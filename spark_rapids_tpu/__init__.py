@@ -1,0 +1,50 @@
+"""spark_rapids_tpu — a TPU-native accelerator framework for Spark-SQL-style
+columnar query execution.
+
+This is a brand-new, TPU-first framework with the capabilities of the RAPIDS
+Accelerator for Apache Spark (reference: LuciferYang/spark-rapids, a fork of
+NVIDIA/spark-rapids).  It is NOT a port: where the reference rewrites Spark
+physical plans into GPU operators backed by libcudf/CUDA, this framework
+rewrites columnar query plans into TPU operators backed by JAX/XLA/Pallas:
+
+  * columns live in TPU HBM as validity-masked dense arrays (strings as
+    length-bucketed padded byte matrices — the TPU-idiomatic answer to
+    cuDF's offset-based layout, because XLA wants static shapes and the
+    VPU operates on 8x128 tiles);
+  * query-plan fragments between pipeline breakers are traced ONCE and
+    compiled by XLA into a single fused program (whole-stage jit — the
+    TPU answer to cuDF AST fusion, reference:
+    sql-plugin/src/main/scala/com/nvidia/spark/rapids/GpuTieredProject);
+  * group-by / join / sort are sort-based (lax.sort + segment reductions),
+    because a systolic/vector machine without device-wide atomics favors
+    sorting networks over hash tables (SURVEY.md §7 hard-part #3);
+  * the shuffle's device-direct mode rides XLA all-to-all collectives over
+    ICI via jax.sharding + shard_map, replacing the reference's UCX/NVLink
+    point-to-point transport (reference: com/nvidia/spark/rapids/shuffle/**).
+
+Layer map (mirrors SURVEY.md §1):
+  config.py        — RapidsConf analog (typed spark.rapids.* registry)    [L8]
+  types.py         — Spark SQL type system
+  columnar/        — device ColumnVector / ColumnarBatch                  [L3]
+  expr/            — GpuExpression library analog                         [L4/2.5]
+  plan/            — plan nodes + DataFrame builder (CPU-plan stand-in)
+  overrides/       — TpuOverrides / RapidsMeta tagging / transitions      [L2]
+  exec/            — TpuExec operators                                    [L4]
+  mem/             — semaphore, spill, OOM-retry, device manager          [L3]
+  io/              — Parquet/CSV/JSON readers + writers                   [L6]
+  shuffle/         — serializer + shuffle manager + ICI all-to-all        [L5]
+  parallel/        — Mesh / collectives / multi-chip planning             [L5]
+  ops/             — jnp/Pallas kernels (segment, sort, string, hash)     [L0]
+  cpu/             — independent CPU oracle (differential-test golden)    [L9]
+"""
+
+__version__ = "0.1.0"
+
+# Spark semantics are 64-bit (bigint, double).  Must be set before any jax
+# array is created.  On TPU f64 is emulated (slow) — hot numeric paths use
+# int64 decimals / f32 where Spark compatibility allows.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from spark_rapids_tpu.config import TpuConf, get_conf, set_conf  # noqa: F401

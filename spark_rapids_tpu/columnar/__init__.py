@@ -1,0 +1,6 @@
+from spark_rapids_tpu.columnar.column import (  # noqa: F401
+    DeviceColumn,
+    HostColumn,
+    round_up_bucket,
+)
+from spark_rapids_tpu.columnar.batch import ColumnarBatch  # noqa: F401

@@ -1,0 +1,203 @@
+"""ColumnarBatch — a set of equal-row-count device columns.
+
+Reference analog: Spark's ColumnarBatch holding GpuColumnVectors
+(GpuColumnVector.from(Table) etc.).  Batches here carry:
+
+  * columns: DeviceColumn pytrees (padded to a shared row capacity)
+  * num_rows: the logical row count (host int — known when the batch is
+    materialized; device-resident fused programs carry it as a scalar)
+  * schema: StructType naming the columns
+
+Batches are immutable; operators build new ones.  Registered as a pytree so a
+whole fused plan-stage can be jitted over batches directly.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import (
+    DEFAULT_ROW_BUCKETS,
+    DeviceColumn,
+    HostColumn,
+    round_up_bucket,
+)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class ColumnarBatch:
+    columns: List[DeviceColumn]
+    num_rows: int
+    schema: T.StructType
+
+    def tree_flatten(self):
+        return tuple(self.columns), (self.num_rows, self.schema)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        num_rows, schema = aux
+        return cls(list(children), num_rows, schema)
+
+    @property
+    def num_cols(self) -> int:
+        return len(self.columns)
+
+    @property
+    def capacity(self) -> int:
+        return self.columns[0].capacity if self.columns else 0
+
+    def nbytes(self) -> int:
+        return sum(c.nbytes() for c in self.columns)
+
+    def column(self, i: int) -> DeviceColumn:
+        return self.columns[i]
+
+    def column_by_name(self, name: str) -> DeviceColumn:
+        for f, c in zip(self.schema.fields, self.columns):
+            if f.name == name:
+                return c
+        raise KeyError(name)
+
+    @property
+    def row_mask(self) -> jax.Array:
+        """(capacity,) bool — True for logical rows, False for padding."""
+        return jnp.arange(self.capacity) < self.num_rows
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_host_columns(cols: Sequence[HostColumn], names: Sequence[str],
+                          row_buckets=DEFAULT_ROW_BUCKETS) -> "ColumnarBatch":
+        n = cols[0].num_rows if cols else 0
+        cap = round_up_bucket(max(n, 1), row_buckets)
+        dcols = [DeviceColumn.from_host(c, capacity=cap) for c in cols]
+        schema = T.StructType(
+            [T.StructField(nm, c.dtype) for nm, c in zip(names, cols)])
+        return ColumnarBatch(dcols, n, schema)
+
+    @staticmethod
+    def from_pydict(data: dict, schema: T.StructType,
+                    row_buckets=DEFAULT_ROW_BUCKETS) -> "ColumnarBatch":
+        cols = [HostColumn.from_pylist(data[f.name], f.dataType)
+                for f in schema.fields]
+        return ColumnarBatch.from_host_columns(
+            cols, [f.name for f in schema.fields], row_buckets)
+
+    def to_host_columns(self) -> List[HostColumn]:
+        return [c.to_host(self.num_rows) for c in self.columns]
+
+    def to_pydict(self) -> dict:
+        return {f.name: c.to_host(self.num_rows).to_pylist()
+                for f, c in zip(self.schema.fields, self.columns)}
+
+    def to_rows(self) -> List[tuple]:
+        cols = [c.to_host(self.num_rows).to_pylist() for c in self.columns]
+        return list(zip(*cols)) if cols else [()] * self.num_rows
+
+    def with_columns(self, columns: List[DeviceColumn],
+                     schema: Optional[T.StructType] = None,
+                     num_rows: Optional[int] = None) -> "ColumnarBatch":
+        return ColumnarBatch(columns,
+                             self.num_rows if num_rows is None else num_rows,
+                             schema or self.schema)
+
+    def select(self, indices: Sequence[int]) -> "ColumnarBatch":
+        return ColumnarBatch(
+            [self.columns[i] for i in indices], self.num_rows,
+            T.StructType([self.schema.fields[i] for i in indices]))
+
+    # -- concat (GpuCoalesceBatches building block) -------------------------
+    @staticmethod
+    def concat(batches: Sequence["ColumnarBatch"],
+               row_buckets=DEFAULT_ROW_BUCKETS) -> "ColumnarBatch":
+        """Concatenate batches (same schema) into one padded batch.
+
+        Reference analog: cuDF Table.concatenate used by GpuCoalesceBatches.
+        Device-resident: pure jnp ops, no host round-trip.
+        """
+        assert batches, "concat of zero batches"
+        if len(batches) == 1:
+            return batches[0]
+        total = sum(b.num_rows for b in batches)
+        cap = round_up_bucket(max(total, 1), row_buckets)
+        schema = batches[0].schema
+        ncols = batches[0].num_cols
+        out_cols: List[DeviceColumn] = []
+        for ci in range(ncols):
+            cols = [b.columns[ci] for b in batches]
+            dtype = cols[0].dtype
+            if cols[0].is_string:
+                width = max(c.width for c in cols)
+                chars = jnp.zeros((cap, width), jnp.uint8)
+                lengths = jnp.zeros(cap, jnp.int32)
+                validity = jnp.zeros(cap, jnp.bool_)
+                off = 0
+                for b, c in zip(batches, cols):
+                    n = b.num_rows
+                    if n == 0:
+                        continue
+                    chars = jax.lax.dynamic_update_slice(
+                        chars,
+                        jnp.pad(c.chars[:, :],
+                                ((0, 0), (0, width - c.width))).astype(jnp.uint8)[:n],
+                        (off, 0))
+                    lengths = jax.lax.dynamic_update_slice(lengths, c.lengths[:n], (off,))
+                    validity = jax.lax.dynamic_update_slice(validity, c.validity[:n], (off,))
+                    off += n
+                out_cols.append(DeviceColumn(dtype, validity, chars=chars,
+                                             lengths=lengths))
+            else:
+                data = jnp.zeros(cap, cols[0].data.dtype)
+                validity = jnp.zeros(cap, jnp.bool_)
+                off = 0
+                for b, c in zip(batches, cols):
+                    n = b.num_rows
+                    if n == 0:
+                        continue
+                    data = jax.lax.dynamic_update_slice(data, c.data[:n], (off,))
+                    validity = jax.lax.dynamic_update_slice(validity, c.validity[:n], (off,))
+                    off += n
+                out_cols.append(DeviceColumn(dtype, validity, data=data))
+        return ColumnarBatch(out_cols, total, schema)
+
+    def slice_rows(self, start: int, length: int,
+                   row_buckets=DEFAULT_ROW_BUCKETS) -> "ColumnarBatch":
+        """Host-driven row slice (used by split-and-retry)."""
+        cap = round_up_bucket(max(length, 1), row_buckets)
+        cols = []
+        for c in self.columns:
+            if c.is_string:
+                cols.append(DeviceColumn(
+                    c.dtype,
+                    jax.lax.dynamic_slice(c.validity, (start,), (length,))
+                    if length <= c.capacity - start else c.validity[start:start + length],
+                    chars=c.chars[start:start + length],
+                    lengths=c.lengths[start:start + length]).slice_to(cap))
+            else:
+                cols.append(DeviceColumn(
+                    c.dtype, c.validity[start:start + length],
+                    data=c.data[start:start + length]).slice_to(cap))
+        return ColumnarBatch(cols, length, self.schema)
+
+    def __repr__(self):
+        return (f"ColumnarBatch(rows={self.num_rows}, cap={self.capacity}, "
+                f"schema={self.schema.simpleString})")
+
+
+def empty_batch(schema: T.StructType, capacity: int = 1) -> ColumnarBatch:
+    cols = []
+    for f in schema.fields:
+        if isinstance(f.dataType, T.StringType):
+            cols.append(DeviceColumn(f.dataType, jnp.zeros(capacity, jnp.bool_),
+                                     chars=jnp.zeros((capacity, 8), jnp.uint8),
+                                     lengths=jnp.zeros(capacity, jnp.int32)))
+        else:
+            sdt = T.storage_dtype(f.dataType)
+            cols.append(DeviceColumn(f.dataType, jnp.zeros(capacity, jnp.bool_),
+                                     data=jnp.zeros(capacity, sdt)))
+    return ColumnarBatch(cols, 0, schema)

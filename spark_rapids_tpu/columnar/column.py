@@ -1,0 +1,317 @@
+"""Device/host column vectors — the TPU answer to GpuColumnVector.
+
+Reference analog: sql-plugin/src/main/java/com/nvidia/spark/rapids/
+GpuColumnVector.java and RapidsHostColumnVector.java, which wrap cuDF device
+columns (data + validity bitmask + offsets) as Spark ColumnVectors.
+
+TPU-first design decisions (NOT a translation of the cuDF layout):
+
+* **Padded capacities.** XLA compiles per shape.  Every column is padded to a
+  row-capacity bucket (pow2 ladder, ``spark.rapids.tpu.batch.rowBuckets``) so
+  a query sees a handful of compiled programs, not one per batch size.  The
+  logical row count rides alongside (host int) and as a device scalar inside
+  fused programs; rows past ``num_rows`` are garbage and masked off.
+
+* **Validity as bool vector, not bitmask.**  cuDF packs validity 1 bit/row
+  because PCIe bytes are precious; on TPU the VPU operates on 8x128 lanes of
+  bytes and XLA fuses the mask reads into consumers, so a bool vector is both
+  faster and simpler.
+
+* **Strings as length-bucketed padded char matrices.**  cuDF stores
+  (chars, offsets); offset-indirection defeats XLA's static-shape tiling, so
+  strings here are a ``(capacity, width)`` uint8 matrix plus an int32 length
+  vector, with ``width`` drawn from a bucket ladder
+  (``spark.rapids.tpu.string.widthBuckets``).  Lexicographic compare, hash,
+  substring etc. become dense vector ops.  Memory overhead is bounded by the
+  ladder and by width re-bucketing at coalesce time.
+
+* **Decimals** are unscaled int64 (precision<=18); decimal128 is a two-limb
+  (hi int64, lo uint-as-int64) pair — see expr/decimal128.py.
+
+Columns are registered as JAX pytrees so whole-stage-fused programs take and
+return them directly.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+DEFAULT_ROW_BUCKETS = (1024, 8192, 65536, 262144, 1048576, 4194304)
+DEFAULT_WIDTH_BUCKETS = (8, 32, 128, 512, 2048)
+
+
+def round_up_bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    # beyond the ladder: next pow2
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DeviceColumn:
+    """One column resident in TPU HBM.
+
+    kind "flat": data (capacity,) of storage dtype; chars/lengths None.
+    kind "string": chars (capacity, width) uint8; lengths (capacity,) int32;
+                   data is None.
+    validity: (capacity,) bool; True = valid (non-null).
+    """
+
+    dtype: T.DataType
+    validity: jax.Array
+    data: Optional[jax.Array] = None
+    chars: Optional[jax.Array] = None
+    lengths: Optional[jax.Array] = None
+
+    # -- pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        children = (self.validity, self.data, self.chars, self.lengths)
+        return children, self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        validity, data, chars, lengths = children
+        return cls(dtype=aux, validity=validity, data=data, chars=chars,
+                   lengths=lengths)
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def is_string(self) -> bool:
+        return self.chars is not None
+
+    @property
+    def capacity(self) -> int:
+        return int(self.validity.shape[0])
+
+    @property
+    def width(self) -> int:
+        return int(self.chars.shape[1]) if self.chars is not None else 0
+
+    def nbytes(self) -> int:
+        n = self.validity.size  # bool = 1 byte
+        if self.data is not None:
+            n += self.data.size * self.data.dtype.itemsize
+        if self.chars is not None:
+            n += self.chars.size + self.lengths.size * 4
+        return int(n)
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def from_host(h: "HostColumn", capacity: Optional[int] = None,
+                  width_buckets: Sequence[int] = DEFAULT_WIDTH_BUCKETS,
+                  row_buckets: Sequence[int] = DEFAULT_ROW_BUCKETS) -> "DeviceColumn":
+        n = h.num_rows
+        cap = capacity or round_up_bucket(max(n, 1), row_buckets)
+        validity = np.zeros(cap, dtype=np.bool_)
+        validity[:n] = h.validity[:n]
+        if h.is_string:
+            max_len = int(h.lengths[:n].max()) if n else 0
+            width = round_up_bucket(max(max_len, 1), width_buckets)
+            chars = np.zeros((cap, width), dtype=np.uint8)
+            chars[:n, : h.chars.shape[1]] = h.chars[:n, :min(width, h.chars.shape[1])]
+            lengths = np.zeros(cap, dtype=np.int32)
+            lengths[:n] = h.lengths[:n]
+            return DeviceColumn(dtype=h.dtype, validity=jnp.asarray(validity),
+                                chars=jnp.asarray(chars),
+                                lengths=jnp.asarray(lengths))
+        data = np.zeros(cap, dtype=h.data.dtype)
+        data[:n] = h.data[:n]
+        return DeviceColumn(dtype=h.dtype, validity=jnp.asarray(validity),
+                            data=jnp.asarray(data))
+
+    def to_host(self, num_rows: int) -> "HostColumn":
+        validity = np.asarray(self.validity)[:num_rows]
+        if self.is_string:
+            return HostColumn(dtype=self.dtype, validity=validity,
+                              chars=np.asarray(self.chars)[:num_rows],
+                              lengths=np.asarray(self.lengths)[:num_rows])
+        return HostColumn(dtype=self.dtype, validity=validity,
+                          data=np.asarray(self.data)[:num_rows])
+
+    def slice_to(self, capacity: int) -> "DeviceColumn":
+        """Re-pad (grow or shrink capacity); keeps device residency."""
+        if capacity == self.capacity:
+            return self
+        if capacity < self.capacity:
+            if self.is_string:
+                return DeviceColumn(self.dtype, self.validity[:capacity],
+                                    chars=self.chars[:capacity],
+                                    lengths=self.lengths[:capacity])
+            return DeviceColumn(self.dtype, self.validity[:capacity],
+                                data=self.data[:capacity])
+        pad = capacity - self.capacity
+        if self.is_string:
+            return DeviceColumn(
+                self.dtype,
+                jnp.concatenate([self.validity, jnp.zeros(pad, jnp.bool_)]),
+                chars=jnp.concatenate(
+                    [self.chars, jnp.zeros((pad, self.width), jnp.uint8)]),
+                lengths=jnp.concatenate(
+                    [self.lengths, jnp.zeros(pad, jnp.int32)]))
+        return DeviceColumn(
+            self.dtype,
+            jnp.concatenate([self.validity, jnp.zeros(pad, jnp.bool_)]),
+            data=jnp.concatenate(
+                [self.data, jnp.zeros(pad, self.data.dtype)]))
+
+
+@dataclasses.dataclass
+class HostColumn:
+    """Host-side column (numpy), the RapidsHostColumnVector analog.
+
+    Also the interchange point with pyarrow and with the CPU oracle.
+    """
+
+    dtype: T.DataType
+    validity: np.ndarray
+    data: Optional[np.ndarray] = None
+    chars: Optional[np.ndarray] = None     # (n, width) uint8
+    lengths: Optional[np.ndarray] = None   # (n,) int32
+
+    @property
+    def is_string(self) -> bool:
+        return self.chars is not None
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.validity.shape[0])
+
+    # -- python interchange -------------------------------------------------
+    @staticmethod
+    def from_pylist(values: List, dtype: T.DataType) -> "HostColumn":
+        n = len(values)
+        validity = np.array([v is not None for v in values], dtype=np.bool_)
+        if isinstance(dtype, T.StringType):
+            encoded = [v.encode("utf-8") if v is not None else b"" for v in values]
+            width = max((len(b) for b in encoded), default=1) or 1
+            chars = np.zeros((n, width), dtype=np.uint8)
+            lengths = np.zeros(n, dtype=np.int32)
+            for i, b in enumerate(encoded):
+                chars[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+                lengths[i] = len(b)
+            return HostColumn(dtype, validity, chars=chars, lengths=lengths)
+        sdt = T.storage_dtype(dtype)
+        data = np.zeros(n, dtype=sdt)
+        for i, v in enumerate(values):
+            if v is not None:
+                if isinstance(dtype, T.DecimalType):
+                    # accept python Decimal/int/float as scaled value
+                    from decimal import Decimal
+
+                    d = Decimal(str(v)).scaleb(dtype.scale)
+                    data[i] = int(d.to_integral_value())
+                elif isinstance(dtype, T.BooleanType):
+                    data[i] = bool(v)
+                elif isinstance(dtype, T.DateType):
+                    import datetime as _dt
+
+                    data[i] = (v - _dt.date(1970, 1, 1)).days if isinstance(
+                        v, _dt.date) else v
+                elif isinstance(dtype, T.TimestampType):
+                    import datetime as _dt
+
+                    if isinstance(v, _dt.datetime):
+                        epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+                        vv = v if v.tzinfo else v.replace(tzinfo=_dt.timezone.utc)
+                        data[i] = int((vv - epoch).total_seconds() * 1_000_000)
+                    else:
+                        data[i] = v
+                else:
+                    data[i] = v
+        return HostColumn(dtype, validity, data=data)
+
+    def to_pylist(self) -> List:
+        out: List = []
+        for i in range(self.num_rows):
+            if not self.validity[i]:
+                out.append(None)
+            elif self.is_string:
+                ln = int(self.lengths[i])
+                out.append(bytes(self.chars[i, :ln]).decode("utf-8", "replace"))
+            elif isinstance(self.dtype, T.DecimalType):
+                from decimal import Decimal
+
+                out.append(Decimal(int(self.data[i])).scaleb(-self.dtype.scale))
+            elif isinstance(self.dtype, T.BooleanType):
+                out.append(bool(self.data[i]))
+            elif isinstance(self.dtype, (T.FloatType, T.DoubleType)):
+                out.append(float(self.data[i]))
+            elif isinstance(self.dtype, T.DateType):
+                import datetime as _dt
+
+                out.append(_dt.date(1970, 1, 1) + _dt.timedelta(days=int(self.data[i])))
+            elif isinstance(self.dtype, T.TimestampType):
+                import datetime as _dt
+
+                out.append(_dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+                           + _dt.timedelta(microseconds=int(self.data[i])))
+            else:
+                out.append(int(self.data[i]))
+        return out
+
+    @staticmethod
+    def from_numpy(arr: np.ndarray, dtype: T.DataType,
+                   validity: Optional[np.ndarray] = None) -> "HostColumn":
+        v = validity if validity is not None else np.ones(len(arr), np.bool_)
+        return HostColumn(dtype, v, data=np.ascontiguousarray(arr))
+
+    @staticmethod
+    def from_strings(strs: List[Optional[str]]) -> "HostColumn":
+        return HostColumn.from_pylist(strs, T.STRING)
+
+    # -- pyarrow interchange (used by the IO layer) -------------------------
+    @staticmethod
+    def from_arrow(arr, dtype: T.DataType) -> "HostColumn":
+        import pyarrow as pa
+
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        n = len(arr)
+        validity = np.asarray(arr.is_valid())
+        if isinstance(dtype, T.StringType):
+            arr = arr.cast(pa.large_binary()) if not pa.types.is_large_binary(arr.type) else arr
+            buf = np.frombuffer(arr.buffers()[2] or b"", dtype=np.uint8)
+            offs = np.frombuffer(arr.buffers()[1], dtype=np.int64)[arr.offset: arr.offset + n + 1]
+            lengths = (offs[1:] - offs[:-1]).astype(np.int32)
+            width = int(lengths.max()) if n and lengths.size else 1
+            width = max(width, 1)
+            chars = np.zeros((n, width), dtype=np.uint8)
+            for i in range(n):  # TODO(perf): vectorize ragged gather
+                s, ln = offs[i], lengths[i]
+                if ln:
+                    chars[i, :ln] = buf[s: s + ln]
+            return HostColumn(dtype, validity, chars=chars, lengths=lengths)
+        sdt = T.storage_dtype(dtype)
+        if isinstance(dtype, T.DecimalType):
+            import pyarrow.compute as pc
+            np_arr = np.asarray(pc.cast(arr, pa.int64()).fill_null(0), dtype=np.int64)
+        else:
+            np_arr = np.asarray(arr.fill_null(0)).astype(sdt, copy=False)
+        return HostColumn(dtype, validity, data=np_arr)
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        mask = ~self.validity
+        if self.is_string:
+            return pa.array(self.to_pylist(), type=pa.string())
+        if isinstance(self.dtype, T.DecimalType):
+            return pa.array(np.ma.masked_array(self.data, mask)).cast(
+                pa.decimal128(self.dtype.precision, self.dtype.scale))
+        if isinstance(self.dtype, T.DateType):
+            return pa.array(np.ma.masked_array(self.data, mask)).cast(pa.date32())
+        if isinstance(self.dtype, T.TimestampType):
+            return pa.array(np.ma.masked_array(self.data, mask)).cast(
+                pa.timestamp("us", tz="UTC"))
+        return pa.array(np.ma.masked_array(self.data, mask))

@@ -1,0 +1,17 @@
+from spark_rapids_tpu.expr.base import (  # noqa: F401
+    Alias,
+    AttributeReference,
+    BoundReference,
+    EvalContext,
+    Expression,
+    Literal,
+    col,
+    lit,
+)
+from spark_rapids_tpu.expr import arithmetic  # noqa: F401
+from spark_rapids_tpu.expr import predicates  # noqa: F401
+from spark_rapids_tpu.expr import conditional  # noqa: F401
+from spark_rapids_tpu.expr import cast  # noqa: F401
+from spark_rapids_tpu.expr import mathfuncs  # noqa: F401
+from spark_rapids_tpu.expr import strings  # noqa: F401
+from spark_rapids_tpu.expr import datetime as datetime_exprs  # noqa: F401

@@ -1,0 +1,401 @@
+"""Arithmetic expressions with Spark-exact semantics.
+
+Reference analog: org/apache/spark/sql/rapids/arithmetic.scala (GpuAdd,
+GpuSubtract, GpuMultiply, GpuDivide, GpuIntegralDivide, GpuRemainder,
+GpuUnaryMinus, GpuAbs, GpuPmod) and spark-rapids-jni decimal_utils.cu for
+decimal precision/overflow behavior.
+
+Spark semantics reproduced here:
+  * integral overflow wraps (Java two's complement) in legacy mode; ANSI mode
+    raises — on TPU the wrap comes free from int arithmetic and the ANSI
+    check is a fused overflow-flag reduction (EvalContext.add_error).
+  * Divide on non-decimals always yields double; x/0 -> null (legacy) or
+    error (ANSI).
+  * Decimal +,-,* follow DecimalPrecision: add/sub s=max(s1,s2),
+    p=max(p1-s1,p2-s2)+s+1; mul p=p1+p2+1, s=s1+s2 (capped at 38).
+    Results beyond the result precision -> null (legacy) / error (ANSI).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expr.base import (
+    BinaryExpression,
+    EvalContext,
+    Expression,
+    UnaryExpression,
+)
+
+_INT_MIN = {T.ByteType: -(2 ** 7), T.ShortType: -(2 ** 15),
+            T.IntegerType: -(2 ** 31), T.LongType: -(2 ** 63)}
+_INT_MAX = {T.ByteType: 2 ** 7 - 1, T.ShortType: 2 ** 15 - 1,
+            T.IntegerType: 2 ** 31 - 1, T.LongType: 2 ** 63 - 1}
+
+
+def _pow10_i64(k: int):
+    return 10 ** min(k, 18)
+
+
+class BinaryArithmetic(BinaryExpression):
+    symbol = "?"
+
+    def sql_string(self):
+        return f"({self.left.sql_string()} {self.symbol} {self.right.sql_string()})"
+
+    def _resolve_type(self):
+        from spark_rapids_tpu.expr.cast import Cast
+
+        lt, rt = self.left.dataType, self.right.dataType
+        if isinstance(lt, T.DecimalType) or isinstance(rt, T.DecimalType):
+            ld = lt if isinstance(lt, T.DecimalType) else _int_as_decimal(lt)
+            rd = rt if isinstance(rt, T.DecimalType) else _int_as_decimal(rt)
+            if not isinstance(lt, T.DecimalType):
+                self.children[0] = Cast(self.left, ld).resolve(None)
+            if not isinstance(rt, T.DecimalType):
+                self.children[1] = Cast(self.right, rd).resolve(None)
+            self._dataType = self._decimal_result(ld, rd)
+            self._nullable = True
+            return
+        if lt != rt:
+            common = T.numeric_promote(lt, rt)
+            if lt != common:
+                self.children[0] = Cast(self.left, common).resolve(None)
+            if rt != common:
+                self.children[1] = Cast(self.right, common).resolve(None)
+        self._dataType = self.left.dataType
+        self._nullable = self.left.nullable or self.right.nullable
+
+    def _decimal_result(self, ld: T.DecimalType, rd: T.DecimalType) -> T.DecimalType:
+        raise NotImplementedError
+
+    def do_columnar_eval(self, ctx: EvalContext, cols: List[DeviceColumn]):
+        l, r = cols
+        validity = l.validity & r.validity
+        dt = self.dataType
+        if isinstance(dt, T.DecimalType):
+            return self._eval_decimal(ctx, l, r, validity)
+        data = self._op(l.data, r.data)
+        if ctx.ansi and dt.is_integral:
+            over = self._overflow_flag(l.data, r.data, data)
+            if over is not None:
+                ctx.add_error(over & validity,
+                              f"{self.pretty_name} caused overflow (ANSI)")
+        return DeviceColumn(dt, validity, data=data)
+
+    def _op(self, a, b):
+        raise NotImplementedError
+
+    def _overflow_flag(self, a, b, res):
+        return None
+
+    def _eval_decimal(self, ctx, l, r, validity):
+        raise NotImplementedError(f"decimal {self.pretty_name}")
+
+
+def _int_as_decimal(t: T.DataType) -> T.DecimalType:
+    digits = {T.ByteType: 3, T.ShortType: 5, T.IntegerType: 10,
+              T.LongType: 20}.get(type(t))
+    if digits is None:
+        raise TypeError(f"cannot mix {t} with decimal")
+    return T.DecimalType(min(digits, 38), 0)
+
+
+def _decimal_bound_check(ctx, data, dt: T.DecimalType, validity, ansi: bool,
+                         op: str, extra_invalid=None):
+    """null-out (legacy) / flag (ANSI) results beyond 10^precision."""
+    if dt.precision >= 19:
+        bound_ok = jnp.ones_like(validity)
+    else:
+        bound = _pow10_i64(dt.precision)
+        bound_ok = (data < bound) & (data > -bound)
+    if extra_invalid is not None:
+        bound_ok = bound_ok & ~extra_invalid
+    if ansi:
+        ctx.add_error(~bound_ok & validity, f"decimal {op} overflow (ANSI)")
+        return validity
+    return validity & bound_ok
+
+
+class Add(BinaryArithmetic):
+    symbol = "+"
+
+    def _op(self, a, b):
+        return a + b
+
+    def _overflow_flag(self, a, b, res):
+        return ((a > 0) & (b > 0) & (res < 0)) | ((a < 0) & (b < 0) & (res >= 0))
+
+    def _decimal_result(self, ld, rd):
+        s = max(ld.scale, rd.scale)
+        p = max(ld.precision - ld.scale, rd.precision - rd.scale) + s + 1
+        return T.DecimalType(min(p, 38), s)
+
+    def _eval_decimal(self, ctx, l, r, validity):
+        dt: T.DecimalType = self.dataType
+        lt: T.DecimalType = self.left.dataType
+        rt: T.DecimalType = self.right.dataType
+        a = l.data * _pow10_i64(dt.scale - lt.scale)
+        b = r.data * _pow10_i64(dt.scale - rt.scale)
+        data = a + b
+        validity = _decimal_bound_check(ctx, data, dt, validity, ctx.ansi, "add")
+        return DeviceColumn(dt, validity, data=data)
+
+
+class Subtract(Add):
+    symbol = "-"
+
+    def _op(self, a, b):
+        return a - b
+
+    def _overflow_flag(self, a, b, res):
+        return ((a >= 0) & (b < 0) & (res < 0)) | ((a < 0) & (b > 0) & (res >= 0))
+
+    def _eval_decimal(self, ctx, l, r, validity):
+        dt: T.DecimalType = self.dataType
+        lt: T.DecimalType = self.left.dataType
+        rt: T.DecimalType = self.right.dataType
+        a = l.data * _pow10_i64(dt.scale - lt.scale)
+        b = r.data * _pow10_i64(dt.scale - rt.scale)
+        data = a - b
+        validity = _decimal_bound_check(ctx, data, dt, validity, ctx.ansi, "subtract")
+        return DeviceColumn(dt, validity, data=data)
+
+
+class Multiply(BinaryArithmetic):
+    symbol = "*"
+
+    def _op(self, a, b):
+        return a * b
+
+    def _overflow_flag(self, a, b, res):
+        # res/b != a detects int overflow without widening
+        return (b != 0) & (res // jnp.where(b == 0, 1, b) != a)
+
+    def _decimal_result(self, ld, rd):
+        return T.DecimalType(min(ld.precision + rd.precision + 1, 38),
+                             min(ld.scale + rd.scale, 38))
+
+    def _eval_decimal(self, ctx, l, r, validity):
+        dt: T.DecimalType = self.dataType
+        data = l.data * r.data
+        # int64 intermediate overflow detection via float magnitude estimate
+        approx = l.data.astype(jnp.float64) * r.data.astype(jnp.float64)
+        i64_over = jnp.abs(approx) > 9.1e18
+        validity = _decimal_bound_check(ctx, data, dt, validity, ctx.ansi,
+                                        "multiply", extra_invalid=i64_over)
+        return DeviceColumn(dt, validity, data=data)
+
+
+class Divide(BinaryArithmetic):
+    """Spark Divide: non-decimal operands -> double division."""
+
+    symbol = "/"
+
+    def _resolve_type(self):
+        from spark_rapids_tpu.expr.cast import Cast
+
+        lt, rt = self.left.dataType, self.right.dataType
+        if isinstance(lt, T.DecimalType) or isinstance(rt, T.DecimalType):
+            super()._resolve_type()
+            return
+        if lt != T.DOUBLE:
+            self.children[0] = Cast(self.left, T.DOUBLE).resolve(None)
+        if rt != T.DOUBLE:
+            self.children[1] = Cast(self.right, T.DOUBLE).resolve(None)
+        self._dataType = T.DOUBLE
+        self._nullable = True
+
+    def _decimal_result(self, ld, rd):
+        s = max(6, ld.scale + rd.precision + 1)
+        p = ld.precision - ld.scale + rd.scale + s
+        if p > 38:
+            # Spark reduces scale to fit
+            s = max(6, 38 - (p - s))
+            p = 38
+        return T.DecimalType(p, s)
+
+    def do_columnar_eval(self, ctx, cols):
+        l, r = cols
+        if isinstance(self.dataType, T.DecimalType):
+            return self._eval_decimal(ctx, l, r, l.validity & r.validity)
+        div_by_zero = r.data == 0.0
+        validity = l.validity & r.validity & ~div_by_zero
+        if ctx.ansi:
+            ctx.add_error(div_by_zero & l.validity & r.validity,
+                          "division by zero (ANSI)")
+        data = l.data / jnp.where(div_by_zero, 1.0, r.data)
+        return DeviceColumn(T.DOUBLE, validity, data=data)
+
+    def _eval_decimal(self, ctx, l, r, validity):
+        dt: T.DecimalType = self.dataType
+        lt: T.DecimalType = self.left.dataType
+        rt: T.DecimalType = self.right.dataType
+        div_by_zero = r.data == 0
+        if ctx.ansi:
+            ctx.add_error(div_by_zero & validity, "division by zero (ANSI)")
+        validity = validity & ~div_by_zero
+        # target scale: s; numerator scaled to s + rt.scale then HALF_UP
+        shift = dt.scale - lt.scale + rt.scale
+        num = l.data * _pow10_i64(max(shift, 0))
+        den = jnp.where(div_by_zero, 1, r.data) * _pow10_i64(max(-shift, 0))
+        q = num // den
+        rem = num - q * den
+        # Spark HALF_UP rounding on the quotient
+        half = jnp.abs(den)
+        round_away = (jnp.abs(rem) * 2 >= half) & (rem != 0)
+        sign = jnp.where((num < 0) ^ (den < 0), -1, 1)
+        data = q + jnp.where(round_away, sign, 0)
+        # python-floor-div vs truncation: floor differs for negatives
+        # correct truncation-toward-zero first:
+        trunc_fix = jnp.where((rem != 0) & ((num < 0) ^ (den < 0)), 1, 0)
+        data = q + trunc_fix
+        rem2 = num - data * den
+        round_away = (jnp.abs(rem2) * 2 >= half) & (rem2 != 0)
+        data = data + jnp.where(round_away, sign, 0)
+        validity = _decimal_bound_check(ctx, data, dt, validity, ctx.ansi, "divide")
+        return DeviceColumn(dt, validity, data=data)
+
+
+class IntegralDivide(BinaryArithmetic):
+    """`div` — integral division returning LONG (Spark semantics)."""
+
+    symbol = "div"
+
+    def _resolve_type(self):
+        from spark_rapids_tpu.expr.cast import Cast
+
+        for i in (0, 1):
+            if self.children[i].dataType != T.LONG:
+                self.children[i] = Cast(self.children[i], T.LONG).resolve(None)
+        self._dataType = T.LONG
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        l, r = cols
+        div_by_zero = r.data == 0
+        validity = l.validity & r.validity & ~div_by_zero
+        if ctx.ansi:
+            ctx.add_error(div_by_zero & l.validity & r.validity,
+                          "division by zero (ANSI)")
+        den = jnp.where(div_by_zero, 1, r.data)
+        q = l.data // den
+        rem = l.data - q * den
+        # Java integer division truncates toward zero; jnp floors.
+        q = q + jnp.where((rem != 0) & ((l.data < 0) ^ (den < 0)), 1, 0)
+        return DeviceColumn(T.LONG, validity, data=q)
+
+
+class Remainder(BinaryArithmetic):
+    symbol = "%"
+
+    def _op(self, a, b):
+        raise AssertionError("handled in do_columnar_eval")
+
+    def _decimal_result(self, ld, rd):
+        s = max(ld.scale, rd.scale)
+        p = min(ld.precision - ld.scale, rd.precision - rd.scale) + s
+        return T.DecimalType(min(p, 38), s)
+
+    def do_columnar_eval(self, ctx, cols):
+        l, r = cols
+        dt = self.dataType
+        zero = r.data == 0 if not dt.is_floating else r.data == 0.0
+        validity = l.validity & r.validity
+        if not dt.is_floating:
+            if ctx.ansi:
+                ctx.add_error(zero & validity, "division by zero (ANSI)")
+            validity = validity & ~zero
+            den = jnp.where(zero, 1, r.data)
+            data = l.data - _trunc_div(l.data, den) * den
+        else:
+            # float % follows Java Math.IEEEremainder-like fmod (sign of dividend)
+            data = _fmod(l.data, r.data)
+            validity = validity & ~zero
+        return DeviceColumn(dt, validity, data=data)
+
+    def _eval_decimal(self, ctx, l, r, validity):
+        dt: T.DecimalType = self.dataType
+        lt: T.DecimalType = self.left.dataType
+        rt: T.DecimalType = self.right.dataType
+        a = l.data * _pow10_i64(dt.scale - lt.scale)
+        b = r.data * _pow10_i64(dt.scale - rt.scale)
+        zero = b == 0
+        if ctx.ansi:
+            ctx.add_error(zero & validity, "division by zero (ANSI)")
+        validity = validity & ~zero
+        den = jnp.where(zero, 1, b)
+        data = a - _trunc_div(a, den) * den
+        return DeviceColumn(dt, validity, data=data)
+
+
+def _trunc_div(a, b):
+    q = a // b
+    rem = a - q * b
+    return q + jnp.where((rem != 0) & ((a < 0) ^ (b < 0)), 1, 0)
+
+
+def _fmod(a, b):
+    safe_b = jnp.where(b == 0.0, 1.0, b)
+    return a - jnp.trunc(a / safe_b) * safe_b
+
+
+class UnaryMinus(UnaryExpression):
+    def sql_string(self):
+        return f"(- {self.child.sql_string()})"
+
+    def _resolve_type(self):
+        self._dataType = self.child.dataType
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        dt = self.dataType
+        validity = c.validity
+        if ctx.ansi and dt.is_integral:
+            mn = _INT_MIN[type(dt)]
+            ctx.add_error((c.data == mn) & validity, "negate overflow (ANSI)")
+        return DeviceColumn(dt, validity, data=-c.data)
+
+
+class Abs(UnaryExpression):
+    def _resolve_type(self):
+        self._dataType = self.child.dataType
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        dt = self.dataType
+        if ctx.ansi and dt.is_integral:
+            mn = _INT_MIN[type(dt)]
+            ctx.add_error((c.data == mn) & c.validity, "abs overflow (ANSI)")
+        return DeviceColumn(dt, c.validity, data=jnp.abs(c.data))
+
+
+class Pmod(BinaryArithmetic):
+    """pmod(a, b): non-negative remainder."""
+
+    symbol = "pmod"
+
+    def _op(self, a, b):
+        raise AssertionError
+
+    def _decimal_result(self, ld, rd):
+        return Remainder(self.left, self.right)._decimal_result(ld, rd)
+
+    def do_columnar_eval(self, ctx, cols):
+        l, r = cols
+        zero = r.data == 0
+        validity = l.validity & r.validity & ~zero
+        if ctx.ansi:
+            ctx.add_error(zero & l.validity & r.validity,
+                          "division by zero (ANSI)")
+        den = jnp.where(zero, 1, r.data)
+        m = l.data % den  # floored mod
+        data = jnp.where((m != 0) & ((m < 0) != (den < 0)), m + den, m)
+        # floored mod already has sign of divisor; pmod wants value in [0,|b|)
+        data = jnp.where(data < 0, data + jnp.abs(den), data)
+        return DeviceColumn(self.dataType, validity, data=data)

@@ -1,0 +1,222 @@
+"""Conditional / null expressions.
+
+Reference analog: com/nvidia/spark/rapids/conditionalExpressions.scala
+(GpuIf, GpuCaseWhen) and nullExpressions.scala (GpuCoalesce, GpuNvl,
+GpuNaNvl, GpuAtLeastNNonNulls).  On TPU these are pure `jnp.where` selects —
+XLA fuses the full predicate chain into the surrounding stage, so unlike the
+reference there is no "lazy side evaluation" optimization to port: both sides
+are computed vectorized, which is the right trade on a vector machine.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expr.base import EvalContext, Expression
+
+
+def select_column(pred, pred_valid, a: DeviceColumn, b: DeviceColumn,
+                  dtype: T.DataType) -> DeviceColumn:
+    """where(pred, a, b) with null-aware pred (null pred -> b per CaseWhen
+    fallthrough, callers adjust)."""
+    take_a = pred & pred_valid
+    validity = jnp.where(take_a, a.validity, b.validity)
+    if a.is_string:
+        w = max(a.width, b.width)
+        from spark_rapids_tpu.expr.predicates import _pad_to
+
+        chars = jnp.where(take_a[:, None], _pad_to(a.chars, w), _pad_to(b.chars, w))
+        lengths = jnp.where(take_a, a.lengths, b.lengths)
+        return DeviceColumn(dtype, validity, chars=chars, lengths=lengths)
+    data = jnp.where(take_a, a.data, b.data)
+    return DeviceColumn(dtype, validity, data=data)
+
+
+def _common_type(ts: List[T.DataType]) -> T.DataType:
+    out = ts[0]
+    for t in ts[1:]:
+        if t == out or isinstance(t, T.NullType):
+            continue
+        if isinstance(out, T.NullType):
+            out = t
+        elif out.is_numeric and t.is_numeric and not (
+                isinstance(out, T.DecimalType) or isinstance(t, T.DecimalType)):
+            out = T.numeric_promote(out, t)
+        elif isinstance(out, T.DecimalType) and isinstance(t, T.DecimalType):
+            s = max(out.scale, t.scale)
+            p = max(out.precision - out.scale, t.precision - t.scale) + s
+            out = T.DecimalType(min(p, 38), s)
+        else:
+            raise TypeError(f"no common type for {out} and {t}")
+    return out
+
+
+class If(Expression):
+    def __init__(self, pred: Expression, left: Expression, right: Expression):
+        super().__init__([pred, left, right])
+
+    def sql_string(self):
+        p, l, r = (c.sql_string() for c in self.children)
+        return f"if({p}, {l}, {r})"
+
+    def _resolve_type(self):
+        from spark_rapids_tpu.expr.cast import Cast
+
+        common = _common_type([self.children[1].dataType,
+                               self.children[2].dataType])
+        for i in (1, 2):
+            if self.children[i].dataType != common:
+                self.children[i] = Cast(self.children[i], common).resolve(None)
+        self._dataType = common
+        self._nullable = (self.children[0].nullable
+                          or self.children[1].nullable
+                          or self.children[2].nullable)
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        p, a, b = cols
+        # null predicate -> else branch (Spark)
+        return select_column(p.data, p.validity, a, b, self.dataType)
+
+
+class CaseWhen(Expression):
+    """CASE WHEN c1 THEN v1 [WHEN c2 THEN v2]... [ELSE e] END.
+
+    children = [c1, v1, c2, v2, ..., (else)]; has_else marks the tail.
+    """
+
+    def __init__(self, branches, else_value=None):
+        kids: List[Expression] = []
+        for c, v in branches:
+            kids.extend([c, v])
+        self.has_else = else_value is not None
+        if else_value is not None:
+            kids.append(else_value)
+        super().__init__(kids)
+
+    def sql_string(self):
+        n = (len(self.children) - (1 if self.has_else else 0)) // 2
+        parts = []
+        for i in range(n):
+            parts.append(f"WHEN {self.children[2*i].sql_string()} "
+                         f"THEN {self.children[2*i+1].sql_string()}")
+        if self.has_else:
+            parts.append(f"ELSE {self.children[-1].sql_string()}")
+        return "CASE " + " ".join(parts) + " END"
+
+    def _value_children_idx(self):
+        n = (len(self.children) - (1 if self.has_else else 0)) // 2
+        idx = [2 * i + 1 for i in range(n)]
+        if self.has_else:
+            idx.append(len(self.children) - 1)
+        return idx
+
+    def _resolve_type(self):
+        from spark_rapids_tpu.expr.cast import Cast
+
+        vidx = self._value_children_idx()
+        common = _common_type([self.children[i].dataType for i in vidx])
+        for i in vidx:
+            if self.children[i].dataType != common:
+                self.children[i] = Cast(self.children[i], common).resolve(None)
+        self._dataType = common
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        n = (len(self.children) - (1 if self.has_else else 0)) // 2
+        if self.has_else:
+            acc = cols[-1]
+        else:
+            from spark_rapids_tpu.expr.base import Literal
+
+            acc = Literal(None, self.dataType).eval_tpu(ctx)
+            if acc.is_string is not cols[1].is_string:
+                acc = cols[1]
+                acc = DeviceColumn(self.dataType,
+                                   jnp.zeros_like(acc.validity),
+                                   data=acc.data, chars=acc.chars,
+                                   lengths=acc.lengths)
+        # fold from the last branch backwards so earlier WHENs win
+        for i in reversed(range(n)):
+            cond, val = cols[2 * i], cols[2 * i + 1]
+            acc = select_column(cond.data, cond.validity, val, acc,
+                                self.dataType)
+        return acc
+
+
+class Coalesce(Expression):
+    def __init__(self, children: List[Expression]):
+        super().__init__(children)
+
+    def _resolve_type(self):
+        from spark_rapids_tpu.expr.cast import Cast
+
+        common = _common_type([c.dataType for c in self.children])
+        self.children = [c if c.dataType == common else Cast(c, common).resolve(None)
+                         for c in self.children]
+        self._dataType = common
+        self._nullable = all(c.nullable for c in self.children)
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        acc = cols[-1]
+        for c in reversed(cols[:-1]):
+            acc = select_column(c.validity, jnp.ones_like(c.validity), c, acc,
+                                self.dataType)
+        return acc
+
+
+class Nvl(Coalesce):
+    def __init__(self, a: Expression, b: Expression):
+        super().__init__([a, b])
+
+
+class NaNvl(Expression):
+    """nanvl(a, b): a unless a is NaN."""
+
+    def __init__(self, a: Expression, b: Expression):
+        super().__init__([a, b])
+
+    def _resolve_type(self):
+        self._dataType = self.children[0].dataType
+        self._nullable = any(c.nullable for c in self.children)
+
+    def do_columnar_eval(self, ctx, cols):
+        a, b = cols
+        is_nan = jnp.isnan(a.data) & a.validity
+        return select_column(~is_nan, jnp.ones_like(is_nan), a, b, self.dataType)
+
+
+class Greatest(Expression):
+    def __init__(self, children):
+        super().__init__(children)
+
+    def _resolve_type(self):
+        from spark_rapids_tpu.expr.cast import Cast
+
+        common = _common_type([c.dataType for c in self.children])
+        self.children = [c if c.dataType == common else Cast(c, common).resolve(None)
+                         for c in self.children]
+        self._dataType = common
+        self._nullable = all(c.nullable for c in self.children)
+
+    def _pick(self, a, b):
+        return jnp.maximum(a, b)
+
+    def do_columnar_eval(self, ctx, cols):
+        # Spark: skips nulls, null only if ALL null; NaN is greatest
+        acc = cols[0]
+        data, validity = acc.data, acc.validity
+        for c in cols[1:]:
+            both = validity & c.validity
+            picked = self._pick(data, c.data)
+            data = jnp.where(both, picked,
+                             jnp.where(c.validity, c.data, data))
+            validity = validity | c.validity
+        return DeviceColumn(self.dataType, validity, data=data)
+
+
+class Least(Greatest):
+    def _pick(self, a, b):
+        return jnp.minimum(a, b)

@@ -1,0 +1,167 @@
+"""Date/time expressions.
+
+Reference analog: org/apache/spark/sql/rapids/datetimeExpressions.scala
+(GpuYear/GpuMonth/GpuDayOfMonth/GpuHour..., GpuDateAdd/GpuDateSub,
+GpuDateDiff, GpuToUnixTimestamp) with jni timezones.cu for tz conversion.
+Timestamps are UTC micros; session-timezone tables come in a later round
+(reference gates non-UTC behind GpuTimeZoneDB the same way).
+
+All field extraction rides the branch-free civil-calendar math in cast.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expr.base import BinaryExpression, UnaryExpression
+from spark_rapids_tpu.expr.cast import civil_from_days, days_from_civil
+
+_US_PER_DAY = 86_400_000_000
+
+
+def _days_of(c: DeviceColumn, dtype: T.DataType):
+    if isinstance(dtype, T.TimestampType):
+        return jnp.floor_divide(c.data, _US_PER_DAY)
+    return c.data.astype(jnp.int64)
+
+
+class _DateField(UnaryExpression):
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        days = _days_of(c, self.child.dataType)
+        y, m, d = civil_from_days(days)
+        return DeviceColumn(T.INT, c.validity,
+                            data=self._field(y, m, d, days).astype(jnp.int32))
+
+    def _field(self, y, m, d, days):
+        raise NotImplementedError
+
+
+class Year(_DateField):
+    def _field(self, y, m, d, days):
+        return y
+
+
+class Month(_DateField):
+    def _field(self, y, m, d, days):
+        return m
+
+
+class DayOfMonth(_DateField):
+    def _field(self, y, m, d, days):
+        return d
+
+
+class DayOfWeek(_DateField):
+    """Spark: Sunday=1 ... Saturday=7; epoch day 0 was a Thursday."""
+
+    def _field(self, y, m, d, days):
+        return ((days + 4) % 7) + 1
+
+
+class DayOfYear(_DateField):
+    def _field(self, y, m, d, days):
+        jan1 = days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+        return (days - jan1 + 1).astype(jnp.int64)
+
+
+class Quarter(_DateField):
+    def _field(self, y, m, d, days):
+        return (m - 1) // 3 + 1
+
+
+class LastDay(UnaryExpression):
+    def _resolve_type(self):
+        self._dataType = T.DATE
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        days = _days_of(c, self.child.dataType)
+        y, m, _ = civil_from_days(days)
+        ny = jnp.where(m == 12, y + 1, y)
+        nm = jnp.where(m == 12, 1, m + 1)
+        first_next = days_from_civil(ny, nm, jnp.ones_like(nm))
+        return DeviceColumn(T.DATE, c.validity,
+                            data=(first_next - 1).astype(jnp.int32))
+
+
+class _TimeField(UnaryExpression):
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        rem = c.data - jnp.floor_divide(c.data, _US_PER_DAY) * _US_PER_DAY
+        return DeviceColumn(T.INT, c.validity,
+                            data=self._field(rem).astype(jnp.int32))
+
+
+class Hour(_TimeField):
+    def _field(self, rem):
+        return rem // 3_600_000_000
+
+
+class Minute(_TimeField):
+    def _field(self, rem):
+        return (rem // 60_000_000) % 60
+
+
+class Second(_TimeField):
+    def _field(self, rem):
+        return (rem // 1_000_000) % 60
+
+
+class DateAdd(BinaryExpression):
+    def _resolve_type(self):
+        self._dataType = T.DATE
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        d, n = cols
+        return DeviceColumn(T.DATE, d.validity & n.validity,
+                            data=(d.data + n.data.astype(jnp.int32)))
+
+
+class DateSub(BinaryExpression):
+    def _resolve_type(self):
+        self._dataType = T.DATE
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        d, n = cols
+        return DeviceColumn(T.DATE, d.validity & n.validity,
+                            data=(d.data - n.data.astype(jnp.int32)))
+
+
+class DateDiff(BinaryExpression):
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        a, b = cols
+        return DeviceColumn(T.INT, a.validity & b.validity,
+                            data=(a.data - b.data).astype(jnp.int32))
+
+
+class UnixTimestamp(UnaryExpression):
+    """to_unix_timestamp(ts) -> seconds."""
+
+    def _resolve_type(self):
+        self._dataType = T.LONG
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        if isinstance(self.child.dataType, T.DateType):
+            secs = c.data.astype(jnp.int64) * 86_400
+        else:
+            secs = jnp.floor_divide(c.data, 1_000_000)
+        return DeviceColumn(T.LONG, c.validity, data=secs)

@@ -1,0 +1,187 @@
+"""Math expressions (GpuSqrt, GpuFloor, GpuCeil, GpuRound, GpuExp, GpuLog...).
+
+Reference analog: org/apache/spark/sql/rapids/mathExpressions.scala.
+Spark specifics reproduced: log of non-positive -> null; round is HALF_UP
+(not banker's); floor/ceil on integral return the input; pow/exp/trig follow
+java.lang.Math (IEEE, matches XLA f64).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expr.base import (
+    BinaryExpression,
+    Expression,
+    UnaryExpression,
+)
+from spark_rapids_tpu.expr.cast import Cast
+
+
+class _UnaryMathToDouble(UnaryExpression):
+    def _resolve_type(self):
+        if self.child.dataType != T.DOUBLE:
+            self.children = [Cast(self.child, T.DOUBLE).resolve(None)]
+        self._dataType = T.DOUBLE
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        data, extra_null = self._fn(c.data)
+        validity = c.validity if extra_null is None else c.validity & ~extra_null
+        return DeviceColumn(T.DOUBLE, validity, data=data)
+
+    def _fn(self, x):
+        raise NotImplementedError
+
+
+class Sqrt(_UnaryMathToDouble):
+    def _fn(self, x):
+        return jnp.sqrt(jnp.where(x < 0, jnp.nan, x)), None
+
+
+class Exp(_UnaryMathToDouble):
+    def _fn(self, x):
+        return jnp.exp(x), None
+
+
+class Log(_UnaryMathToDouble):
+    """Spark ln(x): null for x <= 0."""
+
+    def _fn(self, x):
+        bad = x <= 0
+        return jnp.log(jnp.where(bad, 1.0, x)), bad
+
+
+class Log10(_UnaryMathToDouble):
+    def _fn(self, x):
+        bad = x <= 0
+        return jnp.log10(jnp.where(bad, 1.0, x)), bad
+
+
+class Sin(_UnaryMathToDouble):
+    def _fn(self, x):
+        return jnp.sin(x), None
+
+
+class Cos(_UnaryMathToDouble):
+    def _fn(self, x):
+        return jnp.cos(x), None
+
+
+class Tan(_UnaryMathToDouble):
+    def _fn(self, x):
+        return jnp.tan(x), None
+
+
+class Asin(_UnaryMathToDouble):
+    def _fn(self, x):
+        return jnp.arcsin(x), None
+
+
+class Acos(_UnaryMathToDouble):
+    def _fn(self, x):
+        return jnp.arccos(x), None
+
+
+class Atan(_UnaryMathToDouble):
+    def _fn(self, x):
+        return jnp.arctan(x), None
+
+
+class Signum(_UnaryMathToDouble):
+    def _fn(self, x):
+        return jnp.sign(x), None
+
+
+class Pow(BinaryExpression):
+    def _resolve_type(self):
+        for i in (0, 1):
+            if self.children[i].dataType != T.DOUBLE:
+                self.children[i] = Cast(self.children[i], T.DOUBLE).resolve(None)
+        self._dataType = T.DOUBLE
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        l, r = cols
+        return DeviceColumn(T.DOUBLE, l.validity & r.validity,
+                            data=jnp.power(l.data, r.data))
+
+
+class Floor(UnaryExpression):
+    """floor returns LONG for double input, input type for integral/decimal."""
+
+    def _resolve_type(self):
+        ct = self.child.dataType
+        if ct.is_integral:
+            self._dataType = ct
+        elif isinstance(ct, T.DecimalType):
+            self._dataType = T.DecimalType(
+                min(ct.precision - ct.scale + 1, 38), 0)
+        else:
+            if ct != T.DOUBLE:
+                self.children = [Cast(self.child, T.DOUBLE).resolve(None)]
+            self._dataType = T.LONG
+        self._nullable = self.child.nullable
+
+    def _round(self, x):
+        return jnp.floor(x)
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        ct = self.child.dataType
+        if ct.is_integral:
+            return c
+        if isinstance(ct, T.DecimalType):
+            den = 10 ** min(ct.scale, 18)
+            q = c.data // den  # jnp floordiv floors: == floor
+            if isinstance(self, Ceil):
+                rem = c.data - q * den
+                q = q + (rem != 0)
+            return DeviceColumn(self.dataType, c.validity, data=q)
+        return DeviceColumn(T.LONG, c.validity,
+                            data=self._round(c.data).astype(jnp.int64))
+
+
+class Ceil(Floor):
+    def _round(self, x):
+        return jnp.ceil(x)
+
+
+class Round(Expression):
+    """round(x, scale) HALF_UP (Spark/BigDecimal), not numpy banker's."""
+
+    def __init__(self, child: Expression, scale: Expression):
+        super().__init__([child, scale])
+
+    def _resolve_type(self):
+        ct = self.children[0].dataType
+        if isinstance(ct, T.DecimalType):
+            from spark_rapids_tpu.expr.base import Literal
+
+            s = self.children[1]
+            assert isinstance(s, Literal), "round scale must be literal"
+            new_scale = min(max(int(s.value), 0), ct.scale)
+            self._dataType = T.DecimalType(
+                min(ct.precision - ct.scale + new_scale + 1, 38), new_scale)
+        else:
+            self._dataType = ct if ct.is_numeric else T.DOUBLE
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        c, s = cols
+        ct = self.children[0].dataType
+        dt = self.dataType
+        if isinstance(ct, T.DecimalType):
+            from spark_rapids_tpu.expr.cast import _dec_rescale
+
+            data, validity = _dec_rescale(ctx, c.data, c.validity, ct.scale,
+                                          dt, ctx.ansi, "round")
+            return DeviceColumn(dt, validity, data=data)
+        if ct.is_integral:
+            return c  # round(int, >=0) is identity; negative scales: later
+        scale_f = 10.0 ** s.data.astype(jnp.float64)
+        x = c.data * scale_f
+        r = jnp.where(x >= 0, jnp.floor(x + 0.5), jnp.ceil(x - 0.5))
+        return DeviceColumn(dt, c.validity & s.validity, data=r / scale_f)

@@ -1,0 +1,316 @@
+"""Comparison and boolean predicates with Spark null semantics.
+
+Reference analog: com/nvidia/spark/rapids/predicates (GpuEqualTo, GpuLessThan,
+GpuAnd/GpuOr with three-valued logic, GpuNot, GpuIsNull/GpuIsNotNull/GpuIsNan,
+GpuInSet, GpuEqualNullSafe).
+
+String ordering: Spark compares strings by UTF-8 byte order.  With the padded
+char-matrix layout (padding byte 0x00 sorts before every real byte) plain
+row-wise byte comparison yields the right order; equality additionally checks
+lengths.  Known limitation (documented): strings containing embedded NUL bytes
+may order differently than Spark — matched by a tag-time warning.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expr.base import (
+    BinaryExpression,
+    EvalContext,
+    Expression,
+    UnaryExpression,
+)
+
+
+def _pad_to(chars, width):
+    w = chars.shape[1]
+    if w >= width:
+        return chars[:, :width]
+    return jnp.pad(chars, ((0, 0), (0, width - w)))
+
+
+def string_compare(l: DeviceColumn, r: DeviceColumn):
+    """Returns (lt, eq) bool vectors for two string columns."""
+    w = max(l.width, r.width)
+    a = _pad_to(l.chars, w)
+    b = _pad_to(r.chars, w)
+    diff = a != b
+    any_diff = jnp.any(diff, axis=1)
+    # first differing byte position; argmax over bool gives first True
+    first = jnp.argmax(diff, axis=1)
+    rows = jnp.arange(a.shape[0])
+    av = a[rows, first]
+    bv = b[rows, first]
+    lt = any_diff & (av < bv)
+    eq = ~any_diff & (l.lengths == r.lengths)
+    # embedded-NUL caveat: padded bytes equal but lengths differ -> shorter lt
+    lt = lt | (~any_diff & (l.lengths < r.lengths))
+    return lt, eq
+
+
+def _coerce_comparison(left: Expression, right: Expression):
+    """Insert casts so both sides share a comparable type; returns (l, r)."""
+    from spark_rapids_tpu.expr.cast import Cast
+
+    lt, rt = left.dataType, right.dataType
+    if lt == rt:
+        return left, right
+    if isinstance(lt, T.DecimalType) and isinstance(rt, T.DecimalType):
+        s = max(lt.scale, rt.scale)
+        p = max(lt.precision - lt.scale, rt.precision - rt.scale) + s
+        common = T.DecimalType(min(p, 38), s)
+        return (Cast(left, common).resolve(None),
+                Cast(right, common).resolve(None))
+    if isinstance(lt, T.DecimalType) or isinstance(rt, T.DecimalType):
+        # promote the non-decimal side to decimal
+        from spark_rapids_tpu.expr.arithmetic import _int_as_decimal
+
+        ld = lt if isinstance(lt, T.DecimalType) else _int_as_decimal(lt)
+        rd = rt if isinstance(rt, T.DecimalType) else _int_as_decimal(rt)
+        l2 = left if lt == ld else Cast(left, ld).resolve(None)
+        r2 = right if rt == rd else Cast(right, rd).resolve(None)
+        return _coerce_comparison(l2, r2)
+    if lt.is_numeric and rt.is_numeric:
+        common = T.numeric_promote(lt, rt)
+        l2 = left if lt == common else Cast(left, common).resolve(None)
+        r2 = right if rt == common else Cast(right, common).resolve(None)
+        return l2, r2
+    if isinstance(lt, T.StringType) and isinstance(rt, T.DateType):
+        return left, Cast(right, T.STRING).resolve(None)
+    if isinstance(lt, T.DateType) and isinstance(rt, T.StringType):
+        return Cast(left, T.STRING).resolve(None), right
+    if isinstance(lt, T.NullType):
+        return Cast(left, rt).resolve(None), right
+    if isinstance(rt, T.NullType):
+        return left, Cast(right, lt).resolve(None)
+    raise TypeError(f"cannot compare {lt} with {rt}")
+
+
+class BinaryComparison(BinaryExpression):
+    symbol = "?"
+
+    def sql_string(self):
+        return f"({self.left.sql_string()} {self.symbol} {self.right.sql_string()})"
+
+    def _resolve_type(self):
+        self.children = list(_coerce_comparison(self.left, self.right))
+        self._dataType = T.BOOLEAN
+        self._nullable = self.left.nullable or self.right.nullable
+
+    def do_columnar_eval(self, ctx: EvalContext, cols: List[DeviceColumn]):
+        l, r = cols
+        validity = l.validity & r.validity
+        if l.is_string:
+            lt, eq = string_compare(l, r)
+            data = self._from_lt_eq(lt, eq)
+        else:
+            data = self._cmp(l.data, r.data)
+        return DeviceColumn(T.BOOLEAN, validity, data=data)
+
+    def _cmp(self, a, b):
+        raise NotImplementedError
+
+    def _from_lt_eq(self, lt, eq):
+        raise NotImplementedError
+
+
+class EqualTo(BinaryComparison):
+    symbol = "="
+
+    def _cmp(self, a, b):
+        return a == b
+
+    def _from_lt_eq(self, lt, eq):
+        return eq
+
+
+class LessThan(BinaryComparison):
+    symbol = "<"
+
+    def _cmp(self, a, b):
+        return a < b
+
+    def _from_lt_eq(self, lt, eq):
+        return lt
+
+
+class LessThanOrEqual(BinaryComparison):
+    symbol = "<="
+
+    def _cmp(self, a, b):
+        return a <= b
+
+    def _from_lt_eq(self, lt, eq):
+        return lt | eq
+
+
+class GreaterThan(BinaryComparison):
+    symbol = ">"
+
+    def _cmp(self, a, b):
+        return a > b
+
+    def _from_lt_eq(self, lt, eq):
+        return ~(lt | eq)
+
+
+class GreaterThanOrEqual(BinaryComparison):
+    symbol = ">="
+
+    def _cmp(self, a, b):
+        return a >= b
+
+    def _from_lt_eq(self, lt, eq):
+        return ~lt
+
+
+class EqualNullSafe(BinaryComparison):
+    """<=> : null <=> null is true; never returns null."""
+
+    symbol = "<=>"
+
+    def _resolve_type(self):
+        super()._resolve_type()
+        self._nullable = False
+
+    def do_columnar_eval(self, ctx, cols):
+        l, r = cols
+        both_valid = l.validity & r.validity
+        both_null = ~l.validity & ~r.validity
+        if l.is_string:
+            _, eq = string_compare(l, r)
+        else:
+            eq = l.data == r.data
+        data = (both_valid & eq) | both_null
+        return DeviceColumn(T.BOOLEAN, jnp.ones_like(data), data=data)
+
+
+class And(BinaryExpression):
+    """Three-valued AND: false AND null = false."""
+
+    def sql_string(self):
+        return f"({self.left.sql_string()} AND {self.right.sql_string()})"
+
+    def _resolve_type(self):
+        self._dataType = T.BOOLEAN
+        self._nullable = self.left.nullable or self.right.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        l, r = cols
+        lv, rv = l.validity, r.validity
+        ld = l.data & lv  # treat null as "unknown", compute definite values
+        rd = r.data & rv
+        definite_false = (lv & ~l.data) | (rv & ~r.data)
+        data = ld & rd
+        validity = (lv & rv) | definite_false
+        return DeviceColumn(T.BOOLEAN, validity, data=data & ~definite_false)
+
+
+class Or(BinaryExpression):
+    """Three-valued OR: true OR null = true."""
+
+    def sql_string(self):
+        return f"({self.left.sql_string()} OR {self.right.sql_string()})"
+
+    def _resolve_type(self):
+        self._dataType = T.BOOLEAN
+        self._nullable = self.left.nullable or self.right.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        l, r = cols
+        lv, rv = l.validity, r.validity
+        definite_true = (lv & l.data) | (rv & r.data)
+        validity = (lv & rv) | definite_true
+        data = definite_true | ((l.data & lv) | (r.data & rv))
+        return DeviceColumn(T.BOOLEAN, validity, data=data)
+
+
+class Not(UnaryExpression):
+    def sql_string(self):
+        return f"(NOT {self.child.sql_string()})"
+
+    def _resolve_type(self):
+        self._dataType = T.BOOLEAN
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        return DeviceColumn(T.BOOLEAN, c.validity, data=~c.data)
+
+
+class IsNull(UnaryExpression):
+    def sql_string(self):
+        return f"({self.child.sql_string()} IS NULL)"
+
+    def _resolve_type(self):
+        self._dataType = T.BOOLEAN
+        self._nullable = False
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        return DeviceColumn(T.BOOLEAN, jnp.ones_like(c.validity),
+                            data=~c.validity)
+
+
+class IsNotNull(UnaryExpression):
+    def sql_string(self):
+        return f"({self.child.sql_string()} IS NOT NULL)"
+
+    def _resolve_type(self):
+        self._dataType = T.BOOLEAN
+        self._nullable = False
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        return DeviceColumn(T.BOOLEAN, jnp.ones_like(c.validity),
+                            data=c.validity)
+
+
+class IsNaN(UnaryExpression):
+    def _resolve_type(self):
+        self._dataType = T.BOOLEAN
+        self._nullable = False
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        data = jnp.isnan(c.data) & c.validity
+        return DeviceColumn(T.BOOLEAN, jnp.ones_like(c.validity), data=data)
+
+
+class In(Expression):
+    """value IN (list-of-literals); Spark null semantics: null if value is
+    null, or if no match and the list contains a null."""
+
+    def __init__(self, value: Expression, candidates: List[Expression]):
+        super().__init__([value] + list(candidates))
+
+    def sql_string(self):
+        cands = ", ".join(c.sql_string() for c in self.children[1:])
+        return f"({self.children[0].sql_string()} IN ({cands}))"
+
+    def _resolve_type(self):
+        self._dataType = T.BOOLEAN
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        v = cols[0]
+        cands = cols[1:]
+        any_match = jnp.zeros(v.capacity, jnp.bool_)
+        any_null_cand = False
+        for c in cands:
+            if not bool(jnp.any(c.validity)):
+                any_null_cand = True
+                continue
+            if v.is_string:
+                _, eq = string_compare(v, c)
+            else:
+                eq = v.data == c.data
+            any_match = any_match | (eq & c.validity)
+        validity = v.validity
+        if any_null_cand:
+            validity = validity & any_match  # no match + null cand -> null
+        return DeviceColumn(T.BOOLEAN, validity, data=any_match)

@@ -1,0 +1,262 @@
+"""String expressions over the padded char-matrix layout.
+
+Reference analog: org/apache/spark/sql/rapids/stringFunctions.scala
+(GpuSubstring, GpuConcat, GpuUpper/GpuLower, GpuStringTrim, GpuContains,
+GpuStartsWith/GpuEndsWith, GpuLength, GpuStringRepeat...).  cuDF implements
+these over (chars, offsets); here every op is a dense (rows x width) vector
+transform — gathers along the width axis with index arithmetic, which XLA
+maps onto the VPU.
+
+Unicode note: Upper/Lower are ASCII-only for now (the reference similarly
+documents incompatibilities and hides some behind conf); Length counts UTF-8
+*code points* like Spark, computed from the byte patterns.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expr.base import (
+    BinaryExpression,
+    EvalContext,
+    Expression,
+    UnaryExpression,
+)
+from spark_rapids_tpu.expr.predicates import _pad_to
+
+
+class Length(UnaryExpression):
+    """UTF-8 code-point count (Spark length), not byte count."""
+
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        pos = jnp.arange(c.width)[None, :]
+        in_str = pos < c.lengths[:, None]
+        # count bytes that are NOT utf-8 continuation bytes (0b10xxxxxx)
+        is_cont = (c.chars & 0xC0) == 0x80
+        n = jnp.sum(in_str & ~is_cont, axis=1)
+        return DeviceColumn(T.INT, c.validity, data=n.astype(jnp.int32))
+
+
+class Upper(UnaryExpression):
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = self.child.nullable
+
+    def _tx(self, ch):
+        return jnp.where((ch >= ord("a")) & (ch <= ord("z")), ch - 32, ch)
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        return DeviceColumn(T.STRING, c.validity,
+                            chars=self._tx(c.chars).astype(jnp.uint8),
+                            lengths=c.lengths)
+
+
+class Lower(Upper):
+    def _tx(self, ch):
+        return jnp.where((ch >= ord("A")) & (ch <= ord("Z")), ch + 32, ch)
+
+
+class Substring(Expression):
+    """substring(str, pos, len) with Spark 1-based / negative pos semantics.
+
+    Byte-based gather; Spark substring is character-based — for ASCII they
+    agree.  Non-ASCII correctness comes with the codepoint-index map
+    (later round; tagged incompat until then, like the reference's CSV/regex
+    caveats)."""
+
+    def __init__(self, s: Expression, pos: Expression, length: Expression):
+        super().__init__([s, pos, length])
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        c, p, ln = cols
+        n = c.lengths
+        pos = p.data.astype(jnp.int32)
+        # Spark: pos>0 -> 1-based; pos<0 -> from end; pos==0 -> treated as 1
+        start = jnp.where(pos > 0, pos - 1,
+                          jnp.where(pos < 0, jnp.maximum(n + pos, 0), 0))
+        start = jnp.minimum(start, n)
+        want = jnp.maximum(ln.data.astype(jnp.int32), 0)
+        out_len = jnp.minimum(want, n - start)
+        width = c.width
+        idx = start[:, None] + jnp.arange(width)[None, :]
+        take = jnp.arange(width)[None, :] < out_len[:, None]
+        gathered = jnp.take_along_axis(c.chars, jnp.clip(idx, 0, width - 1),
+                                       axis=1)
+        chars = jnp.where(take, gathered, 0).astype(jnp.uint8)
+        validity = c.validity & p.validity & ln.validity
+        return DeviceColumn(T.STRING, validity, chars=chars,
+                            lengths=out_len.astype(jnp.int32))
+
+
+class Concat(Expression):
+    """concat(s1, s2, ...): null if any input null (Spark)."""
+
+    def __init__(self, children: List[Expression]):
+        super().__init__(children)
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = any(c.nullable for c in self.children)
+
+    def do_columnar_eval(self, ctx, cols):
+        total_w = sum(c.width for c in cols)
+        n = cols[0].capacity
+        out = jnp.zeros((n, total_w), jnp.uint8)
+        out_len = jnp.zeros(n, jnp.int32)
+        validity = cols[0].validity
+        for c in cols[1:]:
+            validity = validity & c.validity
+        for c in cols:
+            # scatter c's chars at position out_len per row
+            idx = out_len[:, None] + jnp.arange(c.width)[None, :]
+            take = jnp.arange(c.width)[None, :] < c.lengths[:, None]
+            # build one-hot-ish scatter via take_along_axis on the source side:
+            # for each output col j, find source col j - out_len
+            src_idx = jnp.arange(total_w)[None, :] - out_len[:, None]
+            in_range = (src_idx >= 0) & (src_idx < c.width)
+            src = jnp.take_along_axis(
+                _pad_to(c.chars, total_w),
+                jnp.clip(src_idx, 0, total_w - 1), axis=1)
+            write = in_range & (src_idx < c.lengths[:, None])
+            out = jnp.where(write, src, out)
+            out_len = out_len + c.lengths
+            del idx, take
+        return DeviceColumn(T.STRING, validity, chars=out, lengths=out_len)
+
+
+class _FixedCompare(BinaryExpression):
+    """contains/startswith/endswith with arbitrary (usually literal) needle."""
+
+    def _resolve_type(self):
+        self._dataType = T.BOOLEAN
+        self._nullable = True
+
+
+class StartsWith(_FixedCompare):
+    def do_columnar_eval(self, ctx, cols):
+        s, pre = cols
+        w = max(s.width, pre.width)
+        a = _pad_to(s.chars, w)
+        b = _pad_to(pre.chars, w)
+        pos = jnp.arange(w)[None, :]
+        relevant = pos < pre.lengths[:, None]
+        eq = jnp.all(~relevant | (a == b), axis=1)
+        data = eq & (s.lengths >= pre.lengths)
+        return DeviceColumn(T.BOOLEAN, s.validity & pre.validity, data=data)
+
+
+class EndsWith(_FixedCompare):
+    def do_columnar_eval(self, ctx, cols):
+        s, suf = cols
+        w = s.width
+        start = s.lengths - suf.lengths
+        idx = start[:, None] + jnp.arange(max(suf.width, 1))[None, :]
+        gathered = jnp.take_along_axis(
+            s.chars, jnp.clip(idx, 0, max(w - 1, 0)), axis=1)
+        pos = jnp.arange(max(suf.width, 1))[None, :]
+        relevant = pos < suf.lengths[:, None]
+        b = suf.chars if suf.width else jnp.zeros_like(gathered)
+        eq = jnp.all(~relevant | (gathered == _pad_to(b, gathered.shape[1])),
+                     axis=1)
+        data = eq & (s.lengths >= suf.lengths)
+        return DeviceColumn(T.BOOLEAN, s.validity & suf.validity, data=data)
+
+
+class Contains(_FixedCompare):
+    def do_columnar_eval(self, ctx, cols):
+        s, needle = cols
+        w = s.width
+        nw = max(needle.width, 1)
+        # compare needle at every start offset: O(w * nw) vector ops
+        matches = jnp.zeros((s.capacity,), jnp.bool_)
+        npos = jnp.arange(nw)[None, :]
+        relevant = npos < needle.lengths[:, None]
+        nchars = needle.chars if needle.width else jnp.zeros((s.capacity, nw), jnp.uint8)
+        for start in range(w):
+            idx = start + jnp.arange(nw)[None, :]
+            seg = jnp.take_along_axis(s.chars, jnp.clip(idx, 0, w - 1), axis=1)
+            eq = jnp.all(~relevant | (seg == nchars), axis=1)
+            fits = start + needle.lengths <= s.lengths
+            matches = matches | (eq & fits)
+        return DeviceColumn(T.BOOLEAN, s.validity & needle.validity,
+                            data=matches)
+
+
+class StringTrim(UnaryExpression):
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        pos = jnp.arange(c.width)[None, :]
+        in_str = pos < c.lengths[:, None]
+        is_ws = (c.chars == ord(" ")) & in_str
+        nonws = in_str & ~is_ws
+        any_nonws = jnp.any(nonws, axis=1)
+        first = jnp.where(any_nonws, jnp.argmax(nonws, axis=1), 0)
+        last = jnp.where(any_nonws,
+                         c.width - 1 - jnp.argmax(nonws[:, ::-1], axis=1), -1)
+        out_len = (last - first + 1).astype(jnp.int32)
+        idx = first[:, None] + jnp.arange(c.width)[None, :]
+        take = jnp.arange(c.width)[None, :] < out_len[:, None]
+        gathered = jnp.take_along_axis(c.chars, jnp.clip(idx, 0, c.width - 1),
+                                       axis=1)
+        chars = jnp.where(take, gathered, 0).astype(jnp.uint8)
+        return DeviceColumn(T.STRING, c.validity, chars=chars, lengths=out_len)
+
+
+class Like(BinaryExpression):
+    """SQL LIKE with literal pattern, compiled at plan time to device ops.
+
+    Reference analog: GpuLike; complex patterns fall back at tag time (the
+    regex-transpiler-reject path, SURVEY.md §2.5).  Supported here:
+    'abc%', '%abc', '%abc%', exact, and patterns without wildcards; others
+    are rejected by the overrides layer (like_pattern_supported)."""
+
+    def _resolve_type(self):
+        self._dataType = T.BOOLEAN
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.expr.base import Literal
+
+        s, _ = cols
+        pat = self.right
+        assert isinstance(pat, Literal), "LIKE pattern must be literal"
+        p: str = pat.value
+        core = p.strip("%")
+        lit_expr = Literal(core, T.STRING)
+        needle = lit_expr.eval_tpu(ctx)
+        if p.startswith("%") and p.endswith("%") and "%" not in core:
+            return Contains(self.left, pat).do_columnar_eval(ctx, [s, needle])
+        if p.endswith("%") and "%" not in p[:-1]:
+            return StartsWith(self.left, pat).do_columnar_eval(ctx, [s, needle])
+        if p.startswith("%") and "%" not in p[1:]:
+            return EndsWith(self.left, pat).do_columnar_eval(ctx, [s, needle])
+        if "%" not in p and "_" not in p:
+            from spark_rapids_tpu.expr.predicates import string_compare
+
+            _, eq = string_compare(s, needle)
+            return DeviceColumn(T.BOOLEAN, s.validity, data=eq)
+        raise TypeError(f"LIKE pattern {p!r} not supported on TPU")
+
+
+def like_pattern_supported(p: str) -> bool:
+    if "_" in p or "\\" in p:
+        return False
+    core = p.strip("%")
+    return "%" not in core
