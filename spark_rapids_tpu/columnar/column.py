@@ -294,8 +294,14 @@ class HostColumn:
             return HostColumn(dtype, validity, chars=chars, lengths=lengths)
         sdt = T.storage_dtype(dtype)
         if isinstance(dtype, T.DecimalType):
-            import pyarrow.compute as pc
-            np_arr = np.asarray(pc.cast(arr, pa.int64()).fill_null(0), dtype=np.int64)
+            # decimal128 storage is 16-byte little-endian; for precision<=18
+            # the signed low word IS the unscaled value
+            arr2 = arr.cast(pa.decimal128(38, dtype.scale)) \
+                if arr.type.scale != dtype.scale else arr
+            buf = arr2.buffers()[1]
+            raw = np.frombuffer(buf, dtype=np.int64)
+            lo = raw[0::2][arr2.offset: arr2.offset + n]
+            np_arr = np.where(validity, lo, 0)
         else:
             np_arr = np.asarray(arr.fill_null(0)).astype(sdt, copy=False)
         return HostColumn(dtype, validity, data=np_arr)
@@ -307,8 +313,13 @@ class HostColumn:
         if self.is_string:
             return pa.array(self.to_pylist(), type=pa.string())
         if isinstance(self.dtype, T.DecimalType):
-            return pa.array(np.ma.masked_array(self.data, mask)).cast(
-                pa.decimal128(self.dtype.precision, self.dtype.scale))
+            from decimal import Decimal
+
+            vals = [Decimal(int(self.data[i])).scaleb(-self.dtype.scale)
+                    if self.validity[i] else None
+                    for i in range(self.num_rows)]
+            return pa.array(vals, type=pa.decimal128(
+                self.dtype.precision, self.dtype.scale))
         if isinstance(self.dtype, T.DateType):
             return pa.array(np.ma.masked_array(self.data, mask)).cast(pa.date32())
         if isinstance(self.dtype, T.TimestampType):

@@ -291,6 +291,11 @@ TPU_STRING_WIDTH_BUCKETS = conf("spark.rapids.tpu.string.widthBuckets").doc(
 TPU_DONATE_BUFFERS = conf("spark.rapids.tpu.donateInputBuffers").doc(
     "Donate input HBM buffers to XLA where legal.").boolean_conf(True)
 
+TPU_SCAN_CACHE = conf("spark.rapids.tpu.scan.cacheDeviceBatches").doc(
+    "Keep scanned batches resident in HBM across queries over the same "
+    "table (the df.cache / ParquetCachedBatchSerializer analog).  Off by "
+    "default; benchmarks of warm-data queries enable it.").boolean_conf(False)
+
 TPU_WHOLESTAGE_FUSION = conf("spark.rapids.tpu.wholeStageFusion.enabled").doc(
     "Fuse chains of narrow operators (project/filter) into one jitted XLA "
     "program per stage.").boolean_conf(True)

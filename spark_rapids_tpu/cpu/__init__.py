@@ -1,0 +1,1 @@
+from spark_rapids_tpu.cpu.oracle import CpuCol, execute_cpu_plan  # noqa: F401

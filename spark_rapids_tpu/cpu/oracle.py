@@ -1,0 +1,1492 @@
+"""The CPU oracle — an independent CPU implementation of plans + expressions.
+
+Role (SURVEY.md §4 "key insight"): the reference's correctness net runs every
+query twice — with the plugin on (GPU) and off (CPU Spark) — and asserts
+equal results.  Standalone, we have no CPU Spark, so this module *is* the
+"CPU Spark": a second, deliberately different implementation —
+
+  * decimals: arbitrary-precision Python ints (vs device int64 unscaled)
+  * strings: Python str objects (vs device padded byte matrices)
+  * dates/timestamps: Python datetime arithmetic in the handlers
+    (vs device civil-calendar bit math)
+  * group-by/join: dict-based hashing (vs device lax.sort + segments)
+
+so that agreement between the two paths is meaningful evidence.  It is also
+the *fallback executor*: plan nodes tagged willNotWorkOnTpu run here, exactly
+as untagged nodes stay on CPU Spark in the reference.
+"""
+from __future__ import annotations
+
+import dataclasses
+import datetime as pydt
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import HostColumn
+from spark_rapids_tpu.expr import base as E
+from spark_rapids_tpu.expr import arithmetic as A
+from spark_rapids_tpu.expr import cast as C
+from spark_rapids_tpu.expr import conditional as CO
+from spark_rapids_tpu.expr import datetime as DT
+from spark_rapids_tpu.expr import mathfuncs as M
+from spark_rapids_tpu.expr import predicates as P
+from spark_rapids_tpu.expr import strings as S
+from spark_rapids_tpu.plan import nodes as PN
+
+
+@dataclasses.dataclass
+class CpuCol:
+    """values: object ndarray for string/decimal; typed ndarray otherwise.
+    validity: bool ndarray."""
+
+    dtype: T.DataType
+    values: np.ndarray
+    validity: np.ndarray
+
+    @property
+    def n(self):
+        return len(self.validity)
+
+    @staticmethod
+    def from_host(h: HostColumn) -> "CpuCol":
+        if h.is_string:
+            vals = np.array(
+                [bytes(h.chars[i, : h.lengths[i]]).decode("utf-8", "replace")
+                 if h.validity[i] else None
+                 for i in range(h.num_rows)], dtype=object)
+            return CpuCol(h.dtype, vals, h.validity.copy())
+        if isinstance(h.dtype, T.DecimalType):
+            vals = np.array([int(v) for v in h.data], dtype=object)
+            return CpuCol(h.dtype, vals, h.validity.copy())
+        return CpuCol(h.dtype, h.data.copy(), h.validity.copy())
+
+    def to_host(self) -> HostColumn:
+        n = self.n
+        if isinstance(self.dtype, T.StringType):
+            strs = [self.values[i] if self.validity[i] else None
+                    for i in range(n)]
+            h = HostColumn.from_pylist(strs, T.STRING)
+            h.validity = self.validity.copy()
+            return h
+        if isinstance(self.dtype, T.DecimalType):
+            data = np.zeros(n, np.int64)
+            for i in range(n):
+                if self.validity[i]:
+                    v = int(self.values[i])
+                    # clamp into int64 (oracle may exceed; device would null)
+                    data[i] = max(min(v, 2 ** 63 - 1), -(2 ** 63))
+            return HostColumn(self.dtype, self.validity.copy(), data=data)
+        return HostColumn(self.dtype, self.validity.copy(),
+                          data=np.asarray(self.values))
+
+    def row(self, i):
+        return self.values[i] if self.validity[i] else None
+
+    def to_pylist(self):
+        """Lossless python values (decimals keep arbitrary precision —
+        HostColumn's int64 storage would clamp precision>18)."""
+        import datetime as _dt
+        from decimal import Decimal as _Dec
+
+        out = []
+        for i in range(self.n):
+            if not self.validity[i]:
+                out.append(None)
+            elif isinstance(self.dtype, T.DecimalType):
+                out.append(_Dec(int(self.values[i])).scaleb(-self.dtype.scale))
+            elif isinstance(self.dtype, T.DateType):
+                out.append(_dt.date(1970, 1, 1)
+                           + _dt.timedelta(days=int(self.values[i])))
+            elif isinstance(self.dtype, T.TimestampType):
+                out.append(_dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+                           + _dt.timedelta(microseconds=int(self.values[i])))
+            elif isinstance(self.dtype, T.BooleanType):
+                out.append(bool(self.values[i]))
+            elif isinstance(self.dtype, (T.FloatType, T.DoubleType)):
+                out.append(float(self.values[i]))
+            elif isinstance(self.dtype, T.StringType):
+                out.append(self.values[i])
+            else:
+                out.append(int(self.values[i]))
+        return out
+
+
+CpuBatch = List[CpuCol]  # plus schema carried by plan
+
+
+# ===========================================================================
+# Expression interpreter
+# ===========================================================================
+
+def eval_expr(e: E.Expression, cols: CpuBatch, n: int, ansi: bool = False) -> CpuCol:
+    h = _HANDLERS.get(type(e).__name__)
+    if h is None:
+        raise NotImplementedError(f"oracle: {type(e).__name__}")
+    return h(e, cols, n, ansi)
+
+
+def _kids(e, cols, n, ansi):
+    return [eval_expr(c, cols, n, ansi) for c in e.children]
+
+
+def _null_prop_validity(kids: List[CpuCol]) -> np.ndarray:
+    v = kids[0].validity.copy()
+    for k in kids[1:]:
+        v &= k.validity
+    return v
+
+
+def _h_bound(e: E.BoundReference, cols, n, ansi):
+    return cols[e.ordinal]
+
+
+def _h_literal(e: E.Literal, cols, n, ansi):
+    dt = e._dataType
+    if e.value is None:
+        if isinstance(dt, (T.StringType, T.DecimalType)):
+            return CpuCol(dt, np.array([None] * n, dtype=object),
+                          np.zeros(n, np.bool_))
+        sdt = T.storage_dtype(dt) if not isinstance(dt, T.NullType) else np.int32
+        return CpuCol(dt, np.zeros(n, sdt), np.zeros(n, np.bool_))
+    if isinstance(dt, T.StringType):
+        return CpuCol(dt, np.array([e.value] * n, dtype=object),
+                      np.ones(n, np.bool_))
+    if isinstance(dt, T.DecimalType):
+        return CpuCol(dt, np.array([e.storage_value()] * n, dtype=object),
+                      np.ones(n, np.bool_))
+    return CpuCol(dt, np.full(n, e.storage_value(), T.storage_dtype(dt)),
+                  np.ones(n, np.bool_))
+
+
+def _h_alias(e, cols, n, ansi):
+    return eval_expr(e.children[0], cols, n, ansi)
+
+
+# -- arithmetic -------------------------------------------------------------
+
+_JMIN = {T.ByteType: -(2**7), T.ShortType: -(2**15), T.IntegerType: -(2**31),
+         T.LongType: -(2**63)}
+_JRANGE = {T.ByteType: 2**8, T.ShortType: 2**16, T.IntegerType: 2**32,
+           T.LongType: 2**64}
+
+
+def _java_wrap(vals, dt) -> np.ndarray:
+    """Wrap arbitrary python ints into the Java type (independent of numpy
+    overflow behavior)."""
+    lo, rng = _JMIN[type(dt)], _JRANGE[type(dt)]
+    out = np.zeros(len(vals), T.storage_dtype(dt))
+    for i, v in enumerate(vals):
+        out[i] = ((int(v) - lo) % rng) + lo
+    return out
+
+
+def _dec_check(vals, validity, dt: T.DecimalType, ansi, op):
+    bound = 10 ** dt.precision
+    out_validity = validity.copy()
+    for i in range(len(vals)):
+        if validity[i] and not (-bound < int(vals[i]) < bound):
+            if ansi:
+                raise E.SparkArithmeticException(f"decimal {op} overflow (ANSI)")
+            out_validity[i] = False
+    return out_validity
+
+
+def _h_binarith(e: A.BinaryArithmetic, cols, n, ansi):
+    l, r = _kids(e, cols, n, ansi)
+    validity = l.validity & r.validity
+    dt = e.dataType
+    name = type(e).__name__
+    if isinstance(dt, T.DecimalType):
+        lt, rt = e.left.dataType, e.right.dataType
+        out = np.zeros(n, dtype=object)
+        for i in range(n):
+            if not validity[i]:
+                out[i] = 0
+                continue
+            a, b = int(l.values[i]), int(r.values[i])
+            if name in ("Add", "Subtract"):
+                a *= 10 ** (dt.scale - lt.scale)
+                b *= 10 ** (dt.scale - rt.scale)
+                out[i] = a + b if name == "Add" else a - b
+            elif name == "Multiply":
+                out[i] = a * b
+            elif name == "Divide":
+                if b == 0:
+                    if ansi:
+                        raise E.SparkArithmeticException("division by zero (ANSI)")
+                    validity[i] = False
+                    out[i] = 0
+                else:
+                    from decimal import Decimal, ROUND_HALF_UP, localcontext
+
+                    with localcontext() as lctx:
+                        lctx.prec = 78
+                        q = (Decimal(a).scaleb(-lt.scale)
+                             / Decimal(b).scaleb(-rt.scale))
+                        out[i] = int(q.scaleb(dt.scale).quantize(
+                            Decimal(1), rounding=ROUND_HALF_UP))
+            elif name in ("Remainder", "Pmod"):
+                if b == 0:
+                    if ansi:
+                        raise E.SparkArithmeticException("division by zero (ANSI)")
+                    validity[i] = False
+                    out[i] = 0
+                else:
+                    sa = a * 10 ** (dt.scale - lt.scale)
+                    sb = b * 10 ** (dt.scale - rt.scale)
+                    m = abs(sa) % abs(sb)
+                    out[i] = m * (1 if sa >= 0 else -1) if name == "Remainder" \
+                        else (sa % abs(sb))
+            else:
+                raise NotImplementedError(name)
+        validity = _dec_check(out, validity, dt, ansi, name.lower())
+        return CpuCol(dt, out, validity)
+    if dt.is_integral:
+        out_py = []
+        la, ra = l.values, r.values
+        for i in range(n):
+            if not validity[i]:
+                out_py.append(0)
+                continue
+            a, b = int(la[i]), int(ra[i])
+            if name == "Add":
+                v = a + b
+            elif name == "Subtract":
+                v = a - b
+            elif name == "Multiply":
+                v = a * b
+            elif name == "Remainder":
+                if b == 0:
+                    if ansi:
+                        raise E.SparkArithmeticException("division by zero (ANSI)")
+                    validity[i] = False
+                    v = 0
+                else:
+                    v = int(math.fmod(a, b))
+            elif name == "Pmod":
+                if b == 0:
+                    if ansi:
+                        raise E.SparkArithmeticException("division by zero (ANSI)")
+                    validity[i] = False
+                    v = 0
+                else:
+                    # Spark: r = a % n (truncated); r < 0 -> (r + n) % n
+                    v = int(math.fmod(a, b))
+                    if v < 0:
+                        v = int(math.fmod(v + b, b))
+            elif name == "IntegralDivide":
+                if b == 0:
+                    if ansi:
+                        raise E.SparkArithmeticException("division by zero (ANSI)")
+                    validity[i] = False
+                    v = 0
+                else:
+                    v = int(a / b) if abs(a) < 2**52 and abs(b) < 2**52 else \
+                        abs(a) // abs(b) * (1 if (a < 0) == (b < 0) else -1)
+            else:
+                raise NotImplementedError(name)
+            lo, rng = _JMIN[type(dt)], _JRANGE[type(dt)]
+            wrapped = ((v - lo) % rng) + lo
+            if ansi and wrapped != v:
+                raise E.SparkArithmeticException(f"{name} overflow (ANSI)")
+            out_py.append(wrapped)
+        return CpuCol(dt, np.array(out_py, T.storage_dtype(dt)), validity)
+    # floating point
+    la = l.values.astype(np.float64)
+    ra = r.values.astype(np.float64)
+    with np.errstate(all="ignore"):
+        if name == "Add":
+            out = la + ra
+        elif name == "Subtract":
+            out = la - ra
+        elif name == "Multiply":
+            out = la * ra
+        elif name == "Divide":
+            zero = ra == 0.0
+            if ansi and bool((zero & validity).any()):
+                raise E.SparkArithmeticException("division by zero (ANSI)")
+            validity = validity & ~zero
+            out = np.where(zero, np.nan, la / np.where(zero, 1.0, ra))
+        elif name in ("Remainder", "Pmod"):
+            zero = ra == 0.0
+            validity = validity & ~zero
+            out = np.fmod(la, np.where(zero, 1.0, ra))
+            if name == "Pmod":
+                safe = np.where(zero, 1.0, ra)
+                out = np.where(out < 0, np.fmod(out + safe, safe), out)
+        else:
+            raise NotImplementedError(name)
+    return CpuCol(e.dataType, out.astype(T.storage_dtype(e.dataType)), validity)
+
+
+def _h_unaryminus(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    dt = e.dataType
+    if isinstance(dt, T.DecimalType):
+        return CpuCol(dt, np.array([-int(v) for v in c.values], object),
+                      c.validity.copy())
+    if dt.is_integral:
+        return CpuCol(dt, _java_wrap([-int(v) for v in c.values], dt),
+                      c.validity.copy())
+    return CpuCol(dt, -c.values, c.validity.copy())
+
+
+def _h_abs(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    dt = e.dataType
+    if isinstance(dt, T.DecimalType):
+        return CpuCol(dt, np.array([abs(int(v)) for v in c.values], object),
+                      c.validity.copy())
+    if dt.is_integral:
+        return CpuCol(dt, _java_wrap([abs(int(v)) for v in c.values], dt),
+                      c.validity.copy())
+    return CpuCol(dt, np.abs(c.values), c.validity.copy())
+
+
+# -- predicates -------------------------------------------------------------
+
+def _cmp_rows(l: CpuCol, r: CpuCol, dt: T.DataType):
+    """elementwise python compare -> int array (-1,0,1)."""
+    out = np.zeros(l.n, np.int32)
+    for i in range(l.n):
+        a, b = l.values[i], r.values[i]
+        if isinstance(dt, T.StringType):
+            ab, bb = a.encode() if a is not None else b"", \
+                b.encode() if b is not None else b""
+            out[i] = (ab > bb) - (ab < bb)
+        else:
+            out[i] = (a > b) - (a < b)
+    return out
+
+
+def _h_comparison(e: P.BinaryComparison, cols, n, ansi):
+    l, r = _kids(e, cols, n, ansi)
+    validity = l.validity & r.validity
+    name = type(e).__name__
+    ct = e.left.dataType
+    if isinstance(ct, (T.StringType, T.DecimalType)):
+        cmpv = _cmp_rows(l, r, ct)
+        data = {"EqualTo": cmpv == 0, "LessThan": cmpv < 0,
+                "LessThanOrEqual": cmpv <= 0, "GreaterThan": cmpv > 0,
+                "GreaterThanOrEqual": cmpv >= 0}[name]
+    else:
+        with np.errstate(invalid="ignore"):
+            data = {"EqualTo": l.values == r.values,
+                    "LessThan": l.values < r.values,
+                    "LessThanOrEqual": l.values <= r.values,
+                    "GreaterThan": l.values > r.values,
+                    "GreaterThanOrEqual": l.values >= r.values}[name]
+    return CpuCol(T.BOOLEAN, np.asarray(data, np.bool_), validity)
+
+
+def _h_nullsafe_eq(e, cols, n, ansi):
+    l, r = _kids(e, cols, n, ansi)
+    ct = e.left.dataType
+    if isinstance(ct, (T.StringType, T.DecimalType)):
+        eq = _cmp_rows(l, r, ct) == 0
+    else:
+        eq = l.values == r.values
+    data = (l.validity & r.validity & eq) | (~l.validity & ~r.validity)
+    return CpuCol(T.BOOLEAN, data, np.ones(n, np.bool_))
+
+
+def _h_and(e, cols, n, ansi):
+    l, r = _kids(e, cols, n, ansi)
+    lt = l.validity & l.values.astype(bool)
+    lf = l.validity & ~l.values.astype(bool)
+    rt = r.validity & r.values.astype(bool)
+    rf = r.validity & ~r.values.astype(bool)
+    data = lt & rt
+    validity = (l.validity & r.validity) | lf | rf
+    return CpuCol(T.BOOLEAN, data, validity)
+
+
+def _h_or(e, cols, n, ansi):
+    l, r = _kids(e, cols, n, ansi)
+    lt = l.validity & l.values.astype(bool)
+    rt = r.validity & r.values.astype(bool)
+    data = lt | rt
+    validity = (l.validity & r.validity) | lt | rt
+    return CpuCol(T.BOOLEAN, data, validity)
+
+
+def _h_not(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    return CpuCol(T.BOOLEAN, ~c.values.astype(bool), c.validity.copy())
+
+
+def _h_isnull(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    return CpuCol(T.BOOLEAN, ~c.validity, np.ones(n, np.bool_))
+
+
+def _h_isnotnull(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    return CpuCol(T.BOOLEAN, c.validity.copy(), np.ones(n, np.bool_))
+
+
+def _h_isnan(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    data = np.zeros(n, np.bool_)
+    m = c.validity
+    data[m] = np.isnan(c.values[m].astype(np.float64))
+    return CpuCol(T.BOOLEAN, data, np.ones(n, np.bool_))
+
+
+def _h_in(e: P.In, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    v, cands = kids[0], kids[1:]
+    data = np.zeros(n, np.bool_)
+    any_null_cand = any(not bool(c.validity.all()) for c in cands)
+    for c in cands:
+        if not c.validity.any():
+            continue
+        if isinstance(e.children[0].dataType, (T.StringType, T.DecimalType)):
+            eq = np.array([v.values[i] == c.values[i] for i in range(n)])
+        else:
+            eq = v.values == c.values
+        data |= eq & c.validity
+    validity = v.validity.copy()
+    if any_null_cand:
+        validity &= data
+    return CpuCol(T.BOOLEAN, data, validity)
+
+
+# -- conditionals -----------------------------------------------------------
+
+def _select(pred_data, pred_valid, a: CpuCol, b: CpuCol, dt) -> CpuCol:
+    take_a = pred_data.astype(bool) & pred_valid
+    if a.values.dtype == object or b.values.dtype == object:
+        vals = np.array([a.values[i] if take_a[i] else b.values[i]
+                         for i in range(len(take_a))], dtype=object)
+    else:
+        vals = np.where(take_a, a.values, b.values)
+    validity = np.where(take_a, a.validity, b.validity)
+    return CpuCol(dt, vals, validity.astype(np.bool_))
+
+
+def _h_if(e, cols, n, ansi):
+    p, a, b = _kids(e, cols, n, ansi)
+    return _select(p.values, p.validity, a, b, e.dataType)
+
+
+def _h_casewhen(e: CO.CaseWhen, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    nb = (len(e.children) - (1 if e.has_else else 0)) // 2
+    if e.has_else:
+        acc = kids[-1]
+    else:
+        acc = _h_literal(E.Literal(None, e.dataType), cols, n, ansi)
+    for i in reversed(range(nb)):
+        cond, val = kids[2 * i], kids[2 * i + 1]
+        acc = _select(cond.values, cond.validity, val, acc, e.dataType)
+    return acc
+
+
+def _h_coalesce(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    acc = kids[-1]
+    for c in reversed(kids[:-1]):
+        acc = _select(c.validity, np.ones(n, np.bool_), c, acc, e.dataType)
+    return acc
+
+
+def _h_nanvl(e, cols, n, ansi):
+    a, b = _kids(e, cols, n, ansi)
+    is_nan = np.zeros(n, np.bool_)
+    m = a.validity
+    is_nan[m] = np.isnan(a.values[m].astype(np.float64))
+    return _select(~is_nan, np.ones(n, np.bool_), a, b, e.dataType)
+
+
+def _h_greatest(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    mx = type(e).__name__ == "Greatest"
+    out_vals = []
+    out_valid = np.zeros(n, np.bool_)
+
+    def rank(v):
+        # NaN strictly greatest; strings by bytes
+        if isinstance(v, str):
+            return (0, v.encode())
+        if isinstance(v, float) and math.isnan(v):
+            return (1, 0.0)
+        return (0, float(v))
+
+    for i in range(n):
+        vals = [k.values[i] for k in kids if k.validity[i]]
+        if not vals:
+            out_vals.append(0 if kids[0].values.dtype != object else None)
+            continue
+        out_valid[i] = True
+        out_vals.append((max if mx else min)(vals, key=rank))
+    dtype = object if kids[0].values.dtype == object else kids[0].values.dtype
+    return CpuCol(e.dataType, np.array(out_vals, dtype=dtype), out_valid)
+
+
+# -- cast -------------------------------------------------------------------
+
+def _h_cast(e: C.Cast, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    src, dst = e.child.dataType, e.to
+    ansi = ansi or e.ansi_override
+    if src == dst:
+        return c
+    out_vals: list = []
+    out_valid = c.validity.copy()
+    for i in range(n):
+        if not c.validity[i]:
+            out_vals.append(None)
+            continue
+        try:
+            out_vals.append(_cast_one(c.values[i], src, dst, ansi))
+        except _CastNull:
+            if ansi:
+                raise E.SparkArithmeticException(
+                    f"invalid cast {src}->{dst} (ANSI)")
+            out_vals.append(None)
+            out_valid[i] = False
+    if isinstance(dst, (T.StringType, T.DecimalType)):
+        vals = np.array([v if v is not None else None for v in out_vals],
+                        dtype=object)
+    else:
+        sdt = T.storage_dtype(dst)
+        vals = np.array([v if v is not None else 0 for v in out_vals],
+                        dtype=sdt)
+    return CpuCol(dst, vals, out_valid)
+
+
+class _CastNull(Exception):
+    pass
+
+
+def _cast_one(v, src: T.DataType, dst: T.DataType, ansi: bool):
+    import decimal as pydec
+
+    def is_int(t):
+        return t.is_integral
+
+    if isinstance(dst, T.BooleanType):
+        if isinstance(src, T.StringType):
+            s = str(v).strip().lower()
+            if s in ("true", "t", "yes", "y", "1"):
+                return True
+            if s in ("false", "f", "no", "n", "0"):
+                return False
+            raise _CastNull
+        return v != 0
+    if isinstance(dst, T.StringType):
+        if isinstance(src, T.BooleanType):
+            return "true" if v else "false"
+        if isinstance(src, T.DecimalType):
+            d = pydec.Decimal(int(v)).scaleb(-src.scale)
+            return f"{d:.{src.scale}f}" if src.scale > 0 else str(int(v))
+        if isinstance(src, T.DateType):
+            return (pydt.date(1970, 1, 1) + pydt.timedelta(days=int(v))).isoformat()
+        if isinstance(src, T.TimestampType):
+            ts = pydt.datetime(1970, 1, 1) + pydt.timedelta(microseconds=int(v))
+            base = ts.strftime("%Y-%m-%d %H:%M:%S")
+            if ts.microsecond:
+                frac = f"{ts.microsecond:06d}".rstrip("0")
+                return f"{base}.{frac}"
+            return base
+        if isinstance(src, (T.FloatType, T.DoubleType)):
+            raise _CastNull  # gated off at tag time; oracle mirrors fallback
+        return str(int(v))
+    if is_int(dst):
+        if isinstance(src, T.StringType):
+            s = str(v).strip()
+            if not s or not s.lstrip("+-").isdigit() or len(s.lstrip("+-")) > 19:
+                raise _CastNull
+            val = int(s)
+        elif isinstance(src, (T.FloatType, T.DoubleType)):
+            f = float(v)
+            if math.isnan(f):
+                val = 0
+            elif f >= 2 ** 63:      # Java (long) saturates
+                val = 2 ** 63 - 1
+            elif f <= -(2 ** 63):
+                val = -(2 ** 63)
+            else:
+                val = int(f)
+        elif isinstance(src, T.DecimalType):
+            val = int(pydec.Decimal(int(v)).scaleb(-src.scale)
+                      .to_integral_value(rounding=pydec.ROUND_DOWN))
+        elif isinstance(src, T.TimestampType):
+            val = int(v) // 1_000_000 if int(v) >= 0 or int(v) % 1_000_000 == 0 \
+                else int(v) // 1_000_000
+        else:
+            val = int(v)
+        lo, rng = _JMIN[type(dst)], _JRANGE[type(dst)]
+        wrapped = ((val - lo) % rng) + lo
+        if isinstance(src, T.StringType) and wrapped != val:
+            raise _CastNull
+        if isinstance(src, T.DecimalType) and wrapped != val:
+            raise _CastNull
+        return wrapped
+    if isinstance(dst, (T.FloatType, T.DoubleType)):
+        if isinstance(src, T.StringType):
+            try:
+                return float(str(v).strip())
+            except ValueError:
+                raise _CastNull
+        if isinstance(src, T.DecimalType):
+            return float(pydec.Decimal(int(v)).scaleb(-src.scale))
+        return float(v)
+    if isinstance(dst, T.DecimalType):
+        if isinstance(src, T.DecimalType):
+            d = pydec.Decimal(int(v)).scaleb(-src.scale)
+        elif isinstance(src, (T.FloatType, T.DoubleType)):
+            f = float(v)
+            if math.isnan(f) or math.isinf(f):
+                raise _CastNull
+            d = pydec.Decimal(f)
+        else:
+            d = pydec.Decimal(int(v))
+        scaled = int(d.scaleb(dst.scale).quantize(
+            pydec.Decimal(1), rounding=pydec.ROUND_HALF_UP))
+        if abs(scaled) >= 10 ** dst.precision:
+            raise _CastNull
+        return scaled
+    if isinstance(dst, T.DateType):
+        if isinstance(src, T.StringType):
+            try:
+                d = pydt.date.fromisoformat(str(v).strip())
+            except ValueError:
+                raise _CastNull
+            return (d - pydt.date(1970, 1, 1)).days
+        if isinstance(src, T.TimestampType):
+            return int(v) // 86_400_000_000
+        raise _CastNull
+    if isinstance(dst, T.TimestampType):
+        if isinstance(src, T.DateType):
+            return int(v) * 86_400_000_000
+        if is_int(src):
+            return int(v) * 1_000_000
+        raise _CastNull
+    raise NotImplementedError(f"oracle cast {src}->{dst}")
+
+
+# -- math -------------------------------------------------------------------
+
+def _h_unary_math(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    x = c.values.astype(np.float64)
+    name = type(e).__name__
+    validity = c.validity.copy()
+    with np.errstate(all="ignore"):
+        if name == "Sqrt":
+            out = np.sqrt(np.where(x < 0, np.nan, x))
+        elif name == "Exp":
+            out = np.exp(x)
+        elif name == "Log":
+            bad = x <= 0
+            validity &= ~bad
+            out = np.log(np.where(bad, 1.0, x))
+        elif name == "Log10":
+            bad = x <= 0
+            validity &= ~bad
+            out = np.log10(np.where(bad, 1.0, x))
+        elif name in ("Sin", "Cos", "Tan", "Asin", "Acos", "Atan"):
+            out = getattr(np, {"Sin": "sin", "Cos": "cos", "Tan": "tan",
+                               "Asin": "arcsin", "Acos": "arccos",
+                               "Atan": "arctan"}[name])(x)
+        elif name == "Signum":
+            out = np.sign(x)
+        else:
+            raise NotImplementedError(name)
+    return CpuCol(T.DOUBLE, out, validity)
+
+
+def _h_pow(e, cols, n, ansi):
+    l, r = _kids(e, cols, n, ansi)
+    with np.errstate(all="ignore"):
+        out = np.power(l.values.astype(np.float64), r.values.astype(np.float64))
+    return CpuCol(T.DOUBLE, out, l.validity & r.validity)
+
+
+def _h_floorceil(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    ct = e.child.dataType
+    is_ceil = type(e).__name__ == "Ceil"
+    if ct.is_integral:
+        return c
+    if isinstance(ct, T.DecimalType):
+        import decimal as pydec
+
+        r = pydec.ROUND_CEILING if is_ceil else pydec.ROUND_FLOOR
+        vals = np.array([int(pydec.Decimal(int(v)).scaleb(-ct.scale)
+                             .to_integral_value(rounding=r))
+                         for v in c.values], dtype=object)
+        return CpuCol(e.dataType, vals, c.validity.copy())
+    f = np.ceil if is_ceil else np.floor
+    return CpuCol(T.LONG, f(c.values.astype(np.float64)).astype(np.int64),
+                  c.validity.copy())
+
+
+def _h_round(e, cols, n, ansi):
+    c, s = _kids(e, cols, n, ansi)
+    ct = e.children[0].dataType
+    if isinstance(ct, T.DecimalType):
+        import decimal as pydec
+
+        dt: T.DecimalType = e.dataType
+        vals = np.array(
+            [int(pydec.Decimal(int(v)).scaleb(-ct.scale).scaleb(dt.scale)
+                 .quantize(pydec.Decimal(1), rounding=pydec.ROUND_HALF_UP))
+             for v in c.values], dtype=object)
+        return CpuCol(dt, vals, c.validity.copy())
+    if ct.is_integral:
+        return c
+    out = np.zeros(n, np.float64)
+    for i in range(n):
+        if c.validity[i]:
+            import decimal as pydec
+
+            d = pydec.Decimal(repr(float(c.values[i]))).quantize(
+                pydec.Decimal(1).scaleb(-int(s.values[i])),
+                rounding=pydec.ROUND_HALF_UP)
+            out[i] = float(d)
+    return CpuCol(e.dataType, out, c.validity & s.validity)
+
+
+# -- strings ----------------------------------------------------------------
+
+def _str_rows(c: CpuCol):
+    return c.values
+
+
+def _h_length(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    out = np.array([len(v) if v is not None else 0 for v in c.values],
+                   np.int32)
+    return CpuCol(T.INT, out, c.validity.copy())
+
+
+def _h_upperlower(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    up = type(e).__name__ == "Upper"
+    # ASCII-only to match device (documented incompat for non-ASCII)
+    def tx(s):
+        return "".join(
+            chr(ord(ch) - 32) if up and "a" <= ch <= "z" else
+            chr(ord(ch) + 32) if not up and "A" <= ch <= "Z" else ch
+            for ch in s)
+
+    out = np.array([tx(v) if v is not None else None for v in c.values],
+                   object)
+    return CpuCol(T.STRING, out, c.validity.copy())
+
+
+def _h_substring(e, cols, n, ansi):
+    c, p, ln = _kids(e, cols, n, ansi)
+    out = []
+    validity = c.validity & p.validity & ln.validity
+    for i in range(n):
+        if not validity[i]:
+            out.append(None)
+            continue
+        s = c.values[i]
+        pos, want = int(p.values[i]), int(ln.values[i])
+        b = s.encode()
+        # Spark substringSQL: window computed on unclamped start
+        if pos > 0:
+            start = pos - 1
+        elif pos < 0:
+            start = len(b) + pos
+        else:
+            start = 0
+        end = start + max(want, 0)
+        seg = b[max(start, 0): max(end, 0)]
+        out.append(seg.decode("utf-8", "replace"))
+    return CpuCol(T.STRING, np.array(out, object), validity)
+
+
+def _h_concat(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    validity = _null_prop_validity(kids)
+    out = []
+    for i in range(n):
+        out.append("".join(k.values[i] for k in kids) if validity[i] else None)
+    return CpuCol(T.STRING, np.array(out, object), validity)
+
+
+def _h_startswith(e, cols, n, ansi):
+    l, r = _kids(e, cols, n, ansi)
+    validity = l.validity & r.validity
+    name = type(e).__name__
+    out = np.zeros(n, np.bool_)
+    for i in range(n):
+        if validity[i]:
+            s, t = l.values[i], r.values[i]
+            out[i] = (s.startswith(t) if name == "StartsWith"
+                      else s.endswith(t) if name == "EndsWith"
+                      else t in s)
+    return CpuCol(T.BOOLEAN, out, validity)
+
+
+def _h_trim(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    out = np.array([v.strip(" ") if v is not None else None
+                    for v in c.values], object)
+    return CpuCol(T.STRING, out, c.validity.copy())
+
+
+def _h_like(e: S.Like, cols, n, ansi):
+    l, _ = _kids(e, cols, n, ansi)
+    p = e.right.value
+    import re
+
+    rx = re.compile("^" + re.escape(p).replace("%", ".*").replace("_", ".")
+                    + "$", re.DOTALL)
+    out = np.array([bool(rx.match(v)) if v is not None else False
+                    for v in l.values], np.bool_)
+    return CpuCol(T.BOOLEAN, out, l.validity.copy())
+
+
+# -- datetime ---------------------------------------------------------------
+
+def _date_of(c: CpuCol, dtype):
+    if isinstance(dtype, T.TimestampType):
+        return [pydt.date(1970, 1, 1)
+                + pydt.timedelta(days=int(v) // 86_400_000_000)
+                for v in c.values]
+    return [pydt.date(1970, 1, 1) + pydt.timedelta(days=int(v))
+            for v in c.values]
+
+
+def _h_datefield(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    dates = _date_of(c, e.child.dataType)
+    name = type(e).__name__
+    out = np.zeros(n, np.int32)
+    for i in range(n):
+        if not c.validity[i]:
+            continue
+        d = dates[i]
+        if name == "Year":
+            out[i] = d.year
+        elif name == "Month":
+            out[i] = d.month
+        elif name == "DayOfMonth":
+            out[i] = d.day
+        elif name == "DayOfWeek":
+            out[i] = d.isoweekday() % 7 + 1
+        elif name == "DayOfYear":
+            out[i] = d.timetuple().tm_yday
+        elif name == "Quarter":
+            out[i] = (d.month - 1) // 3 + 1
+        else:
+            raise NotImplementedError(name)
+    return CpuCol(T.INT, out, c.validity.copy())
+
+
+def _h_lastday(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    import calendar
+
+    dates = _date_of(c, e.child.dataType)
+    out = np.zeros(n, np.int32)
+    for i in range(n):
+        if c.validity[i]:
+            d = dates[i]
+            last = d.replace(day=calendar.monthrange(d.year, d.month)[1])
+            out[i] = (last - pydt.date(1970, 1, 1)).days
+    return CpuCol(T.DATE, out, c.validity.copy())
+
+
+def _h_timefield(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    name = type(e).__name__
+    out = np.zeros(n, np.int32)
+    for i in range(n):
+        if c.validity[i]:
+            ts = (pydt.datetime(1970, 1, 1)
+                  + pydt.timedelta(microseconds=int(c.values[i])))
+            out[i] = {"Hour": ts.hour, "Minute": ts.minute,
+                      "Second": ts.second}[name]
+    return CpuCol(T.INT, out, c.validity.copy())
+
+
+def _h_dateadd(e, cols, n, ansi):
+    d, k = _kids(e, cols, n, ansi)
+    sign = -1 if type(e).__name__ == "DateSub" else 1
+    out = (d.values.astype(np.int64)
+           + sign * k.values.astype(np.int64)).astype(np.int32)
+    return CpuCol(T.DATE, out, d.validity & k.validity)
+
+
+def _h_datediff(e, cols, n, ansi):
+    a, b = _kids(e, cols, n, ansi)
+    return CpuCol(T.INT, (a.values.astype(np.int64)
+                          - b.values.astype(np.int64)).astype(np.int32),
+                  a.validity & b.validity)
+
+
+def _h_unixts(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    if isinstance(e.child.dataType, T.DateType):
+        out = c.values.astype(np.int64) * 86_400
+    else:
+        out = np.array([int(v) // 1_000_000 for v in c.values], np.int64)
+    return CpuCol(T.LONG, out, c.validity.copy())
+
+
+_HANDLERS = {
+    "BoundReference": _h_bound,
+    "Literal": _h_literal,
+    "Alias": _h_alias,
+    "Add": _h_binarith, "Subtract": _h_binarith, "Multiply": _h_binarith,
+    "Divide": _h_binarith, "IntegralDivide": _h_binarith,
+    "Remainder": _h_binarith, "Pmod": _h_binarith,
+    "UnaryMinus": _h_unaryminus, "Abs": _h_abs,
+    "EqualTo": _h_comparison, "LessThan": _h_comparison,
+    "LessThanOrEqual": _h_comparison, "GreaterThan": _h_comparison,
+    "GreaterThanOrEqual": _h_comparison, "EqualNullSafe": _h_nullsafe_eq,
+    "And": _h_and, "Or": _h_or, "Not": _h_not,
+    "IsNull": _h_isnull, "IsNotNull": _h_isnotnull, "IsNaN": _h_isnan,
+    "In": _h_in,
+    "If": _h_if, "CaseWhen": _h_casewhen, "Coalesce": _h_coalesce,
+    "Nvl": _h_coalesce, "NaNvl": _h_nanvl,
+    "Greatest": _h_greatest, "Least": _h_greatest,
+    "Cast": _h_cast,
+    "Sqrt": _h_unary_math, "Exp": _h_unary_math, "Log": _h_unary_math,
+    "Log10": _h_unary_math, "Sin": _h_unary_math, "Cos": _h_unary_math,
+    "Tan": _h_unary_math, "Asin": _h_unary_math, "Acos": _h_unary_math,
+    "Atan": _h_unary_math, "Signum": _h_unary_math,
+    "Pow": _h_pow, "Floor": _h_floorceil, "Ceil": _h_floorceil,
+    "Round": _h_round,
+    "Length": _h_length, "Upper": _h_upperlower, "Lower": _h_upperlower,
+    "Substring": _h_substring, "Concat": _h_concat,
+    "StartsWith": _h_startswith, "EndsWith": _h_startswith,
+    "Contains": _h_startswith, "StringTrim": _h_trim, "Like": _h_like,
+    "Year": _h_datefield, "Month": _h_datefield, "DayOfMonth": _h_datefield,
+    "DayOfWeek": _h_datefield, "DayOfYear": _h_datefield,
+    "Quarter": _h_datefield, "LastDay": _h_lastday,
+    "Hour": _h_timefield, "Minute": _h_timefield, "Second": _h_timefield,
+    "DateAdd": _h_dateadd, "DateSub": _h_dateadd, "DateDiff": _h_datediff,
+    "UnixTimestamp": _h_unixts,
+}
+
+
+# ===========================================================================
+# Plan executor
+# ===========================================================================
+
+def execute_cpu_plan(plan: PN.SparkPlan, ansi: bool = False) -> Tuple[CpuBatch, int]:
+    """Execute a plan tree fully on CPU.  Returns (columns, num_rows)."""
+    if hasattr(plan, "materialize_cpu"):
+        # TpuMaterializedScan: columnar->row boundary under a CPU node
+        return plan.materialize_cpu()
+    name = type(plan).__name__
+    if isinstance(plan, PN.LocalTableScan):
+        cols = [CpuCol.from_host(h) for h in plan.host_columns]
+        n = cols[0].n if cols else 0
+        return cols, n
+    if isinstance(plan, PN.FileSourceScan):
+        return _cpu_file_scan(plan)
+    if isinstance(plan, PN.RangeNode):
+        vals = np.arange(plan.start, plan.end, plan.step, dtype=np.int64)
+        return [CpuCol(T.LONG, vals, np.ones(len(vals), np.bool_))], len(vals)
+    if isinstance(plan, PN.Project):
+        cols, n = execute_cpu_plan(plan.child, ansi)
+        return [eval_expr(e, cols, n, ansi) for e in plan.exprs], n
+    if isinstance(plan, PN.Filter):
+        cols, n = execute_cpu_plan(plan.child, ansi)
+        pred = eval_expr(plan.condition, cols, n, ansi)
+        keep = pred.values.astype(bool) & pred.validity
+        out = [CpuCol(c.dtype, c.values[keep], c.validity[keep]) for c in cols]
+        return out, int(keep.sum())
+    if isinstance(plan, PN.HashAggregate):
+        return _cpu_aggregate(plan, ansi)
+    if isinstance(plan, (PN.SortMergeJoin, PN.ShuffledHashJoin,
+                         PN.BroadcastHashJoin)):
+        return _cpu_join(plan, ansi)
+    if isinstance(plan, PN.Sort):
+        return _cpu_sort(plan, ansi)
+    if isinstance(plan, PN.Window):
+        return _cpu_window(plan, ansi)
+    if isinstance(plan, (PN.GlobalLimit, PN.LocalLimit)):
+        cols, n = execute_cpu_plan(plan.children[0], ansi)
+        k = min(plan.n, n)
+        return [CpuCol(c.dtype, c.values[:k], c.validity[:k]) for c in cols], k
+    if isinstance(plan, PN.Union):
+        parts = [execute_cpu_plan(c, ansi) for c in plan.children]
+        ncols = len(parts[0][0])
+        out = []
+        for ci in range(ncols):
+            vals = np.concatenate([p[0][ci].values for p in parts])
+            valid = np.concatenate([p[0][ci].validity for p in parts])
+            out.append(CpuCol(parts[0][0][ci].dtype, vals, valid))
+        return out, sum(p[1] for p in parts)
+    if isinstance(plan, (PN.Exchange, PN.BroadcastExchange)):
+        return execute_cpu_plan(plan.children[0], ansi)
+    raise NotImplementedError(f"oracle plan node {name}")
+
+
+def _cpu_file_scan(plan: PN.FileSourceScan):
+    import pyarrow.parquet as pq
+    import pyarrow.csv as pacsv
+
+    tables = []
+    for p in plan.paths:
+        if plan.fmt == "parquet":
+            tables.append(pq.read_table(p))
+        elif plan.fmt == "csv":
+            tables.append(pacsv.read_csv(p))
+        else:
+            raise NotImplementedError(plan.fmt)
+    import pyarrow as pa
+
+    tbl = pa.concat_tables(tables)
+    cols = []
+    for f in plan.output.fields:
+        h = HostColumn.from_arrow(tbl.column(f.name), f.dataType)
+        cols.append(CpuCol.from_host(h))
+    return cols, tbl.num_rows
+
+
+def _group_key(cols: List[CpuCol], i: int):
+    out = []
+    for c in cols:
+        if not c.validity[i]:
+            out.append(("\0NULL",))
+        else:
+            v = c.values[i]
+            if isinstance(v, float) and math.isnan(v):
+                out.append(("\0NAN",))
+            else:
+                out.append(v)
+    return tuple(out)
+
+
+def _cpu_aggregate(plan: PN.HashAggregate, ansi: bool):
+    cols, n = execute_cpu_plan(plan.child, ansi)
+    gcols = [eval_expr(g, cols, n, ansi) for g in plan.grouping]
+    mode = plan.mode
+    child_names = plan.child.output.field_names()
+    if mode == PN.AggregateMode.FINAL:
+        # inputs are partial buffers from the child by name
+        acols = []
+        for a in plan.aggregates:
+            if a.func == "avg":
+                acols.append((cols[child_names.index(a.result_name + "_sum")],
+                              cols[child_names.index(a.result_name + "_count")]))
+            else:
+                nm = a.result_name
+                acols.append(cols[child_names.index(nm)])
+    else:
+        acols = [eval_expr(a.child, cols, n, ansi) if a.child is not None
+                 else None for a in plan.aggregates]
+    groups: Dict[tuple, int] = {}
+    order: List[tuple] = []
+    rows_per_group: List[List[int]] = []
+    if gcols:
+        for i in range(n):
+            k = _group_key(gcols, i)
+            gi = groups.get(k)
+            if gi is None:
+                gi = len(order)
+                groups[k] = gi
+                order.append(k)
+                rows_per_group.append([])
+            rows_per_group[gi].append(i)
+        ng = len(order)
+    else:
+        ng = 1
+        rows_per_group = [list(range(n))]
+    out_cols: List[CpuCol] = []
+    for ki, g in enumerate(plan.grouping):
+        vals = []
+        valid = np.ones(ng, np.bool_)
+        for gi in range(ng):
+            i = rows_per_group[gi][0]
+            if gcols[ki].validity[i]:
+                vals.append(gcols[ki].values[i])
+            else:
+                vals.append(None)
+                valid[gi] = False
+        dtype = (object if gcols[ki].values.dtype == object
+                 else gcols[ki].values.dtype)
+        arr = np.array([v if v is not None else
+                        (None if dtype == object else 0) for v in vals],
+                       dtype=dtype)
+        out_cols.append(CpuCol(g.dataType, arr, valid))
+    for a, ac, f in zip(plan.aggregates, acols,
+                        plan.output.fields[len(plan.grouping):]
+                        if mode != PN.AggregateMode.PARTIAL else
+                        _partial_field_groups(plan)):
+        if mode == PN.AggregateMode.PARTIAL:
+            for c in _agg_partial(a, ac, rows_per_group, f):
+                out_cols.append(c)
+        elif mode == PN.AggregateMode.FINAL:
+            out_cols.append(_agg_final(a, ac, rows_per_group))
+        else:
+            vals, valid = _agg_one(a, ac, rows_per_group, ansi)
+            out_cols.append(CpuCol(a.result_type, vals, valid))
+    return out_cols, ng
+
+
+def _partial_field_groups(plan: PN.HashAggregate):
+    """Yield the output field (or field pair for avg) per aggregate."""
+    fields = plan.output.fields[len(plan.grouping):]
+    i = 0
+    for a in plan.aggregates:
+        if a.func == "avg":
+            yield (fields[i], fields[i + 1])
+            i += 2
+        else:
+            yield (fields[i],)
+            i += 1
+
+
+def _agg_partial(a: PN.AggregateExpression, ac: Optional[CpuCol],
+                 rows_per_group, fields):
+    ng = len(rows_per_group)
+    if a.func == "avg":
+        sum_f, cnt_f = fields
+        sums, cnts = [], []
+        valid = np.ones(ng, np.bool_)
+        dec = isinstance(sum_f.dataType, T.DecimalType)
+        for gi in range(ng):
+            idxs = [i for i in rows_per_group[gi] if ac.validity[i]]
+            cnts.append(len(idxs))
+            if not idxs:
+                sums.append(None)
+                valid[gi] = False
+            elif dec:
+                sums.append(sum(int(ac.values[i]) for i in idxs))
+            else:
+                sums.append(float(np.sum(np.array(
+                    [ac.values[i] for i in idxs], np.float64))))
+        svals = (np.array([s if s is not None else 0 for s in sums],
+                          dtype=object if dec else np.float64))
+        yield CpuCol(sum_f.dataType, svals, valid)
+        yield CpuCol(cnt_f.dataType, np.array(cnts, np.int64),
+                     np.ones(ng, np.bool_))
+        return
+    # count/sum/min/max/first/last partials share the final update shape
+    vals, valid = _agg_one(a, ac, rows_per_group, False)
+    (f,) = fields
+    yield CpuCol(f.dataType, vals, valid)
+
+
+def _agg_final(a: PN.AggregateExpression, ac, rows_per_group) -> CpuCol:
+    """Merge partial buffers."""
+    ng = len(rows_per_group)
+    if a.func == "avg":
+        sc, cc = ac
+        dec = isinstance(a.result_type, T.DecimalType)
+        out, valid = [], np.ones(ng, np.bool_)
+        for gi in range(ng):
+            idxs = rows_per_group[gi]
+            total_cnt = sum(int(cc.values[i]) for i in idxs if cc.validity[i])
+            if total_cnt == 0:
+                out.append(None)
+                valid[gi] = False
+                continue
+            if dec:
+                import decimal as pydec
+
+                rt: T.DecimalType = a.result_type
+                s = sum(int(sc.values[i]) for i in idxs if sc.validity[i])
+                in_scale = rt.scale - 4
+                q = pydec.Decimal(s).scaleb(-in_scale) / total_cnt
+                out.append(int(q.scaleb(rt.scale).quantize(
+                    pydec.Decimal(1), rounding=pydec.ROUND_HALF_UP)))
+            else:
+                s = sum(float(sc.values[i]) for i in idxs if sc.validity[i])
+                out.append(s / total_cnt)
+        if dec:
+            return CpuCol(a.result_type, np.array(out, object), valid)
+        return CpuCol(a.result_type,
+                      np.array([v if v is not None else 0 for v in out],
+                               np.float64), valid)
+    merge_func = {"count": "sum", "count_star": "sum", "sum": "sum",
+                  "min": "min", "max": "max", "first": "first",
+                  "last": "last"}[a.func]
+    merged = PN.AggregateExpression(merge_func, None, a.result_name,
+                                    a.result_type)
+    vals, valid = _agg_one(merged, ac, rows_per_group, False)
+    if a.func in ("count", "count_star"):
+        valid = np.ones(ng, np.bool_)
+        vals = np.array([v if valid[i] else 0 for i, v in enumerate(vals)],
+                        np.int64)
+    return CpuCol(a.result_type, vals, valid)
+
+
+def _agg_one(a: PN.AggregateExpression, ac: Optional[CpuCol],
+             rows_per_group, ansi):
+    ng = len(rows_per_group)
+    func = a.func
+    if func == "count_star":
+        return (np.array([len(r) for r in rows_per_group], np.int64),
+                np.ones(ng, np.bool_))
+    out = []
+    valid = np.ones(ng, np.bool_)
+    dec = isinstance(a.result_type, T.DecimalType)
+    for gi in range(ng):
+        idxs = [i for i in rows_per_group[gi] if ac.validity[i]]
+        if func == "count":
+            out.append(len(idxs))
+            continue
+        if func in ("first", "last"):
+            # Spark First/Last default ignoreNulls=false: nulls count
+            all_rows = rows_per_group[gi]
+            i = all_rows[0] if func == "first" else all_rows[-1]
+            if ac.validity[i]:
+                out.append(ac.values[i])
+            else:
+                out.append(None)
+                valid[gi] = False
+            continue
+        if not idxs:
+            out.append(None)
+            valid[gi] = False
+            continue
+        vs = [ac.values[i] for i in idxs]
+        if func == "sum":
+            out.append(sum(int(v) for v in vs) if dec or
+                       isinstance(a.result_type, T.LongType)
+                       else float(np.sum(np.array(vs, np.float64))))
+        elif func == "min":
+            out.append(_minmax(vs, ac.dtype, mx=False))
+        elif func == "max":
+            out.append(_minmax(vs, ac.dtype, mx=True))
+        elif func == "avg":
+            if isinstance(ac.dtype, T.DecimalType):
+                import decimal as pydec
+
+                s = sum(int(v) for v in vs)
+                rt: T.DecimalType = a.result_type
+                q = (pydec.Decimal(s).scaleb(-ac.dtype.scale)
+                     / pydec.Decimal(len(vs)))
+                out.append(int(q.scaleb(rt.scale).quantize(
+                    pydec.Decimal(1), rounding=pydec.ROUND_HALF_UP)))
+            else:
+                out.append(float(np.mean(np.array(vs, np.float64))))
+        elif func == "first":
+            out.append(vs[0])
+        elif func == "last":
+            out.append(vs[-1])
+        else:
+            raise NotImplementedError(func)
+    if dec or isinstance(a.result_type, T.StringType):
+        vals = np.array([v if v is not None else None for v in out], object)
+    else:
+        sdt = T.storage_dtype(a.result_type)
+        vals = np.array([v if v is not None else 0 for v in out], sdt)
+    return vals, valid
+
+
+def _minmax(vs, dtype, mx):
+    if isinstance(dtype, T.StringType):
+        key = lambda s: s.encode()
+        return (max if mx else min)(vs, key=key)
+    fv = [v for v in vs]
+    floats = [v for v in fv if isinstance(v, float)]
+    if floats and any(math.isnan(v) for v in floats):
+        # Spark: NaN is greater than everything
+        non_nan = [v for v in fv if not (isinstance(v, float) and math.isnan(v))]
+        if mx:
+            return math.nan
+        return min(non_nan) if non_nan else math.nan
+    return (max if mx else min)(fv)
+
+
+def _join_key(cols: List[CpuCol], i: int):
+    parts = []
+    for c in cols:
+        if not c.validity[i]:
+            return None  # null keys never match
+        v = c.values[i]
+        if isinstance(v, float) and math.isnan(v):
+            v = ("\0NAN",)
+        parts.append(v)
+    return tuple(parts)
+
+
+def _cpu_join(plan: PN._BaseJoin, ansi: bool):
+    lcols, ln = execute_cpu_plan(plan.left, ansi)
+    rcols, rn = execute_cpu_plan(plan.right, ansi)
+    lkeys = [eval_expr(k, lcols, ln, ansi) for k in plan.left_keys]
+    rkeys = [eval_expr(k, rcols, rn, ansi) for k in plan.right_keys]
+    build: Dict[tuple, List[int]] = {}
+    for j in range(rn):
+        k = _join_key(rkeys, j)
+        if k is not None:
+            build.setdefault(k, []).append(j)
+    jt = plan.join_type
+    pairs: List[Tuple[int, Optional[int]]] = []
+    matched_right = np.zeros(rn, np.bool_)
+    for i in range(ln):
+        k = _join_key(lkeys, i)
+        matches = build.get(k, []) if k is not None else []
+        if jt == PN.JoinType.LEFT_SEMI:
+            if matches:
+                pairs.append((i, None))
+            continue
+        if jt == PN.JoinType.LEFT_ANTI:
+            if not matches:
+                pairs.append((i, None))
+            continue
+        if matches:
+            for j in matches:
+                pairs.append((i, j))
+                matched_right[j] = True
+        elif jt in (PN.JoinType.LEFT_OUTER, PN.JoinType.FULL_OUTER):
+            pairs.append((i, None))
+    if jt in (PN.JoinType.RIGHT_OUTER, PN.JoinType.FULL_OUTER):
+        if jt == PN.JoinType.RIGHT_OUTER:
+            # keep matched pairs plus unmatched right
+            pass
+        for j in range(rn):
+            if not matched_right[j]:
+                pairs.append((None, j))
+        if jt == PN.JoinType.RIGHT_OUTER:
+            pairs = [(i, j) for (i, j) in pairs if j is not None]
+    # apply residual condition on joined rows (inner-style filter)
+    out_cols = _materialize_join(plan, lcols, rcols, pairs, jt)
+    nrows = len(pairs)
+    if plan.condition is not None and jt == PN.JoinType.INNER:
+        pred = eval_expr(plan.condition, out_cols, nrows, ansi)
+        keep = pred.values.astype(bool) & pred.validity
+        out_cols = [CpuCol(c.dtype, c.values[keep], c.validity[keep])
+                    for c in out_cols]
+        nrows = int(keep.sum())
+    return out_cols, nrows
+
+
+def _materialize_join(plan, lcols, rcols, pairs, jt):
+    def take(cols, idxs):
+        out = []
+        for c in cols:
+            vals = np.array(
+                [c.values[i] if i is not None else
+                 (None if c.values.dtype == object else 0)
+                 for i in idxs],
+                dtype=c.values.dtype if c.values.dtype == object else
+                c.values.dtype)
+            valid = np.array([c.validity[i] if i is not None else False
+                              for i in idxs], np.bool_)
+            out.append(CpuCol(c.dtype, vals, valid))
+        return out
+
+    li = [p[0] for p in pairs]
+    out = take(lcols, li)
+    if jt not in (PN.JoinType.LEFT_SEMI, PN.JoinType.LEFT_ANTI):
+        ri = [p[1] for p in pairs]
+        out += take(rcols, ri)
+    return out
+
+
+def _sort_key_fn(c: CpuCol, spec):
+    def key(i):
+        if not c.validity[i]:
+            return (0 if spec.nulls_first else 2, 0, 0)
+        v = c.values[i]
+        if isinstance(v, str):
+            b = v.encode()
+            if not spec.ascending:
+                # desc for bytes: invert and terminate so prefixes sort after
+                b = bytes(255 - x for x in b) + b"\xff"
+                return (1, b, 0)
+            return (1, b, 0)
+        if isinstance(v, float) and math.isnan(v):
+            # NaN is strictly greatest (above +inf)
+            return ((1, math.inf, 1) if spec.ascending
+                    else (1, -math.inf, -1))
+        v2 = float(v) if not isinstance(v, int) else v
+        return (1, -v2 if not spec.ascending else v2, 0)
+
+    return key
+
+
+def _cpu_sort(plan: PN.Sort, ansi: bool):
+    cols, n = execute_cpu_plan(plan.child, ansi)
+    kcols = [eval_expr(e, cols, n, ansi) for e, _ in plan.orders]
+    idx = list(range(n))
+    # stable multi-key: sort by last key first
+    for (e, spec), kc in reversed(list(zip(plan.orders, kcols))):
+        keyf = _sort_key_fn(kc, spec)
+        idx.sort(key=keyf)
+    take = np.array(idx, np.int64) if n else np.zeros(0, np.int64)
+    out = [CpuCol(c.dtype, c.values[take], c.validity[take]) for c in cols]
+    return out, n
+
+
+def _cpu_window(plan: PN.Window, ansi: bool):
+    cols, n = execute_cpu_plan(plan.child, ansi)
+    pcols = [eval_expr(e, cols, n, ansi) for e in plan.partition_by]
+    ocols = [eval_expr(e, cols, n, ansi) for e, _ in plan.order_by]
+    # partition rows
+    parts: Dict[tuple, List[int]] = {}
+    for i in range(n):
+        k = _group_key(pcols, i) if pcols else ()
+        parts.setdefault(k, []).append(i)
+    # order within partition
+    for k, idxs in parts.items():
+        for (e, spec), oc in reversed(list(zip(plan.order_by, ocols))):
+            keyf = _sort_key_fn(oc, spec)
+            idxs.sort(key=keyf)
+    out_cols = list(cols)
+    for wf in plan.functions:
+        ac = (eval_expr(wf.child, cols, n, ansi)
+              if wf.child is not None else None)
+        vals = [None] * n
+        valid = np.ones(n, np.bool_)
+        for k, idxs in parts.items():
+            if wf.func == "row_number":
+                for r, i in enumerate(idxs):
+                    vals[i] = r + 1
+            elif wf.func in ("rank", "dense_rank"):
+                rank = 0
+                dense = 0
+                prev = object()
+                for r, i in enumerate(idxs):
+                    cur = tuple(oc.row(i) for oc in ocols)
+                    if cur != prev:
+                        rank = r + 1
+                        dense += 1
+                        prev = cur
+                    vals[i] = rank if wf.func == "rank" else dense
+            elif wf.func in ("sum", "count", "avg", "min", "max"):
+                if plan.frame == "running":
+                    acc: List = []
+                    for i in idxs:
+                        if ac.validity[i]:
+                            acc.append(ac.values[i])
+                        vals[i] = _wagg(wf, acc, valid, i)
+                else:  # unbounded
+                    acc = [ac.values[i] for i in idxs if ac.validity[i]]
+                    for i in idxs:
+                        vals[i] = _wagg(wf, acc, valid, i)
+            else:
+                raise NotImplementedError(wf.func)
+        if isinstance(wf.result_type, (T.DecimalType, T.StringType)):
+            arr = np.array(vals, object)
+        else:
+            arr = np.array([v if v is not None else 0 for v in vals],
+                           T.storage_dtype(wf.result_type))
+        out_cols.append(CpuCol(wf.result_type, arr, valid))
+    return out_cols, n
+
+
+def _wagg(wf, acc, valid, i):
+    if wf.func == "count":
+        return len(acc)
+    if not acc:
+        valid[i] = False
+        return None
+    if wf.func == "sum":
+        return sum(acc) if not isinstance(acc[0], float) else float(sum(acc))
+    if wf.func == "avg":
+        return float(sum(float(v) for v in acc)) / len(acc)
+    if wf.func == "min":
+        return min(acc)
+    if wf.func == "max":
+        return max(acc)
+    raise NotImplementedError(wf.func)

@@ -1,0 +1,26 @@
+from spark_rapids_tpu.exec.base import TpuExec, TpuMetric  # noqa: F401
+from spark_rapids_tpu.exec.basic import (  # noqa: F401
+    TpuFilterExec,
+    TpuLocalTableScanExec,
+    TpuProjectExec,
+    TpuRangeExec,
+    TpuStageExec,
+    TpuUnionExec,
+)
+from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec  # noqa: F401
+from spark_rapids_tpu.exec.sort import TpuSortExec, TpuTopNExec  # noqa: F401
+from spark_rapids_tpu.exec.join import (  # noqa: F401
+    TpuBroadcastHashJoinExec,
+    TpuShuffledSymmetricHashJoinExec,
+)
+from spark_rapids_tpu.exec.limit import (  # noqa: F401
+    TpuGlobalLimitExec,
+    TpuLocalLimitExec,
+)
+from spark_rapids_tpu.exec.window import TpuWindowExec  # noqa: F401
+from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec  # noqa: F401
+from spark_rapids_tpu.exec.coalesce import TpuCoalesceBatchesExec  # noqa: F401
+from spark_rapids_tpu.exec.transitions import (  # noqa: F401
+    TpuColumnarToRowExec,
+    TpuRowToColumnarExec,
+)

@@ -1,0 +1,330 @@
+"""TpuHashAggregateExec — sort-based group-by aggregation.
+
+Reference analog: GpuHashAggregateExec / GpuAggregateIterator /
+GpuMergeAggregateIterator (SURVEY.md §2.4): batches are aggregated, partials
+merged, with a sort-based fallback when merge output is too big.  TPU-first
+redesign: the *primary* algorithm is sort-based (lax.sort by packed key words
++ segmented reductions) because Pallas/XLA favor sorting networks over
+device-wide-atomic hash tables (SURVEY.md §7 hard part #3).  The reference's
+"fall back to sort" becomes our main path; its hash fast-path can come later
+as a Pallas kernel if profiling demands.
+
+Partial/Final mode split matches Spark exactly (partial before the exchange,
+final after), including avg -> (sum, count) partial buffers.
+
+The entire aggregation — key packing, sort, segmentation, every aggregate
+update — is one jitted XLA program per shape bucket.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.expr.base import BoundReference, EvalContext, Expression
+from spark_rapids_tpu.ops import segment as SEG
+from spark_rapids_tpu.ops.sortkeys import (
+    SortSpec,
+    _column_key_words,
+    group_segments,
+)
+from spark_rapids_tpu.plan.nodes import AggregateExpression, AggregateMode
+
+
+def _is_float(dt: T.DataType) -> bool:
+    return isinstance(dt, (T.FloatType, T.DoubleType))
+
+
+class TpuHashAggregateExec(TpuExec):
+    def __init__(self, grouping: List[Expression],
+                 aggregates: List[AggregateExpression],
+                 mode: AggregateMode, child: TpuExec,
+                 child_plan_output: T.StructType,
+                 output_schema: T.StructType,
+                 ansi: bool = False):
+        super().__init__([child])
+        self.grouping = grouping
+        self.aggregates = aggregates
+        self.mode = mode
+        self.child_schema = child_plan_output
+        self._output = output_schema
+        self.ansi = ansi
+
+    @property
+    def output(self):
+        return self._output
+
+    def describe(self):
+        g = ", ".join(e.sql_string() for e in self.grouping)
+        a = ", ".join(a.describe() for a in self.aggregates)
+        return f"TpuHashAggregate({self.mode.value}) keys=[{g}] aggs=[{a}]"
+
+    # ------------------------------------------------------------------
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        batches = list(self.children[0].execute_columnar())
+        if not batches:
+            from spark_rapids_tpu.columnar.batch import empty_batch
+
+            if not self.grouping:
+                yield self._global_agg_empty()
+            else:
+                yield empty_batch(self._output)
+            return
+        with self.metrics["opTime"].timed():
+            batch = (batches[0] if len(batches) == 1
+                     else ColumnarBatch.concat(batches))
+            out = self._aggregate_batch(batch)
+        yield self._count_output(out)
+
+    def _global_agg_empty(self) -> ColumnarBatch:
+        cols = []
+        for f, a in zip(self._output.fields, self.aggregates):
+            import numpy as np
+
+            if a.func in ("count", "count_star"):
+                cols.append(DeviceColumn(f.dataType, jnp.ones(1, jnp.bool_),
+                                         data=jnp.zeros(1, jnp.int64)))
+            elif isinstance(f.dataType, T.StringType):
+                cols.append(DeviceColumn(f.dataType, jnp.zeros(1, jnp.bool_),
+                                         chars=jnp.zeros((1, 8), jnp.uint8),
+                                         lengths=jnp.zeros(1, jnp.int32)))
+            else:
+                cols.append(DeviceColumn(
+                    f.dataType, jnp.zeros(1, jnp.bool_),
+                    data=jnp.zeros(1, T.storage_dtype(f.dataType))))
+        return ColumnarBatch(cols, 1, self._output)
+
+    # ------------------------------------------------------------------
+    def _aggregate_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+        if getattr(self, "_jitted", None) is None:
+            self._jitted = jax.jit(self._agg_fn)
+        cols, nrows = self._jitted(tuple(batch.columns),
+                                   jnp.int32(batch.num_rows))
+        return ColumnarBatch(list(cols), int(nrows), self._output)
+
+    def _agg_fn(self, cols, num_rows):
+        batch = ColumnarBatch(list(cols), num_rows, self.child_schema)
+        ctx = EvalContext(batch, ansi=self.ansi)
+        key_cols = [g.eval_tpu(ctx) for g in self.grouping]
+        if not key_cols:
+            return self._global_agg(ctx, batch)
+        cap = batch.capacity
+        mask = batch.row_mask
+        # ---- sort rows by group keys (stable, padding last) ----
+        keys: List[jax.Array] = []
+        hi = jnp.int64(9223372036854775807)
+        for kc in key_cols:
+            nullk = jnp.where(kc.validity, 0, -1).astype(jnp.int64)
+            keys.append(jnp.where(mask, nullk, hi))
+            for w in _column_key_words(kc):
+                keys.append(jnp.where(mask, jnp.where(kc.validity, w, 0), hi))
+        perm = jax.lax.sort(
+            tuple(keys) + (jnp.arange(cap, dtype=jnp.int32),),
+            num_keys=len(keys), is_stable=True)[-1]
+        sorted_keys = [k[perm] for k in keys]
+        mask_sorted = mask[perm]
+        seg, ngroups = group_segments(sorted_keys, mask_sorted)
+        seg = jnp.where(mask_sorted, seg, cap - 1)  # padding -> last bucket
+        # ---- group-key output columns ----
+        first_idx = SEG.seg_first_index(seg, mask_sorted, cap)
+        safe_first = jnp.clip(first_idx, 0, cap - 1)
+        out_cols: List[DeviceColumn] = []
+        group_valid = jnp.arange(cap) < ngroups
+        for kc in key_cols:
+            kcs = _gather_col(kc, perm)
+            g = _gather_col(kcs, safe_first)
+            out_cols.append(DeviceColumn(
+                g.dtype, g.validity & group_valid, data=g.data,
+                chars=g.chars, lengths=g.lengths))
+        # ---- aggregates ----
+        for a, f in zip(self.aggregates, self._agg_fields()):
+            out_cols.extend(self._eval_agg(a, f, ctx, perm, seg, mask_sorted,
+                                           cap, group_valid))
+        return tuple(out_cols), ngroups.astype(jnp.int32)
+
+    def _agg_fields(self):
+        """Output fields per aggregate (partial avg takes two)."""
+        fields = list(self._output.fields[len(self.grouping):])
+        out = []
+        i = 0
+        for a in self.aggregates:
+            if a.func == "avg" and self.mode == AggregateMode.PARTIAL:
+                out.append((fields[i], fields[i + 1]))
+                i += 2
+            else:
+                out.append((fields[i],))
+                i += 1
+        return out
+
+    # -- per-aggregate evaluation --------------------------------------
+    def _input_col(self, a: AggregateExpression, ctx, perm,
+                   suffix: Optional[str] = None):
+        """Column holding this aggregate's input (already sorted via perm)."""
+        if self.mode == AggregateMode.FINAL:
+            # inputs are the partial buffers by position in child schema
+            name = a.result_name + (suffix or "")
+            names = self.child_schema.field_names()
+            ord_ = names.index(name)
+            c = ctx.batch.columns[ord_]
+        else:
+            if a.child is None:
+                c = DeviceColumn(T.LONG,
+                                 jnp.ones(ctx.batch.capacity, jnp.bool_),
+                                 data=jnp.ones(ctx.batch.capacity, jnp.int64))
+            else:
+                c = a.child.eval_tpu(ctx)
+        return c if perm is None else _gather_col(c, perm)
+
+    def _eval_agg(self, a: AggregateExpression, fields, ctx, perm, seg,
+                  mask_sorted, cap, group_valid,
+                  nseg: int = None) -> List[DeviceColumn]:
+        nseg = cap if nseg is None else nseg
+        mode = self.mode
+        func = a.func
+        if func == "count_star":
+            func = "count"
+        out = []
+        if func == "avg":
+            if mode == AggregateMode.PARTIAL:
+                c = self._input_col(a, ctx, perm)
+                sum_f, cnt_f = fields
+                s, has = SEG.seg_sum(_sum_input(c, sum_f.dataType),
+                                     c.validity & mask_sorted, seg, nseg)
+                cnt = SEG.seg_count(c.validity & mask_sorted, seg, nseg)
+                out.append(DeviceColumn(sum_f.dataType, group_valid & has, data=s))
+                out.append(DeviceColumn(cnt_f.dataType, group_valid, data=cnt))
+                return out
+            if mode == AggregateMode.FINAL:
+                cs = self._input_col(a, ctx, perm, "_sum")
+                cc = self._input_col(a, ctx, perm, "_count")
+                s, _ = SEG.seg_sum(cs.data, cs.validity & mask_sorted, seg, nseg)
+                n, _ = SEG.seg_sum(cc.data, cc.validity & mask_sorted, seg, nseg)
+            else:
+                c = self._input_col(a, ctx, perm)
+                s, _ = SEG.seg_sum(_sum_input(c, None),
+                                   c.validity & mask_sorted, seg, nseg)
+                n = SEG.seg_count(c.validity & mask_sorted, seg, nseg)
+            (f,) = fields
+            nz = n > 0
+            if isinstance(f.dataType, T.DecimalType):
+                in_scale = (a.child.dataType.scale
+                            if a.child is not None else 0)
+                shift = f.dataType.scale - in_scale
+                num = s * (10 ** min(max(shift, 0), 18))
+                den = jnp.where(nz, n, 1)
+                q = num // den
+                rem = num - q * den
+                q = q + jnp.where((rem != 0) & (num < 0), 1, 0)
+                rem2 = num - q * den
+                half_up = (jnp.abs(rem2) * 2 >= den) & (rem2 != 0)
+                q = q + jnp.where(half_up, jnp.sign(num), 0)
+                out.append(DeviceColumn(f.dataType, group_valid & nz, data=q))
+            else:
+                avg = s.astype(jnp.float64) / jnp.where(nz, n, 1)
+                out.append(DeviceColumn(T.DOUBLE, group_valid & nz, data=avg))
+            return out
+        (f,) = fields
+        if func == "count":
+            c = self._input_col(a, ctx, perm)
+            if mode == AggregateMode.FINAL:
+                s, _ = SEG.seg_sum(c.data, c.validity & mask_sorted, seg, nseg)
+                cnt = s
+            else:
+                cnt = SEG.seg_count(c.validity & mask_sorted, seg, nseg)
+            out.append(DeviceColumn(T.LONG, group_valid, data=cnt))
+            return out
+        c = self._input_col(a, ctx, perm)
+        validity = c.validity & mask_sorted
+        if func == "sum":
+            s, has = SEG.seg_sum(_sum_input(c, f.dataType), validity, seg, nseg)
+            out.append(DeviceColumn(f.dataType, group_valid & has,
+                                    data=s.astype(T.storage_dtype(f.dataType))))
+            return out
+        if func in ("min", "max"):
+            isf = _is_float(f.dataType)
+            if c.is_string:
+                return [self._minmax_string(c, func, seg, validity, cap,
+                                            group_valid, f, nseg)]
+            fn = SEG.seg_min if func == "min" else SEG.seg_max
+            m, has = fn(c.data, validity, seg, nseg, isf)
+            out.append(DeviceColumn(f.dataType, group_valid & has,
+                                    data=m.astype(T.storage_dtype(f.dataType))
+                                    if not isinstance(f.dataType, T.BooleanType)
+                                    else m))
+            return out
+        if func in ("first", "last"):
+            idx_fn = SEG.seg_first_index if func == "first" else _seg_last_index
+            idx = idx_fn(seg, mask_sorted, nseg)
+            g = _gather_col(c, jnp.clip(idx, 0, cap - 1))
+            out.append(DeviceColumn(f.dataType, g.validity & group_valid,
+                                    data=g.data, chars=g.chars,
+                                    lengths=g.lengths))
+            return out
+        raise NotImplementedError(f"aggregate {func}")
+
+    def _minmax_string(self, c: DeviceColumn, func, seg, validity, cap,
+                       group_valid, f, nseg):
+        """min/max on strings: argmin over packed key words per segment."""
+        words = _column_key_words(c)
+        # build a composite: use first word as primary ordering; resolve ties
+        # via iterative refinement is complex — instead sort-based: rows are
+        # already sorted by GROUP key, not value; do an argmin via two-pass
+        # lexicographic reduction over words.
+        n = c.capacity
+        best = jnp.arange(n, dtype=jnp.int32)
+        # iterative: compute rank by sorting (value words, index) within seg
+        keyseq = [seg.astype(jnp.int64)]
+        for w in words:
+            w2 = jnp.where(validity, w if func == "min" else ~w, jnp.int64(2**62))
+            keyseq.append(w2)
+        perm2 = jax.lax.sort(tuple(keyseq) + (best,),
+                             num_keys=len(keyseq), is_stable=True)[-1]
+        # after sort by (seg, value): first row of each seg = min (or max)
+        seg_sorted = seg[perm2]
+        first = SEG.seg_first_index(seg_sorted, jnp.ones(n, jnp.bool_), nseg)
+        take = perm2[jnp.clip(first, 0, n - 1)]
+        g = _gather_col(c, take)
+        has = jax.ops.segment_sum(validity.astype(jnp.int32), seg,
+                                  num_segments=nseg) > 0
+        return DeviceColumn(f.dataType, group_valid & has & g.validity,
+                            chars=g.chars, lengths=g.lengths)
+
+    # -- global (no grouping keys) -------------------------------------
+    def _global_agg(self, ctx, batch):
+        """No grouping keys: a single-segment reduction (XLA lowers this to
+        a plain tree-reduce; no sort, no scatter)."""
+        mask = batch.row_mask
+        perm = None  # no sort needed for a single segment
+        seg = jnp.where(mask, 0, 1).astype(jnp.int32)  # padding dropped
+        group_valid = jnp.ones(1, jnp.bool_)
+        out_cols: List[DeviceColumn] = []
+        for a, f in zip(self.aggregates, self._agg_fields()):
+            out_cols.extend(self._eval_agg(a, f, ctx, perm, seg, mask,
+                                           batch.capacity, group_valid,
+                                           nseg=1))
+        return tuple(out_cols), jnp.int32(1)
+
+
+def _sum_input(c: DeviceColumn, out_dtype):
+    if _is_float(c.dtype) or (out_dtype is not None and _is_float(out_dtype)):
+        return c.data.astype(jnp.float64)
+    return c.data.astype(jnp.int64)
+
+
+def _seg_last_index(seg, row_mask, num_segments):
+    n = seg.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    v = jnp.where(row_mask, iota, -1)
+    return jax.ops.segment_max(v, seg, num_segments=num_segments)
+
+
+def _gather_col(c: DeviceColumn, idx) -> DeviceColumn:
+    if c.is_string:
+        return DeviceColumn(c.dtype, c.validity[idx], chars=c.chars[idx],
+                            lengths=c.lengths[idx])
+    return DeviceColumn(c.dtype, c.validity[idx], data=c.data[idx])

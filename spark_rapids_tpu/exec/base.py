@@ -1,0 +1,104 @@
+"""TpuExec — base of all TPU operators.
+
+Reference analog: the GpuExec trait (SURVEY.md §1 L4):
+``internalDoExecuteColumnar(): RDD[ColumnarBatch]`` plus GpuMetrics.  Here an
+operator yields an iterator of device ColumnarBatches; device work happens in
+jit-compiled stage functions cached per shape bucket (see basic.py), so the
+per-batch Python cost is one dispatch.
+
+Metrics mirror the reference's standard names (GpuMetric / GpuTaskMetrics):
+opTime, numOutputRows, numOutputBatches, sortTime, joinTime, concatTime,
+semaphoreWaitTime, spillTime, retryCount — surfaced via .metrics and the
+explain output.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterator, List, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.config import METRICS_LEVEL, get_conf
+
+
+class TpuMetric:
+    ESSENTIAL = "ESSENTIAL"
+    MODERATE = "MODERATE"
+    DEBUG = "DEBUG"
+
+    def __init__(self, name: str, level: str = "MODERATE"):
+        self.name = name
+        self.level = level
+        self.value = 0
+
+    def add(self, v):
+        self.value += v
+
+    def __iadd__(self, v):
+        self.value += v
+        return self
+
+    class _Timer:
+        def __init__(self, metric):
+            self.metric = metric
+
+        def __enter__(self):
+            self.t0 = time.perf_counter_ns()
+            return self
+
+        def __exit__(self, *a):
+            self.metric.value += time.perf_counter_ns() - self.t0
+
+    def timed(self):
+        return TpuMetric._Timer(self)
+
+
+class TpuExec:
+    """Base TPU operator; children may be TpuExec or transition nodes."""
+
+    def __init__(self, children: Sequence["TpuExec"]):
+        self.children: List[TpuExec] = list(children)
+        self.metrics: Dict[str, TpuMetric] = {}
+        for m in ("opTime", "numOutputRows", "numOutputBatches"):
+            self.metrics[m] = TpuMetric(m)
+
+    def metric(self, name: str) -> TpuMetric:
+        if name not in self.metrics:
+            self.metrics[name] = TpuMetric(name)
+        return self.metrics[name]
+
+    @property
+    def output(self) -> T.StructType:
+        raise NotImplementedError
+
+    @property
+    def node_name(self) -> str:
+        return type(self).__name__
+
+    def describe(self) -> str:
+        return self.node_name
+
+    def pretty(self, indent: int = 0) -> str:
+        s = "  " * indent + self.describe()
+        for c in self.children:
+            s += "\n" + c.pretty(indent + 1)
+        return s
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        """Yield device batches; implemented by subclasses."""
+        raise NotImplementedError(self.node_name)
+
+    def _count_output(self, b: ColumnarBatch) -> ColumnarBatch:
+        self.metrics["numOutputRows"] += b.num_rows
+        self.metrics["numOutputBatches"] += 1
+        return b
+
+    def collect_metrics(self, into=None) -> Dict[str, int]:
+        into = into if into is not None else {}
+        for m in self.metrics.values():
+            into[f"{self.node_name}.{m.name}"] = (
+                into.get(f"{self.node_name}.{m.name}", 0) + m.value)
+        for c in self.children:
+            if isinstance(c, TpuExec):
+                c.collect_metrics(into)
+        return into

@@ -1,0 +1,303 @@
+"""Scan / Project / Filter / Union / Range + whole-stage fusion.
+
+Reference analog: basicPhysicalOperators.scala (GpuProjectExec, GpuFilterExec,
+GpuTieredProject, GpuUnionExec, GpuRangeExec).
+
+The TPU-first centerpiece is ``TpuStageExec``: a chain of narrow operators
+(project/filter) is traced ONCE into a single jitted function per shape
+bucket — XLA fuses every expression, the filter's mask/compaction, and the
+ANSI error-flag reductions into one executable.  This is strictly stronger
+than the reference's cuDF AST fusion (which only fuses simple expression
+trees); it is why `spark.rapids.tpu.wholeStageFusion.enabled` exists.
+
+Filters keep the row count on device until the stage boundary, where one
+host sync reads (count, error flags) back.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn, HostColumn
+from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.expr.base import EvalContext, Expression, SparkArithmeticException
+
+
+class _StageOp:
+    """One narrow op inside a fused stage."""
+
+    def apply(self, ctx: EvalContext, batch: ColumnarBatch) -> ColumnarBatch:
+        raise NotImplementedError
+
+    def out_schema(self, in_schema: T.StructType) -> T.StructType:
+        raise NotImplementedError
+
+
+class ProjectOp(_StageOp):
+    def __init__(self, exprs: List[Expression]):
+        self.exprs = exprs
+
+    def apply(self, ctx, batch):
+        ctx.batch = batch
+        cols = [e.eval_tpu(ctx) for e in self.exprs]
+        return ColumnarBatch(cols, batch.num_rows, self.out_schema(batch.schema))
+
+    def out_schema(self, in_schema):
+        return T.StructType([
+            T.StructField(e.name, e.dataType, e.nullable) for e in self.exprs])
+
+
+class FilterOp(_StageOp):
+    def __init__(self, condition: Expression):
+        self.condition = condition
+
+    def apply(self, ctx, batch):
+        from spark_rapids_tpu.ops.filterops import compact_columns
+
+        ctx.batch = batch
+        pred = self.condition.eval_tpu(ctx)
+        mask = pred.data & pred.validity & batch.row_mask
+        cols, count = compact_columns(mask, batch.columns)
+        return ColumnarBatch(cols, count, batch.schema)
+
+    def out_schema(self, in_schema):
+        return in_schema
+
+
+class FilterProjectOp(_StageOp):
+    """Filter immediately followed by Project, fused: projections evaluate on
+    the *uncompacted* batch (vector lanes are free), then only the projected
+    columns are compacted — halves scatter traffic vs compacting the full
+    input.  Not used under ANSI (a removed row must not raise)."""
+
+    def __init__(self, condition: Expression, exprs: List[Expression]):
+        self.condition = condition
+        self.exprs = exprs
+
+    def apply(self, ctx, batch):
+        from spark_rapids_tpu.ops.filterops import compact_columns
+
+        ctx.batch = batch
+        pred = self.condition.eval_tpu(ctx)
+        mask = pred.data & pred.validity & batch.row_mask
+        cols = [e.eval_tpu(ctx) for e in self.exprs]
+        out, count = compact_columns(mask, cols)
+        return ColumnarBatch(out, count, self.out_schema(batch.schema))
+
+    def out_schema(self, in_schema):
+        return T.StructType([
+            T.StructField(e.name, e.dataType, e.nullable) for e in self.exprs])
+
+
+def _fuse_filter_project(ops: List[_StageOp], ansi: bool) -> List[_StageOp]:
+    if ansi:
+        return ops
+    out: List[_StageOp] = []
+    i = 0
+    while i < len(ops):
+        if (i + 1 < len(ops) and isinstance(ops[i], FilterOp)
+                and isinstance(ops[i + 1], ProjectOp)):
+            out.append(FilterProjectOp(ops[i].condition, ops[i + 1].exprs))
+            i += 2
+        else:
+            out.append(ops[i])
+            i += 1
+    return out
+
+
+class TpuStageExec(TpuExec):
+    """A fused chain of narrow ops over one child."""
+
+    def __init__(self, ops: Sequence[_StageOp], child: TpuExec,
+                 ansi: bool = False):
+        super().__init__([child])
+        self.ops = _fuse_filter_project(list(ops), ansi)
+        self.ansi = ansi
+        self._jitted = None
+        self._out_schema = child.output
+        for op in self.ops:
+            self._out_schema = op.out_schema(self._out_schema)
+
+    @property
+    def output(self):
+        return self._out_schema
+
+    def describe(self):
+        names = "+".join(type(o).__name__.replace("Op", "") for o in self.ops)
+        return f"TpuStageExec[{names}]"
+
+    def _build(self, in_schema: T.StructType):
+        ops = self.ops
+        ansi = self.ansi
+
+        msgs_store: List[str] = []  # filled as a trace-time side effect
+
+        def fn(cols, num_rows):
+            batch = ColumnarBatch(list(cols), num_rows, in_schema)
+            ctx = EvalContext(batch, ansi=ansi)
+            for op in ops:
+                batch = op.apply(ctx, batch)
+            msgs_store.clear()
+            msgs_store.extend(m for _, m in ctx.error_flags)
+            flags = tuple(jnp.any(f) for f, _ in ctx.error_flags)
+            return batch.columns, jnp.asarray(batch.num_rows), flags
+
+        jitted = jax.jit(fn)
+
+        def run(batch: ColumnarBatch) -> ColumnarBatch:
+            cols, count, flags = jitted(
+                tuple(batch.columns), jnp.int32(batch.num_rows))
+            for f, m in zip(flags, list(msgs_store)):
+                if bool(f):
+                    raise SparkArithmeticException(m)
+            return ColumnarBatch(list(cols), int(count), self._out_schema)
+
+        return run
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        child = self.children[0]
+        for batch in child.execute_columnar():
+            if self._jitted is None:
+                self._jitted = self._build(batch.schema)
+            with self.metrics["opTime"].timed():
+                out = self._jitted(batch)
+            yield self._count_output(out)
+
+
+class TpuProjectExec(TpuStageExec):
+    def __init__(self, exprs: List[Expression], child: TpuExec,
+                 ansi: bool = False):
+        super().__init__([ProjectOp(exprs)], child, ansi)
+        self.exprs = exprs
+
+    def describe(self):
+        return ("TpuProject [" +
+                ", ".join(e.sql_string() for e in self.exprs) + "]")
+
+
+class TpuFilterExec(TpuStageExec):
+    def __init__(self, condition: Expression, child: TpuExec,
+                 ansi: bool = False):
+        super().__init__([FilterOp(condition)], child, ansi)
+        self.condition = condition
+
+    def describe(self):
+        return f"TpuFilter ({self.condition.sql_string()})"
+
+
+def fuse_stages(root: TpuExec) -> TpuExec:
+    """Collapse adjacent TpuStageExec chains (whole-stage fusion pass).
+
+    Reference analog: GpuTransitionOverrides' post-processing; here it turns
+    Project(Filter(Project(x))) into one jitted XLA program."""
+    root.children = [fuse_stages(c) for c in root.children]
+    if isinstance(root, TpuStageExec):
+        child = root.children[0]
+        if isinstance(child, TpuStageExec) and child.ansi == root.ansi:
+            merged = TpuStageExec(child.ops + root.ops, child.children[0],
+                                  root.ansi)
+            return fuse_stages(merged)
+    return root
+
+
+class TpuLocalTableScanExec(TpuExec):
+    def __init__(self, host_columns: List[HostColumn], schema: T.StructType,
+                 target_batch_rows: Optional[int] = None,
+                 cache_device: bool = False, cache_slot=None):
+        super().__init__([])
+        self.host_columns = host_columns
+        self._schema = schema
+        self.target_batch_rows = target_batch_rows
+        self.cache_device = cache_device
+        # cache lives on the plan node so it survives re-planning
+        self._slot = cache_slot if cache_slot is not None else self
+
+    @property
+    def output(self):
+        return self._schema
+
+    def execute_columnar(self):
+        cached = getattr(self._slot, "_device_cache", None)
+        if cached is not None:
+            for b in cached:
+                yield self._count_output(b)
+            return
+        if self.cache_device:
+            acc = []
+            for b in self._materialize():
+                acc.append(b)
+                yield b
+            self._slot._device_cache = acc
+            return
+        yield from self._materialize()
+
+    def _materialize(self):
+        n = self.host_columns[0].num_rows if self.host_columns else 0
+        step = self.target_batch_rows or max(n, 1)
+        names = self._schema.field_names()
+        for start in range(0, max(n, 1), step):
+            end = min(start + step, n)
+            if n == 0 and start > 0:
+                break
+            import numpy as np
+
+            chunk = []
+            for h in self.host_columns:
+                if h.is_string:
+                    chunk.append(HostColumn(h.dtype, h.validity[start:end],
+                                            chars=h.chars[start:end],
+                                            lengths=h.lengths[start:end]))
+                else:
+                    chunk.append(HostColumn(h.dtype, h.validity[start:end],
+                                            data=h.data[start:end]))
+            yield self._count_output(
+                ColumnarBatch.from_host_columns(chunk, names))
+            if n == 0:
+                break
+
+
+class TpuRangeExec(TpuExec):
+    """GpuRangeExec analog: generate id column on device."""
+
+    def __init__(self, start: int, end: int, step: int = 1,
+                 batch_rows: int = 1 << 20):
+        super().__init__([])
+        self.start, self.end, self.step = start, end, step
+        self.batch_rows = batch_rows
+
+    @property
+    def output(self):
+        return T.StructType([T.StructField("id", T.LONG, nullable=False)])
+
+    def execute_columnar(self):
+        total = max(0, -(-(self.end - self.start) // self.step))
+        from spark_rapids_tpu.columnar.column import round_up_bucket, DEFAULT_ROW_BUCKETS
+
+        emitted = 0
+        while emitted < total or (total == 0 and emitted == 0):
+            count = min(self.batch_rows, total - emitted)
+            cap = round_up_bucket(max(count, 1), DEFAULT_ROW_BUCKETS)
+            base = self.start + emitted * self.step
+            data = base + jnp.arange(cap, dtype=jnp.int64) * self.step
+            validity = jnp.arange(cap) < count
+            col = DeviceColumn(T.LONG, validity, data=data)
+            yield self._count_output(
+                ColumnarBatch([col], count, self.output))
+            emitted += count
+            if total == 0:
+                break
+
+
+class TpuUnionExec(TpuExec):
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def execute_columnar(self):
+        for c in self.children:
+            for b in c.execute_columnar():
+                yield self._count_output(b)

@@ -1,0 +1,61 @@
+"""TpuCoalesceBatchesExec — batch concatenation to a size goal.
+
+Reference analog: GpuCoalesceBatches / CoalesceGoal / RequireSingleBatch +
+GpuShuffleCoalesceExec (SURVEY.md §2.3): small batches are concatenated up to
+``spark.rapids.sql.batchSizeBytes`` before expensive operators.  On TPU this
+additionally *re-buckets* row capacity and string widths so downstream ops
+compile against fewer shapes.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import TpuExec
+
+
+class CoalesceGoal:
+    def __init__(self, target_bytes: Optional[int] = None,
+                 require_single: bool = False):
+        self.target_bytes = target_bytes or (1 << 30)
+        self.require_single = require_single
+
+    @staticmethod
+    def require_single_batch() -> "CoalesceGoal":
+        return CoalesceGoal(require_single=True)
+
+
+class TpuCoalesceBatchesExec(TpuExec):
+    def __init__(self, goal: CoalesceGoal, child: TpuExec):
+        super().__init__([child])
+        self.goal = goal
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def describe(self):
+        g = "RequireSingleBatch" if self.goal.require_single else \
+            f"TargetSize({self.goal.target_bytes})"
+        return f"TpuCoalesceBatches {g}"
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        pending: List[ColumnarBatch] = []
+        pending_bytes = 0
+        with self.metric("concatTime").timed():
+            for b in self.children[0].execute_columnar():
+                if self.goal.require_single:
+                    pending.append(b)
+                    continue
+                nb = b.nbytes()
+                if pending and pending_bytes + nb > self.goal.target_bytes:
+                    yield self._flush(pending)
+                    pending, pending_bytes = [], 0
+                pending.append(b)
+                pending_bytes += nb
+        if pending:
+            yield self._flush(pending)
+
+    def _flush(self, pending: List[ColumnarBatch]) -> ColumnarBatch:
+        out = pending[0] if len(pending) == 1 else ColumnarBatch.concat(pending)
+        return self._count_output(out)

@@ -1,0 +1,158 @@
+"""TpuShuffleExchangeExec — partitioning + shuffle boundary.
+
+Reference analog: GpuShuffleExchangeExecBase + GpuPartitioning
+(SURVEY.md §2.4 Exchange, §2.7): slices each batch by partition id and hands
+the slices to the shuffle manager.  Partition ids are Spark-exact
+(murmur3-based pmod — ops/hashing.py) so a TPU stage can interoperate with
+CPU stages, exactly as the reference's GpuHashPartitioning matches Spark's
+Murmur3 partitioning.
+
+In-process execution pushes slices through the shuffle manager
+(shuffle/manager.py) which serializes batches in the concat-friendly layout
+(Kudo analog) or keeps them device-resident; on a mesh the ICI mode turns
+this into an XLA all-to-all (parallel/).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.expr.base import EvalContext
+from spark_rapids_tpu.ops.filterops import compact_columns
+from spark_rapids_tpu.ops.hashing import spark_partition_ids
+from spark_rapids_tpu.plan.nodes import (
+    HashPartitioning,
+    RangePartitioning,
+    RoundRobinPartitioning,
+    SinglePartitioning,
+)
+
+
+class TpuShuffleExchangeExec(TpuExec):
+    def __init__(self, partitioning, child: TpuExec, ansi: bool = False):
+        super().__init__([child])
+        self.partitioning = partitioning
+        self.ansi = ansi
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def describe(self):
+        return f"TpuShuffleExchange {self.partitioning.describe()}"
+
+    @property
+    def num_partitions(self) -> int:
+        return getattr(self.partitioning, "num_partitions", 1)
+
+    def partition_batch(self, batch: ColumnarBatch) -> List[ColumnarBatch]:
+        """Slice one batch into per-partition batches (device-resident).
+
+        Reference analog: GpuPartitioning.sliceInternalGpuOrCpu."""
+        p = self.partitioning
+        if isinstance(p, SinglePartitioning) or self.num_partitions == 1:
+            return [batch]
+        if isinstance(p, HashPartitioning):
+            ids = self._hash_ids(batch)
+        elif isinstance(p, RoundRobinPartitioning):
+            ids = (jnp.arange(batch.capacity, dtype=jnp.int32)
+                   % self.num_partitions)
+        elif isinstance(p, RangePartitioning):
+            ids = self._range_ids(batch)
+        else:
+            raise NotImplementedError(type(p).__name__)
+        out = []
+
+        def slice_fn(cols, ids, num_rows, pid):
+            b = ColumnarBatch(list(cols), num_rows, batch.schema)
+            keep = (ids == pid) & b.row_mask
+            cs, cnt = compact_columns(keep, b.columns)
+            return tuple(cs), cnt
+
+        if getattr(self, "_slice_jit", None) is None:
+            self._slice_jit = jax.jit(slice_fn)
+        for pid in range(self.num_partitions):
+            cols, cnt = self._slice_jit(tuple(batch.columns), ids,
+                                        jnp.int32(batch.num_rows),
+                                        jnp.int32(pid))
+            out.append(ColumnarBatch(list(cols), int(cnt), batch.schema))
+        return out
+
+    def _hash_ids(self, batch: ColumnarBatch):
+        def fn(cols, num_rows):
+            b = ColumnarBatch(list(cols), num_rows, batch.schema)
+            ctx = EvalContext(b, ansi=self.ansi)
+            key_cols = [k.eval_tpu(ctx) for k in self.partitioning.keys]
+            return spark_partition_ids(key_cols, self.num_partitions)
+
+        if getattr(self, "_ids_jit", None) is None:
+            self._ids_jit = jax.jit(fn)
+        return self._ids_jit(tuple(batch.columns), jnp.int32(batch.num_rows))
+
+    def _range_ids(self, batch: ColumnarBatch):
+        """Range partitioning via sampled bounds (GpuRangePartitioner).
+
+        Round-1 simplification: bounds from this batch's sorted sample."""
+        from spark_rapids_tpu.ops.sortkeys import sort_permutation
+
+        orders = self.partitioning.orders
+
+        def fn(cols, num_rows):
+            b = ColumnarBatch(list(cols), num_rows, batch.schema)
+            ctx = EvalContext(b, ansi=self.ansi)
+            key_cols = [e.eval_tpu(ctx) for e, _ in orders]
+            specs = [s for _, s in orders]
+            perm = sort_permutation(key_cols, specs, b.row_mask)
+            # rank of each row / rows-per-partition
+            cap = b.capacity
+            inv = jnp.zeros(cap, jnp.int32).at[perm].set(
+                jnp.arange(cap, dtype=jnp.int32))
+            per = jnp.maximum(
+                (num_rows + self.num_partitions - 1) // self.num_partitions, 1)
+            return jnp.clip(inv // per, 0, self.num_partitions - 1)
+
+        if getattr(self, "_range_jit", None) is None:
+            self._range_jit = jax.jit(fn)
+        return self._range_jit(tuple(batch.columns), jnp.int32(batch.num_rows))
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        """In-process shuffle: produce per-partition coalesced batches in
+        partition order (partition boundaries matter to downstream
+        per-partition operators once multi-chip execution is wired)."""
+        parts: List[List[ColumnarBatch]] = [
+            [] for _ in range(self.num_partitions)]
+        with self.metric("shuffleWriteTime").timed():
+            for b in self.children[0].execute_columnar():
+                for pid, pb in enumerate(self.partition_batch(b)):
+                    if pb.num_rows > 0:
+                        parts[pid].append(pb)
+        for pid in range(self.num_partitions):
+            if parts[pid]:
+                with self.metric("concatTime").timed():
+                    out = (parts[pid][0] if len(parts[pid]) == 1
+                           else ColumnarBatch.concat(parts[pid]))
+                yield self._count_output(out)
+
+
+class TpuBroadcastExchangeExec(TpuExec):
+    """GpuBroadcastExchangeExec analog: materialize + (on mesh) replicate."""
+
+    def __init__(self, child: TpuExec):
+        super().__init__([child])
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def execute_columnar(self):
+        batches = list(self.children[0].execute_columnar())
+        if not batches:
+            return
+        out = (batches[0] if len(batches) == 1
+               else ColumnarBatch.concat(batches))
+        yield self._count_output(out)

@@ -1,0 +1,428 @@
+"""TPU joins — sort-based gather-map equi-joins.
+
+Reference analog (SURVEY.md §2.4 Joins): GpuHashJoin / GpuShuffledHashJoinExec
+/ GpuBroadcastHashJoinExec / JoinGatherer / AbstractGpuJoinIterator, where
+cuDF produces gather maps that are materialized in size-bounded chunks.
+
+TPU-first redesign: the build side is compacted (valid keys only) and sorted
+by packed key words; probes binary-search it (vectorized multiword
+searchsorted — log2(n) lexicographic compare rounds, all rows in parallel).
+The gather-map materialization is the same two-index expansion cuDF uses
+(probe index from searchsorted over the pair-count prefix sum, build index
+by offset within the match run).  Everything is jitted; only the total pair
+count syncs to host (to pick the output capacity bucket) — the exact analog
+of the reference's JoinGatherer.getTotalRows sizing step.
+
+Sort-merge join at the plan level is converted to this shuffled-sort join —
+mirroring GpuSortMergeJoinMeta, which converts SMJ to shuffled-hash on GPU.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import (
+    DEFAULT_ROW_BUCKETS,
+    DeviceColumn,
+    round_up_bucket,
+)
+from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.expr.base import EvalContext, Expression
+from spark_rapids_tpu.ops.filterops import compact_columns, gather_columns
+from spark_rapids_tpu.ops.sortkeys import _column_key_words
+from spark_rapids_tpu.plan.nodes import JoinType
+
+
+def _lex_less(a_words: List[jax.Array], b_words: List[jax.Array],
+              or_equal: bool) -> jax.Array:
+    lt = jnp.zeros(a_words[0].shape, jnp.bool_)
+    eq = jnp.ones(a_words[0].shape, jnp.bool_)
+    for a, b in zip(a_words, b_words):
+        lt = lt | (eq & (a < b))
+        eq = eq & (a == b)
+    return lt | eq if or_equal else lt
+
+
+def _multiword_searchsorted(sorted_words: List[jax.Array], n_valid,
+                            query_words: List[jax.Array],
+                            side: str) -> jax.Array:
+    """For each query row, the insertion point into the sorted build keys."""
+    n = sorted_words[0].shape[0]
+    nq = query_words[0].shape[0]
+    lo = jnp.zeros(nq, jnp.int32)
+    hi = jnp.broadcast_to(n_valid.astype(jnp.int32), (nq,))
+    steps = max(1, int(n).bit_length())
+    for _ in range(steps):
+        mid = (lo + hi) // 2
+        midc = jnp.clip(mid, 0, n - 1)
+        mid_words = [w[midc] for w in sorted_words]
+        if side == "left":
+            go_right = _lex_less(mid_words, query_words, or_equal=False)
+        else:
+            go_right = _lex_less(mid_words, query_words, or_equal=True)
+        go_right = go_right & (mid < hi)
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(go_right, hi, mid)
+    return lo
+
+
+def _key_words_of(key_cols: List[DeviceColumn]) -> List[jax.Array]:
+    words: List[jax.Array] = []
+    for kc in key_cols:
+        words.extend(_column_key_words(kc))
+    return words
+
+
+class _SortedBuildSide:
+    """Build-side state: valid-key rows sorted by key words."""
+
+    def __init__(self, words, row_index, n_valid, batch):
+        self.words = words            # sorted key words (capacity,)
+        self.row_index = row_index    # original row per sorted pos
+        self.n_valid = n_valid        # device scalar
+        self.batch = batch            # the materialized build batch
+
+
+class _BaseTpuJoinExec(TpuExec):
+    def __init__(self, left: TpuExec, right: TpuExec,
+                 left_keys: List[Expression], right_keys: List[Expression],
+                 join_type: JoinType, condition: Optional[Expression],
+                 output_schema: T.StructType, ansi: bool = False):
+        super().__init__([left, right])
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.join_type = join_type
+        self.condition = condition
+        self._output = output_schema
+        self.ansi = ansi
+        self._jit_cache = {}
+
+    def _cached_jit(self, key, builder, **jit_kw):
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(builder, **jit_kw)
+        return self._jit_cache[key]
+
+    @property
+    def output(self):
+        return self._output
+
+    def describe(self):
+        keys = ", ".join(f"{l.sql_string()}={r.sql_string()}"
+                         for l, r in zip(self.left_keys, self.right_keys))
+        return f"{self.node_name} {self.join_type.value} [{keys}]"
+
+    # -- build side -----------------------------------------------------
+    def _prepare_build(self, batch: ColumnarBatch,
+                       keys: List[Expression]) -> _SortedBuildSide:
+        def fn(cols, num_rows):
+            b = ColumnarBatch(list(cols), num_rows, batch.schema)
+            ctx = EvalContext(b, ansi=self.ansi)
+            key_cols = [k.eval_tpu(ctx) for k in key_cols_src]
+            valid = b.row_mask
+            for kc in key_cols:
+                valid = valid & kc.validity
+            words = _key_words_of(key_cols)
+            # sort valid rows first by (is_invalid, words...)
+            inv = (~valid).astype(jnp.int64)
+            iota = jnp.arange(b.capacity, dtype=jnp.int32)
+            out = jax.lax.sort(tuple([inv] + words + [iota]),
+                               num_keys=1 + len(words), is_stable=True)
+            sorted_words = list(out[1:-1])
+            row_index = out[-1]
+            n_valid = jnp.sum(valid.astype(jnp.int32))
+            return sorted_words, row_index, n_valid
+
+        key_cols_src = keys
+        jitted = self._cached_jit("build", fn)
+        words, row_index, n_valid = jitted(tuple(batch.columns),
+                                           jnp.int32(batch.num_rows))
+        return _SortedBuildSide(words, row_index, n_valid, batch)
+
+    # -- probe ----------------------------------------------------------
+    def _probe_counts(self, build: _SortedBuildSide, batch: ColumnarBatch):
+        def fn(bwords, n_valid, cols, num_rows):
+            b = ColumnarBatch(list(cols), num_rows, batch.schema)
+            ctx = EvalContext(b, ansi=self.ansi)
+            key_cols = [k.eval_tpu(ctx) for k in self.left_keys]
+            valid = b.row_mask
+            for kc in key_cols:
+                valid = valid & kc.validity
+            qwords = _key_words_of(key_cols)
+            lo = _multiword_searchsorted(list(bwords), n_valid, qwords, "left")
+            hi = _multiword_searchsorted(list(bwords), n_valid, qwords, "right")
+            counts = jnp.where(valid, hi - lo, 0)
+            total = jnp.sum(counts.astype(jnp.int64))
+            unmatched = valid_probe_unmatched = b.row_mask & (counts == 0)
+            n_unmatched = jnp.sum(unmatched.astype(jnp.int64))
+            return lo, counts, total, unmatched, n_unmatched
+
+        jitted = self._cached_jit("probe", fn)
+        return jitted(tuple(build.words), build.n_valid,
+                      tuple(batch.columns), jnp.int32(batch.num_rows))
+
+    # -- materialization (gather maps -> output batch) -------------------
+    def _materialize(self, build: _SortedBuildSide, probe: ColumnarBatch,
+                     lo, counts, total_host: int, unmatched,
+                     with_unmatched_probe: bool, unmatched_host: int):
+        out_rows = total_host + (unmatched_host if with_unmatched_probe else 0)
+        out_cap = round_up_bucket(max(out_rows, 1), DEFAULT_ROW_BUCKETS)
+
+        def fn(bwords_row_index, b_cols, p_cols, lo, counts, unmatched,
+               total, nrows):
+            n = counts.shape[0]
+            offsets = jnp.cumsum(counts.astype(jnp.int64))
+            excl = offsets - counts.astype(jnp.int64)
+            j = jnp.arange(out_cap, dtype=jnp.int64)
+            probe_row = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32)
+            probe_row = jnp.clip(probe_row, 0, n - 1)
+            k = j - excl[probe_row]
+            build_pos = lo[probe_row].astype(jnp.int64) + k
+            build_row = bwords_row_index[
+                jnp.clip(build_pos, 0, n - 1).astype(jnp.int32)]
+            in_pairs = j < total
+            probe_idx = jnp.where(in_pairs, probe_row, 0)
+            if with_unmatched_probe:
+                # unmatched probe rows appended after the pairs
+                um_positions = jnp.cumsum(unmatched.astype(jnp.int64)) - 1
+                um_slot = total + um_positions
+                scatter_to = jnp.where(unmatched, um_slot,
+                                       out_cap).astype(jnp.int64)
+                probe_idx_full = jnp.zeros(out_cap, jnp.int32).at[
+                    jnp.clip(scatter_to, 0, out_cap)].set(
+                    jnp.arange(n, dtype=jnp.int32), mode="drop")
+                probe_idx = jnp.where(in_pairs, probe_row, probe_idx_full)
+            row_valid = j < nrows
+            lcols = gather_columns(probe_idx, row_valid, list(p_cols))
+            bcols = gather_columns(
+                jnp.where(in_pairs, build_row, 0), row_valid & in_pairs,
+                list(b_cols))
+            return lcols, bcols
+
+        jitted = self._cached_jit(("mat", out_cap, with_unmatched_probe), fn)
+        lcols, bcols = jitted(build.row_index,
+                              tuple(build.batch.columns),
+                              tuple(probe.columns), lo, counts, unmatched,
+                              jnp.int64(total_host), jnp.int64(out_rows))
+        return lcols, bcols, out_rows
+
+    def _semi_anti(self, probe: ColumnarBatch, counts, anti: bool):
+        def fn(cols, counts, num_rows):
+            b = ColumnarBatch(list(cols), num_rows, probe.schema)
+            keep = (counts == 0) if anti else (counts > 0)
+            keep = keep & b.row_mask
+            out, cnt = compact_columns(keep, b.columns)
+            return tuple(out), cnt
+
+        jitted = self._cached_jit(("semi", anti), fn)
+        out, cnt = jitted(tuple(probe.columns), counts,
+                          jnp.int32(probe.num_rows))
+        return ColumnarBatch(list(out), int(cnt), self._output)
+
+    # -- driver ----------------------------------------------------------
+    def _build_batch(self) -> ColumnarBatch:
+        batches = list(self._build_child().execute_columnar())
+        if not batches:
+            from spark_rapids_tpu.columnar.batch import empty_batch
+
+            return empty_batch(self._build_child().output)
+        return (batches[0] if len(batches) == 1
+                else ColumnarBatch.concat(batches))
+
+    def _build_child(self) -> TpuExec:
+        return self.children[1]
+
+    def _probe_child(self) -> TpuExec:
+        return self.children[0]
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        jt = self.join_type
+        if jt == JoinType.RIGHT_OUTER:
+            yield from self._execute_right_outer()
+            return
+        build_batch = self._build_batch()
+        with self.metric("buildTime").timed():
+            build = self._prepare_build(build_batch, self.right_keys)
+        matched_build_any = None
+        if jt == JoinType.FULL_OUTER:
+            matched_build_any = jnp.zeros(build_batch.capacity, jnp.bool_)
+        for probe in self._probe_child().execute_columnar():
+            with self.metric("joinTime").timed():
+                lo, counts, total, unmatched, n_um = self._probe_counts(
+                    build, probe)
+                total_host = int(total)
+                if jt == JoinType.LEFT_SEMI:
+                    yield self._count_output(
+                        self._semi_anti(probe, counts, anti=False))
+                    continue
+                if jt == JoinType.LEFT_ANTI:
+                    yield self._count_output(
+                        self._semi_anti(probe, counts, anti=True))
+                    continue
+                with_um = jt in (JoinType.LEFT_OUTER, JoinType.FULL_OUTER)
+                um_host = int(n_um) if with_um else 0
+                if total_host + um_host == 0:
+                    continue
+                if jt == JoinType.FULL_OUTER:
+                    matched_build_any = matched_build_any | \
+                        self._covered_build_rows(build, lo, counts)
+                lcols, bcols, nrows = self._materialize(
+                    build, probe, lo, counts, total_host, unmatched,
+                    with_um, um_host)
+                out = ColumnarBatch(list(lcols) + list(bcols), nrows,
+                                    self._output)
+                out = self._apply_condition(out)
+            yield self._count_output(out)
+        if jt == JoinType.FULL_OUTER:
+            tail = self._unmatched_build_tail(build_batch, build,
+                                              matched_build_any)
+            if tail is not None:
+                yield self._count_output(tail)
+
+    def _covered_build_rows(self, build: _SortedBuildSide, lo, counts):
+        """bool per original build row: appeared in some pair (diff-array)."""
+        def fn(row_index, lo, counts):
+            n = row_index.shape[0]
+            diff = jnp.zeros(n + 1, jnp.int32)
+            has = counts > 0
+            start = jnp.where(has, lo, n)
+            end = jnp.where(has, lo + counts, n)
+            diff = diff.at[start].add(1, mode="drop")
+            diff = diff.at[end].add(-1, mode="drop")
+            covered_sorted = jnp.cumsum(diff[:-1]) > 0
+            out = jnp.zeros(n, jnp.bool_).at[row_index].set(
+                covered_sorted, mode="drop")
+            return out
+
+        return jax.jit(fn)(build.row_index, lo, counts)
+
+    def _unmatched_build_tail(self, build_batch, build, matched_any):
+        def fn(cols, matched, num_rows):
+            b = ColumnarBatch(list(cols), num_rows, build_batch.schema)
+            keep = b.row_mask & ~matched
+            out, cnt = compact_columns(keep, b.columns)
+            return tuple(out), cnt
+
+        out, cnt = jax.jit(fn)(tuple(build_batch.columns), matched_any,
+                               jnp.int32(build_batch.num_rows))
+        n = int(cnt)
+        if n == 0:
+            return None
+        # null left side
+        lfields = self._output.fields[: len(self._probe_child().output)]
+        lcols = []
+        cap = build_batch.capacity
+        for f in lfields:
+            if isinstance(f.dataType, T.StringType):
+                lcols.append(DeviceColumn(f.dataType,
+                                          jnp.zeros(cap, jnp.bool_),
+                                          chars=jnp.zeros((cap, 8), jnp.uint8),
+                                          lengths=jnp.zeros(cap, jnp.int32)))
+            else:
+                lcols.append(DeviceColumn(
+                    f.dataType, jnp.zeros(cap, jnp.bool_),
+                    data=jnp.zeros(cap, T.storage_dtype(f.dataType))))
+        return ColumnarBatch(lcols + list(out), n, self._output)
+
+    def _execute_right_outer(self):
+        """RIGHT OUTER = LEFT OUTER with sides swapped, columns reordered."""
+        swapped_schema = T.StructType(
+            list(self._build_child().output.fields)
+            + [T.StructField(f.name, f.dataType, True)
+               for f in self._probe_child().output.fields])
+        swapped = TpuShuffledSymmetricHashJoinExec(
+            self.children[1], self.children[0],
+            self.right_keys, self.left_keys,
+            JoinType.LEFT_OUTER, self.condition,
+            swapped_schema, self.ansi)
+        nl = len(self._build_child().output.fields)
+        for b in swapped.execute_columnar():
+            cols = b.columns[nl:] + b.columns[:nl]
+            # right-outer output: left cols (nullable) then right cols
+            reordered = T.StructType(
+                [T.StructField(f.name, f.dataType, True)
+                 for f in self._probe_child().output.fields]
+                + list(self._build_child().output.fields))
+            out = ColumnarBatch(cols, b.num_rows, reordered)
+            yield self._count_output(self._apply_condition(out))
+
+    def _apply_condition(self, batch: ColumnarBatch) -> ColumnarBatch:
+        if self.condition is None or self.join_type != JoinType.INNER:
+            return batch
+
+        def fn(cols, num_rows):
+            b = ColumnarBatch(list(cols), num_rows, self._output)
+            ctx = EvalContext(b, ansi=self.ansi)
+            pred = self.condition.eval_tpu(ctx)
+            keep = pred.data & pred.validity & b.row_mask
+            out, cnt = compact_columns(keep, b.columns)
+            return tuple(out), cnt
+
+        jitted = self._cached_jit("cond", fn)
+        out, cnt = jitted(tuple(batch.columns), jnp.int32(batch.num_rows))
+        return ColumnarBatch(list(out), int(cnt), self._output)
+
+
+class TpuShuffledSymmetricHashJoinExec(_BaseTpuJoinExec):
+    """Shuffled join (post-exchange).  Name mirrors the reference's newer
+    GpuShuffledSymmetricHashJoinExec; algorithm is the sorted-build probe."""
+
+
+class TpuBroadcastHashJoinExec(_BaseTpuJoinExec):
+    """Join against a broadcast build side (small table).  Single-process:
+    the build child is materialized whole, exactly like the broadcast table
+    the reference collects; on a mesh the build batch is replicated to every
+    device (parallel/bcast)."""
+
+
+class TpuCartesianProductExec(TpuExec):
+    """CROSS join: index-arithmetic expansion (GpuCartesianProductExec)."""
+
+    def __init__(self, left: TpuExec, right: TpuExec,
+                 output_schema: T.StructType,
+                 condition: Optional[Expression] = None, ansi: bool = False):
+        super().__init__([left, right])
+        self._output = output_schema
+        self.condition = condition
+        self.join_type = JoinType.INNER  # for _apply_condition reuse
+        self.ansi = ansi
+        self._jit_cache = {}
+
+    _cached_jit = _BaseTpuJoinExec._cached_jit
+    _apply_condition = _BaseTpuJoinExec._apply_condition
+
+    @property
+    def output(self):
+        return self._output
+
+    def execute_columnar(self):
+        right_batches = list(self.children[1].execute_columnar())
+        if not right_batches:
+            return
+        rbatch = (right_batches[0] if len(right_batches) == 1
+                  else ColumnarBatch.concat(right_batches))
+        for lb in self.children[0].execute_columnar():
+            total = lb.num_rows * rbatch.num_rows
+            if total == 0:
+                continue
+            out_cap = round_up_bucket(total, DEFAULT_ROW_BUCKETS)
+
+            def fn(lcols, rcols, nright, total):
+                j = jnp.arange(out_cap, dtype=jnp.int64)
+                li = (j // nright).astype(jnp.int32)
+                ri = (j % nright).astype(jnp.int32)
+                valid = j < total
+                lo = gather_columns(li, valid, list(lcols))
+                ro = gather_columns(ri, valid, list(rcols))
+                return tuple(lo + ro)
+
+            jitted = self._cached_jit(("cart", out_cap), fn)
+            cols = jitted(tuple(lb.columns), tuple(rbatch.columns),
+                          jnp.int64(rbatch.num_rows), jnp.int64(total))
+            out = ColumnarBatch(list(cols), total, self._output)
+            if self.condition is not None:
+                out = self._apply_condition(out)
+            yield self._count_output(out)

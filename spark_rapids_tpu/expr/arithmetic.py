@@ -106,9 +106,12 @@ def _int_as_decimal(t: T.DataType) -> T.DecimalType:
 
 def _decimal_bound_check(ctx, data, dt: T.DecimalType, validity, ansi: bool,
                          op: str, extra_invalid=None):
-    """null-out (legacy) / flag (ANSI) results beyond 10^precision."""
+    """null-out (legacy) / flag (ANSI) results beyond 10^precision.
+
+    precision>=19 exceeds int64 storage; the effective bound is then the
+    int64 range itself (callers must detect intermediate wraps separately)."""
     if dt.precision >= 19:
-        bound_ok = jnp.ones_like(validity)
+        bound_ok = (data < jnp.int64(2 ** 63 - 1)) & (data > jnp.int64(-(2 ** 63) + 1))
     else:
         bound = _pow10_i64(dt.precision)
         bound_ok = (data < bound) & (data > -bound)
@@ -240,22 +243,24 @@ class Divide(BinaryArithmetic):
         validity = validity & ~div_by_zero
         # target scale: s; numerator scaled to s + rt.scale then HALF_UP
         shift = dt.scale - lt.scale + rt.scale
-        num = l.data * _pow10_i64(max(shift, 0))
+        num_scale = _pow10_i64(max(shift, 0))
+        # int64 intermediate overflow: |l| * 10^shift must fit
+        num_limit = (2 ** 63 - 1) // num_scale
+        num_over = jnp.abs(l.data) > num_limit
+        if ctx.ansi:
+            ctx.add_error(num_over & validity, "decimal divide overflow (ANSI)")
+        validity = validity & ~num_over
+        num = jnp.where(num_over, 0, l.data) * num_scale
         den = jnp.where(div_by_zero, 1, r.data) * _pow10_i64(max(-shift, 0))
+        half = jnp.abs(den)
+        sign = jnp.where((num < 0) ^ (den < 0), -1, 1)
+        # truncate toward zero (jnp // floors), then HALF_UP away from zero
         q = num // den
         rem = num - q * den
-        # Spark HALF_UP rounding on the quotient
-        half = jnp.abs(den)
-        round_away = (jnp.abs(rem) * 2 >= half) & (rem != 0)
-        sign = jnp.where((num < 0) ^ (den < 0), -1, 1)
-        data = q + jnp.where(round_away, sign, 0)
-        # python-floor-div vs truncation: floor differs for negatives
-        # correct truncation-toward-zero first:
-        trunc_fix = jnp.where((rem != 0) & ((num < 0) ^ (den < 0)), 1, 0)
-        data = q + trunc_fix
-        rem2 = num - data * den
+        q = q + jnp.where((rem != 0) & ((num < 0) ^ (den < 0)), 1, 0)
+        rem2 = num - q * den
         round_away = (jnp.abs(rem2) * 2 >= half) & (rem2 != 0)
-        data = data + jnp.where(round_away, sign, 0)
+        data = q + jnp.where(round_away, sign, 0)
         validity = _decimal_bound_check(ctx, data, dt, validity, ctx.ansi, "divide")
         return DeviceColumn(dt, validity, data=data)
 
@@ -394,8 +399,10 @@ class Pmod(BinaryArithmetic):
             ctx.add_error(zero & l.validity & r.validity,
                           "division by zero (ANSI)")
         den = jnp.where(zero, 1, r.data)
-        m = l.data % den  # floored mod
-        data = jnp.where((m != 0) & ((m < 0) != (den < 0)), m + den, m)
-        # floored mod already has sign of divisor; pmod wants value in [0,|b|)
-        data = jnp.where(data < 0, data + jnp.abs(den), data)
+        # Spark Pmod: r = a % n (Java truncated); if r < 0 then (r + n) % n
+        # — note the sign of a NEGATIVE divisor is preserved.
+        m = l.data - _trunc_div(l.data, den) * den
+        adjusted = m + den
+        adjusted = adjusted - _trunc_div(adjusted, den) * den
+        data = jnp.where(m < 0, adjusted, m)
         return DeviceColumn(self.dataType, validity, data=data)

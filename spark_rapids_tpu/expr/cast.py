@@ -142,14 +142,17 @@ def _fp_to_int(ctx, c, src, dst, ansi):
     if ansi:
         bad = nan | (tr < mn) | (tr > mx)
         ctx.add_error(bad & c.validity, f"cast overflow to {dst} (ANSI)")
-    # Java: (long) saturates, then narrowing wraps
-    lmin, lmax = float(_I_MIN[T.LongType]), float(_I_MAX[T.LongType])
-    as_long = jnp.where(nan, 0,
-                        jnp.clip(tr, lmin, lmax).astype(jnp.int64))
-    data = as_long.astype(T.storage_dtype(dst))
-    if type(dst) is not T.LongType:
-        # Spark truncates via (int)/(short)/(byte) of the long: wrap is fine
-        pass
+    # Java: (long) saturates, then narrowing wraps.  2^63-1 is not
+    # representable as a double (rounds to 2^63, which wraps on convert),
+    # so saturate explicitly by comparison.
+    lmax_f = 9.223372036854775808e18  # == 2^63 exactly as a double
+    safe = jnp.clip(tr, -9.2233720368547748e18, 9.2233720368547748e18)
+    as_long = jnp.where(
+        nan, 0,
+        jnp.where(tr >= lmax_f, jnp.int64(_I_MAX[T.LongType]),
+                  jnp.where(tr < -lmax_f, jnp.int64(_I_MIN[T.LongType]),
+                            safe.astype(jnp.int64))))
+    data = as_long.astype(T.storage_dtype(dst))  # narrowing wraps like Java
     return DeviceColumn(dst, c.validity, data=data)
 
 
@@ -244,15 +247,15 @@ _MAX_I64_DIGITS = 19
 
 
 def _digits_of(absval, ndig_max):
-    """(n,) int64 -> (n, ndig_max) uint8 ASCII digits, most significant first,
-    plus (n,) count of significant digits (>=1)."""
-    n = absval.shape[0]
-    pows = jnp.asarray([10 ** i for i in range(ndig_max)], jnp.int64)
-    # digit i (from least significant): (v // 10^i) % 10
-    ds = (absval[:, None] // pows[None, :]) % 10
-    ndig = jnp.sum(absval[:, None] >= pows[None, :], axis=1)
+    """(n,) uint64/int64 magnitudes -> (n, ndig_max) digits (at 10^i) plus
+    (n,) significant digit count (>=1).  uint64 input handles 2^63
+    (|Long.MIN_VALUE|)."""
+    work = absval.astype(jnp.uint64)
+    pows = jnp.asarray([10 ** i for i in range(ndig_max)], jnp.uint64)
+    ds = (work[:, None] // pows[None, :]) % jnp.uint64(10)
+    ndig = jnp.sum(work[:, None] >= pows[None, :], axis=1)
     ndig = jnp.maximum(ndig, 1)
-    return ds, ndig  # ds[:, i] = digit at 10^i
+    return ds.astype(jnp.int64), ndig.astype(jnp.int64)  # ds[:, i] = digit at 10^i
 
 
 def _emit_int_string(absval, neg, ndig_max, width):
@@ -272,13 +275,16 @@ def _emit_int_string(absval, neg, ndig_max, width):
     return chars.astype(jnp.uint8), lengths.astype(jnp.int32)
 
 
+def _magnitude_u64(x_i64):
+    """|x| as uint64 — exact for Long.MIN_VALUE (2^63)."""
+    u = x_i64.astype(jnp.int64).view(jnp.uint64)
+    return jnp.where(x_i64 < 0, jnp.uint64(0) - u, u)
+
+
 def _int_to_string(ctx, c, src, dst, ansi):
     width = 20
     neg = c.data < 0
-    absval = jnp.where(neg, -c.data.astype(jnp.int64), c.data.astype(jnp.int64))
-    # int64 min edge: -(-2^63) wraps; handle by unsigned trick
-    absval = jnp.where(c.data.astype(jnp.int64) == _I_MIN[T.LongType],
-                       jnp.int64(_I_MAX[T.LongType]), absval)  # approx; exact fix below
+    absval = _magnitude_u64(c.data)
     chars, lengths = _emit_int_string(absval, neg, _MAX_I64_DIGITS, width)
     return DeviceColumn(T.STRING, c.validity, chars=chars, lengths=lengths)
 
@@ -298,11 +304,11 @@ def _dec_to_string(ctx, c, src: T.DecimalType, dst, ansi):
     """Spark: unscaled/10^s with exactly s fractional digits."""
     s = src.scale
     neg = c.data < 0
-    absval = jnp.abs(c.data.astype(jnp.int64))
+    absval = _magnitude_u64(c.data)
     if s == 0:
         return _int_to_string(ctx, c, src, dst, ansi)
-    intpart = absval // _p10(s)
-    frac = absval % _p10(s)
+    intpart = absval // jnp.uint64(_p10(s))
+    frac = absval % jnp.uint64(_p10(s))
     width = _MAX_I64_DIGITS + s + 3
     ds_int, ndig_int = _digits_of(intpart, _MAX_I64_DIGITS)
     ds_frac, _ = _digits_of(frac, s)
@@ -428,16 +434,21 @@ def _string_to_int(ctx, c, src, dst, ansi):
     all_digits = jnp.all(~digit_active | is_digit, axis=1)
     ndig = last - dig_start + 1
     valid_parse = any_nonws & all_digits & (ndig >= 1) & (ndig <= 19)
-    # value = sum digit * 10^(last - pos)
+    # magnitude = sum digit * 10^(last - pos), in uint64 (10^19-1 fits)
     exp = last[:, None] - pos
     p10 = jnp.where((exp >= 0) & (exp < 19) & digit_active,
-                    jnp.asarray([10 ** i for i in range(19)] + [0] * 1,
-                                jnp.int64)[jnp.clip(exp, 0, 19)], 0)
-    val = jnp.sum(jnp.where(digit_active & is_digit,
-                            (ch - ord("0")).astype(jnp.int64) * p10, 0), axis=1)
-    val = jnp.where(neg, -val, val)
+                    jnp.asarray([10 ** i for i in range(19)] + [0],
+                                jnp.uint64)[jnp.clip(exp, 0, 19)],
+                    jnp.uint64(0))
+    mag = jnp.sum(jnp.where(digit_active & is_digit,
+                            (ch - ord("0")).astype(jnp.uint64) * p10,
+                            jnp.uint64(0)), axis=1)
+    # fits signed 64? positive <= 2^63-1, negative magnitude <= 2^63
+    fits_i64 = jnp.where(neg, mag <= jnp.uint64(2 ** 63),
+                         mag <= jnp.uint64(2 ** 63 - 1))
+    val = jnp.where(neg, jnp.uint64(0) - mag, mag).view(jnp.int64)
     mn, mx = _I_MIN[type(dst)], _I_MAX[type(dst)]
-    in_range = (val >= mn) & (val <= mx)
+    in_range = fits_i64 & (val >= mn) & (val <= mx)
     ok = valid_parse & in_range
     if ansi:
         ctx.add_error(~ok & c.validity, f"invalid cast string->{dst} (ANSI)")

@@ -202,6 +202,8 @@ class Greatest(Expression):
         self._nullable = all(c.nullable for c in self.children)
 
     def _pick(self, a, b):
+        # NaN is the greatest value in Spark's ordering; jnp.maximum
+        # propagates NaN, which is exactly "NaN wins"
         return jnp.maximum(a, b)
 
     def do_columnar_eval(self, ctx, cols):
@@ -219,4 +221,8 @@ class Greatest(Expression):
 
 class Least(Greatest):
     def _pick(self, a, b):
+        # least must IGNORE NaN (NaN is greatest): min(NaN, x) = x
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            return jnp.where(jnp.isnan(a), b,
+                             jnp.where(jnp.isnan(b), a, jnp.minimum(a, b)))
         return jnp.minimum(a, b)

@@ -297,13 +297,17 @@ class In(Expression):
         self._nullable = True
 
     def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.expr.base import Literal
+
         v = cols[0]
         cands = cols[1:]
         any_match = jnp.zeros(v.capacity, jnp.bool_)
-        any_null_cand = False
-        for c in cands:
-            if not bool(jnp.any(c.validity)):
-                any_null_cand = True
+        # null-ness of candidates is a plan-time fact (literals)
+        any_null_cand = any(
+            isinstance(c, Literal) and c.value is None
+            for c in self.children[1:])
+        for expr, c in zip(self.children[1:], cands):
+            if isinstance(expr, Literal) and expr.value is None:
                 continue
             if v.is_string:
                 _, eq = string_compare(v, c)

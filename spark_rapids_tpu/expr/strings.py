@@ -84,12 +84,15 @@ class Substring(Expression):
         c, p, ln = cols
         n = c.lengths
         pos = p.data.astype(jnp.int32)
-        # Spark: pos>0 -> 1-based; pos<0 -> from end; pos==0 -> treated as 1
-        start = jnp.where(pos > 0, pos - 1,
-                          jnp.where(pos < 0, jnp.maximum(n + pos, 0), 0))
-        start = jnp.minimum(start, n)
+        # Spark substringSQL: pos>0 -> 1-based; pos<0 -> from end (may land
+        # before the start — the window is [start, start+len) computed on the
+        # UNclamped start, then clipped, so a negative start eats length)
+        start0 = jnp.where(pos > 0, pos - 1,
+                           jnp.where(pos < 0, n + pos, 0))
         want = jnp.maximum(ln.data.astype(jnp.int32), 0)
-        out_len = jnp.minimum(want, n - start)
+        end0 = start0 + want
+        start = jnp.clip(start0, 0, n)
+        out_len = jnp.maximum(jnp.clip(end0, 0, n) - start, 0)
         width = c.width
         idx = start[:, None] + jnp.arange(width)[None, :]
         take = jnp.arange(width)[None, :] < out_len[:, None]

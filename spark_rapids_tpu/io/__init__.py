@@ -1,0 +1,1 @@
+from spark_rapids_tpu.io.scan import TpuFileSourceScanExec  # noqa: F401

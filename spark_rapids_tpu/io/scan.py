@@ -1,0 +1,154 @@
+"""TPU file scans — Parquet/CSV/JSON readers with the reference's 3 modes.
+
+Reference analog (SURVEY.md §2.6): GpuParquetScan + GpuMultiFileReader with
+PERFILE / COALESCING / MULTITHREADED reader types, host-side footer parsing
+and row-group pruning with predicate pushdown, then device decode.
+
+TPU adaptation: the host decode stage uses pyarrow (footer parse, row-group
+pruning, predicate pushdown, dictionary/RLE decode) on background threads —
+playing the role of the reference's host-side fetch+filter threads — and the
+"device decode" step is the host->HBM upload into padded columns.  A Pallas
+on-device Parquet decode (dictionary/RLE/bit-pack) is the planned follow-up,
+mirroring how the reference moved decode from host to cuDF kernels
+(BASELINE north-star note in SURVEY.md §2.10 item 9).
+
+Reader mode selection matches the reference:
+  * PERFILE       — one file at a time, simple.
+  * COALESCING    — many small files/row-groups stitched into one batch
+                    before upload (fewer, larger HBM transfers).
+  * MULTITHREADED — a host thread pool fetches/decodes files ahead while
+    the device consumes (cloud-storage latency hiding).
+  * AUTO          — MULTITHREADED for >1 file else COALESCING.
+"""
+from __future__ import annotations
+
+import concurrent.futures as cf
+from typing import Iterator, List, Optional
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import HostColumn
+from spark_rapids_tpu.config import (
+    MAX_READER_BATCH_SIZE_ROWS,
+    PARQUET_MULTITHREAD_READ_NUM_THREADS,
+    PARQUET_READER_TYPE,
+    TpuConf,
+)
+from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.plan.nodes import FileSourceScan
+
+
+def _filters_to_arrow(pushed) -> Optional[list]:
+    """Convert pushed-down predicates to pyarrow filter tuples (row-group
+    pruning; ParquetFileFilterHandler analog).  Conservative: only simple
+    col-op-literal comparisons are pushed; everything else is re-checked by
+    the TpuFilterExec above the scan anyway."""
+    from spark_rapids_tpu.expr import base as E
+    from spark_rapids_tpu.expr import predicates as P
+
+    out = []
+    for f in pushed or []:
+        try:
+            op_map = {P.EqualTo: "==", P.LessThan: "<",
+                      P.LessThanOrEqual: "<=", P.GreaterThan: ">",
+                      P.GreaterThanOrEqual: ">="}
+            op = op_map.get(type(f))
+            if op is None:
+                continue
+            l, r = f.children
+            if isinstance(l, E.AttributeReference) and isinstance(r, E.Literal):
+                out.append((l.colname, op, r.value))
+        except Exception:
+            continue
+    return out or None
+
+
+class TpuFileSourceScanExec(TpuExec):
+    def __init__(self, plan: FileSourceScan, conf: TpuConf):
+        super().__init__([])
+        self.plan = plan
+        self.conf = conf
+        self.reader_type = conf.get(PARQUET_READER_TYPE).upper()
+        self.num_threads = conf.get(PARQUET_MULTITHREAD_READ_NUM_THREADS)
+        self.max_rows = conf.get(MAX_READER_BATCH_SIZE_ROWS)
+
+    @property
+    def output(self):
+        return self.plan.output
+
+    def describe(self):
+        return (f"TpuFileSourceScan {self.plan.fmt} "
+                f"{len(self.plan.paths)} files mode={self._mode()}")
+
+    def _mode(self) -> str:
+        if self.reader_type != "AUTO":
+            return self.reader_type
+        return "MULTITHREADED" if len(self.plan.paths) > 1 else "COALESCING"
+
+    # -- host decode ----------------------------------------------------
+    def _read_file_host(self, path: str):
+        import pyarrow as pa
+
+        with self.metric("bufferTime").timed():
+            if self.plan.fmt == "parquet":
+                import pyarrow.parquet as pq
+
+                cols = [f.name for f in self.plan.output.fields]
+                tbl = pq.read_table(
+                    path, columns=cols,
+                    filters=_filters_to_arrow(self.plan.pushed_filters))
+            elif self.plan.fmt == "csv":
+                import pyarrow.csv as pacsv
+
+                tbl = pacsv.read_csv(path)
+            elif self.plan.fmt == "json":
+                import pyarrow.json as pajson
+
+                tbl = pajson.read_json(path)
+            else:
+                raise NotImplementedError(self.plan.fmt)
+        return tbl
+
+    def _table_to_host_cols(self, tbl) -> List[HostColumn]:
+        return [HostColumn.from_arrow(tbl.column(f.name), f.dataType)
+                for f in self.plan.output.fields]
+
+    def _upload(self, tbl) -> ColumnarBatch:
+        with self.metric("gpuDecodeTime").timed():  # name kept for parity
+            cols = self._table_to_host_cols(tbl)
+            names = self.plan.output.field_names()
+            return ColumnarBatch.from_host_columns(cols, names)
+
+    # -- modes ----------------------------------------------------------
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        mode = self._mode()
+        if mode == "PERFILE":
+            for p in self.plan.paths:
+                yield self._count_output(self._upload(self._read_file_host(p)))
+        elif mode == "COALESCING":
+            import pyarrow as pa
+
+            tbls = [self._read_file_host(p) for p in self.plan.paths]
+            if not tbls:
+                return
+            tbl = pa.concat_tables(tbls)
+            for chunk in self._row_chunks(tbl):
+                yield self._count_output(self._upload(chunk))
+        else:  # MULTITHREADED
+            with cf.ThreadPoolExecutor(self.num_threads) as pool:
+                futures = [pool.submit(self._read_file_host, p)
+                           for p in self.plan.paths]
+                for fut in futures:
+                    tbl = fut.result()
+                    for chunk in self._row_chunks(tbl):
+                        yield self._count_output(self._upload(chunk))
+
+    def _row_chunks(self, tbl):
+        n = tbl.num_rows
+        if n <= self.max_rows:
+            yield tbl
+            return
+        start = 0
+        while start < n:
+            yield tbl.slice(start, self.max_rows)
+            start += self.max_rows

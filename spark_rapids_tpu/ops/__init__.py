@@ -1,0 +1,11 @@
+"""Device kernels (jnp/XLA today, Pallas where profiling justifies it).
+
+This package is the L0 of the framework — the TPU equivalent of libcudf's
+kernel layer (SURVEY.md §2.10).  Everything here is shape-static, traceable,
+and designed around sort-based algorithms: on a machine whose strengths are
+the MXU/VPU and whose weakness is device-wide atomics, `lax.sort` + segment
+scans replace cuDF's hash tables (hash groupby, hash join) — same semantics,
+different algorithm, as SURVEY.md §7 prescribes.
+"""
+from spark_rapids_tpu.ops.filterops import compact_columns  # noqa: F401
+from spark_rapids_tpu.ops.sortkeys import pack_sort_keys  # noqa: F401

@@ -1,0 +1,63 @@
+"""Stream compaction — the cuDF `apply_boolean_mask` / `copy_if_else` analog.
+
+Reference analog: libcudf stream compaction consumed by GpuFilterExec
+(SURVEY.md §2.10 item 5).  TPU design: compaction is a cumsum + scatter
+(O(n), no sort).  The kept-row count comes back as a device scalar; the
+caller syncs it to host once per stage output (not per op) — whole-stage
+fusion keeps intermediate counts on device.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.column import DeviceColumn
+
+
+def compact_columns(mask: jax.Array,
+                    cols: List[DeviceColumn]) -> Tuple[List[DeviceColumn], jax.Array]:
+    """Move rows where ``mask`` is True to the front, preserving order.
+
+    Returns (compacted columns, kept-count device scalar).  Rows past the
+    count hold garbage (masked by validity=False).
+    """
+    n = mask.shape[0]
+    positions = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    count = jnp.where(n > 0, positions[-1] + 1, 0).astype(jnp.int32)
+    # rows not kept scatter out of bounds -> dropped
+    scatter_idx = jnp.where(mask, positions, n)
+    out = []
+    for c in cols:
+        validity = jnp.zeros_like(c.validity).at[scatter_idx].set(
+            c.validity, mode="drop")
+        if c.is_string:
+            chars = jnp.zeros_like(c.chars).at[scatter_idx].set(
+                c.chars, mode="drop")
+            lengths = jnp.zeros_like(c.lengths).at[scatter_idx].set(
+                c.lengths, mode="drop")
+            out.append(DeviceColumn(c.dtype, validity, chars=chars,
+                                    lengths=lengths))
+        else:
+            data = jnp.zeros_like(c.data).at[scatter_idx].set(
+                c.data, mode="drop")
+            out.append(DeviceColumn(c.dtype, validity, data=data))
+    return out, count
+
+
+def gather_columns(indices: jax.Array, valid_out: jax.Array,
+                   cols: List[DeviceColumn]) -> List[DeviceColumn]:
+    """Row gather (the JoinGatherer primitive): out[i] = col[indices[i]],
+    with rows where ``valid_out`` is False nulled (used for outer joins)."""
+    out = []
+    n = cols[0].capacity if cols else 0
+    safe = jnp.clip(indices, 0, max(n - 1, 0))
+    for c in cols:
+        validity = c.validity[safe] & valid_out
+        if c.is_string:
+            out.append(DeviceColumn(c.dtype, validity, chars=c.chars[safe],
+                                    lengths=c.lengths[safe]))
+        else:
+            out.append(DeviceColumn(c.dtype, validity, data=c.data[safe]))
+    return out

@@ -1,0 +1,144 @@
+"""Spark-compatible hash functions, vectorized.
+
+Reference analog: spark-rapids-jni murmur_hash.cu / xxhash64.cu backing
+GpuMurmur3Hash (hash partitioning MUST produce Spark's exact partition ids so
+CPU and TPU stages can interoperate) and GpuXxHash64.
+
+All arithmetic in uint32/uint64 with natural wraparound; per-row, fully
+vectorized; string hashing unrolls over the (static) char-matrix width the
+way Spark's Murmur3_x86_32.hashUnsafeBytes walks bytes: 4-byte little-endian
+blocks, then each trailing byte as its own block.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+
+_C1 = jnp.uint32(0xCC9E2D51)
+_C2 = jnp.uint32(0x1B873593)
+
+
+def _rotl32(x, r):
+    return (x << r) | (x >> (32 - r))
+
+
+def _mix_k1(k1):
+    k1 = k1 * _C1
+    k1 = _rotl32(k1, 15)
+    return k1 * _C2
+
+
+def _mix_h1(h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl32(h1, 13)
+    return h1 * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+
+
+def _fmix(h1, length):
+    h1 = h1 ^ jnp.uint32(length)
+    h1 = h1 ^ (h1 >> 16)
+    h1 = h1 * jnp.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> 13)
+    h1 = h1 * jnp.uint32(0xC2B2AE35)
+    return h1 ^ (h1 >> 16)
+
+
+def _hash_int_block(seed_u32, block_u32, length):
+    return _fmix(_mix_h1(seed_u32, _mix_k1(block_u32)), length)
+
+
+def murmur3_column(c: DeviceColumn, seed: jax.Array) -> jax.Array:
+    """Per-row murmur3 chained onto ``seed`` (uint32).  Null rows pass the
+    seed through unchanged — exactly Spark's HashExpression behavior."""
+    dt = c.dtype
+    if c.is_string:
+        h = _murmur3_string(c, seed)
+    elif isinstance(dt, (T.FloatType,)):
+        bits = c.data.astype(jnp.float32)
+        bits = jnp.where(bits == 0.0, jnp.float32(0.0), bits)  # -0.0 -> 0.0
+        as_u32 = bits.view(jnp.int32).astype(jnp.uint32)
+        h = _hash_int_block(seed, as_u32, 4)
+    elif isinstance(dt, (T.DoubleType,)):
+        d = c.data.astype(jnp.float64)
+        d = jnp.where(d == 0.0, jnp.float64(0.0), d)
+        bits = d.view(jnp.int64).astype(jnp.uint64)
+        h = _hash_long(seed, bits)
+    elif isinstance(dt, (T.LongType, T.TimestampType)) or (
+            isinstance(dt, T.DecimalType) and dt.precision > 18):
+        h = _hash_long(seed, c.data.astype(jnp.int64).view(jnp.uint64)
+                       if c.data.dtype == jnp.int64
+                       else c.data.astype(jnp.uint64))
+    elif isinstance(dt, T.DecimalType):
+        # Spark hashes small decimals as their unscaled long
+        h = _hash_long(seed, c.data.astype(jnp.int64).astype(jnp.uint64))
+    elif isinstance(dt, T.BooleanType):
+        h = _hash_int_block(seed, c.data.astype(jnp.uint32), 4)
+    else:  # byte/short/int/date hash as int
+        h = _hash_int_block(seed, c.data.astype(jnp.int32).astype(jnp.uint32), 4)
+    return jnp.where(c.validity, h, seed)
+
+
+def _hash_long(seed, bits_u64):
+    low = (bits_u64 & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    high = (bits_u64 >> 32).astype(jnp.uint32)
+    h = _mix_h1(seed, _mix_k1(low))
+    h = _mix_h1(h, _mix_k1(high))
+    return _fmix(h, 8)
+
+
+def _murmur3_string(c: DeviceColumn, seed: jax.Array) -> jax.Array:
+    w = c.width
+    n = c.capacity
+    h = jnp.broadcast_to(seed, (n,)).astype(jnp.uint32)
+    lengths = c.lengths
+    aligned = (lengths // 4) * 4
+    nblocks = w // 4
+    ch = c.chars.astype(jnp.uint32)
+    for b in range(nblocks + 1):
+        base = b * 4
+        if base + 4 <= w:
+            block = (ch[:, base]
+                     | (ch[:, base + 1] << 8)
+                     | (ch[:, base + 2] << 16)
+                     | (ch[:, base + 3] << 24))
+            use = (base + 4) <= aligned
+            h = jnp.where(use, _mix_h1(h, _mix_k1(block)), h)
+    # tail bytes, each as its own signed-byte block (Spark hashUnsafeBytes)
+    for t in range(min(3, w)):
+        idx = aligned + t
+        in_tail = idx < lengths
+        byte = jnp.take_along_axis(
+            ch, jnp.clip(idx, 0, w - 1)[:, None], axis=1)[:, 0]
+        sbyte = jnp.where(byte > 127, byte | jnp.uint32(0xFFFFFF00), byte)
+        h = jnp.where(in_tail, _mix_h1(h, _mix_k1(sbyte)), h)
+    return _fmix_len(h, lengths)
+
+
+def _fmix_len(h1, lengths):
+    h1 = h1 ^ lengths.astype(jnp.uint32)
+    h1 = h1 ^ (h1 >> 16)
+    h1 = h1 * jnp.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> 13)
+    h1 = h1 * jnp.uint32(0xC2B2AE35)
+    return h1 ^ (h1 >> 16)
+
+
+def murmur3_columns(cols: List[DeviceColumn], seed: int = 42) -> jax.Array:
+    """Spark Murmur3Hash(cols): chain column hashes starting at seed."""
+    n = cols[0].capacity
+    h = jnp.full((n,), jnp.uint32(seed))
+    for c in cols:
+        h = murmur3_column(c, h)
+    return h.astype(jnp.int32)
+
+
+def spark_partition_ids(cols: List[DeviceColumn], num_partitions: int) -> jax.Array:
+    """GpuHashPartitioning: pmod(murmur3(keys), numPartitions)."""
+    h = murmur3_columns(cols, seed=42)
+    p = h % jnp.int32(num_partitions)
+    return jnp.where(p < 0, p + num_partitions, p)

@@ -1,0 +1,156 @@
+"""Segmented reductions/scans — the TPU groupby/window primitive.
+
+Reference analog: cuDF's hash `Table.groupBy().aggregate()` kernels
+(SURVEY.md §2.10 item 2).  TPU-first: group-by is sort-based — rows sorted by
+key, equal-key runs become segments, and `jax.ops.segment_*` performs the
+reduction in one pass.  Null/NaN semantics follow Spark SQL:
+
+  * aggregates skip nulls; a group with zero valid inputs yields null
+    (except count, which yields 0);
+  * float min/max treat NaN as the greatest value (Spark total order).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def seg_sum(values, validity, seg_ids, num_segments: int):
+    contrib = jnp.where(validity, values, jnp.zeros_like(values))
+    s = jax.ops.segment_sum(contrib, seg_ids, num_segments=num_segments)
+    cnt = jax.ops.segment_sum(validity.astype(jnp.int64), seg_ids,
+                              num_segments=num_segments)
+    return s, cnt > 0
+
+
+def seg_count(validity, seg_ids, num_segments: int):
+    return jax.ops.segment_sum(validity.astype(jnp.int64), seg_ids,
+                               num_segments=num_segments)
+
+
+def seg_min(values, validity, seg_ids, num_segments: int, is_float: bool):
+    if is_float:
+        nan = jnp.isnan(values)
+        big = jnp.asarray(jnp.inf, values.dtype)
+        v = jnp.where(validity & ~nan, values, big)
+        m = jax.ops.segment_min(v, seg_ids, num_segments=num_segments)
+        valid_nonnan = jax.ops.segment_sum(
+            (validity & ~nan).astype(jnp.int32), seg_ids,
+            num_segments=num_segments) > 0
+        any_valid = jax.ops.segment_sum(
+            validity.astype(jnp.int32), seg_ids,
+            num_segments=num_segments) > 0
+        # all-NaN group -> NaN (NaN is greatest, min falls back to NaN
+        # only when nothing else exists)
+        m = jnp.where(valid_nonnan, m, jnp.asarray(jnp.nan, values.dtype))
+        return m, any_valid
+    if values.dtype == jnp.bool_:
+        v = jnp.where(validity, values, True)
+        m = jax.ops.segment_min(v.astype(jnp.int32), seg_ids,
+                                num_segments=num_segments).astype(jnp.bool_)
+    else:
+        big = jnp.asarray(jnp.iinfo(values.dtype).max, values.dtype)
+        v = jnp.where(validity, values, big)
+        m = jax.ops.segment_min(v, seg_ids, num_segments=num_segments)
+    any_valid = jax.ops.segment_sum(validity.astype(jnp.int32), seg_ids,
+                                    num_segments=num_segments) > 0
+    return m, any_valid
+
+
+def seg_max(values, validity, seg_ids, num_segments: int, is_float: bool):
+    if is_float:
+        nan = jnp.isnan(values)
+        small = jnp.asarray(-jnp.inf, values.dtype)
+        v = jnp.where(validity & ~nan, values, small)
+        m = jax.ops.segment_max(v, seg_ids, num_segments=num_segments)
+        has_nan = jax.ops.segment_sum(
+            (validity & nan).astype(jnp.int32), seg_ids,
+            num_segments=num_segments) > 0
+        any_valid = jax.ops.segment_sum(
+            validity.astype(jnp.int32), seg_ids,
+            num_segments=num_segments) > 0
+        m = jnp.where(has_nan, jnp.asarray(jnp.nan, values.dtype), m)
+        return m, any_valid
+    if values.dtype == jnp.bool_:
+        v = jnp.where(validity, values, False)
+        m = jax.ops.segment_max(v.astype(jnp.int32), seg_ids,
+                                num_segments=num_segments).astype(jnp.bool_)
+    else:
+        small = jnp.asarray(jnp.iinfo(values.dtype).min, values.dtype)
+        v = jnp.where(validity, values, small)
+        m = jax.ops.segment_max(v, seg_ids, num_segments=num_segments)
+    any_valid = jax.ops.segment_sum(validity.astype(jnp.int32), seg_ids,
+                                    num_segments=num_segments) > 0
+    return m, any_valid
+
+
+def seg_first_index(seg_ids, row_mask, num_segments: int):
+    """Index of the first row of each segment (for group-key extraction)."""
+    n = seg_ids.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    big = jnp.int32(n)
+    v = jnp.where(row_mask, iota, big)
+    return jax.ops.segment_min(v, seg_ids, num_segments=num_segments)
+
+
+# -- segmented scans (window running frames) --------------------------------
+
+def _seg_scan(values, starts, combine):
+    """Inclusive segmented scan: resets at rows where ``starts`` is True."""
+
+    def op(a, b):
+        va, fa = a
+        vb, fb = b
+        return jnp.where(fb, vb, combine(va, vb)), fa | fb
+
+    out, _ = jax.lax.associative_scan(op, (values, starts))
+    return out
+
+
+def seg_scan_sum(values, validity, starts):
+    contrib = jnp.where(validity, values, jnp.zeros_like(values))
+    total = _seg_scan(contrib, starts, lambda a, b: a + b)
+    cnt = _seg_scan(validity.astype(jnp.int64), starts, lambda a, b: a + b)
+    return total, cnt
+
+
+def seg_scan_min(values, validity, starts, is_float: bool):
+    if is_float:
+        ident = jnp.asarray(jnp.inf, values.dtype)
+        nan = jnp.isnan(values)
+        v = jnp.where(validity & ~nan, values, ident)
+        m = _seg_scan(v, starts, jnp.minimum)
+        seen_nonnan = _seg_scan((validity & ~nan).astype(jnp.int32), starts,
+                                lambda a, b: a + b) > 0
+        m = jnp.where(seen_nonnan, m, jnp.asarray(jnp.nan, values.dtype))
+        seen = _seg_scan(validity.astype(jnp.int32), starts,
+                         lambda a, b: a + b) > 0
+        return m, seen
+    ident = jnp.asarray(jnp.iinfo(values.dtype).max, values.dtype)
+    v = jnp.where(validity, values, ident)
+    m = _seg_scan(v, starts, jnp.minimum)
+    seen = _seg_scan(validity.astype(jnp.int32), starts,
+                     lambda a, b: a + b) > 0
+    return m, seen
+
+
+def seg_scan_max(values, validity, starts, is_float: bool):
+    if is_float:
+        ident = jnp.asarray(-jnp.inf, values.dtype)
+        nan = jnp.isnan(values)
+        v = jnp.where(validity & ~nan, values, ident)
+        m = _seg_scan(v, starts, jnp.maximum)
+        seen_nan = _seg_scan((validity & nan).astype(jnp.int32), starts,
+                             lambda a, b: a + b) > 0
+        m = jnp.where(seen_nan, jnp.asarray(jnp.nan, values.dtype), m)
+        seen = _seg_scan(validity.astype(jnp.int32), starts,
+                         lambda a, b: a + b) > 0
+        return m, seen
+    ident = jnp.asarray(jnp.iinfo(values.dtype).min, values.dtype)
+    v = jnp.where(validity, values, ident)
+    m = _seg_scan(v, starts, jnp.maximum)
+    seen = _seg_scan(validity.astype(jnp.int32), starts,
+                     lambda a, b: a + b) > 0
+    return m, seen

@@ -1,0 +1,144 @@
+"""Sort-key packing: turn arbitrary typed columns into int64 key words whose
+ascending unsigned-ish order equals the requested SQL ordering.
+
+This is the workhorse behind sort, sort-based group-by, sort-merge join and
+window partitioning (the TPU answer to cuDF's `Table.orderBy` comparators,
+SURVEY.md §2.10 item 4).  Techniques:
+
+  * signed ints -> order-preserving by using them directly as signed keys;
+    descending -> bitwise negation.
+  * doubles -> IEEE-754 total order trick: flip sign bit for positives,
+    flip all bits for negatives; NaN sorts greatest (Spark semantics).
+  * strings (padded char matrix) -> big-endian packed int64 words, 8 chars
+    per word; padding 0x00 orders shorter strings first, matching UTF-8
+    byte order.
+  * nulls -> a leading per-column null-flag key encodes NULLS FIRST/LAST.
+
+`jax.lax.sort` then sorts the tuple of key words lexicographically
+(num_keys=k) carrying a row-index payload; everything downstream gathers
+through that permutation.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+
+
+@dataclasses.dataclass(frozen=True)
+class SortSpec:
+    """One ORDER BY term: column + direction + null ordering.
+
+    Matches Spark's SortOrder (GpuSortOrder analog)."""
+
+    ascending: bool = True
+    nulls_first: bool = True  # Spark default: NULLS FIRST for ASC, LAST for DESC
+
+
+def _float_total_order(bits: jax.Array) -> jax.Array:
+    """IEEE bits (int64) -> monotone *signed* key.
+
+    Positive floats: sign bit clear, bit pattern already ascends as signed.
+    Negative floats: flip all value bits (keep the sign bit) so they stay in
+    the signed-negative range with order reversed: -inf -> most negative key,
+    -0.0 -> -1 (just below +0.0 at 0).  NaN (0x7FF8...) lands above +inf.
+    """
+    return jnp.where(bits < 0,
+                     bits ^ jnp.int64(0x7FFFFFFFFFFFFFFF), bits)
+
+
+def _column_key_words(c: DeviceColumn) -> List[jax.Array]:
+    """int64 key word list for ASC NULLS-handled-separately ordering."""
+    dt = c.dtype
+    if c.is_string:
+        w = c.width
+        words = []
+        nwords = (w + 7) // 8
+        for wi in range(nwords):
+            acc = jnp.zeros(c.capacity, jnp.int64)
+            for b in range(8):
+                ci = wi * 8 + b
+                byte = (c.chars[:, ci].astype(jnp.int64)
+                        if ci < w else jnp.zeros(c.capacity, jnp.int64))
+                acc = (acc << 8) | byte
+            # big-endian packed; values are in [0, 2^64) but we only ever
+            # shift in 8 bytes -> top bit may be set; rebias to signed order
+            acc = acc ^ jnp.int64(-9223372036854775808)
+            words.append(acc)
+        return words
+    if isinstance(dt, (T.FloatType, T.DoubleType)):
+        d = c.data.astype(jnp.float64)
+        # Spark normalization: -0.0 keys with 0.0; every NaN bit pattern is
+        # the same key (and sorts greatest)
+        d = jnp.where(d == 0.0, 0.0, d)
+        bits = d.view(jnp.int64)
+        canonical_nan = jnp.int64(0x7FF8000000000000)
+        bits = jnp.where(jnp.isnan(d), canonical_nan, bits)
+        return [_float_total_order(bits)]
+    if isinstance(dt, T.BooleanType):
+        return [c.data.astype(jnp.int64)]
+    return [c.data.astype(jnp.int64)]
+
+
+def pack_sort_keys(cols: Sequence[DeviceColumn],
+                   specs: Sequence[SortSpec],
+                   row_mask: jax.Array) -> List[jax.Array]:
+    """Build the list of int64 key vectors for lax.sort.
+
+    ``row_mask`` marks logical rows; padding rows sort after everything
+    (key word +inf) so they stay at the tail.
+    """
+    keys: List[jax.Array] = []
+    pad_hi = jnp.int64(9223372036854775807)
+    for c, spec in zip(cols, specs):
+        null_key = jnp.where(c.validity,
+                             0 if spec.nulls_first else 0,
+                             -1 if spec.nulls_first else 1).astype(jnp.int64)
+        if not spec.ascending:
+            null_key = null_key  # null ordering is explicit, not flipped
+        keys.append(jnp.where(row_mask, null_key, pad_hi))
+        for wkey in _column_key_words(c):
+            k = wkey if spec.ascending else ~wkey
+            # null rows: neutral key so null group is stable/contiguous
+            k = jnp.where(c.validity, k, 0)
+            keys.append(jnp.where(row_mask, k, pad_hi))
+    return keys
+
+
+def sort_permutation(cols: Sequence[DeviceColumn],
+                     specs: Sequence[SortSpec],
+                     row_mask: jax.Array,
+                     stable_iota: bool = True) -> jax.Array:
+    """Returns the row permutation realizing the ordering."""
+    n = row_mask.shape[0]
+    keys = pack_sort_keys(cols, specs, row_mask)
+    payload = jnp.arange(n, dtype=jnp.int32)
+    operands = tuple(keys) + (payload,)
+    out = jax.lax.sort(operands, num_keys=len(keys), is_stable=stable_iota)
+    return out[-1]
+
+
+def group_segments(sorted_key_words: Sequence[jax.Array],
+                   row_mask_sorted: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Given key words already in sorted row order, return (segment_ids,
+    num_groups) where equal consecutive keys share a segment id.
+
+    Padding rows (mask False) all land in the last segment and are excluded
+    from num_groups.
+    """
+    n = row_mask_sorted.shape[0]
+    change = jnp.zeros(n, jnp.bool_)
+    for k in sorted_key_words:
+        prev = jnp.concatenate([k[:1], k[:-1]])
+        change = change | (k != prev)
+    change = change.at[0].set(True)
+    seg = jnp.cumsum(change.astype(jnp.int32)) - 1
+    num_groups = jnp.where(
+        jnp.any(row_mask_sorted),
+        seg[jnp.sum(row_mask_sorted.astype(jnp.int32)) - 1] + 1, 0)
+    return seg, num_groups

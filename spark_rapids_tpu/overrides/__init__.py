@@ -1,0 +1,1 @@
+from spark_rapids_tpu.overrides.overrides import TpuOverrides  # noqa: F401

@@ -1,0 +1,164 @@
+"""RapidsMeta analog — the tagging tree over the physical plan.
+
+Reference analog: com/nvidia/spark/rapids/RapidsMeta.scala (RapidsMeta,
+SparkPlanMeta, BaseExprMeta, DataFromReplacementRule): every plan node and
+expression is wrapped in a meta object; ``tag_for_tpu`` marks it
+TPU-capable or records human-readable reasons via ``will_not_work_on_tpu``;
+``convert_to_tpu`` builds the TPU operator.  The accumulated reasons feed
+``spark.rapids.sql.explain=NOT_ON_GPU`` -style output — the reference's
+signature debuggability feature, reproduced verbatim here.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.expr.base import Expression
+
+
+class BaseMeta:
+    def __init__(self, conf: TpuConf):
+        self.conf = conf
+        self.cannot_run_reasons: List[str] = []
+        self.child_metas: List[BaseMeta] = []
+
+    def will_not_work_on_tpu(self, reason: str):
+        if reason not in self.cannot_run_reasons:
+            self.cannot_run_reasons.append(reason)
+
+    @property
+    def can_this_run(self) -> bool:
+        return not self.cannot_run_reasons
+
+    @property
+    def can_run_with_children(self) -> bool:
+        return self.can_this_run and all(
+            m.can_run_with_children for m in self.child_metas)
+
+    def tag_for_tpu(self):
+        raise NotImplementedError
+
+
+class ExprMeta(BaseMeta):
+    """Meta for one expression node (BaseExprMeta analog)."""
+
+    def __init__(self, expr: Expression, conf: TpuConf, rule):
+        super().__init__(conf)
+        self.expr = expr
+        self.rule = rule
+        from spark_rapids_tpu.overrides.overrides import wrap_expr
+
+        self.child_metas = [wrap_expr(c, conf) for c in expr.children]
+
+    @property
+    def name(self) -> str:
+        return type(self.expr).__name__
+
+    def tag_for_tpu(self):
+        for m in self.child_metas:
+            m.tag_for_tpu()
+        if self.rule is None:
+            self.will_not_work_on_tpu(
+                f"expression {self.name} is not supported on TPU")
+            return
+        if not self.conf.is_op_enabled(self.name, "expression"):
+            self.will_not_work_on_tpu(
+                f"expression {self.name} has been disabled by "
+                f"spark.rapids.sql.expression.{self.name}=false")
+        sig: T.TypeSig = self.rule.type_sig
+        dt = self.expr._dataType
+        if dt is not None and not sig.supports(dt):
+            self.will_not_work_on_tpu(
+                f"expression {self.name} produces an unsupported type: "
+                + sig.reason_not_supported(dt))
+        for c in self.expr.children:
+            cdt = c._dataType
+            if cdt is not None and not sig.supports(cdt) \
+                    and not isinstance(cdt, T.NullType):
+                self.will_not_work_on_tpu(
+                    f"expression {self.name} input: "
+                    + sig.reason_not_supported(cdt))
+        if self.rule.extra_check is not None:
+            self.rule.extra_check(self)
+
+    def all_reasons(self) -> List[str]:
+        out = list(self.cannot_run_reasons)
+        for m in self.child_metas:
+            out.extend(m.all_reasons())
+        return out
+
+    @property
+    def can_run_with_children(self) -> bool:
+        return self.can_this_run and all(
+            m.can_run_with_children for m in self.child_metas)
+
+
+class SparkPlanMeta(BaseMeta):
+    """Meta for one plan node (SparkPlanMeta analog)."""
+
+    def __init__(self, plan, conf: TpuConf, rule):
+        super().__init__(conf)
+        self.plan = plan
+        self.rule = rule
+        from spark_rapids_tpu.overrides.overrides import wrap_plan_children
+
+        self.child_metas = wrap_plan_children(plan, conf)
+        self.expr_metas: List[ExprMeta] = []
+
+    @property
+    def name(self) -> str:
+        return type(self.plan).__name__
+
+    def add_expr_metas(self, exprs):
+        from spark_rapids_tpu.overrides.overrides import wrap_expr
+
+        for e in exprs:
+            if e is not None:
+                self.expr_metas.append(wrap_expr(e, self.conf))
+
+    def tag_for_tpu(self):
+        for m in self.child_metas:
+            m.tag_for_tpu()
+        if self.rule is None:
+            self.will_not_work_on_tpu(
+                f"exec {self.name} is not supported on TPU")
+            return
+        if not self.conf.is_op_enabled(self.name, "exec"):
+            self.will_not_work_on_tpu(
+                f"exec {self.name} has been disabled by "
+                f"spark.rapids.sql.exec.{self.name}=false")
+        # output type check
+        sig: T.TypeSig = self.rule.type_sig
+        for f in self.plan.output.fields:
+            if not sig.supports(f.dataType):
+                self.will_not_work_on_tpu(
+                    f"exec {self.name} output column '{f.name}': "
+                    + sig.reason_not_supported(f.dataType))
+        # expression checks
+        if self.rule.tag_exprs is not None:
+            self.add_expr_metas(self.rule.tag_exprs(self.plan))
+        for em in self.expr_metas:
+            em.tag_for_tpu()
+            if not em.can_run_with_children:
+                for r in em.all_reasons():
+                    self.will_not_work_on_tpu(r)
+        if self.rule.extra_check is not None:
+            self.rule.extra_check(self)
+
+    # ------------------------------------------------------------------
+    def explain(self, indent: int = 0, only_fallback: bool = True) -> str:
+        lines = []
+        pad = "  " * indent
+        if self.can_this_run:
+            if not only_fallback:
+                lines.append(f"{pad}*{self.name} will run on TPU")
+        else:
+            reasons = "; ".join(self.cannot_run_reasons)
+            lines.append(f"{pad}!{self.name} cannot run on TPU because "
+                         f"{reasons}")
+        for m in self.child_metas:
+            sub = m.explain(indent + 1, only_fallback)
+            if sub:
+                lines.append(sub)
+        return "\n".join(l for l in lines if l)

@@ -1,0 +1,405 @@
+"""TpuOverrides — the plan-rewrite registry (the product's core).
+
+Reference analog: com/nvidia/spark/rapids/GpuOverrides.scala (~4,800 LoC):
+a registry mapping every Catalyst expression / exec / scan / partitioning to
+a replacement rule with a TypeSig, a tagging hook and a conversion; applied
+as a Rule[SparkPlan].  The structure here is the same `expr()` / `exec()`
+DSL over our plan nodes, and the apply() entry runs: wrap -> tag (accumulate
+willNotWorkOnTpu reasons) -> convert (maximal TPU subtrees + transitions) ->
+TpuTransitionOverrides (coalesce insertion + whole-stage fusion).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Type
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import (
+    ENABLE_CAST_STRING_TO_TIMESTAMP,
+    TpuConf,
+)
+from spark_rapids_tpu.expr import arithmetic as A
+from spark_rapids_tpu.expr import base as E
+from spark_rapids_tpu.expr import cast as C
+from spark_rapids_tpu.expr import conditional as CO
+from spark_rapids_tpu.expr import datetime as DT
+from spark_rapids_tpu.expr import mathfuncs as M
+from spark_rapids_tpu.expr import predicates as P
+from spark_rapids_tpu.expr import strings as S
+from spark_rapids_tpu.overrides.meta import ExprMeta, SparkPlanMeta
+from spark_rapids_tpu.plan import nodes as PN
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ExprRule:
+    type_sig: T.TypeSig
+    extra_check: Optional[Callable[[ExprMeta], None]] = None
+    desc: str = ""
+
+
+@dataclasses.dataclass
+class ExecRule:
+    type_sig: T.TypeSig
+    convert: Callable = None
+    tag_exprs: Optional[Callable] = None
+    extra_check: Optional[Callable[[SparkPlanMeta], None]] = None
+    desc: str = ""
+
+
+_COMMON = (T.BOOLEAN_SIG + T.numeric + T.STRING_SIG + T.DATETIME_SIG
+           + T.NULL_SIG)
+_COMMON128 = _COMMON + T.DECIMAL_128_SIG.with_max_decimal(18)
+_NUM = T.numeric + T.NULL_SIG
+
+
+def _check_cast(meta: ExprMeta):
+    e: C.Cast = meta.expr
+    src = e.child._dataType
+    if src is None:
+        return
+    if not C.cast_supported(src, e.to):
+        meta.will_not_work_on_tpu(
+            f"cast from {src.simpleString} to {e.to.simpleString} is not "
+            f"supported on TPU")
+    if isinstance(src, T.StringType) and isinstance(e.to, T.TimestampType):
+        if not meta.conf.get(ENABLE_CAST_STRING_TO_TIMESTAMP):
+            meta.will_not_work_on_tpu(
+                "string->timestamp cast is disabled "
+                "(spark.rapids.sql.castStringToTimestamp.enabled)")
+
+
+def _check_like(meta: ExprMeta):
+    e: S.Like = meta.expr
+    pat = e.right
+    if not isinstance(pat, E.Literal):
+        meta.will_not_work_on_tpu("LIKE pattern must be a literal")
+    elif not S.like_pattern_supported(pat.value):
+        meta.will_not_work_on_tpu(
+            f"LIKE pattern {pat.value!r} is not supported on TPU "
+            f"(transpiler-reject path; see RegexParser analog)")
+
+
+def _check_literal_pattern(meta: ExprMeta):
+    if not isinstance(meta.expr.children[1], E.Literal):
+        meta.will_not_work_on_tpu("pattern must be a literal")
+
+
+EXPRESSIONS: Dict[Type, ExprRule] = {
+    E.Literal: ExprRule(_COMMON128, desc="constant literal"),
+    E.BoundReference: ExprRule(_COMMON128, desc="column reference"),
+    E.AttributeReference: ExprRule(_COMMON128, desc="column reference"),
+    E.Alias: ExprRule(_COMMON128, desc="alias"),
+    A.Add: ExprRule(_NUM), A.Subtract: ExprRule(_NUM),
+    A.Multiply: ExprRule(_NUM), A.Divide: ExprRule(_NUM),
+    A.IntegralDivide: ExprRule(_NUM), A.Remainder: ExprRule(_NUM),
+    A.Pmod: ExprRule(_NUM), A.UnaryMinus: ExprRule(_NUM),
+    A.Abs: ExprRule(_NUM),
+    P.EqualTo: ExprRule(_COMMON128), P.LessThan: ExprRule(_COMMON128),
+    P.LessThanOrEqual: ExprRule(_COMMON128),
+    P.GreaterThan: ExprRule(_COMMON128),
+    P.GreaterThanOrEqual: ExprRule(_COMMON128),
+    P.EqualNullSafe: ExprRule(_COMMON128),
+    P.And: ExprRule(T.BOOLEAN_SIG + T.NULL_SIG),
+    P.Or: ExprRule(T.BOOLEAN_SIG + T.NULL_SIG),
+    P.Not: ExprRule(T.BOOLEAN_SIG + T.NULL_SIG),
+    P.IsNull: ExprRule(_COMMON128), P.IsNotNull: ExprRule(_COMMON128),
+    P.IsNaN: ExprRule(T.FP_SIG + T.BOOLEAN_SIG),
+    P.In: ExprRule(_COMMON128),
+    CO.If: ExprRule(_COMMON128), CO.CaseWhen: ExprRule(_COMMON128),
+    CO.Coalesce: ExprRule(_COMMON128), CO.Nvl: ExprRule(_COMMON128),
+    CO.NaNvl: ExprRule(T.FP_SIG),
+    CO.Greatest: ExprRule(_NUM + T.STRING_SIG),
+    CO.Least: ExprRule(_NUM + T.STRING_SIG),
+    C.Cast: ExprRule(_COMMON128, extra_check=_check_cast),
+    M.Sqrt: ExprRule(_NUM), M.Exp: ExprRule(_NUM), M.Log: ExprRule(_NUM),
+    M.Log10: ExprRule(_NUM), M.Sin: ExprRule(_NUM), M.Cos: ExprRule(_NUM),
+    M.Tan: ExprRule(_NUM), M.Asin: ExprRule(_NUM), M.Acos: ExprRule(_NUM),
+    M.Atan: ExprRule(_NUM), M.Signum: ExprRule(_NUM), M.Pow: ExprRule(_NUM),
+    M.Floor: ExprRule(_NUM), M.Ceil: ExprRule(_NUM), M.Round: ExprRule(_NUM),
+    S.Length: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG),
+    S.Upper: ExprRule(T.STRING_SIG.with_note(
+        T.StringType, "ASCII-only case conversion")),
+    S.Lower: ExprRule(T.STRING_SIG.with_note(
+        T.StringType, "ASCII-only case conversion")),
+    S.Substring: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG),
+    S.Concat: ExprRule(T.STRING_SIG),
+    S.StartsWith: ExprRule(T.STRING_SIG + T.BOOLEAN_SIG),
+    S.EndsWith: ExprRule(T.STRING_SIG + T.BOOLEAN_SIG),
+    S.Contains: ExprRule(T.STRING_SIG + T.BOOLEAN_SIG),
+    S.StringTrim: ExprRule(T.STRING_SIG),
+    S.Like: ExprRule(T.STRING_SIG + T.BOOLEAN_SIG, extra_check=_check_like),
+    DT.Year: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.Month: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.DayOfMonth: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.DayOfWeek: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.DayOfYear: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.Quarter: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.LastDay: ExprRule(T.DATETIME_SIG),
+    DT.Hour: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.Minute: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.Second: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.DateAdd: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.DateSub: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.DateDiff: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.UnixTimestamp: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+}
+
+
+def wrap_expr(e: E.Expression, conf: TpuConf) -> ExprMeta:
+    rule = EXPRESSIONS.get(type(e))
+    return ExprMeta(e, conf, rule)
+
+
+# ---------------------------------------------------------------------------
+# Exec rules
+# ---------------------------------------------------------------------------
+
+_AGG_FUNCS_SUPPORTED = {"sum", "count", "count_star", "min", "max", "avg",
+                        "first", "last"}
+_WINDOW_FUNCS_SUPPORTED = {"row_number", "rank", "dense_rank", "sum", "count",
+                           "min", "max", "avg"}
+_JOIN_TYPES_SUPPORTED = {PN.JoinType.INNER, PN.JoinType.LEFT_OUTER,
+                         PN.JoinType.RIGHT_OUTER, PN.JoinType.FULL_OUTER,
+                         PN.JoinType.LEFT_SEMI, PN.JoinType.LEFT_ANTI,
+                         PN.JoinType.CROSS}
+
+
+def _agg_check(meta: SparkPlanMeta):
+    plan: PN.HashAggregate = meta.plan
+    for a in plan.aggregates:
+        if a.func not in _AGG_FUNCS_SUPPORTED:
+            meta.will_not_work_on_tpu(
+                f"aggregate function {a.func} is not supported on TPU")
+        if a.distinct:
+            meta.will_not_work_on_tpu(
+                "distinct aggregates are not supported on TPU yet")
+
+
+def _join_check(meta: SparkPlanMeta):
+    plan = meta.plan
+    if plan.join_type not in _JOIN_TYPES_SUPPORTED:
+        meta.will_not_work_on_tpu(
+            f"join type {plan.join_type.value} is not supported on TPU")
+    if plan.condition is not None and plan.join_type != PN.JoinType.INNER:
+        meta.will_not_work_on_tpu(
+            "non-inner join with residual condition is not supported on TPU")
+    if not plan.left_keys and plan.join_type != PN.JoinType.CROSS:
+        meta.will_not_work_on_tpu("equi-join keys required")
+
+
+def _window_check(meta: SparkPlanMeta):
+    plan: PN.Window = meta.plan
+    for f in plan.functions:
+        if f.func not in _WINDOW_FUNCS_SUPPORTED:
+            meta.will_not_work_on_tpu(
+                f"window function {f.func} is not supported on TPU")
+        if f.child is not None and isinstance(f.child._dataType, T.StringType):
+            meta.will_not_work_on_tpu(
+                "string-valued window aggregates not supported on TPU")
+
+
+def _scan_check(meta: SparkPlanMeta):
+    plan: PN.FileSourceScan = meta.plan
+    fmt = plan.fmt
+    key = {"parquet": "spark.rapids.sql.format.parquet.read.enabled",
+           "csv": "spark.rapids.sql.format.csv.read.enabled",
+           "json": "spark.rapids.sql.format.json.read.enabled"}.get(fmt)
+    if key is None:
+        meta.will_not_work_on_tpu(f"format {fmt} is not supported on TPU")
+        return
+    if str(meta.conf.settings.get(key, "true")).lower() == "false":
+        meta.will_not_work_on_tpu(f"{fmt} reads disabled by {key}=false")
+
+
+def _exprs_of(plan) -> List[E.Expression]:
+    if isinstance(plan, PN.Project):
+        return list(plan.exprs)
+    if isinstance(plan, PN.Filter):
+        return [plan.condition]
+    if isinstance(plan, PN.HashAggregate):
+        out = list(plan.grouping)
+        out += [a.child for a in plan.aggregates if a.child is not None]
+        return out
+    if isinstance(plan, PN._BaseJoin):
+        out = list(plan.left_keys) + list(plan.right_keys)
+        if plan.condition is not None:
+            out.append(plan.condition)
+        return out
+    if isinstance(plan, PN.Sort):
+        return [e for e, _ in plan.orders]
+    if isinstance(plan, PN.Window):
+        out = list(plan.partition_by) + [e for e, _ in plan.order_by]
+        out += [f.child for f in plan.functions if f.child is not None]
+        return out
+    if isinstance(plan, PN.Exchange) and isinstance(
+            plan.partitioning, PN.HashPartitioning):
+        return list(plan.partitioning.keys)
+    return []
+
+
+EXECS: Dict[Type, ExecRule] = {}
+
+
+def _exec(cls, sig=_COMMON128, tag_exprs=_exprs_of, extra=None, desc=""):
+    EXECS[cls] = ExecRule(sig, tag_exprs=tag_exprs, extra_check=extra,
+                          desc=desc)
+
+
+_exec(PN.LocalTableScan)
+_exec(PN.FileSourceScan, extra=_scan_check)
+_exec(PN.RangeNode)
+_exec(PN.Project)
+_exec(PN.Filter)
+_exec(PN.HashAggregate, extra=_agg_check)
+_exec(PN.SortMergeJoin, extra=_join_check,
+      desc="converted to shuffled sorted join (GpuSortMergeJoinMeta analog)")
+_exec(PN.ShuffledHashJoin, extra=_join_check)
+_exec(PN.BroadcastHashJoin, extra=_join_check)
+_exec(PN.Sort)
+_exec(PN.Window, extra=_window_check)
+_exec(PN.Exchange)
+_exec(PN.BroadcastExchange)
+_exec(PN.GlobalLimit)
+_exec(PN.LocalLimit)
+_exec(PN.Union)
+
+
+def wrap_plan(plan: PN.SparkPlan, conf: TpuConf) -> SparkPlanMeta:
+    rule = EXECS.get(type(plan))
+    return SparkPlanMeta(plan, conf, rule)
+
+
+def wrap_plan_children(plan: PN.SparkPlan, conf: TpuConf):
+    return [wrap_plan(c, conf) for c in plan.children]
+
+
+# ---------------------------------------------------------------------------
+# Conversion
+# ---------------------------------------------------------------------------
+
+def _convert_node(meta: SparkPlanMeta, tpu_children, ansi: bool):
+    """Build the TpuExec for one convertible node."""
+    from spark_rapids_tpu import exec as X
+    from spark_rapids_tpu.exec.exchange import TpuBroadcastExchangeExec
+    from spark_rapids_tpu.exec.join import TpuCartesianProductExec
+    from spark_rapids_tpu.io.scan import TpuFileSourceScanExec
+
+    plan = meta.plan
+    if isinstance(plan, PN.LocalTableScan):
+        from spark_rapids_tpu.config import TPU_SCAN_CACHE
+
+        return X.TpuLocalTableScanExec(
+            plan.host_columns, plan.output,
+            cache_device=meta.conf.get(TPU_SCAN_CACHE), cache_slot=plan)
+    if isinstance(plan, PN.FileSourceScan):
+        return TpuFileSourceScanExec(plan, meta.conf)
+    if isinstance(plan, PN.RangeNode):
+        return X.TpuRangeExec(plan.start, plan.end, plan.step)
+    if isinstance(plan, PN.Project):
+        return X.TpuProjectExec(plan.exprs, tpu_children[0], ansi)
+    if isinstance(plan, PN.Filter):
+        return X.TpuFilterExec(plan.condition, tpu_children[0], ansi)
+    if isinstance(plan, PN.HashAggregate):
+        return X.TpuHashAggregateExec(
+            plan.grouping, plan.aggregates, plan.mode, tpu_children[0],
+            plan.child.output, plan.output, ansi)
+    if isinstance(plan, (PN.SortMergeJoin, PN.ShuffledHashJoin)):
+        if plan.join_type == PN.JoinType.CROSS:
+            return TpuCartesianProductExec(tpu_children[0], tpu_children[1],
+                                           plan.output, plan.condition, ansi)
+        return X.TpuShuffledSymmetricHashJoinExec(
+            tpu_children[0], tpu_children[1], plan.left_keys, plan.right_keys,
+            plan.join_type, plan.condition, plan.output, ansi)
+    if isinstance(plan, PN.BroadcastHashJoin):
+        return X.TpuBroadcastHashJoinExec(
+            tpu_children[0], tpu_children[1], plan.left_keys, plan.right_keys,
+            plan.join_type, plan.condition, plan.output, ansi)
+    if isinstance(plan, PN.Sort):
+        return X.TpuSortExec(plan.orders, plan.is_global, tpu_children[0],
+                             ansi)
+    if isinstance(plan, PN.Window):
+        return X.TpuWindowExec(plan.functions, plan.partition_by,
+                               plan.order_by, tpu_children[0], plan.output,
+                               plan.frame, ansi)
+    if isinstance(plan, PN.Exchange):
+        return X.TpuShuffleExchangeExec(plan.partitioning, tpu_children[0],
+                                        ansi)
+    if isinstance(plan, PN.BroadcastExchange):
+        return TpuBroadcastExchangeExec(tpu_children[0])
+    if isinstance(plan, PN.GlobalLimit):
+        return X.TpuGlobalLimitExec(plan.n, tpu_children[0])
+    if isinstance(plan, PN.LocalLimit):
+        return X.TpuLocalLimitExec(plan.n, tpu_children[0])
+    if isinstance(plan, PN.Union):
+        return X.TpuUnionExec(tpu_children)
+    raise NotImplementedError(f"convert {meta.name}")
+
+
+class CpuSubtree:
+    """Marker: this subtree stays on CPU (executed by the oracle)."""
+
+    def __init__(self, plan: PN.SparkPlan):
+        self.plan = plan
+
+
+def _rebuild_cpu_plan(meta: SparkPlanMeta, converted_children):
+    """Child results may be TpuExec (need materialization node) or CPU plans."""
+    from spark_rapids_tpu.exec.base import TpuExec
+    from spark_rapids_tpu.overrides.transitions import TpuMaterializedScan
+
+    new_children = []
+    for cc in converted_children:
+        if isinstance(cc, TpuExec):
+            new_children.append(TpuMaterializedScan(cc))
+        else:
+            new_children.append(cc)
+    return meta.plan.with_new_children(new_children)
+
+
+class TpuOverrides:
+    """The Rule[SparkPlan] entry point."""
+
+    @staticmethod
+    def apply(plan: PN.SparkPlan, conf: TpuConf):
+        """Returns (root, meta): root is a TpuExec (possibly with embedded
+        CPU subtrees) or a CPU plan (possibly with embedded TPU subtrees)."""
+        from spark_rapids_tpu.exec.base import TpuExec
+        from spark_rapids_tpu.exec.transitions import TpuRowToColumnarExec
+        from spark_rapids_tpu.overrides.transitions import (
+            TpuTransitionOverrides,
+        )
+
+        meta = wrap_plan(plan, conf)
+        meta.tag_for_tpu()
+        explain = conf.explain.upper()
+        if explain in ("NOT_ON_GPU", "ALL"):
+            txt = meta.explain(only_fallback=(explain == "NOT_ON_GPU"))
+            if txt:
+                print(txt)
+        ansi = conf.ansi_enabled
+        root = TpuOverrides._convert(meta, ansi)
+        if isinstance(root, TpuExec):
+            root = TpuTransitionOverrides.apply(root, conf)
+        return root, meta
+
+    @staticmethod
+    def _convert(meta: SparkPlanMeta, ansi: bool):
+        from spark_rapids_tpu.exec.base import TpuExec
+        from spark_rapids_tpu.exec.transitions import TpuRowToColumnarExec
+
+        converted = [TpuOverrides._convert(m, ansi) for m in meta.child_metas]
+        if meta.can_this_run:
+            tpu_children = []
+            for cc, cm in zip(converted, meta.child_metas):
+                if isinstance(cc, TpuExec):
+                    tpu_children.append(cc)
+                else:
+                    # CPU child under a TPU parent: row->columnar transition
+                    tpu_children.append(TpuRowToColumnarExec(cc, ansi))
+            return _convert_node(meta, tpu_children, ansi)
+        # node stays on CPU; TPU children materialize through transitions
+        return _rebuild_cpu_plan(meta, converted)

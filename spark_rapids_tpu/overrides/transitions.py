@@ -1,0 +1,95 @@
+"""TpuTransitionOverrides — post-conversion plan fixups.
+
+Reference analog: com/nvidia/spark/rapids/GpuTransitionOverrides.scala:
+inserts transitions at CPU<->GPU boundaries, adds GpuCoalesceBatches /
+GpuShuffleCoalesceExec after shuffles, and validates the final plan.  Here
+the boundary transitions are inserted during conversion (overrides.py); this
+pass adds:
+
+  * TpuCoalesceBatchesExec after every shuffle exchange (the
+    GpuShuffleCoalesceExec role: concat per-partition slices to the goal
+    size — and on TPU, re-bucket shapes to bound recompiles);
+  * Sort+Limit -> TpuTopNExec rewrite (GpuTopN);
+  * whole-stage fusion of adjacent project/filter stages (TPU-specific).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import BATCH_SIZE_BYTES, TPU_WHOLESTAGE_FUSION, TpuConf
+from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.exec.basic import TpuStageExec, fuse_stages
+from spark_rapids_tpu.exec.coalesce import CoalesceGoal, TpuCoalesceBatchesExec
+from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+from spark_rapids_tpu.exec.limit import TpuGlobalLimitExec, TpuLocalLimitExec
+from spark_rapids_tpu.exec.sort import TpuSortExec, TpuTopNExec
+from spark_rapids_tpu.plan.nodes import SparkPlan
+
+
+class TpuMaterializedScan(SparkPlan):
+    """CPU plan node backed by a TPU subtree: the columnar->row boundary.
+
+    Reference analog: GpuColumnarToRowExec feeding a CPU operator."""
+
+    def __init__(self, tpu_child: TpuExec):
+        super().__init__([])
+        self.tpu_child = tpu_child
+
+    @property
+    def output(self):
+        return self.tpu_child.output
+
+    def describe(self):
+        return f"ColumnarToRow <- {self.tpu_child.describe()}"
+
+    def materialize_cpu(self):
+        from spark_rapids_tpu.cpu.oracle import CpuCol
+        from spark_rapids_tpu.exec.transitions import TpuColumnarToRowExec
+
+        c2r = TpuColumnarToRowExec(self.tpu_child)
+        host = c2r.collect_host()
+        cols = [CpuCol.from_host(h) for h in host]
+        n = cols[0].n if cols else 0
+        return cols, n
+
+
+class TpuTransitionOverrides:
+    @staticmethod
+    def apply(root: TpuExec, conf: TpuConf) -> TpuExec:
+        root = TpuTransitionOverrides._insert_coalesce(root, conf)
+        root = TpuTransitionOverrides._rewrite_topn(root)
+        if conf.get(TPU_WHOLESTAGE_FUSION):
+            root = fuse_stages(root)
+        return root
+
+    @staticmethod
+    def _insert_coalesce(node: TpuExec, conf: TpuConf) -> TpuExec:
+        node.children = [
+            TpuTransitionOverrides._insert_coalesce(c, conf)
+            if isinstance(c, TpuExec) else c
+            for c in node.children]
+        new_children = []
+        for c in node.children:
+            if isinstance(c, TpuShuffleExchangeExec):
+                goal = CoalesceGoal(conf.get(BATCH_SIZE_BYTES))
+                new_children.append(TpuCoalesceBatchesExec(goal, c))
+            else:
+                new_children.append(c)
+        node.children = new_children
+        return node
+
+    @staticmethod
+    def _rewrite_topn(node: TpuExec) -> TpuExec:
+        node.children = [TpuTransitionOverrides._rewrite_topn(c)
+                         if isinstance(c, TpuExec) else c
+                         for c in node.children]
+        if isinstance(node, (TpuGlobalLimitExec, TpuLocalLimitExec)):
+            child = node.children[0]
+            # Limit(Sort) or Limit(Coalesce(Exchange(Sort)))
+            if isinstance(child, TpuSortExec):
+                return TpuTopNExec(node.n, child.orders, child.children[0],
+                                   child.ansi)
+        return node

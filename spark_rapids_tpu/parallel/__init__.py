@@ -1,0 +1,5 @@
+from spark_rapids_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    distributed_agg_step,
+    distributed_shuffle_agg_step,
+)

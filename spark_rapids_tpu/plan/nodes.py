@@ -1,0 +1,453 @@
+"""CPU physical plan nodes — the stand-in for Spark's SparkPlan tree.
+
+The reference is a *plugin*: Spark hands it a physical plan and GpuOverrides
+rewrites it (SURVEY.md §3.2).  This framework is standalone (no JVM in the
+loop), so it carries its own Catalyst-shaped physical plan; the node names
+deliberately mirror Spark's (ProjectExec, FilterExec, HashAggregateExec,
+SortMergeJoinExec, ShuffleExchangeExec...) so that the overrides layer, the
+fallback-explain output, and the tests read exactly like the reference's.
+
+Every node can execute on CPU via the oracle (spark_rapids_tpu/cpu/) — that
+CPU path plays the role CPU Spark plays for the reference: the golden
+differential baseline AND the fallback target for untagged nodes.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.base import Alias, Expression
+from spark_rapids_tpu.ops.sortkeys import SortSpec
+
+
+class SparkPlan:
+    """Base physical plan node (CPU side)."""
+
+    def __init__(self, children: Sequence["SparkPlan"]):
+        self.children: List[SparkPlan] = list(children)
+
+    @property
+    def output(self) -> T.StructType:
+        raise NotImplementedError
+
+    @property
+    def node_name(self) -> str:
+        return type(self).__name__
+
+    def pretty(self, indent: int = 0) -> str:
+        s = "  " * indent + self.describe()
+        for c in self.children:
+            s += "\n" + c.pretty(indent + 1)
+        return s
+
+    def describe(self) -> str:
+        return self.node_name
+
+    def with_new_children(self, children: Sequence["SparkPlan"]) -> "SparkPlan":
+        import copy
+
+        n = copy.copy(self)
+        n.children = list(children)
+        return n
+
+
+class LocalTableScan(SparkPlan):
+    def __init__(self, host_columns, schema: T.StructType):
+        super().__init__([])
+        self.host_columns = host_columns  # List[HostColumn]
+        self._schema = schema
+
+    @property
+    def output(self):
+        return self._schema
+
+    def describe(self):
+        return f"LocalTableScan {self._schema.simpleString}"
+
+
+class FileSourceScan(SparkPlan):
+    def __init__(self, fmt: str, paths: List[str], schema: T.StructType,
+                 pushed_filters: Optional[List[Expression]] = None,
+                 options: Optional[dict] = None):
+        super().__init__([])
+        self.fmt = fmt
+        self.paths = list(paths)
+        self._schema = schema
+        self.pushed_filters = list(pushed_filters or [])
+        self.options = dict(options or {})
+
+    @property
+    def output(self):
+        return self._schema
+
+    def describe(self):
+        return f"FileSourceScan {self.fmt} {len(self.paths)} files"
+
+
+class RangeNode(SparkPlan):
+    """spark.range(start, end, step) — GpuRangeExec analog."""
+
+    def __init__(self, start: int, end: int, step: int = 1):
+        super().__init__([])
+        self.start, self.end, self.step = start, end, step
+
+    @property
+    def output(self):
+        return T.StructType([T.StructField("id", T.LONG, nullable=False)])
+
+    def describe(self):
+        return f"Range ({self.start}, {self.end}, step={self.step})"
+
+
+class Project(SparkPlan):
+    def __init__(self, exprs: List[Expression], child: SparkPlan):
+        super().__init__([child])
+        self.exprs = exprs
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        return T.StructType([
+            T.StructField(e.name, e.dataType, e.nullable) for e in self.exprs])
+
+    def describe(self):
+        return "Project [" + ", ".join(e.sql_string() for e in self.exprs) + "]"
+
+
+class Filter(SparkPlan):
+    def __init__(self, condition: Expression, child: SparkPlan):
+        super().__init__([child])
+        self.condition = condition
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def describe(self):
+        return f"Filter ({self.condition.sql_string()})"
+
+
+class AggregateMode(enum.Enum):
+    PARTIAL = "Partial"
+    FINAL = "Final"
+    COMPLETE = "Complete"
+
+
+@dataclasses.dataclass
+class AggregateExpression:
+    """One aggregate: func name + input expr (resolved) + result name.
+
+    func in {sum, count, min, max, avg, first, last, count_star}.
+    """
+
+    func: str
+    child: Optional[Expression]  # None for count(*)
+    result_name: str
+    result_type: Optional[T.DataType] = None
+    distinct: bool = False
+
+    def resolve(self, schema: T.StructType) -> "AggregateExpression":
+        if self.child is not None:
+            self.child = self.child.resolve(schema)
+        self.result_type = self._compute_type()
+        return self
+
+    def _compute_type(self) -> T.DataType:
+        if self.func in ("count", "count_star"):
+            return T.LONG
+        ct = self.child.dataType
+        if self.func == "sum":
+            if isinstance(ct, T.DecimalType):
+                return T.DecimalType(min(ct.precision + 10, 38), ct.scale)
+            if ct.is_integral:
+                return T.LONG
+            return T.DOUBLE
+        if self.func == "avg":
+            if isinstance(ct, T.DecimalType):
+                return T.DecimalType(min(ct.precision + 4, 38),
+                                     min(ct.scale + 4, 38))
+            return T.DOUBLE
+        return ct  # min/max/first/last
+
+    def describe(self):
+        inner = self.child.sql_string() if self.child is not None else "*"
+        return f"{self.func}({inner}) AS {self.result_name}"
+
+
+class HashAggregate(SparkPlan):
+    def __init__(self, grouping: List[Expression],
+                 aggregates: List[AggregateExpression],
+                 mode: AggregateMode, child: SparkPlan):
+        super().__init__([child])
+        self.grouping = grouping
+        self.aggregates = aggregates
+        self.mode = mode
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        fields = [T.StructField(g.name, g.dataType, g.nullable)
+                  for g in self.grouping]
+        if self.mode == AggregateMode.PARTIAL:
+            for a in self.aggregates:
+                if a.func == "avg":
+                    fields.append(T.StructField(a.result_name + "_sum", T.DOUBLE
+                                  if not isinstance(a.result_type, T.DecimalType)
+                                  else T.DecimalType(38, a.child.dataType.scale)))
+                    fields.append(T.StructField(a.result_name + "_count", T.LONG))
+                else:
+                    fields.append(T.StructField(a.result_name, a.result_type))
+        else:
+            fields += [T.StructField(a.result_name, a.result_type)
+                       for a in self.aggregates]
+        return T.StructType(fields)
+
+    def describe(self):
+        g = ", ".join(e.sql_string() for e in self.grouping)
+        a = ", ".join(a.describe() for a in self.aggregates)
+        return f"HashAggregate({self.mode.value}) keys=[{g}] aggs=[{a}]"
+
+
+class JoinType(enum.Enum):
+    INNER = "Inner"
+    LEFT_OUTER = "LeftOuter"
+    RIGHT_OUTER = "RightOuter"
+    FULL_OUTER = "FullOuter"
+    LEFT_SEMI = "LeftSemi"
+    LEFT_ANTI = "LeftAnti"
+    CROSS = "Cross"
+
+
+class _BaseJoin(SparkPlan):
+    def __init__(self, left: SparkPlan, right: SparkPlan,
+                 left_keys: List[Expression], right_keys: List[Expression],
+                 join_type: JoinType,
+                 condition: Optional[Expression] = None):
+        super().__init__([left, right])
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.join_type = join_type
+        self.condition = condition
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    @property
+    def output(self):
+        lt, rt = self.join_type, JoinType
+        lf = list(self.left.output.fields)
+        rf = list(self.right.output.fields)
+        if self.join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+            return T.StructType(lf)
+        if self.join_type in (JoinType.LEFT_OUTER, JoinType.FULL_OUTER):
+            rf = [T.StructField(f.name, f.dataType, True) for f in rf]
+        if self.join_type in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER):
+            lf = [T.StructField(f.name, f.dataType, True) for f in lf]
+        return T.StructType(lf + rf)
+
+    def describe(self):
+        keys = ", ".join(
+            f"{l.sql_string()}={r.sql_string()}"
+            for l, r in zip(self.left_keys, self.right_keys))
+        return f"{self.node_name} {self.join_type.value} [{keys}]"
+
+
+class SortMergeJoin(_BaseJoin):
+    pass
+
+
+class ShuffledHashJoin(_BaseJoin):
+    pass
+
+
+class BroadcastHashJoin(_BaseJoin):
+    def __init__(self, *args, build_side: str = "right", **kw):
+        super().__init__(*args, **kw)
+        self.build_side = build_side
+
+
+class Sort(SparkPlan):
+    def __init__(self, orders: List[Tuple[Expression, SortSpec]],
+                 is_global: bool, child: SparkPlan):
+        super().__init__([child])
+        self.orders = orders
+        self.is_global = is_global
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def describe(self):
+        o = ", ".join(
+            f"{e.sql_string()} {'ASC' if s.ascending else 'DESC'}"
+            for e, s in self.orders)
+        return f"Sort [{o}] global={self.is_global}"
+
+
+class SinglePartitioning:
+    num_partitions = 1
+
+    def describe(self):
+        return "SinglePartition"
+
+
+@dataclasses.dataclass
+class HashPartitioning:
+    keys: List[Expression]
+    num_partitions: int
+
+    def describe(self):
+        k = ", ".join(e.sql_string() for e in self.keys)
+        return f"hashpartitioning({k}, {self.num_partitions})"
+
+
+@dataclasses.dataclass
+class RangePartitioning:
+    orders: List[Tuple[Expression, SortSpec]]
+    num_partitions: int
+
+    def describe(self):
+        return f"rangepartitioning({self.num_partitions})"
+
+
+@dataclasses.dataclass
+class RoundRobinPartitioning:
+    num_partitions: int
+
+    def describe(self):
+        return f"roundrobin({self.num_partitions})"
+
+
+class Exchange(SparkPlan):
+    """ShuffleExchangeExec analog."""
+
+    def __init__(self, partitioning, child: SparkPlan):
+        super().__init__([child])
+        self.partitioning = partitioning
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def describe(self):
+        return f"Exchange {self.partitioning.describe()}"
+
+
+class BroadcastExchange(SparkPlan):
+    def __init__(self, child: SparkPlan):
+        super().__init__([child])
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+
+@dataclasses.dataclass
+class WindowFunction:
+    """window function spec: func over (partition, order, frame)."""
+
+    func: str                      # row_number, rank, dense_rank, sum, ...
+    child: Optional[Expression]
+    result_name: str
+    result_type: Optional[T.DataType] = None
+
+    def resolve(self, schema):
+        if self.child is not None:
+            self.child = self.child.resolve(schema)
+        if self.func in ("row_number", "rank", "dense_rank"):
+            self.result_type = T.INT
+        elif self.func == "count":
+            self.result_type = T.LONG
+        elif self.func == "sum":
+            ct = self.child.dataType
+            if isinstance(ct, T.DecimalType):
+                self.result_type = T.DecimalType(min(ct.precision + 10, 38), ct.scale)
+            elif ct.is_integral:
+                self.result_type = T.LONG
+            else:
+                self.result_type = T.DOUBLE
+        elif self.func == "avg":
+            self.result_type = T.DOUBLE
+        else:
+            self.result_type = self.child.dataType
+        return self
+
+
+class Window(SparkPlan):
+    def __init__(self, functions: List[WindowFunction],
+                 partition_by: List[Expression],
+                 order_by: List[Tuple[Expression, SortSpec]],
+                 child: SparkPlan,
+                 frame: str = "running"):
+        super().__init__([child])
+        self.functions = functions
+        self.partition_by = partition_by
+        self.order_by = order_by
+        self.frame = frame  # "running" | "unbounded" | (lo, hi) bounded rows
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        fields = list(self.child.output.fields)
+        fields += [T.StructField(f.result_name, f.result_type)
+                   for f in self.functions]
+        return T.StructType(fields)
+
+    def describe(self):
+        fns = ", ".join(f.func for f in self.functions)
+        return f"Window [{fns}] frame={self.frame}"
+
+
+class LocalLimit(SparkPlan):
+    def __init__(self, n: int, child: SparkPlan):
+        super().__init__([child])
+        self.n = n
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def describe(self):
+        return f"LocalLimit {self.n}"
+
+
+class GlobalLimit(LocalLimit):
+    def describe(self):
+        return f"GlobalLimit {self.n}"
+
+
+class Union(SparkPlan):
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def describe(self):
+        return f"Union ({len(self.children)} children)"

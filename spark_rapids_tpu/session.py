@@ -1,0 +1,417 @@
+"""TpuSession / DataFrame — the user entry point.
+
+Role: in the reference, users keep using SparkSession and the plugin hooks in
+via ``spark.plugins=com.nvidia.spark.SQLPlugin`` (SURVEY.md §3.1).  This
+standalone framework has no JVM, so TpuSession plays both roles: it builds
+Catalyst-shaped physical plans from a PySpark-flavored DataFrame API
+(select/filter/groupBy/join/orderBy...), plans aggregates two-phase around
+exchanges exactly like Spark (partial -> shuffle -> final), and at collect()
+time applies TpuOverrides (the ColumnarOverrideRules hook analog), executes
+the rewritten plan, and returns rows.
+
+``conf`` accepts the same ``spark.rapids.*`` keys as the reference;
+``spark.rapids.sql.enabled=false`` runs everything on the CPU oracle — which
+is precisely what the differential test harness does to get golden results.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import HostColumn
+from spark_rapids_tpu.config import SHUFFLE_PARTITIONS, TpuConf
+from spark_rapids_tpu.expr.base import (
+    Alias,
+    AttributeReference,
+    Expression,
+    col as _col,
+    lit as _lit,
+)
+from spark_rapids_tpu.ops.sortkeys import SortSpec
+from spark_rapids_tpu.plan import nodes as PN
+
+ColumnLike = Union[str, Expression]
+
+
+def _to_expr(c: ColumnLike) -> Expression:
+    if isinstance(c, Expression):
+        return c
+    return _col(c)
+
+
+class TpuSession:
+    def __init__(self, conf: Optional[Dict[str, str]] = None):
+        self.conf = TpuConf(conf or {})
+
+    @staticmethod
+    def builder() -> "TpuSessionBuilder":
+        return TpuSessionBuilder()
+
+    def set_conf(self, key: str, value) -> "TpuSession":
+        self.conf = self.conf.set(key, value)
+        return self
+
+    # -- data sources ---------------------------------------------------
+    def create_dataframe(self, data, schema: T.StructType) -> "DataFrame":
+        if isinstance(data, dict):
+            cols = [HostColumn.from_pylist(data[f.name], f.dataType)
+                    for f in schema.fields]
+        else:  # rows
+            cols = []
+            for i, f in enumerate(schema.fields):
+                cols.append(HostColumn.from_pylist(
+                    [r[i] for r in data], f.dataType))
+        return DataFrame(PN.LocalTableScan(cols, schema), self)
+
+    createDataFrame = create_dataframe
+
+    def range(self, start: int, end: Optional[int] = None,
+              step: int = 1) -> "DataFrame":
+        if end is None:
+            start, end = 0, start
+        return DataFrame(PN.RangeNode(start, end, step), self)
+
+    @property
+    def read(self) -> "DataFrameReader":
+        return DataFrameReader(self)
+
+    @property
+    def shuffle_partitions(self) -> int:
+        return self.conf.get(SHUFFLE_PARTITIONS)
+
+
+class TpuSessionBuilder:
+    def __init__(self):
+        self._conf: Dict[str, str] = {}
+
+    def config(self, key: str, value) -> "TpuSessionBuilder":
+        self._conf[key] = value
+        return self
+
+    def get_or_create(self) -> TpuSession:
+        return TpuSession(self._conf)
+
+    getOrCreate = get_or_create
+
+
+class DataFrameReader:
+    def __init__(self, session: TpuSession):
+        self.session = session
+        self._options: Dict[str, str] = {}
+        self._schema: Optional[T.StructType] = None
+
+    def option(self, k, v) -> "DataFrameReader":
+        self._options[k] = v
+        return self
+
+    def schema(self, s: T.StructType) -> "DataFrameReader":
+        self._schema = s
+        return self
+
+    def _infer_schema(self, fmt: str, paths: List[str]) -> T.StructType:
+        import pyarrow as pa
+
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+
+            arrow_schema = pq.read_schema(paths[0])
+        elif fmt == "csv":
+            import pyarrow.csv as pacsv
+
+            arrow_schema = pacsv.read_csv(paths[0]).schema
+        else:
+            import pyarrow.json as pajson
+
+            arrow_schema = pajson.read_json(paths[0]).schema
+        fields = []
+        for f in arrow_schema:
+            fields.append(T.StructField(f.name, _arrow_to_sql(f.type),
+                                        f.nullable))
+        return T.StructType(fields)
+
+    def parquet(self, *paths: str) -> "DataFrame":
+        schema = self._schema or self._infer_schema("parquet", list(paths))
+        return DataFrame(
+            PN.FileSourceScan("parquet", list(paths), schema,
+                              options=self._options), self.session)
+
+    def csv(self, *paths: str) -> "DataFrame":
+        schema = self._schema or self._infer_schema("csv", list(paths))
+        return DataFrame(
+            PN.FileSourceScan("csv", list(paths), schema,
+                              options=self._options), self.session)
+
+    def json(self, *paths: str) -> "DataFrame":
+        schema = self._schema or self._infer_schema("json", list(paths))
+        return DataFrame(
+            PN.FileSourceScan("json", list(paths), schema,
+                              options=self._options), self.session)
+
+
+def _arrow_to_sql(t) -> T.DataType:
+    import pyarrow as pa
+
+    if pa.types.is_boolean(t):
+        return T.BOOLEAN
+    if pa.types.is_int8(t):
+        return T.BYTE
+    if pa.types.is_int16(t):
+        return T.SHORT
+    if pa.types.is_int32(t):
+        return T.INT
+    if pa.types.is_int64(t):
+        return T.LONG
+    if pa.types.is_float32(t):
+        return T.FLOAT
+    if pa.types.is_float64(t):
+        return T.DOUBLE
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return T.STRING
+    if pa.types.is_date32(t):
+        return T.DATE
+    if pa.types.is_timestamp(t):
+        return T.TIMESTAMP
+    if pa.types.is_decimal(t):
+        return T.DecimalType(t.precision, t.scale)
+    raise TypeError(f"unsupported arrow type {t}")
+
+
+class DataFrame:
+    def __init__(self, plan: PN.SparkPlan, session: TpuSession):
+        self.plan = plan
+        self.session = session
+
+    @property
+    def schema(self) -> T.StructType:
+        return self.plan.output
+
+    @property
+    def columns(self) -> List[str]:
+        return self.schema.field_names()
+
+    # -- transformations ------------------------------------------------
+    def select(self, *cols: ColumnLike) -> "DataFrame":
+        exprs = [_named(_to_expr(c).resolve(self.schema), i)
+                 for i, c in enumerate(cols)]
+        return DataFrame(PN.Project(exprs, self.plan), self.session)
+
+    def with_column(self, name: str, e: Expression) -> "DataFrame":
+        exprs = []
+        for f in self.schema.fields:
+            if f.name != name:
+                exprs.append(Alias(_col(f.name).resolve(self.schema), f.name))
+                exprs[-1].resolve(self.schema)
+        newe = Alias(e.resolve(self.schema), name)
+        newe.resolve(self.schema)
+        exprs.append(newe)
+        return DataFrame(PN.Project(exprs, self.plan), self.session)
+
+    withColumn = with_column
+
+    def filter(self, cond: Expression) -> "DataFrame":
+        return DataFrame(
+            PN.Filter(cond.resolve(self.schema), self.plan), self.session)
+
+    where = filter
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(PN.Union([self.plan, other.plan]), self.session)
+
+    def group_by(self, *cols: ColumnLike) -> "GroupedData":
+        return GroupedData(self, [_to_expr(c).resolve(self.schema)
+                                  for c in cols])
+
+    groupBy = group_by
+
+    def agg(self, *aggs) -> "DataFrame":
+        return GroupedData(self, []).agg(*aggs)
+
+    def join(self, other: "DataFrame", on, how: str = "inner") -> "DataFrame":
+        jt = {"inner": PN.JoinType.INNER, "left": PN.JoinType.LEFT_OUTER,
+              "left_outer": PN.JoinType.LEFT_OUTER,
+              "right": PN.JoinType.RIGHT_OUTER,
+              "right_outer": PN.JoinType.RIGHT_OUTER,
+              "outer": PN.JoinType.FULL_OUTER,
+              "full": PN.JoinType.FULL_OUTER,
+              "full_outer": PN.JoinType.FULL_OUTER,
+              "left_semi": PN.JoinType.LEFT_SEMI, "semi": PN.JoinType.LEFT_SEMI,
+              "left_anti": PN.JoinType.LEFT_ANTI, "anti": PN.JoinType.LEFT_ANTI,
+              "cross": PN.JoinType.CROSS}[how.lower()]
+        if isinstance(on, str):
+            on = [on]
+        lkeys = [_col(k).resolve(self.schema) for k in on] if on else []
+        rkeys = [_col(k).resolve(other.schema) for k in on] if on else []
+        np_ = self.session.shuffle_partitions
+        if jt == PN.JoinType.CROSS:
+            node = PN.SortMergeJoin(self.plan, other.plan, [], [], jt)
+            return DataFrame(node, self.session)
+        # broadcast if the right side is a small local/file scan
+        if _is_broadcastable(other.plan):
+            node = PN.BroadcastHashJoin(
+                self.plan, PN.BroadcastExchange(other.plan), lkeys, rkeys, jt)
+            return DataFrame(node, self.session)
+        lex = PN.Exchange(PN.HashPartitioning(lkeys, np_), self.plan)
+        rex = PN.Exchange(PN.HashPartitioning(rkeys, np_), other.plan)
+        node = PN.SortMergeJoin(lex, rex, lkeys, rkeys, jt)
+        return DataFrame(node, self.session)
+
+    def order_by(self, *cols, ascending=None) -> "DataFrame":
+        orders = []
+        for i, c in enumerate(cols):
+            if isinstance(c, tuple):
+                e, spec = c
+            else:
+                asc = (ascending[i] if isinstance(ascending, (list, tuple))
+                       else (ascending if ascending is not None else True))
+                e = _to_expr(c)
+                spec = SortSpec(ascending=asc, nulls_first=asc)
+            orders.append((e.resolve(self.schema), spec))
+        return DataFrame(PN.Sort(orders, True, self.plan), self.session)
+
+    orderBy = order_by
+    sort = order_by
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(PN.GlobalLimit(n, self.plan), self.session)
+
+    def window(self, functions: List[PN.WindowFunction],
+               partition_by: Sequence[ColumnLike],
+               order_by: Sequence, frame: str = "running") -> "DataFrame":
+        pb = [_to_expr(c).resolve(self.schema) for c in partition_by]
+        ob = []
+        for c in order_by:
+            if isinstance(c, tuple):
+                e, spec = c
+            else:
+                e, spec = _to_expr(c), SortSpec()
+            ob.append((e.resolve(self.schema), spec))
+        fns = [f.resolve(self.schema) for f in functions]
+        return DataFrame(PN.Window(fns, pb, ob, self.plan, frame),
+                         self.session)
+
+    # -- actions --------------------------------------------------------
+    def _planned(self):
+        """Apply TpuOverrides; the planned exec tree is cached per conf so
+        repeated collects reuse compiled XLA programs (Spark likewise reuses
+        a query's compiled stages across executions of the same plan)."""
+        from spark_rapids_tpu.overrides import TpuOverrides
+
+        conf = self.session.conf
+        if not conf.sql_enabled:
+            return self.plan, None
+        cache_key = tuple(sorted((k, str(v))
+                                 for k, v in conf.settings.items()))
+        cached = getattr(self, "_plan_cache", None)
+        if cached is not None and cached[0] == cache_key:
+            return cached[1], cached[2]
+        root, meta = TpuOverrides.apply(self.plan, conf)
+        self._plan_cache = (cache_key, root, meta)
+        return root, meta
+
+    def collect(self) -> List[tuple]:
+        from spark_rapids_tpu.cpu.oracle import execute_cpu_plan
+        from spark_rapids_tpu.exec.base import TpuExec
+        from spark_rapids_tpu.exec.transitions import TpuColumnarToRowExec
+
+        root, _meta = self._planned()
+        if isinstance(root, TpuExec):
+            host = TpuColumnarToRowExec(root).collect_host()
+            lists = [h.to_pylist() for h in host]
+            return list(zip(*lists)) if lists else []
+        cols, n = execute_cpu_plan(root, ansi=self.session.conf.ansi_enabled)
+        lists = [c.to_pylist() for c in cols]
+        return list(zip(*lists)) if lists else []
+
+    def to_pydict(self) -> Dict[str, list]:
+        rows = self.collect()
+        names = self.columns
+        return {n: [r[i] for r in rows] for i, n in enumerate(names)}
+
+    def count(self) -> int:
+        rows = self.agg(("count_star", None, "count")).collect()
+        return int(rows[0][0]) if rows else 0
+
+    def explain(self, mode: str = "formatted") -> str:
+        from spark_rapids_tpu.exec.base import TpuExec
+
+        root, meta = self._planned()
+        s = root.pretty() if isinstance(root, TpuExec) else root.pretty()
+        if meta is not None:
+            fb = meta.explain(only_fallback=True)
+            if fb:
+                s += "\nFallback reasons:\n" + fb
+        return s
+
+
+def _is_broadcastable(plan: PN.SparkPlan) -> bool:
+    if isinstance(plan, PN.LocalTableScan):
+        n = plan.host_columns[0].num_rows if plan.host_columns else 0
+        return n <= 100_000
+    return False
+
+
+def _named(e: Expression, i: int) -> Expression:
+    return e
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, keys: List[Expression]):
+        self.df = df
+        self.keys = keys
+
+    def agg(self, *aggs) -> "DataFrame":
+        """aggs: tuples (func, column-or-None, result_name) or
+        AggregateExpression."""
+        schema = self.df.schema
+        aexprs: List[PN.AggregateExpression] = []
+        for a in aggs:
+            if isinstance(a, PN.AggregateExpression):
+                aexprs.append(a.resolve(schema))
+            else:
+                func, child, name = a
+                ce = _to_expr(child) if child is not None else None
+                aexprs.append(PN.AggregateExpression(
+                    func, ce, name).resolve(schema))
+        np_ = self.df.session.shuffle_partitions
+        partial = PN.HashAggregate(self.keys, aexprs,
+                                   PN.AggregateMode.PARTIAL, self.df.plan)
+        if self.keys:
+            # re-key the exchange + final agg on the partial output
+            pschema = partial.output
+            fkeys = [AttributeReference(g.name).resolve(pschema)
+                     for g in self.keys]
+            ex = PN.Exchange(PN.HashPartitioning(fkeys, np_), partial)
+        else:
+            fkeys = []
+            ex = PN.Exchange(PN.SinglePartitioning(), partial)
+        final_aggs = [PN.AggregateExpression(a.func, a.child, a.result_name,
+                                             a.result_type)
+                      for a in aexprs]
+        final = PN.HashAggregate(fkeys, final_aggs,
+                                 PN.AggregateMode.FINAL, ex)
+        return DataFrame(final, self.df.session)
+
+
+# convenience re-exports (pyspark.sql.functions flavored)
+col = _col
+lit = _lit
+
+
+def sum_(c: ColumnLike, name: str = "sum") -> Tuple[str, ColumnLike, str]:
+    return ("sum", c, name)
+
+
+def count_(c: Optional[ColumnLike] = None, name: str = "count"):
+    return ("count", c, name) if c is not None else ("count_star", None, name)
+
+
+def min_(c: ColumnLike, name: str = "min"):
+    return ("min", c, name)
+
+
+def max_(c: ColumnLike, name: str = "max"):
+    return ("max", c, name)
+
+
+def avg_(c: ColumnLike, name: str = "avg"):
+    return ("avg", c, name)

@@ -1,0 +1,104 @@
+"""Differential assertions — the reference's asserts.py reproduced.
+
+Reference analog: integration_tests/src/main/python/asserts.py
+(assert_gpu_and_cpu_are_equal_collect, assert_gpu_fallback_collect):
+golden-ness comes from running the SAME query with the accelerator disabled
+(there: CPU Spark; here: the CPU oracle), not from stored fixtures.
+"""
+from __future__ import annotations
+
+import math
+from decimal import Decimal
+from typing import Callable, Optional
+
+from spark_rapids_tpu.session import DataFrame, TpuSession
+
+
+def _normalize(v, approx_float: bool):
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "NaN"
+        if v == 0.0:
+            return 0.0  # -0.0 and 0.0 are equal values in Spark comparisons
+        if approx_float:
+            # 12 significant digits: tolerates backend ULP differences in
+            # division/transcendentals (the reference's @approximate_float)
+            return float(f"{v:.12g}")
+    if isinstance(v, Decimal):
+        return ("dec", str(v.normalize()))
+    return v
+
+
+def _rows_key(rows, approx_float):
+    return sorted(
+        (tuple(str(type(v).__name__) + ":" + repr(_normalize(v, approx_float))
+               for v in r) for r in rows))
+
+
+def assert_tpu_and_cpu_are_equal_collect(
+        build_df: Callable[[TpuSession], DataFrame],
+        conf: Optional[dict] = None,
+        ignore_order: bool = True,
+        approximate_float: bool = False):
+    """Run the query with the TPU plan rewrite on and off; compare rows."""
+    conf = dict(conf or {})
+    cpu_conf = dict(conf)
+    cpu_conf["spark.rapids.sql.enabled"] = False
+    tpu_conf = dict(conf)
+    tpu_conf["spark.rapids.sql.enabled"] = True
+
+    cpu_rows = build_df(TpuSession(cpu_conf)).collect()
+    tpu_rows = build_df(TpuSession(tpu_conf)).collect()
+
+    if ignore_order:
+        ck, tk = _rows_key(cpu_rows, approximate_float), _rows_key(
+            tpu_rows, approximate_float)
+    else:
+        ck = [tuple(_normalize(v, approximate_float) for v in r)
+              for r in cpu_rows]
+        tk = [tuple(_normalize(v, approximate_float) for v in r)
+              for r in tpu_rows]
+    assert len(cpu_rows) == len(tpu_rows), (
+        f"row count differs: CPU {len(cpu_rows)} vs TPU {len(tpu_rows)}")
+    for i, (c, t) in enumerate(zip(ck, tk)):
+        assert c == t, (f"row {i} differs:\nCPU: {c}\nTPU: {t}")
+
+
+def assert_tpu_fallback_collect(
+        build_df: Callable[[TpuSession], DataFrame],
+        cpu_class: str,
+        conf: Optional[dict] = None):
+    """Assert results match AND the named exec fell back to CPU.
+
+    Reference analog: assert_gpu_fallback_collect(df, 'ProjectExec')."""
+    conf = dict(conf or {})
+    conf["spark.rapids.sql.enabled"] = True
+    df = build_df(TpuSession(conf))
+    root, meta = df._planned()
+
+    def find_fallback(m):
+        if type(m.plan).__name__ == cpu_class and not m.can_this_run:
+            return True
+        return any(find_fallback(c) for c in m.child_metas)
+
+    assert meta is not None and find_fallback(meta), (
+        f"expected {cpu_class} to fall back to CPU but it did not;\n"
+        + (meta.explain(only_fallback=False) if meta else ""))
+    # and the results must still be correct
+    assert_tpu_and_cpu_are_equal_collect(build_df, conf)
+
+
+def assert_plan_on_tpu(build_df: Callable[[TpuSession], DataFrame],
+                       conf: Optional[dict] = None):
+    """Assert NO node fell back."""
+    conf = dict(conf or {})
+    conf["spark.rapids.sql.enabled"] = True
+    df = build_df(TpuSession(conf))
+    root, meta = df._planned()
+
+    def all_ok(m):
+        return m.can_this_run and all(all_ok(c) for c in m.child_metas)
+
+    assert meta is not None and all_ok(meta), (
+        "expected full TPU plan but got fallbacks:\n"
+        + (meta.explain(only_fallback=True) if meta else ""))

@@ -1,0 +1,29 @@
+"""Test harness config.
+
+Mirrors integration_tests/src/main/python/conftest.py in the reference:
+tests run the same query twice — TPU plugin on vs off — and compare.  Tests
+run on the XLA CPU backend with a virtual 8-device mesh
+(xla_force_host_platform_device_count) so the full suite, including
+multi-chip sharding tests, runs on any machine; the same code paths execute
+unchanged on real TPU chips.
+"""
+import os
+
+# Force the CPU backend for tests (SRT_TEST_ON_TPU=1 opts into real chips).
+# Note: the container's sitecustomize may have pre-registered a TPU plugin;
+# JAX_PLATFORMS=cpu keeps execution on the XLA CPU backend regardless.
+if os.environ.get("SRT_TEST_ON_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+xf = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in xf:
+    os.environ["XLA_FLAGS"] = (
+        xf + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tpu_session():
+    from spark_rapids_tpu.session import TpuSession
+
+    return TpuSession({"spark.rapids.sql.enabled": True})

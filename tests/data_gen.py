@@ -1,0 +1,215 @@
+"""Composable seeded random data generators.
+
+Reference analog: integration_tests/src/main/python/data_gen.py (IntegerGen,
+LongGen, DoubleGen w/ special values, StringGen, DecimalGen, DateGen,
+TimestampGen, BooleanGen, NullGen; nullable wrappers; seeded determinism).
+The generator zoo is the backbone of the differential harness: wide value
+coverage (boundaries, NaN/inf, nulls) with reproducible seeds.
+"""
+from __future__ import annotations
+
+import datetime
+import math
+import random
+import string as _string
+from decimal import Decimal
+from typing import List, Optional
+
+from spark_rapids_tpu import types as T
+
+DEFAULT_SEED = 20260729
+
+
+class DataGen:
+    def __init__(self, data_type: T.DataType, nullable: bool = True,
+                 null_prob: float = 0.08):
+        self.data_type = data_type
+        self.nullable = nullable
+        self.null_prob = null_prob if nullable else 0.0
+
+    def gen_value(self, rng: random.Random):
+        raise NotImplementedError
+
+    def gen(self, rng: random.Random):
+        if self.nullable and rng.random() < self.null_prob:
+            return None
+        return self.gen_value(rng)
+
+    def with_nullable(self, nullable: bool) -> "DataGen":
+        import copy
+
+        g = copy.copy(self)
+        g.nullable = nullable
+        g.null_prob = g.null_prob if nullable else 0.0
+        return g
+
+
+class _IntLike(DataGen):
+    def __init__(self, data_type, lo, hi, special, nullable=True,
+                 null_prob=0.08):
+        super().__init__(data_type, nullable, null_prob)
+        self.lo, self.hi = lo, hi
+        self.special = special
+
+    def gen_value(self, rng):
+        if rng.random() < 0.1:
+            return rng.choice(self.special)
+        return rng.randint(self.lo, self.hi)
+
+
+def ByteGen(nullable=True):
+    return _IntLike(T.BYTE, -128, 127, [-128, -1, 0, 1, 127], nullable)
+
+
+def ShortGen(nullable=True):
+    return _IntLike(T.SHORT, -(2**15), 2**15 - 1,
+                    [-(2**15), -1, 0, 1, 2**15 - 1], nullable)
+
+
+def IntegerGen(nullable=True, min_val=None, max_val=None, null_prob=0.08):
+    lo = min_val if min_val is not None else -(2**31)
+    hi = max_val if max_val is not None else 2**31 - 1
+    special = [v for v in [lo, -1, 0, 1, hi] if lo <= v <= hi]
+    return _IntLike(T.INT, lo, hi, special, nullable, null_prob)
+
+
+def LongGen(nullable=True, min_val=None, max_val=None, null_prob=0.08):
+    lo = min_val if min_val is not None else -(2**63)
+    hi = max_val if max_val is not None else 2**63 - 1
+    special = [v for v in [lo, -1, 0, 1, hi] if lo <= v <= hi]
+    return _IntLike(T.LONG, lo, hi, special, nullable, null_prob)
+
+
+class BooleanGen(DataGen):
+    def __init__(self, nullable=True, null_prob=0.08):
+        super().__init__(T.BOOLEAN, nullable, null_prob)
+
+    def gen_value(self, rng):
+        return rng.random() < 0.5
+
+
+class DoubleGen(DataGen):
+    def __init__(self, nullable=True, no_nans=False, min_exp=-30, max_exp=30,
+                 null_prob=0.08):
+        super().__init__(T.DOUBLE, nullable, null_prob)
+        self.no_nans = no_nans
+        self.min_exp, self.max_exp = min_exp, max_exp
+
+    def gen_value(self, rng):
+        r = rng.random()
+        if r < 0.08:
+            choices = [0.0, -0.0, 1.0, -1.0]
+            if not self.no_nans:
+                choices += [math.nan, math.inf, -math.inf]
+            return rng.choice(choices)
+        m = rng.uniform(-1.0, 1.0)
+        e = rng.randint(self.min_exp, self.max_exp)
+        return m * (10.0 ** e)
+
+
+class FloatGen(DoubleGen):
+    def __init__(self, nullable=True, no_nans=False):
+        super().__init__(nullable, no_nans, -10, 10)
+        self.data_type = T.FLOAT
+
+    def gen_value(self, rng):
+        import struct
+
+        v = super().gen_value(rng)
+        return struct.unpack("f", struct.pack("f", v))[0]
+
+
+class DecimalGen(DataGen):
+    def __init__(self, precision=10, scale=2, nullable=True):
+        super().__init__(T.DecimalType(precision, scale), nullable)
+        self.precision, self.scale = precision, scale
+
+    def gen_value(self, rng):
+        # keep within precision (and leave headroom for aggregation tests)
+        digits = min(self.precision, 15)
+        unscaled = rng.randint(-(10**digits - 1), 10**digits - 1)
+        return Decimal(unscaled).scaleb(-self.scale)
+
+
+class StringGen(DataGen):
+    def __init__(self, pattern: Optional[str] = None, nullable=True,
+                 min_len=0, max_len=20, charset=None):
+        super().__init__(T.STRING, nullable)
+        self.min_len, self.max_len = min_len, max_len
+        self.charset = charset or (_string.ascii_letters + _string.digits
+                                   + " _-.")
+
+    def gen_value(self, rng):
+        n = rng.randint(self.min_len, self.max_len)
+        return "".join(rng.choice(self.charset) for _ in range(n))
+
+
+class DateGen(DataGen):
+    def __init__(self, nullable=True,
+                 start=datetime.date(1940, 1, 1),
+                 end=datetime.date(2100, 12, 31)):
+        super().__init__(T.DATE, nullable)
+        self.start_days = (start - datetime.date(1970, 1, 1)).days
+        self.end_days = (end - datetime.date(1970, 1, 1)).days
+
+    def gen_value(self, rng):
+        return (datetime.date(1970, 1, 1) + datetime.timedelta(
+            days=rng.randint(self.start_days, self.end_days)))
+
+
+class TimestampGen(DataGen):
+    def __init__(self, nullable=True):
+        super().__init__(T.TIMESTAMP, nullable)
+
+    def gen_value(self, rng):
+        us = rng.randint(-30610224000 * 1_000_000 // 1000,
+                         4102444800 * 1_000_000)
+        return (datetime.datetime(1970, 1, 1,
+                                  tzinfo=datetime.timezone.utc)
+                + datetime.timedelta(microseconds=us))
+
+
+class NullGen(DataGen):
+    def __init__(self):
+        super().__init__(T.NULL, True, 1.0)
+
+    def gen_value(self, rng):
+        return None
+
+
+class SetValuesGen(DataGen):
+    """Draw from a fixed set (for skewed keys etc.)."""
+
+    def __init__(self, data_type, values: List, nullable=True):
+        super().__init__(data_type, nullable)
+        self.values = values
+
+    def gen_value(self, rng):
+        return rng.choice(self.values)
+
+
+def gen_df(session, gens: List, names: Optional[List[str]] = None,
+           length: int = 512, seed: int = DEFAULT_SEED):
+    """Build a DataFrame of `length` rows from generator list.
+
+    Reference analog: data_gen.py gen_df(spark, gen_list)."""
+    rng = random.Random(seed)
+    names = names or [f"c{i}" for i in range(len(gens))]
+    data = {}
+    for name, g in zip(names, gens):
+        data[name] = [g.gen(rng) for _ in range(length)]
+    schema = T.StructType([
+        T.StructField(n, g.data_type, g.nullable)
+        for n, g in zip(names, gens)])
+    return session.create_dataframe(data, schema)
+
+
+# canonical generator sets, as the reference groups them
+numeric_gens = [ByteGen(), ShortGen(), IntegerGen(), LongGen(),
+                FloatGen(), DoubleGen()]
+integral_gens = [ByteGen(), ShortGen(), IntegerGen(), LongGen()]
+decimal_gens = [DecimalGen(7, 3), DecimalGen(12, 2), DecimalGen(18, 6)]
+string_gens = [StringGen(), StringGen(min_len=1, max_len=5)]
+date_gens = [DateGen()]
+all_basic_gens = (numeric_gens + [BooleanGen(), StringGen(), DateGen(),
+                                  TimestampGen()])

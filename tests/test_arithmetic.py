@@ -1,0 +1,117 @@
+"""Arithmetic differential tests (reference: arithmetic_ops_test.py)."""
+import pytest
+
+from spark_rapids_tpu.session import col, lit
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import (
+    ByteGen,
+    DecimalGen,
+    DoubleGen,
+    IntegerGen,
+    LongGen,
+    ShortGen,
+    gen_df,
+)
+
+_int_gens = [ByteGen(), ShortGen(),
+             IntegerGen(min_val=-10**6, max_val=10**6),
+             LongGen(min_val=-10**9, max_val=10**9)]
+
+
+@pytest.mark.parametrize("gen", _int_gens + [DoubleGen()],
+                         ids=lambda g: type(g).__name__)
+@pytest.mark.parametrize("op", ["+", "-", "*"])
+def test_binary_numeric(gen, op):
+    def build(s):
+        df = gen_df(s, [gen, gen], ["a", "b"], length=200)
+        e = {"+": col("a") + col("b"), "-": col("a") - col("b"),
+             "*": col("a") * col("b")}[op]
+        return df.select(e.alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_divide_double():
+    def build(s):
+        df = gen_df(s, [DoubleGen(), DoubleGen()], ["a", "b"], length=200)
+        return df.select((col("a") / col("b")).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_divide_by_zero_is_null():
+    def build(s):
+        df = gen_df(s, [IntegerGen()], ["a"], length=50)
+        return df.select((col("a") / lit(0)).alias("r"),
+                         (col("a") % lit(0)).alias("m"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_integral_divide_and_remainder():
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=-1000, max_val=1000),
+                        IntegerGen(min_val=-7, max_val=7)], ["a", "b"],
+                    length=300)
+        from spark_rapids_tpu.expr.arithmetic import IntegralDivide
+
+        return df.select(IntegralDivide(col("a"), col("b")).alias("d"),
+                         (col("a") % col("b")).alias("m"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("gen", [DecimalGen(7, 3), DecimalGen(12, 2),
+                                 DecimalGen(10, 0)],
+                         ids=lambda g: g.data_type.simpleString)
+def test_decimal_add_sub_mul(gen):
+    def build(s):
+        small = DecimalGen(5, 2)
+        df = gen_df(s, [gen, small], ["a", "b"], length=200)
+        return df.select((col("a") + col("b")).alias("p"),
+                         (col("a") - col("b")).alias("m"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_decimal_multiply():
+    def build(s):
+        df = gen_df(s, [DecimalGen(7, 2), DecimalGen(5, 1)], ["a", "b"],
+                    length=200)
+        return df.select((col("a") * col("b")).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_unary_minus_abs():
+    from spark_rapids_tpu.expr.arithmetic import Abs
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=-10**6, max_val=10**6),
+                        DoubleGen()], ["a", "b"], length=200)
+        return df.select((-col("a")).alias("na"), Abs(col("a")).alias("aa"),
+                         (-col("b")).alias("nb"), Abs(col("b")).alias("ab"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_int_overflow_wraps_legacy():
+    def build(s):
+        df = gen_df(s, [LongGen()], ["a"], length=100)
+        return df.select((col("a") * col("a")).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_ansi_overflow_raises():
+    from spark_rapids_tpu.expr.base import SparkArithmeticException
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu import types as T
+
+    s = TpuSession({"spark.rapids.sql.enabled": True,
+                    "spark.sql.ansi.enabled": True})
+    schema = T.StructType([T.StructField("a", T.LONG)])
+    df = s.create_dataframe({"a": [2**62, 2**62]}, schema)
+    with pytest.raises(SparkArithmeticException):
+        df.select((col("a") + col("a")).alias("r")).collect()

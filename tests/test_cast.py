@@ -1,0 +1,135 @@
+"""Cast matrix differential tests (reference: cast_test.py)."""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.session import col
+
+from asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    assert_tpu_fallback_collect,
+)
+from data_gen import (
+    BooleanGen,
+    ByteGen,
+    DateGen,
+    DecimalGen,
+    DoubleGen,
+    IntegerGen,
+    LongGen,
+    SetValuesGen,
+    StringGen,
+    TimestampGen,
+    gen_df,
+)
+
+
+@pytest.mark.parametrize("to", [T.BYTE, T.SHORT, T.INT, T.LONG, T.DOUBLE,
+                                T.BOOLEAN, T.STRING],
+                         ids=lambda t: t.simpleString)
+def test_cast_int_to(to):
+    def build(s):
+        df = gen_df(s, [IntegerGen()], ["a"], length=200)
+        return df.select(col("a").cast(to).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("to", [T.INT, T.LONG, T.FLOAT, T.BOOLEAN],
+                         ids=lambda t: t.simpleString)
+def test_cast_double_to(to):
+    def build(s):
+        df = gen_df(s, [DoubleGen()], ["a"], length=200)
+        return df.select(col("a").cast(to).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_cast_decimal_matrix():
+    def build(s):
+        df = gen_df(s, [DecimalGen(10, 2)], ["a"], length=200)
+        return df.select(col("a").cast(T.DecimalType(12, 4)).alias("up"),
+                         col("a").cast(T.DecimalType(8, 1)).alias("down"),
+                         col("a").cast(T.LONG).alias("l"),
+                         col("a").cast(T.DOUBLE).alias("d"),
+                         col("a").cast(T.STRING).alias("s"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_cast_int_to_decimal_and_back():
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=-10**6, max_val=10**6)], ["a"],
+                    length=200)
+        return df.select(col("a").cast(T.DecimalType(12, 2)).alias("d"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_cast_string_to_int():
+    def build(s):
+        g = SetValuesGen(T.STRING, ["1", "-42", " 7 ", "2147483648", "abc",
+                                    "", "+5", "12x", "99999999999999999999"])
+        df = gen_df(s, [g], ["a"], length=200)
+        return df.select(col("a").cast(T.INT).alias("i"),
+                         col("a").cast(T.LONG).alias("l"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_cast_string_to_bool():
+    def build(s):
+        g = SetValuesGen(T.STRING, ["true", "FALSE", "t", "no", "1", "0",
+                                    "yes", "maybe", ""])
+        df = gen_df(s, [g], ["a"], length=100)
+        return df.select(col("a").cast(T.BOOLEAN).alias("b"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_cast_string_to_date():
+    def build(s):
+        g = SetValuesGen(T.STRING, ["2020-02-29", "2021-02-29", "1999-12-31",
+                                    "2020-13-01", "2020-00-10", "not-a-date",
+                                    "1970-01-01", "2020-1-1"])
+        df = gen_df(s, [g], ["a"], length=100)
+        return df.select(col("a").cast(T.DATE).alias("d"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_cast_date_roundtrip_string():
+    def build(s):
+        df = gen_df(s, [DateGen()], ["a"], length=200)
+        return df.select(col("a").cast(T.STRING).alias("s"),
+                         col("a").cast(T.TIMESTAMP).alias("ts"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_cast_timestamp():
+    def build(s):
+        df = gen_df(s, [TimestampGen()], ["a"], length=200)
+        return df.select(col("a").cast(T.DATE).alias("d"),
+                         col("a").cast(T.LONG).alias("secs"),
+                         col("a").cast(T.STRING).alias("s"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_cast_bool():
+    def build(s):
+        df = gen_df(s, [BooleanGen()], ["a"], length=100)
+        return df.select(col("a").cast(T.INT).alias("i"),
+                         col("a").cast(T.STRING).alias("s"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_unsupported_cast_falls_back():
+    # float->string is not on the TPU yet: the Project must fall back,
+    # results still correct via CPU (the reference's fallback contract).
+    def build(s):
+        df = gen_df(s, [DoubleGen()], ["a"], length=50)
+        return df.select(col("a").cast(T.STRING).alias("s"))
+
+    assert_tpu_fallback_collect(build, "Project")

@@ -1,0 +1,136 @@
+"""Conditional + math expression differential tests (reference:
+conditionals_test.py, arithmetic_ops_test.py math section)."""
+import pytest
+
+from spark_rapids_tpu.expr.conditional import (
+    CaseWhen,
+    Coalesce,
+    Greatest,
+    If,
+    Least,
+    NaNvl,
+    Nvl,
+)
+from spark_rapids_tpu.expr.mathfuncs import (
+    Acos,
+    Asin,
+    Atan,
+    Ceil,
+    Cos,
+    Exp,
+    Floor,
+    Log,
+    Log10,
+    Pow,
+    Round,
+    Signum,
+    Sin,
+    Sqrt,
+    Tan,
+)
+from spark_rapids_tpu.session import col, lit
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import (
+    BooleanGen,
+    DecimalGen,
+    DoubleGen,
+    IntegerGen,
+    StringGen,
+    gen_df,
+)
+
+
+def test_if_case_when():
+    def build(s):
+        df = gen_df(s, [BooleanGen(null_prob=0.3), IntegerGen(),
+                        IntegerGen()], ["p", "a", "b"], length=250)
+        return df.select(
+            If(col("p"), col("a"), col("b")).alias("if_"),
+            CaseWhen([(col("p"), col("a")),
+                      (col("a") > lit(0), col("b"))],
+                     lit(-1)).alias("cw"),
+            CaseWhen([(col("p"), col("a"))]).alias("cw_noelse"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_if_string_branches():
+    def build(s):
+        df = gen_df(s, [BooleanGen(), StringGen(max_len=5),
+                        StringGen(max_len=8)], ["p", "a", "b"], length=200)
+        return df.select(If(col("p"), col("a"), col("b")).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_coalesce_nvl():
+    def build(s):
+        df = gen_df(s, [IntegerGen(null_prob=0.5), IntegerGen(null_prob=0.5),
+                        IntegerGen(null_prob=0.5)], ["a", "b", "c"],
+                    length=250)
+        return df.select(Coalesce([col("a"), col("b"), col("c")]).alias("co"),
+                         Nvl(col("a"), lit(0)).alias("nvl"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_nanvl_greatest_least():
+    def build(s):
+        df = gen_df(s, [DoubleGen(), DoubleGen(no_nans=True)], ["a", "b"],
+                    length=250)
+        return df.select(NaNvl(col("a"), col("b")).alias("nv"),
+                         Greatest([col("a"), col("b")]).alias("g"),
+                         Least([col("a"), col("b")]).alias("l"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_unary_math():
+    def build(s):
+        df = gen_df(s, [DoubleGen(min_exp=-3, max_exp=3)], ["a"], length=200)
+        return df.select(Sqrt(col("a")).alias("sqrt"),
+                         Exp(col("a")).alias("exp"),
+                         Log(col("a")).alias("log"),
+                         Log10(col("a")).alias("log10"),
+                         Sin(col("a")).alias("sin"),
+                         Cos(col("a")).alias("cos"),
+                         Atan(col("a")).alias("atan"),
+                         Signum(col("a")).alias("sign"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_floor_ceil():
+    def build(s):
+        df = gen_df(s, [DoubleGen(min_exp=-3, max_exp=6, no_nans=True),
+                        DecimalGen(9, 2), IntegerGen()], ["d", "dec", "i"],
+                    length=200)
+        return df.select(Floor(col("d")).alias("fd"),
+                         Ceil(col("d")).alias("cd"),
+                         Floor(col("dec")).alias("fdec"),
+                         Ceil(col("dec")).alias("cdec"),
+                         Floor(col("i")).alias("fi"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("scale", [0, 1, 2])
+def test_round(scale):
+    def build(s):
+        df = gen_df(s, [DoubleGen(min_exp=-3, max_exp=3, no_nans=True),
+                        DecimalGen(9, 3)], ["d", "dec"], length=200)
+        return df.select(Round(col("d"), lit(scale)).alias("rd"),
+                         Round(col("dec"), lit(scale)).alias("rdec"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_pow():
+    def build(s):
+        df = gen_df(s, [DoubleGen(min_exp=-1, max_exp=1, no_nans=True),
+                        IntegerGen(min_val=-3, max_val=3)], ["a", "b"],
+                    length=150)
+        return df.select(Pow(col("a"), col("b")).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)

@@ -1,0 +1,80 @@
+"""Date/time differential tests (reference: date_time_test.py)."""
+import pytest
+
+from spark_rapids_tpu.expr.datetime import (
+    DateAdd,
+    DateDiff,
+    DateSub,
+    DayOfMonth,
+    DayOfWeek,
+    DayOfYear,
+    Hour,
+    LastDay,
+    Minute,
+    Month,
+    Quarter,
+    Second,
+    UnixTimestamp,
+    Year,
+)
+from spark_rapids_tpu.session import col, lit
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import DateGen, IntegerGen, TimestampGen, gen_df
+
+
+def test_date_fields():
+    def build(s):
+        df = gen_df(s, [DateGen()], ["d"], length=300)
+        return df.select(Year(col("d")).alias("y"),
+                         Month(col("d")).alias("m"),
+                         DayOfMonth(col("d")).alias("dom"),
+                         DayOfWeek(col("d")).alias("dow"),
+                         DayOfYear(col("d")).alias("doy"),
+                         Quarter(col("d")).alias("q"),
+                         LastDay(col("d")).alias("ld"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_time_fields():
+    def build(s):
+        df = gen_df(s, [TimestampGen()], ["t"], length=300)
+        return df.select(Hour(col("t")).alias("h"),
+                         Minute(col("t")).alias("m"),
+                         Second(col("t")).alias("s"),
+                         Year(col("t")).alias("y"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_date_arith():
+    def build(s):
+        df = gen_df(s, [DateGen(), DateGen(),
+                        IntegerGen(min_val=-1000, max_val=1000)],
+                    ["d1", "d2", "n"], length=200)
+        return df.select(DateAdd(col("d1"), col("n")).alias("da"),
+                         DateSub(col("d1"), col("n")).alias("ds"),
+                         DateDiff(col("d1"), col("d2")).alias("dd"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_unix_timestamp():
+    def build(s):
+        df = gen_df(s, [TimestampGen(), DateGen()], ["t", "d"], length=200)
+        return df.select(UnixTimestamp(col("t")).alias("ut"),
+                         UnixTimestamp(col("d")).alias("ud"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_date_comparison_filter():
+    import datetime
+
+    def build(s):
+        df = gen_df(s, [DateGen(), IntegerGen()], ["d", "v"], length=200)
+        return df.filter((col("d") >= lit(datetime.date(1994, 1, 1)))
+                         & (col("d") < lit(datetime.date(1995, 1, 1))))
+
+    assert_tpu_and_cpu_are_equal_collect(build)

@@ -1,0 +1,177 @@
+"""Partitioning + file IO tests (reference: repart_test.py, parquet_test.py,
+csv_test.py)."""
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.session import TpuSession, col, lit, sum_
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import (
+    DateGen,
+    DecimalGen,
+    DoubleGen,
+    IntegerGen,
+    StringGen,
+    gen_df,
+)
+
+
+def test_hash_partitioning_deterministic_and_complete():
+    """Rows split by murmur3 partition ids recombine to the input."""
+    from spark_rapids_tpu.exec.basic import TpuLocalTableScanExec
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.plan.nodes import HashPartitioning
+
+    s = TpuSession({})
+    df = gen_df(s, [IntegerGen(), StringGen()], ["k", "v"], length=300)
+    from spark_rapids_tpu.overrides import TpuOverrides
+
+    scan_cols = df.plan.host_columns
+    scan = TpuLocalTableScanExec(scan_cols, df.plan.output)
+    keys = [col("k").resolve(df.schema)]
+    ex = TpuShuffleExchangeExec(HashPartitioning(keys, 5), scan)
+    batches = list(ex.execute_columnar())
+    total = sum(b.num_rows for b in batches)
+    assert total == 300
+    # determinism
+    scan2 = TpuLocalTableScanExec(scan_cols, df.plan.output)
+    ex2 = TpuShuffleExchangeExec(HashPartitioning(keys, 5), scan2)
+    batches2 = list(ex2.execute_columnar())
+    assert [b.num_rows for b in batches] == [b.num_rows for b in batches2]
+
+
+def test_murmur3_matches_spark_golden():
+    """Spark-exact murmur3: golden values from
+    org.apache.spark.sql.catalyst.expressions.Murmur3Hash (seed 42).
+
+    NOTE: golden values below were computed from the reference algorithm
+    definition (Murmur3_x86_32 with Spark's int/long block layout)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    from spark_rapids_tpu.ops.hashing import murmur3_columns
+
+    def ref_hash_int(v, seed=42):
+        import struct
+
+        def rotl(x, r):
+            return ((x << r) | (x >> (32 - r))) & 0xFFFFFFFF
+
+        c1, c2 = 0xCC9E2D51, 0x1B873593
+        k1 = (v & 0xFFFFFFFF) * c1 & 0xFFFFFFFF
+        k1 = rotl(k1, 15) * c2 & 0xFFFFFFFF
+        h1 = seed ^ k1
+        h1 = (rotl(h1, 13) * 5 + 0xE6546B64) & 0xFFFFFFFF
+        h1 ^= 4
+        h1 ^= h1 >> 16
+        h1 = h1 * 0x85EBCA6B & 0xFFFFFFFF
+        h1 ^= h1 >> 13
+        h1 = h1 * 0xC2B2AE35 & 0xFFFFFFFF
+        h1 ^= h1 >> 16
+        return h1 - (1 << 32) if h1 >= 1 << 31 else h1
+
+    vals = [0, 1, -1, 42, 2**31 - 1, -(2**31)]
+    c = DeviceColumn(T.INT, jnp.ones(len(vals), jnp.bool_),
+                     data=jnp.asarray(vals, jnp.int32))
+    got = [int(x) for x in murmur3_columns([c])]
+    want = [ref_hash_int(v) for v in vals]
+    assert got == want
+
+
+@pytest.mark.parametrize("gens", [
+    [IntegerGen(), DoubleGen(no_nans=True), StringGen()],
+    [DateGen(), DecimalGen(9, 2)]],
+    ids=["basic", "date_decimal"])
+def test_parquet_roundtrip_scan(tmp_path, gens):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    s_gen = TpuSession({})
+    df = gen_df(s_gen, gens, length=200)
+    # write with pyarrow from the host columns
+    cols = {}
+    for f, h in zip(df.plan.output.fields, df.plan.host_columns):
+        cols[f.name] = h.to_arrow()
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table(cols), path)
+
+    def build(s):
+        return s.read.parquet(path)
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_parquet_pushdown_and_agg(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(0)
+    n = 5000
+    tbl = pa.table({
+        "k": pa.array(rng.integers(0, 10, n), pa.int32()),
+        "v": pa.array(rng.uniform(0, 100, n), pa.float64()),
+    })
+    path = str(tmp_path / "kv.parquet")
+    pq.write_table(tbl, path, row_group_size=512)
+
+    def build(s):
+        df = s.read.parquet(path)
+        return (df.filter(col("k") < lit(5))
+                .group_by("k").agg(sum_("v", "sv")))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+@pytest.mark.parametrize("mode", ["PERFILE", "COALESCING", "MULTITHREADED"])
+def test_parquet_reader_modes(tmp_path, mode):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(1)
+    paths = []
+    for i in range(3):
+        tbl = pa.table({"a": pa.array(rng.integers(0, 100, 400), pa.int64())})
+        p = str(tmp_path / f"f{i}.parquet")
+        pq.write_table(tbl, p)
+        paths.append(p)
+
+    def build(s):
+        return s.read.parquet(*paths).agg(sum_("a", "sa"),
+                                          ("count_star", None, "n"))
+
+    assert_tpu_and_cpu_are_equal_collect(
+        build,
+        conf={"spark.rapids.sql.format.parquet.reader.type": mode})
+
+
+def test_csv_scan(tmp_path):
+    path = str(tmp_path / "t.csv")
+    with open(path, "w") as f:
+        f.write("a,b\n")
+        for i in range(100):
+            f.write(f"{i},{i * 1.5}\n")
+
+    def build(s):
+        return s.read.csv(path).filter(col("a") > lit(50))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_scan_disabled_falls_back(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"a": pa.array([1, 2, 3], pa.int64())}), path)
+
+    from asserts import assert_tpu_fallback_collect
+
+    def build(s):
+        return s.read.parquet(path)
+
+    assert_tpu_fallback_collect(
+        build, "FileSourceScan",
+        conf={"spark.rapids.sql.format.parquet.read.enabled": "false"})

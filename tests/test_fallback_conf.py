@@ -1,0 +1,112 @@
+"""Tagging / fallback / config tests (reference: marks.py @allow_non_gpu
+machinery + RapidsConf behaviors)."""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import TpuConf, all_entries
+from spark_rapids_tpu.session import TpuSession, col, lit, sum_
+
+from asserts import (
+    assert_plan_on_tpu,
+    assert_tpu_and_cpu_are_equal_collect,
+    assert_tpu_fallback_collect,
+)
+from data_gen import IntegerGen, StringGen, gen_df
+
+
+def test_expression_kill_switch_forces_fallback():
+    def build(s):
+        df = gen_df(s, [IntegerGen(), IntegerGen()], ["a", "b"], length=50)
+        return df.select((col("a") + col("b")).alias("r"))
+
+    assert_tpu_fallback_collect(
+        build, "Project", conf={"spark.rapids.sql.expression.Add": "false"})
+
+
+def test_exec_kill_switch_forces_fallback():
+    def build(s):
+        df = gen_df(s, [IntegerGen()], ["a"], length=50)
+        return df.filter(col("a") > lit(0))
+
+    assert_tpu_fallback_collect(
+        build, "Filter", conf={"spark.rapids.sql.exec.Filter": "false"})
+
+
+def test_sql_disabled_runs_cpu():
+    s = TpuSession({"spark.rapids.sql.enabled": False})
+    df = gen_df(s, [IntegerGen()], ["a"], length=20)
+    root, meta = df._planned()
+    assert meta is None  # no rewrite happened
+
+
+def test_full_plan_on_tpu():
+    def build(s):
+        df = gen_df(s, [IntegerGen(), StringGen()], ["a", "s"], length=50)
+        return (df.filter(col("a") > lit(0))
+                .group_by("s").agg(sum_("a", "sa")))
+
+    assert_plan_on_tpu(build)
+
+
+def test_fallback_mixed_plan_still_correct():
+    # CPU filter under TPU aggregate: transition inserted, results equal
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=5), IntegerGen()],
+                    ["k", "v"], length=150)
+        return df.filter(col("v").is_not_null()).group_by("k").agg(
+            sum_("v", "sv"))
+
+    assert_tpu_and_cpu_are_equal_collect(
+        build, conf={"spark.rapids.sql.exec.Filter": "false"})
+
+
+def test_explain_not_on_tpu(capsys):
+    s = TpuSession({"spark.rapids.sql.enabled": True,
+                    "spark.rapids.sql.explain": "NOT_ON_GPU",
+                    "spark.rapids.sql.exec.Filter": "false"})
+    df = gen_df(s, [IntegerGen()], ["a"], length=20)
+    df.filter(col("a") > lit(0)).collect()
+    out = capsys.readouterr().out
+    assert "cannot run on TPU" in out
+    assert "Filter" in out
+
+
+def test_conf_registry_shapes():
+    entries = all_entries()
+    assert len(entries) >= 45
+    keys = {e.key for e in entries}
+    # the reference's flagship knobs exist under the same names
+    for k in ["spark.rapids.sql.enabled", "spark.rapids.sql.explain",
+              "spark.rapids.sql.batchSizeBytes",
+              "spark.rapids.sql.concurrentGpuTasks",
+              "spark.rapids.memory.host.spillStorageSize",
+              "spark.rapids.shuffle.mode"]:
+        assert k in keys, k
+
+
+def test_conf_parsing():
+    c = TpuConf({"spark.rapids.sql.batchSizeBytes": "512m",
+                 "spark.rapids.sql.enabled": "false"})
+    assert c.batch_size_bytes == 512 << 20
+    assert c.sql_enabled is False
+    assert c.is_op_enabled("Add") is True
+    c2 = TpuConf({"spark.rapids.sql.expression.Add": "false"})
+    assert c2.is_op_enabled("Add") is False
+
+
+def test_union():
+    def build(s):
+        df1 = gen_df(s, [IntegerGen(), StringGen()], ["a", "s"], length=80,
+                     seed=1)
+        df2 = gen_df(s, [IntegerGen(), StringGen()], ["a", "s"], length=60,
+                     seed=2)
+        return df1.union(df2)
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_range():
+    def build(s):
+        return s.range(0, 1000, 3)
+
+    assert_tpu_and_cpu_are_equal_collect(build)

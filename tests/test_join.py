@@ -1,0 +1,156 @@
+"""Join differential tests (reference: join_test.py)."""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.session import col
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import (
+    DateGen,
+    DecimalGen,
+    DoubleGen,
+    IntegerGen,
+    SetValuesGen,
+    StringGen,
+    gen_df,
+)
+
+_join_types = ["inner", "left", "right", "full", "left_semi", "left_anti"]
+
+
+def _two_tables(s, keygen, n_left=150, n_right=100):
+    left = gen_df(s, [keygen, IntegerGen()], ["k", "lv"], length=n_left,
+                  seed=11)
+    right = gen_df(s, [keygen, IntegerGen()], ["k", "rv"], length=n_right,
+                  seed=22)
+    # avoid duplicate column name 'k' in output
+    right = right.select(col("k").alias("rk"), col("rv"))
+    return left, right
+
+
+@pytest.mark.parametrize("how", _join_types)
+def test_join_types_int_keys(how):
+    def build(s):
+        left, right = _two_tables(s, IntegerGen(min_val=0, max_val=20))
+        lk = left.plan
+        # join on k == rk: use explicit key expressions
+        from spark_rapids_tpu.plan import nodes as PN
+        from spark_rapids_tpu.session import DataFrame
+
+        jt = {"inner": PN.JoinType.INNER, "left": PN.JoinType.LEFT_OUTER,
+              "right": PN.JoinType.RIGHT_OUTER, "full": PN.JoinType.FULL_OUTER,
+              "left_semi": PN.JoinType.LEFT_SEMI,
+              "left_anti": PN.JoinType.LEFT_ANTI}[how]
+        lkeys = [col("k").resolve(left.schema)]
+        rkeys = [col("rk").resolve(right.schema)]
+        node = PN.SortMergeJoin(left.plan, right.plan, lkeys, rkeys, jt)
+        return DataFrame(node, s)
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("keygen", [
+    StringGen(min_len=0, max_len=3, charset="ab"),
+    DateGen(), DecimalGen(6, 2),
+    SetValuesGen(T.DOUBLE, [1.0, 2.5, float("nan"), -0.0, 0.0])],
+    ids=lambda g: type(g).__name__)
+def test_inner_join_key_types(keygen):
+    def build(s):
+        left, right = _two_tables(s, keygen, 100, 80)
+        from spark_rapids_tpu.plan import nodes as PN
+        from spark_rapids_tpu.session import DataFrame
+
+        lkeys = [col("k").resolve(left.schema)]
+        rkeys = [col("rk").resolve(right.schema)]
+        node = PN.SortMergeJoin(left.plan, right.plan, lkeys, rkeys,
+                                PN.JoinType.INNER)
+        return DataFrame(node, s)
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_join_null_keys_never_match():
+    def build(s):
+        left, right = _two_tables(s, IntegerGen(min_val=0, max_val=5,
+                                                null_prob=0.4))
+        from spark_rapids_tpu.plan import nodes as PN
+        from spark_rapids_tpu.session import DataFrame
+
+        lkeys = [col("k").resolve(left.schema)]
+        rkeys = [col("rk").resolve(right.schema)]
+        node = PN.SortMergeJoin(left.plan, right.plan, lkeys, rkeys,
+                                PN.JoinType.FULL_OUTER)
+        return DataFrame(node, s)
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_join_with_condition_inner():
+    def build(s):
+        left, right = _two_tables(s, IntegerGen(min_val=0, max_val=10))
+        from spark_rapids_tpu.plan import nodes as PN
+        from spark_rapids_tpu.session import DataFrame
+
+        lkeys = [col("k").resolve(left.schema)]
+        rkeys = [col("rk").resolve(right.schema)]
+        node = PN.SortMergeJoin(left.plan, right.plan, lkeys, rkeys,
+                                PN.JoinType.INNER)
+        joined = DataFrame(node, s)
+        cond = (col("lv") > col("rv"))
+        node.condition = cond.resolve(joined.schema)
+        return joined
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_broadcast_join():
+    def build(s):
+        big = gen_df(s, [IntegerGen(min_val=0, max_val=30), DoubleGen()],
+                     ["k", "v"], length=400, seed=5)
+        small = gen_df(s, [IntegerGen(min_val=0, max_val=30), StringGen()],
+                       ["k2", "name"], length=20, seed=6)
+        from spark_rapids_tpu.plan import nodes as PN
+        from spark_rapids_tpu.session import DataFrame
+
+        lkeys = [col("k").resolve(big.schema)]
+        rkeys = [col("k2").resolve(small.schema)]
+        node = PN.BroadcastHashJoin(
+            big.plan, PN.BroadcastExchange(small.plan), lkeys, rkeys,
+            PN.JoinType.INNER)
+        return DataFrame(node, s)
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_cross_join():
+    def build(s):
+        left = gen_df(s, [IntegerGen()], ["a"], length=30, seed=1)
+        right = gen_df(s, [IntegerGen()], ["b"], length=20, seed=2)
+        from spark_rapids_tpu.plan import nodes as PN
+        from spark_rapids_tpu.session import DataFrame
+
+        node = PN.SortMergeJoin(left.plan, right.plan, [], [],
+                                PN.JoinType.CROSS)
+        return DataFrame(node, s)
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_join_multi_key():
+    def build(s):
+        g1 = IntegerGen(min_val=0, max_val=4)
+        g2 = StringGen(min_len=1, max_len=1, charset="xy")
+        left = gen_df(s, [g1, g2, IntegerGen()], ["k1", "k2", "lv"],
+                      length=150, seed=7)
+        right = gen_df(s, [g1, g2, IntegerGen()], ["j1", "j2", "rv"],
+                       length=100, seed=8)
+        from spark_rapids_tpu.plan import nodes as PN
+        from spark_rapids_tpu.session import DataFrame
+
+        lkeys = [col("k1").resolve(left.schema), col("k2").resolve(left.schema)]
+        rkeys = [col("j1").resolve(right.schema), col("j2").resolve(right.schema)]
+        node = PN.SortMergeJoin(left.plan, right.plan, lkeys, rkeys,
+                                PN.JoinType.INNER)
+        return DataFrame(node, s)
+
+    assert_tpu_and_cpu_are_equal_collect(build)

@@ -1,0 +1,91 @@
+"""Comparison / boolean logic differential tests (reference: cmp_test.py)."""
+import pytest
+
+from spark_rapids_tpu.session import col, lit
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import (
+    BooleanGen,
+    DateGen,
+    DecimalGen,
+    DoubleGen,
+    IntegerGen,
+    StringGen,
+    gen_df,
+)
+
+_cmp_gens = [IntegerGen(), DoubleGen(), StringGen(), DateGen(),
+             DecimalGen(9, 2)]
+
+
+@pytest.mark.parametrize("gen", _cmp_gens, ids=lambda g: type(g).__name__)
+def test_comparisons(gen):
+    def build(s):
+        df = gen_df(s, [gen, gen], ["a", "b"], length=200)
+        return df.select((col("a") < col("b")).alias("lt"),
+                         (col("a") <= col("b")).alias("le"),
+                         (col("a") > col("b")).alias("gt"),
+                         (col("a") >= col("b")).alias("ge"),
+                         col("a").eq(col("b")).alias("eq"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_equal_null_safe():
+    from spark_rapids_tpu.expr.predicates import EqualNullSafe
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(null_prob=0.5),
+                        IntegerGen(null_prob=0.5)], ["a", "b"], length=200)
+        return df.select(EqualNullSafe(col("a"), col("b")).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_and_or_three_valued():
+    def build(s):
+        df = gen_df(s, [BooleanGen(null_prob=0.4), BooleanGen(null_prob=0.4)],
+                    ["a", "b"], length=300)
+        return df.select((col("a") & col("b")).alias("and_"),
+                         (col("a") | col("b")).alias("or_"),
+                         (~col("a")).alias("not_"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_is_null_not_null_nan():
+    from spark_rapids_tpu.expr.predicates import IsNaN
+
+    def build(s):
+        df = gen_df(s, [DoubleGen(null_prob=0.3)], ["a"], length=200)
+        return df.select(col("a").is_null().alias("n"),
+                         col("a").is_not_null().alias("nn"),
+                         IsNaN(col("a")).alias("nan"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_in_list():
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=10)], ["a"], length=200)
+        return df.select(col("a").isin(1, 3, 5, 7).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_in_list_strings():
+    def build(s):
+        df = gen_df(s, [StringGen(min_len=0, max_len=3,
+                                  charset="abc")], ["a"], length=200)
+        return df.select(col("a").isin("a", "bc", "abc").alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_filter_pushes_nulls():
+    def build(s):
+        df = gen_df(s, [IntegerGen(null_prob=0.3), StringGen()], ["a", "s"],
+                    length=300)
+        return df.filter((col("a") > lit(0)) & col("s").is_not_null())
+
+    assert_tpu_and_cpu_are_equal_collect(build)

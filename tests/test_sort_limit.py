@@ -1,0 +1,85 @@
+"""Sort / TopN / limit differential tests (reference: sort_test.py,
+limit_test.py)."""
+import pytest
+
+from spark_rapids_tpu.ops.sortkeys import SortSpec
+from spark_rapids_tpu.session import col
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import (
+    DateGen,
+    DecimalGen,
+    DoubleGen,
+    IntegerGen,
+    StringGen,
+    gen_df,
+)
+
+_sort_gens = [IntegerGen(), DoubleGen(), StringGen(), DateGen(),
+              DecimalGen(9, 3)]
+
+
+@pytest.mark.parametrize("gen", _sort_gens, ids=lambda g: type(g).__name__)
+@pytest.mark.parametrize("asc", [True, False])
+def test_orderby_single(gen, asc):
+    def build(s):
+        df = gen_df(s, [gen, IntegerGen()], ["a", "b"], length=200)
+        return df.order_by("a", ascending=asc)
+
+    assert_tpu_and_cpu_are_equal_collect(build, ignore_order=False,
+                                         approximate_float=True)
+
+
+def test_orderby_multi_mixed_direction():
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=5), DoubleGen(),
+                        StringGen()], ["a", "b", "c"], length=200)
+        return df.order_by(
+            (col("a"), SortSpec(ascending=True, nulls_first=True)),
+            (col("b"), SortSpec(ascending=False, nulls_first=False)),
+            (col("c"), SortSpec(ascending=True, nulls_first=True)))
+
+    assert_tpu_and_cpu_are_equal_collect(build, ignore_order=False,
+                                         approximate_float=True)
+
+
+def test_orderby_nulls_orderings():
+    def build(s):
+        df = gen_df(s, [IntegerGen(null_prob=0.3),
+                        IntegerGen()], ["a", "b"], length=150)
+        return df.order_by((col("a"), SortSpec(ascending=True,
+                                               nulls_first=False)),
+                           (col("b"), SortSpec()))
+
+    assert_tpu_and_cpu_are_equal_collect(build, ignore_order=False)
+
+
+def test_limit():
+    def build(s):
+        df = gen_df(s, [IntegerGen()], ["a"], length=200)
+        return df.limit(17)
+
+    # limit without sort: just check the row count contract
+    from spark_rapids_tpu.session import TpuSession
+
+    n_tpu = len(build(TpuSession({"spark.rapids.sql.enabled": True})
+                      ).collect())
+    n_cpu = len(build(TpuSession({"spark.rapids.sql.enabled": False})
+                      ).collect())
+    assert n_tpu == n_cpu == 17
+
+
+def test_topn():
+    def build(s):
+        df = gen_df(s, [IntegerGen(), StringGen()], ["a", "s"], length=300)
+        return df.order_by("a").limit(25)
+
+    assert_tpu_and_cpu_are_equal_collect(build, ignore_order=False)
+
+
+def test_topn_desc_strings():
+    def build(s):
+        df = gen_df(s, [StringGen()], ["s"], length=300)
+        return df.order_by("s", ascending=False).limit(10)
+
+    assert_tpu_and_cpu_are_equal_collect(build, ignore_order=False)

@@ -1,0 +1,104 @@
+"""String expression differential tests (reference: string_test.py)."""
+import pytest
+
+from spark_rapids_tpu.expr.strings import (
+    Concat,
+    Contains,
+    EndsWith,
+    Length,
+    Like,
+    Lower,
+    StartsWith,
+    StringTrim,
+    Substring,
+    Upper,
+)
+from spark_rapids_tpu.session import col, lit
+
+from asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    assert_tpu_fallback_collect,
+)
+from data_gen import IntegerGen, SetValuesGen, StringGen, gen_df
+from spark_rapids_tpu import types as T
+
+
+def test_length_upper_lower_trim():
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=12)], ["a"], length=200)
+        return df.select(Length(col("a")).alias("len"),
+                         Upper(col("a")).alias("up"),
+                         Lower(col("a")).alias("lo"),
+                         StringTrim(col("a")).alias("tr"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("pos,ln", [(1, 3), (2, 100), (0, 2), (-3, 2),
+                                    (5, 0), (-100, 4)])
+def test_substring(pos, ln):
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=8)], ["a"], length=150)
+        return df.select(col("a").substr(pos, ln).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_concat():
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=5), StringGen(max_len=5)],
+                    ["a", "b"], length=150)
+        return df.select(Concat([col("a"), lit("-"), col("b")]).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_starts_ends_contains():
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=6, charset="abc")], ["a"],
+                    length=200)
+        return df.select(StartsWith(col("a"), lit("ab")).alias("sw"),
+                         EndsWith(col("a"), lit("c")).alias("ew"),
+                         Contains(col("a"), lit("bc")).alias("ct"),
+                         Contains(col("a"), lit("")).alias("ce"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("pattern", ["abc%", "%abc", "%b%", "abc"])
+def test_like_supported(pattern):
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=6, charset="abc")], ["a"],
+                    length=200)
+        return df.select(Like(col("a"), lit(pattern)).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_like_complex_falls_back():
+    # '_' patterns hit the transpiler-reject path -> CPU fallback
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=4, charset="ab")], ["a"], length=80)
+        return df.select(Like(col("a"), lit("a_b")).alias("r"))
+
+    assert_tpu_fallback_collect(build, "Project")
+
+
+def test_string_compare_unicode_bytes():
+    def build(s):
+        g = SetValuesGen(T.STRING, ["", "a", "ab", "abc", "b", "ümlaut",
+                                    "zz", "ZZ", "  a"])
+        df = gen_df(s, [g, g], ["a", "b"], length=150)
+        return df.select((col("a") < col("b")).alias("lt"),
+                         col("a").eq(col("b")).alias("eq"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_string_sort_unicode():
+    def build(s):
+        g = SetValuesGen(T.STRING, ["", "a", "ab", "ümlaut", "zz", "é", "e"])
+        df = gen_df(s, [g], ["a"], length=100)
+        return df.order_by("a")
+
+    assert_tpu_and_cpu_are_equal_collect(build, ignore_order=False)

@@ -1,0 +1,77 @@
+"""Window function differential tests (reference: window_function_test.py)."""
+import pytest
+
+from spark_rapids_tpu.ops.sortkeys import SortSpec
+from spark_rapids_tpu.plan.nodes import WindowFunction
+from spark_rapids_tpu.session import col
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import DoubleGen, IntegerGen, StringGen, gen_df
+
+
+def _wdf(s, fns, frame="running"):
+    df = gen_df(s, [IntegerGen(min_val=0, max_val=4),
+                    IntegerGen(min_val=0, max_val=1000),
+                    IntegerGen(min_val=-50, max_val=50)],
+                ["p", "o", "v"], length=250)
+    return df.window(fns, partition_by=["p"],
+                     order_by=[(col("o"), SortSpec())], frame=frame)
+
+
+def test_row_number_rank_dense_rank():
+    def build(s):
+        return _wdf(s, [WindowFunction("row_number", None, "rn"),
+                        WindowFunction("rank", None, "rk"),
+                        WindowFunction("dense_rank", None, "dr")])
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("frame", ["running", "unbounded"])
+def test_window_aggs(frame):
+    def build(s):
+        return _wdf(s, [WindowFunction("sum", col("v"), "sv"),
+                        WindowFunction("count", col("v"), "cv"),
+                        WindowFunction("min", col("v"), "mn"),
+                        WindowFunction("max", col("v"), "mx")], frame)
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_window_avg_double():
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=3),
+                        IntegerGen(min_val=0, max_val=10000),
+                        DoubleGen(no_nans=True)], ["p", "o", "v"], length=200)
+        return df.window([WindowFunction("avg", col("v"), "av")],
+                        partition_by=["p"],
+                        order_by=[(col("o"), SortSpec())], frame="unbounded")
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_window_string_partition():
+    def build(s):
+        df = gen_df(s, [StringGen(min_len=1, max_len=2, charset="ab"),
+                        IntegerGen(min_val=0, max_val=10000),
+                        IntegerGen(min_val=-10, max_val=10)],
+                    ["p", "o", "v"], length=200)
+        return df.window([WindowFunction("row_number", None, "rn"),
+                          WindowFunction("sum", col("v"), "sv")],
+                         partition_by=["p"],
+                         order_by=[(col("o"), SortSpec())])
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_window_no_partition():
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=100000),
+                        IntegerGen(min_val=-5, max_val=5)], ["o", "v"],
+                    length=150)
+        return df.window([WindowFunction("row_number", None, "rn"),
+                          WindowFunction("sum", col("v"), "sv")],
+                         partition_by=[],
+                         order_by=[(col("o"), SortSpec())])
+
+    assert_tpu_and_cpu_are_equal_collect(build)
