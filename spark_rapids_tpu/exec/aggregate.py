@@ -278,10 +278,11 @@ class TpuHashAggregateExec(TpuExec):
         n = c.capacity
         best = jnp.arange(n, dtype=jnp.int32)
         # iterative: compute rank by sorting (value words, index) within seg
-        keyseq = [seg.astype(jnp.int64)]
+        # null rows must sort after every valid row: a value-word sentinel
+        # can collide with real key words, so nullness is its own sort key
+        keyseq = [seg.astype(jnp.int64), (~validity).astype(jnp.int64)]
         for w in words:
-            w2 = jnp.where(validity, w if func == "min" else ~w, jnp.int64(2**62))
-            keyseq.append(w2)
+            keyseq.append(w if func == "min" else ~w)
         perm2 = jax.lax.sort(tuple(keyseq) + (best,),
                              num_keys=len(keyseq), is_stable=True)[-1]
         # after sort by (seg, value): first row of each seg = min (or max)

@@ -181,8 +181,9 @@ class _BaseTpuJoinExec(TpuExec):
             probe_row = jnp.clip(probe_row, 0, n - 1)
             k = j - excl[probe_row]
             build_pos = lo[probe_row].astype(jnp.int64) + k
+            build_cap = bwords_row_index.shape[0]
             build_row = bwords_row_index[
-                jnp.clip(build_pos, 0, n - 1).astype(jnp.int32)]
+                jnp.clip(build_pos, 0, build_cap - 1).astype(jnp.int32)]
             in_pairs = j < total
             probe_idx = jnp.where(in_pairs, probe_row, 0)
             if with_unmatched_probe:
@@ -297,7 +298,7 @@ class _BaseTpuJoinExec(TpuExec):
                 covered_sorted, mode="drop")
             return out
 
-        return jax.jit(fn)(build.row_index, lo, counts)
+        return self._cached_jit("covered", fn)(build.row_index, lo, counts)
 
     def _unmatched_build_tail(self, build_batch, build, matched_any):
         def fn(cols, matched, num_rows):
@@ -306,8 +307,9 @@ class _BaseTpuJoinExec(TpuExec):
             out, cnt = compact_columns(keep, b.columns)
             return tuple(out), cnt
 
-        out, cnt = jax.jit(fn)(tuple(build_batch.columns), matched_any,
-                               jnp.int32(build_batch.num_rows))
+        out, cnt = self._cached_jit("build_tail", fn)(
+            tuple(build_batch.columns), matched_any,
+            jnp.int32(build_batch.num_rows))
         n = int(cnt)
         if n == 0:
             return None

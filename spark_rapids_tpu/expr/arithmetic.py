@@ -111,7 +111,9 @@ def _decimal_bound_check(ctx, data, dt: T.DecimalType, validity, ansi: bool,
     precision>=19 exceeds int64 storage; the effective bound is then the
     int64 range itself (callers must detect intermediate wraps separately)."""
     if dt.precision >= 19:
-        bound_ok = (data < jnp.int64(2 ** 63 - 1)) & (data > jnp.int64(-(2 ** 63) + 1))
+        # int64 storage bound, inclusive; only INT64_MIN is excluded (callers
+        # use it as a wrap sentinel when detecting intermediate overflow)
+        bound_ok = data != jnp.int64(-(2 ** 63))
     else:
         bound = _pow10_i64(dt.precision)
         bound_ok = (data < bound) & (data > -bound)

@@ -10,14 +10,21 @@ unchanged on real TPU chips.
 import os
 
 # Force the CPU backend for tests (SRT_TEST_ON_TPU=1 opts into real chips).
-# Note: the container's sitecustomize may have pre-registered a TPU plugin;
-# JAX_PLATFORMS=cpu keeps execution on the XLA CPU backend regardless.
-if os.environ.get("SRT_TEST_ON_TPU") != "1":
-    os.environ["JAX_PLATFORMS"] = "cpu"
+# The container's sitecustomize imports jax at interpreter startup with
+# JAX_PLATFORMS=axon already set, so mutating os.environ here is too late —
+# jax.config.update("jax_platforms", ...) is honored up until the backend
+# actually initializes (first jax.devices() call), which is what we need.
+# Running float64 tests on a real v5e silently downgrades to the f64
+# emulation (~1e-15 relative error), which breaks exact differential tests.
 xf = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in xf:
     os.environ["XLA_FLAGS"] = (
         xf + " --xla_force_host_platform_device_count=8").strip()
+if os.environ.get("SRT_TEST_ON_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

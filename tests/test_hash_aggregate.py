@@ -134,3 +134,16 @@ def test_first_last():
     # first/last depend on encounter order: with a single input partition
     # and stable sort they are deterministic on both engines
     assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_groupby_minmax_string_with_nulls():
+    """Regression: a NULL row must never beat a valid string for min/max
+    (null sentinel used to collide with real key words)."""
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=6),
+                        StringGen(min_len=0, max_len=8,
+                                  charset=" 0AZazé中")],
+                    ["k", "v"], length=400)
+        return df.group_by("k").agg(min_("v", "mn"), max_("v", "mx"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)

@@ -154,3 +154,24 @@ def test_join_multi_key():
         return DataFrame(node, s)
 
     assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_join_build_larger_than_probe_capacity():
+    """Regression: build rows at sorted positions beyond the probe batch
+    capacity must still gather the right build row (clip bound bug)."""
+    def build(s):
+        # probe of 600 rows lands in the 1024 bucket; build of 3000 rows
+        # lands in the 8192 bucket, so valid sorted build positions exceed
+        # the probe capacity.
+        left, right = _two_tables(s, IntegerGen(min_val=0, max_val=5000),
+                                  n_left=600, n_right=3000)
+        from spark_rapids_tpu.plan import nodes as PN
+        from spark_rapids_tpu.session import DataFrame
+
+        lkeys = [col("k").resolve(left.schema)]
+        rkeys = [col("rk").resolve(right.schema)]
+        node = PN.SortMergeJoin(left.plan, right.plan, lkeys, rkeys,
+                                PN.JoinType.INNER)
+        return DataFrame(node, s)
+
+    assert_tpu_and_cpu_are_equal_collect(build)
