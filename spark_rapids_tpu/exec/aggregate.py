@@ -66,8 +66,27 @@ class TpuHashAggregateExec(TpuExec):
 
     # ------------------------------------------------------------------
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
-        batches = list(self.children[0].execute_columnar())
-        if not batches:
+        """Streaming aggregation with bounded memory.
+
+        Reference analog: GpuAggregateIterator + GpuMergeAggregateIterator —
+        each input batch is pre-aggregated on its own, the per-batch results
+        (buffer form) are kept *spillable*, then merged pairwise; only at the
+        end does FINAL mode apply the finalizing transform.  Peak HBM is
+        ~2 batches regardless of input count, and every step runs inside the
+        OOM-retry framework (split-and-retry on the pre-aggregation, since
+        splitting input rows pre-agg is always sound)."""
+        from spark_rapids_tpu.memory.retry import with_retry, with_retry_no_split
+        from spark_rapids_tpu.memory.spill import get_spill_framework
+
+        fw = get_spill_framework()
+        spillables = []
+        any_input = False
+        for b in self.children[0].execute_columnar():
+            any_input = True
+            with self.metrics["opTime"].timed():
+                for out in with_retry(fw.track(b), self._preagg_batch):
+                    spillables.append(fw.track(out))
+        if not any_input:
             from spark_rapids_tpu.columnar.batch import empty_batch
 
             if not self.grouping:
@@ -76,10 +95,162 @@ class TpuHashAggregateExec(TpuExec):
                 yield empty_batch(self._output)
             return
         with self.metrics["opTime"].timed():
-            batch = (batches[0] if len(batches) == 1
-                     else ColumnarBatch.concat(batches))
-            out = self._aggregate_batch(batch)
+            # pairwise merge tree over spillable partials
+            while len(spillables) > 1:
+                a, b2 = spillables.pop(0), spillables.pop(0)
+                merged = with_retry_no_split(lambda: self._merge_pair(a, b2))
+                spillables.append(fw.track(merged))
+            last = spillables[0]
+            buf = last.get_batch()
+            last.close()
+            out = self._finalize(buf)
         yield self._count_output(out)
+
+    # -- streaming pieces ----------------------------------------------
+    def _buffer_schema(self) -> T.StructType:
+        """Schema of the intermediate buffer form (PARTIAL-shaped)."""
+        if self.mode == AggregateMode.FINAL:
+            return self.child_schema
+        return self._output  # PARTIAL output is the buffer form
+
+    def _preagg_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """One input batch -> buffer-form partial result."""
+        if self.mode == AggregateMode.FINAL:
+            # child feeds buffer rows: reduce them with merge semantics
+            return self._merge_batch(batch)
+        return self._aggregate_batch(batch)
+
+    def _merge_pair(self, a, b) -> ColumnarBatch:
+        a.pin()
+        b.pin()
+        try:
+            cat = ColumnarBatch.concat([a.get_batch(), b.get_batch()])
+        finally:
+            a.unpin()
+            b.unpin()
+        a.close()
+        b.close()
+        return self._merge_batch(cat)
+
+    def _merge_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Re-aggregate buffer-form rows with per-agg merge functions."""
+        key = ("merge", batch.capacity)
+        cache = getattr(self, "_merge_jits", None)
+        if cache is None:
+            cache = self._merge_jits = {}
+        if key not in cache:
+            cache[key] = jax.jit(self._merge_fn)
+        cols, nrows = cache[key](tuple(batch.columns),
+                                 jnp.int32(batch.num_rows))
+        return ColumnarBatch(list(cols), int(nrows), self._buffer_schema())
+
+    def _finalize(self, buf: ColumnarBatch) -> ColumnarBatch:
+        """Buffer form -> this node's output form."""
+        if self.mode == AggregateMode.FINAL:
+            return self._aggregate_batch(buf)
+        return buf  # PARTIAL / COMPLETE buffers are the output
+
+    def _merge_fn(self, cols, num_rows):
+        schema = self._buffer_schema()
+        batch = ColumnarBatch(list(cols), num_rows, schema)
+        ctx = EvalContext(batch, ansi=self.ansi)
+        k = len(self.grouping)
+        key_cols = list(batch.columns[:k])
+        cap = batch.capacity
+        mask = batch.row_mask
+        if not key_cols:
+            seg = jnp.where(mask, 0, 1).astype(jnp.int32)
+            perm = None
+            mask_sorted = mask
+            group_valid = jnp.ones(1, jnp.bool_)
+            ngroups = jnp.int32(1)
+            nseg = 1
+        else:
+            keys: List[jax.Array] = []
+            hi = jnp.int64(9223372036854775807)
+            for kc in key_cols:
+                nullk = jnp.where(kc.validity, 0, -1).astype(jnp.int64)
+                keys.append(jnp.where(mask, nullk, hi))
+                for w in _column_key_words(kc):
+                    keys.append(jnp.where(mask, jnp.where(kc.validity, w, 0), hi))
+            perm = jax.lax.sort(
+                tuple(keys) + (jnp.arange(cap, dtype=jnp.int32),),
+                num_keys=len(keys), is_stable=True)[-1]
+            sorted_keys = [kk[perm] for kk in keys]
+            mask_sorted = mask[perm]
+            seg, ngroups = group_segments(sorted_keys, mask_sorted)
+            seg = jnp.where(mask_sorted, seg, cap - 1)
+            group_valid = jnp.arange(cap) < ngroups
+            nseg = cap
+        out_cols: List[DeviceColumn] = []
+        if key_cols:
+            first_idx = SEG.seg_first_index(seg, mask_sorted, cap)
+            safe_first = jnp.clip(first_idx, 0, cap - 1)
+            for kc in key_cols:
+                kcs = _gather_col(kc, perm)
+                g = _gather_col(kcs, safe_first)
+                out_cols.append(DeviceColumn(
+                    g.dtype, g.validity & group_valid, data=g.data,
+                    chars=g.chars, lengths=g.lengths))
+        pos = k
+        for a, nbuf in zip(self.aggregates, self._buffer_widths()):
+            bufs = [batch.columns[pos + i] for i in range(nbuf)]
+            fields = [schema.fields[pos + i] for i in range(nbuf)]
+            pos += nbuf
+            out_cols.extend(self._eval_merge(
+                a, bufs, fields, perm, seg, mask_sorted, cap, group_valid,
+                nseg))
+        return tuple(out_cols), (ngroups.astype(jnp.int32)
+                                 if key_cols else jnp.int32(1))
+
+    def _buffer_widths(self) -> List[int]:
+        return [2 if a.func == "avg" else 1 for a in self.aggregates]
+
+    def _eval_merge(self, a, bufs, fields, perm, seg, mask_sorted, cap,
+                    group_valid, nseg) -> List[DeviceColumn]:
+        """Merge semantics per aggregate: sum->sum, count->sum, min->min,
+        max->max, first->first, last->last, avg(sum,count)->(sum,sum)."""
+        func = "count" if a.func == "count_star" else a.func
+        out = []
+        for f, c in zip(fields, bufs):
+            cs = c if perm is None else _gather_col(c, perm)
+            validity = cs.validity & mask_sorted
+            if func in ("sum", "count", "avg"):
+                s, has = SEG.seg_sum(
+                    cs.data.astype(jnp.float64)
+                    if _is_float(f.dataType) else cs.data.astype(jnp.int64),
+                    validity, seg, nseg)
+                if func == "count" or f.name.endswith("_count"):
+                    out.append(DeviceColumn(
+                        f.dataType, group_valid,
+                        data=s.astype(T.storage_dtype(f.dataType))))
+                else:
+                    out.append(DeviceColumn(
+                        f.dataType, group_valid & has,
+                        data=s.astype(T.storage_dtype(f.dataType))))
+            elif func in ("min", "max"):
+                if cs.is_string:
+                    out.append(self._minmax_string(
+                        cs, func, seg, validity, cap, group_valid, f, nseg))
+                else:
+                    fn = SEG.seg_min if func == "min" else SEG.seg_max
+                    m, has = fn(cs.data, validity, seg, nseg,
+                                _is_float(f.dataType))
+                    out.append(DeviceColumn(
+                        f.dataType, group_valid & has,
+                        data=m.astype(T.storage_dtype(f.dataType))
+                        if not isinstance(f.dataType, T.BooleanType) else m))
+            elif func in ("first", "last"):
+                idx_fn = (SEG.seg_first_index if func == "first"
+                          else _seg_last_index)
+                idx = idx_fn(seg, mask_sorted, nseg)
+                g = _gather_col(cs, jnp.clip(idx, 0, cap - 1))
+                out.append(DeviceColumn(f.dataType, g.validity & group_valid,
+                                        data=g.data, chars=g.chars,
+                                        lengths=g.lengths))
+            else:
+                raise NotImplementedError(f"merge for {func}")
+        return out
 
     def _global_agg_empty(self) -> ColumnarBatch:
         cols = []

@@ -40,22 +40,41 @@ class TpuCoalesceBatchesExec(TpuExec):
         return f"TpuCoalesceBatches {g}"
 
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
-        pending: List[ColumnarBatch] = []
+        """Pending batches are held *spillable* while more input streams in
+        (reference: the coalesce iterator's batches are
+        SpillableColumnarBatch), and the concat runs in a retry block."""
+        from spark_rapids_tpu.memory.spill import get_spill_framework
+
+        fw = get_spill_framework()
+        pending: List = []   # SpillableColumnarBatch
         pending_bytes = 0
         with self.metric("concatTime").timed():
             for b in self.children[0].execute_columnar():
-                if self.goal.require_single:
-                    pending.append(b)
-                    continue
                 nb = b.nbytes()
-                if pending and pending_bytes + nb > self.goal.target_bytes:
+                if (pending and not self.goal.require_single
+                        and pending_bytes + nb > self.goal.target_bytes):
                     yield self._flush(pending)
                     pending, pending_bytes = [], 0
-                pending.append(b)
+                pending.append(fw.track(b))
                 pending_bytes += nb
         if pending:
             yield self._flush(pending)
 
-    def _flush(self, pending: List[ColumnarBatch]) -> ColumnarBatch:
-        out = pending[0] if len(pending) == 1 else ColumnarBatch.concat(pending)
+    def _flush(self, pending: List) -> ColumnarBatch:
+        from spark_rapids_tpu.memory.retry import with_retry_no_split
+
+        def concat():
+            for s in pending:
+                s.pin()
+            try:
+                batches = [s.get_batch() for s in pending]
+                return (batches[0] if len(batches) == 1
+                        else ColumnarBatch.concat(batches))
+            finally:
+                for s in pending:
+                    s.unpin()
+
+        out = with_retry_no_split(concat)
+        for s in pending:
+            s.close()
         return self._count_output(out)

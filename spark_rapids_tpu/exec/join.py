@@ -244,39 +244,49 @@ class _BaseTpuJoinExec(TpuExec):
         if jt == JoinType.RIGHT_OUTER:
             yield from self._execute_right_outer()
             return
+        from spark_rapids_tpu.memory.retry import with_retry
+        from spark_rapids_tpu.memory.spill import get_spill_framework
+
         build_batch = self._build_batch()
         with self.metric("buildTime").timed():
             build = self._prepare_build(build_batch, self.right_keys)
         matched_build_any = None
         if jt == JoinType.FULL_OUTER:
             matched_build_any = jnp.zeros(build_batch.capacity, jnp.bool_)
+        fw = get_spill_framework()
+
+        def probe_one(probe: ColumnarBatch):
+            """Per-probe-batch join; re-runnable and probe-splittable (the
+            reference splits the stream side on SplitAndRetryOOM; FULL
+            OUTER's coverage update is an idempotent OR)."""
+            nonlocal matched_build_any
+            lo, counts, total, unmatched, n_um = self._probe_counts(
+                build, probe)
+            total_host = int(total)
+            if jt == JoinType.LEFT_SEMI:
+                return self._semi_anti(probe, counts, anti=False)
+            if jt == JoinType.LEFT_ANTI:
+                return self._semi_anti(probe, counts, anti=True)
+            with_um = jt in (JoinType.LEFT_OUTER, JoinType.FULL_OUTER)
+            um_host = int(n_um) if with_um else 0
+            if jt == JoinType.FULL_OUTER:
+                matched_build_any = matched_build_any | \
+                    self._covered_build_rows(build, lo, counts)
+            if total_host + um_host == 0:
+                return None
+            lcols, bcols, nrows = self._materialize(
+                build, probe, lo, counts, total_host, unmatched,
+                with_um, um_host)
+            out = ColumnarBatch(list(lcols) + list(bcols), nrows,
+                                self._output)
+            return self._apply_condition(out)
+
         for probe in self._probe_child().execute_columnar():
             with self.metric("joinTime").timed():
-                lo, counts, total, unmatched, n_um = self._probe_counts(
-                    build, probe)
-                total_host = int(total)
-                if jt == JoinType.LEFT_SEMI:
-                    yield self._count_output(
-                        self._semi_anti(probe, counts, anti=False))
-                    continue
-                if jt == JoinType.LEFT_ANTI:
-                    yield self._count_output(
-                        self._semi_anti(probe, counts, anti=True))
-                    continue
-                with_um = jt in (JoinType.LEFT_OUTER, JoinType.FULL_OUTER)
-                um_host = int(n_um) if with_um else 0
-                if total_host + um_host == 0:
-                    continue
-                if jt == JoinType.FULL_OUTER:
-                    matched_build_any = matched_build_any | \
-                        self._covered_build_rows(build, lo, counts)
-                lcols, bcols, nrows = self._materialize(
-                    build, probe, lo, counts, total_host, unmatched,
-                    with_um, um_host)
-                out = ColumnarBatch(list(lcols) + list(bcols), nrows,
-                                    self._output)
-                out = self._apply_condition(out)
-            yield self._count_output(out)
+                outs = list(with_retry(fw.track(probe), probe_one))
+            for out in outs:
+                if out is not None:
+                    yield self._count_output(out)
         if jt == JoinType.FULL_OUTER:
             tail = self._unmatched_build_tail(build_batch, build,
                                               matched_build_any)

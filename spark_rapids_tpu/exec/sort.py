@@ -72,15 +72,33 @@ class TpuSortExec(TpuExec):
         return self._jitted
 
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
-        batches = list(self.children[0].execute_columnar())
-        if not batches:
+        from spark_rapids_tpu.memory.retry import with_retry_no_split
+        from spark_rapids_tpu.memory.spill import get_spill_framework
+
+        fw = get_spill_framework()
+        spillables = [fw.track(b)
+                      for b in self.children[0].execute_columnar()]
+        if not spillables:
             return
         with self.metric("sortTime").timed():
-            batch = (batches[0] if len(batches) == 1
-                     else ColumnarBatch.concat(batches))
-            fn = self._sort_fn(batch.schema)
-            cols = fn(tuple(batch.columns), jnp.int32(batch.num_rows))
-            out = ColumnarBatch(list(cols), batch.num_rows, batch.schema)
+            def run():
+                for s in spillables:
+                    s.pin()
+                try:
+                    batches = [s.get_batch() for s in spillables]
+                    batch = (batches[0] if len(batches) == 1
+                             else ColumnarBatch.concat(batches))
+                    fn = self._sort_fn(batch.schema)
+                    cols = fn(tuple(batch.columns), jnp.int32(batch.num_rows))
+                    return ColumnarBatch(list(cols), batch.num_rows,
+                                         batch.schema)
+                finally:
+                    for s in spillables:
+                        s.unpin()
+
+            out = with_retry_no_split(run)
+            for s in spillables:
+                s.close()
         yield self._count_output(out)
 
 

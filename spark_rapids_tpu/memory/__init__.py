@@ -1,0 +1,41 @@
+"""Memory & execution runtime (SURVEY.md §2.3).
+
+Reference analogs: GpuDeviceManager (RMM pool init), GpuSemaphore (task
+admission), the spill framework (SpillableColumnarBatch, device->host->disk
+stores), and RmmRapidsRetryIterator (cooperative OOM retry / split-and-retry).
+
+TPU adaptation: XLA owns physical HBM, so the arena is a *logical* budget —
+every live batch is registered with the spill framework and accounted
+against the pool derived from the chip's memory stats; pressure beyond the
+budget spills least-recently-used batches host-ward and, cooperatively,
+raises TpuRetryOOM / TpuSplitAndRetryOOM for the retry framework to unwind
+(mirroring RmmSpark's allocation callbacks without cudaMalloc semantics).
+"""
+from spark_rapids_tpu.memory.device_manager import (
+    TpuDeviceManager,
+    get_device_manager,
+)
+from spark_rapids_tpu.memory.retry import (
+    TpuRetryOOM,
+    TpuSplitAndRetryOOM,
+    force_retry_oom,
+    force_split_and_retry_oom,
+    split_in_half_by_rows,
+    with_retry,
+    with_retry_no_split,
+)
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore, get_semaphore
+from spark_rapids_tpu.memory.spill import (
+    SpillableColumnarBatch,
+    SpillFramework,
+    get_spill_framework,
+)
+
+__all__ = [
+    "TpuDeviceManager", "get_device_manager",
+    "TpuRetryOOM", "TpuSplitAndRetryOOM", "force_retry_oom",
+    "force_split_and_retry_oom", "split_in_half_by_rows", "with_retry",
+    "with_retry_no_split",
+    "TpuSemaphore", "get_semaphore",
+    "SpillableColumnarBatch", "SpillFramework", "get_spill_framework",
+]

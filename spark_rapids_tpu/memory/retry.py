@@ -1,0 +1,162 @@
+"""Cooperative OOM retry — the resilience backbone.
+
+Reference analog: RmmRapidsRetryIterator.withRetry / withRetryNoSplit /
+splitSpillableInHalfByRows + the jni RmmSpark / SparkResourceAdaptor state
+machine (SURVEY.md §2.3, §5.3): per-batch work runs inside a retry block; a
+failed allocation surfaces as GpuRetryOOM (roll back, spill, retry) or
+GpuSplitAndRetryOOM (roll back, split the input in half, retry each half).
+Tests force these via RmmSpark.forceRetryOOM / forceSplitAndRetryOOM.
+
+TPU adaptation: XLA signals device OOM with RESOURCE_EXHAUSTED runtime
+errors, which we translate into the same two exceptions; the spill
+framework's ensure_room() failing is the cooperative (pre-allocation)
+signal.  The injection hooks match the reference's test API.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterator, List, Optional, TypeVar, Union
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.memory.spill import SpillableColumnarBatch
+
+X = TypeVar("X")
+
+
+class TpuRetryOOM(RuntimeError):
+    """Roll back and retry the block (after the framework spills)."""
+
+
+class TpuSplitAndRetryOOM(RuntimeError):
+    """Roll back, split the input in half by rows, retry each half."""
+
+
+class _InjectState(threading.local):
+    def __init__(self):
+        self.retry_count = 0
+        self.split_count = 0
+
+
+_inject = _InjectState()
+
+
+def force_retry_oom(count: int = 1) -> None:
+    """Test hook (reference: RmmSpark.forceRetryOOM)."""
+    _inject.retry_count = count
+
+
+def force_split_and_retry_oom(count: int = 1) -> None:
+    """Test hook (reference: RmmSpark.forceSplitAndRetryOOM)."""
+    _inject.split_count = count
+
+
+def _check_injection() -> None:
+    if _inject.retry_count > 0:
+        _inject.retry_count -= 1
+        raise TpuRetryOOM("injected")
+    if _inject.split_count > 0:
+        _inject.split_count -= 1
+        raise TpuSplitAndRetryOOM("injected")
+
+
+def _is_device_oom(exc: BaseException) -> bool:
+    s = repr(exc)
+    return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s
+
+
+def split_in_half_by_rows(
+        spillable: SpillableColumnarBatch) -> List[SpillableColumnarBatch]:
+    """Reference analog: splitSpillableInHalfByRows."""
+    from spark_rapids_tpu.memory.spill import get_spill_framework
+
+    batch = spillable.get_batch()
+    n = batch.num_rows
+    if n < 2:
+        raise TpuSplitAndRetryOOM(
+            f"cannot split batch of {n} rows any further")
+    half = n // 2
+    fw = get_spill_framework()
+    first = fw.track(batch.slice_rows(0, half))
+    second = fw.track(batch.slice_rows(half, n - half))
+    spillable.close()
+    return [first, second]
+
+
+def with_retry(
+        inputs: Union[SpillableColumnarBatch, List[SpillableColumnarBatch]],
+        fn: Callable[[ColumnarBatch], X],
+        max_attempts: int = 8,
+        min_split_rows: int = 8,
+        split: bool = True) -> Iterator[X]:
+    """Run fn over each input batch with OOM retry and split-and-retry.
+
+    `fn` must be re-runnable (CheckpointRestore contract: no side effects
+    it cannot repeat).  Yields one result per (possibly split) input."""
+    from spark_rapids_tpu.memory.spill import get_spill_framework
+
+    queue: List[SpillableColumnarBatch] = (
+        [inputs] if isinstance(inputs, SpillableColumnarBatch) else
+        list(inputs))
+    fw = get_spill_framework()
+    while queue:
+        item = queue.pop(0)
+        attempts = 0
+        while True:
+            attempts += 1
+            try:
+                _check_injection()
+                item.pin()
+                try:
+                    result = fn(item.get_batch())
+                finally:
+                    item.unpin()
+                item.close()
+                yield result
+                break
+            except TpuRetryOOM:
+                if attempts >= max_attempts:
+                    item.close()
+                    raise
+                fw.spill_device_pressure()
+            except TpuSplitAndRetryOOM:
+                if not split or item.num_rows < max(min_split_rows, 2):
+                    item.close()
+                    raise
+                queue = split_in_half_by_rows(item) + queue
+                break
+            except Exception as e:  # XLA RESOURCE_EXHAUSTED
+                if not _is_device_oom(e):
+                    item.close()
+                    raise
+                fw.spill_device_pressure()
+                if split and item.num_rows >= max(min_split_rows, 2):
+                    queue = split_in_half_by_rows(item) + queue
+                    break
+                if attempts >= max_attempts:
+                    item.close()
+                    raise
+    return
+
+
+def with_retry_no_split(fn: Callable[[], X], max_attempts: int = 8) -> X:
+    """Reference analog: withRetryNoSplit — retry a block (spilling between
+    attempts) without an input to split."""
+    from spark_rapids_tpu.memory.spill import get_spill_framework
+
+    fw = get_spill_framework()
+    attempts = 0
+    while True:
+        attempts += 1
+        try:
+            _check_injection()
+            return fn()
+        except TpuRetryOOM:
+            if attempts >= max_attempts:
+                raise
+            fw.spill_device_pressure()
+        except TpuSplitAndRetryOOM:
+            raise
+        except Exception as e:
+            if not _is_device_oom(e) or attempts >= max_attempts:
+                raise
+            fw.spill_device_pressure()

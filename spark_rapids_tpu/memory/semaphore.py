@@ -1,0 +1,88 @@
+"""TpuSemaphore — bounds how many tasks hold the device concurrently.
+
+Reference analog: GpuSemaphore (SURVEY.md §2.3):
+``spark.rapids.sql.concurrentGpuTasks`` permits gate device access so
+oversubscribed Spark tasks don't OOM the device together; host-side work
+(file fetch/decode threads) deliberately runs *outside* the semaphore.
+
+Here a "task" is the thread driving a partition's iterator chain.  Permits
+are reentrant per thread (a task that already holds one passes through),
+matching acquireIfNecessary semantics.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class TpuSemaphore:
+    def __init__(self, permits: int):
+        self.permits = permits
+        self._available = permits
+        self._cond = threading.Condition()
+        self._holders: Dict[int, int] = {}   # thread id -> depth
+        self.total_wait_ns = 0               # semaphoreWaitTime metric
+
+    def acquire_if_necessary(self, timeout: Optional[float] = None) -> None:
+        tid = threading.get_ident()
+        with self._cond:
+            if self._holders.get(tid, 0) > 0:
+                self._holders[tid] += 1
+                return
+            t0 = time.perf_counter_ns()
+            while self._available <= 0:
+                if not self._cond.wait(timeout):
+                    raise TimeoutError("TpuSemaphore acquire timed out")
+            self.total_wait_ns += time.perf_counter_ns() - t0
+            self._available -= 1
+            self._holders[tid] = 1
+
+    def release_if_necessary(self) -> None:
+        tid = threading.get_ident()
+        with self._cond:
+            depth = self._holders.get(tid, 0)
+            if depth == 0:
+                return
+            if depth > 1:
+                self._holders[tid] = depth - 1
+                return
+            del self._holders[tid]
+            self._available += 1
+            self._cond.notify()
+
+    def held_by_current_thread(self) -> bool:
+        return self._holders.get(threading.get_ident(), 0) > 0
+
+    class _Scope:
+        def __init__(self, sem):
+            self.sem = sem
+
+        def __enter__(self):
+            self.sem.acquire_if_necessary()
+            return self.sem
+
+        def __exit__(self, *a):
+            self.sem.release_if_necessary()
+
+    def scope(self) -> "_Scope":
+        return TpuSemaphore._Scope(self)
+
+
+_lock = threading.Lock()
+_semaphore: Optional[TpuSemaphore] = None
+
+
+def get_semaphore(permits: Optional[int] = None) -> TpuSemaphore:
+    global _semaphore
+    with _lock:
+        if _semaphore is None or (permits is not None
+                                  and _semaphore.permits != permits):
+            _semaphore = TpuSemaphore(permits if permits is not None else 2)
+        return _semaphore
+
+
+def reset_semaphore() -> None:
+    global _semaphore
+    with _lock:
+        _semaphore = None

@@ -1,0 +1,324 @@
+"""Spill framework — spillable batches with device -> host -> disk tiers.
+
+Reference analog: spill/SpillFramework.scala + SpillableColumnarBatch (and
+the older RapidsBufferCatalog / RapidsDeviceMemoryStore / RapidsHostMemoryStore
+/ RapidsDiskStore family) in SURVEY.md §2.3: batches an operator is not
+actively computing on are registered as spillable handles; under memory
+pressure the framework moves the least-recently-used ones down-tier and
+materializes them back on demand.
+
+TPU adaptation: XLA owns physical HBM, so the device tier is accounted
+logically — a handle's batch contributes its padded nbytes to the pool while
+device-resident.  Spilling device->host is a jax.device_get into pinned-ish
+numpy arrays; host->disk is an .npz file under ``spark.rapids.memory.spillDir``.
+Materializing uploads back (which may in turn spill other handles).
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.config import (
+    HOST_SPILL_STORAGE_SIZE,
+    MEM_DEBUG,
+    SPILL_DIR,
+    TpuConf,
+)
+
+STATE_DEVICE = "DEVICE"
+STATE_HOST = "HOST"
+STATE_DISK = "DISK"
+
+
+class SpillableColumnarBatch:
+    """A batch handle that can migrate between HBM, host RAM, and disk.
+
+    Reference analog: SpillableColumnarBatch /
+    SpillableColumnarBatchHandle."""
+
+    def __init__(self, batch: ColumnarBatch, framework: "SpillFramework"):
+        self._framework = framework
+        self._batch: Optional[ColumnarBatch] = batch
+        self._host: Optional[List[Dict[str, np.ndarray]]] = None
+        self._disk_path: Optional[str] = None
+        self.schema = batch.schema
+        self.num_rows = batch.num_rows
+        self.device_bytes = batch.nbytes()
+        self.state = STATE_DEVICE
+        self.pinned = 0          # >0 while an operator computes on it
+        self.lru_tick = 0
+        self.closed = False
+        framework._register(self)
+
+    # -- public API ------------------------------------------------------
+    def get_batch(self) -> ColumnarBatch:
+        """Materialize on device (unspilling if needed) and bump LRU."""
+        with self._framework._lock:
+            self._framework._touch(self)
+            if self.state == STATE_DEVICE:
+                return self._batch
+        # needs unspill: make room first (outside our own pin)
+        self._framework.ensure_room(self.device_bytes, exclude=self)
+        with self._framework._lock:
+            if self.state == STATE_DISK:
+                self._disk_to_host_locked()
+            if self.state == STATE_HOST:
+                self._host_to_device_locked()
+            return self._batch
+
+    def pin(self) -> "SpillableColumnarBatch":
+        with self._framework._lock:
+            self.pinned += 1
+        return self
+
+    def unpin(self) -> None:
+        with self._framework._lock:
+            self.pinned = max(0, self.pinned - 1)
+
+    def close(self) -> None:
+        with self._framework._lock:
+            if self.closed:
+                return
+            self.closed = True
+            self._framework._unregister(self)
+            self._batch = None
+            self._host = None
+            if self._disk_path and os.path.exists(self._disk_path):
+                try:
+                    os.unlink(self._disk_path)
+                except OSError:
+                    pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+    # -- tier moves (framework lock held) --------------------------------
+    def _device_to_host_locked(self) -> int:
+        import jax
+
+        assert self.state == STATE_DEVICE
+        host_cols = []
+        for c in self._batch.columns:
+            entry = {"validity": np.asarray(jax.device_get(c.validity))}
+            if c.is_string:
+                entry["chars"] = np.asarray(jax.device_get(c.chars))
+                entry["lengths"] = np.asarray(jax.device_get(c.lengths))
+            else:
+                entry["data"] = np.asarray(jax.device_get(c.data))
+            host_cols.append(entry)
+        self._host = host_cols
+        self._batch = None
+        self.state = STATE_HOST
+        return self.device_bytes
+
+    def _host_to_device_locked(self) -> None:
+        import jax.numpy as jnp
+
+        assert self.state == STATE_HOST
+        cols = []
+        for f, entry in zip(self.schema.fields, self._host):
+            if "chars" in entry:
+                cols.append(DeviceColumn(
+                    f.dataType, jnp.asarray(entry["validity"]),
+                    chars=jnp.asarray(entry["chars"]),
+                    lengths=jnp.asarray(entry["lengths"])))
+            else:
+                cols.append(DeviceColumn(
+                    f.dataType, jnp.asarray(entry["validity"]),
+                    data=jnp.asarray(entry["data"])))
+        self._batch = ColumnarBatch(cols, self.num_rows, self.schema)
+        self._host = None
+        self.state = STATE_DEVICE
+        self._framework._device_used += self.device_bytes
+
+    def host_bytes(self) -> int:
+        if self._host is None:
+            return 0
+        return sum(a.nbytes for e in self._host for a in e.values())
+
+    def _host_to_disk_locked(self) -> int:
+        assert self.state == STATE_HOST
+        nbytes = self.host_bytes()
+        arrays = {}
+        for i, entry in enumerate(self._host):
+            for k, v in entry.items():
+                arrays[f"c{i}_{k}"] = v
+        fd, path = tempfile.mkstemp(suffix=".spill.npz",
+                                    dir=self._framework.spill_dir)
+        os.close(fd)
+        np.savez(path, **arrays)
+        self._disk_path = path
+        self._host = None
+        self.state = STATE_DISK
+        return nbytes
+
+    def _disk_to_host_locked(self) -> None:
+        assert self.state == STATE_DISK
+        loaded = np.load(self._disk_path)
+        host_cols: List[Dict[str, np.ndarray]] = []
+        for i in range(len(self.schema.fields)):
+            entry = {}
+            for k in ("validity", "data", "chars", "lengths"):
+                key = f"c{i}_{k}"
+                if key in loaded:
+                    entry[k] = loaded[key]
+            host_cols.append(entry)
+        self._host = host_cols
+        try:
+            os.unlink(self._disk_path)
+        except OSError:
+            pass
+        self._disk_path = None
+        self.state = STATE_HOST
+
+
+class SpillFramework:
+    """Tracks spillable handles and enforces the HBM pool budget."""
+
+    def __init__(self, pool_bytes: int, host_limit: int,
+                 spill_dir: Optional[str], debug: bool = False):
+        self.pool_bytes = pool_bytes
+        self.host_limit = host_limit
+        self.spill_dir = spill_dir
+        if self.spill_dir:
+            os.makedirs(self.spill_dir, exist_ok=True)
+        self.debug = debug
+        self._lock = threading.RLock()
+        self._handles: List[SpillableColumnarBatch] = []
+        self._device_used = 0
+        self._tick = 0
+        # metrics (GpuTaskMetrics analog)
+        self.spill_to_host_count = 0
+        self.spill_to_disk_count = 0
+        self.spill_to_host_bytes = 0
+        self.spill_to_disk_bytes = 0
+
+    # -- registration ----------------------------------------------------
+    def _register(self, h: SpillableColumnarBatch) -> None:
+        with self._lock:
+            self._touch(h)
+            self._handles.append(h)
+            self._device_used += h.device_bytes
+        # over-budget after admitting the new batch: shed others
+        self.ensure_room(0, exclude=h)
+
+    def _unregister(self, h: SpillableColumnarBatch) -> None:
+        if h.state == STATE_DEVICE:
+            self._device_used -= h.device_bytes
+        if h in self._handles:
+            self._handles.remove(h)
+
+    def _touch(self, h: SpillableColumnarBatch) -> None:
+        self._tick += 1
+        h.lru_tick = self._tick
+
+    def track(self, batch: ColumnarBatch) -> SpillableColumnarBatch:
+        return SpillableColumnarBatch(batch, self)
+
+    # -- pressure --------------------------------------------------------
+    @property
+    def device_used(self) -> int:
+        return self._device_used
+
+    def ensure_room(self, nbytes: int,
+                    exclude: Optional[SpillableColumnarBatch] = None) -> bool:
+        """Spill LRU device handles until `nbytes` more fit in the pool.
+
+        Returns False when the budget cannot be met (caller's retry block
+        turns that into TpuRetryOOM)."""
+        while True:
+            with self._lock:
+                if self._device_used + nbytes <= self.pool_bytes:
+                    return True
+                victims = sorted(
+                    (h for h in self._handles
+                     if h.state == STATE_DEVICE and h.pinned == 0
+                     and h is not exclude),
+                    key=lambda h: h.lru_tick)
+                if not victims:
+                    return False
+                v = victims[0]
+                freed = v._device_to_host_locked()
+                self._device_used -= freed
+                self.spill_to_host_count += 1
+                self.spill_to_host_bytes += freed
+                if self.debug:
+                    print(f"[spill] device->host {freed >> 10}KiB "
+                          f"rows={v.num_rows} used={self._device_used >> 20}MiB")
+                self._host_pressure_locked()
+
+    def _host_pressure_locked(self) -> None:
+        host_used = sum(h.host_bytes() for h in self._handles
+                        if h.state == STATE_HOST)
+        if host_used <= self.host_limit:
+            return
+        if self.spill_dir is None:
+            self.spill_dir = tempfile.mkdtemp(prefix="srt_spill_")
+        for h in sorted((h for h in self._handles if h.state == STATE_HOST),
+                        key=lambda h: h.lru_tick):
+            if host_used <= self.host_limit:
+                break
+            n = h._host_to_disk_locked()
+            host_used -= n
+            self.spill_to_disk_count += 1
+            self.spill_to_disk_bytes += n
+
+    def spill_device_pressure(self) -> int:
+        """Spill everything unpinned (the RetryOOM 'roll back' release)."""
+        spilled = 0
+        with self._lock:
+            for h in sorted((h for h in self._handles
+                             if h.state == STATE_DEVICE and h.pinned == 0),
+                            key=lambda h: h.lru_tick):
+                freed = h._device_to_host_locked()
+                self._device_used -= freed
+                self.spill_to_host_count += 1
+                self.spill_to_host_bytes += freed
+                spilled += freed
+            self._host_pressure_locked()
+        return spilled
+
+    def metrics(self) -> Dict[str, int]:
+        return {
+            "spillToHostCount": self.spill_to_host_count,
+            "spillToDiskCount": self.spill_to_disk_count,
+            "spillToHostBytes": self.spill_to_host_bytes,
+            "spillToDiskBytes": self.spill_to_disk_bytes,
+            "deviceUsedBytes": self._device_used,
+        }
+
+
+_lock = threading.Lock()
+_framework: Optional[SpillFramework] = None
+
+
+def get_spill_framework(tpu_conf: Optional[TpuConf] = None) -> SpillFramework:
+    global _framework
+    with _lock:
+        if _framework is None or tpu_conf is not None:
+            from spark_rapids_tpu.memory.device_manager import get_device_manager
+
+            c = tpu_conf or TpuConf()
+            dm = get_device_manager(tpu_conf)
+            _framework = SpillFramework(
+                pool_bytes=dm.pool_bytes,
+                host_limit=c.get(HOST_SPILL_STORAGE_SIZE),
+                spill_dir=c.get(SPILL_DIR),
+                debug=c.get(MEM_DEBUG))
+        return _framework
+
+
+def reset_spill_framework() -> None:
+    global _framework
+    with _lock:
+        _framework = None

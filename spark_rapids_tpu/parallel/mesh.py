@@ -34,10 +34,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax>=0.4.35
-    from jax.experimental.shard_map import shard_map
+try:  # jax>=0.8
+    from jax import shard_map
 except ImportError:  # pragma: no cover
-    from jax.shard_map import shard_map  # type: ignore
+    from jax.experimental.shard_map import shard_map  # type: ignore
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:
@@ -192,4 +192,4 @@ def broadcast_build_side(mesh: Mesh, axis: str = "dp"):
         return bk, bv
 
     return shard_map(step, mesh=mesh, in_specs=(P(axis), P(axis)),
-                     out_specs=(P(None), P(None)), check_rep=False)
+                     out_specs=(P(None), P(None)), check_vma=False)

@@ -315,7 +315,27 @@ class DataFrame:
 
         root, _meta = self._planned()
         if isinstance(root, TpuExec):
-            host = TpuColumnarToRowExec(root).collect_host()
+            # Admission control: the thread driving this query's iterator
+            # chain holds a TpuSemaphore permit while it touches the device
+            # (reference: GpuSemaphore.acquireIfNecessary at first batch).
+            from spark_rapids_tpu.memory import get_semaphore, get_spill_framework
+            from spark_rapids_tpu.memory.retry import (
+                force_retry_oom,
+                force_split_and_retry_oom,
+            )
+            from spark_rapids_tpu.config import TEST_RETRY_OOM_INJECTION_MODE
+
+            get_spill_framework(self.session.conf)
+            inject = self.session.conf.get(TEST_RETRY_OOM_INJECTION_MODE)
+            if inject and inject != "NONE":
+                kind, _, n = inject.partition(":")
+                if kind.upper() == "RETRY":
+                    force_retry_oom(int(n or 1))
+                elif kind.upper() == "SPLIT":
+                    force_split_and_retry_oom(int(n or 1))
+            sem = get_semaphore(self.session.conf.concurrent_tpu_tasks)
+            with sem.scope():
+                host = TpuColumnarToRowExec(root).collect_host()
             lists = [h.to_pylist() for h in host]
             return list(zip(*lists)) if lists else []
         cols, n = execute_cpu_plan(root, ansi=self.session.conf.ansi_enabled)

@@ -1,0 +1,212 @@
+"""Memory runtime tests: spill tiers, OOM retry/split, semaphore.
+
+Reference analogs: WithRetrySuite / spill-framework suites (SURVEY.md §4),
+which force OOMs via RmmSpark.forceRetryOOM / forceSplitAndRetryOOM and
+check the work still completes correctly.
+"""
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.memory.retry import (
+    TpuSplitAndRetryOOM,
+    force_retry_oom,
+    force_split_and_retry_oom,
+    with_retry,
+    with_retry_no_split,
+)
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.memory.spill import SpillFramework
+from spark_rapids_tpu.session import TpuSession, col, lit, sum_
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import IntegerGen, StringGen, gen_df
+
+
+def _batch(n=1000, start=0):
+    data = {"a": list(range(start, start + n)),
+            "s": [f"row{i}" for i in range(n)]}
+    schema = T.StructType([T.StructField("a", T.LONG),
+                           T.StructField("s", T.STRING)])
+    return ColumnarBatch.from_pydict(data, schema)
+
+
+def _tiny_framework(pool=64 << 10, host=1 << 30, tmp=None):
+    return SpillFramework(pool_bytes=pool, host_limit=host,
+                          spill_dir=str(tmp) if tmp else None)
+
+
+def test_spill_device_to_host_and_back():
+    fw = _tiny_framework(pool=32 << 10)
+    b1 = _batch(1000)
+    h1 = fw.track(b1)          # ~22KiB: two batches exceed the 32KiB pool
+    h2 = fw.track(_batch(1000, start=5000))
+    # admitting h2 must have pushed h1 (LRU) off the device
+    assert h1.state == "HOST"
+    assert h2.state == "DEVICE"
+    # materializing h1 back evicts h2
+    rows = h1.get_batch().to_pydict()
+    assert rows["a"][:3] == [0, 1, 2]
+    assert h1.state == "DEVICE"
+    assert fw.spill_to_host_count >= 1
+    h1.close()
+    h2.close()
+    assert fw.device_used == 0
+
+
+def test_spill_to_disk(tmp_path):
+    fw = _tiny_framework(pool=32 << 10, host=16 << 10, tmp=tmp_path)
+    handles = [fw.track(_batch(1000, start=i * 1000)) for i in range(4)]
+    states = {h.state for h in handles}
+    assert "DISK" in states, states
+    # everything still materializes correctly
+    for i, h in enumerate(handles):
+        got = h.get_batch().to_pydict()["a"][0]
+        assert got == i * 1000
+        h.close()
+    assert fw.spill_to_disk_count >= 1
+
+
+def test_with_retry_injected_retry():
+    from spark_rapids_tpu.memory import spill as spill_mod
+
+    spill_mod.reset_spill_framework()
+    fw = spill_mod.get_spill_framework(TpuConf(
+        {"spark.rapids.tpu.test.deviceMemoryBytes": str(1 << 30)}))
+    calls = []
+
+    def fn(batch):
+        calls.append(batch.num_rows)
+        return batch.num_rows
+
+    force_retry_oom(2)
+    out = list(with_retry(fw.track(_batch(100)), fn))
+    assert out == [100]
+
+
+def test_with_retry_injected_split():
+    from spark_rapids_tpu.memory import spill as spill_mod
+
+    spill_mod.reset_spill_framework()
+    fw = spill_mod.get_spill_framework(TpuConf(
+        {"spark.rapids.tpu.test.deviceMemoryBytes": str(1 << 30)}))
+
+    def fn(batch):
+        return batch.num_rows
+
+    force_split_and_retry_oom(1)
+    out = list(with_retry(fw.track(_batch(100)), fn))
+    assert out == [50, 50]   # split in half, both halves processed
+
+
+def test_with_retry_split_exhausted():
+    from spark_rapids_tpu.memory import spill as spill_mod
+
+    spill_mod.reset_spill_framework()
+    fw = spill_mod.get_spill_framework(TpuConf(
+        {"spark.rapids.tpu.test.deviceMemoryBytes": str(1 << 30)}))
+    force_split_and_retry_oom(1)
+    with pytest.raises(TpuSplitAndRetryOOM):
+        list(with_retry(fw.track(_batch(1)), lambda b: b.num_rows))
+
+
+def test_with_retry_no_split():
+    attempts = []
+
+    def fn():
+        attempts.append(1)
+        return 42
+
+    force_retry_oom(1)
+    assert with_retry_no_split(fn) == 42
+    assert len(attempts) == 1   # injection fires before fn on attempt 1
+
+
+def test_semaphore_limits_concurrency():
+    sem = TpuSemaphore(1)
+    active = []
+    peak = []
+
+    def task():
+        sem.acquire_if_necessary()
+        active.append(1)
+        peak.append(len(active))
+        time.sleep(0.02)
+        active.remove(1)
+        sem.release_if_necessary()
+
+    threads = [threading.Thread(target=task) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert max(peak) == 1
+    assert sem.total_wait_ns > 0
+
+
+def test_semaphore_reentrant():
+    sem = TpuSemaphore(1)
+    sem.acquire_if_necessary()
+    sem.acquire_if_necessary()   # same thread passes through
+    sem.release_if_necessary()
+    assert sem.held_by_current_thread()
+    sem.release_if_necessary()
+    assert not sem.held_by_current_thread()
+
+
+# ---- end-to-end: queries survive injected OOMs with correct results ------
+
+_inject_confs = [
+    {"spark.rapids.sql.test.injectRetryOOM": "RETRY:2"},
+    {"spark.rapids.sql.test.injectRetryOOM": "SPLIT:1"},
+]
+
+
+@pytest.mark.parametrize("inject", _inject_confs,
+                         ids=["retry", "split"])
+def test_query_with_injected_oom(inject):
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=10),
+                        IntegerGen(min_val=-100, max_val=100)],
+                    ["k", "v"], length=400)
+        return df.group_by("k").agg(sum_("v", "sv"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=inject)
+
+
+def test_query_under_tiny_pool():
+    """The whole query runs with a pool smaller than the working set —
+    forcing real spill traffic — and still matches the CPU oracle."""
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=6),
+                        StringGen(min_len=1, max_len=12)],
+                    ["k", "v"], length=2000)
+        u = df.union(df)
+        return u.group_by("k").agg(("count", "v", "c"),
+                                   ("max", "v", "mx"))
+
+    assert_tpu_and_cpu_are_equal_collect(
+        build,
+        conf={"spark.rapids.tpu.test.deviceMemoryBytes": str(256 << 10),
+              "spark.rapids.sql.batchSizeBytes": "64k"})
+
+
+def test_multibatch_aggregate_merge_path():
+    """union -> several input batches -> the pairwise merge tree runs."""
+    def build(s):
+        df1 = gen_df(s, [IntegerGen(min_val=0, max_val=5),
+                         IntegerGen(min_val=-50, max_val=50)],
+                     ["k", "v"], length=300, seed=1)
+        df2 = gen_df(s, [IntegerGen(min_val=3, max_val=9),
+                         IntegerGen(min_val=-50, max_val=50)],
+                     ["k", "v"], length=300, seed=2)
+        u = df1.union(df2).union(df1)
+        return u.group_by("k").agg(sum_("v", "sv"), ("avg", "v", "av"),
+                                   ("min", "v", "mn"), ("count", "v", "c"))
+
+    assert_tpu_and_cpu_are_equal_collect(
+        build, conf={"spark.rapids.sql.batchSizeBytes": "1k"})
