@@ -34,10 +34,12 @@ from spark_rapids_tpu.plan.nodes import (
 
 
 class TpuShuffleExchangeExec(TpuExec):
-    def __init__(self, partitioning, child: TpuExec, ansi: bool = False):
+    def __init__(self, partitioning, child: TpuExec, ansi: bool = False,
+                 conf=None):
         super().__init__([child])
         self.partitioning = partitioning
         self.ansi = ansi
+        self.conf = conf
 
     @property
     def output(self):
@@ -121,22 +123,31 @@ class TpuShuffleExchangeExec(TpuExec):
         return self._range_jit(tuple(batch.columns), jnp.int32(batch.num_rows))
 
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
-        """In-process shuffle: produce per-partition coalesced batches in
-        partition order (partition boundaries matter to downstream
-        per-partition operators once multi-chip execution is wired)."""
-        parts: List[List[ColumnarBatch]] = [
-            [] for _ in range(self.num_partitions)]
-        with self.metric("shuffleWriteTime").timed():
-            for b in self.children[0].execute_columnar():
-                for pid, pb in enumerate(self.partition_batch(b)):
-                    if pb.num_rows > 0:
-                        parts[pid].append(pb)
-        for pid in range(self.num_partitions):
-            if parts[pid]:
-                with self.metric("concatTime").timed():
-                    out = (parts[pid][0] if len(parts[pid]) == 1
-                           else ColumnarBatch.concat(parts[pid]))
-                yield self._count_output(out)
+        """Shuffle through the manager: each input batch is a "map task"
+        whose partition slices are written (serialized in MULTITHREADED
+        mode — the Kudo wire-format path), then each reduce partition is
+        assembled by the concat-friendly reader.
+
+        Partition boundaries are preserved in output order so downstream
+        per-partition operators see real reduce partitions."""
+        from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
+
+        mgr = get_shuffle_manager(self.conf)
+        shuffle_id = mgr.register_shuffle()
+        try:
+            with self.metric("shuffleWriteTime").timed():
+                for map_id, b in enumerate(
+                        self.children[0].execute_columnar()):
+                    mgr.write_map_output(shuffle_id, map_id,
+                                         self.partition_batch(b))
+            schema = self.output
+            for pid in range(self.num_partitions):
+                with self.metric("shuffleReadTime").timed():
+                    out = mgr.read_partition(shuffle_id, pid, schema)
+                if out is not None and out.num_rows > 0:
+                    yield self._count_output(out)
+        finally:
+            mgr.unregister_shuffle(shuffle_id)
 
 
 class TpuBroadcastExchangeExec(TpuExec):

@@ -327,7 +327,7 @@ def _convert_node(meta: SparkPlanMeta, tpu_children, ansi: bool):
                                plan.frame, ansi)
     if isinstance(plan, PN.Exchange):
         return X.TpuShuffleExchangeExec(plan.partitioning, tpu_children[0],
-                                        ansi)
+                                        ansi, conf=meta.conf)
     if isinstance(plan, PN.BroadcastExchange):
         return TpuBroadcastExchangeExec(tpu_children[0])
     if isinstance(plan, PN.GlobalLimit):

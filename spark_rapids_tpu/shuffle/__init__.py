@@ -1,0 +1,29 @@
+"""Shuffle subsystem (SURVEY.md §2.7).
+
+Reference analogs: RapidsShuffleInternalManagerBase (the shuffle manager
+shell with MULTITHREADED / UCX / CACHE_ONLY modes), GpuColumnarBatchSerializer
++ the Kudo concat-friendly serialization format, ShuffleBufferCatalog, and
+GpuShuffleEnv.
+
+TPU mapping:
+  * MULTITHREADED — batches are serialized host-side in the concat-friendly
+    wire format (serializer.py, the Kudo analog) by a writer thread pool and
+    reassembled by the reader with one cheap multi-block concat.  This is
+    the mode that works everywhere, like the reference's default.
+  * ICI — device-resident all-to-all over the TPU interconnect via XLA
+    collectives (parallel/mesh.py) — the UCX-transport replacement: no
+    peer-to-peer pull, the pod slice is the network.
+  * CACHE_ONLY — batches stay device-resident in the block store (useful for
+    single-process pipelines and tests).
+"""
+from spark_rapids_tpu.shuffle.manager import (
+    TpuShuffleManager,
+    get_shuffle_manager,
+)
+from spark_rapids_tpu.shuffle.serializer import (
+    deserialize_concat,
+    serialize_batch,
+)
+
+__all__ = ["TpuShuffleManager", "get_shuffle_manager", "serialize_batch",
+           "deserialize_concat"]

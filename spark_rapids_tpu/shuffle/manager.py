@@ -1,0 +1,199 @@
+"""TpuShuffleManager — the shuffle manager shell.
+
+Reference analog: RapidsShuffleInternalManagerBase / GpuShuffleEnv /
+ShuffleBufferCatalog (SURVEY.md §2.7): per-shuffle registration, a writer
+that serializes partition slices (thread pool in MULTITHREADED mode), a
+block store mapping (shuffle, map, partition) -> block, and a reader that
+fetches a partition's blocks and assembles them into batches.
+
+TPU adaptation: blocks live in a host block store (the netty shuffle file
+analog — memory-backed, overflowing to the spill dir); CACHE_ONLY keeps
+device batches resident (no serialization); ICI mode is the mesh all-to-all
+(parallel/mesh.py) used when executing over a device mesh.
+"""
+from __future__ import annotations
+
+import concurrent.futures as cf
+import itertools
+import os
+import tempfile
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.config import (
+    SHUFFLE_COMPRESSION_CODEC,
+    SHUFFLE_MODE,
+    SHUFFLE_MT_WRITER_THREADS,
+    SPILL_DIR,
+    TpuConf,
+    conf,
+)
+from spark_rapids_tpu.shuffle.serializer import (
+    deserialize_concat,
+    serialize_batch,
+)
+
+SHUFFLE_HOST_STORE_LIMIT = conf(
+    "spark.rapids.shuffle.hostStoreSize").doc(
+    "Host memory for shuffle blocks before they overflow to disk files "
+    "(the netty shuffle-file analog).").bytes_conf(1 << 31)
+
+
+class _BlockStore:
+    """Host block store with disk overflow (ShuffleBufferCatalog analog)."""
+
+    def __init__(self, limit: int, spill_dir: Optional[str]):
+        self._blocks: Dict[Tuple[int, int, int], bytes] = {}
+        self._files: Dict[Tuple[int, int, int], str] = {}
+        self._bytes = 0
+        self.limit = limit
+        self.spill_dir = spill_dir
+        self._lock = threading.Lock()
+
+    def put(self, key: Tuple[int, int, int], blob: bytes) -> None:
+        with self._lock:
+            if self._bytes + len(blob) > self.limit:
+                if self.spill_dir is None:
+                    self.spill_dir = tempfile.mkdtemp(prefix="srt_shuffle_")
+                os.makedirs(self.spill_dir, exist_ok=True)
+                path = os.path.join(
+                    self.spill_dir,
+                    f"shuffle_{key[0]}_{key[1]}_{key[2]}.blk")
+                with open(path, "wb") as f:
+                    f.write(blob)
+                self._files[key] = path
+            else:
+                self._blocks[key] = blob
+                self._bytes += len(blob)
+
+    def get(self, key: Tuple[int, int, int]) -> Optional[bytes]:
+        with self._lock:
+            if key in self._blocks:
+                return self._blocks[key]
+            path = self._files.get(key)
+        if path is not None:
+            with open(path, "rb") as f:
+                return f.read()
+        return None
+
+    def keys_for_partition(self, shuffle_id: int,
+                           pid: int) -> List[Tuple[int, int, int]]:
+        with self._lock:
+            ks = [k for k in itertools.chain(self._blocks, self._files)
+                  if k[0] == shuffle_id and k[2] == pid]
+        return sorted(ks)
+
+    def remove_shuffle(self, shuffle_id: int) -> None:
+        with self._lock:
+            for k in [k for k in self._blocks if k[0] == shuffle_id]:
+                self._bytes -= len(self._blocks.pop(k))
+            for k in [k for k in self._files if k[0] == shuffle_id]:
+                try:
+                    os.unlink(self._files.pop(k))
+                except OSError:
+                    pass
+
+
+class TpuShuffleManager:
+    def __init__(self, tpu_conf: TpuConf):
+        self.mode = tpu_conf.get(SHUFFLE_MODE).upper()
+        self.codec = tpu_conf.get(SHUFFLE_COMPRESSION_CODEC)
+        self.writer_threads = tpu_conf.get(SHUFFLE_MT_WRITER_THREADS)
+        self.store = _BlockStore(tpu_conf.get(SHUFFLE_HOST_STORE_LIMIT),
+                                 tpu_conf.get(SPILL_DIR))
+        self._device_store: Dict[Tuple[int, int, int], ColumnarBatch] = {}
+        self._next_shuffle = itertools.count()
+        self._pool: Optional[cf.ThreadPoolExecutor] = None
+        self._lock = threading.Lock()
+        # metrics
+        self.bytes_written = 0
+        self.blocks_written = 0
+
+    def _get_pool(self) -> cf.ThreadPoolExecutor:
+        with self._lock:
+            if self._pool is None:
+                self._pool = cf.ThreadPoolExecutor(
+                    max_workers=self.writer_threads,
+                    thread_name_prefix="shuffle-writer")
+            return self._pool
+
+    def register_shuffle(self) -> int:
+        return next(self._next_shuffle)
+
+    # -- write side ------------------------------------------------------
+    def write_map_output(self, shuffle_id: int, map_id: int,
+                         slices: List[ColumnarBatch]) -> None:
+        """Write one map task's partition slices (pid = index)."""
+        if self.mode == "CACHE_ONLY":
+            for pid, b in enumerate(slices):
+                if b is not None and b.num_rows > 0:
+                    self._device_store[(shuffle_id, map_id, pid)] = b
+            return
+        # MULTITHREADED: serialize each non-empty slice on the pool
+        pool = self._get_pool()
+
+        def job(pid: int, batch: ColumnarBatch):
+            blob = serialize_batch(batch, codec=self.codec)
+            self.store.put((shuffle_id, map_id, pid), blob)
+            return len(blob)
+
+        futures = [pool.submit(job, pid, b) for pid, b in enumerate(slices)
+                   if b is not None and b.num_rows > 0]
+        for f in futures:
+            n = f.result()
+            self.bytes_written += n
+            self.blocks_written += 1
+
+    # -- read side -------------------------------------------------------
+    def read_partition(self, shuffle_id: int, pid: int,
+                       schema: T.StructType) -> Optional[ColumnarBatch]:
+        """Assemble one reduce partition from all map outputs."""
+        if self.mode == "CACHE_ONLY":
+            batches = [b for k, b in sorted(self._device_store.items())
+                       if k[0] == shuffle_id and k[2] == pid]
+            if not batches:
+                return None
+            return (batches[0] if len(batches) == 1
+                    else ColumnarBatch.concat(batches))
+        keys = self.store.keys_for_partition(shuffle_id, pid)
+        blocks = [self.store.get(k) for k in keys]
+        blocks = [b for b in blocks if b is not None]
+        if not blocks:
+            return None
+        return deserialize_concat(blocks, schema, codec=self.codec)
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        self.store.remove_shuffle(shuffle_id)
+        for k in [k for k in self._device_store if k[0] == shuffle_id]:
+            del self._device_store[k]
+
+
+_lock = threading.Lock()
+_manager: Optional[TpuShuffleManager] = None
+_manager_key = None
+
+
+def get_shuffle_manager(tpu_conf: Optional[TpuConf] = None) -> TpuShuffleManager:
+    """GpuShuffleEnv analog: process-wide manager, rebuilt when the shuffle
+    configs change."""
+    global _manager, _manager_key
+    with _lock:
+        if tpu_conf is None:
+            if _manager is None:
+                _manager = TpuShuffleManager(TpuConf())
+            return _manager
+        key = (tpu_conf.get(SHUFFLE_MODE), tpu_conf.get(SHUFFLE_COMPRESSION_CODEC),
+               tpu_conf.get(SHUFFLE_MT_WRITER_THREADS))
+        if _manager is None or key != _manager_key:
+            _manager = TpuShuffleManager(tpu_conf)
+            _manager_key = key
+        return _manager
+
+
+def reset_shuffle_manager() -> None:
+    global _manager, _manager_key
+    with _lock:
+        _manager = None
+        _manager_key = None

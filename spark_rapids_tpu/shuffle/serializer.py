@@ -1,0 +1,180 @@
+"""Concat-friendly columnar wire format — the Kudo serializer analog.
+
+Reference analog: spark-rapids-jni KudoSerializer + GpuColumnarBatchSerializer
+(SURVEY.md §2.7): the shuffle write path serializes device batches into a
+layout whose whole point is that the *reader* can assemble many partition
+blocks into one batch cheaply (one pass, no per-row work), because a shuffle
+read concatenates hundreds of small map-side slices.
+
+Layout (little-endian):
+
+    magic  b"TKU1"
+    u32    header_len
+    bytes  header (msgpack-less: utf-8 JSON {num_rows, cols:[...]})
+    buffers back to back, 8-byte aligned, in header order
+
+Per column the header records kind (flat/string), the numpy dtype string,
+string width, and each buffer's (offset, length).  Validity is bit-packed
+(1 bit/row — this is wire format, where bytes are precious; in HBM validity
+is a bool vector, see columnar/column.py).  Padding rows are dropped at
+serialize time and re-created at deserialize time, so shuffle bytes scale
+with logical rows, not capacity buckets.
+
+The optional codec (zstd/zlib) compresses the whole frame; `lz4` (the
+reference's default) aliases to zstd since this image has no lz4 binding.
+
+deserialize_concat() is the Kudo trick: allocates each output column once
+across all blocks and fills sequentially — O(total bytes) regardless of how
+many blocks the read assembles.
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import (
+    DEFAULT_ROW_BUCKETS,
+    DeviceColumn,
+    round_up_bucket,
+)
+
+MAGIC = b"TKU1"
+
+
+def _codec_pair(codec: Optional[str]):
+    c = (codec or "none").lower()
+    if c in ("none", "uncompressed"):
+        return (lambda b: b), (lambda b: b)
+    if c in ("zstd", "lz4"):  # lz4 aliases to zstd (no lz4 binding in image)
+        import zstandard
+
+        cctx = zstandard.ZstdCompressor(level=1)
+        dctx = zstandard.ZstdDecompressor()
+        return cctx.compress, dctx.decompress
+    if c == "zlib":
+        import zlib
+
+        return (lambda b: zlib.compress(b, 1)), zlib.decompress
+    raise ValueError(f"unknown shuffle codec {codec}")
+
+
+def _align8(n: int) -> int:
+    return (n + 7) & ~7
+
+
+def serialize_batch(batch: ColumnarBatch, codec: Optional[str] = None) -> bytes:
+    """Device batch -> wire bytes (host).  Drops capacity padding."""
+    import jax
+
+    n = batch.num_rows
+    header_cols = []
+    buffers: List[bytes] = []
+    offset = 0
+
+    def add_buffer(raw: bytes) -> Tuple[int, int]:
+        nonlocal offset
+        off = offset
+        buffers.append(raw)
+        pad = _align8(len(raw)) - len(raw)
+        if pad:
+            buffers.append(b"\0" * pad)
+        offset += _align8(len(raw))
+        return off, len(raw)
+
+    # one host sync for the whole batch
+    host_cols = jax.device_get(
+        [(c.validity, c.data, c.chars, c.lengths) for c in batch.columns])
+    for c, (validity, data, chars, lengths) in zip(batch.columns, host_cols):
+        validity = np.asarray(validity)[:n]
+        vbuf = add_buffer(np.packbits(validity, bitorder="little").tobytes())
+        if c.is_string:
+            lengths = np.asarray(lengths)[:n]
+            width = int(lengths.max()) if n else 0
+            chars = np.ascontiguousarray(np.asarray(chars)[:n, :width])
+            lbuf = add_buffer(lengths.astype(np.int32).tobytes())
+            cbuf = add_buffer(chars.tobytes())
+            header_cols.append({
+                "kind": "string", "width": width,
+                "validity": vbuf, "lengths": lbuf, "chars": cbuf})
+        else:
+            data = np.ascontiguousarray(np.asarray(data)[:n])
+            dbuf = add_buffer(data.tobytes())
+            header_cols.append({
+                "kind": "flat", "dtype": data.dtype.str,
+                "validity": vbuf, "data": dbuf})
+    header = json.dumps({"num_rows": n, "cols": header_cols}).encode()
+    frame = b"".join([MAGIC, struct.pack("<I", len(header)), header]
+                     + buffers)
+    comp, _ = _codec_pair(codec)
+    return comp(frame)
+
+
+def _parse(frame: bytes):
+    if frame[:4] != MAGIC:
+        raise ValueError("bad shuffle frame magic")
+    (hlen,) = struct.unpack_from("<I", frame, 4)
+    header = json.loads(frame[8: 8 + hlen].decode())
+    body = frame[8 + hlen:]
+    return header, body
+
+
+def deserialize_concat(blocks: Sequence[bytes], schema: T.StructType,
+                       codec: Optional[str] = None,
+                       row_buckets=DEFAULT_ROW_BUCKETS) -> ColumnarBatch:
+    """Assemble many wire blocks into ONE padded device batch.
+
+    The concat-friendly read: per column one output allocation, blocks
+    copied in sequentially, a single host->device upload at the end."""
+    import jax.numpy as jnp
+
+    _, decomp = _codec_pair(codec)
+    parsed = [_parse(decomp(b)) for b in blocks]
+    total = sum(h["num_rows"] for h, _ in parsed)
+    cap = round_up_bucket(max(total, 1), row_buckets)
+    out_cols: List[DeviceColumn] = []
+    for ci, f in enumerate(schema.fields):
+        validity = np.zeros(cap, dtype=np.bool_)
+        is_string = isinstance(f.dataType, T.StringType)
+        if is_string:
+            width = max([h["cols"][ci]["width"] for h, _ in parsed] + [1])
+            chars = np.zeros((cap, width), dtype=np.uint8)
+            lengths = np.zeros(cap, dtype=np.int32)
+        else:
+            sdt = np.dtype(T.storage_dtype(f.dataType))
+            data = np.zeros(cap, dtype=sdt)
+        row = 0
+        for h, body in parsed:
+            n = h["num_rows"]
+            col = h["cols"][ci]
+            voff, vlen = col["validity"]
+            vbits = np.frombuffer(body, np.uint8, count=vlen, offset=voff)
+            validity[row: row + n] = np.unpackbits(
+                vbits, count=n, bitorder="little").astype(np.bool_)
+            if is_string:
+                loff, llen = col["lengths"]
+                lengths[row: row + n] = np.frombuffer(
+                    body, np.int32, count=n, offset=loff)
+                w = col["width"]
+                if w:
+                    coff, clen = col["chars"]
+                    chars[row: row + n, :w] = np.frombuffer(
+                        body, np.uint8, count=n * w, offset=coff
+                    ).reshape(n, w)
+            else:
+                doff, dlen = col["data"]
+                data[row: row + n] = np.frombuffer(
+                    body, np.dtype(col["dtype"]), count=n, offset=doff)
+            row += n
+        if is_string:
+            out_cols.append(DeviceColumn(
+                f.dataType, jnp.asarray(validity),
+                chars=jnp.asarray(chars), lengths=jnp.asarray(lengths)))
+        else:
+            out_cols.append(DeviceColumn(
+                f.dataType, jnp.asarray(validity), data=jnp.asarray(data)))
+    return ColumnarBatch(out_cols, total, schema)

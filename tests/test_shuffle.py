@@ -1,0 +1,139 @@
+"""Shuffle subsystem tests (reference analog: RapidsShuffleClientSuite /
+GpuColumnarBatchSerializer tests — in-process, no real network, SURVEY §4)."""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.session import col, sum_
+from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+from spark_rapids_tpu.shuffle.serializer import (
+    deserialize_concat,
+    serialize_batch,
+)
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import (
+    DecimalGen,
+    DoubleGen,
+    IntegerGen,
+    StringGen,
+    gen_df,
+)
+
+_schema = T.StructType([
+    T.StructField("i", T.INT),
+    T.StructField("d", T.DOUBLE),
+    T.StructField("s", T.STRING),
+    T.StructField("dec", T.DecimalType(9, 2)),
+    T.StructField("b", T.BOOLEAN),
+])
+
+
+def _mixed_batch(n=100, offset=0):
+    from decimal import Decimal
+
+    data = {
+        "i": [None if i % 7 == 0 else i + offset for i in range(n)],
+        "d": [float(i) * 1.5 - offset for i in range(n)],
+        "s": [None if i % 5 == 0 else ("x" * (i % 13)) + str(i)
+              for i in range(n)],
+        "dec": [Decimal(i * 10 + offset).scaleb(-2) for i in range(n)],
+        "b": [i % 3 == 0 for i in range(n)],
+    }
+    return ColumnarBatch.from_pydict(data, _schema)
+
+
+@pytest.mark.parametrize("codec", ["none", "zstd", "zlib", "lz4"])
+def test_serializer_roundtrip(codec):
+    b = _mixed_batch(100)
+    blob = serialize_batch(b, codec=codec)
+    out = deserialize_concat([blob], _schema, codec=codec)
+    assert out.to_rows() == b.to_rows()
+
+
+def test_serializer_concat_many_blocks():
+    batches = [_mixed_batch(37, offset=i * 100) for i in range(5)]
+    blobs = [serialize_batch(b, codec="zstd") for b in batches]
+    out = deserialize_concat(blobs, _schema, codec="zstd")
+    expected = [r for b in batches for r in b.to_rows()]
+    assert out.num_rows == 5 * 37
+    assert out.to_rows() == expected
+
+
+def test_serializer_empty_strings_and_zero_width():
+    schema = T.StructType([T.StructField("s", T.STRING)])
+    b = ColumnarBatch.from_pydict({"s": ["", "", None, ""]}, schema)
+    blob = serialize_batch(b)
+    out = deserialize_concat([blob], schema)
+    assert out.to_rows() == [("",), ("",), (None,), ("",)]
+
+
+def test_manager_write_read_partitions():
+    mgr = TpuShuffleManager(TpuConf({}))
+    sid = mgr.register_shuffle()
+    # two map tasks, three partitions
+    mgr.write_map_output(sid, 0, [_mixed_batch(10), _mixed_batch(5, 50), None])
+    mgr.write_map_output(sid, 1, [None, _mixed_batch(7, 90), None])
+    p0 = mgr.read_partition(sid, 0, _schema)
+    p1 = mgr.read_partition(sid, 1, _schema)
+    p2 = mgr.read_partition(sid, 2, _schema)
+    assert p0.num_rows == 10
+    assert p1.num_rows == 12     # 5 + 7, map order preserved
+    assert p2 is None
+    assert mgr.bytes_written > 0 and mgr.blocks_written == 3
+    mgr.unregister_shuffle(sid)
+    assert mgr.read_partition(sid, 0, _schema) is None
+
+
+def test_manager_disk_overflow(tmp_path):
+    c = TpuConf({"spark.rapids.shuffle.hostStoreSize": "128",
+                 "spark.rapids.memory.spillDir": str(tmp_path)})
+    mgr = TpuShuffleManager(c)
+    sid = mgr.register_shuffle()
+    mgr.write_map_output(sid, 0, [_mixed_batch(200)])
+    assert mgr.store._files, "expected overflow to disk files"
+    out = mgr.read_partition(sid, 0, _schema)
+    assert out.num_rows == 200
+
+
+_modes = ["MULTITHREADED", "CACHE_ONLY"]
+
+
+@pytest.mark.parametrize("mode", _modes)
+def test_exchange_modes_differential(mode):
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=30),
+                        DoubleGen(), StringGen(max_len=6),
+                        DecimalGen(9, 2)],
+                    ["k", "v", "sv", "dv"], length=500)
+        return df.group_by("k").agg(sum_("v", "s"),
+                                    ("max", "sv", "mx"),
+                                    ("min", "dv", "mn"))
+
+    assert_tpu_and_cpu_are_equal_collect(
+        build, conf={"spark.rapids.shuffle.mode": mode},
+        approximate_float=True)
+
+
+@pytest.mark.parametrize("codec", ["none", "zstd", "zlib"])
+def test_exchange_codecs_differential(codec):
+    def build(s):
+        left = gen_df(s, [IntegerGen(min_val=0, max_val=15),
+                          StringGen(max_len=8)], ["k", "lv"], length=200,
+                      seed=3)
+        right = gen_df(s, [IntegerGen(min_val=0, max_val=15),
+                           DoubleGen()], ["k", "rv"], length=150, seed=4)
+        right = right.select(col("k").alias("rk"), col("rv"))
+        from spark_rapids_tpu.plan import nodes as PN
+        from spark_rapids_tpu.session import DataFrame
+
+        lk = [col("k").resolve(left.schema)]
+        rk = [col("rk").resolve(right.schema)]
+        node = PN.SortMergeJoin(left.plan, right.plan, lk, rk,
+                                PN.JoinType.INNER)
+        return DataFrame(node, s)
+
+    assert_tpu_and_cpu_are_equal_collect(
+        build, conf={"spark.rapids.shuffle.compression.codec": codec},
+        approximate_float=True)
