@@ -303,6 +303,9 @@ class HostColumn:
             lo = raw[0::2][arr2.offset: arr2.offset + n]
             np_arr = np.where(validity, lo, 0)
         else:
+            if isinstance(dtype, T.TimestampType) and pa.types.is_timestamp(
+                    arr.type) and arr.type.unit != "us":
+                arr = arr.cast(pa.timestamp("us", tz=arr.type.tz))
             np_arr = np.asarray(arr.fill_null(0)).astype(sdt, copy=False)
         return HostColumn(dtype, validity, data=np_arr)
 

@@ -1024,6 +1024,11 @@ def execute_cpu_plan(plan: PN.SparkPlan, ansi: bool = False) -> Tuple[CpuBatch, 
         return out, sum(p[1] for p in parts)
     if isinstance(plan, (PN.Exchange, PN.BroadcastExchange)):
         return execute_cpu_plan(plan.children[0], ansi)
+    if isinstance(plan, PN.InsertIntoHadoopFsRelation):
+        from spark_rapids_tpu.io.writer import cpu_write
+
+        cpu_write(plan, ansi)
+        return [], 0
     raise NotImplementedError(f"oracle plan node {name}")
 
 
@@ -1031,12 +1036,29 @@ def _cpu_file_scan(plan: PN.FileSourceScan):
     import pyarrow.parquet as pq
     import pyarrow.csv as pacsv
 
+    import os
+
     tables = []
     for p in plan.paths:
-        if plan.fmt == "parquet":
+        if os.path.isdir(p):
+            import pyarrow.dataset as ds
+
+            tables.append(ds.dataset(
+                p, format=plan.fmt, partitioning="hive",
+                exclude_invalid_files=True).to_table(
+                columns=[f.name for f in plan.output.fields]))
+        elif plan.fmt == "parquet":
             tables.append(pq.read_table(p))
+        elif plan.fmt == "orc":
+            import pyarrow.orc as paorc
+
+            tables.append(paorc.ORCFile(p).read())
         elif plan.fmt == "csv":
             tables.append(pacsv.read_csv(p))
+        elif plan.fmt == "json":
+            import pyarrow.json as pajson
+
+            tables.append(pajson.read_json(p))
         else:
             raise NotImplementedError(plan.fmt)
     import pyarrow as pa

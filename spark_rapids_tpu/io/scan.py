@@ -89,14 +89,31 @@ class TpuFileSourceScanExec(TpuExec):
     def _read_file_host(self, path: str):
         import pyarrow as pa
 
+        import os
+
         with self.metric("bufferTime").timed():
-            if self.plan.fmt == "parquet":
+            if os.path.isdir(path):
+                # hive-partitioned directory: dataset read (partition
+                # columns materialize from the directory names)
+                import pyarrow.dataset as ds
+
+                dset = ds.dataset(path, format=self.plan.fmt,
+                                  partitioning="hive",
+                                  exclude_invalid_files=True)
+                tbl = dset.to_table(
+                    columns=[f.name for f in self.plan.output.fields])
+            elif self.plan.fmt == "parquet":
                 import pyarrow.parquet as pq
 
                 cols = [f.name for f in self.plan.output.fields]
                 tbl = pq.read_table(
                     path, columns=cols,
                     filters=_filters_to_arrow(self.plan.pushed_filters))
+            elif self.plan.fmt == "orc":
+                import pyarrow.orc as paorc
+
+                tbl = paorc.ORCFile(path).read(
+                    columns=[f.name for f in self.plan.output.fields])
             elif self.plan.fmt == "csv":
                 import pyarrow.csv as pacsv
 

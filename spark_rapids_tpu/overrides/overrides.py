@@ -207,12 +207,26 @@ def _scan_check(meta: SparkPlanMeta):
     fmt = plan.fmt
     key = {"parquet": "spark.rapids.sql.format.parquet.read.enabled",
            "csv": "spark.rapids.sql.format.csv.read.enabled",
-           "json": "spark.rapids.sql.format.json.read.enabled"}.get(fmt)
+           "json": "spark.rapids.sql.format.json.read.enabled",
+           "orc": "spark.rapids.sql.format.orc.read.enabled"}.get(fmt)
     if key is None:
         meta.will_not_work_on_tpu(f"format {fmt} is not supported on TPU")
         return
     if str(meta.conf.settings.get(key, "true")).lower() == "false":
         meta.will_not_work_on_tpu(f"{fmt} reads disabled by {key}=false")
+
+
+def _write_check(meta: SparkPlanMeta):
+    """dataWriteCmds tagging (GpuOverrides.dataWriteCmds analog)."""
+    plan = meta.plan
+    if plan.fmt not in ("parquet", "orc", "csv", "json"):
+        meta.will_not_work_on_tpu(
+            f"write format {plan.fmt} is not supported on TPU")
+        return
+    key = f"spark.rapids.sql.format.{plan.fmt}.write.enabled"
+    if str(meta.conf.settings.get(key, "true")).lower() == "false":
+        meta.will_not_work_on_tpu(
+            f"{plan.fmt} writes disabled by {key}=false")
 
 
 def _exprs_of(plan) -> List[E.Expression]:
@@ -251,6 +265,8 @@ def _exec(cls, sig=_COMMON128, tag_exprs=_exprs_of, extra=None, desc=""):
 
 _exec(PN.LocalTableScan)
 _exec(PN.FileSourceScan, extra=_scan_check)
+_exec(PN.InsertIntoHadoopFsRelation, extra=_write_check,
+      desc="GpuDataWritingCommandExec analog")
 _exec(PN.RangeNode)
 _exec(PN.Project)
 _exec(PN.Filter)
@@ -336,6 +352,12 @@ def _convert_node(meta: SparkPlanMeta, tpu_children, ansi: bool):
         return X.TpuLocalLimitExec(plan.n, tpu_children[0])
     if isinstance(plan, PN.Union):
         return X.TpuUnionExec(tpu_children)
+    if isinstance(plan, PN.InsertIntoHadoopFsRelation):
+        from spark_rapids_tpu.io.writer import TpuDataWritingCommandExec
+
+        return TpuDataWritingCommandExec(
+            plan.fmt, plan.path, plan.partition_cols, tpu_children[0],
+            meta.conf, plan.mode)
     raise NotImplementedError(f"convert {meta.name}")
 
 

@@ -451,3 +451,29 @@ class Union(SparkPlan):
 
     def describe(self):
         return f"Union ({len(self.children)} children)"
+
+
+class InsertIntoHadoopFsRelation(SparkPlan):
+    """Write command (DataWritingCommand analog).
+
+    Reference analog: InsertIntoHadoopFsRelationCommand wrapped by
+    GpuDataWritingCommandExec via the dataWriteCmds registry
+    (SURVEY.md §2.2 GpuOverrides.dataWriteCmds, §2.6 Writers)."""
+
+    def __init__(self, fmt: str, path: str, child: SparkPlan,
+                 partition_cols=None, mode: str = "overwrite",
+                 options=None):
+        super().__init__([child])
+        self.fmt = fmt
+        self.path = path
+        self.partition_cols = list(partition_cols or [])
+        self.mode = mode
+        self.options = dict(options or {})
+
+    @property
+    def output(self):
+        return T.StructType([])
+
+    def describe(self):
+        p = f" partitionBy={self.partition_cols}" if self.partition_cols else ""
+        return f"InsertIntoHadoopFsRelation {self.fmt} {self.path}{p}"

@@ -109,12 +109,26 @@ class DataFrameReader:
         return self
 
     def _infer_schema(self, fmt: str, paths: List[str]) -> T.StructType:
+        import os
+
         import pyarrow as pa
 
-        if fmt == "parquet":
+        if os.path.isdir(paths[0]):
+            # hive-partitioned directory written by df.write.partitionBy
+            import pyarrow.dataset as ds
+
+            dset = ds.dataset(paths[0], format=fmt,
+                              partitioning="hive",
+                              exclude_invalid_files=True)
+            arrow_schema = dset.schema
+        elif fmt == "parquet":
             import pyarrow.parquet as pq
 
             arrow_schema = pq.read_schema(paths[0])
+        elif fmt == "orc":
+            import pyarrow.orc as paorc
+
+            arrow_schema = paorc.ORCFile(paths[0]).schema
         elif fmt == "csv":
             import pyarrow.csv as pacsv
 
@@ -139,6 +153,12 @@ class DataFrameReader:
         schema = self._schema or self._infer_schema("csv", list(paths))
         return DataFrame(
             PN.FileSourceScan("csv", list(paths), schema,
+                              options=self._options), self.session)
+
+    def orc(self, *paths: str) -> "DataFrame":
+        schema = self._schema or self._infer_schema("orc", list(paths))
+        return DataFrame(
+            PN.FileSourceScan("orc", list(paths), schema,
                               options=self._options), self.session)
 
     def json(self, *paths: str) -> "DataFrame":
@@ -351,6 +371,10 @@ class DataFrame:
         rows = self.agg(("count_star", None, "count")).collect()
         return int(rows[0][0]) if rows else 0
 
+    @property
+    def write(self) -> "DataFrameWriter":
+        return DataFrameWriter(self)
+
     def explain(self, mode: str = "formatted") -> str:
         from spark_rapids_tpu.exec.base import TpuExec
 
@@ -361,6 +385,50 @@ class DataFrame:
             if fb:
                 s += "\nFallback reasons:\n" + fb
         return s
+
+
+class DataFrameWriter:
+    """df.write API (DataFrameWriter analog); executes the write command
+    through the plan rewrite so GPU-vs-CPU write placement follows the same
+    tagging rules as reads."""
+
+    def __init__(self, df: DataFrame):
+        self.df = df
+        self._mode = "overwrite"
+        self._partition_by: List[str] = []
+        self._options: Dict[str, str] = {}
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        self._mode = m
+        return self
+
+    def partition_by(self, *cols: str) -> "DataFrameWriter":
+        self._partition_by = list(cols)
+        return self
+
+    partitionBy = partition_by
+
+    def option(self, k, v) -> "DataFrameWriter":
+        self._options[k] = v
+        return self
+
+    def _run(self, fmt: str, path: str) -> None:
+        node = PN.InsertIntoHadoopFsRelation(
+            fmt, path, self.df.plan, self._partition_by, self._mode,
+            self._options)
+        DataFrame(node, self.df.session).collect()
+
+    def parquet(self, path: str) -> None:
+        self._run("parquet", path)
+
+    def orc(self, path: str) -> None:
+        self._run("orc", path)
+
+    def csv(self, path: str) -> None:
+        self._run("csv", path)
+
+    def json(self, path: str) -> None:
+        self._run("json", path)
 
 
 def _is_broadcastable(plan: PN.SparkPlan) -> bool:

@@ -158,12 +158,21 @@ class DateGen(DataGen):
 
 
 class TimestampGen(DataGen):
-    def __init__(self, nullable=True):
+    def __init__(self, nullable=True, min_us=None, max_us=None):
         super().__init__(T.TIMESTAMP, nullable)
+        self.min_us = (min_us if min_us is not None
+                       else -30610224000 * 1_000_000 // 1000)
+        self.max_us = max_us if max_us is not None else 4102444800 * 1_000_000
+
+    @staticmethod
+    def ns_safe(nullable=True):
+        """Range representable as int64 nanoseconds (1677-2262) — what ORC
+        and parquet-ns can round-trip."""
+        return TimestampGen(nullable, min_us=-9_223_372_036_854_000,
+                            max_us=9_223_372_036_854_000)
 
     def gen_value(self, rng):
-        us = rng.randint(-30610224000 * 1_000_000 // 1000,
-                         4102444800 * 1_000_000)
+        us = rng.randint(self.min_us, self.max_us)
         return (datetime.datetime(1970, 1, 1,
                                   tzinfo=datetime.timezone.utc)
                 + datetime.timedelta(microseconds=us))
