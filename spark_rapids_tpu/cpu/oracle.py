@@ -1097,6 +1097,10 @@ def _cpu_aggregate(plan: PN.HashAggregate, ansi: bool):
             if a.func == "avg":
                 acols.append((cols[child_names.index(a.result_name + "_sum")],
                               cols[child_names.index(a.result_name + "_count")]))
+            elif a.func in PN.VARIANCE_FUNCS:
+                acols.append(tuple(
+                    cols[child_names.index(a.result_name + s)]
+                    for s in ("_n", "_avg", "_m2")))
             else:
                 nm = a.result_name
                 acols.append(cols[child_names.index(nm)])
@@ -1160,6 +1164,9 @@ def _partial_field_groups(plan: PN.HashAggregate):
         if a.func == "avg":
             yield (fields[i], fields[i + 1])
             i += 2
+        elif a.func in PN.VARIANCE_FUNCS:
+            yield (fields[i], fields[i + 1], fields[i + 2])
+            i += 3
         else:
             yield (fields[i],)
             i += 1
@@ -1189,6 +1196,29 @@ def _agg_partial(a: PN.AggregateExpression, ac: Optional[CpuCol],
         yield CpuCol(sum_f.dataType, svals, valid)
         yield CpuCol(cnt_f.dataType, np.array(cnts, np.int64),
                      np.ones(ng, np.bool_))
+        return
+    if a.func in PN.VARIANCE_FUNCS:
+        fn_, fa, fm = fields
+        scale = (10.0 ** -a.child.dataType.scale
+                 if isinstance(a.child.dataType, T.DecimalType) else 1.0)
+        ns, avgs, m2s = [], [], []
+        mvalid = np.ones(ng, np.bool_)
+        for gi in range(ng):
+            xs = [float(ac.values[i]) * scale for i in rows_per_group[gi]
+                  if ac.validity[i]]
+            ns.append(float(len(xs)))
+            if not xs:
+                avgs.append(0.0)
+                m2s.append(0.0)
+                mvalid[gi] = False
+            else:
+                m = sum(xs) / len(xs)
+                avgs.append(m)
+                m2s.append(sum((x - m) ** 2 for x in xs))
+        yield CpuCol(fn_.dataType, np.array(ns, np.float64),
+                     np.ones(ng, np.bool_))
+        yield CpuCol(fa.dataType, np.array(avgs, np.float64), mvalid)
+        yield CpuCol(fm.dataType, np.array(m2s, np.float64), mvalid)
         return
     # count/sum/min/max/first/last partials share the final update shape
     vals, valid = _agg_one(a, ac, rows_per_group, False)
@@ -1227,6 +1257,26 @@ def _agg_final(a: PN.AggregateExpression, ac, rows_per_group) -> CpuCol:
         return CpuCol(a.result_type,
                       np.array([v if v is not None else 0 for v in out],
                                np.float64), valid)
+    if a.func in PN.VARIANCE_FUNCS:
+        cn, ca, cm = ac
+        out = np.zeros(ng, np.float64)
+        valid = np.ones(ng, np.bool_)
+        for gi in range(ng):
+            idxs = [i for i in rows_per_group[gi]
+                    if cn.validity[i] and float(cn.values[i]) > 0]
+            ntot = sum(float(cn.values[i]) for i in idxs)
+            if ntot == 0:
+                valid[gi] = False
+                continue
+            mean = sum(float(cn.values[i]) * float(ca.values[i])
+                       for i in idxs) / ntot
+            m2 = sum(float(cm.values[i])
+                     + float(cn.values[i]) * (float(ca.values[i]) - mean) ** 2
+                     for i in idxs)
+            v, ok = _finalize_variance(a.func, ntot, m2)
+            out[gi] = v
+            valid[gi] = ok
+        return CpuCol(a.result_type, out, valid)
     merge_func = {"count": "sum", "count_star": "sum", "sum": "sum",
                   "min": "min", "max": "max", "first": "first",
                   "last": "last"}[a.func]
@@ -1238,6 +1288,16 @@ def _agg_final(a: PN.AggregateExpression, ac, rows_per_group) -> CpuCol:
         vals = np.array([v if valid[i] else 0 for i, v in enumerate(vals)],
                         np.int64)
     return CpuCol(a.result_type, vals, valid)
+
+
+def _finalize_variance(func: str, n: float, m2: float):
+    """-> (value, is_valid).  Spark CentralMomentAgg semantics with the
+    default nullOnDivideByZero (samp of a single row -> NULL)."""
+    den = n if func.endswith("_pop") else n - 1.0
+    if den <= 0:
+        return 0.0, False
+    v = m2 / den
+    return (v if func.startswith("var") else math.sqrt(v)), True
 
 
 def _agg_one(a: PN.AggregateExpression, ac: Optional[CpuCol],
@@ -1294,6 +1354,18 @@ def _agg_one(a: PN.AggregateExpression, ac: Optional[CpuCol],
             out.append(vs[0])
         elif func == "last":
             out.append(vs[-1])
+        elif func in PN.VARIANCE_FUNCS:
+            vscale = (10.0 ** -ac.dtype.scale
+                      if isinstance(ac.dtype, T.DecimalType) else 1.0)
+            xs = [float(v) * vscale for v in vs]
+            m = sum(xs) / len(xs)
+            m2 = sum((x - m) ** 2 for x in xs)
+            v, ok = _finalize_variance(func, float(len(xs)), m2)
+            if ok:
+                out.append(v)
+            else:
+                out.append(None)
+                valid[gi] = False
         else:
             raise NotImplementedError(func)
     if dec or isinstance(a.result_type, T.StringType):

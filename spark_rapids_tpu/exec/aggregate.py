@@ -33,7 +33,11 @@ from spark_rapids_tpu.ops.sortkeys import (
     _column_key_words,
     group_segments,
 )
-from spark_rapids_tpu.plan.nodes import AggregateExpression, AggregateMode
+from spark_rapids_tpu.plan.nodes import (
+    VARIANCE_FUNCS,
+    AggregateExpression,
+    AggregateMode,
+)
 
 
 def _is_float(dt: T.DataType) -> bool:
@@ -204,13 +208,25 @@ class TpuHashAggregateExec(TpuExec):
                                  if key_cols else jnp.int32(1))
 
     def _buffer_widths(self) -> List[int]:
-        return [2 if a.func == "avg" else 1 for a in self.aggregates]
+        return [3 if a.func in VARIANCE_FUNCS else
+                (2 if a.func == "avg" else 1) for a in self.aggregates]
 
     def _eval_merge(self, a, bufs, fields, perm, seg, mask_sorted, cap,
                     group_valid, nseg) -> List[DeviceColumn]:
         """Merge semantics per aggregate: sum->sum, count->sum, min->min,
         max->max, first->first, last->last, avg(sum,count)->(sum,sum)."""
         func = "count" if a.func == "count_star" else a.func
+        if func in VARIANCE_FUNCS:
+            cn, ca, cm = (c if perm is None else _gather_col(c, perm)
+                          for c in bufs)
+            ntot, nz, mean, m2tot = _chan_merge(cn, ca, cm, mask_sorted,
+                                                seg, nseg)
+            fn_, fa, fm = fields
+            return [
+                DeviceColumn(fn_.dataType, group_valid, data=ntot),
+                DeviceColumn(fa.dataType, group_valid & nz, data=mean),
+                DeviceColumn(fm.dataType, group_valid & nz, data=m2tot),
+            ]
         out = []
         for f, c in zip(fields, bufs):
             cs = c if perm is None else _gather_col(c, perm)
@@ -253,21 +269,28 @@ class TpuHashAggregateExec(TpuExec):
         return out
 
     def _global_agg_empty(self) -> ColumnarBatch:
+        """Zero input batches, no grouping keys -> one row of initial agg
+        values, in buffer form for PARTIAL (so multi-wide avg/variance
+        buffers stay aligned with the declared schema)."""
         cols = []
-        for f, a in zip(self._output.fields, self.aggregates):
-            import numpy as np
-
-            if a.func in ("count", "count_star"):
-                cols.append(DeviceColumn(f.dataType, jnp.ones(1, jnp.bool_),
-                                         data=jnp.zeros(1, jnp.int64)))
-            elif isinstance(f.dataType, T.StringType):
-                cols.append(DeviceColumn(f.dataType, jnp.zeros(1, jnp.bool_),
-                                         chars=jnp.zeros((1, 8), jnp.uint8),
-                                         lengths=jnp.zeros(1, jnp.int32)))
-            else:
-                cols.append(DeviceColumn(
-                    f.dataType, jnp.zeros(1, jnp.bool_),
-                    data=jnp.zeros(1, T.storage_dtype(f.dataType))))
+        for a, fields in zip(self.aggregates, self._agg_fields()):
+            for f in fields:
+                zero_valued = (a.func in ("count", "count_star")
+                               or f.name.endswith("_count")
+                               or f.name.endswith("_n"))
+                if zero_valued:
+                    cols.append(DeviceColumn(
+                        f.dataType, jnp.ones(1, jnp.bool_),
+                        data=jnp.zeros(1, T.storage_dtype(f.dataType))))
+                elif isinstance(f.dataType, T.StringType):
+                    cols.append(DeviceColumn(
+                        f.dataType, jnp.zeros(1, jnp.bool_),
+                        chars=jnp.zeros((1, 8), jnp.uint8),
+                        lengths=jnp.zeros(1, jnp.int32)))
+                else:
+                    cols.append(DeviceColumn(
+                        f.dataType, jnp.zeros(1, jnp.bool_),
+                        data=jnp.zeros(1, T.storage_dtype(f.dataType))))
         return ColumnarBatch(cols, 1, self._output)
 
     # ------------------------------------------------------------------
@@ -327,6 +350,10 @@ class TpuHashAggregateExec(TpuExec):
             if a.func == "avg" and self.mode == AggregateMode.PARTIAL:
                 out.append((fields[i], fields[i + 1]))
                 i += 2
+            elif (a.func in VARIANCE_FUNCS
+                  and self.mode == AggregateMode.PARTIAL):
+                out.append((fields[i], fields[i + 1], fields[i + 2]))
+                i += 3
             else:
                 out.append((fields[i],))
                 i += 1
@@ -360,6 +387,9 @@ class TpuHashAggregateExec(TpuExec):
         if func == "count_star":
             func = "count"
         out = []
+        if func in VARIANCE_FUNCS:
+            return self._eval_variance(a, fields, ctx, perm, seg, mask_sorted,
+                                       cap, group_valid, nseg)
         if func == "avg":
             if mode == AggregateMode.PARTIAL:
                 c = self._input_col(a, ctx, perm)
@@ -438,6 +468,47 @@ class TpuHashAggregateExec(TpuExec):
             return out
         raise NotImplementedError(f"aggregate {func}")
 
+    def _eval_variance(self, a, fields, ctx, perm, seg, mask_sorted, cap,
+                       group_valid, nseg) -> List[DeviceColumn]:
+        """Central moments (n, avg, m2).  PARTIAL emits the buffer triple;
+        FINAL Chan-merges child buffers and finalizes; COMPLETE does both.
+        Matches Spark's CentralMomentAgg: n==0 -> NULL, samp with n==1 ->
+        NULL (default nullOnDivideByZero)."""
+        if self.mode == AggregateMode.FINAL:
+            cn = self._input_col(a, ctx, perm, "_n")
+            ca = self._input_col(a, ctx, perm, "_avg")
+            cm = self._input_col(a, ctx, perm, "_m2")
+            ntot, nz, mean, m2 = _chan_merge(cn, ca, cm, mask_sorted, seg,
+                                             nseg)
+        else:
+            c = self._input_col(a, ctx, perm)
+            valid = c.validity & mask_sorted
+            x = jnp.where(valid, c.data.astype(jnp.float64), 0.0)
+            if isinstance(c.dtype, T.DecimalType):
+                # unscaled storage -> numeric value (Spark casts to double)
+                x = x * jnp.float64(10.0 ** -c.dtype.scale)
+            ntot = SEG.seg_count(valid, seg, nseg).astype(jnp.float64)
+            s, _ = SEG.seg_sum(x, valid, seg, nseg)
+            nz = ntot > 0
+            mean = s / jnp.where(nz, ntot, 1.0)
+            d = jnp.where(valid, x - mean[seg], 0.0)
+            m2, _ = SEG.seg_sum(d * d, valid, seg, nseg)
+        if self.mode == AggregateMode.PARTIAL:
+            fn_, fa, fm = fields
+            return [
+                DeviceColumn(fn_.dataType, group_valid, data=ntot),
+                DeviceColumn(fa.dataType, group_valid & nz, data=mean),
+                DeviceColumn(fm.dataType, group_valid & nz, data=m2),
+            ]
+        (f,) = fields
+        pop = a.func.endswith("_pop")
+        den = ntot if pop else ntot - 1.0
+        # Spark 3.1+ default nullOnDivideByZero: samp with n==1 -> NULL
+        ok = den > 0.0
+        var = m2 / jnp.where(ok, den, 1.0)
+        res = var if a.func.startswith("var") else jnp.sqrt(var)
+        return [DeviceColumn(f.dataType, group_valid & nz & ok, data=res)]
+
     def _minmax_string(self, c: DeviceColumn, func, seg, validity, cap,
                        group_valid, f, nseg):
         """min/max on strings: argmin over packed key words per segment."""
@@ -486,6 +557,24 @@ def _sum_input(c: DeviceColumn, out_dtype):
     if _is_float(c.dtype) or (out_dtype is not None and _is_float(out_dtype)):
         return c.data.astype(jnp.float64)
     return c.data.astype(jnp.int64)
+
+
+def _chan_merge(cn: DeviceColumn, ca: DeviceColumn, cm: DeviceColumn,
+                mask_sorted, seg, nseg):
+    """Chan's parallel merge of (n, avg, m2) buffer rows per segment.
+
+    -> (ntot, nonzero_mask, mean, m2) per group."""
+    valid = cn.validity & mask_sorted & (cn.data > 0)
+    n_r = jnp.where(valid, cn.data, 0.0)
+    ntot, _ = SEG.seg_sum(n_r, valid, seg, nseg)
+    wsum, _ = SEG.seg_sum(n_r * jnp.where(valid, ca.data, 0.0),
+                          valid, seg, nseg)
+    nz = ntot > 0
+    mean = wsum / jnp.where(nz, ntot, 1.0)
+    d = jnp.where(valid, ca.data, 0.0) - mean[seg]
+    m2, _ = SEG.seg_sum(jnp.where(valid, cm.data + n_r * d * d, 0.0),
+                        valid, seg, nseg)
+    return ntot, nz, mean, m2
 
 
 def _seg_last_index(seg, row_mask, num_segments):

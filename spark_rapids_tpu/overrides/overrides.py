@@ -159,7 +159,8 @@ def wrap_expr(e: E.Expression, conf: TpuConf) -> ExprMeta:
 # ---------------------------------------------------------------------------
 
 _AGG_FUNCS_SUPPORTED = {"sum", "count", "count_star", "min", "max", "avg",
-                        "first", "last"}
+                        "first", "last", "var_pop", "var_samp", "stddev_pop",
+                        "stddev_samp"}
 _WINDOW_FUNCS_SUPPORTED = {"row_number", "rank", "dense_rank", "sum", "count",
                            "min", "max", "avg"}
 _JOIN_TYPES_SUPPORTED = {PN.JoinType.INNER, PN.JoinType.LEFT_OUTER,

@@ -142,11 +142,19 @@ class AggregateMode(enum.Enum):
     COMPLETE = "Complete"
 
 
+# central-moment aggregates sharing the (n, avg, m2) buffer form
+# (reference: Spark CentralMomentAgg, GPU'd as GpuStddevPop etc. in
+# org/apache/spark/sql/rapids/aggregate — SURVEY.md §2.4 hash aggregate)
+VARIANCE_FUNCS = frozenset(
+    {"var_pop", "var_samp", "stddev_pop", "stddev_samp"})
+
+
 @dataclasses.dataclass
 class AggregateExpression:
     """One aggregate: func name + input expr (resolved) + result name.
 
-    func in {sum, count, min, max, avg, first, last, count_star}.
+    func in {sum, count, min, max, avg, first, last, count_star,
+    var_pop, var_samp, stddev_pop, stddev_samp}.
     """
 
     func: str
@@ -175,6 +183,8 @@ class AggregateExpression:
             if isinstance(ct, T.DecimalType):
                 return T.DecimalType(min(ct.precision + 4, 38),
                                      min(ct.scale + 4, 38))
+            return T.DOUBLE
+        if self.func in VARIANCE_FUNCS:
             return T.DOUBLE
         return ct  # min/max/first/last
 
@@ -207,6 +217,10 @@ class HashAggregate(SparkPlan):
                                   if not isinstance(a.result_type, T.DecimalType)
                                   else T.DecimalType(38, a.child.dataType.scale)))
                     fields.append(T.StructField(a.result_name + "_count", T.LONG))
+                elif a.func in VARIANCE_FUNCS:
+                    fields.append(T.StructField(a.result_name + "_n", T.DOUBLE))
+                    fields.append(T.StructField(a.result_name + "_avg", T.DOUBLE))
+                    fields.append(T.StructField(a.result_name + "_m2", T.DOUBLE))
                 else:
                     fields.append(T.StructField(a.result_name, a.result_type))
         else:

@@ -503,3 +503,19 @@ def max_(c: ColumnLike, name: str = "max"):
 
 def avg_(c: ColumnLike, name: str = "avg"):
     return ("avg", c, name)
+
+
+def stddev_(c: ColumnLike, name: str = "stddev"):
+    return ("stddev_samp", c, name)
+
+
+def stddev_pop_(c: ColumnLike, name: str = "stddev_pop"):
+    return ("stddev_pop", c, name)
+
+
+def variance_(c: ColumnLike, name: str = "variance"):
+    return ("var_samp", c, name)
+
+
+def var_pop_(c: ColumnLike, name: str = "var_pop"):
+    return ("var_pop", c, name)

@@ -147,3 +147,58 @@ def test_groupby_minmax_string_with_nulls():
         return df.group_by("k").agg(min_("v", "mn"), max_("v", "mx"))
 
     assert_tpu_and_cpu_are_equal_collect(build)
+
+
+_var_funcs = ["var_pop", "var_samp", "stddev_pop", "stddev_samp"]
+
+
+@pytest.mark.parametrize("func", _var_funcs)
+def test_groupby_variance(func):
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=6),
+                        DoubleGen(nullable=True)], ["k", "v"], length=512)
+        return df.group_by(col("k")).agg((func, col("v"), "r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+@pytest.mark.parametrize("func", _var_funcs)
+def test_global_variance(func):
+    def build(s):
+        df = gen_df(s, [LongGen(nullable=True)], ["v"], length=300)
+        return df.agg((func, col("v"), "r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_variance_single_row_groups():
+    """samp variance of a 1-row group is NULL (nullOnDivideByZero)."""
+    def build(s):
+        df = gen_df(s, [LongGen(min_val=0, max_val=10**9),
+                        DoubleGen()], ["k", "v"], length=64)
+        return df.group_by(col("k")).agg(
+            ("var_samp", col("v"), "vs"), ("stddev_samp", col("v"), "ss"),
+            ("var_pop", col("v"), "vp"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_variance_all_null_group():
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=3),
+                        IntegerGen(null_prob=0.9)], ["k", "v"], length=200)
+        return df.group_by(col("k")).agg(
+            ("stddev_samp", col("v"), "s"), ("var_pop", col("v"), "p"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_variance_decimal_input():
+    """Variance over decimals uses numeric values, not unscaled storage."""
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=3),
+                        DecimalGen(8, 2)], ["k", "v"], length=128)
+        return df.group_by(col("k")).agg(
+            ("var_pop", col("v"), "vp"), ("stddev_samp", col("v"), "ss"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
