@@ -935,6 +935,167 @@ def _h_unixts(e, cols, n, ansi):
     return CpuCol(T.LONG, out, c.validity.copy())
 
 
+# -- hash functions (exact ports of Spark Murmur3_x86_32 / XXH64) -----------
+
+_M32 = 0xFFFFFFFF
+_M64 = 0xFFFFFFFFFFFFFFFF
+
+
+def _mm3_mix_k1(k1):
+    k1 = (k1 * 0xCC9E2D51) & _M32
+    k1 = ((k1 << 15) | (k1 >> 17)) & _M32
+    return (k1 * 0x1B873593) & _M32
+
+
+def _mm3_mix_h1(h1, k1):
+    h1 ^= k1
+    h1 = ((h1 << 13) | (h1 >> 19)) & _M32
+    return (h1 * 5 + 0xE6546B64) & _M32
+
+
+def _mm3_fmix(h1, length):
+    h1 ^= length
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & _M32
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & _M32
+    return h1 ^ (h1 >> 16)
+
+
+def _mm3_update(kind, x, seed):
+    if kind == "int":
+        return _mm3_fmix(_mm3_mix_h1(seed, _mm3_mix_k1(x & _M32)), 4)
+    if kind == "long":
+        x &= _M64
+        h = _mm3_mix_h1(seed, _mm3_mix_k1(x & _M32))
+        h = _mm3_mix_h1(h, _mm3_mix_k1(x >> 32))
+        return _mm3_fmix(h, 8)
+    bs = x
+    h = seed
+    aligned = (len(bs) // 4) * 4
+    for i in range(0, aligned, 4):
+        block = bs[i] | bs[i + 1] << 8 | bs[i + 2] << 16 | bs[i + 3] << 24
+        h = _mm3_mix_h1(h, _mm3_mix_k1(block))
+    for i in range(aligned, len(bs)):
+        b = bs[i]
+        sb = b if b < 128 else b | 0xFFFFFF00
+        h = _mm3_mix_h1(h, _mm3_mix_k1(sb))
+    return _mm3_fmix(h, len(bs))
+
+
+_XP1 = 0x9E3779B185EBCA87
+_XP2 = 0xC2B2AE3D27D4EB4F
+_XP3 = 0x165667B19E3779F9
+_XP4 = 0x85EBCA77C2B2AE63
+_XP5 = 0x27D4EB2F165667C5
+
+
+def _xrotl(x, r):
+    return ((x << r) | (x >> (64 - r))) & _M64
+
+
+def _xfmix(h):
+    h ^= h >> 33
+    h = (h * _XP2) & _M64
+    h ^= h >> 29
+    h = (h * _XP3) & _M64
+    return h ^ (h >> 32)
+
+
+def _xxh_update(kind, x, seed):
+    if kind == "int":
+        h = (seed + _XP5 + 4) & _M64
+        h ^= ((x & _M32) * _XP1) & _M64
+        h = (_xrotl(h, 23) * _XP2 + _XP3) & _M64
+        return _xfmix(h)
+    if kind == "long":
+        x &= _M64
+        h = (seed + _XP5 + 8) & _M64
+        h ^= (_xrotl((x * _XP2) & _M64, 31) * _XP1) & _M64
+        h = (_xrotl(h, 27) * _XP1 + _XP4) & _M64
+        return _xfmix(h)
+    bs = x
+    n = len(bs)
+    if n >= 32:
+        v1 = (seed + _XP1 + _XP2) & _M64
+        v2 = (seed + _XP2) & _M64
+        v3 = seed & _M64
+        v4 = (seed - _XP1) & _M64
+        o = 0
+        while o <= n - 32:
+            vs = []
+            for j, v in enumerate((v1, v2, v3, v4)):
+                k = int.from_bytes(bs[o + 8 * j:o + 8 * j + 8], "little")
+                vs.append((_xrotl((v + k * _XP2) & _M64, 31) * _XP1) & _M64)
+            v1, v2, v3, v4 = vs
+            o += 32
+        h = (_xrotl(v1, 1) + _xrotl(v2, 7) + _xrotl(v3, 12)
+             + _xrotl(v4, 18)) & _M64
+        for v in (v1, v2, v3, v4):
+            h = ((h ^ (_xrotl((v * _XP2) & _M64, 31) * _XP1 & _M64))
+                 * _XP1 + _XP4) & _M64
+    else:
+        h = (seed + _XP5) & _M64
+        o = 0
+    h = (h + n) & _M64
+    while o <= n - 8:
+        k = int.from_bytes(bs[o:o + 8], "little")
+        h = (_xrotl(h ^ ((_xrotl((k * _XP2) & _M64, 31) * _XP1) & _M64), 27)
+             * _XP1 + _XP4) & _M64
+        o += 8
+    if o <= n - 4:
+        k = int.from_bytes(bs[o:o + 4], "little")
+        h = (_xrotl(h ^ ((k * _XP1) & _M64), 23) * _XP2 + _XP3) & _M64
+        o += 4
+    while o < n:
+        h = (_xrotl(h ^ ((bs[o] * _XP5) & _M64), 11) * _XP1) & _M64
+        o += 1
+    return _xfmix(h)
+
+
+def _hash_input(dt: T.DataType, v):
+    """-> (kind, value) matching Spark HashExpression's per-type encoding."""
+    if isinstance(dt, T.StringType):
+        return "bytes", v.encode("utf-8")
+    if isinstance(dt, T.FloatType):
+        f = np.float32(v)
+        if f == 0.0:
+            f = np.float32(0.0)
+        bits = (0x7FC00000 if np.isnan(f)
+                else int(f.view(np.int32)))
+        return "int", bits
+    if isinstance(dt, T.DoubleType):
+        d = np.float64(v)
+        if d == 0.0:
+            d = np.float64(0.0)
+        bits = (0x7FF8000000000000 if np.isnan(d)
+                else int(d.view(np.int64)))
+        return "long", bits
+    if isinstance(dt, (T.LongType, T.TimestampType, T.DecimalType)):
+        return "long", int(v)
+    if isinstance(dt, T.BooleanType):
+        return "int", 1 if v else 0
+    return "int", int(v)  # byte/short/int/date
+
+
+def _h_hashexpr(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    xx = type(e).__name__ == "XxHash64"
+    out = np.zeros(n, np.int64 if xx else np.int32)
+    for i in range(n):
+        h = e.seed & (_M64 if xx else _M32)
+        for c in kids:
+            if not c.validity[i]:
+                continue
+            kind, x = _hash_input(c.dtype, c.values[i])
+            h = _xxh_update(kind, x, h) if xx else _mm3_update(kind, x, h)
+        if xx:
+            out[i] = h - (1 << 64) if h >= (1 << 63) else h
+        else:
+            out[i] = h - (1 << 32) if h >= (1 << 31) else h
+    return CpuCol(e.dataType, out, np.ones(n, np.bool_))
+
+
 _HANDLERS = {
     "BoundReference": _h_bound,
     "Literal": _h_literal,
@@ -969,6 +1130,7 @@ _HANDLERS = {
     "Hour": _h_timefield, "Minute": _h_timefield, "Second": _h_timefield,
     "DateAdd": _h_dateadd, "DateSub": _h_dateadd, "DateDiff": _h_datediff,
     "UnixTimestamp": _h_unixts,
+    "Murmur3Hash": _h_hashexpr, "XxHash64": _h_hashexpr,
 }
 
 

@@ -274,10 +274,17 @@ class TpuHashAggregateExec(TpuExec):
         buffers stay aligned with the declared schema)."""
         cols = []
         for a, fields in zip(self.aggregates, self._agg_fields()):
-            for f in fields:
-                zero_valued = (a.func in ("count", "count_star")
-                               or f.name.endswith("_count")
-                               or f.name.endswith("_n"))
+            for fi, f in enumerate(fields):
+                # position within the buffer group decides the initial
+                # value: counts start at valid 0, everything else NULL
+                if a.func in ("count", "count_star"):
+                    zero_valued = True
+                elif a.func == "avg" and len(fields) == 2:
+                    zero_valued = fi == 1  # (sum, count)
+                elif a.func in VARIANCE_FUNCS and len(fields) == 3:
+                    zero_valued = fi == 0  # (n, avg, m2)
+                else:
+                    zero_valued = False
                 if zero_valued:
                     cols.append(DeviceColumn(
                         f.dataType, jnp.ones(1, jnp.bool_),

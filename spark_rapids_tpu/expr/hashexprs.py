@@ -1,0 +1,52 @@
+"""Hash expressions: hash() (Murmur3) and xxhash64().
+
+Reference analog: GpuMurmur3Hash / GpuXxHash64 (HashFunctions.scala,
+SURVEY.md §2.5 hash/misc), backed by spark-rapids-jni murmur_hash.cu /
+xxhash64.cu.  Here both are vectorized jnp programs over the columnar
+layout (ops/hashing.py); seed-chaining across columns matches Spark's
+HashExpression: h = hash(col_i, seed=h), null columns pass the seed.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expr.base import Expression
+from spark_rapids_tpu.ops.hashing import murmur3_columns, xxhash64_columns
+
+
+class Murmur3Hash(Expression):
+    """hash(c1, c2, ...) -> int32, never null (seed 42)."""
+
+    def __init__(self, children: List[Expression], seed: int = 42):
+        super().__init__(children)
+        self.seed = seed
+
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = False
+
+    def do_columnar_eval(self, ctx, cols):
+        h = murmur3_columns(cols, seed=self.seed)
+        return DeviceColumn(T.INT, jnp.ones(cols[0].capacity, jnp.bool_),
+                            data=h)
+
+
+class XxHash64(Expression):
+    """xxhash64(c1, c2, ...) -> int64, never null (seed 42)."""
+
+    def __init__(self, children: List[Expression], seed: int = 42):
+        super().__init__(children)
+        self.seed = seed
+
+    def _resolve_type(self):
+        self._dataType = T.LONG
+        self._nullable = False
+
+    def do_columnar_eval(self, ctx, cols):
+        h = xxhash64_columns(cols, seed=self.seed)
+        return DeviceColumn(T.LONG, jnp.ones(cols[0].capacity, jnp.bool_),
+                            data=h)

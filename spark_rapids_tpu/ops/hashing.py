@@ -59,14 +59,17 @@ def murmur3_column(c: DeviceColumn, seed: jax.Array) -> jax.Array:
     if c.is_string:
         h = _murmur3_string(c, seed)
     elif isinstance(dt, (T.FloatType,)):
-        bits = c.data.astype(jnp.float32)
-        bits = jnp.where(bits == 0.0, jnp.float32(0.0), bits)  # -0.0 -> 0.0
-        as_u32 = bits.view(jnp.int32).astype(jnp.uint32)
+        f = c.data.astype(jnp.float32)
+        f = jnp.where(f == 0.0, jnp.float32(0.0), f)  # -0.0 -> 0.0
+        as_u32 = f.view(jnp.int32).astype(jnp.uint32)
+        # Java Float.floatToIntBits canonicalizes every NaN
+        as_u32 = jnp.where(jnp.isnan(f), jnp.uint32(0x7FC00000), as_u32)
         h = _hash_int_block(seed, as_u32, 4)
     elif isinstance(dt, (T.DoubleType,)):
         d = c.data.astype(jnp.float64)
         d = jnp.where(d == 0.0, jnp.float64(0.0), d)
         bits = d.view(jnp.int64).astype(jnp.uint64)
+        bits = jnp.where(jnp.isnan(d), jnp.uint64(0x7FF8000000000000), bits)
         h = _hash_long(seed, bits)
     elif isinstance(dt, (T.LongType, T.TimestampType)) or (
             isinstance(dt, T.DecimalType) and dt.precision > 18):
@@ -142,3 +145,151 @@ def spark_partition_ids(cols: List[DeviceColumn], num_partitions: int) -> jax.Ar
     h = murmur3_columns(cols, seed=42)
     p = h % jnp.int32(num_partitions)
     return jnp.where(p < 0, p + num_partitions, p)
+
+
+# ---------------------------------------------------------------------------
+# XXH64 (Spark's XxHash64, seed-chained per column like murmur3 above).
+# Reference analog: spark-rapids-jni xxhash64.cu backing GpuXxHash64.
+# ---------------------------------------------------------------------------
+_P1 = jnp.uint64(0x9E3779B185EBCA87)
+_P2 = jnp.uint64(0xC2B2AE3D27D4EB4F)
+_P3 = jnp.uint64(0x165667B19E3779F9)
+_P4 = jnp.uint64(0x85EBCA77C2B2AE63)
+_P5 = jnp.uint64(0x27D4EB2F165667C5)
+
+
+def _rotl64(x, r):
+    return (x << r) | (x >> (64 - r))
+
+
+def _xxh_fmix(h):
+    h = h ^ (h >> 33)
+    h = h * _P2
+    h = h ^ (h >> 29)
+    h = h * _P3
+    return h ^ (h >> 32)
+
+
+def _xxh_int(value_i32, seed_u64):
+    h = seed_u64 + _P5 + jnp.uint64(4)
+    u = value_i32.astype(jnp.uint32).astype(jnp.uint64)  # i & 0xFFFFFFFF
+    h = h ^ (u * _P1)
+    h = _rotl64(h, 23) * _P2 + _P3
+    return _xxh_fmix(h)
+
+
+def _xxh_long(value_u64, seed_u64):
+    h = seed_u64 + _P5 + jnp.uint64(8)
+    h = h ^ (_rotl64(value_u64 * _P2, 31) * _P1)
+    h = _rotl64(h, 27) * _P1 + _P4
+    return _xxh_fmix(h)
+
+
+def _gather_byte(ch_u64, idx, width):
+    """ch_u64: (n, w) uint64 byte matrix; idx: (n,) positions (clipped)."""
+    return jnp.take_along_axis(
+        ch_u64, jnp.clip(idx, 0, max(width - 1, 0))[:, None], axis=1)[:, 0]
+
+
+def _le_chunk(ch_u64, base, nbytes, width):
+    """Little-endian nbytes chunk starting at per-row ``base`` offsets."""
+    v = jnp.zeros(ch_u64.shape[0], jnp.uint64)
+    for t in range(nbytes):
+        v = v | (_gather_byte(ch_u64, base + t, width) << (8 * t))
+    return v
+
+
+def _xxh_string(c: DeviceColumn, seed: jax.Array) -> jax.Array:
+    """Vectorized XXH64.hashUnsafeBytes over the padded char matrix."""
+    n, w = c.capacity, c.width
+    ch = c.chars.astype(jnp.uint64)
+    lengths = c.lengths.astype(jnp.int32)
+    len64 = lengths.astype(jnp.uint64)
+    long_path = lengths >= 32
+    nstripes = lengths // 32  # do-while stripes == floor(len/32)
+    v1 = seed + _P1 + _P2
+    v2 = seed + _P2
+    v3 = seed
+    v4 = seed - _P1
+    for b in range(w // 32):
+        active = b < nstripes
+        for j, v in enumerate((v1, v2, v3, v4)):
+            base = 32 * b + 8 * j
+            k = jnp.zeros(n, jnp.uint64)
+            for t in range(8):  # static offsets -> plain column slices
+                k = k | (ch[:, base + t] << (8 * t))
+            nv = _rotl64(v + k * _P2, 31) * _P1
+            if j == 0:
+                v1 = jnp.where(active, nv, v1)
+            elif j == 1:
+                v2 = jnp.where(active, nv, v2)
+            elif j == 2:
+                v3 = jnp.where(active, nv, v3)
+            else:
+                v4 = jnp.where(active, nv, v4)
+    merged = (_rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12)
+              + _rotl64(v4, 18))
+    for v in (v1, v2, v3, v4):
+        merged = (merged ^ (_rotl64(v * _P2, 31) * _P1)) * _P1 + _P4
+    h = jnp.where(long_path, merged, seed + _P5)
+    h = h + len64
+    base = nstripes * 32
+    rem = lengths - base
+    # up to three 8-byte tail chunks
+    for j in range(3):
+        active = (j + 1) * 8 <= rem
+        k = _le_chunk(ch, base + 8 * j, 8, w)
+        nh = _rotl64(h ^ (_rotl64(k * _P2, 31) * _P1), 27) * _P1 + _P4
+        h = jnp.where(active, nh, h)
+    o4 = base + (rem // 8) * 8
+    rem4 = lengths - o4
+    active4 = rem4 >= 4
+    k4 = _le_chunk(ch, o4, 4, w)
+    h = jnp.where(active4, _rotl64(h ^ (k4 * _P1), 23) * _P2 + _P3, h)
+    ob = o4 + jnp.where(active4, 4, 0)
+    for t in range(3):
+        idx = ob + t
+        active = idx < lengths
+        byte = _gather_byte(ch, idx, w)
+        h = jnp.where(active, _rotl64(h ^ (byte * _P5), 11) * _P1, h)
+    return _xxh_fmix(h)
+
+
+_CANON_NAN32 = jnp.uint32(0x7FC00000)
+_CANON_NAN64 = jnp.uint64(0x7FF8000000000000)
+
+
+def xxhash64_column(c: DeviceColumn, seed: jax.Array) -> jax.Array:
+    """Per-row xxhash64 chained onto ``seed`` (uint64); null rows pass the
+    seed through (Spark HashExpression)."""
+    dt = c.dtype
+    if c.is_string:
+        h = _xxh_string(c, seed)
+    elif isinstance(dt, T.FloatType):
+        f = c.data.astype(jnp.float32)
+        f = jnp.where(f == 0.0, jnp.float32(0.0), f)
+        bits = f.view(jnp.int32)
+        bits = jnp.where(jnp.isnan(f), _CANON_NAN32.astype(jnp.int32), bits)
+        h = _xxh_int(bits, seed)
+    elif isinstance(dt, T.DoubleType):
+        d = c.data.astype(jnp.float64)
+        d = jnp.where(d == 0.0, jnp.float64(0.0), d)
+        bits = d.view(jnp.int64).astype(jnp.uint64)
+        bits = jnp.where(jnp.isnan(d), _CANON_NAN64, bits)
+        h = _xxh_long(bits, seed)
+    elif isinstance(dt, (T.LongType, T.TimestampType)) or isinstance(
+            dt, T.DecimalType):
+        h = _xxh_long(c.data.astype(jnp.int64).view(jnp.uint64), seed)
+    elif isinstance(dt, T.BooleanType):
+        h = _xxh_int(c.data.astype(jnp.int32), seed)
+    else:  # byte/short/int/date
+        h = _xxh_int(c.data.astype(jnp.int32), seed)
+    return jnp.where(c.validity, h, seed)
+
+
+def xxhash64_columns(cols: List[DeviceColumn], seed: int = 42) -> jax.Array:
+    n = cols[0].capacity
+    h = jnp.full((n,), jnp.uint64(seed))
+    for c in cols:
+        h = xxhash64_column(c, h)
+    return h.view(jnp.int64)

@@ -23,6 +23,7 @@ from spark_rapids_tpu.expr import base as E
 from spark_rapids_tpu.expr import cast as C
 from spark_rapids_tpu.expr import conditional as CO
 from spark_rapids_tpu.expr import datetime as DT
+from spark_rapids_tpu.expr import hashexprs as H
 from spark_rapids_tpu.expr import mathfuncs as M
 from spark_rapids_tpu.expr import predicates as P
 from spark_rapids_tpu.expr import strings as S
@@ -146,6 +147,8 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
     DT.DateSub: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
     DT.DateDiff: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
     DT.UnixTimestamp: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    H.Murmur3Hash: ExprRule(_COMMON128, desc="Spark murmur3 hash"),
+    H.XxHash64: ExprRule(_COMMON128, desc="Spark xxhash64"),
 }
 
 

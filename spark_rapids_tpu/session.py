@@ -505,6 +505,18 @@ def avg_(c: ColumnLike, name: str = "avg"):
     return ("avg", c, name)
 
 
+def hash_(*cols: ColumnLike):
+    from spark_rapids_tpu.expr.hashexprs import Murmur3Hash
+
+    return Murmur3Hash([_to_expr(c) for c in cols])
+
+
+def xxhash64_(*cols: ColumnLike):
+    from spark_rapids_tpu.expr.hashexprs import XxHash64
+
+    return XxHash64([_to_expr(c) for c in cols])
+
+
 def stddev_(c: ColumnLike, name: str = "stddev"):
     return ("stddev_samp", c, name)
 
