@@ -935,6 +935,161 @@ def _h_unixts(e, cols, n, ansi):
     return CpuCol(T.LONG, out, c.validity.copy())
 
 
+# -- string breadth ---------------------------------------------------------
+
+def _h_reverse(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    out = np.array([v[::-1] if v is not None else None for v in c.values],
+                   object)
+    return CpuCol(T.STRING, out, c.validity.copy())
+
+
+def _h_initcap(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+
+    def tx(s):
+        if s is None:
+            return None
+        out = []
+        prev_space = True
+        for ch in s:
+            if prev_space and "a" <= ch <= "z":
+                out.append(chr(ord(ch) - 32))
+            elif not prev_space and "A" <= ch <= "Z":
+                out.append(chr(ord(ch) + 32))
+            else:
+                out.append(ch)
+            prev_space = ch == " "
+        return "".join(out)
+
+    return CpuCol(T.STRING, np.array([tx(v) for v in c.values], object),
+                  c.validity.copy())
+
+
+def _h_ascii(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    out = np.array([(ord(v[0]) if v else 0)
+                    if v is not None else 0 for v in c.values], np.int32)
+    return CpuCol(T.INT, out, c.validity.copy())
+
+
+def _h_chr(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+
+    def tx(v):
+        if v is None:
+            return None
+        lv = int(v)
+        if lv < 0:
+            return ""
+        return chr(lv % 256)
+
+    return CpuCol(T.STRING, np.array([tx(v) for v in c.values], object),
+                  c.validity.copy())
+
+
+def _h_replace(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    c, se, re_ = kids
+    validity = _null_prop_validity(kids)
+    out = []
+    for i in range(n):
+        if not validity[i]:
+            out.append(None)
+            continue
+        s, search, rep = c.values[i], se.values[i], re_.values[i]
+        out.append(s if search == "" else s.replace(search, rep))
+    return CpuCol(T.STRING, np.array(out, object), validity)
+
+
+def _h_translate(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    c, f, t = kids
+    validity = _null_prop_validity(kids)
+    out = []
+    for i in range(n):
+        if not validity[i]:
+            out.append(None)
+            continue
+        frm, to = f.values[i], t.values[i]
+        table = {}
+        for j, ch in enumerate(frm):
+            if ch not in table:
+                table[ch] = to[j] if j < len(to) else None
+        out.append("".join(table.get(ch, ch) for ch in c.values[i]
+                           if table.get(ch, ch) is not None))
+    return CpuCol(T.STRING, np.array(out, object), validity)
+
+
+def _h_instr(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    s, sub = kids
+    validity = _null_prop_validity(kids)
+    out = np.array([(s.values[i].find(sub.values[i]) + 1)
+                    if validity[i] else 0 for i in range(n)], np.int32)
+    return CpuCol(T.INT, out, validity)
+
+
+def _h_locate(e, cols, n, ansi):
+    sub, s, st = _kids(e, cols, n, ansi)
+    validity = s.validity & sub.validity
+    out = np.zeros(n, np.int32)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        if not st.validity[i] or int(st.values[i]) < 1:
+            out[i] = 0  # Spark: null start or start < 1 -> 0, stays valid
+            continue
+        frm = int(st.values[i]) - 1
+        if sub.values[i] == "":
+            out[i] = 1  # UTF8String.indexOf("") is 0 regardless of start
+        else:
+            out[i] = s.values[i].find(sub.values[i], frm) + 1
+    return CpuCol(T.INT, out, validity)
+
+
+def _pad_str(s, target, pad, left):
+    if target <= 0:
+        return ""
+    if len(s) >= target:
+        return s[:target]
+    need = target - len(s)
+    fill = (pad * (need // len(pad) + 1))[:need] if pad else ""
+    return (fill + s) if left else (s + fill)
+
+
+def _h_pad(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    c, ln, p = kids
+    validity = _null_prop_validity(kids)
+    left = type(e).__name__ == "StringLPad"
+    out = [(_pad_str(c.values[i], int(ln.values[i]), p.values[i], left)
+            if validity[i] else None) for i in range(n)]
+    return CpuCol(T.STRING, np.array(out, object), validity)
+
+
+def _h_repeat(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    c, r = kids
+    validity = _null_prop_validity(kids)
+    out = [(c.values[i] * max(int(r.values[i]), 0)
+            if validity[i] else None) for i in range(n)]
+    return CpuCol(T.STRING, np.array(out, object), validity)
+
+
+def _h_concat_ws(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    sep = kids[0]
+    out = []
+    for i in range(n):
+        if not sep.validity[i]:  # Spark: null separator -> NULL result
+            out.append(None)
+            continue
+        pieces = [c.values[i] for c in kids[1:] if c.validity[i]]
+        out.append(sep.values[i].join(pieces))
+    return CpuCol(T.STRING, np.array(out, object), sep.validity.copy())
+
+
 # -- hash functions (exact ports of Spark Murmur3_x86_32 / XXH64) -----------
 
 _M32 = 0xFFFFFFFF
@@ -1131,6 +1286,11 @@ _HANDLERS = {
     "DateAdd": _h_dateadd, "DateSub": _h_dateadd, "DateDiff": _h_datediff,
     "UnixTimestamp": _h_unixts,
     "Murmur3Hash": _h_hashexpr, "XxHash64": _h_hashexpr,
+    "Reverse": _h_reverse, "InitCap": _h_initcap, "Ascii": _h_ascii,
+    "Chr": _h_chr, "StringReplace": _h_replace,
+    "StringTranslate": _h_translate, "StringInstr": _h_instr,
+    "StringLocate": _h_locate, "StringLPad": _h_pad, "StringRPad": _h_pad,
+    "StringRepeat": _h_repeat, "ConcatWs": _h_concat_ws,
 }
 
 

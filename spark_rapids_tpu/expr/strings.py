@@ -181,19 +181,8 @@ class EndsWith(_FixedCompare):
 class Contains(_FixedCompare):
     def do_columnar_eval(self, ctx, cols):
         s, needle = cols
-        w = s.width
-        nw = max(needle.width, 1)
-        # compare needle at every start offset: O(w * nw) vector ops
-        matches = jnp.zeros((s.capacity,), jnp.bool_)
-        npos = jnp.arange(nw)[None, :]
-        relevant = npos < needle.lengths[:, None]
-        nchars = needle.chars if needle.width else jnp.zeros((s.capacity, nw), jnp.uint8)
-        for start in range(w):
-            idx = start + jnp.arange(nw)[None, :]
-            seg = jnp.take_along_axis(s.chars, jnp.clip(idx, 0, w - 1), axis=1)
-            eq = jnp.all(~relevant | (seg == nchars), axis=1)
-            fits = start + needle.lengths <= s.lengths
-            matches = matches | (eq & fits)
+        # shared first-match scan (also backs instr/locate)
+        matches = _first_match_pos(s, needle) > 0
         return DeviceColumn(T.BOOLEAN, s.validity & needle.validity,
                             data=matches)
 
@@ -263,3 +252,438 @@ def like_pattern_supported(p: str) -> bool:
         return False
     core = p.strip("%")
     return "%" not in core
+
+
+# ---------------------------------------------------------------------------
+# Breadth set: replace/translate/instr/locate/pad/repeat/reverse/initcap/
+# ascii/chr/concat_ws.  Reference analog: stringFunctions.scala
+# (GpuStringReplace, GpuStringTranslate, GpuStringInstr, GpuStringLocate,
+# GpuStringLPad/RPad, GpuStringRepeat, GpuReverse, GpuInitCap, GpuAscii,
+# GpuChr, GpuConcatWs).  All are dense (rows x width) vector transforms;
+# where the reference requires literal needles/pads at plan time, the
+# overrides layer enforces the same restriction here.
+# ---------------------------------------------------------------------------
+
+
+def _literal_bytes(e: Expression) -> bytes:
+    from spark_rapids_tpu.expr.base import Literal
+
+    assert isinstance(e, Literal) and e.value is not None
+    return e.value.encode("utf-8")
+
+
+def _match_literal_at(c: DeviceColumn, needle: bytes) -> "jnp.ndarray":
+    """(n, w) bool: needle matches starting at byte position i."""
+    w = c.width
+    ls = len(needle)
+    m = jnp.ones((c.capacity, max(w, 1)), jnp.bool_)
+    for k, b in enumerate(needle):
+        if k >= w:
+            m = jnp.zeros_like(m)
+            break
+        shifted = jnp.concatenate(
+            [c.chars[:, k:], jnp.zeros((c.capacity, k), jnp.uint8)], axis=1)
+        m = m & (shifted == b)
+    pos = jnp.arange(max(w, 1))[None, :]
+    return m & (pos + ls <= c.lengths[:, None])
+
+
+class Reverse(UnaryExpression):
+    """Byte-reverse (ASCII-only, like Upper/Lower)."""
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        w = max(c.width, 1)
+        idx = c.lengths[:, None] - 1 - jnp.arange(w)[None, :]
+        take = jnp.arange(w)[None, :] < c.lengths[:, None]
+        src = c.chars if c.width else jnp.zeros((c.capacity, 1), jnp.uint8)
+        g = jnp.take_along_axis(src, jnp.clip(idx, 0, w - 1), axis=1)
+        return DeviceColumn(T.STRING, c.validity,
+                            chars=jnp.where(take, g, 0).astype(jnp.uint8),
+                            lengths=c.lengths)
+
+
+class InitCap(UnaryExpression):
+    """First letter of each space-separated word upper, rest lower
+    (ASCII-only)."""
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        ch = c.chars
+        is_space = ch == ord(" ")
+        prev_space = jnp.concatenate(
+            [jnp.ones((c.capacity, 1), jnp.bool_), is_space[:, :-1]], axis=1)
+        lower = jnp.where((ch >= ord("A")) & (ch <= ord("Z")), ch + 32, ch)
+        upper = jnp.where((ch >= ord("a")) & (ch <= ord("z")), ch - 32, ch)
+        out = jnp.where(prev_space, upper, lower)
+        return DeviceColumn(T.STRING, c.validity,
+                            chars=out.astype(jnp.uint8), lengths=c.lengths)
+
+
+class Ascii(UnaryExpression):
+    """ascii(s): code of the first byte; 0 for empty (ASCII-only)."""
+
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        if not c.width:
+            return DeviceColumn(T.INT, c.validity,
+                                data=jnp.zeros(c.capacity, jnp.int32))
+        # decode the first UTF-8 code point (Spark: codePointAt(0))
+        b = [c.chars[:, k].astype(jnp.int32) if k < c.width
+             else jnp.zeros(c.capacity, jnp.int32) for k in range(4)]
+        one = b[0] < 0x80
+        two = (b[0] >= 0xC0) & (b[0] < 0xE0)
+        three = (b[0] >= 0xE0) & (b[0] < 0xF0)
+        cp = jnp.where(
+            one, b[0],
+            jnp.where(two, ((b[0] & 0x1F) << 6) | (b[1] & 0x3F),
+                      jnp.where(three,
+                                ((b[0] & 0x0F) << 12) | ((b[1] & 0x3F) << 6)
+                                | (b[2] & 0x3F),
+                                ((b[0] & 0x07) << 18) | ((b[1] & 0x3F) << 12)
+                                | ((b[2] & 0x3F) << 6) | (b[3] & 0x3F))))
+        out = jnp.where(c.lengths > 0, cp, 0)
+        return DeviceColumn(T.INT, c.validity, data=out)
+
+
+class Chr(UnaryExpression):
+    """chr(n): character with code n % 256 (UTF-8 encoded); n<0 -> ''."""
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        lv = c.data.astype(jnp.int64)
+        code = (lv % 256).astype(jnp.int32)  # python-style mod: >= 0
+        neg = lv < 0
+        two_byte = code >= 128
+        b0 = jnp.where(two_byte, 0xC0 | (code >> 6), code)
+        b1 = jnp.where(two_byte, 0x80 | (code & 0x3F), 0)
+        chars = jnp.stack([b0, b1], axis=1).astype(jnp.uint8)
+        out_len = jnp.where(neg, 0, jnp.where(two_byte, 2, 1)).astype(jnp.int32)
+        chars = jnp.where(jnp.arange(2)[None, :] < out_len[:, None], chars, 0)
+        return DeviceColumn(T.STRING, c.validity,
+                            chars=chars.astype(jnp.uint8), lengths=out_len)
+
+
+class StringReplace(Expression):
+    """replace(str, search, rep) with literal search/rep: non-overlapping
+    left-to-right, like Java String.replace.  Empty search returns str."""
+
+    def __init__(self, s: Expression, search: Expression, rep: Expression):
+        super().__init__([s, search, rep])
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        import jax
+
+        c = cols[0]
+        validity = self.and_validity(cols)
+        search = _literal_bytes(self.children[1])
+        rep = _literal_bytes(self.children[2])
+        ls, lr = len(search), len(rep)
+        if ls == 0 or c.width == 0 or ls > c.width:
+            return DeviceColumn(T.STRING, validity, chars=c.chars,
+                                lengths=c.lengths)
+        n, w = c.capacity, c.width
+        m = _match_literal_at(c, search)
+
+        # greedy non-overlap: scan across columns with a per-row skip count
+        def step(skip, m_col):
+            start = m_col & (skip == 0)
+            new_skip = jnp.where(start, ls - 1, jnp.maximum(skip - 1, 0))
+            return new_skip, (start, skip > 0)
+
+        _, (starts_t, covered_t) = jax.lax.scan(
+            step, jnp.zeros(n, jnp.int32), m.T)
+        starts, covered = starts_t.T, covered_t.T
+        in_str = jnp.arange(w)[None, :] < c.lengths[:, None]
+        contrib = jnp.where(in_str,
+                            jnp.where(starts, lr,
+                                      jnp.where(covered, 0, 1)), 0)
+        off = jnp.cumsum(contrib, axis=1) - contrib  # exclusive
+        n_rep_max = w // ls
+        out_w = w + n_rep_max * max(lr - ls, 0)
+        out_len = jnp.sum(contrib, axis=1).astype(jnp.int32)
+        flat = jnp.zeros(n * out_w, jnp.uint8)
+        rows = jnp.arange(n)[:, None]
+        # plain chars
+        tgt = jnp.where(in_str & ~starts & ~covered,
+                        rows * out_w + off, n * out_w)
+        flat = flat.at[tgt.reshape(-1)].set(c.chars.reshape(-1), mode="drop")
+        # replacement bytes
+        for k, b in enumerate(rep):
+            tgt = jnp.where(in_str & starts, rows * out_w + off + k, n * out_w)
+            flat = flat.at[tgt.reshape(-1)].set(
+                jnp.uint8(b), mode="drop")
+        return DeviceColumn(T.STRING, validity,
+                            chars=flat.reshape(n, out_w), lengths=out_len)
+
+
+class StringTranslate(Expression):
+    """translate(str, from, to) with literal from/to; unmatched from-chars
+    are deleted (ASCII-only byte mapping)."""
+
+    def __init__(self, s: Expression, frm: Expression, to: Expression):
+        super().__init__([s, frm, to])
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        import numpy as np
+
+        c = cols[0]
+        validity = self.and_validity(cols)
+        frm = _literal_bytes(self.children[1])
+        to = _literal_bytes(self.children[2])
+        table = np.arange(256, dtype=np.uint8)
+        deleted = np.zeros(256, np.bool_)
+        seen = set()
+        for i, b in enumerate(frm):
+            if b in seen:  # first occurrence wins (Java Spark behavior)
+                continue
+            seen.add(b)
+            if i < len(to):
+                table[b] = to[i]
+            else:
+                deleted[b] = True
+        if c.width == 0:
+            return DeviceColumn(T.STRING, validity, chars=c.chars,
+                                lengths=c.lengths)
+        mapped = jnp.take(jnp.asarray(table), c.chars.astype(jnp.int32))
+        in_str = jnp.arange(c.width)[None, :] < c.lengths[:, None]
+        drop = jnp.take(jnp.asarray(deleted), c.chars.astype(jnp.int32))
+        keep = in_str & ~drop
+        # stable compaction: sort by (dropped-or-padding) ascending
+        perm = jnp.argsort(~keep, axis=1, stable=True)
+        g = jnp.take_along_axis(mapped, perm, axis=1)
+        out_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+        mask = jnp.arange(c.width)[None, :] < out_len[:, None]
+        return DeviceColumn(T.STRING, validity,
+                            chars=jnp.where(mask, g, 0).astype(jnp.uint8),
+                            lengths=out_len)
+
+
+def _first_match_pos(s: DeviceColumn, needle: DeviceColumn,
+                     from_idx=None) -> "jnp.ndarray":
+    """1-based position of the first needle occurrence at/after from_idx
+    (0-based), 0 if absent.  Empty needle -> 1 (Spark UTF8String.indexOf
+    returns 0 for an empty needle regardless of start)."""
+    w = max(s.width, 1)
+    nw = max(needle.width, 1)
+    npos = jnp.arange(nw)[None, :]
+    relevant = npos < needle.lengths[:, None]
+    nchars = (needle.chars if needle.width
+              else jnp.zeros((s.capacity, nw), jnp.uint8))
+    schars = s.chars if s.width else jnp.zeros((s.capacity, w), jnp.uint8)
+    found = jnp.zeros(s.capacity, jnp.bool_)
+    first = jnp.zeros(s.capacity, jnp.int32)
+    for start in range(w):
+        idx = start + jnp.arange(nw)[None, :]
+        seg = jnp.take_along_axis(schars, jnp.clip(idx, 0, w - 1), axis=1)
+        eq = jnp.all(~relevant | (seg == nchars), axis=1)
+        hit = eq & (start + needle.lengths <= s.lengths)
+        if from_idx is not None:
+            hit = hit & (start >= from_idx)
+        first = jnp.where(hit & ~found, start + 1, first)
+        found = found | hit
+    return jnp.where(needle.lengths == 0, 1, first)
+
+
+class StringInstr(BinaryExpression):
+    """instr(str, substr): 1-based first occurrence; 0 if absent."""
+
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        s, needle = cols
+        return DeviceColumn(T.INT, s.validity & needle.validity,
+                            data=_first_match_pos(s, needle))
+
+
+class StringLocate(Expression):
+    """locate(substr, str, start).  Spark semantics: start < 1 -> 0;
+    null start -> 0 (valid); empty substr -> 1."""
+
+    def __init__(self, substr: Expression, s: Expression,
+                 start: Expression):
+        super().__init__([substr, s, start])
+
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        needle, s, st = cols
+        start_val = st.data.astype(jnp.int32)
+        first = _first_match_pos(s, needle, jnp.maximum(start_val - 1, 0))
+        out = jnp.where(st.validity & (start_val >= 1), first, 0)
+        return DeviceColumn(T.INT, s.validity & needle.validity, data=out)
+
+
+class _PadBase(Expression):
+    def __init__(self, s: Expression, ln: Expression, pad: Expression):
+        super().__init__([s, ln, pad])
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def _parts(self, cols):
+        from spark_rapids_tpu.expr.base import Literal
+
+        c = cols[0]
+        assert isinstance(self.children[1], Literal)
+        target = max(int(self.children[1].value), 0)
+        pad = _literal_bytes(self.children[2])
+        return c, target, pad
+
+
+class StringLPad(_PadBase):
+    def do_columnar_eval(self, ctx, cols):
+        import numpy as np
+
+        c, target, pad = self._parts(cols)
+        validity = self.and_validity(cols)
+        if target == 0:
+            return DeviceColumn(T.STRING, validity,
+                                chars=jnp.zeros((c.capacity, 1), jnp.uint8),
+                                lengths=jnp.zeros(c.capacity, jnp.int32))
+        w = max(target, 1)
+        spaces = jnp.maximum(target - c.lengths, 0)
+        pad_np = np.frombuffer(pad, np.uint8)
+        pad_cols = jnp.asarray(
+            np.resize(pad_np, w) if len(pad) else np.zeros(w, np.uint8))
+        j = jnp.arange(w)[None, :]
+        src_idx = j - spaces[:, None]
+        gw = max(c.width, w)
+        src_chars = (_pad_to(c.chars, gw) if c.width
+                     else jnp.zeros((c.capacity, gw), jnp.uint8))
+        src = jnp.take_along_axis(src_chars,
+                                  jnp.clip(src_idx, 0, gw - 1), axis=1)
+        out = jnp.where(src_idx < 0, pad_cols[None, :], src)
+        out_len = jnp.full(c.capacity, target, jnp.int32)  # always `target`
+        mask = j < out_len[:, None]
+        return DeviceColumn(T.STRING, validity,
+                            chars=jnp.where(mask, out, 0).astype(jnp.uint8),
+                            lengths=out_len)
+
+
+class StringRPad(_PadBase):
+    def do_columnar_eval(self, ctx, cols):
+        import numpy as np
+
+        c, target, pad = self._parts(cols)
+        validity = self.and_validity(cols)
+        if target == 0:
+            return DeviceColumn(T.STRING, validity,
+                                chars=jnp.zeros((c.capacity, 1), jnp.uint8),
+                                lengths=jnp.zeros(c.capacity, jnp.int32))
+        w = max(target, 1)
+        lp = max(len(pad), 1)
+        pad_arr = jnp.asarray(np.frombuffer(pad.ljust(1, b"\0"), np.uint8))
+        j = jnp.arange(w)[None, :]
+        pad_idx = (j - c.lengths[:, None]) % lp
+        padded = jnp.take(pad_arr, pad_idx)
+        src = (_pad_to(c.chars, w)[:, :w] if c.width
+               else jnp.zeros((c.capacity, w), jnp.uint8))
+        out = jnp.where(j < c.lengths[:, None], src, padded)
+        out_len = jnp.full(c.capacity, target, jnp.int32)
+        mask = j < out_len[:, None]
+        return DeviceColumn(T.STRING, validity,
+                            chars=jnp.where(mask, out, 0).astype(jnp.uint8),
+                            lengths=out_len)
+
+
+class StringRepeat(BinaryExpression):
+    """repeat(str, n) with literal n."""
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.expr.base import Literal
+
+        c, _ = cols
+        validity = self.and_validity(cols)
+        assert isinstance(self.right, Literal)
+        n_rep = max(int(self.right.value), 0)
+        if n_rep == 0 or c.width == 0:
+            return DeviceColumn(T.STRING, validity,
+                                chars=jnp.zeros((c.capacity, 1), jnp.uint8),
+                                lengths=jnp.zeros(c.capacity, jnp.int32))
+        w = c.width * n_rep
+        j = jnp.arange(w)[None, :]
+        safe_len = jnp.maximum(c.lengths, 1)[:, None]
+        src_idx = j % safe_len
+        out = jnp.take_along_axis(_pad_to(c.chars, w),
+                                  jnp.clip(src_idx, 0, w - 1), axis=1)
+        out_len = (c.lengths * n_rep).astype(jnp.int32)
+        mask = j < out_len[:, None]
+        return DeviceColumn(T.STRING, validity,
+                            chars=jnp.where(mask, out, 0).astype(jnp.uint8),
+                            lengths=out_len)
+
+
+class ConcatWs(Expression):
+    """concat_ws(sep, s1, s2, ...): null inputs are SKIPPED (not null-
+    propagating like concat); null only when the separator is null (the
+    TPU path requires a non-null literal sep via overrides)."""
+
+    def __init__(self, children: List[Expression]):
+        super().__init__(children)
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = self.children[0].nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        sep = cols[0]
+        pieces = cols[1:]
+        n = sep.capacity
+        total_w = (sum(max(c.width, 1) for c in pieces)
+                   + max(sep.width, 1) * max(len(pieces) - 1, 0))
+        out = jnp.zeros((n, total_w), jnp.uint8)
+        out_len = jnp.zeros(n, jnp.int32)
+        has_prev = jnp.zeros(n, jnp.bool_)
+        for c in pieces:
+            include = c.validity
+            emit_sep = has_prev & include
+            for part, emit, plen in ((sep, emit_sep, sep.lengths),
+                                     (c, include, c.lengths)):
+                if part.width == 0:
+                    continue
+                src_idx = jnp.arange(total_w)[None, :] - out_len[:, None]
+                in_range = (src_idx >= 0) & (src_idx < part.width)
+                src = jnp.take_along_axis(
+                    _pad_to(part.chars, total_w),
+                    jnp.clip(src_idx, 0, total_w - 1), axis=1)
+                write = (in_range & (src_idx < plen[:, None])
+                         & emit[:, None])
+                out = jnp.where(write, src, out)
+                out_len = out_len + jnp.where(emit, plen, 0)
+            has_prev = has_prev | include
+        return DeviceColumn(T.STRING, jnp.ones(n, jnp.bool_),
+                            chars=out, lengths=out_len)

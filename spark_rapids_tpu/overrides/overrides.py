@@ -89,6 +89,23 @@ def _check_literal_pattern(meta: ExprMeta):
         meta.will_not_work_on_tpu("pattern must be a literal")
 
 
+def _check_literal_children(*ordinals, names="argument"):
+    def check(meta: ExprMeta):
+        for o in ordinals:
+            ch = meta.expr.children[o]
+            if not isinstance(ch, E.Literal) or ch.value is None:
+                meta.will_not_work_on_tpu(
+                    f"{names} (child {o}) must be a non-null literal on TPU")
+    return check
+
+
+def _check_pad(meta: ExprMeta):
+    _check_literal_children(1, 2, names="pad length/pad string")(meta)
+    pad = meta.expr.children[2]
+    if isinstance(pad, E.Literal) and pad.value == "":
+        meta.will_not_work_on_tpu("empty pad string is not supported on TPU")
+
+
 EXPRESSIONS: Dict[Type, ExprRule] = {
     E.Literal: ExprRule(_COMMON128, desc="constant literal"),
     E.BoundReference: ExprRule(_COMMON128, desc="column reference"),
@@ -132,6 +149,30 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
     S.EndsWith: ExprRule(T.STRING_SIG + T.BOOLEAN_SIG),
     S.Contains: ExprRule(T.STRING_SIG + T.BOOLEAN_SIG),
     S.StringTrim: ExprRule(T.STRING_SIG),
+    S.Reverse: ExprRule(T.STRING_SIG.with_note(
+        T.StringType, "byte-reverse; ASCII-only")),
+    S.InitCap: ExprRule(T.STRING_SIG.with_note(
+        T.StringType, "ASCII-only case conversion")),
+    S.Ascii: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG),
+    S.Chr: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG),
+    S.StringReplace: ExprRule(
+        T.STRING_SIG, extra_check=_check_literal_children(
+            1, 2, names="search/replace")),
+    S.StringTranslate: ExprRule(
+        T.STRING_SIG, extra_check=_check_literal_children(
+            1, 2, names="from/to")),
+    S.StringInstr: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG),
+    S.StringLocate: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG),
+    S.StringLPad: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG,
+                           extra_check=_check_pad),
+    S.StringRPad: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG,
+                           extra_check=_check_pad),
+    S.StringRepeat: ExprRule(
+        T.STRING_SIG + T.INTEGRAL_SIG,
+        extra_check=_check_literal_children(1, names="repeat count")),
+    S.ConcatWs: ExprRule(
+        T.STRING_SIG, extra_check=_check_literal_children(
+            0, names="separator")),
     S.Like: ExprRule(T.STRING_SIG + T.BOOLEAN_SIG, extra_check=_check_like),
     DT.Year: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
     DT.Month: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),

@@ -102,3 +102,130 @@ def test_string_sort_unicode():
         return df.order_by("a")
 
     assert_tpu_and_cpu_are_equal_collect(build, ignore_order=False)
+
+
+# -- breadth set: replace/translate/instr/locate/pad/repeat/reverse/ --------
+# -- initcap/ascii/chr/concat_ws --------------------------------------------
+
+from spark_rapids_tpu.expr.strings import (  # noqa: E402
+    Ascii,
+    Chr,
+    ConcatWs,
+    InitCap,
+    Reverse,
+    StringInstr,
+    StringLocate,
+    StringLPad,
+    StringRepeat,
+    StringReplace,
+    StringRPad,
+    StringTranslate,
+)
+
+
+def test_reverse_initcap_ascii():
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=10, charset="aB c")], ["a"],
+                    length=200)
+        return df.select(Reverse(col("a")).alias("r"),
+                         InitCap(col("a")).alias("i"),
+                         Ascii(col("a")).alias("c"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_chr():
+    def build(s):
+        from data_gen import LongGen
+        df = gen_df(s, [LongGen(min_val=-300, max_val=700)], ["n"],
+                    length=200)
+        return df.select(Chr(col("n")).alias("c"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("search,rep", [
+    ("ab", "X"), ("a", "zz"), ("aa", "b"), ("abc", ""), ("", "x"),
+    ("b", "bb")])
+def test_string_replace(search, rep):
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=10, charset="abc")], ["a"],
+                    length=200)
+        return df.select(
+            StringReplace(col("a"), lit(search), lit(rep)).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("frm,to", [("abc", "xyz"), ("ab", "x"),
+                                    ("aab", "xyz"), ("c", "")])
+def test_string_translate(frm, to):
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=10, charset="abcd")], ["a"],
+                    length=200)
+        return df.select(
+            StringTranslate(col("a"), lit(frm), lit(to)).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("sub", ["", "a", "ab", "abcd"])
+def test_instr(sub):
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=8, charset="abc")], ["a"],
+                    length=200)
+        return df.select(StringInstr(col("a"), lit(sub)).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("sub,start", [("a", 1), ("ab", 2), ("b", 0),
+                                       ("b", -3), ("", 3), ("c", 5)])
+def test_locate(sub, start):
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=8, charset="abc")], ["a"],
+                    length=200)
+        return df.select(
+            StringLocate(lit(sub), col("a"), lit(start)).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("target,pad", [(5, "*"), (3, "xy"), (0, "p"),
+                                        (12, "ab")])
+@pytest.mark.parametrize("cls", [StringLPad, StringRPad])
+def test_pad(cls, target, pad):
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=8)], ["a"], length=200)
+        return df.select(cls(col("a"), lit(target), lit(pad)).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("n_rep", [0, 1, 3])
+def test_repeat(n_rep):
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=6)], ["a"], length=200)
+        return df.select(StringRepeat(col("a"), lit(n_rep)).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_concat_ws_skips_nulls():
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=4), StringGen(max_len=4),
+                        StringGen(max_len=4)], ["a", "b", "c"], length=200)
+        return df.select(
+            ConcatWs([lit(","), col("a"), col("b"), col("c")]).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_replace_non_literal_fallback():
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=4), StringGen(max_len=2)],
+                    ["a", "b"], length=50)
+        return df.select(
+            StringReplace(col("a"), col("b"), lit("x")).alias("r"))
+
+    assert_tpu_fallback_collect(build, "Project")
