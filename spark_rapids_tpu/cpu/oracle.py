@@ -836,13 +836,53 @@ def _h_trim(e, cols, n, ansi):
 
 
 def _h_like(e: S.Like, cols, n, ansi):
-    l, _ = _kids(e, cols, n, ansi)
-    p = e.right.value
     import re
 
-    rx = re.compile("^" + re.escape(p).replace("%", ".*").replace("_", ".")
-                    + "$", re.DOTALL)
-    out = np.array([bool(rx.match(v)) if v is not None else False
+    from spark_rapids_tpu.regex.transpiler import like_to_regex
+
+    l, _ = _kids(e, cols, n, ansi)
+    rx = re.compile(like_to_regex(e.right.value))
+    out = np.array([bool(rx.fullmatch(v)) if v is not None else False
+                    for v in l.values], np.bool_)
+    return CpuCol(T.BOOLEAN, out, l.validity.copy())
+
+
+def _java_regex_to_python(pat: str) -> str:
+    """Adjust Java-vs-Python differences for the supported subset:
+    Java `.` excludes \\r too; Java `$` also matches before a final \\r /
+    \\r\\n.  Walks the pattern skipping escapes and char classes."""
+    out = []
+    i = 0
+    in_class = False
+    while i < len(pat):
+        c = pat[i]
+        if c == "\\" and i + 1 < len(pat):
+            out.append(pat[i:i + 2])
+            i += 2
+            continue
+        if in_class:
+            if c == "]":
+                in_class = False
+            out.append(c)
+        elif c == "[":
+            in_class = True
+            out.append(c)
+        elif c == ".":
+            out.append(r"[^\n\r]")
+        elif c == "$":
+            out.append(r"(?=(?:\r\n|\n|\r)?\Z)")
+        else:
+            out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _h_rlike(e, cols, n, ansi):
+    import re
+
+    l, _ = _kids(e, cols, n, ansi)
+    rx = re.compile(_java_regex_to_python(e.right.value))
+    out = np.array([bool(rx.search(v)) if v is not None else False
                     for v in l.values], np.bool_)
     return CpuCol(T.BOOLEAN, out, l.validity.copy())
 
@@ -1279,6 +1319,7 @@ _HANDLERS = {
     "Substring": _h_substring, "Concat": _h_concat,
     "StartsWith": _h_startswith, "EndsWith": _h_startswith,
     "Contains": _h_startswith, "StringTrim": _h_trim, "Like": _h_like,
+    "RLike": _h_rlike,
     "Year": _h_datefield, "Month": _h_datefield, "DayOfMonth": _h_datefield,
     "DayOfWeek": _h_datefield, "DayOfYear": _h_datefield,
     "Quarter": _h_datefield, "LastDay": _h_lastday,

@@ -230,28 +230,55 @@ class Like(BinaryExpression):
         pat = self.right
         assert isinstance(pat, Literal), "LIKE pattern must be literal"
         p: str = pat.value
+        simple = "_" not in p and "\\" not in p
         core = p.strip("%")
-        lit_expr = Literal(core, T.STRING)
-        needle = lit_expr.eval_tpu(ctx)
-        if p.startswith("%") and p.endswith("%") and "%" not in core:
-            return Contains(self.left, pat).do_columnar_eval(ctx, [s, needle])
-        if p.endswith("%") and "%" not in p[:-1]:
-            return StartsWith(self.left, pat).do_columnar_eval(ctx, [s, needle])
-        if p.startswith("%") and "%" not in p[1:]:
-            return EndsWith(self.left, pat).do_columnar_eval(ctx, [s, needle])
-        if "%" not in p and "_" not in p:
+        if simple and "%" not in core:
+            needle = Literal(core, T.STRING).eval_tpu(ctx)
+            if p.startswith("%") and p.endswith("%"):
+                return Contains(self.left, pat).do_columnar_eval(
+                    ctx, [s, needle])
+            if p.endswith("%"):
+                return StartsWith(self.left, pat).do_columnar_eval(
+                    ctx, [s, needle])
+            if p.startswith("%"):
+                return EndsWith(self.left, pat).do_columnar_eval(
+                    ctx, [s, needle])
             from spark_rapids_tpu.expr.predicates import string_compare
 
             _, eq = string_compare(s, needle)
             return DeviceColumn(T.BOOLEAN, s.validity, data=eq)
-        raise TypeError(f"LIKE pattern {p!r} not supported on TPU")
+        # general patterns (underscores, inner %, escapes): full-match DFA
+        from spark_rapids_tpu.regex import compile_regex, like_to_regex
+
+        compiled = getattr(self, "_dfa", None)
+        if compiled is None:
+            compiled = self._dfa = compile_regex(like_to_regex(p),
+                                                 full_match=True)
+        return DeviceColumn(T.BOOLEAN, s.validity, data=run_dfa(s, compiled))
 
 
-def like_pattern_supported(p: str) -> bool:
-    if "_" in p or "\\" in p:
+def like_pattern_supported(p) -> bool:
+    """Fast paths cover prefix/suffix/contains/exact; everything else
+    (underscores, inner %, escapes) compiles to a full-match DFA."""
+    if p is None:
         return False
-    core = p.strip("%")
-    return "%" not in core
+    if "_" not in p and "\\" not in p:
+        core = p.strip("%")
+        if "%" not in core:
+            return True
+    from spark_rapids_tpu.regex import (
+        RegexUnsupported,
+        compile_regex,
+        like_to_regex,
+    )
+
+    try:
+        compile_regex(like_to_regex(p), full_match=True)
+        return True
+    except (RegexUnsupported, ValueError):
+        # invalid escape sequences error identically on the CPU path, so
+        # letting them fall back surfaces the same Spark-style error there
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -687,3 +714,59 @@ class ConcatWs(Expression):
             has_prev = has_prev | include
         return DeviceColumn(T.STRING, jnp.ones(n, jnp.bool_),
                             chars=out, lengths=out_len)
+
+
+# ---------------------------------------------------------------------------
+# Regex: RLike over the plan-time-compiled DFA (regex/transpiler.py).
+# ---------------------------------------------------------------------------
+
+
+def run_dfa(c: DeviceColumn, compiled) -> "jnp.ndarray":
+    """Run a compiled DFA over every row; -> (n,) bool matched.
+
+    One lax.scan step per byte column: a single gather into the
+    (states x 256) table, vectorized across rows — the TPU replacement for
+    cuDF's regex VM."""
+    import jax
+
+    table = jnp.asarray(compiled.table.reshape(-1))  # (S*256,)
+    accept = jnp.asarray(compiled.accept)
+    n = c.capacity
+    if c.width == 0:
+        state = jnp.zeros(n, jnp.int32)
+        return accept[state]
+    in_str = jnp.arange(c.width)[None, :] < c.lengths[:, None]
+
+    def step(state, xs):
+        ch, live = xs
+        nxt = jnp.take(table, state * 256 + ch.astype(jnp.int32))
+        return jnp.where(live, nxt, state), None
+
+    state, _ = jax.lax.scan(step, jnp.zeros(n, jnp.int32),
+                            (c.chars.T, in_str.T))
+    return accept[state]
+
+
+class RLike(BinaryExpression):
+    """str RLIKE pattern (literal).  Pattern is transpiled to a DFA at plan
+    time; unsupported patterns are rejected by the overrides layer (the
+    reference's CudfRegexTranspiler-reject path)."""
+
+    def _resolve_type(self):
+        self._dataType = T.BOOLEAN
+        self._nullable = True
+
+    def _compiled(self):
+        from spark_rapids_tpu.expr.base import Literal
+        from spark_rapids_tpu.regex import compile_regex
+
+        cached = getattr(self, "_dfa", None)
+        if cached is None:
+            assert isinstance(self.right, Literal)
+            cached = self._dfa = compile_regex(self.right.value)
+        return cached
+
+    def do_columnar_eval(self, ctx, cols):
+        s, _ = cols
+        return DeviceColumn(T.BOOLEAN, s.validity,
+                            data=run_dfa(s, self._compiled()))

@@ -89,6 +89,21 @@ def _check_literal_pattern(meta: ExprMeta):
         meta.will_not_work_on_tpu("pattern must be a literal")
 
 
+def _check_rlike(meta: ExprMeta):
+    """Transpile at tag time; reject -> CPU fallback (the reference's
+    CudfRegexTranspiler-reject path, RegexParser.scala)."""
+    from spark_rapids_tpu.regex import RegexUnsupported, compile_regex
+
+    pat = meta.expr.children[1]
+    if not isinstance(pat, E.Literal) or pat.value is None:
+        meta.will_not_work_on_tpu("RLIKE pattern must be a non-null literal")
+        return
+    try:
+        compile_regex(pat.value)
+    except RegexUnsupported as ex:
+        meta.will_not_work_on_tpu(str(ex))
+
+
 def _check_literal_children(*ordinals, names="argument"):
     def check(meta: ExprMeta):
         for o in ordinals:
@@ -174,6 +189,8 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
         T.STRING_SIG, extra_check=_check_literal_children(
             0, names="separator")),
     S.Like: ExprRule(T.STRING_SIG + T.BOOLEAN_SIG, extra_check=_check_like),
+    S.RLike: ExprRule(T.STRING_SIG + T.BOOLEAN_SIG,
+                      extra_check=_check_rlike),
     DT.Year: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
     DT.Month: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
     DT.DayOfMonth: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),

@@ -1,0 +1,6 @@
+from spark_rapids_tpu.regex.transpiler import (  # noqa: F401
+    CompiledRegex,
+    RegexUnsupported,
+    compile_regex,
+    like_to_regex,
+)

@@ -505,6 +505,12 @@ def avg_(c: ColumnLike, name: str = "avg"):
     return ("avg", c, name)
 
 
+def rlike_(c: ColumnLike, pattern: str):
+    from spark_rapids_tpu.expr.strings import RLike
+
+    return RLike(_to_expr(c), _lit(pattern))
+
+
 def hash_(*cols: ColumnLike):
     from spark_rapids_tpu.expr.hashexprs import Murmur3Hash
 

@@ -1,0 +1,139 @@
+"""Regex transpiler + DFA tests (reference: regexp_test.py and
+RegularExpressionTranspilerSuite's fuzz-vs-oracle strategy)."""
+import re
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.regex import RegexUnsupported, compile_regex, like_to_regex
+from spark_rapids_tpu.session import col, lit, rlike_
+
+from asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    assert_tpu_fallback_collect,
+)
+from data_gen import StringGen, gen_df
+
+_SUPPORTED = [
+    "abc", "a.c", "^abc", "abc$", "^abc$", "a*", "a+b?", "[abc]+",
+    "[^ab]", "[a-f0-9]+", r"\d+", r"\w*z", r"\s", "(ab|cd)+", "a{2,4}",
+    "a{3}", "(a|b)c$", "^$", "a|", r"\.", r"[\d]x", "(?:ab)+c",
+    "x[0-9]{1,2}$", "^(foo|ba[rz])",
+]
+
+_UNSUPPORTED = [
+    r"(a)\1", r"\bword\b", "a*?", "a*+", "(?=x)y", "(?<=x)y", "(?<name>a)",
+    "a{500}", r"\p{Alpha}", "é+",
+]
+
+
+def _random_strings(rng, n=300):
+    alpha = "abcdefz019. \n\t|xFOO"
+    out = []
+    for _ in range(n):
+        ln = rng.integers(0, 12)
+        out.append("".join(rng.choice(list(alpha)) for _ in range(ln)))
+    out += ["", "abc", "aabc", "abcabc", "a\nb", "  ", "zzz", "fooz",
+            "bar", "baz", "x12", "x1", "x123", "a" * 20]
+    return out
+
+
+@pytest.mark.parametrize("pattern", _SUPPORTED)
+def test_dfa_matches_python_re(pattern):
+    """DFA vs Python re.search over randomized inputs (pure unit test)."""
+    from spark_rapids_tpu.columnar.column import HostColumn
+    from spark_rapids_tpu.expr.strings import run_dfa
+    from spark_rapids_tpu import types as T
+
+    compiled = compile_regex(pattern)
+    rng = np.random.default_rng(42)
+    strings = _random_strings(rng)
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    host = HostColumn.from_pylist(strings, T.STRING)
+    dev = DeviceColumn.from_host(host)
+    got = np.asarray(run_dfa(dev, compiled))[:len(strings)]
+    rx = re.compile(pattern)
+    for s, g in zip(strings, got):
+        want = bool(rx.search(s))
+        assert bool(g) == want, f"{pattern!r} on {s!r}: dfa={g} re={want}"
+
+
+@pytest.mark.parametrize("pattern", _UNSUPPORTED)
+def test_unsupported_patterns_rejected(pattern):
+    with pytest.raises(RegexUnsupported):
+        compile_regex(pattern)
+
+
+@pytest.mark.parametrize("pattern", ["^a[bc]+$", r"\d{2,4}", "(foo|bar)z?",
+                                     "x.*y$"])
+def test_rlike_differential(pattern):
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=10, charset="abcfoxyz019")],
+                    ["a"], length=300)
+        return df.select(rlike_(col("a"), pattern).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_rlike_unsupported_falls_back():
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=6)], ["a"], length=50)
+        return df.select(rlike_(col("a"), r"(x)\1").alias("r"))
+
+    assert_tpu_fallback_collect(build, "Project")
+
+
+@pytest.mark.parametrize("pattern", ["a_c", "a%b%c", "_bc%", "%a_",
+                                     "ab\\%c", "%\\_%"])
+def test_like_general_patterns_on_dfa(pattern):
+    from spark_rapids_tpu.expr.strings import Like
+
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=8, charset="abc_%")], ["a"],
+                    length=300)
+        return df.select(Like(col("a"), lit(pattern)).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_like_to_regex_fullmatch():
+    assert re.fullmatch(like_to_regex("a%b_"), "axxbZ")
+    assert re.fullmatch(like_to_regex("a\\%b"), "a%b")
+    assert not re.fullmatch(like_to_regex("a\\%b"), "axb")
+    assert re.fullmatch(like_to_regex("_"), "\n")
+
+
+@pytest.mark.parametrize("pattern", ["^.$", "[^a]", r"\D+", "a.", "^..$"])
+def test_rlike_multibyte_utf8(pattern):
+    """Byte DFA must count CHARACTERS: any-char/complement classes expand
+    to UTF-8 multi-byte alternations."""
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=4, charset="abé€\U0001F600")],
+                    ["a"], length=300)
+        return df.select(rlike_(col("a"), pattern).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_like_underscore_multibyte():
+    from spark_rapids_tpu.expr.strings import Like
+
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=3, charset="aé")], ["a"],
+                    length=200)
+        return df.select(Like(col("a"), lit("a_")).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_rlike_carriage_return_dollar():
+    """Java `$` matches before a final \\r / \\r\\n too."""
+    def build(s):
+        from spark_rapids_tpu import types as T
+        df = s.create_dataframe(
+            {"a": ["a", "a\n", "a\r", "a\r\n", "a\rb", "ab"]},
+            T.StructType([T.StructField("a", T.STRING)]))
+        return df.select(rlike_(col("a"), "a$").alias("d"),
+                         rlike_(col("a"), "a.").alias("dot"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)

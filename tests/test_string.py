@@ -75,11 +75,20 @@ def test_like_supported(pattern):
     assert_tpu_and_cpu_are_equal_collect(build)
 
 
-def test_like_complex_falls_back():
-    # '_' patterns hit the transpiler-reject path -> CPU fallback
+def test_like_underscore_runs_on_dfa():
+    # '_' patterns compile to the full-match DFA and stay on TPU
     def build(s):
         df = gen_df(s, [StringGen(max_len=4, charset="ab")], ["a"], length=80)
         return df.select(Like(col("a"), lit("a_b")).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_like_non_ascii_falls_back():
+    # non-ASCII patterns hit the transpiler-reject path -> CPU fallback
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=4, charset="ab")], ["a"], length=80)
+        return df.select(Like(col("a"), lit("é_")).alias("r"))
 
     assert_tpu_fallback_collect(build, "Project")
 
