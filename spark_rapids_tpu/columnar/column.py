@@ -65,6 +65,10 @@ class DeviceColumn:
     kind "flat": data (capacity,) of storage dtype; chars/lengths None.
     kind "string": chars (capacity, width) uint8; lengths (capacity,) int32;
                    data is None.
+    kind "array":  data (capacity, ewidth) of element storage dtype;
+                   elem_valid (capacity, ewidth) bool; lengths (capacity,)
+                   int32 — a padded list-column (primitive elements), the
+                   TPU answer to cuDF LIST columns (offsets + child).
     validity: (capacity,) bool; True = valid (non-null).
     """
 
@@ -73,22 +77,28 @@ class DeviceColumn:
     data: Optional[jax.Array] = None
     chars: Optional[jax.Array] = None
     lengths: Optional[jax.Array] = None
+    elem_valid: Optional[jax.Array] = None
 
     # -- pytree protocol ----------------------------------------------------
     def tree_flatten(self):
-        children = (self.validity, self.data, self.chars, self.lengths)
+        children = (self.validity, self.data, self.chars, self.lengths,
+                    self.elem_valid)
         return children, self.dtype
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        validity, data, chars, lengths = children
+        validity, data, chars, lengths, elem_valid = children
         return cls(dtype=aux, validity=validity, data=data, chars=chars,
-                   lengths=lengths)
+                   lengths=lengths, elem_valid=elem_valid)
 
     # -- properties ---------------------------------------------------------
     @property
     def is_string(self) -> bool:
         return self.chars is not None
+
+    @property
+    def is_array(self) -> bool:
+        return self.elem_valid is not None
 
     @property
     def capacity(self) -> int:
@@ -98,13 +108,34 @@ class DeviceColumn:
     def width(self) -> int:
         return int(self.chars.shape[1]) if self.chars is not None else 0
 
+    @property
+    def ewidth(self) -> int:
+        """Element capacity per row for array columns."""
+        return int(self.data.shape[1]) if self.is_array else 0
+
     def nbytes(self) -> int:
         n = self.validity.size  # bool = 1 byte
         if self.data is not None:
             n += self.data.size * self.data.dtype.itemsize
         if self.chars is not None:
             n += self.chars.size + self.lengths.size * 4
+        if self.elem_valid is not None:
+            n += self.elem_valid.size + self.lengths.size * 4
         return int(n)
+
+    def gather(self, idx) -> "DeviceColumn":
+        """Row gather (works for every column kind)."""
+        if self.is_string:
+            return DeviceColumn(self.dtype, self.validity[idx],
+                                chars=self.chars[idx],
+                                lengths=self.lengths[idx])
+        if self.is_array:
+            return DeviceColumn(self.dtype, self.validity[idx],
+                                data=self.data[idx],
+                                lengths=self.lengths[idx],
+                                elem_valid=self.elem_valid[idx])
+        return DeviceColumn(self.dtype, self.validity[idx],
+                            data=self.data[idx])
 
     # -- constructors -------------------------------------------------------
     @staticmethod
@@ -125,6 +156,20 @@ class DeviceColumn:
             return DeviceColumn(dtype=h.dtype, validity=jnp.asarray(validity),
                                 chars=jnp.asarray(chars),
                                 lengths=jnp.asarray(lengths))
+        if h.is_array:
+            max_len = int(h.lengths[:n].max()) if n else 0
+            width = round_up_bucket(max(max_len, 1), width_buckets)
+            data = np.zeros((cap, width), dtype=h.data.dtype)
+            ev = np.zeros((cap, width), dtype=np.bool_)
+            w0 = min(width, h.data.shape[1])
+            data[:n, :w0] = h.data[:n, :w0]
+            ev[:n, :w0] = h.elem_valid[:n, :w0]
+            lengths = np.zeros(cap, dtype=np.int32)
+            lengths[:n] = h.lengths[:n]
+            return DeviceColumn(dtype=h.dtype, validity=jnp.asarray(validity),
+                                data=jnp.asarray(data),
+                                lengths=jnp.asarray(lengths),
+                                elem_valid=jnp.asarray(ev))
         data = np.zeros(cap, dtype=h.data.dtype)
         data[:n] = h.data[:n]
         return DeviceColumn(dtype=h.dtype, validity=jnp.asarray(validity),
@@ -136,6 +181,11 @@ class DeviceColumn:
             return HostColumn(dtype=self.dtype, validity=validity,
                               chars=np.asarray(self.chars)[:num_rows],
                               lengths=np.asarray(self.lengths)[:num_rows])
+        if self.is_array:
+            return HostColumn(dtype=self.dtype, validity=validity,
+                              data=np.asarray(self.data)[:num_rows],
+                              lengths=np.asarray(self.lengths)[:num_rows],
+                              elem_valid=np.asarray(self.elem_valid)[:num_rows])
         return HostColumn(dtype=self.dtype, validity=validity,
                           data=np.asarray(self.data)[:num_rows])
 
@@ -148,20 +198,35 @@ class DeviceColumn:
                 return DeviceColumn(self.dtype, self.validity[:capacity],
                                     chars=self.chars[:capacity],
                                     lengths=self.lengths[:capacity])
+            if self.is_array:
+                return DeviceColumn(self.dtype, self.validity[:capacity],
+                                    data=self.data[:capacity],
+                                    lengths=self.lengths[:capacity],
+                                    elem_valid=self.elem_valid[:capacity])
             return DeviceColumn(self.dtype, self.validity[:capacity],
                                 data=self.data[:capacity])
         pad = capacity - self.capacity
+        validity = jnp.concatenate([self.validity, jnp.zeros(pad, jnp.bool_)])
         if self.is_string:
             return DeviceColumn(
-                self.dtype,
-                jnp.concatenate([self.validity, jnp.zeros(pad, jnp.bool_)]),
+                self.dtype, validity,
                 chars=jnp.concatenate(
                     [self.chars, jnp.zeros((pad, self.width), jnp.uint8)]),
                 lengths=jnp.concatenate(
                     [self.lengths, jnp.zeros(pad, jnp.int32)]))
+        if self.is_array:
+            return DeviceColumn(
+                self.dtype, validity,
+                data=jnp.concatenate(
+                    [self.data,
+                     jnp.zeros((pad, self.ewidth), self.data.dtype)]),
+                lengths=jnp.concatenate(
+                    [self.lengths, jnp.zeros(pad, jnp.int32)]),
+                elem_valid=jnp.concatenate(
+                    [self.elem_valid,
+                     jnp.zeros((pad, self.ewidth), jnp.bool_)]))
         return DeviceColumn(
-            self.dtype,
-            jnp.concatenate([self.validity, jnp.zeros(pad, jnp.bool_)]),
+            self.dtype, validity,
             data=jnp.concatenate(
                 [self.data, jnp.zeros(pad, self.data.dtype)]))
 
@@ -178,10 +243,15 @@ class HostColumn:
     data: Optional[np.ndarray] = None
     chars: Optional[np.ndarray] = None     # (n, width) uint8
     lengths: Optional[np.ndarray] = None   # (n,) int32
+    elem_valid: Optional[np.ndarray] = None  # (n, ewidth) bool (arrays)
 
     @property
     def is_string(self) -> bool:
         return self.chars is not None
+
+    @property
+    def is_array(self) -> bool:
+        return self.elem_valid is not None
 
     @property
     def num_rows(self) -> int:
@@ -192,6 +262,30 @@ class HostColumn:
     def from_pylist(values: List, dtype: T.DataType) -> "HostColumn":
         n = len(values)
         validity = np.array([v is not None for v in values], dtype=np.bool_)
+        if isinstance(dtype, T.ArrayType):
+            elem_host = HostColumn.from_pylist(
+                [e for v in values if v is not None for e in v],
+                dtype.elementType)
+            width = max((len(v) for v in values if v is not None),
+                        default=1) or 1
+            sdt = elem_host.data.dtype if elem_host.data is not None else None
+            if sdt is None:
+                raise NotImplementedError(
+                    "arrays of strings are not supported yet")
+            data = np.zeros((n, width), dtype=sdt)
+            ev = np.zeros((n, width), np.bool_)
+            lengths = np.zeros(n, np.int32)
+            pos = 0
+            for i, v in enumerate(values):
+                if v is None:
+                    continue
+                ln = len(v)
+                lengths[i] = ln
+                data[i, :ln] = elem_host.data[pos:pos + ln]
+                ev[i, :ln] = elem_host.validity[pos:pos + ln]
+                pos += ln
+            return HostColumn(dtype, validity, data=data, lengths=lengths,
+                              elem_valid=ev)
         if isinstance(dtype, T.StringType):
             encoded = [v.encode("utf-8") if v is not None else b"" for v in values]
             width = max((len(b) for b in encoded), default=1) or 1
@@ -232,6 +326,18 @@ class HostColumn:
         return HostColumn(dtype, validity, data=data)
 
     def to_pylist(self) -> List:
+        if self.is_array:
+            elem_t = self.dtype.elementType
+            out = []
+            for i in range(self.num_rows):
+                if not self.validity[i]:
+                    out.append(None)
+                    continue
+                ln = int(self.lengths[i])
+                row = HostColumn(elem_t, self.elem_valid[i, :ln],
+                                 data=self.data[i, :ln])
+                out.append(row.to_pylist())
+            return out
         out: List = []
         for i in range(self.num_rows):
             if not self.validity[i]:

@@ -52,6 +52,21 @@ class CpuCol:
 
     @staticmethod
     def from_host(h: HostColumn) -> "CpuCol":
+        if h.is_array:
+            elem_t = h.dtype.elementType
+            vals = []
+            for i in range(h.num_rows):
+                if not h.validity[i]:
+                    vals.append(None)
+                    continue
+                ln = int(h.lengths[i])
+                row = CpuCol.from_host(HostColumn(
+                    elem_t, h.elem_valid[i, :ln], data=h.data[i, :ln]))
+                vals.append([row.row(j) for j in range(ln)])
+            out = np.empty(h.num_rows, object)
+            for i, v in enumerate(vals):
+                out[i] = v
+            return CpuCol(h.dtype, out, h.validity.copy())
         if h.is_string:
             vals = np.array(
                 [bytes(h.chars[i, : h.lengths[i]]).decode("utf-8", "replace")
@@ -65,6 +80,23 @@ class CpuCol:
 
     def to_host(self) -> HostColumn:
         n = self.n
+        if isinstance(self.dtype, T.ArrayType):
+            elem_t = self.dtype.elementType
+            width = max((len(v) for v in self.values if v is not None),
+                        default=1) or 1
+            data = np.zeros((n, width), T.storage_dtype(elem_t))
+            ev = np.zeros((n, width), np.bool_)
+            lengths = np.zeros(n, np.int32)
+            for i in range(n):
+                v = self.values[i]
+                if not self.validity[i] or v is None:
+                    continue
+                lengths[i] = len(v)
+                eh = HostColumn.from_pylist(list(v), elem_t)
+                data[i, :len(v)] = eh.data
+                ev[i, :len(v)] = eh.validity
+            return HostColumn(self.dtype, self.validity.copy(), data=data,
+                              lengths=lengths, elem_valid=ev)
         if isinstance(self.dtype, T.StringType):
             strs = [self.values[i] if self.validity[i] else None
                     for i in range(n)]
@@ -95,6 +127,14 @@ class CpuCol:
         for i in range(self.n):
             if not self.validity[i]:
                 out.append(None)
+            elif isinstance(self.dtype, T.ArrayType):
+                v = self.values[i]
+                ev = np.array([e is not None for e in v], np.bool_)
+                vals = np.empty(len(v), object)
+                for j, e in enumerate(v):
+                    vals[j] = e
+                out.append(CpuCol(self.dtype.elementType, vals,
+                                  ev).to_pylist())
             elif isinstance(self.dtype, T.DecimalType):
                 out.append(_Dec(int(self.values[i])).scaleb(-self.dtype.scale))
             elif isinstance(self.dtype, T.DateType):
