@@ -217,7 +217,7 @@ class Like(BinaryExpression):
     Reference analog: GpuLike; complex patterns fall back at tag time (the
     regex-transpiler-reject path, SURVEY.md §2.5).  Supported here:
     'abc%', '%abc', '%abc%', exact, and patterns without wildcards; others
-    are rejected by the overrides layer (like_pattern_supported)."""
+    are rejected by the overrides layer (try_compile_like)."""
 
     def _resolve_type(self):
         self._dataType = T.BOOLEAN
@@ -257,15 +257,17 @@ class Like(BinaryExpression):
         return DeviceColumn(T.BOOLEAN, s.validity, data=run_dfa(s, compiled))
 
 
-def like_pattern_supported(p) -> bool:
-    """Fast paths cover prefix/suffix/contains/exact; everything else
-    (underscores, inner %, escapes) compiles to a full-match DFA."""
+def try_compile_like(p):
+    """-> (supported, compiled-or-None).  Fast paths (prefix/suffix/
+    contains/exact) need no DFA; everything else (underscores, inner %,
+    escapes) compiles to a full-match DFA, returned so the tag-time caller
+    can stash it on the expression (avoids a second compile at eval)."""
     if p is None:
-        return False
+        return False, None
     if "_" not in p and "\\" not in p:
         core = p.strip("%")
         if "%" not in core:
-            return True
+            return True, None
     from spark_rapids_tpu.regex import (
         RegexUnsupported,
         compile_regex,
@@ -273,12 +275,11 @@ def like_pattern_supported(p) -> bool:
     )
 
     try:
-        compile_regex(like_to_regex(p), full_match=True)
-        return True
+        return True, compile_regex(like_to_regex(p), full_match=True)
     except (RegexUnsupported, ValueError):
         # invalid escape sequences error identically on the CPU path, so
         # letting them fall back surfaces the same Spark-style error there
-        return False
+        return False, None
 
 
 # ---------------------------------------------------------------------------
@@ -512,9 +513,11 @@ class StringTranslate(Expression):
 
 def _first_match_pos(s: DeviceColumn, needle: DeviceColumn,
                      from_idx=None) -> "jnp.ndarray":
-    """1-based position of the first needle occurrence at/after from_idx
-    (0-based), 0 if absent.  Empty needle -> 1 (Spark UTF8String.indexOf
-    returns 0 for an empty needle regardless of start)."""
+    """1-based CHARACTER position of the first needle occurrence at/after
+    char index from_idx (0-based), 0 if absent.  Spark's instr/locate count
+    code points (UTF8String.indexOf), not bytes: matching is byte-wise over
+    the UTF-8 matrix, but reported positions count non-continuation bytes.
+    Empty needle -> 1 regardless of start."""
     w = max(s.width, 1)
     nw = max(needle.width, 1)
     npos = jnp.arange(nw)[None, :]
@@ -522,6 +525,9 @@ def _first_match_pos(s: DeviceColumn, needle: DeviceColumn,
     nchars = (needle.chars if needle.width
               else jnp.zeros((s.capacity, nw), jnp.uint8))
     schars = s.chars if s.width else jnp.zeros((s.capacity, w), jnp.uint8)
+    # chars_before[:, j] = number of code points strictly before byte j
+    noncont = ((schars < 0x80) | (schars >= 0xC0)).astype(jnp.int32)
+    chars_before = jnp.cumsum(noncont, axis=1) - noncont
     found = jnp.zeros(s.capacity, jnp.bool_)
     first = jnp.zeros(s.capacity, jnp.int32)
     for start in range(w):
@@ -529,9 +535,10 @@ def _first_match_pos(s: DeviceColumn, needle: DeviceColumn,
         seg = jnp.take_along_axis(schars, jnp.clip(idx, 0, w - 1), axis=1)
         eq = jnp.all(~relevant | (seg == nchars), axis=1)
         hit = eq & (start + needle.lengths <= s.lengths)
+        cpos = chars_before[:, start]
         if from_idx is not None:
-            hit = hit & (start >= from_idx)
-        first = jnp.where(hit & ~found, start + 1, first)
+            hit = hit & (cpos >= from_idx)
+        first = jnp.where(hit & ~found, cpos + 1, first)
         found = found | hit
     return jnp.where(needle.lengths == 0, 1, first)
 

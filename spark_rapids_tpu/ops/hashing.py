@@ -71,13 +71,18 @@ def murmur3_column(c: DeviceColumn, seed: jax.Array) -> jax.Array:
         bits = d.view(jnp.int64).astype(jnp.uint64)
         bits = jnp.where(jnp.isnan(d), jnp.uint64(0x7FF8000000000000), bits)
         h = _hash_long(seed, bits)
-    elif isinstance(dt, (T.LongType, T.TimestampType)) or (
-            isinstance(dt, T.DecimalType) and dt.precision > 18):
+    elif isinstance(dt, (T.LongType, T.TimestampType)):
         h = _hash_long(seed, c.data.astype(jnp.int64).view(jnp.uint64)
                        if c.data.dtype == jnp.int64
                        else c.data.astype(jnp.uint64))
     elif isinstance(dt, T.DecimalType):
-        # Spark hashes small decimals as their unscaled long
+        # Spark hashes precision<=18 decimals as their unscaled long;
+        # larger ones as the minimal BigInteger byte array — fail loudly
+        # until that path exists (HashExpression.hash in Spark).
+        if dt.precision > 18:
+            raise NotImplementedError(
+                "murmur3 of decimal precision > 18 requires the BigInteger "
+                "byte-array path")
         h = _hash_long(seed, c.data.astype(jnp.int64).astype(jnp.uint64))
     elif isinstance(dt, T.BooleanType):
         h = _hash_int_block(seed, c.data.astype(jnp.uint32), 4)
@@ -277,9 +282,15 @@ def xxhash64_column(c: DeviceColumn, seed: jax.Array) -> jax.Array:
         bits = d.view(jnp.int64).astype(jnp.uint64)
         bits = jnp.where(jnp.isnan(d), _CANON_NAN64, bits)
         h = _xxh_long(bits, seed)
-    elif isinstance(dt, (T.LongType, T.TimestampType)) or isinstance(
-            dt, T.DecimalType):
+    elif isinstance(dt, (T.LongType, T.TimestampType)) or (
+            isinstance(dt, T.DecimalType) and dt.precision <= 18):
         h = _xxh_long(c.data.astype(jnp.int64).view(jnp.uint64), seed)
+    elif isinstance(dt, T.DecimalType):
+        # Spark hashes precision>18 decimals as the minimal BigInteger
+        # byte array, not the unscaled long (same as murmur3 above).
+        raise NotImplementedError(
+            "xxhash64 of decimal precision > 18 requires the BigInteger "
+            "byte-array path")
     elif isinstance(dt, T.BooleanType):
         h = _xxh_int(c.data.astype(jnp.int32), seed)
     else:  # byte/short/int/date

@@ -78,10 +78,14 @@ def _check_like(meta: ExprMeta):
     pat = e.right
     if not isinstance(pat, E.Literal):
         meta.will_not_work_on_tpu("LIKE pattern must be a literal")
-    elif not S.like_pattern_supported(pat.value):
+        return
+    ok, compiled = S.try_compile_like(pat.value)
+    if not ok:
         meta.will_not_work_on_tpu(
             f"LIKE pattern {pat.value!r} is not supported on TPU "
             f"(transpiler-reject path; see RegexParser analog)")
+    elif compiled is not None:
+        e._dfa = compiled  # reuse the tag-time compilation at eval
 
 
 def _check_literal_pattern(meta: ExprMeta):
@@ -99,7 +103,7 @@ def _check_rlike(meta: ExprMeta):
         meta.will_not_work_on_tpu("RLIKE pattern must be a non-null literal")
         return
     try:
-        compile_regex(pat.value)
+        meta.expr._dfa = compile_regex(pat.value)
     except RegexUnsupported as ex:
         meta.will_not_work_on_tpu(str(ex))
 

@@ -112,6 +112,8 @@ class _Parser:
     def __init__(self, pattern: str):
         self.p = pattern
         self.i = 0
+        self.depth = 0          # group nesting depth
+        self.top_alt = False    # pattern has a `|` at depth 0
 
     def error(self, why: str):
         raise RegexUnsupported(f"regex {self.p!r}: {why} (at {self.i})")
@@ -132,6 +134,13 @@ class _Parser:
             self.next()
             anchored_start = True
         node = self.alternation()
+        if anchored_start and self.top_alt:
+            # Java precedence binds a leading `^` to the FIRST branch only
+            # (`^a|b` == `(^a)|b`); the DFA anchor flag is whole-pattern, so
+            # compiling this would silently anchor every branch.  Reject at
+            # plan time -> CPU fallback, like mid-pattern `^`/`$`.
+            # (`^(a|b)` is fine: the alternation is inside a group.)
+            self.error("`^` binds to the first alternation branch only")
         anchored_end = False
         # `$` only meaningful at the very end (deeper `$`s are rejected in
         # atom())
@@ -150,6 +159,8 @@ class _Parser:
         while self.peek() == "|":
             self.next()
             opts.append(self.sequence())
+        if len(opts) > 1 and self.depth == 0:
+            self.top_alt = True
         return opts[0] if len(opts) == 1 else RAlt(opts)
 
     def sequence(self):
@@ -214,7 +225,9 @@ class _Parser:
                     self.next()
                 else:
                     self.error("lookaround / named groups are not supported")
+            self.depth += 1
             node = self.alternation()
+            self.depth -= 1
             if self.peek() != ")":
                 self.error("unterminated group")
             self.next()

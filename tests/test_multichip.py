@@ -81,3 +81,22 @@ def test_dryrun_entrypoints():
     out = jax.jit(fn)(*args)
     assert len(out) == 2
     g.dryrun_multichip(8)
+
+
+def test_dryrun_standalone_like_driver():
+    """Run `python __graft_entry__.py` in a fresh interpreter with NONE of
+    conftest's platform forcing — exactly how the driver invokes it.  Round 1
+    failed precisely because this parity check did not exist (the driver env
+    grabbed the real TPU instead of building the virtual mesh)."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "__graft_entry__.py")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "dryrun_multichip OK" in proc.stdout

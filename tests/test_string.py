@@ -238,3 +238,27 @@ def test_replace_non_literal_fallback():
             StringReplace(col("a"), col("b"), lit("x")).alias("r"))
 
     assert_tpu_fallback_collect(build, "Project")
+
+
+@pytest.mark.parametrize("sub", ["é", "llo", "h", "él"])
+def test_instr_utf8_char_positions(sub):
+    """Spark instr/locate count CODE POINTS, not bytes (ADVICE r1: instr
+    ('héllo','llo') must be 3, not the byte offset 4)."""
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=6, charset="héloç")], ["a"],
+                    length=200)
+        return df.select(StringInstr(col("a"), lit(sub)).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("sub,start", [("é", 1), ("l", 2), ("lo", 3),
+                                       ("ç", 2)])
+def test_locate_utf8_char_positions(sub, start):
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=6, charset="héloç")], ["a"],
+                    length=200)
+        return df.select(
+            StringLocate(lit(sub), col("a"), lit(start)).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
