@@ -89,7 +89,28 @@ class ColumnarBatch:
             cols, [f.name for f in schema.fields], row_buckets)
 
     def to_host_columns(self) -> List[HostColumn]:
-        return [c.to_host(self.num_rows) for c in self.columns]
+        # one device_get for the whole batch: per-array np.asarray would pay
+        # a device round trip PER BUFFER (tunnel latency dominates small
+        # transfers)
+        import jax
+
+        host = jax.device_get([
+            (c.validity, c.data, c.chars, c.lengths, c.elem_valid)
+            for c in self.columns])
+        n = self.num_rows
+        out = []
+        for c, (validity, data, chars, lengths, elem_valid) in zip(
+                self.columns, host):
+            if c.is_string:
+                out.append(HostColumn(c.dtype, validity[:n],
+                                      chars=chars[:n], lengths=lengths[:n]))
+            elif c.is_array:
+                out.append(HostColumn(c.dtype, validity[:n], data=data[:n],
+                                      lengths=lengths[:n],
+                                      elem_valid=elem_valid[:n]))
+            else:
+                out.append(HostColumn(c.dtype, validity[:n], data=data[:n]))
+        return out
 
     def to_pydict(self) -> dict:
         return {f.name: c.to_host(self.num_rows).to_pylist()
@@ -152,14 +173,16 @@ class ColumnarBatch:
                 out_cols.append(DeviceColumn(dtype, validity, chars=chars,
                                              lengths=lengths))
             else:
-                data = jnp.zeros(cap, cols[0].data.dtype)
+                trail = cols[0].data.shape[1:]
+                data = jnp.zeros((cap,) + trail, cols[0].data.dtype)
                 validity = jnp.zeros(cap, jnp.bool_)
                 off = 0
                 for b, c in zip(batches, cols):
                     n = b.num_rows
                     if n == 0:
                         continue
-                    data = jax.lax.dynamic_update_slice(data, c.data[:n], (off,))
+                    data = jax.lax.dynamic_update_slice(
+                        data, c.data[:n], (off,) + (0,) * len(trail))
                     validity = jax.lax.dynamic_update_slice(validity, c.validity[:n], (off,))
                     off += n
                 out_cols.append(DeviceColumn(dtype, validity, data=data))
@@ -198,6 +221,9 @@ def empty_batch(schema: T.StructType, capacity: int = 1) -> ColumnarBatch:
                                      lengths=jnp.zeros(capacity, jnp.int32)))
         else:
             sdt = T.storage_dtype(f.dataType)
+            shape = ((capacity, 2)
+                     if isinstance(f.dataType, T.DecimalType)
+                     and f.dataType.is_128 else (capacity,))
             cols.append(DeviceColumn(f.dataType, jnp.zeros(capacity, jnp.bool_),
-                                     data=jnp.zeros(capacity, sdt)))
+                                     data=jnp.zeros(shape, sdt)))
     return ColumnarBatch(cols, 0, schema)

@@ -101,6 +101,11 @@ class DeviceColumn:
         return self.elem_valid is not None
 
     @property
+    def is_dec128(self) -> bool:
+        """decimal(p>18): data is (capacity, 2) int64 [hi, lo] limbs."""
+        return isinstance(self.dtype, T.DecimalType) and self.dtype.is_128
+
+    @property
     def capacity(self) -> int:
         return int(self.validity.shape[0])
 
@@ -170,7 +175,7 @@ class DeviceColumn:
                                 data=jnp.asarray(data),
                                 lengths=jnp.asarray(lengths),
                                 elem_valid=jnp.asarray(ev))
-        data = np.zeros(cap, dtype=h.data.dtype)
+        data = np.zeros((cap,) + h.data.shape[1:], dtype=h.data.dtype)
         data[:n] = h.data[:n]
         return DeviceColumn(dtype=h.dtype, validity=jnp.asarray(validity),
                             data=jnp.asarray(data))
@@ -228,7 +233,8 @@ class DeviceColumn:
         return DeviceColumn(
             self.dtype, validity,
             data=jnp.concatenate(
-                [self.data, jnp.zeros(pad, self.data.dtype)]))
+                [self.data,
+                 jnp.zeros((pad,) + self.data.shape[1:], self.data.dtype)]))
 
 
 @dataclasses.dataclass
@@ -296,6 +302,19 @@ class HostColumn:
                 lengths[i] = len(b)
             return HostColumn(dtype, validity, chars=chars, lengths=lengths)
         sdt = T.storage_dtype(dtype)
+        if isinstance(dtype, T.DecimalType) and dtype.is_128:
+            from decimal import Decimal
+
+            from spark_rapids_tpu.expr.decimal128 import limbs_of
+
+            data = np.zeros((n, 2), dtype=np.int64)
+            for i, v in enumerate(values):
+                if v is not None:
+                    d = Decimal(str(v)).scaleb(dtype.scale)
+                    hi, lo = limbs_of(int(d.to_integral_value()))
+                    data[i, 0] = hi
+                    data[i, 1] = lo
+            return HostColumn(dtype, validity, data=data)
         data = np.zeros(n, dtype=sdt)
         for i, v in enumerate(values):
             if v is not None:
@@ -348,7 +367,14 @@ class HostColumn:
             elif isinstance(self.dtype, T.DecimalType):
                 from decimal import Decimal
 
-                out.append(Decimal(int(self.data[i])).scaleb(-self.dtype.scale))
+                if self.dtype.is_128:
+                    from spark_rapids_tpu.expr.decimal128 import to_py
+
+                    v = to_py(int(self.data[i, 0]), int(self.data[i, 1]))
+                    out.append(Decimal(v).scaleb(-self.dtype.scale))
+                else:
+                    out.append(
+                        Decimal(int(self.data[i])).scaleb(-self.dtype.scale))
             elif isinstance(self.dtype, T.BooleanType):
                 out.append(bool(self.data[i]))
             elif isinstance(self.dtype, (T.FloatType, T.DoubleType)):
@@ -400,13 +426,19 @@ class HostColumn:
             return HostColumn(dtype, validity, chars=chars, lengths=lengths)
         sdt = T.storage_dtype(dtype)
         if isinstance(dtype, T.DecimalType):
-            # decimal128 storage is 16-byte little-endian; for precision<=18
-            # the signed low word IS the unscaled value
+            # arrow decimal128 storage is 16-byte little-endian (lo, hi)
             arr2 = arr.cast(pa.decimal128(38, dtype.scale)) \
                 if arr.type.scale != dtype.scale else arr
             buf = arr2.buffers()[1]
             raw = np.frombuffer(buf, dtype=np.int64)
             lo = raw[0::2][arr2.offset: arr2.offset + n]
+            if dtype.is_128:
+                hi = raw[1::2][arr2.offset: arr2.offset + n]
+                limbs = np.zeros((n, 2), np.int64)
+                limbs[:, 0] = np.where(validity, hi, 0)
+                limbs[:, 1] = np.where(validity, lo, 0)
+                return HostColumn(dtype, validity, data=limbs)
+            # precision<=18: the signed low word IS the unscaled value
             np_arr = np.where(validity, lo, 0)
         else:
             if isinstance(dtype, T.TimestampType) and pa.types.is_timestamp(
@@ -424,9 +456,18 @@ class HostColumn:
         if isinstance(self.dtype, T.DecimalType):
             from decimal import Decimal
 
-            vals = [Decimal(int(self.data[i])).scaleb(-self.dtype.scale)
-                    if self.validity[i] else None
-                    for i in range(self.num_rows)]
+            if self.dtype.is_128:
+                from spark_rapids_tpu.expr.decimal128 import to_py
+
+                vals = [Decimal(to_py(int(self.data[i, 0]),
+                                      int(self.data[i, 1])))
+                        .scaleb(-self.dtype.scale)
+                        if self.validity[i] else None
+                        for i in range(self.num_rows)]
+            else:
+                vals = [Decimal(int(self.data[i])).scaleb(-self.dtype.scale)
+                        if self.validity[i] else None
+                        for i in range(self.num_rows)]
             return pa.array(vals, type=pa.decimal128(
                 self.dtype.precision, self.dtype.scale))
         if isinstance(self.dtype, T.DateType):

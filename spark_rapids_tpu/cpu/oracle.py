@@ -74,7 +74,17 @@ class CpuCol:
                  for i in range(h.num_rows)], dtype=object)
             return CpuCol(h.dtype, vals, h.validity.copy())
         if isinstance(h.dtype, T.DecimalType):
-            vals = np.array([int(v) for v in h.data], dtype=object)
+            if h.dtype.is_128:
+                from spark_rapids_tpu.expr.decimal128 import to_py
+
+                vals = np.array(
+                    [to_py(int(h.data[i, 0]), int(h.data[i, 1]))
+                     for i in range(h.num_rows)], dtype=object)
+            else:
+                # tolist() gives PYTHON ints (np.int64 elements would wrap
+                # on >64-bit products); np.array over the list is C-speed
+                vals = np.empty(h.num_rows, object)
+                vals[:] = h.data.tolist()
             return CpuCol(h.dtype, vals, h.validity.copy())
         return CpuCol(h.dtype, h.data.copy(), h.validity.copy())
 
@@ -104,6 +114,14 @@ class CpuCol:
             h.validity = self.validity.copy()
             return h
         if isinstance(self.dtype, T.DecimalType):
+            if self.dtype.is_128:
+                from spark_rapids_tpu.expr.decimal128 import limbs_of
+
+                data = np.zeros((n, 2), np.int64)
+                for i in range(n):
+                    if self.validity[i]:
+                        data[i, 0], data[i, 1] = limbs_of(int(self.values[i]))
+                return HostColumn(self.dtype, self.validity.copy(), data=data)
             data = np.zeros(n, np.int64)
             for i in range(n):
                 if self.validity[i]:
@@ -225,13 +243,15 @@ def _java_wrap(vals, dt) -> np.ndarray:
 
 def _dec_check(vals, validity, dt: T.DecimalType, ansi, op):
     bound = 10 ** dt.precision
-    out_validity = validity.copy()
-    for i in range(len(vals)):
-        if validity[i] and not (-bound < int(vals[i]) < bound):
-            if ansi:
-                raise E.SparkArithmeticException(f"decimal {op} overflow (ANSI)")
-            out_validity[i] = False
-    return out_validity
+    safe = np.where(validity, vals, 0)
+    in_bounds = np.asarray(safe < bound, np.bool_) & np.asarray(
+        safe > -bound, np.bool_)
+    bad = validity & ~in_bounds
+    if bad.any():
+        if ansi:
+            raise E.SparkArithmeticException(f"decimal {op} overflow (ANSI)")
+        return validity & in_bounds
+    return validity.copy() if hasattr(validity, "copy") else validity
 
 
 def _h_binarith(e: A.BinaryArithmetic, cols, n, ansi):
@@ -241,19 +261,25 @@ def _h_binarith(e: A.BinaryArithmetic, cols, n, ansi):
     name = type(e).__name__
     if isinstance(dt, T.DecimalType):
         lt, rt = e.left.dataType, e.right.dataType
+        if name in ("Add", "Subtract", "Multiply"):
+            # vectorized object-int arithmetic (the hot TPC-H shapes)
+            a = np.where(validity, l.values, 0)
+            b = np.where(validity, r.values, 0)
+            if name in ("Add", "Subtract"):
+                a = a * (10 ** (dt.scale - lt.scale))
+                b = b * (10 ** (dt.scale - rt.scale))
+                out = a + b if name == "Add" else a - b
+            else:
+                out = a * b
+            validity = _dec_check(out, validity, dt, ansi, name.lower())
+            return CpuCol(dt, out, validity)
         out = np.zeros(n, dtype=object)
         for i in range(n):
             if not validity[i]:
                 out[i] = 0
                 continue
             a, b = int(l.values[i]), int(r.values[i])
-            if name in ("Add", "Subtract"):
-                a *= 10 ** (dt.scale - lt.scale)
-                b *= 10 ** (dt.scale - rt.scale)
-                out[i] = a + b if name == "Add" else a - b
-            elif name == "Multiply":
-                out[i] = a * b
-            elif name == "Divide":
+            if name == "Divide":
                 if b == 0:
                     if ansi:
                         raise E.SparkArithmeticException("division by zero (ANSI)")
@@ -390,6 +416,12 @@ def _h_abs(e, cols, n, ansi):
 
 def _cmp_rows(l: CpuCol, r: CpuCol, dt: T.DataType):
     """elementwise python compare -> int array (-1,0,1)."""
+    if isinstance(dt, T.DecimalType):
+        # vectorized object-int compare (nulls neutralized; validity masks
+        # the result downstream)
+        a = np.where(l.validity, l.values, 0)
+        b = np.where(r.validity, r.values, 0)
+        return np.asarray(a > b, np.int32) - np.asarray(a < b, np.int32)
     out = np.zeros(l.n, np.int32)
     for i in range(l.n):
         a, b = l.values[i], r.values[i]
@@ -575,6 +607,32 @@ def _h_cast(e: C.Cast, cols, n, ansi):
     ansi = ansi or e.ansi_override
     if src == dst:
         return c
+    if isinstance(src, T.DecimalType) and isinstance(dst, T.DecimalType):
+        # vectorized integer rescale (comparison coercion makes this hot)
+        vals = np.where(c.validity, c.values, 0)
+        diff = dst.scale - src.scale
+        widens = (dst.precision - dst.scale >= src.precision - src.scale
+                  and diff >= 0)
+        if diff == 0:
+            if widens:  # pure widening: values cannot overflow
+                return CpuCol(dst, vals, c.validity.copy())
+            out = vals
+        elif diff > 0:
+            out = vals * (10 ** diff)
+        else:
+            den = 10 ** (-diff)
+            q = vals // den               # floor
+            rem = vals - q * den
+            neg = np.asarray(vals < 0, np.bool_)
+            q = q + np.asarray(neg & np.asarray(rem != 0, np.bool_),
+                               np.int64)  # -> trunc toward zero
+            rem2 = np.abs(vals - q * den)
+            q = q + np.where(np.asarray(2 * rem2 >= den, np.bool_)
+                             & np.asarray(rem2 != 0, np.bool_),
+                             np.where(neg, -1, 1), 0)  # HALF_UP
+            out = q
+        validity = _dec_check(out, c.validity, dst, ansi, "cast")
+        return CpuCol(dst, out, validity)
     out_vals: list = []
     out_valid = c.validity.copy()
     for i in range(n):
@@ -1649,9 +1707,11 @@ def _agg_final(a: PN.AggregateExpression, ac, rows_per_group) -> CpuCol:
                 rt: T.DecimalType = a.result_type
                 s = sum(int(sc.values[i]) for i in idxs if sc.validity[i])
                 in_scale = rt.scale - 4
-                q = pydec.Decimal(s).scaleb(-in_scale) / total_cnt
-                out.append(int(q.scaleb(rt.scale).quantize(
-                    pydec.Decimal(1), rounding=pydec.ROUND_HALF_UP)))
+                with pydec.localcontext() as lctx:
+                    lctx.prec = 78
+                    q = pydec.Decimal(s).scaleb(-in_scale) / total_cnt
+                    out.append(int(q.scaleb(rt.scale).quantize(
+                        pydec.Decimal(1), rounding=pydec.ROUND_HALF_UP)))
             else:
                 s = sum(float(sc.values[i]) for i in idxs if sc.validity[i])
                 out.append(s / total_cnt)

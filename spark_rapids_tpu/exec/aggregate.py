@@ -58,6 +58,10 @@ class TpuHashAggregateExec(TpuExec):
         self.child_schema = child_plan_output
         self._output = output_schema
         self.ansi = ansi
+        # whole-stage fusion (fuse_stages): narrow ops absorbed into this
+        # node's jitted program, applied in selection-mask mode
+        self.pre_ops = []
+        self.input_schema = child_plan_output
 
     @property
     def output(self):
@@ -66,7 +70,13 @@ class TpuHashAggregateExec(TpuExec):
     def describe(self):
         g = ", ".join(e.sql_string() for e in self.grouping)
         a = ", ".join(a.describe() for a in self.aggregates)
-        return f"TpuHashAggregate({self.mode.value}) keys=[{g}] aggs=[{a}]"
+        fused = ""
+        if self.pre_ops:
+            names = "+".join(type(o).__name__.replace("Op", "")
+                             for o in self.pre_ops)
+            fused = f" fused=[{names}]"
+        return (f"TpuHashAggregate({self.mode.value}) keys=[{g}] "
+                f"aggs=[{a}]{fused}")
 
     # ------------------------------------------------------------------
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
@@ -146,7 +156,10 @@ class TpuHashAggregateExec(TpuExec):
             cache[key] = jax.jit(self._merge_fn)
         cols, nrows = cache[key](tuple(batch.columns),
                                  jnp.int32(batch.num_rows))
-        return ColumnarBatch(list(cols), int(nrows), self._buffer_schema())
+        # global aggregates have a statically known single output row —
+        # skip the device sync (int(nrows) blocks on tunnel latency)
+        n = 1 if not self.grouping else int(nrows)
+        return ColumnarBatch(list(cols), n, self._buffer_schema())
 
     def _finalize(self, buf: ColumnarBatch) -> ColumnarBatch:
         """Buffer form -> this node's output form."""
@@ -231,6 +244,15 @@ class TpuHashAggregateExec(TpuExec):
         for f, c in zip(fields, bufs):
             cs = c if perm is None else _gather_col(c, perm)
             validity = cs.validity & mask_sorted
+            if (func in ("sum", "avg") and isinstance(f.dataType, T.DecimalType)
+                    and (f.dataType.is_128 or cs.is_dec128)):
+                out.append(_sum_dec128(cs, validity, seg, nseg, group_valid,
+                                       f.dataType))
+                continue
+            if func in ("min", "max") and cs.is_dec128:
+                out.append(_minmax_dec128(cs, func, seg, validity, nseg,
+                                          group_valid, f))
+                continue
             if func in ("sum", "count", "avg"):
                 s, has = SEG.seg_sum(
                     cs.data.astype(jnp.float64)
@@ -285,10 +307,12 @@ class TpuHashAggregateExec(TpuExec):
                     zero_valued = fi == 0  # (n, avg, m2)
                 else:
                     zero_valued = False
+                shape = ((1, 2) if isinstance(f.dataType, T.DecimalType)
+                         and f.dataType.is_128 else (1,))
                 if zero_valued:
                     cols.append(DeviceColumn(
                         f.dataType, jnp.ones(1, jnp.bool_),
-                        data=jnp.zeros(1, T.storage_dtype(f.dataType))))
+                        data=jnp.zeros(shape, T.storage_dtype(f.dataType))))
                 elif isinstance(f.dataType, T.StringType):
                     cols.append(DeviceColumn(
                         f.dataType, jnp.zeros(1, jnp.bool_),
@@ -297,7 +321,7 @@ class TpuHashAggregateExec(TpuExec):
                 else:
                     cols.append(DeviceColumn(
                         f.dataType, jnp.zeros(1, jnp.bool_),
-                        data=jnp.zeros(1, T.storage_dtype(f.dataType))))
+                        data=jnp.zeros(shape, T.storage_dtype(f.dataType))))
         return ColumnarBatch(cols, 1, self._output)
 
     # ------------------------------------------------------------------
@@ -306,16 +330,20 @@ class TpuHashAggregateExec(TpuExec):
             self._jitted = jax.jit(self._agg_fn)
         cols, nrows = self._jitted(tuple(batch.columns),
                                    jnp.int32(batch.num_rows))
-        return ColumnarBatch(list(cols), int(nrows), self._output)
+        n = 1 if not self.grouping else int(nrows)
+        return ColumnarBatch(list(cols), n, self._output)
 
     def _agg_fn(self, cols, num_rows):
-        batch = ColumnarBatch(list(cols), num_rows, self.child_schema)
+        batch = ColumnarBatch(list(cols), num_rows, self.input_schema)
         ctx = EvalContext(batch, ansi=self.ansi)
+        mask = batch.row_mask
+        for op in self.pre_ops:
+            batch, mask = op.apply_masked(ctx, batch, mask)
+        ctx.batch = batch
         key_cols = [g.eval_tpu(ctx) for g in self.grouping]
         if not key_cols:
-            return self._global_agg(ctx, batch)
+            return self._global_agg(ctx, batch, mask)
         cap = batch.capacity
-        mask = batch.row_mask
         # ---- sort rows by group keys (stable, padding last) ----
         keys: List[jax.Array] = []
         hi = jnp.int64(9223372036854775807)
@@ -398,26 +426,58 @@ class TpuHashAggregateExec(TpuExec):
             return self._eval_variance(a, fields, ctx, perm, seg, mask_sorted,
                                        cap, group_valid, nseg)
         if func == "avg":
+            sum_dt = (fields[0].dataType if mode == AggregateMode.PARTIAL
+                      else (self.child_schema.fields[
+                          self.child_schema.field_names().index(
+                              a.result_name + "_sum")].dataType
+                          if mode == AggregateMode.FINAL else None))
+            dec_in = (a.child is not None
+                      and isinstance(a.child.dataType, T.DecimalType)) \
+                if mode != AggregateMode.FINAL else isinstance(
+                    sum_dt, T.DecimalType)
+            buf128 = (isinstance(sum_dt, T.DecimalType) and sum_dt.is_128) \
+                if sum_dt is not None else (
+                    dec_in and a.child.dataType.precision + 10 > 18)
             if mode == AggregateMode.PARTIAL:
                 c = self._input_col(a, ctx, perm)
                 sum_f, cnt_f = fields
-                s, has = SEG.seg_sum(_sum_input(c, sum_f.dataType),
-                                     c.validity & mask_sorted, seg, nseg)
-                cnt = SEG.seg_count(c.validity & mask_sorted, seg, nseg)
-                out.append(DeviceColumn(sum_f.dataType, group_valid & has, data=s))
+                validity = c.validity & mask_sorted
+                if buf128:
+                    out.append(_sum_dec128(c, validity, seg, nseg,
+                                           group_valid, sum_f.dataType))
+                else:
+                    s, has = SEG.seg_sum(_sum_input(c, sum_f.dataType),
+                                         validity, seg, nseg)
+                    out.append(DeviceColumn(sum_f.dataType, group_valid & has,
+                                            data=s))
+                cnt = SEG.seg_count(validity, seg, nseg)
                 out.append(DeviceColumn(cnt_f.dataType, group_valid, data=cnt))
                 return out
+            (f,) = fields
             if mode == AggregateMode.FINAL:
                 cs = self._input_col(a, ctx, perm, "_sum")
                 cc = self._input_col(a, ctx, perm, "_count")
+                n, _ = SEG.seg_sum(cc.data, cc.validity & mask_sorted, seg,
+                                   nseg)
+                if buf128:
+                    scol = _sum_dec128(cs, cs.validity & mask_sorted, seg,
+                                       nseg, group_valid, sum_dt)
+                    return [_avg_div_dec128(scol, n, sum_dt.scale,
+                                            f.dataType, group_valid)]
                 s, _ = SEG.seg_sum(cs.data, cs.validity & mask_sorted, seg, nseg)
-                n, _ = SEG.seg_sum(cc.data, cc.validity & mask_sorted, seg, nseg)
             else:
                 c = self._input_col(a, ctx, perm)
-                s, _ = SEG.seg_sum(_sum_input(c, None),
-                                   c.validity & mask_sorted, seg, nseg)
-                n = SEG.seg_count(c.validity & mask_sorted, seg, nseg)
-            (f,) = fields
+                validity = c.validity & mask_sorted
+                n = SEG.seg_count(validity, seg, nseg)
+                if buf128:
+                    buf_dt = T.DecimalType(
+                        min(a.child.dataType.precision + 10, 38),
+                        a.child.dataType.scale)
+                    scol = _sum_dec128(c, validity, seg, nseg, group_valid,
+                                       buf_dt)
+                    return [_avg_div_dec128(scol, n, buf_dt.scale,
+                                            f.dataType, group_valid)]
+                s, _ = SEG.seg_sum(_sum_input(c, None), validity, seg, nseg)
             nz = n > 0
             if isinstance(f.dataType, T.DecimalType):
                 in_scale = (a.child.dataType.scale
@@ -449,6 +509,11 @@ class TpuHashAggregateExec(TpuExec):
         c = self._input_col(a, ctx, perm)
         validity = c.validity & mask_sorted
         if func == "sum":
+            if (isinstance(f.dataType, T.DecimalType)
+                    and (f.dataType.is_128 or c.is_dec128)):
+                out.append(_sum_dec128(c, validity, seg, nseg, group_valid,
+                                       f.dataType))
+                return out
             s, has = SEG.seg_sum(_sum_input(c, f.dataType), validity, seg, nseg)
             out.append(DeviceColumn(f.dataType, group_valid & has,
                                     data=s.astype(T.storage_dtype(f.dataType))))
@@ -458,6 +523,9 @@ class TpuHashAggregateExec(TpuExec):
             if c.is_string:
                 return [self._minmax_string(c, func, seg, validity, cap,
                                             group_valid, f, nseg)]
+            if c.is_dec128:
+                return [_minmax_dec128(c, func, seg, validity, nseg,
+                                       group_valid, f)]
             fn = SEG.seg_min if func == "min" else SEG.seg_max
             m, has = fn(c.data, validity, seg, nseg, isf)
             out.append(DeviceColumn(f.dataType, group_valid & has,
@@ -545,10 +613,11 @@ class TpuHashAggregateExec(TpuExec):
                             chars=g.chars, lengths=g.lengths)
 
     # -- global (no grouping keys) -------------------------------------
-    def _global_agg(self, ctx, batch):
+    def _global_agg(self, ctx, batch, mask=None):
         """No grouping keys: a single-segment reduction (XLA lowers this to
         a plain tree-reduce; no sort, no scatter)."""
-        mask = batch.row_mask
+        if mask is None:
+            mask = batch.row_mask
         perm = None  # no sort needed for a single segment
         seg = jnp.where(mask, 0, 1).astype(jnp.int32)  # padding dropped
         group_valid = jnp.ones(1, jnp.bool_)
@@ -564,6 +633,85 @@ def _sum_input(c: DeviceColumn, out_dtype):
     if _is_float(c.dtype) or (out_dtype is not None and _is_float(out_dtype)):
         return c.data.astype(jnp.float64)
     return c.data.astype(jnp.int64)
+
+
+def _sum_dec128(c: DeviceColumn, validity, seg, nseg, group_valid,
+                dt: T.DecimalType) -> DeviceColumn:
+    """sum over a decimal column into a >18-digit result: exact 128-bit limb
+    sums; overflow past 10^precision yields NULL (Spark nullOnOverflow).
+
+    Reference analog: GpuSum's DECIMAL128 buffer (GpuAggregateExec.scala) +
+    decimal_utils.cu overflow checks."""
+    from spark_rapids_tpu.expr import decimal128 as D
+
+    hi, lo = D.column_limbs(c)
+    ok, has, sh, sl = D.sum128_segments(hi, lo, validity, seg, nseg)
+    ok = ok & D.in_bounds(sh, sl, dt.precision)
+    data = D.pack(sh, sl) if dt.is_128 else sl
+    return DeviceColumn(dt, group_valid & has & ok, data=data)
+
+
+def _avg_div_dec128(scol: DeviceColumn, n, in_scale: int,
+                    dt: T.DecimalType, group_valid) -> DeviceColumn:
+    """Finalize decimal avg from a 128-bit sum buffer: sum/count with
+    HALF_UP at the result scale (Spark Average.evaluateExpression).
+
+    Exact integer path: q, r = divmod(|sum|, count); result =
+    q*10^shift + round_half_up(r*10^shift / count).  The remainder term
+    stays under 2^31 * 10^4 so it fits int64.  The long division's divisor
+    contract is d < 2^31; FINAL-mode merged counts could exceed it, so such
+    groups yield NULL rather than a silently wrong quotient."""
+    from spark_rapids_tpu.expr import decimal128 as D
+
+    sh, sl = D.column_limbs(scol)
+    nz = n > 0
+    n_ok = n < jnp.int64(2 ** 31)
+    d = jnp.where(nz & n_ok, n, 1)
+    neg = D.is_neg(sh, sl)
+    uh, ul = D.abs128(sh, sl)
+    qh, ql, rem = D.udivmod128_by_u32(uh, ul, d)
+    shift = dt.scale - in_scale            # in [0, 4]
+    over, qh, ql = D.mul128_pow10(qh, ql, shift)
+    p10 = 10 ** max(shift, 0)
+    num = rem * p10
+    eq = num // d
+    er = num - eq * d
+    eq = eq + ((2 * er) >= d).astype(jnp.int64)
+    qh, ql = D.add128(qh, ql, *D.from64(eq))
+    ok = D.in_bounds(qh, ql, dt.precision) & ~over
+    rh, rl = D.neg128(qh, ql)
+    hi = jnp.where(neg, rh, qh)
+    lo = jnp.where(neg, rl, ql)
+    data = D.pack(hi, lo) if dt.is_128 else lo
+    return DeviceColumn(dt, group_valid & nz & n_ok & ok & scol.validity,
+                        data=data)
+
+
+def _minmax_dec128(c: DeviceColumn, func, seg, validity, nseg,
+                   group_valid, f) -> DeviceColumn:
+    """min/max on decimal128: lexicographic two-word reduction.
+
+    First reduce the high word; then reduce the low word among rows whose
+    high word hit the optimum — two segment_min passes, no sort."""
+    from spark_rapids_tpu.expr import decimal128 as D
+
+    hi, lo = D.unpack(c.data)
+    kh, kl = D.key_words(hi, lo)
+    if func == "max":
+        kh, kl = ~kh, ~kl
+    big = jnp.int64(9223372036854775807)
+    kh_m = jnp.where(validity, kh, big)
+    mh = SEG._seg_min_raw(kh_m, seg, nseg)
+    tie = validity & (kh_m == (mh[seg] if nseg > 1 else mh[0]))
+    kl_m = jnp.where(tie, kl, big)
+    ml = SEG._seg_min_raw(kl_m, seg, nseg)
+    has = SEG._seg_isum(validity.astype(jnp.int32), seg, nseg) > 0
+    if func == "max":
+        mh, ml = ~mh, ~ml
+    out_hi = mh
+    out_lo = ml ^ jnp.int64(-0x8000000000000000)
+    return DeviceColumn(f.dataType, group_valid & has,
+                        data=D.pack(out_hi, out_lo))
 
 
 def _chan_merge(cn: DeviceColumn, ca: DeviceColumn, cm: DeviceColumn,

@@ -130,8 +130,17 @@ class TpuShuffleExchangeExec(TpuExec):
 
         Partition boundaries are preserved in output order so downstream
         per-partition operators see real reduce partitions."""
+        from spark_rapids_tpu.plan.nodes import SinglePartitioning
         from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
 
+        if isinstance(self.partitioning, SinglePartitioning):
+            # device-resident pipe: a single reduce partition receives every
+            # map output in order, so the exchange is an identity over the
+            # child's batches — no serialize/deserialize round trip (the
+            # degenerate case of ICI shuffle mode 2's device-resident design)
+            for b in self.children[0].execute_columnar():
+                yield self._count_output(b)
+            return
         mgr = get_shuffle_manager(self.conf)
         shuffle_id = mgr.register_shuffle()
         try:

@@ -125,6 +125,13 @@ def _decimal_bound_check(ctx, data, dt: T.DecimalType, validity, ansi: bool,
     return validity & bound_ok
 
 
+def _dec_limbs(c: DeviceColumn):
+    """Any decimal column -> (hi, lo) limb pair."""
+    from spark_rapids_tpu.expr.decimal128 import column_limbs
+
+    return column_limbs(c)
+
+
 class Add(BinaryArithmetic):
     symbol = "+"
 
@@ -139,35 +146,50 @@ class Add(BinaryArithmetic):
         p = max(ld.precision - ld.scale, rd.precision - rd.scale) + s + 1
         return T.DecimalType(min(p, 38), s)
 
+    _dec_sign = 1
+
     def _eval_decimal(self, ctx, l, r, validity):
         dt: T.DecimalType = self.dataType
         lt: T.DecimalType = self.left.dataType
         rt: T.DecimalType = self.right.dataType
+        op = "add" if self._dec_sign > 0 else "subtract"
+        if dt.is_128 or lt.is_128 or rt.is_128:
+            from spark_rapids_tpu.expr import decimal128 as D
+
+            ah, al = _dec_limbs(l)
+            bh, bl = _dec_limbs(r)
+            oa, ah, al = D.mul128_pow10(ah, al, dt.scale - lt.scale)
+            ob, bh, bl = D.mul128_pow10(bh, bl, dt.scale - rt.scale)
+            if self._dec_sign < 0:
+                bh, bl = D.neg128(bh, bl)
+            rh, rl = D.add128(ah, al, bh, bl)
+            # signed 128 wrap: same operand signs, different result sign
+            wrap = (ah < 0) == (bh < 0)
+            wrap = wrap & ((rh < 0) != (ah < 0))
+            ok = D.in_bounds(rh, rl, dt.precision) & ~wrap & ~oa & ~ob
+            if ctx.ansi:
+                ctx.add_error(~ok & validity, f"decimal {op} overflow (ANSI)")
+            else:
+                validity = validity & ok
+            data = D.pack(rh, rl) if dt.is_128 else rl
+            return DeviceColumn(dt, validity, data=data)
         a = l.data * _pow10_i64(dt.scale - lt.scale)
         b = r.data * _pow10_i64(dt.scale - rt.scale)
-        data = a + b
-        validity = _decimal_bound_check(ctx, data, dt, validity, ctx.ansi, "add")
+        data = a + b if self._dec_sign > 0 else a - b
+        validity = _decimal_bound_check(ctx, data, dt, validity, ctx.ansi, op)
         return DeviceColumn(dt, validity, data=data)
 
 
 class Subtract(Add):
     symbol = "-"
 
+    _dec_sign = -1
+
     def _op(self, a, b):
         return a - b
 
     def _overflow_flag(self, a, b, res):
         return ((a >= 0) & (b < 0) & (res < 0)) | ((a < 0) & (b > 0) & (res >= 0))
-
-    def _eval_decimal(self, ctx, l, r, validity):
-        dt: T.DecimalType = self.dataType
-        lt: T.DecimalType = self.left.dataType
-        rt: T.DecimalType = self.right.dataType
-        a = l.data * _pow10_i64(dt.scale - lt.scale)
-        b = r.data * _pow10_i64(dt.scale - rt.scale)
-        data = a - b
-        validity = _decimal_bound_check(ctx, data, dt, validity, ctx.ansi, "subtract")
-        return DeviceColumn(dt, validity, data=data)
 
 
 class Multiply(BinaryArithmetic):
@@ -186,6 +208,23 @@ class Multiply(BinaryArithmetic):
 
     def _eval_decimal(self, ctx, l, r, validity):
         dt: T.DecimalType = self.dataType
+        lt: T.DecimalType = self.left.dataType
+        rt: T.DecimalType = self.right.dataType
+        if lt.is_128 or rt.is_128:
+            # 128x128 -> 256-bit intermediates; rejected at tag time
+            # (overrides _check_decimal_mult), mirroring the reference's
+            # DECIMAL128 ceiling in GpuDecimalMultiply.
+            raise NotImplementedError("decimal multiply operands > 18 digits")
+        if dt.is_128:
+            from spark_rapids_tpu.expr import decimal128 as D
+
+            rh, rl = D.mul64_to_128(l.data, r.data)   # exact, cannot wrap
+            ok = D.in_bounds(rh, rl, dt.precision)
+            if ctx.ansi:
+                ctx.add_error(~ok & validity, "decimal multiply overflow (ANSI)")
+            else:
+                validity = validity & ok
+            return DeviceColumn(dt, validity, data=D.pack(rh, rl))
         data = l.data * r.data
         # int64 intermediate overflow detection via float magnitude estimate
         approx = l.data.astype(jnp.float64) * r.data.astype(jnp.float64)

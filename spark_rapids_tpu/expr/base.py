@@ -347,6 +347,9 @@ class Literal(Expression):
                 return DeviceColumn(dt, validity,
                                     chars=jnp.zeros((cap, 8), jnp.uint8),
                                     lengths=jnp.zeros(cap, jnp.int32))
+            if isinstance(dt, T.DecimalType) and dt.is_128:
+                return DeviceColumn(dt, validity,
+                                    data=jnp.zeros((cap, 2), jnp.int64))
             sdt = T.storage_dtype(dt) if not isinstance(dt, T.NullType) else np.int32
             return DeviceColumn(dt, validity, data=jnp.zeros(cap, sdt))
         validity = jnp.ones(cap, jnp.bool_)
@@ -359,6 +362,12 @@ class Literal(Expression):
             return DeviceColumn(dt, validity, chars=chars,
                                 lengths=jnp.full(cap, len(b), jnp.int32))
         sdt = T.storage_dtype(dt)
+        if isinstance(dt, T.DecimalType) and dt.is_128:
+            from spark_rapids_tpu.expr.decimal128 import limbs_of
+
+            hi, lo = limbs_of(int(self.storage_value()))
+            return DeviceColumn(dt, validity, data=jnp.broadcast_to(
+                jnp.asarray([hi, lo], jnp.int64), (cap, 2)))
         return DeviceColumn(dt, validity,
                             data=jnp.full(cap, self.storage_value(), sdt))
 

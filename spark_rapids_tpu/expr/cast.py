@@ -194,19 +194,70 @@ def _dec_rescale(ctx, data, validity, from_scale, to: T.DecimalType, ansi, op):
     return out, validity
 
 
+def _dec128_rescale(ctx, hi, lo, validity, from_scale, dst: T.DecimalType,
+                    ansi, op):
+    """(hi, lo) at from_scale -> dst scale/precision; 128-bit limb path."""
+    from spark_rapids_tpu.expr import decimal128 as D
+
+    diff = dst.scale - from_scale
+    over = jnp.zeros_like(validity)
+    if diff >= 0:
+        over, hi, lo = D.mul128_pow10(hi, lo, diff)
+    else:
+        hi, lo = D.div128_pow10_half_up(hi, lo, -diff)
+    ok = D.in_bounds(hi, lo, dst.precision) & ~over
+    if ansi:
+        ctx.add_error(~ok & validity, f"decimal {op} overflow (ANSI)")
+    else:
+        validity = validity & ok
+    return hi, lo, validity
+
+
 def _dec_to_dec(ctx, c, src: T.DecimalType, dst: T.DecimalType, ansi):
-    data, validity = _dec_rescale(ctx, c.data, c.validity, src.scale, dst,
-                                  ansi, "cast")
-    return DeviceColumn(dst, validity, data=data)
+    if not src.is_128 and not dst.is_128:
+        data, validity = _dec_rescale(ctx, c.data, c.validity, src.scale, dst,
+                                      ansi, "cast")
+        return DeviceColumn(dst, validity, data=data)
+    from spark_rapids_tpu.expr import decimal128 as D
+
+    hi, lo = D.column_limbs(c)
+    hi, lo, validity = _dec128_rescale(ctx, hi, lo, c.validity, src.scale,
+                                       dst, ansi, "cast")
+    if dst.is_128:
+        return DeviceColumn(dst, validity, data=D.pack(hi, lo))
+    # narrowing: bound check guarantees |v| < 10^18, so lo IS the value
+    return DeviceColumn(dst, validity, data=lo)
 
 
 def _int_to_dec(ctx, c, src, dst: T.DecimalType, ansi):
+    if dst.is_128:
+        from spark_rapids_tpu.expr import decimal128 as D
+
+        hi, lo = D.from64(c.data.astype(jnp.int64))
+        hi, lo, validity = _dec128_rescale(ctx, hi, lo, c.validity, 0, dst,
+                                           ansi, "cast")
+        return DeviceColumn(dst, validity, data=D.pack(hi, lo))
     data, validity = _dec_rescale(ctx, c.data.astype(jnp.int64), c.validity, 0,
                                   dst, ansi, "cast")
     return DeviceColumn(dst, validity, data=data)
 
 
 def _dec_to_int(ctx, c, src: T.DecimalType, dst, ansi):
+    if src.is_128:
+        from spark_rapids_tpu.expr import decimal128 as D
+
+        hi, lo = D.unpack(c.data)
+        qh, ql = D.div128_pow10_trunc(hi, lo, src.scale)
+        fits64 = (qh == (ql >> 63))      # pure sign extension
+        mn, mx = _I_MIN[type(dst)], _I_MAX[type(dst)]
+        bad = ~fits64 | (ql < mn) | (ql > mx)
+        if ansi:
+            ctx.add_error(bad & c.validity, f"cast overflow to {dst} (ANSI)")
+            validity = c.validity
+        else:
+            validity = c.validity & ~bad
+        return DeviceColumn(dst, validity,
+                            data=ql.astype(T.storage_dtype(dst)))
     den = _p10(src.scale)
     q = c.data // den
     rem = c.data - q * den
@@ -223,6 +274,16 @@ def _dec_to_int(ctx, c, src: T.DecimalType, dst, ansi):
 
 
 def _dec_to_fp(ctx, c, src: T.DecimalType, dst, ansi):
+    if src.is_128:
+        from spark_rapids_tpu.expr import decimal128 as D
+
+        hi, lo = D.unpack(c.data)
+        lo_f = lo.astype(jnp.float64)
+        lo_u = jnp.where(lo < 0, lo_f + 18446744073709551616.0, lo_f)
+        val = hi.astype(jnp.float64) * 18446744073709551616.0 + lo_u
+        data = val / (10.0 ** src.scale)
+        return DeviceColumn(dst, c.validity,
+                            data=data.astype(T.storage_dtype(dst)))
     data = c.data.astype(jnp.float64) / float(_p10(src.scale))
     return DeviceColumn(dst, c.validity,
                         data=data.astype(T.storage_dtype(dst)))

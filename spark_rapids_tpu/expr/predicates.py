@@ -107,6 +107,13 @@ class BinaryComparison(BinaryExpression):
         if l.is_string:
             lt, eq = string_compare(l, r)
             data = self._from_lt_eq(lt, eq)
+        elif l.is_dec128:
+            from spark_rapids_tpu.expr import decimal128 as D
+
+            ah, al = D.unpack(l.data)
+            bh, bl = D.unpack(r.data)
+            data = self._from_lt_eq(D.lt128(ah, al, bh, bl),
+                                    D.eq128(ah, al, bh, bl))
         else:
             data = self._cmp(l.data, r.data)
         return DeviceColumn(T.BOOLEAN, validity, data=data)
@@ -183,6 +190,8 @@ class EqualNullSafe(BinaryComparison):
         both_null = ~l.validity & ~r.validity
         if l.is_string:
             _, eq = string_compare(l, r)
+        elif l.is_dec128:
+            eq = jnp.all(l.data == r.data, axis=-1)
         else:
             eq = l.data == r.data
         data = (both_valid & eq) | both_null
@@ -293,6 +302,29 @@ class In(Expression):
         return f"({self.children[0].sql_string()} IN ({cands}))"
 
     def _resolve_type(self):
+        # coerce every candidate to a common comparable type with the value
+        # (Spark's ImplicitTypeCasts; without this a decimal128 column would
+        # compare raw unscaled limbs against differently-scaled candidates)
+        from spark_rapids_tpu.expr.base import Literal
+
+        value = self.children[0]
+        new_cands = []
+        for c in self.children[1:]:
+            if isinstance(c, Literal) and c.value is None:
+                new_cands.append(c)
+                continue
+            value, c2 = _coerce_comparison(value, c)
+            new_cands.append(c2)
+        # a late value-side promotion must be re-applied to earlier candidates
+        final = []
+        for c in new_cands:
+            if (isinstance(c, Literal) and c.value is None) \
+                    or c.dataType == value.dataType:
+                final.append(c)
+            else:
+                _, c2 = _coerce_comparison(value, c)
+                final.append(c2)
+        self.children = [value] + final
         self._dataType = T.BOOLEAN
         self._nullable = True
 
@@ -311,6 +343,8 @@ class In(Expression):
                 continue
             if v.is_string:
                 _, eq = string_compare(v, c)
+            elif v.is_dec128:
+                eq = jnp.all(v.data == c.data, axis=-1)
             else:
                 eq = v.data == c.data
             any_match = any_match | (eq & c.validity)

@@ -19,6 +19,10 @@ import jax.numpy as jnp
 
 def seg_sum(values, validity, seg_ids, num_segments: int):
     contrib = jnp.where(validity, values, jnp.zeros_like(values))
+    if num_segments == 1:
+        # global reduction: plain tree-reduce, no scatter
+        return (jnp.sum(contrib, keepdims=True),
+                jnp.sum(validity.astype(jnp.int64), keepdims=True) > 0)
     s = jax.ops.segment_sum(contrib, seg_ids, num_segments=num_segments)
     cnt = jax.ops.segment_sum(validity.astype(jnp.int64), seg_ids,
                               num_segments=num_segments)
@@ -26,8 +30,28 @@ def seg_sum(values, validity, seg_ids, num_segments: int):
 
 
 def seg_count(validity, seg_ids, num_segments: int):
+    if num_segments == 1:
+        return jnp.sum(validity.astype(jnp.int64), keepdims=True)
     return jax.ops.segment_sum(validity.astype(jnp.int64), seg_ids,
                                num_segments=num_segments)
+
+
+def _seg_min_raw(v, seg_ids, num_segments: int):
+    if num_segments == 1:
+        return jnp.min(v, keepdims=True)
+    return jax.ops.segment_min(v, seg_ids, num_segments=num_segments)
+
+
+def _seg_max_raw(v, seg_ids, num_segments: int):
+    if num_segments == 1:
+        return jnp.max(v, keepdims=True)
+    return jax.ops.segment_max(v, seg_ids, num_segments=num_segments)
+
+
+def _seg_isum(v, seg_ids, num_segments: int):
+    if num_segments == 1:
+        return jnp.sum(v, keepdims=True)
+    return jax.ops.segment_sum(v, seg_ids, num_segments=num_segments)
 
 
 def seg_min(values, validity, seg_ids, num_segments: int, is_float: bool):
@@ -35,27 +59,25 @@ def seg_min(values, validity, seg_ids, num_segments: int, is_float: bool):
         nan = jnp.isnan(values)
         big = jnp.asarray(jnp.inf, values.dtype)
         v = jnp.where(validity & ~nan, values, big)
-        m = jax.ops.segment_min(v, seg_ids, num_segments=num_segments)
-        valid_nonnan = jax.ops.segment_sum(
-            (validity & ~nan).astype(jnp.int32), seg_ids,
-            num_segments=num_segments) > 0
-        any_valid = jax.ops.segment_sum(
-            validity.astype(jnp.int32), seg_ids,
-            num_segments=num_segments) > 0
+        m = _seg_min_raw(v, seg_ids, num_segments)
+        valid_nonnan = _seg_isum(
+            (validity & ~nan).astype(jnp.int32), seg_ids, num_segments) > 0
+        any_valid = _seg_isum(
+            validity.astype(jnp.int32), seg_ids, num_segments) > 0
         # all-NaN group -> NaN (NaN is greatest, min falls back to NaN
         # only when nothing else exists)
         m = jnp.where(valid_nonnan, m, jnp.asarray(jnp.nan, values.dtype))
         return m, any_valid
     if values.dtype == jnp.bool_:
         v = jnp.where(validity, values, True)
-        m = jax.ops.segment_min(v.astype(jnp.int32), seg_ids,
-                                num_segments=num_segments).astype(jnp.bool_)
+        m = _seg_min_raw(v.astype(jnp.int32), seg_ids,
+                         num_segments).astype(jnp.bool_)
     else:
         big = jnp.asarray(jnp.iinfo(values.dtype).max, values.dtype)
         v = jnp.where(validity, values, big)
-        m = jax.ops.segment_min(v, seg_ids, num_segments=num_segments)
-    any_valid = jax.ops.segment_sum(validity.astype(jnp.int32), seg_ids,
-                                    num_segments=num_segments) > 0
+        m = _seg_min_raw(v, seg_ids, num_segments)
+    any_valid = _seg_isum(validity.astype(jnp.int32), seg_ids,
+                          num_segments) > 0
     return m, any_valid
 
 
@@ -64,25 +86,23 @@ def seg_max(values, validity, seg_ids, num_segments: int, is_float: bool):
         nan = jnp.isnan(values)
         small = jnp.asarray(-jnp.inf, values.dtype)
         v = jnp.where(validity & ~nan, values, small)
-        m = jax.ops.segment_max(v, seg_ids, num_segments=num_segments)
-        has_nan = jax.ops.segment_sum(
-            (validity & nan).astype(jnp.int32), seg_ids,
-            num_segments=num_segments) > 0
-        any_valid = jax.ops.segment_sum(
-            validity.astype(jnp.int32), seg_ids,
-            num_segments=num_segments) > 0
+        m = _seg_max_raw(v, seg_ids, num_segments)
+        has_nan = _seg_isum(
+            (validity & nan).astype(jnp.int32), seg_ids, num_segments) > 0
+        any_valid = _seg_isum(
+            validity.astype(jnp.int32), seg_ids, num_segments) > 0
         m = jnp.where(has_nan, jnp.asarray(jnp.nan, values.dtype), m)
         return m, any_valid
     if values.dtype == jnp.bool_:
         v = jnp.where(validity, values, False)
-        m = jax.ops.segment_max(v.astype(jnp.int32), seg_ids,
-                                num_segments=num_segments).astype(jnp.bool_)
+        m = _seg_max_raw(v.astype(jnp.int32), seg_ids,
+                         num_segments).astype(jnp.bool_)
     else:
         small = jnp.asarray(jnp.iinfo(values.dtype).min, values.dtype)
         v = jnp.where(validity, values, small)
-        m = jax.ops.segment_max(v, seg_ids, num_segments=num_segments)
-    any_valid = jax.ops.segment_sum(validity.astype(jnp.int32), seg_ids,
-                                    num_segments=num_segments) > 0
+        m = _seg_max_raw(v, seg_ids, num_segments)
+    any_valid = _seg_isum(validity.astype(jnp.int32), seg_ids,
+                          num_segments) > 0
     return m, any_valid
 
 

@@ -82,6 +82,11 @@ def _column_key_words(c: DeviceColumn) -> List[jax.Array]:
         return [_float_total_order(bits)]
     if isinstance(dt, T.BooleanType):
         return [c.data.astype(jnp.int64)]
+    if isinstance(dt, T.DecimalType) and dt.is_128:
+        from spark_rapids_tpu.expr.decimal128 import key_words, unpack
+
+        hi, lo = unpack(c.data)
+        return list(key_words(hi, lo))
     return [c.data.astype(jnp.int64)]
 
 

@@ -54,7 +54,40 @@ class ExecRule:
 _COMMON = (T.BOOLEAN_SIG + T.numeric + T.STRING_SIG + T.DATETIME_SIG
            + T.NULL_SIG)
 _COMMON128 = _COMMON + T.DECIMAL_128_SIG.with_max_decimal(18)
+# full 38-digit decimals (two-limb device columns, expr/decimal128.py)
+_DEC128_FULL = _COMMON + T.DECIMAL_128_SIG
 _NUM = T.numeric + T.NULL_SIG
+_NUM128 = _NUM + T.DECIMAL_128_SIG
+
+
+def _check_decimal_mult(meta: ExprMeta):
+    """128x128 multiply needs 256-bit intermediates (reference caps at
+    DECIMAL128 via decimal_utils.cu); operands above 18 digits fall back."""
+    e = meta.expr
+    for side in (e.left, e.right):
+        dt = side._dataType
+        if isinstance(dt, T.DecimalType) and dt.precision > 18:
+            meta.will_not_work_on_tpu(
+                "decimal multiply with an operand above 18 digits is not "
+                "supported on TPU (needs 256-bit intermediates)")
+
+
+def _check_decimal_addsub(meta: ExprMeta):
+    """Reject results that Spark would rescale with precision loss (we only
+    implement the exact <=38-digit path)."""
+    e = meta.expr
+    lt, rt = e.left._dataType, e.right._dataType
+    if isinstance(lt, T.DecimalType) and isinstance(rt, T.DecimalType):
+        s = max(lt.scale, rt.scale)
+        p = max(lt.precision - lt.scale, rt.precision - rt.scale) + s + 1
+        if p > 38:
+            meta.will_not_work_on_tpu(
+                "decimal add/subtract result exceeds 38 digits "
+                "(precision-loss rescale not implemented on TPU)")
+
+
+def _is_dec128(dt) -> bool:
+    return isinstance(dt, T.DecimalType) and dt.precision > 18
 
 
 def _check_cast(meta: ExprMeta):
@@ -66,6 +99,26 @@ def _check_cast(meta: ExprMeta):
         meta.will_not_work_on_tpu(
             f"cast from {src.simpleString} to {e.to.simpleString} is not "
             f"supported on TPU")
+    if _is_dec128(src) or _is_dec128(e.to):
+        # decimal128 limb paths implemented: dec<->dec, int->dec, dec->int,
+        # dec->fp.  Everything else (string/fp->dec128, dec128->string)
+        # falls back (reference: CastStrings 128-bit kernels, cast_string.cu)
+        def kindof(t):
+            if isinstance(t, T.DecimalType):
+                return "dec"
+            if isinstance(t, (T.ByteType, T.ShortType, T.IntegerType,
+                              T.LongType)):
+                return "int"
+            if isinstance(t, (T.FloatType, T.DoubleType)):
+                return "fp"
+            return "other"
+
+        pair = (kindof(src), kindof(e.to))
+        if pair not in {("dec", "dec"), ("int", "dec"), ("dec", "int"),
+                        ("dec", "fp")}:
+            meta.will_not_work_on_tpu(
+                f"cast {src.simpleString} -> {e.to.simpleString} above 18 "
+                f"decimal digits is not supported on TPU")
     if isinstance(src, T.StringType) and isinstance(e.to, T.TimestampType):
         if not meta.conf.get(ENABLE_CAST_STRING_TO_TIMESTAMP):
             meta.will_not_work_on_tpu(
@@ -126,32 +179,34 @@ def _check_pad(meta: ExprMeta):
 
 
 EXPRESSIONS: Dict[Type, ExprRule] = {
-    E.Literal: ExprRule(_COMMON128, desc="constant literal"),
-    E.BoundReference: ExprRule(_COMMON128, desc="column reference"),
-    E.AttributeReference: ExprRule(_COMMON128, desc="column reference"),
-    E.Alias: ExprRule(_COMMON128, desc="alias"),
-    A.Add: ExprRule(_NUM), A.Subtract: ExprRule(_NUM),
-    A.Multiply: ExprRule(_NUM), A.Divide: ExprRule(_NUM),
+    E.Literal: ExprRule(_DEC128_FULL, desc="constant literal"),
+    E.BoundReference: ExprRule(_DEC128_FULL, desc="column reference"),
+    E.AttributeReference: ExprRule(_DEC128_FULL, desc="column reference"),
+    E.Alias: ExprRule(_DEC128_FULL, desc="alias"),
+    A.Add: ExprRule(_NUM128, extra_check=_check_decimal_addsub),
+    A.Subtract: ExprRule(_NUM128, extra_check=_check_decimal_addsub),
+    A.Multiply: ExprRule(_NUM128, extra_check=_check_decimal_mult),
+    A.Divide: ExprRule(_NUM),
     A.IntegralDivide: ExprRule(_NUM), A.Remainder: ExprRule(_NUM),
     A.Pmod: ExprRule(_NUM), A.UnaryMinus: ExprRule(_NUM),
     A.Abs: ExprRule(_NUM),
-    P.EqualTo: ExprRule(_COMMON128), P.LessThan: ExprRule(_COMMON128),
-    P.LessThanOrEqual: ExprRule(_COMMON128),
-    P.GreaterThan: ExprRule(_COMMON128),
-    P.GreaterThanOrEqual: ExprRule(_COMMON128),
-    P.EqualNullSafe: ExprRule(_COMMON128),
+    P.EqualTo: ExprRule(_DEC128_FULL), P.LessThan: ExprRule(_DEC128_FULL),
+    P.LessThanOrEqual: ExprRule(_DEC128_FULL),
+    P.GreaterThan: ExprRule(_DEC128_FULL),
+    P.GreaterThanOrEqual: ExprRule(_DEC128_FULL),
+    P.EqualNullSafe: ExprRule(_DEC128_FULL),
     P.And: ExprRule(T.BOOLEAN_SIG + T.NULL_SIG),
     P.Or: ExprRule(T.BOOLEAN_SIG + T.NULL_SIG),
     P.Not: ExprRule(T.BOOLEAN_SIG + T.NULL_SIG),
-    P.IsNull: ExprRule(_COMMON128), P.IsNotNull: ExprRule(_COMMON128),
+    P.IsNull: ExprRule(_DEC128_FULL), P.IsNotNull: ExprRule(_DEC128_FULL),
     P.IsNaN: ExprRule(T.FP_SIG + T.BOOLEAN_SIG),
-    P.In: ExprRule(_COMMON128),
+    P.In: ExprRule(_DEC128_FULL),
     CO.If: ExprRule(_COMMON128), CO.CaseWhen: ExprRule(_COMMON128),
     CO.Coalesce: ExprRule(_COMMON128), CO.Nvl: ExprRule(_COMMON128),
     CO.NaNvl: ExprRule(T.FP_SIG),
     CO.Greatest: ExprRule(_NUM + T.STRING_SIG),
     CO.Least: ExprRule(_NUM + T.STRING_SIG),
-    C.Cast: ExprRule(_COMMON128, extra_check=_check_cast),
+    C.Cast: ExprRule(_DEC128_FULL, extra_check=_check_cast),
     M.Sqrt: ExprRule(_NUM), M.Exp: ExprRule(_NUM), M.Log: ExprRule(_NUM),
     M.Log10: ExprRule(_NUM), M.Sin: ExprRule(_NUM), M.Cos: ExprRule(_NUM),
     M.Tan: ExprRule(_NUM), M.Asin: ExprRule(_NUM), M.Acos: ExprRule(_NUM),
@@ -243,6 +298,12 @@ def _agg_check(meta: SparkPlanMeta):
         if a.distinct:
             meta.will_not_work_on_tpu(
                 "distinct aggregates are not supported on TPU yet")
+        if (a.func in ("avg", "var_pop", "var_samp", "stddev_pop",
+                       "stddev_samp")
+                and a.child is not None and _is_dec128(a.child._dataType)):
+            meta.will_not_work_on_tpu(
+                f"{a.func} over decimals above 18 digits needs 128-bit "
+                f"division; not supported on TPU yet")
 
 
 def _join_check(meta: SparkPlanMeta):
@@ -324,9 +385,19 @@ def _exprs_of(plan) -> List[E.Expression]:
 EXECS: Dict[Type, ExecRule] = {}
 
 
-def _exec(cls, sig=_COMMON128, tag_exprs=_exprs_of, extra=None, desc=""):
+def _exec(cls, sig=_DEC128_FULL, tag_exprs=_exprs_of, extra=None, desc=""):
     EXECS[cls] = ExecRule(sig, tag_exprs=tag_exprs, extra_check=extra,
                           desc=desc)
+
+
+def _exchange_check(meta: SparkPlanMeta):
+    plan: PN.Exchange = meta.plan
+    if isinstance(plan.partitioning, PN.HashPartitioning):
+        for k in plan.partitioning.keys:
+            if _is_dec128(k._dataType):
+                meta.will_not_work_on_tpu(
+                    "hash partitioning on decimals above 18 digits is not "
+                    "supported on TPU (murmur3 big-integer path missing)")
 
 
 _exec(PN.LocalTableScan)
@@ -342,8 +413,8 @@ _exec(PN.SortMergeJoin, extra=_join_check,
 _exec(PN.ShuffledHashJoin, extra=_join_check)
 _exec(PN.BroadcastHashJoin, extra=_join_check)
 _exec(PN.Sort)
-_exec(PN.Window, extra=_window_check)
-_exec(PN.Exchange)
+_exec(PN.Window, sig=_COMMON128, extra=_window_check)
+_exec(PN.Exchange, extra=_exchange_check)
 _exec(PN.BroadcastExchange)
 _exec(PN.GlobalLimit)
 _exec(PN.LocalLimit)

@@ -106,6 +106,7 @@ def serialize_batch(batch: ColumnarBatch, codec: Optional[str] = None) -> bytes:
             dbuf = add_buffer(data.tobytes())
             header_cols.append({
                 "kind": "flat", "dtype": data.dtype.str,
+                "trail": list(data.shape[1:]),
                 "validity": vbuf, "data": dbuf})
     header = json.dumps({"num_rows": n, "cols": header_cols}).encode()
     frame = b"".join([MAGIC, struct.pack("<I", len(header)), header]
@@ -146,7 +147,9 @@ def deserialize_concat(blocks: Sequence[bytes], schema: T.StructType,
             lengths = np.zeros(cap, dtype=np.int32)
         else:
             sdt = np.dtype(T.storage_dtype(f.dataType))
-            data = np.zeros(cap, dtype=sdt)
+            trail = tuple(parsed[0][0]["cols"][ci].get("trail", ())
+                          ) if parsed else ()
+            data = np.zeros((cap,) + trail, dtype=sdt)
         row = 0
         for h, body in parsed:
             n = h["num_rows"]
@@ -167,8 +170,10 @@ def deserialize_concat(blocks: Sequence[bytes], schema: T.StructType,
                     ).reshape(n, w)
             else:
                 doff, dlen = col["data"]
+                k = int(np.prod(trail)) if trail else 1
                 data[row: row + n] = np.frombuffer(
-                    body, np.dtype(col["dtype"]), count=n, offset=doff)
+                    body, np.dtype(col["dtype"]), count=n * k, offset=doff
+                ).reshape((n,) + trail)
             row += n
         if is_string:
             out_cols.append(DeviceColumn(

@@ -120,13 +120,16 @@ class FloatGen(DoubleGen):
 
 
 class DecimalGen(DataGen):
-    def __init__(self, precision=10, scale=2, nullable=True):
+    def __init__(self, precision=10, scale=2, nullable=True,
+                 full_range=False):
         super().__init__(T.DecimalType(precision, scale), nullable)
         self.precision, self.scale = precision, scale
+        self.full_range = full_range
 
     def gen_value(self, rng):
-        # keep within precision (and leave headroom for aggregation tests)
-        digits = min(self.precision, 15)
+        # default: leave headroom for aggregation tests; full_range exercises
+        # the whole precision (decimal128 limb paths need >18-digit values)
+        digits = self.precision if self.full_range else min(self.precision, 15)
         unscaled = rng.randint(-(10**digits - 1), 10**digits - 1)
         return Decimal(unscaled).scaleb(-self.scale)
 
