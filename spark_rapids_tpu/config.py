@@ -252,6 +252,13 @@ SHUFFLE_MODE = conf("spark.rapids.shuffle.mode").doc(
     "XLA collectives — replaces the reference's UCX transport), or CACHE_ONLY."
 ).string_conf("MULTITHREADED")
 
+MESH_ENABLED = conf("spark.rapids.tpu.mesh.enabled").doc(
+    "Execute eligible plan stages SPMD over a jax.sharding.Mesh of all "
+    "visible devices.  With shuffle.mode=ICI the partial-agg -> exchange -> "
+    "final-agg stage pair compiles to ONE collective program per batch "
+    "(scan shards rows, all-to-all repartitions by key hash over the "
+    "interconnect).").boolean_conf(False)
+
 SHUFFLE_MT_WRITER_THREADS = conf(
     "spark.rapids.shuffle.multiThreaded.writer.threads").integer_conf(20)
 SHUFFLE_MT_READER_THREADS = conf(

@@ -333,10 +333,14 @@ class TpuHashAggregateExec(TpuExec):
         n = 1 if not self.grouping else int(nrows)
         return ColumnarBatch(list(cols), n, self._output)
 
-    def _agg_fn(self, cols, num_rows):
+    def _agg_fn(self, cols, num_rows, row_valid=None):
         batch = ColumnarBatch(list(cols), num_rows, self.input_schema)
         ctx = EvalContext(batch, ansi=self.ansi)
         mask = batch.row_mask
+        if row_valid is not None:
+            # mesh execution: rows received over the ICI all-to-all carry an
+            # explicit occupancy mask instead of a dense [0, num_rows) prefix
+            mask = mask & row_valid
         for op in self.pre_ops:
             batch, mask = op.apply_masked(ctx, batch, mask)
         ctx.batch = batch

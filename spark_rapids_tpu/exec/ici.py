@@ -1,0 +1,198 @@
+"""ICI mesh execution — whole plan stages as one SPMD collective program.
+
+Reference analog: the reference's distributed execution is Spark tasks
+pulling shuffle blocks peer-to-peer over UCX (SURVEY.md §2.7/§5.8,
+RapidsShuffleClient/Server).  TPU-first replacement: the stage pair
+
+    HashAggregate(FINAL) <- [Coalesce] <- ShuffleExchange <-
+    HashAggregate(PARTIAL, fused scan ops)
+
+compiles to ONE shard_map program over the device mesh:
+
+    per device:  local partial _agg_fn (the unchanged single-chip program)
+              -> spark murmur3 partition ids over the group keys
+              -> all-to-all of every partial-buffer column over ICI
+              -> local final _agg_fn on the received buffer rows
+
+The per-device program IS the single-chip code path — shard_map only wires
+the collectives around it (the "same program, sharded data" SPMD design the
+scaling-book recipe prescribes).  Global (no-key) aggregates skip the
+all-to-all: partial buffers are all-gathered and every device finalizes the
+replicated merge (one row; replication is free).
+
+The Spark-async vs SPMD-collective impedance mismatch (SURVEY.md §7 hard
+part #1) is resolved by epoching: an exchange is already a full barrier in
+Spark semantics, so executing it as one collective step loses no generality.
+
+Current quota layout: the all-to-all reserves local-cap slots per peer
+(received capacity = global cap).  jax.lax.ragged_all_to_all is the planned
+upgrade for skewed partitions.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.exec.base import TpuExec
+
+try:  # jax>=0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+class TpuIciShuffleAggExec(TpuExec):
+    """Fused distributed aggregation stage over a jax Mesh."""
+
+    def __init__(self, partial, final, mesh, axis: str = "dp"):
+        super().__init__(list(partial.children))
+        self.partial = partial
+        self.final = final
+        self.mesh = mesh
+        self.axis = axis
+        self._program = None
+
+    @property
+    def output(self):
+        return self.final.output
+
+    def describe(self):
+        n = self.mesh.devices.size
+        return (f"TpuIciShuffleAgg[{n}dev] partial=({self.partial.describe()})"
+                f" final=({self.final.describe()})")
+
+    # ------------------------------------------------------------------
+    def _build_program(self):
+        axis = self.axis
+        n_dev = int(self.mesh.devices.size)
+        partial = self.partial
+        final = self.final
+        grouped = bool(final.grouping)
+        nkeys = len(partial.grouping)
+
+        def per_device(cols, num_rows):
+            from spark_rapids_tpu.parallel.mesh import ici_all_to_all_columns
+
+            local_cap = cols[0].capacity
+            idx = jax.lax.axis_index(axis)
+            nloc = jnp.clip(num_rows - idx.astype(jnp.int32) * local_cap,
+                            0, local_cap)
+            pcols, ng = partial._agg_fn(cols, nloc)
+            pcols = list(pcols)
+            grows = jnp.arange(pcols[0].capacity) < ng
+            if grouped:
+                from spark_rapids_tpu.ops.hashing import spark_partition_ids
+
+                tgt = spark_partition_ids(pcols[:nkeys], n_dev)
+                rcols, rok = ici_all_to_all_columns(pcols, grows, tgt,
+                                                    n_dev, axis)
+                fcols, fng = final._agg_fn(
+                    tuple(rcols), jnp.int32(rcols[0].capacity), row_valid=rok)
+            else:
+                gathered = []
+                for c in pcols:
+                    validity = jax.lax.all_gather(c.validity, axis, tiled=True)
+                    if c.is_string:
+                        gathered.append(DeviceColumn(
+                            c.dtype, validity,
+                            chars=jax.lax.all_gather(c.chars, axis, tiled=True),
+                            lengths=jax.lax.all_gather(c.lengths, axis,
+                                                       tiled=True)))
+                    else:
+                        gathered.append(DeviceColumn(
+                            c.dtype, validity,
+                            data=jax.lax.all_gather(c.data, axis, tiled=True)))
+                rok = jax.lax.all_gather(grows, axis, tiled=True)
+                fcols, fng = final._agg_fn(
+                    tuple(gathered), jnp.int32(gathered[0].capacity),
+                    row_valid=rok)
+            return tuple(fcols), fng.reshape(1)
+
+        out_spec = P(axis) if grouped else P()
+        return shard_map(
+            per_device, mesh=self.mesh,
+            in_specs=(P(axis), P()),
+            out_specs=(out_spec, out_spec),
+            check_vma=False)
+
+    # ------------------------------------------------------------------
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        n_dev = int(self.mesh.devices.size)
+        batches = list(self.children[0].execute_columnar())
+        if not batches:
+            batches = [None]
+        with self.metrics["opTime"].timed():
+            batch = (ColumnarBatch.concat(batches)
+                     if batches[0] is not None and len(batches) > 1
+                     else batches[0])
+            if batch is None or batch.num_rows == 0:
+                yield from self._empty_input()
+                return
+            cap = batch.capacity
+            if cap % n_dev or cap < n_dev:
+                batch = ColumnarBatch(
+                    [c.slice_to(-(-cap // n_dev) * n_dev)
+                     for c in batch.columns], batch.num_rows, batch.schema)
+            sharded = self._shard_batch(batch)
+            if self._program is None:
+                self._program = self._build_program()
+            fcols, fng = self._program(tuple(sharded),
+                                       jnp.int32(batch.num_rows))
+            fng_np = np.asarray(fng)          # one host sync
+        out_schema = self.final.output
+        if not self.final.grouping:
+            yield self._count_output(
+                ColumnarBatch([c.gather(jnp.arange(1)) for c in fcols],
+                              1, out_schema))
+            return
+        per_dev_cap = fcols[0].capacity // n_dev
+        for d in range(n_dev):
+            ng = int(fng_np[d])
+            if ng == 0:
+                continue
+            lo = d * per_dev_cap
+            cols = [
+                DeviceColumn(c.dtype,
+                             c.validity[lo: lo + per_dev_cap],
+                             data=None if c.data is None
+                             else c.data[lo: lo + per_dev_cap],
+                             chars=None if c.chars is None
+                             else c.chars[lo: lo + per_dev_cap],
+                             lengths=None if c.lengths is None
+                             else c.lengths[lo: lo + per_dev_cap])
+                for c in fcols]
+            yield self._count_output(
+                ColumnarBatch(cols, ng, out_schema))
+
+    def _shard_batch(self, batch: ColumnarBatch) -> List[DeviceColumn]:
+        """Row-shard every column array over the mesh axis."""
+        def put(arr):
+            if arr is None:
+                return None
+            spec = P(self.axis) if arr.ndim >= 1 else P()
+            return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+        return [DeviceColumn(c.dtype, put(c.validity), data=put(c.data),
+                             chars=put(c.chars), lengths=put(c.lengths),
+                             elem_valid=put(c.elem_valid))
+                for c in batch.columns]
+
+    def _empty_input(self):
+        """Empty scan: reproduce the single-chip chain's semantics — the
+        partial emits its initial buffer row (global agg) which the final
+        merges and finalizes; grouped aggregates emit nothing."""
+        from spark_rapids_tpu.columnar.batch import empty_batch
+
+        if self.final.grouping:
+            yield self._count_output(empty_batch(self.final.output))
+            return
+        pb = self.partial._global_agg_empty()
+        merged = self.final._merge_batch(pb)
+        yield self._count_output(self.final._finalize(merged))

@@ -231,10 +231,12 @@ def div128_pow10_half_up(h, l, k: int) -> Tuple[jax.Array, jax.Array]:
     # exact remainder in 128 bits: rem = |v| - q * 10^k
     _, qph, qpl = mul128_pow10(uh, ul, k)
     rem_h, rem_l = sub128(uh0, ul0, qph, qpl)
-    # HALF_UP: round away from zero when 2*rem >= 10^k
-    th, tl = add128(rem_h, rem_l, rem_h, rem_l)
-    bh_, bl_ = limbs_of(10 ** k)
-    round_up = ~lt128(th, tl, jnp.full_like(h, bh_), jnp.full_like(l, bl_))
+    # HALF_UP: round away from zero when rem >= 10^k / 2 (comparing against
+    # the halved divisor instead of doubling rem, which would overflow
+    # signed 128 bits at k=38)
+    bh_, bl_ = limbs_of(10 ** k // 2)
+    round_up = ~lt128(rem_h, rem_l, jnp.full_like(h, bh_),
+                      jnp.full_like(l, bl_))
     one = round_up.astype(jnp.int64)
     uh, ul = add128(uh, ul, jnp.zeros_like(h), one)
     rh, rl = neg128(uh, ul)

@@ -19,8 +19,10 @@ import jax.numpy as jnp
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.column import DeviceColumn
 
-_C1 = jnp.uint32(0xCC9E2D51)
-_C2 = jnp.uint32(0x1B873593)
+# python ints, NOT jnp arrays: module-level jax arrays become lifted
+# jit constants that leak as foreign tracers into shard_map programs
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
 
 
 def _rotl32(x, r):
@@ -156,11 +158,18 @@ def spark_partition_ids(cols: List[DeviceColumn], num_partitions: int) -> jax.Ar
 # XXH64 (Spark's XxHash64, seed-chained per column like murmur3 above).
 # Reference analog: spark-rapids-jni xxhash64.cu backing GpuXxHash64.
 # ---------------------------------------------------------------------------
-_P1 = jnp.uint64(0x9E3779B185EBCA87)
-_P2 = jnp.uint64(0xC2B2AE3D27D4EB4F)
-_P3 = jnp.uint64(0x165667B19E3779F9)
-_P4 = jnp.uint64(0x85EBCA77C2B2AE63)
-_P5 = jnp.uint64(0x27D4EB2F165667C5)
+_P1 = 0x9E3779B185EBCA87
+_P2 = 0xC2B2AE3D27D4EB4F
+_P3 = 0x165667B19E3779F9
+_P4 = 0x85EBCA77C2B2AE63
+_P5 = 0x27D4EB2F165667C5
+
+
+def _u64(x: int):
+    """In-trace uint64 constant: primes >= 2^63 overflow jax's weak-int
+    scalar path, and module-level jnp arrays leak across traces — so each
+    trace materializes its own constant."""
+    return jnp.uint64(x)
 
 
 def _rotl64(x, r):
@@ -169,7 +178,7 @@ def _rotl64(x, r):
 
 def _xxh_fmix(h):
     h = h ^ (h >> 33)
-    h = h * _P2
+    h = h * _u64(_P2)
     h = h ^ (h >> 29)
     h = h * _P3
     return h ^ (h >> 32)
@@ -178,15 +187,15 @@ def _xxh_fmix(h):
 def _xxh_int(value_i32, seed_u64):
     h = seed_u64 + _P5 + jnp.uint64(4)
     u = value_i32.astype(jnp.uint32).astype(jnp.uint64)  # i & 0xFFFFFFFF
-    h = h ^ (u * _P1)
-    h = _rotl64(h, 23) * _P2 + _P3
+    h = h ^ (u * _u64(_P1))
+    h = _rotl64(h, 23) * _u64(_P2) + _P3
     return _xxh_fmix(h)
 
 
 def _xxh_long(value_u64, seed_u64):
     h = seed_u64 + _P5 + jnp.uint64(8)
-    h = h ^ (_rotl64(value_u64 * _P2, 31) * _P1)
-    h = _rotl64(h, 27) * _P1 + _P4
+    h = h ^ (_rotl64(value_u64 * _u64(_P2), 31) * _u64(_P1))
+    h = _rotl64(h, 27) * _u64(_P1) + _u64(_P4)
     return _xxh_fmix(h)
 
 
@@ -212,10 +221,10 @@ def _xxh_string(c: DeviceColumn, seed: jax.Array) -> jax.Array:
     len64 = lengths.astype(jnp.uint64)
     long_path = lengths >= 32
     nstripes = lengths // 32  # do-while stripes == floor(len/32)
-    v1 = seed + _P1 + _P2
-    v2 = seed + _P2
+    v1 = seed + _u64(_P1) + _u64(_P2)
+    v2 = seed + _u64(_P2)
     v3 = seed
-    v4 = seed - _P1
+    v4 = seed - _u64(_P1)
     for b in range(w // 32):
         active = b < nstripes
         for j, v in enumerate((v1, v2, v3, v4)):
@@ -223,7 +232,7 @@ def _xxh_string(c: DeviceColumn, seed: jax.Array) -> jax.Array:
             k = jnp.zeros(n, jnp.uint64)
             for t in range(8):  # static offsets -> plain column slices
                 k = k | (ch[:, base + t] << (8 * t))
-            nv = _rotl64(v + k * _P2, 31) * _P1
+            nv = _rotl64(v + k * _u64(_P2), 31) * _u64(_P1)
             if j == 0:
                 v1 = jnp.where(active, nv, v1)
             elif j == 1:
@@ -235,7 +244,7 @@ def _xxh_string(c: DeviceColumn, seed: jax.Array) -> jax.Array:
     merged = (_rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12)
               + _rotl64(v4, 18))
     for v in (v1, v2, v3, v4):
-        merged = (merged ^ (_rotl64(v * _P2, 31) * _P1)) * _P1 + _P4
+        merged = (merged ^ (_rotl64(v * _u64(_P2), 31) * _u64(_P1))) * _u64(_P1) + _u64(_P4)
     h = jnp.where(long_path, merged, seed + _P5)
     h = h + len64
     base = nstripes * 32
@@ -244,24 +253,24 @@ def _xxh_string(c: DeviceColumn, seed: jax.Array) -> jax.Array:
     for j in range(3):
         active = (j + 1) * 8 <= rem
         k = _le_chunk(ch, base + 8 * j, 8, w)
-        nh = _rotl64(h ^ (_rotl64(k * _P2, 31) * _P1), 27) * _P1 + _P4
+        nh = _rotl64(h ^ (_rotl64(k * _u64(_P2), 31) * _u64(_P1)), 27) * _u64(_P1) + _u64(_P4)
         h = jnp.where(active, nh, h)
     o4 = base + (rem // 8) * 8
     rem4 = lengths - o4
     active4 = rem4 >= 4
     k4 = _le_chunk(ch, o4, 4, w)
-    h = jnp.where(active4, _rotl64(h ^ (k4 * _P1), 23) * _P2 + _P3, h)
+    h = jnp.where(active4, _rotl64(h ^ (k4 * _u64(_P1)), 23) * _u64(_P2) + _P3, h)
     ob = o4 + jnp.where(active4, 4, 0)
     for t in range(3):
         idx = ob + t
         active = idx < lengths
         byte = _gather_byte(ch, idx, w)
-        h = jnp.where(active, _rotl64(h ^ (byte * _P5), 11) * _P1, h)
+        h = jnp.where(active, _rotl64(h ^ (byte * _P5), 11) * _u64(_P1), h)
     return _xxh_fmix(h)
 
 
-_CANON_NAN32 = jnp.uint32(0x7FC00000)
-_CANON_NAN64 = jnp.uint64(0x7FF8000000000000)
+_CANON_NAN32 = 0x7FC00000
+_CANON_NAN64 = 0x7FF8000000000000
 
 
 def xxhash64_column(c: DeviceColumn, seed: jax.Array) -> jax.Array:
@@ -274,7 +283,7 @@ def xxhash64_column(c: DeviceColumn, seed: jax.Array) -> jax.Array:
         f = c.data.astype(jnp.float32)
         f = jnp.where(f == 0.0, jnp.float32(0.0), f)
         bits = f.view(jnp.int32)
-        bits = jnp.where(jnp.isnan(f), _CANON_NAN32.astype(jnp.int32), bits)
+        bits = jnp.where(jnp.isnan(f), jnp.int32(_CANON_NAN32), bits)
         h = _xxh_int(bits, seed)
     elif isinstance(dt, T.DoubleType):
         d = c.data.astype(jnp.float64)

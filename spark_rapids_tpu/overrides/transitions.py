@@ -63,7 +63,45 @@ class TpuTransitionOverrides:
         root = TpuTransitionOverrides._rewrite_topn(root)
         if conf.get(TPU_WHOLESTAGE_FUSION):
             root = fuse_stages(root)
+        root = TpuTransitionOverrides._rewrite_ici_agg(root, conf)
         return root
+
+    @staticmethod
+    def _rewrite_ici_agg(node: TpuExec, conf: TpuConf) -> TpuExec:
+        """ICI mesh mode: collapse Final<-[Coalesce]<-Exchange<-Partial into
+        one SPMD collective program (exec/ici.py).
+
+        Runs after fuse_stages so the partial aggregate already carries its
+        fused scan-side filter/project ops into the per-device program."""
+        import jax
+
+        from spark_rapids_tpu.config import MESH_ENABLED, SHUFFLE_MODE
+        from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+        from spark_rapids_tpu.exec.ici import TpuIciShuffleAggExec
+        from spark_rapids_tpu.plan.nodes import AggregateMode
+
+        node.children = [
+            TpuTransitionOverrides._rewrite_ici_agg(c, conf)
+            if isinstance(c, TpuExec) else c for c in node.children]
+        if not (conf.get(MESH_ENABLED)
+                and str(conf.get(SHUFFLE_MODE)).upper() == "ICI"
+                and len(jax.devices()) > 1):
+            return node
+        if not (isinstance(node, TpuHashAggregateExec)
+                and node.mode == AggregateMode.FINAL):
+            return node
+        mid = node.children[0]
+        if isinstance(mid, TpuCoalesceBatchesExec):
+            mid = mid.children[0]
+        if not isinstance(mid, TpuShuffleExchangeExec):
+            return node
+        partial = mid.children[0]
+        if not (isinstance(partial, TpuHashAggregateExec)
+                and partial.mode == AggregateMode.PARTIAL):
+            return node
+        from spark_rapids_tpu.parallel.mesh import make_mesh
+
+        return TpuIciShuffleAggExec(partial, node, make_mesh())
 
     @staticmethod
     def _insert_coalesce(node: TpuExec, conf: TpuConf) -> TpuExec:

@@ -98,6 +98,75 @@ def ici_all_to_all(values: jax.Array, validity: jax.Array,
     return recv_vals.reshape(-1), recv_ok.reshape(-1)
 
 
+def _slot_plan(validity: jax.Array, target_dev: jax.Array, n_dev: int):
+    """Shared slotting for a multi-column all-to-all: returns (perm, slot,
+    ok_send) placing row i of the sorted order at dense quota slot
+    [peer * cap + rank]."""
+    cap = validity.shape[0]
+    perm = jax.lax.sort(
+        (jnp.where(validity, target_dev, n_dev).astype(jnp.int32),
+         jnp.arange(cap, dtype=jnp.int32)), num_keys=1, is_stable=True)[-1]
+    ok_s = validity[perm]
+    tgt_s = jnp.where(ok_s, target_dev[perm], n_dev)
+    is_start = jnp.concatenate([jnp.ones(1, jnp.bool_),
+                                tgt_s[1:] != tgt_s[:-1]])
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    seg_start = jnp.where(is_start, pos, 0)
+    seg_start = jax.lax.associative_scan(jnp.maximum, seg_start)
+    slot = tgt_s * cap + (pos - seg_start)
+    return perm, slot, ok_s & (tgt_s < n_dev)
+
+
+def _a2a_array(arr: jax.Array, perm, slot, n_dev: int, axis: str):
+    """Route one array (any trailing shape) through the dense-quota
+    all-to-all using a precomputed slot plan."""
+    cap = perm.shape[0]
+    sorted_ = arr[perm]
+    send = jnp.zeros((n_dev * cap,) + arr.shape[1:], arr.dtype
+                     ).at[slot].set(sorted_, mode="drop")
+    send = send.reshape((n_dev, cap) + arr.shape[1:])
+    recv = jax.lax.all_to_all(send, axis, 0, 0, tiled=False)
+    return recv.reshape((n_dev * cap,) + arr.shape[1:])
+
+
+def ici_all_to_all_columns(cols, row_valid: jax.Array,
+                           target_dev: jax.Array, n_dev: int, axis: str):
+    """Device-resident shuffle of a whole batch (list of DeviceColumn)
+    inside shard_map: every array (validity/data/chars/lengths) of every
+    column rides the same all-to-all routing plan.
+
+    Returns (received columns, received-row mask).  Dense quota layout:
+    each device reserves cap slots per peer, so the received capacity is
+    n_dev * cap (ragged all-to-all is the planned upgrade —
+    jax.lax.ragged_all_to_all where available)."""
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+
+    perm, slot, ok_send = _slot_plan(row_valid, target_dev, n_dev)
+    cap = row_valid.shape[0]
+    # ok_send is already in sorted order; scatter it through the slot plan
+    sent_ok = jnp.zeros((n_dev * cap,), jnp.bool_).at[slot].set(
+        ok_send, mode="drop").reshape(n_dev, cap)
+    rok = jax.lax.all_to_all(sent_ok, axis, 0, 0, tiled=False).reshape(-1)
+    out = []
+    for c in cols:
+        validity = _a2a_array(c.validity, perm, slot, n_dev, axis)
+        if c.is_string:
+            chars = _a2a_array(c.chars, perm, slot, n_dev, axis)
+            lengths = _a2a_array(c.lengths, perm, slot, n_dev, axis)
+            out.append(DeviceColumn(c.dtype, validity & rok, chars=chars,
+                                    lengths=lengths))
+        elif c.is_array:
+            data = _a2a_array(c.data, perm, slot, n_dev, axis)
+            lengths = _a2a_array(c.lengths, perm, slot, n_dev, axis)
+            ev = _a2a_array(c.elem_valid, perm, slot, n_dev, axis)
+            out.append(DeviceColumn(c.dtype, validity & rok, data=data,
+                                    lengths=lengths, elem_valid=ev))
+        else:
+            data = _a2a_array(c.data, perm, slot, n_dev, axis)
+            out.append(DeviceColumn(c.dtype, validity & rok, data=data))
+    return out, rok
+
+
 # ---------------------------------------------------------------------------
 # Demonstration steps (used by tests and the driver's dryrun_multichip)
 # ---------------------------------------------------------------------------
