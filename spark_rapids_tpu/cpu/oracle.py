@@ -788,15 +788,86 @@ def _h_unary_math(e, cols, n, ansi):
             bad = x <= 0
             validity &= ~bad
             out = np.log10(np.where(bad, 1.0, x))
-        elif name in ("Sin", "Cos", "Tan", "Asin", "Acos", "Atan"):
+        elif name in ("Sin", "Cos", "Tan", "Asin", "Acos", "Atan",
+                      "Sinh", "Cosh", "Tanh", "Asinh", "Acosh", "Atanh",
+                      "Cbrt", "Expm1"):
             out = getattr(np, {"Sin": "sin", "Cos": "cos", "Tan": "tan",
                                "Asin": "arcsin", "Acos": "arccos",
-                               "Atan": "arctan"}[name])(x)
+                               "Atan": "arctan", "Sinh": "sinh",
+                               "Cosh": "cosh", "Tanh": "tanh",
+                               "Asinh": "arcsinh", "Acosh": "arccosh",
+                               "Atanh": "arctanh", "Cbrt": "cbrt",
+                               "Expm1": "expm1"}[name])(x)
+        elif name == "Log2":
+            bad = x <= 0
+            validity &= ~bad
+            out = np.log2(np.where(bad, 1.0, x))
+        elif name == "Log1p":
+            bad = x <= -1.0
+            validity &= ~bad
+            out = np.log1p(np.where(bad, 0.0, x))
+        elif name == "Rint":
+            out = np.round(x)  # numpy round is half-to-even == Math.rint
+        elif name == "Cot":
+            out = 1.0 / np.tan(x)
+        elif name == "Csc":
+            out = 1.0 / np.sin(x)
+        elif name == "Sec":
+            out = 1.0 / np.cos(x)
+        elif name == "ToDegrees":
+            out = np.degrees(x)
+        elif name == "ToRadians":
+            out = np.radians(x)
         elif name == "Signum":
             out = np.sign(x)
         else:
             raise NotImplementedError(name)
     return CpuCol(T.DOUBLE, out, validity)
+
+
+def _h_binary_math(e, cols, n, ansi):
+    l, r = _kids(e, cols, n, ansi)
+    a = l.values.astype(np.float64)
+    b = r.values.astype(np.float64)
+    name = type(e).__name__
+    validity = l.validity & r.validity
+    with np.errstate(all="ignore"):
+        if name == "Atan2":
+            out = np.arctan2(a, b)
+        elif name == "Hypot":
+            out = np.hypot(a, b)
+        elif name == "Logarithm":
+            bad = (b <= 0) | (a <= 0) | (a == 1.0)
+            validity = validity & ~bad
+            out = np.log(np.where(b <= 0, 1.0, b)) / np.log(
+                np.where((a <= 0) | (a == 1.0), 2.0, a))
+        else:
+            raise NotImplementedError(name)
+    return CpuCol(T.DOUBLE, out, validity)
+
+
+def _h_bitwise(e, cols, n, ansi):
+    name = type(e).__name__
+    if name == "BitwiseNot":
+        (c,) = _kids(e, cols, n, ansi)
+        return CpuCol(e.dataType, ~c.values, c.validity.copy())
+    l, r = _kids(e, cols, n, ansi)
+    validity = l.validity & r.validity
+    if name in ("BitwiseAnd", "BitwiseOr", "BitwiseXor"):
+        fn = {"BitwiseAnd": np.bitwise_and, "BitwiseOr": np.bitwise_or,
+              "BitwiseXor": np.bitwise_xor}[name]
+        return CpuCol(e.dataType, fn(l.values, r.values), validity)
+    # shifts: Java masks the amount to the value width
+    width_mask = 63 if isinstance(e.dataType, T.LongType) else 31
+    amt = (r.values.astype(np.int64) & width_mask).astype(l.values.dtype)
+    if name == "ShiftLeft":
+        out = l.values << amt
+    elif name == "ShiftRight":
+        out = l.values >> amt
+    else:  # ShiftRightUnsigned
+        udt = np.uint64 if l.values.dtype == np.int64 else np.uint32
+        out = (l.values.view(udt) >> amt.view(udt)).view(l.values.dtype)
+    return CpuCol(e.dataType, out, validity)
 
 
 def _h_pow(e, cols, n, ansi):
@@ -1071,6 +1142,289 @@ def _h_unixts(e, cols, n, ansi):
     else:
         out = np.array([int(v) // 1_000_000 for v in c.values], np.int64)
     return CpuCol(T.LONG, out, c.validity.copy())
+
+
+def _h_weekofyear(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    dates = _date_of(c, e.child.dataType)
+    out = np.zeros(n, np.int32)
+    for i in range(n):
+        if c.validity[i]:
+            out[i] = dates[i].isocalendar()[1]
+    return CpuCol(T.INT, out, c.validity.copy())
+
+
+def _h_addmonths(e, cols, n, ansi):
+    import calendar
+
+    d, k = _kids(e, cols, n, ansi)
+    dates = _date_of(d, e.children[0].dataType)
+    out = np.zeros(n, np.int32)
+    validity = d.validity & k.validity
+    for i in range(n):
+        if not validity[i]:
+            continue
+        dt = dates[i]
+        total = dt.year * 12 + dt.month - 1 + int(k.values[i])
+        y, m = total // 12, total % 12 + 1
+        day = min(dt.day, calendar.monthrange(y, m)[1])
+        out[i] = (pydt.date(y, m, day) - pydt.date(1970, 1, 1)).days
+    return CpuCol(T.DATE, out, validity)
+
+
+def _h_monthsbetween(e, cols, n, ansi):
+    import calendar
+
+    a, b = _kids(e, cols, n, ansi)
+    validity = a.validity & b.validity
+    out = np.zeros(n, np.float64)
+
+    def parts(col_, dt):
+        if isinstance(dt, T.TimestampType):
+            tss = [pydt.datetime(1970, 1, 1)
+                   + pydt.timedelta(microseconds=int(v)) for v in col_.values]
+        else:
+            tss = [pydt.datetime(1970, 1, 1)
+                   + pydt.timedelta(days=int(v)) for v in col_.values]
+        return tss
+
+    ta = parts(a, e.children[0].dataType)
+    tb = parts(b, e.children[1].dataType)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        x, y = ta[i], tb[i]
+        months = (x.year - y.year) * 12 + (x.month - y.month)
+        x_end = x.day == calendar.monthrange(x.year, x.month)[1]
+        y_end = y.day == calendar.monthrange(y.year, y.month)[1]
+        secs_x = x.hour * 3600 + x.minute * 60 + x.second + x.microsecond / 1e6
+        secs_y = y.hour * 3600 + y.minute * 60 + y.second + y.microsecond / 1e6
+        # Spark: equal day-of-month (or both month ends) -> whole months,
+        # time of day ignored
+        if (x_end and y_end) or x.day == y.day:
+            v = float(months)
+        else:
+            v = months + ((x.day - y.day) * 86400.0 + secs_x - secs_y) \
+                / (31.0 * 86400.0)
+        if getattr(e, "round_off", True):
+            v = float(np.round(v * 1e8) / 1e8)
+        out[i] = v
+    return CpuCol(T.DOUBLE, out, validity)
+
+
+def _h_truncdate(e, cols, n, ansi):
+    c = eval_expr(e.children[0], cols, n, ansi)
+    from spark_rapids_tpu.expr.datetime import TruncDate as _TD
+
+    fmt = e.children[1]
+    unit = _TD._FMTS.get(str(fmt.value).lower()) \
+        if getattr(fmt, "value", None) is not None else None
+    dates = _date_of(c, e.children[0].dataType)
+    out = np.zeros(n, np.int32)
+    validity = c.validity.copy()
+    for i in range(n):
+        if not c.validity[i]:
+            continue
+        d = dates[i]
+        if unit == "year":
+            t = d.replace(month=1, day=1)
+        elif unit == "quarter":
+            t = d.replace(month=(d.month - 1) // 3 * 3 + 1, day=1)
+        elif unit == "month":
+            t = d.replace(day=1)
+        elif unit == "week":
+            t = d - pydt.timedelta(days=d.weekday())
+        else:
+            validity[i] = False
+            continue
+        out[i] = (t - pydt.date(1970, 1, 1)).days
+    return CpuCol(T.DATE, out, validity)
+
+
+def _h_nextday(e, cols, n, ansi):
+    c = eval_expr(e.children[0], cols, n, ansi)
+    from spark_rapids_tpu.expr.datetime import NextDay as _ND
+
+    lit_ = e.children[1]
+    target = _ND._DOW.get(str(lit_.value).strip().lower()) \
+        if getattr(lit_, "value", None) is not None else None
+    dates = _date_of(c, e.children[0].dataType)
+    out = np.zeros(n, np.int32)
+    validity = c.validity.copy()
+    for i in range(n):
+        if not c.validity[i]:
+            continue
+        if target is None:
+            validity[i] = False
+            continue
+        d = dates[i]
+        dow = d.isoweekday() % 7     # Sunday=0
+        delta = (target - dow) % 7 or 7
+        out[i] = (d - pydt.date(1970, 1, 1)).days + delta
+    return CpuCol(T.DATE, out, validity)
+
+
+def _py_civil_from_days(z: int):
+    """Howard Hinnant civil-from-days (pure ints: no datetime range cap)."""
+    z += 719468
+    era = (z if z >= 0 else z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + 3 if mp < 10 else mp - 9
+    return y + (1 if m <= 2 else 0), m, d
+
+
+_DOW_ABBR = ["Sun", "Mon", "Tue", "Wed", "Thu", "Fri", "Sat"]
+_DOW_FULL = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+             "Friday", "Saturday"]
+_MON_ABBR = ["Jan", "Feb", "Mar", "Apr", "May", "Jun", "Jul", "Aug", "Sep",
+             "Oct", "Nov", "Dec"]
+_MON_FULL = ["January", "February", "March", "April", "May", "June", "July",
+             "August", "September", "October", "November", "December"]
+_ORACLE_FMT_TOKENS = ("yyyy", "MMMM", "MMM", "MM", "dd", "DD", "HH", "mm",
+                      "ss", "EEEE", "EEE", "a")
+
+
+def _oracle_format_micros(micros: int, fmt: str) -> str:
+    """Render with pure integer civil math (Java patterns, UTC)."""
+    days, rem = divmod(micros, 86_400_000_000)
+    y, mo, d = _py_civil_from_days(days)
+    h = rem // 3_600_000_000
+    mi = (rem // 60_000_000) % 60
+    s = (rem // 1_000_000) % 60
+    dow = (days + 4) % 7
+    out = []
+    i = 0
+    while i < len(fmt):
+        for t in _ORACLE_FMT_TOKENS:
+            if fmt.startswith(t, i):
+                if t == "yyyy":
+                    out.append(f"{y:04d}")
+                elif t == "MM":
+                    out.append(f"{mo:02d}")
+                elif t == "MMM":
+                    out.append(_MON_ABBR[mo - 1])
+                elif t == "MMMM":
+                    out.append(_MON_FULL[mo - 1])
+                elif t == "dd":
+                    out.append(f"{d:02d}")
+                elif t == "DD":
+                    out.append(f"{_day_of_year(y, mo, d):03d}")
+                elif t == "HH":
+                    out.append(f"{h:02d}")
+                elif t == "mm":
+                    out.append(f"{mi:02d}")
+                elif t == "ss":
+                    out.append(f"{s:02d}")
+                elif t == "EEE":
+                    out.append(_DOW_ABBR[dow])
+                elif t == "EEEE":
+                    out.append(_DOW_FULL[dow])
+                elif t == "a":
+                    out.append("AM" if h < 12 else "PM")
+                i += len(t)
+                break
+        else:
+            ch = fmt[i]
+            if ch.isalpha():
+                raise NotImplementedError(f"oracle time format letter {ch!r}")
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+_MDAYS = [31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31]
+
+
+def _day_of_year(y: int, m: int, d: int) -> int:
+    leap = (y % 4 == 0 and y % 100 != 0) or y % 400 == 0
+    return sum(_MDAYS[: m - 1]) + (1 if leap and m > 2 else 0) + d
+
+
+def _h_format_time(e, cols, n, ansi):
+    c = eval_expr(e.children[0], cols, n, ansi)
+    fmt = str(e.children[1].value)
+    name = type(e).__name__
+    out = np.empty(n, object)
+    for i in range(n):
+        if not c.validity[i]:
+            out[i] = None
+            continue
+        if name == "FromUnixTime":
+            # Java sec * MICROS_PER_SECOND wraps silently (long multiply)
+            micros = int(c.values[i]) * 1_000_000
+            micros = (micros + 2 ** 63) % 2 ** 64 - 2 ** 63
+        elif isinstance(e.children[0].dataType, T.DateType):
+            micros = int(c.values[i]) * 86_400_000_000
+        else:
+            micros = int(c.values[i])
+        out[i] = _oracle_format_micros(micros, fmt)
+    return CpuCol(T.STRING, out, c.validity.copy())
+
+
+def _h_octetbit(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    mult = 8 if type(e).__name__ == "BitLength" else 1
+    out = np.array([len(v.encode("utf-8")) * mult if v is not None else 0
+                    for v in c.values], np.int32)
+    return CpuCol(T.INT, out, c.validity.copy())
+
+
+def _h_leftright(e, cols, n, ansi):
+    s, k = _kids(e, cols, n, ansi)
+    left = type(e).__name__ == "StringLeft"
+    validity = s.validity & k.validity
+    out = np.empty(n, object)
+    for i in range(n):
+        if not validity[i]:
+            out[i] = None
+            continue
+        v = s.values[i]
+        kk = int(k.values[i])
+        if kk <= 0:
+            out[i] = ""
+        else:
+            out[i] = v[:kk] if left else v[-kk:] if kk <= len(v) else v
+    return CpuCol(T.STRING, out, validity)
+
+
+def _h_substring_index(e, cols, n, ansi):
+    s, d, k = _kids(e, cols, n, ansi)
+    validity = s.validity & d.validity & k.validity
+    out = np.empty(n, object)
+    for i in range(n):
+        if not validity[i]:
+            out[i] = None
+            continue
+        v, delim, cnt = s.values[i], d.values[i], int(k.values[i])
+        if cnt == 0 or not delim:
+            out[i] = ""
+            continue
+        if cnt > 0:
+            pos = 0
+            found = 0
+            while found < cnt:
+                j = v.find(delim, pos)
+                if j < 0:
+                    break
+                found += 1
+                pos = j + len(delim)
+            out[i] = v if found < cnt else v[: pos - len(delim)]
+        else:
+            pos = len(v)
+            found = 0
+            while found < -cnt:
+                j = v.rfind(delim, 0, pos)
+                if j < 0:
+                    break
+                found += 1
+                pos = j
+            out[i] = v if found < -cnt else v[pos + len(delim):]
+    return CpuCol(T.STRING, out, validity)
 
 
 # -- string breadth ---------------------------------------------------------
@@ -1411,6 +1765,18 @@ _HANDLERS = {
     "Log10": _h_unary_math, "Sin": _h_unary_math, "Cos": _h_unary_math,
     "Tan": _h_unary_math, "Asin": _h_unary_math, "Acos": _h_unary_math,
     "Atan": _h_unary_math, "Signum": _h_unary_math,
+    "Sinh": _h_unary_math, "Cosh": _h_unary_math, "Tanh": _h_unary_math,
+    "Asinh": _h_unary_math, "Acosh": _h_unary_math, "Atanh": _h_unary_math,
+    "Cbrt": _h_unary_math, "Log2": _h_unary_math, "Log1p": _h_unary_math,
+    "Expm1": _h_unary_math, "Rint": _h_unary_math, "Cot": _h_unary_math,
+    "Csc": _h_unary_math, "Sec": _h_unary_math,
+    "ToDegrees": _h_unary_math, "ToRadians": _h_unary_math,
+    "Atan2": _h_binary_math, "Hypot": _h_binary_math,
+    "Logarithm": _h_binary_math,
+    "BitwiseAnd": _h_bitwise, "BitwiseOr": _h_bitwise,
+    "BitwiseXor": _h_bitwise, "BitwiseNot": _h_bitwise,
+    "ShiftLeft": _h_bitwise, "ShiftRight": _h_bitwise,
+    "ShiftRightUnsigned": _h_bitwise,
     "Pow": _h_pow, "Floor": _h_floorceil, "Ceil": _h_floorceil,
     "Round": _h_round,
     "Length": _h_length, "Upper": _h_upperlower, "Lower": _h_upperlower,
@@ -1421,6 +1787,10 @@ _HANDLERS = {
     "Year": _h_datefield, "Month": _h_datefield, "DayOfMonth": _h_datefield,
     "DayOfWeek": _h_datefield, "DayOfYear": _h_datefield,
     "Quarter": _h_datefield, "LastDay": _h_lastday,
+    "WeekOfYear": _h_weekofyear, "AddMonths": _h_addmonths,
+    "MonthsBetween": _h_monthsbetween, "TruncDate": _h_truncdate,
+    "NextDay": _h_nextday, "FromUnixTime": _h_format_time,
+    "DateFormat": _h_format_time,
     "Hour": _h_timefield, "Minute": _h_timefield, "Second": _h_timefield,
     "DateAdd": _h_dateadd, "DateSub": _h_dateadd, "DateDiff": _h_datediff,
     "UnixTimestamp": _h_unixts,
@@ -1430,6 +1800,9 @@ _HANDLERS = {
     "StringTranslate": _h_translate, "StringInstr": _h_instr,
     "StringLocate": _h_locate, "StringLPad": _h_pad, "StringRPad": _h_pad,
     "StringRepeat": _h_repeat, "ConcatWs": _h_concat_ws,
+    "OctetLength": _h_octetbit, "BitLength": _h_octetbit,
+    "StringLeft": _h_leftright, "StringRight": _h_leftright,
+    "SubstringIndex": _h_substring_index,
 }
 
 

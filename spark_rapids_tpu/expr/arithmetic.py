@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from typing import List
 
+import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
@@ -447,3 +448,99 @@ class Pmod(BinaryArithmetic):
         adjusted = adjusted - _trunc_div(adjusted, den) * den
         data = jnp.where(m < 0, adjusted, m)
         return DeviceColumn(self.dataType, validity, data=data)
+
+
+# -- bitwise (GpuBitwiseAnd/Or/Xor/Not, GpuShiftLeft/Right/RightUnsigned;
+# reference: org/apache/spark/sql/rapids/bitwise.scala analog) --------------
+
+class _BitwiseBinary(BinaryExpression):
+    def _resolve_type(self):
+        lt, rt = self.left.dataType, self.right.dataType
+        if not (lt.is_integral and rt.is_integral):
+            raise TypeError(f"{self.pretty_name} needs integral operands")
+        common = T.numeric_promote(lt, rt)
+        from spark_rapids_tpu.expr.cast import Cast
+
+        self.children = [
+            c if c.dataType == common else Cast(c, common).resolve(None)
+            for c in self.children]
+        self._dataType = common
+        self._nullable = self.left.nullable or self.right.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        l, r = cols
+        return DeviceColumn(self.dataType, l.validity & r.validity,
+                            data=self._fn(l.data, r.data))
+
+
+class BitwiseAnd(_BitwiseBinary):
+    symbol = "&"
+
+    def _fn(self, a, b):
+        return a & b
+
+
+class BitwiseOr(_BitwiseBinary):
+    symbol = "|"
+
+    def _fn(self, a, b):
+        return a | b
+
+
+class BitwiseXor(_BitwiseBinary):
+    symbol = "^"
+
+    def _fn(self, a, b):
+        return a ^ b
+
+
+class BitwiseNot(UnaryExpression):
+    def _resolve_type(self):
+        if not self.child.dataType.is_integral:
+            raise TypeError("~ needs an integral operand")
+        self._dataType = self.child.dataType
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        return DeviceColumn(self.dataType, c.validity, data=~c.data)
+
+
+class _Shift(BinaryExpression):
+    """Java shift semantics: the amount is masked to the value width
+    (x << 33 on int == x << 1), never widened."""
+
+    def _resolve_type(self):
+        lt = self.left.dataType
+        if not isinstance(lt, (T.IntegerType, T.LongType)):
+            from spark_rapids_tpu.expr.cast import Cast
+
+            self.children[0] = Cast(self.left, T.INT).resolve(None)
+            lt = T.INT
+        self._dataType = lt
+        self._nullable = self.left.nullable or self.right.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        l, r = cols
+        width_mask = 63 if isinstance(self.dataType, T.LongType) else 31
+        amt = (r.data.astype(jnp.int32) & width_mask).astype(l.data.dtype)
+        return DeviceColumn(self.dataType, l.validity & r.validity,
+                            data=self._fn(l.data, amt))
+
+
+class ShiftLeft(_Shift):
+    def _fn(self, a, amt):
+        return a << amt
+
+
+class ShiftRight(_Shift):
+    def _fn(self, a, amt):
+        return a >> amt   # arithmetic (sign-propagating)
+
+
+class ShiftRightUnsigned(_Shift):
+    def _fn(self, a, amt):
+        udt = jnp.uint64 if a.dtype == jnp.int64 else jnp.uint32
+        return jax.lax.shift_right_logical(
+            jax.lax.bitcast_convert_type(a, udt),
+            jax.lax.bitcast_convert_type(amt, udt)).astype(a.dtype)

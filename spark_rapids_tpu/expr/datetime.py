@@ -165,3 +165,250 @@ class UnixTimestamp(UnaryExpression):
         else:
             secs = jnp.floor_divide(c.data, 1_000_000)
         return DeviceColumn(T.LONG, c.validity, data=secs)
+
+
+class WeekOfYear(_DateField):
+    """ISO-8601 week number (Spark WeekOfYear: week containing Thursday)."""
+
+    def _field(self, y, m, d, days):
+        # ISO week: shift to the Thursday of this row's week, then count
+        # weeks from that year's Jan 1st week
+        dow0 = (days + 3) % 7          # Monday=0 ... Sunday=6
+        thursday = days - dow0 + 3
+        ty, _, _ = civil_from_days(thursday)
+        jan1 = days_from_civil(ty, jnp.full_like(ty, 1), jnp.full_like(ty, 1))
+        return ((thursday - jan1) // 7 + 1).astype(jnp.int64)
+
+
+def _month_len(y, m):
+    """Days in month (y, m) via civil-day differences."""
+    next_m_y = jnp.where(m == 12, y + 1, y)
+    next_m = jnp.where(m == 12, 1, m + 1)
+    return (days_from_civil(next_m_y, next_m, jnp.ones_like(m))
+            - days_from_civil(y, m, jnp.ones_like(m)))
+
+
+def _clamped_ymd_to_days(y, m, d):
+    """days_from_civil with day-of-month clamped to the month length."""
+    return days_from_civil(y, m, jnp.minimum(d, _month_len(y, m)))
+
+
+class AddMonths(BinaryExpression):
+    """add_months(date, n): day clamped to the target month's last day."""
+
+    def _resolve_type(self):
+        self._dataType = T.DATE
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        c, n = cols
+        days = _days_of(c, self.children[0].dataType)
+        y, m, d = civil_from_days(days)
+        total = (y * 12 + (m - 1)) + n.data.astype(jnp.int64)
+        ny = total // 12
+        nm = total % 12 + 1
+        out = _clamped_ymd_to_days(ny, nm, d)
+        return DeviceColumn(T.DATE, c.validity & n.validity,
+                            data=out.astype(jnp.int32))
+
+
+class MonthsBetween(BinaryExpression):
+    """months_between(ts1, ts2[, roundOff=true]) -> double.
+
+    Spark: whole months when both are the same day-of-month or both are
+    month ends; otherwise day difference / 31 with time-of-day fraction,
+    rounded to 8 digits when roundOff."""
+
+    def __init__(self, left, right, round_off: bool = True):
+        super().__init__(left, right)
+        self.round_off = round_off
+
+    def _resolve_type(self):
+        self._dataType = T.DOUBLE
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        a, b = cols
+
+        def parts(c, dt):
+            days = _days_of(c, dt)
+            y, m, d = civil_from_days(days)
+            if isinstance(dt, T.TimestampType):
+                tod = (c.data - days * _US_PER_DAY).astype(jnp.float64) / 1e6
+            else:
+                tod = jnp.zeros_like(days, jnp.float64)
+            return y, m, d, tod, _month_len(y, m)
+
+        ya, ma, da, ta, la = parts(a, self.children[0].dataType)
+        yb, mb, db, tb, lb = parts(b, self.children[1].dataType)
+        months = (ya - yb) * 12 + (ma - mb)
+        both_end = (da == la) & (db == lb)
+        # Spark DateTimeUtils.monthsBetween: equal day-of-month (or both
+        # month ends) -> whole months, time of day IGNORED
+        same_day = da == db
+        whole = months.astype(jnp.float64)
+        frac_days = (da - db).astype(jnp.float64)
+        secs = ta - tb
+        frac = (frac_days * 86400.0 + secs) / (31.0 * 86400.0)
+        out = jnp.where(both_end | same_day, whole, whole + frac)
+        if self.round_off:
+            out = jnp.round(out * 1e8) / 1e8
+        return DeviceColumn(T.DOUBLE, a.validity & b.validity, data=out)
+
+
+class TruncDate(BinaryExpression):
+    """trunc(date, fmt): fmt is a plan-time literal (year/quarter/month/week)."""
+
+    _FMTS = {"year": "year", "yyyy": "year", "yy": "year",
+             "quarter": "quarter", "month": "month", "mon": "month",
+             "mm": "month", "week": "week"}
+
+    def _resolve_type(self):
+        self._dataType = T.DATE
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.expr.base import Literal
+
+        c = cols[0]
+        fmt = self.children[1]
+        unit = self._FMTS.get(str(fmt.value).lower()) \
+            if isinstance(fmt, Literal) and fmt.value is not None else None
+        days = _days_of(c, self.children[0].dataType)
+        y, m, d = civil_from_days(days)
+        if unit == "year":
+            out = days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+        elif unit == "quarter":
+            qm = (m - 1) // 3 * 3 + 1
+            out = days_from_civil(y, qm, jnp.ones_like(d))
+        elif unit == "month":
+            out = days_from_civil(y, m, jnp.ones_like(d))
+        elif unit == "week":
+            out = days - (days + 3) % 7  # back to Monday
+        else:
+            # unsupported fmt -> null (Spark behavior)
+            return DeviceColumn(T.DATE, jnp.zeros_like(c.validity),
+                                data=jnp.zeros_like(days, jnp.int32))
+        return DeviceColumn(T.DATE, c.validity, data=out.astype(jnp.int32))
+
+
+class NextDay(BinaryExpression):
+    """next_day(date, 'Mon'): first strictly-later date with that weekday."""
+
+    _DOW = {"su": 0, "sun": 0, "sunday": 0, "mo": 1, "mon": 1, "monday": 1,
+            "tu": 2, "tue": 2, "tues": 2, "tuesday": 2, "we": 3, "wed": 3,
+            "wednesday": 3, "th": 4, "thu": 4, "thur": 4, "thurs": 4,
+            "thursday": 4, "fr": 5, "fri": 5, "friday": 5, "sa": 6,
+            "sat": 6, "saturday": 6}
+
+    def _resolve_type(self):
+        self._dataType = T.DATE
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.expr.base import Literal
+
+        c = cols[0]
+        lit_ = self.children[1]
+        target = self._DOW.get(str(lit_.value).strip().lower()) \
+            if isinstance(lit_, Literal) and lit_.value is not None else None
+        days = _days_of(c, self.children[0].dataType)
+        if target is None:
+            return DeviceColumn(T.DATE, jnp.zeros_like(c.validity),
+                                data=jnp.zeros_like(days, jnp.int32))
+        dow = (days + 4) % 7          # Sunday=0
+        delta = (target - dow) % 7
+        delta = jnp.where(delta == 0, 7, delta)
+        return DeviceColumn(T.DATE, c.validity,
+                            data=(days + delta).astype(jnp.int32))
+
+
+# -- formatting (UTC session timezone; the reference gates non-UTC behind
+# GpuTimeZoneDB the same way) ------------------------------------------------
+
+_FMT_TOKENS = ("yyyy", "MM", "dd", "HH", "mm", "ss")
+
+
+def parse_format(fmt: str):
+    """Pattern -> list of ('tok', name) | ('lit', char); None if unsupported."""
+    out = []
+    i = 0
+    while i < len(fmt):
+        for t in _FMT_TOKENS:
+            if fmt.startswith(t, i):
+                out.append(("tok", t))
+                i += len(t)
+                break
+        else:
+            ch = fmt[i]
+            if ch.isalpha():
+                return None          # unknown format letter
+            out.append(("lit", ch))
+            i += 1
+    return out
+
+
+def _format_to_chars(segments, y, mo, d, h, mi, s):
+    """Render the static pattern into a (n, width) char matrix."""
+    vals = {"yyyy": (y, 4), "MM": (mo, 2), "dd": (d, 2), "HH": (h, 2),
+            "mm": (mi, 2), "ss": (s, 2)}
+    cols = []
+    for kind, v in segments:
+        if kind == "lit":
+            cols.append(jnp.full_like(y, ord(v)).astype(jnp.uint8)[:, None])
+        else:
+            num, w = vals[v]
+            for k in range(w - 1, -1, -1):
+                digit = (num // (10 ** k)) % 10
+                cols.append((digit + ord("0")).astype(jnp.uint8)[:, None])
+    return jnp.concatenate(cols, axis=1)
+
+
+class _FormatBase(BinaryExpression):
+    """Common machinery for from_unixtime / date_format with a literal
+    pattern from the supported token subset."""
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def _segments(self):
+        from spark_rapids_tpu.expr.base import Literal
+
+        fmt = self.children[1]
+        if not isinstance(fmt, Literal) or fmt.value is None:
+            return None
+        return parse_format(str(fmt.value))
+
+    def _render(self, c, micros):
+        segs = self._segments()
+        days = jnp.floor_divide(micros, _US_PER_DAY)
+        rem = micros - days * _US_PER_DAY
+        y, mo, d = civil_from_days(days)
+        h = rem // 3_600_000_000
+        mi = (rem // 60_000_000) % 60
+        s = (rem // 1_000_000) % 60
+        chars = _format_to_chars(segs, y, mo, d, h, mi, s)
+        lengths = jnp.full(c.capacity, chars.shape[1], jnp.int32)
+        return DeviceColumn(T.STRING, c.validity, chars=chars,
+                            lengths=lengths)
+
+
+class FromUnixTime(_FormatBase):
+    """from_unixtime(seconds, fmt) -> string (UTC)."""
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        return self._render(c, c.data.astype(jnp.int64) * 1_000_000)
+
+
+class DateFormat(_FormatBase):
+    """date_format(ts_or_date, fmt) -> string (UTC)."""
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        if isinstance(self.children[0].dataType, T.DateType):
+            micros = c.data.astype(jnp.int64) * _US_PER_DAY
+        else:
+            micros = c.data
+        return self._render(c, micros)

@@ -185,3 +185,131 @@ class Round(Expression):
         x = c.data * scale_f
         r = jnp.where(x >= 0, jnp.floor(x + 0.5), jnp.ceil(x - 0.5))
         return DeviceColumn(dt, c.validity & s.validity, data=r / scale_f)
+
+
+class Sinh(_UnaryMathToDouble):
+    def _fn(self, x):
+        return jnp.sinh(x), None
+
+
+class Cosh(_UnaryMathToDouble):
+    def _fn(self, x):
+        return jnp.cosh(x), None
+
+
+class Tanh(_UnaryMathToDouble):
+    def _fn(self, x):
+        return jnp.tanh(x), None
+
+
+class Asinh(_UnaryMathToDouble):
+    def _fn(self, x):
+        return jnp.arcsinh(x), None
+
+
+class Acosh(_UnaryMathToDouble):
+    """java.lang.StrictMath semantics: x < 1 -> NaN."""
+
+    def _fn(self, x):
+        return jnp.arccosh(x), None
+
+
+class Atanh(_UnaryMathToDouble):
+    def _fn(self, x):
+        return jnp.arctanh(x), None
+
+
+class Cbrt(_UnaryMathToDouble):
+    def _fn(self, x):
+        return jnp.cbrt(x), None
+
+
+class Log2(_UnaryMathToDouble):
+    """Spark log2(x): null for x <= 0."""
+
+    def _fn(self, x):
+        bad = x <= 0
+        return jnp.log2(jnp.where(bad, 1.0, x)), bad
+
+
+class Log1p(_UnaryMathToDouble):
+    """Spark log1p(x): null for x <= -1."""
+
+    def _fn(self, x):
+        bad = x <= -1.0
+        return jnp.log1p(jnp.where(bad, 0.0, x)), bad
+
+
+class Expm1(_UnaryMathToDouble):
+    def _fn(self, x):
+        return jnp.expm1(x), None
+
+
+class Rint(_UnaryMathToDouble):
+    """Math.rint: round half to EVEN (unlike Spark round's HALF_UP)."""
+
+    def _fn(self, x):
+        return jnp.round(x), None  # jnp.round is banker's rounding
+
+
+class Cot(_UnaryMathToDouble):
+    def _fn(self, x):
+        return 1.0 / jnp.tan(x), None
+
+
+class Csc(_UnaryMathToDouble):
+    def _fn(self, x):
+        return 1.0 / jnp.sin(x), None
+
+
+class Sec(_UnaryMathToDouble):
+    def _fn(self, x):
+        return 1.0 / jnp.cos(x), None
+
+
+class ToDegrees(_UnaryMathToDouble):
+    def _fn(self, x):
+        return jnp.degrees(x), None
+
+
+class ToRadians(_UnaryMathToDouble):
+    def _fn(self, x):
+        return jnp.radians(x), None
+
+
+class _BinaryMathToDouble(BinaryExpression):
+    def _resolve_type(self):
+        new = []
+        for c in self.children:
+            new.append(c if c.dataType == T.DOUBLE
+                       else Cast(c, T.DOUBLE).resolve(None))
+        self.children = new
+        self._dataType = T.DOUBLE
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        l, r = cols
+        return DeviceColumn(T.DOUBLE, l.validity & r.validity,
+                            data=self._fn(l.data, r.data))
+
+
+class Atan2(_BinaryMathToDouble):
+    def _fn(self, a, b):
+        return jnp.arctan2(a, b)
+
+
+class Hypot(_BinaryMathToDouble):
+    def _fn(self, a, b):
+        return jnp.hypot(a, b)
+
+
+class Logarithm(_BinaryMathToDouble):
+    """log(base, x): null when x <= 0 or base <= 0 or base == 1."""
+
+    def do_columnar_eval(self, ctx, cols):
+        b, x = cols
+        bad = (x.data <= 0) | (b.data <= 0) | (b.data == 1.0)
+        out = jnp.log(jnp.where(x.data <= 0, 1.0, x.data)) / jnp.log(
+            jnp.where((b.data <= 0) | (b.data == 1.0), 2.0, b.data))
+        return DeviceColumn(T.DOUBLE, b.validity & x.validity & ~bad,
+                            data=out)

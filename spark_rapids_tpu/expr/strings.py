@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import List
 
+import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
@@ -777,3 +778,150 @@ class RLike(BinaryExpression):
         s, _ = cols
         return DeviceColumn(T.BOOLEAN, s.validity,
                             data=run_dfa(s, self._compiled()))
+
+
+class OctetLength(UnaryExpression):
+    """octet_length(str): byte count (the padded layout stores it directly)."""
+
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        return DeviceColumn(T.INT, c.validity, data=c.lengths)
+
+
+class BitLength(UnaryExpression):
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        return DeviceColumn(T.INT, c.validity, data=c.lengths * 8)
+
+
+class _LeftRight(BinaryExpression):
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        c, k = cols
+        n = c.lengths
+        want = k.data.astype(jnp.int32)
+        take_n = jnp.clip(jnp.where(want < 0, 0, want), 0, n)
+        start = self._start(n, take_n)
+        width = c.width
+        idx = start[:, None] + jnp.arange(width)[None, :]
+        keep = jnp.arange(width)[None, :] < take_n[:, None]
+        gathered = jnp.take_along_axis(c.chars, jnp.clip(idx, 0, width - 1),
+                                       axis=1)
+        return DeviceColumn(T.STRING, c.validity & k.validity,
+                            chars=jnp.where(keep, gathered, 0).astype(jnp.uint8),
+                            lengths=take_n)
+
+
+class StringLeft(_LeftRight):
+    """left(str, n): first n bytes (ASCII-exact; see Substring caveat)."""
+
+    def _start(self, n, take_n):
+        return jnp.zeros_like(n)
+
+
+class StringRight(_LeftRight):
+    """right(str, n): last n bytes."""
+
+    def _start(self, n, take_n):
+        return n - take_n
+
+
+class SubstringIndex(Expression):
+    """substring_index(str, delim, count) with a LITERAL delimiter.
+
+    count > 0: everything before the count-th occurrence (whole string if
+    fewer); count < 0: everything after the |count|-th occurrence from the
+    right; count = 0 or empty delim -> empty string."""
+
+    def __init__(self, s: Expression, delim: Expression, count: Expression):
+        super().__init__([s, delim, count])
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.expr.base import Literal
+
+        c, _, k = cols
+        delim_expr = self.children[1]
+        delim = (str(delim_expr.value).encode("utf-8")
+                 if isinstance(delim_expr, Literal)
+                 and delim_expr.value is not None else b"")
+        width = c.width
+        n = c.lengths
+        count = k.data.astype(jnp.int32)
+        validity = c.validity & cols[1].validity & k.validity
+        if len(delim) == 0:
+            return DeviceColumn(T.STRING, validity,
+                                chars=jnp.zeros_like(c.chars),
+                                lengths=jnp.zeros_like(n))
+        if len(delim) > width:
+            # delimiter longer than every string: no occurrence anywhere ->
+            # whole string (count != 0) / empty (count == 0)
+            out_len = jnp.where(count == 0, 0, n)
+            keep = jnp.arange(width)[None, :] < out_len[:, None]
+            return DeviceColumn(T.STRING, validity,
+                                chars=jnp.where(keep, c.chars, 0
+                                                ).astype(jnp.uint8),
+                                lengths=out_len.astype(jnp.int32))
+        dl = len(delim)
+        # occurrence start positions: delim bytes match AND fully in bounds.
+        # Spark counts LEFT-TO-RIGHT NON-OVERLAPPING occurrences for both
+        # signs (StringUtils.ordinalIndexOf / lastOrdinalIndexOf are
+        # non-overlapping scans).
+        hit = jnp.ones((c.capacity, width), jnp.bool_)
+        for j, b in enumerate(delim):
+            shifted = jnp.roll(c.chars, -j, axis=1) if j else c.chars
+            hit = hit & (shifted == b)
+        pos_ok = (jnp.arange(width)[None, :] + dl) <= n[:, None]
+        hit = hit & pos_ok
+        if dl > 1:
+            # kill overlapping hits: scan left->right, a hit only counts if
+            # no counted hit began in the previous dl-1 positions
+            def step(carry, x):
+                # carry: distance since last counted hit (>= dl means free)
+                free = carry >= dl
+                counted = x & free
+                nc = jnp.where(counted, 1, carry + 1)
+                return nc, counted
+
+            init = jnp.full(c.capacity, dl, jnp.int32)
+            _, counted_t = jax.lax.scan(step, init, hit.T)
+            hit = counted_t.T
+        occ_idx = jnp.cumsum(hit.astype(jnp.int32), axis=1)  # 1-based count
+        total = occ_idx[:, -1]
+        # forward: cut before count-th occurrence
+        is_kth = hit & (occ_idx == jnp.clip(count, 1, None)[:, None])
+        kth_pos = jnp.min(jnp.where(
+            is_kth, jnp.arange(width)[None, :], width), axis=1)
+        fwd_len = jnp.where((count > 0) & (total >= count), kth_pos, n)
+        # backward: cut after the (total+count+1)-th occurrence (count < 0)
+        wanted = total + count + 1
+        is_kth_b = hit & (occ_idx == jnp.clip(wanted, 1, None)[:, None])
+        kth_pos_b = jnp.min(jnp.where(
+            is_kth_b, jnp.arange(width)[None, :], width), axis=1)
+        bwd_start = jnp.where((count < 0) & (total >= -count),
+                              kth_pos_b + dl, 0)
+        start = jnp.where(count < 0, bwd_start, 0)
+        out_len = jnp.where(count == 0, 0,
+                            jnp.where(count > 0, fwd_len, n - start))
+        out_len = jnp.clip(out_len, 0, n)
+        idx = start[:, None] + jnp.arange(width)[None, :]
+        keep = jnp.arange(width)[None, :] < out_len[:, None]
+        gathered = jnp.take_along_axis(c.chars, jnp.clip(idx, 0, width - 1),
+                                       axis=1)
+        return DeviceColumn(T.STRING, validity,
+                            chars=jnp.where(keep, gathered, 0).astype(jnp.uint8),
+                            lengths=out_len.astype(jnp.int32))

@@ -171,6 +171,38 @@ def _check_literal_children(*ordinals, names="argument"):
     return check
 
 
+def _check_time_format(meta: ExprMeta):
+    """from_unixtime/date_format: literal pattern from the supported token
+    subset (the transpiler-reject pattern applied to time formats)."""
+    from spark_rapids_tpu.expr.datetime import parse_format
+
+    fmt = meta.expr.children[1]
+    if not isinstance(fmt, E.Literal) or fmt.value is None:
+        meta.will_not_work_on_tpu("time format must be a non-null literal")
+        return
+    if parse_format(str(fmt.value)) is None:
+        meta.will_not_work_on_tpu(
+            f"time format {fmt.value!r} contains unsupported pattern "
+            f"letters (supported: yyyy MM dd HH mm ss + separators)")
+
+
+def _check_substring_index(meta: ExprMeta):
+    """Delimiter must be a literal without a self-overlap border (so left
+    and right non-overlapping scans agree with Spark's byte scans)."""
+    d = meta.expr.children[1]
+    if not isinstance(d, E.Literal) or d.value is None:
+        meta.will_not_work_on_tpu("substring_index delimiter must be a "
+                                  "non-null literal")
+        return
+    s = str(d.value)
+    for k in range(1, len(s)):
+        if s[:k] == s[-k:]:
+            meta.will_not_work_on_tpu(
+                f"substring_index delimiter {s!r} is self-overlapping "
+                f"(border of length {k}); occurrence counting may diverge")
+            return
+
+
 def _check_pad(meta: ExprMeta):
     _check_literal_children(1, 2, names="pad length/pad string")(meta)
     pad = meta.expr.children[2]
@@ -212,6 +244,22 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
     M.Tan: ExprRule(_NUM), M.Asin: ExprRule(_NUM), M.Acos: ExprRule(_NUM),
     M.Atan: ExprRule(_NUM), M.Signum: ExprRule(_NUM), M.Pow: ExprRule(_NUM),
     M.Floor: ExprRule(_NUM), M.Ceil: ExprRule(_NUM), M.Round: ExprRule(_NUM),
+    M.Sinh: ExprRule(_NUM), M.Cosh: ExprRule(_NUM), M.Tanh: ExprRule(_NUM),
+    M.Asinh: ExprRule(_NUM), M.Acosh: ExprRule(_NUM),
+    M.Atanh: ExprRule(_NUM), M.Cbrt: ExprRule(_NUM),
+    M.Log2: ExprRule(_NUM), M.Log1p: ExprRule(_NUM),
+    M.Expm1: ExprRule(_NUM), M.Rint: ExprRule(_NUM), M.Cot: ExprRule(_NUM),
+    M.Csc: ExprRule(_NUM), M.Sec: ExprRule(_NUM),
+    M.ToDegrees: ExprRule(_NUM), M.ToRadians: ExprRule(_NUM),
+    M.Atan2: ExprRule(_NUM), M.Hypot: ExprRule(_NUM),
+    M.Logarithm: ExprRule(_NUM),
+    A.BitwiseAnd: ExprRule(T.INTEGRAL_SIG + T.NULL_SIG),
+    A.BitwiseOr: ExprRule(T.INTEGRAL_SIG + T.NULL_SIG),
+    A.BitwiseXor: ExprRule(T.INTEGRAL_SIG + T.NULL_SIG),
+    A.BitwiseNot: ExprRule(T.INTEGRAL_SIG + T.NULL_SIG),
+    A.ShiftLeft: ExprRule(T.INTEGRAL_SIG + T.NULL_SIG),
+    A.ShiftRight: ExprRule(T.INTEGRAL_SIG + T.NULL_SIG),
+    A.ShiftRightUnsigned: ExprRule(T.INTEGRAL_SIG + T.NULL_SIG),
     S.Length: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG),
     S.Upper: ExprRule(T.STRING_SIG.with_note(
         T.StringType, "ASCII-only case conversion")),
@@ -247,6 +295,16 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
     S.ConcatWs: ExprRule(
         T.STRING_SIG, extra_check=_check_literal_children(
             0, names="separator")),
+    S.OctetLength: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG),
+    S.BitLength: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG),
+    S.StringLeft: ExprRule(T.STRING_SIG.with_note(
+        T.StringType, "byte-based; ASCII-exact") + T.INTEGRAL_SIG),
+    S.StringRight: ExprRule(T.STRING_SIG.with_note(
+        T.StringType, "byte-based; ASCII-exact") + T.INTEGRAL_SIG),
+    S.SubstringIndex: ExprRule(
+        T.STRING_SIG.with_note(T.StringType, "byte-based; ASCII-exact")
+        + T.INTEGRAL_SIG,
+        extra_check=_check_substring_index),
     S.Like: ExprRule(T.STRING_SIG + T.BOOLEAN_SIG, extra_check=_check_like),
     S.RLike: ExprRule(T.STRING_SIG + T.BOOLEAN_SIG,
                       extra_check=_check_rlike),
@@ -264,6 +322,25 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
     DT.DateSub: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
     DT.DateDiff: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
     DT.UnixTimestamp: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.WeekOfYear: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.AddMonths: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.MonthsBetween: ExprRule(T.DATETIME_SIG + T.FP_SIG),
+    DT.TruncDate: ExprRule(
+        T.DATETIME_SIG + T.STRING_SIG,
+        extra_check=_check_literal_children(1, names="trunc format")),
+    DT.NextDay: ExprRule(
+        T.DATETIME_SIG + T.STRING_SIG,
+        extra_check=_check_literal_children(1, names="day of week")),
+    DT.FromUnixTime: ExprRule(
+        T.DATETIME_SIG + T.INTEGRAL_SIG + T.STRING_SIG.with_note(
+            T.StringType,
+            "UTC session timezone; years 0001-9999 render correctly"),
+        extra_check=_check_time_format),
+    DT.DateFormat: ExprRule(
+        T.DATETIME_SIG + T.STRING_SIG.with_note(
+            T.StringType,
+            "UTC session timezone; years 0001-9999 render correctly"),
+        extra_check=_check_time_format),
     H.Murmur3Hash: ExprRule(_COMMON128, desc="Spark murmur3 hash"),
     H.XxHash64: ExprRule(_COMMON128, desc="Spark xxhash64"),
 }

@@ -14,24 +14,25 @@ from typing import Callable, Optional
 from spark_rapids_tpu.session import DataFrame, TpuSession
 
 
-def _normalize(v, approx_float: bool):
+def _normalize(v, approx_float: bool, digits: int = 12):
     if isinstance(v, float):
         if math.isnan(v):
             return "NaN"
         if v == 0.0:
             return 0.0  # -0.0 and 0.0 are equal values in Spark comparisons
         if approx_float:
-            # 12 significant digits: tolerates backend ULP differences in
+            # N significant digits: tolerates backend ULP differences in
             # division/transcendentals (the reference's @approximate_float)
-            return float(f"{v:.12g}")
+            return float(f"{v:.{digits}g}")
     if isinstance(v, Decimal):
         return ("dec", str(v.normalize()))
     return v
 
 
-def _rows_key(rows, approx_float):
+def _rows_key(rows, approx_float, digits: int = 12):
     return sorted(
-        (tuple(str(type(v).__name__) + ":" + repr(_normalize(v, approx_float))
+        (tuple(str(type(v).__name__) + ":"
+               + repr(_normalize(v, approx_float, digits))
                for v in r) for r in rows))
 
 
@@ -39,7 +40,8 @@ def assert_tpu_and_cpu_are_equal_collect(
         build_df: Callable[[TpuSession], DataFrame],
         conf: Optional[dict] = None,
         ignore_order: bool = True,
-        approximate_float: bool = False):
+        approximate_float: bool = False,
+        float_digits: int = 12):
     """Run the query with the TPU plan rewrite on and off; compare rows."""
     conf = dict(conf or {})
     cpu_conf = dict(conf)
@@ -51,12 +53,12 @@ def assert_tpu_and_cpu_are_equal_collect(
     tpu_rows = build_df(TpuSession(tpu_conf)).collect()
 
     if ignore_order:
-        ck, tk = _rows_key(cpu_rows, approximate_float), _rows_key(
-            tpu_rows, approximate_float)
+        ck = _rows_key(cpu_rows, approximate_float, float_digits)
+        tk = _rows_key(tpu_rows, approximate_float, float_digits)
     else:
-        ck = [tuple(_normalize(v, approximate_float) for v in r)
+        ck = [tuple(_normalize(v, approximate_float, float_digits) for v in r)
               for r in cpu_rows]
-        tk = [tuple(_normalize(v, approximate_float) for v in r)
+        tk = [tuple(_normalize(v, approximate_float, float_digits) for v in r)
               for r in tpu_rows]
     assert len(cpu_rows) == len(tpu_rows), (
         f"row count differs: CPU {len(cpu_rows)} vs TPU {len(tpu_rows)}")

@@ -36,6 +36,7 @@ from data_gen import (
     DecimalGen,
     DoubleGen,
     IntegerGen,
+    LongGen,
     StringGen,
     gen_df,
 )
@@ -134,3 +135,65 @@ def test_pow():
         return df.select(Pow(col("a"), col("b")).alias("r"))
 
     assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+@pytest.mark.parametrize("cls_name", [
+    "Sinh", "Cosh", "Tanh", "Asinh", "Acosh", "Atanh", "Cbrt", "Log2",
+    "Log1p", "Expm1", "Rint", "Cot", "Csc", "Sec", "ToDegrees", "ToRadians"])
+def test_unary_math_extended(cls_name):
+    from spark_rapids_tpu.expr import mathfuncs as M
+
+    cls = getattr(M, cls_name)
+
+    def build(s):
+        df = gen_df(s, [DoubleGen()], ["a"], length=300)
+        return df.select(cls(col("a")).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True,
+                                         float_digits=10)
+
+
+@pytest.mark.parametrize("cls_name", ["Atan2", "Hypot", "Logarithm"])
+def test_binary_math_extended(cls_name):
+    from spark_rapids_tpu.expr import mathfuncs as M
+
+    cls = getattr(M, cls_name)
+
+    def build(s):
+        df = gen_df(s, [DoubleGen(), DoubleGen()], ["a", "b"], length=300)
+        return df.select(cls(col("a"), col("b")).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+@pytest.mark.parametrize("gen", [IntegerGen(), LongGen(),
+                                 IntegerGen(min_val=-5, max_val=5)],
+                         ids=["int", "long", "small"])
+def test_bitwise_ops(gen):
+    from spark_rapids_tpu.expr.arithmetic import (
+        BitwiseAnd, BitwiseNot, BitwiseOr, BitwiseXor)
+
+    def build(s):
+        df = gen_df(s, [gen, gen], ["a", "b"], length=300)
+        return df.select(BitwiseAnd(col("a"), col("b")).alias("and_"),
+                         BitwiseOr(col("a"), col("b")).alias("or_"),
+                         BitwiseXor(col("a"), col("b")).alias("xor_"),
+                         BitwiseNot(col("a")).alias("not_"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("gen", [IntegerGen(), LongGen()], ids=["int", "long"])
+def test_shifts(gen):
+    from spark_rapids_tpu.expr.arithmetic import (
+        ShiftLeft, ShiftRight, ShiftRightUnsigned)
+
+    def build(s):
+        # amounts beyond the width exercise the Java masking semantics
+        df = gen_df(s, [gen, IntegerGen(min_val=-3, max_val=70)],
+                    ["a", "n"], length=300)
+        return df.select(ShiftLeft(col("a"), col("n")).alias("sl"),
+                         ShiftRight(col("a"), col("n")).alias("sr"),
+                         ShiftRightUnsigned(col("a"), col("n")).alias("sru"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)

@@ -19,8 +19,11 @@ from spark_rapids_tpu.expr.datetime import (
 )
 from spark_rapids_tpu.session import col, lit
 
-from asserts import assert_tpu_and_cpu_are_equal_collect
-from data_gen import DateGen, IntegerGen, TimestampGen, gen_df
+from asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    assert_tpu_fallback_collect,
+)
+from data_gen import DateGen, IntegerGen, LongGen, TimestampGen, gen_df
 
 
 def test_date_fields():
@@ -78,3 +81,85 @@ def test_date_comparison_filter():
                          & (col("d") < lit(datetime.date(1995, 1, 1))))
 
     assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_week_of_year():
+    from spark_rapids_tpu.expr.datetime import WeekOfYear
+
+    def build(s):
+        df = gen_df(s, [DateGen()], ["d"], length=400)
+        return df.select(WeekOfYear(col("d")).alias("w"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_add_months():
+    from spark_rapids_tpu.expr.datetime import AddMonths
+
+    def build(s):
+        df = gen_df(s, [DateGen(), IntegerGen(min_val=-40, max_val=40)],
+                    ["d", "n"], length=400)
+        return df.select(AddMonths(col("d"), col("n")).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("gen", [DateGen(), TimestampGen()],
+                         ids=["date", "ts"])
+def test_months_between(gen):
+    from spark_rapids_tpu.expr.datetime import MonthsBetween
+
+    def build(s):
+        df = gen_df(s, [gen, gen], ["a", "b"], length=300)
+        return df.select(MonthsBetween(col("a"), col("b")).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+@pytest.mark.parametrize("fmt", ["year", "quarter", "month", "week", "mm",
+                                 "bogus"])
+def test_trunc_date(fmt):
+    from spark_rapids_tpu.expr.datetime import TruncDate
+
+    def build(s):
+        df = gen_df(s, [DateGen()], ["d"], length=200)
+        return df.select(TruncDate(col("d"), lit(fmt)).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("day", ["Mon", "fri", "SUNDAY", "tu"])
+def test_next_day(day):
+    from spark_rapids_tpu.expr.datetime import NextDay
+
+    def build(s):
+        df = gen_df(s, [DateGen()], ["d"], length=200)
+        return df.select(NextDay(col("d"), lit(day)).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("fmt", ["yyyy-MM-dd HH:mm:ss", "yyyy/MM/dd",
+                                 "HH:mm", "yyyy-MM-dd"])
+def test_from_unixtime_and_date_format(fmt):
+    from spark_rapids_tpu.expr.datetime import DateFormat, FromUnixTime
+
+    def build(s):
+        # years 1..9999 (the formatter's supported range, like the
+        # reference's incompatible-date-formats note)
+        df = gen_df(s, [LongGen(min_val=-62_000_000_000, max_val=250_000_000_000),
+                        TimestampGen()], ["secs", "ts"], length=300)
+        return df.select(FromUnixTime(col("secs"), lit(fmt)).alias("a"),
+                         DateFormat(col("ts"), lit(fmt)).alias("b"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_date_format_unsupported_pattern_falls_back():
+    from spark_rapids_tpu.expr.datetime import DateFormat
+
+    def build(s):
+        df = gen_df(s, [TimestampGen()], ["ts"], length=50)
+        return df.select(DateFormat(col("ts"), lit("yyyy-MM-dd EEE")).alias("r"))
+
+    assert_tpu_fallback_collect(build, "Project")

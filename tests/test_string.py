@@ -262,3 +262,52 @@ def test_locate_utf8_char_positions(sub, start):
             StringLocate(lit(sub), col("a"), lit(start)).alias("r"))
 
     assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_octet_bit_length():
+    from spark_rapids_tpu.expr.strings import BitLength, OctetLength
+
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=12)], ["a"], length=300)
+        return df.select(OctetLength(col("a")).alias("o"),
+                         BitLength(col("a")).alias("b"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_left_right():
+    from spark_rapids_tpu.expr.strings import StringLeft, StringRight
+
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=10),
+                        IntegerGen(min_val=-3, max_val=15)], ["a", "n"],
+                    length=300)
+        return df.select(StringLeft(col("a"), col("n")).alias("l"),
+                         StringRight(col("a"), col("n")).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("delim", [".", "ab", "--"])
+def test_substring_index(delim):
+    from spark_rapids_tpu.expr.strings import SubstringIndex
+
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=16, charset="ab.-x"),
+                        IntegerGen(min_val=-4, max_val=4)], ["a", "n"],
+                    length=400)
+        return df.select(
+            SubstringIndex(col("a"), lit(delim), col("n")).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_substring_index_overlapping_delim_falls_back():
+    from spark_rapids_tpu.expr.strings import SubstringIndex
+
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=8, charset="a")], ["a"], length=50)
+        return df.select(
+            SubstringIndex(col("a"), lit("aa"), lit(2)).alias("r"))
+
+    assert_tpu_fallback_collect(build, "Project")
