@@ -2383,8 +2383,61 @@ def _cpu_window(plan: PN.Window, ansi: bool):
                         dense += 1
                         prev = cur
                     vals[i] = rank if wf.func == "rank" else dense
+            elif wf.func == "percent_rank":
+                prev = object()
+                rank = 0
+                nr = len(idxs)
+                for r, i in enumerate(idxs):
+                    cur = tuple(oc.row(i) for oc in ocols)
+                    if cur != prev:
+                        rank = r + 1
+                        prev = cur
+                    vals[i] = ((rank - 1) / (nr - 1)) if nr > 1 else 0.0
+            elif wf.func == "cume_dist":
+                nr = len(idxs)
+                keys = [tuple(oc.row(i) for oc in ocols) for i in idxs]
+                for r, i in enumerate(idxs):
+                    last = r
+                    while last + 1 < nr and keys[last + 1] == keys[r]:
+                        last += 1
+                    vals[i] = (last + 1) / nr
+            elif wf.func == "ntile":
+                nb = max(int(wf.buckets), 1)
+                nr = len(idxs)
+                q, rem = divmod(nr, nb)
+                for r, i in enumerate(idxs):
+                    big = rem * (q + 1)
+                    vals[i] = (r // (q + 1) if r < big
+                               else rem + (r - big) // max(q, 1)) + 1
+            elif wf.func in ("lead", "lag"):
+                off = int(wf.offset) * (1 if wf.func == "lead" else -1)
+                for r, i in enumerate(idxs):
+                    j = r + off
+                    if 0 <= j < len(idxs):
+                        src = idxs[j]
+                        if ac.validity[src]:
+                            vals[i] = ac.values[src]
+                        else:
+                            vals[i] = None
+                            valid[i] = False
+                    elif wf.default is not None:
+                        from spark_rapids_tpu.expr.base import Literal
+
+                        vals[i] = Literal(wf.default,
+                                          wf.result_type).storage_value()
+                    else:
+                        vals[i] = None
+                        valid[i] = False
             elif wf.func in ("sum", "count", "avg", "min", "max"):
-                if plan.frame == "running":
+                if isinstance(plan.frame, tuple):
+                    a, b = plan.frame
+                    for r, i in enumerate(idxs):
+                        lo = max(0, r - int(a))
+                        hi = min(len(idxs), r + int(b) + 1)
+                        acc = [ac.values[j] for j in idxs[lo:hi]
+                               if ac.validity[j]]
+                        vals[i] = _wagg(wf, acc, valid, i)
+                elif plan.frame == "running":
                     acc: List = []
                     for i in idxs:
                         if ac.validity[i]:

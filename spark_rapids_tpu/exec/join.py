@@ -87,11 +87,38 @@ class _SortedBuildSide:
         self.batch = batch            # the materialized build batch
 
 
+_SUB_PARTITION_SEED = 100407   # decorrelated from exchange partitioning
+
+
+class _MaterializedExec(TpuExec):
+    """Leaf exec replaying already-materialized spillable batches (the
+    per-bucket children of a sub-partitioned join)."""
+
+    def __init__(self, spillables, schema: T.StructType):
+        super().__init__([])
+        self._spillables = spillables
+        self._schema = schema
+
+    @property
+    def output(self):
+        return self._schema
+
+    def execute_columnar(self):
+        for s in self._spillables:
+            s.pin()
+            try:
+                b = s.get_batch()
+            finally:
+                s.unpin()
+            yield b
+
+
 class _BaseTpuJoinExec(TpuExec):
     def __init__(self, left: TpuExec, right: TpuExec,
                  left_keys: List[Expression], right_keys: List[Expression],
                  join_type: JoinType, condition: Optional[Expression],
-                 output_schema: T.StructType, ansi: bool = False):
+                 output_schema: T.StructType, ansi: bool = False,
+                 sub_partition_bytes: int = 1 << 30):
         super().__init__([left, right])
         self.left_keys = left_keys
         self.right_keys = right_keys
@@ -99,6 +126,7 @@ class _BaseTpuJoinExec(TpuExec):
         self.condition = condition
         self._output = output_schema
         self.ansi = ansi
+        self.sub_partition_bytes = sub_partition_bytes
         self._jit_cache = {}
 
     def _cached_jit(self, key, builder, **jit_kw):
@@ -224,12 +252,12 @@ class _BaseTpuJoinExec(TpuExec):
         return ColumnarBatch(list(out), int(cnt), self._output)
 
     # -- driver ----------------------------------------------------------
-    def _build_batch(self) -> ColumnarBatch:
-        batches = list(self._build_child().execute_columnar())
+    @staticmethod
+    def _concat_or_empty(batches, schema) -> ColumnarBatch:
         if not batches:
             from spark_rapids_tpu.columnar.batch import empty_batch
 
-            return empty_batch(self._build_child().output)
+            return empty_batch(schema)
         return (batches[0] if len(batches) == 1
                 else ColumnarBatch.concat(batches))
 
@@ -239,6 +267,84 @@ class _BaseTpuJoinExec(TpuExec):
     def _probe_child(self) -> TpuExec:
         return self.children[0]
 
+    # -- sub-partitioning (GpuSubPartitionHashJoin analog) ----------------
+    def _sub_partition(self, spillables, keys, n_parts: int, side: str,
+                       schema, fw):
+        """Hash-bucket rows of spillable ``spillables`` into n_parts
+        spillable lists.  Partition ids are computed ONCE per batch; the
+        per-bucket compactions reuse them."""
+        from spark_rapids_tpu.ops.hashing import spark_partition_ids
+
+        def ids_fn(cols, num_rows):
+            b = ColumnarBatch(list(cols), num_rows, schema)
+            ctx = EvalContext(b, ansi=self.ansi)
+            key_cols = [k.eval_tpu(ctx) for k in keys]
+            return spark_partition_ids(key_cols, n_parts,
+                                       seed=_SUB_PARTITION_SEED)
+
+        def slice_fn(cols, ids, num_rows, pid):
+            b = ColumnarBatch(list(cols), num_rows, schema)
+            keep = (ids == pid) & b.row_mask
+            out, cnt = compact_columns(keep, b.columns)
+            return tuple(out), cnt
+
+        # side in the cache key: build and probe close over different key
+        # expressions and schemas
+        ids_j = self._cached_jit(("subpart_ids", n_parts, side), ids_fn)
+        slice_j = self._cached_jit(("subpart_slice", n_parts, side), slice_fn)
+        buckets = [[] for _ in range(n_parts)]
+        for s in spillables:
+            s.pin()
+            try:
+                b = s.get_batch()
+                ids = ids_j(tuple(b.columns), jnp.int32(b.num_rows))
+                for pid in range(n_parts):
+                    cols, cnt = slice_j(tuple(b.columns), ids,
+                                        jnp.int32(b.num_rows),
+                                        jnp.int32(pid))
+                    n = int(cnt)
+                    if n:
+                        buckets[pid].append(
+                            fw.track(ColumnarBatch(list(cols), n, schema)))
+            finally:
+                s.unpin()
+            s.close()
+        return buckets
+
+    def _execute_sub_partitioned(self, build_spillables,
+                                 total_bytes: int) -> Iterator[ColumnarBatch]:
+        """Build side exceeds the goal: hash both sides into buckets and
+        join bucket-by-bucket so only ~1/P of the build is live at once."""
+        from spark_rapids_tpu.memory.spill import get_spill_framework
+
+        fw = get_spill_framework()
+        n_parts = 1
+        while n_parts * self.sub_partition_bytes < total_bytes:
+            n_parts <<= 1
+        n_parts = max(2, n_parts)
+        bschema = self._build_child().output
+        pschema = self._probe_child().output
+        build_buckets = self._sub_partition(build_spillables,
+                                            self.right_keys, n_parts,
+                                            "build", bschema, fw)
+        del build_spillables
+        probe_buckets = self._sub_partition(
+            [fw.track(b) for b in self._probe_child().execute_columnar()],
+            self.left_keys, n_parts, "probe", pschema, fw)
+        for pid in range(n_parts):
+            if not build_buckets[pid] and not probe_buckets[pid]:
+                continue
+            sub = TpuShuffledSymmetricHashJoinExec(
+                _MaterializedExec(probe_buckets[pid], pschema),
+                _MaterializedExec(build_buckets[pid], bschema),
+                self.left_keys, self.right_keys, self.join_type,
+                self.condition, self._output, self.ansi,
+                sub_partition_bytes=1 << 62)  # buckets never re-partition
+            for out in sub.execute_columnar():
+                yield self._count_output(out)
+            for s in build_buckets[pid] + probe_buckets[pid]:
+                s.close()
+
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
         jt = self.join_type
         if jt == JoinType.RIGHT_OUTER:
@@ -247,7 +353,31 @@ class _BaseTpuJoinExec(TpuExec):
         from spark_rapids_tpu.memory.retry import with_retry
         from spark_rapids_tpu.memory.spill import get_spill_framework
 
-        build_batch = self._build_batch()
+        fw0 = get_spill_framework()
+        # track build batches as they stream in so the spill framework can
+        # shed them during ingest (the oversized-build case is exactly when
+        # that matters)
+        build_spill = []
+        total_build_bytes = 0
+        for b in self._build_child().execute_columnar():
+            total_build_bytes += b.nbytes()
+            build_spill.append(fw0.track(b))
+        if (total_build_bytes > self.sub_partition_bytes and self.left_keys
+                and jt != JoinType.CROSS):
+            yield from self._execute_sub_partitioned(build_spill,
+                                                     total_build_bytes)
+            return
+        for s in build_spill:
+            s.pin()
+        try:
+            build_batch = self._concat_or_empty(
+                [s.get_batch() for s in build_spill],
+                self._build_child().output)
+        finally:
+            for s in build_spill:
+                s.unpin()
+                s.close()
+        del build_spill
         with self.metric("buildTime").timed():
             build = self._prepare_build(build_batch, self.right_keys)
         matched_build_any = None
@@ -349,7 +479,8 @@ class _BaseTpuJoinExec(TpuExec):
             self.children[1], self.children[0],
             self.right_keys, self.left_keys,
             JoinType.LEFT_OUTER, self.condition,
-            swapped_schema, self.ansi)
+            swapped_schema, self.ansi,
+            sub_partition_bytes=self.sub_partition_bytes)
         nl = len(self._build_child().output.fields)
         for b in swapped.execute_columnar():
             cols = b.columns[nl:] + b.columns[:nl]

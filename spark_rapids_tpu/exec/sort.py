@@ -38,11 +38,16 @@ def _gather_batch(batch: ColumnarBatch, perm, num_rows,
 
 class TpuSortExec(TpuExec):
     def __init__(self, orders: List[Tuple[Expression, SortSpec]],
-                 is_global: bool, child: TpuExec, ansi: bool = False):
+                 is_global: bool, child: TpuExec, ansi: bool = False,
+                 ooc_bytes: int = 1 << 30, ooc_chunk_rows: int = 1024):
         super().__init__([child])
         self.orders = orders
         self.is_global = is_global
         self.ansi = ansi
+        # out-of-core threshold + merge window chunk (GpuOutOfCoreSortIterator
+        # analog: inputs beyond the goal sort as spillable runs + k-way merge)
+        self.ooc_bytes = ooc_bytes
+        self.ooc_chunk_rows = ooc_chunk_rows
 
     @property
     def output(self):
@@ -76,9 +81,15 @@ class TpuSortExec(TpuExec):
         from spark_rapids_tpu.memory.spill import get_spill_framework
 
         fw = get_spill_framework()
-        spillables = [fw.track(b)
-                      for b in self.children[0].execute_columnar()]
+        spillables = []
+        total_bytes = 0
+        for b in self.children[0].execute_columnar():
+            total_bytes += b.nbytes()
+            spillables.append(fw.track(b))
         if not spillables:
+            return
+        if len(spillables) > 1 and total_bytes > self.ooc_bytes:
+            yield from self._execute_out_of_core(spillables, fw)
             return
         with self.metric("sortTime").timed():
             def run():
@@ -100,6 +111,162 @@ class TpuSortExec(TpuExec):
             for s in spillables:
                 s.close()
         yield self._count_output(out)
+
+    # -- out-of-core: sorted runs + k-way windowed merge -----------------
+    def _execute_out_of_core(self, spillables, fw) -> Iterator[ColumnarBatch]:
+        """GpuOutOfCoreSortIterator analog: sort each batch into a spillable
+        run, then merge fixed-size chunk windows of all runs; rows are safe
+        to emit once their key is <= the smallest last-loaded key of any
+        non-exhausted run.  Peak device memory ~ one run + k * chunk."""
+        from spark_rapids_tpu.memory.retry import with_retry_no_split
+
+        schema = self.children[0].output
+        C = self.ooc_chunk_rows
+        sort_one = self._sort_fn(schema)
+
+        runs = []            # (spillable sorted run, row count)
+        with self.metric("sortTime").timed():
+            for s in spillables:
+                def mk(s=s):
+                    s.pin()
+                    try:
+                        b = s.get_batch()
+                        cols = sort_one(tuple(b.columns),
+                                        jnp.int32(b.num_rows))
+                        return ColumnarBatch(list(cols), b.num_rows, schema)
+                    finally:
+                        s.unpin()
+                sorted_b = with_retry_no_split(mk)
+                runs.append([fw.track(sorted_b), sorted_b.num_rows, 0])
+                s.close()
+        k = len(runs)
+        merge = self._merge_window_fn(schema, k)
+        while any(off < n for _, n, off in runs):
+            chunks = []
+            metas = []   # (nvalid, exhausted)
+            for s, n, off in runs:
+                remaining = n - off
+                take = min(C, max(remaining, 0))
+                if take > 0:
+                    s.pin()
+                    try:
+                        full = s.get_batch()
+                        chunk = full.slice_rows(off, C)
+                    finally:
+                        s.unpin()
+                    # capacity C even when fewer rows remain
+                    chunk = ColumnarBatch(
+                        [c.slice_to(C) for c in chunk.columns], take, schema)
+                else:
+                    from spark_rapids_tpu.columnar.batch import empty_batch
+
+                    chunk = empty_batch(schema, capacity=C)
+                chunks.append(chunk)
+                metas.append((take, remaining <= C))
+            nvalid = jnp.asarray([m[0] for m in metas], jnp.int32)
+            exhausted = jnp.asarray([m[1] for m in metas], jnp.bool_)
+            with self.metric("sortTime").timed():
+                out_cols, emit_cnt, consumed = merge(
+                    tuple(tuple(c.columns) for c in chunks), nvalid,
+                    exhausted)
+                emit = int(emit_cnt)
+                consumed_np = [int(x) for x in consumed]
+            for i, used in enumerate(consumed_np):
+                runs[i][2] += used
+            if emit:
+                yield self._count_output(
+                    ColumnarBatch(list(out_cols), emit, schema))
+        for s, _, _ in runs:
+            s.close()
+
+    def _merge_window_fn(self, schema, k: int):
+        orders = self.orders
+        ansi = self.ansi
+
+        def keys_of(batch):
+            ctx = EvalContext(batch, ansi=ansi)
+            key_cols = [e.eval_tpu(ctx) for e, _ in orders]
+            specs = [s for _, s in orders]
+            from spark_rapids_tpu.ops.sortkeys import pack_sort_keys
+
+            return pack_sort_keys(key_cols, specs, batch.row_mask)
+
+        def le_bound(words, bound):
+            """per row: key <= bound (lexicographic over packed words)."""
+            lt = jnp.zeros(words[0].shape, jnp.bool_)
+            eq = jnp.ones(words[0].shape, jnp.bool_)
+            for w, b in zip(words, bound):
+                lt = lt | (eq & (w < b))
+                eq = eq & (w == b)
+            return lt | eq
+
+        def cat_columns(batches, C, k):
+            """Static-shape concat of k C-capacity chunk batches."""
+            out = []
+            for ci in range(len(batches[0].columns)):
+                cs = [b.columns[ci] for b in batches]
+                validity = jnp.concatenate([c.validity for c in cs])
+                if cs[0].is_string:
+                    w = max(c.width for c in cs)
+                    chars = jnp.concatenate([
+                        jnp.pad(c.chars, ((0, 0), (0, w - c.width)))
+                        for c in cs])
+                    lengths = jnp.concatenate([c.lengths for c in cs])
+                    out.append(DeviceColumn(cs[0].dtype, validity,
+                                            chars=chars, lengths=lengths))
+                else:
+                    out.append(DeviceColumn(
+                        cs[0].dtype, validity,
+                        data=jnp.concatenate([c.data for c in cs])))
+            return out
+
+        def fn(chunk_cols, nvalid, exhausted):
+            C = chunk_cols[0][0].capacity if chunk_cols else 0
+            batches = [ColumnarBatch(list(cs), nvalid[i], schema)
+                       for i, cs in enumerate(chunk_cols)]
+            all_words = []
+            bounds = []       # last valid key of each non-exhausted chunk
+            big = jnp.int64(9223372036854775807)
+            for i, b in enumerate(batches):
+                mask = jnp.arange(C) < nvalid[i]
+                words = keys_of(b)
+                all_words.append((words, mask))
+                last = jnp.clip(nvalid[i] - 1, 0, C - 1)
+                # exhausted or empty runs impose no bound
+                no_bound = exhausted[i] | (nvalid[i] == 0)
+                bounds.append([jnp.where(no_bound, big, w[last])
+                               for w in words])
+            bound = bounds[0]
+            for cand in bounds[1:]:
+                lt = jnp.zeros((), jnp.bool_)
+                eq = jnp.ones((), jnp.bool_)
+                for a, c in zip(bound, cand):
+                    lt = lt | (eq & (c < a))
+                    eq = eq & (c == a)
+                bound = [jnp.where(lt, c, a) for a, c in zip(bound, cand)]
+            # consumed per chunk + total window sort
+            consumed = []
+            for words, mask in all_words:
+                ok = le_bound(words, bound) & mask
+                consumed.append(jnp.sum(ok.astype(jnp.int32)))
+            mcols = cat_columns(batches, C, k)
+            mmask = jnp.concatenate(
+                [jnp.arange(C) < nvalid[i] for i in range(k)])
+            merged = ColumnarBatch(mcols, C * k, schema)
+            ctx = EvalContext(merged, ansi=ansi)
+            key_cols = [e.eval_tpu(ctx) for e, _ in orders]
+            specs = [s for _, s in orders]
+            perm = sort_permutation(key_cols, specs, mmask)
+            out = _gather_batch(merged, perm, C * k, schema)
+            from spark_rapids_tpu.ops.sortkeys import pack_sort_keys
+
+            mwords = [w[perm]
+                      for w in pack_sort_keys(key_cols, specs, mmask)]
+            emit = jnp.sum((le_bound(mwords, bound)
+                            & mmask[perm]).astype(jnp.int32))
+            return tuple(out.columns), emit, jnp.stack(consumed)
+
+        return jax.jit(fn)
 
 
 class TpuTopNExec(TpuExec):

@@ -2,16 +2,21 @@
 
 Reference analog (SURVEY.md §2.4 Window): GpuWindowExec with three
 strategies — running window (cumulative batch-streaming), double-pass
-cached, and batched bounded-window.  TPU redesign folds the first two into
-one jitted program built on `lax.associative_scan` segmented scans:
+cached, and batched bounded-window.  TPU redesign folds all three into one
+jitted program built on `lax.associative_scan` segmented scans:
 
-  * rank/dense_rank/row_number: order-key change flags + segmented cumsum
+  * rank/dense_rank/row_number/ntile/percent_rank/cume_dist: order-key
+    change flags + segmented cumsums / peer-group reductions
   * running frames (UNBOUNDED PRECEDING..CURRENT ROW): segmented inclusive
-    scans (sum/count/min/max)
+    scans (sum/count/min/max/avg)
   * unbounded frames: segment totals broadcast back
-  * bounded row frames: windowed differences of the running scan
-    (sum[i] - sum[i-k-1]) — the TPU counterpart of the reference's batched
-    bounded-window kernel.
+  * bounded ROWS frames (a PRECEDING..b FOLLOWING, both finite): statically
+    unrolled shifted combines masked at partition boundaries — the TPU
+    counterpart of the reference's batched bounded-window kernel (window
+    width is a plan-time constant; widths above _MAX_BOUNDED_WINDOW fall
+    back at tag time)
+  * lead/lag: shifted gathers with partition-boundary masking and literal
+    defaults (strings included)
 
 Rows are sorted by (partition keys, order keys), computed, and scattered
 back to the original order through the inverse permutation, so output row
@@ -125,8 +130,17 @@ class TpuWindowExec(TpuExec):
         pos_in_part = SEG.seg_scan_sum(
             jnp.ones(cap, jnp.int64), jnp.ones(cap, jnp.bool_), starts)[0] - 1
         for wf in self.functions:
-            vals_sorted, valid_sorted = self._one_function(
+            res = self._one_function(
                 wf, ctx, perm, seg, starts, ochange, pos_in_part, mask_s, cap)
+            if isinstance(res, DeviceColumn):
+                # column result (lead/lag incl. strings): gather back
+                out_cols.append(res.gather(inv_perm))
+                out_cols[-1] = DeviceColumn(
+                    res.dtype, out_cols[-1].validity & mask,
+                    data=out_cols[-1].data, chars=out_cols[-1].chars,
+                    lengths=out_cols[-1].lengths)
+                continue
+            vals_sorted, valid_sorted = res
             # scatter back to original order
             vals = vals_sorted[inv_perm]
             valid = valid_sorted[inv_perm] & mask
@@ -134,6 +148,12 @@ class TpuWindowExec(TpuExec):
             out_cols.append(DeviceColumn(wf.result_type, valid,
                                          data=vals.astype(sdt)))
         return tuple(out_cols)
+
+    def _part_sizes(self, seg, mask_s, pos_in_part, cap):
+        """Rows per partition, broadcast back to every row (sorted order)."""
+        cnt = jax.ops.segment_sum(mask_s.astype(jnp.int64), seg,
+                                  num_segments=cap)
+        return cnt[seg]
 
     def _one_function(self, wf: WindowFunction, ctx, perm, seg, starts,
                       ochange, pos_in_part, mask_s, cap):
@@ -149,6 +169,69 @@ class TpuWindowExec(TpuExec):
         if wf.func == "dense_rank":
             d = SEG.seg_scan_sum(ochange.astype(jnp.int64), ones, starts)[0]
             return d, ones
+        if wf.func == "percent_rank":
+            anchor = jnp.where(ochange, pos_in_part, jnp.int64(-1))
+            rank = SEG.seg_scan_max(anchor, ones, starts,
+                                    is_float=False)[0] + 1
+            nrows = self._part_sizes(seg, mask_s, pos_in_part, cap)
+            den = jnp.maximum(nrows - 1, 1)
+            return (rank - 1).astype(jnp.float64) / den, ones
+        if wf.func == "cume_dist":
+            # rows whose order key <= current = last row of the peer group
+            peer = jnp.cumsum(ochange.astype(jnp.int32)) - 1
+            peer = jnp.where(mask_s, peer, cap - 1)
+            last_pos = jax.ops.segment_max(
+                jnp.where(mask_s, pos_in_part, -1), peer, num_segments=cap)
+            nrows = self._part_sizes(seg, mask_s, pos_in_part, cap)
+            return ((last_pos[peer] + 1).astype(jnp.float64)
+                    / jnp.maximum(nrows, 1)), ones
+        if wf.func == "ntile":
+            nb = jnp.int64(max(int(wf.buckets), 1))
+            nrows = self._part_sizes(seg, mask_s, pos_in_part, cap)
+            q = nrows // nb
+            r = nrows % nb
+            p = pos_in_part
+            big = r * (q + 1)
+            bucket = jnp.where(
+                p < big, p // jnp.maximum(q + 1, 1),
+                r + (p - big) // jnp.maximum(q, 1))
+            return bucket + 1, ones
+        if wf.func in ("lead", "lag"):
+            c = wf.child.eval_tpu(ctx)
+            cs = c.gather(perm)
+            off = int(wf.offset) * (1 if wf.func == "lead" else -1)
+            iota = jnp.arange(cap, dtype=jnp.int32)
+            idx = iota + off
+            inb = (idx >= 0) & (idx < cap)
+            safe = jnp.clip(idx, 0, cap - 1)
+            same_part = inb & (seg[safe] == seg) & mask_s & mask_s[safe]
+            shifted = cs.gather(safe)
+            validity = jnp.where(same_part, shifted.validity, False)
+            if wf.default is not None:
+                from spark_rapids_tpu.expr.base import Literal
+
+                dflt = Literal(wf.default, wf.result_type).eval_tpu(ctx)
+                if cs.is_string:
+                    w = max(shifted.width, dflt.width)
+                    from spark_rapids_tpu.expr.predicates import _pad_to
+
+                    chars = jnp.where(same_part[:, None],
+                                      _pad_to(shifted.chars, w),
+                                      _pad_to(dflt.chars, w))
+                    lengths = jnp.where(same_part, shifted.lengths,
+                                        dflt.lengths)
+                    return DeviceColumn(wf.result_type,
+                                        validity | (~same_part & mask_s),
+                                        chars=chars, lengths=lengths)
+                data = jnp.where(same_part, shifted.data, dflt.data)
+                return DeviceColumn(wf.result_type,
+                                    validity | (~same_part & mask_s),
+                                    data=data)
+            if cs.is_string:
+                return DeviceColumn(wf.result_type, validity,
+                                    chars=shifted.chars,
+                                    lengths=shifted.lengths)
+            return DeviceColumn(wf.result_type, validity, data=shifted.data)
         c = wf.child.eval_tpu(ctx)
         vals = (c.data if not c.is_string else None)
         if vals is None:
@@ -157,6 +240,9 @@ class TpuWindowExec(TpuExec):
         valid_s = (c.validity & ctx.batch.row_mask)[perm]
         is_f = isinstance(wf.result_type, (T.FloatType, T.DoubleType))
         acc_vals = vals_s.astype(jnp.float64 if is_f else jnp.int64)
+        if isinstance(self.frame, tuple):
+            return self._bounded_frame(wf, acc_vals, valid_s, seg, mask_s,
+                                       cap, is_f)
         if self.frame == "running":
             if wf.func == "count":
                 _, cnt = SEG.seg_scan_sum(acc_vals, valid_s, starts)
@@ -190,3 +276,53 @@ class TpuWindowExec(TpuExec):
             m, has = SEG.seg_max(acc_vals, valid_s, seg, cap, is_f)
             return m[seg], has[seg]
         raise NotImplementedError(wf.func)
+
+    def _bounded_frame(self, wf, acc_vals, valid_s, seg, mask_s, cap, is_f):
+        """ROWS BETWEEN a PRECEDING AND b FOLLOWING via statically unrolled
+        shifted combines (window width is a plan-time constant)."""
+        a, b = self.frame
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        total = jnp.zeros(cap, acc_vals.dtype)
+        cnt = jnp.zeros(cap, jnp.int64)
+        if is_f:
+            mn = jnp.full(cap, jnp.inf)
+            mx = jnp.full(cap, -jnp.inf)
+            cnt_nonnan = jnp.zeros(cap, jnp.int64)
+        else:
+            mn = jnp.full(cap, jnp.iinfo(acc_vals.dtype).max, acc_vals.dtype)
+            mx = jnp.full(cap, jnp.iinfo(acc_vals.dtype).min, acc_vals.dtype)
+        for d in range(-int(a), int(b) + 1):
+            idx = iota + d
+            inb = (idx >= 0) & (idx < cap)
+            safe = jnp.clip(idx, 0, cap - 1)
+            ok = inb & (seg[safe] == seg) & mask_s & mask_s[safe] \
+                & valid_s[safe]
+            v = acc_vals[safe]
+            total = total + jnp.where(ok, v, 0)
+            cnt = cnt + ok.astype(jnp.int64)
+            if is_f:
+                nan = jnp.isnan(v)
+                mn = jnp.where(ok & ~nan, jnp.minimum(mn, v), mn)
+                mx = jnp.where(ok & nan, jnp.nan,
+                               jnp.where(ok, jnp.maximum(mx, v), mx))
+                cnt_nonnan = cnt_nonnan + (ok & ~nan).astype(jnp.int64)
+            else:
+                mn = jnp.where(ok, jnp.minimum(mn, v), mn)
+                mx = jnp.where(ok, jnp.maximum(mx, v), mx)
+        has = cnt > 0
+        if wf.func == "count":
+            return cnt, jnp.ones(cap, jnp.bool_)
+        if wf.func == "sum":
+            return total, has
+        if wf.func == "avg":
+            return (total.astype(jnp.float64)
+                    / jnp.maximum(cnt, 1)), has
+        if wf.func == "min":
+            if is_f:
+                # all-NaN window -> NaN (NaN greatest, min only if nothing else)
+                only_nan = has & (cnt_nonnan == 0)
+                return jnp.where(only_nan, jnp.nan, mn), has
+            return mn, has
+        if wf.func == "max":
+            return mx, has
+        raise NotImplementedError(f"bounded frame {wf.func}")

@@ -147,9 +147,14 @@ def murmur3_columns(cols: List[DeviceColumn], seed: int = 42) -> jax.Array:
     return h.astype(jnp.int32)
 
 
-def spark_partition_ids(cols: List[DeviceColumn], num_partitions: int) -> jax.Array:
-    """GpuHashPartitioning: pmod(murmur3(keys), numPartitions)."""
-    h = murmur3_columns(cols, seed=42)
+def spark_partition_ids(cols: List[DeviceColumn], num_partitions: int,
+                        seed: int = 42) -> jax.Array:
+    """GpuHashPartitioning: pmod(murmur3(keys), numPartitions).
+
+    Sub-partitioned joins pass a different seed so bucket assignment is
+    decorrelated from the upstream exchange's partitioning (reference:
+    GpuSubPartitionHashJoin's distinct hash seed)."""
+    h = murmur3_columns(cols, seed=seed)
     p = h % jnp.int32(num_partitions)
     return jnp.where(p < 0, p + num_partitions, p)
 

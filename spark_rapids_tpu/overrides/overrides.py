@@ -15,7 +15,9 @@ from typing import Callable, Dict, List, Optional, Type
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.config import (
+    BATCH_SIZE_BYTES,
     ENABLE_CAST_STRING_TO_TIMESTAMP,
+    MAX_READER_BATCH_SIZE_ROWS,
     TpuConf,
 )
 from spark_rapids_tpu.expr import arithmetic as A
@@ -359,7 +361,10 @@ _AGG_FUNCS_SUPPORTED = {"sum", "count", "count_star", "min", "max", "avg",
                         "first", "last", "var_pop", "var_samp", "stddev_pop",
                         "stddev_samp"}
 _WINDOW_FUNCS_SUPPORTED = {"row_number", "rank", "dense_rank", "sum", "count",
-                           "min", "max", "avg"}
+                           "min", "max", "avg", "lead", "lag", "ntile",
+                           "percent_rank", "cume_dist"}
+# bounded ROWS frames unroll shifted combines; cap the static window width
+_MAX_BOUNDED_WINDOW = 256
 _JOIN_TYPES_SUPPORTED = {PN.JoinType.INNER, PN.JoinType.LEFT_OUTER,
                          PN.JoinType.RIGHT_OUTER, PN.JoinType.FULL_OUTER,
                          PN.JoinType.LEFT_SEMI, PN.JoinType.LEFT_ANTI,
@@ -401,9 +406,19 @@ def _window_check(meta: SparkPlanMeta):
         if f.func not in _WINDOW_FUNCS_SUPPORTED:
             meta.will_not_work_on_tpu(
                 f"window function {f.func} is not supported on TPU")
-        if f.child is not None and isinstance(f.child._dataType, T.StringType):
+        if (f.func not in ("lead", "lag") and f.child is not None
+                and isinstance(f.child._dataType, T.StringType)):
             meta.will_not_work_on_tpu(
                 "string-valued window aggregates not supported on TPU")
+    if isinstance(plan.frame, tuple):
+        a, b = plan.frame
+        if a < 0 or b < 0:
+            meta.will_not_work_on_tpu(
+                "bounded window frame offsets must be non-negative")
+        elif a + b + 1 > _MAX_BOUNDED_WINDOW:
+            meta.will_not_work_on_tpu(
+                f"bounded window width {a + b + 1} exceeds the TPU unroll "
+                f"cap ({_MAX_BOUNDED_WINDOW})")
 
 
 def _scan_check(meta: SparkPlanMeta):
@@ -522,8 +537,10 @@ def _convert_node(meta: SparkPlanMeta, tpu_children, ansi: bool):
     if isinstance(plan, PN.LocalTableScan):
         from spark_rapids_tpu.config import TPU_SCAN_CACHE
 
+        rows_cap = meta.conf.get(MAX_READER_BATCH_SIZE_ROWS)
         return X.TpuLocalTableScanExec(
             plan.host_columns, plan.output,
+            target_batch_rows=rows_cap if rows_cap < 2147483647 else None,
             cache_device=meta.conf.get(TPU_SCAN_CACHE), cache_slot=plan)
     if isinstance(plan, PN.FileSourceScan):
         return TpuFileSourceScanExec(plan, meta.conf)
@@ -543,14 +560,16 @@ def _convert_node(meta: SparkPlanMeta, tpu_children, ansi: bool):
                                            plan.output, plan.condition, ansi)
         return X.TpuShuffledSymmetricHashJoinExec(
             tpu_children[0], tpu_children[1], plan.left_keys, plan.right_keys,
-            plan.join_type, plan.condition, plan.output, ansi)
+            plan.join_type, plan.condition, plan.output, ansi,
+            sub_partition_bytes=meta.conf.get(BATCH_SIZE_BYTES))
     if isinstance(plan, PN.BroadcastHashJoin):
         return X.TpuBroadcastHashJoinExec(
             tpu_children[0], tpu_children[1], plan.left_keys, plan.right_keys,
-            plan.join_type, plan.condition, plan.output, ansi)
+            plan.join_type, plan.condition, plan.output, ansi,
+            sub_partition_bytes=meta.conf.get(BATCH_SIZE_BYTES))
     if isinstance(plan, PN.Sort):
         return X.TpuSortExec(plan.orders, plan.is_global, tpu_children[0],
-                             ansi)
+                             ansi, ooc_bytes=meta.conf.get(BATCH_SIZE_BYTES))
     if isinstance(plan, PN.Window):
         return X.TpuWindowExec(plan.functions, plan.partition_by,
                                plan.order_by, tpu_children[0], plan.output,

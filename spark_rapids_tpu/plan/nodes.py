@@ -383,18 +383,26 @@ class BroadcastExchange(SparkPlan):
 
 @dataclasses.dataclass
 class WindowFunction:
-    """window function spec: func over (partition, order, frame)."""
+    """window function spec: func over (partition, order, frame).
+
+    lead/lag carry ``offset`` (+ optional literal ``default``); ntile
+    carries ``buckets``."""
 
     func: str                      # row_number, rank, dense_rank, sum, ...
     child: Optional[Expression]
     result_name: str
     result_type: Optional[T.DataType] = None
+    offset: int = 1                # lead/lag
+    default: Optional[object] = None   # lead/lag literal default
+    buckets: int = 2               # ntile
 
     def resolve(self, schema):
         if self.child is not None:
             self.child = self.child.resolve(schema)
-        if self.func in ("row_number", "rank", "dense_rank"):
+        if self.func in ("row_number", "rank", "dense_rank", "ntile"):
             self.result_type = T.INT
+        elif self.func in ("percent_rank", "cume_dist"):
+            self.result_type = T.DOUBLE
         elif self.func == "count":
             self.result_type = T.LONG
         elif self.func == "sum":

@@ -210,3 +210,127 @@ def test_multibatch_aggregate_merge_path():
 
     assert_tpu_and_cpu_are_equal_collect(
         build, conf={"spark.rapids.sql.batchSizeBytes": "1k"})
+
+
+# -- out-of-core operation under a tiny pool (SURVEY §5.7) -------------------
+
+_OOC_CONF = {
+    "spark.rapids.sql.enabled": True,
+    # ~10x the data must not fit: tiny pool + forced multi-batch scan
+    "spark.rapids.tpu.test.deviceMemoryBytes": 256 << 10,
+    "spark.rapids.sql.batchSizeBytes": 64 << 10,
+    "spark.rapids.sql.reader.batchSizeRows": 900,
+}
+
+
+def _fresh_frameworks(conf):
+    from spark_rapids_tpu.memory.device_manager import reset_device_manager
+    from spark_rapids_tpu.memory.spill import (
+        get_spill_framework,
+        reset_spill_framework,
+    )
+    from spark_rapids_tpu.config import TpuConf
+
+    reset_spill_framework()
+    try:
+        reset_device_manager()
+    except Exception:
+        pass
+    return get_spill_framework(TpuConf(conf))
+
+
+def test_out_of_core_sort_matches_oracle_with_spill(tmp_path):
+    import sys
+    sys.path.insert(0, "tests")
+    from asserts import assert_tpu_and_cpu_are_equal_collect
+    from data_gen import IntegerGen, StringGen, gen_df
+    from spark_rapids_tpu.session import col
+
+    conf = dict(_OOC_CONF)
+    conf["spark.rapids.memory.spill.dir"] = str(tmp_path)
+    _fresh_frameworks(conf)
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(), StringGen(min_len=1, max_len=24),
+                        IntegerGen()], ["a", "t", "b"], length=6000)
+        return df.order_by("a", "t")
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=conf,
+                                         ignore_order=False)
+    from spark_rapids_tpu.memory.spill import get_spill_framework
+
+    fw = get_spill_framework()   # the one the collect actually used
+    assert fw.spill_to_host_count > 0, "expected device->host spills"
+
+
+def test_sub_partitioned_join_matches_oracle_with_spill(tmp_path):
+    import sys
+    sys.path.insert(0, "tests")
+    from asserts import assert_tpu_and_cpu_are_equal_collect
+    from data_gen import IntegerGen, StringGen, gen_df
+    from spark_rapids_tpu.session import col
+
+    conf = dict(_OOC_CONF)
+    conf["spark.rapids.memory.spill.dir"] = str(tmp_path)
+    _fresh_frameworks(conf)
+
+    def build(s):
+        left = gen_df(s, [IntegerGen(min_val=0, max_val=4000),
+                          StringGen(min_len=4, max_len=20)],
+                      ["k", "x"], length=5000)
+        right = gen_df(s, [IntegerGen(min_val=0, max_val=4000),
+                           StringGen(min_len=4, max_len=20)],
+                       ["k", "y"], length=5000, seed=99)
+        return left.join(right, on="k", how="inner")
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=conf)
+    from spark_rapids_tpu.memory.spill import get_spill_framework
+
+    fw = get_spill_framework()
+    assert fw.spill_to_host_count > 0, "expected device->host spills"
+
+
+@pytest.mark.parametrize("how", ["left", "full", "semi", "anti"])
+def test_sub_partitioned_join_types(how, tmp_path):
+    import sys
+    sys.path.insert(0, "tests")
+    from asserts import assert_tpu_and_cpu_are_equal_collect
+    from data_gen import IntegerGen, gen_df
+
+    conf = dict(_OOC_CONF)
+    conf["spark.rapids.memory.spill.dir"] = str(tmp_path)
+    _fresh_frameworks(conf)
+
+    def build(s):
+        left = gen_df(s, [IntegerGen(min_val=0, max_val=2000),
+                          IntegerGen()], ["k", "x"], length=3500)
+        right = gen_df(s, [IntegerGen(min_val=0, max_val=2000),
+                           IntegerGen()], ["k", "y"], length=3500, seed=5)
+        return left.join(right, on="k", how=how)
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=conf)
+
+
+def test_sub_partitioned_join_mismatched_key_ordinals(tmp_path):
+    """Build and probe keys at different column ordinals: the bucketing jits
+    must not be shared between sides (code-review regression)."""
+    import sys
+    sys.path.insert(0, "tests")
+    from asserts import assert_tpu_and_cpu_are_equal_collect
+    from data_gen import IntegerGen, StringGen, gen_df
+    from spark_rapids_tpu.session import col
+
+    conf = dict(_OOC_CONF)
+    conf["spark.rapids.memory.spill.dir"] = str(tmp_path)
+    _fresh_frameworks(conf)
+
+    def build(s):
+        left = gen_df(s, [StringGen(min_len=3, max_len=12),
+                          IntegerGen(min_val=0, max_val=1500)],
+                      ["pad", "k"], length=4000)       # key at ordinal 1
+        right = gen_df(s, [IntegerGen(min_val=0, max_val=1500),
+                           StringGen(min_len=3, max_len=12)],
+                       ["k", "pad2"], length=4000, seed=11)  # key at ordinal 0
+        return left.join(right, on="k", how="inner")
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=conf)

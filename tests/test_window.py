@@ -75,3 +75,72 @@ def test_window_no_partition():
                          order_by=[(col("o"), SortSpec())])
 
     assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("frame", [(2, 3), (0, 5), (4, 0), (1, 1)],
+                         ids=lambda f: f"{f[0]}p_{f[1]}f")
+def test_bounded_row_frames(frame):
+    def build(s):
+        return _wdf(s, [WindowFunction("sum", col("v"), "sv"),
+                        WindowFunction("count", col("v"), "cv"),
+                        WindowFunction("avg", col("v"), "av"),
+                        WindowFunction("min", col("v"), "mn"),
+                        WindowFunction("max", col("v"), "mx")], frame)
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_bounded_frame_double():
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=4),
+                        IntegerGen(min_val=0, max_val=1000),
+                        DoubleGen()], ["p", "o", "v"], length=250)
+        return df.window([WindowFunction("sum", col("v"), "sv"),
+                          WindowFunction("min", col("v"), "mn")],
+                         partition_by=["p"],
+                         order_by=[(col("o"), SortSpec())], frame=(3, 2))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+@pytest.mark.parametrize("func,off,dflt", [
+    ("lead", 1, None), ("lag", 1, None), ("lead", 3, None),
+    ("lag", 2, None), ("lead", 1, 42), ("lag", 2, -7)])
+def test_lead_lag(func, off, dflt):
+    def build(s):
+        return _wdf(s, [WindowFunction(func, col("v"), "r",
+                                       offset=off, default=dflt)])
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_lead_lag_strings():
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=4),
+                        IntegerGen(min_val=0, max_val=1000),
+                        StringGen(min_len=1, max_len=8)],
+                    ["p", "o", "v"], length=200)
+        return df.window([WindowFunction("lead", col("v"), "ld"),
+                          WindowFunction("lag", col("v"), "lg")],
+                         partition_by=["p"],
+                         order_by=[(col("o"), SortSpec())])
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_ntile_percent_rank_cume_dist():
+    def build(s):
+        return _wdf(s, [WindowFunction("ntile", None, "nt", buckets=4),
+                        WindowFunction("percent_rank", None, "pr"),
+                        WindowFunction("cume_dist", None, "cd")])
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_wide_bounded_frame_falls_back():
+    from asserts import assert_tpu_fallback_collect
+
+    def build(s):
+        return _wdf(s, [WindowFunction("sum", col("v"), "sv")], (300, 300))
+
+    assert_tpu_fallback_collect(build, "Window")
