@@ -1366,6 +1366,28 @@ def _h_format_time(e, cols, n, ansi):
     return CpuCol(T.STRING, out, c.validity.copy())
 
 
+def _h_udf(e, cols, n, ansi):
+    """Row-based UDF evaluation — the CPU truth (reference: the original
+    Scala UDF body that RapidsUDF accelerates)."""
+    kids = _kids(e, cols, n, ansi)
+    out_vals = []
+    validity = np.ones(n, np.bool_)
+    for i in range(n):
+        args = [k.row(i) for k in kids]
+        v = e.fn(*args)
+        if v is None:
+            validity[i] = False
+        out_vals.append(v)
+    dt = e.dataType
+    if isinstance(dt, (T.StringType, T.DecimalType)):
+        arr = np.array([v if v is not None else None for v in out_vals],
+                       object)
+    else:
+        arr = np.array([v if v is not None else 0 for v in out_vals],
+                       T.storage_dtype(dt))
+    return CpuCol(dt, arr, validity)
+
+
 def _h_octetbit(e, cols, n, ansi):
     (c,) = _kids(e, cols, n, ansi)
     mult = 8 if type(e).__name__ == "BitLength" else 1
@@ -1801,6 +1823,7 @@ _HANDLERS = {
     "StringLocate": _h_locate, "StringLPad": _h_pad, "StringRPad": _h_pad,
     "StringRepeat": _h_repeat, "ConcatWs": _h_concat_ws,
     "OctetLength": _h_octetbit, "BitLength": _h_octetbit,
+    "UserDefinedExpression": _h_udf,
     "StringLeft": _h_leftright, "StringRight": _h_leftright,
     "SubstringIndex": _h_substring_index,
 }
@@ -1822,6 +1845,12 @@ def execute_cpu_plan(plan: PN.SparkPlan, ansi: bool = False) -> Tuple[CpuBatch, 
         return cols, n
     if isinstance(plan, PN.FileSourceScan):
         return _cpu_file_scan(plan)
+    if isinstance(plan, PN.CachedRelation):
+        cached = plan.cache_slot.get("cpu")
+        if cached is None:
+            cached = execute_cpu_plan(plan.child, ansi)
+            plan.cache_slot["cpu"] = cached
+        return cached
     if isinstance(plan, PN.RangeNode):
         vals = np.arange(plan.start, plan.end, plan.step, dtype=np.int64)
         return [CpuCol(T.LONG, vals, np.ones(len(vals), np.bool_))], len(vals)
@@ -2208,6 +2237,10 @@ def _agg_one(a: PN.AggregateExpression, ac: Optional[CpuCol],
         vals = np.array([v if v is not None else None for v in out], object)
     else:
         sdt = T.storage_dtype(a.result_type)
+        if a.result_type.is_integral:
+            # Spark sum(long) wraps silently in non-ANSI mode (Java +)
+            out = [((int(v) + 2 ** 63) % 2 ** 64) - 2 ** 63
+                   if v is not None else None for v in out]
         vals = np.array([v if v is not None else 0 for v in out], sdt)
     return vals, valid
 
@@ -2468,8 +2501,18 @@ def _wagg(wf, acc, valid, i):
         return sum(acc) if not isinstance(acc[0], float) else float(sum(acc))
     if wf.func == "avg":
         return float(sum(float(v) for v in acc)) / len(acc)
+    floats = isinstance(acc[0], float) or isinstance(acc[0], np.floating)
     if wf.func == "min":
+        if floats:
+            # Spark total order: NaN is the GREATEST value — min prefers
+            # any non-NaN (python min() is positional on NaN)
+            non_nan = [v for v in acc if not math.isnan(v)]
+            return min(non_nan) if non_nan else float("nan")
         return min(acc)
     if wf.func == "max":
+        if floats:
+            if any(math.isnan(float(v)) for v in acc):
+                return float("nan")
+            return max(acc)
         return max(acc)
     raise NotImplementedError(wf.func)

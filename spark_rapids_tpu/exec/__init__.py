@@ -1,6 +1,7 @@
 from spark_rapids_tpu.exec.base import TpuExec, TpuMetric  # noqa: F401
 from spark_rapids_tpu.exec.basic import (  # noqa: F401
     TpuFilterExec,
+    TpuInMemoryTableScanExec,
     TpuLocalTableScanExec,
     TpuProjectExec,
     TpuRangeExec,

@@ -342,3 +342,53 @@ class TpuUnionExec(TpuExec):
         for c in self.children:
             for b in c.execute_columnar():
                 yield self._count_output(b)
+
+
+class TpuInMemoryTableScanExec(TpuExec):
+    """df.cache() exec: first run materializes the child's batches into
+    SPILLABLE handles stored on the plan node (so the cache survives
+    re-planning and is reclaimable under memory pressure); later runs
+    replay them.
+
+    Reference analog: GpuInMemoryTableScanExec + ParquetCachedBatchSerializer
+    (SURVEY.md §2.8) — device-resident cached batches instead of
+    parquet-encoded host buffers (HBM spill handles play the same role)."""
+
+    def __init__(self, child: TpuExec, cache_slot: dict):
+        super().__init__([child])
+        self.cache_slot = cache_slot
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def describe(self):
+        state = "hit" if "tpu" in self.cache_slot else "cold"
+        return f"TpuInMemoryTableScan [{state}]"
+
+    def execute_columnar(self):
+        from spark_rapids_tpu.memory.spill import get_spill_framework
+
+        cached = self.cache_slot.get("tpu")
+        if cached is None:
+            # materialize eagerly BEFORE yielding: an abandoned generator
+            # (e.g. a limit above the cache) must not leak tracked handles
+            # or leave a partial cache
+            fw = get_spill_framework()
+            acc = []
+            try:
+                for b in self.children[0].execute_columnar():
+                    acc.append(fw.track(b))
+            except BaseException:
+                for s in acc:
+                    s.close()
+                raise
+            self.cache_slot["tpu"] = acc
+            cached = acc
+        for s in cached:
+            s.pin()
+            try:
+                b = s.get_batch()
+            finally:
+                s.unpin()
+            yield self._count_output(b)

@@ -331,19 +331,28 @@ class _BaseTpuJoinExec(TpuExec):
         probe_buckets = self._sub_partition(
             [fw.track(b) for b in self._probe_child().execute_columnar()],
             self.left_keys, n_parts, "probe", pschema, fw)
-        for pid in range(n_parts):
-            if not build_buckets[pid] and not probe_buckets[pid]:
-                continue
-            sub = TpuShuffledSymmetricHashJoinExec(
-                _MaterializedExec(probe_buckets[pid], pschema),
-                _MaterializedExec(build_buckets[pid], bschema),
-                self.left_keys, self.right_keys, self.join_type,
-                self.condition, self._output, self.ansi,
-                sub_partition_bytes=1 << 62)  # buckets never re-partition
-            for out in sub.execute_columnar():
-                yield self._count_output(out)
-            for s in build_buckets[pid] + probe_buckets[pid]:
-                s.close()
+        try:
+            for pid in range(n_parts):
+                if not build_buckets[pid] and not probe_buckets[pid]:
+                    continue
+                sub = TpuShuffledSymmetricHashJoinExec(
+                    _MaterializedExec(probe_buckets[pid], pschema),
+                    _MaterializedExec(build_buckets[pid], bschema),
+                    self.left_keys, self.right_keys, self.join_type,
+                    self.condition, self._output, self.ansi,
+                    sub_partition_bytes=1 << 62)  # buckets never re-partition
+                for out in sub.execute_columnar():
+                    yield self._count_output(out)
+                for s in build_buckets[pid] + probe_buckets[pid]:
+                    s.close()
+                build_buckets[pid] = []
+                probe_buckets[pid] = []
+        finally:
+            # an abandoned generator (limit above the join) must not leave
+            # tracked handles registered for the session
+            for pid in range(n_parts):
+                for s in build_buckets[pid] + probe_buckets[pid]:
+                    s.close()
 
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
         jt = self.join_type
@@ -359,9 +368,14 @@ class _BaseTpuJoinExec(TpuExec):
         # that matters)
         build_spill = []
         total_build_bytes = 0
-        for b in self._build_child().execute_columnar():
-            total_build_bytes += b.nbytes()
-            build_spill.append(fw0.track(b))
+        try:
+            for b in self._build_child().execute_columnar():
+                total_build_bytes += b.nbytes()
+                build_spill.append(fw0.track(b))
+        except BaseException:
+            for s in build_spill:
+                s.close()
+            raise
         if (total_build_bytes > self.sub_partition_bytes and self.left_keys
                 and jt != JoinType.CROSS):
             yield from self._execute_sub_partitioned(build_spill,

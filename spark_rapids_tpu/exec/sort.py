@@ -222,6 +222,27 @@ class TpuSortExec(TpuExec):
 
         def fn(chunk_cols, nvalid, exhausted):
             C = chunk_cols[0][0].capacity if chunk_cols else 0
+            # normalize string widths across chunks: pack_sort_keys emits one
+            # word per 8 chars, so differing widths would misalign the
+            # word-by-word bound comparisons
+            ncols = len(chunk_cols[0])
+            widths = [max(cs[ci].width for cs in chunk_cols)
+                      for ci in range(ncols)]
+            from spark_rapids_tpu.expr.predicates import _pad_to
+
+            norm = []
+            for cs in chunk_cols:
+                row = []
+                for ci, c in enumerate(cs):
+                    if c.is_string and c.width < widths[ci]:
+                        row.append(DeviceColumn(
+                            c.dtype, c.validity,
+                            chars=_pad_to(c.chars, widths[ci]),
+                            lengths=c.lengths))
+                    else:
+                        row.append(c)
+                norm.append(row)
+            chunk_cols = norm
             batches = [ColumnarBatch(list(cs), nvalid[i], schema)
                        for i, cs in enumerate(chunk_cols)]
             all_words = []

@@ -1,0 +1,91 @@
+"""User-defined functions on TPU — the RapidsUDF hook.
+
+Reference analog: com.nvidia.spark.RapidsUDF (sql-plugin-api, SURVEY.md
+§2.8): a UDF author opts into GPU execution by ALSO implementing
+``evaluateColumnar(ColumnVector...)``; GpuOverrides detects the interface
+and replaces the row-based UDF, otherwise the UDF stays on CPU with an
+explain reason.
+
+TPU counterpart: a ``TpuUDF`` implements
+
+  * ``evaluate_columnar(*cols: DeviceColumn) -> DeviceColumn`` — a
+    jax-traceable columnar kernel (runs inside the enclosing stage's jitted
+    program, so it fuses with the surrounding expressions); and
+  * ``__call__(*scalars) -> scalar`` — the original row-based function,
+    which is what the CPU oracle (and Spark) executes.
+
+A plain Python function (no ``evaluate_columnar``) is still usable: the
+plan tags the expression ``willNotWorkOnTpu`` and the whole stage falls
+back to CPU row evaluation, mirroring the reference's behavior for
+un-accelerated UDFs.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.base import EvalContext, Expression
+
+
+class TpuUDF:
+    """Base class (optional — duck typing suffices) for TPU-enabled UDFs."""
+
+    def evaluate_columnar(self, *cols):
+        raise NotImplementedError
+
+    def __call__(self, *args):
+        raise NotImplementedError
+
+
+def supports_columnar(fn) -> bool:
+    m = getattr(fn, "evaluate_columnar", None)
+    if not callable(m):
+        return False
+    # a TpuUDF subclass that only implements __call__ inherits the base's
+    # raising stub — that is NOT a columnar implementation
+    impl = getattr(type(fn), "evaluate_columnar", None)
+    return impl is not TpuUDF.evaluate_columnar
+
+
+class UserDefinedExpression(Expression):
+    """ScalaUDF / GpuScalaUDF analog wrapping a python callable."""
+
+    def __init__(self, fn, children: List[Expression],
+                 dataType: T.DataType, name: str = "udf"):
+        super().__init__(list(children))
+        self.fn = fn
+        self._dataType = dataType
+        self._nullable = True
+        self._name = name
+
+    def sql_string(self):
+        args = ", ".join(c.sql_string() for c in self.children)
+        return f"{self._name}({args})"
+
+    @property
+    def name(self):
+        return self._name
+
+    def _resolve_type(self):
+        pass  # dataType fixed at construction (like ScalaUDF)
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        out = self.fn.evaluate_columnar(*cols)
+        if out.dtype != self._dataType:
+            raise TypeError(
+                f"UDF {self._name} returned {out.dtype.simpleString}, "
+                f"declared {self._dataType.simpleString}")
+        return out
+
+
+def udf(fn, return_type: T.DataType, name: str = "udf"):
+    """pyspark-flavored helper: udf(fn, T.INT)(col("a"), col("b"))."""
+
+    def make(*children):
+        from spark_rapids_tpu.expr.base import Expression, Literal
+
+        kids = [c if isinstance(c, Expression) else Literal.of(c)
+                for c in children]
+        return UserDefinedExpression(fn, kids, return_type, name)
+
+    return make

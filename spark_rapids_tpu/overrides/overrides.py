@@ -29,6 +29,7 @@ from spark_rapids_tpu.expr import hashexprs as H
 from spark_rapids_tpu.expr import mathfuncs as M
 from spark_rapids_tpu.expr import predicates as P
 from spark_rapids_tpu.expr import strings as S
+from spark_rapids_tpu.expr import udf as U
 from spark_rapids_tpu.overrides.meta import ExprMeta, SparkPlanMeta
 from spark_rapids_tpu.plan import nodes as PN
 
@@ -188,6 +189,18 @@ def _check_time_format(meta: ExprMeta):
             f"letters (supported: yyyy MM dd HH mm ss + separators)")
 
 
+def _check_udf(meta: ExprMeta):
+    """RapidsUDF detection: only UDFs exposing a columnar kernel run on
+    TPU; plain python functions fall back with the reference's explain
+    wording."""
+    from spark_rapids_tpu.expr.udf import supports_columnar
+
+    if not supports_columnar(meta.expr.fn):
+        meta.will_not_work_on_tpu(
+            f"UDF {meta.expr.name} does not implement evaluate_columnar "
+            f"(TpuUDF); it will run row-based on CPU")
+
+
 def _check_substring_index(meta: ExprMeta):
     """Delimiter must be a literal without a self-overlap border (so left
     and right non-overlapping scans agree with Spark's byte scans)."""
@@ -345,6 +358,9 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
         extra_check=_check_time_format),
     H.Murmur3Hash: ExprRule(_COMMON128, desc="Spark murmur3 hash"),
     H.XxHash64: ExprRule(_COMMON128, desc="Spark xxhash64"),
+    U.UserDefinedExpression: ExprRule(
+        _DEC128_FULL, extra_check=_check_udf,
+        desc="TpuUDF (RapidsUDF analog): columnar jax kernel"),
 }
 
 
@@ -493,6 +509,7 @@ def _exchange_check(meta: SparkPlanMeta):
 
 
 _exec(PN.LocalTableScan)
+_exec(PN.CachedRelation, desc="GpuInMemoryTableScanExec analog")
 _exec(PN.FileSourceScan, extra=_scan_check)
 _exec(PN.InsertIntoHadoopFsRelation, extra=_write_check,
       desc="GpuDataWritingCommandExec analog")
@@ -546,6 +563,8 @@ def _convert_node(meta: SparkPlanMeta, tpu_children, ansi: bool):
         return TpuFileSourceScanExec(plan, meta.conf)
     if isinstance(plan, PN.RangeNode):
         return X.TpuRangeExec(plan.start, plan.end, plan.step)
+    if isinstance(plan, PN.CachedRelation):
+        return X.TpuInMemoryTableScanExec(tpu_children[0], plan.cache_slot)
     if isinstance(plan, PN.Project):
         return X.TpuProjectExec(plan.exprs, tpu_children[0], ansi)
     if isinstance(plan, PN.Filter):

@@ -67,6 +67,31 @@ class LocalTableScan(SparkPlan):
         return f"LocalTableScan {self._schema.simpleString}"
 
 
+class CachedRelation(SparkPlan):
+    """df.cache(): materialized child batches reused across actions.
+
+    Reference analog: InMemoryRelation backed by the
+    ParquetCachedBatchSerializer (SURVEY.md §2.8) — the plugin caches
+    DataFrames as device-encodable batches.  Here the cache holds DEVICE
+    batches registered with the spill framework, so cached data is
+    reclaimable under memory pressure like any other batch."""
+
+    def __init__(self, child: SparkPlan):
+        super().__init__([child])
+        self.cache_slot = {}       # filled by the exec / oracle on first run
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def describe(self):
+        return "InMemoryRelation [cached]"
+
+
 class FileSourceScan(SparkPlan):
     def __init__(self, fmt: str, paths: List[str], schema: T.StructType,
                  pushed_filters: Optional[List[Expression]] = None,

@@ -294,6 +294,28 @@ class DataFrame:
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(PN.GlobalLimit(n, self.plan), self.session)
 
+    def cache(self) -> "DataFrame":
+        """Materialize this DataFrame's batches on first action and reuse
+        them (ParquetCachedBatchSerializer analog; device batches held as
+        spillable handles)."""
+        if isinstance(self.plan, PN.CachedRelation):
+            return self
+        return DataFrame(PN.CachedRelation(self.plan), self.session)
+
+    persist = cache
+
+    def unpersist(self) -> "DataFrame":
+        if isinstance(self.plan, PN.CachedRelation):
+            for handles in self.plan.cache_slot.values():
+                if isinstance(handles, list):
+                    for h in handles:
+                        try:
+                            h.close()
+                        except Exception:
+                            pass
+            self.plan.cache_slot.clear()
+        return self
+
     def window(self, functions: List[PN.WindowFunction],
                partition_by: Sequence[ColumnLike],
                order_by: Sequence, frame: str = "running") -> "DataFrame":

@@ -334,3 +334,37 @@ def test_sub_partitioned_join_mismatched_key_ordinals(tmp_path):
         return left.join(right, on="k", how="inner")
 
     assert_tpu_and_cpu_are_equal_collect(build, conf=conf)
+
+
+def test_out_of_core_sort_mixed_string_widths(tmp_path):
+    """Runs whose string columns land in different width buckets: the merge
+    must align key words across chunks (code-review regression)."""
+    import sys
+    sys.path.insert(0, "tests")
+    from asserts import assert_tpu_and_cpu_are_equal_collect
+    from data_gen import SetValuesGen
+    from spark_rapids_tpu import types as T
+
+    conf = dict(_OOC_CONF)
+    conf["spark.rapids.memory.spill.dir"] = str(tmp_path)
+    conf["spark.rapids.sql.reader.batchSizeRows"] = 400
+    _fresh_frameworks(conf)
+
+    short = ["a", "bb", "cc", "d"]
+    long_ = ["x" * 30, "y" * 25, "z" * 28, "w" * 31]
+
+    def build(s):
+        import random
+        rng = random.Random(7)
+        # first half short strings (width bucket 8), second half long (32):
+        # consecutive scan batches land in different buckets
+        vals = [rng.choice(short) for _ in range(1200)] \
+            + [rng.choice(long_) for _ in range(1200)]
+        nums = [rng.randint(0, 50) for _ in range(2400)]
+        schema = T.StructType([T.StructField("t", T.STRING),
+                               T.StructField("n", T.INT)])
+        return s.create_dataframe({"t": vals, "n": nums}, schema) \
+                .order_by("t", "n")
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=conf,
+                                         ignore_order=False)

@@ -1,0 +1,139 @@
+"""TpuUDF hook + df.cache tests (reference: RapidsUDF + PCBS suites,
+SURVEY.md §2.8)."""
+import jax.numpy as jnp
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expr.udf import TpuUDF, udf
+from spark_rapids_tpu.session import col, lit, sum_
+
+from asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    assert_tpu_fallback_collect,
+    assert_plan_on_tpu,
+)
+from data_gen import IntegerGen, LongGen, gen_df
+
+
+class _FusedMultiplyAdd(TpuUDF):
+    """x*y + 1 with a columnar jax kernel (the RapidsUDF pattern)."""
+
+    def evaluate_columnar(self, x: DeviceColumn, y: DeviceColumn):
+        data = x.data.astype(jnp.int64) * y.data.astype(jnp.int64) + 1
+        return DeviceColumn(T.LONG, x.validity & y.validity, data=data)
+
+    def __call__(self, x, y):
+        if x is None or y is None:
+            return None
+        return int(x) * int(y) + 1
+
+
+def _plain_fn(x, y):
+    return None if x is None or y is None else int(x) * int(y) + 1
+
+
+def test_columnar_udf_runs_on_tpu():
+    fma = udf(_FusedMultiplyAdd(), T.LONG, name="fma")
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(), IntegerGen()], ["a", "b"], length=300)
+        return df.select(fma(col("a"), col("b")).alias("r"))
+
+    assert_plan_on_tpu(build)
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_plain_udf_falls_back_with_reason():
+    plain = udf(_plain_fn, T.LONG, name="plain_fma")
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(), IntegerGen()], ["a", "b"], length=100)
+        return df.select(plain(col("a"), col("b")).alias("r"))
+
+    assert_tpu_fallback_collect(build, "Project")
+
+
+def test_udf_composes_with_expressions():
+    fma = udf(_FusedMultiplyAdd(), T.LONG, name="fma")
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=-100, max_val=100),
+                        IntegerGen(min_val=-100, max_val=100)],
+                    ["a", "b"], length=300)
+        return (df.filter(col("a") > lit(0))
+                  .select((fma(col("a"), col("b")) + lit(5)).alias("r"))
+                  .agg(sum_("r", "s")))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_cache_reuses_batches():
+    from spark_rapids_tpu.session import TpuSession
+
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    df = gen_df(s, [IntegerGen(), LongGen()], ["a", "b"], length=500).cache()
+    r1 = sorted(df.collect(), key=str)
+    # second action replays cached spillable batches (cache slot populated)
+    from spark_rapids_tpu.plan import nodes as PN
+
+    assert isinstance(df.plan, PN.CachedRelation)
+    assert "tpu" in df.plan.cache_slot
+    r2 = sorted(df.collect(), key=str)
+    assert r1 == r2
+    agg = sorted(df.group_by("a").agg(sum_("b", "s")).collect(), key=str)
+    s2 = TpuSession({"spark.rapids.sql.enabled": False})
+    df2 = gen_df(s2, [IntegerGen(), LongGen()], ["a", "b"], length=500)
+    want = sorted(df2.group_by("a").agg(sum_("b", "s")).collect(), key=str)
+    assert agg == want
+    df.unpersist()
+    assert "tpu" not in df.plan.cache_slot
+
+
+def test_cache_differential():
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=9), LongGen()],
+                    ["k", "v"], length=400).cache()
+        return df.group_by("k").agg(sum_("v", "s"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+class _RowOnlyUDF(TpuUDF):
+    """Subclasses TpuUDF but never overrides evaluate_columnar — must fall
+    back, not crash (code-review regression)."""
+
+    def __call__(self, x):
+        return None if x is None else int(x) + 10
+
+
+def test_row_only_tpuudf_subclass_falls_back():
+    inc = udf(_RowOnlyUDF(), T.LONG, name="inc10")
+
+    def build(s):
+        df = gen_df(s, [IntegerGen()], ["a"], length=50)
+        return df.select(inc(col("a")).alias("r"))
+
+    assert_tpu_fallback_collect(build, "Project")
+
+
+def test_cache_under_limit_no_handle_leak():
+    from spark_rapids_tpu.memory.spill import (
+        get_spill_framework,
+        reset_spill_framework,
+    )
+    from spark_rapids_tpu.session import TpuSession
+
+    reset_spill_framework()
+    s = TpuSession({"spark.rapids.sql.enabled": True,
+                    "spark.rapids.sql.reader.batchSizeRows": 50})
+    df = gen_df(s, [IntegerGen()], ["a"], length=300).cache()
+    before = len(get_spill_framework()._handles)
+    r = df.limit(5).collect()
+    assert len(r) == 5
+    # cache fully materialized (one tracked handle per batch), not leaked
+    assert "tpu" in df.plan.cache_slot
+    n_cached = len(df.plan.cache_slot["tpu"])
+    after = len(get_spill_framework()._handles)
+    assert after - before == n_cached, (before, after, n_cached)
+    df.unpersist()
