@@ -172,6 +172,31 @@ class ColumnarBatch:
                     off += n
                 out_cols.append(DeviceColumn(dtype, validity, chars=chars,
                                              lengths=lengths))
+            elif cols[0].is_array:
+                ew = max(c.ewidth for c in cols)
+                data = jnp.zeros((cap, ew), cols[0].data.dtype)
+                ev = jnp.zeros((cap, ew), jnp.bool_)
+                lengths = jnp.zeros(cap, jnp.int32)
+                validity = jnp.zeros(cap, jnp.bool_)
+                off = 0
+                for b, c in zip(batches, cols):
+                    n = b.num_rows
+                    if n == 0:
+                        continue
+                    pad = ew - c.ewidth
+                    data = jax.lax.dynamic_update_slice(
+                        data, jnp.pad(c.data, ((0, 0), (0, pad)))[:n],
+                        (off, 0))
+                    ev = jax.lax.dynamic_update_slice(
+                        ev, jnp.pad(c.elem_valid, ((0, 0), (0, pad)))[:n],
+                        (off, 0))
+                    lengths = jax.lax.dynamic_update_slice(
+                        lengths, c.lengths[:n], (off,))
+                    validity = jax.lax.dynamic_update_slice(
+                        validity, c.validity[:n], (off,))
+                    off += n
+                out_cols.append(DeviceColumn(dtype, validity, data=data,
+                                             lengths=lengths, elem_valid=ev))
             else:
                 trail = cols[0].data.shape[1:]
                 data = jnp.zeros((cap,) + trail, cols[0].data.dtype)

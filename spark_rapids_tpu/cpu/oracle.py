@@ -1366,6 +1366,92 @@ def _h_format_time(e, cols, n, ansi):
     return CpuCol(T.STRING, out, c.validity.copy())
 
 
+def _h_size(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    out = np.array([len(v) if c.validity[i] and v is not None else -1
+                    for i, v in enumerate(c.values)], np.int32)
+    return CpuCol(T.INT, out, np.ones(n, np.bool_))
+
+
+def _arr_index(e, cols, n, ansi, one_based):
+    a, k = _kids(e, cols, n, ansi)
+    et = e.dataType
+    out_vals = []
+    validity = a.validity & k.validity
+    for i in range(n):
+        if not validity[i]:
+            out_vals.append(None)
+            continue
+        v = a.values[i]
+        idx = int(k.values[i])
+        if one_based:
+            if idx == 0:
+                out_vals.append(None)
+                validity[i] = False
+                continue
+            idx = idx - 1 if idx > 0 else len(v) + idx
+        if not (0 <= idx < len(v)) or v[idx] is None:
+            out_vals.append(None)
+            validity[i] = False
+        else:
+            out_vals.append(v[idx])
+    arr = np.array([x if x is not None else 0 for x in out_vals],
+                   T.storage_dtype(et))
+    return CpuCol(et, arr, validity)
+
+
+def _h_get_array_item(e, cols, n, ansi):
+    return _arr_index(e, cols, n, ansi, one_based=False)
+
+
+def _h_element_at(e, cols, n, ansi):
+    return _arr_index(e, cols, n, ansi, one_based=True)
+
+
+def _h_array_contains(e, cols, n, ansi):
+    a, v = _kids(e, cols, n, ansi)
+    out = np.zeros(n, np.bool_)
+    validity = a.validity & v.validity
+    for i in range(n):
+        if not validity[i]:
+            continue
+        arr = a.values[i]
+        found = any(x is not None and x == v.values[i] for x in arr)
+        out[i] = found
+        if not found and any(x is None for x in arr):
+            validity[i] = False
+    return CpuCol(T.BOOLEAN, out, validity)
+
+
+def _h_create_array(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    vals = np.empty(n, object)
+    for i in range(n):
+        vals[i] = [k.row(i) for k in kids]
+    return CpuCol(e.dataType, vals, np.ones(n, np.bool_))
+
+
+def _h_array_minmax(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    mx = type(e).__name__ == "ArrayMax"
+    et = e.dataType
+    out_vals = []
+    validity = c.validity.copy()
+    for i in range(n):
+        if not c.validity[i]:
+            out_vals.append(None)
+            continue
+        xs = [x for x in c.values[i] if x is not None]
+        if not xs:
+            out_vals.append(None)
+            validity[i] = False
+        else:
+            out_vals.append(max(xs) if mx else min(xs))
+    arr = np.array([x if x is not None else 0 for x in out_vals],
+                   T.storage_dtype(et))
+    return CpuCol(et, arr, validity)
+
+
 def _h_udf(e, cols, n, ansi):
     """Row-based UDF evaluation — the CPU truth (reference: the original
     Scala UDF body that RapidsUDF accelerates)."""
@@ -1824,6 +1910,10 @@ _HANDLERS = {
     "StringRepeat": _h_repeat, "ConcatWs": _h_concat_ws,
     "OctetLength": _h_octetbit, "BitLength": _h_octetbit,
     "UserDefinedExpression": _h_udf,
+    "Size": _h_size, "GetArrayItem": _h_get_array_item,
+    "ElementAt": _h_element_at, "ArrayContains": _h_array_contains,
+    "CreateArray": _h_create_array, "ArrayMin": _h_array_minmax,
+    "ArrayMax": _h_array_minmax,
     "StringLeft": _h_leftright, "StringRight": _h_leftright,
     "SubstringIndex": _h_substring_index,
 }
@@ -1854,6 +1944,20 @@ def execute_cpu_plan(plan: PN.SparkPlan, ansi: bool = False) -> Tuple[CpuBatch, 
     if isinstance(plan, PN.RangeNode):
         vals = np.arange(plan.start, plan.end, plan.step, dtype=np.int64)
         return [CpuCol(T.LONG, vals, np.ones(len(vals), np.bool_))], len(vals)
+    if isinstance(plan, PN.Generate):
+        return _cpu_generate(plan, ansi)
+    if isinstance(plan, PN.Expand):
+        cols, n = execute_cpu_plan(plan.child, ansi)
+        pieces = [[eval_expr(e, cols, n, ansi) for e in ps]
+                  for ps in plan.projections]
+        merged = []
+        for ci in range(len(plan.projections[0])):
+            vals = np.concatenate([p[ci].values for p in pieces])
+            valid = np.concatenate([p[ci].validity for p in pieces])
+            merged.append(CpuCol(pieces[0][ci].dtype, vals, valid))
+        return merged, n * len(plan.projections)
+    if isinstance(plan, PN.BroadcastNestedLoopJoin):
+        return _cpu_bnlj(plan, ansi)
     if isinstance(plan, PN.Project):
         cols, n = execute_cpu_plan(plan.child, ansi)
         return [eval_expr(e, cols, n, ansi) for e in plan.exprs], n
@@ -2379,6 +2483,91 @@ def _cpu_sort(plan: PN.Sort, ansi: bool):
     take = np.array(idx, np.int64) if n else np.zeros(0, np.int64)
     out = [CpuCol(c.dtype, c.values[take], c.validity[take]) for c in cols]
     return out, n
+
+
+def _cpu_generate(plan: PN.Generate, ansi: bool):
+    cols, n = execute_cpu_plan(plan.child, ansi)
+    arr = eval_expr(plan.gen_expr, cols, n, ansi)
+    rows = []           # (src_row, pos or None, value, value_valid)
+    for i in range(n):
+        v = arr.values[i] if arr.validity[i] else None
+        if v is None or len(v) == 0:
+            if plan.outer:
+                rows.append((i, None, None, False))
+            continue
+        for k, e in enumerate(v):
+            rows.append((i, k, e, e is not None))
+    m = len(rows)
+    out = []
+    for c in cols:
+        vals = np.array([c.values[r[0]] for r in rows],
+                        dtype=c.values.dtype)
+        valid = np.array([c.validity[r[0]] for r in rows], np.bool_)
+        out.append(CpuCol(c.dtype, vals, valid))
+    if plan.position:
+        out.append(CpuCol(T.INT, np.array(
+            [r[1] if r[1] is not None else 0 for r in rows], np.int32),
+            np.array([r[1] is not None for r in rows], np.bool_)))
+    et = plan.gen_expr.dataType.elementType
+    evals = np.array([r[2] if r[3] else 0 for r in rows],
+                     T.storage_dtype(et))
+    evalid = np.array([r[3] for r in rows], np.bool_)
+    out.append(CpuCol(et, evals, evalid))
+    return out, m
+
+
+def _cpu_bnlj(plan, ansi: bool):
+    lcols, nl = execute_cpu_plan(plan.left, ansi)
+    rcols, nr = execute_cpu_plan(plan.right, ansi)
+    jt = plan.join_type
+    # expand all pairs, evaluate the condition on the pair table
+    li = np.repeat(np.arange(nl), max(nr, 1)) if nr else np.array([], np.int64)
+    ri = np.tile(np.arange(max(nr, 1)), nl) if nr else np.array([], np.int64)
+    pair_cols = [CpuCol(c.dtype, c.values[li], c.validity[li])
+                 for c in lcols] +                 [CpuCol(c.dtype, c.values[ri], c.validity[ri])
+                 for c in rcols] if nr else []
+    npairs = nl * nr
+    if plan.condition is not None and npairs:
+        pred = eval_expr(plan.condition, pair_cols, npairs, ansi)
+        ok = pred.values.astype(bool) & pred.validity
+    else:
+        ok = np.ones(npairs, np.bool_)
+    matched_left = np.zeros(nl, np.bool_)
+    if npairs:
+        for i in range(npairs):
+            if ok[i]:
+                matched_left[li[i]] = True
+    if jt in (PN.JoinType.LEFT_SEMI, PN.JoinType.LEFT_ANTI):
+        keep = matched_left if jt == PN.JoinType.LEFT_SEMI else ~matched_left
+        idx = np.nonzero(keep)[0]
+        return [CpuCol(c.dtype, c.values[idx], c.validity[idx])
+                for c in lcols], len(idx)
+    sel = np.nonzero(ok)[0] if npairs else np.array([], np.int64)
+    out = [CpuCol(c.dtype, c.values[li[sel]], c.validity[li[sel]])
+           for c in lcols] +           [CpuCol(c.dtype, c.values[ri[sel]], c.validity[ri[sel]])
+           for c in rcols]
+    m = len(sel)
+    if jt == PN.JoinType.LEFT_OUTER:
+        um = np.nonzero(~matched_left)[0]
+        if len(um):
+            for ci, c in enumerate(lcols):
+                out[ci] = CpuCol(c.dtype,
+                                 np.concatenate([out[ci].values,
+                                                 c.values[um]]),
+                                 np.concatenate([out[ci].validity,
+                                                 c.validity[um]]))
+            for ci, c in enumerate(rcols):
+                k = len(lcols) + ci
+                pad_vals = np.zeros(len(um), dtype=c.values.dtype) \
+                    if c.values.dtype != object else np.array(
+                        [None] * len(um), object)
+                out[k] = CpuCol(c.dtype,
+                                np.concatenate([out[k].values, pad_vals]),
+                                np.concatenate([out[k].validity,
+                                                np.zeros(len(um),
+                                                         np.bool_)]))
+            m += len(um)
+    return out, m
 
 
 def _cpu_window(plan: PN.Window, ansi: bool):

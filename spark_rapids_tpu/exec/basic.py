@@ -292,6 +292,12 @@ class TpuLocalTableScanExec(TpuExec):
                     chunk.append(HostColumn(h.dtype, h.validity[start:end],
                                             chars=h.chars[start:end],
                                             lengths=h.lengths[start:end]))
+                elif h.is_array:
+                    chunk.append(HostColumn(
+                        h.dtype, h.validity[start:end],
+                        data=h.data[start:end],
+                        lengths=h.lengths[start:end],
+                        elem_valid=h.elem_valid[start:end]))
                 else:
                     chunk.append(HostColumn(h.dtype, h.validity[start:end],
                                             data=h.data[start:end]))

@@ -1,0 +1,335 @@
+"""TpuGenerateExec (explode/posexplode) + TpuExpandExec +
+TpuBroadcastNestedLoopJoinExec.
+
+Reference analogs (SURVEY.md §2.4): GpuGenerateExec.scala,
+GpuExpandExec.scala, GpuBroadcastNestedLoopJoinExec.
+
+TPU designs:
+  * explode: the same two-index gather-map expansion the joins use — output
+    row j maps to (source row, element) via searchsorted over the prefix
+    sum of per-row element counts; one jitted program, one host sync for
+    the total (output capacity bucket).
+  * expand: one projected batch per projection set, concatenated on device.
+  * BNLJ: chunked cartesian expansion with the condition fused in; SEMI /
+    ANTI / LEFT OUTER reduce a per-left-row match flag across right chunks.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import (
+    DEFAULT_ROW_BUCKETS,
+    DeviceColumn,
+    round_up_bucket,
+)
+from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.expr.base import EvalContext, Expression
+from spark_rapids_tpu.ops.filterops import compact_columns, gather_columns
+from spark_rapids_tpu.plan.nodes import JoinType
+
+
+class TpuGenerateExec(TpuExec):
+    def __init__(self, gen_expr: Expression, child: TpuExec,
+                 position: bool, outer: bool, output_schema: T.StructType,
+                 ansi: bool = False):
+        super().__init__([child])
+        self.gen_expr = gen_expr
+        self.position = position
+        self.outer = outer
+        self._output = output_schema
+        self.ansi = ansi
+        self._jits = {}
+
+    @property
+    def output(self):
+        return self._output
+
+    def describe(self):
+        kind = "posexplode" if self.position else "explode"
+        return f"TpuGenerate {kind}({self.gen_expr.sql_string()})"
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        for batch in self.children[0].execute_columnar():
+            with self.metrics["opTime"].timed():
+                out = self._generate(batch)
+            if out is not None:
+                yield self._count_output(out)
+
+    def _counts(self, batch: ColumnarBatch):
+        def fn(cols, num_rows):
+            b = ColumnarBatch(list(cols), num_rows, batch.schema)
+            ctx = EvalContext(b, ansi=self.ansi)
+            arr = self.gen_expr.eval_tpu(ctx)
+            eff = jnp.where(arr.validity, arr.lengths, 0)
+            if self.outer:
+                eff = jnp.maximum(eff, 1)
+            eff = jnp.where(b.row_mask, eff, 0)
+            return eff, jnp.sum(eff.astype(jnp.int64))
+
+        if "counts" not in self._jits:
+            self._jits["counts"] = jax.jit(fn)
+        return self._jits["counts"](tuple(batch.columns),
+                                    jnp.int32(batch.num_rows))
+
+    def _generate(self, batch: ColumnarBatch) -> Optional[ColumnarBatch]:
+        eff, total = self._counts(batch)
+        total = int(total)
+        if total == 0:
+            return None
+        out_cap = round_up_bucket(total, DEFAULT_ROW_BUCKETS)
+
+        def fn(cols, eff, num_rows, total):
+            b = ColumnarBatch(list(cols), num_rows, batch.schema)
+            ctx = EvalContext(b, ansi=self.ansi)
+            arr = self.gen_expr.eval_tpu(ctx)
+            offsets = jnp.cumsum(eff.astype(jnp.int64))
+            excl = offsets - eff.astype(jnp.int64)
+            j = jnp.arange(out_cap, dtype=jnp.int64)
+            src = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32)
+            src = jnp.clip(src, 0, b.capacity - 1)
+            k = (j - excl[src]).astype(jnp.int32)
+            row_valid = j < total
+            out_cols = gather_columns(src, row_valid, b.columns)
+            ew = max(arr.ewidth, 1)
+            ksafe = jnp.clip(k, 0, ew - 1)
+            elem = arr.data[src, ksafe] if arr.ewidth else jnp.zeros(
+                out_cap, arr.data.dtype)
+            ev = arr.elem_valid[src, ksafe] if arr.ewidth else jnp.zeros(
+                out_cap, jnp.bool_)
+            # outer rows synthesized for empty/null arrays have k==0 but no
+            # real element (and a NULL pos, matching Spark posexplode_outer)
+            in_arr = (k < arr.lengths[src]) & arr.validity[src]
+            if self.position:
+                out_cols.append(DeviceColumn(
+                    T.INT, row_valid & in_arr, data=k))
+            out_cols.append(DeviceColumn(
+                self._output.fields[-1].dataType,
+                row_valid & ev & in_arr, data=elem))
+            return tuple(out_cols)
+
+        key = ("gen", out_cap)
+        if key not in self._jits:
+            self._jits[key] = jax.jit(fn)
+        cols = self._jits[key](tuple(batch.columns), eff,
+                               jnp.int32(batch.num_rows), jnp.int64(total))
+        return ColumnarBatch(list(cols), total, self._output)
+
+
+class TpuExpandExec(TpuExec):
+    def __init__(self, projections: List[List[Expression]], child: TpuExec,
+                 output_schema: T.StructType, ansi: bool = False):
+        super().__init__([child])
+        self.projections = projections
+        self._output = output_schema
+        self.ansi = ansi
+        self._jit = None
+
+    @property
+    def output(self):
+        return self._output
+
+    def describe(self):
+        return f"TpuExpand [{len(self.projections)} projections]"
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        for batch in self.children[0].execute_columnar():
+            with self.metrics["opTime"].timed():
+                for proj_idx in range(len(self.projections)):
+                    out = self._one(batch, proj_idx)
+                    yield self._count_output(out)
+
+    def _one(self, batch: ColumnarBatch, proj_idx: int) -> ColumnarBatch:
+        msgs = []
+
+        def fn(cols, num_rows):
+            b = ColumnarBatch(list(cols), num_rows, batch.schema)
+            ctx = EvalContext(b, ansi=self.ansi)
+            out = tuple(e.eval_tpu(ctx) for e in self.projections[proj_idx])
+            msgs.clear()
+            msgs.extend(m for _, m in ctx.error_flags)
+            return out, tuple(jnp.any(f) for f, _ in ctx.error_flags)
+
+        key = ("expand", proj_idx)
+        if self._jit is None:
+            self._jit = {}
+        if key not in self._jit:
+            self._jit[key] = (jax.jit(fn), msgs)
+        jitted, msgs = self._jit[key]
+        cols, flags = jitted(tuple(batch.columns),
+                             jnp.int32(batch.num_rows))
+        from spark_rapids_tpu.expr.base import SparkArithmeticException
+
+        for f, m in zip(flags, list(msgs)):
+            if bool(f):
+                raise SparkArithmeticException(m)
+        return ColumnarBatch(list(cols), batch.num_rows, self._output)
+
+
+class TpuBroadcastNestedLoopJoinExec(TpuExec):
+    """Non-equi join: condition over the cartesian expansion, chunked so a
+    left-chunk x right product stays within one capacity bucket."""
+
+    MAX_PRODUCT = 1 << 20
+
+    def __init__(self, left: TpuExec, right: TpuExec, join_type: JoinType,
+                 condition: Optional[Expression],
+                 output_schema: T.StructType, ansi: bool = False):
+        super().__init__([left, right])
+        self.join_type = join_type
+        self.condition = condition
+        self._output = output_schema
+        self.ansi = ansi
+        self._jits = {}
+
+    @property
+    def output(self):
+        return self._output
+
+    def describe(self):
+        c = self.condition.sql_string() if self.condition is not None else ""
+        return f"TpuBroadcastNestedLoopJoin {self.join_type.value} [{c}]"
+
+    def _cached(self, key, fn):
+        if key not in self._jits:
+            self._jits[key] = jax.jit(fn)
+        return self._jits[key]
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        right_batches = list(self.children[1].execute_columnar())
+        if right_batches:
+            rbatch = (right_batches[0] if len(right_batches) == 1
+                      else ColumnarBatch.concat(right_batches))
+        else:
+            from spark_rapids_tpu.columnar.batch import empty_batch
+
+            rbatch = empty_batch(self.children[1].output)
+        nright = rbatch.num_rows
+        jt = self.join_type
+        pair_schema = T.StructType(
+            list(self.children[0].output.fields)
+            + [T.StructField(f.name, f.dataType, True)
+               for f in rbatch.schema.fields])
+        chunk = max(1, self.MAX_PRODUCT // max(nright, 1))
+        for lb in self.children[0].execute_columnar():
+            start = 0
+            while start < lb.num_rows or (lb.num_rows == 0 and start == 0):
+                piece = lb.slice_rows(start, min(chunk, lb.num_rows - start)) \
+                    if lb.num_rows else lb
+                start += chunk
+                out = self._join_chunk(piece, rbatch, nright, jt, pair_schema)
+                if out is not None and out.num_rows > 0:
+                    yield self._count_output(out)
+                if lb.num_rows == 0:
+                    break
+
+    def _join_chunk(self, lb: ColumnarBatch, rbatch: ColumnarBatch,
+                    nright: int, jt: JoinType, pair_schema):
+        nl = lb.num_rows
+        if jt in (JoinType.INNER, JoinType.CROSS):
+            if nl * nright == 0:
+                return None
+        out_cap = round_up_bucket(max(nl * max(nright, 1), 1),
+                                  DEFAULT_ROW_BUCKETS)
+
+        def match_fn(lcols, rcols, n_l, n_r):
+            """(matched pairs flags + per-left any-match) on the expansion."""
+            j = jnp.arange(out_cap, dtype=jnp.int64)
+            nr = jnp.maximum(n_r, 1)
+            li = (j // nr).astype(jnp.int32)
+            ri = (j % nr).astype(jnp.int32)
+            pair_ok = j < n_l * n_r
+            lo = gather_columns(li, pair_ok, list(lcols))
+            ro = gather_columns(ri, pair_ok, list(rcols))
+            pb = ColumnarBatch(list(lo) + list(ro),
+                               (n_l * n_r).astype(jnp.int32), pair_schema)
+            flags = ()
+            if self.condition is not None:
+                ctx = EvalContext(pb, ansi=self.ansi)
+                pred = self.condition.eval_tpu(ctx)
+                ok = pred.data & pred.validity & pair_ok
+                flags = tuple(jnp.any(f) for f, _ in ctx.error_flags)
+                self._flag_msgs = [m for _, m in ctx.error_flags]
+            else:
+                ok = pair_ok
+            li_safe = jnp.where(pair_ok, li, 0).astype(jnp.int32)
+            li_safe = jnp.clip(li_safe, 0, lb.capacity - 1)
+            any_match = jax.ops.segment_max(
+                jnp.where(ok, 1, 0), li_safe,
+                num_segments=lb.capacity) > 0
+            return tuple(lo), tuple(ro), ok, any_match, flags
+
+        self._flag_msgs = []
+        mf = self._cached(("match", out_cap, lb.capacity), match_fn)
+        lo, ro, ok, any_match, flags = mf(
+            tuple(lb.columns), tuple(rbatch.columns),
+            jnp.int64(nl), jnp.int64(nright))
+        from spark_rapids_tpu.expr.base import SparkArithmeticException
+
+        for f, m in zip(flags, list(self._flag_msgs)):
+            if bool(f):
+                raise SparkArithmeticException(m)
+        if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+            def compact_fn(cols, flags, num_rows):
+                b = ColumnarBatch(list(cols), num_rows, lb.schema)
+                keep = flags if jt == JoinType.LEFT_SEMI else ~flags
+                keep = keep & b.row_mask
+                out, cnt = compact_columns(keep, b.columns)
+                return tuple(out), cnt
+
+            cf = self._cached(("semi", jt.value, lb.capacity), compact_fn)
+            cols, cnt = cf(tuple(lb.columns), any_match,
+                           jnp.int32(lb.num_rows))
+            n = int(cnt)
+            return ColumnarBatch(list(cols), n, self._output) if n else None
+        # INNER / CROSS / LEFT_OUTER: compact matched pairs; LEFT_OUTER
+        # appends unmatched left rows with null right side
+        def pairs_fn(lo, ro, ok):
+            cols = list(lo) + list(ro)
+            out, cnt = compact_columns(ok, cols)
+            return tuple(out), cnt
+
+        pf = self._cached(("pairs", out_cap), pairs_fn)
+        pcols, pcnt = pf(lo, ro, ok)
+        n_pairs = int(pcnt)
+        parts = []
+        if n_pairs:
+            parts.append(ColumnarBatch(list(pcols), n_pairs, self._output))
+        if jt == JoinType.LEFT_OUTER:
+            def unmatched_fn(cols, flags, num_rows):
+                b = ColumnarBatch(list(cols), num_rows, lb.schema)
+                keep = ~flags & b.row_mask
+                out, cnt = compact_columns(keep, b.columns)
+                return tuple(out), cnt
+
+            uf = self._cached(("um", lb.capacity), unmatched_fn)
+            ucols, ucnt = uf(tuple(lb.columns), any_match,
+                             jnp.int32(lb.num_rows))
+            n_um = int(ucnt)
+            if n_um:
+                cap = lb.capacity
+                rfields = rbatch.schema.fields
+                null_right = []
+                for f in rfields:
+                    if isinstance(f.dataType, T.StringType):
+                        null_right.append(DeviceColumn(
+                            f.dataType, jnp.zeros(cap, jnp.bool_),
+                            chars=jnp.zeros((cap, 8), jnp.uint8),
+                            lengths=jnp.zeros(cap, jnp.int32)))
+                    else:
+                        shape = ((cap, 2) if isinstance(f.dataType,
+                                                        T.DecimalType)
+                                 and f.dataType.is_128 else (cap,))
+                        null_right.append(DeviceColumn(
+                            f.dataType, jnp.zeros(cap, jnp.bool_),
+                            data=jnp.zeros(shape,
+                                           T.storage_dtype(f.dataType))))
+                parts.append(ColumnarBatch(
+                    list(ucols) + null_right, n_um, self._output))
+        if not parts:
+            return None
+        return parts[0] if len(parts) == 1 else ColumnarBatch.concat(parts)

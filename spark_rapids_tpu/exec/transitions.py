@@ -102,6 +102,20 @@ class TpuColumnarToRowExec(TpuExec):
                     off += len(h.lengths)
                 out.append(HostColumn(dtype, validity, chars=chars,
                                       lengths=lengths))
+            elif hs[0].is_array:
+                ew = max(h.data.shape[1] for h in hs)
+                n = len(validity)
+                data = np.zeros((n, ew), hs[0].data.dtype)
+                ev = np.zeros((n, ew), np.bool_)
+                lengths = np.concatenate([h.lengths for h in hs])
+                off = 0
+                for h in hs:
+                    k = len(h.lengths)
+                    data[off: off + k, : h.data.shape[1]] = h.data
+                    ev[off: off + k, : h.elem_valid.shape[1]] = h.elem_valid
+                    off += k
+                out.append(HostColumn(dtype, validity, data=data,
+                                      lengths=lengths, elem_valid=ev))
             else:
                 data = np.concatenate([h.data for h in hs])
                 out.append(HostColumn(dtype, validity, data=data))

@@ -1,0 +1,153 @@
+"""Collection expressions over padded list columns.
+
+Reference analog: org/apache/spark/sql/rapids/collectionOperations.scala
+(GpuSize, GpuElementAt, GpuGetArrayItem, GpuArrayContains, GpuCreateArray,
+SURVEY.md §2.5 Collections).  Device layout: a list column is
+``data (cap, ewidth)`` + ``elem_valid (cap, ewidth)`` + ``lengths (cap,)``
+(the padded counterpart of cuDF's offsets+child, chosen for XLA static
+shapes — columnar/column.py).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expr.base import (
+    BinaryExpression,
+    EvalContext,
+    Expression,
+    UnaryExpression,
+)
+
+
+class Size(UnaryExpression):
+    """size(array): element count; null input -> -1 (legacy) like Spark's
+    default spark.sql.legacy.sizeOfNull=true."""
+
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = False
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        c = cols[0]
+        data = jnp.where(c.validity, c.lengths, -1)
+        return DeviceColumn(T.INT, jnp.ones_like(c.validity), data=data)
+
+
+class GetArrayItem(BinaryExpression):
+    """array[idx]: 0-based; out of bounds -> null (legacy mode)."""
+
+    def _resolve_type(self):
+        self._dataType = self.left.dataType.elementType
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        arr, idx = cols
+        i = idx.data.astype(jnp.int32)
+        inb = (i >= 0) & (i < arr.lengths)
+        safe = jnp.clip(i, 0, max(arr.ewidth - 1, 0))
+        data = jnp.take_along_axis(arr.data, safe[:, None], axis=1)[:, 0]
+        ev = jnp.take_along_axis(arr.elem_valid, safe[:, None], axis=1)[:, 0]
+        validity = arr.validity & idx.validity & inb & ev
+        return DeviceColumn(self.dataType, validity, data=data)
+
+
+class ElementAt(BinaryExpression):
+    """element_at(array, i): 1-based, negative counts from the end;
+    out of bounds -> null (legacy mode)."""
+
+    def _resolve_type(self):
+        self._dataType = self.left.dataType.elementType
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        arr, idx = cols
+        i = idx.data.astype(jnp.int32)
+        n = arr.lengths
+        zero = i == 0          # element_at(_, 0) is an error in Spark; null here
+        pos = jnp.where(i > 0, i - 1, n + i)
+        inb = (pos >= 0) & (pos < n) & ~zero
+        safe = jnp.clip(pos, 0, max(arr.ewidth - 1, 0))
+        data = jnp.take_along_axis(arr.data, safe[:, None], axis=1)[:, 0]
+        ev = jnp.take_along_axis(arr.elem_valid, safe[:, None], axis=1)[:, 0]
+        validity = arr.validity & idx.validity & inb & ev
+        return DeviceColumn(self.dataType, validity, data=data)
+
+
+class ArrayContains(BinaryExpression):
+    """array_contains(arr, v): Spark null semantics — true if found, null
+    if not found but the array has null elements, else false."""
+
+    def _resolve_type(self):
+        self._dataType = T.BOOLEAN
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        arr, v = cols
+        w = arr.ewidth
+        in_len = jnp.arange(w)[None, :] < arr.lengths[:, None]
+        eq = (arr.data == v.data[:, None]) & arr.elem_valid & in_len
+        found = jnp.any(eq, axis=1)
+        has_null_elem = jnp.any(~arr.elem_valid & in_len, axis=1)
+        validity = arr.validity & v.validity & (found | ~has_null_elem)
+        return DeviceColumn(T.BOOLEAN, validity, data=found)
+
+
+class CreateArray(Expression):
+    """array(e1, e2, ...) over flat element expressions."""
+
+    def __init__(self, children: List[Expression]):
+        super().__init__(list(children))
+
+    def sql_string(self):
+        return "array(" + ", ".join(c.sql_string() for c in self.children) + ")"
+
+    def _resolve_type(self):
+        et = self.children[0].dataType
+        self._dataType = T.ArrayType(et)
+        self._nullable = False
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        k = len(cols)
+        data = jnp.stack([c.data for c in cols], axis=1)
+        ev = jnp.stack([c.validity for c in cols], axis=1)
+        cap = cols[0].capacity
+        lengths = jnp.full(cap, k, jnp.int32)
+        return DeviceColumn(self.dataType, jnp.ones(cap, jnp.bool_),
+                            data=data, lengths=lengths, elem_valid=ev)
+
+
+class ArrayMin(UnaryExpression):
+    """array_min: nulls skipped; empty/all-null -> null."""
+
+    _is_min = True
+
+    def _resolve_type(self):
+        self._dataType = self.child.dataType.elementType
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        c = cols[0]
+        w = c.ewidth
+        in_len = jnp.arange(w)[None, :] < c.lengths[:, None]
+        ok = c.elem_valid & in_len
+        dt = self.dataType
+        is_f = isinstance(dt, (T.FloatType, T.DoubleType))
+        if is_f:
+            ident = jnp.asarray(jnp.inf if self._is_min else -jnp.inf,
+                                c.data.dtype)
+        else:
+            info = jnp.iinfo(c.data.dtype)
+            ident = jnp.asarray(info.max if self._is_min else info.min,
+                                c.data.dtype)
+        v = jnp.where(ok, c.data, ident)
+        red = jnp.min(v, axis=1) if self._is_min else jnp.max(v, axis=1)
+        has = jnp.any(ok, axis=1)
+        return DeviceColumn(dt, c.validity & has, data=red)
+
+
+class ArrayMax(ArrayMin):
+    _is_min = False

@@ -39,6 +39,15 @@ def compact_columns(mask: jax.Array,
                 c.lengths, mode="drop")
             out.append(DeviceColumn(c.dtype, validity, chars=chars,
                                     lengths=lengths))
+        elif c.is_array:
+            data = jnp.zeros_like(c.data).at[scatter_idx].set(
+                c.data, mode="drop")
+            lengths = jnp.zeros_like(c.lengths).at[scatter_idx].set(
+                c.lengths, mode="drop")
+            ev = jnp.zeros_like(c.elem_valid).at[scatter_idx].set(
+                c.elem_valid, mode="drop")
+            out.append(DeviceColumn(c.dtype, validity, data=data,
+                                    lengths=lengths, elem_valid=ev))
         else:
             data = jnp.zeros_like(c.data).at[scatter_idx].set(
                 c.data, mode="drop")
@@ -58,6 +67,10 @@ def gather_columns(indices: jax.Array, valid_out: jax.Array,
         if c.is_string:
             out.append(DeviceColumn(c.dtype, validity, chars=c.chars[safe],
                                     lengths=c.lengths[safe]))
+        elif c.is_array:
+            out.append(DeviceColumn(c.dtype, validity, data=c.data[safe],
+                                    lengths=c.lengths[safe],
+                                    elem_valid=c.elem_valid[safe]))
         else:
             out.append(DeviceColumn(c.dtype, validity, data=c.data[safe]))
     return out

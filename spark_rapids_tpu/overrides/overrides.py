@@ -23,6 +23,7 @@ from spark_rapids_tpu.config import (
 from spark_rapids_tpu.expr import arithmetic as A
 from spark_rapids_tpu.expr import base as E
 from spark_rapids_tpu.expr import cast as C
+from spark_rapids_tpu.expr import collections as CL
 from spark_rapids_tpu.expr import conditional as CO
 from spark_rapids_tpu.expr import datetime as DT
 from spark_rapids_tpu.expr import hashexprs as H
@@ -61,6 +62,10 @@ _COMMON128 = _COMMON + T.DECIMAL_128_SIG.with_max_decimal(18)
 _DEC128_FULL = _COMMON + T.DECIMAL_128_SIG
 _NUM = T.numeric + T.NULL_SIG
 _NUM128 = _NUM + T.DECIMAL_128_SIG
+# arrays of primitive elements (padded list columns; element support is
+# checked recursively by TypeSig.supports)
+_ARRAY_SIG = T.TypeSig(frozenset({T.ArrayType}), 18)
+_WITH_ARRAYS = _DEC128_FULL + _ARRAY_SIG
 
 
 def _check_decimal_mult(meta: ExprMeta):
@@ -189,6 +194,22 @@ def _check_time_format(meta: ExprMeta):
             f"letters (supported: yyyy MM dd HH mm ss + separators)")
 
 
+def _check_create_array(meta: ExprMeta):
+    kids = meta.expr.children
+    if not kids:
+        meta.will_not_work_on_tpu("empty array() literal is not supported")
+        return
+    et = kids[0]._dataType
+    if isinstance(et, (T.StringType, T.ArrayType, T.MapType, T.StructType)):
+        meta.will_not_work_on_tpu(
+            "array() of non-primitive elements is not supported on TPU")
+        return
+    for c in kids[1:]:
+        if c._dataType != et:
+            meta.will_not_work_on_tpu("array() elements must share one type")
+            return
+
+
 def _check_udf(meta: ExprMeta):
     """RapidsUDF detection: only UDFs exposing a columnar kernel run on
     TPU; plain python functions fall back with the reference's explain
@@ -226,10 +247,10 @@ def _check_pad(meta: ExprMeta):
 
 
 EXPRESSIONS: Dict[Type, ExprRule] = {
-    E.Literal: ExprRule(_DEC128_FULL, desc="constant literal"),
-    E.BoundReference: ExprRule(_DEC128_FULL, desc="column reference"),
-    E.AttributeReference: ExprRule(_DEC128_FULL, desc="column reference"),
-    E.Alias: ExprRule(_DEC128_FULL, desc="alias"),
+    E.Literal: ExprRule(_WITH_ARRAYS, desc="constant literal"),
+    E.BoundReference: ExprRule(_WITH_ARRAYS, desc="column reference"),
+    E.AttributeReference: ExprRule(_WITH_ARRAYS, desc="column reference"),
+    E.Alias: ExprRule(_WITH_ARRAYS, desc="alias"),
     A.Add: ExprRule(_NUM128, extra_check=_check_decimal_addsub),
     A.Subtract: ExprRule(_NUM128, extra_check=_check_decimal_addsub),
     A.Multiply: ExprRule(_NUM128, extra_check=_check_decimal_mult),
@@ -245,7 +266,8 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
     P.And: ExprRule(T.BOOLEAN_SIG + T.NULL_SIG),
     P.Or: ExprRule(T.BOOLEAN_SIG + T.NULL_SIG),
     P.Not: ExprRule(T.BOOLEAN_SIG + T.NULL_SIG),
-    P.IsNull: ExprRule(_DEC128_FULL), P.IsNotNull: ExprRule(_DEC128_FULL),
+    P.IsNull: ExprRule(_WITH_ARRAYS),
+    P.IsNotNull: ExprRule(_WITH_ARRAYS),
     P.IsNaN: ExprRule(T.FP_SIG + T.BOOLEAN_SIG),
     P.In: ExprRule(_DEC128_FULL),
     CO.If: ExprRule(_COMMON128), CO.CaseWhen: ExprRule(_COMMON128),
@@ -358,6 +380,13 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
         extra_check=_check_time_format),
     H.Murmur3Hash: ExprRule(_COMMON128, desc="Spark murmur3 hash"),
     H.XxHash64: ExprRule(_COMMON128, desc="Spark xxhash64"),
+    CL.Size: ExprRule(_WITH_ARRAYS),
+    CL.GetArrayItem: ExprRule(_WITH_ARRAYS),
+    CL.ElementAt: ExprRule(_WITH_ARRAYS),
+    CL.ArrayContains: ExprRule(_WITH_ARRAYS),
+    CL.CreateArray: ExprRule(_WITH_ARRAYS, extra_check=_check_create_array),
+    CL.ArrayMin: ExprRule(_WITH_ARRAYS),
+    CL.ArrayMax: ExprRule(_WITH_ARRAYS),
     U.UserDefinedExpression: ExprRule(
         _DEC128_FULL, extra_check=_check_udf,
         desc="TpuUDF (RapidsUDF analog): columnar jax kernel"),
@@ -487,6 +516,10 @@ def _exprs_of(plan) -> List[E.Expression]:
     if isinstance(plan, PN.Exchange) and isinstance(
             plan.partitioning, PN.HashPartitioning):
         return list(plan.partitioning.keys)
+    if isinstance(plan, PN.Generate):
+        return [plan.gen_expr]
+    if isinstance(plan, PN.Expand):
+        return [e for ps in plan.projections for e in ps]
     return []
 
 
@@ -496,6 +529,30 @@ EXECS: Dict[Type, ExecRule] = {}
 def _exec(cls, sig=_DEC128_FULL, tag_exprs=_exprs_of, extra=None, desc=""):
     EXECS[cls] = ExecRule(sig, tag_exprs=tag_exprs, extra_check=extra,
                           desc=desc)
+
+
+def _generate_check(meta: SparkPlanMeta):
+    plan: PN.Generate = meta.plan
+    dt = plan.gen_expr._dataType
+    if not isinstance(dt, T.ArrayType):
+        meta.will_not_work_on_tpu("explode input must be an array column")
+    elif isinstance(dt.elementType, (T.StringType, T.ArrayType, T.MapType,
+                                     T.StructType)):
+        meta.will_not_work_on_tpu(
+            "explode of non-primitive array elements is not supported on "
+            "TPU yet")
+
+
+_BNLJ_TYPES = {PN.JoinType.INNER, PN.JoinType.CROSS, PN.JoinType.LEFT_OUTER,
+               PN.JoinType.LEFT_SEMI, PN.JoinType.LEFT_ANTI}
+
+
+def _bnlj_check(meta: SparkPlanMeta):
+    plan: PN.BroadcastNestedLoopJoin = meta.plan
+    if plan.join_type not in _BNLJ_TYPES:
+        meta.will_not_work_on_tpu(
+            f"nested-loop join type {plan.join_type.value} is not supported "
+            f"on TPU (use an equi-join)")
 
 
 def _exchange_check(meta: SparkPlanMeta):
@@ -508,26 +565,29 @@ def _exchange_check(meta: SparkPlanMeta):
                     "supported on TPU (murmur3 big-integer path missing)")
 
 
-_exec(PN.LocalTableScan)
+_exec(PN.LocalTableScan, sig=_WITH_ARRAYS)
 _exec(PN.CachedRelation, desc="GpuInMemoryTableScanExec analog")
 _exec(PN.FileSourceScan, extra=_scan_check)
 _exec(PN.InsertIntoHadoopFsRelation, extra=_write_check,
       desc="GpuDataWritingCommandExec analog")
 _exec(PN.RangeNode)
-_exec(PN.Project)
-_exec(PN.Filter)
-_exec(PN.HashAggregate, extra=_agg_check)
+_exec(PN.Project, sig=_WITH_ARRAYS)
+_exec(PN.Filter, sig=_WITH_ARRAYS)
+_exec(PN.HashAggregate, extra=_agg_check)  # output never carries arrays
 _exec(PN.SortMergeJoin, extra=_join_check,
       desc="converted to shuffled sorted join (GpuSortMergeJoinMeta analog)")
 _exec(PN.ShuffledHashJoin, extra=_join_check)
 _exec(PN.BroadcastHashJoin, extra=_join_check)
 _exec(PN.Sort)
 _exec(PN.Window, sig=_COMMON128, extra=_window_check)
+_exec(PN.Generate, sig=_WITH_ARRAYS, extra=_generate_check)
+_exec(PN.Expand, sig=_WITH_ARRAYS)
+_exec(PN.BroadcastNestedLoopJoin, extra=_bnlj_check)
 _exec(PN.Exchange, extra=_exchange_check)
 _exec(PN.BroadcastExchange)
-_exec(PN.GlobalLimit)
-_exec(PN.LocalLimit)
-_exec(PN.Union)
+_exec(PN.GlobalLimit, sig=_WITH_ARRAYS)
+_exec(PN.LocalLimit, sig=_WITH_ARRAYS)
+_exec(PN.Union, sig=_WITH_ARRAYS)
 
 
 def wrap_plan(plan: PN.SparkPlan, conf: TpuConf) -> SparkPlanMeta:
@@ -593,6 +653,24 @@ def _convert_node(meta: SparkPlanMeta, tpu_children, ansi: bool):
         return X.TpuWindowExec(plan.functions, plan.partition_by,
                                plan.order_by, tpu_children[0], plan.output,
                                plan.frame, ansi)
+    if isinstance(plan, PN.Generate):
+        from spark_rapids_tpu.exec.generate import TpuGenerateExec
+
+        return TpuGenerateExec(plan.gen_expr, tpu_children[0],
+                               plan.position, plan.outer, plan.output, ansi)
+    if isinstance(plan, PN.Expand):
+        from spark_rapids_tpu.exec.generate import TpuExpandExec
+
+        return TpuExpandExec(plan.projections, tpu_children[0], plan.output,
+                             ansi)
+    if isinstance(plan, PN.BroadcastNestedLoopJoin):
+        from spark_rapids_tpu.exec.generate import (
+            TpuBroadcastNestedLoopJoinExec,
+        )
+
+        return TpuBroadcastNestedLoopJoinExec(
+            tpu_children[0], tpu_children[1], plan.join_type,
+            plan.condition, plan.output, ansi)
     if isinstance(plan, PN.Exchange):
         return X.TpuShuffleExchangeExec(plan.partitioning, tpu_children[0],
                                         ansi, conf=meta.conf)

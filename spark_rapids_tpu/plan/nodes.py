@@ -322,6 +322,79 @@ class BroadcastHashJoin(_BaseJoin):
         self.build_side = build_side
 
 
+class BroadcastNestedLoopJoin(_BaseJoin):
+    """Join without equi-keys: every pair is checked against the condition.
+
+    Reference analog: GpuBroadcastNestedLoopJoinExec (SURVEY.md §2.4)."""
+
+    def __init__(self, left, right, join_type: JoinType,
+                 condition: Optional[Expression]):
+        super().__init__(left, right, [], [], join_type, condition)
+
+    def describe(self):
+        c = self.condition.sql_string() if self.condition is not None else ""
+        return f"BroadcastNestedLoopJoin {self.join_type.value} [{c}]"
+
+
+class Generate(SparkPlan):
+    """explode/posexplode over an array column.
+
+    Reference analog: GpuGenerateExec (SURVEY.md §2.4)."""
+
+    def __init__(self, gen_expr: Expression, child: SparkPlan,
+                 position: bool = False, outer: bool = False,
+                 out_name: str = "col"):
+        super().__init__([child])
+        self.gen_expr = gen_expr
+        self.position = position
+        self.outer = outer
+        self.out_name = out_name
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        fields = list(self.child.output.fields)
+        if self.position:
+            fields.append(T.StructField("pos", T.INT, False))
+        dt = self.gen_expr.dataType
+        # non-array input is rejected at tag time; keep output well-formed
+        # so tagging can reach the check
+        et = dt.elementType if isinstance(dt, T.ArrayType) else dt
+        fields.append(T.StructField(self.out_name, et, True))
+        return T.StructType(fields)
+
+    def describe(self):
+        kind = "posexplode" if self.position else "explode"
+        if self.outer:
+            kind += "_outer"
+        return f"Generate {kind}({self.gen_expr.sql_string()})"
+
+
+class Expand(SparkPlan):
+    """Emit one output row per projection set per input row (rollup/cube
+    building block).  Reference analog: GpuExpandExec."""
+
+    def __init__(self, projections: List[List[Expression]],
+                 output_schema: T.StructType, child: SparkPlan):
+        super().__init__([child])
+        self.projections = projections
+        self._output = output_schema
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    @property
+    def output(self):
+        return self._output
+
+    def describe(self):
+        return f"Expand [{len(self.projections)} projections]"
+
+
 class Sort(SparkPlan):
     def __init__(self, orders: List[Tuple[Expression, SortSpec]],
                  is_global: bool, child: SparkPlan):

@@ -257,6 +257,15 @@ class DataFrame:
               "left_semi": PN.JoinType.LEFT_SEMI, "semi": PN.JoinType.LEFT_SEMI,
               "left_anti": PN.JoinType.LEFT_ANTI, "anti": PN.JoinType.LEFT_ANTI,
               "cross": PN.JoinType.CROSS}[how.lower()]
+        if isinstance(on, Expression):
+            # non-equi condition -> broadcast nested loop join; the
+            # condition resolves against the combined (left ++ right) schema
+            combined = T.StructType(list(self.schema.fields)
+                                    + list(other.schema.fields))
+            cond = on.resolve(combined)
+            node = PN.BroadcastNestedLoopJoin(
+                self.plan, PN.BroadcastExchange(other.plan), jt, cond)
+            return DataFrame(node, self.session)
         if isinstance(on, str):
             on = [on]
         lkeys = [_col(k).resolve(self.schema) for k in on] if on else []
@@ -293,6 +302,26 @@ class DataFrame:
 
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(PN.GlobalLimit(n, self.plan), self.session)
+
+    def explode(self, column: ColumnLike, outer: bool = False,
+                position: bool = False, out_name: str = "col") -> "DataFrame":
+        """explode/posexplode an array column; retains the other columns
+        (GpuGenerateExec analog)."""
+        gen = _to_expr(column).resolve(self.schema)
+        return DataFrame(PN.Generate(gen, self.plan, position=position,
+                                     outer=outer, out_name=out_name),
+                         self.session)
+
+    def expand(self, projections) -> "DataFrame":
+        """Emit one row per projection set per input row (GpuExpandExec;
+        the rollup/cube building block).  ``projections`` is a list of
+        same-length expression lists; output columns take names/types from
+        the first set."""
+        resolved = [[_to_expr(e).resolve(self.schema) for e in ps]
+                    for ps in projections]
+        schema = T.StructType([
+            T.StructField(e.name, e.dataType, True) for e in resolved[0]])
+        return DataFrame(PN.Expand(resolved, schema, self.plan), self.session)
 
     def cache(self) -> "DataFrame":
         """Materialize this DataFrame's batches on first action and reuse

@@ -134,6 +134,22 @@ class DecimalGen(DataGen):
         return Decimal(unscaled).scaleb(-self.scale)
 
 
+class ArrayGen(DataGen):
+    """Arrays of primitive elements (device layout: padded list column)."""
+
+    def __init__(self, elem_gen, min_len=0, max_len=6, nullable=True,
+                 elem_null_prob=0.1):
+        super().__init__(T.ArrayType(elem_gen.data_type), nullable)
+        self.elem_gen = elem_gen
+        self.min_len, self.max_len = min_len, max_len
+        self.elem_null_prob = elem_null_prob
+
+    def gen_value(self, rng):
+        ln = rng.randint(self.min_len, self.max_len)
+        return [None if rng.random() < self.elem_null_prob
+                else self.elem_gen.gen_value(rng) for _ in range(ln)]
+
+
 class StringGen(DataGen):
     def __init__(self, pattern: Optional[str] = None, nullable=True,
                  min_len=0, max_len=20, charset=None):

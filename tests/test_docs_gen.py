@@ -1,0 +1,24 @@
+"""Docs generation drift check (reference: SupportedOpsDocs + configs.md
+generation verified in CI)."""
+import os
+
+
+def test_generated_docs_are_current():
+    import sys
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(here, "docs"))
+    import gen_docs
+
+    with open(os.path.join(here, "docs", "supported_ops.md")) as f:
+        assert f.read() == gen_docs.gen_supported_ops(), \
+            "docs/supported_ops.md is stale — run python docs/gen_docs.py"
+    with open(os.path.join(here, "docs", "configs.md")) as f:
+        assert f.read() == gen_docs.gen_configs(), \
+            "docs/configs.md is stale — run python docs/gen_docs.py"
+
+
+def test_registry_minimums():
+    from spark_rapids_tpu.overrides.overrides import EXECS, EXPRESSIONS
+
+    assert len(EXPRESSIONS) >= 120, len(EXPRESSIONS)
+    assert len(EXECS) >= 18, len(EXECS)

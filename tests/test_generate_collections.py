@@ -1,0 +1,127 @@
+"""Generate/explode, collection expressions, Expand, BNLJ tests
+(reference: generate_expr_test.py, collection_ops_test.py, join_test.py's
+BNLJ cases)."""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.session import col, lit, sum_
+
+from asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    assert_tpu_fallback_collect,
+)
+from data_gen import ArrayGen, IntegerGen, LongGen, StringGen, gen_df
+
+_arr_int = ArrayGen(IntegerGen(nullable=False))
+
+
+def test_size_element_at_get_item():
+    from spark_rapids_tpu.expr.collections import (
+        ElementAt, GetArrayItem, Size)
+
+    def build(s):
+        df = gen_df(s, [_arr_int, IntegerGen(min_val=-4, max_val=8)],
+                    ["a", "i"], length=300)
+        return df.select(Size(col("a")).alias("sz"),
+                         GetArrayItem(col("a"), col("i")).alias("gi"),
+                         ElementAt(col("a"), col("i")).alias("ea"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_array_contains_min_max():
+    from spark_rapids_tpu.expr.collections import (
+        ArrayContains, ArrayMax, ArrayMin)
+
+    def build(s):
+        df = gen_df(s, [ArrayGen(IntegerGen(min_val=0, max_val=10,
+                                            nullable=False)),
+                        IntegerGen(min_val=0, max_val=10, nullable=False)],
+                    ["a", "v"], length=300)
+        return df.select(ArrayContains(col("a"), col("v")).alias("c"),
+                         ArrayMin(col("a")).alias("mn"),
+                         ArrayMax(col("a")).alias("mx"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_create_array_roundtrip():
+    from spark_rapids_tpu.expr.collections import CreateArray, Size
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(), IntegerGen(), IntegerGen()],
+                    ["a", "b", "c"], length=200)
+        return df.select(
+            Size(CreateArray([col("a"), col("b"), col("c")])).alias("sz"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("outer", [False, True], ids=["inner", "outer"])
+@pytest.mark.parametrize("position", [False, True], ids=["explode", "pos"])
+def test_explode(outer, position):
+    def build(s):
+        df = gen_df(s, [IntegerGen(nullable=False), _arr_int],
+                    ["k", "a"], length=200)
+        return df.explode(col("a"), outer=outer, position=position)
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_explode_then_aggregate():
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=5, nullable=False),
+                        ArrayGen(LongGen(min_val=-1000, max_val=1000,
+                                         nullable=False))],
+                    ["k", "a"], length=300)
+        return df.explode(col("a")).group_by("k").agg(sum_("col", "s"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_expand_rollup_shape():
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=3, nullable=False),
+                        LongGen(min_val=-100, max_val=100, nullable=False)],
+                    ["k", "v"], length=200)
+        # rollup-style: (k, v) and (null-as-total, v)
+        return df.expand([[col("k"), col("v")],
+                          [(col("k") * lit(0)).alias("k"), col("v")]])
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+def test_bnlj_condition_join(how):
+    def build(s):
+        left = gen_df(s, [IntegerGen(min_val=0, max_val=50, nullable=False),
+                          IntegerGen()], ["a", "x"], length=120)
+        right = gen_df(s, [IntegerGen(min_val=0, max_val=50, nullable=False),
+                           IntegerGen()], ["b", "y"], length=80, seed=9)
+        return left.join(right, on=col("a") < col("b"), how=how)
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_bnlj_full_outer_falls_back():
+    def build(s):
+        left = gen_df(s, [IntegerGen(nullable=False)], ["a"], length=20)
+        right = gen_df(s, [IntegerGen(nullable=False)], ["b"], length=20,
+                       seed=3)
+        return left.join(right, on=col("a") < col("b"), how="full")
+
+    assert_tpu_fallback_collect(build, "BroadcastNestedLoopJoin")
+
+
+def test_explode_non_array_rejected_at_tag_time():
+    from spark_rapids_tpu.session import TpuSession
+
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    df = gen_df(s, [IntegerGen()], ["a"], length=10).explode(col("a"))
+    root, meta = df._planned()
+
+    def find(m):
+        if type(m.plan).__name__ == "Generate" and not m.can_this_run:
+            return True
+        return any(find(c) for c in m.child_metas)
+    assert meta is not None and find(meta), meta.explain(only_fallback=False)
