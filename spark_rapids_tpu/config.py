@@ -246,6 +246,12 @@ ORC_ENABLED = conf("spark.rapids.sql.format.orc.enabled").boolean_conf(True)
 
 # --- shuffle ---------------------------------------------------------------
 
+PROFILE_ENABLED = conf("spark.rapids.profile.enabled").doc(
+    "Wrap every operator's per-batch work in jax.profiler TraceAnnotations "
+    "so XProf/Perfetto timelines attribute device time to plan operators "
+    "(the NVTX-ranges analog; reference: nvtx_profiling.md + the CUPTI "
+    "profiler module).").boolean_conf(False)
+
 SHUFFLE_MODE = conf("spark.rapids.shuffle.mode").doc(
     "MULTITHREADED (serialize batches host-side, concat-friendly Kudo-style "
     "format), ICI (device-resident all-to-all over the TPU interconnect via "

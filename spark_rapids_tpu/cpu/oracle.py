@@ -1474,6 +1474,56 @@ def _h_udf(e, cols, n, ansi):
     return CpuCol(dt, arr, validity)
 
 
+def _java_replacement_to_python(r: str) -> str:
+    """Java replacement -> python re template: $n -> \\n (group ref),
+    \\$ -> literal $, literal backslashes doubled."""
+    out = []
+    i = 0
+    while i < len(r):
+        ch = r[i]
+        if ch == "\\" and i + 1 < len(r):
+            nxt = r[i + 1]
+            out.append("$" if nxt == "$" else "\\\\" + nxt)
+            i += 2
+        elif ch == "$" and i + 1 < len(r) and r[i + 1].isdigit():
+            out.append("\\" + r[i + 1])
+            i += 2
+        elif ch == "\\":
+            out.append("\\\\")
+            i += 1
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+def _h_regexp_replace(e, cols, n, ansi):
+    import re as _re
+
+    c = eval_expr(e.children[0], cols, n, ansi)
+    pat = _re.compile(_java_regex_to_python(str(e.children[1].value)))
+    repl = _java_replacement_to_python(str(e.children[2].value))
+    out = np.array([pat.sub(repl, v) if v is not None else None
+                    for v in c.values], object)
+    return CpuCol(T.STRING, out, c.validity.copy())
+
+
+def _h_regexp_extract(e, cols, n, ansi):
+    import re as _re
+
+    c = eval_expr(e.children[0], cols, n, ansi)
+    pat = _re.compile(_java_regex_to_python(str(e.children[1].value)))
+    idx = int(e.children[2].value)
+    out = []
+    for v in c.values:
+        if v is None:
+            out.append(None)
+            continue
+        m = pat.search(v)
+        out.append((m.group(idx) or "") if m else "")
+    return CpuCol(T.STRING, np.array(out, object), c.validity.copy())
+
+
 def _h_octetbit(e, cols, n, ansi):
     (c,) = _kids(e, cols, n, ansi)
     mult = 8 if type(e).__name__ == "BitLength" else 1
@@ -1916,6 +1966,8 @@ _HANDLERS = {
     "ArrayMax": _h_array_minmax,
     "StringLeft": _h_leftright, "StringRight": _h_leftright,
     "SubstringIndex": _h_substring_index,
+    "RegExpReplace": _h_regexp_replace,
+    "RegExpExtract": _h_regexp_extract,
 }
 
 
@@ -2500,8 +2552,14 @@ def _cpu_generate(plan: PN.Generate, ansi: bool):
     m = len(rows)
     out = []
     for c in cols:
-        vals = np.array([c.values[r[0]] for r in rows],
-                        dtype=c.values.dtype)
+        if c.values.dtype == object:
+            # np.array() would collapse equal-length lists into a 2-D array
+            vals = np.empty(m, object)
+            for j, r in enumerate(rows):
+                vals[j] = c.values[r[0]]
+        else:
+            vals = np.array([c.values[r[0]] for r in rows],
+                            dtype=c.values.dtype)
         valid = np.array([c.validity[r[0]] for r in rows], np.bool_)
         out.append(CpuCol(c.dtype, vals, valid))
     if plan.position:

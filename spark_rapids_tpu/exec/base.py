@@ -10,6 +10,10 @@ Metrics mirror the reference's standard names (GpuMetric / GpuTaskMetrics):
 opTime, numOutputRows, numOutputBatches, sortTime, joinTime, concatTime,
 semaphoreWaitTime, spillTime, retryCount — surfaced via .metrics and the
 explain output.
+Tracing (SURVEY.md §5.1): with ``spark.rapids.profile.enabled`` every
+operator's batch iteration is wrapped in a ``jax.profiler.TraceAnnotation``
+named after the operator — the NVTX-range analog, visible in XProf /
+Perfetto captures via ``jax.profiler.trace``.
 """
 from __future__ import annotations
 
@@ -53,6 +57,39 @@ class TpuMetric:
         return TpuMetric._Timer(self)
 
 
+def enable_operator_tracing(root: "TpuExec", on: bool = True) -> None:
+    """Mark an exec tree for jax.profiler TraceAnnotations (driven by
+    spark.rapids.profile.enabled; scoped per plan, not process-global, so
+    concurrent sessions with different settings do not interfere)."""
+    root._trace_on = on
+    for c in root.children:
+        if isinstance(c, TpuExec):
+            enable_operator_tracing(c, on)
+
+
+def _traced(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, *a, **kw):
+        if not getattr(self, "_trace_on", False):
+            yield from fn(self, *a, **kw)
+            return
+        import jax.profiler
+
+        it = fn(self, *a, **kw)
+        name = self.node_name
+        while True:
+            with jax.profiler.TraceAnnotation(name):
+                try:
+                    b = next(it)
+                except StopIteration:
+                    return
+            yield b
+
+    return wrapper
+
+
 class TpuExec:
     """Base TPU operator; children may be TpuExec or transition nodes."""
 
@@ -92,6 +129,13 @@ class TpuExec:
         self.metrics["numOutputRows"] += b.num_rows
         self.metrics["numOutputBatches"] += 1
         return b
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        # wrap execute_columnar with per-operator trace annotations
+        # (NvtxRange analog); zero overhead unless profiling is enabled
+        if "execute_columnar" in cls.__dict__:
+            cls.execute_columnar = _traced(cls.execute_columnar)
 
     def collect_metrics(self, into=None) -> Dict[str, int]:
         into = into if into is not None else {}

@@ -229,6 +229,7 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
 
     def _join_chunk(self, lb: ColumnarBatch, rbatch: ColumnarBatch,
                     nright: int, jt: JoinType, pair_schema):
+        flag_msgs_store = flag_msgs = []
         nl = lb.num_rows
         if jt in (JoinType.INNER, JoinType.CROSS):
             if nl * nright == 0:
@@ -253,7 +254,8 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
                 pred = self.condition.eval_tpu(ctx)
                 ok = pred.data & pred.validity & pair_ok
                 flags = tuple(jnp.any(f) for f, _ in ctx.error_flags)
-                self._flag_msgs = [m for _, m in ctx.error_flags]
+                flag_msgs.clear()
+                flag_msgs.extend(m for _, m in ctx.error_flags)
             else:
                 ok = pair_ok
             li_safe = jnp.where(pair_ok, li, 0).astype(jnp.int32)
@@ -263,14 +265,18 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
                 num_segments=lb.capacity) > 0
             return tuple(lo), tuple(ro), ok, any_match, flags
 
-        self._flag_msgs = []
-        mf = self._cached(("match", out_cap, lb.capacity), match_fn)
+        key = ("match", out_cap, lb.capacity)
+        if key not in self._jits:
+            # msgs list is captured by the traced fn and cached WITH the jit
+            # so cache hits still know the flag messages
+            self._jits[key] = (jax.jit(match_fn), flag_msgs_store)
+        mf, flag_msgs = self._jits[key]
         lo, ro, ok, any_match, flags = mf(
             tuple(lb.columns), tuple(rbatch.columns),
             jnp.int64(nl), jnp.int64(nright))
         from spark_rapids_tpu.expr.base import SparkArithmeticException
 
-        for f, m in zip(flags, list(self._flag_msgs)):
+        for f, m in zip(flags, list(flag_msgs)):
             if bool(f):
                 raise SparkArithmeticException(m)
         if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):

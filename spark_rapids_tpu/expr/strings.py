@@ -925,3 +925,121 @@ class SubstringIndex(Expression):
         return DeviceColumn(T.STRING, validity,
                             chars=jnp.where(keep, gathered, 0).astype(jnp.uint8),
                             lengths=out_len.astype(jnp.int32))
+
+
+class RegExpReplace(Expression):
+    """regexp_replace(str, pattern, replacement) — all matches replaced.
+
+    Pattern + replacement are plan-time literals from the span-safe subset
+    (regex/spans.py); replacement is literal bytes (no $group refs).
+    Reference analog: GpuRegExpReplace via CudfRegexTranspiler."""
+
+    def __init__(self, s: Expression, pattern: Expression,
+                 replacement: Expression):
+        super().__init__([s, pattern, replacement])
+        self._dfa = None  # stashed by the tag-time check
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.expr.base import Literal
+        from spark_rapids_tpu.regex.spans import (
+            compile_for_spans,
+            greedy_match_starts,
+            match_lengths,
+        )
+
+        c = cols[0]
+        if self._dfa is None:
+            self._dfa = compile_for_spans(str(self.children[1].value))
+        repl = str(self.children[2].value).encode("utf-8")
+        R = len(repl)
+        w = c.width
+        n = c.lengths
+        best = match_lengths(self._dfa, c.chars, n)
+        matched, mlen = greedy_match_starts(best, n)
+        nz = matched & (mlen > 0)
+        # covered[p]: char p consumed by a (non-zero) match — diff array
+        cap = c.capacity
+        diff = jnp.zeros((cap, w + 2), jnp.int32)
+        pcols = jnp.arange(w + 1, dtype=jnp.int32)[None, :]
+        starts_idx = jnp.where(nz, pcols, w + 1)
+        ends_idx = jnp.where(nz, pcols + mlen, w + 1)
+        rows_idx = jnp.arange(cap)[:, None].repeat(w + 1, 1)
+        diff = diff.at[rows_idx, starts_idx].add(1, mode="drop")
+        diff = diff.at[rows_idx, ends_idx].add(-1, mode="drop")
+        covered = jnp.cumsum(diff[:, :w], axis=1) > 0
+        keep_char = ~covered & (jnp.arange(w)[None, :] < n[:, None])
+        # emissions per position p in [0, w]: R if matched[p], +1 if
+        # p < w and keep_char[p]
+        emit = matched.astype(jnp.int32) * R
+        emit = emit.at[:, :w].add(keep_char.astype(jnp.int32))
+        prefix = jnp.cumsum(emit, axis=1) - emit     # exclusive
+        out_len = prefix[:, -1] + emit[:, -1]
+        out_w = c.width * (R + 1) + R if R else c.width
+        from spark_rapids_tpu.columnar.column import (
+            DEFAULT_WIDTH_BUCKETS,
+            round_up_bucket,
+        )
+
+        out_w = round_up_bucket(max(out_w, 1), DEFAULT_WIDTH_BUCKETS)
+        out = jnp.zeros((cap, out_w), jnp.uint8)
+        # chars land after the (optional) replacement at their position
+        char_off = prefix[:, :w] + matched[:, :w].astype(jnp.int32) * R
+        char_tgt = jnp.where(keep_char, char_off, out_w)
+        rows_w = jnp.arange(cap)[:, None].repeat(w, 1)
+        out = out.at[rows_w, char_tgt].set(
+            jnp.where(keep_char, c.chars, 0).astype(jnp.uint8), mode="drop")
+        # replacement bytes (static unroll over R)
+        rows_w1 = jnp.arange(cap)[:, None].repeat(w + 1, 1)
+        for r, byte in enumerate(repl):
+            tgt = jnp.where(matched, prefix + r, out_w)
+            out = out.at[rows_w1, tgt].set(jnp.uint8(byte), mode="drop")
+        validity = c.validity & cols[1].validity & cols[2].validity
+        return DeviceColumn(T.STRING, validity, chars=out,
+                            lengths=out_len.astype(jnp.int32))
+
+
+class RegExpExtract(Expression):
+    """regexp_extract(str, pattern, idx) with idx == 0 (the whole match);
+    capture groups need a backtracking engine and fall back.
+
+    No match -> empty string (Spark)."""
+
+    def __init__(self, s: Expression, pattern: Expression,
+                 idx: Expression):
+        super().__init__([s, pattern, idx])
+        self._dfa = None
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.regex.spans import (
+            compile_for_spans,
+            match_lengths,
+        )
+
+        c = cols[0]
+        if self._dfa is None:
+            self._dfa = compile_for_spans(str(self.children[1].value))
+        w = c.width
+        n = c.lengths
+        best = match_lengths(self._dfa, c.chars, n)
+        has = best >= 0
+        first = jnp.argmax(has, axis=1).astype(jnp.int32)
+        found = jnp.any(has, axis=1)
+        mlen = jnp.where(found,
+                         jnp.take_along_axis(best, first[:, None],
+                                             axis=1)[:, 0], 0)
+        idx = first[:, None] + jnp.arange(w)[None, :]
+        keep = jnp.arange(w)[None, :] < mlen[:, None]
+        gathered = jnp.take_along_axis(c.chars, jnp.clip(idx, 0, w - 1),
+                                       axis=1)
+        validity = c.validity & cols[1].validity & cols[2].validity
+        return DeviceColumn(T.STRING, validity,
+                            chars=jnp.where(keep, gathered, 0).astype(jnp.uint8),
+                            lengths=jnp.where(found, mlen, 0).astype(jnp.int32))

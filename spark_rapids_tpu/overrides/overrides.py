@@ -210,6 +210,41 @@ def _check_create_array(meta: ExprMeta):
             return
 
 
+def _check_regexp_spans(meta: ExprMeta):
+    """regexp_replace/extract: literal pattern from the span-safe subset
+    (regex/spans.py), literal replacement without $group refs / backslash,
+    extract index 0 only (capture groups need backtracking)."""
+    from spark_rapids_tpu.regex import RegexUnsupported
+    from spark_rapids_tpu.regex.spans import compile_for_spans
+
+    e = meta.expr
+    pat = e.children[1]
+    if not isinstance(pat, E.Literal) or pat.value is None:
+        meta.will_not_work_on_tpu("regexp pattern must be a non-null literal")
+        return
+    try:
+        e._dfa = compile_for_spans(str(pat.value))
+    except RegexUnsupported as ex:
+        meta.will_not_work_on_tpu(str(ex))
+        return
+    third = e.children[2]
+    if not isinstance(third, E.Literal) or third.value is None:
+        meta.will_not_work_on_tpu(
+            "replacement/index must be a non-null literal")
+        return
+    if type(e).__name__ == "RegExpReplace":
+        r = str(third.value)
+        if "$" in r or "\\" in r:
+            meta.will_not_work_on_tpu(
+                "replacement with $group references or escapes is not "
+                "supported on TPU")
+    else:
+        if int(third.value) != 0:
+            meta.will_not_work_on_tpu(
+                "regexp_extract group index != 0 needs capture groups "
+                "(backtracking engine); falls back to CPU")
+
+
 def _check_udf(meta: ExprMeta):
     """RapidsUDF detection: only UDFs exposing a columnar kernel run on
     TPU; plain python functions fall back with the reference's explain
@@ -342,6 +377,10 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
         T.STRING_SIG.with_note(T.StringType, "byte-based; ASCII-exact")
         + T.INTEGRAL_SIG,
         extra_check=_check_substring_index),
+    S.RegExpReplace: ExprRule(T.STRING_SIG,
+                              extra_check=_check_regexp_spans),
+    S.RegExpExtract: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG,
+                              extra_check=_check_regexp_spans),
     S.Like: ExprRule(T.STRING_SIG + T.BOOLEAN_SIG, extra_check=_check_like),
     S.RLike: ExprRule(T.STRING_SIG + T.BOOLEAN_SIG,
                       extra_check=_check_rlike),

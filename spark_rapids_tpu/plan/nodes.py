@@ -358,7 +358,8 @@ class Generate(SparkPlan):
     def output(self):
         fields = list(self.child.output.fields)
         if self.position:
-            fields.append(T.StructField("pos", T.INT, False))
+            # posexplode_outer synthesizes NULL pos for empty/null arrays
+            fields.append(T.StructField("pos", T.INT, self.outer))
         dt = self.gen_expr.dataType
         # non-array input is rejected at tag time; keep output well-formed
         # so tagging can reach the check

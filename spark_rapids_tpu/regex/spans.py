@@ -1,0 +1,123 @@
+"""Match-span extraction on the byte DFA — regexp_replace / regexp_extract.
+
+Reference analog: RegexParser.scala consumers GpuRegExpReplace /
+GpuRegExpExtract (SURVEY.md §2.5).  The reference transpiles Java regex to
+cuDF's backtracking VM; the TPU engine is a DFA, which yields
+leftmost-LONGEST spans.  Java's backtracking engine yields leftmost-FIRST.
+The two agree exactly on the subset accepted by ``compile_for_spans``:
+
+  * no alternation anywhere (``a|b`` prefers the first branch in Java even
+    when the second is longer);
+  * greedy quantifiers only over SINGLE-BYTE atoms (a quantified group like
+    ``(aaa){0,1}(aa){0,2}`` can backtrack to a shorter total than the
+    longest);
+  * no anchors (span search is positional);
+  * no lazy/possessive quantifiers (already rejected by the parser).
+
+Everything else falls back to CPU at plan time — the same
+transpiler-reject contract RLike uses.
+
+``match_lengths`` runs the anchored DFA from EVERY start position
+simultaneously: a (rows, width) state matrix advanced over match offsets
+with one `lax.scan`; step l gathers byte p+l for every start p.  O(width)
+steps of O(rows*width) vector work — dense, scatter-free, TPU-shaped.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.regex.transpiler import (
+    CompiledRegex,
+    RAlt,
+    RLit,
+    RRep,
+    RSeq,
+    RegexUnsupported,
+    _Parser,
+    compile_regex,
+)
+
+
+def _check_spans_safe(node) -> None:
+    if isinstance(node, RAlt):
+        raise RegexUnsupported(
+            "alternation is not supported for span extraction (Java is "
+            "leftmost-first, the DFA is leftmost-longest)")
+    if isinstance(node, RRep):
+        if not isinstance(node.node, RLit):
+            raise RegexUnsupported(
+                "quantifier over a multi-byte atom is not supported for "
+                "span extraction (backtracking may pick a shorter total)")
+        return
+    if isinstance(node, RSeq):
+        for p in node.parts:
+            if p == "$":
+                raise RegexUnsupported("`$` inside a span pattern")
+            _check_spans_safe(p)
+
+
+def compile_for_spans(pattern: str) -> CompiledRegex:
+    node, anchored_start, anchored_end = _Parser(pattern).parse()
+    if anchored_start or anchored_end:
+        raise RegexUnsupported(
+            "anchors are not supported for span extraction")
+    _check_spans_safe(node)
+    return compile_regex(pattern, full_match=True)
+
+
+def match_lengths(dfa: CompiledRegex, chars: jax.Array,
+                  lengths: jax.Array) -> jax.Array:
+    """Longest match length starting at each byte position.
+
+    chars: (rows, w) uint8; lengths: (rows,) int32.
+    Returns (rows, w+1) int32: best[p] = longest l with chars[p:p+l]
+    matching the (fully anchored) DFA, or -1; column w covers the
+    end-of-string position (zero-width matches there)."""
+    rows, w = chars.shape
+    table = jnp.asarray(dfa.table)          # (n_states, 256) int32
+    accept = jnp.asarray(dfa.accept)
+    start_accepts = bool(np.asarray(dfa.accept)[0])
+    pos = jnp.arange(w + 1, dtype=jnp.int32)[None, :]      # start positions
+    started = pos <= lengths[:, None]
+    best0 = jnp.where(started & start_accepts, 0, -1).astype(jnp.int32)
+    states0 = jnp.zeros((rows, w + 1), jnp.int32)          # DFA start = 0
+
+    def step(carry, l):
+        states, best = carry
+        idx = pos[0][None, :] + l                          # byte p + l
+        inb = idx < lengths[:, None]
+        safe = jnp.clip(idx, 0, w - 1)
+        byte = jnp.take_along_axis(chars, safe, axis=1).astype(jnp.int32)
+        nxt = table[states, byte]
+        # out-of-string bytes kill the run (no byte to consume)
+        states = jnp.where(inb & started, nxt, jnp.int32(dfa.n_states - 2))
+        acc = accept[states] & inb & started
+        best = jnp.where(acc, l + 1, best)
+        return (states, best), None
+
+    (_, best), _ = jax.lax.scan(step, (states0, best0),
+                                jnp.arange(w, dtype=jnp.int32))
+    return best
+
+
+def greedy_match_starts(best: jax.Array, lengths: jax.Array):
+    """Java replaceAll scan: non-overlapping leftmost matches.
+
+    Returns (matched, mlen): (rows, w+1) bool / int32.  A zero-width match
+    consumes nothing but blocks another match at the same position."""
+    rows, wp1 = best.shape
+
+    def step(carry, p):
+        next_allowed = carry
+        b = best[:, p]
+        can = (b >= 0) & (p >= next_allowed) & (p <= lengths)
+        adv = jnp.maximum(b, 1)
+        next_allowed = jnp.where(can, p + adv, next_allowed)
+        return next_allowed, (can, jnp.where(can, b, -1))
+
+    _, (matched, mlen) = jax.lax.scan(
+        step, jnp.zeros(rows, jnp.int32),
+        jnp.arange(wp1, dtype=jnp.int32))
+    return matched.T, mlen.T

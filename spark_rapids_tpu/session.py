@@ -386,6 +386,11 @@ class DataFrame:
 
         root, _meta = self._planned()
         if isinstance(root, TpuExec):
+            from spark_rapids_tpu.config import PROFILE_ENABLED
+            from spark_rapids_tpu.exec.base import enable_operator_tracing
+
+            enable_operator_tracing(
+                root, bool(self.session.conf.get(PROFILE_ENABLED)))
             # Admission control: the thread driving this query's iterator
             # chain holds a TpuSemaphore permit while it touches the device
             # (reference: GpuSemaphore.acquireIfNecessary at first batch).

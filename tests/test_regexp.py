@@ -141,3 +141,65 @@ def test_rlike_carriage_return_dollar():
                          rlike_(col("a"), "a.").alias("dot"))
 
     assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("pattern,repl", [
+    (r"[0-9]+", "#"),
+    (r"a+", "XY"),
+    (r"x*", "_"),            # zero-width matches between every char
+    (r"[a-c][0-9]?", ""),    # empty replacement
+    (r"\.", "dot"),
+    (r"b{2,3}", "<B>"),
+])
+def test_regexp_replace(pattern, repl):
+    from spark_rapids_tpu.expr.strings import RegExpReplace
+
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=14, charset="abcx0123 .")],
+                    ["a"], length=400)
+        return df.select(
+            RegExpReplace(col("a"), lit(pattern), lit(repl)).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("pattern", [r"[0-9]+", r"a+b", r"c[0-9]{2}"])
+def test_regexp_extract_group0(pattern):
+    from spark_rapids_tpu.expr.strings import RegExpExtract
+
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=14, charset="abc0123 ")],
+                    ["a"], length=400)
+        return df.select(
+            RegExpExtract(col("a"), lit(pattern), lit(0)).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("pattern,why", [
+    (r"a|b", "alternation"),
+    (r"(ab)+", "multi-byte atom"),
+    (r"^abc", "anchor"),
+])
+def test_regexp_replace_unsupported_falls_back(pattern, why):
+    from spark_rapids_tpu.expr.strings import RegExpReplace
+
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=8, charset="ab")], ["a"],
+                    length=60)
+        return df.select(
+            RegExpReplace(col("a"), lit(pattern), lit("_")).alias("r"))
+
+    assert_tpu_fallback_collect(build, "Project")
+
+
+def test_regexp_extract_group1_falls_back():
+    from spark_rapids_tpu.expr.strings import RegExpExtract
+
+    def build(s):
+        df = gen_df(s, [StringGen(max_len=8, charset="ab01")], ["a"],
+                    length=60)
+        return df.select(
+            RegExpExtract(col("a"), lit("([0-9]+)"), lit(1)).alias("r"))
+
+    assert_tpu_fallback_collect(build, "Project")
