@@ -1,0 +1,52 @@
+// Host-side native kernels for the TPU runtime's data path.
+//
+// Reference analog: the reference's runtime hot loops live in C++
+// (spark-rapids-jni: Kudo serializer, string kernels, row conversion —
+// SURVEY.md §2.10).  The TPU compute path is XLA; the HOST glue around it
+// (decode staging, shuffle serialization) is where Python loops would
+// dominate, so those run here.  Loaded via ctypes (no pybind11 in the
+// image); spark_rapids_tpu/native.py holds the bindings + pure-Python
+// fallbacks.
+//
+// Build: python -m spark_rapids_tpu.native  (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Arrow (chars, offsets) -> padded (rows, width) char matrix.
+// offsets are int64 arrow offsets relative to buf; lengths[i] must equal
+// offsets[i+1]-offsets[i]; out is zero-initialized (rows*width).
+void ragged_to_padded(const uint8_t* buf, const int64_t* offsets,
+                      int64_t rows, int64_t width, uint8_t* out) {
+    for (int64_t i = 0; i < rows; ++i) {
+        const int64_t start = offsets[i];
+        const int64_t len = offsets[i + 1] - start;
+        if (len > 0) {
+            std::memcpy(out + i * width, buf + start,
+                        static_cast<size_t>(len < width ? len : width));
+        }
+    }
+}
+
+// Padded (rows, width) char matrix -> packed bytes + int32 offsets
+// (the serializer's ragged write).  out must hold sum(lengths) bytes;
+// out_offsets must hold rows+1 entries.
+void padded_to_ragged(const uint8_t* chars, const int32_t* lengths,
+                      int64_t rows, int64_t width, uint8_t* out,
+                      int64_t* out_offsets) {
+    int64_t pos = 0;
+    out_offsets[0] = 0;
+    for (int64_t i = 0; i < rows; ++i) {
+        const int64_t len = lengths[i] < width ? lengths[i] : width;
+        if (len > 0) {
+            std::memcpy(out + pos, chars + i * width,
+                        static_cast<size_t>(len));
+            pos += len;
+        }
+        out_offsets[i + 1] = pos;
+    }
+}
+
+}  // extern "C"

@@ -418,11 +418,9 @@ class HostColumn:
             lengths = (offs[1:] - offs[:-1]).astype(np.int32)
             width = int(lengths.max()) if n and lengths.size else 1
             width = max(width, 1)
-            chars = np.zeros((n, width), dtype=np.uint8)
-            for i in range(n):  # TODO(perf): vectorize ragged gather
-                s, ln = offs[i], lengths[i]
-                if ln:
-                    chars[i, :ln] = buf[s: s + ln]
+            from spark_rapids_tpu.native import ragged_to_padded
+
+            chars = ragged_to_padded(buf, offs, width)
             return HostColumn(dtype, validity, chars=chars, lengths=lengths)
         sdt = T.storage_dtype(dtype)
         if isinstance(dtype, T.DecimalType):

@@ -1,0 +1,145 @@
+"""ctypes bindings for the native host kernels (native/host_kernels.cpp).
+
+Reference analog: the reference runtime's C++ host components
+(spark-rapids-jni Kudo serializer / string kernels, SURVEY.md §2.10);
+python↔native goes through ctypes because pybind11 is not in the image.
+
+The library is compiled on first use with g++ (cached next to the source);
+every entry point has a pure-Python fallback so a missing toolchain only
+costs speed, never correctness.  ``python -m spark_rapids_tpu.native``
+rebuilds and self-tests.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_HERE, "native", "host_kernels.cpp")
+_SO = os.path.join(_HERE, "native", "host_kernels.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_lib():
+    """The loaded library, or None (fallbacks used)."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SRC):
+            return None
+        if not os.path.exists(_SO) or (os.path.getmtime(_SO)
+                                       < os.path.getmtime(_SRC)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            i32p = ctypes.POINTER(ctypes.c_int32)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            lib.ragged_to_padded.argtypes = [u8p, i64p, ctypes.c_int64,
+                                             ctypes.c_int64, u8p]
+            lib.padded_to_ragged.argtypes = [u8p, i32p, ctypes.c_int64,
+                                             ctypes.c_int64, u8p, i64p]
+        except Exception:
+            # stale/incompatible .so: fall back to the python paths
+            return None
+        _lib = lib
+        return _lib
+
+
+def _p(arr, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def ragged_to_padded(buf: np.ndarray, offsets: np.ndarray,
+                     width: int) -> np.ndarray:
+    """Arrow string (chars buffer, int64 offsets) -> (rows, width) uint8."""
+    rows = len(offsets) - 1
+    out = np.zeros((rows, max(width, 1)), np.uint8)
+    lib = get_lib()
+    if lib is not None and rows:
+        buf = np.ascontiguousarray(buf)
+        offsets = np.ascontiguousarray(offsets, np.int64)
+        lib.ragged_to_padded(_p(buf, ctypes.c_uint8),
+                             _p(offsets, ctypes.c_int64),
+                             rows, out.shape[1],
+                             _p(out, ctypes.c_uint8))
+        return out
+    for i in range(rows):
+        s, e = offsets[i], offsets[i + 1]
+        ln = min(e - s, out.shape[1])
+        if ln > 0:
+            out[i, :ln] = buf[s: s + ln]
+    return out
+
+
+def padded_to_ragged(chars: np.ndarray, lengths: np.ndarray):
+    """(rows, width) uint8 + lengths -> (packed bytes, int64 offsets)."""
+    rows, width = chars.shape
+    lens = np.minimum(lengths.astype(np.int64), width)
+    total = int(lens.sum())
+    out = np.empty(total, np.uint8)
+    offsets = np.empty(rows + 1, np.int64)
+    lib = get_lib()
+    if lib is not None and rows:
+        chars = np.ascontiguousarray(chars)
+        l32 = np.ascontiguousarray(lengths, np.int32)
+        lib.padded_to_ragged(_p(chars, ctypes.c_uint8),
+                             _p(l32, ctypes.c_int32), rows, width,
+                             _p(out, ctypes.c_uint8),
+                             _p(offsets, ctypes.c_int64))
+        return out, offsets
+    pos = 0
+    offsets[0] = 0
+    for i in range(rows):
+        ln = int(lens[i])
+        if ln:
+            out[pos: pos + ln] = chars[i, :ln]
+            pos += ln
+        offsets[i + 1] = pos
+    return out, offsets
+
+
+def _selftest():
+    import time
+
+    strs = [b"hello", b"", b"a" * 37, b"xy"] * 50000
+    offs = np.zeros(len(strs) + 1, np.int64)
+    np.cumsum([len(s) for s in strs], out=offs[1:])
+    buf = np.frombuffer(b"".join(strs), np.uint8)
+    width = 64
+    t0 = time.perf_counter()
+    out = ragged_to_padded(buf, offs, width)
+    t_native = time.perf_counter() - t0
+    for i in (0, 1, 2, 3):
+        assert bytes(out[i, : len(strs[i])]) == strs[i]
+        assert not out[i, len(strs[i]):].any()
+    lengths = (offs[1:] - offs[:-1]).astype(np.int32)
+    packed, offs2 = padded_to_ragged(out, lengths)
+    assert bytes(packed[: len(strs[0])]) == strs[0]
+    assert np.array_equal(offs, offs2)
+    mode = "native" if get_lib() is not None else "python fallback"
+    print(f"host_kernels self-test OK ({mode}; "
+          f"{len(strs)} rows in {t_native * 1000:.1f}ms)")
+
+
+if __name__ == "__main__":
+    _selftest()

@@ -505,7 +505,31 @@ class GroupedData:
 
     def agg(self, *aggs) -> "DataFrame":
         """aggs: tuples (func, column-or-None, result_name) or
-        AggregateExpression."""
+        AggregateExpression.  count_distinct/sum_distinct expand to a
+        two-level aggregation at plan time (dedup on (keys, expr), then
+        aggregate) — Spark's single-distinct-column rewrite — so both the
+        TPU path and the oracle execute the same plan."""
+        specs = list(aggs)
+        distinct = [a for a in specs if isinstance(a, tuple)
+                    and a[0] in ("count_distinct", "sum_distinct")]
+        if distinct:
+            if len(distinct) != len(specs):
+                raise NotImplementedError(
+                    "mixing distinct and non-distinct aggregates is not "
+                    "supported yet")
+            children = {str(a[1]) for a in distinct}
+            if len(children) != 1:
+                raise NotImplementedError(
+                    "distinct aggregates over multiple columns are not "
+                    "supported yet")
+            schema = self.df.schema
+            dcol = _to_expr(distinct[0][1]).resolve(schema)
+            dedup = GroupedData(self.df, self.keys + [dcol]).agg()
+            outer_keys = [k.name for k in self.keys]
+            outer = [(a[0].replace("_distinct", ""), dcol.name, a[2])
+                     for a in distinct]
+            return dedup.group_by(*outer_keys).agg(*outer) if outer_keys \
+                else dedup.agg(*outer)
         schema = self.df.schema
         aexprs: List[PN.AggregateExpression] = []
         for a in aggs:
@@ -547,6 +571,14 @@ def sum_(c: ColumnLike, name: str = "sum") -> Tuple[str, ColumnLike, str]:
 
 def count_(c: Optional[ColumnLike] = None, name: str = "count"):
     return ("count", c, name) if c is not None else ("count_star", None, name)
+
+
+def count_distinct_(c: ColumnLike, name: str = "count_distinct"):
+    return ("count_distinct", c, name)
+
+
+def sum_distinct_(c: ColumnLike, name: str = "sum_distinct"):
+    return ("sum_distinct", c, name)
 
 
 def min_(c: ColumnLike, name: str = "min"):

@@ -93,11 +93,15 @@ def serialize_batch(batch: ColumnarBatch, codec: Optional[str] = None) -> bytes:
         validity = np.asarray(validity)[:n]
         vbuf = add_buffer(np.packbits(validity, bitorder="little").tobytes())
         if c.is_string:
+            from spark_rapids_tpu.native import padded_to_ragged
+
             lengths = np.asarray(lengths)[:n]
             width = int(lengths.max()) if n else 0
-            chars = np.ascontiguousarray(np.asarray(chars)[:n, :width])
+            chars_np = np.ascontiguousarray(np.asarray(chars)[:n])
+            # ragged wire layout (Kudo-style): padding bytes never travel
+            packed, _ = padded_to_ragged(chars_np, lengths)
             lbuf = add_buffer(lengths.astype(np.int32).tobytes())
-            cbuf = add_buffer(chars.tobytes())
+            cbuf = add_buffer(packed.tobytes())
             header_cols.append({
                 "kind": "string", "width": width,
                 "validity": vbuf, "lengths": lbuf, "chars": cbuf})
@@ -160,14 +164,19 @@ def deserialize_concat(blocks: Sequence[bytes], schema: T.StructType,
                 vbits, count=n, bitorder="little").astype(np.bool_)
             if is_string:
                 loff, llen = col["lengths"]
-                lengths[row: row + n] = np.frombuffer(
-                    body, np.int32, count=n, offset=loff)
+                lens = np.frombuffer(body, np.int32, count=n, offset=loff)
+                lengths[row: row + n] = lens
                 w = col["width"]
                 if w:
+                    from spark_rapids_tpu.native import ragged_to_padded
+
                     coff, clen = col["chars"]
-                    chars[row: row + n, :w] = np.frombuffer(
-                        body, np.uint8, count=n * w, offset=coff
-                    ).reshape(n, w)
+                    packed = np.frombuffer(body, np.uint8, count=clen,
+                                           offset=coff)
+                    offs = np.zeros(n + 1, np.int64)
+                    np.cumsum(lens, out=offs[1:])
+                    chars[row: row + n, :w] = ragged_to_padded(
+                        packed, offs, w)[:, :w]
             else:
                 doff, dlen = col["data"]
                 k = int(np.prod(trail)) if trail else 1

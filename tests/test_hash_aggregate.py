@@ -202,3 +202,22 @@ def test_variance_decimal_input():
             ("var_pop", col("v"), "vp"), ("stddev_samp", col("v"), "ss"))
 
     assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_count_sum_distinct():
+    from spark_rapids_tpu.session import count_distinct_, sum_distinct_
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=5),
+                        IntegerGen(min_val=0, max_val=20)], ["k", "v"],
+                    length=400)
+        return df.group_by("k").agg(count_distinct_("v", "cd"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+    def build2(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=20)], ["v"],
+                    length=300)
+        return df.agg(sum_distinct_("v", "sd"))
+
+    assert_tpu_and_cpu_are_equal_collect(build2)

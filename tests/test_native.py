@@ -1,0 +1,54 @@
+"""Native host kernel tests (native/host_kernels.cpp via ctypes;
+reference analog: spark-rapids-jni host-side kernels, SURVEY.md §2.10)."""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import native
+
+
+def _mk(strs):
+    offs = np.zeros(len(strs) + 1, np.int64)
+    np.cumsum([len(s) for s in strs], out=offs[1:])
+    buf = np.frombuffer(b"".join(strs), np.uint8)
+    return buf, offs
+
+
+@pytest.mark.parametrize("use_native", [True, False],
+                         ids=["native", "fallback"])
+def test_ragged_roundtrip(use_native, monkeypatch):
+    if use_native and native.get_lib() is None:
+        pytest.skip("toolchain unavailable")
+    if not use_native:
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_tried", True)
+    strs = [b"hello", b"", b"a" * 37, b"xy", b"\x00bin\xff"]
+    buf, offs = _mk(strs)
+    out = native.ragged_to_padded(buf, offs, 40)
+    for i, s in enumerate(strs):
+        assert bytes(out[i, : len(s)]) == s
+        assert not out[i, len(s):].any()
+    lengths = (offs[1:] - offs[:-1]).astype(np.int32)
+    packed, offs2 = native.padded_to_ragged(out, lengths)
+    assert packed.tobytes() == b"".join(strs)
+    assert np.array_equal(offs, offs2)
+
+
+def test_native_matches_fallback():
+    if native.get_lib() is None:
+        pytest.skip("toolchain unavailable")
+    rng = np.random.default_rng(0)
+    strs = [bytes(rng.integers(0, 256, rng.integers(0, 30)).astype(np.uint8))
+            for _ in range(500)]
+    buf, offs = _mk(strs)
+    a = native.ragged_to_padded(buf, offs, 32)
+    lib, tried = native._lib, native._tried
+    try:
+        native._lib, native._tried = None, True
+        b = native.ragged_to_padded(buf, offs, 32)
+    finally:
+        native._lib, native._tried = lib, tried
+    assert np.array_equal(a, b)
+
+
+def test_native_library_builds():
+    assert native.get_lib() is not None, "g++ is in the image; must build"
