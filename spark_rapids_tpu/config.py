@@ -180,6 +180,13 @@ SPLIT_UNTIL_ROWS = conf("spark.rapids.tpu.retry.minSplitRows").doc(
     "Do not split batches below this many rows on SplitAndRetry."
 ).integer_conf(8)
 
+AUTO_BROADCAST_JOIN_THRESHOLD = conf(
+    "spark.sql.autoBroadcastJoinThreshold").doc(
+    "Estimated build-side size below which joins broadcast instead of "
+    "shuffling (Spark's conf; file-scan sizes come from file footers, "
+    "local tables from their host columns).  -1 disables broadcasting."
+).bytes_conf(10 << 20)
+
 # --- plan / exec switches --------------------------------------------------
 
 ENABLE_CAST_FLOAT_TO_STRING = conf(

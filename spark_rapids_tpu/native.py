@@ -45,10 +45,22 @@ def get_lib():
         _tried = True
         if not os.path.exists(_SRC):
             return None
-        if not os.path.exists(_SO) or (os.path.getmtime(_SO)
-                                       < os.path.getmtime(_SRC)):
+        # rebuild keyed on a source HASH (mtimes are not preserved by git
+        # checkouts, so a stale binary could silently shadow newer source)
+        import hashlib
+
+        with open(_SRC, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()
+        stamp = _SO + ".hash"
+        current = None
+        if os.path.exists(stamp):
+            with open(stamp) as f:
+                current = f.read().strip()
+        if not os.path.exists(_SO) or current != digest:
             if not _build():
                 return None
+            with open(stamp, "w") as f:
+                f.write(digest)
         try:
             lib = ctypes.CDLL(_SO)
             u8p = ctypes.POINTER(ctypes.c_uint8)
