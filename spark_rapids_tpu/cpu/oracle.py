@@ -2246,7 +2246,8 @@ def _agg_partial(a: PN.AggregateExpression, ac: Optional[CpuCol],
 
 
 def _agg_final(a: PN.AggregateExpression, ac, rows_per_group) -> CpuCol:
-    """Merge partial buffers."""
+    """Merge partial buffers (collect_* never reaches FINAL — the planner
+    builds it single-phase COMPLETE)."""
     ng = len(rows_per_group)
     if a.func == "avg":
         sc, cc = ac
@@ -2328,6 +2329,21 @@ def _agg_one(a: PN.AggregateExpression, ac: Optional[CpuCol],
     if func == "count_star":
         return (np.array([len(r) for r in rows_per_group], np.int64),
                 np.ones(ng, np.bool_))
+    if func in ("collect_list", "collect_set"):
+        vals = np.empty(ng, object)
+        for gi in range(ng):
+            xs = [ac.row(i) for i in rows_per_group[gi] if ac.validity[i]]
+            if func == "collect_set":
+                # NaN == NaN for set membership (Spark total order); output
+                # ascending with NaN last, matching the TPU kernel's keys
+                has_nan = any(isinstance(x, float) and math.isnan(x)
+                              for x in xs)
+                rest = sorted({x for x in xs
+                               if not (isinstance(x, float)
+                                       and math.isnan(x))})
+                xs = rest + ([float("nan")] if has_nan else [])
+            vals[gi] = xs
+        return vals, np.ones(ng, np.bool_)
     out = []
     valid = np.ones(ng, np.bool_)
     dec = isinstance(a.result_type, T.DecimalType)

@@ -78,8 +78,59 @@ class TpuHashAggregateExec(TpuExec):
         return (f"TpuHashAggregate({self.mode.value}) keys=[{g}] "
                 f"aggs=[{a}]{fused}")
 
+    @property
+    def _has_collect(self) -> bool:
+        return any(a.func in ("collect_list", "collect_set")
+                   for a in self.aggregates)
+
     # ------------------------------------------------------------------
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        if self._has_collect:
+            yield from self._execute_collect()
+            return
+        yield from self._execute_streaming()
+
+    def _execute_collect(self) -> Iterator[ColumnarBatch]:
+        """collect_list/collect_set: concat all input (a hash exchange has
+        already co-located keys), ONE aggregate pass (array-buffer merges
+        across partials are not implemented — reference: GpuCollectList is
+        likewise a memory-hungry TypedImperativeAggregate)."""
+        batches = list(self.children[0].execute_columnar())
+        if not batches:
+            from spark_rapids_tpu.columnar.batch import empty_batch
+
+            if not self.grouping:
+                yield self._count_output(self._collect_empty_global())
+            else:
+                yield self._count_output(empty_batch(self._output))
+            return
+        with self.metrics["opTime"].timed():
+            batch = (batches[0] if len(batches) == 1
+                     else ColumnarBatch.concat(batches))
+            yield self._count_output(self._aggregate_batch(batch))
+
+    def _collect_empty_global(self) -> ColumnarBatch:
+        cols = []
+        for a, f in zip(self.aggregates, self._output.fields):
+            if a.func in ("collect_list", "collect_set"):
+                # empty array, not null
+                cols.append(DeviceColumn(
+                    f.dataType, jnp.ones(1, jnp.bool_),
+                    data=jnp.zeros((1, 1),
+                                   T.storage_dtype(f.dataType.elementType)),
+                    lengths=jnp.zeros(1, jnp.int32),
+                    elem_valid=jnp.zeros((1, 1), jnp.bool_)))
+            elif a.func in ("count", "count_star"):
+                cols.append(DeviceColumn(
+                    f.dataType, jnp.ones(1, jnp.bool_),
+                    data=jnp.zeros(1, T.storage_dtype(f.dataType))))
+            else:
+                cols.append(DeviceColumn(
+                    f.dataType, jnp.zeros(1, jnp.bool_),
+                    data=jnp.zeros(1, T.storage_dtype(f.dataType))))
+        return ColumnarBatch(cols, 1, self._output)
+
+    def _execute_streaming(self) -> Iterator[ColumnarBatch]:
         """Streaming aggregation with bounded memory.
 
         Reference analog: GpuAggregateIterator + GpuMergeAggregateIterator —
@@ -326,12 +377,61 @@ class TpuHashAggregateExec(TpuExec):
 
     # ------------------------------------------------------------------
     def _aggregate_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
-        if getattr(self, "_jitted", None) is None:
-            self._jitted = jax.jit(self._agg_fn)
-        cols, nrows = self._jitted(tuple(batch.columns),
-                                   jnp.int32(batch.num_rows))
+        if self._has_collect:
+            # array output width must be static: pre-pass for the largest
+            # group's row count, bucketed (jit cached per bucket)
+            from spark_rapids_tpu.columnar.column import (
+                DEFAULT_WIDTH_BUCKETS,
+                round_up_bucket,
+            )
+
+            if getattr(self, "_maxgrp_jit", None) is None:
+                self._maxgrp_jit = jax.jit(self._max_group_rows_fn)
+            mx = int(self._maxgrp_jit(tuple(batch.columns),
+                                      jnp.int32(batch.num_rows)))
+            self._collect_ewidth = round_up_bucket(
+                max(mx, 1), DEFAULT_WIDTH_BUCKETS)
+            cache = getattr(self, "_collect_jits", None)
+            if cache is None:
+                cache = self._collect_jits = {}
+            if self._collect_ewidth not in cache:
+                cache[self._collect_ewidth] = jax.jit(self._agg_fn)
+            jitted = cache[self._collect_ewidth]
+        else:
+            if getattr(self, "_jitted", None) is None:
+                self._jitted = jax.jit(self._agg_fn)
+            jitted = self._jitted
+        cols, nrows = jitted(tuple(batch.columns),
+                             jnp.int32(batch.num_rows))
         n = 1 if not self.grouping else int(nrows)
         return ColumnarBatch(list(cols), n, self._output)
+
+    def _max_group_rows_fn(self, cols, num_rows):
+        """Largest per-group row count (the collect array width bound)."""
+        batch = ColumnarBatch(list(cols), num_rows, self.input_schema)
+        ctx = EvalContext(batch, ansi=self.ansi)
+        mask = batch.row_mask
+        for op in self.pre_ops:
+            batch, mask = op.apply_masked(ctx, batch, mask)
+        ctx.batch = batch
+        key_cols = [g.eval_tpu(ctx) for g in self.grouping]
+        if not key_cols:
+            return jnp.sum(mask.astype(jnp.int32))
+        cap = batch.capacity
+        keys: List[jax.Array] = []
+        hi = jnp.int64(9223372036854775807)
+        for kc in key_cols:
+            nullk = jnp.where(kc.validity, 0, -1).astype(jnp.int64)
+            keys.append(jnp.where(mask, nullk, hi))
+            for w in _column_key_words(kc):
+                keys.append(jnp.where(mask, jnp.where(kc.validity, w, 0), hi))
+        sorted_keys = jax.lax.sort(tuple(keys), num_keys=len(keys))
+        mask_sorted = jnp.sort(~mask)  # row_mask sorted: valid first
+        seg, _ = group_segments(list(sorted_keys), ~mask_sorted)
+        seg = jnp.where(~mask_sorted, seg, cap - 1)
+        cnt = jax.ops.segment_sum((~mask_sorted).astype(jnp.int32), seg,
+                                  num_segments=cap)
+        return jnp.max(cnt)
 
     def _agg_fn(self, cols, num_rows, row_valid=None):
         batch = ColumnarBatch(list(cols), num_rows, self.input_schema)
@@ -510,6 +610,11 @@ class TpuHashAggregateExec(TpuExec):
                 cnt = SEG.seg_count(c.validity & mask_sorted, seg, nseg)
             out.append(DeviceColumn(T.LONG, group_valid, data=cnt))
             return out
+        if func in ("collect_list", "collect_set"):
+            c = self._input_col(a, ctx, perm)
+            return [self._eval_collect(a, fields[0], c,
+                                       c.validity & mask_sorted, seg,
+                                       mask_sorted, cap, group_valid, nseg)]
         c = self._input_col(a, ctx, perm)
         validity = c.validity & mask_sorted
         if func == "sum":
@@ -587,6 +692,64 @@ class TpuHashAggregateExec(TpuExec):
         var = m2 / jnp.where(ok, den, 1.0)
         res = var if a.func.startswith("var") else jnp.sqrt(var)
         return [DeviceColumn(f.dataType, group_valid & nz & ok, data=res)]
+
+    def _eval_collect(self, a, f, c: DeviceColumn, validity, seg,
+                      mask_sorted, cap, group_valid, nseg) -> DeviceColumn:
+        """collect_list / collect_set into a padded list column.
+
+        Reference analog: GpuCollectList/GpuCollectSet (SURVEY.md §2.4).
+        Nulls are skipped (Spark).  collect_list keeps input order (rows
+        are key-sorted STABLY, so within-group order is arrival order);
+        collect_set emits values ASCENDING (Spark's set order is
+        unspecified; the oracle sorts the same way so differential tests
+        are deterministic)."""
+        ew = self._collect_ewidth
+        if a.func == "collect_set":
+            # second sort by (segment, value words) + first-of-run mask
+            words = _column_key_words(c)
+            keyseq = [seg.astype(jnp.int64),
+                      (~validity).astype(jnp.int64)] + \
+                     [jnp.where(validity, w, 0) for w in words]
+            iota = jnp.arange(cap, dtype=jnp.int32)
+            perm2 = jax.lax.sort(tuple(keyseq) + (iota,),
+                                 num_keys=len(keyseq), is_stable=True)[-1]
+            seg = seg[perm2]
+            validity = validity[perm2]
+            c = _gather_col(c, perm2)
+            words2 = [w[perm2] for w in keyseq[2:]]
+            same = jnp.ones(cap, jnp.bool_)
+            for w in words2:
+                prev = jnp.concatenate([w[:1] - 1, w[:-1]])
+                same = same & (w == prev)
+            same_seg = jnp.concatenate(
+                [jnp.zeros(1, jnp.bool_), seg[1:] == seg[:-1]])
+            validity = validity & ~(same & same_seg)
+        # within-group rank among VALID rows; for the global (nseg==1)
+        # case seg may be unsorted (fused-filter mask), so scan globally
+        if nseg == 1:
+            starts = jnp.zeros(cap, jnp.bool_).at[0].set(True)
+        else:
+            starts = jnp.concatenate([jnp.ones(1, jnp.bool_),
+                                      seg[1:] != seg[:-1]])
+        rank = (SEG.seg_scan_sum(jnp.ones(cap, jnp.int64), validity,
+                                 starts)[1] - 1).astype(jnp.int32)
+        elem_dt = f.dataType.elementType
+        seg_out = seg if nseg != 1 else jnp.zeros(cap, jnp.int32)
+        flat_idx = jnp.where(validity & (rank < ew),
+                             seg_out.astype(jnp.int64) * ew + rank,
+                             cap * ew).astype(jnp.int64)
+        sdt = T.storage_dtype(elem_dt)
+        data = jnp.zeros(cap * ew, sdt).at[flat_idx].set(
+            c.data.astype(sdt), mode="drop")
+        ev = jnp.zeros(cap * ew, jnp.bool_).at[flat_idx].set(
+            True, mode="drop")
+        lengths = jnp.clip(SEG.seg_count(validity, seg, nseg), 0, ew)
+        out_rows = int(lengths.shape[0])
+        return DeviceColumn(
+            f.dataType, group_valid,
+            data=data.reshape(cap, ew)[:out_rows],
+            lengths=lengths.astype(jnp.int32),
+            elem_valid=ev.reshape(cap, ew)[:out_rows])
 
     def _minmax_string(self, c: DeviceColumn, func, seg, validity, cap,
                        group_valid, f, nseg):

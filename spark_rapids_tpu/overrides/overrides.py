@@ -443,7 +443,7 @@ def wrap_expr(e: E.Expression, conf: TpuConf) -> ExprMeta:
 
 _AGG_FUNCS_SUPPORTED = {"sum", "count", "count_star", "min", "max", "avg",
                         "first", "last", "var_pop", "var_samp", "stddev_pop",
-                        "stddev_samp"}
+                        "stddev_samp", "collect_list", "collect_set"}
 _WINDOW_FUNCS_SUPPORTED = {"row_number", "rank", "dense_rank", "sum", "count",
                            "min", "max", "avg", "lead", "lag", "ntile",
                            "percent_rank", "cume_dist"}
@@ -457,13 +457,32 @@ _JOIN_TYPES_SUPPORTED = {PN.JoinType.INNER, PN.JoinType.LEFT_OUTER,
 
 def _agg_check(meta: SparkPlanMeta):
     plan: PN.HashAggregate = meta.plan
+    # the array-capable sig exists for collect_* OUTPUT columns only; array
+    # grouping keys / array inputs to other aggregates have no TPU kernels
+    for g in plan.grouping:
+        if isinstance(g._dataType, T.ArrayType):
+            meta.will_not_work_on_tpu(
+                "grouping by an array column is not supported on TPU")
     for a in plan.aggregates:
+        if (a.func not in ("collect_list", "collect_set")
+                and a.child is not None
+                and isinstance(a.child._dataType, T.ArrayType)):
+            meta.will_not_work_on_tpu(
+                f"{a.func} over an array column is not supported on TPU")
         if a.func not in _AGG_FUNCS_SUPPORTED:
             meta.will_not_work_on_tpu(
                 f"aggregate function {a.func} is not supported on TPU")
         if a.distinct:
             meta.will_not_work_on_tpu(
                 "distinct aggregates are not supported on TPU yet")
+        if a.func in ("collect_list", "collect_set") \
+                and a.child is not None:
+            et = a.child._dataType
+            if isinstance(et, (T.StringType, T.ArrayType, T.MapType,
+                               T.StructType)) or _is_dec128(et):
+                meta.will_not_work_on_tpu(
+                    f"{a.func} of {et.simpleString} elements is not "
+                    f"supported on TPU (primitive elements only)")
         if (a.func in ("avg", "var_pop", "var_samp", "stddev_pop",
                        "stddev_samp")
                 and a.child is not None and _is_dec128(a.child._dataType)):
@@ -612,7 +631,7 @@ _exec(PN.InsertIntoHadoopFsRelation, extra=_write_check,
 _exec(PN.RangeNode)
 _exec(PN.Project, sig=_WITH_ARRAYS)
 _exec(PN.Filter, sig=_WITH_ARRAYS)
-_exec(PN.HashAggregate, extra=_agg_check)  # output never carries arrays
+_exec(PN.HashAggregate, sig=_WITH_ARRAYS, extra=_agg_check)
 _exec(PN.SortMergeJoin, extra=_join_check,
       desc="converted to shuffled sorted join (GpuSortMergeJoinMeta analog)")
 _exec(PN.ShuffledHashJoin, extra=_join_check)

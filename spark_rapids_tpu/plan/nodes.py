@@ -211,6 +211,8 @@ class AggregateExpression:
             return T.DOUBLE
         if self.func in VARIANCE_FUNCS:
             return T.DOUBLE
+        if self.func in ("collect_list", "collect_set"):
+            return T.ArrayType(ct)
         return ct  # min/max/first/last
 
     def describe(self):

@@ -563,6 +563,32 @@ class GroupedData:
                      for a in distinct]
             return dedup.group_by(*outer_keys).agg(*outer) if outer_keys \
                 else dedup.agg(*outer)
+        collect = [a for a in specs if isinstance(a, tuple)
+                   and a[0] in ("collect_list", "collect_set")]
+        if collect:
+            # single-phase plan: co-locate each key's rows with a hash
+            # exchange, then ONE COMPLETE-mode aggregate builds the arrays
+            # (partial/final would need array-buffer merges)
+            schema = self.df.schema
+            aexprs = []
+            for a in specs:
+                if isinstance(a, PN.AggregateExpression):
+                    aexprs.append(a.resolve(schema))
+                    continue
+                func, child, name = a
+                ce = _to_expr(child) if child is not None else None
+                aexprs.append(PN.AggregateExpression(
+                    func, ce, name).resolve(schema))
+            if self.keys:
+                ex = PN.Exchange(
+                    PN.HashPartitioning(self.keys,
+                                        self.df.session.shuffle_partitions),
+                    self.df.plan)
+            else:
+                ex = PN.Exchange(PN.SinglePartitioning(), self.df.plan)
+            comp = PN.HashAggregate(self.keys, aexprs,
+                                    PN.AggregateMode.COMPLETE, ex)
+            return DataFrame(comp, self.df.session)
         schema = self.df.schema
         aexprs: List[PN.AggregateExpression] = []
         for a in aggs:
@@ -612,6 +638,14 @@ def count_distinct_(c: ColumnLike, name: str = "count_distinct"):
 
 def sum_distinct_(c: ColumnLike, name: str = "sum_distinct"):
     return ("sum_distinct", c, name)
+
+
+def collect_list_(c: ColumnLike, name: str = "collect_list"):
+    return ("collect_list", c, name)
+
+
+def collect_set_(c: ColumnLike, name: str = "collect_set"):
+    return ("collect_set", c, name)
 
 
 def min_(c: ColumnLike, name: str = "min"):

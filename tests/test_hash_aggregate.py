@@ -221,3 +221,28 @@ def test_count_sum_distinct():
         return df.agg(sum_distinct_("v", "sd"))
 
     assert_tpu_and_cpu_are_equal_collect(build2)
+
+
+def test_collect_list_and_set():
+    from spark_rapids_tpu.session import collect_list_, collect_set_
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=8),
+                        IntegerGen(min_val=-20, max_val=20)], ["k", "v"],
+                    length=400)
+        return df.group_by("k").agg(collect_list_("v", "cl"),
+                                    collect_set_("v", "cs"),
+                                    ("count", col("v"), "c"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_collect_global_and_empty():
+    from spark_rapids_tpu.session import collect_list_
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=50)], ["v"],
+                    length=150)
+        return df.agg(collect_list_("v", "cl"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
