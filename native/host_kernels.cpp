@@ -12,8 +12,352 @@
 
 #include <cstdint>
 #include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// JSON path engine — C++ port of spark_rapids_tpu/jsonpath.py (the reference
+// keeps this in a CUDA kernel, get_json_object.cu; here it is a host kernel
+// invoked through jax.pure_callback).  The Python module is the semantic
+// spec; keep the two in lockstep.
+// ---------------------------------------------------------------------------
+
+struct JsonStep {
+    bool is_key;
+    std::string key;
+    int64_t index;
+};
+
+inline bool is_ws(uint8_t c) {
+    return c == ' ' || c == '\t' || c == '\n' || c == '\r';
+}
+
+inline bool is_delim(uint8_t c) {
+    return c == ',' || c == '}' || c == ']' || is_ws(c);
+}
+
+inline int64_t skip_ws(const uint8_t* b, int64_t i, int64_t L) {
+    while (i < L && is_ws(b[i])) ++i;
+    return i;
+}
+
+// b[i]=='"'; one past closing quote, or -1
+int64_t string_end(const uint8_t* b, int64_t i, int64_t L) {
+    ++i;
+    while (i < L) {
+        if (b[i] == '\\') { i += 2; continue; }
+        if (b[i] == '"') return i + 1;
+        ++i;
+    }
+    return -1;
+}
+
+bool unescape(const uint8_t* raw, int64_t len, std::string* out);
+bool valid_scalar(const uint8_t* raw, int64_t len);
+
+constexpr int64_t kMaxDepth = 256;
+
+// Validating skip (see jsonpath.py _skip_value): Jackson streaming fails on
+// malformed tokens it passes over, so bracket-matching alone would diverge.
+int64_t skip_value(const uint8_t* b, int64_t i, int64_t L,
+                   int64_t depth = 0) {
+    if (depth > kMaxDepth) return -1;
+    i = skip_ws(b, i, L);
+    if (i >= L) return -1;
+    uint8_t c = b[i];
+    std::string scratch;
+    if (c == '"') {
+        int64_t e = string_end(b, i, L);
+        if (e < 0 || !unescape(b + i + 1, e - i - 2, &scratch)) return -1;
+        return e;
+    }
+    if (c == '{') {
+        i = skip_ws(b, i + 1, L);
+        if (i < L && b[i] == '}') return i + 1;
+        while (true) {
+            i = skip_ws(b, i, L);
+            if (i >= L || b[i] != '"') return -1;
+            int64_t ke = string_end(b, i, L);
+            if (ke < 0 || !unescape(b + i + 1, ke - i - 2, &scratch))
+                return -1;
+            i = skip_ws(b, ke, L);
+            if (i >= L || b[i] != ':') return -1;
+            int64_t e = skip_value(b, i + 1, L, depth + 1);
+            if (e < 0) return -1;
+            i = skip_ws(b, e, L);
+            if (i >= L) return -1;
+            if (b[i] == ',') { ++i; continue; }
+            if (b[i] == '}') return i + 1;
+            return -1;
+        }
+    }
+    if (c == '[') {
+        i = skip_ws(b, i + 1, L);
+        if (i < L && b[i] == ']') return i + 1;
+        while (true) {
+            int64_t e = skip_value(b, i, L, depth + 1);
+            if (e < 0) return -1;
+            i = skip_ws(b, e, L);
+            if (i >= L) return -1;
+            if (b[i] == ',') { ++i; continue; }
+            if (b[i] == ']') return i + 1;
+            return -1;
+        }
+    }
+    int64_t j = i;
+    while (j < L && !is_delim(b[j])) ++j;
+    if (j == i || !valid_scalar(b + i, j - i)) return -1;
+    return j;
+}
+
+// JSON string-body unescape into out; false on bad escape
+bool unescape(const uint8_t* raw, int64_t len, std::string* out) {
+    out->clear();
+    out->reserve(len);
+    int64_t i = 0;
+    while (i < len) {
+        uint8_t c = raw[i];
+        if (c != '\\') { out->push_back(static_cast<char>(c)); ++i; continue; }
+        if (i + 1 >= len) return false;
+        uint8_t e = raw[i + 1];
+        i += 2;
+        switch (e) {
+            case '"': out->push_back('"'); continue;
+            case '\\': out->push_back('\\'); continue;
+            case '/': out->push_back('/'); continue;
+            case 'b': out->push_back('\b'); continue;
+            case 'f': out->push_back('\f'); continue;
+            case 'n': out->push_back('\n'); continue;
+            case 'r': out->push_back('\r'); continue;
+            case 't': out->push_back('\t'); continue;
+            case 'u': break;
+            default: return false;
+        }
+        if (i + 4 > len) return false;
+        auto hex4 = [&](int64_t p, int64_t* v) {
+            int64_t acc = 0;
+            for (int k = 0; k < 4; ++k) {
+                uint8_t h = raw[p + k];
+                int64_t d;
+                if (h >= '0' && h <= '9') d = h - '0';
+                else if (h >= 'a' && h <= 'f') d = h - 'a' + 10;
+                else if (h >= 'A' && h <= 'F') d = h - 'A' + 10;
+                else return false;
+                acc = (acc << 4) | d;
+            }
+            *v = acc;
+            return true;
+        };
+        int64_t cp;
+        if (!hex4(i, &cp)) return false;
+        i += 4;
+        if (cp >= 0xD800 && cp <= 0xDBFF) {
+            // high surrogate MUST pair (python spec: chr() would reject)
+            int64_t lo = -1;
+            if (i + 6 <= len && raw[i] == '\\' && raw[i + 1] == 'u') {
+                hex4(i + 2, &lo);
+            }
+            if (lo < 0xDC00 || lo > 0xDFFF) return false;
+            cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            i += 6;
+        } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+            return false;  // lone low surrogate
+        }
+        // utf-8 encode
+        if (cp < 0x80) {
+            out->push_back(static_cast<char>(cp));
+        } else if (cp < 0x800) {
+            out->push_back(static_cast<char>(0xC0 | (cp >> 6)));
+            out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+        } else if (cp < 0x10000) {
+            out->push_back(static_cast<char>(0xE0 | (cp >> 12)));
+            out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+            out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+        } else if (cp < 0x110000) {
+            out->push_back(static_cast<char>(0xF0 | (cp >> 18)));
+            out->push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+            out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+            out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+        } else {
+            return false;
+        }
+    }
+    return true;
+}
+
+// strip whitespace outside strings
+bool compact(const uint8_t* raw, int64_t len, std::string* out) {
+    out->clear();
+    out->reserve(len);
+    int64_t i = 0;
+    while (i < len) {
+        uint8_t c = raw[i];
+        if (c == '"') {
+            int64_t e = string_end(raw, i, len);
+            if (e < 0) return false;
+            out->append(reinterpret_cast<const char*>(raw + i),
+                        static_cast<size_t>(e - i));
+            i = e;
+            continue;
+        }
+        if (is_ws(c)) { ++i; continue; }
+        out->push_back(static_cast<char>(c));
+        ++i;
+    }
+    return true;
+}
+
+bool valid_scalar(const uint8_t* raw, int64_t len) {
+    auto eq = [&](const char* s) {
+        return static_cast<int64_t>(std::strlen(s)) == len &&
+               std::memcmp(raw, s, static_cast<size_t>(len)) == 0;
+    };
+    if (eq("true") || eq("false") || eq("null")) return true;
+    int64_t i = 0;
+    if (i < len && raw[i] == '-') ++i;
+    int64_t start = i;
+    while (i < len && raw[i] >= '0' && raw[i] <= '9') ++i;
+    if (i == start) return false;
+    if (i < len && raw[i] == '.') {
+        ++i;
+        start = i;
+        while (i < len && raw[i] >= '0' && raw[i] <= '9') ++i;
+        if (i == start) return false;
+    }
+    if (i < len && (raw[i] == 'e' || raw[i] == 'E')) {
+        ++i;
+        if (i < len && (raw[i] == '+' || raw[i] == '-')) ++i;
+        start = i;
+        while (i < len && raw[i] >= '0' && raw[i] <= '9') ++i;
+        if (i == start) return false;
+    }
+    return i == len;
+}
+
+// span of the value addressed by steps[si:]; false if no match
+bool navigate(const uint8_t* b, int64_t i, int64_t L,
+              const std::vector<JsonStep>& steps, size_t si,
+              int64_t* out_s, int64_t* out_e) {
+    i = skip_ws(b, i, L);
+    if (si == steps.size()) {
+        int64_t e = skip_value(b, i, L);
+        if (e < 0) return false;
+        *out_s = i;
+        *out_e = e;
+        return true;
+    }
+    if (i >= L) return false;
+    const JsonStep& step = steps[si];
+    if (step.is_key) {
+        if (b[i] != '{') return false;
+        ++i;
+        std::string key;
+        while (true) {
+            i = skip_ws(b, i, L);
+            if (i >= L || b[i] == '}') return false;
+            if (b[i] != '"') return false;
+            int64_t ke = string_end(b, i, L);
+            if (ke < 0) return false;
+            if (!unescape(b + i + 1, ke - i - 2, &key)) return false;
+            int64_t i2 = skip_ws(b, ke, L);
+            if (i2 >= L || b[i2] != ':') return false;
+            ++i2;
+            if (key == step.key) {
+                return navigate(b, i2, L, steps, si + 1, out_s, out_e);
+            }
+            int64_t e = skip_value(b, i2, L);
+            if (e < 0) return false;
+            i = skip_ws(b, e, L);
+            if (i >= L) return false;
+            if (b[i] == ',') ++i;
+            else if (b[i] != '}') return false;
+        }
+    }
+    if (b[i] != '[') return false;
+    ++i;
+    for (int64_t k = 0; k < step.index; ++k) {
+        i = skip_ws(b, i, L);
+        if (i >= L || b[i] == ']') return false;
+        int64_t e = skip_value(b, i, L);
+        if (e < 0) return false;
+        i = skip_ws(b, e, L);
+        if (i >= L || b[i] != ',') return false;
+        ++i;
+    }
+    i = skip_ws(b, i, L);
+    if (i >= L || b[i] == ']') return false;
+    return navigate(b, i, L, steps, si + 1, out_s, out_e);
+}
+
+// result string or not-found
+bool eval_json_path(const uint8_t* doc, int64_t L,
+                    const std::vector<JsonStep>& steps, std::string* out) {
+    int64_t s, e;
+    if (!navigate(doc, 0, L, steps, 0, &s, &e)) return false;
+    uint8_t c = doc[s];
+    if (c == '"') return unescape(doc + s + 1, e - s - 2, out);
+    if (c == '{' || c == '[') return compact(doc + s, e - s, out);
+    if (e - s == 4 && std::memcmp(doc + s, "null", 4) == 0) return false;
+    if (!valid_scalar(doc + s, e - s)) return false;
+    out->assign(reinterpret_cast<const char*>(doc + s),
+                static_cast<size_t>(e - s));
+    return true;
+}
+
+// steps blob: repeated ['k'|'i'][u32 LE payload][key bytes if 'k']
+std::vector<JsonStep> parse_steps(const uint8_t* blob, int64_t blob_len) {
+    std::vector<JsonStep> steps;
+    int64_t i = 0;
+    while (i + 5 <= blob_len) {
+        uint8_t tag = blob[i];
+        uint32_t v;
+        std::memcpy(&v, blob + i + 1, 4);
+        i += 5;
+        JsonStep s;
+        if (tag == 'k') {
+            s.is_key = true;
+            s.key.assign(reinterpret_cast<const char*>(blob + i), v);
+            i += v;
+        } else {
+            s.is_key = false;
+            s.index = v;
+        }
+        steps.push_back(std::move(s));
+    }
+    return steps;
+}
+
+}  // namespace
 
 extern "C" {
+
+// get_json_object over a padded (rows, width) char matrix; one path for
+// all rows.  out_chars must be zeroed (rows*width); results longer than
+// width are truncated (cannot happen: every transform shrinks).
+void get_json_object_padded(const uint8_t* chars, const int32_t* lengths,
+                            const uint8_t* validity, int64_t rows,
+                            int64_t width, const uint8_t* steps_blob,
+                            int64_t steps_len, uint8_t* out_chars,
+                            int32_t* out_lengths, uint8_t* out_valid) {
+    const std::vector<JsonStep> steps = parse_steps(steps_blob, steps_len);
+    std::string result;
+    for (int64_t i = 0; i < rows; ++i) {
+        out_valid[i] = 0;
+        out_lengths[i] = 0;
+        if (!validity[i]) continue;
+        const uint8_t* doc = chars + i * width;
+        int64_t L = lengths[i] < width ? lengths[i] : width;
+        if (!eval_json_path(doc, L, steps, &result)) continue;
+        int64_t n = static_cast<int64_t>(result.size());
+        if (n > width) n = width;
+        std::memcpy(out_chars + i * width, result.data(),
+                    static_cast<size_t>(n));
+        out_lengths[i] = static_cast<int32_t>(n);
+        out_valid[i] = 1;
+    }
+}
 
 // Arrow (chars, offsets) -> padded (rows, width) char matrix.
 // offsets are int64 arrow offsets relative to buf; lengths[i] must equal

@@ -94,23 +94,11 @@ class ColumnarBatch:
         # transfers)
         import jax
 
-        host = jax.device_get([
-            (c.validity, c.data, c.chars, c.lengths, c.elem_valid)
-            for c in self.columns])
+        # DeviceColumn is a pytree, so one device_get fetches every buffer
+        # of every column (incl. struct children) in a single transfer
+        host = jax.device_get(self.columns)
         n = self.num_rows
-        out = []
-        for c, (validity, data, chars, lengths, elem_valid) in zip(
-                self.columns, host):
-            if c.is_string:
-                out.append(HostColumn(c.dtype, validity[:n],
-                                      chars=chars[:n], lengths=lengths[:n]))
-            elif c.is_array:
-                out.append(HostColumn(c.dtype, validity[:n], data=data[:n],
-                                      lengths=lengths[:n],
-                                      elem_valid=elem_valid[:n]))
-            else:
-                out.append(HostColumn(c.dtype, validity[:n], data=data[:n]))
-        return out
+        return [c.to_host(n) for c in host]
 
     def to_pydict(self) -> dict:
         return {f.name: c.to_host(self.num_rows).to_pylist()
@@ -148,10 +136,23 @@ class ColumnarBatch:
         cap = round_up_bucket(max(total, 1), row_buckets)
         schema = batches[0].schema
         ncols = batches[0].num_cols
-        out_cols: List[DeviceColumn] = []
-        for ci in range(ncols):
-            cols = [b.columns[ci] for b in batches]
+        rows = [b.num_rows for b in batches]
+
+        def _concat_col(cols: List[DeviceColumn]) -> DeviceColumn:
             dtype = cols[0].dtype
+            if cols[0].is_struct:
+                validity = jnp.zeros(cap, jnp.bool_)
+                off = 0
+                for n, c in zip(rows, cols):
+                    if n == 0:
+                        continue
+                    validity = jax.lax.dynamic_update_slice(
+                        validity, c.validity[:n], (off,))
+                    off += n
+                kids = tuple(
+                    _concat_col([c.children[k] for c in cols])
+                    for k in range(len(cols[0].children)))
+                return DeviceColumn(dtype, validity, children=kids)
             if cols[0].is_string:
                 width = max(c.width for c in cols)
                 chars = jnp.zeros((cap, width), jnp.uint8)
@@ -170,9 +171,9 @@ class ColumnarBatch:
                     lengths = jax.lax.dynamic_update_slice(lengths, c.lengths[:n], (off,))
                     validity = jax.lax.dynamic_update_slice(validity, c.validity[:n], (off,))
                     off += n
-                out_cols.append(DeviceColumn(dtype, validity, chars=chars,
-                                             lengths=lengths))
-            elif cols[0].is_array:
+                return DeviceColumn(dtype, validity, chars=chars,
+                                    lengths=lengths)
+            if cols[0].is_array:
                 ew = max(c.ewidth for c in cols)
                 data = jnp.zeros((cap, ew), cols[0].data.dtype)
                 ev = jnp.zeros((cap, ew), jnp.bool_)
@@ -195,41 +196,49 @@ class ColumnarBatch:
                     validity = jax.lax.dynamic_update_slice(
                         validity, c.validity[:n], (off,))
                     off += n
-                out_cols.append(DeviceColumn(dtype, validity, data=data,
-                                             lengths=lengths, elem_valid=ev))
-            else:
-                trail = cols[0].data.shape[1:]
-                data = jnp.zeros((cap,) + trail, cols[0].data.dtype)
-                validity = jnp.zeros(cap, jnp.bool_)
-                off = 0
-                for b, c in zip(batches, cols):
-                    n = b.num_rows
-                    if n == 0:
-                        continue
-                    data = jax.lax.dynamic_update_slice(
-                        data, c.data[:n], (off,) + (0,) * len(trail))
-                    validity = jax.lax.dynamic_update_slice(validity, c.validity[:n], (off,))
-                    off += n
-                out_cols.append(DeviceColumn(dtype, validity, data=data))
+                return DeviceColumn(dtype, validity, data=data,
+                                    lengths=lengths, elem_valid=ev)
+            trail = cols[0].data.shape[1:]
+            data = jnp.zeros((cap,) + trail, cols[0].data.dtype)
+            validity = jnp.zeros(cap, jnp.bool_)
+            off = 0
+            for b, c in zip(batches, cols):
+                n = b.num_rows
+                if n == 0:
+                    continue
+                data = jax.lax.dynamic_update_slice(
+                    data, c.data[:n], (off,) + (0,) * len(trail))
+                validity = jax.lax.dynamic_update_slice(validity, c.validity[:n], (off,))
+                off += n
+            return DeviceColumn(dtype, validity, data=data)
+
+        out_cols = [_concat_col([b.columns[ci] for b in batches])
+                    for ci in range(ncols)]
         return ColumnarBatch(out_cols, total, schema)
 
     def slice_rows(self, start: int, length: int,
                    row_buckets=DEFAULT_ROW_BUCKETS) -> "ColumnarBatch":
         """Host-driven row slice (used by split-and-retry)."""
         cap = round_up_bucket(max(length, 1), row_buckets)
-        cols = []
-        for c in self.columns:
+
+        def _slice_col(c: DeviceColumn) -> DeviceColumn:
+            sl = slice(start, start + length)
             if c.is_string:
-                cols.append(DeviceColumn(
-                    c.dtype,
-                    jax.lax.dynamic_slice(c.validity, (start,), (length,))
-                    if length <= c.capacity - start else c.validity[start:start + length],
-                    chars=c.chars[start:start + length],
-                    lengths=c.lengths[start:start + length]).slice_to(cap))
-            else:
-                cols.append(DeviceColumn(
-                    c.dtype, c.validity[start:start + length],
-                    data=c.data[start:start + length]).slice_to(cap))
+                return DeviceColumn(c.dtype, c.validity[sl], chars=c.chars[sl],
+                                    lengths=c.lengths[sl]).slice_to(cap)
+            if c.is_array:
+                return DeviceColumn(c.dtype, c.validity[sl], data=c.data[sl],
+                                    lengths=c.lengths[sl],
+                                    elem_valid=c.elem_valid[sl]).slice_to(cap)
+            if c.is_struct:
+                return DeviceColumn(
+                    c.dtype, c.validity[sl],
+                    children=tuple(_slice_col(k) for k in c.children)
+                ).slice_to(cap)
+            return DeviceColumn(c.dtype, c.validity[sl],
+                                data=c.data[sl]).slice_to(cap)
+
+        cols = [_slice_col(c) for c in self.columns]
         return ColumnarBatch(cols, length, self.schema)
 
     def __repr__(self):

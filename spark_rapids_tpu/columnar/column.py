@@ -69,6 +69,9 @@ class DeviceColumn:
                    elem_valid (capacity, ewidth) bool; lengths (capacity,)
                    int32 — a padded list-column (primitive elements), the
                    TPU answer to cuDF LIST columns (offsets + child).
+    kind "struct": children = tuple of full child DeviceColumns (one per
+                   struct field) — cuDF STRUCT columns are likewise a
+                   validity mask over recursively stored children.
     validity: (capacity,) bool; True = valid (non-null).
     """
 
@@ -78,18 +81,19 @@ class DeviceColumn:
     chars: Optional[jax.Array] = None
     lengths: Optional[jax.Array] = None
     elem_valid: Optional[jax.Array] = None
+    children: Optional[tuple] = None  # tuple of DeviceColumn (structs)
 
     # -- pytree protocol ----------------------------------------------------
     def tree_flatten(self):
         children = (self.validity, self.data, self.chars, self.lengths,
-                    self.elem_valid)
+                    self.elem_valid, self.children)
         return children, self.dtype
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        validity, data, chars, lengths, elem_valid = children
+        validity, data, chars, lengths, elem_valid, kids = children
         return cls(dtype=aux, validity=validity, data=data, chars=chars,
-                   lengths=lengths, elem_valid=elem_valid)
+                   lengths=lengths, elem_valid=elem_valid, children=kids)
 
     # -- properties ---------------------------------------------------------
     @property
@@ -99,6 +103,10 @@ class DeviceColumn:
     @property
     def is_array(self) -> bool:
         return self.elem_valid is not None
+
+    @property
+    def is_struct(self) -> bool:
+        return self.children is not None
 
     @property
     def is_dec128(self) -> bool:
@@ -126,6 +134,8 @@ class DeviceColumn:
             n += self.chars.size + self.lengths.size * 4
         if self.elem_valid is not None:
             n += self.elem_valid.size + self.lengths.size * 4
+        if self.children is not None:
+            n += sum(c.nbytes() for c in self.children)
         return int(n)
 
     def gather(self, idx) -> "DeviceColumn":
@@ -139,6 +149,10 @@ class DeviceColumn:
                                 data=self.data[idx],
                                 lengths=self.lengths[idx],
                                 elem_valid=self.elem_valid[idx])
+        if self.is_struct:
+            return DeviceColumn(self.dtype, self.validity[idx],
+                                children=tuple(c.gather(idx)
+                                               for c in self.children))
         return DeviceColumn(self.dtype, self.validity[idx],
                             data=self.data[idx])
 
@@ -175,6 +189,13 @@ class DeviceColumn:
                                 data=jnp.asarray(data),
                                 lengths=jnp.asarray(lengths),
                                 elem_valid=jnp.asarray(ev))
+        if h.is_struct:
+            kids = tuple(DeviceColumn.from_host(c, capacity=cap,
+                                                width_buckets=width_buckets,
+                                                row_buckets=row_buckets)
+                         for c in h.children)
+            return DeviceColumn(dtype=h.dtype, validity=jnp.asarray(validity),
+                                children=kids)
         data = np.zeros((cap,) + h.data.shape[1:], dtype=h.data.dtype)
         data[:n] = h.data[:n]
         return DeviceColumn(dtype=h.dtype, validity=jnp.asarray(validity),
@@ -191,6 +212,10 @@ class DeviceColumn:
                               data=np.asarray(self.data)[:num_rows],
                               lengths=np.asarray(self.lengths)[:num_rows],
                               elem_valid=np.asarray(self.elem_valid)[:num_rows])
+        if self.is_struct:
+            return HostColumn(dtype=self.dtype, validity=validity,
+                              children=[c.to_host(num_rows)
+                                        for c in self.children])
         return HostColumn(dtype=self.dtype, validity=validity,
                           data=np.asarray(self.data)[:num_rows])
 
@@ -208,6 +233,10 @@ class DeviceColumn:
                                     data=self.data[:capacity],
                                     lengths=self.lengths[:capacity],
                                     elem_valid=self.elem_valid[:capacity])
+            if self.is_struct:
+                return DeviceColumn(self.dtype, self.validity[:capacity],
+                                    children=tuple(c.slice_to(capacity)
+                                                   for c in self.children))
             return DeviceColumn(self.dtype, self.validity[:capacity],
                                 data=self.data[:capacity])
         pad = capacity - self.capacity
@@ -230,6 +259,10 @@ class DeviceColumn:
                 elem_valid=jnp.concatenate(
                     [self.elem_valid,
                      jnp.zeros((pad, self.ewidth), jnp.bool_)]))
+        if self.is_struct:
+            return DeviceColumn(
+                self.dtype, validity,
+                children=tuple(c.slice_to(capacity) for c in self.children))
         return DeviceColumn(
             self.dtype, validity,
             data=jnp.concatenate(
@@ -250,6 +283,7 @@ class HostColumn:
     chars: Optional[np.ndarray] = None     # (n, width) uint8
     lengths: Optional[np.ndarray] = None   # (n,) int32
     elem_valid: Optional[np.ndarray] = None  # (n, ewidth) bool (arrays)
+    children: Optional[List["HostColumn"]] = None  # structs
 
     @property
     def is_string(self) -> bool:
@@ -260,14 +294,64 @@ class HostColumn:
         return self.elem_valid is not None
 
     @property
+    def is_struct(self) -> bool:
+        return self.children is not None
+
+    @property
     def num_rows(self) -> int:
         return int(self.validity.shape[0])
+
+    def slice_rows(self, start: int, end: int) -> "HostColumn":
+        """Row range view (all column kinds)."""
+        if self.is_string:
+            return HostColumn(self.dtype, self.validity[start:end],
+                              chars=self.chars[start:end],
+                              lengths=self.lengths[start:end])
+        if self.is_array:
+            return HostColumn(self.dtype, self.validity[start:end],
+                              data=self.data[start:end],
+                              lengths=self.lengths[start:end],
+                              elem_valid=self.elem_valid[start:end])
+        if self.is_struct:
+            return HostColumn(self.dtype, self.validity[start:end],
+                              children=[c.slice_rows(start, end)
+                                        for c in self.children])
+        return HostColumn(self.dtype, self.validity[start:end],
+                          data=self.data[start:end])
 
     # -- python interchange -------------------------------------------------
     @staticmethod
     def from_pylist(values: List, dtype: T.DataType) -> "HostColumn":
         n = len(values)
         validity = np.array([v is not None for v in values], dtype=np.bool_)
+        if isinstance(dtype, T.MapType):
+            # map rows are python dicts; device layout = (keys array col,
+            # values array col) children sharing lengths
+            keys = [list(v.keys()) if v is not None else None
+                    for v in values]
+            vals = [list(v.values()) if v is not None else None
+                    for v in values]
+            kcol = HostColumn.from_pylist(
+                keys, T.ArrayType(dtype.keyType, containsNull=False))
+            vcol = HostColumn.from_pylist(
+                vals, T.ArrayType(dtype.valueType))
+            return HostColumn(dtype, validity, children=[kcol, vcol])
+        if isinstance(dtype, T.StructType):
+            # rows are dicts (by field name) or sequences (by position);
+            # null rows become all-null children (Spark reads null.field
+            # as null)
+            kids = []
+            for fi, f in enumerate(dtype.fields):
+                fv = []
+                for v in values:
+                    if v is None:
+                        fv.append(None)
+                    elif isinstance(v, dict):
+                        fv.append(v.get(f.name))
+                    else:
+                        fv.append(v[fi])
+                kids.append(HostColumn.from_pylist(fv, f.dataType))
+            return HostColumn(dtype, validity, children=kids)
         if isinstance(dtype, T.ArrayType):
             elem_host = HostColumn.from_pylist(
                 [e for v in values if v is not None for e in v],
@@ -345,6 +429,15 @@ class HostColumn:
         return HostColumn(dtype, validity, data=data)
 
     def to_pylist(self) -> List:
+        if isinstance(self.dtype, T.MapType):
+            keys = self.children[0].to_pylist()
+            vals = self.children[1].to_pylist()
+            return [dict(zip(keys[i], vals[i])) if self.validity[i]
+                    else None for i in range(self.num_rows)]
+        if self.is_struct:
+            kid_vals = [c.to_pylist() for c in self.children]
+            return [tuple(kv[i] for kv in kid_vals) if self.validity[i]
+                    else None for i in range(self.num_rows)]
         if self.is_array:
             elem_t = self.dtype.elementType
             out = []
@@ -411,6 +504,14 @@ class HostColumn:
             arr = arr.combine_chunks()
         n = len(arr)
         validity = np.asarray(arr.is_valid())
+        if isinstance(dtype, T.StructType):
+            kids = [HostColumn.from_arrow(arr.field(f.name), f.dataType)
+                    for f in dtype.fields]
+            return HostColumn(dtype, validity, children=kids)
+        if isinstance(dtype, (T.ArrayType, T.MapType)):
+            # list/map columns come through the python interchange (scan
+            # formats with nested data: parquet lists, avro arrays)
+            return HostColumn.from_pylist(arr.to_pylist(), dtype)
         if isinstance(dtype, T.StringType):
             arr = arr.cast(pa.large_binary()) if not pa.types.is_large_binary(arr.type) else arr
             buf = np.frombuffer(arr.buffers()[2] or b"", dtype=np.uint8)
@@ -442,13 +543,23 @@ class HostColumn:
             if isinstance(dtype, T.TimestampType) and pa.types.is_timestamp(
                     arr.type) and arr.type.unit != "us":
                 arr = arr.cast(pa.timestamp("us", tz=arr.type.tz))
-            np_arr = np.asarray(arr.fill_null(0)).astype(sdt, copy=False)
+            fill = False if pa.types.is_boolean(arr.type) else 0
+            np_arr = np.asarray(arr.fill_null(fill)).astype(sdt, copy=False)
         return HostColumn(dtype, validity, data=np_arr)
 
     def to_arrow(self):
         import pyarrow as pa
 
         mask = ~self.validity
+        if self.is_array or isinstance(self.dtype, T.MapType):
+            return pa.array(self.to_pylist())
+        if self.is_struct:
+            kid_arrays = [c.to_arrow() for c in self.children]
+            fields = [pa.field(f.name, a.type) for f, a in
+                      zip(self.dtype.fields, kid_arrays)]
+            return pa.StructArray.from_arrays(
+                kid_arrays, fields=fields,
+                mask=pa.array(mask) if mask.any() else None)
         if self.is_string:
             return pa.array(self.to_pylist(), type=pa.string())
         if isinstance(self.dtype, T.DecimalType):

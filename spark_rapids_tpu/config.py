@@ -250,6 +250,10 @@ CSV_READ_ENABLED = conf("spark.rapids.sql.format.csv.read.enabled").boolean_conf
 JSON_ENABLED = conf("spark.rapids.sql.format.json.enabled").boolean_conf(True)
 JSON_READ_ENABLED = conf("spark.rapids.sql.format.json.read.enabled").boolean_conf(True)
 ORC_ENABLED = conf("spark.rapids.sql.format.orc.enabled").boolean_conf(True)
+AVRO_ENABLED = conf("spark.rapids.sql.format.avro.enabled").boolean_conf(True)
+AVRO_READ_ENABLED = conf("spark.rapids.sql.format.avro.read.enabled").doc(
+    "Enable TPU Avro scans (pure-python container decode, io/avro.py)."
+).boolean_conf(True)
 
 # --- shuffle ---------------------------------------------------------------
 

@@ -51,7 +51,39 @@ class CpuCol:
         return len(self.validity)
 
     @staticmethod
+    def from_objs(objs, dt: T.DataType) -> "CpuCol":
+        """Build from python objects in STORAGE representation (None = null)."""
+        n = len(objs)
+        validity = np.array([o is not None for o in objs], np.bool_)
+        if isinstance(dt, (T.StringType, T.DecimalType, T.ArrayType,
+                           T.StructType, T.MapType)):
+            vals = np.empty(n, object)
+            for i, o in enumerate(objs):
+                vals[i] = o
+            return CpuCol(dt, vals, validity)
+        data = np.zeros(n, T.storage_dtype(dt))
+        for i, o in enumerate(objs):
+            if o is not None:
+                data[i] = o
+        return CpuCol(dt, data, validity)
+
+    @staticmethod
     def from_host(h: HostColumn) -> "CpuCol":
+        if isinstance(h.dtype, T.MapType):
+            kcol = CpuCol.from_host(h.children[0])
+            vcol = CpuCol.from_host(h.children[1])
+            vals = np.empty(h.num_rows, object)
+            for i in range(h.num_rows):
+                vals[i] = (dict(zip(kcol.row(i), vcol.row(i)))
+                           if h.validity[i] else None)
+            return CpuCol(h.dtype, vals, h.validity.copy())
+        if h.is_struct:
+            kids = [CpuCol.from_host(c) for c in h.children]
+            vals = np.empty(h.num_rows, object)
+            for i in range(h.num_rows):
+                vals[i] = (tuple(k.row(i) for k in kids)
+                           if h.validity[i] else None)
+            return CpuCol(h.dtype, vals, h.validity.copy())
         if h.is_array:
             elem_t = h.dtype.elementType
             vals = []
@@ -90,6 +122,26 @@ class CpuCol:
 
     def to_host(self) -> HostColumn:
         n = self.n
+        if isinstance(self.dtype, T.MapType):
+            keys = [list(self.values[i].keys())
+                    if self.validity[i] and self.values[i] is not None
+                    else None for i in range(n)]
+            vals = [list(self.values[i].values())
+                    if self.validity[i] and self.values[i] is not None
+                    else None for i in range(n)]
+            kcol = CpuCol.from_objs(
+                keys, T.ArrayType(self.dtype.keyType, containsNull=False))
+            vcol = CpuCol.from_objs(vals, T.ArrayType(self.dtype.valueType))
+            return HostColumn(self.dtype, self.validity.copy(),
+                              children=[kcol.to_host(), vcol.to_host()])
+        if isinstance(self.dtype, T.StructType):
+            kids = []
+            for k, f in enumerate(self.dtype.fields):
+                fv = [self.values[i][k]
+                      if self.validity[i] and self.values[i] is not None
+                      else None for i in range(n)]
+                kids.append(CpuCol.from_objs(fv, f.dataType).to_host())
+            return HostColumn(self.dtype, self.validity.copy(), children=kids)
         if isinstance(self.dtype, T.ArrayType):
             elem_t = self.dtype.elementType
             width = max((len(v) for v in self.values if v is not None),
@@ -153,6 +205,18 @@ class CpuCol:
                     vals[j] = e
                 out.append(CpuCol(self.dtype.elementType, vals,
                                   ev).to_pylist())
+            elif isinstance(self.dtype, T.StructType):
+                v = self.values[i]
+                out.append(tuple(
+                    CpuCol.from_objs([v[k]], f.dataType).to_pylist()[0]
+                    for k, f in enumerate(self.dtype.fields)))
+            elif isinstance(self.dtype, T.MapType):
+                d = self.values[i]
+                ks = CpuCol.from_objs(list(d.keys()),
+                                      self.dtype.keyType).to_pylist()
+                vs = CpuCol.from_objs(list(d.values()),
+                                      self.dtype.valueType).to_pylist()
+                out.append(dict(zip(ks, vs)))
             elif isinstance(self.dtype, T.DecimalType):
                 out.append(_Dec(int(self.values[i])).scaleb(-self.dtype.scale))
             elif isinstance(self.dtype, T.DateType):
@@ -1405,6 +1469,8 @@ def _h_get_array_item(e, cols, n, ansi):
 
 
 def _h_element_at(e, cols, n, ansi):
+    if isinstance(e.children[0]._dataType, T.MapType):
+        return _h_get_map_value(e, cols, n, ansi)
     return _arr_index(e, cols, n, ansi, one_based=True)
 
 
@@ -1901,6 +1967,584 @@ def _h_hashexpr(e, cols, n, ansi):
     return CpuCol(e.dataType, out, np.ones(n, np.bool_))
 
 
+# -- collection breadth ------------------------------------------------------
+
+def _nan_eq(a, b):
+    """SQL set-op equality incl. NaN == NaN."""
+    import math
+
+    if isinstance(a, float) and isinstance(b, float):
+        if math.isnan(a) and math.isnan(b):
+            return True
+    return a == b
+
+
+def _null_aware_eq(a, b):
+    if a is None or b is None:
+        return a is None and b is None
+    return _nan_eq(a, b)
+
+
+def _h_array_position(e, cols, n, ansi):
+    a, v = _kids(e, cols, n, ansi)
+    validity = a.validity & v.validity
+    out = np.zeros(n, np.int64)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        for j, x in enumerate(a.values[i]):
+            if x is not None and _nan_eq(x, v.values[i]):
+                out[i] = j + 1
+                break
+    return CpuCol(T.LONG, out, validity)
+
+
+def _h_array_remove(e, cols, n, ansi):
+    a, v = _kids(e, cols, n, ansi)
+    validity = a.validity & v.validity
+    vals = np.empty(n, object)
+    for i in range(n):
+        if validity[i]:
+            vals[i] = [x for x in a.values[i]
+                       if x is None or not _nan_eq(x, v.values[i])]
+    return CpuCol(e.dataType, vals, validity)
+
+
+def _distinct_list(xs):
+    out = []
+    for x in xs:
+        if not any(_null_aware_eq(x, y) for y in out):
+            out.append(x)
+    return out
+
+
+def _h_array_distinct(e, cols, n, ansi):
+    (a,) = _kids(e, cols, n, ansi)
+    vals = np.empty(n, object)
+    for i in range(n):
+        if a.validity[i]:
+            vals[i] = _distinct_list(a.values[i])
+    return CpuCol(e.dataType, vals, a.validity.copy())
+
+
+def _h_arrays_overlap(e, cols, n, ansi):
+    a, b = _kids(e, cols, n, ansi)
+    validity = a.validity & b.validity
+    out = np.zeros(n, np.bool_)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        xs, ys = a.values[i], b.values[i]
+        hit = any(x is not None and any(
+            y is not None and _nan_eq(x, y) for y in ys) for x in xs)
+        out[i] = hit
+        if (not hit and xs and ys
+                and (any(x is None for x in xs)
+                     or any(y is None for y in ys))):
+            validity[i] = False
+    return CpuCol(T.BOOLEAN, out, validity)
+
+
+def _h_array_union(e, cols, n, ansi):
+    a, b = _kids(e, cols, n, ansi)
+    validity = a.validity & b.validity
+    vals = np.empty(n, object)
+    for i in range(n):
+        if validity[i]:
+            vals[i] = _distinct_list(list(a.values[i]) + list(b.values[i]))
+    return CpuCol(e.dataType, vals, validity)
+
+
+def _h_array_intersect(e, cols, n, ansi):
+    a, b = _kids(e, cols, n, ansi)
+    validity = a.validity & b.validity
+    vals = np.empty(n, object)
+    for i in range(n):
+        if validity[i]:
+            vals[i] = [x for x in _distinct_list(a.values[i])
+                       if any(_null_aware_eq(x, y) for y in b.values[i])]
+    return CpuCol(e.dataType, vals, validity)
+
+
+def _h_array_except(e, cols, n, ansi):
+    a, b = _kids(e, cols, n, ansi)
+    validity = a.validity & b.validity
+    vals = np.empty(n, object)
+    for i in range(n):
+        if validity[i]:
+            vals[i] = [x for x in _distinct_list(a.values[i])
+                       if not any(_null_aware_eq(x, y)
+                                  for y in b.values[i])]
+    return CpuCol(e.dataType, vals, validity)
+
+
+def _h_slice(e, cols, n, ansi):
+    a, st, ln = _kids(e, cols, n, ansi)
+    validity = a.validity & st.validity & ln.validity
+    vals = np.empty(n, object)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        s, k = int(st.values[i]), int(ln.values[i])
+        if s == 0:
+            raise RuntimeError(
+                "Unexpected value for start in function slice: SQL array "
+                "indices start at 1.")
+        if k < 0:
+            raise RuntimeError(
+                "Unexpected value for length in function slice: length "
+                "must be greater than or equal to 0.")
+        xs = a.values[i]
+        start0 = s - 1 if s > 0 else len(xs) + s
+        vals[i] = [] if start0 < 0 else xs[start0:start0 + k]
+    return CpuCol(e.dataType, vals, validity)
+
+
+def _h_sort_array(e, cols, n, ansi):
+    import math
+
+    a, _ = _kids(e, cols, n, ansi)
+    asc = True
+    if isinstance(e.children[1], E.Literal):
+        asc = bool(e.children[1].value)
+    vals = np.empty(n, object)
+
+    def key(x):
+        if isinstance(x, float) and math.isnan(x):
+            return (1, 0.0)  # NaN greatest (Spark)
+        return (0, x)
+
+    for i in range(n):
+        if a.validity[i]:
+            xs = a.values[i]
+            nulls = [x for x in xs if x is None]
+            rest = sorted((x for x in xs if x is not None), key=key,
+                          reverse=not asc)
+            vals[i] = (nulls + rest) if asc else (rest + nulls)
+    return CpuCol(e.dataType, vals, a.validity.copy())
+
+
+def _h_array_repeat(e, cols, n, ansi):
+    v, k = _kids(e, cols, n, ansi)
+    vals = np.empty(n, object)
+    validity = k.validity.copy()
+    for i in range(n):
+        if validity[i]:
+            count = max(int(k.values[i]), 0)
+            vals[i] = [v.row(i)] * count
+    return CpuCol(e.dataType, vals, validity)
+
+
+def _h_sequence(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    validity = _null_prop_validity(kids)
+    vals = np.empty(n, object)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        start, stop = int(kids[0].values[i]), int(kids[1].values[i])
+        if len(kids) > 2:
+            step = int(kids[2].values[i])
+        else:
+            step = 1 if stop >= start else -1
+        if step == 0 or (stop > start and step < 0) or \
+                (stop < start and step > 0):
+            raise RuntimeError("Illegal sequence boundaries")
+        count = (stop - start) // step + 1
+        vals[i] = [start + j * step for j in range(count)]
+    return CpuCol(e.dataType, vals, validity)
+
+
+def _h_create_map(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    vals = np.empty(n, object)
+    for i in range(n):
+        d = {}
+        for k in range(0, len(kids), 2):
+            key = kids[k].row(i)
+            if key is None:
+                raise RuntimeError("Cannot use null as map key")
+            if any(_nan_eq(key, existing) for existing in d):
+                raise RuntimeError("Duplicate map key was found")
+            d[key] = kids[k + 1].row(i)
+        vals[i] = d
+    return CpuCol(e.dataType, vals, np.ones(n, np.bool_))
+
+
+def _h_map_keys(e, cols, n, ansi):
+    (m,) = _kids(e, cols, n, ansi)
+    vals = np.empty(n, object)
+    for i in range(n):
+        if m.validity[i]:
+            vals[i] = list(m.values[i].keys())
+    return CpuCol(e.dataType, vals, m.validity.copy())
+
+
+def _h_map_values(e, cols, n, ansi):
+    (m,) = _kids(e, cols, n, ansi)
+    vals = np.empty(n, object)
+    for i in range(n):
+        if m.validity[i]:
+            vals[i] = list(m.values[i].values())
+    return CpuCol(e.dataType, vals, m.validity.copy())
+
+
+def _h_get_map_value(e, cols, n, ansi):
+    m, k = _kids(e, cols, n, ansi)
+    validity = m.validity & k.validity
+    objs = []
+    for i in range(n):
+        if not validity[i]:
+            objs.append(None)
+            continue
+        hit = None
+        for key, val in m.values[i].items():
+            if _nan_eq(key, k.values[i]):
+                hit = val
+                break
+        objs.append(hit)
+    return CpuCol.from_objs(objs, e.dataType)
+
+
+# -- higher-order functions ---------------------------------------------------
+
+def _hof_flatten(e, cols, n, ansi):
+    """Evaluate the lambda body over a flattened (row, element) batch."""
+    a = eval_expr(e.children[0], cols, n, ansi)
+    idx, elems = [], []
+    for i in range(n):
+        if a.validity[i] and a.values[i] is not None:
+            for x in a.values[i]:
+                idx.append(i)
+                elems.append(x)
+    m = len(idx)
+    et = e.children[0]._dataType.elementType
+    outer = [CpuCol(c.dtype, c.values[idx], c.validity[idx]) for c in cols]
+    elem_col = CpuCol.from_objs(elems, et)
+    # null elements stay null values (validity False) but rows exist
+    res = eval_expr(e.body, outer + [elem_col], m, ansi)
+    per_row = [[] for _ in range(n)]
+    for k, i in enumerate(idx):
+        per_row[i].append(res.row(k))
+    return a, per_row
+
+
+def _h_array_transform(e, cols, n, ansi):
+    a, per_row = _hof_flatten(e, cols, n, ansi)
+    vals = np.empty(n, object)
+    for i in range(n):
+        if a.validity[i]:
+            vals[i] = per_row[i]
+    return CpuCol(e.dataType, vals, a.validity.copy())
+
+
+def _h_array_filter(e, cols, n, ansi):
+    a, per_row = _hof_flatten(e, cols, n, ansi)
+    vals = np.empty(n, object)
+    for i in range(n):
+        if a.validity[i]:
+            vals[i] = [x for x, keep in zip(a.values[i], per_row[i])
+                       if keep is not None and bool(keep)]
+    return CpuCol(e.dataType, vals, a.validity.copy())
+
+
+def _h_array_exists(e, cols, n, ansi):
+    a, per_row = _hof_flatten(e, cols, n, ansi)
+    out = np.zeros(n, np.bool_)
+    validity = a.validity.copy()
+    for i in range(n):
+        if not a.validity[i]:
+            continue
+        preds = per_row[i]
+        any_true = any(bool(p) for p in preds if p is not None)
+        any_null = any(p is None for p in preds)
+        out[i] = any_true
+        if not any_true and any_null:
+            validity[i] = False
+    return CpuCol(T.BOOLEAN, out, validity)
+
+
+def _h_array_forall(e, cols, n, ansi):
+    a, per_row = _hof_flatten(e, cols, n, ansi)
+    out = np.zeros(n, np.bool_)
+    validity = a.validity.copy()
+    for i in range(n):
+        if not a.validity[i]:
+            continue
+        preds = per_row[i]
+        any_false = any(not bool(p) for p in preds if p is not None)
+        any_null = any(p is None for p in preds)
+        out[i] = not any_false
+        if not any_false and any_null:
+            validity[i] = False
+    return CpuCol(T.BOOLEAN, out, validity)
+
+
+def _h_array_aggregate(e, cols, n, ansi):
+    a = eval_expr(e.children[0], cols, n, ansi)
+    acc = eval_expr(e.children[1], cols, n, ansi)
+    maxw = max((len(v) for v in a.values
+                if v is not None), default=0)
+    for j in range(maxw):
+        elems = [a.values[i][j]
+                 if (a.validity[i] and a.values[i] is not None
+                     and j < len(a.values[i])) else None
+                 for i in range(n)]
+        elem_col = CpuCol.from_objs(elems, e.children[0]._dataType.elementType)
+        merged = eval_expr(e.merge, cols + [acc, elem_col], n, ansi)
+        take = np.array([a.validity[i] and a.values[i] is not None
+                         and j < len(a.values[i]) for i in range(n)])
+        new_vals = acc.values.copy()
+        new_valid = acc.validity.copy()
+        for i in range(n):
+            if take[i]:
+                new_vals[i] = merged.values[i]
+                new_valid[i] = merged.validity[i]
+        acc = CpuCol(merged.dtype, new_vals, new_valid)
+    if e.finish is not None:
+        acc = eval_expr(e.finish, cols + [acc], n, ansi)
+    return CpuCol(acc.dtype, acc.values, acc.validity & a.validity)
+
+
+# -- JSON + struct expressions ----------------------------------------------
+# Independent of the device path: json-module based (the device engine is a
+# byte-level state machine in jsonpath.py / native C++), so differential
+# tests exercise two implementations.
+
+class _RawNum(str):
+    """Number token with its raw source text preserved."""
+
+
+_JSON_MISSING = object()
+
+
+def _oracle_parse_json_path(path):
+    import re
+
+    if not isinstance(path, str) or not path.startswith("$"):
+        return None
+    token = re.compile(r"\.([^.\[]+)|\[\s*'([^']*)'\s*\]|\[(\d+)\]")
+    out, i = [], 1
+    while i < len(path):
+        m = token.match(path, i)
+        if not m:
+            return None
+        if m.group(1) is not None:
+            if m.group(1) == "*":
+                raise NotImplementedError("oracle: wildcard JSON path")
+            out.append(m.group(1))
+        elif m.group(2) is not None:
+            out.append(m.group(2))
+        else:
+            out.append(int(m.group(3)))
+        i = m.end()
+    return out
+
+
+def _oracle_json_loads(s: str):
+    import json as _json
+
+    def _reject(_):
+        raise ValueError("non-standard constant")
+
+    return _json.loads(s, parse_int=_RawNum, parse_float=_RawNum,
+                       parse_constant=_reject)
+
+
+def _oracle_json_ser(v) -> str:
+    import json as _json
+
+    if isinstance(v, _RawNum):
+        return str(v)
+    if v is None:
+        return "null"
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    if isinstance(v, str):
+        return _json.dumps(v, ensure_ascii=False)
+    if isinstance(v, list):
+        return "[" + ",".join(_oracle_json_ser(x) for x in v) + "]"
+    if isinstance(v, dict):
+        return "{" + ",".join(
+            _json.dumps(k, ensure_ascii=False) + ":" + _oracle_json_ser(x)
+            for k, x in v.items()) + "}"
+    return _json.dumps(v)
+
+
+def _oracle_get_json_object(doc, path):
+    if doc is None or path is None:
+        return None
+    steps = _oracle_parse_json_path(path)
+    if steps is None:
+        return None
+    try:
+        cur = _oracle_json_loads(doc)
+    except ValueError:
+        return None
+    for s in steps:
+        if isinstance(s, str):
+            if not isinstance(cur, dict) or s not in cur:
+                return None
+            cur = cur[s]
+        else:
+            if not isinstance(cur, list) or s >= len(cur):
+                return None
+            cur = cur[s]
+    if cur is None:
+        return None
+    if isinstance(cur, _RawNum):
+        return str(cur)
+    if cur is True:
+        return "true"
+    if cur is False:
+        return "false"
+    if isinstance(cur, str):
+        return cur
+    return _oracle_json_ser(cur)
+
+
+def _h_get_json_object(e, cols, n, ansi):
+    s, p = _kids(e, cols, n, ansi)
+    out = np.empty(n, object)
+    for i in range(n):
+        try:
+            out[i] = _oracle_get_json_object(s.row(i), p.row(i))
+        except NotImplementedError:
+            out[i] = None
+        except RecursionError:
+            out[i] = None
+    return CpuCol.from_objs(list(out), T.STRING)
+
+
+def _h_json_tuple(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    s = kids[0]
+    vals = []
+    for i in range(n):
+        row = []
+        doc = s.row(i)
+        for k in kids[1:]:
+            key = k.row(i)
+            if doc is None or key is None:
+                row.append(None)
+                continue
+            try:
+                parsed = _oracle_json_loads(doc)
+            except ValueError:
+                row.append(None)
+                continue
+            v = parsed.get(key, _JSON_MISSING) if isinstance(
+                parsed, dict) else _JSON_MISSING
+            if v is _JSON_MISSING or v is None:
+                row.append(None)
+            elif isinstance(v, _RawNum):
+                row.append(str(v))
+            elif v is True:
+                row.append("true")
+            elif v is False:
+                row.append("false")
+            elif isinstance(v, str):
+                row.append(v)
+            else:
+                row.append(_oracle_json_ser(v))
+        vals.append(tuple(row))
+    return CpuCol.from_objs(vals, e.dataType)
+
+
+def _h_json_to_structs(e, cols, n, ansi):
+    import json as _json
+
+    s = _kids(e, cols, n, ansi)[0]
+    fields = e.schema.fields
+    vals = []
+    for i in range(n):
+        doc = s.row(i)
+        if doc is None:
+            vals.append(None)
+            continue
+        try:
+            parsed = _json.loads(doc)
+        except ValueError:
+            parsed = None
+        row = []
+        if not isinstance(parsed, dict):
+            row = [None] * len(fields)
+        else:
+            for f in fields:
+                v = parsed.get(f.name)
+                ok, sv = _oracle_convert_json_field(v, f.dataType)
+                if not ok:
+                    row = [None] * len(fields)
+                    break
+                row.append(sv)
+        vals.append(tuple(row))
+    return CpuCol.from_objs(vals, e.schema)
+
+
+def _oracle_convert_json_field(v, dt):
+    # from_json field conversion is DELIBERATELY shared with the device
+    # path (expr/jsonexprs.convert_json_field): both sides parse with the
+    # stdlib json module, so a separate copy would only invite silent
+    # divergence, not independent verification.  The pinned expectations in
+    # test_spark_semantics.py are the guard against a shared
+    # misunderstanding of Spark's PERMISSIVE rules.
+    from spark_rapids_tpu.expr.jsonexprs import convert_json_field
+
+    ok, sv = convert_json_field(v, dt)
+    if ok and sv is not None and isinstance(dt, T.FloatType):
+        sv = np.float32(sv)
+    return ok, sv
+
+
+def _h_structs_to_json(e, cols, n, ansi):
+    import json as _json
+
+    s = _kids(e, cols, n, ansi)[0]
+    fields = e.children[0].dataType.fields
+    out = []
+    for i in range(n):
+        v = s.row(i)
+        if v is None:
+            out.append(None)
+            continue
+        parts = []
+        for k, f in enumerate(fields):
+            fv = v[k]
+            if fv is None:
+                continue
+            key = _json.dumps(f.name, ensure_ascii=False)
+            if isinstance(f.dataType, T.StringType):
+                parts.append(f"{key}:{_json.dumps(fv, ensure_ascii=False)}")
+            elif isinstance(f.dataType, T.BooleanType):
+                parts.append(f"{key}:{'true' if fv else 'false'}")
+            elif isinstance(f.dataType, (T.FloatType, T.DoubleType)):
+                parts.append(f"{key}:{_json.dumps(float(fv))}")
+            else:
+                parts.append(f"{key}:{int(fv)}")
+        out.append("{" + ",".join(parts) + "}")
+    return CpuCol.from_objs(out, T.STRING)
+
+
+def _h_get_struct_field(e, cols, n, ansi):
+    s = _kids(e, cols, n, ansi)[0]
+    k = e._field_ordinal
+    ft = e.dataType
+    objs = [s.values[i][k]
+            if s.validity[i] and s.values[i] is not None else None
+            for i in range(n)]
+    return CpuCol.from_objs(objs, ft)
+
+
+def _h_create_named_struct(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    vals = [tuple(k.row(i) for k in kids) for i in range(n)]
+    out = CpuCol.from_objs(vals, e.dataType)
+    out.validity[:] = True
+    return out
+
+
 _HANDLERS = {
     "BoundReference": _h_bound,
     "Literal": _h_literal,
@@ -1968,6 +2612,32 @@ _HANDLERS = {
     "SubstringIndex": _h_substring_index,
     "RegExpReplace": _h_regexp_replace,
     "RegExpExtract": _h_regexp_extract,
+    "GetJsonObject": _h_get_json_object,
+    "JsonTuple": _h_json_tuple,
+    "JsonToStructs": _h_json_to_structs,
+    "StructsToJson": _h_structs_to_json,
+    "GetStructField": _h_get_struct_field,
+    "CreateNamedStruct": _h_create_named_struct,
+    "ArrayPosition": _h_array_position,
+    "ArrayRemove": _h_array_remove,
+    "ArrayDistinct": _h_array_distinct,
+    "ArraysOverlap": _h_arrays_overlap,
+    "ArrayUnion": _h_array_union,
+    "ArrayIntersect": _h_array_intersect,
+    "ArrayExcept": _h_array_except,
+    "Slice": _h_slice,
+    "SortArray": _h_sort_array,
+    "ArrayRepeat": _h_array_repeat,
+    "Sequence": _h_sequence,
+    "CreateMap": _h_create_map,
+    "MapKeys": _h_map_keys,
+    "MapValues": _h_map_values,
+    "GetMapValue": _h_get_map_value,
+    "ArrayTransform": _h_array_transform,
+    "ArrayFilter": _h_array_filter,
+    "ArrayExists": _h_array_exists,
+    "ArrayForAll": _h_array_forall,
+    "ArrayAggregate": _h_array_aggregate,
 }
 
 
@@ -2078,6 +2748,15 @@ def _cpu_file_scan(plan: PN.FileSourceScan):
             import pyarrow.json as pajson
 
             tables.append(pajson.read_json(p))
+        elif plan.fmt == "avro":
+            import pyarrow as pa
+
+            from spark_rapids_tpu.io.avro import read_avro_columns
+
+            acols, astruct = read_avro_columns(p, plan.output)
+            tables.append(pa.table(
+                {f.name: c.to_arrow()
+                 for f, c in zip(astruct.fields, acols)}))
         else:
             raise NotImplementedError(plan.fmt)
     import pyarrow as pa

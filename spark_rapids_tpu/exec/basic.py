@@ -284,23 +284,7 @@ class TpuLocalTableScanExec(TpuExec):
             end = min(start + step, n)
             if n == 0 and start > 0:
                 break
-            import numpy as np
-
-            chunk = []
-            for h in self.host_columns:
-                if h.is_string:
-                    chunk.append(HostColumn(h.dtype, h.validity[start:end],
-                                            chars=h.chars[start:end],
-                                            lengths=h.lengths[start:end]))
-                elif h.is_array:
-                    chunk.append(HostColumn(
-                        h.dtype, h.validity[start:end],
-                        data=h.data[start:end],
-                        lengths=h.lengths[start:end],
-                        elem_valid=h.elem_valid[start:end]))
-                else:
-                    chunk.append(HostColumn(h.dtype, h.validity[start:end],
-                                            data=h.data[start:end]))
+            chunk = [h.slice_rows(start, end) for h in self.host_columns]
             yield self._count_output(
                 ColumnarBatch.from_host_columns(chunk, names))
             if n == 0:

@@ -44,17 +44,10 @@ class TpuRowToColumnarExec(TpuExec):
         host = [c.to_host() for c in cols]
         names = self.output.field_names()
         step = self.target_batch_rows
+
         for start in range(0, max(n, 1), step):
             end = min(start + step, n)
-            chunk = []
-            for h in host:
-                if h.is_string:
-                    chunk.append(HostColumn(h.dtype, h.validity[start:end],
-                                            chars=h.chars[start:end],
-                                            lengths=h.lengths[start:end]))
-                else:
-                    chunk.append(HostColumn(h.dtype, h.validity[start:end],
-                                            data=h.data[start:end]))
+            chunk = [h.slice_rows(start, end) for h in host]
             yield self._count_output(
                 ColumnarBatch.from_host_columns(chunk, names))
             if n == 0:
@@ -87,36 +80,43 @@ class TpuColumnarToRowExec(TpuExec):
             return [HostColumn.from_pylist([], f.dataType)
                     for f in schema.fields]
         per_batch = [b.to_host_columns() for b in batches]
-        out = []
-        for ci in range(len(per_batch[0])):
-            hs = [pb[ci] for pb in per_batch]
-            dtype = hs[0].dtype
-            validity = np.concatenate([h.validity for h in hs])
-            if hs[0].is_string:
-                width = max(h.chars.shape[1] for h in hs)
-                chars = np.zeros((len(validity), width), np.uint8)
-                lengths = np.concatenate([h.lengths for h in hs])
-                off = 0
-                for h in hs:
-                    chars[off: off + len(h.lengths), : h.chars.shape[1]] = h.chars
-                    off += len(h.lengths)
-                out.append(HostColumn(dtype, validity, chars=chars,
-                                      lengths=lengths))
-            elif hs[0].is_array:
-                ew = max(h.data.shape[1] for h in hs)
-                n = len(validity)
-                data = np.zeros((n, ew), hs[0].data.dtype)
-                ev = np.zeros((n, ew), np.bool_)
-                lengths = np.concatenate([h.lengths for h in hs])
-                off = 0
-                for h in hs:
-                    k = len(h.lengths)
-                    data[off: off + k, : h.data.shape[1]] = h.data
-                    ev[off: off + k, : h.elem_valid.shape[1]] = h.elem_valid
-                    off += k
-                out.append(HostColumn(dtype, validity, data=data,
-                                      lengths=lengths, elem_valid=ev))
-            else:
-                data = np.concatenate([h.data for h in hs])
-                out.append(HostColumn(dtype, validity, data=data))
+        out = [_concat_host([pb[ci] for pb in per_batch])
+               for ci in range(len(per_batch[0]))]
         return out
+
+
+def _concat_host(hs: List[HostColumn]) -> HostColumn:
+    """Concatenate host columns of one schema slot (all column kinds)."""
+    import numpy as np
+
+    dtype = hs[0].dtype
+    validity = np.concatenate([h.validity for h in hs])
+    if hs[0].is_struct:
+        kids = [_concat_host([h.children[k] for h in hs])
+                for k in range(len(hs[0].children))]
+        return HostColumn(dtype, validity, children=kids)
+    if hs[0].is_string:
+        width = max(h.chars.shape[1] for h in hs)
+        chars = np.zeros((len(validity), width), np.uint8)
+        lengths = np.concatenate([h.lengths for h in hs])
+        off = 0
+        for h in hs:
+            chars[off: off + len(h.lengths), : h.chars.shape[1]] = h.chars
+            off += len(h.lengths)
+        return HostColumn(dtype, validity, chars=chars, lengths=lengths)
+    if hs[0].is_array:
+        ew = max(h.data.shape[1] for h in hs)
+        n = len(validity)
+        data = np.zeros((n, ew), hs[0].data.dtype)
+        ev = np.zeros((n, ew), np.bool_)
+        lengths = np.concatenate([h.lengths for h in hs])
+        off = 0
+        for h in hs:
+            k = len(h.lengths)
+            data[off: off + k, : h.data.shape[1]] = h.data
+            ev[off: off + k, : h.elem_valid.shape[1]] = h.elem_valid
+            off += k
+        return HostColumn(dtype, validity, data=data, lengths=lengths,
+                          elem_valid=ev)
+    data = np.concatenate([h.data for h in hs])
+    return HostColumn(dtype, validity, data=data)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import List
 
+import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
@@ -57,13 +58,22 @@ class GetArrayItem(BinaryExpression):
 
 class ElementAt(BinaryExpression):
     """element_at(array, i): 1-based, negative counts from the end;
-    out of bounds -> null (legacy mode)."""
+    out of bounds -> null (legacy mode).  element_at(map, key) is a map
+    lookup (delegates to GetMapValue)."""
 
     def _resolve_type(self):
-        self._dataType = self.left.dataType.elementType
+        lt = self.left.dataType
+        if isinstance(lt, T.MapType):
+            self._dataType = lt.valueType
+        else:
+            self._dataType = lt.elementType
         self._nullable = True
 
     def do_columnar_eval(self, ctx: EvalContext, cols):
+        if isinstance(self.left.dataType, T.MapType):
+            gm = GetMapValue(self.left, self.right)
+            gm._dataType = self._dataType
+            return gm.do_columnar_eval(ctx, cols)
         arr, idx = cols
         i = idx.data.astype(jnp.int32)
         n = arr.lengths
@@ -151,3 +161,478 @@ class ArrayMin(UnaryExpression):
 
 class ArrayMax(ArrayMin):
     _is_min = False
+
+
+# ---------------------------------------------------------------------------
+# Shared element helpers
+# ---------------------------------------------------------------------------
+
+def _in_len(c: DeviceColumn) -> jax.Array:
+    return jnp.arange(c.ewidth)[None, :] < c.lengths[:, None]
+
+
+def _elem_eq(x: jax.Array, y: jax.Array, dtype: T.DataType) -> jax.Array:
+    """SQL set-op equality: NaN == NaN for float elements."""
+    if isinstance(dtype, (T.FloatType, T.DoubleType)):
+        return (x == y) | (jnp.isnan(x) & jnp.isnan(y))
+    return x == y
+
+
+def _compact_elems(data, ev, keep):
+    """Per-row stable compaction of kept elements to the front."""
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    data2 = jnp.take_along_axis(data, order, axis=1)
+    ev2 = jnp.take_along_axis(ev, order, axis=1)
+    keep2 = jnp.take_along_axis(keep, order, axis=1)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    return jnp.where(keep2, data2, 0), ev2 & keep2, new_len
+
+
+def _first_occurrence(c: DeviceColumn, et: T.DataType) -> jax.Array:
+    """(cap, w) mask: True where this element is its value's first
+    appearance within the row (nulls count as one value)."""
+    inl = _in_len(c)
+    v = c.elem_valid & inl
+    nul = ~c.elem_valid & inl
+    eq = _elem_eq(c.data[:, :, None], c.data[:, None, :], et)
+    same = ((v[:, :, None] & v[:, None, :] & eq)
+            | (nul[:, :, None] & nul[:, None, :]))
+    w = c.ewidth
+    before = jnp.tril(jnp.ones((w, w), jnp.bool_), k=-1)[None, :, :]
+    dup = jnp.any(same & before.transpose(0, 2, 1), axis=1)
+    return inl & ~dup
+
+
+def _membership(a: DeviceColumn, b: DeviceColumn, et: T.DataType):
+    """(cap, wa) mask: a-element (null-aware) appears among b's elements."""
+    inl_b = _in_len(b)
+    vb = b.elem_valid & inl_b
+    nb = ~b.elem_valid & inl_b
+    va = a.elem_valid & _in_len(a)
+    na = ~a.elem_valid & _in_len(a)
+    eq = _elem_eq(a.data[:, :, None], b.data[:, None, :], et)
+    same = ((va[:, :, None] & vb[:, None, :] & eq)
+            | (na[:, :, None] & nb[:, None, :]))
+    return jnp.any(same, axis=2)
+
+
+class ArrayPosition(BinaryExpression):
+    """array_position(arr, v): 1-based first index, 0 if absent (LONG)."""
+
+    def _resolve_type(self):
+        self._dataType = T.LONG
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        arr, v = cols
+        et = arr.dtype.elementType
+        inl = _in_len(arr)
+        eq = (_elem_eq(arr.data, v.data[:, None], et)
+              & arr.elem_valid & inl)
+        found = jnp.any(eq, axis=1)
+        pos = jnp.argmax(eq, axis=1) + 1
+        data = jnp.where(found, pos, 0).astype(jnp.int64)
+        return DeviceColumn(T.LONG, arr.validity & v.validity, data=data)
+
+
+class ArrayRemove(BinaryExpression):
+    """array_remove(arr, v): drop elements equal to v (nulls kept)."""
+
+    def _resolve_type(self):
+        self._dataType = self.left.dataType
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        arr, v = cols
+        et = arr.dtype.elementType
+        inl = _in_len(arr)
+        drop = (_elem_eq(arr.data, v.data[:, None], et)
+                & arr.elem_valid & v.validity[:, None])
+        keep = inl & ~drop
+        data, ev, lengths = _compact_elems(arr.data, arr.elem_valid, keep)
+        return DeviceColumn(self.dataType, arr.validity & v.validity,
+                            data=data, lengths=lengths, elem_valid=ev)
+
+
+class ArrayDistinct(UnaryExpression):
+    """array_distinct: first occurrence of each value (one null kept)."""
+
+    def _resolve_type(self):
+        self._dataType = self.child.dataType
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        arr = cols[0]
+        et = arr.dtype.elementType
+        keep = _first_occurrence(arr, et)
+        data, ev, lengths = _compact_elems(arr.data, arr.elem_valid, keep)
+        return DeviceColumn(self.dataType, arr.validity, data=data,
+                            lengths=lengths, elem_valid=ev)
+
+
+class ArraysOverlap(BinaryExpression):
+    """arrays_overlap: true on a shared non-null element; null when no
+    overlap but either side contains null (Spark three-valued result)."""
+
+    def _resolve_type(self):
+        self._dataType = T.BOOLEAN
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        a, b = cols
+        et = a.dtype.elementType
+        inl_a, inl_b = _in_len(a), _in_len(b)
+        va = a.elem_valid & inl_a
+        vb = b.elem_valid & inl_b
+        eq = (_elem_eq(a.data[:, :, None], b.data[:, None, :], et)
+              & va[:, :, None] & vb[:, None, :])
+        overlap = jnp.any(eq, axis=(1, 2))
+        has_null = (jnp.any(~a.elem_valid & inl_a, axis=1)
+                    | jnp.any(~b.elem_valid & inl_b, axis=1))
+        nonempty = (a.lengths > 0) & (b.lengths > 0)
+        unknown = ~overlap & has_null & nonempty
+        validity = a.validity & b.validity & ~unknown
+        return DeviceColumn(T.BOOLEAN, validity, data=overlap)
+
+
+class ArrayUnion(BinaryExpression):
+    """array_union: distinct elements of a then b, first-appearance order."""
+
+    def _resolve_type(self):
+        self._dataType = self.left.dataType
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        a, b = cols
+        et = a.dtype.elementType
+        # concatenate rows then distinct
+        data = jnp.concatenate([a.data, b.data], axis=1)
+        ev_raw = jnp.concatenate([a.elem_valid, b.elem_valid], axis=1)
+        lengths = a.lengths + b.lengths
+        # rebuild a contiguous layout: b's elements start at a.lengths
+        wa, wb = a.ewidth, b.ewidth
+        w = wa + wb
+        pos = jnp.arange(w)[None, :]
+        src_b = pos >= wa
+        tgt = jnp.where(src_b, a.lengths[:, None] + (pos - wa), pos)
+        in_src = jnp.where(src_b, pos - wa < b.lengths[:, None],
+                           pos < a.lengths[:, None])
+        tgt = jnp.where(in_src, tgt, w)
+        cat_data = jnp.zeros_like(data).at[
+            jnp.arange(data.shape[0])[:, None], tgt].set(data, mode="drop")
+        cat_ev = jnp.zeros_like(ev_raw).at[
+            jnp.arange(data.shape[0])[:, None], tgt].set(
+            ev_raw, mode="drop")
+        cat = DeviceColumn(self.dataType, a.validity, data=cat_data,
+                           lengths=lengths.astype(jnp.int32),
+                           elem_valid=cat_ev)
+        keep = _first_occurrence(cat, et)
+        data2, ev2, len2 = _compact_elems(cat_data, cat_ev, keep)
+        return DeviceColumn(self.dataType, a.validity & b.validity,
+                            data=data2, lengths=len2, elem_valid=ev2)
+
+
+class ArrayIntersect(BinaryExpression):
+    """array_intersect: distinct a-elements that also appear in b."""
+
+    def _resolve_type(self):
+        self._dataType = self.left.dataType
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        a, b = cols
+        et = a.dtype.elementType
+        keep = _first_occurrence(a, et) & _membership(a, b, et)
+        data, ev, lengths = _compact_elems(a.data, a.elem_valid, keep)
+        return DeviceColumn(self.dataType, a.validity & b.validity,
+                            data=data, lengths=lengths, elem_valid=ev)
+
+
+class ArrayExcept(BinaryExpression):
+    """array_except: distinct a-elements not appearing in b."""
+
+    def _resolve_type(self):
+        self._dataType = self.left.dataType
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        a, b = cols
+        et = a.dtype.elementType
+        keep = _first_occurrence(a, et) & ~_membership(a, b, et)
+        data, ev, lengths = _compact_elems(a.data, a.elem_valid, keep)
+        return DeviceColumn(self.dataType, a.validity & b.validity,
+                            data=data, lengths=lengths, elem_valid=ev)
+
+
+class Slice(Expression):
+    """slice(arr, start, length): 1-based; negative start from the end;
+    start=0 or length<0 raises (surfaced via the batch error flags)."""
+
+    def __init__(self, arr: Expression, start: Expression,
+                 length: Expression):
+        super().__init__([arr, start, length])
+
+    def _resolve_type(self):
+        self._dataType = self.children[0].dataType
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        arr, st, ln = cols
+        n = arr.lengths
+        s = st.data.astype(jnp.int32)
+        k = ln.data.astype(jnp.int32)
+        ok_in = arr.validity & st.validity & ln.validity
+        ctx.add_error(ok_in & (s == 0),
+                      "Unexpected value for start in function slice: SQL "
+                      "array indices start at 1.")
+        ctx.add_error(ok_in & (k < 0),
+                      "Unexpected value for length in function slice: "
+                      "length must be greater than or equal to 0.")
+        start0 = jnp.where(s > 0, s - 1, n + s)
+        w = arr.ewidth
+        pos = jnp.arange(w)[None, :]
+        src = start0[:, None] + pos
+        take = (pos < k[:, None]) & (src >= 0) & (src < n[:, None])
+        safe = jnp.clip(src, 0, max(w - 1, 0))
+        data = jnp.where(take, jnp.take_along_axis(arr.data, safe, axis=1), 0)
+        ev = jnp.where(take,
+                       jnp.take_along_axis(arr.elem_valid, safe, axis=1),
+                       False)
+        out_len = jnp.sum(take, axis=1).astype(jnp.int32)
+        # negative start beyond the head yields an empty array in Spark
+        empty = start0 < 0
+        out_len = jnp.where(empty, 0, out_len)
+        return DeviceColumn(self.dataType, ok_in, data=data,
+                            lengths=out_len, elem_valid=ev & ~empty[:, None])
+
+
+class SortArray(BinaryExpression):
+    """sort_array(arr, asc): nulls first when ascending, last descending."""
+
+    def _resolve_type(self):
+        self._dataType = self.left.dataType
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        arr, asc_col = cols
+        from spark_rapids_tpu.expr.base import Literal as _Lit
+
+        asc = True
+        if isinstance(self.right, _Lit):
+            asc = bool(self.right.value)
+        et = arr.dtype.elementType
+        inl = _in_len(arr)
+        null_in = ~arr.elem_valid & inl
+        key = arr.data
+        if isinstance(et, (T.FloatType, T.DoubleType)):
+            from spark_rapids_tpu.ops.sortkeys import _float_total_order
+
+            # f32 -> f64 is exact and order-preserving, so one bit trick
+            # covers both float widths; canonicalize NaN bit patterns
+            # (negative-signed NaNs would otherwise sort below -inf) like
+            # sortkeys._column_key_words does
+            f64 = key.astype(jnp.float64)
+            bits = jax.lax.bitcast_convert_type(f64, jnp.int64)
+            bits = jnp.where(jnp.isnan(f64),
+                             jnp.int64(0x7FF8000000000000), bits)
+            key = _float_total_order(bits)
+        else:
+            key = key.astype(jnp.int64)
+        if not asc:
+            key = ~key  # monotone reversal without overflow
+        # tiers: nulls first (asc) / last (desc); padding always last
+        if asc:
+            tier = jnp.where(~inl, 2, jnp.where(null_in, 0, 1))
+        else:
+            tier = jnp.where(~inl, 2, jnp.where(null_in, 1, 0))
+        tier32 = tier.astype(jnp.int32)
+        s_tier, s_key, s_data, s_ev = jax.lax.sort(
+            (tier32, key, arr.data, arr.elem_valid), dimension=1,
+            num_keys=2, is_stable=True)
+        return DeviceColumn(self.dataType, arr.validity, data=s_data,
+                            lengths=arr.lengths, elem_valid=s_ev)
+
+
+class ArrayRepeat(BinaryExpression):
+    """array_repeat(v, n) with a static element-capacity cap."""
+
+    MAX_ELEMENTS = 1024
+
+    def _resolve_type(self):
+        self._dataType = T.ArrayType(self.left.dataType)
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        v, n = cols
+        cap = v.capacity
+        count = jnp.maximum(n.data.astype(jnp.int32), 0)
+        ctx.add_error(n.validity & (count > self.MAX_ELEMENTS),
+                      f"array_repeat count above the TPU element cap "
+                      f"({self.MAX_ELEMENTS})")
+        from spark_rapids_tpu.expr.base import Literal as _Lit
+
+        if isinstance(self.right, _Lit) and self.right.value is not None:
+            w = max(min(int(self.right.value), self.MAX_ELEMENTS), 1)
+        else:
+            w = self.MAX_ELEMENTS
+        pos = jnp.arange(w)[None, :]
+        take = pos < count[:, None]
+        data = jnp.where(take, v.data[:, None], 0)
+        ev = take & v.validity[:, None]
+        return DeviceColumn(self.dataType, n.validity,
+                            data=data, lengths=count, elem_valid=ev)
+
+
+class Sequence(Expression):
+    """sequence(start, stop[, step]) with a static element cap (the
+    reference errors above MAX_ROUNDED_ARRAY_LENGTH; we error above the
+    TPU cap via the batch error flags)."""
+
+    MAX_ELEMENTS = 1024
+
+    def __init__(self, start: Expression, stop: Expression,
+                 step: Expression = None):
+        kids = [start, stop] + ([step] if step is not None else [])
+        super().__init__(kids)
+
+    def _resolve_type(self):
+        self._dataType = T.ArrayType(self.children[0].dataType)
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        start = cols[0].data.astype(jnp.int64)
+        stop = cols[1].data.astype(jnp.int64)
+        if len(cols) > 2:
+            step = cols[2].data.astype(jnp.int64)
+            step_v = cols[2].validity
+        else:
+            step = jnp.where(stop >= start, 1, -1).astype(jnp.int64)
+            step_v = jnp.ones_like(cols[0].validity)
+        validity = cols[0].validity & cols[1].validity & step_v
+        bad_step = validity & (
+            (step == 0) | ((stop > start) & (step < 0))
+            | ((stop < start) & (step > 0)))
+        ctx.add_error(bad_step,
+                      "Illegal sequence boundaries: step must move start "
+                      "towards stop")
+        safe_step = jnp.where(step == 0, 1, step)
+        count = jnp.where(bad_step, 0,
+                          (stop - start) // safe_step + 1)
+        count = jnp.maximum(count, 0)
+        ctx.add_error(validity & (count > self.MAX_ELEMENTS),
+                      f"sequence length above the TPU element cap "
+                      f"({self.MAX_ELEMENTS})")
+        count = jnp.minimum(count, self.MAX_ELEMENTS).astype(jnp.int32)
+        w = self.MAX_ELEMENTS
+        pos = jnp.arange(w, dtype=jnp.int64)[None, :]
+        vals = start[:, None] + pos * safe_step[:, None]
+        take = pos < count[:, None]
+        et = self.children[0].dataType
+        data = jnp.where(take, vals, 0).astype(T.storage_dtype(et))
+        return DeviceColumn(self.dataType, validity, data=data,
+                            lengths=count, elem_valid=take)
+
+
+# ---------------------------------------------------------------------------
+# Maps — device layout: children = (keys ArrayType column, values ArrayType
+# column) sharing lengths, the padded counterpart of cuDF MAP (list of
+# key/value structs).  Reference: GpuCreateMap / GpuMapKeys / GpuMapValues /
+# GpuGetMapValue (collectionOperations.scala).
+# ---------------------------------------------------------------------------
+
+class CreateMap(Expression):
+    """map(k1, v1, k2, v2, ...); duplicate keys raise (Spark's default
+    EXCEPTION dedup policy), surfaced via the batch error flags."""
+
+    def __init__(self, children: List[Expression]):
+        assert len(children) % 2 == 0, "map() needs key/value pairs"
+        super().__init__(list(children))
+
+    def sql_string(self):
+        return "map(" + ", ".join(c.sql_string() for c in self.children) + ")"
+
+    def _resolve_type(self):
+        kt = self.children[0].dataType
+        vt = self.children[1].dataType
+        self._dataType = T.MapType(kt, vt)
+        self._nullable = False
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        ks = cols[0::2]
+        vs = cols[1::2]
+        cap = ks[0].capacity
+        kdata = jnp.stack([c.data for c in ks], axis=1)
+        kvalid = jnp.stack([c.validity for c in ks], axis=1)
+        vdata = jnp.stack([c.data for c in vs], axis=1)
+        vvalid = jnp.stack([c.validity for c in vs], axis=1)
+        # Spark: null keys are invalid; duplicates raise
+        ctx.add_error(jnp.any(~kvalid, axis=1),
+                      "Cannot use null as map key")
+        kt = self.children[0].dataType
+        dup = jnp.any(
+            _elem_eq(kdata[:, :, None], kdata[:, None, :], kt)
+            & jnp.tril(jnp.ones((len(ks), len(ks)), jnp.bool_), k=-1)[None],
+            axis=(1, 2))
+        ctx.add_error(dup, "Duplicate map key was found")
+        n = len(ks)
+        lengths = jnp.full(cap, n, jnp.int32)
+        keys_col = DeviceColumn(T.ArrayType(kt, containsNull=False),
+                                jnp.ones(cap, jnp.bool_), data=kdata,
+                                lengths=lengths, elem_valid=kvalid)
+        vals_col = DeviceColumn(T.ArrayType(self.children[1].dataType),
+                                jnp.ones(cap, jnp.bool_), data=vdata,
+                                lengths=lengths, elem_valid=vvalid)
+        return DeviceColumn(self.dataType, jnp.ones(cap, jnp.bool_),
+                            children=(keys_col, vals_col))
+
+
+class MapKeys(UnaryExpression):
+    def _resolve_type(self):
+        mt = self.child.dataType
+        self._dataType = T.ArrayType(mt.keyType, containsNull=False)
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        m = cols[0]
+        k = m.children[0]
+        return DeviceColumn(self.dataType, k.validity & m.validity,
+                            data=k.data, lengths=k.lengths,
+                            elem_valid=k.elem_valid)
+
+
+class MapValues(UnaryExpression):
+    def _resolve_type(self):
+        mt = self.child.dataType
+        self._dataType = T.ArrayType(mt.valueType)
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        m = cols[0]
+        v = m.children[1]
+        return DeviceColumn(self.dataType, v.validity & m.validity,
+                            data=v.data, lengths=v.lengths,
+                            elem_valid=v.elem_valid)
+
+
+class GetMapValue(BinaryExpression):
+    """map[key] — first matching key's value, null when absent."""
+
+    def _resolve_type(self):
+        self._dataType = self.left.dataType.valueType
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        m, key = cols
+        kcol, vcol = m.children
+        kt = self.left.dataType.keyType
+        inl = _in_len(kcol)
+        eq = (_elem_eq(kcol.data, key.data[:, None], kt)
+              & kcol.elem_valid & inl)
+        found = jnp.any(eq, axis=1)
+        pos = jnp.argmax(eq, axis=1)
+        safe = jnp.clip(pos, 0, max(kcol.ewidth - 1, 0))
+        data = jnp.take_along_axis(vcol.data, safe[:, None], axis=1)[:, 0]
+        ev = jnp.take_along_axis(vcol.elem_valid, safe[:, None],
+                                 axis=1)[:, 0]
+        validity = m.validity & key.validity & found & ev
+        return DeviceColumn(self.dataType, validity, data=data)

@@ -1,0 +1,71 @@
+"""Struct expressions over struct device columns.
+
+Reference analog: org/apache/spark/sql/rapids/complexTypeCreator.scala
+(GpuCreateNamedStruct) and complexTypeExtractors (GpuGetStructField) —
+cuDF STRUCT columns are a validity mask over child columns, and so are
+ours (columnar/column.py kind "struct"), so extraction is a child pick
+and creation is a bundle: both free at the XLA level.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expr.base import Expression, UnaryExpression
+
+
+class GetStructField(UnaryExpression):
+    """struct.field — child column pick, validity AND'd with the struct's."""
+
+    def __init__(self, child: Expression, name: str):
+        super().__init__(child)
+        self.field_name = name
+
+    def sql_string(self):
+        return f"{self.child.sql_string()}.{self.field_name}"
+
+    def _resolve_type(self):
+        st = self.child.dataType
+        if not isinstance(st, T.StructType):
+            raise TypeError(f"GetStructField on {st.simpleString}")
+        matches = [f for f in st.fields if f.name == self.field_name]
+        if not matches:
+            raise KeyError(
+                f"no field '{self.field_name}' in {st.simpleString}")
+        self._field_ordinal = st.fields.index(matches[0])
+        self._dataType = matches[0].dataType
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        kid = c.children[self._field_ordinal]
+        validity = kid.validity & c.validity
+        return DeviceColumn(kid.dtype, validity, data=kid.data,
+                            chars=kid.chars, lengths=kid.lengths,
+                            elem_valid=kid.elem_valid, children=kid.children)
+
+
+class CreateNamedStruct(Expression):
+    """named_struct('a', x, 'b', y) — bundle children into a struct column."""
+
+    def __init__(self, names: List[str], values: List[Expression]):
+        super().__init__(values)
+        self.field_names = list(names)
+
+    def sql_string(self):
+        parts = ", ".join(f"'{n}', {v.sql_string()}"
+                          for n, v in zip(self.field_names, self.children))
+        return f"named_struct({parts})"
+
+    def _resolve_type(self):
+        self._dataType = T.StructType(
+            [T.StructField(n, c.dataType, c.nullable)
+             for n, c in zip(self.field_names, self.children)])
+        self._nullable = False
+
+    def do_columnar_eval(self, ctx, cols):
+        validity = jnp.ones(ctx.batch.capacity, jnp.bool_)
+        return DeviceColumn(self.dataType, validity, children=tuple(cols))

@@ -1,0 +1,255 @@
+"""Higher-order array functions: transform / filter / exists / forall.
+
+Reference analog: org/apache/spark/sql/rapids/higherOrderFunctions.scala
+(GpuArrayTransform, GpuArrayFilter, GpuArrayExists, SURVEY.md §2.5
+Collections/higher-order).
+
+TPU design: the lambda body is an ordinary expression tree resolved against
+an EXTENDED schema (outer columns + the lambda variable).  Evaluation
+flattens the (capacity, ewidth) element matrix into a (capacity*ewidth)
+pseudo-batch — outer columns repeated per element — and runs the body ONCE
+as part of the enclosing jitted stage, so the lambda fuses with everything
+else (the reference instead re-enters cuDF per lambda node).  The result
+reshapes back to the padded element matrix.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expr.base import EvalContext, Expression
+from spark_rapids_tpu.expr.collections import _compact_elems, _in_len
+
+
+def _repeat_col(c: DeviceColumn, w: int) -> DeviceColumn:
+    """Repeat each row w times (row-major, matching a (cap, w) flatten)."""
+    validity = jnp.repeat(c.validity, w)
+    if c.is_string:
+        return DeviceColumn(c.dtype, validity,
+                            chars=jnp.repeat(c.chars, w, axis=0),
+                            lengths=jnp.repeat(c.lengths, w))
+    if c.is_array:
+        return DeviceColumn(c.dtype, validity,
+                            data=jnp.repeat(c.data, w, axis=0),
+                            lengths=jnp.repeat(c.lengths, w),
+                            elem_valid=jnp.repeat(c.elem_valid, w, axis=0))
+    if c.is_struct:
+        return DeviceColumn(c.dtype, validity,
+                            children=tuple(_repeat_col(k, w)
+                                           for k in c.children))
+    return DeviceColumn(c.dtype, validity,
+                        data=jnp.repeat(c.data, w, axis=0))
+
+
+class HigherOrderFunction(Expression):
+    """Base: one array child + a lambda body over (outer cols, element)."""
+
+    def __init__(self, arr: Expression, var_name: str, body: Expression):
+        super().__init__([arr])
+        self.var_name = var_name
+        self.body = body
+
+    @property
+    def arr(self):
+        return self.children[0]
+
+    def sql_string(self):
+        return (f"{self.pretty_name.lower()}({self.arr.sql_string()}, "
+                f"{self.var_name} -> {self.body.sql_string()})")
+
+    def resolve(self, schema: T.StructType) -> Expression:
+        self.children = [c.resolve(schema) for c in self.children]
+        et = self.arr.dataType.elementType
+        ext = T.StructType(
+            list(schema.fields) + [T.StructField(self.var_name, et, True)])
+        self.body = self.body.resolve(ext)
+        self._resolve_type()
+        self.resolved = True
+        return self
+
+    def collect(self, pred):
+        out = super().collect(pred)
+        out.extend(self.body.collect(pred))
+        return out
+
+    def _eval_body(self, ctx: EvalContext, arr: DeviceColumn):
+        """Flatten elements, run the body, return its (cap*w,) column."""
+        cap, w = arr.capacity, max(arr.ewidth, 1)
+        inl = _in_len(arr)
+        elem = DeviceColumn(self.arr.dataType.elementType,
+                            (arr.elem_valid & inl).reshape(-1),
+                            data=arr.data.reshape(cap * w))
+        outer = [_repeat_col(c, w) for c in ctx.batch.columns]
+        ext = T.StructType(
+            list(ctx.batch.schema.fields)
+            + [T.StructField(self.var_name,
+                             self.arr.dataType.elementType, True)])
+        flat = ColumnarBatch(outer + [elem], cap * w, ext)
+        sub = EvalContext(flat, ansi=ctx.ansi, error_flags=ctx.error_flags)
+        res = self.body.eval_tpu(sub)
+        return res, inl
+
+
+class ArrayTransform(HigherOrderFunction):
+    """transform(arr, x -> f(x))."""
+
+    def _resolve_type(self):
+        self._dataType = T.ArrayType(self.body.dataType)
+        self._nullable = self.arr.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        arr = cols[0]
+        res, inl = self._eval_body(ctx, arr)
+        cap, w = arr.capacity, max(arr.ewidth, 1)
+        data = res.data.reshape(cap, w)
+        ev = res.validity.reshape(cap, w) & inl
+        return DeviceColumn(self.dataType, arr.validity, data=data,
+                            lengths=arr.lengths, elem_valid=ev)
+
+
+class ArrayFilter(HigherOrderFunction):
+    """filter(arr, x -> pred(x)): keeps elements where pred is TRUE
+    (null predicate drops the element, like Spark)."""
+
+    def _resolve_type(self):
+        self._dataType = self.arr.dataType
+        self._nullable = self.arr.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        arr = cols[0]
+        res, inl = self._eval_body(ctx, arr)
+        cap, w = arr.capacity, max(arr.ewidth, 1)
+        keep = (res.data.reshape(cap, w) & res.validity.reshape(cap, w)
+                & inl)
+        data, ev, lengths = _compact_elems(arr.data, arr.elem_valid, keep)
+        return DeviceColumn(self.dataType, arr.validity, data=data,
+                            lengths=lengths, elem_valid=ev)
+
+
+class ArrayExists(HigherOrderFunction):
+    """exists(arr, pred): three-valued — true if any TRUE, null if no TRUE
+    but some null predicate results, else false."""
+
+    def _resolve_type(self):
+        self._dataType = T.BOOLEAN
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        arr = cols[0]
+        res, inl = self._eval_body(ctx, arr)
+        cap, w = arr.capacity, max(arr.ewidth, 1)
+        pred = res.data.reshape(cap, w)
+        pv = res.validity.reshape(cap, w)
+        any_true = jnp.any(pred & pv & inl, axis=1)
+        any_null = jnp.any(~pv & inl, axis=1)
+        validity = arr.validity & (any_true | ~any_null)
+        return DeviceColumn(T.BOOLEAN, validity, data=any_true)
+
+
+class ArrayForAll(HigherOrderFunction):
+    """forall(arr, pred): false if any FALSE, null if no FALSE but some
+    null predicate results, else true."""
+
+    def _resolve_type(self):
+        self._dataType = T.BOOLEAN
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        arr = cols[0]
+        res, inl = self._eval_body(ctx, arr)
+        cap, w = arr.capacity, max(arr.ewidth, 1)
+        pred = res.data.reshape(cap, w)
+        pv = res.validity.reshape(cap, w)
+        any_false = jnp.any(~pred & pv & inl, axis=1)
+        any_null = jnp.any(~pv & inl, axis=1)
+        validity = arr.validity & (any_false | ~any_null)
+        return DeviceColumn(T.BOOLEAN, validity, data=~any_false)
+
+
+class ArrayAggregate(Expression):
+    """aggregate(arr, zero, (acc, x) -> merge, acc -> finish).
+
+    Sequential fold unrolled over the STATIC element width — each step is
+    one fused vector op over all rows, so the fold costs O(ewidth) vector
+    ops, not O(rows*ewidth) scalar ops."""
+
+    def __init__(self, arr: Expression, zero: Expression,
+                 acc_name: str, var_name: str, merge: Expression,
+                 finish: Expression = None):
+        super().__init__([arr, zero])
+        self.acc_name = acc_name
+        self.var_name = var_name
+        self.merge = merge
+        self.finish = finish
+
+    @property
+    def arr(self):
+        return self.children[0]
+
+    def resolve(self, schema: T.StructType) -> Expression:
+        self.children = [c.resolve(schema) for c in self.children]
+        et = self.arr.dataType.elementType
+        acc_t = self.children[1].dataType
+        ext = T.StructType(
+            list(schema.fields)
+            + [T.StructField(self.acc_name, acc_t, True),
+               T.StructField(self.var_name, et, True)])
+        self.merge = self.merge.resolve(ext)
+        if self.finish is not None:
+            fin_schema = T.StructType(
+                list(schema.fields)
+                + [T.StructField(self.acc_name, self.merge.dataType, True)])
+            self.finish = self.finish.resolve(fin_schema)
+        self._resolve_type()
+        self.resolved = True
+        return self
+
+    def _resolve_type(self):
+        self._dataType = (self.finish.dataType if self.finish is not None
+                          else self.merge.dataType)
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        arr, zero = cols
+        cap, w = arr.capacity, max(arr.ewidth, 1)
+        inl_np = _in_len(arr)
+        acc = zero
+        for j in range(arr.ewidth):
+            elem = DeviceColumn(self.arr.dataType.elementType,
+                                arr.elem_valid[:, j], data=arr.data[:, j])
+            ext = T.StructType(
+                list(ctx.batch.schema.fields)
+                + [T.StructField(self.acc_name, acc.dtype, True),
+                   T.StructField(self.var_name, elem.dtype, True)])
+            sub = EvalContext(
+                ColumnarBatch(list(ctx.batch.columns) + [acc, elem],
+                              ctx.batch.num_rows, ext),
+                ansi=ctx.ansi, error_flags=ctx.error_flags)
+            merged = self.merge.eval_tpu(sub)
+            take = inl_np[:, j]
+            acc = DeviceColumn(
+                merged.dtype,
+                jnp.where(take, merged.validity, acc.validity),
+                data=jnp.where(take, merged.data, acc.data))
+        if self.finish is not None:
+            ext = T.StructType(
+                list(ctx.batch.schema.fields)
+                + [T.StructField(self.acc_name, acc.dtype, True)])
+            sub = EvalContext(
+                ColumnarBatch(list(ctx.batch.columns) + [acc],
+                              ctx.batch.num_rows, ext),
+                ansi=ctx.ansi, error_flags=ctx.error_flags)
+            acc = self.finish.eval_tpu(sub)
+        validity = acc.validity & arr.validity
+        return DeviceColumn(self.dataType, validity, data=acc.data,
+                            chars=acc.chars, lengths=acc.lengths,
+                            elem_valid=acc.elem_valid,
+                            children=acc.children)
+
+    def sql_string(self):
+        return (f"aggregate({self.arr.sql_string()}, "
+                f"{self.children[1].sql_string()}, "
+                f"({self.acc_name}, {self.var_name}) -> "
+                f"{self.merge.sql_string()})")

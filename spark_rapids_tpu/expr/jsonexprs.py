@@ -1,0 +1,393 @@
+"""JSON expressions: get_json_object, json_tuple, from_json, to_json.
+
+Reference analog: GpuGetJsonObject / GpuJsonTuple (spark-rapids-jni
+``get_json_object.cu``), GpuJsonToStructs (jni JSON parser), GpuStructsToJson
+(SURVEY.md §2.5 JSON row).  The reference runs a CUDA JSON kernel; the TPU
+build keeps JSON parsing on the host (SURVEY.md §2.10 item 10: host parse →
+device) behind ``jax.pure_callback`` — the byte-level path engine lives in
+spark_rapids_tpu/jsonpath.py with a native C++ port (native/host_kernels.cpp)
+for throughput; results land back in the jitted stage as padded columns.
+
+Path support mirrors the reference's plan-time reject stance: wildcard
+paths fall back to CPU with an explain reason (overrides._check_json_path).
+"""
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expr.base import (
+    BinaryExpression,
+    EvalContext,
+    Expression,
+    Literal,
+    UnaryExpression,
+)
+from spark_rapids_tpu.jsonpath import (
+    PathStep,
+    UnsupportedJsonPath,
+    get_json_object_bytes,
+    parse_json_path,
+)
+
+
+def _null_string_col(cap: int) -> DeviceColumn:
+    return DeviceColumn(T.STRING, jnp.zeros(cap, jnp.bool_),
+                        chars=jnp.zeros((cap, 8), jnp.uint8),
+                        lengths=jnp.zeros(cap, jnp.int32))
+
+
+def _padded_json_eval(chars: np.ndarray, lengths: np.ndarray,
+                      validity: np.ndarray,
+                      steps: List[PathStep]):
+    """Host kernel: evaluate one path over a padded char matrix."""
+    from spark_rapids_tpu import native
+
+    return native.get_json_object_padded(chars, lengths, validity, steps)
+
+
+def _callback_string_result(c: DeviceColumn, fn):
+    """Run fn(chars,lengths,validity) -> (chars,lengths,valid) on host."""
+    cap, w = c.capacity, max(c.width, 1)
+    shapes = (jax.ShapeDtypeStruct((cap, w), np.uint8),
+              jax.ShapeDtypeStruct((cap,), np.int32),
+              jax.ShapeDtypeStruct((cap,), np.bool_))
+    out_chars, out_lens, out_valid = jax.pure_callback(
+        fn, shapes, c.chars, c.lengths, c.validity)
+    return DeviceColumn(T.STRING, out_valid, chars=out_chars,
+                        lengths=out_lens)
+
+
+class GetJsonObject(BinaryExpression):
+    """get_json_object(json, path) — path must be a literal (Spark requires
+    foldable); wildcard paths are rejected at plan time."""
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+        self._steps: Optional[List[PathStep]] = None
+        p = self.right
+        if isinstance(p, Literal) and p.value is not None:
+            try:
+                self._steps = parse_json_path(p.value)
+            except UnsupportedJsonPath:
+                self._steps = None  # overrides rejects before we get here
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        c = cols[0]
+        if self._steps is None:
+            # invalid path or null path literal: Spark yields NULL rows
+            return _null_string_col(c.capacity)
+        steps = self._steps
+
+        def fn(chars, lengths, validity):
+            return _padded_json_eval(np.asarray(chars), np.asarray(lengths),
+                                     np.asarray(validity), steps)
+
+        return _callback_string_result(c, fn)
+
+
+class JsonTuple(Expression):
+    """json_tuple(json, k1, ...) — struct of N string fields c0..cN-1.
+
+    Spark plans json_tuple as a generator (one row, N columns); the TPU
+    build returns a struct column (same capability; flattened by a
+    Project of GetStructField)."""
+
+    def __init__(self, children: List[Expression]):
+        super().__init__(children)
+
+    def _resolve_type(self):
+        nkeys = len(self.children) - 1
+        self._dataType = T.StructType(
+            [T.StructField(f"c{i}", T.STRING, True) for i in range(nkeys)])
+        self._nullable = False
+        self._keys: List[Optional[str]] = []
+        for k in self.children[1:]:
+            if isinstance(k, Literal) and isinstance(k.value, str):
+                self._keys.append(k.value)
+            else:
+                self._keys.append(None)
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        from spark_rapids_tpu.jsonpath import json_tuple_bytes
+
+        c = cols[0]
+        cap, w = c.capacity, max(c.width, 1)
+        keys: List[str] = []
+        slot_to_j = {}
+        for slot, k in enumerate(self._keys):
+            if k is not None:
+                slot_to_j[slot] = len(keys)
+                keys.append(k)
+
+        def fn(chars, lengths, validity):
+            chars = np.asarray(chars)
+            lengths = np.asarray(lengths)
+            validity = np.asarray(validity)
+            k = len(keys)
+            out_chars = np.zeros((k, cap, w), np.uint8)
+            out_lens = np.zeros((k, cap), np.int32)
+            out_valid = np.zeros((k, cap), np.bool_)
+            for i in range(cap):
+                if not validity[i]:
+                    continue
+                vals = json_tuple_bytes(bytes(chars[i, :lengths[i]]), keys)
+                for j, v in enumerate(vals):
+                    if v is None:
+                        continue
+                    v = v[:w]
+                    out_chars[j, i, :len(v)] = np.frombuffer(v, np.uint8)
+                    out_lens[j, i] = len(v)
+                    out_valid[j, i] = True
+            return out_chars, out_lens, out_valid
+
+        shapes = (jax.ShapeDtypeStruct((len(keys), cap, w), np.uint8),
+                  jax.ShapeDtypeStruct((len(keys), cap), np.int32),
+                  jax.ShapeDtypeStruct((len(keys), cap), np.bool_))
+        if keys:
+            och, oln, ova = jax.pure_callback(fn, shapes, c.chars,
+                                              c.lengths, c.validity)
+        kids = []
+        for slot in range(len(self._keys)):
+            if slot in slot_to_j:
+                j = slot_to_j[slot]
+                kids.append(DeviceColumn(T.STRING, ova[j], chars=och[j],
+                                         lengths=oln[j]))
+            else:
+                kids.append(_null_string_col(cap))
+        validity = jnp.ones(cap, jnp.bool_)
+        return DeviceColumn(self.dataType, validity, children=tuple(kids))
+
+
+# ---------------------------------------------------------------------------
+# from_json / to_json
+# ---------------------------------------------------------------------------
+
+def convert_json_field(v, dt: T.DataType):
+    """One parsed JSON value -> storage value for field type dt.
+
+    Returns (ok, value); ok=False means the RECORD fails (PERMISSIVE mode
+    nulls every field of the row, like Spark's JacksonParser badRecord)."""
+    if v is None:
+        return True, None
+    if isinstance(dt, T.StringType):
+        if isinstance(v, str):
+            return True, v
+        if isinstance(v, bool):
+            return True, "true" if v else "false"
+        if isinstance(v, (int, float)):
+            return True, json.dumps(v)
+        return True, json.dumps(v, separators=(",", ":"),
+                                ensure_ascii=False)
+    if isinstance(dt, T.BooleanType):
+        return (True, bool(v)) if isinstance(v, bool) else (False, None)
+    if isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType, T.LongType)):
+        if isinstance(v, bool) or not isinstance(v, int):
+            return False, None
+        lo = {T.ByteType: -(2**7), T.ShortType: -(2**15),
+              T.IntegerType: -(2**31), T.LongType: -(2**63)}[type(dt)]
+        if not (lo <= v < -lo):
+            return False, None
+        return True, v
+    if isinstance(dt, (T.FloatType, T.DoubleType)):
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return False, None
+        return True, float(v)
+    return False, None
+
+
+class JsonToStructs(UnaryExpression):
+    """from_json(json, schema) for flat structs of primitive/string fields.
+
+    PERMISSIVE semantics: a malformed record (or a field/type mismatch)
+    yields a row with every field NULL; a SQL NULL input yields a NULL
+    struct."""
+
+    def __init__(self, child: Expression, schema: T.StructType):
+        super().__init__(child)
+        self.schema = schema
+
+    def _resolve_type(self):
+        self._dataType = self.schema
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        c = cols[0]
+        cap, w = c.capacity, max(c.width, 1)
+        fields = self.schema.fields
+
+        def fn(chars, lengths, validity):
+            chars = np.asarray(chars)
+            lengths = np.asarray(lengths)
+            validity = np.asarray(validity)
+            n = cap
+            records: List[Optional[list]] = []
+            for i in range(n):
+                if not validity[i]:
+                    records.append(None)
+                    continue
+                raw = bytes(chars[i, :lengths[i]])
+                vals: Optional[list] = []
+                try:
+                    doc = json.loads(raw.decode("utf-8", "replace"))
+                except (ValueError, UnicodeDecodeError):
+                    doc = None
+                if not isinstance(doc, dict):
+                    vals = [None] * len(fields)
+                else:
+                    for f in fields:
+                        ok, sv = convert_json_field(doc.get(f.name),
+                                                     f.dataType)
+                        if not ok:
+                            vals = [None] * len(fields)
+                            break
+                        vals.append(sv)
+                records.append(vals)
+            outs = []
+            for k, f in enumerate(fields):
+                col_vals = [r[k] if r is not None else None for r in records]
+                fvalid = np.array([v is not None for v in col_vals],
+                                  np.bool_)
+                if isinstance(f.dataType, T.StringType):
+                    fchars = np.zeros((n, w), np.uint8)
+                    flens = np.zeros(n, np.int32)
+                    for i, v in enumerate(col_vals):
+                        if v is None:
+                            continue
+                        b = v.encode("utf-8")[:w]
+                        fchars[i, :len(b)] = np.frombuffer(b, np.uint8)
+                        flens[i] = len(b)
+                    outs += [fchars, flens, fvalid]
+                else:
+                    data = np.zeros(n, T.storage_dtype(f.dataType))
+                    for i, v in enumerate(col_vals):
+                        if v is not None:
+                            data[i] = v
+                    outs += [data, fvalid]
+            outs.append(validity.copy())
+            return tuple(outs)
+
+        shapes = []
+        for f in fields:
+            if isinstance(f.dataType, T.StringType):
+                shapes += [jax.ShapeDtypeStruct((cap, w), np.uint8),
+                           jax.ShapeDtypeStruct((cap,), np.int32),
+                           jax.ShapeDtypeStruct((cap,), np.bool_)]
+            else:
+                shapes += [jax.ShapeDtypeStruct(
+                    (cap,), T.storage_dtype(f.dataType)),
+                    jax.ShapeDtypeStruct((cap,), np.bool_)]
+        shapes.append(jax.ShapeDtypeStruct((cap,), np.bool_))
+        flat = jax.pure_callback(fn, tuple(shapes), c.chars, c.lengths,
+                                 c.validity)
+        kids = []
+        pos = 0
+        for f in fields:
+            if isinstance(f.dataType, T.StringType):
+                kids.append(DeviceColumn(T.STRING, flat[pos + 2],
+                                         chars=flat[pos],
+                                         lengths=flat[pos + 1]))
+                pos += 3
+            else:
+                kids.append(DeviceColumn(f.dataType, flat[pos + 1],
+                                         data=flat[pos]))
+                pos += 2
+        return DeviceColumn(self.schema, flat[pos], children=tuple(kids))
+
+
+def _json_escape(s: str) -> str:
+    return json.dumps(s, ensure_ascii=False)
+
+
+class StructsToJson(UnaryExpression):
+    """to_json(struct) — null fields omitted (Spark ignoreNullFields)."""
+
+    def _resolve_type(self):
+        if not isinstance(self.child.dataType, T.StructType):
+            raise TypeError("to_json expects a struct input")
+        self._dataType = T.STRING
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        c = cols[0]
+        cap = c.capacity
+        fields = self.child.dataType.fields
+        # static output bound: braces + per-field key/punct + value bound
+        bound = 2
+        for f, kid in zip(fields, c.children):
+            if isinstance(f.dataType, T.StringType):
+                vb = 2 + 6 * max(kid.width, 1)
+            elif isinstance(f.dataType, T.BooleanType):
+                vb = 5
+            else:
+                vb = 25
+            bound += len(f.name.encode()) + 4 + vb
+        bound = max(bound, 8)
+
+        def fn(validity, *kid_arrays):
+            validity = np.asarray(validity)
+            # unpack per-field host views
+            host_fields = []
+            pos = 0
+            for f in fields:
+                if isinstance(f.dataType, T.StringType):
+                    host_fields.append((np.asarray(kid_arrays[pos]),
+                                        np.asarray(kid_arrays[pos + 1]),
+                                        np.asarray(kid_arrays[pos + 2])))
+                    pos += 3
+                else:
+                    host_fields.append((np.asarray(kid_arrays[pos]),
+                                        np.asarray(kid_arrays[pos + 1])))
+                    pos += 2
+            out_chars = np.zeros((cap, bound), np.uint8)
+            out_lens = np.zeros(cap, np.int32)
+            for i in range(cap):
+                if not validity[i]:
+                    continue
+                parts = []
+                for f, hf in zip(fields, host_fields):
+                    if isinstance(f.dataType, T.StringType):
+                        fchars, flens, fvalid = hf
+                        if not fvalid[i]:
+                            continue
+                        v = bytes(fchars[i, :flens[i]]).decode(
+                            "utf-8", "replace")
+                        parts.append(f"{_json_escape(f.name)}:"
+                                     f"{_json_escape(v)}")
+                    else:
+                        data, fvalid = hf
+                        if not fvalid[i]:
+                            continue
+                        if isinstance(f.dataType, T.BooleanType):
+                            txt = "true" if data[i] else "false"
+                        elif isinstance(f.dataType,
+                                        (T.FloatType, T.DoubleType)):
+                            txt = json.dumps(float(data[i]))
+                        else:
+                            txt = str(int(data[i]))
+                        parts.append(f"{_json_escape(f.name)}:{txt}")
+                b = ("{" + ",".join(parts) + "}").encode("utf-8")[:bound]
+                out_chars[i, :len(b)] = np.frombuffer(b, np.uint8)
+                out_lens[i] = len(b)
+            return out_chars, out_lens, validity.copy()
+
+        shapes = (jax.ShapeDtypeStruct((cap, bound), np.uint8),
+                  jax.ShapeDtypeStruct((cap,), np.int32),
+                  jax.ShapeDtypeStruct((cap,), np.bool_))
+        args = [c.validity]
+        for f, kid in zip(fields, c.children):
+            if isinstance(f.dataType, T.StringType):
+                args += [kid.chars, kid.lengths, kid.validity & c.validity]
+            else:
+                args += [kid.data, kid.validity & c.validity]
+        out_chars, out_lens, out_valid = jax.pure_callback(
+            fn, shapes, *args)
+        return DeviceColumn(T.STRING, out_valid, chars=out_chars,
+                            lengths=out_lens)

@@ -1,0 +1,368 @@
+"""Pure-python Avro Object Container File reader/writer.
+
+Reference analog: GpuAvroScan (SURVEY.md §2.6 Avro read) — the reference
+decodes Avro blocks on the GPU via cuDF.  On TPU, Avro (like CSV/JSON) is a
+host-parse format (SURVEY.md §2.10 item 10); no third-party Avro library is
+available in the image, so the container format + binary encoding are
+implemented here from the Avro 1.11 spec.  This module also powers the
+Iceberg manifest reader (manifests are Avro files).
+
+Supported: records of null/boolean/int/long/float/double/bytes/string,
+nullable unions ["null", T], arrays of primitives, logicalTypes
+date / timestamp-millis / timestamp-micros / decimal(bytes); codecs
+null + deflate.
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+MAGIC = b"Obj\x01"
+
+
+# ---------------------------------------------------------------------------
+# Binary primitives
+# ---------------------------------------------------------------------------
+
+def zigzag_encode(n: int) -> int:
+    return (n << 1) ^ (n >> 63) if n < 0 else n << 1
+
+
+def zigzag_decode(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def write_long(buf: bytearray, n: int):
+    z = zigzag_encode(n) & 0xFFFFFFFFFFFFFFFF
+    while True:
+        b = z & 0x7F
+        z >>= 7
+        if z:
+            buf.append(b | 0x80)
+        else:
+            buf.append(b)
+            return
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def read_long(self) -> int:
+        shift, acc = 0, 0
+        while True:
+            b = self.data[self.pos]
+            self.pos += 1
+            acc |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return zigzag_decode(acc)
+            shift += 7
+
+    def read_bytes(self) -> bytes:
+        n = self.read_long()
+        out = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def read_fixed(self, n: int) -> bytes:
+        out = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Schema-driven value codec
+# ---------------------------------------------------------------------------
+
+def _decode_value(r: _Reader, schema) -> Any:
+    if isinstance(schema, list):  # union
+        idx = r.read_long()
+        return _decode_value(r, schema[idx])
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            return {f["name"]: _decode_value(r, f["type"])
+                    for f in schema["fields"]}
+        if t == "array":
+            out = []
+            while True:
+                count = r.read_long()
+                if count == 0:
+                    return out
+                if count < 0:
+                    r.read_long()  # block byte size (skipped)
+                    count = -count
+                for _ in range(count):
+                    out.append(_decode_value(r, schema["items"]))
+        if t == "map":
+            out = {}
+            while True:
+                count = r.read_long()
+                if count == 0:
+                    return out
+                if count < 0:
+                    r.read_long()
+                    count = -count
+                for _ in range(count):
+                    k = r.read_bytes().decode("utf-8")
+                    out[k] = _decode_value(r, schema["values"])
+        if t == "enum":
+            return schema["symbols"][r.read_long()]
+        if t == "fixed":
+            return r.read_fixed(schema["size"])
+        return _decode_value(r, t)  # {"type": "int", "logicalType": ...}
+    if schema == "null":
+        return None
+    if schema == "boolean":
+        b = r.read_fixed(1)
+        return b != b"\x00"
+    if schema in ("int", "long"):
+        return r.read_long()
+    if schema == "float":
+        return struct.unpack("<f", r.read_fixed(4))[0]
+    if schema == "double":
+        return struct.unpack("<d", r.read_fixed(8))[0]
+    if schema == "bytes":
+        return r.read_bytes()
+    if schema == "string":
+        return r.read_bytes().decode("utf-8")
+    raise ValueError(f"unsupported avro type: {schema!r}")
+
+
+def _encode_value(buf: bytearray, schema, v):
+    if isinstance(schema, list):  # union: pick first matching branch
+        for i, branch in enumerate(schema):
+            if (v is None) == (branch == "null"):
+                write_long(buf, i)
+                _encode_value(buf, branch, v)
+                return
+        raise ValueError(f"no union branch for {v!r} in {schema!r}")
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            for f in schema["fields"]:
+                _encode_value(buf, f["type"], v[f["name"]])
+            return
+        if t == "array":
+            if v:
+                write_long(buf, len(v))
+                for x in v:
+                    _encode_value(buf, schema["items"], x)
+            write_long(buf, 0)
+            return
+        if t == "map":
+            if v:
+                write_long(buf, len(v))
+                for k, x in v.items():
+                    kb = k.encode("utf-8")
+                    write_long(buf, len(kb))
+                    buf.extend(kb)
+                    _encode_value(buf, schema["values"], x)
+            write_long(buf, 0)
+            return
+        if t == "enum":
+            write_long(buf, schema["symbols"].index(v))
+            return
+        if t == "fixed":
+            buf.extend(v)
+            return
+        _encode_value(buf, t, v)
+        return
+    if schema == "null":
+        return
+    if schema == "boolean":
+        buf.append(1 if v else 0)
+        return
+    if schema in ("int", "long"):
+        write_long(buf, int(v))
+        return
+    if schema == "float":
+        buf.extend(struct.pack("<f", v))
+        return
+    if schema == "double":
+        buf.extend(struct.pack("<d", v))
+        return
+    if schema == "bytes":
+        write_long(buf, len(v))
+        buf.extend(v)
+        return
+    if schema == "string":
+        b = v.encode("utf-8")
+        write_long(buf, len(b))
+        buf.extend(b)
+        return
+    raise ValueError(f"unsupported avro type: {schema!r}")
+
+
+# ---------------------------------------------------------------------------
+# Container files
+# ---------------------------------------------------------------------------
+
+def read_avro_file(path: str) -> Tuple[dict, List[dict]]:
+    """-> (parsed schema json, records as dicts)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:4] != MAGIC:
+        raise ValueError(f"{path}: not an Avro object container file")
+    r = _Reader(data)
+    r.pos = 4
+    meta: Dict[str, bytes] = {}
+    while True:
+        count = r.read_long()
+        if count == 0:
+            break
+        if count < 0:
+            r.read_long()
+            count = -count
+        for _ in range(count):
+            k = r.read_bytes().decode("utf-8")
+            meta[k] = r.read_bytes()
+    sync = r.read_fixed(16)
+    schema = json.loads(meta["avro.schema"].decode("utf-8"))
+    codec = meta.get("avro.codec", b"null").decode("utf-8")
+    records: List[dict] = []
+    while r.pos < len(data):
+        count = r.read_long()
+        size = r.read_long()
+        block = r.read_fixed(size)
+        if codec == "deflate":
+            block = zlib.decompress(block, -15)
+        elif codec != "null":
+            raise ValueError(f"unsupported avro codec: {codec}")
+        br = _Reader(block)
+        for _ in range(count):
+            records.append(_decode_value(br, schema))
+        marker = r.read_fixed(16)
+        if marker != sync:
+            raise ValueError(f"{path}: sync marker mismatch")
+    return schema, records
+
+
+def write_avro_file(path: str, schema: dict, records: List[dict],
+                    codec: str = "null", sync: Optional[bytes] = None):
+    sync = sync or os.urandom(16)
+    buf = bytearray(MAGIC)
+    meta = {"avro.schema": json.dumps(schema).encode("utf-8"),
+            "avro.codec": codec.encode("utf-8")}
+    write_long(buf, len(meta))
+    for k, v in meta.items():
+        kb = k.encode("utf-8")
+        write_long(buf, len(kb))
+        buf.extend(kb)
+        write_long(buf, len(v))
+        buf.extend(v)
+    write_long(buf, 0)
+    buf.extend(sync)
+    body = bytearray()
+    for rec in records:
+        _encode_value(body, schema, rec)
+    block = bytes(body)
+    if codec == "deflate":
+        c = zlib.compressobj(9, zlib.DEFLATED, -15)
+        block = c.compress(block) + c.flush()
+    elif codec != "null":
+        raise ValueError(f"unsupported avro codec: {codec}")
+    write_long(buf, len(records))
+    write_long(buf, len(block))
+    buf.extend(block)
+    buf.extend(sync)
+    with open(path, "wb") as f:
+        f.write(bytes(buf))
+
+
+# ---------------------------------------------------------------------------
+# Schema mapping to the engine's type system
+# ---------------------------------------------------------------------------
+
+def avro_schema_to_struct(schema: dict):
+    """Avro record schema -> StructType (logicalTypes honored)."""
+    from spark_rapids_tpu import types as T
+
+    def field_type(s) -> Tuple[Any, bool]:
+        nullable = False
+        if isinstance(s, list):
+            branches = [b for b in s if b != "null"]
+            nullable = len(branches) < len(s)
+            if len(branches) != 1:
+                raise ValueError(f"unsupported avro union: {s!r}")
+            s = branches[0]
+        if isinstance(s, dict):
+            lt = s.get("logicalType")
+            t = s["type"]
+            if lt == "date" and t == "int":
+                return T.DATE, nullable
+            if lt in ("timestamp-micros", "timestamp-millis") and t == "long":
+                return T.TIMESTAMP, nullable
+            if lt == "decimal":
+                return T.DecimalType(s.get("precision", 38),
+                                     s.get("scale", 0)), nullable
+            if t == "array":
+                et, en = field_type(s["items"])
+                return T.ArrayType(et, containsNull=en), nullable
+            if t == "record":
+                inner = avro_schema_to_struct(s)
+                return inner, nullable
+            s = t
+        prim = {"boolean": T.BOOLEAN, "int": T.INT, "long": T.LONG,
+                "float": T.FLOAT, "double": T.DOUBLE, "string": T.STRING,
+                "bytes": T.BINARY}
+        if s in prim:
+            return prim[s], nullable
+        raise ValueError(f"unsupported avro type: {s!r}")
+
+    fields = []
+    for f in schema["fields"]:
+        dt, nullable = field_type(f["type"])
+        fields.append(T.StructField(f["name"], dt, nullable))
+    return T.StructType(fields)
+
+
+def _convert_cell(v, s):
+    """Avro-decoded value -> engine python value for HostColumn."""
+    import datetime as _dt
+    from decimal import Decimal
+
+    from spark_rapids_tpu import types as T
+
+    if v is None:
+        return None
+    if isinstance(s, T.DateType):
+        return _dt.date(1970, 1, 1) + _dt.timedelta(days=v)
+    if isinstance(s, T.DecimalType):
+        unscaled = int.from_bytes(v, "big", signed=True)
+        return Decimal(unscaled).scaleb(-s.scale)
+    return v
+
+
+def read_avro_columns(path: str, schema_struct=None):
+    """Read an Avro file into (HostColumns, StructType).
+
+    Timestamp-millis values are normalized to microseconds."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.column import HostColumn
+
+    avro_schema, records = read_avro_file(path)
+    struct = schema_struct or avro_schema_to_struct(avro_schema)
+    # detect millis fields for normalization
+    millis = set()
+    for f in avro_schema["fields"]:
+        s = f["type"]
+        if isinstance(s, list):
+            s = next((b for b in s if b != "null"), None)
+        if isinstance(s, dict) and s.get("logicalType") == "timestamp-millis":
+            millis.add(f["name"])
+    cols = []
+    for f in struct.fields:
+        vals = []
+        for rec in records:
+            v = _convert_cell(rec.get(f.name), f.dataType)
+            if v is not None and f.name in millis:
+                v = v * 1000
+            vals.append(v)
+        cols.append(HostColumn.from_pylist(vals, f.dataType))
+    return cols, struct

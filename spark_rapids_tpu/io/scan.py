@@ -122,6 +122,13 @@ class TpuFileSourceScanExec(TpuExec):
                 import pyarrow.json as pajson
 
                 tbl = pajson.read_json(path)
+            elif self.plan.fmt == "avro":
+                from spark_rapids_tpu.io.avro import read_avro_columns
+
+                cols, struct = read_avro_columns(path, self.plan.output)
+                tbl = pa.table(
+                    {f.name: c.to_arrow()
+                     for f, c in zip(struct.fields, cols)})
             else:
                 raise NotImplementedError(self.plan.fmt)
         return tbl

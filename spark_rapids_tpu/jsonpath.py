@@ -1,0 +1,438 @@
+"""JSON path engine — the host half of the JSON expression family.
+
+Reference analog: spark-rapids-jni ``get_json_object.cu`` + Spark's
+``JsonExpressions.scala`` (JsonPathParser / GetJsonObject evaluatePath).
+The reference evaluates JSON paths in a CUDA kernel; the TPU build keeps
+JSON on the host (SURVEY.md §2.10 item 10: "CSV/JSON parsers — host parse →
+device") behind ``jax.pure_callback``, with a native C++ port of this exact
+state machine in native/host_kernels.cpp for speed.
+
+Semantics notes (documented TypeSig notes, mirroring the reference's own
+get_json_object compatibility docs):
+  * nested object/array results are whitespace-compacted from the source
+    text; Spark (Jackson) re-serializes, which also normalizes string
+    escapes — inputs with non-canonical escapes inside nested results may
+    differ.
+  * a terminal JSON ``null`` yields SQL NULL.
+  * wildcard paths (``[*]``, ``.*``) are rejected at plan time (CPU
+    fallback), like the reference transpiler-reject path for regex.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+_WS = b" \t\n\r"
+_DELIM = b",}] \t\n\r"
+
+PathStep = Union[str, int]
+
+
+def parse_json_path(path) -> Optional[List[PathStep]]:
+    """Parse a Spark JSON path into [key|index] steps.
+
+    Returns None for an INVALID path (Spark: result is NULL for every row),
+    raises UnsupportedJsonPath for wildcards (plan-time CPU fallback).
+    """
+    if not isinstance(path, str) or not path.startswith("$"):
+        return None
+    steps: List[PathStep] = []
+    i, L = 1, len(path)
+    while i < L:
+        c = path[i]
+        if c == ".":
+            i += 1
+            j = i
+            while j < L and path[j] not in ".[":
+                j += 1
+            name = path[i:j]
+            if not name:
+                return None
+            if name == "*":
+                raise UnsupportedJsonPath("wildcard field .*")
+            steps.append(name)
+            i = j
+        elif c == "[":
+            j = path.find("]", i)
+            if j < 0:
+                return None
+            inner = path[i + 1:j]
+            if inner == "*":
+                raise UnsupportedJsonPath("wildcard subscript [*]")
+            if len(inner) >= 2 and inner[0] == "'" and inner[-1] == "'":
+                steps.append(inner[1:-1])
+            else:
+                try:
+                    steps.append(int(inner))
+                except ValueError:
+                    return None
+                if steps[-1] < 0:
+                    return None
+            i = j + 1
+        else:
+            return None
+    return steps
+
+
+class UnsupportedJsonPath(Exception):
+    """Wildcard (or otherwise un-accelerated) path: plan-time fallback."""
+
+
+# ---------------------------------------------------------------------------
+# Byte-level evaluator (ported verbatim to C++ in native/host_kernels.cpp)
+# ---------------------------------------------------------------------------
+
+def _skip_ws(b: bytes, i: int) -> int:
+    L = len(b)
+    while i < L and b[i] in _WS:
+        i += 1
+    return i
+
+
+def _string_end(b: bytes, i: int) -> int:
+    """b[i] == '\"'; index one past the closing quote, or -1."""
+    L = len(b)
+    i += 1
+    while i < L:
+        c = b[i]
+        if c == 0x5C:  # backslash
+            i += 2
+            continue
+        if c == 0x22:
+            return i + 1
+        i += 1
+    return -1
+
+
+_MAX_DEPTH = 256
+
+
+def _skip_value(b: bytes, i: int, depth: int = 0) -> int:
+    """Index one past the JSON value starting at (ws-skipped) i, or -1.
+
+    VALIDATES as it goes (strings incl. escapes, scalars, structure):
+    Spark's Jackson streaming fails on any malformed token it passes over,
+    so a skip that merely bracket-matched would diverge on bad documents.
+    """
+    if depth > _MAX_DEPTH:
+        return -1
+    L = len(b)
+    i = _skip_ws(b, i)
+    if i >= L:
+        return -1
+    c = b[i]
+    if c == 0x22:
+        e = _string_end(b, i)
+        if e < 0 or _unescape(b[i + 1:e - 1]) is None:
+            return -1
+        return e
+    if c == 0x7B:  # {
+        i = _skip_ws(b, i + 1)
+        if i < L and b[i] == 0x7D:
+            return i + 1
+        while True:
+            i = _skip_ws(b, i)
+            if i >= L or b[i] != 0x22:
+                return -1
+            ke = _string_end(b, i)
+            if ke < 0 or _unescape(b[i + 1:ke - 1]) is None:
+                return -1
+            i = _skip_ws(b, ke)
+            if i >= L or b[i] != 0x3A:
+                return -1
+            e = _skip_value(b, i + 1, depth + 1)
+            if e < 0:
+                return -1
+            i = _skip_ws(b, e)
+            if i >= L:
+                return -1
+            if b[i] == 0x2C:
+                i += 1
+                continue
+            if b[i] == 0x7D:
+                return i + 1
+            return -1
+    if c == 0x5B:  # [
+        i = _skip_ws(b, i + 1)
+        if i < L and b[i] == 0x5D:
+            return i + 1
+        while True:
+            e = _skip_value(b, i, depth + 1)
+            if e < 0:
+                return -1
+            i = _skip_ws(b, e)
+            if i >= L:
+                return -1
+            if b[i] == 0x2C:
+                i += 1
+                continue
+            if b[i] == 0x5D:
+                return i + 1
+            return -1
+    j = i
+    while j < L and b[j] not in _DELIM:
+        j += 1
+    if j == i or not _valid_scalar(b[i:j]):
+        return -1
+    return j
+
+
+def _unescape(raw: bytes) -> Optional[bytes]:
+    """JSON string-body unescape (handles \\uXXXX incl. surrogate pairs)."""
+    if 0x5C not in raw:
+        return raw
+    out = bytearray()
+    i, L = 0, len(raw)
+    while i < L:
+        c = raw[i]
+        if c != 0x5C:
+            out.append(c)
+            i += 1
+            continue
+        if i + 1 >= L:
+            return None
+        e = raw[i + 1]
+        i += 2
+        simple = {0x22: 0x22, 0x5C: 0x5C, 0x2F: 0x2F, 0x62: 8, 0x66: 12,
+                  0x6E: 10, 0x72: 13, 0x74: 9}
+        if e in simple:
+            out.append(simple[e])
+            continue
+        if e != 0x75:  # u
+            return None
+        if i + 4 > L:
+            return None
+        try:
+            cp = int(raw[i:i + 4], 16)
+        except ValueError:
+            return None
+        i += 4
+        if 0xD800 <= cp <= 0xDBFF and i + 6 <= L and raw[i:i + 2] == b"\\u":
+            try:
+                lo = int(raw[i + 2:i + 6], 16)
+            except ValueError:
+                lo = -1
+            if 0xDC00 <= lo <= 0xDFFF:
+                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00)
+                i += 6
+        try:
+            out += chr(cp).encode("utf-8")
+        except (ValueError, UnicodeEncodeError):
+            return None
+    return bytes(out)
+
+
+def _compact(raw: bytes) -> Optional[bytes]:
+    """Strip whitespace outside strings (Jackson-compact analog)."""
+    out = bytearray()
+    i, L = 0, len(raw)
+    while i < L:
+        c = raw[i]
+        if c == 0x22:
+            e = _string_end(raw, i)
+            if e < 0:
+                return None
+            out += raw[i:e]
+            i = e
+            continue
+        if c in _WS:
+            i += 1
+            continue
+        out.append(c)
+        i += 1
+    return bytes(out)
+
+
+def _valid_scalar(raw: bytes) -> bool:
+    if raw in (b"true", b"false", b"null"):
+        return True
+    # JSON number grammar
+    i, L = 0, len(raw)
+    if i < L and raw[i] == 0x2D:
+        i += 1
+    start = i
+    while i < L and 0x30 <= raw[i] <= 0x39:
+        i += 1
+    if i == start:
+        return False
+    if i < L and raw[i] == 0x2E:
+        i += 1
+        start = i
+        while i < L and 0x30 <= raw[i] <= 0x39:
+            i += 1
+        if i == start:
+            return False
+    if i < L and raw[i] in (0x65, 0x45):
+        i += 1
+        if i < L and raw[i] in (0x2B, 0x2D):
+            i += 1
+        start = i
+        while i < L and 0x30 <= raw[i] <= 0x39:
+            i += 1
+        if i == start:
+            return False
+    return i == L
+
+
+def _navigate(b: bytes, i: int, steps: List[PathStep],
+              si: int) -> Optional[Tuple[int, int]]:
+    """Span (start, end) of the value addressed by steps[si:], or None."""
+    L = len(b)
+    i = _skip_ws(b, i)
+    if si == len(steps):
+        e = _skip_value(b, i)
+        if e < 0:
+            return None
+        return (i, e)
+    if i >= L:
+        return None
+    step = steps[si]
+    if isinstance(step, str):
+        if b[i] != 0x7B:  # {
+            return None
+        i += 1
+        while True:
+            i = _skip_ws(b, i)
+            if i >= L or b[i] == 0x7D:
+                return None
+            if b[i] != 0x22:
+                return None
+            ke = _string_end(b, i)
+            if ke < 0:
+                return None
+            key = _unescape(b[i + 1:ke - 1])
+            if key is None:
+                return None
+            i2 = _skip_ws(b, ke)
+            if i2 >= L or b[i2] != 0x3A:  # :
+                return None
+            i2 += 1
+            if key.decode("utf-8", "replace") == step:
+                return _navigate(b, i2, steps, si + 1)
+            e = _skip_value(b, i2)
+            if e < 0:
+                return None
+            i = _skip_ws(b, e)
+            if i >= L:
+                return None
+            if b[i] == 0x2C:  # ,
+                i += 1
+            elif b[i] != 0x7D:
+                return None
+    else:
+        if b[i] != 0x5B:  # [
+            return None
+        i += 1
+        for _ in range(step):
+            i = _skip_ws(b, i)
+            if i >= L or b[i] == 0x5D:
+                return None
+            e = _skip_value(b, i)
+            if e < 0:
+                return None
+            i = _skip_ws(b, e)
+            if i >= L or b[i] != 0x2C:
+                return None
+            i += 1
+        i = _skip_ws(b, i)
+        if i >= L or b[i] == 0x5D:
+            return None
+        return _navigate(b, i, steps, si + 1)
+
+
+def get_json_object_bytes(doc: bytes,
+                          steps: List[PathStep]) -> Optional[bytes]:
+    """Evaluate path; result bytes or None (SQL NULL)."""
+    span = _navigate(doc, 0, steps, 0)
+    if span is None:
+        return None
+    s, e = span
+    c = doc[s]
+    if c == 0x22:
+        return _unescape(doc[s + 1:e - 1])
+    raw = doc[s:e]
+    if c in (0x7B, 0x5B):
+        return _compact(raw)
+    if raw == b"null":
+        return None
+    if not _valid_scalar(raw):
+        return None
+    return raw
+
+
+def _terminal_bytes(doc: bytes, s: int, e: int) -> Optional[bytes]:
+    """Extracted value span -> result bytes (string unescape / compact /
+    raw scalar), or None for JSON null."""
+    c = doc[s]
+    if c == 0x22:
+        return _unescape(doc[s + 1:e - 1])
+    raw = doc[s:e]
+    if c in (0x7B, 0x5B):
+        return _compact(raw)
+    if raw == b"null":
+        return None
+    if not _valid_scalar(raw):
+        return None
+    return raw
+
+
+def json_tuple_bytes(doc: bytes,
+                     keys: List[str]) -> List[Optional[bytes]]:
+    """One top-level pass filling every requested key (Spark JsonTuple:
+    a parse failure anywhere nulls the whole row; a later duplicate key
+    overwrites an earlier one)."""
+    out: List[Optional[bytes]] = [None] * len(keys)
+    idx_of = {}
+    for i, k in enumerate(keys):
+        idx_of.setdefault(k, []).append(i)
+    L = len(doc)
+    i = _skip_ws(doc, 0)
+    if i >= L or doc[i] != 0x7B:
+        return out
+    i += 1
+    none_row = [None] * len(keys)
+    while True:
+        i = _skip_ws(doc, i)
+        if i >= L:
+            return list(none_row)
+        if doc[i] == 0x7D:
+            return out
+        if doc[i] != 0x22:
+            return list(none_row)
+        ke = _string_end(doc, i)
+        if ke < 0:
+            return list(none_row)
+        key = _unescape(doc[i + 1:ke - 1])
+        if key is None:
+            return list(none_row)
+        i = _skip_ws(doc, ke)
+        if i >= L or doc[i] != 0x3A:
+            return list(none_row)
+        i += 1
+        vs = _skip_ws(doc, i)
+        e = _skip_value(doc, vs)
+        if e < 0:
+            return list(none_row)
+        slots = idx_of.get(key.decode("utf-8", "replace"))
+        if slots:
+            val = _terminal_bytes(doc, vs, e)
+            for sl in slots:
+                out[sl] = val
+        i = _skip_ws(doc, e)
+        if i >= L:
+            return list(none_row)
+        if doc[i] == 0x2C:
+            i += 1
+        elif doc[i] != 0x7D:
+            return list(none_row)
+
+
+def get_json_object_str(doc: str, path: str) -> Optional[str]:
+    """Convenience wrapper (oracle cross-checks + doctests)."""
+    try:
+        steps = parse_json_path(path)
+    except UnsupportedJsonPath:
+        return None
+    if steps is None:
+        return None
+    out = get_json_object_bytes(doc.encode("utf-8"), steps)
+    return None if out is None else out.decode("utf-8", "replace")

@@ -70,6 +70,9 @@ def get_lib():
                                              ctypes.c_int64, u8p]
             lib.padded_to_ragged.argtypes = [u8p, i32p, ctypes.c_int64,
                                              ctypes.c_int64, u8p, i64p]
+            lib.get_json_object_padded.argtypes = [
+                u8p, i32p, u8p, ctypes.c_int64, ctypes.c_int64,
+                u8p, ctypes.c_int64, u8p, i32p, u8p]
         except Exception:
             # stale/incompatible .so: fall back to the python paths
             return None
@@ -128,6 +131,61 @@ def padded_to_ragged(chars: np.ndarray, lengths: np.ndarray):
             pos += ln
         offsets[i + 1] = pos
     return out, offsets
+
+
+def _serialize_json_steps(steps) -> np.ndarray:
+    """[key|index] steps -> the C kernel's tag/u32/bytes blob."""
+    import struct
+
+    blob = bytearray()
+    for s in steps:
+        if isinstance(s, str):
+            b = s.encode("utf-8")
+            blob += b"k" + struct.pack("<I", len(b)) + b
+        else:
+            blob += b"i" + struct.pack("<I", int(s))
+    return np.frombuffer(bytes(blob), np.uint8) if blob else np.zeros(
+        0, np.uint8)
+
+
+def get_json_object_padded(chars: np.ndarray, lengths: np.ndarray,
+                           validity: np.ndarray, steps):
+    """Evaluate one JSON path over a padded char matrix.
+
+    Returns (out_chars, out_lengths, out_valid); invalid/unmatched rows are
+    null.  Native C++ engine when available, else the Python engine in
+    spark_rapids_tpu/jsonpath.py (the semantic spec both must match)."""
+    rows, width = chars.shape
+    out_chars = np.zeros((rows, width), np.uint8)
+    out_lens = np.zeros(rows, np.int32)
+    out_valid = np.zeros(rows, np.bool_)
+    lib = get_lib()
+    if lib is not None and rows:
+        blob = np.ascontiguousarray(_serialize_json_steps(steps))
+        chars_c = np.ascontiguousarray(chars)
+        lens_c = np.ascontiguousarray(lengths, np.int32)
+        valid_c = np.ascontiguousarray(validity, np.uint8)
+        lib.get_json_object_padded(
+            _p(chars_c, ctypes.c_uint8), _p(lens_c, ctypes.c_int32),
+            _p(valid_c, ctypes.c_uint8), rows, width,
+            _p(blob, ctypes.c_uint8), len(blob),
+            _p(out_chars, ctypes.c_uint8), _p(out_lens, ctypes.c_int32),
+            _p(out_valid.view(np.uint8), ctypes.c_uint8))
+        return out_chars, out_lens, out_valid
+    from spark_rapids_tpu.jsonpath import get_json_object_bytes
+
+    for i in range(rows):
+        if not validity[i]:
+            continue
+        doc = bytes(chars[i, :lengths[i]])
+        res = get_json_object_bytes(doc, list(steps))
+        if res is None:
+            continue
+        res = res[:width]
+        out_chars[i, :len(res)] = np.frombuffer(res, np.uint8)
+        out_lens[i] = len(res)
+        out_valid[i] = True
+    return out_chars, out_lens, out_valid
 
 
 def _selftest():

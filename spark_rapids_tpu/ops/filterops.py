@@ -28,8 +28,8 @@ def compact_columns(mask: jax.Array,
     count = jnp.where(n > 0, positions[-1] + 1, 0).astype(jnp.int32)
     # rows not kept scatter out of bounds -> dropped
     scatter_idx = jnp.where(mask, positions, n)
-    out = []
-    for c in cols:
+
+    def _compact(c: DeviceColumn) -> DeviceColumn:
         validity = jnp.zeros_like(c.validity).at[scatter_idx].set(
             c.validity, mode="drop")
         if c.is_string:
@@ -37,40 +37,48 @@ def compact_columns(mask: jax.Array,
                 c.chars, mode="drop")
             lengths = jnp.zeros_like(c.lengths).at[scatter_idx].set(
                 c.lengths, mode="drop")
-            out.append(DeviceColumn(c.dtype, validity, chars=chars,
-                                    lengths=lengths))
-        elif c.is_array:
+            return DeviceColumn(c.dtype, validity, chars=chars,
+                                lengths=lengths)
+        if c.is_array:
             data = jnp.zeros_like(c.data).at[scatter_idx].set(
                 c.data, mode="drop")
             lengths = jnp.zeros_like(c.lengths).at[scatter_idx].set(
                 c.lengths, mode="drop")
             ev = jnp.zeros_like(c.elem_valid).at[scatter_idx].set(
                 c.elem_valid, mode="drop")
-            out.append(DeviceColumn(c.dtype, validity, data=data,
-                                    lengths=lengths, elem_valid=ev))
-        else:
-            data = jnp.zeros_like(c.data).at[scatter_idx].set(
-                c.data, mode="drop")
-            out.append(DeviceColumn(c.dtype, validity, data=data))
-    return out, count
+            return DeviceColumn(c.dtype, validity, data=data,
+                                lengths=lengths, elem_valid=ev)
+        if c.is_struct:
+            return DeviceColumn(c.dtype, validity,
+                                children=tuple(_compact(k)
+                                               for k in c.children))
+        data = jnp.zeros_like(c.data).at[scatter_idx].set(
+            c.data, mode="drop")
+        return DeviceColumn(c.dtype, validity, data=data)
+
+    return [_compact(c) for c in cols], count
 
 
 def gather_columns(indices: jax.Array, valid_out: jax.Array,
                    cols: List[DeviceColumn]) -> List[DeviceColumn]:
     """Row gather (the JoinGatherer primitive): out[i] = col[indices[i]],
     with rows where ``valid_out`` is False nulled (used for outer joins)."""
-    out = []
     n = cols[0].capacity if cols else 0
     safe = jnp.clip(indices, 0, max(n - 1, 0))
-    for c in cols:
+
+    def _gather(c: DeviceColumn) -> DeviceColumn:
         validity = c.validity[safe] & valid_out
         if c.is_string:
-            out.append(DeviceColumn(c.dtype, validity, chars=c.chars[safe],
-                                    lengths=c.lengths[safe]))
-        elif c.is_array:
-            out.append(DeviceColumn(c.dtype, validity, data=c.data[safe],
-                                    lengths=c.lengths[safe],
-                                    elem_valid=c.elem_valid[safe]))
-        else:
-            out.append(DeviceColumn(c.dtype, validity, data=c.data[safe]))
-    return out
+            return DeviceColumn(c.dtype, validity, chars=c.chars[safe],
+                                lengths=c.lengths[safe])
+        if c.is_array:
+            return DeviceColumn(c.dtype, validity, data=c.data[safe],
+                                lengths=c.lengths[safe],
+                                elem_valid=c.elem_valid[safe])
+        if c.is_struct:
+            return DeviceColumn(c.dtype, validity,
+                                children=tuple(_gather(k)
+                                               for k in c.children))
+        return DeviceColumn(c.dtype, validity, data=c.data[safe])
+
+    return [_gather(c) for c in cols]

@@ -79,6 +79,20 @@ class ExprMeta(BaseMeta):
                 self.will_not_work_on_tpu(
                     f"expression {self.name} input: "
                     + sig.reason_not_supported(cdt))
+        # nested-type element constraints (TypeSig recursion is too loose:
+        # it would admit array<string> because StringType is in the sig)
+        from spark_rapids_tpu.overrides.overrides import (
+            unsupported_nested_reason,
+        )
+
+        for d in [dt] + [c._dataType for c in self.expr.children]:
+            if d is None:
+                continue
+            reason = unsupported_nested_reason(d)
+            if reason:
+                self.will_not_work_on_tpu(
+                    f"expression {self.name}: {reason}")
+                break
         if self.rule.extra_check is not None:
             self.rule.extra_check(self)
 
@@ -130,11 +144,21 @@ class SparkPlanMeta(BaseMeta):
                 f"spark.rapids.sql.exec.{self.name}=false")
         # output type check
         sig: T.TypeSig = self.rule.type_sig
+        from spark_rapids_tpu.overrides.overrides import (
+            unsupported_nested_reason,
+        )
+
         for f in self.plan.output.fields:
             if not sig.supports(f.dataType):
                 self.will_not_work_on_tpu(
                     f"exec {self.name} output column '{f.name}': "
                     + sig.reason_not_supported(f.dataType))
+            else:
+                reason = unsupported_nested_reason(f.dataType)
+                if reason:
+                    self.will_not_work_on_tpu(
+                        f"exec {self.name} output column '{f.name}': "
+                        + reason)
         # expression checks
         if self.rule.tag_exprs is not None:
             self.add_expr_metas(self.rule.tag_exprs(self.plan))

@@ -27,6 +27,9 @@ from spark_rapids_tpu.expr import collections as CL
 from spark_rapids_tpu.expr import conditional as CO
 from spark_rapids_tpu.expr import datetime as DT
 from spark_rapids_tpu.expr import hashexprs as H
+from spark_rapids_tpu.expr import complextypes as CT
+from spark_rapids_tpu.expr import hof as HOF
+from spark_rapids_tpu.expr import jsonexprs as J
 from spark_rapids_tpu.expr import mathfuncs as M
 from spark_rapids_tpu.expr import predicates as P
 from spark_rapids_tpu.expr import strings as S
@@ -281,6 +284,142 @@ def _check_pad(meta: ExprMeta):
         meta.will_not_work_on_tpu("empty pad string is not supported on TPU")
 
 
+# structs of primitives/strings (device struct columns, columnar/column.py)
+_STRUCT_SIG = (T.TypeSig(frozenset({T.StructType})) + T.BOOLEAN_SIG
+               + T.INTEGRAL_SIG + T.FP_SIG + T.STRING_SIG
+               + T.DATETIME_SIG + T.NULL_SIG)
+
+_PRIM_ELEM = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,
+              T.LongType, T.FloatType, T.DoubleType, T.DateType,
+              T.TimestampType)
+
+
+def unsupported_nested_reason(dt) -> Optional[str]:
+    """Why a nested type cannot live in device columns yet, or None.
+
+    Array elements and map keys/values must be flat primitives (the padded
+    list layout stores one numeric matrix); struct fields may additionally
+    be strings.  TypeSig.supports recurses with the FULL kind set, which
+    would wrongly admit array<string>, so every rule whose sig includes
+    nested kinds routes through this check."""
+    if isinstance(dt, T.ArrayType):
+        et = dt.elementType
+        if isinstance(et, T.DecimalType):
+            return None if not et.is_128 else \
+                f"{dt.simpleString}: decimal128 array elements"
+        if not isinstance(et, _PRIM_ELEM):
+            return (f"{dt.simpleString}: array elements must be flat "
+                    f"primitives on TPU")
+        return None
+    if isinstance(dt, T.MapType):
+        for part, name in ((dt.keyType, "key"), (dt.valueType, "value")):
+            if isinstance(part, T.DecimalType):
+                if part.is_128:
+                    return f"{dt.simpleString}: decimal128 map {name}s"
+            elif not isinstance(part, _PRIM_ELEM):
+                return (f"{dt.simpleString}: map {name}s must be flat "
+                        f"primitives on TPU")
+        return None
+    if isinstance(dt, T.StructType):
+        for f in dt.fields:
+            if isinstance(f.dataType, (T.ArrayType, T.MapType,
+                                       T.StructType)):
+                return (f"{dt.simpleString}: nested field "
+                        f"{f.name} inside a struct")
+        return None
+    return None
+
+
+# maps with primitive keys/values (keys/values array-column pair)
+_WITH_MAPS = (T.TypeSig(frozenset({T.MapType, T.ArrayType}))
+              + T.BOOLEAN_SIG + T.INTEGRAL_SIG + T.FP_SIG
+              + T.DATETIME_SIG + T.NULL_SIG).with_note(
+    T.MapType, "primitive keys/values only (no strings yet)")
+
+
+def _check_hof(meta: ExprMeta):
+    """Tag the lambda body's expressions too (it is not a regular child)."""
+    body_meta = wrap_expr(meta.expr.body, meta.conf)
+    body_meta.tag_for_tpu()
+    if not body_meta.can_run_with_children:
+        for r in body_meta.all_reasons():
+            meta.will_not_work_on_tpu(f"lambda body: {r}")
+
+
+def _check_hof_agg(meta: ExprMeta):
+    e = meta.expr
+    merge_meta = wrap_expr(e.merge, meta.conf)
+    merge_meta.tag_for_tpu()
+    if not merge_meta.can_run_with_children:
+        for r in merge_meta.all_reasons():
+            meta.will_not_work_on_tpu(f"merge lambda: {r}")
+    if e.finish is not None:
+        fin_meta = wrap_expr(e.finish, meta.conf)
+        fin_meta.tag_for_tpu()
+        if not fin_meta.can_run_with_children:
+            for r in fin_meta.all_reasons():
+                meta.will_not_work_on_tpu(f"finish lambda: {r}")
+    if e.merge.resolved and e.children[1].resolved \
+            and type(e.merge.dataType) is not type(e.children[1].dataType):
+        meta.will_not_work_on_tpu(
+            "aggregate: merge result type must match the zero value type")
+    if e.children[1].resolved and isinstance(
+            e.children[1].dataType,
+            (T.StringType, T.ArrayType, T.MapType, T.StructType)):
+        meta.will_not_work_on_tpu(
+            "aggregate: accumulator must be a flat primitive on TPU")
+
+
+def _check_json_path(meta: ExprMeta):
+    """Literal, non-wildcard JSON path (the reference's GpuGetJsonObject
+    likewise falls back for non-literal paths)."""
+    from spark_rapids_tpu.jsonpath import UnsupportedJsonPath, parse_json_path
+
+    p = meta.expr.children[1]
+    if not isinstance(p, E.Literal):
+        meta.will_not_work_on_tpu(
+            "get_json_object: only literal JSON paths are supported")
+        return
+    if p.value is None:
+        return
+    try:
+        parse_json_path(p.value)
+    except UnsupportedJsonPath as ex:
+        meta.will_not_work_on_tpu(f"get_json_object: {ex} is not supported")
+
+
+def _check_json_tuple(meta: ExprMeta):
+    for k in meta.expr.children[1:]:
+        if not isinstance(k, E.Literal):
+            meta.will_not_work_on_tpu(
+                "json_tuple: only literal field names are supported")
+            return
+
+
+_FLAT_STRUCT_OK = (T.StringType, T.BooleanType, T.ByteType, T.ShortType,
+                   T.IntegerType, T.LongType, T.FloatType, T.DoubleType)
+
+
+def _check_flat_struct(meta: ExprMeta, st, what: str):
+    if not isinstance(st, T.StructType):
+        meta.will_not_work_on_tpu(f"{what}: requires a struct schema")
+        return
+    for f in st.fields:
+        if not isinstance(f.dataType, _FLAT_STRUCT_OK):
+            meta.will_not_work_on_tpu(
+                f"{what}: field {f.name} of type "
+                f"{f.dataType.simpleString} is not supported (flat "
+                "primitive/string structs only)")
+
+
+def _check_from_json(meta: ExprMeta):
+    _check_flat_struct(meta, meta.expr.schema, "from_json")
+
+
+def _check_to_json(meta: ExprMeta):
+    _check_flat_struct(meta, meta.expr.children[0]._dataType, "to_json")
+
+
 EXPRESSIONS: Dict[Type, ExprRule] = {
     E.Literal: ExprRule(_WITH_ARRAYS, desc="constant literal"),
     E.BoundReference: ExprRule(_WITH_ARRAYS, desc="column reference"),
@@ -421,14 +560,65 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
     H.XxHash64: ExprRule(_COMMON128, desc="Spark xxhash64"),
     CL.Size: ExprRule(_WITH_ARRAYS),
     CL.GetArrayItem: ExprRule(_WITH_ARRAYS),
-    CL.ElementAt: ExprRule(_WITH_ARRAYS),
+    CL.ElementAt: ExprRule(_WITH_ARRAYS + _WITH_MAPS),
     CL.ArrayContains: ExprRule(_WITH_ARRAYS),
     CL.CreateArray: ExprRule(_WITH_ARRAYS, extra_check=_check_create_array),
     CL.ArrayMin: ExprRule(_WITH_ARRAYS),
     CL.ArrayMax: ExprRule(_WITH_ARRAYS),
+    CL.ArrayPosition: ExprRule(_WITH_ARRAYS),
+    CL.ArrayRemove: ExprRule(_WITH_ARRAYS),
+    CL.ArrayDistinct: ExprRule(_WITH_ARRAYS),
+    CL.ArraysOverlap: ExprRule(_WITH_ARRAYS),
+    CL.ArrayUnion: ExprRule(_WITH_ARRAYS),
+    CL.ArrayIntersect: ExprRule(_WITH_ARRAYS),
+    CL.ArrayExcept: ExprRule(_WITH_ARRAYS),
+    CL.Slice: ExprRule(_WITH_ARRAYS),
+    CL.SortArray: ExprRule(
+        _WITH_ARRAYS + T.BOOLEAN_SIG,
+        extra_check=_check_literal_children(1, names="ascending flag")),
+    CL.ArrayRepeat: ExprRule(
+        _WITH_ARRAYS.with_note(
+            T.ArrayType,
+            f"element count capped at {CL.ArrayRepeat.MAX_ELEMENTS}")),
+    CL.Sequence: ExprRule(
+        _WITH_ARRAYS.with_note(
+            T.ArrayType,
+            f"sequence length capped at {CL.Sequence.MAX_ELEMENTS}")),
+    HOF.ArrayTransform: ExprRule(_WITH_ARRAYS, extra_check=_check_hof),
+    HOF.ArrayFilter: ExprRule(_WITH_ARRAYS, extra_check=_check_hof),
+    HOF.ArrayExists: ExprRule(
+        _WITH_ARRAYS + T.BOOLEAN_SIG, extra_check=_check_hof),
+    HOF.ArrayForAll: ExprRule(
+        _WITH_ARRAYS + T.BOOLEAN_SIG, extra_check=_check_hof),
+    HOF.ArrayAggregate: ExprRule(_WITH_ARRAYS, extra_check=_check_hof_agg),
+    CL.CreateMap: ExprRule(_WITH_MAPS),
+    CL.MapKeys: ExprRule(_WITH_MAPS),
+    CL.MapValues: ExprRule(_WITH_MAPS),
+    CL.GetMapValue: ExprRule(_WITH_MAPS),
     U.UserDefinedExpression: ExprRule(
         _DEC128_FULL, extra_check=_check_udf,
         desc="TpuUDF (RapidsUDF analog): columnar jax kernel"),
+    J.GetJsonObject: ExprRule(
+        T.STRING_SIG.with_note(
+            T.StringType,
+            "nested results are whitespace-compacted, not re-serialized"),
+        extra_check=_check_json_path,
+        desc="JSON path extraction (native host kernel)"),
+    J.JsonTuple: ExprRule(
+        T.STRING_SIG + _STRUCT_SIG,
+        extra_check=_check_json_tuple,
+        desc="json_tuple as a struct of string fields"),
+    J.JsonToStructs: ExprRule(
+        T.STRING_SIG + _STRUCT_SIG,
+        extra_check=_check_from_json,
+        desc="from_json (PERMISSIVE) into a flat struct"),
+    J.StructsToJson: ExprRule(
+        T.STRING_SIG + _STRUCT_SIG.with_note(
+            T.StructType, "float fields may format differently than Spark"),
+        extra_check=_check_to_json,
+        desc="to_json of a flat struct"),
+    CT.GetStructField: ExprRule(_STRUCT_SIG + _DEC128_FULL),
+    CT.CreateNamedStruct: ExprRule(_STRUCT_SIG + _DEC128_FULL),
 }
 
 
@@ -530,7 +720,8 @@ def _scan_check(meta: SparkPlanMeta):
     key = {"parquet": "spark.rapids.sql.format.parquet.read.enabled",
            "csv": "spark.rapids.sql.format.csv.read.enabled",
            "json": "spark.rapids.sql.format.json.read.enabled",
-           "orc": "spark.rapids.sql.format.orc.read.enabled"}.get(fmt)
+           "orc": "spark.rapids.sql.format.orc.read.enabled",
+           "avro": "spark.rapids.sql.format.avro.read.enabled"}.get(fmt)
     if key is None:
         meta.will_not_work_on_tpu(f"format {fmt} is not supported on TPU")
         return
@@ -623,14 +814,17 @@ def _exchange_check(meta: SparkPlanMeta):
                     "supported on TPU (murmur3 big-integer path missing)")
 
 
-_exec(PN.LocalTableScan, sig=_WITH_ARRAYS)
+_WITH_NESTED = _WITH_ARRAYS + T.TypeSig(
+    frozenset({T.StructType, T.MapType}))
+
+_exec(PN.LocalTableScan, sig=_WITH_NESTED)
 _exec(PN.CachedRelation, desc="GpuInMemoryTableScanExec analog")
 _exec(PN.FileSourceScan, extra=_scan_check)
 _exec(PN.InsertIntoHadoopFsRelation, extra=_write_check,
       desc="GpuDataWritingCommandExec analog")
 _exec(PN.RangeNode)
-_exec(PN.Project, sig=_WITH_ARRAYS)
-_exec(PN.Filter, sig=_WITH_ARRAYS)
+_exec(PN.Project, sig=_WITH_NESTED)
+_exec(PN.Filter, sig=_WITH_NESTED)
 _exec(PN.HashAggregate, sig=_WITH_ARRAYS, extra=_agg_check)
 _exec(PN.SortMergeJoin, extra=_join_check,
       desc="converted to shuffled sorted join (GpuSortMergeJoinMeta analog)")

@@ -155,6 +155,20 @@ class DataFrameReader:
             PN.FileSourceScan("csv", list(paths), schema,
                               options=self._options), self.session)
 
+    def avro(self, *paths: str) -> "DataFrame":
+        if self._schema is None:
+            from spark_rapids_tpu.io.avro import (
+                avro_schema_to_struct,
+                read_avro_file,
+            )
+
+            schema = avro_schema_to_struct(read_avro_file(paths[0])[0])
+        else:
+            schema = self._schema
+        return DataFrame(
+            PN.FileSourceScan("avro", list(paths), schema,
+                              options=self._options), self.session)
+
     def orc(self, *paths: str) -> "DataFrame":
         schema = self._schema or self._infer_schema("orc", list(paths))
         return DataFrame(

@@ -205,6 +205,51 @@ class NullGen(DataGen):
         return None
 
 
+class JsonGen(DataGen):
+    """Random JSON documents with nested objects/arrays, escapes, unicode,
+    and occasional malformed docs (reference: json_test.py gens)."""
+
+    def __init__(self, nullable=True, max_depth=2, malformed_prob=0.08):
+        super().__init__(T.STRING, nullable)
+        self.max_depth = max_depth
+        self.malformed_prob = malformed_prob
+
+    def _value(self, rng, depth):
+        r = rng.random()
+        if depth > 0 and r < 0.22:
+            return {f"k{i}": self._value(rng, depth - 1)
+                    for i in range(rng.randint(0, 3))}
+        if depth > 0 and r < 0.38:
+            return [self._value(rng, depth - 1)
+                    for _ in range(rng.randint(0, 3))]
+        r = rng.random()
+        if r < 0.25:
+            return rng.randint(-10**9, 10**9)
+        if r < 0.40:
+            return round(rng.uniform(-1000, 1000), 4)
+        if r < 0.55:
+            return rng.choice([True, False])
+        if r < 0.62:
+            return None
+        n = rng.randint(0, 10)
+        chars = 'abXY01 "\\\n\t\ré€語'
+        return "".join(rng.choice(chars) for _ in range(n))
+
+    def gen_value(self, rng):
+        import json as _json
+
+        if rng.random() < self.malformed_prob:
+            return rng.choice(['not json', '{"a":', '', '[1,2', '{"a" 1}',
+                               '{"a": }'])
+        doc = {}
+        for k in ("a", "b", "c")[:rng.randint(0, 3)]:
+            doc[k] = self._value(rng, self.max_depth)
+        compact = rng.random() < 0.7
+        return _json.dumps(
+            doc, separators=(",", ":") if compact else (", ", ": "),
+            ensure_ascii=False)
+
+
 class SetValuesGen(DataGen):
     """Draw from a fixed set (for skewed keys etc.)."""
 

@@ -1,0 +1,107 @@
+"""Avro scan tests (reference: avro_test.py / GpuAvroScan)."""
+import os
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.io.avro import (
+    read_avro_file,
+    write_avro_file,
+)
+from spark_rapids_tpu.session import col
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+
+_SCHEMA = {
+    "type": "record", "name": "r", "fields": [
+        {"name": "a", "type": ["null", "long"]},
+        {"name": "b", "type": "string"},
+        {"name": "c", "type": ["null", "double"]},
+        {"name": "d", "type": {"type": "int", "logicalType": "date"}},
+        {"name": "e", "type": "boolean"},
+        {"name": "ts", "type": {"type": "long",
+                                "logicalType": "timestamp-micros"}},
+    ]}
+
+
+def _write_sample(path, n=500, seed=7, codec="null"):
+    import random
+
+    rng = random.Random(seed)
+    recs = []
+    for i in range(n):
+        recs.append({
+            "a": rng.randint(-10**12, 10**12) if rng.random() > 0.1 else None,
+            "b": "".join(rng.choice("abcdé語 ") for _ in range(rng.randint(0, 12))),
+            "c": rng.uniform(-1e6, 1e6) if rng.random() > 0.1 else None,
+            "d": rng.randint(0, 20000),
+            "e": rng.random() < 0.5,
+            "ts": rng.randint(0, 2**45),
+        })
+    write_avro_file(path, _SCHEMA, recs, codec=codec)
+    return recs
+
+
+@pytest.mark.parametrize("codec", ["null", "deflate"])
+def test_avro_roundtrip_codecs(tmp_path, codec):
+    p = str(tmp_path / f"t_{codec}.avro")
+    recs = _write_sample(p, codec=codec)
+    schema, back = read_avro_file(p)
+    assert back == recs
+
+
+def test_avro_scan_differential(tmp_path):
+    p = str(tmp_path / "t.avro")
+    _write_sample(p)
+
+    def build(s):
+        return s.read.avro(p).select(
+            col("a"), col("b"), col("c"), col("d"), col("e"), col("ts"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_avro_scan_filter_agg(tmp_path):
+    p = str(tmp_path / "t.avro")
+    _write_sample(p)
+
+    def build(s):
+        from spark_rapids_tpu.session import count_, sum_
+
+        from spark_rapids_tpu.expr.datetime import Month
+
+        df = s.read.avro(p)
+        return df.filter(col("e")).select(
+            Month(col("d")).alias("m"), col("a")).group_by("m").agg(
+            count_(None, "n"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_avro_explicit_schema_pruning(tmp_path):
+    p = str(tmp_path / "t.avro")
+    _write_sample(p)
+    sub = T.StructType([T.StructField("b", T.STRING),
+                        T.StructField("a", T.LONG)])
+
+    def build(s):
+        return s.read.schema(sub).avro(p)
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_avro_arrays(tmp_path):
+    schema = {"type": "record", "name": "r", "fields": [
+        {"name": "xs", "type": {"type": "array", "items": "int"}},
+        {"name": "k", "type": "long"}]}
+    recs = [{"xs": list(range(i % 5)), "k": i} for i in range(200)]
+    p = str(tmp_path / "arr.avro")
+    write_avro_file(p, schema, recs)
+
+    def build(s):
+        from spark_rapids_tpu.expr.collections import Size
+
+        df = s.read.avro(p)
+        return df.select(Size(col("xs")).alias("sz"), col("k"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)

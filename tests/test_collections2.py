@@ -1,0 +1,193 @@
+"""Collection breadth tests: set ops, slice/sort, sequence, maps,
+higher-order functions (reference: collection_ops_test.py,
+map_test.py, higher_order_functions_test.py)."""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.collections import (
+    ArrayDistinct,
+    ArrayExcept,
+    ArrayIntersect,
+    ArrayPosition,
+    ArrayRemove,
+    ArrayRepeat,
+    ArraysOverlap,
+    ArrayUnion,
+    CreateMap,
+    ElementAt,
+    GetMapValue,
+    MapKeys,
+    MapValues,
+    Sequence,
+    Slice,
+    SortArray,
+)
+from spark_rapids_tpu.expr.hof import (
+    ArrayAggregate,
+    ArrayExists,
+    ArrayFilter,
+    ArrayForAll,
+    ArrayTransform,
+)
+from spark_rapids_tpu.session import col, lit
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import (
+    ArrayGen,
+    DoubleGen,
+    IntegerGen,
+    LongGen,
+    gen_df,
+)
+
+_small_int = IntegerGen(min_val=-3, max_val=3)
+_arr = ArrayGen(_small_int)
+_arr_nn = ArrayGen(IntegerGen(min_val=-3, max_val=3, nullable=False))
+
+
+def test_array_position_remove():
+    def build(s):
+        df = gen_df(s, [_arr, _small_int.with_nullable(True)], ["a", "v"],
+                    length=300)
+        return df.select(ArrayPosition(col("a"), col("v")).alias("p"),
+                         ArrayRemove(col("a"), col("v")).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_array_distinct():
+    def build(s):
+        df = gen_df(s, [_arr], ["a"], length=300)
+        return df.select(ArrayDistinct(col("a")).alias("d"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_arrays_overlap_union_intersect_except():
+    def build(s):
+        df = gen_df(s, [_arr, _arr], ["a", "b"], length=300)
+        return df.select(
+            ArraysOverlap(col("a"), col("b")).alias("ov"),
+            ArrayUnion(col("a"), col("b")).alias("un"),
+            ArrayIntersect(col("a"), col("b")).alias("ix"),
+            ArrayExcept(col("a"), col("b")).alias("ex"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_array_set_ops_doubles_nan():
+    g = ArrayGen(DoubleGen())
+
+    def build(s):
+        df = gen_df(s, [g, g], ["a", "b"], length=200)
+        return df.select(ArrayUnion(col("a"), col("b")).alias("un"),
+                         ArrayDistinct(col("a")).alias("d"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_slice():
+    def build(s):
+        df = gen_df(s, [_arr,
+                        IntegerGen(min_val=-5, max_val=5, nullable=False),
+                        IntegerGen(min_val=0, max_val=4, nullable=False)],
+                    ["a", "st", "ln"], length=300)
+        # start=0 raises in Spark; keep starts nonzero
+        from spark_rapids_tpu.expr.conditional import If
+        from spark_rapids_tpu.expr.predicates import EqualTo
+
+        st = If(EqualTo(col("st"), lit(0)), lit(1), col("st"))
+        return df.select(Slice(col("a"), st, col("ln")).alias("s"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("asc", [True, False])
+def test_sort_array(asc):
+    def build(s):
+        df = gen_df(s, [_arr, ArrayGen(DoubleGen())], ["a", "d"],
+                    length=300)
+        return df.select(SortArray(col("a"), lit(asc)).alias("s"),
+                         SortArray(col("d"), lit(asc)).alias("sd"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_array_repeat_sequence():
+    def build(s):
+        df = gen_df(s, [IntegerGen(), IntegerGen(min_val=0, max_val=5,
+                                                 nullable=False),
+                        IntegerGen(min_val=0, max_val=20, nullable=False)],
+                    ["v", "n", "stop"], length=200)
+        return df.select(
+            ArrayRepeat(col("v"), col("n")).alias("rep"),
+            Sequence(lit(0), col("stop")).alias("seq"),
+            Sequence(col("stop"), lit(0), lit(-2)).alias("seq2"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_create_map_and_lookups():
+    def build(s):
+        df = gen_df(s, [IntegerGen(nullable=False), LongGen(),
+                        IntegerGen(nullable=False), LongGen()],
+                    ["k1", "v1", "k2", "v2"], length=200)
+        # ensure distinct keys: k2' = k2 + 1000 when equal to k1
+        from spark_rapids_tpu.expr.conditional import If
+        from spark_rapids_tpu.expr.predicates import EqualTo
+
+        k2 = If(EqualTo(col("k1"), col("k2")), col("k2") + lit(1000),
+                col("k2"))
+        m = CreateMap([col("k1"), col("v1"), k2, col("v2")])
+        return df.select(
+            MapKeys(m).alias("ks"),
+            MapValues(m).alias("vs"),
+            GetMapValue(m, col("k1")).alias("g1"),
+            ElementAt(m, lit(12345)).alias("missing"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_map_column_roundtrip():
+    """Map columns from input data survive the device round trip."""
+    def build(s):
+        data = {"m": [{1: 10, 2: 20}, None, {}, {5: None, 7: 70}] * 50}
+        schema = T.StructType([
+            T.StructField("m", T.MapType(T.INT, T.LONG))])
+        df = s.create_dataframe(data, schema)
+        return df.select(MapKeys(col("m")).alias("ks"),
+                         MapValues(col("m")).alias("vs"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_transform():
+    def build(s):
+        df = gen_df(s, [_arr, IntegerGen(nullable=False)], ["a", "k"],
+                    length=300)
+        body = col("x") * lit(2) + col("k")
+        return df.select(
+            ArrayTransform(col("a"), "x", body).alias("t"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_filter_exists_forall():
+    def build(s):
+        df = gen_df(s, [_arr], ["a"], length=300)
+        return df.select(
+            ArrayFilter(col("a"), "x", col("x") > lit(0)).alias("f"),
+            ArrayExists(col("a"), "x", col("x") > lit(1)).alias("e"),
+            ArrayForAll(col("a"), "x", col("x") > lit(-2)).alias("fa"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_aggregate_fold():
+    def build(s):
+        df = gen_df(s, [_arr_nn], ["a"], length=300)
+        agg = ArrayAggregate(col("a"), lit(0), "acc", "x",
+                             col("acc") + col("x"))
+        return df.select(agg.alias("s"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)

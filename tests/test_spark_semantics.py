@@ -478,3 +478,73 @@ def test_decimal_to_string_keeps_scale():
 def test_date_to_string_iso():
     _both(lambda s: _df1(s, [datetime.date(2024, 3, 7)], T.DATE).select(
         Cast(col("a"), T.STRING).alias("r")), [("2024-03-07",)])
+
+
+# -- JSON: Spark-documented get_json_object / from_json behavior -------------
+
+def test_get_json_object_null_terminal():
+    from spark_rapids_tpu.expr.jsonexprs import GetJsonObject
+    _both(lambda s: _df1(s, ['{"a":null}'], T.STRING).select(
+        GetJsonObject(col("a"), lit("$.a")).alias("r")), [(None,)])
+
+
+def test_get_json_object_nested_compacts():
+    from spark_rapids_tpu.expr.jsonexprs import GetJsonObject
+    _both(lambda s: _df1(s, ['{"a": {"b": 1, "c": [1, 2]}}'],
+                         T.STRING).select(
+        GetJsonObject(col("a"), lit("$.a")).alias("r")),
+        [('{"b":1,"c":[1,2]}',)])
+
+
+def test_get_json_object_invalid_json_is_null():
+    from spark_rapids_tpu.expr.jsonexprs import GetJsonObject
+    _both(lambda s: _df1(s, ['{"a": }'], T.STRING).select(
+        GetJsonObject(col("a"), lit("$.a")).alias("r")), [(None,)])
+
+
+def test_get_json_object_string_unescapes():
+    from spark_rapids_tpu.expr.jsonexprs import GetJsonObject
+    _both(lambda s: _df1(s, ['{"a":"x\\n\\"y\\u0041"}'], T.STRING).select(
+        GetJsonObject(col("a"), lit("$.a")).alias("r")), [('x\n"yA',)])
+
+
+def test_from_json_permissive_nulls_whole_record():
+    """An int field holding a float nulls EVERY field of the row."""
+    from spark_rapids_tpu.expr.complextypes import GetStructField
+    from spark_rapids_tpu.expr.jsonexprs import JsonToStructs
+    schema = T.StructType([T.StructField("a", T.INT),
+                           T.StructField("b", T.STRING)])
+
+    def build(s):
+        st = JsonToStructs(col("a"), schema)
+        return _df1(s, ['{"a":1.5,"b":"keep"}'], T.STRING).select(
+            GetStructField(st, "a").alias("x"),
+            GetStructField(st, "b").alias("y"))
+
+    _both(build, [(None, None)])
+
+
+def test_from_json_missing_field_is_null_only_there():
+    from spark_rapids_tpu.expr.complextypes import GetStructField
+    from spark_rapids_tpu.expr.jsonexprs import JsonToStructs
+    schema = T.StructType([T.StructField("a", T.INT),
+                           T.StructField("b", T.STRING)])
+
+    def build(s):
+        st = JsonToStructs(col("a"), schema)
+        return _df1(s, ['{"b":"only"}'], T.STRING).select(
+            GetStructField(st, "a").alias("x"),
+            GetStructField(st, "b").alias("y"))
+
+    _both(build, [(None, "only")])
+
+
+def test_to_json_omits_null_fields():
+    from spark_rapids_tpu.expr.complextypes import CreateNamedStruct
+    from spark_rapids_tpu.expr.jsonexprs import StructsToJson
+
+    def build(s):
+        st = CreateNamedStruct(["p", "q"], [col("a"), lit(None).cast(T.INT)])
+        return _df1(s, [7], T.INT).select(StructsToJson(st).alias("r"))
+
+    _both(build, [('{"p":7}',)])
