@@ -508,8 +508,13 @@ class HostColumn:
             kids = [HostColumn.from_arrow(arr.field(f.name), f.dataType)
                     for f in dtype.fields]
             return HostColumn(dtype, validity, children=kids)
-        if isinstance(dtype, (T.ArrayType, T.MapType)):
-            # list/map columns come through the python interchange (scan
+        if isinstance(dtype, T.MapType):
+            # pyarrow MapArray.to_pylist yields [(k, v), ...] pairs
+            rows = arr.to_pylist()
+            return HostColumn.from_pylist(
+                [dict(v) if v is not None else None for v in rows], dtype)
+        if isinstance(dtype, T.ArrayType):
+            # list columns come through the python interchange (scan
             # formats with nested data: parquet lists, avro arrays)
             return HostColumn.from_pylist(arr.to_pylist(), dtype)
         if isinstance(dtype, T.StringType):
@@ -551,7 +556,16 @@ class HostColumn:
         import pyarrow as pa
 
         mask = ~self.validity
-        if self.is_array or isinstance(self.dtype, T.MapType):
+        if isinstance(self.dtype, T.MapType):
+            # dict inference would require string keys; build the MapArray
+            # as [(k, v), ...] item lists instead
+            rows = self.to_pylist()
+            items = [list(d.items()) if d is not None else None
+                     for d in rows]
+            return pa.array(items, type=pa.map_(
+                self.children[0].to_arrow().type.value_type,
+                self.children[1].to_arrow().type.value_type))
+        if self.is_array:
             return pa.array(self.to_pylist())
         if self.is_struct:
             kid_arrays = [c.to_arrow() for c in self.children]

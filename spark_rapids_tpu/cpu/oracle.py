@@ -1967,6 +1967,281 @@ def _h_hashexpr(e, cols, n, ansi):
     return CpuCol(e.dataType, out, np.ones(n, np.bool_))
 
 
+def _h_utc_shift(e, cols, n, ansi):
+    """from/to_utc_timestamp via python zoneinfo — independent of the
+    device path's raw TZif tables."""
+    import datetime as pydt
+    from zoneinfo import ZoneInfo
+
+    ts, tzc = _kids(e, cols, n, ansi)
+    to_utc = type(e).__name__ == "ToUTCTimestamp"
+    validity = ts.validity & tzc.validity
+    out = np.zeros(n, np.int64)
+    zi_cache = {}
+    for i in range(n):
+        if not validity[i]:
+            continue
+        tz = tzc.values[i]
+        zi = zi_cache.get(tz)
+        if zi is None:
+            zi = zi_cache[tz] = ZoneInfo(tz)
+        us = int(ts.values[i])
+        if to_utc:
+            wall = (pydt.datetime(1970, 1, 1)
+                    + pydt.timedelta(microseconds=us))
+            off = wall.replace(tzinfo=zi, fold=0).utcoffset()
+        else:
+            inst = pydt.datetime.fromtimestamp(us // 1_000_000,
+                                               tz=pydt.timezone.utc)
+            # astimezone: offset AT THE INSTANT (tzinfo.utcoffset(dt)
+            # alone would treat dt's fields as wall time)
+            off = inst.astimezone(zi).utcoffset()
+        shift = int(off.total_seconds()) * 1_000_000
+        out[i] = us - shift if to_utc else us + shift
+    return CpuCol(T.TIMESTAMP, out, validity)
+
+
+# -- misc breadth: digests, encodings, url, soundex, ids ---------------------
+
+def _str_map_handler(fn):
+    def h(e, cols, n, ansi):
+        kids = _kids(e, cols, n, ansi)
+        s = kids[0]
+        out = np.empty(n, object)
+        validity = _null_prop_validity(kids)
+        for i in range(n):
+            if validity[i]:
+                out[i] = fn(e, s.values[i], [k.values[i] for k in kids[1:]])
+                if out[i] is None:
+                    validity[i] = False
+        return CpuCol.from_objs(list(out), T.STRING)
+
+    return h
+
+
+def _o_md5(e, s, _):
+    import hashlib
+
+    return hashlib.md5(s.encode()).hexdigest()
+
+
+def _o_sha1(e, s, _):
+    import hashlib
+
+    return hashlib.sha1(s.encode()).hexdigest()
+
+
+def _o_sha2(e, s, extra):
+    import hashlib
+
+    algo = {0: "sha256", 224: "sha224", 256: "sha256", 384: "sha384",
+            512: "sha512"}.get(int(extra[0]) if extra[0] is not None
+                               else -1)
+    if algo is None:
+        return None
+    return getattr(hashlib, algo)(s.encode()).hexdigest()
+
+
+def _h_crc32(e, cols, n, ansi):
+    import zlib
+
+    (s,) = _kids(e, cols, n, ansi)
+    out = np.zeros(n, np.int64)
+    for i in range(n):
+        if s.validity[i]:
+            out[i] = zlib.crc32(s.values[i].encode())
+    return CpuCol(T.LONG, out, s.validity.copy())
+
+
+def _o_base64(e, s, _):
+    import base64 as b64
+
+    return b64.b64encode(s.encode()).decode()
+
+
+def _o_unbase64(e, s, _):
+    import base64 as b64
+
+    try:
+        return b64.b64decode(s.encode(), validate=False).decode(
+            "utf-8", "replace")
+    except Exception:
+        return None
+
+
+def _o_encode(e, s, extra):
+    try:
+        return s.encode(str(extra[0]).lower()).decode("utf-8", "replace")
+    except (UnicodeError, LookupError, TypeError):
+        return None
+
+
+def _o_decode(e, s, extra):
+    try:
+        return s.encode("utf-8").decode(str(extra[0]).lower())
+    except (UnicodeError, LookupError, TypeError):
+        return None
+
+
+def _h_hex(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    out = np.empty(n, object)
+    for i in range(n):
+        if not c.validity[i]:
+            continue
+        if isinstance(c.dtype, T.StringType):
+            out[i] = c.values[i].encode().hex().upper()
+        else:
+            out[i] = format(int(c.values[i]) & 0xFFFFFFFFFFFFFFFF, "X")
+    return CpuCol.from_objs(list(out), T.STRING)
+
+
+def _o_unhex(e, s, _):
+    if len(s) % 2:
+        s = "0" + s
+    try:
+        return bytes.fromhex(s).decode("utf-8", "replace")
+    except ValueError:
+        return None
+
+
+def _h_bin(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    out = np.empty(n, object)
+    for i in range(n):
+        if c.validity[i]:
+            out[i] = format(int(c.values[i]) & 0xFFFFFFFFFFFFFFFF, "b")
+    return CpuCol.from_objs(list(out), T.STRING)
+
+
+def _o_conv(e, s, extra):
+    from spark_rapids_tpu.expr.misc import _conv_str
+
+    if extra[0] is None or extra[1] is None:
+        return None
+    return _conv_str(s, int(extra[0]), int(extra[1]))
+
+
+def _h_format_number(e, cols, n, ansi):
+    import decimal as pydec
+
+    c, d = _kids(e, cols, n, ansi)
+    out = np.empty(n, object)
+    validity = c.validity & d.validity
+    for i in range(n):
+        if not validity[i]:
+            continue
+        dd = int(d.values[i])
+        if dd < 0:
+            validity[i] = False
+            continue
+        if isinstance(c.dtype, T.DecimalType):
+            v = pydec.Decimal(int(c.values[i])).scaleb(-c.dtype.scale)
+        elif isinstance(c.dtype, (T.FloatType, T.DoubleType)):
+            fv = float(c.values[i])
+            if math.isnan(fv) or math.isinf(fv):
+                # Java DecimalFormat renders the NaN / infinity glyphs
+                out[i] = ("NaN" if math.isnan(fv)
+                          else ("∞" if fv > 0 else "-∞"))
+                continue
+            v = pydec.Decimal(repr(fv))
+        else:
+            v = pydec.Decimal(int(c.values[i]))
+        with pydec.localcontext() as lctx:
+            lctx.prec = 400  # 1e308 doubles need headroom to quantize
+            q = v.quantize(pydec.Decimal(1).scaleb(-dd),
+                           rounding=pydec.ROUND_HALF_EVEN)
+        out[i] = f"{q:,.{dd}f}"
+    col = CpuCol.from_objs(list(out), T.STRING)
+    col.validity &= validity
+    return col
+
+
+def _o_parse_url(e, s, extra):
+    from spark_rapids_tpu.expr.misc import _URL_PARTS, _parse_url_part
+
+    part = extra[0] if extra else None
+    key = extra[1] if len(extra) > 1 else None
+    if part not in _URL_PARTS:
+        return None
+    return _parse_url_part(s, part, key)
+
+
+def _o_soundex(e, s, _):
+    from spark_rapids_tpu.expr.misc import _soundex_str
+
+    return _soundex_str(s)
+
+
+def _h_levenshtein(e, cols, n, ansi):
+    a, b = _kids(e, cols, n, ansi)
+    validity = a.validity & b.validity
+    out = np.zeros(n, np.int32)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        x, y = a.values[i].encode(), b.values[i].encode()
+        prev = list(range(len(y) + 1))
+        for ii, cx in enumerate(x, 1):
+            cur = [ii]
+            for jj, cy in enumerate(y, 1):
+                cur.append(min(prev[jj] + 1, cur[-1] + 1,
+                               prev[jj - 1] + (cx != cy)))
+            prev = cur
+        out[i] = prev[-1]
+    return CpuCol(T.INT, out, validity)
+
+
+def _h_mono_id(e, cols, n, ansi):
+    return CpuCol(T.LONG, np.arange(n, dtype=np.int64),
+                  np.ones(n, np.bool_))
+
+
+def _h_partition_id(e, cols, n, ansi):
+    return CpuCol(T.INT, np.zeros(n, np.int32), np.ones(n, np.bool_))
+
+
+def _h_rand(e, cols, n, ansi):
+    # same splitmix64 spec as the device path (a PRNG stream is a spec,
+    # not semantics to cross-check; NOT Spark's XORShiftRandom)
+    from spark_rapids_tpu.expr.misc import Rand as _DevRand
+
+    z = _DevRand._u64_for_rows(e.seed, 0, n)
+    vals = (z >> np.uint64(11)).astype(np.float64) / float(1 << 53)
+    return CpuCol(T.DOUBLE, vals, np.ones(n, np.bool_))
+
+
+def _h_raise_error(e, cols, n, ansi):
+    (m,) = _kids(e, cols, n, ansi)
+    for i in range(n):
+        if m.validity[i]:
+            raise RuntimeError(f"raise_error: {m.values[i]}")
+    return CpuCol(T.NULL, np.zeros(n, np.int32), np.zeros(n, np.bool_))
+
+
+def _h_bloom_might_contain(e, cols, n, ansi):
+    bloom, v = _kids(e, cols, n, ansi)
+    import math as _math
+
+    k = max(1, round(e.num_bits / e.num_items * _math.log(2)))
+    out = np.zeros(n, np.bool_)
+    validity = bloom.validity & v.validity
+    for i in range(n):
+        if not validity[i]:
+            continue
+        words = bloom.values[i]
+        h1 = _wrap64(_oracle_xxh64(v.dtype, v.values[i], 42))
+        h2 = _wrap64(_oracle_xxh64(v.dtype, v.values[i], 77))
+        hit = True
+        for j in range(k):
+            bit = _wrap64(h1 + j * h2) % e.num_bits
+            if not (int(words[bit // 64]) >> (bit % 64)) & 1:
+                hit = False
+                break
+        out[i] = hit
+    return CpuCol(T.BOOLEAN, out, validity)
+
+
 # -- collection breadth ------------------------------------------------------
 
 def _nan_eq(a, b):
@@ -2633,6 +2908,29 @@ _HANDLERS = {
     "MapKeys": _h_map_keys,
     "MapValues": _h_map_values,
     "GetMapValue": _h_get_map_value,
+    "BloomFilterMightContain": _h_bloom_might_contain,
+    "FromUTCTimestamp": _h_utc_shift,
+    "ToUTCTimestamp": _h_utc_shift,
+    "Md5": _str_map_handler(_o_md5),
+    "Sha1": _str_map_handler(_o_sha1),
+    "Sha2": _str_map_handler(_o_sha2),
+    "Crc32": _h_crc32,
+    "Base64": _str_map_handler(_o_base64),
+    "UnBase64": _str_map_handler(_o_unbase64),
+    "Encode": _str_map_handler(_o_encode),
+    "Decode": _str_map_handler(_o_decode),
+    "Hex": _h_hex,
+    "Unhex": _str_map_handler(_o_unhex),
+    "Bin": _h_bin,
+    "Conv": _str_map_handler(_o_conv),
+    "FormatNumber": _h_format_number,
+    "ParseUrl": _str_map_handler(_o_parse_url),
+    "Soundex": _str_map_handler(_o_soundex),
+    "Levenshtein": _h_levenshtein,
+    "MonotonicallyIncreasingID": _h_mono_id,
+    "SparkPartitionID": _h_partition_id,
+    "Rand": _h_rand,
+    "RaiseError": _h_raise_error,
     "ArrayTransform": _h_array_transform,
     "ArrayFilter": _h_array_filter,
     "ArrayExists": _h_array_exists,
@@ -2795,16 +3093,25 @@ def _cpu_aggregate(plan: PN.HashAggregate, ansi: bool):
             if a.func == "avg":
                 acols.append((cols[child_names.index(a.result_name + "_sum")],
                               cols[child_names.index(a.result_name + "_count")]))
-            elif a.func in PN.VARIANCE_FUNCS:
+            elif a.func in PN.MOMENT_BUFFERS:
                 acols.append(tuple(
                     cols[child_names.index(a.result_name + s)]
-                    for s in ("_n", "_avg", "_m2")))
+                    for s in PN.MOMENT_BUFFERS[a.func]))
+            elif a.func == "approx_count_distinct":
+                acols.append(cols[child_names.index(a.result_name + "_hll")])
             else:
                 nm = a.result_name
                 acols.append(cols[child_names.index(nm)])
     else:
-        acols = [eval_expr(a.child, cols, n, ansi) if a.child is not None
-                 else None for a in plan.aggregates]
+        acols = []
+        for a in plan.aggregates:
+            if a.child is None:
+                acols.append(None)
+            elif a.child2 is not None:
+                acols.append((eval_expr(a.child, cols, n, ansi),
+                              eval_expr(a.child2, cols, n, ansi)))
+            else:
+                acols.append(eval_expr(a.child, cols, n, ansi))
     groups: Dict[tuple, int] = {}
     order: List[tuple] = []
     rows_per_group: List[List[int]] = []
@@ -2862,12 +3169,145 @@ def _partial_field_groups(plan: PN.HashAggregate):
         if a.func == "avg":
             yield (fields[i], fields[i + 1])
             i += 2
-        elif a.func in PN.VARIANCE_FUNCS:
-            yield (fields[i], fields[i + 1], fields[i + 2])
-            i += 3
+        elif a.func in PN.MOMENT_BUFFERS:
+            k = len(PN.MOMENT_BUFFERS[a.func])
+            yield tuple(fields[i:i + k])
+            i += k
         else:
             yield (fields[i],)
             i += 1
+
+
+# -- moment/covariance/HLL/bloom helpers (spec-mirrors of the device path;
+# hashing goes through the oracle's OWN xxhash64) -----------------------------
+
+_HLL_P = PN.HLL_DEFAULT_P
+
+
+def _oracle_xxh64(dtype, value, seed: int) -> int:
+    kind, x = _hash_input(dtype, value)
+    h = _xxh_update(kind, x, seed & _M64)
+    return h & _M64
+
+
+def _scaled_floats(ac: CpuCol, idxs) -> List[float]:
+    scale = (10.0 ** -ac.dtype.scale
+             if isinstance(ac.dtype, T.DecimalType) else 1.0)
+    return [float(ac.values[i]) * scale for i in idxs if ac.validity[i]]
+
+
+def _moment_stats(xs: List[float]):
+    n = len(xs)
+    if n == 0:
+        return 0.0, 0.0, 0.0, 0.0, 0.0
+    m = sum(xs) / n
+    m2 = sum((x - m) ** 2 for x in xs)
+    m3 = sum((x - m) ** 3 for x in xs)
+    m4 = sum((x - m) ** 4 for x in xs)
+    return float(n), m, m2, m3, m4
+
+
+def _cov_stats(pairs):
+    n = len(pairs)
+    if n == 0:
+        return 0.0, 0.0, 0.0, 0.0, 0.0, 0.0
+    xa = sum(x for x, _ in pairs) / n
+    ya = sum(y for _, y in pairs) / n
+    ck = sum((x - xa) * (y - ya) for x, y in pairs)
+    xm2 = sum((x - xa) ** 2 for x, _ in pairs)
+    ym2 = sum((y - ya) ** 2 for _, y in pairs)
+    return float(n), xa, ya, ck, xm2, ym2
+
+
+def _cov_pairs(ac, idxs):
+    xc, yc = ac
+    xs = _scaled_floats_map(xc)
+    ys = _scaled_floats_map(yc)
+    return [(xs(i), ys(i)) for i in idxs
+            if xc.validity[i] and yc.validity[i]]
+
+
+def _scaled_floats_map(c: CpuCol):
+    scale = (10.0 ** -c.dtype.scale
+             if isinstance(c.dtype, T.DecimalType) else 1.0)
+    return lambda i: float(c.values[i]) * scale
+
+
+def _finalize_moment(func: str, n, m2, m3, m4):
+    """-> (value, valid); Spark nullOnDivideByZero semantics."""
+    if n <= 0 or m2 == 0.0:
+        return 0.0, False
+    if func == "skewness":
+        return math.sqrt(n) * m3 / (m2 ** 1.5), True
+    return n * m4 / (m2 * m2) - 3.0, True
+
+
+def _finalize_cov(func: str, n, ck, xm2, ym2):
+    if n <= 0:
+        return 0.0, False
+    if func == "corr":
+        denom = math.sqrt(xm2 * ym2)
+        if denom == 0.0:
+            return float("nan"), True
+        return ck / denom, True
+    if func == "covar_pop":
+        return ck / n, True
+    if n <= 1:
+        return 0.0, False
+    return ck / (n - 1.0), True
+
+
+def _hll_regs(ac: CpuCol, idxs) -> List[int]:
+    p = _HLL_P
+    m = 1 << p
+    regs = [0] * m
+    for i in idxs:
+        if not ac.validity[i]:
+            continue
+        h = _oracle_xxh64(ac.dtype, ac.values[i], 42)
+        idx = h >> (64 - p)
+        w = (h << p) & _M64
+        clz = 64 - w.bit_length()
+        rank = min(clz + 1, 65 - p)
+        regs[idx] = max(regs[idx], rank)
+    return regs
+
+
+def _hll_estimate(regs: List[int]) -> int:
+    m = len(regs)
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    inv = sum(2.0 ** -r for r in regs)
+    raw = alpha * m * m / inv
+    zeros = regs.count(0)
+    if raw <= 2.5 * m and zeros > 0:
+        est = m * math.log(m / zeros)
+    else:
+        est = raw
+    return int(round(est))
+
+
+def _wrap64(x: int) -> int:
+    return ((x + 2**63) % 2**64) - 2**63
+
+
+def _bloom_words(ac: CpuCol, idxs, num_items: int, num_bits: int):
+    words = [0] * (num_bits // 64)
+    k = max(1, round(num_bits / num_items * math.log(2)))
+    for i in idxs:
+        if not ac.validity[i]:
+            continue
+        h1 = _wrap64(_oracle_xxh64(ac.dtype, ac.values[i], 42))
+        h2 = _wrap64(_oracle_xxh64(ac.dtype, ac.values[i], 77))
+        for j in range(k):
+            bit = _wrap64(h1 + j * h2) % num_bits
+            words[bit // 64] |= 1 << (bit % 64)
+    return [_wrap64(w) for w in words]
+
+
+def _percentile_sorted(ac: CpuCol, idxs):
+    vals = [(ac.values[i]) for i in idxs if ac.validity[i]]
+    return sorted(vals, key=lambda v: (isinstance(v, float)
+                                       and math.isnan(v), v))
 
 
 def _agg_partial(a: PN.AggregateExpression, ac: Optional[CpuCol],
@@ -2895,30 +3335,39 @@ def _agg_partial(a: PN.AggregateExpression, ac: Optional[CpuCol],
         yield CpuCol(cnt_f.dataType, np.array(cnts, np.int64),
                      np.ones(ng, np.bool_))
         return
-    if a.func in PN.VARIANCE_FUNCS:
-        fn_, fa, fm = fields
-        scale = (10.0 ** -a.child.dataType.scale
-                 if isinstance(a.child.dataType, T.DecimalType) else 1.0)
-        ns, avgs, m2s = [], [], []
+    if a.func in PN.MOMENT_BUFFERS:
+        suffixes = PN.MOMENT_BUFFERS[a.func]
+        bufs = [[] for _ in suffixes]
         mvalid = np.ones(ng, np.bool_)
         for gi in range(ng):
-            xs = [float(ac.values[i]) * scale for i in rows_per_group[gi]
-                  if ac.validity[i]]
-            ns.append(float(len(xs)))
-            if not xs:
-                avgs.append(0.0)
-                m2s.append(0.0)
-                mvalid[gi] = False
+            if a.func in PN.COVARIANCE_FUNCS:
+                pairs = _cov_pairs(ac, rows_per_group[gi])
+                stats = _cov_stats(pairs)
+                nvals = stats[0]
             else:
-                m = sum(xs) / len(xs)
-                avgs.append(m)
-                m2s.append(sum((x - m) ** 2 for x in xs))
-        yield CpuCol(fn_.dataType, np.array(ns, np.float64),
-                     np.ones(ng, np.bool_))
-        yield CpuCol(fa.dataType, np.array(avgs, np.float64), mvalid)
-        yield CpuCol(fm.dataType, np.array(m2s, np.float64), mvalid)
+                xs = _scaled_floats(ac, rows_per_group[gi])
+                n_, m, m2, m3, m4 = _moment_stats(xs)
+                stats = {"_n": n_, "_avg": m, "_m2": m2, "_m3": m3,
+                         "_m4": m4}
+                stats = tuple(stats[s] for s in suffixes)
+                nvals = n_
+            if nvals == 0:
+                mvalid[gi] = False
+            for b, v in zip(bufs, stats):
+                b.append(v)
+        for si, (s, f) in enumerate(zip(suffixes, fields)):
+            valid = np.ones(ng, np.bool_) if s == "_n" else mvalid
+            yield CpuCol(f.dataType, np.array(bufs[si], np.float64),
+                         valid.copy())
         return
-    # count/sum/min/max/first/last partials share the final update shape
+    if a.func == "approx_count_distinct":
+        (f,) = fields
+        vals = np.empty(ng, object)
+        for gi in range(ng):
+            vals[gi] = _hll_regs(ac, rows_per_group[gi])
+        yield CpuCol(f.dataType, vals, np.ones(ng, np.bool_))
+        return
+    # count/sum/min/max/first/last/count_if partials share the final shape
     vals, valid = _agg_one(a, ac, rows_per_group, False)
     (f,) = fields
     yield CpuCol(f.dataType, vals, valid)
@@ -2978,13 +3427,85 @@ def _agg_final(a: PN.AggregateExpression, ac, rows_per_group) -> CpuCol:
             out[gi] = v
             valid[gi] = ok
         return CpuCol(a.result_type, out, valid)
+    if a.func in PN.HIGHER_MOMENT_FUNCS:
+        cn, ca, cm2, cm3 = ac[:4]
+        cm4 = ac[4] if len(ac) > 4 else None
+        out = np.zeros(ng, np.float64)
+        valid = np.ones(ng, np.bool_)
+        for gi in range(ng):
+            idxs = [i for i in rows_per_group[gi]
+                    if cn.validity[i] and float(cn.values[i]) > 0]
+            ntot = sum(float(cn.values[i]) for i in idxs)
+            if ntot == 0:
+                valid[gi] = False
+                continue
+            mean = sum(float(cn.values[i]) * float(ca.values[i])
+                       for i in idxs) / ntot
+            m2 = m3 = m4 = 0.0
+            for i in idxs:
+                ni = float(cn.values[i])
+                di = float(ca.values[i]) - mean
+                m2i = float(cm2.values[i])
+                m3i = float(cm3.values[i])
+                m2 += m2i + ni * di * di
+                m3 += m3i + 3.0 * m2i * di + ni * di ** 3
+                if cm4 is not None:
+                    m4 += (float(cm4.values[i]) + 4.0 * m3i * di
+                           + 6.0 * m2i * di * di + ni * di ** 4)
+            v, ok = _finalize_moment(a.func, ntot, m2, m3, m4)
+            out[gi] = v
+            valid[gi] = ok
+        return CpuCol(a.result_type, out, valid)
+    if a.func in PN.COVARIANCE_FUNCS:
+        cn, cx, cy, cc = ac[:4]
+        is_corr = a.func == "corr"
+        out = np.zeros(ng, np.float64)
+        valid = np.ones(ng, np.bool_)
+        for gi in range(ng):
+            idxs = [i for i in rows_per_group[gi]
+                    if cn.validity[i] and float(cn.values[i]) > 0]
+            ntot = sum(float(cn.values[i]) for i in idxs)
+            if ntot == 0:
+                valid[gi] = False
+                continue
+            xavg = sum(float(cn.values[i]) * float(cx.values[i])
+                       for i in idxs) / ntot
+            yavg = sum(float(cn.values[i]) * float(cy.values[i])
+                       for i in idxs) / ntot
+            ck = xm2 = ym2 = 0.0
+            for i in idxs:
+                ni = float(cn.values[i])
+                dxi = float(cx.values[i]) - xavg
+                dyi = float(cy.values[i]) - yavg
+                ck += float(cc.values[i]) + ni * dxi * dyi
+                if is_corr:
+                    xm2 += float(ac[4].values[i]) + ni * dxi * dxi
+                    ym2 += float(ac[5].values[i]) + ni * dyi * dyi
+            v, ok = _finalize_cov(a.func, ntot, ck, xm2, ym2)
+            out[gi] = v
+            valid[gi] = ok
+        return CpuCol(a.result_type, out, valid)
+    if a.func == "approx_count_distinct":
+        out = np.zeros(ng, np.int64)
+        for gi in range(ng):
+            m = 1 << _HLL_P
+            merged = [0] * m
+            for i in rows_per_group[gi]:
+                if not ac.validity[i]:
+                    continue
+                regs = ac.values[i]
+                for j in range(m):
+                    if regs[j] > merged[j]:
+                        merged[j] = regs[j]
+            out[gi] = _hll_estimate(merged)
+        return CpuCol(a.result_type, out, np.ones(ng, np.bool_))
     merge_func = {"count": "sum", "count_star": "sum", "sum": "sum",
                   "min": "min", "max": "max", "first": "first",
-                  "last": "last"}[a.func]
+                  "last": "last", "count_if": "sum"}[a.func]
     merged = PN.AggregateExpression(merge_func, None, a.result_name,
                                     a.result_type)
     vals, valid = _agg_one(merged, ac, rows_per_group, False)
-    if a.func in ("count", "count_star"):
+    if a.func in ("count", "count_star", "count_if"):
         valid = np.ones(ng, np.bool_)
         vals = np.array([v if valid[i] else 0 for i, v in enumerate(vals)],
                         np.int64)
@@ -3023,13 +3544,34 @@ def _agg_one(a: PN.AggregateExpression, ac: Optional[CpuCol],
                 xs = rest + ([float("nan")] if has_nan else [])
             vals[gi] = xs
         return vals, np.ones(ng, np.bool_)
+    if func == "bloom_filter_agg":
+        vals = np.empty(ng, object)
+        for gi in range(ng):
+            vals[gi] = _bloom_words(ac, rows_per_group[gi],
+                                    int(a.args[0]), int(a.args[1]))
+        return vals, np.ones(ng, np.bool_)
     out = []
     valid = np.ones(ng, np.bool_)
     dec = isinstance(a.result_type, T.DecimalType)
+    if isinstance(ac, tuple):  # covariance family: (x, y) inputs
+        for gi in range(ng):
+            pairs = _cov_pairs(ac, rows_per_group[gi])
+            n_, xa, ya, ck, xm2, ym2 = _cov_stats(pairs)
+            v, ok = _finalize_cov(func, n_, ck, xm2, ym2)
+            out.append(v if ok else None)
+            valid[gi] = ok
+        return (np.array([v if v is not None else 0.0 for v in out],
+                         np.float64), valid)
     for gi in range(ng):
         idxs = [i for i in rows_per_group[gi] if ac.validity[i]]
         if func == "count":
             out.append(len(idxs))
+            continue
+        if func == "count_if":
+            out.append(sum(1 for i in idxs if bool(ac.values[i])))
+            continue
+        if func == "approx_count_distinct":
+            out.append(_hll_estimate(_hll_regs(ac, rows_per_group[gi])))
             continue
         if func in ("first", "last"):
             # Spark First/Last default ignoreNulls=false: nulls count
@@ -3082,6 +3624,37 @@ def _agg_one(a: PN.AggregateExpression, ac: Optional[CpuCol],
             else:
                 out.append(None)
                 valid[gi] = False
+        elif func in PN.HIGHER_MOMENT_FUNCS:
+            xs = _scaled_floats(ac, idxs)
+            n_, m, m2, m3, m4 = _moment_stats(xs)
+            v, ok = _finalize_moment(func, n_, m2, m3, m4)
+            if ok:
+                out.append(v)
+            else:
+                out.append(None)
+                valid[gi] = False
+        elif func == "percentile":
+            xs = _percentile_sorted(ac, idxs)
+            if not xs:
+                out.append(None)
+                valid[gi] = False
+                continue
+            pscale = (10.0 ** -ac.dtype.scale
+                      if isinstance(ac.dtype, T.DecimalType) else 1.0)
+            p = float(a.args[0])
+            r = p * (len(xs) - 1)
+            lo, hi = int(math.floor(r)), int(math.ceil(r))
+            frac = r - lo
+            out.append((float(xs[lo]) * (1 - frac)
+                        + float(xs[hi]) * frac) * pscale)
+        elif func == "approx_percentile":
+            xs = _percentile_sorted(ac, idxs)
+            if not xs:
+                out.append(None)
+                valid[gi] = False
+                continue
+            p = float(a.args[0])
+            out.append(xs[int(math.floor(p * (len(xs) - 1)))])
         else:
             raise NotImplementedError(func)
     if dec or isinstance(a.result_type, T.StringType):

@@ -1,0 +1,7 @@
+"""Delta Lake support (SURVEY.md §2.8) — log, table commands, Z-ORDER."""
+from spark_rapids_tpu.delta.log import DeltaLog, Snapshot  # noqa: F401
+from spark_rapids_tpu.delta.table import (  # noqa: F401
+    DeltaTable,
+    read_delta,
+    write_delta,
+)

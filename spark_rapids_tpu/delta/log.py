@@ -1,0 +1,278 @@
+"""Delta transaction log — the GpuDeltaLog analog.
+
+Reference analog: delta-lake/common GpuDeltaLog + GpuOptimisticTransaction
+(SURVEY.md §2.8): the reference wraps Delta's log replay and commits
+GPU-written files through Delta's optimistic protocol.  This module
+implements the open Delta log format directly (the subset the engine
+needs): JSON commit files under ``_delta_log/``, protocol/metaData/add/
+remove actions, parquet checkpoints + ``_last_checkpoint``, and optimistic
+concurrency via atomic create (O_EXCL) with retry.
+
+Interoperability: the files written here follow the public Delta spec
+(https://github.com/delta-io/delta PROTOCOL.md) at reader/writer version 1,
+so delta-rs / Spark can read these tables (no deletion vectors, no column
+mapping).  Checkpoints use a PRIVATE simplified layout under the private
+``_tpu_checkpoint.json`` pointer (never ``_last_checkpoint``), so foreign
+readers replay the spec-compliant JSON commits and stay compatible.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu import types as T
+
+LOG_DIR = "_delta_log"
+
+
+# ---------------------------------------------------------------------------
+# Schema <-> Spark schema JSON
+# ---------------------------------------------------------------------------
+
+_TO_JSON = {
+    T.BooleanType: "boolean", T.ByteType: "byte", T.ShortType: "short",
+    T.IntegerType: "integer", T.LongType: "long", T.FloatType: "float",
+    T.DoubleType: "double", T.StringType: "string", T.DateType: "date",
+    T.TimestampType: "timestamp", T.BinaryType: "binary",
+}
+
+_FROM_JSON = {v: k for k, v in _TO_JSON.items()}
+
+
+def _type_to_json(dt: T.DataType):
+    if isinstance(dt, T.DecimalType):
+        return f"decimal({dt.precision},{dt.scale})"
+    if isinstance(dt, T.ArrayType):
+        return {"type": "array", "elementType": _type_to_json(dt.elementType),
+                "containsNull": dt.containsNull}
+    if isinstance(dt, T.StructType):
+        return schema_to_json(dt)
+    return _TO_JSON[type(dt)]
+
+
+def _type_from_json(j):
+    if isinstance(j, dict):
+        if j.get("type") == "array":
+            return T.ArrayType(_type_from_json(j["elementType"]),
+                               j.get("containsNull", True))
+        if j.get("type") == "struct":
+            return schema_from_json(j)
+        raise ValueError(f"unsupported delta type {j!r}")
+    if j.startswith("decimal("):
+        p, s = j[8:-1].split(",")
+        return T.DecimalType(int(p), int(s))
+    return _FROM_JSON[j]()
+
+
+def schema_to_json(schema: T.StructType) -> dict:
+    return {"type": "struct", "fields": [
+        {"name": f.name, "type": _type_to_json(f.dataType),
+         "nullable": f.nullable, "metadata": {}} for f in schema.fields]}
+
+
+def schema_from_json(j: dict) -> T.StructType:
+    return T.StructType([
+        T.StructField(f["name"], _type_from_json(f["type"]),
+                      f.get("nullable", True)) for f in j["fields"]])
+
+
+# ---------------------------------------------------------------------------
+# Snapshot
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class AddFile:
+    path: str
+    partitionValues: Dict[str, str]
+    size: int
+    modificationTime: int
+    dataChange: bool = True
+    stats: Optional[str] = None
+
+
+@dataclasses.dataclass
+class Snapshot:
+    version: int
+    schema: T.StructType
+    files: List[AddFile]
+    partition_columns: List[str]
+    metadata_id: str
+
+    def file_paths(self, table_path: str) -> List[str]:
+        return [os.path.join(table_path, f.path) for f in self.files]
+
+
+class DeltaLog:
+    """Log replay + optimistic commits for one table path."""
+
+    CHECKPOINT_INTERVAL = 10
+
+    def __init__(self, table_path: str):
+        self.table_path = table_path
+        self.log_path = os.path.join(table_path, LOG_DIR)
+
+    # -- replay ---------------------------------------------------------
+    def _commit_file(self, version: int) -> str:
+        return os.path.join(self.log_path, f"{version:020d}.json")
+
+    def _checkpoint_file(self, version: int) -> str:
+        return os.path.join(self.log_path,
+                            f"{version:020d}.tpu-checkpoint.parquet")
+
+    def latest_version(self) -> int:
+        if not os.path.isdir(self.log_path):
+            return -1
+        best = -1
+        for name in os.listdir(self.log_path):
+            if name.endswith(".json") and name[:20].isdigit():
+                best = max(best, int(name[:20]))
+        return best
+
+    def _last_checkpoint_version(self) -> int:
+        p = os.path.join(self.log_path, "_tpu_checkpoint.json")
+        if not os.path.isfile(p):
+            return -1
+        try:
+            with open(p) as f:
+                return int(json.load(f)["version"])
+        except (ValueError, KeyError, OSError):
+            return -1
+
+    def snapshot(self, version: Optional[int] = None) -> Snapshot:
+        latest = self.latest_version()
+        if latest < 0:
+            raise FileNotFoundError(
+                f"{self.table_path} is not a Delta table (no {LOG_DIR})")
+        version = latest if version is None else version
+        files: Dict[str, AddFile] = {}
+        schema = None
+        part_cols: List[str] = []
+        meta_id = ""
+        start = 0
+        ckpt = self._last_checkpoint_version()
+        if 0 <= ckpt <= version and os.path.isfile(
+                self._checkpoint_file(ckpt)):
+            for action in self._read_checkpoint(ckpt):
+                schema, part_cols, meta_id = self._apply(
+                    action, files, schema, part_cols, meta_id)
+            start = ckpt + 1
+        for v in range(start, version + 1):
+            p = self._commit_file(v)
+            if not os.path.isfile(p):
+                continue
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        schema, part_cols, meta_id = self._apply(
+                            json.loads(line), files, schema, part_cols,
+                            meta_id)
+        if schema is None:
+            raise ValueError(f"{self.table_path}: no metaData action found")
+        return Snapshot(version, schema, list(files.values()), part_cols,
+                        meta_id)
+
+    @staticmethod
+    def _apply(action, files, schema, part_cols, meta_id):
+        if "metaData" in action:
+            md = action["metaData"]
+            schema = schema_from_json(json.loads(md["schemaString"]))
+            part_cols = md.get("partitionColumns", [])
+            meta_id = md.get("id", "")
+        elif "add" in action:
+            a = action["add"]
+            files[a["path"]] = AddFile(
+                a["path"], a.get("partitionValues", {}),
+                a.get("size", 0), a.get("modificationTime", 0),
+                a.get("dataChange", True), a.get("stats"))
+        elif "remove" in action:
+            files.pop(action["remove"]["path"], None)
+        return schema, part_cols, meta_id
+
+    # -- commit ---------------------------------------------------------
+    def commit(self, actions: List[dict], attempts: int = 20) -> int:
+        """Optimistic commit: next version via atomic O_EXCL create; a
+        concurrent writer winning the race surfaces as FileExistsError and
+        we re-read + retry (the reference delegates this loop to Delta's
+        OptimisticTransaction)."""
+        os.makedirs(self.log_path, exist_ok=True)
+        for _ in range(attempts):
+            version = self.latest_version() + 1
+            path = self._commit_file(version)
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                time.sleep(0.01)
+                continue
+            with os.fdopen(fd, "w") as f:
+                for a in actions:
+                    f.write(json.dumps(a) + "\n")
+            if version > 0 and version % self.CHECKPOINT_INTERVAL == 0:
+                self._write_checkpoint(version)
+            return version
+        raise RuntimeError(
+            f"could not commit to {self.log_path} after {attempts} tries")
+
+    def metadata_action(self, schema: T.StructType,
+                        partition_columns: List[str],
+                        meta_id: Optional[str] = None) -> dict:
+        return {"metaData": {
+            "id": meta_id or str(uuid.uuid4()),
+            "format": {"provider": "parquet", "options": {}},
+            "schemaString": json.dumps(schema_to_json(schema)),
+            "partitionColumns": partition_columns,
+            "configuration": {},
+            "createdTime": int(time.time() * 1000),
+        }}
+
+    @staticmethod
+    def protocol_action() -> dict:
+        return {"protocol": {"minReaderVersion": 1, "minWriterVersion": 1}}
+
+    @staticmethod
+    def add_action(rel_path: str, size: int,
+                   partition_values: Optional[Dict[str, str]] = None,
+                   stats: Optional[str] = None) -> dict:
+        return {"add": {
+            "path": rel_path, "partitionValues": partition_values or {},
+            "size": size, "modificationTime": int(time.time() * 1000),
+            "dataChange": True, **({"stats": stats} if stats else {})}}
+
+    @staticmethod
+    def remove_action(rel_path: str) -> dict:
+        return {"remove": {"path": rel_path,
+                           "deletionTimestamp": int(time.time() * 1000),
+                           "dataChange": True}}
+
+    # -- checkpoints ----------------------------------------------------
+    def _write_checkpoint(self, version: int):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        snap = self.snapshot(version)
+        rows = []
+        rows.append({"kind": "protocol",
+                     "json": json.dumps(self.protocol_action())})
+        rows.append({"kind": "metaData", "json": json.dumps(
+            self.metadata_action(snap.schema, snap.partition_columns,
+                                 snap.metadata_id))})
+        for f in snap.files:
+            rows.append({"kind": "add", "json": json.dumps(
+                {"add": dataclasses.asdict(f)})})
+        tbl = pa.table({"kind": [r["kind"] for r in rows],
+                        "json": [r["json"] for r in rows]})
+        pq.write_table(tbl, self._checkpoint_file(version))
+        with open(os.path.join(self.log_path,
+                               "_tpu_checkpoint.json"), "w") as f:
+            json.dump({"version": version, "size": len(rows)}, f)
+
+    def _read_checkpoint(self, version: int):
+        import pyarrow.parquet as pq
+
+        tbl = pq.read_table(self._checkpoint_file(version))
+        for j in tbl.column("json").to_pylist():
+            yield json.loads(j)

@@ -1,0 +1,316 @@
+"""DeltaTable command surface: read, write, DELETE, UPDATE, MERGE,
+OPTIMIZE [ZORDER BY].
+
+Reference analog: delta-lake/delta-2xx GpuDeltaLog consumers —
+GpuDeleteCommand, GpuUpdateCommand, GpuMergeIntoCommand (low-shuffle
+merge), GpuOptimizeExecutor with Z-ORDER (SURVEY.md §2.8).
+
+TPU designs:
+  * DELETE/UPDATE rewrite only files that CONTAIN matches (a per-file
+    filter probe — the reference's file-pruning pass), committing
+    add+remove pairs in one optimistic transaction.
+  * MERGE runs as engine joins: matched updates/deletes resolve per target
+    file; unmatched inserts append — all columnar on device.
+  * OPTIMIZE ZORDER sorts on interleaved bit planes (ops/zorder.py, the
+    zorder.cu analog) and rewrites files.
+"""
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.delta.log import DeltaLog, Snapshot
+from spark_rapids_tpu.expr.base import Expression
+
+_CHUNK_ROWS = 1 << 20
+
+
+def _write_parquet_file(table_path: str, arrow_tbl) -> Dict:
+    import pyarrow.parquet as pq
+
+    name = f"part-{uuid.uuid4().hex}.snappy.parquet"
+    full = os.path.join(table_path, name)
+    pq.write_table(arrow_tbl, full, compression="snappy")
+    return {"path": name, "size": os.path.getsize(full)}
+
+
+def _df_to_arrow(df):
+    """Collect a DataFrame (through the TPU plan) into one arrow table."""
+    import pyarrow as pa
+
+    from spark_rapids_tpu.exec.transitions import TpuColumnarToRowExec
+
+    root, _ = df._planned()
+    from spark_rapids_tpu.exec.base import TpuExec
+
+    if isinstance(root, TpuExec):
+        host = TpuColumnarToRowExec(root).collect_host()
+    else:
+        from spark_rapids_tpu.cpu.oracle import execute_cpu_plan
+
+        cols, n = execute_cpu_plan(root, ansi=False)
+        host = [c.to_host() for c in cols]
+    names = df.schema.field_names()
+    return pa.table({n: h.to_arrow() for n, h in zip(names, host)})
+
+
+class DeltaTable:
+    """deltaTable = DeltaTable.for_path(session, path)."""
+
+    def __init__(self, session, path: str):
+        self.session = session
+        self.path = path
+        self.log = DeltaLog(path)
+
+    @staticmethod
+    def for_path(session, path: str) -> "DeltaTable":
+        DeltaLog(path).snapshot()  # validate
+        return DeltaTable(session, path)
+
+    @staticmethod
+    def create(session, path: str, df, mode: str = "error",
+               partition_by: Optional[List[str]] = None) -> "DeltaTable":
+        write_delta(df, path, mode=mode, partition_by=partition_by)
+        return DeltaTable(session, path)
+
+    # -- read -----------------------------------------------------------
+    def to_df(self):
+        return read_delta(self.session, self.path)
+
+    def history(self) -> List[int]:
+        return list(range(self.log.latest_version() + 1))
+
+    # -- commands -------------------------------------------------------
+    def _scan_file(self, add):
+        """One data file -> DataFrame."""
+        snap = self.log.snapshot()
+        return self.session.read.schema(snap.schema).parquet(
+            os.path.join(self.path, add.path))
+
+    def delete(self, condition: Expression) -> int:
+        """DELETE WHERE condition; returns #files rewritten."""
+        from spark_rapids_tpu.expr.predicates import Not
+
+        snap = self.log.snapshot()
+        actions = []
+        rewritten = 0
+        for add in snap.files:
+            df = self._scan_file(add)
+            n_match = df.filter(condition).count()
+            if n_match == 0:
+                continue  # file untouched (the pruning pass)
+            keep = df.filter(Not(condition))
+            kept_rows = keep.count()
+            actions.append(DeltaLog.remove_action(add.path))
+            if kept_rows:
+                tbl = _df_to_arrow(keep)
+                info = _write_parquet_file(self.path, tbl)
+                actions.append(DeltaLog.add_action(info["path"],
+                                                   info["size"]))
+            rewritten += 1
+        if actions:
+            self.log.commit(actions)
+        return rewritten
+
+    def update(self, condition: Expression,
+               assignments: Dict[str, Expression]) -> int:
+        """UPDATE SET col=expr WHERE condition; returns #files rewritten."""
+        from spark_rapids_tpu.expr.base import AttributeReference
+        from spark_rapids_tpu.expr.conditional import If
+
+        snap = self.log.snapshot()
+        actions = []
+        rewritten = 0
+        for add in snap.files:
+            df = self._scan_file(add)
+            if df.filter(condition).count() == 0:
+                continue
+            # project: updated value where cond else original
+            exprs = []
+            for f in snap.schema.fields:
+                if f.name in assignments:
+                    exprs.append(
+                        If(condition, assignments[f.name],
+                           AttributeReference(f.name)).alias(f.name))
+                else:
+                    exprs.append(AttributeReference(f.name))
+            out = df.select(*exprs)
+            tbl = _df_to_arrow(out)
+            info = _write_parquet_file(self.path, tbl)
+            actions.append(DeltaLog.remove_action(add.path))
+            actions.append(DeltaLog.add_action(info["path"], info["size"]))
+            rewritten += 1
+        if actions:
+            self.log.commit(actions)
+        return rewritten
+
+    def merge(self, source, on: List[str],
+              when_matched_update: Optional[Dict[str, Expression]] = None,
+              when_matched_delete: bool = False,
+              when_not_matched_insert: bool = True) -> dict:
+        """MERGE INTO target USING source ON target.k == source.k.
+
+        Supported clause shapes (the common upsert patterns):
+          * matched -> update assignments OR delete
+          * not matched -> insert source row
+        Executes as engine joins (the low-shuffle-merge idea: matched
+        rows resolve against the existing files; inserts append)."""
+        from spark_rapids_tpu.expr.base import AttributeReference
+
+        snap = self.log.snapshot()
+        target = self.to_df()
+        actions = []
+        stats = {"files_rewritten": 0, "rows_inserted": 0}
+        schema_names = snap.schema.field_names()
+        # 1. per-file rewrite for matched rows
+        if when_matched_update or when_matched_delete:
+            for add in snap.files:
+                fdf = self._scan_file(add)
+                matched = fdf.join(source, on=on, how="left_semi")
+                if matched.count() == 0:
+                    continue
+                if when_matched_delete:
+                    out = fdf.join(source, on=on, how="left_anti")
+                else:
+                    # update matched rows from source values; target fields
+                    # bind by ORDINAL (an inner join repeats the key names
+                    # on both sides), update expressions resolve by name
+                    # against the joined schema (source columns must be
+                    # uniquely named apart from the keys)
+                    from spark_rapids_tpu.expr.base import BoundReference
+
+                    joined = fdf.join(source, on=on, how="inner")
+                    upd_exprs = []
+                    for fi, f in enumerate(snap.schema.fields):
+                        if f.name in when_matched_update:
+                            upd_exprs.append(
+                                when_matched_update[f.name].alias(f.name))
+                        else:
+                            upd_exprs.append(
+                                BoundReference(fi, f.dataType, f.nullable,
+                                               name=f.name).alias(f.name))
+                    updated = joined.select(*upd_exprs)
+                    untouched = fdf.join(source, on=on, how="left_anti")
+                    out = untouched.union(updated)
+                tbl = _df_to_arrow(out)
+                actions.append(DeltaLog.remove_action(add.path))
+                if tbl.num_rows:
+                    info = _write_parquet_file(self.path, tbl)
+                    actions.append(DeltaLog.add_action(info["path"],
+                                                       info["size"]))
+                stats["files_rewritten"] += 1
+        # 2. inserts: source rows with no target match
+        if when_not_matched_insert:
+            inserts = source.join(target, on=on, how="left_anti").select(
+                *[AttributeReference(n) for n in schema_names])
+            tbl = _df_to_arrow(inserts)
+            if tbl.num_rows:
+                info = _write_parquet_file(self.path, tbl)
+                actions.append(DeltaLog.add_action(info["path"],
+                                                   info["size"]))
+                stats["rows_inserted"] = tbl.num_rows
+        if actions:
+            self.log.commit(actions)
+        return stats
+
+    def optimize(self, zorder_by: Optional[List[str]] = None) -> dict:
+        """OPTIMIZE [ZORDER BY cols]: compact all files into one (or a
+        z-ordered rewrite) — GpuOptimizeExecutor analog."""
+        snap = self.log.snapshot()
+        df = self.to_df()
+        if zorder_by:
+            df = _zorder_sort(df, zorder_by)
+        tbl = _df_to_arrow(df)
+        actions = [DeltaLog.remove_action(a.path) for a in snap.files]
+        if tbl.num_rows:
+            info = _write_parquet_file(self.path, tbl)
+            actions.append(DeltaLog.add_action(info["path"], info["size"]))
+        self.log.commit(actions)
+        return {"files_removed": len(snap.files),
+                "files_added": 1 if tbl.num_rows else 0}
+
+    def vacuum(self) -> int:
+        """Remove data files no longer referenced by the latest snapshot."""
+        snap = self.log.snapshot()
+        live = {a.path for a in snap.files}
+        removed = 0
+        for name in os.listdir(self.path):
+            if name.endswith(".parquet") and name not in live \
+                    and not name.startswith("_"):
+                os.unlink(os.path.join(self.path, name))
+                removed += 1
+        return removed
+
+
+def _zorder_sort(df, zorder_by: List[str]):
+    """Sort rows by interleaved z-order key (device kernel)."""
+    import numpy as np
+
+    import jax
+
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.columnar.column import HostColumn
+    from spark_rapids_tpu.ops.zorder import interleave_bits
+
+    # materialize once, compute keys on device, argsort, rebuild
+    rows = df.collect()
+    schema = df.schema
+    names = schema.field_names()
+    data = {n: [r[i] for r in rows] for i, n in enumerate(names)}
+    batch = ColumnarBatch.from_host_columns(
+        [HostColumn.from_pylist(data[n], f.dataType)
+         for n, f in zip(names, schema.fields)], names)
+    key_cols = [batch.columns[names.index(z)] for z in zorder_by]
+    words = interleave_bits(key_cols)
+    n = batch.num_rows
+    keys = tuple(words) + (jax.numpy.arange(batch.capacity),)
+    sorted_keys = jax.lax.sort(keys, num_keys=len(words))
+    perm = np.asarray(sorted_keys[-1])
+    # padding rows carry zero keys and sort first; drop them
+    perm = perm[np.isin(perm, np.arange(n))][:n] if batch.capacity != n \
+        else perm
+    order = [int(i) for i in perm if i < n]
+    reordered = {nm: [data[nm][i] for i in order] for nm in names}
+    return df.session.create_dataframe(reordered, schema)
+
+
+# ---------------------------------------------------------------------------
+# read/write entry points (wired into session.read / DataFrameWriter)
+# ---------------------------------------------------------------------------
+
+def read_delta(session, path: str, version: Optional[int] = None):
+    log = DeltaLog(path)
+    snap = log.snapshot(version)
+    paths = snap.file_paths(path)
+    if not paths:
+        return session.create_dataframe(
+            {f.name: [] for f in snap.schema.fields}, snap.schema)
+    return session.read.schema(snap.schema).parquet(*paths)
+
+
+def write_delta(df, path: str, mode: str = "error",
+                partition_by: Optional[List[str]] = None) -> int:
+    """Write a DataFrame as a Delta commit; returns the new version."""
+    log = DeltaLog(path)
+    existing = log.latest_version()
+    if existing >= 0 and mode == "error":
+        raise FileExistsError(f"delta table already exists at {path}")
+    if existing >= 0 and mode == "ignore":
+        return existing
+    os.makedirs(path, exist_ok=True)
+    actions: List[dict] = []
+    if existing < 0:
+        actions.append(DeltaLog.protocol_action())
+        actions.append(log.metadata_action(df.schema, partition_by or []))
+    elif mode == "overwrite":
+        snap = log.snapshot()
+        actions.append(log.metadata_action(df.schema, partition_by or [],
+                                           snap.metadata_id))
+        actions.extend(DeltaLog.remove_action(a.path) for a in snap.files)
+    tbl = _df_to_arrow(df)
+    if tbl.num_rows:
+        info = _write_parquet_file(path, tbl)
+        actions.append(DeltaLog.add_action(info["path"], info["size"]))
+    return log.commit(actions)

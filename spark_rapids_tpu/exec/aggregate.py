@@ -34,6 +34,11 @@ from spark_rapids_tpu.ops.sortkeys import (
     group_segments,
 )
 from spark_rapids_tpu.plan.nodes import (
+    COVARIANCE_FUNCS,
+    HIGHER_MOMENT_FUNCS,
+    HLL_DEFAULT_P,
+    MOMENT_BUFFERS,
+    SINGLE_PHASE_FUNCS,
     VARIANCE_FUNCS,
     AggregateExpression,
     AggregateMode,
@@ -80,8 +85,7 @@ class TpuHashAggregateExec(TpuExec):
 
     @property
     def _has_collect(self) -> bool:
-        return any(a.func in ("collect_list", "collect_set")
-                   for a in self.aggregates)
+        return any(a.func in SINGLE_PHASE_FUNCS for a in self.aggregates)
 
     # ------------------------------------------------------------------
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
@@ -120,6 +124,13 @@ class TpuHashAggregateExec(TpuExec):
                                    T.storage_dtype(f.dataType.elementType)),
                     lengths=jnp.zeros(1, jnp.int32),
                     elem_valid=jnp.zeros((1, 1), jnp.bool_)))
+            elif a.func == "bloom_filter_agg":
+                words = int(a.args[1]) // 64
+                cols.append(DeviceColumn(
+                    f.dataType, jnp.ones(1, jnp.bool_),
+                    data=jnp.zeros((1, words), jnp.int64),
+                    lengths=jnp.full(1, words, jnp.int32),
+                    elem_valid=jnp.ones((1, words), jnp.bool_)))
             elif a.func in ("count", "count_star"):
                 cols.append(DeviceColumn(
                     f.dataType, jnp.ones(1, jnp.bool_),
@@ -272,14 +283,16 @@ class TpuHashAggregateExec(TpuExec):
                                  if key_cols else jnp.int32(1))
 
     def _buffer_widths(self) -> List[int]:
-        return [3 if a.func in VARIANCE_FUNCS else
-                (2 if a.func == "avg" else 1) for a in self.aggregates]
+        return [len(MOMENT_BUFFERS[a.func]) if a.func in MOMENT_BUFFERS
+                else (2 if a.func == "avg" else 1)
+                for a in self.aggregates]
 
     def _eval_merge(self, a, bufs, fields, perm, seg, mask_sorted, cap,
                     group_valid, nseg) -> List[DeviceColumn]:
         """Merge semantics per aggregate: sum->sum, count->sum, min->min,
         max->max, first->first, last->last, avg(sum,count)->(sum,sum)."""
-        func = "count" if a.func == "count_star" else a.func
+        func = ("count" if a.func in ("count_star", "count_if")
+                else a.func)
         if func in VARIANCE_FUNCS:
             cn, ca, cm = (c if perm is None else _gather_col(c, perm)
                           for c in bufs)
@@ -291,6 +304,35 @@ class TpuHashAggregateExec(TpuExec):
                 DeviceColumn(fa.dataType, group_valid & nz, data=mean),
                 DeviceColumn(fm.dataType, group_valid & nz, data=m2tot),
             ]
+        if func in HIGHER_MOMENT_FUNCS:
+            cs = [c if perm is None else _gather_col(c, perm) for c in bufs]
+            merged = _merge_moment_bufs(cs, mask_sorted, seg, nseg)
+            ntot, nz = merged[0], merged[1]
+            out = [DeviceColumn(fields[0].dataType, group_valid, data=ntot)]
+            for f, arr in zip(fields[1:], merged[2:]):
+                out.append(DeviceColumn(f.dataType, group_valid & nz,
+                                        data=arr))
+            return out
+        if func in COVARIANCE_FUNCS:
+            cs = [c if perm is None else _gather_col(c, perm) for c in bufs]
+            merged = _merge_cov_bufs(cs, mask_sorted, seg, nseg)
+            ntot, nz = merged[0], merged[1]
+            out = [DeviceColumn(fields[0].dataType, group_valid, data=ntot)]
+            for f, arr in zip(fields[1:], merged[2:]):
+                out.append(DeviceColumn(f.dataType, group_valid & nz,
+                                        data=arr))
+            return out
+        if a.func == "approx_count_distinct":
+            c = bufs[0] if perm is None else _gather_col(bufs[0], perm)
+            ok = c.validity & mask_sorted
+            m = c.ewidth
+            seg_safe = jnp.where(ok, seg, nseg)
+            regs = jnp.zeros((nseg, m), jnp.int32).at[seg_safe].max(
+                c.data.astype(jnp.int32), mode="drop")
+            lengths = jnp.full(nseg, m, jnp.int32)
+            ev = jnp.ones((nseg, m), jnp.bool_)
+            return [DeviceColumn(fields[0].dataType, group_valid, data=regs,
+                                 lengths=lengths, elem_valid=ev)]
         out = []
         for f, c in zip(fields, bufs):
             cs = c if perm is None else _gather_col(c, perm)
@@ -489,10 +531,11 @@ class TpuHashAggregateExec(TpuExec):
             if a.func == "avg" and self.mode == AggregateMode.PARTIAL:
                 out.append((fields[i], fields[i + 1]))
                 i += 2
-            elif (a.func in VARIANCE_FUNCS
+            elif (a.func in MOMENT_BUFFERS
                   and self.mode == AggregateMode.PARTIAL):
-                out.append((fields[i], fields[i + 1], fields[i + 2]))
-                i += 3
+                k = len(MOMENT_BUFFERS[a.func])
+                out.append(tuple(fields[i:i + k]))
+                i += k
             else:
                 out.append((fields[i],))
                 i += 1
@@ -529,6 +572,34 @@ class TpuHashAggregateExec(TpuExec):
         if func in VARIANCE_FUNCS:
             return self._eval_variance(a, fields, ctx, perm, seg, mask_sorted,
                                        cap, group_valid, nseg)
+        if func in HIGHER_MOMENT_FUNCS:
+            return self._eval_higher_moment(a, fields, ctx, perm, seg,
+                                            mask_sorted, cap, group_valid,
+                                            nseg)
+        if func in COVARIANCE_FUNCS:
+            return self._eval_covariance(a, fields, ctx, perm, seg,
+                                         mask_sorted, cap, group_valid, nseg)
+        if func == "count_if":
+            (f,) = fields
+            if mode == AggregateMode.FINAL:
+                c = self._input_col(a, ctx, perm)
+                s, _ = SEG.seg_sum(c.data, c.validity & mask_sorted, seg,
+                                   nseg)
+                cnt = s
+            else:
+                c = self._input_col(a, ctx, perm)
+                hit = c.validity & mask_sorted & c.data.astype(jnp.bool_)
+                cnt = SEG.seg_count(hit, seg, nseg)
+            return [DeviceColumn(T.LONG, group_valid, data=cnt)]
+        if func == "approx_count_distinct":
+            return self._eval_hll(a, fields, ctx, perm, seg, mask_sorted,
+                                  cap, group_valid, nseg)
+        if func in ("percentile", "approx_percentile"):
+            return self._eval_percentile(a, fields, ctx, perm, seg,
+                                         mask_sorted, cap, group_valid, nseg)
+        if func == "bloom_filter_agg":
+            return self._eval_bloom(a, fields, ctx, perm, seg, mask_sorted,
+                                    cap, group_valid, nseg)
         if func == "avg":
             sum_dt = (fields[0].dataType if mode == AggregateMode.PARTIAL
                       else (self.child_schema.fields[
@@ -692,6 +763,245 @@ class TpuHashAggregateExec(TpuExec):
         var = m2 / jnp.where(ok, den, 1.0)
         res = var if a.func.startswith("var") else jnp.sqrt(var)
         return [DeviceColumn(f.dataType, group_valid & nz & ok, data=res)]
+
+    def _numeric_f64(self, c: DeviceColumn) -> jax.Array:
+        x = c.data.astype(jnp.float64)
+        if isinstance(c.dtype, T.DecimalType):
+            x = x * jnp.float64(10.0 ** -c.dtype.scale)
+        return x
+
+    def _eval_higher_moment(self, a, fields, ctx, perm, seg, mask_sorted,
+                            cap, group_valid, nseg) -> List[DeviceColumn]:
+        """skewness / kurtosis: central moments up to m3/m4.
+
+        Reference analog: Spark Skewness/Kurtosis (CentralMomentAgg with
+        momentOrder 3/4), GPU'd in org/apache/spark/sql/rapids/aggregate.
+        Merging uses the closed forms m3 = Σm3_i + 3Σm2_i·d_i + Σn_i·d_i³
+        (and the order-4 analog), which are plain segmented sums — no
+        sequential pairwise Chan recursion needed."""
+        want_m4 = a.func == "kurtosis"
+        if self.mode == AggregateMode.FINAL:
+            from spark_rapids_tpu.plan.nodes import MOMENT_BUFFERS as _MB
+
+            bufs = [self._input_col(a, ctx, perm, s)
+                    for s in _MB[a.func]]
+            merged = _merge_moment_bufs(bufs, mask_sorted, seg, nseg)
+            if want_m4:
+                ntot, nz, mean, m2, m3, m4 = merged
+            else:
+                ntot, nz, mean, m2, m3 = merged
+        else:
+            c = self._input_col(a, ctx, perm)
+            valid = c.validity & mask_sorted
+            x = jnp.where(valid, self._numeric_f64(c), 0.0)
+            ntot = SEG.seg_count(valid, seg, nseg).astype(jnp.float64)
+            s, _ = SEG.seg_sum(x, valid, seg, nseg)
+            nz = ntot > 0
+            mean = s / jnp.where(nz, ntot, 1.0)
+            d = jnp.where(valid, x - mean[seg], 0.0)
+            m2, _ = SEG.seg_sum(d * d, valid, seg, nseg)
+            m3, _ = SEG.seg_sum(d ** 3, valid, seg, nseg)
+            if want_m4:
+                m4, _ = SEG.seg_sum(d ** 4, valid, seg, nseg)
+        if self.mode == AggregateMode.PARTIAL:
+            cols = [ntot, mean, m2, m3] + ([m4] if want_m4 else [])
+            out = [DeviceColumn(fields[0].dataType, group_valid, data=ntot)]
+            for f, arr in zip(fields[1:], cols[1:]):
+                out.append(DeviceColumn(f.dataType, group_valid & nz,
+                                        data=arr))
+            return out
+        (f,) = fields
+        # Spark nullOnDivideByZero: m2 == 0 (or empty) -> NULL
+        ok_res = nz & (m2 != 0.0)
+        safe_m2 = jnp.where(ok_res, m2, 1.0)
+        if want_m4:
+            res = ntot * m4 / (safe_m2 * safe_m2) - 3.0
+        else:
+            res = jnp.sqrt(ntot) * m3 / jnp.power(safe_m2, 1.5)
+        return [DeviceColumn(f.dataType, group_valid & ok_res, data=res)]
+
+    def _eval_covariance(self, a, fields, ctx, perm, seg, mask_sorted, cap,
+                         group_valid, nseg) -> List[DeviceColumn]:
+        """covar_pop / covar_samp / corr — Spark Covariance/Corr buffers
+        (n, xAvg, yAvg, ck [, xMk, yMk]); rows count only when BOTH inputs
+        are non-null."""
+        is_corr = a.func == "corr"
+        if self.mode == AggregateMode.FINAL:
+            from spark_rapids_tpu.plan.nodes import MOMENT_BUFFERS as _MB
+
+            bufs = [self._input_col(a, ctx, perm, s)
+                    for s in _MB[a.func]]
+            merged = _merge_cov_bufs(bufs, mask_sorted, seg, nseg)
+            if is_corr:
+                ntot, nz, xavg, yavg, ck, xm2, ym2 = merged
+            else:
+                ntot, nz, xavg, yavg, ck = merged
+        else:
+            x_col = a.child.eval_tpu(ctx)
+            y_col = a.child2.eval_tpu(ctx)
+            if perm is not None:
+                x_col = _gather_col(x_col, perm)
+                y_col = _gather_col(y_col, perm)
+            valid = x_col.validity & y_col.validity & mask_sorted
+            x = jnp.where(valid, self._numeric_f64(x_col), 0.0)
+            y = jnp.where(valid, self._numeric_f64(y_col), 0.0)
+            ntot = SEG.seg_count(valid, seg, nseg).astype(jnp.float64)
+            nz = ntot > 0
+            sx, _ = SEG.seg_sum(x, valid, seg, nseg)
+            sy, _ = SEG.seg_sum(y, valid, seg, nseg)
+            xavg = sx / jnp.where(nz, ntot, 1.0)
+            yavg = sy / jnp.where(nz, ntot, 1.0)
+            dx = jnp.where(valid, x - xavg[seg], 0.0)
+            dy = jnp.where(valid, y - yavg[seg], 0.0)
+            ck, _ = SEG.seg_sum(dx * dy, valid, seg, nseg)
+            if is_corr:
+                xm2, _ = SEG.seg_sum(dx * dx, valid, seg, nseg)
+                ym2, _ = SEG.seg_sum(dy * dy, valid, seg, nseg)
+        if self.mode == AggregateMode.PARTIAL:
+            bufs = [ntot, xavg, yavg, ck] + ([xm2, ym2] if is_corr else [])
+            out = [DeviceColumn(fields[0].dataType, group_valid, data=ntot)]
+            for f, arr in zip(fields[1:], bufs[1:]):
+                out.append(DeviceColumn(f.dataType, group_valid & nz,
+                                        data=arr))
+            return out
+        (f,) = fields
+        if is_corr:
+            # zero variance -> NaN via natural fp division (Spark Corr)
+            res = ck / jnp.sqrt(xm2 * ym2)
+            return [DeviceColumn(f.dataType, group_valid & nz, data=res)]
+        if a.func == "covar_pop":
+            res = ck / jnp.where(nz, ntot, 1.0)
+            return [DeviceColumn(f.dataType, group_valid & nz, data=res)]
+        ok_res = ntot > 1.0
+        res = ck / jnp.where(ok_res, ntot - 1.0, 1.0)
+        return [DeviceColumn(f.dataType, group_valid & ok_res, data=res)]
+
+    def _eval_hll(self, a, fields, ctx, perm, seg, mask_sorted, cap,
+                  group_valid, nseg) -> List[DeviceColumn]:
+        """approx_count_distinct — HyperLogLog++ registers per group.
+
+        Reference analog: GpuHyperLogLogPlusPlus (spark-rapids-jni HLL
+        sketch, SURVEY.md §2.4).  TPU design: registers live as a padded
+        list column (one m-wide int32 row per group), built with one
+        scatter-max; partial merge is another scatter-max.  Estimation uses
+        the standard HLL++ raw/linear-counting split WITHOUT Spark's
+        empirical bias tables (documented TypeSig note)."""
+        from spark_rapids_tpu.ops.hashing import xxhash64_column
+
+        p = HLL_DEFAULT_P
+        m = 1 << p
+        (f,) = fields
+        if self.mode == AggregateMode.FINAL:
+            c = self._input_col(a, ctx, perm, "_hll")  # list col (cap, m)
+            ok = c.validity & mask_sorted
+            seg_safe = jnp.where(ok, seg, nseg)
+            regs = jnp.zeros((nseg, m), jnp.int32).at[seg_safe].max(
+                c.data.astype(jnp.int32), mode="drop")
+        else:
+            c = self._input_col(a, ctx, perm)
+            valid = c.validity & mask_sorted
+            h = xxhash64_column(c, jnp.full(cap, jnp.uint64(42)))
+            h = h.view(jnp.int64)
+            idx = jnp.right_shift(h, 64 - p) & (m - 1)
+            w = jnp.left_shift(h, p)
+            rank = jnp.minimum(jax.lax.clz(w) + 1, 65 - p).astype(jnp.int32)
+            seg_safe = jnp.where(valid, seg, nseg)
+            regs = jnp.zeros((nseg, m), jnp.int32).at[
+                seg_safe, idx].max(rank, mode="drop")
+        if self.mode == AggregateMode.PARTIAL:
+            lengths = jnp.full(nseg, m, jnp.int32)
+            ev = jnp.ones((nseg, m), jnp.bool_)
+            return [DeviceColumn(f.dataType, group_valid, data=regs,
+                                 lengths=lengths, elem_valid=ev)]
+        alpha = 0.7213 / (1.0 + 1.079 / m)
+        inv = jnp.sum(jnp.exp2(-regs.astype(jnp.float64)), axis=1)
+        raw = alpha * m * m / inv
+        zeros = jnp.sum(regs == 0, axis=1).astype(jnp.float64)
+        lin = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+        est = jnp.where((raw <= 2.5 * m) & (zeros > 0), lin, raw)
+        cnt = jnp.round(est).astype(jnp.int64)
+        return [DeviceColumn(T.LONG, group_valid, data=cnt)]
+
+    def _eval_percentile(self, a, fields, ctx, perm, seg, mask_sorted, cap,
+                         group_valid, nseg) -> List[DeviceColumn]:
+        """percentile (exact, interpolated) / approx_percentile (element at
+        floor(p*(n-1)), exact while the group fits in one batch — the GK
+        summary is uncompressed below the accuracy threshold, which is the
+        same answer).  Single-phase COMPLETE (planned like collect_list)."""
+        (f,) = fields
+        pct = jnp.float64(a.args[0])
+        c = self._input_col(a, ctx, perm)
+        valid = c.validity & mask_sorted
+        # sort values within their (already sorted) segments; invalid last
+        tier = (~valid).astype(jnp.int32)
+        vkey = c.data.astype(jnp.int64) if not _is_float(c.dtype) else None
+        if vkey is None:
+            from spark_rapids_tpu.ops.sortkeys import _float_total_order
+
+            f64 = c.data.astype(jnp.float64)
+            bits = jax.lax.bitcast_convert_type(f64, jnp.int64)
+            bits = jnp.where(jnp.isnan(f64),
+                             jnp.int64(0x7FF8000000000000), bits)
+            vkey = _float_total_order(bits)
+        seg_key = jnp.where(mask_sorted, seg, nseg)
+        _, _, _, sdata = jax.lax.sort(
+            (seg_key.astype(jnp.int32), tier, vkey, c.data),
+            dimension=0, num_keys=3, is_stable=True)
+        nv = SEG.seg_count(valid, seg, nseg)
+        starts = SEG.seg_first_index(seg, mask_sorted, nseg)
+        has = nv > 0
+        r = pct * (jnp.maximum(nv, 1) - 1).astype(jnp.float64)
+        lo = jnp.floor(r).astype(jnp.int64)
+        hi = jnp.ceil(r).astype(jnp.int64)
+        frac = r - lo.astype(jnp.float64)
+        gi_lo = jnp.clip(starts + lo, 0, cap - 1)
+        gi_hi = jnp.clip(starts + hi, 0, cap - 1)
+        v_lo = sdata[gi_lo]
+        v_hi = sdata[gi_hi]
+        validity = group_valid & has
+        if a.func == "approx_percentile":
+            return [DeviceColumn(f.dataType, validity,
+                                 data=v_lo.astype(T.storage_dtype(
+                                     f.dataType)))]
+        scale = (jnp.float64(10.0 ** -c.dtype.scale)
+                 if isinstance(c.dtype, T.DecimalType) else jnp.float64(1.0))
+        res = (v_lo.astype(jnp.float64) * (1.0 - frac)
+               + v_hi.astype(jnp.float64) * frac) * scale
+        return [DeviceColumn(T.DOUBLE, validity, data=res)]
+
+    def _eval_bloom(self, a, fields, ctx, perm, seg, mask_sorted, cap,
+                    group_valid, nseg) -> List[DeviceColumn]:
+        """bloom_filter_agg — the GpuBloomFilterAggregate analog.
+
+        Layout: array<long> of num_bits/64 words (double hashing with
+        xxhash64 seeds 42 and 77; NOT byte-compatible with Spark's sketch
+        serialization — probed by BloomFilterMightContain with the same
+        parameters)."""
+        import math as _math
+
+        from spark_rapids_tpu.ops.hashing import xxhash64_column
+
+        (f,) = fields
+        num_items, num_bits = int(a.args[0]), int(a.args[1])
+        words = num_bits // 64
+        k = max(1, round(num_bits / num_items * _math.log(2)))
+        c = self._input_col(a, ctx, perm)
+        valid = c.validity & mask_sorted
+        h1 = xxhash64_column(c, jnp.full(cap, jnp.uint64(42))).view(jnp.int64)
+        h2 = xxhash64_column(c, jnp.full(cap, jnp.uint64(77))).view(jnp.int64)
+        bits = jnp.zeros((nseg, num_bits), jnp.bool_)
+        seg_safe = jnp.where(valid, seg, nseg)
+        for j in range(k):
+            bit = jnp.remainder(h1 + j * h2, num_bits)
+            bits = bits.at[seg_safe, bit].set(True, mode="drop")
+        packed = bits.reshape(nseg, words, 64)
+        weights = jnp.left_shift(jnp.int64(1), jnp.arange(64, dtype=jnp.int64))
+        data = jnp.sum(packed.astype(jnp.int64) * weights[None, None, :],
+                       axis=2)
+        lengths = jnp.full(nseg, words, jnp.int32)
+        ev = jnp.ones((nseg, words), jnp.bool_)
+        return [DeviceColumn(f.dataType, group_valid, data=data,
+                             lengths=lengths, elem_valid=ev)]
 
     def _eval_collect(self, a, f, c: DeviceColumn, validity, seg,
                       mask_sorted, cap, group_valid, nseg) -> DeviceColumn:
@@ -899,6 +1209,57 @@ def _chan_merge(cn: DeviceColumn, ca: DeviceColumn, cm: DeviceColumn,
     return ntot, nz, mean, m2
 
 
+def _merge_moment_bufs(cs, mask_sorted, seg, nseg):
+    """Merge (n, avg, m2, m3[, m4]) buffer columns per segment using the
+    order-independent closed forms (Pébay's formulas reduced to segmented
+    sums).  -> (ntot, nz, mean, m2, m3[, m4])."""
+    cn, ca, cm2, cm3 = cs[:4]
+    cm4 = cs[4] if len(cs) > 4 else None
+    ok = cn.validity & mask_sorted
+    ni = jnp.where(ok, cn.data, 0.0)
+    ntot, _ = SEG.seg_sum(ni, ok, seg, nseg)
+    nz = ntot > 0
+    s, _ = SEG.seg_sum(ni * jnp.where(ok, ca.data, 0.0), ok, seg, nseg)
+    mean = s / jnp.where(nz, ntot, 1.0)
+    d = jnp.where(ok, ca.data - mean[seg], 0.0)
+    m2i = jnp.where(ok, cm2.data, 0.0)
+    m3i = jnp.where(ok, cm3.data, 0.0)
+    m2, _ = SEG.seg_sum(m2i + ni * d * d, ok, seg, nseg)
+    m3, _ = SEG.seg_sum(m3i + 3.0 * m2i * d + ni * d ** 3, ok, seg, nseg)
+    if cm4 is None:
+        return ntot, nz, mean, m2, m3
+    m4i = jnp.where(ok, cm4.data, 0.0)
+    m4, _ = SEG.seg_sum(
+        m4i + 4.0 * m3i * d + 6.0 * m2i * d * d + ni * d ** 4, ok, seg,
+        nseg)
+    return ntot, nz, mean, m2, m3, m4
+
+
+def _merge_cov_bufs(cs, mask_sorted, seg, nseg):
+    """Merge (n, xavg, yavg, ck[, xm2, ym2]) covariance buffers per
+    segment. -> (ntot, nz, xavg, yavg, ck[, xm2, ym2])."""
+    cn, cx, cy, cc = cs[:4]
+    ok = cn.validity & mask_sorted
+    ni = jnp.where(ok, cn.data, 0.0)
+    ntot, _ = SEG.seg_sum(ni, ok, seg, nseg)
+    nz = ntot > 0
+    sx, _ = SEG.seg_sum(ni * jnp.where(ok, cx.data, 0.0), ok, seg, nseg)
+    sy, _ = SEG.seg_sum(ni * jnp.where(ok, cy.data, 0.0), ok, seg, nseg)
+    xavg = sx / jnp.where(nz, ntot, 1.0)
+    yavg = sy / jnp.where(nz, ntot, 1.0)
+    dx = jnp.where(ok, cx.data - xavg[seg], 0.0)
+    dy = jnp.where(ok, cy.data - yavg[seg], 0.0)
+    cki = jnp.where(ok, cc.data, 0.0)
+    ck, _ = SEG.seg_sum(cki + ni * dx * dy, ok, seg, nseg)
+    if len(cs) <= 4:
+        return ntot, nz, xavg, yavg, ck
+    xm2, _ = SEG.seg_sum(jnp.where(ok, cs[4].data, 0.0) + ni * dx * dx,
+                         ok, seg, nseg)
+    ym2, _ = SEG.seg_sum(jnp.where(ok, cs[5].data, 0.0) + ni * dy * dy,
+                         ok, seg, nseg)
+    return ntot, nz, xavg, yavg, ck, xm2, ym2
+
+
 def _seg_last_index(seg, row_mask, num_segments):
     n = seg.shape[0]
     iota = jnp.arange(n, dtype=jnp.int32)
@@ -907,7 +1268,4 @@ def _seg_last_index(seg, row_mask, num_segments):
 
 
 def _gather_col(c: DeviceColumn, idx) -> DeviceColumn:
-    if c.is_string:
-        return DeviceColumn(c.dtype, c.validity[idx], chars=c.chars[idx],
-                            lengths=c.lengths[idx])
-    return DeviceColumn(c.dtype, c.validity[idx], data=c.data[idx])
+    return c.gather(idx)

@@ -155,6 +155,20 @@ class TpuStageExec(TpuExec):
         names = "+".join(type(o).__name__.replace("Op", "") for o in self.ops)
         return f"TpuStageExec[{names}]"
 
+    def _op_expressions(self) -> List[Expression]:
+        out: List[Expression] = []
+        for op in self.ops:
+            out.extend(getattr(op, "exprs", []) or [])
+            cond = getattr(op, "condition", None)
+            if cond is not None:
+                out.append(cond)
+        return out
+
+    def _has_host_kernels(self) -> bool:
+        from spark_rapids_tpu.expr.base import contains_host_kernel
+
+        return any(contains_host_kernel(e) for e in self._op_expressions())
+
     def _build(self, in_schema: T.StructType):
         ops = self.ops
         ansi = self.ansi
@@ -171,7 +185,15 @@ class TpuStageExec(TpuExec):
             flags = tuple(jnp.any(f) for f, _ in ctx.error_flags)
             return batch.columns, jnp.asarray(batch.num_rows), flags
 
-        jitted = jax.jit(fn)
+        # host-kernel expressions (JSON, digests, ... — jax.pure_callback)
+        # cannot live inside a compiled TPU program (the PJRT plugin has no
+        # host-callback channel); the stage runs op-by-op eagerly instead —
+        # callbacks execute directly and the jnp ops still dispatch to the
+        # device.  CPU/test backends jit as usual.
+        if self._has_host_kernels():
+            jitted = fn
+        else:
+            jitted = jax.jit(fn)
 
         def run(batch: ColumnarBatch) -> ColumnarBatch:
             cols, count, flags = jitted(

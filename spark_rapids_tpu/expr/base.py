@@ -68,8 +68,32 @@ class EvalContext:
         self.error_flags.clear()
 
 
+def contains_host_kernel(e: "Expression") -> bool:
+    """True if any node needs a host callback (cannot be jit-compiled on
+    backends without a PJRT host-callback channel, e.g. the axon TPU
+    tunnel) — the enclosing stage then runs eagerly."""
+    return bool(e.collect(lambda x: getattr(x, "is_host_kernel", False)))
+
+
+def call_host_kernel(fn, shapes, *args):
+    """Run a host kernel over device arrays.
+
+    Under a trace: jax.pure_callback (CPU/test backends compile this fine).
+    Concrete arrays: call directly — mandatory on the axon TPU tunnel,
+    whose PJRT plugin has no host-callback channel at all (even the eager
+    pure_callback impl compiles a program)."""
+    import jax.core
+
+    if any(isinstance(a, jax.core.Tracer) for a in args):
+        return jax.pure_callback(fn, shapes, *args)
+    res = fn(*(np.asarray(a) for a in args))
+    return jax.tree_util.tree_map(jnp.asarray, res)
+
+
 class Expression:
     """Base expression; subclasses set children and implement do_columnar_eval."""
+
+    is_host_kernel = False  # True: evaluates via jax.pure_callback
 
     def __init__(self, children: Sequence["Expression"] = ()):
         self.children: List[Expression] = list(children)

@@ -499,6 +499,21 @@ class Sequence(Expression):
         self._dataType = T.ArrayType(self.children[0].dataType)
         self._nullable = True
 
+    def _static_width(self) -> int:
+        """Literal bounds shrink the padded element width (the 1024-wide
+        default would cost capacity*8KB per batch otherwise)."""
+        from spark_rapids_tpu.expr.base import Literal as _Lit
+
+        kids = self.children
+        if all(isinstance(k, _Lit) and k.value is not None for k in kids):
+            start, stop = int(kids[0].value), int(kids[1].value)
+            step = int(kids[2].value) if len(kids) > 2 else (
+                1 if stop >= start else -1)
+            if step != 0 and (stop - start) * step >= 0:
+                n = abs(stop - start) // abs(step) + 1
+                return max(min(n, self.MAX_ELEMENTS), 1)
+        return self.MAX_ELEMENTS
+
     def do_columnar_eval(self, ctx: EvalContext, cols):
         start = cols[0].data.astype(jnp.int64)
         stop = cols[1].data.astype(jnp.int64)
@@ -523,7 +538,7 @@ class Sequence(Expression):
                       f"sequence length above the TPU element cap "
                       f"({self.MAX_ELEMENTS})")
         count = jnp.minimum(count, self.MAX_ELEMENTS).astype(jnp.int32)
-        w = self.MAX_ELEMENTS
+        w = self._static_width()
         pos = jnp.arange(w, dtype=jnp.int64)[None, :]
         vals = start[:, None] + pos * safe_step[:, None]
         take = pos < count[:, None]

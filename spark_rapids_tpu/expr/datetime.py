@@ -412,3 +412,54 @@ class DateFormat(_FormatBase):
         else:
             micros = c.data
         return self._render(c, micros)
+
+
+class _UtcTzShift(BinaryExpression):
+    """Base for from_utc_timestamp / to_utc_timestamp.
+
+    Reference analog: GpuFromUTCTimestamp/GpuToUTCTimestamp via
+    GpuTimeZoneDB (jni timezones.cu).  The zone's transition tables
+    (spark_rapids_tpu/tzdb.py, parsed from TZif + POSIX footer rules)
+    upload once; every row resolves its offset with one vectorized
+    searchsorted — same shape as the reference's device binary search."""
+
+    _to_utc = False
+
+    def _resolve_type(self):
+        self._dataType = T.TIMESTAMP
+        self._nullable = True
+        from spark_rapids_tpu.expr.base import Literal
+
+        self._tz = None
+        if isinstance(self.right, Literal) and self.right.value is not None:
+            self._tz = str(self.right.value)
+
+    def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.tzdb import zone_tables
+
+        c = cols[0]
+        tables = zone_tables(self._tz)
+        offsets = jnp.asarray(tables["offsets"])
+        key = "wall_starts" if self._to_utc else "utc_instants"
+        bounds = jnp.asarray(tables[key])
+        secs = jnp.floor_divide(c.data.astype(jnp.int64), 1_000_000)
+        idx = jnp.searchsorted(bounds, secs, side="right") - 1
+        off = offsets[jnp.clip(idx, 0, offsets.shape[0] - 1)]
+        shift = off * jnp.int64(1_000_000)
+        data = c.data - shift if self._to_utc else c.data + shift
+        validity = c.validity & cols[1].validity
+        return DeviceColumn(T.TIMESTAMP, validity, data=data)
+
+
+class FromUTCTimestamp(_UtcTzShift):
+    """from_utc_timestamp(ts, tz): render a UTC instant in tz's wall
+    clock."""
+
+    _to_utc = False
+
+
+class ToUTCTimestamp(_UtcTzShift):
+    """to_utc_timestamp(ts, tz): interpret ts as tz wall time; gap/overlap
+    resolution matches java.time (forward shift / earlier offset)."""
+
+    _to_utc = True

@@ -50,3 +50,44 @@ class XxHash64(Expression):
         h = xxhash64_columns(cols, seed=self.seed)
         return DeviceColumn(T.LONG, jnp.ones(cols[0].capacity, jnp.bool_),
                             data=h)
+
+
+class BloomFilterMightContain(Expression):
+    """might_contain(bloom, value) — probes a bloom_filter_agg result.
+
+    Reference analog: GpuBloomFilterMightContain (spark-rapids-jni
+    bloom_filter.cu), the runtime-filter join pushdown probe.  The filter
+    is an array<long> of words built by bloom_filter_agg with matching
+    (num_items, num_bits); double hashing with xxhash64 seeds 42/77 (layout
+    documented in exec/aggregate.TpuHashAggregateExec._eval_bloom — NOT
+    byte-compatible with Spark's sketch serialization)."""
+
+    def __init__(self, bloom, value, num_items: int = 4096,
+                 num_bits: int = 65536):
+        super().__init__([bloom, value])
+        self.num_items = int(num_items)
+        self.num_bits = int(num_bits)
+
+    def _resolve_type(self):
+        self._dataType = T.BOOLEAN
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        import math as _math
+
+        bloom, v = cols
+        k = max(1, round(self.num_bits / self.num_items * _math.log(2)))
+        cap = v.capacity
+        h1 = xxhash64_columns([v], seed=42)
+        h2 = xxhash64_columns([v], seed=77)
+        hit = jnp.ones(cap, jnp.bool_)
+        ew = max(bloom.ewidth, 1)
+        for j in range(k):
+            bit = jnp.remainder(h1 + j * h2, self.num_bits)
+            word_idx = jnp.clip(bit // 64, 0, ew - 1)
+            word = jnp.take_along_axis(bloom.data,
+                                       word_idx[:, None], axis=1)[:, 0]
+            hit = hit & (jnp.bitwise_and(
+                jnp.right_shift(word, bit % 64), 1) == 1)
+        validity = bloom.validity & v.validity
+        return DeviceColumn(T.BOOLEAN, validity, data=hit)

@@ -24,6 +24,7 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.column import DeviceColumn
 from spark_rapids_tpu.expr.base import (
     BinaryExpression,
+    call_host_kernel,
     EvalContext,
     Expression,
     Literal,
@@ -58,7 +59,7 @@ def _callback_string_result(c: DeviceColumn, fn):
     shapes = (jax.ShapeDtypeStruct((cap, w), np.uint8),
               jax.ShapeDtypeStruct((cap,), np.int32),
               jax.ShapeDtypeStruct((cap,), np.bool_))
-    out_chars, out_lens, out_valid = jax.pure_callback(
+    out_chars, out_lens, out_valid = call_host_kernel(
         fn, shapes, c.chars, c.lengths, c.validity)
     return DeviceColumn(T.STRING, out_valid, chars=out_chars,
                         lengths=out_lens)
@@ -67,6 +68,8 @@ def _callback_string_result(c: DeviceColumn, fn):
 class GetJsonObject(BinaryExpression):
     """get_json_object(json, path) — path must be a literal (Spark requires
     foldable); wildcard paths are rejected at plan time."""
+
+    is_host_kernel = True
 
     def _resolve_type(self):
         self._dataType = T.STRING
@@ -99,6 +102,8 @@ class JsonTuple(Expression):
     Spark plans json_tuple as a generator (one row, N columns); the TPU
     build returns a struct column (same capability; flattened by a
     Project of GetStructField)."""
+
+    is_host_kernel = True
 
     def __init__(self, children: List[Expression]):
         super().__init__(children)
@@ -152,7 +157,7 @@ class JsonTuple(Expression):
                   jax.ShapeDtypeStruct((len(keys), cap), np.int32),
                   jax.ShapeDtypeStruct((len(keys), cap), np.bool_))
         if keys:
-            och, oln, ova = jax.pure_callback(fn, shapes, c.chars,
+            och, oln, ova = call_host_kernel(fn, shapes, c.chars,
                                               c.lengths, c.validity)
         kids = []
         for slot in range(len(self._keys)):
@@ -209,6 +214,8 @@ class JsonToStructs(UnaryExpression):
     PERMISSIVE semantics: a malformed record (or a field/type mismatch)
     yields a row with every field NULL; a SQL NULL input yields a NULL
     struct."""
+
+    is_host_kernel = True
 
     def __init__(self, child: Expression, schema: T.StructType):
         super().__init__(child)
@@ -285,7 +292,7 @@ class JsonToStructs(UnaryExpression):
                     (cap,), T.storage_dtype(f.dataType)),
                     jax.ShapeDtypeStruct((cap,), np.bool_)]
         shapes.append(jax.ShapeDtypeStruct((cap,), np.bool_))
-        flat = jax.pure_callback(fn, tuple(shapes), c.chars, c.lengths,
+        flat = call_host_kernel(fn, tuple(shapes), c.chars, c.lengths,
                                  c.validity)
         kids = []
         pos = 0
@@ -308,6 +315,8 @@ def _json_escape(s: str) -> str:
 
 class StructsToJson(UnaryExpression):
     """to_json(struct) — null fields omitted (Spark ignoreNullFields)."""
+
+    is_host_kernel = True
 
     def _resolve_type(self):
         if not isinstance(self.child.dataType, T.StructType):
@@ -387,7 +396,7 @@ class StructsToJson(UnaryExpression):
                 args += [kid.chars, kid.lengths, kid.validity & c.validity]
             else:
                 args += [kid.data, kid.validity & c.validity]
-        out_chars, out_lens, out_valid = jax.pure_callback(
+        out_chars, out_lens, out_valid = call_host_kernel(
             fn, shapes, *args)
         return DeviceColumn(T.STRING, out_valid, chars=out_chars,
                             lengths=out_lens)

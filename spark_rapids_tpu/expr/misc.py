@@ -1,0 +1,716 @@
+"""Misc expression breadth: digests, encodings, number formatting, URL
+parsing, soundex, ids, rand.
+
+Reference analogs (SURVEY.md §2.5): GpuMd5 (cudf md5), GpuSha1/GpuSha2,
+GpuCrc32, GpuBase64/GpuUnBase64, GpuHex/GpuUnhex, GpuConv (jni conv.cu),
+GpuFormatNumber (jni format_float.cu), GpuParseUrl (jni parse_uri.cu),
+GpuMonotonicallyIncreasingID, GpuSparkPartitionID, GpuRand.
+
+TPU design notes:
+  * digest/encoding/url functions are irregular byte-twiddling with no MXU
+    upside; like JSON they run as host kernels behind jax.pure_callback
+    (SURVEY.md §2.10 item 10's host-parse stance) — levenshtein, which IS
+    dense-vectorizable, runs on device as a lax.scan DP.
+  * Rand uses jax's counter-based threefry keyed on (seed, row_id): a
+    deterministic, seedable stream, but NOT Spark's XORShiftRandom
+    sequence (TypeSig note; the reference matches Spark bit-exactly, which
+    a counter-based TPU PRNG deliberately does not attempt).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expr.base import (
+    BinaryExpression,
+    call_host_kernel,
+    EvalContext,
+    Expression,
+    UnaryExpression,
+)
+
+
+def _host_string_map(c: DeviceColumn, out_width: int,
+                     fn: Callable[[bytes], Optional[bytes]]) -> DeviceColumn:
+    """Row-wise bytes->bytes host kernel behind pure_callback."""
+    cap = c.capacity
+
+    def run(chars, lengths, validity):
+        chars = np.asarray(chars)
+        lengths = np.asarray(lengths)
+        validity = np.asarray(validity)
+        out_chars = np.zeros((cap, out_width), np.uint8)
+        out_lens = np.zeros(cap, np.int32)
+        out_valid = np.zeros(cap, np.bool_)
+        for i in range(cap):
+            if not validity[i]:
+                continue
+            res = fn(bytes(chars[i, :lengths[i]]))
+            if res is None:
+                continue
+            res = res[:out_width]
+            out_chars[i, :len(res)] = np.frombuffer(res, np.uint8)
+            out_lens[i] = len(res)
+            out_valid[i] = True
+        return out_chars, out_lens, out_valid
+
+    shapes = (jax.ShapeDtypeStruct((cap, out_width), np.uint8),
+              jax.ShapeDtypeStruct((cap,), np.int32),
+              jax.ShapeDtypeStruct((cap,), np.bool_))
+    och, oln, ova = call_host_kernel(run, shapes, c.chars, c.lengths,
+                                      c.validity)
+    return DeviceColumn(T.STRING, ova, chars=och, lengths=oln)
+
+
+class _HostStringUnary(UnaryExpression):
+    """Base for string->string host-kernel expressions."""
+
+    is_host_kernel = True
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def _out_width(self, c: DeviceColumn) -> int:
+        return max(c.width, 1)
+
+    def _fn(self, b: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def do_columnar_eval(self, ctx, cols):
+        return _host_string_map(cols[0], self._out_width(cols[0]), self._fn)
+
+
+class Md5(_HostStringUnary):
+    def _out_width(self, c):
+        return 32
+
+    def _fn(self, b):
+        import hashlib
+
+        return hashlib.md5(b).hexdigest().encode()
+
+
+class Sha1(_HostStringUnary):
+    def _out_width(self, c):
+        return 40
+
+    def _fn(self, b):
+        import hashlib
+
+        return hashlib.sha1(b).hexdigest().encode()
+
+
+class Sha2(Expression):
+    """sha2(s, bitLength) with bitLength in {0(=256), 224, 256, 384, 512}."""
+
+    is_host_kernel = True
+
+    def __init__(self, child: Expression, bits: Expression):
+        super().__init__([child, bits])
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+        from spark_rapids_tpu.expr.base import Literal
+
+        self._bits = None
+        if isinstance(self.children[1], Literal) \
+                and self.children[1].value is not None:
+            self._bits = int(self.children[1].value)
+
+    def do_columnar_eval(self, ctx, cols):
+        import hashlib
+
+        bits = self._bits
+        algo = {0: "sha256", 224: "sha224", 256: "sha256",
+                384: "sha384", 512: "sha512"}.get(bits)
+
+        def fn(b):
+            if algo is None:
+                return None  # Spark: invalid bit length -> null
+            return getattr(hashlib, algo)(b).hexdigest().encode()
+
+        return _host_string_map(cols[0], 128, fn)
+
+
+class Crc32(UnaryExpression):
+    is_host_kernel = True
+
+    def _resolve_type(self):
+        self._dataType = T.LONG
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        import zlib
+
+        c = cols[0]
+        cap = c.capacity
+
+        def run(chars, lengths, validity):
+            chars = np.asarray(chars)
+            lengths = np.asarray(lengths)
+            validity = np.asarray(validity)
+            out = np.zeros(cap, np.int64)
+            for i in range(cap):
+                if validity[i]:
+                    out[i] = zlib.crc32(bytes(chars[i, :lengths[i]]))
+            return (out,)
+
+        (data,) = call_host_kernel(
+            run, (jax.ShapeDtypeStruct((cap,), np.int64),),
+            c.chars, c.lengths, c.validity)
+        return DeviceColumn(T.LONG, c.validity, data=data)
+
+
+class Base64(_HostStringUnary):
+    def _out_width(self, c):
+        return ((max(c.width, 1) + 2) // 3) * 4
+
+    def _fn(self, b):
+        import base64 as b64
+
+        return b64.b64encode(b)
+
+
+class UnBase64(_HostStringUnary):
+    """unbase64 -> binary; surfaced as a string column (the engine's
+    binary representation)."""
+
+    is_host_kernel = True
+
+    def _fn(self, b):
+        import base64 as b64
+
+        try:
+            return b64.b64decode(b, validate=False)
+        except Exception:
+            return None
+
+
+_CHARSETS = {"utf-8", "utf8", "us-ascii", "iso-8859-1", "utf-16", "utf-16be",
+             "utf-16le"}
+
+
+class Encode(Expression):
+    """encode(str, charset) -> binary (string column)."""
+
+    is_host_kernel = True
+
+    def __init__(self, child: Expression, charset: Expression):
+        super().__init__([child, charset])
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+        from spark_rapids_tpu.expr.base import Literal
+
+        self._charset = None
+        if isinstance(self.children[1], Literal) \
+                and self.children[1].value is not None:
+            self._charset = str(self.children[1].value).lower()
+
+    def do_columnar_eval(self, ctx, cols):
+        cs = self._charset
+
+        def fn(b):
+            try:
+                return b.decode("utf-8").encode(cs)
+            except (UnicodeError, LookupError, TypeError):
+                return None
+
+        return _host_string_map(cols[0], max(cols[0].width * 4, 4), fn)
+
+
+class Decode(Encode):
+    """decode(binary, charset) -> string."""
+
+    def do_columnar_eval(self, ctx, cols):
+        cs = self._charset
+
+        def fn(b):
+            try:
+                return b.decode(cs).encode("utf-8")
+            except (UnicodeError, LookupError, TypeError):
+                return None
+
+        return _host_string_map(cols[0], max(cols[0].width * 4, 4), fn)
+
+
+class Hex(UnaryExpression):
+    """hex(int) / hex(string): Spark uppercase, no leading zeros for ints."""
+
+    is_host_kernel = True
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        if c.is_string:
+            return _host_string_map(
+                c, max(c.width * 2, 2), lambda b: b.hex().upper().encode())
+        cap = c.capacity
+
+        def run(data, validity):
+            data = np.asarray(data)
+            validity = np.asarray(validity)
+            out_chars = np.zeros((cap, 16), np.uint8)
+            out_lens = np.zeros(cap, np.int32)
+            for i in range(cap):
+                if not validity[i]:
+                    continue
+                v = int(data[i]) & 0xFFFFFFFFFFFFFFFF
+                s = format(v, "X").encode()
+                out_chars[i, :len(s)] = np.frombuffer(s, np.uint8)
+                out_lens[i] = len(s)
+            return out_chars, out_lens
+
+        och, oln = call_host_kernel(
+            run, (jax.ShapeDtypeStruct((cap, 16), np.uint8),
+                  jax.ShapeDtypeStruct((cap,), np.int32)),
+            c.data, c.validity)
+        return DeviceColumn(T.STRING, c.validity, chars=och, lengths=oln)
+
+
+class Unhex(_HostStringUnary):
+    def _fn(self, b):
+        s = b.decode("utf-8", "replace")
+        if len(s) % 2:
+            s = "0" + s
+        try:
+            return bytes.fromhex(s)
+        except ValueError:
+            return None
+
+
+class Bin(UnaryExpression):
+    """bin(long) — binary text of the two's-complement value."""
+
+    is_host_kernel = True
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        cap = c.capacity
+
+        def run(data, validity):
+            data = np.asarray(data)
+            validity = np.asarray(validity)
+            out_chars = np.zeros((cap, 64), np.uint8)
+            out_lens = np.zeros(cap, np.int32)
+            for i in range(cap):
+                if not validity[i]:
+                    continue
+                v = int(data[i]) & 0xFFFFFFFFFFFFFFFF
+                s = format(v, "b").encode()
+                out_chars[i, :len(s)] = np.frombuffer(s, np.uint8)
+                out_lens[i] = len(s)
+            return out_chars, out_lens
+
+        och, oln = call_host_kernel(
+            run, (jax.ShapeDtypeStruct((cap, 64), np.uint8),
+                  jax.ShapeDtypeStruct((cap,), np.int32)),
+            c.data, c.validity)
+        return DeviceColumn(T.STRING, c.validity, chars=och, lengths=oln)
+
+
+def _conv_str(s: str, from_base: int, to_base: int) -> Optional[str]:
+    """Spark conv(): parse leading digits, unsigned 64-bit wrap."""
+    s = s.strip()
+    if not s or not (2 <= abs(from_base) <= 36) \
+            or not (2 <= abs(to_base) <= 36):
+        return None
+    neg = s.startswith("-")
+    if neg:
+        s = s[1:]
+    digits = "0123456789abcdefghijklmnopqrstuvwxyz"
+    val = 0
+    seen = False
+    for ch in s.lower():
+        d = digits.find(ch)
+        if d < 0 or d >= abs(from_base):
+            break
+        val = val * abs(from_base) + d
+        seen = True
+    if not seen:
+        return "0"
+    if neg:
+        val = -val
+    val &= 0xFFFFFFFFFFFFFFFF
+    if to_base < 0:
+        # signed output
+        if val >= 1 << 63:
+            val -= 1 << 64
+        sign = "-" if val < 0 else ""
+        val = abs(val)
+        base = -to_base
+    else:
+        sign = ""
+        base = to_base
+    if val == 0:
+        return "0"
+    out = []
+    while val:
+        out.append(digits[val % base].upper())
+        val //= base
+    return sign + "".join(reversed(out))
+
+
+class Conv(Expression):
+    """conv(num_str, from_base, to_base) with literal bases."""
+
+    is_host_kernel = True
+
+    def __init__(self, child: Expression, fb: Expression, tb: Expression):
+        super().__init__([child, fb, tb])
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+        from spark_rapids_tpu.expr.base import Literal
+
+        self._fb = self._tb = None
+        if isinstance(self.children[1], Literal) \
+                and self.children[1].value is not None:
+            self._fb = int(self.children[1].value)
+        if isinstance(self.children[2], Literal) \
+                and self.children[2].value is not None:
+            self._tb = int(self.children[2].value)
+
+    def do_columnar_eval(self, ctx, cols):
+        fb, tb = self._fb, self._tb
+
+        def fn(b):
+            if fb is None or tb is None:
+                return None
+            r = _conv_str(b.decode("utf-8", "replace"), fb, tb)
+            return None if r is None else r.encode()
+
+        return _host_string_map(cols[0], 65, fn)
+
+
+class FormatNumber(Expression):
+    """format_number(x, d): thousands separators, HALF_EVEN to d places."""
+
+    is_host_kernel = True
+
+    def __init__(self, child: Expression, d: Expression):
+        super().__init__([child, d])
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        c, dcol = cols
+        cap = c.capacity
+        dt = c.dtype
+        is_dec = isinstance(dt, T.DecimalType)
+        scale = dt.scale if is_dec else 0
+        is_f = isinstance(dt, (T.FloatType, T.DoubleType))
+        # 1.8e308 with grouping commas needs ~410 bytes + decimal places
+        width = 512 if is_f else 64
+
+        def run(data, validity, dvals, dvalid):
+            import decimal as pydec
+
+            data = np.asarray(data)
+            validity = np.asarray(validity)
+            dvals = np.asarray(dvals)
+            dvalid = np.asarray(dvalid)
+            out_chars = np.zeros((cap, width), np.uint8)
+            out_lens = np.zeros(cap, np.int32)
+            out_valid = np.zeros(cap, np.bool_)
+            for i in range(cap):
+                if not validity[i] or not dvalid[i]:
+                    continue
+                d = int(dvals[i])
+                if d < 0:
+                    continue  # Spark: negative d -> null
+                if is_dec:
+                    v = pydec.Decimal(int(data[i])).scaleb(-scale)
+                elif is_f:
+                    import math as _m
+
+                    fv = float(data[i])
+                    if _m.isnan(fv) or _m.isinf(fv):
+                        # Java DecimalFormat: NaN / \u221e literals
+                        s = ("NaN" if _m.isnan(fv) else
+                             ("\u221e" if fv > 0 else "-\u221e")).encode()
+                        out_chars[i, :len(s)] = np.frombuffer(s, np.uint8)
+                        out_lens[i] = len(s)
+                        out_valid[i] = True
+                        continue
+                    v = pydec.Decimal(repr(fv))
+                else:
+                    v = pydec.Decimal(int(data[i]))
+                with pydec.localcontext() as lctx:
+                    lctx.prec = 400  # 1e308 doubles need quantize headroom
+                    q = v.quantize(pydec.Decimal(1).scaleb(-d),
+                                   rounding=pydec.ROUND_HALF_EVEN)
+                s = f"{q:,.{d}f}".encode()[:width]
+                out_chars[i, :len(s)] = np.frombuffer(s, np.uint8)
+                out_lens[i] = len(s)
+                out_valid[i] = True
+            return out_chars, out_lens, out_valid
+
+        shapes = (jax.ShapeDtypeStruct((cap, width), np.uint8),
+                  jax.ShapeDtypeStruct((cap,), np.int32),
+                  jax.ShapeDtypeStruct((cap,), np.bool_))
+        och, oln, ova = call_host_kernel(
+            run, shapes, c.data, c.validity, dcol.data, dcol.validity)
+        return DeviceColumn(T.STRING, ova, chars=och, lengths=oln)
+
+
+_URL_PARTS = {"HOST", "PATH", "QUERY", "REF", "PROTOCOL", "FILE",
+              "AUTHORITY", "USERINFO"}
+
+
+def _parse_url_part(url: str, part: str,
+                    key: Optional[str]) -> Optional[str]:
+    from urllib.parse import parse_qs, urlparse
+
+    try:
+        u = urlparse(url)
+    except ValueError:
+        return None
+    if not u.scheme:
+        return None
+    if part == "PROTOCOL":
+        return u.scheme or None
+    if part == "HOST":
+        return u.hostname
+    if part == "PATH":
+        return u.path
+    if part == "QUERY":
+        if key is not None:
+            if not u.query:
+                return None
+            vals = parse_qs(u.query, keep_blank_values=True).get(key)
+            return vals[0] if vals else None
+        return u.query or None
+    if part == "REF":
+        return u.fragment or None
+    if part == "FILE":
+        return u.path + ("?" + u.query if u.query else "")
+    if part == "AUTHORITY":
+        return u.netloc or None
+    if part == "USERINFO":
+        if "@" in u.netloc:
+            return u.netloc.rsplit("@", 1)[0]
+        return None
+    return None
+
+
+class ParseUrl(Expression):
+    """parse_url(url, part[, key]) — host urllib kernel."""
+
+    is_host_kernel = True
+
+    def __init__(self, url: Expression, part: Expression,
+                 key: Expression = None):
+        kids = [url, part] + ([key] if key is not None else [])
+        super().__init__(kids)
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+        from spark_rapids_tpu.expr.base import Literal
+
+        self._part = None
+        self._key = None
+        if isinstance(self.children[1], Literal) \
+                and self.children[1].value is not None:
+            self._part = str(self.children[1].value)
+        if len(self.children) > 2 and isinstance(self.children[2], Literal):
+            self._key = self.children[2].value
+
+    def do_columnar_eval(self, ctx, cols):
+        part, key = self._part, self._key
+
+        def fn(b):
+            if part not in _URL_PARTS:
+                return None
+            r = _parse_url_part(b.decode("utf-8", "replace"), part, key)
+            return None if r is None else r.encode()
+
+        return _host_string_map(cols[0], max(cols[0].width, 1), fn)
+
+
+_SOUNDEX_CODE = {
+    **{c: "1" for c in "BFPV"}, **{c: "2" for c in "CGJKQSXZ"},
+    **{c: "3" for c in "DT"}, "L": "4", **{c: "5" for c in "MN"}, "R": "6",
+}
+
+
+def _soundex_str(s: str) -> str:
+    if not s or not s[0].isalpha():
+        return s  # Spark returns input unchanged when not soundex-able
+    up = s.upper()
+    first = up[0]
+    codes = [first]
+    prev = _SOUNDEX_CODE.get(first, "")
+    for ch in up[1:]:
+        code = _SOUNDEX_CODE.get(ch, "")
+        if ch in "HW":
+            continue  # h/w do not break runs
+        if code and code != prev:
+            codes.append(code)
+        prev = code
+        if len(codes) == 4:
+            break
+    return "".join(codes).ljust(4, "0")
+
+
+class Soundex(_HostStringUnary):
+    def _out_width(self, c):
+        return max(c.width, 4)
+
+    def _fn(self, b):
+        return _soundex_str(b.decode("utf-8", "replace")).encode()
+
+
+class Levenshtein(BinaryExpression):
+    """levenshtein(a, b) — edit-distance DP as a lax.scan over a's bytes
+    with the full DP row as carry: O(w1) fused vector steps over all rows
+    at once (the one misc function with a real device win)."""
+
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        a, b = cols
+        w1, w2 = a.width, b.width
+        la = a.lengths.astype(jnp.int32)
+        lb = b.lengths.astype(jnp.int32)
+        cap = a.capacity
+        # dp[j] = distance(a[:i], b[:j]); init row: dp[j] = j
+        init = jnp.broadcast_to(jnp.arange(w2 + 1, dtype=jnp.int32),
+                                (cap, w2 + 1))
+
+        bj = b.chars  # (cap, w2)
+
+        def step(dp, ai):
+            # ai: (cap,) byte of a at position i (garbage past la, masked)
+            achar, idx = ai
+            sub_cost = (bj != achar[:, None]).astype(jnp.int32)
+            # new[0] = i+1
+            def inner(carry, j):
+                prev_diag, new_prev = carry
+                dele = dp[:, j + 1] + 1
+                ins = new_prev + 1
+                sub = prev_diag + sub_cost[:, j]
+                val = jnp.minimum(jnp.minimum(dele, ins), sub)
+                return (dp[:, j + 1], val), val
+
+            first = jnp.full((cap,), 0, jnp.int32) + (idx + 1)
+            (_, _), rest = jax.lax.scan(
+                inner, (dp[:, 0], first), jnp.arange(w2))
+            new_dp = jnp.concatenate([first[:, None], rest.T], axis=1)
+            keep = idx < la
+            new_dp = jnp.where(keep[:, None], new_dp, dp)
+            return new_dp, None
+
+        xs = (a.chars.T, jnp.arange(w1, dtype=jnp.int32))
+        dp, _ = jax.lax.scan(step, init, xs)
+        res = jnp.take_along_axis(dp, jnp.clip(lb, 0, w2)[:, None],
+                                  axis=1)[:, 0]
+        validity = a.validity & b.validity
+        return DeviceColumn(T.INT, validity, data=res)
+
+
+class MonotonicallyIncreasingID(Expression):
+    """monotonically_increasing_id(): (partition_id << 33) | row index.
+
+    The session executes one logical partition; batches contribute a
+    running row offset carried on the EvalContext."""
+
+    def _resolve_type(self):
+        self._dataType = T.LONG
+        self._nullable = False
+
+    def eval_tpu(self, ctx: EvalContext) -> DeviceColumn:
+        cap = ctx.batch.capacity
+        base = jnp.int64(getattr(ctx, "row_offset", 0))
+        ids = base + jnp.arange(cap, dtype=jnp.int64)
+        return DeviceColumn(T.LONG, jnp.ones(cap, jnp.bool_), data=ids)
+
+
+class SparkPartitionID(Expression):
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = False
+
+    def eval_tpu(self, ctx: EvalContext) -> DeviceColumn:
+        cap = ctx.batch.capacity
+        pid = jnp.int32(getattr(ctx, "partition_id", 0))
+        return DeviceColumn(T.INT, jnp.ones(cap, jnp.bool_),
+                            data=jnp.full(cap, pid, jnp.int32))
+
+
+class Rand(Expression):
+    """rand([seed]) — uniform [0,1) from threefry keyed on (seed, row).
+
+    Deterministic and seedable but NOT Spark's XORShiftRandom sequence
+    (TypeSig note); the oracle evaluates the identical spec."""
+
+    is_host_kernel = True
+
+    def __init__(self, seed: int = 0):
+        super().__init__([])
+        self.seed = int(seed)
+
+    def _resolve_type(self):
+        self._dataType = T.DOUBLE
+        self._nullable = False
+
+    @staticmethod
+    def _u64_for_rows(seed: int, base: int, n: int) -> np.ndarray:
+        """Spec shared with the oracle: splitmix64 of (seed*2^32 + row)."""
+        rows = np.arange(base, base + n, dtype=np.uint64)
+        x = (np.uint64(seed) << np.uint64(32)) + rows
+        z = (x + np.uint64(0x9E3779B97F4A7C15))
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        z = z ^ (z >> np.uint64(31))
+        return z
+
+    def eval_tpu(self, ctx: EvalContext) -> DeviceColumn:
+        cap = ctx.batch.capacity
+        base = int(getattr(ctx, "row_offset", 0))
+        seed = self.seed
+
+        def run():
+            z = Rand._u64_for_rows(seed, base, cap)
+            return ((z >> np.uint64(11)).astype(np.float64)
+                    / float(1 << 53),)
+
+        (vals,) = call_host_kernel(
+            run, (jax.ShapeDtypeStruct((cap,), np.float64),))
+        return DeviceColumn(T.DOUBLE, jnp.ones(cap, jnp.bool_), data=vals)
+
+
+class RaiseError(UnaryExpression):
+    """raise_error(msg) — surfaces through the batch error flags."""
+
+    def _resolve_type(self):
+        self._dataType = T.NULL
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        ctx.add_error(c.validity, "raise_error invoked")
+        cap = c.capacity
+        return DeviceColumn(T.NULL, jnp.zeros(cap, jnp.bool_),
+                            data=jnp.zeros(cap, jnp.int32))

@@ -345,18 +345,7 @@ def get_json_object_bytes(doc: bytes,
     span = _navigate(doc, 0, steps, 0)
     if span is None:
         return None
-    s, e = span
-    c = doc[s]
-    if c == 0x22:
-        return _unescape(doc[s + 1:e - 1])
-    raw = doc[s:e]
-    if c in (0x7B, 0x5B):
-        return _compact(raw)
-    if raw == b"null":
-        return None
-    if not _valid_scalar(raw):
-        return None
-    return raw
+    return _terminal_bytes(doc, span[0], span[1])
 
 
 def _terminal_bytes(doc: bytes, s: int, e: int) -> Optional[bytes]:

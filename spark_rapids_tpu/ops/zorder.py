@@ -1,0 +1,79 @@
+"""Z-order (Morton) interleaving — the zorder.cu analog.
+
+Reference analog: spark-rapids-jni ``zorder.cu`` (GpuInterleaveBits +
+GpuHilbertLongIndex) powering Delta OPTIMIZE ZORDER BY (SURVEY.md §2.5
+Hash/misc, §2.8 Delta).
+
+TPU design: each key column is rank-normalized to uint32 (order-preserving
+per type: ints biased, floats via the total-order bit trick, strings by
+their first 4 big-endian bytes), then bits interleave into k 32-bit planes
+packed as int64 key words — all dense vector ops; the actual clustering is
+the engine's regular sort over those words.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.ops.sortkeys import _float_total_order
+
+
+def _rank_u32(c: DeviceColumn) -> jax.Array:
+    """Order-preserving uint32 surrogate per row (nulls smallest)."""
+    dt = c.dtype
+    if c.is_string:
+        w = min(c.width, 4)
+        acc = jnp.zeros(c.capacity, jnp.uint32)
+        for i in range(4):
+            byte = (c.chars[:, i].astype(jnp.uint32)
+                    if i < w else jnp.zeros(c.capacity, jnp.uint32))
+            inb = (i < c.lengths).astype(jnp.uint32)
+            acc = (acc << 8) | (byte * inb)
+        ranked = acc
+    elif isinstance(dt, (T.FloatType, T.DoubleType)):
+        bits = jax.lax.bitcast_convert_type(
+            c.data.astype(jnp.float64), jnp.int64)
+        bits = jnp.where(jnp.isnan(c.data.astype(jnp.float64)),
+                         jnp.int64(0x7FF8000000000000), bits)
+        key = _float_total_order(bits)
+        ranked = ((key >> 32) + jnp.int64(1 << 31)).astype(jnp.uint32)
+    else:
+        v = c.data.astype(jnp.int64)
+        wide = isinstance(dt, (T.LongType, T.TimestampType)) or (
+            isinstance(dt, T.DecimalType))
+        if wide:
+            # top 32 bits of the sign-biased 64-bit value
+            ranked = ((v >> jnp.int64(32))
+                      + jnp.int64(1 << 31)).astype(jnp.uint32)
+        else:
+            ranked = (v + jnp.int64(1 << 31)).astype(jnp.uint32)
+    # nulls first: shift valid ranks up by 1 (saturating) is unnecessary —
+    # zero out null ranks (ties with real zeros only smear clustering)
+    return jnp.where(c.validity, ranked, 0)
+
+
+def interleave_bits(cols: List[DeviceColumn]) -> List[jax.Array]:
+    """-> list of int64 sort-key words, most-significant first.
+
+    k columns × 32 bits = 32*k interleaved bits, packed big-endian into
+    ceil(32k/64) words (the cuDF interleave_bits returns a byte list; key
+    words feed our lax.sort directly)."""
+    k = len(cols)
+    ranks = [_rank_u32(c) for c in cols]
+    total_bits = 32 * k
+    nwords = (total_bits + 63) // 64
+    cap = cols[0].capacity
+    words = [jnp.zeros(cap, jnp.int64) for _ in range(nwords)]
+    # bit b (0 = most significant) = bit (31 - b//k) of column (b % k)
+    for b in range(total_bits):
+        col_i = b % k
+        src_bit = 31 - (b // k)
+        bit = (ranks[col_i] >> jnp.uint32(src_bit)) & jnp.uint32(1)
+        w_i = b // 64
+        dst = 63 - (b % 64)
+        words[w_i] = words[w_i] | (bit.astype(jnp.int64) << jnp.int64(dst))
+    return words

@@ -167,6 +167,18 @@ class SparkPlanMeta(BaseMeta):
             if not em.can_run_with_children:
                 for r in em.all_reasons():
                     self.will_not_work_on_tpu(r)
+        # host-kernel expressions (pure_callback) only run in the eager
+        # Project/Filter stage path; every other exec jits its expressions
+        # into one XLA program, where no host-callback channel exists
+        if self.name not in ("Project", "Filter"):
+            from spark_rapids_tpu.expr.base import contains_host_kernel
+
+            for em in self.expr_metas:
+                if contains_host_kernel(em.expr):
+                    self.will_not_work_on_tpu(
+                        f"exec {self.name}: host-kernel expression "
+                        f"{em.expr.sql_string()} must sit under a Project")
+                    break
         if self.rule.extra_check is not None:
             self.rule.extra_check(self)
 

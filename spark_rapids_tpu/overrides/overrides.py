@@ -31,6 +31,7 @@ from spark_rapids_tpu.expr import complextypes as CT
 from spark_rapids_tpu.expr import hof as HOF
 from spark_rapids_tpu.expr import jsonexprs as J
 from spark_rapids_tpu.expr import mathfuncs as M
+from spark_rapids_tpu.expr import misc as MI
 from spark_rapids_tpu.expr import predicates as P
 from spark_rapids_tpu.expr import strings as S
 from spark_rapids_tpu.expr import udf as U
@@ -370,6 +371,34 @@ def _check_hof_agg(meta: ExprMeta):
             "aggregate: accumulator must be a flat primitive on TPU")
 
 
+_SUPPORTED_CHARSETS = {"utf-8", "utf8", "us-ascii", "ascii", "iso-8859-1",
+                       "utf-16", "utf-16be", "utf-16le"}
+
+
+def _check_timezone(meta: ExprMeta):
+    from spark_rapids_tpu.tzdb import is_known_zone
+
+    tz = meta.expr.children[1]
+    if not isinstance(tz, E.Literal):
+        meta.will_not_work_on_tpu(
+            "from/to_utc_timestamp: timezone must be a literal")
+        return
+    if not is_known_zone(tz.value):
+        meta.will_not_work_on_tpu(
+            f"unknown or unsupported timezone {tz.value!r}")
+
+
+def _check_charset(meta: ExprMeta):
+    cs = meta.expr.children[1]
+    if not isinstance(cs, E.Literal):
+        meta.will_not_work_on_tpu(
+            "encode/decode: charset must be a literal")
+        return
+    if cs.value is None or str(cs.value).lower() not in _SUPPORTED_CHARSETS:
+        meta.will_not_work_on_tpu(
+            f"encode/decode: charset {cs.value!r} is not supported")
+
+
 def _check_json_path(meta: ExprMeta):
     """Literal, non-wildcard JSON path (the reference's GpuGetJsonObject
     likewise falls back for non-literal paths)."""
@@ -546,6 +575,12 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
     DT.NextDay: ExprRule(
         T.DATETIME_SIG + T.STRING_SIG,
         extra_check=_check_literal_children(1, names="day of week")),
+    DT.FromUTCTimestamp: ExprRule(
+        T.DATETIME_SIG + T.STRING_SIG, extra_check=_check_timezone,
+        desc="tz offset via device transition tables (tzdb.py)"),
+    DT.ToUTCTimestamp: ExprRule(
+        T.DATETIME_SIG + T.STRING_SIG, extra_check=_check_timezone,
+        desc="java.time gap/overlap resolution"),
     DT.FromUnixTime: ExprRule(
         T.DATETIME_SIG + T.INTEGRAL_SIG + T.STRING_SIG.with_note(
             T.StringType,
@@ -558,6 +593,12 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
         extra_check=_check_time_format),
     H.Murmur3Hash: ExprRule(_COMMON128, desc="Spark murmur3 hash"),
     H.XxHash64: ExprRule(_COMMON128, desc="Spark xxhash64"),
+    H.BloomFilterMightContain: ExprRule(
+        _COMMON128 + _ARRAY_SIG.with_note(
+            T.ArrayType,
+            "filter layout is the TPU word array, not Spark's sketch "
+            "bytes"),
+        desc="bloom filter probe (runtime-filter pushdown)"),
     CL.Size: ExprRule(_WITH_ARRAYS),
     CL.GetArrayItem: ExprRule(_WITH_ARRAYS),
     CL.ElementAt: ExprRule(_WITH_ARRAYS + _WITH_MAPS),
@@ -619,6 +660,42 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
         desc="to_json of a flat struct"),
     CT.GetStructField: ExprRule(_STRUCT_SIG + _DEC128_FULL),
     CT.CreateNamedStruct: ExprRule(_STRUCT_SIG + _DEC128_FULL),
+    MI.Md5: ExprRule(T.STRING_SIG, desc="md5 hex digest (host kernel)"),
+    MI.Sha1: ExprRule(T.STRING_SIG),
+    MI.Sha2: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG,
+                      extra_check=_check_literal_children(
+                          1, names="bit length")),
+    MI.Crc32: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG),
+    MI.Base64: ExprRule(T.STRING_SIG),
+    MI.UnBase64: ExprRule(T.STRING_SIG.with_note(
+        T.StringType, "binary output surfaces as the string column kind")),
+    MI.Encode: ExprRule(T.STRING_SIG,
+                        extra_check=_check_charset),
+    MI.Decode: ExprRule(T.STRING_SIG, extra_check=_check_charset),
+    MI.Hex: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG),
+    MI.Unhex: ExprRule(T.STRING_SIG),
+    MI.Bin: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG),
+    MI.Conv: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG,
+                      extra_check=_check_literal_children(
+                          1, 2, names="bases")),
+    MI.FormatNumber: ExprRule(
+        _NUM + T.STRING_SIG.with_note(
+            T.StringType, "HALF_EVEN rounding, US grouping")),
+    MI.ParseUrl: ExprRule(T.STRING_SIG,
+                          extra_check=_check_literal_children(
+                              1, names="url part")),
+    MI.Soundex: ExprRule(T.STRING_SIG.with_note(
+        T.StringType, "ASCII letters only")),
+    MI.Levenshtein: ExprRule(
+        T.STRING_SIG.with_note(T.StringType, "byte-based; ASCII-exact")
+        + T.INTEGRAL_SIG),
+    MI.MonotonicallyIncreasingID: ExprRule(T.INTEGRAL_SIG),
+    MI.SparkPartitionID: ExprRule(T.INTEGRAL_SIG),
+    MI.Rand: ExprRule(T.FP_SIG.with_note(
+        T.DoubleType,
+        "deterministic threefry/splitmix stream, not Spark's "
+        "XORShiftRandom sequence")),
+    MI.RaiseError: ExprRule(T.STRING_SIG + T.NULL_SIG),
 }
 
 
@@ -633,7 +710,54 @@ def wrap_expr(e: E.Expression, conf: TpuConf) -> ExprMeta:
 
 _AGG_FUNCS_SUPPORTED = {"sum", "count", "count_star", "min", "max", "avg",
                         "first", "last", "var_pop", "var_samp", "stddev_pop",
-                        "stddev_samp", "collect_list", "collect_set"}
+                        "stddev_samp", "collect_list", "collect_set",
+                        "count_if", "skewness", "kurtosis", "corr",
+                        "covar_pop", "covar_samp", "percentile",
+                        "approx_percentile", "approx_count_distinct",
+                        "bloom_filter_agg"}
+
+_NUMERIC_AGG_INPUT = (T.ByteType, T.ShortType, T.IntegerType, T.LongType,
+                      T.FloatType, T.DoubleType, T.DecimalType)
+
+
+def _agg_extra_checks(meta: SparkPlanMeta, a) -> None:
+    """Per-function input gates for the breadth aggregates."""
+    ct = a.child._dataType if a.child is not None else None
+    if a.func == "count_if" and not isinstance(ct, T.BooleanType):
+        meta.will_not_work_on_tpu("count_if requires a boolean input")
+    if a.func in ("skewness", "kurtosis", "percentile",
+                  "approx_percentile") \
+            and not isinstance(ct, _NUMERIC_AGG_INPUT):
+        meta.will_not_work_on_tpu(
+            f"{a.func} requires a numeric input")
+    if a.func in PN.COVARIANCE_FUNCS:
+        c2 = a.child2._dataType if a.child2 is not None else None
+        for part in (ct, c2):
+            if not isinstance(part, _NUMERIC_AGG_INPUT):
+                meta.will_not_work_on_tpu(
+                    f"{a.func} requires numeric inputs")
+                break
+    if a.func in ("percentile", "approx_percentile"):
+        if not a.args or not (0.0 <= float(a.args[0]) <= 1.0):
+            meta.will_not_work_on_tpu(
+                f"{a.func}: percentage must be a literal in [0, 1]")
+        if isinstance(ct, T.DecimalType) and ct.is_128:
+            meta.will_not_work_on_tpu(
+                f"{a.func} over decimal128 is not supported on TPU")
+    if a.func in ("approx_count_distinct", "bloom_filter_agg"):
+        if isinstance(ct, T.DecimalType) and ct.precision > 18:
+            meta.will_not_work_on_tpu(
+                f"{a.func}: decimal128 inputs are not supported (xxhash64 "
+                "big-integer path missing)")
+        if isinstance(ct, (T.ArrayType, T.MapType, T.StructType)):
+            meta.will_not_work_on_tpu(
+                f"{a.func} over nested inputs is not supported on TPU")
+    if a.func == "bloom_filter_agg":
+        if len(a.args) != 2 or int(a.args[1]) % 64 != 0 \
+                or not (64 <= int(a.args[1]) <= (1 << 22)):
+            meta.will_not_work_on_tpu(
+                "bloom_filter_agg: num_bits must be a multiple of 64 in "
+                "[64, 4194304]")
 _WINDOW_FUNCS_SUPPORTED = {"row_number", "rank", "dense_rank", "sum", "count",
                            "min", "max", "avg", "lead", "lag", "ntile",
                            "percent_rank", "cume_dist"}
@@ -662,6 +786,8 @@ def _agg_check(meta: SparkPlanMeta):
         if a.func not in _AGG_FUNCS_SUPPORTED:
             meta.will_not_work_on_tpu(
                 f"aggregate function {a.func} is not supported on TPU")
+        else:
+            _agg_extra_checks(meta, a)
         if a.distinct:
             meta.will_not_work_on_tpu(
                 "distinct aggregates are not supported on TPU yet")
@@ -691,6 +817,10 @@ def _join_check(meta: SparkPlanMeta):
             "non-inner join with residual condition is not supported on TPU")
     if not plan.left_keys and plan.join_type != PN.JoinType.CROSS:
         meta.will_not_work_on_tpu("equi-join keys required")
+    for k in list(plan.left_keys) + list(plan.right_keys):
+        if isinstance(k._dataType, (T.ArrayType, T.MapType, T.StructType)):
+            meta.will_not_work_on_tpu(
+                "join keys of nested types are not supported on TPU")
 
 
 def _window_check(meta: SparkPlanMeta):
@@ -750,6 +880,7 @@ def _exprs_of(plan) -> List[E.Expression]:
     if isinstance(plan, PN.HashAggregate):
         out = list(plan.grouping)
         out += [a.child for a in plan.aggregates if a.child is not None]
+        out += [a.child2 for a in plan.aggregates if a.child2 is not None]
         return out
     if isinstance(plan, PN._BaseJoin):
         out = list(plan.left_keys) + list(plan.right_keys)
@@ -826,10 +957,10 @@ _exec(PN.RangeNode)
 _exec(PN.Project, sig=_WITH_NESTED)
 _exec(PN.Filter, sig=_WITH_NESTED)
 _exec(PN.HashAggregate, sig=_WITH_ARRAYS, extra=_agg_check)
-_exec(PN.SortMergeJoin, extra=_join_check,
+_exec(PN.SortMergeJoin, sig=_WITH_ARRAYS, extra=_join_check,
       desc="converted to shuffled sorted join (GpuSortMergeJoinMeta analog)")
-_exec(PN.ShuffledHashJoin, extra=_join_check)
-_exec(PN.BroadcastHashJoin, extra=_join_check)
+_exec(PN.ShuffledHashJoin, sig=_WITH_ARRAYS, extra=_join_check)
+_exec(PN.BroadcastHashJoin, sig=_WITH_ARRAYS, extra=_join_check)
 _exec(PN.Sort)
 _exec(PN.Window, sig=_COMMON128, extra=_window_check)
 _exec(PN.Generate, sig=_WITH_ARRAYS, extra=_generate_check)

@@ -173,6 +173,38 @@ class AggregateMode(enum.Enum):
 VARIANCE_FUNCS = frozenset(
     {"var_pop", "var_samp", "stddev_pop", "stddev_samp"})
 
+# higher central moments (Spark Skewness/Kurtosis: same CentralMomentAgg
+# family, buffers extended with m3/m4)
+HIGHER_MOMENT_FUNCS = frozenset({"skewness", "kurtosis"})
+
+# two-input covariance family (Spark Covariance/Corr: n, xAvg, yAvg, ck
+# buffers; corr adds xMk/yMk)
+COVARIANCE_FUNCS = frozenset({"covar_pop", "covar_samp", "corr"})
+
+# single-phase aggregates (planned COMPLETE after a hash exchange, like
+# collect_list — their state is the whole group)
+SINGLE_PHASE_FUNCS = frozenset(
+    {"collect_list", "collect_set", "percentile", "approx_percentile",
+     "bloom_filter_agg"})
+
+# PARTIAL-mode buffer field suffixes per moment-family func; every buffer
+# column is DOUBLE
+MOMENT_BUFFERS = {
+    "var_pop": ("_n", "_avg", "_m2"),
+    "var_samp": ("_n", "_avg", "_m2"),
+    "stddev_pop": ("_n", "_avg", "_m2"),
+    "stddev_samp": ("_n", "_avg", "_m2"),
+    "skewness": ("_n", "_avg", "_m2", "_m3"),
+    "kurtosis": ("_n", "_avg", "_m2", "_m3", "_m4"),
+    "covar_pop": ("_n", "_xavg", "_yavg", "_ck"),
+    "covar_samp": ("_n", "_xavg", "_yavg", "_ck"),
+    "corr": ("_n", "_xavg", "_yavg", "_ck", "_xm2", "_ym2"),
+}
+
+# default register-count exponent for approx_count_distinct at Spark's
+# default relativeSD=0.05 (p = ceil(2 * log2(1.106 / rsd)))
+HLL_DEFAULT_P = 9
+
 
 @dataclasses.dataclass
 class AggregateExpression:
@@ -187,16 +219,23 @@ class AggregateExpression:
     result_name: str
     result_type: Optional[T.DataType] = None
     distinct: bool = False
+    child2: Optional[Expression] = None   # corr/covar second input
+    args: tuple = ()                      # literal extras (percentage, ...)
 
     def resolve(self, schema: T.StructType) -> "AggregateExpression":
         if self.child is not None:
             self.child = self.child.resolve(schema)
+        if self.child2 is not None:
+            self.child2 = self.child2.resolve(schema)
         self.result_type = self._compute_type()
         return self
 
     def _compute_type(self) -> T.DataType:
-        if self.func in ("count", "count_star"):
+        if self.func in ("count", "count_star", "count_if",
+                         "approx_count_distinct"):
             return T.LONG
+        if self.func == "bloom_filter_agg":
+            return T.ArrayType(T.LONG, containsNull=False)
         ct = self.child.dataType
         if self.func == "sum":
             if isinstance(ct, T.DecimalType):
@@ -209,8 +248,13 @@ class AggregateExpression:
                 return T.DecimalType(min(ct.precision + 4, 38),
                                      min(ct.scale + 4, 38))
             return T.DOUBLE
-        if self.func in VARIANCE_FUNCS:
+        if self.func in VARIANCE_FUNCS or self.func in HIGHER_MOMENT_FUNCS \
+                or self.func in COVARIANCE_FUNCS:
             return T.DOUBLE
+        if self.func == "percentile":
+            return T.DOUBLE
+        if self.func == "approx_percentile":
+            return ct
         if self.func in ("collect_list", "collect_set"):
             return T.ArrayType(ct)
         return ct  # min/max/first/last
@@ -244,10 +288,14 @@ class HashAggregate(SparkPlan):
                                   if not isinstance(a.result_type, T.DecimalType)
                                   else T.DecimalType(38, a.child.dataType.scale)))
                     fields.append(T.StructField(a.result_name + "_count", T.LONG))
-                elif a.func in VARIANCE_FUNCS:
-                    fields.append(T.StructField(a.result_name + "_n", T.DOUBLE))
-                    fields.append(T.StructField(a.result_name + "_avg", T.DOUBLE))
-                    fields.append(T.StructField(a.result_name + "_m2", T.DOUBLE))
+                elif a.func in MOMENT_BUFFERS:
+                    for suffix in MOMENT_BUFFERS[a.func]:
+                        fields.append(T.StructField(
+                            a.result_name + suffix, T.DOUBLE))
+                elif a.func == "approx_count_distinct":
+                    fields.append(T.StructField(
+                        a.result_name + "_hll",
+                        T.ArrayType(T.INT, containsNull=False)))
                 else:
                     fields.append(T.StructField(a.result_name, a.result_type))
         else:

@@ -155,6 +155,11 @@ class DataFrameReader:
             PN.FileSourceScan("csv", list(paths), schema,
                               options=self._options), self.session)
 
+    def delta(self, path: str, version: Optional[int] = None) -> "DataFrame":
+        from spark_rapids_tpu.delta import read_delta
+
+        return read_delta(self.session, path, version)
+
     def avro(self, *paths: str) -> "DataFrame":
         if self._schema is None:
             from spark_rapids_tpu.io.avro import (
@@ -259,6 +264,9 @@ class DataFrame:
 
     def agg(self, *aggs) -> "DataFrame":
         return GroupedData(self, []).agg(*aggs)
+
+    def cross_join(self, other: "DataFrame") -> "DataFrame":
+        return self.join(other, None, "cross")
 
     def join(self, other: "DataFrame", on, how: str = "inner") -> "DataFrame":
         jt = {"inner": PN.JoinType.INNER, "left": PN.JoinType.LEFT_OUTER,
@@ -500,6 +508,15 @@ class DataFrameWriter:
     def json(self, path: str) -> None:
         self._run("json", path)
 
+    def delta(self, path: str) -> None:
+        from spark_rapids_tpu.delta import write_delta
+
+        mode = {"overwrite": "overwrite", "append": "append",
+                "error": "error", "errorifexists": "error",
+                "ignore": "ignore"}.get(self._mode, self._mode)
+        write_delta(self.df, path, mode=mode,
+                    partition_by=self._partition_by)
+
 
 def _estimated_plan_bytes(plan: PN.SparkPlan):
     """Size estimate for broadcast decisions; None = unknown (never
@@ -577,8 +594,11 @@ class GroupedData:
                      for a in distinct]
             return dedup.group_by(*outer_keys).agg(*outer) if outer_keys \
                 else dedup.agg(*outer)
-        collect = [a for a in specs if isinstance(a, tuple)
-                   and a[0] in ("collect_list", "collect_set")]
+        collect = [a for a in specs
+                   if (isinstance(a, tuple)
+                       and a[0] in PN.SINGLE_PHASE_FUNCS)
+                   or (isinstance(a, PN.AggregateExpression)
+                       and a.func in PN.SINGLE_PHASE_FUNCS)]
         if collect:
             # single-phase plan: co-locate each key's rows with a hash
             # exchange, then ONE COMPLETE-mode aggregate builds the arrays
@@ -626,7 +646,8 @@ class GroupedData:
             fkeys = []
             ex = PN.Exchange(PN.SinglePartitioning(), partial)
         final_aggs = [PN.AggregateExpression(a.func, a.child, a.result_name,
-                                             a.result_type)
+                                             a.result_type,
+                                             child2=a.child2, args=a.args)
                       for a in aexprs]
         final = PN.HashAggregate(fkeys, final_aggs,
                                  PN.AggregateMode.FINAL, ex)
@@ -706,3 +727,53 @@ def variance_(c: ColumnLike, name: str = "variance"):
 
 def var_pop_(c: ColumnLike, name: str = "var_pop"):
     return ("var_pop", c, name)
+
+
+def count_if_(c: ColumnLike, name: str = "count_if"):
+    return ("count_if", c, name)
+
+
+def skewness_(c: ColumnLike, name: str = "skewness"):
+    return ("skewness", c, name)
+
+
+def kurtosis_(c: ColumnLike, name: str = "kurtosis"):
+    return ("kurtosis", c, name)
+
+
+def corr_(x: ColumnLike, y: ColumnLike, name: str = "corr"):
+    return PN.AggregateExpression("corr", _to_expr(x), name,
+                                  child2=_to_expr(y))
+
+
+def covar_pop_(x: ColumnLike, y: ColumnLike, name: str = "covar_pop"):
+    return PN.AggregateExpression("covar_pop", _to_expr(x), name,
+                                  child2=_to_expr(y))
+
+
+def covar_samp_(x: ColumnLike, y: ColumnLike, name: str = "covar_samp"):
+    return PN.AggregateExpression("covar_samp", _to_expr(x), name,
+                                  child2=_to_expr(y))
+
+
+def percentile_(c: ColumnLike, percentage: float, name: str = "percentile"):
+    return PN.AggregateExpression("percentile", _to_expr(c), name,
+                                  args=(float(percentage),))
+
+
+def approx_percentile_(c: ColumnLike, percentage: float,
+                       accuracy: int = 10000,
+                       name: str = "approx_percentile"):
+    return PN.AggregateExpression("approx_percentile", _to_expr(c), name,
+                                  args=(float(percentage), int(accuracy)))
+
+
+def approx_count_distinct_(c: ColumnLike,
+                           name: str = "approx_count_distinct"):
+    return ("approx_count_distinct", c, name)
+
+
+def bloom_filter_agg_(c: ColumnLike, name: str = "bloom_filter_agg",
+                      num_items: int = 4096, num_bits: int = 65536):
+    return PN.AggregateExpression("bloom_filter_agg", _to_expr(c), name,
+                                  args=(int(num_items), int(num_bits)))

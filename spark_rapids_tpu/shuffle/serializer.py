@@ -88,11 +88,30 @@ def serialize_batch(batch: ColumnarBatch, codec: Optional[str] = None) -> bytes:
 
     # one host sync for the whole batch
     host_cols = jax.device_get(
-        [(c.validity, c.data, c.chars, c.lengths) for c in batch.columns])
-    for c, (validity, data, chars, lengths) in zip(batch.columns, host_cols):
+        [(c.validity, c.data, c.chars, c.lengths, c.elem_valid)
+         for c in batch.columns])
+    for c, (validity, data, chars, lengths, elem_valid) in zip(
+            batch.columns, host_cols):
         validity = np.asarray(validity)[:n]
         vbuf = add_buffer(np.packbits(validity, bitorder="little").tobytes())
-        if c.is_string:
+        if c.is_array:
+            # padded list column: per-row element counts + ragged element
+            # data/validity (padding elements never travel, like strings)
+            lengths = np.asarray(lengths)[:n].astype(np.int32)
+            ew = int(lengths.max()) if n else 0
+            data = np.asarray(data)[:n]
+            ev = np.asarray(elem_valid)[:n]
+            take = np.arange(data.shape[1])[None, :] < lengths[:, None]
+            flat = np.ascontiguousarray(data[take])
+            flat_ev = np.packbits(ev[take], bitorder="little")
+            lbuf = add_buffer(lengths.tobytes())
+            dbuf = add_buffer(flat.tobytes())
+            ebuf = add_buffer(flat_ev.tobytes())
+            header_cols.append({
+                "kind": "array", "dtype": data.dtype.str, "ewidth": ew,
+                "validity": vbuf, "lengths": lbuf, "data": dbuf,
+                "elem_valid": ebuf})
+        elif c.is_string:
             from spark_rapids_tpu.native import padded_to_ragged
 
             lengths = np.asarray(lengths)[:n]
@@ -145,9 +164,16 @@ def deserialize_concat(blocks: Sequence[bytes], schema: T.StructType,
     for ci, f in enumerate(schema.fields):
         validity = np.zeros(cap, dtype=np.bool_)
         is_string = isinstance(f.dataType, T.StringType)
+        is_array = isinstance(f.dataType, T.ArrayType)
         if is_string:
             width = max([h["cols"][ci]["width"] for h, _ in parsed] + [1])
             chars = np.zeros((cap, width), dtype=np.uint8)
+            lengths = np.zeros(cap, dtype=np.int32)
+        elif is_array:
+            ew = max([h["cols"][ci]["ewidth"] for h, _ in parsed] + [1])
+            sdt = np.dtype(T.storage_dtype(f.dataType.elementType))
+            data = np.zeros((cap, ew), dtype=sdt)
+            ev = np.zeros((cap, ew), dtype=np.bool_)
             lengths = np.zeros(cap, dtype=np.int32)
         else:
             sdt = np.dtype(T.storage_dtype(f.dataType))
@@ -162,6 +188,27 @@ def deserialize_concat(blocks: Sequence[bytes], schema: T.StructType,
             vbits = np.frombuffer(body, np.uint8, count=vlen, offset=voff)
             validity[row: row + n] = np.unpackbits(
                 vbits, count=n, bitorder="little").astype(np.bool_)
+            if is_array:
+                loff, llen = col["lengths"]
+                lens = np.frombuffer(body, np.int32, count=n, offset=loff)
+                lengths[row: row + n] = lens
+                total_e = int(lens.sum())
+                doff, dlen = col["data"]
+                flat = np.frombuffer(body, np.dtype(col["dtype"]),
+                                     count=total_e, offset=doff)
+                eoff, elen = col["elem_valid"]
+                ebits = np.frombuffer(body, np.uint8, count=elen,
+                                      offset=eoff)
+                flat_ev = np.unpackbits(ebits, count=total_e,
+                                        bitorder="little").astype(np.bool_)
+                take = (np.arange(ew)[None, :]
+                        < lens.astype(np.int32)[:, None])
+                dview = data[row: row + n]
+                evview = ev[row: row + n]
+                dview[take] = flat
+                evview[take] = flat_ev
+                row += n
+                continue
             if is_string:
                 loff, llen = col["lengths"]
                 lens = np.frombuffer(body, np.int32, count=n, offset=loff)
@@ -188,6 +235,10 @@ def deserialize_concat(blocks: Sequence[bytes], schema: T.StructType,
             out_cols.append(DeviceColumn(
                 f.dataType, jnp.asarray(validity),
                 chars=jnp.asarray(chars), lengths=jnp.asarray(lengths)))
+        elif is_array:
+            out_cols.append(DeviceColumn(
+                f.dataType, jnp.asarray(validity), data=jnp.asarray(data),
+                lengths=jnp.asarray(lengths), elem_valid=jnp.asarray(ev)))
         else:
             out_cols.append(DeviceColumn(
                 f.dataType, jnp.asarray(validity), data=jnp.asarray(data)))

@@ -1,0 +1,189 @@
+"""Aggregate breadth tests: count_if, higher moments, covariance family,
+percentile, approx_count_distinct, bloom filters (reference:
+hash_aggregate_test.py)."""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.session import (
+    approx_count_distinct_,
+    approx_percentile_,
+    bloom_filter_agg_,
+    col,
+    corr_,
+    count_if_,
+    covar_pop_,
+    covar_samp_,
+    kurtosis_,
+    lit,
+    percentile_,
+    skewness_,
+    sum_,
+)
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import (
+    BooleanGen,
+    DoubleGen,
+    IntegerGen,
+    LongGen,
+    SetValuesGen,
+    StringGen,
+    gen_df,
+)
+
+_key = IntegerGen(min_val=0, max_val=5, nullable=False)
+
+
+def test_count_if():
+    def build(s):
+        df = gen_df(s, [_key, BooleanGen()], ["k", "b"], length=500)
+        return df.group_by("k").agg(count_if_("b", "ci"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_count_if_global():
+    def build(s):
+        df = gen_df(s, [BooleanGen()], ["b"], length=300)
+        return df.agg(count_if_("b", "ci"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("fn", [skewness_, kurtosis_],
+                         ids=["skewness", "kurtosis"])
+def test_higher_moments(fn):
+    def build(s):
+        df = gen_df(s, [_key, DoubleGen()], ["k", "v"], length=600)
+        return df.group_by("k").agg(fn("v", "m"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True,
+                                         float_digits=8)
+
+
+def test_moments_constant_group_null():
+    """Zero variance -> NULL (Spark nullOnDivideByZero)."""
+    def build(s):
+        df = gen_df(s, [_key, SetValuesGen(T.INT, [7], nullable=False)],
+                    ["k", "v"], length=100)
+        return df.group_by("k").agg(skewness_("v", "sk"),
+                                    kurtosis_("v", "ku"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("fn", [corr_, covar_pop_, covar_samp_],
+                         ids=["corr", "covar_pop", "covar_samp"])
+def test_covariance_family(fn):
+    def build(s):
+        df = gen_df(s, [_key, DoubleGen(), DoubleGen()], ["k", "x", "y"],
+                    length=600)
+        return df.group_by("k").agg(fn(col("x"), col("y"), "c"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True,
+                                         float_digits=8)
+
+
+def test_covariance_global_ints():
+    def build(s):
+        df = gen_df(s, [IntegerGen(), LongGen()], ["x", "y"], length=400)
+        return df.agg(corr_(col("x"), col("y"), "r"),
+                      covar_pop_(col("x"), col("y"), "cp"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True,
+                                         float_digits=8)
+
+
+@pytest.mark.parametrize("p", [0.0, 0.25, 0.5, 0.9, 1.0])
+def test_percentile(p):
+    def build(s):
+        df = gen_df(s, [_key, LongGen()], ["k", "v"], length=500)
+        return df.group_by("k").agg(percentile_("v", p, "p"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+@pytest.mark.parametrize("p", [0.1, 0.5, 0.99])
+def test_approx_percentile(p):
+    def build(s):
+        df = gen_df(s, [_key, IntegerGen()], ["k", "v"], length=500)
+        return df.group_by("k").agg(approx_percentile_("v", p, name="p"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_percentile_doubles_with_nan():
+    def build(s):
+        df = gen_df(s, [_key, DoubleGen()], ["k", "v"], length=400)
+        return df.group_by("k").agg(percentile_("v", 0.5, "med"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+@pytest.mark.parametrize("gen", [IntegerGen(), LongGen(), StringGen(),
+                                 DoubleGen()],
+                         ids=["int", "long", "string", "double"])
+def test_approx_count_distinct(gen):
+    def build(s):
+        df = gen_df(s, [_key, gen], ["k", "v"], length=800)
+        return df.group_by("k").agg(approx_count_distinct_("v", "acd"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_approx_count_distinct_global():
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=50)], ["v"],
+                    length=600)
+        return df.agg(approx_count_distinct_("v", "acd"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_bloom_filter_agg_and_might_contain():
+    """Build a bloom filter on one side, probe with might_contain —
+    the runtime-filter join pushdown pattern (GpuBloomFilterMightContain)."""
+    from spark_rapids_tpu.expr.hashexprs import BloomFilterMightContain
+
+    def build(s):
+        build_side = gen_df(s, [IntegerGen(min_val=0, max_val=40,
+                                           nullable=False)], ["v"],
+                            length=300)
+        bloom = build_side.agg(bloom_filter_agg_("v", "bf"))
+        probe = gen_df(s, [IntegerGen(min_val=0, max_val=200,
+                                      nullable=False)], ["p"],
+                       length=300, seed=99)
+        joined = probe.cross_join(bloom)
+        return joined.select(
+            col("p"),
+            BloomFilterMightContain(col("bf"), col("p")).alias("mc"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_bloom_filter_no_false_negatives():
+    """Every value put in the filter must probe true."""
+    from spark_rapids_tpu.expr.hashexprs import BloomFilterMightContain
+    from spark_rapids_tpu.session import TpuSession
+
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    df = gen_df(s, [LongGen(nullable=False)], ["v"], length=200)
+    bloom = df.agg(bloom_filter_agg_("v", "bf"))
+    probe = df.cross_join(bloom).select(
+        BloomFilterMightContain(col("bf"), col("v")).alias("mc"))
+    rows = probe.collect()
+    assert all(r[0] is True for r in rows)
+
+
+def test_percentile_all_null_group():
+    from spark_rapids_tpu.session import TpuSession
+
+    def build(s):
+        df = s.create_dataframe(
+            {"k": [1, 1, 2], "v": [None, None, 5]},
+            T.StructType([T.StructField("k", T.INT, False),
+                          T.StructField("v", T.LONG)]))
+        return df.group_by("k").agg(percentile_("v", 0.5, "p"),
+                                    approx_percentile_("v", 0.5, name="ap"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)

@@ -1,0 +1,161 @@
+"""Delta Lake tests: log roundtrip, time travel, DELETE/UPDATE/MERGE,
+OPTIMIZE ZORDER, vacuum (reference: delta_lake_*_test.py)."""
+import os
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.delta import DeltaLog, DeltaTable, write_delta
+from spark_rapids_tpu.session import TpuSession, col, lit
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import IntegerGen, LongGen, StringGen, gen_df
+
+
+def _sess():
+    return TpuSession({"spark.rapids.sql.enabled": True})
+
+
+def _make_table(s, path, n=200, seed=1):
+    df = gen_df(s, [IntegerGen(min_val=0, max_val=50, nullable=False),
+                    LongGen(), StringGen()], ["k", "v", "s"],
+                length=n, seed=seed)
+    df.write.mode("error").delta(path)
+    return df
+
+
+def test_write_read_roundtrip(tmp_path):
+    p = str(tmp_path / "t")
+    s = _sess()
+    df = _make_table(s, p)
+    back = sorted(s.read.delta(p).collect(), key=repr)
+    assert back == sorted(df.collect(), key=repr)
+
+
+def test_append_and_overwrite(tmp_path):
+    p = str(tmp_path / "t")
+    s = _sess()
+    _make_table(s, p, n=100)
+    df2 = gen_df(s, [IntegerGen(min_val=0, max_val=50, nullable=False),
+                     LongGen(), StringGen()], ["k", "v", "s"],
+                 length=60, seed=9)
+    df2.write.mode("append").delta(p)
+    assert len(s.read.delta(p).collect()) == 160
+    df2.write.mode("overwrite").delta(p)
+    assert len(s.read.delta(p).collect()) == 60
+    # time travel: version 0 still has the first write
+    assert len(s.read.delta(p, version=0).collect()) == 100
+
+
+def test_delete(tmp_path):
+    p = str(tmp_path / "t")
+    s = _sess()
+    _make_table(s, p)
+    before = s.read.delta(p).collect()
+    expect = [r for r in before if not (r[0] is not None and r[0] < 10)]
+    dt = DeltaTable.for_path(s, p)
+    dt.delete(col("k") < lit(10))
+    after = s.read.delta(p).collect()
+    assert sorted(after, key=repr) == sorted(expect, key=repr)
+
+
+def test_update(tmp_path):
+    p = str(tmp_path / "t")
+    s = _sess()
+    _make_table(s, p)
+    before = s.read.delta(p).collect()
+    dt = DeltaTable.for_path(s, p)
+    dt.update(col("k") >= lit(25), {"v": lit(0).cast(T.LONG)})
+    after = sorted(s.read.delta(p).collect(), key=repr)
+    expect = sorted(((k, 0 if k >= 25 else v, st) for k, v, st in before), key=repr)
+    assert after == expect
+
+
+def test_merge_upsert(tmp_path):
+    p = str(tmp_path / "t")
+    s = _sess()
+    data = {"k": [1, 2, 3, 4], "v": [10, 20, 30, 40],
+            "s": ["a", "b", "c", "d"]}
+    schema = T.StructType([T.StructField("k", T.INT, False),
+                           T.StructField("v", T.LONG),
+                           T.StructField("s", T.STRING)])
+    s.create_dataframe(data, schema).write.mode("error").delta(p)
+    src = s.create_dataframe(
+        {"k": [3, 4, 5, 6], "nv": [333, 444, 555, 666],
+         "ns": ["C", "D", "E", "F"]},
+        T.StructType([T.StructField("k", T.INT, False),
+                      T.StructField("nv", T.LONG),
+                      T.StructField("ns", T.STRING)]))
+    # matched -> update v/s from source; not matched -> insert
+    src_for_insert = src.select(
+        col("k"), col("nv").alias("v"), col("ns").alias("s"))
+    dt = DeltaTable.for_path(s, p)
+    dt.merge(src, on=["k"],
+             when_matched_update={"v": col("nv"), "s": col("ns")},
+             when_not_matched_insert=False)
+    dt.merge(src_for_insert, on=["k"], when_not_matched_insert=True)
+    rows = dict((r[0], (r[1], r[2])) for r in s.read.delta(p).collect())
+    assert rows[1] == (10, "a") and rows[2] == (20, "b")
+    assert rows[3] == (333, "C") and rows[4] == (444, "D")
+    assert rows[5] == (555, "E") and rows[6] == (666, "F")
+
+
+def test_merge_delete(tmp_path):
+    p = str(tmp_path / "t")
+    s = _sess()
+    _make_table(s, p)
+    before = s.read.delta(p).collect()
+    keys = sorted({r[0] for r in before})[:5]
+    src = s.create_dataframe(
+        {"k": keys}, T.StructType([T.StructField("k", T.INT, False)]))
+    dt = DeltaTable.for_path(s, p)
+    dt.merge(src, on=["k"], when_matched_delete=True,
+             when_not_matched_insert=False)
+    after = s.read.delta(p).collect()
+    assert sorted(after, key=repr) == sorted((r for r in before if r[0] not in keys), key=repr)
+
+
+def test_optimize_zorder_preserves_rows(tmp_path):
+    p = str(tmp_path / "t")
+    s = _sess()
+    _make_table(s, p, n=150)
+    extra = gen_df(s, [IntegerGen(min_val=0, max_val=50, nullable=False),
+                       LongGen(), StringGen()], ["k", "v", "s"],
+                   length=50, seed=77)
+    extra.write.mode("append").delta(p)
+    before = sorted(s.read.delta(p).collect(), key=repr)
+    dt = DeltaTable.for_path(s, p)
+    stats = dt.optimize(zorder_by=["k", "v"])
+    assert stats["files_removed"] == 2
+    after = sorted(s.read.delta(p).collect(), key=repr)
+    assert after == before
+    removed = dt.vacuum()
+    assert removed == 2
+    assert sorted(s.read.delta(p).collect(), key=repr) == before
+
+
+def test_checkpoint_replay(tmp_path):
+    p = str(tmp_path / "t")
+    s = _sess()
+    df = gen_df(s, [IntegerGen(nullable=False)], ["a"], length=10)
+    df.write.mode("error").delta(p)
+    for _ in range(12):  # crosses the checkpoint interval
+        df.write.mode("append").delta(p)
+    log = DeltaLog(p)
+    assert log._last_checkpoint_version() >= 10
+    assert len(s.read.delta(p).collect()) == 130
+
+
+def test_delta_scan_through_engine_differential(tmp_path):
+    p = str(tmp_path / "t")
+    s = _sess()
+    _make_table(s, p, n=300)
+
+    def build(sess):
+        from spark_rapids_tpu.session import sum_
+
+        df = sess.read.delta(p)
+        return df.filter(col("k") > lit(10)).group_by("k").agg(
+            sum_("v", "sv"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)

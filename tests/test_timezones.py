@@ -1,0 +1,74 @@
+"""Timezone tests: from_utc_timestamp / to_utc_timestamp incl. DST
+boundaries (reference: date_time_test.py tz cases + GpuTimeZoneDB)."""
+import datetime
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.datetime import FromUTCTimestamp, ToUTCTimestamp
+from spark_rapids_tpu.session import col, lit
+
+from asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    assert_tpu_fallback_collect,
+)
+from data_gen import TimestampGen, gen_df
+
+_ZONES = ["America/New_York", "Europe/Berlin", "Asia/Kolkata",
+          "Australia/Sydney", "UTC", "Asia/Tokyo"]
+
+
+@pytest.mark.parametrize("tz", _ZONES)
+def test_from_utc_timestamp(tz):
+    def build(s):
+        df = gen_df(s, [TimestampGen()], ["t"], length=400)
+        return df.select(FromUTCTimestamp(col("t"), lit(tz)).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("tz", _ZONES)
+def test_to_utc_timestamp(tz):
+    def build(s):
+        df = gen_df(s, [TimestampGen()], ["t"], length=400)
+        return df.select(ToUTCTimestamp(col("t"), lit(tz)).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_dst_boundaries_pinned():
+    """Spring-forward gap and fall-back overlap, America/New_York 2024."""
+    def ts(y, mo, d, h, mi=0):
+        return datetime.datetime(y, mo, d, h, mi,
+                                 tzinfo=datetime.timezone.utc)
+
+    # gap: 2024-03-10 02:30 EST does not exist; overlap: 2024-11-03 01:30
+    walls = [ts(2024, 3, 10, 2, 30), ts(2024, 11, 3, 1, 30),
+             ts(2024, 6, 1, 12), ts(2024, 1, 1, 12)]
+
+    def build(s):
+        df = s.create_dataframe(
+            {"t": walls},
+            T.StructType([T.StructField("t", T.TIMESTAMP)]))
+        return df.select(
+            ToUTCTimestamp(col("t"), lit("America/New_York")).alias("to"),
+            FromUTCTimestamp(col("t"),
+                             lit("America/New_York")).alias("fr"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, ignore_order=False)
+
+
+def test_unknown_timezone_falls_back():
+    def build(s):
+        df = gen_df(s, [TimestampGen()], ["t"], length=20)
+        return df.select(
+            FromUTCTimestamp(col("t"), lit("Not/AZone")).alias("r"))
+
+    # oracle would raise too; just assert the plan tag
+    import spark_rapids_tpu.session as S
+
+    sess = S.TpuSession({"spark.rapids.sql.enabled": True})
+    df = build(sess)
+    root, meta = df._planned()
+    assert "unknown or unsupported timezone" in meta.explain(
+        only_fallback=False)
