@@ -153,6 +153,39 @@ class ColumnarBatch:
                     _concat_col([c.children[k] for c in cols])
                     for k in range(len(cols[0].children)))
                 return DeviceColumn(dtype, validity, children=kids)
+            if cols[0].is_string_array:
+                ew = max(c.ewidth for c in cols)
+                w = max(c.width for c in cols)
+                chars = jnp.zeros((cap, ew, w), jnp.uint8)
+                elens = jnp.zeros((cap, ew), jnp.int32)
+                ev = jnp.zeros((cap, ew), jnp.bool_)
+                lengths = jnp.zeros(cap, jnp.int32)
+                validity = jnp.zeros(cap, jnp.bool_)
+                off = 0
+                for b, c in zip(batches, cols):
+                    nn = b.num_rows
+                    if nn == 0:
+                        continue
+                    cpad = jnp.pad(c.chars, ((0, 0), (0, ew - c.ewidth),
+                                             (0, w - c.width)))[:nn]
+                    chars = jax.lax.dynamic_update_slice(
+                        chars, cpad.astype(jnp.uint8), (off, 0, 0))
+                    elens = jax.lax.dynamic_update_slice(
+                        elens,
+                        jnp.pad(c.data, ((0, 0), (0, ew - c.ewidth))
+                                )[:nn].astype(jnp.int32), (off, 0))
+                    ev = jax.lax.dynamic_update_slice(
+                        ev, jnp.pad(c.elem_valid,
+                                    ((0, 0), (0, ew - c.ewidth)))[:nn],
+                        (off, 0))
+                    lengths = jax.lax.dynamic_update_slice(
+                        lengths, c.lengths[:nn], (off,))
+                    validity = jax.lax.dynamic_update_slice(
+                        validity, c.validity[:nn], (off,))
+                    off += nn
+                return DeviceColumn(dtype, validity, chars=chars,
+                                    data=elens, lengths=lengths,
+                                    elem_valid=ev)
             if cols[0].is_string:
                 width = max(c.width for c in cols)
                 chars = jnp.zeros((cap, width), jnp.uint8)
@@ -223,6 +256,11 @@ class ColumnarBatch:
 
         def _slice_col(c: DeviceColumn) -> DeviceColumn:
             sl = slice(start, start + length)
+            if c.is_string_array:
+                return DeviceColumn(c.dtype, c.validity[sl],
+                                    chars=c.chars[sl], data=c.data[sl],
+                                    lengths=c.lengths[sl],
+                                    elem_valid=c.elem_valid[sl]).slice_to(cap)
             if c.is_string:
                 return DeviceColumn(c.dtype, c.validity[sl], chars=c.chars[sl],
                                     lengths=c.lengths[sl]).slice_to(cap)

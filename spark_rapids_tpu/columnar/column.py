@@ -72,6 +72,11 @@ class DeviceColumn:
     kind "struct": children = tuple of full child DeviceColumns (one per
                    struct field) — cuDF STRUCT columns are likewise a
                    validity mask over recursively stored children.
+    kind "string_array": chars (capacity, ewidth, width) uint8;
+                   data (capacity, ewidth) int32 holds PER-ELEMENT byte
+                   lengths; lengths (capacity,) element counts;
+                   elem_valid (capacity, ewidth) — array<string> as a 3-D
+                   padded char tensor (cuDF: LIST of STRING offsets).
     validity: (capacity,) bool; True = valid (non-null).
     """
 
@@ -98,15 +103,19 @@ class DeviceColumn:
     # -- properties ---------------------------------------------------------
     @property
     def is_string(self) -> bool:
-        return self.chars is not None
+        return self.chars is not None and self.chars.ndim == 2
 
     @property
     def is_array(self) -> bool:
-        return self.elem_valid is not None
+        return self.elem_valid is not None and self.chars is None
 
     @property
     def is_struct(self) -> bool:
         return self.children is not None
+
+    @property
+    def is_string_array(self) -> bool:
+        return self.chars is not None and self.chars.ndim == 3
 
     @property
     def is_dec128(self) -> bool:
@@ -119,11 +128,15 @@ class DeviceColumn:
 
     @property
     def width(self) -> int:
-        return int(self.chars.shape[1]) if self.chars is not None else 0
+        if self.chars is None:
+            return 0
+        return int(self.chars.shape[-1])
 
     @property
     def ewidth(self) -> int:
         """Element capacity per row for array columns."""
+        if self.is_string_array:
+            return int(self.chars.shape[1])
         return int(self.data.shape[1]) if self.is_array else 0
 
     def nbytes(self) -> int:
@@ -140,6 +153,11 @@ class DeviceColumn:
 
     def gather(self, idx) -> "DeviceColumn":
         """Row gather (works for every column kind)."""
+        if self.is_string_array:
+            return DeviceColumn(self.dtype, self.validity[idx],
+                                chars=self.chars[idx], data=self.data[idx],
+                                lengths=self.lengths[idx],
+                                elem_valid=self.elem_valid[idx])
         if self.is_string:
             return DeviceColumn(self.dtype, self.validity[idx],
                                 chars=self.chars[idx],
@@ -165,6 +183,22 @@ class DeviceColumn:
         cap = capacity or round_up_bucket(max(n, 1), row_buckets)
         validity = np.zeros(cap, dtype=np.bool_)
         validity[:n] = h.validity[:n]
+        if h.is_string_array:
+            ew = h.chars.shape[1]
+            w = h.chars.shape[2]
+            chars = np.zeros((cap, max(ew, 1), max(w, 1)), np.uint8)
+            chars[:n, :ew, :w] = h.chars[:n]
+            elens = np.zeros((cap, max(ew, 1)), np.int32)
+            elens[:n, :ew] = h.data[:n]
+            ev = np.zeros((cap, max(ew, 1)), np.bool_)
+            ev[:n, :ew] = h.elem_valid[:n]
+            lengths = np.zeros(cap, np.int32)
+            lengths[:n] = h.lengths[:n]
+            return DeviceColumn(dtype=h.dtype, validity=jnp.asarray(validity),
+                                chars=jnp.asarray(chars),
+                                data=jnp.asarray(elens),
+                                lengths=jnp.asarray(lengths),
+                                elem_valid=jnp.asarray(ev))
         if h.is_string:
             max_len = int(h.lengths[:n].max()) if n else 0
             width = round_up_bucket(max(max_len, 1), width_buckets)
@@ -203,6 +237,13 @@ class DeviceColumn:
 
     def to_host(self, num_rows: int) -> "HostColumn":
         validity = np.asarray(self.validity)[:num_rows]
+        if self.is_string_array:
+            return HostColumn(dtype=self.dtype, validity=validity,
+                              chars=np.asarray(self.chars)[:num_rows],
+                              data=np.asarray(self.data)[:num_rows],
+                              lengths=np.asarray(self.lengths)[:num_rows],
+                              elem_valid=np.asarray(
+                                  self.elem_valid)[:num_rows])
         if self.is_string:
             return HostColumn(dtype=self.dtype, validity=validity,
                               chars=np.asarray(self.chars)[:num_rows],
@@ -224,6 +265,12 @@ class DeviceColumn:
         if capacity == self.capacity:
             return self
         if capacity < self.capacity:
+            if self.is_string_array:
+                return DeviceColumn(self.dtype, self.validity[:capacity],
+                                    chars=self.chars[:capacity],
+                                    data=self.data[:capacity],
+                                    lengths=self.lengths[:capacity],
+                                    elem_valid=self.elem_valid[:capacity])
             if self.is_string:
                 return DeviceColumn(self.dtype, self.validity[:capacity],
                                     chars=self.chars[:capacity],
@@ -241,6 +288,19 @@ class DeviceColumn:
                                 data=self.data[:capacity])
         pad = capacity - self.capacity
         validity = jnp.concatenate([self.validity, jnp.zeros(pad, jnp.bool_)])
+        if self.is_string_array:
+            return DeviceColumn(
+                self.dtype, validity,
+                chars=jnp.concatenate(
+                    [self.chars,
+                     jnp.zeros((pad,) + self.chars.shape[1:], jnp.uint8)]),
+                data=jnp.concatenate(
+                    [self.data, jnp.zeros((pad, self.ewidth), jnp.int32)]),
+                lengths=jnp.concatenate(
+                    [self.lengths, jnp.zeros(pad, jnp.int32)]),
+                elem_valid=jnp.concatenate(
+                    [self.elem_valid,
+                     jnp.zeros((pad, self.ewidth), jnp.bool_)]))
         if self.is_string:
             return DeviceColumn(
                 self.dtype, validity,
@@ -287,11 +347,15 @@ class HostColumn:
 
     @property
     def is_string(self) -> bool:
-        return self.chars is not None
+        return self.chars is not None and self.chars.ndim == 2
 
     @property
     def is_array(self) -> bool:
-        return self.elem_valid is not None
+        return self.elem_valid is not None and self.chars is None
+
+    @property
+    def is_string_array(self) -> bool:
+        return self.chars is not None and self.chars.ndim == 3
 
     @property
     def is_struct(self) -> bool:
@@ -303,6 +367,12 @@ class HostColumn:
 
     def slice_rows(self, start: int, end: int) -> "HostColumn":
         """Row range view (all column kinds)."""
+        if self.is_string_array:
+            return HostColumn(self.dtype, self.validity[start:end],
+                              chars=self.chars[start:end],
+                              data=self.data[start:end],
+                              lengths=self.lengths[start:end],
+                              elem_valid=self.elem_valid[start:end])
         if self.is_string:
             return HostColumn(self.dtype, self.validity[start:end],
                               chars=self.chars[start:end],
@@ -352,6 +422,32 @@ class HostColumn:
                         fv.append(v[fi])
                 kids.append(HostColumn.from_pylist(fv, f.dataType))
             return HostColumn(dtype, validity, children=kids)
+        if isinstance(dtype, T.ArrayType) and isinstance(
+                dtype.elementType, T.StringType):
+            # array<string>: 3-D padded char tensor
+            ew = max((len(v) for v in values if v is not None),
+                     default=1) or 1
+            encoded = [[e.encode("utf-8") if e is not None else None
+                        for e in v] if v is not None else None
+                       for v in values]
+            w = max((len(b) for row in encoded if row is not None
+                     for b in row if b is not None), default=1) or 1
+            chars = np.zeros((n, ew, w), np.uint8)
+            elens = np.zeros((n, ew), np.int32)
+            ev = np.zeros((n, ew), np.bool_)
+            lengths = np.zeros(n, np.int32)
+            for i, row in enumerate(encoded):
+                if row is None:
+                    continue
+                lengths[i] = len(row)
+                for j, b in enumerate(row):
+                    if b is None:
+                        continue
+                    ev[i, j] = True
+                    elens[i, j] = len(b)
+                    chars[i, j, :len(b)] = np.frombuffer(b, np.uint8)
+            return HostColumn(dtype, validity, chars=chars, data=elens,
+                              lengths=lengths, elem_valid=ev)
         if isinstance(dtype, T.ArrayType):
             elem_host = HostColumn.from_pylist(
                 [e for v in values if v is not None for e in v],
@@ -361,7 +457,7 @@ class HostColumn:
             sdt = elem_host.data.dtype if elem_host.data is not None else None
             if sdt is None:
                 raise NotImplementedError(
-                    "arrays of strings are not supported yet")
+                    "nested array elements are not supported yet")
             data = np.zeros((n, width), dtype=sdt)
             ev = np.zeros((n, width), np.bool_)
             lengths = np.zeros(n, np.int32)
@@ -429,6 +525,23 @@ class HostColumn:
         return HostColumn(dtype, validity, data=data)
 
     def to_pylist(self) -> List:
+        if self.is_string_array:
+            out = []
+            for i in range(self.num_rows):
+                if not self.validity[i]:
+                    out.append(None)
+                    continue
+                ln = int(self.lengths[i])
+                row = []
+                for j in range(ln):
+                    if not self.elem_valid[i, j]:
+                        row.append(None)
+                    else:
+                        row.append(bytes(
+                            self.chars[i, j, :self.data[i, j]]).decode(
+                            "utf-8", "replace"))
+                out.append(row)
+            return out
         if isinstance(self.dtype, T.MapType):
             keys = self.children[0].to_pylist()
             vals = self.children[1].to_pylist()

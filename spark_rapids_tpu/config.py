@@ -251,11 +251,50 @@ JSON_ENABLED = conf("spark.rapids.sql.format.json.enabled").boolean_conf(True)
 JSON_READ_ENABLED = conf("spark.rapids.sql.format.json.read.enabled").boolean_conf(True)
 ORC_ENABLED = conf("spark.rapids.sql.format.orc.enabled").boolean_conf(True)
 AVRO_ENABLED = conf("spark.rapids.sql.format.avro.enabled").boolean_conf(True)
+PARQUET_DEVICE_DECODE = conf(
+    "spark.rapids.sql.format.parquet.decode.device").doc(
+    "Decode Parquet pages with the Pallas kernels (bit-unpack + run "
+    "expansion + dictionary gather on device; host parses only footers "
+    "and run headers).  Files outside the supported subset (v2 pages, "
+    "snappy, byte arrays, nested) silently fall back to the host pyarrow "
+    "decode per file.  Off by default: correct on TPU, but the page "
+    "pipeline dispatches eager device ops whose round-trips dominate "
+    "over a tunneled chip (directly-attached TPU hosts amortize "
+    "them).").boolean_conf(False)
 AVRO_READ_ENABLED = conf("spark.rapids.sql.format.avro.read.enabled").doc(
     "Enable TPU Avro scans (pure-python container decode, io/avro.py)."
 ).boolean_conf(True)
 
 # --- shuffle ---------------------------------------------------------------
+
+ADAPTIVE_ENABLED = conf("spark.sql.adaptive.enabled").doc(
+    "AQE analog: shuffled equi-joins re-plan themselves at execution time "
+    "— the build side materializes first and, when its measured bytes sit "
+    "under spark.sql.autoBroadcastJoinThreshold, the join runs broadcast "
+    "with both planned exchanges elided (runtime stats beat static "
+    "planning).").boolean_conf(True)
+
+OPTIMIZER_ENABLED = conf("spark.rapids.sql.optimizer.enabled").doc(
+    "Cost-based fallback (CostBasedOptimizer analog, default off like the "
+    "reference): plans whose estimated input is below "
+    "spark.rapids.sql.optimizer.smallPlanBytes stay on CPU — the device "
+    "round-trip cannot pay for itself.").boolean_conf(False)
+
+OPTIMIZER_SMALL_PLAN_BYTES = conf(
+    "spark.rapids.sql.optimizer.smallPlanBytes").doc(
+    "Cost-based fallback threshold (bytes).").integer_conf(32768)
+
+ARROW_EVAL_ENABLED = conf("spark.rapids.sql.python.arrowEval.enabled").doc(
+    "Run plain python UDFs inside the TPU plan through the host arrow-eval "
+    "path (GpuArrowEvalPythonExec analog): batches cross to the host for "
+    "the UDF only, everything else stays on device.  false: such stages "
+    "fall back to CPU entirely.").boolean_conf(True)
+
+UDF_COMPILER_ENABLED = conf("spark.rapids.sql.udfCompiler.enabled").doc(
+    "Translate simple python UDFs into engine expressions at plan time by "
+    "operator-overload tracing (the udf-compiler analog of the "
+    "reference's bytecode decompiler); untranslatable functions keep the "
+    "arrow-eval path.").boolean_conf(True)
 
 PROFILE_ENABLED = conf("spark.rapids.profile.enabled").doc(
     "Wrap every operator's per-batch work in jax.profiler TraceAnnotations "

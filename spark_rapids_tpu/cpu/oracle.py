@@ -84,6 +84,12 @@ class CpuCol:
                 vals[i] = (tuple(k.row(i) for k in kids)
                            if h.validity[i] else None)
             return CpuCol(h.dtype, vals, h.validity.copy())
+        if h.is_string_array:
+            lists = h.to_pylist()
+            vals = np.empty(h.num_rows, object)
+            for i, v in enumerate(lists):
+                vals[i] = v
+            return CpuCol(h.dtype, vals, h.validity.copy())
         if h.is_array:
             elem_t = h.dtype.elementType
             vals = []
@@ -142,6 +148,14 @@ class CpuCol:
                       else None for i in range(n)]
                 kids.append(CpuCol.from_objs(fv, f.dataType).to_host())
             return HostColumn(self.dtype, self.validity.copy(), children=kids)
+        if isinstance(self.dtype, T.ArrayType) and isinstance(
+                self.dtype.elementType, T.StringType):
+            rows = [list(self.values[i]) if self.validity[i]
+                    and self.values[i] is not None else None
+                    for i in range(n)]
+            h = HostColumn.from_pylist(rows, self.dtype)
+            h.validity = self.validity.copy()
+            return h
         if isinstance(self.dtype, T.ArrayType):
             elem_t = self.dtype.elementType
             width = max((len(v) for v in self.values if v is not None),
@@ -1459,6 +1473,11 @@ def _arr_index(e, cols, n, ansi, one_based):
             validity[i] = False
         else:
             out_vals.append(v[idx])
+    if isinstance(et, T.StringType):
+        arr = np.empty(n, object)
+        for i, x in enumerate(out_vals):
+            arr[i] = x
+        return CpuCol(et, arr, validity)
     arr = np.array([x if x is not None else 0 for x in out_vals],
                    T.storage_dtype(et))
     return CpuCol(et, arr, validity)
@@ -1524,13 +1543,63 @@ def _h_udf(e, cols, n, ansi):
     kids = _kids(e, cols, n, ansi)
     out_vals = []
     validity = np.ones(n, np.bool_)
+    from spark_rapids_tpu.udf_compiler import F, _wants_namespace
+
+    wants_f = _wants_namespace(e.fn)
+    if getattr(e, "vectorized", False):
+        # pandas-style: whole columns in storage representation (mirrors
+        # UserDefinedExpression._eval_python's vectorized branch)
+        ins = []
+        for k in kids:
+            if k.values.dtype == object:
+                ins.append(np.array(k.to_pylist(), dtype=object))
+            else:
+                ins.append(k.values)
+        res = np.asarray(e.fn(*ins))
+        mask = np.ones(n, np.bool_)
+        for k in kids:
+            mask &= k.validity
+        out_vals = [res[i].item() if mask[i] else None for i in range(n)]
+        validity = mask.copy()
+        for i in range(n):
+            if out_vals[i] is None:
+                validity[i] = False
+        dt = e.dataType
+        return _udf_results_to_col(out_vals, validity, dt, n)
+    # python UDFs receive CONVERTED python values (dates as datetime.date,
+    # decimals as Decimal, plain python ints — NOT numpy storage scalars),
+    # exactly like pyspark and the device arrow-eval path
+    pylists = [k.to_pylist() for k in kids]
     for i in range(n):
-        args = [k.row(i) for k in kids]
-        v = e.fn(*args)
+        args = [p[i] for p in pylists]
+        v = e.fn(*args, F) if wants_f else e.fn(*args)
+        v = _clamp_udf_result(v, e.dataType)
         if v is None:
             validity[i] = False
         out_vals.append(v)
     dt = e.dataType
+    return _udf_results_to_col(out_vals, validity, dt, n)
+
+
+_INT_BOUNDS = {T.ByteType: 2**7, T.ShortType: 2**15, T.IntegerType: 2**31,
+               T.LongType: 2**63}
+
+
+def _clamp_udf_result(v, dt):
+    """Results outside the declared type's range become NULL (pyspark's
+    serializer behavior)."""
+    bound = _INT_BOUNDS.get(type(dt))
+    if bound is not None and v is not None:
+        if not isinstance(v, int) or not (-bound <= v < bound):
+            return None
+    return v
+
+
+def _udf_results_to_col(out_vals, validity, dt, n):
+    out_vals = [_clamp_udf_result(v, dt) for v in out_vals]
+    for i, v in enumerate(out_vals):
+        if v is None:
+            validity[i] = False
     if isinstance(dt, (T.StringType, T.DecimalType)):
         arr = np.array([v if v is not None else None for v in out_vals],
                        object)
@@ -2242,6 +2311,44 @@ def _h_bloom_might_contain(e, cols, n, ansi):
     return CpuCol(T.BOOLEAN, out, validity)
 
 
+def _h_string_split(e, cols, n, ansi):
+    import re as _re
+
+    kids = _kids(e, cols, n, ansi)
+    s = kids[0]
+    pat = e._pattern
+    limit = e._limit
+    try:
+        rx = _re.compile(_java_regex_to_python(pat)) if pat else None
+    except _re.error:
+        rx = None
+    vals = np.empty(n, object)
+    validity = s.validity.copy()
+    from spark_rapids_tpu.expr.strings import _java_split
+
+    for i in range(n):
+        if not validity[i] or rx is None:
+            validity[i] = False
+            continue
+        vals[i] = _java_split(rx, s.values[i], limit)
+    return CpuCol(e.dataType, vals, validity)
+
+
+def _h_array_join(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    arr, delim = kids[0], kids[1]
+    rep = kids[2] if len(kids) > 2 else None
+    validity = arr.validity & delim.validity
+    out = np.empty(n, object)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        r = rep.row(i) if rep is not None else None
+        parts = [e2 if e2 is not None else r for e2 in arr.values[i]]
+        out[i] = delim.values[i].join(p for p in parts if p is not None)
+    return CpuCol.from_objs(list(out), T.STRING)
+
+
 # -- collection breadth ------------------------------------------------------
 
 def _nan_eq(a, b):
@@ -2885,6 +2992,8 @@ _HANDLERS = {
     "ArrayMax": _h_array_minmax,
     "StringLeft": _h_leftright, "StringRight": _h_leftright,
     "SubstringIndex": _h_substring_index,
+    "StringSplit": _h_string_split,
+    "ArrayJoin": _h_array_join,
     "RegExpReplace": _h_regexp_replace,
     "RegExpExtract": _h_regexp_extract,
     "GetJsonObject": _h_get_json_object,
@@ -2978,6 +3087,16 @@ def execute_cpu_plan(plan: PN.SparkPlan, ansi: bool = False) -> Tuple[CpuBatch, 
         return merged, n * len(plan.projections)
     if isinstance(plan, PN.BroadcastNestedLoopJoin):
         return _cpu_bnlj(plan, ansi)
+    if isinstance(plan, PN.Sample):
+        from spark_rapids_tpu.expr.misc import Rand as _DevRand
+
+        cols, n = execute_cpu_plan(plan.children[0], ansi)
+        z = _DevRand._u64_for_rows(plan.seed, 0, n)
+        u = (z >> np.uint64(11)).astype(np.float64) / float(1 << 53)
+        keep = u < plan.fraction
+        idx = np.nonzero(keep)[0]
+        return [CpuCol(c.dtype, c.values[idx], c.validity[idx])
+                for c in cols], len(idx)
     if isinstance(plan, PN.Project):
         cols, n = execute_cpu_plan(plan.child, ansi)
         return [eval_expr(e, cols, n, ansi) for e in plan.exprs], n
@@ -3835,9 +3954,14 @@ def _cpu_generate(plan: PN.Generate, ansi: bool):
             [r[1] if r[1] is not None else 0 for r in rows], np.int32),
             np.array([r[1] is not None for r in rows], np.bool_)))
     et = plan.gen_expr.dataType.elementType
-    evals = np.array([r[2] if r[3] else 0 for r in rows],
-                     T.storage_dtype(et))
     evalid = np.array([r[3] for r in rows], np.bool_)
+    if isinstance(et, T.StringType):
+        evals = np.empty(m, object)
+        for j, r in enumerate(rows):
+            evals[j] = r[2] if r[3] else None
+    else:
+        evals = np.array([r[2] if r[3] else 0 for r in rows],
+                         T.storage_dtype(et))
     out.append(CpuCol(et, evals, evalid))
     return out, m
 

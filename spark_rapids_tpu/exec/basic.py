@@ -143,6 +143,7 @@ class TpuStageExec(TpuExec):
         self.ops = _fuse_filter_project(list(ops), ansi)
         self.ansi = ansi
         self._jitted = None
+        self._offset_holder = [0]
         self._out_schema = child.output
         for op in self.ops:
             self._out_schema = op.out_schema(self._out_schema)
@@ -175,9 +176,15 @@ class TpuStageExec(TpuExec):
 
         msgs_store: List[str] = []  # filled as a trace-time side effect
 
+        offset_holder = self._offset_holder
+
         def fn(cols, num_rows):
             batch = ColumnarBatch(list(cols), num_rows, in_schema)
-            ctx = EvalContext(batch, ansi=ansi)
+            # row_offset is only consumed by host-kernel expressions, which
+            # force the EAGER path — under jit the closure value would be
+            # baked at trace time, but jitted stages never contain them
+            ctx = EvalContext(batch, ansi=ansi,
+                              row_offset=offset_holder[0])
             for op in ops:
                 batch = op.apply(ctx, batch)
             msgs_store.clear()
@@ -207,11 +214,13 @@ class TpuStageExec(TpuExec):
 
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
         child = self.children[0]
+        self._offset_holder[0] = 0
         for batch in child.execute_columnar():
             if self._jitted is None:
                 self._jitted = self._build(batch.schema)
             with self.metrics["opTime"].timed():
                 out = self._jitted(batch)
+            self._offset_holder[0] += batch.num_rows
             yield self._count_output(out)
 
 

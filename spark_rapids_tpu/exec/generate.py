@@ -97,8 +97,13 @@ class TpuGenerateExec(TpuExec):
             out_cols = gather_columns(src, row_valid, b.columns)
             ew = max(arr.ewidth, 1)
             ksafe = jnp.clip(k, 0, ew - 1)
-            elem = arr.data[src, ksafe] if arr.ewidth else jnp.zeros(
-                out_cap, arr.data.dtype)
+            if arr.is_string_array:
+                elem_chars = arr.chars[src, ksafe]       # (out_cap, w)
+                elem_lens = arr.data[src, ksafe]
+                elem = None
+            else:
+                elem = arr.data[src, ksafe] if arr.ewidth else jnp.zeros(
+                    out_cap, arr.data.dtype)
             ev = arr.elem_valid[src, ksafe] if arr.ewidth else jnp.zeros(
                 out_cap, jnp.bool_)
             # outer rows synthesized for empty/null arrays have k==0 but no
@@ -107,9 +112,15 @@ class TpuGenerateExec(TpuExec):
             if self.position:
                 out_cols.append(DeviceColumn(
                     T.INT, row_valid & in_arr, data=k))
-            out_cols.append(DeviceColumn(
-                self._output.fields[-1].dataType,
-                row_valid & ev & in_arr, data=elem))
+            if arr.is_string_array:
+                out_cols.append(DeviceColumn(
+                    self._output.fields[-1].dataType,
+                    row_valid & ev & in_arr, chars=elem_chars,
+                    lengths=elem_lens.astype(jnp.int32)))
+            else:
+                out_cols.append(DeviceColumn(
+                    self._output.fields[-1].dataType,
+                    row_valid & ev & in_arr, data=elem))
             return tuple(out_cols)
 
         key = ("gen", out_cap)

@@ -583,3 +583,95 @@ class TpuCartesianProductExec(TpuExec):
             if self.condition is not None:
                 out = self._apply_condition(out)
             yield self._count_output(out)
+
+
+class _ReplayExec(TpuExec):
+    """Re-emits batches already materialized by the adaptive planner.
+
+    Batches arrive as SPILLABLE handles (tracked while the runtime
+    decision was pending, so an oversized build side can shed to host/disk
+    instead of pinning HBM) and are closed once replayed."""
+
+    def __init__(self, handles, output_schema):
+        super().__init__([])
+        self._handles = handles
+        self._output = output_schema
+
+    @property
+    def output(self):
+        return self._output
+
+    def describe(self):
+        return f"Replay[{len(self._handles)} batches]"
+
+    def execute_columnar(self):
+        for h in self._handles:
+            yield h.get_batch()
+            h.close()
+        self._handles = []
+
+
+def _logical_bytes(batches) -> int:
+    """Row-weighted bytes (padding capacity excluded)."""
+    total = 0
+    for b in batches:
+        cap = max(b.capacity, 1)
+        total += int(sum(c.nbytes() for c in b.columns)
+                     * (b.num_rows / cap))
+    return total
+
+
+class TpuAdaptiveJoinExec(TpuExec):
+    """AQE runtime join-strategy switch (GpuCustomShuffleReaderExec /
+    AQE re-optimization analog, SURVEY.md §2.2).
+
+    Wraps a planned shuffled join whose children are exchanges.  At
+    EXECUTION time the build side below its exchange materializes first;
+    if its measured bytes fall under spark.sql.autoBroadcastJoinThreshold
+    the join re-plans itself as a broadcast join with BOTH exchanges
+    elided (runtime statistics beating the static planner — the point of
+    AQE); otherwise the shuffled plan runs with the materialized batches
+    replayed into its exchange, so nothing is computed twice."""
+
+    def __init__(self, shuffled: "TpuShuffledSymmetricHashJoinExec",
+                 threshold: int):
+        super().__init__(list(shuffled.children))
+        self.shuffled = shuffled
+        self.threshold = threshold
+        self.decision: Optional[str] = None
+
+    @property
+    def output(self):
+        return self.shuffled.output
+
+    def describe(self):
+        d = f" decided={self.decision}" if self.decision else ""
+        return (f"TpuAdaptiveJoin(threshold={self.threshold})"
+                f"[{self.shuffled.describe()}]{d}")
+
+    def execute_columnar(self):
+        from spark_rapids_tpu.memory.spill import get_spill_framework
+
+        left_ex, right_ex = self.shuffled.children
+        build_inner = right_ex.children[0]
+        fw = get_spill_framework()
+        handles = []
+        size = 0
+        for b in build_inner.execute_columnar():
+            size += _logical_bytes([b])
+            handles.append(fw.track(b))
+        if 0 <= self.threshold and size <= self.threshold:
+            self.decision = f"broadcast({size}B)"
+            bj = TpuBroadcastHashJoinExec(
+                left_ex.children[0], _ReplayExec(handles,
+                                                 build_inner.output),
+                self.shuffled.left_keys, self.shuffled.right_keys,
+                self.shuffled.join_type, self.shuffled.condition,
+                self.shuffled.output, self.shuffled.ansi,
+                sub_partition_bytes=self.shuffled.sub_partition_bytes)
+            self.metrics.update(bj.metrics)
+            yield from bj.execute_columnar()
+            return
+        self.decision = f"shuffled({size}B)"
+        right_ex.children[0] = _ReplayExec(handles, build_inner.output)
+        yield from self.shuffled.execute_columnar()

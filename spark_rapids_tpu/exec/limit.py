@@ -32,3 +32,42 @@ class TpuLocalLimitExec(TpuExec):
 class TpuGlobalLimitExec(TpuLocalLimitExec):
     def describe(self):
         return f"TpuGlobalLimit {self.n}"
+
+
+class TpuSampleExec(TpuExec):
+    """Bernoulli sample (GpuSampleExec analog): one jitted program per
+    batch computes the splitmix64 draw (same spec as Rand, offset by the
+    running row position) and compacts kept rows."""
+
+    def __init__(self, fraction: float, seed: int, child: TpuExec):
+        super().__init__([child])
+        self.fraction = fraction
+        self.seed = seed
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def describe(self):
+        return f"TpuSample fraction={self.fraction} seed={self.seed}"
+
+    def execute_columnar(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.expr.misc import Rand
+        from spark_rapids_tpu.ops.filterops import compact_columns
+
+        offset = 0
+        for b in self.children[0].execute_columnar():
+            with self.metrics["opTime"].timed():
+                z = Rand._u64_for_rows(self.seed, offset, b.capacity)
+                u = (z >> np.uint64(11)).astype(np.float64) / float(1 << 53)
+                keep = jnp.asarray(u < self.fraction) & b.row_mask
+                cols, count = compact_columns(keep, b.columns)
+                out = ColumnarBatch(list(cols), int(count), b.schema)
+            offset += b.num_rows
+            if out.num_rows:
+                yield self._count_output(out)

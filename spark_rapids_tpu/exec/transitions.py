@@ -95,6 +95,23 @@ def _concat_host(hs: List[HostColumn]) -> HostColumn:
         kids = [_concat_host([h.children[k] for h in hs])
                 for k in range(len(hs[0].children))]
         return HostColumn(dtype, validity, children=kids)
+    if hs[0].is_string_array:
+        ew = max(h.chars.shape[1] for h in hs)
+        w = max(h.chars.shape[2] for h in hs)
+        nrows = len(validity)
+        chars = np.zeros((nrows, ew, w), np.uint8)
+        elens = np.zeros((nrows, ew), np.int32)
+        ev = np.zeros((nrows, ew), np.bool_)
+        lengths = np.concatenate([h.lengths for h in hs])
+        off = 0
+        for h in hs:
+            k = len(h.lengths)
+            chars[off:off + k, :h.chars.shape[1], :h.chars.shape[2]] = h.chars
+            elens[off:off + k, :h.data.shape[1]] = h.data
+            ev[off:off + k, :h.elem_valid.shape[1]] = h.elem_valid
+            off += k
+        return HostColumn(dtype, validity, chars=chars, data=elens,
+                          lengths=lengths, elem_valid=ev)
     if hs[0].is_string:
         width = max(h.chars.shape[1] for h in hs)
         chars = np.zeros((len(validity), width), np.uint8)

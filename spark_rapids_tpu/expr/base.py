@@ -49,6 +49,10 @@ class EvalContext:
     batch: ColumnarBatch
     ansi: bool = False
     error_flags: List = dataclasses.field(default_factory=list)
+    # absolute row position of this batch's first row (host int; consumed
+    # by Rand / monotonically_increasing_id, which force the eager stage
+    # path so the value is concrete)
+    row_offset: int = 0
 
     @property
     def num_rows(self) -> int:

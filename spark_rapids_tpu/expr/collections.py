@@ -24,6 +24,19 @@ from spark_rapids_tpu.expr.base import (
 )
 
 
+def _take_element(arr: DeviceColumn, safe: jax.Array, validity: jax.Array,
+                  out_dt: T.DataType) -> DeviceColumn:
+    """Per-row element pick (string-array aware)."""
+    if arr.is_string_array:
+        cap = arr.capacity
+        rows = jnp.arange(cap)
+        chars = arr.chars[rows, safe]
+        lens = arr.data[rows, safe].astype(jnp.int32)
+        return DeviceColumn(out_dt, validity, chars=chars, lengths=lens)
+    data = jnp.take_along_axis(arr.data, safe[:, None], axis=1)[:, 0]
+    return DeviceColumn(out_dt, validity, data=data)
+
+
 class Size(UnaryExpression):
     """size(array): element count; null input -> -1 (legacy) like Spark's
     default spark.sql.legacy.sizeOfNull=true."""
@@ -50,10 +63,9 @@ class GetArrayItem(BinaryExpression):
         i = idx.data.astype(jnp.int32)
         inb = (i >= 0) & (i < arr.lengths)
         safe = jnp.clip(i, 0, max(arr.ewidth - 1, 0))
-        data = jnp.take_along_axis(arr.data, safe[:, None], axis=1)[:, 0]
         ev = jnp.take_along_axis(arr.elem_valid, safe[:, None], axis=1)[:, 0]
         validity = arr.validity & idx.validity & inb & ev
-        return DeviceColumn(self.dataType, validity, data=data)
+        return _take_element(arr, safe, validity, self.dataType)
 
 
 class ElementAt(BinaryExpression):
@@ -81,10 +93,9 @@ class ElementAt(BinaryExpression):
         pos = jnp.where(i > 0, i - 1, n + i)
         inb = (pos >= 0) & (pos < n) & ~zero
         safe = jnp.clip(pos, 0, max(arr.ewidth - 1, 0))
-        data = jnp.take_along_axis(arr.data, safe[:, None], axis=1)[:, 0]
         ev = jnp.take_along_axis(arr.elem_valid, safe[:, None], axis=1)[:, 0]
         validity = arr.validity & idx.validity & inb & ev
-        return DeviceColumn(self.dataType, validity, data=data)
+        return _take_element(arr, safe, validity, self.dataType)
 
 
 class ArrayContains(BinaryExpression):

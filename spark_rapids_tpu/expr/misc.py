@@ -634,7 +634,10 @@ class MonotonicallyIncreasingID(Expression):
     """monotonically_increasing_id(): (partition_id << 33) | row index.
 
     The session executes one logical partition; batches contribute a
-    running row offset carried on the EvalContext."""
+    running row offset carried on the EvalContext (host-kernel flag forces
+    the eager stage path, where the offset is a concrete int)."""
+
+    is_host_kernel = True
 
     def _resolve_type(self):
         self._dataType = T.LONG
@@ -642,7 +645,7 @@ class MonotonicallyIncreasingID(Expression):
 
     def eval_tpu(self, ctx: EvalContext) -> DeviceColumn:
         cap = ctx.batch.capacity
-        base = jnp.int64(getattr(ctx, "row_offset", 0))
+        base = jnp.int64(ctx.row_offset)
         ids = base + jnp.arange(cap, dtype=jnp.int64)
         return DeviceColumn(T.LONG, jnp.ones(cap, jnp.bool_), data=ids)
 
@@ -688,7 +691,7 @@ class Rand(Expression):
 
     def eval_tpu(self, ctx: EvalContext) -> DeviceColumn:
         cap = ctx.batch.capacity
-        base = int(getattr(ctx, "row_offset", 0))
+        base = int(ctx.row_offset)
         seed = self.seed
 
         def run():

@@ -1043,3 +1043,113 @@ class RegExpExtract(Expression):
         return DeviceColumn(T.STRING, validity,
                             chars=jnp.where(keep, gathered, 0).astype(jnp.uint8),
                             lengths=jnp.where(found, mlen, 0).astype(jnp.int32))
+
+
+def _java_split(rx, s: str, limit: int):
+    """Java String.split semantics: limit>0 caps the part count (limit=1
+    -> no split at all); limit==0 drops TRAILING empty strings; negative
+    limits keep them."""
+    if limit == 1:
+        return [s]
+    parts = rx.split(s, maxsplit=(limit - 1 if limit > 0 else 0))
+    if limit == 0:
+        while parts and parts[-1] == "":
+            parts.pop()
+    return parts
+
+
+class StringSplit(Expression):
+    """split(str, regex[, limit]) -> array<string> (3-D char tensor).
+
+    Reference analog: GpuStringSplit via the regex transpiler
+    (RegexParser.scala consumers).  Irregular per-row output shapes make
+    this a host kernel (like the JSON family); the pattern is validated
+    at plan time and translated with the same Java-regex rules the oracle
+    uses for RLike."""
+
+    is_host_kernel = True
+
+    def __init__(self, s: Expression, pattern: Expression,
+                 limit: Expression = None):
+        kids = [s, pattern] + ([limit] if limit is not None else [])
+        super().__init__(kids)
+
+    def _resolve_type(self):
+        self._dataType = T.ArrayType(T.STRING, containsNull=False)
+        self._nullable = True
+        from spark_rapids_tpu.expr.base import Literal
+
+        self._pattern = None
+        self._limit = -1
+        if isinstance(self.children[1], Literal) \
+                and self.children[1].value is not None:
+            self._pattern = str(self.children[1].value)
+        if len(self.children) > 2 and isinstance(self.children[2], Literal) \
+                and self.children[2].value is not None:
+            self._limit = int(self.children[2].value)
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        import re as _re
+
+        import numpy as np
+
+        from spark_rapids_tpu.columnar.column import HostColumn
+
+        c = cols[0]
+        n = int(ctx.batch.num_rows)  # eager (host kernel) path
+        cap = c.capacity
+        host = c.to_host(n)
+        vals = host.to_pylist()
+        from spark_rapids_tpu.cpu.oracle import _java_regex_to_python
+
+        try:
+            rx = _re.compile(_java_regex_to_python(self._pattern))
+        except _re.error:
+            rx = None
+        out = []
+        for v in vals:
+            if v is None or rx is None:
+                out.append(None)
+                continue
+            out.append(_java_split(rx, v, self._limit))
+        h = HostColumn.from_pylist(out, self.dataType)
+        from spark_rapids_tpu.columnar.column import DeviceColumn
+
+        return DeviceColumn.from_host(h, capacity=cap)
+
+
+class ArrayJoin(Expression):
+    """array_join(arr, delim[, null_replacement])."""
+
+    is_host_kernel = True
+
+    def __init__(self, arr: Expression, delim: Expression,
+                 null_replacement: Expression = None):
+        kids = [arr, delim] + ([null_replacement]
+                               if null_replacement is not None else [])
+        super().__init__(kids)
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        from spark_rapids_tpu.columnar.column import DeviceColumn, HostColumn
+
+        arr, delim = cols[0], cols[1]
+        nullrep = cols[2] if len(cols) > 2 else None
+        n = int(ctx.batch.num_rows)
+        cap = arr.capacity
+        rows = arr.to_host(n).to_pylist()
+        delims = delim.to_host(n).to_pylist()
+        reps = nullrep.to_host(n).to_pylist() if nullrep is not None \
+            else [None] * n
+        out = []
+        for row, d, rep in zip(rows, delims, reps):
+            if row is None or d is None:
+                out.append(None)
+                continue
+            parts = [e if e is not None else rep for e in row]
+            out.append(d.join(p for p in parts if p is not None))
+        h = HostColumn.from_pylist(out, T.STRING)
+        return DeviceColumn.from_host(h, capacity=cap)

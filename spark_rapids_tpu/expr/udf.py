@@ -48,15 +48,33 @@ def supports_columnar(fn) -> bool:
 
 
 class UserDefinedExpression(Expression):
-    """ScalaUDF / GpuScalaUDF analog wrapping a python callable."""
+    """ScalaUDF / GpuScalaUDF analog wrapping a python callable.
+
+    Three execution tiers, mirroring the reference's UDF ladder:
+      1. ``evaluate_columnar`` (TpuUDF / RapidsUDF analog): a jax kernel
+         that fuses into the stage's compiled program;
+      2. plain python function + ``spark.rapids.sql.python.arrowEval``
+         (default): runs INSIDE the TPU plan as a host kernel — batches
+         transfer to the host, the function evaluates row-by-row (or
+         whole-column when ``vectorized``), results upload back.  The
+         GpuArrowEvalPythonExec analog, minus the worker daemon (the
+         engine is in-process python already);
+      3. otherwise the stage falls back to CPU with an explain reason.
+    """
 
     def __init__(self, fn, children: List[Expression],
-                 dataType: T.DataType, name: str = "udf"):
+                 dataType: T.DataType, name: str = "udf",
+                 vectorized: bool = False):
         super().__init__(list(children))
         self.fn = fn
         self._dataType = dataType
         self._nullable = True
         self._name = name
+        self.vectorized = vectorized
+
+    @property
+    def is_host_kernel(self):  # noqa: D401 - property form of the flag
+        return not supports_columnar(self.fn)
 
     def sql_string(self):
         args = ", ".join(c.sql_string() for c in self.children)
@@ -70,12 +88,57 @@ class UserDefinedExpression(Expression):
         pass  # dataType fixed at construction (like ScalaUDF)
 
     def do_columnar_eval(self, ctx: EvalContext, cols):
-        out = self.fn.evaluate_columnar(*cols)
-        if out.dtype != self._dataType:
-            raise TypeError(
-                f"UDF {self._name} returned {out.dtype.simpleString}, "
-                f"declared {self._dataType.simpleString}")
-        return out
+        if supports_columnar(self.fn):
+            out = self.fn.evaluate_columnar(*cols)
+            if out.dtype != self._dataType:
+                raise TypeError(
+                    f"UDF {self._name} returned {out.dtype.simpleString}, "
+                    f"declared {self._dataType.simpleString}")
+            return out
+        return self._eval_python(ctx, cols)
+
+    def _eval_python(self, ctx: EvalContext, cols):
+        """Arrow-eval python path — batches cross to the host, the
+        function evaluates, results upload.  is_host_kernel routes the
+        enclosing stage through the EAGER (non-jit) path, so row counts
+        and buffers are concrete here."""
+        import numpy as np
+
+        from spark_rapids_tpu.columnar.column import DeviceColumn
+
+        cap = ctx.batch.capacity
+        n = int(ctx.batch.num_rows)
+        dt = self._dataType
+        host_cols = [c.to_host(n) for c in cols]
+        pylists = [h.to_pylist() for h in host_cols]
+        if self.vectorized:
+            # pandas-style: whole columns in storage representation
+            ins = [h.data if h.data is not None
+                   else np.array(p, dtype=object)
+                   for h, p in zip(host_cols, pylists)]
+            res = np.asarray(self.fn(*ins))
+            valid_mask = np.ones(n, np.bool_)
+            for h in host_cols:
+                valid_mask &= h.validity
+            results = [res[i].item() if valid_mask[i] else None
+                       for i in range(n)]
+        else:
+            # row-based, nulls passed through as None (Spark semantics;
+            # identical to the oracle's _h_udf — no exception swallowing)
+            from spark_rapids_tpu.udf_compiler import F, _wants_namespace
+
+            if _wants_namespace(self.fn):
+                results = [self.fn(*[p[i] for p in pylists], F)
+                           for i in range(n)]
+            else:
+                results = [self.fn(*[p[i] for p in pylists])
+                           for i in range(n)]
+        from spark_rapids_tpu.cpu.oracle import _clamp_udf_result
+        from spark_rapids_tpu.columnar.column import HostColumn
+
+        results = [_clamp_udf_result(v, dt) for v in results]
+        out = HostColumn.from_pylist(results, dt)
+        return DeviceColumn.from_host(out, capacity=cap)
 
 
 def udf(fn, return_type: T.DataType, name: str = "udf"):

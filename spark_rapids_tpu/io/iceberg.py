@@ -1,0 +1,140 @@
+"""Iceberg table scan (read path) — the GpuIcebergParquetReader analog.
+
+Reference analog: iceberg/ module (SURVEY.md §2.8, MED): the reference
+accelerates Iceberg's parquet data-file reads.  This module walks the open
+Iceberg v1/v2 table metadata directly: ``metadata/version-hint.text`` (or
+the highest ``vN.metadata.json``), current snapshot -> manifest LIST
+(Avro) -> manifests (Avro) -> live parquet data files; the engine's
+regular parquet scan reads the data.
+
+Supported subset: parquet data files, append-only tables (no position /
+equality deletes — those raise), flat primitive schemas.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import List, Optional, Tuple
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.io.avro import read_avro_file
+
+_PRIMS = {
+    "boolean": T.BOOLEAN, "int": T.INT, "long": T.LONG, "float": T.FLOAT,
+    "double": T.DOUBLE, "string": T.STRING, "date": T.DATE,
+    "timestamp": T.TIMESTAMP, "timestamptz": T.TIMESTAMP,
+    "binary": T.BINARY,
+}
+
+
+def _field_type(t) -> T.DataType:
+    if isinstance(t, str):
+        if t in _PRIMS:
+            return _PRIMS[t]
+        m = re.match(r"decimal\((\d+),\s*(\d+)\)", t)
+        if m:
+            return T.DecimalType(int(m.group(1)), int(m.group(2)))
+        raise ValueError(f"unsupported iceberg type {t!r}")
+    if isinstance(t, dict) and t.get("type") == "list":
+        return T.ArrayType(_field_type(t["element"]),
+                           not t.get("element-required", False))
+    raise ValueError(f"unsupported iceberg type {t!r}")
+
+
+def _schema_from_metadata(meta: dict) -> T.StructType:
+    schemas = meta.get("schemas")
+    if schemas:
+        sid = meta.get("current-schema-id", 0)
+        schema = next((s for s in schemas if s.get("schema-id") == sid),
+                      schemas[-1])
+    else:
+        schema = meta["schema"]  # v1 single-schema layout
+    return T.StructType([
+        T.StructField(f["name"], _field_type(f["type"]),
+                      not f.get("required", False))
+        for f in schema["fields"]])
+
+
+def _resolve(table_path: str, p: str) -> str:
+    """Manifest paths may be absolute file URIs or table-relative."""
+    if p.startswith("file://"):
+        return p[len("file://"):]
+    if os.path.isabs(p):
+        return p
+    return os.path.join(table_path, p)
+
+
+def _latest_metadata(table_path: str) -> str:
+    mdir = os.path.join(table_path, "metadata")
+    hint = os.path.join(mdir, "version-hint.text")
+    if os.path.isfile(hint):
+        with open(hint) as f:
+            v = f.read().strip()
+        cand = os.path.join(mdir, f"v{v}.metadata.json")
+        if os.path.isfile(cand):
+            return cand
+    best: Tuple[int, Optional[str]] = (-1, None)
+    for name in os.listdir(mdir):
+        m = re.match(r"v(\d+)\.metadata\.json$", name)
+        if m and int(m.group(1)) > best[0]:
+            best = (int(m.group(1)), os.path.join(mdir, name))
+    if best[1] is None:
+        raise FileNotFoundError(
+            f"{table_path}: no iceberg metadata json found")
+    return best[1]
+
+
+def iceberg_data_files(table_path: str,
+                       snapshot_id: Optional[int] = None
+                       ) -> Tuple[List[str], T.StructType]:
+    """-> (live parquet data file paths, table schema)."""
+    with open(_latest_metadata(table_path)) as f:
+        meta = json.load(f)
+    schema = _schema_from_metadata(meta)
+    snaps = meta.get("snapshots", [])
+    if not snaps:
+        return [], schema
+    sid = snapshot_id if snapshot_id is not None \
+        else meta.get("current-snapshot-id")
+    snap = next((s for s in snaps if s.get("snapshot-id") == sid),
+                snaps[-1])
+    mlist = _resolve(table_path, snap["manifest-list"])
+    _, entries = read_avro_file(mlist)
+    paths: List[str] = []
+    for entry in entries:
+        content = entry.get("content", 0)
+        if content not in (None, 0):
+            raise ValueError(
+                "iceberg delete manifests are not supported (append-only "
+                "tables)")
+        mpath = _resolve(table_path, entry["manifest_path"])
+        _, files = read_avro_file(mpath)
+        for fe in files:
+            status = fe.get("status", 1)
+            if status == 2:  # DELETED
+                continue
+            df = fe["data_file"]
+            if isinstance(df.get("content"), int) and df["content"] != 0:
+                raise ValueError("iceberg delete files are not supported")
+            fmt = (df.get("file_format") or "PARQUET")
+            if str(fmt).upper() != "PARQUET":
+                raise ValueError(f"iceberg {fmt} data files not supported")
+            paths.append(_resolve(table_path, df["file_path"]))
+    # manifests replay newest-first; drop duplicates, keep order
+    seen = set()
+    uniq = []
+    for p in paths:
+        if p not in seen:
+            seen.add(p)
+            uniq.append(p)
+    return uniq, schema
+
+
+def read_iceberg(session, table_path: str,
+                 snapshot_id: Optional[int] = None):
+    paths, schema = iceberg_data_files(table_path, snapshot_id)
+    if not paths:
+        return session.create_dataframe(
+            {f.name: [] for f in schema.fields}, schema)
+    return session.read.schema(schema).parquet(*paths)

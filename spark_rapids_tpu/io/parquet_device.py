@@ -1,0 +1,149 @@
+"""Device-side Parquet column assembly.
+
+Reference analog: SURVEY.md §3.4's device half — the reference hands
+host-stitched row-group bytes to cuDF's decode kernels; here the host half
+(io/parquet_native.py) parses footers/page headers/run headers and the
+Pallas kernels (pallas/decode.py) unpack bits, expand runs, and gather
+dictionaries on device.  Unsupported features raise _Unsupported and the
+scan silently falls back to the pyarrow host decode (the reference's
+hybrid-scan stance).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import (
+    DEFAULT_ROW_BUCKETS,
+    DeviceColumn,
+    round_up_bucket,
+)
+from spark_rapids_tpu.io.parquet_native import (
+    ENC_PLAIN,
+    ENC_PLAIN_DICT,
+    ENC_RLE_DICT,
+    TYPE_BOOLEAN,
+    TYPE_FLOAT,
+    TYPE_INT32,
+    TYPE_INT64,
+    _PLAIN_DTYPES,
+    _Unsupported,
+    read_column_pages,
+    read_footer,
+    split_hybrid_runs,
+)
+from spark_rapids_tpu.pallas.decode import (
+    MAX_BIT_WIDTH,
+    expand_runs,
+    expand_runs_host,
+    unpack_bitpacked,
+)
+
+_OK_TYPES = {
+    TYPE_INT32: (T.IntegerType, T.DateType, T.ByteType, T.ShortType,
+                 T.DecimalType),
+    TYPE_INT64: (T.LongType, T.TimestampType, T.DecimalType),
+    TYPE_FLOAT: (T.FloatType,),
+    5: (T.DoubleType,),          # TYPE_DOUBLE
+    TYPE_BOOLEAN: (T.BooleanType,),
+}
+
+
+def _check_field(info, dt: T.DataType):
+    ok = _OK_TYPES.get(info.ptype)
+    if ok is None or not isinstance(dt, ok):
+        raise _Unsupported(
+            f"column {info.name}: parquet type {info.ptype} as "
+            f"{dt.simpleString}")
+    if isinstance(dt, T.DecimalType) and dt.is_128:
+        raise _Unsupported("decimal128 device decode")
+
+
+def _decode_page(page, info, dt: T.DataType, dictionary):
+    """One data page -> (values (n,), validity (n,)) device arrays."""
+    n = page.num_values
+    if page.def_runs is not None:
+        # def levels expand on the host (tiny 1-bit streams, many runs —
+        # per-run device dispatch would dominate); ndef comes free
+        levels = expand_runs_host(page.def_runs, page.def_buf, n, 1)
+        defined_np = levels.astype(np.bool_)
+        ndef = int(defined_np.sum())
+        defined = jnp.asarray(defined_np)
+    else:
+        defined = jnp.ones(n, jnp.bool_)
+        ndef = n
+    sdt = T.storage_dtype(dt)
+    if page.encoding in (ENC_PLAIN_DICT, ENC_RLE_DICT):
+        if dictionary is None:
+            raise _Unsupported("dictionary page missing")
+        if page.index_bit_width > MAX_BIT_WIDTH:
+            raise _Unsupported(
+                f"dictionary index width {page.index_bit_width}")
+        runs = split_hybrid_runs(page.value_buf, page.index_bit_width,
+                                 ndef)
+        idx = expand_runs(runs, page.value_buf, ndef,
+                          page.index_bit_width)
+        dict_dev = jnp.asarray(dictionary)
+        vals = dict_dev[jnp.clip(idx.astype(jnp.int32), 0,
+                                 max(len(dictionary) - 1, 0))]
+    elif page.encoding == ENC_PLAIN:
+        if info.ptype == TYPE_BOOLEAN:
+            vals = unpack_bitpacked(
+                np.frombuffer(page.value_buf, np.uint8), 1, ndef)
+        else:
+            np_dt = _PLAIN_DTYPES[info.ptype]
+            vals = jnp.asarray(np.frombuffer(page.value_buf, np_dt,
+                                             count=ndef))
+    else:
+        raise _Unsupported(f"encoding {page.encoding}")
+    vals = vals.astype(sdt)
+    if ndef == n:
+        return vals, defined
+    # scatter defined values back to row positions
+    pos = jnp.cumsum(defined.astype(jnp.int32)) - 1
+    safe = jnp.clip(pos, 0, max(ndef - 1, 0))
+    row_vals = jnp.where(defined, vals[safe], jnp.zeros((), sdt))
+    return row_vals, defined
+
+
+def read_parquet_device(path: str, schema: T.StructType,
+                        row_buckets=DEFAULT_ROW_BUCKETS) -> ColumnarBatch:
+    """One file -> one padded device batch via the Pallas decode path."""
+    with open(path, "rb") as f:
+        data = f.read()
+    groups, names = read_footer(data)
+    wanted = schema.field_names()
+    for w in wanted:
+        if w not in names:
+            raise _Unsupported(f"column {w} missing from file")
+    total = sum(g.num_rows for g in groups)
+    cap = round_up_bucket(max(total, 1), row_buckets)
+    per_field_vals: List[List] = [[] for _ in wanted]
+    per_field_valid: List[List] = [[] for _ in wanted]
+    for g in groups:
+        by_name = {c.name: c for c in g.columns}
+        for fi, f in enumerate(schema.fields):
+            info = by_name.get(f.name)
+            if info is None:
+                raise _Unsupported(f"column {f.name} missing in row group")
+            _check_field(info, f.dataType)
+            cp = read_column_pages(data, info, g.num_rows)
+            for page in cp.pages:
+                v, ok = _decode_page(page, info, f.dataType, cp.dictionary)
+                per_field_vals[fi].append(v)
+                per_field_valid[fi].append(ok)
+    cols = []
+    for fi, f in enumerate(schema.fields):
+        vals = jnp.concatenate(per_field_vals[fi]) \
+            if len(per_field_vals[fi]) > 1 else per_field_vals[fi][0]
+        valid = jnp.concatenate(per_field_valid[fi]) \
+            if len(per_field_valid[fi]) > 1 else per_field_valid[fi][0]
+        sdt = T.storage_dtype(f.dataType)
+        data_arr = jnp.zeros(cap, sdt).at[:vals.shape[0]].set(vals)
+        valid_arr = jnp.zeros(cap, jnp.bool_).at[:valid.shape[0]].set(valid)
+        cols.append(DeviceColumn(f.dataType, valid_arr, data=data_arr))
+    return ColumnarBatch(cols, total, schema)

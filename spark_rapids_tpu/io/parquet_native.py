@@ -1,0 +1,315 @@
+"""Native Parquet page reader — footer/page parsing for the device decode
+path.
+
+Reference analog: GpuParquetScan's host side (SURVEY.md §3.4): the
+reference parses footers and stitches row-group bytes ON THE HOST, then
+hands buffers to cuDF's device decode kernels.  This module is that host
+half for the TPU build: a thrift-compact FileMetaData/PageHeader parser,
+page walker, and RLE/bit-packed-hybrid RUN SPLITTER.  The device half
+(spark_rapids_tpu/pallas/decode.py) expands runs / unpacks bits / gathers
+dictionaries with Pallas kernels.
+
+Host work is O(#pages + #runs), never O(#values): run headers are varints
+scanned on the host; the value bytes upload untouched.
+
+Supported subset (else the scan silently falls back to the pyarrow host
+decode): non-nested columns of INT32/INT64/DOUBLE/FLOAT/BOOLEAN, data page
+v1, PLAIN or RLE_DICTIONARY/PLAIN_DICTIONARY encodings, UNCOMPRESSED or
+ZSTD codec (the image has no standalone snappy binding).
+"""
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+MAGIC = b"PAR1"
+
+# thrift compact type ids
+_CT_STOP = 0
+_CT_TRUE = 1
+_CT_FALSE = 2
+_CT_BYTE = 3
+_CT_I16 = 4
+_CT_I32 = 5
+_CT_I64 = 6
+_CT_DOUBLE = 7
+_CT_BINARY = 8
+_CT_LIST = 9
+_CT_SET = 10
+_CT_MAP = 11
+_CT_STRUCT = 12
+
+
+class _Thrift:
+    """Minimal thrift compact-protocol reader -> {field_id: value} dicts."""
+
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def varint(self) -> int:
+        shift = acc = 0
+        while True:
+            b = self.buf[self.pos]
+            self.pos += 1
+            acc |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return acc
+            shift += 7
+
+    def zigzag(self) -> int:
+        v = self.varint()
+        return (v >> 1) ^ -(v & 1)
+
+    def read_value(self, ctype: int):
+        if ctype in (_CT_TRUE, _CT_FALSE):
+            return ctype == _CT_TRUE
+        if ctype == _CT_BYTE:
+            v = self.buf[self.pos]
+            self.pos += 1
+            return v - 256 if v >= 128 else v
+        if ctype in (_CT_I16, _CT_I32, _CT_I64):
+            return self.zigzag()
+        if ctype == _CT_DOUBLE:
+            v = struct.unpack_from("<d", self.buf, self.pos)[0]
+            self.pos += 8
+            return v
+        if ctype == _CT_BINARY:
+            n = self.varint()
+            v = self.buf[self.pos:self.pos + n]
+            self.pos += n
+            return v
+        if ctype == _CT_LIST or ctype == _CT_SET:
+            head = self.buf[self.pos]
+            self.pos += 1
+            size = head >> 4
+            etype = head & 0x0F
+            if size == 15:
+                size = self.varint()
+            return [self.read_value(etype) for _ in range(size)]
+        if ctype == _CT_STRUCT:
+            return self.read_struct()
+        if ctype == _CT_MAP:
+            size = self.varint()
+            if size == 0:
+                return {}
+            kv = self.buf[self.pos]
+            self.pos += 1
+            kt, vt = kv >> 4, kv & 0x0F
+            return {self.read_value(kt): self.read_value(vt)
+                    for _ in range(size)}
+        raise ValueError(f"thrift compact type {ctype}")
+
+    def read_struct(self) -> Dict[int, object]:
+        out: Dict[int, object] = {}
+        last_id = 0
+        while True:
+            head = self.buf[self.pos]
+            self.pos += 1
+            if head == _CT_STOP:
+                return out
+            delta = head >> 4
+            ctype = head & 0x0F
+            if delta == 0:
+                fid = self.zigzag()
+            else:
+                fid = last_id + delta
+            last_id = fid
+            if ctype in (_CT_TRUE, _CT_FALSE):
+                out[fid] = ctype == _CT_TRUE
+            else:
+                out[fid] = self.read_value(ctype)
+
+
+# parquet enums (format/parquet.thrift)
+TYPE_BOOLEAN, TYPE_INT32, TYPE_INT64 = 0, 1, 2
+TYPE_FLOAT, TYPE_DOUBLE, TYPE_BYTE_ARRAY = 4, 5, 6
+ENC_PLAIN, ENC_PLAIN_DICT, ENC_RLE = 0, 2, 3
+ENC_RLE_DICT = 8
+CODEC_UNCOMPRESSED, CODEC_SNAPPY, CODEC_ZSTD = 0, 1, 6
+PAGE_DATA, PAGE_DICT = 0, 2
+
+
+@dataclasses.dataclass
+class ColumnInfo:
+    name: str
+    ptype: int
+    optional: bool
+    codec: int
+    encodings: List[int]
+    num_values: int
+    data_page_offset: int
+    dict_page_offset: Optional[int]
+    total_compressed: int
+
+
+@dataclasses.dataclass
+class RowGroupInfo:
+    num_rows: int
+    columns: List[ColumnInfo]
+
+
+@dataclasses.dataclass
+class Run:
+    """One RLE/bit-packed hybrid run (host-parsed header, device-expanded
+    payload)."""
+
+    is_packed: bool
+    count: int        # values in the run
+    value: int        # RLE repeated value (is_packed=False)
+    byte_off: int     # payload offset into the level/index buffer
+    nbytes: int
+
+
+def read_footer(data: bytes) -> Tuple[List[RowGroupInfo], List[str]]:
+    if data[:4] != MAGIC or data[-4:] != MAGIC:
+        raise ValueError("not a parquet file")
+    flen = struct.unpack_from("<I", data, len(data) - 8)[0]
+    meta = _Thrift(data, len(data) - 8 - flen).read_struct()
+    schema = meta[2]
+    # schema[0] is the root; leaves follow in order (non-nested only)
+    names, optional, ptypes = [], {}, {}
+    for el in schema[1:]:
+        name = el[4].decode()
+        names.append(name)
+        optional[name] = el.get(3, 0) == 1  # repetition OPTIONAL
+        ptypes[name] = el.get(1)
+    groups = []
+    for rg in meta[4]:
+        cols = []
+        for cc in rg[1]:
+            md = cc[3]
+            path = b".".join(md[3]).decode()
+            cols.append(ColumnInfo(
+                name=path, ptype=md[1],
+                optional=optional.get(path, True),
+                codec=md[4], encodings=md[2], num_values=md[5],
+                data_page_offset=md[9],
+                dict_page_offset=md.get(11),
+                total_compressed=md[7]))
+        groups.append(RowGroupInfo(num_rows=rg[3], columns=cols))
+    return groups, names
+
+
+def _decompress(buf: bytes, codec: int, usize: int) -> bytes:
+    if codec == CODEC_UNCOMPRESSED:
+        return buf
+    if codec == CODEC_ZSTD:
+        import zstandard
+
+        return zstandard.ZstdDecompressor().decompress(buf, max_output_size=usize)
+    raise _Unsupported(f"codec {codec}")
+
+
+class _Unsupported(Exception):
+    """Feature outside the device-decode subset -> pyarrow fallback."""
+
+
+def split_hybrid_runs(buf: bytes, bit_width: int,
+                      total: int) -> List[Run]:
+    """Parse RLE/bit-packed hybrid run headers (no value decode)."""
+    runs: List[Run] = []
+    t = _Thrift(buf)
+    got = 0
+    vbytes = (bit_width + 7) // 8
+    while got < total and t.pos < len(buf):
+        header = t.varint()
+        if header & 1:
+            groups = header >> 1
+            count = groups * 8
+            nbytes = groups * bit_width
+            runs.append(Run(True, min(count, total - got), 0, t.pos,
+                            nbytes))
+            t.pos += nbytes
+        else:
+            count = header >> 1
+            raw = buf[t.pos:t.pos + vbytes]
+            value = int.from_bytes(raw, "little") if vbytes else 0
+            runs.append(Run(False, min(count, total - got), value, t.pos,
+                            vbytes))
+            t.pos += vbytes
+        got += runs[-1].count
+    return runs
+
+
+@dataclasses.dataclass
+class PageData:
+    """One decoded-on-host-STRUCTURE data page: raw bytes stay packed."""
+
+    num_values: int
+    encoding: int
+    def_runs: Optional[List[Run]]   # None: required column
+    def_buf: Optional[bytes]
+    value_buf: bytes                # PLAIN values or packed indices
+    index_bit_width: int            # dictionary index width (dict pages)
+
+
+@dataclasses.dataclass
+class ColumnPages:
+    info: ColumnInfo
+    dictionary: Optional[np.ndarray]  # decoded dict values (PLAIN, host view)
+    pages: List[PageData]
+
+
+_PLAIN_DTYPES = {TYPE_INT32: np.int32, TYPE_INT64: np.int64,
+                 TYPE_FLOAT: np.float32, TYPE_DOUBLE: np.float64}
+
+
+def read_column_pages(data: bytes, info: ColumnInfo,
+                      num_rows: int) -> ColumnPages:
+    if info.ptype not in _PLAIN_DTYPES and info.ptype != TYPE_BOOLEAN:
+        raise _Unsupported(f"parquet type {info.ptype}")
+    start = (info.dict_page_offset
+             if info.dict_page_offset is not None
+             and 0 < info.dict_page_offset < info.data_page_offset
+             else info.data_page_offset)
+    pos = start
+    end = start + info.total_compressed
+    dictionary = None
+    pages: List[PageData] = []
+    values_seen = 0
+    while pos < end and values_seen < info.num_values:
+        t = _Thrift(data, pos)
+        header = t.read_struct()
+        pos = t.pos
+        ptype = header[1]
+        usize = header[2]
+        csize = header[3]
+        raw = _decompress(data[pos:pos + csize], info.codec, usize)
+        pos += csize
+        if ptype == PAGE_DICT:
+            dph = header[7]
+            n = dph[1]
+            if info.ptype == TYPE_BOOLEAN:
+                raise _Unsupported("boolean dictionary")
+            dictionary = np.frombuffer(
+                raw, _PLAIN_DTYPES[info.ptype], count=n)
+            continue
+        if ptype != PAGE_DATA:
+            raise _Unsupported(f"page type {ptype} (v2 pages)")
+        dp = header[5]
+        nvals = dp[1]
+        enc = dp[2]
+        off = 0
+        def_runs = None
+        def_buf = None
+        if info.optional:
+            if dp[3] != ENC_RLE:
+                raise _Unsupported("definition level encoding")
+            dlen = struct.unpack_from("<I", raw, 0)[0]
+            def_buf = raw[4:4 + dlen]
+            def_runs = split_hybrid_runs(def_buf, 1, nvals)
+            off = 4 + dlen
+        ibw = 0
+        if enc in (ENC_PLAIN_DICT, ENC_RLE_DICT):
+            ibw = raw[off]
+            off += 1
+        elif enc != ENC_PLAIN:
+            raise _Unsupported(f"encoding {enc}")
+        pages.append(PageData(nvals, enc, def_runs, def_buf, raw[off:],
+                              ibw))
+        values_seen += nvals
+    return ColumnPages(info, dictionary, pages)

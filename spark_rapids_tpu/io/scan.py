@@ -30,6 +30,7 @@ from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar.column import HostColumn
 from spark_rapids_tpu.config import (
     MAX_READER_BATCH_SIZE_ROWS,
+    PARQUET_DEVICE_DECODE,
     PARQUET_MULTITHREAD_READ_NUM_THREADS,
     PARQUET_READER_TYPE,
     TpuConf,
@@ -84,6 +85,24 @@ class TpuFileSourceScanExec(TpuExec):
         if self.reader_type != "AUTO":
             return self.reader_type
         return "MULTITHREADED" if len(self.plan.paths) > 1 else "COALESCING"
+
+    # -- device decode (Pallas) -----------------------------------------
+    def _try_device_decode(self, path: str):
+        """Pallas decode path; None -> fall back to the host decode."""
+        import os
+
+        if (self.plan.fmt != "parquet"
+                or not self.conf.get(PARQUET_DEVICE_DECODE)
+                or os.path.isdir(path)):
+            return None
+        from spark_rapids_tpu.io.parquet_native import _Unsupported
+        from spark_rapids_tpu.io.parquet_device import read_parquet_device
+
+        try:
+            with self.metric("gpuDecodeTime").timed():
+                return read_parquet_device(path, self.plan.output)
+        except (_Unsupported, KeyError, ValueError, IndexError):
+            return None
 
     # -- host decode ----------------------------------------------------
     def _read_file_host(self, path: str):
@@ -148,11 +167,23 @@ class TpuFileSourceScanExec(TpuExec):
         mode = self._mode()
         if mode == "PERFILE":
             for p in self.plan.paths:
-                yield self._count_output(self._upload(self._read_file_host(p)))
+                dev = self._try_device_decode(p)
+                if dev is not None:
+                    yield self._count_output(dev)
+                else:
+                    yield self._count_output(
+                        self._upload(self._read_file_host(p)))
         elif mode == "COALESCING":
             import pyarrow as pa
 
-            tbls = [self._read_file_host(p) for p in self.plan.paths]
+            host_paths = []
+            for p in self.plan.paths:
+                dev = self._try_device_decode(p)
+                if dev is not None:
+                    yield self._count_output(dev)
+                else:
+                    host_paths.append(p)
+            tbls = [self._read_file_host(p) for p in host_paths]
             if not tbls:
                 return
             tbl = pa.concat_tables(tbls)
@@ -160,9 +191,17 @@ class TpuFileSourceScanExec(TpuExec):
                 yield self._count_output(self._upload(chunk))
         else:  # MULTITHREADED
             with cf.ThreadPoolExecutor(self.num_threads) as pool:
-                futures = [pool.submit(self._read_file_host, p)
-                           for p in self.plan.paths]
-                for fut in futures:
+                # device decode is a single-threaded device pipeline; host
+                # fallbacks keep the thread pool
+                host_futs = []  # (path, future) — duplicates preserved
+                for p in self.plan.paths:
+                    dev = self._try_device_decode(p)
+                    if dev is not None:
+                        yield self._count_output(dev)
+                    else:
+                        host_futs.append(
+                            (p, pool.submit(self._read_file_host, p)))
+                for p, fut in host_futs:
                     tbl = fut.result()
                     for chunk in self._row_chunks(tbl):
                         yield self._count_output(self._upload(chunk))

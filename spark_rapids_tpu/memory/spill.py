@@ -209,8 +209,31 @@ class SpillFramework:
             self._touch(h)
             self._handles.append(h)
             self._device_used += h.device_bytes
+            if self.debug:
+                # handle-leak tracking (the cuDF refcount-debug analog,
+                # SURVEY.md §5.2): remember where each live handle came
+                # from so leak_report() can name the allocation site
+                import traceback
+
+                h._alloc_stack = "".join(traceback.format_stack(limit=8))
         # over-budget after admitting the new batch: shed others
         self.ensure_room(0, exclude=h)
+
+    def leak_report(self) -> List[str]:
+        """Live (unclosed) handles with their allocation sites.
+
+        Reference analog: ai.rapids.refcount.debug leak logs (SURVEY.md
+        §5.2).  Enable with spark.rapids.memory.debug=true; an empty list
+        after a query completes means every spillable handle was
+        released."""
+        with self._lock:
+            out = []
+            for h in self._handles:
+                site = getattr(h, "_alloc_stack", "<enable "
+                               "spark.rapids.memory.debug for stacks>")
+                out.append(
+                    f"LEAK: {h.state} handle {h.device_bytes}B\n{site}")
+            return out
 
     def _unregister(self, h: SpillableColumnarBatch) -> None:
         if h.state == STATE_DEVICE:

@@ -32,6 +32,17 @@ def compact_columns(mask: jax.Array,
     def _compact(c: DeviceColumn) -> DeviceColumn:
         validity = jnp.zeros_like(c.validity).at[scatter_idx].set(
             c.validity, mode="drop")
+        if c.is_string_array:
+            chars = jnp.zeros_like(c.chars).at[scatter_idx].set(
+                c.chars, mode="drop")
+            elens = jnp.zeros_like(c.data).at[scatter_idx].set(
+                c.data, mode="drop")
+            lengths = jnp.zeros_like(c.lengths).at[scatter_idx].set(
+                c.lengths, mode="drop")
+            ev = jnp.zeros_like(c.elem_valid).at[scatter_idx].set(
+                c.elem_valid, mode="drop")
+            return DeviceColumn(c.dtype, validity, chars=chars, data=elens,
+                                lengths=lengths, elem_valid=ev)
         if c.is_string:
             chars = jnp.zeros_like(c.chars).at[scatter_idx].set(
                 c.chars, mode="drop")
@@ -68,6 +79,10 @@ def gather_columns(indices: jax.Array, valid_out: jax.Array,
 
     def _gather(c: DeviceColumn) -> DeviceColumn:
         validity = c.validity[safe] & valid_out
+        if c.is_string_array:
+            return DeviceColumn(c.dtype, validity, chars=c.chars[safe],
+                                data=c.data[safe], lengths=c.lengths[safe],
+                                elem_valid=c.elem_valid[safe])
         if c.is_string:
             return DeviceColumn(c.dtype, validity, chars=c.chars[safe],
                                 lengths=c.lengths[safe])

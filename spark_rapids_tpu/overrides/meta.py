@@ -85,10 +85,11 @@ class ExprMeta(BaseMeta):
             unsupported_nested_reason,
         )
 
+        allow_sa = getattr(self.rule, "allow_string_arrays", False)
         for d in [dt] + [c._dataType for c in self.expr.children]:
             if d is None:
                 continue
-            reason = unsupported_nested_reason(d)
+            reason = unsupported_nested_reason(d, allow_sa)
             if reason:
                 self.will_not_work_on_tpu(
                     f"expression {self.name}: {reason}")
@@ -154,7 +155,9 @@ class SparkPlanMeta(BaseMeta):
                     f"exec {self.name} output column '{f.name}': "
                     + sig.reason_not_supported(f.dataType))
             else:
-                reason = unsupported_nested_reason(f.dataType)
+                reason = unsupported_nested_reason(
+                    f.dataType,
+                    getattr(self.rule, "allow_string_arrays", False))
                 if reason:
                     self.will_not_work_on_tpu(
                         f"exec {self.name} output column '{f.name}': "

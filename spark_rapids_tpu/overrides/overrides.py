@@ -48,6 +48,8 @@ class ExprRule:
     type_sig: T.TypeSig
     extra_check: Optional[Callable[[ExprMeta], None]] = None
     desc: str = ""
+    # array<string> (3-D char tensor) flows only through rules that opt in
+    allow_string_arrays: bool = False
 
 
 @dataclasses.dataclass
@@ -57,6 +59,7 @@ class ExecRule:
     tag_exprs: Optional[Callable] = None
     extra_check: Optional[Callable[[SparkPlanMeta], None]] = None
     desc: str = ""
+    allow_string_arrays: bool = False
 
 
 _COMMON = (T.BOOLEAN_SIG + T.numeric + T.STRING_SIG + T.DATETIME_SIG
@@ -250,15 +253,20 @@ def _check_regexp_spans(meta: ExprMeta):
 
 
 def _check_udf(meta: ExprMeta):
-    """RapidsUDF detection: only UDFs exposing a columnar kernel run on
-    TPU; plain python functions fall back with the reference's explain
-    wording."""
+    """RapidsUDF/arrow-eval ladder: columnar UDFs fuse into the stage;
+    plain python functions stay in the TPU plan via the arrow-eval host
+    path (GpuArrowEvalPythonExec analog) unless disabled, in which case
+    the stage falls back with the reference's explain wording."""
     from spark_rapids_tpu.expr.udf import supports_columnar
 
     if not supports_columnar(meta.expr.fn):
-        meta.will_not_work_on_tpu(
-            f"UDF {meta.expr.name} does not implement evaluate_columnar "
-            f"(TpuUDF); it will run row-based on CPU")
+        from spark_rapids_tpu.config import ARROW_EVAL_ENABLED
+
+        if not meta.conf.get(ARROW_EVAL_ENABLED):
+            meta.will_not_work_on_tpu(
+                f"UDF {meta.expr.name} does not implement "
+                f"evaluate_columnar (TpuUDF); it will run row-based on "
+                f"CPU")
 
 
 def _check_substring_index(meta: ExprMeta):
@@ -295,7 +303,7 @@ _PRIM_ELEM = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,
               T.TimestampType)
 
 
-def unsupported_nested_reason(dt) -> Optional[str]:
+def unsupported_nested_reason(dt, allow_string_elems=False) -> Optional[str]:
     """Why a nested type cannot live in device columns yet, or None.
 
     Array elements and map keys/values must be flat primitives (the padded
@@ -305,12 +313,15 @@ def unsupported_nested_reason(dt) -> Optional[str]:
     nested kinds routes through this check."""
     if isinstance(dt, T.ArrayType):
         et = dt.elementType
+        if allow_string_elems and isinstance(et, T.StringType):
+            return None
         if isinstance(et, T.DecimalType):
             return None if not et.is_128 else \
                 f"{dt.simpleString}: decimal128 array elements"
         if not isinstance(et, _PRIM_ELEM):
             return (f"{dt.simpleString}: array elements must be flat "
-                    f"primitives on TPU")
+                    f"primitives on TPU (array<string> needs a rule that "
+                    f"opts in)")
         return None
     if isinstance(dt, T.MapType):
         for part, name in ((dt.keyType, "key"), (dt.valueType, "value")):
@@ -450,10 +461,10 @@ def _check_to_json(meta: ExprMeta):
 
 
 EXPRESSIONS: Dict[Type, ExprRule] = {
-    E.Literal: ExprRule(_WITH_ARRAYS, desc="constant literal"),
-    E.BoundReference: ExprRule(_WITH_ARRAYS, desc="column reference"),
-    E.AttributeReference: ExprRule(_WITH_ARRAYS, desc="column reference"),
-    E.Alias: ExprRule(_WITH_ARRAYS, desc="alias"),
+    E.Literal: ExprRule(_WITH_ARRAYS, desc="constant literal", allow_string_arrays=True),
+    E.BoundReference: ExprRule(_WITH_ARRAYS, desc="column reference", allow_string_arrays=True),
+    E.AttributeReference: ExprRule(_WITH_ARRAYS, desc="column reference", allow_string_arrays=True),
+    E.Alias: ExprRule(_WITH_ARRAYS, desc="alias", allow_string_arrays=True),
     A.Add: ExprRule(_NUM128, extra_check=_check_decimal_addsub),
     A.Subtract: ExprRule(_NUM128, extra_check=_check_decimal_addsub),
     A.Multiply: ExprRule(_NUM128, extra_check=_check_decimal_mult),
@@ -469,8 +480,8 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
     P.And: ExprRule(T.BOOLEAN_SIG + T.NULL_SIG),
     P.Or: ExprRule(T.BOOLEAN_SIG + T.NULL_SIG),
     P.Not: ExprRule(T.BOOLEAN_SIG + T.NULL_SIG),
-    P.IsNull: ExprRule(_WITH_ARRAYS),
-    P.IsNotNull: ExprRule(_WITH_ARRAYS),
+    P.IsNull: ExprRule(_WITH_ARRAYS, allow_string_arrays=True),
+    P.IsNotNull: ExprRule(_WITH_ARRAYS, allow_string_arrays=True),
     P.IsNaN: ExprRule(T.FP_SIG + T.BOOLEAN_SIG),
     P.In: ExprRule(_DEC128_FULL),
     CO.If: ExprRule(_COMMON128), CO.CaseWhen: ExprRule(_COMMON128),
@@ -545,6 +556,11 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
         T.STRING_SIG.with_note(T.StringType, "byte-based; ASCII-exact")
         + T.INTEGRAL_SIG,
         extra_check=_check_substring_index),
+    S.StringSplit: ExprRule(
+        _WITH_ARRAYS, allow_string_arrays=True,
+        extra_check=_check_literal_children(1, names="split pattern"),
+        desc="split into array<string> (host kernel + java-regex rules)"),
+    S.ArrayJoin: ExprRule(_WITH_ARRAYS, allow_string_arrays=True),
     S.RegExpReplace: ExprRule(T.STRING_SIG,
                               extra_check=_check_regexp_spans),
     S.RegExpExtract: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG,
@@ -599,9 +615,10 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
             "filter layout is the TPU word array, not Spark's sketch "
             "bytes"),
         desc="bloom filter probe (runtime-filter pushdown)"),
-    CL.Size: ExprRule(_WITH_ARRAYS),
-    CL.GetArrayItem: ExprRule(_WITH_ARRAYS),
-    CL.ElementAt: ExprRule(_WITH_ARRAYS + _WITH_MAPS),
+    CL.Size: ExprRule(_WITH_ARRAYS, allow_string_arrays=True),
+    CL.GetArrayItem: ExprRule(_WITH_ARRAYS, allow_string_arrays=True),
+    CL.ElementAt: ExprRule(_WITH_ARRAYS + _WITH_MAPS,
+                           allow_string_arrays=True),
     CL.ArrayContains: ExprRule(_WITH_ARRAYS),
     CL.CreateArray: ExprRule(_WITH_ARRAYS, extra_check=_check_create_array),
     CL.ArrayMin: ExprRule(_WITH_ARRAYS),
@@ -906,9 +923,11 @@ def _exprs_of(plan) -> List[E.Expression]:
 EXECS: Dict[Type, ExecRule] = {}
 
 
-def _exec(cls, sig=_DEC128_FULL, tag_exprs=_exprs_of, extra=None, desc=""):
+def _exec(cls, sig=_DEC128_FULL, tag_exprs=_exprs_of, extra=None, desc="",
+          allow_string_arrays=False):
     EXECS[cls] = ExecRule(sig, tag_exprs=tag_exprs, extra_check=extra,
-                          desc=desc)
+                          desc=desc,
+                          allow_string_arrays=allow_string_arrays)
 
 
 def _generate_check(meta: SparkPlanMeta):
@@ -916,11 +935,9 @@ def _generate_check(meta: SparkPlanMeta):
     dt = plan.gen_expr._dataType
     if not isinstance(dt, T.ArrayType):
         meta.will_not_work_on_tpu("explode input must be an array column")
-    elif isinstance(dt.elementType, (T.StringType, T.ArrayType, T.MapType,
-                                     T.StructType)):
+    elif isinstance(dt.elementType, (T.ArrayType, T.MapType, T.StructType)):
         meta.will_not_work_on_tpu(
-            "explode of non-primitive array elements is not supported on "
-            "TPU yet")
+            "explode of nested array elements is not supported on TPU yet")
 
 
 _BNLJ_TYPES = {PN.JoinType.INNER, PN.JoinType.CROSS, PN.JoinType.LEFT_OUTER,
@@ -948,14 +965,17 @@ def _exchange_check(meta: SparkPlanMeta):
 _WITH_NESTED = _WITH_ARRAYS + T.TypeSig(
     frozenset({T.StructType, T.MapType}))
 
-_exec(PN.LocalTableScan, sig=_WITH_NESTED)
+_exec(PN.LocalTableScan, sig=_WITH_NESTED, allow_string_arrays=True)
 _exec(PN.CachedRelation, desc="GpuInMemoryTableScanExec analog")
 _exec(PN.FileSourceScan, extra=_scan_check)
 _exec(PN.InsertIntoHadoopFsRelation, extra=_write_check,
       desc="GpuDataWritingCommandExec analog")
 _exec(PN.RangeNode)
-_exec(PN.Project, sig=_WITH_NESTED)
-_exec(PN.Filter, sig=_WITH_NESTED)
+_exec(PN.Sample, sig=_WITH_NESTED, allow_string_arrays=True,
+      desc="deterministic splitmix sampler "
+      "(GpuSampleExec analog; not Spark's XORShift sequence)")
+_exec(PN.Project, sig=_WITH_NESTED, allow_string_arrays=True)
+_exec(PN.Filter, sig=_WITH_NESTED, allow_string_arrays=True)
 _exec(PN.HashAggregate, sig=_WITH_ARRAYS, extra=_agg_check)
 _exec(PN.SortMergeJoin, sig=_WITH_ARRAYS, extra=_join_check,
       desc="converted to shuffled sorted join (GpuSortMergeJoinMeta analog)")
@@ -963,7 +983,8 @@ _exec(PN.ShuffledHashJoin, sig=_WITH_ARRAYS, extra=_join_check)
 _exec(PN.BroadcastHashJoin, sig=_WITH_ARRAYS, extra=_join_check)
 _exec(PN.Sort)
 _exec(PN.Window, sig=_COMMON128, extra=_window_check)
-_exec(PN.Generate, sig=_WITH_ARRAYS, extra=_generate_check)
+_exec(PN.Generate, sig=_WITH_ARRAYS, extra=_generate_check,
+      allow_string_arrays=True)
 _exec(PN.Expand, sig=_WITH_ARRAYS)
 _exec(PN.BroadcastNestedLoopJoin, extra=_bnlj_check)
 _exec(PN.Exchange, extra=_exchange_check)
@@ -1020,15 +1041,36 @@ def _convert_node(meta: SparkPlanMeta, tpu_children, ansi: bool):
         if plan.join_type == PN.JoinType.CROSS:
             return TpuCartesianProductExec(tpu_children[0], tpu_children[1],
                                            plan.output, plan.condition, ansi)
-        return X.TpuShuffledSymmetricHashJoinExec(
+        shuffled = X.TpuShuffledSymmetricHashJoinExec(
             tpu_children[0], tpu_children[1], plan.left_keys, plan.right_keys,
             plan.join_type, plan.condition, plan.output, ansi,
             sub_partition_bytes=meta.conf.get(BATCH_SIZE_BYTES))
+        # AQE: runtime join-strategy switch when both sides are planned
+        # exchanges (spark.sql.adaptive.enabled, default on like Spark)
+        from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+        from spark_rapids_tpu.exec.join import TpuAdaptiveJoinExec
+
+        from spark_rapids_tpu.config import ADAPTIVE_ENABLED
+
+        adaptive = meta.conf.get(ADAPTIVE_ENABLED)
+        if adaptive and all(isinstance(c, TpuShuffleExchangeExec)
+                            for c in shuffled.children):
+            from spark_rapids_tpu.config import (
+                AUTO_BROADCAST_JOIN_THRESHOLD,
+            )
+
+            return TpuAdaptiveJoinExec(
+                shuffled, meta.conf.get(AUTO_BROADCAST_JOIN_THRESHOLD))
+        return shuffled
     if isinstance(plan, PN.BroadcastHashJoin):
         return X.TpuBroadcastHashJoinExec(
             tpu_children[0], tpu_children[1], plan.left_keys, plan.right_keys,
             plan.join_type, plan.condition, plan.output, ansi,
             sub_partition_bytes=meta.conf.get(BATCH_SIZE_BYTES))
+    if isinstance(plan, PN.Sample):
+        from spark_rapids_tpu.exec.limit import TpuSampleExec
+
+        return TpuSampleExec(plan.fraction, plan.seed, tpu_children[0])
     if isinstance(plan, PN.Sort):
         return X.TpuSortExec(plan.orders, plan.is_global, tpu_children[0],
                              ansi, ooc_bytes=meta.conf.get(BATCH_SIZE_BYTES))
@@ -1095,6 +1137,12 @@ def _rebuild_cpu_plan(meta: SparkPlanMeta, converted_children):
     return meta.plan.with_new_children(new_children)
 
 
+def _walk_plan(plan: PN.SparkPlan):
+    yield plan
+    for c in plan.children:
+        yield from _walk_plan(c)
+
+
 class TpuOverrides:
     """The Rule[SparkPlan] entry point."""
 
@@ -1108,8 +1156,10 @@ class TpuOverrides:
             TpuTransitionOverrides,
         )
 
+        TpuOverrides._compile_udfs(plan, conf)
         meta = wrap_plan(plan, conf)
         meta.tag_for_tpu()
+        TpuOverrides._apply_cost_optimizer(meta, conf)
         explain = conf.explain.upper()
         if explain in ("NOT_ON_GPU", "ALL"):
             txt = meta.explain(only_fallback=(explain == "NOT_ON_GPU"))
@@ -1120,6 +1170,87 @@ class TpuOverrides:
         if isinstance(root, TpuExec):
             root = TpuTransitionOverrides.apply(root, conf)
         return root, meta
+
+    @staticmethod
+    def _apply_cost_optimizer(meta: SparkPlanMeta, conf: TpuConf):
+        """CostBasedOptimizer analog (SURVEY.md §2.2, default OFF like the
+        reference): keeps a plan on CPU when the device round-trip cannot
+        pay for itself — the transition cost (2 transfers + compile) of a
+        tiny input exceeds any kernel win."""
+        from spark_rapids_tpu.config import (
+            OPTIMIZER_ENABLED,
+            OPTIMIZER_SMALL_PLAN_BYTES,
+        )
+
+        if not conf.get(OPTIMIZER_ENABLED) or not meta.can_this_run:
+            return
+        from spark_rapids_tpu.session import _estimated_plan_bytes
+
+        threshold = conf.get(OPTIMIZER_SMALL_PLAN_BYTES)
+        size = _estimated_plan_bytes(meta.plan)
+        if size is not None and size < threshold:
+            meta.will_not_work_on_tpu(
+                f"not worth accelerating (cost-based optimizer: input "
+                f"~{size}B below spark.rapids.sql.optimizer."
+                f"smallPlanBytes={threshold})")
+
+    @staticmethod
+    def _compile_udfs(plan: PN.SparkPlan, conf: TpuConf):
+        """udf-compiler pass (the reference's logical-rule analog): trace
+        plain-python UDFs in Project/Filter into expression trees so they
+        fuse into the compiled stage; untranslatable UDFs keep arrow-eval.
+
+        Runs pre-tagging; differential tests still compare against the
+        oracle executing the ORIGINAL python function."""
+        from spark_rapids_tpu.expr.cast import Cast
+        from spark_rapids_tpu.expr.udf import (
+            UserDefinedExpression,
+            supports_columnar,
+        )
+        from spark_rapids_tpu.udf_compiler import try_compile
+
+        from spark_rapids_tpu.config import UDF_COMPILER_ENABLED
+
+        if not conf.get(UDF_COMPILER_ENABLED):
+            return
+
+        def make_sub(schema):
+            def sub(e):
+                if isinstance(e, UserDefinedExpression) \
+                        and not supports_columnar(e.fn):
+                    compiled = try_compile(e.fn, e.children)
+                    if compiled is not None:
+                        try:
+                            out = Cast(compiled, e.dataType)
+                            out.resolve(schema)
+                            return out
+                        except Exception:
+                            return e
+                return e
+
+            return sub
+
+        import copy
+
+        def has_plain_udf(x):
+            return bool(x.collect(
+                lambda y: isinstance(y, UserDefinedExpression)
+                and not supports_columnar(y.fn)))
+
+        for node in _walk_plan(plan):
+            # substitution works on DEEP COPIES: the logical plan is the
+            # user's object and re-plans with the compiler (or the rewrite)
+            # disabled must still see the original python UDF
+            if isinstance(node, PN.Project):
+                sub = make_sub(node.child.output)
+                node.exprs = [
+                    copy.deepcopy(x).transform_up(sub)
+                    if has_plain_udf(x) else x for x in node.exprs]
+            elif isinstance(node, PN.Filter):
+                if has_plain_udf(node.condition):
+                    sub = make_sub(node.child.output)
+                    node.condition = copy.deepcopy(
+                        node.condition).transform_up(sub)
 
     @staticmethod
     def _convert(meta: SparkPlanMeta, ansi: bool):

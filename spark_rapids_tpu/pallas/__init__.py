@@ -1,0 +1,1 @@
+"""Pallas TPU kernels (SURVEY.md §2.10 L0) — device decode et al."""

@@ -615,6 +615,25 @@ class GlobalLimit(LocalLimit):
         return f"GlobalLimit {self.n}"
 
 
+class Sample(SparkPlan):
+    """Bernoulli row sample (GpuSampleExec analog).  The keep decision is
+    the engine's deterministic splitmix64 stream keyed on (seed, row) —
+    both backends draw identical samples (Spark's sampler is
+    XORShift-based; documented divergence, same statistics)."""
+
+    def __init__(self, fraction: float, seed: int, child: SparkPlan):
+        super().__init__([child])
+        self.fraction = float(fraction)
+        self.seed = int(seed)
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def describe(self):
+        return f"Sample fraction={self.fraction} seed={self.seed}"
+
+
 class Union(SparkPlan):
     @property
     def output(self):

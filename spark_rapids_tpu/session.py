@@ -160,6 +160,12 @@ class DataFrameReader:
 
         return read_delta(self.session, path, version)
 
+    def iceberg(self, path: str,
+                snapshot_id: Optional[int] = None) -> "DataFrame":
+        from spark_rapids_tpu.io.iceberg import read_iceberg
+
+        return read_iceberg(self.session, path, snapshot_id)
+
     def avro(self, *paths: str) -> "DataFrame":
         if self._schema is None:
             from spark_rapids_tpu.io.avro import (
@@ -305,6 +311,9 @@ class DataFrame:
         rex = PN.Exchange(PN.HashPartitioning(rkeys, np_), other.plan)
         node = PN.SortMergeJoin(lex, rex, lkeys, rkeys, jt)
         return DataFrame(node, self.session)
+
+    def sample(self, fraction: float, seed: int = 0) -> "DataFrame":
+        return DataFrame(PN.Sample(fraction, seed, self.plan), self.session)
 
     def order_by(self, *cols, ascending=None) -> "DataFrame":
         orders = []

@@ -1,0 +1,331 @@
+"""UDF compiler — translate simple python functions into expressions.
+
+Reference analog: the udf-compiler module (SURVEY.md §2.8):
+CatalystExpressionBuilder decompiles Scala UDF BYTECODE (javassist) into
+Catalyst expressions so the rewritten query runs fully on device.
+
+Python needs no decompiler: expressions already overload the arithmetic /
+comparison / logical operators, so the function is compiled by CALLING it
+with symbolic arguments (the expression nodes themselves) and capturing
+the tree it builds — operator-overload tracing.  Functions that branch on
+data (`if x > 0:`) or call unsupported libraries raise during tracing and
+keep the arrow-eval python path instead; ``F``-namespace helpers cover the
+common non-operator calls (sqrt/abs/when...).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.base import Expression, Literal, lit
+
+
+class UDFTraceError(TypeError):
+    """The function's result depends on python control flow over data."""
+
+
+class _Sym:
+    """Symbolic argument: overloads operators, FORBIDS data-dependent
+    python control flow (bool/len/iter raise, unlike raw Expressions,
+    which are always truthy and would silently mistrace `if x > 0:`)."""
+
+    __slots__ = ("e",)
+
+    def __init__(self, e: Expression):
+        self.e = e
+
+    def __bool__(self):
+        raise UDFTraceError("data-dependent branch (if/while/and/or)")
+
+    def __len__(self):
+        raise UDFTraceError("len() over a column")
+
+    def __iter__(self):
+        raise UDFTraceError("iteration over a column")
+
+    def __index__(self):
+        raise UDFTraceError("indexing with a column")
+
+    def __float__(self):
+        raise UDFTraceError("float() over a column")
+
+    def __int__(self):
+        raise UDFTraceError("int() over a column")
+
+    def _bin(self, other, cls, swap=False):
+        l, r = self.e, _as_expr(other)
+        if swap:
+            l, r = r, l
+        return _Sym(cls(l, r))
+
+    def __add__(self, o):
+        from spark_rapids_tpu.expr.arithmetic import Add
+
+        return self._bin(o, Add)
+
+    def __radd__(self, o):
+        from spark_rapids_tpu.expr.arithmetic import Add
+
+        return self._bin(o, Add, swap=True)
+
+    def __sub__(self, o):
+        from spark_rapids_tpu.expr.arithmetic import Subtract
+
+        return self._bin(o, Subtract)
+
+    def __rsub__(self, o):
+        from spark_rapids_tpu.expr.arithmetic import Subtract
+
+        return self._bin(o, Subtract, swap=True)
+
+    def __mul__(self, o):
+        from spark_rapids_tpu.expr.arithmetic import Multiply
+
+        return self._bin(o, Multiply)
+
+    def __rmul__(self, o):
+        from spark_rapids_tpu.expr.arithmetic import Multiply
+
+        return self._bin(o, Multiply, swap=True)
+
+    def __truediv__(self, o):
+        from spark_rapids_tpu.expr.arithmetic import Divide
+
+        return self._bin(o, Divide)
+
+    def __rtruediv__(self, o):
+        from spark_rapids_tpu.expr.arithmetic import Divide
+
+        return self._bin(o, Divide, swap=True)
+
+    def __mod__(self, o):
+        # python % follows the divisor's sign == SQL pmod, NOT Remainder
+        from spark_rapids_tpu.expr.arithmetic import Pmod
+
+        return self._bin(o, Pmod)
+
+    def __pow__(self, o):
+        from spark_rapids_tpu.expr.mathfuncs import Pow
+
+        return self._bin(o, Pow)
+
+    def __neg__(self):
+        from spark_rapids_tpu.expr.arithmetic import UnaryMinus
+
+        return _Sym(UnaryMinus(self.e))
+
+    def __abs__(self):
+        from spark_rapids_tpu.expr.arithmetic import Abs
+
+        return _Sym(Abs(self.e))
+
+    def __lt__(self, o):
+        from spark_rapids_tpu.expr.predicates import LessThan
+
+        return self._bin(o, LessThan)
+
+    def __le__(self, o):
+        from spark_rapids_tpu.expr.predicates import LessThanOrEqual
+
+        return self._bin(o, LessThanOrEqual)
+
+    def __gt__(self, o):
+        from spark_rapids_tpu.expr.predicates import GreaterThan
+
+        return self._bin(o, GreaterThan)
+
+    def __ge__(self, o):
+        from spark_rapids_tpu.expr.predicates import GreaterThanOrEqual
+
+        return self._bin(o, GreaterThanOrEqual)
+
+    def __eq__(self, o):  # noqa: A003 - symbolic equality
+        from spark_rapids_tpu.expr.predicates import EqualTo
+
+        return self._bin(o, EqualTo)
+
+    def __ne__(self, o):
+        from spark_rapids_tpu.expr.predicates import EqualTo, Not
+
+        return _Sym(Not(EqualTo(self.e, _as_expr(o))))
+
+    def __hash__(self):
+        return id(self)
+
+    def __and__(self, o):
+        from spark_rapids_tpu.expr.predicates import And
+
+        return self._bin(o, And)
+
+    def __or__(self, o):
+        from spark_rapids_tpu.expr.predicates import Or
+
+        return self._bin(o, Or)
+
+    def __invert__(self):
+        from spark_rapids_tpu.expr.predicates import Not
+
+        return _Sym(Not(self.e))
+
+
+class _F:
+    """Function namespace usable inside compiled UDFs (F.sqrt(x)...).
+
+    Dual-mode: symbolic arguments build expressions (the compile trace);
+    plain scalars compute with python math (so the SAME function body
+    also runs row-based on the oracle / arrow-eval path)."""
+
+    @staticmethod
+    def _sym(v):
+        return isinstance(v, (_Sym, Expression))
+
+    @staticmethod
+    def sqrt(x):
+        if _F._sym(x):
+            from spark_rapids_tpu.expr.mathfuncs import Sqrt
+
+            return _Sym(Sqrt(_as_expr(x)))
+        import math
+
+        return None if x is None else (
+            math.sqrt(x) if x >= 0 else float("nan"))
+
+    @staticmethod
+    def abs(x):
+        if _F._sym(x):
+            from spark_rapids_tpu.expr.arithmetic import Abs
+
+            return _Sym(Abs(_as_expr(x)))
+        return None if x is None else abs(x)
+
+    @staticmethod
+    def log(x):
+        if _F._sym(x):
+            from spark_rapids_tpu.expr.mathfuncs import Log
+
+            return _Sym(Log(_as_expr(x)))
+        import math
+
+        return None if x is None or x <= 0 else math.log(x)
+
+    @staticmethod
+    def exp(x):
+        if _F._sym(x):
+            from spark_rapids_tpu.expr.mathfuncs import Exp
+
+            return _Sym(Exp(_as_expr(x)))
+        import math
+
+        return None if x is None else math.exp(x)
+
+    @staticmethod
+    def when(cond, value, otherwise):
+        if _F._sym(cond):
+            from spark_rapids_tpu.expr.conditional import If
+
+            return _Sym(If(_as_expr(cond), _as_expr(value),
+                           _as_expr(otherwise)))
+        return value if cond else otherwise
+
+    @staticmethod
+    def upper(x):
+        if _F._sym(x):
+            from spark_rapids_tpu.expr.strings import Upper
+
+            return _Sym(Upper(_as_expr(x)))
+        return None if x is None else x.upper()
+
+    @staticmethod
+    def lower(x):
+        if _F._sym(x):
+            from spark_rapids_tpu.expr.strings import Lower
+
+            return _Sym(Lower(_as_expr(x)))
+        return None if x is None else x.lower()
+
+    @staticmethod
+    def length(x):
+        if _F._sym(x):
+            from spark_rapids_tpu.expr.strings import Length
+
+            return _Sym(Length(_as_expr(x)))
+        return None if x is None else len(x)
+
+    @staticmethod
+    def concat(*xs):
+        if any(_F._sym(x) for x in xs):
+            from spark_rapids_tpu.expr.strings import Concat
+
+            return _Sym(Concat([_as_expr(x) for x in xs]))
+        if any(x is None for x in xs):
+            return None
+        return "".join(xs)
+
+
+F = _F()
+
+
+def _as_expr(v) -> Expression:
+    if isinstance(v, _Sym):
+        return v.e
+    return v if isinstance(v, Expression) else Literal.of(v)
+
+
+_UNSAFE_OPS = {"IS_OP", "POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE",
+               "CONTAINS_OP"}
+
+
+def _trace_safe(fn) -> bool:
+    """Identity/None tests trace unsoundly (`a is None` is always False
+    over a symbolic argument, silently folding the null branch away), so
+    any function using them keeps the python path."""
+    import dis
+
+    try:
+        return not any(ins.opname in _UNSAFE_OPS
+                       for ins in dis.get_instructions(fn))
+    except TypeError:
+        return False
+
+
+def compile_udf(fn: Callable, args) -> Optional[Expression]:
+    """Trace fn over symbolic arguments; None if untranslatable."""
+    if not _trace_safe(fn):
+        return None
+    sym_args = [_Sym(a) for a in args]
+    try:
+        result = fn(*sym_args, F) if _wants_namespace(fn) \
+            else fn(*sym_args)
+    except Exception:
+        return None
+    if isinstance(result, _Sym):
+        return result.e
+    if isinstance(result, Expression):
+        return result
+    try:
+        return Literal.of(result)
+    except TypeError:
+        return None
+
+
+def _wants_namespace(fn) -> bool:
+    try:
+        import inspect
+
+        params = inspect.signature(fn).parameters
+        return len(params) > 0 and list(params)[-1] in ("F", "functions")
+    except (TypeError, ValueError):
+        return False
+
+
+def try_compile(fn: Callable, children, conf_settings=None):
+    """Plan-time entry: expression tree or None.
+
+    The result still re-resolves against the child schema downstream, so
+    types line up exactly as if the user had written the expression."""
+    if conf_settings is not None:
+        from spark_rapids_tpu.config import UDF_COMPILER_ENABLED
+
+        if not UDF_COMPILER_ENABLED.get(conf_settings):
+            return None
+    return compile_udf(fn, list(children))

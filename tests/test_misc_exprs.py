@@ -179,3 +179,23 @@ def test_sha2_invalid_bits_null():
         return df.select(Sha2(col("a"), lit(123)).alias("x"))
 
     assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_rand_and_mid_across_batches():
+    """Row offsets must accumulate across reader batches (regression:
+    every batch restarted at row 0, duplicating ids and draws)."""
+    def build(s):
+        df = gen_df(s, [IntegerGen()], ["a"], length=600)
+        return df.select(MonotonicallyIncreasingID().alias("mid"),
+                         Rand(seed=5).alias("r"))
+
+    conf = {"spark.rapids.sql.reader.batchSizeRows": 100}
+    assert_tpu_and_cpu_are_equal_collect(build, conf=conf,
+                                         ignore_order=False)
+
+    s = TpuSession({"spark.rapids.sql.enabled": True,
+                    "spark.rapids.sql.reader.batchSizeRows": 100})
+    df = gen_df(s, [IntegerGen()], ["a"], length=600)
+    mids = [r[0] for r in df.select(
+        MonotonicallyIncreasingID().alias("m")).collect()]
+    assert len(set(mids)) == 600

@@ -1,0 +1,88 @@
+"""Pallas Parquet device-decode tests (reference: parquet_test.py reader
+modes + cuDF decode kernels)."""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.session import TpuSession, col, lit, sum_
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import (
+    BooleanGen,
+    DateGen,
+    DoubleGen,
+    IntegerGen,
+    LongGen,
+    TimestampGen,
+    gen_df,
+)
+
+_CONF = {"spark.rapids.sql.format.parquet.decode.device": "true"}
+
+
+def _write(tmp_path, s, codec="NONE", dict_on=True, n=2000, seed=5):
+    import pyarrow.parquet as pq
+
+    df = gen_df(s, [LongGen(), IntegerGen(min_val=0, max_val=30),
+                    DoubleGen(), BooleanGen(), DateGen(),
+                    TimestampGen.ns_safe()],
+                ["a", "b", "c", "d", "e", "f"], length=n, seed=seed)
+    p = str(tmp_path / f"t_{codec}_{dict_on}.parquet")
+    import pyarrow as pa
+
+    from spark_rapids_tpu.columnar.column import HostColumn
+
+    data = {}
+    for name, f in zip(df.schema.field_names(), df.schema.fields):
+        vals = [r[df.schema.field_names().index(name)]
+                for r in df.collect()]
+        data[name] = HostColumn.from_pylist(vals, f.dataType).to_arrow()
+    tbl = pa.table(data)
+    pq.write_table(tbl, p, compression=codec, use_dictionary=dict_on,
+                   data_page_version="1.0")
+    return p, df.schema
+
+
+@pytest.mark.parametrize("codec,dict_on", [("NONE", True), ("ZSTD", True),
+                                           ("NONE", False),
+                                           ("ZSTD", False)])
+def test_device_decode_differential(tmp_path, codec, dict_on):
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    p, schema = _write(tmp_path, s, codec, dict_on)
+
+    def build(sess):
+        return sess.read.schema(schema).parquet(p)
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=_CONF)
+
+
+def test_device_decode_through_query(tmp_path):
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    p, schema = _write(tmp_path, s, "ZSTD", True, n=4000)
+
+    def build(sess):
+        df = sess.read.schema(schema).parquet(p)
+        return df.filter(col("b") > lit(5)).group_by("b").agg(
+            sum_("a", "sa"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=_CONF)
+
+
+def test_snappy_falls_back_to_host(tmp_path):
+    """Unsupported codec: silent per-file host fallback, same results."""
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    p, schema = _write(tmp_path, s, "SNAPPY", True)
+
+    def build(sess):
+        return sess.read.schema(schema).parquet(p)
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=_CONF)
+
+
+def test_decode_metric_counts_device_path(tmp_path):
+    s = TpuSession({"spark.rapids.sql.enabled": True,
+                    **_CONF})
+    p, schema = _write(tmp_path, s, "NONE", True)
+    from spark_rapids_tpu.io.parquet_device import read_parquet_device
+
+    batch = read_parquet_device(p, schema)
+    assert batch.num_rows == 2000

@@ -83,3 +83,45 @@ def test_topn_desc_strings():
         return df.order_by("s", ascending=False).limit(10)
 
     assert_tpu_and_cpu_are_equal_collect(build, ignore_order=False)
+
+
+def test_sample_differential():
+    from data_gen import IntegerGen, StringGen
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(), StringGen()], ["a", "s"], length=800)
+        return df.sample(0.3, seed=7)
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_sample_fraction_bounds():
+    from data_gen import IntegerGen
+    from spark_rapids_tpu.session import TpuSession
+
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    df = gen_df(s, [IntegerGen(nullable=False)], ["a"], length=1000)
+    n = len(df.sample(0.25, seed=3).collect())
+    assert 150 < n < 350, n
+    assert df.sample(0.25, seed=3).collect() == \
+        df.sample(0.25, seed=3).collect()
+
+
+def test_spill_leak_report():
+    from data_gen import IntegerGen
+    from spark_rapids_tpu.memory.spill import (
+        get_spill_framework,
+        reset_spill_framework,
+    )
+    from spark_rapids_tpu.session import TpuSession
+
+    reset_spill_framework()
+    s = TpuSession({"spark.rapids.sql.enabled": True,
+                    "spark.rapids.memory.debug": "true"})
+    from spark_rapids_tpu.session import count_
+
+    df = gen_df(s, [IntegerGen()], ["a"], length=500)
+    assert len(df.group_by("a").agg(count_(None, "n")).collect()) > 0
+    fw = get_spill_framework()
+    report = fw.leak_report()
+    assert report == [], report  # every handle closed after the query

@@ -1,0 +1,105 @@
+"""array<string> tests: split, array_join, element access, explode of
+split — the canonical tokenize pattern (reference: GpuStringSplit +
+generate tests)."""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.collections import ElementAt, GetArrayItem, Size
+from spark_rapids_tpu.expr.strings import ArrayJoin, StringSplit
+from spark_rapids_tpu.session import TpuSession, col, lit
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import IntegerGen, SetValuesGen, StringGen, gen_df
+
+_sentences = SetValuesGen(T.STRING, [
+    "the quick brown fox", "a,b,,c", "", "one", "x  y   z",
+    "trailing space ", None, "comma,separated,values,here"])
+
+
+def test_split_literal_space():
+    def build(s):
+        df = gen_df(s, [_sentences], ["t"], length=300)
+        return df.select(StringSplit(col("t"), lit(" ")).alias("w"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_split_regex_and_limit():
+    def build(s):
+        df = gen_df(s, [_sentences], ["t"], length=300)
+        return df.select(
+            StringSplit(col("t"), lit("[ ,]+")).alias("w"),
+            StringSplit(col("t"), lit(","), lit(2)).alias("w2"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_split_size_and_element_access():
+    def build(s):
+        df = gen_df(s, [_sentences, IntegerGen(min_val=-3, max_val=4)],
+                    ["t", "i"], length=300)
+        w = StringSplit(col("t"), lit(" "))
+        return df.select(Size(w).alias("n"),
+                         GetArrayItem(w, col("i")).alias("g"),
+                         ElementAt(w, col("i")).alias("e"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_array_join_roundtrip():
+    def build(s):
+        df = gen_df(s, [_sentences], ["t"], length=300)
+        w = StringSplit(col("t"), lit(" "))
+        return df.select(ArrayJoin(w, lit("|")).alias("j"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_explode_split_tokenize():
+    """The canonical explode(split(text)) word-count pattern."""
+    from spark_rapids_tpu.session import count_
+
+    def build(s):
+        df = gen_df(s, [_sentences], ["t"], length=200)
+        words = df.select(StringSplit(col("t"), lit("[ ,]+")).alias("w"))
+        exploded = words.explode("w", out_name="word")
+        return exploded.group_by("word").agg(count_(None, "n"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_string_array_through_filter_and_sample():
+    def build(s):
+        df = gen_df(s, [_sentences, IntegerGen(nullable=False)],
+                    ["t", "k"], length=300)
+        w = StringSplit(col("t"), lit(" "))
+        return df.select(w.alias("w"), col("k")).filter(col("k") > 0)
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_split_java_limit_semantics_pinned():
+    """Java String.split rules: limit=1 -> no split; negative limit keeps
+    trailing empties; limit=0 drops them (both engines must match the
+    PINNED Spark behavior, not just each other)."""
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    df = s.create_dataframe({"t": ["a,b,", "a,b,c"]},
+                            T.StructType([T.StructField("t", T.STRING)]))
+    rows = df.select(
+        StringSplit(col("t"), lit(",")).alias("neg"),
+        StringSplit(col("t"), lit(","), lit(0)).alias("zero"),
+        StringSplit(col("t"), lit(","), lit(1)).alias("one"),
+        StringSplit(col("t"), lit(","), lit(2)).alias("two")).collect()
+    assert rows[0] == (["a", "b", ""], ["a", "b"], ["a,b,"], ["a", "b,"])
+    assert rows[1] == (["a", "b", "c"], ["a", "b", "c"], ["a,b,c"],
+                       ["a", "b,c"])
+    # and the oracle agrees
+    s2 = TpuSession({"spark.rapids.sql.enabled": False})
+    df2 = s2.create_dataframe({"t": ["a,b,", "a,b,c"]},
+                              T.StructType([T.StructField("t", T.STRING)]))
+    rows2 = df2.select(
+        StringSplit(col("t"), lit(",")).alias("neg"),
+        StringSplit(col("t"), lit(","), lit(0)).alias("zero"),
+        StringSplit(col("t"), lit(","), lit(1)).alias("one"),
+        StringSplit(col("t"), lit(","), lit(2)).alias("two")).collect()
+    assert rows2 == rows

@@ -45,13 +45,18 @@ def test_columnar_udf_runs_on_tpu():
 
 
 def test_plain_udf_falls_back_with_reason():
+    """With arrow-eval AND the udf compiler disabled, a plain python UDF
+    falls back to CPU with an explain reason (the pre-arrow-eval
+    behavior, still reachable via confs)."""
     plain = udf(_plain_fn, T.LONG, name="plain_fma")
+    conf = {"spark.rapids.sql.python.arrowEval.enabled": "false",
+            "spark.rapids.sql.udfCompiler.enabled": "false"}
 
     def build(s):
         df = gen_df(s, [IntegerGen(), IntegerGen()], ["a", "b"], length=100)
         return df.select(plain(col("a"), col("b")).alias("r"))
 
-    assert_tpu_fallback_collect(build, "Project")
+    assert_tpu_fallback_collect(build, "Project", conf=conf)
 
 
 def test_udf_composes_with_expressions():
@@ -109,12 +114,14 @@ class _RowOnlyUDF(TpuUDF):
 
 def test_row_only_tpuudf_subclass_falls_back():
     inc = udf(_RowOnlyUDF(), T.LONG, name="inc10")
+    conf = {"spark.rapids.sql.python.arrowEval.enabled": "false",
+            "spark.rapids.sql.udfCompiler.enabled": "false"}
 
     def build(s):
         df = gen_df(s, [IntegerGen()], ["a"], length=50)
         return df.select(inc(col("a")).alias("r"))
 
-    assert_tpu_fallback_collect(build, "Project")
+    assert_tpu_fallback_collect(build, "Project", conf=conf)
 
 
 def test_cache_under_limit_no_handle_leak():
