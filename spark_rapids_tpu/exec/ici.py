@@ -196,3 +196,272 @@ class TpuIciShuffleAggExec(TpuExec):
         pb = self.partial._global_agg_empty()
         merged = self.final._merge_batch(pb)
         yield self._count_output(self.final._finalize(merged))
+
+
+class TpuIciShuffleJoinExec(TpuExec):
+    """Distributed shuffled equi-join over the mesh — the UCX-shuffle
+    join's TPU-native replacement (SURVEY.md §5.8 mode 2, VERDICT r1 #3's
+    "and the shuffled join").
+
+    Two SPMD steps (mirroring the agg exec's epoch design):
+
+      1. COLLECTIVE program: both inputs row-shard over the mesh; each
+         device computes murmur3 partition ids of its join keys and
+         all-to-alls both sides over ICI (null-keyed rows stay put), then
+         sorts its received build keys and probes counts — returning the
+         received shards + gather-plan arrays, all still device-sharded.
+      2. LOCAL program: with the per-device pair counts synced once to the
+         host (the static output capacity), a collective-free shard_map
+         materializes each device's join output via the same searchsorted
+         gather maps the single-chip join uses.
+
+    Supported: INNER / LEFT_OUTER / LEFT_SEMI / LEFT_ANTI equi-joins
+    without residual conditions; everything else keeps the single-chip
+    exec.
+    """
+
+    def __init__(self, join, left_inner, right_inner, mesh,
+                 axis: str = "dp"):
+        super().__init__([left_inner, right_inner])
+        self.join = join            # TpuShuffledSymmetricHashJoinExec
+        self.mesh = mesh
+        self.axis = axis
+        self._p1 = None
+        self._p2 = {}
+
+    @property
+    def output(self):
+        return self.join.output
+
+    def describe(self):
+        n = self.mesh.devices.size
+        return (f"TpuIciShuffleJoin[{n}dev] "
+                f"{self.join.join_type.value} "
+                f"[{self.join.describe()}]")
+
+    # ------------------------------------------------------------------
+    def _keys_and_valid(self, cols, schema, keys, nloc, ansi):
+        from spark_rapids_tpu.exec.join import _key_words_of
+        from spark_rapids_tpu.expr.base import EvalContext
+
+        cap = cols[0].capacity
+        b = ColumnarBatch(list(cols), nloc, schema)
+        ctx = EvalContext(b, ansi=ansi)
+        key_cols = [k.eval_tpu(ctx) for k in keys]
+        rows = jnp.arange(cap) < nloc
+        kvalid = rows
+        for kc in key_cols:
+            kvalid = kvalid & kc.validity
+        return key_cols, rows, kvalid
+
+    def _build_p1(self, l_schema, r_schema):
+        axis = self.axis
+        n_dev = int(self.mesh.devices.size)
+        join = self.join
+
+        def per_device(lcols, l_rows, rcols, r_rows):
+            from spark_rapids_tpu.exec.join import (
+                _key_words_of,
+                _multiword_searchsorted,
+            )
+            from spark_rapids_tpu.ops.hashing import spark_partition_ids
+            from spark_rapids_tpu.parallel.mesh import ici_all_to_all_columns
+
+            idx = jax.lax.axis_index(axis)
+            lcap = lcols[0].capacity
+            rcap = rcols[0].capacity
+            nloc_l = jnp.clip(l_rows - idx.astype(jnp.int32) * lcap, 0, lcap)
+            nloc_r = jnp.clip(r_rows - idx.astype(jnp.int32) * rcap, 0, rcap)
+            # ---- exchange left
+            lkeys, lrows, lkvalid = self._keys_and_valid(
+                lcols, l_schema, join.left_keys, nloc_l, join.ansi)
+            tgt_l = jnp.where(
+                lkvalid,
+                spark_partition_ids(lkeys, n_dev),
+                idx.astype(jnp.int32))  # null-keyed rows stay local
+            rl, rl_ok = ici_all_to_all_columns(list(lcols), lrows, tgt_l,
+                                               n_dev, axis)
+            # ---- exchange right
+            rkeys, rrows, rkvalid = self._keys_and_valid(
+                rcols, r_schema, join.right_keys, nloc_r, join.ansi)
+            tgt_r = jnp.where(
+                rkvalid,
+                spark_partition_ids(rkeys, n_dev),
+                idx.astype(jnp.int32))
+            rr, rr_ok = ici_all_to_all_columns(list(rcols), rrows, tgt_r,
+                                               n_dev, axis)
+            # ---- local build (received right)
+            bkeys, _, bkvalid = self._keys_and_valid(
+                rr, r_schema, join.right_keys,
+                jnp.int32(rr[0].capacity), join.ansi)
+            bkvalid = bkvalid & rr_ok
+            bwords = _key_words_of(bkeys)
+            inv = (~bkvalid).astype(jnp.int64)
+            iota = jnp.arange(rr[0].capacity, dtype=jnp.int32)
+            srt = jax.lax.sort(tuple([inv] + bwords + [iota]),
+                               num_keys=1 + len(bwords), is_stable=True)
+            swords = list(srt[1:-1])
+            row_index = srt[-1]
+            n_valid = jnp.sum(bkvalid.astype(jnp.int32))
+            # ---- local probe (received left)
+            pkeys, _, pkvalid = self._keys_and_valid(
+                rl, l_schema, join.left_keys,
+                jnp.int32(rl[0].capacity), join.ansi)
+            pkvalid = pkvalid & rl_ok
+            qwords = _key_words_of(pkeys)
+            lo = _multiword_searchsorted(swords, n_valid, qwords, "left")
+            hi = _multiword_searchsorted(swords, n_valid, qwords, "right")
+            counts = jnp.where(pkvalid, hi - lo, 0)
+            total = jnp.sum(counts.astype(jnp.int64))
+            unmatched = rl_ok & (counts == 0)
+            n_unmatched = jnp.sum(unmatched.astype(jnp.int64))
+            flat = []
+            for c in list(rl) + list(rr):
+                flat.append(c)
+            return (tuple(flat), tuple(swords), row_index, lo, counts,
+                    unmatched, rl_ok,
+                    jnp.stack([total, n_unmatched]).reshape(1, 2))
+
+        return shard_map(
+            per_device, mesh=self.mesh,
+            in_specs=(P(axis), P(), P(axis), P()),
+            out_specs=(P(axis), P(axis), P(axis), P(axis), P(axis),
+                       P(axis), P(axis), P(axis)),
+            check_vma=False)
+
+    def _build_p2(self, out_cap, l_schema, r_schema, n_l):
+        """Collective-free per-device materialization."""
+        axis = self.axis
+        join = self.join
+        jt = join.join_type
+        from spark_rapids_tpu.plan.nodes import JoinType
+
+        def per_device(flat, row_index, lo, counts, unmatched, rl_ok,
+                       totals):
+            from spark_rapids_tpu.ops.filterops import (
+                compact_columns,
+                gather_columns,
+            )
+
+            lcols = list(flat[:n_l])
+            rcols = list(flat[n_l:])
+            total = totals[0, 0]
+            n_um = totals[0, 1]
+            if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+                keep = ((counts == 0) if jt == JoinType.LEFT_ANTI
+                        else (counts > 0)) & rl_ok
+                out, cnt = compact_columns(keep, lcols)
+                return tuple(out), cnt.astype(jnp.int64).reshape(1)
+            n = counts.shape[0]
+            offsets = jnp.cumsum(counts.astype(jnp.int64))
+            excl = offsets - counts.astype(jnp.int64)
+            j = jnp.arange(out_cap, dtype=jnp.int64)
+            probe_row = jnp.searchsorted(offsets, j,
+                                         side="right").astype(jnp.int32)
+            probe_row = jnp.clip(probe_row, 0, n - 1)
+            k = j - excl[probe_row]
+            build_pos = lo[probe_row].astype(jnp.int64) + k
+            bcap = row_index.shape[0]
+            build_row = row_index[jnp.clip(build_pos, 0,
+                                           bcap - 1).astype(jnp.int32)]
+            in_pairs = j < total
+            with_um = jt == JoinType.LEFT_OUTER
+            probe_idx = jnp.where(in_pairs, probe_row, 0)
+            out_rows = total + (n_um if with_um else 0)
+            if with_um:
+                um_pos = jnp.cumsum(unmatched.astype(jnp.int64)) - 1
+                um_slot = total + um_pos
+                scatter_to = jnp.where(unmatched, um_slot,
+                                       out_cap).astype(jnp.int64)
+                probe_idx_full = jnp.zeros(out_cap, jnp.int32).at[
+                    jnp.clip(scatter_to, 0, out_cap)].set(
+                    jnp.arange(n, dtype=jnp.int32), mode="drop")
+                probe_idx = jnp.where(in_pairs, probe_row, probe_idx_full)
+            row_valid = j < out_rows
+            out_l = gather_columns(probe_idx, row_valid, lcols)
+            out_r = gather_columns(
+                jnp.where(in_pairs, build_row, 0), row_valid & in_pairs,
+                rcols)
+            return (tuple(out_l + out_r),
+                    out_rows.astype(jnp.int64).reshape(1))
+
+        return shard_map(
+            per_device, mesh=self.mesh,
+            in_specs=(P(axis),) * 7,
+            out_specs=(P(axis), P(axis)),
+            check_vma=False)
+
+    # ------------------------------------------------------------------
+    def _collect_side(self, child) -> ColumnarBatch:
+        batches = list(child.execute_columnar())
+        if not batches:
+            from spark_rapids_tpu.columnar.batch import empty_batch
+
+            return empty_batch(child.output)
+        return (batches[0] if len(batches) == 1
+                else ColumnarBatch.concat(batches))
+
+    def _pad_for_mesh(self, batch: ColumnarBatch) -> ColumnarBatch:
+        n_dev = int(self.mesh.devices.size)
+        cap = batch.capacity
+        if cap % n_dev or cap < n_dev:
+            batch = ColumnarBatch(
+                [c.slice_to(-(-cap // n_dev) * n_dev)
+                 for c in batch.columns], batch.num_rows, batch.schema)
+        return batch
+
+    def _shard(self, batch: ColumnarBatch) -> List[DeviceColumn]:
+        def put(arr):
+            if arr is None:
+                return None
+            return jax.device_put(
+                arr, NamedSharding(self.mesh, P(self.axis)))
+
+        return [DeviceColumn(c.dtype, put(c.validity), data=put(c.data),
+                             chars=put(c.chars), lengths=put(c.lengths),
+                             elem_valid=put(c.elem_valid))
+                for c in batch.columns]
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.plan.nodes import JoinType
+
+        n_dev = int(self.mesh.devices.size)
+        left = self._pad_for_mesh(self._collect_side(self.children[0]))
+        right = self._pad_for_mesh(self._collect_side(self.children[1]))
+        l_schema, r_schema = left.schema, right.schema
+        with self.metrics["opTime"].timed():
+            ls = self._shard(left)
+            rs = self._shard(right)
+            if self._p1 is None:
+                self._p1 = self._build_p1(l_schema, r_schema)
+            (flat, swords, row_index, lo, counts, unmatched, rl_ok,
+             totals) = self._p1(tuple(ls), jnp.int32(left.num_rows),
+                                tuple(rs), jnp.int32(right.num_rows))
+            totals_np = np.asarray(totals)      # one host sync
+            jt = self.join.join_type
+            per_dev_rows = totals_np[:, 0] + (
+                totals_np[:, 1] if jt == JoinType.LEFT_OUTER else 0)
+            if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+                out_cap = flat[0].capacity // n_dev
+            else:
+                out_cap = max(int(per_dev_rows.max()), 1)
+                out_cap = 1 << (out_cap - 1).bit_length()
+            key2 = out_cap
+            if key2 not in self._p2:
+                self._p2[key2] = self._build_p2(
+                    out_cap, l_schema, r_schema, len(ls))
+            out_cols, out_rows = self._p2[key2](
+                flat, row_index, lo, counts, unmatched, rl_ok, totals)
+            rows_np = np.asarray(out_rows)      # one host sync
+        out_schema = self.join.output
+        per_dev_cap = out_cols[0].capacity // n_dev
+        keep_cols = len(out_schema.fields)
+        for d in range(n_dev):
+            ng = int(rows_np[d])
+            if ng == 0:
+                continue
+            lo_i = d * per_dev_cap
+            cols = [c.gather(jnp.arange(lo_i, lo_i + per_dev_cap))
+                    for c in out_cols[:keep_cols]]
+            yield self._count_output(
+                ColumnarBatch(cols, ng, out_schema))

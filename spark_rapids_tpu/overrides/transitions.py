@@ -64,6 +64,7 @@ class TpuTransitionOverrides:
         if conf.get(TPU_WHOLESTAGE_FUSION):
             root = fuse_stages(root)
         root = TpuTransitionOverrides._rewrite_ici_agg(root, conf)
+        root = TpuTransitionOverrides._rewrite_ici_join(root, conf)
         return root
 
     @staticmethod
@@ -102,6 +103,49 @@ class TpuTransitionOverrides:
         from spark_rapids_tpu.parallel.mesh import make_mesh
 
         return TpuIciShuffleAggExec(partial, node, make_mesh())
+
+    @staticmethod
+    def _rewrite_ici_join(node: TpuExec, conf: TpuConf) -> TpuExec:
+        """ICI mesh mode: Join <- (Exchange, Exchange) becomes one pair of
+        SPMD programs — all-to-all both sides over ICI, local sorted-probe
+        join per device (exec/ici.TpuIciShuffleJoinExec)."""
+        import jax
+
+        from spark_rapids_tpu.config import MESH_ENABLED, SHUFFLE_MODE
+        from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+        from spark_rapids_tpu.exec.ici import TpuIciShuffleJoinExec
+        from spark_rapids_tpu.exec.join import (
+            TpuAdaptiveJoinExec,
+            TpuShuffledSymmetricHashJoinExec,
+        )
+        from spark_rapids_tpu.plan.nodes import JoinType
+
+        node.children = [
+            TpuTransitionOverrides._rewrite_ici_join(c, conf)
+            if isinstance(c, TpuExec) else c for c in node.children]
+        if not (conf.get(MESH_ENABLED)
+                and str(conf.get(SHUFFLE_MODE)).upper() == "ICI"
+                and len(jax.devices()) > 1):
+            return node
+        join = node
+        if isinstance(join, TpuAdaptiveJoinExec):
+            # the collective plan replaces the AQE wrapper: a mesh
+            # all-to-all already is the "shuffle" it would avoid
+            join = join.shuffled
+        if not isinstance(join, TpuShuffledSymmetricHashJoinExec):
+            return node
+        if join.condition is not None or join.join_type not in (
+                JoinType.INNER, JoinType.LEFT_OUTER, JoinType.LEFT_SEMI,
+                JoinType.LEFT_ANTI):
+            return node
+        if not all(isinstance(c, TpuShuffleExchangeExec)
+                   for c in join.children):
+            return node
+        from spark_rapids_tpu.parallel.mesh import make_mesh
+
+        return TpuIciShuffleJoinExec(
+            join, join.children[0].children[0],
+            join.children[1].children[0], make_mesh())
 
     @staticmethod
     def _insert_coalesce(node: TpuExec, conf: TpuConf) -> TpuExec:

@@ -179,3 +179,48 @@ def test_ici_plan_empty_input():
     df = s.create_dataframe({"k": [], "v": []}, schema)
     assert df.group_by("k").agg(sum_("v", "s")).collect() == []
     assert df.agg(sum_("v", "s")).collect() == [(None,)]
+
+
+@needs_mesh
+@pytest.mark.parametrize("how", ["inner", "left", "left_semi", "left_anti"])
+def test_ici_plan_shuffled_join_matches_oracle(how):
+    """A shuffled equi-join DataFrame query executes as the two-step SPMD
+    collective program (all-to-all both sides over ICI, local sorted-probe
+    join per device) and matches the oracle."""
+    import sys
+    sys.path.insert(0, "tests")
+    from asserts import assert_tpu_and_cpu_are_equal_collect
+    from data_gen import IntegerGen, LongGen, StringGen, gen_df
+
+    conf = dict(_ICI_CONF)
+    conf["spark.sql.autoBroadcastJoinThreshold"] = "-1"
+
+    def build(s):
+        left = gen_df(s, [IntegerGen(min_val=0, max_val=30),
+                          LongGen(), StringGen(max_len=6)],
+                      ["k", "v", "t"], length=600)
+        right = gen_df(s, [IntegerGen(min_val=5, max_val=40,
+                                      nullable=False),
+                           LongGen()], ["k", "w"], length=300, seed=9)
+        return left.join(right, on=["k"], how=how)
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=conf)
+
+
+@needs_mesh
+def test_ici_join_plan_is_installed():
+    import sys
+    sys.path.insert(0, "tests")
+    from data_gen import IntegerGen, LongGen, gen_df
+    from spark_rapids_tpu.session import TpuSession
+
+    conf = dict(_ICI_CONF)
+    conf["spark.sql.autoBroadcastJoinThreshold"] = "-1"
+    s = TpuSession(conf)
+    left = gen_df(s, [IntegerGen(nullable=False), LongGen()], ["k", "v"],
+                  length=100)
+    right = gen_df(s, [IntegerGen(nullable=False), LongGen()],
+                   ["k", "w"], length=100, seed=3)
+    q = left.join(right, on=["k"])
+    root, meta = q._planned()
+    assert "TpuIciShuffleJoin" in root.pretty(), root.pretty()
