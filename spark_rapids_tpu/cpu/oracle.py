@@ -2927,6 +2927,283 @@ def _h_create_named_struct(e, cols, n, ansi):
     return out
 
 
+def _map_hof_flatten(e, cols, n, ansi):
+    """Evaluate a (k, v) lambda body over a flattened map-entry batch."""
+    m = eval_expr(e.children[0], cols, n, ansi)
+    idx, ks, vs = [], [], []
+    for i in range(n):
+        if m.validity[i] and m.values[i] is not None:
+            for k, v in m.values[i].items():
+                idx.append(i)
+                ks.append(k)
+                vs.append(v)
+    cnt = len(idx)
+    mt = e.children[0]._dataType
+    outer = [CpuCol(c.dtype, c.values[idx], c.validity[idx]) for c in cols]
+    kcol = CpuCol.from_objs(ks, mt.keyType)
+    vcol = CpuCol.from_objs(vs, mt.valueType)
+    res = eval_expr(e.body, outer + [kcol, vcol], cnt, ansi)
+    per_row = [[] for _ in range(n)]
+    for k, i in enumerate(idx):
+        per_row[i].append(res.row(k))
+    return m, per_row
+
+
+def _h_transform_keys(e, cols, n, ansi):
+    m, per_row = _map_hof_flatten(e, cols, n, ansi)
+    vals = np.empty(n, object)
+    for i in range(n):
+        if not m.validity[i]:
+            continue
+        d = {}
+        for nk, v in zip(per_row[i], m.values[i].values()):
+            if nk is None:
+                raise RuntimeError("Cannot use null as map key")
+            if any(_nan_eq(nk, ex) for ex in d):
+                raise RuntimeError("Duplicate map key was found")
+            d[nk] = v
+        vals[i] = d
+    return CpuCol(e.dataType, vals, m.validity.copy())
+
+
+def _h_transform_values(e, cols, n, ansi):
+    m, per_row = _map_hof_flatten(e, cols, n, ansi)
+    vals = np.empty(n, object)
+    for i in range(n):
+        if m.validity[i]:
+            vals[i] = dict(zip(m.values[i].keys(), per_row[i]))
+    return CpuCol(e.dataType, vals, m.validity.copy())
+
+
+def _h_map_filter(e, cols, n, ansi):
+    m, per_row = _map_hof_flatten(e, cols, n, ansi)
+    vals = np.empty(n, object)
+    for i in range(n):
+        if m.validity[i]:
+            vals[i] = {k: v for (k, v), keep
+                       in zip(m.values[i].items(), per_row[i])
+                       if keep is not None and bool(keep)}
+    return CpuCol(e.dataType, vals, m.validity.copy())
+
+
+def _h_zip_with(e, cols, n, ansi):
+    a = eval_expr(e.children[0], cols, n, ansi)
+    b = eval_expr(e.children[1], cols, n, ansi)
+    idx, xs, ys = [], [], []
+    for i in range(n):
+        if a.validity[i] and b.validity[i]:
+            la = a.values[i] or []
+            lb = b.values[i] or []
+            for j in range(max(len(la), len(lb))):
+                idx.append(i)
+                xs.append(la[j] if j < len(la) else None)
+                ys.append(lb[j] if j < len(lb) else None)
+    cnt = len(idx)
+    outer = [CpuCol(c.dtype, c.values[idx], c.validity[idx]) for c in cols]
+    xcol = CpuCol.from_objs(xs, e.children[0]._dataType.elementType)
+    ycol = CpuCol.from_objs(ys, e.children[1]._dataType.elementType)
+    res = eval_expr(e.body, outer + [xcol, ycol], cnt, ansi)
+    per_row = [[] for _ in range(n)]
+    for k, i in enumerate(idx):
+        per_row[i].append(res.row(k))
+    vals = np.empty(n, object)
+    validity = a.validity & b.validity
+    for i in range(n):
+        if validity[i]:
+            vals[i] = per_row[i]
+    return CpuCol(e.dataType, vals, validity)
+
+
+def _h_map_from_arrays(e, cols, n, ansi):
+    ka, va = _kids(e, cols, n, ansi)
+    validity = ka.validity & va.validity
+    vals = np.empty(n, object)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        ks = ka.values[i] or []
+        vs = va.values[i] or []
+        if len(ks) != len(vs):
+            raise RuntimeError(
+                "key and value arrays must have the same length")
+        d = {}
+        for k, v in zip(ks, vs):
+            if k is None:
+                raise RuntimeError("Cannot use null as map key")
+            if any(_nan_eq(k, ex) for ex in d):
+                raise RuntimeError("Duplicate map key was found")
+            d[k] = v
+        vals[i] = d
+    return CpuCol(e.dataType, vals, validity)
+
+
+def _h_map_concat(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    validity = _null_prop_validity(kids)
+    vals = np.empty(n, object)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        d = {}
+        for m in kids:
+            for k, v in (m.values[i] or {}).items():
+                if any(_nan_eq(k, ex) for ex in d):
+                    raise RuntimeError("Duplicate map key was found")
+                d[k] = v
+        vals[i] = d
+    return CpuCol(e.dataType, vals, validity)
+
+
+def _h_map_contains_key(e, cols, n, ansi):
+    m, key = _kids(e, cols, n, ansi)
+    validity = m.validity & key.validity
+    out = np.zeros(n, np.bool_)
+    for i in range(n):
+        if validity[i]:
+            out[i] = any(_nan_eq(key.row(i), k)
+                         for k in (m.values[i] or {}))
+    return CpuCol(T.BOOLEAN, out, validity)
+
+
+def _h_array_compact(e, cols, n, ansi):
+    (a,) = _kids(e, cols, n, ansi)
+    vals = np.empty(n, object)
+    for i in range(n):
+        if a.validity[i]:
+            vals[i] = [x for x in (a.values[i] or []) if x is not None]
+    return CpuCol(e.dataType, vals, a.validity.copy())
+
+
+def _h_array_append(e, cols, n, ansi):
+    a, x = _kids(e, cols, n, ansi)
+    prepend = type(e).__name__ == "ArrayPrepend"
+    vals = np.empty(n, object)
+    for i in range(n):
+        if a.validity[i]:
+            base = list(a.values[i] or [])
+            vals[i] = ([x.row(i)] + base if prepend
+                       else base + [x.row(i)])
+    return CpuCol(e.dataType, vals, a.validity.copy())
+
+
+def _h_make_date(e, cols, n, ansi):
+    y, m, d = _kids(e, cols, n, ansi)
+    validity = y.validity & m.validity & d.validity
+    out = np.zeros(n, np.int32)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        try:
+            yy, mm, dd = int(y.values[i]), int(m.values[i]), int(d.values[i])
+            if not (1 <= yy <= 9999):
+                raise ValueError
+            out[i] = (pydt.date(yy, mm, dd) - pydt.date(1970, 1, 1)).days
+        except (ValueError, OverflowError):
+            if ansi:
+                raise RuntimeError("invalid date in make_date (ANSI)")
+            validity[i] = False
+    return CpuCol(T.DATE, out, validity)
+
+
+def _h_make_timestamp(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    validity = _null_prop_validity(kids)
+    y, m, d, h, mi, s = kids
+    st = e.children[5].dataType
+    out = np.zeros(n, np.int64)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        try:
+            yy, mm, dd = int(y.values[i]), int(m.values[i]), int(d.values[i])
+            hh, mmin = int(h.values[i]), int(mi.values[i])
+            if isinstance(st, T.DecimalType):
+                micros_in_sec = int(s.values[i]) * (10 ** (6 - st.scale))
+            elif isinstance(st, (T.FloatType, T.DoubleType)):
+                micros_in_sec = int(round(float(s.values[i]) * 1e6))
+            else:
+                micros_in_sec = int(s.values[i]) * 1_000_000
+            if not (1 <= yy <= 9999 and 0 <= hh <= 23 and 0 <= mmin <= 59
+                    and 0 <= micros_in_sec <= 60_000_000):
+                raise ValueError
+            days = (pydt.date(yy, mm, dd) - pydt.date(1970, 1, 1)).days
+            out[i] = (days * 86_400_000_000 + hh * 3_600_000_000
+                      + mmin * 60_000_000 + micros_in_sec)
+        except (ValueError, OverflowError):
+            if ansi:
+                raise RuntimeError("invalid timestamp in make_timestamp (ANSI)")
+            validity[i] = False
+    return CpuCol(T.TIMESTAMP, out, validity)
+
+
+def _h_current(e, cols, n, ansi):
+    if type(e).__name__ == "CurrentDate":
+        return CpuCol(T.DATE,
+                      np.full(n, e.captured_micros // 86_400_000_000,
+                              np.int32), np.ones(n, np.bool_))
+    return CpuCol(T.TIMESTAMP, np.full(n, e.captured_micros, np.int64),
+                  np.ones(n, np.bool_))
+
+
+def _h_timestamp_units(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    name = type(e).__name__
+    validity = c.validity.copy()
+    st = e.child.dataType
+    out = np.zeros(n, np.int64)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        v = c.values[i]
+        if name == "TimestampSeconds":
+            if isinstance(st, (T.FloatType, T.DoubleType)):
+                f = float(v) * 1e6
+                if not (math.isfinite(f) and abs(f) < 2.0 ** 63):
+                    validity[i] = False
+                    continue
+                out[i] = int(round(f))
+            elif not -9223372036854 <= int(v) <= 9223372036854:
+                if ansi:
+                    raise RuntimeError("timestamp_seconds overflow (ANSI)")
+                validity[i] = False
+            else:
+                out[i] = int(v) * 1_000_000
+        elif name == "TimestampMillis":
+            if not -9223372036854775 <= int(v) <= 9223372036854775:
+                validity[i] = False
+            else:
+                out[i] = int(v) * 1_000
+        else:
+            out[i] = int(v)
+    return CpuCol(T.TIMESTAMP, out, validity)
+
+
+def _h_unix_units(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    name = type(e).__name__
+    div = {"UnixSeconds": 1_000_000, "UnixMillis": 1_000,
+           "UnixMicros": 1}[name]
+    out = np.array([int(v) // div for v in
+                    np.where(c.validity, c.values, 0)], np.int64)
+    return CpuCol(T.LONG, out, c.validity.copy())
+
+
+def _h_unix_date(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    name = type(e).__name__
+    dt = T.INT if name == "UnixDate" else T.DATE
+    return CpuCol(dt, c.values.astype(np.int32), c.validity.copy())
+
+
+def _h_weekday(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    days = (c.values.astype(np.int64) if isinstance(e.child.dataType,
+                                                    T.DateType)
+            else c.values.astype(np.int64) // 86_400_000_000)
+    return CpuCol(T.INT, ((days + 3) % 7).astype(np.int32),
+                  c.validity.copy())
+
+
 _HANDLERS = {
     "BoundReference": _h_bound,
     "Literal": _h_literal,
@@ -2977,7 +3254,16 @@ _HANDLERS = {
     "DateFormat": _h_format_time,
     "Hour": _h_timefield, "Minute": _h_timefield, "Second": _h_timefield,
     "DateAdd": _h_dateadd, "DateSub": _h_dateadd, "DateDiff": _h_datediff,
-    "UnixTimestamp": _h_unixts,
+    "UnixTimestamp": _h_unixts, "ToUnixTimestamp": _h_unixts,
+    "MakeDate": _h_make_date, "MakeTimestamp": _h_make_timestamp,
+    "CurrentDate": _h_current, "CurrentTimestamp": _h_current,
+    "TimestampSeconds": _h_timestamp_units,
+    "TimestampMillis": _h_timestamp_units,
+    "TimestampMicros": _h_timestamp_units,
+    "UnixSeconds": _h_unix_units, "UnixMillis": _h_unix_units,
+    "UnixMicros": _h_unix_units,
+    "UnixDate": _h_unix_date, "DateFromUnixDate": _h_unix_date,
+    "WeekDay": _h_weekday,
     "Murmur3Hash": _h_hashexpr, "XxHash64": _h_hashexpr,
     "Reverse": _h_reverse, "InitCap": _h_initcap, "Ascii": _h_ascii,
     "Chr": _h_chr, "StringReplace": _h_replace,
@@ -3041,6 +3327,16 @@ _HANDLERS = {
     "Rand": _h_rand,
     "RaiseError": _h_raise_error,
     "ArrayTransform": _h_array_transform,
+    "TransformKeys": _h_transform_keys,
+    "TransformValues": _h_transform_values,
+    "MapFilter": _h_map_filter,
+    "ZipWith": _h_zip_with,
+    "MapFromArrays": _h_map_from_arrays,
+    "MapConcat": _h_map_concat,
+    "MapContainsKey": _h_map_contains_key,
+    "ArrayCompact": _h_array_compact,
+    "ArrayAppend": _h_array_append,
+    "ArrayPrepend": _h_array_append,
     "ArrayFilter": _h_array_filter,
     "ArrayExists": _h_array_exists,
     "ArrayForAll": _h_array_forall,
@@ -4020,6 +4316,19 @@ def _cpu_bnlj(plan, ansi: bool):
     return out, m
 
 
+def _order_peer_key(ocols, i):
+    """Order-key tuple for peer/rank comparison; NaN maps to a sentinel so
+    NaN rows peer with each other (Spark: NaN == NaN in ordering — plain
+    tuple equality would make every NaN its own peer group)."""
+    out = []
+    for oc in ocols:
+        v = oc.row(i)
+        if isinstance(v, (float, np.floating)) and math.isnan(v):
+            v = "__nan__"
+        out.append(v)
+    return tuple(out)
+
+
 def _cpu_window(plan: PN.Window, ansi: bool):
     cols, n = execute_cpu_plan(plan.child, ansi)
     pcols = [eval_expr(e, cols, n, ansi) for e in plan.partition_by]
@@ -4049,7 +4358,7 @@ def _cpu_window(plan: PN.Window, ansi: bool):
                 dense = 0
                 prev = object()
                 for r, i in enumerate(idxs):
-                    cur = tuple(oc.row(i) for oc in ocols)
+                    cur = _order_peer_key(ocols, i)
                     if cur != prev:
                         rank = r + 1
                         dense += 1
@@ -4060,14 +4369,14 @@ def _cpu_window(plan: PN.Window, ansi: bool):
                 rank = 0
                 nr = len(idxs)
                 for r, i in enumerate(idxs):
-                    cur = tuple(oc.row(i) for oc in ocols)
+                    cur = _order_peer_key(ocols, i)
                     if cur != prev:
                         rank = r + 1
                         prev = cur
                     vals[i] = ((rank - 1) / (nr - 1)) if nr > 1 else 0.0
             elif wf.func == "cume_dist":
                 nr = len(idxs)
-                keys = [tuple(oc.row(i) for oc in ocols) for i in idxs]
+                keys = [_order_peer_key(ocols, i) for i in idxs]
                 for r, i in enumerate(idxs):
                     last = r
                     while last + 1 < nr and keys[last + 1] == keys[r]:
@@ -4100,24 +4409,42 @@ def _cpu_window(plan: PN.Window, ansi: bool):
                     else:
                         vals[i] = None
                         valid[i] = False
-            elif wf.func in ("sum", "count", "avg", "min", "max"):
-                if isinstance(plan.frame, tuple):
-                    a, b = plan.frame
-                    for r, i in enumerate(idxs):
-                        lo = max(0, r - int(a))
-                        hi = min(len(idxs), r + int(b) + 1)
-                        acc = [ac.values[j] for j in idxs[lo:hi]
-                               if ac.validity[j]]
-                        vals[i] = _wagg(wf, acc, valid, i)
-                elif plan.frame == "running":
+            elif wf.func in ("first_value", "last_value"):
+                for r, i in enumerate(idxs):
+                    sel = _frame_rows(plan, idxs, r, ocols)
+                    order = sel if wf.func == "first_value" \
+                        else list(reversed(sel))
+                    vals[i] = None
+                    valid[i] = False
+                    for j in order:
+                        if wf.ignore_nulls and not ac.validity[j]:
+                            continue
+                        if ac.validity[j]:
+                            vals[i] = ac.values[j]
+                            valid[i] = True
+                        break
+            elif wf.func in ("sum", "count", "avg", "min", "max",
+                             "var_pop", "var_samp", "stddev_pop",
+                             "stddev_samp"):
+                # incremental/shared accumulators for the linear frames;
+                # per-row _frame_rows only for peer/bounded frames (the
+                # oracle is the production CPU fallback — O(n^2) frame
+                # rebuilds would melt large partitions)
+                if plan.frame == "running":
                     acc: List = []
                     for i in idxs:
                         if ac.validity[i]:
                             acc.append(ac.values[i])
                         vals[i] = _wagg(wf, acc, valid, i)
-                else:  # unbounded
+                elif plan.frame == "unbounded":
                     acc = [ac.values[i] for i in idxs if ac.validity[i]]
                     for i in idxs:
+                        vals[i] = _wagg(wf, acc, valid, i)
+                else:
+                    for r, i in enumerate(idxs):
+                        sel = _frame_rows(plan, idxs, r, ocols)
+                        acc = [ac.values[j] for j in sel
+                               if ac.validity[j]]
                         vals[i] = _wagg(wf, acc, valid, i)
             else:
                 raise NotImplementedError(wf.func)
@@ -4130,9 +4457,72 @@ def _cpu_window(plan: PN.Window, ansi: bool):
     return out_cols, n
 
 
+def _frame_rows(plan: PN.Window, idxs, r, ocols):
+    """Row indices in the window frame of sorted-position ``r``
+    (frame forms per plan.nodes.normalize_frame)."""
+    fr = plan.frame
+    nr = len(idxs)
+    if fr == "running":
+        return idxs[:r + 1]
+    if fr == "unbounded":
+        return idxs
+    if fr == "range_running":
+        # peers (equal order keys, nulls peer with nulls) are included
+        kr = _order_peer_key(ocols, idxs[r])
+        last = r
+        while last + 1 < nr and \
+                _order_peer_key(ocols, idxs[last + 1]) == kr:
+            last += 1
+        return idxs[:last + 1]
+    if fr[0] == "rows":
+        lo = max(0, r - int(fr[1]))
+        hi = min(nr, r + int(fr[2]) + 1)
+        return idxs[lo:hi]
+    # ("range", lo, hi) over the single (numeric) order key.  "PRECEDING"
+    # means towards the partition start, so the value-space bounds flip for
+    # descending order.  Null order keys frame only their null peers.
+    lo_off, hi_off = fr[1], fr[2]
+    ov = ocols[0]
+    i = idxs[r]
+    if not ov.validity[i]:
+        return [j for j in idxs if not ov.validity[j]]
+    asc = plan.order_by[0][1].ascending
+    v = ov.values[i]
+    if isinstance(v, (float, np.floating)) and math.isnan(v):
+        # NaN order keys frame their NaN peers (Spark: NaN == NaN in
+        # ordering; NaN ± offset comparisons would otherwise all be False)
+        return [j for j in idxs
+                if ov.validity[j]
+                and isinstance(ov.values[j], (float, np.floating))
+                and math.isnan(ov.values[j])]
+    if isinstance(v, (int, np.integer)) and not isinstance(v, bool):
+        # exact python-int arithmetic: np.int64 boundaries would wrap at
+        # the extremes (the device side saturates, which is equivalent)
+        v = int(v)
+        lo_v = v - int(lo_off) if asc else v - int(hi_off)
+        hi_v = v + int(hi_off) if asc else v + int(lo_off)
+        return [j for j in idxs
+                if ov.validity[j] and lo_v <= int(ov.values[j]) <= hi_v]
+    lo_v = v - lo_off if asc else v - hi_off
+    hi_v = v + hi_off if asc else v + lo_off
+    return [j for j in idxs
+            if ov.validity[j] and lo_v <= ov.values[j] <= hi_v]
+
+
 def _wagg(wf, acc, valid, i):
     if wf.func == "count":
         return len(acc)
+    if wf.func in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
+        xs = [float(v) for v in acc]
+        n = len(xs)
+        den = n if wf.func.endswith("pop") else n - 1
+        if den <= 0:  # Spark nullOnDivideByZero: samp of n<=1 -> NULL
+            valid[i] = False
+            return None
+        mean = sum(xs) / n
+        m2 = sum((x - mean) ** 2 for x in xs)
+        var = m2 / den
+        return var if wf.func.startswith("var") else math.sqrt(var)
     if not acc:
         valid[i] = False
         return None

@@ -7,20 +7,35 @@ jitted program built on `lax.associative_scan` segmented scans:
 
   * rank/dense_rank/row_number/ntile/percent_rank/cume_dist: order-key
     change flags + segmented cumsums / peer-group reductions
-  * running frames (UNBOUNDED PRECEDING..CURRENT ROW): segmented inclusive
-    scans (sum/count/min/max/avg)
+  * running ROWS frames (UNBOUNDED PRECEDING..CURRENT ROW): segmented
+    inclusive scans (sum/count/min/max/avg/var/stddev)
+  * RANGE running (Spark's default frame with ORDER BY): the running scan
+    result gathered at the last order-key *peer* of each row
   * unbounded frames: segment totals broadcast back
   * bounded ROWS frames (a PRECEDING..b FOLLOWING, both finite): statically
     unrolled shifted combines masked at partition boundaries — the TPU
     counterpart of the reference's batched bounded-window kernel (window
-    width is a plan-time constant; widths above _MAX_BOUNDED_WINDOW fall
-    back at tag time)
+    width is a plan-time constant; widths above the tag-time cap fall back)
+  * bounded RANGE frames over a single numeric order key: per-row frame
+    boundaries found with a vectorized merged-sort searchsorted (data and
+    query keys share one `lax.sort`), then prefix-sum differences for
+    sum/count/avg/var and a sparse table (doubling min/max levels) for
+    min/max over the variable-width contiguous ranges
   * lead/lag: shifted gathers with partition-boundary masking and literal
     defaults (strings included)
+  * first_value/last_value (incl. IGNORE NULLS): frame-boundary gathers
+    through next-valid/prev-valid index scans — strings included
+  * string min/max (running/range-running/unbounded frames): segmented
+    lexicographic arg-min/max scans over the packed sort-key words, then a
+    chars gather
 
 Rows are sorted by (partition keys, order keys), computed, and scattered
 back to the original order through the inverse permutation, so output row
 order matches the child (Spark's WindowExec contract).
+
+Every unsupported (function, frame, type) combination is rejected at *tag*
+time by overrides._window_check — no execution-time NotImplementedError is
+reachable from a converted plan (the RapidsMeta tag-or-fallback contract).
 """
 from __future__ import annotations
 
@@ -35,8 +50,51 @@ from spark_rapids_tpu.columnar.column import DeviceColumn
 from spark_rapids_tpu.exec.base import TpuExec
 from spark_rapids_tpu.expr.base import EvalContext, Expression
 from spark_rapids_tpu.ops import segment as SEG
-from spark_rapids_tpu.ops.sortkeys import SortSpec, _column_key_words, pack_sort_keys
+from spark_rapids_tpu.ops.sortkeys import (SortSpec, _column_key_words,
+                                           float_order_key, pack_sort_keys)
 from spark_rapids_tpu.plan.nodes import WindowFunction
+
+_VAR_FUNCS = ("var_pop", "var_samp", "stddev_pop", "stddev_samp")
+_I64_MAX = 9223372036854775807
+_I64_MIN = -9223372036854775808
+
+
+def _g(geom, key):
+    """Memoizing accessor for the lazily-built frame-geometry thunks."""
+    v = geom[key]
+    if callable(v):
+        v = v()
+        geom[key] = v
+    return v
+
+
+def _peer_first(geom):
+    return _g(geom, "peers")[0]
+
+
+def _peer_last(geom):
+    return _g(geom, "peers")[1]
+
+
+def _chan_merge(na, ma, m2a, nb, mb, m2b):
+    """Chan's pairwise (n, mean, M2) merge — numerically stable variance
+    combination (the reference gets this from Spark's CentralMomentAgg)."""
+    n = na + nb
+    nsafe = jnp.maximum(n, 1.0)
+    d = mb - ma
+    mean = ma + d * nb / nsafe
+    m2 = m2a + m2b + d * d * na * nb / nsafe
+    return n, mean, m2
+
+
+def _lex_lt(aw, bw):
+    """Lexicographic a < b over equal-length int64 word tuples."""
+    lt = jnp.zeros_like(aw[0], jnp.bool_)
+    done = jnp.zeros_like(aw[0], jnp.bool_)
+    for x, y in zip(aw, bw):
+        lt = jnp.where(~done & (x < y), True, lt)
+        done = done | (x != y)
+    return lt
 
 
 class TpuWindowExec(TpuExec):
@@ -44,7 +102,7 @@ class TpuWindowExec(TpuExec):
                  partition_by: List[Expression],
                  order_by: List[Tuple[Expression, SortSpec]],
                  child: TpuExec, output_schema: T.StructType,
-                 frame: str = "running", ansi: bool = False):
+                 frame="running", ansi: bool = False):
         super().__init__([child])
         self.functions = functions
         self.partition_by = partition_by
@@ -113,7 +171,7 @@ class TpuWindowExec(TpuExec):
             starts = jnp.zeros(cap, jnp.bool_).at[0].set(True)
         seg = jnp.cumsum(starts.astype(jnp.int32)) - 1
         seg = jnp.where(mask_s, seg, cap - 1)
-        # order-key change flags (for rank/dense_rank)
+        # order-key change flags (for rank/dense_rank/peer groups)
         owords = []
         for oc, spec in zip(ocols, ospecs):
             nullbit = jnp.where(oc.validity, 0, 1).astype(jnp.int64)
@@ -129,11 +187,37 @@ class TpuWindowExec(TpuExec):
         # row position within partition (0-based), in sorted order
         pos_in_part = SEG.seg_scan_sum(
             jnp.ones(cap, jnp.int64), jnp.ones(cap, jnp.bool_), starts)[0] - 1
+        pos32 = pos_in_part.astype(jnp.int32)
+        # frame geometry shared by all functions (sorted order); the
+        # reductions/gathers are thunks so a ranking-only window (row_number/
+        # rank/lead/lag) never pays for peer/segment-end indices
+        seg_first = iota - pos32
+
+        def _seg_last():
+            return jax.ops.segment_max(jnp.where(mask_s, iota, -1), seg,
+                                       num_segments=cap)[seg]
+
+        def _peers():
+            peer = jnp.cumsum(ochange.astype(jnp.int32)) - 1
+            peer = jnp.where(mask_s, peer, cap - 1)
+            last = jax.ops.segment_max(jnp.where(mask_s, iota, -1), peer,
+                                       num_segments=cap)[peer]
+            first = jax.ops.segment_min(
+                jnp.where(mask_s, iota, cap), peer, num_segments=cap)[peer]
+            return first, last
+
+        geom = dict(iota=iota, seg_first=seg_first,
+                    seg_last=_seg_last,
+                    peers=_peers,
+                    ocols_sorted=lambda: [c.gather(perm) for c in ocols],
+                    ospecs=ospecs)
         for wf in self.functions:
             res = self._one_function(
-                wf, ctx, perm, seg, starts, ochange, pos_in_part, mask_s, cap)
+                wf, ctx, perm, seg, starts, ochange, pos_in_part, mask_s,
+                cap, geom)
             if isinstance(res, DeviceColumn):
-                # column result (lead/lag incl. strings): gather back
+                # column result (lead/lag/first/last/string min-max): gather
+                # back to input row order
                 out_cols.append(res.gather(inv_perm))
                 out_cols[-1] = DeviceColumn(
                     res.dtype, out_cols[-1].validity & mask,
@@ -155,8 +239,128 @@ class TpuWindowExec(TpuExec):
                                   num_segments=cap)
         return cnt[seg]
 
+    # -- frame boundaries ----------------------------------------------------
+
+    def _frame_start_end(self, frame, mask_s, seg, cap, geom):
+        """Per-row [fs, fe) frame boundaries as sorted-space indices
+        (memoized in ``geom`` — identical for every window function)."""
+        if "fs" in geom:
+            return geom["fs"], geom["fe"]
+        iota = geom["iota"]
+        seg_first = geom["seg_first"]
+        if frame == "running":
+            fs, fe = seg_first, iota + 1
+        elif frame == "range_running":
+            fs, fe = seg_first, _peer_last(geom) + 1
+        elif frame == "unbounded":
+            fs, fe = seg_first, _g(geom, "seg_last") + 1
+        else:
+            kind, a, b = frame
+            if kind == "rows":
+                seg_last = _g(geom, "seg_last")
+                fs = jnp.maximum(seg_first, iota - jnp.int32(int(a)))
+                fe = jnp.minimum(seg_last + 1, iota + jnp.int32(int(b) + 1))
+                fe = jnp.maximum(fe, fs)
+            else:
+                fs, fe = self._range_bounds(a, b, mask_s, seg, cap, geom)
+        geom["fs"], geom["fe"] = fs, fe
+        return fs, fe
+
+    def _order_value_key(self, vals, dtype, asc):
+        """Physical-sort-compatible key word for an order value array."""
+        if isinstance(dtype, (T.FloatType, T.DoubleType)):
+            k = float_order_key(vals)
+        else:
+            k = vals.astype(jnp.int64)
+        return k if asc else ~k
+
+    def _range_bounds(self, lo_off, hi_off, mask_s, seg, cap, geom):
+        """Bounded RANGE frame boundaries via merged-sort searchsorted.
+
+        The data rows are physically sorted by (segment, null-flag,
+        order-key); query keys (value ± offset) of non-null rows are sorted
+        the same way, so one stable `lax.sort` over the 2N concatenation
+        yields every searchsorted position at once (GpuRangePartitioner-
+        style binary search, vectorized the XLA way).  Null order keys
+        frame their null peer group (Spark RANGE semantics).
+        """
+        oc = _g(geom, "ocols_sorted")[0]
+        spec: SortSpec = geom["ospecs"][0]
+        asc = spec.ascending
+        dt = oc.dtype
+        iota = geom["iota"]
+        # value-space bounds; "PRECEDING" points to the partition start so
+        # the bounds flip for descending order
+        if isinstance(dt, (T.FloatType, T.DoubleType)):
+            v = oc.data.astype(jnp.float64)
+
+            def sub(x, k):
+                return x - jnp.float64(float(k))
+
+            def add(x, k):
+                return x + jnp.float64(float(k))
+        else:
+            v = oc.data.astype(jnp.int64)
+
+            # saturating: an int64 boundary that would wrap clamps to the
+            # type extreme, which frames the same row set as the exact
+            # (unbounded-overflowing) arithmetic would
+            def sub(x, k):
+                k = int(k)
+                return jnp.where(x < _I64_MIN + k, jnp.int64(_I64_MIN),
+                                 x - jnp.int64(k))
+
+            def add(x, k):
+                k = int(k)
+                return jnp.where(x > _I64_MAX - k, jnp.int64(_I64_MAX),
+                                 x + jnp.int64(k))
+        left_val = sub(v, lo_off) if asc else add(v, lo_off)
+        right_val = add(v, hi_off) if asc else sub(v, hi_off)
+        qL = self._order_value_key(left_val, dt, asc)
+        qR = self._order_value_key(right_val, dt, asc)
+        # data keys exactly as pack_sort_keys built them
+        null_key = jnp.where(oc.validity, 0,
+                             -1 if spec.nulls_first else 1).astype(jnp.int64)
+        dk = self._order_value_key(oc.data, dt, asc)
+        dk = jnp.where(oc.validity, dk, 0)
+        segk = jnp.where(mask_s, seg.astype(jnp.int64), _I64_MAX)
+        q_segk = segk
+        q_null = jnp.zeros(cap, jnp.int64)
+        fs = self._sorted_bound(segk, null_key, dk, q_segk, q_null, qL,
+                                True, cap)
+        fe = self._sorted_bound(segk, null_key, dk, q_segk, q_null, qR,
+                                False, cap)
+        # null order keys: frame = the null peer group
+        fs = jnp.where(oc.validity, fs, _peer_first(geom))
+        fe = jnp.where(oc.validity, fe, _peer_last(geom) + 1)
+        return fs, jnp.maximum(fe, fs)
+
+    def _sorted_bound(self, dk1, dk2, dk3, qk1, qk2, qk3, left, cap):
+        """searchsorted of sorted queries into sorted data (both length cap,
+        lexicographic 3-word keys) via one merged stable sort."""
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        tie_d = jnp.full(cap, 1 if left else 0, jnp.int64)
+        tie_q = jnp.full(cap, 0 if left else 1, jnp.int64)
+        k1 = jnp.concatenate([dk1, qk1])
+        k2 = jnp.concatenate([dk2, qk2])
+        k3 = jnp.concatenate([dk3, qk3])
+        k4 = jnp.concatenate([tie_d, tie_q])
+        payload = jnp.concatenate(
+            [jnp.zeros(cap, jnp.int32), iota + 1])
+        sp = jax.lax.sort((k1, k2, k3, k4, payload), num_keys=4,
+                          is_stable=True)[-1]
+        is_q = sp > 0
+        pos = jnp.arange(2 * cap, dtype=jnp.int32)
+        csq = jnp.cumsum(is_q.astype(jnp.int32))
+        ndata_before = pos + 1 - csq
+        idx = jnp.where(is_q, sp - 1, cap)
+        return jnp.zeros(cap, jnp.int32).at[idx].set(
+            jnp.where(is_q, ndata_before, 0), mode="drop")
+
+    # -- function dispatch ---------------------------------------------------
+
     def _one_function(self, wf: WindowFunction, ctx, perm, seg, starts,
-                      ochange, pos_in_part, mask_s, cap):
+                      ochange, pos_in_part, mask_s, cap, geom):
         ones = jnp.ones(cap, jnp.bool_)
         if wf.func == "row_number":
             return pos_in_part + 1, ones
@@ -177,13 +381,9 @@ class TpuWindowExec(TpuExec):
             den = jnp.maximum(nrows - 1, 1)
             return (rank - 1).astype(jnp.float64) / den, ones
         if wf.func == "cume_dist":
-            # rows whose order key <= current = last row of the peer group
-            peer = jnp.cumsum(ochange.astype(jnp.int32)) - 1
-            peer = jnp.where(mask_s, peer, cap - 1)
-            last_pos = jax.ops.segment_max(
-                jnp.where(mask_s, pos_in_part, -1), peer, num_segments=cap)
+            last_pos = pos_in_part[_peer_last(geom)]
             nrows = self._part_sizes(seg, mask_s, pos_in_part, cap)
-            return ((last_pos[peer] + 1).astype(jnp.float64)
+            return ((last_pos + 1).astype(jnp.float64)
                     / jnp.maximum(nrows, 1)), ones
         if wf.func == "ntile":
             nb = jnp.int64(max(int(wf.buckets), 1))
@@ -197,67 +397,156 @@ class TpuWindowExec(TpuExec):
                 r + (p - big) // jnp.maximum(q, 1))
             return bucket + 1, ones
         if wf.func in ("lead", "lag"):
-            c = wf.child.eval_tpu(ctx)
-            cs = c.gather(perm)
-            off = int(wf.offset) * (1 if wf.func == "lead" else -1)
-            iota = jnp.arange(cap, dtype=jnp.int32)
-            idx = iota + off
-            inb = (idx >= 0) & (idx < cap)
-            safe = jnp.clip(idx, 0, cap - 1)
-            same_part = inb & (seg[safe] == seg) & mask_s & mask_s[safe]
-            shifted = cs.gather(safe)
-            validity = jnp.where(same_part, shifted.validity, False)
-            if wf.default is not None:
-                from spark_rapids_tpu.expr.base import Literal
+            return self._lead_lag(wf, ctx, perm, seg, mask_s, cap)
+        if wf.func in ("first_value", "last_value"):
+            return self._first_last(wf, ctx, perm, seg, mask_s, cap, geom)
+        c = wf.child.eval_tpu(ctx)
+        if c.is_string and wf.func in ("min", "max"):
+            return self._string_minmax(wf, c, perm, seg, starts, mask_s,
+                                       cap, geom)
+        return self._numeric_agg(wf, c, ctx, perm, seg, starts, mask_s,
+                                 cap, geom)
 
-                dflt = Literal(wf.default, wf.result_type).eval_tpu(ctx)
-                if cs.is_string:
-                    w = max(shifted.width, dflt.width)
-                    from spark_rapids_tpu.expr.predicates import _pad_to
+    def _lead_lag(self, wf, ctx, perm, seg, mask_s, cap):
+        c = wf.child.eval_tpu(ctx)
+        cs = c.gather(perm)
+        off = int(wf.offset) * (1 if wf.func == "lead" else -1)
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        idx = iota + off
+        inb = (idx >= 0) & (idx < cap)
+        safe = jnp.clip(idx, 0, cap - 1)
+        same_part = inb & (seg[safe] == seg) & mask_s & mask_s[safe]
+        shifted = cs.gather(safe)
+        validity = jnp.where(same_part, shifted.validity, False)
+        if wf.default is not None:
+            from spark_rapids_tpu.expr.base import Literal
 
-                    chars = jnp.where(same_part[:, None],
-                                      _pad_to(shifted.chars, w),
-                                      _pad_to(dflt.chars, w))
-                    lengths = jnp.where(same_part, shifted.lengths,
-                                        dflt.lengths)
-                    return DeviceColumn(wf.result_type,
-                                        validity | (~same_part & mask_s),
-                                        chars=chars, lengths=lengths)
-                data = jnp.where(same_part, shifted.data, dflt.data)
+            dflt = Literal(wf.default, wf.result_type).eval_tpu(ctx)
+            if cs.is_string:
+                w = max(shifted.width, dflt.width)
+                from spark_rapids_tpu.expr.predicates import _pad_to
+
+                chars = jnp.where(same_part[:, None],
+                                  _pad_to(shifted.chars, w),
+                                  _pad_to(dflt.chars, w))
+                lengths = jnp.where(same_part, shifted.lengths,
+                                    dflt.lengths)
                 return DeviceColumn(wf.result_type,
                                     validity | (~same_part & mask_s),
-                                    data=data)
-            if cs.is_string:
-                return DeviceColumn(wf.result_type, validity,
-                                    chars=shifted.chars,
-                                    lengths=shifted.lengths)
-            return DeviceColumn(wf.result_type, validity, data=shifted.data)
+                                    chars=chars, lengths=lengths)
+            data = jnp.where(same_part, shifted.data, dflt.data)
+            return DeviceColumn(wf.result_type,
+                                validity | (~same_part & mask_s),
+                                data=data)
+        if cs.is_string:
+            return DeviceColumn(wf.result_type, validity,
+                                chars=shifted.chars,
+                                lengths=shifted.lengths)
+        return DeviceColumn(wf.result_type, validity, data=shifted.data)
+
+    def _first_last(self, wf, ctx, perm, seg, mask_s, cap, geom):
+        """first_value/last_value: a frame-boundary gather (strings too)."""
         c = wf.child.eval_tpu(ctx)
-        vals = (c.data if not c.is_string else None)
-        if vals is None:
-            raise NotImplementedError("string window aggregates")
+        cs = c.gather(perm)
+        valid_s = cs.validity & mask_s
+        fs, fe = self._frame_start_end(self.frame, mask_s, seg, cap, geom)
+        nonempty = fe > fs
+        iota = geom["iota"]
+        if wf.ignore_nulls:
+            if wf.func == "first_value":
+                nxt = jax.lax.associative_scan(
+                    jnp.minimum, jnp.where(valid_s, iota, cap), reverse=True)
+                at = nxt[jnp.clip(fs, 0, cap - 1)]
+                ok = nonempty & (at <= fe - 1)
+            else:
+                prv = jax.lax.associative_scan(
+                    jnp.maximum, jnp.where(valid_s, iota, -1))
+                at = prv[jnp.clip(fe - 1, 0, cap - 1)]
+                ok = nonempty & (at >= fs)
+            at = jnp.clip(at, 0, cap - 1)
+            res = cs.gather(at)
+            return DeviceColumn(wf.result_type, ok & mask_s,
+                                data=res.data, chars=res.chars,
+                                lengths=res.lengths)
+        at = fs if wf.func == "first_value" else fe - 1
+        at = jnp.clip(at, 0, cap - 1)
+        res = cs.gather(at)
+        return DeviceColumn(wf.result_type,
+                            nonempty & res.validity & mask_s,
+                            data=res.data, chars=res.chars,
+                            lengths=res.lengths)
+
+    # -- string min/max ------------------------------------------------------
+
+    def _string_minmax(self, wf, c, perm, seg, starts, mask_s, cap, geom):
+        """Segmented lexicographic argmin/argmax scan over sort-key words,
+        then a chars gather (running / range_running / unbounded frames —
+        bounded frames fall back at tag time)."""
+        cs = c.gather(perm)
+        valid_s = cs.validity & mask_s
+        want_min = wf.func == "min"
+        words = _column_key_words(cs)
+        # leading word: invalid rows always lose the comparison
+        lead = jnp.where(valid_s, jnp.int64(0),
+                         jnp.int64(_I64_MAX if want_min else _I64_MIN))
+        iota = geom["iota"]
+        elems = (starts,) + (lead,) + tuple(words) + (iota,)
+
+        def op(a, b):
+            af, bf = a[0], b[0]
+            aw, bw = a[1:-1], b[1:-1]
+            ai, bi = a[-1], b[-1]
+            if want_min:
+                b_better = _lex_lt(bw, aw)
+            else:
+                b_better = _lex_lt(aw, bw)
+            take_b = bf | b_better
+            w = tuple(jnp.where(take_b, y, x) for x, y in zip(aw, bw))
+            return (af | bf,
+                    *w,
+                    jnp.where(take_b, bi, ai))
+
+        scanned = jax.lax.associative_scan(op, elems)
+        arg_running = scanned[-1]
+        # invalid rows always lose the comparison, so arg_running points at
+        # a valid row iff any valid row was seen in the segment prefix
+        seen = valid_s[arg_running]
+        if self.frame == "running":
+            arg, ok = arg_running, seen
+        elif self.frame == "range_running":
+            pl = _peer_last(geom)
+            arg, ok = arg_running[pl], seen[pl]
+        else:  # unbounded
+            sl = _g(geom, "seg_last")
+            arg, ok = arg_running[sl], seen[sl]
+        res = cs.gather(jnp.clip(arg, 0, cap - 1))
+        return DeviceColumn(wf.result_type, ok & mask_s,
+                            chars=res.chars, lengths=res.lengths)
+
+    # -- numeric aggregates --------------------------------------------------
+
+    def _numeric_agg(self, wf, c, ctx, perm, seg, starts, mask_s, cap, geom):
+        # count over strings has no data array — only validity matters
+        vals = c.data if not c.is_string else jnp.zeros(cap, jnp.int64)
         vals_s = vals[perm]
         valid_s = (c.validity & ctx.batch.row_mask)[perm]
         is_f = isinstance(wf.result_type, (T.FloatType, T.DoubleType))
         acc_vals = vals_s.astype(jnp.float64 if is_f else jnp.int64)
-        if isinstance(self.frame, tuple):
-            return self._bounded_frame(wf, acc_vals, valid_s, seg, mask_s,
-                                       cap, is_f)
-        if self.frame == "running":
-            if wf.func == "count":
-                _, cnt = SEG.seg_scan_sum(acc_vals, valid_s, starts)
-                return cnt, ones
-            if wf.func == "sum":
-                s, cnt = SEG.seg_scan_sum(acc_vals, valid_s, starts)
-                return s, cnt > 0
-            if wf.func == "avg":
-                s, cnt = SEG.seg_scan_sum(acc_vals, valid_s, starts)
-                return s.astype(jnp.float64) / jnp.maximum(cnt, 1), cnt > 0
-            if wf.func == "min":
-                return SEG.seg_scan_min(acc_vals, valid_s, starts, is_f)
-            if wf.func == "max":
-                return SEG.seg_scan_max(acc_vals, valid_s, starts, is_f)
-            raise NotImplementedError(wf.func)
+        frame = self.frame
+        ones = jnp.ones(cap, jnp.bool_)
+        if frame in ("running", "range_running"):
+            res, ok = self._running_agg(wf, acc_vals, valid_s, starts, is_f,
+                                        cap)
+            if frame == "range_running":
+                pl = _peer_last(geom)
+                res, ok = res[pl], ok[pl]
+            return res, ok
+        if isinstance(frame, tuple) and frame[0] == "rows":
+            return self._bounded_rows_frame(wf, acc_vals, valid_s, seg,
+                                            mask_s, cap, is_f, frame)
+        if isinstance(frame, tuple) and frame[0] == "range":
+            return self._bounded_range_frame(wf, acc_vals, valid_s, seg,
+                                             mask_s, cap, is_f, geom)
         # unbounded frame: segment totals broadcast back via seg gather
         if wf.func == "count":
             cnt = SEG.seg_count(valid_s, seg, cap)
@@ -275,12 +564,66 @@ class TpuWindowExec(TpuExec):
         if wf.func == "max":
             m, has = SEG.seg_max(acc_vals, valid_s, seg, cap, is_f)
             return m[seg], has[seg]
-        raise NotImplementedError(wf.func)
+        # variance family — two-pass (mean, then Σ(x−μ)²); the Σx² identity
+        # loses everything to cancellation when |x| ≫ stddev
+        x = acc_vals.astype(jnp.float64)
+        cnt = SEG.seg_count(valid_s, seg, cap)
+        s, _ = SEG.seg_sum(jnp.where(valid_s, x, 0.0), valid_s, seg, cap)
+        mean = s / jnp.maximum(cnt, 1)
+        d = jnp.where(valid_s, x - mean[seg], 0.0)
+        m2, _ = SEG.seg_sum(d * d, valid_s, seg, cap)
+        res, ok = self._var_from_m2(wf.func, m2, cnt.astype(jnp.float64))
+        return res[seg], ok[seg]
 
-    def _bounded_frame(self, wf, acc_vals, valid_s, seg, mask_s, cap, is_f):
+    def _running_agg(self, wf, acc_vals, valid_s, starts, is_f, cap):
+        ones = jnp.ones(cap, jnp.bool_)
+        if wf.func == "count":
+            _, cnt = SEG.seg_scan_sum(acc_vals, valid_s, starts)
+            return cnt, ones
+        if wf.func == "sum":
+            s, cnt = SEG.seg_scan_sum(acc_vals, valid_s, starts)
+            return s, cnt > 0
+        if wf.func == "avg":
+            s, cnt = SEG.seg_scan_sum(acc_vals, valid_s, starts)
+            return s.astype(jnp.float64) / jnp.maximum(cnt, 1), cnt > 0
+        if wf.func == "min":
+            return SEG.seg_scan_min(acc_vals, valid_s, starts, is_f)
+        if wf.func == "max":
+            return SEG.seg_scan_max(acc_vals, valid_s, starts, is_f)
+        # variance family — segmented associative scan of Chan (n, mean, M2)
+        # triples (the merge is associative, so lax.associative_scan applies;
+        # numerically stable where a running Σx² would cancel)
+        x = acc_vals.astype(jnp.float64)
+        n0 = jnp.where(valid_s, 1.0, 0.0)
+        m0 = jnp.where(valid_s, x, 0.0)
+        z = jnp.zeros(cap, jnp.float64)
+
+        def op(a, b):
+            af, an, am, am2 = a
+            bf, bn, bm, bm2 = b
+            n, mean, m2 = _chan_merge(an, am, am2, bn, bm, bm2)
+            return (af | bf,
+                    jnp.where(bf, bn, n),
+                    jnp.where(bf, bm, mean),
+                    jnp.where(bf, bm2, m2))
+
+        _, n, _, m2 = jax.lax.associative_scan(op, (starts, n0, m0, z))
+        return self._var_from_m2(wf.func, m2, n)
+
+    def _var_from_m2(self, func, m2, n):
+        """var/stddev from Σ(x−μ)² and n — Spark nullOnDivideByZero: samp
+        with n<=1 (and anything with n==0) yields NULL; pop w/ n==1 is 0."""
+        den = n if func.endswith("pop") else n - 1.0
+        ok = den > 0.0
+        var = jnp.maximum(m2, 0.0) / jnp.where(ok, den, 1.0)
+        res = var if func.startswith("var") else jnp.sqrt(var)
+        return res, ok
+
+    def _bounded_rows_frame(self, wf, acc_vals, valid_s, seg, mask_s, cap,
+                            is_f, frame):
         """ROWS BETWEEN a PRECEDING AND b FOLLOWING via statically unrolled
         shifted combines (window width is a plan-time constant)."""
-        a, b = self.frame
+        _, a, b = frame
         iota = jnp.arange(cap, dtype=jnp.int32)
         total = jnp.zeros(cap, acc_vals.dtype)
         cnt = jnp.zeros(cap, jnp.int64)
@@ -325,4 +668,136 @@ class TpuWindowExec(TpuExec):
             return mn, has
         if wf.func == "max":
             return mx, has
-        raise NotImplementedError(f"bounded frame {wf.func}")
+        # variance: second unrolled pass over deviations from the frame
+        # mean — two-pass conditioning, same as the oracle
+        mean = total.astype(jnp.float64) / jnp.maximum(cnt, 1)
+        m2 = jnp.zeros(cap, jnp.float64)
+        for d in range(-int(a), int(b) + 1):
+            idx = iota + d
+            inb = (idx >= 0) & (idx < cap)
+            safe = jnp.clip(idx, 0, cap - 1)
+            ok = inb & (seg[safe] == seg) & mask_s & mask_s[safe] \
+                & valid_s[safe]
+            dev = acc_vals[safe].astype(jnp.float64) - mean
+            m2 = m2 + jnp.where(ok, dev * dev, 0.0)
+        return self._var_from_m2(wf.func, m2, cnt.astype(jnp.float64))
+
+    def _build_levels(self, base, merge, ident, cap):
+        """Doubling level tables: level k at i = merge over [i, i+2^k)
+        (identity-padded past the end).  Shared by the block-decomposition
+        query and the idempotent sparse-table min/max query."""
+        iota32 = jnp.arange(cap, dtype=jnp.int32)
+        L = max(1, (cap - 1).bit_length())
+        levels = [base]
+        for k in range(1, L + 1):
+            prev = levels[-1]
+            shift = 1 << (k - 1)
+            idx = jnp.minimum(iota32 + shift, cap - 1)
+            inb = iota32 + shift < cap
+            shifted = tuple(
+                jnp.where(inb, p[idx], jnp.asarray(iv, p.dtype))
+                for p, iv in zip(prev, ident))
+            levels.append(merge(prev, shifted))
+        return levels, L
+
+    def _range_block_merge(self, base, merge, ident, fs, fe, cap):
+        """Aggregate tuples over per-row [fs, fe) ranges via binary block
+        decomposition of the range: level-k tables hold the merge of
+        [i, i+2^k), and each query greedily consumes the bits of its width
+        high-to-low — at most L+1 merges per row, no global prefix sums
+        (a single inf/overflow row would poison every later frame through
+        prefix-difference cancellation)."""
+        levels, L = self._build_levels(base, merge, ident, cap)
+        acc = tuple(jnp.full(cap, iv, b.dtype) for b, iv in zip(base, ident))
+        pos = fs
+        rem = fe - fs
+        for k in range(L, -1, -1):
+            size = jnp.int32(1 << k)
+            take = rem >= size
+            at = jnp.clip(pos, 0, cap - 1)
+            blk = tuple(lv[at] for lv in levels[k])
+            merged = merge(acc, blk)
+            acc = tuple(jnp.where(take, m, a) for m, a in zip(merged, acc))
+            pos = pos + jnp.where(take, size, 0)
+            rem = rem - jnp.where(take, size, 0)
+        return acc
+
+    def _bounded_range_frame(self, wf, acc_vals, valid_s, seg, mask_s, cap,
+                             is_f, geom):
+        """Bounded RANGE frame aggregates over the per-row contiguous
+        [fs, fe) ranges: prefix-sum differences for integer sum/count
+        (modular wrap cancels exactly), block-decomposed stable merges for
+        float sums and variance (Chan's pairwise update), and a doubling
+        sparse table for min/max."""
+        fs, fe = self._frame_start_end(self.frame, mask_s, seg, cap, geom)
+        x = acc_vals
+
+        def pref(arr):
+            return jnp.concatenate([jnp.zeros((1,), arr.dtype),
+                                    jnp.cumsum(arr)])
+
+        pcnt = pref(valid_s.astype(jnp.int64))
+        cnt = pcnt[fe] - pcnt[fs]
+        has = cnt > 0
+        if wf.func == "count":
+            return cnt, jnp.ones(cap, jnp.bool_)
+        if wf.func in ("sum", "avg"):
+            if x.dtype == jnp.int64:
+                psum = pref(jnp.where(valid_s, x, jnp.zeros_like(x)))
+                total = psum[fe] - psum[fs]
+            else:
+                def add(a, b):
+                    return (a[0] + b[0],)
+
+                (total,) = self._range_block_merge(
+                    (jnp.where(valid_s, x, jnp.zeros_like(x)),),
+                    add, (0.0,), fs, fe, cap)
+            if wf.func == "sum":
+                return total, has
+            return total.astype(jnp.float64) / jnp.maximum(cnt, 1), has
+        if wf.func in _VAR_FUNCS:
+            xf = x.astype(jnp.float64)
+            base = (valid_s.astype(jnp.float64),
+                    jnp.where(valid_s, xf, 0.0),
+                    jnp.zeros(cap, jnp.float64))
+
+            def chan(a, b):
+                return _chan_merge(*a, *b)
+
+            n, _, m2 = self._range_block_merge(
+                base, chan, (0.0, 0.0, 0.0), fs, fe, cap)
+            return self._var_from_m2(wf.func, m2, n)
+        # min/max over variable contiguous ranges: sparse table
+        want_min = wf.func == "min"
+        if is_f:
+            nanmask = valid_s & jnp.isnan(x)
+            usable = valid_s & ~jnp.isnan(x)
+            ident = jnp.inf if want_min else -jnp.inf
+            base = jnp.where(usable, x, ident)
+            pnan = pref(nanmask.astype(jnp.int64))
+            pnonnan = pref(usable.astype(jnp.int64))
+        else:
+            ident = (jnp.iinfo(x.dtype).max if want_min
+                     else jnp.iinfo(x.dtype).min)
+            base = jnp.where(valid_s, x, ident)
+        combine = jnp.minimum if want_min else jnp.maximum
+        levels, L = self._build_levels(
+            (base,), lambda a, b: (combine(a[0], b[0]),), (ident,), cap)
+        stacked = jnp.stack([lv[0] for lv in levels])  # (L+1, cap)
+        w = fe - fs
+        k = jnp.zeros(cap, jnp.int32)
+        for j in range(1, L + 1):
+            k = k + (w >= (1 << j)).astype(jnp.int32)
+        span = jnp.left_shift(jnp.int32(1), k)
+        i1 = jnp.clip(fs, 0, cap - 1)
+        i2 = jnp.clip(fe - span, 0, cap - 1)
+        m = combine(stacked[k, i1], stacked[k, i2])
+        m = jnp.where(w > 0, m, jnp.asarray(ident, base.dtype))
+        if is_f:
+            n_nan = pnan[fe] - pnan[fs]
+            n_nonnan = pnonnan[fe] - pnonnan[fs]
+            if want_min:
+                m = jnp.where(has & (n_nonnan == 0), jnp.nan, m)
+            else:
+                m = jnp.where(n_nan > 0, jnp.nan, m)
+        return m, has

@@ -189,6 +189,17 @@ def _elem_eq(x: jax.Array, y: jax.Array, dtype: T.DataType) -> jax.Array:
     return x == y
 
 
+def _dup_map_keys(kdata, live, kt) -> jax.Array:
+    """Row mask: any duplicate among the live key elements (pairwise
+    equality over the lower triangle) — Spark's EXCEPTION dedup policy."""
+    w = max(int(kdata.shape[1]), 1)
+    return jnp.any(
+        _elem_eq(kdata[:, :, None], kdata[:, None, :], kt)
+        & live[:, :, None] & live[:, None, :]
+        & jnp.tril(jnp.ones((w, w), jnp.bool_), k=-1)[None],
+        axis=(1, 2))
+
+
 def _compact_elems(data, ev, keep):
     """Per-row stable compaction of kept elements to the front."""
     order = jnp.argsort(~keep, axis=1, stable=True)
@@ -595,11 +606,9 @@ class CreateMap(Expression):
         ctx.add_error(jnp.any(~kvalid, axis=1),
                       "Cannot use null as map key")
         kt = self.children[0].dataType
-        dup = jnp.any(
-            _elem_eq(kdata[:, :, None], kdata[:, None, :], kt)
-            & jnp.tril(jnp.ones((len(ks), len(ks)), jnp.bool_), k=-1)[None],
-            axis=(1, 2))
-        ctx.add_error(dup, "Duplicate map key was found")
+        ctx.add_error(
+            _dup_map_keys(kdata, jnp.ones_like(kvalid), kt),
+            "Duplicate map key was found")
         n = len(ks)
         lengths = jnp.full(cap, n, jnp.int32)
         keys_col = DeviceColumn(T.ArrayType(kt, containsNull=False),
@@ -662,3 +671,157 @@ class GetMapValue(BinaryExpression):
                                  axis=1)[:, 0]
         validity = m.validity & key.validity & found & ev
         return DeviceColumn(self.dataType, validity, data=data)
+
+
+class MapFromArrays(BinaryExpression):
+    """map_from_arrays(keys, values): lengths must match; null/duplicate
+    keys raise (Spark EXCEPTION dedup policy) via the error flags."""
+
+    def _resolve_type(self):
+        kt = self.left.dataType.elementType
+        vt = self.right.dataType.elementType
+        self._dataType = T.MapType(kt, vt)
+        self._nullable = self.left.nullable or self.right.nullable
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        ka, va = cols
+        cap = ka.capacity
+        valid = ka.validity & va.validity
+        ctx.add_error(valid & (ka.lengths != va.lengths),
+                      "key and value arrays must have the same length")
+        kt = self.left.dataType.elementType
+        inl = _in_len(ka)
+        live = ka.elem_valid & inl
+        ctx.add_error(valid & jnp.any(inl & ~ka.elem_valid, axis=1),
+                      "Cannot use null as map key")
+        ctx.add_error(valid & _dup_map_keys(ka.data, live, kt),
+                      "Duplicate map key was found")
+        keys = DeviceColumn(T.ArrayType(kt, containsNull=False),
+                            valid, data=ka.data, lengths=ka.lengths,
+                            elem_valid=live)
+        vals = DeviceColumn(T.ArrayType(self.right.dataType.elementType),
+                            valid, data=va.data, lengths=ka.lengths,
+                            elem_valid=va.elem_valid & inl)
+        return DeviceColumn(self.dataType, valid, children=(keys, vals))
+
+
+class MapConcat(Expression):
+    """map_concat(m1, m2, ...): entry concatenation; duplicate keys across
+    inputs raise (Spark EXCEPTION dedup policy)."""
+
+    def __init__(self, children: List[Expression]):
+        super().__init__(list(children))
+
+    def sql_string(self):
+        return ("map_concat("
+                + ", ".join(c.sql_string() for c in self.children) + ")")
+
+    def _resolve_type(self):
+        self._dataType = self.children[0].dataType
+        self._nullable = any(c.nullable for c in self.children)
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        kt = self.dataType.keyType
+        valid = cols[0].validity
+        for c in cols[1:]:
+            valid = valid & c.validity
+        kparts, vparts, kevs, vevs = [], [], [], []
+        for m in cols:
+            kcol, vcol = m.children
+            inl = _in_len(kcol)
+            kparts.append(kcol.data)
+            vparts.append(vcol.data)
+            kevs.append(kcol.elem_valid & inl)
+            vevs.append(vcol.elem_valid & inl)
+        kd = jnp.concatenate(kparts, axis=1)
+        vd = jnp.concatenate(vparts, axis=1)
+        kev = jnp.concatenate(kevs, axis=1)
+        vev = jnp.concatenate(vevs, axis=1)
+        # compact the live entries left so lengths/data line up
+        kd2, kev2, lengths = _compact_elems(kd, kev, kev)
+        vd2, vev2, _ = _compact_elems(vd, vev & kev, kev)
+        w = max(kd2.shape[1], 1)
+        pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+        live = pos < lengths[:, None]
+        ctx.add_error(valid & _dup_map_keys(kd2, live, kt),
+                      "Duplicate map key was found")
+        keys = DeviceColumn(T.ArrayType(kt, containsNull=False), valid,
+                            data=kd2, lengths=lengths, elem_valid=kev2)
+        vals = DeviceColumn(T.ArrayType(self.dataType.valueType), valid,
+                            data=vd2, lengths=lengths, elem_valid=vev2)
+        return DeviceColumn(self.dataType, valid, children=(keys, vals))
+
+
+class MapContainsKey(BinaryExpression):
+    """map_contains_key(m, key)."""
+
+    def _resolve_type(self):
+        self._dataType = T.BOOLEAN
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        m, key = cols
+        kcol, _ = m.children
+        kt = self.left.dataType.keyType
+        inl = _in_len(kcol)
+        eq = (_elem_eq(kcol.data, key.data[:, None], kt)
+              & kcol.elem_valid & inl)
+        return DeviceColumn(T.BOOLEAN, m.validity & key.validity,
+                            data=jnp.any(eq, axis=1))
+
+
+class ArrayCompact(UnaryExpression):
+    """array_compact(arr): drops null elements."""
+
+    def _resolve_type(self):
+        et = self.child.dataType.elementType
+        self._dataType = T.ArrayType(et, containsNull=False)
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        arr = cols[0]
+        keep = arr.elem_valid & _in_len(arr)
+        data, ev, lengths = _compact_elems(arr.data, arr.elem_valid, keep)
+        return DeviceColumn(self.dataType, arr.validity, data=data,
+                            lengths=lengths, elem_valid=ev)
+
+
+class _ArrayAppendBase(BinaryExpression):
+    prepend = False
+
+    def _resolve_type(self):
+        self._dataType = T.ArrayType(self.left.dataType.elementType)
+        self._nullable = self.left.nullable
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        arr, elem = cols
+        cap = arr.capacity
+        w = arr.ewidth + 1
+        if arr.ewidth == 0:
+            sdt = T.storage_dtype(self.dataType.elementType)
+            data0 = jnp.zeros((cap, 0), sdt)
+            ev0 = jnp.zeros((cap, 0), jnp.bool_)
+        else:
+            data0, ev0 = arr.data, arr.elem_valid & _in_len(arr)
+        pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+        if self.prepend:
+            data = jnp.concatenate([elem.data[:, None], data0], axis=1)
+            ev = jnp.concatenate([elem.validity[:, None], ev0], axis=1)
+        else:
+            data = jnp.pad(data0, ((0, 0), (0, 1)))
+            ev = jnp.pad(ev0, ((0, 0), (0, 1)))
+            at = pos == arr.lengths[:, None]
+            data = jnp.where(at, elem.data[:, None], data)
+            ev = jnp.where(at, elem.validity[:, None], ev)
+        return DeviceColumn(self.dataType, arr.validity, data=data,
+                            lengths=arr.lengths + 1, elem_valid=ev)
+
+
+class ArrayAppend(_ArrayAppendBase):
+    """array_append(arr, elem) — null elements append as null entries."""
+
+
+class ArrayPrepend(_ArrayAppendBase):
+    """array_prepend(arr, elem)."""
+
+    prepend = True

@@ -14,7 +14,8 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.column import DeviceColumn
-from spark_rapids_tpu.expr.base import BinaryExpression, UnaryExpression
+from spark_rapids_tpu.expr.base import (BinaryExpression, Expression,
+                                        UnaryExpression)
 from spark_rapids_tpu.expr.cast import civil_from_days, days_from_civil
 
 _US_PER_DAY = 86_400_000_000
@@ -463,3 +464,234 @@ class ToUTCTimestamp(_UtcTzShift):
     resolution matches java.time (forward shift / earlier offset)."""
 
     _to_utc = True
+
+
+class ToUnixTimestamp(UnixTimestamp):
+    """to_unix_timestamp — same device kernel as unix_timestamp."""
+
+
+class WeekDay(_DateField):
+    """weekday(date): Monday=0 ... Sunday=6."""
+
+    def _field(self, y, m, d, days):
+        return (days + 3) % 7
+
+
+class MakeDate(Expression):
+    """make_date(y, m, d) — invalid civil dates yield NULL (ANSI: error).
+
+    Reference analog: GpuMakeDate (datetimeExpressions.scala)."""
+
+    def __init__(self, y, m, d):
+        super().__init__([y, m, d])
+
+    def _resolve_type(self):
+        self._dataType = T.DATE
+        self._nullable = True
+
+    def sql_string(self):
+        return ("make_date("
+                + ", ".join(c.sql_string() for c in self.children) + ")")
+
+    def do_columnar_eval(self, ctx, cols):
+        y, m, d = (c.data.astype(jnp.int64) for c in cols)
+        days = days_from_civil(y, m, d)
+        y2, m2, d2 = civil_from_days(days)
+        ok = ((y2 == y) & (m2 == m) & (d2 == d)
+              & (y >= 1) & (y <= 9999))
+        validity = cols[0].validity & cols[1].validity & cols[2].validity
+        if ctx.ansi:
+            ctx.add_error(~ok & validity, "invalid date in make_date (ANSI)")
+        else:
+            validity = validity & ok
+        return DeviceColumn(T.DATE, validity,
+                            data=days.astype(jnp.int32))
+
+
+class MakeTimestamp(Expression):
+    """make_timestamp(y, m, d, h, min, sec) in the UTC session timezone;
+    sec is integral or fractional (micros kept exactly for decimals)."""
+
+    def __init__(self, y, m, d, h, mi, s):
+        super().__init__([y, m, d, h, mi, s])
+
+    def _resolve_type(self):
+        self._dataType = T.TIMESTAMP
+        self._nullable = True
+
+    def sql_string(self):
+        return ("make_timestamp("
+                + ", ".join(c.sql_string() for c in self.children) + ")")
+
+    def do_columnar_eval(self, ctx, cols):
+        y, m, d, h, mi = (c.data.astype(jnp.int64) for c in cols[:5])
+        sec_col = cols[5]
+        st = self.children[5].dataType
+        if isinstance(st, T.DecimalType):
+            micros_in_sec = (sec_col.data.astype(jnp.int64)
+                             * (10 ** (6 - st.scale)))
+        elif isinstance(st, (T.FloatType, T.DoubleType)):
+            micros_in_sec = jnp.round(
+                sec_col.data.astype(jnp.float64) * 1e6).astype(jnp.int64)
+        else:
+            micros_in_sec = sec_col.data.astype(jnp.int64) * 1_000_000
+        days = days_from_civil(y, m, d)
+        y2, m2, d2 = civil_from_days(days)
+        # Spark: seconds==60 rolls to the next minute only when exactly 60
+        ok = ((y2 == y) & (m2 == m) & (d2 == d) & (y >= 1) & (y <= 9999)
+              & (h >= 0) & (h <= 23) & (mi >= 0) & (mi <= 59)
+              & (micros_in_sec >= 0) & (micros_in_sec <= 60_000_000))
+        micros = (days * _US_PER_DAY + h * 3_600_000_000
+                  + mi * 60_000_000 + micros_in_sec)
+        validity = cols[0].validity
+        for c in cols[1:]:
+            validity = validity & c.validity
+        if ctx.ansi:
+            ctx.add_error(~ok & validity,
+                          "invalid timestamp in make_timestamp (ANSI)")
+        else:
+            validity = validity & ok
+        return DeviceColumn(T.TIMESTAMP, validity, data=micros)
+
+
+class _CapturedNow(Expression):
+    """Base for current_date()/current_timestamp(): the instant is captured
+    when the expression is constructed (Spark: once per query at analysis),
+    so every row — and every batch — sees the same value."""
+
+    def __init__(self):
+        super().__init__([])
+        import time
+
+        self.captured_micros = int(time.time() * 1_000_000)
+
+    def sql_string(self):
+        return f"{self.pretty_name.lower()}()"
+
+
+class CurrentDate(_CapturedNow):
+    def _resolve_type(self):
+        self._dataType = T.DATE
+        self._nullable = False
+
+    def do_columnar_eval(self, ctx, cols):
+        cap = ctx.batch.capacity
+        days = self.captured_micros // _US_PER_DAY
+        return DeviceColumn(T.DATE, jnp.ones(cap, jnp.bool_),
+                            data=jnp.full(cap, days, jnp.int32))
+
+
+class CurrentTimestamp(_CapturedNow):
+    def _resolve_type(self):
+        self._dataType = T.TIMESTAMP
+        self._nullable = False
+
+    def do_columnar_eval(self, ctx, cols):
+        cap = ctx.batch.capacity
+        return DeviceColumn(T.TIMESTAMP, jnp.ones(cap, jnp.bool_),
+                            data=jnp.full(cap, self.captured_micros,
+                                          jnp.int64))
+
+
+class TimestampSeconds(UnaryExpression):
+    """timestamp_seconds(n) — integral or fractional seconds -> ts."""
+
+    def _resolve_type(self):
+        self._dataType = T.TIMESTAMP
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        st = self.child.dataType
+        if isinstance(st, (T.FloatType, T.DoubleType)):
+            f = c.data.astype(jnp.float64) * 1e6
+            ok = jnp.isfinite(f) & (jnp.abs(f) < 2.0 ** 63)
+            data = jnp.round(f).astype(jnp.int64)
+            validity = c.validity & ok
+            return DeviceColumn(T.TIMESTAMP, validity, data=data)
+        v = c.data.astype(jnp.int64)
+        ok = (v >= -9223372036854) & (v <= 9223372036854)
+        data = v * 1_000_000
+        if ctx.ansi:
+            ctx.add_error(~ok & c.validity,
+                          "timestamp_seconds overflow (ANSI)")
+            return DeviceColumn(T.TIMESTAMP, c.validity, data=data)
+        return DeviceColumn(T.TIMESTAMP, c.validity & ok, data=data)
+
+
+class TimestampMillis(UnaryExpression):
+    def _resolve_type(self):
+        self._dataType = T.TIMESTAMP
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        v = c.data.astype(jnp.int64)
+        ok = (v >= -9223372036854775) & (v <= 9223372036854775)
+        return DeviceColumn(T.TIMESTAMP, c.validity & ok, data=v * 1_000)
+
+
+class TimestampMicros(UnaryExpression):
+    def _resolve_type(self):
+        self._dataType = T.TIMESTAMP
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        return DeviceColumn(T.TIMESTAMP, c.validity,
+                            data=c.data.astype(jnp.int64))
+
+
+class UnixDate(UnaryExpression):
+    """unix_date(date) -> days since epoch (int)."""
+
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        return DeviceColumn(T.INT, c.validity,
+                            data=c.data.astype(jnp.int32))
+
+
+class DateFromUnixDate(UnaryExpression):
+    """date_from_unix_date(days)."""
+
+    def _resolve_type(self):
+        self._dataType = T.DATE
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        return DeviceColumn(T.DATE, c.validity,
+                            data=c.data.astype(jnp.int32))
+
+
+class _UnixExtract(UnaryExpression):
+    """unix_seconds/millis/micros(ts) — floorDiv like Spark's
+    DateTimeUtils."""
+
+    _div = 1
+
+    def _resolve_type(self):
+        self._dataType = T.LONG
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        v = c.data.astype(jnp.int64)
+        data = jnp.floor_divide(v, self._div) if self._div != 1 else v
+        return DeviceColumn(T.LONG, c.validity, data=data)
+
+
+class UnixSeconds(_UnixExtract):
+    _div = 1_000_000
+
+
+class UnixMillis(_UnixExtract):
+    _div = 1_000
+
+
+class UnixMicros(_UnixExtract):
+    _div = 1

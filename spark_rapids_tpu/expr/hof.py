@@ -253,3 +253,229 @@ class ArrayAggregate(Expression):
                 f"{self.children[1].sql_string()}, "
                 f"({self.acc_name}, {self.var_name}) -> "
                 f"{self.merge.sql_string()})")
+
+
+class MapHigherOrderFunction(Expression):
+    """Base: one map child + a lambda body over (outer cols, key, value).
+
+    Reference analog: GpuTransformKeys/GpuTransformValues/GpuMapFilter
+    (higherOrderFunctions.scala).  Same flatten trick as the array HOFs:
+    the aligned key/value element matrices flatten into a (cap*ewidth)
+    pseudo-batch with two lambda columns."""
+
+    def __init__(self, m: Expression, key_name: str, val_name: str,
+                 body: Expression):
+        super().__init__([m])
+        self.key_name = key_name
+        self.val_name = val_name
+        self.body = body
+
+    @property
+    def m(self):
+        return self.children[0]
+
+    def sql_string(self):
+        return (f"{self.pretty_name.lower()}({self.m.sql_string()}, "
+                f"({self.key_name}, {self.val_name}) -> "
+                f"{self.body.sql_string()})")
+
+    def resolve(self, schema: T.StructType) -> Expression:
+        self.children = [c.resolve(schema) for c in self.children]
+        mt = self.m.dataType
+        ext = T.StructType(
+            list(schema.fields)
+            + [T.StructField(self.key_name, mt.keyType, False),
+               T.StructField(self.val_name, mt.valueType, True)])
+        self.body = self.body.resolve(ext)
+        self._resolve_type()
+        self.resolved = True
+        return self
+
+    def collect(self, pred):
+        out = super().collect(pred)
+        out.extend(self.body.collect(pred))
+        return out
+
+    def _eval_body(self, ctx: EvalContext, m: DeviceColumn):
+        kcol, vcol = m.children
+        cap, w = kcol.capacity, max(kcol.ewidth, 1)
+        inl = _in_len(kcol)
+        mt = self.m.dataType
+        ek = DeviceColumn(mt.keyType, (kcol.elem_valid & inl).reshape(-1),
+                          data=kcol.data.reshape(cap * w))
+        ev = DeviceColumn(mt.valueType, (vcol.elem_valid & inl).reshape(-1),
+                          data=vcol.data.reshape(cap * w))
+        outer = [_repeat_col(c, w) for c in ctx.batch.columns]
+        ext = T.StructType(
+            list(ctx.batch.schema.fields)
+            + [T.StructField(self.key_name, mt.keyType, False),
+               T.StructField(self.val_name, mt.valueType, True)])
+        flat = ColumnarBatch(outer + [ek, ev], cap * w, ext)
+        sub = EvalContext(flat, ansi=ctx.ansi, error_flags=ctx.error_flags)
+        res = self.body.eval_tpu(sub)
+        return res, inl
+
+
+class TransformKeys(MapHigherOrderFunction):
+    """transform_keys(m, (k, v) -> f): new keys must be non-null and
+    duplicate-free (Spark's EXCEPTION dedup policy) — checked via the
+    batch error flags like CreateMap."""
+
+    def _resolve_type(self):
+        mt = self.m.dataType
+        self._dataType = T.MapType(self.body.dataType, mt.valueType)
+        self._nullable = self.m.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        m = cols[0]
+        kcol, vcol = m.children
+        cap, w = kcol.capacity, max(kcol.ewidth, 1)
+        res, inl = self._eval_body(ctx, m)
+        nk = res.data.reshape(cap, w)
+        nk_valid = res.validity.reshape(cap, w)
+        live = kcol.elem_valid & inl
+        ctx.add_error(m.validity & jnp.any(live & ~nk_valid, axis=1),
+                      "Cannot use null as map key")
+        from spark_rapids_tpu.expr.collections import _dup_map_keys
+
+        ctx.add_error(
+            m.validity & _dup_map_keys(nk, live & nk_valid,
+                                       self.body.dataType),
+            "Duplicate map key was found")
+        keys = DeviceColumn(T.ArrayType(self.body.dataType,
+                                        containsNull=False),
+                            kcol.validity, data=nk, lengths=kcol.lengths,
+                            elem_valid=live)
+        return DeviceColumn(self.dataType, m.validity,
+                            children=(keys, vcol))
+
+
+class TransformValues(MapHigherOrderFunction):
+    """transform_values(m, (k, v) -> f)."""
+
+    def _resolve_type(self):
+        mt = self.m.dataType
+        self._dataType = T.MapType(mt.keyType, self.body.dataType)
+        self._nullable = self.m.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        m = cols[0]
+        kcol, vcol = m.children
+        cap, w = kcol.capacity, max(kcol.ewidth, 1)
+        res, inl = self._eval_body(ctx, m)
+        nv = res.data.reshape(cap, w)
+        nv_valid = res.validity.reshape(cap, w) & kcol.elem_valid & inl
+        vals = DeviceColumn(T.ArrayType(self.body.dataType), vcol.validity,
+                            data=nv, lengths=vcol.lengths,
+                            elem_valid=nv_valid)
+        return DeviceColumn(self.dataType, m.validity,
+                            children=(kcol, vals))
+
+
+class MapFilter(MapHigherOrderFunction):
+    """map_filter(m, (k, v) -> pred): keeps entries where pred is TRUE."""
+
+    def _resolve_type(self):
+        self._dataType = self.m.dataType
+        self._nullable = self.m.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        m = cols[0]
+        kcol, vcol = m.children
+        cap, w = kcol.capacity, max(kcol.ewidth, 1)
+        res, inl = self._eval_body(ctx, m)
+        keep = (res.data.reshape(cap, w) & res.validity.reshape(cap, w)
+                & kcol.elem_valid & inl)
+        kd, kev, lengths = _compact_elems(kcol.data, kcol.elem_valid, keep)
+        vd, vev, _ = _compact_elems(vcol.data, vcol.elem_valid, keep)
+        keys = DeviceColumn(kcol.dtype, kcol.validity, data=kd,
+                            lengths=lengths, elem_valid=kev)
+        vals = DeviceColumn(vcol.dtype, vcol.validity, data=vd,
+                            lengths=lengths, elem_valid=vev)
+        return DeviceColumn(self.dataType, m.validity,
+                            children=(keys, vals))
+
+
+class ZipWith(Expression):
+    """zip_with(a, b, (x, y) -> f): zips to the LONGER array; the shorter
+    side contributes nulls (Spark semantics)."""
+
+    def __init__(self, left: Expression, right: Expression,
+                 x_name: str, y_name: str, body: Expression):
+        super().__init__([left, right])
+        self.x_name = x_name
+        self.y_name = y_name
+        self.body = body
+
+    def sql_string(self):
+        return (f"zip_with({self.children[0].sql_string()}, "
+                f"{self.children[1].sql_string()}, "
+                f"({self.x_name}, {self.y_name}) -> "
+                f"{self.body.sql_string()})")
+
+    def resolve(self, schema: T.StructType) -> Expression:
+        self.children = [c.resolve(schema) for c in self.children]
+        ext = T.StructType(
+            list(schema.fields)
+            + [T.StructField(self.x_name,
+                             self.children[0].dataType.elementType, True),
+               T.StructField(self.y_name,
+                             self.children[1].dataType.elementType, True)])
+        self.body = self.body.resolve(ext)
+        self._resolve_type()
+        self.resolved = True
+        return self
+
+    def collect(self, pred):
+        out = super().collect(pred)
+        out.extend(self.body.collect(pred))
+        return out
+
+    def _resolve_type(self):
+        self._dataType = T.ArrayType(self.body.dataType)
+        self._nullable = (self.children[0].nullable
+                          or self.children[1].nullable)
+
+    def do_columnar_eval(self, ctx, cols):
+        a, b = cols
+        cap = a.capacity
+        w = max(a.ewidth, b.ewidth, 1)
+
+        def pad(c):
+            if c.ewidth == w:
+                return c.data, c.elem_valid
+            pw = w - c.ewidth
+            if c.ewidth == 0:
+                sdt = T.storage_dtype(c.dtype.elementType)
+                return (jnp.zeros((cap, w), sdt),
+                        jnp.zeros((cap, w), jnp.bool_))
+            return (jnp.pad(c.data, ((0, 0), (0, pw))),
+                    jnp.pad(c.elem_valid, ((0, 0), (0, pw))))
+
+        ad, aev = pad(a)
+        bd, bev = pad(b)
+        out_len = jnp.maximum(a.lengths, b.lengths)
+        pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+        inl = pos < out_len[:, None]
+        in_a = pos < a.lengths[:, None]
+        in_b = pos < b.lengths[:, None]
+        ex = DeviceColumn(self.children[0].dataType.elementType,
+                          (aev & in_a & inl).reshape(-1),
+                          data=ad.reshape(cap * w))
+        ey = DeviceColumn(self.children[1].dataType.elementType,
+                          (bev & in_b & inl).reshape(-1),
+                          data=bd.reshape(cap * w))
+        outer = [_repeat_col(c, w) for c in ctx.batch.columns]
+        ext = T.StructType(
+            list(ctx.batch.schema.fields)
+            + [T.StructField(self.x_name,
+                             self.children[0].dataType.elementType, True),
+               T.StructField(self.y_name,
+                             self.children[1].dataType.elementType, True)])
+        flat = ColumnarBatch(outer + [ex, ey], cap * w, ext)
+        sub = EvalContext(flat, ansi=ctx.ansi, error_flags=ctx.error_flags)
+        res = self.body.eval_tpu(sub)
+        data = res.data.reshape(cap, w)
+        ev = res.validity.reshape(cap, w) & inl
+        return DeviceColumn(self.dataType, a.validity & b.validity,
+                            data=data, lengths=out_len, elem_valid=ev)

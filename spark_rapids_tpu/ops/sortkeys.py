@@ -52,6 +52,20 @@ def _float_total_order(bits: jax.Array) -> jax.Array:
                      bits ^ jnp.int64(0x7FFFFFFFFFFFFFFF), bits)
 
 
+def float_order_key(vals: jax.Array) -> jax.Array:
+    """float array -> monotone int64 key with Spark normalization: -0.0
+    keys with 0.0, every NaN bit pattern collapses to one canonical key
+    (and sorts greatest).  Shared by sort-key packing and the window
+    RANGE-frame searchsorted, which must match the physical sort order
+    bit-for-bit."""
+    d = vals.astype(jnp.float64)
+    d = jnp.where(d == 0.0, 0.0, d)
+    bits = d.view(jnp.int64)
+    canonical_nan = jnp.int64(0x7FF8000000000000)
+    bits = jnp.where(jnp.isnan(d), canonical_nan, bits)
+    return _float_total_order(bits)
+
+
 def _column_key_words(c: DeviceColumn) -> List[jax.Array]:
     """int64 key word list for ASC NULLS-handled-separately ordering."""
     dt = c.dtype
@@ -72,14 +86,7 @@ def _column_key_words(c: DeviceColumn) -> List[jax.Array]:
             words.append(acc)
         return words
     if isinstance(dt, (T.FloatType, T.DoubleType)):
-        d = c.data.astype(jnp.float64)
-        # Spark normalization: -0.0 keys with 0.0; every NaN bit pattern is
-        # the same key (and sorts greatest)
-        d = jnp.where(d == 0.0, 0.0, d)
-        bits = d.view(jnp.int64)
-        canonical_nan = jnp.int64(0x7FF8000000000000)
-        bits = jnp.where(jnp.isnan(d), canonical_nan, bits)
-        return [_float_total_order(bits)]
+        return [float_order_key(c.data)]
     if isinstance(dt, T.BooleanType):
         return [c.data.astype(jnp.int64)]
     if isinstance(dt, T.DecimalType) and dt.is_128:

@@ -607,6 +607,27 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
             T.StringType,
             "UTC session timezone; years 0001-9999 render correctly"),
         extra_check=_check_time_format),
+    DT.ToUnixTimestamp: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.WeekDay: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.MakeDate: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.MakeTimestamp: ExprRule(
+        T.DATETIME_SIG + T.INTEGRAL_SIG + T.FP_SIG + T.DECIMAL_64_SIG),
+    DT.CurrentDate: ExprRule(
+        T.DATETIME_SIG.with_note(
+            T.DateType, "captured once per query (UTC session timezone)")),
+    DT.CurrentTimestamp: ExprRule(
+        T.DATETIME_SIG.with_note(
+            T.TimestampType,
+            "captured once per query (UTC session timezone)")),
+    DT.TimestampSeconds: ExprRule(
+        T.DATETIME_SIG + T.INTEGRAL_SIG + T.FP_SIG),
+    DT.TimestampMillis: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.TimestampMicros: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.UnixSeconds: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.UnixMillis: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.UnixMicros: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.UnixDate: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.DateFromUnixDate: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
     H.Murmur3Hash: ExprRule(_COMMON128, desc="Spark murmur3 hash"),
     H.XxHash64: ExprRule(_COMMON128, desc="Spark xxhash64"),
     H.BloomFilterMightContain: ExprRule(
@@ -653,6 +674,17 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
     CL.MapKeys: ExprRule(_WITH_MAPS),
     CL.MapValues: ExprRule(_WITH_MAPS),
     CL.GetMapValue: ExprRule(_WITH_MAPS),
+    CL.MapFromArrays: ExprRule(_WITH_MAPS),
+    CL.MapConcat: ExprRule(_WITH_MAPS),
+    CL.MapContainsKey: ExprRule(_WITH_MAPS),
+    CL.ArrayCompact: ExprRule(_WITH_ARRAYS),
+    CL.ArrayAppend: ExprRule(_WITH_ARRAYS),
+    CL.ArrayPrepend: ExprRule(_WITH_ARRAYS),
+    HOF.TransformKeys: ExprRule(_WITH_MAPS, extra_check=_check_hof),
+    HOF.TransformValues: ExprRule(_WITH_MAPS, extra_check=_check_hof),
+    HOF.MapFilter: ExprRule(_WITH_MAPS + T.BOOLEAN_SIG,
+                            extra_check=_check_hof),
+    HOF.ZipWith: ExprRule(_WITH_ARRAYS, extra_check=_check_hof),
     U.UserDefinedExpression: ExprRule(
         _DEC128_FULL, extra_check=_check_udf,
         desc="TpuUDF (RapidsUDF analog): columnar jax kernel"),
@@ -777,7 +809,12 @@ def _agg_extra_checks(meta: SparkPlanMeta, a) -> None:
                 "[64, 4194304]")
 _WINDOW_FUNCS_SUPPORTED = {"row_number", "rank", "dense_rank", "sum", "count",
                            "min", "max", "avg", "lead", "lag", "ntile",
-                           "percent_rank", "cume_dist"}
+                           "percent_rank", "cume_dist", "first_value",
+                           "last_value", "var_pop", "var_samp", "stddev_pop",
+                           "stddev_samp"}
+# frame-independent ranking/navigation functions
+_WINDOW_RANK_FUNCS = {"row_number", "rank", "dense_rank", "ntile",
+                      "percent_rank", "cume_dist", "lead", "lag"}
 # bounded ROWS frames unroll shifted combines; cap the static window width
 _MAX_BOUNDED_WINDOW = 256
 _JOIN_TYPES_SUPPORTED = {PN.JoinType.INNER, PN.JoinType.LEFT_OUTER,
@@ -841,24 +878,70 @@ def _join_check(meta: SparkPlanMeta):
 
 
 def _window_check(meta: SparkPlanMeta):
+    """Tag-or-fallback for every (function, frame, type) combination the
+    exec supports (GpuWindowExec tagging analog).  Anything rejected here is
+    unreachable in exec/window.py — the RapidsMeta contract is that no
+    NotImplementedError fires after conversion."""
     plan: PN.Window = meta.plan
+    frame = plan.frame
+    bounded = isinstance(frame, tuple)
     for f in plan.functions:
         if f.func not in _WINDOW_FUNCS_SUPPORTED:
             meta.will_not_work_on_tpu(
                 f"window function {f.func} is not supported on TPU")
-        if (f.func not in ("lead", "lag") and f.child is not None
-                and isinstance(f.child._dataType, T.StringType)):
+            continue
+        if f.func in _WINDOW_RANK_FUNCS:
+            continue
+        ct = f.child._dataType if f.child is not None else None
+        if ct is not None and isinstance(ct, (T.ArrayType, T.MapType,
+                                              T.StructType)):
             meta.will_not_work_on_tpu(
-                "string-valued window aggregates not supported on TPU")
-    if isinstance(plan.frame, tuple):
-        a, b = plan.frame
+                f"{f.func} over nested-typed window inputs is not "
+                f"supported on TPU")
+        if ct is not None and isinstance(ct, T.DecimalType) and ct.is_128 \
+                and f.func != "count":
+            meta.will_not_work_on_tpu(
+                f"{f.func} over decimals above 18 digits in a window is "
+                f"not supported on TPU")
+        if ct is not None and isinstance(ct, T.DecimalType) \
+                and (f.func == "avg" or f.func.startswith(("var", "stddev"))):
+            meta.will_not_work_on_tpu(
+                f"window {f.func} over decimals yields a decimal result "
+                f"(needs decimal division); not supported on TPU")
+        if isinstance(ct, T.StringType):
+            if f.func in ("sum", "avg") or f.func.startswith(("var", "stddev")):
+                meta.will_not_work_on_tpu(
+                    f"{f.func} over strings is not valid")
+            elif f.func in ("min", "max") and bounded:
+                meta.will_not_work_on_tpu(
+                    "string min/max over bounded window frames is not "
+                    "supported on TPU (running/range/unbounded frames only)")
+    if bounded:
+        kind, a, b = frame
         if a < 0 or b < 0:
             meta.will_not_work_on_tpu(
                 "bounded window frame offsets must be non-negative")
-        elif a + b + 1 > _MAX_BOUNDED_WINDOW:
+        elif kind == "rows" and a + b + 1 > _MAX_BOUNDED_WINDOW:
             meta.will_not_work_on_tpu(
                 f"bounded window width {a + b + 1} exceeds the TPU unroll "
                 f"cap ({_MAX_BOUNDED_WINDOW})")
+        if kind == "range":
+            if len(plan.order_by) != 1:
+                meta.will_not_work_on_tpu(
+                    "RANGE window frames require exactly one ORDER BY key")
+            else:
+                ot = plan.order_by[0][0]._dataType
+                ok = (ot.is_integral
+                      or isinstance(ot, (T.FloatType, T.DoubleType,
+                                         T.DateType, T.TimestampType)))
+                if not ok:
+                    meta.will_not_work_on_tpu(
+                        f"RANGE window frames over {ot.simpleString} order "
+                        f"keys are not supported on TPU")
+    if frame in ("range_running",) or (bounded and frame[0] == "range"):
+        if not plan.order_by:
+            meta.will_not_work_on_tpu(
+                "RANGE window frames require an ORDER BY")
 
 
 def _scan_check(meta: SparkPlanMeta):

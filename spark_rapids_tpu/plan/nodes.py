@@ -535,7 +535,7 @@ class WindowFunction:
     """window function spec: func over (partition, order, frame).
 
     lead/lag carry ``offset`` (+ optional literal ``default``); ntile
-    carries ``buckets``."""
+    carries ``buckets``; first_value/last_value carry ``ignore_nulls``."""
 
     func: str                      # row_number, rank, dense_rank, sum, ...
     child: Optional[Expression]
@@ -544,6 +544,7 @@ class WindowFunction:
     offset: int = 1                # lead/lag
     default: Optional[object] = None   # lead/lag literal default
     buckets: int = 2               # ntile
+    ignore_nulls: bool = False     # first_value/last_value
 
     def resolve(self, schema):
         if self.child is not None:
@@ -562,11 +563,36 @@ class WindowFunction:
                 self.result_type = T.LONG
             else:
                 self.result_type = T.DOUBLE
-        elif self.func == "avg":
+        elif self.func in ("avg", "var_pop", "var_samp",
+                           "stddev_pop", "stddev_samp"):
             self.result_type = T.DOUBLE
         else:
             self.result_type = self.child.dataType
         return self
+
+
+def normalize_frame(frame):
+    """Canonical window-frame forms (GpuSpecifiedWindowFrame analog):
+
+      "running"        ROWS  UNBOUNDED PRECEDING .. CURRENT ROW
+      "range_running"  RANGE UNBOUNDED PRECEDING .. CURRENT ROW (Spark's
+                       default frame when ORDER BY is present — includes
+                       the current row's order-key peers)
+      "unbounded"      the whole partition
+      ("rows", a, b)   ROWS  BETWEEN a PRECEDING AND b FOLLOWING
+      ("range", a, b)  RANGE BETWEEN a PRECEDING AND b FOLLOWING over a
+                       single numeric order key
+
+    A bare (a, b) tuple is legacy shorthand for ("rows", a, b)."""
+    if isinstance(frame, tuple):
+        if len(frame) == 2:
+            return ("rows", frame[0], frame[1])
+        if len(frame) == 3 and frame[0] in ("rows", "range"):
+            return frame
+        raise ValueError(f"bad window frame {frame!r}")
+    if frame not in ("running", "range_running", "unbounded"):
+        raise ValueError(f"bad window frame {frame!r}")
+    return frame
 
 
 class Window(SparkPlan):
@@ -579,7 +605,7 @@ class Window(SparkPlan):
         self.functions = functions
         self.partition_by = partition_by
         self.order_by = order_by
-        self.frame = frame  # "running" | "unbounded" | (lo, hi) bounded rows
+        self.frame = normalize_frame(frame)  # see normalize_frame
 
     @property
     def child(self):

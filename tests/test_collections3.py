@@ -1,0 +1,134 @@
+"""Round-3 collection breadth: map HOFs, zip_with, map constructors,
+array append/compact (reference: higher_order_functions_test.py,
+map_test.py, collection_ops_test.py)."""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.collections import (
+    ArrayAppend,
+    ArrayCompact,
+    ArrayPrepend,
+    MapConcat,
+    MapContainsKey,
+    MapFromArrays,
+)
+from spark_rapids_tpu.expr.hof import (
+    MapFilter,
+    TransformKeys,
+    TransformValues,
+    ZipWith,
+)
+from spark_rapids_tpu.session import col, lit
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import ArrayGen, IntegerGen, gen_df
+
+_small_int = IntegerGen(min_val=-3, max_val=3)
+_arr = ArrayGen(_small_int)
+
+
+def _map_df(s, n=200):
+    data = {"m": [{1: 10, 2: 20, 3: None}, None, {}, {5: 50, -1: -10},
+                  {7: 70}] * (n // 5)}
+    schema = T.StructType([T.StructField("m", T.MapType(T.INT, T.LONG))])
+    return s.create_dataframe(data, schema)
+
+
+def test_transform_keys():
+    def build(s):
+        df = _map_df(s)
+        return df.select(
+            TransformKeys(col("m"), "k", "v",
+                          col("k") * lit(10)).alias("t"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_transform_values():
+    def build(s):
+        df = _map_df(s)
+        return df.select(
+            TransformValues(col("m"), "k", "v",
+                            col("v") + col("k")).alias("t"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_map_filter():
+    def build(s):
+        df = _map_df(s)
+        return df.select(
+            MapFilter(col("m"), "k", "v", col("k") > lit(1)).alias("t"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_zip_with():
+    def build(s):
+        df = gen_df(s, [_arr, _arr], ["a", "b"], length=300)
+        return df.select(
+            ZipWith(col("a"), col("b"), "x", "y",
+                    col("x") + col("y")).alias("z"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_zip_with_unequal_lengths():
+    def build(s):
+        data = {"a": [[1, 2, 3], [1], None, []] * 50,
+                "b": [[10], [10, 20, 30, 40], [1], None] * 50}
+        schema = T.StructType([
+            T.StructField("a", T.ArrayType(T.INT)),
+            T.StructField("b", T.ArrayType(T.INT))])
+        df = s.create_dataframe(data, schema)
+        return df.select(
+            ZipWith(col("a"), col("b"), "x", "y",
+                    col("x") * lit(100) + col("y")).alias("z"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_map_from_arrays():
+    def build(s):
+        data = {"k": [[1, 2], [3], [], None] * 50,
+                "v": [[10, 20], [30], [], [1]] * 50}
+        schema = T.StructType([
+            T.StructField("k", T.ArrayType(T.INT, containsNull=False)),
+            T.StructField("v", T.ArrayType(T.INT))])
+        df = s.create_dataframe(data, schema)
+        return df.select(MapFromArrays(col("k"), col("v")).alias("m"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_map_concat():
+    def build(s):
+        data = {"m1": [{1: 10}, None, {2: 20, 3: 30}, {}] * 50,
+                "m2": [{4: 40}, {5: 50}, {}, {6: 60, 7: 70}] * 50}
+        mt = T.MapType(T.INT, T.LONG)
+        schema = T.StructType([T.StructField("m1", mt),
+                               T.StructField("m2", mt)])
+        df = s.create_dataframe(data, schema)
+        return df.select(MapConcat([col("m1"), col("m2")]).alias("m"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_map_contains_key():
+    def build(s):
+        df = _map_df(s)
+        return df.select(MapContainsKey(col("m"), lit(2)).alias("c2"),
+                         MapContainsKey(col("m"), lit(9)).alias("c9"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_array_compact_append_prepend():
+    def build(s):
+        df = gen_df(s, [_arr, _small_int.with_nullable(True)], ["a", "v"],
+                    length=300)
+        return df.select(ArrayCompact(col("a")).alias("c"),
+                         ArrayAppend(col("a"), col("v")).alias("ap"),
+                         ArrayPrepend(col("a"), col("v")).alias("pp"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)

@@ -163,3 +163,109 @@ def test_date_format_unsupported_pattern_falls_back():
         return df.select(DateFormat(col("ts"), lit("yyyy-MM-dd EEE")).alias("r"))
 
     assert_tpu_fallback_collect(build, "Project")
+
+
+# -- round 3: make_date/make_timestamp, unix units, current_* --------------
+
+
+def test_make_date():
+    from spark_rapids_tpu.expr.datetime import MakeDate
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=1, max_val=9999),
+                        IntegerGen(min_val=0, max_val=13),
+                        IntegerGen(min_val=0, max_val=32)],
+                    ["y", "m", "d"], length=300)
+        return df.select(MakeDate(col("y"), col("m"), col("d")).alias("dt"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_make_timestamp():
+    from spark_rapids_tpu.expr.datetime import MakeTimestamp
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=1900, max_val=2100),
+                        IntegerGen(min_val=1, max_val=12),
+                        IntegerGen(min_val=1, max_val=31),
+                        IntegerGen(min_val=0, max_val=24),
+                        IntegerGen(min_val=0, max_val=60),
+                        IntegerGen(min_val=0, max_val=61)],
+                    ["y", "mo", "d", "h", "mi", "s"], length=300)
+        return df.select(MakeTimestamp(col("y"), col("mo"), col("d"),
+                                       col("h"), col("mi"),
+                                       col("s")).alias("ts"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_weekday_to_unix_timestamp():
+    from spark_rapids_tpu.expr.datetime import ToUnixTimestamp, WeekDay
+
+    def build(s):
+        df = gen_df(s, [DateGen(), TimestampGen()], ["d", "t"], length=300)
+        return df.select(WeekDay(col("d")).alias("wd"),
+                         ToUnixTimestamp(col("t")).alias("ut"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_timestamp_unit_constructors():
+    from spark_rapids_tpu.expr.datetime import (TimestampMicros,
+                                                TimestampMillis,
+                                                TimestampSeconds)
+
+    def build(s):
+        df = gen_df(s, [LongGen(min_val=-10**10, max_val=10**10),
+                        LongGen()], ["n", "big"], length=300)
+        return df.select(TimestampSeconds(col("n")).alias("ts"),
+                         TimestampMillis(col("n")).alias("tm"),
+                         TimestampMicros(col("n")).alias("tu"),
+                         TimestampSeconds(col("big")).alias("ts_ovf"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_unix_unit_extractors():
+    from spark_rapids_tpu.expr.datetime import (DateFromUnixDate, UnixDate,
+                                                UnixMicros, UnixMillis,
+                                                UnixSeconds)
+
+    def build(s):
+        df = gen_df(s, [TimestampGen(), DateGen(),
+                        IntegerGen(min_val=-100000, max_val=100000)],
+                    ["t", "d", "n"], length=300)
+        return df.select(UnixSeconds(col("t")).alias("us"),
+                         UnixMillis(col("t")).alias("um"),
+                         UnixMicros(col("t")).alias("uu"),
+                         UnixDate(col("d")).alias("ud"),
+                         DateFromUnixDate(col("n")).alias("df"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_current_date_timestamp():
+    """current_* capture one instant per query; CPU/TPU runs happen within
+    seconds of each other, so current_date matches (midnight-crossing runs
+    excepted) and current_timestamp is range-checked."""
+    import time
+
+    from spark_rapids_tpu.expr.datetime import (CurrentDate,
+                                                CurrentTimestamp)
+    from spark_rapids_tpu.session import TpuSession
+
+    def build(s):
+        df = gen_df(s, [IntegerGen()], ["x"], length=10)
+        return df.select(CurrentDate().alias("cd"),
+                         CurrentTimestamp().alias("ct"))
+
+    rows = build(TpuSession({"spark.rapids.sql.enabled": True})).collect()
+    now = time.time()
+    import datetime as pydt
+
+    epoch = pydt.datetime(1970, 1, 1, tzinfo=pydt.timezone.utc)
+    for cd, ct in rows:
+        if ct.tzinfo is None:
+            ct = ct.replace(tzinfo=pydt.timezone.utc)
+        assert abs((ct - epoch).total_seconds() - now) < 120
+        assert cd == pydt.datetime.now(pydt.timezone.utc).date()

@@ -144,3 +144,354 @@ def test_wide_bounded_frame_falls_back():
         return _wdf(s, [WindowFunction("sum", col("v"), "sv")], (300, 300))
 
     assert_tpu_fallback_collect(build, "Window")
+
+
+# -- round 3: RANGE frames, string min/max, variance, first/last_value ------
+
+
+def test_range_running_default_frame():
+    """Spark's default frame with ORDER BY: RANGE UNBOUNDED
+    PRECEDING..CURRENT ROW — order-key peers are included."""
+    def build(s):
+        # few distinct order values -> many peer groups
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=3),
+                        IntegerGen(min_val=0, max_val=8),
+                        IntegerGen(min_val=-50, max_val=50)],
+                    ["p", "o", "v"], length=250)
+        return df.window([WindowFunction("sum", col("v"), "sv"),
+                          WindowFunction("count", col("v"), "cv"),
+                          WindowFunction("min", col("v"), "mn"),
+                          WindowFunction("max", col("v"), "mx")],
+                         partition_by=["p"],
+                         order_by=[(col("o"), SortSpec())],
+                         frame="range_running")
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("lo,hi", [(0, 0), (2, 3), (5, 0), (0, 7)],
+                         ids=lambda v: str(v))
+def test_bounded_range_frames(lo, hi):
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=3),
+                        IntegerGen(min_val=0, max_val=30),
+                        IntegerGen(min_val=-50, max_val=50)],
+                    ["p", "o", "v"], length=250)
+        return df.window([WindowFunction("sum", col("v"), "sv"),
+                          WindowFunction("count", col("v"), "cv"),
+                          WindowFunction("avg", col("v"), "av"),
+                          WindowFunction("min", col("v"), "mn"),
+                          WindowFunction("max", col("v"), "mx")],
+                         partition_by=["p"],
+                         order_by=[(col("o"), SortSpec())],
+                         frame=("range", lo, hi))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_bounded_range_desc():
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=3),
+                        IntegerGen(min_val=0, max_val=30),
+                        IntegerGen(min_val=-50, max_val=50)],
+                    ["p", "o", "v"], length=200)
+        return df.window([WindowFunction("sum", col("v"), "sv"),
+                          WindowFunction("min", col("v"), "mn")],
+                         partition_by=["p"],
+                         order_by=[(col("o"), SortSpec(ascending=False))],
+                         frame=("range", 4, 2))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_bounded_range_null_order_keys():
+    """Null order keys frame exactly their null peer group."""
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=2),
+                        IntegerGen(min_val=0, max_val=10, null_prob=0.3),
+                        IntegerGen(min_val=-9, max_val=9)],
+                    ["p", "o", "v"], length=200)
+        return df.window([WindowFunction("sum", col("v"), "sv"),
+                          WindowFunction("count", col("v"), "cv")],
+                         partition_by=["p"],
+                         order_by=[(col("o"), SortSpec())],
+                         frame=("range", 1, 1))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_bounded_range_double_order():
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=3),
+                        DoubleGen(no_nans=True),
+                        IntegerGen(min_val=-50, max_val=50)],
+                    ["p", "o", "v"], length=200)
+        return df.window([WindowFunction("sum", col("v"), "sv"),
+                          WindowFunction("max", col("v"), "mx")],
+                         partition_by=["p"],
+                         order_by=[(col("o"), SortSpec())],
+                         frame=("range", 10.5, 3.25))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_bounded_range_minmax_double_values():
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=3),
+                        IntegerGen(min_val=0, max_val=30),
+                        DoubleGen()], ["p", "o", "v"], length=200)
+        return df.window([WindowFunction("min", col("v"), "mn"),
+                          WindowFunction("max", col("v"), "mx")],
+                         partition_by=["p"],
+                         order_by=[(col("o"), SortSpec())],
+                         frame=("range", 3, 3))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("frame", ["running", "range_running", "unbounded"])
+def test_string_min_max_windows(frame):
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=3),
+                        IntegerGen(min_val=0, max_val=1000),
+                        StringGen(min_len=0, max_len=8)],
+                    ["p", "o", "v"], length=200)
+        return df.window([WindowFunction("min", col("v"), "mn"),
+                          WindowFunction("max", col("v"), "mx")],
+                         partition_by=["p"],
+                         order_by=[(col("o"), SortSpec())], frame=frame)
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("frame", ["running", "unbounded", ("rows", 2, 2),
+                                   ("range", 3, 3)],
+                         ids=lambda f: str(f))
+def test_window_variance(frame):
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=3),
+                        IntegerGen(min_val=0, max_val=30),
+                        DoubleGen(no_nans=True)], ["p", "o", "v"],
+                    length=200)
+        return df.window([WindowFunction("var_pop", col("v"), "vp"),
+                          WindowFunction("var_samp", col("v"), "vs"),
+                          WindowFunction("stddev_pop", col("v"), "sp"),
+                          WindowFunction("stddev_samp", col("v"), "ss")],
+                         partition_by=["p"],
+                         order_by=[(col("o"), SortSpec())], frame=frame)
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+@pytest.mark.parametrize("frame", ["running", "range_running", "unbounded",
+                                   ("rows", 1, 2), ("range", 2, 2)],
+                         ids=lambda f: str(f))
+@pytest.mark.parametrize("ignore_nulls", [False, True])
+def test_first_last_value(frame, ignore_nulls):
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=3),
+                        IntegerGen(min_val=0, max_val=30),
+                        IntegerGen(min_val=-50, max_val=50, null_prob=0.3)],
+                    ["p", "o", "v"], length=200)
+        return df.window(
+            [WindowFunction("first_value", col("v"), "fv",
+                            ignore_nulls=ignore_nulls),
+             WindowFunction("last_value", col("v"), "lv",
+                            ignore_nulls=ignore_nulls)],
+            partition_by=["p"],
+            order_by=[(col("o"), SortSpec())], frame=frame)
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_first_last_value_strings():
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=3),
+                        IntegerGen(min_val=0, max_val=1000),
+                        StringGen(min_len=0, max_len=6)],
+                    ["p", "o", "v"], length=150)
+        return df.window(
+            [WindowFunction("first_value", col("v"), "fv"),
+             WindowFunction("last_value", col("v"), "lv",
+                            ignore_nulls=True)],
+            partition_by=["p"],
+            order_by=[(col("o"), SortSpec())], frame="range_running")
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_string_minmax_bounded_falls_back():
+    from asserts import assert_tpu_fallback_collect
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=3),
+                        IntegerGen(min_val=0, max_val=1000),
+                        StringGen(min_len=1, max_len=4)],
+                    ["p", "o", "v"], length=50)
+        return df.window([WindowFunction("min", col("v"), "mn")],
+                         partition_by=["p"],
+                         order_by=[(col("o"), SortSpec())],
+                         frame=("rows", 1, 1))
+
+    assert_tpu_fallback_collect(build, "Window")
+
+
+def test_range_frame_decimal_order_falls_back():
+    from asserts import assert_tpu_fallback_collect
+    from data_gen import DecimalGen
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=3),
+                        DecimalGen(precision=9, scale=2),
+                        IntegerGen(min_val=-9, max_val=9)],
+                    ["p", "o", "v"], length=50)
+        return df.window([WindowFunction("sum", col("v"), "sv")],
+                         partition_by=["p"],
+                         order_by=[(col("o"), SortSpec())],
+                         frame=("range", 1, 1))
+
+    assert_tpu_fallback_collect(build, "Window")
+
+
+def test_dec128_window_agg_falls_back():
+    from asserts import assert_tpu_fallback_collect
+    from data_gen import DecimalGen
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=3),
+                        IntegerGen(min_val=0, max_val=1000),
+                        DecimalGen(precision=28, scale=3)],
+                    ["p", "o", "v"], length=50)
+        return df.window([WindowFunction("min", col("v"), "mn")],
+                         partition_by=["p"],
+                         order_by=[(col("o"), SortSpec())])
+
+    assert_tpu_fallback_collect(build, "Window")
+
+
+def test_count_over_strings():
+    """count(string_col) is a validity count — must not hit the string
+    min/max path (regression: review r3)."""
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=3),
+                        IntegerGen(min_val=0, max_val=1000),
+                        StringGen(min_len=0, max_len=5)],
+                    ["p", "o", "v"], length=150)
+        return df.window([WindowFunction("count", col("v"), "cv")],
+                         partition_by=["p"],
+                         order_by=[(col("o"), SortSpec())], frame="running")
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_bounded_range_nan_order_keys():
+    """NaN order keys frame exactly their NaN peers on both backends."""
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=2),
+                        DoubleGen(),  # includes NaN/inf specials
+                        IntegerGen(min_val=-9, max_val=9)],
+                    ["p", "o", "v"], length=200)
+        return df.window([WindowFunction("sum", col("v"), "sv"),
+                          WindowFunction("count", col("v"), "cv")],
+                         partition_by=["p"],
+                         order_by=[(col("o"), SortSpec())],
+                         frame=("range", 1.5, 1.5))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("frame", ["running", "unbounded", ("rows", 2, 2),
+                                   ("range", 2, 2)],
+                         ids=lambda f: str(f))
+def test_window_variance_large_offset(frame):
+    """Values ~1e9 with variance ~1: the Σx² identity would cancel to 0;
+    Chan/two-pass keeps ~15 good digits (regression: review r3)."""
+    import numpy as np
+
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.column import HostColumn
+    from spark_rapids_tpu.plan.nodes import LocalTableScan
+    from spark_rapids_tpu.session import DataFrame
+
+    def build(s):
+        n = 64
+        rng = np.random.default_rng(7)
+        p = HostColumn.from_numpy(rng.integers(0, 3, n), T.INT)
+        o = HostColumn.from_numpy(np.arange(n) % 16, T.INT)
+        v = HostColumn.from_numpy(1e9 + rng.standard_normal(n), T.DOUBLE)
+        schema = T.StructType([T.StructField("p", T.INT),
+                               T.StructField("o", T.INT),
+                               T.StructField("v", T.DOUBLE)])
+        df = DataFrame(LocalTableScan([p, o, v], schema), s)
+        return df.window([WindowFunction("var_samp", col("v"), "vs"),
+                          WindowFunction("stddev_pop", col("v"), "sp")],
+                         partition_by=["p"],
+                         order_by=[(col("o"), SortSpec())], frame=frame)
+
+    # mean ~1e9, stddev ~1: m2 conditioning caps agreement at ~7 digits —
+    # what matters is it is not 0 (the sum-of-squares identity collapses)
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True,
+                                         float_digits=5)
+
+
+def test_bounded_range_int64_extremes():
+    """Order keys at the int64 extremes: boundary arithmetic saturates
+    instead of wrapping (regression: review r3)."""
+    import numpy as np
+
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.column import HostColumn
+    from spark_rapids_tpu.plan.nodes import LocalTableScan
+    from spark_rapids_tpu.session import DataFrame
+
+    I64 = 9223372036854775807
+
+    def build(s):
+        o = np.array([I64, I64 - 5, I64 - 20, -I64 - 1, -I64 + 3, 0, 7],
+                     np.int64)
+        v = np.arange(7, dtype=np.int64)
+        p = np.zeros(7, np.int64)
+        schema = T.StructType([T.StructField("p", T.LONG),
+                               T.StructField("o", T.LONG),
+                               T.StructField("v", T.LONG)])
+        df = DataFrame(LocalTableScan(
+            [HostColumn.from_numpy(p, T.LONG),
+             HostColumn.from_numpy(o, T.LONG),
+             HostColumn.from_numpy(v, T.LONG)], schema), s)
+        return df.window([WindowFunction("count", col("v"), "cv"),
+                          WindowFunction("sum", col("v"), "sv")],
+                         partition_by=["p"],
+                         order_by=[(col("o"), SortSpec())],
+                         frame=("range", 10, 10))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_range_running_nan_peers():
+    """Duplicate NaN order keys are peers of each other (Spark ordering
+    treats NaN == NaN); regression for the oracle's tuple-equality peers."""
+    import numpy as np
+
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.column import HostColumn
+    from spark_rapids_tpu.plan.nodes import LocalTableScan
+    from spark_rapids_tpu.session import DataFrame
+
+    def build(s):
+        o = np.array([1.0, np.nan, np.nan, 2.0, np.nan], np.float64)
+        v = np.array([1, 10, 100, 1000, 10000], np.int64)
+        p = np.zeros(5, np.int64)
+        schema = T.StructType([T.StructField("p", T.LONG),
+                               T.StructField("o", T.DOUBLE),
+                               T.StructField("v", T.LONG)])
+        df = DataFrame(LocalTableScan(
+            [HostColumn.from_numpy(p, T.LONG),
+             HostColumn.from_numpy(o, T.DOUBLE),
+             HostColumn.from_numpy(v, T.LONG)], schema), s)
+        return df.window([WindowFunction("sum", col("v"), "sv"),
+                          WindowFunction("rank", None, "rk")],
+                         partition_by=["p"],
+                         order_by=[(col("o"), SortSpec())],
+                         frame="range_running")
+
+    assert_tpu_and_cpu_are_equal_collect(build)
