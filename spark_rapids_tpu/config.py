@@ -199,7 +199,8 @@ ENABLE_CAST_STRING_TO_FLOAT = conf(
 
 ENABLE_CAST_STRING_TO_TIMESTAMP = conf(
     "spark.rapids.sql.castStringToTimestamp.enabled").doc(
-    "String->timestamp cast compat switch.").boolean_conf(False)
+    "String->timestamp cast compat switch (device civil parser; named "
+    "timezones parse as null).").boolean_conf(True)
 
 ENABLE_FLOAT_AGG = conf("spark.rapids.sql.castFloatToDecimal.enabled").doc(
     "Float->decimal cast compat switch.").boolean_conf(True)
@@ -314,6 +315,26 @@ MESH_ENABLED = conf("spark.rapids.tpu.mesh.enabled").doc(
     "final-agg stage pair compiles to ONE collective program per batch "
     "(scan shards rows, all-to-all repartitions by key hash over the "
     "interconnect).").boolean_conf(False)
+
+SINGLE_DEVICE_SHUFFLE_COALESCE = conf(
+    "spark.rapids.tpu.shuffle.singleDeviceCoalesce").doc(
+    "On a single device with the host shuffle, collapse hash/round-robin "
+    "exchanges to ONE partition (an AQE-style partition coalesce: per-"
+    "partition program launches are pure overhead without a second chip; "
+    "aggregation/join results are partition-count independent)."
+).boolean_conf(True)
+
+MESH_DEVICES = conf("spark.rapids.tpu.mesh.devices").doc(
+    "Number of mesh devices for ICI stages (0 = all visible devices).  "
+    "Non-power-of-2 counts are supported; capacities pad to multiples of "
+    "the device count.").integer_conf(0)
+
+MESH_EPOCH_BYTES = conf("spark.rapids.tpu.mesh.epochTargetBytes").doc(
+    "Input bytes gathered into one mesh collective epoch.  ICI stages "
+    "stream the child's batches through the SPMD program in epochs of "
+    "roughly this size instead of concatenating the whole input, so "
+    "per-device memory stays bounded by (epoch shard + accumulator/build "
+    "state).").integer_conf(1 << 28)
 
 SHUFFLE_MT_WRITER_THREADS = conf(
     "spark.rapids.shuffle.multiThreaded.writer.threads").integer_conf(20)

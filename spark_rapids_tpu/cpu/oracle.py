@@ -739,6 +739,108 @@ class _CastNull(Exception):
     pass
 
 
+_TS_TIME_RE = None
+
+
+def _civil_days_py(y, m, d):
+    """Hinnant days-from-civil (python ints; years beyond 9999 fine)."""
+    yy = y - (1 if m <= 2 else 0)
+    era = (yy if yy >= 0 else yy - 399) // 400
+    yoe = yy - era * 400
+    mp = m + (-3 if m > 2 else 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _civil_valid_py(y, m, d):
+    if not (1 <= m <= 12 and d >= 1 and 1 <= y <= 9999):
+        return False
+    if m == 12:
+        ml = _civil_days_py(y + 1, 1, 1) - _civil_days_py(y, 12, 1)
+    else:
+        ml = _civil_days_py(y, m + 1, 1) - _civil_days_py(y, m, 1)
+    return d <= ml
+
+
+def _parse_civil_py(s):
+    """Oracle twin of cast._parse_civil_string: returns (days, tail) or
+    None.  Grammar: [y]yyyy[-[m]m[-[d]d<tail>]]."""
+    import re as _re
+
+    m = _re.match(r"^(\d{4,6})(?:-(\d{1,2})(?:-(\d{1,2})(.*))?)?$", s,
+                  _re.S)
+    if not m:
+        return None
+    y = int(m.group(1))
+    mo = int(m.group(2)) if m.group(2) else 1
+    d = int(m.group(3)) if m.group(3) else 1
+    tail = m.group(4) if m.group(4) is not None else ""
+    if not _civil_valid_py(y, mo, d):
+        return None
+    return _civil_days_py(y, mo, d), tail, m.group(3) is not None
+
+
+def _str_to_date_py(sv):
+    r = _parse_civil_py(str(sv).strip())
+    if r is None:
+        return None
+    days, tail, had_day = r
+    if tail and not (had_day and tail[0] in " T"):
+        return None
+    return days
+
+
+_TS_TAIL_RE = None
+
+
+def _str_to_ts_py(sv):
+    """Oracle twin of cast._string_to_timestamp (same documented subset)."""
+    import re as _re
+
+    global _TS_TAIL_RE
+    if _TS_TAIL_RE is None:
+        _TS_TAIL_RE = _re.compile(
+            r"^[ T](\d{1,2})(?::(\d{1,2})(?::(\d{1,2})"
+            r"(?:\.(\d{1,9}))?)?)?"
+            r"(Z|z|[+-](?:\d{4}|\d{1,2}(?::\d{2})?))?$")
+    r = _parse_civil_py(str(sv).strip())
+    if r is None:
+        return None
+    days, tail, _ = r
+    micros = days * 86_400_000_000
+    if not tail:
+        return micros
+    m = _TS_TAIL_RE.match(tail)
+    if not m:
+        return None
+    h = int(m.group(1))
+    mi = int(m.group(2)) if m.group(2) else 0
+    s = int(m.group(3)) if m.group(3) else 0
+    if h > 23 or mi > 59 or s > 59:
+        return None
+    frac = m.group(4) or ""
+    frac_us = (int(frac) * 10 ** (6 - len(frac)) if len(frac) <= 6
+               else int(frac) // 10 ** (len(frac) - 6)) if frac else 0
+    off = 0
+    tz = m.group(5)
+    if tz and tz not in ("Z", "z"):
+        sign = 1 if tz[0] == "+" else -1
+        body = tz[1:]
+        if ":" in body:
+            hh, mm = body.split(":")
+        elif len(body) == 4:
+            hh, mm = body[:2], body[2:]
+        else:
+            hh, mm = body, "0"
+        hh, mm = int(hh), int(mm)
+        if hh > 18 or mm > 59 or hh * 60 + mm > 18 * 60:
+            return None
+        off = sign * (hh * 3600 + mm * 60)
+    return (micros + h * 3_600_000_000 + mi * 60_000_000 + s * 1_000_000
+            + frac_us - off * 1_000_000)
+
+
 def _cast_one(v, src: T.DataType, dst: T.DataType, ansi: bool):
     import decimal as pydec
 
@@ -829,17 +931,21 @@ def _cast_one(v, src: T.DataType, dst: T.DataType, ansi: bool):
         return scaled
     if isinstance(dst, T.DateType):
         if isinstance(src, T.StringType):
-            try:
-                d = pydt.date.fromisoformat(str(v).strip())
-            except ValueError:
+            days = _str_to_date_py(v)
+            if days is None:
                 raise _CastNull
-            return (d - pydt.date(1970, 1, 1)).days
+            return days
         if isinstance(src, T.TimestampType):
             return int(v) // 86_400_000_000
         raise _CastNull
     if isinstance(dst, T.TimestampType):
         if isinstance(src, T.DateType):
             return int(v) * 86_400_000_000
+        if isinstance(src, T.StringType):
+            micros = _str_to_ts_py(v)
+            if micros is None:
+                raise _CastNull
+            return micros
         if is_int(src):
             return int(v) * 1_000_000
         raise _CastNull
@@ -3204,6 +3310,203 @@ def _h_weekday(e, cols, n, ansi):
                   c.validity.copy())
 
 
+def _h_to_date_ts(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    want_date = type(e).__name__ == "ToDate"
+    ct = e.child.dataType
+    validity = c.validity.copy()
+    out = np.zeros(n, np.int32 if want_date else np.int64)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        v = c.values[i]
+        if isinstance(ct, T.DateType):
+            out[i] = int(v) if want_date else int(v) * 86_400_000_000
+        elif isinstance(ct, T.TimestampType):
+            out[i] = int(v) // 86_400_000_000 if want_date else int(v)
+        else:
+            r = _str_to_date_py(v) if want_date else _str_to_ts_py(v)
+            if r is None:
+                validity[i] = False
+            else:
+                out[i] = r
+    return CpuCol(T.DATE if want_date else T.TIMESTAMP, out, validity)
+
+
+def _h_regexp_extract_all(e, cols, n, ansi):
+    import re as _re
+
+    c = eval_expr(e.children[0], cols, n, ansi)
+    pat = _re.compile(_java_regex_to_python(str(e.children[1].value)))
+    out = np.empty(n, object)
+    for i in range(n):
+        v = c.values[i]
+        if v is not None and c.validity[i]:
+            out[i] = [m for m in pat.findall(v) if m != ""]
+    return CpuCol(e.dataType, out, c.validity.copy())
+
+
+def _h_overlay(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    s, r, p, ln = kids
+    validity = _null_prop_validity(kids)
+    out = np.empty(n, object)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        sv, rv = str(s.values[i]), str(r.values[i])
+        pos0 = int(p.values[i]) - 1
+        replen = int(ln.values[i])
+        if replen < 0:
+            replen = len(rv)
+        pre = sv[:max(pos0, 0)][:len(sv)]
+        tail = sv[min(max(pos0 + replen, 0), len(sv)):]
+        out[i] = pre + rv + tail
+    return CpuCol(T.STRING, out, validity)
+
+
+def _h_find_in_set(e, cols, n, ansi):
+    s, lst = _kids(e, cols, n, ansi)
+    validity = s.validity & lst.validity
+    out = np.zeros(n, np.int32)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        sv = str(s.values[i])
+        if "," in sv:
+            out[i] = 0
+            continue
+        parts = str(lst.values[i]).split(",")
+        out[i] = parts.index(sv) + 1 if sv in parts else 0
+    return CpuCol(T.INT, out, validity)
+
+
+def _h_elt(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    idx = kids[0]
+    out = np.empty(n, object)
+    validity = np.zeros(n, np.bool_)
+    for i in range(n):
+        if not idx.validity[i]:
+            continue
+        k = int(idx.values[i])
+        if 1 <= k <= len(kids) - 1 and kids[k].validity[i]:
+            out[i] = kids[k].values[i]
+            validity[i] = True
+    return CpuCol(T.STRING, out, validity)
+
+
+def _h_space(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    out = np.empty(n, object)
+    for i in range(n):
+        if c.validity[i]:
+            out[i] = " " * max(int(c.values[i]), 0)
+    return CpuCol(T.STRING, out, c.validity.copy())
+
+
+def _h_ltrim_rtrim(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    left = type(e).__name__ == "StringTrimLeft"
+    out = np.empty(n, object)
+    for i in range(n):
+        if c.validity[i]:
+            v = str(c.values[i])
+            out[i] = v.lstrip(" ") if left else v.rstrip(" ")
+    return CpuCol(T.STRING, out, c.validity.copy())
+
+
+def _h_bround(e, cols, n, ansi):
+    c, s = _kids(e, cols, n, ansi)
+    ct = e.children[0].dataType
+    if ct.is_integral:
+        return c
+    out = np.zeros(n, np.float64)
+    validity = c.validity & s.validity
+    for i in range(n):
+        if validity[i]:
+            sc = 10.0 ** int(s.values[i])
+            out[i] = np.round(float(c.values[i]) * sc) / sc
+    return CpuCol(e.dataType, out, validity)
+
+
+def _h_width_bucket(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    validity = _null_prop_validity(kids)
+    out = np.zeros(n, np.int64)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        v, lo, hi = (float(kids[j].values[i]) for j in range(3))
+        nb = int(kids[3].values[i])
+        if nb <= 0 or not all(math.isfinite(x) for x in (v, lo, hi)) \
+                or lo == hi:
+            validity[i] = False
+            continue
+        if lo < hi:
+            if v < lo:
+                out[i] = 0
+            elif v >= hi:
+                out[i] = nb + 1
+            else:
+                out[i] = int((v - lo) / ((hi - lo) / nb)) + 1
+        else:
+            if v > lo:
+                out[i] = 0
+            elif v <= hi:
+                out[i] = nb + 1
+            else:
+                out[i] = int((lo - v) / ((lo - hi) / nb)) + 1
+        out[i] = min(max(out[i], 0), nb + 1)
+    return CpuCol(T.LONG, out, validity)
+
+
+def _h_factorial(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    out = np.zeros(n, np.int64)
+    validity = c.validity.copy()
+    for i in range(n):
+        if validity[i]:
+            v = int(c.values[i])
+            if 0 <= v <= 20:
+                out[i] = math.factorial(v)
+            else:
+                validity[i] = False
+    return CpuCol(T.LONG, out, validity)
+
+
+def _h_bit_count(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    ct = e.child.dataType
+    out = np.zeros(n, np.int32)
+    for i in range(n):
+        if not c.validity[i]:
+            continue
+        if isinstance(ct, T.BooleanType):
+            out[i] = 1 if c.values[i] else 0
+        else:
+            # Java widens (sign-extends) before Long.bitCount
+            out[i] = bin(int(c.values[i]) & ((1 << 64) - 1)).count("1")
+    return CpuCol(T.INT, out, c.validity.copy())
+
+
+def _h_nvl2(e, cols, n, ansi):
+    a, b, c = _kids(e, cols, n, ansi)
+    vals = np.where(a.validity, b.values, c.values)
+    validity = np.where(a.validity, b.validity, c.validity)
+    return CpuCol(e.dataType, vals, validity.astype(np.bool_))
+
+
+def _h_nullif(e, cols, n, ansi):
+    a, b = _kids(e, cols, n, ansi)
+    validity = a.validity.copy()
+    for i in range(n):
+        if a.validity[i] and b.validity[i] \
+                and _nan_eq(a.values[i], b.values[i]):
+            validity[i] = False
+    return CpuCol(e.dataType, a.values.copy(), validity)
+
+
 _HANDLERS = {
     "BoundReference": _h_bound,
     "Literal": _h_literal,
@@ -3264,6 +3567,7 @@ _HANDLERS = {
     "UnixMicros": _h_unix_units,
     "UnixDate": _h_unix_date, "DateFromUnixDate": _h_unix_date,
     "WeekDay": _h_weekday,
+    "ToDate": _h_to_date_ts, "ToTimestamp": _h_to_date_ts,
     "Murmur3Hash": _h_hashexpr, "XxHash64": _h_hashexpr,
     "Reverse": _h_reverse, "InitCap": _h_initcap, "Ascii": _h_ascii,
     "Chr": _h_chr, "StringReplace": _h_replace,
@@ -3282,6 +3586,13 @@ _HANDLERS = {
     "ArrayJoin": _h_array_join,
     "RegExpReplace": _h_regexp_replace,
     "RegExpExtract": _h_regexp_extract,
+    "RegExpExtractAll": _h_regexp_extract_all,
+    "Overlay": _h_overlay, "FindInSet": _h_find_in_set, "Elt": _h_elt,
+    "StringSpace": _h_space,
+    "StringTrimLeft": _h_ltrim_rtrim, "StringTrimRight": _h_ltrim_rtrim,
+    "BRound": _h_bround, "WidthBucket": _h_width_bucket,
+    "Factorial": _h_factorial, "BitwiseCount": _h_bit_count,
+    "Nvl2": _h_nvl2, "NullIf": _h_nullif,
     "GetJsonObject": _h_get_json_object,
     "JsonTuple": _h_json_tuple,
     "JsonToStructs": _h_json_to_structs,

@@ -229,7 +229,7 @@ class TpuHashAggregateExec(TpuExec):
             return self._aggregate_batch(buf)
         return buf  # PARTIAL / COMPLETE buffers are the output
 
-    def _merge_fn(self, cols, num_rows):
+    def _merge_fn(self, cols, num_rows, row_valid=None):
         schema = self._buffer_schema()
         batch = ColumnarBatch(list(cols), num_rows, schema)
         ctx = EvalContext(batch, ansi=self.ansi)
@@ -237,6 +237,10 @@ class TpuHashAggregateExec(TpuExec):
         key_cols = list(batch.columns[:k])
         cap = batch.capacity
         mask = batch.row_mask
+        if row_valid is not None:
+            # mesh epoching: accumulator + all-to-all-received rows carry an
+            # explicit occupancy mask instead of a dense [0, num_rows) prefix
+            mask = mask & row_valid
         if not key_cols:
             seg = jnp.where(mask, 0, 1).astype(jnp.int32)
             perm = None

@@ -139,6 +139,13 @@ class TpuSortExec(TpuExec):
                 sorted_b = with_retry_no_split(mk)
                 runs.append([fw.track(sorted_b), sorted_b.num_rows, 0])
                 s.close()
+        yield from self._merge_runs(runs, schema)
+
+    def _merge_runs(self, runs, schema) -> Iterator[ColumnarBatch]:
+        """Memory-bounded k-way merge of sorted spillable runs — shared by
+        the single-chip out-of-core sort and the per-device emit of the
+        distributed ICI sort (exec/ici.py)."""
+        C = self.ooc_chunk_rows
         k = len(runs)
         merge = self._merge_window_fn(schema, k)
         while any(off < n for _, n, off in runs):

@@ -14,8 +14,11 @@ semantics the differential harness exercises, entirely as fused vector ops:
   * string <-> date (yyyy-MM-dd with civil-calendar day math on device, the
     Hinnant algorithm — branch-free integer ops, TPU-friendly).
   * date/timestamp conversions (micros <-> days, floor semantics).
-  * float->string and string->timestamp are plan-time fallbacks for now,
-    gated exactly like the reference gates castFloatToString
+  * string -> timestamp/date: vectorized variable-width civil parsing of
+    Spark's stringToTimestamp grammar (see _FieldCursor for the documented
+    subset; named timezones fall out as nulls).
+  * float->string remains a plan-time fallback, gated exactly like the
+    reference gates castFloatToString
     (spark.rapids.sql.castFloatToString.enabled) — see overrides/.
 """
 from __future__ import annotations
@@ -637,3 +640,244 @@ def cast_supported(src: T.DataType, dst: T.DataType) -> bool:
     if src == dst:
         return True
     return _dispatch(src, dst) is not None
+
+
+# ---------------------------------------------------------------------------
+# string -> timestamp / date: variable-width civil parsing (GpuCast analog
+# of spark-rapids-jni cast_string.cu's stringToTimestamp kernel)
+# ---------------------------------------------------------------------------
+
+_P10_I64 = [10 ** i for i in range(19)]
+
+
+class _FieldCursor:
+    """Vectorized cursor over trimmed char windows: digit-run extraction and
+    single-char matches, all as masked vector ops (no per-row loops).
+
+    Grammar supported (documented subset of Spark's stringToTimestamp):
+      [y]yyyy[-[m]m[-[d]d[( |T)[h]h[:[m]m[:[s]s[.f{1,9}]]]][tz]]]]
+      tz := Z | z | +-h[h] | +-hh:mm | +-h:mm | +-hhmm
+    Named zones (e.g. "UTC", "America/New_York") are not recognized and
+    parse as invalid (the reference handles them via GpuTimeZoneDB)."""
+
+    def __init__(self, c: DeviceColumn):
+        self.c = c
+        self.any_nonws, self.first, self.last = _parse_trim(c)
+        w = c.width
+        self.w = w
+        self.pos = jnp.arange(w)[None, :]
+        self.rows = jnp.arange(c.capacity)
+        active = ((self.pos >= self.first[:, None])
+                  & (self.pos <= self.last[:, None])
+                  & (self.pos < c.lengths[:, None]))
+        self.ch = jnp.where(active, c.chars, 0)
+        self.is_digit = (self.ch >= ord("0")) & (self.ch <= ord("9"))
+
+    def char_at(self, p):
+        safe = jnp.clip(p, 0, self.w - 1)
+        v = self.ch[self.rows, safe]
+        return jnp.where((p >= 0) & (p < self.w), v, 0)
+
+    def digit_run_end(self, p):
+        """Exclusive end of the digit run starting at p (<= last+1)."""
+        nd = ((self.pos >= p[:, None]) & ~self.is_digit
+              & (self.pos <= self.last[:, None]))
+        has = jnp.any(nd, axis=1)
+        idx = jnp.argmax(nd, axis=1).astype(jnp.int32)
+        return jnp.where(has, idx, self.last + 1).astype(jnp.int32)
+
+    def parse_int(self, start, end_excl, max_digits):
+        """Integer from digits [start, end_excl); caller validates length."""
+        exp = end_excl[:, None] - 1 - self.pos
+        dig_active = ((self.pos >= start[:, None])
+                      & (self.pos < end_excl[:, None]))
+        p10 = jnp.asarray(_P10_I64[:max_digits] + [0], jnp.int64)
+        mult = p10[jnp.clip(exp, 0, max_digits)]
+        contrib = jnp.where(dig_active,
+                            (self.ch - ord("0")).astype(jnp.int64) * mult,
+                            jnp.int64(0))
+        return jnp.sum(contrib, axis=1)
+
+
+def _parse_civil_string(c: DeviceColumn):
+    """Parse the shared date prefix + optional time/tz suffix.
+
+    Returns a dict of fields and per-shape validity flags; consumers pick
+    the shapes they accept (date cast ignores everything after the day)."""
+    cur = _FieldCursor(c)
+    last = cur.last
+    ys = cur.first
+    ye = cur.digit_run_end(ys)
+    ylen = ye - ys
+    y = cur.parse_int(ys, ye, 6)
+    # year capped at 9999: the collect layer renders python datetimes
+    year_ok = cur.any_nonws & (ylen >= 4) & (ylen <= 6) & (y <= 9999)
+    only_year = ye > last
+    dash1 = cur.char_at(ye) == ord("-")
+    ms = ye + 1
+    me = cur.digit_run_end(ms)
+    mlen = me - ms
+    m = cur.parse_int(ms, me, 2)
+    month_ok = (mlen >= 1) & (mlen <= 2)
+    only_ym = me > last
+    dash2 = cur.char_at(me) == ord("-")
+    ds = me + 1
+    de = cur.digit_run_end(ds)
+    dlen = de - ds
+    d = cur.parse_int(ds, de, 2)
+    day_ok = (dlen >= 1) & (dlen <= 2)
+    only_date = de > last
+    sepc = cur.char_at(de)
+    sep = (sepc == ord(" ")) | (sepc == ord("T"))
+    # time fields
+    hs = de + 1
+    he = cur.digit_run_end(hs)
+    hlen = he - hs
+    h = cur.parse_int(hs, he, 2)
+    hour_ok = (hlen >= 1) & (hlen <= 2)
+    colon1 = cur.char_at(he) == ord(":")
+    mins = he + 1
+    mine = cur.digit_run_end(mins)
+    minlen = mine - mins
+    mi = cur.parse_int(mins, mine, 2)
+    min_ok = (minlen >= 1) & (minlen <= 2)
+    colon2 = cur.char_at(mine) == ord(":")
+    ss = mine + 1
+    se = cur.digit_run_end(ss)
+    slen = se - ss
+    s = cur.parse_int(ss, se, 2)
+    sec_ok = (slen >= 1) & (slen <= 2)
+    dot = cur.char_at(se) == ord(".")
+    fs = se + 1
+    fe = cur.digit_run_end(fs)
+    flen = fe - fs
+    frac_ok = (flen >= 1) & (flen <= 9)
+    frac_raw = cur.parse_int(fs, fe, 9)
+    # fraction -> micros (truncating past 6 digits)
+    scale_up = jnp.asarray([_P10_I64[i] for i in range(7)], jnp.int64)
+    up = scale_up[jnp.clip(6 - flen, 0, 6)]
+    down = scale_up[jnp.clip(flen - 6, 0, 6)]
+    frac_us = jnp.where(flen <= 6, frac_raw * up, frac_raw // down)
+    # time shape: hour [: min [: sec [.frac]]], ending at time_end
+    time_end = jnp.where(
+        dot & frac_ok, fe,
+        jnp.where(colon2 & sec_ok, se,
+                  jnp.where(colon1 & min_ok, mine, he)))
+    has_min = colon1 & min_ok
+    has_sec = has_min & colon2 & sec_ok
+    has_frac = has_sec & dot & frac_ok
+    mi = jnp.where(has_min, mi, 0)
+    s = jnp.where(has_sec, s, 0)
+    frac_us = jnp.where(has_frac, frac_us, 0)
+    time_shape_ok = hour_ok & (
+        (time_end == he)
+        | (has_min & (time_end == mine))
+        | (has_sec & (time_end == se))
+        | (has_frac & (time_end == fe)))
+    # tz suffix after the time
+    tzp = time_end
+    tz_none = tzp > last
+    tzc = cur.char_at(tzp)
+    tz_z = ((tzc == ord("Z")) | (tzc == ord("z"))) & (tzp == last)
+    tz_sign = jnp.where(tzc == ord("+"), 1,
+                        jnp.where(tzc == ord("-"), -1, 0)).astype(jnp.int64)
+    ths = tzp + 1
+    the = cur.digit_run_end(ths)
+    thlen = the - ths
+    th_raw = cur.parse_int(ths, the, 4)
+    # forms: hhmm (4 digits), h/hh (then optional :mm)
+    tz_hhmm = thlen == 4
+    tzh = jnp.where(tz_hhmm, th_raw // 100, th_raw)
+    tcolon = cur.char_at(the) == ord(":")
+    tms = the + 1
+    tme = cur.digit_run_end(tms)
+    tmlen = tme - tms
+    tzm_c = cur.parse_int(tms, tme, 2)
+    has_tzm = tcolon & (tmlen == 2)
+    tzm = jnp.where(tz_hhmm, th_raw % 100,
+                    jnp.where(has_tzm, tzm_c, 0))
+    tz_num_end = jnp.where(has_tzm & ~tz_hhmm, tme, the)
+    tz_num_ok = ((tz_sign != 0)
+                 & ((tz_hhmm & ~tcolon)
+                    | ((thlen >= 1) & (thlen <= 2)))
+                 & (tz_num_end > last))
+    tz_off_ok = (tzh <= 18) & (tzm <= 59) \
+        & ((tzh * 60 + tzm) <= 18 * 60)
+    tz_ok = tz_none | tz_z | (tz_num_ok & tz_off_ok)
+    tz_offset_s = jnp.where(tz_none | tz_z, 0,
+                            tz_sign * (tzh * 3600 + tzm * 60))
+    return dict(
+        cur=cur, y=y, m=m, d=d, h=h, mi=mi, s=s, frac_us=frac_us,
+        year_ok=year_ok, only_year=only_year,
+        dash1=dash1, month_ok=month_ok, only_ym=only_ym, dash2=dash2,
+        day_ok=day_ok, only_date=only_date, sep=sep,
+        time_shape_ok=time_shape_ok, tz_ok=tz_ok,
+        tz_offset_s=tz_offset_s, h_ok=(h <= 23), mi_ok=(mi <= 59),
+        s_ok=(s <= 59))
+
+
+def _string_to_timestamp(ctx, c, src, dst, ansi):
+    """Spark stringToTimestamp subset — see _FieldCursor for the grammar."""
+    f = _parse_civil_string(c)
+    m_eff = jnp.where(f["only_year"], 1, f["m"])
+    d_eff = jnp.where(f["only_year"] | f["only_ym"], 1, f["d"])
+    days = days_from_civil(f["y"], jnp.maximum(m_eff, 1),
+                           jnp.maximum(d_eff, 1))
+    y2, m2, d2 = civil_from_days(days)
+    civil_ok = ((y2 == f["y"]) & (m2 == jnp.maximum(m_eff, 1))
+                & (d2 == jnp.maximum(d_eff, 1)))
+    date_part_ok = (
+        f["only_year"]
+        | (f["dash1"] & f["month_ok"]
+           & (f["only_ym"]
+              | (f["dash2"] & f["day_ok"]))))
+    time_part_ok = (
+        f["only_date"] | f["only_year"] | f["only_ym"]
+        | (f["sep"] & f["time_shape_ok"] & f["tz_ok"]
+           & f["h_ok"] & f["mi_ok"] & f["s_ok"]))
+    has_time = ~(f["only_date"] | f["only_year"] | f["only_ym"])
+    ok = (f["year_ok"] & date_part_ok & time_part_ok & civil_ok
+          & (m_eff >= 1) & (d_eff >= 1))
+    h = jnp.where(has_time, f["h"], 0)
+    mi = jnp.where(has_time, f["mi"], 0)
+    s = jnp.where(has_time, f["s"], 0)
+    frac = jnp.where(has_time, f["frac_us"], 0)
+    off = jnp.where(has_time, f["tz_offset_s"], 0)
+    micros = (days.astype(jnp.int64) * 86_400_000_000
+              + h * 3_600_000_000 + mi * 60_000_000 + s * 1_000_000
+              + frac - off * 1_000_000)
+    if ansi:
+        ctx.add_error(~ok & c.validity,
+                      "invalid cast string->timestamp (ANSI)")
+        validity = c.validity
+    else:
+        validity = c.validity & ok
+    return DeviceColumn(T.TIMESTAMP, validity, data=micros)
+
+
+def _string_to_date_v2(ctx, c, src, dst, ansi):
+    """Spark stringToDate: [y]yyyy[-[m]m[-[d]d]], with anything after the
+    day accepted when separated by ' ' or 'T' (Spark ignores the tail)."""
+    f = _parse_civil_string(c)
+    m_eff = jnp.where(f["only_year"], 1, f["m"])
+    d_eff = jnp.where(f["only_year"] | f["only_ym"], 1, f["d"])
+    days = days_from_civil(f["y"], jnp.maximum(m_eff, 1),
+                           jnp.maximum(d_eff, 1))
+    y2, m2, d2 = civil_from_days(days)
+    civil_ok = ((y2 == f["y"]) & (m2 == jnp.maximum(m_eff, 1))
+                & (d2 == jnp.maximum(d_eff, 1)))
+    tail_ok = f["only_date"] | f["sep"]
+    ok = (f["year_ok"] & civil_ok & (m_eff >= 1) & (d_eff >= 1)
+          & (f["only_year"]
+             | (f["dash1"] & f["month_ok"]
+                & (f["only_ym"] | (f["dash2"] & f["day_ok"] & tail_ok)))))
+    if ansi:
+        ctx.add_error(~ok & c.validity, "invalid cast string->date (ANSI)")
+        validity = c.validity
+    else:
+        validity = c.validity & ok
+    return DeviceColumn(T.DATE, validity, data=days.astype(jnp.int32))
+
+
+_CASTS[("str", "ts")] = _string_to_timestamp
+_CASTS[("str", "date")] = _string_to_date_v2

@@ -226,3 +226,47 @@ class Least(Greatest):
             return jnp.where(jnp.isnan(a), b,
                              jnp.where(jnp.isnan(b), a, jnp.minimum(a, b)))
         return jnp.minimum(a, b)
+
+
+class Nvl2(Expression):
+    """nvl2(a, b, c): b when a is not null, else c."""
+
+    def __init__(self, a: Expression, b: Expression, c: Expression):
+        super().__init__([a, b, c])
+
+    def _resolve_type(self):
+        self._dataType = self.children[1].dataType
+        self._nullable = (self.children[1].nullable
+                          or self.children[2].nullable)
+
+    def do_columnar_eval(self, ctx, cols):
+        a, b, c = cols
+        return select_column(a.validity, jnp.ones_like(a.validity), b, c,
+                             self.dataType)
+
+
+class NullIf(Expression):
+    """nullif(a, b): null when a == b, else a."""
+
+    def __init__(self, a: Expression, b: Expression):
+        super().__init__([a, b])
+
+    def _resolve_type(self):
+        self._dataType = self.children[0].dataType
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        a, b = cols
+        from spark_rapids_tpu.expr.predicates import EqualTo
+
+        eq = EqualTo(self.children[0], self.children[1])
+        eq._dataType = T.BOOLEAN
+        eq.resolved = True
+        eqc = eq.do_columnar_eval(ctx, [a, b])
+        null_out = eqc.data & eqc.validity
+        if a.is_string:
+            return DeviceColumn(self.dataType, a.validity & ~null_out,
+                                chars=a.chars, lengths=a.lengths)
+        return DeviceColumn(self.dataType, a.validity & ~null_out,
+                            data=a.data, chars=a.chars, lengths=a.lengths,
+                            elem_valid=a.elem_valid, children=a.children)

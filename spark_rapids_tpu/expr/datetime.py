@@ -695,3 +695,44 @@ class UnixMillis(_UnixExtract):
 
 class UnixMicros(_UnixExtract):
     _div = 1
+
+
+class ToDate(UnaryExpression):
+    """to_date(e) — no-format variant: Cast-to-date semantics."""
+
+    def _resolve_type(self):
+        self._dataType = T.DATE
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        ct = self.child.dataType
+        if isinstance(ct, T.DateType):
+            return c
+        if isinstance(ct, T.TimestampType):
+            return DeviceColumn(T.DATE, c.validity,
+                                data=jnp.floor_divide(
+                                    c.data, _US_PER_DAY).astype(jnp.int32))
+        from spark_rapids_tpu.expr.cast import _string_to_date_v2
+
+        return _string_to_date_v2(ctx, c, ct, T.DATE, False)
+
+
+class ToTimestamp(UnaryExpression):
+    """to_timestamp(e) — no-format variant: Cast-to-timestamp semantics."""
+
+    def _resolve_type(self):
+        self._dataType = T.TIMESTAMP
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        ct = self.child.dataType
+        if isinstance(ct, T.TimestampType):
+            return c
+        if isinstance(ct, T.DateType):
+            return DeviceColumn(T.TIMESTAMP, c.validity,
+                                data=c.data.astype(jnp.int64) * _US_PER_DAY)
+        from spark_rapids_tpu.expr.cast import _string_to_timestamp
+
+        return _string_to_timestamp(ctx, c, ct, T.TIMESTAMP, False)

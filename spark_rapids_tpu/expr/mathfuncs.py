@@ -313,3 +313,104 @@ class Logarithm(_BinaryMathToDouble):
             jnp.where((b.data <= 0) | (b.data == 1.0), 2.0, b.data))
         return DeviceColumn(T.DOUBLE, b.validity & x.validity & ~bad,
                             data=out)
+
+
+class BRound(Round):
+    """bround(x, scale) HALF_EVEN (banker's rounding)."""
+
+    def do_columnar_eval(self, ctx, cols):
+        # decimals fall back at tag time (HALF_EVEN decimal rescale TBD)
+        c, s = cols
+        ct = self.children[0].dataType
+        dt = self.dataType
+        if ct.is_integral:
+            return c
+        scale_f = 10.0 ** s.data.astype(jnp.float64)
+        x = c.data * scale_f
+        # ties to even: numpy/jnp rint IS banker's rounding
+        r = jnp.round(x)
+        return DeviceColumn(dt, c.validity & s.validity, data=r / scale_f)
+
+
+class WidthBucket(Expression):
+    """width_bucket(v, lo, hi, n) — 1-based bucket; 0 / n+1 outside."""
+
+    def __init__(self, v, lo, hi, n):
+        super().__init__([v, lo, hi, n])
+
+    def sql_string(self):
+        return ("width_bucket("
+                + ", ".join(c.sql_string() for c in self.children) + ")")
+
+    def _resolve_type(self):
+        self._dataType = T.LONG
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        v, lo, hi, n = (c.data.astype(jnp.float64) for c in cols)
+        nb = cols[3].data.astype(jnp.int64)
+        ok = ((nb > 0) & jnp.isfinite(v) & jnp.isfinite(lo)
+              & jnp.isfinite(hi) & (lo != hi))
+        asc = lo < hi
+        width = (hi - lo) / nb.astype(jnp.float64)
+        b_asc = jnp.floor((v - lo) / width).astype(jnp.int64) + 1
+        b_desc = jnp.floor((lo - v) / -width).astype(jnp.int64) + 1
+        b = jnp.where(asc, b_asc, b_desc)
+        below = jnp.where(asc, v < lo, v > lo)
+        above = jnp.where(asc, v >= hi, v <= hi)
+        res = jnp.where(below, 0, jnp.where(above, nb + 1, b))
+        res = jnp.clip(res, 0, nb + 1)
+        validity = ok
+        for c in cols:
+            validity = validity & c.validity
+        return DeviceColumn(T.LONG, validity, data=res)
+
+
+class Factorial(UnaryExpression):
+    """factorial(n) for n in [0, 20]; outside -> null (Spark)."""
+
+    _TABLE = [1]
+
+    def _resolve_type(self):
+        self._dataType = T.LONG
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        import math as _math
+
+        table = jnp.asarray([_math.factorial(i) for i in range(21)] + [0],
+                            jnp.int64)
+        v = c.data.astype(jnp.int64)
+        ok = (v >= 0) & (v <= 20)
+        res = table[jnp.clip(v, 0, 21)]
+        return DeviceColumn(T.LONG, c.validity & ok, data=res)
+
+
+class BitwiseCount(UnaryExpression):
+    """bit_count(x) — set bits (bool counts itself)."""
+
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        ct = self.child.dataType
+        if isinstance(ct, T.BooleanType):
+            res = c.data.astype(jnp.int32)
+        else:
+            # Spark evaluates Long.bitCount on the SIGN-EXTENDED value
+            # (Java widening), so bit_count(tinyint -1) is 64, not 8
+            v = c.data.astype(jnp.int64)
+            x = v.view(jnp.uint64)
+            res = jnp.zeros(c.capacity, jnp.int32)
+            for shift in range(0, 64, 8):
+                byte = ((x >> jnp.uint64(shift))
+                        & jnp.uint64(0xFF)).astype(jnp.int32)
+                # 8-bit popcount via lookup-free SWAR
+                b = byte - ((byte >> 1) & 0x55)
+                b = (b & 0x33) + ((b >> 2) & 0x33)
+                b = (b + (b >> 4)) & 0x0F
+                res = res + b
+        return DeviceColumn(T.INT, c.validity, data=res)

@@ -1153,3 +1153,307 @@ class ArrayJoin(Expression):
             out.append(d.join(p for p in parts if p is not None))
         h = HostColumn.from_pylist(out, T.STRING)
         return DeviceColumn.from_host(h, capacity=cap)
+
+
+class RegExpExtractAll(Expression):
+    """regexp_extract_all(str, pattern[, idx=0]) -> array<string> of all
+    non-overlapping leftmost matches.
+
+    Tag-time contract (checked in overrides): span-safe pattern with
+    bounded, non-empty match length (min>=1, max<=MAX_MATCH_LEN) so the
+    padded element matrix stays static; rows with more than MAX_MATCHES
+    matches raise via the error flags instead of truncating silently."""
+
+    MAX_MATCH_LEN = 32
+    MAX_MATCHES = 64
+
+    def __init__(self, s: Expression, pattern: Expression,
+                 idx: Expression = None):
+        from spark_rapids_tpu.expr.base import Literal
+
+        super().__init__([s, pattern]
+                         + ([idx] if idx is not None else
+                            [Literal(0, T.INT)]))
+        self._dfa = None
+        self._bounds = None
+
+    def _resolve_type(self):
+        self._dataType = T.ArrayType(T.STRING, containsNull=False)
+        self._nullable = True
+
+    def sql_string(self):
+        return (f"regexp_extract_all({self.children[0].sql_string()}, "
+                f"{self.children[1].sql_string()})")
+
+    def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.regex.spans import (
+            compile_for_spans,
+            greedy_match_starts,
+            match_lengths,
+        )
+
+        c = cols[0]
+        if self._dfa is None:
+            self._dfa = compile_for_spans(str(self.children[1].value))
+        cap, w = c.capacity, c.width
+        n = c.lengths
+        best = match_lengths(self._dfa, c.chars, n)
+        matched, mlen = greedy_match_starts(best, n)
+        # positions span [0, w] (a zero-length match may sit at the end);
+        # bounded non-empty matches only start inside the string
+        nz = (matched & (mlen > 0))[:, :w]
+        mlen = mlen[:, :w]
+        ecount = jnp.sum(nz, axis=1).astype(jnp.int32)
+        maxe = min(self.MAX_MATCHES, max(w, 1))
+        ctx.add_error(c.validity & (ecount > maxe),
+                      f"regexp_extract_all: more than {self.MAX_MATCHES} "
+                      f"matches in one string")
+        eidx = (jnp.cumsum(nz.astype(jnp.int32), axis=1) - 1)
+        rows = jnp.arange(cap)[:, None].repeat(w, 1)
+        tgt = jnp.where(nz, jnp.clip(eidx, 0, maxe - 1), maxe)
+        pos = jnp.arange(w, dtype=jnp.int32)[None, :].repeat(cap, 0)
+        starts_e = jnp.zeros((cap, maxe), jnp.int32).at[rows, tgt].set(
+            pos, mode="drop")
+        mlen_e = jnp.zeros((cap, maxe), jnp.int32).at[rows, tgt].set(
+            jnp.where(nz, mlen, 0), mode="drop")
+        ew = min(self.MAX_MATCH_LEN, max(w, 1))
+        k = jnp.arange(ew, dtype=jnp.int32)[None, None, :]
+        src = jnp.clip(starts_e[:, :, None] + k, 0, w - 1)
+        chars3 = jnp.take_along_axis(
+            c.chars[:, None, :].repeat(maxe, 1), src, axis=2)
+        inlen = k < mlen_e[:, :, None]
+        chars3 = jnp.where(inlen, chars3, 0).astype(jnp.uint8)
+        elem_valid = (jnp.arange(maxe, dtype=jnp.int32)[None, :]
+                      < ecount[:, None])
+        validity = c.validity & cols[1].validity
+        return DeviceColumn(self.dataType, validity, chars=chars3,
+                            data=mlen_e, lengths=jnp.minimum(ecount, maxe),
+                            elem_valid=elem_valid)
+
+
+class Overlay(Expression):
+    """overlay(input, replace, pos[, len]) — 1-based; len<0 means
+    length(replace) (Spark default)."""
+
+    def __init__(self, s, r, pos, length=None):
+        from spark_rapids_tpu.expr.base import Literal
+
+        super().__init__([s, r, pos]
+                         + ([length] if length is not None
+                            else [Literal(-1, T.INT)]))
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def sql_string(self):
+        return ("overlay("
+                + ", ".join(c.sql_string() for c in self.children) + ")")
+
+    def do_columnar_eval(self, ctx, cols):
+        s, r, p, ln = cols
+        cap = s.capacity
+        pos0 = (p.data.astype(jnp.int32) - 1)
+        rl = r.lengths
+        replen = jnp.where(ln.data.astype(jnp.int32) < 0, rl,
+                           ln.data.astype(jnp.int32))
+        pre_len = jnp.clip(pos0, 0, s.lengths)
+        tail_start = jnp.clip(pos0 + replen, 0, s.lengths)
+        tail_len = s.lengths - tail_start
+        out_len = pre_len + rl + tail_len
+        out_w = int(s.width + r.width)
+        from spark_rapids_tpu.columnar.column import (
+            DEFAULT_WIDTH_BUCKETS,
+            round_up_bucket,
+        )
+
+        out_w = round_up_bucket(max(out_w, 1), DEFAULT_WIDTH_BUCKETS)
+        pos_o = jnp.arange(out_w, dtype=jnp.int32)[None, :]
+        # three segments gathered by source index
+        in_pre = pos_o < pre_len[:, None]
+        in_rep = ~in_pre & (pos_o < (pre_len + rl)[:, None])
+        in_tail = ~in_pre & ~in_rep & (pos_o < out_len[:, None])
+        src_s = jnp.where(in_pre, pos_o,
+                          jnp.where(in_tail,
+                                    pos_o - (pre_len + rl)[:, None]
+                                    + tail_start[:, None], 0))
+        src_r = jnp.where(in_rep, pos_o - pre_len[:, None], 0)
+        sw = max(s.width, 1)
+        rw = max(r.width, 1)
+        g_s = jnp.take_along_axis(
+            s.chars if s.width else jnp.zeros((cap, 1), jnp.uint8),
+            jnp.clip(src_s, 0, sw - 1), axis=1)
+        g_r = jnp.take_along_axis(
+            r.chars if r.width else jnp.zeros((cap, 1), jnp.uint8),
+            jnp.clip(src_r, 0, rw - 1), axis=1)
+        chars = jnp.where(in_rep, g_r,
+                          jnp.where(in_pre | in_tail, g_s, 0))
+        validity = s.validity & r.validity & p.validity & ln.validity
+        return DeviceColumn(T.STRING, validity,
+                            chars=chars.astype(jnp.uint8),
+                            lengths=out_len.astype(jnp.int32))
+
+
+class FindInSet(BinaryExpression):
+    """find_in_set(s, comma_list) — 1-based index, 0 when absent or when s
+    contains a comma."""
+
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        s, lst = cols
+        cap = s.capacity
+        w = max(lst.width, 1)
+        pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+        in_l = pos < lst.lengths[:, None]
+        lch = jnp.where(in_l, lst.chars, 0) if lst.width else \
+            jnp.zeros((cap, 1), jnp.uint8)
+        is_comma = (lch == ord(",")) & in_l
+        # element id per position (elements are the runs between commas)
+        elem = jnp.cumsum(is_comma.astype(jnp.int32), axis=1) - \
+            is_comma.astype(jnp.int32)
+        rows = jnp.arange(cap)[:, None].repeat(w, 1)
+        # per-element char count + first position via scatter-reduce
+        maxe = w + 1
+        one_hot_src = jnp.where(in_l & ~is_comma, elem, maxe)
+        counts = jnp.zeros((cap, maxe + 1), jnp.int32).at[
+            rows, jnp.clip(one_hot_src, 0, maxe)].add(
+            jnp.where(in_l & ~is_comma, 1, 0), mode="drop")
+        counts = counts[:, :maxe]
+        first_pos = jnp.full((cap, maxe + 1), w, jnp.int32).at[
+            rows, jnp.clip(jnp.where(in_l & ~is_comma, elem, maxe),
+                           0, maxe)].min(
+            jnp.where(in_l & ~is_comma, pos, w), mode="drop")
+        first_pos = first_pos[:, :maxe]
+        nelem = jnp.sum(is_comma.astype(jnp.int32), axis=1) + 1
+        # compare s against each element (element count = comma count + 1)
+        slen = s.lengths
+        sw = max(s.width, 1)
+        sch = s.chars if s.width else jnp.zeros((cap, 1), jnp.uint8)
+        s_has_comma = jnp.any((sch == ord(",")) &
+                              (jnp.arange(sw)[None, :] < slen[:, None]),
+                              axis=1)
+        k = jnp.arange(sw, dtype=jnp.int32)[None, None, :]
+        src = jnp.clip(first_pos[:, :, None] + k, 0, w - 1)
+        echars = jnp.take_along_axis(lch[:, None, :].repeat(maxe, 1), src,
+                                     axis=2)
+        want = sch[:, None, :]
+        cmp_len = jnp.minimum(counts, slen[:, None])
+        eq = jnp.all(jnp.where(k < cmp_len[:, :, None], echars == want,
+                               True), axis=2)
+        match = eq & (counts == slen[:, None]) & \
+            (jnp.arange(maxe, dtype=jnp.int32)[None, :] < nelem[:, None])
+        found = jnp.any(match, axis=1)
+        idx = jnp.argmax(match, axis=1).astype(jnp.int32) + 1
+        res = jnp.where(found & ~s_has_comma, idx, 0)
+        return DeviceColumn(T.INT, s.validity & lst.validity, data=res)
+
+
+class Elt(Expression):
+    """elt(n, s1, s2, ...) — 1-based pick; out of range -> null."""
+
+    def __init__(self, children):
+        super().__init__(list(children))
+
+    def sql_string(self):
+        return "elt(" + ", ".join(c.sql_string() for c in self.children) + ")"
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        n = cols[0]
+        opts = cols[1:]
+        cap = n.capacity
+        w = max(max((c.width for c in opts), default=1), 1)
+        from spark_rapids_tpu.expr.predicates import _pad_to
+
+        idx = n.data.astype(jnp.int32)
+        chars = jnp.zeros((cap, w), jnp.uint8)
+        lengths = jnp.zeros(cap, jnp.int32)
+        validity = jnp.zeros(cap, jnp.bool_)
+        for k, c in enumerate(opts):
+            takes = idx == (k + 1)
+            chars = jnp.where(takes[:, None], _pad_to(c.chars, w), chars)
+            lengths = jnp.where(takes, c.lengths, lengths)
+            validity = jnp.where(takes, c.validity, validity)
+        return DeviceColumn(T.STRING, n.validity & validity,
+                            chars=chars, lengths=lengths)
+
+
+class StringSpace(UnaryExpression):
+    """space(n) — n spaces (n<0 -> empty).  A literal n sizes the char
+    matrix exactly; non-literal n pays the MAX_LEN-wide bucket and rows
+    above MAX_LEN raise via the error flags."""
+
+    MAX_LEN = 2048
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.columnar.column import (
+            DEFAULT_WIDTH_BUCKETS,
+            round_up_bucket,
+        )
+        from spark_rapids_tpu.expr.base import Literal
+
+        c = cols[0]
+        n = jnp.maximum(c.data.astype(jnp.int32), 0)
+        if isinstance(self.child, Literal) and self.child.value is not None:
+            w_static = round_up_bucket(
+                min(max(int(self.child.value), 1), self.MAX_LEN),
+                DEFAULT_WIDTH_BUCKETS)
+        else:
+            w_static = round_up_bucket(self.MAX_LEN, DEFAULT_WIDTH_BUCKETS)
+        ctx.add_error(c.validity & (n > w_static),
+                      f"space(): length above {self.MAX_LEN}")
+        n = jnp.minimum(n, w_static)
+        pos = jnp.arange(w_static, dtype=jnp.int32)[None, :]
+        chars = jnp.where(pos < n[:, None], jnp.uint8(ord(" ")),
+                          jnp.uint8(0))
+        return DeviceColumn(T.STRING, c.validity, chars=chars, lengths=n)
+
+
+class StringTrimLeft(UnaryExpression):
+    """ltrim(s) — strips leading spaces (Spark trims 0x20 only)."""
+
+    side = "left"
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        pos = jnp.arange(c.width)[None, :]
+        in_str = pos < c.lengths[:, None]
+        nonws = in_str & (c.chars != ord(" "))
+        any_nonws = jnp.any(nonws, axis=1)
+        if self.side == "left":
+            first = jnp.where(any_nonws, jnp.argmax(nonws, axis=1), 0)
+            out_len = jnp.where(any_nonws, c.lengths - first, 0)
+        else:
+            first = jnp.zeros(c.capacity, jnp.int32)
+            last = jnp.where(
+                any_nonws,
+                c.width - 1 - jnp.argmax(nonws[:, ::-1], axis=1), -1)
+            out_len = (last + 1).astype(jnp.int32)
+        idx = first[:, None] + jnp.arange(c.width)[None, :]
+        take = jnp.arange(c.width)[None, :] < out_len[:, None]
+        gathered = jnp.take_along_axis(
+            c.chars, jnp.clip(idx, 0, max(c.width - 1, 0)), axis=1)
+        return DeviceColumn(T.STRING, c.validity,
+                            chars=jnp.where(take, gathered,
+                                            0).astype(jnp.uint8),
+                            lengths=out_len.astype(jnp.int32))
+
+
+class StringTrimRight(StringTrimLeft):
+    """rtrim(s)."""
+
+    side = "right"

@@ -217,6 +217,47 @@ def _check_create_array(meta: ExprMeta):
             return
 
 
+def _check_regexp_extract_all(meta: ExprMeta):
+    """regexp_extract_all: span-safe literal pattern with bounded non-empty
+    match length (static padded element matrix), idx 0 only."""
+    from spark_rapids_tpu.regex import RegexUnsupported
+    from spark_rapids_tpu.regex.spans import (compile_for_spans,
+                                              match_length_bounds)
+
+    e = meta.expr
+    pat = e.children[1]
+    if not isinstance(pat, E.Literal) or pat.value is None:
+        meta.will_not_work_on_tpu("regexp pattern must be a non-null literal")
+        return
+    try:
+        e._dfa = compile_for_spans(str(pat.value))
+        lo, hi = match_length_bounds(str(pat.value))
+    except RegexUnsupported as ex:
+        meta.will_not_work_on_tpu(str(ex))
+        return
+    if lo < 1:
+        meta.will_not_work_on_tpu(
+            "regexp_extract_all: pattern can match the empty string")
+    if hi is None or hi > e.MAX_MATCH_LEN:
+        meta.will_not_work_on_tpu(
+            f"regexp_extract_all: match length must be bounded by "
+            f"{e.MAX_MATCH_LEN}")
+    idx = e.children[2]
+    if not isinstance(idx, E.Literal) or idx.value is None \
+            or int(idx.value) != 0:
+        meta.will_not_work_on_tpu(
+            "regexp_extract_all with capture-group index needs a "
+            "backtracking engine")
+
+
+def _check_bround(meta: ExprMeta):
+    ct = meta.expr.children[0]._dataType
+    if isinstance(ct, T.DecimalType):
+        meta.will_not_work_on_tpu(
+            "bround over decimals (HALF_EVEN rescale) is not supported "
+            "on TPU")
+
+
 def _check_regexp_spans(meta: ExprMeta):
     """regexp_replace/extract: literal pattern from the span-safe subset
     (regex/spans.py), literal replacement without $group refs / backslash,
@@ -462,9 +503,14 @@ def _check_to_json(meta: ExprMeta):
 
 EXPRESSIONS: Dict[Type, ExprRule] = {
     E.Literal: ExprRule(_WITH_ARRAYS, desc="constant literal", allow_string_arrays=True),
-    E.BoundReference: ExprRule(_WITH_ARRAYS, desc="column reference", allow_string_arrays=True),
-    E.AttributeReference: ExprRule(_WITH_ARRAYS, desc="column reference", allow_string_arrays=True),
-    E.Alias: ExprRule(_WITH_ARRAYS, desc="alias", allow_string_arrays=True),
+    E.BoundReference: ExprRule(_WITH_ARRAYS + _WITH_MAPS,
+                               desc="column reference",
+                               allow_string_arrays=True),
+    E.AttributeReference: ExprRule(_WITH_ARRAYS + _WITH_MAPS,
+                                   desc="column reference",
+                                   allow_string_arrays=True),
+    E.Alias: ExprRule(_WITH_ARRAYS + _WITH_MAPS, desc="alias",
+                      allow_string_arrays=True),
     A.Add: ExprRule(_NUM128, extra_check=_check_decimal_addsub),
     A.Subtract: ExprRule(_NUM128, extra_check=_check_decimal_addsub),
     A.Multiply: ExprRule(_NUM128, extra_check=_check_decimal_mult),
@@ -565,6 +611,29 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
                               extra_check=_check_regexp_spans),
     S.RegExpExtract: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG,
                               extra_check=_check_regexp_spans),
+    S.RegExpExtractAll: ExprRule(
+        T.STRING_SIG + T.INTEGRAL_SIG + _ARRAY_SIG.with_note(
+            T.ArrayType,
+            f"bounded patterns; at most "
+            f"{S.RegExpExtractAll.MAX_MATCHES} matches per row"),
+        allow_string_arrays=True,
+        extra_check=_check_regexp_extract_all),
+    S.Overlay: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG),
+    S.FindInSet: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG),
+    S.Elt: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG),
+    S.StringSpace: ExprRule(
+        T.STRING_SIG.with_note(
+            T.StringType,
+            f"length capped at {S.StringSpace.MAX_LEN}")
+        + T.INTEGRAL_SIG),
+    S.StringTrimLeft: ExprRule(T.STRING_SIG),
+    S.StringTrimRight: ExprRule(T.STRING_SIG),
+    M.BRound: ExprRule(_NUM, extra_check=_check_bround),
+    M.WidthBucket: ExprRule(_NUM),
+    M.Factorial: ExprRule(T.INTEGRAL_SIG),
+    M.BitwiseCount: ExprRule(T.INTEGRAL_SIG + T.BOOLEAN_SIG),
+    CO.Nvl2: ExprRule(_COMMON128),
+    CO.NullIf: ExprRule(_COMMON128),
     S.Like: ExprRule(T.STRING_SIG + T.BOOLEAN_SIG, extra_check=_check_like),
     S.RLike: ExprRule(T.STRING_SIG + T.BOOLEAN_SIG,
                       extra_check=_check_rlike),
@@ -608,6 +677,16 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
             "UTC session timezone; years 0001-9999 render correctly"),
         extra_check=_check_time_format),
     DT.ToUnixTimestamp: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.ToDate: ExprRule(
+        T.DATETIME_SIG + T.STRING_SIG.with_note(
+            T.StringType,
+            "Spark stringToTimestamp subset; named timezones parse "
+            "as null")),
+    DT.ToTimestamp: ExprRule(
+        T.DATETIME_SIG + T.STRING_SIG.with_note(
+            T.StringType,
+            "Spark stringToTimestamp subset; named timezones parse "
+            "as null")),
     DT.WeekDay: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
     DT.MakeDate: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
     DT.MakeTimestamp: ExprRule(

@@ -59,13 +59,42 @@ class TpuMaterializedScan(SparkPlan):
 class TpuTransitionOverrides:
     @staticmethod
     def apply(root: TpuExec, conf: TpuConf) -> TpuExec:
+        root = TpuTransitionOverrides._coalesce_single_device_shuffle(
+            root, conf)
         root = TpuTransitionOverrides._insert_coalesce(root, conf)
         root = TpuTransitionOverrides._rewrite_topn(root)
         if conf.get(TPU_WHOLESTAGE_FUSION):
             root = fuse_stages(root)
         root = TpuTransitionOverrides._rewrite_ici_agg(root, conf)
         root = TpuTransitionOverrides._rewrite_ici_join(root, conf)
+        root = TpuTransitionOverrides._rewrite_ici_sort(root, conf)
         return root
+
+    @staticmethod
+    def _rewrite_ici_sort(node: TpuExec, conf: TpuConf) -> TpuExec:
+        """ICI mesh mode: a global TpuSortExec becomes the distributed
+        range-exchange sort (sampled global splitters + all-to-all +
+        per-device sort + ordered emit — exec/ici.TpuIciSortExec)."""
+        import jax
+
+        from spark_rapids_tpu.config import (MESH_ENABLED, MESH_EPOCH_BYTES,
+                                             SHUFFLE_MODE)
+        from spark_rapids_tpu.exec.ici import TpuIciSortExec
+
+        node.children = [
+            TpuTransitionOverrides._rewrite_ici_sort(c, conf)
+            if isinstance(c, TpuExec) else c for c in node.children]
+        if not (conf.get(MESH_ENABLED)
+                and str(conf.get(SHUFFLE_MODE)).upper() == "ICI"
+                and len(jax.devices()) > 1):
+            return node
+        if not (isinstance(node, TpuSortExec) and node.is_global):
+            return node
+        from spark_rapids_tpu.config import MESH_DEVICES as _MD
+        from spark_rapids_tpu.parallel.mesh import make_mesh
+
+        return TpuIciSortExec(node, make_mesh(conf.get(_MD) or None),
+                              epoch_bytes=conf.get(MESH_EPOCH_BYTES))
 
     @staticmethod
     def _rewrite_ici_agg(node: TpuExec, conf: TpuConf) -> TpuExec:
@@ -100,9 +129,12 @@ class TpuTransitionOverrides:
         if not (isinstance(partial, TpuHashAggregateExec)
                 and partial.mode == AggregateMode.PARTIAL):
             return node
+        from spark_rapids_tpu.config import MESH_DEVICES, MESH_EPOCH_BYTES
         from spark_rapids_tpu.parallel.mesh import make_mesh
 
-        return TpuIciShuffleAggExec(partial, node, make_mesh())
+        return TpuIciShuffleAggExec(
+            partial, node, make_mesh(conf.get(MESH_DEVICES) or None),
+            epoch_bytes=conf.get(MESH_EPOCH_BYTES))
 
     @staticmethod
     def _rewrite_ici_join(node: TpuExec, conf: TpuConf) -> TpuExec:
@@ -141,11 +173,45 @@ class TpuTransitionOverrides:
         if not all(isinstance(c, TpuShuffleExchangeExec)
                    for c in join.children):
             return node
+        from spark_rapids_tpu.config import MESH_DEVICES
         from spark_rapids_tpu.parallel.mesh import make_mesh
+
+        from spark_rapids_tpu.config import MESH_EPOCH_BYTES as _MEB
 
         return TpuIciShuffleJoinExec(
             join, join.children[0].children[0],
-            join.children[1].children[0], make_mesh())
+            join.children[1].children[0],
+            make_mesh(conf.get(MESH_DEVICES) or None),
+            epoch_bytes=conf.get(_MEB))
+
+    @staticmethod
+    def _coalesce_single_device_shuffle(node: TpuExec,
+                                        conf: TpuConf) -> TpuExec:
+        """AQE-style shuffle partition coalescing for one device: hash/
+        round-robin exchanges repartition for parallelism that a single
+        chip does not have, and every extra partition costs a program
+        launch (and, on a compile-tunnel platform, potentially a compile).
+        Collapse them to a single partition; results are unchanged
+        (aggs/joins are partition-count independent)."""
+        import jax
+
+        from spark_rapids_tpu.config import SINGLE_DEVICE_SHUFFLE_COALESCE
+        from spark_rapids_tpu.plan.nodes import (HashPartitioning,
+                                                 RoundRobinPartitioning,
+                                                 SinglePartitioning)
+
+        node.children = [
+            TpuTransitionOverrides._coalesce_single_device_shuffle(c, conf)
+            if isinstance(c, TpuExec) else c for c in node.children]
+        if not conf.get(SINGLE_DEVICE_SHUFFLE_COALESCE):
+            return node
+        if len(jax.devices()) > 1:
+            return node
+        if isinstance(node, TpuShuffleExchangeExec) and isinstance(
+                node.partitioning,
+                (HashPartitioning, RoundRobinPartitioning)):
+            node.partitioning = SinglePartitioning()
+        return node
 
     @staticmethod
     def _insert_coalesce(node: TpuExec, conf: TpuConf) -> TpuExec:

@@ -121,3 +121,32 @@ def greedy_match_starts(best: jax.Array, lengths: jax.Array):
         step, jnp.zeros(rows, jnp.int32),
         jnp.arange(wp1, dtype=jnp.int32))
     return matched.T, mlen.T
+
+
+def match_length_bounds(pattern: str):
+    """(min_len, max_len) of strings the span-safe pattern can match;
+    max_len is None for unbounded quantifiers.  Used by
+    regexp_extract_all's tag check (bounded element widths)."""
+    node, _, _ = _Parser(pattern).parse()
+
+    def bounds(nd):
+        if isinstance(nd, RLit):
+            return 1, 1
+        if isinstance(nd, RSeq):
+            lo = hi = 0
+            for p in nd.parts:
+                l2, h2 = bounds(p)
+                lo += l2
+                hi = None if hi is None or h2 is None else hi + h2
+            return lo, hi
+        if isinstance(nd, RAlt):
+            los, his = zip(*(bounds(o) for o in nd.options))
+            return min(los), (None if any(h is None for h in his)
+                              else max(his))
+        if isinstance(nd, RRep):
+            l2, h2 = bounds(nd.node)
+            return (l2 * nd.lo,
+                    None if nd.hi is None or h2 is None else h2 * nd.hi)
+        raise RegexUnsupported(f"bounds: {type(nd).__name__}")
+
+    return bounds(node)

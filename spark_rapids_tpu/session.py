@@ -354,6 +354,35 @@ class DataFrame:
             T.StructField(e.name, e.dataType, True) for e in resolved[0]])
         return DataFrame(PN.Expand(resolved, schema, self.plan), self.session)
 
+    def stack(self, n: int, columns, names=None) -> "DataFrame":
+        """stack(n, e1..ek): n rows of k//n columns per input row — planned
+        as Expand with n projection sets (exact Spark semantics: short
+        rows pad with NULL literals).  Reference analog: GpuGenerateExec's
+        stack generator (GpuStack)."""
+        from spark_rapids_tpu.expr.base import Literal
+
+        exprs = [_to_expr(c).resolve(self.schema) for c in columns]
+        k = len(exprs)
+        per = (k + n - 1) // n
+        names = names or [f"col{i}" for i in range(per)]
+        projections = []
+        for r in range(n):
+            row = []
+            for c in range(per):
+                i = r * per + c
+                if i < k:
+                    row.append(exprs[i].alias(names[c]))
+                else:
+                    row.append(Literal(None, exprs[c].dataType)
+                               .alias(names[c]))
+            projections.append(row)
+        resolved = [[e.resolve(self.schema) for e in ps]
+                    for ps in projections]
+        schema = T.StructType([
+            T.StructField(e.name, e.dataType, True) for e in resolved[0]])
+        return DataFrame(PN.Expand(resolved, schema, self.plan),
+                         self.session)
+
     def cache(self) -> "DataFrame":
         """Materialize this DataFrame's batches on first action and reuse
         them (ParquetCachedBatchSerializer analog; device batches held as

@@ -133,3 +133,82 @@ def test_unsupported_cast_falls_back():
         return df.select(col("a").cast(T.STRING).alias("s"))
 
     assert_tpu_fallback_collect(build, "Project")
+
+
+# -- round 3: string -> timestamp/date (variable-width civil grammar) -------
+
+
+_TS_STRINGS = [
+    "2020-05-06 11:12:13", "2020-5-6 1:2:3", "2020-05-06T23:59:59.123456",
+    "2020-05-06 11:12:13.9", "2020-05-06 11:12:13.123456789",
+    "2015-03-18T12:03", "2015-03-18 12", "2015-03-18", "2015-03", "2015",
+    "2020-02-29", "2019-02-29", "2020-13-01", "2020-00-10", "2020-01-32",
+    "  2020-05-06 11:12:13  ", "2020-05-06 11:12:13Z",
+    "2020-05-06 11:12:13+05:30", "2020-05-06 11:12:13-0800",
+    "2020-05-06 11:12:13+5", "2020-05-06 11:12:13+19:00",
+    "2020-05-06 24:00:00", "2020-05-06 11:60:00", "2020-05-06 11:12:60",
+    "garbage", "", "   ", "2020-05-06x", "2020-05-06 11:12:13 extra",
+    "123-05-06", "123456-05-06", "0001-01-01", "9999-12-31 23:59:59",
+    None,
+]
+
+
+def test_cast_string_to_timestamp():
+    def build(s):
+        df = s.create_dataframe(
+            {"s": _TS_STRINGS},
+            T.StructType([T.StructField("s", T.STRING, True)]))
+        return df.select(col("s").cast(T.TIMESTAMP).alias("ts"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_cast_string_to_date_variable_width():
+    strs = ["2020-05-06", "2020-5-6", "2020-05", "2020",
+            "2015-03-18T123123", "2015-03-18 anything", "2015-03-18Xjunk",
+            "2019-02-29", "2020-02-29", "99-01-01", "", "nope", None]
+
+    def build(s):
+        df = s.create_dataframe(
+            {"s": strs}, T.StructType([T.StructField("s", T.STRING, True)]))
+        return df.select(col("s").cast(T.DATE).alias("d"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_to_date_to_timestamp_exprs():
+    from spark_rapids_tpu.expr.datetime import ToDate, ToTimestamp
+
+    def build(s):
+        df = s.create_dataframe(
+            {"s": _TS_STRINGS},
+            T.StructType([T.StructField("s", T.STRING, True)]))
+        return df.select(ToDate(col("s")).alias("d"),
+                         ToTimestamp(col("s")).alias("ts"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_cast_string_to_timestamp_roundtrip_gen():
+    """Generated timestamps render with ts->string then parse back."""
+    def build(s):
+        from data_gen import TimestampGen, gen_df
+
+        df = gen_df(s, [TimestampGen()], ["t"], length=300)
+        return df.select(
+            col("t").cast(T.STRING).cast(T.TIMESTAMP).alias("rt"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_string_to_timestamp_cast_on_tpu():
+    from asserts import assert_plan_on_tpu
+
+    def build(s):
+        df = s.create_dataframe(
+            {"s": ["2020-05-06 11:12:13"] * 8},
+            T.StructType([T.StructField("s", T.STRING)]))
+        return df.select(col("s").cast(T.TIMESTAMP).alias("ts"),
+                         col("s").cast(T.DATE).alias("d"))
+
+    assert_plan_on_tpu(build)

@@ -132,3 +132,19 @@ def test_array_compact_append_prepend():
                          ArrayPrepend(col("a"), col("v")).alias("pp"))
 
     assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_round3_collections_all_on_tpu():
+    """Guard against silent fallbacks: every round-3 collection expr must
+    convert (results matching alone can hide a fallback to the oracle)."""
+    from asserts import assert_plan_on_tpu
+
+    def build(s):
+        df = _map_df(s, n=20)
+        return df.select(
+            TransformKeys(col("m"), "k", "v", col("k") + lit(1)).alias("a"),
+            TransformValues(col("m"), "k", "v", col("v") * lit(2)).alias("b"),
+            MapFilter(col("m"), "k", "v", col("k") > lit(0)).alias("c"),
+            MapContainsKey(col("m"), lit(1)).alias("d"))
+
+    assert_plan_on_tpu(build)

@@ -269,3 +269,42 @@ def test_current_date_timestamp():
             ct = ct.replace(tzinfo=pydt.timezone.utc)
         assert abs((ct - epoch).total_seconds() - now) < 120
         assert cd == pydt.datetime.now(pydt.timezone.utc).date()
+
+
+def test_round3_datetime_all_on_tpu():
+    """Guard against silent fallbacks for the round-3 datetime exprs."""
+    from asserts import assert_plan_on_tpu
+    from spark_rapids_tpu.expr.datetime import (CurrentDate, CurrentTimestamp,
+                                                DateFromUnixDate, MakeDate,
+                                                MakeTimestamp, TimestampMicros,
+                                                TimestampMillis,
+                                                TimestampSeconds, ToDate,
+                                                ToTimestamp, ToUnixTimestamp,
+                                                UnixDate, UnixMicros,
+                                                UnixMillis, UnixSeconds,
+                                                WeekDay)
+    from spark_rapids_tpu.session import lit
+
+    def build(s):
+        df = gen_df(s, [DateGen(), TimestampGen(),
+                        IntegerGen(min_val=1, max_val=9999)],
+                    ["d", "t", "n"], length=20)
+        return df.select(
+            MakeDate(col("n"), lit(5), lit(6)).alias("a"),
+            MakeTimestamp(col("n"), lit(5), lit(6), lit(1), lit(2),
+                          lit(3)).alias("b"),
+            CurrentDate().alias("c"), CurrentTimestamp().alias("cc"),
+            TimestampSeconds(col("n")).alias("e"),
+            TimestampMillis(col("n")).alias("f"),
+            TimestampMicros(col("n")).alias("g"),
+            UnixSeconds(col("t")).alias("h"),
+            UnixMillis(col("t")).alias("i"),
+            UnixMicros(col("t")).alias("j"),
+            UnixDate(col("d")).alias("k"),
+            DateFromUnixDate(col("n")).alias("l"),
+            WeekDay(col("d")).alias("m"),
+            ToUnixTimestamp(col("t")).alias("o"),
+            ToDate(col("d")).alias("p"),
+            ToTimestamp(col("t")).alias("q"))
+
+    assert_plan_on_tpu(build)

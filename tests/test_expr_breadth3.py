@@ -1,0 +1,201 @@
+"""Round-3 expression breadth: regexp_extract_all, overlay/elt/find_in_set,
+bround/width_bucket/factorial/bit_count, nvl2/nullif, ltrim/rtrim, space,
+stack (reference: string_test.py, arithmetic_ops_test.py,
+conditionals_test.py, generate_expr_test.py)."""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.session import col, lit
+
+from asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    assert_tpu_fallback_collect,
+)
+from data_gen import (
+    BooleanGen,
+    DoubleGen,
+    IntegerGen,
+    LongGen,
+    StringGen,
+    gen_df,
+)
+
+
+def test_regexp_extract_all():
+    from spark_rapids_tpu.expr.strings import RegExpExtractAll
+
+    def build(s):
+        df = gen_df(s, [StringGen(min_len=0, max_len=20,
+                                  charset="ab0123 ,-")], ["s"], length=300)
+        return df.select(
+            RegExpExtractAll(col("s"), lit(r"[0-9]{1,4}")).alias("nums"),
+            RegExpExtractAll(col("s"), lit(r"a[b]?")).alias("abs"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_regexp_extract_all_unbounded_falls_back():
+    from spark_rapids_tpu.expr.strings import RegExpExtractAll
+
+    def build(s):
+        df = gen_df(s, [StringGen(min_len=0, max_len=8)], ["s"], length=20)
+        return df.select(
+            RegExpExtractAll(col("s"), lit(r"[0-9]+")).alias("x"))
+
+    assert_tpu_fallback_collect(build, "Project")
+
+
+def test_overlay():
+    from spark_rapids_tpu.expr.strings import Overlay
+
+    def build(s):
+        df = gen_df(s, [StringGen(min_len=0, max_len=10),
+                        StringGen(min_len=0, max_len=4),
+                        IntegerGen(min_val=-2, max_val=12),
+                        IntegerGen(min_val=-1, max_val=6)],
+                    ["s", "r", "p", "l"], length=300)
+        return df.select(Overlay(col("s"), col("r"), col("p")).alias("o1"),
+                         Overlay(col("s"), col("r"), col("p"),
+                                 col("l")).alias("o2"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_find_in_set():
+    from spark_rapids_tpu.expr.strings import FindInSet
+
+    def build(s):
+        df = gen_df(s, [StringGen(min_len=0, max_len=3, charset="abc"),
+                        StringGen(min_len=0, max_len=15, charset="abc,")],
+                    ["s", "lst"], length=300)
+        return df.select(FindInSet(col("s"), col("lst")).alias("i"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_elt_space_trims():
+    from spark_rapids_tpu.expr.strings import (Elt, StringSpace,
+                                               StringTrimLeft,
+                                               StringTrimRight)
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=-1, max_val=4),
+                        StringGen(min_len=0, max_len=6, charset="ab "),
+                        StringGen(min_len=0, max_len=6, charset="cd "),
+                        IntegerGen(min_val=-3, max_val=20)],
+                    ["n", "a", "b", "k"], length=300)
+        return df.select(
+            Elt([col("n"), col("a"), col("b")]).alias("e"),
+            StringSpace(col("k")).alias("sp"),
+            StringTrimLeft(col("a")).alias("lt"),
+            StringTrimRight(col("a")).alias("rt"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_bround_width_bucket():
+    from spark_rapids_tpu.expr.mathfuncs import BRound, WidthBucket
+
+    def build(s):
+        df = gen_df(s, [DoubleGen(no_nans=True),
+                        IntegerGen(min_val=-3, max_val=5),
+                        IntegerGen(min_val=-2, max_val=12)],
+                    ["x", "sc", "nb"], length=300)
+        return df.select(
+            BRound(col("x"), lit(2)).alias("b2"),
+            BRound(col("x"), lit(0)).alias("b0"),
+            WidthBucket(col("x"), lit(-5.0), lit(5.0),
+                        col("nb")).alias("wb"),
+            WidthBucket(col("x"), lit(5.0), lit(-5.0),
+                        lit(4)).alias("wbd"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_factorial_bit_count():
+    from spark_rapids_tpu.expr.mathfuncs import BitwiseCount, Factorial
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=-3, max_val=25), LongGen(),
+                        BooleanGen()], ["n", "x", "b"], length=300)
+        return df.select(Factorial(col("n")).alias("f"),
+                         BitwiseCount(col("x")).alias("bc"),
+                         BitwiseCount(col("b")).alias("bb"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_nvl2_nullif():
+    from spark_rapids_tpu.expr.conditional import Nvl2, NullIf
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(), IntegerGen(), IntegerGen()],
+                    ["a", "b", "c"], length=300)
+        return df.select(Nvl2(col("a"), col("b"), col("c")).alias("n2"),
+                         NullIf(col("a"), col("b")).alias("ni"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_nullif_strings():
+    from spark_rapids_tpu.expr.conditional import NullIf
+
+    def build(s):
+        df = gen_df(s, [StringGen(min_len=0, max_len=3, charset="ab"),
+                        StringGen(min_len=0, max_len=3, charset="ab")],
+                    ["a", "b"], length=200)
+        return df.select(NullIf(col("a"), col("b")).alias("ni"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_stack():
+    def build(s):
+        df = gen_df(s, [IntegerGen(), IntegerGen(), IntegerGen()],
+                    ["a", "b", "c"], length=200)
+        return df.stack(2, [col("a"), col("b"), col("c"), lit(7)],
+                        names=["x", "y"])
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_stack_uneven():
+    def build(s):
+        df = gen_df(s, [IntegerGen(), IntegerGen(), IntegerGen()],
+                    ["a", "b", "c"], length=200)
+        return df.stack(2, [col("a"), col("b"), col("c")])
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_round3_breadth_all_on_tpu():
+    """Guard against silent fallbacks for the round-3 string/math exprs."""
+    from asserts import assert_plan_on_tpu
+    from spark_rapids_tpu.expr.conditional import Nvl2, NullIf
+    from spark_rapids_tpu.expr.mathfuncs import (BitwiseCount, BRound,
+                                                 Factorial, WidthBucket)
+    from spark_rapids_tpu.expr.strings import (Elt, FindInSet, Overlay,
+                                               RegExpExtractAll,
+                                               StringSpace, StringTrimLeft,
+                                               StringTrimRight)
+
+    def build(s):
+        df = gen_df(s, [StringGen(min_len=0, max_len=8),
+                        IntegerGen(), DoubleGen(no_nans=True), LongGen()],
+                    ["s", "n", "x", "l"], length=20)
+        return df.select(
+            RegExpExtractAll(col("s"), lit(r"[0-9]{1,4}")).alias("a"),
+            Overlay(col("s"), col("s"), lit(2)).alias("b"),
+            FindInSet(col("s"), col("s")).alias("c"),
+            Elt([col("n"), col("s"), col("s")]).alias("d"),
+            StringSpace(col("n")).alias("e"),
+            StringTrimLeft(col("s")).alias("f"),
+            StringTrimRight(col("s")).alias("g"),
+            BRound(col("x"), lit(2)).alias("h"),
+            WidthBucket(col("x"), lit(-5.0), lit(5.0), lit(4)).alias("i"),
+            Factorial(col("n")).alias("j"),
+            BitwiseCount(col("l")).alias("k"),
+            Nvl2(col("n"), col("l"), lit(0)).alias("m"),
+            NullIf(col("n"), lit(3)).alias("o"))
+
+    assert_plan_on_tpu(build)

@@ -224,3 +224,223 @@ def test_ici_join_plan_is_installed():
     q = left.join(right, on=["k"])
     root, meta = q._planned()
     assert "TpuIciShuffleJoin" in root.pretty(), root.pretty()
+
+
+# -- round 3: epoch streaming, distributed sort, device-count sweep ---------
+
+
+@needs_mesh
+def test_ici_epoch_streamed_agg():
+    """Input far above one epoch's bytes streams through the accumulator
+    (multi-epoch path: partial -> a2a -> merge-into-acc per epoch)."""
+    import sys
+    sys.path.insert(0, "tests")
+    from asserts import assert_tpu_and_cpu_are_equal_collect
+    from data_gen import IntegerGen, gen_df
+    from spark_rapids_tpu.session import col, count_, sum_
+
+    conf = dict(_ICI_CONF)
+    conf["spark.rapids.tpu.mesh.epochTargetBytes"] = 4096
+    conf["spark.rapids.sql.batchSizeBytes"] = 4096
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=40),
+                        IntegerGen(min_val=-100, max_val=100)],
+                    ["k", "v"], length=3000)
+        return df.group_by("k").agg(sum_("v", "s"), count_(col("v"), "c"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=conf)
+
+
+@needs_mesh
+def test_ici_epoch_streamed_global_agg():
+    import sys
+    sys.path.insert(0, "tests")
+    from asserts import assert_tpu_and_cpu_are_equal_collect
+    from data_gen import LongGen, gen_df
+    from spark_rapids_tpu.session import count_, sum_
+
+    conf = dict(_ICI_CONF)
+    conf["spark.rapids.tpu.mesh.epochTargetBytes"] = 4096
+    conf["spark.rapids.sql.batchSizeBytes"] = 4096
+
+    def build(s):
+        df = gen_df(s, [LongGen(min_val=-10**6, max_val=10**6)], ["v"],
+                    length=2500)
+        return df.agg(sum_("v", "s"), count_(None, "c"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=conf)
+
+
+@needs_mesh
+def test_ici_distributed_sort():
+    """Global order_by runs as the range-exchange mesh sort and emits the
+    exact oracle order."""
+    import sys
+    sys.path.insert(0, "tests")
+    from asserts import assert_tpu_and_cpu_are_equal_collect
+    from data_gen import IntegerGen, StringGen, gen_df
+    from spark_rapids_tpu.session import col
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=-1000, max_val=1000),
+                        StringGen(min_len=0, max_len=6)],
+                    ["v", "t"], length=900)
+        return df.order_by(col("v"), col("t"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=_ICI_CONF,
+                                         ignore_order=False)
+
+
+@needs_mesh
+def test_ici_distributed_sort_desc_nulls():
+    import sys
+    sys.path.insert(0, "tests")
+    from asserts import assert_tpu_and_cpu_are_equal_collect
+    from data_gen import IntegerGen, gen_df
+    from spark_rapids_tpu.session import col
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=-50, max_val=50),
+                        IntegerGen()], ["v", "x"], length=600)
+        return df.order_by(col("v"), ascending=False)
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=_ICI_CONF,
+                                         ignore_order=False)
+
+
+@needs_mesh
+def test_ici_distributed_sort_multi_epoch():
+    """Sort input spanning several epochs still emits globally ordered."""
+    import sys
+    sys.path.insert(0, "tests")
+    from asserts import assert_tpu_and_cpu_are_equal_collect
+    from data_gen import IntegerGen, gen_df
+    from spark_rapids_tpu.session import col
+
+    conf = dict(_ICI_CONF)
+    conf["spark.rapids.tpu.mesh.epochTargetBytes"] = 4096
+    conf["spark.rapids.sql.batchSizeBytes"] = 4096
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=-10**6, max_val=10**6)],
+                    ["v"], length=2500)
+        return df.order_by(col("v"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=conf,
+                                         ignore_order=False)
+
+
+@needs_mesh
+def test_ici_sort_installed():
+    from data_gen import IntegerGen, gen_df
+    from spark_rapids_tpu.exec.ici import TpuIciSortExec
+    from spark_rapids_tpu.session import TpuSession, col
+
+    s = TpuSession(dict(_ICI_CONF))
+    df = gen_df(s, [IntegerGen()], ["v"], length=64)
+    root, _ = df.order_by(col("v"))._planned()
+
+    def find(n):
+        if isinstance(n, TpuIciSortExec):
+            return True
+        return any(find(c) for c in n.children
+                   if hasattr(c, "children"))
+
+    assert find(root), f"no TpuIciSortExec in plan: {root.describe()}"
+
+
+@needs_mesh
+@pytest.mark.parametrize("n_dev", [2, 3, 5, 8])
+def test_ici_device_count_sweep(n_dev):
+    """Non-power-of-2 meshes: quota/padding math must hold for every
+    device count (VERDICT r2 weak #9)."""
+    import sys
+    sys.path.insert(0, "tests")
+    from asserts import assert_tpu_and_cpu_are_equal_collect
+    from data_gen import IntegerGen, gen_df
+    from spark_rapids_tpu.session import col, count_, sum_
+
+    conf = dict(_ICI_CONF)
+    conf["spark.rapids.tpu.mesh.devices"] = n_dev
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=15),
+                        IntegerGen(min_val=-100, max_val=100)],
+                    ["k", "v"], length=500)
+        return df.group_by("k").agg(sum_("v", "s"), count_(col("v"), "c"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=conf)
+
+
+@needs_mesh
+@pytest.mark.parametrize("n_dev", [3, 5])
+def test_ici_sort_device_count_sweep(n_dev):
+    import sys
+    sys.path.insert(0, "tests")
+    from asserts import assert_tpu_and_cpu_are_equal_collect
+    from data_gen import IntegerGen, gen_df
+    from spark_rapids_tpu.session import col
+
+    conf = dict(_ICI_CONF)
+    conf["spark.rapids.tpu.mesh.devices"] = n_dev
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=-500, max_val=500)], ["v"],
+                    length=400)
+        return df.order_by(col("v"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=conf,
+                                         ignore_order=False)
+
+
+@needs_mesh
+def test_ici_right_full_joins_fall_back_with_reason():
+    """RIGHT/FULL mesh joins keep the single-chip exec (visible reason in
+    the ICI plan decision, not a crash)."""
+    from data_gen import IntegerGen, gen_df
+    from spark_rapids_tpu.exec.ici import TpuIciShuffleJoinExec
+    from spark_rapids_tpu.session import TpuSession
+
+    s = TpuSession(dict(_ICI_CONF))
+    l = gen_df(s, [IntegerGen(min_val=0, max_val=9), IntegerGen()],
+               ["k", "a"], length=64)
+    r = gen_df(s, [IntegerGen(min_val=0, max_val=9), IntegerGen()],
+               ["k", "b"], length=64)
+    for how in ("right", "full"):
+        root, _ = l.join(r, on="k", how=how)._planned()
+
+        def find(n):
+            if isinstance(n, TpuIciShuffleJoinExec):
+                return True
+            return any(find(c) for c in n.children
+                       if hasattr(c, "children"))
+
+        assert not find(root), f"{how} join must not use the ICI exec"
+        # and it still computes correctly through the single-chip path
+        assert l.join(r, on="k", how=how).collect() is not None
+
+
+@needs_mesh
+def test_ici_join_probe_epochs():
+    """Probe side spanning several epochs: per-device memory = build side
+    + one epoch; every epoch's matches stream out."""
+    import sys
+    sys.path.insert(0, "tests")
+    from asserts import assert_tpu_and_cpu_are_equal_collect
+    from data_gen import IntegerGen, gen_df
+
+    conf = dict(_ICI_CONF)
+    conf["spark.rapids.tpu.mesh.epochTargetBytes"] = 4096
+    conf["spark.rapids.sql.reader.batchSizeRows"] = 256
+    conf["spark.sql.autoBroadcastJoinThreshold"] = "-1"
+
+    def build(s):
+        left = gen_df(s, [IntegerGen(min_val=0, max_val=30, nullable=False),
+                          IntegerGen()], ["k", "v"], length=2000)
+        right = gen_df(s, [IntegerGen(min_val=10, max_val=40,
+                                      nullable=False),
+                           IntegerGen()], ["k", "w"], length=300)
+        return left.join(right, on="k", how="left")
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=conf)
