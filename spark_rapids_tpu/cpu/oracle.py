@@ -3766,12 +3766,17 @@ def _cpu_file_scan(plan: PN.FileSourceScan):
             import pyarrow.orc as paorc
 
             tables.append(paorc.ORCFile(p).read())
-        elif plan.fmt == "csv":
-            tables.append(pacsv.read_csv(p))
-        elif plan.fmt == "json":
-            import pyarrow.json as pajson
+        elif plan.fmt in ("csv", "json"):
+            import pyarrow as pa
 
-            tables.append(pajson.read_json(p))
+            from spark_rapids_tpu.io.text import (read_csv_spark,
+                                                  read_json_spark)
+
+            rd = read_csv_spark if plan.fmt == "csv" else read_json_spark
+            tcols, _ = rd(p, plan.output, plan.options)
+            tables.append(pa.table(
+                {f.name: c.to_arrow()
+                 for f, c in zip(plan.output.fields, tcols)}))
         elif plan.fmt == "avro":
             import pyarrow as pa
 

@@ -1,0 +1,205 @@
+"""Delta deletion vectors — RoaringBitmapArray decode + Z85 paths.
+
+Reference analog: the reference's Delta modules read deletion vectors so
+DML on DV-enabled tables stays on the GPU (SURVEY.md §2.8 "deletion
+vectors").  A deletion vector marks deleted ROW INDICES of one data file:
+
+  deletionVector: {storageType: 'u'|'i'|'p', pathOrInlineDv, offset?,
+                   sizeInBytes, cardinality}
+
+  * 'i': pathOrInlineDv is the Z85-encoded serialized bitmap itself
+  * 'u': pathOrInlineDv is [optional random prefix]<20-char Z85 UUID>;
+         the bytes live in <table>/[prefix/]deletion_vector_<uuid>.bin at
+         ``offset`` (int32 big-endian size, then the bitmap, then CRC32)
+  * 'p': an absolute path to such a .bin file
+
+The serialized form is Delta's *portable* RoaringBitmapArray: little-
+endian magic 1681511377, int64 bitmap count, then per 32-bit roaring
+bitmap an int32 key plus the standard roaring serialization (array /
+bitmap / run containers — RoaringFormatSpec).  Absolute row index =
+key << 32 | container value.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import uuid as _uuid
+from typing import List, Optional
+
+_MAGIC = 1681511377
+_SERIAL_COOKIE_NO_RUN = 12346
+_SERIAL_COOKIE = 12347
+
+_Z85_CHARS = ("0123456789abcdefghijklmnopqrstuvwxyz"
+              "ABCDEFGHIJKLMNOPQRSTUVWXYZ.-:+=^!/*?&<>()[]{}@%$#")
+_Z85_INDEX = {c: i for i, c in enumerate(_Z85_CHARS)}
+
+
+def z85_decode(s: str) -> bytes:
+    if len(s) % 5:
+        raise ValueError("z85 length must be a multiple of 5")
+    out = bytearray()
+    for i in range(0, len(s), 5):
+        v = 0
+        for ch in s[i:i + 5]:
+            v = v * 85 + _Z85_INDEX[ch]
+        out += v.to_bytes(4, "big")
+    return bytes(out)
+
+
+def z85_encode(b: bytes) -> str:
+    if len(b) % 4:
+        raise ValueError("z85 input must be a multiple of 4 bytes")
+    out = []
+    for i in range(0, len(b), 4):
+        v = int.from_bytes(b[i:i + 4], "big")
+        chunk = []
+        for _ in range(5):
+            v, r = divmod(v, 85)
+            chunk.append(_Z85_CHARS[r])
+        out.extend(reversed(chunk))
+    return "".join(out)
+
+
+def _decode_roaring32(buf: bytes, off: int):
+    """One standard 32-bit roaring bitmap at ``off`` -> (values, new off)."""
+    cookie = struct.unpack_from("<I", buf, off)[0]
+    vals: List[int] = []
+    if (cookie & 0xFFFF) == _SERIAL_COOKIE:
+        n = (cookie >> 16) + 1
+        off += 4
+        run_flags = buf[off: off + (n + 7) // 8]
+        off += (n + 7) // 8
+        has_offsets = n >= 4
+    elif cookie == _SERIAL_COOKIE_NO_RUN:
+        n = struct.unpack_from("<I", buf, off + 4)[0]
+        off += 8
+        run_flags = b"\x00" * ((n + 7) // 8)
+        has_offsets = True
+    else:
+        raise ValueError(f"bad roaring cookie {cookie}")
+    keys = []
+    cards = []
+    for i in range(n):
+        k, c = struct.unpack_from("<HH", buf, off)
+        off += 4
+        keys.append(k)
+        cards.append(c + 1)
+    if has_offsets:
+        off += 4 * n  # container offsets (we read sequentially)
+    for i in range(n):
+        base = keys[i] << 16
+        is_run = (run_flags[i // 8] >> (i % 8)) & 1
+        if is_run:
+            nruns = struct.unpack_from("<H", buf, off)[0]
+            off += 2
+            for _ in range(nruns):
+                start, length = struct.unpack_from("<HH", buf, off)
+                off += 4
+                vals.extend(base + v
+                            for v in range(start, start + length + 1))
+        elif cards[i] > 4096:  # bitmap container: 8 KiB bitset
+            words = struct.unpack_from("<1024Q", buf, off)
+            off += 8192
+            for wi, w in enumerate(words):
+                while w:
+                    b = w & -w
+                    vals.append(base + (wi << 6) + b.bit_length() - 1)
+                    w ^= b
+        else:  # array container
+            arr = struct.unpack_from(f"<{cards[i]}H", buf, off)
+            off += 2 * cards[i]
+            vals.extend(base + v for v in arr)
+    return vals, off
+
+
+def decode_roaring_array(buf: bytes) -> List[int]:
+    """Delta portable RoaringBitmapArray -> sorted absolute row indices."""
+    magic = struct.unpack_from("<i", buf, 0)[0]
+    if magic != _MAGIC:
+        raise ValueError(f"bad deletion vector magic {magic}")
+    nmaps = struct.unpack_from("<q", buf, 4)[0]
+    off = 12
+    out: List[int] = []
+    for _ in range(nmaps):
+        key = struct.unpack_from("<i", buf, off)[0]
+        off += 4
+        vals, off = _decode_roaring32(buf, off)
+        out.extend((key << 32) | v for v in vals)
+    return sorted(out)
+
+
+def encode_roaring_array(indices) -> bytes:
+    """Serialize row indices as a portable RoaringBitmapArray (array
+    containers only) — used by the DV writer and tests."""
+    by_key = {}
+    for idx in sorted(set(int(i) for i in indices)):
+        by_key.setdefault(idx >> 32, []).append(idx & 0xFFFFFFFF)
+    out = bytearray(struct.pack("<iq", _MAGIC, len(by_key)))
+    for key in sorted(by_key):
+        vals = by_key[key]
+        containers = {}
+        for v in vals:
+            containers.setdefault(v >> 16, []).append(v & 0xFFFF)
+        out += struct.pack("<i", key)
+        n = len(containers)
+        out += struct.pack("<II", _SERIAL_COOKIE_NO_RUN, n)
+        for k in sorted(containers):
+            out += struct.pack("<HH", k, len(containers[k]) - 1)
+        # offsets (array containers <=4096 values; bitmap containers
+        # above — the spec's mandatory container choice)
+        sizes = [8192 if len(containers[k]) > 4096
+                 else 2 * len(containers[k]) for k in sorted(containers)]
+        base = len(out) + 4 * n
+        pos = 0
+        for sz in sizes:
+            out += struct.pack("<I", base + pos)
+            pos += sz
+        for k in sorted(containers):
+            vals = sorted(containers[k])
+            if len(vals) > 4096:
+                words = [0] * 1024
+                for v in vals:
+                    words[v >> 6] |= 1 << (v & 63)
+                out += struct.pack("<1024Q", *words)
+            else:
+                out += struct.pack(f"<{len(vals)}H", *vals)
+    return bytes(out)
+
+
+def read_dv_indices(table_path: str, dv: dict) -> List[int]:
+    """deletionVector action dict -> sorted deleted row indices."""
+    st = dv.get("storageType", "u")
+    body = dv["pathOrInlineDv"]
+    if st == "i":
+        return decode_roaring_array(z85_decode(body))
+    if st == "p":
+        path = body
+        prefix = ""
+    else:  # 'u': [random prefix]<20-char z85 uuid>
+        enc = body[-20:]
+        prefix = body[:-20]
+        u = _uuid.UUID(bytes=z85_decode(enc))
+        path = os.path.join(table_path, prefix,
+                            f"deletion_vector_{u}.bin")
+    with open(path, "rb") as f:
+        data = f.read()
+    off = int(dv.get("offset", 1))
+    size = struct.unpack_from(">i", data, off)[0]
+    return decode_roaring_array(data[off + 4: off + 4 + size])
+
+
+def write_dv_file(table_path: str, indices) -> dict:
+    """Write a deletion-vector .bin and return its action dict."""
+    import zlib
+
+    payload = encode_roaring_array(indices)
+    u = _uuid.uuid4()
+    name = f"deletion_vector_{u}.bin"
+    blob = (b"\x01" + struct.pack(">i", len(payload)) + payload
+            + struct.pack(">I", zlib.crc32(payload)))
+    with open(os.path.join(table_path, name), "wb") as f:
+        f.write(blob)
+    return {"storageType": "u", "pathOrInlineDv": z85_encode(u.bytes),
+            "offset": 1, "sizeInBytes": len(payload),
+            "cardinality": len(set(int(i) for i in indices))}

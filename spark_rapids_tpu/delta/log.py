@@ -92,6 +92,7 @@ class AddFile:
     modificationTime: int
     dataChange: bool = True
     stats: Optional[str] = None
+    deletionVector: Optional[dict] = None  # delta/dv.py decodes these
 
 
 @dataclasses.dataclass
@@ -188,7 +189,8 @@ class DeltaLog:
             files[a["path"]] = AddFile(
                 a["path"], a.get("partitionValues", {}),
                 a.get("size", 0), a.get("modificationTime", 0),
-                a.get("dataChange", True), a.get("stats"))
+                a.get("dataChange", True), a.get("stats"),
+                a.get("deletionVector"))
         elif "remove" in action:
             files.pop(action["remove"]["path"], None)
         return schema, part_cols, meta_id

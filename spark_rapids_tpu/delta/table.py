@@ -287,7 +287,24 @@ def read_delta(session, path: str, version: Optional[int] = None):
     if not paths:
         return session.create_dataframe(
             {f.name: [] for f in snap.schema.fields}, snap.schema)
+    if any(f.deletionVector for f in snap.files):
+        return _read_with_deletion_vectors(session, path, snap)
     return session.read.schema(snap.schema).parquet(*paths)
+
+
+def _read_with_deletion_vectors(session, path: str, snap):
+    """Merge-on-read: drop each file's DV-marked row indices while
+    assembling the scan (io/mor.py, shared with Iceberg position
+    deletes)."""
+    from spark_rapids_tpu.delta.dv import read_dv_indices
+    from spark_rapids_tpu.io.mor import read_parquet_minus_rows
+
+    files = []
+    for af in snap.files:
+        gone = (read_dv_indices(path, af.deletionVector)
+                if af.deletionVector else None)
+        files.append((os.path.join(path, af.path), gone))
+    return read_parquet_minus_rows(session, files, snap.schema)
 
 
 def write_delta(df, path: str, mode: str = "error",

@@ -7,8 +7,13 @@ the highest ``vN.metadata.json``), current snapshot -> manifest LIST
 (Avro) -> manifests (Avro) -> live parquet data files; the engine's
 regular parquet scan reads the data.
 
-Supported subset: parquet data files, append-only tables (no position /
-equality deletes — those raise), flat primitive schemas.
+Supported subset: parquet data files, flat primitive schemas, v2
+position deletes (file_path/pos parquet files, applied while assembling
+the scan) and equality deletes (applied as device ANTI joins against the
+delete rows).  Limits: equality deletes apply to the whole snapshot —
+sequence-number scoping (re-inserts after a delete) is not implemented
+and such tables read incorrectly (undetected); null values in equality
+delete rows raise (the anti join cannot match null==null).
 """
 from __future__ import annotations
 
@@ -85,16 +90,28 @@ def _latest_metadata(table_path: str) -> str:
     return best[1]
 
 
+def _field_id_names(meta: dict) -> dict:
+    schemas = meta.get("schemas")
+    if schemas:
+        sid = meta.get("current-schema-id", 0)
+        schema = next((s for s in schemas if s.get("schema-id") == sid),
+                      schemas[-1])
+    else:
+        schema = meta["schema"]
+    return {f["id"]: f["name"] for f in schema["fields"] if "id" in f}
+
+
 def iceberg_data_files(table_path: str,
-                       snapshot_id: Optional[int] = None
-                       ) -> Tuple[List[str], T.StructType]:
-    """-> (live parquet data file paths, table schema)."""
+                       snapshot_id: Optional[int] = None):
+    """-> (live data paths, position-delete paths, equality deletes as
+    (path, [column names]) pairs, table schema)."""
     with open(_latest_metadata(table_path)) as f:
         meta = json.load(f)
     schema = _schema_from_metadata(meta)
+    id_names = _field_id_names(meta)
     snaps = meta.get("snapshots", [])
     if not snaps:
-        return [], schema
+        return [], [], [], schema
     sid = snapshot_id if snapshot_id is not None \
         else meta.get("current-snapshot-id")
     snap = next((s for s in snaps if s.get("snapshot-id") == sid),
@@ -102,12 +119,9 @@ def iceberg_data_files(table_path: str,
     mlist = _resolve(table_path, snap["manifest-list"])
     _, entries = read_avro_file(mlist)
     paths: List[str] = []
+    pos_deletes: List[str] = []
+    eq_deletes: List[Tuple[str, List[str]]] = []
     for entry in entries:
-        content = entry.get("content", 0)
-        if content not in (None, 0):
-            raise ValueError(
-                "iceberg delete manifests are not supported (append-only "
-                "tables)")
         mpath = _resolve(table_path, entry["manifest_path"])
         _, files = read_avro_file(mpath)
         for fe in files:
@@ -115,26 +129,85 @@ def iceberg_data_files(table_path: str,
             if status == 2:  # DELETED
                 continue
             df = fe["data_file"]
-            if isinstance(df.get("content"), int) and df["content"] != 0:
-                raise ValueError("iceberg delete files are not supported")
             fmt = (df.get("file_format") or "PARQUET")
             if str(fmt).upper() != "PARQUET":
                 raise ValueError(f"iceberg {fmt} data files not supported")
-            paths.append(_resolve(table_path, df["file_path"]))
+            content = df.get("content") or 0
+            fp = _resolve(table_path, df["file_path"])
+            if content == 0:
+                paths.append(fp)
+            elif content == 1:  # position deletes
+                pos_deletes.append(fp)
+            elif content == 2:  # equality deletes
+                ids = df.get("equality_ids") or []
+                names = [id_names[i] for i in ids if i in id_names]
+                if not names:
+                    raise ValueError(
+                        "iceberg equality delete without resolvable "
+                        "equality_ids")
+                eq_deletes.append((fp, names))
+            else:
+                raise ValueError(f"iceberg delete content {content}")
     # manifests replay newest-first; drop duplicates, keep order
-    seen = set()
-    uniq = []
-    for p in paths:
-        if p not in seen:
-            seen.add(p)
-            uniq.append(p)
-    return uniq, schema
+    def uniq(seq):
+        seen = set()
+        out = []
+        for x in seq:
+            key = x if isinstance(x, str) else x[0]
+            if key not in seen:
+                seen.add(key)
+                out.append(x)
+        return out
+
+    return uniq(paths), uniq(pos_deletes), uniq(eq_deletes), schema
+
+
+def _apply_position_deletes(session, paths, pos_delete_paths, schema):
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.io.mor import read_parquet_minus_rows
+
+    dropped = {}
+    for dp in pos_delete_paths:
+        t = pq.read_table(dp)
+        for fp, pos in zip(t.column("file_path").to_pylist(),
+                           t.column("pos").to_pylist()):
+            dropped.setdefault(_norm_path(fp), set()).add(int(pos))
+    return read_parquet_minus_rows(
+        session, [(p, dropped.get(_norm_path(p))) for p in paths], schema)
+
+
+def _norm_path(p: str) -> str:
+    return p[len("file://"):] if p.startswith("file://") else p
 
 
 def read_iceberg(session, table_path: str,
                  snapshot_id: Optional[int] = None):
-    paths, schema = iceberg_data_files(table_path, snapshot_id)
+    paths, pos_del, eq_del, schema = iceberg_data_files(
+        table_path, snapshot_id)
     if not paths:
         return session.create_dataframe(
             {f.name: [] for f in schema.fields}, schema)
-    return session.read.schema(schema).parquet(*paths)
+    if pos_del:
+        df = _apply_position_deletes(session, paths, pos_del, schema)
+    else:
+        df = session.read.schema(schema).parquet(*paths)
+    # equality deletes: device ANTI join against the delete rows (the
+    # engine-join design Delta MERGE uses)
+    for dp, names in eq_del:
+        import pyarrow.parquet as pq
+
+        t = pq.read_table(dp, columns=names)
+        dschema = T.StructType(
+            [f for f in schema.fields if f.name in names])
+        data = {f.name: t.column(f.name).to_pylist()
+                for f in dschema.fields}
+        if any(v is None for vals in data.values() for v in vals):
+            # the spec matches null==null in equality deletes; the anti
+            # join cannot, so reject rather than silently keep the rows
+            raise ValueError(
+                "iceberg equality deletes with null values are not "
+                "supported")
+        ddf = session.create_dataframe(data, dschema)
+        df = df.join(ddf, on=names, how="left_anti")
+    return df

@@ -1,0 +1,34 @@
+"""Merge-on-read assembly: parquet files minus deleted row indices.
+
+Shared by the Iceberg position-delete reader and the Delta deletion-vector
+reader (the reference applies these inside its GPU parquet readers; here
+per-file row positions do not survive the concatenating scan, so the take
+happens while building the batch)."""
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence, Tuple
+
+
+def read_parquet_minus_rows(session, files, schema):
+    """files: [(path, deleted_row_indices_or_None)] -> DataFrame."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.columnar.column import HostColumn
+    from spark_rapids_tpu.plan.nodes import LocalTableScan
+    from spark_rapids_tpu.session import DataFrame
+
+    names = [f.name for f in schema.fields]
+    tables = []
+    for path, gone in files:
+        t = pq.read_table(path, columns=names)
+        if gone:
+            keep = np.setdiff1d(np.arange(t.num_rows),
+                                np.asarray(sorted(gone), dtype=np.int64))
+            t = t.take(pa.array(keep))
+        tables.append(t)
+    tbl = pa.concat_tables(tables)
+    cols = [HostColumn.from_arrow(tbl.column(f.name), f.dataType)
+            for f in schema.fields]
+    return DataFrame(LocalTableScan(cols, schema), session)

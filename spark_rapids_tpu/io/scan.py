@@ -133,14 +133,18 @@ class TpuFileSourceScanExec(TpuExec):
 
                 tbl = paorc.ORCFile(path).read(
                     columns=[f.name for f in self.plan.output.fields])
-            elif self.plan.fmt == "csv":
-                import pyarrow.csv as pacsv
+            elif self.plan.fmt in ("csv", "json"):
+                # Spark-strict parse (PERMISSIVE/_corrupt_record etc.) —
+                # io/text.py, shared with the CPU oracle
+                from spark_rapids_tpu.io.text import (read_csv_spark,
+                                                      read_json_spark)
 
-                tbl = pacsv.read_csv(path)
-            elif self.plan.fmt == "json":
-                import pyarrow.json as pajson
-
-                tbl = pajson.read_json(path)
+                rd = (read_csv_spark if self.plan.fmt == "csv"
+                      else read_json_spark)
+                cols, _ = rd(path, self.plan.output, self.plan.options)
+                tbl = pa.table(
+                    {f.name: c.to_arrow()
+                     for f, c in zip(self.plan.output.fields, cols)})
             elif self.plan.fmt == "avro":
                 from spark_rapids_tpu.io.avro import read_avro_columns
 

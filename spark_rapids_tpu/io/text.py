@@ -1,0 +1,270 @@
+"""Spark-strict CSV / JSON-lines parsing.
+
+Reference analog: GpuTextBasedPartitionReader + GpuCSVScan / GpuJsonScan
+(SURVEY.md §2.6 CSV/JSON row): the reference reproduces Spark's Univocity/
+Jackson parse semantics in cuDF kernels; here the host parse (sanctioned by
+SURVEY §2.10 item 10 — "host parse -> device, then incremental Pallas")
+reproduces them in one place shared by the device pipeline and the CPU
+oracle, with pinned-expectation tests guarding the semantics.
+
+Supported semantics (the PERMISSIVE core):
+
+  * modes: PERMISSIVE (default), DROPMALFORMED, FAILFAST
+  * ``columnNameOfCorruptRecord`` (default ``_corrupt_record``): when that
+    column appears in the schema, malformed records land there as the raw
+    line while successfully-converted fields keep their values (Spark
+    PERMISSIVE keeps partial rows)
+  * CSV: header/sep/quote options; a record is malformed when its token
+    count differs from the schema or any field fails conversion; empty
+    tokens (== ``nullValue``, default "") are null
+  * CSV field conversion is Spark-strict: integers reject decimals and
+    wrap-only values, booleans are true/false (case-insensitive), date/
+    timestamp use the cast grammar (expr/cast.py twin _str_to_date_py /
+    _str_to_ts_py), decimals HALF_UP-quantize and range-check
+  * JSON lines: a record is malformed when the line is not a JSON object;
+    missing fields are null; a present field of the wrong JSON type is
+    null (numbers render into string fields like Spark's literal-text
+    coercion); nested values into scalar fields are null
+"""
+from __future__ import annotations
+
+import json as _json
+import math
+from decimal import ROUND_HALF_UP, Decimal, InvalidOperation
+from typing import List, Optional
+
+from spark_rapids_tpu import types as T
+
+DEFAULT_CORRUPT_COL = "_corrupt_record"
+
+_I_RANGE = {T.ByteType: (-2**7, 2**7 - 1), T.ShortType: (-2**15, 2**15 - 1),
+            T.IntegerType: (-2**31, 2**31 - 1),
+            T.LongType: (-2**63, 2**63 - 1)}
+
+
+class _FieldError(Exception):
+    pass
+
+
+def _convert_csv_field(tok: Optional[str], dt: T.DataType,
+                       null_value: str):
+    """One CSV token -> python storage value (or None); raises _FieldError
+    on a Spark-invalid token."""
+    if tok is None or tok == null_value:
+        return None
+    if isinstance(dt, T.StringType):
+        return tok
+    if isinstance(dt, T.BooleanType):
+        low = tok.strip().lower()
+        if low == "true":
+            return True
+        if low == "false":
+            return False
+        raise _FieldError(tok)
+    s = tok.strip()
+    if not s:
+        return None
+    if dt.is_integral:
+        body = s[1:] if s[:1] in "+-" else s
+        if not body.isdigit():
+            raise _FieldError(tok)
+        v = int(s)
+        lo, hi = _I_RANGE[type(dt)]
+        if not lo <= v <= hi:
+            raise _FieldError(tok)
+        return v
+    if isinstance(dt, (T.FloatType, T.DoubleType)):
+        try:
+            return float(s)
+        except ValueError:
+            raise _FieldError(tok)
+    if isinstance(dt, T.DecimalType):
+        try:
+            d = Decimal(s)
+        except InvalidOperation:
+            raise _FieldError(tok)
+        scaled = int(d.scaleb(dt.scale).quantize(
+            Decimal(1), rounding=ROUND_HALF_UP))
+        if abs(scaled) >= 10 ** dt.precision:
+            raise _FieldError(tok)
+        return scaled
+    if isinstance(dt, T.DateType):
+        from spark_rapids_tpu.cpu.oracle import _str_to_date_py
+
+        days = _str_to_date_py(s)
+        if days is None:
+            raise _FieldError(tok)
+        return days
+    if isinstance(dt, T.TimestampType):
+        from spark_rapids_tpu.cpu.oracle import _str_to_ts_py
+
+        micros = _str_to_ts_py(s)
+        if micros is None:
+            raise _FieldError(tok)
+        return micros
+    raise _FieldError(f"unsupported CSV type {dt.simpleString}")
+
+
+def _finish(rows, schema: T.StructType):
+    """rows: list of per-field python value lists -> HostColumns."""
+    from spark_rapids_tpu.columnar.column import HostColumn
+
+    cols = []
+    for i, f in enumerate(schema.fields):
+        vals = [r[i] for r in rows]
+        cols.append(HostColumn.from_pylist(vals, f.dataType))
+    return cols, len(rows)
+
+
+def read_csv_spark(path: str, schema: T.StructType, options: dict):
+    """Spark-semantic CSV read -> (HostColumns, row count)."""
+    import csv as _csv
+
+    mode = str(options.get("mode", "PERMISSIVE")).upper()
+    header = str(options.get("header", "false")).lower() == "true"
+    sep = str(options.get("sep", options.get("delimiter", ",")))
+    quote = str(options.get("quote", '"')) or '"'
+    null_value = str(options.get("nullValue", ""))
+    corrupt_col = str(options.get("columnNameOfCorruptRecord",
+                                  DEFAULT_CORRUPT_COL))
+    fields = schema.fields
+    data_idx = [i for i, f in enumerate(fields) if f.name != corrupt_col]
+    corrupt_idx = next((i for i, f in enumerate(fields)
+                        if f.name == corrupt_col), None)
+    rows = []
+
+    class _RawTee:
+        """Line iterator that records what csv.reader consumed, so the
+        corrupt column stores the RAW record (quoting intact), not a
+        re-join of the parsed tokens."""
+
+        def __init__(self, fh):
+            self.fh = fh
+            self.buf = []
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            line = next(self.fh)
+            self.buf.append(line)
+            return line
+
+        def take_raw(self):
+            raw = "".join(self.buf).rstrip("\r\n")
+            self.buf = []
+            return raw
+
+    with open(path, "r", encoding="utf-8", newline="") as fh:
+        tee = _RawTee(fh)
+        reader = _csv.reader(tee, delimiter=sep, quotechar=quote)
+        for li, toks in enumerate(reader):
+            raw = tee.take_raw()
+            if header and li == 0:
+                continue
+            if not toks:
+                continue  # Spark drops blank lines
+            out = [None] * len(fields)
+            bad = len(toks) != len(data_idx)
+            for j, fi in enumerate(data_idx):
+                tok = toks[j] if j < len(toks) else None
+                try:
+                    out[fi] = _convert_csv_field(
+                        tok, fields[fi].dataType, null_value)
+                except _FieldError:
+                    bad = True
+            if bad:
+                if mode == "FAILFAST":
+                    raise RuntimeError(
+                        f"Malformed CSV record (FAILFAST): {raw!r}")
+                if mode == "DROPMALFORMED":
+                    continue
+                if corrupt_idx is not None:
+                    out[corrupt_idx] = raw
+            rows.append(out)
+    return _finish(rows, schema)
+
+
+def _convert_json_value(v, dt: T.DataType):
+    """One parsed JSON value -> python storage value (None on mismatch)."""
+    if v is None:
+        return None
+    if isinstance(dt, T.StringType):
+        if isinstance(v, str):
+            return v
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, (int, float)):
+            # Spark keeps the literal number text; json round-trip is the
+            # closest faithful rendering here
+            return _json.dumps(v)
+        return None
+    if isinstance(dt, T.BooleanType):
+        return v if isinstance(v, bool) else None
+    if dt.is_integral:
+        if isinstance(v, bool) or not isinstance(v, int):
+            return None
+        lo, hi = _I_RANGE[type(dt)]
+        return v if lo <= v <= hi else None
+    if isinstance(dt, (T.FloatType, T.DoubleType)):
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return None
+        return float(v)
+    if isinstance(dt, T.DecimalType):
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return None
+        if isinstance(v, float) and not math.isfinite(v):
+            return None
+        try:
+            scaled = int(Decimal(str(v)).scaleb(dt.scale).quantize(
+                Decimal(1), rounding=ROUND_HALF_UP))
+        except InvalidOperation:
+            return None
+        return scaled if abs(scaled) < 10 ** dt.precision else None
+    if isinstance(dt, T.DateType):
+        from spark_rapids_tpu.cpu.oracle import _str_to_date_py
+
+        return _str_to_date_py(v) if isinstance(v, str) else None
+    if isinstance(dt, T.TimestampType):
+        from spark_rapids_tpu.cpu.oracle import _str_to_ts_py
+
+        return _str_to_ts_py(v) if isinstance(v, str) else None
+    return None
+
+
+def read_json_spark(path: str, schema: T.StructType, options: dict):
+    """Spark-semantic JSON-lines read -> (HostColumns, row count)."""
+    mode = str(options.get("mode", "PERMISSIVE")).upper()
+    corrupt_col = str(options.get("columnNameOfCorruptRecord",
+                                  DEFAULT_CORRUPT_COL))
+    fields = schema.fields
+    corrupt_idx = next((i for i, f in enumerate(fields)
+                        if f.name == corrupt_col), None)
+    rows = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            raw = line.rstrip("\n")
+            if not raw.strip():
+                continue
+            out = [None] * len(fields)
+            try:
+                obj = _json.loads(raw)
+                bad = not isinstance(obj, dict)
+            except ValueError:
+                obj, bad = None, True
+            if not bad:
+                for i, f in enumerate(fields):
+                    if i == corrupt_idx:
+                        continue
+                    out[i] = _convert_json_value(obj.get(f.name),
+                                                 f.dataType)
+            if bad:
+                if mode == "FAILFAST":
+                    raise RuntimeError(
+                        f"Malformed JSON record (FAILFAST): {raw!r}")
+                if mode == "DROPMALFORMED":
+                    continue
+                if corrupt_idx is not None:
+                    out[corrupt_idx] = raw
+            rows.append(out)
+    return _finish(rows, schema)

@@ -150,10 +150,33 @@ class DataFrameReader:
                               options=self._options), self.session)
 
     def csv(self, *paths: str) -> "DataFrame":
-        schema = self._schema or self._infer_schema("csv", list(paths))
+        opts = dict(self._options)
+        if self._schema is None:
+            # inference honors the reader's sep/header options (arrow
+            # parse options), so the strict parse sees the same shape
+            import pyarrow.csv as pacsv
+
+            sep = str(opts.get("sep", opts.get("delimiter", ",")))
+            headerless = str(opts.get("header", "")).lower() == "false"
+            tbl = pacsv.read_csv(
+                paths[0],
+                read_options=pacsv.ReadOptions(
+                    autogenerate_column_names=headerless),
+                parse_options=pacsv.ParseOptions(delimiter=sep))
+            fields = [T.StructField(f.name if not headerless
+                                    else f"_c{i}",
+                                    _arrow_to_sql(f.type), f.nullable)
+                      for i, f in enumerate(tbl.schema)]
+            schema = T.StructType(fields)
+            # schema inference reads column names from the header line, so
+            # the parse must consume it too (explicit schemas keep Spark's
+            # header=false default)
+            opts.setdefault("header", "true")
+        else:
+            schema = self._schema
         return DataFrame(
             PN.FileSourceScan("csv", list(paths), schema,
-                              options=self._options), self.session)
+                              options=opts), self.session)
 
     def delta(self, path: str, version: Optional[int] = None) -> "DataFrame":
         from spark_rapids_tpu.delta import read_delta

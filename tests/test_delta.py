@@ -159,3 +159,95 @@ def test_delta_scan_through_engine_differential(tmp_path):
             sum_("v", "sv"))
 
     assert_tpu_and_cpu_are_equal_collect(build)
+
+
+# -- round 3: deletion vectors ---------------------------------------------
+
+
+def test_dv_roaring_roundtrip():
+    from spark_rapids_tpu.delta.dv import (decode_roaring_array,
+                                           encode_roaring_array,
+                                           z85_decode, z85_encode)
+
+    idx = [0, 1, 5, 1000, 65535, 65536, 70000, (1 << 32) + 7, (3 << 32)]
+    assert decode_roaring_array(encode_roaring_array(idx)) == sorted(idx)
+    blob = b"\x01\x02\x03\x04abcd"
+    assert z85_decode(z85_encode(blob)) == blob
+
+
+def test_dv_bitmap_and_run_containers():
+    """Reader handles bitmap (dense) containers and run containers."""
+    import struct
+
+    from spark_rapids_tpu.delta.dv import (_MAGIC, _SERIAL_COOKIE,
+                                           decode_roaring_array,
+                                           encode_roaring_array)
+
+    # dense: >4096 values in one 2^16 block -> our encoder still writes an
+    # array container; craft a run-container bitmap by hand instead
+    buf = bytearray(struct.pack("<iq", _MAGIC, 1))
+    buf += struct.pack("<i", 0)                     # key 0
+    buf += struct.pack("<I", (0 << 16) | _SERIAL_COOKIE)  # 1 container, runs
+    buf += b"\x01"                                  # run flag bit
+    buf += struct.pack("<HH", 0, 4)                 # key 0, card-1 = 4
+    buf += struct.pack("<H", 1)                     # 1 run
+    buf += struct.pack("<HH", 10, 4)                # 10..14
+    assert decode_roaring_array(bytes(buf)) == [10, 11, 12, 13, 14]
+    # dense array container path (>4096 handled as array by encoder)
+    dense = list(range(5000))
+    assert decode_roaring_array(encode_roaring_array(dense)) == dense
+
+
+def test_delta_read_with_deletion_vector(tmp_path):
+    import os
+
+    from spark_rapids_tpu.delta.dv import write_dv_file
+    from spark_rapids_tpu.delta.log import DeltaLog
+
+    path = str(tmp_path / "t")
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    df = s.create_dataframe(
+        {"k": list(range(100)), "v": [i * 2 for i in range(100)]},
+        T.StructType([T.StructField("k", T.INT),
+                      T.StructField("v", T.LONG)]))
+    df.write.delta(path)
+    # attach a DV to the written file via a new commit
+    log = DeltaLog(path)
+    snap = log.snapshot()
+    (af,) = snap.files
+    dv = write_dv_file(path, [0, 7, 99])
+    log.commit([{"add": {"path": af.path, "partitionValues": {},
+                         "size": af.size, "modificationTime": 0,
+                         "dataChange": False, "deletionVector": dv}}])
+    rows = s.read.delta(path).collect()
+    ks = {r[0] for r in rows}
+    assert len(rows) == 97 and ks.isdisjoint({0, 7, 99})
+
+    def build(sess):
+        return sess.read.delta(path).filter(col("k") < lit(50))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_delta_inline_deletion_vector(tmp_path):
+    from spark_rapids_tpu.delta.dv import encode_roaring_array, z85_encode
+    from spark_rapids_tpu.delta.log import DeltaLog
+
+    path = str(tmp_path / "t")
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    df = s.create_dataframe(
+        {"k": list(range(20))},
+        T.StructType([T.StructField("k", T.INT)]))
+    df.write.delta(path)
+    log = DeltaLog(path)
+    (af,) = log.snapshot().files
+    payload = encode_roaring_array([1, 2, 3])
+    pad = (-len(payload)) % 4
+    dv = {"storageType": "i",
+          "pathOrInlineDv": z85_encode(payload + b"\x00" * pad),
+          "sizeInBytes": len(payload), "cardinality": 3}
+    log.commit([{"add": {"path": af.path, "partitionValues": {},
+                         "size": af.size, "modificationTime": 0,
+                         "dataChange": False, "deletionVector": dv}}])
+    rows = s.read.delta(path).collect()
+    assert {r[0] for r in rows} == set(range(20)) - {1, 2, 3}

@@ -122,16 +122,90 @@ def test_iceberg_query_differential(tmp_path):
     assert_tpu_and_cpu_are_equal_collect(build)
 
 
-def test_iceberg_delete_files_rejected(tmp_path):
-    p = str(tmp_path / "tbl")
-    _build_iceberg_table(p, _frames()[:1])
-    # rewrite manifest with a delete-content data file
+def _add_delete_file(path, name, tbl, content, equality_ids=None):
+    """Append a v2 delete file entry to the table's manifest."""
+    import pyarrow.parquet as pq
+
     from spark_rapids_tpu.io.avro import read_avro_file
 
-    manifest = os.path.join(p, "metadata", "manifest-1.avro")
+    fp = os.path.join(path, "data", name)
+    pq.write_table(tbl, fp)
+    manifest = os.path.join(path, "metadata", "manifest-1.avro")
     schema, entries = read_avro_file(manifest)
-    entries[0]["data_file"]["content"] = 1
-    write_avro_file(manifest, schema, entries)
+    e = {"status": 1, "data_file": {
+        "content": content, "file_path": fp, "file_format": "PARQUET",
+        "record_count": tbl.num_rows}}
+    if equality_ids is not None:
+        # extend the record schema with equality_ids for this write
+        df_schema = schema["fields"][1]["type"]
+        if not any(f["name"] == "equality_ids"
+                   for f in df_schema["fields"]):
+            df_schema["fields"].append(
+                {"name": "equality_ids",
+                 "type": ["null", {"type": "array", "items": "int"}],
+                 "default": None})
+        e["data_file"]["equality_ids"] = equality_ids
+        for prev in entries:
+            prev["data_file"].setdefault("equality_ids", None)
+    write_avro_file(manifest, schema, entries + [e])
+
+
+def test_iceberg_position_deletes(tmp_path):
+    import pyarrow as pa
+
+    p = str(tmp_path / "tbl")
+    _build_iceberg_table(p, _frames())
+    f1 = os.path.join(p, "data", "f1.parquet")
+    dele = pa.table({"file_path": pa.array([f1, f1, f1]),
+                     "pos": pa.array([0, 5, 119], pa.int64())})
+    _add_delete_file(p, "del-pos.parquet", dele, content=1)
     s = TpuSession({"spark.rapids.sql.enabled": True})
-    with pytest.raises(ValueError, match="delete files"):
-        s.read.iceberg(p)
+    rows = s.read.iceberg(p).collect()
+    ks = {r[0] for r in rows}
+    assert len(rows) == 120 + 80 - 3
+    assert 0 not in ks and 5 not in ks and 119 not in ks
+    assert 1 in ks and 1000 in ks
+
+    def build(sess):
+        return sess.read.iceberg(p).filter(col("k") < lit(2000)) \
+            .group_by().agg(sum_("v", "sv"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_iceberg_equality_deletes(tmp_path):
+    import pyarrow as pa
+
+    p = str(tmp_path / "tbl")
+    _build_iceberg_table(p, _frames())
+    dele = pa.table({"k": pa.array([2, 3, 1001], pa.int32())})
+    _add_delete_file(p, "del-eq.parquet", dele, content=2,
+                     equality_ids=[1])  # field id 1 = "k"
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    rows = s.read.iceberg(p).collect()
+    ks = {r[0] for r in rows}
+    assert len(rows) == 200 - 3
+    assert ks.isdisjoint({2, 3, 1001})
+
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda sess: sess.read.iceberg(p))
+
+
+def test_iceberg_mixed_deletes(tmp_path):
+    import pyarrow as pa
+
+    p = str(tmp_path / "tbl")
+    _build_iceberg_table(p, _frames())
+    f2 = os.path.join(p, "data", "f2.parquet")
+    _add_delete_file(p, "del-pos.parquet",
+                     pa.table({"file_path": pa.array([f2]),
+                               "pos": pa.array([0], pa.int64())}),
+                     content=1)
+    _add_delete_file(p, "del-eq.parquet",
+                     pa.table({"s": pa.array(["a7", "a9"])}),
+                     content=2, equality_ids=[3])  # field id 3 = "s"
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    rows = s.read.iceberg(p).collect()
+    assert len(rows) == 200 - 3
+    ss = {r[2] for r in rows}
+    assert ss.isdisjoint({"a7", "a9", "b0"})

@@ -1,0 +1,60 @@
+"""Real-TPU test rung (SURVEY.md §4 premerge analog; VERDICT r2 next #7).
+
+Runs a tagged subset of the differential suite on the real chip
+(SRT_TEST_ON_TPU=1): the Pallas parquet decode kernel (multiple bit
+widths via the codec/dict matrix), decimal128 limb arithmetic, a string-
+kernel slice, and a window slice.  Float64-heavy tests stay off the rung
+(v5e f64 emulation breaks exact differential compares — conftest note).
+
+Writes TPU_TESTS_r<N>.json at the repo root.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+SUBSET = [
+    "tests/test_parquet_device.py",
+    "tests/test_decimal128.py",
+    "tests/test_string.py::test_length_upper_lower_trim",
+    "tests/test_string.py::test_substring",
+    "tests/test_string.py::test_concat",
+    "tests/test_string.py::test_starts_ends_contains",
+    "tests/test_window.py::test_row_number_rank_dense_rank",
+    "tests/test_hash_aggregate.py::test_groupby_sum_count",
+]
+
+
+def main():
+    rnd = os.environ.get("ROUND", "03")
+    env = dict(os.environ)
+    env["SRT_TEST_ON_TPU"] = "1"
+    env.pop("JAX_PLATFORMS", None)
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "--no-header", *SUBSET],
+        capture_output=True, text=True, env=env,
+        timeout=int(os.environ.get("TPU_TESTS_TIMEOUT", 5400)))
+    tail = proc.stdout.strip().splitlines()[-15:]
+    out = {
+        "round": rnd,
+        "subset": SUBSET,
+        "returncode": proc.returncode,
+        "green": proc.returncode == 0,
+        "wall_seconds": round(time.time() - t0, 1),
+        "summary": tail[-1] if tail else "",
+        "tail": tail,
+        "platform_note": ("SRT_TEST_ON_TPU=1: differential tests executed "
+                          "on the real chip (axon tunnel); float64-heavy "
+                          "files excluded per v5e f64-emulation caveat"),
+    }
+    path = f"TPU_TESTS_r{rnd}.json"
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"wrote": path, "green": out["green"],
+                      "summary": out["summary"]}))
+
+
+if __name__ == "__main__":
+    main()
