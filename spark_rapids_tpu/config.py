@@ -329,6 +329,19 @@ MESH_DEVICES = conf("spark.rapids.tpu.mesh.devices").doc(
     "Non-power-of-2 counts are supported; capacities pad to multiples of "
     "the device count.").integer_conf(0)
 
+MESH_AGG_ENABLED = conf("spark.rapids.tpu.mesh.agg.enabled").doc(
+    "Per-stage kill switch: run eligible aggregation stage pairs as ICI "
+    "collective programs (requires mesh.enabled + shuffle.mode=ICI)."
+).boolean_conf(True)
+
+MESH_JOIN_ENABLED = conf("spark.rapids.tpu.mesh.join.enabled").doc(
+    "Per-stage kill switch: run eligible shuffled equi-joins as ICI "
+    "collective programs.").boolean_conf(True)
+
+MESH_SORT_ENABLED = conf("spark.rapids.tpu.mesh.sort.enabled").doc(
+    "Per-stage kill switch: run global sorts as the distributed "
+    "range-exchange ICI sort.").boolean_conf(True)
+
 MESH_EPOCH_BYTES = conf("spark.rapids.tpu.mesh.epochTargetBytes").doc(
     "Input bytes gathered into one mesh collective epoch.  ICI stages "
     "stream the child's batches through the SPMD program in epochs of "
@@ -374,6 +387,12 @@ TPU_STRING_WIDTH_BUCKETS = conf("spark.rapids.tpu.string.widthBuckets").doc(
 
 TPU_DONATE_BUFFERS = conf("spark.rapids.tpu.donateInputBuffers").doc(
     "Donate input HBM buffers to XLA where legal.").boolean_conf(True)
+
+PARQUET_DECODE_LOG_FALLBACK = conf(
+    "spark.rapids.sql.format.parquet.decode.logFallback").doc(
+    "Log (stderr) why a file fell back from the Pallas device decode to "
+    "the host pyarrow decode — silent fallbacks are otherwise invisible."
+).boolean_conf(False)
 
 TPU_SCAN_CACHE = conf("spark.rapids.tpu.scan.cacheDeviceBatches").doc(
     "Keep scanned batches resident in HBM across queries over the same "

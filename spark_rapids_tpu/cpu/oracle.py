@@ -3507,6 +3507,388 @@ def _h_nullif(e, cols, n, ansi):
     return CpuCol(e.dataType, a.values.copy(), validity)
 
 
+def _h_trunc_timestamp(e, cols, n, ansi):
+    from spark_rapids_tpu.expr.datetime import TruncTimestamp as _TT
+
+    fmt_c, c = _kids(e, cols, n, ansi)
+    unit = str(e.children[0].value).lower() \
+        if getattr(e.children[0], "value", None) is not None else ""
+    out = np.zeros(n, np.int64)
+    validity = c.validity.copy()
+    US_DAY = 86_400_000_000
+    for i in range(n):
+        if not validity[i]:
+            continue
+        micros = int(c.values[i])
+        if unit in _TT._TIME:
+            q = _TT._TIME[unit]
+            out[i] = (micros // q) * q
+        elif unit in _TT._DAY_FMTS:
+            days = micros // US_DAY
+            dt0 = pydt.date(1970, 1, 1) + pydt.timedelta(days=days)
+            u = _TT._DAY_FMTS[unit]
+            if u == "year":
+                d2 = dt0.replace(month=1, day=1)
+            elif u == "quarter":
+                d2 = dt0.replace(month=(dt0.month - 1) // 3 * 3 + 1, day=1)
+            elif u == "month":
+                d2 = dt0.replace(day=1)
+            else:
+                d2 = dt0 - pydt.timedelta(days=dt0.weekday())
+            out[i] = (d2 - pydt.date(1970, 1, 1)).days * US_DAY
+        else:
+            validity[i] = False
+    return CpuCol(T.TIMESTAMP, out, validity)
+
+
+def _h_timestamp_add(e, cols, n, ansi):
+    from spark_rapids_tpu.expr.datetime import TimestampAdd as _TA
+
+    k, c = _kids(e, cols, n, ansi)
+    validity = k.validity & c.validity
+    out = np.zeros(n, np.int64)
+    US_DAY = 86_400_000_000
+    for i in range(n):
+        if not validity[i]:
+            continue
+        micros = int(c.values[i])
+        kk = int(k.values[i])
+        if e.unit in _TA._FIXED:
+            out[i] = micros + kk * _TA._FIXED[e.unit]
+            continue
+        mult = {"month": 1, "quarter": 3, "year": 12}.get(e.unit)
+        if mult is None:
+            validity[i] = False
+            continue
+        days = micros // US_DAY
+        tod = micros - days * US_DAY
+        d0 = pydt.date(1970, 1, 1) + pydt.timedelta(days=days)
+        tot = d0.year * 12 + (d0.month - 1) + kk * mult
+        ny, nm = tot // 12, tot % 12 + 1
+        import calendar
+
+        nd = min(d0.day, calendar.monthrange(ny, nm)[1])
+        out[i] = ((pydt.date(ny, nm, nd) - pydt.date(1970, 1, 1)).days
+                  * US_DAY + tod)
+    return CpuCol(T.TIMESTAMP, out, validity)
+
+
+def _h_timestamp_diff(e, cols, n, ansi):
+    from spark_rapids_tpu.expr.datetime import TimestampAdd as _TA
+
+    a, b = _kids(e, cols, n, ansi)
+    validity = a.validity & b.validity
+    out = np.zeros(n, np.int64)
+    US_DAY = 86_400_000_000
+    for i in range(n):
+        if not validity[i]:
+            continue
+        s, t = int(a.values[i]), int(b.values[i])
+        fixed = _TA._FIXED.get(e.unit)
+        if fixed is not None:
+            d = t - s
+            out[i] = d // fixed if d >= 0 else -((-d) // fixed)
+            continue
+        mult = {"month": 1, "quarter": 3, "year": 12}.get(e.unit)
+        if mult is None:
+            validity[i] = False
+            continue
+        sd, ed = s // US_DAY, t // US_DAY
+        d1 = pydt.date(1970, 1, 1) + pydt.timedelta(days=sd)
+        d2 = pydt.date(1970, 1, 1) + pydt.timedelta(days=ed)
+        months = (d2.year * 12 + d2.month) - (d1.year * 12 + d1.month)
+        stod, etod = s - sd * US_DAY, t - ed * US_DAY
+        fwd = t >= s
+        short = ((d2.day < d1.day or (d2.day == d1.day and etod < stod))
+                 if fwd else
+                 (d2.day > d1.day or (d2.day == d1.day and etod > stod)))
+        months += (-1 if short and fwd else (1 if short and not fwd else 0))
+        out[i] = months // mult if months >= 0 else -((-months) // mult)
+    return CpuCol(T.LONG, out, validity)
+
+
+def _h_convert_timezone(e, cols, n, ansi):
+    from spark_rapids_tpu.tzdb import zone_tables
+
+    (c,) = _kids(e, cols, n, ansi)
+    tsrc = zone_tables(e.source_tz)
+    ttgt = zone_tables(e.target_tz)
+    out = np.zeros(n, np.int64)
+    for i in range(n):
+        if not c.validity[i]:
+            continue
+        micros = int(c.values[i])
+        secs = micros // 1_000_000
+        j = np.searchsorted(tsrc["wall_starts"], secs, side="right") - 1
+        off1 = int(tsrc["offsets"][max(min(j, len(tsrc["offsets"]) - 1), 0)])
+        utc = micros - off1 * 1_000_000
+        us = utc // 1_000_000
+        j2 = np.searchsorted(ttgt["utc_instants"], us, side="right") - 1
+        off2 = int(ttgt["offsets"][max(min(j2, len(ttgt["offsets"]) - 1), 0)])
+        out[i] = utc + off2 * 1_000_000
+    return CpuCol(T.TIMESTAMP, out, c.validity.copy())
+
+
+def _h_month_day_name(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    days = _date_of(c, e.child.dataType)
+    months = ("Jan", "Feb", "Mar", "Apr", "May", "Jun",
+              "Jul", "Aug", "Sep", "Oct", "Nov", "Dec")
+    dows = ("Mon", "Tue", "Wed", "Thu", "Fri", "Sat", "Sun")
+    out = np.empty(n, object)
+    for i in range(n):
+        if not c.validity[i]:
+            continue
+        if type(e).__name__ == "MonthName":
+            out[i] = months[days[i].month - 1]
+        else:
+            out[i] = dows[days[i].weekday()]
+    return CpuCol(T.STRING, out, c.validity.copy())
+
+
+def _h_date_part(e, cols, n, ansi):
+    if e._inner is None:
+        return CpuCol(T.INT, np.zeros(n, np.int32), np.zeros(n, np.bool_))
+    return eval_expr(e._inner, cols, n, ansi)
+
+
+def _h_url_codec(e, cols, n, ansi):
+    from urllib.parse import quote_plus, unquote_plus
+    import re as _re
+
+    (c,) = _kids(e, cols, n, ansi)
+    out = np.empty(n, object)
+    validity = c.validity.copy()
+    enc = type(e).__name__ == "UrlEncode"
+    for i in range(n):
+        if not validity[i]:
+            continue
+        s = str(c.values[i])
+        if enc:
+            out[i] = quote_plus(s)
+        else:
+            if _re.search(r"%(?![0-9A-Fa-f]{2})", s):
+                validity[i] = False
+                continue
+            out[i] = unquote_plus(s)
+    return CpuCol(T.STRING, out, validity)
+
+
+def _h_json_array_length(e, cols, n, ansi):
+    import json as _json
+
+    (c,) = _kids(e, cols, n, ansi)
+    out = np.zeros(n, np.int32)
+    validity = np.zeros(n, np.bool_)
+    for i in range(n):
+        if not c.validity[i]:
+            continue
+        try:
+            v = _json.loads(str(c.values[i]))
+        except ValueError:
+            continue
+        if isinstance(v, list):
+            out[i] = len(v)
+            validity[i] = True
+    return CpuCol(T.INT, out, validity)
+
+
+def _h_json_object_keys(e, cols, n, ansi):
+    import json as _json
+
+    (c,) = _kids(e, cols, n, ansi)
+    out = np.empty(n, object)
+    validity = np.zeros(n, np.bool_)
+    for i in range(n):
+        if not c.validity[i]:
+            continue
+        try:
+            v = _json.loads(str(c.values[i]))
+        except ValueError:
+            continue
+        if isinstance(v, dict):
+            out[i] = [str(k)[:e.KEY_WIDTH] for k in list(v)[:e.MAX_KEYS]]
+            validity[i] = True
+    return CpuCol(e.dataType, out, validity)
+
+
+def _h_format_string(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    fmt = str(e.children[0].value)
+    pyfmt = fmt.replace("%%", "\x00")
+    out = np.empty(n, object)
+    validity = np.zeros(n, np.bool_)
+    for i in range(n):
+        row = []
+        null = False
+        for k, ce in zip(kids[1:], e.children[1:]):
+            if not k.validity[i]:
+                null = True
+                break
+            v = k.values[i]
+            if isinstance(ce.dataType, (T.FloatType, T.DoubleType)):
+                row.append(float(v))
+            elif isinstance(ce.dataType, T.StringType):
+                row.append(str(v))
+            else:
+                row.append(int(v))
+        if null:
+            continue
+        try:
+            out[i] = (pyfmt % tuple(row)).replace("\x00", "%")
+            validity[i] = True
+        except (TypeError, ValueError):
+            continue
+    return CpuCol(T.STRING, out, validity)
+
+
+def _h_uuid(e, cols, n, ansi):
+    base = np.uint64((e.seed * 0x9E3779B97F4A7C15 + 0xA5A5A5A5)
+                     & 0xFFFFFFFFFFFFFFFF)
+    out = np.empty(n, object)
+    with np.errstate(over="ignore"):
+        for i in range(n):
+            def mix(z):
+                z = np.uint64(z + np.uint64(0x9E3779B97F4A7C15))
+                z = np.uint64((z ^ (z >> np.uint64(30)))
+                              * np.uint64(0xBF58476D1CE4E5B9))
+                z = np.uint64((z ^ (z >> np.uint64(27)))
+                              * np.uint64(0x94D049BB133111EB))
+                return np.uint64(z ^ (z >> np.uint64(31)))
+
+            hi = int(mix(base + np.uint64(i * 2)))
+            lo = int(mix(base + np.uint64(i * 2 + 1)))
+            hi = (hi & 0xFFFFFFFFFFFF0FFF) | 0x4000
+            lo = (lo & 0x3FFFFFFFFFFFFFFF) | (1 << 63)
+            s = f"{hi:016x}{lo:016x}"
+            out[i] = f"{s[:8]}-{s[8:12]}-{s[12:16]}-{s[16:20]}-{s[20:]}"
+    return CpuCol(T.STRING, out, np.ones(n, np.bool_))
+
+
+def _h_pi_e(e, cols, n, ansi):
+    v = math.pi if type(e).__name__ == "Pi" else math.e
+    return CpuCol(T.DOUBLE, np.full(n, v, np.float64),
+                  np.ones(n, np.bool_))
+
+
+def _h_mask(e, cols, n, ansi):
+    (c,) = [eval_expr(e.children[0], cols, n, ansi)]
+
+    def rep_of(i):
+        v = getattr(e.children[i], "value", None)
+        return None if v is None else str(v)[0]
+
+    up, lo, dg, ot = rep_of(1), rep_of(2), rep_of(3), rep_of(4)
+    out = np.empty(n, object)
+    for i in range(n):
+        if not c.validity[i]:
+            continue
+        res = []
+        for ch in str(c.values[i]):
+            if "A" <= ch <= "Z":
+                res.append(up if up is not None else ch)
+            elif "a" <= ch <= "z":
+                res.append(lo if lo is not None else ch)
+            elif "0" <= ch <= "9":
+                res.append(dg if dg is not None else ch)
+            else:
+                res.append(ot if ot is not None else ch)
+        out[i] = "".join(res)
+    return CpuCol(T.STRING, out, c.validity.copy())
+
+
+def _h_ilike(e, cols, n, ansi):
+    import re
+
+    from spark_rapids_tpu.regex.transpiler import like_to_regex
+
+    l, _ = _kids(e, cols, n, ansi)
+    rx = re.compile(like_to_regex(str(e.right.value).lower()))
+    out = np.array(
+        [bool(rx.fullmatch("".join(
+            chr(ord(ch) + 32) if "A" <= ch <= "Z" else ch for ch in v)))
+         if v is not None else False for v in l.values], np.bool_)
+    return CpuCol(T.BOOLEAN, out, l.validity.copy())
+
+
+def _h_regexp_span(e, cols, n, ansi):
+    import re as _re
+
+    c = eval_expr(e.children[0], cols, n, ansi)
+    pat = _re.compile(_java_regex_to_python(str(e.children[1].value)))
+    name = type(e).__name__
+    def nonempty_matches(v):
+        # full matches (not group contents), skipping zero-length hits —
+        # the device greedy span scan's non-overlapping leftmost contract
+        return [m for m in pat.finditer(v) if m.group(0) != ""]
+
+    if name == "RegExpCount":
+        out = np.array([len(nonempty_matches(v)) if v is not None else 0
+                        for v in c.values], np.int32)
+        return CpuCol(T.INT, out, c.validity.copy())
+    if name == "RegExpInStr":
+        out = np.zeros(n, np.int32)
+        for i, v in enumerate(c.values):
+            if v is None or not c.validity[i]:
+                continue
+            ms = nonempty_matches(v)
+            out[i] = (ms[0].start() + 1) if ms else 0
+        return CpuCol(T.INT, out, c.validity.copy())
+    out = np.empty(n, object)
+    validity = c.validity.copy()
+    for i, v in enumerate(c.values):
+        if v is None or not validity[i]:
+            validity[i] = False
+            continue
+        ms = nonempty_matches(v)
+        if ms:
+            out[i] = ms[0].group(0)
+        else:
+            validity[i] = False
+    return CpuCol(T.STRING, out, validity)
+
+
+def _h_split_part(e, cols, n, ansi):
+    s, d, k = _kids(e, cols, n, ansi)
+    delim = str(e.children[1].value)
+    validity = s.validity & d.validity & k.validity
+    out = np.empty(n, object)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        parts = str(s.values[i]).split(delim)
+        want = int(k.values[i])
+        if want < 0:
+            want = len(parts) + want + 1
+        out[i] = parts[want - 1] if 1 <= want <= len(parts) else ""
+    return CpuCol(T.STRING, out, validity)
+
+
+def _h_get(e, cols, n, ansi):
+    a, idx = _kids(e, cols, n, ansi)
+    validity = a.validity & idx.validity
+    out = np.empty(n, object)
+    ok = np.zeros(n, np.bool_)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        arr = a.values[i] or []
+        j = int(idx.values[i])
+        if 0 <= j < len(arr) and arr[j] is not None:
+            out[i] = arr[j]
+            ok[i] = True
+    return CpuCol.from_objs(
+        [out[i] if ok[i] else None for i in range(n)], e.dataType)
+
+
+def _h_array_size(e, cols, n, ansi):
+    (a,) = _kids(e, cols, n, ansi)
+    out = np.array([len(a.values[i]) if a.validity[i]
+                    and a.values[i] is not None else 0
+                    for i in range(n)], np.int32)
+    return CpuCol(T.INT, out, a.validity.copy())
+
+
 _HANDLERS = {
     "BoundReference": _h_bound,
     "Literal": _h_literal,
@@ -3568,6 +3950,20 @@ _HANDLERS = {
     "UnixDate": _h_unix_date, "DateFromUnixDate": _h_unix_date,
     "WeekDay": _h_weekday,
     "ToDate": _h_to_date_ts, "ToTimestamp": _h_to_date_ts,
+    "TruncTimestamp": _h_trunc_timestamp,
+    "TimestampAdd": _h_timestamp_add, "TimestampDiff": _h_timestamp_diff,
+    "ConvertTimezone": _h_convert_timezone,
+    "MonthName": _h_month_day_name, "DayName": _h_month_day_name,
+    "LocalTimestamp": _h_current, "DatePart": _h_date_part,
+    "UrlEncode": _h_url_codec, "UrlDecode": _h_url_codec,
+    "JsonArrayLength": _h_json_array_length,
+    "JsonObjectKeys": _h_json_object_keys,
+    "FormatString": _h_format_string, "Uuid": _h_uuid,
+    "Pi": _h_pi_e, "EulerNumber": _h_pi_e,
+    "Mask": _h_mask, "ILike": _h_ilike,
+    "RegExpCount": _h_regexp_span, "RegExpInStr": _h_regexp_span,
+    "RegExpSubStr": _h_regexp_span, "SplitPart": _h_split_part,
+    "Get": _h_get, "ArraySize": _h_array_size,
     "Murmur3Hash": _h_hashexpr, "XxHash64": _h_hashexpr,
     "Reverse": _h_reverse, "InitCap": _h_initcap, "Ascii": _h_ascii,
     "Chr": _h_chr, "StringReplace": _h_replace,

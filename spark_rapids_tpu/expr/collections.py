@@ -825,3 +825,36 @@ class ArrayPrepend(_ArrayAppendBase):
     """array_prepend(arr, elem)."""
 
     prepend = True
+
+
+class Get(BinaryExpression):
+    """get(arr, idx) — 0-based, NULL (never an error) out of range
+    (Spark 3.4)."""
+
+    def _resolve_type(self):
+        self._dataType = self.left.dataType.elementType
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        arr, i = cols
+        idx = i.data.astype(jnp.int32)
+        inb = (idx >= 0) & (idx < arr.lengths)
+        safe = jnp.clip(idx, 0, max(arr.ewidth - 1, 0))
+        validity = arr.validity & i.validity & inb
+        ev = jnp.take_along_axis(arr.elem_valid, safe[:, None],
+                                 axis=1)[:, 0] if arr.ewidth else \
+            jnp.zeros(arr.capacity, jnp.bool_)
+        return _take_element(arr, safe, validity & ev, self.dataType)
+
+
+class ArraySize(Size):
+    """array_size(arr) — Size with legacySizeOfNull=false: NULL input is
+    NULL, not -1."""
+
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        r = super().do_columnar_eval(ctx, cols)
+        return DeviceColumn(T.INT, cols[0].validity, data=r.data)

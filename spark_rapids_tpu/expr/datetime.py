@@ -736,3 +736,276 @@ class ToTimestamp(UnaryExpression):
         from spark_rapids_tpu.expr.cast import _string_to_timestamp
 
         return _string_to_timestamp(ctx, c, ct, T.TIMESTAMP, False)
+
+
+class TruncTimestamp(BinaryExpression):
+    """date_trunc(fmt, ts): fmt is a plan-time literal.
+
+    Reference analog: GpuTruncTimestamp (datetimeExpressions.scala)."""
+
+    _DAY_FMTS = dict(TruncDate._FMTS)
+    _TIME = {"day": 86_400_000_000, "dd": 86_400_000_000,
+             "hour": 3_600_000_000, "minute": 60_000_000,
+             "second": 1_000_000, "millisecond": 1_000, "microsecond": 1}
+
+    def _resolve_type(self):
+        self._dataType = T.TIMESTAMP
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.expr.base import Literal
+
+        fmt, c = cols  # date_trunc(fmt, ts)
+        f = self.children[0]
+        unit = (str(f.value).lower()
+                if isinstance(f, Literal) and f.value is not None else "")
+        micros = c.data.astype(jnp.int64)
+        if unit in self._TIME:
+            q = self._TIME[unit]
+            out = jnp.floor_divide(micros, q) * q
+            return DeviceColumn(T.TIMESTAMP, c.validity, data=out)
+        if unit in self._DAY_FMTS:
+            days = jnp.floor_divide(micros, _US_PER_DAY)
+            y, m, d = civil_from_days(days)
+            u = self._DAY_FMTS[unit]
+            if u == "year":
+                out = days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+            elif u == "quarter":
+                out = days_from_civil(y, (m - 1) // 3 * 3 + 1,
+                                      jnp.ones_like(d))
+            elif u == "month":
+                out = days_from_civil(y, m, jnp.ones_like(d))
+            else:  # week
+                out = days - (days + 3) % 7
+            return DeviceColumn(T.TIMESTAMP, c.validity,
+                                data=out * _US_PER_DAY)
+        return DeviceColumn(T.TIMESTAMP, jnp.zeros_like(c.validity),
+                            data=jnp.zeros_like(micros))
+
+
+class TimestampAdd(Expression):
+    """timestampadd(unit, n, ts) — unit is a plan-time literal."""
+
+    _FIXED = {"microsecond": 1, "millisecond": 1_000, "second": 1_000_000,
+              "minute": 60_000_000, "hour": 3_600_000_000,
+              "day": _US_PER_DAY, "week": 7 * _US_PER_DAY}
+
+    def __init__(self, unit, n, ts):
+        super().__init__([n, ts])
+        self.unit = str(unit).lower()
+
+    def sql_string(self):
+        return (f"timestampadd({self.unit}, "
+                f"{self.children[0].sql_string()}, "
+                f"{self.children[1].sql_string()})")
+
+    def _resolve_type(self):
+        self._dataType = T.TIMESTAMP
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        n, c = cols
+        micros = c.data.astype(jnp.int64)
+        k = n.data.astype(jnp.int64)
+        validity = n.validity & c.validity
+        if self.unit in self._FIXED:
+            out = micros + k * self._FIXED[self.unit]
+            return DeviceColumn(T.TIMESTAMP, validity, data=out)
+        # month-based units ride the clamped civil add (add_months rules)
+        mult = {"month": 1, "quarter": 3, "year": 12}.get(self.unit)
+        if mult is None:
+            return DeviceColumn(T.TIMESTAMP,
+                                jnp.zeros_like(validity),
+                                data=jnp.zeros_like(micros))
+        days = jnp.floor_divide(micros, _US_PER_DAY)
+        tod = micros - days * _US_PER_DAY
+        y, m, d = civil_from_days(days)
+        tot = y * 12 + (m - 1) + k * mult
+        ny = tot // 12
+        nm = tot % 12 + 1
+        out_days = _clamped_ymd_to_days(ny, nm, d)
+        return DeviceColumn(T.TIMESTAMP, validity,
+                            data=out_days * _US_PER_DAY + tod)
+
+
+class TimestampDiff(Expression):
+    """timestampdiff(unit, start, end) — whole units, truncated toward
+    zero (java.time.temporal semantics for the fixed units; month-family
+    counts civil month steps)."""
+
+    def __init__(self, unit, start, end):
+        super().__init__([start, end])
+        self.unit = str(unit).lower()
+
+    def sql_string(self):
+        return (f"timestampdiff({self.unit}, "
+                f"{self.children[0].sql_string()}, "
+                f"{self.children[1].sql_string()})")
+
+    def _resolve_type(self):
+        self._dataType = T.LONG
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        a, b = cols
+        validity = a.validity & b.validity
+        s = a.data.astype(jnp.int64)
+        e = b.data.astype(jnp.int64)
+        fixed = TimestampAdd._FIXED.get(self.unit)
+        if fixed is not None:
+            diff = e - s
+            out = jnp.where(diff >= 0, diff // fixed, -((-diff) // fixed))
+            return DeviceColumn(T.LONG, validity, data=out)
+        mult = {"month": 1, "quarter": 3, "year": 12}.get(self.unit)
+        if mult is None:
+            return DeviceColumn(T.LONG, jnp.zeros_like(validity),
+                                data=jnp.zeros_like(s))
+        sd = jnp.floor_divide(s, _US_PER_DAY)
+        ed = jnp.floor_divide(e, _US_PER_DAY)
+        sy, sm, sdd = civil_from_days(sd)
+        ey, em, edd = civil_from_days(ed)
+        months = (ey * 12 + em) - (sy * 12 + sm)
+        # partial month does not count: back off when the end day-of-month
+        # + time hasn't reached the start's
+        stod = s - sd * _US_PER_DAY
+        etod = e - ed * _US_PER_DAY
+        fwd = e >= s
+        short = jnp.where(
+            fwd,
+            (edd < sdd) | ((edd == sdd) & (etod < stod)),
+            (edd > sdd) | ((edd == sdd) & (etod > stod)))
+        months = months - jnp.where(short & fwd, 1, 0) \
+            + jnp.where(short & ~fwd, 1, 0)
+        out = jnp.where(months >= 0, months // mult,
+                        -((-months) // mult))
+        return DeviceColumn(T.LONG, validity, data=out.astype(jnp.int64))
+
+
+class ConvertTimezone(Expression):
+    """convert_timezone(source_tz, target_tz, ts): both tz are plan-time
+    literals; rides the TZif transition tables like from/to_utc."""
+
+    def __init__(self, source_tz, target_tz, ts):
+        super().__init__([ts])
+        self.source_tz = str(source_tz)
+        self.target_tz = str(target_tz)
+
+    def sql_string(self):
+        return (f"convert_timezone({self.source_tz}, {self.target_tz}, "
+                f"{self.children[0].sql_string()})")
+
+    def _resolve_type(self):
+        self._dataType = T.TIMESTAMP
+        self._nullable = self.children[0].nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.tzdb import zone_tables
+
+        c = cols[0]
+        micros = c.data.astype(jnp.int64)
+        # wall(source) -> utc
+        tsrc = zone_tables(self.source_tz)
+        secs = jnp.floor_divide(micros, 1_000_000)
+        i1 = jnp.searchsorted(jnp.asarray(tsrc["wall_starts"]), secs,
+                              side="right") - 1
+        off1 = jnp.asarray(tsrc["offsets"])[
+            jnp.clip(i1, 0, len(tsrc["offsets"]) - 1)]
+        utc = micros - off1 * jnp.int64(1_000_000)
+        # utc -> wall(target)
+        ttgt = zone_tables(self.target_tz)
+        usecs = jnp.floor_divide(utc, 1_000_000)
+        i2 = jnp.searchsorted(jnp.asarray(ttgt["utc_instants"]), usecs,
+                              side="right") - 1
+        off2 = jnp.asarray(ttgt["offsets"])[
+            jnp.clip(i2, 0, len(ttgt["offsets"]) - 1)]
+        return DeviceColumn(T.TIMESTAMP, c.validity,
+                            data=utc + off2 * jnp.int64(1_000_000))
+
+
+class _NameLookup(_DateField):
+    """3-letter name columns from a fixed lookup table (device gather)."""
+
+    _NAMES: tuple = ()
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        import numpy as np
+
+        c = cols[0]
+        days = _days_of(c, self.child.dataType)
+        y, m, d = civil_from_days(days)
+        idx = self._index(y, m, d, days)
+        tbl = np.zeros((len(self._NAMES), 3), np.uint8)
+        for i, nm in enumerate(self._NAMES):
+            tbl[i] = np.frombuffer(nm.encode(), np.uint8)
+        chars = jnp.asarray(tbl)[jnp.clip(idx, 0, len(self._NAMES) - 1)]
+        return DeviceColumn(T.STRING, c.validity, chars=chars,
+                            lengths=jnp.full(c.capacity, 3, jnp.int32))
+
+
+class MonthName(_NameLookup):
+    _NAMES = ("Jan", "Feb", "Mar", "Apr", "May", "Jun",
+              "Jul", "Aug", "Sep", "Oct", "Nov", "Dec")
+
+    def _index(self, y, m, d, days):
+        return m - 1
+
+
+class DayName(_NameLookup):
+    _NAMES = ("Mon", "Tue", "Wed", "Thu", "Fri", "Sat", "Sun")
+
+    def _index(self, y, m, d, days):
+        return (days + 3) % 7
+
+
+class LocalTimestamp(CurrentTimestamp):
+    """localtimestamp() — UTC session timezone makes it current_timestamp."""
+
+
+class DatePart(Expression):
+    """date_part(field, source) / extract(field FROM source): the literal
+    field routes to the matching extraction at plan time."""
+
+    _FIELDS = {"year": Year, "yr": Year, "years": Year,
+               "month": Month, "mon": Month, "months": Month,
+               "day": DayOfMonth, "d": DayOfMonth, "days": DayOfMonth,
+               "dayofweek": DayOfWeek, "dow": DayOfWeek,
+               "doy": DayOfYear, "quarter": Quarter, "qtr": Quarter,
+               "week": WeekOfYear, "weeks": WeekOfYear,
+               "hour": Hour, "hours": Hour, "h": Hour,
+               "minute": Minute, "min": Minute, "minutes": Minute,
+               "second": Second, "sec": Second, "seconds": Second}
+
+    def __init__(self, field, source):
+        super().__init__([source])
+        self.field = str(field).lower()
+        self._inner = None
+
+    def sql_string(self):
+        return f"date_part({self.field}, {self.children[0].sql_string()})"
+
+    def resolve(self, schema):
+        self.children = [c.resolve(schema) for c in self.children]
+        cls = self._FIELDS.get(self.field)
+        if cls is None:
+            # Spark raises an analysis error for unsupported fields; so do
+            # both backends (resolve() IS the analysis step here)
+            raise ValueError(
+                f"date_part: unsupported extract field {self.field!r}")
+        self._inner = cls(self.children[0])
+        self._inner.resolved = True
+        self._inner._resolve_type()
+        self._resolve_type()
+        self.resolved = True
+        return self
+
+    def _resolve_type(self):
+        self._dataType = (self._inner.dataType if self._inner is not None
+                          else T.INT)
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        return self._inner.do_columnar_eval(ctx, cols)

@@ -717,3 +717,311 @@ class RaiseError(UnaryExpression):
         cap = c.capacity
         return DeviceColumn(T.NULL, jnp.zeros(cap, jnp.bool_),
                             data=jnp.zeros(cap, jnp.int32))
+
+
+class UrlEncode(_HostStringUnary):
+    """url_encode(s) — application/x-www-form-urlencoded (Spark 3.4)."""
+
+    def _out_width(self, c):
+        return max(c.width * 3, 3)
+
+    def _fn(self, b):
+        from urllib.parse import quote_plus
+
+        return quote_plus(b.decode("utf-8", "replace")).encode()
+
+
+class UrlDecode(_HostStringUnary):
+    """url_decode(s) — invalid escapes raise in Spark; here -> null."""
+
+    def _fn(self, b):
+        from urllib.parse import unquote_plus
+
+        s = b.decode("utf-8", "replace")
+        import re as _re
+
+        if _re.search(r"%(?![0-9A-Fa-f]{2})", s):
+            return None
+        return unquote_plus(s).encode()
+
+
+class JsonArrayLength(_HostStringUnary):
+    """json_array_length(s) -> int (null unless a valid JSON array)."""
+
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        import json as _json
+
+        c = cols[0]
+        cap = c.capacity
+
+        def run(chars, lengths, validity):
+            chars = np.asarray(chars)
+            lengths = np.asarray(lengths)
+            validity = np.asarray(validity)
+            out = np.zeros(cap, np.int32)
+            ok = np.zeros(cap, np.bool_)
+            for i in range(cap):
+                if not validity[i]:
+                    continue
+                try:
+                    v = _json.loads(bytes(chars[i, :lengths[i]]))
+                except ValueError:
+                    continue
+                if isinstance(v, list):
+                    out[i] = len(v)
+                    ok[i] = True
+            return out, ok
+
+        shapes = (jax.ShapeDtypeStruct((cap,), np.int32),
+                  jax.ShapeDtypeStruct((cap,), np.bool_))
+        o, ok = call_host_kernel(run, shapes, c.chars, c.lengths,
+                                 c.validity)
+        return DeviceColumn(T.INT, ok, data=o)
+
+
+class JsonObjectKeys(_HostStringUnary):
+    """json_object_keys(s) -> array<string> (null unless a JSON object)."""
+
+    MAX_KEYS = 64
+    KEY_WIDTH = 32
+
+    def _resolve_type(self):
+        self._dataType = T.ArrayType(T.STRING, containsNull=False)
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        import json as _json
+
+        c = cols[0]
+        cap = c.capacity
+        ew, w = self.MAX_KEYS, self.KEY_WIDTH
+
+        def run(chars, lengths, validity):
+            chars = np.asarray(chars)
+            lengths = np.asarray(lengths)
+            validity = np.asarray(validity)
+            och = np.zeros((cap, ew, w), np.uint8)
+            olen = np.zeros((cap, ew), np.int32)
+            cnt = np.zeros(cap, np.int32)
+            ok = np.zeros(cap, np.bool_)
+            ev = np.zeros((cap, ew), np.bool_)
+            for i in range(cap):
+                if not validity[i]:
+                    continue
+                try:
+                    v = _json.loads(bytes(chars[i, :lengths[i]]))
+                except ValueError:
+                    continue
+                if not isinstance(v, dict):
+                    continue
+                ok[i] = True
+                for j, k in enumerate(list(v)[:ew]):
+                    kb = str(k).encode()[:w]
+                    och[i, j, :len(kb)] = np.frombuffer(kb, np.uint8)
+                    olen[i, j] = len(kb)
+                    ev[i, j] = True
+                cnt[i] = min(len(v), ew)
+            return och, olen, cnt, ok, ev
+
+        shapes = (jax.ShapeDtypeStruct((cap, ew, w), np.uint8),
+                  jax.ShapeDtypeStruct((cap, ew), np.int32),
+                  jax.ShapeDtypeStruct((cap,), np.int32),
+                  jax.ShapeDtypeStruct((cap,), np.bool_),
+                  jax.ShapeDtypeStruct((cap, ew), np.bool_))
+        och, olen, cnt, ok, ev = call_host_kernel(
+            run, shapes, c.chars, c.lengths, c.validity)
+        return DeviceColumn(self.dataType, ok, chars=och, data=olen,
+                            lengths=cnt, elem_valid=ev)
+
+
+class FormatString(Expression):
+    """format_string(fmt, args...) — literal java-style fmt (the %s/%d/%f
+    family), host kernel."""
+
+    is_host_kernel = True
+
+    def __init__(self, children):
+        super().__init__(list(children))
+
+    def sql_string(self):
+        return ("format_string("
+                + ", ".join(c.sql_string() for c in self.children) + ")")
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.expr.base import Literal
+
+        fmt = str(self.children[0].value)
+        args = cols[1:]
+        cap = args[0].capacity if args else ctx.batch.capacity
+        arg_rows = []
+        for a, e in zip(args, self.children[1:]):
+            arg_rows.append((a, e.dataType))
+        str_w = sum(a.width for a in args if a.is_string)
+        out_w = max(len(fmt) * 4 + 64 + str_w, 64)
+
+        def run(*flat):
+            vals = []
+            k = 0
+            for a, dt in arg_rows:
+                if a.is_string:
+                    vals.append(("s", np.asarray(flat[k]),
+                                 np.asarray(flat[k + 1]),
+                                 np.asarray(flat[k + 2])))
+                    k += 3
+                else:
+                    vals.append(("n", np.asarray(flat[k]),
+                                 np.asarray(flat[k + 1]), dt))
+                    k += 2
+            och = np.zeros((cap, out_w), np.uint8)
+            oln = np.zeros(cap, np.int32)
+            ova = np.zeros(cap, np.bool_)
+            pyfmt = fmt.replace("%%", "\x00")
+            for i in range(cap):
+                row = []
+                null = False
+                for v in vals:
+                    if v[0] == "s":
+                        _, ch, ln, va = v
+                        if not va[i]:
+                            null = True
+                            break
+                        row.append(bytes(ch[i, :ln[i]]).decode(
+                            "utf-8", "replace"))
+                    else:
+                        _, d, va, dt = v
+                        if not va[i]:
+                            null = True
+                            break
+                        row.append(float(d[i]) if isinstance(
+                            dt, (T.FloatType, T.DoubleType))
+                            else int(d[i]))
+                if null:
+                    continue
+                try:
+                    res = (pyfmt % tuple(row)).replace("\x00", "%")
+                except (TypeError, ValueError):
+                    continue
+                rb = res.encode()[:out_w]
+                och[i, :len(rb)] = np.frombuffer(rb, np.uint8)
+                oln[i] = len(rb)
+                ova[i] = True
+            return och, oln, ova
+
+        flat = []
+        for a, dt in arg_rows:
+            if a.is_string:
+                flat += [a.chars, a.lengths, a.validity]
+            else:
+                flat += [a.data, a.validity]
+        shapes = (jax.ShapeDtypeStruct((cap, out_w), np.uint8),
+                  jax.ShapeDtypeStruct((cap,), np.int32),
+                  jax.ShapeDtypeStruct((cap,), np.bool_))
+        och, oln, ova = call_host_kernel(run, shapes, *flat)
+        return DeviceColumn(T.STRING, ova, chars=och, lengths=oln)
+
+
+class Uuid(Expression):
+    """uuid(): deterministic splitmix64 stream per (seed, row) — the same
+    documented-determinism stance as Rand (reference marks both
+    nondeterministic-incompat)."""
+
+    is_host_kernel = True
+
+    def __init__(self, seed: int = 0):
+        super().__init__([])
+        self.seed = seed
+
+    def sql_string(self):
+        return "uuid()"
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = False
+
+    def do_columnar_eval(self, ctx, cols):
+        cap = ctx.batch.capacity
+        base = jnp.uint64((self.seed * 0x9E3779B97F4A7C15 + 0xA5A5A5A5)
+                          & 0xFFFFFFFFFFFFFFFF)
+        idx = (jnp.arange(cap, dtype=jnp.uint64)
+               + jnp.uint64(ctx.row_offset))
+
+        def mix(z):
+            z = (z + jnp.uint64(0x9E3779B97F4A7C15))
+            z = (z ^ (z >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+            z = (z ^ (z >> 27)) * jnp.uint64(0x94D049BB133111EB)
+            return z ^ (z >> 31)
+
+        hi = mix(base + idx * jnp.uint64(2))
+        lo = mix(base + idx * jnp.uint64(2) + jnp.uint64(1))
+        # rfc-4122 v4 bits
+        hi = (hi & jnp.uint64(0xFFFFFFFFFFFF0FFF)) | jnp.uint64(0x4000)
+        lo = (lo & jnp.uint64(0x3FFFFFFFFFFFFFFF)) | jnp.uint64(1 << 63)
+        hexd = jnp.asarray(
+            np.frombuffer(b"0123456789abcdef", np.uint8))
+        out = jnp.zeros((cap, 36), jnp.uint8)
+        dash = jnp.uint8(ord("-"))
+        spans = [(0, 8, "hi", 32), (9, 4, "hi", 16), (14, 4, "hi", 0),
+                 (19, 4, "lo", 48), (24, 12, "lo", 0)]
+        for start, nd, which, shift in spans:
+            word = hi if which == "hi" else lo
+            seg = (word >> jnp.uint64(shift)) & \
+                jnp.uint64((1 << (nd * 4)) - 1)
+            for j in range(nd):
+                nib = ((seg >> jnp.uint64((nd - 1 - j) * 4))
+                       & jnp.uint64(0xF)).astype(jnp.int32)
+                out = out.at[:, start + j].set(hexd[nib])
+        for pos in (8, 13, 18, 23):
+            out = out.at[:, pos].set(dash)
+        return DeviceColumn(T.STRING, jnp.ones(cap, jnp.bool_),
+                            chars=out,
+                            lengths=jnp.full(cap, 36, jnp.int32))
+
+
+class Pi(Expression):
+    """pi()"""
+
+    def __init__(self):
+        super().__init__([])
+
+    def sql_string(self):
+        return "pi()"
+
+    def _resolve_type(self):
+        self._dataType = T.DOUBLE
+        self._nullable = False
+
+    def do_columnar_eval(self, ctx, cols):
+        import math as _m
+
+        cap = ctx.batch.capacity
+        return DeviceColumn(T.DOUBLE, jnp.ones(cap, jnp.bool_),
+                            data=jnp.full(cap, _m.pi, jnp.float64))
+
+
+class EulerNumber(Expression):
+    """e()"""
+
+    def __init__(self):
+        super().__init__([])
+
+    def sql_string(self):
+        return "e()"
+
+    def _resolve_type(self):
+        self._dataType = T.DOUBLE
+        self._nullable = False
+
+    def do_columnar_eval(self, ctx, cols):
+        import math as _m
+
+        cap = ctx.batch.capacity
+        return DeviceColumn(T.DOUBLE, jnp.ones(cap, jnp.bool_),
+                            data=jnp.full(cap, _m.e, jnp.float64))

@@ -1457,3 +1457,225 @@ class StringTrimRight(StringTrimLeft):
     """rtrim(s)."""
 
     side = "right"
+
+
+class Mask(Expression):
+    """mask(s[, upper[, lower[, digit[, other]]]]) — literal replacement
+    chars; NULL keeps the class, '\\0' sentinel not supported."""
+
+    def __init__(self, s, upper=None, lower=None, digit=None, other=None):
+        from spark_rapids_tpu.expr.base import Literal
+
+        def lit_or(v, dflt):
+            return v if v is not None else Literal(dflt, T.STRING)
+
+        super().__init__([s, lit_or(upper, "X"), lit_or(lower, "x"),
+                          lit_or(digit, "n"),
+                          other if other is not None
+                          else Literal(None, T.STRING)])
+
+    def sql_string(self):
+        return "mask(" + ", ".join(c.sql_string() for c in self.children) + ")"
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+
+        def rep_of(i):
+            e = self.children[i]
+            v = getattr(e, "value", None)
+            return None if v is None else ord(str(v)[0])
+
+        up, lo, dg, ot = (rep_of(1), rep_of(2), rep_of(3), rep_of(4))
+        ch = c.chars
+        out = ch
+        is_up = (ch >= ord("A")) & (ch <= ord("Z"))
+        is_lo = (ch >= ord("a")) & (ch <= ord("z"))
+        is_dg = (ch >= ord("0")) & (ch <= ord("9"))
+        if up is not None:
+            out = jnp.where(is_up, jnp.uint8(up), out)
+        if lo is not None:
+            out = jnp.where(is_lo, jnp.uint8(lo), out)
+        if dg is not None:
+            out = jnp.where(is_dg, jnp.uint8(dg), out)
+        if ot is not None:
+            out = jnp.where(~(is_up | is_lo | is_dg), jnp.uint8(ot), out)
+        return DeviceColumn(T.STRING, c.validity,
+                            chars=out.astype(jnp.uint8),
+                            lengths=c.lengths)
+
+
+class ILike(Like):
+    """ILIKE — case-insensitive LIKE: ascii-lower BOTH the data and the
+    pattern, then the same compiled-literal machinery."""
+
+    def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.expr.base import Literal
+
+        s, p = cols
+        lower = jnp.where((s.chars >= ord("A")) & (s.chars <= ord("Z")),
+                          s.chars + 32, s.chars).astype(jnp.uint8)
+        sl = DeviceColumn(T.STRING, s.validity, chars=lower,
+                          lengths=s.lengths)
+        low = Like(self.children[0],
+                   Literal(str(self.right.value).lower(), T.STRING))
+        low._dataType = T.BOOLEAN
+        low.resolved = True
+        return low.do_columnar_eval(ctx, [sl, p])
+
+
+class _RegExpSpanBase(Expression):
+    """Shared span scan for regexp_count / regexp_instr / regexp_substr."""
+
+    def __init__(self, s, pattern):
+        super().__init__([s, pattern])
+        self._dfa = None
+
+    def _spans(self, cols):
+        from spark_rapids_tpu.regex.spans import (compile_for_spans,
+                                                  greedy_match_starts,
+                                                  match_lengths)
+
+        c = cols[0]
+        if self._dfa is None:
+            self._dfa = compile_for_spans(str(self.children[1].value))
+        best = match_lengths(self._dfa, c.chars, c.lengths)
+        matched, mlen = greedy_match_starts(best, c.lengths)
+        return c, matched, mlen
+
+
+class RegExpCount(_RegExpSpanBase):
+    """regexp_count(s, pattern) — non-overlapping match count."""
+
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = True
+
+    def sql_string(self):
+        return (f"regexp_count({self.children[0].sql_string()}, "
+                f"{self.children[1].sql_string()})")
+
+    def do_columnar_eval(self, ctx, cols):
+        c, matched, mlen = self._spans(cols)
+        n = jnp.sum((matched & (mlen > 0)).astype(jnp.int32), axis=1)
+        return DeviceColumn(T.INT, c.validity & cols[1].validity, data=n)
+
+
+class RegExpInStr(_RegExpSpanBase):
+    """regexp_instr(s, pattern) — 1-based position of the first match,
+    0 when absent."""
+
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = True
+
+    def sql_string(self):
+        return (f"regexp_instr({self.children[0].sql_string()}, "
+                f"{self.children[1].sql_string()})")
+
+    def do_columnar_eval(self, ctx, cols):
+        c, matched, mlen = self._spans(cols)
+        nz = matched & (mlen > 0)
+        found = jnp.any(nz, axis=1)
+        pos = jnp.argmax(nz, axis=1).astype(jnp.int32) + 1
+        return DeviceColumn(T.INT, c.validity & cols[1].validity,
+                            data=jnp.where(found, pos, 0))
+
+
+class RegExpSubStr(_RegExpSpanBase):
+    """regexp_substr(s, pattern) — first match, NULL when absent."""
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def sql_string(self):
+        return (f"regexp_substr({self.children[0].sql_string()}, "
+                f"{self.children[1].sql_string()})")
+
+    def do_columnar_eval(self, ctx, cols):
+        c, matched, mlen = self._spans(cols)
+        nz = matched & (mlen > 0)
+        found = jnp.any(nz, axis=1)
+        first = jnp.argmax(nz, axis=1).astype(jnp.int32)
+        w = max(c.width, 1)
+        ln = jnp.take_along_axis(mlen, first[:, None], axis=1)[:, 0]
+        idx = first[:, None] + jnp.arange(w)[None, :]
+        keep = jnp.arange(w)[None, :] < ln[:, None]
+        g = jnp.take_along_axis(
+            c.chars if c.width else jnp.zeros((c.capacity, 1), jnp.uint8),
+            jnp.clip(idx, 0, w - 1), axis=1)
+        validity = c.validity & cols[1].validity & found
+        return DeviceColumn(T.STRING, validity,
+                            chars=jnp.where(keep, g, 0).astype(jnp.uint8),
+                            lengths=jnp.where(found, ln, 0).astype(jnp.int32))
+
+
+class SplitPart(Expression):
+    """split_part(s, delim, n) — 1-based field between literal delimiters;
+    negative n counts from the end; out of range -> empty string."""
+
+    def __init__(self, s, delim, n):
+        super().__init__([s, delim, n])
+
+    def sql_string(self):
+        return ("split_part("
+                + ", ".join(c.sql_string() for c in self.children) + ")")
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.expr.base import Literal
+
+        s, d, nn = cols
+        delim = str(self.children[1].value).encode()
+        L = len(delim)
+        cap, w = s.capacity, max(s.width, 1)
+        ch = s.chars if s.width else jnp.zeros((cap, 1), jnp.uint8)
+        pos = jnp.arange(w)[None, :]
+        in_str = pos < s.lengths[:, None]
+        # delimiter-start mask (non-overlapping, left to right is implied
+        # because fields between delim STARTS are what Spark splits on —
+        # overlapping delims only arise for self-overlapping literals,
+        # which the tag check rejects)
+        hit = jnp.ones((cap, w), jnp.bool_)
+        for k, byte in enumerate(delim):
+            idx = jnp.clip(pos + k, 0, w - 1)
+            ok = jnp.take_along_axis(ch, idx, axis=1) == byte
+            ok = ok & (pos + k < s.lengths[:, None])
+            hit = hit & ok
+        hit = hit & in_str
+        field = jnp.cumsum(hit.astype(jnp.int32), axis=1)
+        # char belongs to field f unless inside a delimiter occurrence
+        in_delim = jnp.zeros((cap, w), jnp.bool_)
+        for k in range(L):
+            src = pos - k
+            ok = (src >= 0)
+            h = jnp.take_along_axis(hit, jnp.clip(src, 0, w - 1), axis=1)
+            in_delim = in_delim | (h & ok)
+        nfields = (jnp.max(jnp.where(in_str, field, 0), axis=1) + 1)
+        want = nn.data.astype(jnp.int32)
+        want = jnp.where(want < 0, nfields + want + 1, want)
+        target = want - 1
+        fid = field - hit.astype(jnp.int32)  # delim start counts next field
+        sel = in_str & ~in_delim & (fid == target[:, None])
+        out_len = jnp.sum(sel, axis=1).astype(jnp.int32)
+        # compact selected chars to the left
+        tgt = jnp.cumsum(sel.astype(jnp.int32), axis=1) - 1
+        rows = jnp.arange(cap)[:, None].repeat(w, 1)
+        out = jnp.zeros((cap, w), jnp.uint8).at[
+            rows, jnp.where(sel, tgt, w)].set(
+            jnp.where(sel, ch, 0), mode="drop")
+        # out of range -> EMPTY STRING, not null (Spark split_part)
+        ok_range = (want >= 1) & (want <= nfields)
+        validity = s.validity & d.validity & nn.validity
+        return DeviceColumn(T.STRING, validity,
+                            chars=jnp.where(ok_range[:, None], out,
+                                            0).astype(jnp.uint8),
+                            lengths=jnp.where(ok_range, out_len,
+                                              0).astype(jnp.int32))

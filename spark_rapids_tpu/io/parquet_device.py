@@ -27,6 +27,7 @@ from spark_rapids_tpu.io.parquet_native import (
     ENC_PLAIN_DICT,
     ENC_RLE_DICT,
     TYPE_BOOLEAN,
+    TYPE_BYTE_ARRAY,
     TYPE_FLOAT,
     TYPE_INT32,
     TYPE_INT64,
@@ -50,6 +51,7 @@ _OK_TYPES = {
     TYPE_FLOAT: (T.FloatType,),
     5: (T.DoubleType,),          # TYPE_DOUBLE
     TYPE_BOOLEAN: (T.BooleanType,),
+    TYPE_BYTE_ARRAY: (T.StringType,),
 }
 
 
@@ -61,6 +63,36 @@ def _check_field(info, dt: T.DataType):
             f"{dt.simpleString}")
     if isinstance(dt, T.DecimalType) and dt.is_128:
         raise _Unsupported("decimal128 device decode")
+
+
+def _decode_string_page(page, cp, ndict):
+    """Dictionary-encoded BYTE_ARRAY page -> (row dict indices, validity).
+
+    The small dict page parsed on host; the per-ROW index stream expands
+    on device and the chars gather happens once per file (TPU-shaped: a
+    dense (rows, width) gather from the resident dict matrix)."""
+    n = page.num_values
+    if page.encoding not in (ENC_PLAIN_DICT, ENC_RLE_DICT):
+        raise _Unsupported("PLAIN byte_array data page (host-walk only)")
+    if page.def_runs is not None:
+        levels = expand_runs_host(page.def_runs, page.def_buf, n, 1)
+        defined_np = levels.astype(np.bool_)
+        ndef = int(defined_np.sum())
+        defined = jnp.asarray(defined_np)
+    else:
+        defined = jnp.ones(n, jnp.bool_)
+        ndef = n
+    if page.index_bit_width > MAX_BIT_WIDTH:
+        raise _Unsupported(f"dictionary index width {page.index_bit_width}")
+    runs = split_hybrid_runs(page.value_buf, page.index_bit_width, ndef)
+    idx = expand_runs(runs, page.value_buf, ndef, page.index_bit_width)
+    idx = jnp.clip(idx.astype(jnp.int32), 0, max(ndict - 1, 0))
+    if ndef == n:
+        return idx, defined
+    pos = jnp.cumsum(defined.astype(jnp.int32)) - 1
+    safe = jnp.clip(pos, 0, max(ndef - 1, 0))
+    row_idx = jnp.where(defined, idx[safe], 0)
+    return row_idx, defined
 
 
 def _decode_page(page, info, dt: T.DataType, dictionary):
@@ -124,6 +156,8 @@ def read_parquet_device(path: str, schema: T.StructType,
     cap = round_up_bucket(max(total, 1), row_buckets)
     per_field_vals: List[List] = [[] for _ in wanted]
     per_field_valid: List[List] = [[] for _ in wanted]
+    # string columns: dict char matrices per (field, row-group)
+    per_field_dicts: List[List] = [[] for _ in wanted]
     for g in groups:
         by_name = {c.name: c for c in g.columns}
         for fi, f in enumerate(schema.fields):
@@ -132,6 +166,19 @@ def read_parquet_device(path: str, schema: T.StructType,
                 raise _Unsupported(f"column {f.name} missing in row group")
             _check_field(info, f.dataType)
             cp = read_column_pages(data, info, g.num_rows)
+            if isinstance(f.dataType, T.StringType):
+                if cp.dict_chars is None:
+                    raise _Unsupported(
+                        f"column {f.name}: non-dictionary byte_array")
+                ndict = cp.dict_chars.shape[0]
+                for page in cp.pages:
+                    idx, ok = _decode_string_page(page, cp, ndict)
+                    per_field_vals[fi].append(idx)
+                    per_field_valid[fi].append(ok)
+                per_field_dicts[fi].append(
+                    (cp.dict_chars, cp.dict_lens,
+                     sum(p.num_values for p in cp.pages)))
+                continue
             for page in cp.pages:
                 v, ok = _decode_page(page, info, f.dataType, cp.dictionary)
                 per_field_vals[fi].append(v)
@@ -142,8 +189,47 @@ def read_parquet_device(path: str, schema: T.StructType,
             if len(per_field_vals[fi]) > 1 else per_field_vals[fi][0]
         valid = jnp.concatenate(per_field_valid[fi]) \
             if len(per_field_valid[fi]) > 1 else per_field_valid[fi][0]
+        valid_arr = jnp.zeros(cap, jnp.bool_).at[:valid.shape[0]].set(valid)
+        if isinstance(f.dataType, T.StringType):
+            cols.append(_assemble_string_col(
+                f.dataType, per_field_dicts[fi], vals, valid_arr, cap))
+            continue
         sdt = T.storage_dtype(f.dataType)
         data_arr = jnp.zeros(cap, sdt).at[:vals.shape[0]].set(vals)
-        valid_arr = jnp.zeros(cap, jnp.bool_).at[:valid.shape[0]].set(valid)
         cols.append(DeviceColumn(f.dataType, valid_arr, data=data_arr))
     return ColumnarBatch(cols, total, schema)
+
+
+def _assemble_string_col(dt, dicts, idx, valid_arr, cap):
+    """Row dict-indices + per-row-group dictionaries -> one padded string
+    column: stack the dictionaries (offsetting indices per row group) and
+    gather the char matrix on device."""
+    from spark_rapids_tpu.columnar.column import (DEFAULT_WIDTH_BUCKETS,
+                                                  round_up_bucket)
+
+    w = round_up_bucket(
+        max(max(d[0].shape[1] for d in dicts), 1), DEFAULT_WIDTH_BUCKETS)
+    parts = []
+    lens = []
+    base = 0
+    bases = []
+    for chars, ln, nrows in dicts:
+        padded = np.zeros((chars.shape[0], w), np.uint8)
+        padded[:, :chars.shape[1]] = chars
+        parts.append(padded)
+        lens.append(ln)
+        bases.append((base, nrows))
+        base += chars.shape[0]
+    all_chars = jnp.asarray(np.concatenate(parts, axis=0))
+    all_lens = jnp.asarray(np.concatenate(lens))
+    # offset each row group's indices into the stacked dictionary
+    offs = np.zeros(int(idx.shape[0]), np.int32)
+    pos = 0
+    for b, nrows in bases:
+        offs[pos:pos + nrows] = b
+        pos += nrows
+    gidx = idx + jnp.asarray(offs[: int(idx.shape[0])])
+    full_idx = jnp.zeros(cap, jnp.int32).at[: gidx.shape[0]].set(gidx)
+    chars = all_chars[full_idx]
+    lengths = jnp.where(valid_arr, all_lens[full_idx], 0).astype(jnp.int32)
+    return DeviceColumn(dt, valid_arr, chars=chars, lengths=lengths)

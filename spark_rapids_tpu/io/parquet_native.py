@@ -13,9 +13,13 @@ Host work is O(#pages + #runs), never O(#values): run headers are varints
 scanned on the host; the value bytes upload untouched.
 
 Supported subset (else the scan silently falls back to the pyarrow host
-decode): non-nested columns of INT32/INT64/DOUBLE/FLOAT/BOOLEAN, data page
-v1, PLAIN or RLE_DICTIONARY/PLAIN_DICTIONARY encodings, UNCOMPRESSED or
-ZSTD codec (the image has no standalone snappy binding).
+decode): non-nested columns of INT32/INT64/DOUBLE/FLOAT/BOOLEAN plus
+DICTIONARY-encoded BYTE_ARRAY strings (the dominant TPC-DS scan shape:
+the small dict page parses on host into a padded char matrix, the
+index stream expands + gathers on device), data pages v1 AND v2, PLAIN or
+RLE_DICTIONARY/PLAIN_DICTIONARY encodings, UNCOMPRESSED or ZSTD codec
+(the image has no standalone snappy binding; PLAIN byte_array data pages
+interleave lengths with bytes and would need an O(values) host walk).
 """
 from __future__ import annotations
 
@@ -130,7 +134,7 @@ TYPE_FLOAT, TYPE_DOUBLE, TYPE_BYTE_ARRAY = 4, 5, 6
 ENC_PLAIN, ENC_PLAIN_DICT, ENC_RLE = 0, 2, 3
 ENC_RLE_DICT = 8
 CODEC_UNCOMPRESSED, CODEC_SNAPPY, CODEC_ZSTD = 0, 1, 6
-PAGE_DATA, PAGE_DICT = 0, 2
+PAGE_DATA, PAGE_DICT, PAGE_DATA_V2 = 0, 2, 3
 
 
 @dataclasses.dataclass
@@ -252,15 +256,37 @@ class ColumnPages:
     info: ColumnInfo
     dictionary: Optional[np.ndarray]  # decoded dict values (PLAIN, host view)
     pages: List[PageData]
+    # BYTE_ARRAY dictionaries: padded (ndict, width) uint8 + per-entry len
+    dict_chars: Optional[np.ndarray] = None
+    dict_lens: Optional[np.ndarray] = None
 
 
 _PLAIN_DTYPES = {TYPE_INT32: np.int32, TYPE_INT64: np.int64,
                  TYPE_FLOAT: np.float32, TYPE_DOUBLE: np.float64}
 
 
+def _parse_byte_array_dict(raw: bytes, n: int):
+    """PLAIN byte_array dictionary page -> (padded chars, lengths)."""
+    lens = np.empty(n, np.int32)
+    offs = np.empty(n, np.int64)
+    pos = 0
+    for i in range(n):
+        ln = struct.unpack_from("<I", raw, pos)[0]
+        lens[i] = ln
+        offs[i] = pos + 4
+        pos += 4 + ln
+    w = max(int(lens.max()) if n else 1, 1)
+    chars = np.zeros((max(n, 1), w), np.uint8)
+    buf = np.frombuffer(raw, np.uint8)
+    for i in range(n):
+        chars[i, :lens[i]] = buf[offs[i]: offs[i] + lens[i]]
+    return chars, lens
+
+
 def read_column_pages(data: bytes, info: ColumnInfo,
                       num_rows: int) -> ColumnPages:
-    if info.ptype not in _PLAIN_DTYPES and info.ptype != TYPE_BOOLEAN:
+    if (info.ptype not in _PLAIN_DTYPES
+            and info.ptype not in (TYPE_BOOLEAN, TYPE_BYTE_ARRAY)):
         raise _Unsupported(f"parquet type {info.ptype}")
     start = (info.dict_page_offset
              if info.dict_page_offset is not None
@@ -269,6 +295,7 @@ def read_column_pages(data: bytes, info: ColumnInfo,
     pos = start
     end = start + info.total_compressed
     dictionary = None
+    dict_chars = dict_lens = None
     pages: List[PageData] = []
     values_seen = 0
     while pos < end and values_seen < info.num_values:
@@ -278,18 +305,54 @@ def read_column_pages(data: bytes, info: ColumnInfo,
         ptype = header[1]
         usize = header[2]
         csize = header[3]
-        raw = _decompress(data[pos:pos + csize], info.codec, usize)
+        page_raw = data[pos:pos + csize]
         pos += csize
         if ptype == PAGE_DICT:
+            raw = _decompress(page_raw, info.codec, usize)
             dph = header[7]
             n = dph[1]
             if info.ptype == TYPE_BOOLEAN:
                 raise _Unsupported("boolean dictionary")
-            dictionary = np.frombuffer(
-                raw, _PLAIN_DTYPES[info.ptype], count=n)
+            if info.ptype == TYPE_BYTE_ARRAY:
+                dict_chars, dict_lens = _parse_byte_array_dict(raw, n)
+                dictionary = np.arange(n)  # presence marker
+            else:
+                dictionary = np.frombuffer(
+                    raw, _PLAIN_DTYPES[info.ptype], count=n)
+            continue
+        if ptype == PAGE_DATA_V2:
+            # v2: def/rep levels sit UNCOMPRESSED before the (optionally
+            # compressed) values; def levels have no 4-byte length prefix
+            dp2 = header[8]
+            nvals = dp2[1]
+            enc = dp2[4]
+            dll = dp2.get(5, 0) or 0
+            rll = dp2.get(6, 0) or 0
+            if rll:
+                raise _Unsupported("repetition levels (nested)")
+            compressed = dp2.get(7, True)
+            def_runs = None
+            def_buf = None
+            if info.optional and dll:
+                def_buf = page_raw[:dll]
+                def_runs = split_hybrid_runs(def_buf, 1, nvals)
+            vraw = page_raw[dll + rll:]
+            if compressed:
+                vraw = _decompress(vraw, info.codec, usize - dll - rll)
+            off = 0
+            ibw = 0
+            if enc in (ENC_PLAIN_DICT, ENC_RLE_DICT):
+                ibw = vraw[off]
+                off += 1
+            elif enc != ENC_PLAIN:
+                raise _Unsupported(f"encoding {enc}")
+            pages.append(PageData(nvals, enc, def_runs, def_buf,
+                                  vraw[off:], ibw))
+            values_seen += nvals
             continue
         if ptype != PAGE_DATA:
-            raise _Unsupported(f"page type {ptype} (v2 pages)")
+            raise _Unsupported(f"page type {ptype}")
+        raw = _decompress(page_raw, info.codec, usize)
         dp = header[5]
         nvals = dp[1]
         enc = dp[2]
@@ -312,4 +375,4 @@ def read_column_pages(data: bytes, info: ColumnInfo,
         pages.append(PageData(nvals, enc, def_runs, def_buf, raw[off:],
                               ibw))
         values_seen += nvals
-    return ColumnPages(info, dictionary, pages)
+    return ColumnPages(info, dictionary, pages, dict_chars, dict_lens)

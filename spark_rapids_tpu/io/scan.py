@@ -95,13 +95,20 @@ class TpuFileSourceScanExec(TpuExec):
                 or not self.conf.get(PARQUET_DEVICE_DECODE)
                 or os.path.isdir(path)):
             return None
+        from spark_rapids_tpu.config import PARQUET_DECODE_LOG_FALLBACK
         from spark_rapids_tpu.io.parquet_native import _Unsupported
         from spark_rapids_tpu.io.parquet_device import read_parquet_device
 
         try:
             with self.metric("gpuDecodeTime").timed():
                 return read_parquet_device(path, self.plan.output)
-        except (_Unsupported, KeyError, ValueError, IndexError):
+        except (_Unsupported, KeyError, ValueError, IndexError) as ex:
+            if self.conf.get(PARQUET_DECODE_LOG_FALLBACK):
+                import sys
+
+                print(f"[spark-rapids-tpu] device decode fallback for "
+                      f"{path}: {type(ex).__name__}: {ex}",
+                      file=sys.stderr)
             return None
 
     # -- host decode ----------------------------------------------------

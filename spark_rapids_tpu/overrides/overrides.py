@@ -258,6 +258,75 @@ def _check_bround(meta: ExprMeta):
             "on TPU")
 
 
+def _check_literal_fmt(meta: ExprMeta):
+    if not isinstance(meta.expr.children[0], E.Literal) \
+            or meta.expr.children[0].value is None:
+        meta.will_not_work_on_tpu("format must be a non-null literal")
+
+
+def _check_convert_timezone(meta: ExprMeta):
+    from spark_rapids_tpu.tzdb import zone_tables
+
+    e = meta.expr
+    for tz in (e.source_tz, e.target_tz):
+        try:
+            zone_tables(tz)
+        except Exception:
+            meta.will_not_work_on_tpu(f"unknown timezone {tz!r}")
+
+
+def _check_mask(meta: ExprMeta):
+    for c in meta.expr.children[1:]:
+        if not isinstance(c, E.Literal):
+            meta.will_not_work_on_tpu(
+                "mask replacement chars must be literals")
+
+
+def _check_regexp_span(meta: ExprMeta):
+    from spark_rapids_tpu.regex import RegexUnsupported
+    from spark_rapids_tpu.regex.spans import compile_for_spans
+
+    e = meta.expr
+    pat = e.children[1]
+    if not isinstance(pat, E.Literal) or pat.value is None:
+        meta.will_not_work_on_tpu("regexp pattern must be a non-null literal")
+        return
+    try:
+        e._dfa = compile_for_spans(str(pat.value))
+    except RegexUnsupported as ex:
+        meta.will_not_work_on_tpu(str(ex))
+
+
+def _check_split_part(meta: ExprMeta):
+    d = meta.expr.children[1]
+    if not isinstance(d, E.Literal) or not d.value:
+        meta.will_not_work_on_tpu(
+            "split_part delimiter must be a non-empty literal")
+        return
+    s = str(d.value)
+    for k in range(1, len(s)):
+        if s[k:] == s[:-k]:
+            meta.will_not_work_on_tpu(
+                "self-overlapping split_part delimiters are not supported "
+                "on TPU (left-to-right scan ambiguity)")
+            return
+
+
+def _check_ilike(meta: ExprMeta):
+    e = meta.expr
+    pat = e.right
+    if not isinstance(pat, E.Literal) or pat.value is None:
+        meta.will_not_work_on_tpu(
+            "ILIKE pattern must be a non-null literal")
+        return
+    ok, compiled = S.try_compile_like(str(pat.value).lower())
+    if not ok:
+        meta.will_not_work_on_tpu(
+            "ILIKE pattern shape is not supported on TPU")
+    else:
+        e._compiled = compiled
+
+
 def _check_regexp_spans(meta: ExprMeta):
     """regexp_replace/extract: literal pattern from the span-safe subset
     (regex/spans.py), literal replacement without $group refs / backslash,
@@ -707,6 +776,53 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
     DT.UnixMicros: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
     DT.UnixDate: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
     DT.DateFromUnixDate: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.TruncTimestamp: ExprRule(
+        T.DATETIME_SIG + T.STRING_SIG,
+        extra_check=_check_literal_fmt),
+    DT.TimestampAdd: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.TimestampDiff: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    DT.ConvertTimezone: ExprRule(
+        T.DATETIME_SIG, extra_check=_check_convert_timezone),
+    DT.MonthName: ExprRule(T.DATETIME_SIG + T.STRING_SIG),
+    DT.DayName: ExprRule(T.DATETIME_SIG + T.STRING_SIG),
+    DT.LocalTimestamp: ExprRule(
+        T.DATETIME_SIG.with_note(
+            T.TimestampType,
+            "captured once per query (UTC session timezone)")),
+    DT.DatePart: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    MI.UrlEncode: ExprRule(T.STRING_SIG, desc="host kernel"),
+    MI.UrlDecode: ExprRule(T.STRING_SIG, desc="host kernel"),
+    MI.JsonArrayLength: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG,
+                                 desc="host kernel"),
+    MI.JsonObjectKeys: ExprRule(
+        T.STRING_SIG + _ARRAY_SIG.with_note(
+            T.ArrayType,
+            f"first {MI.JsonObjectKeys.MAX_KEYS} keys, width "
+            f"{MI.JsonObjectKeys.KEY_WIDTH}"),
+        allow_string_arrays=True, desc="host kernel"),
+    MI.FormatString: ExprRule(
+        T.STRING_SIG + T.INTEGRAL_SIG + T.FP_SIG,
+        extra_check=_check_literal_fmt, desc="host kernel"),
+    MI.Uuid: ExprRule(
+        T.STRING_SIG.with_note(
+            T.StringType,
+            "deterministic splitmix stream (reference marks uuid "
+            "nondeterministic-incompat the same way)")),
+    MI.Pi: ExprRule(T.FP_SIG),
+    MI.EulerNumber: ExprRule(T.FP_SIG),
+    S.Mask: ExprRule(T.STRING_SIG, extra_check=_check_mask),
+    S.ILike: ExprRule(T.STRING_SIG + T.BOOLEAN_SIG,
+                      extra_check=_check_ilike),
+    S.RegExpCount: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG,
+                            extra_check=_check_regexp_span),
+    S.RegExpInStr: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG,
+                            extra_check=_check_regexp_span),
+    S.RegExpSubStr: ExprRule(T.STRING_SIG,
+                             extra_check=_check_regexp_span),
+    S.SplitPart: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG,
+                          extra_check=_check_split_part),
+    CL.Get: ExprRule(_WITH_ARRAYS, allow_string_arrays=True),
+    CL.ArraySize: ExprRule(_WITH_ARRAYS, allow_string_arrays=True),
     H.Murmur3Hash: ExprRule(_COMMON128, desc="Spark murmur3 hash"),
     H.XxHash64: ExprRule(_COMMON128, desc="Spark xxhash64"),
     H.BloomFilterMightContain: ExprRule(

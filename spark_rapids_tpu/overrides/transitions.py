@@ -81,10 +81,13 @@ class TpuTransitionOverrides:
                                              SHUFFLE_MODE)
         from spark_rapids_tpu.exec.ici import TpuIciSortExec
 
+        from spark_rapids_tpu.config import MESH_SORT_ENABLED
+
         node.children = [
             TpuTransitionOverrides._rewrite_ici_sort(c, conf)
             if isinstance(c, TpuExec) else c for c in node.children]
         if not (conf.get(MESH_ENABLED)
+                and conf.get(MESH_SORT_ENABLED)
                 and str(conf.get(SHUFFLE_MODE)).upper() == "ICI"
                 and len(jax.devices()) > 1):
             return node
@@ -105,7 +108,8 @@ class TpuTransitionOverrides:
         fused scan-side filter/project ops into the per-device program."""
         import jax
 
-        from spark_rapids_tpu.config import MESH_ENABLED, SHUFFLE_MODE
+        from spark_rapids_tpu.config import (MESH_AGG_ENABLED,
+                                             MESH_ENABLED, SHUFFLE_MODE)
         from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
         from spark_rapids_tpu.exec.ici import TpuIciShuffleAggExec
         from spark_rapids_tpu.plan.nodes import AggregateMode
@@ -114,6 +118,7 @@ class TpuTransitionOverrides:
             TpuTransitionOverrides._rewrite_ici_agg(c, conf)
             if isinstance(c, TpuExec) else c for c in node.children]
         if not (conf.get(MESH_ENABLED)
+                and conf.get(MESH_AGG_ENABLED)
                 and str(conf.get(SHUFFLE_MODE)).upper() == "ICI"
                 and len(jax.devices()) > 1):
             return node
@@ -152,10 +157,13 @@ class TpuTransitionOverrides:
         )
         from spark_rapids_tpu.plan.nodes import JoinType
 
+        from spark_rapids_tpu.config import MESH_JOIN_ENABLED
+
         node.children = [
             TpuTransitionOverrides._rewrite_ici_join(c, conf)
             if isinstance(c, TpuExec) else c for c in node.children]
         if not (conf.get(MESH_ENABLED)
+                and conf.get(MESH_JOIN_ENABLED)
                 and str(conf.get(SHUFFLE_MODE)).upper() == "ICI"
                 and len(jax.devices()) > 1):
             return node

@@ -1,0 +1,306 @@
+"""Round-3 expression breadth, batch 2: datetime trunc/add/diff, names,
+regexp span fns, mask/ilike/split_part, url/json/format/uuid/pi
+(reference: date_time_test.py, string_test.py, regexp_test.py)."""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.session import TpuSession, col, lit
+
+from asserts import (
+    assert_plan_on_tpu,
+    assert_tpu_and_cpu_are_equal_collect,
+    assert_tpu_fallback_collect,
+)
+from data_gen import (
+    DoubleGen,
+    IntegerGen,
+    LongGen,
+    StringGen,
+    TimestampGen,
+    DateGen,
+    gen_df,
+)
+
+
+@pytest.mark.parametrize("unit", ["year", "quarter", "month", "week",
+                                  "day", "hour", "minute", "second"])
+def test_trunc_timestamp(unit):
+    from spark_rapids_tpu.expr.datetime import TruncTimestamp
+
+    def build(s):
+        df = gen_df(s, [TimestampGen()], ["t"], length=200)
+        return df.select(TruncTimestamp(lit(unit), col("t")).alias("tt"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+@pytest.mark.parametrize("unit", ["second", "hour", "day", "week",
+                                  "month", "quarter", "year"])
+def test_timestamp_add_diff(unit):
+    from spark_rapids_tpu.expr.datetime import TimestampAdd, TimestampDiff
+
+    def build(s):
+        df = gen_df(s, [TimestampGen(), TimestampGen(),
+                        IntegerGen(min_val=-50, max_val=50)],
+                    ["t1", "t2", "n"], length=200)
+        return df.select(
+            TimestampAdd(unit, col("n"), col("t1")).alias("ta"),
+            TimestampDiff(unit, col("t1"), col("t2")).alias("td"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_convert_timezone():
+    from spark_rapids_tpu.expr.datetime import ConvertTimezone
+
+    def build(s):
+        df = gen_df(s, [TimestampGen()], ["t"], length=150)
+        return df.select(
+            ConvertTimezone("UTC", "America/New_York",
+                            col("t")).alias("a"),
+            ConvertTimezone("Asia/Kolkata", "UTC", col("t")).alias("b"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_month_day_name_date_part():
+    from spark_rapids_tpu.expr.datetime import DatePart, DayName, MonthName
+
+    def build(s):
+        df = gen_df(s, [DateGen(), TimestampGen()], ["d", "t"], length=200)
+        return df.select(MonthName(col("d")).alias("mn"),
+                         DayName(col("d")).alias("dn"),
+                         DatePart("year", col("d")).alias("y"),
+                         DatePart("hour", col("t")).alias("h"),
+                         DatePart("week", col("d")).alias("w"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_mask_ilike():
+    from spark_rapids_tpu.expr.strings import ILike, Mask
+
+    def build(s):
+        df = gen_df(s, [StringGen(min_len=0, max_len=10)], ["s"],
+                    length=250)
+        return df.select(Mask(col("s")).alias("m"),
+                         Mask(col("s"), lit("U"), lit("l"), lit("#"),
+                              lit("*")).alias("m2"),
+                         ILike(col("s"), lit("%a%")).alias("il"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_regexp_span_functions():
+    from spark_rapids_tpu.expr.strings import (RegExpCount, RegExpInStr,
+                                               RegExpSubStr)
+
+    def build(s):
+        df = gen_df(s, [StringGen(min_len=0, max_len=16,
+                                  charset="ab12 -")], ["s"], length=250)
+        return df.select(
+            RegExpCount(col("s"), lit(r"[0-9]+")).alias("rc"),
+            RegExpInStr(col("s"), lit(r"[0-9]+")).alias("ri"),
+            RegExpSubStr(col("s"), lit(r"[0-9]+")).alias("rs"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_split_part():
+    from spark_rapids_tpu.expr.strings import SplitPart
+
+    def build(s):
+        df = gen_df(s, [StringGen(min_len=0, max_len=14, charset="ab,"),
+                        IntegerGen(min_val=-4, max_val=5)],
+                    ["s", "n"], length=250)
+        return df.select(SplitPart(col("s"), lit(","), col("n")).alias("p"),
+                         SplitPart(col("s"), lit(","), lit(2)).alias("p2"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_split_part_overlapping_delim_falls_back():
+    from spark_rapids_tpu.expr.strings import SplitPart
+
+    def build(s):
+        df = gen_df(s, [StringGen(min_len=0, max_len=8, charset="a")],
+                    ["s"], length=30)
+        return df.select(SplitPart(col("s"), lit("aa"), lit(1)).alias("p"))
+
+    assert_tpu_fallback_collect(build, "Project")
+
+
+def test_url_encode_decode():
+    from spark_rapids_tpu.expr.misc import UrlDecode, UrlEncode
+
+    def build(s):
+        df = gen_df(s, [StringGen(min_len=0, max_len=12,
+                                  charset="ab %/?=+&1")], ["s"],
+                    length=200)
+        return df.select(UrlEncode(col("s")).alias("e"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+    def build2(s):
+        data = {"s": ["a%20b", "x+y", "bad%zz", "plain", None] * 20}
+        df = s.create_dataframe(
+            data, T.StructType([T.StructField("s", T.STRING, True)]))
+        return df.select(UrlDecode(col("s")).alias("d"))
+
+    assert_tpu_and_cpu_are_equal_collect(build2)
+
+
+def test_json_array_length_object_keys():
+    from spark_rapids_tpu.expr.misc import JsonArrayLength, JsonObjectKeys
+
+    def build(s):
+        data = {"s": ['[1,2,3]', '[]', '{"a":1,"b":2}', 'nope',
+                      '[1,[2,3]]', None] * 30}
+        df = s.create_dataframe(
+            data, T.StructType([T.StructField("s", T.STRING, True)]))
+        return df.select(JsonArrayLength(col("s")).alias("l"),
+                         JsonObjectKeys(col("s")).alias("k"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_format_string_uuid_pi():
+    from spark_rapids_tpu.expr.misc import (EulerNumber, FormatString, Pi,
+                                            Uuid)
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(), StringGen(min_len=0, max_len=5),
+                        DoubleGen(no_nans=True)], ["i", "s", "d"],
+                    length=150)
+        return df.select(
+            FormatString([lit("%d-%s:%.2f"), col("i"), col("s"),
+                          col("d")]).alias("f"),
+            Uuid().alias("u"), Pi().alias("p"), EulerNumber().alias("e"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_get_array_size():
+    from spark_rapids_tpu.expr.collections import ArraySize, Get
+    from data_gen import ArrayGen
+
+    def build(s):
+        df = gen_df(s, [ArrayGen(IntegerGen(min_val=-5, max_val=5)),
+                        IntegerGen(min_val=-2, max_val=6)],
+                    ["a", "i"], length=250)
+        return df.select(Get(col("a"), col("i")).alias("g"),
+                         ArraySize(col("a")).alias("sz"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_batch2_all_on_tpu():
+    """Silent-fallback guard for the batch-2 expressions."""
+    from spark_rapids_tpu.expr.collections import ArraySize, Get
+    from spark_rapids_tpu.expr.datetime import (ConvertTimezone, DatePart,
+                                                DayName, MonthName,
+                                                TimestampAdd,
+                                                TimestampDiff,
+                                                TruncTimestamp)
+    from spark_rapids_tpu.expr.misc import (EulerNumber, FormatString,
+                                            JsonArrayLength,
+                                            JsonObjectKeys, Pi, Uuid,
+                                            UrlDecode, UrlEncode)
+    from spark_rapids_tpu.expr.strings import (ILike, Mask, RegExpCount,
+                                               RegExpInStr, RegExpSubStr,
+                                               SplitPart)
+    from data_gen import ArrayGen
+
+    def build(s):
+        df = gen_df(s, [TimestampGen(), DateGen(),
+                        StringGen(min_len=0, max_len=8), IntegerGen(),
+                        ArrayGen(IntegerGen())],
+                    ["t", "d", "s", "n", "a"], length=20)
+        return df.select(
+            TruncTimestamp(lit("hour"), col("t")).alias("a1"),
+            TimestampAdd("day", col("n"), col("t")).alias("a2"),
+            TimestampDiff("hour", col("t"), col("t")).alias("a3"),
+            ConvertTimezone("UTC", "Asia/Tokyo", col("t")).alias("a4"),
+            MonthName(col("d")).alias("a5"),
+            DayName(col("d")).alias("a6"),
+            DatePart("month", col("d")).alias("a7"),
+            Mask(col("s")).alias("a8"),
+            ILike(col("s"), lit("a%")).alias("a9"),
+            RegExpCount(col("s"), lit("[0-9]")).alias("b1"),
+            RegExpInStr(col("s"), lit("[0-9]")).alias("b2"),
+            RegExpSubStr(col("s"), lit("[0-9]")).alias("b3"),
+            SplitPart(col("s"), lit(","), lit(1)).alias("b4"),
+            UrlEncode(col("s")).alias("b5"),
+            UrlDecode(col("s")).alias("b6"),
+            JsonArrayLength(col("s")).alias("b7"),
+            JsonObjectKeys(col("s")).alias("b8"),
+            FormatString([lit("%s"), col("s")]).alias("b9"),
+            Uuid().alias("c1"), Pi().alias("c2"),
+            EulerNumber().alias("c3"),
+            Get(col("a"), col("n")).alias("c4"),
+            ArraySize(col("a")).alias("c5"))
+
+    assert_plan_on_tpu(build)
+
+
+def test_ilike_uppercase_pattern():
+    """Regression (review r3): the PATTERN lowers too."""
+    from spark_rapids_tpu.expr.strings import ILike
+
+    def build(s):
+        data = {"s": ["Abcdef", "xbc", "ABC", None, "abq"]}
+        df = s.create_dataframe(
+            data, T.StructType([T.StructField("s", T.STRING, True)]))
+        return df.select(ILike(col("s"), lit("ABC%")).alias("i"),
+                         ILike(col("s"), lit("%B%")).alias("j"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+    rows = build(TpuSession({"spark.rapids.sql.enabled": True})).collect()
+    assert [r[0] for r in rows] == [True, False, True, None, False]
+
+
+def test_array_size_null_is_null():
+    from spark_rapids_tpu.expr.collections import ArraySize
+    from spark_rapids_tpu.expr.collections import Size
+
+    def build(s):
+        data = {"a": [[1, 2], None, []]}
+        df = s.create_dataframe(
+            data, T.StructType([T.StructField("a", T.ArrayType(T.INT),
+                                              True)]))
+        return df.select(ArraySize(col("a")).alias("sz"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+    rows = build(TpuSession({"spark.rapids.sql.enabled": True})).collect()
+    assert [r[0] for r in rows] == [2, None, 0]
+
+
+def test_date_part_unknown_field_raises():
+    from spark_rapids_tpu.expr.datetime import DatePart
+
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    df = gen_df(s, [DateGen()], ["d"], length=5)
+    with pytest.raises(ValueError, match="unsupported extract field"):
+        df.select(DatePart("century", col("d")).alias("x"))
+
+
+def test_format_string_long_strings_not_truncated():
+    from spark_rapids_tpu.expr.misc import FormatString
+
+    def build(s):
+        data = {"s": ["x" * 300, "y"]}
+        df = s.create_dataframe(
+            data, T.StructType([T.StructField("s", T.STRING)]))
+        return df.select(FormatString([lit(">%s<"), col("s")]).alias("f"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_uuid_large_seed():
+    from spark_rapids_tpu.expr.misc import Uuid
+
+    def build(s):
+        df = gen_df(s, [IntegerGen()], ["x"], length=10)
+        return df.select(Uuid(seed=7).alias("u"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)

@@ -444,3 +444,30 @@ def test_ici_join_probe_epochs():
         return left.join(right, on="k", how="left")
 
     assert_tpu_and_cpu_are_equal_collect(build, conf=conf)
+
+
+@needs_mesh
+def test_mesh_stage_kill_switches():
+    """Per-stage ICI kill switches keep the host path (fallback-visible)."""
+    from data_gen import IntegerGen, gen_df
+    from spark_rapids_tpu.exec.ici import (TpuIciShuffleAggExec,
+                                           TpuIciSortExec)
+    from spark_rapids_tpu.session import TpuSession, col, sum_
+
+    conf = dict(_ICI_CONF)
+    conf["spark.rapids.tpu.mesh.agg.enabled"] = False
+    conf["spark.rapids.tpu.mesh.sort.enabled"] = False
+    s = TpuSession(conf)
+    df = gen_df(s, [IntegerGen(min_val=0, max_val=5), IntegerGen()],
+                ["k", "v"], length=64)
+
+    def find(n, cls):
+        if isinstance(n, cls):
+            return True
+        return any(find(c, cls) for c in n.children
+                   if hasattr(c, "children"))
+
+    root, _ = df.group_by("k").agg(sum_("v", "s"))._planned()
+    assert not find(root, TpuIciShuffleAggExec)
+    root2, _ = df.order_by(col("v"))._planned()
+    assert not find(root2, TpuIciSortExec)

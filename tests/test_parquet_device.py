@@ -86,3 +86,88 @@ def test_decode_metric_counts_device_path(tmp_path):
 
     batch = read_parquet_device(p, schema)
     assert batch.num_rows == 2000
+
+
+# -- round 3: dictionary string columns + data page v2 ----------------------
+
+
+def _write_with_strings(tmp_path, s, page_version="1.0", codec="NONE",
+                        n=1500):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from data_gen import StringGen
+    from spark_rapids_tpu.columnar.column import HostColumn
+
+    df = gen_df(s, [LongGen(), StringGen(min_len=0, max_len=12),
+                    StringGen(min_len=1, max_len=4, charset="abc"),
+                    IntegerGen(min_val=0, max_val=50)],
+                ["a", "s1", "s2", "b"], length=n, seed=11)
+    data = {}
+    names = df.schema.field_names()
+    rows = df.collect()
+    for i, (name, f) in enumerate(zip(names, df.schema.fields)):
+        data[name] = HostColumn.from_pylist(
+            [r[i] for r in rows], f.dataType).to_arrow()
+    p = str(tmp_path / f"s_{page_version}_{codec}.parquet")
+    pq.write_table(pa.table(data), p, compression=codec,
+                   use_dictionary=True, data_page_version=page_version)
+    return p, df.schema
+
+
+@pytest.mark.parametrize("page_version", ["1.0", "2.0"])
+@pytest.mark.parametrize("codec", ["NONE", "ZSTD"])
+def test_device_decode_strings(tmp_path, page_version, codec):
+    s = TpuSession(dict(_CONF, **{"spark.rapids.sql.enabled": True}))
+    p, schema = _write_with_strings(tmp_path, s, page_version, codec)
+
+    def build(sess):
+        return sess.read.schema(schema).parquet(p)
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=_CONF)
+
+
+def test_device_decode_strings_through_query(tmp_path):
+    s = TpuSession(dict(_CONF, **{"spark.rapids.sql.enabled": True}))
+    p, schema = _write_with_strings(tmp_path, s)
+
+    def build(sess):
+        from spark_rapids_tpu.session import count_
+
+        return (sess.read.schema(schema).parquet(p)
+                .filter(col("b") > lit(10))
+                .group_by("s2").agg(count_(None, "c"), sum_("a", "sa")))
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=_CONF)
+
+
+def test_device_decode_strings_uses_device_path(tmp_path):
+    """The string file must actually take the device decode — calling the
+    device reader directly raises _Unsupported on any fallback path."""
+    from spark_rapids_tpu.io.parquet_device import read_parquet_device
+
+    s = TpuSession(dict(_CONF, **{"spark.rapids.sql.enabled": True}))
+    p, schema = _write_with_strings(tmp_path, s)
+    batch = read_parquet_device(p, schema)
+    assert batch.num_rows == 1500
+    scol = batch.columns[1]
+    assert scol.is_string and scol.chars is not None
+
+
+@pytest.mark.parametrize("page_version", ["1.0", "2.0"])
+def test_device_decode_v2_pages_numerics(tmp_path, page_version):
+    s = TpuSession(dict(_CONF, **{"spark.rapids.sql.enabled": True}))
+    p, schema = _write(tmp_path, s)
+    # rewrite with the requested page version
+    import pyarrow.parquet as pq
+
+    tbl = pq.read_table(p)
+    p2 = str(tmp_path / f"v2_{page_version}.parquet")
+    pq.write_table(tbl, p2, compression="NONE", use_dictionary=True,
+                   data_page_version=page_version)
+
+    def build(sess):
+        return sess.read.schema(schema).parquet(p2).filter(
+            col("b") > lit(5))
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=_CONF)

@@ -11,6 +11,7 @@ Reference analog: the ScalaTest suites that assert exact values
 (CastOpSuite etc., SURVEY.md §4) rather than GPU==CPU.
 """
 import datetime
+import math
 from decimal import Decimal
 
 import pytest
@@ -548,3 +549,40 @@ def test_to_json_omits_null_fields():
         return _df1(s, [7], T.INT).select(StructsToJson(st).alias("r"))
 
     _both(build, [('{"p":7}',)])
+
+
+def test_float_sum_inf_cancellation_pinned():
+    """Spark sum over [+inf, -inf] is NaN (IEEE): the oracle's scalar adds
+    hit this path with a RuntimeWarning — pin the semantics so the NaN
+    behavior is deliberate, not incidental (VERDICT r2 weak #8)."""
+    import warnings
+
+    from spark_rapids_tpu.session import TpuSession, sum_, avg_
+
+    inf = float("inf")
+    data = {"v": [inf, -inf, 1.0, None], "w": [inf, inf, 1.0, 2.0]}
+    schema = T.StructType([T.StructField("v", T.DOUBLE, True),
+                           T.StructField("w", T.DOUBLE, True)])
+
+    def run(enabled):
+        s = TpuSession({"spark.rapids.sql.enabled": enabled})
+        df = s.create_dataframe(data, schema)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", RuntimeWarning)
+            try:
+                return df.agg(sum_("v", "sv"), sum_("w", "sw"),
+                              avg_("v", "av")).collect()
+            except RuntimeWarning:
+                # the oracle's scalar-add path may warn; semantics pinned
+                # below are what matter — rerun without -Werror
+                pass
+        s2 = TpuSession({"spark.rapids.sql.enabled": enabled})
+        df2 = s2.create_dataframe(data, schema)
+        return df2.agg(sum_("v", "sv"), sum_("w", "sw"),
+                       avg_("v", "av")).collect()
+
+    for enabled in (False, True):
+        ((sv, sw, av),) = run(enabled)
+        assert math.isnan(sv), f"sum(+inf,-inf,...) must be NaN ({enabled})"
+        assert sw == inf
+        assert math.isnan(av)
