@@ -23,7 +23,7 @@ referenced input bytes / TPU wall time, with the v5e HBM roofline
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}
 with per-query detail nested under "queries".
 
-Env knobs: BENCH_ROWS (default 10M), BENCH_REPEATS (default 3).
+Env knobs: BENCH_ROWS (default 4M), BENCH_REPEATS (default 2).
 """
 from __future__ import annotations
 
@@ -34,6 +34,11 @@ import time
 from decimal import Decimal
 
 import numpy as np
+
+# the dev chip compiles over a tunnel (~20-60s per program); the
+# persistent cache makes repeat bench invocations skip those entirely
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/srt_jax_cache")
 
 V5E_HBM_GBPS = 819.0
 N_STORES = 40
@@ -302,8 +307,8 @@ def _bytes_of(*col_dicts):
 
 
 def main():
-    n = int(os.environ.get("BENCH_ROWS", 10_000_000))
-    repeats = int(os.environ.get("BENCH_REPEATS", 3))
+    n = int(os.environ.get("BENCH_ROWS", 4_000_000))
+    repeats = int(os.environ.get("BENCH_REPEATS", 2))
     queries = {}
 
     # ---- rung 1: Q6 ------------------------------------------------------

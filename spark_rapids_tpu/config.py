@@ -388,11 +388,21 @@ TPU_STRING_WIDTH_BUCKETS = conf("spark.rapids.tpu.string.widthBuckets").doc(
 TPU_DONATE_BUFFERS = conf("spark.rapids.tpu.donateInputBuffers").doc(
     "Donate input HBM buffers to XLA where legal.").boolean_conf(True)
 
-PARQUET_DECODE_LOG_FALLBACK = conf(
-    "spark.rapids.sql.format.parquet.decode.logFallback").doc(
-    "Log (stderr) why a file fell back from the Pallas device decode to "
-    "the host pyarrow decode — silent fallbacks are otherwise invisible."
-).boolean_conf(False)
+ORC_DEVICE_DECODE = conf(
+    "spark.rapids.sql.format.orc.decode.device").doc(
+    "Decode ORC stripe numerics on device: host parses protobuf footers "
+    "and splits RLEv2 runs, the Pallas bit-unpack kernel expands DIRECT "
+    "payloads (MSB packing bridged by byte/value bit-reversal), DELTA "
+    "runs cumsum on device.  Unsupported shapes silently fall back to "
+    "the pyarrow host decode.  Off by default for the same reason as the "
+    "parquet knob: per-run eager dispatches round-trip the compile "
+    "tunnel on this dev platform.").boolean_conf(False)
+
+DECODE_LOG_FALLBACK = conf(
+    "spark.rapids.sql.decode.logFallback").doc(
+    "Log (stderr) why a file fell back from the device decode (parquet "
+    "OR orc) to the host pyarrow decode — silent fallbacks are otherwise "
+    "invisible.").boolean_conf(False)
 
 TPU_SCAN_CACHE = conf("spark.rapids.tpu.scan.cacheDeviceBatches").doc(
     "Keep scanned batches resident in HBM across queries over the same "

@@ -1520,10 +1520,15 @@ class ILike(Like):
                           s.chars + 32, s.chars).astype(jnp.uint8)
         sl = DeviceColumn(T.STRING, s.validity, chars=lower,
                           lengths=s.lengths)
-        low = Like(self.children[0],
-                   Literal(str(self.right.value).lower(), T.STRING))
-        low._dataType = T.BOOLEAN
-        low.resolved = True
+        low = getattr(self, "_low", None)
+        if low is None:
+            low = Like(self.children[0],
+                       Literal(str(self.right.value).lower(), T.STRING))
+            low._dataType = T.BOOLEAN
+            low.resolved = True
+            if getattr(self, "_compiled", None) is not None:
+                low._compiled = self._compiled  # tag-time DFA, reused
+            self._low = low
         return low.do_columnar_eval(ctx, [sl, p])
 
 

@@ -65,6 +65,28 @@ def _check_field(info, dt: T.DataType):
         raise _Unsupported("decimal128 device decode")
 
 
+def expand_defined(page):
+    """Definition levels -> (defined bool array, ndef) — host expansion of
+    the tiny 1-bit streams (shared by numeric + string pages and the ORC
+    reader's PRESENT handling)."""
+    n = page.num_values
+    if page.def_runs is not None:
+        levels = expand_runs_host(page.def_runs, page.def_buf, n, 1)
+        defined_np = levels.astype(np.bool_)
+        return jnp.asarray(defined_np), int(defined_np.sum())
+    return jnp.ones(n, jnp.bool_), n
+
+
+def scatter_present(vals, defined, ndef, n):
+    """Compacted present values -> row positions (null rows zero-filled)."""
+    if ndef == n:
+        return vals
+    pos = jnp.cumsum(defined.astype(jnp.int32)) - 1
+    safe = jnp.clip(pos, 0, max(ndef - 1, 0))
+    return jnp.where(defined, vals[safe],
+                     jnp.zeros((), vals.dtype))
+
+
 def _decode_string_page(page, cp, ndict):
     """Dictionary-encoded BYTE_ARRAY page -> (row dict indices, validity).
 
@@ -74,40 +96,19 @@ def _decode_string_page(page, cp, ndict):
     n = page.num_values
     if page.encoding not in (ENC_PLAIN_DICT, ENC_RLE_DICT):
         raise _Unsupported("PLAIN byte_array data page (host-walk only)")
-    if page.def_runs is not None:
-        levels = expand_runs_host(page.def_runs, page.def_buf, n, 1)
-        defined_np = levels.astype(np.bool_)
-        ndef = int(defined_np.sum())
-        defined = jnp.asarray(defined_np)
-    else:
-        defined = jnp.ones(n, jnp.bool_)
-        ndef = n
+    defined, ndef = expand_defined(page)
     if page.index_bit_width > MAX_BIT_WIDTH:
         raise _Unsupported(f"dictionary index width {page.index_bit_width}")
     runs = split_hybrid_runs(page.value_buf, page.index_bit_width, ndef)
     idx = expand_runs(runs, page.value_buf, ndef, page.index_bit_width)
     idx = jnp.clip(idx.astype(jnp.int32), 0, max(ndict - 1, 0))
-    if ndef == n:
-        return idx, defined
-    pos = jnp.cumsum(defined.astype(jnp.int32)) - 1
-    safe = jnp.clip(pos, 0, max(ndef - 1, 0))
-    row_idx = jnp.where(defined, idx[safe], 0)
-    return row_idx, defined
+    return scatter_present(idx, defined, ndef, n), defined
 
 
 def _decode_page(page, info, dt: T.DataType, dictionary):
     """One data page -> (values (n,), validity (n,)) device arrays."""
     n = page.num_values
-    if page.def_runs is not None:
-        # def levels expand on the host (tiny 1-bit streams, many runs —
-        # per-run device dispatch would dominate); ndef comes free
-        levels = expand_runs_host(page.def_runs, page.def_buf, n, 1)
-        defined_np = levels.astype(np.bool_)
-        ndef = int(defined_np.sum())
-        defined = jnp.asarray(defined_np)
-    else:
-        defined = jnp.ones(n, jnp.bool_)
-        ndef = n
+    defined, ndef = expand_defined(page)
     sdt = T.storage_dtype(dt)
     if page.encoding in (ENC_PLAIN_DICT, ENC_RLE_DICT):
         if dictionary is None:
@@ -133,13 +134,7 @@ def _decode_page(page, info, dt: T.DataType, dictionary):
     else:
         raise _Unsupported(f"encoding {page.encoding}")
     vals = vals.astype(sdt)
-    if ndef == n:
-        return vals, defined
-    # scatter defined values back to row positions
-    pos = jnp.cumsum(defined.astype(jnp.int32)) - 1
-    safe = jnp.clip(pos, 0, max(ndef - 1, 0))
-    row_vals = jnp.where(defined, vals[safe], jnp.zeros((), sdt))
-    return row_vals, defined
+    return scatter_present(vals, defined, ndef, n), defined
 
 
 def read_parquet_device(path: str, schema: T.StructType,

@@ -23,6 +23,7 @@ Reader mode selection matches the reference:
 from __future__ import annotations
 
 import concurrent.futures as cf
+from struct import error as struct_error
 from typing import Iterator, List, Optional
 
 from spark_rapids_tpu import types as T
@@ -91,19 +92,33 @@ class TpuFileSourceScanExec(TpuExec):
         """Pallas decode path; None -> fall back to the host decode."""
         import os
 
-        if (self.plan.fmt != "parquet"
-                or not self.conf.get(PARQUET_DEVICE_DECODE)
-                or os.path.isdir(path)):
+        from spark_rapids_tpu.config import ORC_DEVICE_DECODE
+
+        if os.path.isdir(path):
             return None
-        from spark_rapids_tpu.config import PARQUET_DECODE_LOG_FALLBACK
+        if self.plan.fmt == "parquet":
+            if not self.conf.get(PARQUET_DEVICE_DECODE):
+                return None
+        elif self.plan.fmt == "orc":
+            if not self.conf.get(ORC_DEVICE_DECODE):
+                return None
+        else:
+            return None
+        from spark_rapids_tpu.config import DECODE_LOG_FALLBACK
         from spark_rapids_tpu.io.parquet_native import _Unsupported
         from spark_rapids_tpu.io.parquet_device import read_parquet_device
 
         try:
             with self.metric("gpuDecodeTime").timed():
+                if self.plan.fmt == "orc":
+                    from spark_rapids_tpu.io.orc_device import (
+                        read_orc_device)
+
+                    return read_orc_device(path, self.plan.output)
                 return read_parquet_device(path, self.plan.output)
-        except (_Unsupported, KeyError, ValueError, IndexError) as ex:
-            if self.conf.get(PARQUET_DECODE_LOG_FALLBACK):
+        except (_Unsupported, KeyError, ValueError, IndexError,
+                struct_error) as ex:
+            if self.conf.get(DECODE_LOG_FALLBACK):
                 import sys
 
                 print(f"[spark-rapids-tpu] device decode fallback for "

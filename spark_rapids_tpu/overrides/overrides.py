@@ -280,6 +280,9 @@ def _check_mask(meta: ExprMeta):
         if not isinstance(c, E.Literal):
             meta.will_not_work_on_tpu(
                 "mask replacement chars must be literals")
+        elif c.value is not None and len(str(c.value)) != 1:
+            meta.will_not_work_on_tpu(
+                "mask replacements must be single characters")
 
 
 def _check_regexp_span(meta: ExprMeta):
