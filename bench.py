@@ -23,7 +23,12 @@ referenced input bytes / TPU wall time, with the v5e HBM roofline
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}
 with per-query detail nested under "queries".
 
-Env knobs: BENCH_ROWS (default 4M), BENCH_REPEATS (default 2).
+Env knobs: BENCH_ROWS (default 2M), BENCH_REPEATS (default 2),
+BENCH_TIME_BUDGET seconds (default 2400) — on this compile-tunnel dev
+platform every program costs ~20-60s+ to compile, so the suite emits its
+JSON line from whatever completed inside the budget instead of dying at
+an outer timeout with nothing (each completed query is timed fully;
+skipped ones are listed under "skipped").
 """
 from __future__ import annotations
 
@@ -35,10 +40,6 @@ from decimal import Decimal
 
 import numpy as np
 
-# the dev chip compiles over a tunnel (~20-60s per program); the
-# persistent cache makes repeat bench invocations skip those entirely
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      "/tmp/srt_jax_cache")
 
 V5E_HBM_GBPS = 819.0
 N_STORES = 40
@@ -307,49 +308,140 @@ def _bytes_of(*col_dicts):
 
 
 def main():
-    n = int(os.environ.get("BENCH_ROWS", 4_000_000))
+    n = int(os.environ.get("BENCH_ROWS", 2_000_000))
     repeats = int(os.environ.get("BENCH_REPEATS", 2))
+    budget = float(os.environ.get("BENCH_TIME_BUDGET", 2400))
+    t_start = time.perf_counter()
+    skipped = []
+
+    # an outer `timeout`'s SIGTERM must still yield the JSON line: convert
+    # it to an exception so the finally-emit below runs with whatever
+    # queries completed (tunnel compiles can exceed any fixed budget)
+    import signal
+
+    def _term(_sig, _frm):
+        raise TimeoutError("SIGTERM/SIGINT during bench")
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    # NOTE: no JAX_COMPILATION_CACHE_DIR here on purpose — the axon
+    # remote-compile relay crashed (SIGSEGV / truncated responses) when
+    # the persistent cache rerouted compiles through its AOT path.
     queries = {}
 
-    # ---- rung 1: Q6 ------------------------------------------------------
-    li = make_lineitem(n)
-    q6_bytes = _bytes_of(li)
+    emitted = {"done": False}
 
-    t_vec, vec_res = _time_repeats(lambda: cpu_q6_vectorized(li), repeats)
-    oracle_df = build_q6(_session(False), li)
-    t_oracle, oracle_rows = _time_repeats(oracle_df.collect, repeats)
+    def over_budget():
+        return time.perf_counter() - t_start > budget
 
-    tpu_hot_df = build_q6(_session(True, cache_batches=True), li)
-    t_hot, tpu_rows = _time_repeats(tpu_hot_df.collect, repeats)
-    tpu_scan_df = build_q6(_session(True, cache_batches=False), li)
-    t_scan, _ = _time_repeats(tpu_scan_df.collect, repeats)
+    def progress(msg):
+        import sys
 
-    assert int(tpu_rows[0][0].scaleb(4)) == vec_res, \
-        f"Q6 mismatch: tpu {tpu_rows[0][0]} vs vectorized {vec_res}"
-    assert tpu_rows == oracle_rows
+        print(f"[bench {time.perf_counter() - t_start:7.1f}s] {msg}",
+              file=sys.stderr, flush=True)
 
-    queries["q6_hot"] = dict(
-        tpu_s=t_hot, cpu_vec_s=t_vec, cpu_oracle_s=t_oracle,
-        rows_per_s=n / t_hot, eff_gbps=q6_bytes / t_hot / 1e9,
-        vs_vec=t_vec / t_hot, vs_oracle=t_oracle / t_hot)
-    queries["q6_scan"] = dict(
-        tpu_s=t_scan, cpu_vec_s=t_vec, cpu_oracle_s=t_oracle,
-        rows_per_s=n / t_scan, eff_gbps=q6_bytes / t_scan / 1e9,
-        vs_vec=t_vec / t_scan, vs_oracle=t_oracle / t_scan)
+    def emit():
+        if emitted["done"]:
+            return
+        emitted["done"] = True
+        rung2 = [q for q in ("qa_join_agg_hot", "qb_left_join_hot",
+                             "qc_window_hot") if q in queries]
+        geo_vec = (math.exp(sum(math.log(queries[q]["vs_vec"])
+                                for q in rung2) / len(rung2))
+                   if rung2 else 0.0)
+        rung2_scan = [q for q in ("qa_join_agg_scan",) if q in queries]
+        geo_scan = (math.exp(sum(math.log(queries[q]["vs_vec"])
+                                 for q in rung2_scan) / len(rung2_scan))
+                    if rung2_scan else 0.0)
+        for q in queries.values():
+            q["hbm_frac"] = q["eff_gbps"] / V5E_HBM_GBPS
+            for k in list(q):
+                q[k] = round(q[k], 6)
+        print(json.dumps({
+            "metric": "tpcds_mini_geomean_speedup_vs_vectorized_cpu",
+            "value": round(geo_vec, 3),
+            "unit": "x",
+            "vs_baseline": round(geo_vec, 3),
+            "rows": n,
+            "skipped_on_time_budget": skipped,
+            "scan_inclusive_geomean": round(geo_scan, 3),
+            "hbm_roofline_gbps": V5E_HBM_GBPS,
+            "note": ("vs_baseline = geomean TPU speedup over "
+                     "hand-vectorized numpy (bincount/searchsorted/"
+                     "lexsort) across the completed rung-2 queries with "
+                     "device-resident inputs (_hot); "
+                     "scan_inclusive_geomean pays the host->device "
+                     "transfer every run — on this tunnel-relayed chip "
+                     "the transport tops out near 5-40 MB/s and each "
+                     "program compile costs minutes, so _scan is "
+                     "transport-bound and 'skipped_on_time_budget' lists "
+                     "queries whose compiles did not fit the budget; "
+                     "per-query detail incl. TPC-H Q6 under 'queries'"),
+            "queries": queries,
+        }), flush=True)
+
+    _ALL = ["qa_join_agg", "qb_left_join", "qc_window"]
+
+    def abort(current):
+        idx = _ALL.index(current) if current in _ALL else 0
+        skipped.extend(_ALL[idx:])
+        progress(f"terminated during {current}; emitting partial results")
+        emit()
+
+    try:
+        # ---- rung 1: Q6 ------------------------------------------------------
+        li = make_lineitem(n)
+        q6_bytes = _bytes_of(li)
+
+        t_vec, vec_res = _time_repeats(lambda: cpu_q6_vectorized(li), repeats)
+        oracle_df = build_q6(_session(False), li)
+        t_oracle, oracle_rows = _time_repeats(oracle_df.collect, repeats)
+
+        tpu_hot_df = build_q6(_session(True, cache_batches=True), li)
+        t_hot, tpu_rows = _time_repeats(tpu_hot_df.collect, repeats)
+        tpu_scan_df = build_q6(_session(True, cache_batches=False), li)
+        t_scan, _ = _time_repeats(tpu_scan_df.collect, repeats)
+
+        assert int(tpu_rows[0][0].scaleb(4)) == vec_res, \
+            f"Q6 mismatch: tpu {tpu_rows[0][0]} vs vectorized {vec_res}"
+        assert tpu_rows == oracle_rows
+
+        queries["q6_hot"] = dict(
+            tpu_s=t_hot, cpu_vec_s=t_vec, cpu_oracle_s=t_oracle,
+            rows_per_s=n / t_hot, eff_gbps=q6_bytes / t_hot / 1e9,
+            vs_vec=t_vec / t_hot, vs_oracle=t_oracle / t_hot)
+        queries["q6_scan"] = dict(
+            tpu_s=t_scan, cpu_vec_s=t_vec, cpu_oracle_s=t_oracle,
+            rows_per_s=n / t_scan, eff_gbps=q6_bytes / t_scan / 1e9,
+            vs_vec=t_vec / t_scan, vs_oracle=t_oracle / t_scan)
+    except TimeoutError:
+        skipped.extend(["q6"] + _ALL)
+        progress("terminated during rung 1; emitting partial results")
+        emit()
+        return
 
     # ---- rung 2 ----------------------------------------------------------
     ss = make_store_sales(n)
     dd = make_date_dim()
     sr = make_store_returns(ss, n // 10)
 
-    def run_query(name, build, args, vec_fn, check, bytes_):
+    def run_query(name, build, args, vec_fn, check, bytes_,
+                  scan_mode=False):
+        if over_budget():
+            skipped.append(name)
+            progress(f"skipping {name} (budget)")
+            return
         t_vec, vec_res = _time_repeats(lambda: vec_fn(), repeats)
         t_oracle, _ = _time_repeats(build(_session(False), *args).collect,
                                     repeats)
-        for mode, cache in (("hot", True), ("scan", False)):
+        progress(f"{name}: baselines done (vec {t_vec:.2f}s, oracle "
+                 f"{t_oracle:.2f}s)")
+        modes = [("hot", True)] + ([("scan", False)] if scan_mode else [])
+        for mode, cache in modes:
             df = build(_session(True, cache_batches=cache), *args)
             t_tpu, rows = _time_repeats(df.collect, repeats)
             check(rows, vec_res)
+            progress(f"{name}_{mode}: tpu {t_tpu:.2f}s")
             queries[f"{name}_{mode}"] = dict(
                 tpu_s=t_tpu, cpu_vec_s=t_vec, cpu_oracle_s=t_oracle,
                 rows_per_s=n / t_tpu, eff_gbps=bytes_ / t_tpu / 1e9,
@@ -359,60 +451,43 @@ def main():
         got = {(int(r[0]), int(r[1])): int(r[2].scaleb(2)) for r in rows}
         assert got == want, "qa mismatch vs vectorized baseline"
 
-    run_query("qa_join_agg", build_qa, (ss, dd),
-              lambda: cpu_qa_vectorized(ss, dd), check_qa,
-              _bytes_of({"a": ss["date_sk"], "b": ss["store_sk"],
-                         "c": ss["ext_sales"]}, dd))
+    try:
+        run_query("qa_join_agg", build_qa, (ss, dd),
+                  lambda: cpu_qa_vectorized(ss, dd), check_qa,
+                  _bytes_of({"a": ss["date_sk"], "b": ss["store_sk"],
+                             "c": ss["ext_sales"]}, dd), scan_mode=True)
+    except TimeoutError:
+        abort("qa_join_agg")
+        return
 
     def check_qb(rows, want):
         got = {int(r[0]): int(r[1].scaleb(2)) for r in rows}
         assert got == want, "qb mismatch vs vectorized baseline"
 
-    run_query("qb_left_join", build_qb, (ss, sr),
-              lambda: cpu_qb_vectorized(ss, sr), check_qb,
-              _bytes_of({"a": ss["ticket"], "b": ss["item_sk"],
-                         "c": ss["store_sk"], "d": ss["ext_sales"]}, sr))
+    try:
+        run_query("qb_left_join", build_qb, (ss, sr),
+                  lambda: cpu_qb_vectorized(ss, sr), check_qb,
+                  _bytes_of({"a": ss["ticket"], "b": ss["item_sk"],
+                             "c": ss["store_sk"],
+                             "d": ss["ext_sales"]}, sr))
+    except TimeoutError:
+        abort("qb_left_join")
+        return
 
     def check_qc(rows, want):
         got = {(int(r[0]), int(r[1]), int(r[2].scaleb(2)), int(r[3]))
                for r in rows}
         assert got == want, "qc mismatch vs vectorized baseline"
 
-    run_query("qc_window", build_qc, (ss,),
-              lambda: cpu_qc_vectorized(ss), check_qc,
-              _bytes_of({"a": ss["store_sk"], "b": ss["date_sk"],
-                         "c": ss["ext_sales"]}))
-
-    # ---- headline --------------------------------------------------------
-    rung2 = ["qa_join_agg_hot", "qb_left_join_hot", "qc_window_hot"]
-    geo_vec = math.exp(sum(math.log(queries[q]["vs_vec"])
-                           for q in rung2) / len(rung2))
-    rung2_scan = ["qa_join_agg_scan", "qb_left_join_scan",
-                  "qc_window_scan"]
-    geo_scan = math.exp(sum(math.log(queries[q]["vs_vec"])
-                            for q in rung2_scan) / len(rung2_scan))
-    for q in queries.values():
-        q["hbm_frac"] = q["eff_gbps"] / V5E_HBM_GBPS
-        for k in list(q):
-            q[k] = round(q[k], 6)
-    print(json.dumps({
-        "metric": "tpcds_mini_geomean_speedup_vs_vectorized_cpu",
-        "value": round(geo_vec, 3),
-        "unit": "x",
-        "vs_baseline": round(geo_vec, 3),
-        "rows": n,
-        "scan_inclusive_geomean": round(geo_scan, 3),
-        "hbm_roofline_gbps": V5E_HBM_GBPS,
-        "note": ("vs_baseline = geomean TPU speedup over hand-vectorized "
-                 "numpy (bincount/searchsorted/lexsort) across the three "
-                 "rung-2 queries with device-resident inputs (_hot); "
-                 "scan_inclusive_geomean repeats them paying the "
-                 "host->device transfer every run (_scan) — on this "
-                 "tunnel-relayed chip the transport tops out near "
-                 "40 MB/s, so _scan is transport-bound, not compute; "
-                 "per-query detail incl. TPC-H Q6 under 'queries'"),
-        "queries": queries,
-    }))
+    try:
+        run_query("qc_window", build_qc, (ss,),
+                  lambda: cpu_qc_vectorized(ss), check_qc,
+                  _bytes_of({"a": ss["store_sk"], "b": ss["date_sk"],
+                             "c": ss["ext_sales"]}))
+    except TimeoutError:
+        skipped.append("qc_window")
+        progress("terminated during qc_window; emitting partial results")
+    emit()
 
 
 if __name__ == "__main__":

@@ -121,6 +121,26 @@ class TpuExec:
             s += "\n" + c.pretty(indent + 1)
         return s
 
+    def metrics_report(self, indent: int = 0) -> str:
+        """Per-operator metric rollup after execution — the Spark SQL UI
+        metrics surface (GpuMetric / GpuTaskMetrics analog, SURVEY §5.5).
+        Time metrics render in ms; zero-valued metrics are elided."""
+        parts = []
+        for name, m in sorted(self.metrics.items()):
+            if not m.value:
+                continue
+            if name.endswith(("Time", "time")):
+                parts.append(f"{name}={m.value / 1e6:.1f}ms")
+            else:
+                parts.append(f"{name}={m.value}")
+        s = "  " * indent + self.describe()
+        if parts:
+            s += "  [" + ", ".join(parts) + "]"
+        for c in self.children:
+            if hasattr(c, "metrics_report"):
+                s += "\n" + c.metrics_report(indent + 1)
+        return s
+
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
         """Yield device batches; implemented by subclasses."""
         raise NotImplementedError(self.node_name)

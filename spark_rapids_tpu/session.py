@@ -514,6 +514,18 @@ class DataFrame:
     def write(self) -> "DataFrameWriter":
         return DataFrameWriter(self)
 
+    def metrics_report(self) -> str:
+        """Per-operator metrics CUMULATIVE across every execution of this
+        DataFrame's cached plan (run collect() first) — the Spark SQL UI
+        metrics analog, which likewise accumulates across a query's
+        tasks."""
+        root, _ = self._planned()
+        from spark_rapids_tpu.exec.base import TpuExec
+
+        if isinstance(root, TpuExec):
+            return root.metrics_report()
+        return "(plan ran on the CPU oracle; no TPU metrics)"
+
     def explain(self, mode: str = "formatted") -> str:
         from spark_rapids_tpu.exec.base import TpuExec
 

@@ -368,3 +368,20 @@ def test_out_of_core_sort_mixed_string_widths(tmp_path):
 
     assert_tpu_and_cpu_are_equal_collect(build, conf=conf,
                                          ignore_order=False)
+
+
+def test_metrics_report_surface():
+    """df.metrics_report() renders per-operator metric rollups after
+    execution (the SQL-UI metrics analog, SURVEY §5.5)."""
+    from spark_rapids_tpu.session import TpuSession, col, lit, sum_
+
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    df = s.create_dataframe(
+        {"k": [1, 2, 1, 2] * 50, "v": list(range(200))},
+        T.StructType([T.StructField("k", T.INT),
+                      T.StructField("v", T.LONG)]))
+    q = df.filter(col("v") > lit(5)).group_by("k").agg(sum_("v", "sv"))
+    q.collect()
+    rep = q.metrics_report()
+    assert "numOutputRows" in rep and "opTime" in rep
+    assert "TpuHashAggregate" in rep
