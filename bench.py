@@ -286,12 +286,43 @@ def cpu_qc_vectorized(ss):
 # harness
 # ===========================================================================
 
-def _time_repeats(fn, repeats):
-    fn()  # warm / compile
+def _time_repeats(fn, repeats, counters=False):
+    """Time fn (excluding the first, compile, run).  With counters=True the
+    third return value holds tunnel-independent per-run perf counters
+    (programs launched / compiles / host syncs / bytes moved — VERDICT r3
+    Next #1a) averaged over the timed repeats."""
+    from spark_rapids_tpu import perfcounters as PC
+
+    # warm until a run triggers no fresh XLA compile (max 3): the engine
+    # switches strategy after run 1 (e.g. the join's unique-build fast path
+    # compiles on run 2), and a tunnel compile landing inside the timed
+    # repeats would report minutes of compile as if it were execution
+    for _ in range(3):
+        pre = PC.COUNTERS["compiles"]
+        fn()
+        if PC.COUNTERS["compiles"] == pre:
+            break
+    snap = None
+    if counters:
+        snap = PC.snapshot()
     t0 = time.perf_counter()
     for _ in range(repeats):
         out = fn()
-    return (time.perf_counter() - t0) / repeats, out
+    dt = (time.perf_counter() - t0) / repeats
+    if not counters:
+        return dt, out
+    from spark_rapids_tpu import perfcounters as PC
+
+    d = PC.since(snap)
+    per_run = {
+        "nProgramsLaunched": d["programs_launched"] / repeats,
+        "nCompiles": d["compiles"] / repeats,
+        "nHostSyncs": d["host_syncs"] / repeats,
+        "bytesD2H": d["bytes_d2h"] / repeats,
+        "bytesH2D": d["bytes_h2d"] / repeats,
+        "launchWall_s": d["launch_wall_ns"] / repeats / 1e9,
+    }
+    return dt, out, per_run
 
 
 def _session(enabled: bool, cache_batches: bool = False):
@@ -308,6 +339,14 @@ def _bytes_of(*col_dicts):
 
 
 def main():
+    # BENCH_PLATFORM=cpu runs the suite on the XLA CPU backend (fast
+    # correctness smoke; the container sitecustomize pre-imports jax on the
+    # axon TPU platform, so only config.update can redirect it)
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     n = int(os.environ.get("BENCH_ROWS", 2_000_000))
     repeats = int(os.environ.get("BENCH_REPEATS", 2))
     budget = float(os.environ.get("BENCH_TIME_BUDGET", 2400))
@@ -324,9 +363,25 @@ def main():
 
     signal.signal(signal.SIGTERM, _term)
     signal.signal(signal.SIGINT, _term)
-    # NOTE: no JAX_COMPILATION_CACHE_DIR here on purpose — the axon
-    # remote-compile relay crashed (SIGSEGV / truncated responses) when
-    # the persistent cache rerouted compiles through its AOT path.
+    # Persistent XLA compile cache (VERDICT r3 Next #1b).  Default ON with
+    # a repo-local dir; opt out with BENCH_COMPILE_CACHE=0.  Round 3 saw
+    # the axon remote-compile relay SIGSEGV with the cache's AOT path;
+    # re-validated round 4 on this relay: a full 6-variant run on the real
+    # chip completed rc=0 with the cache writing and re-reading entries, so
+    # it now defaults on (the knob remains as the escape hatch).
+    cache_dir = os.environ.get("BENCH_COMPILE_CACHE",
+                               os.path.join(os.path.dirname(
+                                   os.path.abspath(__file__)),
+                                   ".jax_compile_cache"))
+    if cache_dir and cache_dir != "0":
+        import jax
+
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.5)
+        except Exception:
+            pass
     queries = {}
 
     emitted = {"done": False}
@@ -398,9 +453,11 @@ def main():
         t_oracle, oracle_rows = _time_repeats(oracle_df.collect, repeats)
 
         tpu_hot_df = build_q6(_session(True, cache_batches=True), li)
-        t_hot, tpu_rows = _time_repeats(tpu_hot_df.collect, repeats)
+        t_hot, tpu_rows, ctr_hot = _time_repeats(tpu_hot_df.collect, repeats,
+                                                 counters=True)
         tpu_scan_df = build_q6(_session(True, cache_batches=False), li)
-        t_scan, _ = _time_repeats(tpu_scan_df.collect, repeats)
+        t_scan, _, ctr_scan = _time_repeats(tpu_scan_df.collect, repeats,
+                                            counters=True)
 
         assert int(tpu_rows[0][0].scaleb(4)) == vec_res, \
             f"Q6 mismatch: tpu {tpu_rows[0][0]} vs vectorized {vec_res}"
@@ -409,11 +466,11 @@ def main():
         queries["q6_hot"] = dict(
             tpu_s=t_hot, cpu_vec_s=t_vec, cpu_oracle_s=t_oracle,
             rows_per_s=n / t_hot, eff_gbps=q6_bytes / t_hot / 1e9,
-            vs_vec=t_vec / t_hot, vs_oracle=t_oracle / t_hot)
+            vs_vec=t_vec / t_hot, vs_oracle=t_oracle / t_hot, **ctr_hot)
         queries["q6_scan"] = dict(
             tpu_s=t_scan, cpu_vec_s=t_vec, cpu_oracle_s=t_oracle,
             rows_per_s=n / t_scan, eff_gbps=q6_bytes / t_scan / 1e9,
-            vs_vec=t_vec / t_scan, vs_oracle=t_oracle / t_scan)
+            vs_vec=t_vec / t_scan, vs_oracle=t_oracle / t_scan, **ctr_scan)
     except TimeoutError:
         skipped.extend(["q6"] + _ALL)
         progress("terminated during rung 1; emitting partial results")
@@ -439,13 +496,17 @@ def main():
         modes = [("hot", True)] + ([("scan", False)] if scan_mode else [])
         for mode, cache in modes:
             df = build(_session(True, cache_batches=cache), *args)
-            t_tpu, rows = _time_repeats(df.collect, repeats)
+            t_tpu, rows, ctr = _time_repeats(df.collect, repeats,
+                                             counters=True)
             check(rows, vec_res)
-            progress(f"{name}_{mode}: tpu {t_tpu:.2f}s")
+            progress(f"{name}_{mode}: tpu {t_tpu:.2f}s "
+                     f"(programs={ctr['nProgramsLaunched']:.0f} "
+                     f"syncs={ctr['nHostSyncs']:.0f} "
+                     f"d2h={ctr['bytesD2H'] / 1e6:.1f}MB)")
             queries[f"{name}_{mode}"] = dict(
                 tpu_s=t_tpu, cpu_vec_s=t_vec, cpu_oracle_s=t_oracle,
                 rows_per_s=n / t_tpu, eff_gbps=bytes_ / t_tpu / 1e9,
-                vs_vec=t_vec / t_tpu, vs_oracle=t_oracle / t_tpu)
+                vs_vec=t_vec / t_tpu, vs_oracle=t_oracle / t_tpu, **ctr)
 
     def check_qa(rows, want):
         got = {(int(r[0]), int(r[1])): int(r[2].scaleb(2)) for r in rows}

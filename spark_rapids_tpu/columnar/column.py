@@ -179,6 +179,9 @@ class DeviceColumn:
     def from_host(h: "HostColumn", capacity: Optional[int] = None,
                   width_buckets: Sequence[int] = DEFAULT_WIDTH_BUCKETS,
                   row_buckets: Sequence[int] = DEFAULT_ROW_BUCKETS) -> "DeviceColumn":
+        from spark_rapids_tpu.perfcounters import count_h2d
+
+        count_h2d(h.nbytes())
         n = h.num_rows
         cap = capacity or round_up_bucket(max(n, 1), row_buckets)
         validity = np.zeros(cap, dtype=np.bool_)
@@ -344,6 +347,15 @@ class HostColumn:
     lengths: Optional[np.ndarray] = None   # (n,) int32
     elem_valid: Optional[np.ndarray] = None  # (n, ewidth) bool (arrays)
     children: Optional[List["HostColumn"]] = None  # structs
+
+    def nbytes(self) -> int:
+        n = self.validity.nbytes
+        for buf in (self.data, self.chars, self.lengths, self.elem_valid):
+            if buf is not None:
+                n += buf.nbytes
+        if self.children is not None:
+            n += sum(c.nbytes() for c in self.children)
+        return int(n)
 
     @property
     def is_string(self) -> bool:

@@ -324,6 +324,31 @@ SINGLE_DEVICE_SHUFFLE_COALESCE = conf(
     "aggregation/join results are partition-count independent)."
 ).boolean_conf(True)
 
+COMPLETE_AGG_COLLAPSE = conf(
+    "spark.rapids.tpu.completeAggCollapse.enabled").doc(
+    "When a two-phase aggregate's exchange runs on one device (mesh off "
+    "or a single chip), collapse Final<-Coalesce<-Exchange<-Partial into "
+    "ONE COMPLETE-mode aggregate: a single-batch input then aggregates and "
+    "finalizes in one XLA program instead of three (the single-device "
+    "analog of AQE's exchange elision — each saved launch is a saved host "
+    "round trip).").boolean_conf(True)
+
+JOIN_AGG_FUSION = conf("spark.rapids.tpu.joinAggFusion.enabled").doc(
+    "Compile an aggregate sitting directly on an equi-join INTO the join's "
+    "materialization program (and, when the build side's keys are unique — "
+    "the dim-table case — run probe+gather+aggregate as ONE program with "
+    "no pair-count host sync).  Each saved launch is a saved host round "
+    "trip; joined rows feeding an aggregate never round-trip through HBM."
+).boolean_conf(True)
+
+WINDOW_CHAIN_FUSION = conf(
+    "spark.rapids.tpu.windowChainFusion.enabled").doc(
+    "Compile [COMPLETE aggregate ->] window [-> project/filter] chains "
+    "into ONE XLA program (the window function already runs as a single "
+    "jitted scan program; a grouped aggregate below and a stage above "
+    "compose with it via device-scalar row counts — no host sync between "
+    "operators).").boolean_conf(True)
+
 MESH_DEVICES = conf("spark.rapids.tpu.mesh.devices").doc(
     "Number of mesh devices for ICI stages (0 = all visible devices).  "
     "Non-power-of-2 counts are supported; capacities pad to multiples of "

@@ -20,6 +20,7 @@ from __future__ import annotations
 from typing import Iterator, List, Optional
 
 import jax
+from spark_rapids_tpu.perfcounters import tpu_jit
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
@@ -155,6 +156,9 @@ class TpuHashAggregateExec(TpuExec):
         from spark_rapids_tpu.memory.spill import get_spill_framework
 
         fw = get_spill_framework()
+        if self.mode == AggregateMode.COMPLETE:
+            yield from self._execute_complete(fw)
+            return
         spillables = []
         any_input = False
         for b in self.children[0].execute_columnar():
@@ -189,6 +193,123 @@ class TpuHashAggregateExec(TpuExec):
             return self.child_schema
         return self._output  # PARTIAL output is the buffer form
 
+    # -- COMPLETE mode --------------------------------------------------
+    def _complete_twins(self):
+        """PARTIAL/FINAL twin execs for multi-batch COMPLETE execution.
+
+        A COMPLETE aggregate cannot merge its own finalized outputs
+        (avg/variance would average averages), so when more than one input
+        batch arrives the work routes through a PARTIAL twin (buffer form
+        per batch), buffer-form merges, and one FINAL finalize — exactly
+        the two-phase plan, minus the exchange."""
+        cached = getattr(self, "_twin_cache", None)
+        if cached is not None:
+            return cached
+        from spark_rapids_tpu.expr.base import AttributeReference
+        from spark_rapids_tpu.plan.nodes import partial_buffer_schema
+
+        buf_schema = partial_buffer_schema(self.grouping, self.aggregates)
+        p = TpuHashAggregateExec(self.grouping, self.aggregates,
+                                 AggregateMode.PARTIAL, self.children[0],
+                                 self.child_schema, buf_schema, self.ansi)
+        p.pre_ops = self.pre_ops
+        p.input_schema = self.input_schema
+        fkeys = [AttributeReference(g.name).resolve(buf_schema)
+                 for g in self.grouping]
+        faggs = [AggregateExpression(a.func, a.child, a.result_name,
+                                     a.result_type, child2=a.child2,
+                                     args=a.args)
+                 for a in self.aggregates]
+        f = TpuHashAggregateExec(fkeys, faggs, AggregateMode.FINAL,
+                                 self.children[0], buf_schema, self._output,
+                                 self.ansi)
+        self._twin_cache = (p, f)
+        return self._twin_cache
+
+    def _execute_complete(self, fw) -> Iterator[ColumnarBatch]:
+        """COMPLETE: one input batch -> ONE fused program (aggregate +
+        finalize); multiple batches -> two-phase via twins."""
+        from spark_rapids_tpu.memory.retry import (
+            with_retry,
+            with_retry_no_split,
+        )
+
+        it = self.children[0].execute_columnar()
+        first = next(it, None)
+        if first is None:
+            from spark_rapids_tpu.columnar.batch import empty_batch
+
+            if not self.grouping:
+                yield self._global_agg_empty()
+            else:
+                yield empty_batch(self._output)
+            return
+        second = next(it, None)
+        if second is None:
+            from spark_rapids_tpu.memory.retry import TpuSplitAndRetryOOM
+
+            s = fw.track(first)
+            try:
+                with self.metrics["opTime"].timed():
+                    s.pin()
+                    try:
+                        out = with_retry_no_split(
+                            lambda: self._aggregate_batch(s.get_batch()))
+                    finally:
+                        s.unpin()
+            except TpuSplitAndRetryOOM:
+                # the fused single program cannot split; the two-phase
+                # twins can (PARTIAL buffers merge correctly over pieces)
+                yield from self._complete_two_phase(iter(()), fw, [s])
+                return
+            except BaseException:
+                s.close()
+                raise
+            s.close()
+            yield self._count_output(out)
+            return
+
+        def feed():
+            yield first
+            yield second
+            yield from it
+
+        yield from self._complete_two_phase(feed(), fw, [])
+
+    def _complete_two_phase(self, batches, fw,
+                            tracked) -> Iterator[ColumnarBatch]:
+        """Multi-batch (or split-forced) COMPLETE: PARTIAL per batch ->
+        buffer merges -> one FINAL finalize."""
+        from spark_rapids_tpu.memory.retry import (
+            with_retry,
+            with_retry_no_split,
+        )
+
+        partial, final = self._complete_twins()
+        spillables = []
+        for s in tracked:
+            with self.metrics["opTime"].timed():
+                for out in with_retry(s, partial._aggregate_batch):
+                    spillables.append(fw.track(out))
+        for b in batches:
+            with self.metrics["opTime"].timed():
+                for out in with_retry(fw.track(b), partial._aggregate_batch):
+                    spillables.append(fw.track(out))
+        with self.metrics["opTime"].timed():
+            while len(spillables) > 1:
+                a, b2 = spillables.pop(0), spillables.pop(0)
+                merged = with_retry_no_split(lambda: final._merge_pair(a, b2))
+                spillables.append(fw.track(merged))
+            last = spillables[0]
+            last.pin()
+            try:
+                buf = last.get_batch()
+            finally:
+                last.unpin()
+            last.close()
+            out = final._finalize(buf)
+        yield self._count_output(out)
+
     def _preagg_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
         """One input batch -> buffer-form partial result."""
         if self.mode == AggregateMode.FINAL:
@@ -197,16 +318,20 @@ class TpuHashAggregateExec(TpuExec):
         return self._aggregate_batch(batch)
 
     def _merge_pair(self, a, b) -> ColumnarBatch:
+        # inputs close only AFTER the merge succeeds: callers run this under
+        # with_retry_no_split, whose contract requires the block to be
+        # re-runnable — closing first would hand a retry freed buffers
         a.pin()
         b.pin()
         try:
             cat = ColumnarBatch.concat([a.get_batch(), b.get_batch()])
+            out = self._merge_batch(cat)
         finally:
             a.unpin()
             b.unpin()
         a.close()
         b.close()
-        return self._merge_batch(cat)
+        return out
 
     def _merge_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
         """Re-aggregate buffer-form rows with per-agg merge functions."""
@@ -215,7 +340,7 @@ class TpuHashAggregateExec(TpuExec):
         if cache is None:
             cache = self._merge_jits = {}
         if key not in cache:
-            cache[key] = jax.jit(self._merge_fn)
+            cache[key] = tpu_jit(self._merge_fn)
         cols, nrows = cache[key](tuple(batch.columns),
                                  jnp.int32(batch.num_rows))
         # global aggregates have a statically known single output row —
@@ -432,7 +557,7 @@ class TpuHashAggregateExec(TpuExec):
             )
 
             if getattr(self, "_maxgrp_jit", None) is None:
-                self._maxgrp_jit = jax.jit(self._max_group_rows_fn)
+                self._maxgrp_jit = tpu_jit(self._max_group_rows_fn)
             mx = int(self._maxgrp_jit(tuple(batch.columns),
                                       jnp.int32(batch.num_rows)))
             self._collect_ewidth = round_up_bucket(
@@ -441,11 +566,11 @@ class TpuHashAggregateExec(TpuExec):
             if cache is None:
                 cache = self._collect_jits = {}
             if self._collect_ewidth not in cache:
-                cache[self._collect_ewidth] = jax.jit(self._agg_fn)
+                cache[self._collect_ewidth] = tpu_jit(self._agg_fn)
             jitted = cache[self._collect_ewidth]
         else:
             if getattr(self, "_jitted", None) is None:
-                self._jitted = jax.jit(self._agg_fn)
+                self._jitted = tpu_jit(self._agg_fn)
             jitted = self._jitted
         cols, nrows = jitted(tuple(batch.columns),
                              jnp.int32(batch.num_rows))

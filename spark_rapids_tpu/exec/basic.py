@@ -18,6 +18,7 @@ from __future__ import annotations
 from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
 import jax
+from spark_rapids_tpu.perfcounters import tpu_jit
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
@@ -200,7 +201,7 @@ class TpuStageExec(TpuExec):
         if self._has_host_kernels():
             jitted = fn
         else:
-            jitted = jax.jit(fn)
+            jitted = tpu_jit(fn)
 
         def run(batch: ColumnarBatch) -> ColumnarBatch:
             cols, count, flags = jitted(

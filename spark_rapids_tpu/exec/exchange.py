@@ -17,6 +17,7 @@ from __future__ import annotations
 from typing import Iterator, List
 
 import jax
+from spark_rapids_tpu.perfcounters import tpu_jit
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
@@ -92,7 +93,7 @@ class TpuShuffleExchangeExec(TpuExec):
             return tuple(sorted_cols), bounds
 
         if getattr(self, "_sort_jit", None) is None:
-            self._sort_jit = jax.jit(sort_fn)
+            self._sort_jit = tpu_jit(sort_fn)
         cols, bounds = self._sort_jit(tuple(batch.columns), ids,
                                       jnp.int32(batch.num_rows))
         import numpy as _np
@@ -118,7 +119,7 @@ class TpuShuffleExchangeExec(TpuExec):
             return spark_partition_ids(key_cols, self.num_partitions)
 
         if getattr(self, "_ids_jit", None) is None:
-            self._ids_jit = jax.jit(fn)
+            self._ids_jit = tpu_jit(fn)
         return self._ids_jit(tuple(batch.columns), jnp.int32(batch.num_rows))
 
     def _range_ids(self, batch: ColumnarBatch):
@@ -146,7 +147,7 @@ class TpuShuffleExchangeExec(TpuExec):
             return jnp.clip(inv // per, 0, self.num_partitions - 1)
 
         if getattr(self, "_range_jit", None) is None:
-            self._range_jit = jax.jit(fn)
+            self._range_jit = tpu_jit(fn)
         return self._range_jit(tuple(batch.columns), jnp.int32(batch.num_rows))
 
     def execute_columnar(self) -> Iterator[ColumnarBatch]:

@@ -1,0 +1,464 @@
+"""Join->Aggregate whole-stage fusion — the program-count killer.
+
+Reference analog: none directly — the reference streams gather-map chunks
+from GpuShuffledHashJoinExec into GpuHashAggregateExec as separate kernels
+(SURVEY.md §2.4 Joins / hash aggregate); on a PCIe-local GPU the launch
+boundary is ~10µs so fusing across it buys little.  On TPU every program
+launch is a host round trip (hundreds of ms through a tunnel relay), so an
+aggregate directly above an equi-join is compiled INTO the join's
+materialization program:
+
+  * general path: [build] [probe: lo/counts/sizes] -> ONE host sync for the
+    pair count -> [materialize+aggregate fused].  3 programs, 1 sync.
+  * unique-build fast path: when the build side's keys are unique (the
+    star-schema dim-table case — learned from the first probe's size sync
+    and cached on the exec), pairs == matched probe rows, so the output
+    capacity is the probe capacity: probe search, build gather, and the
+    whole aggregation run in ONE program with NO size sync.  The unmatched
+    probe rows of a LEFT join stay in place with null build columns; an
+    INNER join masks them out via the aggregate's row-validity mask —
+    filtered rows never move (no compaction scatter at all).
+
+Falls back to the unfused pair (agg over join output) when the build side
+exceeds the sub-partition threshold (out-of-core joins keep their own
+machinery) — correctness is identical either way.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import (
+    DEFAULT_ROW_BUCKETS,
+    DeviceColumn,
+    round_up_bucket,
+)
+from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.exec.join import (
+    _BaseTpuJoinExec,
+    _key_words_of,
+    _multiword_searchsorted,
+    _SortedBuildSide,
+)
+from spark_rapids_tpu.expr.base import EvalContext
+from spark_rapids_tpu.perfcounters import sync_get, tpu_jit
+from spark_rapids_tpu.plan.nodes import AggregateMode, JoinType
+
+
+def _mask_col(c: DeviceColumn, keep) -> DeviceColumn:
+    """AND a row mask into a column's validity (recursing into structs)."""
+    if c.is_struct:
+        return DeviceColumn(c.dtype, c.validity & keep,
+                            children=tuple(_mask_col(k, keep)
+                                           for k in c.children))
+    return DeviceColumn(c.dtype, c.validity & keep, data=c.data,
+                        chars=c.chars, lengths=c.lengths,
+                        elem_valid=c.elem_valid)
+
+
+class TpuJoinAggFusedExec(TpuExec):
+    """agg(join(probe, build)) in (at most) three XLA programs."""
+
+    def __init__(self, agg, join: _BaseTpuJoinExec):
+        super().__init__(list(join.children))
+        self.agg = agg
+        self.join = join
+        self._jit_cache = {}
+        # None = unknown; True/False learned from the first size sync and
+        # reused across collects of the same plan (device-cached scans make
+        # repeat execution the hot path)
+        self._build_unique: Optional[bool] = None
+
+    @property
+    def output(self):
+        return self.agg.output
+
+    def describe(self):
+        return (f"TpuJoinAggFused[{self.agg.describe()} <- "
+                f"{self.join.describe()}]")
+
+    def _cached(self, key, builder):
+        if key not in self._jit_cache:
+            self._jit_cache[key] = tpu_jit(builder)
+        return self._jit_cache[key]
+
+    # ------------------------------------------------------------------
+    def _fallback(self) -> Iterator[ColumnarBatch]:
+        # the agg's child is still the join exec — the unfused pipeline
+        yield from self.agg.execute_columnar()
+
+    def _build_source(self):
+        """(exec to drive, stage ops to fuse into the build program, input
+        schema) — absorbs BroadcastExchange(Stage(x)) into the build."""
+        from spark_rapids_tpu.exec.basic import TpuStageExec
+        from spark_rapids_tpu.exec.exchange import TpuBroadcastExchangeExec
+
+        child = self.join._build_child()
+        if isinstance(child, TpuBroadcastExchangeExec):
+            inner = child.children[0]
+            if (isinstance(inner, TpuStageExec) and not inner.ansi
+                    and not inner._has_host_kernels()):
+                return inner.children[0], inner.ops, inner.children[0].output
+        return child, None, None
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.memory.spill import get_spill_framework
+
+        join = self.join
+        # later plan passes rewrite self.children in place; the join exec
+        # must execute the rewritten subtrees, not its stale private copy
+        join.children = list(self.children)
+        fw = get_spill_framework()
+        # broadcast-side stage absorption: drive the stage's CHILD and fuse
+        # the project/filter ops into the build-sort program
+        build_src, pre_ops, pre_schema = self._build_source()
+        build_spill = []
+        total_build_bytes = 0
+        try:
+            for b in build_src.execute_columnar():
+                total_build_bytes += b.nbytes()
+                build_spill.append(fw.track(b))
+        except BaseException:
+            for s in build_spill:
+                s.close()
+            raise
+        if total_build_bytes > join.sub_partition_bytes:
+            for s in build_spill:
+                s.close()
+            # out-of-core join path owns this size class; re-drive the
+            # build child (scans re-stream; device cache makes it cheap)
+            yield from self._fallback()
+            return
+        for s in build_spill:
+            s.pin()
+        try:
+            build_batch = join._concat_or_empty(
+                [s.get_batch() for s in build_spill],
+                pre_schema if pre_schema is not None
+                else join._build_child().output)
+        finally:
+            for s in build_spill:
+                s.unpin()
+                s.close()
+        with join.metric("buildTime").timed():
+            build = join._prepare_build(build_batch, join.right_keys,
+                                        pre_ops=pre_ops,
+                                        in_schema=pre_schema)
+
+        probe_it = join._probe_child().execute_columnar()
+        first = next(probe_it, None)
+        if first is None:
+            from spark_rapids_tpu.columnar.batch import empty_batch
+
+            if not self.agg.grouping:
+                yield self.agg._global_agg_empty()
+            else:
+                yield empty_batch(self.agg._output)
+            return
+        from spark_rapids_tpu.memory.retry import (
+            TpuSplitAndRetryOOM,
+            with_retry,
+            with_retry_no_split,
+        )
+
+        if self.agg.mode == AggregateMode.PARTIAL:
+            # buffer-form output per probe batch; the surviving FINAL agg
+            # above merges them (finalizing here would feed it avg-of-avgs)
+            def feed_all():
+                yield first
+                yield from probe_it
+
+            for probe in feed_all():
+                with self.metrics["opTime"].timed():
+                    for out in with_retry(
+                            fw.track(probe),
+                            lambda piece: self._probe_agg_one(
+                                build, piece, self.agg)):
+                        yield self._count_output(out)
+            return
+
+        second = next(probe_it, None)
+        if second is None:
+            try:
+                with self.metrics["opTime"].timed():
+                    out = with_retry_no_split(
+                        lambda: self._probe_agg_one(build, first, self.agg))
+                yield self._count_output(out)
+                return
+            except TpuSplitAndRetryOOM:
+                # split the probe batch and continue on the two-phase path
+                pass
+
+        # multi-batch probe (or split-forced): per-batch PARTIAL buffers,
+        # buffer merges, one FINAL finalize (the agg's COMPLETE twins)
+
+        partial, final = self.agg._complete_twins()
+        spillables = []
+
+        def feed():
+            yield first
+            if second is not None:
+                yield second
+            yield from probe_it
+
+        for probe in feed():
+            with self.metrics["opTime"].timed():
+                for out in with_retry(
+                        fw.track(probe),
+                        lambda piece: self._probe_agg_one(build, piece,
+                                                          partial)):
+                    spillables.append(fw.track(out))
+        with self.metrics["opTime"].timed():
+            while len(spillables) > 1:
+                a, b2 = spillables.pop(0), spillables.pop(0)
+                merged = with_retry_no_split(
+                    lambda: final._merge_pair(a, b2))
+                spillables.append(fw.track(merged))
+            last = spillables[0]
+            last.pin()
+            try:
+                buf = last.get_batch()
+            finally:
+                last.unpin()
+            last.close()
+            out = final._finalize(buf)
+        yield self._count_output(out)
+
+    # ------------------------------------------------------------------
+    def _probe_agg_one(self, build: _SortedBuildSide, probe: ColumnarBatch,
+                       agg) -> ColumnarBatch:
+        if self._build_unique:
+            return self._unique_probe_agg(build, probe, agg)
+        lo, counts, unmatched, sizes = self._probe_sizes(build, probe)
+        total, n_um, has_dup = (int(x) for x in sync_get(sizes))
+        if self._build_unique is None:
+            self._build_unique = has_dup == 0
+        return self._mat_agg(build, probe, lo, counts, unmatched,
+                             total, n_um, agg)
+
+    def _probe_sizes(self, build: _SortedBuildSide, probe: ColumnarBatch):
+        """Probe program: lo/counts plus ONE packed sizes vector
+        [total_pairs, n_unmatched, build_has_dup] so sizing costs a single
+        host round trip."""
+        join = self.join
+        schema = probe.schema
+
+        def fn(bwords, n_valid, cols, num_rows):
+            b = ColumnarBatch(list(cols), num_rows, schema)
+            ctx = EvalContext(b, ansi=join.ansi)
+            key_cols = [k.eval_tpu(ctx) for k in join.left_keys]
+            valid = b.row_mask
+            for kc in key_cols:
+                valid = valid & kc.validity
+            qwords = _key_words_of(key_cols)
+            lo = _multiword_searchsorted(list(bwords), n_valid, qwords,
+                                         "left")
+            hi = _multiword_searchsorted(list(bwords), n_valid, qwords,
+                                         "right")
+            counts = jnp.where(valid, hi - lo, 0)
+            total = jnp.sum(counts.astype(jnp.int64))
+            unmatched = b.row_mask & (counts == 0)
+            n_um = jnp.sum(unmatched.astype(jnp.int64))
+            # build-key uniqueness: any adjacent equal pair among the first
+            # n_valid sorted keys
+            cap_b = bwords[0].shape[0]
+            idx = jnp.arange(cap_b - 1)
+            adj_eq = jnp.ones(cap_b - 1, jnp.bool_)
+            for w in bwords:
+                adj_eq = adj_eq & (w[:-1] == w[1:])
+            in_valid = (idx + 1) < n_valid
+            has_dup = jnp.any(adj_eq & in_valid).astype(jnp.int64)
+            sizes = jnp.stack([total, n_um, has_dup])
+            return lo, counts, unmatched, sizes
+
+        jitted = self._cached("probe_sizes", fn)
+        return jitted(tuple(build.words), build.n_valid,
+                      tuple(probe.columns), jnp.int32(probe.num_rows))
+
+    # ------------------------------------------------------------------
+    def _finish(self, agg, cols, nrows) -> ColumnarBatch:
+        n = 1 if not agg.grouping else int(nrows)
+        return ColumnarBatch(list(cols), n, agg._output)
+
+    def _mat_agg(self, build, probe, lo, counts, unmatched, total: int,
+                 n_um: int, agg) -> ColumnarBatch:
+        """General path: materialize pairs + aggregate in ONE program."""
+        join = self.join
+        with_um = join.join_type == JoinType.LEFT_OUTER
+        out_rows = total + (n_um if with_um else 0)
+        out_cap = round_up_bucket(max(out_rows, 1), DEFAULT_ROW_BUCKETS)
+
+        def fn(row_index, b_cols, p_cols, lo, counts, unmatched, total,
+               nrows):
+            lcols, bcols = _BaseTpuJoinExec.materialize_pairs(
+                row_index, b_cols, p_cols, lo, counts, unmatched, total,
+                nrows, out_cap, with_um)
+            joined = tuple(list(lcols) + list(bcols))
+            return agg._agg_fn(joined, nrows.astype(jnp.int32))
+
+        jitted = self._cached(("mat_agg", out_cap, with_um, id(agg)), fn)
+        cols, nrows = jitted(build.row_index, tuple(build.batch.columns),
+                             tuple(probe.columns), lo, counts, unmatched,
+                             jnp.int64(total), jnp.int64(out_rows))
+        return self._finish(agg, cols, nrows)
+
+    def _unique_probe_agg(self, build, probe, agg) -> ColumnarBatch:
+        """Unique-build fast path: probe search + build gather + aggregate
+        in ONE program; no size sync (output capacity == probe capacity)."""
+        join = self.join
+        left_outer = join.join_type == JoinType.LEFT_OUTER
+        schema = probe.schema
+
+        def fn(bwords, row_index, n_valid, b_cols, p_cols, num_rows):
+            b = ColumnarBatch(list(p_cols), num_rows, schema)
+            ctx = EvalContext(b, ansi=join.ansi)
+            key_cols = [k.eval_tpu(ctx) for k in join.left_keys]
+            valid = b.row_mask
+            for kc in key_cols:
+                valid = valid & kc.validity
+            qwords = _key_words_of(key_cols)
+            lo = _multiword_searchsorted(list(bwords), n_valid, qwords,
+                                         "left")
+            cap_b = bwords[0].shape[0]
+            loc = jnp.clip(lo, 0, cap_b - 1)
+            eq = jnp.ones(lo.shape, jnp.bool_)
+            for w, q in zip(bwords, qwords):
+                eq = eq & (w[loc] == q)
+            found = valid & (lo < n_valid) & eq
+            brow = jnp.where(found, row_index[loc], 0)
+            bcols = [_mask_col(c.gather(brow), found) for c in b_cols]
+            joined = tuple(list(p_cols) + bcols)
+            row_valid = b.row_mask if left_outer else (b.row_mask & found)
+            return agg._agg_fn(joined, num_rows, row_valid=row_valid)
+
+        jitted = self._cached(("uniq_agg", id(agg)), fn)
+        cols, nrows = jitted(tuple(build.words), build.row_index,
+                             build.n_valid, tuple(build.batch.columns),
+                             tuple(probe.columns),
+                             jnp.int32(probe.num_rows))
+        return self._finish(agg, cols, nrows)
+
+
+class TpuWindowChainFusedExec(TpuExec):
+    """[COMPLETE agg ->] window [-> project/filter stage] as ONE program.
+
+    The window already runs in a single jitted function of
+    (columns, num_rows-scalar); a grouped aggregate feeding it produces
+    (columns, ngroups-scalar) — so the whole chain composes into one XLA
+    program with zero host syncs between operators.  Only the final row
+    count syncs (to label the output batch).  The reference runs these as
+    three separate stages with exchange boundaries (SURVEY.md §2.4 Window).
+    """
+
+    def __init__(self, window, pre_agg=None, post_ops=None,
+                 post_schema=None):
+        child = pre_agg.children[0] if pre_agg is not None \
+            else window.children[0]
+        super().__init__([child])
+        self.window = window
+        self.pre_agg = pre_agg
+        self.post_ops = list(post_ops or [])
+        self._post_schema = post_schema
+        self._jit_cache = {}
+
+    @property
+    def output(self):
+        return self._post_schema if self._post_schema is not None \
+            else self.window.output
+
+    def describe(self):
+        parts = []
+        if self.pre_agg is not None:
+            parts.append(self.pre_agg.describe())
+        parts.append(self.window.describe())
+        if self.post_ops:
+            parts.append("+".join(type(o).__name__.replace("Op", "")
+                                  for o in self.post_ops))
+        return "TpuWindowChainFused[" + " -> ".join(parts) + "]"
+
+    def _cached(self, key, builder):
+        if key not in self._jit_cache:
+            self._jit_cache[key] = tpu_jit(builder)
+        return self._jit_cache[key]
+
+    def _chain_fn(self, with_agg: bool):
+        window = self.window
+        pre_agg = self.pre_agg if with_agg else None
+        post_ops = self.post_ops
+
+        def fn(cols, num_rows):
+            if pre_agg is not None:
+                cols, num_rows = pre_agg._agg_fn(cols, num_rows)
+                num_rows = num_rows.astype(jnp.int32)
+            wcols = window._window_fn(tuple(cols), num_rows)
+            batch = ColumnarBatch(list(wcols), num_rows, window.output)
+            if post_ops:
+                ctx = EvalContext(batch, ansi=False)
+                for op in post_ops:
+                    batch = op.apply(ctx, batch)
+            return tuple(batch.columns), jnp.asarray(batch.num_rows)
+
+        return fn
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.memory.retry import (
+            TpuSplitAndRetryOOM,
+            with_retry_no_split,
+        )
+        from spark_rapids_tpu.memory.spill import get_spill_framework
+
+        # keep the owned execs pointing at the (possibly rewritten) child
+        owner = self.pre_agg if self.pre_agg is not None else self.window
+        owner.children = list(self.children)
+
+        def run(b, with_agg):
+            jitted = self._cached(("chain", with_agg, b.capacity),
+                                  self._chain_fn(with_agg))
+            cols, count = jitted(tuple(b.columns), jnp.int32(b.num_rows))
+            return ColumnarBatch(list(cols), int(count), self.output)
+
+        fw = get_spill_framework()
+        batches = list(self.children[0].execute_columnar())
+        if not batches:
+            if self.pre_agg is None:
+                return
+            # aggregate-of-empty semantics, then window[+stage] over it
+            from spark_rapids_tpu.columnar.batch import empty_batch
+
+            if not self.pre_agg.grouping:
+                b = self.pre_agg._global_agg_empty()
+            else:
+                b = empty_batch(self.pre_agg._output)
+            with self.metrics["opTime"].timed():
+                out = with_retry_no_split(lambda: run(b, False))
+            yield self._count_output(out)
+            return
+
+        def agg_then_window(batch_list):
+            """Aggregate the already-materialized batches through the
+            two-phase twins (no re-execution of the child subtree), then
+            window the grouped result."""
+            agg_out = list(self.pre_agg._complete_two_phase(
+                iter(batch_list), fw, []))
+            b = (agg_out[0] if len(agg_out) == 1
+                 else ColumnarBatch.concat(agg_out))
+            return with_retry_no_split(lambda: run(b, False))
+
+        run_agg = self.pre_agg is not None
+        with self.metrics["opTime"].timed():
+            if run_agg and len(batches) > 1:
+                out = agg_then_window(batches)
+            else:
+                batch = (batches[0] if len(batches) == 1
+                         else ColumnarBatch.concat(batches))
+                try:
+                    out = with_retry_no_split(lambda: run(batch, run_agg))
+                except TpuSplitAndRetryOOM:
+                    if not run_agg:
+                        raise
+                    out = agg_then_window(batches)
+        yield self._count_output(out)

@@ -18,6 +18,7 @@ from __future__ import annotations
 from typing import Iterator, List, Optional
 
 import jax
+from spark_rapids_tpu.perfcounters import tpu_jit
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
@@ -72,7 +73,7 @@ class TpuGenerateExec(TpuExec):
             return eff, jnp.sum(eff.astype(jnp.int64))
 
         if "counts" not in self._jits:
-            self._jits["counts"] = jax.jit(fn)
+            self._jits["counts"] = tpu_jit(fn)
         return self._jits["counts"](tuple(batch.columns),
                                     jnp.int32(batch.num_rows))
 
@@ -125,7 +126,7 @@ class TpuGenerateExec(TpuExec):
 
         key = ("gen", out_cap)
         if key not in self._jits:
-            self._jits[key] = jax.jit(fn)
+            self._jits[key] = tpu_jit(fn)
         cols = self._jits[key](tuple(batch.columns), eff,
                                jnp.int32(batch.num_rows), jnp.int64(total))
         return ColumnarBatch(list(cols), total, self._output)
@@ -169,7 +170,7 @@ class TpuExpandExec(TpuExec):
         if self._jit is None:
             self._jit = {}
         if key not in self._jit:
-            self._jit[key] = (jax.jit(fn), msgs)
+            self._jit[key] = (tpu_jit(fn), msgs)
         jitted, msgs = self._jit[key]
         cols, flags = jitted(tuple(batch.columns),
                              jnp.int32(batch.num_rows))
@@ -207,7 +208,7 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
 
     def _cached(self, key, fn):
         if key not in self._jits:
-            self._jits[key] = jax.jit(fn)
+            self._jits[key] = tpu_jit(fn)
         return self._jits[key]
 
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
@@ -280,7 +281,7 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
         if key not in self._jits:
             # msgs list is captured by the traced fn and cached WITH the jit
             # so cache hits still know the flag messages
-            self._jits[key] = (jax.jit(match_fn), flag_msgs_store)
+            self._jits[key] = (tpu_jit(match_fn), flag_msgs_store)
         mf, flag_msgs = self._jits[key]
         lo, ro, ok, any_match, flags = mf(
             tuple(lb.columns), tuple(rbatch.columns),

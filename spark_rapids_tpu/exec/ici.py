@@ -33,6 +33,7 @@ from __future__ import annotations
 from typing import Iterator, List
 
 import jax
+from spark_rapids_tpu.perfcounters import tpu_jit
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -735,7 +736,7 @@ class TpuIciSortExec(TpuExec):
                 return tuple(pack_sort_keys(key_cols, specs,
                                             batch.row_mask))
 
-            self._key_fns[key] = jax.jit(fn)
+            self._key_fns[key] = tpu_jit(fn)
         return self._key_fns[key]
 
     def _sample_words(self, batch: ColumnarBatch):

@@ -21,6 +21,7 @@ from __future__ import annotations
 from typing import Iterator, List, Optional, Tuple
 
 import jax
+from spark_rapids_tpu.perfcounters import tpu_jit
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
@@ -131,7 +132,7 @@ class _BaseTpuJoinExec(TpuExec):
 
     def _cached_jit(self, key, builder, **jit_kw):
         if key not in self._jit_cache:
-            self._jit_cache[key] = jax.jit(builder, **jit_kw)
+            self._jit_cache[key] = tpu_jit(builder, **jit_kw)
         return self._jit_cache[key]
 
     @property
@@ -144,13 +145,25 @@ class _BaseTpuJoinExec(TpuExec):
         return f"{self.node_name} {self.join_type.value} [{keys}]"
 
     # -- build side -----------------------------------------------------
-    def _prepare_build(self, batch: ColumnarBatch,
-                       keys: List[Expression]) -> _SortedBuildSide:
+    def _prepare_build(self, batch: ColumnarBatch, keys: List[Expression],
+                       pre_ops=None, in_schema=None) -> _SortedBuildSide:
+        """Sort the build side by packed key words (ONE program).
+
+        ``pre_ops`` fuses a broadcast-side project/filter stage into this
+        program in selection-mask mode: filtered rows sort to the invalid
+        tail and are never probed — the stage costs no extra launch and no
+        compaction scatter."""
+        schema = in_schema or batch.schema
+
         def fn(cols, num_rows):
-            b = ColumnarBatch(list(cols), num_rows, batch.schema)
+            b = ColumnarBatch(list(cols), num_rows, schema)
             ctx = EvalContext(b, ansi=self.ansi)
+            mask = b.row_mask
+            for op in (pre_ops or []):
+                b, mask = op.apply_masked(ctx, b, mask)
+            ctx.batch = b
             key_cols = [k.eval_tpu(ctx) for k in key_cols_src]
-            valid = b.row_mask
+            valid = mask
             for kc in key_cols:
                 valid = valid & kc.validity
             words = _key_words_of(key_cols)
@@ -162,13 +175,22 @@ class _BaseTpuJoinExec(TpuExec):
             sorted_words = list(out[1:-1])
             row_index = out[-1]
             n_valid = jnp.sum(valid.astype(jnp.int32))
-            return sorted_words, row_index, n_valid
+            if pre_ops is None:
+                return sorted_words, row_index, n_valid
+            return sorted_words, row_index, n_valid, tuple(b.columns)
 
         key_cols_src = keys
-        jitted = self._cached_jit("build", fn)
-        words, row_index, n_valid = jitted(tuple(batch.columns),
-                                           jnp.int32(batch.num_rows))
-        return _SortedBuildSide(words, row_index, n_valid, batch)
+        if pre_ops is None:
+            jitted = self._cached_jit("build", fn)
+            words, row_index, n_valid = jitted(tuple(batch.columns),
+                                               jnp.int32(batch.num_rows))
+            return _SortedBuildSide(words, row_index, n_valid, batch)
+        jitted = self._cached_jit("build_preops", fn)
+        words, row_index, n_valid, bcols = jitted(
+            tuple(batch.columns), jnp.int32(batch.num_rows))
+        out_batch = ColumnarBatch(list(bcols), batch.num_rows,
+                                  self._build_child().output)
+        return _SortedBuildSide(words, row_index, n_valid, out_batch)
 
     # -- probe ----------------------------------------------------------
     def _probe_counts(self, build: _SortedBuildSide, batch: ColumnarBatch):
@@ -193,6 +215,44 @@ class _BaseTpuJoinExec(TpuExec):
                       tuple(batch.columns), jnp.int32(batch.num_rows))
 
     # -- materialization (gather maps -> output batch) -------------------
+    @staticmethod
+    def materialize_pairs(bwords_row_index, b_cols, p_cols, lo, counts,
+                          unmatched, total, nrows, out_cap: int,
+                          with_unmatched_probe: bool):
+        """Traced gather-map expansion: (probe, build) pair columns for the
+        matched pairs [+ null-extended unmatched probe rows].  Pure function
+        of device operands + static (out_cap, with_unmatched_probe) so it
+        can be inlined into a consumer's program (join->agg fusion)."""
+        n = counts.shape[0]
+        offsets = jnp.cumsum(counts.astype(jnp.int64))
+        excl = offsets - counts.astype(jnp.int64)
+        j = jnp.arange(out_cap, dtype=jnp.int64)
+        probe_row = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32)
+        probe_row = jnp.clip(probe_row, 0, n - 1)
+        k = j - excl[probe_row]
+        build_pos = lo[probe_row].astype(jnp.int64) + k
+        build_cap = bwords_row_index.shape[0]
+        build_row = bwords_row_index[
+            jnp.clip(build_pos, 0, build_cap - 1).astype(jnp.int32)]
+        in_pairs = j < total
+        probe_idx = jnp.where(in_pairs, probe_row, 0)
+        if with_unmatched_probe:
+            # unmatched probe rows appended after the pairs
+            um_positions = jnp.cumsum(unmatched.astype(jnp.int64)) - 1
+            um_slot = total + um_positions
+            scatter_to = jnp.where(unmatched, um_slot,
+                                   out_cap).astype(jnp.int64)
+            probe_idx_full = jnp.zeros(out_cap, jnp.int32).at[
+                jnp.clip(scatter_to, 0, out_cap)].set(
+                jnp.arange(n, dtype=jnp.int32), mode="drop")
+            probe_idx = jnp.where(in_pairs, probe_row, probe_idx_full)
+        row_valid = j < nrows
+        lcols = gather_columns(probe_idx, row_valid, list(p_cols))
+        bcols = gather_columns(
+            jnp.where(in_pairs, build_row, 0), row_valid & in_pairs,
+            list(b_cols))
+        return lcols, bcols
+
     def _materialize(self, build: _SortedBuildSide, probe: ColumnarBatch,
                      lo, counts, total_host: int, unmatched,
                      with_unmatched_probe: bool, unmatched_host: int):
@@ -201,35 +261,9 @@ class _BaseTpuJoinExec(TpuExec):
 
         def fn(bwords_row_index, b_cols, p_cols, lo, counts, unmatched,
                total, nrows):
-            n = counts.shape[0]
-            offsets = jnp.cumsum(counts.astype(jnp.int64))
-            excl = offsets - counts.astype(jnp.int64)
-            j = jnp.arange(out_cap, dtype=jnp.int64)
-            probe_row = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32)
-            probe_row = jnp.clip(probe_row, 0, n - 1)
-            k = j - excl[probe_row]
-            build_pos = lo[probe_row].astype(jnp.int64) + k
-            build_cap = bwords_row_index.shape[0]
-            build_row = bwords_row_index[
-                jnp.clip(build_pos, 0, build_cap - 1).astype(jnp.int32)]
-            in_pairs = j < total
-            probe_idx = jnp.where(in_pairs, probe_row, 0)
-            if with_unmatched_probe:
-                # unmatched probe rows appended after the pairs
-                um_positions = jnp.cumsum(unmatched.astype(jnp.int64)) - 1
-                um_slot = total + um_positions
-                scatter_to = jnp.where(unmatched, um_slot,
-                                       out_cap).astype(jnp.int64)
-                probe_idx_full = jnp.zeros(out_cap, jnp.int32).at[
-                    jnp.clip(scatter_to, 0, out_cap)].set(
-                    jnp.arange(n, dtype=jnp.int32), mode="drop")
-                probe_idx = jnp.where(in_pairs, probe_row, probe_idx_full)
-            row_valid = j < nrows
-            lcols = gather_columns(probe_idx, row_valid, list(p_cols))
-            bcols = gather_columns(
-                jnp.where(in_pairs, build_row, 0), row_valid & in_pairs,
-                list(b_cols))
-            return lcols, bcols
+            return _BaseTpuJoinExec.materialize_pairs(
+                bwords_row_index, b_cols, p_cols, lo, counts, unmatched,
+                total, nrows, out_cap, with_unmatched_probe)
 
         jitted = self._cached_jit(("mat", out_cap, with_unmatched_probe), fn)
         lcols, bcols = jitted(build.row_index,

@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import Iterator, List, Tuple
 
 import jax
+from spark_rapids_tpu.perfcounters import tpu_jit
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
@@ -73,7 +74,7 @@ class TpuSortExec(TpuExec):
             out = _gather_batch(batch, perm, num_rows, schema)
             return tuple(out.columns)
 
-        self._jitted = jax.jit(fn)
+        self._jitted = tpu_jit(fn)
         return self._jitted
 
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
@@ -294,7 +295,7 @@ class TpuSortExec(TpuExec):
                             & mmask[perm]).astype(jnp.int32))
             return tuple(out.columns), emit, jnp.stack(consumed)
 
-        return jax.jit(fn)
+        return tpu_jit(fn)
 
 
 class TpuTopNExec(TpuExec):

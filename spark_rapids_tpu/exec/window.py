@@ -42,6 +42,7 @@ from __future__ import annotations
 from typing import Iterator, List, Tuple
 
 import jax
+from spark_rapids_tpu.perfcounters import tpu_jit
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
@@ -127,7 +128,7 @@ class TpuWindowExec(TpuExec):
                  else ColumnarBatch.concat(batches))
         with self.metrics["opTime"].timed():
             if getattr(self, "_jitted", None) is None:
-                self._jitted = jax.jit(self._window_fn)
+                self._jitted = tpu_jit(self._window_fn)
             cols = self._jitted(tuple(batch.columns),
                                 jnp.int32(batch.num_rows))
             out = ColumnarBatch(list(cols), batch.num_rows, self._output)

@@ -62,13 +62,138 @@ class TpuTransitionOverrides:
         root = TpuTransitionOverrides._coalesce_single_device_shuffle(
             root, conf)
         root = TpuTransitionOverrides._insert_coalesce(root, conf)
+        root = TpuTransitionOverrides._collapse_complete_agg(root, conf)
         root = TpuTransitionOverrides._rewrite_topn(root)
         if conf.get(TPU_WHOLESTAGE_FUSION):
             root = fuse_stages(root)
+        # after stage fusion so Agg(Stage(Join)) has become Agg(Join) with
+        # the stage ops absorbed as the aggregate's pre_ops
+        root = TpuTransitionOverrides._fuse_join_agg(root, conf)
+        root = TpuTransitionOverrides._fuse_window_chain(root, conf)
         root = TpuTransitionOverrides._rewrite_ici_agg(root, conf)
         root = TpuTransitionOverrides._rewrite_ici_join(root, conf)
         root = TpuTransitionOverrides._rewrite_ici_sort(root, conf)
         return root
+
+    @staticmethod
+    def _collapse_complete_agg(node: TpuExec, conf: TpuConf) -> TpuExec:
+        """Single-device exchange elision for two-phase aggregates:
+        Final <- [Coalesce] <- Exchange <- Partial  =>  Complete.
+
+        The exchange exists to co-locate keys across devices; with one
+        device (or the mesh path disabled) it only adds program launches.
+        The COMPLETE aggregate runs ONE fused XLA program for a
+        single-batch input and falls back to the exact two-phase pipeline
+        (buffer-form merges) for multi-batch — see
+        TpuHashAggregateExec._execute_complete.  Reference analog: AQE's
+        single-partition shuffle elision (SURVEY.md §2.2)."""
+        import jax
+
+        from spark_rapids_tpu.config import (
+            COMPLETE_AGG_COLLAPSE,
+            MESH_AGG_ENABLED,
+            MESH_ENABLED,
+            SHUFFLE_MODE,
+        )
+        from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+        from spark_rapids_tpu.plan.nodes import AggregateMode
+
+        node.children = [
+            TpuTransitionOverrides._collapse_complete_agg(c, conf)
+            if isinstance(c, TpuExec) else c for c in node.children]
+        if not conf.get(COMPLETE_AGG_COLLAPSE):
+            return node
+        if (conf.get(MESH_ENABLED) and conf.get(MESH_AGG_ENABLED)
+                and str(conf.get(SHUFFLE_MODE)).upper() == "ICI"
+                and len(jax.devices()) > 1):
+            return node  # the ICI collective rewrite owns this pattern
+        if not (isinstance(node, TpuHashAggregateExec)
+                and node.mode == AggregateMode.FINAL):
+            return node
+        mid = node.children[0]
+        if isinstance(mid, TpuCoalesceBatchesExec):
+            mid = mid.children[0]
+        if not isinstance(mid, TpuShuffleExchangeExec):
+            return node
+        partial = mid.children[0]
+        if not (isinstance(partial, TpuHashAggregateExec)
+                and partial.mode == AggregateMode.PARTIAL):
+            return node
+        comp = TpuHashAggregateExec(
+            partial.grouping, partial.aggregates, AggregateMode.COMPLETE,
+            partial.children[0], partial.child_schema, node.output,
+            node.ansi)
+        comp.pre_ops = partial.pre_ops
+        comp.input_schema = partial.input_schema
+        return comp
+
+    @staticmethod
+    def _fuse_join_agg(node: TpuExec, conf: TpuConf) -> TpuExec:
+        """Aggregate directly above an unconditioned INNER/LEFT equi-join
+        fuses into TpuJoinAggFusedExec (exec/fused.py)."""
+        from spark_rapids_tpu.config import JOIN_AGG_FUSION
+        from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+        from spark_rapids_tpu.exec.fused import TpuJoinAggFusedExec
+        from spark_rapids_tpu.exec.join import TpuBroadcastHashJoinExec
+        from spark_rapids_tpu.plan.nodes import AggregateMode, JoinType
+
+        node.children = [
+            TpuTransitionOverrides._fuse_join_agg(c, conf)
+            if isinstance(c, TpuExec) else c for c in node.children]
+        if not conf.get(JOIN_AGG_FUSION):
+            return node
+        if not (isinstance(node, TpuHashAggregateExec)
+                and node.mode in (AggregateMode.COMPLETE,
+                                  AggregateMode.PARTIAL)
+                and not node._has_collect):
+            return node
+        join = node.children[0]
+        if not (isinstance(join, TpuBroadcastHashJoinExec)
+                and join.condition is None
+                and join.join_type in (JoinType.INNER, JoinType.LEFT_OUTER)
+                and join.left_keys):
+            return node
+        # the agg keeps the join as its child (used by the oversized-build
+        # fallback); the fused exec replaces it in the surrounding tree
+        return TpuJoinAggFusedExec(node, join)
+
+    @staticmethod
+    def _fuse_window_chain(node: TpuExec, conf: TpuConf) -> TpuExec:
+        """[Stage(]Window([CompleteAgg(x)])[)] -> TpuWindowChainFusedExec.
+
+        Non-ANSI only (the fused program carries no error-flag channel);
+        ANSI chains keep their per-operator programs."""
+        from spark_rapids_tpu.config import WINDOW_CHAIN_FUSION
+        from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+        from spark_rapids_tpu.exec.basic import TpuStageExec
+        from spark_rapids_tpu.exec.fused import TpuWindowChainFusedExec
+        from spark_rapids_tpu.exec.window import TpuWindowExec
+        from spark_rapids_tpu.plan.nodes import AggregateMode
+
+        # match TOP-DOWN so the longest chain (stage+window+agg) wins over
+        # the inner window+agg pair, then recurse into the result
+        if conf.get(WINDOW_CHAIN_FUSION):
+            post_ops, post_schema = None, None
+            window = node
+            if isinstance(node, TpuStageExec) and not node.ansi \
+                    and not node._has_host_kernels() \
+                    and isinstance(node.children[0], TpuWindowExec):
+                window = node.children[0]
+                post_ops, post_schema = node.ops, node.output
+            if isinstance(window, TpuWindowExec) and not window.ansi:
+                pre_agg = None
+                child = window.children[0]
+                if (isinstance(child, TpuHashAggregateExec)
+                        and child.mode == AggregateMode.COMPLETE
+                        and not child._has_collect and not child.ansi):
+                    pre_agg = child
+                if pre_agg is not None or post_ops is not None:
+                    node = TpuWindowChainFusedExec(window, pre_agg,
+                                                   post_ops, post_schema)
+        node.children = [
+            TpuTransitionOverrides._fuse_window_chain(c, conf)
+            if isinstance(c, TpuExec) else c for c in node.children]
+        return node
 
     @staticmethod
     def _rewrite_ici_sort(node: TpuExec, conf: TpuConf) -> TpuExec:

@@ -264,6 +264,30 @@ class AggregateExpression:
         return f"{self.func}({inner}) AS {self.result_name}"
 
 
+def partial_buffer_schema(grouping, aggregates) -> T.StructType:
+    """PARTIAL-mode buffer schema for a grouping+aggregate set (what a
+    PARTIAL HashAggregate outputs and a FINAL one consumes)."""
+    fields = [T.StructField(g.name, g.dataType, g.nullable)
+              for g in grouping]
+    for a in aggregates:
+        if a.func == "avg":
+            fields.append(T.StructField(a.result_name + "_sum", T.DOUBLE
+                          if not isinstance(a.result_type, T.DecimalType)
+                          else T.DecimalType(38, a.child.dataType.scale)))
+            fields.append(T.StructField(a.result_name + "_count", T.LONG))
+        elif a.func in MOMENT_BUFFERS:
+            for suffix in MOMENT_BUFFERS[a.func]:
+                fields.append(T.StructField(
+                    a.result_name + suffix, T.DOUBLE))
+        elif a.func == "approx_count_distinct":
+            fields.append(T.StructField(
+                a.result_name + "_hll",
+                T.ArrayType(T.INT, containsNull=False)))
+        else:
+            fields.append(T.StructField(a.result_name, a.result_type))
+    return T.StructType(fields)
+
+
 class HashAggregate(SparkPlan):
     def __init__(self, grouping: List[Expression],
                  aggregates: List[AggregateExpression],
@@ -279,28 +303,12 @@ class HashAggregate(SparkPlan):
 
     @property
     def output(self):
+        if self.mode == AggregateMode.PARTIAL:
+            return partial_buffer_schema(self.grouping, self.aggregates)
         fields = [T.StructField(g.name, g.dataType, g.nullable)
                   for g in self.grouping]
-        if self.mode == AggregateMode.PARTIAL:
-            for a in self.aggregates:
-                if a.func == "avg":
-                    fields.append(T.StructField(a.result_name + "_sum", T.DOUBLE
-                                  if not isinstance(a.result_type, T.DecimalType)
-                                  else T.DecimalType(38, a.child.dataType.scale)))
-                    fields.append(T.StructField(a.result_name + "_count", T.LONG))
-                elif a.func in MOMENT_BUFFERS:
-                    for suffix in MOMENT_BUFFERS[a.func]:
-                        fields.append(T.StructField(
-                            a.result_name + suffix, T.DOUBLE))
-                elif a.func == "approx_count_distinct":
-                    fields.append(T.StructField(
-                        a.result_name + "_hll",
-                        T.ArrayType(T.INT, containsNull=False)))
-                else:
-                    fields.append(T.StructField(a.result_name, a.result_type))
-        else:
-            fields += [T.StructField(a.result_name, a.result_type)
-                       for a in self.aggregates]
+        fields += [T.StructField(a.result_name, a.result_type)
+                   for a in self.aggregates]
         return T.StructType(fields)
 
     def describe(self):
