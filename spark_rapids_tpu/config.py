@@ -367,6 +367,17 @@ MESH_SORT_ENABLED = conf("spark.rapids.tpu.mesh.sort.enabled").doc(
     "Per-stage kill switch: run global sorts as the distributed "
     "range-exchange ICI sort.").boolean_conf(True)
 
+MESH_WINDOW_ENABLED = conf("spark.rapids.tpu.mesh.window.enabled").doc(
+    "Per-stage kill switch: run partitioned window stages as the "
+    "distributed ICI window (hash all-to-all on PARTITION BY, then the "
+    "single-chip window program per device).").boolean_conf(True)
+
+MESH_REPARTITION_ENABLED = conf(
+    "spark.rapids.tpu.mesh.repartition.enabled").doc(
+    "Per-stage kill switch: lower remaining hash/round-robin shuffle "
+    "exchanges (those no specialized ICI stage claims) to the generic "
+    "mesh all-to-all repartition.").boolean_conf(True)
+
 MESH_EPOCH_BYTES = conf("spark.rapids.tpu.mesh.epochTargetBytes").doc(
     "Input bytes gathered into one mesh collective epoch.  ICI stages "
     "stream the child's batches through the SPMD program in epochs of "

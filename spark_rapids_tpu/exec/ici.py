@@ -97,6 +97,69 @@ def _slice_cols(cols, cap):
         for c in cols)
 
 
+def _map_col_arrays(c: DeviceColumn, f) -> DeviceColumn:
+    """Rebuild a DeviceColumn with ``f`` applied to every row-major array
+    (validity/data/chars/lengths/elem_valid, recursing into struct
+    children) — the one place column-layout completeness lives for the
+    mesh helpers below."""
+    return DeviceColumn(
+        c.dtype, f(c.validity),
+        data=None if c.data is None else f(c.data),
+        chars=None if c.chars is None else f(c.chars),
+        lengths=None if c.lengths is None else f(c.lengths),
+        elem_valid=None if c.elem_valid is None else f(c.elem_valid),
+        children=None if c.children is None
+        else tuple(_map_col_arrays(k, f) for k in c.children))
+
+
+def _fit_cols(cols, cap):
+    """Slice or zero-pad columns to exactly ``cap`` rows."""
+    def fit(arr):
+        n = arr.shape[0]
+        if cap <= n:
+            return arr[:cap]
+        return jnp.pad(arr, [(0, cap - n)] + [(0, 0)] * (arr.ndim - 1))
+
+    return tuple(_map_col_arrays(c, fit) for c in cols)
+
+
+def _rebucket_sharded(cols, per_dev_cap: int, tgt_cap: int, n_dev: int,
+                      mesh, axis: str):
+    """Re-bucket device-sharded prefix-compacted columns from per_dev_cap
+    to tgt_cap rows per device (the agg accumulator's resize, shared by
+    the window/repartition stages)."""
+    def rs(arr):
+        shp = arr.shape
+        a = arr.reshape((n_dev, per_dev_cap) + shp[1:])
+        if tgt_cap <= per_dev_cap:
+            a = a[:, :tgt_cap]
+        else:
+            a = jnp.pad(a, [(0, 0), (0, tgt_cap - per_dev_cap)]
+                        + [(0, 0)] * (arr.ndim - 1))
+        out = a.reshape((n_dev * tgt_cap,) + shp[1:])
+        return jax.device_put(out, NamedSharding(mesh, P(axis)))
+
+    return [_map_col_arrays(c, rs) for c in cols]
+
+
+def _ceil_to_mesh(batch: ColumnarBatch, n_dev: int) -> ColumnarBatch:
+    """Pad a batch's capacity up to a multiple of the device count."""
+    cap = batch.capacity
+    if cap % n_dev or cap < n_dev:
+        return ColumnarBatch(
+            [c.slice_to(-(-cap // n_dev) * n_dev) for c in batch.columns],
+            batch.num_rows, batch.schema)
+    return batch
+
+
+def _shard_cols(batch: ColumnarBatch, mesh, axis: str):
+    """Row-shard every column array of a batch over the mesh axis."""
+    def put(arr):
+        return jax.device_put(arr, NamedSharding(mesh, P(axis)))
+
+    return [_map_col_arrays(c, put) for c in batch.columns]
+
+
 class TpuIciShuffleAggExec(TpuExec):
     """Fused distributed aggregation stage over a jax Mesh.
 
@@ -379,13 +442,35 @@ class TpuIciShuffleJoinExec(TpuExec):
          materializes each device's join output via the same searchsorted
          gather maps the single-chip join uses.
 
-    Supported: INNER / LEFT_OUTER / LEFT_SEMI / LEFT_ANTI equi-joins
-    without residual conditions; everything else keeps the single-chip
-    exec.
+    Supported (VERDICT r3 Next #3): INNER (incl. residual conditions,
+    filtered in the materialization program) / LEFT_OUTER / LEFT_SEMI /
+    LEFT_ANTI / RIGHT_OUTER (mirror-swapped to LEFT_OUTER, columns
+    reordered on emit — the single-chip _execute_right_outer design) /
+    FULL_OUTER (LEFT_OUTER streaming + device-resident matched-build mask
+    + one unmatched-build tail program after the last epoch).
     """
 
     def __init__(self, join, left_inner, right_inner, mesh,
                  axis: str = "dp", epoch_bytes: int = 1 << 28):
+        from spark_rapids_tpu.plan.nodes import JoinType
+
+        self._orig_output = join.output
+        self._mirror_nl = None
+        if join.join_type == JoinType.RIGHT_OUTER:
+            from spark_rapids_tpu.exec.join import (
+                TpuShuffledSymmetricHashJoinExec,
+            )
+
+            swapped_schema = T.StructType(
+                list(right_inner.output.fields)
+                + [T.StructField(f.name, f.dataType, True)
+                   for f in left_inner.output.fields])
+            join = TpuShuffledSymmetricHashJoinExec(
+                right_inner, left_inner, join.right_keys, join.left_keys,
+                JoinType.LEFT_OUTER, join.condition, swapped_schema,
+                join.ansi)
+            left_inner, right_inner = right_inner, left_inner
+            self._mirror_nl = len(left_inner.output.fields)
         super().__init__([left_inner, right_inner])
         self.join = join            # TpuShuffledSymmetricHashJoinExec
         self.mesh = mesh
@@ -394,15 +479,18 @@ class TpuIciShuffleJoinExec(TpuExec):
         self._pbuild = None
         self._pprobe = {}
         self._p2 = {}
+        self._ptail = None
 
     @property
     def output(self):
-        return self.join.output
+        return self._orig_output
 
     def describe(self):
         n = self.mesh.devices.size
+        jt = ("right_outer(mirrored)" if self._mirror_nl is not None
+              else self.join.join_type.value)
         return (f"TpuIciShuffleJoin[{n}dev] "
-                f"{self.join.join_type.value} "
+                f"{jt} "
                 f"[{self.join.describe()}]")
 
     # ------------------------------------------------------------------
@@ -457,22 +545,27 @@ class TpuIciShuffleJoinExec(TpuExec):
             row_index = srt[-1]
             n_valid = jnp.sum(bkvalid.astype(jnp.int32))
             return (tuple(rr), tuple(swords), row_index,
-                    n_valid.reshape(1))
+                    n_valid.reshape(1), rr_ok)
 
         return shard_map(
             per_device, mesh=self.mesh,
             in_specs=(P(axis), P()),
-            out_specs=(P(axis), P(axis), P(axis), P(axis)),
+            out_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
             check_vma=False)
 
     def _build_pprobe(self, l_schema):
         """Per probe epoch: all-to-all the epoch's PROBE rows and count
-        matches against the resident sorted build keys."""
+        matches against the resident sorted build keys.  FULL OUTER also
+        ORs covered build positions (sorted space, diff-array) into the
+        device-resident matched accumulator."""
+        from spark_rapids_tpu.plan.nodes import JoinType
+
         axis = self.axis
         n_dev = int(self.mesh.devices.size)
         join = self.join
+        full = join.join_type == JoinType.FULL_OUTER
 
-        def per_device(lcols, l_rows, swords, n_valid):
+        def per_device(lcols, l_rows, swords, n_valid, *acc):
             from spark_rapids_tpu.exec.join import (
                 _key_words_of,
                 _multiword_searchsorted,
@@ -504,14 +597,26 @@ class TpuIciShuffleJoinExec(TpuExec):
             total = jnp.sum(counts.astype(jnp.int64))
             unmatched = rl_ok & (counts == 0)
             n_unmatched = jnp.sum(unmatched.astype(jnp.int64))
-            return (tuple(rl), lo, counts, unmatched, rl_ok,
-                    jnp.stack([total, n_unmatched]).reshape(1, 2))
+            out = (tuple(rl), lo, counts, unmatched, rl_ok,
+                   jnp.stack([total, n_unmatched]).reshape(1, 2))
+            if full:
+                bcap = swords[0].shape[0]
+                diff = jnp.zeros(bcap + 1, jnp.int32)
+                has = counts > 0
+                start = jnp.where(has, lo, bcap)
+                end = jnp.where(has, lo + counts, bcap)
+                diff = diff.at[start].add(1, mode="drop")
+                diff = diff.at[end].add(-1, mode="drop")
+                covered_sorted = jnp.cumsum(diff[:-1]) > 0
+                out = out + (acc[0] | covered_sorted,)
+            return out
 
         return shard_map(
             per_device, mesh=self.mesh,
-            in_specs=(P(axis), P(), P(axis), P(axis)),
+            in_specs=(P(axis), P(), P(axis), P(axis))
+            + ((P(axis),) if full else ()),
             out_specs=(P(axis), P(axis), P(axis), P(axis), P(axis),
-                       P(axis)),
+                       P(axis)) + ((P(axis),) if full else ()),
             check_vma=False)
 
     def _build_p2(self, out_cap, l_schema, r_schema, n_l):
@@ -537,20 +642,20 @@ class TpuIciShuffleJoinExec(TpuExec):
                         else (counts > 0)) & rl_ok
                 out, cnt = compact_columns(keep, lcols)
                 return tuple(out), cnt.astype(jnp.int64).reshape(1)
+            from spark_rapids_tpu.exec.join import _slots_to_probe_rows
+
             n = counts.shape[0]
             offsets = jnp.cumsum(counts.astype(jnp.int64))
             excl = offsets - counts.astype(jnp.int64)
             j = jnp.arange(out_cap, dtype=jnp.int64)
-            probe_row = jnp.searchsorted(offsets, j,
-                                         side="right").astype(jnp.int32)
-            probe_row = jnp.clip(probe_row, 0, n - 1)
+            probe_row = _slots_to_probe_rows(excl, counts, out_cap)
             k = j - excl[probe_row]
             build_pos = lo[probe_row].astype(jnp.int64) + k
             bcap = row_index.shape[0]
             build_row = row_index[jnp.clip(build_pos, 0,
                                            bcap - 1).astype(jnp.int32)]
             in_pairs = j < total
-            with_um = jt == JoinType.LEFT_OUTER
+            with_um = jt in (JoinType.LEFT_OUTER, JoinType.FULL_OUTER)
             probe_idx = jnp.where(in_pairs, probe_row, 0)
             out_rows = total + (n_um if with_um else 0)
             if with_um:
@@ -567,6 +672,19 @@ class TpuIciShuffleJoinExec(TpuExec):
             out_r = gather_columns(
                 jnp.where(in_pairs, build_row, 0), row_valid & in_pairs,
                 rcols)
+            if join.condition is not None and jt == JoinType.INNER:
+                # residual condition: evaluate over the materialized
+                # pairs and compact (single-chip _apply_condition, fused
+                # into this program)
+                from spark_rapids_tpu.expr.base import EvalContext
+
+                b = ColumnarBatch(list(out_l) + list(out_r), out_rows,
+                                  join.output)
+                ctx = EvalContext(b, ansi=join.ansi)
+                pred = join.condition.eval_tpu(ctx)
+                keep = pred.data & pred.validity & row_valid
+                out, cnt = compact_columns(keep, list(out_l) + list(out_r))
+                return tuple(out), cnt.astype(jnp.int64).reshape(1)
             return (tuple(out_l + out_r),
                     out_rows.astype(jnp.int64).reshape(1))
 
@@ -575,6 +693,42 @@ class TpuIciShuffleJoinExec(TpuExec):
             in_specs=(P(axis),) * 7,
             out_specs=(P(axis), P(axis)),
             check_vma=False)
+
+    def _build_ptail(self, bcap_local: int):
+        """FULL OUTER tail: per device, compact the build rows never
+        covered by any probe epoch (single-chip _unmatched_build_tail,
+        device-resident)."""
+        axis = self.axis
+
+        def per_device(rr, row_index, matched_sorted, rok):
+            from spark_rapids_tpu.ops.filterops import compact_columns
+
+            matched_orig = jnp.zeros(bcap_local, jnp.bool_).at[
+                row_index].set(matched_sorted, mode="drop")
+            keep = rok & ~matched_orig
+            out, cnt = compact_columns(keep, list(rr))
+            return tuple(out), cnt.astype(jnp.int64).reshape(1)
+
+        return shard_map(
+            per_device, mesh=self.mesh,
+            in_specs=(P(axis),) * 4,
+            out_specs=(P(axis), P(axis)),
+            check_vma=False)
+
+    def _null_cols(self, fields, cap: int):
+        """All-null columns for the unmatched side of an outer emit."""
+        cols = []
+        for f in fields:
+            if isinstance(f.dataType, T.StringType):
+                cols.append(DeviceColumn(
+                    f.dataType, jnp.zeros(cap, jnp.bool_),
+                    chars=jnp.zeros((cap, 8), jnp.uint8),
+                    lengths=jnp.zeros(cap, jnp.int32)))
+            else:
+                cols.append(DeviceColumn(
+                    f.dataType, jnp.zeros(cap, jnp.bool_),
+                    data=jnp.zeros(cap, T.storage_dtype(f.dataType))))
+        return cols
 
     # ------------------------------------------------------------------
     def _collect_side(self, child) -> ColumnarBatch:
@@ -625,28 +779,37 @@ class TpuIciShuffleJoinExec(TpuExec):
         jt = self.join.join_type
         out_schema = self.join.output
         keep_cols = len(out_schema.fields)
-        saw_probe = False
+        full = jt == JoinType.FULL_OUTER
         with self.metrics["opTime"].timed():
             rs = self._shard(right)
             if self._pbuild is None:
                 self._pbuild = self._build_pbuild(r_schema)
-            rr, swords, row_index, n_valid = self._pbuild(
+            rr, swords, row_index, n_valid, rr_ok = self._pbuild(
                 tuple(rs), jnp.int32(right.num_rows))
+        matched = None
+        if full:
+            matched = jax.device_put(
+                jnp.zeros(swords[0].shape[0], jnp.bool_),
+                NamedSharding(self.mesh, P(self.axis)))
         for epoch in self._epochs(self.children[0].execute_columnar()):
-            saw_probe = True
             with self.metrics["opTime"].timed():
                 epoch = self._pad_for_mesh(epoch)
                 ls = self._shard(epoch)
                 pkey = (epoch.capacity,)
                 if pkey not in self._pprobe:
                     self._pprobe[pkey] = self._build_pprobe(l_schema)
-                (rl, lo, counts, unmatched, rl_ok, totals) = \
-                    self._pprobe[pkey](tuple(ls),
-                                       jnp.int32(epoch.num_rows),
-                                       swords, n_valid)
+                acc = (matched,) if full else ()
+                res = self._pprobe[pkey](tuple(ls),
+                                         jnp.int32(epoch.num_rows),
+                                         swords, n_valid, *acc)
+                (rl, lo, counts, unmatched, rl_ok, totals) = res[:6]
+                if full:
+                    matched = res[6]
                 totals_np = np.asarray(totals)  # one host sync per epoch
                 per_dev_rows = totals_np[:, 0] + (
-                    totals_np[:, 1] if jt == JoinType.LEFT_OUTER else 0)
+                    totals_np[:, 1]
+                    if jt in (JoinType.LEFT_OUTER, JoinType.FULL_OUTER)
+                    else 0)
                 flat = tuple(rl) + tuple(rr)
                 if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
                     out_cap = rl[0].capacity // n_dev
@@ -671,10 +834,36 @@ class TpuIciShuffleJoinExec(TpuExec):
                 lo_i = d * per_dev_cap
                 cols = [c.gather(jnp.arange(lo_i, lo_i + per_dev_cap))
                         for c in out_cols[:keep_cols]]
-                yield self._count_output(
-                    ColumnarBatch(cols, ng, out_schema))
-        if not saw_probe:
-            return
+                yield self._emit(cols, ng)
+        if full:
+            with self.metrics["opTime"].timed():
+                bcap_local = swords[0].shape[0] // n_dev
+                if self._ptail is None:
+                    self._ptail = self._build_ptail(bcap_local)
+                tail_cols, tail_rows = self._ptail(rr, row_index, matched,
+                                                   rr_ok)
+                tail_np = np.asarray(tail_rows)  # one host sync
+            n_l = len(l_schema.fields)
+            per_dev_cap = tail_cols[0].capacity // n_dev
+            for d in range(n_dev):
+                ng = int(tail_np[d])
+                if ng == 0:
+                    continue
+                lo_i = d * per_dev_cap
+                bcols = [c.gather(jnp.arange(lo_i, lo_i + per_dev_cap))
+                         for c in tail_cols]
+                lcols = self._null_cols(out_schema.fields[:n_l],
+                                        per_dev_cap)
+                yield self._emit(lcols + list(bcols), ng)
+
+    def _emit(self, cols, ng):
+        """Emit one output batch, reordering mirrored RIGHT OUTER columns
+        back to the original left-then-right order."""
+        if self._mirror_nl is not None:
+            nl = self._mirror_nl
+            cols = cols[nl:] + cols[:nl]
+        return self._count_output(
+            ColumnarBatch(list(cols), ng, self._orig_output))
 
 
 class TpuIciSortExec(TpuExec):
@@ -909,3 +1098,277 @@ class TpuIciSortExec(TpuExec):
                              chars=put(c.chars), lengths=put(c.lengths),
                              elem_valid=put(c.elem_valid))
                 for c in batch.columns]
+
+
+def _build_exchange_epoch_program(mesh, axis: str, tgt_of):
+    """Shared SPMD exchange program for the window/repartition stages:
+    local rows -> target device ids (``tgt_of``) -> all-to-all over ICI ->
+    prefix compaction.  Returns per-device (received cols, count)."""
+    n_dev = int(mesh.devices.size)
+
+    def per_device(cols, num_rows):
+        from spark_rapids_tpu.ops.filterops import compact_columns
+        from spark_rapids_tpu.parallel.mesh import ici_all_to_all_columns
+
+        local_cap = cols[0].capacity
+        idx = jax.lax.axis_index(axis)
+        nloc = jnp.clip(num_rows - idx.astype(jnp.int32) * local_cap,
+                        0, local_cap)
+        rows = jnp.arange(local_cap) < nloc
+        tgt = tgt_of(cols, nloc, idx, local_cap)
+        rcols, rok = ici_all_to_all_columns(list(cols), rows, tgt,
+                                            n_dev, axis)
+        out, cnt = compact_columns(rok, rcols)
+        return tuple(out), cnt.astype(jnp.int32).reshape(1)
+
+    return shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=(P(axis), P(axis)),
+        check_vma=False)
+
+
+def mesh_exchange_schema_supported(schema) -> bool:
+    """The generic exchange stages ride _concat_cols/_fit_cols, which
+    handle flat and plain-string layouts; nested columns keep the host
+    path (the rewrites check this before claiming a stage)."""
+    return not any(
+        isinstance(f.dataType, (T.ArrayType, T.MapType, T.StructType))
+        for f in schema.fields)
+
+
+class _IciExchangeStageBase(TpuExec):
+    """Shared epoch driver for the exchange-shaped ICI stages (window /
+    generic repartition): pad to the mesh, shard, run the exchange
+    program, sync received counts, re-bucket the compacted block."""
+
+    def __init__(self, children, mesh, axis: str, epoch_bytes: int):
+        super().__init__(children)
+        self.mesh = mesh
+        self.axis = axis
+        self.epoch_bytes = epoch_bytes
+        self._pex = {}
+
+    def _tgt_of(self):
+        raise NotImplementedError
+
+    def _run_exchange_epoch(self, epoch: ColumnarBatch):
+        n_dev = int(self.mesh.devices.size)
+        epoch = _ceil_to_mesh(epoch, n_dev)
+        sharded = _shard_cols(epoch, self.mesh, self.axis)
+        pkey = epoch.capacity
+        if pkey not in self._pex:
+            self._pex[pkey] = _build_exchange_epoch_program(
+                self.mesh, self.axis, self._tgt_of())
+        rcols, cnts = self._pex[pkey](tuple(sharded),
+                                      jnp.int32(epoch.num_rows))
+        cnts_np = np.asarray(cnts).reshape(-1)  # one host sync per epoch
+        per_dev_cap = rcols[0].capacity // n_dev
+        need = max(int(cnts_np.max()), 1)
+        blk_cap = min(1 << (need - 1).bit_length(), per_dev_cap)
+        block = (_rebucket_sharded(rcols, per_dev_cap, blk_cap, n_dev,
+                                   self.mesh, self.axis)
+                 if blk_cap != per_dev_cap else list(rcols))
+        return block, blk_cap, cnts_np
+
+    def _cnt_dev(self, cnts_np):
+        return jax.device_put(
+            np.asarray(cnts_np, np.int32).reshape(-1),
+            NamedSharding(self.mesh, P(self.axis)))
+
+    def _emit_per_device(self, cols, cnts_np, schema):
+        n_dev = int(self.mesh.devices.size)
+        per_dev_cap = cols[0].capacity // n_dev
+        for d in range(n_dev):
+            ng = int(cnts_np[d])
+            if ng == 0:
+                continue
+            lo = d * per_dev_cap
+            out = [c.gather(jnp.arange(lo, lo + per_dev_cap))
+                   for c in cols]
+            yield self._count_output(ColumnarBatch(out, ng, schema))
+
+
+class TpuIciWindowExec(_IciExchangeStageBase):
+    """Distributed partitioned window over the mesh — the fourth ICI stage
+    shape (VERDICT r3 Next #2): hash all-to-all on the PARTITION BY keys
+    co-locates every window partition on one device, then the unchanged
+    single-chip window program (exec/window.TpuWindowExec._window_fn) runs
+    per device inside shard_map.
+
+    Reference analog: GpuWindowExec downstream of a hash-partitioned
+    GpuShuffleExchangeExec (SURVEY.md §2.4 Window, §5.8): the reference
+    relies on the exchange for partition co-location; on TPU the exchange
+    IS the collective step of this exec.
+
+    Epoch-streamed: each epoch runs one SPMD exchange program, the
+    compacted block re-buckets to the smallest pow2 per-device capacity,
+    and blocks fold into one device-resident accumulator; the window
+    program runs once after the last epoch.  Programs: 1 exchange +
+    [1 fold] per epoch + 1 window; one host sync per epoch."""
+
+    def __init__(self, window, mesh, axis: str = "dp",
+                 epoch_bytes: int = 1 << 28):
+        super().__init__(list(window.children), mesh, axis, epoch_bytes)
+        self.window = window            # single-chip TpuWindowExec (reused)
+        self._pfold = {}
+        self._pwin = {}
+
+    @property
+    def output(self):
+        return self.window.output
+
+    def describe(self):
+        n = self.mesh.devices.size
+        return f"TpuIciWindow[{n}dev] [{self.window.describe()}]"
+
+    def _tgt_of(self):
+        window = self.window
+        n_dev = int(self.mesh.devices.size)
+        schema = self.children[0].output
+
+        def tgt(cols, nloc, idx, local_cap):
+            from spark_rapids_tpu.expr.base import EvalContext
+            from spark_rapids_tpu.ops.hashing import spark_partition_ids
+
+            batch = ColumnarBatch(list(cols), nloc, schema)
+            ctx = EvalContext(batch, ansi=window.ansi)
+            pcols = [e.eval_tpu(ctx) for e in window.partition_by]
+            return spark_partition_ids(pcols, n_dev)
+
+        return tgt
+
+    # ------------------------------------------------------------------
+    def _build_fold_program(self, acc_cap: int, blk_cap: int, out_cap: int):
+        """Concat the accumulator's and the new block's per-device valid
+        prefixes into one prefix-compacted accumulator of out_cap rows."""
+        axis = self.axis
+
+        def per_device(acc_cols, acc_cnt, blk_cols, blk_cnt):
+            from spark_rapids_tpu.ops.filterops import compact_columns
+
+            rows_a = jnp.arange(acc_cap, dtype=jnp.int32) < acc_cnt[0]
+            rows_b = jnp.arange(blk_cap, dtype=jnp.int32) < blk_cnt[0]
+            cat = [_concat_cols(a, b)
+                   for a, b in zip(acc_cols, blk_cols)]
+            keep = jnp.concatenate([rows_a, rows_b])
+            out, _cnt = compact_columns(keep, cat)
+            return _fit_cols(out, out_cap)
+
+        return shard_map(
+            per_device, mesh=self.mesh,
+            in_specs=(P(axis),) * 4,
+            out_specs=P(axis),
+            check_vma=False)
+
+    def _build_window_program(self, acc_cap: int):
+        axis = self.axis
+        window = self.window
+
+        def per_device(cols, cnt):
+            return tuple(window._window_fn(tuple(cols), cnt[0]))
+
+        return shard_map(
+            per_device, mesh=self.mesh,
+            in_specs=(P(axis), P(axis)),
+            out_specs=P(axis),
+            check_vma=False)
+
+    # ------------------------------------------------------------------
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        n_dev = int(self.mesh.devices.size)
+        acc = None
+        acc_cnts = None
+        for epoch in _epoch_batches(self.children[0].execute_columnar(),
+                                    self.epoch_bytes):
+            # per-epoch timing only: the child's execution must not be
+            # charged to this stage's opTime
+            with self.metrics["opTime"].timed():
+                block, blk_cap, cnts_np = self._run_exchange_epoch(epoch)
+                if acc is None:
+                    acc, acc_cnts = block, cnts_np
+                    continue
+                acc_cap = acc[0].capacity // n_dev
+                tot = acc_cnts + cnts_np
+                need = max(int(tot.max()), 1)
+                out_cap = min(1 << (need - 1).bit_length(),
+                              acc_cap + blk_cap)
+                fkey = (acc_cap, blk_cap, out_cap)
+                if fkey not in self._pfold:
+                    self._pfold[fkey] = self._build_fold_program(
+                        acc_cap, blk_cap, out_cap)
+                acc = list(self._pfold[fkey](
+                    tuple(acc), self._cnt_dev(acc_cnts),
+                    tuple(block), self._cnt_dev(cnts_np)))
+                acc_cnts = tot
+        if acc is None:
+            return
+        with self.metrics["opTime"].timed():
+            acc_cap = acc[0].capacity // n_dev
+            if acc_cap not in self._pwin:
+                self._pwin[acc_cap] = self._build_window_program(acc_cap)
+            out_cols = self._pwin[acc_cap](tuple(acc),
+                                           self._cnt_dev(acc_cnts))
+        yield from self._emit_per_device(out_cols, acc_cnts,
+                                         self.window.output)
+
+
+class TpuIciRepartitionExec(_IciExchangeStageBase):
+    """Generic mesh repartition — the fifth ICI stage shape (VERDICT r3
+    Next #2): ANY hash/round-robin shuffle exchange lowers to one SPMD
+    all-to-all program per epoch, so exchanges that no specialized ICI
+    stage claims still execute on the mesh instead of the host loop.
+
+    Reference analog: GpuShuffleExchangeExec + RapidsShuffleManager
+    (SURVEY.md §2.7) — the generic exchange every plan shape rides.
+
+    Per epoch: partition ids (murmur3 pmod for hash, cycling offset for
+    round-robin) -> all-to-all -> compact -> re-bucket -> emit one batch
+    per device.  Downstream single-chip operators consume the emitted
+    batches exactly as they would the host shuffle's partitions."""
+
+    def __init__(self, exchange, mesh, axis: str = "dp",
+                 epoch_bytes: int = 1 << 28):
+        super().__init__(list(exchange.children), mesh, axis, epoch_bytes)
+        self.exchange = exchange
+        self.partitioning = exchange.partitioning
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def describe(self):
+        n = self.mesh.devices.size
+        return (f"TpuIciRepartition[{n}dev] "
+                f"{self.partitioning.describe()}")
+
+    def _tgt_of(self):
+        from spark_rapids_tpu.plan.nodes import HashPartitioning
+
+        part = self.partitioning
+        n_dev = int(self.mesh.devices.size)
+        schema = self.children[0].output
+        ansi = getattr(self.exchange, "ansi", False)
+
+        if isinstance(part, HashPartitioning):
+            def tgt(cols, nloc, idx, local_cap):
+                from spark_rapids_tpu.expr.base import EvalContext
+                from spark_rapids_tpu.ops.hashing import spark_partition_ids
+
+                batch = ColumnarBatch(list(cols), nloc, schema)
+                ctx = EvalContext(batch, ansi=ansi)
+                kcols = [e.eval_tpu(ctx) for e in part.keys]
+                return spark_partition_ids(kcols, n_dev)
+        else:
+            def tgt(cols, nloc, idx, local_cap):
+                return ((jnp.arange(local_cap, dtype=jnp.int32)
+                         + idx.astype(jnp.int32)) % n_dev)
+
+        return tgt
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        for epoch in _epoch_batches(self.children[0].execute_columnar(),
+                                    self.epoch_bytes):
+            with self.metrics["opTime"].timed():
+                block, blk_cap, cnts_np = self._run_exchange_epoch(epoch)
+            yield from self._emit_per_device(block, cnts_np, self.output)

@@ -56,6 +56,19 @@ class TpuMaterializedScan(SparkPlan):
         return cols, n
 
 
+def _mesh_stage_on(conf: TpuConf, switch) -> bool:
+    """The shared 4-condition guard of every ICI stage rewrite: mesh mode
+    on, the per-stage kill switch on, shuffle mode ICI, >1 device."""
+    import jax
+
+    from spark_rapids_tpu.config import MESH_ENABLED, SHUFFLE_MODE
+
+    return (conf.get(MESH_ENABLED)
+            and conf.get(switch)
+            and str(conf.get(SHUFFLE_MODE)).upper() == "ICI"
+            and len(jax.devices()) > 1)
+
+
 class TpuTransitionOverrides:
     @staticmethod
     def apply(root: TpuExec, conf: TpuConf) -> TpuExec:
@@ -73,6 +86,8 @@ class TpuTransitionOverrides:
         root = TpuTransitionOverrides._rewrite_ici_agg(root, conf)
         root = TpuTransitionOverrides._rewrite_ici_join(root, conf)
         root = TpuTransitionOverrides._rewrite_ici_sort(root, conf)
+        root = TpuTransitionOverrides._rewrite_ici_window(root, conf)
+        root = TpuTransitionOverrides._rewrite_ici_repartition(root, conf)
         return root
 
     @staticmethod
@@ -103,9 +118,7 @@ class TpuTransitionOverrides:
             if isinstance(c, TpuExec) else c for c in node.children]
         if not conf.get(COMPLETE_AGG_COLLAPSE):
             return node
-        if (conf.get(MESH_ENABLED) and conf.get(MESH_AGG_ENABLED)
-                and str(conf.get(SHUFFLE_MODE)).upper() == "ICI"
-                and len(jax.devices()) > 1):
+        if _mesh_stage_on(conf, MESH_AGG_ENABLED):
             return node  # the ICI collective rewrite owns this pattern
         if not (isinstance(node, TpuHashAggregateExec)
                 and node.mode == AggregateMode.FINAL):
@@ -170,6 +183,9 @@ class TpuTransitionOverrides:
         from spark_rapids_tpu.exec.window import TpuWindowExec
         from spark_rapids_tpu.plan.nodes import AggregateMode
 
+        from spark_rapids_tpu.config import MESH_WINDOW_ENABLED
+
+        mesh_claims = _mesh_stage_on(conf, MESH_WINDOW_ENABLED)
         # match TOP-DOWN so the longest chain (stage+window+agg) wins over
         # the inner window+agg pair, then recurse into the result
         if conf.get(WINDOW_CHAIN_FUSION):
@@ -180,7 +196,10 @@ class TpuTransitionOverrides:
                     and isinstance(node.children[0], TpuWindowExec):
                 window = node.children[0]
                 post_ops, post_schema = node.ops, node.output
-            if isinstance(window, TpuWindowExec) and not window.ansi:
+            if (isinstance(window, TpuWindowExec) and not window.ansi
+                    # partitioned windows belong to the ICI window rewrite
+                    # in mesh mode; partition-less ones still fuse
+                    and not (mesh_claims and window.partition_by)):
                 pre_agg = None
                 child = window.children[0]
                 if (isinstance(child, TpuHashAggregateExec)
@@ -211,10 +230,7 @@ class TpuTransitionOverrides:
         node.children = [
             TpuTransitionOverrides._rewrite_ici_sort(c, conf)
             if isinstance(c, TpuExec) else c for c in node.children]
-        if not (conf.get(MESH_ENABLED)
-                and conf.get(MESH_SORT_ENABLED)
-                and str(conf.get(SHUFFLE_MODE)).upper() == "ICI"
-                and len(jax.devices()) > 1):
+        if not _mesh_stage_on(conf, MESH_SORT_ENABLED):
             return node
         if not (isinstance(node, TpuSortExec) and node.is_global):
             return node
@@ -242,10 +258,7 @@ class TpuTransitionOverrides:
         node.children = [
             TpuTransitionOverrides._rewrite_ici_agg(c, conf)
             if isinstance(c, TpuExec) else c for c in node.children]
-        if not (conf.get(MESH_ENABLED)
-                and conf.get(MESH_AGG_ENABLED)
-                and str(conf.get(SHUFFLE_MODE)).upper() == "ICI"
-                and len(jax.devices()) > 1):
+        if not _mesh_stage_on(conf, MESH_AGG_ENABLED):
             return node
         if not (isinstance(node, TpuHashAggregateExec)
                 and node.mode == AggregateMode.FINAL):
@@ -287,10 +300,7 @@ class TpuTransitionOverrides:
         node.children = [
             TpuTransitionOverrides._rewrite_ici_join(c, conf)
             if isinstance(c, TpuExec) else c for c in node.children]
-        if not (conf.get(MESH_ENABLED)
-                and conf.get(MESH_JOIN_ENABLED)
-                and str(conf.get(SHUFFLE_MODE)).upper() == "ICI"
-                and len(jax.devices()) > 1):
+        if not _mesh_stage_on(conf, MESH_JOIN_ENABLED):
             return node
         join = node
         if isinstance(join, TpuAdaptiveJoinExec):
@@ -299,9 +309,13 @@ class TpuTransitionOverrides:
             join = join.shuffled
         if not isinstance(join, TpuShuffledSymmetricHashJoinExec):
             return node
-        if join.condition is not None or join.join_type not in (
+        if join.join_type not in (
                 JoinType.INNER, JoinType.LEFT_OUTER, JoinType.LEFT_SEMI,
-                JoinType.LEFT_ANTI):
+                JoinType.LEFT_ANTI, JoinType.RIGHT_OUTER,
+                JoinType.FULL_OUTER):
+            return node
+        if join.condition is not None and join.join_type != JoinType.INNER:
+            # non-inner residual conditions are tag-time fallbacks anyway
             return node
         if not all(isinstance(c, TpuShuffleExchangeExec)
                    for c in join.children):
@@ -316,6 +330,68 @@ class TpuTransitionOverrides:
             join.children[1].children[0],
             make_mesh(conf.get(MESH_DEVICES) or None),
             epoch_bytes=conf.get(_MEB))
+
+    @staticmethod
+    def _rewrite_ici_window(node: TpuExec, conf: TpuConf) -> TpuExec:
+        """ICI mesh mode: a partitioned TpuWindowExec becomes the
+        distributed mesh window (hash all-to-all on PARTITION BY +
+        single-chip window per device — exec/ici.TpuIciWindowExec).
+        Partition-less windows keep the single-chip exec (a global window
+        is one ordered scan; there is nothing to co-locate)."""
+        from spark_rapids_tpu.config import (MESH_DEVICES,
+                                             MESH_EPOCH_BYTES,
+                                             MESH_WINDOW_ENABLED)
+        from spark_rapids_tpu.exec.ici import (
+            TpuIciWindowExec,
+            mesh_exchange_schema_supported,
+        )
+        from spark_rapids_tpu.exec.window import TpuWindowExec
+
+        node.children = [
+            TpuTransitionOverrides._rewrite_ici_window(c, conf)
+            if isinstance(c, TpuExec) else c for c in node.children]
+        if not _mesh_stage_on(conf, MESH_WINDOW_ENABLED):
+            return node
+        if not (isinstance(node, TpuWindowExec) and node.partition_by
+                and mesh_exchange_schema_supported(node.children[0].output)):
+            return node
+        from spark_rapids_tpu.parallel.mesh import make_mesh
+
+        return TpuIciWindowExec(
+            node, make_mesh(conf.get(MESH_DEVICES) or None),
+            epoch_bytes=conf.get(MESH_EPOCH_BYTES))
+
+    @staticmethod
+    def _rewrite_ici_repartition(node: TpuExec, conf: TpuConf) -> TpuExec:
+        """ICI mesh mode, LAST of the mesh rewrites: any remaining hash /
+        round-robin shuffle exchange (not claimed by the agg/join/sort/
+        window stages above) lowers to the generic mesh all-to-all
+        repartition (exec/ici.TpuIciRepartitionExec)."""
+        from spark_rapids_tpu.config import (MESH_DEVICES,
+                                             MESH_EPOCH_BYTES,
+                                             MESH_REPARTITION_ENABLED)
+        from spark_rapids_tpu.exec.ici import (
+            TpuIciRepartitionExec,
+            mesh_exchange_schema_supported,
+        )
+        from spark_rapids_tpu.plan.nodes import (HashPartitioning,
+                                                 RoundRobinPartitioning)
+
+        node.children = [
+            TpuTransitionOverrides._rewrite_ici_repartition(c, conf)
+            if isinstance(c, TpuExec) else c for c in node.children]
+        if not _mesh_stage_on(conf, MESH_REPARTITION_ENABLED):
+            return node
+        if not (isinstance(node, TpuShuffleExchangeExec)
+                and isinstance(node.partitioning,
+                               (HashPartitioning, RoundRobinPartitioning))
+                and mesh_exchange_schema_supported(node.output)):
+            return node
+        from spark_rapids_tpu.parallel.mesh import make_mesh
+
+        return TpuIciRepartitionExec(
+            node, make_mesh(conf.get(MESH_DEVICES) or None),
+            epoch_bytes=conf.get(MESH_EPOCH_BYTES))
 
     @staticmethod
     def _coalesce_single_device_shuffle(node: TpuExec,

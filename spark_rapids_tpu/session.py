@@ -285,6 +285,19 @@ class DataFrame:
     def union(self, other: "DataFrame") -> "DataFrame":
         return DataFrame(PN.Union([self.plan, other.plan]), self.session)
 
+    def repartition(self, num_partitions: int,
+                    *cols: ColumnLike) -> "DataFrame":
+        """Dataset.repartition: hash exchange on ``cols`` (round-robin
+        when none given).  Under mesh/ICI mode this lowers to the generic
+        mesh all-to-all (exec/ici.TpuIciRepartitionExec)."""
+        if cols:
+            part = PN.HashPartitioning(
+                [_to_expr(c).resolve(self.schema) for c in cols],
+                num_partitions)
+        else:
+            part = PN.RoundRobinPartitioning(num_partitions)
+        return DataFrame(PN.Exchange(part, self.plan), self.session)
+
     def group_by(self, *cols: ColumnLike) -> "GroupedData":
         return GroupedData(self, [_to_expr(c).resolve(self.schema)
                                   for c in cols])
