@@ -88,11 +88,12 @@ class TpuGenerateExec(TpuExec):
             b = ColumnarBatch(list(cols), num_rows, batch.schema)
             ctx = EvalContext(b, ansi=self.ansi)
             arr = self.gen_expr.eval_tpu(ctx)
+            from spark_rapids_tpu.exec.join import _slots_to_probe_rows
+
             offsets = jnp.cumsum(eff.astype(jnp.int64))
             excl = offsets - eff.astype(jnp.int64)
             j = jnp.arange(out_cap, dtype=jnp.int64)
-            src = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32)
-            src = jnp.clip(src, 0, b.capacity - 1)
+            src = _slots_to_probe_rows(excl, eff, out_cap)
             k = (j - excl[src]).astype(jnp.int32)
             row_valid = j < total
             out_cols = gather_columns(src, row_valid, b.columns)

@@ -51,9 +51,23 @@ def _lex_less(a_words: List[jax.Array], b_words: List[jax.Array],
 def _multiword_searchsorted(sorted_words: List[jax.Array], n_valid,
                             query_words: List[jax.Array],
                             side: str) -> jax.Array:
-    """For each query row, the insertion point into the sorted build keys."""
+    """For each query row, the insertion point into the sorted build keys.
+
+    Two strategies (perf-critical — the probe of every hash join):
+
+    * merge-rank for large inputs: concat build+query words, ONE
+      lax.sort, exclusive cumsum of build flags at query positions.
+      lax.sort is a fused sorting network on TPU (~the cost of a few
+      elementwise passes) while each binary-search step is a full-width
+      gather; at 2M probe rows the gather loop measured ~800ms device
+      time vs ~100ms for the shared sort (round-4 microbench).
+    * the O(log n) gather loop for small inputs, where the sort's
+      fixed cost would dominate.
+    """
     n = sorted_words[0].shape[0]
     nq = query_words[0].shape[0]
+    if n >= (1 << 14) or nq >= (1 << 14):
+        return _merge_rank(sorted_words, n_valid, query_words, side)
     lo = jnp.zeros(nq, jnp.int32)
     hi = jnp.broadcast_to(n_valid.astype(jnp.int32), (nq,))
     steps = max(1, int(n).bit_length())
@@ -69,6 +83,54 @@ def _multiword_searchsorted(sorted_words: List[jax.Array], n_valid,
         lo = jnp.where(go_right, mid + 1, lo)
         hi = jnp.where(go_right, hi, mid)
     return lo
+
+
+def _merge_rank(sorted_words: List[jax.Array], n_valid,
+                query_words: List[jax.Array], side: str) -> jax.Array:
+    """searchsorted via one shared sort: rank of each query among the
+    valid sorted build keys.  Key layout per row:
+
+      (invalid, word_0..word_k, tie) + iota payload
+
+    where ``invalid`` pushes the build tail (rows >= n_valid) after every
+    query and valid build row so they are never counted, and ``tie``
+    orders a query before equal build keys for side=left (strict rank)
+    or after them for side=right (inclusive rank)."""
+    n = sorted_words[0].shape[0]
+    nq = query_words[0].shape[0]
+    b_inv = (jnp.arange(n, dtype=jnp.int32)
+             >= n_valid.astype(jnp.int32)).astype(jnp.int32)
+    q_inv = jnp.zeros(nq, jnp.int32)
+    tie_b = jnp.full(n, 0 if side == "right" else 1, jnp.int32)
+    tie_q = jnp.full(nq, 1 if side == "right" else 0, jnp.int32)
+    words = [jnp.concatenate([b_inv, q_inv])]
+    for sw, qw in zip(sorted_words, query_words):
+        words.append(jnp.concatenate([sw, qw]))
+    words.append(jnp.concatenate([tie_b, tie_q]))
+    iota = jnp.arange(n + nq, dtype=jnp.int32)
+    srt = jax.lax.sort(tuple(words) + (iota,), num_keys=len(words),
+                       is_stable=False)
+    pos = srt[-1]
+    is_build = (pos < n).astype(jnp.int32)
+    nb_before = jnp.cumsum(is_build) - is_build
+    qpos = jnp.where(is_build == 1, nq, pos - n)
+    return jnp.zeros(nq, jnp.int32).at[qpos].set(nb_before, mode="drop")
+
+
+def _slots_to_probe_rows(excl, counts, out_cap: int) -> jax.Array:
+    """probe_row[j] for every output pair slot j: scatter each matched
+    probe row's index at its first slot, then a running-max scan.
+    Replaces jnp.searchsorted(offsets, j) — the binary-search gather loop
+    measured ~700ms device time at 2M rows while scatter+scan is ~80ms
+    (round-4 microbench); scans and sorts are near-free on TPU."""
+    n = counts.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    # excl is strictly increasing over count>0 rows -> distinct slots
+    scatter_idx = jnp.where(counts > 0, excl, out_cap).astype(jnp.int64)
+    m = jnp.full(out_cap, -1, jnp.int32).at[scatter_idx].set(
+        iota, mode="drop")
+    pr = jax.lax.associative_scan(jnp.maximum, m)
+    return jnp.clip(pr, 0, jnp.int32(max(n - 1, 0)))
 
 
 def _key_words_of(key_cols: List[DeviceColumn]) -> List[jax.Array]:
@@ -227,8 +289,7 @@ class _BaseTpuJoinExec(TpuExec):
         offsets = jnp.cumsum(counts.astype(jnp.int64))
         excl = offsets - counts.astype(jnp.int64)
         j = jnp.arange(out_cap, dtype=jnp.int64)
-        probe_row = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32)
-        probe_row = jnp.clip(probe_row, 0, n - 1)
+        probe_row = _slots_to_probe_rows(excl, counts, out_cap)
         k = j - excl[probe_row]
         build_pos = lo[probe_row].astype(jnp.int64) + k
         build_cap = bwords_row_index.shape[0]

@@ -194,17 +194,30 @@ class TpuWindowExec(TpuExec):
         # rank/lead/lag) never pays for peer/segment-end indices
         seg_first = iota - pos32
 
+        def _suffix_min(marks):
+            """Running min from the right (free scan — segment_min/max
+            scatters measured ~480ms at 2M in the round-4 microbench;
+            rows are sorted so segments are contiguous runs)."""
+            return jax.lax.associative_scan(jnp.minimum, marks,
+                                            reverse=True)
+
+        def _run_last(run_starts):
+            """Index of the last VALID row of each contiguous run,
+            broadcast to its rows (garbage past the valid prefix)."""
+            nxt_start = jnp.concatenate([run_starts[1:],
+                                         jnp.ones(1, jnp.bool_)])
+            nxt_invalid = jnp.concatenate([~mask_s[1:],
+                                           jnp.ones(1, jnp.bool_)])
+            is_last = mask_s & (nxt_start | nxt_invalid)
+            return _suffix_min(jnp.where(is_last, iota, cap))
+
         def _seg_last():
-            return jax.ops.segment_max(jnp.where(mask_s, iota, -1), seg,
-                                       num_segments=cap)[seg]
+            return _run_last(starts)
 
         def _peers():
-            peer = jnp.cumsum(ochange.astype(jnp.int32)) - 1
-            peer = jnp.where(mask_s, peer, cap - 1)
-            last = jax.ops.segment_max(jnp.where(mask_s, iota, -1), peer,
-                                       num_segments=cap)[peer]
-            first = jax.ops.segment_min(
-                jnp.where(mask_s, iota, cap), peer, num_segments=cap)[peer]
+            last = _run_last(ochange)
+            first = jax.lax.associative_scan(
+                jnp.maximum, jnp.where(ochange, iota, -1))
             return first, last
 
         geom = dict(iota=iota, seg_first=seg_first,
@@ -234,11 +247,12 @@ class TpuWindowExec(TpuExec):
                                          data=vals.astype(sdt)))
         return tuple(out_cols)
 
-    def _part_sizes(self, seg, mask_s, pos_in_part, cap):
-        """Rows per partition, broadcast back to every row (sorted order)."""
-        cnt = jax.ops.segment_sum(mask_s.astype(jnp.int64), seg,
-                                  num_segments=cap)
-        return cnt[seg]
+    def _part_sizes(self, geom, pos_in_part, cap):
+        """Rows per partition, broadcast back to every row (sorted order):
+        the 0-based position of the segment's last row, plus one (free
+        gather instead of a segment_sum scatter)."""
+        sl = jnp.clip(_g(geom, "seg_last"), 0, cap - 1)
+        return pos_in_part[sl] + 1
 
     # -- frame boundaries ----------------------------------------------------
 
@@ -378,17 +392,17 @@ class TpuWindowExec(TpuExec):
             anchor = jnp.where(ochange, pos_in_part, jnp.int64(-1))
             rank = SEG.seg_scan_max(anchor, ones, starts,
                                     is_float=False)[0] + 1
-            nrows = self._part_sizes(seg, mask_s, pos_in_part, cap)
+            nrows = self._part_sizes(geom, pos_in_part, cap)
             den = jnp.maximum(nrows - 1, 1)
             return (rank - 1).astype(jnp.float64) / den, ones
         if wf.func == "cume_dist":
             last_pos = pos_in_part[_peer_last(geom)]
-            nrows = self._part_sizes(seg, mask_s, pos_in_part, cap)
+            nrows = self._part_sizes(geom, pos_in_part, cap)
             return ((last_pos + 1).astype(jnp.float64)
                     / jnp.maximum(nrows, 1)), ones
         if wf.func == "ntile":
             nb = jnp.int64(max(int(wf.buckets), 1))
-            nrows = self._part_sizes(seg, mask_s, pos_in_part, cap)
+            nrows = self._part_sizes(geom, pos_in_part, cap)
             q = nrows // nb
             r = nrows % nb
             p = pos_in_part
@@ -548,33 +562,16 @@ class TpuWindowExec(TpuExec):
         if isinstance(frame, tuple) and frame[0] == "range":
             return self._bounded_range_frame(wf, acc_vals, valid_s, seg,
                                              mask_s, cap, is_f, geom)
-        # unbounded frame: segment totals broadcast back via seg gather
-        if wf.func == "count":
-            cnt = SEG.seg_count(valid_s, seg, cap)
-            return cnt[seg], ones
-        if wf.func == "sum":
-            s, has = SEG.seg_sum(acc_vals, valid_s, seg, cap)
-            return s[seg], has[seg]
-        if wf.func == "avg":
-            s, has = SEG.seg_sum(acc_vals, valid_s, seg, cap)
-            cnt = SEG.seg_count(valid_s, seg, cap)
-            return (s.astype(jnp.float64) / jnp.maximum(cnt, 1))[seg], has[seg]
-        if wf.func == "min":
-            m, has = SEG.seg_min(acc_vals, valid_s, seg, cap, is_f)
-            return m[seg], has[seg]
-        if wf.func == "max":
-            m, has = SEG.seg_max(acc_vals, valid_s, seg, cap, is_f)
-            return m[seg], has[seg]
-        # variance family — two-pass (mean, then Σ(x−μ)²); the Σx² identity
-        # loses everything to cancellation when |x| ≫ stddev
-        x = acc_vals.astype(jnp.float64)
-        cnt = SEG.seg_count(valid_s, seg, cap)
-        s, _ = SEG.seg_sum(jnp.where(valid_s, x, 0.0), valid_s, seg, cap)
-        mean = s / jnp.maximum(cnt, 1)
-        d = jnp.where(valid_s, x - mean[seg], 0.0)
-        m2, _ = SEG.seg_sum(d * d, valid_s, seg, cap)
-        res, ok = self._var_from_m2(wf.func, m2, cnt.astype(jnp.float64))
-        return res[seg], ok[seg]
+        # unbounded frame = the segmented RUNNING scan's value at each
+        # segment's last row (one free associative scan + one gather;
+        # the previous per-function segment_* scatters measured 83-483ms
+        # each at 2M rows in the round-4 microbench).  The variance
+        # family rides the same path: the running Chan (n, mean, M2)
+        # merge is numerically stable at the segment end too.
+        res, ok = self._running_agg(wf, acc_vals, valid_s, starts, is_f,
+                                    cap)
+        sl = jnp.clip(_g(geom, "seg_last"), 0, cap - 1)
+        return res[sl], ok[sl]
 
     def _running_agg(self, wf, acc_vals, valid_s, starts, is_f, cap):
         ones = jnp.ones(cap, jnp.bool_)
