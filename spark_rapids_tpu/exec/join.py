@@ -129,7 +129,10 @@ def _slots_to_probe_rows(excl, counts, out_cap: int) -> jax.Array:
     scatter_idx = jnp.where(counts > 0, excl, out_cap).astype(jnp.int64)
     m = jnp.full(out_cap, -1, jnp.int32).at[scatter_idx].set(
         iota, mode="drop")
-    pr = jax.lax.associative_scan(jnp.maximum, m)
+    # lax.cummax lowers to a compact reduce-window; the generic
+    # associative_scan's unrolled log-depth graph took ~100s of XLA
+    # compile time at 1M rows on TPU (round-4 hang)
+    pr = jax.lax.cummax(m)
     return jnp.clip(pr, 0, jnp.int32(max(n - 1, 0)))
 
 

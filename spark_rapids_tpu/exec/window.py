@@ -185,21 +185,23 @@ class TpuWindowExec(TpuExec):
             ochange = ochange | (w != prev)
         ochange = ochange | starts
         out_cols = list(batch.columns)
-        # row position within partition (0-based), in sorted order
-        pos_in_part = SEG.seg_scan_sum(
-            jnp.ones(cap, jnp.int64), jnp.ones(cap, jnp.bool_), starts)[0] - 1
-        pos32 = pos_in_part.astype(jnp.int32)
+        # row position within partition (0-based), in sorted order:
+        # iota minus the running segment-start position (cummax is a
+        # compact reduce-window; a segmented scan costs ~20s of compile)
+        seg_first0 = jax.lax.cummax(jnp.where(starts, iota, 0))
+        pos32 = iota - seg_first0
+        pos_in_part = pos32.astype(jnp.int64)
         # frame geometry shared by all functions (sorted order); the
         # reductions/gathers are thunks so a ranking-only window (row_number/
         # rank/lead/lag) never pays for peer/segment-end indices
-        seg_first = iota - pos32
+        seg_first = seg_first0
 
         def _suffix_min(marks):
-            """Running min from the right (free scan — segment_min/max
-            scatters measured ~480ms at 2M in the round-4 microbench;
-            rows are sorted so segments are contiguous runs)."""
-            return jax.lax.associative_scan(jnp.minimum, marks,
-                                            reverse=True)
+            """Running min from the right (cheap reduce-window scan —
+            segment_min/max scatters measured ~480ms at 2M in the
+            round-4 microbench; rows are sorted so segments are
+            contiguous runs)."""
+            return jax.lax.cummin(marks, reverse=True)
 
         def _run_last(run_starts):
             """Index of the last VALID row of each contiguous run,
@@ -216,8 +218,7 @@ class TpuWindowExec(TpuExec):
 
         def _peers():
             last = _run_last(ochange)
-            first = jax.lax.associative_scan(
-                jnp.maximum, jnp.where(ochange, iota, -1))
+            first = jax.lax.cummax(jnp.where(ochange, iota, -1))
             return first, last
 
         geom = dict(iota=iota, seg_first=seg_first,
@@ -379,19 +380,20 @@ class TpuWindowExec(TpuExec):
         ones = jnp.ones(cap, jnp.bool_)
         if wf.func == "row_number":
             return pos_in_part + 1, ones
+        iota = _g(geom, "iota")
         if wf.func == "rank":
-            # rank = index of last order-change within partition + 1
-            anchor = jnp.where(ochange, pos_in_part, jnp.int64(-1))
-            last_anchor = SEG.seg_scan_max(
-                anchor, ones, starts, is_float=False)[0]
-            return last_anchor + 1, ones
+            # rank = position of the last order-change row + 1 (running
+            # max of GLOBAL row index resets naturally: partition starts
+            # are ochange rows and iota is globally increasing)
+            anchor_row = jax.lax.cummax(jnp.where(ochange, iota, -1))
+            return (pos_in_part[jnp.clip(anchor_row, 0, cap - 1)]
+                    + 1), ones
         if wf.func == "dense_rank":
             d = SEG.seg_scan_sum(ochange.astype(jnp.int64), ones, starts)[0]
             return d, ones
         if wf.func == "percent_rank":
-            anchor = jnp.where(ochange, pos_in_part, jnp.int64(-1))
-            rank = SEG.seg_scan_max(anchor, ones, starts,
-                                    is_float=False)[0] + 1
+            anchor_row = jax.lax.cummax(jnp.where(ochange, iota, -1))
+            rank = pos_in_part[jnp.clip(anchor_row, 0, cap - 1)] + 1
             nrows = self._part_sizes(geom, pos_in_part, cap)
             den = jnp.maximum(nrows - 1, 1)
             return (rank - 1).astype(jnp.float64) / den, ones

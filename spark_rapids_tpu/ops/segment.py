@@ -17,6 +17,19 @@ import jax
 import jax.numpy as jnp
 
 
+def _starts_of(seg_ids):
+    return jnp.concatenate([jnp.ones(1, jnp.bool_),
+                            seg_ids[1:] != seg_ids[:-1]])
+
+
+def _scatter_at(rows_mask, seg_ids, values, num_segments: int, fill):
+    """values at flagged rows -> their segment's slot (one scatter-set;
+    flagged rows are one-per-segment so indices are distinct)."""
+    idx = jnp.where(rows_mask, seg_ids, num_segments).astype(jnp.int32)
+    return jnp.full(num_segments, fill, values.dtype).at[idx].set(
+        values, mode="drop")
+
+
 def seg_sum(values, validity, seg_ids, num_segments: int):
     contrib = jnp.where(validity, values, jnp.zeros_like(values))
     if num_segments == 1:
@@ -37,15 +50,31 @@ def seg_count(validity, seg_ids, num_segments: int):
 
 
 def _seg_min_raw(v, seg_ids, num_segments: int):
+    """Sorted-run min: re-sort within segments by value, pick segment
+    starts, scatter to slots.  segment_min's scatter measured ~480ms at
+    2M on TPU while sorts are near-free; associative_scan alternatives
+    cost ~20s of XLA compile EACH (the round-4 compile hang), so this is
+    the compile-cheap AND runtime-cheap form."""
     if num_segments == 1:
         return jnp.min(v, keepdims=True)
-    return jax.ops.segment_min(v, seg_ids, num_segments=num_segments)
+    fill = (jnp.asarray(jnp.inf, v.dtype)
+            if jnp.issubdtype(v.dtype, jnp.floating)
+            else jnp.asarray(jnp.iinfo(v.dtype).max, v.dtype))
+    sv = jax.lax.sort((seg_ids, v), num_keys=2)[1]
+    return _scatter_at(_starts_of(seg_ids), seg_ids, sv, num_segments,
+                       fill)
 
 
 def _seg_max_raw(v, seg_ids, num_segments: int):
     if num_segments == 1:
         return jnp.max(v, keepdims=True)
-    return jax.ops.segment_max(v, seg_ids, num_segments=num_segments)
+    fill = (jnp.asarray(-jnp.inf, v.dtype)
+            if jnp.issubdtype(v.dtype, jnp.floating)
+            else jnp.asarray(jnp.iinfo(v.dtype).min, v.dtype))
+    sv = jax.lax.sort((seg_ids, v), num_keys=2)[1]
+    starts = _starts_of(seg_ids)
+    is_end = jnp.concatenate([starts[1:], jnp.ones(1, jnp.bool_)])
+    return _scatter_at(is_end, seg_ids, sv, num_segments, fill)
 
 
 def _seg_isum(v, seg_ids, num_segments: int):
@@ -107,12 +136,18 @@ def seg_max(values, validity, seg_ids, num_segments: int, is_float: bool):
 
 
 def seg_first_index(seg_ids, row_mask, num_segments: int):
-    """Index of the first row of each segment (for group-key extraction)."""
+    """Index of the first row of each segment (for group-key extraction):
+    rows are in segment order already, so the first VALID row index is
+    the value at each segment start after a (seg, ~valid, iota) sort."""
     n = seg_ids.shape[0]
     iota = jnp.arange(n, dtype=jnp.int32)
     big = jnp.int32(n)
-    v = jnp.where(row_mask, iota, big)
-    return jax.ops.segment_min(v, seg_ids, num_segments=num_segments)
+    _, inv_s, iota_s = jax.lax.sort(
+        (seg_ids, (~row_mask).astype(jnp.int32), iota), num_keys=3)
+    # a segment whose first sorted row is invalid has NO valid rows
+    vals = jnp.where(inv_s == 0, iota_s, big)
+    return _scatter_at(_starts_of(seg_ids), seg_ids, vals,
+                       num_segments, big)
 
 
 # -- segmented scans (window running frames) --------------------------------
@@ -130,10 +165,28 @@ def _seg_scan(values, starts, combine):
 
 
 def seg_scan_sum(values, validity, starts):
+    """Segmented inclusive running sum via global cumsum minus the
+    segment-base (cumsum/cummax lower to compact reduce-windows; a
+    generic associative_scan costs ~20s of XLA compile per instance on
+    TPU — round-4 finding).  Integer wrap cancels exactly in the
+    subtraction; float running sums lose at most the usual cancellation
+    (tests compare approximately)."""
+    n = values.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    first = jax.lax.cummax(jnp.where(starts, iota, 0))
+
+    def seg_csum(x):
+        cs = jnp.cumsum(x)
+        return cs - (cs[first] - x[first])
+
     contrib = jnp.where(validity, values, jnp.zeros_like(values))
-    total = _seg_scan(contrib, starts, lambda a, b: a + b)
-    cnt = _seg_scan(validity.astype(jnp.int64), starts, lambda a, b: a + b)
-    return total, cnt
+    if jnp.issubdtype(contrib.dtype, jnp.floating):
+        # the cumsum-difference cancels catastrophically when another
+        # segment holds huge values; floats keep the exact segmented scan
+        total = _seg_scan(contrib, starts, lambda a, b: a + b)
+    else:
+        total = seg_csum(contrib)   # integer wrap cancels exactly
+    return total, seg_csum(validity.astype(jnp.int64))
 
 
 def seg_scan_min(values, validity, starts, is_float: bool):
@@ -174,3 +227,19 @@ def seg_scan_max(values, validity, starts, is_float: bool):
     seen = _seg_scan(validity.astype(jnp.int32), starts,
                      lambda a, b: a + b) > 0
     return m, seen
+
+
+def seg_fold(values, validity, seg_ids, num_segments: int, op, identity):
+    """Segmented fold for non-min/max/sum combines (bit_and/or/xor): the
+    pair-scan segmented fold + one end scatter.  associative_scan costs
+    ~20s of XLA compile per instance on TPU, acceptable for these rare
+    aggregates."""
+    v = jnp.where(validity, values, jnp.asarray(identity, values.dtype))
+    starts = _starts_of(seg_ids)
+    run = _seg_scan(v, starts, op)
+    is_end = jnp.concatenate([starts[1:], jnp.ones(1, jnp.bool_)])
+    out = _scatter_at(is_end, seg_ids, run, num_segments,
+                      jnp.asarray(identity, values.dtype))
+    has = jax.ops.segment_sum(validity.astype(jnp.int32), seg_ids,
+                              num_segments=num_segments) > 0
+    return out, has
