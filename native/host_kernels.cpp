@@ -393,4 +393,96 @@ void padded_to_ragged(const uint8_t* chars, const int32_t* lengths,
     }
 }
 
+
+// Raw snappy block decompression (the default codec of most real parquet
+// files; no binding exists in the image so the format is implemented from
+// scratch — it is a simple LZ77 variant).  Returns bytes written or -1
+// on malformed input / overflow.
+int64_t snappy_uncompress(const uint8_t* in, int64_t in_len, uint8_t* out,
+                          int64_t out_cap) {
+    int64_t ip = 0;
+    // varint preamble: uncompressed length
+    uint64_t ulen = 0;
+    int shift = 0;
+    while (ip < in_len) {
+        uint8_t b = in[ip++];
+        ulen |= static_cast<uint64_t>(b & 0x7F) << shift;
+        if (!(b & 0x80)) break;
+        shift += 7;
+        if (shift > 35) return -1;
+    }
+    if (static_cast<int64_t>(ulen) > out_cap) return -1;
+    int64_t op = 0;
+    while (ip < in_len) {
+        const uint8_t tag = in[ip++];
+        const int type = tag & 3;
+        if (type == 0) {                       // literal
+            int64_t len = (tag >> 2) + 1;
+            if (len > 60) {
+                const int nb = static_cast<int>(len - 60);
+                if (ip + nb > in_len) return -1;
+                len = 0;
+                for (int k = 0; k < nb; ++k)
+                    len |= static_cast<int64_t>(in[ip + k]) << (8 * k);
+                len += 1;
+                ip += nb;
+            }
+            if (ip + len > in_len || op + len > out_cap) return -1;
+            std::memcpy(out + op, in + ip, static_cast<size_t>(len));
+            ip += len;
+            op += len;
+            continue;
+        }
+        int64_t len, offset;
+        if (type == 1) {                        // copy, 1-byte offset
+            if (ip >= in_len) return -1;
+            len = ((tag >> 2) & 0x7) + 4;
+            offset = (static_cast<int64_t>(tag >> 5) << 8) | in[ip++];
+        } else if (type == 2) {                 // copy, 2-byte offset
+            if (ip + 2 > in_len) return -1;
+            len = (tag >> 2) + 1;
+            offset = in[ip] | (static_cast<int64_t>(in[ip + 1]) << 8);
+            ip += 2;
+        } else {                                // copy, 4-byte offset
+            if (ip + 4 > in_len) return -1;
+            len = (tag >> 2) + 1;
+            offset = 0;
+            for (int k = 0; k < 4; ++k)
+                offset |= static_cast<int64_t>(in[ip + k]) << (8 * k);
+            ip += 4;
+        }
+        if (offset <= 0 || offset > op || op + len > out_cap) return -1;
+        // overlapping copies are byte-serial by definition
+        for (int64_t k = 0; k < len; ++k) {
+            out[op + k] = out[op + k - offset];
+        }
+        op += len;
+    }
+    return (op == static_cast<int64_t>(ulen)) ? op : -1;
+}
+
+
+// PLAIN BYTE_ARRAY page walk: extract the n per-value lengths from the
+// interleaved (4-byte LE length, bytes) layout.  The sequential
+// dependency makes this a host walk (C, not python) — the chars then
+// upload as one padded matrix.  Returns total string bytes or -1.
+int64_t plain_byte_array_lens(const uint8_t* buf, int64_t buf_len,
+                              int64_t n, int32_t* lens) {
+    int64_t pos = 0;
+    int64_t total = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        if (pos + 4 > buf_len) return -1;
+        uint32_t ln = static_cast<uint32_t>(buf[pos])
+            | (static_cast<uint32_t>(buf[pos + 1]) << 8)
+            | (static_cast<uint32_t>(buf[pos + 2]) << 16)
+            | (static_cast<uint32_t>(buf[pos + 3]) << 24);
+        pos += 4;
+        if (pos + ln > static_cast<uint64_t>(buf_len)) return -1;
+        lens[i] = static_cast<int32_t>(ln);
+        pos += ln;
+        total += ln;
+    }
+    return total;
+}
+
 }  // extern "C"

@@ -105,6 +105,33 @@ def _decode_string_page(page, cp, ndict):
     return scatter_present(idx, defined, ndef, n), defined
 
 
+def _decode_plain_string_page(page):
+    """PLAIN BYTE_ARRAY page -> (chars matrix, lens, identity indices,
+    validity) — VERDICT r3 Next #4.  The interleaved (len, bytes) layout
+    forces a sequential length walk (C kernel, host_kernels.cpp); the
+    char gather into the padded matrix is one vectorized numpy pass and
+    the matrix uploads once like a page-local dictionary."""
+    from spark_rapids_tpu.native import plain_byte_array_lens
+
+    n = page.num_values
+    defined, ndef = expand_defined(page)
+    lens = plain_byte_array_lens(page.value_buf, ndef)
+    buf_np = np.frombuffer(page.value_buf, np.uint8)
+    starts = (4 * (np.arange(ndef, dtype=np.int64) + 1)
+              + np.concatenate([[0], np.cumsum(lens[:-1], dtype=np.int64)])
+              if ndef else np.zeros(0, np.int64))
+    w = max(int(lens.max()) if ndef else 1, 1)
+    pos = starts[:, None] + np.arange(w, dtype=np.int64)[None, :]
+    inside = np.arange(w, dtype=np.int32)[None, :] < lens[:, None]
+    chars = np.where(inside,
+                     buf_np[np.clip(pos, 0, max(len(buf_np) - 1, 0))],
+                     0).astype(np.uint8)
+    idx = scatter_present(jnp.arange(max(ndef, 1), dtype=jnp.int32)[:ndef]
+                          if ndef else jnp.zeros(0, jnp.int32),
+                          defined, ndef, n)
+    return chars, lens, idx, defined
+
+
 def _decode_page(page, info, dt: T.DataType, dictionary):
     """One data page -> (values (n,), validity (n,)) device arrays."""
     n = page.num_values
@@ -162,17 +189,38 @@ def read_parquet_device(path: str, schema: T.StructType,
             _check_field(info, f.dataType)
             cp = read_column_pages(data, info, g.num_rows)
             if isinstance(f.dataType, T.StringType):
-                if cp.dict_chars is None:
-                    raise _Unsupported(
-                        f"column {f.name}: non-dictionary byte_array")
-                ndict = cp.dict_chars.shape[0]
+                # dict-encoded pages share the row group's dictionary;
+                # PLAIN pages (incl. parquet's dict-overflow spill) carry
+                # page-local char matrices — entries appended in row
+                # order so the assembly's base offsets line up
+                pending_dict_rows = 0
                 for page in cp.pages:
-                    idx, ok = _decode_string_page(page, cp, ndict)
+                    if page.encoding in (ENC_PLAIN_DICT, ENC_RLE_DICT):
+                        if cp.dict_chars is None:
+                            raise _Unsupported(
+                                f"column {f.name}: dictionary page "
+                                f"missing")
+                        ndict = cp.dict_chars.shape[0]
+                        idx, ok = _decode_string_page(page, cp, ndict)
+                        pending_dict_rows += page.num_values
+                    elif page.encoding == ENC_PLAIN:
+                        if pending_dict_rows:
+                            per_field_dicts[fi].append(
+                                (cp.dict_chars, cp.dict_lens,
+                                 pending_dict_rows))
+                            pending_dict_rows = 0
+                        chars, lens2, idx, ok = \
+                            _decode_plain_string_page(page)
+                        per_field_dicts[fi].append(
+                            (chars, lens2, page.num_values))
+                    else:
+                        raise _Unsupported(
+                            f"byte_array encoding {page.encoding}")
                     per_field_vals[fi].append(idx)
                     per_field_valid[fi].append(ok)
-                per_field_dicts[fi].append(
-                    (cp.dict_chars, cp.dict_lens,
-                     sum(p.num_values for p in cp.pages)))
+                if pending_dict_rows:
+                    per_field_dicts[fi].append(
+                        (cp.dict_chars, cp.dict_lens, pending_dict_rows))
                 continue
             for page in cp.pages:
                 v, ok = _decode_page(page, info, f.dataType, cp.dictionary)

@@ -17,8 +17,9 @@ decode): non-nested columns of INT32/INT64/DOUBLE/FLOAT/BOOLEAN plus
 DICTIONARY-encoded BYTE_ARRAY strings (the dominant TPC-DS scan shape:
 the small dict page parses on host into a padded char matrix, the
 index stream expands + gathers on device), data pages v1 AND v2, PLAIN or
-RLE_DICTIONARY/PLAIN_DICTIONARY encodings, UNCOMPRESSED or ZSTD codec
-(the image has no standalone snappy binding; PLAIN byte_array data pages
+RLE_DICTIONARY/PLAIN_DICTIONARY encodings, UNCOMPRESSED, SNAPPY (from-
+scratch block decoder, native/host_kernels.cpp) or ZSTD codec
+(PLAIN byte_array data pages
 interleave lengths with bytes and would need an O(values) host walk).
 """
 from __future__ import annotations
@@ -201,6 +202,10 @@ def read_footer(data: bytes) -> Tuple[List[RowGroupInfo], List[str]]:
 def _decompress(buf: bytes, codec: int, usize: int) -> bytes:
     if codec == CODEC_UNCOMPRESSED:
         return buf
+    if codec == CODEC_SNAPPY:
+        from spark_rapids_tpu.native import snappy_uncompress
+
+        return snappy_uncompress(buf, usize)
     if codec == CODEC_ZSTD:
         import zstandard
 

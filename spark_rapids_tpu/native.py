@@ -213,3 +213,97 @@ def _selftest():
 
 if __name__ == "__main__":
     _selftest()
+
+
+def snappy_uncompress(data: bytes, usize: int) -> bytes:
+    """Raw snappy block decompression (parquet's default codec).
+
+    Native C++ when built; a pure-python twin otherwise — the format is
+    a simple LZ77 variant (varint length + literal/copy tags)."""
+    lib = get_lib()
+    if lib is not None:
+        inp = np.frombuffer(data, np.uint8)
+        out = np.zeros(max(usize, 1), np.uint8)
+        fn = lib.snappy_uncompress
+        fn.restype = ctypes.c_int64
+        n = fn(_p(np.ascontiguousarray(inp), ctypes.c_uint8),
+               len(inp), _p(out, ctypes.c_uint8),
+               ctypes.c_int64(len(out)))
+        if n < 0:
+            raise ValueError("malformed snappy block")
+        return out[:n].tobytes()
+    return _snappy_uncompress_py(data, usize)
+
+
+def _snappy_uncompress_py(data: bytes, usize: int) -> bytes:
+    ip = 0
+    ulen = 0
+    shift = 0
+    n = len(data)
+    while ip < n:
+        b = data[ip]
+        ip += 1
+        ulen |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            break
+        shift += 7
+        if shift > 35:
+            raise ValueError("malformed snappy varint")
+    out = bytearray()
+    while ip < n:
+        tag = data[ip]
+        ip += 1
+        typ = tag & 3
+        if typ == 0:
+            ln = (tag >> 2) + 1
+            if ln > 60:
+                nb = ln - 60
+                ln = int.from_bytes(data[ip: ip + nb], "little") + 1
+                ip += nb
+            out += data[ip: ip + ln]
+            ip += ln
+            continue
+        if typ == 1:
+            ln = ((tag >> 2) & 0x7) + 4
+            offset = ((tag >> 5) << 8) | data[ip]
+            ip += 1
+        elif typ == 2:
+            ln = (tag >> 2) + 1
+            offset = int.from_bytes(data[ip: ip + 2], "little")
+            ip += 2
+        else:
+            ln = (tag >> 2) + 1
+            offset = int.from_bytes(data[ip: ip + 4], "little")
+            ip += 4
+        if offset <= 0 or offset > len(out):
+            raise ValueError("malformed snappy copy")
+        for _ in range(ln):
+            out.append(out[-offset])
+    if len(out) != ulen:
+        raise ValueError("snappy length mismatch")
+    return bytes(out)
+
+
+def plain_byte_array_lens(buf: bytes, n: int) -> np.ndarray:
+    """PLAIN BYTE_ARRAY page -> int32 lengths (C walk; python twin)."""
+    lens = np.zeros(max(n, 1), np.int32)
+    lib = get_lib()
+    if lib is not None and n:
+        inp = np.frombuffer(buf, np.uint8)
+        fn = lib.plain_byte_array_lens
+        fn.restype = ctypes.c_int64
+        total = fn(_p(np.ascontiguousarray(inp), ctypes.c_uint8),
+                   ctypes.c_int64(len(inp)), ctypes.c_int64(n),
+                   _p(lens, ctypes.c_int32))
+        if total < 0:
+            raise ValueError("malformed PLAIN byte_array page")
+        return lens[:n]
+    pos = 0
+    for i in range(n):
+        ln = int.from_bytes(buf[pos: pos + 4], "little")
+        pos += 4
+        if pos + ln > len(buf):
+            raise ValueError("malformed PLAIN byte_array page")
+        lens[i] = ln
+        pos += ln
+    return lens[:n]

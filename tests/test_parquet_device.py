@@ -171,3 +171,69 @@ def test_device_decode_v2_pages_numerics(tmp_path, page_version):
             col("b") > lit(5))
 
     assert_tpu_and_cpu_are_equal_collect(build, conf=_CONF)
+
+
+# -- round 4: snappy + PLAIN byte_array pages (VERDICT r3 Next #4) ----------
+
+
+def test_snappy_plain_string_pages(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.io.parquet_device import read_parquet_device
+
+    strs = ["alpha", None, "", "beéta", "y" * 33] * 60
+    tbl = pa.table({"s": pa.array(strs, pa.string()),
+                    "v": pa.array(range(300), pa.int64())})
+    p = str(tmp_path / "sp.parquet")
+    pq.write_table(tbl, p, compression="snappy", use_dictionary=False)
+    schema = T.StructType([T.StructField("s", T.STRING, True),
+                           T.StructField("v", T.LONG, False)])
+    b = read_parquet_device(p, schema)
+    host = b.columns[0].to_host(b.num_rows).to_pylist()
+    assert host == strs
+    assert b.columns[1].to_host(b.num_rows).to_pylist() == list(range(300))
+
+
+def test_snappy_numeric_pages(tmp_path):
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.io.parquet_device import read_parquet_device
+
+    rng = np.random.default_rng(3)
+    vals = rng.integers(-10**9, 10**9, 4000)
+    fl = rng.random(4000)
+    tbl = pa.table({"i": pa.array(vals, pa.int64()),
+                    "f": pa.array(fl, pa.float64())})
+    p = str(tmp_path / "sn.parquet")
+    pq.write_table(tbl, p, compression="snappy")
+    schema = T.StructType([T.StructField("i", T.LONG, False),
+                           T.StructField("f", T.DOUBLE, False)])
+    b = read_parquet_device(p, schema)
+    import numpy as np2
+    got = np2.asarray(b.columns[0].data)[:4000]
+    assert (got == vals).all()
+
+
+def test_snappy_through_scan_session(tmp_path):
+    """The full scan path decodes a snappy file on device and matches
+    the oracle."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import sys
+    sys.path.insert(0, "tests")
+    from asserts import assert_tpu_and_cpu_are_equal_collect
+
+    tbl = pa.table({"k": pa.array([1, 2, 1, 3, 2] * 40, pa.int32()),
+                    "s": pa.array(["a", "bb", None, "dd", "e"] * 40,
+                                  pa.string())})
+    p = str(tmp_path / "scan.parquet")
+    pq.write_table(tbl, p, compression="snappy", use_dictionary=False)
+
+    def build(s):
+        return s.read.parquet(p)
+
+    assert_tpu_and_cpu_are_equal_collect(build)
