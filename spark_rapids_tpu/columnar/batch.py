@@ -161,17 +161,24 @@ class ColumnarBatch:
             dtype = cols[0].dtype
             if cols[0].is_struct:
                 validity = jnp.zeros(cap, jnp.bool_)
+                lengths = (jnp.zeros(cap, jnp.int32)
+                           if cols[0].lengths is not None else None)
                 off = 0
                 for n, c in zip(rows, cols):
                     if n == 0:
                         continue
                     validity = jax.lax.dynamic_update_slice(
                         validity, c.validity[:n], (off,))
+                    if lengths is not None:
+                        lengths = jax.lax.dynamic_update_slice(
+                            lengths, c.lengths[:n].astype(jnp.int32),
+                            (off,))
                     off += n
                 kids = tuple(
                     _concat_col([c.children[k] for c in cols])
                     for k in range(len(cols[0].children)))
-                return DeviceColumn(dtype, validity, children=kids)
+                return DeviceColumn(dtype, validity, lengths=lengths,
+                                    children=kids)
             if cols[0].is_string_array:
                 ew = max(c.ewidth for c in cols)
                 w = max(c.width for c in cols)

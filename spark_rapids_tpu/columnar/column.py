@@ -168,9 +168,11 @@ class DeviceColumn:
                                 lengths=self.lengths[idx],
                                 elem_valid=self.elem_valid[idx])
         if self.is_struct:
-            return DeviceColumn(self.dtype, self.validity[idx],
-                                children=tuple(c.gather(idx)
-                                               for c in self.children))
+            return DeviceColumn(
+                self.dtype, self.validity[idx],
+                lengths=None if self.lengths is None
+                else self.lengths[idx],
+                children=tuple(c.gather(idx) for c in self.children))
         return DeviceColumn(self.dtype, self.validity[idx],
                             data=self.data[idx])
 
@@ -231,8 +233,13 @@ class DeviceColumn:
                                                 width_buckets=width_buckets,
                                                 row_buckets=row_buckets)
                          for c in h.children)
+            lengths = None
+            if h.lengths is not None:      # entries layout (array<struct>)
+                lp = np.zeros(cap, np.int32)
+                lp[:n] = h.lengths[:n]
+                lengths = jnp.asarray(lp)
             return DeviceColumn(dtype=h.dtype, validity=jnp.asarray(validity),
-                                children=kids)
+                                lengths=lengths, children=kids)
         data = np.zeros((cap,) + h.data.shape[1:], dtype=h.data.dtype)
         data[:n] = h.data[:n]
         return DeviceColumn(dtype=h.dtype, validity=jnp.asarray(validity),
@@ -257,9 +264,13 @@ class DeviceColumn:
                               lengths=np.asarray(self.lengths)[:num_rows],
                               elem_valid=np.asarray(self.elem_valid)[:num_rows])
         if self.is_struct:
-            return HostColumn(dtype=self.dtype, validity=validity,
-                              children=[c.to_host(num_rows)
-                                        for c in self.children])
+            # entries layout (array<struct>): ArrayType with per-field
+            # array-column children sharing ``lengths``
+            return HostColumn(
+                dtype=self.dtype, validity=validity,
+                lengths=None if self.lengths is None
+                else np.asarray(self.lengths)[:num_rows],
+                children=[c.to_host(num_rows) for c in self.children])
         return HostColumn(dtype=self.dtype, validity=validity,
                           data=np.asarray(self.data)[:num_rows])
 
@@ -284,9 +295,12 @@ class DeviceColumn:
                                     lengths=self.lengths[:capacity],
                                     elem_valid=self.elem_valid[:capacity])
             if self.is_struct:
-                return DeviceColumn(self.dtype, self.validity[:capacity],
-                                    children=tuple(c.slice_to(capacity)
-                                                   for c in self.children))
+                return DeviceColumn(
+                    self.dtype, self.validity[:capacity],
+                    lengths=None if self.lengths is None
+                    else self.lengths[:capacity],
+                    children=tuple(c.slice_to(capacity)
+                                   for c in self.children))
             return DeviceColumn(self.dtype, self.validity[:capacity],
                                 data=self.data[:capacity])
         pad = capacity - self.capacity
@@ -325,6 +339,9 @@ class DeviceColumn:
         if self.is_struct:
             return DeviceColumn(
                 self.dtype, validity,
+                lengths=None if self.lengths is None
+                else jnp.concatenate(
+                    [self.lengths, jnp.zeros(pad, jnp.int32)]),
                 children=tuple(c.slice_to(capacity) for c in self.children))
         return DeviceColumn(
             self.dtype, validity,
@@ -460,6 +477,35 @@ class HostColumn:
                     chars[i, j, :len(b)] = np.frombuffer(b, np.uint8)
             return HostColumn(dtype, validity, chars=chars, data=elens,
                               lengths=lengths, elem_valid=ev)
+        if isinstance(dtype, T.ArrayType) and isinstance(
+                dtype.elementType, T.StructType):
+            # entries layout: decompose rows of [{f1,f2}|tuple, ...] into
+            # one ARRAY child per struct field sharing ``lengths``
+            et = dtype.elementType
+            lengths = np.zeros(n, np.int32)
+            for i, v in enumerate(values):
+                if v is not None:
+                    lengths[i] = len(v)
+            kids = []
+            for fi, f in enumerate(et.fields):
+                rows = []
+                for v in values:
+                    if v is None:
+                        rows.append(None)
+                        continue
+                    fr = []
+                    for e in v:
+                        if e is None:
+                            fr.append(None)
+                        elif isinstance(e, dict):
+                            fr.append(e.get(f.name))
+                        else:
+                            fr.append(e[fi])
+                    rows.append(fr)
+                kids.append(HostColumn.from_pylist(
+                    rows, T.ArrayType(f.dataType)))
+            return HostColumn(dtype, validity, lengths=lengths,
+                              children=kids)
         if isinstance(dtype, T.ArrayType):
             elem_host = HostColumn.from_pylist(
                 [e for v in values if v is not None for e in v],
@@ -560,6 +606,21 @@ class HostColumn:
             return [dict(zip(keys[i], vals[i])) if self.validity[i]
                     else None for i in range(self.num_rows)]
         if self.is_struct:
+            if isinstance(self.dtype, T.ArrayType):
+                # entries layout: children are per-field ARRAY columns
+                kid_rows = [c.to_pylist() for c in self.children]
+                out = []
+                for i in range(self.num_rows):
+                    if not self.validity[i]:
+                        out.append(None)
+                        continue
+                    ln = int(self.lengths[i])
+                    out.append([
+                        tuple((kr[i][j] if kr[i] is not None
+                               and j < len(kr[i]) else None)
+                              for kr in kid_rows)
+                        for j in range(ln)])
+                return out
             kid_vals = [c.to_pylist() for c in self.children]
             return [tuple(kv[i] for kv in kid_vals) if self.validity[i]
                     else None for i in range(self.num_rows)]

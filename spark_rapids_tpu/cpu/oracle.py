@@ -872,7 +872,9 @@ def _cast_one(v, src: T.DataType, dst: T.DataType, ansi: bool):
                 return f"{base}.{frac}"
             return base
         if isinstance(src, (T.FloatType, T.DoubleType)):
-            raise _CastNull  # gated off at tag time; oracle mirrors fallback
+            from spark_rapids_tpu.expr.cast import java_fp_to_string
+
+            return java_fp_to_string(float(v), isinstance(src, T.FloatType))
         return str(int(v))
     if is_int(dst):
         if isinstance(src, T.StringType):
@@ -907,10 +909,12 @@ def _cast_one(v, src: T.DataType, dst: T.DataType, ansi: bool):
         return wrapped
     if isinstance(dst, (T.FloatType, T.DoubleType)):
         if isinstance(src, T.StringType):
-            try:
-                return float(str(v).strip())
-            except ValueError:
+            from spark_rapids_tpu.expr.cast import spark_string_to_double
+
+            f = spark_string_to_double(str(v))
+            if f is None:
                 raise _CastNull
+            return f
         if isinstance(src, T.DecimalType):
             return float(pydec.Decimal(int(v)).scaleb(-src.scale))
         return float(v)
@@ -3889,6 +3893,327 @@ def _h_array_size(e, cols, n, ansi):
     return CpuCol(T.INT, out, a.validity.copy())
 
 
+
+
+def _h_hive_hash(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+
+    def one(c, i):
+        if not c.validity[i]:
+            return 0
+        v = c.values[i]
+        dt = c.dtype
+        if isinstance(dt, T.BooleanType):
+            return 1 if v else 0
+        if isinstance(dt, T.LongType):
+            u = int(v) & _M64
+            return _to_i32((u ^ (u >> 32)) & _M32)
+        if isinstance(dt, T.FloatType):
+            import struct
+
+            f = np.float32(v)
+            bits = struct.unpack("<i", struct.pack("<f", float(f)))[0]
+            if math.isnan(float(f)):
+                bits = 0x7FC00000
+            return _to_i32(bits & _M32)
+        if isinstance(dt, T.DoubleType):
+            import struct
+
+            bits = struct.unpack("<q", struct.pack("<d", float(v)))[0]
+            if math.isnan(float(v)):
+                bits = 0x7FF8000000000000
+            u = bits & _M64
+            return _to_i32((u ^ (u >> 32)) & _M32)
+        if isinstance(dt, T.StringType):
+            h = 0
+            for b in str(v).encode("utf-8"):
+                sb = b - 256 if b >= 128 else b   # Java signed bytes
+                h = (h * 31 + sb) & _M32
+            return _to_i32(h)
+        return _to_i32(int(v) & _M32)
+
+    out = np.zeros(n, np.int32)
+    for i in range(n):
+        h = 0
+        for c in kids:
+            h = (h * 31 + one(c, i)) & _M32
+        out[i] = _to_i32(h)
+    return CpuCol(T.INT, out, np.ones(n, np.bool_))
+
+
+def _to_i32(u):
+    return u - (1 << 32) if u >= (1 << 31) else u
+
+
+def _h_array_insert(e, cols, n, ansi):
+    arr, _p, item = _kids(e, cols, n, ansi)
+    pos = int(e.pos_literal)
+    vals = np.empty(n, object)
+    validity = arr.validity.copy()
+    for i in range(n):
+        if not arr.validity[i]:
+            continue
+        a = list(arr.values[i])
+        v = item.row(i)
+        L = len(a)
+        if pos > 0:
+            idx = pos - 1
+            if idx >= L:
+                vals[i] = a + [None] * (idx - L) + [v]
+            else:
+                vals[i] = a[:idx] + [v] + a[idx:]
+        else:
+            # Spark 3.5 default: -1 appends (0-based position L + pos + 1)
+            idx = L + pos + 1
+            if idx < 0:
+                vals[i] = [v] + [None] * (-idx) + a
+            else:
+                vals[i] = a[:idx] + [v] + a[idx:]
+    return CpuCol(e.dataType, vals, validity)
+
+
+def _h_flatten(e, cols, n, ansi):
+    vals = np.empty(n, object)
+    validity = np.ones(n, np.bool_)
+    if getattr(e, "_absorbed", False):
+        members = [eval_expr(m, cols, n, ansi) for m in e.children]
+        for i in range(n):
+            if any(not m.validity[i] for m in members):
+                validity[i] = False
+                continue
+            out = []
+            for m in members:
+                out.extend(m.values[i])
+            vals[i] = out
+        return CpuCol(e.dataType, vals, validity)
+    # general array<array> child (CPU-only shape): a null inner array
+    # nulls the whole result, matching Spark flatten
+    (c,) = _kids(e, cols, n, ansi)
+    for i in range(n):
+        if not c.validity[i]:
+            validity[i] = False
+            continue
+        out = []
+        bad = False
+        for sub in c.values[i]:
+            if sub is None:
+                bad = True
+                break
+            out.extend(sub)
+        if bad:
+            validity[i] = False
+        else:
+            vals[i] = out
+    return CpuCol(e.dataType, vals, validity)
+
+
+def _h_str_to_map(e, cols, n, ansi):
+    import re as _re
+
+    kids = _kids(e, cols, n, ansi)
+    rp = _re.compile(_java_regex_to_python(e._pair))
+    rk = _re.compile(_java_regex_to_python(e._kv))
+    vals = np.empty(n, object)
+    validity = kids[0].validity.copy()
+    for i in range(n):
+        if not validity[i]:
+            continue
+        m = {}
+        for entry in rp.split(str(kids[0].values[i])):
+            parts = rk.split(entry, maxsplit=1)
+            if parts[0] in m:
+                raise RuntimeError("Duplicate map key was found")
+            m[parts[0]] = parts[1] if len(parts) > 1 else None
+        vals[i] = m
+    return CpuCol(e.dataType, vals, validity)
+
+
+def _h_schema_of_json(e, cols, n, ansi):
+    s = e._folded()
+    return CpuCol(T.STRING, np.array([s] * n, object),
+                  np.ones(n, np.bool_))
+
+
+def _h_xpath(e, cols, n, ansi):
+    from spark_rapids_tpu.expr.xpath import xpath_eval
+
+    kids = _kids(e, cols, n, ansi)
+    path = e._path()
+    vals = np.empty(n, object)
+    validity = np.zeros(n, np.bool_)
+    for i in range(n):
+        v = kids[0].row(i)
+        res = e._convert(xpath_eval(v, path)) if path is not None else None
+        if res is not None:
+            vals[i] = res
+            validity[i] = True
+    return CpuCol(e.dataType, vals, validity)
+
+
+
+
+def _h_try_arith(e, cols, n, ansi):
+    """try_add/subtract/multiply/divide: the ANSI op with per-row
+    errors-as-null (twin of arithmetic._TryMixin)."""
+    base = type(e).__name__[3:]
+    l, r = _kids(e, cols, n, ansi)
+    dt = e.dataType
+    validity = (l.validity & r.validity).copy()
+    if isinstance(dt, (T.FloatType, T.DoubleType)):
+        a = np.where(validity, l.values.astype(np.float64), 0.0)
+        b = np.where(validity, r.values.astype(np.float64), 1.0)
+        if base == "Divide":
+            zero = b == 0.0
+            validity &= ~zero
+            out = a / np.where(zero, 1.0, b)
+        elif base == "Add":
+            out = a + b
+        elif base == "Subtract":
+            out = a - b
+        else:
+            out = a * b
+        return CpuCol(dt, out.astype(T.storage_dtype(dt)), validity)
+    if isinstance(dt, T.DecimalType):
+        lt, rt = e.left.dataType, e.right.dataType
+        out = np.zeros(n, object)
+        for i in range(n):
+            if not validity[i]:
+                out[i] = 0
+                continue
+            a, b = int(l.values[i]), int(r.values[i])
+            if base in ("Add", "Subtract"):
+                sa = a * 10 ** (dt.scale - lt.scale)
+                sb = b * 10 ** (dt.scale - rt.scale)
+                v = sa + sb if base == "Add" else sa - sb
+            elif base == "Multiply":
+                v = a * b
+            else:
+                if b == 0:
+                    validity[i] = False
+                    out[i] = 0
+                    continue
+                from decimal import ROUND_HALF_UP, Decimal, localcontext
+
+                with localcontext() as lc:
+                    lc.prec = 78
+                    q = (Decimal(a).scaleb(-lt.scale)
+                         / Decimal(b).scaleb(-rt.scale))
+                    v = int(q.scaleb(dt.scale).quantize(
+                        Decimal(1), rounding=ROUND_HALF_UP))
+            if abs(v) >= 10 ** dt.precision:
+                validity[i] = False
+                v = 0
+            out[i] = v
+        return CpuCol(dt, out, validity)
+    out = np.zeros(n, T.storage_dtype(dt))
+    lo, rng = _JMIN[type(dt)], _JRANGE[type(dt)]
+    for i in range(n):
+        if not validity[i]:
+            continue
+        a, b = int(l.values[i]), int(r.values[i])
+        v = a + b if base == "Add" else a - b if base == "Subtract" \
+            else a * b
+        wrapped = ((v - lo) % rng) + lo
+        if wrapped != v:
+            validity[i] = False
+        else:
+            out[i] = v
+    return CpuCol(dt, out, validity)
+
+
+def _h_bit_get(e, cols, n, ansi):
+    l, r = _kids(e, cols, n, ansi)
+    bits = {T.ByteType: 8, T.ShortType: 16, T.IntegerType: 32,
+            T.LongType: 64}[type(e.left.dataType)]
+    validity = l.validity & r.validity
+    out = np.zeros(n, np.int8)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        pos = int(r.values[i])
+        if pos < 0 or pos >= bits:
+            raise RuntimeError(
+                f"Invalid bit position: must be in [0, {bits})")
+        out[i] = (int(l.values[i]) >> pos) & 1
+    return CpuCol(T.BYTE, out, validity)
+
+
+def _h_assert_true(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    for i in range(n):
+        if not (c.validity[i] and bool(c.values[i])):
+            raise RuntimeError(
+                f"'{e.child.sql_string()}' is not true!")
+    return CpuCol(T.NullType(), np.zeros(n, np.int8),
+                  np.zeros(n, np.bool_))
+
+
+def _h_typeof(e, cols, n, ansi):
+    s = e.child.dataType.simpleString
+    return CpuCol(T.STRING, np.array([s] * n, object),
+                  np.ones(n, np.bool_))
+
+
+
+
+
+def _h_map_entries(e, cols, n, ansi):
+    (m,) = _kids(e, cols, n, ansi)
+    vals = np.empty(n, object)
+    for i in range(n):
+        if m.validity[i]:
+            vals[i] = [tuple(kv) for kv in m.values[i].items()]
+    return CpuCol(e.dataType, vals, m.validity.copy())
+
+
+def _h_arrays_zip(e, cols, n, ansi):
+    kids = _kids(e, cols, n, ansi)
+    validity = _null_prop_validity(kids)
+    vals = np.empty(n, object)
+    for i in range(n):
+        if not validity[i]:
+            continue
+        arrs = [k.values[i] for k in kids]
+        ln = max((len(a) for a in arrs), default=0)
+        vals[i] = [tuple(a[j] if j < len(a) else None for a in arrs)
+                   for j in range(ln)]
+    return CpuCol(e.dataType, vals, validity)
+
+
+def _h_map_zip_with(e, cols, n, ansi):
+    m1 = eval_expr(e.children[0], cols, n, ansi)
+    m2 = eval_expr(e.children[1], cols, n, ansi)
+    idx, ks, v1s, v2s = [], [], [], []
+    validity = m1.validity & m2.validity
+    for i in range(n):
+        if not validity[i]:
+            continue
+        d1 = m1.values[i] or {}
+        d2 = m2.values[i] or {}
+        keys = list(d1.keys()) + [k for k in d2 if k not in d1]
+        for k in keys:
+            idx.append(i)
+            ks.append(k)
+            v1s.append(d1.get(k))
+            v2s.append(d2.get(k))
+    cnt = len(idx)
+    outer = [CpuCol(c.dtype, c.values[idx], c.validity[idx]) for c in cols]
+    m1t = e.children[0]._dataType
+    m2t = e.children[1]._dataType
+    kcol = CpuCol.from_objs(ks, m1t.keyType)
+    c1 = CpuCol.from_objs(v1s, m1t.valueType)
+    c2 = CpuCol.from_objs(v2s, m2t.valueType)
+    res = eval_expr(e.body, outer + [kcol, c1, c2], cnt, ansi)
+    per_row = [{} for _ in range(n)]
+    for k, i in enumerate(idx):
+        per_row[i][ks[k]] = res.row(k)
+    vals = np.empty(n, object)
+    for i in range(n):
+        if validity[i]:
+            vals[i] = per_row[i]
+    return CpuCol(e.dataType, vals, validity)
+
+
 _HANDLERS = {
     "BoundReference": _h_bound,
     "Literal": _h_literal,
@@ -3965,6 +4290,19 @@ _HANDLERS = {
     "RegExpSubStr": _h_regexp_span, "SplitPart": _h_split_part,
     "Get": _h_get, "ArraySize": _h_array_size,
     "Murmur3Hash": _h_hashexpr, "XxHash64": _h_hashexpr,
+    "HiveHash": _h_hive_hash,
+    "TryAdd": _h_try_arith, "TrySubtract": _h_try_arith,
+    "TryMultiply": _h_try_arith, "TryDivide": _h_try_arith,
+    "BitGet": _h_bit_get, "AssertTrue": _h_assert_true,
+    "TypeOf": _h_typeof,
+    "ArrayInsert": _h_array_insert,
+    "Flatten": _h_flatten,
+    "StrToMap": _h_str_to_map,
+    "SchemaOfJson": _h_schema_of_json,
+    "XPathList": _h_xpath, "XPathString": _h_xpath,
+    "XPathBoolean": _h_xpath, "XPathShort": _h_xpath,
+    "XPathInt": _h_xpath, "XPathLong": _h_xpath,
+    "XPathFloat": _h_xpath, "XPathDouble": _h_xpath,
     "Reverse": _h_reverse, "InitCap": _h_initcap, "Ascii": _h_ascii,
     "Chr": _h_chr, "StringReplace": _h_replace,
     "StringTranslate": _h_translate, "StringInstr": _h_instr,
@@ -4038,6 +4376,9 @@ _HANDLERS = {
     "TransformValues": _h_transform_values,
     "MapFilter": _h_map_filter,
     "ZipWith": _h_zip_with,
+    "MapZipWith": _h_map_zip_with,
+    "MapEntries": _h_map_entries,
+    "ArraysZip": _h_arrays_zip,
     "MapFromArrays": _h_map_from_arrays,
     "MapConcat": _h_map_concat,
     "MapContainsKey": _h_map_contains_key,
@@ -4467,8 +4808,11 @@ def _agg_partial(a: PN.AggregateExpression, ac: Optional[CpuCol],
         bufs = [[] for _ in suffixes]
         mvalid = np.ones(ng, np.bool_)
         for gi in range(ng):
-            if a.func in PN.COVARIANCE_FUNCS:
-                pairs = _cov_pairs(ac, rows_per_group[gi])
+            if a.func in PN.COVARIANCE_FUNCS \
+                    or a.func in PN.REGR_FUNCS:
+                pair_ac = ((ac[1], ac[0])
+                           if a.func in PN.REGR_FUNCS else ac)
+                pairs = _cov_pairs(pair_ac, rows_per_group[gi])
                 stats = _cov_stats(pairs)
                 nvals = stats[0]
             else:
@@ -4583,9 +4927,10 @@ def _agg_final(a: PN.AggregateExpression, ac, rows_per_group) -> CpuCol:
             out[gi] = v
             valid[gi] = ok
         return CpuCol(a.result_type, out, valid)
-    if a.func in PN.COVARIANCE_FUNCS:
+    if a.func in PN.COVARIANCE_FUNCS or a.func in PN.REGR_FUNCS:
         cn, cx, cy, cc = ac[:4]
-        is_corr = a.func == "corr"
+        is_regr = a.func in PN.REGR_FUNCS
+        is_corr = a.func == "corr" or is_regr
         out = np.zeros(ng, np.float64)
         valid = np.ones(ng, np.bool_)
         for gi in range(ng):
@@ -4608,9 +4953,16 @@ def _agg_final(a: PN.AggregateExpression, ac, rows_per_group) -> CpuCol:
                 if is_corr:
                     xm2 += float(ac[4].values[i]) + ni * dxi * dxi
                     ym2 += float(ac[5].values[i]) + ni * dyi * dyi
-            v, ok = _finalize_cov(a.func, ntot, ck, xm2, ym2)
+            if is_regr:
+                v, ok = _finalize_regr(a.func, ntot, xavg, yavg, ck,
+                                       xm2, ym2)
+            else:
+                v, ok = _finalize_cov(a.func, ntot, ck, xm2, ym2)
             out[gi] = v
             valid[gi] = ok
+        if a.func == "regr_count":
+            return CpuCol(T.LONG, out.astype(np.int64),
+                          np.ones(ng, np.bool_))
         return CpuCol(a.result_type, out, valid)
     if a.func == "approx_count_distinct":
         out = np.zeros(ng, np.int64)
@@ -4628,7 +4980,10 @@ def _agg_final(a: PN.AggregateExpression, ac, rows_per_group) -> CpuCol:
         return CpuCol(a.result_type, out, np.ones(ng, np.bool_))
     merge_func = {"count": "sum", "count_star": "sum", "sum": "sum",
                   "min": "min", "max": "max", "first": "first",
-                  "last": "last", "count_if": "sum"}[a.func]
+                  "last": "last", "count_if": "sum",
+                  "bool_and": "min", "bool_or": "max",
+                  "any_value": "first", "bit_and": "bit_and",
+                  "bit_or": "bit_or", "bit_xor": "bit_xor"}[a.func]
     merged = PN.AggregateExpression(merge_func, None, a.result_name,
                                     a.result_type)
     vals, valid = _agg_one(merged, ac, rows_per_group, False)
@@ -4649,10 +5004,42 @@ def _finalize_variance(func: str, n: float, m2: float):
     return (v if func.startswith("var") else math.sqrt(v)), True
 
 
+def _finalize_regr(func, n, xa, ya, ck, xm2, ym2):
+    """-> (value, valid); Spark regr_* null/zero semantics."""
+    if func == "regr_count":
+        return float(n), True
+    if n <= 0:
+        return 0.0, False
+    if func == "regr_avgx":
+        return xa, True
+    if func == "regr_avgy":
+        return ya, True
+    if func == "regr_sxx":
+        return xm2, True
+    if func == "regr_syy":
+        return ym2, True
+    if func == "regr_sxy":
+        return ck, True
+    if xm2 == 0.0:
+        return 0.0, False
+    slope = ck / xm2
+    if func == "regr_slope":
+        return slope, True
+    if func == "regr_intercept":
+        return ya - slope * xa, True
+    if ym2 == 0.0:
+        return 1.0, True
+    return (ck * ck) / (xm2 * ym2), True
+
+
 def _agg_one(a: PN.AggregateExpression, ac: Optional[CpuCol],
              rows_per_group, ansi):
     ng = len(rows_per_group)
     func = a.func
+    if func == "any_value":
+        func = "first"
+    if func in ("bool_and", "bool_or"):
+        func = "min" if func == "bool_and" else "max"
     if func == "count_star":
         return (np.array([len(r) for r in rows_per_group], np.int64),
                 np.ones(ng, np.bool_))
@@ -4680,13 +5067,22 @@ def _agg_one(a: PN.AggregateExpression, ac: Optional[CpuCol],
     out = []
     valid = np.ones(ng, np.bool_)
     dec = isinstance(a.result_type, T.DecimalType)
-    if isinstance(ac, tuple):  # covariance family: (x, y) inputs
+    if isinstance(ac, tuple):  # covariance/regr family: two inputs
+        is_regr = func in PN.REGR_FUNCS
         for gi in range(ng):
-            pairs = _cov_pairs(ac, rows_per_group[gi])
+            # regr_f(y, x): the independent x is the SECOND argument
+            pair_ac = (ac[1], ac[0]) if is_regr else ac
+            pairs = _cov_pairs(pair_ac, rows_per_group[gi])
             n_, xa, ya, ck, xm2, ym2 = _cov_stats(pairs)
-            v, ok = _finalize_cov(func, n_, ck, xm2, ym2)
+            if is_regr:
+                v, ok = _finalize_regr(func, n_, xa, ya, ck, xm2, ym2)
+            else:
+                v, ok = _finalize_cov(func, n_, ck, xm2, ym2)
             out.append(v if ok else None)
             valid[gi] = ok
+        if func == "regr_count":
+            return (np.array([int(v) if v is not None else 0
+                              for v in out], np.int64), valid)
         return (np.array([v if v is not None else 0.0 for v in out],
                          np.float64), valid)
     for gi in range(ng):
@@ -4760,7 +5156,7 @@ def _agg_one(a: PN.AggregateExpression, ac: Optional[CpuCol],
             else:
                 out.append(None)
                 valid[gi] = False
-        elif func == "percentile":
+        elif func in ("percentile", "median"):
             xs = _percentile_sorted(ac, idxs)
             if not xs:
                 out.append(None)
@@ -4768,7 +5164,7 @@ def _agg_one(a: PN.AggregateExpression, ac: Optional[CpuCol],
                 continue
             pscale = (10.0 ** -ac.dtype.scale
                       if isinstance(ac.dtype, T.DecimalType) else 1.0)
-            p = float(a.args[0])
+            p = 0.5 if func == "median" else float(a.args[0])
             r = p * (len(xs) - 1)
             lo, hi = int(math.floor(r)), int(math.ceil(r))
             frac = r - lo
@@ -4782,6 +5178,13 @@ def _agg_one(a: PN.AggregateExpression, ac: Optional[CpuCol],
                 continue
             p = float(a.args[0])
             out.append(xs[int(math.floor(p * (len(xs) - 1)))])
+        elif func in ("bit_and", "bit_or", "bit_xor"):
+            acc = -1 if func == "bit_and" else 0
+            for i in idxs:
+                v = int(ac.values[i])
+                acc = acc & v if func == "bit_and" else (
+                    acc | v if func == "bit_or" else acc ^ v)
+            out.append(acc)
         else:
             raise NotImplementedError(func)
     if dec or isinstance(a.result_type, T.StringType):

@@ -24,6 +24,7 @@ from spark_rapids_tpu.perfcounters import tpu_jit
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
+from spark_rapids_tpu.plan.nodes import REGR_FUNCS as PN_REGR_FUNCS
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar.column import DeviceColumn
 from spark_rapids_tpu.exec.base import TpuExec
@@ -422,6 +423,10 @@ class TpuHashAggregateExec(TpuExec):
         max->max, first->first, last->last, avg(sum,count)->(sum,sum)."""
         func = ("count" if a.func in ("count_star", "count_if")
                 else a.func)
+        if func == "any_value":
+            func = "first"
+        if func in ("bool_and", "bool_or"):
+            func = "min" if func == "bool_and" else "max"
         if func in VARIANCE_FUNCS:
             cn, ca, cm = (c if perm is None else _gather_col(c, perm)
                           for c in bufs)
@@ -442,7 +447,7 @@ class TpuHashAggregateExec(TpuExec):
                 out.append(DeviceColumn(f.dataType, group_valid & nz,
                                         data=arr))
             return out
-        if func in COVARIANCE_FUNCS:
+        if func in COVARIANCE_FUNCS or func in PN_REGR_FUNCS:
             cs = [c if perm is None else _gather_col(c, perm) for c in bufs]
             merged = _merge_cov_bufs(cs, mask_sorted, seg, nseg)
             ntot, nz = merged[0], merged[1]
@@ -508,6 +513,15 @@ class TpuHashAggregateExec(TpuExec):
                 out.append(DeviceColumn(f.dataType, g.validity & group_valid,
                                         data=g.data, chars=g.chars,
                                         lengths=g.lengths))
+            elif func in ("bit_and", "bit_or", "bit_xor"):
+                op = {"bit_and": (lambda x, y: x & y, -1),
+                      "bit_or": (lambda x, y: x | y, 0),
+                      "bit_xor": (lambda x, y: x ^ y, 0)}[func]
+                m, has = SEG.seg_fold(cs.data, validity, seg, nseg,
+                                      op[0], op[1])
+                out.append(DeviceColumn(
+                    f.dataType, group_valid & has,
+                    data=m.astype(T.storage_dtype(f.dataType))))
             else:
                 raise NotImplementedError(f"merge for {func}")
         return out
@@ -697,6 +711,10 @@ class TpuHashAggregateExec(TpuExec):
         func = a.func
         if func == "count_star":
             func = "count"
+        if func == "any_value":
+            func = "first"          # Spark AnyValue == First(ignoreNulls=F)
+        if func in ("bool_and", "bool_or"):
+            func = "min" if func == "bool_and" else "max"
         out = []
         if func in VARIANCE_FUNCS:
             return self._eval_variance(a, fields, ctx, perm, seg, mask_sorted,
@@ -705,9 +723,20 @@ class TpuHashAggregateExec(TpuExec):
             return self._eval_higher_moment(a, fields, ctx, perm, seg,
                                             mask_sorted, cap, group_valid,
                                             nseg)
-        if func in COVARIANCE_FUNCS:
+        if func in COVARIANCE_FUNCS or func in PN_REGR_FUNCS:
             return self._eval_covariance(a, fields, ctx, perm, seg,
                                          mask_sorted, cap, group_valid, nseg)
+        if func in ("bit_and", "bit_or", "bit_xor"):
+            (f,) = fields
+            c = self._input_col(a, ctx, perm)
+            validity = c.validity & mask_sorted
+            op = {"bit_and": (lambda x, y: x & y, -1),
+                  "bit_or": (lambda x, y: x | y, 0),
+                  "bit_xor": (lambda x, y: x ^ y, 0)}[func]
+            m, has = SEG.seg_fold(c.data, validity, seg, nseg,
+                                  op[0], op[1])
+            return [DeviceColumn(f.dataType, group_valid & has,
+                                 data=m.astype(T.storage_dtype(f.dataType)))]
         if func == "count_if":
             (f,) = fields
             if mode == AggregateMode.FINAL:
@@ -723,7 +752,7 @@ class TpuHashAggregateExec(TpuExec):
         if func == "approx_count_distinct":
             return self._eval_hll(a, fields, ctx, perm, seg, mask_sorted,
                                   cap, group_valid, nseg)
-        if func in ("percentile", "approx_percentile"):
+        if func in ("percentile", "approx_percentile", "median"):
             return self._eval_percentile(a, fields, ctx, perm, seg,
                                          mask_sorted, cap, group_valid, nseg)
         if func == "bloom_filter_agg":
@@ -954,7 +983,8 @@ class TpuHashAggregateExec(TpuExec):
         """covar_pop / covar_samp / corr — Spark Covariance/Corr buffers
         (n, xAvg, yAvg, ck [, xMk, yMk]); rows count only when BOTH inputs
         are non-null."""
-        is_corr = a.func == "corr"
+        is_regr = a.func in PN_REGR_FUNCS
+        is_corr = a.func == "corr" or is_regr   # 6-channel buffers
         if self.mode == AggregateMode.FINAL:
             from spark_rapids_tpu.plan.nodes import MOMENT_BUFFERS as _MB
 
@@ -966,8 +996,12 @@ class TpuHashAggregateExec(TpuExec):
             else:
                 ntot, nz, xavg, yavg, ck = merged
         else:
-            x_col = a.child.eval_tpu(ctx)
-            y_col = a.child2.eval_tpu(ctx)
+            # regr_f(y, x): the DEPENDENT y is the first argument; the
+            # covariance stats' x must be the independent (second)
+            x_expr = a.child2 if is_regr else a.child
+            y_expr = a.child if is_regr else a.child2
+            x_col = x_expr.eval_tpu(ctx)
+            y_col = y_expr.eval_tpu(ctx)
             if perm is not None:
                 x_col = _gather_col(x_col, perm)
                 y_col = _gather_col(y_col, perm)
@@ -994,6 +1028,41 @@ class TpuHashAggregateExec(TpuExec):
                                         data=arr))
             return out
         (f,) = fields
+        if is_regr:
+            func = a.func
+            if func == "regr_count":
+                return [DeviceColumn(T.LONG, group_valid,
+                                     data=jnp.where(
+                                         group_valid, ntot, 0.0).astype(
+                                         jnp.int64))]
+            if func == "regr_avgx":
+                return [DeviceColumn(f.dataType, group_valid & nz,
+                                     data=xavg)]
+            if func == "regr_avgy":
+                return [DeviceColumn(f.dataType, group_valid & nz,
+                                     data=yavg)]
+            if func == "regr_sxx":
+                return [DeviceColumn(f.dataType, group_valid & nz,
+                                     data=xm2)]
+            if func == "regr_syy":
+                return [DeviceColumn(f.dataType, group_valid & nz,
+                                     data=ym2)]
+            if func == "regr_sxy":
+                return [DeviceColumn(f.dataType, group_valid & nz,
+                                     data=ck)]
+            ok = nz & (xm2 != 0.0)
+            slope = ck / jnp.where(xm2 != 0.0, xm2, 1.0)
+            if func == "regr_slope":
+                return [DeviceColumn(f.dataType, group_valid & ok,
+                                     data=slope)]
+            if func == "regr_intercept":
+                return [DeviceColumn(f.dataType, group_valid & ok,
+                                     data=yavg - slope * xavg)]
+            # regr_r2: syy==0 -> 1.0; else ck^2/(sxx*syy)
+            r2 = jnp.where(ym2 == 0.0, 1.0,
+                           (ck * ck) / jnp.where(
+                               (xm2 * ym2) != 0.0, xm2 * ym2, 1.0))
+            return [DeviceColumn(f.dataType, group_valid & ok, data=r2)]
         if is_corr:
             # zero variance -> NaN via natural fp division (Spark Corr)
             res = ck / jnp.sqrt(xm2 * ym2)
@@ -1058,7 +1127,7 @@ class TpuHashAggregateExec(TpuExec):
         summary is uncompressed below the accuracy threshold, which is the
         same answer).  Single-phase COMPLETE (planned like collect_list)."""
         (f,) = fields
-        pct = jnp.float64(a.args[0])
+        pct = jnp.float64(0.5 if a.func == "median" else a.args[0])
         c = self._input_col(a, ctx, perm)
         valid = c.validity & mask_sorted
         # sort values within their (already sorted) segments; invalid last

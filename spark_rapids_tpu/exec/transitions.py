@@ -94,7 +94,9 @@ def _concat_host(hs: List[HostColumn]) -> HostColumn:
     if hs[0].is_struct:
         kids = [_concat_host([h.children[k] for h in hs])
                 for k in range(len(hs[0].children))]
-        return HostColumn(dtype, validity, children=kids)
+        lengths = (np.concatenate([h.lengths for h in hs])
+                   if hs[0].lengths is not None else None)
+        return HostColumn(dtype, validity, lengths=lengths, children=kids)
     if hs[0].is_string_array:
         ew = max(h.chars.shape[1] for h in hs)
         w = max(h.chars.shape[2] for h in hs)

@@ -200,8 +200,11 @@ class Multiply(BinaryArithmetic):
         return a * b
 
     def _overflow_flag(self, a, b, res):
-        # res/b != a detects int overflow without widening
-        return (b != 0) & (res // jnp.where(b == 0, 1, b) != a)
+        # res/b != a detects int overflow without widening; INT_MIN * -1
+        # needs its own check (the division wraps back to INT_MIN)
+        imin = jnp.asarray(jnp.iinfo(res.dtype).min, res.dtype)
+        return ((b != 0) & (res // jnp.where(b == 0, 1, b) != a)) \
+            | ((a == imin) & (b == -1))
 
     def _decimal_result(self, ld, rd):
         return T.DecimalType(min(ld.precision + rd.precision + 1, 38),
@@ -544,3 +547,49 @@ class ShiftRightUnsigned(_Shift):
         return jax.lax.shift_right_logical(
             jax.lax.bitcast_convert_type(a, udt),
             jax.lax.bitcast_convert_type(amt, udt)).astype(a.dtype)
+
+
+class _TryMixin:
+    """try_* arithmetic: the ANSI operation with errors becoming NULL
+    (Spark's TryEval over the ANSI evaluator).  The child op runs with a
+    forked always-ANSI context; its error flags null the result rows
+    instead of raising.
+
+    Reference analog: GpuTryAdd/... (sql-plugin arithmetic.scala)."""
+
+    _fn_name = "try_op"
+
+    def sql_string(self):
+        return (f"{self._fn_name}({self.left.sql_string()}, "
+                f"{self.right.sql_string()})")
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        sub = EvalContext(ctx.batch, ansi=True,
+                          row_offset=ctx.row_offset)
+        out = super().do_columnar_eval(sub, cols)
+        bad = None
+        for flag, _msg in sub.error_flags:
+            bad = flag if bad is None else (bad | flag)
+        if bad is None:
+            return out
+        return DeviceColumn(out.dtype, out.validity & ~bad,
+                            data=out.data, chars=out.chars,
+                            lengths=out.lengths,
+                            elem_valid=out.elem_valid,
+                            children=out.children)
+
+
+class TryAdd(_TryMixin, Add):
+    _fn_name = "try_add"
+
+
+class TrySubtract(_TryMixin, Subtract):
+    _fn_name = "try_subtract"
+
+
+class TryMultiply(_TryMixin, Multiply):
+    _fn_name = "try_multiply"
+
+
+class TryDivide(_TryMixin, Divide):
+    _fn_name = "try_divide"

@@ -86,6 +86,19 @@ class Cast(UnaryExpression):
         self.resolved = True
         return self
 
+    @property
+    def is_host_kernel(self):
+        """fp<->string casts run as host kernels (Java shortest-repr
+        formatting / Spark float parsing), routed through the eager
+        Project/Filter stage path like the JSON family."""
+        srcdt = self.child._dataType
+        if srcdt is None:
+            return False
+        fp = (T.FloatType, T.DoubleType)
+        return ((isinstance(srcdt, fp) and isinstance(self.to, T.StringType))
+                or (isinstance(srcdt, T.StringType)
+                    and isinstance(self.to, fp)))
+
     def do_columnar_eval(self, ctx: EvalContext, cols):
         c = cols[0]
         src, dst = self.child.dataType, self.to
@@ -599,6 +612,106 @@ def _null_to_any(ctx, c, src, dst, ansi):
     return Literal(None, dst).eval_tpu(ctx)
 
 
+def java_fp_to_string(v: float, is_float: bool) -> str:
+    """Java Float/Double.toString: shortest round-trip digits, positional
+    for 1e-3 <= |v| < 1e7, else "d.dddEnn".  Shared by the device
+    host-kernel cast and the CPU oracle (reference: cast_string.cu /
+    format_float.cu, SURVEY.md §2.5 Cast)."""
+    import math
+
+    import numpy as np
+
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "Infinity" if v > 0 else "-Infinity"
+    if v == 0.0:
+        return "-0.0" if math.copysign(1.0, v) < 0 else "0.0"
+    x = np.float32(v) if is_float else np.float64(v)
+    s = np.format_float_scientific(x, unique=True, trim="-")
+    mant, _, exps = s.partition("e")
+    exp = int(exps)
+    neg = mant.startswith("-")
+    if neg:
+        mant = mant[1:]
+    digits = (mant.replace(".", "").rstrip("0")) or "0"
+    if -3 <= exp <= 6:
+        if exp >= 0:
+            ip = digits[: exp + 1].ljust(exp + 1, "0")
+            fp = digits[exp + 1:] or "0"
+        else:
+            ip = "0"
+            fp = "0" * (-exp - 1) + digits
+        out = f"{ip}.{fp}"
+    else:
+        out = f"{digits[0]}.{digits[1:] or '0'}E{exp}"
+    return ("-" if neg else "") + out
+
+
+def _fp_to_string(ctx, c, src, dst, ansi):
+    """HOST kernel (eager path): Java shortest-repr formatting."""
+    from spark_rapids_tpu.columnar.column import HostColumn
+
+    cap = c.capacity
+    n = int(ctx.batch.num_rows)
+    vals = c.to_host(n).to_pylist()
+    is_f = isinstance(src, T.FloatType)
+    out = [None if v is None else java_fp_to_string(float(v), is_f)
+           for v in vals]
+    host = HostColumn.from_pylist(out, T.STRING)
+    return DeviceColumn.from_host(host, capacity=cap)
+
+
+def spark_string_to_double(s: str):
+    """Spark's cast(string as double): trimmed Java Double.parseDouble
+    grammar (shared by the device host-kernel and the CPU oracle).
+    Returns None for Spark-invalid input.  Python-only syntax Java
+    rejects — digit underscores and the bare 'inf'/'-inf' spellings —
+    is rejected; Java's trailing d/f suffix is accepted."""
+    t = s.strip()
+    if not t or "_" in t:
+        return None
+    low = t.lower()
+    if low.lstrip("+-") in ("inf",):
+        return None              # Java wants 'Infinity'
+    if low and low[-1] in "df" and any(ch.isdigit() for ch in low[:-1]) \
+            and "x" not in low:
+        t = t[:-1]               # Java FP suffix
+    try:
+        return float(t)
+    except ValueError:
+        return None
+
+
+def _string_to_fp(ctx, c, src, dst, ansi):
+    """HOST kernel: Spark string->float parse via the shared
+    spark_string_to_double grammar; invalid -> null (ANSI: error)."""
+    import numpy as np
+
+    from spark_rapids_tpu.columnar.column import HostColumn
+
+    cap = c.capacity
+    n = int(ctx.batch.num_rows)
+    vals = c.to_host(n).to_pylist()
+    out = []
+    bad = np.zeros(cap, np.bool_)
+    for i, v in enumerate(vals):
+        if v is None:
+            out.append(None)
+            continue
+        f = spark_string_to_double(str(v))
+        if f is None:
+            out.append(None)
+            bad[i] = True
+        else:
+            out.append(f)
+    if ansi:
+        ctx.add_error(jnp.asarray(bad),
+                      "invalid input syntax for type numeric (ANSI)")
+    host = HostColumn.from_pylist(out, dst)
+    return DeviceColumn.from_host(host, capacity=cap)
+
+
 _CASTS = {
     ("int", "int"): _int_to_int,
     ("int", "fp"): _int_to_fp,
@@ -614,6 +727,8 @@ _CASTS = {
     ("dec", "fp"): _dec_to_fp,
     ("fp", "dec"): _fp_to_dec,
     ("int", "str"): _int_to_string,
+    ("fp", "str"): _fp_to_string,
+    ("str", "fp"): _string_to_fp,
     ("bool", "str"): _bool_to_string,
     ("dec", "str"): _dec_to_string,
     ("date", "str"): _date_to_string,

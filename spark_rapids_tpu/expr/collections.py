@@ -858,3 +858,285 @@ class ArraySize(Size):
     def do_columnar_eval(self, ctx: EvalContext, cols):
         r = super().do_columnar_eval(ctx, cols)
         return DeviceColumn(T.INT, cols[0].validity, data=r.data)
+
+
+class ArrayInsert(Expression):
+    """array_insert(arr, pos, item) — Spark 3.5 default semantics
+    (legacy negativeIndexInArrayInsert=false: -1 appends).  ``pos`` must
+    be a foldable non-zero literal (the output width bucket is a static
+    shape; the overrides rule tags non-literal positions back to CPU).
+
+    Reference analog: GpuArrayInsert (SURVEY.md §2.5 Collections)."""
+
+    def __init__(self, children: List[Expression]):
+        super().__init__(list(children))
+
+    def sql_string(self):
+        a, p, v = self.children
+        return (f"array_insert({a.sql_string()}, {p.sql_string()}, "
+                f"{v.sql_string()})")
+
+    @property
+    def pos_literal(self):
+        from spark_rapids_tpu.expr.base import Literal
+
+        p = self.children[1]
+        return p.value if isinstance(p, Literal) else None
+
+    def _resolve_type(self):
+        et = self.children[0].dataType.elementType
+        self._dataType = T.ArrayType(et, containsNull=True)
+        self._nullable = self.children[0].nullable
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        arr, _posc, val = cols
+        pos = int(self.pos_literal)
+        cap = arr.capacity
+        ew = arr.ewidth
+        wout = max(ew + 1, abs(pos))
+        lens = arr.lengths.astype(jnp.int32)
+        j = jnp.arange(wout, dtype=jnp.int32)[None, :]     # (1, wout)
+        if pos > 0:
+            idx0 = jnp.full((cap, 1), pos - 1, jnp.int32)
+        else:
+            # Spark 3.5 default (legacy flag off): -1 appends, so the
+            # 0-based insert position is len + pos + 1
+            idx0 = (lens[:, None] + pos + 1).astype(jnp.int32)
+        neg = idx0 < 0
+        # case A (idx0 >= 0): insert at idx0, tail-null pad when past len
+        # case B (idx0 < 0): [item, nulls x (-idx0-1), arr...]
+        pad = jnp.where(neg, -idx0, 0)
+        is_item = jnp.where(neg, j == 0, j == idx0)
+        srcA = jnp.where(j < idx0, j, j - 1)
+        srcB = j - pad - 1
+        src = jnp.where(neg, srcB, srcA)
+        src_ok = (~is_item & (src >= 0) & (src < lens[:, None]))
+        out_len = jnp.where(
+            neg[:, 0], -pos * jnp.ones(cap, jnp.int32),
+            jnp.maximum(lens + 1, idx0[:, 0] + 1))
+        safe = jnp.clip(src, 0, max(ew - 1, 0))
+        item_valid = val.validity[:, None]
+        in_out = j < out_len[:, None]
+        if arr.is_string_array:
+            rows = jnp.arange(cap)[:, None]
+            chars = jnp.where(
+                is_item[:, :, None],
+                _pad_chars_to(val.chars, arr.chars.shape[-1])[:, None, :],
+                arr.chars[rows, safe])
+            elens = jnp.where(is_item, val.lengths[:, None].astype(
+                arr.data.dtype), arr.data[rows, safe])
+            ev = jnp.where(is_item, item_valid,
+                           src_ok & arr.elem_valid[rows, safe]) & in_out
+            return DeviceColumn(self.dataType, arr.validity, chars=chars,
+                                data=jnp.where(ev, elens, 0),
+                                lengths=out_len, elem_valid=ev)
+        data = jnp.where(is_item, val.data[:, None],
+                         jnp.take_along_axis(
+                             arr.data, safe, axis=1))
+        ev = jnp.where(is_item, item_valid,
+                       src_ok & jnp.take_along_axis(
+                           arr.elem_valid, safe, axis=1)) & in_out
+        return DeviceColumn(self.dataType, arr.validity,
+                            data=jnp.where(ev, data,
+                                           jnp.zeros_like(data)),
+                            lengths=out_len, elem_valid=ev)
+
+
+def _pad_chars_to(chars, w):
+    if chars.shape[-1] >= w:
+        return chars[..., :w]
+    pad = [(0, 0)] * (chars.ndim - 1) + [(0, w - chars.shape[-1])]
+    return jnp.pad(chars, pad)
+
+
+class Flatten(Expression):
+    """flatten(array_of_arrays) -> array.
+
+    The padded device layout has no general array<array<T>> column, so
+    the supported shape is the one users actually write —
+    ``flatten(array(a1, a2, ...))`` over array-typed columns.  The
+    CreateArray is ABSORBED at construction (its members become this
+    node's children), so no array<array> type ever appears in the tagged
+    plan; any other child shape keeps a single child and is tagged back
+    to CPU by the overrides rule.  A null member array makes the result
+    null (Spark flatten semantics)."""
+
+    def __init__(self, child: Expression):
+        members = None
+        if isinstance(child, CreateArray) and child.children:
+            members = list(child.children)
+        self._absorbed = members is not None
+        super().__init__(members if members is not None else [child])
+
+    def _resolve_type(self):
+        if self._absorbed:
+            self._dataType = self.children[0].dataType
+        else:
+            self._dataType = self.children[0].dataType.elementType
+        self._nullable = True
+
+    def sql_string(self):
+        if self._absorbed:
+            inner = ", ".join(c.sql_string() for c in self.children)
+            return f"flatten(array({inner}))"
+        return f"flatten({self.children[0].sql_string()})"
+
+    def eval_tpu(self, ctx: EvalContext) -> DeviceColumn:
+        members = [m.eval_tpu(ctx) for m in self.children]
+        validity = self.and_validity(members)
+        lens = sum(m.lengths.astype(jnp.int32) for m in members)
+        if members[0].is_string_array:
+            w = max(m.chars.shape[-1] for m in members)
+            chars = jnp.concatenate(
+                [_pad_chars_to(m.chars, w) for m in members], axis=1)
+        else:
+            chars = None
+        elens = jnp.concatenate([m.data for m in members], axis=1)
+        # compact each row's PRESENT elements (inside their array's
+        # length; null elements count as present) to a prefix with a
+        # stable per-row sort by (absent, position)
+        present = jnp.concatenate([_in_len(m) for m in members], axis=1)
+        wtot = elens.shape[1]
+        posm = jnp.broadcast_to(jnp.arange(wtot, dtype=jnp.int32)[None, :],
+                                elens.shape[:1] + (wtot,))
+        live_idx = jax.lax.sort(((~present).astype(jnp.int32), posm),
+                                num_keys=2, dimension=1, is_stable=True)[1]
+        gath = jnp.take_along_axis
+        elens_c = gath(elens, live_idx, axis=1)
+        ev_c = gath(jnp.concatenate(
+            [m.elem_valid for m in members], axis=1), live_idx, axis=1)
+        in_out = jnp.arange(wtot, dtype=jnp.int32)[None, :] < lens[:, None]
+        if chars is not None:
+            chars_c = gath(chars, live_idx[:, :, None], axis=1)
+            return DeviceColumn(self.dataType, validity, chars=chars_c,
+                                data=jnp.where(ev_c & in_out, elens_c, 0),
+                                lengths=lens, elem_valid=ev_c & in_out)
+        return DeviceColumn(self.dataType, validity,
+                            data=elens_c,
+                            lengths=lens, elem_valid=ev_c & in_out)
+
+
+class StrToMap(Expression):
+    """str_to_map(text[, pairDelim[, keyValueDelim]]) -> map<string,string>.
+
+    Reference analog: GpuStringToMap (SURVEY.md §2.5 Collections).  Like
+    the split family, irregular per-row shapes make this a host kernel;
+    delimiters are Java regexes validated at plan time.  Duplicate keys
+    follow Spark's default EXCEPTION dedup policy via the error flags."""
+
+    is_host_kernel = True
+
+    def __init__(self, children: List[Expression]):
+        super().__init__(list(children))
+
+    def sql_string(self):
+        return ("str_to_map("
+                + ", ".join(c.sql_string() for c in self.children) + ")")
+
+    def _resolve_type(self):
+        from spark_rapids_tpu.expr.base import Literal
+
+        self._dataType = T.MapType(T.STRING, T.STRING)
+        self._nullable = True
+        self._pair = ","
+        self._kv = ":"
+        if len(self.children) > 1 and isinstance(self.children[1], Literal):
+            self._pair = str(self.children[1].value)
+        if len(self.children) > 2 and isinstance(self.children[2], Literal):
+            self._kv = str(self.children[2].value)
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        import re as _re
+
+        import numpy as np
+
+        from spark_rapids_tpu.columnar.column import HostColumn
+        from spark_rapids_tpu.cpu.oracle import _java_regex_to_python
+
+        c = cols[0]
+        n = int(ctx.batch.num_rows)
+        cap = c.capacity
+        vals = c.to_host(n).to_pylist()
+        rp = _re.compile(_java_regex_to_python(self._pair))
+        rk = _re.compile(_java_regex_to_python(self._kv))
+        out = []
+        dup = np.zeros(cap, np.bool_)
+        for i, s in enumerate(vals):
+            if s is None:
+                out.append(None)
+                continue
+            m = {}
+            for entry in rp.split(s):
+                parts = rk.split(entry, maxsplit=1)
+                k = parts[0]
+                v = parts[1] if len(parts) > 1 else None
+                if k in m:
+                    dup[i] = True
+                m[k] = v
+            out.append(m)
+        ctx.add_error(jnp.asarray(dup), "Duplicate map key was found")
+        host = HostColumn.from_pylist(out, self.dataType)
+        return DeviceColumn.from_host(host, capacity=cap)
+
+
+class MapEntries(UnaryExpression):
+    """map_entries(m) -> array<struct<key, value>> — the map's children
+    ARE the entries layout (per-field array columns sharing lengths).
+
+    Reference analog: GpuMapEntries (collectionOperations.scala)."""
+
+    def _resolve_type(self):
+        mt = self.child.dataType
+        et = T.StructType([T.StructField("key", mt.keyType, False),
+                           T.StructField("value", mt.valueType, True)])
+        self._dataType = T.ArrayType(et, containsNull=False)
+        self._nullable = self.child.nullable
+
+    def sql_string(self):
+        return f"map_entries({self.child.sql_string()})"
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        m = cols[0]
+        kcol, vcol = m.children
+        return DeviceColumn(self.dataType, m.validity,
+                            lengths=kcol.lengths,
+                            children=(kcol, vcol))
+
+
+class ArraysZip(Expression):
+    """arrays_zip(a1, a2, ...) -> array<struct<...>> zipped to the
+    LONGEST input; shorter inputs contribute null fields.
+
+    Reference analog: GpuArraysZip (collectionOperations.scala)."""
+
+    def __init__(self, children: List[Expression], names=None):
+        super().__init__(list(children))
+        self._names = names
+
+    def sql_string(self):
+        return ("arrays_zip("
+                + ", ".join(c.sql_string() for c in self.children) + ")")
+
+    def _resolve_type(self):
+        names = self._names or [str(i) for i in range(len(self.children))]
+        fields = [T.StructField(nm, c.dataType.elementType, True)
+                  for nm, c in zip(names, self.children)]
+        self._dataType = T.ArrayType(T.StructType(fields),
+                                     containsNull=False)
+        self._nullable = any(c.nullable for c in self.children)
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        validity = self.and_validity(cols)
+        out_len = cols[0].lengths
+        for c in cols[1:]:
+            out_len = jnp.maximum(out_len, c.lengths)
+        kids = []
+        for c in cols:
+            # keep each input's own lengths: the entries layout's reader
+            # nulls fields past their array's length
+            kids.append(DeviceColumn(
+                T.ArrayType(c.dtype.elementType, containsNull=True),
+                validity, data=c.data, chars=c.chars,
+                lengths=c.lengths, elem_valid=c.elem_valid))
+        return DeviceColumn(self.dataType, validity, lengths=out_len,
+                            children=tuple(kids))

@@ -91,3 +91,27 @@ class BloomFilterMightContain(Expression):
                 jnp.right_shift(word, bit % 64), 1) == 1)
         validity = bloom.validity & v.validity
         return DeviceColumn(T.BOOLEAN, validity, data=hit)
+
+
+class HiveHash(Expression):
+    """hive_hash(c1, c2, ...) -> int32, never null.
+
+    Reference analog: GpuHiveHash (spark-rapids-jni hive_hash.cu,
+    SURVEY.md §2.5): h = 31*h + colHash with Hive's per-type hashes."""
+
+    def __init__(self, children: List[Expression]):
+        super().__init__(children)
+
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = False
+
+    def sql_string(self):
+        return ("hive_hash("
+                + ", ".join(c.sql_string() for c in self.children) + ")")
+
+    def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.ops.hashing import hive_hash_columns
+
+        return DeviceColumn(T.INT, jnp.ones(cols[0].capacity, jnp.bool_),
+                            data=hive_hash_columns(cols))

@@ -479,3 +479,126 @@ class ZipWith(Expression):
         ev = res.validity.reshape(cap, w) & inl
         return DeviceColumn(self.dataType, a.validity & b.validity,
                             data=data, lengths=out_len, elem_valid=ev)
+
+
+class MapZipWith(Expression):
+    """map_zip_with(m1, m2, (k, v1, v2) -> f): the key UNION (m1's keys
+    in order, then m2-only keys), each value null where its map lacks
+    the key.
+
+    Reference analog: GpuMapZipWith (higherOrderFunctions.scala)."""
+
+    def __init__(self, m1: Expression, m2: Expression, k_name: str,
+                 v1_name: str, v2_name: str, body: Expression):
+        super().__init__([m1, m2])
+        self.k_name = k_name
+        self.v1_name = v1_name
+        self.v2_name = v2_name
+        self.body = body
+
+    def sql_string(self):
+        return (f"map_zip_with({self.children[0].sql_string()}, "
+                f"{self.children[1].sql_string()}, "
+                f"({self.k_name}, {self.v1_name}, {self.v2_name}) -> "
+                f"{self.body.sql_string()})")
+
+    def resolve(self, schema: T.StructType) -> Expression:
+        self.children = [c.resolve(schema) for c in self.children]
+        m1t = self.children[0].dataType
+        m2t = self.children[1].dataType
+        ext = T.StructType(
+            list(schema.fields)
+            + [T.StructField(self.k_name, m1t.keyType, False),
+               T.StructField(self.v1_name, m1t.valueType, True),
+               T.StructField(self.v2_name, m2t.valueType, True)])
+        self.body = self.body.resolve(ext)
+        self._resolve_type()
+        self.resolved = True
+        return self
+
+    def collect(self, pred):
+        out = super().collect(pred)
+        out.extend(self.body.collect(pred))
+        return out
+
+    def _resolve_type(self):
+        self._dataType = T.MapType(self.children[0].dataType.keyType,
+                                   self.body.dataType)
+        self._nullable = (self.children[0].nullable
+                          or self.children[1].nullable)
+
+    def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.expr.collections import _elem_eq
+
+        m1, m2 = cols
+        k1, v1 = m1.children
+        k2, v2 = m2.children
+        kt = self.children[0].dataType.keyType
+        cap = m1.capacity
+        w1, w2 = max(k1.ewidth, 1), max(k2.ewidth, 1)
+        w = w1 + w2
+
+        def padk(c, width):
+            if c.ewidth == width:
+                return c.data
+            if c.ewidth == 0:
+                return jnp.zeros((cap, width),
+                                 T.storage_dtype(kt))
+            return jnp.pad(c.data, ((0, 0), (0, width - c.ewidth)))
+
+        live1 = k1.elem_valid & _in_len(k1)
+        live2 = k2.elem_valid & _in_len(k2)
+        catk = jnp.concatenate([padk(k1, w1), padk(k2, w2)], axis=1)
+        live = jnp.concatenate([live1, live2], axis=1)
+        # first-occurrence dedup over the concat (m1 keys first)
+        eq = _elem_eq(catk[:, :, None], catk[:, None, :], kt)
+        both = live[:, :, None] & live[:, None, :]
+        earlier = jnp.tril(jnp.ones((w, w), jnp.bool_), k=-1)[None]
+        dup = jnp.any(eq & both & earlier, axis=2)
+        keep = live & ~dup
+        kd, kev, lengths = _compact_elems(catk, keep, keep)
+        # per union key, look up each side's value (first match)
+        def lookup(kc, vc, width, livem):
+            eqm = (_elem_eq(kd[:, :, None], padk(kc, width)[:, None, :],
+                            kt) & livem[:, None, :] & kev[:, :, None])
+            found = jnp.any(eqm, axis=2)
+            pos = jnp.argmax(eqm, axis=2)
+            safe = jnp.clip(pos, 0, max(width - 1, 0))
+            vd = jnp.take_along_axis(
+                jnp.pad(vc.data, ((0, 0), (0, width - vc.ewidth)))
+                if vc.ewidth < width else vc.data, safe, axis=1)
+            vev = jnp.take_along_axis(
+                jnp.pad(vc.elem_valid, ((0, 0), (0, width - vc.ewidth)))
+                if vc.ewidth < width else vc.elem_valid, safe, axis=1)
+            return vd, vev & found, found
+
+        v1d, v1ok, _ = lookup(k1, v1, w1, live1)
+        v2d, v2ok, _ = lookup(k2, v2, w2, live2)
+        # flatten for the lambda body
+        m1t = self.children[0].dataType
+        m2t = self.children[1].dataType
+        ek = DeviceColumn(kt, kev.reshape(-1), data=kd.reshape(cap * w))
+        e1 = DeviceColumn(m1t.valueType, v1ok.reshape(-1),
+                          data=v1d.reshape(cap * w))
+        e2 = DeviceColumn(m2t.valueType, v2ok.reshape(-1),
+                          data=v2d.reshape(cap * w))
+        outer = [_repeat_col(c, w) for c in ctx.batch.columns]
+        ext = T.StructType(
+            list(ctx.batch.schema.fields)
+            + [T.StructField(self.k_name, kt, False),
+               T.StructField(self.v1_name, m1t.valueType, True),
+               T.StructField(self.v2_name, m2t.valueType, True)])
+        flat = ColumnarBatch(outer + [ek, e1, e2], cap * w, ext)
+        sub = EvalContext(flat, ansi=ctx.ansi)
+        res = self.body.eval_tpu(sub)
+        for f, msg in sub.error_flags:
+            ctx.add_error(jnp.any(f.reshape(cap, w) & kev, axis=1), msg)
+        validity = m1.validity & m2.validity
+        keys = DeviceColumn(T.ArrayType(kt, containsNull=False), validity,
+                            data=kd, lengths=lengths, elem_valid=kev)
+        vals = DeviceColumn(T.ArrayType(self.body.dataType), validity,
+                            data=res.data.reshape(cap, w),
+                            lengths=lengths,
+                            elem_valid=res.validity.reshape(cap, w) & kev)
+        return DeviceColumn(self.dataType, validity,
+                            children=(keys, vals))

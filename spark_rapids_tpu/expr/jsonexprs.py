@@ -400,3 +400,61 @@ class StructsToJson(UnaryExpression):
             fn, shapes, *args)
         return DeviceColumn(T.STRING, out_valid, chars=out_chars,
                             lengths=out_lens)
+
+
+class SchemaOfJson(Expression):
+    """schema_of_json('literal json') -> DDL schema string (plan-time
+    constant fold — Spark requires a foldable argument).
+
+    Reference analog: GpuSchemaOfJson (SURVEY.md §2.5 JSON)."""
+
+    def __init__(self, children):
+        super().__init__(list(children))
+
+    def sql_string(self):
+        return f"schema_of_json({self.children[0].sql_string()})"
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = False
+
+    @staticmethod
+    def _infer(v) -> str:
+        if isinstance(v, bool):
+            return "BOOLEAN"
+        if isinstance(v, int):
+            return "BIGINT"
+        if isinstance(v, float):
+            return "DOUBLE"
+        if isinstance(v, str) or v is None:
+            return "STRING"
+        if isinstance(v, list):
+            if not v:
+                return "ARRAY<STRING>"
+            return f"ARRAY<{SchemaOfJson._infer(v[0])}>"
+        if isinstance(v, dict):
+            inner = ", ".join(
+                f"{k}: {SchemaOfJson._infer(val)}"
+                for k, val in sorted(v.items()))
+            return f"STRUCT<{inner}>"
+        return "STRING"
+
+    def _folded(self) -> str:
+        import json as _json
+
+        from spark_rapids_tpu.expr.base import Literal
+
+        lit = self.children[0]
+        if not isinstance(lit, Literal) or lit.value is None:
+            raise ValueError(
+                "schema_of_json requires a foldable string literal")
+        return self._infer(_json.loads(str(lit.value)))
+
+    def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.columnar.column import HostColumn
+        from spark_rapids_tpu.columnar.column import DeviceColumn
+
+        cap = ctx.batch.capacity
+        s = self._folded()
+        host = HostColumn.from_pylist([s] * cap, T.STRING)
+        return DeviceColumn.from_host(host, capacity=cap)

@@ -1025,3 +1025,69 @@ class EulerNumber(Expression):
         cap = ctx.batch.capacity
         return DeviceColumn(T.DOUBLE, jnp.ones(cap, jnp.bool_),
                             data=jnp.full(cap, _m.e, jnp.float64))
+
+
+class BitGet(BinaryExpression):
+    """bit_get(v, pos) -> 0/1 byte; pos outside [0, bits) errors.
+
+    Reference analog: GpuBitwiseGet (SURVEY.md §2.5 Hash/misc)."""
+
+    def _resolve_type(self):
+        self._dataType = T.BYTE
+        self._nullable = True
+
+    def sql_string(self):
+        return (f"bit_get({self.left.sql_string()}, "
+                f"{self.right.sql_string()})")
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        v, p = cols
+        bits = {T.ByteType: 8, T.ShortType: 16, T.IntegerType: 32,
+                T.LongType: 64}[type(self.left.dataType)]
+        pos = p.data.astype(jnp.int32)
+        valid = v.validity & p.validity
+        bad = valid & ((pos < 0) | (pos >= bits))
+        ctx.add_error(bad, f"Invalid bit position: must be in [0, {bits})")
+        safe = jnp.clip(pos, 0, bits - 1)
+        out = jax.lax.shift_right_logical(
+            v.data.astype(jnp.int64),
+            safe.astype(jnp.int64)) & jnp.int64(1)
+        return DeviceColumn(T.BYTE, valid, data=out.astype(jnp.int8))
+
+
+class AssertTrue(UnaryExpression):
+    """assert_true(cond): NULL, erroring when any row is false."""
+
+    def _resolve_type(self):
+        self._dataType = T.NullType()
+        self._nullable = True
+
+    def sql_string(self):
+        return f"assert_true({self.child.sql_string()})"
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        c = cols[0]
+        bad = ~(c.validity & c.data.astype(jnp.bool_))
+        ctx.add_error(bad, f"'{self.child.sql_string()}' is not true!")
+        cap = c.capacity
+        return DeviceColumn(T.NullType(), jnp.zeros(cap, jnp.bool_),
+                            data=jnp.zeros(cap, jnp.int8))
+
+
+class TypeOf(UnaryExpression):
+    """typeof(expr) -> the SQL type name (constant per column)."""
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = False
+
+    def sql_string(self):
+        return f"typeof({self.child.sql_string()})"
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        from spark_rapids_tpu.columnar.column import HostColumn
+
+        cap = cols[0].capacity
+        s = self.child.dataType.simpleString
+        host = HostColumn.from_pylist([s] * cap, T.STRING)
+        return DeviceColumn.from_host(host, capacity=cap)

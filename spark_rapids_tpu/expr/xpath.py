@@ -1,0 +1,286 @@
+"""XPath expressions over XML strings.
+
+Reference analog: GpuXPathBoolean/Short/Int/Long/Float/Double/String/List
+(sql-plugin xpath expressions backed by spark-rapids-jni's XPath kernel,
+SURVEY.md §2.5).  Irregular string processing makes these host kernels
+here (like the JSON/split families): batches cross to the host, a
+python-XML evaluator applies the path, results upload.
+
+Supported path subset (validated at plan time; matches the common Hive
+xpath usage):
+
+    /a/b          child steps from the document root
+    //b           descendant search
+    /a/*          wildcard child
+    /a/b/@attr    attribute value extraction
+    /a/b/text()   explicit text nodes
+    /a[1]/b       positional predicates (1-based)
+    /a/b[@x='v']  attribute-equality predicates
+
+Malformed XML or an unsupported path yields null for that row (the CPU
+oracle runs this same evaluator, so differential tests stay exact).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.base import EvalContext, Expression
+
+
+def xpath_eval(xml: Optional[str], path: str) -> Optional[List[str]]:
+    """Evaluate the path subset; None for malformed XML, else the list of
+    matched string values (element text / attribute values)."""
+    if xml is None:
+        return None
+    import xml.etree.ElementTree as ET
+
+    try:
+        root = ET.fromstring(xml)
+    except ET.ParseError:
+        return None
+    want_text = False
+    attr = None
+    p = path.strip()
+    if p.endswith("/text()"):
+        want_text = True
+        p = p[: -len("/text()")]
+    else:
+        last = p.rsplit("/", 1)[-1]
+        if last.startswith("@"):
+            attr = last[1:]
+            p = p[: -(len(last) + 1)]
+    nodes = _match(root, p)
+    if nodes is None:
+        return None
+    out = []
+    for nd in nodes:
+        if attr is not None:
+            if attr in nd.attrib:
+                out.append(nd.attrib[attr])
+        elif want_text:
+            if nd.text is not None and nd.text != "":
+                out.append(nd.text)
+        else:
+            out.append("".join(nd.itertext()))
+    return out
+
+
+def _match(root, p: str):
+    """Resolve the element-step part of the path against the root."""
+    p = p.strip()
+    if p in ("", "/"):
+        return [root]
+    if p.startswith("//"):
+        # descendant search including the root itself
+        rest = p[2:]
+        first, _, tail = rest.partition("/")
+        name, pred = _split_pred(first)
+        cands = ([root] if _name_ok(root, name) else []) \
+            + [e for e in root.iter() if e is not root
+               and _name_ok(e, name)]
+        cands = _apply_pred(cands, pred)
+        if cands is None:
+            return None
+        return _steps(cands, tail)
+    if p.startswith("/"):
+        first, _, tail = p[1:].partition("/")
+        name, pred = _split_pred(first)
+        if not _name_ok(root, name):
+            return []
+        sel = _apply_pred([root], pred)
+        if sel is None:
+            return None
+        return _steps(sel, tail)
+    # relative path: treat as children of root
+    return _steps([root], p)
+
+
+def _steps(nodes, tail: str):
+    while tail:
+        step, _, tail = tail.partition("/")
+        name, pred = _split_pred(step)
+        nxt = []
+        for nd in nodes:
+            nxt.extend(c for c in list(nd) if _name_ok(c, name))
+        nodes = _apply_pred(nxt, pred)
+        if nodes is None:
+            return None
+    return nodes
+
+
+def _name_ok(e, name: str) -> bool:
+    return name == "*" or e.tag == name
+
+
+def _split_pred(step: str):
+    if "[" in step and step.endswith("]"):
+        name, _, pred = step.partition("[")
+        return name, pred[:-1]
+    return step, None
+
+
+def _apply_pred(nodes, pred: Optional[str]):
+    if pred is None:
+        return nodes
+    pred = pred.strip()
+    if pred.isdigit():
+        i = int(pred)
+        return [nodes[i - 1]] if 1 <= i <= len(nodes) else []
+    if pred.startswith("@") and "=" in pred:
+        attr, _, val = pred[1:].partition("=")
+        val = val.strip().strip("'\"")
+        return [n for n in nodes if n.attrib.get(attr.strip()) == val]
+    return None  # unsupported predicate -> null rows
+
+
+class _XPathBase(Expression):
+    is_host_kernel = True
+    _fname = "xpath"
+
+    def __init__(self, children: List[Expression]):
+        super().__init__(list(children))
+
+    def sql_string(self):
+        args = ", ".join(c.sql_string() for c in self.children)
+        return f"{self._fname}({args})"
+
+    def _path(self) -> Optional[str]:
+        from spark_rapids_tpu.expr.base import Literal
+
+        p = self.children[1]
+        return str(p.value) if isinstance(p, Literal) \
+            and p.value is not None else None
+
+    def _convert(self, matches: Optional[List[str]]):
+        raise NotImplementedError
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        from spark_rapids_tpu.columnar.column import (DeviceColumn,
+                                                      HostColumn)
+
+        c = cols[0]
+        cap = c.capacity
+        n = int(ctx.batch.num_rows)
+        path = self._path()
+        vals = c.to_host(n).to_pylist()
+        out = [self._convert(xpath_eval(v, path)) if path is not None
+               else None for v in vals]
+        host = HostColumn.from_pylist(out, self.dataType)
+        return DeviceColumn.from_host(host, capacity=cap)
+
+
+class XPathList(_XPathBase):
+    _fname = "xpath"
+
+    def _resolve_type(self):
+        self._dataType = T.ArrayType(T.STRING, containsNull=False)
+        self._nullable = True
+
+    def _convert(self, m):
+        return m
+
+
+class XPathString(_XPathBase):
+    _fname = "xpath_string"
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def _convert(self, m):
+        if m is None:
+            return None
+        return m[0] if m else None
+
+
+class XPathBoolean(_XPathBase):
+    _fname = "xpath_boolean"
+
+    def _resolve_type(self):
+        self._dataType = T.BOOLEAN
+        self._nullable = True
+
+    def _convert(self, m):
+        if m is None:
+            return None
+        return bool(m)
+
+
+class _XPathNumeric(_XPathBase):
+    def _num(self, m):
+        if m is None or not m:
+            return None
+        try:
+            return float(m[0])
+        except ValueError:
+            return None
+
+
+class XPathShort(_XPathNumeric):
+    _fname = "xpath_short"
+
+    def _resolve_type(self):
+        self._dataType = T.SHORT
+        self._nullable = True
+
+    def _convert(self, m):
+        v = self._num(m)
+        if v is None:
+            return None
+        w = int(v)
+        return ((w + 2 ** 15) % 2 ** 16) - 2 ** 15
+
+
+class XPathInt(_XPathNumeric):
+    _fname = "xpath_int"
+
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = True
+
+    def _convert(self, m):
+        v = self._num(m)
+        if v is None:
+            return None
+        w = int(v)
+        return ((w + 2 ** 31) % 2 ** 32) - 2 ** 31
+
+
+class XPathLong(_XPathNumeric):
+    _fname = "xpath_long"
+
+    def _resolve_type(self):
+        self._dataType = T.LONG
+        self._nullable = True
+
+    def _convert(self, m):
+        v = self._num(m)
+        if v is None:
+            return None
+        w = int(v)
+        return ((w + 2 ** 63) % 2 ** 64) - 2 ** 63
+
+
+class XPathFloat(_XPathNumeric):
+    _fname = "xpath_float"
+
+    def _resolve_type(self):
+        self._dataType = T.FLOAT
+        self._nullable = True
+
+    def _convert(self, m):
+        return self._num(m)
+
+
+class XPathDouble(_XPathNumeric):
+    _fname = "xpath_double"
+
+    def _resolve_type(self):
+        self._dataType = T.DOUBLE
+        self._nullable = True
+
+    def _convert(self, m):
+        return self._num(m)

@@ -60,7 +60,11 @@ def compact_columns(mask: jax.Array,
             return DeviceColumn(c.dtype, validity, data=data,
                                 lengths=lengths, elem_valid=ev)
         if c.is_struct:
-            return DeviceColumn(c.dtype, validity,
+            lengths = None
+            if c.lengths is not None:   # entries layout (array<struct>)
+                lengths = jnp.zeros_like(c.lengths).at[scatter_idx].set(
+                    c.lengths, mode="drop")
+            return DeviceColumn(c.dtype, validity, lengths=lengths,
                                 children=tuple(_compact(k)
                                                for k in c.children))
         data = jnp.zeros_like(c.data).at[scatter_idx].set(

@@ -318,3 +318,69 @@ def xxhash64_columns(cols: List[DeviceColumn], seed: int = 42) -> jax.Array:
     for c in cols:
         h = xxhash64_column(c, h)
     return h.view(jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# HiveHash.  Reference analog: spark-rapids-jni hive_hash.cu backing
+# GpuHiveHash (SURVEY.md §2.5 Hash/misc).  Semantics: Spark's HiveHash
+# expression — h = 31*h + colHash per child (int32 wraparound), null -> 0;
+# string = byte-polynomial hash, long = (v ^ (v >>> 32)).
+# ---------------------------------------------------------------------------
+
+def hive_hash_column(c: DeviceColumn) -> jax.Array:
+    """Per-row Hive hash of one column (int32), null rows -> 0."""
+    dt = c.dtype
+    if c.is_string:
+        h = _hive_hash_string(c)
+    elif isinstance(dt, T.BooleanType):
+        h = c.data.astype(jnp.int32)
+    elif isinstance(dt, (T.LongType, T.TimestampType)):
+        v = c.data.astype(jnp.int64)
+        h = (v ^ jax.lax.shift_right_logical(
+            v, jnp.int64(32))).astype(jnp.int32)
+    elif isinstance(dt, T.FloatType):
+        f = c.data.astype(jnp.float32)
+        bits = f.view(jnp.int32)
+        bits = jnp.where(jnp.isnan(f), jnp.int32(0x7FC00000), bits)
+        h = bits
+    elif isinstance(dt, T.DoubleType):
+        d = c.data.astype(jnp.float64)
+        bits = d.view(jnp.int64)
+        bits = jnp.where(jnp.isnan(d),
+                         jnp.int64(0x7FF8000000000000), bits)
+        h = (bits ^ jax.lax.shift_right_logical(
+            bits, jnp.int64(32))).astype(jnp.int32)
+    else:  # byte/short/int/date
+        h = c.data.astype(jnp.int32)
+    return jnp.where(c.validity, h, jnp.int32(0))
+
+
+def _hive_hash_string(c: DeviceColumn) -> jax.Array:
+    """h = 31*h + byte over the row's UTF-8 bytes (chunked fori_loop —
+    O(1) compile size at any width bucket)."""
+    # Java HiveHasher reads SIGNED bytes; chars are stored unsigned
+    chars = c.chars.astype(jnp.int32)
+    chars = jnp.where(chars >= 128, chars - 256, chars)
+    w = chars.shape[1] if chars.ndim == 2 else 1
+    lens = c.lengths.astype(jnp.int32)
+    cap = chars.shape[0]
+    pow31 = jnp.int32(31)
+
+    def body(i, h):
+        byte = chars[:, i]
+        inside = i < lens
+        return jnp.where(inside, h * pow31 + byte, h)
+
+    h0 = jnp.zeros(cap, jnp.int32)
+    if w == 0:
+        return h0
+    return jax.lax.fori_loop(0, w, body, h0)
+
+
+def hive_hash_columns(cols: List[DeviceColumn]) -> jax.Array:
+    """HiveHash(c1..cn): h = 31*h + hash(ci), starting at 0."""
+    n = cols[0].capacity
+    h = jnp.zeros(n, jnp.int32)
+    for c in cols:
+        h = h * jnp.int32(31) + hive_hash_column(c)
+    return h

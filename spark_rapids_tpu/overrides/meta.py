@@ -86,10 +86,11 @@ class ExprMeta(BaseMeta):
         )
 
         allow_sa = getattr(self.rule, "allow_string_arrays", False)
+        allow_se = getattr(self.rule, "allow_struct_entries", False)
         for d in [dt] + [c._dataType for c in self.expr.children]:
             if d is None:
                 continue
-            reason = unsupported_nested_reason(d, allow_sa)
+            reason = unsupported_nested_reason(d, allow_sa, allow_se)
             if reason:
                 self.will_not_work_on_tpu(
                     f"expression {self.name}: {reason}")

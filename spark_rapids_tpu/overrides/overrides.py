@@ -30,6 +30,7 @@ from spark_rapids_tpu.expr import hashexprs as H
 from spark_rapids_tpu.expr import complextypes as CT
 from spark_rapids_tpu.expr import hof as HOF
 from spark_rapids_tpu.expr import jsonexprs as J
+from spark_rapids_tpu.expr import xpath as XP
 from spark_rapids_tpu.expr import mathfuncs as M
 from spark_rapids_tpu.expr import misc as MI
 from spark_rapids_tpu.expr import predicates as P
@@ -50,6 +51,8 @@ class ExprRule:
     desc: str = ""
     # array<string> (3-D char tensor) flows only through rules that opt in
     allow_string_arrays: bool = False
+    # array<struct<flat|string...>> (the entries layout) opt-in
+    allow_struct_entries: bool = False
 
 
 @dataclasses.dataclass
@@ -73,6 +76,76 @@ _NUM128 = _NUM + T.DECIMAL_128_SIG
 # checked recursively by TypeSig.supports)
 _ARRAY_SIG = T.TypeSig(frozenset({T.ArrayType}), 18)
 _WITH_ARRAYS = _DEC128_FULL + _ARRAY_SIG
+
+
+def _check_array_insert(meta: ExprMeta):
+    e = meta.expr
+    if e.pos_literal is None or int(e.pos_literal) == 0:
+        meta.will_not_work_on_tpu(
+            "array_insert position must be a non-zero literal on TPU "
+            "(the output width bucket is a static shape)")
+
+
+def _check_flatten(meta: ExprMeta):
+    e = meta.expr
+    if not (e._absorbed
+            and all(isinstance(m.dataType, T.ArrayType)
+                    for m in e.children)):
+        meta.will_not_work_on_tpu(
+            "flatten supports array(a1, a2, ...) of array columns on TPU "
+            "(no general array<array> device layout)")
+
+
+def _check_str_to_map(meta: ExprMeta):
+    from spark_rapids_tpu.expr.base import Literal
+
+    for d in meta.expr.children[1:]:
+        if not isinstance(d, Literal):
+            meta.will_not_work_on_tpu(
+                "str_to_map delimiters must be string literals")
+            break
+
+
+def _check_schema_of_json(meta: ExprMeta):
+    try:
+        meta.expr._folded()
+    except Exception as ex:  # non-literal / bad json: CPU raises instead
+        meta.will_not_work_on_tpu(f"schema_of_json: {ex}")
+
+
+def _check_hive_hash(meta: ExprMeta):
+    for c in meta.expr.children:
+        if isinstance(c.dataType, (T.DecimalType, T.TimestampType,
+                                   T.ArrayType, T.MapType, T.StructType)):
+            meta.will_not_work_on_tpu(
+                f"hive_hash of {c.dataType.simpleString} is not supported "
+                f"on TPU")
+            break
+
+
+def _check_xpath(meta: ExprMeta):
+    from spark_rapids_tpu.expr.base import Literal
+
+    p = meta.expr.children[1]
+    if not (isinstance(p, Literal) and p.value is not None):
+        meta.will_not_work_on_tpu("xpath path must be a string literal")
+
+
+def _check_decimal_div(meta: ExprMeta):
+    """Decimal divide computes numerator = l * 10^(s - ls + rs) in int64;
+    operands whose numerator can exceed 18 digits fall back (reference:
+    decimal_utils.cu 128-bit division; silent-null was a round-4 bug)."""
+    e = meta.expr
+    dt = e.dataType
+    if not isinstance(dt, T.DecimalType):
+        return
+    lt = e.left.dataType
+    rt = e.right.dataType
+    shift = dt.scale - lt.scale + rt.scale
+    if lt.precision + max(shift, 0) > 18 or rt.precision + max(-shift, 0) > 18:
+        meta.will_not_work_on_tpu(
+            "decimal divide intermediate exceeds 18 digits "
+            "(128-bit division is not implemented on TPU)")
 
 
 def _check_decimal_mult(meta: ExprMeta):
@@ -416,17 +489,33 @@ _PRIM_ELEM = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,
               T.TimestampType)
 
 
-def unsupported_nested_reason(dt, allow_string_elems=False) -> Optional[str]:
+def unsupported_nested_reason(dt, allow_string_elems=False,
+                              allow_struct_entries=False) -> Optional[str]:
     """Why a nested type cannot live in device columns yet, or None.
 
     Array elements and map keys/values must be flat primitives (the padded
     list layout stores one numeric matrix); struct fields may additionally
     be strings.  TypeSig.supports recurses with the FULL kind set, which
     would wrongly admit array<string>, so every rule whose sig includes
-    nested kinds routes through this check."""
+    nested kinds routes through this check.  ``allow_struct_entries``
+    admits array<struct<flat-or-string...>> — the entries layout
+    (per-field array-column children) used by map_entries/arrays_zip."""
     if isinstance(dt, T.ArrayType):
         et = dt.elementType
         if allow_string_elems and isinstance(et, T.StringType):
+            return None
+        if isinstance(et, T.StructType):
+            # the ENTRIES layout (per-field array-column children) is a
+            # first-class representation: gather/compact/concat/host
+            # conversions all handle it, so array<struct<flat|string>>
+            # flows through any exec
+            for f in et.fields:
+                fd = f.dataType
+                ok = isinstance(fd, (T.StringType,) + _PRIM_ELEM) or (
+                    isinstance(fd, T.DecimalType) and not fd.is_128)
+                if not ok:
+                    return (f"{dt.simpleString}: entries-struct fields "
+                            f"must be flat or string on TPU")
             return None
         if isinstance(et, T.DecimalType):
             return None if not et.is_128 else \
@@ -581,12 +670,21 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
     E.AttributeReference: ExprRule(_WITH_ARRAYS + _WITH_MAPS,
                                    desc="column reference",
                                    allow_string_arrays=True),
-    E.Alias: ExprRule(_WITH_ARRAYS + _WITH_MAPS, desc="alias",
-                      allow_string_arrays=True),
+    E.Alias: ExprRule(_WITH_ARRAYS + _WITH_MAPS
+                      + T.TypeSig(frozenset({T.StructType})),
+                      desc="alias", allow_string_arrays=True),
     A.Add: ExprRule(_NUM128, extra_check=_check_decimal_addsub),
     A.Subtract: ExprRule(_NUM128, extra_check=_check_decimal_addsub),
     A.Multiply: ExprRule(_NUM128, extra_check=_check_decimal_mult),
-    A.Divide: ExprRule(_NUM),
+    A.Divide: ExprRule(_NUM, extra_check=_check_decimal_div),
+    A.TryAdd: ExprRule(_NUM128, extra_check=_check_decimal_addsub,
+                       desc="ANSI op, errors become null"),
+    A.TrySubtract: ExprRule(_NUM128, extra_check=_check_decimal_addsub,
+                            desc="ANSI op, errors become null"),
+    A.TryMultiply: ExprRule(_NUM128, extra_check=_check_decimal_mult,
+                            desc="ANSI op, errors become null"),
+    A.TryDivide: ExprRule(_NUM, extra_check=_check_decimal_div,
+                          desc="ANSI op, errors become null"),
     A.IntegralDivide: ExprRule(_NUM), A.Remainder: ExprRule(_NUM),
     A.Pmod: ExprRule(_NUM), A.UnaryMinus: ExprRule(_NUM),
     A.Abs: ExprRule(_NUM),
@@ -793,6 +891,11 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
             T.TimestampType,
             "captured once per query (UTC session timezone)")),
     DT.DatePart: ExprRule(T.DATETIME_SIG + T.INTEGRAL_SIG),
+    MI.BitGet: ExprRule(T.INTEGRAL_SIG),
+    MI.AssertTrue: ExprRule(T.BOOLEAN_SIG + T.NULL_SIG),
+    MI.TypeOf: ExprRule(_WITH_ARRAYS + _WITH_MAPS,
+                        allow_string_arrays=True,
+                        desc="plan-time constant"),
     MI.UrlEncode: ExprRule(T.STRING_SIG, desc="host kernel"),
     MI.UrlDecode: ExprRule(T.STRING_SIG, desc="host kernel"),
     MI.JsonArrayLength: ExprRule(T.STRING_SIG + T.INTEGRAL_SIG,
@@ -828,6 +931,8 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
     CL.ArraySize: ExprRule(_WITH_ARRAYS, allow_string_arrays=True),
     H.Murmur3Hash: ExprRule(_COMMON128, desc="Spark murmur3 hash"),
     H.XxHash64: ExprRule(_COMMON128, desc="Spark xxhash64"),
+    H.HiveHash: ExprRule(_COMMON, extra_check=_check_hive_hash,
+                         desc="Hive hash (31*h + colHash)"),
     H.BloomFilterMightContain: ExprRule(
         _COMMON128 + _ARRAY_SIG.with_note(
             T.ArrayType,
@@ -849,6 +954,22 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
     CL.ArrayUnion: ExprRule(_WITH_ARRAYS),
     CL.ArrayIntersect: ExprRule(_WITH_ARRAYS),
     CL.ArrayExcept: ExprRule(_WITH_ARRAYS),
+    CL.ArrayInsert: ExprRule(_WITH_ARRAYS,
+                             extra_check=_check_array_insert,
+                             allow_string_arrays=True),
+    CL.Flatten: ExprRule(_WITH_ARRAYS, extra_check=_check_flatten,
+                         allow_string_arrays=True),
+    CL.StrToMap: ExprRule(T.STRING_SIG + T.NULL_SIG + T.TypeSig(
+        frozenset({T.MapType, T.ArrayType})),
+                          extra_check=_check_str_to_map,
+                          desc="host kernel (split family)"),
+    CL.MapEntries: ExprRule(
+        _WITH_MAPS + T.TypeSig(frozenset({T.StructType})),
+        allow_struct_entries=True, desc="entries layout"),
+    CL.ArraysZip: ExprRule(
+        _WITH_ARRAYS + T.TypeSig(frozenset({T.StructType})),
+        allow_struct_entries=True, allow_string_arrays=True,
+        desc="entries layout"),
     CL.Slice: ExprRule(_WITH_ARRAYS),
     CL.SortArray: ExprRule(
         _WITH_ARRAYS + T.BOOLEAN_SIG,
@@ -862,6 +983,7 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
             T.ArrayType,
             f"sequence length capped at {CL.Sequence.MAX_ELEMENTS}")),
     HOF.ArrayTransform: ExprRule(_WITH_ARRAYS, extra_check=_check_hof),
+    HOF.MapZipWith: ExprRule(_WITH_MAPS + T.STRING_SIG),
     HOF.ArrayFilter: ExprRule(_WITH_ARRAYS, extra_check=_check_hof),
     HOF.ArrayExists: ExprRule(
         _WITH_ARRAYS + T.BOOLEAN_SIG, extra_check=_check_hof),
@@ -886,6 +1008,28 @@ EXPRESSIONS: Dict[Type, ExprRule] = {
     U.UserDefinedExpression: ExprRule(
         _DEC128_FULL, extra_check=_check_udf,
         desc="TpuUDF (RapidsUDF analog): columnar jax kernel"),
+    J.SchemaOfJson: ExprRule(T.STRING_SIG,
+                            extra_check=_check_schema_of_json,
+                            desc="plan-time constant fold"),
+    XP.XPathList: ExprRule(T.STRING_SIG + T.NULL_SIG,
+                           extra_check=_check_xpath,
+                           allow_string_arrays=True,
+                           desc="host kernel"),
+    XP.XPathString: ExprRule(T.STRING_SIG + T.NULL_SIG,
+                             extra_check=_check_xpath, desc="host kernel"),
+    XP.XPathBoolean: ExprRule(T.STRING_SIG + T.NULL_SIG,
+                              extra_check=_check_xpath,
+                              desc="host kernel"),
+    XP.XPathShort: ExprRule(T.STRING_SIG + T.NULL_SIG,
+                            extra_check=_check_xpath, desc="host kernel"),
+    XP.XPathInt: ExprRule(T.STRING_SIG + T.NULL_SIG,
+                          extra_check=_check_xpath, desc="host kernel"),
+    XP.XPathLong: ExprRule(T.STRING_SIG + T.NULL_SIG,
+                           extra_check=_check_xpath, desc="host kernel"),
+    XP.XPathFloat: ExprRule(T.STRING_SIG + T.NULL_SIG,
+                            extra_check=_check_xpath, desc="host kernel"),
+    XP.XPathDouble: ExprRule(T.STRING_SIG + T.NULL_SIG,
+                             extra_check=_check_xpath, desc="host kernel"),
     J.GetJsonObject: ExprRule(
         T.STRING_SIG.with_note(
             T.StringType,
@@ -961,7 +1105,13 @@ _AGG_FUNCS_SUPPORTED = {"sum", "count", "count_star", "min", "max", "avg",
                         "count_if", "skewness", "kurtosis", "corr",
                         "covar_pop", "covar_samp", "percentile",
                         "approx_percentile", "approx_count_distinct",
-                        "bloom_filter_agg"}
+                        "bloom_filter_agg",
+                        # round 4: bool/bit/any_value/median + regr family
+                        "bool_and", "bool_or", "bit_and", "bit_or",
+                        "bit_xor", "any_value", "median",
+                        "regr_count", "regr_avgx", "regr_avgy", "regr_sxx",
+                        "regr_syy", "regr_sxy", "regr_slope",
+                        "regr_intercept", "regr_r2"}
 
 _NUMERIC_AGG_INPUT = (T.ByteType, T.ShortType, T.IntegerType, T.LongType,
                       T.FloatType, T.DoubleType, T.DecimalType)
@@ -977,13 +1127,24 @@ def _agg_extra_checks(meta: SparkPlanMeta, a) -> None:
             and not isinstance(ct, _NUMERIC_AGG_INPUT):
         meta.will_not_work_on_tpu(
             f"{a.func} requires a numeric input")
-    if a.func in PN.COVARIANCE_FUNCS:
+    if a.func in PN.COVARIANCE_FUNCS or a.func in PN.REGR_FUNCS:
         c2 = a.child2._dataType if a.child2 is not None else None
         for part in (ct, c2):
             if not isinstance(part, _NUMERIC_AGG_INPUT):
                 meta.will_not_work_on_tpu(
                     f"{a.func} requires numeric inputs")
                 break
+    if a.func in ("bool_and", "bool_or") \
+            and not isinstance(ct, T.BooleanType):
+        meta.will_not_work_on_tpu(f"{a.func} requires a boolean input")
+    if a.func in ("bit_and", "bit_or", "bit_xor") \
+            and not (ct is not None and ct.is_integral):
+        meta.will_not_work_on_tpu(f"{a.func} requires an integral input")
+    if a.func == "median" and not isinstance(ct, _NUMERIC_AGG_INPUT):
+        meta.will_not_work_on_tpu("median requires a numeric input")
+    if a.func == "median" and isinstance(ct, T.DecimalType) and ct.is_128:
+        meta.will_not_work_on_tpu(
+            "median over decimal128 is not supported on TPU")
     if a.func in ("percentile", "approx_percentile"):
         if not a.args or not (0.0 <= float(a.args[0]) <= 1.0):
             meta.will_not_work_on_tpu(

@@ -181,11 +181,20 @@ HIGHER_MOMENT_FUNCS = frozenset({"skewness", "kurtosis"})
 # buffers; corr adds xMk/yMk)
 COVARIANCE_FUNCS = frozenset({"covar_pop", "covar_samp", "corr"})
 
+# linear-regression family (Spark RegrCount/RegrAvgX/...): rides the same
+# covariance buffers — regr_f(y, x) observes rows where BOTH are non-null
+REGR_FUNCS = frozenset(
+    {"regr_count", "regr_avgx", "regr_avgy", "regr_sxx", "regr_syy",
+     "regr_sxy", "regr_slope", "regr_intercept", "regr_r2"})
+
+# bitwise aggregates (Spark BitAndAgg/BitOrAgg/BitXorAgg)
+BIT_AGG_FUNCS = frozenset({"bit_and", "bit_or", "bit_xor"})
+
 # single-phase aggregates (planned COMPLETE after a hash exchange, like
 # collect_list — their state is the whole group)
 SINGLE_PHASE_FUNCS = frozenset(
     {"collect_list", "collect_set", "percentile", "approx_percentile",
-     "bloom_filter_agg"})
+     "median", "bloom_filter_agg"})
 
 # PARTIAL-mode buffer field suffixes per moment-family func; every buffer
 # column is DOUBLE
@@ -199,6 +208,10 @@ MOMENT_BUFFERS = {
     "covar_pop": ("_n", "_xavg", "_yavg", "_ck"),
     "covar_samp": ("_n", "_xavg", "_yavg", "_ck"),
     "corr": ("_n", "_xavg", "_yavg", "_ck", "_xm2", "_ym2"),
+    **{f: ("_n", "_xavg", "_yavg", "_ck", "_xm2", "_ym2")
+       for f in ("regr_count", "regr_avgx", "regr_avgy", "regr_sxx",
+                 "regr_syy", "regr_sxy", "regr_slope", "regr_intercept",
+                 "regr_r2")},
 }
 
 # default register-count exponent for approx_count_distinct at Spark's
@@ -251,7 +264,11 @@ class AggregateExpression:
         if self.func in VARIANCE_FUNCS or self.func in HIGHER_MOMENT_FUNCS \
                 or self.func in COVARIANCE_FUNCS:
             return T.DOUBLE
-        if self.func == "percentile":
+        if self.func == "regr_count":
+            return T.LONG
+        if self.func in REGR_FUNCS:
+            return T.DOUBLE
+        if self.func in ("percentile", "median"):
             return T.DOUBLE
         if self.func == "approx_percentile":
             return ct

@@ -827,6 +827,53 @@ def kurtosis_(c: ColumnLike, name: str = "kurtosis"):
     return ("kurtosis", c, name)
 
 
+def bool_and_(c: ColumnLike, name: str = "bool_and"):
+    return PN.AggregateExpression("bool_and", _to_expr(c), name)
+
+
+def bool_or_(c: ColumnLike, name: str = "bool_or"):
+    return PN.AggregateExpression("bool_or", _to_expr(c), name)
+
+
+def bit_and_(c: ColumnLike, name: str = "bit_and"):
+    return PN.AggregateExpression("bit_and", _to_expr(c), name)
+
+
+def bit_or_(c: ColumnLike, name: str = "bit_or"):
+    return PN.AggregateExpression("bit_or", _to_expr(c), name)
+
+
+def bit_xor_(c: ColumnLike, name: str = "bit_xor"):
+    return PN.AggregateExpression("bit_xor", _to_expr(c), name)
+
+
+def any_value_(c: ColumnLike, name: str = "any_value"):
+    return PN.AggregateExpression("any_value", _to_expr(c), name)
+
+
+def median_(c: ColumnLike, name: str = "median"):
+    return PN.AggregateExpression("median", _to_expr(c), name)
+
+
+def _regr(func):
+    def helper(y: ColumnLike, x: ColumnLike, name: str = None):
+        return PN.AggregateExpression(func, _to_expr(y), name or func,
+                                      child2=_to_expr(x))
+    helper.__name__ = func + "_"
+    return helper
+
+
+regr_count_ = _regr("regr_count")
+regr_avgx_ = _regr("regr_avgx")
+regr_avgy_ = _regr("regr_avgy")
+regr_sxx_ = _regr("regr_sxx")
+regr_syy_ = _regr("regr_syy")
+regr_sxy_ = _regr("regr_sxy")
+regr_slope_ = _regr("regr_slope")
+regr_intercept_ = _regr("regr_intercept")
+regr_r2_ = _regr("regr_r2")
+
+
 def corr_(x: ColumnLike, y: ColumnLike, name: str = "corr"):
     return PN.AggregateExpression("corr", _to_expr(x), name,
                                   child2=_to_expr(y))

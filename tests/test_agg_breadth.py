@@ -187,3 +187,87 @@ def test_percentile_all_null_group():
                                     approx_percentile_("v", 0.5, name="ap"))
 
     assert_tpu_and_cpu_are_equal_collect(build)
+
+
+# -- round 4: bool/bit/any_value/median + regr family -----------------------
+
+
+def test_bool_and_or_agg():
+    from spark_rapids_tpu.session import bool_and_, bool_or_
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=4),
+                        BooleanGen()], ["k", "b"], length=400)
+        return df.group_by("k").agg(bool_and_("b", "ba"),
+                                    bool_or_("b", "bo"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_bit_agg():
+    from spark_rapids_tpu.session import bit_and_, bit_or_, bit_xor_
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=4),
+                        LongGen(min_val=-1000, max_val=1000)],
+                    ["k", "v"], length=400)
+        return df.group_by("k").agg(bit_and_("v", "ba"),
+                                    bit_or_("v", "bo"),
+                                    bit_xor_("v", "bx"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_any_value_and_median():
+    from spark_rapids_tpu.session import any_value_, median_
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=4),
+                        LongGen(min_val=-500, max_val=500)],
+                    ["k", "v"], length=400)
+        return df.group_by("k").agg(any_value_("v", "av"),
+                                    median_("v", "md"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_regr_family():
+    from spark_rapids_tpu.session import (regr_avgx_, regr_avgy_,
+                                          regr_count_, regr_intercept_,
+                                          regr_r2_, regr_slope_,
+                                          regr_sxx_, regr_sxy_, regr_syy_)
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=3),
+                        DoubleGen(), DoubleGen()],
+                    ["k", "y", "x"], length=400)
+        return df.group_by("k").agg(
+            regr_count_("y", "x", "rc"), regr_avgx_("y", "x", "rax"),
+            regr_avgy_("y", "x", "ray"), regr_sxx_("y", "x", "sxx"),
+            regr_syy_("y", "x", "syy"), regr_sxy_("y", "x", "sxy"),
+            regr_slope_("y", "x", "sl"), regr_intercept_("y", "x", "ic"),
+            regr_r2_("y", "x", "r2"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, approximate_float=True)
+
+
+def test_regr_two_phase_partial_final():
+    """regr buffers merge through the exchange (PARTIAL -> FINAL)."""
+    from spark_rapids_tpu.session import regr_slope_, regr_count_
+
+    conf = {"spark.rapids.sql.enabled": True,
+            "spark.rapids.tpu.completeAggCollapse.enabled": False}
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=5),
+                        DoubleGen(), DoubleGen()],
+                    ["k", "y", "x"], length=600)
+        from spark_rapids_tpu.session import (any_value_, bit_xor_,
+                                              bool_or_)
+
+        return df.group_by("k").agg(regr_slope_("y", "x", "sl"),
+                                    regr_count_("y", "x", "rc"),
+                                    bit_xor_("k", "bx"))
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=conf,
+                                         approximate_float=True)

@@ -125,12 +125,24 @@ def test_cast_bool():
     assert_tpu_and_cpu_are_equal_collect(build)
 
 
-def test_unsupported_cast_falls_back():
-    # float->string is not on the TPU yet: the Project must fall back,
-    # results still correct via CPU (the reference's fallback contract).
+def test_fp_to_string_cast_runs_on_tpu():
+    # round 4: float->string runs as a host-kernel cast inside the TPU
+    # plan (Java shortest-repr formatting) instead of falling back
     def build(s):
         df = gen_df(s, [DoubleGen()], ["a"], length=50)
         return df.select(col("a").cast(T.STRING).alias("s"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_unsupported_cast_falls_back():
+    # a cast pair with no device or host path still falls back with the
+    # reference's tag-or-fallback contract (date -> boolean)
+    def build(s):
+        from data_gen import DateGen
+
+        df = gen_df(s, [DateGen()], ["a"], length=50)
+        return df.select(col("a").cast(T.BOOLEAN).alias("b"))
 
     assert_tpu_fallback_collect(build, "Project")
 

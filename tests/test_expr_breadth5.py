@@ -1,0 +1,396 @@
+"""Round-4 expression breadth: hive_hash, array_insert, flatten,
+str_to_map, schema_of_json, the xpath family, and fp<->string casts
+(reference: hash_aggregate_test.py / collection_ops_test.py /
+xpath_test.py / cast_test.py)."""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.session import TpuSession, col, lit
+
+from asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    assert_tpu_fallback_collect,
+)
+from data_gen import (
+    ArrayGen,
+    BooleanGen,
+    DateGen,
+    DoubleGen,
+    FloatGen,
+    IntegerGen,
+    LongGen,
+    StringGen,
+    gen_df,
+)
+
+
+def test_hive_hash():
+    from spark_rapids_tpu.expr.hashexprs import HiveHash
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(), LongGen(), StringGen(max_len=12),
+                        BooleanGen(), DoubleGen(), FloatGen(), DateGen()],
+                    ["i", "l", "t", "b", "d", "f", "dt"], length=300)
+        return df.select(
+            HiveHash([col("i"), col("l"), col("t"), col("b"),
+                      col("d"), col("f"), col("dt")]).alias("h"),
+            HiveHash([col("t")]).alias("hs"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_hive_hash_decimal_falls_back():
+    from spark_rapids_tpu.expr.hashexprs import HiveHash
+    from data_gen import DecimalGen
+
+    def build(s):
+        df = gen_df(s, [DecimalGen(10, 2)], ["d"], length=50)
+        return df.select(HiveHash([col("d")]).alias("h"))
+
+    assert_tpu_fallback_collect(build, "Project")
+
+
+@pytest.mark.parametrize("pos", [1, 3, 7, -1, -2, -8])
+def test_array_insert(pos):
+    from spark_rapids_tpu.expr.collections import ArrayInsert
+
+    def build(s):
+        df = gen_df(s, [ArrayGen(IntegerGen(), max_len=5), IntegerGen()],
+                    ["a", "v"], length=300)
+        return df.select(
+            ArrayInsert([col("a"), lit(pos), col("v")]).alias("out"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_array_insert_strings():
+    from spark_rapids_tpu.expr.collections import ArrayInsert
+
+    def build(s):
+        df = gen_df(s, [ArrayGen(StringGen(max_len=6), max_len=4),
+                        StringGen(max_len=6)], ["a", "v"], length=200)
+        return df.select(
+            ArrayInsert([col("a"), lit(2), col("v")]).alias("out"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_flatten_of_create_array():
+    from spark_rapids_tpu.expr.collections import CreateArray, Flatten
+
+    def build(s):
+        df = gen_df(s, [ArrayGen(IntegerGen(), max_len=4),
+                        ArrayGen(IntegerGen(), max_len=3)],
+                    ["a", "b"], length=300)
+        return df.select(
+            Flatten(CreateArray([col("a"), col("b")])).alias("f"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_flatten_general_tags_fallback_reason():
+    """A flatten whose child is not array(a1, ...) is tagged off the TPU
+    plan with a visible reason (plan-time only: the padded layout cannot
+    even construct a general array<array> column to execute)."""
+    from spark_rapids_tpu.expr.collections import CreateArray, Flatten
+
+    s = TpuSession({"spark.rapids.sql.enabled": True,
+                    "spark.rapids.sql.explain": "NOT_ON_GPU"})
+    df = gen_df(s, [ArrayGen(IntegerGen(), max_len=4),
+                    ArrayGen(IntegerGen(), max_len=3)], ["a", "b"],
+                length=20)
+    # nested-element members: array(array(...)) of STRING arrays is fine,
+    # but a non-CreateArray child must tag the reason
+    inner = CreateArray([col("a"), col("b")])
+    q = df.select(Flatten(Flatten(CreateArray([inner]))).alias("f"))
+    txt = q.explain()
+    assert "flatten" in txt.lower(), txt
+
+
+def test_str_to_map():
+    from spark_rapids_tpu.expr.collections import StrToMap
+
+    def build(s):
+        df = s.create_dataframe(
+            {"t": ["a:1,b:2", "x:9", "", "k", "a:1,b", None,
+                   "q:1,r:2,s:3"]},
+            T.StructType([T.StructField("t", T.STRING, True)]))
+        m = StrToMap([col("t")])
+        from spark_rapids_tpu.expr.collections import MapKeys, MapValues
+
+        return df.select(MapKeys(m).alias("ks"), MapValues(m).alias("vs"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_schema_of_json():
+    from spark_rapids_tpu.expr.jsonexprs import SchemaOfJson
+
+    def build(s):
+        df = gen_df(s, [IntegerGen()], ["i"], length=20)
+        return df.select(
+            SchemaOfJson([lit('{"a": 1, "b": "x", "c": [1.5]}')])
+            .alias("sch"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+_XML = [
+    "<a><b>1</b><b>2</b><c attr='z'>t</c></a>",
+    "<a><b>7</b></a>",
+    "<a><c attr='q'>only</c></a>",
+    "not xml",
+    None,
+    "<a><b>3.5</b><b x='y'>4</b></a>",
+]
+
+
+def _xml_df(s):
+    return s.create_dataframe(
+        {"x": _XML},
+        T.StructType([T.StructField("x", T.STRING, True)]))
+
+
+def test_xpath_scalars():
+    from spark_rapids_tpu.expr.xpath import (XPathBoolean, XPathDouble,
+                                             XPathInt, XPathLong,
+                                             XPathString)
+
+    def build(s):
+        df = _xml_df(s)
+        return df.select(
+            XPathString([col("x"), lit("/a/b")]).alias("s"),
+            XPathInt([col("x"), lit("/a/b")]).alias("i"),
+            XPathLong([col("x"), lit("/a/b")]).alias("l"),
+            XPathDouble([col("x"), lit("/a/b")]).alias("d"),
+            XPathBoolean([col("x"), lit("/a/c")]).alias("bc"),
+            XPathString([col("x"), lit("/a/c/@attr")]).alias("at"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_xpath_list():
+    from spark_rapids_tpu.expr.xpath import XPathList
+
+    def build(s):
+        df = _xml_df(s)
+        return df.select(
+            XPathList([col("x"), lit("//b/text()")]).alias("lst"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_xpath_non_literal_path_falls_back():
+    from spark_rapids_tpu.expr.xpath import XPathString
+
+    def build(s):
+        df = _xml_df(s)
+        return df.select(
+            XPathString([col("x"), col("x")]).alias("s"))
+
+    assert_tpu_fallback_collect(build, "Project")
+
+
+def test_cast_fp_to_string():
+    def build(s):
+        df = gen_df(s, [DoubleGen(), FloatGen()], ["d", "f"], length=300)
+        from spark_rapids_tpu.expr.cast import Cast
+
+        return df.select(Cast(col("d"), T.STRING).alias("ds"),
+                         Cast(col("f"), T.STRING).alias("fs"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_cast_fp_to_string_specials():
+    def build(s):
+        df = s.create_dataframe(
+            {"d": [0.0, -0.0, 1.0, 1e7, 9999999.5, 1e-3, 9.99e-4,
+                   float("nan"), float("inf"), float("-inf"),
+                   123.456, -2.5e-10, None]},
+            T.StructType([T.StructField("d", T.DOUBLE, True)]))
+        from spark_rapids_tpu.expr.cast import Cast
+
+        return df.select(Cast(col("d"), T.STRING).alias("s"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_cast_string_to_fp():
+    def build(s):
+        df = s.create_dataframe(
+            {"t": ["1.5", " 2 ", "1e3", "-0.0", "inf", "Infinity", "NaN",
+                   "abc", "", None, ".5", "5."]},
+            T.StructType([T.StructField("t", T.STRING, True)]))
+        from spark_rapids_tpu.expr.cast import Cast
+
+        return df.select(Cast(col("t"), T.DOUBLE).alias("d"),
+                         Cast(col("t"), T.FLOAT).alias("f"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_try_arithmetic_ints():
+    from spark_rapids_tpu.expr.arithmetic import (TryAdd, TryDivide,
+                                                  TryMultiply, TrySubtract)
+
+    def build(s):
+        df = s.create_dataframe(
+            {"a": [2147483647, -2147483648, 5, 100, None],
+             "b": [1, -1, 3, 0, 7]},
+            T.StructType([T.StructField("a", T.INT, True),
+                          T.StructField("b", T.INT, True)]))
+        return df.select(
+            TryAdd(col("a"), col("b")).alias("ta"),
+            TrySubtract(col("a"), col("b")).alias("ts"),
+            TryMultiply(col("a"), col("b")).alias("tm"),
+            TryDivide(col("a"), col("b")).alias("td"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_try_arithmetic_decimal():
+    from decimal import Decimal
+
+    from spark_rapids_tpu.expr.arithmetic import TryAdd, TryDivide
+
+    def build(s):
+        df = s.create_dataframe(
+            {"a": [Decimal("999.99"), Decimal("1.50"), None],
+             "b": [Decimal("1.00"), Decimal("0.00"), Decimal("2.00")]},
+            T.StructType([T.StructField("a", T.DecimalType(5, 2), True),
+                          T.StructField("b", T.DecimalType(5, 2), True)]))
+        return df.select(TryAdd(col("a"), col("b")).alias("ta"),
+                         TryDivide(col("a"), col("b")).alias("td"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_decimal_divide_wide_falls_back_correctly():
+    """dec(10,2)/dec(10,2) needs a >18-digit numerator: the plan must
+    fall back (round-4 caught silent nulls here) and values must match
+    the exact oracle division."""
+    from decimal import Decimal
+
+    from spark_rapids_tpu.expr.arithmetic import Divide
+
+    def build(s):
+        df = s.create_dataframe(
+            {"a": [Decimal("99999999.99"), Decimal("1.50")],
+             "b": [Decimal("1.00"), Decimal("3.00")]},
+            T.StructType([T.StructField("a", T.DecimalType(10, 2), True),
+                          T.StructField("b", T.DecimalType(10, 2), True)]))
+        return df.select(Divide(col("a"), col("b")).alias("d"))
+
+    assert_tpu_fallback_collect(build, "Project")
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_bit_get_typeof():
+    from spark_rapids_tpu.expr.misc import BitGet, TypeOf
+
+    def build(s):
+        df = gen_df(s, [LongGen(), StringGen(min_len=1, max_len=10)],
+                    ["v", "t"], length=200)
+        return df.select(
+            BitGet(col("v"), lit(3)).alias("b3"),
+            BitGet(col("v"), lit(63)).alias("b63"),
+            TypeOf(col("v")).alias("ty"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_assert_true_raises_both_ways():
+    import pytest as _pt
+
+    from spark_rapids_tpu.expr.misc import AssertTrue
+
+    for enabled in (True, False):
+        s = TpuSession({"spark.rapids.sql.enabled": enabled})
+        df = s.create_dataframe(
+            {"v": [1, 2, 3]},
+            T.StructType([T.StructField("v", T.INT, False)]))
+        ok = df.select(AssertTrue((col("v") > lit(0))).alias("x"))
+        assert ok.collect() == [(None,), (None,), (None,)]
+        bad = df.select(AssertTrue((col("v") > lit(1))).alias("x"))
+        with _pt.raises(Exception):
+            bad.collect()
+
+
+def test_map_entries():
+    from spark_rapids_tpu.expr.collections import CreateMap, MapEntries
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=9, nullable=False),
+                        LongGen(), IntegerGen(min_val=10, max_val=19,
+                                              nullable=False), LongGen()],
+                    ["k1", "v1", "k2", "v2"], length=200)
+        m = CreateMap([col("k1"), col("v1"), col("k2"), col("v2")])
+        return df.select(MapEntries(m).alias("e"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_arrays_zip():
+    from spark_rapids_tpu.expr.collections import ArraysZip
+
+    def build(s):
+        df = gen_df(s, [ArrayGen(IntegerGen(), max_len=4),
+                        ArrayGen(LongGen(), max_len=6)],
+                    ["a", "b"], length=300)
+        return df.select(ArraysZip([col("a"), col("b")]).alias("z"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_map_zip_with():
+    from spark_rapids_tpu.expr.collections import CreateMap
+    from spark_rapids_tpu.expr.hof import MapZipWith
+    from spark_rapids_tpu.expr.arithmetic import Add
+    from spark_rapids_tpu.expr.conditional import Coalesce
+    from spark_rapids_tpu.expr.base import Literal
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=3, nullable=False),
+                        LongGen(min_val=-99, max_val=99),
+                        IntegerGen(min_val=2, max_val=5, nullable=False),
+                        LongGen(min_val=-99, max_val=99)],
+                    ["k1", "v1", "k2", "v2"], length=300)
+        m1 = CreateMap([col("k1"), col("v1")])
+        m2 = CreateMap([col("k2"), col("v2")])
+        body = Add(Coalesce([col("x"), Literal(0, T.LONG)]),
+                   Coalesce([col("y"), Literal(0, T.LONG)]))
+        return df.select(
+            MapZipWith(m1, m2, "k", "x", "y", body).alias("mz"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_entries_expressions_run_on_tpu():
+    """Regression guard (round-4 review): the entries-layout expressions
+    must EXECUTE on TPU — silent CPU fallback hid dead device code."""
+    from spark_rapids_tpu.expr.arithmetic import Add
+    from spark_rapids_tpu.expr.base import Literal
+    from spark_rapids_tpu.expr.collections import (ArraysZip, CreateArray,
+                                                   CreateMap, Flatten,
+                                                   MapEntries)
+    from spark_rapids_tpu.expr.conditional import Coalesce
+    from spark_rapids_tpu.expr.hof import MapZipWith
+
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    df = s.create_dataframe(
+        {"k": [1, 2], "v": [7, 8], "a": [[1, 2], [3]], "b": [[9], None]},
+        T.StructType([T.StructField("k", T.INT, False),
+                      T.StructField("v", T.INT, False),
+                      T.StructField("a", T.ArrayType(T.INT), True),
+                      T.StructField("b", T.ArrayType(T.INT), True)]))
+    m1 = CreateMap([col("k"), col("v")])
+    m2 = CreateMap([col("v"), col("k")])
+    body = Add(Coalesce([col("x"), Literal(0, T.INT)]),
+               Coalesce([col("y"), Literal(0, T.INT)]))
+    q = df.select(Flatten(CreateArray([col("a"), col("b")])).alias("f"),
+                  MapEntries(m1).alias("me"),
+                  ArraysZip([col("a"), col("b")]).alias("az"),
+                  MapZipWith(m1, m2, "k2", "x", "y", body).alias("mz"))
+    plan = q.explain()
+    assert "cannot run on TPU" not in plan, plan
