@@ -535,6 +535,141 @@ def main():
         abort("qb_left_join")
         return
 
+    # ---- rung 3 (BASELINE.md): nested structs + decimal128 through the
+    # OOC machinery under a constrained pool, with spill counters
+    # (VERDICT r3 Next #9) --------------------------------------------------
+    def run_rung3():
+        from decimal import Decimal as _D
+
+        import numpy as np
+
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.memory.spill import (get_spill_framework,
+                                                   reset_spill_framework)
+        from spark_rapids_tpu.session import (TpuSession, col, lit, max_,
+                                              min_, sum_)
+
+        # default = the full row count: the 64MiB pool floor needs >64MiB
+        # of live batches before the spill path engages
+        n3 = int(os.environ.get("BENCH_RUNG3_ROWS", max(n, 100_000)))
+        rng = np.random.default_rng(11)
+        k = rng.integers(0, 1000, n3).astype(np.int32)
+        amt = rng.integers(-10**12, 10**12, n3)   # DECIMAL(25,4) unscaled
+        qty = rng.integers(1, 100, n3).astype(np.int32)
+        sa = rng.integers(0, 10**6, n3)
+        sb = rng.integers(-500, 500, n3).astype(np.int32)
+
+        def build(s):
+            from spark_rapids_tpu.columnar.column import HostColumn
+            from spark_rapids_tpu.expr.complextypes import GetStructField
+            from spark_rapids_tpu.plan.nodes import LocalTableScan
+            from spark_rapids_tpu.session import DataFrame
+
+            dec = T.DecimalType(25, 4)
+            struct_t = T.StructType([T.StructField("a", T.LONG, False),
+                                     T.StructField("b", T.INT, False)])
+            host = [
+                HostColumn.from_numpy(k, T.INT),
+                HostColumn(dec,
+                           np.ones(n3, np.bool_),
+                           data=np.stack([np.where(amt < 0, -1, 0),
+                                          amt], axis=1).astype(np.int64)),
+                HostColumn.from_numpy(qty, T.INT),
+                HostColumn(struct_t, np.ones(n3, np.bool_), children=[
+                    HostColumn.from_numpy(sa, T.LONG),
+                    HostColumn.from_numpy(sb, T.INT)]),
+            ]
+            schema = T.StructType([
+                T.StructField("k", T.INT, False),
+                T.StructField("amt", dec, False),
+                T.StructField("qty", T.INT, False),
+                T.StructField("s", struct_t, False)])
+            df = DataFrame(LocalTableScan(host, schema), s)
+            return (df.filter(col("qty") > lit(5))
+                    .select(col("k"), col("amt"),
+                            GetStructField(col("s"), "a").alias("sa"))
+                    .group_by("k")
+                    .agg(sum_("amt", "sum_amt"), min_("amt", "lo"),
+                         max_("amt", "hi"), sum_("sa", "ssa")))
+
+        # constrain the pool so the OOC path must spill
+        reset_spill_framework()
+        from spark_rapids_tpu.config import TpuConf
+
+        conf = {"spark.rapids.sql.enabled": True,
+                "spark.rapids.memory.gpu.allocFraction": 0.0001,
+                "spark.rapids.sql.batchSizeBytes": 8 << 20,
+                "spark.rapids.sql.reader.batchSizeRows": max(n3 // 8, 1)}
+        fw = get_spill_framework(TpuConf(conf))
+        s = TpuSession(conf)
+        t_tpu, rows, ctr = _time_repeats(build(s).collect, repeats,
+                                         counters=True)
+        oracle_rows = build(_session(False)).collect()
+        assert sorted(rows) == sorted(oracle_rows), "rung3 mismatch"
+
+        # OOC evidence: a global sort of the full table under the 64MiB
+        # pool — TpuSortExec tracks its sorted runs as spillables, so the
+        # pool budget forces device->host spills (SURVEY.md §5.7)
+        def build_sort(sess):
+            from spark_rapids_tpu.columnar.column import HostColumn
+            from spark_rapids_tpu.plan.nodes import LocalTableScan
+            from spark_rapids_tpu.session import DataFrame
+
+            dec = T.DecimalType(25, 4)
+            schema = T.StructType([
+                T.StructField("k", T.INT, False),
+                T.StructField("amt", dec, False),
+                T.StructField("sa", T.LONG, False),
+                T.StructField("sa2", T.LONG, False),
+                T.StructField("sa3", T.LONG, False)])
+            # CHUNKED input (a union of scans): the out-of-core sort only
+            # forms spillable runs from a multi-batch stream; the payload
+            # columns push the tracked runs past the 64MiB pool floor so
+            # the spill path must engage
+            nchunk = 8
+            step = -(-n3 // nchunk)
+            df = None
+            for c0 in range(0, n3, step):
+                sl = slice(c0, min(c0 + step, n3))
+                m = sl.stop - sl.start
+                host = [HostColumn.from_numpy(k[sl], T.INT),
+                        HostColumn(dec, np.ones(m, np.bool_),
+                                   data=np.stack(
+                                       [np.where(amt[sl] < 0, -1, 0),
+                                        amt[sl]],
+                                       axis=1).astype(np.int64)),
+                        HostColumn.from_numpy(sa[sl], T.LONG),
+                        HostColumn.from_numpy(sa[sl] * 2, T.LONG),
+                        HostColumn.from_numpy(sa[sl] + 7, T.LONG)]
+                part = DataFrame(LocalTableScan(host, schema), sess)
+                df = part if df is None else df.union(part)
+            return df.order_by(col("amt"))
+
+        t_sort, nrows_sorted = _time_repeats(build_sort(s).count, repeats)
+        assert nrows_sorted == n3
+        queries["rung3_dec128_nested"] = dict(
+            tpu_s=t_tpu, cpu_vec_s=0.0, cpu_oracle_s=0.0,
+            rows_per_s=n3 / t_tpu, eff_gbps=0.0, vs_vec=1.0, vs_oracle=1.0,
+            oocSort_s=t_sort,
+            poolBytes=float(fw.pool_bytes),
+            spillToHostCount=float(fw.spill_to_host_count),
+            spillToHostBytes=float(fw.spill_to_host_bytes),
+            spillToDiskCount=float(fw.spill_to_disk_count),
+            **ctr)
+        reset_spill_framework()
+        progress(f"rung3: tpu {t_tpu:.2f}s pool={fw.pool_bytes >> 20}MiB "
+                 f"spills={fw.spill_to_host_count} "
+                 f"({fw.spill_to_host_bytes >> 20}MiB to host)")
+
+    if os.environ.get("BENCH_RUNG3", "1") != "0" and not over_budget():
+        try:
+            run_rung3()
+        except TimeoutError:
+            abort("qc_window")
+            return
+        except Exception as ex:   # rung-3 is additive: never lose rung 1-2
+            progress(f"rung3 failed: {ex!r}")
+
     def check_qc(rows, want):
         got = {(int(r[0]), int(r[1]), int(r[2].scaleb(2)), int(r[3]))
                for r in rows}

@@ -211,3 +211,207 @@ def read_iceberg(session, table_path: str,
         ddf = session.create_dataframe(data, dschema)
         df = df.join(ddf, on=names, how="left_anti")
     return df
+
+
+# ---------------------------------------------------------------------------
+# Write path (VERDICT r3 Next #7).  Reference analog: the reference's
+# Iceberg module is read-only too in most branches; Spark's Iceberg writes
+# go through the iceberg-spark runtime (SURVEY.md §2.8 Iceberg).  This
+# implements format-version-2 append/overwrite commits from scratch:
+# data parquet files + manifest avro + manifest-list avro + metadata json,
+# all round-tripping through this module's own reader and avro codec.
+# ---------------------------------------------------------------------------
+
+_ICEBERG_TYPE = {
+    "BooleanType": "boolean", "IntegerType": "int", "LongType": "long",
+    "FloatType": "float", "DoubleType": "double", "StringType": "string",
+    "DateType": "date", "TimestampType": "timestamptz",
+    "ByteType": "int", "ShortType": "int",
+}
+
+
+def _type_to_iceberg(dt) -> str:
+    if isinstance(dt, T.DecimalType):
+        return f"decimal({dt.precision}, {dt.scale})"
+    name = type(dt).__name__
+    if name not in _ICEBERG_TYPE:
+        raise ValueError(f"iceberg write: unsupported type {dt.simpleString}")
+    return _ICEBERG_TYPE[name]
+
+
+def _schema_json(schema: T.StructType) -> dict:
+    return {"type": "struct", "schema-id": 0,
+            "fields": [{"id": i + 1, "name": f.name,
+                        "required": not f.nullable,
+                        "type": _type_to_iceberg(f.dataType)}
+                       for i, f in enumerate(schema.fields)]}
+
+
+_MANIFEST_SCHEMA = {
+    "type": "record", "name": "manifest_entry", "fields": [
+        {"name": "status", "type": "int"},
+        {"name": "snapshot_id", "type": ["null", "long"], "default": None},
+        {"name": "data_file", "type": {
+            "type": "record", "name": "r2", "fields": [
+                {"name": "content", "type": "int"},
+                {"name": "file_path", "type": "string"},
+                {"name": "file_format", "type": "string"},
+                {"name": "record_count", "type": "long"},
+                {"name": "file_size_in_bytes", "type": "long"},
+            ]}},
+    ]}
+
+_MANIFEST_LIST_SCHEMA = {
+    "type": "record", "name": "manifest_file", "fields": [
+        {"name": "manifest_path", "type": "string"},
+        {"name": "manifest_length", "type": "long"},
+        {"name": "partition_spec_id", "type": "int"},
+        {"name": "added_snapshot_id", "type": "long"},
+    ]}
+
+
+def write_iceberg(df, table_path: str, mode: str = "error",
+                  partition_by=None) -> int:
+    """Write a DataFrame as an iceberg v2 commit; returns the snapshot id.
+
+    modes: error/ignore/append/overwrite.  ``partition_by`` uses identity
+    transforms; data files land under data/<col>=<value>/ and the spec is
+    recorded in the metadata (the scan reads files regardless of
+    partition layout)."""
+    import time
+    import uuid
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.delta.table import _df_to_arrow
+    from spark_rapids_tpu.io.avro import write_avro_file
+
+    mdir = os.path.join(table_path, "metadata")
+    ddir = os.path.join(table_path, "data")
+    exists = os.path.isdir(mdir) and any(
+        re.match(r"v(\d+)\.metadata\.json$", n)
+        for n in os.listdir(mdir)) if os.path.isdir(mdir) else False
+    if exists and mode in ("error", "errorifexists"):
+        raise FileExistsError(f"iceberg table already exists: {table_path}")
+    if exists and mode == "ignore":
+        with open(_latest_metadata(table_path)) as f:
+            return json.load(f).get("current-snapshot-id", -1)
+    os.makedirs(mdir, exist_ok=True)
+    os.makedirs(ddir, exist_ok=True)
+
+    meta = None
+    version = 0
+    if exists:
+        mpath = _latest_metadata(table_path)
+        version = int(re.match(r"v(\d+)\.metadata\.json$",
+                               os.path.basename(mpath)).group(1))
+        with open(mpath) as f:
+            meta = json.load(f)
+
+    tbl = _df_to_arrow(df)
+    snapshot_id = int(uuid.uuid4().int % (1 << 62))
+    now_ms = int(time.time() * 1000)
+    part_cols = list(partition_by or [])
+
+    # -- data files (hive-style dirs for identity partitions) ----------
+    data_files = []
+
+    def _write_part(sub_tbl, subdir):
+        os.makedirs(subdir, exist_ok=True)
+        fp = os.path.join(subdir, f"{uuid.uuid4().hex}.parquet")
+        pq.write_table(sub_tbl, fp)
+        data_files.append({
+            "status": 1, "snapshot_id": snapshot_id,
+            "data_file": {
+                "content": 0, "file_path": fp,
+                "file_format": "PARQUET",
+                "record_count": sub_tbl.num_rows,
+                "file_size_in_bytes": os.path.getsize(fp)}})
+
+    if tbl.num_rows:
+        if part_cols:
+            import pyarrow.compute as pc
+
+            keys = [tbl.column(c) for c in part_cols]
+            combos = {tuple(row) for row in zip(
+                *[k.to_pylist() for k in keys])}
+            for combo in sorted(combos, key=lambda t: tuple(map(str, t))):
+                mask = None
+                for c, v in zip(part_cols, combo):
+                    m = (pc.is_null(tbl.column(c)) if v is None
+                         else pc.equal(tbl.column(c), pa.scalar(v)))
+                    mask = m if mask is None else pc.and_(mask, m)
+                sub = tbl.filter(mask)
+                subdir = os.path.join(ddir, *[
+                    f"{c}={'null' if v is None else v}"
+                    for c, v in zip(part_cols, combo)])
+                _write_part(sub, subdir)
+        else:
+            _write_part(tbl, ddir)
+
+    # -- manifest + manifest list --------------------------------------
+    manifest_path = os.path.join(
+        mdir, f"manifest-{uuid.uuid4().hex}.avro")
+    write_avro_file(manifest_path, _MANIFEST_SCHEMA, data_files)
+    manifests = [{"manifest_path": manifest_path,
+                  "manifest_length": os.path.getsize(manifest_path),
+                  "partition_spec_id": 0,
+                  "added_snapshot_id": snapshot_id}]
+    if meta is not None and mode == "append":
+        cur = next((s for s in meta.get("snapshots", [])
+                    if s.get("snapshot-id")
+                    == meta.get("current-snapshot-id")), None)
+        if cur is not None:
+            old_list = _resolve(table_path, cur["manifest-list"])
+            _, old = read_avro_file(old_list)
+            manifests = list(old) + manifests
+    mlist_path = os.path.join(
+        mdir, f"snap-{snapshot_id}-{uuid.uuid4().hex}.avro")
+    write_avro_file(mlist_path, _MANIFEST_LIST_SCHEMA, manifests)
+
+    # -- metadata json v2 ----------------------------------------------
+    schema_json = _schema_json(df.schema)
+    name_to_id = {f["name"]: f["id"] for f in schema_json["fields"]}
+    spec = {"spec-id": 0, "fields": [
+        {"name": c, "transform": "identity",
+         "source-id": name_to_id[c], "field-id": 1000 + i}
+        for i, c in enumerate(part_cols)]}
+    snapshot = {"snapshot-id": snapshot_id,
+                "timestamp-ms": now_ms,
+                "sequence-number": (meta or {}).get(
+                    "last-sequence-number", 0) + 1,
+                "summary": {"operation":
+                            "append" if mode == "append" else "overwrite"},
+                "manifest-list": mlist_path,
+                "schema-id": 0}
+    snapshots = list((meta or {}).get("snapshots", [])) \
+        if mode == "append" and meta is not None else []
+    if meta is not None and mode == "overwrite":
+        snapshots = list(meta.get("snapshots", []))
+    snapshots.append(snapshot)
+    new_meta = {
+        "format-version": 2,
+        "table-uuid": (meta or {}).get("table-uuid",
+                                       str(uuid.uuid4())),
+        "location": table_path,
+        "last-sequence-number": snapshot["sequence-number"],
+        "last-updated-ms": now_ms,
+        "last-column-id": len(schema_json["fields"]),
+        "schemas": [schema_json],
+        "current-schema-id": 0,
+        "partition-specs": [spec],
+        "default-spec-id": 0,
+        "last-partition-id": 999 + len(part_cols),
+        "sort-orders": [{"order-id": 0, "fields": []}],
+        "default-sort-order-id": 0,
+        "properties": {},
+        "snapshots": snapshots,
+        "current-snapshot-id": snapshot_id,
+    }
+    out_path = os.path.join(mdir, f"v{version + 1}.metadata.json")
+    with open(out_path, "w") as f:
+        json.dump(new_meta, f)
+    with open(os.path.join(mdir, "version-hint.text"), "w") as f:
+        f.write(str(version + 1))
+    return snapshot_id

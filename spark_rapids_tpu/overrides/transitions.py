@@ -123,8 +123,13 @@ class TpuTransitionOverrides:
         if not (isinstance(node, TpuHashAggregateExec)
                 and node.mode == AggregateMode.FINAL):
             return node
+        from spark_rapids_tpu.exec.exchange import (
+            TpuAdaptiveShuffleReaderExec,
+        )
+
         mid = node.children[0]
-        if isinstance(mid, TpuCoalesceBatchesExec):
+        if isinstance(mid, (TpuCoalesceBatchesExec,
+                            TpuAdaptiveShuffleReaderExec)):
             mid = mid.children[0]
         if not isinstance(mid, TpuShuffleExchangeExec):
             return node
@@ -263,8 +268,13 @@ class TpuTransitionOverrides:
         if not (isinstance(node, TpuHashAggregateExec)
                 and node.mode == AggregateMode.FINAL):
             return node
+        from spark_rapids_tpu.exec.exchange import (
+            TpuAdaptiveShuffleReaderExec,
+        )
+
         mid = node.children[0]
-        if isinstance(mid, TpuCoalesceBatchesExec):
+        if isinstance(mid, (TpuCoalesceBatchesExec,
+                            TpuAdaptiveShuffleReaderExec)):
             mid = mid.children[0]
         if not isinstance(mid, TpuShuffleExchangeExec):
             return node
@@ -424,6 +434,11 @@ class TpuTransitionOverrides:
 
     @staticmethod
     def _insert_coalesce(node: TpuExec, conf: TpuConf) -> TpuExec:
+        from spark_rapids_tpu.config import ADAPTIVE_ENABLED
+        from spark_rapids_tpu.exec.exchange import (
+            TpuAdaptiveShuffleReaderExec,
+        )
+
         node.children = [
             TpuTransitionOverrides._insert_coalesce(c, conf)
             if isinstance(c, TpuExec) else c
@@ -431,8 +446,15 @@ class TpuTransitionOverrides:
         new_children = []
         for c in node.children:
             if isinstance(c, TpuShuffleExchangeExec):
-                goal = CoalesceGoal(conf.get(BATCH_SIZE_BYTES))
-                new_children.append(TpuCoalesceBatchesExec(goal, c))
+                if conf.get(ADAPTIVE_ENABLED):
+                    # general AQE: the reader RECORDS per-partition
+                    # rows/bytes and coalesces on the measured stats
+                    # (GpuCustomShuffleReaderExec analog)
+                    new_children.append(TpuAdaptiveShuffleReaderExec(
+                        c, conf.get(BATCH_SIZE_BYTES)))
+                else:
+                    goal = CoalesceGoal(conf.get(BATCH_SIZE_BYTES))
+                    new_children.append(TpuCoalesceBatchesExec(goal, c))
             else:
                 new_children.append(c)
         node.children = new_children

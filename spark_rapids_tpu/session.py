@@ -594,6 +594,14 @@ class DataFrameWriter:
     def json(self, path: str) -> None:
         self._run("json", path)
 
+    def iceberg(self, path: str) -> None:
+        from spark_rapids_tpu.io.iceberg import write_iceberg
+
+        mode = {"error": "error", "errorifexists": "error"}.get(
+            self._mode, self._mode)
+        write_iceberg(self.df, path, mode=mode,
+                      partition_by=self._partition_by)
+
     def delta(self, path: str) -> None:
         from spark_rapids_tpu.delta import write_delta
 

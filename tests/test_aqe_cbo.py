@@ -116,3 +116,73 @@ def test_cost_optimizer_off_by_default():
     q = df.select((col("a") + lit(1)).alias("r"))
     root, meta = q._planned()
     assert "cost-based optimizer" not in meta.explain(only_fallback=False)
+
+
+# -- round 4: general AQE beyond the broadcast-join case --------------------
+
+
+def test_adaptive_shuffle_reader_coalesces_on_measured_stats():
+    """The AQE shuffle reader records per-partition rows/bytes at
+    execution and coalesces partitions on those MEASURED stats
+    (GpuCustomShuffleReaderExec analog) — a runtime plan change beyond
+    the broadcast-join case (VERDICT r3 Next #8)."""
+    from spark_rapids_tpu.exec.exchange import TpuAdaptiveShuffleReaderExec
+    from spark_rapids_tpu.session import TpuSession, sum_
+
+    s = TpuSession({
+        "spark.rapids.sql.enabled": True,
+        # many tiny reduce partitions + a tiny coalesce target would keep
+        # them separate; default target merges them all
+        "spark.sql.shuffle.partitions": 8,
+        # keep the exchange alive (no single-device collapse)
+        "spark.rapids.tpu.completeAggCollapse.enabled": False,
+    })
+    df = gen_df(s, [IntegerGen(min_val=0, max_val=30), IntegerGen()],
+                ["k", "v"], length=500)
+    q = df.group_by("k").agg(sum_("v", "s"))
+    root, _ = q._planned()
+
+    readers = []
+
+    def find(n):
+        if isinstance(n, TpuAdaptiveShuffleReaderExec):
+            readers.append(n)
+        for c in n.children:
+            if hasattr(c, "children"):
+                find(c)
+
+    find(root)
+    assert readers, f"no adaptive reader in plan: {root.pretty()}"
+    rows = q.collect()
+    assert rows
+    r = readers[0]
+    assert r.decision is not None and "->" in r.decision, r.decision
+    n_in = int(r.decision.split()[1].split("->")[0])
+    n_out = int(r.decision.split()[1].split("->")[1])
+    assert n_in > n_out, r.decision          # stats-driven plan change
+    assert len(r.stats) == n_in
+    assert all(b > 0 for _, b in r.stats)
+
+
+def test_adaptive_reader_disabled_falls_back_to_static_coalesce():
+    from spark_rapids_tpu.exec.coalesce import TpuCoalesceBatchesExec
+    from spark_rapids_tpu.exec.exchange import TpuAdaptiveShuffleReaderExec
+    from spark_rapids_tpu.session import TpuSession, sum_
+
+    s = TpuSession({
+        "spark.rapids.sql.enabled": True,
+        "spark.sql.adaptive.enabled": False,
+        "spark.rapids.tpu.completeAggCollapse.enabled": False,
+    })
+    df = gen_df(s, [IntegerGen(min_val=0, max_val=5), IntegerGen()],
+                ["k", "v"], length=100)
+    root, _ = df.group_by("k").agg(sum_("v", "s"))._planned()
+
+    def find(n, cls):
+        if isinstance(n, cls):
+            return True
+        return any(find(c, cls) for c in n.children
+                   if hasattr(c, "children"))
+
+    assert not find(root, TpuAdaptiveShuffleReaderExec)
+    assert find(root, TpuCoalesceBatchesExec)

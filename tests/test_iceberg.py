@@ -209,3 +209,92 @@ def test_iceberg_mixed_deletes(tmp_path):
     assert len(rows) == 200 - 3
     ss = {r[2] for r in rows}
     assert ss.isdisjoint({"a7", "a9", "b0"})
+
+
+# -- round 4: write/commit path (VERDICT r3 Next #7) ------------------------
+
+
+def _rows(df):
+    return sorted(df.collect(), key=lambda r: tuple(
+        (x is None, str(x)) for x in r))
+
+
+def test_iceberg_write_read_roundtrip(tmp_path):
+    from decimal import Decimal
+
+    p = str(tmp_path / "t1")
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    schema = T.StructType([
+        T.StructField("i", T.INT, False),
+        T.StructField("t", T.STRING, True),
+        T.StructField("d", T.DecimalType(10, 2), True),
+        T.StructField("f", T.DOUBLE, True)])
+    df = s.create_dataframe(
+        {"i": [1, 2, 3], "t": ["a", None, "c"],
+         "d": [Decimal("1.50"), Decimal("-2.25"), None],
+         "f": [0.5, None, 2.5]}, schema)
+    df.write.iceberg(p)
+    back = s.read.iceberg(p)
+    assert back.schema.field_names() == ["i", "t", "d", "f"]
+    assert _rows(back) == _rows(df)
+
+
+def test_iceberg_append_and_overwrite(tmp_path):
+    p = str(tmp_path / "t2")
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    schema = T.StructType([T.StructField("v", T.LONG, False)])
+    d1 = s.create_dataframe({"v": [1, 2]}, schema)
+    d2 = s.create_dataframe({"v": [3]}, schema)
+    d3 = s.create_dataframe({"v": [9]}, schema)
+    d1.write.iceberg(p)
+    d2.write.mode("append").iceberg(p)
+    assert _rows(s.read.iceberg(p)) == [(1,), (2,), (3,)]
+    d3.write.mode("overwrite").iceberg(p)
+    assert _rows(s.read.iceberg(p)) == [(9,)]
+    # snapshot chain survives: three snapshots recorded
+    import json as _json
+    import os as _os
+    import re as _re
+
+    mdir = _os.path.join(p, "metadata")
+    latest = max(int(_re.match(r"v(\d+)", n).group(1))
+                 for n in _os.listdir(mdir)
+                 if _re.match(r"v(\d+)\.metadata\.json$", n))
+    with open(_os.path.join(mdir, f"v{latest}.metadata.json")) as f:
+        meta = _json.load(f)
+    assert len(meta["snapshots"]) == 3
+    assert meta["format-version"] == 2
+    # time travel to the append snapshot
+    sid = meta["snapshots"][1]["snapshot-id"]
+    assert _rows(s.read.iceberg(p, snapshot_id=sid)) == [(1,), (2,), (3,)]
+
+
+def test_iceberg_partitioned_write(tmp_path):
+    import os as _os
+
+    p = str(tmp_path / "t3")
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    schema = T.StructType([T.StructField("k", T.INT, False),
+                           T.StructField("v", T.LONG, False)])
+    df = s.create_dataframe({"k": [1, 2, 1, 2], "v": [10, 20, 30, 40]},
+                            schema)
+    df.write.partition_by("k").iceberg(p)
+    assert _rows(s.read.iceberg(p)) == _rows(df)
+    dirs = sorted(_os.listdir(_os.path.join(p, "data")))
+    assert dirs == ["k=1", "k=2"], dirs
+
+
+def test_iceberg_write_error_and_ignore(tmp_path):
+    import pytest as _pt
+
+    p = str(tmp_path / "t4")
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    schema = T.StructType([T.StructField("v", T.INT, False)])
+    s.create_dataframe({"v": [1]}, schema).write.iceberg(p)
+    # the writer's default mode is overwrite (matching the file writers);
+    # explicit error/ignore modes follow Spark semantics
+    with _pt.raises(FileExistsError):
+        s.create_dataframe({"v": [2]}, schema).write.mode(
+            "error").iceberg(p)
+    s.create_dataframe({"v": [2]}, schema).write.mode("ignore").iceberg(p)
+    assert _rows(s.read.iceberg(p)) == [(1,)]
