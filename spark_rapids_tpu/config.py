@@ -187,6 +187,16 @@ AUTO_BROADCAST_JOIN_THRESHOLD = conf(
     "local tables from their host columns).  -1 disables broadcasting."
 ).bytes_conf(10 << 20)
 
+COMPILE_CACHE_DIR = conf("spark.rapids.tpu.compileCache.dir").doc(
+    "Persistent XLA compile-cache directory, applied process-wide on the "
+    "first TpuSession construction so tests/tools/bench all share compiled "
+    "programs across processes (on the tunnel-relayed dev chip a single "
+    "compile costs minutes; the cache makes it once).  Empty string or "
+    "'0' disables.  Default: <repo>/.jax_compile_cache."
+).string_conf(os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    ".jax_compile_cache"))
+
 # --- plan / exec switches --------------------------------------------------
 
 ENABLE_CAST_FLOAT_TO_STRING = conf(

@@ -15,6 +15,7 @@ is precisely what the differential test harness does to get golden results.
 """
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 from spark_rapids_tpu import types as T
@@ -39,9 +40,50 @@ def _to_expr(c: ColumnLike) -> Expression:
     return _col(c)
 
 
+_COMPILE_CACHE_APPLIED: Optional[str] = None     # last applied dir ("" = off)
+
+
+def _apply_compile_cache(conf: "TpuConf") -> None:
+    """Point XLA's persistent compile cache at the configured dir (VERDICT
+    r4 Next #6: one cache authority for session/tests/tools/bench).
+    jax.config is process-global; re-applied whenever a session resolves a
+    DIFFERENT dir, so a later explicit conf is not silently ignored.  An
+    empty/'0' dir opts out.  Falls back to ~/.cache when the configured dir
+    cannot be created (e.g. a read-only install tree)."""
+    global _COMPILE_CACHE_APPLIED
+    from spark_rapids_tpu.config import COMPILE_CACHE_DIR
+
+    cache_dir = conf.get(COMPILE_CACHE_DIR)
+    if not cache_dir or cache_dir == "0":
+        cache_dir = ""
+    if _COMPILE_CACHE_APPLIED == cache_dir:
+        return
+    _COMPILE_CACHE_APPLIED = cache_dir
+    if not cache_dir:
+        return
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        cache_dir = os.path.join(
+            os.path.expanduser("~"), ".cache", "spark_rapids_tpu",
+            "xla_cache")
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+        except OSError:
+            return
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+
 class TpuSession:
     def __init__(self, conf: Optional[Dict[str, str]] = None):
         self.conf = TpuConf(conf or {})
+        _apply_compile_cache(self.conf)
 
     @staticmethod
     def builder() -> "TpuSessionBuilder":

@@ -1,0 +1,92 @@
+"""Durability guards for the perf-counter patches and the compile cache.
+
+VERDICT r4 (weak #5 / next #6): the counters live on monkey-patched JAX
+internals (``ArrayImpl.__array__``, scalar dunders, ``_cache_size``); a JAX
+upgrade could silently zero them via the guarded ``SYNC_COUNTING=False``
+path.  These tests fail LOUDLY instead, and pin the one-cache-authority
+behavior of ``TpuSession``.
+"""
+import os
+
+from spark_rapids_tpu import perfcounters as PC
+
+
+def test_sync_counting_patches_installed():
+    # if a jax upgrade breaks the ArrayImpl patches this must fail, not
+    # silently report zero syncs forever
+    assert PC.SYNC_COUNTING is True
+
+
+def test_tpu_jit_counts_programs_and_compiles():
+    import jax.numpy as jnp
+
+    fn = PC.tpu_jit(lambda x: x * 2 + 1)
+    x = jnp.arange(16)
+    snap = PC.snapshot()
+    fn(x).block_until_ready()
+    d1 = PC.since(snap)
+    assert d1["programs_launched"] == 1
+    assert d1["compiles"] == 1          # first call traces + compiles
+    assert d1["launch_wall_ns"] > 0
+    snap = PC.snapshot()
+    fn(x).block_until_ready()
+    d2 = PC.since(snap)
+    assert d2["programs_launched"] == 1
+    assert d2["compiles"] == 0          # warm cache
+
+
+def test_host_sync_counted_on_materialize():
+    # device_get + scalar dunders are the engine's materialization paths;
+    # raw np.asarray on the CPU backend can take the zero-copy buffer
+    # protocol and legitimately skip __array__, so it is not pinned here
+    import jax
+
+    import jax.numpy as jnp
+
+    y = (jnp.arange(64) + 1)
+    y.block_until_ready()
+    snap = PC.snapshot()
+    arr = jax.device_get(y)
+    d = PC.since(snap)
+    assert arr[3] == 4
+    assert d["host_syncs"] == 1
+    assert d["bytes_d2h"] >= y.nbytes
+    # scalar dunders count too
+    snap = PC.snapshot()
+    assert int(jnp.int32(7)) == 7
+    assert PC.since(snap)["host_syncs"] == 1
+
+
+def test_sync_get_is_one_logical_sync():
+    import jax.numpy as jnp
+
+    tree = {"a": jnp.arange(8), "b": jnp.ones(8)}
+    snap = PC.snapshot()
+    out = PC.sync_get(tree)
+    d = PC.since(snap)
+    assert d["host_syncs"] == 1          # one round trip, two leaves
+    assert out["a"][2] == 2
+
+
+def test_session_applies_compile_cache_conf():
+    import jax
+
+    from spark_rapids_tpu import session as S
+    from spark_rapids_tpu.config import COMPILE_CACHE_DIR, TpuConf
+
+    # force a fresh application regardless of earlier sessions in-process
+    S._COMPILE_CACHE_APPLIED = None
+    S.TpuSession({})
+    want = TpuConf({}).get(COMPILE_CACHE_DIR)
+    assert jax.config.jax_compilation_cache_dir == want
+    assert S._COMPILE_CACHE_APPLIED == want
+    # a later session with an explicitly different dir is honored, not
+    # silently ignored (code-review finding)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        other = os.path.join(td, "xc")
+        S.TpuSession({"spark.rapids.tpu.compileCache.dir": other})
+        assert jax.config.jax_compilation_cache_dir == other
+    S._COMPILE_CACHE_APPLIED = None
+    S.TpuSession({})      # restore the default for the rest of the suite
