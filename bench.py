@@ -23,12 +23,22 @@ referenced input bytes / TPU wall time, with the v5e HBM roofline
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}
 with per-query detail nested under "queries".
 
-Env knobs: BENCH_ROWS (default 2M), BENCH_REPEATS (default 2),
-BENCH_TIME_BUDGET seconds (default 2400) — on this compile-tunnel dev
-platform every program costs ~20-60s+ to compile, so the suite emits its
-JSON line from whatever completed inside the budget instead of dying at
-an outer timeout with nothing (each completed query is timed fully;
-skipped ones are listed under "skipped").
+Env knobs: BENCH_ROWS (default 20M — VERDICT r4 Next #1: at the old 2M
+default the fixed ~100ms tunnel sync made vs_vec mathematically
+unreachable while >99.9% of HBM sat idle), BENCH_Q6_ROWS (default 50M
+when BENCH_ROWS >= 10M), BENCH_REPEATS (default 2), BENCH_TIME_BUDGET
+seconds (default 2400) — on this compile-tunnel dev platform every
+program costs ~20-60s+ to compile, so the suite emits its JSON line from
+whatever completed inside the budget instead of dying at an outer
+timeout with nothing (each completed query is timed fully; skipped ones
+are listed under "skipped").
+
+Query order (VERDICT r4 weak #2): q6 -> qa -> qb -> qc -> q6_parquet ->
+rung3, so a budget kill can no longer erase the window number.  The
+transfer-bound _scan variants and the CPU-oracle multi-repeats only run
+at <= 4M rows (the tunnel tops out near 5-40 MB/s; at 20M+ they would
+eat the budget without informing the device-side story the counters
+already tell).
 """
 from __future__ import annotations
 
@@ -347,8 +357,14 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", plat)
-    n = int(os.environ.get("BENCH_ROWS", 2_000_000))
+    n = int(os.environ.get("BENCH_ROWS", 20_000_000))
+    n_q6 = int(os.environ.get("BENCH_Q6_ROWS",
+                              50_000_000 if n >= 10_000_000 else n))
     repeats = int(os.environ.get("BENCH_REPEATS", 2))
+    # the row-at-a-time CPU oracle is deterministic and ~15-30x slower than
+    # the engine at 20M+; one timed run is enough there
+    oracle_repeats = repeats if n <= 4_000_000 else 1
+    scan_variants = n <= 4_000_000
     budget = float(os.environ.get("BENCH_TIME_BUDGET", 2400))
     t_start = time.perf_counter()
     skipped = []
@@ -432,7 +448,8 @@ def main():
             "queries": queries,
         }), flush=True)
 
-    _ALL = ["qa_join_agg", "qb_left_join", "qc_window"]
+    _ALL = ["qa_join_agg", "qb_left_join", "qc_window", "q6_parquet",
+            "rung3"]
 
     def abort(current):
         idx = _ALL.index(current) if current in _ALL else 0
@@ -442,19 +459,20 @@ def main():
 
     try:
         # ---- rung 1: Q6 ------------------------------------------------------
-        li = make_lineitem(n)
+        li = make_lineitem(n_q6)
         q6_bytes = _bytes_of(li)
 
         t_vec, vec_res = _time_repeats(lambda: cpu_q6_vectorized(li), repeats)
         oracle_df = build_q6(_session(False), li)
-        t_oracle, oracle_rows = _time_repeats(oracle_df.collect, repeats)
+        t_oracle, oracle_rows = _time_repeats(oracle_df.collect,
+                                              oracle_repeats)
+        progress(f"q6: baselines done (vec {t_vec:.2f}s, oracle "
+                 f"{t_oracle:.2f}s, rows={n_q6})")
 
         tpu_hot_df = build_q6(_session(True, cache_batches=True), li)
         t_hot, tpu_rows, ctr_hot = _time_repeats(tpu_hot_df.collect, repeats,
                                                  counters=True)
-        tpu_scan_df = build_q6(_session(True, cache_batches=False), li)
-        t_scan, _, ctr_scan = _time_repeats(tpu_scan_df.collect, repeats,
-                                            counters=True)
+        progress(f"q6_hot: tpu {t_hot:.3f}s (vs_vec {t_vec / t_hot:.2f})")
 
         assert int(tpu_rows[0][0].scaleb(4)) == vec_res, \
             f"Q6 mismatch: tpu {tpu_rows[0][0]} vs vectorized {vec_res}"
@@ -462,12 +480,18 @@ def main():
 
         queries["q6_hot"] = dict(
             tpu_s=t_hot, cpu_vec_s=t_vec, cpu_oracle_s=t_oracle,
-            rows_per_s=n / t_hot, eff_gbps=q6_bytes / t_hot / 1e9,
+            rows_per_s=n_q6 / t_hot, eff_gbps=q6_bytes / t_hot / 1e9,
             vs_vec=t_vec / t_hot, vs_oracle=t_oracle / t_hot, **ctr_hot)
-        queries["q6_scan"] = dict(
-            tpu_s=t_scan, cpu_vec_s=t_vec, cpu_oracle_s=t_oracle,
-            rows_per_s=n / t_scan, eff_gbps=q6_bytes / t_scan / 1e9,
-            vs_vec=t_vec / t_scan, vs_oracle=t_oracle / t_scan, **ctr_scan)
+        if scan_variants:
+            tpu_scan_df = build_q6(_session(True, cache_batches=False), li)
+            t_scan, _, ctr_scan = _time_repeats(tpu_scan_df.collect, repeats,
+                                                counters=True)
+            queries["q6_scan"] = dict(
+                tpu_s=t_scan, cpu_vec_s=t_vec, cpu_oracle_s=t_oracle,
+                rows_per_s=n_q6 / t_scan, eff_gbps=q6_bytes / t_scan / 1e9,
+                vs_vec=t_vec / t_scan, vs_oracle=t_oracle / t_scan,
+                **ctr_scan)
+        del li
     except TimeoutError:
         skipped.extend(["q6"] + _ALL)
         progress("terminated during rung 1; emitting partial results")
@@ -487,7 +511,7 @@ def main():
             return
         t_vec, vec_res = _time_repeats(lambda: vec_fn(), repeats)
         t_oracle, _ = _time_repeats(build(_session(False), *args).collect,
-                                    repeats)
+                                    oracle_repeats)
         progress(f"{name}: baselines done (vec {t_vec:.2f}s, oracle "
                  f"{t_oracle:.2f}s)")
         modes = [("hot", True)] + ([("scan", False)] if scan_mode else [])
@@ -513,7 +537,8 @@ def main():
         run_query("qa_join_agg", build_qa, (ss, dd),
                   lambda: cpu_qa_vectorized(ss, dd), check_qa,
                   _bytes_of({"a": ss["date_sk"], "b": ss["store_sk"],
-                             "c": ss["ext_sales"]}, dd), scan_mode=True)
+                             "c": ss["ext_sales"]}, dd),
+                  scan_mode=scan_variants)
     except TimeoutError:
         abort("qa_join_agg")
         return
@@ -532,6 +557,110 @@ def main():
         abort("qb_left_join")
         return
 
+    def check_qc(rows, want):
+        got = {(int(r[0]), int(r[1]), int(r[2].scaleb(2)), int(r[3]))
+               for r in rows}
+        assert got == want, "qc mismatch vs vectorized baseline"
+
+    # qc runs BEFORE the parquet variant and rung-3 (VERDICT r4 weak #2:
+    # two rounds of budget kills erased the window number)
+    try:
+        run_query("qc_window", build_qc, (ss,),
+                  lambda: cpu_qc_vectorized(ss), check_qc,
+                  _bytes_of({"a": ss["store_sk"], "b": ss["date_sk"],
+                             "c": ss["ext_sales"]}))
+    except TimeoutError:
+        abort("qc_window")
+        return
+
+    # ---- q6 over real snappy parquet files through the device decode path
+    # (VERDICT r4 Next #5: two rounds of decode work had no recorded perf
+    # number).  Scan-inclusive by construction: every run re-reads, decodes
+    # and uploads the pages; the counters tell the program/round-trip
+    # story. -----------------------------------------------------------------
+    def run_q6_parquet():
+        import shutil
+        import tempfile
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        n_pq = int(os.environ.get("BENCH_PARQUET_ROWS",
+                                  min(n, 4_000_000)))
+        li_pq = make_lineitem(n_pq)
+        tmp = tempfile.mkdtemp(prefix="bench_q6_parquet_")
+        try:
+            tbl = pa.table({
+                "l_extendedprice": li_pq["l_extendedprice"],
+                "l_discount": li_pq["l_discount"],
+                "l_quantity": li_pq["l_quantity"],
+                "l_shipdate_days": li_pq["l_shipdate_days"],
+            })
+            nfiles = 4
+            step = -(-n_pq // nfiles)
+            paths = []
+            for i in range(nfiles):
+                p = os.path.join(tmp, f"part-{i}.parquet")
+                pq.write_table(tbl.slice(i * step, step), p,
+                               compression="snappy",
+                               use_dictionary=True,
+                               data_page_version="1.0")
+                paths.append(p)
+            file_bytes = float(sum(os.path.getsize(p) for p in paths))
+
+            def pyarrow_q6():
+                cols = pq.ParquetDataset(tmp).read().to_pydict()
+                arrs = {k: np.asarray(v) for k, v in cols.items()}
+                return cpu_q6_vectorized(arrs)
+
+            t_vec, vec_res = _time_repeats(pyarrow_q6, 1)
+
+            def build_q6_scan(session):
+                from spark_rapids_tpu.session import col, lit, sum_
+
+                df = session.read.parquet(*paths)
+                return (df.filter(
+                    (col("l_shipdate_days") >= lit(8766))
+                    & (col("l_shipdate_days") < lit(9131))
+                    & (col("l_discount") >= lit(5))
+                    & (col("l_discount") <= lit(7))
+                    & (col("l_quantity") < lit(2400)))
+                    .select((col("l_extendedprice") * col("l_discount"))
+                            .alias("revenue"))
+                    .agg(sum_("revenue", "revenue")))
+
+            from spark_rapids_tpu.session import TpuSession
+
+            s = TpuSession({
+                "spark.rapids.sql.enabled": True,
+                "spark.rapids.sql.format.parquet.decode.device": True,
+                "spark.rapids.sql.format.parquet.reader.type": "PERFILE",
+            })
+            df = build_q6_scan(s)
+            t_tpu, rows, ctr = _time_repeats(df.collect, 1, counters=True)
+            got = int(rows[0][0])
+            assert got == vec_res, f"q6_parquet mismatch: {got} vs {vec_res}"
+            progress(f"q6_parquet: tpu {t_tpu:.2f}s over "
+                     f"{file_bytes / 1e6:.0f}MB snappy "
+                     f"(programs={ctr['nProgramsLaunched']:.0f})")
+            queries["q6_parquet"] = dict(
+                tpu_s=t_tpu, cpu_vec_s=t_vec, cpu_oracle_s=0.0,
+                rows_per_s=n_pq / t_tpu,
+                eff_gbps=file_bytes / t_tpu / 1e9,
+                vs_vec=t_vec / t_tpu, vs_oracle=0.0,
+                fileBytes=file_bytes, **ctr)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    if os.environ.get("BENCH_PARQUET", "1") != "0" and not over_budget():
+        try:
+            run_q6_parquet()
+        except TimeoutError:
+            abort("q6_parquet")
+            return
+        except Exception as ex:   # additive: never lose rung 1-2
+            progress(f"q6_parquet failed: {ex!r}")
+
     # ---- rung 3 (BASELINE.md): nested structs + decimal128 through the
     # OOC machinery under a constrained pool, with spill counters
     # (VERDICT r3 Next #9) --------------------------------------------------
@@ -546,9 +675,11 @@ def main():
         from spark_rapids_tpu.session import (TpuSession, col, lit, max_,
                                               min_, sum_)
 
-        # default = the full row count: the 64MiB pool floor needs >64MiB
-        # of live batches before the spill path engages
-        n3 = int(os.environ.get("BENCH_RUNG3_ROWS", max(n, 100_000)))
+        # 2M-row cap: rung-3 demonstrates the spill machinery under a
+        # 64MiB pool (needs >64MiB live batches, ~36B/row), not scale —
+        # at 20M+ the OOC host round-trips would eat the whole budget
+        n3 = int(os.environ.get("BENCH_RUNG3_ROWS",
+                                min(max(n, 2_000_000), 2_000_000)))
         rng = np.random.default_rng(11)
         k = rng.integers(0, 1000, n3).astype(np.int32)
         amt = rng.integers(-10**12, 10**12, n3)   # DECIMAL(25,4) unscaled
@@ -662,24 +793,12 @@ def main():
         try:
             run_rung3()
         except TimeoutError:
-            abort("qc_window")
+            skipped.append("rung3")
+            progress("terminated during rung3; emitting partial results")
+            emit()
             return
         except Exception as ex:   # rung-3 is additive: never lose rung 1-2
             progress(f"rung3 failed: {ex!r}")
-
-    def check_qc(rows, want):
-        got = {(int(r[0]), int(r[1]), int(r[2].scaleb(2)), int(r[3]))
-               for r in rows}
-        assert got == want, "qc mismatch vs vectorized baseline"
-
-    try:
-        run_query("qc_window", build_qc, (ss,),
-                  lambda: cpu_qc_vectorized(ss), check_qc,
-                  _bytes_of({"a": ss["store_sk"], "b": ss["date_sk"],
-                             "c": ss["ext_sales"]}))
-    except TimeoutError:
-        skipped.append("qc_window")
-        progress("terminated during qc_window; emitting partial results")
     emit()
 
 

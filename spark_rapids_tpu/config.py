@@ -197,6 +197,14 @@ COMPILE_CACHE_DIR = conf("spark.rapids.tpu.compileCache.dir").doc(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     ".jax_compile_cache"))
 
+AGG_SMALL_GROUPS_CAP = conf("spark.rapids.tpu.agg.smallGroupsCap").doc(
+    "Sort-based group-by emits results through a bounded-cardinality "
+    "program when the group count fits this cap: boundary/cumsum forms "
+    "replace the full-width segment scatters (~20x device time at 20M "
+    "rows), with host-side growth to the next power of two on overflow "
+    "(the output row count is synced anyway, so the check is free).  "
+    "0 disables (always full-width).").integer_conf(65536)
+
 # --- plan / exec switches --------------------------------------------------
 
 ENABLE_CAST_FLOAT_TO_STRING = conf(

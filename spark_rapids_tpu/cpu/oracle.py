@@ -5660,3 +5660,501 @@ def _wagg(wf, acc, valid, i):
             return max(acc)
         return max(acc)
     raise NotImplementedError(wf.func)
+
+
+# -- round-5 breadth: luhn/binary/bitmap/number-format/xml/avro/etc ----------
+
+def _h_luhn(e, cols, n, ansi):
+    (s,) = _kids(e, cols, n, ansi)
+    out = np.zeros(n, np.bool_)
+    for i in range(n):
+        if not s.validity[i]:
+            continue
+        t = s.values[i]
+        if not t or not t.isdigit():
+            continue
+        total = 0
+        for j, ch in enumerate(reversed(t)):
+            d = ord(ch) - 48
+            if j % 2 == 1:
+                d *= 2
+                if d > 9:
+                    d -= 9
+            total += d
+        out[i] = total % 10 == 0
+    return CpuCol(T.BOOLEAN, out, s.validity.copy())
+
+
+def _h_empty2null(e, cols, n, ansi):
+    (s,) = _kids(e, cols, n, ansi)
+    validity = s.validity & np.array(
+        [bool(v) for v in s.values], np.bool_)
+    return CpuCol(T.STRING, s.values.copy(), validity)
+
+
+def _h_unary_positive(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    return c
+
+
+def _h_to_binary(e, cols, n, ansi):
+    import base64 as b64
+
+    kids = _kids(e, cols, n, ansi)
+    s = kids[0]
+    fmt = e._fmt
+    out = np.empty(n, object)
+    validity = s.validity.copy()
+    bad = np.zeros(n, np.bool_)
+    for i in range(n):
+        if not validity[i]:
+            out[i] = None
+            continue
+        t = s.values[i]
+        if fmt in ("utf-8", "utf8"):
+            out[i] = t
+            continue
+        try:
+            if fmt == "hex":
+                if not all(c2 in "0123456789abcdefABCDEF" for c2 in t):
+                    raise ValueError
+                tt = ("0" + t) if len(t) % 2 else t
+                out[i] = bytes.fromhex(tt).decode("utf-8", "replace")
+            else:
+                out[i] = b64.b64decode(t.encode(), validate=True).decode(
+                    "utf-8", "replace")
+        except Exception:
+            out[i] = None
+            validity[i] = False
+            bad[i] = True
+    if not e._try and ansi and bad.any():
+        raise E.SparkArithmeticException(
+            f"to_binary: malformed {fmt} input")
+    return CpuCol.from_objs(list(out), T.STRING)
+
+
+def _h_bitmap_bit_position(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    v = c.values.astype(np.int64)
+    adj = np.where(v > 0, v - 1, v)
+    pos = np.remainder(adj, 32768)
+    return CpuCol(T.LONG, pos.astype(np.int64), c.validity.copy())
+
+
+def _h_bitmap_bucket_number(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    v = c.values.astype(np.int64)
+    adj = np.where(v > 0, v - 1, v)
+    b = np.floor_divide(adj, 32768)
+    b = np.where(v > 0, b + 1, b)
+    return CpuCol(T.LONG, b.astype(np.int64), c.validity.copy())
+
+
+def _h_bitmap_count(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)
+    out = np.zeros(n, np.int64)
+    for i in range(n):
+        if c.validity[i] and c.values[i] is not None:
+            out[i] = sum(bin(b).count("1")
+                         for b in c.values[i].encode("utf-8", "replace"))
+    return CpuCol(T.LONG, out, c.validity.copy())
+
+
+def _h_randn(e, cols, n, ansi):
+    from spark_rapids_tpu.expr.base import Literal as _L
+
+    seed = 0
+    ch = e.child
+    if isinstance(ch, _L) and ch.value is not None:
+        seed = int(ch.value)
+    idx = np.arange(n, dtype=np.uint64)
+
+    def unit(salt):
+        z = idx * np.uint64(0x9E3779B97F4A7C15) + np.uint64(salt)
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        z = z ^ (z >> np.uint64(31))
+        return (z >> np.uint64(11)).astype(np.float64) / float(1 << 53)
+
+    u1 = unit((seed * 2654435769 + 1) % (1 << 64))
+    u2 = unit((seed * 2654435769 + 2) % (1 << 64))
+    r = np.sqrt(-2.0 * np.log(np.maximum(u1, 1e-300)))
+    out = r * np.cos(2.0 * np.pi * u2)
+    return CpuCol(T.DOUBLE, out, np.ones(n, np.bool_))
+
+
+def _h_sentences(e, cols, n, ansi):
+    import re as _re
+
+    (s,) = _kids(e, cols, n, ansi)[:1]
+    out = np.empty(n, object)
+    for i in range(n):
+        if not s.validity[i]:
+            out[i] = None
+            continue
+        sents = [x for x in _re.split(r"[.!?]+", s.values[i]) if x.strip()]
+        out[i] = [[w for w in _re.split(r"[^\w']+", x) if w]
+                  for x in sents]
+    return CpuCol(e.dataType, out, s.validity.copy())
+
+
+def _h_try_element_at(e, cols, n, ansi):
+    return _h_element_at(e, cols, n, ansi)
+
+
+def _h_cardinality(e, cols, n, ansi):
+    (a,) = _kids(e, cols, n, ansi)
+    out = np.zeros(n, np.int32)
+    for i in range(n):
+        if a.validity[i] and a.values[i] is not None:
+            out[i] = len(a.values[i])
+    return CpuCol(T.INT, out, a.validity.copy())
+
+
+def _h_map_from_entries(e, cols, n, ansi):
+    (a,) = _kids(e, cols, n, ansi)
+    out = np.empty(n, object)
+    validity = a.validity.copy()
+    for i in range(n):
+        if not validity[i]:
+            continue
+        entries = a.values[i]
+        m = {}
+        for kv in entries:
+            if kv is None:
+                validity[i] = False
+                break
+            k, v = (kv if isinstance(kv, tuple) else tuple(kv))
+            if k is None:
+                raise E.SparkArithmeticException(
+                    "Cannot use null as map key")
+            if k in m:
+                raise E.SparkArithmeticException(
+                    "Duplicate map key was found")
+            m[k] = v
+        else:
+            out[i] = m
+    return CpuCol(e.dataType, out, validity)
+
+
+def _h_map_sort(e, cols, n, ansi):
+    (m,) = _kids(e, cols, n, ansi)
+    out = np.empty(n, object)
+    for i in range(n):
+        if m.validity[i] and m.values[i] is not None:
+            out[i] = dict(sorted(m.values[i].items()))
+    return CpuCol(e.dataType, out, m.validity.copy())
+
+
+def _h_shuffle(e, cols, n, ansi):
+    (a,) = _kids(e, cols, n, ansi)
+    seed = getattr(e, "_seed", 0)
+    out = np.empty(n, object)
+    for i in range(n):
+        if not a.validity[i] or a.values[i] is None:
+            continue
+        arr = list(a.values[i])
+        w = len(arr)
+        ranks = []
+        np.seterr(over="ignore")     # uint64 mix wraps by design
+        for j in range(w):
+            idx = np.uint64(i) * np.uint64(1 << 17) + np.uint64(j)
+            z = idx * np.uint64(0x9E3779B97F4A7C15) + np.uint64(
+                (seed * 2654435769 + 11) % (1 << 64))
+            z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+            z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+            ranks.append(np.int64(z ^ (z >> np.uint64(31))))
+        order = sorted(range(w), key=lambda j: ranks[j])
+        np.seterr(over="warn")
+        out[i] = [arr[j] for j in order]
+    return CpuCol(e.dataType, out, a.validity.copy())
+
+
+def _h_parse_to_date(e, cols, n, ansi):
+    inner = type(e).__mro__  # noqa: F841  (delegation below)
+    from spark_rapids_tpu.expr.datetime import ToDate as _TD, \
+        ToTimestamp as _TT
+
+    name = type(e).__name__
+    d = (_TD if name == "ParseToDate" else _TT)(e.children[0])
+    d._resolve_type()
+    return eval_expr(d, cols, n, ansi if name != "TryToTimestamp" else False)
+
+
+def _h_to_number(e, cols, n, ansi):
+    import re as _re
+    from decimal import Decimal as _D
+
+    kids = _kids(e, cols, n, ansi)
+    s = kids[0]
+    spec = e._spec
+    scale = spec["scale"]
+    out = np.empty(n, object)
+    validity = s.validity.copy()
+    for i in range(n):
+        if not validity[i]:
+            out[i] = None
+            continue
+        t = s.values[i].strip()
+        sign = ""
+        if spec["sign"] == "S_START" and t[:1] in "+-":
+            sign, t = t[0], t[1:]
+        if spec["currency"]:
+            if not t.startswith("$"):
+                out[i] = None
+                validity[i] = False
+                continue
+            t = t[1:]
+        if spec["sign"] == "S_END" and t[-1:] in "+-":
+            sign, t = t[-1], t[:-1]
+        elif spec["sign"] == "MI" and t.endswith("-"):
+            sign, t = "-", t[:-1]
+        fr = r"(?:\.([0-9]{0,%d}))?" % scale if scale else "()?"
+        pat = (r"^([0-9][0-9,]*)?" if spec["grouping"]
+               else r"^([0-9]+)?") + fr + "$"
+        m2 = _re.match(pat, t)
+        if not m2 or (not (m2.group(1) or "") and not (m2.group(2) or "")):
+            out[i] = None
+            validity[i] = False
+            continue
+        digits = (m2.group(1) or "").replace(",", "")
+        fpart = (m2.group(2) or "")
+        if len(digits.lstrip("0")) > spec["int_digits"]:
+            out[i] = None
+            validity[i] = False
+            continue
+        unscaled = int((digits or "0") + fpart.ljust(scale, "0"))
+        if sign == "-":
+            unscaled = -unscaled
+        out[i] = unscaled     # CpuCol decimal storage = unscaled int
+    if not e._try and ansi:
+        bad = s.validity & ~validity
+        if bad.any():
+            raise E.SparkArithmeticException(
+                "to_number: input does not match the format")
+    return CpuCol.from_objs(
+        [None if v is None else v for v in out], e.dataType)
+
+
+def _h_to_character(e, cols, n, ansi):
+    from decimal import Decimal as _D
+
+    kids = _kids(e, cols, n, ansi)
+    c = kids[0]
+    spec = e._spec
+    scale = spec["scale"]
+    in_dt = e.children[0]._dataType
+    out = np.empty(n, object)
+    validity = c.validity.copy()
+    for i in range(n):
+        if not validity[i]:
+            out[i] = None
+            continue
+        v = c.values[i]
+        in_scale = in_dt.scale if isinstance(in_dt, T.DecimalType) else 0
+        v = (v if isinstance(v, _D)
+             else _D(int(v)).scaleb(-in_scale))
+        q = v.quantize(_D(1).scaleb(-scale)) if scale else v.quantize(_D(1))
+        neg = q < 0
+        digits = format(abs(q), "f")
+        ipart, _, fpart = digits.partition(".")
+        if len(ipart.lstrip("0") or "") > spec["int_digits"]:
+            out[i] = "#" * (spec["precision"] + (1 if scale else 0))
+            continue
+        if spec["grouping"]:
+            rev = ipart[::-1]
+            ipart = ",".join(rev[j:j + 3]
+                             for j in range(0, len(rev), 3))[::-1]
+        s2 = ipart + (("." + fpart.ljust(scale, "0")) if scale else "")
+        if spec["currency"]:
+            s2 = "$" + s2
+        if spec["sign"] == "S_START":
+            s2 = ("-" if neg else "+") + s2
+        elif spec["sign"] == "S_END":
+            s2 = s2 + ("-" if neg else "+")
+        elif spec["sign"] == "MI":
+            s2 = s2 + ("-" if neg else " ")
+        elif neg:
+            s2 = "-" + s2
+        out[i] = s2
+    return CpuCol.from_objs(list(out), T.STRING)
+
+
+def _h_input_file_name(e, cols, n, ansi):
+    from spark_rapids_tpu.expr.misc import CURRENT_INPUT_FILE
+
+    path = getattr(cols, "input_file", None)
+    if path is None:
+        path = CURRENT_INPUT_FILE[0]
+    return CpuCol.from_objs([path or ""] * n, T.STRING)
+
+
+def _h_from_avro(e, cols, n, ansi):
+    from spark_rapids_tpu.io.avro import _Reader, _decode_value
+
+    (c,) = _kids(e, cols, n, ansi)[:1]
+    st = e.dataType
+    out = np.empty(n, object)
+    validity = c.validity.copy()
+    for i in range(n):
+        if not validity[i]:
+            continue
+        try:
+            r = _Reader(c.values[i].encode("latin-1", "replace")
+                        if isinstance(c.values[i], str) else c.values[i])
+            rec = _decode_value(r, e._avro_schema)
+            out[i] = tuple(rec.get(f.name) for f in st.fields)
+        except Exception:
+            validity[i] = False
+    return CpuCol(st, out, validity)
+
+
+def _h_to_avro(e, cols, n, ansi):
+    from spark_rapids_tpu.io.avro import _encode_value
+
+    (c,) = _kids(e, cols, n, ansi)[:1]
+    st = e.children[0]._dataType
+    out = np.empty(n, object)
+    for i in range(n):
+        if not c.validity[i]:
+            continue
+        row = c.values[i]
+        rec = {f.name: (row[j] if not isinstance(row, dict)
+                        else row.get(f.name))
+               for j, f in enumerate(st.fields)}
+        buf = bytearray()
+        _encode_value(buf, e._avro_schema, rec)
+        out[i] = bytes(buf).decode("latin-1")
+    return CpuCol.from_objs(list(out), T.STRING)
+
+
+def _h_from_xml(e, cols, n, ansi):
+    import xml.etree.ElementTree as _ET
+
+    (c,) = _kids(e, cols, n, ansi)[:1]
+    st = e.schema
+    out = np.empty(n, object)
+    validity = c.validity.copy()
+    from spark_rapids_tpu.expr.jsonexprs import convert_json_field as _cjf
+    for i in range(n):
+        if not validity[i]:
+            continue
+        try:
+            root = _ET.fromstring(c.values[i])
+        except _ET.ParseError:
+            out[i] = tuple([None] * len(st.fields))
+            continue
+        vals = []
+        for f in st.fields:
+            el = root.find(f.name)
+            txt = None if el is None else (el.text or "")
+            if txt is None:
+                vals.append(None)
+                continue
+            sv = txt
+            if not isinstance(f.dataType, T.StringType):
+                try:
+                    if isinstance(f.dataType, T.BooleanType):
+                        sv = txt.strip().lower() == "true"
+                    elif isinstance(f.dataType, (T.FloatType, T.DoubleType)):
+                        sv = float(txt)
+                    else:
+                        sv = int(txt.strip())
+                except ValueError:
+                    vals = [None] * len(st.fields)
+                    break
+            ok, sv = _cjf(sv, f.dataType)
+            if not ok:
+                vals = [None] * len(st.fields)
+                break
+            vals.append(sv)
+        out[i] = tuple(vals)
+    return CpuCol(st, out, validity)
+
+
+def _h_to_xml(e, cols, n, ansi):
+    (c,) = _kids(e, cols, n, ansi)[:1]
+    st = e.children[0]._dataType
+    out = np.empty(n, object)
+
+    def esc(s):
+        return (s.replace("&", "&amp;").replace("<", "&lt;")
+                .replace(">", "&gt;"))
+
+    for i in range(n):
+        if not c.validity[i]:
+            continue
+        row = c.values[i]
+        body = []
+        for j, f in enumerate(st.fields):
+            v = row[j] if not isinstance(row, dict) else row.get(f.name)
+            if v is None:
+                continue
+            if isinstance(f.dataType, T.StringType):
+                sv = esc(str(v))
+            elif isinstance(f.dataType, T.BooleanType):
+                sv = "true" if v else "false"
+            elif isinstance(f.dataType, (T.FloatType, T.DoubleType)):
+                sv = repr(float(v))
+            else:
+                sv = str(int(v))
+            body.append(f"<{f.name}>{sv}</{f.name}>")
+        out[i] = "<row>" + "".join(body) + "</row>"
+    return CpuCol.from_objs(list(out), T.STRING)
+
+
+_HANDLERS.update({
+    "Luhn": _h_luhn,
+    "Empty2Null": _h_empty2null,
+    "UnaryPositive": _h_unary_positive,
+    "ToBinary": _h_to_binary, "TryToBinary": _h_to_binary,
+    "BitmapBitPosition": _h_bitmap_bit_position,
+    "BitmapBucketNumber": _h_bitmap_bucket_number,
+    "BitmapCount": _h_bitmap_count,
+    "Randn": _h_randn,
+    "Sentences": _h_sentences,
+    "TryElementAt": _h_try_element_at,
+    "Cardinality": _h_cardinality,
+    "MapFromEntries": _h_map_from_entries,
+    "MapSort": _h_map_sort,
+    "Shuffle": _h_shuffle,
+    "ParseToDate": _h_parse_to_date,
+    "ParseToTimestamp": _h_parse_to_date,
+    "TryToTimestamp": _h_parse_to_date,
+    "ToNumber": _h_to_number, "TryToNumber": _h_to_number,
+    "ToCharacter": _h_to_character,
+    "InputFileName": _h_input_file_name,
+    "AvroDataToCatalyst": _h_from_avro,
+    "CatalystDataToAvro": _h_to_avro,
+    "XmlToStructs": _h_from_xml,
+    "StructsToXml": _h_to_xml,
+})
+
+
+def _h_extract(e, cols, n, ansi):
+    from spark_rapids_tpu.expr.datetime import _EXTRACT_FIELDS
+    from spark_rapids_tpu.expr.base import Literal as _L
+
+    f = e.children[0]
+    name = str(f.value).lower() if isinstance(f, _L) else None
+    cls = _EXTRACT_FIELDS.get(name)
+    if cls is None:
+        if name == "epoch":
+            (src_col,) = [eval_expr(e.children[1], cols, n, ansi)]
+            out = np.zeros(n, np.int64)
+            for i in range(n):
+                if src_col.validity[i]:
+                    v = int(src_col.values[i])
+                    # date days -> seconds; timestamps are micros
+                    if isinstance(e.children[1]._dataType, T.DateType):
+                        out[i] = v * 86400
+                    else:
+                        out[i] = v // 1_000_000
+            return CpuCol(T.LONG, out, src_col.validity.copy())
+        raise NotImplementedError(f"oracle extract field {name!r}")
+    d = cls(e.children[1])
+    d._resolve_type()
+    return eval_expr(d, cols, n, ansi)
+
+
+_HANDLERS["Extract"] = _h_extract

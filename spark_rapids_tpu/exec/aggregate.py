@@ -582,14 +582,62 @@ class TpuHashAggregateExec(TpuExec):
             if self._collect_ewidth not in cache:
                 cache[self._collect_ewidth] = tpu_jit(self._agg_fn)
             jitted = cache[self._collect_ewidth]
-        else:
-            if getattr(self, "_jitted", None) is None:
-                self._jitted = tpu_jit(self._agg_fn)
-            jitted = self._jitted
-        cols, nrows = jitted(tuple(batch.columns),
-                             jnp.int32(batch.num_rows))
+            cols, nrows = jitted(tuple(batch.columns),
+                                 jnp.int32(batch.num_rows))
+            n = 1 if not self.grouping else int(nrows)
+            return ColumnarBatch(list(cols), n, self._output)
+        args = (tuple(batch.columns), jnp.int32(batch.num_rows))
+        B = self._bounded_groups_cap(batch.capacity)
+        if B:
+            # bounded-cardinality ladder (VERDICT r5 perf): run the
+            # B-wide boundary-form program; the output row count (synced
+            # anyway) doubles as the overflow check, growing B to the
+            # next power of two when the data has more groups
+            cols, nrows = self._agg_jit(B)(*args)
+            n = int(nrows)
+            while n > B:
+                B2 = min(max(1 << (n - 1).bit_length(), B * 2),
+                         batch.capacity)
+                self._groups_cap_hint = B2
+                if B2 >= batch.capacity:
+                    cols, nrows = self._agg_jit(None)(*args)
+                    n = int(nrows)
+                    break
+                cols, nrows = self._agg_jit(B2)(*args)
+                n = int(nrows)
+                B = B2
+            return ColumnarBatch(list(cols), n, self._output)
+        cols, nrows = self._agg_jit(None)(*args)
         n = 1 if not self.grouping else int(nrows)
         return ColumnarBatch(list(cols), n, self._output)
+
+    def _agg_jit(self, groups_cap=None):
+        cache = getattr(self, "_agg_jits", None)
+        if cache is None:
+            cache = self._agg_jits = {}
+        if groups_cap not in cache:
+            if groups_cap is None:
+                cache[groups_cap] = tpu_jit(self._agg_fn)
+            else:
+                def fn(cols, num_rows, _b=groups_cap):
+                    return self._agg_fn(cols, num_rows, groups_cap=_b)
+
+                cache[groups_cap] = tpu_jit(fn)
+        return cache[groups_cap]
+
+    def _bounded_groups_cap(self, cap: int):
+        """The groups-cap ladder rung for this batch, or None when the
+        bounded path does not apply (no grouping / collect aggs / conf
+        off / batch small enough that full width is already cheap)."""
+        if not self.grouping or self._has_collect:
+            return None
+        from spark_rapids_tpu.config import AGG_SMALL_GROUPS_CAP, get_conf
+
+        B = get_conf().get(AGG_SMALL_GROUPS_CAP)
+        if not B:
+            return None
+        B = max(B, getattr(self, "_groups_cap_hint", 0))
+        return B if B < cap else None
 
     def _max_group_rows_fn(self, cols, num_rows):
         """Largest per-group row count (the collect array width bound)."""
@@ -618,7 +666,7 @@ class TpuHashAggregateExec(TpuExec):
                                   num_segments=cap)
         return jnp.max(cnt)
 
-    def _agg_fn(self, cols, num_rows, row_valid=None):
+    def _agg_fn(self, cols, num_rows, row_valid=None, groups_cap=None):
         batch = ColumnarBatch(list(cols), num_rows, self.input_schema)
         ctx = EvalContext(batch, ansi=self.ansi)
         mask = batch.row_mask
@@ -641,29 +689,90 @@ class TpuHashAggregateExec(TpuExec):
             keys.append(jnp.where(mask, nullk, hi))
             for w in _column_key_words(kc):
                 keys.append(jnp.where(mask, jnp.where(kc.validity, w, 0), hi))
-        perm = jax.lax.sort(
-            tuple(keys) + (jnp.arange(cap, dtype=jnp.int32),),
-            num_keys=len(keys), is_stable=True)[-1]
-        sorted_keys = [k[perm] for k in keys]
-        mask_sorted = mask[perm]
-        seg, ngroups = group_segments(sorted_keys, mask_sorted)
-        seg = jnp.where(mask_sorted, seg, cap - 1)  # padding -> last bucket
-        # ---- group-key output columns ----
-        first_idx = SEG.seg_first_index(seg, mask_sorted, cap)
-        safe_first = jnp.clip(first_idx, 0, cap - 1)
-        out_cols: List[DeviceColumn] = []
-        group_valid = jnp.arange(cap) < ngroups
-        for kc in key_cols:
-            kcs = _gather_col(kc, perm)
-            g = _gather_col(kcs, safe_first)
-            out_cols.append(DeviceColumn(
-                g.dtype, g.validity & group_valid, data=g.data,
-                chars=g.chars, lengths=g.lengths))
-        # ---- aggregates ----
-        for a, f in zip(self.aggregates, self._agg_fields()):
-            out_cols.extend(self._eval_agg(a, f, ctx, perm, seg, mask_sorted,
-                                           cap, group_valid))
+        # CO-SORT the aggregate-input payloads with the keys: one fused
+        # sorting network moves the data, replacing one full-width random
+        # gather PER INPUT (each ~380ms at 20M rows on v5e — round-5
+        # calibration) with a small per-operand sort cost
+        payload = self._presortable_inputs(ctx)
+        extra_ops: List[jax.Array] = []
+        layout = []
+        for pk, c, arrs in payload:
+            layout.append((pk, c, len(arrs)))
+            extra_ops.extend(arrs)
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        sorted_all = jax.lax.sort(
+            tuple(keys) + (iota, mask) + tuple(extra_ops),
+            num_keys=len(keys), is_stable=True)
+        nk = len(keys)
+        sorted_keys = list(sorted_all[:nk])
+        perm = sorted_all[nk]
+        mask_sorted = sorted_all[nk + 1]
+        rest = sorted_all[nk + 2:]
+        self._presorted = {}
+        pos = 0
+        for pk, c, k in layout:
+            self._presorted[pk] = _rebuild_flat_col(c, rest[pos:pos + k])
+            pos += k
+        try:
+            seg, ngroups = group_segments(sorted_keys, mask_sorted)
+            seg = jnp.where(mask_sorted, seg, cap - 1)  # padding -> last
+            nseg = cap
+            bscope = None
+            if groups_cap:
+                # bounded-cardinality mode (VERDICT r5 perf): outputs are
+                # groups_cap wide; every SEG primitive in this trace takes
+                # the boundary form (no full-width scatters).  The caller
+                # verifies ngroups <= groups_cap from the synced row count
+                # and re-runs on the next ladder rung if not.
+                nseg = groups_cap
+                bscope = SEG.bounds_scope(SEG.SegBounds(seg, nseg))
+                bscope.__enter__()
+            try:
+                # ---- group-key output columns ----
+                first_idx = SEG.seg_first_index(seg, mask_sorted, nseg)
+                safe_first = jnp.clip(first_idx, 0, cap - 1)
+                out_cols: List[DeviceColumn] = []
+                group_valid = jnp.arange(nseg) < ngroups
+                for kc in key_cols:
+                    g = _gather_col(kc, perm[safe_first])
+                    out_cols.append(DeviceColumn(
+                        g.dtype, g.validity & group_valid, data=g.data,
+                        chars=g.chars, lengths=g.lengths))
+                # ---- aggregates ----
+                for a, f in zip(self.aggregates, self._agg_fields()):
+                    out_cols.extend(self._eval_agg(
+                        a, f, ctx, perm, seg, mask_sorted, cap,
+                        group_valid, nseg=nseg))
+            finally:
+                if bscope is not None:
+                    bscope.__exit__()
+        finally:
+            self._presorted = None
         return tuple(out_cols), ngroups.astype(jnp.int32)
+
+    _PRESORTABLE_FUNCS = frozenset({
+        "sum", "count", "min", "max", "avg", "first", "last",
+        "any_value", "bool_and", "bool_or", "bit_and", "bit_or",
+        "bit_xor", "count_if"})
+
+    def _presortable_inputs(self, ctx):
+        """Aggregate-input columns eligible for key co-sorting, with their
+        flat operand arrays.  Strings/nested stay on the gather path."""
+        out = []
+        for a in self.aggregates:
+            if a.func not in self._PRESORTABLE_FUNCS:
+                continue
+            suffixes = [None]
+            if self.mode == AggregateMode.FINAL and a.func == "avg":
+                suffixes = ["_sum", "_count"]
+            if a.child is None and self.mode != AggregateMode.FINAL:
+                continue     # count(*): a constant ones column
+            for sfx in suffixes:
+                c = self._input_col(a, ctx, None, sfx)
+                arrs = _flat_sort_operands(c)
+                if arrs is not None:
+                    out.append(((a.result_name, sfx), c, arrs))
+        return out
 
     def _agg_fields(self):
         """Output fields per aggregate (partial avg takes two)."""
@@ -687,7 +796,15 @@ class TpuHashAggregateExec(TpuExec):
     # -- per-aggregate evaluation --------------------------------------
     def _input_col(self, a: AggregateExpression, ctx, perm,
                    suffix: Optional[str] = None):
-        """Column holding this aggregate's input (already sorted via perm)."""
+        """Column holding this aggregate's input (already sorted via perm).
+
+        When the enclosing _agg_fn co-sorted this input with the keys the
+        presorted column comes back directly — no gather."""
+        pres = getattr(self, "_presorted", None)
+        if perm is not None and pres is not None:
+            hit = pres.get((a.result_name, suffix))
+            if hit is not None:
+                return hit
         if self.mode == AggregateMode.FINAL:
             # inputs are the partial buffers by position in child schema
             name = a.result_name + (suffix or "")
@@ -1467,3 +1584,25 @@ def _seg_last_index(seg, row_mask, num_segments):
 
 def _gather_col(c: DeviceColumn, idx) -> DeviceColumn:
     return c.gather(idx)
+
+
+def _flat_sort_operands(c: DeviceColumn):
+    """1-D operand arrays of a flat (or dec128 two-limb) column for key
+    co-sorting; None when the column needs the gather path (strings,
+    arrays, structs)."""
+    if c.chars is not None or c.children is not None \
+            or c.elem_valid is not None or c.data is None:
+        return None
+    if c.data.ndim == 1:
+        return [c.data, c.validity]
+    if c.data.ndim == 2 and c.data.shape[1] == 2:     # decimal128 limbs
+        return [c.data[:, 0], c.data[:, 1], c.validity]
+    return None
+
+
+def _rebuild_flat_col(c: DeviceColumn, arrs) -> DeviceColumn:
+    """Inverse of _flat_sort_operands over the sorted operand slices."""
+    if len(arrs) == 2:
+        return DeviceColumn(c.dtype, arrs[1], data=arrs[0])
+    return DeviceColumn(c.dtype, arrs[2],
+                        data=jnp.stack([arrs[0], arrs[1]], axis=1))

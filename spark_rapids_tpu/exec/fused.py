@@ -308,38 +308,84 @@ class TpuJoinAggFusedExec(TpuExec):
 
     def _unique_probe_agg(self, build, probe, agg) -> ColumnarBatch:
         """Unique-build fast path: probe search + build gather + aggregate
-        in ONE program; no size sync (output capacity == probe capacity)."""
+        in ONE program; no size sync (output capacity == probe capacity).
+        The aggregate runs through its bounded-cardinality ladder
+        (groups_cap) — the synced output row count is the overflow
+        check."""
         join = self.join
         left_outer = join.join_type == JoinType.LEFT_OUTER
         schema = probe.schema
 
-        def fn(bwords, row_index, n_valid, b_cols, p_cols, num_rows):
-            b = ColumnarBatch(list(p_cols), num_rows, schema)
-            ctx = EvalContext(b, ansi=join.ansi)
-            key_cols = [k.eval_tpu(ctx) for k in join.left_keys]
-            valid = b.row_mask
-            for kc in key_cols:
-                valid = valid & kc.validity
-            qwords = _key_words_of(key_cols)
-            lo = _multiword_searchsorted(list(bwords), n_valid, qwords,
-                                         "left")
-            cap_b = bwords[0].shape[0]
-            loc = jnp.clip(lo, 0, cap_b - 1)
-            eq = jnp.ones(lo.shape, jnp.bool_)
-            for w, q in zip(bwords, qwords):
-                eq = eq & (w[loc] == q)
-            found = valid & (lo < n_valid) & eq
-            brow = jnp.where(found, row_index[loc], 0)
-            bcols = [_mask_col(c.gather(brow), found) for c in b_cols]
-            joined = tuple(list(p_cols) + bcols)
-            row_valid = b.row_mask if left_outer else (b.row_mask & found)
-            return agg._agg_fn(joined, num_rows, row_valid=row_valid)
+        def mk(groups_cap):
+            def fn(bwords, row_index, n_valid, b_cols, p_cols, num_rows):
+                b = ColumnarBatch(list(p_cols), num_rows, schema)
+                ctx = EvalContext(b, ansi=join.ansi)
+                key_cols = [k.eval_tpu(ctx) for k in join.left_keys]
+                valid = b.row_mask
+                for kc in key_cols:
+                    valid = valid & kc.validity
+                qwords = _key_words_of(key_cols)
+                lo = _multiword_searchsorted(list(bwords), n_valid, qwords,
+                                             "left")
+                cap_b = bwords[0].shape[0]
+                loc = jnp.clip(lo, 0, cap_b - 1)
+                # small build tables ride the MXU one-hot gather: a VPU
+                # random gather costs ~300ms per column at 20M probe rows
+                # while the fused one_hot@table contraction is ~5ms
+                # (ops/mxugather.py)
+                from spark_rapids_tpu.ops import mxugather as MG
 
-        jitted = self._cached(("uniq_agg", id(agg)), fn)
-        cols, nrows = jitted(tuple(build.words), build.row_index,
-                             build.n_valid, tuple(build.batch.columns),
-                             tuple(probe.columns),
-                             jnp.int32(probe.num_rows))
+                use_mxu = cap_b <= MG.MAX_TABLE_ROWS
+                eq = jnp.ones(lo.shape, jnp.bool_)
+                for w, q in zip(bwords, qwords):
+                    wl = MG.mxu_gather(w, loc) if use_mxu else w[loc]
+                    eq = eq & (wl == q)
+                found = valid & (lo < n_valid) & eq
+                if use_mxu:
+                    brow = jnp.where(found, MG.mxu_gather(row_index, loc),
+                                     0)
+                    bcols = []
+                    for c in b_cols:
+                        g = MG.mxu_gather_col(c, brow)
+                        if g is None:
+                            g = c.gather(brow)
+                        bcols.append(_mask_col(g, found))
+                else:
+                    brow = jnp.where(found, row_index[loc], 0)
+                    bcols = [_mask_col(c.gather(brow), found)
+                             for c in b_cols]
+                joined = tuple(list(p_cols) + bcols)
+                row_valid = b.row_mask if left_outer \
+                    else (b.row_mask & found)
+                return agg._agg_fn(joined, num_rows, row_valid=row_valid,
+                                   groups_cap=groups_cap)
+
+            return fn
+
+        args = (tuple(build.words), build.row_index, build.n_valid,
+                tuple(build.batch.columns), tuple(probe.columns),
+                jnp.int32(probe.num_rows))
+        cap = probe.capacity
+        B = agg._bounded_groups_cap(cap)
+        if B:
+            cols, nrows = self._cached(("uniq_agg", id(agg), B),
+                                       mk(B))(*args)
+            n = int(nrows)
+            while n > B:
+                B2 = min(max(1 << (n - 1).bit_length(), B * 2), cap)
+                agg._groups_cap_hint = B2
+                if B2 >= cap:
+                    B2 = None
+                cols, nrows = self._cached(("uniq_agg", id(agg), B2),
+                                           mk(B2))(*args)
+                if B2 is None:
+                    n = int(nrows)
+                    break
+                n = int(nrows)
+                B = B2
+            return self._finish(agg, cols, n)
+        cols, nrows = self._cached(("uniq_agg", id(agg), None),
+                                   mk(None))(*args)
         return self._finish(agg, cols, nrows)
 
 
@@ -385,22 +431,29 @@ class TpuWindowChainFusedExec(TpuExec):
             self._jit_cache[key] = tpu_jit(builder)
         return self._jit_cache[key]
 
-    def _chain_fn(self, with_agg: bool):
+    def _chain_fn(self, with_agg: bool, groups_cap=None):
         window = self.window
         pre_agg = self.pre_agg if with_agg else None
         post_ops = self.post_ops
 
         def fn(cols, num_rows):
+            ngroups = jnp.asarray(0, jnp.int32)
             if pre_agg is not None:
-                cols, num_rows = pre_agg._agg_fn(cols, num_rows)
-                num_rows = num_rows.astype(jnp.int32)
+                # bounded-cardinality agg: the window then runs over the
+                # B-wide grouped result instead of input-capacity columns
+                cols, ngroups = pre_agg._agg_fn(cols, num_rows,
+                                                groups_cap=groups_cap)
+                num_rows = ngroups.astype(jnp.int32)
             wcols = window._window_fn(tuple(cols), num_rows)
             batch = ColumnarBatch(list(wcols), num_rows, window.output)
             if post_ops:
                 ctx = EvalContext(batch, ansi=False)
                 for op in post_ops:
                     batch = op.apply(ctx, batch)
-            return tuple(batch.columns), jnp.asarray(batch.num_rows)
+            # ngroups reported separately: post_ops may filter rows, so
+            # the final count cannot double as the ladder overflow check
+            return (tuple(batch.columns), jnp.asarray(batch.num_rows),
+                    jnp.asarray(ngroups, jnp.int32))
 
         return fn
 
@@ -416,9 +469,31 @@ class TpuWindowChainFusedExec(TpuExec):
         owner.children = list(self.children)
 
         def run(b, with_agg):
-            jitted = self._cached(("chain", with_agg, b.capacity),
-                                  self._chain_fn(with_agg))
-            cols, count = jitted(tuple(b.columns), jnp.int32(b.num_rows))
+            args = (tuple(b.columns), jnp.int32(b.num_rows))
+            B = (self.pre_agg._bounded_groups_cap(b.capacity)
+                 if with_agg else None)
+            if B:
+                cols, count, ng = self._cached(
+                    ("chain", with_agg, b.capacity, B),
+                    self._chain_fn(with_agg, B))(*args)
+                n, g = int(count), int(ng)
+                while g > B:     # groups-cap ladder (see aggregate.py)
+                    B2 = min(max(1 << (g - 1).bit_length(), B * 2),
+                             b.capacity)
+                    self.pre_agg._groups_cap_hint = B2
+                    if B2 >= b.capacity:
+                        B2 = None
+                    cols, count, ng = self._cached(
+                        ("chain", with_agg, b.capacity, B2),
+                        self._chain_fn(with_agg, B2))(*args)
+                    n, g = int(count), int(ng)
+                    if B2 is None:
+                        break
+                    B = B2
+                return ColumnarBatch(list(cols), n, self.output)
+            cols, count, _ = self._cached(
+                ("chain", with_agg, b.capacity, None),
+                self._chain_fn(with_agg))(*args)
             return ColumnarBatch(list(cols), int(count), self.output)
 
         fw = get_spill_framework()

@@ -593,3 +593,17 @@ class TryMultiply(_TryMixin, Multiply):
 
 class TryDivide(_TryMixin, Divide):
     _fn_name = "try_divide"
+
+
+class UnaryPositive(UnaryExpression):
+    """(+ e): identity (Spark keeps the node through analysis)."""
+
+    def sql_string(self):
+        return f"(+ {self.child.sql_string()})"
+
+    def _resolve_type(self):
+        self._dataType = self.child.dataType
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        return cols[0]

@@ -1,0 +1,244 @@
+"""from_avro / to_avro — per-row Avro binary codec expressions.
+
+Reference analog: the spark-avro connector's AvroDataToCatalyst /
+CatalystDataToAvro, which the plugin accelerates via GpuAvroScan-adjacent
+paths (SURVEY.md §2.5 JSON/Avro row codecs).  TPU design: the record
+codec is a host kernel (one pure_callback over the batch — the same tier
+as Crc32/Encode); the surrounding plan stays columnar on device.  The
+value codec is io/avro.py's own from-scratch implementation — no
+third-party avro dependency.
+
+Supported schemas: flat records of primitive fields (int/long, string,
+boolean, float/double) with optional ["null", T] unions — the subset the
+tag check admits; anything else falls back by rule.
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import jax
+import numpy as np
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expr.base import (Expression, UnaryExpression,
+                                        call_host_kernel)
+from spark_rapids_tpu.io.avro import (_Reader, _decode_value, _encode_value,
+                                      avro_schema_to_struct)
+
+
+def _schema_of(expr) -> Optional[dict]:
+    from spark_rapids_tpu.expr.base import Literal
+
+    if len(expr.children) > 1 and isinstance(expr.children[1], Literal) \
+            and expr.children[1].value is not None:
+        try:
+            return json.loads(str(expr.children[1].value))
+        except ValueError:
+            return None
+    return None
+
+
+class AvroDataToCatalyst(Expression):
+    """from_avro(binary, jsonSchema) -> struct (PERMISSIVE: corrupt rows
+    null out, matching the connector's default mode)."""
+
+    is_host_kernel = True
+
+    def __init__(self, child: Expression, json_schema: Expression):
+        super().__init__([child, json_schema])
+
+    def _resolve_type(self):
+        self._avro_schema = _schema_of(self)
+        self._dataType = (avro_schema_to_struct(self._avro_schema)
+                          if self._avro_schema else
+                          T.StructType([]))
+        self._nullable = True
+
+    def sql_string(self):
+        return f"from_avro({self.children[0].sql_string()})"
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        cap = c.capacity
+        schema = self._avro_schema
+        st: T.StructType = self.dataType
+
+        STR_W = 64      # fixed decode width for string fields
+
+        def run(chars, lengths, validity):
+            chars = np.asarray(chars)
+            lengths = np.asarray(lengths)
+            validity = np.asarray(validity)
+            ok = np.zeros(cap, np.bool_)
+            outs = [ok]
+            store = []
+            for f in st.fields:
+                fv = np.zeros(cap, np.bool_)
+                if isinstance(f.dataType, T.StringType):
+                    store.append((fv, np.zeros((cap, STR_W), np.uint8),
+                                  np.zeros(cap, np.int32)))
+                else:
+                    store.append((fv, np.zeros(
+                        cap, T.storage_dtype(f.dataType))))
+            for i in range(cap):
+                if not validity[i]:
+                    continue
+                try:
+                    r = _Reader(bytes(chars[i, :lengths[i]]))
+                    rec = _decode_value(r, schema)
+                except Exception:
+                    continue
+                ok[i] = True
+                for f, parts in zip(st.fields, store):
+                    v = rec.get(f.name)
+                    if v is None:
+                        continue
+                    parts[0][i] = True
+                    if isinstance(f.dataType, T.StringType):
+                        b = str(v).encode("utf-8")[:STR_W]
+                        parts[1][i, :len(b)] = np.frombuffer(b, np.uint8)
+                        parts[2][i] = len(b)
+                    else:
+                        parts[1][i] = v
+            for parts in store:
+                outs.extend(parts)
+            return tuple(outs)
+
+        shapes = [jax.ShapeDtypeStruct((cap,), np.bool_)]
+        for f in st.fields:
+            shapes.append(jax.ShapeDtypeStruct((cap,), np.bool_))
+            if isinstance(f.dataType, T.StringType):
+                shapes.append(jax.ShapeDtypeStruct((cap, STR_W), np.uint8))
+                shapes.append(jax.ShapeDtypeStruct((cap,), np.int32))
+            else:
+                shapes.append(jax.ShapeDtypeStruct(
+                    (cap,), T.storage_dtype(f.dataType)))
+        res = call_host_kernel(run, tuple(shapes), c.chars, c.lengths,
+                               c.validity)
+        ok = res[0]
+        kids = []
+        k = 1
+        for f in st.fields:
+            fv = res[k]
+            k += 1
+            if isinstance(f.dataType, T.StringType):
+                ch, ln = res[k], res[k + 1]
+                k += 2
+                kids.append(DeviceColumn(f.dataType, fv, chars=ch,
+                                         lengths=ln))
+            else:
+                d = res[k]
+                k += 1
+                kids.append(DeviceColumn(f.dataType, fv, data=d))
+        return DeviceColumn(st, c.validity & ok, children=tuple(kids))
+
+
+class CatalystDataToAvro(Expression):
+    """to_avro(struct[, jsonSchema]) -> binary (string column)."""
+
+    is_host_kernel = True
+
+    def __init__(self, child: Expression,
+                 json_schema: Optional[Expression] = None):
+        super().__init__([child] if json_schema is None
+                         else [child, json_schema])
+
+    def _resolve_type(self):
+        self._avro_schema = _schema_of(self)
+        if self._avro_schema is None:
+            st = self.children[0].dataType
+            self._avro_schema = {
+                "type": "record", "name": "topLevelRecord",
+                "fields": [{"name": f.name,
+                            "type": [_avro_primitive(f.dataType), "null"]
+                            if f.nullable else _avro_primitive(f.dataType)}
+                           for f in st.fields]}
+        self._dataType = T.STRING
+        self._nullable = self.children[0].nullable
+
+    def sql_string(self):
+        return f"to_avro({self.children[0].sql_string()})"
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        cap = c.capacity
+        st: T.StructType = self.children[0].dataType
+        schema = self._avro_schema
+
+        flat = [c.validity]
+        field_layout = []
+        for kid in c.children:
+            flat.append(kid.validity)
+            if kid.data is not None:
+                flat.append(kid.data)
+                field_layout.append(("flat", 2))
+            else:
+                flat.append(kid.chars)
+                flat.append(kid.lengths)
+                field_layout.append(("str", 3))
+        width = 16
+        for f, kid in zip(st.fields, c.children):
+            width += (kid.chars.shape[1] + 8) if kid.chars is not None else 12
+
+        def run(*arrs):
+            arrs = [np.asarray(a) for a in arrs]
+            validity = arrs[0]
+            out_chars = np.zeros((cap, width), np.uint8)
+            out_lens = np.zeros(cap, np.int32)
+            pos = 1
+            cols_np = []
+            for kind, cnt in field_layout:
+                cols_np.append((kind, arrs[pos:pos + cnt]))
+                pos += cnt
+            for i in range(cap):
+                if not validity[i]:
+                    continue
+                rec = {}
+                for (kind, parts), f in zip(cols_np, st.fields):
+                    if not parts[0][i]:
+                        rec[f.name] = None
+                    elif kind == "str":
+                        rec[f.name] = bytes(
+                            parts[1][i, :parts[2][i]]).decode(
+                            "utf-8", "replace")
+                    else:
+                        v = parts[1][i]
+                        if isinstance(f.dataType, T.BooleanType):
+                            v = bool(v)
+                        elif isinstance(f.dataType,
+                                        (T.FloatType, T.DoubleType)):
+                            v = float(v)
+                        else:
+                            v = int(v)
+                        rec[f.name] = v
+                buf = bytearray()
+                _encode_value(buf, schema, rec)
+                b = bytes(buf)[:width]
+                out_chars[i, :len(b)] = np.frombuffer(b, np.uint8)
+                out_lens[i] = len(b)
+            return out_chars, out_lens
+
+        shapes = (jax.ShapeDtypeStruct((cap, width), np.uint8),
+                  jax.ShapeDtypeStruct((cap,), np.int32))
+        och, oln = call_host_kernel(run, shapes, *flat)
+        return DeviceColumn(T.STRING, c.validity, chars=och, lengths=oln)
+
+
+def _avro_primitive(dt) -> str:
+    if isinstance(dt, T.BooleanType):
+        return "boolean"
+    if isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType)):
+        return "int"
+    if isinstance(dt, T.LongType):
+        return "long"
+    if isinstance(dt, T.FloatType):
+        return "float"
+    if isinstance(dt, T.DoubleType):
+        return "double"
+    if isinstance(dt, T.StringType):
+        return "string"
+    raise TypeError(f"to_avro: unsupported field type {dt}")

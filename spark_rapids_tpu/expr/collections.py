@@ -1140,3 +1140,169 @@ class ArraysZip(Expression):
                 lengths=c.lengths, elem_valid=c.elem_valid))
         return DeviceColumn(self.dataType, validity, lengths=out_len,
                             children=tuple(kids))
+
+
+class TryElementAt(ElementAt):
+    """try_element_at: element_at that returns NULL instead of erroring on
+    0 / out-of-range index (the engine's ElementAt is already null-safe;
+    this class pins the ANSI-mode behavior too)."""
+
+    def sql_string(self):
+        return (f"try_element_at({self.left.sql_string()}, "
+                f"{self.right.sql_string()})")
+
+
+class Cardinality(UnaryExpression):
+    """cardinality(array|map): element count, NULL for null input (unlike
+    legacy size() which yields -1)."""
+
+    def _resolve_type(self):
+        self._dataType = T.INT
+        self._nullable = self.child.nullable
+
+    def sql_string(self):
+        return f"cardinality({self.child.sql_string()})"
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        c = cols[0]
+        lens = c.lengths
+        if lens is None and c.children is not None:
+            lens = c.children[0].lengths    # map layout: key child
+        return DeviceColumn(T.INT, c.validity, data=lens)
+
+
+class MapFromEntries(UnaryExpression):
+    """map_from_entries(array<struct<k,v>>) -> map<k,v>.
+
+    The entries layout IS the map layout (per-field element columns
+    sharing lengths), so this is a relabel + the Spark error checks:
+    null keys error; duplicate keys error under the default
+    spark.sql.mapKeyDedupPolicy=EXCEPTION.
+
+    Reference analog: GpuMapFromEntries (collectionOperations.scala,
+    SURVEY.md §2.5 Collections)."""
+
+    def _resolve_type(self):
+        at = self.child.dataType
+        et = at.elementType
+        self._dataType = T.MapType(et.fields[0].dataType,
+                                   et.fields[1].dataType)
+        self._nullable = True
+
+    def sql_string(self):
+        return f"map_from_entries({self.child.sql_string()})"
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        arr = cols[0]
+        kcol, vcol = arr.children
+        w = max(arr.ewidth, 1)
+        in_len = jnp.arange(w)[None, :] < arr.lengths[:, None]
+        # null map keys are an error (Spark: "Cannot use null as map key")
+        null_key = arr.validity & jnp.any(in_len & ~kcol.elem_valid, axis=1)
+        ctx.add_error(null_key, "Cannot use null as map key")
+        # duplicate keys: per-row sort + adjacent compare (EXCEPTION policy)
+        kd = kcol.data
+        if kd is not None and kd.ndim == 2:
+            big = jnp.iinfo(jnp.int64).max
+            masked = jnp.where(in_len & kcol.elem_valid,
+                               kd.astype(jnp.int64), big)
+            ks = jnp.sort(masked, axis=1)
+            dup = jnp.any((ks[:, 1:] == ks[:, :-1]) & (ks[:, 1:] != big),
+                          axis=1)
+            ctx.add_error(arr.validity & dup,
+                          "Duplicate map key was found")
+        keys_out = DeviceColumn(T.ArrayType(self.dataType.keyType, False),
+                                arr.validity, data=kcol.data,
+                                chars=kcol.chars,
+                                lengths=arr.lengths,
+                                elem_valid=kcol.elem_valid)
+        vals_out = DeviceColumn(T.ArrayType(self.dataType.valueType),
+                                arr.validity, data=vcol.data,
+                                chars=vcol.chars,
+                                lengths=arr.lengths,
+                                elem_valid=vcol.elem_valid)
+        return DeviceColumn(self.dataType, arr.validity,
+                            lengths=arr.lengths,
+                            children=(keys_out, vals_out))
+
+
+class MapSort(UnaryExpression):
+    """map_sort-like canonical ordering: entries sorted by key per row
+    (Spark 4.0 MapSort; flat orderable keys — the tag check restricts).
+
+    TPU design: one vectorized per-row argsort over the padded entries
+    axis (pads sort last), then take_along_axis on keys and values —
+    no per-row loops."""
+
+    def _resolve_type(self):
+        self._dataType = self.child.dataType
+        self._nullable = self.child.nullable
+
+    def sql_string(self):
+        return f"map_sort({self.child.sql_string()})"
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        m = cols[0]
+        kcol, vcol = m.children
+        # map columns carry lengths/width on the key child
+        lens = kcol.lengths
+        w = max(kcol.data.shape[1], 1)
+        in_len = jnp.arange(w)[None, :] < lens[:, None]
+        big = jnp.iinfo(jnp.int64).max
+        masked = jnp.where(in_len, kcol.data.astype(jnp.int64), big)
+        order = jnp.argsort(masked, axis=1)
+        ks = jnp.take_along_axis(kcol.data, order, axis=1)
+        kev = jnp.take_along_axis(kcol.elem_valid, order, axis=1)
+        kout = DeviceColumn(kcol.dtype, kcol.validity, data=ks,
+                            lengths=lens, elem_valid=kev)
+        if vcol.data is not None and vcol.data.ndim == 2:
+            vs = jnp.take_along_axis(vcol.data, order, axis=1)
+            vev = jnp.take_along_axis(vcol.elem_valid, order, axis=1)
+            vout = DeviceColumn(vcol.dtype, vcol.validity, data=vs,
+                                lengths=lens, elem_valid=vev)
+        else:   # string values: gather the 3-D char tensor by entry
+            # string_array layout: data holds per-element byte lengths
+            vch = jnp.take_along_axis(vcol.chars, order[:, :, None], axis=1)
+            vln = jnp.take_along_axis(vcol.data, order, axis=1)
+            vev = jnp.take_along_axis(vcol.elem_valid, order, axis=1)
+            vout = DeviceColumn(vcol.dtype, vcol.validity, data=vln,
+                                chars=vch, lengths=lens,
+                                elem_valid=vev)
+        return DeviceColumn(self.dataType, m.validity,
+                            children=(kout, vout))
+
+
+class Shuffle(UnaryExpression):
+    """shuffle(array[, seed]): random permutation per row via a
+    splitmix-keyed per-row argsort (not Spark's sequence — like GpuRand,
+    the stream differs; tests pin determinism per seed)."""
+
+    def __init__(self, child: Expression, seed: int = 0):
+        super().__init__(child)
+        self._seed = seed
+
+    def _resolve_type(self):
+        self._dataType = self.child.dataType
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        arr = cols[0]
+        w = max(arr.ewidth, 1)
+        cap = arr.capacity
+        # fixed stride so the stream is layout-independent (the oracle
+        # computes the same ranks from (row, element) alone)
+        idx = (jnp.arange(cap, dtype=jnp.uint64)[:, None]
+               * jnp.uint64(1 << 17)
+               + jnp.arange(w, dtype=jnp.uint64)[None, :])
+        z = idx * jnp.uint64(0x9E3779B97F4A7C15) \
+            + jnp.uint64(self._seed * 2654435769 + 11)
+        z = (z ^ (z >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> 27)) * jnp.uint64(0x94D049BB133111EB)
+        rank = (z ^ (z >> 31)).astype(jnp.int64)
+        in_len = jnp.arange(w)[None, :] < arr.lengths[:, None]
+        big = jnp.iinfo(jnp.int64).max
+        order = jnp.argsort(jnp.where(in_len, rank, big), axis=1)
+        data = jnp.take_along_axis(arr.data, order, axis=1)
+        ev = jnp.take_along_axis(arr.elem_valid, order, axis=1)
+        return DeviceColumn(self.dataType, arr.validity, data=data,
+                            lengths=arr.lengths, elem_valid=ev)

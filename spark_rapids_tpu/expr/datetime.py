@@ -1009,3 +1009,118 @@ class DatePart(Expression):
 
     def do_columnar_eval(self, ctx, cols):
         return self._inner.do_columnar_eval(ctx, cols)
+
+
+class ParseToDate(Expression):
+    """to_date(e, fmt) — format-carrying variant.  The default-grammar
+    formats ('yyyy-MM-dd') delegate to the cast parser; other literal
+    formats are tag-time fallbacks (overrides._check_parse_to_date).
+
+    Reference analog: GpuParseToDate via GpuGetTimestamp rewrite."""
+
+    def __init__(self, child: Expression, fmt: Expression = None):
+        super().__init__([child] if fmt is None else [child, fmt])
+
+    def _resolve_type(self):
+        self._dataType = T.DATE
+        self._nullable = True
+
+    def sql_string(self):
+        return f"to_date({', '.join(c.sql_string() for c in self.children)})"
+
+    @property
+    def fmt_literal(self):
+        from spark_rapids_tpu.expr.base import Literal
+
+        if len(self.children) == 1:
+            return None
+        f = self.children[1]
+        return str(f.value) if isinstance(f, Literal) and f.value is not None \
+            else False      # non-literal / null format: unsupported
+
+    def do_columnar_eval(self, ctx, cols):
+        d = ToDate(self.children[0])
+        d._resolve_type()
+        return d.do_columnar_eval(ctx, cols[:1])
+
+
+class ParseToTimestamp(Expression):
+    """to_timestamp(e, fmt) — format-carrying variant (default grammar
+    only, like ParseToDate)."""
+
+    def __init__(self, child: Expression, fmt: Expression = None):
+        super().__init__([child] if fmt is None else [child, fmt])
+
+    def _resolve_type(self):
+        self._dataType = T.TIMESTAMP
+        self._nullable = True
+
+    def sql_string(self):
+        return (f"to_timestamp("
+                f"{', '.join(c.sql_string() for c in self.children)})")
+
+    fmt_literal = ParseToDate.fmt_literal
+
+    def do_columnar_eval(self, ctx, cols):
+        t = ToTimestamp(self.children[0])
+        t._resolve_type()
+        return t.do_columnar_eval(ctx, cols[:1])
+
+
+_EXTRACT_FIELDS = {
+    "year": Year, "yearofweek": Year, "month": Month, "mon": Month,
+    "day": DayOfMonth, "days": DayOfMonth, "d": DayOfMonth,
+    "dayofweek": DayOfWeek, "dow": DayOfWeek,
+    "doy": DayOfYear, "quarter": Quarter, "qtr": Quarter,
+    "week": WeekOfYear, "weeks": WeekOfYear, "w": WeekOfYear,
+    "hour": Hour, "hours": Hour, "h": Hour,
+    "minute": Minute, "minutes": Minute, "min": Minute,
+    "second": Second, "seconds": Second, "s": Second,
+}
+
+
+class Extract(Expression):
+    """extract(FIELD FROM source): delegates to the matching field
+    expression (Spark resolves Extract the same way at analysis time)."""
+
+    def __init__(self, field: Expression, source: Expression):
+        super().__init__([field, source])
+
+    def _resolve_type(self):
+        from spark_rapids_tpu.expr.base import Literal
+
+        f = self.children[0]
+        name = str(f.value).lower() if isinstance(f, Literal) else None
+        cls = _EXTRACT_FIELDS.get(name)
+        self._delegate = None
+        if cls is not None:
+            d = cls(self.children[1])
+            d._resolve_type()
+            self._delegate = d
+        self._dataType = (self._delegate._dataType if self._delegate
+                          else T.INT)
+        self._nullable = True
+
+    def sql_string(self):
+        return (f"extract({self.children[0].sql_string()} FROM "
+                f"{self.children[1].sql_string()})")
+
+    def do_columnar_eval(self, ctx, cols):
+        return self._delegate.do_columnar_eval(ctx, cols[1:])
+
+
+class TryToTimestamp(ParseToTimestamp):
+    """try_to_timestamp: NULL instead of error on malformed input (the
+    non-ANSI cast grammar already nulls; this pins ANSI mode too)."""
+
+    def sql_string(self):
+        return (f"try_to_timestamp("
+                f"{', '.join(c.sql_string() for c in self.children)})")
+
+    def do_columnar_eval(self, ctx, cols):
+        saved = ctx.ansi
+        ctx.ansi = False
+        try:
+            return super().do_columnar_eval(ctx, cols)
+        finally:
+            ctx.ansi = saved

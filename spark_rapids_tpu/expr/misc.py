@@ -1091,3 +1091,479 @@ class TypeOf(UnaryExpression):
         s = self.child.dataType.simpleString
         host = HostColumn.from_pylist([s] * cap, T.STRING)
         return DeviceColumn.from_host(host, capacity=cap)
+
+
+class ToBinary(Expression):
+    """to_binary(str[, fmt]) -> binary (string column, the engine's binary
+    representation).  fmt literal in {'utf-8','utf8','hex','base64'}.
+
+    Reference analog: GpuToBinary paths (hex via GpuUnhex, utf-8 identity;
+    SURVEY.md §2.5 Strings)."""
+
+    is_host_kernel = True
+    _try = False
+
+    def __init__(self, child: Expression, fmt: Optional[Expression] = None):
+        super().__init__([child] if fmt is None else [child, fmt])
+
+    def _resolve_type(self):
+        from spark_rapids_tpu.expr.base import Literal
+
+        self._dataType = T.STRING
+        self._nullable = True
+        self._fmt = "hex"
+        if len(self.children) > 1:
+            f = self.children[1]
+            if isinstance(f, Literal) and f.value is not None:
+                self._fmt = str(f.value).lower()
+
+    def sql_string(self):
+        name = "try_to_binary" if self._try else "to_binary"
+        return f"{name}({self.children[0].sql_string()}, '{self._fmt}')"
+
+    def do_columnar_eval(self, ctx, cols):
+        fmt = self._fmt
+        c = cols[0]
+
+        if fmt in ("utf-8", "utf8"):
+            return DeviceColumn(T.STRING, c.validity, chars=c.chars,
+                                lengths=c.lengths)
+
+        import base64 as b64
+
+        def from_hex(b):
+            t = b.decode("ascii", "replace")
+            if not all(ch in "0123456789abcdefABCDEF" for ch in t):
+                return None
+            if len(t) % 2:
+                t = "0" + t
+            return bytes.fromhex(t)
+
+        def from_b64(b):
+            try:
+                return b64.b64decode(b, validate=True)
+            except Exception:
+                return None
+
+        fn = from_hex if fmt == "hex" else from_b64
+        width = max(1, (c.width + 1) // 2 if fmt == "hex"
+                    else (c.width * 3 + 3) // 4)
+        out = _host_string_map(c, width, fn)
+        if not self._try:
+            bad = c.validity & ~out.validity
+            ctx.add_error(bad, f"to_binary: malformed {fmt} input")
+        return out
+
+
+class TryToBinary(ToBinary):
+    """try_to_binary: NULL instead of error on malformed input."""
+
+    _try = True
+
+
+class BitmapBitPosition(UnaryExpression):
+    """bitmap_bit_position(long): 0-based position within a bitmap bucket
+    (Spark: (input - 1) % 32768 for positive, input % 32768 otherwise)."""
+
+    def _resolve_type(self):
+        self._dataType = T.LONG
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        v = cols[0].data.astype(jnp.int64)
+        adj = jnp.where(v > 0, v - 1, v)
+        # Spark uses Math.floorMod against the bitmap bit count
+        pos = jnp.remainder(adj, jnp.int64(32768))
+        pos = jnp.where(pos < 0, pos + 32768, pos)
+        return DeviceColumn(T.LONG, cols[0].validity, data=pos)
+
+
+class BitmapBucketNumber(UnaryExpression):
+    """bitmap_bucket_number(long): 1-based bucket (floorDiv by 32768 + 1
+    for positive inputs; Spark's GetBucketNumber)."""
+
+    def _resolve_type(self):
+        self._dataType = T.LONG
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        v = cols[0].data.astype(jnp.int64)
+        adj = jnp.where(v > 0, v - 1, v)
+        bucket = jnp.floor_divide(adj, jnp.int64(32768))
+        bucket = jnp.where(v > 0, bucket + 1, bucket)
+        return DeviceColumn(T.LONG, cols[0].validity, data=bucket)
+
+
+class BitmapCount(UnaryExpression):
+    """bitmap_count(binary): number of set bits in the blob.
+
+    Caveat (shared with every binary-as-string surface, e.g. UnBase64):
+    the engine's binary representation round-trips through utf-8-replace
+    at row boundaries, so blobs with bytes >= 0x80 lose bit fidelity when
+    they cross a host row boundary before reaching this expression; the
+    device-resident path counts the raw bytes."""
+
+    def _resolve_type(self):
+        self._dataType = T.LONG
+        self._nullable = self.child.nullable
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        if not c.width:
+            return DeviceColumn(T.LONG, c.validity,
+                                data=jnp.zeros(c.capacity, jnp.int64))
+        in_len = jnp.arange(c.width)[None, :] < c.lengths[:, None]
+        pop = _popcount_u8(c.chars)
+        total = jnp.sum(jnp.where(in_len, pop, 0), axis=1).astype(jnp.int64)
+        return DeviceColumn(T.LONG, c.validity, data=total)
+
+
+def _popcount_u8(b):
+    x = b.astype(jnp.int32)
+    x = x - ((x >> 1) & 0x55)
+    x = (x & 0x33) + ((x >> 2) & 0x33)
+    return (x + (x >> 4)) & 0x0F
+
+
+class Randn(UnaryExpression):
+    """randn([seed]): standard normal via Box-Muller over the same
+    splitmix stream Rand uses (not Spark's XORShiftRandom sequence —
+    documented incompatibility, like GpuRand)."""
+
+    def __init__(self, seed: Expression):
+        super().__init__(seed)
+
+    def _resolve_type(self):
+        self._dataType = T.DOUBLE
+        self._nullable = False
+
+    def do_columnar_eval(self, ctx, cols):
+        from spark_rapids_tpu.expr.base import Literal
+
+        seed = 0
+        if isinstance(self.child, Literal) and self.child.value is not None:
+            seed = int(self.child.value)
+        cap = ctx.batch.capacity
+        idx = jnp.arange(cap, dtype=jnp.uint64)
+        u1 = _splitmix_unit(idx, jnp.uint64(seed * 2654435769 + 1))
+        u2 = _splitmix_unit(idx, jnp.uint64(seed * 2654435769 + 2))
+        r = jnp.sqrt(-2.0 * jnp.log(jnp.maximum(u1, 1e-300)))
+        out = r * jnp.cos(2.0 * jnp.pi * u2)
+        return DeviceColumn(T.DOUBLE, jnp.ones(cap, jnp.bool_), data=out)
+
+
+def _splitmix_unit(idx, salt):
+    z = idx * jnp.uint64(0x9E3779B97F4A7C15) + salt
+    z = (z ^ (z >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> 31)
+    return (z >> 11).astype(jnp.float64) / float(1 << 53)
+
+
+class Sentences(Expression):
+    """sentences(str[, lang, country]) -> array<array<string>> of words
+    per sentence.
+
+    The output type needs a nested list-of-list-of-string device layout
+    that the padded columnar model does not carry; the expression is
+    registered with a permanent tag-time fallback (overrides.py
+    _check_sentences) and executes on the CPU oracle — the reference
+    likewise leaves Sentences on CPU (no GpuSentences rule)."""
+
+    def __init__(self, child, lang=None, country=None):
+        kids = [child]
+        if lang is not None:
+            kids.append(lang)
+        if country is not None:
+            kids.append(country)
+        super().__init__(kids)
+
+    def _resolve_type(self):
+        self._dataType = T.ArrayType(T.ArrayType(T.STRING))
+        self._nullable = True
+
+    def sql_string(self):
+        return f"sentences({self.children[0].sql_string()})"
+
+    def do_columnar_eval(self, ctx, cols):
+        raise NotImplementedError(
+            "Sentences always falls back to CPU (nested array<array> "
+            "layout); the tag rule prevents this path")
+
+
+def _parse_number_format(fmt: str):
+    """Validate a to_number/to_char format and derive (precision, scale,
+    grouping, currency, sign_mode).  Subset: 0/9 digits, ',' grouping,
+    '.' point, leading '$', 'S' (start/end), trailing 'MI'."""
+    f = fmt.upper()
+    sign = None
+    if f.startswith("S"):
+        sign, f = "S_START", f[1:]
+    elif f.endswith("S"):
+        sign, f = "S_END", f[:-1]
+    elif f.endswith("MI"):
+        sign, f = "MI", f[:-2]
+    currency = False
+    if f.startswith("$"):
+        currency, f = True, f[1:]
+    if "." in f:
+        ip, _, fp = f.partition(".")
+    else:
+        ip, fp = f, ""
+    if not all(c in "09," for c in ip) or not all(c in "09" for c in fp):
+        return None
+    int_digits = sum(1 for c in ip if c in "09")
+    scale = len(fp)
+    if int_digits + scale == 0 or int_digits + scale > 38:
+        return None
+    return {"precision": int_digits + scale, "scale": scale,
+            "grouping": "," in ip, "currency": currency, "sign": sign,
+            "int_digits": int_digits}
+
+
+class ToNumber(Expression):
+    """to_number(str, fmt) -> decimal; strict parse per the format.
+
+    Reference analog: GpuToNumber subset (sql-plugin stringFunctions).
+    Host kernel (format grammar is branchy row work; the batch stays
+    columnar around it)."""
+
+    is_host_kernel = True
+    _try = False
+
+    def __init__(self, child: Expression, fmt: Expression):
+        super().__init__([child, fmt])
+
+    def _resolve_type(self):
+        from spark_rapids_tpu.expr.base import Literal
+
+        self._spec = None
+        f = self.children[1]
+        if isinstance(f, Literal) and f.value is not None:
+            self._spec = _parse_number_format(str(f.value))
+        if self._spec:
+            self._dataType = T.DecimalType(self._spec["precision"],
+                                           self._spec["scale"])
+        else:
+            self._dataType = T.DecimalType(38, 0)
+        self._nullable = True
+
+    def sql_string(self):
+        name = "try_to_number" if self._try else "to_number"
+        return (f"{name}({self.children[0].sql_string()}, "
+                f"{self.children[1].sql_string()})")
+
+    def do_columnar_eval(self, ctx, cols):
+        import re as _re
+
+        c = cols[0]
+        cap = c.capacity
+        spec = self._spec
+        scale = spec["scale"]
+        pat = "^"
+        if spec["sign"] == "S_START":
+            pat += "([+-])?"
+        if spec["currency"]:
+            pat += r"\$"
+        pat += r"([0-9][0-9,]*)?" if spec["grouping"] else "([0-9]+)?"
+        if scale:
+            pat += r"(?:\.([0-9]{0,%d}))?" % scale
+        else:
+            pat += "()?"
+        if spec["sign"] == "S_END":
+            pat += "([+-])?"
+        elif spec["sign"] == "MI":
+            pat += "(-)?"
+        else:
+            pat += "()?"
+        pat += "$"
+        rx = _re.compile(pat)
+        int_digits = spec["int_digits"]
+        two_limb = self.dataType.is_128
+
+        def run(chars, lengths, validity):
+            chars = np.asarray(chars)
+            lengths = np.asarray(lengths)
+            validity = np.asarray(validity)
+            if two_limb:
+                out = np.zeros((cap, 2), np.int64)
+            else:
+                out = np.zeros(cap, np.int64)
+            ok = np.zeros(cap, np.bool_)
+            for i in range(cap):
+                if not validity[i]:
+                    continue
+                s = bytes(chars[i, :lengths[i]]).decode("utf-8", "replace")
+                m = rx.match(s.strip())
+                if not m:
+                    continue
+                g = m.groups()
+                sign_s = g[0] if len(g) > 2 and spec["sign"] == "S_START" \
+                    else (g[-1] or "")
+                ipart = (g[1] if spec["sign"] == "S_START" else g[0]) or ""
+                fpart = (g[2] if spec["sign"] == "S_START" else g[1]) or ""
+                digits = ipart.replace(",", "")
+                if not digits and not fpart:
+                    continue
+                if len(digits.lstrip("0") or "0") > int_digits \
+                        and len(digits.lstrip("0")) > int_digits:
+                    continue
+                unscaled = int((digits or "0")
+                               + (fpart or "").ljust(scale, "0"))
+                if sign_s == "-":
+                    unscaled = -unscaled
+                if two_limb:
+                    out[i, 0] = unscaled >> 64 if unscaled >= 0 \
+                        else ~((~unscaled) >> 64)
+                    out[i, 1] = np.uint64(
+                        unscaled & ((1 << 64) - 1)).astype(np.int64)
+                else:
+                    out[i] = unscaled
+                ok[i] = True
+            return out, ok
+
+        shape = ((cap, 2) if two_limb else (cap,))
+        o, ok = call_host_kernel(
+            run, (jax.ShapeDtypeStruct(shape, np.int64),
+                  jax.ShapeDtypeStruct((cap,), np.bool_)),
+            c.chars, c.lengths, c.validity)
+        if not self._try:
+            ctx.add_error(c.validity & ~ok,
+                          "to_number: input does not match the format")
+        return DeviceColumn(self.dataType, ok, data=o)
+
+
+class TryToNumber(ToNumber):
+    _try = True
+
+
+class ToCharacter(Expression):
+    """to_char(numeric, fmt) -> string (same format subset as ToNumber)."""
+
+    is_host_kernel = True
+
+    def __init__(self, child: Expression, fmt: Expression):
+        super().__init__([child, fmt])
+
+    def _resolve_type(self):
+        from spark_rapids_tpu.expr.base import Literal
+
+        self._spec = None
+        f = self.children[1]
+        if isinstance(f, Literal) and f.value is not None:
+            self._spec = _parse_number_format(str(f.value))
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def sql_string(self):
+        return (f"to_char({self.children[0].sql_string()}, "
+                f"{self.children[1].sql_string()})")
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        cap = c.capacity
+        spec = self._spec
+        in_dt = self.children[0].dataType
+        in_scale = in_dt.scale if isinstance(in_dt, T.DecimalType) else 0
+        scale = spec["scale"]
+        width = spec["precision"] + 8
+        two_limb = isinstance(in_dt, T.DecimalType) and in_dt.is_128
+
+        def run(data, validity):
+            import decimal
+            from decimal import Decimal as D
+
+            data = np.asarray(data)
+            validity = np.asarray(validity)
+            out_chars = np.zeros((cap, width), np.uint8)
+            out_lens = np.zeros(cap, np.int32)
+            ok = np.zeros(cap, np.bool_)
+            for i in range(cap):
+                if not validity[i]:
+                    continue
+                if two_limb:
+                    unscaled = (int(data[i, 0]) << 64) | int(
+                        np.uint64(data[i, 1]))
+                else:
+                    unscaled = int(data[i])
+                with decimal.localcontext() as dctx:
+                    dctx.prec = 60      # 38-digit decimals need headroom
+                    v = D(unscaled).scaleb(-in_scale)
+                    q = v.quantize(D(1).scaleb(-scale)) if scale else \
+                        v.quantize(D(1))
+                neg = q < 0
+                digits = format(abs(q), "f")
+                if "." in digits:
+                    ipart, _, fpart = digits.partition(".")
+                else:
+                    ipart, fpart = digits, ""
+                if len(ipart.lstrip("0") or "") > spec["int_digits"]:
+                    s = "#" * (spec["precision"] + (1 if scale else 0))
+                else:
+                    if spec["grouping"]:
+                        rev = ipart[::-1]
+                        ipart = ",".join(rev[j:j + 3]
+                                         for j in range(0, len(rev),
+                                                        3))[::-1]
+                    s = ipart + (("." + fpart.ljust(scale, "0"))
+                                 if scale else "")
+                    if spec["currency"]:
+                        s = "$" + s
+                    if spec["sign"] == "S_START":
+                        s = ("-" if neg else "+") + s
+                    elif spec["sign"] == "S_END":
+                        s = s + ("-" if neg else "+")
+                    elif spec["sign"] == "MI":
+                        s = s + ("-" if neg else " ")
+                    elif neg:
+                        s = "-" + s
+                b = s.encode("ascii")[:width]
+                out_chars[i, :len(b)] = np.frombuffer(b, np.uint8)
+                out_lens[i] = len(b)
+                ok[i] = True
+            return out_chars, out_lens, ok
+
+        och, oln, ok = call_host_kernel(
+            run, (jax.ShapeDtypeStruct((cap, width), np.uint8),
+                  jax.ShapeDtypeStruct((cap,), np.int32),
+                  jax.ShapeDtypeStruct((cap,), np.bool_)),
+            c.data, c.validity)
+        return DeviceColumn(T.STRING, c.validity & ok, chars=och,
+                            lengths=oln)
+
+
+CURRENT_INPUT_FILE = [""]    # set by the scan exec at batch-yield time
+
+
+class InputFileName(Expression):
+    """input_file_name(): path of the file the current batch was scanned
+    from; empty string outside a file scan (Spark semantics, backed by
+    the InputFileBlockHolder analog ``CURRENT_INPUT_FILE``).
+
+    Marked as a host kernel so the enclosing stage runs EAGERLY: under a
+    jit trace the path would bake into the cached program as a constant
+    and go stale on the next file; eager evaluation reads the holder at
+    batch-processing time (pull execution makes that the right file)."""
+
+    is_host_kernel = True
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = False
+
+    def sql_string(self):
+        return "input_file_name()"
+
+    def do_columnar_eval(self, ctx, cols):
+        cap = ctx.batch.capacity
+        path = getattr(ctx.batch, "input_file", None)
+        if path is None:
+            path = CURRENT_INPUT_FILE[0]
+        b = path.encode("utf-8")
+        w = max(len(b), 1)
+        chars = jnp.broadcast_to(
+            jnp.asarray(np.frombuffer(b.ljust(w, b"\0"), np.uint8)),
+            (cap, w))
+        lengths = jnp.full(cap, len(b), jnp.int32)
+        return DeviceColumn(T.STRING, jnp.ones(cap, jnp.bool_),
+                            chars=chars, lengths=lengths)

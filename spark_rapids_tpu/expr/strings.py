@@ -1714,3 +1714,54 @@ class SplitPart(Expression):
                                             0).astype(jnp.uint8),
                             lengths=jnp.where(ok_range, out_len,
                                               0).astype(jnp.int32))
+
+
+class Luhn(UnaryExpression):
+    """luhn_check(s): Luhn mod-10 checksum validity of a digit string.
+
+    Reference analog: GpuLuhnCheck (sql-plugin stringFunctions; SURVEY.md
+    §2.5 Strings).  False for empty strings or any non-digit byte."""
+
+    def _resolve_type(self):
+        self._dataType = T.BOOLEAN
+        self._nullable = self.child.nullable
+
+    def sql_string(self):
+        return f"luhn_check({self.child.sql_string()})"
+
+    def do_columnar_eval(self, ctx, cols):
+        s = cols[0]
+        cap = s.capacity
+        if not s.width:
+            return DeviceColumn(T.BOOLEAN, s.validity,
+                                data=jnp.zeros(cap, jnp.bool_))
+        ch = s.chars.astype(jnp.int32)
+        w = s.width
+        in_str = jnp.arange(w)[None, :] < s.lengths[:, None]
+        digit = (ch >= 0x30) & (ch <= 0x39)
+        all_digits = jnp.all(digit | ~in_str, axis=1) & (s.lengths > 0)
+        d = jnp.where(in_str & digit, ch - 0x30, 0)
+        # position from the right (rightmost = 0); double odd positions
+        pos_r = s.lengths[:, None] - 1 - jnp.arange(w)[None, :]
+        dbl = (pos_r % 2) == 1
+        dd = jnp.where(dbl, d * 2, d)
+        dd = jnp.where(dd > 9, dd - 9, dd)
+        total = jnp.sum(jnp.where(in_str, dd, 0), axis=1)
+        ok = all_digits & (total % 10 == 0)
+        return DeviceColumn(T.BOOLEAN, s.validity, data=ok)
+
+
+class Empty2Null(UnaryExpression):
+    """empty string -> NULL (Spark inserts this above Hive text writes)."""
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = True
+
+    def sql_string(self):
+        return f"empty2null({self.child.sql_string()})"
+
+    def do_columnar_eval(self, ctx, cols):
+        c = cols[0]
+        return DeviceColumn(T.STRING, c.validity & (c.lengths > 0),
+                            chars=c.chars, lengths=c.lengths)

@@ -1,0 +1,222 @@
+"""from_xml / to_xml — per-row XML record codecs (Spark 4.0 surface).
+
+Reference analog: the reference accelerates from_json/to_json via cuDF's
+JSON device parser and leaves XML to CPU connectors; here both row codecs
+ride the same host-kernel tier as JsonToStructs (one pure_callback per
+batch), with flat primitive/string structs — the tag check restricts.
+
+from_xml is PERMISSIVE: a malformed document yields an all-NULL row.
+to_xml emits ``<row><field>value</field>...</row>`` with null fields
+omitted, matching Spark's writer defaults.
+"""
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.expr.base import (EvalContext, Expression,
+                                        UnaryExpression, call_host_kernel)
+from spark_rapids_tpu.expr.jsonexprs import convert_json_field
+
+
+class XmlToStructs(UnaryExpression):
+    """from_xml(xml, schema) for flat structs (child elements by name)."""
+
+    is_host_kernel = True
+
+    def __init__(self, child: Expression, schema: T.StructType):
+        super().__init__(child)
+        self.schema = schema
+
+    def _resolve_type(self):
+        self._dataType = self.schema
+        self._nullable = True
+
+    def sql_string(self):
+        return f"from_xml({self.child.sql_string()})"
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        c = cols[0]
+        cap, w = c.capacity, max(c.width, 1)
+        fields = self.schema.fields
+
+        def fn(chars, lengths, validity):
+            chars = np.asarray(chars)
+            lengths = np.asarray(lengths)
+            validity = np.asarray(validity)
+            records: List[Optional[list]] = []
+            for i in range(cap):
+                if not validity[i]:
+                    records.append(None)
+                    continue
+                raw = bytes(chars[i, :lengths[i]])
+                vals: Optional[list] = []
+                try:
+                    root = ET.fromstring(raw.decode("utf-8", "replace"))
+                except ET.ParseError:
+                    root = None
+                if root is None:
+                    vals = [None] * len(fields)
+                else:
+                    for f in fields:
+                        el = root.find(f.name)
+                        txt = None if el is None else (el.text or "")
+                        if txt is None:
+                            vals.append(None)
+                            continue
+                        sv = txt
+                        if not isinstance(f.dataType, T.StringType):
+                            try:
+                                if isinstance(f.dataType, T.BooleanType):
+                                    sv = txt.strip().lower() == "true"
+                                elif isinstance(f.dataType,
+                                                (T.FloatType,
+                                                 T.DoubleType)):
+                                    sv = float(txt)
+                                else:
+                                    sv = int(txt.strip())
+                            except ValueError:
+                                vals = [None] * len(fields)
+                                break
+                        ok, sv = convert_json_field(sv, f.dataType)
+                        if not ok:
+                            vals = [None] * len(fields)
+                            break
+                        vals.append(sv)
+                records.append(vals)
+            outs = []
+            for k, f in enumerate(fields):
+                col_vals = [r[k] if r is not None else None
+                            for r in records]
+                fvalid = np.array([v is not None for v in col_vals],
+                                  np.bool_)
+                if isinstance(f.dataType, T.StringType):
+                    fchars = np.zeros((cap, w), np.uint8)
+                    flens = np.zeros(cap, np.int32)
+                    for i, v in enumerate(col_vals):
+                        if v is None:
+                            continue
+                        b = v.encode("utf-8")[:w]
+                        fchars[i, :len(b)] = np.frombuffer(b, np.uint8)
+                        flens[i] = len(b)
+                    outs += [fchars, flens, fvalid]
+                else:
+                    data = np.zeros(cap, T.storage_dtype(f.dataType))
+                    for i, v in enumerate(col_vals):
+                        if v is not None:
+                            data[i] = v
+                    outs += [data, fvalid]
+            outs.append(validity.copy())
+            return tuple(outs)
+
+        shapes = []
+        for f in fields:
+            if isinstance(f.dataType, T.StringType):
+                shapes += [jax.ShapeDtypeStruct((cap, w), np.uint8),
+                           jax.ShapeDtypeStruct((cap,), np.int32),
+                           jax.ShapeDtypeStruct((cap,), np.bool_)]
+            else:
+                shapes += [jax.ShapeDtypeStruct(
+                    (cap,), T.storage_dtype(f.dataType)),
+                    jax.ShapeDtypeStruct((cap,), np.bool_)]
+        shapes.append(jax.ShapeDtypeStruct((cap,), np.bool_))
+        flat = call_host_kernel(fn, tuple(shapes), c.chars, c.lengths,
+                                c.validity)
+        kids = []
+        pos = 0
+        for f in fields:
+            if isinstance(f.dataType, T.StringType):
+                kids.append(DeviceColumn(T.STRING, flat[pos + 2],
+                                         chars=flat[pos],
+                                         lengths=flat[pos + 1]))
+                pos += 3
+            else:
+                kids.append(DeviceColumn(f.dataType, flat[pos + 1],
+                                         data=flat[pos]))
+                pos += 2
+        return DeviceColumn(self.schema, flat[pos], children=tuple(kids))
+
+
+def _xml_escape(s: str) -> str:
+    return (s.replace("&", "&amp;").replace("<", "&lt;")
+            .replace(">", "&gt;"))
+
+
+class StructsToXml(UnaryExpression):
+    """to_xml(struct) -> one <row>...</row> document per row."""
+
+    is_host_kernel = True
+
+    def _resolve_type(self):
+        self._dataType = T.STRING
+        self._nullable = self.child.nullable
+
+    def sql_string(self):
+        return f"to_xml({self.child.sql_string()})"
+
+    def do_columnar_eval(self, ctx: EvalContext, cols):
+        c = cols[0]
+        cap = c.capacity
+        st: T.StructType = self.child.dataType
+        width = 16
+        for f, kid in zip(st.fields, c.children):
+            width += len(f.name) * 2 + 5 + (
+                kid.chars.shape[1] * 5 if kid.chars is not None else 24)
+
+        flat = [c.validity]
+        layout = []
+        for kid in c.children:
+            flat.append(kid.validity)
+            if kid.data is not None and kid.chars is None:
+                flat.append(kid.data)
+                layout.append(("flat", 2))
+            else:
+                flat.append(kid.chars)
+                flat.append(kid.lengths)
+                layout.append(("str", 3))
+
+        def fn(*arrs):
+            arrs = [np.asarray(a) for a in arrs]
+            validity = arrs[0]
+            parts = []
+            pos = 1
+            for kind, cnt in layout:
+                parts.append((kind, arrs[pos:pos + cnt]))
+                pos += cnt
+            out_chars = np.zeros((cap, width), np.uint8)
+            out_lens = np.zeros(cap, np.int32)
+            for i in range(cap):
+                if not validity[i]:
+                    continue
+                body = []
+                for (kind, ps), f in zip(parts, st.fields):
+                    if not ps[0][i]:
+                        continue
+                    if kind == "str":
+                        v = _xml_escape(bytes(
+                            ps[1][i, :ps[2][i]]).decode("utf-8", "replace"))
+                    else:
+                        raw = ps[1][i]
+                        if isinstance(f.dataType, T.BooleanType):
+                            v = "true" if raw else "false"
+                        elif isinstance(f.dataType,
+                                        (T.FloatType, T.DoubleType)):
+                            v = repr(float(raw))
+                        else:
+                            v = str(int(raw))
+                    body.append(f"<{f.name}>{v}</{f.name}>")
+                s = "<row>" + "".join(body) + "</row>"
+                b = s.encode("utf-8")[:width]
+                out_chars[i, :len(b)] = np.frombuffer(b, np.uint8)
+                out_lens[i] = len(b)
+            return out_chars, out_lens
+
+        och, oln = call_host_kernel(
+            fn, (jax.ShapeDtypeStruct((cap, width), np.uint8),
+                 jax.ShapeDtypeStruct((cap,), np.int32)), *flat)
+        return DeviceColumn(T.STRING, c.validity, chars=och, lengths=oln)

@@ -189,16 +189,28 @@ class TpuFileSourceScanExec(TpuExec):
             return ColumnarBatch.from_host_columns(cols, names)
 
     # -- modes ----------------------------------------------------------
+    @staticmethod
+    def _stamp(batch: ColumnarBatch, path: str) -> ColumnarBatch:
+        """Record the source file on the batch and in the process-wide
+        holder (InputFileName reads them — Spark's InputFileBlockHolder
+        analog; pull execution processes each batch before the next
+        yield, so the holder tracks the right file)."""
+        from spark_rapids_tpu.expr.misc import CURRENT_INPUT_FILE
+
+        batch.input_file = path
+        CURRENT_INPUT_FILE[0] = path
+        return batch
+
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
         mode = self._mode()
         if mode == "PERFILE":
             for p in self.plan.paths:
                 dev = self._try_device_decode(p)
                 if dev is not None:
-                    yield self._count_output(dev)
+                    yield self._stamp(self._count_output(dev), p)
                 else:
-                    yield self._count_output(
-                        self._upload(self._read_file_host(p)))
+                    yield self._stamp(self._count_output(
+                        self._upload(self._read_file_host(p))), p)
         elif mode == "COALESCING":
             import pyarrow as pa
 
@@ -206,15 +218,17 @@ class TpuFileSourceScanExec(TpuExec):
             for p in self.plan.paths:
                 dev = self._try_device_decode(p)
                 if dev is not None:
-                    yield self._count_output(dev)
+                    yield self._stamp(self._count_output(dev), p)
                 else:
                     host_paths.append(p)
             tbls = [self._read_file_host(p) for p in host_paths]
             if not tbls:
                 return
             tbl = pa.concat_tables(tbls)
+            one = host_paths[0] if len(host_paths) == 1 else ""
             for chunk in self._row_chunks(tbl):
-                yield self._count_output(self._upload(chunk))
+                yield self._stamp(
+                    self._count_output(self._upload(chunk)), one)
         else:  # MULTITHREADED
             with cf.ThreadPoolExecutor(self.num_threads) as pool:
                 # device decode is a single-threaded device pipeline; host
@@ -223,14 +237,15 @@ class TpuFileSourceScanExec(TpuExec):
                 for p in self.plan.paths:
                     dev = self._try_device_decode(p)
                     if dev is not None:
-                        yield self._count_output(dev)
+                        yield self._stamp(self._count_output(dev), p)
                     else:
                         host_futs.append(
                             (p, pool.submit(self._read_file_host, p)))
                 for p, fut in host_futs:
                     tbl = fut.result()
                     for chunk in self._row_chunks(tbl):
-                        yield self._count_output(self._upload(chunk))
+                        yield self._stamp(self._count_output(
+                            self._upload(chunk)), p)
 
     def _row_chunks(self, tbl):
         n = tbl.num_rows

@@ -1,0 +1,105 @@
+"""MXU-backed small-table gather: one_hot(idx, D) @ table.
+
+Reference analog: cuDF's gather kernels scatter through HBM with native
+random-access bandwidth; on TPU a random gather of N elements runs on the
+VPU at ~1/20 of sequential bandwidth (~300ms for 20M rows even from a
+VMEM-resident table — round-5 calibration), while the MXU contracts a
+fused one_hot×table product in single-digit milliseconds.  For D-row
+build tables (broadcast joins, dictionary decode) with D up to a few
+thousand, XLA fuses the one-hot into the dot so the (N, D) selector is
+never materialized.
+
+Exactness: every output row selects exactly ONE table row (one-hot), so
+each f32 dot term is a single product with no accumulation — exact as
+long as each operand fits f32's 24-bit mantissa.  64-bit payloads are
+split into 13-bit limbs of their (unsigned) bit pattern and recombined
+with integer shifts, making the gather bit-exact for every flat dtype.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+
+# 13-bit limbs: one-hot rows have a single 1, so a dot term is a single
+# f32 product of 1.0 * limb (< 2^13) — exact with margin
+_LIMB_BITS = 13
+_LIMB_MASK = (1 << _LIMB_BITS) - 1
+
+MAX_TABLE_ROWS = 8192      # beyond this the one-hot contraction's N*D
+#                            FLOPs stop being free; callers check
+
+
+def _limbs_of(table: jax.Array) -> jax.Array:
+    """(D,) integer/bool array -> (D, L) f32 limb matrix (bit pattern)."""
+    if table.dtype == jnp.bool_:
+        u = table.astype(jnp.uint32)
+        nbits = 1
+    else:
+        nbits = table.dtype.itemsize * 8
+        u = table.view(jnp.uint32 if nbits <= 32 else jnp.uint64)
+        if nbits < 32:
+            u = table.astype(jnp.int32).view(jnp.uint32) \
+                & jnp.uint32((1 << nbits) - 1)
+    nl = -(-nbits // _LIMB_BITS)
+    limbs = [((u >> (i * _LIMB_BITS)) & _LIMB_MASK).astype(jnp.float32)
+             for i in range(nl)]
+    return jnp.stack(limbs, axis=1)
+
+
+def _recombine(out_f: jax.Array, dtype) -> jax.Array:
+    """(N, L) f32 limb matrix -> (N,) array of dtype (bit pattern)."""
+    if dtype == jnp.bool_:
+        return out_f[:, 0] > 0.5
+    nbits = jnp.dtype(dtype).itemsize * 8
+    wide = jnp.uint32 if nbits <= 32 else jnp.uint64
+    acc = jnp.zeros(out_f.shape[0], wide)
+    for i in range(out_f.shape[1]):
+        acc = acc | (out_f[:, i].astype(wide) << (i * _LIMB_BITS))
+    if nbits < 32:
+        # sign-extend sub-word types through int32
+        acc32 = acc.astype(jnp.uint32)
+        shifted = (acc32 << (32 - nbits)).view(jnp.int32) >> (32 - nbits)
+        return shifted.astype(dtype)
+    return acc.view(jnp.int32 if nbits == 32 else jnp.int64).astype(dtype) \
+        if not jnp.issubdtype(dtype, jnp.floating) \
+        else acc.view(dtype)
+
+
+def mxu_gather(table: jax.Array, idx: jax.Array) -> jax.Array:
+    """table[(idx,)] via the MXU; bit-exact for every flat dtype."""
+    d = table.shape[0]
+    oh = jax.nn.one_hot(idx, d, dtype=jnp.float32)
+    if table.ndim == 2 and table.dtype == jnp.uint8:
+        # char matrix: each byte column is its own (<256) exact limb
+        out = oh @ table.astype(jnp.float32)
+        return jnp.round(out).astype(jnp.uint8)
+    limbs = _limbs_of(table)
+    out_f = oh @ limbs
+    return _recombine(jnp.round(out_f), table.dtype)
+
+
+def mxu_gather_col(c: DeviceColumn, idx: jax.Array):
+    """DeviceColumn gather via the MXU, or None when the layout is not
+    eligible (nested/array columns keep the VPU gather)."""
+    if c.children is not None or c.elem_valid is not None:
+        return None
+    validity = mxu_gather(c.validity, idx)
+    if c.chars is not None and c.chars.ndim == 2:
+        chars = mxu_gather(c.chars, idx)
+        lengths = mxu_gather(c.lengths, idx)
+        return DeviceColumn(c.dtype, validity, chars=chars,
+                            lengths=lengths)
+    if c.data is None:
+        return None
+    if c.data.ndim == 1:
+        return DeviceColumn(c.dtype, validity, data=mxu_gather(c.data, idx))
+    if c.data.ndim == 2 and c.data.shape[1] == 2:      # decimal128
+        hi = mxu_gather(c.data[:, 0], idx)
+        lo = mxu_gather(c.data[:, 1], idx)
+        return DeviceColumn(c.dtype, validity,
+                            data=jnp.stack([hi, lo], axis=1))
+    return None

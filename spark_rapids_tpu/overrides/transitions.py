@@ -59,19 +59,113 @@ class TpuMaterializedScan(SparkPlan):
 def _mesh_stage_on(conf: TpuConf, switch) -> bool:
     """The shared 4-condition guard of every ICI stage rewrite: mesh mode
     on, the per-stage kill switch on, shuffle mode ICI, >1 device."""
+    return _mesh_stage_reason(conf, switch) is None
+
+
+def _mesh_stage_reason(conf: TpuConf, switch):
+    """None when the mesh stage may install; otherwise the fallback reason
+    (which of the 4 guard conditions failed), for explain parity."""
     import jax
 
     from spark_rapids_tpu.config import MESH_ENABLED, SHUFFLE_MODE
 
-    return (conf.get(MESH_ENABLED)
-            and conf.get(switch)
-            and str(conf.get(SHUFFLE_MODE)).upper() == "ICI"
-            and len(jax.devices()) > 1)
+    if not conf.get(MESH_ENABLED):
+        return f"{MESH_ENABLED.key} is false"
+    if not conf.get(switch):
+        return f"{switch.key} is false"
+    if str(conf.get(SHUFFLE_MODE)).upper() != "ICI":
+        return (f"{SHUFFLE_MODE.key}={conf.get(SHUFFLE_MODE)} "
+                "(mesh stages need ICI)")
+    if len(jax.devices()) <= 1:
+        return "single device (no mesh to distribute over)"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Stage rules: the taggable registry of transition-installed execs
+# (VERDICT r4 Next #8).  The reference registers every exec in
+# GpuOverrides.execs with per-exec explain/fallback; the collective (ICI)
+# and fused stages here are installed by plan REWRITE rather than node
+# conversion, so they get their own registry + per-apply decision ledger
+# that the explain output and docs generator read.
+# ---------------------------------------------------------------------------
+
+import dataclasses as _dc
+import threading as _threading
+
+
+@_dc.dataclass(frozen=True)
+class StageRule:
+    name: str           # installed exec class name
+    conf_key: str       # kill-switch conf
+    desc: str           # what the stage collapses / replaces
+
+
+def _stage_rules():
+    from spark_rapids_tpu import config as C
+
+    return {r.name: r for r in [
+        StageRule("TpuIciShuffleAggExec", C.MESH_AGG_ENABLED.key,
+                  "Final<-Exchange<-Partial aggregate as one SPMD "
+                  "collective program (all-to-all over ICI)"),
+        StageRule("TpuIciShuffleJoinExec", C.MESH_JOIN_ENABLED.key,
+                  "shuffled equi-join as mesh all-to-all both sides + "
+                  "per-device sorted probe"),
+        StageRule("TpuIciSortExec", C.MESH_SORT_ENABLED.key,
+                  "global sort as sampled range exchange + per-device "
+                  "sort + ordered emit"),
+        StageRule("TpuIciWindowExec", C.MESH_WINDOW_ENABLED.key,
+                  "partitioned window as hash all-to-all on PARTITION BY "
+                  "+ per-device window"),
+        StageRule("TpuIciRepartitionExec", C.MESH_REPARTITION_ENABLED.key,
+                  "remaining hash/round-robin exchanges as the generic "
+                  "mesh all-to-all"),
+        StageRule("TpuJoinAggFusedExec", C.JOIN_AGG_FUSION.key,
+                  "aggregate over unconditioned INNER/LEFT broadcast "
+                  "equi-join fused into one program"),
+        StageRule("TpuWindowChainFusedExec", C.WINDOW_CHAIN_FUSION.key,
+                  "window over complete-agg (and trailing stage ops) "
+                  "fused into one program"),
+        StageRule("TpuAdaptiveShuffleReaderExec",
+                  C.ADAPTIVE_ENABLED.key,
+                  "stats-driven shuffle-read partition coalescing "
+                  "(GpuCustomShuffleReaderExec analog)"),
+    ]}
+
+
+STAGE_RULES = None      # populated lazily (config import cycle)
+
+
+def stage_rules():
+    global STAGE_RULES
+    if STAGE_RULES is None:
+        STAGE_RULES = _stage_rules()
+    return STAGE_RULES
+
+
+_STAGE_LOG = _threading.local()
+
+
+def _stage_log_reset() -> None:
+    _STAGE_LOG.entries = []
+
+
+def stage_decisions():
+    """[(exec_name, installed: bool, reason: Optional[str])] for the most
+    recent TpuTransitionOverrides.apply on this thread."""
+    return list(getattr(_STAGE_LOG, "entries", []))
+
+
+def _record(name: str, installed: bool, reason=None) -> None:
+    entries = getattr(_STAGE_LOG, "entries", None)
+    if entries is not None:
+        entries.append((name, installed, reason))
 
 
 class TpuTransitionOverrides:
     @staticmethod
     def apply(root: TpuExec, conf: TpuConf) -> TpuExec:
+        _stage_log_reset()
         root = TpuTransitionOverrides._coalesce_single_device_shuffle(
             root, conf)
         root = TpuTransitionOverrides._insert_coalesce(root, conf)
@@ -158,8 +252,6 @@ class TpuTransitionOverrides:
         node.children = [
             TpuTransitionOverrides._fuse_join_agg(c, conf)
             if isinstance(c, TpuExec) else c for c in node.children]
-        if not conf.get(JOIN_AGG_FUSION):
-            return node
         if not (isinstance(node, TpuHashAggregateExec)
                 and node.mode in (AggregateMode.COMPLETE,
                                   AggregateMode.PARTIAL)
@@ -171,6 +263,11 @@ class TpuTransitionOverrides:
                 and join.join_type in (JoinType.INNER, JoinType.LEFT_OUTER)
                 and join.left_keys):
             return node
+        if not conf.get(JOIN_AGG_FUSION):
+            _record("TpuJoinAggFusedExec", False,
+                    f"{JOIN_AGG_FUSION.key} is false")
+            return node
+        _record("TpuJoinAggFusedExec", True)
         # the agg keeps the join as its child (used by the oversized-build
         # fallback); the fused exec replaces it in the surrounding tree
         return TpuJoinAggFusedExec(node, join)
@@ -193,27 +290,31 @@ class TpuTransitionOverrides:
         mesh_claims = _mesh_stage_on(conf, MESH_WINDOW_ENABLED)
         # match TOP-DOWN so the longest chain (stage+window+agg) wins over
         # the inner window+agg pair, then recurse into the result
-        if conf.get(WINDOW_CHAIN_FUSION):
-            post_ops, post_schema = None, None
-            window = node
-            if isinstance(node, TpuStageExec) and not node.ansi \
-                    and not node._has_host_kernels() \
-                    and isinstance(node.children[0], TpuWindowExec):
-                window = node.children[0]
-                post_ops, post_schema = node.ops, node.output
-            if (isinstance(window, TpuWindowExec) and not window.ansi
-                    # partitioned windows belong to the ICI window rewrite
-                    # in mesh mode; partition-less ones still fuse
-                    and not (mesh_claims and window.partition_by)):
-                pre_agg = None
-                child = window.children[0]
-                if (isinstance(child, TpuHashAggregateExec)
-                        and child.mode == AggregateMode.COMPLETE
-                        and not child._has_collect and not child.ansi):
-                    pre_agg = child
-                if pre_agg is not None or post_ops is not None:
+        post_ops, post_schema = None, None
+        window = node
+        if isinstance(node, TpuStageExec) and not node.ansi \
+                and not node._has_host_kernels() \
+                and isinstance(node.children[0], TpuWindowExec):
+            window = node.children[0]
+            post_ops, post_schema = node.ops, node.output
+        if (isinstance(window, TpuWindowExec) and not window.ansi
+                # partitioned windows belong to the ICI window rewrite
+                # in mesh mode; partition-less ones still fuse
+                and not (mesh_claims and window.partition_by)):
+            pre_agg = None
+            child = window.children[0]
+            if (isinstance(child, TpuHashAggregateExec)
+                    and child.mode == AggregateMode.COMPLETE
+                    and not child._has_collect and not child.ansi):
+                pre_agg = child
+            if pre_agg is not None or post_ops is not None:
+                if conf.get(WINDOW_CHAIN_FUSION):
+                    _record("TpuWindowChainFusedExec", True)
                     node = TpuWindowChainFusedExec(window, pre_agg,
                                                    post_ops, post_schema)
+                else:
+                    _record("TpuWindowChainFusedExec", False,
+                            f"{WINDOW_CHAIN_FUSION.key} is false")
         node.children = [
             TpuTransitionOverrides._fuse_window_chain(c, conf)
             if isinstance(c, TpuExec) else c for c in node.children]
@@ -235,13 +336,16 @@ class TpuTransitionOverrides:
         node.children = [
             TpuTransitionOverrides._rewrite_ici_sort(c, conf)
             if isinstance(c, TpuExec) else c for c in node.children]
-        if not _mesh_stage_on(conf, MESH_SORT_ENABLED):
-            return node
         if not (isinstance(node, TpuSortExec) and node.is_global):
+            return node
+        reason = _mesh_stage_reason(conf, MESH_SORT_ENABLED)
+        if reason is not None:
+            _record("TpuIciSortExec", False, reason)
             return node
         from spark_rapids_tpu.config import MESH_DEVICES as _MD
         from spark_rapids_tpu.parallel.mesh import make_mesh
 
+        _record("TpuIciSortExec", True)
         return TpuIciSortExec(node, make_mesh(conf.get(_MD) or None),
                               epoch_bytes=conf.get(MESH_EPOCH_BYTES))
 
@@ -263,8 +367,6 @@ class TpuTransitionOverrides:
         node.children = [
             TpuTransitionOverrides._rewrite_ici_agg(c, conf)
             if isinstance(c, TpuExec) else c for c in node.children]
-        if not _mesh_stage_on(conf, MESH_AGG_ENABLED):
-            return node
         if not (isinstance(node, TpuHashAggregateExec)
                 and node.mode == AggregateMode.FINAL):
             return node
@@ -282,9 +384,14 @@ class TpuTransitionOverrides:
         if not (isinstance(partial, TpuHashAggregateExec)
                 and partial.mode == AggregateMode.PARTIAL):
             return node
+        reason = _mesh_stage_reason(conf, MESH_AGG_ENABLED)
+        if reason is not None:
+            _record("TpuIciShuffleAggExec", False, reason)
+            return node
         from spark_rapids_tpu.config import MESH_DEVICES, MESH_EPOCH_BYTES
         from spark_rapids_tpu.parallel.mesh import make_mesh
 
+        _record("TpuIciShuffleAggExec", True)
         return TpuIciShuffleAggExec(
             partial, node, make_mesh(conf.get(MESH_DEVICES) or None),
             epoch_bytes=conf.get(MESH_EPOCH_BYTES))
@@ -310,8 +417,6 @@ class TpuTransitionOverrides:
         node.children = [
             TpuTransitionOverrides._rewrite_ici_join(c, conf)
             if isinstance(c, TpuExec) else c for c in node.children]
-        if not _mesh_stage_on(conf, MESH_JOIN_ENABLED):
-            return node
         join = node
         if isinstance(join, TpuAdaptiveJoinExec):
             # the collective plan replaces the AQE wrapper: a mesh
@@ -319,22 +424,31 @@ class TpuTransitionOverrides:
             join = join.shuffled
         if not isinstance(join, TpuShuffledSymmetricHashJoinExec):
             return node
-        if join.join_type not in (
+        reason = _mesh_stage_reason(conf, MESH_JOIN_ENABLED)
+        if reason is None and join.join_type not in (
                 JoinType.INNER, JoinType.LEFT_OUTER, JoinType.LEFT_SEMI,
                 JoinType.LEFT_ANTI, JoinType.RIGHT_OUTER,
                 JoinType.FULL_OUTER):
-            return node
-        if join.condition is not None and join.join_type != JoinType.INNER:
+            reason = (f"join type {join.join_type.value} has no mesh "
+                      "materialization")
+        if reason is None and join.condition is not None \
+                and join.join_type != JoinType.INNER:
             # non-inner residual conditions are tag-time fallbacks anyway
-            return node
-        if not all(isinstance(c, TpuShuffleExchangeExec)
-                   for c in join.children):
+            reason = ("residual join condition is only supported for "
+                      "INNER mesh joins")
+        if reason is None and not all(
+                isinstance(c, TpuShuffleExchangeExec)
+                for c in join.children):
+            reason = "join inputs are not both shuffle exchanges"
+        if reason is not None:
+            _record("TpuIciShuffleJoinExec", False, reason)
             return node
         from spark_rapids_tpu.config import MESH_DEVICES
         from spark_rapids_tpu.parallel.mesh import make_mesh
 
         from spark_rapids_tpu.config import MESH_EPOCH_BYTES as _MEB
 
+        _record("TpuIciShuffleJoinExec", True)
         return TpuIciShuffleJoinExec(
             join, join.children[0].children[0],
             join.children[1].children[0],
@@ -360,13 +474,19 @@ class TpuTransitionOverrides:
         node.children = [
             TpuTransitionOverrides._rewrite_ici_window(c, conf)
             if isinstance(c, TpuExec) else c for c in node.children]
-        if not _mesh_stage_on(conf, MESH_WINDOW_ENABLED):
+        if not (isinstance(node, TpuWindowExec) and node.partition_by):
             return node
-        if not (isinstance(node, TpuWindowExec) and node.partition_by
-                and mesh_exchange_schema_supported(node.children[0].output)):
+        reason = _mesh_stage_reason(conf, MESH_WINDOW_ENABLED)
+        if reason is None and not mesh_exchange_schema_supported(
+                node.children[0].output):
+            reason = ("input schema has nested/unsupported columns for "
+                      "the mesh exchange")
+        if reason is not None:
+            _record("TpuIciWindowExec", False, reason)
             return node
         from spark_rapids_tpu.parallel.mesh import make_mesh
 
+        _record("TpuIciWindowExec", True)
         return TpuIciWindowExec(
             node, make_mesh(conf.get(MESH_DEVICES) or None),
             epoch_bytes=conf.get(MESH_EPOCH_BYTES))
@@ -390,15 +510,21 @@ class TpuTransitionOverrides:
         node.children = [
             TpuTransitionOverrides._rewrite_ici_repartition(c, conf)
             if isinstance(c, TpuExec) else c for c in node.children]
-        if not _mesh_stage_on(conf, MESH_REPARTITION_ENABLED):
-            return node
         if not (isinstance(node, TpuShuffleExchangeExec)
                 and isinstance(node.partitioning,
-                               (HashPartitioning, RoundRobinPartitioning))
-                and mesh_exchange_schema_supported(node.output)):
+                               (HashPartitioning, RoundRobinPartitioning))):
+            return node
+        reason = _mesh_stage_reason(conf, MESH_REPARTITION_ENABLED)
+        if reason is None and not mesh_exchange_schema_supported(
+                node.output):
+            reason = ("output schema has nested/unsupported columns for "
+                      "the mesh exchange")
+        if reason is not None:
+            _record("TpuIciRepartitionExec", False, reason)
             return node
         from spark_rapids_tpu.parallel.mesh import make_mesh
 
+        _record("TpuIciRepartitionExec", True)
         return TpuIciRepartitionExec(
             node, make_mesh(conf.get(MESH_DEVICES) or None),
             epoch_bytes=conf.get(MESH_EPOCH_BYTES))
@@ -450,9 +576,12 @@ class TpuTransitionOverrides:
                     # general AQE: the reader RECORDS per-partition
                     # rows/bytes and coalesces on the measured stats
                     # (GpuCustomShuffleReaderExec analog)
+                    _record("TpuAdaptiveShuffleReaderExec", True)
                     new_children.append(TpuAdaptiveShuffleReaderExec(
                         c, conf.get(BATCH_SIZE_BYTES)))
                 else:
+                    _record("TpuAdaptiveShuffleReaderExec", False,
+                            f"{ADAPTIVE_ENABLED.key} is false")
                     goal = CoalesceGoal(conf.get(BATCH_SIZE_BYTES))
                     new_children.append(TpuCoalesceBatchesExec(goal, c))
             else:

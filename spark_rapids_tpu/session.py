@@ -56,21 +56,39 @@ def _apply_compile_cache(conf: "TpuConf") -> None:
     cache_dir = conf.get(COMPILE_CACHE_DIR)
     if not cache_dir or cache_dir == "0":
         cache_dir = ""
+    if cache_dir:
+        # partition by backend + compile mode: XLA:CPU AOT artifacts are
+        # machine-feature-specific, and the axon remote-compile relay
+        # builds them for ITS host — loading those locally risks SIGILL
+        # (observed "+prefer-no-scatter not supported" load warnings)
+        try:
+            import jax
+
+            plat = jax.default_backend()
+        except Exception:
+            plat = "unknown"
+        if os.environ.get("PALLAS_AXON_REMOTE_COMPILE"):
+            plat += "-remote"
+        cache_dir = os.path.join(cache_dir, plat)
     if _COMPILE_CACHE_APPLIED == cache_dir:
         return
-    _COMPILE_CACHE_APPLIED = cache_dir
     if not cache_dir:
+        _COMPILE_CACHE_APPLIED = cache_dir
         return
     try:
         os.makedirs(cache_dir, exist_ok=True)
     except OSError:
+        # keep the backend partition in the fallback too (mixing AOT
+        # artifacts across machines risks SIGILL); leave the sentinel
+        # unset on total failure so a later fixed conf can still apply
         cache_dir = os.path.join(
             os.path.expanduser("~"), ".cache", "spark_rapids_tpu",
-            "xla_cache")
+            os.path.basename(cache_dir))
         try:
             os.makedirs(cache_dir, exist_ok=True)
         except OSError:
             return
+    _COMPILE_CACHE_APPLIED = cache_dir
     try:
         import jax
 
@@ -521,7 +539,9 @@ class DataFrame:
         from spark_rapids_tpu.cpu.oracle import execute_cpu_plan
         from spark_rapids_tpu.exec.base import TpuExec
         from spark_rapids_tpu.exec.transitions import TpuColumnarToRowExec
+        from spark_rapids_tpu.expr.misc import CURRENT_INPUT_FILE
 
+        CURRENT_INPUT_FILE[0] = ""   # InputFileName: "" outside file scans
         root, _meta = self._planned()
         if isinstance(root, TpuExec):
             from spark_rapids_tpu.config import PROFILE_ENABLED

@@ -1,0 +1,342 @@
+"""Round-5 expression breadth: luhn_check, to_binary, bitmap scalars,
+map_from_entries/map_sort, try_element_at/cardinality, shuffle, randn,
+to_number/to_char, extract/to_date(fmt), from_avro/to_avro,
+from_xml/to_xml, input_file_name, empty2null, unary positive
+(reference: string_test.py / collection_ops_test.py / map_test.py /
+avro/xml connector tests)."""
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.session import TpuSession, col, lit
+
+from asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    assert_tpu_fallback_collect,
+)
+from data_gen import (
+    ArrayGen,
+    IntegerGen,
+    LongGen,
+    StringGen,
+    gen_df,
+)
+
+
+def test_luhn_check():
+    from spark_rapids_tpu.expr.strings import Luhn
+
+    def build(s):
+        df = s.create_dataframe(
+            {"t": ["79927398713", "79927398710", "4532015112830366",
+                   "1234", "0", "", "79a27398713", None, "18", "059"]},
+            T.StructType([T.StructField("t", T.STRING, True)]))
+        return df.select(Luhn(col("t")).alias("ok"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_to_binary_utf8_hex_base64():
+    from spark_rapids_tpu.expr.misc import ToBinary, TryToBinary
+
+    def build(s):
+        df = s.create_dataframe(
+            {"h": ["6162", "4A4B", "f", "", None, "zz"],
+             "b": ["YWJj", "aGk=", "", None, "###", "aGVsbG8="],
+             "u": ["plain", "", None, "x", "yy", "zzz"]},
+            T.StructType([T.StructField("h", T.STRING, True),
+                          T.StructField("b", T.STRING, True),
+                          T.StructField("u", T.STRING, True)]))
+        return df.select(
+            TryToBinary(col("h"), lit("hex")).alias("hx"),
+            TryToBinary(col("b"), lit("base64")).alias("b64"),
+            ToBinary(col("u"), lit("utf-8")).alias("u8"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_bitmap_scalars():
+    from spark_rapids_tpu.expr.misc import (BitmapBitPosition,
+                                            BitmapBucketNumber,
+                                            BitmapCount)
+
+    def build(s):
+        df = s.create_dataframe(
+            {"v": [1, 2, 32768, 32769, 0, -1, -32768, 123456, None],
+             "t": ["abc", "", "\x01\x7f", None, "x", "yy", "z", "w", "q"]},
+            T.StructType([T.StructField("v", T.LONG, True),
+                          T.StructField("t", T.STRING, True)]))
+        return df.select(
+            BitmapBitPosition(col("v")).alias("pos"),
+            BitmapBucketNumber(col("v")).alias("bkt"),
+            BitmapCount(col("t")).alias("cnt"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_try_element_at_and_cardinality():
+    from spark_rapids_tpu.expr.collections import Cardinality, TryElementAt
+
+    def build(s):
+        df = gen_df(s, [ArrayGen(IntegerGen(), max_len=5),
+                        IntegerGen(min_val=-3, max_val=6)],
+                    ["a", "i"], length=200)
+        return df.select(
+            TryElementAt(col("a"), col("i")).alias("e"),
+            Cardinality(col("a")).alias("c"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_map_from_entries_roundtrip():
+    from spark_rapids_tpu.expr.collections import (MapEntries,
+                                                   MapFromEntries)
+
+    def build(s):
+        schema = T.StructType([
+            T.StructField("m", T.MapType(T.INT, T.LONG), True)])
+        df = s.create_dataframe(
+            {"m": [{1: 10, 2: 20}, {}, None, {5: None, 7: 70}]}, schema)
+        return df.select(
+            MapFromEntries(MapEntries(col("m"))).alias("m2"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_map_from_entries_duplicate_key_errors():
+    from spark_rapids_tpu.expr.collections import MapFromEntries
+    from spark_rapids_tpu.expr.complextypes import CreateNamedStruct
+    from spark_rapids_tpu.expr.collections import CreateArray
+
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    df = s.create_dataframe(
+        {"k": [1, 2], "v": [10, 20]},
+        T.StructType([T.StructField("k", T.INT, False),
+                      T.StructField("v", T.INT, False)]))
+    ent = CreateNamedStruct(["key", "value"], [col("k"), col("v")])
+    q = df.select(MapFromEntries(
+        CreateArray([ent, ent])).alias("m"))
+    with pytest.raises(Exception, match="[Dd]uplicate"):
+        q.collect()
+
+
+def test_map_sort():
+    from spark_rapids_tpu.expr.collections import MapSort
+
+    def build(s):
+        schema = T.StructType([
+            T.StructField("m", T.MapType(T.INT, T.LONG), True)])
+        df = s.create_dataframe(
+            {"m": [{3: 30, 1: 10, 2: 20}, {}, None, {9: 90, 4: None}]},
+            schema)
+        return df.select(MapSort(col("m")).alias("ms"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_shuffle_deterministic_per_seed():
+    from spark_rapids_tpu.expr.collections import Shuffle
+
+    def build(s):
+        df = gen_df(s, [ArrayGen(IntegerGen(), max_len=6)], ["a"],
+                    length=150)
+        return df.select(Shuffle(col("a"), seed=7).alias("sh"))
+
+    # device and oracle implement the same splitmix permutation
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_randn_matches_spec():
+    from spark_rapids_tpu.expr.misc import Randn
+
+    def build(s):
+        df = gen_df(s, [IntegerGen()], ["x"], length=100)
+        return df.select(Randn(lit(42)).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_to_number_and_try_to_number():
+    from spark_rapids_tpu.expr.misc import ToNumber, TryToNumber
+
+    def build(s):
+        df = s.create_dataframe(
+            {"t": ["454", "054", "54", "", None, "4x4", "999999"],
+             "d": ["12.34", "0.01", "5.", ".99", "bad", None, "12345.67"],
+             "g": ["12,454", "1,234", "12454", "1,2,3", None, "x", "9"],
+             "c": ["$78.12", "$0.01", "78.12", "$", None, "$9.99", "$1.00"],
+             "m": ["12-", "34", "-12", "7-", None, "", "99-"]},
+            T.StructType([T.StructField(c, T.STRING, True)
+                          for c in ("t", "d", "g", "c", "m")]))
+        return df.select(
+            TryToNumber(col("t"), lit("999")).alias("n1"),
+            TryToNumber(col("d"), lit("99999.99")).alias("n2"),
+            TryToNumber(col("g"), lit("99,999")).alias("n3"),
+            TryToNumber(col("c"), lit("$99.99")).alias("n4"),
+            TryToNumber(col("m"), lit("99MI")).alias("n5"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_to_char():
+    from decimal import Decimal
+
+    from spark_rapids_tpu.expr.misc import ToCharacter
+
+    def build(s):
+        df = s.create_dataframe(
+            {"d": [Decimal("454.00"), Decimal("-12.79"), Decimal("0.10"),
+                   None, Decimal("99999.99"), Decimal("12345.67")]},
+            T.StructType([T.StructField("d", T.DecimalType(7, 2), True)]))
+        return df.select(
+            ToCharacter(col("d"), lit("99,999.99")).alias("c1"),
+            ToCharacter(col("d"), lit("$99999.99")).alias("c2"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_extract_and_parse_to_date():
+    from spark_rapids_tpu.expr.datetime import (Extract, ParseToDate,
+                                                TryToTimestamp)
+
+    def build(s):
+        df = s.create_dataframe(
+            {"t": ["2023-03-14", "1999-12-31", None, "bad", "2001-01-01"],
+             "ts": ["2023-03-14 01:02:03", "bad ts", None,
+                    "1970-01-01 00:00:00", "2038-01-19 03:14:07"]},
+            T.StructType([T.StructField("t", T.STRING, True),
+                          T.StructField("ts", T.STRING, True)]))
+        d = ParseToDate(col("t"), lit("yyyy-MM-dd"))
+        return df.select(
+            d.alias("d"),
+            Extract(lit("YEAR"), ParseToDate(col("t"))).alias("y"),
+            Extract(lit("DOW"), ParseToDate(col("t"))).alias("dw"),
+            TryToTimestamp(col("ts"),
+                           lit("yyyy-MM-dd HH:mm:ss")).alias("ts2"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_extract_bad_field_falls_back():
+    from spark_rapids_tpu.expr.datetime import Extract, ParseToDate
+
+    def build(s):
+        df = s.create_dataframe(
+            {"t": ["2023-03-14"]},
+            T.StructType([T.StructField("t", T.STRING, True)]))
+        return df.select(
+            Extract(lit("EPOCH"), ParseToDate(col("t"))).alias("x"))
+
+    assert_tpu_fallback_collect(build, "Project")
+
+
+def test_avro_roundtrip():
+    import json
+
+    from spark_rapids_tpu.expr.avroexprs import (AvroDataToCatalyst,
+                                                 CatalystDataToAvro)
+    from spark_rapids_tpu.expr.complextypes import CreateNamedStruct
+
+    schema_json = json.dumps({
+        "type": "record", "name": "r",
+        "fields": [{"name": "a", "type": ["null", "long"]},
+                   {"name": "t", "type": ["null", "string"]}]})
+
+    def build(s):
+        df = s.create_dataframe(
+            {"a": [1, -5, None, 123456789], "t": ["x", "", "hey", None]},
+            T.StructType([T.StructField("a", T.LONG, True),
+                          T.StructField("t", T.STRING, True)]))
+        enc = CatalystDataToAvro(
+            CreateNamedStruct(["a", "t"], [col("a"), col("t")]),
+            lit(schema_json))
+        return df.select(
+            AvroDataToCatalyst(enc, lit(schema_json)).alias("rt"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_xml_roundtrip():
+    from spark_rapids_tpu.expr.complextypes import CreateNamedStruct
+    from spark_rapids_tpu.expr.xmlexprs import StructsToXml, XmlToStructs
+
+    def build(s):
+        df = s.create_dataframe(
+            {"a": [3, None, 77], "t": ["he<llo", "", None]},
+            T.StructType([T.StructField("a", T.LONG, True),
+                          T.StructField("t", T.STRING, True)]))
+        xml = StructsToXml(
+            CreateNamedStruct(["a", "t"], [col("a"), col("t")]))
+        st = T.StructType([T.StructField("a", T.LONG, True),
+                           T.StructField("t", T.STRING, True)])
+        return df.select(XmlToStructs(xml, st).alias("rt"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_from_xml_malformed_yields_nulls():
+    from spark_rapids_tpu.expr.xmlexprs import XmlToStructs
+
+    def build(s):
+        df = s.create_dataframe(
+            {"x": ["<row><a>1</a></row>", "<row><a>zz</a></row>",
+                   "not xml", None, "<row></row>"]},
+            T.StructType([T.StructField("x", T.STRING, True)]))
+        st = T.StructType([T.StructField("a", T.LONG, True)])
+        return df.select(XmlToStructs(col("x"), st).alias("r"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_sentences_falls_back_and_matches():
+    from spark_rapids_tpu.expr.misc import Sentences
+
+    def build(s):
+        df = s.create_dataframe(
+            {"t": ["Hi there. How are you?", "", None, "One two."]},
+            T.StructType([T.StructField("t", T.STRING, True)]))
+        return df.select(Sentences(col("t")).alias("w"))
+
+    assert_tpu_fallback_collect(build, "Project")
+
+
+def test_empty2null_and_unary_positive():
+    from spark_rapids_tpu.expr.arithmetic import UnaryPositive
+    from spark_rapids_tpu.expr.strings import Empty2Null
+
+    def build(s):
+        df = s.create_dataframe(
+            {"t": ["", "x", None, "  ", ""],
+             "v": [1, -2, None, 7, 0]},
+            T.StructType([T.StructField("t", T.STRING, True),
+                          T.StructField("v", T.INT, True)]))
+        return df.select(Empty2Null(col("t")).alias("e"),
+                         UnaryPositive(col("v")).alias("p"))
+
+    assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_input_file_name_empty_without_scan():
+    from spark_rapids_tpu.expr.misc import InputFileName
+
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    df = s.create_dataframe(
+        {"v": [1, 2]},
+        T.StructType([T.StructField("v", T.INT, False)]))
+    rows = df.select(InputFileName().alias("f"), col("v")).collect()
+    assert rows == [("", 1), ("", 2)]
+
+
+def test_input_file_name_from_parquet(tmp_path):
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.expr.misc import InputFileName
+
+    p = str(tmp_path / "f.parquet")
+    pq.write_table(pa.table({"v": np.arange(4, dtype=np.int64)}), p)
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    rows = s.read.parquet(p).select(
+        InputFileName().alias("f"), col("v")).collect()
+    assert len(rows) == 4
+    assert all(r[0].endswith("f.parquet") for r in rows)

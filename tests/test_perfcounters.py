@@ -78,8 +78,10 @@ def test_session_applies_compile_cache_conf():
     S._COMPILE_CACHE_APPLIED = None
     S.TpuSession({})
     want = TpuConf({}).get(COMPILE_CACHE_DIR)
-    assert jax.config.jax_compilation_cache_dir == want
-    assert S._COMPILE_CACHE_APPLIED == want
+    # the applied dir is partitioned by backend (CPU AOT artifacts are
+    # machine-specific; mixing relay-compiled ones risks SIGILL)
+    assert jax.config.jax_compilation_cache_dir.startswith(want)
+    assert S._COMPILE_CACHE_APPLIED.startswith(want)
     # a later session with an explicitly different dir is honored, not
     # silently ignored (code-review finding)
     import tempfile
@@ -87,6 +89,6 @@ def test_session_applies_compile_cache_conf():
     with tempfile.TemporaryDirectory() as td:
         other = os.path.join(td, "xc")
         S.TpuSession({"spark.rapids.tpu.compileCache.dir": other})
-        assert jax.config.jax_compilation_cache_dir == other
+        assert jax.config.jax_compilation_cache_dir.startswith(other)
     S._COMPILE_CACHE_APPLIED = None
     S.TpuSession({})      # restore the default for the rest of the suite
