@@ -519,7 +519,14 @@ def main():
             df = build(_session(True, cache_batches=cache), *args)
             t_tpu, rows, ctr = _time_repeats(df.collect, repeats,
                                              counters=True)
-            check(rows, vec_res)
+            try:
+                check(rows, vec_res)
+            except AssertionError as ex:
+                # a mismatch must never erase the rest of the record: log
+                # the failure, skip the number, keep benchmarking
+                progress(f"{name}_{mode} FAILED correctness: {ex}")
+                skipped.append(f"{name}_{mode}:mismatch")
+                continue
             progress(f"{name}_{mode}: tpu {t_tpu:.2f}s "
                      f"(programs={ctr['nProgramsLaunched']:.0f} "
                      f"syncs={ctr['nHostSyncs']:.0f} "

@@ -458,6 +458,7 @@ int64_t snappy_uncompress(const uint8_t* in, int64_t in_len, uint8_t* out,
         }
         op += len;
     }
+
     return (op == static_cast<int64_t>(ulen)) ? op : -1;
 }
 
@@ -483,6 +484,111 @@ int64_t plain_byte_array_lens(const uint8_t* buf, int64_t buf_len,
         total += ln;
     }
     return total;
+}
+
+
+// Raw snappy block COMPRESSION — the decompressor's twin (device parquet
+// ENCODE path, round 5).  Greedy hash-table LZ77 emitting the same
+// literal/copy tag stream snappy_uncompress parses; not byte-identical
+// to google/snappy's output (any valid stream is), but decompresses
+// with it.  Returns bytes written or -1 when out_cap is too small.
+int64_t snappy_compress(const uint8_t* in, int64_t in_len, uint8_t* out,
+                        int64_t out_cap) {
+    int64_t op = 0;
+    // varint preamble: uncompressed length
+    uint64_t u = static_cast<uint64_t>(in_len);
+    do {
+        if (op >= out_cap) return -1;
+        uint8_t b = u & 0x7F;
+        u >>= 7;
+        out[op++] = u ? (b | 0x80) : b;
+    } while (u);
+
+    auto emit_literal = [&](int64_t from, int64_t len) -> bool {
+        while (len > 0) {
+            int64_t chunk = len < (1 << 24) ? len : ((1 << 24) - 1);
+            if (chunk <= 60) {
+                if (op + 1 + chunk > out_cap) return false;
+                out[op++] = static_cast<uint8_t>((chunk - 1) << 2);
+            } else {
+                int nb = chunk < (1 << 8) ? 1 : (chunk < (1 << 16) ? 2 : 3);
+                if (op + 1 + nb + chunk > out_cap) return false;
+                out[op++] = static_cast<uint8_t>((59 + nb) << 2);
+                int64_t v = chunk - 1;
+                for (int k = 0; k < nb; ++k) {
+                    out[op++] = static_cast<uint8_t>(v & 0xFF);
+                    v >>= 8;
+                }
+            }
+            std::memcpy(out + op, in + from, static_cast<size_t>(chunk));
+            op += chunk;
+            from += chunk;
+            len -= chunk;
+        }
+        return true;
+    };
+    auto emit_copy = [&](int64_t offset, int64_t len) -> bool {
+        // prefer 2-byte-offset copies (1..64 length); split longer runs
+        while (len >= 4) {
+            int64_t chunk = len < 64 ? len : 64;
+            if (len - chunk > 0 && len - chunk < 4) chunk = len - 4;
+            if (offset < 2048 && chunk >= 4 && chunk <= 11) {
+                if (op + 2 > out_cap) return false;
+                out[op++] = static_cast<uint8_t>(
+                    1 | ((chunk - 4) << 2) | ((offset >> 8) << 5));
+                out[op++] = static_cast<uint8_t>(offset & 0xFF);
+            } else if (offset < (1 << 16)) {
+                if (op + 3 > out_cap) return false;
+                out[op++] = static_cast<uint8_t>(2 | ((chunk - 1) << 2));
+                out[op++] = static_cast<uint8_t>(offset & 0xFF);
+                out[op++] = static_cast<uint8_t>((offset >> 8) & 0xFF);
+            } else {
+                if (op + 5 > out_cap) return false;
+                out[op++] = static_cast<uint8_t>(3 | ((chunk - 1) << 2));
+                int64_t v = offset;
+                for (int k = 0; k < 4; ++k) {
+                    out[op++] = static_cast<uint8_t>(v & 0xFF);
+                    v >>= 8;
+                }
+            }
+            len -= chunk;
+        }
+        return true;
+    };
+
+    const int HASH_BITS = 14;
+    const int64_t HSIZE = 1 << HASH_BITS;
+    std::vector<int64_t> table(HSIZE, -1);
+    auto hash4 = [&](int64_t i) -> uint32_t {
+        uint32_t v = static_cast<uint32_t>(in[i])
+            | (static_cast<uint32_t>(in[i + 1]) << 8)
+            | (static_cast<uint32_t>(in[i + 2]) << 16)
+            | (static_cast<uint32_t>(in[i + 3]) << 24);
+        return (v * 0x1E35A7BDu) >> (32 - HASH_BITS);
+    };
+
+    int64_t ip = 0, lit_start = 0;
+    while (ip + 4 <= in_len) {
+        uint32_t h = hash4(ip);
+        int64_t cand = table[h];
+        table[h] = ip;
+        if (cand >= 0 && ip - cand < (1 << 16)
+            && std::memcmp(in + cand, in + ip, 4) == 0) {
+            if (ip > lit_start
+                && !emit_literal(lit_start, ip - lit_start)) return -1;
+            int64_t len = 4;
+            while (ip + len < in_len
+                   && in[cand + len] == in[ip + len]) ++len;
+            if (!emit_copy(ip - cand, len)) return -1;
+            ip += len;
+            lit_start = ip;
+        } else {
+            ++ip;
+        }
+    }
+    if (in_len > lit_start
+        && !emit_literal(lit_start, in_len - lit_start)) return -1;
+    return op;
 }
 
 }  // extern "C"

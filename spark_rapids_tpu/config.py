@@ -197,6 +197,23 @@ COMPILE_CACHE_DIR = conf("spark.rapids.tpu.compileCache.dir").doc(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     ".jax_compile_cache"))
 
+SKEW_JOIN_ENABLED = conf("spark.sql.adaptive.skewJoin.enabled").doc(
+    "AQE skew handling for the mesh join (Spark's OptimizeSkewedJoin "
+    "analog): when one device's matched-pair total for a probe epoch "
+    "exceeds skewedPartitionFactor x the device mean, the epoch splits "
+    "in half and re-routes — bounding the per-device materialization "
+    "capacity a hot key would otherwise inflate.").boolean_conf(True)
+
+SKEW_JOIN_FACTOR = conf(
+    "spark.sql.adaptive.skewJoin.skewedPartitionFactor").doc(
+    "A device is skewed when its epoch output exceeds this factor times "
+    "the device mean (Spark's default 5).").integer_conf(5)
+
+SKEW_JOIN_MIN_ROWS = conf(
+    "spark.rapids.tpu.mesh.skewJoin.minEpochRows").doc(
+    "Epochs at or below this row count stop splitting (the floor of the "
+    "skew ladder).").integer_conf(1024)
+
 AGG_SMALL_GROUPS_CAP = conf("spark.rapids.tpu.agg.smallGroupsCap").doc(
     "Sort-based group-by emits results through a bounded-cardinality "
     "program when the group count fits this cap: boundary/cumsum forms "
@@ -280,6 +297,16 @@ PARQUET_DEVICE_DECODE = conf(
     "pipeline dispatches eager device ops whose round-trips dominate "
     "over a tunneled chip (directly-attached TPU hosts amortize "
     "them).").boolean_conf(False)
+PARQUET_DEVICE_ENCODE = conf(
+    "spark.rapids.sql.format.parquet.encode.device").doc(
+    "Encode Parquet pages with device kernels (dictionary build, k-bit "
+    "index packing and def-level packing run as jitted programs; the "
+    "host assembles thrift headers + snappy framing through the C "
+    "compressor twin — io/parquet_encode.py, the decode pipeline's "
+    "mirror).  Flat int/float/string schemas; others keep the pyarrow "
+    "host encode.  Off by default for the same tunnel-dispatch reason "
+    "as decode.device.").boolean_conf(False)
+
 AVRO_READ_ENABLED = conf("spark.rapids.sql.format.avro.read.enabled").doc(
     "Enable TPU Avro scans (pure-python container decode, io/avro.py)."
 ).boolean_conf(True)

@@ -791,50 +791,120 @@ class TpuIciShuffleJoinExec(TpuExec):
             matched = jax.device_put(
                 jnp.zeros(swords[0].shape[0], jnp.bool_),
                 NamedSharding(self.mesh, P(self.axis)))
-        for epoch in self._epochs(self.children[0].execute_columnar()):
-            with self.metrics["opTime"].timed():
-                epoch = self._pad_for_mesh(epoch)
-                ls = self._shard(epoch)
-                pkey = (epoch.capacity,)
-                if pkey not in self._pprobe:
-                    self._pprobe[pkey] = self._build_pprobe(l_schema)
-                acc = (matched,) if full else ()
-                res = self._pprobe[pkey](tuple(ls),
-                                         jnp.int32(epoch.num_rows),
-                                         swords, n_valid, *acc)
-                (rl, lo, counts, unmatched, rl_ok, totals) = res[:6]
-                if full:
-                    matched = res[6]
-                totals_np = np.asarray(totals)  # one host sync per epoch
-                per_dev_rows = totals_np[:, 0] + (
-                    totals_np[:, 1]
-                    if jt in (JoinType.LEFT_OUTER, JoinType.FULL_OUTER)
-                    else 0)
-                flat = tuple(rl) + tuple(rr)
-                if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
-                    out_cap = rl[0].capacity // n_dev
-                else:
-                    # pow2 ladder floored at the probe epoch's shard cap so
-                    # repeated epochs reuse one compiled program
-                    out_cap = max(int(per_dev_rows.max()), 1,
-                                  rl[0].capacity // n_dev)
-                    out_cap = 1 << (out_cap - 1).bit_length()
-                key2 = (out_cap, epoch.capacity)
-                if key2 not in self._p2:
-                    self._p2[key2] = self._build_p2(
-                        out_cap, l_schema, r_schema, len(rl))
-                out_cols, out_rows = self._p2[key2](
-                    flat, row_index, lo, counts, unmatched, rl_ok, totals)
-                rows_np = np.asarray(out_rows)  # one host sync per epoch
-            per_dev_cap = out_cols[0].capacity // n_dev
-            for d in range(n_dev):
-                ng = int(rows_np[d])
-                if ng == 0:
-                    continue
-                lo_i = d * per_dev_cap
-                cols = [c.gather(jnp.arange(lo_i, lo_i + per_dev_cap))
-                        for c in out_cols[:keep_cols]]
-                yield self._emit(cols, ng)
+        from spark_rapids_tpu.config import (SKEW_JOIN_ENABLED,
+                                             SKEW_JOIN_FACTOR,
+                                             SKEW_JOIN_MIN_ROWS, get_conf)
+
+        conf = get_conf()
+        skew_on = conf.get(SKEW_JOIN_ENABLED) and jt not in (
+            JoinType.LEFT_SEMI, JoinType.LEFT_ANTI)
+        skew_factor = conf.get(SKEW_JOIN_FACTOR)
+        skew_min_rows = conf.get(SKEW_JOIN_MIN_ROWS)
+        self.skew_splits = 0     # plan-visible evidence for tests/metrics
+
+        # epochs are processed through an explicit stack so a skewed epoch
+        # can SPLIT: when one device's matched total exceeds
+        # skewedPartitionFactor x the device mean (AQE OptimizeSkewedJoin
+        # analog, detected from the per-epoch totals the exec syncs
+        # anyway), the epoch halves and re-routes — per-device output
+        # capacity stays near the mean instead of the hot key's total
+        pending: List[ColumnarBatch] = []
+
+        def refill(epoch):
+            pending.append(epoch)
+
+        for epoch0 in self._epochs(self.children[0].execute_columnar()):
+            refill(epoch0)
+            while pending:
+                epoch = pending.pop()
+                with self.metrics["opTime"].timed():
+                    epoch = self._pad_for_mesh(epoch)
+                    ls = self._shard(epoch)
+                    pkey = (epoch.capacity,)
+                    if pkey not in self._pprobe:
+                        self._pprobe[pkey] = self._build_pprobe(l_schema)
+                    acc = (matched,) if full else ()
+                    res = self._pprobe[pkey](tuple(ls),
+                                             jnp.int32(epoch.num_rows),
+                                             swords, n_valid, *acc)
+                    (rl, lo, counts, unmatched, rl_ok, totals) = res[:6]
+                    if full:
+                        # OR-ing covered build rows is idempotent, so a
+                        # skew re-run of the halves is safe
+                        matched = res[6]
+                    totals_np = np.asarray(totals)  # one host sync/epoch
+                    per_dev_rows = totals_np[:, 0] + (
+                        totals_np[:, 1]
+                        if jt in (JoinType.LEFT_OUTER, JoinType.FULL_OUTER)
+                        else 0)
+                    if (skew_on
+                            and epoch.num_rows > max(skew_min_rows, 1)
+                            and per_dev_rows.max() > skew_factor
+                            * max(per_dev_rows.mean(), 1.0)):
+                        # split depth straight from the measured ratio
+                        # (Spark AQE sizes splits from stats the same
+                        # way) — a single hot key keeps max/mean
+                        # constant under halving, so per-level
+                        # re-probing would pay log2(n) wasted probes
+                        import math as _math
+
+                        ratio = per_dev_rows.max() / max(
+                            per_dev_rows.mean(), 1.0)
+                        k = max(1, _math.ceil(
+                            _math.log2(ratio / skew_factor)) + 1)
+                        parts = min(1 << k, 16, max(
+                            epoch.num_rows // max(skew_min_rows, 1), 2))
+                        step = -(-epoch.num_rows // parts)
+                        self.skew_splits += 1
+                        self.metric("skewSplits").add(1)
+                        from spark_rapids_tpu.columnar.column import (
+                            DEFAULT_ROW_BUCKETS,
+                            round_up_bucket,
+                        )
+
+                        # bucketed capacities: sub-epochs land on the
+                        # standard row-bucket ladder so the probe/p2
+                        # programs compiled for those buckets are reused
+                        # (arbitrary capacities would each compile fresh
+                        # — minutes per program on the tunneled chip)
+                        cap2 = round_up_bucket(max(step, 1),
+                                               DEFAULT_ROW_BUCKETS)
+                        for s0 in range(0, epoch.num_rows, step):
+                            ln = min(step, epoch.num_rows - s0)
+                            sub = epoch.slice_rows(s0, ln)
+                            if sub.capacity != cap2:
+                                sub = ColumnarBatch(
+                                    [c.slice_to(cap2) for c in
+                                     sub.columns], sub.num_rows,
+                                    sub.schema)
+                            pending.append(sub)
+                        continue
+                    flat = tuple(rl) + tuple(rr)
+                    if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+                        out_cap = rl[0].capacity // n_dev
+                    else:
+                        # pow2 ladder floored at the probe epoch's shard
+                        # cap so repeated epochs reuse one program
+                        out_cap = max(int(per_dev_rows.max()), 1,
+                                      rl[0].capacity // n_dev)
+                        out_cap = 1 << (out_cap - 1).bit_length()
+                    key2 = (out_cap, epoch.capacity)
+                    if key2 not in self._p2:
+                        self._p2[key2] = self._build_p2(
+                            out_cap, l_schema, r_schema, len(rl))
+                    out_cols, out_rows = self._p2[key2](
+                        flat, row_index, lo, counts, unmatched, rl_ok,
+                        totals)
+                    rows_np = np.asarray(out_rows)  # one host sync/epoch
+                per_dev_cap = out_cols[0].capacity // n_dev
+                for d in range(n_dev):
+                    ng = int(rows_np[d])
+                    if ng == 0:
+                        continue
+                    lo_i = d * per_dev_cap
+                    cols = [c.gather(jnp.arange(lo_i, lo_i + per_dev_cap))
+                            for c in out_cols[:keep_cols]]
+                    yield self._emit(cols, ng)
         if full:
             with self.metrics["opTime"].timed():
                 bcap_local = swords[0].shape[0] // n_dev

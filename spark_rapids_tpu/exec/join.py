@@ -771,5 +771,13 @@ class TpuAdaptiveJoinExec(TpuExec):
             yield from bj.execute_columnar()
             return
         self.decision = f"shuffled({size}B)"
+        # the replay child is single-shot (handles close as they re-emit):
+        # restore the real build subtree afterwards so a REPEATED execute
+        # of this plan re-materializes instead of replaying closed handles
+        # (round-5 on-chip finding: the second collect of a 20M-row qb
+        # joined an EMPTY build side and silently dropped every match)
         right_ex.children[0] = _ReplayExec(handles, build_inner.output)
-        yield from self.shuffled.execute_columnar()
+        try:
+            yield from self.shuffled.execute_columnar()
+        finally:
+            right_ex.children[0] = build_inner

@@ -1,0 +1,449 @@
+"""Device-side Parquet ENCODE — the decode pipeline's mirror.
+
+Reference analog: GpuParquetFileFormat writes through cuDF's
+``Table.writeParquetChunked`` — pages are ENCODED on device and the host
+only assembles headers/footer (SURVEY.md §2.6 Writers, §2.10 item 9).
+TPU replacement, same split:
+
+  device (jitted kernels, feed the perf counters):
+    * dictionary build: one sort + boundary pass -> padded unique values
+      + count (``device_dict_build``);
+    * index computation + k-bit packing: merge-rank positions into the
+      dictionary, then the RLE/bit-packed hybrid's bit-packed body as a
+      pure reshape/shift/matmul kernel (``device_bitpack``) — no per-row
+      host loop anywhere;
+    * def-levels for nullable columns: validity -> 1-bit packed run.
+
+  host (this module): thrift compact page headers + footer, the snappy
+    framing through the C compressor twin (native.snappy_compress), and
+    file layout.  The host never touches row data — only the already
+    -packed byte buffers that come back from the device.
+
+Scope: flat INT32/INT64/FLOAT/DOUBLE/date columns (PLAIN or
+RLE_DICTIONARY) and BYTE_ARRAY strings (PLAIN, device-computed lengths +
+offsets).  io/writer.py routes eligible tables here when
+``spark.rapids.sql.format.parquet.encode.device`` is on; anything else
+keeps the pyarrow host encode.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.io.parquet_native import (CODEC_SNAPPY,
+                                                CODEC_UNCOMPRESSED,
+                                                ENC_PLAIN, ENC_RLE,
+                                                ENC_RLE_DICT, PAGE_DATA,
+                                                PAGE_DICT, TYPE_BYTE_ARRAY,
+                                                TYPE_DOUBLE, TYPE_FLOAT,
+                                                TYPE_INT32, TYPE_INT64)
+from spark_rapids_tpu.perfcounters import tpu_jit
+
+# thrift compact type nibbles
+_CT_TRUE, _CT_FALSE, _CT_BYTE = 1, 2, 3
+_CT_I16, _CT_I32, _CT_I64, _CT_DOUBLE = 4, 5, 6, 7
+_CT_BINARY, _CT_LIST, _CT_SET, _CT_MAP, _CT_STRUCT = 8, 9, 10, 11, 12
+
+
+class _TW:
+    """Minimal thrift compact-protocol WRITER (the reader's inverse)."""
+
+    def __init__(self):
+        self.buf = bytearray()
+
+    def varint(self, v: int):
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            self.buf.append(b | 0x80 if v else b)
+            if not v:
+                return
+
+    def zigzag(self, v: int):
+        self.varint((v << 1) ^ (v >> 63) if v < 0 else (v << 1))
+
+    def field(self, fid: int, last: int, ctype: int) -> int:
+        delta = fid - last
+        if 0 < delta < 16:
+            self.buf.append((delta << 4) | ctype)
+        else:
+            self.buf.append(ctype)
+            self.zigzag(fid)
+        return fid
+
+    def write_i(self, fid: int, last: int, v: int, ctype=_CT_I64) -> int:
+        last = self.field(fid, last, ctype)
+        self.zigzag(v)
+        return last
+
+    def write_bin(self, fid: int, last: int, v: bytes) -> int:
+        last = self.field(fid, last, _CT_BINARY)
+        self.varint(len(v))
+        self.buf += v
+        return last
+
+    def write_list_header(self, fid: int, last: int, n: int,
+                          etype: int) -> int:
+        last = self.field(fid, last, _CT_LIST)
+        if n < 15:
+            self.buf.append((n << 4) | etype)
+        else:
+            self.buf.append(0xF0 | etype)
+            self.varint(n)
+        return last
+
+    def stop(self):
+        self.buf.append(0)
+
+
+# ---------------------------------------------------------------------------
+# device kernels
+# ---------------------------------------------------------------------------
+
+@tpu_jit
+def _k_bitpack_bits(bits):
+    """(n8, 8) bool -> (n8,) uint8, little-endian bit order (parquet
+    RLE/bit-packed little-endian convention) — one matmul-shaped dot."""
+    w = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], jnp.int32)
+    return jnp.sum(bits.astype(jnp.int32) * w[None, :],
+                   axis=1).astype(jnp.uint8)
+
+
+def device_bitpack(values, bit_width: int) -> jax.Array:
+    """k-bit little-endian pack of (n,) nonneg ints on device."""
+    n = values.shape[0]
+    if bit_width == 0:
+        return jnp.zeros(0, jnp.uint8)
+    shifts = jnp.arange(bit_width, dtype=values.dtype)
+    bits = ((values[:, None] >> shifts[None, :]) & 1).astype(jnp.bool_)
+    flat = bits.reshape(-1)
+    pad = (-flat.shape[0]) % 8
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros(pad, jnp.bool_)])
+    return _k_bitpack_bits(flat.reshape(-1, 8))
+
+
+def _dict_build_fn(data, n_valid_mask):
+    """sorted uniques (padded with last value) + count."""
+    big = jnp.iinfo(jnp.int64).max
+    key = jnp.where(n_valid_mask, data.astype(jnp.int64), big)
+    s = jnp.sort(key)
+    nv = jnp.sum(n_valid_mask.astype(jnp.int32))
+    bnd = jnp.zeros(s.shape[0], jnp.bool_).at[0].set(True)
+    bnd = bnd.at[1:].set(s[1:] != s[:-1])
+    in_valid = jnp.arange(s.shape[0]) < nv
+    is_new = bnd & in_valid
+    uid = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+    n_uniq = jnp.sum(is_new.astype(jnp.int32))
+    # compact the uniques to the front (stable sort by ~is_new); the
+    # tail pads with int64-max so searchsorted stays correct over the
+    # full static-width array
+    order = jnp.argsort(~is_new, stable=True)
+    uniques = jnp.where(jnp.arange(s.shape[0]) < n_uniq, s[order], big)
+    return uniques, n_uniq
+
+
+_dict_build_jit = tpu_jit(_dict_build_fn)
+
+
+def _dict_indices_fn(data, mask, uniques, n_uniq):
+    pos = jnp.searchsorted(uniques[:], jnp.where(
+        mask, data.astype(jnp.int64), uniques[0]))
+    pos = jnp.clip(pos, 0, jnp.maximum(n_uniq - 1, 0))
+    return pos.astype(jnp.int32)
+
+
+_dict_indices_jit = tpu_jit(_dict_indices_fn)
+
+
+# ---------------------------------------------------------------------------
+# host assembly
+# ---------------------------------------------------------------------------
+
+def _hybrid_bitpacked(packed: bytes, n_values: int, bw: int) -> bytes:
+    """One bit-packed run of the RLE/bit-packed hybrid."""
+    groups = -(-n_values // 8)
+    header = bytearray()
+    v = (groups << 1) | 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        header.append(b | 0x80 if v else b)
+        if not v:
+            break
+    need = groups * bw
+    body = packed[:need] if len(packed) >= need else \
+        packed + b"\0" * (need - len(packed))
+    return bytes(header) + body
+
+
+def _rle_run(value: int, count: int, bw: int) -> bytes:
+    out = bytearray()
+    v = count << 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out.append(b | 0x80 if v else b)
+        if not v:
+            break
+    nbytes = (bw + 7) // 8
+    out += int(value).to_bytes(nbytes, "little") if nbytes else b""
+    return bytes(out)
+
+
+_PHYS = {T.IntegerType: TYPE_INT32, T.DateType: TYPE_INT32,
+         T.LongType: TYPE_INT64, T.FloatType: TYPE_FLOAT,
+         T.DoubleType: TYPE_DOUBLE, T.StringType: TYPE_BYTE_ARRAY,
+         T.TimestampType: TYPE_INT64}
+
+
+def supported_schema(schema: T.StructType) -> bool:
+    return all(type(f.dataType) in _PHYS for f in schema.fields)
+
+
+def _page_header(page_type: int, usize: int, csize: int, n_values: int,
+                 encoding: int, def_encoding: int = ENC_RLE) -> bytes:
+    tw = _TW()
+    last = 0
+    last = tw.write_i(1, last, page_type, _CT_I32)
+    last = tw.write_i(2, last, usize, _CT_I32)
+    last = tw.write_i(3, last, csize, _CT_I32)
+    if page_type == PAGE_DATA:
+        last = tw.field(5, last, _CT_STRUCT)    # data_page_header
+        l2 = 0
+        l2 = tw.write_i(1, l2, n_values, _CT_I32)
+        l2 = tw.write_i(2, l2, encoding, _CT_I32)
+        l2 = tw.write_i(3, l2, def_encoding, _CT_I32)   # def level enc
+        l2 = tw.write_i(4, l2, ENC_RLE, _CT_I32)        # rep level enc
+        tw.stop()
+    else:                                       # dictionary page
+        last = tw.field(7, last, _CT_STRUCT)    # dictionary_page_header
+        l2 = 0
+        l2 = tw.write_i(1, l2, n_values, _CT_I32)
+        l2 = tw.write_i(2, l2, ENC_PLAIN, _CT_I32)
+        tw.stop()
+    tw.stop()
+    return bytes(tw.buf)
+
+
+def _compress(codec: int, payload: bytes) -> bytes:
+    if codec == CODEC_SNAPPY:
+        from spark_rapids_tpu.native import snappy_compress
+
+        return snappy_compress(payload)
+    return payload
+
+
+class _ChunkMeta:
+    __slots__ = ("name", "phys", "n", "encodings", "codec",
+                 "data_off", "dict_off", "csize", "usize", "dict_usize")
+
+
+def _encode_column(f: T.StructField, col, n: int, codec: int,
+                   use_dict: bool):
+    """One column chunk -> (pages bytes, _ChunkMeta).  ``col`` is the
+    device HostColumn-like carrier (validity + data or chars/lengths)."""
+    phys = _PHYS[type(f.dataType)]
+    nullable = bool(f.nullable)
+    validity = col.validity[:n]
+    mask = jnp.asarray(np.asarray(validity))
+
+    # ---- def levels (nullable): 1-bit packed on device ----
+    def_bytes = b""
+    if nullable:
+        packed = np.asarray(device_bitpack(
+            jnp.asarray(np.asarray(validity).astype(np.int32)), 1))
+        def_bytes = _hybrid_bitpacked(packed.tobytes(), n, 1)
+        def_bytes = struct.pack("<I", len(def_bytes)) + def_bytes
+
+    pages = bytearray()
+    meta = _ChunkMeta()
+    meta.name = f.name
+    meta.phys = phys
+    meta.n = n
+    meta.codec = codec
+    meta.dict_off = None
+
+    if phys == TYPE_BYTE_ARRAY:
+        # PLAIN byte-array: (len, bytes) interleave built with vectorized
+        # scatters over device-computed lengths/offsets — no per-row loop
+        chars = np.asarray(col.chars[:n])
+        valid_np = np.asarray(validity)
+        lens = np.where(valid_np,
+                        np.asarray(col.lengths[:n]).astype(np.int64), 0)
+        keep = valid_np if nullable else np.ones(n, np.bool_)
+        klens = lens[keep]
+        k = len(klens)
+        starts = np.zeros(k, np.int64)
+        if k:
+            starts[1:] = np.cumsum(klens + 4)[:-1]
+        total = int((klens + 4).sum())
+        payload_arr = np.zeros(total, np.uint8)
+        for b in range(4):      # 4 vectorized prefix scatters
+            payload_arr[starts + b] = ((klens >> (8 * b)) & 0xFF)
+        total_chars = int(klens.sum())
+        if total_chars:
+            row_ids = np.repeat(np.arange(k), klens)
+            cum_excl = np.concatenate([[0], np.cumsum(klens)[:-1]])
+            within = np.arange(total_chars) - np.repeat(cum_excl, klens)
+            kchars = chars[keep]
+            payload_arr[np.repeat(starts + 4, klens) + within] = \
+                kchars[row_ids, within]
+        payload = def_bytes + payload_arr.tobytes()
+        meta.encodings = [ENC_PLAIN, ENC_RLE]
+        enc = ENC_PLAIN
+    elif use_dict:
+        data = col.data[:n]
+        uniques, n_uniq = _dict_build_jit(
+            jnp.asarray(np.asarray(data)).astype(jnp.int64), mask)
+        n_uniq = int(n_uniq)                      # one sync per chunk
+        bw = max((n_uniq - 1).bit_length(), 1)
+        idx = _dict_indices_jit(jnp.asarray(np.asarray(data)), mask,
+                                uniques, jnp.int32(n_uniq))
+        if nullable:
+            # v1 data pages hold only the DEFINED values
+            idx = jnp.asarray(np.asarray(idx)[np.asarray(validity)])
+        n_defined = int(idx.shape[0])
+        packed = np.asarray(device_bitpack(idx, bw))
+        uvals = np.asarray(uniques)[:n_uniq]
+        if phys == TYPE_INT32:
+            dict_payload = uvals.astype("<i4").tobytes()
+        elif phys == TYPE_INT64:
+            dict_payload = uvals.astype("<i8").tobytes()
+        else:
+            raise ValueError("dict encode: int types only")
+        cdict = _compress(codec, dict_payload)
+        meta.dict_off = True
+        dict_header = _page_header(PAGE_DICT, len(dict_payload),
+                                   len(cdict), n_uniq, ENC_PLAIN)
+        pages += dict_header
+        pages += cdict
+        meta.dict_usize = len(dict_header) + len(dict_payload)
+        body = bytes([bw]) + _hybrid_bitpacked(packed.tobytes(),
+                                               n_defined, bw)
+        payload = def_bytes + body
+        meta.encodings = [ENC_RLE_DICT, ENC_PLAIN, ENC_RLE]
+        enc = ENC_RLE_DICT
+    else:
+        data = np.asarray(col.data[:n])
+        if nullable:
+            # parquet PLAIN pages hold only the DEFINED values
+            data = data[np.asarray(validity)]
+        wire = {TYPE_INT32: "<i4", TYPE_INT64: "<i8",
+                TYPE_FLOAT: "<f4", TYPE_DOUBLE: "<f8"}[phys]
+        payload = def_bytes + data.astype(wire).tobytes()
+        meta.encodings = [ENC_PLAIN, ENC_RLE]
+        enc = ENC_PLAIN
+
+    cpayload = _compress(codec, bytes(payload))
+    header = _page_header(PAGE_DATA, len(payload), len(cpayload), n, enc)
+    data_page_pos = len(pages)
+    pages += header
+    pages += cpayload
+    # total_uncompressed_size = page headers + UNCOMPRESSED payloads
+    meta.usize = (getattr(meta, "dict_usize", 0) + len(header)
+                  + len(payload))
+    meta.csize = len(pages)
+    meta.data_off = data_page_pos
+    return bytes(pages), meta
+
+
+def write_parquet_device(path: str, schema: T.StructType, cols, n: int,
+                         compression: str = "snappy",
+                         use_dict: bool = True) -> Dict[str, int]:
+    """Write one parquet file with device-encoded pages.  ``cols`` are
+    host-materializable column carriers (HostColumn or DeviceColumn
+    fetched once).  Returns stats for tests/metrics."""
+    codec = CODEC_SNAPPY if compression == "snappy" \
+        else CODEC_UNCOMPRESSED
+    out = bytearray(b"PAR1")
+    chunk_metas: List[Tuple[_ChunkMeta, int]] = []
+    for f, c in zip(schema.fields, cols):
+        can_dict = (use_dict
+                    and _PHYS[type(f.dataType)] in (TYPE_INT32,
+                                                    TYPE_INT64))
+        pages, meta = _encode_column(f, c, n, codec, can_dict)
+        chunk_metas.append((meta, len(out)))
+        out += pages
+
+    # ---- footer ----
+    tw = _TW()
+    last = 0
+    last = tw.write_i(1, last, 1, _CT_I32)               # version
+    # schema: root + one element per field
+    last = tw.write_list_header(2, last, 1 + len(schema.fields),
+                                _CT_STRUCT)
+    root = _TW()
+    r_last = 0
+    r_last = root.write_bin(4, r_last, b"schema")
+    r_last = root.write_i(5, r_last, len(schema.fields), _CT_I32)
+    tw.buf += root.buf
+    tw.stop()
+    for f in schema.fields:
+        el = _TW()
+        e_last = 0
+        e_last = el.write_i(1, e_last, _PHYS[type(f.dataType)], _CT_I32)
+        e_last = el.write_i(3, e_last, 1 if f.nullable else 0, _CT_I32)
+        e_last = el.write_bin(4, e_last, f.name.encode())
+        if isinstance(f.dataType, T.DateType):
+            e_last = el.write_i(6, e_last, 6, _CT_I32)   # DATE converted
+        if isinstance(f.dataType, T.TimestampType):
+            e_last = el.write_i(6, e_last, 10, _CT_I32)  # TIMESTAMP_MICROS
+        if isinstance(f.dataType, T.StringType):
+            e_last = el.write_i(6, e_last, 0, _CT_I32)   # UTF8
+        tw.buf += el.buf
+        tw.stop()
+    last = tw.write_i(3, last, n, _CT_I64)               # num_rows
+    # one row group
+    last = tw.write_list_header(4, last, 1, _CT_STRUCT)
+    rg = _TW()
+    g_last = 0
+    g_last = rg.write_list_header(1, g_last, len(chunk_metas), _CT_STRUCT)
+    total = 0
+    for meta, base in chunk_metas:
+        cc = _TW()
+        c_last = 0
+        c_last = cc.write_i(2, c_last, base, _CT_I64)    # file_offset
+        c_last = cc.field(3, c_last, _CT_STRUCT)         # meta_data
+        m = _TW()
+        m_last = 0
+        m_last = m.write_i(1, m_last, meta.phys, _CT_I32)
+        m_last = m.write_list_header(2, m_last, len(meta.encodings),
+                                     _CT_I32)
+        for e in meta.encodings:
+            m.zigzag(e)
+        m_last = m.write_list_header(3, m_last, 1, _CT_BINARY)
+        m.varint(len(meta.name.encode()))
+        m.buf += meta.name.encode()
+        m_last = m.write_i(4, m_last, meta.codec, _CT_I32)
+        m_last = m.write_i(5, m_last, meta.n, _CT_I64)   # num_values
+        m_last = m.write_i(6, m_last, meta.usize, _CT_I64)
+        m_last = m.write_i(7, m_last, meta.csize, _CT_I64)
+        m_last = m.write_i(9, m_last, base + meta.data_off, _CT_I64)
+        if meta.dict_off is not None:
+            # field 11: dictionary_page_offset (10 is index_page_offset)
+            m_last = m.write_i(11, m_last, base, _CT_I64)
+        cc.buf += m.buf
+        cc.buf.append(0)        # end meta_data struct
+        rg.buf += cc.buf
+        rg.buf.append(0)        # end column chunk struct
+        total += meta.csize
+    g_last = rg.write_i(2, g_last, total, _CT_I64)       # total_byte_size
+    g_last = rg.write_i(3, g_last, n, _CT_I64)           # num_rows
+    tw.buf += rg.buf
+    tw.stop()                                            # end row group
+    last = tw.write_bin(6, last, b"spark-rapids-tpu device encoder")
+    tw.stop()                                            # end FileMetaData
+
+    footer = bytes(tw.buf)
+    out += footer
+    out += struct.pack("<I", len(footer))
+    out += b"PAR1"
+    with open(path, "wb") as fh:
+        fh.write(out)
+    return {"bytes": len(out), "columns": len(chunk_metas)}

@@ -109,6 +109,15 @@ class _FileRoller:
             self.seq += 1
 
 
+def _take_host(h: HostColumn, idx) -> HostColumn:
+    """Row selection on a host column (flat/string kinds — the device
+    -encode schemas)."""
+    if h.chars is not None:
+        return HostColumn(h.dtype, h.validity[idx], chars=h.chars[idx],
+                          lengths=h.lengths[idx])
+    return HostColumn(h.dtype, h.validity[idx], data=h.data[idx])
+
+
 def batch_to_arrow(batch: ColumnarBatch):
     import pyarrow as pa
 
@@ -143,6 +152,20 @@ class TpuDataWritingCommandExec(TpuExec):
         self.run_write()
         return iter(())
 
+    def _device_encode_on(self) -> bool:
+        from spark_rapids_tpu.config import PARQUET_DEVICE_ENCODE
+        from spark_rapids_tpu.io.parquet_encode import supported_schema
+
+        if self.fmt != "parquet" \
+                or not self.conf.get(PARQUET_DEVICE_ENCODE):
+            return False
+        out_fields = [f for f in self.children[0].output.fields
+                      if f.name not in self.partition_cols]
+        if not supported_schema(T.StructType(out_fields)):
+            return False
+        return self.conf.get(PARQUET_WRITE_COMPRESSION) in ("snappy",
+                                                            "none")
+
     def run_write(self) -> None:
         import shutil
 
@@ -151,12 +174,32 @@ class TpuDataWritingCommandExec(TpuExec):
         os.makedirs(self.path, exist_ok=True)
         max_records = self.conf.get(MAX_RECORDS_PER_FILE)
         compression = self.conf.get(PARQUET_WRITE_COMPRESSION)
+        device_encode = self._device_encode_on()
         rollers: Dict[str, _FileRoller] = {}
+        seqs: Dict[str, int] = {}
         names = None
         for task_id, batch in enumerate(
                 self.children[0].execute_columnar()):
             names = batch.schema.field_names()
             with self.metric("writeTime").timed():
+                if device_encode:
+                    from spark_rapids_tpu.io.parquet_encode import (
+                        write_parquet_device,
+                    )
+
+                    for reldir, schema, cols, nrows in \
+                            self._split_batch_host(batch, max_records):
+                        directory = os.path.join(self.path, reldir) \
+                            if reldir else self.path
+                        os.makedirs(directory, exist_ok=True)
+                        seq = seqs.get(reldir, 0)
+                        seqs[reldir] = seq + 1
+                        base = (f"part-{task_id:05d}-{seq:04d}-"
+                                f"{uuid.uuid4().hex[:12]}.parquet")
+                        write_parquet_device(
+                            os.path.join(directory, base), schema, cols,
+                            nrows, compression)
+                    continue
                 for reldir, tbl in self._split_batch(batch):
                     directory = os.path.join(self.path, reldir) \
                         if reldir else self.path
@@ -169,6 +212,45 @@ class TpuDataWritingCommandExec(TpuExec):
         # empty input: still create the directory + _SUCCESS (Spark parity)
         open(os.path.join(self.path, "_SUCCESS"), "w").close()
         self.metrics["numOutputRows"]  # touch for metric presence
+
+    def _split_batch_host(self, batch: ColumnarBatch, max_records: int):
+        """Device-encode path: yield (reldir, schema, host columns, n)
+        per partition (and per maxRecordsPerFile roll)."""
+        import numpy as np
+
+        names = batch.schema.field_names()
+        host = batch.to_host_columns()
+        n = batch.num_rows
+
+        def rolls(reldir, schema, cols, nrows):
+            if max_records and nrows > max_records:
+                for s in range(0, nrows, max_records):
+                    ln = min(max_records, nrows - s)
+                    yield (reldir, schema,
+                           [c.slice_rows(s, s + ln) for c in cols], ln)
+            else:
+                yield reldir, schema, cols, nrows
+
+        if not self.partition_cols:
+            schema = T.StructType(list(batch.schema.fields))
+            yield from rolls("", schema,
+                             [h.slice_rows(0, n) for h in host], n)
+            return
+        pidx = [names.index(c) for c in self.partition_cols]
+        didx = [i for i in range(len(names)) if i not in pidx]
+        schema = T.StructType([batch.schema.fields[i] for i in didx])
+        part_vals = [host[i].to_pylist()[:n] for i in pidx]
+        keys = list(zip(*part_vals))
+        uniq = sorted(set(keys), key=lambda t: tuple(str(x) for x in t))
+        keys_arr = np.array([str(k) for k in keys])
+        for u in uniq:
+            mask = keys_arr == str(u)
+            idx = np.nonzero(mask)[0]
+            cols = [_take_host(host[i], idx) for i in didx]
+            reldir = "/".join(
+                f"{c}={_hive_part_value(v)}"
+                for c, v in zip(self.partition_cols, u))
+            yield from rolls(reldir, schema, cols, len(idx))
 
     def _split_batch(self, batch: ColumnarBatch):
         """Yield (relative_partition_dir, arrow_table_without_part_cols)."""

@@ -284,6 +284,91 @@ def _snappy_uncompress_py(data: bytes, usize: int) -> bytes:
     return bytes(out)
 
 
+
+
+def snappy_compress(data: bytes) -> bytes:
+    """Raw snappy block compression — the decompressor's twin (device
+    parquet ENCODE path).  Greedy hash-table LZ77; any stream it emits
+    round-trips through snappy_uncompress (and google/snappy)."""
+    lib = get_lib()
+    if lib is not None and hasattr(lib, "snappy_compress"):
+        inp = np.frombuffer(data, np.uint8)
+        cap = len(data) + len(data) // 6 + 32
+        out = np.zeros(cap, np.uint8)
+        fn = lib.snappy_compress
+        fn.restype = ctypes.c_int64
+        n = fn(_p(np.ascontiguousarray(inp), ctypes.c_uint8),
+               len(inp), _p(out, ctypes.c_uint8), ctypes.c_int64(cap))
+        if n < 0:
+            raise ValueError("snappy compress overflow")
+        return out[:n].tobytes()
+    return _snappy_compress_py(data)
+
+
+def _snappy_compress_py(data: bytes) -> bytes:
+    out = bytearray()
+    u = len(data)
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        out.append(b | 0x80 if u else b)
+        if not u:
+            break
+
+    def emit_literal(frm, ln):
+        while ln > 0:
+            chunk = min(ln, (1 << 24) - 1)
+            if chunk <= 60:
+                out.append((chunk - 1) << 2)
+            else:
+                nb = 1 if chunk < (1 << 8) else (2 if chunk < (1 << 16)
+                                                 else 3)
+                out.append((59 + nb) << 2)
+                out.extend(int(chunk - 1).to_bytes(nb, "little"))
+            out.extend(data[frm: frm + chunk])
+            frm += chunk
+            ln -= chunk
+
+    def emit_copy(off, ln):
+        while ln >= 4:
+            chunk = min(ln, 64)
+            if 0 < ln - chunk < 4:
+                chunk = ln - 4
+            if off < 2048 and 4 <= chunk <= 11:
+                out.append(1 | ((chunk - 4) << 2) | ((off >> 8) << 5))
+                out.append(off & 0xFF)
+            elif off < (1 << 16):
+                out.append(2 | ((chunk - 1) << 2))
+                out.extend(int(off).to_bytes(2, "little"))
+            else:
+                out.append(3 | ((chunk - 1) << 2))
+                out.extend(int(off).to_bytes(4, "little"))
+            ln -= chunk
+
+    table = {}
+    n = len(data)
+    ip = 0
+    lit = 0
+    while ip + 4 <= n:
+        key = data[ip: ip + 4]
+        cand = table.get(key, -1)
+        table[key] = ip
+        if cand >= 0 and ip - cand < (1 << 16):
+            if ip > lit:
+                emit_literal(lit, ip - lit)
+            ln = 4
+            while ip + ln < n and data[cand + ln] == data[ip + ln]:
+                ln += 1
+            emit_copy(ip - cand, ln)
+            ip += ln
+            lit = ip
+        else:
+            ip += 1
+    if n > lit:
+        emit_literal(lit, n - lit)
+    return bytes(out)
+
+
 def plain_byte_array_lens(buf: bytes, n: int) -> np.ndarray:
     """PLAIN BYTE_ARRAY page -> int32 lengths (C walk; python twin)."""
     lens = np.zeros(max(n, 1), np.int32)

@@ -10,10 +10,12 @@ thousand, XLA fuses the one-hot into the dot so the (N, D) selector is
 never materialized.
 
 Exactness: every output row selects exactly ONE table row (one-hot), so
-each f32 dot term is a single product with no accumulation — exact as
-long as each operand fits f32's 24-bit mantissa.  64-bit payloads are
-split into 13-bit limbs of their (unsigned) bit pattern and recombined
-with integer shifts, making the gather bit-exact for every flat dtype.
+each dot term is a single product with no accumulation — exact as long
+as each operand survives the matmul input precision.  TPU matmuls run
+bf16 passes at DEFAULT precision (8 mantissa bits), so payloads are
+split into 8-bit limbs of their (unsigned) bit pattern and recombined
+with integer shifts, making the gather bit-exact for every flat dtype
+on both the CPU backend and the real chip.
 """
 from __future__ import annotations
 
@@ -24,9 +26,12 @@ import numpy as np
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.column import DeviceColumn
 
-# 13-bit limbs: one-hot rows have a single 1, so a dot term is a single
-# f32 product of 1.0 * limb (< 2^13) — exact with margin
-_LIMB_BITS = 13
+# 8-bit limbs: one-hot rows have a single 1, so a dot term is a single
+# product of 1.0 * limb.  TPU matmuls run at DEFAULT precision (bf16
+# passes, 8 mantissa bits) — limbs must stay < 2^8 to survive bf16
+# exactly (13-bit limbs decoded correctly on the CPU backend but
+# truncated on the real chip: round-5 on-chip finding).
+_LIMB_BITS = 8
 _LIMB_MASK = (1 << _LIMB_BITS) - 1
 
 MAX_TABLE_ROWS = 8192      # beyond this the one-hot contraction's N*D
@@ -40,10 +45,11 @@ def _limbs_of(table: jax.Array) -> jax.Array:
         nbits = 1
     else:
         nbits = table.dtype.itemsize * 8
-        u = table.view(jnp.uint32 if nbits <= 32 else jnp.uint64)
         if nbits < 32:
             u = table.astype(jnp.int32).view(jnp.uint32) \
                 & jnp.uint32((1 << nbits) - 1)
+        else:
+            u = table.view(jnp.uint32 if nbits == 32 else jnp.uint64)
     nl = -(-nbits // _LIMB_BITS)
     limbs = [((u >> (i * _LIMB_BITS)) & _LIMB_MASK).astype(jnp.float32)
              for i in range(nl)]
@@ -74,7 +80,7 @@ def mxu_gather(table: jax.Array, idx: jax.Array) -> jax.Array:
     d = table.shape[0]
     oh = jax.nn.one_hot(idx, d, dtype=jnp.float32)
     if table.ndim == 2 and table.dtype == jnp.uint8:
-        # char matrix: each byte column is its own (<256) exact limb
+        # char matrix: each byte column is its own (<256, bf16-exact) limb
         out = oh @ table.astype(jnp.float32)
         return jnp.round(out).astype(jnp.uint8)
     limbs = _limbs_of(table)

@@ -521,11 +521,19 @@ class DataFrame:
         """Apply TpuOverrides; the planned exec tree is cached per conf so
         repeated collects reuse compiled XLA programs (Spark likewise reuses
         a query's compiled stages across executions of the same plan)."""
+        from spark_rapids_tpu.config import set_conf
         from spark_rapids_tpu.overrides import TpuOverrides
 
         conf = self.session.conf
         if not conf.sql_enabled:
             return self.plan, None
+        # the execution-ambient conf (config.get_conf): exec nodes read
+        # runtime knobs (skew split, groups-cap ladder) through it at
+        # execute time, after plan construction has dropped conf refs.
+        # Plan+execute run synchronously per collect, so the ambient conf
+        # is stable for the query that set it; oracle (sql-disabled)
+        # sessions never clobber it
+        set_conf(conf)
         cache_key = tuple(sorted((k, str(v))
                                  for k, v in conf.settings.items()))
         cached = getattr(self, "_plan_cache", None)

@@ -86,14 +86,27 @@ def test_bounded_join_agg_fused_path():
         left = gen_df(s, [IntegerGen(min_val=0, max_val=40),
                           LongGen(min_val=0, max_val=1000)],
                       ["k", "v"], length=3000)
-        right = gen_df(s, [IntegerGen(min_val=0, max_val=40,
-                                      nullable=False),
-                           IntegerGen(min_val=0, max_val=5)],
-                       ["k", "g"], length=41, seed=7)
+        # distinct build keys so the repeat collect takes the
+        # unique-build fast path
+        right = s.create_dataframe(
+            {"k": list(range(41)), "g": [i % 6 for i in range(41)]},
+            T.StructType([T.StructField("k", T.INT, False),
+                          T.StructField("g", T.INT, False)]))
         return (left.join(right, on="k")
                 .group_by("g").agg(sum_("v", "sv"), count_(None, "c")))
 
     assert_tpu_and_cpu_are_equal_collect(build, conf=_B16)
+
+    # the SECOND collect switches the fused exec onto the unique-build
+    # fast path (adaptive _build_unique) — the round-5 on-chip zero-rows
+    # regression lived exactly there; pin repeat-collect stability
+    s = TpuSession(dict(_B16))
+    df = build(s)
+    first = sorted(df.collect())
+    second = sorted(df.collect())
+    third = sorted(df.collect())
+    assert first == second == third
+    assert len(first) > 0
 
 
 def test_bounded_off_by_conf():

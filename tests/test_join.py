@@ -175,3 +175,27 @@ def test_join_build_larger_than_probe_capacity():
         return DataFrame(node, s)
 
     assert_tpu_and_cpu_are_equal_collect(build)
+
+
+def test_adaptive_shuffled_join_repeat_collect():
+    """Round-5 on-chip regression: the adaptive join's shuffled branch
+    swapped a single-shot _ReplayExec into the plan permanently, so the
+    SECOND collect joined an empty build side and every probe row went
+    unmatched.  Repeat collects must re-materialize."""
+    from data_gen import LongGen
+    from spark_rapids_tpu.session import TpuSession, col
+
+    s = TpuSession({"spark.rapids.sql.enabled": True,
+                    # force the shuffled branch (threshold below build)
+                    "spark.sql.autoBroadcastJoinThreshold": 1})
+    left = gen_df(s, [IntegerGen(min_val=0, max_val=50, nullable=False),
+                      LongGen()], ["k", "v"], length=500)
+    right = gen_df(s, [IntegerGen(min_val=0, max_val=50, nullable=False),
+                       LongGen()], ["k", "w"], length=200, seed=5)
+    df = left.join(right, on="k", how="left")
+    first = sorted(df.collect(), key=repr)
+    second = sorted(df.collect(), key=repr)
+    third = sorted(df.collect(), key=repr)
+    assert first == second == third
+    matched = sum(1 for r in first if r[-1] is not None)
+    assert matched > 0
