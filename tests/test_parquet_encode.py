@@ -60,10 +60,16 @@ def test_plain_and_dict_int_roundtrip(tmp_path):
     got = {k: back_pa.column(k).to_pylist() for k in data}
     assert got["i"] == data["i"]
     assert got["l"] == data["l"]
-    assert got["d"] == data["d"]
+    # doubles round-trip through device batches; the real v5e emulates
+    # f64 (~1e-15 relative error — conftest caveat), exact on CPU
+    assert np.allclose(got["d"], data["d"], rtol=1e-12, atol=0)
     assert len(back_own) == n
     want = sorted(zip(data["i"], data["l"], data["d"]), key=repr)
-    assert back_own == want
+    got_sorted = sorted(back_own, key=repr)
+    # int columns exact; doubles within the v5e f64-emulation tolerance
+    assert [r[:2] for r in got_sorted] == [r[:2] for r in want]
+    assert np.allclose([r[2] for r in got_sorted],
+                       [r[2] for r in want], rtol=1e-12, atol=0)
 
 
 def test_nullable_columns_def_levels(tmp_path):
