@@ -180,6 +180,54 @@ SPLIT_UNTIL_ROWS = conf("spark.rapids.tpu.retry.minSplitRows").doc(
     "Do not split batches below this many rows on SplitAndRetry."
 ).integer_conf(8)
 
+# --- resilience (stage-level fault domains) --------------------------------
+
+RESILIENCE_ENABLED = conf("spark.rapids.tpu.resilience.enabled").doc(
+    "Wrap every exec operator in a fault domain that classifies escaping "
+    "failures (device OOM / transient / deterministic), retries the "
+    "recoverable classes, and falls the rest back to the CPU oracle at "
+    "runtime (resilience/ package; reference: the RmmRapidsRetryIterator "
+    "state machine plus CPU-Spark stage fallback).").boolean_conf(True)
+
+RESILIENCE_MAX_TRANSIENT_RETRIES = conf(
+    "spark.rapids.tpu.resilience.maxTransientRetries").doc(
+    "Bounded restarts of an operator after a transient runtime error "
+    "(UNAVAILABLE / DEADLINE_EXCEEDED style XLA failures) before it is "
+    "treated as deterministic.").integer_conf(3)
+
+RESILIENCE_BACKOFF_BASE_MS = conf(
+    "spark.rapids.tpu.resilience.backoffBaseMs").doc(
+    "Base delay for exponential backoff between transient retries "
+    "(delay = base * 2^attempt + jitter in [0, base), capped at 2s); "
+    "0 disables sleeping (tests).").double_conf(10.0)
+
+RESILIENCE_RUNTIME_FALLBACK = conf(
+    "spark.rapids.tpu.resilience.runtimeFallbackEnabled").doc(
+    "On a deterministic failure, materialize the stage's inputs to host, "
+    "execute the stage's plan-node twin through the CPU oracle, and "
+    "continue the query on TPU (the mid-query analog of plan-time "
+    "willNotWorkOnTpu tagging).  Also enables the whole-query oracle "
+    "fallback of last resort in collect().").boolean_conf(True)
+
+RESILIENCE_BREAKER_THRESHOLD = conf(
+    "spark.rapids.tpu.resilience.breakerFailureThreshold").doc(
+    "Deterministic failures of one (operator, expression-fingerprint) key "
+    "before the circuit breaker opens and plan-time tagging routes that "
+    "stage to the CPU oracle for subsequent queries.").integer_conf(3)
+
+RESILIENCE_BREAKER_TTL_SEC = conf(
+    "spark.rapids.tpu.resilience.breakerTtlSec").doc(
+    "How long an open breaker entry holds its stage on CPU before a "
+    "half-open probe re-admits it to the TPU (success closes the entry, "
+    "failure re-opens with a fresh TTL).").double_conf(300.0)
+
+RESILIENCE_TEST_INJECT = conf(
+    "spark.rapids.tpu.resilience.testInject").doc(
+    "Chaos-injection hook: 'kind:Operator[:count[:atBatch[:seed]]]' "
+    "(kinds: compile, transient, poison; ';'-separated for multiple), "
+    "armed at collect() time.  The force_retry_oom test API generalized "
+    "to every failure class.").internal().string_conf("NONE")
+
 AUTO_BROADCAST_JOIN_THRESHOLD = conf(
     "spark.sql.autoBroadcastJoinThreshold").doc(
     "Estimated build-side size below which joins broadcast instead of "

@@ -90,6 +90,23 @@ def _traced(fn):
     return wrapper
 
 
+def _fault_domain(fn):
+    """Wrap an operator's batch iterator in the stage-level fault domain
+    (resilience/domain.py): failure classification, bounded transient /
+    OOM restarts, runtime CPU fallback, circuit-breaker recording, and the
+    chaos-injection hooks.  The reference's RmmRapidsRetryIterator analog,
+    generalized past OOM."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, *a, **kw):
+        from spark_rapids_tpu.resilience.domain import run_fault_domain
+
+        yield from run_fault_domain(self, fn, a, kw)
+
+    return wrapper
+
+
 class TpuExec:
     """Base TPU operator; children may be TpuExec or transition nodes."""
 
@@ -154,8 +171,11 @@ class TpuExec:
         super().__init_subclass__(**kw)
         # wrap execute_columnar with per-operator trace annotations
         # (NvtxRange analog); zero overhead unless profiling is enabled
+        # fault domain outermost: it must see failures escaping the whole
+        # iteration, trace annotations included
         if "execute_columnar" in cls.__dict__:
-            cls.execute_columnar = _traced(cls.execute_columnar)
+            cls.execute_columnar = _fault_domain(
+                _traced(cls.execute_columnar))
 
     def collect_metrics(self, into=None) -> Dict[str, int]:
         into = into if into is not None else {}

@@ -60,8 +60,15 @@ def _check_injection() -> None:
 
 
 def _is_device_oom(exc: BaseException) -> bool:
-    s = repr(exc)
-    return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s
+    """RESOURCE_EXHAUSTED anywhere in the __cause__/__context__ chain.
+
+    Framework layers wrap jaxlib's XlaRuntimeError (``raise X from e``),
+    so sniffing only ``repr(exc)`` misclassified wrapped OOMs as
+    deterministic failures; resilience/classify.py walks the chain and
+    matches XLA status codes."""
+    from spark_rapids_tpu.resilience.classify import is_device_oom
+
+    return is_device_oom(exc)
 
 
 def split_in_half_by_rows(
@@ -98,44 +105,53 @@ def with_retry(
         [inputs] if isinstance(inputs, SpillableColumnarBatch) else
         list(inputs))
     fw = get_spill_framework()
-    while queue:
-        item = queue.pop(0)
-        attempts = 0
-        while True:
-            attempts += 1
-            try:
-                _check_injection()
-                item.pin()
+    try:
+        while queue:
+            item = queue.pop(0)
+            attempts = 0
+            while True:
+                attempts += 1
                 try:
-                    result = fn(item.get_batch())
-                finally:
-                    item.unpin()
-                item.close()
-                yield result
-                break
-            except TpuRetryOOM:
-                if attempts >= max_attempts:
+                    _check_injection()
+                    item.pin()
+                    try:
+                        result = fn(item.get_batch())
+                    finally:
+                        item.unpin()
                     item.close()
-                    raise
-                fw.spill_device_pressure()
-            except TpuSplitAndRetryOOM:
-                if not split or item.num_rows < max(min_split_rows, 2):
-                    item.close()
-                    raise
-                queue = split_in_half_by_rows(item) + queue
-                break
-            except Exception as e:  # XLA RESOURCE_EXHAUSTED
-                if not _is_device_oom(e):
-                    item.close()
-                    raise
-                fw.spill_device_pressure()
-                if split and item.num_rows >= max(min_split_rows, 2):
+                    yield result
+                    break
+                except TpuRetryOOM:
+                    if attempts >= max_attempts:
+                        item.close()
+                        raise
+                    fw.spill_device_pressure()
+                except TpuSplitAndRetryOOM:
+                    if not split or item.num_rows < max(min_split_rows, 2):
+                        item.close()
+                        raise
                     queue = split_in_half_by_rows(item) + queue
                     break
-                if attempts >= max_attempts:
-                    item.close()
-                    raise
-    return
+                except Exception as e:  # XLA RESOURCE_EXHAUSTED
+                    if not _is_device_oom(e):
+                        item.close()
+                        raise
+                    fw.spill_device_pressure()
+                    if split and item.num_rows >= max(min_split_rows, 2):
+                        queue = split_in_half_by_rows(item) + queue
+                        break
+                    if attempts >= max_attempts:
+                        item.close()
+                        raise
+    finally:
+        # a consumer that abandons the generator early (GeneratorExit) —
+        # or any raise above — must not leak the still-queued spillable
+        # handles; the in-flight item is always closed before its yield
+        for q in queue:
+            try:
+                q.close()
+            except Exception:
+                pass
 
 
 def with_retry_no_split(fn: Callable[[], X], max_attempts: int = 8) -> X:

@@ -185,6 +185,17 @@ class SparkPlanMeta(BaseMeta):
                     break
         if self.rule.extra_check is not None:
             self.rule.extra_check(self)
+        # circuit breaker (resilience/breaker.py): stages that failed
+        # deterministically at runtime are routed to the CPU oracle at
+        # plan time until their TTL expires (half-open probe re-admits)
+        from spark_rapids_tpu.resilience.breaker import consult_plan
+
+        reason = consult_plan(self.plan, self.conf)
+        if reason:
+            from spark_rapids_tpu import perfcounters as PC
+
+            PC.bump("breakerPlanFallbacks")
+            self.will_not_work_on_tpu(reason)
 
     # ------------------------------------------------------------------
     def explain(self, indent: int = 0, only_fallback: bool = True) -> str:

@@ -1890,6 +1890,10 @@ class TpuOverrides:
                 else:
                     # CPU child under a TPU parent: row->columnar transition
                     tpu_children.append(TpuRowToColumnarExec(cc, ansi))
-            return _convert_node(meta, tpu_children, ansi)
+            node = _convert_node(meta, tpu_children, ansi)
+            # the fault domain's runtime CPU fallback + circuit-breaker
+            # keying map an exec back to its plan-node twin
+            node._origin_plan = meta.plan
+            return node
         # node stays on CPU; TPU children materialize through transitions
         return _rebuild_cpu_plan(meta, converted)

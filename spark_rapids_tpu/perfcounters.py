@@ -44,20 +44,39 @@ COUNTERS: Dict[str, int] = {
     "bytes_d2h": 0,
     "bytes_h2d": 0,
     "launch_wall_ns": 0,
+    # resilience (stage-level fault domains, resilience/domain.py)
+    "transientRetries": 0,
+    "oomRestarts": 0,
+    "runtimeFallbacks": 0,
+    "breakerTrips": 0,
+    "breakerPlanFallbacks": 0,
+    "queryFallbacks": 0,
 }
 
 
+def bump(key: str, n: int = 1) -> None:
+    """Thread-safe increment.  ``COUNTERS[k] += n`` is three bytecodes
+    (load / add / store) and CPython may switch threads between them, so
+    concurrent unguarded increments lose updates; every write in this
+    module routes through ``_LOCK``."""
+    with _LOCK:
+        COUNTERS[key] = COUNTERS.get(key, 0) + n
+
+
 def snapshot() -> Dict[str, int]:
-    return dict(COUNTERS)
+    with _LOCK:
+        return dict(COUNTERS)
 
 
 def since(snap: Dict[str, int]) -> Dict[str, int]:
-    return {k: COUNTERS[k] - snap.get(k, 0) for k in COUNTERS}
+    cur = snapshot()
+    return {k: cur[k] - snap.get(k, 0) for k in cur}
 
 
 def reset() -> None:
-    for k in COUNTERS:
-        COUNTERS[k] = 0
+    with _LOCK:
+        for k in COUNTERS:
+            COUNTERS[k] = 0
 
 
 class _CountingJit:
@@ -74,10 +93,12 @@ class _CountingJit:
         t0 = time.perf_counter_ns()
         out = jitted(*args, **kwargs)
         dt = time.perf_counter_ns() - t0
-        COUNTERS["programs_launched"] += 1
-        COUNTERS["launch_wall_ns"] += dt
-        if jitted._cache_size() > n0:
-            COUNTERS["compiles"] += 1
+        compiled = jitted._cache_size() > n0
+        with _LOCK:
+            COUNTERS["programs_launched"] += 1
+            COUNTERS["launch_wall_ns"] += dt
+            if compiled:
+                COUNTERS["compiles"] += 1
         return out
 
     def __getattr__(self, name):  # lower/trace/eval_shape passthrough
@@ -102,12 +123,14 @@ def _install_sync_counters() -> bool:
         return False
 
     def _count(self):
-        if not _in_sync_event():
-            COUNTERS["host_syncs"] += 1
         try:
-            COUNTERS["bytes_d2h"] += self.nbytes
+            nbytes = self.nbytes
         except Exception:
-            pass
+            nbytes = 0
+        with _LOCK:
+            if not _in_sync_event():
+                COUNTERS["host_syncs"] += 1
+            COUNTERS["bytes_d2h"] += nbytes
 
     try:
         real_array = impl.__array__
@@ -141,7 +164,7 @@ SYNC_COUNTING = _install_sync_counters()
 
 def count_h2d(nbytes: int) -> None:
     """Host->device transfer accounting (called from upload sites)."""
-    COUNTERS["bytes_h2d"] += int(nbytes)
+    bump("bytes_h2d", int(nbytes))
 
 
 _tls = threading.local()
@@ -156,7 +179,7 @@ class sync_event:
     still accounts bytes_d2h but not host_syncs."""
 
     def __enter__(self):
-        COUNTERS["host_syncs"] += 1
+        bump("host_syncs")
         _tls.in_sync_event = getattr(_tls, "in_sync_event", 0) + 1
         return self
 

@@ -1,0 +1,52 @@
+"""Stage-level fault domains (SURVEY.md §2.3, §5.3; PAPER.md §2.3).
+
+The reference plugin survives production because every GPU stage runs
+inside a retry/spill state machine and anything it cannot run routes back
+to CPU Spark.  This package is the TPU port of that posture, generalized
+beyond the OOM slice that memory/retry.py already covers:
+
+  * classify.py — one taxonomy for every failure escaping a stage:
+    device OOM (delegate to memory/retry.py spill+retry), deterministic
+    (compile / lowering / unsupported — never retried), transient
+    (bounded retry with exponential backoff + jitter), and propagate
+    (semantic errors like ANSI overflow that must surface unchanged).
+  * faults.py — the fault-injection harness (force_retry_oom generalized):
+    inject a compile failure, a transient runtime error, or a poisoned
+    output batch at any named operator, deterministically seeded.
+  * breaker.py — a process-global circuit breaker keyed by (operator
+    class, expression fingerprint): stages that failed deterministically
+    are tagged to the CPU oracle at *plan* time for subsequent queries,
+    with TTL + half-open probing so a fixed stage returns to TPU.
+  * fallback.py — runtime per-stage CPU fallback: synthesize the failing
+    operator's plan-node twin over its materialized TPU inputs and run it
+    through cpu/oracle.py, then continue the query on TPU.
+  * domain.py — the per-operator wrapper (installed by exec/base.py)
+    that ties the four together around every execute_columnar iterator.
+"""
+from spark_rapids_tpu.resilience.classify import (
+    DETERMINISTIC,
+    DEVICE_OOM,
+    PROPAGATE,
+    TRANSIENT,
+    classify_failure,
+    exception_chain,
+    is_device_oom,
+)
+from spark_rapids_tpu.resilience.faults import (
+    InjectedCompileError,
+    InjectedTransientError,
+    clear_faults,
+    inject_fault,
+)
+from spark_rapids_tpu.resilience.breaker import (
+    get_breaker,
+    reset_breaker,
+)
+
+__all__ = [
+    "DETERMINISTIC", "DEVICE_OOM", "PROPAGATE", "TRANSIENT",
+    "classify_failure", "exception_chain", "is_device_oom",
+    "InjectedCompileError", "InjectedTransientError",
+    "clear_faults", "inject_fault",
+    "get_breaker", "reset_breaker",
+]

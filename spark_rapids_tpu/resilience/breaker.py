@@ -1,0 +1,196 @@
+"""Process-global circuit breaker over (operator class, expr fingerprint).
+
+Role: runtime CPU fallback (fallback.py) saves the *current* query, but a
+deterministically-broken stage would fail and fall back again on every
+subsequent query — paying the failed TPU attempt each time.  The breaker
+remembers deterministic failures across queries: after ``failureThreshold``
+failures of the same (operator, fingerprint) key the breaker OPENS and
+plan-time tagging (overrides/meta.py) routes that stage to the CPU oracle
+*before* execution — the mid-query analog of ``willNotWorkOnTpu``.
+
+Lifecycle (the classic three-state machine):
+
+    CLOSED --N deterministic failures--> OPEN
+    OPEN   --TTL expiry, next consult--> HALF_OPEN (one TPU probe admitted)
+    HALF_OPEN --probe succeeds--> CLOSED (entry dropped)
+    HALF_OPEN --probe fails--> OPEN (fresh TTL)
+
+Keys pair the *plan-node* class name with a fingerprint of the node's
+expressions (sql_string digest), so e.g. a Sort on column ``a`` that broke
+does not banish Sorts on other keys.  The clock is injectable for TTL
+tests."""
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+CLOSED = "CLOSED"
+OPEN = "OPEN"
+HALF_OPEN = "HALF_OPEN"
+
+Key = Tuple[str, str]
+
+
+class _Entry:
+    __slots__ = ("failures", "state", "opened_at", "probed_at",
+                 "last_reason")
+
+    def __init__(self):
+        self.failures = 0
+        self.state = CLOSED
+        self.opened_at = 0.0
+        self.probed_at = 0.0
+        self.last_reason = ""
+
+
+class CircuitBreakerRegistry:
+    def __init__(self, now=time.monotonic):
+        self._now = now
+        self._lock = threading.Lock()
+        self._entries: Dict[Key, _Entry] = {}
+        self.trips = 0          # lifetime OPEN transitions (metrics)
+        # bumped on every planner-visible state change; session.py mixes
+        # it into the per-DataFrame plan-cache key so a cached TPU plan is
+        # re-planned (and re-tagged) after a trip, close, or probe
+        self.generation = 0
+
+    # -- recording (called from the fault domain at execution time) -----
+    def record_failure(self, key: Key, threshold: int,
+                       reason: str = "") -> bool:
+        """One deterministic failure; True when this one tripped OPEN."""
+        with self._lock:
+            e = self._entries.setdefault(key, _Entry())
+            e.failures += 1
+            e.last_reason = reason
+            if e.state == HALF_OPEN or (e.state == CLOSED
+                                        and e.failures >= threshold):
+                e.state = OPEN
+                e.opened_at = self._now()
+                self.trips += 1
+                self.generation += 1
+                return True
+            if e.state == OPEN:
+                e.opened_at = self._now()
+            return False
+
+    def record_success(self, key: Key) -> None:
+        """A completed TPU run closes a half-open entry (probe passed) and
+        decays closed-state failure counts."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return
+            if e.state == HALF_OPEN:
+                del self._entries[key]
+                self.generation += 1
+            elif e.state == CLOSED and e.failures:
+                e.failures -= 1
+
+    # -- consulting (called from plan-time tagging) ---------------------
+    def consult(self, key: Key, ttl_sec: float) -> Optional[str]:
+        """Why this stage must stay on CPU, or None (run on TPU).  An OPEN
+        entry past its TTL flips to HALF_OPEN and admits ONE probe."""
+        if not self._entries:
+            return None
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.state == CLOSED:
+                return None
+            if e.state == OPEN and self._now() - e.opened_at >= ttl_sec:
+                e.state = HALF_OPEN
+                e.probed_at = self._now()
+                self.generation += 1
+                return None
+            if e.state == HALF_OPEN:
+                if self._now() - e.probed_at >= ttl_sec:
+                    # the admitted probe never resolved (e.g. a LIMIT
+                    # short-circuited its iterator before StopIteration,
+                    # so record_success never fired) — re-admit another
+                    # probe instead of pinning the stage to CPU forever
+                    e.probed_at = self._now()
+                    return None
+                # a probe is already in flight; further plans stay on CPU
+                return (f"circuit breaker half-open for {key[0]} "
+                        f"(probe in flight)")
+            remaining = ttl_sec - (self._now() - e.opened_at)
+            why = f" ({e.last_reason})" if e.last_reason else ""
+            return (f"circuit breaker open for {key[0]} after "
+                    f"{e.failures} deterministic failure(s){why}; "
+                    f"re-probing TPU in {max(remaining, 0):.0f}s")
+
+    # -- introspection ---------------------------------------------------
+    def has_entries(self) -> bool:
+        return bool(self._entries)
+
+    def state_of(self, key: Key) -> str:
+        with self._lock:
+            e = self._entries.get(key)
+            return e.state if e is not None else CLOSED
+
+    def snapshot(self) -> List[Tuple[Key, str, int]]:
+        with self._lock:
+            return [(k, e.state, e.failures)
+                    for k, e in self._entries.items()]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.trips = 0
+            self.generation += 1
+
+
+_BREAKER = CircuitBreakerRegistry()
+
+
+def get_breaker() -> CircuitBreakerRegistry:
+    return _BREAKER
+
+
+def reset_breaker() -> None:
+    _BREAKER.reset()
+    _BREAKER._now = time.monotonic
+
+
+def expr_fingerprint(exprs) -> str:
+    """Digest of the expression list that parameterizes a plan node."""
+    parts = []
+    for e in exprs or []:
+        try:
+            parts.append(e.sql_string())
+        except Exception:
+            parts.append(type(e).__name__)
+    h = hashlib.sha1(";".join(parts).encode("utf-8", "replace"))
+    return h.hexdigest()[:12]
+
+
+def plan_key(plan) -> Key:
+    """(plan-node class name, expression fingerprint) — the breaker key.
+    Computed identically at plan time (overrides/meta.py consult) and at
+    execution time (domain.py record), so a runtime failure tags the
+    matching plan node in the next query."""
+    from spark_rapids_tpu.overrides.overrides import _exprs_of
+
+    try:
+        exprs = _exprs_of(plan)
+    except Exception:
+        exprs = []
+    return (type(plan).__name__, expr_fingerprint(exprs))
+
+
+def consult_plan(plan, conf) -> Optional[str]:
+    """Plan-time hook: the fallback reason when the breaker holds this
+    stage on CPU, else None.  Reads the resilience confs lazily so config
+    stays import-cycle-free."""
+    if not _BREAKER.has_entries():
+        return None
+    from spark_rapids_tpu.config import (
+        RESILIENCE_BREAKER_TTL_SEC,
+        RESILIENCE_ENABLED,
+    )
+
+    if not conf.get(RESILIENCE_ENABLED):
+        return None
+    return _BREAKER.consult(plan_key(plan),
+                            float(conf.get(RESILIENCE_BREAKER_TTL_SEC)))

@@ -1,0 +1,156 @@
+"""Failure classification — one taxonomy for everything escaping a stage.
+
+Reference analog: the retry state machine in SURVEY.md §2.3 distinguishes
+GpuRetryOOM / GpuSplitAndRetryOOM (recoverable, roll back + spill/split)
+from everything else (the task dies and CPU Spark reruns the stage).  XLA
+surfaces a richer error space — jaxlib raises ``XlaRuntimeError`` carrying
+an absl status code, often *wrapped* by framework layers via ``raise ...
+from e`` — so classification must walk the cause chain and read status
+codes, not just ``repr`` the outermost exception.
+
+Classes:
+
+  * DEVICE_OOM      — RESOURCE_EXHAUSTED anywhere in the chain, or the
+                      cooperative TpuRetryOOM/TpuSplitAndRetryOOM pair.
+                      Handled by the memory/retry.py path: spill + retry.
+  * TRANSIENT       — infrastructure errors that may heal on their own
+                      (UNAVAILABLE, DEADLINE_EXCEEDED, ABORTED, CANCELLED,
+                      UNKNOWN, INTERNAL; plugin/tunnel disconnects).
+                      Bounded retry with exponential backoff + jitter.
+  * DETERMINISTIC   — compile / lowering / unsupported-dtype / shape
+                      errors: retrying re-derives the same failure, so the
+                      stage goes straight to the CPU oracle (and feeds the
+                      circuit breaker).
+  * PROPAGATE       — semantic errors that are the *correct result* of the
+                      query (ANSI overflow, FAILFAST parse errors) plus
+                      control-flow exceptions; the fault domain must
+                      re-raise these unchanged.
+"""
+from __future__ import annotations
+
+from typing import Iterator
+
+DEVICE_OOM = "deviceOom"
+TRANSIENT = "transient"
+DETERMINISTIC = "deterministic"
+PROPAGATE = "propagate"
+
+# absl / XLA status codes (the string form jaxlib prefixes messages with)
+_OOM_CODES = ("RESOURCE_EXHAUSTED",)
+_TRANSIENT_CODES = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
+                    "CANCELLED", "UNKNOWN")
+_DETERMINISTIC_CODES = ("INVALID_ARGUMENT", "UNIMPLEMENTED", "NOT_FOUND",
+                        "FAILED_PRECONDITION", "OUT_OF_RANGE")
+
+# cooperative OOM exceptions from memory/retry.py, matched by name to keep
+# this module import-cycle-free (retry.py imports us for is_device_oom)
+_OOM_TYPE_NAMES = ("TpuRetryOOM", "TpuSplitAndRetryOOM")
+
+# exceptions that ARE the query's correct observable behavior
+_PROPAGATE_TYPE_NAMES = ("SparkArithmeticException",
+                         "SparkDateTimeException",
+                         "SparkNumberFormatException")
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+# OSError errnos that may heal on retry (network / interrupt flavored);
+# everything else (ENOSPC, EACCES, ENOENT, ...) is deterministic
+import errno as _errno
+
+_TRANSIENT_ERRNOS = frozenset((
+    _errno.EAGAIN, _errno.EINTR, _errno.ETIMEDOUT, _errno.ECONNRESET,
+    _errno.ECONNABORTED, _errno.ECONNREFUSED, _errno.EHOSTUNREACH,
+    _errno.ENETUNREACH, _errno.ENETRESET, _errno.EPIPE, _errno.EBUSY,
+))
+
+
+def exception_chain(exc: BaseException) -> Iterator[BaseException]:
+    """Yield ``exc`` and every ``__cause__``/``__context__`` beneath it
+    (cause preferred, cycle-guarded) — wrapped XLA errors keep their
+    status visible to the classifier.  ``raise X from None`` sets
+    ``__suppress_context__``: the raiser declared the context unrelated,
+    so the walk stops there (an error raised while *handling* an OOM must
+    not inherit the OOM's class when explicitly disowned)."""
+    seen = set()
+    cur: BaseException = exc
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        yield cur
+        if cur.__cause__ is not None:
+            cur = cur.__cause__
+        elif cur.__suppress_context__:
+            cur = None
+        else:
+            cur = cur.__context__
+
+
+def _status_of(exc: BaseException):
+    """The absl status-code token of one chain link, or None."""
+    if type(exc).__name__ != "XlaRuntimeError":
+        return None
+    msg = str(exc)
+    for code in (_OOM_CODES + _TRANSIENT_CODES + _DETERMINISTIC_CODES
+                 + ("INTERNAL", "DATA_LOSS", "PERMISSION_DENIED")):
+        if msg.startswith(code) or f"{code}:" in msg:
+            return code
+    return None
+
+
+def is_device_oom(exc: BaseException) -> bool:
+    """RESOURCE_EXHAUSTED (or the cooperative OOM pair) anywhere in the
+    cause chain — the fix for wrapped XLA errors being misclassified as
+    deterministic failures."""
+    for link in exception_chain(exc):
+        if type(link).__name__ in _OOM_TYPE_NAMES:
+            return True
+        if _status_of(link) in _OOM_CODES:
+            return True
+        s = repr(link)
+        if any(m in s for m in _OOM_MARKERS):
+            return True
+    return False
+
+
+def classify_failure(exc: BaseException) -> str:
+    """Map an exception (walking its cause chain) to a failure class."""
+    from spark_rapids_tpu.resilience.faults import (
+        InjectedCompileError,
+        InjectedTransientError,
+    )
+
+    if isinstance(exc, (KeyboardInterrupt, SystemExit, GeneratorExit)):
+        return PROPAGATE
+    for link in exception_chain(exc):
+        if type(link).__name__ in _PROPAGATE_TYPE_NAMES:
+            return PROPAGATE
+    if is_device_oom(exc):
+        return DEVICE_OOM
+    for link in exception_chain(exc):
+        if isinstance(link, InjectedTransientError):
+            return TRANSIENT
+        if isinstance(link, InjectedCompileError):
+            return DETERMINISTIC
+        code = _status_of(link)
+        if code in _TRANSIENT_CODES:
+            return TRANSIENT
+        if code == "INTERNAL":
+            # XLA INTERNAL covers both compiler bugs and runtime hiccups;
+            # the runtime ones usually mention the transport/program load
+            msg = str(link)
+            if any(m in msg for m in ("socket", "connection", "stream",
+                                      "transfer", "premature")):
+                return TRANSIENT
+            return DETERMINISTIC
+        if code in _DETERMINISTIC_CODES:
+            return DETERMINISTIC
+        if isinstance(link, (ConnectionError, TimeoutError,
+                             BrokenPipeError)):
+            return TRANSIENT
+        if isinstance(link, OSError) and link.errno in _TRANSIENT_ERRNOS:
+            # only network/interrupt-flavored OS errors may heal on their
+            # own; ENOSPC, EACCES, ENOENT etc. re-derive every retry (and
+            # retrying a disk-full spill makes the pressure worse)
+            return TRANSIENT
+    # compile / trace / type errors and anything unidentified: retrying
+    # re-derives the same failure, so treat as deterministic
+    return DETERMINISTIC

@@ -1,0 +1,246 @@
+"""The per-operator fault domain — installed around every exec iterator.
+
+Reference analog: RmmRapidsRetryIterator wraps per-batch work in the retry
+state machine (SURVEY.md §2.3); here the wrapper (hooked in by
+``exec/base.py.__init_subclass__``) also owns the non-OOM failure classes:
+
+  * DEVICE_OOM      -> spill everything unpinned and restart the operator,
+                       bounded by spark.rapids.tpu.retry.maxAttempts
+                       (delegating pressure release to memory/spill.py —
+                       the same valve with_retry uses).
+  * TRANSIENT       -> restart with exponential backoff + jitter, bounded
+                       by spark.rapids.tpu.resilience.maxTransientRetries.
+  * DETERMINISTIC   -> record the failure with the circuit breaker, then
+                       run the stage's CPU twin via fallback.py and keep
+                       the query going; re-raise when no twin exists (the
+                       parent domain falls back at its granularity).
+  * PROPAGATE       -> re-raise unchanged (ANSI errors are results).
+
+Restarts replay the operator from scratch and fast-forward past batches
+already yielded downstream — sound because stage programs are
+deterministic functions of their (re-executed) inputs.  The CPU fallback
+only engages before the first yield OR re-emits the full result when
+nothing was yielded yet; a mid-stream deterministic failure after yields
+re-raises (oracle row order is not guaranteed to match the device's, so
+splicing rows would risk duplicates) — the session-level whole-query
+fallback still catches it."""
+from __future__ import annotations
+
+import random
+import time
+from typing import Iterator
+
+from spark_rapids_tpu.resilience import classify as CL
+from spark_rapids_tpu.resilience import faults
+
+
+def _confs():
+    from spark_rapids_tpu.config import (
+        RESILIENCE_BACKOFF_BASE_MS,
+        RESILIENCE_BREAKER_THRESHOLD,
+        RESILIENCE_ENABLED,
+        RESILIENCE_MAX_TRANSIENT_RETRIES,
+        RESILIENCE_RUNTIME_FALLBACK,
+        RETRY_MAX_ATTEMPTS,
+        get_conf,
+    )
+
+    c = get_conf()
+    return {
+        "enabled": bool(c.get(RESILIENCE_ENABLED)),
+        "max_transient": int(c.get(RESILIENCE_MAX_TRANSIENT_RETRIES)),
+        "backoff_ms": float(c.get(RESILIENCE_BACKOFF_BASE_MS)),
+        "max_oom": int(c.get(RETRY_MAX_ATTEMPTS)),
+        "fallback": bool(c.get(RESILIENCE_RUNTIME_FALLBACK)),
+        "ansi": bool(c.ansi_enabled),
+        "threshold": int(c.get(RESILIENCE_BREAKER_THRESHOLD)),
+    }
+
+
+def _backoff_sleep(base_ms: float, attempt: int) -> None:
+    """base * 2^(attempt-1) + jitter in [0, base), capped at 2s."""
+    if base_ms <= 0:
+        return
+    delay = min(base_ms * (2 ** (attempt - 1)), 2000.0)
+    delay += random.random() * base_ms
+    time.sleep(delay / 1000.0)
+
+
+_KEY_UNSET = object()
+
+
+def _breaker_key_of(op):
+    """op_breaker_key, cached on the exec: the key is immutable per
+    instance, and computing it means synthesizing the CPU twin plus
+    hashing every expression — too heavy to redo on every operator
+    completion once any breaker entry exists."""
+    key = getattr(op, "_srt_breaker_key", _KEY_UNSET)
+    if key is _KEY_UNSET:
+        from spark_rapids_tpu.resilience.fallback import op_breaker_key
+
+        key = op_breaker_key(op)
+        op._srt_breaker_key = key
+    return key
+
+
+class ReplayMisalignment(Exception):
+    """A restarted operator's batch boundaries no longer line up with the
+    rows already delivered downstream (e.g. an OOM split on the first run
+    changed batch sizes).  Splicing would drop or duplicate rows, so the
+    domain re-raises to the session's whole-query fallback — and skips
+    breaker recording, since the operator did not deterministically
+    fail."""
+
+
+def run_fault_domain(op, fn, args, kwargs) -> Iterator:
+    """Drive ``fn(op, *args, **kwargs)`` (the operator's raw batch
+    iterator) inside the fault domain."""
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu.resilience.breaker import get_breaker
+    from spark_rapids_tpu.resilience.fallback import execute_fallback
+
+    conf = _confs()
+    name = op.node_name
+    if not conf["enabled"]:
+        # the injection hooks stay live so tests can demonstrate that a
+        # disabled fault domain lets failures kill the query
+        it = fn(op, *args, **kwargs)
+        try:
+            idx = 0
+            while True:
+                faults.check_fault(name, idx)
+                try:
+                    b = next(it)
+                except StopIteration:
+                    return
+                yield faults.maybe_poison(name, idx, b)
+                idx += 1
+        finally:
+            it.close()
+
+    breaker = get_breaker()
+    yielded = 0                 # batches already delivered downstream
+    yielded_rows = 0            # rows already delivered downstream
+    transient_used = 0
+    oom_used = 0
+    it = None
+    try:
+        while True:
+            try:
+                if it is None:
+                    it = fn(op, *args, **kwargs)
+                    # deterministic replay, accounted by ROWS: batch
+                    # boundaries are not stable across restarts (an OOM
+                    # split on the failed run changes batch sizes), so a
+                    # misaligned boundary bails to the whole-query
+                    # fallback instead of dropping/duplicating rows
+                    replayed = 0
+                    while replayed < yielded_rows:
+                        try:
+                            rb = next(it)
+                        except StopIteration:
+                            raise ReplayMisalignment(
+                                f"{name}: restart replayed {replayed} of "
+                                f"{yielded_rows} rows") from None
+                        replayed += rb.num_rows
+                        # the inner iterator re-counted this batch on the
+                        # way out; it was already counted when first
+                        # delivered downstream
+                        op.metric("numOutputRows").add(-rb.num_rows)
+                        op.metric("numOutputBatches").add(-1)
+                    if replayed > yielded_rows:
+                        raise ReplayMisalignment(
+                            f"{name}: restart batch boundary overshot "
+                            f"({replayed} > {yielded_rows} rows)")
+
+                faults.check_fault(name, yielded)
+                try:
+                    b = next(it)
+                except StopIteration:
+                    if breaker.has_entries():
+                        key = _breaker_key_of(op)
+                        if key is not None:
+                            breaker.record_success(key)
+                    return
+                b = faults.maybe_poison(name, yielded, b)
+            except GeneratorExit:
+                raise
+            except Exception as e:
+                kind = CL.classify_failure(e)
+                if kind == CL.PROPAGATE:
+                    raise
+                # a child domain that already exhausted its own retry
+                # budget tags the exception; retrying the whole subtree
+                # here would reset the child's counter and multiply the
+                # work exponentially with plan depth — treat as
+                # deterministic instead
+                exhausted = getattr(e, "_srt_retries_exhausted", False)
+                if kind == CL.TRANSIENT and not exhausted \
+                        and transient_used < conf["max_transient"]:
+                    transient_used += 1
+                    PC.bump("transientRetries")
+                    op.metric("transientRetries").add(1)
+                    _close_quietly(it)
+                    it = None
+                    _backoff_sleep(conf["backoff_ms"], transient_used)
+                    continue
+                if kind == CL.DEVICE_OOM and not exhausted \
+                        and oom_used < conf["max_oom"]:
+                    oom_used += 1
+                    PC.bump("oomRestarts")
+                    op.metric("retryCount").add(1)
+                    from spark_rapids_tpu.memory.spill import (
+                        get_spill_framework,
+                    )
+
+                    get_spill_framework().spill_device_pressure()
+                    _close_quietly(it)
+                    it = None
+                    continue
+                if kind in (CL.TRANSIENT, CL.DEVICE_OOM):
+                    e._srt_retries_exhausted = True
+                # deterministic (or retry budget exhausted): breaker +
+                # runtime CPU fallback
+                key = None if isinstance(e, ReplayMisalignment) \
+                    else _breaker_key_of(op)
+                if key is not None and not getattr(
+                        e, "_srt_breaker_recorded", False):
+                    tripped = breaker.record_failure(
+                        key, conf["threshold"],
+                        reason=f"{type(e).__name__}: {e}")
+                    e._srt_breaker_recorded = True
+                    if tripped:
+                        PC.bump("breakerTrips")
+                        op.metric("breakerTrips").add(1)
+                if not conf["fallback"] or yielded:
+                    raise
+                try:
+                    fb = execute_fallback(op, conf["ansi"])
+                    out = list(fb)
+                except LookupError:
+                    raise e
+                except Exception as oracle_err:
+                    # the oracle agrees this fails; surface the ORIGINAL
+                    # error so expected-error tests keep their match
+                    raise e from oracle_err
+                PC.bump("runtimeFallbacks")
+                op.metric("runtimeFallbacks").add(1)
+                _close_quietly(it)
+                it = None
+                for b2 in out:
+                    yield op._count_output(b2)
+                return
+            else:
+                yielded += 1
+                yielded_rows += b.num_rows
+                yield b
+    finally:
+        _close_quietly(it)
+
+
+def _close_quietly(it) -> None:
+    if it is not None:
+        try:
+            it.close()
+        except Exception:
+            pass

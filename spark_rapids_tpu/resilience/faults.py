@@ -1,0 +1,209 @@
+"""Chaos-injection harness — force_retry_oom generalized to every class.
+
+Reference analog: RmmSpark.forceRetryOOM / forceSplitAndRetryOOM
+(SURVEY.md §2.3 test API), which let CPU-only tests exercise the OOM
+state machine.  Here the same idea covers the whole failure taxonomy:
+
+    inject_fault("TpuSortExec", "compile")        # deterministic failure
+    inject_fault("TpuSortExec", "transient", 2)   # fails twice, then heals
+    inject_fault("TpuSortExec", "poison", seed=7) # silently corrupt output
+
+Faults are keyed by operator *node_name* (exec class name, "*" matches
+every operator) and fire inside the fault domain that wraps each
+operator's batch iterator — ``at_batch`` selects the batch ordinal so
+mid-stream failures are testable too.  Counts are decremented as faults
+fire, so a bounded-retry loop observes the fault heal deterministically.
+
+Poisoned output does NOT raise: it perturbs the numeric columns of
+the selected batch by a seed-derived delta.  It exists as the negative
+control of the chaos sweep — a harness that cannot *detect* corruption
+proves nothing when it reports oracle-equal results.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class InjectedCompileError(Exception):
+    """Injected deterministic failure (stands in for an XLA compile /
+    lowering / unsupported-dtype error)."""
+
+
+class InjectedTransientError(Exception):
+    """Injected transient runtime failure (stands in for UNAVAILABLE /
+    DEADLINE_EXCEEDED style XLA runtime errors)."""
+
+
+class _Fault:
+    __slots__ = ("operator", "kind", "count", "at_batch", "seed", "fired")
+
+    def __init__(self, operator: str, kind: str, count: int,
+                 at_batch: int, seed: int):
+        self.operator = operator
+        self.kind = kind
+        self.count = count
+        self.at_batch = at_batch
+        self.seed = seed
+        self.fired = 0
+
+
+_LOCK = threading.Lock()
+_FAULTS: List[_Fault] = []
+# (operator:kind) -> fire count of faults whose budget is spent; spent
+# _Fault objects are pruned from _FAULTS so long-lived sessions do not
+# scan an ever-growing list on every batch
+_FIRED: Dict[str, int] = {}
+# the testInject spec currently armed via arm_conf_spec (process-global,
+# like the fault list itself)
+_CONF_SPEC: Optional[str] = None
+
+KINDS = ("compile", "transient", "poison", "oom")
+
+
+def inject_fault(operator: str, kind: str, count: int = 1,
+                 at_batch: int = 0, seed: int = 0) -> None:
+    """Arm a fault at the named operator (process-global, like
+    force_retry_oom).  ``count`` fires then the fault is spent."""
+    if kind not in KINDS:
+        raise ValueError(f"unknown fault kind {kind!r} (use one of {KINDS})")
+    with _LOCK:
+        _FAULTS.append(_Fault(operator, kind, int(count),
+                              int(at_batch), int(seed)))
+
+
+def clear_faults() -> None:
+    global _CONF_SPEC
+    with _LOCK:
+        _FAULTS.clear()
+        _FIRED.clear()
+        _CONF_SPEC = None
+
+
+def active_faults() -> List[Tuple[str, str, int]]:
+    """[(operator, kind, remaining)] for faults not yet spent."""
+    with _LOCK:
+        return [(f.operator, f.kind, f.count)
+                for f in _FAULTS if f.count > 0]
+
+
+def fault_report() -> Dict[str, int]:
+    """How many times each (operator, kind) actually fired."""
+    with _LOCK:
+        out: Dict[str, int] = dict(_FIRED)
+        for f in _FAULTS:
+            if f.fired:
+                k = f"{f.operator}:{f.kind}"
+                out[k] = out.get(k, 0) + f.fired
+        return out
+
+
+def _take(op_name: str, batch_index: int, kind: str) -> Optional[_Fault]:
+    with _LOCK:
+        for i, f in enumerate(_FAULTS):
+            if f.count <= 0 or f.kind != kind:
+                continue
+            if f.operator not in (op_name, "*"):
+                continue
+            if batch_index != f.at_batch:
+                continue
+            f.count -= 1
+            f.fired += 1
+            if f.count <= 0:      # spent: fold into _FIRED and prune
+                k = f"{f.operator}:{f.kind}"
+                _FIRED[k] = _FIRED.get(k, 0) + f.fired
+                del _FAULTS[i]
+            return f
+    return None
+
+
+def check_fault(op_name: str, batch_index: int) -> None:
+    """Raise the armed compile/transient fault for this (operator, batch),
+    if any.  Called by the fault domain before pulling each batch."""
+    if not _FAULTS:
+        return
+    if _take(op_name, batch_index, "compile") is not None:
+        raise InjectedCompileError(
+            f"injected compile failure at {op_name} batch {batch_index}")
+    if _take(op_name, batch_index, "transient") is not None:
+        raise InjectedTransientError(
+            f"injected transient error at {op_name} batch {batch_index}")
+    if _take(op_name, batch_index, "oom") is not None:
+        # classified DEVICE_OOM by the status-code sniff — exercises the
+        # spill-and-restart delegation without a real allocation failure
+        raise RuntimeError(
+            f"RESOURCE_EXHAUSTED: injected device OOM at {op_name} "
+            f"batch {batch_index}")
+
+
+def maybe_poison(op_name: str, batch_index: int, batch):
+    """Return the (possibly corrupted) batch.  Perturbs every numeric
+    column by a seed-derived delta — deterministic, silent, and detectable
+    only by a differential check.  (Every column, not just the first: a
+    perturbed join key that downstream operators drop would otherwise be
+    invisible to the oracle comparison.)"""
+    if not _FAULTS:
+        return batch
+    f = _take(op_name, batch_index, "poison")
+    if f is None:
+        return batch
+    return _poison_batch(batch, f.seed)
+
+
+def _poison_batch(batch, seed: int):
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+
+    delta = 1 + (seed % 7)
+    cols = list(batch.columns)
+    for i, c in enumerate(cols):
+        if c.is_string or c.data is None:
+            continue
+        if not jnp.issubdtype(c.data.dtype, jnp.number):
+            continue
+        cols[i] = DeviceColumn(c.dtype, c.validity,
+                               data=c.data + jnp.asarray(
+                                   delta, dtype=c.data.dtype))
+    return ColumnarBatch(cols, batch.num_rows, batch.schema)
+
+
+def parse_inject_conf(spec: str) -> int:
+    """Arm faults from the ``spark.rapids.tpu.resilience.testInject`` conf:
+    ``kind:Operator[:count[:at_batch[:seed]]]`` with ``;`` separating
+    multiple faults.  Returns how many were armed."""
+    n = 0
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part or part.upper() == "NONE":
+            continue
+        bits = part.split(":")
+        if len(bits) < 2 or not bits[0] or not bits[1]:
+            raise ValueError(
+                f"bad testInject spec {part!r}: expected "
+                f"'kind:Operator[:count[:atBatch[:seed]]]'")
+        kind, operator = bits[0], bits[1]
+        count = int(bits[2]) if len(bits) > 2 else 1
+        at_batch = int(bits[3]) if len(bits) > 3 else 0
+        seed = int(bits[4]) if len(bits) > 4 else 0
+        inject_fault(operator, kind, count, at_batch, seed)
+        n += 1
+    return n
+
+
+def arm_conf_spec(spec: str) -> int:
+    """Arm the ``testInject`` conf spec exactly once per distinct value
+    (re-arming on every collect would turn a 'fails once' spec into
+    fails-every-query).  Changing the spec first de-arms whatever the
+    previous spec left behind — a fault whose operator never ran must not
+    linger and fire under the NEW spec's queries."""
+    global _CONF_SPEC
+    norm = (spec or "").strip()
+    if norm == _CONF_SPEC:
+        return 0
+    if _CONF_SPEC and _CONF_SPEC.upper() != "NONE":
+        clear_faults()
+    n = parse_inject_conf(norm)
+    _CONF_SPEC = norm
+    return n

@@ -534,8 +534,14 @@ class DataFrame:
         # is stable for the query that set it; oracle (sql-disabled)
         # sessions never clobber it
         set_conf(conf)
-        cache_key = tuple(sorted((k, str(v))
-                                 for k, v in conf.settings.items()))
+        from spark_rapids_tpu.resilience.breaker import get_breaker
+
+        # the breaker generation ticks on every planner-visible breaker
+        # transition (trip / probe / close), so a plan cached before a
+        # stage tripped is re-planned — and re-tagged to the oracle —
+        # instead of re-failing on the TPU every collect
+        cache_key = (get_breaker().generation,) + tuple(
+            sorted((k, str(v)) for k, v in conf.settings.items()))
         cached = getattr(self, "_plan_cache", None)
         if cached is not None and cached[0] == cache_key:
             return cached[1], cached[2]
@@ -575,14 +581,61 @@ class DataFrame:
                     force_retry_oom(int(n or 1))
                 elif kind.upper() == "SPLIT":
                     force_split_and_retry_oom(int(n or 1))
+            # chaos injection (the force_retry_oom API generalized to
+            # compile/transient/poison faults at named operators); armed
+            # once per distinct spec, process-global like the fault list
+            from spark_rapids_tpu.config import RESILIENCE_TEST_INJECT
+            from spark_rapids_tpu.resilience.faults import arm_conf_spec
+
+            arm_conf_spec(self.session.conf.get(RESILIENCE_TEST_INJECT))
             sem = get_semaphore(self.session.conf.concurrent_tpu_tasks)
-            with sem.scope():
-                host = TpuColumnarToRowExec(root).collect_host()
+            try:
+                with sem.scope():
+                    host = TpuColumnarToRowExec(root).collect_host()
+            except Exception as e:
+                host = self._query_fallback(e)
             lists = [h.to_pylist() for h in host]
             return list(zip(*lists)) if lists else []
         cols, n = execute_cpu_plan(root, ansi=self.session.conf.ansi_enabled)
         lists = [c.to_pylist() for c in cols]
         return list(zip(*lists)) if lists else []
+
+    def _query_fallback(self, exc: Exception):
+        """Whole-query oracle fallback of last resort: a deterministic
+        failure that escaped every stage-level fault domain (e.g. a stage
+        with no CPU twin, or a mid-stream failure after yields) re-runs
+        the ORIGINAL logical plan on the CPU oracle — the runtime analog
+        of spark.rapids.sql.enabled=false.  Semantic errors (ANSI,
+        FAILFAST) and recoverable classes re-raise unchanged; if the
+        oracle also fails, the original device error stays primary."""
+        from spark_rapids_tpu import perfcounters as PC
+        from spark_rapids_tpu.config import (
+            RESILIENCE_ENABLED,
+            RESILIENCE_RUNTIME_FALLBACK,
+        )
+        from spark_rapids_tpu.cpu.oracle import execute_cpu_plan
+        from spark_rapids_tpu.resilience.classify import (
+            DETERMINISTIC,
+            classify_failure,
+        )
+
+        conf = self.session.conf
+        if not (conf.get(RESILIENCE_ENABLED)
+                and conf.get(RESILIENCE_RUNTIME_FALLBACK)):
+            raise exc
+        # a transient/OOM failure whose retry budget a stage domain
+        # already exhausted is as good as deterministic here — retrying
+        # the whole query would re-derive the same exhaustion
+        if classify_failure(exc) != DETERMINISTIC \
+                and not getattr(exc, "_srt_retries_exhausted", False):
+            raise exc
+        try:
+            cols, _n = execute_cpu_plan(self.plan,
+                                        ansi=conf.ansi_enabled)
+        except Exception as oracle_err:
+            raise exc from oracle_err
+        PC.bump("queryFallbacks")
+        return [c.to_host() for c in cols]
 
     def to_pydict(self) -> Dict[str, list]:
         rows = self.collect()

@@ -51,7 +51,16 @@ def _codec_pair(codec: Optional[str]):
     if c in ("none", "uncompressed"):
         return (lambda b: b), (lambda b: b)
     if c in ("zstd", "lz4"):  # lz4 aliases to zstd (no lz4 binding in image)
-        import zstandard
+        try:
+            import zstandard
+        except ImportError:
+            # degrade to stdlib zlib instead of failing every exchange at
+            # runtime on images without the zstandard wheel (writer and
+            # reader resolve the codec through this same gate, so both
+            # sides of a shuffle agree within a process)
+            import zlib
+
+            return (lambda b: zlib.compress(b, 1)), zlib.decompress
 
         cctx = zstandard.ZstdCompressor(level=1)
         dctx = zstandard.ZstdDecompressor()

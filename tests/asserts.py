@@ -41,8 +41,17 @@ def assert_tpu_and_cpu_are_equal_collect(
         conf: Optional[dict] = None,
         ignore_order: bool = True,
         approximate_float: bool = False,
-        float_digits: int = 12):
-    """Run the query with the TPU plan rewrite on and off; compare rows."""
+        float_digits: int = 12,
+        allow_runtime_fallback: bool = False):
+    """Run the query with the TPU plan rewrite on and off; compare rows.
+
+    By default the TPU run must complete WITHOUT a resilience runtime
+    fallback: the fault domain (resilience/) would otherwise silently
+    reroute a crashing TPU operator to the very oracle we compare
+    against, making the differential vacuous.  Chaos tests that exercise
+    the fallback on purpose pass ``allow_runtime_fallback=True``."""
+    from spark_rapids_tpu import perfcounters as PC
+
     conf = dict(conf or {})
     cpu_conf = dict(conf)
     cpu_conf["spark.rapids.sql.enabled"] = False
@@ -50,7 +59,19 @@ def assert_tpu_and_cpu_are_equal_collect(
     tpu_conf["spark.rapids.sql.enabled"] = True
 
     cpu_rows = build_df(TpuSession(cpu_conf)).collect()
+    snap = PC.snapshot()
     tpu_rows = build_df(TpuSession(tpu_conf)).collect()
+    if not allow_runtime_fallback:
+        delta = PC.since(snap)
+        silently_degraded = {
+            k: delta[k] for k in ("runtimeFallbacks", "queryFallbacks",
+                                  "breakerPlanFallbacks")
+            if delta.get(k)}
+        assert not silently_degraded, (
+            f"TPU run silently degraded to the CPU oracle "
+            f"({silently_degraded}) — the differential comparison would "
+            f"be vacuous; fix the TPU failure or pass "
+            f"allow_runtime_fallback=True")
 
     if ignore_order:
         ck = _rows_key(cpu_rows, approximate_float, float_digits)

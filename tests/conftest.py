@@ -29,6 +29,29 @@ if os.environ.get("SRT_TEST_ON_TPU") != "1":
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 'not slow' run")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection sweep tests "
+        "(tools/run_chaos.py runs these standalone)")
+
+
+@pytest.fixture(autouse=True)
+def _resilience_isolation():
+    """The fault list and circuit breaker are process-global: an entry a
+    failing test trips would route matching stages of every LATER test to
+    the CPU oracle at plan time, turning their differential comparisons
+    into vacuous CPU-vs-CPU checks.  Reset around every test."""
+    from spark_rapids_tpu.resilience import clear_faults, reset_breaker
+
+    clear_faults()
+    reset_breaker()
+    yield
+    clear_faults()
+    reset_breaker()
+
+
 @pytest.fixture
 def tpu_session():
     from spark_rapids_tpu.session import TpuSession

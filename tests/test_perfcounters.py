@@ -92,3 +92,30 @@ def test_session_applies_compile_cache_conf():
         assert jax.config.jax_compilation_cache_dir.startswith(other)
     S._COMPILE_CACHE_APPLIED = None
     S.TpuSession({})      # restore the default for the rest of the suite
+
+
+def test_concurrent_increments_lose_nothing():
+    """COUNTERS[k] += n is three bytecodes; unguarded concurrent
+    increments can lose updates at thread switches.  Every write now
+    routes through PC.bump's lock — N threads x M bumps must land
+    exactly."""
+    import threading
+
+    snap = PC.snapshot()
+    threads = 8
+    per_thread = 5000
+
+    def worker():
+        for _ in range(per_thread):
+            PC.bump("transientRetries")
+            PC.bump("bytes_h2d", 3)
+
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    d = PC.since(snap)
+    assert d["transientRetries"] == threads * per_thread
+    assert d["bytes_h2d"] == threads * per_thread * 3
+    PC.reset()

@@ -1,0 +1,584 @@
+"""Stage-level fault domain tests: failure classification, bounded
+transient retry, runtime CPU fallback, circuit breaker lifecycle, and the
+chaos-injection harness.
+
+Reference analogs: WithRetrySuite (forced OOMs) generalized to every
+failure class, and the CPU-fallback posture of SURVEY.md §2.3/§5.3.
+All CPU-only, tier-1 safe."""
+import threading
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu import perfcounters as PC
+from spark_rapids_tpu.resilience import (
+    DETERMINISTIC,
+    DEVICE_OOM,
+    PROPAGATE,
+    TRANSIENT,
+    classify_failure,
+    clear_faults,
+    get_breaker,
+    inject_fault,
+    is_device_oom,
+    reset_breaker,
+)
+from spark_rapids_tpu.resilience.faults import (
+    InjectedCompileError,
+    InjectedTransientError,
+    active_faults,
+    parse_inject_conf,
+)
+from spark_rapids_tpu.session import TpuSession, col, sum_
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+
+
+FAST = {"spark.rapids.tpu.resilience.backoffBaseMs": "0"}
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    clear_faults()
+    reset_breaker()
+    PC.reset()
+    yield
+    clear_faults()
+    reset_breaker()
+
+
+def _schema():
+    return T.StructType([T.StructField("k", T.INT),
+                         T.StructField("v", T.LONG)])
+
+
+def _df(s, n=64):
+    return s.create_dataframe(
+        {"k": [i % 4 for i in range(n)], "v": list(range(n))}, _schema())
+
+
+def _sorted_query(s):
+    return _df(s).filter(col("v") < 50).order_by("k", "v")
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+class XlaRuntimeError(RuntimeError):
+    """Name-matched stand-in for jaxlib's XlaRuntimeError."""
+
+
+def _wrap(inner):
+    try:
+        try:
+            raise inner
+        except Exception as e:
+            raise RuntimeError("stage dispatch failed") from e
+    except RuntimeError as outer:
+        return outer
+
+
+def test_classify_wrapped_resource_exhausted_is_oom():
+    e = _wrap(XlaRuntimeError("RESOURCE_EXHAUSTED: out of memory "
+                              "allocating 123 bytes"))
+    assert is_device_oom(e)
+    assert classify_failure(e) == DEVICE_OOM
+
+
+def test_classify_context_only_chain():
+    # __context__ (no explicit from) must be walked too
+    try:
+        try:
+            raise XlaRuntimeError("RESOURCE_EXHAUSTED: oom")
+        except XlaRuntimeError:
+            raise RuntimeError("cleanup path failed")
+    except RuntimeError as e:
+        assert classify_failure(e) == DEVICE_OOM
+
+
+def test_classify_transient_codes():
+    for code in ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED"):
+        e = _wrap(XlaRuntimeError(f"{code}: transport hiccup"))
+        assert classify_failure(e) == TRANSIENT, code
+
+
+def test_classify_deterministic():
+    assert classify_failure(TypeError("unsupported dtype")) == DETERMINISTIC
+    e = _wrap(XlaRuntimeError("INVALID_ARGUMENT: bad HLO"))
+    assert classify_failure(e) == DETERMINISTIC
+    assert classify_failure(InjectedCompileError("x")) == DETERMINISTIC
+    assert classify_failure(InjectedTransientError("x")) == TRANSIENT
+
+
+def test_classify_semantic_errors_propagate():
+    from spark_rapids_tpu.expr.base import SparkArithmeticException
+
+    assert classify_failure(
+        SparkArithmeticException("overflow")) == PROPAGATE
+
+
+def test_classify_suppressed_context_not_walked():
+    """``raise X from None`` declares the in-flight exception unrelated —
+    a cleanup error raised while handling an OOM must not inherit the
+    OOM's class when explicitly disowned."""
+    try:
+        try:
+            raise XlaRuntimeError("RESOURCE_EXHAUSTED: oom")
+        except XlaRuntimeError:
+            raise RuntimeError("unrelated cleanup bug") from None
+    except RuntimeError as e:
+        assert classify_failure(e) == DETERMINISTIC
+        assert not is_device_oom(e)
+
+
+def test_classify_oserror_by_errno():
+    import errno
+
+    assert classify_failure(OSError(errno.ECONNRESET, "reset")) == TRANSIENT
+    # ENOSPC / EACCES re-derive on every retry (and retrying a disk-full
+    # spill makes the pressure worse) — deterministic
+    assert classify_failure(
+        OSError(errno.ENOSPC, "disk full")) == DETERMINISTIC
+    assert classify_failure(
+        PermissionError(errno.EACCES, "denied")) == DETERMINISTIC
+
+
+def test_exhausted_child_budget_not_retried_by_parent():
+    """An exception a child domain tagged as budget-exhausted must not be
+    retried again upstream — otherwise restarts multiply exponentially
+    with plan depth."""
+    from spark_rapids_tpu.config import set_conf
+    from spark_rapids_tpu.resilience.domain import run_fault_domain
+
+    class _Op:
+        node_name = "FakeOp"
+
+        def metric(self, name):
+            class _M:
+                def add(self, v):
+                    pass
+            return _M()
+
+    calls = [0]
+
+    def fn(op):
+        calls[0] += 1
+        err = InjectedTransientError("child already retried this")
+        err._srt_retries_exhausted = True
+        raise err
+        yield  # pragma: no cover
+
+    set_conf(TpuSession(FAST).conf)
+    with pytest.raises(InjectedTransientError):
+        list(run_fault_domain(_Op(), fn, (), {}))
+    assert calls[0] == 1           # no transient restarts
+    assert PC.snapshot()["transientRetries"] == 0
+
+
+def test_retry_is_device_oom_walks_chain():
+    from spark_rapids_tpu.memory.retry import _is_device_oom
+
+    assert _is_device_oom(_wrap(XlaRuntimeError("RESOURCE_EXHAUSTED: x")))
+    assert not _is_device_oom(_wrap(XlaRuntimeError("INVALID_ARGUMENT: x")))
+
+
+# ---------------------------------------------------------------------------
+# transient retry / OOM delegation / runtime fallback
+# ---------------------------------------------------------------------------
+
+def test_transient_fault_retries_and_matches_oracle():
+    inject_fault("TpuSortExec", "transient")
+    assert_tpu_and_cpu_are_equal_collect(_sorted_query, conf=FAST,
+                                         ignore_order=False)
+    assert PC.snapshot()["transientRetries"] == 1
+    assert PC.snapshot()["runtimeFallbacks"] == 0
+
+
+def test_compile_fault_falls_back_and_matches_oracle():
+    inject_fault("TpuSortExec", "compile")
+    assert_tpu_and_cpu_are_equal_collect(_sorted_query, conf=FAST,
+                                         ignore_order=False,
+                                         allow_runtime_fallback=True)
+    assert PC.snapshot()["runtimeFallbacks"] >= 1
+
+
+def test_injected_oom_spills_and_restarts():
+    inject_fault("TpuSortExec", "oom")
+    assert_tpu_and_cpu_are_equal_collect(_sorted_query, conf=FAST,
+                                         ignore_order=False)
+    assert PC.snapshot()["runtimeFallbacks"] == 0
+
+
+def test_exhausted_transient_escalates_to_fallback():
+    inject_fault("TpuSortExec", "transient", count=99)
+    conf = dict(FAST)
+    conf["spark.rapids.tpu.resilience.maxTransientRetries"] = "2"
+    assert_tpu_and_cpu_are_equal_collect(_sorted_query, conf=conf,
+                                         ignore_order=False,
+                                         allow_runtime_fallback=True)
+    assert PC.snapshot()["transientRetries"] == 2
+    assert PC.snapshot()["runtimeFallbacks"] >= 1
+
+
+def test_disabled_resilience_lets_fault_kill_query():
+    inject_fault("TpuSortExec", "compile")
+    conf = {"spark.rapids.tpu.resilience.enabled": "false"}
+    with pytest.raises(InjectedCompileError):
+        _sorted_query(TpuSession(conf)).collect()
+
+
+def test_fallback_disabled_raises():
+    inject_fault("TpuSortExec", "compile")
+    conf = dict(FAST)
+    conf["spark.rapids.tpu.resilience.runtimeFallbackEnabled"] = "false"
+    with pytest.raises(InjectedCompileError):
+        _sorted_query(TpuSession(conf)).collect()
+
+
+def test_midstream_transient_restart_replays_correctly():
+    conf = dict(FAST)
+    conf["spark.rapids.sql.reader.batchSizeRows"] = "16"  # multi-batch
+    inject_fault("TpuProjectExec", "transient", at_batch=1)
+
+    def q(s):
+        return _df(s, 64).select(col("k"), (col("v") * 2).alias("d"))
+
+    assert_tpu_and_cpu_are_equal_collect(q, conf=conf)
+    assert PC.snapshot()["transientRetries"] == 1
+
+
+def test_midstream_deterministic_uses_query_fallback():
+    conf = dict(FAST)
+    conf["spark.rapids.sql.reader.batchSizeRows"] = "16"
+    inject_fault("TpuProjectExec", "compile", at_batch=1)
+
+    def q(s):
+        return _df(s, 64).select(col("k"), (col("v") * 2).alias("d"))
+
+    assert_tpu_and_cpu_are_equal_collect(q, conf=conf,
+                                         allow_runtime_fallback=True)
+    assert PC.snapshot()["queryFallbacks"] == 1
+
+
+def test_per_op_metrics_report_path_taken():
+    inject_fault("TpuSortExec", "transient")
+    s = TpuSession(FAST)
+    df = _sorted_query(s)
+    df.collect()
+    root, _ = df._planned()
+    m = root.collect_metrics()
+    assert m.get("TpuSortExec.transientRetries", 0) == 1
+
+    clear_faults()
+    inject_fault("TpuSortExec", "compile")
+    df2 = _sorted_query(TpuSession(FAST))
+    df2.collect()
+    root2, _ = df2._planned()
+    m2 = root2.collect_metrics()
+    assert m2.get("TpuSortExec.runtimeFallbacks", 0) == 1
+
+
+def test_conf_driven_injection():
+    conf = dict(FAST)
+    conf["spark.rapids.tpu.resilience.testInject"] = \
+        "transient:TpuSortExec:1"
+    rows = _sorted_query(TpuSession(conf)).collect()
+    oracle = _sorted_query(
+        TpuSession({"spark.rapids.sql.enabled": False})).collect()
+    assert rows == oracle
+    assert PC.snapshot()["transientRetries"] == 1
+
+
+def test_parse_inject_conf_spec():
+    assert parse_inject_conf("NONE") == 0
+    assert parse_inject_conf("") == 0
+    n = parse_inject_conf("compile:TpuSortExec;poison:TpuProjectExec:2:1:7")
+    assert n == 2
+    kinds = {(op, k) for op, k, _ in active_faults()}
+    assert ("TpuSortExec", "compile") in kinds
+    assert ("TpuProjectExec", "poison") in kinds
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+BRK = dict(FAST)
+BRK["spark.rapids.tpu.resilience.breakerFailureThreshold"] = "2"
+
+
+def _oracle_rows():
+    return _sorted_query(
+        TpuSession({"spark.rapids.sql.enabled": False})).collect()
+
+
+def test_breaker_trips_and_tags_plan_time():
+    oracle = _oracle_rows()
+    for _ in range(2):
+        inject_fault("TpuSortExec", "compile")
+        assert _sorted_query(TpuSession(BRK)).collect() == oracle
+    assert PC.snapshot()["breakerTrips"] == 1
+    snap = get_breaker().snapshot()
+    assert len(snap) == 1 and snap[0][1] == "OPEN"
+    assert snap[0][0][0] == "Sort"     # plan-node class name keys the entry
+
+    # next query: the Sort stage is tagged to the oracle at PLAN time —
+    # an armed fault never fires because TpuSortExec never runs
+    inject_fault("TpuSortExec", "compile")
+    PC.reset()
+    df = _sorted_query(TpuSession(BRK))
+    assert df.collect() == oracle
+    assert PC.snapshot()["runtimeFallbacks"] == 0
+    assert PC.snapshot()["queryFallbacks"] == 0
+    assert active_faults() == [("TpuSortExec", "compile", 1)]
+    assert "circuit breaker open" in df.explain()
+
+
+def test_breaker_ttl_half_open_readmits():
+    oracle = _oracle_rows()
+    for _ in range(2):
+        inject_fault("TpuSortExec", "compile")
+        _sorted_query(TpuSession(BRK)).collect()
+    b = get_breaker()
+    assert b.snapshot()[0][1] == "OPEN"
+    key = b.snapshot()[0][0]
+
+    clock = [0.0]
+    b._now = lambda: clock[0]
+    b._entries[key].opened_at = 0.0
+    clock[0] = 9999.0          # past the 300s TTL
+
+    # half-open probe: the stage runs on TPU again and, succeeding,
+    # closes the breaker entirely
+    PC.reset()
+    assert _sorted_query(TpuSession(BRK)).collect() == oracle
+    assert PC.snapshot()["runtimeFallbacks"] == 0
+    assert b.snapshot() == []
+
+
+def test_breaker_half_open_failure_reopens():
+    for _ in range(2):
+        inject_fault("TpuSortExec", "compile")
+        _sorted_query(TpuSession(BRK)).collect()
+    b = get_breaker()
+    key = b.snapshot()[0][0]
+    clock = [1000.0]
+    b._now = lambda: clock[0]
+    b._entries[key].opened_at = 0.0   # TTL expired
+
+    inject_fault("TpuSortExec", "compile")   # the probe fails again
+    oracle = _oracle_rows()
+    assert _sorted_query(TpuSession(BRK)).collect() == oracle
+    assert b.state_of(key) == "OPEN"
+    assert b._entries[key].opened_at == 1000.0   # fresh TTL
+
+
+def test_breaker_keyed_by_expression_fingerprint():
+    # a Sort on DIFFERENT keys must not be banished by this Sort's entry
+    for _ in range(2):
+        inject_fault("TpuSortExec", "compile")
+        _sorted_query(TpuSession(BRK)).collect()
+
+    def other_sort(s):
+        return _df(s).order_by("v")
+
+    PC.reset()
+    assert_tpu_and_cpu_are_equal_collect(other_sort, conf=BRK,
+                                         ignore_order=False)
+    # ran on TPU (no fallback, no new trip)
+    assert PC.snapshot()["breakerTrips"] == 0
+    assert PC.snapshot()["runtimeFallbacks"] == 0
+
+
+def test_breaker_half_open_stalled_probe_readmits():
+    """A probe that never resolves (LIMIT short-circuit: no StopIteration,
+    no record_success) must not pin the stage to CPU forever — after
+    another TTL the registry re-admits a fresh probe."""
+    from spark_rapids_tpu.resilience.breaker import CircuitBreakerRegistry
+
+    clock = [0.0]
+    b = CircuitBreakerRegistry(now=lambda: clock[0])
+    key = ("Sort", "fp")
+    b.record_failure(key, threshold=1)
+    assert b.state_of(key) == "OPEN"
+
+    clock[0] = 400.0
+    assert b.consult(key, ttl_sec=300.0) is None    # probe admitted
+    assert b.state_of(key) == "HALF_OPEN"
+    # probe never resolves; within the TTL further plans stay on CPU
+    clock[0] = 500.0
+    assert "probe in flight" in b.consult(key, ttl_sec=300.0)
+    # ... but a full TTL later another probe is admitted
+    clock[0] = 701.0
+    assert b.consult(key, ttl_sec=300.0) is None
+
+
+def test_breaker_trip_invalidates_cached_plan():
+    """The same DataFrame object re-plans after its stage trips the
+    breaker mid-collect: the second collect routes the Sort to the oracle
+    at plan time instead of re-failing on the TPU."""
+    conf = dict(FAST)
+    conf["spark.rapids.tpu.resilience.breakerFailureThreshold"] = "1"
+    oracle = _oracle_rows()
+    s = TpuSession(conf)
+    df = _sorted_query(s)
+
+    inject_fault("TpuSortExec", "compile")
+    assert df.collect() == oracle          # trips (threshold 1) + falls back
+    assert PC.snapshot()["breakerTrips"] == 1
+
+    PC.reset()
+    inject_fault("TpuSortExec", "compile")   # would fire if Sort ran on TPU
+    assert df.collect() == oracle            # same DataFrame, cached plan
+    assert PC.snapshot()["runtimeFallbacks"] == 0
+    assert PC.snapshot()["queryFallbacks"] == 0
+    assert active_faults() == [("TpuSortExec", "compile", 1)]
+
+
+def test_conf_injection_arms_once_per_session():
+    """testInject='...:1' means the session fails ONCE — a second collect
+    must not re-arm the spent fault."""
+    conf = dict(FAST)
+    conf["spark.rapids.tpu.resilience.testInject"] = \
+        "transient:TpuSortExec:1"
+    s = TpuSession(conf)
+    df = _sorted_query(s)
+    df.collect()
+    df.collect()
+    assert PC.snapshot()["transientRetries"] == 1
+
+
+def test_changing_inject_spec_disarms_previous():
+    """A conf-armed fault whose operator never ran must not linger and
+    fire once a session with a DIFFERENT spec starts collecting."""
+    c1 = dict(FAST)
+    c1["spark.rapids.tpu.resilience.testInject"] = "compile:TpuSortExec:1"
+    _df(TpuSession(c1)).select(col("v").alias("x")).collect()  # no Sort
+    assert active_faults() == [("TpuSortExec", "compile", 1)]
+
+    c2 = dict(FAST)
+    c2["spark.rapids.tpu.resilience.testInject"] = \
+        "transient:TpuSortExec:1"
+    rows = _sorted_query(TpuSession(c2)).collect()
+    assert rows == _oracle_rows()
+    # the stale compile fault was de-armed, not fired as a fallback
+    assert PC.snapshot()["runtimeFallbacks"] == 0
+    assert PC.snapshot()["transientRetries"] == 1
+
+
+def test_asserts_guard_detects_plan_time_breaker_routing():
+    """An open breaker entry routes the stage to the oracle at PLAN time
+    (no runtime-fallback counter fires) — the differential assert must
+    still refuse the silently vacuous comparison."""
+    for _ in range(2):
+        inject_fault("TpuSortExec", "compile")
+        _sorted_query(TpuSession(BRK)).collect()
+    assert get_breaker().snapshot()[0][1] == "OPEN"
+    with pytest.raises(AssertionError, match="silently degraded"):
+        assert_tpu_and_cpu_are_equal_collect(_sorted_query, conf=BRK,
+                                             ignore_order=False)
+
+
+def test_replay_misalignment_bails_to_query_fallback():
+    """Restart replay is accounted by rows; a batch boundary that no
+    longer lines up must raise (whole-query fallback handles it), never
+    drop or duplicate rows."""
+    from spark_rapids_tpu.resilience.domain import (
+        ReplayMisalignment,
+        run_fault_domain,
+    )
+
+    class _B:
+        def __init__(self, n):
+            self.num_rows = n
+
+    class _Op:
+        node_name = "FakeOp"
+
+        def metric(self, name):
+            class _M:
+                def add(self, v):
+                    pass
+            return _M()
+
+    runs = [0]
+
+    def fn(op):
+        runs[0] += 1
+        if runs[0] == 1:
+            yield _B(2)
+            raise InjectedTransientError("hiccup")
+        yield _B(3)               # boundary moved: 3 rows where 2 were
+        yield _B(2)
+
+    from spark_rapids_tpu.config import set_conf
+
+    set_conf(TpuSession(FAST).conf)   # ambient conf: no backoff sleeps
+    it = run_fault_domain(_Op(), fn, (), {})
+    assert next(it).num_rows == 2
+    with pytest.raises(ReplayMisalignment):
+        next(it)
+
+
+def test_breaker_disabled_with_resilience_off():
+    b = get_breaker()
+    b.record_failure(("Sort", "x"), threshold=1)
+    conf = {"spark.rapids.tpu.resilience.enabled": "false"}
+    df = _sorted_query(TpuSession(conf))
+    assert "circuit breaker" not in df.explain()
+
+
+# ---------------------------------------------------------------------------
+# with_retry generator cleanup (satellite)
+# ---------------------------------------------------------------------------
+
+def _mini_framework():
+    from spark_rapids_tpu.memory.spill import SpillFramework
+
+    return SpillFramework(pool_bytes=1 << 30, host_limit=1 << 30,
+                          spill_dir=None)
+
+
+def _mini_batch(n=8):
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+
+    return ColumnarBatch.from_pydict(
+        {"a": list(range(n))},
+        T.StructType([T.StructField("a", T.LONG)]))
+
+
+def test_with_retry_closes_queue_on_abandon(monkeypatch):
+    import spark_rapids_tpu.memory.spill as spill_mod
+    from spark_rapids_tpu.memory.retry import with_retry
+
+    fw = _mini_framework()
+    monkeypatch.setattr(spill_mod, "_framework", fw)
+    items = [fw.track(_mini_batch()) for _ in range(4)]
+    gen = with_retry(list(items), lambda b: b.num_rows)
+    assert next(gen) == 8
+    gen.close()                       # consumer abandons early
+    assert all(i.closed for i in items), \
+        [(n, i.closed) for n, i in enumerate(items)]
+
+
+def test_with_retry_closes_queue_on_error(monkeypatch):
+    import spark_rapids_tpu.memory.spill as spill_mod
+    from spark_rapids_tpu.memory.retry import with_retry
+
+    fw = _mini_framework()
+    monkeypatch.setattr(spill_mod, "_framework", fw)
+    items = [fw.track(_mini_batch()) for _ in range(3)]
+    calls = [0]
+
+    def fn(b):
+        calls[0] += 1
+        if calls[0] == 2:
+            raise ValueError("boom")        # non-OOM: no retry
+        return b.num_rows
+
+    gen = with_retry(list(items), fn)
+    assert next(gen) == 8
+    with pytest.raises(ValueError):
+        next(gen)
+    assert all(i.closed for i in items)

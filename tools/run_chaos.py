@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Chaos sweep CLI — run the (operator x failure class) injection matrix
+over the representative join+agg+sort+expr query and print a summary.
+
+    python tools/run_chaos.py [--seed 7] [--shape broadcast|shuffled|all]
+
+For every planned exec operator and every failure class (compile,
+transient, oom, poison) one query runs with that single fault armed; the
+table reports whether the run matched the CPU oracle and which resilience
+path (retry / oom-restart / stage-fallback / query-fallback / breaker)
+absorbed the fault.  Poison rows are the negative control: DETECTED means
+the corrupted output diverged from the oracle, proving the harness'
+oracle-equality checks can see silent corruption.
+
+Exit code 0 iff every non-poison cell is PASS and every poison cell is
+DETECTED.  Deterministically seeded; CPU-only (same virtual-device setup
+as the tier-1 suite).
+"""
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, os.pardir))
+sys.path.insert(0, os.path.join(_HERE, os.pardir, "tests"))
+xf = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in xf:
+    os.environ["XLA_FLAGS"] = (
+        xf + " --xla_force_host_platform_device_count=8").strip()
+if os.environ.get("SRT_TEST_ON_TPU") != "1":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# the query matrix is owned by the pytest sweep — importing it keeps the
+# CLI and tier-1 validating the SAME (shape x operator x fault) cells
+from test_chaos_sweep import (  # noqa: E402
+    SHAPES,
+    build_query,
+    planned_op_names as planned_ops,
+)
+
+KINDS = ("compile", "transient", "oom", "poison")
+
+
+def run_cell(conf, op, kind, seed):
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu.resilience import (
+        clear_faults,
+        inject_fault,
+        reset_breaker,
+    )
+    from spark_rapids_tpu.resilience.faults import fault_report
+    from spark_rapids_tpu.session import TpuSession
+
+    cpu_conf = dict(conf)
+    cpu_conf["spark.rapids.sql.enabled"] = False
+    oracle = sorted(build_query(TpuSession(cpu_conf)).collect())
+
+    clear_faults()
+    reset_breaker()
+    PC.reset()
+    inject_fault(op, kind, seed=seed)
+    try:
+        rows = sorted(build_query(TpuSession(conf)).collect())
+        err = None
+    except Exception as e:          # noqa: BLE001 — report, don't die
+        rows, err = None, e
+    d = PC.snapshot()
+    fired = bool(fault_report())
+    clear_faults()
+
+    path = []
+    if d["transientRetries"]:
+        path.append(f"retry x{d['transientRetries']}")
+    if d["oomRestarts"]:
+        path.append(f"oom-restart x{d['oomRestarts']}")
+    if d["runtimeFallbacks"]:
+        path.append(f"stage-fallback x{d['runtimeFallbacks']}")
+    if d["queryFallbacks"]:
+        path.append("query-fallback")
+    if d["breakerTrips"]:
+        path.append("breaker-trip")
+    path = ", ".join(path) or ("-" if fired else "not-executed")
+
+    if err is not None:
+        return "ERROR", f"{type(err).__name__}: {err}"
+    equal = rows == oracle
+    if kind == "poison":
+        if not fired:
+            return "SKIP", path
+        return ("DETECTED" if not equal else "MISSED"), path
+    return ("PASS" if equal else "DIVERGED"), path
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--shape", default="all",
+                    choices=["all"] + sorted(SHAPES))
+    args = ap.parse_args()
+
+    shapes = sorted(SHAPES) if args.shape == "all" else [args.shape]
+    ok = True
+    for shape in shapes:
+        conf = SHAPES[shape]
+        ops = planned_ops(conf)
+        print(f"\n== shape: {shape} ({len(ops)} operators) ==")
+        print(f"{'operator':34s} {'fault':10s} {'outcome':9s} path")
+        print("-" * 78)
+        totals = {}
+        for op in ops:
+            for kind in KINDS:
+                outcome, path = run_cell(conf, op, kind, args.seed)
+                totals[kind] = totals.get(kind, {})
+                totals[kind][outcome] = totals[kind].get(outcome, 0) + 1
+                print(f"{op:34s} {kind:10s} {outcome:9s} {path}")
+                if outcome in ("DIVERGED", "ERROR", "MISSED"):
+                    ok = False
+        print("-" * 78)
+        for kind in KINDS:
+            cells = ", ".join(f"{k}={v}"
+                              for k, v in sorted(totals[kind].items()))
+            print(f"  {kind:10s} {cells}")
+    print("\nchaos sweep:", "OK" if ok else "FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
