@@ -31,7 +31,11 @@ seconds (default 2400) — on this compile-tunnel dev platform every
 program costs ~20-60s+ to compile, so the suite emits its JSON line from
 whatever completed inside the budget instead of dying at an outer
 timeout with nothing (each completed query is timed fully; skipped ones
-are listed under "skipped").
+are listed under "skipped").  BENCH_OUT (default BENCH_STREAM.json, "0"
+disables) streams per-query results to a JSON file as each query
+completes — a `timeout` SIGKILL mid-suite still leaves a parseable
+record of everything finished; per-query counters now include
+compileWall_s and the compile-cache hit/miss counts.
 
 Query order (VERDICT r4 weak #2): q6 -> qa -> qb -> qc -> rung3 ->
 q6_parquet, so a budget kill can no longer erase the window or spill
@@ -332,6 +336,13 @@ def _time_repeats(fn, repeats, counters=False):
         "bytesD2H": d["bytes_d2h"] / repeats,
         "bytesH2D": d["bytes_h2d"] / repeats,
         "launchWall_s": d["launch_wall_ns"] / repeats / 1e9,
+        # compile-cache detail (compilecache/): wall spent in fresh XLA
+        # compiles (inline + AOT pool) and registry hit/miss counts — on
+        # the tunnel platform compileWall_s is where cold-start time goes
+        "compileWall_s": d["compile_wall_ns"] / repeats / 1e9,
+        "aotCompileWall_s": d["aot_compile_wall_ns"] / repeats / 1e9,
+        "nCompileCacheHits": d["compile_cache_hits"] / repeats,
+        "nCompileCacheMisses": d["compile_cache_misses"] / repeats,
     }
     return dt, out, per_run
 
@@ -409,30 +420,31 @@ def main():
         print(f"[bench {time.perf_counter() - t_start:7.1f}s] {msg}",
               file=sys.stderr, flush=True)
 
-    def emit():
-        if emitted["done"]:
-            return
-        emitted["done"] = True
+    def _payload(partial: bool):
+        import copy
+
+        qs = copy.deepcopy(queries)
         rung2 = [q for q in ("qa_join_agg_hot", "qb_left_join_hot",
-                             "qc_window_hot") if q in queries]
-        geo_vec = (math.exp(sum(math.log(queries[q]["vs_vec"])
+                             "qc_window_hot") if q in qs]
+        geo_vec = (math.exp(sum(math.log(qs[q]["vs_vec"])
                                 for q in rung2) / len(rung2))
                    if rung2 else 0.0)
-        rung2_scan = [q for q in ("qa_join_agg_scan",) if q in queries]
-        geo_scan = (math.exp(sum(math.log(queries[q]["vs_vec"])
+        rung2_scan = [q for q in ("qa_join_agg_scan",) if q in qs]
+        geo_scan = (math.exp(sum(math.log(qs[q]["vs_vec"])
                                  for q in rung2_scan) / len(rung2_scan))
                     if rung2_scan else 0.0)
-        for q in queries.values():
+        for q in qs.values():
             q["hbm_frac"] = q["eff_gbps"] / V5E_HBM_GBPS
             for k in list(q):
                 q[k] = round(q[k], 6)
-        print(json.dumps({
+        return {
             "metric": "tpcds_mini_geomean_speedup_vs_vectorized_cpu",
             "value": round(geo_vec, 3),
             "unit": "x",
             "vs_baseline": round(geo_vec, 3),
             "rows": n,
-            "skipped_on_time_budget": skipped,
+            "partial": partial,
+            "skipped_on_time_budget": list(skipped),
             "scan_inclusive_geomean": round(geo_scan, 3),
             "hbm_roofline_gbps": V5E_HBM_GBPS,
             "note": ("vs_baseline = geomean TPU speedup over "
@@ -446,8 +458,37 @@ def main():
                      "transport-bound and 'skipped_on_time_budget' lists "
                      "queries whose compiles did not fit the budget; "
                      "per-query detail incl. TPC-H Q6 under 'queries'"),
-            "queries": queries,
-        }), flush=True)
+            "queries": qs,
+        }
+
+    # streaming output (BENCH_r05 post-mortem: a `timeout` SIGKILL after
+    # the -k grace erased the whole run — "parsed": null — because the one
+    # JSON line only printed at the very end).  Each completed query
+    # atomically rewrites BENCH_OUT (tmp + rename) so ANY kill leaves a
+    # parseable file with everything finished so far.  "0" disables.
+    stream_path = os.environ.get("BENCH_OUT", "BENCH_STREAM.json")
+
+    def _write_stream(payload):
+        if not stream_path or stream_path == "0":
+            return
+        try:
+            tmp = stream_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, stream_path)
+        except OSError:
+            pass
+
+    def stream():
+        _write_stream(_payload(partial=True))
+
+    def emit():
+        if emitted["done"]:
+            return
+        emitted["done"] = True
+        payload = _payload(partial=False)
+        _write_stream(payload)
+        print(json.dumps(payload), flush=True)
 
     _ALL = ["qa_join_agg", "qb_left_join", "qc_window", "rung3",
             "q6_parquet"]
@@ -483,6 +524,7 @@ def main():
             tpu_s=t_hot, cpu_vec_s=t_vec, cpu_oracle_s=t_oracle,
             rows_per_s=n_q6 / t_hot, eff_gbps=q6_bytes / t_hot / 1e9,
             vs_vec=t_vec / t_hot, vs_oracle=t_oracle / t_hot, **ctr_hot)
+        stream()
         if scan_variants:
             tpu_scan_df = build_q6(_session(True, cache_batches=False), li)
             t_scan, _, ctr_scan = _time_repeats(tpu_scan_df.collect, repeats,
@@ -492,6 +534,7 @@ def main():
                 rows_per_s=n_q6 / t_scan, eff_gbps=q6_bytes / t_scan / 1e9,
                 vs_vec=t_vec / t_scan, vs_oracle=t_oracle / t_scan,
                 **ctr_scan)
+            stream()
         del li
     except TimeoutError:
         skipped.extend(["q6"] + _ALL)
@@ -536,6 +579,7 @@ def main():
                 tpu_s=t_tpu, cpu_vec_s=t_vec, cpu_oracle_s=t_oracle,
                 rows_per_s=n / t_tpu, eff_gbps=bytes_ / t_tpu / 1e9,
                 vs_vec=t_vec / t_tpu, vs_oracle=t_oracle / t_tpu, **ctr)
+            stream()
 
     def check_qa(rows, want):
         got = {(int(r[0]), int(r[1])): int(r[2].scaleb(2)) for r in rows}
@@ -704,6 +748,7 @@ def main():
             spillToHostBytes=float(fw.spill_to_host_bytes),
             spillToDiskCount=float(fw.spill_to_disk_count),
             **ctr)
+        stream()
         reset_spill_framework()
         progress(f"rung3: tpu {t_tpu:.2f}s pool={fw.pool_bytes >> 20}MiB "
                  f"spills={fw.spill_to_host_count} "
@@ -798,6 +843,7 @@ def main():
                 eff_gbps=file_bytes / t_tpu / 1e9,
                 vs_vec=t_vec / t_tpu, vs_oracle=0.0,
                 fileBytes=file_bytes, **ctr)
+            stream()
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
 

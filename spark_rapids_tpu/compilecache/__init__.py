@@ -1,0 +1,47 @@
+"""Plan-time AOT compilation pipeline + persistent executable cache.
+
+On a tunnel-relayed TPU every fresh XLA compile costs minutes, and the seed
+engine compiled every stage program lazily on the first batch of the first
+run — serialized, inside the query's critical path (BENCH_r05 dropped two
+queries on exactly that).  This package moves compilation off the critical
+path with two halves:
+
+* ``registry`` — an in-process executable registry: every exec routes its
+  ``tpu_jit`` creation through :func:`cached_program` keyed by a
+  collision-safe fingerprint (expression SQL + schemas + mode + relevant
+  confs), so a re-planned query (fresh session, same logical plan) reuses
+  the already-compiled programs instead of re-tracing.  Counters:
+  ``compile_cache_hits`` / ``compile_cache_misses`` / ``compile_wall_ns``.
+
+* ``aot`` — plan-time enumeration: after overrides produce the exec tree,
+  :func:`submit_plan` walks it, predicts each stage program's (function x
+  shape-bucket) from the plan's static row estimates, and compiles them
+  concurrently on a bounded background pool — batch 1 of operator 1
+  overlaps the compiles of everything downstream.  The runtime lookup
+  blocks only when it reaches a program whose AOT compile is still in
+  flight.
+
+The cross-process half rides JAX's on-disk compilation cache
+(``jax_compilation_cache_dir``), pointed at ``spark.rapids.tpu.compile.
+cacheDir`` by the session (see session._apply_compile_cache) — a fresh
+process re-running the same plan deserializes executables instead of
+compiling.
+"""
+from spark_rapids_tpu.compilecache.keys import (  # noqa: F401
+    conf_fp,
+    exprs_fp,
+    fingerprint,
+    schema_fp,
+)
+from spark_rapids_tpu.compilecache.registry import (  # noqa: F401
+    ProgramEntry,
+    cached_program,
+    get_registry,
+    registry_enabled,
+    reset_registry,
+)
+from spark_rapids_tpu.compilecache.aot import (  # noqa: F401
+    AotSubmission,
+    maybe_submit_aot,
+    submit_plan,
+)

@@ -240,10 +240,49 @@ COMPILE_CACHE_DIR = conf("spark.rapids.tpu.compileCache.dir").doc(
     "first TpuSession construction so tests/tools/bench all share compiled "
     "programs across processes (on the tunnel-relayed dev chip a single "
     "compile costs minutes; the cache makes it once).  Empty string or "
-    "'0' disables.  Default: <repo>/.jax_compile_cache."
+    "'0' disables.  Default: <repo>/.jax_compile_cache.  Legacy alias of "
+    "spark.rapids.tpu.compile.cacheDir, which wins when set."
 ).string_conf(os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     ".jax_compile_cache"))
+
+# --- compile cache / AOT pipeline (compilecache/) --------------------------
+
+COMPILE_CACHE_DIR_V2 = conf("spark.rapids.tpu.compile.cacheDir").doc(
+    "Persistent XLA executable cache directory "
+    "(jax_compilation_cache_dir): a fresh process re-running the same "
+    "plan deserializes executables instead of compiling.  Preferred "
+    "spelling; unset falls back to spark.rapids.tpu.compileCache.dir "
+    "(and its repo-local default).  Empty string or '0' disables."
+).string_conf(None)
+
+COMPILE_AOT_ENABLED = conf("spark.rapids.tpu.compile.aot.enabled").doc(
+    "Plan-time AOT compilation: after overrides produce the exec tree, "
+    "enumerate the (stage function x shape-bucket) programs the query "
+    "will need and compile them concurrently on a bounded background "
+    "pool, so batch 1 of operator 1 overlaps the compiles of everything "
+    "downstream instead of serializing minute-long compiles between "
+    "launches (compilecache/aot.py).").boolean_conf(True)
+
+COMPILE_AOT_THREADS = conf("spark.rapids.tpu.compile.aot.threads").doc(
+    "Background compile pool width.  On the tunnel-relayed dev relay "
+    "compiles serialize behind one channel anyway; on a directly "
+    "attached host XLA compiles are CPU-bound and parallelize well."
+).integer_conf(4)
+
+COMPILE_REGISTRY_ENABLED = conf(
+    "spark.rapids.tpu.compile.registry.enabled").doc(
+    "In-process executable registry: exec nodes share compiled stage "
+    "programs keyed by semantic fingerprint (expressions + schemas + "
+    "confs), so a re-planned query compiles nothing the process already "
+    "built.  Off: every exec instance keeps private jits (the seed "
+    "behavior).").boolean_conf(True)
+
+COMPILE_REGISTRY_MAX_PROGRAMS = conf(
+    "spark.rapids.tpu.compile.registry.maxPrograms").doc(
+    "LRU bound on registered programs (each entry pins its compiled "
+    "executables); evicted programs simply recompile on next use."
+).integer_conf(1024)
 
 SKEW_JOIN_ENABLED = conf("spark.sql.adaptive.skewJoin.enabled").doc(
     "AQE skew handling for the mesh join (Spark's OptimizeSkewedJoin "
@@ -612,10 +651,12 @@ class TpuConf:
 
 _lock = threading.Lock()
 _active = TpuConf()
+_tls = threading.local()
 
 
 def get_conf() -> TpuConf:
-    return _active
+    override = getattr(_tls, "override", None)
+    return override if override is not None else _active
 
 
 def set_conf(c: TpuConf) -> TpuConf:
@@ -623,6 +664,25 @@ def set_conf(c: TpuConf) -> TpuConf:
     with _lock:
         _active = c
     return c
+
+
+class ambient_conf:
+    """Thread-local conf override: background threads (the AOT compile
+    pool) trace programs whose expressions read the ambient conf at trace
+    time; pinning the conf captured at submit keeps a warm-up's trace
+    consistent with its registry key even if the main thread re-plans a
+    different session mid-compile."""
+
+    def __init__(self, conf: TpuConf):
+        self._conf = conf
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "override", None)
+        _tls.override = self._conf
+        return self._conf
+
+    def __exit__(self, *a):
+        _tls.override = self._prev
 
 
 def all_entries() -> List[ConfEntry]:

@@ -334,16 +334,46 @@ class TpuHashAggregateExec(TpuExec):
         b.close()
         return out
 
+    def _program_fp(self):
+        """Registry fingerprint parts for this aggregate's programs, or
+        None when an expression is not safely fingerprintable (then every
+        jit stays instance-private)."""
+        from spark_rapids_tpu.compilecache.keys import (
+            aggs_fp,
+            conf_fp,
+            exprs_fp,
+            schema_fp,
+            stage_ops_fp,
+        )
+
+        g = exprs_fp(self.grouping)
+        a = aggs_fp(self.aggregates)
+        p = stage_ops_fp(self.pre_ops)
+        if g is None or a is None or p is None:
+            return None
+        return ("agg", g, a, p, self.mode.value,
+                schema_fp(self.input_schema), schema_fp(self.child_schema),
+                schema_fp(self._output), bool(self.ansi), conf_fp())
+
+    def _merge_jit(self):
+        if getattr(self, "_merge_jitted", None) is None:
+            from spark_rapids_tpu.compilecache.registry import (
+                cached_program,
+            )
+
+            fpp = self._program_fp()
+            key_parts = None if fpp is None else fpp + ("mergefn",)
+            self._merge_jitted = cached_program(
+                key_parts,
+                lambda: (tpu_jit(self.detached_for_trace()._merge_fn),
+                         None),
+                label=f"agg-merge:{self.describe()[:40]}").jitted
+        return self._merge_jitted
+
     def _merge_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
         """Re-aggregate buffer-form rows with per-agg merge functions."""
-        key = ("merge", batch.capacity)
-        cache = getattr(self, "_merge_jits", None)
-        if cache is None:
-            cache = self._merge_jits = {}
-        if key not in cache:
-            cache[key] = tpu_jit(self._merge_fn)
-        cols, nrows = cache[key](tuple(batch.columns),
-                                 jnp.int32(batch.num_rows))
+        cols, nrows = self._merge_jit()(tuple(batch.columns),
+                                        jnp.int32(batch.num_rows))
         # global aggregates have a statically known single output row —
         # skip the device sync (int(nrows) blocks on tunnel latency)
         n = 1 if not self.grouping else int(nrows)
@@ -611,19 +641,100 @@ class TpuHashAggregateExec(TpuExec):
         n = 1 if not self.grouping else int(nrows)
         return ColumnarBatch(list(cols), n, self._output)
 
+    def _agg_program(self, groups_cap=None):
+        """(registry key parts, factory) for the aggregation program at
+        one groups-cap rung — shared by runtime and AOT enumeration."""
+        fpp = self._program_fp()
+        key_parts = None if fpp is None else fpp + ("aggfn", groups_cap)
+
+        def factory():
+            # detached clone: registry entries outlive the query and must
+            # not pin the input subtree through the bound method
+            clone = self.detached_for_trace()
+            if groups_cap is None:
+                return tpu_jit(clone._agg_fn), None
+
+            def fn(cols, num_rows, _b=groups_cap):
+                return clone._agg_fn(cols, num_rows, groups_cap=_b)
+
+            return tpu_jit(fn), None
+
+        return key_parts, factory
+
     def _agg_jit(self, groups_cap=None):
         cache = getattr(self, "_agg_jits", None)
         if cache is None:
             cache = self._agg_jits = {}
         if groups_cap not in cache:
-            if groups_cap is None:
-                cache[groups_cap] = tpu_jit(self._agg_fn)
-            else:
-                def fn(cols, num_rows, _b=groups_cap):
-                    return self._agg_fn(cols, num_rows, groups_cap=_b)
+            from spark_rapids_tpu.compilecache.registry import (
+                cached_program,
+            )
 
-                cache[groups_cap] = tpu_jit(fn)
+            key_parts, factory = self._agg_program(groups_cap)
+            cache[groups_cap] = cached_program(
+                key_parts, factory,
+                label=f"agg:{self.describe()[:40]}").jitted
         return cache[groups_cap]
+
+    # -- plan-time AOT enumeration (compilecache/aot.py) -----------------
+    def aot_output_caps(self):
+        """Output capacity is predictable even though the group COUNT is
+        not: the bounded-groups ladder emits B-capacity batches on its
+        first rung, the full-width path keeps the input capacity — this
+        is what lets a window/sort ABOVE an aggregate enumerate its
+        program at plan time."""
+        if self._has_collect:
+            return None
+        in_caps = self.aot_input_caps()
+        if not in_caps:
+            return None
+        out = set()
+        for c in in_caps:
+            B = self._bounded_groups_cap(c)
+            out.add(B if B else c)
+        return sorted(out)
+
+    def aot_emits_single_batch(self):
+        # streaming/COMPLETE merge down to one output batch; PARTIAL
+        # emits one buffer batch per input batch
+        return self.mode != AggregateMode.PARTIAL
+
+    def aot_programs(self):
+        from spark_rapids_tpu.compilecache.aot import (
+            AotProgram,
+            dummy_batch_args,
+        )
+
+        if self._has_collect:
+            return []
+        caps = self.aot_input_caps()
+        if not caps:
+            return []
+        if self.mode == AggregateMode.COMPLETE \
+                and not self.aot_child_single_batch():
+            # multi-batch COMPLETE runs through the two-phase twins, not
+            # this node's fused program
+            return []
+        if self.mode == AggregateMode.FINAL:
+            return []  # consumes data-dependent buffer rows
+        schema = self.input_schema
+        out = []
+        for B in {self._bounded_groups_cap(c) for c in caps}:
+            key_parts, factory = self._agg_program(B)
+            # only the capacities whose ladder rung IS this B — the
+            # runtime pairs each batch capacity with exactly its rung, so
+            # warming the (B x capacity) cross-product would burn pool
+            # time on specializations nothing ever dispatches
+            b_caps = tuple(c for c in caps
+                           if self._bounded_groups_cap(c) == B)
+
+            def args_factory(_caps=b_caps):
+                return [dummy_batch_args(schema, c) for c in _caps]
+
+            out.append(AotProgram(
+                key_parts, factory, args_factory,
+                f"agg:{self.describe()[:48]}"))
+        return out
 
     def _bounded_groups_cap(self, cap: int):
         """The groups-cap ladder rung for this batch, or None when the

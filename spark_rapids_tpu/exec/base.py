@@ -107,6 +107,21 @@ def _fault_domain(fn):
     return wrapper
 
 
+class _SchemaOnlyExec:
+    """Stand-in child inside a detached trace clone (detached_for_trace):
+    registry-shared stage functions only ever read ``.output`` from their
+    children at trace time."""
+
+    __slots__ = ("_schema",)
+
+    def __init__(self, schema):
+        self._schema = schema
+
+    @property
+    def output(self):
+        return self._schema
+
+
 class TpuExec:
     """Base TPU operator; children may be TpuExec or transition nodes."""
 
@@ -161,6 +176,104 @@ class TpuExec:
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
         """Yield device batches; implemented by subclasses."""
         raise NotImplementedError(self.node_name)
+
+    def detached_for_trace(self) -> "TpuExec":
+        """A shallow clone safe to capture in a registry-shared jit
+        closure.  The process-global program registry keeps entries alive
+        across queries; a bound-method closure over ``self`` would pin
+        the whole exec subtree — scan host columns, plan-node twins,
+        device caches — for as long as the entry lives.  The clone keeps
+        only the semantic fields the trace reads; children become schema
+        stubs and every cache/plan back-reference is dropped."""
+        import copy
+
+        clone = copy.copy(self)
+        clone.children = [_SchemaOnlyExec(c.output) for c in self.children]
+        clone.metrics = {}
+        # sweep cache/back-reference attrs by convention so a subclass
+        # adding a new per-instance cache cannot silently re-introduce
+        # the leak; plus the known non-conforming names
+        drop = {"_origin_plan", "_aot_submission", "_twin_cache",
+                "_reg_scope", "_device_cache", "_slot"}
+        for name in list(clone.__dict__):
+            if name in drop or name.endswith(
+                    ("_jit", "_jits", "_jitted", "_jit_cache", "_cache")):
+                clone.__dict__.pop(name, None)
+        return clone
+
+    # -- plan-time AOT compilation (compilecache/aot.py) ----------------
+    def aot_output_rows(self):
+        """Per-batch row counts this operator will emit, when derivable
+        from the plan alone (local/range scans and the narrow operators
+        above them); None when data-dependent (exchange partitions,
+        aggregate groups, join pair counts...).  Drives shape-bucket
+        prediction for the AOT pipeline."""
+        return None
+
+    def aot_output_caps(self):
+        """Predicted output batch CAPACITIES (shape buckets) — what
+        programs actually specialize on.  Default: derived from the row
+        estimate; operators whose output capacity is predictable even
+        when row counts are not (aggregates under a groups cap) override
+        this directly."""
+        rows = self.aot_output_rows()
+        if rows is None:
+            return None
+        from spark_rapids_tpu.compilecache.aot import bucket_of
+
+        return sorted({bucket_of(r) for r in rows})
+
+    def aot_emits_single_batch(self) -> bool:
+        """True when this operator emits exactly one batch regardless of
+        input batching (concat-style operators, non-partial aggregates) —
+        lets a concat consumer above trust aot_output_caps even without a
+        row estimate."""
+        return False
+
+    def aot_input_rows(self):
+        """First child's static row estimate (the common input shape)."""
+        if not self.children:
+            return None
+        child = self.children[0]
+        fn = getattr(child, "aot_output_rows", None)
+        return fn() if fn is not None else None
+
+    def aot_input_caps(self):
+        """Capacities of the batches the first child will emit — for
+        PER-BATCH consumers (stage/aggregate programs run once per input
+        batch, so any batch count works)."""
+        if not self.children:
+            return None
+        fn = getattr(self.children[0], "aot_output_caps", None)
+        return fn() if fn is not None else None
+
+    def aot_input_concat_caps(self):
+        """Capacity of the CONCATENATION of the first child's batches —
+        for concat consumers (sort/window); see compilecache.aot
+        concat_caps for the rule."""
+        if not self.children:
+            return None
+        from spark_rapids_tpu.compilecache.aot import concat_caps
+
+        return concat_caps(self.children[0])
+
+    def aot_child_single_batch(self) -> bool:
+        """True when the first child is known to emit exactly one batch."""
+        rows = self.aot_input_rows()
+        if rows is not None:
+            return len(rows) == 1
+        if not self.children:
+            return False
+        single = getattr(self.children[0], "aot_emits_single_batch", None)
+        return bool(single()) if single is not None else False
+
+    def aot_programs(self):
+        """The (stage function x shape-bucket) programs this operator
+        will need, as compilecache.aot.AotProgram items; default: none
+        enumerable.  Implementations MUST derive key parts and factories
+        from the same helpers the runtime path uses, so an AOT-compiled
+        entry is exactly the one the first batch looks up."""
+        return []
 
     def _count_output(self, b: ColumnarBatch) -> ColumnarBatch:
         self.metrics["numOutputRows"] += b.num_rows

@@ -171,7 +171,9 @@ class TpuStageExec(TpuExec):
 
         return any(contains_host_kernel(e) for e in self._op_expressions())
 
-    def _build(self, in_schema: T.StructType):
+    def _stage_fn(self, in_schema: T.StructType):
+        """The traceable stage function + its ANSI message store (filled as
+        a trace-time side effect, so it must travel WITH the executable)."""
         ops = self.ops
         ansi = self.ansi
 
@@ -193,15 +195,44 @@ class TpuStageExec(TpuExec):
             flags = tuple(jnp.any(f) for f, _ in ctx.error_flags)
             return batch.columns, jnp.asarray(batch.num_rows), flags
 
+        return fn, msgs_store
+
+    def _program(self, in_schema: T.StructType):
+        """(registry key parts, factory) — shared verbatim by the runtime
+        build and the plan-time AOT enumeration so both land on the same
+        registry entry."""
+        from spark_rapids_tpu.compilecache.keys import (
+            conf_fp,
+            schema_fp,
+            stage_ops_fp,
+        )
+
+        ops_fp = stage_ops_fp(self.ops)
+        key_parts = None if ops_fp is None else (
+            "stage", schema_fp(in_schema), ops_fp, bool(self.ansi),
+            conf_fp())
+
+        def factory():
+            fn, msgs = self._stage_fn(in_schema)
+            return tpu_jit(fn), msgs
+
+        return key_parts, factory
+
+    def _build(self, in_schema: T.StructType):
         # host-kernel expressions (JSON, digests, ... — jax.pure_callback)
         # cannot live inside a compiled TPU program (the PJRT plugin has no
         # host-callback channel); the stage runs op-by-op eagerly instead —
         # callbacks execute directly and the jnp ops still dispatch to the
         # device.  CPU/test backends jit as usual.
         if self._has_host_kernels():
-            jitted = fn
+            jitted, msgs_store = self._stage_fn(in_schema)
         else:
-            jitted = tpu_jit(fn)
+            from spark_rapids_tpu.compilecache.registry import cached_program
+
+            key_parts, factory = self._program(in_schema)
+            entry = cached_program(key_parts, factory,
+                                   label=self.describe())
+            jitted, msgs_store = entry.jitted, entry.aux
 
         def run(batch: ColumnarBatch) -> ColumnarBatch:
             cols, count, flags = jitted(
@@ -212,6 +243,47 @@ class TpuStageExec(TpuExec):
             return ColumnarBatch(list(cols), int(count), self._out_schema)
 
         return run
+
+    # -- plan-time AOT enumeration (compilecache/aot.py) -----------------
+    def _aot_filters_rows(self) -> bool:
+        return any(getattr(op, "condition", None) is not None
+                   for op in self.ops)
+
+    def aot_output_rows(self):
+        # projections preserve row counts exactly; a filtering stage's
+        # OUTPUT rows are data-dependent (a concat above would size its
+        # capacity from the post-filter counts), though per-batch
+        # capacity still passes through (aot_output_caps)
+        if self._aot_filters_rows():
+            return None
+        return self.aot_input_rows()
+
+    def aot_output_caps(self):
+        return self.aot_input_caps()
+
+    def aot_emits_single_batch(self):
+        # one output batch per input batch
+        return self.aot_child_single_batch()
+
+    def aot_programs(self):
+        from spark_rapids_tpu.compilecache.aot import (
+            AotProgram,
+            dummy_batch_args,
+        )
+
+        if self._has_host_kernels():
+            return []
+        caps = self.aot_input_caps()
+        if not caps:
+            return []
+        in_schema = self.children[0].output
+        key_parts, factory = self._program(in_schema)
+
+        def args_factory():
+            return [dummy_batch_args(in_schema, c) for c in caps]
+
+        return [AotProgram(key_parts, factory, args_factory,
+                           f"stage:{self.describe()[:48]}")]
 
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
         child = self.children[0]
@@ -308,6 +380,18 @@ class TpuLocalTableScanExec(TpuExec):
             return
         yield from self._materialize()
 
+    def aot_output_rows(self):
+        """Exact per-batch row counts (mirrors _materialize's chunking) —
+        the AOT pipeline's ground truth for shape buckets."""
+        n = self.host_columns[0].num_rows if self.host_columns else 0
+        step = self.target_batch_rows or max(n, 1)
+        out = []
+        for start in range(0, max(n, 1), step):
+            out.append(min(start + step, n) - start if n else 0)
+            if n == 0:
+                break
+        return out
+
     def _materialize(self):
         n = self.host_columns[0].num_rows if self.host_columns else 0
         step = self.target_batch_rows or max(n, 1)
@@ -336,6 +420,17 @@ class TpuRangeExec(TpuExec):
     def output(self):
         return T.StructType([T.StructField("id", T.LONG, nullable=False)])
 
+    def aot_output_rows(self):
+        total = max(0, -(-(self.end - self.start) // self.step))
+        out, emitted = [], 0
+        while emitted < total or (total == 0 and emitted == 0):
+            count = min(self.batch_rows, total - emitted)
+            out.append(count)
+            emitted += count
+            if total == 0:
+                break
+        return out
+
     def execute_columnar(self):
         total = max(0, -(-(self.end - self.start) // self.step))
         from spark_rapids_tpu.columnar.column import round_up_bucket, DEFAULT_ROW_BUCKETS
@@ -359,6 +454,16 @@ class TpuUnionExec(TpuExec):
     @property
     def output(self):
         return self.children[0].output
+
+    def aot_output_rows(self):
+        out = []
+        for c in self.children:
+            fn = getattr(c, "aot_output_rows", None)
+            rows = fn() if fn is not None else None
+            if rows is None:
+                return None
+            out.extend(rows)
+        return out
 
     def execute_columnar(self):
         for c in self.children:

@@ -39,6 +39,31 @@ class TpuCoalesceBatchesExec(TpuExec):
             f"TargetSize({self.goal.target_bytes})"
         return f"TpuCoalesceBatches {g}"
 
+    def _aot_one_flush(self) -> bool:
+        """Plan-time guess: with a production-scale byte goal the whole
+        input coalesces into one flush; a deliberately tiny goal (tests,
+        re-bucketing configs) means one flush per input batch."""
+        return self.goal.require_single \
+            or self.goal.target_bytes >= (32 << 20)
+
+    def aot_output_rows(self):
+        """Shape estimate: one batch of the total row count under the
+        one-flush guess, else the child's batching passes through.  A
+        wrong guess only costs one speculative background compile;
+        correctness never depends on it."""
+        rows = self.aot_input_rows()
+        if rows is None:
+            return None
+        return [sum(rows)] if self._aot_one_flush() else rows
+
+    def aot_emits_single_batch(self):
+        # claim a single output batch only when the flush heuristic says
+        # so (or the input is single anyway): downstream single-batch
+        # fused programs are only warmed when they will actually dispatch
+        return (self._aot_one_flush()
+                and self.aot_input_rows() is not None) \
+            or self.aot_child_single_batch()
+
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
         """Pending batches are held *spillable* while more input streams in
         (reference: the coalesce iterator's batches are

@@ -53,6 +53,60 @@ class TpuShuffleExchangeExec(TpuExec):
     def num_partitions(self) -> int:
         return getattr(self.partitioning, "num_partitions", 1)
 
+    def aot_output_rows(self):
+        # single partition = identity pipe over the child; hash/rr/range
+        # partition splits are data-dependent
+        if isinstance(self.partitioning, SinglePartitioning) \
+                or self.num_partitions == 1:
+            return self.aot_input_rows()
+        return None
+
+    def aot_output_caps(self):
+        if isinstance(self.partitioning, SinglePartitioning) \
+                or self.num_partitions == 1:
+            return self.aot_input_caps()
+        return None
+
+    def aot_emits_single_batch(self):
+        return (isinstance(self.partitioning, SinglePartitioning)
+                or self.num_partitions == 1) \
+            and self.aot_child_single_batch()
+
+    def _registry_scope(self, kind: str):
+        from spark_rapids_tpu.compilecache.keys import (
+            conf_fp,
+            exprs_fp,
+            schema_fp,
+        )
+
+        p = self.partitioning
+        if isinstance(p, HashPartitioning):
+            efp = exprs_fp(p.keys)
+        elif isinstance(p, RangePartitioning):
+            efp = exprs_fp([e for e, _ in p.orders])
+            if efp is not None:
+                efp = efp + tuple((s.ascending, s.nulls_first)
+                                  for _, s in p.orders)
+        else:
+            efp = ()
+        if efp is None:
+            return None
+        return ("exchange", kind, type(p).__name__, efp,
+                self.num_partitions, schema_fp(self.output),
+                bool(self.ansi), conf_fp())
+
+    def _cached_jit(self, attr: str, kind: str, builder):
+        jitted = getattr(self, attr, None)
+        if jitted is None:
+            from spark_rapids_tpu.compilecache.registry import (
+                cached_jit_program,
+            )
+
+            jitted = cached_jit_program(self._registry_scope(kind),
+                                        builder, label=f"exchange:{kind}")
+            setattr(self, attr, jitted)
+        return jitted
+
     def partition_batch(self, batch: ColumnarBatch) -> List[ColumnarBatch]:
         """Slice one batch into per-partition batches (device-resident).
 
@@ -92,10 +146,8 @@ class TpuShuffleExchangeExec(TpuExec):
                 side="left").astype(jnp.int32)
             return tuple(sorted_cols), bounds
 
-        if getattr(self, "_sort_jit", None) is None:
-            self._sort_jit = tpu_jit(sort_fn)
-        cols, bounds = self._sort_jit(tuple(batch.columns), ids,
-                                      jnp.int32(batch.num_rows))
+        cols, bounds = self._cached_jit("_sort_jit", "partsort", sort_fn)(
+            tuple(batch.columns), ids, jnp.int32(batch.num_rows))
         import numpy as _np
 
         bounds_np = _np.asarray(bounds).tolist()   # one transfer
@@ -111,16 +163,17 @@ class TpuShuffleExchangeExec(TpuExec):
 
     def _hash_ids(self, batch: ColumnarBatch):
         schema = batch.schema
+        keys, n_parts, ansi = (self.partitioning.keys,
+                               self.num_partitions, self.ansi)
 
         def fn(cols, num_rows):
             b = ColumnarBatch(list(cols), num_rows, schema)
-            ctx = EvalContext(b, ansi=self.ansi)
-            key_cols = [k.eval_tpu(ctx) for k in self.partitioning.keys]
-            return spark_partition_ids(key_cols, self.num_partitions)
+            ctx = EvalContext(b, ansi=ansi)
+            key_cols = [k.eval_tpu(ctx) for k in keys]
+            return spark_partition_ids(key_cols, n_parts)
 
-        if getattr(self, "_ids_jit", None) is None:
-            self._ids_jit = tpu_jit(fn)
-        return self._ids_jit(tuple(batch.columns), jnp.int32(batch.num_rows))
+        return self._cached_jit("_ids_jit", "hashids", fn)(
+            tuple(batch.columns), jnp.int32(batch.num_rows))
 
     def _range_ids(self, batch: ColumnarBatch):
         """Range partitioning via sampled bounds (GpuRangePartitioner).
@@ -131,10 +184,11 @@ class TpuShuffleExchangeExec(TpuExec):
         orders = self.partitioning.orders
 
         schema = batch.schema
+        n_parts, ansi = self.num_partitions, self.ansi
 
         def fn(cols, num_rows):
             b = ColumnarBatch(list(cols), num_rows, schema)
-            ctx = EvalContext(b, ansi=self.ansi)
+            ctx = EvalContext(b, ansi=ansi)
             key_cols = [e.eval_tpu(ctx) for e, _ in orders]
             specs = [s for _, s in orders]
             perm = sort_permutation(key_cols, specs, b.row_mask)
@@ -143,12 +197,11 @@ class TpuShuffleExchangeExec(TpuExec):
             inv = jnp.zeros(cap, jnp.int32).at[perm].set(
                 jnp.arange(cap, dtype=jnp.int32))
             per = jnp.maximum(
-                (num_rows + self.num_partitions - 1) // self.num_partitions, 1)
-            return jnp.clip(inv // per, 0, self.num_partitions - 1)
+                (num_rows + n_parts - 1) // n_parts, 1)
+            return jnp.clip(inv // per, 0, n_parts - 1)
 
-        if getattr(self, "_range_jit", None) is None:
-            self._range_jit = tpu_jit(fn)
-        return self._range_jit(tuple(batch.columns), jnp.int32(batch.num_rows))
+        return self._cached_jit("_range_jit", "rangeids", fn)(
+            tuple(batch.columns), jnp.int32(batch.num_rows))
 
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
         """Shuffle through the manager: each input batch is a "map task"
@@ -196,6 +249,17 @@ class TpuBroadcastExchangeExec(TpuExec):
     @property
     def output(self):
         return self.children[0].output
+
+    def aot_output_rows(self):
+        rows = self.aot_input_rows()
+        return None if rows is None else [sum(rows)]
+
+    def aot_output_caps(self):
+        caps = super().aot_output_caps()
+        return caps if caps is not None else self.aot_input_concat_caps()
+
+    def aot_emits_single_batch(self):
+        return True
 
     def execute_columnar(self):
         batches = list(self.children[0].execute_columnar())

@@ -81,10 +81,74 @@ class TpuJoinAggFusedExec(TpuExec):
         return (f"TpuJoinAggFused[{self.agg.describe()} <- "
                 f"{self.join.describe()}]")
 
+    def _registry_scope(self):
+        cached = getattr(self, "_reg_scope", False)
+        if cached is not False:
+            return cached
+        join_scope = self.join._registry_scope()
+        agg_fp = self.agg._program_fp()
+        scope = None
+        if join_scope is not None and agg_fp is not None:
+            scope = ("joinagg",) + join_scope + (agg_fp,)
+        self._reg_scope = scope
+        return scope
+
+    def _agg_tag(self, agg):
+        """Stable registry identity for the agg variant a key closes over
+        (self.agg or its PARTIAL/FINAL twins) — replaces id(agg), which
+        never matches across exec instances."""
+        fpp = agg._program_fp()
+        return fpp if fpp is not None else ("id", id(agg))
+
     def _cached(self, key, builder):
         if key not in self._jit_cache:
-            self._jit_cache[key] = tpu_jit(builder)
+            from spark_rapids_tpu.compilecache.registry import (
+                cached_jit_program,
+            )
+
+            scope = self._registry_scope()
+            self._jit_cache[key] = cached_jit_program(
+                None if scope is None else scope + (key,), builder,
+                label=f"joinagg:{key if isinstance(key, str) else key[0]}")
         return self._jit_cache[key]
+
+    def aot_programs(self):
+        """The fused path reuses the join's build-sort program verbatim —
+        including the broadcast-side stage-absorbed (pre_ops) variant —
+        while the fused probe/materialize programs have data-dependent
+        operand shapes (pair counts, uniqueness) and compile inline."""
+        self.join.children = list(self.children)
+        build_src, pre_ops, pre_schema = self._build_source()
+        if pre_ops is None:
+            return [p for p in self.join.aot_programs()
+                    if p.label.startswith("join-build")]
+        from spark_rapids_tpu.compilecache.aot import (
+            AotProgram,
+            concat_caps,
+            dummy_batch_args,
+        )
+        from spark_rapids_tpu.compilecache.keys import (
+            schema_fp,
+            stage_ops_fp,
+        )
+        from spark_rapids_tpu.perfcounters import tpu_jit as _tj
+
+        join = self.join
+        scope = join._registry_scope()
+        ops_fp = stage_ops_fp(pre_ops)
+        caps = concat_caps(build_src)
+        if scope is None or ops_fp is None or not caps:
+            return []
+        cap = caps[0]
+        key = ("build_preops", ops_fp, schema_fp(pre_schema))
+        fn = join._build_fn(pre_schema, join.right_keys, pre_ops)
+
+        def args_factory(_schema=pre_schema, _cap=cap):
+            return [dummy_batch_args(_schema, _cap)]
+
+        return [AotProgram(scope + (key,),
+                           lambda _fn=fn: (_tj(_fn), None), args_factory,
+                           f"join-build-preops:{self.describe()[:36]}")]
 
     # ------------------------------------------------------------------
     def _fallback(self) -> Iterator[ColumnarBatch]:
@@ -246,11 +310,12 @@ class TpuJoinAggFusedExec(TpuExec):
         host round trip."""
         join = self.join
         schema = probe.schema
+        ansi, left_keys = join.ansi, join.left_keys   # locals only
 
         def fn(bwords, n_valid, cols, num_rows):
             b = ColumnarBatch(list(cols), num_rows, schema)
-            ctx = EvalContext(b, ansi=join.ansi)
-            key_cols = [k.eval_tpu(ctx) for k in join.left_keys]
+            ctx = EvalContext(b, ansi=ansi)
+            key_cols = [k.eval_tpu(ctx) for k in left_keys]
             valid = b.row_mask
             for kc in key_cols:
                 valid = valid & kc.validity
@@ -291,6 +356,7 @@ class TpuJoinAggFusedExec(TpuExec):
         with_um = join.join_type == JoinType.LEFT_OUTER
         out_rows = total + (n_um if with_um else 0)
         out_cap = round_up_bucket(max(out_rows, 1), DEFAULT_ROW_BUCKETS)
+        agg_fn = agg.detached_for_trace()._agg_fn   # no subtree capture
 
         def fn(row_index, b_cols, p_cols, lo, counts, unmatched, total,
                nrows):
@@ -298,9 +364,10 @@ class TpuJoinAggFusedExec(TpuExec):
                 row_index, b_cols, p_cols, lo, counts, unmatched, total,
                 nrows, out_cap, with_um)
             joined = tuple(list(lcols) + list(bcols))
-            return agg._agg_fn(joined, nrows.astype(jnp.int32))
+            return agg_fn(joined, nrows.astype(jnp.int32))
 
-        jitted = self._cached(("mat_agg", out_cap, with_um, id(agg)), fn)
+        jitted = self._cached(("mat_agg", out_cap, with_um,
+                               self._agg_tag(agg)), fn)
         cols, nrows = jitted(build.row_index, tuple(build.batch.columns),
                              tuple(probe.columns), lo, counts, unmatched,
                              jnp.int64(total), jnp.int64(out_rows))
@@ -315,12 +382,14 @@ class TpuJoinAggFusedExec(TpuExec):
         join = self.join
         left_outer = join.join_type == JoinType.LEFT_OUTER
         schema = probe.schema
+        ansi, left_keys = join.ansi, join.left_keys
+        agg_fn = agg.detached_for_trace()._agg_fn   # no subtree capture
 
         def mk(groups_cap):
             def fn(bwords, row_index, n_valid, b_cols, p_cols, num_rows):
                 b = ColumnarBatch(list(p_cols), num_rows, schema)
-                ctx = EvalContext(b, ansi=join.ansi)
-                key_cols = [k.eval_tpu(ctx) for k in join.left_keys]
+                ctx = EvalContext(b, ansi=ansi)
+                key_cols = [k.eval_tpu(ctx) for k in left_keys]
                 valid = b.row_mask
                 for kc in key_cols:
                     valid = valid & kc.validity
@@ -357,8 +426,8 @@ class TpuJoinAggFusedExec(TpuExec):
                 joined = tuple(list(p_cols) + bcols)
                 row_valid = b.row_mask if left_outer \
                     else (b.row_mask & found)
-                return agg._agg_fn(joined, num_rows, row_valid=row_valid,
-                                   groups_cap=groups_cap)
+                return agg_fn(joined, num_rows, row_valid=row_valid,
+                              groups_cap=groups_cap)
 
             return fn
 
@@ -367,8 +436,9 @@ class TpuJoinAggFusedExec(TpuExec):
                 jnp.int32(probe.num_rows))
         cap = probe.capacity
         B = agg._bounded_groups_cap(cap)
+        tag = self._agg_tag(agg)
         if B:
-            cols, nrows = self._cached(("uniq_agg", id(agg), B),
+            cols, nrows = self._cached(("uniq_agg", tag, B),
                                        mk(B))(*args)
             n = int(nrows)
             while n > B:
@@ -376,7 +446,7 @@ class TpuJoinAggFusedExec(TpuExec):
                 agg._groups_cap_hint = B2
                 if B2 >= cap:
                     B2 = None
-                cols, nrows = self._cached(("uniq_agg", id(agg), B2),
+                cols, nrows = self._cached(("uniq_agg", tag, B2),
                                            mk(B2))(*args)
                 if B2 is None:
                     n = int(nrows)
@@ -384,7 +454,7 @@ class TpuJoinAggFusedExec(TpuExec):
                 n = int(nrows)
                 B = B2
             return self._finish(agg, cols, n)
-        cols, nrows = self._cached(("uniq_agg", id(agg), None),
+        cols, nrows = self._cached(("uniq_agg", tag, None),
                                    mk(None))(*args)
         return self._finish(agg, cols, nrows)
 
@@ -426,14 +496,78 @@ class TpuWindowChainFusedExec(TpuExec):
                                   for o in self.post_ops))
         return "TpuWindowChainFused[" + " -> ".join(parts) + "]"
 
+    def _registry_scope(self):
+        cached = getattr(self, "_reg_scope", False)
+        if cached is not False:
+            return cached
+        from spark_rapids_tpu.compilecache.keys import (
+            schema_fp,
+            stage_ops_fp,
+        )
+
+        wkey, _ = self.window._window_program()
+        ops_fp = stage_ops_fp(self.post_ops)
+        agg_fp = (self.pre_agg._program_fp()
+                  if self.pre_agg is not None else ())
+        scope = None
+        if wkey is not None and ops_fp is not None and agg_fp is not None:
+            scope = ("windowchain", wkey, agg_fp, ops_fp,
+                     schema_fp(self.output))
+        self._reg_scope = scope
+        return scope
+
     def _cached(self, key, builder):
         if key not in self._jit_cache:
-            self._jit_cache[key] = tpu_jit(builder)
+            from spark_rapids_tpu.compilecache.registry import (
+                cached_jit_program,
+            )
+
+            scope = self._registry_scope()
+            self._jit_cache[key] = cached_jit_program(
+                None if scope is None else scope + (key,), builder,
+                label=f"windowchain:{key}")
         return self._jit_cache[key]
 
+    def aot_programs(self):
+        from spark_rapids_tpu.compilecache.aot import (
+            AotProgram,
+            dummy_batch_args,
+        )
+
+        scope = self._registry_scope()
+        if scope is None:
+            return []
+        with_agg = self.pre_agg is not None
+        if with_agg and not self.aot_child_single_batch():
+            # multi-batch + pre-agg runs through the two-phase twins, not
+            # the fused chain program
+            return []
+        caps = self.aot_input_concat_caps()
+        if not caps:
+            return []
+        schema = self.children[0].output
+        out = []
+        for cap in caps:
+            B = (self.pre_agg._bounded_groups_cap(cap)
+                 if with_agg else None)
+            key = ("chain", with_agg, cap, B)
+
+            def factory(_b=B):
+                return tpu_jit(self._chain_fn(with_agg, _b)), None
+
+            def args_factory(_cap=cap):
+                return [dummy_batch_args(schema, _cap)]
+
+            out.append(AotProgram(scope + (key,), factory, args_factory,
+                                  f"windowchain:{self.describe()[:44]}"))
+        return out
+
     def _chain_fn(self, with_agg: bool, groups_cap=None):
-        window = self.window
-        pre_agg = self.pre_agg if with_agg else None
+        # detached clones: the registry-shared closure must not pin the
+        # live window/agg execs (and through them the input subtree)
+        window = self.window.detached_for_trace()
+        pre_agg = (self.pre_agg.detached_for_trace()
+                   if with_agg and self.pre_agg is not None else None)
         post_ops = self.post_ops
 
         def fn(cols, num_rows):
@@ -476,7 +610,11 @@ class TpuWindowChainFusedExec(TpuExec):
                 cols, count, ng = self._cached(
                     ("chain", with_agg, b.capacity, B),
                     self._chain_fn(with_agg, B))(*args)
-                n, g = int(count), int(ng)
+                # ONE host round trip for both scalars: the output row
+                # count and the ladder's overflow check used to sync
+                # separately — BENCH_r05 counted the extra trip on every
+                # qc_window run
+                n, g = (int(x) for x in sync_get((count, ng)))
                 while g > B:     # groups-cap ladder (see aggregate.py)
                     B2 = min(max(1 << (g - 1).bit_length(), B * 2),
                              b.capacity)
@@ -486,7 +624,7 @@ class TpuWindowChainFusedExec(TpuExec):
                     cols, count, ng = self._cached(
                         ("chain", with_agg, b.capacity, B2),
                         self._chain_fn(with_agg, B2))(*args)
-                    n, g = int(count), int(ng)
+                    n, g = (int(x) for x in sync_get((count, ng)))
                     if B2 is None:
                         break
                     B = B2
@@ -494,6 +632,9 @@ class TpuWindowChainFusedExec(TpuExec):
             cols, count, _ = self._cached(
                 ("chain", with_agg, b.capacity, None),
                 self._chain_fn(with_agg))(*args)
+            # int(count) is irreducible here: it is the only scalar this
+            # path reads back (ng is statically irrelevant without the
+            # groups-cap ladder)
             return ColumnarBatch(list(cols), int(count), self.output)
 
         fw = get_spill_framework()

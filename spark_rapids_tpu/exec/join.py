@@ -195,9 +195,44 @@ class _BaseTpuJoinExec(TpuExec):
         self.sub_partition_bytes = sub_partition_bytes
         self._jit_cache = {}
 
-    def _cached_jit(self, key, builder, **jit_kw):
+    def _registry_scope(self):
+        """Fingerprint prefix identifying this join's program family (the
+        compilecache registry shares programs across exec instances with
+        identical scope + local key), or None when an expression is not
+        safely fingerprintable."""
+        cached = getattr(self, "_reg_scope", False)
+        if cached is not False:
+            return cached
+        from spark_rapids_tpu.compilecache.keys import (
+            conf_fp,
+            exprs_fp,
+            schema_fp,
+        )
+
+        lk = exprs_fp(self.left_keys)
+        rk = exprs_fp(self.right_keys)
+        cond = exprs_fp(
+            [self.condition] if self.condition is not None else [])
+        scope = None
+        if lk is not None and rk is not None and cond is not None:
+            scope = ("join", type(self).__name__, self.join_type.value,
+                     lk, rk, cond,
+                     schema_fp(self.children[0].output),
+                     schema_fp(self.children[1].output),
+                     schema_fp(self._output), bool(self.ansi), conf_fp())
+        self._reg_scope = scope
+        return scope
+
+    def _cached_jit(self, key, builder, unsafe=False, **jit_kw):
         if key not in self._jit_cache:
-            self._jit_cache[key] = tpu_jit(builder, **jit_kw)
+            from spark_rapids_tpu.compilecache.registry import (
+                cached_jit_program,
+            )
+
+            scope = None if unsafe else self._registry_scope()
+            self._jit_cache[key] = cached_jit_program(
+                None if scope is None else scope + (key,), builder,
+                label=f"{type(self).__name__}:{key}", **jit_kw)
         return self._jit_cache[key]
 
     @property
@@ -219,10 +254,43 @@ class _BaseTpuJoinExec(TpuExec):
         tail and are never probed — the stage costs no extra launch and no
         compaction scatter."""
         schema = in_schema or batch.schema
+        fn = self._build_fn(schema, keys, pre_ops)
+        if pre_ops is None:
+            jitted = self._cached_jit(self._build_key(schema), fn)
+            words, row_index, n_valid = jitted(tuple(batch.columns),
+                                               jnp.int32(batch.num_rows))
+            return _SortedBuildSide(words, row_index, n_valid, batch)
+        from spark_rapids_tpu.compilecache.keys import (
+            schema_fp,
+            stage_ops_fp,
+        )
+
+        ops_fp = stage_ops_fp(pre_ops)
+        jitted = self._cached_jit(
+            ("build_preops", ops_fp, schema_fp(schema)), fn,
+            unsafe=ops_fp is None)
+        words, row_index, n_valid, bcols = jitted(
+            tuple(batch.columns), jnp.int32(batch.num_rows))
+        out_batch = ColumnarBatch(list(bcols), batch.num_rows,
+                                  self._build_child().output)
+        return _SortedBuildSide(words, row_index, n_valid, out_batch)
+
+    def _build_key(self, schema):
+        from spark_rapids_tpu.compilecache.keys import schema_fp
+
+        return ("build", schema_fp(schema))
+
+    def _build_fn(self, schema, keys, pre_ops=None):
+        """The build-sort program body — shared by runtime and AOT.
+        Captures only locals (never ``self``): the registry keeps these
+        closures alive across queries and a self-reference would pin the
+        whole exec subtree."""
+        key_cols_src = keys
+        ansi = self.ansi
 
         def fn(cols, num_rows):
             b = ColumnarBatch(list(cols), num_rows, schema)
-            ctx = EvalContext(b, ansi=self.ansi)
+            ctx = EvalContext(b, ansi=ansi)
             mask = b.row_mask
             for op in (pre_ops or []):
                 b, mask = op.apply_masked(ctx, b, mask)
@@ -244,25 +312,19 @@ class _BaseTpuJoinExec(TpuExec):
                 return sorted_words, row_index, n_valid
             return sorted_words, row_index, n_valid, tuple(b.columns)
 
-        key_cols_src = keys
-        if pre_ops is None:
-            jitted = self._cached_jit("build", fn)
-            words, row_index, n_valid = jitted(tuple(batch.columns),
-                                               jnp.int32(batch.num_rows))
-            return _SortedBuildSide(words, row_index, n_valid, batch)
-        jitted = self._cached_jit("build_preops", fn)
-        words, row_index, n_valid, bcols = jitted(
-            tuple(batch.columns), jnp.int32(batch.num_rows))
-        out_batch = ColumnarBatch(list(bcols), batch.num_rows,
-                                  self._build_child().output)
-        return _SortedBuildSide(words, row_index, n_valid, out_batch)
+        return fn
 
     # -- probe ----------------------------------------------------------
-    def _probe_counts(self, build: _SortedBuildSide, batch: ColumnarBatch):
+    def _probe_fn(self, schema):
+        """The probe-search program body — shared by runtime and AOT.
+        Locals only; no ``self`` capture (see _build_fn)."""
+        left_keys = self.left_keys
+        ansi = self.ansi
+
         def fn(bwords, n_valid, cols, num_rows):
-            b = ColumnarBatch(list(cols), num_rows, batch.schema)
-            ctx = EvalContext(b, ansi=self.ansi)
-            key_cols = [k.eval_tpu(ctx) for k in self.left_keys]
+            b = ColumnarBatch(list(cols), num_rows, schema)
+            ctx = EvalContext(b, ansi=ansi)
+            key_cols = [k.eval_tpu(ctx) for k in left_keys]
             valid = b.row_mask
             for kc in key_cols:
                 valid = valid & kc.validity
@@ -271,11 +333,20 @@ class _BaseTpuJoinExec(TpuExec):
             hi = _multiword_searchsorted(list(bwords), n_valid, qwords, "right")
             counts = jnp.where(valid, hi - lo, 0)
             total = jnp.sum(counts.astype(jnp.int64))
-            unmatched = valid_probe_unmatched = b.row_mask & (counts == 0)
+            unmatched = b.row_mask & (counts == 0)
             n_unmatched = jnp.sum(unmatched.astype(jnp.int64))
             return lo, counts, total, unmatched, n_unmatched
 
-        jitted = self._cached_jit("probe", fn)
+        return fn
+
+    def _probe_key(self, schema):
+        from spark_rapids_tpu.compilecache.keys import schema_fp
+
+        return ("probe", schema_fp(schema))
+
+    def _probe_counts(self, build: _SortedBuildSide, batch: ColumnarBatch):
+        jitted = self._cached_jit(self._probe_key(batch.schema),
+                                  self._probe_fn(batch.schema))
         return jitted(tuple(build.words), build.n_valid,
                       tuple(batch.columns), jnp.int32(batch.num_rows))
 
@@ -337,16 +408,23 @@ class _BaseTpuJoinExec(TpuExec):
         return lcols, bcols, out_rows
 
     def _semi_anti(self, probe: ColumnarBatch, counts, anti: bool):
+        schema = probe.schema   # never capture the device batch itself
+
         def fn(cols, counts, num_rows):
-            b = ColumnarBatch(list(cols), num_rows, probe.schema)
+            b = ColumnarBatch(list(cols), num_rows, schema)
             keep = (counts == 0) if anti else (counts > 0)
             keep = keep & b.row_mask
             out, cnt = compact_columns(keep, b.columns)
             return tuple(out), cnt
 
-        jitted = self._cached_jit(("semi", anti), fn)
+        from spark_rapids_tpu.compilecache.keys import schema_fp
+
+        jitted = self._cached_jit(("semi", anti, schema_fp(probe.schema)),
+                                  fn)
         out, cnt = jitted(tuple(probe.columns), counts,
                           jnp.int32(probe.num_rows))
+        # int(cnt) is irreducible: the compacted row count labels the
+        # output batch and nothing else in this path syncs to fold it into
         return ColumnarBatch(list(out), int(cnt), self._output)
 
     # -- driver ----------------------------------------------------------
@@ -365,6 +443,74 @@ class _BaseTpuJoinExec(TpuExec):
     def _probe_child(self) -> TpuExec:
         return self.children[0]
 
+    # -- plan-time AOT enumeration (compilecache/aot.py) -----------------
+    def aot_programs(self):
+        """Build-sort program (always enumerable when the build side's
+        shape is static) and the probe-search program (enumerable when
+        every key packs to one sort-key word, so the build-words operand
+        shape is predictable).  The pair-materialization program is NOT
+        enumerable: its output capacity is the runtime pair count."""
+        from spark_rapids_tpu.compilecache.aot import (
+            AotProgram,
+            batch_caps,
+            concat_caps,
+            dummy_batch_args,
+            dummy_columns,
+            single_word_keys,
+        )
+        from spark_rapids_tpu.compilecache.registry import registry_enabled
+
+        scope = self._registry_scope()
+        if scope is None or not registry_enabled():
+            return []
+        out = []
+        bchild, pchild = self._build_child(), self._probe_child()
+        bschema = bchild.output
+        bcaps = concat_caps(bchild)  # build side concats whole
+        bcap = bcaps[0] if bcaps else None
+        if bcap is not None:
+            key = self._build_key(bschema)
+            fn = self._build_fn(bschema, self.right_keys)
+
+            def b_args(_cap=bcap, _schema=bschema):
+                return [dummy_batch_args(_schema, _cap)]
+
+            out.append(AotProgram(
+                scope + (key,), lambda _fn=fn: (tpu_jit(_fn), None),
+                b_args, f"join-build:{self.describe()[:40]}"))
+        pcaps = batch_caps(pchild)
+        if bcap is not None and pcaps \
+                and single_word_keys(self.right_keys):
+            pschema = pchild.output
+            key = self._probe_key(pschema)
+            fn = self._probe_fn(pschema)
+            nwords = len(self.right_keys)
+
+            def p_args(_bcap=bcap, _n=nwords, _schema=pschema,
+                       _caps=tuple(pcaps)):
+                import jax.numpy as jnp
+
+                from spark_rapids_tpu.compilecache.aot import (
+                    abstract_array,
+                    abstract_scalar,
+                )
+
+                sets = []
+                for c in _caps:
+                    cols = dummy_columns(_schema, c)
+                    if cols is None:
+                        continue
+                    bwords = tuple(abstract_array((_bcap,), jnp.int64)
+                                   for _ in range(_n))
+                    sets.append((bwords, abstract_scalar(jnp.int32),
+                                 cols, abstract_scalar(jnp.int32)))
+                return sets
+
+            out.append(AotProgram(
+                scope + (key,), lambda _fn=fn: (tpu_jit(_fn), None),
+                p_args, f"join-probe:{self.describe()[:40]}"))
+        return out
+
     # -- sub-partitioning (GpuSubPartitionHashJoin analog) ----------------
     def _sub_partition(self, spillables, keys, n_parts: int, side: str,
                        schema, fw):
@@ -373,9 +519,11 @@ class _BaseTpuJoinExec(TpuExec):
         per-bucket compactions reuse them."""
         from spark_rapids_tpu.ops.hashing import spark_partition_ids
 
+        ansi = self.ansi   # locals only: closures outlive the exec
+
         def ids_fn(cols, num_rows):
             b = ColumnarBatch(list(cols), num_rows, schema)
-            ctx = EvalContext(b, ansi=self.ansi)
+            ctx = EvalContext(b, ansi=ansi)
             key_cols = [k.eval_tpu(ctx) for k in keys]
             return spark_partition_ids(key_cols, n_parts,
                                        seed=_SUB_PARTITION_SEED)
@@ -388,8 +536,13 @@ class _BaseTpuJoinExec(TpuExec):
 
         # side in the cache key: build and probe close over different key
         # expressions and schemas
-        ids_j = self._cached_jit(("subpart_ids", n_parts, side), ids_fn)
-        slice_j = self._cached_jit(("subpart_slice", n_parts, side), slice_fn)
+        from spark_rapids_tpu.compilecache.keys import schema_fp
+
+        sfp = schema_fp(schema)
+        ids_j = self._cached_jit(("subpart_ids", n_parts, side, sfp),
+                                 ids_fn)
+        slice_j = self._cached_jit(("subpart_slice", n_parts, side, sfp),
+                                   slice_fn)
         buckets = [[] for _ in range(n_parts)]
         for s in spillables:
             s.pin()
@@ -504,13 +657,21 @@ class _BaseTpuJoinExec(TpuExec):
             nonlocal matched_build_any
             lo, counts, total, unmatched, n_um = self._probe_counts(
                 build, probe)
-            total_host = int(total)
             if jt == JoinType.LEFT_SEMI:
                 return self._semi_anti(probe, counts, anti=False)
             if jt == JoinType.LEFT_ANTI:
                 return self._semi_anti(probe, counts, anti=True)
             with_um = jt in (JoinType.LEFT_OUTER, JoinType.FULL_OUTER)
-            um_host = int(n_um) if with_um else 0
+            # ONE host round trip for both sizing scalars (the seed synced
+            # total and n_um separately — BENCH_r05 counted the extra
+            # round trip on every probe batch of qb_left_join); semi/anti
+            # return above without paying the total sync at all
+            from spark_rapids_tpu.perfcounters import sync_get
+
+            total_host, um_host = (int(x)
+                                   for x in sync_get((total, n_um)))
+            if not with_um:
+                um_host = 0
             if jt == JoinType.FULL_OUTER:
                 matched_build_any = matched_build_any | \
                     self._covered_build_rows(build, lo, counts)
@@ -553,8 +714,10 @@ class _BaseTpuJoinExec(TpuExec):
         return self._cached_jit("covered", fn)(build.row_index, lo, counts)
 
     def _unmatched_build_tail(self, build_batch, build, matched_any):
+        schema = build_batch.schema   # never capture the device batch
+
         def fn(cols, matched, num_rows):
-            b = ColumnarBatch(list(cols), num_rows, build_batch.schema)
+            b = ColumnarBatch(list(cols), num_rows, schema)
             keep = b.row_mask & ~matched
             out, cnt = compact_columns(keep, b.columns)
             return tuple(out), cnt
@@ -607,11 +770,12 @@ class _BaseTpuJoinExec(TpuExec):
     def _apply_condition(self, batch: ColumnarBatch) -> ColumnarBatch:
         if self.condition is None or self.join_type != JoinType.INNER:
             return batch
+        out_schema, cond, ansi = self._output, self.condition, self.ansi
 
         def fn(cols, num_rows):
-            b = ColumnarBatch(list(cols), num_rows, self._output)
-            ctx = EvalContext(b, ansi=self.ansi)
-            pred = self.condition.eval_tpu(ctx)
+            b = ColumnarBatch(list(cols), num_rows, out_schema)
+            ctx = EvalContext(b, ansi=ansi)
+            pred = cond.eval_tpu(ctx)
             keep = pred.data & pred.validity & b.row_mask
             out, cnt = compact_columns(keep, b.columns)
             return tuple(out), cnt
@@ -648,6 +812,27 @@ class TpuCartesianProductExec(TpuExec):
 
     _cached_jit = _BaseTpuJoinExec._cached_jit
     _apply_condition = _BaseTpuJoinExec._apply_condition
+
+    def _registry_scope(self):
+        cached = getattr(self, "_reg_scope", False)
+        if cached is not False:
+            return cached
+        from spark_rapids_tpu.compilecache.keys import (
+            conf_fp,
+            exprs_fp,
+            schema_fp,
+        )
+
+        cond = exprs_fp(
+            [self.condition] if self.condition is not None else [])
+        scope = None
+        if cond is not None:
+            scope = ("cartesian", cond,
+                     schema_fp(self.children[0].output),
+                     schema_fp(self.children[1].output),
+                     schema_fp(self._output), bool(self.ansi), conf_fp())
+        self._reg_scope = scope
+        return scope
 
     @property
     def output(self):

@@ -59,23 +59,76 @@ class TpuSortExec(TpuExec):
                       for e, s in self.orders)
         return f"TpuSort [{o}]"
 
+    def _sort_program(self, schema):
+        """(registry key parts, factory) — shared by the runtime path and
+        the plan-time AOT enumeration."""
+        from spark_rapids_tpu.compilecache.keys import (
+            conf_fp,
+            exprs_fp,
+            schema_fp,
+        )
+
+        orders = self.orders
+        ansi = self.ansi
+        okeys = exprs_fp([e for e, _ in orders])
+        key_parts = None if okeys is None else (
+            "sort", schema_fp(schema), okeys,
+            tuple((s.ascending, s.nulls_first) for _, s in orders),
+            bool(ansi), conf_fp())
+
+        def factory():
+            def fn(cols, num_rows):
+                batch = ColumnarBatch(list(cols), num_rows, schema)
+                ctx = EvalContext(batch, ansi=ansi)
+                key_cols = [e.eval_tpu(ctx) for e, _ in orders]
+                specs = [s for _, s in orders]
+                perm = sort_permutation(key_cols, specs, batch.row_mask)
+                out = _gather_batch(batch, perm, num_rows, schema)
+                return tuple(out.columns)
+
+            return tpu_jit(fn), None
+
+        return key_parts, factory
+
     def _sort_fn(self, schema):
         if getattr(self, "_jitted", None) is not None:
             return self._jitted
-        orders = self.orders
-        ansi = self.ansi
+        from spark_rapids_tpu.compilecache.registry import cached_program
 
-        def fn(cols, num_rows):
-            batch = ColumnarBatch(list(cols), num_rows, schema)
-            ctx = EvalContext(batch, ansi=ansi)
-            key_cols = [e.eval_tpu(ctx) for e, _ in orders]
-            specs = [s for _, s in orders]
-            perm = sort_permutation(key_cols, specs, batch.row_mask)
-            out = _gather_batch(batch, perm, num_rows, schema)
-            return tuple(out.columns)
-
-        self._jitted = tpu_jit(fn)
+        key_parts, factory = self._sort_program(schema)
+        self._jitted = cached_program(key_parts, factory,
+                                      label=self.describe()).jitted
         return self._jitted
+
+    def aot_output_rows(self):
+        # global sort concatenates the whole input into one batch
+        rows = self.aot_input_rows()
+        return None if rows is None else [sum(rows)]
+
+    def aot_output_caps(self):
+        caps = super().aot_output_caps()
+        return caps if caps is not None else self.aot_input_concat_caps()
+
+    def aot_emits_single_batch(self):
+        return True
+
+    def aot_programs(self):
+        from spark_rapids_tpu.compilecache.aot import (
+            AotProgram,
+            dummy_batch_args,
+        )
+
+        caps = self.aot_input_concat_caps()
+        if not caps:
+            return []
+        schema = self.children[0].output
+        key_parts, factory = self._sort_program(schema)
+
+        def args_factory():
+            return [dummy_batch_args(schema, c) for c in caps]
+
+        return [AotProgram(key_parts, factory, args_factory,
+                           f"sort:{self.describe()[:48]}")]
 
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
         from spark_rapids_tpu.memory.retry import with_retry_no_split

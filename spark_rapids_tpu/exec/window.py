@@ -120,6 +120,76 @@ class TpuWindowExec(TpuExec):
         fns = ", ".join(f.func for f in self.functions)
         return f"TpuWindow [{fns}] frame={self.frame}"
 
+    def _window_program(self):
+        """(registry key parts, factory) for the single fused window
+        program — shared by runtime, chain fusion and AOT enumeration."""
+        from spark_rapids_tpu.compilecache.keys import (
+            conf_fp,
+            exprs_fp,
+            schema_fp,
+            window_fns_fp,
+        )
+
+        fns = window_fns_fp(self.functions)
+        pby = exprs_fp(self.partition_by)
+        oby = exprs_fp([e for e, _ in self.order_by])
+        key_parts = None
+        if fns is not None and pby is not None and oby is not None:
+            key_parts = (
+                "window", schema_fp(self.children[0].output), fns, pby,
+                oby,
+                tuple((s.ascending, s.nulls_first)
+                      for _, s in self.order_by),
+                str(self.frame), bool(self.ansi),
+                schema_fp(self._output), conf_fp())
+
+        def factory():
+            # detached clone: a registry entry outliving this query must
+            # not pin the scan subtree through the bound method
+            return tpu_jit(self.detached_for_trace()._window_fn), None
+
+        return key_parts, factory
+
+    def _window_jit(self):
+        if getattr(self, "_jitted", None) is None:
+            from spark_rapids_tpu.compilecache.registry import (
+                cached_program,
+            )
+
+            key_parts, factory = self._window_program()
+            self._jitted = cached_program(key_parts, factory,
+                                          label=self.describe()).jitted
+        return self._jitted
+
+    def aot_output_rows(self):
+        rows = self.aot_input_rows()
+        return None if rows is None else [sum(rows)]
+
+    def aot_output_caps(self):
+        caps = super().aot_output_caps()
+        return caps if caps is not None else self.aot_input_concat_caps()
+
+    def aot_emits_single_batch(self):
+        return True
+
+    def aot_programs(self):
+        from spark_rapids_tpu.compilecache.aot import (
+            AotProgram,
+            dummy_batch_args,
+        )
+
+        caps = self.aot_input_concat_caps()
+        if not caps:
+            return []
+        schema = self.children[0].output
+        key_parts, factory = self._window_program()
+
+        def args_factory():
+            return [dummy_batch_args(schema, c) for c in caps]
+
+        return [AotProgram(key_parts, factory, args_factory,
+                           f"window:{self.describe()[:48]}")]
+
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
         batches = list(self.children[0].execute_columnar())
         if not batches:
@@ -127,10 +197,8 @@ class TpuWindowExec(TpuExec):
         batch = (batches[0] if len(batches) == 1
                  else ColumnarBatch.concat(batches))
         with self.metrics["opTime"].timed():
-            if getattr(self, "_jitted", None) is None:
-                self._jitted = tpu_jit(self._window_fn)
-            cols = self._jitted(tuple(batch.columns),
-                                jnp.int32(batch.num_rows))
+            cols = self._window_jit()(tuple(batch.columns),
+                                      jnp.int32(batch.num_rows))
             out = ColumnarBatch(list(cols), batch.num_rows, self._output)
         yield self._count_output(out)
 

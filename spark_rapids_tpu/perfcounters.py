@@ -44,6 +44,14 @@ COUNTERS: Dict[str, int] = {
     "bytes_d2h": 0,
     "bytes_h2d": 0,
     "launch_wall_ns": 0,
+    # compile cache (compilecache/): registry-level program reuse + wall
+    # time spent inside fresh XLA compiles (inline or AOT-pool)
+    "compile_cache_hits": 0,
+    "compile_cache_misses": 0,
+    "compile_wall_ns": 0,        # inline (critical-path) compile wall
+    "aot_compiles": 0,
+    "aot_compile_wall_ns": 0,    # background-pool compile wall
+    "aot_compile_errors": 0,
     # resilience (stage-level fault domains, resilience/domain.py)
     "transientRetries": 0,
     "oomRestarts": 0,
@@ -99,6 +107,10 @@ class _CountingJit:
             COUNTERS["launch_wall_ns"] += dt
             if compiled:
                 COUNTERS["compiles"] += 1
+                # the compiling call's wall is ~all trace+XLA-compile time
+                # (dispatch+execute are orders of magnitude smaller); this
+                # is the inline twin of the AOT pool's measured wall
+                COUNTERS["compile_wall_ns"] += dt
         return out
 
     def __getattr__(self, name):  # lower/trace/eval_shape passthrough

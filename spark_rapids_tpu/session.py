@@ -51,9 +51,13 @@ def _apply_compile_cache(conf: "TpuConf") -> None:
     empty/'0' dir opts out.  Falls back to ~/.cache when the configured dir
     cannot be created (e.g. a read-only install tree)."""
     global _COMPILE_CACHE_APPLIED
-    from spark_rapids_tpu.config import COMPILE_CACHE_DIR
+    from spark_rapids_tpu.config import COMPILE_CACHE_DIR, COMPILE_CACHE_DIR_V2
 
-    cache_dir = conf.get(COMPILE_CACHE_DIR)
+    # preferred spelling first (spark.rapids.tpu.compile.cacheDir); unset
+    # falls back to the legacy key and its repo-local default
+    cache_dir = conf.get(COMPILE_CACHE_DIR_V2)
+    if cache_dir is None:
+        cache_dir = conf.get(COMPILE_CACHE_DIR)
     if not cache_dir or cache_dir == "0":
         cache_dir = ""
     if cache_dir:
@@ -563,6 +567,14 @@ class DataFrame:
 
             enable_operator_tracing(
                 root, bool(self.session.conf.get(PROFILE_ENABLED)))
+            # Plan-time AOT pipeline (compilecache/aot.py): enumerate the
+            # stage programs this exec tree will need and compile them on
+            # the background pool NOW, so the first operator's first batch
+            # overlaps the compiles of everything downstream.  Idempotent
+            # per planned tree; a warm-up failure never reaches the query.
+            from spark_rapids_tpu.compilecache import maybe_submit_aot
+
+            maybe_submit_aot(root, self.session.conf)
             # Admission control: the thread driving this query's iterator
             # chain holds a TpuSemaphore permit while it touches the device
             # (reference: GpuSemaphore.acquireIfNecessary at first batch).

@@ -1,0 +1,130 @@
+"""Populate the compile caches for a query suite WITHOUT executing it.
+
+Plan-time enumeration only: each query is planned (TpuOverrides), the AOT
+pipeline (compilecache/aot.py) walks the exec tree and compiles every
+predictable (stage function x shape-bucket) program on the background
+pool, and — when ``spark.rapids.tpu.compile.cacheDir`` points somewhere
+persistent (it does by default) — the resulting executables land in JAX's
+on-disk cache, so the NEXT process (bench run, CI job, serving replica)
+starts with zero cold compiles.  No query executes; no data leaves the
+host beyond the dummy warm-up batches.
+
+    python tools/warm_cache.py                       # bench suite, 20M rows
+    python tools/warm_cache.py --queries q6,qa --rows 1000000
+    python tools/warm_cache.py --cache-dir /nfs/xla-cache --json
+
+Match --rows to the rows the real run will use: programs are keyed per
+shape bucket, so warming 1M-row buckets does not help a 20M-row run.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_queries(names, rows, cache_dir=None):
+    import bench as B
+    from spark_rapids_tpu.session import TpuSession
+
+    def session():
+        conf = {"spark.rapids.sql.enabled": True,
+                "spark.rapids.tpu.scan.cacheDeviceBatches": True}
+        if cache_dir:
+            # in the session conf, not just pre-applied: every session
+            # construction re-resolves the cache dir, and a conf without
+            # it would silently re-point jax at the repo default
+            conf["spark.rapids.tpu.compile.cacheDir"] = cache_dir
+        return TpuSession(conf)
+
+    out = {}
+    ss = dd = sr = li = None
+    if {"qa", "qb", "qc"} & set(names):
+        ss = B.make_store_sales(rows)
+    if "q6" in names:
+        li = B.make_lineitem(rows)
+        out["q6"] = B.build_q6(session(), li)
+    if "qa" in names:
+        dd = B.make_date_dim()
+        out["qa"] = B.build_qa(session(), ss, dd)
+    if "qb" in names:
+        sr = B.make_store_returns(ss, rows // 10)
+        out["qb"] = B.build_qb(session(), ss, sr)
+    if "qc" in names:
+        out["qc"] = B.build_qc(session(), ss)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--queries", default="q6,qa,qb,qc",
+                    help="comma list from {q6,qa,qb,qc}")
+    ap.add_argument("--rows", type=int,
+                    default=int(os.environ.get("BENCH_ROWS", 20_000_000)),
+                    help="row count the real run will use (shape buckets "
+                    "are keyed on it)")
+    ap.add_argument("--cache-dir", default=None,
+                    help="persistent cache dir (default: the conf default)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON line")
+    args = ap.parse_args(argv)
+
+    if args.cache_dir:
+        # applied process-wide before any session constructs
+        from spark_rapids_tpu.config import TpuConf
+        from spark_rapids_tpu.session import _apply_compile_cache
+
+        _apply_compile_cache(TpuConf(
+            {"spark.rapids.tpu.compile.cacheDir": args.cache_dir}))
+
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu.compilecache import submit_plan
+    from spark_rapids_tpu.exec.base import TpuExec
+
+    names = [q.strip() for q in args.queries.split(",") if q.strip()]
+    queries = _build_queries(names, args.rows, args.cache_dir)
+    report = {}
+    snap_all = PC.snapshot()
+    for name, df in queries.items():
+        t0 = time.perf_counter()
+        snap = PC.snapshot()
+        root, _meta = df._planned()
+        if not isinstance(root, TpuExec):
+            report[name] = {"programs": 0, "skipped": ["plan is CPU-only"]}
+            continue
+        sub = submit_plan(root, wait=True)
+        d = PC.since(snap)
+        report[name] = {
+            "programs": len(sub.programs),
+            "labels": sub.programs,
+            "skipped": sub.skipped,
+            "aotCompiles": d["aot_compiles"],
+            "compileWall_s": round(d["aot_compile_wall_ns"] / 1e9, 3),
+            "wall_s": round(time.perf_counter() - t0, 3),
+        }
+        if not args.json:
+            print(f"[warm_cache] {name}: {sub.summary()} "
+                  f"({report[name]['compileWall_s']}s compiling)",
+                  file=sys.stderr, flush=True)
+    total = PC.since(snap_all)
+    payload = {
+        "rows": args.rows,
+        "queries": report,
+        "totalAotCompiles": total["aot_compiles"],
+        "totalCompileWall_s": round(total["aot_compile_wall_ns"] / 1e9, 3),
+    }
+    if args.json:
+        print(json.dumps(payload), flush=True)
+    else:
+        print(f"[warm_cache] done: {payload['totalAotCompiles']} programs "
+              f"compiled in {payload['totalCompileWall_s']}s "
+              f"across {len(report)} queries", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
