@@ -343,8 +343,45 @@ def _time_repeats(fn, repeats, counters=False):
         "aotCompileWall_s": d["aot_compile_wall_ns"] / repeats / 1e9,
         "nCompileCacheHits": d["compile_cache_hits"] / repeats,
         "nCompileCacheMisses": d["compile_cache_misses"] / repeats,
+        # resilience events (ISSUE 3 satellite): a bench run that only
+        # finished because stages retried or fell back to the oracle must
+        # say so in its own record
+        "nTransientRetries": d["transient_retries"] / repeats,
+        "nOomRestarts": d["oom_restarts"] / repeats,
+        "nRuntimeFallbacks": d["runtime_fallbacks"] / repeats,
+        "nBreakerTrips": d["breaker_trips"] / repeats,
+        "nQueryFallbacks": d["query_fallbacks"] / repeats,
     }
     return dt, out, per_run
+
+
+def _diag_conf():
+    """Diagnostics confs for bench sessions (ISSUE 3 satellite): every
+    bench run doubles as a diagnostics corpus.  BENCH_DIAG_DIR (default
+    diag_logs; "0" disables) receives one JSONL event log per query,
+    ready for tools/profile_report.py; the per-query record carries the
+    last timed run's log path under "eventLog".  Recorder overhead on
+    the timed TPU runs is one lock+append per event (µs) under launches
+    that cost 10ms-300ms — but when comparing against a pre-diagnostics
+    BENCH_r* baseline at sub-ms granularity, set BENCH_DIAG_DIR=0 for
+    the un-instrumented numbers (the CPU baselines never run through
+    the recorder either way)."""
+    diag_dir = os.environ.get("BENCH_DIAG_DIR", "diag_logs")
+    if not diag_dir or diag_dir == "0":
+        return {}
+    return {
+        "spark.rapids.tpu.diagnostics.enabled": True,
+        "spark.rapids.tpu.diagnostics.eventLogDir": diag_dir,
+        # no rotation for bench corpora: a sweep writes one log per
+        # collect and BENCH_OUT records the paths — rotating at the
+        # default 64 would dangle the recorded eventLog references
+        "spark.rapids.tpu.diagnostics.eventLog.maxFiles": 0,
+    }
+
+
+def _event_log_of(df) -> str:
+    diag = getattr(df, "_last_diag", None)
+    return getattr(diag, "event_log_path", None) or ""
 
 
 def _session(enabled: bool, cache_batches: bool = False):
@@ -353,6 +390,7 @@ def _session(enabled: bool, cache_batches: bool = False):
     return TpuSession({
         "spark.rapids.sql.enabled": enabled,
         "spark.rapids.tpu.scan.cacheDeviceBatches": cache_batches,
+        **_diag_conf(),
     })
 
 
@@ -436,7 +474,8 @@ def main():
         for q in qs.values():
             q["hbm_frac"] = q["eff_gbps"] / V5E_HBM_GBPS
             for k in list(q):
-                q[k] = round(q[k], 6)
+                if isinstance(q[k], (int, float)):
+                    q[k] = round(q[k], 6)
         return {
             "metric": "tpcds_mini_geomean_speedup_vs_vectorized_cpu",
             "value": round(geo_vec, 3),
@@ -523,7 +562,8 @@ def main():
         queries["q6_hot"] = dict(
             tpu_s=t_hot, cpu_vec_s=t_vec, cpu_oracle_s=t_oracle,
             rows_per_s=n_q6 / t_hot, eff_gbps=q6_bytes / t_hot / 1e9,
-            vs_vec=t_vec / t_hot, vs_oracle=t_oracle / t_hot, **ctr_hot)
+            vs_vec=t_vec / t_hot, vs_oracle=t_oracle / t_hot,
+            eventLog=_event_log_of(tpu_hot_df), **ctr_hot)
         stream()
         if scan_variants:
             tpu_scan_df = build_q6(_session(True, cache_batches=False), li)
@@ -533,7 +573,7 @@ def main():
                 tpu_s=t_scan, cpu_vec_s=t_vec, cpu_oracle_s=t_oracle,
                 rows_per_s=n_q6 / t_scan, eff_gbps=q6_bytes / t_scan / 1e9,
                 vs_vec=t_vec / t_scan, vs_oracle=t_oracle / t_scan,
-                **ctr_scan)
+                eventLog=_event_log_of(tpu_scan_df), **ctr_scan)
             stream()
         del li
     except TimeoutError:
@@ -578,7 +618,8 @@ def main():
             queries[f"{name}_{mode}"] = dict(
                 tpu_s=t_tpu, cpu_vec_s=t_vec, cpu_oracle_s=t_oracle,
                 rows_per_s=n / t_tpu, eff_gbps=bytes_ / t_tpu / 1e9,
-                vs_vec=t_vec / t_tpu, vs_oracle=t_oracle / t_tpu, **ctr)
+                vs_vec=t_vec / t_tpu, vs_oracle=t_oracle / t_tpu,
+                eventLog=_event_log_of(df), **ctr)
             stream()
 
     def check_qa(rows, want):
@@ -691,10 +732,12 @@ def main():
         conf = {"spark.rapids.sql.enabled": True,
                 "spark.rapids.memory.gpu.allocFraction": 0.0001,
                 "spark.rapids.sql.batchSizeBytes": 8 << 20,
-                "spark.rapids.sql.reader.batchSizeRows": max(n3 // 8, 1)}
+                "spark.rapids.sql.reader.batchSizeRows": max(n3 // 8, 1),
+                **_diag_conf()}
         fw = get_spill_framework(TpuConf(conf))
         s = TpuSession(conf)
-        t_tpu, rows, ctr = _time_repeats(build(s).collect, repeats,
+        df3 = build(s)
+        t_tpu, rows, ctr = _time_repeats(df3.collect, repeats,
                                          counters=True)
         oracle_rows = build(_session(False)).collect()
         assert sorted(rows) == sorted(oracle_rows), "rung3 mismatch"
@@ -742,7 +785,7 @@ def main():
         queries["rung3_dec128_nested"] = dict(
             tpu_s=t_tpu, cpu_vec_s=0.0, cpu_oracle_s=0.0,
             rows_per_s=n3 / t_tpu, eff_gbps=0.0, vs_vec=1.0, vs_oracle=1.0,
-            oocSort_s=t_sort,
+            oocSort_s=t_sort, eventLog=_event_log_of(df3),
             poolBytes=float(fw.pool_bytes),
             spillToHostCount=float(fw.spill_to_host_count),
             spillToHostBytes=float(fw.spill_to_host_bytes),
@@ -829,6 +872,7 @@ def main():
                 "spark.rapids.sql.enabled": True,
                 "spark.rapids.sql.format.parquet.decode.device": True,
                 "spark.rapids.sql.format.parquet.reader.type": "PERFILE",
+                **_diag_conf(),
             })
             df = build_q6_scan(s)
             t_tpu, rows, ctr = _time_repeats(df.collect, 1, counters=True)
@@ -842,7 +886,7 @@ def main():
                 rows_per_s=n_pq / t_tpu,
                 eff_gbps=file_bytes / t_tpu / 1e9,
                 vs_vec=t_vec / t_tpu, vs_oracle=0.0,
-                fileBytes=file_bytes, **ctr)
+                fileBytes=file_bytes, eventLog=_event_log_of(df), **ctr)
             stream()
         finally:
             shutil.rmtree(tmp, ignore_errors=True)

@@ -264,6 +264,11 @@ def _compile_job(entry: ProgramEntry,
                 dt = time.perf_counter_ns() - t0
                 entry.compiled_by = "aot"
                 PC.bump("aot_compiles")
+                from spark_rapids_tpu.diagnostics import context as _DIAG
+
+                rec = _DIAG.RECORDER
+                if rec is not None:
+                    rec.aot_compile(label, dt)
                 # separate counter: compile_wall_ns is the CRITICAL-PATH
                 # (inline) compile wall; folding background wall into it
                 # would double-count every warmed program (the runtime's

@@ -30,6 +30,7 @@ from collections import OrderedDict
 from typing import Any, Callable, Optional, Tuple
 
 from spark_rapids_tpu import perfcounters as PC
+from spark_rapids_tpu.diagnostics import context as _DIAG
 
 
 class ProgramEntry:
@@ -99,6 +100,9 @@ class ProgramRegistry:
                         e.handoff_pending = False
                     else:
                         PC.bump("compile_cache_hits")
+                        rec = _DIAG.RECORDER
+                        if rec is not None:
+                            rec.cache_event(True, label or e.label)
                 else:
                     # a LATER submission touching the entry means the
                     # original query is done with it: any future runtime
@@ -117,6 +121,9 @@ class ProgramRegistry:
                 e.handoff_pending = not wait_inflight
                 self._entries[key] = e
                 PC.bump("compile_cache_misses")
+                rec = _DIAG.RECORDER
+                if rec is not None:
+                    rec.cache_event(False, label)
                 # LRU bound; never evict an entry a background compile
                 # still owns (the recompile would double minutes of work)
                 excess = len(self._entries) - max(self.max_programs, 1)

@@ -534,6 +534,48 @@ SHUFFLE_COMPRESSION_CODEC = conf(
 METRICS_LEVEL = conf("spark.rapids.sql.metrics.level").doc(
     "ESSENTIAL, MODERATE, or DEBUG.").string_conf("MODERATE")
 
+# --- diagnostics (diagnostics/ — spans, event log, profile reports) --------
+
+DIAGNOSTICS_ENABLED = conf("spark.rapids.tpu.diagnostics.enabled").doc(
+    "Install a QueryDiagnostics recorder around every collect(): each "
+    "operator's batch iteration, jit launch, logical host sync, "
+    "inline/AOT compile, cache hit/miss, and resilience event is "
+    "recorded as a span/event attributed to the current operator, with "
+    "per-operator perf-counter deltas that sum exactly to the process-"
+    "global deltas for the query.  Event verbosity follows "
+    "spark.rapids.sql.metrics.level.  Disabled (default): every "
+    "instrumentation site costs one ambient None-check per event."
+).boolean_conf(False)
+
+DIAGNOSTICS_EVENT_LOG_DIR = conf(
+    "spark.rapids.tpu.diagnostics.eventLogDir").doc(
+    "Directory for per-query JSONL structured event logs "
+    "(query-<id>.jsonl, atomic tmp+rename flush per query, rotation via "
+    "eventLog.maxFiles); consumed by tools/profile_report.py.  Unset: "
+    "events stay in memory (explain('analyze') still works)."
+).string_conf(None)
+
+DIAGNOSTICS_TRACE_DIR = conf(
+    "spark.rapids.tpu.diagnostics.chromeTraceDir").doc(
+    "Directory for per-query Chrome-trace files (query-<id>.trace.json) "
+    "rendering the operator timeline with launches/syncs/compiles "
+    "nested per operator track — load in chrome://tracing or "
+    "ui.perfetto.dev.  Unset: no trace files."
+).string_conf(None)
+
+DIAGNOSTICS_MAX_FILES = conf(
+    "spark.rapids.tpu.diagnostics.eventLog.maxFiles").doc(
+    "Rotation bound per diagnostics sink directory: after each flush, "
+    "oldest files beyond this count are deleted.  <= 0 disables "
+    "rotation.").integer_conf(64)
+
+DIAGNOSTICS_MAX_EVENTS = conf(
+    "spark.rapids.tpu.diagnostics.maxEvents").doc(
+    "In-memory bound on recorded events per query: a launch-per-row "
+    "pathological query must not hold GBs of event dicts until flush.  "
+    "Overflow is counted into query_end's events_dropped field; operator "
+    "summaries and query_start/end are always kept.").integer_conf(200000)
+
 MEM_DEBUG = conf("spark.rapids.memory.gpu.debug").doc(
     "Log arena allocations.").boolean_conf(False)
 

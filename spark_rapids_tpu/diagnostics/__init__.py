@@ -1,0 +1,139 @@
+"""Query diagnostics layer (ISSUE 3): per-operator spans, a structured
+JSONL event log, a Chrome-trace/Perfetto exporter, and offline report
+tooling.
+
+Reference analog: the reference plugin's telemetry stack — GpuExec
+metrics in the SQL UI (``spark.rapids.sql.metrics.level``),
+GpuTaskMetrics per task, and the spark-rapids-tools profiler over event
+logs (SURVEY.md §5.5, L8).  On a tunnel-relayed TPU the *counts*
+(launches, host syncs, D2H bytes) are the portable truth about engine
+quality, so the recorder's core invariant is exact counter attribution:
+per-operator deltas (+ the query-level bucket) sum to the process-global
+``perfcounters.since()`` deltas over the query window.
+
+This ``__init__`` is deliberately lazy: the hot paths import only
+``diagnostics.context`` (one ambient check on the disabled path), and
+everything heavier loads on first enabled query.
+
+Layout:
+  context.py   — the active-recorder slot + contextvar current operator
+  recorder.py  — QueryDiagnostics (spans, events, attribution)
+  sinks.py     — JSONL event log + Chrome-trace/Perfetto export
+  report.py    — offline aggregation (tools/profile_report.py) and
+                 explain("analyze") rendering
+"""
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Optional
+
+_SCOPE_LOCK = threading.Lock()
+_WARNED = [False]
+
+
+class query_scope:
+    """Context manager installing a QueryDiagnostics recorder around one
+    query execution (used by ``DataFrame.collect``).  Yields the recorder
+    or None when diagnostics are disabled — or when another query's
+    recorder is already active (one recorder per process; the concurrent
+    query runs unrecorded rather than corrupting the first's log)."""
+
+    def __init__(self, conf, root, plan_text: str = ""):
+        self._conf = conf
+        self._root = root
+        self._plan_text = plan_text
+        self.diag = None
+
+    def __enter__(self):
+        from spark_rapids_tpu.config import (
+            DIAGNOSTICS_ENABLED,
+            DIAGNOSTICS_MAX_EVENTS,
+            METRICS_LEVEL,
+        )
+        from spark_rapids_tpu.diagnostics import context as CTX
+
+        if not self._conf.get(DIAGNOSTICS_ENABLED):
+            return None
+        with _SCOPE_LOCK:
+            if CTX.RECORDER is not None:
+                if not _WARNED[0]:
+                    _WARNED[0] = True
+                    print("spark_rapids_tpu.diagnostics: a recorder is "
+                          "already active; concurrent query runs "
+                          "unrecorded", file=sys.stderr)
+                return None
+            from spark_rapids_tpu.diagnostics.recorder import (
+                QueryDiagnostics,
+                next_query_id,
+            )
+
+            diag = QueryDiagnostics(
+                next_query_id(),
+                metrics_level=self._conf.get(METRICS_LEVEL),
+                plan_text=self._plan_text,
+                max_events=int(self._conf.get(DIAGNOSTICS_MAX_EVENTS)))
+            diag.register_root(self._root)
+            # install + baseline snapshot atomically under the counter
+            # lock (counter writes attribute under the same lock), so no
+            # bump can land in the global window without also reaching
+            # the recorder — the exact-sum invariant's other half; see
+            # QueryDiagnostics.finish
+            from spark_rapids_tpu import perfcounters as PC
+
+            with PC._LOCK:
+                diag.snap0 = dict(PC.COUNTERS)
+                CTX.RECORDER = diag
+            self.diag = diag
+        return diag
+
+    def __exit__(self, exc_type, exc, tb):
+        if self.diag is None:
+            return False
+        from spark_rapids_tpu.diagnostics import context as CTX
+
+        try:
+            self.diag.finish(self._root,
+                             status="ok" if exc_type is None else
+                             f"error:{getattr(exc_type, '__name__', '?')}")
+        finally:
+            with _SCOPE_LOCK:
+                if CTX.RECORDER is self.diag:
+                    CTX.RECORDER = None
+        self._write_sinks()
+        return False
+
+    def _write_sinks(self) -> None:
+        """Atomic per-query flush of the configured sinks; sink I/O
+        failures never fail the query."""
+        from spark_rapids_tpu.config import (
+            DIAGNOSTICS_EVENT_LOG_DIR,
+            DIAGNOSTICS_MAX_FILES,
+            DIAGNOSTICS_TRACE_DIR,
+        )
+
+        max_files = int(self._conf.get(DIAGNOSTICS_MAX_FILES))
+        log_dir = self._conf.get(DIAGNOSTICS_EVENT_LOG_DIR)
+        trace_dir = self._conf.get(DIAGNOSTICS_TRACE_DIR)
+        try:
+            if log_dir:
+                from spark_rapids_tpu.diagnostics.sinks import write_event_log
+
+                write_event_log(self.diag, log_dir, max_files)
+            if trace_dir:
+                from spark_rapids_tpu.diagnostics.sinks import (
+                    write_chrome_trace,
+                )
+
+                write_chrome_trace(self.diag, trace_dir, max_files)
+        except Exception as e:   # a sink failure must never fail the query
+            print(f"spark_rapids_tpu.diagnostics: sink write failed: {e}",
+                  file=sys.stderr)
+            return
+        if self.diag.event_log_path or self.diag.trace_path:
+            # the flushed file is now the authoritative copy; dropping
+            # the in-memory duplicate keeps a bench sweep's retained
+            # _last_diag recorders from pinning up to maxEvents dicts
+            # each (explain("analyze") reads ops/n_events, not events)
+            with self.diag._lock:
+                self.diag.events = []

@@ -1,0 +1,43 @@
+"""Diagnostics context — the ONLY module the hot paths import.
+
+Two pieces of ambient state:
+
+* ``RECORDER`` — the process-wide active :class:`QueryDiagnostics`
+  recorder (or None).  It is deliberately a plain module attribute, not a
+  contextvar: counter bumps can come from helper threads the engine owns
+  (the multithreaded shuffle writer/reader pool, the AOT compile pool),
+  and a contextvar would silently lose their deltas — then the event
+  log's per-operator sums could never reconcile with the process-global
+  ``perfcounters.since()`` deltas.  One recorder may be active at a time;
+  a concurrent ``collect()`` simply runs unrecorded (see
+  ``diagnostics.query_scope``).
+
+* ``CURRENT_OP`` — the contextvar-scoped "current operator" (a plan-node
+  path string like ``"0.1.0"``).  Each exec operator's batch pull sets it
+  for exactly the duration of its ``next()`` (exec/base._diag), so the
+  innermost operator actually doing the work wins attribution; events
+  fired from threads without an operator context attribute to ``""``
+  (the query-level bucket).
+
+Disabled-path contract (ISSUE 3): every instrumentation site performs
+exactly ONE ambient check — ``if CTX.RECORDER is None: return`` (or the
+equivalent inline test) — before doing any other Python work.  Tests
+assert this by profiling the disabled path (tests/test_diagnostics.py).
+"""
+from __future__ import annotations
+
+from contextvars import ContextVar
+from typing import Optional
+
+# the active QueryDiagnostics recorder; None = diagnostics disabled.
+# Read lock-free from hot paths; written only by diagnostics.query_scope
+# under _RECORDER_LOCK.
+RECORDER = None
+
+CURRENT_OP: "ContextVar[Optional[str]]" = ContextVar(
+    "srt_diagnostics_current_op", default=None)
+
+
+def active():
+    """The active recorder or None (one ambient check)."""
+    return RECORDER

@@ -1,0 +1,400 @@
+"""QueryDiagnostics — the per-query span/event recorder.
+
+Reference analog: GpuTaskMetrics + the Spark event log (SURVEY.md §5.5):
+the reference surfaces per-operator metrics in the SQL UI and writes an
+event log the spark-rapids-tools profiler mines offline.  Here one
+recorder is active per query (installed by ``diagnostics.query_scope``
+around ``DataFrame.collect``); every instrumented site — jit launches
+(``perfcounters.tpu_jit``), logical host syncs (``sync_event`` and the
+scalar dunders), compile-cache hits/misses (``compilecache.registry``),
+inline/AOT compiles, and resilience events (``resilience/domain.py``) —
+records an event tagged with the contextvar-scoped current operator, and
+every perf-counter bump is attributed to that operator's delta bucket.
+
+The invariant the event log is built around: for any counter key, the
+per-operator deltas (including the ``""`` query-level bucket for work no
+operator claimed — plan-time compiles, background pool work, shuffle
+helper threads) sum EXACTLY to the process-global ``perfcounters.since``
+delta over the recorder's window.  tests/test_diagnostics.py pins this.
+
+Event levels honor ``spark.rapids.sql.metrics.level``:
+
+* ESSENTIAL — operator summaries, resilience events, query_start/end.
+* MODERATE  — + launches, logical host syncs, compiles, cache hits/misses.
+* DEBUG     — + one span per operator batch pull (``op_batch``).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from spark_rapids_tpu import perfcounters as PC
+from spark_rapids_tpu.diagnostics import context as CTX
+
+ESSENTIAL, MODERATE, DEBUG = 0, 1, 2
+_LEVELS = {"ESSENTIAL": ESSENTIAL, "MODERATE": MODERATE, "DEBUG": DEBUG}
+
+# Event schema (golden — tests/test_diagnostics.py validates recorded
+# logs against it and docs/diagnostics.md must document every type).
+# Every event also carries: ev, ts_ns, op (the attributed operator path,
+# "" when no operator context was active).
+EVENT_SCHEMA: Dict[str, List[str]] = {
+    "query_start": ["query_id", "started_at", "metrics_level", "plan"],
+    "launch": ["dur_ns", "compiled"],
+    "compile": ["mode", "dur_ns", "label"],
+    "sync": ["kind", "dur_ns", "bytes"],
+    "cache": ["hit", "label"],
+    "resilience": ["kind", "op_name", "detail"],
+    "op_batch": ["path", "batch", "rows", "dur_ns"],
+    "operator": ["path", "name", "describe", "wall_ns", "self_wall_ns",
+                 "batches", "rows", "counters", "metrics", "fallback"],
+    "query_end": ["wall_ns", "status", "counters"],
+}
+
+_QUERY_SEQ = [0]
+_SEQ_LOCK = threading.Lock()
+
+
+def next_query_id() -> str:
+    with _SEQ_LOCK:
+        _QUERY_SEQ[0] += 1
+        seq = _QUERY_SEQ[0]
+    return f"{int(time.time() * 1000):013d}-{os.getpid()}-{seq:04d}"
+
+
+class _OpStat:
+    """Per-operator accumulation: inclusive wall, batch/row counts, and
+    the counter deltas attributed while this operator was current."""
+
+    __slots__ = ("path", "name", "describe", "wall_ns", "batches", "rows",
+                 "t_first_ns", "t_last_ns", "counters", "metrics",
+                 "fallback")
+
+    def __init__(self, path: str, name: str, describe: str):
+        self.path = path
+        self.name = name
+        self.describe = describe
+        self.wall_ns = 0
+        self.batches = 0
+        self.rows = 0
+        self.t_first_ns: Optional[int] = None
+        self.t_last_ns: Optional[int] = None
+        self.counters: Dict[str, int] = {}
+        self.metrics: Dict[str, int] = {}
+        self.fallback = False
+
+
+class QueryDiagnostics:
+    """One query's diagnostics: spans, events, per-operator counter
+    deltas.  Thread-safe; installed as ``diagnostics.context.RECORDER``
+    for the duration of the query by ``diagnostics.query_scope``."""
+
+    def __init__(self, query_id: str, metrics_level: str = "MODERATE",
+                 plan_text: str = "", max_events: int = 200_000):
+        self._lock = threading.Lock()
+        self.query_id = query_id
+        self.max_events = int(max_events)
+        self.dropped_events = 0
+        self.level = _LEVELS.get(str(metrics_level).upper(), MODERATE)
+        self.metrics_level = str(metrics_level).upper()
+        self.plan_text = plan_text
+        self.started_at = time.time()
+        self._t0 = time.perf_counter_ns()
+        self.events: List[Dict[str, Any]] = []
+        self.ops: Dict[str, _OpStat] = {"": _OpStat("", "(query)", "(query)")}
+        self._op_order: List[str] = [""]
+        self._extra_seq = 0
+        # TpuMetric values are CUMULATIVE across collects of a cached
+        # plan (the Spark-UI semantics metrics_report documents); this
+        # log is per-query, so baselines captured at registration turn
+        # them into per-query deltas at finish()
+        self._metric_base: Dict[str, Dict[str, int]] = {}
+        self.snap0 = PC.snapshot()
+        self.total: Dict[str, int] = {}
+        self.wall_ns = 0
+        self.status = "running"
+        self.closed = False
+        self.event_log_path: Optional[str] = None
+        self.trace_path: Optional[str] = None
+        self.n_events = 0          # final count, survives the post-flush
+                                   # drop of the in-memory events list
+
+    # -- time ----------------------------------------------------------
+    def _now(self) -> int:
+        return time.perf_counter_ns() - self._t0
+
+    # -- plan registration ---------------------------------------------
+    def register_root(self, root) -> None:
+        """Assign a plan-node path ("0", "0.1", ...) to every TpuExec in
+        the tree and create its stat bucket.  Idempotent per recorder;
+        overwrites stale paths a previous query's recorder left behind."""
+        from spark_rapids_tpu.exec.base import TpuExec
+
+        def walk(node, path):
+            node._diag_path = path
+            node._diag_qid = self.query_id
+            with self._lock:
+                if path not in self.ops:
+                    self.ops[path] = _OpStat(path, node.node_name,
+                                             node.describe())
+                    self._op_order.append(path)
+                self._metric_base[path] = {
+                    m.name: m.value for m in node.metrics.values()}
+            for i, c in enumerate(node.children):
+                if isinstance(c, TpuExec):
+                    walk(c, f"{path}.{i}")
+
+        walk(root, "0")
+
+    def _register_runtime_op(self, op) -> str:
+        """An exec created after planning (adaptive re-plan, runtime CPU
+        fallback shim) registers lazily under a ``+N`` path."""
+        with self._lock:
+            self._extra_seq += 1
+            path = f"+{self._extra_seq}"
+            self.ops[path] = _OpStat(path, op.node_name, op.describe())
+            self._op_order.append(path)
+            self._metric_base[path] = {
+                m.name: m.value for m in op.metrics.values()}
+        op._diag_path = path
+        op._diag_qid = self.query_id
+        return path
+
+    # -- operator span driving (called from exec/base._diag) -----------
+    def begin_op(self, op):
+        """Returns (path, token, t0) — or None when ``op`` belongs to a
+        DIFFERENT query's registered tree (a concurrent collect whose
+        query_scope lost the one-recorder slot): its spans/counters must
+        not corrupt this recorder's log, so it runs unrecorded.  (A
+        never-diagnosed concurrent tree carries no ownership stamp and
+        still lands here as a ``+N`` op — the one-recorder-per-process
+        design's residual ambiguity.)"""
+        qid = getattr(op, "_diag_qid", None)
+        if qid is not None and qid != self.query_id:
+            return None
+        path = getattr(op, "_diag_path", None)
+        if path is None or path not in self.ops:
+            path = self._register_runtime_op(op)
+        token = CTX.CURRENT_OP.set(path)
+        return path, token, self._now()
+
+    def end_op(self, path: str, token, t0_ns: int,
+               rows: Optional[int]) -> None:
+        CTX.CURRENT_OP.reset(token)
+        t1 = self._now()
+        dur = t1 - t0_ns
+        with self._lock:
+            if self.closed:
+                return
+            st = self.ops.get(path)
+            if st is None:       # another query's stale path (see attribute)
+                return
+            st.wall_ns += dur
+            if st.t_first_ns is None:
+                st.t_first_ns = t0_ns
+            st.t_last_ns = t1
+            if rows is not None:
+                batch_idx = st.batches
+                st.batches += 1
+                st.rows += rows
+                if self.level >= DEBUG:
+                    self._append_event({
+                        "ev": "op_batch", "ts_ns": t0_ns, "op": path,
+                        "path": path, "batch": batch_idx, "rows": rows,
+                        "dur_ns": dur})
+
+    # -- counter attribution (called from perfcounters.bump) -----------
+    def attribute(self, key: str, n: int) -> None:
+        path = CTX.CURRENT_OP.get() or ""
+        with self._lock:
+            if self.closed:
+                return
+            # a path this recorder never registered (a thread still
+            # carrying another query's CURRENT_OP token) lands in the
+            # query-level bucket instead of KeyError-ing the hot path
+            st = self.ops.get(path) or self.ops[""]
+            c = st.counters
+            c[key] = c.get(key, 0) + n
+
+    def _attr_many(self, path: str, deltas) -> None:
+        st = self.ops.get(path) or self.ops[""]
+        c = st.counters
+        for key, n in deltas:
+            c[key] = c.get(key, 0) + n
+
+    def _append_event(self, e) -> None:
+        """Caller holds self._lock.  The in-memory list is bounded (a
+        launch-per-row pathological query must not hold GBs of event
+        dicts until flush); overflow counts into ``events_dropped`` on
+        query_end instead of growing without limit."""
+        if len(self.events) >= self.max_events:
+            self.dropped_events += 1
+            return
+        self.events.append(e)
+
+    def _event(self, min_level: int, ev: str, **fields) -> None:
+        if self.level < min_level:
+            return
+        e = {"ev": ev, "ts_ns": self._now(),
+             "op": CTX.CURRENT_OP.get() or ""}
+        e.update(fields)
+        with self._lock:
+            if not self.closed:
+                self._append_event(e)
+
+    # -- instrumentation entry points ----------------------------------
+    def launch(self, dur_ns: int, compiled: int) -> None:
+        """One jitted program dispatch (perfcounters._CountingJit).
+        Mirrors the counter writes the jit wrapper just made so the
+        per-operator sums reconcile exactly with the globals."""
+        path = CTX.CURRENT_OP.get() or ""
+        deltas = [("programs_launched", 1), ("launch_wall_ns", dur_ns)]
+        if compiled:
+            deltas += [("compiles", compiled), ("compile_wall_ns", dur_ns)]
+        with self._lock:
+            if self.closed:
+                return
+            self._attr_many(path, deltas)
+            if self.level >= MODERATE:
+                ts = self._now()
+                self._append_event({
+                    "ev": "launch", "ts_ns": ts - dur_ns, "op": path,
+                    "dur_ns": dur_ns, "compiled": int(compiled)})
+                if compiled:
+                    self._append_event({
+                        "ev": "compile", "ts_ns": ts - dur_ns, "op": path,
+                        "mode": "inline", "dur_ns": dur_ns, "label": ""})
+
+    def d2h(self, nbytes: int, counted_sync: bool) -> None:
+        """One device->host materialization (ArrayImpl dunder patch)."""
+        path = CTX.CURRENT_OP.get() or ""
+        deltas = [("bytes_d2h", nbytes)]
+        if counted_sync:
+            deltas.append(("host_syncs", 1))
+        with self._lock:
+            if self.closed:
+                return
+            self._attr_many(path, deltas)
+            if counted_sync and self.level >= MODERATE:
+                self._append_event({
+                    "ev": "sync", "ts_ns": self._now(), "op": path,
+                    "kind": "scalar", "dur_ns": 0, "bytes": int(nbytes)})
+
+    def sync_batched(self, dur_ns: int) -> None:
+        """One LOGICAL batched round trip (perfcounters.sync_event exit;
+        the host_syncs counter was attributed at entry via bump).
+        Back-dated to the sync's START like launch events, so the trace
+        span occupies the interval the round trip actually covered."""
+        if self.level < MODERATE:
+            return
+        e = {"ev": "sync", "ts_ns": self._now() - dur_ns,
+             "op": CTX.CURRENT_OP.get() or "", "kind": "batched",
+             "dur_ns": dur_ns, "bytes": 0}
+        with self._lock:
+            if not self.closed:
+                self._append_event(e)
+
+    def cache_event(self, hit: bool, label: str) -> None:
+        """Compile-registry hit/miss (counter attributed via bump)."""
+        self._event(MODERATE, "cache", hit=bool(hit), label=label or "")
+
+    def aot_compile(self, label: str, dur_ns: int) -> None:
+        """One background-pool AOT compile (counters via bump, which the
+        pool thread attributes to the query-level bucket)."""
+        self._event(MODERATE, "compile", mode="aot", dur_ns=dur_ns,
+                    label=label or "")
+
+    def resilience(self, kind: str, op_name: str, detail: str = "") -> None:
+        """A fault-domain event: transient_retry, oom_restart,
+        runtime_fallback, breaker_trip, or query_fallback."""
+        self._event(ESSENTIAL, "resilience", kind=kind, op_name=op_name,
+                    detail=str(detail)[:500])
+
+    # -- finalization --------------------------------------------------
+    def finish(self, root=None, status: str = "ok") -> None:
+        """Close the window: snapshot the global deltas, harvest each
+        registered operator's TpuMetrics, and append the operator
+        summaries + query_end events."""
+        from spark_rapids_tpu.exec.base import TpuExec
+
+        if self.closed:
+            return
+        self.wall_ns = self._now()
+        self.status = status
+        # Snapshot the globals and stop attribution ATOMICALLY: counter
+        # writes hold PC._LOCK across (global increment + attribution),
+        # so every bump — including one from an AOT pool thread racing
+        # the end of collect() — lands either fully inside the window or
+        # fully outside; the per-operator sums stay exactly equal to the
+        # global deltas.  Lock order everywhere: PC._LOCK -> self._lock.
+        with PC._LOCK:
+            cur = dict(PC.COUNTERS)
+            with self._lock:
+                self.closed = True
+        self.total = {k: cur[k] - self.snap0.get(k, 0) for k in cur
+                      if k not in PC.ALIASES}
+        if root is not None:
+            def walk(node):
+                path = getattr(node, "_diag_path", None)
+                st = self.ops.get(path)
+                if st is not None \
+                        and getattr(node, "_diag_qid", None) == self.query_id:
+                    base = self._metric_base.get(path, {})
+                    st.metrics = {
+                        m.name: m.value - base.get(m.name, 0)
+                        for m in node.metrics.values()
+                        if m.value - base.get(m.name, 0)}
+                    st.fallback = bool(st.metrics.get("runtimeFallbacks"))
+                for c in node.children:
+                    if isinstance(c, TpuExec):
+                        walk(c)
+
+            walk(root)
+        with self._lock:
+            # exclusive (self) wall: an operator's pull span contains all
+            # descendant pulls, so ranking by inclusive wall would just
+            # rank by plan depth — subtract the DIRECT children's wall
+            child_wall: Dict[str, int] = {}
+            for path, st in self.ops.items():
+                dot = path.rfind(".")
+                if dot > 0:
+                    parent = path[:dot]
+                    child_wall[parent] = child_wall.get(parent, 0) \
+                        + st.wall_ns
+            for path in self._op_order:
+                st = self.ops[path]
+                if path == "" and not st.counters:
+                    continue
+                self.events.append({
+                    "ev": "operator", "ts_ns": self.wall_ns, "op": path,
+                    "path": path, "name": st.name,
+                    "describe": st.describe, "wall_ns": st.wall_ns,
+                    "self_wall_ns": max(
+                        st.wall_ns - child_wall.get(path, 0), 0),
+                    "batches": st.batches, "rows": st.rows,
+                    "counters": dict(st.counters),
+                    "metrics": dict(st.metrics),
+                    "fallback": st.fallback,
+                    "t_first_ns": st.t_first_ns, "t_last_ns": st.t_last_ns})
+            self.events.append({
+                "ev": "query_end", "ts_ns": self.wall_ns, "op": "",
+                "wall_ns": self.wall_ns, "status": status,
+                "events_dropped": self.dropped_events,
+                "counters": dict(self.total)})
+            self.n_events = len(self.events)
+
+    def header(self) -> Dict[str, Any]:
+        return {
+            "ev": "query_start", "ts_ns": 0, "op": "",
+            "query_id": self.query_id, "started_at": self.started_at,
+            "metrics_level": self.metrics_level,
+            "plan": [{"path": p, "name": self.ops[p].name,
+                      "describe": self.ops[p].describe}
+                     for p in self._op_order if p != ""],
+        }
+
+    def operator_stats(self) -> List[_OpStat]:
+        with self._lock:
+            return [self.ops[p] for p in self._op_order]

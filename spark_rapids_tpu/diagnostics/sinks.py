@@ -1,0 +1,164 @@
+"""Diagnostics sinks: the JSONL structured event log and the
+Chrome-trace/Perfetto exporter.
+
+Reference analog: the Spark event log (what spark-rapids-tools profiles
+offline) and NVTX/XProf timelines (SURVEY.md §5.1/§5.5).  Both sinks are
+pure functions of a finished :class:`QueryDiagnostics`:
+
+* :func:`write_event_log` — one ``query-<id>.jsonl`` per query, written
+  to a temp file then ``os.replace``-d (atomic per-query flush: a killed
+  process never leaves a half-written log), with oldest-first rotation
+  bounded by ``spark.rapids.tpu.diagnostics.eventLog.maxFiles``.
+
+* :func:`chrome_trace` / :func:`write_chrome_trace` — the Chrome trace
+  event format (``chrome://tracing`` / Perfetto ``ui.perfetto.dev``).
+  Each operator gets its own track (tid) named by plan path; its lifetime
+  renders as a B/E span pair and the launches / syncs / compiles / cache
+  and resilience events it attributed nest inside as X / instant events.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List
+
+from spark_rapids_tpu.diagnostics.recorder import QueryDiagnostics
+
+
+# ---------------------------------------------------------------------------
+# JSONL event log
+# ---------------------------------------------------------------------------
+
+def event_log_lines(diag: QueryDiagnostics) -> List[str]:
+    """Header first, then events ordered by ts_ns (stable), query_end
+    last by construction (it carries the final timestamp)."""
+    lines = [json.dumps(diag.header(), default=str)]
+    with diag._lock:
+        events = sorted(diag.events,
+                        key=lambda e: (e.get("ts_ns", 0)))
+    for e in events:
+        lines.append(json.dumps(e, default=str))
+    return lines
+
+
+def write_event_log(diag: QueryDiagnostics, directory: str,
+                    max_files: int = 64) -> str:
+    """Atomically write ``<directory>/query-<id>.jsonl`` and rotate."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"query-{diag.query_id}.jsonl")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("\n".join(event_log_lines(diag)) + "\n")
+    os.replace(tmp, path)
+    diag.event_log_path = path
+    _rotate(directory, "query-", ".jsonl", max_files)
+    return path
+
+
+def _rotate(directory: str, prefix: str, suffix: str,
+            max_files: int) -> None:
+    if max_files <= 0:
+        return
+    try:
+        names = [n for n in os.listdir(directory)
+                 if n.startswith(prefix) and n.endswith(suffix)]
+        if len(names) <= max_files:
+            return
+        # query ids embed a ms timestamp + sequence, so name order is
+        # creation order — no mtime stat storm needed
+        for n in sorted(names)[:len(names) - max_files]:
+            try:
+                os.unlink(os.path.join(directory, n))
+            except OSError:
+                pass
+    except OSError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace / Perfetto
+# ---------------------------------------------------------------------------
+
+def chrome_trace(diag: QueryDiagnostics) -> Dict[str, Any]:
+    """Build the Chrome trace-event dict for one finished query."""
+    pid = 0
+    tids: Dict[str, int] = {}
+    trace: List[Dict[str, Any]] = []
+    seq = [0]
+
+    def emit(ev):
+        seq[0] += 1
+        ev["_seq"] = seq[0]
+        trace.append(ev)
+
+    stats = diag.operator_stats()
+    for i, st in enumerate(stats):
+        tids[st.path] = i
+        label = f"{st.path or 'query'} {st.name}" if st.path else "(query)"
+        emit({"ph": "M", "name": "thread_name", "pid": pid, "tid": i,
+              "ts": 0, "args": {"name": label}})
+    # operator lifetime spans (B/E pairs, one per op that ever ran)
+    for st in stats:
+        if st.t_first_ns is None:
+            continue
+        tid = tids[st.path]
+        args = {"path": st.path, "batches": st.batches, "rows": st.rows,
+                "wall_ms": round(st.wall_ns / 1e6, 3)}
+        if st.counters:
+            args["counters"] = {k: v for k, v in sorted(st.counters.items())}
+        emit({"ph": "B", "name": st.name, "pid": pid, "tid": tid,
+              "ts": st.t_first_ns / 1e3, "args": args})
+        emit({"ph": "E", "name": st.name, "pid": pid, "tid": tid,
+              "ts": st.t_last_ns / 1e3})
+    # point/duration events nested on their operator's track
+    with diag._lock:
+        events = list(diag.events)
+    for e in events:
+        ev = e.get("ev")
+        tid = tids.get(e.get("op") or "", tids.get("", 0))
+        ts_us = e.get("ts_ns", 0) / 1e3
+        if ev == "launch":
+            emit({"ph": "X", "name": "launch", "pid": pid, "tid": tid,
+                  "ts": ts_us, "dur": e["dur_ns"] / 1e3,
+                  "args": {"compiled": e["compiled"]}})
+        elif ev == "compile":
+            emit({"ph": "X", "name": f"compile:{e['mode']}", "pid": pid,
+                  "tid": tid, "ts": ts_us, "dur": e["dur_ns"] / 1e3,
+                  "args": {"label": e.get("label", "")}})
+        elif ev == "sync":
+            emit({"ph": "X", "name": f"sync:{e['kind']}", "pid": pid,
+                  "tid": tid, "ts": ts_us, "dur": e["dur_ns"] / 1e3,
+                  "args": {"bytes": e.get("bytes", 0)}})
+        elif ev == "cache":
+            emit({"ph": "i", "s": "t",
+                  "name": "cache_hit" if e["hit"] else "cache_miss",
+                  "pid": pid, "tid": tid, "ts": ts_us,
+                  "args": {"label": e.get("label", "")}})
+        elif ev == "resilience":
+            emit({"ph": "i", "s": "t", "name": f"resilience:{e['kind']}",
+                  "pid": pid, "tid": tid, "ts": ts_us,
+                  "args": {"op": e.get("op_name", ""),
+                           "detail": e.get("detail", "")}})
+    # monotonic ts; B sorts before its E at equal ts via emission order,
+    # and nested X events never straddle their operator's B/E interval
+    trace.sort(key=lambda ev: (ev["ts"], ev["_seq"]))
+    for ev in trace:
+        del ev["_seq"]
+    return {"traceEvents": trace, "displayTimeUnit": "ms",
+            "otherData": {"query_id": diag.query_id,
+                          "metrics_level": diag.metrics_level}}
+
+
+def write_chrome_trace(diag: QueryDiagnostics, directory: str,
+                       max_files: int = 64) -> str:
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"query-{diag.query_id}.trace.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        # default=str: a stray non-native-JSON value (numpy scalar in a
+        # rows/bytes field) must degrade to a string, not fail the query
+        json.dump(chrome_trace(diag), f, default=str)
+    os.replace(tmp, path)
+    diag.trace_path = path
+    _rotate(directory, "query-", ".trace.json", max_files)
+    return path

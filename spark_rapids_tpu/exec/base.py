@@ -107,6 +107,56 @@ def _fault_domain(fn):
     return wrapper
 
 
+def _diag(fn):
+    """Outermost wrapper: when a QueryDiagnostics recorder is active,
+    every batch pull runs with the contextvar-scoped "current operator"
+    set to this exec's plan-node path, so launches / host syncs /
+    compiles / resilience events fired anywhere below (fault domain and
+    retries included) attribute here, and the pull itself is recorded as
+    a span.  Disabled path: one ambient check per batch, nothing else
+    (ISSUE 3's overhead contract)."""
+    import functools
+
+    from spark_rapids_tpu.diagnostics import context as _CTX
+
+    @functools.wraps(fn)
+    def wrapper(self, *a, **kw):
+        it = fn(self, *a, **kw)
+        try:
+            while True:
+                rec = _CTX.RECORDER
+                if rec is None:
+                    try:
+                        b = next(it)
+                    except StopIteration:
+                        return
+                    yield b
+                    continue
+                span = rec.begin_op(self)
+                if span is None:   # another query's recorder owns the slot
+                    try:
+                        b = next(it)
+                    except StopIteration:
+                        return
+                    yield b
+                    continue
+                path, token, t0 = span
+                rows = None
+                try:
+                    try:
+                        b = next(it)
+                    except StopIteration:
+                        return
+                    rows = b.num_rows
+                finally:
+                    rec.end_op(path, token, t0, rows)
+                yield b
+        finally:
+            it.close()
+
+    return wrapper
+
+
 class _SchemaOnlyExec:
     """Stand-in child inside a detached trace clone (detached_for_trace):
     registry-shared stage functions only ever read ``.output`` from their
@@ -123,17 +173,44 @@ class _SchemaOnlyExec:
 
 
 class TpuExec:
-    """Base TPU operator; children may be TpuExec or transition nodes."""
+    """Base TPU operator; children may be TpuExec or transition nodes.
+
+    Metric registration mirrors the reference's GpuExec pattern: the
+    three standard metrics register here with their reference levels
+    (numOutputRows ESSENTIAL; opTime / numOutputBatches MODERATE), and a
+    subclass declares its operator-specific metrics up front via
+    ``EXTRA_METRICS`` (name -> level) — so the diagnostics layer and
+    ``explain("analyze")`` can filter on ``spark.rapids.sql.metrics.
+    level`` without guessing.  ``metric()`` still creates undeclared
+    names on the fly (at DEBUG level, the reference's default for ad-hoc
+    metrics)."""
+
+    EXTRA_METRICS: Dict[str, str] = {}
 
     def __init__(self, children: Sequence["TpuExec"]):
         self.children: List[TpuExec] = list(children)
         self.metrics: Dict[str, TpuMetric] = {}
-        for m in ("opTime", "numOutputRows", "numOutputBatches"):
-            self.metrics[m] = TpuMetric(m)
+        self.metrics["numOutputRows"] = TpuMetric(
+            "numOutputRows", TpuMetric.ESSENTIAL)
+        for m in ("opTime", "numOutputBatches"):
+            self.metrics[m] = TpuMetric(m, TpuMetric.MODERATE)
+        for m, level in self.EXTRA_METRICS.items():
+            self.metrics[m] = TpuMetric(m, level)
+
+    # ad-hoc metrics created by the fault domain record operator-level
+    # failures — ESSENTIAL like the resilience events themselves, so
+    # explain("analyze") at the default level never hides a retry/fallback
+    _ADHOC_METRIC_LEVELS = {
+        "transientRetries": TpuMetric.ESSENTIAL,
+        "retryCount": TpuMetric.ESSENTIAL,
+        "runtimeFallbacks": TpuMetric.ESSENTIAL,
+        "breakerTrips": TpuMetric.ESSENTIAL,
+    }
 
     def metric(self, name: str) -> TpuMetric:
         if name not in self.metrics:
-            self.metrics[name] = TpuMetric(name)
+            self.metrics[name] = TpuMetric(
+                name, self._ADHOC_METRIC_LEVELS.get(name, TpuMetric.DEBUG))
         return self.metrics[name]
 
     @property
@@ -283,12 +360,14 @@ class TpuExec:
     def __init_subclass__(cls, **kw):
         super().__init_subclass__(**kw)
         # wrap execute_columnar with per-operator trace annotations
-        # (NvtxRange analog); zero overhead unless profiling is enabled
-        # fault domain outermost: it must see failures escaping the whole
-        # iteration, trace annotations included
+        # (NvtxRange analog); zero overhead unless profiling is enabled.
+        # fault domain outside the trace: it must see failures escaping
+        # the whole iteration, trace annotations included.  diagnostics
+        # outermost: the span covers retries/fallbacks, and resilience
+        # events fired by the fault domain attribute to this operator
         if "execute_columnar" in cls.__dict__:
-            cls.execute_columnar = _fault_domain(
-                _traced(cls.execute_columnar))
+            cls.execute_columnar = _diag(_fault_domain(
+                _traced(cls.execute_columnar)))
 
     def collect_metrics(self, into=None) -> Dict[str, int]:
         into = into if into is not None else {}

@@ -26,6 +26,8 @@ class CoalesceGoal:
 
 
 class TpuCoalesceBatchesExec(TpuExec):
+    EXTRA_METRICS = {"concatTime": "MODERATE"}
+
     def __init__(self, goal: CoalesceGoal, child: TpuExec):
         super().__init__([child])
         self.goal = goal

@@ -35,6 +35,10 @@ from spark_rapids_tpu.plan.nodes import (
 
 
 class TpuShuffleExchangeExec(TpuExec):
+    # GpuShuffleExchangeExec write/fetch metric pair
+    EXTRA_METRICS = {"shuffleWriteTime": "MODERATE",
+                     "shuffleReadTime": "MODERATE"}
+
     def __init__(self, partitioning, child: TpuExec, ansi: bool = False,
                  conf=None):
         super().__init__([child])

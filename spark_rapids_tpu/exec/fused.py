@@ -63,6 +63,8 @@ def _mask_col(c: DeviceColumn, keep) -> DeviceColumn:
 class TpuJoinAggFusedExec(TpuExec):
     """agg(join(probe, build)) in (at most) three XLA programs."""
 
+    EXTRA_METRICS = {"buildTime": "MODERATE"}
+
     def __init__(self, agg, join: _BaseTpuJoinExec):
         super().__init__(list(join.children))
         self.agg = agg
@@ -208,7 +210,10 @@ class TpuJoinAggFusedExec(TpuExec):
             for s in build_spill:
                 s.unpin()
                 s.close()
-        with join.metric("buildTime").timed():
+        # timed on the FUSED exec's own metric: the inner join node is
+        # not in this exec's children, so a metric written there would
+        # never be harvested by collect_metrics / explain("analyze")
+        with self.metric("buildTime").timed():
             build = join._prepare_build(build_batch, join.right_keys,
                                         pre_ops=pre_ops,
                                         in_schema=pre_schema)

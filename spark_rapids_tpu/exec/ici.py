@@ -450,6 +450,9 @@ class TpuIciShuffleJoinExec(TpuExec):
     + one unmatched-build tail program after the last epoch).
     """
 
+    # AQE skew-split count (OptimizeSkewedJoin analog)
+    EXTRA_METRICS = {"skewSplits": "DEBUG"}
+
     def __init__(self, join, left_inner, right_inner, mesh,
                  axis: str = "dp", epoch_bytes: int = 1 << 28):
         from spark_rapids_tpu.plan.nodes import JoinType

@@ -180,6 +180,10 @@ class _MaterializedExec(TpuExec):
 
 
 class _BaseTpuJoinExec(TpuExec):
+    # GpuShuffledHashJoinExec metric set: build + stream/probe time
+    EXTRA_METRICS = {"buildTime": "MODERATE",
+                     "joinTime": "MODERATE"}
+
     def __init__(self, left: TpuExec, right: TpuExec,
                  left_keys: List[Expression], right_keys: List[Expression],
                  join_type: JoinType, condition: Optional[Expression],

@@ -38,6 +38,9 @@ def _gather_batch(batch: ColumnarBatch, perm, num_rows,
 
 
 class TpuSortExec(TpuExec):
+    # declared up front with reference levels (GpuSortExec metrics)
+    EXTRA_METRICS = {"sortTime": "MODERATE"}
+
     def __init__(self, orders: List[Tuple[Expression, SortSpec]],
                  is_global: bool, child: TpuExec, ansi: bool = False,
                  ooc_bytes: int = 1 << 30, ooc_chunk_rows: int = 1024):

@@ -66,6 +66,10 @@ def _filters_to_arrow(pushed) -> Optional[list]:
 
 
 class TpuFileSourceScanExec(TpuExec):
+    # GpuFileSourceScanExec metric set (bufferTime/gpuDecodeTime)
+    EXTRA_METRICS = {"bufferTime": "MODERATE",
+                     "gpuDecodeTime": "MODERATE"}
+
     def __init__(self, plan: FileSourceScan, conf: TpuConf):
         super().__init__([])
         self.plan = plan

@@ -131,6 +131,8 @@ class TpuDataWritingCommandExec(TpuExec):
     """GpuFileFormatDataWriter analog: consumes the child's device batches
     and writes them; dynamic partitioning splits on device first."""
 
+    EXTRA_METRICS = {"writeTime": "MODERATE"}
+
     def __init__(self, fmt: str, path: str, partition_cols: List[str],
                  child: TpuExec, tpu_conf, mode: str = "overwrite"):
         super().__init__([child])

@@ -194,7 +194,7 @@ class SparkPlanMeta(BaseMeta):
         if reason:
             from spark_rapids_tpu import perfcounters as PC
 
-            PC.bump("breakerPlanFallbacks")
+            PC.bump("breaker_plan_fallbacks")
             self.will_not_work_on_tpu(reason)
 
     # ------------------------------------------------------------------

@@ -35,6 +35,8 @@ from typing import Any, Dict
 
 import jax
 
+from spark_rapids_tpu.diagnostics import context as _DIAG
+
 _LOCK = threading.Lock()
 
 COUNTERS: Dict[str, int] = {
@@ -53,12 +55,24 @@ COUNTERS: Dict[str, int] = {
     "aot_compile_wall_ns": 0,    # background-pool compile wall
     "aot_compile_errors": 0,
     # resilience (stage-level fault domains, resilience/domain.py)
-    "transientRetries": 0,
-    "oomRestarts": 0,
-    "runtimeFallbacks": 0,
-    "breakerTrips": 0,
-    "breakerPlanFallbacks": 0,
-    "queryFallbacks": 0,
+    "transient_retries": 0,
+    "oom_restarts": 0,
+    "runtime_fallbacks": 0,
+    "breaker_trips": 0,
+    "breaker_plan_fallbacks": 0,
+    "query_fallbacks": 0,
+}
+
+# One-release read/write compat for the pre-normalization camelCase keys
+# (ISSUE 3 satellite): ``bump`` accepts them, ``snapshot``/``since``
+# still expose them.  New code must use the snake_case canonical names.
+ALIASES: Dict[str, str] = {
+    "transientRetries": "transient_retries",
+    "oomRestarts": "oom_restarts",
+    "runtimeFallbacks": "runtime_fallbacks",
+    "breakerTrips": "breaker_trips",
+    "breakerPlanFallbacks": "breaker_plan_fallbacks",
+    "queryFallbacks": "query_fallbacks",
 }
 
 
@@ -67,13 +81,26 @@ def bump(key: str, n: int = 1) -> None:
     (load / add / store) and CPython may switch threads between them, so
     concurrent unguarded increments lose updates; every write in this
     module routes through ``_LOCK``."""
+    key = ALIASES.get(key, key)
+    # attribution happens INSIDE the counter lock so a bump is atomic
+    # with respect to the diagnostics window: the recorder installs /
+    # snapshots / closes under this same lock, so every bump lands
+    # either fully inside the window (global delta AND per-op bucket) or
+    # fully outside (neither) — the exact-sum invariant survives racing
+    # background threads (lock order: _LOCK -> recorder._lock)
     with _LOCK:
         COUNTERS[key] = COUNTERS.get(key, 0) + n
+        rec = _DIAG.RECORDER
+        if rec is not None:
+            rec.attribute(key, n)
 
 
 def snapshot() -> Dict[str, int]:
     with _LOCK:
-        return dict(COUNTERS)
+        snap = dict(COUNTERS)
+    for alias, canon in ALIASES.items():
+        snap[alias] = snap[canon]
+    return snap
 
 
 def since(snap: Dict[str, int]) -> Dict[str, int]:
@@ -88,29 +115,61 @@ def reset() -> None:
 
 
 class _CountingJit:
-    """Wraps a ``jax.jit``-ed callable; counts launches and compiles."""
+    """Wraps a ``jax.jit``-ed callable; counts launches and compiles.
 
-    __slots__ = ("_jitted",)
+    Compile detection is serialized per wrapper: the monotonic
+    ``_seen`` high-water mark of the jit cache size is advanced under
+    ``_detect_lock``, taken only on the miss path (cache size grew), so
+    two threads racing the same uncompiled program attribute exactly one
+    compile between them instead of two (or zero).  The compile COUNT is
+    exact; ``compile_wall_ns`` attribution is approximate under
+    concurrent mixed-shape calls on one wrapper (a cached call landing
+    right after another thread's cache insertion can claim the compile
+    and contribute its own small wall) — the count, not the wall, is the
+    portable signal (module docstring)."""
+
+    __slots__ = ("_jitted", "_detect_lock", "_seen")
 
     def __init__(self, jitted):
         self._jitted = jitted
+        self._detect_lock = threading.Lock()
+        try:
+            self._seen = jitted._cache_size()
+        except Exception:
+            self._seen = 0
 
     def __call__(self, *args, **kwargs):
         jitted = self._jitted
-        n0 = jitted._cache_size()
         t0 = time.perf_counter_ns()
         out = jitted(*args, **kwargs)
         dt = time.perf_counter_ns() - t0
-        compiled = jitted._cache_size() > n0
+        compiled = 0
+        n1 = jitted._cache_size()
+        if n1 != self._seen:         # miss path only: serialize detection
+            with self._detect_lock:
+                if n1 > self._seen:
+                    compiled = n1 - self._seen
+                    self._seen = n1
+                elif n1 < self._seen:
+                    # the jit cache SHRANK (jax.clear_caches): this call
+                    # re-traced, so count one compile and re-anchor the
+                    # high-water mark instead of going silent until the
+                    # cache regrows past the stale value
+                    compiled = 1
+                    self._seen = n1
         with _LOCK:
             COUNTERS["programs_launched"] += 1
             COUNTERS["launch_wall_ns"] += dt
             if compiled:
-                COUNTERS["compiles"] += 1
+                COUNTERS["compiles"] += compiled
                 # the compiling call's wall is ~all trace+XLA-compile time
                 # (dispatch+execute are orders of magnitude smaller); this
                 # is the inline twin of the AOT pool's measured wall
                 COUNTERS["compile_wall_ns"] += dt
+            # inside _LOCK: atomic with the diagnostics window (see bump)
+            rec = _DIAG.RECORDER
+            if rec is not None:
+                rec.launch(dt, compiled)
         return out
 
     def __getattr__(self, name):  # lower/trace/eval_shape passthrough
@@ -139,10 +198,15 @@ def _install_sync_counters() -> bool:
             nbytes = self.nbytes
         except Exception:
             nbytes = 0
+        counted_sync = not _in_sync_event()
         with _LOCK:
-            if not _in_sync_event():
+            if counted_sync:
                 COUNTERS["host_syncs"] += 1
             COUNTERS["bytes_d2h"] += nbytes
+            # inside _LOCK: atomic with the diagnostics window (see bump)
+            rec = _DIAG.RECORDER
+            if rec is not None:
+                rec.d2h(nbytes, counted_sync)
 
     try:
         real_array = impl.__array__
@@ -188,15 +252,27 @@ class sync_event:
     ``jax.device_get`` over a pytree materializes every leaf; counting each
     leaf's ``__array__`` as a separate sync would overstate the round trips
     the engine design costs.  Inside this context the per-buffer patch
-    still accounts bytes_d2h but not host_syncs."""
+    still accounts bytes_d2h but not host_syncs.
+
+    Nested events count ONCE: a ``sync_get`` issued from inside another
+    ``sync_event`` is part of the same logical round trip, so only the
+    depth-0 entry bumps ``host_syncs`` (ISSUE 3 satellite — the old code
+    double-counted every nested batched fetch)."""
 
     def __enter__(self):
-        bump("host_syncs")
-        _tls.in_sync_event = getattr(_tls, "in_sync_event", 0) + 1
+        depth = getattr(_tls, "in_sync_event", 0)
+        _tls.in_sync_event = depth + 1
+        if depth == 0:
+            self._t0 = time.perf_counter_ns()
+            bump("host_syncs")
         return self
 
     def __exit__(self, *a):
         _tls.in_sync_event -= 1
+        if _tls.in_sync_event == 0:
+            rec = _DIAG.RECORDER
+            if rec is not None:
+                rec.sync_batched(time.perf_counter_ns() - self._t0)
 
 
 def _in_sync_event() -> bool:

@@ -30,8 +30,17 @@ import random
 import time
 from typing import Iterator
 
+from spark_rapids_tpu.diagnostics import context as DIAG_CTX
 from spark_rapids_tpu.resilience import classify as CL
 from spark_rapids_tpu.resilience import faults
+
+
+def _diag_event(kind: str, op_name: str, detail: str = "") -> None:
+    """One ambient check; records a resilience event when a
+    QueryDiagnostics recorder is active (ISSUE 3)."""
+    rec = DIAG_CTX.RECORDER
+    if rec is not None:
+        rec.resilience(kind, op_name, detail)
 
 
 def _confs():
@@ -178,8 +187,10 @@ def run_fault_domain(op, fn, args, kwargs) -> Iterator:
                 if kind == CL.TRANSIENT and not exhausted \
                         and transient_used < conf["max_transient"]:
                     transient_used += 1
-                    PC.bump("transientRetries")
+                    PC.bump("transient_retries")
                     op.metric("transientRetries").add(1)
+                    _diag_event("transient_retry", name,
+                                f"{type(e).__name__}: {e}")
                     _close_quietly(it)
                     it = None
                     _backoff_sleep(conf["backoff_ms"], transient_used)
@@ -187,8 +198,10 @@ def run_fault_domain(op, fn, args, kwargs) -> Iterator:
                 if kind == CL.DEVICE_OOM and not exhausted \
                         and oom_used < conf["max_oom"]:
                     oom_used += 1
-                    PC.bump("oomRestarts")
+                    PC.bump("oom_restarts")
                     op.metric("retryCount").add(1)
+                    _diag_event("oom_restart", name,
+                                f"{type(e).__name__}: {e}")
                     from spark_rapids_tpu.memory.spill import (
                         get_spill_framework,
                     )
@@ -210,8 +223,10 @@ def run_fault_domain(op, fn, args, kwargs) -> Iterator:
                         reason=f"{type(e).__name__}: {e}")
                     e._srt_breaker_recorded = True
                     if tripped:
-                        PC.bump("breakerTrips")
+                        PC.bump("breaker_trips")
                         op.metric("breakerTrips").add(1)
+                        _diag_event("breaker_trip", name,
+                                    f"{type(e).__name__}: {e}")
                 if not conf["fallback"] or yielded:
                     raise
                 try:
@@ -223,8 +238,10 @@ def run_fault_domain(op, fn, args, kwargs) -> Iterator:
                     # the oracle agrees this fails; surface the ORIGINAL
                     # error so expected-error tests keep their match
                     raise e from oracle_err
-                PC.bump("runtimeFallbacks")
+                PC.bump("runtime_fallbacks")
                 op.metric("runtimeFallbacks").add(1)
+                _diag_event("runtime_fallback", name,
+                            f"{type(e).__name__}: {e}")
                 _close_quietly(it)
                 it = None
                 for b2 in out:

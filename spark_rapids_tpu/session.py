@@ -567,45 +567,70 @@ class DataFrame:
 
             enable_operator_tracing(
                 root, bool(self.session.conf.get(PROFILE_ENABLED)))
-            # Plan-time AOT pipeline (compilecache/aot.py): enumerate the
-            # stage programs this exec tree will need and compile them on
-            # the background pool NOW, so the first operator's first batch
-            # overlaps the compiles of everything downstream.  Idempotent
-            # per planned tree; a warm-up failure never reaches the query.
-            from spark_rapids_tpu.compilecache import maybe_submit_aot
+            # Diagnostics (ISSUE 3): one QueryDiagnostics recorder spans
+            # the window from AOT submission through execution — operator
+            # spans, launch/sync/compile/resilience events, per-operator
+            # counter attribution — flushed atomically to the configured
+            # sinks on exit and kept on the DataFrame for
+            # explain("analyze")
+            from spark_rapids_tpu.diagnostics import query_scope
 
-            maybe_submit_aot(root, self.session.conf)
-            # Admission control: the thread driving this query's iterator
-            # chain holds a TpuSemaphore permit while it touches the device
-            # (reference: GpuSemaphore.acquireIfNecessary at first batch).
-            from spark_rapids_tpu.memory import get_semaphore, get_spill_framework
-            from spark_rapids_tpu.memory.retry import (
-                force_retry_oom,
-                force_split_and_retry_oom,
-            )
-            from spark_rapids_tpu.config import TEST_RETRY_OOM_INJECTION_MODE
-
-            get_spill_framework(self.session.conf)
-            inject = self.session.conf.get(TEST_RETRY_OOM_INJECTION_MODE)
-            if inject and inject != "NONE":
-                kind, _, n = inject.partition(":")
-                if kind.upper() == "RETRY":
-                    force_retry_oom(int(n or 1))
-                elif kind.upper() == "SPLIT":
-                    force_split_and_retry_oom(int(n or 1))
-            # chaos injection (the force_retry_oom API generalized to
-            # compile/transient/poison faults at named operators); armed
-            # once per distinct spec, process-global like the fault list
-            from spark_rapids_tpu.config import RESILIENCE_TEST_INJECT
-            from spark_rapids_tpu.resilience.faults import arm_conf_spec
-
-            arm_conf_spec(self.session.conf.get(RESILIENCE_TEST_INJECT))
-            sem = get_semaphore(self.session.conf.concurrent_tpu_tasks)
+            scope = query_scope(self.session.conf, root)
             try:
-                with sem.scope():
-                    host = TpuColumnarToRowExec(root).collect_host()
-            except Exception as e:
-                host = self._query_fallback(e)
+                with scope:
+                    # Plan-time AOT pipeline (compilecache/aot.py): enumerate
+                    # the stage programs this exec tree will need and compile
+                    # them on the background pool NOW, so the first operator's
+                    # first batch overlaps the compiles of everything
+                    # downstream.  Idempotent per planned tree; a warm-up
+                    # failure never reaches the query.
+                    from spark_rapids_tpu.compilecache import maybe_submit_aot
+
+                    maybe_submit_aot(root, self.session.conf)
+                    # Admission control: the thread driving this query's
+                    # iterator chain holds a TpuSemaphore permit while it
+                    # touches the device (reference:
+                    # GpuSemaphore.acquireIfNecessary at first batch).
+                    from spark_rapids_tpu.memory import (
+                        get_semaphore,
+                        get_spill_framework,
+                    )
+                    from spark_rapids_tpu.memory.retry import (
+                        force_retry_oom,
+                        force_split_and_retry_oom,
+                    )
+                    from spark_rapids_tpu.config import (
+                        TEST_RETRY_OOM_INJECTION_MODE,
+                    )
+
+                    get_spill_framework(self.session.conf)
+                    inject = self.session.conf.get(TEST_RETRY_OOM_INJECTION_MODE)
+                    if inject and inject != "NONE":
+                        kind, _, n = inject.partition(":")
+                        if kind.upper() == "RETRY":
+                            force_retry_oom(int(n or 1))
+                        elif kind.upper() == "SPLIT":
+                            force_split_and_retry_oom(int(n or 1))
+                    # chaos injection (the force_retry_oom API generalized to
+                    # compile/transient/poison faults at named operators);
+                    # armed once per distinct spec, process-global like the
+                    # fault list
+                    from spark_rapids_tpu.config import RESILIENCE_TEST_INJECT
+                    from spark_rapids_tpu.resilience.faults import arm_conf_spec
+
+                    arm_conf_spec(self.session.conf.get(RESILIENCE_TEST_INJECT))
+                    sem = get_semaphore(self.session.conf.concurrent_tpu_tasks)
+                    try:
+                        with sem.scope():
+                            host = TpuColumnarToRowExec(root).collect_host()
+                    except Exception as e:
+                        host = self._query_fallback(e)
+            finally:
+                # None when this collect ran unrecorded; assigned on the
+                # FAILURE path too — explain("analyze") must not report a
+                # stale previous query's diagnostics as if they described
+                # the latest (failed) execution
+                self._last_diag = scope.diag
             lists = [h.to_pylist() for h in host]
             return list(zip(*lists)) if lists else []
         cols, n = execute_cpu_plan(root, ansi=self.session.conf.ansi_enabled)
@@ -646,7 +671,13 @@ class DataFrame:
                                         ansi=conf.ansi_enabled)
         except Exception as oracle_err:
             raise exc from oracle_err
-        PC.bump("queryFallbacks")
+        PC.bump("query_fallbacks")
+        from spark_rapids_tpu.diagnostics import context as DIAG_CTX
+
+        rec = DIAG_CTX.RECORDER
+        if rec is not None:
+            rec.resilience("query_fallback", "collect",
+                           f"{type(exc).__name__}: {exc}")
         return [c.to_host() for c in cols]
 
     def to_pydict(self) -> Dict[str, list]:
@@ -675,9 +706,24 @@ class DataFrame:
         return "(plan ran on the CPU oracle; no TPU metrics)"
 
     def explain(self, mode: str = "formatted") -> str:
+        """``mode="analyze"``: re-print the plan tree annotated with each
+        node's metrics, attributed counter deltas, compile-cache hits,
+        and fallback status from the LAST collect() (requires
+        spark.rapids.tpu.diagnostics.enabled for the counter columns;
+        falls back to metrics-only otherwise) — the diagnostics analog of
+        Spark's AQE ``explain`` with runtime statistics."""
         from spark_rapids_tpu.exec.base import TpuExec
 
         root, meta = self._planned()
+        if mode == "analyze":
+            if not isinstance(root, TpuExec):
+                return "(plan ran on the CPU oracle; no TPU metrics)"
+            from spark_rapids_tpu.config import METRICS_LEVEL
+            from spark_rapids_tpu.diagnostics.report import analyze_tree
+
+            return analyze_tree(root, getattr(self, "_last_diag", None),
+                                meta,
+                                self.session.conf.get(METRICS_LEVEL))
         s = root.pretty() if isinstance(root, TpuExec) else root.pretty()
         if meta is not None:
             fb = meta.explain(only_fallback=True)

@@ -64,8 +64,8 @@ def assert_tpu_and_cpu_are_equal_collect(
     if not allow_runtime_fallback:
         delta = PC.since(snap)
         silently_degraded = {
-            k: delta[k] for k in ("runtimeFallbacks", "queryFallbacks",
-                                  "breakerPlanFallbacks")
+            k: delta[k] for k in ("runtime_fallbacks", "query_fallbacks",
+                                  "breaker_plan_fallbacks")
             if delta.get(k)}
         assert not silently_degraded, (
             f"TPU run silently degraded to the CPU oracle "

@@ -119,15 +119,15 @@ def test_chaos_sweep(shape, kind):
             continue
         fired_ops.add(op)
         d = PC.snapshot()
-        handled = (d["transientRetries"] + d["oomRestarts"]
-                   + d["runtimeFallbacks"] + d["queryFallbacks"])
+        handled = (d["transient_retries"] + d["oom_restarts"]
+                   + d["runtime_fallbacks"] + d["query_fallbacks"])
         if kind == "transient":
-            assert d["transientRetries"] >= 1, f"{shape}/{op}: no retry"
+            assert d["transient_retries"] >= 1, f"{shape}/{op}: no retry"
         elif kind == "compile":
-            assert d["runtimeFallbacks"] + d["queryFallbacks"] >= 1, \
+            assert d["runtime_fallbacks"] + d["query_fallbacks"] >= 1, \
                 f"{shape}/{op}: no fallback recorded"
         elif kind == "oom":
-            assert d["oomRestarts"] >= 1, f"{shape}/{op}: no OOM restart"
+            assert d["oom_restarts"] >= 1, f"{shape}/{op}: no OOM restart"
         assert handled >= 1, f"{shape}/{op}/{kind}: fault not observed"
     for want in MUST_FIRE:
         assert any(want in op for op in fired_ops), \

@@ -1,0 +1,348 @@
+"""ISSUE 3: query diagnostics layer.
+
+Pins the four tentpole deliverables — span recorder with exact counter
+attribution, JSONL event log + Chrome-trace sinks, explain("analyze"),
+and the profile-report aggregation — plus the golden event schema and
+the disabled-path overhead contract (no diagnostics Python work beyond
+one ambient check per event).
+"""
+import cProfile
+import json
+import os
+import pstats
+
+import pytest
+
+from spark_rapids_tpu import perfcounters as PC
+
+
+def _session(tmp_path, extra=None, enabled=True):
+    from spark_rapids_tpu.session import TpuSession
+
+    conf = {
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.tpu.diagnostics.enabled": enabled,
+        "spark.rapids.tpu.diagnostics.eventLogDir": str(tmp_path / "logs"),
+        "spark.rapids.tpu.diagnostics.chromeTraceDir": str(tmp_path / "logs"),
+    }
+    conf.update(extra or {})
+    return TpuSession(conf)
+
+
+def _build_query(s):
+    """Join + grouped agg + sort: a multi-operator TPC-like plan."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.session import col, lit, sum_
+
+    sales = s.create_dataframe(
+        {"k": [1, 2, 1, 3, 2, 1, 4, 4], "v": [10, 20, 30, 40, 50, 60, 7, 9]},
+        T.StructType([T.StructField("k", T.INT, False),
+                      T.StructField("v", T.LONG, False)]))
+    dim = s.create_dataframe(
+        {"k": [1, 2, 3, 4], "grp": [0, 0, 1, 1]},
+        T.StructType([T.StructField("k", T.INT, False),
+                      T.StructField("grp", T.INT, False)]))
+    return (sales.filter(col("v") > lit(5))
+            .join(dim, on="k")
+            .group_by("grp").agg(sum_("v", "sv"))
+            .order_by("grp"))
+
+
+def _run_and_load(tmp_path, extra=None):
+    s = _session(tmp_path, extra)
+    df = _build_query(s)
+    rows = df.collect()
+    assert sorted(rows) == [(0, 170), (1, 56)]
+    diag = df._last_diag
+    assert diag is not None and diag.event_log_path
+    with open(diag.event_log_path) as f:
+        events = [json.loads(line) for line in f]
+    return df, diag, events
+
+
+# ---------------------------------------------------------------------------
+# golden event-log schema
+# ---------------------------------------------------------------------------
+
+# The golden copy: a schema drift (renamed field, dropped event type) must
+# fail HERE, not just in the generated docs.
+GOLDEN_SCHEMA = {
+    "query_start": ["query_id", "started_at", "metrics_level", "plan"],
+    "launch": ["dur_ns", "compiled"],
+    "compile": ["mode", "dur_ns", "label"],
+    "sync": ["kind", "dur_ns", "bytes"],
+    "cache": ["hit", "label"],
+    "resilience": ["kind", "op_name", "detail"],
+    "op_batch": ["path", "batch", "rows", "dur_ns"],
+    "operator": ["path", "name", "describe", "wall_ns", "self_wall_ns",
+                 "batches", "rows", "counters", "metrics", "fallback"],
+    "query_end": ["wall_ns", "status", "counters"],
+}
+
+
+def test_event_schema_is_golden():
+    from spark_rapids_tpu.diagnostics.recorder import EVENT_SCHEMA
+
+    assert EVENT_SCHEMA == GOLDEN_SCHEMA
+
+
+def test_event_log_schema_stability(tmp_path):
+    _df, _diag, events = _run_and_load(tmp_path)
+    assert events[0]["ev"] == "query_start"
+    assert events[-1]["ev"] == "query_end"
+    for e in events:
+        assert e["ev"] in GOLDEN_SCHEMA, f"unknown event type {e['ev']}"
+        for field in ("ev", "ts_ns", "op"):
+            assert field in e, f"{e['ev']} missing common field {field}"
+        for field in GOLDEN_SCHEMA[e["ev"]]:
+            assert field in e, f"{e['ev']} missing {field}"
+    header = events[0]
+    paths = {n["path"] for n in header["plan"]}
+    assert paths, "header plan is empty"
+    # every operator summary's path is either a plan node or the
+    # query-level bucket / a runtime-registered op
+    for e in events:
+        if e["ev"] == "operator" and e["path"] not in ("",):
+            assert e["path"] in paths or e["path"].startswith("+")
+    # multi-operator plan: scan, stage, join/agg, sort...
+    assert len(paths) >= 3
+    # the log records real work
+    assert any(e["ev"] == "launch" for e in events)
+    assert any(e["ev"] == "cache" for e in events)
+
+
+def test_per_operator_counters_sum_to_global(tmp_path):
+    """The acceptance invariant: per-operator deltas (incl. the
+    query-level bucket) sum EXACTLY to the process-global since() deltas
+    for the query window (query_end.counters)."""
+    _df, _diag, events = _run_and_load(tmp_path)
+    ops = [e for e in events if e["ev"] == "operator"]
+    end = [e for e in events if e["ev"] == "query_end"][0]
+    assert ops and end["counters"]["programs_launched"] > 0
+    for key in ("programs_launched", "host_syncs", "bytes_d2h",
+                "bytes_h2d", "compiles", "compile_cache_misses"):
+        per_op = sum(e["counters"].get(key, 0) for e in ops)
+        assert per_op == end["counters"][key], (
+            f"{key}: per-op sum {per_op} != global {end['counters'][key]}")
+    # and real attribution happened: some operator (not the query-level
+    # bucket) claimed launches
+    attributed = sum(e["counters"].get("programs_launched", 0)
+                     for e in ops if e["path"] != "")
+    assert attributed > 0
+
+
+def test_perfetto_export_opens(tmp_path):
+    """Valid JSON, monotonic ts, matched B/E pairs per track."""
+    _df, diag, _events = _run_and_load(tmp_path)
+    assert diag.trace_path and os.path.exists(diag.trace_path)
+    with open(diag.trace_path) as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    assert evs, "empty trace"
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts), "trace timestamps not monotonic"
+    stacks = {}
+    for e in evs:
+        assert e["ph"] in ("M", "B", "E", "X", "i")
+        if e["ph"] == "B":
+            stacks.setdefault(e["tid"], []).append(e["name"])
+        elif e["ph"] == "E":
+            stack = stacks.get(e["tid"], [])
+            assert stack, f"E without B on tid {e['tid']}"
+            stack.pop()
+        elif e["ph"] == "X":
+            assert e["dur"] >= 0
+    for tid, stack in stacks.items():
+        assert not stack, f"unmatched B events on tid {tid}: {stack}"
+    # operator spans exist and launches nest under some operator track
+    assert any(e["ph"] == "B" for e in evs)
+    assert any(e["ph"] == "X" and e["name"] == "launch" for e in evs)
+
+
+def test_debug_level_records_batch_spans(tmp_path):
+    _df, _diag, events = _run_and_load(
+        tmp_path, {"spark.rapids.sql.metrics.level": "DEBUG"})
+    batches = [e for e in events if e["ev"] == "op_batch"]
+    assert batches, "DEBUG level must record per-batch operator spans"
+    assert all(e["dur_ns"] >= 0 for e in batches)
+
+
+def test_essential_level_elides_launch_events(tmp_path):
+    _df, _diag, events = _run_and_load(
+        tmp_path, {"spark.rapids.sql.metrics.level": "ESSENTIAL"})
+    assert not [e for e in events if e["ev"] in ("launch", "sync", "cache")]
+    assert [e for e in events if e["ev"] == "operator"]
+
+
+# ---------------------------------------------------------------------------
+# explain("analyze")
+# ---------------------------------------------------------------------------
+
+def test_explain_analyze_annotates_plan(tmp_path):
+    df, _diag, _events = _run_and_load(tmp_path)
+    out = df.explain("analyze")
+    assert "wall=" in out
+    assert "programs_launched=" in out
+    assert "TpuLocalTableScanExec" in out
+    assert "status=ok" in out
+    # without diagnostics the mode still renders (metrics only)
+    s2 = _session(tmp_path, enabled=False)
+    df2 = _build_query(s2)
+    df2.collect()
+    out2 = df2.explain("analyze")
+    assert "diagnostics were not enabled" in out2
+    # a later UNdiagnosed collect must not report the stale recorder of
+    # an earlier diagnosed run as if it described the latest execution
+    df.session.conf = df.session.conf.set(
+        "spark.rapids.tpu.diagnostics.enabled", False)
+    df.collect()
+    assert "diagnostics were not enabled" in df.explain("analyze")
+
+
+def test_runtime_fallback_marked_in_analyze_and_log(tmp_path):
+    """A chaos-injected deterministic failure routes the stage to the CPU
+    oracle; the event log records the resilience event and the analyze
+    output flags the operator."""
+    s = _session(tmp_path, {
+        "spark.rapids.tpu.resilience.testInject": "compile:TpuSortExec:1",
+        "spark.rapids.tpu.resilience.backoffBaseMs": 0,
+    })
+    df = _build_query(s)
+    rows = df.collect()
+    assert sorted(rows) == [(0, 170), (1, 56)]
+    with open(df._last_diag.event_log_path) as f:
+        events = [json.loads(line) for line in f]
+    res = [e for e in events if e["ev"] == "resilience"]
+    assert any(e["kind"] == "runtime_fallback" for e in res)
+    end = [e for e in events if e["ev"] == "query_end"][0]
+    assert end["counters"]["runtime_fallbacks"] >= 1
+    assert "fallback=CPU(runtime)" in df.explain("analyze")
+
+
+# ---------------------------------------------------------------------------
+# sinks: rotation + atomicity
+# ---------------------------------------------------------------------------
+
+def test_event_log_rotation(tmp_path):
+    s = _session(tmp_path, {
+        "spark.rapids.tpu.diagnostics.eventLog.maxFiles": 2})
+    for _ in range(4):
+        _build_query(s).collect()
+    logs = [n for n in os.listdir(tmp_path / "logs")
+            if n.endswith(".jsonl")]
+    assert len(logs) == 2
+    # no stray .tmp files (atomic flush)
+    assert not [n for n in os.listdir(tmp_path / "logs")
+                if n.endswith(".tmp")]
+
+
+# ---------------------------------------------------------------------------
+# disabled-path overhead
+# ---------------------------------------------------------------------------
+
+def test_disabled_path_does_no_diagnostics_work(tmp_path):
+    """With diagnostics disabled, the instrumentation must cost one
+    ambient check per event: profiling a launch/sync/collect-heavy
+    workload shows ZERO calls into the recorder/context modules."""
+    import jax.numpy as jnp
+
+    s = _session(tmp_path, enabled=False)
+    df = _build_query(s)
+    df.collect()          # warm compile caches outside the profile
+    fn = PC.tpu_jit(lambda x: x * 2 + 1)
+    x = jnp.arange(64)
+    fn(x)
+
+    prof = cProfile.Profile()
+    prof.enable()
+    for _ in range(50):
+        fn(x)
+        with PC.sync_event():
+            pass
+    df.collect()
+    prof.disable()
+    banned = (os.path.join("diagnostics", "recorder.py"),
+              os.path.join("diagnostics", "context.py"),
+              os.path.join("diagnostics", "sinks.py"))
+    offenders = [
+        (fname, func)
+        for (fname, _lineno, func) in pstats.Stats(prof).stats
+        if any(b in fname for b in banned)]
+    assert not offenders, (
+        f"diagnostics work on the disabled path: {offenders}")
+
+
+# ---------------------------------------------------------------------------
+# profile report
+# ---------------------------------------------------------------------------
+
+def test_profile_report_top_operators(tmp_path):
+    _run_and_load(tmp_path)
+    _run_and_load(tmp_path)
+    from spark_rapids_tpu.diagnostics.report import (
+        load_logs,
+        render_report,
+        top_operators,
+        totals_summary,
+    )
+
+    profiles = load_logs([str(tmp_path / "logs")])
+    assert len(profiles) == 2
+    report = render_report(profiles)
+    assert "top operators by self wall time" in report
+    assert "top operators by host syncs" in report
+    assert "compile cache" in report
+    by_wall = top_operators(profiles, "wall_ns", 5)
+    assert by_wall and all(a["wall_ns"] > 0 for _n, a in by_wall)
+    # exclusive (self) wall never exceeds inclusive wall
+    for _n, a in by_wall:
+        assert 0 <= a["self_wall_ns"] <= a["wall_ns"] + 1
+    tot = totals_summary(profiles)
+    assert tot["queries"] == 2
+    assert 0.0 <= tot["compile_cache_hit_rate"] <= 1.0
+
+
+def test_profile_report_cli_json(tmp_path, capsys):
+    _run_and_load(tmp_path)
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        import profile_report
+    finally:
+        sys.path.pop(0)
+    rc = profile_report.main([str(tmp_path / "logs"), "--json", "--top", "3"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["queries"] and payload["totals"]["queries"] == 1
+    assert "top_by_wall" in payload and "top_by_host_syncs" in payload
+
+
+def test_profile_report_diff_matches_by_plan(tmp_path):
+    _run_and_load(tmp_path / "a")
+    _run_and_load(tmp_path / "b")
+    from spark_rapids_tpu.diagnostics.report import diff_profiles, load_logs
+
+    base = load_logs([str(tmp_path / "a" / "logs")])
+    new = load_logs([str(tmp_path / "b" / "logs")])
+    rows = diff_profiles(base, new)
+    assert len(rows) == 1 and rows[0]["matched"] == base[0].query_id
+    assert "wall_delta_pct" in rows[0]
+    assert rows[0]["programs_launched"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# docs drift
+# ---------------------------------------------------------------------------
+
+def test_docs_cover_counters_and_confs():
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        import check_counters
+    finally:
+        sys.path.pop(0)
+    assert check_counters.check() == []

@@ -68,6 +68,72 @@ def test_sync_get_is_one_logical_sync():
     assert out["a"][2] == 2
 
 
+def test_nested_sync_event_counts_once():
+    """ISSUE 3 satellite: a sync_get issued from inside another
+    sync_event is part of the same logical round trip — the old
+    __enter__ bumped host_syncs at every depth, double-counting."""
+    import jax.numpy as jnp
+
+    y = jnp.arange(8)
+    snap = PC.snapshot()
+    with PC.sync_event():
+        PC.sync_get({"a": y})            # nested: must NOT count again
+        with PC.sync_event():
+            pass
+    assert PC.since(snap)["host_syncs"] == 1
+
+
+def test_counting_jit_concurrent_first_call_counts_one_compile():
+    """ISSUE 3 satellite: two threads racing the same uncompiled program
+    could both observe a _cache_size() delta (or neither); detection is
+    now serialized per wrapper — exactly one compile lands."""
+    import threading
+
+    import jax.numpy as jnp
+
+    fn = PC.tpu_jit(lambda x: x * 3 + 2)
+    x = jnp.arange(32)
+    snap = PC.snapshot()
+    barrier = threading.Barrier(2)
+    errors = []
+
+    def worker():
+        try:
+            barrier.wait()
+            fn(x).block_until_ready()
+        except Exception as e:           # pragma: no cover - surfaced below
+            errors.append(e)
+
+    ts = [threading.Thread(target=worker) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors
+    d = PC.since(snap)
+    assert d["programs_launched"] == 2
+    assert d["compiles"] == 1, f"compile race miscount: {d['compiles']}"
+    # a later new-shape call still detects its compile
+    snap = PC.snapshot()
+    fn(jnp.arange(64)).block_until_ready()
+    assert PC.since(snap)["compiles"] == 1
+
+
+def test_counter_key_aliases_read_and_write():
+    """Counter names are canonical snake_case; the camelCase spellings
+    stay readable via snapshot()/since() and writable via bump() for one
+    release."""
+    assert "transient_retries" in PC.COUNTERS
+    assert "transientRetries" not in PC.COUNTERS
+    snap = PC.snapshot()
+    PC.bump("transientRetries")          # legacy write spelling
+    PC.bump("oom_restarts")
+    d = PC.since(snap)
+    assert d["transient_retries"] == 1 and d["transientRetries"] == 1
+    assert d["oom_restarts"] == 1 and d["oomRestarts"] == 1
+    PC.reset()
+
+
 def test_session_applies_compile_cache_conf():
     import jax
 

@@ -173,7 +173,7 @@ def test_exhausted_child_budget_not_retried_by_parent():
     with pytest.raises(InjectedTransientError):
         list(run_fault_domain(_Op(), fn, (), {}))
     assert calls[0] == 1           # no transient restarts
-    assert PC.snapshot()["transientRetries"] == 0
+    assert PC.snapshot()["transient_retries"] == 0
 
 
 def test_retry_is_device_oom_walks_chain():
@@ -191,8 +191,8 @@ def test_transient_fault_retries_and_matches_oracle():
     inject_fault("TpuSortExec", "transient")
     assert_tpu_and_cpu_are_equal_collect(_sorted_query, conf=FAST,
                                          ignore_order=False)
-    assert PC.snapshot()["transientRetries"] == 1
-    assert PC.snapshot()["runtimeFallbacks"] == 0
+    assert PC.snapshot()["transient_retries"] == 1
+    assert PC.snapshot()["runtime_fallbacks"] == 0
 
 
 def test_compile_fault_falls_back_and_matches_oracle():
@@ -200,14 +200,14 @@ def test_compile_fault_falls_back_and_matches_oracle():
     assert_tpu_and_cpu_are_equal_collect(_sorted_query, conf=FAST,
                                          ignore_order=False,
                                          allow_runtime_fallback=True)
-    assert PC.snapshot()["runtimeFallbacks"] >= 1
+    assert PC.snapshot()["runtime_fallbacks"] >= 1
 
 
 def test_injected_oom_spills_and_restarts():
     inject_fault("TpuSortExec", "oom")
     assert_tpu_and_cpu_are_equal_collect(_sorted_query, conf=FAST,
                                          ignore_order=False)
-    assert PC.snapshot()["runtimeFallbacks"] == 0
+    assert PC.snapshot()["runtime_fallbacks"] == 0
 
 
 def test_exhausted_transient_escalates_to_fallback():
@@ -217,8 +217,8 @@ def test_exhausted_transient_escalates_to_fallback():
     assert_tpu_and_cpu_are_equal_collect(_sorted_query, conf=conf,
                                          ignore_order=False,
                                          allow_runtime_fallback=True)
-    assert PC.snapshot()["transientRetries"] == 2
-    assert PC.snapshot()["runtimeFallbacks"] >= 1
+    assert PC.snapshot()["transient_retries"] == 2
+    assert PC.snapshot()["runtime_fallbacks"] >= 1
 
 
 def test_disabled_resilience_lets_fault_kill_query():
@@ -245,7 +245,7 @@ def test_midstream_transient_restart_replays_correctly():
         return _df(s, 64).select(col("k"), (col("v") * 2).alias("d"))
 
     assert_tpu_and_cpu_are_equal_collect(q, conf=conf)
-    assert PC.snapshot()["transientRetries"] == 1
+    assert PC.snapshot()["transient_retries"] == 1
 
 
 def test_midstream_deterministic_uses_query_fallback():
@@ -258,7 +258,7 @@ def test_midstream_deterministic_uses_query_fallback():
 
     assert_tpu_and_cpu_are_equal_collect(q, conf=conf,
                                          allow_runtime_fallback=True)
-    assert PC.snapshot()["queryFallbacks"] == 1
+    assert PC.snapshot()["query_fallbacks"] == 1
 
 
 def test_per_op_metrics_report_path_taken():
@@ -287,7 +287,7 @@ def test_conf_driven_injection():
     oracle = _sorted_query(
         TpuSession({"spark.rapids.sql.enabled": False})).collect()
     assert rows == oracle
-    assert PC.snapshot()["transientRetries"] == 1
+    assert PC.snapshot()["transient_retries"] == 1
 
 
 def test_parse_inject_conf_spec():
@@ -318,7 +318,7 @@ def test_breaker_trips_and_tags_plan_time():
     for _ in range(2):
         inject_fault("TpuSortExec", "compile")
         assert _sorted_query(TpuSession(BRK)).collect() == oracle
-    assert PC.snapshot()["breakerTrips"] == 1
+    assert PC.snapshot()["breaker_trips"] == 1
     snap = get_breaker().snapshot()
     assert len(snap) == 1 and snap[0][1] == "OPEN"
     assert snap[0][0][0] == "Sort"     # plan-node class name keys the entry
@@ -329,8 +329,8 @@ def test_breaker_trips_and_tags_plan_time():
     PC.reset()
     df = _sorted_query(TpuSession(BRK))
     assert df.collect() == oracle
-    assert PC.snapshot()["runtimeFallbacks"] == 0
-    assert PC.snapshot()["queryFallbacks"] == 0
+    assert PC.snapshot()["runtime_fallbacks"] == 0
+    assert PC.snapshot()["query_fallbacks"] == 0
     assert active_faults() == [("TpuSortExec", "compile", 1)]
     assert "circuit breaker open" in df.explain()
 
@@ -353,7 +353,7 @@ def test_breaker_ttl_half_open_readmits():
     # closes the breaker entirely
     PC.reset()
     assert _sorted_query(TpuSession(BRK)).collect() == oracle
-    assert PC.snapshot()["runtimeFallbacks"] == 0
+    assert PC.snapshot()["runtime_fallbacks"] == 0
     assert b.snapshot() == []
 
 
@@ -387,8 +387,8 @@ def test_breaker_keyed_by_expression_fingerprint():
     assert_tpu_and_cpu_are_equal_collect(other_sort, conf=BRK,
                                          ignore_order=False)
     # ran on TPU (no fallback, no new trip)
-    assert PC.snapshot()["breakerTrips"] == 0
-    assert PC.snapshot()["runtimeFallbacks"] == 0
+    assert PC.snapshot()["breaker_trips"] == 0
+    assert PC.snapshot()["runtime_fallbacks"] == 0
 
 
 def test_breaker_half_open_stalled_probe_readmits():
@@ -426,13 +426,13 @@ def test_breaker_trip_invalidates_cached_plan():
 
     inject_fault("TpuSortExec", "compile")
     assert df.collect() == oracle          # trips (threshold 1) + falls back
-    assert PC.snapshot()["breakerTrips"] == 1
+    assert PC.snapshot()["breaker_trips"] == 1
 
     PC.reset()
     inject_fault("TpuSortExec", "compile")   # would fire if Sort ran on TPU
     assert df.collect() == oracle            # same DataFrame, cached plan
-    assert PC.snapshot()["runtimeFallbacks"] == 0
-    assert PC.snapshot()["queryFallbacks"] == 0
+    assert PC.snapshot()["runtime_fallbacks"] == 0
+    assert PC.snapshot()["query_fallbacks"] == 0
     assert active_faults() == [("TpuSortExec", "compile", 1)]
 
 
@@ -446,7 +446,7 @@ def test_conf_injection_arms_once_per_session():
     df = _sorted_query(s)
     df.collect()
     df.collect()
-    assert PC.snapshot()["transientRetries"] == 1
+    assert PC.snapshot()["transient_retries"] == 1
 
 
 def test_changing_inject_spec_disarms_previous():
@@ -463,8 +463,8 @@ def test_changing_inject_spec_disarms_previous():
     rows = _sorted_query(TpuSession(c2)).collect()
     assert rows == _oracle_rows()
     # the stale compile fault was de-armed, not fired as a fallback
-    assert PC.snapshot()["runtimeFallbacks"] == 0
-    assert PC.snapshot()["transientRetries"] == 1
+    assert PC.snapshot()["runtime_fallbacks"] == 0
+    assert PC.snapshot()["transient_retries"] == 1
 
 
 def test_asserts_guard_detects_plan_time_breaker_routing():

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Drift check: every perf counter and every diagnostics conf must be
+documented (ISSUE 3 satellite).
+
+Checks, failing the suite (tests/test_diagnostics.py calls
+:func:`check`) and this CLI (exit 1) on drift:
+
+* every canonical ``perfcounters.COUNTERS`` key appears in
+  ``docs/diagnostics.md``;
+* every ``spark.rapids.tpu.diagnostics.*`` conf key is registered in the
+  typed registry AND appears in ``docs/diagnostics.md`` AND in the
+  generated ``docs/configs.md`` (i.e. gen_docs.py was re-run);
+* every event type in ``diagnostics.recorder.EVENT_SCHEMA`` appears in
+  ``docs/diagnostics.md``.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def check() -> list:
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu.config import _REGISTRY
+    from spark_rapids_tpu.diagnostics.recorder import EVENT_SCHEMA
+
+    problems = []
+
+    def read(name):
+        path = os.path.join(REPO, "docs", name)
+        try:
+            with open(path) as f:
+                return f.read()
+        except OSError:
+            problems.append(f"missing docs file: docs/{name}")
+            return ""
+
+    diag_md = read("diagnostics.md")
+    configs_md = read("configs.md")
+
+    for key in sorted(PC.COUNTERS):
+        if key in PC.ALIASES:
+            continue
+        # backtick-delimited: a bare substring test is vacuous for
+        # counter names that are ordinary words ("compiles")
+        if f"`{key}`" not in diag_md:
+            problems.append(
+                f"perf counter '{key}' is not documented (backticked) in "
+                f"docs/diagnostics.md")
+    for key in sorted(PC.ALIASES):
+        if PC.ALIASES[key] not in PC.COUNTERS:
+            problems.append(
+                f"perfcounters alias '{key}' points at unknown "
+                f"counter '{PC.ALIASES[key]}'")
+
+    diag_confs = [k for k in _REGISTRY
+                  if k.startswith("spark.rapids.tpu.diagnostics.")]
+    if not diag_confs:
+        problems.append("no spark.rapids.tpu.diagnostics.* confs "
+                        "registered")
+    for key in sorted(diag_confs):
+        if key not in diag_md:
+            problems.append(
+                f"conf '{key}' is not documented in docs/diagnostics.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+
+    for ev in sorted(EVENT_SCHEMA):
+        if f"`{ev}`" not in diag_md:
+            problems.append(
+                f"event type '{ev}' is not documented in "
+                f"docs/diagnostics.md")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        for p in problems:
+            print(f"DRIFT: {p}", file=sys.stderr)
+        return 1
+    print("counters/confs/events documentation: in sync")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Aggregate diagnostics event logs into a profile report.
+
+The offline half of the diagnostics layer (the spark-rapids-tools
+profiler analog): point it at one or more ``query-*.jsonl`` files (or
+directories of them, e.g. the ``spark.rapids.tpu.diagnostics.
+eventLogDir``) and it prints top operators by wall / host syncs / D2H
+bytes / launches, the compile-cache hit rate, and a resilience event
+summary.  With ``--diff`` it matches queries between two logs by plan
+signature and reports per-query regressions (wall, launches, syncs,
+D2H).
+
+Usage:
+    python tools/profile_report.py LOG_OR_DIR [LOG_OR_DIR ...]
+    python tools/profile_report.py NEW_LOGS... --diff BASELINE_LOG_OR_DIR
+    python tools/profile_report.py diag_logs --json --top 5
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Aggregate spark_rapids_tpu diagnostics event logs "
+                    "into a profile report.")
+    ap.add_argument("logs", nargs="+",
+                    help="JSONL event logs or directories of query-*.jsonl")
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows per top-operators section (default 10)")
+    ap.add_argument("--diff", metavar="BASELINE",
+                    help="baseline log/dir: report per-query regression "
+                         "diff of LOGS vs BASELINE")
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable JSON instead of text")
+    args = ap.parse_args(argv)
+
+    from spark_rapids_tpu.diagnostics.report import (
+        diff_profiles,
+        load_logs,
+        render_diff,
+        render_report,
+        resilience_summary,
+        top_operators,
+        totals_summary,
+    )
+
+    profiles = load_logs(args.logs)
+    if not profiles:
+        print("no event logs found", file=sys.stderr)
+        return 2
+
+    if args.json:
+        payload = {
+            "queries": [{"query_id": qp.query_id, "path": qp.path,
+                         "wall_ns": qp.wall_ns, "status": qp.status,
+                         "totals": qp.totals} for qp in profiles],
+            "totals": totals_summary(profiles),
+            "resilience": resilience_summary(profiles),
+            "top_by_wall": top_operators(profiles, "wall_ns", args.top),
+            "top_by_host_syncs": top_operators(profiles, "host_syncs",
+                                               args.top),
+            "top_by_bytes_d2h": top_operators(profiles, "bytes_d2h",
+                                              args.top),
+        }
+        if args.diff:
+            payload["diff"] = diff_profiles(load_logs([args.diff]),
+                                            profiles)
+        print(json.dumps(payload))
+        return 0
+
+    print(render_report(profiles, top_n=args.top))
+    if args.diff:
+        print()
+        print(render_diff(load_logs([args.diff]), profiles))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -69,15 +69,15 @@ def run_cell(conf, op, kind, seed):
     clear_faults()
 
     path = []
-    if d["transientRetries"]:
-        path.append(f"retry x{d['transientRetries']}")
-    if d["oomRestarts"]:
-        path.append(f"oom-restart x{d['oomRestarts']}")
-    if d["runtimeFallbacks"]:
-        path.append(f"stage-fallback x{d['runtimeFallbacks']}")
-    if d["queryFallbacks"]:
+    if d["transient_retries"]:
+        path.append(f"retry x{d['transient_retries']}")
+    if d["oom_restarts"]:
+        path.append(f"oom-restart x{d['oom_restarts']}")
+    if d["runtime_fallbacks"]:
+        path.append(f"stage-fallback x{d['runtime_fallbacks']}")
+    if d["query_fallbacks"]:
         path.append("query-fallback")
-    if d["breakerTrips"]:
+    if d["breaker_trips"]:
         path.append("breaker-trip")
     path = ", ".join(path) or ("-" if fired else "not-executed")
 
