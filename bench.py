@@ -398,6 +398,80 @@ def _bytes_of(*col_dicts):
     return float(sum(v.nbytes for d in col_dicts for v in d.values()))
 
 
+def run_concurrency(n_workers: int, rounds: int = 3,
+                    rows: int = 200_000) -> dict:
+    """``bench.py --concurrency N`` (ISSUE 4 satellite): N threads run
+    the rung-2-shaped mini queries concurrently through the query
+    lifecycle layer; reports p50/p95 per-query latency and admission
+    queue wait.  Emits one JSON line like the main suite."""
+    import threading
+
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.lifecycle import last_query_stats
+    from spark_rapids_tpu.session import TpuSession, sum_
+
+    ss = make_store_sales(rows)
+    dd = make_date_dim()
+    conf = {
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.tpu.concurrentQueries": str(
+            int(os.environ.get("BENCH_CONCURRENT_QUERIES", 4))),
+        "spark.rapids.tpu.admission.maxQueueDepth": "64",
+    }
+
+    def q(s):
+        sales = _df(s, {k: ss[k] for k in ("date_sk", "store_sk",
+                                           "ext_sales")},
+                    [T.INT, T.INT, T.LONG])
+        dates = _df(s, dd, [T.INT, T.INT, T.INT, T.INT])
+        return sales.join(dates, on="date_sk", how="inner") \
+            .group_by("store_sk").agg(sum_("ext_sales", "s"))
+
+    # warm compile once, single-threaded
+    q(TpuSession(conf)).collect()
+
+    walls, waits, lock = [], [], threading.Lock()
+    snap = PC.snapshot()
+    t0 = time.perf_counter()
+
+    def worker():
+        s = TpuSession(conf)
+        for _ in range(rounds):
+            q(s).collect()
+            st = last_query_stats() or {}
+            with lock:
+                walls.append(st.get("wall_ns", 0))
+                waits.append(st.get("admission_wait_ns", 0))
+
+    threads = [threading.Thread(target=worker) for _ in range(n_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+    d = PC.since(snap)
+
+    def pct(xs, p):
+        xs = sorted(xs) or [0]
+        return round(xs[min(int(len(xs) * p), len(xs) - 1)] / 1e6, 3)
+
+    out = {
+        "metric": "concurrency", "unit": "ms",
+        "workers": n_workers, "rounds": rounds, "rows": rows,
+        "wall_s": round(wall_s, 3),
+        "queries": len(walls),
+        "qps": round(len(walls) / wall_s, 2) if wall_s else 0.0,
+        "latency_ms": {"p50": pct(walls, 0.5), "p95": pct(walls, 0.95)},
+        "queue_wait_ms": {"p50": pct(waits, 0.5), "p95": pct(waits, 0.95)},
+        "counters": {k: d[k] for k in (
+            "queries_admitted", "queries_rejected", "queries_cancelled",
+            "deadline_trips", "admission_wait_ns")},
+    }
+    print(json.dumps(out))
+    return out
+
+
 def main():
     # BENCH_PLATFORM=cpu runs the suite on the XLA CPU backend (fast
     # correctness smoke; the container sitecustomize pre-imports jax on the
@@ -407,6 +481,17 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", plat)
+    # --concurrency N: run the concurrent-query latency sweep instead of
+    # the single-stream suite
+    import sys
+
+    if "--concurrency" in sys.argv:
+        idx = sys.argv.index("--concurrency")
+        n_workers = int(sys.argv[idx + 1]) if idx + 1 < len(sys.argv) else 4
+        run_concurrency(n_workers,
+                        rounds=int(os.environ.get("BENCH_CONC_ROUNDS", 3)),
+                        rows=int(os.environ.get("BENCH_CONC_ROWS", 200_000)))
+        return
     n = int(os.environ.get("BENCH_ROWS", 20_000_000))
     n_q6 = int(os.environ.get("BENCH_Q6_ROWS",
                               50_000_000 if n >= 10_000_000 else n))

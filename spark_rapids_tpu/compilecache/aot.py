@@ -223,7 +223,7 @@ def _get_pool() -> _DaemonPool:
 
 def _compile_job(entry: ProgramEntry,
                  args_factory: Callable[[], Optional[tuple]],
-                 label: str, conf=None) -> None:
+                 label: str, conf=None, token=None) -> None:
     """Warm one program via the AOT API: ``jitted.lower(*abstract).
     compile()`` on the RAW jitted (bypassing the launch/compile perf
     counters — a background warm-up is not an engine launch).  Operands
@@ -252,6 +252,10 @@ def _compile_job(entry: ProgramEntry,
         else contextlib.nullcontext()
     try:
         with scope:
+            # a cancelled submitter's speculative warm-ups are dead work:
+            # skip them (the runtime path compiles inline if ever needed)
+            if token is not None and token.cancelled:
+                return
             arg_sets = args_factory() or []
             if arg_sets and not isinstance(arg_sets, list):
                 arg_sets = [arg_sets]
@@ -259,6 +263,8 @@ def _compile_job(entry: ProgramEntry,
             for args in arg_sets:
                 if args is None:
                     continue
+                if token is not None and token.cancelled:
+                    return
                 t0 = time.perf_counter_ns()
                 raw.lower(*args).compile()
                 dt = time.perf_counter_ns() - t0
@@ -298,15 +304,29 @@ class AotSubmission:
         return [label for label, _, _ in self.items]
 
     def wait(self, timeout: Optional[float] = None) -> bool:
-        """Block until every submitted compile finished; True if all did."""
+        """Block until every submitted compile finished; True if all did.
+        Cancellable: raises if the current query's token trips while
+        waiting."""
+        from spark_rapids_tpu.lifecycle.context import current_token
+
+        token = current_token()
         deadline = None if timeout is None else time.monotonic() + timeout
         for _, entry, _fut in self.items:
             if entry.aot_state is None:
                 continue   # was already compiled before this submission
-            left = None if deadline is None \
-                else max(deadline - time.monotonic(), 0.0)
-            if not entry.ready_event.wait(left):
-                return False
+            while True:
+                left = None if deadline is None \
+                    else max(deadline - time.monotonic(), 0.0)
+                slice_s = 0.05 if token is not None else left
+                if left is not None:
+                    slice_s = min(slice_s, left) if slice_s is not None \
+                        else left
+                if entry.ready_event.wait(slice_s):
+                    break
+                if token is not None:
+                    token.check()
+                if deadline is not None and time.monotonic() >= deadline:
+                    return False
         return True
 
     def states(self) -> dict:
@@ -345,8 +365,10 @@ def submit_plan(root, wait: bool = False) -> AotSubmission:
     except Exception:
         return sub
     from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.lifecycle.context import current_token
 
     conf = get_conf()   # pinned for every background trace of this plan
+    token = current_token()   # the submitting query's cancel token
     pool = _get_pool()
     seen_keys = set()
     for node in _post_order(root):
@@ -392,7 +414,7 @@ def submit_plan(root, wait: bool = False) -> AotSubmission:
             entry.ready_event.clear()
             try:
                 fut = pool.submit(_compile_job, entry, prog.args_factory,
-                                  prog.label, conf)
+                                  prog.label, conf, token)
             except Exception:
                 # a failed submit (e.g. executor shutting down) must not
                 # leave a queued entry nobody will ever mark ready —

@@ -147,13 +147,22 @@ class ProgramRegistry:
         # generous cap: proceeding while the pool worker is mid-trace of
         # the SAME fn would race the shared trace-time aux (ANSI message
         # store) — blocking longer is strictly safer than corrupting it,
-        # and "compiling" is only ever set by an actively running job
+        # and "compiling" is only ever set by an actively running job.
+        # Cancellable (ISSUE 4): a cancelled/deadline-tripped query must
+        # not sit behind minutes of pool compile work, so inside a query
+        # the wait polls the CancelToken in short slices
+        from spark_rapids_tpu.lifecycle.context import current_token
+
+        token = current_token()
         waited = 0.0
         while wait_inflight and e.aot_state == "compiling" \
                 and waited < 7200.0:
-            if e.ready_event.wait(30.0):
+            slice_s = 0.05 if token is not None else 30.0
+            if e.ready_event.wait(slice_s):
                 break
-            waited += 30.0
+            waited += slice_s
+            if token is not None:
+                token.check()
         return e
 
     def peek(self, key: str) -> Optional[ProgramEntry]:

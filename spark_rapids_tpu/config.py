@@ -180,6 +180,48 @@ SPLIT_UNTIL_ROWS = conf("spark.rapids.tpu.retry.minSplitRows").doc(
     "Do not split batches below this many rows on SplitAndRetry."
 ).integer_conf(8)
 
+# --- query lifecycle (admission control / deadlines / cancellation) --------
+
+CONCURRENT_QUERIES = conf("spark.rapids.tpu.concurrentQueries").doc(
+    "How many queries may be admitted (planning + executing) at once; "
+    "further collect() calls wait in a FIFO admission queue "
+    "(lifecycle/admission.py — the query-level analog of "
+    "spark.rapids.sql.concurrentGpuTasks, which gates device access "
+    "*within* an admitted query).  0 disables admission control."
+).integer_conf(4)
+
+ADMISSION_MAX_QUEUE = conf("spark.rapids.tpu.admission.maxQueueDepth").doc(
+    "Bound on queries waiting for admission; a collect() arriving at a "
+    "full queue fast-rejects with QueryRejected instead of piling an "
+    "unbounded convoy onto the process (load-shedding beats collapse)."
+).integer_conf(16)
+
+ADMISSION_QUEUE_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.admission.queueTimeoutMs").doc(
+    "Max time a query waits in the admission queue before rejecting "
+    "with QueryRejected.  0 waits indefinitely (still cancellable and "
+    "deadline-trippable).").long_conf(0)
+
+QUERY_TIMEOUT_MS = conf("spark.rapids.tpu.query.timeoutMs").doc(
+    "Per-query deadline armed at collect(): a daemon watchdog thread "
+    "trips the query's CancelToken once the deadline passes, and every "
+    "blocking site (batch pulls, semaphore/admission waits, retry "
+    "backoffs, shuffle pool tasks, AOT compile waits) raises "
+    "QueryDeadlineExceeded cooperatively.  0 disables.").long_conf(0)
+
+QUERY_WATCHDOG_PERIOD_MS = conf(
+    "spark.rapids.tpu.query.watchdogPeriodMs").doc(
+    "Scan period of the deadline watchdog thread; an expired query is "
+    "tripped within one period and blocked waits notice within one "
+    "more (the 2x-period abort bound).").double_conf(50.0)
+
+SEMAPHORE_ACQUIRE_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.semaphore.acquireTimeoutMs").doc(
+    "Max time a task waits for a TPU semaphore permit before raising "
+    "SemaphoreTimeout (classified transient: the fault domain retries "
+    "with backoff, by which time the convoy may have drained).  "
+    "0 waits indefinitely.").long_conf(0)
+
 # --- resilience (stage-level fault domains) --------------------------------
 
 RESILIENCE_ENABLED = conf("spark.rapids.tpu.resilience.enabled").doc(

@@ -47,6 +47,7 @@ EVENT_SCHEMA: Dict[str, List[str]] = {
     "sync": ["kind", "dur_ns", "bytes"],
     "cache": ["hit", "label"],
     "resilience": ["kind", "op_name", "detail"],
+    "lifecycle": ["kind", "detail", "dur_ns"],
     "op_batch": ["path", "batch", "rows", "dur_ns"],
     "operator": ["path", "name", "describe", "wall_ns", "self_wall_ns",
                  "batches", "rows", "counters", "metrics", "fallback"],
@@ -311,6 +312,14 @@ class QueryDiagnostics:
         runtime_fallback, breaker_trip, or query_fallback."""
         self._event(ESSENTIAL, "resilience", kind=kind, op_name=op_name,
                     detail=str(detail)[:500])
+
+    def lifecycle(self, kind: str, detail: str = "",
+                  dur_ns: int = 0) -> None:
+        """A query-lifecycle event (ISSUE 4): ``admitted`` (dur_ns = the
+        admission queue wait), ``cancelled``, ``deadline_trip``, or
+        ``rejected``."""
+        self._event(ESSENTIAL, "lifecycle", kind=kind,
+                    detail=str(detail)[:500], dur_ns=int(dur_ns))
 
     # -- finalization --------------------------------------------------
     def finish(self, root=None, status: str = "ok") -> None:

@@ -90,6 +90,37 @@ def _traced(fn):
     return wrapper
 
 
+def _cancel_guard(fn):
+    """Outermost-of-all wrapper: ONE ambient contextvar check per batch
+    pull against the current query's CancelToken (lifecycle/context.py).
+    A tripped token raises QueryCancelled / QueryDeadlineExceeded from
+    the pull site, which every enclosing fault domain classifies
+    PROPAGATE — the unwind reaches collect() without a retry, a CPU
+    fallback, or a breaker count (ISSUE 4).  Outside a lifecycle-managed
+    query the check is a None test and nothing else."""
+    import functools
+
+    from spark_rapids_tpu.lifecycle.context import CURRENT as _QCTX
+
+    @functools.wraps(fn)
+    def wrapper(self, *a, **kw):
+        it = fn(self, *a, **kw)
+        try:
+            while True:
+                ctx = _QCTX.get()
+                if ctx is not None:
+                    ctx.token.check()
+                try:
+                    b = next(it)
+                except StopIteration:
+                    return
+                yield b
+        finally:
+            it.close()
+
+    return wrapper
+
+
 def _fault_domain(fn):
     """Wrap an operator's batch iterator in the stage-level fault domain
     (resilience/domain.py): failure classification, bounded transient /
@@ -363,11 +394,14 @@ class TpuExec:
         # (NvtxRange analog); zero overhead unless profiling is enabled.
         # fault domain outside the trace: it must see failures escaping
         # the whole iteration, trace annotations included.  diagnostics
-        # outermost: the span covers retries/fallbacks, and resilience
-        # events fired by the fault domain attribute to this operator
+        # outside that: the span covers retries/fallbacks, and resilience
+        # events fired by the fault domain attribute to this operator.
+        # cancel guard outermost of all: a tripped CancelToken stops the
+        # pull BEFORE any more work starts, and its raise must not be
+        # wrapped in a diagnostics span it would never close
         if "execute_columnar" in cls.__dict__:
-            cls.execute_columnar = _diag(_fault_domain(
-                _traced(cls.execute_columnar)))
+            cls.execute_columnar = _cancel_guard(_diag(_fault_domain(
+                _traced(cls.execute_columnar))))
 
     def collect_metrics(self, into=None) -> Dict[str, int]:
         into = into if into is not None else {}

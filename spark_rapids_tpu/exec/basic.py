@@ -504,8 +504,11 @@ class TpuInMemoryTableScanExec(TpuExec):
             fw = get_spill_framework()
             acc = []
             try:
+                # persistent: cache handles intentionally outlive the
+                # query (until unpersist), so query-end cleanup and the
+                # leak gate must not reap them
                 for b in self.children[0].execute_columnar():
-                    acc.append(fw.track(b))
+                    acc.append(fw.track(b, persistent=True))
             except BaseException:
                 for s in acc:
                     s.close()

@@ -235,7 +235,12 @@ class TpuShuffleExchangeExec(TpuExec):
                     mgr.write_map_output(shuffle_id, map_id,
                                          self.partition_batch(b))
             schema = self.output
+            from spark_rapids_tpu.lifecycle.context import check_cancel
+
             for pid in range(self.num_partitions):
+                # cooperative cancellation between reduce partitions: a
+                # wide shuffle read must not outlive its query's deadline
+                check_cancel()
                 with self.metric("shuffleReadTime").timed():
                     out = mgr.read_partition(shuffle_id, pid, schema)
                 if out is not None and out.num_rows > 0:

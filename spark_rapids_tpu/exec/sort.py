@@ -202,10 +202,15 @@ class TpuSortExec(TpuExec):
         """Memory-bounded k-way merge of sorted spillable runs — shared by
         the single-chip out-of-core sort and the per-device emit of the
         distributed ICI sort (exec/ici.py)."""
+        from spark_rapids_tpu.lifecycle.context import check_cancel
+
         C = self.ooc_chunk_rows
         k = len(runs)
         merge = self._merge_window_fn(schema, k)
         while any(off < n for _, n, off in runs):
+            # cooperative cancellation per merge window: the k-way merge
+            # can loop for many windows between yields
+            check_cancel()
             chunks = []
             metas = []   # (nvalid, exhausted)
             for s, n, off in runs:

@@ -1,0 +1,232 @@
+"""Query lifecycle layer (ISSUE 4): admission control, deadlines, and
+cooperative cancellation — what makes N concurrent ``collect()`` calls
+safe, bounded, and killable.
+
+Reference analog: the reference plugin leans on Spark's task framework
+for admission (GpuSemaphore), task kill, and resource release on task
+completion (SURVEY.md §2.3); Theseus (arXiv:2508.05029) and "Rethinking
+Analytical Processing in the GPU Era" (arXiv:2508.04701) both argue an
+accelerator engine lives or dies on controlled concurrency and bounded
+device-memory occupancy under load.  This standalone engine has no task
+framework, so the lifecycle layer supplies the missing pieces:
+
+  * context.py   — QueryContext (one per collect, in a contextvar) +
+                   CancelToken, the one object every blocking layer
+                   observes; QueryCancelled / QueryDeadlineExceeded /
+                   QueryRejected.
+  * admission.py — FIFO admission gate (spark.rapids.tpu.
+                   concurrentQueries) with a bounded wait queue and
+                   queue-full fast-reject.
+  * watchdog.py  — one daemon thread trips queries past
+                   spark.rapids.tpu.query.timeoutMs.
+
+``query_lifecycle`` (used by ``DataFrame.collect``) ties them together:
+admission BEFORE planning, deadline armed at entry, and on exit —
+success, error, or mid-batch unwind — guaranteed cleanup: residual
+semaphore permits released, the query's tracked spillables closed, its
+shuffle registrations dropped, and the admission slot returned.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from spark_rapids_tpu.lifecycle.context import (
+    CURRENT,
+    CancelToken,
+    QueryCancelled,
+    QueryContext,
+    QueryDeadlineExceeded,
+    QueryRejected,
+    check_cancel,
+    current,
+    current_token,
+)
+from spark_rapids_tpu.lifecycle.admission import (
+    AdmissionController,
+    get_admission,
+    reset_admission,
+)
+from spark_rapids_tpu.lifecycle import watchdog as _watchdog
+
+active_queries = _watchdog.active_queries
+
+_tls = threading.local()
+
+
+def last_query_stats() -> Optional[dict]:
+    """Lifecycle stats of the calling thread's most recent collect()
+    (bench/stress harness hook): query_id, admission_wait_ns, wall_ns,
+    status."""
+    return getattr(_tls, "last", None)
+
+
+class query_lifecycle:
+    """Context manager around one ``collect()``.
+
+    Yields the new :class:`QueryContext`, or None when the lifecycle
+    layer does not apply: sql disabled (oracle runs need no admission)
+    or a nested collect (the inner one shares the outer query's context,
+    token, and admission slot)."""
+
+    def __init__(self, conf):
+        self._conf = conf
+        self._ctx: Optional[QueryContext] = None
+        self._ctl: Optional[AdmissionController] = None
+        self._cv_token = None
+
+    def __enter__(self) -> Optional[QueryContext]:
+        from spark_rapids_tpu.config import (
+            ADMISSION_MAX_QUEUE,
+            ADMISSION_QUEUE_TIMEOUT_MS,
+            CONCURRENT_QUERIES,
+            QUERY_TIMEOUT_MS,
+            QUERY_WATCHDOG_PERIOD_MS,
+        )
+
+        conf = self._conf
+        if not conf.sql_enabled or current() is not None:
+            return None
+        period_s = max(float(conf.get(QUERY_WATCHDOG_PERIOD_MS)), 1.0) / 1000.0
+        ctx = QueryContext(watchdog_period_s=period_s)
+        # deadline armed and watchdog registered BEFORE the admission
+        # wait: a query stuck in the queue must be deadline-trippable and
+        # visible to active_queries() cancel tooling (the acquire loop
+        # polls ctx.token), not just once it starts running
+        timeout_ms = int(conf.get(QUERY_TIMEOUT_MS))
+        if timeout_ms > 0:
+            ctx.deadline_ns = time.monotonic_ns() + timeout_ms * 1_000_000
+        _watchdog.register(ctx)
+        limit = int(conf.get(CONCURRENT_QUERIES))
+        if limit > 0:
+            ctl = get_admission(limit, int(conf.get(ADMISSION_MAX_QUEUE)))
+            try:
+                # admission BEFORE planning: a rejected query must cost
+                # the process nothing, and a queued one must not pin
+                # plan state
+                ctx.admission_wait_ns = ctl.acquire(
+                    ctx, int(conf.get(ADMISSION_QUEUE_TIMEOUT_MS)))
+            except BaseException as e:
+                from spark_rapids_tpu import perfcounters as PC
+
+                _watchdog.unregister(ctx)
+                if isinstance(e, QueryCancelled):
+                    PC.bump("queries_cancelled")
+                raise
+            self._ctl = ctl
+        self._cv_token = CURRENT.set(ctx)
+        self._ctx = ctx
+        return ctx
+
+    def __exit__(self, exc_type, exc, tb):
+        ctx = self._ctx
+        if ctx is None:
+            return False
+        from spark_rapids_tpu import perfcounters as PC
+
+        try:
+            CURRENT.reset(self._cv_token)
+            _watchdog.unregister(ctx)
+            if exc is not None and isinstance(exc, QueryCancelled):
+                PC.bump("queries_cancelled")
+            _cleanup_query(ctx)
+        finally:
+            if self._ctl is not None:
+                self._ctl.release()
+            _tls.last = {
+                "query_id": ctx.query_id,
+                "admission_wait_ns": ctx.admission_wait_ns,
+                "wall_ns": time.monotonic_ns() - ctx.started_ns,
+                "status": ("ok" if exc_type is None else
+                           getattr(exc_type, "__name__", "error")),
+            }
+        return False
+
+
+def _cleanup_query(ctx: QueryContext) -> None:
+    """Release everything the query may still hold after its exec tree
+    unwound (possibly mid-batch).  Every step peeks the singleton —
+    nothing is created during cleanup — and every step is idempotent."""
+    # 1. residual semaphore permit: the collect-level scope released one
+    #    depth; exec code that failed between acquire and its finally can
+    #    leave extra depth, which would starve every other query
+    from spark_rapids_tpu.memory import semaphore as _sem
+
+    sem = _sem.peek_semaphore()
+    if sem is not None:
+        sem.force_release_current_thread()
+    # 2. spillable handles tracked (and not yet closed) by this query —
+    #    cache handles are marked persistent and survive
+    from spark_rapids_tpu.memory import spill as _spill
+
+    fw = _spill.peek_spill_framework()
+    if fw is not None:
+        fw.close_owned_by(ctx.query_id)
+    # 3. shuffle registrations this query's exchanges left behind
+    from spark_rapids_tpu.shuffle import manager as _shuffle
+
+    mgr = _shuffle.peek_shuffle_manager()
+    if mgr is not None:
+        mgr.unregister_owned(ctx.query_id)
+
+
+# ---------------------------------------------------------------------------
+# leak reporting (conftest gate + TpuSession.close)
+# ---------------------------------------------------------------------------
+
+def leak_report_all() -> List[str]:
+    """Aggregate leak report across the process singletons: unclosed
+    non-persistent spillables, held/lost semaphore permits, and live
+    shuffle registrations.  Empty after a well-behaved query (pinned by
+    the autouse tests/conftest.py gate and the stress harness)."""
+    out: List[str] = []
+    from spark_rapids_tpu.memory import spill as _spill
+
+    fw = _spill.peek_spill_framework()
+    if fw is not None:
+        out.extend(fw.leak_report())
+    from spark_rapids_tpu.memory import semaphore as _sem
+
+    sem = _sem.peek_semaphore()
+    if sem is not None:
+        out.extend(sem.leak_report())
+    from spark_rapids_tpu.shuffle import manager as _shuffle
+
+    mgr = _shuffle.peek_shuffle_manager()
+    if mgr is not None:
+        for sid in mgr.active_shuffles():
+            out.append(f"LEAK: shuffle {sid} still registered")
+    return out
+
+
+def reset_leaked_state() -> None:
+    """Best-effort recovery after a detected leak so ONE leaky test/query
+    cannot poison everything after it: close leaked handles, rebuild the
+    semaphore, drop orphaned shuffle registrations."""
+    from spark_rapids_tpu.memory import semaphore as _sem
+    from spark_rapids_tpu.memory import spill as _spill
+    from spark_rapids_tpu.shuffle import manager as _shuffle
+
+    fw = _spill.peek_spill_framework()
+    if fw is not None:
+        fw.close_all(include_persistent=False)
+    sem = _sem.peek_semaphore()
+    if sem is not None and sem.leak_report():
+        _sem.reset_semaphore()
+    mgr = _shuffle.peek_shuffle_manager()
+    if mgr is not None:
+        for sid in mgr.active_shuffles():
+            try:
+                mgr.unregister_shuffle(sid)
+            except Exception:
+                pass
+
+
+__all__ = [
+    "CancelToken", "QueryCancelled", "QueryContext",
+    "QueryDeadlineExceeded", "QueryRejected",
+    "active_queries", "check_cancel", "current", "current_token",
+    "get_admission", "reset_admission", "last_query_stats",
+    "leak_report_all", "reset_leaked_state", "query_lifecycle",
+]

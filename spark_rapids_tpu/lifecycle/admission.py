@@ -1,0 +1,125 @@
+"""Admission control — a FIFO gate on concurrently-running queries.
+
+Reference analog: GpuSemaphore bounds how many *tasks* touch the device
+(SURVEY.md §2.3); Theseus (arXiv:2508.05029) argues accelerator query
+engines must additionally bound how many *queries* hold planning state
+and device memory at once, because N queries each spilling the others'
+working set livelocks the pool.  ``spark.rapids.tpu.concurrentQueries``
+admits at most L queries; up to ``admission.maxQueueDepth`` more wait in
+FIFO order, and anything beyond that fast-rejects with
+:class:`QueryRejected` — shedding load at the door beats collapsing the
+whole process.
+
+Waiters poll in short slices so a tripped CancelToken (user cancel or
+watchdog deadline) aborts the wait within ~50ms.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional, Tuple
+
+from spark_rapids_tpu.lifecycle.context import (
+    QueryContext,
+    QueryRejected,
+)
+
+_POLL_S = 0.05
+
+
+class AdmissionController:
+    def __init__(self, limit: int, max_queue: int):
+        self.limit = max(1, int(limit))
+        self.max_queue = max(0, int(max_queue))
+        self._cond = threading.Condition()
+        self._running = 0
+        self._waiters: "deque" = deque()   # ticket objects, FIFO
+
+    # -- introspection ---------------------------------------------------
+    def stats(self) -> dict:
+        with self._cond:
+            return {"running": self._running, "queued": len(self._waiters),
+                    "limit": self.limit, "max_queue": self.max_queue}
+
+    # -- the gate --------------------------------------------------------
+    def acquire(self, ctx: QueryContext,
+                timeout_ms: int = 0) -> int:
+        """Admit ``ctx`` (FIFO), returning the queue-wait in ns.  Raises
+        :class:`QueryRejected` immediately when the wait queue is full,
+        or after ``timeout_ms`` (0 = wait indefinitely); raises the
+        token's exception if the query is cancelled while queued."""
+        from spark_rapids_tpu import perfcounters as PC
+
+        t0 = time.perf_counter_ns()
+        with self._cond:
+            if self._running < self.limit and not self._waiters:
+                self._running += 1
+                PC.bump("queries_admitted")
+                return 0
+            if len(self._waiters) >= self.max_queue:
+                PC.bump("queries_rejected")
+                raise QueryRejected(
+                    f"admission queue full ({len(self._waiters)} queued, "
+                    f"{self._running}/{self.limit} running; "
+                    f"spark.rapids.tpu.admission.maxQueueDepth="
+                    f"{self.max_queue})")
+            ticket = object()
+            self._waiters.append(ticket)
+            deadline = (None if timeout_ms <= 0
+                        else time.monotonic() + timeout_ms / 1000.0)
+            try:
+                while not (self._running < self.limit
+                           and self._waiters[0] is ticket):
+                    ctx.token.check()
+                    if deadline is not None and time.monotonic() >= deadline:
+                        PC.bump("queries_rejected")
+                        raise QueryRejected(
+                            f"{ctx.query_id}: admission wait exceeded "
+                            f"queueTimeoutMs={timeout_ms}")
+                    self._cond.wait(_POLL_S)
+                self._waiters.popleft()
+                self._running += 1
+            except BaseException:
+                try:
+                    self._waiters.remove(ticket)
+                except ValueError:
+                    pass
+                self._cond.notify_all()
+                raise
+            # the head moved: the next waiter (or a free slot) may now
+            # be eligible
+            self._cond.notify_all()
+        wait_ns = time.perf_counter_ns() - t0
+        PC.bump("queries_admitted")
+        PC.bump("admission_wait_ns", wait_ns)
+        return wait_ns
+
+    def release(self) -> None:
+        with self._cond:
+            self._running = max(0, self._running - 1)
+            self._cond.notify_all()
+
+
+_lock = threading.Lock()
+_controller: Optional[AdmissionController] = None
+_controller_key: Optional[Tuple[int, int]] = None
+
+
+def get_admission(limit: int, max_queue: int) -> AdmissionController:
+    """Process-wide controller, rebuilt when the confs change (the
+    get_semaphore pattern)."""
+    global _controller, _controller_key
+    with _lock:
+        key = (int(limit), int(max_queue))
+        if _controller is None or key != _controller_key:
+            _controller = AdmissionController(limit, max_queue)
+            _controller_key = key
+        return _controller
+
+
+def reset_admission() -> None:
+    global _controller, _controller_key
+    with _lock:
+        _controller = None
+        _controller_key = None

@@ -1,0 +1,156 @@
+"""QueryContext / CancelToken — the per-query lifecycle state.
+
+One :class:`QueryContext` exists per admitted ``collect()`` (installed in
+a contextvar by ``lifecycle.query_lifecycle``); it carries the admission
+slot, the optional deadline, and the :class:`CancelToken` every blocking
+layer observes.  The reference plugin gets task kill / resource release
+for free from Spark's task framework (SURVEY.md §2.3: RmmSpark task
+tracking + GpuSemaphore release on task completion); this standalone
+engine has no task framework, so the token is the one thing a wedged
+query's every wait — batch pulls, semaphore and admission queues, retry
+backoffs, shuffle pool tasks, AOT compile waits — must observe.
+
+Cancellation is COOPERATIVE: ``trip()`` never interrupts a thread, it
+sets an event that each blocking site polls (or sleeps on); the tripped
+site raises :class:`QueryCancelled` / :class:`QueryDeadlineExceeded`,
+which ``resilience/classify.py`` treats as PROPAGATE — never retried,
+never CPU-fallbacked, never counted by the circuit breaker.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from contextvars import ContextVar
+from typing import Optional, Tuple, Type
+
+
+class QueryCancelled(RuntimeError):
+    """The query's CancelToken was tripped (user cancel()); classified
+    PROPAGATE — surfaces to the caller unchanged."""
+
+
+class QueryDeadlineExceeded(QueryCancelled):
+    """The query ran past spark.rapids.tpu.query.timeoutMs and the
+    watchdog tripped its token."""
+
+
+class QueryRejected(RuntimeError):
+    """Admission fast-reject: the wait queue was full (or the queue wait
+    timed out).  Raised before any planning/device work happened, so the
+    caller can shed load or retry later."""
+
+
+class CancelToken:
+    """A trip-once cancellation flag blocking layers sleep on.
+
+    ``trip(exc_type, reason)`` stores the exception CLASS + message and
+    sets the event; each observer raises a FRESH instance from
+    ``check()`` so tracebacks point at the site that noticed, not at the
+    tripper."""
+
+    __slots__ = ("_evt", "_lock", "_exc")
+
+    def __init__(self):
+        self._evt = threading.Event()
+        self._lock = threading.Lock()
+        self._exc: Optional[Tuple[Type[BaseException], str]] = None
+
+    def trip(self, exc_type: Type[BaseException], reason: str) -> bool:
+        """Arm the token; returns True if this call tripped it (False:
+        already tripped — first reason wins)."""
+        with self._lock:
+            if self._exc is not None:
+                return False
+            self._exc = (exc_type, reason)
+        self._evt.set()
+        return True
+
+    @property
+    def cancelled(self) -> bool:
+        return self._evt.is_set()
+
+    def check(self) -> None:
+        """Raise the tripped exception (no-op while untripped)."""
+        if self._evt.is_set():
+            exc_type, reason = self._exc
+            raise exc_type(reason)
+
+    def wait(self, timeout: Optional[float]) -> bool:
+        """Block up to ``timeout`` seconds or until tripped; True when
+        tripped (the caller should then ``check()``)."""
+        return self._evt.wait(timeout)
+
+    def sleep_or_raise(self, seconds: float) -> None:
+        """A cancellable time.sleep: wakes immediately on trip and
+        raises."""
+        if self._evt.wait(seconds):
+            self.check()
+
+
+_QUERY_SEQ = itertools.count(1)
+
+
+class QueryContext:
+    """Everything the lifecycle layer tracks for one collect()."""
+
+    __slots__ = ("query_id", "token", "admission_seq", "admission_wait_ns",
+                 "deadline_ns", "watchdog_period_s", "started_ns",
+                 "owner_thread")
+
+    def __init__(self, watchdog_period_s: float = 0.05):
+        n = next(_QUERY_SEQ)
+        self.query_id = f"q{n}"
+        self.token = CancelToken()
+        # admission order doubles as semaphore priority: a LOWER seq was
+        # admitted earlier (already running, already holding memory) and
+        # outranks newly admitted queries at the device semaphore so it
+        # finishes and releases instead of convoying
+        self.admission_seq = n
+        self.admission_wait_ns = 0
+        self.deadline_ns: Optional[int] = None   # time.monotonic_ns basis
+        self.watchdog_period_s = watchdog_period_s
+        self.started_ns = time.monotonic_ns()
+        self.owner_thread = threading.get_ident()
+
+    # -- cancellation ----------------------------------------------------
+    def cancel(self, reason: str = "query cancelled") -> bool:
+        """User-facing abort: trip the token (idempotent)."""
+        return self.token.trip(
+            QueryCancelled, f"{self.query_id}: {reason}")
+
+    def check_cancel(self) -> None:
+        self.token.check()
+
+    def deadline_expired(self, now_ns: Optional[int] = None) -> bool:
+        if self.deadline_ns is None:
+            return False
+        return (now_ns if now_ns is not None
+                else time.monotonic_ns()) >= self.deadline_ns
+
+
+# the active QueryContext of the current (logical) thread of execution.
+# A contextvar, not a threading.local: the exec iterator chain runs on
+# the query thread, and explicitly captured tokens travel to helper
+# threads (shuffle pool, AOT pool) via closures.
+CURRENT: "ContextVar[Optional[QueryContext]]" = ContextVar(
+    "srt_query_context", default=None)
+
+
+def current() -> Optional[QueryContext]:
+    """The active QueryContext, or None outside a lifecycle-managed
+    collect (ONE ambient check — safe on every hot path)."""
+    return CURRENT.get()
+
+
+def current_token() -> Optional[CancelToken]:
+    ctx = CURRENT.get()
+    return ctx.token if ctx is not None else None
+
+
+def check_cancel() -> None:
+    """Raise if the current query's token is tripped; no-op outside a
+    query or while untripped."""
+    ctx = CURRENT.get()
+    if ctx is not None:
+        ctx.token.check()

@@ -24,9 +24,14 @@ from spark_rapids_tpu.memory.retry import (
     with_retry,
     with_retry_no_split,
 )
-from spark_rapids_tpu.memory.semaphore import TpuSemaphore, get_semaphore
+from spark_rapids_tpu.memory.semaphore import (
+    SemaphoreTimeout,
+    TpuSemaphore,
+    get_semaphore,
+)
 from spark_rapids_tpu.memory.spill import (
     SpillableColumnarBatch,
+    SpillCorruption,
     SpillFramework,
     get_spill_framework,
 )
@@ -36,6 +41,7 @@ __all__ = [
     "TpuRetryOOM", "TpuSplitAndRetryOOM", "force_retry_oom",
     "force_split_and_retry_oom", "split_in_half_by_rows", "with_retry",
     "with_retry_no_split",
-    "TpuSemaphore", "get_semaphore",
-    "SpillableColumnarBatch", "SpillFramework", "get_spill_framework",
+    "SemaphoreTimeout", "TpuSemaphore", "get_semaphore",
+    "SpillableColumnarBatch", "SpillCorruption", "SpillFramework",
+    "get_spill_framework",
 ]

@@ -101,12 +101,17 @@ def with_retry(
     it cannot repeat).  Yields one result per (possibly split) input."""
     from spark_rapids_tpu.memory.spill import get_spill_framework
 
+    from spark_rapids_tpu.lifecycle.context import check_cancel
+
     queue: List[SpillableColumnarBatch] = (
         [inputs] if isinstance(inputs, SpillableColumnarBatch) else
         list(inputs))
     fw = get_spill_framework()
     try:
         while queue:
+            # cooperative cancellation (ISSUE 4): checked while every
+            # handle is still queued, so the finally below closes them
+            check_cancel()
             item = queue.pop(0)
             attempts = 0
             while True:
@@ -159,11 +164,14 @@ def with_retry_no_split(fn: Callable[[], X], max_attempts: int = 8) -> X:
     attempts) without an input to split."""
     from spark_rapids_tpu.memory.spill import get_spill_framework
 
+    from spark_rapids_tpu.lifecycle.context import check_cancel
+
     fw = get_spill_framework()
     attempts = 0
     while True:
         attempts += 1
         try:
+            check_cancel()
             _check_injection()
             return fn()
         except TpuRetryOOM:

@@ -8,12 +8,45 @@ oversubscribed Spark tasks don't OOM the device together; host-side work
 Here a "task" is the thread driving a partition's iterator chain.  Permits
 are reentrant per thread (a task that already holds one passes through),
 matching acquireIfNecessary semantics.
+
+Concurrent-query hardening (ISSUE 4):
+
+* **Priority-aware**: waiters are granted in (priority, arrival) order,
+  where priority defaults to the admission sequence of the current
+  QueryContext — a query admitted EARLIER (already running, already
+  holding device memory) outranks a newly admitted one, so the running
+  query drains and releases instead of both convoying on a half-held
+  working set (the reference's GpuSemaphore priority, which uses "has
+  the task held the semaphore before" for the same reason).
+* **Cancellable**: waiters poll in short slices and observe the current
+  query's CancelToken, so a deadline/cancel aborts a blocked acquire
+  within ~50ms.
+* **Typed timeout**: an exhausted ``timeout`` raises
+  :class:`SemaphoreTimeout` (a TimeoutError subtype, classified
+  TRANSIENT by resilience/classify.py) with the permit deterministically
+  NOT held; ``release_if_necessary`` stays safe to call from ``finally``
+  after a failed acquire.
+* **Lock ordering**: acquiring the semaphore while holding the spill
+  framework's lock is a deadlock recipe (a spilling thread would wait on
+  a permit held by a thread waiting to spill) and raises immediately —
+  the ordering is semaphore BEFORE spill locks, always.
 """
 from __future__ import annotations
 
+import bisect
+import itertools
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
+
+_NO_PRIORITY = 1 << 62
+_POLL_S = 0.05
+
+
+class SemaphoreTimeout(TimeoutError):
+    """TpuSemaphore.acquire_if_necessary ran out of time; the permit is
+    NOT held.  Classified transient: by the time the fault domain's
+    backoff retries, the convoy may have drained."""
 
 
 class TpuSemaphore:
@@ -22,23 +55,78 @@ class TpuSemaphore:
         self._available = permits
         self._cond = threading.Condition()
         self._holders: Dict[int, int] = {}   # thread id -> depth
+        self._waiters: List[Tuple[int, int]] = []   # sorted (priority, seq)
+        self._seq = itertools.count()
         self.total_wait_ns = 0               # semaphoreWaitTime metric
 
-    def acquire_if_necessary(self, timeout: Optional[float] = None) -> None:
+    def _check_lock_order(self) -> None:
+        from spark_rapids_tpu.memory import spill as _spill
+
+        fw = _spill.peek_spill_framework()
+        if fw is not None:
+            owned = getattr(fw._lock, "_is_owned", None)
+            if owned is not None and owned():
+                raise RuntimeError(
+                    "lock-order violation: TpuSemaphore.acquire_if_"
+                    "necessary while holding the SpillFramework lock "
+                    "(ordering is semaphore -> spill; the reverse "
+                    "deadlocks concurrent OOM-spill paths)")
+
+    def acquire_if_necessary(self, timeout: Optional[float] = None,
+                             priority: Optional[int] = None) -> None:
         tid = threading.get_ident()
+        token = None
+        if priority is None:
+            from spark_rapids_tpu.lifecycle.context import current
+
+            ctx = current()
+            if ctx is not None:
+                priority = ctx.admission_seq
+                token = ctx.token
+            else:
+                priority = _NO_PRIORITY
+        else:
+            from spark_rapids_tpu.lifecycle.context import current_token
+
+            token = current_token()
         with self._cond:
             if self._holders.get(tid, 0) > 0:
                 self._holders[tid] += 1
                 return
+            self._check_lock_order()
             t0 = time.perf_counter_ns()
-            while self._available <= 0:
-                if not self._cond.wait(timeout):
-                    raise TimeoutError("TpuSemaphore acquire timed out")
-            self.total_wait_ns += time.perf_counter_ns() - t0
-            self._available -= 1
-            self._holders[tid] = 1
+            deadline = None if timeout is None else t0 + int(timeout * 1e9)
+            ticket = (priority, next(self._seq))
+            bisect.insort(self._waiters, ticket)
+            try:
+                while self._available <= 0 or self._waiters[0] != ticket:
+                    if token is not None:
+                        token.check()
+                    now = time.perf_counter_ns()
+                    if deadline is not None and now >= deadline:
+                        raise SemaphoreTimeout(
+                            f"TpuSemaphore acquire timed out after "
+                            f"{timeout:.3f}s ({self.permits} permits, "
+                            f"{len(self._holders)} holders)")
+                    if deadline is None:
+                        wait_s = _POLL_S if token is not None else None
+                    else:
+                        left = (deadline - now) / 1e9
+                        wait_s = min(_POLL_S, left) if token is not None \
+                            else left
+                    self._cond.wait(wait_s)
+                self._available -= 1
+                self._holders[tid] = 1
+            finally:
+                self._waiters.remove(ticket)
+                self.total_wait_ns += time.perf_counter_ns() - t0
+                # waiter-set or availability changed either way; let the
+                # new head re-evaluate
+                self._cond.notify_all()
 
     def release_if_necessary(self) -> None:
+        """Safe from ``finally`` even after a FAILED acquire: a thread
+        holding no permit returns without touching the count."""
         tid = threading.get_ident()
         with self._cond:
             depth = self._holders.get(tid, 0)
@@ -49,24 +137,51 @@ class TpuSemaphore:
                 return
             del self._holders[tid]
             self._available += 1
-            self._cond.notify()
+            self._cond.notify_all()
+
+    def force_release_current_thread(self) -> int:
+        """Drop ALL depth the current thread holds (query cleanup after a
+        mid-batch unwind); returns the depth released."""
+        tid = threading.get_ident()
+        with self._cond:
+            depth = self._holders.pop(tid, 0)
+            if depth:
+                self._available += 1
+                self._cond.notify_all()
+            return depth
 
     def held_by_current_thread(self) -> bool:
         return self._holders.get(threading.get_ident(), 0) > 0
 
+    def leak_report(self) -> List[str]:
+        """Permit-accounting anomalies: held permits (leaked by a thread
+        that never released) or a corrupted available count."""
+        with self._cond:
+            out = [f"LEAK: semaphore permit held by thread {tid} "
+                   f"(depth {d})" for tid, d in self._holders.items()]
+            if self._available + len(self._holders) != self.permits:
+                out.append(
+                    f"LEAK: semaphore accounting off — available="
+                    f"{self._available} holders={len(self._holders)} "
+                    f"permits={self.permits}")
+            return out
+
     class _Scope:
-        def __init__(self, sem):
+        def __init__(self, sem, timeout=None, priority=None):
             self.sem = sem
+            self.timeout = timeout
+            self.priority = priority
 
         def __enter__(self):
-            self.sem.acquire_if_necessary()
+            self.sem.acquire_if_necessary(self.timeout, self.priority)
             return self.sem
 
         def __exit__(self, *a):
             self.sem.release_if_necessary()
 
-    def scope(self) -> "_Scope":
-        return TpuSemaphore._Scope(self)
+    def scope(self, timeout: Optional[float] = None,
+              priority: Optional[int] = None) -> "_Scope":
+        return TpuSemaphore._Scope(self, timeout, priority)
 
 
 _lock = threading.Lock()
@@ -80,6 +195,12 @@ def get_semaphore(permits: Optional[int] = None) -> TpuSemaphore:
                                   and _semaphore.permits != permits):
             _semaphore = TpuSemaphore(permits if permits is not None else 2)
         return _semaphore
+
+
+def peek_semaphore() -> Optional[TpuSemaphore]:
+    """The singleton if it exists — cleanup/leak paths must never CREATE
+    one."""
+    return _semaphore
 
 
 def reset_semaphore() -> None:

@@ -37,17 +37,41 @@ STATE_HOST = "HOST"
 STATE_DISK = "DISK"
 
 
+class SpillCorruption(RuntimeError):
+    """A disk-tier unspill read back bytes whose CRC32 does not match
+    what was written (bit rot / torn write / corrupted spill dir).
+    Deterministic by classification: re-reading re-derives the same
+    corruption, so the fault domain falls the stage back to the CPU
+    oracle instead of retrying."""
+
+
+def _crc_host_cols(host_cols: List[Dict[str, np.ndarray]]) -> int:
+    """CRC32 of a host-tier column set, independent of dict ordering
+    (write and read build their entries in different key orders)."""
+    import zlib
+
+    crc = 0
+    for i, entry in enumerate(host_cols):
+        for k in sorted(entry):
+            v = entry[k]
+            crc = zlib.crc32(f"{i}:{k}".encode(), crc)
+            crc = zlib.crc32(np.ascontiguousarray(v).tobytes(), crc)
+    return crc
+
+
 class SpillableColumnarBatch:
     """A batch handle that can migrate between HBM, host RAM, and disk.
 
     Reference analog: SpillableColumnarBatch /
     SpillableColumnarBatchHandle."""
 
-    def __init__(self, batch: ColumnarBatch, framework: "SpillFramework"):
+    def __init__(self, batch: ColumnarBatch, framework: "SpillFramework",
+                 persistent: bool = False):
         self._framework = framework
         self._batch: Optional[ColumnarBatch] = batch
         self._host: Optional[List[Dict[str, np.ndarray]]] = None
         self._disk_path: Optional[str] = None
+        self._disk_crc: Optional[int] = None
         self.schema = batch.schema
         self.num_rows = batch.num_rows
         self.device_bytes = batch.nbytes()
@@ -55,6 +79,14 @@ class SpillableColumnarBatch:
         self.pinned = 0          # >0 while an operator computes on it
         self.lru_tick = 0
         self.closed = False
+        # lifecycle bookkeeping (ISSUE 4): which query tracked this
+        # handle (query-end cleanup closes its leftovers) and whether it
+        # intentionally outlives the query (df.cache() handles)
+        self.persistent = persistent
+        from spark_rapids_tpu.lifecycle.context import current
+
+        ctx = current()
+        self.owner_qid = ctx.query_id if ctx is not None else None
         framework._register(self)
 
     # -- public API ------------------------------------------------------
@@ -153,6 +185,9 @@ class SpillableColumnarBatch:
         for i, entry in enumerate(self._host):
             for k, v in entry.items():
                 arrays[f"c{i}_{k}"] = v
+        # integrity checksum (ISSUE 4 satellite): remember what the
+        # bytes looked like going down; unspill verifies before trusting
+        self._disk_crc = _crc_host_cols(self._host)
         fd, path = tempfile.mkstemp(suffix=".spill.npz",
                                     dir=self._framework.spill_dir)
         os.close(fd)
@@ -164,21 +199,35 @@ class SpillableColumnarBatch:
 
     def _disk_to_host_locked(self) -> None:
         assert self.state == STATE_DISK
-        loaded = np.load(self._disk_path)
-        host_cols: List[Dict[str, np.ndarray]] = []
-        for i in range(len(self.schema.fields)):
-            entry = {}
-            for k in ("validity", "data", "chars", "lengths"):
-                key = f"c{i}_{k}"
-                if key in loaded:
-                    entry[k] = loaded[key]
-            host_cols.append(entry)
+        try:
+            loaded = np.load(self._disk_path)
+            host_cols: List[Dict[str, np.ndarray]] = []
+            for i in range(len(self.schema.fields)):
+                entry = {}
+                for k in ("validity", "data", "chars", "lengths"):
+                    key = f"c{i}_{k}"
+                    if key in loaded:
+                        entry[k] = loaded[key]
+                host_cols.append(entry)
+        except Exception as e:
+            # the zip container itself rejected the bytes (BadZipFile /
+            # zlib error from a flipped byte): same corruption class
+            raise SpillCorruption(
+                f"disk unspill of {self._disk_path} failed to decode: "
+                f"{type(e).__name__}: {e}") from e
+        if self._disk_crc is not None:
+            got = _crc_host_cols(host_cols)
+            if got != self._disk_crc:
+                raise SpillCorruption(
+                    f"disk unspill CRC mismatch for {self._disk_path}: "
+                    f"wrote {self._disk_crc:#010x}, read {got:#010x}")
         self._host = host_cols
         try:
             os.unlink(self._disk_path)
         except OSError:
             pass
         self._disk_path = None
+        self._disk_crc = None
         self.state = STATE_HOST
 
 
@@ -219,21 +268,53 @@ class SpillFramework:
         # over-budget after admitting the new batch: shed others
         self.ensure_room(0, exclude=h)
 
-    def leak_report(self) -> List[str]:
+    def leak_report(self, include_persistent: bool = False) -> List[str]:
         """Live (unclosed) handles with their allocation sites.
 
         Reference analog: ai.rapids.refcount.debug leak logs (SURVEY.md
         §5.2).  Enable with spark.rapids.memory.debug=true; an empty list
         after a query completes means every spillable handle was
-        released."""
+        released.  Handles marked ``persistent`` (df.cache() batches,
+        which intentionally outlive their query) are excluded unless
+        ``include_persistent``."""
         with self._lock:
             out = []
             for h in self._handles:
+                if h.persistent and not include_persistent:
+                    continue
                 site = getattr(h, "_alloc_stack", "<enable "
                                "spark.rapids.memory.debug for stacks>")
+                owner = f" owner={h.owner_qid}" if h.owner_qid else ""
                 out.append(
-                    f"LEAK: {h.state} handle {h.device_bytes}B\n{site}")
+                    f"LEAK: {h.state} handle {h.device_bytes}B{owner}"
+                    f"\n{site}")
             return out
+
+    def close_owned_by(self, query_id: str) -> int:
+        """Query-end cleanup (ISSUE 4): close every non-persistent handle
+        the given query tracked and never closed (a mid-batch unwind
+        leaves these behind); returns how many were closed."""
+        with self._lock:
+            victims = [h for h in self._handles
+                       if h.owner_qid == query_id and not h.persistent]
+        for h in victims:
+            try:
+                h.close()
+            except Exception:
+                pass
+        return len(victims)
+
+    def close_all(self, include_persistent: bool = True) -> int:
+        """Close every live handle (leak recovery / session shutdown)."""
+        with self._lock:
+            victims = [h for h in self._handles
+                       if include_persistent or not h.persistent]
+        for h in victims:
+            try:
+                h.close()
+            except Exception:
+                pass
+        return len(victims)
 
     def _unregister(self, h: SpillableColumnarBatch) -> None:
         if h.state == STATE_DEVICE:
@@ -245,8 +326,9 @@ class SpillFramework:
         self._tick += 1
         h.lru_tick = self._tick
 
-    def track(self, batch: ColumnarBatch) -> SpillableColumnarBatch:
-        return SpillableColumnarBatch(batch, self)
+    def track(self, batch: ColumnarBatch,
+              persistent: bool = False) -> SpillableColumnarBatch:
+        return SpillableColumnarBatch(batch, self, persistent=persistent)
 
     # -- pressure --------------------------------------------------------
     @property
@@ -339,6 +421,12 @@ def get_spill_framework(tpu_conf: Optional[TpuConf] = None) -> SpillFramework:
                 spill_dir=c.get(SPILL_DIR),
                 debug=c.get(MEM_DEBUG))
         return _framework
+
+
+def peek_spill_framework() -> Optional[SpillFramework]:
+    """The singleton if it exists — cleanup/leak paths must never CREATE
+    one (get_spill_framework would build a device manager)."""
+    return _framework
 
 
 def reset_spill_framework() -> None:

@@ -61,6 +61,13 @@ COUNTERS: Dict[str, int] = {
     "breaker_trips": 0,
     "breaker_plan_fallbacks": 0,
     "query_fallbacks": 0,
+    # query lifecycle (admission control / deadlines / cancellation,
+    # lifecycle/ package)
+    "queries_admitted": 0,
+    "queries_rejected": 0,
+    "queries_cancelled": 0,
+    "deadline_trips": 0,
+    "admission_wait_ns": 0,
 }
 
 # One-release read/write compat for the pre-normalization camelCase keys

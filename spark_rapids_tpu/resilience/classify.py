@@ -46,10 +46,23 @@ _DETERMINISTIC_CODES = ("INVALID_ARGUMENT", "UNIMPLEMENTED", "NOT_FOUND",
 # this module import-cycle-free (retry.py imports us for is_device_oom)
 _OOM_TYPE_NAMES = ("TpuRetryOOM", "TpuSplitAndRetryOOM")
 
-# exceptions that ARE the query's correct observable behavior
+# exceptions that ARE the query's correct observable behavior — plus the
+# lifecycle layer's control-flow exceptions (ISSUE 4): a cancellation or
+# deadline must surface unchanged, NEVER be retried, CPU-fallbacked, or
+# counted by the circuit breaker (the query was killed, the stage did
+# not fail)
 _PROPAGATE_TYPE_NAMES = ("SparkArithmeticException",
                          "SparkDateTimeException",
-                         "SparkNumberFormatException")
+                         "SparkNumberFormatException",
+                         "QueryCancelled",
+                         "QueryDeadlineExceeded",
+                         "QueryRejected")
+
+# typed corruption errors from the integrity checksums (shuffle frame
+# CRC, disk-spill CRC): re-reading re-derives the same corruption, so
+# they classify DETERMINISTIC (the fallthrough default — listed here so
+# the contract is explicit and message contents can never reclassify)
+_DETERMINISTIC_TYPE_NAMES = ("ShuffleCorruption", "SpillCorruption")
 
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
 
@@ -123,6 +136,9 @@ def classify_failure(exc: BaseException) -> str:
     for link in exception_chain(exc):
         if type(link).__name__ in _PROPAGATE_TYPE_NAMES:
             return PROPAGATE
+    for link in exception_chain(exc):
+        if type(link).__name__ in _DETERMINISTIC_TYPE_NAMES:
+            return DETERMINISTIC
     if is_device_oom(exc):
         return DEVICE_OOM
     for link in exception_chain(exc):

@@ -67,12 +67,23 @@ def _confs():
 
 
 def _backoff_sleep(base_ms: float, attempt: int) -> None:
-    """base * 2^(attempt-1) + jitter in [0, base), capped at 2s."""
+    """base * 2^(attempt-1) + jitter in [0, base), capped at 2s.
+
+    Cancellable (ISSUE 4): a backoff is a *blocked batch pull*, so it
+    sleeps on the query's CancelToken — a deadline/cancel trip wakes it
+    immediately and raises instead of holding the stage (and whatever it
+    pinned) for the rest of the delay."""
     if base_ms <= 0:
         return
     delay = min(base_ms * (2 ** (attempt - 1)), 2000.0)
     delay += random.random() * base_ms
-    time.sleep(delay / 1000.0)
+    from spark_rapids_tpu.lifecycle.context import current_token
+
+    token = current_token()
+    if token is not None:
+        token.sleep_or_raise(delay / 1000.0)
+    else:
+        time.sleep(delay / 1000.0)
 
 
 _KEY_UNSET = object()

@@ -143,6 +143,22 @@ class TpuSession:
     def shuffle_partitions(self) -> int:
         return self.conf.get(SHUFFLE_PARTITIONS)
 
+    def close(self, check_leaks: bool = True) -> List[str]:
+        """Session shutdown (ISSUE 4 satellite): report — and then
+        release — anything still held across the process singletons:
+        unclosed non-persistent spillables, semaphore permits, live
+        shuffle registrations.  Returns the leak report (empty for a
+        well-behaved session); with spark.rapids.memory.debug the
+        entries carry allocation stacks."""
+        from spark_rapids_tpu.lifecycle import (
+            leak_report_all,
+            reset_leaked_state,
+        )
+
+        leaks = leak_report_all() if check_leaks else []
+        reset_leaked_state()
+        return leaks
+
 
 class TpuSessionBuilder:
     def __init__(self):
@@ -554,6 +570,17 @@ class DataFrame:
         return root, meta
 
     def collect(self) -> List[tuple]:
+        # Query lifecycle (ISSUE 4): admission slot BEFORE planning, an
+        # optional deadline armed by the watchdog, a CancelToken every
+        # blocking layer observes, and guaranteed cleanup (semaphore
+        # permits, tracked spillables, shuffle registrations) when the
+        # exec tree unwinds — even mid-batch
+        from spark_rapids_tpu.lifecycle import query_lifecycle
+
+        with query_lifecycle(self.session.conf) as qctx:
+            return self._collect_impl(qctx)
+
+    def _collect_impl(self, qctx) -> List[tuple]:
         from spark_rapids_tpu.cpu.oracle import execute_cpu_plan
         from spark_rapids_tpu.exec.base import TpuExec
         from spark_rapids_tpu.exec.transitions import TpuColumnarToRowExec
@@ -573,11 +600,21 @@ class DataFrame:
             # counter attribution — flushed atomically to the configured
             # sinks on exit and kept on the DataFrame for
             # explain("analyze")
+            from spark_rapids_tpu.config import ambient_conf
             from spark_rapids_tpu.diagnostics import query_scope
 
             scope = query_scope(self.session.conf, root)
             try:
-                with scope:
+                # thread-local conf pin: concurrent collects each read
+                # THEIR OWN session conf through config.get_conf() on
+                # their own thread, instead of racing the process-global
+                # ambient slot _planned() set (ISSUE 4: N queries with
+                # different knobs must not clobber each other)
+                with ambient_conf(self.session.conf), scope:
+                    if scope.diag is not None and qctx is not None:
+                        scope.diag.lifecycle(
+                            "admitted", qctx.query_id,
+                            qctx.admission_wait_ns)
                     # Plan-time AOT pipeline (compilecache/aot.py): enumerate
                     # the stage programs this exec tree will need and compile
                     # them on the background pool NOW, so the first operator's
@@ -619,11 +656,30 @@ class DataFrame:
                     from spark_rapids_tpu.resilience.faults import arm_conf_spec
 
                     arm_conf_spec(self.session.conf.get(RESILIENCE_TEST_INJECT))
+                    from spark_rapids_tpu.config import (
+                        SEMAPHORE_ACQUIRE_TIMEOUT_MS,
+                    )
+
+                    sem_timeout_ms = int(self.session.conf.get(
+                        SEMAPHORE_ACQUIRE_TIMEOUT_MS))
                     sem = get_semaphore(self.session.conf.concurrent_tpu_tasks)
                     try:
-                        with sem.scope():
+                        with sem.scope(
+                                timeout=(sem_timeout_ms / 1000.0
+                                         if sem_timeout_ms > 0 else None)):
                             host = TpuColumnarToRowExec(root).collect_host()
                     except Exception as e:
+                        from spark_rapids_tpu.lifecycle.context import (
+                            QueryCancelled,
+                            QueryDeadlineExceeded,
+                        )
+
+                        if isinstance(e, QueryCancelled) \
+                                and scope.diag is not None:
+                            scope.diag.lifecycle(
+                                "deadline_trip"
+                                if isinstance(e, QueryDeadlineExceeded)
+                                else "cancelled", str(e))
                         host = self._query_fallback(e)
             finally:
                 # None when this collect ran unrecorded; assigned on the

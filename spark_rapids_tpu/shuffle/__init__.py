@@ -21,9 +21,10 @@ from spark_rapids_tpu.shuffle.manager import (
     get_shuffle_manager,
 )
 from spark_rapids_tpu.shuffle.serializer import (
+    ShuffleCorruption,
     deserialize_concat,
     serialize_batch,
 )
 
 __all__ = ["TpuShuffleManager", "get_shuffle_manager", "serialize_batch",
-           "deserialize_concat"]
+           "deserialize_concat", "ShuffleCorruption"]

@@ -107,6 +107,10 @@ class TpuShuffleManager:
         self._next_shuffle = itertools.count()
         self._pool: Optional[cf.ThreadPoolExecutor] = None
         self._lock = threading.Lock()
+        # lifecycle bookkeeping (ISSUE 4): live shuffle ids + the query
+        # that registered each, so query-end cleanup can drop what a
+        # mid-batch unwind left behind and the leak gate can see the rest
+        self._owners: Dict[int, Optional[str]] = {}
         # metrics
         self.bytes_written = 0
         self.blocks_written = 0
@@ -120,12 +124,37 @@ class TpuShuffleManager:
             return self._pool
 
     def register_shuffle(self) -> int:
-        return next(self._next_shuffle)
+        from spark_rapids_tpu.lifecycle.context import current
+
+        sid = next(self._next_shuffle)
+        ctx = current()
+        with self._lock:
+            self._owners[sid] = ctx.query_id if ctx is not None else None
+        return sid
+
+    def active_shuffles(self) -> List[int]:
+        with self._lock:
+            return sorted(self._owners)
+
+    def unregister_owned(self, query_id: str) -> int:
+        """Query-end cleanup: drop every registration the given query
+        left behind; returns how many were dropped."""
+        with self._lock:
+            victims = [sid for sid, q in self._owners.items()
+                       if q == query_id]
+        for sid in victims:
+            self.unregister_shuffle(sid)
+        return len(victims)
 
     # -- write side ------------------------------------------------------
     def write_map_output(self, shuffle_id: int, map_id: int,
                          slices: List[ColumnarBatch]) -> None:
         """Write one map task's partition slices (pid = index)."""
+        from spark_rapids_tpu.lifecycle.context import current_token
+
+        token = current_token()   # captured HERE: pool threads have no
+        if token is not None:     # query contextvar of their own
+            token.check()
         if self.mode == "CACHE_ONLY":
             for pid, b in enumerate(slices):
                 if b is not None and b.num_rows > 0:
@@ -135,21 +164,39 @@ class TpuShuffleManager:
         pool = self._get_pool()
 
         def job(pid: int, batch: ColumnarBatch):
+            # cooperative cancellation: a cancelled query's queued
+            # serialization jobs bail instead of burning the pool
+            if token is not None:
+                token.check()
             blob = serialize_batch(batch, codec=self.codec)
             self.store.put((shuffle_id, map_id, pid), blob)
             return len(blob)
 
         futures = [pool.submit(job, pid, b) for pid, b in enumerate(slices)
                    if b is not None and b.num_rows > 0]
-        for f in futures:
-            n = f.result()
-            self.bytes_written += n
-            self.blocks_written += 1
+        try:
+            for f in futures:
+                n = f.result()
+                self.bytes_written += n
+                self.blocks_written += 1
+        except BaseException:
+            for f in futures:
+                f.cancel()
+            # drain in-flight jobs before unwinding: a straggler's
+            # store.put() landing AFTER query-end cleanup unregistered
+            # this shuffle would leak its block in the singleton store
+            # forever (the id is gone from the owner map, so no leak
+            # report would ever see it)
+            cf.wait(futures)
+            raise
 
     # -- read side -------------------------------------------------------
     def read_partition(self, shuffle_id: int, pid: int,
                        schema: T.StructType) -> Optional[ColumnarBatch]:
         """Assemble one reduce partition from all map outputs."""
+        from spark_rapids_tpu.lifecycle.context import check_cancel
+
+        check_cancel()
         if self.mode == "CACHE_ONLY":
             batches = [b for k, b in sorted(self._device_store.items())
                        if k[0] == shuffle_id and k[2] == pid]
@@ -168,6 +215,8 @@ class TpuShuffleManager:
         self.store.remove_shuffle(shuffle_id)
         for k in [k for k in self._device_store if k[0] == shuffle_id]:
             del self._device_store[k]
+        with self._lock:
+            self._owners.pop(shuffle_id, None)
 
 
 _lock = threading.Lock()
@@ -190,6 +239,12 @@ def get_shuffle_manager(tpu_conf: Optional[TpuConf] = None) -> TpuShuffleManager
             _manager = TpuShuffleManager(tpu_conf)
             _manager_key = key
         return _manager
+
+
+def peek_shuffle_manager() -> Optional[TpuShuffleManager]:
+    """The singleton if it exists — cleanup/leak paths must never CREATE
+    one."""
+    return _manager
 
 
 def reset_shuffle_manager() -> None:

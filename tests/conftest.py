@@ -35,6 +35,19 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: fault-injection sweep tests "
         "(tools/run_chaos.py runs these standalone)")
+    config.addinivalue_line(
+        "markers", "stress: concurrent-query stress harness "
+        "(tools/run_stress.py runs the big sweeps standalone)")
+
+
+@pytest.hookimpl(tryfirst=True, hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Stash per-phase reports so teardown fixtures can tell whether the
+    test body itself passed (the leak gate must not stack an ERROR on an
+    already-failing test)."""
+    outcome = yield
+    rep = outcome.get_result()
+    setattr(item, "rep_" + rep.when, rep)
 
 
 @pytest.fixture(autouse=True)
@@ -50,6 +63,53 @@ def _resilience_isolation():
     yield
     clear_faults()
     reset_breaker()
+
+
+@pytest.fixture(autouse=True)
+def _leak_gate(request):
+    """ISSUE 4 satellite: a leaked spillable handle, semaphore permit, or
+    shuffle registration fails the OWNING test instead of silently
+    poisoning every later one.  The gate only *fails* a test whose body
+    passed (a failing test already reported its real error — the leaked
+    state is still cleaned so it cannot cascade)."""
+    yield
+    from spark_rapids_tpu.lifecycle import (
+        leak_report_all,
+        reset_leaked_state,
+    )
+
+    try:
+        leaks = leak_report_all()
+    except Exception:
+        return
+    if not leaks:
+        return
+    reset_leaked_state()
+    rep = getattr(request.node, "rep_call", None)
+    if rep is not None and rep.passed:
+        pytest.fail(
+            "resource leak after test (spillables / semaphore permits / "
+            "shuffle registrations):\n" + "\n".join(leaks[:20]),
+            pytrace=False)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Session-shutdown leak check: print (never fail) anything still
+    live at exit, so CI logs surface a leak even when the owning test
+    could not be identified."""
+    try:
+        from spark_rapids_tpu.lifecycle import leak_report_all
+
+        leaks = leak_report_all()
+    except Exception:
+        return
+    if leaks:
+        import sys
+
+        print("\nspark_rapids_tpu session-shutdown leak report "
+              f"({len(leaks)} entries):", file=sys.stderr)
+        for line in leaks[:20]:
+            print("  " + line.splitlines()[0], file=sys.stderr)
 
 
 @pytest.fixture

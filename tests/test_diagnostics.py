@@ -73,6 +73,7 @@ GOLDEN_SCHEMA = {
     "sync": ["kind", "dur_ns", "bytes"],
     "cache": ["hit", "label"],
     "resilience": ["kind", "op_name", "detail"],
+    "lifecycle": ["kind", "detail", "dur_ns"],
     "op_batch": ["path", "batch", "rows", "dur_ns"],
     "operator": ["path", "name", "describe", "wall_ns", "self_wall_ns",
                  "batches", "rows", "counters", "metrics", "fallback"],

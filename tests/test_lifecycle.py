@@ -1,0 +1,663 @@
+"""Query lifecycle tests (ISSUE 4): admission control, deadlines,
+cooperative cancellation, priority semaphore, integrity checksums, and
+the concurrent-query stress criterion."""
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.session import TpuSession, col, sum_
+
+
+def _mk_session(extra=None, limit=4, queue=16):
+    conf = {
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.tpu.concurrentQueries": str(limit),
+        "spark.rapids.tpu.admission.maxQueueDepth": str(queue),
+        "spark.rapids.tpu.resilience.backoffBaseMs": "0",
+    }
+    conf.update(extra or {})
+    return TpuSession(conf)
+
+
+def _small_df(s, n=64, k=4):
+    return s.create_dataframe(
+        {"a": list(range(n)), "k": [i % k for i in range(n)]},
+        T.StructType([T.StructField("a", T.LONG, True),
+                      T.StructField("k", T.LONG, True)]))
+
+
+def _agg_query(s, n=64):
+    return _small_df(s, n).group_by("k").agg(sum_("a", "s"))
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def test_admission_fifo_and_reject_unit():
+    from spark_rapids_tpu.lifecycle import QueryRejected
+    from spark_rapids_tpu.lifecycle.admission import AdmissionController
+    from spark_rapids_tpu.lifecycle.context import QueryContext
+
+    ctl = AdmissionController(limit=1, max_queue=1)
+    c1, c2, c3 = QueryContext(), QueryContext(), QueryContext()
+    ctl.acquire(c1)
+    # one waiter fits the queue...
+    got = []
+
+    def waiter():
+        ctl.acquire(c2)
+        got.append("c2")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    deadline = time.monotonic() + 5
+    while ctl.stats()["queued"] != 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    # ...the next one fast-rejects
+    with pytest.raises(QueryRejected):
+        ctl.acquire(c3)
+    ctl.release()
+    t.join(5)
+    assert got == ["c2"]
+    ctl.release()
+    assert ctl.stats() == {"running": 0, "queued": 0,
+                           "limit": 1, "max_queue": 1}
+
+
+def test_admission_queue_timeout_rejects():
+    from spark_rapids_tpu.lifecycle import QueryRejected
+    from spark_rapids_tpu.lifecycle.admission import AdmissionController
+    from spark_rapids_tpu.lifecycle.context import QueryContext
+
+    ctl = AdmissionController(limit=1, max_queue=4)
+    ctl.acquire(QueryContext())
+    t0 = time.monotonic()
+    with pytest.raises(QueryRejected):
+        ctl.acquire(QueryContext(), timeout_ms=150)
+    assert time.monotonic() - t0 < 5.0
+    ctl.release()
+
+
+def test_admission_cancel_while_queued_unblocks():
+    from spark_rapids_tpu.lifecycle import QueryCancelled
+    from spark_rapids_tpu.lifecycle.admission import AdmissionController
+    from spark_rapids_tpu.lifecycle.context import QueryContext
+
+    ctl = AdmissionController(limit=1, max_queue=4)
+    ctl.acquire(QueryContext())
+    c2 = QueryContext()
+    err = []
+
+    def waiter():
+        try:
+            ctl.acquire(c2)
+        except QueryCancelled as e:
+            err.append(e)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    deadline = time.monotonic() + 5
+    while ctl.stats()["queued"] != 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    c2.cancel("test")
+    t.join(5)
+    assert len(err) == 1
+    assert ctl.stats()["queued"] == 0
+    ctl.release()
+
+
+def test_concurrent_collects_serialize_through_admission():
+    """Two collects under concurrentQueries=1: the second is admitted
+    only after the first finishes, and reports a queue wait."""
+    from spark_rapids_tpu.expr.udf import udf
+    from spark_rapids_tpu.lifecycle import last_query_stats
+
+    started = threading.Event()
+    release = threading.Event()
+
+    def blocker(x):
+        started.set()
+        release.wait(20)
+        return x
+
+    conf = {"spark.rapids.sql.udfCompiler.enabled": "false"}
+    s1 = _mk_session(conf, limit=1, queue=4)
+    s2 = _mk_session(conf, limit=1, queue=4)
+    dfa = _small_df(s1, 8).select(
+        udf(blocker, T.LONG, "blocker")(col("a")).alias("r"))
+    results = {}
+
+    def run_a():
+        results["a"] = dfa.collect()
+        results["a_stats"] = last_query_stats()
+
+    def run_b():
+        results["b"] = _agg_query(s2).collect()
+        results["b_stats"] = last_query_stats()
+
+    ta = threading.Thread(target=run_a)
+    ta.start()
+    assert started.wait(20), "query A never started executing"
+    tb = threading.Thread(target=run_b)
+    tb.start()
+    # B must be queued (not running) while A holds the only slot
+    from spark_rapids_tpu.lifecycle import get_admission
+
+    ctl = get_admission(1, 4)
+    deadline = time.monotonic() + 10
+    while ctl.stats()["queued"] != 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert ctl.stats()["queued"] == 1
+    release.set()
+    ta.join(30)
+    tb.join(30)
+    assert sorted(r[0] for r in results["a"]) == list(range(8))
+    assert sorted(results["b"]) == [(0, 480), (1, 496), (2, 512), (3, 528)]
+    assert results["b_stats"]["admission_wait_ns"] > 0
+
+
+def test_admission_queue_full_fast_reject():
+    from spark_rapids_tpu.expr.udf import udf
+    from spark_rapids_tpu.lifecycle import QueryRejected
+
+    started = threading.Event()
+    release = threading.Event()
+
+    def blocker(x):
+        started.set()
+        release.wait(20)
+        return x
+
+    conf = {"spark.rapids.sql.udfCompiler.enabled": "false"}
+    s1 = _mk_session(conf, limit=1, queue=0)
+    s2 = _mk_session(conf, limit=1, queue=0)
+    dfa = _small_df(s1, 8).select(
+        udf(blocker, T.LONG, "blocker")(col("a")).alias("r"))
+    ta = threading.Thread(target=dfa.collect)
+    ta.start()
+    try:
+        assert started.wait(20)
+        t0 = time.monotonic()
+        with pytest.raises(QueryRejected):
+            _agg_query(s2).collect()
+        # fast-reject: no planning, no queue wait
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        release.set()
+        ta.join(30)
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+def test_deadline_aborts_blocked_query_and_session_recovers():
+    """Acceptance pin: a query exceeding query.timeoutMs on a blocked
+    batch pull (here: the semaphore acquire a stuck peer never releases)
+    aborts within ~2x the watchdog period of its deadline, and a
+    subsequent query on the same session runs normally."""
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu.lifecycle import QueryDeadlineExceeded
+    from spark_rapids_tpu.memory.semaphore import get_semaphore
+
+    s = _mk_session({
+        "spark.rapids.sql.concurrentGpuTasks": "1",
+        "spark.rapids.tpu.query.timeoutMs": "1000",
+        "spark.rapids.tpu.query.watchdogPeriodMs": "100",
+    })
+    df = _agg_query(s)
+    # warm the plan's programs while nothing contends (compile wall must
+    # not eat the deadline budget below)
+    assert sorted(df.collect()) == [(0, 480), (1, 496), (2, 512), (3, 528)]
+
+    sem = get_semaphore(1)
+    held = threading.Event()
+    release = threading.Event()
+
+    def hold():
+        sem.acquire_if_necessary()
+        held.set()
+        release.wait(30)
+        sem.release_if_necessary()
+
+    t = threading.Thread(target=hold)
+    t.start()
+    assert held.wait(10)
+    snap = PC.snapshot()
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(QueryDeadlineExceeded):
+            df.collect()
+        elapsed = time.monotonic() - t0
+        # deadline 1.0s + watchdog trip (<=0.1s) + wait-slice notice
+        # (<=0.1s) + scheduling slack
+        assert 0.8 < elapsed < 3.0, elapsed
+        d = PC.since(snap)
+        assert d["deadline_trips"] >= 1
+        assert d["queries_cancelled"] >= 1
+        # never retried / fallbacked / breaker-counted
+        assert d["transient_retries"] == 0
+        assert d["runtime_fallbacks"] == 0
+        assert d["query_fallbacks"] == 0
+        assert d["breaker_trips"] == 0
+    finally:
+        release.set()
+        t.join(10)
+    # the same session runs normally afterwards
+    assert sorted(df.collect()) == [(0, 480), (1, 496), (2, 512), (3, 528)]
+    from spark_rapids_tpu.lifecycle import leak_report_all
+
+    assert leak_report_all() == []
+
+
+def test_deadline_trips_query_stuck_in_admission_queue():
+    """A query waiting for ADMISSION (not yet running) must still be
+    deadline-trippable and visible to active_queries cancel tooling."""
+    from spark_rapids_tpu.expr.udf import udf
+    from spark_rapids_tpu.lifecycle import QueryDeadlineExceeded
+
+    started = threading.Event()
+    release = threading.Event()
+
+    def blocker(x):
+        started.set()
+        release.wait(30)
+        return x
+
+    conf = {"spark.rapids.sql.udfCompiler.enabled": "false"}
+    s1 = _mk_session(conf, limit=1, queue=4)
+    s2 = _mk_session({
+        **conf,
+        "spark.rapids.tpu.query.timeoutMs": "400",
+        "spark.rapids.tpu.query.watchdogPeriodMs": "100",
+    }, limit=1, queue=4)
+    dfa = _small_df(s1, 8).select(
+        udf(blocker, T.LONG, "blocker")(col("a")).alias("r"))
+    ta = threading.Thread(target=dfa.collect)
+    ta.start()
+    try:
+        assert started.wait(20)
+        t0 = time.monotonic()
+        with pytest.raises(QueryDeadlineExceeded):
+            _agg_query(s2).collect()   # never admitted: A holds the slot
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        release.set()
+        ta.join(30)
+
+
+# ---------------------------------------------------------------------------
+# cooperative cancellation
+# ---------------------------------------------------------------------------
+
+def test_cancel_mid_query_propagates_and_cleans_up():
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu.expr.udf import udf
+    from spark_rapids_tpu.lifecycle import (
+        QueryCancelled,
+        active_queries,
+        leak_report_all,
+    )
+    from spark_rapids_tpu.resilience.breaker import get_breaker
+
+    def slow(x):
+        time.sleep(0.001)
+        return x
+
+    s = _mk_session({"spark.rapids.sql.udfCompiler.enabled": "false"})
+    base = _small_df(s, 48)
+    df = base.union(base).union(base).union(base).select(
+        udf(slow, T.LONG, "slow")(col("a")).alias("r"))
+    snap = PC.snapshot()
+    errs = []
+
+    def run():
+        try:
+            df.collect()
+            errs.append(None)
+        except QueryCancelled as e:
+            errs.append(e)
+
+    t = threading.Thread(target=run)
+    t.start()
+    deadline = time.monotonic() + 10
+    while not active_queries() and time.monotonic() < deadline:
+        time.sleep(0.005)
+    qs = active_queries()
+    assert qs, "query never became active"
+    qs[0].cancel("user abort")
+    t.join(30)
+    assert len(errs) == 1
+    if errs[0] is not None:   # cancelled (unless the query won the race)
+        assert isinstance(errs[0], QueryCancelled)
+        d = PC.since(snap)
+        assert d["queries_cancelled"] == 1
+        # cancellation is PROPAGATE: no retry, no fallback, no breaker
+        assert d["transient_retries"] == 0
+        assert d["runtime_fallbacks"] == 0
+        assert d["query_fallbacks"] == 0
+        assert not get_breaker().has_entries()
+    assert leak_report_all() == []
+
+
+def test_cancellation_classified_propagate():
+    from spark_rapids_tpu.lifecycle import (
+        QueryCancelled,
+        QueryDeadlineExceeded,
+        QueryRejected,
+    )
+    from spark_rapids_tpu.memory.semaphore import SemaphoreTimeout
+    from spark_rapids_tpu.memory.spill import SpillCorruption
+    from spark_rapids_tpu.resilience.classify import (
+        DETERMINISTIC,
+        PROPAGATE,
+        TRANSIENT,
+        classify_failure,
+    )
+    from spark_rapids_tpu.shuffle.serializer import ShuffleCorruption
+
+    assert classify_failure(QueryCancelled("x")) == PROPAGATE
+    assert classify_failure(QueryDeadlineExceeded("x")) == PROPAGATE
+    assert classify_failure(QueryRejected("x")) == PROPAGATE
+    # wrapped cancellations stay PROPAGATE (cause-chain walk)
+    try:
+        try:
+            raise QueryCancelled("inner")
+        except QueryCancelled as e:
+            raise RuntimeError("wrapped") from e
+    except RuntimeError as wrapped:
+        assert classify_failure(wrapped) == PROPAGATE
+    # satellite contracts
+    assert classify_failure(SemaphoreTimeout("x")) == TRANSIENT
+    assert classify_failure(ShuffleCorruption("x")) == DETERMINISTIC
+    assert classify_failure(SpillCorruption("x")) == DETERMINISTIC
+
+
+def test_cancel_token_wakes_backoff_sleep():
+    from spark_rapids_tpu.lifecycle.context import CancelToken, QueryCancelled
+
+    tok = CancelToken()
+
+    def trip():
+        time.sleep(0.05)
+        tok.trip(QueryCancelled, "now")
+
+    t = threading.Thread(target=trip)
+    t0 = time.monotonic()
+    t.start()
+    with pytest.raises(QueryCancelled):
+        tok.sleep_or_raise(10.0)
+    assert time.monotonic() - t0 < 5.0
+    t.join()
+
+
+# ---------------------------------------------------------------------------
+# semaphore satellite: typed timeout, priority, release-after-failure
+# ---------------------------------------------------------------------------
+
+def test_semaphore_timeout_typed_and_release_safe():
+    from spark_rapids_tpu.memory.semaphore import SemaphoreTimeout, TpuSemaphore
+
+    sem = TpuSemaphore(1)
+    held = threading.Event()
+    release = threading.Event()
+
+    def hold():
+        sem.acquire_if_necessary()
+        held.set()
+        release.wait(10)
+        sem.release_if_necessary()
+
+    t = threading.Thread(target=hold)
+    t.start()
+    assert held.wait(10)
+    try:
+        with pytest.raises(SemaphoreTimeout):
+            sem.acquire_if_necessary(timeout=0.1)
+        # the permit is deterministically NOT held...
+        assert not sem.held_by_current_thread()
+        # ...and release from a finally after the failed acquire is safe
+        sem.release_if_necessary()
+        assert sem.leak_report() != []   # holder thread still holds — fine
+    finally:
+        release.set()
+        t.join(10)
+    assert sem.leak_report() == []
+    sem.acquire_if_necessary(timeout=0.1)   # now free
+    sem.release_if_necessary()
+
+
+def test_semaphore_priority_prefers_running_query():
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+
+    sem = TpuSemaphore(1)
+    sem.acquire_if_necessary(priority=5)
+    order = []
+
+    def waiter(prio, name):
+        sem.acquire_if_necessary(priority=prio)
+        order.append(name)
+        sem.release_if_necessary()
+
+    t_new = threading.Thread(target=waiter, args=(10, "new"))
+    t_new.start()
+    deadline = time.monotonic() + 5
+    while len(sem._waiters) != 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    t_run = threading.Thread(target=waiter, args=(1, "running"))
+    t_run.start()
+    while len(sem._waiters) != 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    sem.release_if_necessary()
+    t_new.join(10)
+    t_run.join(10)
+    # the earlier-admitted (lower seq) query got the permit first even
+    # though it arrived at the semaphore later
+    assert order == ["running", "new"]
+
+
+def test_semaphore_lock_order_guard():
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.memory import spill as spill_mod
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+
+    spill_mod.reset_spill_framework()
+    fw = spill_mod.get_spill_framework(TpuConf())
+    sem = TpuSemaphore(1)
+    with fw._lock:
+        with pytest.raises(RuntimeError, match="lock-order"):
+            sem.acquire_if_necessary()
+    # outside the spill lock the acquire works
+    sem.acquire_if_necessary()
+    sem.release_if_necessary()
+
+
+# ---------------------------------------------------------------------------
+# integrity checksums (shuffle frames + disk spill)
+# ---------------------------------------------------------------------------
+
+def _device_batch(n=100):
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.columnar.column import HostColumn
+
+    h = [HostColumn.from_pylist(list(range(n)), T.LONG),
+         HostColumn.from_pylist([f"s{i}" for i in range(n)], T.STRING)]
+    return ColumnarBatch.from_host_columns(h, ["a", "b"])
+
+
+@pytest.mark.parametrize("codec", [None, "zstd"])
+def test_shuffle_frame_crc_bit_flip(codec):
+    from spark_rapids_tpu.shuffle.serializer import (
+        ShuffleCorruption,
+        deserialize_concat,
+        serialize_batch,
+    )
+
+    schema = T.StructType([T.StructField("a", T.LONG, True),
+                           T.StructField("b", T.STRING, True)])
+    b = _device_batch()
+    blob = serialize_batch(b, codec=codec)
+    out = deserialize_concat([blob], schema, codec=codec)
+    assert out.num_rows == 100
+    for pos in (10, len(blob) // 2, len(blob) - 3):
+        bad = bytearray(blob)
+        bad[pos] ^= 0x40
+        with pytest.raises(ShuffleCorruption):
+            deserialize_concat([bytes(bad)], schema, codec=codec)
+
+
+def test_spill_disk_crc_bit_flip(tmp_path):
+    from spark_rapids_tpu.memory.spill import SpillCorruption, SpillFramework
+
+    fw = SpillFramework(pool_bytes=1 << 30, host_limit=0,
+                        spill_dir=str(tmp_path))
+    h = fw.track(_device_batch())
+    fw.ensure_room(1 << 40)    # push device -> host -> (limit 0) disk
+    assert h.state == "DISK"
+    path = h._disk_path
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0x01
+    with open(path, "wb") as f:
+        f.write(bytes(raw))
+    with pytest.raises(SpillCorruption):
+        h.get_batch()
+    h.close()
+
+
+def test_spill_disk_roundtrip_crc_ok(tmp_path):
+    from spark_rapids_tpu.memory.spill import SpillFramework
+
+    fw = SpillFramework(pool_bytes=1 << 30, host_limit=0,
+                        spill_dir=str(tmp_path))
+    h = fw.track(_device_batch(50))
+    fw.ensure_room(1 << 40)
+    assert h.state == "DISK"
+    b = h.get_batch()
+    assert b.num_rows == 50
+    import numpy as np
+
+    assert list(np.asarray(b.columns[0].data)[:50]) == list(range(50))
+    h.close()
+
+
+# ---------------------------------------------------------------------------
+# diagnostics integration
+# ---------------------------------------------------------------------------
+
+def test_lifecycle_admitted_event_recorded():
+    s = _mk_session({"spark.rapids.tpu.diagnostics.enabled": "true"})
+    df = _agg_query(s)
+    df.collect()
+    diag = df._last_diag
+    assert diag is not None
+    evs = [e for e in diag.events if e["ev"] == "lifecycle"]
+    assert any(e["kind"] == "admitted" for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# the 8-way stress criterion (small tier-1 version; tools/run_stress.py
+# and the @stress-marked sweep scale it up)
+# ---------------------------------------------------------------------------
+
+def test_stress_eight_concurrent_collects_with_faults_and_cancels():
+    import random
+
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu.lifecycle import (
+        QueryCancelled,
+        QueryRejected,
+        active_queries,
+        leak_report_all,
+    )
+    from spark_rapids_tpu.resilience import clear_faults, inject_fault
+
+    rng = random.Random(20260803)
+
+    def q_agg(s):
+        return _agg_query(s, 96)
+
+    def q_sort(s):
+        return _small_df(s, 96).order_by("a", ascending=False).limit(5)
+
+    def q_join(s):
+        left = _small_df(s, 64)
+        right = s.create_dataframe(
+            {"k": [0, 1, 2, 3], "w": [10, 20, 30, 40]},
+            T.StructType([T.StructField("k", T.LONG, True),
+                          T.StructField("w", T.LONG, True)]))
+        return left.join(right, on="k", how="inner") \
+            .group_by("w").agg(sum_("a", "s"))
+
+    shapes = [q_agg, q_sort, q_join]
+    oracle = {}
+    for i, q in enumerate(shapes):
+        so = TpuSession({"spark.rapids.sql.enabled": False})
+        oracle[i] = sorted(q(so).collect())
+
+    # chaos faults on the aggregate + injected OOMs via conf (both
+    # consumed by whichever concurrent query hits them first)
+    clear_faults()
+    inject_fault("TpuHashAggregateExec", "transient", count=4)
+    base_conf = {
+        "spark.rapids.tpu.resilience.backoffBaseMs": "0",
+        "spark.rapids.sql.concurrentGpuTasks": "2",
+    }
+    outcomes = []
+    out_lock = threading.Lock()
+    stop_cancelling = threading.Event()
+
+    def worker(wid):
+        extra = dict(base_conf)
+        if wid % 3 == 0:
+            extra["spark.rapids.sql.test.injectRetryOOM"] = "RETRY:1"
+        if wid == 5:
+            extra["spark.rapids.tpu.query.timeoutMs"] = "30000"
+        s = _mk_session(extra, limit=4, queue=16)
+        for r in range(2):
+            qi = (wid + r) % len(shapes)
+            try:
+                rows = sorted(shapes[qi](s).collect())
+                with out_lock:
+                    outcomes.append(("ok", qi, rows))
+            except (QueryCancelled, QueryRejected) as e:
+                with out_lock:
+                    outcomes.append(("cancelled", qi, type(e).__name__))
+
+    def canceller():
+        end = time.monotonic() + 1.0
+        n = 0
+        while time.monotonic() < end and n < 3 \
+                and not stop_cancelling.is_set():
+            qs = active_queries()
+            if qs:
+                rng.choice(qs).cancel("stress chaos")
+                n += 1
+            time.sleep(0.05)
+
+    snap = PC.snapshot()
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    tc = threading.Thread(target=canceller)
+    for t in threads:
+        t.start()
+    tc.start()
+    for t in threads:
+        t.join(120)
+    stop_cancelling.set()
+    tc.join(10)
+    clear_faults()
+    assert len(outcomes) == 16
+    for kind, qi, payload in outcomes:
+        if kind == "ok":
+            assert payload == oracle[qi], f"shape {qi} diverged"
+        else:
+            assert payload in ("QueryCancelled", "QueryDeadlineExceeded",
+                               "QueryRejected")
+    # zero leaked permits, spillables, or shuffle registrations
+    assert leak_report_all() == []
+    d = PC.since(snap)
+    # a query cancelled while still QUEUED is never admitted, so admitted
+    # + cancelled together must cover every attempt
+    assert d["queries_admitted"] + d["queries_cancelled"] >= 16

@@ -1,0 +1,30 @@
+"""The ``stress``-marked concurrent-query sweep (ISSUE 4 satellite).
+
+Runs the tools/run_stress.py engine — N threads x M mixed queries under
+chaos faults, injected OOM, and random cancellations — asserting every
+query either matches the CPU oracle or raises a clean lifecycle error,
+with empty leak reports afterwards.  The tier-1 acceptance pin (8
+concurrent collects) lives in tests/test_lifecycle.py; this sweep is the
+bigger, slower soak (`pytest -m stress`, or the CLI for full control).
+"""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+
+
+@pytest.mark.stress
+@pytest.mark.slow
+@pytest.mark.parametrize("timeout_ms", [0, 15000])
+def test_stress_sweep(timeout_ms):
+    from run_stress import run_stress
+
+    s = run_stress(n_threads=8, rounds=3, seed=20260803,
+                   cancel_budget=5, timeout_ms=timeout_ms, quiet=True)
+    assert not s["failures"], s["failures"]
+    assert not s["leaks"], s["leaks"]
+    assert s["queries"] == 24
+    assert s["ok"] + s["cancelled"] == 24

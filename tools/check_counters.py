@@ -11,7 +11,12 @@ Checks, failing the suite (tests/test_diagnostics.py calls
   typed registry AND appears in ``docs/diagnostics.md`` AND in the
   generated ``docs/configs.md`` (i.e. gen_docs.py was re-run);
 * every event type in ``diagnostics.recorder.EVENT_SCHEMA`` appears in
-  ``docs/diagnostics.md``.
+  ``docs/diagnostics.md``;
+* every query-lifecycle conf (``spark.rapids.tpu.concurrentQueries``,
+  ``spark.rapids.tpu.admission.*``, ``spark.rapids.tpu.query.*``,
+  ``spark.rapids.tpu.semaphore.*``) appears in ``docs/concurrency.md``
+  and the generated ``docs/configs.md``, and the lifecycle counters are
+  documented in both.
 """
 from __future__ import annotations
 
@@ -75,6 +80,35 @@ def check() -> list:
             problems.append(
                 f"event type '{ev}' is not documented in "
                 f"docs/diagnostics.md")
+
+    # query lifecycle (ISSUE 4): confs + counters must be documented in
+    # docs/concurrency.md (and confs in the regenerated configs.md)
+    conc_md = read("concurrency.md")
+    life_confs = [k for k in _REGISTRY
+                  if k == "spark.rapids.tpu.concurrentQueries"
+                  or k.startswith(("spark.rapids.tpu.admission.",
+                                   "spark.rapids.tpu.query.",
+                                   "spark.rapids.tpu.semaphore."))]
+    if not life_confs:
+        problems.append("no query-lifecycle confs registered")
+    for key in sorted(life_confs):
+        if f"`{key}`" not in conc_md:
+            problems.append(
+                f"conf '{key}' is not documented in docs/concurrency.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+    for key in ("queries_admitted", "queries_rejected",
+                "queries_cancelled", "deadline_trips",
+                "admission_wait_ns"):
+        if key not in PC.COUNTERS:
+            problems.append(f"lifecycle counter '{key}' is not "
+                            f"registered in perfcounters.COUNTERS")
+        if f"`{key}`" not in conc_md:
+            problems.append(
+                f"lifecycle counter '{key}' is not documented in "
+                f"docs/concurrency.md")
     return problems
 
 

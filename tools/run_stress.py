@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Concurrent-query stress harness (ISSUE 4 satellite).
+
+    python tools/run_stress.py [--threads 8] [--rounds 3] [--seed 7]
+                               [--cancels 4] [--timeout-ms 0]
+
+N worker threads each run M mixed queries (shuffled aggregate, sort +
+limit, broadcast join + aggregate, two-level distinct) through their own
+TpuSession while:
+
+  * chaos faults (transient + compile) are armed on shared operators,
+  * a subset of workers runs with injected RetryOOM,
+  * a canceller thread trips random in-flight queries' CancelTokens,
+  * (optionally) every query carries a spark.rapids.tpu.query.timeoutMs
+    deadline.
+
+Every outcome must be either ORACLE-CORRECT rows or a clean
+QueryCancelled / QueryDeadlineExceeded / QueryRejected; afterwards the
+process-wide leak report (spillable handles, semaphore permits, shuffle
+registrations) must be empty.  Exit code 0 iff both hold.
+
+CPU-only (same virtual-device setup as the tier-1 suite); the
+``stress``-marked pytest in tests/test_stress_harness.py runs the same
+engine at a smaller size.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, os.pardir))
+xf = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in xf:
+    os.environ["XLA_FLAGS"] = (
+        xf + " --xla_force_host_platform_device_count=8").strip()
+if os.environ.get("SRT_TEST_ON_TPU") != "1":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _shapes():
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.session import count_distinct_, sum_
+
+    def df_main(s, n=256):
+        return s.create_dataframe(
+            {"a": list(range(n)), "k": [i % 8 for i in range(n)]},
+            T.StructType([T.StructField("a", T.LONG, True),
+                          T.StructField("k", T.LONG, True)]))
+
+    def q_agg(s):
+        return df_main(s).group_by("k").agg(sum_("a", "s"))
+
+    def q_sort(s):
+        return df_main(s).order_by("a", ascending=False).limit(11)
+
+    def q_join(s):
+        from spark_rapids_tpu import types as T
+
+        right = s.create_dataframe(
+            {"k": list(range(8)), "w": [10 * i for i in range(8)]},
+            T.StructType([T.StructField("k", T.LONG, True),
+                          T.StructField("w", T.LONG, True)]))
+        return df_main(s).join(right, on="k", how="inner") \
+            .group_by("w").agg(sum_("a", "s"))
+
+    def q_distinct(s):
+        return df_main(s).group_by("k").agg(count_distinct_("a", "d"))
+
+    return [q_agg, q_sort, q_join, q_distinct]
+
+
+def run_stress(n_threads: int = 8, rounds: int = 3, seed: int = 7,
+               cancel_budget: int = 4, timeout_ms: int = 0,
+               quiet: bool = False) -> dict:
+    import random
+
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu.lifecycle import (
+        QueryCancelled,
+        QueryRejected,
+        active_queries,
+        last_query_stats,
+        leak_report_all,
+    )
+    from spark_rapids_tpu.resilience import (
+        clear_faults,
+        inject_fault,
+        reset_breaker,
+    )
+    from spark_rapids_tpu.session import TpuSession
+
+    rng = random.Random(seed)
+    shapes = _shapes()
+    oracle = {}
+    for i, q in enumerate(shapes):
+        so = TpuSession({"spark.rapids.sql.enabled": False})
+        oracle[i] = sorted(q(so).collect())
+
+    clear_faults()
+    reset_breaker()
+    inject_fault("TpuHashAggregateExec", "transient", count=n_threads // 2)
+    inject_fault("TpuSortExec", "transient", count=2)
+
+    base_conf = {
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.tpu.concurrentQueries": "4",
+        "spark.rapids.tpu.admission.maxQueueDepth": "32",
+        "spark.rapids.tpu.resilience.backoffBaseMs": "0",
+        "spark.rapids.sql.concurrentGpuTasks": "2",
+    }
+    if timeout_ms > 0:
+        base_conf["spark.rapids.tpu.query.timeoutMs"] = str(timeout_ms)
+
+    outcomes, failures, waits, walls = [], [], [], []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def worker(wid: int):
+        conf = dict(base_conf)
+        if wid % 3 == 0:
+            conf["spark.rapids.sql.test.injectRetryOOM"] = "RETRY:1"
+        s = TpuSession(conf)
+        for r in range(rounds):
+            qi = (wid + r) % len(shapes)
+            try:
+                rows = sorted(shapes[qi](s).collect())
+                st = last_query_stats() or {}
+                with lock:
+                    outcomes.append("ok")
+                    waits.append(st.get("admission_wait_ns", 0))
+                    walls.append(st.get("wall_ns", 0))
+                    if rows != oracle[qi]:
+                        failures.append(
+                            f"worker {wid} round {r} shape {qi}: "
+                            f"result diverged from oracle")
+            except (QueryCancelled, QueryRejected) as e:
+                with lock:
+                    outcomes.append(type(e).__name__)
+            except Exception as e:   # noqa: BLE001 — report, don't die
+                with lock:
+                    failures.append(
+                        f"worker {wid} round {r} shape {qi}: unexpected "
+                        f"{type(e).__name__}: {e}")
+
+    def canceller():
+        n = 0
+        while n < cancel_budget and not stop.is_set():
+            qs = active_queries()
+            if qs:
+                rng.choice(qs).cancel("stress chaos")
+                n += 1
+            time.sleep(0.03)
+
+    snap = PC.snapshot()
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    tc = threading.Thread(target=canceller)
+    for t in threads:
+        t.start()
+    tc.start()
+    for t in threads:
+        t.join(300)
+    stop.set()
+    tc.join(10)
+    wall_s = time.monotonic() - t0
+    clear_faults()
+    reset_breaker()
+    leaks = leak_report_all()
+    d = PC.since(snap)
+
+    def pct(xs, p):
+        if not xs:
+            return 0.0
+        xs = sorted(xs)
+        return xs[min(int(len(xs) * p), len(xs) - 1)] / 1e6
+
+    summary = {
+        "threads": n_threads, "rounds": rounds,
+        "queries": len(outcomes),
+        "ok": outcomes.count("ok"),
+        "cancelled": sum(1 for o in outcomes if o != "ok"),
+        "failures": failures,
+        "leaks": leaks,
+        "wall_s": round(wall_s, 2),
+        "latency_ms": {"p50": round(pct(walls, 0.50), 2),
+                       "p95": round(pct(walls, 0.95), 2)},
+        "queue_wait_ms": {"p50": round(pct(waits, 0.50), 3),
+                          "p95": round(pct(waits, 0.95), 3)},
+        "counters": {k: d[k] for k in (
+            "queries_admitted", "queries_rejected", "queries_cancelled",
+            "deadline_trips", "transient_retries", "oom_restarts",
+            "runtime_fallbacks")},
+    }
+    if not quiet:
+        import json
+
+        print(json.dumps(summary, indent=2))
+    return summary
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--cancels", type=int, default=4)
+    ap.add_argument("--timeout-ms", type=int, default=0)
+    args = ap.parse_args()
+    s = run_stress(args.threads, args.rounds, args.seed, args.cancels,
+                   args.timeout_ms)
+    ok = not s["failures"] and not s["leaks"]
+    print(("PASS" if ok else "FAIL")
+          + f": {s['ok']} ok / {s['cancelled']} cancelled of "
+          f"{s['queries']} queries in {s['wall_s']}s")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
