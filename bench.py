@@ -351,6 +351,12 @@ def _time_repeats(fn, repeats, counters=False):
         "nRuntimeFallbacks": d["runtime_fallbacks"] / repeats,
         "nBreakerTrips": d["breaker_trips"] / repeats,
         "nQueryFallbacks": d["query_fallbacks"] / repeats,
+        # I/O fault domain (ISSUE 5 satellite): a bench run that only
+        # finished by skipping bad inputs or retrying files on the
+        # native decoder must say so in its own record
+        "nFilesSkippedCorrupt": d["files_skipped_corrupt"] / repeats,
+        "nFilesSkippedMissing": d["files_skipped_missing"] / repeats,
+        "nFileDecoderFallbacks": d["file_decoder_fallbacks"] / repeats,
     }
     return dt, out, per_run
 

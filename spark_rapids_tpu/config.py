@@ -266,7 +266,8 @@ RESILIENCE_BREAKER_TTL_SEC = conf(
 RESILIENCE_TEST_INJECT = conf(
     "spark.rapids.tpu.resilience.testInject").doc(
     "Chaos-injection hook: 'kind:Operator[:count[:atBatch[:seed]]]' "
-    "(kinds: compile, transient, poison; ';'-separated for multiple), "
+    "(kinds: compile, transient, poison, oom, file_corrupt, decode; "
+    "';'-separated for multiple), "
     "armed at collect() time.  The force_retry_oom test API generalized "
     "to every failure class.").internal().string_conf("NONE")
 
@@ -439,6 +440,41 @@ PARQUET_DEVICE_ENCODE = conf(
 AVRO_READ_ENABLED = conf("spark.rapids.sql.format.avro.read.enabled").doc(
     "Enable TPU Avro scans (pure-python container decode, io/avro.py)."
 ).boolean_conf(True)
+
+# --- IO fault tolerance (io/faults.py — per-file scan fault domain) --------
+
+IGNORE_CORRUPT_FILES = conf("spark.sql.files.ignoreCorruptFiles").doc(
+    "Spark conf: skip files whose bytes fail to decode (corrupt / "
+    "truncated / schema-drifted) instead of failing the query.  Each "
+    "skip bumps files_skipped_corrupt, emits an io_fault diagnostics "
+    "event, and lands in the per-query quarantine manifest "
+    "(docs/io_resilience.md).").boolean_conf(False)
+
+IGNORE_MISSING_FILES = conf("spark.sql.files.ignoreMissingFiles").doc(
+    "Spark conf: skip files that vanished between planning and read "
+    "(ENOENT) instead of failing the query; skips bump "
+    "files_skipped_missing and are quarantined like corrupt files."
+).boolean_conf(False)
+
+TPU_IGNORE_CORRUPT_FILES = conf(
+    "spark.rapids.tpu.files.ignoreCorruptFiles").doc(
+    "Tri-state alias of spark.sql.files.ignoreCorruptFiles: set "
+    "true/false to override the Spark conf for TPU scans only; unset "
+    "defers to it.").string_conf(None)
+
+TPU_IGNORE_MISSING_FILES = conf(
+    "spark.rapids.tpu.files.ignoreMissingFiles").doc(
+    "Tri-state alias of spark.sql.files.ignoreMissingFiles: set "
+    "true/false to override the Spark conf for TPU scans only; unset "
+    "defers to it.").string_conf(None)
+
+FSYNC_ON_COMMIT = conf("spark.rapids.tpu.files.fsyncOnCommit").doc(
+    "Writer durability: fsync every staged output file (and its "
+    "directory) before the atomic commit rename, so a machine crash "
+    "right after commit cannot surface zero-length files.  Off by "
+    "default — rename-atomicity alone already guarantees readers never "
+    "observe partial output; fsync adds a per-file syscall cost."
+).boolean_conf(False)
 
 # --- shuffle ---------------------------------------------------------------
 

@@ -4488,22 +4488,35 @@ def _cpu_file_scan(plan: PN.FileSourceScan):
 
     import os
 
-    tables = []
-    for p in plan.paths:
+    from spark_rapids_tpu.config import get_conf
+    from spark_rapids_tpu.io import faults as IOF
+
+    # the oracle honors the SAME per-file tolerance confs as the TPU
+    # scan (differential runs must read the same surviving file set);
+    # skips here bump no counters and write no quarantine — only the
+    # device scan's accounting is the product surface
+    conf = get_conf()
+    tol = IOF.scan_tolerance(conf)
+
+    def read_one(p):
         if os.path.isdir(p):
             import pyarrow.dataset as ds
 
-            tables.append(ds.dataset(
+            return ds.dataset(
                 p, format=plan.fmt, partitioning="hive",
                 exclude_invalid_files=True).to_table(
-                columns=[f.name for f in plan.output.fields]))
-        elif plan.fmt == "parquet":
-            tables.append(pq.read_table(p))
-        elif plan.fmt == "orc":
+                columns=[f.name for f in plan.output.fields])
+        if plan.fmt == "parquet":
+            from spark_rapids_tpu.io.scan import read_parquet_file
+
+            return read_parquet_file(
+                p, [f.name for f in plan.output.fields])
+        if plan.fmt == "orc":
             import pyarrow.orc as paorc
 
-            tables.append(paorc.ORCFile(p).read())
-        elif plan.fmt in ("csv", "json"):
+            return paorc.ORCFile(p).read(
+                columns=[f.name for f in plan.output.fields])
+        if plan.fmt in ("csv", "json"):
             import pyarrow as pa
 
             from spark_rapids_tpu.io.text import (read_csv_spark,
@@ -4511,22 +4524,34 @@ def _cpu_file_scan(plan: PN.FileSourceScan):
 
             rd = read_csv_spark if plan.fmt == "csv" else read_json_spark
             tcols, _ = rd(p, plan.output, plan.options)
-            tables.append(pa.table(
+            return pa.table(
                 {f.name: c.to_arrow()
-                 for f, c in zip(plan.output.fields, tcols)}))
-        elif plan.fmt == "avro":
+                 for f, c in zip(plan.output.fields, tcols)})
+        if plan.fmt == "avro":
             import pyarrow as pa
 
             from spark_rapids_tpu.io.avro import read_avro_columns
 
             acols, astruct = read_avro_columns(p, plan.output)
-            tables.append(pa.table(
+            return pa.table(
                 {f.name: c.to_arrow()
-                 for f, c in zip(astruct.fields, acols)}))
-        else:
-            raise NotImplementedError(plan.fmt)
+                 for f, c in zip(astruct.fields, acols)})
+        raise NotImplementedError(plan.fmt)
+
+    tables = []
+    for p in plan.paths:
+        try:
+            with IOF.file_context(p, plan.fmt, "cpu-oracle"):
+                tables.append(read_one(p))
+        except Exception as e:
+            IOF.handle_scan_error(e, p, plan.fmt, "cpu-oracle", tol,
+                                  conf, count_skips=False)
     import pyarrow as pa
 
+    if not tables:
+        cols = [CpuCol.from_host(HostColumn.from_pylist([], f.dataType))
+                for f in plan.output.fields]
+        return cols, 0
     tbl = pa.concat_tables(tables)
     cols = []
     for f in plan.output.fields:

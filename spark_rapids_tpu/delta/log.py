@@ -275,6 +275,11 @@ class DeltaLog:
     def _read_checkpoint(self, version: int):
         import pyarrow.parquet as pq
 
-        tbl = pq.read_table(self._checkpoint_file(version))
+        from spark_rapids_tpu.io.faults import file_context
+
+        # log metadata: never tolerated away, attributed only (ISSUE 5)
+        path = self._checkpoint_file(version)
+        with file_context(path, "parquet", "delta-checkpoint"):
+            tbl = pq.read_table(path)
         for j in tbl.column("json").to_pylist():
             yield json.loads(j)

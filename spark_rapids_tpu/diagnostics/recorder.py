@@ -48,6 +48,7 @@ EVENT_SCHEMA: Dict[str, List[str]] = {
     "cache": ["hit", "label"],
     "resilience": ["kind", "op_name", "detail"],
     "lifecycle": ["kind", "detail", "dur_ns"],
+    "io_fault": ["kind", "path", "fmt", "detail"],
     "op_batch": ["path", "batch", "rows", "dur_ns"],
     "operator": ["path", "name", "describe", "wall_ns", "self_wall_ns",
                  "batches", "rows", "counters", "metrics", "fallback"],
@@ -312,6 +313,13 @@ class QueryDiagnostics:
         runtime_fallback, breaker_trip, or query_fallback."""
         self._event(ESSENTIAL, "resilience", kind=kind, op_name=op_name,
                     detail=str(detail)[:500])
+
+    def io_fault(self, kind: str, path: str, fmt: str = "",
+                 detail: str = "") -> None:
+        """A per-file scan fault tolerated away (ISSUE 5): kind is the
+        quarantine class (corrupt, truncated, missing, schema_mismatch)."""
+        self._event(ESSENTIAL, "io_fault", kind=kind, path=path,
+                    fmt=fmt or "", detail=str(detail)[:500])
 
     def lifecycle(self, kind: str, detail: str = "",
                   dur_ns: int = 0) -> None:

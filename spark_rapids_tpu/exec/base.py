@@ -236,6 +236,10 @@ class TpuExec:
         "retryCount": TpuMetric.ESSENTIAL,
         "runtimeFallbacks": TpuMetric.ESSENTIAL,
         "breakerTrips": TpuMetric.ESSENTIAL,
+        # I/O fault domain (ISSUE 5): skipped files and per-file device
+        # ->native decoder retries are resilience events too
+        "filesSkipped": TpuMetric.ESSENTIAL,
+        "fileDecoderFallbacks": TpuMetric.ESSENTIAL,
     }
 
     def metric(self, name: str) -> TpuMetric:

@@ -204,12 +204,26 @@ def _encode_value(buf: bytearray, schema, v):
 # ---------------------------------------------------------------------------
 
 def read_avro_file(path: str) -> Tuple[dict, List[dict]]:
-    """-> (parsed schema json, records as dicts)."""
+    """-> (parsed schema json, records as dicts).  Decode errors carry
+    the byte offset reached (io/faults.py quarantine context)."""
     with open(path, "rb") as f:
         data = f.read()
     if data[:4] != MAGIC:
         raise ValueError(f"{path}: not an Avro object container file")
     r = _Reader(data)
+    try:
+        return _read_avro_blocks(r, data, path)
+    except (IndexError, struct.error, zlib.error, KeyError,
+            json.JSONDecodeError) as e:
+        err = ValueError(
+            f"{path}: corrupt avro container near byte {r.pos} "
+            f"({type(e).__name__}: {e})")
+        err.srt_offset = r.pos
+        raise err from e
+
+
+def _read_avro_blocks(r: "_Reader", data: bytes,
+                      path: str) -> Tuple[dict, List[dict]]:
     r.pos = 4
     meta: Dict[str, bytes] = {}
     while True:

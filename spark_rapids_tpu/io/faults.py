@@ -1,0 +1,446 @@
+"""I/O fault domain — per-FILE scan-error classification and tolerance.
+
+Reference analog (SURVEY.md §2.6): GpuMultiFileReader inherits Spark's
+``spark.sql.files.ignoreCorruptFiles`` / ``ignoreMissingFiles`` semantics —
+one truncated footer in a million-file data lake skips ONE file, not the
+query.  PR 1's stage fault domain is too coarse for that: a deterministic
+scan failure would CPU-fallback (and re-fail: the oracle reads the same
+bytes) or kill the stage.  This module is the finer-grained layer every
+reader routes escaping errors through:
+
+  * classification — :func:`to_scan_fault` walks the exception chain
+    (``resilience/classify.exception_chain``) and maps file-attributable
+    decode errors to :class:`CorruptFile` / :class:`TruncatedFile` /
+    :class:`MissingFile` / :class:`SchemaMismatch`, each carrying the
+    path, format, reader mode, and (when a parser recorded one) the byte
+    offset.  Non-file faults (ANSI errors, cancellation, OOM, transient
+    infrastructure) are never classified — they keep their PR 1 semantics.
+  * tolerance — :func:`scan_tolerance` reads the Spark confs (and their
+    ``spark.rapids.tpu.files.*`` tri-state aliases, which win when set);
+    :func:`is_tolerated` decides skip vs fail-fast per fault class.
+  * accounting — :func:`record_skip` bumps ``files_skipped_corrupt`` /
+    ``files_skipped_missing``, emits an ``io_fault`` diagnostics event,
+    and appends the file to the per-query quarantine manifest
+    (``quarantine-<query_id>.json`` next to the event log).
+  * attribution — :func:`annotate_scan_error` / :func:`file_context` tag
+    any OTHER error escaping a reader with ``file=<path>`` and the reader
+    mode via ``__notes__``, so chaos/stress logs are attributable even
+    for faults the classifier refuses to own.
+"""
+from __future__ import annotations
+
+import errno as _errno
+import json
+import os
+import struct
+import threading
+import zlib
+from typing import Dict, List, Optional, Type
+
+from spark_rapids_tpu.resilience import classify as CL
+
+
+# ---------------------------------------------------------------------------
+# fault classes
+# ---------------------------------------------------------------------------
+
+class ScanFault(Exception):
+    """A file-attributable scan failure.  ``kind`` is the quarantine /
+    counter class; classified DETERMINISTIC by resilience/classify (the
+    fallthrough default), so an untolerated fault escalates to the stage
+    fault domain with full file context in its message."""
+
+    kind = "corrupt"
+
+    def __init__(self, path: str, detail: str = "", fmt: str = "",
+                 reader_mode: str = "", offset: Optional[int] = None):
+        self.path = path
+        self.detail = detail
+        self.fmt = fmt
+        self.reader_mode = reader_mode
+        self.offset = offset
+        at = f" near byte {offset}" if offset is not None else ""
+        mode = f" reader={reader_mode}" if reader_mode else ""
+        super().__init__(
+            f"{self.kind} file={path}{at} fmt={fmt or '?'}{mode}"
+            + (f": {detail}" if detail else ""))
+
+
+class CorruptFile(ScanFault):
+    kind = "corrupt"
+
+
+class TruncatedFile(CorruptFile):
+    """Corrupt subclass: the file ends early (footer/postscript/sync
+    marker missing).  Tolerated by the same conf as CorruptFile; the
+    distinction survives into the quarantine manifest."""
+
+    kind = "truncated"
+
+
+class MissingFile(ScanFault):
+    kind = "missing"
+
+
+class SchemaMismatch(CorruptFile):
+    """The file's physical schema drifted from the scan schema (renamed /
+    missing column).  Treated as a corrupt-class fault: Spark's
+    FileScanRDD likewise skips any per-file read error under
+    ignoreCorruptFiles."""
+
+    kind = "schema_mismatch"
+
+
+# ---------------------------------------------------------------------------
+# tolerance confs
+# ---------------------------------------------------------------------------
+
+class ScanTolerance:
+    __slots__ = ("ignore_corrupt", "ignore_missing")
+
+    def __init__(self, ignore_corrupt: bool, ignore_missing: bool):
+        self.ignore_corrupt = bool(ignore_corrupt)
+        self.ignore_missing = bool(ignore_missing)
+
+
+def _tri_state(conf, alias_entry, base_entry) -> bool:
+    """spark.rapids.tpu.files.* alias wins when set; unset defers to the
+    Spark conf."""
+    raw = conf.get(alias_entry)
+    if raw is None or str(raw).strip() == "":
+        return bool(conf.get(base_entry))
+    return str(raw).strip().lower() in ("true", "1", "yes")
+
+
+def scan_tolerance(conf) -> ScanTolerance:
+    from spark_rapids_tpu.config import (
+        IGNORE_CORRUPT_FILES,
+        IGNORE_MISSING_FILES,
+        TPU_IGNORE_CORRUPT_FILES,
+        TPU_IGNORE_MISSING_FILES,
+    )
+
+    return ScanTolerance(
+        _tri_state(conf, TPU_IGNORE_CORRUPT_FILES, IGNORE_CORRUPT_FILES),
+        _tri_state(conf, TPU_IGNORE_MISSING_FILES, IGNORE_MISSING_FILES))
+
+
+def is_tolerated(fault: ScanFault, tol: ScanTolerance) -> bool:
+    if isinstance(fault, MissingFile):
+        return tol.ignore_missing
+    return tol.ignore_corrupt
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+# pyarrow / parser messages that mean "this file's schema drifted"
+_SCHEMA_MARKERS = (
+    "No match for FieldRef",
+    "Invalid column selected",
+    "Schema at index",
+    "schema mismatch",
+    "field not found",
+)
+
+# messages that mean "the file ends early" (vs generic corruption)
+_TRUNCATION_MARKERS = (
+    "truncat", "postscript", "Unexpected end", "unexpected end",
+    "ends early", "sync marker mismatch", "footer missing",
+)
+
+# messages that mean "the bytes are not a valid file of this format"
+_CORRUPT_MARKERS = (
+    "Is this a 'parquet' file",
+    "Could not open Parquet input source",
+    "Parquet magic bytes not found",
+    "not a parquet file",
+    "not an ORC file",
+    "not an Avro object container",
+    "corrupt", "Corrupt",
+    "StripeFooter",
+    "Couldn't deserialize thrift",
+    "CRC",
+    "invalid checksum",
+    "decompress",
+    "snappy",
+)
+
+# python-level parse wreckage inside a file-decode region: these types are
+# only mapped when no marker matched AND the error came from a decode
+# context (the wrap sites only cover the per-file read region)
+_DECODE_ERROR_TYPES = (struct.error, UnicodeDecodeError, EOFError,
+                       IndexError, zlib.error)
+
+# chaos-injected corruption (resilience/faults.py file_corrupt kind),
+# matched by name to keep this module import-light
+_INJECTED_CORRUPT_NAMES = ("InjectedFileCorruption",)
+
+
+def _looks_truncated(path: str, fmt: str) -> bool:
+    """Cheap tail sniff: a parquet file whose last 4 bytes are not PAR1
+    (or any file the message-markers flagged) was cut short."""
+    try:
+        if fmt == "parquet" and os.path.isfile(path):
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                if size < 8:
+                    return True
+                f.seek(-4, os.SEEK_END)
+                return f.read(4) != b"PAR1"
+    except OSError:
+        pass
+    return False
+
+
+def to_scan_fault(exc: BaseException, path: str, fmt: str = "",
+                  reader_mode: str = "") -> Optional[ScanFault]:
+    """Map ``exc`` (raised while reading ``path``) to a typed scan fault,
+    or None when the error is not file-attributable (semantic errors,
+    cancellation, OOM, transient infrastructure, plain bugs) — those keep
+    their resilience class and are only annotated with file context."""
+    if CL.classify_failure(exc) != CL.DETERMINISTIC:
+        return None
+    offset = None
+    for link in CL.exception_chain(exc):
+        if isinstance(link, ScanFault):
+            return link
+        if offset is None:
+            offset = getattr(link, "srt_offset", None)
+
+    def build(cls: Type[ScanFault], detail_exc: BaseException) -> ScanFault:
+        detail = f"{type(detail_exc).__name__}: {detail_exc}"
+        return cls(path, detail[:300], fmt, reader_mode, offset)
+
+    for link in CL.exception_chain(exc):
+        msg = str(link)
+        if isinstance(link, FileNotFoundError) \
+                or (isinstance(link, OSError)
+                    and link.errno == _errno.ENOENT) \
+                or (isinstance(link, OSError)
+                    and "No such file or directory" in msg):
+            return build(MissingFile, link)
+        if type(link).__name__ in _INJECTED_CORRUPT_NAMES:
+            return build(CorruptFile, link)
+        # message-marker sniffing is restricted to PARSER-origin types
+        # (ValueError covers pyarrow ArrowInvalid and the native
+        # parsers; OSError covers pyarrow IO errors): an engine error —
+        # e.g. Spark's FAILFAST RuntimeError, which interpolates the raw
+        # ROW into its message — must never classify from user data
+        # that happens to contain 'corrupt'/'CRC'/...
+        if isinstance(link, (ValueError, OSError)):
+            if any(m in msg for m in _SCHEMA_MARKERS):
+                return build(SchemaMismatch, link)
+            if any(m in msg for m in _TRUNCATION_MARKERS):
+                return build(TruncatedFile, link)
+            if any(m in msg for m in _CORRUPT_MARKERS):
+                return build(TruncatedFile if _looks_truncated(path, fmt)
+                             else CorruptFile, link)
+        if isinstance(link, _DECODE_ERROR_TYPES):
+            return build(TruncatedFile if _looks_truncated(path, fmt)
+                         else CorruptFile, link)
+        if isinstance(link, OSError) and link.errno is None:
+            # pyarrow surfaces decode failures as bare OSError with no
+            # errno ("Unknown compression type", "bad StripeFooter", …);
+            # real OS-level errors always carry one
+            return build(TruncatedFile if _looks_truncated(path, fmt)
+                         else CorruptFile, link)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# attribution (__notes__ on python 3.10: set the list by hand)
+# ---------------------------------------------------------------------------
+
+def annotate_scan_error(exc: BaseException, path: str,
+                        reader_mode: str = "") -> BaseException:
+    """Attach ``file=<path> reader=<mode>`` to an escaping error so
+    chaos/stress logs can attribute it — idempotent per path."""
+    note = f"file={path}" + (f" reader={reader_mode}" if reader_mode else "")
+    notes = getattr(exc, "__notes__", None)
+    if notes is None:
+        try:
+            exc.__notes__ = [note]
+        except Exception:
+            pass
+    elif not any(f"file={path}" in n for n in notes):
+        notes.append(note)
+    if getattr(exc, "srt_file", None) is None:
+        try:
+            exc.srt_file = path
+        except Exception:
+            pass
+    return exc
+
+
+class file_context:
+    """``with file_context(path, fmt, reader):`` — annotate anything that
+    escapes the per-file decode region with the file path + reader mode.
+    Typed ScanFaults pass through untouched (they already carry it)."""
+
+    def __init__(self, path: str, fmt: str = "", reader_mode: str = ""):
+        self.path = path
+        self.fmt = fmt
+        self.reader_mode = reader_mode
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is None or isinstance(exc, ScanFault) \
+                or isinstance(exc, (GeneratorExit, KeyboardInterrupt,
+                                    SystemExit)):
+            return False
+        annotate_scan_error(exc, self.path, self.reader_mode)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# skip accounting: counters, io_fault event, quarantine manifest
+# ---------------------------------------------------------------------------
+
+_Q_LOCK = threading.Lock()
+# query_id -> [entry]; bounded per query (a pathological million-file
+# skip storm cannot hold GBs of dicts) AND across queries (a long-lived
+# serving process evicts the oldest query's records once
+# _MAX_QUARANTINE_QUERIES are retained)
+_QUARANTINE: Dict[str, List[dict]] = {}
+_DONE_QIDS: set = set()          # flushed (query ended) — evict these first
+_MAX_QUARANTINE_ENTRIES = 10000
+_MAX_QUARANTINE_QUERIES = 64
+_LAST_QID: List[Optional[str]] = [None]
+
+
+def quarantine_entries(query_id: Optional[str] = None) -> List[dict]:
+    """The quarantine records of one query — default: the current query,
+    or (outside one) the most recent query that quarantined anything."""
+    with _Q_LOCK:
+        if query_id is None:
+            from spark_rapids_tpu.lifecycle.context import current
+
+            ctx = current()
+            query_id = ctx.query_id if ctx is not None else _LAST_QID[0]
+        return list(_QUARANTINE.get(query_id, []))
+
+
+def reset_quarantine() -> None:
+    with _Q_LOCK:
+        _QUARANTINE.clear()
+        _DONE_QIDS.clear()
+        _LAST_QID[0] = None
+
+
+def _flush_manifest(conf, qid: str, entries: List[dict]) -> Optional[str]:
+    """Atomic rewrite of the per-query quarantine manifest next to the
+    diagnostics event log.  No eventLogDir conf -> in-memory only."""
+    from spark_rapids_tpu.config import DIAGNOSTICS_EVENT_LOG_DIR
+
+    log_dir = conf.get(DIAGNOSTICS_EVENT_LOG_DIR)
+    if not log_dir:
+        return None
+    os.makedirs(log_dir, exist_ok=True)
+    path = os.path.join(log_dir, f"quarantine-{qid}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"query_id": qid, "files": entries}, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def flush_quarantine(conf, qid: str) -> None:
+    """Write ``qid``'s manifest now (idempotent; OSError-silent — the
+    manifest is diagnostics, never query-fatal).  Marks the query done:
+    its retained records become first in line for cross-query
+    eviction."""
+    with _Q_LOCK:
+        snapshot = list(_QUARANTINE.get(qid, []))
+        _DONE_QIDS.add(qid)
+    if not snapshot:
+        return
+    try:
+        _flush_manifest(conf, qid, snapshot)
+    except OSError:
+        pass
+
+
+def record_skip(fault: ScanFault, conf) -> None:
+    """One tolerated-away file: counter + io_fault event + quarantine.
+
+    The manifest flushes ONCE per query — on the first skip a lifecycle
+    cleanup hook is registered, so a 10k-file skip storm costs one
+    write, and a query killed mid-scan still flushes on unwind.
+    Outside a query context (eager MOR assembly) each skip flushes
+    immediately."""
+    import time
+
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu.diagnostics import context as DIAG_CTX
+    from spark_rapids_tpu.lifecycle.context import current
+
+    PC.bump("files_skipped_missing" if isinstance(fault, MissingFile)
+            else "files_skipped_corrupt")
+    rec = DIAG_CTX.RECORDER
+    if rec is not None:
+        rec.io_fault(fault.kind, fault.path, fault.fmt,
+                     detail=fault.detail)
+    ctx = current()
+    qid = ctx.query_id if ctx is not None else "-"
+    entry = {
+        "path": fault.path,
+        "class": fault.kind,
+        "offset": fault.offset,
+        "fmt": fault.fmt,
+        "reader": fault.reader_mode,
+        "error": fault.detail,
+        "ts": time.time(),
+    }
+    with _Q_LOCK:
+        _LAST_QID[0] = qid
+        lst = _QUARANTINE.get(qid)
+        if lst is None:
+            # bound retained queries: evict FLUSHED (ended) queries
+            # first so a still-running query's records survive to its
+            # end-of-query flush; only if every retained query is still
+            # live does the hard bound evict the oldest regardless
+            while len(_QUARANTINE) >= _MAX_QUARANTINE_QUERIES:
+                victim = next((k for k in _QUARANTINE
+                               if k in _DONE_QIDS),
+                              next(iter(_QUARANTINE)))
+                _QUARANTINE.pop(victim)
+                _DONE_QIDS.discard(victim)
+            lst = _QUARANTINE[qid] = []
+        if len(lst) >= _MAX_QUARANTINE_ENTRIES:
+            return
+        lst.append(entry)
+        first = len(lst) == 1
+    if ctx is not None:
+        if first:
+            ctx.add_cleanup(lambda: flush_quarantine(conf, qid))
+    else:
+        flush_quarantine(conf, qid)
+
+
+# ---------------------------------------------------------------------------
+# the per-file error handler every reader mode routes through
+# ---------------------------------------------------------------------------
+
+def handle_scan_error(exc: BaseException, path: str, fmt: str,
+                      reader_mode: str, tol: ScanTolerance, conf,
+                      count_skips: bool = True) -> bool:
+    """Classify + resolve one per-file error.  Returns True when the
+    file was tolerated away (caller skips it and re-drives the surviving
+    set); raises the typed fault (untolerated, file-attributed) or the
+    annotated original (unclassifiable) otherwise."""
+    fault = to_scan_fault(exc, path, fmt, reader_mode)
+    if fault is not None:
+        if is_tolerated(fault, tol):
+            if count_skips:
+                record_skip(fault, conf)
+            return True
+        if fault is exc:
+            raise fault
+        raise fault from exc
+    annotate_scan_error(exc, path, reader_mode)
+    raise exc

@@ -116,14 +116,21 @@ def iceberg_data_files(table_path: str,
         else meta.get("current-snapshot-id")
     snap = next((s for s in snaps if s.get("snapshot-id") == sid),
                 snaps[-1])
+    from spark_rapids_tpu.io.faults import file_context
+
     mlist = _resolve(table_path, snap["manifest-list"])
-    _, entries = read_avro_file(mlist)
+    with file_context(mlist, "avro", "iceberg-manifest-list"):
+        _, entries = read_avro_file(mlist)
     paths: List[str] = []
     pos_deletes: List[str] = []
     eq_deletes: List[Tuple[str, List[str]]] = []
     for entry in entries:
         mpath = _resolve(table_path, entry["manifest_path"])
-        _, files = read_avro_file(mpath)
+        # metadata corruption is never tolerated away (skipping a
+        # manifest silently drops an unknowable file set) — it only
+        # gains file attribution here
+        with file_context(mpath, "avro", "iceberg-manifest"):
+            _, files = read_avro_file(mpath)
         for fe in files:
             status = fe.get("status", 1)
             if status == 2:  # DELETED
@@ -167,9 +174,14 @@ def _apply_position_deletes(session, paths, pos_delete_paths, schema):
 
     from spark_rapids_tpu.io.mor import read_parquet_minus_rows
 
+    from spark_rapids_tpu.io.faults import file_context
+
     dropped = {}
     for dp in pos_delete_paths:
-        t = pq.read_table(dp)
+        # delete files are MOR metadata: never tolerated away (skipping
+        # one would resurrect deleted rows) — attributed only
+        with file_context(dp, "parquet", "iceberg-position-deletes"):
+            t = pq.read_table(dp)
         for fp, pos in zip(t.column("file_path").to_pylist(),
                            t.column("pos").to_pylist()):
             dropped.setdefault(_norm_path(fp), set()).add(int(pos))
@@ -197,7 +209,10 @@ def read_iceberg(session, table_path: str,
     for dp, names in eq_del:
         import pyarrow.parquet as pq
 
-        t = pq.read_table(dp, columns=names)
+        from spark_rapids_tpu.io.faults import file_context
+
+        with file_context(dp, "parquet", "iceberg-equality-deletes"):
+            t = pq.read_table(dp, columns=names)
         dschema = T.StructType(
             [f for f in schema.fields if f.name in names])
         data = {f.name: t.column(f.name).to_pylist()

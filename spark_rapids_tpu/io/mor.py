@@ -3,7 +3,13 @@
 Shared by the Iceberg position-delete reader and the Delta deletion-vector
 reader (the reference applies these inside its GPU parquet readers; here
 per-file row positions do not survive the concatenating scan, so the take
-happens while building the batch)."""
+happens while building the batch).
+
+I/O fault domain (ISSUE 5): each data file reads under the same per-file
+classify/tolerate path as the plain scan — a corrupt or vanished data
+file listed by a stale manifest/log skips (with counters + quarantine)
+when the ignoreCorruptFiles/ignoreMissingFiles confs allow, and otherwise
+raises a file-attributed fault instead of an anonymous pyarrow error."""
 from __future__ import annotations
 
 from typing import Iterable, Optional, Sequence, Tuple
@@ -13,22 +19,35 @@ def read_parquet_minus_rows(session, files, schema):
     """files: [(path, deleted_row_indices_or_None)] -> DataFrame."""
     import numpy as np
     import pyarrow as pa
-    import pyarrow.parquet as pq
 
     from spark_rapids_tpu.columnar.column import HostColumn
+    from spark_rapids_tpu.io import faults as IOF
+    from spark_rapids_tpu.io.scan import read_parquet_file
     from spark_rapids_tpu.plan.nodes import LocalTableScan
     from spark_rapids_tpu.session import DataFrame
 
+    conf = session.conf
+    tol = IOF.scan_tolerance(conf)
     names = [f.name for f in schema.fields]
     tables = []
     for path, gone in files:
-        t = pq.read_table(path, columns=names)
+        try:
+            with IOF.file_context(path, "parquet", "MOR"):
+                t = read_parquet_file(path, names)
+        except Exception as e:
+            IOF.handle_scan_error(e, path, "parquet", "MOR", tol, conf)
+            continue
         if gone:
             keep = np.setdiff1d(np.arange(t.num_rows),
                                 np.asarray(sorted(gone), dtype=np.int64))
             t = t.take(pa.array(keep))
         tables.append(t)
-    tbl = pa.concat_tables(tables)
-    cols = [HostColumn.from_arrow(tbl.column(f.name), f.dataType)
-            for f in schema.fields]
+    if tables:
+        tbl = pa.concat_tables(tables)
+        cols = [HostColumn.from_arrow(tbl.column(f.name), f.dataType)
+                for f in schema.fields]
+    else:
+        # every file tolerated away: an empty table of the right schema
+        cols = [HostColumn.from_pylist([], f.dataType)
+                for f in schema.fields]
     return DataFrame(LocalTableScan(cols, schema), session)

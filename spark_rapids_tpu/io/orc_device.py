@@ -52,6 +52,15 @@ _OK = {
 
 def read_orc_device(path: str, schema: T.StructType,
                     row_buckets=DEFAULT_ROW_BUCKETS) -> ColumnarBatch:
+    """Escaping errors carry ``file=<path>`` context (io/faults.py)."""
+    from spark_rapids_tpu.io.faults import file_context
+
+    with file_context(path, "orc", "device"):
+        return _read_orc_device(path, schema, row_buckets)
+
+
+def _read_orc_device(path: str, schema: T.StructType,
+                     row_buckets=DEFAULT_ROW_BUCKETS) -> ColumnarBatch:
     with open(path, "rb") as f:
         data = f.read()
     cols_meta, stripes, compression, total = read_orc_meta(data)

@@ -161,6 +161,22 @@ def read_orc_meta(data: bytes):
     """-> (columns, stripes, compression, num_rows)."""
     if not data.startswith(MAGIC):
         raise _Unsupported("not an ORC file")
+    if len(data) < 4:
+        err = ValueError("ORC file truncated (no postscript)")
+        err.srt_offset = len(data)
+        raise err
+    try:
+        return _read_orc_meta(data)
+    except (IndexError, struct.error) as e:
+        # byte-offset context for the fault classifier / quarantine
+        err = ValueError(
+            f"corrupt ORC postscript/footer near byte {len(data) - 1} "
+            f"({type(e).__name__}: {e})")
+        err.srt_offset = len(data) - 1
+        raise err from e
+
+
+def _read_orc_meta(data: bytes):
     ps_len = data[-1]
     ps = _pb_fields(data[-1 - ps_len:-1])
     footer_len = _one(ps, 1, 0)

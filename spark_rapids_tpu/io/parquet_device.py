@@ -166,7 +166,17 @@ def _decode_page(page, info, dt: T.DataType, dictionary):
 
 def read_parquet_device(path: str, schema: T.StructType,
                         row_buckets=DEFAULT_ROW_BUCKETS) -> ColumnarBatch:
-    """One file -> one padded device batch via the Pallas decode path."""
+    """One file -> one padded device batch via the Pallas decode path.
+    Escaping errors carry ``file=<path>`` context (io/faults.py) so a
+    decoder failure in a multi-file scan is attributable."""
+    from spark_rapids_tpu.io.faults import file_context
+
+    with file_context(path, "parquet", "device"):
+        return _read_parquet_device(path, schema, row_buckets)
+
+
+def _read_parquet_device(path: str, schema: T.StructType,
+                         row_buckets=DEFAULT_ROW_BUCKETS) -> ColumnarBatch:
     with open(path, "rb") as f:
         data = f.read()
     groups, names = read_footer(data)

@@ -170,9 +170,27 @@ class Run:
 
 
 def read_footer(data: bytes) -> Tuple[List[RowGroupInfo], List[str]]:
-    if data[:4] != MAGIC or data[-4:] != MAGIC:
+    if len(data) < 12 or data[:4] != MAGIC or data[-4:] != MAGIC:
         raise ValueError("not a parquet file")
     flen = struct.unpack_from("<I", data, len(data) - 8)[0]
+    if flen > len(data) - 12:
+        err = ValueError(
+            f"parquet footer truncated (footer length {flen} exceeds "
+            f"file size {len(data)})")
+        err.srt_offset = len(data) - 8
+        raise err
+    try:
+        return _read_footer_meta(data, flen)
+    except (IndexError, struct.error, KeyError, TypeError) as e:
+        # byte-offset context for the fault classifier / quarantine
+        err = ValueError(
+            f"corrupt parquet footer metadata near byte "
+            f"{len(data) - 8 - flen} ({type(e).__name__}: {e})")
+        err.srt_offset = len(data) - 8 - flen
+        raise err from e
+
+
+def _read_footer_meta(data: bytes, flen: int):
     meta = _Thrift(data, len(data) - 8 - flen).read_struct()
     schema = meta[2]
     # schema[0] is the root; leaves follow in order (non-nested only)
@@ -304,8 +322,17 @@ def read_column_pages(data: bytes, info: ColumnInfo,
     pages: List[PageData] = []
     values_seen = 0
     while pos < end and values_seen < info.num_values:
-        t = _Thrift(data, pos)
-        header = t.read_struct()
+        try:
+            t = _Thrift(data, pos)
+            header = t.read_struct()
+        except (IndexError, struct.error) as e:
+            # byte-offset context for the fault classifier / quarantine
+            err = ValueError(
+                f"corrupt parquet page header for column "
+                f"{info.name!r} near byte {pos} "
+                f"({type(e).__name__}: {e})")
+            err.srt_offset = pos
+            raise err from e
         pos = t.pos
         ptype = header[1]
         usize = header[2]

@@ -19,6 +19,18 @@ Reader mode selection matches the reference:
   * MULTITHREADED — a host thread pool fetches/decodes files ahead while
     the device consumes (cloud-storage latency hiding).
   * AUTO          — MULTITHREADED for >1 file else COALESCING.
+
+I/O fault domain (ISSUE 5, io/faults.py): every per-file read routes its
+escaping errors through per-FILE classification — corrupt / truncated /
+missing / schema-drifted files are skipped (with counters, an io_fault
+event, and a quarantine-manifest entry) when the
+``spark.sql.files.ignoreCorruptFiles`` / ``ignoreMissingFiles`` confs (or
+their ``spark.rapids.tpu.files.*`` aliases) say so, and the COALESCING /
+MULTITHREADED modes re-drive the surviving file set instead of aborting
+the batch stitch.  A DEVICE-decode failure on one file retries that file
+only on the native (host) decoder (``file_decoder_fallbacks``), and a
+systematically-failing device decoder trips a per-format circuit-breaker
+entry that routes the whole scan to the native decoder at plan time.
 """
 from __future__ import annotations
 
@@ -37,7 +49,9 @@ from spark_rapids_tpu.config import (
     TpuConf,
 )
 from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.io import faults as IOF
 from spark_rapids_tpu.plan.nodes import FileSourceScan
+from spark_rapids_tpu.resilience import faults as chaos
 
 
 def _filters_to_arrow(pushed) -> Optional[list]:
@@ -63,6 +77,39 @@ def _filters_to_arrow(pushed) -> Optional[list]:
         except Exception:
             continue
     return out or None
+
+
+def read_parquet_file(path: str, columns, filters=None):
+    """Single-FILE parquet read (shared with the CPU oracle and the MOR
+    reader).  Without pushdown filters it bypasses pyarrow's dataset
+    layer: dataset discovery infers hive partitioning from ``k=1/`` path
+    segments and then fails to merge a partition column that ALSO exists
+    in the file (the iceberg/delta identity-partition layout).  Missing
+    columns raise a typed SchemaMismatch (ParquetFile.read would silently
+    drop them)."""
+    import pyarrow.parquet as pq
+
+    if filters is not None:
+        # filters need the dataset reader; partitioning=None keeps the
+        # hive inference off for this single-file path too
+        return pq.read_table(path, columns=columns, filters=filters,
+                             partitioning=None)
+    pf = pq.ParquetFile(path)
+    have = set(pf.schema_arrow.names)
+    missing = [c for c in (columns or []) if c not in have]
+    if missing:
+        raise IOF.SchemaMismatch(
+            path, f"columns {missing} not in file schema "
+                  f"{sorted(have)[:8]}", "parquet")
+    return pf.read(columns=columns)
+
+
+def _decode_breaker_key(fmt: str):
+    """Per-FORMAT breaker key for the device decoder: a decoder that
+    fails file after file (a systematic kernel/parser bug, not one bad
+    file) should stop being tried at all — plan-time consult routes the
+    format to the native decoder until the TTL re-probe."""
+    return ("TpuFileSourceScanExec.deviceDecode", fmt)
 
 
 class TpuFileSourceScanExec(TpuExec):
@@ -92,43 +139,116 @@ class TpuFileSourceScanExec(TpuExec):
         return "MULTITHREADED" if len(self.plan.paths) > 1 else "COALESCING"
 
     # -- device decode (Pallas) -----------------------------------------
-    def _try_device_decode(self, path: str):
-        """Pallas decode path; None -> fall back to the host decode."""
-        import os
-
+    def _device_decode_conf_on(self) -> bool:
         from spark_rapids_tpu.config import ORC_DEVICE_DECODE
 
-        if os.path.isdir(path):
-            return None
         if self.plan.fmt == "parquet":
-            if not self.conf.get(PARQUET_DEVICE_DECODE):
-                return None
-        elif self.plan.fmt == "orc":
-            if not self.conf.get(ORC_DEVICE_DECODE):
-                return None
-        else:
-            return None
+            return bool(self.conf.get(PARQUET_DEVICE_DECODE))
+        if self.plan.fmt == "orc":
+            return bool(self.conf.get(ORC_DEVICE_DECODE))
+        return False
+
+    def _decode_breaker_open(self) -> bool:
+        """True when the per-format decode breaker holds this scan on the
+        native decoder (the plan-time trip of a systematically-failing
+        device decoder)."""
+        from spark_rapids_tpu.config import RESILIENCE_BREAKER_TTL_SEC
+        from spark_rapids_tpu.resilience.breaker import get_breaker
+
+        breaker = get_breaker()
+        if not breaker.has_entries():
+            return False
+        why = breaker.consult(
+            _decode_breaker_key(self.plan.fmt),
+            float(self.conf.get(RESILIENCE_BREAKER_TTL_SEC)))
+        if why is not None:
+            self._log_decode_fallback("(all files)",
+                                      f"decode breaker: {why}")
+            return True
+        return False
+
+    def _log_decode_fallback(self, path: str, why: str) -> None:
         from spark_rapids_tpu.config import DECODE_LOG_FALLBACK
+
+        if self.conf.get(DECODE_LOG_FALLBACK):
+            import sys
+
+            print(f"[spark-rapids-tpu] device decode fallback for "
+                  f"{path}: {why}", file=sys.stderr)
+
+    def _try_device_decode(self, path: str, file_index: int = 0,
+                           blocked: bool = False):
+        """Pallas decode path; None -> retry THIS FILE on the native
+        (host) decoder.  An error outside the expected unsupported-subset
+        set counts as a decoder failure (``file_decoder_fallbacks``) and
+        feeds the per-format decode breaker; it never escalates to the
+        stage fault domain — the host decoder owns the file from here.
+        ``blocked`` is the per-SCAN breaker decision (consulted once in
+        execute_columnar, not per file)."""
+        import os
+
+        if blocked or os.path.isdir(path):
+            return None
+        if not self._device_decode_conf_on():
+            return None
+        from spark_rapids_tpu import perfcounters as PC
+        from spark_rapids_tpu.config import RESILIENCE_BREAKER_THRESHOLD
         from spark_rapids_tpu.io.parquet_native import _Unsupported
         from spark_rapids_tpu.io.parquet_device import read_parquet_device
+        from spark_rapids_tpu.resilience import classify as CL
+        from spark_rapids_tpu.resilience.breaker import get_breaker
 
+        key = _decode_breaker_key(self.plan.fmt)
         try:
+            chaos.check_decode_fault(self.node_name, file_index)
             with self.metric("gpuDecodeTime").timed():
                 if self.plan.fmt == "orc":
                     from spark_rapids_tpu.io.orc_device import (
                         read_orc_device)
 
-                    return read_orc_device(path, self.plan.output)
-                return read_parquet_device(path, self.plan.output)
+                    out = read_orc_device(path, self.plan.output)
+                else:
+                    out = read_parquet_device(path, self.plan.output)
         except (_Unsupported, KeyError, ValueError, IndexError,
                 struct_error) as ex:
-            if self.conf.get(DECODE_LOG_FALLBACK):
-                import sys
-
-                print(f"[spark-rapids-tpu] device decode fallback for "
-                      f"{path}: {type(ex).__name__}: {ex}",
-                      file=sys.stderr)
+            # the documented unsupported-subset fallback: expected,
+            # silent, not a decoder failure
+            self._log_decode_fallback(path, f"{type(ex).__name__}: {ex}")
             return None
+        except Exception as ex:
+            kind = CL.classify_failure(ex)
+            if kind == CL.PROPAGATE:
+                raise
+            if kind in (CL.TRANSIENT, CL.DEVICE_OOM):
+                # infrastructure pressure, not a decoder bug: the native
+                # decoder still reads this file, but the event must not
+                # feed the per-format breaker or misreport a
+                # systematically-failing decoder
+                self._log_decode_fallback(
+                    path, f"{kind} during device decode "
+                          f"({type(ex).__name__}: {ex}); using native "
+                          f"decoder for this file")
+                return None
+            if IOF.to_scan_fault(ex, path, self.plan.fmt) is not None:
+                # a vanished/corrupt/drifted FILE is not a decoder
+                # failure: the host path re-derives the fault and the
+                # tolerance confs own it — bad data must not indict the
+                # decoder (or trip its breaker)
+                return None
+            PC.bump("file_decoder_fallbacks")
+            self.metric("fileDecoderFallbacks").add(1)
+            if get_breaker().record_failure(
+                    key,
+                    int(self.conf.get(RESILIENCE_BREAKER_THRESHOLD)),
+                    reason=f"device decode: {type(ex).__name__}: {ex}"):
+                PC.bump("breaker_trips")
+            self._log_decode_fallback(
+                path, f"decoder FAILURE {type(ex).__name__}: {ex} "
+                      f"(retrying on native decoder)")
+            return None
+        if get_breaker().has_entries():
+            get_breaker().record_success(key)
+        return out
 
     # -- host decode ----------------------------------------------------
     def _read_file_host(self, path: str):
@@ -148,11 +268,9 @@ class TpuFileSourceScanExec(TpuExec):
                 tbl = dset.to_table(
                     columns=[f.name for f in self.plan.output.fields])
             elif self.plan.fmt == "parquet":
-                import pyarrow.parquet as pq
-
                 cols = [f.name for f in self.plan.output.fields]
-                tbl = pq.read_table(
-                    path, columns=cols,
+                tbl = read_parquet_file(
+                    path, cols,
                     filters=_filters_to_arrow(self.plan.pushed_filters))
             elif self.plan.fmt == "orc":
                 import pyarrow.orc as paorc
@@ -182,6 +300,35 @@ class TpuFileSourceScanExec(TpuExec):
                 raise NotImplementedError(self.plan.fmt)
         return tbl
 
+    def _read_host_checked(self, path: str, file_index: int, mode: str):
+        """One per-file host read under the I/O fault domain: the chaos
+        ``file_corrupt`` hook fires here, and every escaping error is
+        wrapped/annotated with the file path + reader mode."""
+        with IOF.file_context(path, self.plan.fmt, mode):
+            chaos.check_file_fault(self.node_name, file_index, path)
+            return self._read_file_host(path)
+
+    def _table_or_skip(self, thunk, path: str, mode: str,
+                       tol: IOF.ScanTolerance):
+        """Run ``thunk`` (a per-file read, or a future's result) under
+        the tolerate/skip contract: -> arrow table, or None when the
+        file was tolerated away (counted, quarantined); raises the
+        typed/annotated fault otherwise."""
+        try:
+            return thunk()
+        except Exception as e:
+            # handle_scan_error returns True (tolerated) or raises
+            IOF.handle_scan_error(e, path, self.plan.fmt, mode, tol,
+                                  self.conf)
+            self.metric("filesSkipped").add(1)
+            return None
+
+    def _host_table_or_skip(self, path: str, file_index: int, mode: str,
+                            tol: IOF.ScanTolerance):
+        return self._table_or_skip(
+            lambda: self._read_host_checked(path, file_index, mode),
+            path, mode, tol)
+
     def _table_to_host_cols(self, tbl) -> List[HostColumn]:
         return [HostColumn.from_arrow(tbl.column(f.name), f.dataType)
                 for f in self.plan.output.fields]
@@ -207,29 +354,46 @@ class TpuFileSourceScanExec(TpuExec):
 
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
         mode = self._mode()
+        tol = IOF.scan_tolerance(self.conf)
+        # ONE breaker consult per scan (an open breaker would otherwise
+        # be re-consulted and re-logged for every one of N files)
+        dev_blocked = (self._device_decode_conf_on()
+                       and self._decode_breaker_open())
         if mode == "PERFILE":
-            for p in self.plan.paths:
-                dev = self._try_device_decode(p)
+            for i, p in enumerate(self.plan.paths):
+                dev = self._try_device_decode(p, i, dev_blocked)
                 if dev is not None:
                     yield self._stamp(self._count_output(dev), p)
-                else:
-                    yield self._stamp(self._count_output(
-                        self._upload(self._read_file_host(p))), p)
+                    continue
+                tbl = self._host_table_or_skip(p, i, mode, tol)
+                if tbl is None:
+                    continue
+                yield self._stamp(self._count_output(
+                    self._upload(tbl)), p)
         elif mode == "COALESCING":
             import pyarrow as pa
 
             host_paths = []
-            for p in self.plan.paths:
-                dev = self._try_device_decode(p)
+            for i, p in enumerate(self.plan.paths):
+                dev = self._try_device_decode(p, i, dev_blocked)
                 if dev is not None:
                     yield self._stamp(self._count_output(dev), p)
                 else:
-                    host_paths.append(p)
-            tbls = [self._read_file_host(p) for p in host_paths]
+                    host_paths.append((i, p))
+            # the batch stitch re-drives the SURVIVING file set: a
+            # tolerated-away file drops out of the concat instead of
+            # aborting it
+            tbls = []
+            surviving = []
+            for i, p in host_paths:
+                tbl = self._host_table_or_skip(p, i, mode, tol)
+                if tbl is not None:
+                    tbls.append(tbl)
+                    surviving.append(p)
             if not tbls:
                 return
             tbl = pa.concat_tables(tbls)
-            one = host_paths[0] if len(host_paths) == 1 else ""
+            one = surviving[0] if len(surviving) == 1 else ""
             for chunk in self._row_chunks(tbl):
                 yield self._stamp(
                     self._count_output(self._upload(chunk)), one)
@@ -237,16 +401,23 @@ class TpuFileSourceScanExec(TpuExec):
             with cf.ThreadPoolExecutor(self.num_threads) as pool:
                 # device decode is a single-threaded device pipeline; host
                 # fallbacks keep the thread pool
-                host_futs = []  # (path, future) — duplicates preserved
-                for p in self.plan.paths:
-                    dev = self._try_device_decode(p)
+                host_futs = []  # (index, path, future) — dups preserved
+                for i, p in enumerate(self.plan.paths):
+                    dev = self._try_device_decode(p, i, dev_blocked)
                     if dev is not None:
                         yield self._stamp(self._count_output(dev), p)
                     else:
                         host_futs.append(
-                            (p, pool.submit(self._read_file_host, p)))
-                for p, fut in host_futs:
-                    tbl = fut.result()
+                            (i, p,
+                             pool.submit(self._read_host_checked,
+                                         p, i, mode)))
+                for i, p, fut in host_futs:
+                    # the pyarrow struct_error that named no file now
+                    # does: the wrap happened on the pool thread, the
+                    # tolerate/raise decision happens here
+                    tbl = self._table_or_skip(fut.result, p, mode, tol)
+                    if tbl is None:
+                        continue
                     for chunk in self._row_chunks(tbl):
                         yield self._stamp(self._count_output(
                             self._upload(chunk)), p)

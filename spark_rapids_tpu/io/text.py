@@ -456,7 +456,17 @@ def _read_csv_fast(path: str, schema: T.StructType, options: dict):
 
 
 def read_csv_spark(path: str, schema: T.StructType, options: dict):
-    """Spark-semantic CSV read -> (HostColumns, row count)."""
+    """Spark-semantic CSV read -> (HostColumns, row count).  Escaping
+    errors are annotated with ``file=<path>`` (io/faults.py) — FAILFAST
+    parse errors keep their type (PROPAGATE semantics), unreadable bytes
+    classify as corrupt at the scan layer."""
+    from spark_rapids_tpu.io.faults import file_context
+
+    with file_context(path, "csv", "host"):
+        return _read_csv_spark(path, schema, options)
+
+
+def _read_csv_spark(path: str, schema: T.StructType, options: dict):
     import csv as _csv
 
     if str(options.get("tpuFastParse", "true")).lower() != "false":
@@ -652,6 +662,15 @@ def _read_json_fast(path: str, schema: T.StructType, options: dict):
 
 
 def read_json_spark(path: str, schema: T.StructType, options: dict):
+    """Spark-semantic JSON-lines read; file-context annotated like the
+    CSV twin."""
+    from spark_rapids_tpu.io.faults import file_context
+
+    with file_context(path, "json", "host"):
+        return _read_json_spark(path, schema, options)
+
+
+def _read_json_spark(path: str, schema: T.StructType, options: dict):
     """Spark-semantic JSON-lines read -> (HostColumns, row count)."""
     if str(options.get("tpuFastParse", "true")).lower() != "false":
         try:

@@ -11,10 +11,28 @@ TPU adaptation: the encode step is pyarrow (host) after a device->host
 columnar copy; partition splitting happens device-side (one compaction per
 partition value) before the host copy, mirroring how the reference slices
 batches on device before writing.
+
+Commit protocol (ISSUE 5, GpuFileFormatDataWriter / Spark task-commit
+analog): every part file is written into a ``_temporary/<query-uuid>``
+staging dir under the output path and atomically renamed into place on
+commit (optionally fsync'd — files, partition dirs, and the ``_SUCCESS``
+marker — via ``spark.rapids.tpu.files.fsyncOnCommit``); ``_SUCCESS`` is
+written only after every rename landed.  Overwrite mode deletes the OLD
+output at commit time, not before the write, so any failure or cancel
+BEFORE commit leaves the previous data intact (the commit's own
+clear+rename pass keeps Spark's residual non-atomic window — a
+disk-full mid-commit can still mix old and new).  Failure or a
+CancelToken trip
+deletes the staging dir — registered both in the writer's own unwind path
+and as a lifecycle cleanup hook — so readers can never observe partial
+output.  Staging dirs are tracked process-wide; a leftover one fails the
+owning test through the conftest leak gate.
 """
 from __future__ import annotations
 
 import os
+import shutil
+import threading
 import uuid
 from typing import Dict, List, Optional, Tuple
 
@@ -33,6 +51,142 @@ PARQUET_WRITE_COMPRESSION = conf(
     "Parquet write codec: snappy, zstd, gzip, none.").string_conf("snappy")
 
 _EXT = {"parquet": ".parquet", "orc": ".orc", "csv": ".csv", "json": ".json"}
+
+TEMP_DIR_NAME = "_temporary"
+
+# process-wide registry of live (uncommitted, unaborted) staging dirs —
+# the conftest leak gate reads it through lifecycle.leak_report_all
+_STAGING_LOCK = threading.Lock()
+_LIVE_STAGING: set = set()
+
+
+def staging_leak_report() -> List[str]:
+    with _STAGING_LOCK:
+        dirs = sorted(_LIVE_STAGING)
+    return [f"LEAK: writer staging dir never committed/aborted: {d}"
+            for d in dirs if os.path.isdir(d)]
+
+
+def reset_leaked_staging() -> None:
+    """Remove leftover staging dirs (leak-gate recovery path)."""
+    with _STAGING_LOCK:
+        dirs = list(_LIVE_STAGING)
+        _LIVE_STAGING.clear()
+    for d in dirs:
+        shutil.rmtree(d, ignore_errors=True)
+        _prune_temp_root(os.path.dirname(d))
+
+
+def _prune_temp_root(temp_root: str) -> None:
+    """Drop the _temporary parent once its last staging dir is gone."""
+    try:
+        if os.path.basename(temp_root) == TEMP_DIR_NAME \
+                and not os.listdir(temp_root):
+            os.rmdir(temp_root)
+    except OSError:
+        pass
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class TaskCommit:
+    """One write's staging/commit lifecycle (Spark's
+    FileCommitProtocol task-commit analog, single-task form).
+
+    Files are written under ``<out>/_temporary/<query-uuid>/<reldir>``;
+    :meth:`commit` renames each into ``<out>/<reldir>`` (atomic per
+    file — readers see a part file fully or not at all, and ``_SUCCESS``
+    only after all of them), :meth:`abort` deletes the staging dir.
+    Both are idempotent; abort is also registered as a lifecycle cleanup
+    hook so a CancelToken trip mid-write cleans up even if the writer's
+    own unwind path never runs."""
+
+    def __init__(self, final_dir: str):
+        from spark_rapids_tpu.lifecycle.context import current
+
+        self.final = final_dir
+        ctx = current()
+        qid = f"{ctx.query_id}-" if ctx is not None else ""
+        self.staging = os.path.join(
+            final_dir, TEMP_DIR_NAME, f"{qid}{uuid.uuid4().hex[:12]}")
+        os.makedirs(self.staging)
+        self._done = False
+        with _STAGING_LOCK:
+            _LIVE_STAGING.add(self.staging)
+        if ctx is not None:
+            ctx.add_cleanup(self.abort)
+
+    def stage_dir(self, reldir: str = "") -> str:
+        d = os.path.join(self.staging, reldir) if reldir else self.staging
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def commit(self, fsync: bool = False,
+               clear_existing: bool = False) -> List[str]:
+        """Atomically publish every staged file; returns final paths.
+
+        ``clear_existing`` implements overwrite semantics HERE rather
+        than before the write started: a failed or cancelled overwrite
+        leaves the OLD data intact (only a successful write replaces
+        it)."""
+        if self._done:
+            return []
+        if clear_existing:
+            for entry in os.listdir(self.final):
+                if entry == TEMP_DIR_NAME:
+                    continue
+                full = os.path.join(self.final, entry)
+                if os.path.isdir(full):
+                    shutil.rmtree(full)
+                else:
+                    os.remove(full)
+        moved: List[str] = []
+        dest_dirs = []
+        for root, _dirs, files in os.walk(self.staging):
+            rel = os.path.relpath(root, self.staging)
+            dest_dir = (self.final if rel == "."
+                        else os.path.join(self.final, rel))
+            os.makedirs(dest_dir, exist_ok=True)
+            dest_dirs.append(dest_dir)
+            for fn in files:
+                src = os.path.join(root, fn)
+                if fsync:
+                    _fsync_file(src)
+                dst = os.path.join(dest_dir, fn)
+                os.replace(src, dst)
+                moved.append(dst)
+        # _SUCCESS is the commit marker: written only after every part
+        # file landed (Spark parity — and the reader-visible guarantee)
+        success = os.path.join(self.final, "_SUCCESS")
+        open(success, "w").close()
+        if fsync:
+            # durability covers the rename targets too: every directory
+            # a part file landed in (partition subdirs included), the
+            # commit marker, and the output root
+            _fsync_file(success)
+            for d in dict.fromkeys(dest_dirs + [self.final]):
+                _fsync_file(d)
+        self._finish()
+        return moved
+
+    def abort(self) -> None:
+        if self._done:
+            return
+        shutil.rmtree(self.staging, ignore_errors=True)
+        self._finish()
+
+    def _finish(self) -> None:
+        self._done = True
+        with _STAGING_LOCK:
+            _LIVE_STAGING.discard(self.staging)
+        shutil.rmtree(self.staging, ignore_errors=True)
+        _prune_temp_root(os.path.dirname(self.staging))
 
 
 def _hive_part_value(v) -> str:
@@ -169,50 +323,58 @@ class TpuDataWritingCommandExec(TpuExec):
                                                             "none")
 
     def run_write(self) -> None:
-        import shutil
+        from spark_rapids_tpu.config import FSYNC_ON_COMMIT
 
-        if self.mode == "overwrite" and os.path.exists(self.path):
-            shutil.rmtree(self.path)
+        # overwrite deletes the OLD output at COMMIT time (TaskCommit
+        # clear_existing), not here: a failed/cancelled overwrite must
+        # leave the previous data intact, never an emptied directory
         os.makedirs(self.path, exist_ok=True)
         max_records = self.conf.get(MAX_RECORDS_PER_FILE)
         compression = self.conf.get(PARQUET_WRITE_COMPRESSION)
         device_encode = self._device_encode_on()
+        commit = TaskCommit(self.path)
         rollers: Dict[str, _FileRoller] = {}
         seqs: Dict[str, int] = {}
-        names = None
-        for task_id, batch in enumerate(
-                self.children[0].execute_columnar()):
-            names = batch.schema.field_names()
-            with self.metric("writeTime").timed():
-                if device_encode:
-                    from spark_rapids_tpu.io.parquet_encode import (
-                        write_parquet_device,
-                    )
+        try:
+            for task_id, batch in enumerate(
+                    self.children[0].execute_columnar()):
+                with self.metric("writeTime").timed():
+                    if device_encode:
+                        from spark_rapids_tpu.io.parquet_encode import (
+                            write_parquet_device,
+                        )
 
-                    for reldir, schema, cols, nrows in \
-                            self._split_batch_host(batch, max_records):
-                        directory = os.path.join(self.path, reldir) \
-                            if reldir else self.path
-                        os.makedirs(directory, exist_ok=True)
-                        seq = seqs.get(reldir, 0)
-                        seqs[reldir] = seq + 1
-                        base = (f"part-{task_id:05d}-{seq:04d}-"
-                                f"{uuid.uuid4().hex[:12]}.parquet")
-                        write_parquet_device(
-                            os.path.join(directory, base), schema, cols,
-                            nrows, compression)
-                    continue
-                for reldir, tbl in self._split_batch(batch):
-                    directory = os.path.join(self.path, reldir) \
-                        if reldir else self.path
-                    roller = rollers.get(reldir)
-                    if roller is None:
-                        roller = rollers[reldir] = _FileRoller(
-                            self.fmt, directory, task_id, max_records,
-                            compression)
-                    roller.write(tbl)
-        # empty input: still create the directory + _SUCCESS (Spark parity)
-        open(os.path.join(self.path, "_SUCCESS"), "w").close()
+                        for reldir, schema, cols, nrows in \
+                                self._split_batch_host(batch, max_records):
+                            directory = commit.stage_dir(reldir)
+                            seq = seqs.get(reldir, 0)
+                            seqs[reldir] = seq + 1
+                            base = (f"part-{task_id:05d}-{seq:04d}-"
+                                    f"{uuid.uuid4().hex[:12]}.parquet")
+                            write_parquet_device(
+                                os.path.join(directory, base), schema,
+                                cols, nrows, compression)
+                        continue
+                    for reldir, tbl in self._split_batch(batch):
+                        roller = rollers.get(reldir)
+                        if roller is None:
+                            # rolled (maxRecordsPerFile) part files stage
+                            # under the same commit protocol as everything
+                            # else — no direct-to-destination writes left
+                            roller = rollers[reldir] = _FileRoller(
+                                self.fmt, commit.stage_dir(reldir),
+                                task_id, max_records, compression)
+                        roller.write(tbl)
+            # empty input still commits: directory + _SUCCESS (Spark
+            # parity); the rename pass is then a no-op
+            commit.commit(fsync=bool(self.conf.get(FSYNC_ON_COMMIT)),
+                          clear_existing=(self.mode == "overwrite"))
+        except BaseException:
+            # failure or CancelToken trip: readers must never observe
+            # partial output (the lifecycle cleanup hook is the backstop
+            # when this frame never unwinds)
+            commit.abort()
+            raise
         self.metrics["numOutputRows"]  # touch for metric presence
 
     def _split_batch_host(self, batch: ColumnarBatch, max_records: int):
@@ -290,31 +452,36 @@ def cpu_write(plan, ansi: bool) -> None:
     arrays = [c.to_host().to_arrow() for c in cols]
     names = child.output.field_names()
     tbl = pa.table(dict(zip(names, arrays)))
-    import shutil
-
-    if plan.mode == "overwrite" and os.path.exists(plan.path):
-        shutil.rmtree(plan.path)
     os.makedirs(plan.path, exist_ok=True)
-    writer = TpuDataWritingCommandExec.__new__(TpuDataWritingCommandExec)
-    # reuse the partition-splitting logic host-side
-    if plan.partition_cols:
-        import numpy as np
+    # the oracle write runs the SAME staging/commit protocol: the
+    # differential write tests must compare like with like, and a failed
+    # oracle write must not leave partial output either
+    commit = TaskCommit(plan.path)
+    try:
+        if plan.partition_cols:
+            import numpy as np
 
-        pidx = [names.index(c) for c in plan.partition_cols]
-        didx = [i for i in range(len(names)) if i not in pidx]
-        part_vals = [tbl.column(names[i]).to_pylist() for i in pidx]
-        keys = list(zip(*part_vals))
-        uniq = sorted(set(keys), key=lambda t: tuple(str(x) for x in t))
-        keys_arr = np.array([str(k) for k in keys])
-        for u in uniq:
-            mask = keys_arr == str(u)
-            sub = tbl.filter(mask).select([names[i] for i in didx])
-            reldir = "/".join(f"{c}={_hive_part_value(v)}"
-                              for c, v in zip(plan.partition_cols, u))
+            pidx = [names.index(c) for c in plan.partition_cols]
+            didx = [i for i in range(len(names)) if i not in pidx]
+            part_vals = [tbl.column(names[i]).to_pylist() for i in pidx]
+            keys = list(zip(*part_vals))
+            uniq = sorted(set(keys), key=lambda t: tuple(str(x) for x in t))
+            keys_arr = np.array([str(k) for k in keys])
+            for u in uniq:
+                mask = keys_arr == str(u)
+                sub = tbl.filter(mask).select([names[i] for i in didx])
+                reldir = "/".join(f"{c}={_hive_part_value(v)}"
+                                  for c, v in zip(plan.partition_cols, u))
+                base = f"part-00000-0000-{uuid.uuid4().hex[:12]}"
+                write_arrow_table(sub, plan.fmt, commit.stage_dir(reldir),
+                                  base)
+        else:
             base = f"part-00000-0000-{uuid.uuid4().hex[:12]}"
-            write_arrow_table(sub, plan.fmt,
-                              os.path.join(plan.path, reldir), base)
-    else:
-        base = f"part-00000-0000-{uuid.uuid4().hex[:12]}"
-        write_arrow_table(tbl, plan.fmt, plan.path, base)
-    open(os.path.join(plan.path, "_SUCCESS"), "w").close()
+            write_arrow_table(tbl, plan.fmt, commit.stage_dir(), base)
+        from spark_rapids_tpu.config import FSYNC_ON_COMMIT, get_conf
+
+        commit.commit(fsync=bool(get_conf().get(FSYNC_ON_COMMIT)),
+                      clear_existing=(plan.mode == "overwrite"))
+    except BaseException:
+        commit.abort()
+        raise

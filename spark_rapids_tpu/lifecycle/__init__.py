@@ -148,6 +148,15 @@ def _cleanup_query(ctx: QueryContext) -> None:
     """Release everything the query may still hold after its exec tree
     unwound (possibly mid-batch).  Every step peeks the singleton —
     nothing is created during cleanup — and every step is idempotent."""
+    # 0. query-registered cleanup hooks (ISSUE 5: the writer's staging
+    #    -dir abort) — run FIRST so a cancelled mid-write query deletes
+    #    its _temporary dir before anything else is torn down
+    while ctx.cleanup_hooks:
+        fn = ctx.cleanup_hooks.pop()
+        try:
+            fn()
+        except Exception:
+            pass
     # 1. residual semaphore permit: the collect-level scope released one
     #    depth; exec code that failed between acquire and its finally can
     #    leave extra depth, which would starve every other query
@@ -197,6 +206,12 @@ def leak_report_all() -> List[str]:
     if mgr is not None:
         for sid in mgr.active_shuffles():
             out.append(f"LEAK: shuffle {sid} still registered")
+    # 4. writer staging dirs never committed nor aborted (ISSUE 5): a
+    #    leftover _temporary/<uuid> means a write unwound without its
+    #    commit protocol running — visible-partial-output risk
+    from spark_rapids_tpu.io import writer as _writer
+
+    out.extend(_writer.staging_leak_report())
     return out
 
 
@@ -221,6 +236,9 @@ def reset_leaked_state() -> None:
                 mgr.unregister_shuffle(sid)
             except Exception:
                 pass
+    from spark_rapids_tpu.io import writer as _writer
+
+    _writer.reset_leaked_staging()
 
 
 __all__ = [

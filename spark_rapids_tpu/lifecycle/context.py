@@ -96,7 +96,7 @@ class QueryContext:
 
     __slots__ = ("query_id", "token", "admission_seq", "admission_wait_ns",
                  "deadline_ns", "watchdog_period_s", "started_ns",
-                 "owner_thread")
+                 "owner_thread", "cleanup_hooks")
 
     def __init__(self, watchdog_period_s: float = 0.05):
         n = next(_QUERY_SEQ)
@@ -112,6 +112,16 @@ class QueryContext:
         self.watchdog_period_s = watchdog_period_s
         self.started_ns = time.monotonic_ns()
         self.owner_thread = threading.get_ident()
+        # idempotent callables run by lifecycle._cleanup_query when the
+        # query's exec tree unwinds (success, error, or cancel trip) —
+        # e.g. the writer's staging-dir abort (ISSUE 5): a killed
+        # mid-write query must leave zero visible partial output
+        self.cleanup_hooks: list = []
+
+    def add_cleanup(self, fn) -> None:
+        """Register an idempotent cleanup callable (exceptions are
+        swallowed at cleanup time)."""
+        self.cleanup_hooks.append(fn)
 
     # -- cancellation ----------------------------------------------------
     def cancel(self, reason: str = "query cancelled") -> bool:

@@ -73,10 +73,15 @@ def _unpack_call(padded: jax.Array, bw: int, groups: int) -> jax.Array:
     pad_groups = tiles * _TILE
     # Mosaic rejects the i64 grid scalars jax_enable_x64 produces; the
     # kernel itself is pure u8/u32, so trace it in an x64-free scope.
+    # (jax.experimental.enable_x64 — the top-level jax.enable_x64 alias
+    # was removed in jax 0.4.x, which made every device decode fail and
+    # fall back to the host path.)
     # Blocks pad the byte dimension to the 128-lane register width —
     # narrower last dims hit Mosaic relayout hazards (observed: silent
     # wrong lanes at bw=13).
-    with jax.enable_x64(False):
+    from jax.experimental import enable_x64 as _x64_scope
+
+    with _x64_scope(False):
         mat = jnp.zeros((pad_groups, _LANES), jnp.uint32)
         mat = mat.at[:groups, :bw].set(
             padded.reshape(groups, bw).astype(jnp.uint32))

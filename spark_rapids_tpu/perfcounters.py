@@ -61,6 +61,11 @@ COUNTERS: Dict[str, int] = {
     "breaker_trips": 0,
     "breaker_plan_fallbacks": 0,
     "query_fallbacks": 0,
+    # I/O fault domain (io/faults.py, ISSUE 5): per-file scan tolerance
+    # and the per-file device->native decoder fallback
+    "files_skipped_corrupt": 0,
+    "files_skipped_missing": 0,
+    "file_decoder_fallbacks": 0,
     # query lifecycle (admission control / deadlines / cancellation,
     # lifecycle/ package)
     "queries_admitted": 0,

@@ -34,7 +34,10 @@ from spark_rapids_tpu.resilience.classify import (
 )
 from spark_rapids_tpu.resilience.faults import (
     InjectedCompileError,
+    InjectedDecodeError,
+    InjectedFileCorruption,
     InjectedTransientError,
+    active_faults,
     clear_faults,
     inject_fault,
 )

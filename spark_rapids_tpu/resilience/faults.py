@@ -35,6 +35,20 @@ class InjectedTransientError(Exception):
     DEADLINE_EXCEEDED style XLA runtime errors)."""
 
 
+class InjectedFileCorruption(Exception):
+    """Injected per-file scan corruption (ISSUE 5): raised inside the
+    scan's per-file read so io/faults.py classifies it CorruptFile —
+    tolerated-skip vs fail-fast then follows the ignoreCorruptFiles
+    conf matrix exactly like real on-disk corruption."""
+
+
+class InjectedDecodeError(Exception):
+    """Injected DEVICE-decoder failure (ISSUE 5): raised inside
+    _try_device_decode so the scan retries that one file on the native
+    (host) decoder — exercises the file_decoder_fallbacks counter and
+    the per-format decode breaker without a real kernel bug."""
+
+
 class _Fault:
     __slots__ = ("operator", "kind", "count", "at_batch", "seed", "fired")
 
@@ -58,7 +72,8 @@ _FIRED: Dict[str, int] = {}
 # like the fault list itself)
 _CONF_SPEC: Optional[str] = None
 
-KINDS = ("compile", "transient", "poison", "oom")
+KINDS = ("compile", "transient", "poison", "oom", "file_corrupt",
+         "decode")
 
 
 def inject_fault(operator: str, kind: str, count: int = 1,
@@ -134,6 +149,31 @@ def check_fault(op_name: str, batch_index: int) -> None:
         raise RuntimeError(
             f"RESOURCE_EXHAUSTED: injected device OOM at {op_name} "
             f"batch {batch_index}")
+
+
+def check_file_fault(op_name: str, file_index: int, path: str) -> None:
+    """Raise the armed ``file_corrupt`` fault for this (operator, file
+    ordinal), if any.  Called by the scan inside each per-file read, so
+    the injected corruption flows through the SAME classify/tolerate
+    path as a real bad file (``at_batch`` selects the file ordinal)."""
+    if not _FAULTS:
+        return
+    if _take(op_name, file_index, "file_corrupt") is not None:
+        raise InjectedFileCorruption(
+            f"injected corrupt file at {op_name} file {file_index}: "
+            f"{path}")
+
+
+def check_decode_fault(op_name: str, file_index: int) -> None:
+    """Raise the armed ``decode`` fault for this (operator, file
+    ordinal) — fired inside the device-decode attempt only, so the scan
+    falls back to the native decoder for that file."""
+    if not _FAULTS:
+        return
+    if _take(op_name, file_index, "decode") is not None:
+        raise InjectedDecodeError(
+            f"injected device decode failure at {op_name} "
+            f"file {file_index}")
 
 
 def maybe_poison(op_name: str, batch_index: int, batch):

@@ -689,7 +689,15 @@ class DataFrame:
                 self._last_diag = scope.diag
             lists = [h.to_pylist() for h in host]
             return list(zip(*lists)) if lists else []
-        cols, n = execute_cpu_plan(root, ansi=self.session.conf.ansi_enabled)
+        # full-oracle runs pin the session conf thread-locally too: the
+        # oracle file scan reads the per-file tolerance confs (ISSUE 5)
+        # through config.get_conf(), which must see THIS session's
+        # settings, not the process-global slot
+        from spark_rapids_tpu.config import ambient_conf
+
+        with ambient_conf(self.session.conf):
+            cols, n = execute_cpu_plan(
+                root, ansi=self.session.conf.ansi_enabled)
         lists = [c.to_pylist() for c in cols]
         return list(zip(*lists)) if lists else []
 

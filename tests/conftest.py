@@ -69,9 +69,11 @@ def _resilience_isolation():
 def _leak_gate(request):
     """ISSUE 4 satellite: a leaked spillable handle, semaphore permit, or
     shuffle registration fails the OWNING test instead of silently
-    poisoning every later one.  The gate only *fails* a test whose body
-    passed (a failing test already reported its real error — the leaked
-    state is still cleaned so it cannot cascade)."""
+    poisoning every later one.  ISSUE 5 extends the report to writer
+    staging dirs: a leftover ``_temporary/<uuid>`` means a write unwound
+    without its commit protocol running.  The gate only *fails* a test
+    whose body passed (a failing test already reported its real error —
+    the leaked state is still cleaned so it cannot cascade)."""
     yield
     from spark_rapids_tpu.lifecycle import (
         leak_report_all,
@@ -89,7 +91,8 @@ def _leak_gate(request):
     if rep is not None and rep.passed:
         pytest.fail(
             "resource leak after test (spillables / semaphore permits / "
-            "shuffle registrations):\n" + "\n".join(leaks[:20]),
+            "shuffle registrations / writer staging dirs):\n"
+            + "\n".join(leaks[:20]),
             pytrace=False)
 
 

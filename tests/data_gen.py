@@ -277,6 +277,136 @@ def gen_df(session, gens: List, names: Optional[List[str]] = None,
     return session.create_dataframe(data, schema)
 
 
+# ---------------------------------------------------------------------------
+# corrupt-file generators (ISSUE 5): deterministic on-disk damage for the
+# I/O fault-domain matrix tests and tools/run_chaos.py --corrupt-inputs
+# ---------------------------------------------------------------------------
+
+def write_multifile_dataset(dirpath, fmt: str, n_files: int = 4,
+                            rows_per_file: int = 50,
+                            seed: int = DEFAULT_SEED) -> List[str]:
+    """N standalone files of one scan-able schema (i: long, v: double,
+    s: string) -> ordered path list.  Values are globally unique across
+    files so surviving-row counts are unambiguous."""
+    import os
+
+    import pyarrow as pa
+
+    os.makedirs(str(dirpath), exist_ok=True)
+    rng = random.Random(seed)
+    paths = []
+    for fi in range(n_files):
+        base = fi * rows_per_file
+        tbl = pa.table({
+            "i": list(range(base, base + rows_per_file)),
+            "v": [round(rng.uniform(-100, 100), 6)
+                  for _ in range(rows_per_file)],
+            "s": [f"r{base + j}" for j in range(rows_per_file)],
+        })
+        path = os.path.join(str(dirpath), f"part-{fi:03d}.{fmt}")
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+
+            pq.write_table(tbl, path)
+        elif fmt == "orc":
+            import pyarrow.orc as paorc
+
+            paorc.write_table(tbl, path)
+        elif fmt == "avro":
+            from spark_rapids_tpu.io.avro import write_avro_file
+
+            schema = {"type": "record", "name": "row", "fields": [
+                {"name": "i", "type": "long"},
+                {"name": "v", "type": "double"},
+                {"name": "s", "type": "string"}]}
+            write_avro_file(path, schema, tbl.to_pylist())
+        elif fmt == "csv":
+            with open(path, "w") as f:
+                f.write("i,v,s\n")
+                for r in tbl.to_pylist():
+                    f.write(f"{r['i']},{r['v']},{r['s']}\n")
+        else:
+            raise NotImplementedError(fmt)
+        paths.append(path)
+    return paths
+
+
+def corrupt_truncate(path: str, keep_frac: float = 0.6) -> str:
+    """Cut the file short (drops the parquet footer / ORC postscript /
+    avro sync tail) — the classic mid-upload truncation."""
+    with open(path, "rb") as f:
+        data = f.read()
+    keep = max(int(len(data) * keep_frac), 1)
+    with open(path, "wb") as f:
+        f.write(data[:keep])
+    return path
+
+
+def corrupt_flip(path: str, offset: Optional[int] = None,
+                 nbytes: int = 16) -> str:
+    """Flip a byte run.  Default offset targets the metadata tail
+    (footer / postscript / sync marker), where single-bit damage is
+    reliably fatal to every container format; pyarrow does not verify
+    data-page checksums on read, so mid-page flips may decode silently."""
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    if offset is None:
+        offset = max(len(data) - 24, 0)
+    for i in range(offset, min(offset + nbytes, len(data))):
+        data[i] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+    return path
+
+
+def corrupt_garbage(path: str, offset: int = 0, nbytes: int = 24) -> str:
+    """Overwrite a byte run with NUL/0xFF garbage — the text-format
+    corruption shape (undecodable bytes; a bit-flipped ASCII row would
+    still parse permissively)."""
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    junk = (b"\x00\xff" * ((nbytes + 1) // 2))[:nbytes]
+    data[offset:offset + len(junk)] = junk
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+    return path
+
+
+def corrupt_delete(path: str) -> str:
+    """The file vanished between planning and read (ignoreMissingFiles
+    territory)."""
+    import os
+
+    os.remove(path)
+    return path
+
+
+def write_schema_drifted(path: str, fmt: str, rows: int = 10,
+                         seed: int = DEFAULT_SEED) -> str:
+    """Overwrite ``path`` with a file whose column ``i`` was renamed —
+    the per-file SchemaMismatch shape (pyarrow: 'No match for FieldRef'
+    / 'Invalid column selected')."""
+    import pyarrow as pa
+
+    rng = random.Random(seed)
+    tbl = pa.table({
+        "i_renamed": list(range(rows)),
+        "v": [round(rng.uniform(-100, 100), 6) for _ in range(rows)],
+        "s": [f"d{j}" for j in range(rows)],
+    })
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(tbl, path)
+    elif fmt == "orc":
+        import pyarrow.orc as paorc
+
+        paorc.write_table(tbl, path)
+    else:
+        raise NotImplementedError(fmt)
+    return path
+
+
 # canonical generator sets, as the reference groups them
 numeric_gens = [ByteGen(), ShortGen(), IntegerGen(), LongGen(),
                 FloatGen(), DoubleGen()]

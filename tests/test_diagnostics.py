@@ -74,6 +74,7 @@ GOLDEN_SCHEMA = {
     "cache": ["hit", "label"],
     "resilience": ["kind", "op_name", "detail"],
     "lifecycle": ["kind", "detail", "dur_ns"],
+    "io_fault": ["kind", "path", "fmt", "detail"],
     "op_batch": ["path", "batch", "rows", "dur_ns"],
     "operator": ["path", "name", "describe", "wall_ns", "self_wall_ns",
                  "batches", "rows", "counters", "metrics", "fallback"],

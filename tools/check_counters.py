@@ -16,7 +16,12 @@ Checks, failing the suite (tests/test_diagnostics.py calls
   ``spark.rapids.tpu.admission.*``, ``spark.rapids.tpu.query.*``,
   ``spark.rapids.tpu.semaphore.*``) appears in ``docs/concurrency.md``
   and the generated ``docs/configs.md``, and the lifecycle counters are
-  documented in both.
+  documented in both;
+* every I/O fault-tolerance conf (``spark.sql.files.ignore*``,
+  ``spark.rapids.tpu.files.*``) appears in ``docs/io_resilience.md``
+  and the generated ``docs/configs.md``, the I/O counters
+  (``files_skipped_*``, ``file_decoder_fallbacks``) are documented
+  there, and the ``io_fault`` event type is registered.
 """
 from __future__ import annotations
 
@@ -109,6 +114,36 @@ def check() -> list:
             problems.append(
                 f"lifecycle counter '{key}' is not documented in "
                 f"docs/concurrency.md")
+
+    # I/O fault domain (ISSUE 5): tolerance confs + counters must be
+    # documented in docs/io_resilience.md (and confs in configs.md)
+    io_md = read("io_resilience.md")
+    io_confs = [k for k in _REGISTRY
+                if k.startswith(("spark.sql.files.ignore",
+                                 "spark.rapids.tpu.files."))]
+    if not io_confs:
+        problems.append("no I/O fault-tolerance confs registered")
+    for key in sorted(io_confs):
+        if f"`{key}`" not in io_md:
+            problems.append(
+                f"conf '{key}' is not documented in "
+                f"docs/io_resilience.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+    for key in ("files_skipped_corrupt", "files_skipped_missing",
+                "file_decoder_fallbacks"):
+        if key not in PC.COUNTERS:
+            problems.append(f"I/O counter '{key}' is not registered in "
+                            f"perfcounters.COUNTERS")
+        if f"`{key}`" not in io_md:
+            problems.append(
+                f"I/O counter '{key}' is not documented in "
+                f"docs/io_resilience.md")
+    if "io_fault" not in EVENT_SCHEMA:
+        problems.append("diagnostics event type 'io_fault' is not "
+                        "registered in EVENT_SCHEMA")
     return problems
 
 

@@ -3,6 +3,14 @@
 over the representative join+agg+sort+expr query and print a summary.
 
     python tools/run_chaos.py [--seed 7] [--shape broadcast|shuffled|all]
+    python tools/run_chaos.py --corrupt-inputs [--seed 7]
+
+``--corrupt-inputs`` (ISSUE 5) sweeps REAL on-disk input damage instead
+of injected operator faults: for each mutation (truncate / bit-flip /
+delete one file of a multi-file parquet scan) x tolerance conf (ignore
+on / off), one query runs and the outcome must match the conf matrix —
+tolerated-skip returning exactly the surviving files' rows, or fail-fast
+with a file-attributed error.
 
 For every planned exec operator and every failure class (compile,
 transient, oom, poison) one query runs with that single fault armed; the
@@ -91,12 +99,97 @@ def run_cell(conf, op, kind, seed):
     return ("PASS" if equal else "DIVERGED"), path
 
 
+def run_corrupt_inputs(seed: int) -> bool:
+    """The --corrupt-inputs sweep: (mutation x ignore-conf) over a
+    6-file parquet scan, asserting tolerated-skip vs fail-fast matches
+    the conf matrix (io/faults.py)."""
+    import tempfile
+
+    from data_gen import (
+        corrupt_delete,
+        corrupt_flip,
+        corrupt_truncate,
+        write_multifile_dataset,
+    )
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu.io.faults import ScanFault
+    from spark_rapids_tpu.session import TpuSession
+
+    MUTATIONS = {"truncate": corrupt_truncate, "bitflip": corrupt_flip,
+                 "delete": corrupt_delete}
+    BAD = 2   # which file gets damaged
+
+    def scan_rows(conf, paths):
+        s = TpuSession(conf)
+        from spark_rapids_tpu import types as T
+
+        schema = T.StructType([T.StructField("i", T.LONG),
+                               T.StructField("v", T.DOUBLE),
+                               T.StructField("s", T.STRING)])
+        return sorted(s.read.schema(schema).parquet(*paths).collect())
+
+    ok = True
+    print("\n== corrupt-inputs sweep (parquet, 6 files, file "
+          f"{BAD} damaged) ==")
+    print(f"{'mutation':10s} {'ignore':7s} {'outcome':22s} detail")
+    print("-" * 72)
+    for mname, mutate in sorted(MUTATIONS.items()):
+        for ignore in (True, False):
+            with tempfile.TemporaryDirectory() as td:
+                paths = write_multifile_dataset(td, "parquet",
+                                                n_files=6,
+                                                rows_per_file=25,
+                                                seed=seed)
+                mutate(paths[BAD])
+                surviving = [p for k, p in enumerate(paths) if k != BAD]
+                expected = scan_rows(
+                    {"spark.rapids.sql.enabled": False}, surviving)
+                conf = {"spark.rapids.sql.enabled": True,
+                        "spark.rapids.tpu.resilience.enabled": False,
+                        "spark.sql.files.ignoreCorruptFiles": ignore,
+                        "spark.sql.files.ignoreMissingFiles": ignore}
+                PC.reset()
+                try:
+                    rows = scan_rows(conf, paths)
+                    err = None
+                except Exception as e:   # noqa: BLE001 — report matrix
+                    rows, err = None, e
+                d = PC.snapshot()
+                skipped = (d["files_skipped_corrupt"]
+                           + d["files_skipped_missing"])
+                if ignore:
+                    good = err is None and rows == expected \
+                        and skipped == 1
+                    outcome = ("SKIPPED-OK" if good else
+                               "DIVERGED" if err is None else "ERROR")
+                    detail = (f"skipped={skipped}" if err is None
+                              else f"{type(err).__name__}: {err}")
+                else:
+                    good = (isinstance(err, ScanFault)
+                            and paths[BAD] in str(err))
+                    outcome = "FAILFAST-OK" if good else \
+                        ("NO-ERROR" if err is None else "WRONG-ERROR")
+                    detail = type(err).__name__ if err else "-"
+                ok &= good
+                print(f"{mname:10s} {str(ignore):7s} {outcome:22s} "
+                      f"{str(detail)[:40]}")
+    print("-" * 72)
+    print("corrupt-inputs sweep:", "OK" if ok else "FAILED")
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--shape", default="all",
                     choices=["all"] + sorted(SHAPES))
+    ap.add_argument("--corrupt-inputs", action="store_true",
+                    help="sweep real on-disk input damage against the "
+                         "ignoreCorruptFiles/ignoreMissingFiles matrix")
     args = ap.parse_args()
+
+    if args.corrupt_inputs:
+        return 0 if run_corrupt_inputs(args.seed) else 1
 
     shapes = sorted(SHAPES) if args.shape == "all" else [args.shape]
     ok = True
