@@ -441,6 +441,47 @@ AVRO_READ_ENABLED = conf("spark.rapids.sql.format.avro.read.enabled").doc(
     "Enable TPU Avro scans (pure-python container decode, io/avro.py)."
 ).boolean_conf(True)
 
+# --- transport-aware scan pipeline (ISSUE 6) -------------------------------
+
+PARQUET_COMPRESSED_TRANSFER = conf(
+    "spark.rapids.sql.format.parquet.transfer.compressed").doc(
+    "With parquet decode.device on, ship eligible column chunks across "
+    "the host->device link as RAW COMPRESSED page bytes and decompress "
+    "(snappy block gather) + decode (RLE/bit-pack/dictionary) on device, "
+    "so the link carries the smallest representation (the 5-40 MB/s "
+    "tunnel is the standing scan bottleneck; BENCH_r05).  Chunks outside "
+    "the device-decompressible subset (zstd codec, PLAIN byte_array "
+    "pages) fall back PER CHUNK to the decoded-transfer device path "
+    "(`chunk_decode_fallbacks`).  Physical link bytes land in "
+    "`bytes_h2d`; the decoded size lands in `bytes_h2d_logical`."
+).boolean_conf(True)
+
+SCAN_PREFETCH_DEPTH = conf("spark.rapids.tpu.scan.prefetch.depth").doc(
+    "Async H2D prefetch ring depth for the COALESCING/MULTITHREADED "
+    "readers: up to this many upcoming batches are decoded+uploaded on a "
+    "staging thread while the query computes on the current batch "
+    "(double-buffering at the default 2).  Overlap efficiency is "
+    "observable via `bytes_h2d_overlapped` / `prefetch_stall_ns` and the "
+    "`scan_prefetch` diagnostics event.  0 disables (strictly "
+    "sequential transfer-then-compute).").integer_conf(2)
+
+SCAN_HOT_CACHE = conf("spark.rapids.tpu.scan.hotTableCache.enabled").doc(
+    "Device-resident hot-table cache: completed file scans register "
+    "their device batches (keyed by file fingerprints + column set + "
+    "pushed filters + snapshot id) so a repeated query over the same "
+    "table skips the read+decode+transfer entirely "
+    "(`hot_cache_hits`/`hot_cache_misses`).  Entries are spillable "
+    "(memory/spill.py): HBM pressure migrates them down-tier instead of "
+    "OOMing, and `TpuSession.close()` drops them.  Off by default; "
+    "serving-tier deployments replaying dashboards enable it."
+).boolean_conf(False)
+
+SCAN_HOT_CACHE_MAX_BYTES = conf(
+    "spark.rapids.tpu.scan.hotTableCache.maxBytes").doc(
+    "Device-bytes bound on the hot-table cache; inserting past it "
+    "evicts least-recently-used entries (`hot_cache_evictions`).  A "
+    "single scan larger than the bound is not cached.").bytes_conf(1 << 30)
+
 # --- IO fault tolerance (io/faults.py — per-file scan fault domain) --------
 
 IGNORE_CORRUPT_FILES = conf("spark.sql.files.ignoreCorruptFiles").doc(

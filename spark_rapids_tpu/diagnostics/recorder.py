@@ -49,6 +49,7 @@ EVENT_SCHEMA: Dict[str, List[str]] = {
     "resilience": ["kind", "op_name", "detail"],
     "lifecycle": ["kind", "detail", "dur_ns"],
     "io_fault": ["kind", "path", "fmt", "detail"],
+    "scan_prefetch": ["depth", "batches", "overlapped_bytes", "stall_ns"],
     "op_batch": ["path", "batch", "rows", "dur_ns"],
     "operator": ["path", "name", "describe", "wall_ns", "self_wall_ns",
                  "batches", "rows", "counters", "metrics", "fallback"],
@@ -328,6 +329,18 @@ class QueryDiagnostics:
         ``rejected``."""
         self._event(ESSENTIAL, "lifecycle", kind=kind,
                     detail=str(detail)[:500], dur_ns=int(dur_ns))
+
+    def scan_prefetch(self, depth: int, batches: int,
+                      overlapped_bytes: int, stall_ns: int) -> None:
+        """One scan's H2D prefetch-ring summary (ISSUE 6): how many
+        batches the ring produced, how many uploaded bytes fully
+        overlapped query compute, and how long the consumer stalled
+        waiting on an in-flight prefetch — profile_report derives
+        overlap efficiency from these."""
+        self._event(MODERATE, "scan_prefetch", depth=int(depth),
+                    batches=int(batches),
+                    overlapped_bytes=int(overlapped_bytes),
+                    stall_ns=int(stall_ns))
 
     # -- finalization --------------------------------------------------
     def finish(self, root=None, status: str = "ok") -> None:

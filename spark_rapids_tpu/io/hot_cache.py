@@ -1,0 +1,177 @@
+"""Device-resident hot-table cache — repeated scans skip the link.
+
+Reference analog: the serving-tier observation in "Accelerating Presto
+with GPUs" (arXiv:2606.24647) — dashboard workloads re-scan the same
+slowly-changing tables — combined with the reference's
+ParquetCachedBatchSerializer stance: once decoded columns sit in
+accelerator memory, a repeat query should pay zero transfer.
+
+A completed file scan registers its device batches here keyed by a
+``compilecache.keys.fingerprint`` over everything that could change the
+bytes produced: the file set WITH per-file (size, mtime_ns) fingerprints
+(a rewritten file misses naturally), the projected column set, the
+pushed-down filters, the snapshot id recorded in the scan options
+(iceberg/delta MOR scans), and the reader chunking conf.  A second scan
+with the same key yields the cached batches — zero H2D bytes
+(``hot_cache_hits``; the tier-1 pin asserts the zero).
+
+Memory discipline: every cached batch is registered with the spill
+framework as a PERSISTENT spillable (the df.cache() semantics — it
+outlives its query), so HBM pressure migrates cold entries down-tier
+through the existing LRU machinery instead of OOMing, and the leak
+accounting knows about every byte.  The cache itself enforces
+``spark.rapids.tpu.scan.hotTableCache.maxBytes`` by closing
+least-recently-used entries (``hot_cache_evictions``).
+``TpuSession.close()`` (and ``clear()``) drops everything — the
+session-shutdown leak gate sees an empty framework afterwards.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import List, Optional, Tuple
+
+
+class _Entry:
+    __slots__ = ("handles", "paths", "nbytes")
+
+    def __init__(self, handles, paths, nbytes: int):
+        self.handles = handles          # List[SpillableColumnarBatch]
+        self.paths = paths              # List[str] (stamp source per batch)
+        self.nbytes = nbytes
+
+
+class HotTableCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._bytes = 0
+
+    # -- keying ---------------------------------------------------------
+    @staticmethod
+    def scan_key(fmt: str, paths, columns, pushed_repr: str, options,
+                 max_rows: int) -> Optional[str]:
+        """Fingerprint of everything that could change scan output; None
+        when a file vanished mid-keying (no caching on shaky ground)."""
+        from spark_rapids_tpu.compilecache.keys import fingerprint
+
+        stats = []
+        for p in paths:
+            try:
+                st = os.stat(p)
+                stats.append((p, st.st_size, st.st_mtime_ns))
+            except OSError:
+                return None
+        return fingerprint(
+            "hot_table_scan", fmt, tuple(stats), tuple(columns),
+            pushed_repr,
+            tuple(sorted((str(k), str(v))
+                         for k, v in (options or {}).items())),
+            int(max_rows))
+
+    # -- lookup / insert ------------------------------------------------
+    def get(self, key: str) -> Optional[List[Tuple[object, str]]]:
+        """Cached (batch, path) pairs, LRU-touched; None on miss.
+        Materializing may unspill (that transfer is counted normally).
+        An entry racing a concurrent eviction (handle closed between
+        the lock release and materialization) degrades to a miss."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return None
+            self._entries.move_to_end(key)
+            handles = list(e.handles)
+            paths = list(e.paths)
+        out = []
+        for h, p in zip(handles, paths):
+            if h.closed:
+                return None
+            b = h.get_batch()
+            if b is None:
+                return None
+            out.append((b, p))
+        return out
+
+    def put(self, key: str, batches: List[Tuple[object, str]],
+            max_bytes: int) -> bool:
+        """Register a completed scan's batches; False when it exceeds
+        ``max_bytes`` on its own (not cached)."""
+        from spark_rapids_tpu import perfcounters as PC
+        from spark_rapids_tpu.memory.spill import get_spill_framework
+
+        total = sum(b.nbytes() for b, _ in batches)
+        if not batches or total > max_bytes:
+            return False
+        # the spill framework's host tier round-trips flat + string
+        # columns only: a nested (array/struct) batch would lose its
+        # element buffers on a device->host migration, so such scans
+        # stay uncached
+        for b, _ in batches:
+            for c in b.columns:
+                if c.is_array or c.is_struct or c.is_string_array:
+                    return False
+        fw = get_spill_framework()
+        handles = [fw.track(b, persistent=True) for b, _ in batches]
+        paths = [p for _, p in batches]
+        with self._lock:
+            old = self._entries.pop(key, None)
+            victims = [old] if old is not None else []
+            if old is not None:
+                self._bytes -= old.nbytes
+            while self._bytes + total > max_bytes and self._entries:
+                k, v = self._entries.popitem(last=False)
+                self._bytes -= v.nbytes
+                victims.append(v)
+                PC.bump("hot_cache_evictions")
+            self._entries[key] = _Entry(handles, paths, total)
+            self._bytes += total
+        for v in victims:
+            for h in v.handles:
+                try:
+                    h.close()
+                except Exception:
+                    pass
+        return True
+
+    # -- maintenance ----------------------------------------------------
+    def clear(self) -> int:
+        with self._lock:
+            victims = list(self._entries.values())
+            self._entries.clear()
+            self._bytes = 0
+        n = 0
+        for v in victims:
+            for h in v.handles:
+                n += 1
+                try:
+                    h.close()
+                except Exception:
+                    pass
+        return n
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes}
+
+
+_lock = threading.Lock()
+_cache: Optional[HotTableCache] = None
+
+
+def get_hot_cache() -> HotTableCache:
+    global _cache
+    with _lock:
+        if _cache is None:
+            _cache = HotTableCache()
+        return _cache
+
+
+def peek_hot_cache() -> Optional[HotTableCache]:
+    return _cache
+
+
+def clear_hot_cache() -> int:
+    """Drop every cached table (session shutdown / tests)."""
+    c = _cache
+    return c.clear() if c is not None else 0

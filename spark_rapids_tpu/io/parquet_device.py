@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -23,6 +24,8 @@ from spark_rapids_tpu.columnar.column import (
     round_up_bucket,
 )
 from spark_rapids_tpu.io.parquet_native import (
+    CODEC_SNAPPY,
+    CODEC_UNCOMPRESSED,
     ENC_PLAIN,
     ENC_PLAIN_DICT,
     ENC_RLE_DICT,
@@ -40,9 +43,23 @@ from spark_rapids_tpu.io.parquet_native import (
 from spark_rapids_tpu.pallas.decode import (
     MAX_BIT_WIDTH,
     expand_runs,
+    expand_runs_dev,
     expand_runs_host,
     unpack_bitpacked,
+    unpack_bitpacked_dev,
 )
+from spark_rapids_tpu.pallas.decompress import (
+    TooFragmented,
+    raw_to_device,
+    snappy_to_device,
+)
+
+
+class _CompressedUnsupported(Exception):
+    """Page/chunk outside the compressed-transfer subset: the caller
+    re-decodes the CHUNK through the decoded-transfer device path
+    (``chunk_decode_fallbacks``) — correctness is identical, only the
+    link bytes differ."""
 
 _OK_TYPES = {
     TYPE_INT32: (T.IntegerType, T.DateType, T.ByteType, T.ShortType,
@@ -69,12 +86,61 @@ def expand_defined(page):
     """Definition levels -> (defined bool array, ndef) — host expansion of
     the tiny 1-bit streams (shared by numeric + string pages and the ORC
     reader's PRESENT handling)."""
+    from spark_rapids_tpu.perfcounters import count_h2d
+
     n = page.num_values
     if page.def_runs is not None:
         levels = expand_runs_host(page.def_runs, page.def_buf, n, 1)
         defined_np = levels.astype(np.bool_)
+        count_h2d(defined_np.nbytes)
         return jnp.asarray(defined_np), int(defined_np.sum())
     return jnp.ones(n, jnp.bool_), n
+
+
+def _page_dev_region(page) -> jax.Array:
+    """Ship the page's STORED bytes across the link and return the
+    decompressed region as a device uint8 array (the compressed-transfer
+    entry point).  Raises for codecs outside the device-decompressible
+    subset (zstd) or streams whose gather resolution has no transport
+    win — the chunk then falls back to the decoded-transfer path."""
+    if page.raw_values is None:
+        raise _CompressedUnsupported("no stored-page bytes recorded")
+    if page.raw_codec == CODEC_UNCOMPRESSED:
+        return raw_to_device(page.raw_values)
+    if page.raw_codec == CODEC_SNAPPY:
+        # what the decoded-transfer path would ship for this page: the
+        # value payload plus (when the levels live inside the region)
+        # the expanded definition-level bool vector
+        decoded_cost = len(page.value_buf) + (
+            page.num_values if page.def_off is not None else 0)
+        return snappy_to_device(page.raw_values, decoded_cost)
+    raise _CompressedUnsupported(
+        f"codec {page.raw_codec} has no device decompressor")
+
+
+def _expand_defined_dev(page, dev_region):
+    """Compressed-path twin of :func:`expand_defined`: the 1-bit levels
+    expand from the DEVICE-resident decompressed region (v1 pages carry
+    them inside it), so no decoded bool vector crosses the link.  The
+    defined COUNT comes from the host-parsed runs — the host already
+    holds the decompressed structure, so this costs neither a transfer
+    nor a device sync."""
+    from spark_rapids_tpu.perfcounters import count_h2d
+
+    n = page.num_values
+    if page.def_runs is None:
+        return jnp.ones(n, jnp.bool_), n
+    levels = expand_runs_host(page.def_runs, page.def_buf, n, 1)
+    ndef = int(levels.astype(np.bool_).sum())
+    if page.def_off is not None:
+        lv = expand_runs_dev(page.def_runs, dev_region, page.def_off,
+                             n, 1)
+        return lv.astype(jnp.bool_), ndef
+    # v2: levels sit uncompressed OUTSIDE the values region — host
+    # expansion, decoded bool vector on the link (counted)
+    defined_np = levels.astype(np.bool_)
+    count_h2d(defined_np.nbytes)
+    return jnp.asarray(defined_np), ndef
 
 
 def scatter_present(vals, defined, ndef, n):
@@ -101,6 +167,26 @@ def _decode_string_page(page, cp, ndict):
         raise _Unsupported(f"dictionary index width {page.index_bit_width}")
     runs = split_hybrid_runs(page.value_buf, page.index_bit_width, ndef)
     idx = expand_runs(runs, page.value_buf, ndef, page.index_bit_width)
+    idx = jnp.clip(idx.astype(jnp.int32), 0, max(ndict - 1, 0))
+    return scatter_present(idx, defined, ndef, n), defined
+
+
+def _decode_string_page_compressed(page, cp, ndict):
+    """Compressed-transfer twin of :func:`_decode_string_page`: the
+    index stream expands from the device-decompressed page region.
+    PLAIN byte_array pages stay outside the subset (their interleaved
+    lengths force the host walk) — the chunk falls back."""
+    n = page.num_values
+    if page.encoding not in (ENC_PLAIN_DICT, ENC_RLE_DICT):
+        raise _CompressedUnsupported(
+            "PLAIN byte_array page (host-walk only)")
+    if page.index_bit_width > MAX_BIT_WIDTH:
+        raise _Unsupported(f"dictionary index width {page.index_bit_width}")
+    dev = _page_dev_region(page)
+    defined, ndef = _expand_defined_dev(page, dev)
+    runs = split_hybrid_runs(page.value_buf, page.index_bit_width, ndef)
+    idx = expand_runs_dev(runs, dev, page.value_off, ndef,
+                          page.index_bit_width)
     idx = jnp.clip(idx.astype(jnp.int32), 0, max(ndict - 1, 0))
     return scatter_present(idx, defined, ndef, n), defined
 
@@ -134,6 +220,8 @@ def _decode_plain_string_page(page):
 
 def _decode_page(page, info, dt: T.DataType, dictionary):
     """One data page -> (values (n,), validity (n,)) device arrays."""
+    from spark_rapids_tpu.perfcounters import count_h2d
+
     n = page.num_values
     defined, ndef = expand_defined(page)
     sdt = T.storage_dtype(dt)
@@ -147,6 +235,7 @@ def _decode_page(page, info, dt: T.DataType, dictionary):
                                  ndef)
         idx = expand_runs(runs, page.value_buf, ndef,
                           page.index_bit_width)
+        count_h2d(dictionary.nbytes)
         dict_dev = jnp.asarray(dictionary)
         vals = dict_dev[jnp.clip(idx.astype(jnp.int32), 0,
                                  max(len(dictionary) - 1, 0))]
@@ -156,8 +245,55 @@ def _decode_page(page, info, dt: T.DataType, dictionary):
                 np.frombuffer(page.value_buf, np.uint8), 1, ndef)
         else:
             np_dt = _PLAIN_DTYPES[info.ptype]
-            vals = jnp.asarray(np.frombuffer(page.value_buf, np_dt,
-                                             count=ndef))
+            host_vals = np.frombuffer(page.value_buf, np_dt, count=ndef)
+            count_h2d(host_vals.nbytes)
+            vals = jnp.asarray(host_vals)
+    else:
+        raise _Unsupported(f"encoding {page.encoding}")
+    vals = vals.astype(sdt)
+    return scatter_present(vals, defined, ndef, n), defined
+
+
+def _decode_page_compressed(page, info, dt: T.DataType, dictionary):
+    """Compressed-transfer twin of :func:`_decode_page`: the page's
+    STORED bytes cross the link, decompress on device
+    (pallas/decompress.py), and the value stream decodes from the
+    device-resident region — bit-unpack + run expansion via the Pallas
+    kernels, PLAIN numerics via a device bitcast."""
+    from spark_rapids_tpu.perfcounters import count_h2d
+
+    n = page.num_values
+    dev = _page_dev_region(page)
+    defined, ndef = _expand_defined_dev(page, dev)
+    sdt = T.storage_dtype(dt)
+    if page.encoding in (ENC_PLAIN_DICT, ENC_RLE_DICT):
+        if dictionary is None:
+            raise _Unsupported("dictionary page missing")
+        if page.index_bit_width > MAX_BIT_WIDTH:
+            raise _Unsupported(
+                f"dictionary index width {page.index_bit_width}")
+        runs = split_hybrid_runs(page.value_buf, page.index_bit_width,
+                                 ndef)
+        idx = expand_runs_dev(runs, dev, page.value_off, ndef,
+                              page.index_bit_width)
+        count_h2d(dictionary.nbytes)
+        dict_dev = jnp.asarray(dictionary)
+        vals = dict_dev[jnp.clip(idx.astype(jnp.int32), 0,
+                                 max(len(dictionary) - 1, 0))]
+    elif page.encoding == ENC_PLAIN:
+        if info.ptype == TYPE_BOOLEAN:
+            vals = unpack_bitpacked_dev(
+                dev[page.value_off:], 1, ndef)
+        else:
+            np_dt = _PLAIN_DTYPES[info.ptype]
+            isz = np.dtype(np_dt).itemsize
+            lo = page.value_off
+            region = dev[lo:lo + ndef * isz]
+            if int(region.shape[0]) < ndef * isz:
+                raise _Unsupported("PLAIN value region short")
+            vals = jax.lax.bitcast_convert_type(
+                region.reshape(ndef, isz) if ndef else
+                region.reshape(0, isz), np_dt)
     else:
         raise _Unsupported(f"encoding {page.encoding}")
     vals = vals.astype(sdt)
@@ -175,8 +311,88 @@ def read_parquet_device(path: str, schema: T.StructType,
         return _read_parquet_device(path, schema, row_buckets)
 
 
+def _decode_string_chunk(f, cp, use_compressed: bool):
+    """One string column chunk -> (vals, valids, dicts).
+
+    dict-encoded pages share the row group's dictionary; PLAIN pages
+    (incl. parquet's dict-overflow spill) carry page-local char matrices
+    — entries appended in row order so the assembly's base offsets line
+    up."""
+    vals: List = []
+    valids: List = []
+    dicts: List = []
+    pending_dict_rows = 0
+    for page in cp.pages:
+        if page.encoding in (ENC_PLAIN_DICT, ENC_RLE_DICT):
+            if cp.dict_chars is None:
+                raise _Unsupported(
+                    f"column {cp.info.name}: dictionary page missing")
+            ndict = cp.dict_chars.shape[0]
+            if use_compressed:
+                idx, ok = _decode_string_page_compressed(page, cp, ndict)
+            else:
+                idx, ok = _decode_string_page(page, cp, ndict)
+            pending_dict_rows += page.num_values
+        elif page.encoding == ENC_PLAIN:
+            if use_compressed:
+                raise _CompressedUnsupported(
+                    "PLAIN byte_array page (host-walk only)")
+            if pending_dict_rows:
+                dicts.append((cp.dict_chars, cp.dict_lens,
+                              pending_dict_rows))
+                pending_dict_rows = 0
+            chars, lens2, idx, ok = _decode_plain_string_page(page)
+            dicts.append((chars, lens2, page.num_values))
+        else:
+            raise _Unsupported(f"byte_array encoding {page.encoding}")
+        vals.append(idx)
+        valids.append(ok)
+    if pending_dict_rows:
+        dicts.append((cp.dict_chars, cp.dict_lens, pending_dict_rows))
+    return vals, valids, dicts
+
+
+def _decode_numeric_chunk(f, info, cp, use_compressed: bool):
+    vals: List = []
+    valids: List = []
+    for page in cp.pages:
+        if use_compressed:
+            v, ok = _decode_page_compressed(page, info, f.dataType,
+                                            cp.dictionary)
+        else:
+            v, ok = _decode_page(page, info, f.dataType, cp.dictionary)
+        vals.append(v)
+        valids.append(ok)
+    return vals, valids
+
+
+def _compressed_transfer_on() -> bool:
+    from spark_rapids_tpu.config import (PARQUET_COMPRESSED_TRANSFER,
+                                         get_conf)
+
+    return bool(get_conf().get(PARQUET_COMPRESSED_TRANSFER))
+
+
+def _chunk_compressed_eligible(cp, is_string: bool) -> bool:
+    """Metadata pre-pass: every page of the chunk must sit inside the
+    compressed-transfer subset BEFORE any bytes ship — a mid-chunk
+    unsupported page discovered after uploading its predecessors would
+    pay the link twice (once compressed, once decoded on the retry)."""
+    for page in cp.pages:
+        if page.raw_values is None:
+            return False
+        if page.raw_codec not in (CODEC_UNCOMPRESSED, CODEC_SNAPPY):
+            return False
+        if is_string and page.encoding not in (ENC_PLAIN_DICT,
+                                               ENC_RLE_DICT):
+            return False
+    return True
+
+
 def _read_parquet_device(path: str, schema: T.StructType,
                          row_buckets=DEFAULT_ROW_BUCKETS) -> ColumnarBatch:
+    from spark_rapids_tpu import perfcounters as PC
+
     with open(path, "rb") as f:
         data = f.read()
     groups, names = read_footer(data)
@@ -186,6 +402,7 @@ def _read_parquet_device(path: str, schema: T.StructType,
             raise _Unsupported(f"column {w} missing from file")
     total = sum(g.num_rows for g in groups)
     cap = round_up_bucket(max(total, 1), row_buckets)
+    compressed = _compressed_transfer_on()
     per_field_vals: List[List] = [[] for _ in wanted]
     per_field_valid: List[List] = [[] for _ in wanted]
     # string columns: dict char matrices per (field, row-group)
@@ -198,44 +415,36 @@ def _read_parquet_device(path: str, schema: T.StructType,
                 raise _Unsupported(f"column {f.name} missing in row group")
             _check_field(info, f.dataType)
             cp = read_column_pages(data, info, g.num_rows)
-            if isinstance(f.dataType, T.StringType):
-                # dict-encoded pages share the row group's dictionary;
-                # PLAIN pages (incl. parquet's dict-overflow spill) carry
-                # page-local char matrices — entries appended in row
-                # order so the assembly's base offsets line up
-                pending_dict_rows = 0
-                for page in cp.pages:
-                    if page.encoding in (ENC_PLAIN_DICT, ENC_RLE_DICT):
-                        if cp.dict_chars is None:
-                            raise _Unsupported(
-                                f"column {f.name}: dictionary page "
-                                f"missing")
-                        ndict = cp.dict_chars.shape[0]
-                        idx, ok = _decode_string_page(page, cp, ndict)
-                        pending_dict_rows += page.num_values
-                    elif page.encoding == ENC_PLAIN:
-                        if pending_dict_rows:
-                            per_field_dicts[fi].append(
-                                (cp.dict_chars, cp.dict_lens,
-                                 pending_dict_rows))
-                            pending_dict_rows = 0
-                        chars, lens2, idx, ok = \
-                            _decode_plain_string_page(page)
-                        per_field_dicts[fi].append(
-                            (chars, lens2, page.num_values))
+            # compressed transfer first, falling back PER CHUNK to the
+            # decoded-transfer path when any page sits outside the
+            # device-decompressible subset (zstd, PLAIN byte_array,
+            # no-transport-win streams) — same bits, heavier link.
+            # Statically-knowable ineligibility (codec/encoding) is
+            # decided from the page headers before any bytes ship; the
+            # try/except handles the data-dependent cases
+            # (no-transport-win snappy streams)
+            is_str = isinstance(f.dataType, T.StringType)
+            use_comp = compressed and _chunk_compressed_eligible(
+                cp, is_str)
+            if compressed and not use_comp:
+                PC.bump("chunk_decode_fallbacks")
+            while True:
+                try:
+                    if isinstance(f.dataType, T.StringType):
+                        vals, valids, dicts = _decode_string_chunk(
+                            f, cp, use_comp)
+                        per_field_dicts[fi].extend(dicts)
                     else:
-                        raise _Unsupported(
-                            f"byte_array encoding {page.encoding}")
-                    per_field_vals[fi].append(idx)
-                    per_field_valid[fi].append(ok)
-                if pending_dict_rows:
-                    per_field_dicts[fi].append(
-                        (cp.dict_chars, cp.dict_lens, pending_dict_rows))
-                continue
-            for page in cp.pages:
-                v, ok = _decode_page(page, info, f.dataType, cp.dictionary)
-                per_field_vals[fi].append(v)
-                per_field_valid[fi].append(ok)
+                        vals, valids = _decode_numeric_chunk(
+                            f, info, cp, use_comp)
+                    break
+                except (_CompressedUnsupported, TooFragmented):
+                    if not use_comp:
+                        raise
+                    use_comp = False
+                    PC.bump("chunk_decode_fallbacks")
+            per_field_vals[fi].extend(vals)
+            per_field_valid[fi].extend(valids)
     cols = []
     for fi, f in enumerate(schema.fields):
         vals = jnp.concatenate(per_field_vals[fi]) \
@@ -260,6 +469,8 @@ def _assemble_string_col(dt, dicts, idx, valid_arr, cap):
     from spark_rapids_tpu.columnar.column import (DEFAULT_WIDTH_BUCKETS,
                                                   round_up_bucket)
 
+    from spark_rapids_tpu.perfcounters import count_h2d
+
     w = round_up_bucket(
         max(max(d[0].shape[1] for d in dicts), 1), DEFAULT_WIDTH_BUCKETS)
     parts = []
@@ -273,14 +484,18 @@ def _assemble_string_col(dt, dicts, idx, valid_arr, cap):
         lens.append(ln)
         bases.append((base, nrows))
         base += chars.shape[0]
-    all_chars = jnp.asarray(np.concatenate(parts, axis=0))
-    all_lens = jnp.asarray(np.concatenate(lens))
+    chars_np = np.concatenate(parts, axis=0)
+    lens_np = np.concatenate(lens)
+    count_h2d(chars_np.nbytes + lens_np.nbytes)
+    all_chars = jnp.asarray(chars_np)
+    all_lens = jnp.asarray(lens_np)
     # offset each row group's indices into the stacked dictionary
     offs = np.zeros(int(idx.shape[0]), np.int32)
     pos = 0
     for b, nrows in bases:
         offs[pos:pos + nrows] = b
         pos += nrows
+    count_h2d(4 * int(idx.shape[0]))
     gidx = idx + jnp.asarray(offs[: int(idx.shape[0])])
     full_idx = jnp.zeros(cap, jnp.int32).at[: gidx.shape[0]].set(gidx)
     chars = all_chars[full_idx]

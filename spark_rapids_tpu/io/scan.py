@@ -7,10 +7,24 @@ and row-group pruning with predicate pushdown, then device decode.
 TPU adaptation: the host decode stage uses pyarrow (footer parse, row-group
 pruning, predicate pushdown, dictionary/RLE decode) on background threads —
 playing the role of the reference's host-side fetch+filter threads — and the
-"device decode" step is the host->HBM upload into padded columns.  A Pallas
-on-device Parquet decode (dictionary/RLE/bit-pack) is the planned follow-up,
-mirroring how the reference moved decode from host to cuDF kernels
-(BASELINE north-star note in SURVEY.md §2.10 item 9).
+"device decode" step is the host->HBM upload into padded columns.  The
+Pallas on-device Parquet decode (io/parquet_device.py) replaces that upload
+with a COMPRESSED-page transfer where eligible (decompress + decode at HBM
+bandwidth; ``spark.rapids.sql.format.parquet.transfer.compressed``),
+mirroring how the reference moved decode from host to cuDF kernels.
+
+Transport-aware pipeline (ISSUE 6):
+
+  * an async double-buffered H2D prefetch ring
+    (``spark.rapids.tpu.scan.prefetch.depth``) overlaps the upload of
+    batch N+1 with query compute on batch N for the COALESCING and
+    MULTITHREADED modes — ``bytes_h2d_overlapped`` / ``prefetch_stall_ns``
+    and the ``scan_prefetch`` diagnostics event expose the overlap;
+  * a device-resident hot-table cache
+    (``spark.rapids.tpu.scan.hotTableCache.enabled``, io/hot_cache.py)
+    lets a repeated query over an unchanged table skip the
+    read+decode+transfer entirely (spill-integrated, dropped at session
+    close).
 
 Reader mode selection matches the reference:
   * PERFILE       — one file at a time, simple.
@@ -34,10 +48,13 @@ entry that routes the whole scan to the native decoder at plan time.
 """
 from __future__ import annotations
 
+import collections
 import concurrent.futures as cf
+import time
 from struct import error as struct_error
 from typing import Iterator, List, Optional
 
+from spark_rapids_tpu import perfcounters as PC
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar.column import HostColumn
@@ -46,6 +63,9 @@ from spark_rapids_tpu.config import (
     PARQUET_DEVICE_DECODE,
     PARQUET_MULTITHREAD_READ_NUM_THREADS,
     PARQUET_READER_TYPE,
+    SCAN_HOT_CACHE,
+    SCAN_HOT_CACHE_MAX_BYTES,
+    SCAN_PREFETCH_DEPTH,
     TpuConf,
 )
 from spark_rapids_tpu.exec.base import TpuExec
@@ -337,7 +357,13 @@ class TpuFileSourceScanExec(TpuExec):
         with self.metric("gpuDecodeTime").timed():  # name kept for parity
             cols = self._table_to_host_cols(tbl)
             names = self.plan.output.field_names()
-            return ColumnarBatch.from_host_columns(cols, names)
+            # transfer-wall attribution (ISSUE 6 satellite): time the
+            # pad+device_put only — the arrow->HostColumn conversion
+            # above is host decode, not link time
+            t0 = time.perf_counter_ns()
+            out = ColumnarBatch.from_host_columns(cols, names)
+            PC.bump("scan_transfer_ns", time.perf_counter_ns() - t0)
+            return out
 
     # -- modes ----------------------------------------------------------
     @staticmethod
@@ -352,6 +378,16 @@ class TpuFileSourceScanExec(TpuExec):
         CURRENT_INPUT_FILE[0] = path
         return batch
 
+    # -- hot-table cache (ISSUE 6) --------------------------------------
+    def _hot_cache_key(self) -> Optional[str]:
+        from spark_rapids_tpu.io.hot_cache import HotTableCache
+
+        return HotTableCache.scan_key(
+            self.plan.fmt, self.plan.paths,
+            [f.name for f in self.plan.output.fields],
+            repr(_filters_to_arrow(self.plan.pushed_filters)),
+            self.plan.options, self.max_rows)
+
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
         mode = self._mode()
         tol = IOF.scan_tolerance(self.conf)
@@ -359,17 +395,55 @@ class TpuFileSourceScanExec(TpuExec):
         # be re-consulted and re-logged for every one of N files)
         dev_blocked = (self._device_decode_conf_on()
                        and self._decode_breaker_open())
+        cache = key = None
+        collected: Optional[list] = None
+        cacheable = [True]
+        if self.conf.get(SCAN_HOT_CACHE):
+            from spark_rapids_tpu.io.hot_cache import get_hot_cache
+
+            key = self._hot_cache_key()
+            if key is not None:
+                cache = get_hot_cache()
+                hit = cache.get(key)
+                if hit is not None:
+                    PC.bump("hot_cache_hits")
+                    for b, p in hit:
+                        yield self._stamp(self._count_output(b), p)
+                    return
+                PC.bump("hot_cache_misses")
+                collected = []
+
+        def note_skip():
+            # a tolerated-away file means this scan's output is a
+            # conf-dependent SUBSET of the key's file set — never cache
+            cacheable[0] = False
+
+        for b, p in self._execute_scan(mode, tol, dev_blocked,
+                                       note_skip):
+            if collected is not None:
+                collected.append((b, p))
+            yield self._stamp(self._count_output(b), p)
+        # reached ONLY on full completion: an abandoned generator
+        # (limit) or an escaping fault must not publish partial output
+        if cache is not None and collected is not None and cacheable[0]:
+            cache.put(key, collected,
+                      int(self.conf.get(SCAN_HOT_CACHE_MAX_BYTES)))
+
+    def _execute_scan(self, mode: str, tol: IOF.ScanTolerance,
+                      dev_blocked: bool, note_skip):
+        """Per-mode read pipeline yielding (batch, source-path) pairs
+        (stamping/caching happen in execute_columnar)."""
         if mode == "PERFILE":
             for i, p in enumerate(self.plan.paths):
                 dev = self._try_device_decode(p, i, dev_blocked)
                 if dev is not None:
-                    yield self._stamp(self._count_output(dev), p)
+                    yield dev, p
                     continue
                 tbl = self._host_table_or_skip(p, i, mode, tol)
                 if tbl is None:
+                    note_skip()
                     continue
-                yield self._stamp(self._count_output(
-                    self._upload(tbl)), p)
+                yield self._upload(tbl), p
         elif mode == "COALESCING":
             import pyarrow as pa
 
@@ -377,7 +451,7 @@ class TpuFileSourceScanExec(TpuExec):
             for i, p in enumerate(self.plan.paths):
                 dev = self._try_device_decode(p, i, dev_blocked)
                 if dev is not None:
-                    yield self._stamp(self._count_output(dev), p)
+                    yield dev, p
                 else:
                     host_paths.append((i, p))
             # the batch stitch re-drives the SURVIVING file set: a
@@ -390,13 +464,18 @@ class TpuFileSourceScanExec(TpuExec):
                 if tbl is not None:
                     tbls.append(tbl)
                     surviving.append(p)
+                else:
+                    note_skip()
             if not tbls:
                 return
             tbl = pa.concat_tables(tbls)
             one = surviving[0] if len(surviving) == 1 else ""
-            for chunk in self._row_chunks(tbl):
-                yield self._stamp(
-                    self._count_output(self._upload(chunk)), one)
+
+            def jobs():
+                for chunk in self._row_chunks(tbl):
+                    yield (lambda ch=chunk: [(self._upload(ch), one)])
+
+            yield from self._prefetched(jobs())
         else:  # MULTITHREADED
             with cf.ThreadPoolExecutor(self.num_threads) as pool:
                 # device decode is a single-threaded device pipeline; host
@@ -405,22 +484,100 @@ class TpuFileSourceScanExec(TpuExec):
                 for i, p in enumerate(self.plan.paths):
                     dev = self._try_device_decode(p, i, dev_blocked)
                     if dev is not None:
-                        yield self._stamp(self._count_output(dev), p)
+                        yield dev, p
                     else:
                         host_futs.append(
                             (i, p,
                              pool.submit(self._read_host_checked,
                                          p, i, mode)))
-                for i, p, fut in host_futs:
-                    # the pyarrow struct_error that named no file now
-                    # does: the wrap happened on the pool thread, the
-                    # tolerate/raise decision happens here
-                    tbl = self._table_or_skip(fut.result, p, mode, tol)
-                    if tbl is None:
-                        continue
-                    for chunk in self._row_chunks(tbl):
-                        yield self._stamp(self._count_output(
-                            self._upload(chunk)), p)
+
+                def jobs():
+                    for i, p, fut in host_futs:
+                        # the pyarrow struct_error that named no file
+                        # now does: the wrap happened on the pool
+                        # thread, the tolerate/raise decision happens
+                        # here.  ONE upload job per CHUNK — a per-file
+                        # job would materialize whole files in HBM and
+                        # defeat the bounded ring
+                        tbl = self._table_or_skip(fut.result, p, mode,
+                                                  tol)
+                        if tbl is None:
+                            note_skip()
+                            continue
+                        for chunk in self._row_chunks(tbl):
+                            yield (lambda ch=chunk, pp=p:
+                                   [(self._upload(ch), pp)])
+
+                yield from self._prefetched(jobs())
+
+    # -- async H2D prefetch ring (ISSUE 6) ------------------------------
+    def _prefetched(self, jobs):
+        """Bounded staging ring: run up to ``prefetch.depth`` upload
+        jobs ahead on a staging thread so the transfer of batch N+1
+        overlaps the query's compute on batch N.  Each job returns a
+        list of (batch, path) pairs.  CancelToken-aware: the consumer
+        wait polls the query's cooperative cancel; overlap efficiency
+        lands in ``bytes_h2d_overlapped`` / ``prefetch_stall_ns`` and a
+        ``scan_prefetch`` diagnostics event."""
+        depth = int(self.conf.get(SCAN_PREFETCH_DEPTH))
+        if depth <= 0:
+            for job in jobs:
+                yield from job()
+            return
+        from spark_rapids_tpu.diagnostics import context as DIAG_CTX
+        from spark_rapids_tpu.lifecycle import check_cancel
+
+        stats = {"batches": 0, "overlapped_bytes": 0, "stall_ns": 0}
+        ring: collections.deque = collections.deque()
+        pool = cf.ThreadPoolExecutor(
+            1, thread_name_prefix="srt-scan-prefetch")
+        jobs_it = iter(jobs)
+
+        def fill():
+            while len(ring) < depth:
+                try:
+                    job = next(jobs_it)
+                except StopIteration:
+                    return
+                ring.append(pool.submit(job))
+
+        try:
+            fill()
+            while ring:
+                fut = ring.popleft()
+                fill()
+                overlapped = fut.done()
+                if not overlapped:
+                    t0 = time.perf_counter_ns()
+                    while True:
+                        check_cancel()
+                        try:
+                            items = fut.result(timeout=0.05)
+                            break
+                        except cf.TimeoutError:
+                            continue
+                    stall = time.perf_counter_ns() - t0
+                    PC.bump("prefetch_stall_ns", stall)
+                    stats["stall_ns"] += stall
+                else:
+                    items = fut.result()
+                for b, p in items:
+                    stats["batches"] += 1
+                    if overlapped:
+                        nb = b.nbytes()
+                        PC.bump("bytes_h2d_overlapped", nb)
+                        stats["overlapped_bytes"] += nb
+                    yield b, p
+                fill()
+        finally:
+            for f in ring:
+                f.cancel()
+            pool.shutdown(wait=True)
+            rec = DIAG_CTX.RECORDER
+            if rec is not None:
+                rec.scan_prefetch(depth, stats["batches"],
+                                  stats["overlapped_bytes"],
+                                  stats["stall_ns"])
 
     def _row_chunks(self, tbl):
         n = tbl.num_rows

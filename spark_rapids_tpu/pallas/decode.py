@@ -59,6 +59,9 @@ def _use_real_pallas() -> bool:
 _LANES = 128
 
 
+_UNPACK_JITS: dict = {}
+
+
 def _unpack_call(padded: jax.Array, bw: int, groups: int) -> jax.Array:
     from jax.experimental import pallas as pl
 
@@ -85,15 +88,24 @@ def _unpack_call(padded: jax.Array, bw: int, groups: int) -> jax.Array:
         mat = jnp.zeros((pad_groups, _LANES), jnp.uint32)
         mat = mat.at[:groups, :bw].set(
             padded.reshape(groups, bw).astype(jnp.uint32))
-        fn = pl.pallas_call(
-            partial(_unpack_body, bw=bw),
-            out_shape=jax.ShapeDtypeStruct((pad_groups, _LANES),
-                                           jnp.uint32),
-            grid=(tiles,),
-            in_specs=[pl.BlockSpec((_TILE, _LANES), lambda i: (i, 0))],
-            out_specs=pl.BlockSpec((_TILE, _LANES), lambda i: (i, 0)),
-            interpret=not _use_real_pallas(),
-        )
+        # one JITTED program per (tiles, bw) bucket: the bare pallas_call
+        # re-traced (and in interpret mode re-interpreted) on EVERY run
+        # of every page — a multi-run page paid seconds of pure Python
+        # re-tracing per scan (ISSUE 6: the scan path is now hot enough
+        # to see it)
+        fn = _UNPACK_JITS.get((tiles, bw))
+        if fn is None:
+            from spark_rapids_tpu.perfcounters import tpu_jit
+
+            fn = _UNPACK_JITS[(tiles, bw)] = tpu_jit(pl.pallas_call(
+                partial(_unpack_body, bw=bw),
+                out_shape=jax.ShapeDtypeStruct((pad_groups, _LANES),
+                                               jnp.uint32),
+                grid=(tiles,),
+                in_specs=[pl.BlockSpec((_TILE, _LANES), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((_TILE, _LANES), lambda i: (i, 0)),
+                interpret=not _use_real_pallas(),
+            ))
         return fn(mat)[:, :8]
 
 
@@ -106,7 +118,29 @@ def unpack_bitpacked(payload: np.ndarray, bw: int,
     need = groups * bw
     buf = np.zeros(need, np.uint8)
     buf[:min(len(payload), need)] = payload[:need]
+    from spark_rapids_tpu.perfcounters import count_h2d
+
+    count_h2d(buf.nbytes)
     out = _unpack_call(jnp.asarray(buf), bw, groups)
+    return out.reshape(-1)[:count]
+
+
+def unpack_bitpacked_dev(payload: jax.Array, bw: int,
+                         count: int) -> jax.Array:
+    """Device-resident twin of :func:`unpack_bitpacked`: the payload is
+    already in HBM (the compressed-transfer path decompressed it there),
+    so no bytes cross the link here."""
+    if bw == 0:
+        return jnp.zeros(count, jnp.uint32)
+    groups = (count + 7) // 8
+    need = groups * bw
+    n = int(payload.shape[0])
+    if n < need:
+        payload = jnp.concatenate(
+            [payload, jnp.zeros(need - n, jnp.uint8)])
+    elif n > need:
+        payload = payload[:need]
+    out = _unpack_call(payload, bw, groups)
     return out.reshape(-1)[:count]
 
 
@@ -124,7 +158,12 @@ def expand_runs_host(runs, buf: bytes, total: int,
         if r.is_packed:
             payload = np.frombuffer(buf, np.uint8, count=r.nbytes,
                                     offset=r.byte_off)
-            if bw == 1:
+            if bw == 0:
+                # bw=0 (all-dictionary single-entry stream): zero-width
+                # packed values are all index 0 — mirror the device
+                # path's uint32 zeros instead of dividing by zero below
+                vals = np.zeros(take, np.uint32)
+            elif bw == 1:
                 vals = np.unpackbits(payload, bitorder="little")[:take]
             else:
                 bits = np.unpackbits(payload, bitorder="little")
@@ -155,6 +194,34 @@ def expand_runs(runs, buf: bytes, total: int, bw: int) -> jax.Array:
             payload = np.frombuffer(buf, np.uint8, count=r.nbytes,
                                     offset=r.byte_off)
             parts.append(unpack_bitpacked(payload, bw, take))
+        else:
+            parts.append(jnp.full(take, np.uint32(r.value), jnp.uint32))
+        got += take
+    if not parts:
+        return jnp.zeros(total, jnp.uint32)
+    out = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+    if out.shape[0] < total:
+        out = jnp.concatenate(
+            [out, jnp.zeros(total - out.shape[0], jnp.uint32)])
+    return out[:total]
+
+
+def expand_runs_dev(runs, dev_buf: jax.Array, base_off: int, total: int,
+                    bw: int) -> jax.Array:
+    """Device-resident twin of :func:`expand_runs`: payload bytes live in
+    ``dev_buf`` (a device-decompressed page region) at ``base_off`` plus
+    each run's host-parsed ``byte_off`` — no link bytes, the expansion
+    consumes HBM-resident slices directly."""
+    parts: List[jax.Array] = []
+    got = 0
+    for r in runs:
+        take = min(r.count, total - got)
+        if take <= 0:
+            break
+        if r.is_packed:
+            lo = base_off + r.byte_off
+            parts.append(unpack_bitpacked_dev(
+                dev_buf[lo:lo + r.nbytes], bw, take))
         else:
             parts.append(jnp.full(take, np.uint32(r.value), jnp.uint32))
         got += take

@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 
@@ -73,6 +73,23 @@ COUNTERS: Dict[str, int] = {
     "queries_cancelled": 0,
     "deadline_trips": 0,
     "admission_wait_ns": 0,
+    # transport-aware scan pipeline (ISSUE 6): bytes_h2d counts PHYSICAL
+    # link bytes (compressed payloads count their compressed size);
+    # bytes_h2d_logical counts the decoded/useful bytes those transfers
+    # represent — the ratio is the transport win
+    "bytes_h2d_logical": 0,
+    "scan_transfer_ns": 0,        # wall inside scan H2D upload sites
+    "pages_device_decompressed": 0,
+    "chunk_decode_fallbacks": 0,  # compressed->decoded per-chunk falls
+    # H2D prefetch ring (io/scan.py): bytes whose transfer fully
+    # overlapped query compute, and wall the consumer stalled waiting on
+    # an in-flight prefetch
+    "bytes_h2d_overlapped": 0,
+    "prefetch_stall_ns": 0,
+    # device-resident hot-table cache (io/hot_cache.py)
+    "hot_cache_hits": 0,
+    "hot_cache_misses": 0,
+    "hot_cache_evictions": 0,
 }
 
 # One-release read/write compat for the pre-normalization camelCase keys
@@ -250,9 +267,15 @@ def _install_sync_counters() -> bool:
 SYNC_COUNTING = _install_sync_counters()
 
 
-def count_h2d(nbytes: int) -> None:
-    """Host->device transfer accounting (called from upload sites)."""
+def count_h2d(nbytes: int, logical: Optional[int] = None) -> None:
+    """Host->device transfer accounting (called from upload sites).
+
+    ``nbytes`` is the PHYSICAL byte count crossing the link (for a
+    compressed-transfer payload: the compressed size + descriptor
+    arrays); ``logical`` is the decoded/useful size those bytes
+    represent (defaults to ``nbytes`` for plain uploads)."""
     bump("bytes_h2d", int(nbytes))
+    bump("bytes_h2d_logical", int(nbytes if logical is None else logical))
 
 
 _tls = threading.local()

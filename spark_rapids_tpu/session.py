@@ -143,18 +143,29 @@ class TpuSession:
     def shuffle_partitions(self) -> int:
         return self.conf.get(SHUFFLE_PARTITIONS)
 
-    def close(self, check_leaks: bool = True) -> List[str]:
+    def close(self, check_leaks: bool = True,
+              drop_hot_cache: bool = True) -> List[str]:
         """Session shutdown (ISSUE 4 satellite): report — and then
         release — anything still held across the process singletons:
         unclosed non-persistent spillables, semaphore permits, live
         shuffle registrations.  Returns the leak report (empty for a
         well-behaved session); with spark.rapids.memory.debug the
         entries carry allocation stacks."""
+        from spark_rapids_tpu.io.hot_cache import clear_hot_cache
         from spark_rapids_tpu.lifecycle import (
             leak_report_all,
             reset_leaked_state,
         )
 
+        # hot-table cache entries are INTENTIONAL persistent spillables
+        # while the process serves queries; like everything else this
+        # method touches, the cache is a PROCESS singleton — shutdown
+        # drops it so the leak report below (and the conftest session
+        # gate) sees a clean framework.  A deployment closing one of
+        # several live sessions passes drop_hot_cache=False to keep the
+        # other sessions' warm tables.
+        if drop_hot_cache:
+            clear_hot_cache()
         leaks = leak_report_all() if check_leaks else []
         reset_leaked_state()
         return leaks

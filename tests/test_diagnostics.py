@@ -75,6 +75,7 @@ GOLDEN_SCHEMA = {
     "resilience": ["kind", "op_name", "detail"],
     "lifecycle": ["kind", "detail", "dur_ns"],
     "io_fault": ["kind", "path", "fmt", "detail"],
+    "scan_prefetch": ["depth", "batches", "overlapped_bytes", "stall_ns"],
     "op_batch": ["path", "batch", "rows", "dur_ns"],
     "operator": ["path", "name", "describe", "wall_ns", "self_wall_ns",
                  "batches", "rows", "counters", "metrics", "fallback"],

@@ -28,3 +28,21 @@ def test_stress_sweep(timeout_ms):
     assert not s["leaks"], s["leaks"]
     assert s["queries"] == 24
     assert s["ok"] + s["cancelled"] == 24
+
+
+def test_hot_cache_trace_replay():
+    """``run_stress.py --hot-cache`` engine (ISSUE 6): 8 workers replay
+    the same parquet table concurrently — every warm replay must be a
+    cache hit moving zero H2D bytes, with nothing leaked after the
+    cache drops at session close.  Small enough for tier-1; the CLI
+    runs the bigger soak."""
+    from spark_rapids_tpu.io.hot_cache import clear_hot_cache
+
+    from run_stress import run_hot_cache
+
+    clear_hot_cache()
+    s = run_hot_cache(n_threads=8, rounds=2, rows=30_000, quiet=True)
+    assert not s["failures"], s["failures"]
+    assert not s["leaks"], s["leaks"]
+    assert s["hot_cache_hits"] == 16
+    assert s["bytes_h2d"] == 0

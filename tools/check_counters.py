@@ -144,6 +144,40 @@ def check() -> list:
     if "io_fault" not in EVENT_SCHEMA:
         problems.append("diagnostics event type 'io_fault' is not "
                         "registered in EVENT_SCHEMA")
+
+    # transport-aware scan pipeline (ISSUE 6): confs + counters must be
+    # documented in docs/scan_pipeline.md (and confs in configs.md)
+    scan_md = read("scan_pipeline.md")
+    scan_confs = [k for k in _REGISTRY
+                  if k.startswith(("spark.rapids.tpu.scan.",
+                                   "spark.rapids.sql.format.parquet."
+                                   "transfer."))]
+    if not scan_confs:
+        problems.append("no scan-pipeline confs registered")
+    for key in sorted(scan_confs):
+        if f"`{key}`" not in scan_md:
+            problems.append(
+                f"conf '{key}' is not documented in "
+                f"docs/scan_pipeline.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+    for key in ("bytes_h2d_logical", "scan_transfer_ns",
+                "pages_device_decompressed", "chunk_decode_fallbacks",
+                "bytes_h2d_overlapped", "prefetch_stall_ns",
+                "hot_cache_hits", "hot_cache_misses",
+                "hot_cache_evictions"):
+        if key not in PC.COUNTERS:
+            problems.append(f"scan counter '{key}' is not registered "
+                            f"in perfcounters.COUNTERS")
+        if f"`{key}`" not in scan_md:
+            problems.append(
+                f"scan counter '{key}' is not documented in "
+                f"docs/scan_pipeline.md")
+    if "scan_prefetch" not in EVENT_SCHEMA:
+        problems.append("diagnostics event type 'scan_prefetch' is not "
+                        "registered in EVENT_SCHEMA")
     return problems
 
 

@@ -22,6 +22,11 @@ registrations) must be empty.  Exit code 0 iff both hold.
 CPU-only (same virtual-device setup as the tier-1 suite); the
 ``stress``-marked pytest in tests/test_stress_harness.py runs the same
 engine at a smaller size.
+
+``--hot-cache`` (ISSUE 6) switches to a repeated-query trace: every
+worker replays the SAME parquet table scan through the device-resident
+hot-table cache — all warm replays must hit the cache (zero H2D bytes)
+and leave no device buffers behind at session close.
 """
 from __future__ import annotations
 
@@ -203,6 +208,112 @@ def run_stress(n_threads: int = 8, rounds: int = 3, seed: int = 7,
     return summary
 
 
+def run_hot_cache(n_threads: int = 8, rounds: int = 3,
+                  rows: int = 60_000, quiet: bool = False) -> dict:
+    """``--hot-cache`` mode (ISSUE 6): a repeated-query trace — every
+    worker replays the SAME parquet table scan+aggregate — with the
+    device-resident hot-table cache on.  After one warm run, all
+    ``threads x rounds`` replays must (a) match the CPU oracle, (b) move
+    ZERO H2D bytes (the cache serves every scan), and (c) leave no
+    device buffers behind once the cache is dropped at session close."""
+    import json
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu.io.hot_cache import clear_hot_cache
+    from spark_rapids_tpu.lifecycle import leak_report_all
+    from spark_rapids_tpu.session import TpuSession, sum_
+
+    tmp = tempfile.mkdtemp(prefix="srt_hot_cache_stress_")
+    failures: list = []
+    try:
+        rng = np.random.default_rng(13)
+        paths = []
+        for i in range(3):
+            tbl = pa.table({
+                "k": rng.integers(0, 16, rows // 3).astype(np.int64),
+                "v": rng.integers(0, 10**6, rows // 3).astype(np.int64),
+            })
+            p = os.path.join(tmp, f"part-{i}.parquet")
+            pq.write_table(tbl, p, compression="snappy")
+            paths.append(p)
+
+        conf = {
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.tpu.scan.hotTableCache.enabled": True,
+            "spark.rapids.tpu.concurrentQueries": "4",
+        }
+
+        def q(s):
+            return (s.read.parquet(*paths).group_by("k")
+                    .agg(sum_("v", "sv")))
+
+        oracle = sorted(
+            q(TpuSession({"spark.rapids.sql.enabled": False})).collect())
+        warm_s = TpuSession(conf)
+        assert sorted(q(warm_s).collect()) == oracle, "warm run diverged"
+
+        snap = PC.snapshot()
+        t0 = time.monotonic()
+
+        def worker(wid: int):
+            s = TpuSession(conf)
+            for r in range(rounds):
+                try:
+                    rows_got = sorted(q(s).collect())
+                    if rows_got != oracle:
+                        failures.append(
+                            f"worker {wid} round {r}: diverged")
+                except Exception as e:   # noqa: BLE001
+                    failures.append(
+                        f"worker {wid} round {r}: "
+                        f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        wall_s = time.monotonic() - t0
+        d = PC.since(snap)
+        if d["bytes_h2d"] != 0:
+            failures.append(
+                f"cached replays moved {d['bytes_h2d']} H2D bytes "
+                f"(expected 0)")
+        want_hits = n_threads * rounds
+        if d["hot_cache_hits"] != want_hits:
+            failures.append(
+                f"hot_cache_hits {d['hot_cache_hits']} != {want_hits}")
+        warm_s.close(check_leaks=False)
+        leaks = leak_report_all()
+        from spark_rapids_tpu.memory.spill import peek_spill_framework
+
+        fw = peek_spill_framework()
+        if fw is not None and fw.leak_report(include_persistent=True):
+            leaks = leaks + fw.leak_report(include_persistent=True)
+        summary = {
+            "mode": "hot-cache",
+            "threads": n_threads, "rounds": rounds, "rows": rows,
+            "wall_s": round(wall_s, 2),
+            "hot_cache_hits": d["hot_cache_hits"],
+            "bytes_h2d": d["bytes_h2d"],
+            "failures": failures,
+            "leaks": leaks,
+        }
+        if not quiet:
+            print(json.dumps(summary, indent=2))
+        return summary
+    finally:
+        clear_hot_cache()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--threads", type=int, default=8)
@@ -210,7 +321,17 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--cancels", type=int, default=4)
     ap.add_argument("--timeout-ms", type=int, default=0)
+    ap.add_argument("--hot-cache", action="store_true",
+                    help="repeated-query hot-table-cache trace instead "
+                         "of the mixed chaos sweep")
     args = ap.parse_args()
+    if args.hot_cache:
+        s = run_hot_cache(args.threads, args.rounds)
+        ok = not s["failures"] and not s["leaks"]
+        print(("PASS" if ok else "FAIL")
+              + f": {s['hot_cache_hits']} cached replays, "
+              f"{s['bytes_h2d']} H2D bytes in {s['wall_s']}s")
+        return 0 if ok else 1
     s = run_stress(args.threads, args.rounds, args.seed, args.cancels,
                    args.timeout_ms)
     ok = not s["failures"] and not s["leaks"]
