@@ -511,13 +511,50 @@ def main():
     # the single-stream suite
     import sys
 
+    # --gate BASELINE.json (ISSUE 7 satellite): after emitting, diff the
+    # payload against the baseline with tools/bench_gate.py and exit
+    # non-zero on a regression — a bench sweep IS the regression check
+    gate_path = None
+    if "--gate" in sys.argv:
+        gidx = sys.argv.index("--gate")
+        if gidx + 1 >= len(sys.argv):
+            # a silently-disarmed gate is a false PASS: fail loudly like
+            # an unreadable baseline does
+            print("bench gate: --gate requires a BASELINE.json operand",
+                  file=sys.stderr)
+            return 1
+        gate_path = sys.argv[gidx + 1]
+
+    def run_gate(payload) -> int:
+        if not gate_path:
+            return 0
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import bench_gate
+
+        try:
+            base = bench_gate.load(gate_path)
+        except (OSError, ValueError) as e:
+            print(f"bench gate: cannot load baseline {gate_path}: {e}",
+                  file=sys.stderr)
+            return 1
+        regressions = bench_gate.gate(base, payload)
+        for r in regressions:
+            print(f"REGRESSION: {r}", file=sys.stderr)
+        print("bench gate vs " + gate_path + ": "
+              + ("PASS" if not regressions
+                 else f"FAIL ({len(regressions)} regression(s))"),
+              file=sys.stderr)
+        return 1 if regressions else 0
+
     if "--concurrency" in sys.argv:
         idx = sys.argv.index("--concurrency")
         n_workers = int(sys.argv[idx + 1]) if idx + 1 < len(sys.argv) else 4
-        run_concurrency(n_workers,
-                        rounds=int(os.environ.get("BENCH_CONC_ROUNDS", 3)),
-                        rows=int(os.environ.get("BENCH_CONC_ROWS", 200_000)))
-        return
+        out = run_concurrency(
+            n_workers,
+            rounds=int(os.environ.get("BENCH_CONC_ROUNDS", 3)),
+            rows=int(os.environ.get("BENCH_CONC_ROWS", 200_000)))
+        return run_gate(out)
     n = int(os.environ.get("BENCH_ROWS", 20_000_000))
     n_q6 = int(os.environ.get("BENCH_Q6_ROWS",
                               50_000_000 if n >= 10_000_000 else n))
@@ -558,7 +595,7 @@ def main():
         else {"spark.rapids.tpu.compileCache.dir": cache_env}))
     queries = {}
 
-    emitted = {"done": False}
+    emitted = {"done": False, "rc": 0}
 
     def over_budget():
         return time.perf_counter() - t_start > budget
@@ -568,6 +605,25 @@ def main():
 
         print(f"[bench {time.perf_counter() - t_start:7.1f}s] {msg}",
               file=sys.stderr, flush=True)
+
+    def _telemetry_section():
+        """SLO/telemetry section (ISSUE 7): per-plan-signature latency
+        p50/p95 from the process hub's histograms, plus sampler/flight
+        state — the numbers tools/bench_gate.py diffs across runs."""
+        from spark_rapids_tpu import perfcounters as PC
+        from spark_rapids_tpu import telemetry
+
+        hub = telemetry.get_hub()
+        if hub is None:
+            return {}, {}
+        slo = telemetry.slo_summary()
+        tel = {
+            "sampler_ticks": hub.sampler.ticks,
+            "flight_events": hub.flight.events_recorded,
+            "postmortems": len(hub.postmortems),
+            "slo_violations": PC.COUNTERS.get("slo_violations", 0),
+        }
+        return slo, tel
 
     def _payload(partial: bool):
         import copy
@@ -592,6 +648,7 @@ def main():
             for k in list(q):
                 if isinstance(q[k], (int, float)):
                     q[k] = round(q[k], 6)
+        slo, tel = _telemetry_section()
         return {
             "metric": "tpcds_mini_geomean_speedup_vs_vectorized_cpu",
             "value": round(geo_vec, 3),
@@ -601,6 +658,8 @@ def main():
             "partial": partial,
             "skipped_on_time_budget": list(skipped),
             "scan_inclusive_geomean": round(geo_scan, 3),
+            "slo": slo,
+            "telemetry": tel,
             "hbm_roofline_gbps": V5E_HBM_GBPS,
             "note": ("vs_baseline = geomean TPU speedup over "
                      "hand-vectorized numpy (bincount/searchsorted/"
@@ -644,6 +703,7 @@ def main():
         payload = _payload(partial=False)
         _write_stream(payload)
         print(json.dumps(payload), flush=True)
+        emitted["rc"] = run_gate(payload)
 
     _ALL = ["qa_join_agg", "qb_left_join", "qc_window", "rung3",
             "q6_parquet"]
@@ -696,7 +756,7 @@ def main():
         skipped.extend(["q6"] + _ALL)
         progress("terminated during rung 1; emitting partial results")
         emit()
-        return
+        return emitted["rc"]
 
     # ---- rung 2 ----------------------------------------------------------
     ss = make_store_sales(n)
@@ -750,7 +810,7 @@ def main():
                   scan_mode=scan_variants)
     except TimeoutError:
         abort("qa_join_agg")
-        return
+        return emitted["rc"]
 
     def check_qb(rows, want):
         got = {int(r[0]): int(r[1].scaleb(2)) for r in rows}
@@ -764,7 +824,7 @@ def main():
                              "d": ss["ext_sales"]}, sr))
     except TimeoutError:
         abort("qb_left_join")
-        return
+        return emitted["rc"]
 
     def check_qc(rows, want):
         got = {(int(r[0]), int(r[1]), int(r[2].scaleb(2)), int(r[3]))
@@ -780,7 +840,7 @@ def main():
                              "c": ss["ext_sales"]}))
     except TimeoutError:
         abort("qc_window")
-        return
+        return emitted["rc"]
 
     # ---- rung 3 (BASELINE.md): nested structs + decimal128 through the
     # OOC machinery under a constrained pool, with spill counters
@@ -920,7 +980,7 @@ def main():
             skipped.extend(["rung3", "q6_parquet"])
             progress("terminated during rung3; emitting partial results")
             emit()
-            return
+            return emitted["rc"]
         except Exception as ex:   # rung-3 is additive: never lose rung 1-2
             progress(f"rung3 failed: {ex!r}")
     # ---- q6 over real snappy parquet files through the device decode path
@@ -1039,12 +1099,13 @@ def main():
             run_q6_parquet()
         except TimeoutError:
             abort("q6_parquet")
-            return
+            return emitted["rc"]
         except Exception as ex:   # additive: never lose rung 1-2
             progress(f"q6_parquet failed: {ex!r}")
 
     emit()
+    return emitted["rc"]
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

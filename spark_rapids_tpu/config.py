@@ -695,6 +695,77 @@ DIAGNOSTICS_MAX_EVENTS = conf(
     "Overflow is counted into query_end's events_dropped field; operator "
     "summaries and query_start/end are always kept.").integer_conf(200000)
 
+# --- telemetry (telemetry/ — always-on metrics, flight recorder, SLOs) -----
+
+TELEMETRY_ENABLED = conf("spark.rapids.tpu.telemetry.enabled").doc(
+    "Always-on telemetry tier: a process-global time-series metrics "
+    "registry fed by a sampler thread (admission queue depth, HBM "
+    "occupancy, spill tiers, cache hit rates, H2D bandwidth), "
+    "per-plan-signature latency histograms with p50/p95 SLO tracking "
+    "recorded at collect() exit, and the failure flight recorder.  The "
+    "hub is built by the first TpuSession whose conf leaves this true; "
+    "per-batch hot paths are never instrumented (docs/observability.md)."
+).boolean_conf(True)
+
+TELEMETRY_SAMPLE_PERIOD_MS = conf(
+    "spark.rapids.tpu.telemetry.samplePeriodMs").doc(
+    "Sampler thread period: every period the daemon snapshots the "
+    "process singletons (peek-only — an idle tick creates nothing) into "
+    "the time-series registry and the in-memory timeline.  0 disables "
+    "the sampler (the registry, SLO histograms, and flight recorder "
+    "still work; only the periodic gauges stop).").double_conf(500.0)
+
+TELEMETRY_RETENTION = conf("spark.rapids.tpu.telemetry.retention").doc(
+    "Ring-buffer bound on retained samples per time series (and on "
+    "timeline rows): at the default 500ms period, 720 points is a "
+    "six-minute sliding window.  A long-running process holds a window, "
+    "never an unbounded history.").integer_conf(720)
+
+TELEMETRY_PORT = conf("spark.rapids.tpu.telemetry.port").doc(
+    "Bind a localhost-only (127.0.0.1) HTTP scrape endpoint serving GET "
+    "/metrics in Prometheus exposition format.  0 disables (the "
+    "default); telemetry.export() returns the same text in-process "
+    "either way.  Fleet exposure belongs to a sidecar, not this "
+    "library.").integer_conf(0)
+
+TELEMETRY_JSONL_DIR = conf("spark.rapids.tpu.telemetry.jsonlDir").doc(
+    "Directory for the periodic JSONL telemetry log "
+    "(telemetry-<pid>.jsonl, one line per sampler tick) — the "
+    "process-level companion of the per-query diagnostics event log.  "
+    "Unset: samples stay in the in-memory timeline only."
+).string_conf(None)
+
+TELEMETRY_FLIGHT_ENABLED = conf(
+    "spark.rapids.tpu.telemetry.flightRecorder.enabled").doc(
+    "Always-on failure flight recorder: a fixed-size in-memory ring of "
+    "recent query-level events (admitted/finished/cancelled/deadline/"
+    "breaker — a handful of appends per QUERY, never per batch) that "
+    "auto-dumps a post-mortem bundle (ring + all-thread stacks with the "
+    "offending query's thread named + counter snapshot + active-query "
+    "table) when a deadline trips, a query is cancelled mid-batch, a "
+    "circuit breaker opens, or collect() raises.  On by default."
+).boolean_conf(True)
+
+TELEMETRY_FLIGHT_CAPACITY = conf(
+    "spark.rapids.tpu.telemetry.flightRecorder.capacity").doc(
+    "Flight-recorder ring size in events (oldest evicted first)."
+).integer_conf(2048)
+
+TELEMETRY_FLIGHT_DUMP_DIR = conf(
+    "spark.rapids.tpu.telemetry.flightRecorder.dumpDir").doc(
+    "Directory post-mortem bundles are written to (atomic tmp+rename "
+    "JSON, postmortem-<ts>-<reason>[-<qid>].json).  Unset: bundles are "
+    "kept in memory only (the last 8, telemetry.last_postmortem())."
+).string_conf(None)
+
+TELEMETRY_SLO_TARGET_P95_MS = conf(
+    "spark.rapids.tpu.telemetry.slo.targetP95Ms").doc(
+    "Per-query latency SLO target: any collect() slower than this bumps "
+    "slo_violations and drops an slo_violation event into the flight "
+    "ring.  0 disables (latency histograms still record; "
+    "tools/bench_gate.py owns cross-run regression gating)."
+).double_conf(0.0)
+
 MEM_DEBUG = conf("spark.rapids.memory.gpu.debug").doc(
     "Log arena allocations.").boolean_conf(False)
 

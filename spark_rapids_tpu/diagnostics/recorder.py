@@ -363,8 +363,7 @@ class QueryDiagnostics:
             cur = dict(PC.COUNTERS)
             with self._lock:
                 self.closed = True
-        self.total = {k: cur[k] - self.snap0.get(k, 0) for k in cur
-                      if k not in PC.ALIASES}
+        self.total = {k: cur[k] - self.snap0.get(k, 0) for k in cur}
         if root is not None:
             def walk(node):
                 path = getattr(node, "_diag_path", None)

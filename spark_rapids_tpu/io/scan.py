@@ -133,9 +133,19 @@ def _decode_breaker_key(fmt: str):
 
 
 class TpuFileSourceScanExec(TpuExec):
-    # GpuFileSourceScanExec metric set (bufferTime/gpuDecodeTime)
+    # GpuFileSourceScanExec metric set (bufferTime/gpuDecodeTime), plus
+    # the ISSUE 6 transport-pipeline signals surfaced per-operator so
+    # explain("analyze") shows them as per-query deltas like the other
+    # operator metrics (ISSUE 7 satellite): hot-cache hit/miss,
+    # overlapped H2D bytes, prefetch stall wall, and per-chunk
+    # compressed->decoded decode fallbacks
     EXTRA_METRICS = {"bufferTime": "MODERATE",
-                     "gpuDecodeTime": "MODERATE"}
+                     "gpuDecodeTime": "MODERATE",
+                     "hotCacheHits": "MODERATE",
+                     "hotCacheMisses": "MODERATE",
+                     "bytesH2DOverlapped": "MODERATE",
+                     "prefetchStallTime": "MODERATE",
+                     "chunkDecodeFallbacks": "MODERATE"}
 
     def __init__(self, plan: FileSourceScan, conf: TpuConf):
         super().__init__([])
@@ -219,6 +229,11 @@ class TpuFileSourceScanExec(TpuExec):
         from spark_rapids_tpu.resilience.breaker import get_breaker
 
         key = _decode_breaker_key(self.plan.fmt)
+        # per-chunk compressed->decoded fallbacks happen inside
+        # parquet_device without operator context; the counter delta
+        # across this file's decode attributes them to this scan
+        # (advisory under concurrent scans, like every TpuMetric)
+        pre_chunk_falls = PC.COUNTERS.get("chunk_decode_fallbacks", 0)
         try:
             chaos.check_decode_fault(self.node_name, file_index)
             with self.metric("gpuDecodeTime").timed():
@@ -266,6 +281,10 @@ class TpuFileSourceScanExec(TpuExec):
                 path, f"decoder FAILURE {type(ex).__name__}: {ex} "
                       f"(retrying on native decoder)")
             return None
+        falls = PC.COUNTERS.get("chunk_decode_fallbacks", 0) \
+            - pre_chunk_falls
+        if falls > 0:
+            self.metric("chunkDecodeFallbacks").add(falls)
         if get_breaker().has_entries():
             get_breaker().record_success(key)
         return out
@@ -407,10 +426,12 @@ class TpuFileSourceScanExec(TpuExec):
                 hit = cache.get(key)
                 if hit is not None:
                     PC.bump("hot_cache_hits")
+                    self.metric("hotCacheHits").add(1)
                     for b, p in hit:
                         yield self._stamp(self._count_output(b), p)
                     return
                 PC.bump("hot_cache_misses")
+                self.metric("hotCacheMisses").add(1)
                 collected = []
 
         def note_skip():
@@ -558,6 +579,7 @@ class TpuFileSourceScanExec(TpuExec):
                             continue
                     stall = time.perf_counter_ns() - t0
                     PC.bump("prefetch_stall_ns", stall)
+                    self.metric("prefetchStallTime").add(stall)
                     stats["stall_ns"] += stall
                 else:
                     items = fut.result()
@@ -566,6 +588,7 @@ class TpuFileSourceScanExec(TpuExec):
                     if overlapped:
                         nb = b.nbytes()
                         PC.bump("bytes_h2d_overlapped", nb)
+                        self.metric("bytesH2DOverlapped").add(nb)
                         stats["overlapped_bytes"] += nb
                     yield b, p
                 fill()

@@ -113,6 +113,21 @@ class query_lifecycle:
                 _watchdog.unregister(ctx)
                 if isinstance(e, QueryCancelled):
                     PC.bump("queries_cancelled")
+                # rejection raises HERE, before the telemetry collect
+                # wrapper ever runs — record the overload event at the
+                # only site that sees it (ISSUE 7)
+                if isinstance(e, QueryRejected):
+                    from spark_rapids_tpu.telemetry import context as TEL
+
+                    hub = TEL.HUB
+                    if hub is not None:
+                        try:
+                            hub.record_event(
+                                "query_rejected",
+                                query_id=ctx.query_id,
+                                detail=str(e)[:300])
+                        except Exception:
+                            pass
                 raise
             self._ctl = ctl
         self._cv_token = CURRENT.set(ctx)

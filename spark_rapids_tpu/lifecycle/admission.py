@@ -118,6 +118,12 @@ def get_admission(limit: int, max_queue: int) -> AdmissionController:
         return _controller
 
 
+def peek_admission() -> Optional[AdmissionController]:
+    """The controller if it exists — the telemetry sampler must read
+    queue depth without CREATING a controller on an idle process."""
+    return _controller
+
+
 def reset_admission() -> None:
     global _controller, _controller_key
     with _lock:

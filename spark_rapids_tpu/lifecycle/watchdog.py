@@ -70,5 +70,18 @@ def _run() -> None:
                         f"spark.rapids.tpu.query.timeoutMs "
                         f"(deadline passed {over_ms:.0f}ms ago)"):
                     PC.bump("deadline_trips")
+                    # Flight recorder (ISSUE 7): dump the post-mortem
+                    # NOW, while the offending query's thread is still
+                    # blocked wherever it is stuck — its stack is the
+                    # bundle's whole point, and it unwinds as soon as
+                    # the cooperative cancel is noticed
+                    from spark_rapids_tpu.telemetry import context as TEL
+
+                    hub = TEL.HUB
+                    if hub is not None:
+                        try:
+                            hub.deadline_tripped(ctx)
+                        except Exception:
+                            pass
         with _COND:
             _COND.wait(max(period, 0.005))

@@ -90,18 +90,10 @@ COUNTERS: Dict[str, int] = {
     "hot_cache_hits": 0,
     "hot_cache_misses": 0,
     "hot_cache_evictions": 0,
-}
-
-# One-release read/write compat for the pre-normalization camelCase keys
-# (ISSUE 3 satellite): ``bump`` accepts them, ``snapshot``/``since``
-# still expose them.  New code must use the snake_case canonical names.
-ALIASES: Dict[str, str] = {
-    "transientRetries": "transient_retries",
-    "oomRestarts": "oom_restarts",
-    "runtimeFallbacks": "runtime_fallbacks",
-    "breakerTrips": "breaker_trips",
-    "breakerPlanFallbacks": "breaker_plan_fallbacks",
-    "queryFallbacks": "query_fallbacks",
+    # telemetry tier (ISSUE 7, telemetry/): per-query SLO-target misses
+    # and flight-recorder post-mortem bundles produced
+    "slo_violations": 0,
+    "postmortem_dumps": 0,
 }
 
 
@@ -110,7 +102,6 @@ def bump(key: str, n: int = 1) -> None:
     (load / add / store) and CPython may switch threads between them, so
     concurrent unguarded increments lose updates; every write in this
     module routes through ``_LOCK``."""
-    key = ALIASES.get(key, key)
     # attribution happens INSIDE the counter lock so a bump is atomic
     # with respect to the diagnostics window: the recorder installs /
     # snapshots / closes under this same lock, so every bump lands
@@ -126,10 +117,7 @@ def bump(key: str, n: int = 1) -> None:
 
 def snapshot() -> Dict[str, int]:
     with _LOCK:
-        snap = dict(COUNTERS)
-    for alias, canon in ALIASES.items():
-        snap[alias] = snap[canon]
-    return snap
+        return dict(COUNTERS)
 
 
 def since(snap: Dict[str, int]) -> Dict[str, int]:

@@ -60,6 +60,7 @@ class CircuitBreakerRegistry:
     def record_failure(self, key: Key, threshold: int,
                        reason: str = "") -> bool:
         """One deterministic failure; True when this one tripped OPEN."""
+        tripped = False
         with self._lock:
             e = self._entries.setdefault(key, _Entry())
             e.failures += 1
@@ -70,10 +71,24 @@ class CircuitBreakerRegistry:
                 e.opened_at = self._now()
                 self.trips += 1
                 self.generation += 1
-                return True
-            if e.state == OPEN:
+                tripped = True
+            elif e.state == OPEN:
                 e.opened_at = self._now()
-            return False
+        if tripped:
+            # Flight recorder (ISSUE 7): an opening breaker means a
+            # stage is now systematically broken — bundle the recent
+            # ring + stacks + counters so the first open is
+            # investigable after the fact (outside the lock; a
+            # telemetry failure must never break the breaker)
+            from spark_rapids_tpu.telemetry import context as TEL
+
+            hub = TEL.HUB
+            if hub is not None:
+                try:
+                    hub.breaker_opened(key, reason)
+                except Exception:
+                    pass
+        return tripped
 
     def record_success(self, key: Key) -> None:
         """A completed TPU run closes a half-open entry (probe passed) and

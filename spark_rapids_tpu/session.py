@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.column import HostColumn
+from spark_rapids_tpu.telemetry import context as _TEL_CTX
 from spark_rapids_tpu.config import SHUFFLE_PARTITIONS, TpuConf
 from spark_rapids_tpu.expr.base import (
     Alias,
@@ -106,6 +107,12 @@ class TpuSession:
     def __init__(self, conf: Optional[Dict[str, str]] = None):
         self.conf = TpuConf(conf or {})
         _apply_compile_cache(self.conf)
+        # Telemetry tier (ISSUE 7): the first enabling session builds the
+        # process-global hub (metrics registry + sampler + flight
+        # recorder + optional scrape endpoint); later sessions reuse it.
+        from spark_rapids_tpu.telemetry import maybe_configure
+
+        maybe_configure(self.conf)
 
     @staticmethod
     def builder() -> "TpuSessionBuilder":
@@ -168,6 +175,13 @@ class TpuSession:
             clear_hot_cache()
         leaks = leak_report_all() if check_leaks else []
         reset_leaked_state()
+        # flush the telemetry JSONL sink so a shutdown-then-inspect
+        # workflow sees every sampler tick; the hub itself is
+        # process-global and keeps serving other live sessions
+        # (telemetry.shutdown() stops it for good)
+        from spark_rapids_tpu.telemetry import flush as _telemetry_flush
+
+        _telemetry_flush()
         return leaks
 
 
@@ -589,6 +603,18 @@ class DataFrame:
         from spark_rapids_tpu.lifecycle import query_lifecycle
 
         with query_lifecycle(self.session.conf) as qctx:
+            # Telemetry (ISSUE 7): lifecycle-managed queries run under
+            # flight-recorder + SLO observation — a few dict appends and
+            # one plan walk per QUERY.  The hub check is one ambient
+            # attribute read; a telemetry-disabled session skips on the
+            # conf alone (zero calls into telemetry modules — pinned by
+            # tests/test_telemetry.py).
+            hub = _TEL_CTX.HUB
+            if hub is not None and qctx is not None:
+                from spark_rapids_tpu.config import TELEMETRY_ENABLED
+
+                if self.session.conf.get(TELEMETRY_ENABLED):
+                    return hub.observed_collect(self, qctx)
             return self._collect_impl(qctx)
 
     def _collect_impl(self, qctx) -> List[tuple]:

@@ -1,0 +1,27 @@
+"""Telemetry context — the ONLY telemetry module instrumented sites read.
+
+``HUB`` is the process-wide active :class:`~spark_rapids_tpu.telemetry.
+TelemetryHub` (or None).  Like ``diagnostics.context.RECORDER`` it is a
+plain module attribute, not a contextvar: telemetry is deliberately
+process-scoped (queue depth, HBM occupancy, and per-plan latency are
+properties of the *service*, not of one query), and signals arrive from
+engine-owned helper threads (the watchdog, the AOT pool, shuffle pools)
+that a contextvar would silently drop.
+
+Disabled-path contract (mirrors ISSUE 3's diagnostics contract, pinned
+by tests/test_telemetry.py): every instrumented site performs exactly
+ONE ambient check — ``if CTX.HUB is None: skip`` — before doing any
+other telemetry work, so the sampler-off/hub-off path costs an attribute
+read and nothing else.
+"""
+from __future__ import annotations
+
+# the active TelemetryHub; None = telemetry off.  Read lock-free from
+# instrumented sites; written only by telemetry.maybe_configure /
+# telemetry.shutdown under the hub lock.
+HUB = None
+
+
+def active():
+    """The active hub or None (one ambient check)."""
+    return HUB

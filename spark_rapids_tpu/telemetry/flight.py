@@ -1,0 +1,125 @@
+"""Flight recorder — an always-on bounded ring of recent query events,
+plus the post-mortem bundle builder.
+
+Reference analog: the JVM's JFR "flight recorder" stance applied to the
+query engine: full diagnostics (ISSUE 3) are opt-in and per-query; the
+flight recorder is ON BY DEFAULT and process-wide, recording only
+coarse query-level events (admitted / started / finished / cancelled /
+deadline trip / breaker open) into a fixed-size ring — a handful of
+dict appends per QUERY, never per batch, so the always-on cost is
+unmeasurable next to a single program launch.
+
+When something goes wrong — a deadline trips, a query is cancelled
+mid-batch, a circuit breaker opens, or ``collect()`` raises — the hub
+dumps a **post-mortem bundle**: the ring contents, a stack trace of
+every live thread (the offending query's collect thread called out by
+name), the process counter snapshot, and the active-query table.  The
+bundle is what an operator opens FIRST when a serving-tier query
+wedges: it answers "what was the process doing in the seconds before"
+without anyone having enabled anything in advance.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+
+class FlightRecorder:
+    """Fixed-size ring of recent events.  ``record`` is the only method
+    on a query path: one small dict + one deque append under a lock."""
+
+    def __init__(self, capacity: int = 2048):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(int(capacity), 16))
+        self.events_recorded = 0
+
+    def record(self, kind: str, **fields) -> None:
+        e = {"ev": kind, "ts": time.time()}
+        e.update(fields)
+        with self._lock:
+            self._ring.append(e)
+            self.events_recorded += 1
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+def _thread_stacks(offender_ident: Optional[int] = None) -> Dict[str, List[str]]:
+    """Formatted stacks of every live thread, keyed
+    ``"<name>@<ident>"``; the offending query's thread key gets an
+    ``"*offender*"`` suffix so the bundle names it unambiguously."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: Dict[str, List[str]] = {}
+    for ident, frame in sys._current_frames().items():
+        key = f"{names.get(ident, '?')}@{ident}"
+        if offender_ident is not None and ident == offender_ident:
+            key += " *offender*"
+        out[key] = traceback.format_stack(frame)
+    return out
+
+
+def _active_query_table() -> List[Dict[str, Any]]:
+    from spark_rapids_tpu.lifecycle import watchdog as _wd
+
+    now = time.monotonic_ns()
+    rows = []
+    for ctx in _wd.active_queries():
+        rows.append({
+            "query_id": ctx.query_id,
+            "age_ms": round((now - ctx.started_ns) / 1e6, 1),
+            "deadline_set": ctx.deadline_ns is not None,
+            "deadline_expired": ctx.deadline_expired(now),
+            "cancelled": ctx.token.cancelled,
+            "owner_thread": ctx.owner_thread,
+        })
+    return rows
+
+
+def build_bundle(recorder: FlightRecorder, reason: str,
+                 query_id: str = "", detail: str = "",
+                 offender_ident: Optional[int] = None) -> Dict[str, Any]:
+    """Assemble one post-mortem bundle (pure data, JSON-serializable)."""
+    from spark_rapids_tpu import perfcounters as PC
+
+    return {
+        "bundle": "spark_rapids_tpu_postmortem",
+        "reason": reason,
+        "query_id": query_id,
+        "detail": str(detail)[:2000],
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "counters": PC.snapshot(),
+        "active_queries": _active_query_table(),
+        "thread_stacks": _thread_stacks(offender_ident),
+        "ring": recorder.snapshot(),
+    }
+
+
+def write_bundle(bundle: Dict[str, Any], dump_dir: str) -> Optional[str]:
+    """Atomic (tmp + rename) JSON write; returns the path or None on
+    I/O failure (a dump must never fail the process it describes)."""
+    try:
+        os.makedirs(dump_dir, exist_ok=True)
+        name = (f"postmortem-{int(bundle['ts'] * 1000):013d}-"
+                f"{bundle['reason']}"
+                + (f"-{bundle['query_id']}" if bundle["query_id"] else "")
+                + ".json")
+        path = os.path.join(dump_dir, name)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(bundle, f, default=str)
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        return None

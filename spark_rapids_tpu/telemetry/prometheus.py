@@ -1,0 +1,114 @@
+"""Prometheus exposition-format exporter + the localhost scrape
+endpoint.
+
+``render_prometheus(hub)`` emits the text format (version 0.0.4) every
+Prometheus-compatible scraper parses: gauges and counters from the
+time-series registry (counters get the conventional ``_total`` suffix)
+and the SLO latency histograms as ``_bucket{le=...}`` / ``_sum`` /
+``_count`` families labeled by plan signature.  Metric names are
+prefixed ``srt_`` and sanitized to the exposition charset; a parse test
+round-trips the output through a from-scratch parser
+(tests/test_telemetry.py) so the format itself is pinned, not just the
+substring shapes.
+
+``spark.rapids.tpu.telemetry.port`` > 0 binds a daemon HTTP server to
+``127.0.0.1:<port>`` serving ``GET /metrics`` — localhost-only by
+design: fleet exposure belongs to a real sidecar, not this library.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _name(raw: str) -> str:
+    return "srt_" + _NAME_RE.sub("_", raw)
+
+
+def _esc_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def render_prometheus(hub) -> str:
+    out = []
+    for s in sorted(hub.registry.series_items(), key=lambda s: s.name):
+        name = _name(s.name) + ("_total" if s.kind == "counter" else "")
+        if s.help:
+            out.append(f"# HELP {name} {s.help}")
+        out.append(f"# TYPE {name} {s.kind}")
+        out.append(f"{name} {_fmt(s.value)}")
+    for h in sorted(hub.registry.hist_items(), key=lambda h: h.name):
+        name = _name(h.name)
+        if h.help:
+            out.append(f"# HELP {name} {h.help}")
+        out.append(f"# TYPE {name} histogram")
+        lname = h.label_name or "label"
+        # one consistent copy per histogram: a scrape racing a collect()
+        # exit must never emit buckets whose cumsum disagrees with _count
+        shards = h.snapshot_shards()
+        for lbl in sorted(shards):
+            sh = shards[lbl]
+            prefix = (f'{lname}="{_esc_label(lbl)}",' if lbl else "")
+            cum = 0
+            for i, ub in enumerate(h.buckets):
+                cum += sh["counts"][i]
+                out.append(f'{name}_bucket{{{prefix}le="{_fmt(ub)}"}} '
+                           f'{cum}')
+            cum += sh["counts"][len(h.buckets)]
+            out.append(f'{name}_bucket{{{prefix}le="+Inf"}} {cum}')
+            suffix = f"{{{prefix[:-1]}}}" if prefix else ""
+            out.append(f"{name}_sum{suffix} {_fmt(sh['sum'])}")
+            out.append(f"{name}_count{suffix} {sh['count']}")
+    return "\n".join(out) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    hub = None                      # set per server class below
+
+    def do_GET(self):               # noqa: N802 (http.server API)
+        if self.path.rstrip("/") not in ("", "/metrics"):
+            self.send_response(404)
+            self.end_headers()
+            return
+        try:
+            body = render_prometheus(self.hub).encode()
+        except Exception as e:      # a scrape must never crash the server
+            self.send_response(500)
+            self.end_headers()
+            self.wfile.write(str(e).encode())
+            return
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):      # no stderr chatter per scrape
+        pass
+
+
+def start_http(hub, port: int) -> Tuple[Optional[ThreadingHTTPServer],
+                                        Optional[int]]:
+    """Bind the scrape endpoint on 127.0.0.1 (port 0 = ephemeral, used
+    by tests); returns (server, bound_port) or (None, None) when the
+    bind fails (a busy port must not fail session construction)."""
+    handler = type("_BoundHandler", (_Handler,), {"hub": hub})
+    try:
+        srv = ThreadingHTTPServer(("127.0.0.1", int(port)), handler)
+    except OSError:
+        return None, None
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever,
+                         name="srt-telemetry-http", daemon=True)
+    t.start()
+    return srv, srv.server_address[1]

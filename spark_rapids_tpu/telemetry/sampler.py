@@ -1,0 +1,192 @@
+"""The telemetry sampler — one daemon thread snapshots process state
+into the time-series registry.
+
+Every ``spark.rapids.tpu.telemetry.samplePeriodMs`` the sampler reads —
+via the singletons' ``peek_*`` accessors only, so an idle tick can never
+*create* a spill framework, admission controller, or cache — and
+records:
+
+* admission queue depth / running / limit and cumulative queue wait
+  (``lifecycle/admission.py``),
+* active and cumulative cancelled/admitted/rejected query counts
+  (``lifecycle/watchdog.py`` + perfcounters),
+* memory-pool occupancy and spill-tier movement (``memory/spill.py``),
+* hot-table-cache and compile-registry occupancy plus hit rates,
+* H2D logical-vs-physical transfer volume and prefetch stalls
+  (``perfcounters``),
+* the rolling all-queries p95 from the SLO histogram.
+
+Each tick also appends one combined row to the bounded in-memory
+timeline (what ``tools/run_stress.py`` dumps) and, when
+``spark.rapids.tpu.telemetry.jsonlDir`` is set, one JSON line to
+``telemetry-<pid>.jsonl`` — the periodic process-level companion of the
+per-query diagnostics event log.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+# the perfcounters mirrored into counter series each tick (cumulative;
+# consumers diff for rates)
+SAMPLED_COUNTERS = (
+    "queries_admitted", "queries_rejected", "queries_cancelled",
+    "deadline_trips", "admission_wait_ns",
+    "bytes_h2d", "bytes_h2d_logical", "bytes_h2d_overlapped",
+    "bytes_d2h", "prefetch_stall_ns", "scan_transfer_ns",
+    "hot_cache_hits", "hot_cache_misses", "hot_cache_evictions",
+    "compile_cache_hits", "compile_cache_misses", "compile_wall_ns",
+    "host_syncs", "programs_launched", "compiles",
+    "transient_retries", "runtime_fallbacks", "breaker_trips",
+    "slo_violations", "postmortem_dumps",
+)
+
+
+def _ratio(hits: float, misses: float) -> float:
+    total = hits + misses
+    return hits / total if total else 0.0
+
+
+def collect_gauges() -> Dict[str, float]:
+    """One tick's gauge readings (peek-only; shared with tests)."""
+    g: Dict[str, float] = {}
+    from spark_rapids_tpu.lifecycle.admission import peek_admission
+
+    ctl = peek_admission()
+    if ctl is not None:
+        st = ctl.stats()
+        g["admission_running"] = st["running"]
+        g["admission_queued"] = st["queued"]
+        g["admission_limit"] = st["limit"]
+    from spark_rapids_tpu.lifecycle import watchdog as _wd
+
+    g["active_queries"] = len(_wd.active_queries())
+    from spark_rapids_tpu.memory.spill import peek_spill_framework
+
+    fw = peek_spill_framework()
+    if fw is not None:
+        g["hbm_pool_bytes"] = fw.pool_bytes
+        g["hbm_used_bytes"] = fw.device_used
+        g["hbm_occupancy"] = (fw.device_used / fw.pool_bytes
+                              if fw.pool_bytes else 0.0)
+        g["spill_to_host_count"] = fw.spill_to_host_count
+        g["spill_to_disk_count"] = fw.spill_to_disk_count
+        g["spill_to_host_bytes"] = fw.spill_to_host_bytes
+        g["spill_to_disk_bytes"] = fw.spill_to_disk_bytes
+    from spark_rapids_tpu.io.hot_cache import peek_hot_cache
+
+    hc = peek_hot_cache()
+    if hc is not None:
+        st = hc.stats()
+        g["hot_cache_entries"] = st["entries"]
+        g["hot_cache_bytes"] = st["bytes"]
+    from spark_rapids_tpu.compilecache.registry import get_registry
+
+    g["compile_registry_programs"] = get_registry().stats()["programs"]
+    from spark_rapids_tpu import perfcounters as PC
+
+    c = PC.COUNTERS
+    g["hot_cache_hit_rate"] = _ratio(c.get("hot_cache_hits", 0),
+                                     c.get("hot_cache_misses", 0))
+    g["compile_cache_hit_rate"] = _ratio(c.get("compile_cache_hits", 0),
+                                         c.get("compile_cache_misses", 0))
+    return g
+
+
+class Sampler:
+    """Owns the daemon thread, the timeline ring, and the JSONL sink."""
+
+    def __init__(self, hub, period_s: float, retention: int,
+                 jsonl_dir: Optional[str] = None):
+        self._hub = hub
+        self.period_s = max(float(period_s), 0.01)
+        self.timeline: deque = deque(maxlen=max(int(retention), 1))
+        self._jsonl_dir = jsonl_dir or None
+        self._jsonl = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.ticks = 0
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="srt-telemetry-sampler", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(self.period_s * 4 + 1.0)
+        self._thread = None
+        self.flush()
+        # a stopped sampler never writes again: close the sink so hub
+        # shutdown/rebuild cycles do not accumulate open fds
+        f, self._jsonl = self._jsonl, None
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period_s):
+            try:
+                self.tick()
+            except Exception:        # a broken peek must not kill the loop
+                pass
+
+    # -- one sample ------------------------------------------------------
+    def tick(self) -> Dict[str, float]:
+        from spark_rapids_tpu import perfcounters as PC
+
+        ts = time.time()
+        gauges = collect_gauges()
+        counters = {k: float(PC.COUNTERS.get(k, 0))
+                    for k in SAMPLED_COUNTERS}
+        reg = self._hub.registry
+        reg.record_many("gauge", gauges, ts)
+        reg.record_many("counter", counters, ts)
+        p95 = self._hub.slo.p95_ms()
+        reg.record("query_latency_p95_ms", p95, "gauge",
+                   "rolling all-queries p95 collect latency", ts)
+        row = {"ts": round(ts, 3), "p95_ms": round(p95, 3)}
+        row.update({k: v for k, v in gauges.items()})
+        row.update({k: int(v) for k, v in counters.items()})
+        self.timeline.append(row)
+        self.ticks += 1
+        self._write_jsonl(row)
+        return row
+
+    def timeline_snapshot(self) -> list:
+        return list(self.timeline)
+
+    # -- JSONL sink ------------------------------------------------------
+    def _write_jsonl(self, row: Dict) -> None:
+        if not self._jsonl_dir:
+            return
+        try:
+            if self._jsonl is None:
+                os.makedirs(self._jsonl_dir, exist_ok=True)
+                self._jsonl = open(
+                    os.path.join(self._jsonl_dir,
+                                 f"telemetry-{os.getpid()}.jsonl"), "a")
+            self._jsonl.write(json.dumps(row) + "\n")
+            self._jsonl.flush()
+        except OSError:
+            self._jsonl_dir = None       # disable after an I/O failure
+
+    def flush(self) -> None:
+        f = self._jsonl
+        if f is not None:
+            try:
+                f.flush()
+            except OSError:
+                pass

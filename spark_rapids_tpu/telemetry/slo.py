@@ -1,0 +1,97 @@
+"""SLO tracking — per-plan-signature latency histograms observed at
+``collect()`` exit.
+
+Reference analog: the serving-tier p95 discipline in "Accelerating
+Presto with GPUs" (arXiv:2606.24647) — a dashboard deployment is tuned
+against tail latency of REPEATED queries, so latency must be keyed by
+plan shape, not pooled.  Every lifecycle-managed ``collect()`` lands one
+observation here: the query's wall time into (a) the global latency
+histogram and (b) its plan-signature sub-series (the same
+``path:OperatorName|...`` signature ``tools/profile_report.py --diff``
+matches queries by, so SLO series line up with diagnostics diffs).
+
+``spark.rapids.tpu.telemetry.slo.targetP95Ms`` arms a per-query latency
+target: any single query slower than the target bumps
+``slo_violations`` and drops a ``slo_violation`` event into the flight
+ring.  The cross-run regression gate lives in ``tools/bench_gate.py``,
+which diffs the histogram-derived p50/p95 a bench run records.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from spark_rapids_tpu.telemetry.registry import (
+    DEFAULT_LATENCY_BUCKETS_MS,
+    MetricsRegistry,
+)
+
+LATENCY_HIST = "query_latency_ms"
+
+
+class SloTracker:
+    def __init__(self, registry: MetricsRegistry):
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._hist = registry.histogram(
+            LATENCY_HIST,
+            "per-query collect() wall time, labeled by plan signature",
+            DEFAULT_LATENCY_BUCKETS_MS, label_name="plan_sig")
+        self._status: Dict[str, Dict[str, int]] = {}
+
+    def observe(self, plan_sig: str, wall_ns: int, status: str,
+                target_p95_ms: float = 0.0) -> bool:
+        """Record one query; True when it violated the armed target."""
+        ms = wall_ns / 1e6
+        key = "ok" if status == "ok" else "error"
+        with self._lock:
+            self._hist.observe(ms, "")            # the all-queries series
+            self._status.setdefault("", {"ok": 0, "error": 0})[key] += 1
+            if plan_sig:
+                self._hist.observe(ms, plan_sig)
+                self._status.setdefault(
+                    plan_sig, {"ok": 0, "error": 0})[key] += 1
+        return bool(target_p95_ms and ms > target_p95_ms)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-plan-signature latency summary ("" = all queries):
+        count / error_count / p50_ms / p95_ms / max_ms."""
+        with self._lock:
+            out = {}
+            for lbl in self._hist.labels():
+                s = self._hist.stats(lbl)
+                st = self._status.get(lbl or "", {})
+                out[lbl or ""] = {
+                    "count": s["count"],
+                    "errors": st.get("error", 0),
+                    "p50_ms": round(s["p50"], 3),
+                    "p95_ms": round(s["p95"], 3),
+                    "max_ms": round(s["max"], 3),
+                    "mean_ms": round(s["sum"] / s["count"], 3)
+                    if s["count"] else 0.0,
+                }
+            return out
+
+    def p95_ms(self, plan_sig: str = "") -> float:
+        with self._lock:
+            return self._hist.quantile(0.95, plan_sig)
+
+
+def plan_signature(root) -> str:
+    """The diagnostics-compatible plan signature of a planned exec tree
+    (``path:NodeName`` in path order) — cheap: one walk per collect."""
+    from spark_rapids_tpu.exec.base import TpuExec
+
+    parts = []
+
+    def walk(node, path: str) -> None:
+        parts.append(f"{path}:{type(node).__name__}")
+        for i, c in enumerate(getattr(node, "children", ())):
+            if isinstance(c, TpuExec):
+                walk(c, f"{path}.{i}")
+
+    try:
+        walk(root, "0")
+    except Exception:
+        return ""
+    return "|".join(parts)

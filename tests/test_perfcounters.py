@@ -119,18 +119,21 @@ def test_counting_jit_concurrent_first_call_counts_one_compile():
     assert PC.since(snap)["compiles"] == 1
 
 
-def test_counter_key_aliases_read_and_write():
-    """Counter names are canonical snake_case; the camelCase spellings
-    stay readable via snapshot()/since() and writable via bump() for one
-    release."""
+def test_counter_keys_are_snake_case_only():
+    """ISSUE 7 satellite: the one-release camelCase read/write aliases
+    (ISSUE 3) are gone — snapshot()/since() expose canonical snake_case
+    keys only, and the ALIASES table no longer exists."""
     assert "transient_retries" in PC.COUNTERS
-    assert "transientRetries" not in PC.COUNTERS
+    assert not hasattr(PC, "ALIASES")
     snap = PC.snapshot()
-    PC.bump("transientRetries")          # legacy write spelling
+    for legacy in ("transientRetries", "oomRestarts", "runtimeFallbacks",
+                   "breakerTrips", "breakerPlanFallbacks",
+                   "queryFallbacks"):
+        assert legacy not in snap
     PC.bump("oom_restarts")
     d = PC.since(snap)
-    assert d["transient_retries"] == 1 and d["transientRetries"] == 1
-    assert d["oom_restarts"] == 1 and d["oomRestarts"] == 1
+    assert d["oom_restarts"] == 1
+    assert "oomRestarts" not in d
     PC.reset()
 
 
@@ -173,7 +176,7 @@ def test_concurrent_increments_lose_nothing():
 
     def worker():
         for _ in range(per_thread):
-            PC.bump("transientRetries")
+            PC.bump("transient_retries")
             PC.bump("bytes_h2d", 3)
 
     ts = [threading.Thread(target=worker) for _ in range(threads)]
@@ -182,6 +185,6 @@ def test_concurrent_increments_lose_nothing():
     for t in ts:
         t.join()
     d = PC.since(snap)
-    assert d["transientRetries"] == threads * per_thread
+    assert d["transient_retries"] == threads * per_thread
     assert d["bytes_h2d"] == threads * per_thread * 3
     PC.reset()

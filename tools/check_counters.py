@@ -52,19 +52,16 @@ def check() -> list:
     configs_md = read("configs.md")
 
     for key in sorted(PC.COUNTERS):
-        if key in PC.ALIASES:
-            continue
         # backtick-delimited: a bare substring test is vacuous for
         # counter names that are ordinary words ("compiles")
         if f"`{key}`" not in diag_md:
             problems.append(
                 f"perf counter '{key}' is not documented (backticked) in "
                 f"docs/diagnostics.md")
-    for key in sorted(PC.ALIASES):
-        if PC.ALIASES[key] not in PC.COUNTERS:
-            problems.append(
-                f"perfcounters alias '{key}' points at unknown "
-                f"counter '{PC.ALIASES[key]}'")
+    if hasattr(PC, "ALIASES"):
+        problems.append(
+            "perfcounters.ALIASES still exists — the one-release "
+            "camelCase compat window closed in ISSUE 7")
 
     diag_confs = [k for k in _REGISTRY
                   if k.startswith("spark.rapids.tpu.diagnostics.")]
@@ -178,6 +175,42 @@ def check() -> list:
     if "scan_prefetch" not in EVENT_SCHEMA:
         problems.append("diagnostics event type 'scan_prefetch' is not "
                         "registered in EVENT_SCHEMA")
+
+    # telemetry tier (ISSUE 7): confs + counters + the sampler's gauge
+    # vocabulary must be documented in docs/observability.md (and confs
+    # in the regenerated configs.md)
+    obs_md = read("observability.md")
+    tel_confs = [k for k in _REGISTRY
+                 if k.startswith("spark.rapids.tpu.telemetry.")]
+    if not tel_confs:
+        problems.append("no spark.rapids.tpu.telemetry.* confs "
+                        "registered")
+    for key in sorted(tel_confs):
+        if f"`{key}`" not in obs_md:
+            problems.append(
+                f"conf '{key}' is not documented in "
+                f"docs/observability.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+    for key in ("slo_violations", "postmortem_dumps"):
+        if key not in PC.COUNTERS:
+            problems.append(f"telemetry counter '{key}' is not "
+                            f"registered in perfcounters.COUNTERS")
+        if f"`{key}`" not in obs_md:
+            problems.append(
+                f"telemetry counter '{key}' is not documented in "
+                f"docs/observability.md")
+    for gauge in ("admission_running", "admission_queued",
+                  "active_queries", "hbm_pool_bytes", "hbm_used_bytes",
+                  "hbm_occupancy", "hot_cache_hit_rate",
+                  "compile_cache_hit_rate", "compile_registry_programs",
+                  "query_latency_p95_ms"):
+        if f"`{gauge}`" not in obs_md:
+            problems.append(
+                f"sampler gauge '{gauge}' is not documented in "
+                f"docs/observability.md")
     return problems
 
 

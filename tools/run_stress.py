@@ -78,9 +78,47 @@ def _shapes():
     return [q_agg, q_sort, q_join, q_distinct]
 
 
+def _dump_telemetry(path: str) -> dict:
+    """Write the process telemetry timeline + SLO summary to ``path``
+    (ISSUE 7 satellite): a stress run becomes an inspectable time series
+    (queue depth, HBM occupancy, rolling p95 per tick) instead of a
+    pass/fail line.  Returns the embedded summary for the caller's
+    JSON."""
+    import json
+
+    from spark_rapids_tpu import telemetry
+
+    hub = telemetry.get_hub()
+    if hub is None:
+        return {}
+    # one final tick so the dump includes the post-run state even when
+    # the run finished between sampler periods
+    try:
+        hub.sampler.tick()
+    except Exception:
+        pass
+    timeline = telemetry.timeline()
+    slo = telemetry.slo_summary()
+    out = {"timeline": timeline, "slo": slo,
+           "flight_events": hub.flight.events_recorded,
+           "postmortems": [p.get("reason") for p in hub.postmortems]}
+    if path:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+        os.replace(tmp, path)
+    peak_q = max((r.get("admission_queued", 0) for r in timeline),
+                 default=0)
+    peak_hbm = max((r.get("hbm_used_bytes", 0) for r in timeline),
+                   default=0)
+    return {"path": path or None, "ticks": len(timeline),
+            "peak_queue_depth": peak_q, "peak_hbm_bytes": peak_hbm,
+            "p95_ms": (slo.get("", {}) or {}).get("p95_ms", 0.0)}
+
+
 def run_stress(n_threads: int = 8, rounds: int = 3, seed: int = 7,
                cancel_budget: int = 4, timeout_ms: int = 0,
-               quiet: bool = False) -> dict:
+               quiet: bool = False, telemetry_out: str = "") -> dict:
     import random
 
     from spark_rapids_tpu import perfcounters as PC
@@ -116,7 +154,15 @@ def run_stress(n_threads: int = 8, rounds: int = 3, seed: int = 7,
         "spark.rapids.tpu.admission.maxQueueDepth": "32",
         "spark.rapids.tpu.resilience.backoffBaseMs": "0",
         "spark.rapids.sql.concurrentGpuTasks": "2",
+        # fast sampler ticks so even a seconds-long stress run records a
+        # usable telemetry timeline (ISSUE 7)
+        "spark.rapids.tpu.telemetry.samplePeriodMs": "50",
     }
+    # rebuild the hub with the fast-tick conf (the oracle sessions above
+    # already built one at the default period)
+    from spark_rapids_tpu import telemetry
+
+    telemetry.shutdown()
     if timeout_ms > 0:
         base_conf["spark.rapids.tpu.query.timeoutMs"] = str(timeout_ms)
 
@@ -200,6 +246,7 @@ def run_stress(n_threads: int = 8, rounds: int = 3, seed: int = 7,
             "queries_admitted", "queries_rejected", "queries_cancelled",
             "deadline_trips", "transient_retries", "oom_restarts",
             "runtime_fallbacks")},
+        "telemetry": _dump_telemetry(telemetry_out),
     }
     if not quiet:
         import json
@@ -209,7 +256,8 @@ def run_stress(n_threads: int = 8, rounds: int = 3, seed: int = 7,
 
 
 def run_hot_cache(n_threads: int = 8, rounds: int = 3,
-                  rows: int = 60_000, quiet: bool = False) -> dict:
+                  rows: int = 60_000, quiet: bool = False,
+                  telemetry_out: str = "") -> dict:
     """``--hot-cache`` mode (ISSUE 6): a repeated-query trace — every
     worker replays the SAME parquet table scan+aggregate — with the
     device-resident hot-table cache on.  After one warm run, all
@@ -247,6 +295,8 @@ def run_hot_cache(n_threads: int = 8, rounds: int = 3,
             "spark.rapids.sql.enabled": True,
             "spark.rapids.tpu.scan.hotTableCache.enabled": True,
             "spark.rapids.tpu.concurrentQueries": "4",
+            # fast ticks for an inspectable timeline, like run_stress
+            "spark.rapids.tpu.telemetry.samplePeriodMs": "50",
         }
 
         def q(s):
@@ -255,6 +305,11 @@ def run_hot_cache(n_threads: int = 8, rounds: int = 3,
 
         oracle = sorted(
             q(TpuSession({"spark.rapids.sql.enabled": False})).collect())
+        # rebuild the hub at the fast period (the oracle session above
+        # already built one at the default)
+        from spark_rapids_tpu import telemetry
+
+        telemetry.shutdown()
         warm_s = TpuSession(conf)
         assert sorted(q(warm_s).collect()) == oracle, "warm run diverged"
 
@@ -305,6 +360,7 @@ def run_hot_cache(n_threads: int = 8, rounds: int = 3,
             "bytes_h2d": d["bytes_h2d"],
             "failures": failures,
             "leaks": leaks,
+            "telemetry": _dump_telemetry(telemetry_out),
         }
         if not quiet:
             print(json.dumps(summary, indent=2))
@@ -324,16 +380,21 @@ def main() -> int:
     ap.add_argument("--hot-cache", action="store_true",
                     help="repeated-query hot-table-cache trace instead "
                          "of the mixed chaos sweep")
+    ap.add_argument("--telemetry-out", default="STRESS_TELEMETRY.json",
+                    help="write the telemetry timeline (queue depth, "
+                         "HBM occupancy, rolling p95 per sampler tick) "
+                         "+ SLO summary to this JSON file; '' disables")
     args = ap.parse_args()
     if args.hot_cache:
-        s = run_hot_cache(args.threads, args.rounds)
+        s = run_hot_cache(args.threads, args.rounds,
+                          telemetry_out=args.telemetry_out)
         ok = not s["failures"] and not s["leaks"]
         print(("PASS" if ok else "FAIL")
               + f": {s['hot_cache_hits']} cached replays, "
               f"{s['bytes_h2d']} H2D bytes in {s['wall_s']}s")
         return 0 if ok else 1
     s = run_stress(args.threads, args.rounds, args.seed, args.cancels,
-                   args.timeout_ms)
+                   args.timeout_ms, telemetry_out=args.telemetry_out)
     ok = not s["failures"] and not s["leaks"]
     print(("PASS" if ok else "FAIL")
           + f": {s['ok']} ok / {s['cancelled']} cancelled of "
