@@ -35,7 +35,10 @@ are listed under "skipped").  BENCH_OUT (default BENCH_STREAM.json, "0"
 disables) streams per-query results to a JSON file as each query
 completes — a `timeout` SIGKILL mid-suite still leaves a parseable
 record of everything finished; per-query counters now include
-compileWall_s and the compile-cache hit/miss counts.
+compileWall_s and the compile-cache hit/miss counts, plus the cost
+model's predicted-vs-actual wall (costPredictedWall_s,
+costModelHits/Misses — BENCH_PROFILE_DIR sets the calibration store,
+"0" disables).
 
 Query order (VERDICT r4 weak #2): q6 -> qa -> qb -> qc -> rung3 ->
 q6_parquet, so a budget kill can no longer erase the window or spill
@@ -377,6 +380,16 @@ def _time_repeats(fn, repeats, counters=False):
         "nFilesSkippedCorrupt": d["files_skipped_corrupt"] / repeats,
         "nFilesSkippedMissing": d["files_skipped_missing"] / repeats,
         "nFileDecoderFallbacks": d["file_decoder_fallbacks"] / repeats,
+        # cost model (ISSUE 8 satellite): the plan-time prediction the
+        # calibration store produced for each timed run vs the measured
+        # tpu_s — tools/bench_gate.py renders the (non-gating)
+        # prediction-error column from these
+        "costPredictedWall_s":
+            d["cost_model_predicted_wall_ns"] / repeats / 1e9,
+        "costMatchedActualWall_s":
+            d["cost_model_matched_actual_wall_ns"] / repeats / 1e9,
+        "costModelHits": d["cost_model_hits"] / repeats,
+        "costModelMisses": d["cost_model_misses"] / repeats,
     }
     return dt, out, per_run
 
@@ -405,6 +418,21 @@ def _diag_conf():
     }
 
 
+def _profile_conf():
+    """Calibration-store conf for bench sessions (ISSUE 8 satellite):
+    every bench round both FEEDS the store (operator spans fold in at
+    query_end) and MEASURES it (the plan-time prediction for each query
+    lands in the record as costPredictedWall_s, diffable across rounds
+    by tools/bench_gate.py's prediction-error column).
+    BENCH_PROFILE_DIR overrides the store location (default
+    profile_store; "0" disables — e.g. when comparing against a
+    pre-profiling baseline at sub-ms granularity)."""
+    prof_dir = os.environ.get("BENCH_PROFILE_DIR", "profile_store")
+    if not prof_dir or prof_dir == "0":
+        return {}
+    return {"spark.rapids.tpu.profile.dir": prof_dir}
+
+
 def _event_log_of(df) -> str:
     diag = getattr(df, "_last_diag", None)
     return getattr(diag, "event_log_path", None) or ""
@@ -417,6 +445,7 @@ def _session(enabled: bool, cache_batches: bool = False):
         "spark.rapids.sql.enabled": enabled,
         "spark.rapids.tpu.scan.cacheDeviceBatches": cache_batches,
         **_diag_conf(),
+        **_profile_conf(),
     })
 
 
@@ -541,6 +570,9 @@ def main():
         regressions = bench_gate.gate(base, payload)
         for r in regressions:
             print(f"REGRESSION: {r}", file=sys.stderr)
+        # informational cost-model drift column (never gates)
+        for p in bench_gate.prediction_report(base, payload):
+            print(f"note: {p}", file=sys.stderr)
         print("bench gate vs " + gate_path + ": "
               + ("PASS" if not regressions
                  else f"FAIL ({len(regressions)} regression(s))"),
@@ -909,7 +941,7 @@ def main():
                 "spark.rapids.memory.gpu.allocFraction": 0.0001,
                 "spark.rapids.sql.batchSizeBytes": 8 << 20,
                 "spark.rapids.sql.reader.batchSizeRows": max(n3 // 8, 1),
-                **_diag_conf()}
+                **_diag_conf(), **_profile_conf()}
         fw = get_spill_framework(TpuConf(conf))
         s = TpuSession(conf)
         df3 = build(s)
@@ -1048,7 +1080,7 @@ def main():
                 "spark.rapids.sql.enabled": True,
                 "spark.rapids.sql.format.parquet.decode.device": True,
                 "spark.rapids.sql.format.parquet.reader.type": "PERFILE",
-                **_diag_conf(),
+                **_diag_conf(), **_profile_conf(),
             })
             df = build_q6_scan(s)
             t_tpu, rows, ctr = _time_repeats(df.collect, 1, counters=True)
@@ -1076,7 +1108,7 @@ def main():
                     "spark.rapids.sql.format.parquet.reader.type":
                         "PERFILE",
                     "spark.rapids.tpu.scan.hotTableCache.enabled": True,
-                    **_diag_conf(),
+                    **_diag_conf(), **_profile_conf(),
                 })
                 df_hot = build_q6_scan(s_hot)
                 t_hot2, rows_hot, ctr_hot2 = _time_repeats(

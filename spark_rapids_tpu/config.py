@@ -766,6 +766,51 @@ TELEMETRY_SLO_TARGET_P95_MS = conf(
     "tools/bench_gate.py owns cross-run regression gating)."
 ).double_conf(0.0)
 
+# --- profiling (profiling/ — calibration store, cost model, advisor) -------
+
+PROFILE_DIR = conf("spark.rapids.tpu.profile.dir").doc(
+    "Directory for the persistent operator calibration store "
+    "(calibration.json, atomic merge-on-write).  When set, every "
+    "diagnostics-recorded query folds its per-operator spans "
+    "(self_wall_ns, syncs, H2D/D2H bytes, fallback/retry outcomes) into "
+    "per-(operator, expr-fingerprint, shape-bucket) decaying EWMAs at "
+    "query_end, and collect() annotates the plan with cost-model "
+    "predictions (cost_model_hits/misses/cost_model_predicted_wall_ns "
+    "counters, explain('cost')).  Unset (default): zero profiling-module "
+    "calls per query — the disabled path is free."
+).string_conf(None)
+
+PROFILE_EWMA_ALPHA = conf("spark.rapids.tpu.profile.ewmaAlpha").doc(
+    "Decay factor for the calibration store's exponentially weighted "
+    "moving averages: new = alpha*obs + (1-alpha)*old.  Higher tracks "
+    "drift faster; lower smooths noisy walls.  Clamped to (0, 1]."
+).double_conf(0.25)
+
+PROFILE_COST_MODEL_ENABLED = conf(
+    "spark.rapids.tpu.profile.costModel.enabled").doc(
+    "With profile.dir set, walk the planned exec tree before execution "
+    "and predict per-operator wall / transfer bytes / confidence from "
+    "the calibration store (explain('cost'), the cost_model diagnostics "
+    "event, and the cost_model_* counters).  false: the store still "
+    "accumulates observations but no plan-time prediction runs."
+).boolean_conf(True)
+
+PROFILE_ADVISOR_ENABLED = conf(
+    "spark.rapids.tpu.profile.advisor.enabled").doc(
+    "Consult the qualification advisory file (tools/qualify.py "
+    "--advisory-out) at plan time: an operator class the profile shows "
+    "as persistently fallback-heavy is routed to its native/CPU "
+    "placement (advisor_plan_fallbacks counter) while every other class "
+    "keeps its default placement.  Off by default — the seed of "
+    "cost-based routing, opt-in until the cost model earns trust."
+).boolean_conf(False)
+
+PROFILE_ADVISOR_FILE = conf("spark.rapids.tpu.profile.advisor.file").doc(
+    "Path of the advisory JSON the plan-time consult reads.  Unset: "
+    "<spark.rapids.tpu.profile.dir>/advisory.json when profile.dir is "
+    "set, else no advisory."
+).string_conf(None)
+
 MEM_DEBUG = conf("spark.rapids.memory.gpu.debug").doc(
     "Log arena allocations.").boolean_conf(False)
 

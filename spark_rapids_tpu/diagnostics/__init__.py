@@ -32,17 +32,50 @@ _SCOPE_LOCK = threading.Lock()
 _WARNED = [False]
 
 
+def _stamp_unrecorded(root, keep_qid=None) -> None:
+    """Mark a tree that is about to execute WITHOUT a recorder while some
+    OTHER query's recorder is (or may become) active: ``begin_op`` sees
+    the foreign ownership stamp and runs the span unrecorded, instead of
+    lazily registering the tree as ``+N`` runtime ops and interleaving a
+    concurrent query's spans into the active query's log/trace (ISSUE 8
+    satellite: per-query span trees must not interleave).
+
+    ``keep_qid``: nodes already stamped with the ACTIVE recorder's query
+    id are left untouched — two threads collecting the SAME DataFrame
+    share one cached exec tree, and the losing collect must not evict
+    the winner's registration (that would silently truncate the
+    recorded query's attribution mid-flight)."""
+    from spark_rapids_tpu.exec.base import TpuExec
+
+    def walk(node):
+        if not (keep_qid is not None
+                and getattr(node, "_diag_qid", None) == keep_qid):
+            node._diag_qid = "(unrecorded)"
+            node._diag_path = None
+        for c in node.children:
+            if isinstance(c, TpuExec):
+                walk(c)
+
+    walk(root)
+
+
 class query_scope:
     """Context manager installing a QueryDiagnostics recorder around one
     query execution (used by ``DataFrame.collect``).  Yields the recorder
     or None when diagnostics are disabled — or when another query's
     recorder is already active (one recorder per process; the concurrent
-    query runs unrecorded rather than corrupting the first's log)."""
+    query runs unrecorded rather than corrupting the first's log).
 
-    def __init__(self, conf, root, plan_text: str = ""):
+    ``on_finish`` (optional): called with the finished recorder after
+    ``finish()`` computed the operator summaries but BEFORE the sinks
+    flush (so it may still append, e.g. the profiling layer's
+    ``cost_model`` record) — its failures never fail the query."""
+
+    def __init__(self, conf, root, plan_text: str = "", on_finish=None):
         self._conf = conf
         self._root = root
         self._plan_text = plan_text
+        self._on_finish = on_finish
         self.diag = None
 
     def __enter__(self):
@@ -54,6 +87,15 @@ class query_scope:
         from spark_rapids_tpu.diagnostics import context as CTX
 
         if not self._conf.get(DIAGNOSTICS_ENABLED):
+            # another session's recorder is live: this undiagnosed
+            # query's spans must not land in its log as +N ops.  Only
+            # then — the disabled-path contract stays one conf read +
+            # one ambient check per collect (a recorder installed AFTER
+            # this check can still briefly absorb spans; the common
+            # overlap, recorder-first, is covered)
+            rec = CTX.RECORDER
+            if rec is not None:
+                _stamp_unrecorded(self._root, keep_qid=rec.query_id)
             return None
         with _SCOPE_LOCK:
             if CTX.RECORDER is not None:
@@ -62,6 +104,11 @@ class query_scope:
                     print("spark_rapids_tpu.diagnostics: a recorder is "
                           "already active; concurrent query runs "
                           "unrecorded", file=sys.stderr)
+                # under _SCOPE_LOCK the active recorder cannot change:
+                # keep_qid exactly protects a concurrently-recorded
+                # collect of the SAME DataFrame's shared exec tree
+                _stamp_unrecorded(self._root,
+                                  keep_qid=CTX.RECORDER.query_id)
                 return None
             from spark_rapids_tpu.diagnostics.recorder import (
                 QueryDiagnostics,
@@ -100,6 +147,12 @@ class query_scope:
             with _SCOPE_LOCK:
                 if CTX.RECORDER is self.diag:
                     CTX.RECORDER = None
+        if self._on_finish is not None:
+            try:
+                self._on_finish(self.diag)
+            except Exception as e:
+                print("spark_rapids_tpu.diagnostics: finish hook "
+                      f"failed: {e}", file=sys.stderr)
         self._write_sinks()
         return False
 

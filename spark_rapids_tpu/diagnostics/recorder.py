@@ -51,8 +51,11 @@ EVENT_SCHEMA: Dict[str, List[str]] = {
     "io_fault": ["kind", "path", "fmt", "detail"],
     "scan_prefetch": ["depth", "batches", "overlapped_bytes", "stall_ns"],
     "op_batch": ["path", "batch", "rows", "dur_ns"],
-    "operator": ["path", "name", "describe", "wall_ns", "self_wall_ns",
-                 "batches", "rows", "counters", "metrics", "fallback"],
+    "operator": ["path", "name", "describe", "op_class", "fp", "wall_ns",
+                 "self_wall_ns", "batches", "rows", "counters", "metrics",
+                 "fallback"],
+    "cost_model": ["hits", "misses", "predicted_wall_ns",
+                   "actual_wall_ns", "matched_actual_wall_ns"],
     "query_end": ["wall_ns", "status", "counters"],
 }
 
@@ -73,7 +76,7 @@ class _OpStat:
 
     __slots__ = ("path", "name", "describe", "wall_ns", "batches", "rows",
                  "t_first_ns", "t_last_ns", "counters", "metrics",
-                 "fallback")
+                 "fallback", "cal_op", "cal_fp")
 
     def __init__(self, path: str, name: str, describe: str):
         self.path = path
@@ -87,6 +90,24 @@ class _OpStat:
         self.counters: Dict[str, int] = {}
         self.metrics: Dict[str, int] = {}
         self.fallback = False
+        # calibration identity (ISSUE 8): the breaker/tagging plan key —
+        # (plan-node class, expr fingerprint) — so the operator summary
+        # event carries the key the profiling store and the plan-time
+        # cost model match on; None when the exec has no plan twin
+        self.cal_op: Optional[str] = None
+        self.cal_fp: Optional[str] = None
+
+
+def _cal_key_of(node):
+    """The exec's (plan class, expr fingerprint) via its plan twin —
+    cached on the exec by resilience.domain, so this is a dict hit on
+    every collect after the first."""
+    try:
+        from spark_rapids_tpu.resilience.domain import _breaker_key_of
+
+        return _breaker_key_of(node)
+    except Exception:
+        return None
 
 
 class QueryDiagnostics:
@@ -138,11 +159,14 @@ class QueryDiagnostics:
         def walk(node, path):
             node._diag_path = path
             node._diag_qid = self.query_id
+            cal = _cal_key_of(node)
             with self._lock:
                 if path not in self.ops:
                     self.ops[path] = _OpStat(path, node.node_name,
                                              node.describe())
                     self._op_order.append(path)
+                if cal is not None:
+                    self.ops[path].cal_op, self.ops[path].cal_fp = cal
                 self._metric_base[path] = {
                     m.name: m.value for m in node.metrics.values()}
             for i, c in enumerate(node.children):
@@ -154,10 +178,13 @@ class QueryDiagnostics:
     def _register_runtime_op(self, op) -> str:
         """An exec created after planning (adaptive re-plan, runtime CPU
         fallback shim) registers lazily under a ``+N`` path."""
+        cal = _cal_key_of(op)
         with self._lock:
             self._extra_seq += 1
             path = f"+{self._extra_seq}"
             self.ops[path] = _OpStat(path, op.node_name, op.describe())
+            if cal is not None:
+                self.ops[path].cal_op, self.ops[path].cal_fp = cal
             self._op_order.append(path)
             self._metric_base[path] = {
                 m.name: m.value for m in op.metrics.values()}
@@ -399,7 +426,9 @@ class QueryDiagnostics:
                 self.events.append({
                     "ev": "operator", "ts_ns": self.wall_ns, "op": path,
                     "path": path, "name": st.name,
-                    "describe": st.describe, "wall_ns": st.wall_ns,
+                    "describe": st.describe,
+                    "op_class": st.cal_op, "fp": st.cal_fp,
+                    "wall_ns": st.wall_ns,
                     "self_wall_ns": max(
                         st.wall_ns - child_wall.get(path, 0), 0),
                     "batches": st.batches, "rows": st.rows,
@@ -412,6 +441,26 @@ class QueryDiagnostics:
                 "wall_ns": self.wall_ns, "status": status,
                 "events_dropped": self.dropped_events,
                 "counters": dict(self.total)})
+            self.n_events = len(self.events)
+
+    def record_cost_model(self, hits: int, misses: int,
+                          predicted_wall_ns: int, actual_wall_ns: int,
+                          matched_actual_wall_ns: int) -> None:
+        """The per-query predicted-vs-actual record (ISSUE 8).  The
+        profiling finish hook runs after ``finish()`` closed the window
+        but before the sinks flush, so this appends past the closed
+        flag — inserted BEFORE the trailing query_end to keep the
+        query_end-last log invariant."""
+        e = {"ev": "cost_model", "ts_ns": self.wall_ns, "op": "",
+             "hits": int(hits), "misses": int(misses),
+             "predicted_wall_ns": int(predicted_wall_ns),
+             "actual_wall_ns": int(actual_wall_ns),
+             "matched_actual_wall_ns": int(matched_actual_wall_ns)}
+        with self._lock:
+            if self.events and self.events[-1].get("ev") == "query_end":
+                self.events.insert(len(self.events) - 1, e)
+            else:
+                self.events.append(e)
             self.n_events = len(self.events)
 
     def header(self) -> Dict[str, Any]:

@@ -18,7 +18,7 @@ class QueryProfile:
 
     __slots__ = ("path", "query_id", "started_at", "metrics_level",
                  "plan", "operators", "events", "totals", "wall_ns",
-                 "status")
+                 "status", "parse_errors", "events_dropped")
 
     def __init__(self):
         self.path = ""
@@ -31,6 +31,17 @@ class QueryProfile:
         self.totals: Dict[str, int] = {}
         self.wall_ns = 0
         self.status = ""
+        # data-quality flags (ISSUE 8 satellite): malformed/truncated
+        # JSONL lines skipped while parsing this file (a query killed
+        # mid-write leaves a torn trailing line), and the recorder-side
+        # in-memory overflow count from query_end — either nonzero means
+        # this query's aggregates are incomplete
+        self.parse_errors = 0
+        self.events_dropped = 0
+
+    @property
+    def incomplete(self) -> bool:
+        return self.parse_errors > 0 or self.events_dropped > 0
 
     @property
     def plan_signature(self) -> str:
@@ -40,14 +51,26 @@ class QueryProfile:
 
 
 def load_query_log(path: str) -> QueryProfile:
+    """Parse one query log, tolerating torn lines: a query killed
+    mid-write (SIGKILL between the sink's write and rename never
+    happens, but a NON-atomic copy/tail of a live log does get truncated)
+    must yield whatever parsed instead of raising — skipped lines are
+    counted into ``parse_errors`` and the report flags the query's
+    aggregates as incomplete."""
     qp = QueryProfile()
     qp.path = path
-    with open(path) as f:
+    with open(path, errors="replace") as f:
         for line in f:
             line = line.strip()
             if not line:
                 continue
-            e = json.loads(line)
+            try:
+                e = json.loads(line)
+                if not isinstance(e, dict):
+                    raise ValueError("not an event object")
+            except ValueError:
+                qp.parse_errors += 1
+                continue
             ev = e.get("ev")
             if ev == "query_start":
                 qp.query_id = e.get("query_id", "")
@@ -60,6 +83,7 @@ def load_query_log(path: str) -> QueryProfile:
                 qp.totals = e.get("counters", {})
                 qp.wall_ns = e.get("wall_ns", 0)
                 qp.status = e.get("status", "")
+                qp.events_dropped = int(e.get("events_dropped", 0) or 0)
             else:
                 qp.events.append(e)
     return qp
@@ -202,11 +226,36 @@ def _fmt_bytes(n: float) -> str:
     return f"{n:.1f}TB"
 
 
+def data_quality_warnings(profiles: List[QueryProfile]) -> List[str]:
+    """Header warnings for incomplete inputs: queries whose in-memory
+    event list overflowed (events_dropped > 0 — their aggregates are
+    lower bounds) and files with skipped malformed/truncated lines."""
+    out = []
+    dropped = [qp for qp in profiles if qp.events_dropped > 0]
+    if dropped:
+        ids = ", ".join((qp.query_id or qp.path) for qp in dropped[:5])
+        more = "" if len(dropped) <= 5 else f" (+{len(dropped) - 5} more)"
+        out.append(
+            f"WARNING: {len(dropped)} quer"
+            f"{'y' if len(dropped) == 1 else 'ies'} dropped events "
+            f"in-memory — aggregates incomplete: {ids}{more}")
+    torn = sum(qp.parse_errors for qp in profiles)
+    if torn:
+        files = sum(1 for qp in profiles if qp.parse_errors)
+        out.append(
+            f"WARNING: skipped {torn} malformed/truncated line"
+            f"{'' if torn == 1 else 's'} across {files} file"
+            f"{'' if files == 1 else 's'} (query killed mid-write?) — "
+            f"affected aggregates incomplete")
+    return out
+
+
 def render_report(profiles: List[QueryProfile], top_n: int = 10) -> str:
     out = []
     tot = totals_summary(profiles)
     out.append(f"== profile report: {len(profiles)} quer"
                f"{'y' if len(profiles) == 1 else 'ies'} ==")
+    out.extend(data_quality_warnings(profiles))
     out.append(
         f"total wall {tot.get('wall_ns', 0) / 1e9:.3f}s | launches "
         f"{int(tot.get('programs_launched', 0))} | host syncs "

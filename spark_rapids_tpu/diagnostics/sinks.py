@@ -79,9 +79,18 @@ def _rotate(directory: str, prefix: str, suffix: str,
 # Chrome trace / Perfetto
 # ---------------------------------------------------------------------------
 
+def trace_pid(query_id: str) -> int:
+    """Stable per-QUERY trace pid: concurrent queries' traces merge into
+    one Perfetto timeline as separate process groups instead of
+    interleaving on pid 0 (ISSUE 8 satellite)."""
+    import zlib
+
+    return (zlib.crc32(query_id.encode("utf-8")) & 0x3FFFFFFF) or 1
+
+
 def chrome_trace(diag: QueryDiagnostics) -> Dict[str, Any]:
     """Build the Chrome trace-event dict for one finished query."""
-    pid = 0
+    pid = trace_pid(diag.query_id)
     tids: Dict[str, int] = {}
     trace: List[Dict[str, Any]] = []
     seq = [0]
@@ -91,6 +100,8 @@ def chrome_trace(diag: QueryDiagnostics) -> Dict[str, Any]:
         ev["_seq"] = seq[0]
         trace.append(ev)
 
+    emit({"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+          "ts": 0, "args": {"name": f"query {diag.query_id}"}})
     stats = diag.operator_stats()
     for i, st in enumerate(stats):
         tids[st.path] = i
@@ -139,6 +150,15 @@ def chrome_trace(diag: QueryDiagnostics) -> Dict[str, Any]:
                   "pid": pid, "tid": tid, "ts": ts_us,
                   "args": {"op": e.get("op_name", ""),
                            "detail": e.get("detail", "")}})
+        elif ev == "cost_model":
+            emit({"ph": "i", "s": "p", "name": "cost_model",
+                  "pid": pid, "tid": tid, "ts": ts_us,
+                  "args": {"hits": e.get("hits", 0),
+                           "misses": e.get("misses", 0),
+                           "predicted_wall_ms": round(
+                               e.get("predicted_wall_ns", 0) / 1e6, 3),
+                           "actual_wall_ms": round(
+                               e.get("actual_wall_ns", 0) / 1e6, 3)}})
     # monotonic ts; B sorts before its E at equal ts via emission order,
     # and nested X events never straddle their operator's B/E interval
     trace.sort(key=lambda ev: (ev["ts"], ev["_seq"]))

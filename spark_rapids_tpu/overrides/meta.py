@@ -196,6 +196,25 @@ class SparkPlanMeta(BaseMeta):
 
             PC.bump("breaker_plan_fallbacks")
             self.will_not_work_on_tpu(reason)
+        # qualification advisory (profiling/advisor.py, ISSUE 8): an
+        # operator class the accumulated profile shows as persistently
+        # fallback-heavy is routed to its native/CPU placement at plan
+        # time — opt-in (off-by-default conf), every other class keeps
+        # its default placement; the conf gate keeps the disabled path
+        # free of profiling-module calls
+        from spark_rapids_tpu.config import PROFILE_ADVISOR_ENABLED
+
+        if self.conf.get(PROFILE_ADVISOR_ENABLED):
+            from spark_rapids_tpu.profiling.advisor import (
+                consult_plan_advisor,
+            )
+
+            reason = consult_plan_advisor(self.plan, self.conf)
+            if reason:
+                from spark_rapids_tpu import perfcounters as PC
+
+                PC.bump("advisor_plan_fallbacks")
+                self.will_not_work_on_tpu(reason)
 
     # ------------------------------------------------------------------
     def explain(self, indent: int = 0, only_fallback: bool = True) -> str:

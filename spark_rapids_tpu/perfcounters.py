@@ -94,6 +94,17 @@ COUNTERS: Dict[str, int] = {
     # and flight-recorder post-mortem bundles produced
     "slo_violations": 0,
     "postmortem_dumps": 0,
+    # profile-driven cost model (ISSUE 8, profiling/): plan nodes the
+    # calibration store matched / missed at plan time, the summed
+    # predicted self-wall of the matched nodes, the measured self-wall
+    # of those same nodes (the apples-to-apples denominator for
+    # prediction error), and operator classes the qualification
+    # advisory routed off the device at plan time
+    "cost_model_hits": 0,
+    "cost_model_misses": 0,
+    "cost_model_predicted_wall_ns": 0,
+    "cost_model_matched_actual_wall_ns": 0,
+    "advisor_plan_fallbacks": 0,
 }
 
 
@@ -113,6 +124,20 @@ def bump(key: str, n: int = 1) -> None:
         rec = _DIAG.RECORDER
         if rec is not None:
             rec.attribute(key, n)
+
+
+def bump_unattributed(key: str, n: int = 1) -> None:
+    """Global-only increment that deliberately BYPASSES recorder
+    attribution: for values produced OUTSIDE any query window (e.g. a
+    finish hook running after its own recorder already closed), where
+    routing through ``bump`` would attribute them to a concurrently
+    installed OTHER query's recorder and contaminate that query's log.
+    The global delta of such a key can therefore exceed a window's
+    attributed per-op sums.  Users: the profiling finish hook's
+    matched-actual bump and an UNRECORDED collect's cost_model_*
+    prediction bumps (docs/profiling.md)."""
+    with _LOCK:
+        COUNTERS[key] = COUNTERS.get(key, 0) + n
 
 
 def snapshot() -> Dict[str, int]:

@@ -1,0 +1,205 @@
+"""Profile-driven cost model (ISSUE 8): the persistent operator
+calibration store, the plan-time cost model, and the qualification /
+routing advisor.
+
+Reference analog: NVIDIA ships a whole sibling repo of qualification and
+profiling tools (spark-rapids-tools, SURVEY §5.1) that mine event logs
+to tell users what will and won't benefit from acceleration.  Here the
+loop closes in-process: diagnostics operator spans (PR 3) fold into a
+persistent per-(operator, expr-fingerprint, shape-bucket) store at
+``query_end``; before the NEXT execution the cost model matches the
+planned exec tree against the store and annotates ``explain("cost")``
+with predicted wall / transfer / confidence; and ``tools/qualify.py``
+turns the accumulated profile into routing recommendations that
+``overrides/meta.py`` consults behind the off-by-default advisor conf.
+
+Layout:
+  store.py    — CalibrationStore (atomic merge-on-write JSON, EWMAs)
+  ingest.py   — event-log replay (tools/profile_ingest.py) + the live
+                recorder harvest
+  model.py    — plan-time prediction + explain("cost") rendering
+  advisor.py  — per-operator-class qualification + the plan-time consult
+
+Overhead contract: with ``spark.rapids.tpu.profile.dir`` unset (the
+default) a collect makes ZERO calls into this package — every call site
+gates on the conf before importing anything here
+(tests/test_profiling.py pins it with cProfile, the same methodology as
+the diagnostics and telemetry disabled-path pins).
+
+This module is the session-facing surface: :func:`annotate_plan` runs
+pre-execution inside the diagnostics window, :func:`record_query` runs
+as the ``query_scope`` finish hook (post-``finish()``, pre-sink-flush).
+Both swallow their own failures — profiling must never fail a query.
+"""
+from __future__ import annotations
+
+import sys
+
+
+def annotate_plan(root, conf, attributed: bool = True):
+    """Pre-execution: predict the planned tree's cost from the store
+    and bump the cost_model_* counters.  ``attributed=True`` only when
+    THIS collect owns the active recorder (the bumps then land in its
+    own window); a collect running unrecorded — diagnostics off, or it
+    lost the one-recorder slot — must bump UNattributed, or its counts
+    would land in the concurrently recorded query's log.  Returns the
+    QueryPrediction or None.  The caller threads the return value to
+    ``record_query`` itself — stashing it on the (cached, shared) plan
+    root would let a losing concurrent collect of the same DataFrame
+    clobber the recorded query's prediction."""
+    try:
+        from spark_rapids_tpu.config import (
+            PROFILE_COST_MODEL_ENABLED,
+            PROFILE_DIR,
+            PROFILE_EWMA_ALPHA,
+        )
+
+        prof_dir = conf.get(PROFILE_DIR)
+        if not prof_dir or not conf.get(PROFILE_COST_MODEL_ENABLED):
+            return None
+        from spark_rapids_tpu import perfcounters as PC
+        from spark_rapids_tpu.profiling.model import predict_tree
+        from spark_rapids_tpu.profiling.store import CalibrationStore
+
+        store = CalibrationStore.load_cached(
+            prof_dir, alpha=float(conf.get(PROFILE_EWMA_ALPHA)))
+        pred = predict_tree(root, store)
+        bump = PC.bump if attributed else PC.bump_unattributed
+        if pred.hits:
+            bump("cost_model_hits", pred.hits)
+            bump("cost_model_predicted_wall_ns",
+                 pred.predicted_wall_ns)
+        if pred.misses:
+            bump("cost_model_misses", pred.misses)
+        return pred
+    except Exception as e:
+        print(f"spark_rapids_tpu.profiling: plan annotation failed: {e}",
+              file=sys.stderr)
+        return None
+
+
+def record_query(diag, conf, prediction=None) -> None:
+    """query_scope finish hook (caller gated on profile.dir): fold the
+    finished recorder's operator spans into the calibration store,
+    append the per-query predicted-vs-actual ``cost_model`` diagnostics
+    event, and mirror it into the telemetry registry.  ``prediction``
+    is THIS collect's ``annotate_plan`` result (None when the cost
+    model is disabled or prediction failed)."""
+    try:
+        from spark_rapids_tpu.config import PROFILE_DIR, PROFILE_EWMA_ALPHA
+
+        prof_dir = conf.get(PROFILE_DIR)
+        if not prof_dir:
+            return
+        from spark_rapids_tpu.profiling.ingest import (
+            observations_from_events,
+        )
+        from spark_rapids_tpu.profiling.store import CalibrationStore
+
+        # ONE locked copy of the event list serves both harvests below
+        # (the observations and the per-path actual self-walls)
+        with diag._lock:
+            events = list(diag.events)
+        # only CLEAN queries calibrate: a cancelled/deadline-tripped/
+        # failed query's spans are truncated mid-flight, and folding
+        # their partial walls into the EWMAs would teach the cost model
+        # systematically short estimates for exactly the operators that
+        # time out
+        obs = observations_from_events(events) \
+            if diag.status == "ok" else []
+        if obs:
+            # write-only store: no load() — save() merges the pending
+            # observations onto a fresh disk read anyway, so a prior
+            # full parse of the store would be pure waste on the
+            # query's exit path
+            store = CalibrationStore(
+                prof_dir, alpha=float(conf.get(PROFILE_EWMA_ALPHA)))
+            store.observe_many(obs)
+            store.save()
+        pred = prediction
+        if pred is None:
+            return
+        # apples-to-apples actual: the matched operators' recorded self
+        # wall (the query wall includes unmatched operators + host work)
+        actual_by_path = {
+            e.get("path", ""): int(e.get("self_wall_ns", 0))
+            for e in events if e.get("ev") == "operator"}
+        matched_actual = sum(
+            actual_by_path.get(n.path, 0)
+            for n in pred.nodes if n.matched != "miss")
+        from spark_rapids_tpu import perfcounters as PC
+
+        # the measured twin of cost_model_predicted_wall_ns — bench
+        # divides the two for an apples-to-apples prediction error.
+        # UNATTRIBUTED: this hook runs after its own recorder closed; a
+        # plain bump would attribute the value to whatever OTHER
+        # query's recorder is installed by now
+        PC.bump_unattributed("cost_model_matched_actual_wall_ns",
+                             matched_actual)
+        diag.record_cost_model(
+            hits=pred.hits, misses=pred.misses,
+            predicted_wall_ns=pred.predicted_wall_ns,
+            actual_wall_ns=diag.wall_ns,
+            matched_actual_wall_ns=matched_actual)
+        _record_telemetry(pred, matched_actual, diag.wall_ns)
+    except Exception as e:
+        print(f"spark_rapids_tpu.profiling: query recording failed: {e}",
+              file=sys.stderr)
+
+
+def _record_telemetry(pred, matched_actual_ns: int,
+                      wall_ns: int) -> None:
+    """Predicted-vs-actual gauges for the always-on registry (ISSUE 7):
+    calibration drift is visible on the same surface as latency/SLOs."""
+    from spark_rapids_tpu import telemetry
+
+    hub = telemetry.get_hub()
+    if hub is None:
+        return
+    reg = hub.registry
+    reg.record("cost_model_predicted_wall_ms",
+               pred.predicted_wall_ns / 1e6)
+    reg.record("cost_model_matched_actual_wall_ms",
+               matched_actual_ns / 1e6)
+    total = pred.hits + pred.misses
+    reg.record("cost_model_hit_rate",
+               pred.hits / total if total else 0.0)
+    if pred.predicted_wall_ns and matched_actual_ns:
+        err = abs(pred.predicted_wall_ns - matched_actual_ns) \
+            / float(matched_actual_ns)
+        reg.record("cost_model_prediction_error", err)
+
+
+def explain_cost(df) -> str:
+    """``df.explain("cost")`` implementation (session.py delegates)."""
+    from spark_rapids_tpu.config import (
+        PROFILE_COST_MODEL_ENABLED,
+        PROFILE_DIR,
+        PROFILE_EWMA_ALPHA,
+    )
+    from spark_rapids_tpu.exec.base import TpuExec
+
+    root, _meta = df._planned()
+    if not isinstance(root, TpuExec):
+        return "(plan runs on the CPU oracle; no TPU cost model)"
+    conf = df.session.conf
+    prof_dir = conf.get(PROFILE_DIR)
+    if not prof_dir:
+        return ("(no calibration store: set spark.rapids.tpu.profile.dir "
+                "to enable the cost model — see docs/profiling.md)")
+    if not conf.get(PROFILE_COST_MODEL_ENABLED):
+        return ("(cost model disabled by spark.rapids.tpu.profile."
+                "costModel.enabled=false; the store still accumulates "
+                "observations)")
+    from spark_rapids_tpu.profiling.model import (
+        predict_tree,
+        render_cost_tree,
+    )
+    from spark_rapids_tpu.profiling.store import CalibrationStore
+
+    store = CalibrationStore.load_cached(
+        prof_dir, alpha=float(conf.get(PROFILE_EWMA_ALPHA)))
+    pred = predict_tree(root, store)
+    diag = getattr(df, "_last_diag", None)
+    return render_cost_tree(root, pred, diag=diag,
+                            store_path=store.path)

@@ -1,0 +1,198 @@
+"""Qualification / routing advisor (the spark-rapids-tools qualification
+analog, SURVEY §5.1).
+
+:func:`classify` rolls the calibration store up per operator CLASS and
+flags each as **fallback-heavy** (runtime CPU fallbacks dominate its
+observations — the device placement is wasted work), **sync-bound**
+(host round-trips per batch above threshold), or **transport-bound**
+(scan-transfer wall dominates its span).  Only fallback-heavy flips the
+routing recommendation (``device`` → ``native``): that is the one case
+the profile *proves* the default placement loses; sync/transport flags
+are tuning advice, not routing.
+
+The advisory is a machine-readable JSON file (``tools/qualify.py
+--advisory-out``); :func:`consult_plan_advisor` is the plan-time hook
+``overrides/meta.py`` calls behind the off-by-default
+``spark.rapids.tpu.profile.advisor.enabled`` — the seed of cost-based
+routing without changing default plans.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.profiling.store import CalibrationStore
+
+ADVISORY_VERSION = 1
+ADVISORY_FILENAME = "advisory.json"
+
+ROUTE_DEVICE = "device"
+ROUTE_NATIVE = "native"
+ROUTE_CPU = "cpu"
+
+# classification thresholds (CLI-overridable in tools/qualify.py)
+DEFAULT_MIN_OBS = 2             # classes seen fewer times stay device
+DEFAULT_FALLBACK_RATIO = 0.5    # fallback obs / obs ≥ this → native
+DEFAULT_SYNCS_PER_BATCH = 4.0   # host syncs per batch ≥ this → flagged
+DEFAULT_TRANSPORT_SHARE = 0.5   # scan transfer / wall ≥ this → flagged
+
+
+def classify(store: CalibrationStore,
+             min_obs: int = DEFAULT_MIN_OBS,
+             fallback_ratio: float = DEFAULT_FALLBACK_RATIO,
+             syncs_per_batch: float = DEFAULT_SYNCS_PER_BATCH,
+             transport_share: float = DEFAULT_TRANSPORT_SHARE
+             ) -> Dict[str, Any]:
+    """The advisory payload for one store."""
+    operators: Dict[str, Dict[str, Any]] = {}
+    for op, a in sorted(store.by_op_class().items()):
+        obs = int(a["obs"])
+        flags: List[str] = []
+        reasons: List[str] = []
+        route = ROUTE_DEVICE
+        fb = a["fallback_obs"] / obs if obs else 0.0
+        if obs >= min_obs and fb >= fallback_ratio:
+            flags.append("fallback-heavy")
+            reasons.append(
+                f"{int(a['fallback_obs'])}/{obs} observed spans fell "
+                f"back to CPU at runtime ({fb * 100:.0f}%)")
+            route = ROUTE_NATIVE
+        batches = a["batches"] or 1.0
+        spb = a["host_syncs"] / batches
+        if obs >= min_obs and spb >= syncs_per_batch:
+            flags.append("sync-bound")
+            reasons.append(
+                f"{spb:.1f} host syncs per batch (threshold "
+                f"{syncs_per_batch:g})")
+        wall = a["wall_ns"] or 1.0
+        tshare = a["scan_transfer_ns"] / wall
+        if obs >= min_obs and tshare >= transport_share:
+            flags.append("transport-bound")
+            reasons.append(
+                f"{tshare * 100:.0f}% of wall inside scan transfer "
+                f"(threshold {transport_share * 100:.0f}%)")
+        operators[op] = {
+            "route": route,
+            "flags": flags,
+            "reasons": reasons,
+            "confidence": min(1.0, obs / 10.0),
+            "stats": {
+                "obs": obs,
+                "fallback_ratio": round(fb, 4),
+                "syncs_per_batch": round(spb, 3),
+                "transport_share": round(tshare, 4),
+                "mean_self_wall_ms":
+                    round(a["self_wall_ns"] / 1e6, 3),
+                "mean_bytes_h2d": round(a["bytes_h2d"], 1),
+                "mean_bytes_d2h": round(a["bytes_d2h"], 1),
+            },
+        }
+    return {
+        "version": ADVISORY_VERSION,
+        "generated_at": time.time(),
+        "store": store.path,
+        "thresholds": {"min_obs": min_obs,
+                       "fallback_ratio": fallback_ratio,
+                       "syncs_per_batch": syncs_per_batch,
+                       "transport_share": transport_share},
+        "operators": operators,
+    }
+
+
+def write_advisory(advisory: Dict[str, Any], path: str) -> str:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(advisory, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+# -- plan-time consult (overrides/meta.py hook) -----------------------------
+
+_CACHE_LOCK = threading.Lock()
+# bounded like the store read cache: many distinct advisory paths over
+# a process lifetime must not pin one parsed advisory each forever
+_CACHE_MAX = 8
+_CACHED: Dict[str, Tuple[Tuple[int, int], Optional[Dict]]] = {}
+
+
+def advisory_path(conf) -> Optional[str]:
+    """Where the consult reads from: the explicit file conf, else the
+    profile dir's default advisory name, else nowhere."""
+    from spark_rapids_tpu.config import PROFILE_ADVISOR_FILE, PROFILE_DIR
+
+    explicit = conf.get(PROFILE_ADVISOR_FILE)
+    if explicit:
+        return explicit
+    prof_dir = conf.get(PROFILE_DIR)
+    if prof_dir:
+        return os.path.join(prof_dir, ADVISORY_FILENAME)
+    return None
+
+
+def advisory_state(conf) -> Optional[Tuple[str, int, int]]:
+    """(path, mtime_ns, size) of the advisory the consult would read —
+    part of the plan-cache key, so editing the file re-tags cached
+    plans; None when no advisory applies."""
+    path = advisory_path(conf)
+    if not path:
+        return None
+    try:
+        st = os.stat(path)
+    except OSError:
+        return (path, 0, -1)
+    return (path, st.st_mtime_ns, st.st_size)
+
+
+def load_advisory(path: str) -> Optional[Dict[str, Any]]:
+    """Parse + cache by (mtime_ns, size); None when absent, unreadable,
+    or a different version (an old advisory must not silently keep
+    routing under new semantics)."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    stamp = (st.st_mtime_ns, st.st_size)
+    with _CACHE_LOCK:
+        hit = _CACHED.get(path)
+        if hit is not None and hit[0] == stamp:
+            return hit[1]
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+        if not isinstance(payload, dict) \
+                or payload.get("version") != ADVISORY_VERSION:
+            payload = None
+    except (OSError, ValueError):
+        payload = None
+    from spark_rapids_tpu.profiling.store import bounded_cache_put
+
+    with _CACHE_LOCK:
+        bounded_cache_put(_CACHED, path, (stamp, payload), _CACHE_MAX)
+    return payload
+
+
+def consult_plan_advisor(plan, conf) -> Optional[str]:
+    """The fallback reason when the advisory routes this plan node's
+    operator class off the device, else None.  Caller (SparkPlanMeta)
+    already checked spark.rapids.tpu.profile.advisor.enabled."""
+    path = advisory_path(conf)
+    if not path:
+        return None
+    adv = load_advisory(path)
+    if adv is None:
+        return None
+    ent = (adv.get("operators") or {}).get(type(plan).__name__)
+    if not ent:
+        return None
+    route = ent.get("route")
+    if route not in (ROUTE_NATIVE, ROUTE_CPU):
+        return None
+    why = "; ".join(ent.get("reasons") or []) or "profile recommendation"
+    return (f"profiling advisor routes {type(plan).__name__} to {route} "
+            f"({why}) [spark.rapids.tpu.profile.advisor.enabled=true, "
+            f"advisory {path}]")

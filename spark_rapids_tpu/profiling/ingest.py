@@ -1,0 +1,74 @@
+"""Event-log → calibration-store ingestion (the offline half).
+
+Two producers feed the same :class:`~spark_rapids_tpu.profiling.store.
+CalibrationStore`:
+
+* **online** — ``profiling.record_query`` (wired into the diagnostics
+  ``query_scope`` finish hook) harvests the just-finished recorder's
+  operator events at ``query_end`` through
+  :func:`observations_from_events`;
+* **offline** — :func:`ingest_logs` replays diagnostics JSONL event
+  logs (``tools/profile_ingest.py``), tolerating truncated trailing
+  lines, so a recorded bench corpus or a production event-log directory
+  can seed a fresh store byte-identically to what the online path would
+  have accumulated (the feedback-loop pin in tests/test_profiling.py
+  relies on this equivalence).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List
+
+from spark_rapids_tpu.profiling.store import CalibrationStore, Observation
+
+
+def observations_from_events(events: Iterable[Dict[str, Any]]
+                             ) -> List[Observation]:
+    """Observations from an event stream (parsed JSONL lines or a live
+    recorder's in-memory list) — one per ``operator`` event that carries
+    a calibration identity and recorded work."""
+    out = []
+    for e in events:
+        if e.get("ev") != "operator":
+            continue
+        obs = Observation.from_operator_event(e)
+        if obs is not None:
+            out.append(obs)
+    return out
+
+
+def ingest_logs(log_paths: List[str], store_dir: str,
+                alpha: float = 0.25, return_store: bool = False):
+    """Replay diagnostics event logs into the store at ``store_dir``;
+    returns ingestion stats (or ``(stats, store)`` with
+    ``return_store=True`` — the merged in-memory state, saving callers
+    a re-parse).  Truncated/corrupt trailing lines are skipped with a
+    count (``parse_errors``), never raised — a query killed mid-write
+    must not poison the whole corpus.  Queries that did not finish
+    clean (``status != ok``: cancelled, deadline-tripped, errored) are
+    skipped — their spans are truncated mid-flight and would bias the
+    wall EWMAs short (mirrors the online ``record_query`` rule)."""
+    from spark_rapids_tpu.diagnostics.report import load_logs
+
+    profiles = load_logs(log_paths)
+    store = CalibrationStore.load(store_dir, alpha=alpha)
+    n_obs = 0
+    parse_errors = 0
+    incomplete = 0
+    skipped_unclean = 0
+    for qp in profiles:
+        parse_errors += qp.parse_errors
+        if qp.events_dropped:
+            incomplete += 1
+        if qp.status != "ok":
+            skipped_unclean += 1
+            continue
+        n_obs += store.observe_many(
+            Observation.from_operator_event(e) for e in qp.operators)
+    if n_obs:
+        store.save()
+    stats = {"queries": len(profiles), "observations": n_obs,
+             "entries": len(store.entries),
+             "parse_errors": parse_errors,
+             "incomplete_queries": incomplete,
+             "skipped_unclean": skipped_unclean}
+    return (stats, store) if return_store else stats

@@ -1,0 +1,210 @@
+"""Plan-time cost model over the calibration store.
+
+Before execution (and for ``df.explain("cost")``) the model walks the
+planned exec tree, computes each node's calibration identity — the
+``resilience.breaker.plan_key`` (operator class + expression
+fingerprint) via the exec's plan twin, exactly what the breaker and the
+plan-time tagging already compute — predicts its shape bucket from the
+AOT row estimates, and matches the store: exact-bucket hits predict at
+full confidence, nearest-bucket matches at half, and unseen pairs are
+misses.  Predictions are per-operator EWMAs read straight back
+(``self_wall_ns``, transfer bytes, host syncs), so a store seeded from
+one recorded run predicts that run's profile exactly — the property the
+feedback-loop pin in tests/test_profiling.py asserts.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.profiling.store import CalibrationStore, bucket_of
+
+# observations before an exact-bucket match reaches full confidence
+_FULL_CONFIDENCE_OBS = 5
+
+
+class NodePrediction:
+    __slots__ = ("path", "node_name", "describe", "op_class", "fp",
+                 "bucket", "matched", "obs", "predicted_self_wall_ns",
+                 "predicted_transfer_bytes", "predicted_syncs",
+                 "confidence")
+
+    def __init__(self, path: str, node_name: str, describe: str):
+        self.path = path
+        self.node_name = node_name
+        self.describe = describe
+        self.op_class: Optional[str] = None
+        self.fp: Optional[str] = None
+        self.bucket: Optional[int] = None
+        self.matched = "miss"          # "exact" | "nearest" | "miss"
+        self.obs = 0
+        self.predicted_self_wall_ns = 0.0
+        self.predicted_transfer_bytes = 0.0
+        self.predicted_syncs = 0.0
+        self.confidence = 0.0
+
+
+class QueryPrediction:
+    __slots__ = ("nodes", "hits", "misses", "predicted_wall_ns")
+
+    def __init__(self, nodes: List[NodePrediction]):
+        self.nodes = nodes
+        self.hits = sum(1 for n in nodes if n.matched != "miss")
+        self.misses = len(nodes) - self.hits
+        self.predicted_wall_ns = int(sum(
+            n.predicted_self_wall_ns for n in nodes
+            if n.matched != "miss"))
+
+    def ranking(self) -> List[NodePrediction]:
+        """Matched nodes, most-expensive predicted self wall first — the
+        order ``explain("cost")`` reports and the feedback-loop test
+        compares against the recorded profile's ranking."""
+        return sorted((n for n in self.nodes if n.matched != "miss"),
+                      key=lambda n: -n.predicted_self_wall_ns)
+
+    def by_path(self) -> Dict[str, NodePrediction]:
+        return {n.path: n for n in self.nodes}
+
+
+def _planned_bucket(node) -> Optional[int]:
+    """The shape bucket this operator's output will pad to, when the
+    plan can predict it (same rule as the AOT concat estimate: total
+    static rows); None when data-dependent."""
+    try:
+        rows_fn = getattr(node, "aot_output_rows", None)
+        rows = rows_fn() if rows_fn is not None else None
+        if rows:
+            return bucket_of(sum(rows))
+    except Exception:
+        pass
+    return None
+
+
+def predict_tree(root, store: CalibrationStore) -> QueryPrediction:
+    """Walk the planned exec tree (paths follow the diagnostics
+    ``register_root`` convention, so predictions line up with recorded
+    operator spans) and match every node against the store."""
+    from spark_rapids_tpu.exec.base import TpuExec
+    from spark_rapids_tpu.resilience.domain import _breaker_key_of
+
+    nodes: List[NodePrediction] = []
+
+    def walk(node, path):
+        pred = NodePrediction(path, node.node_name, node.describe())
+        key = None
+        try:
+            key = _breaker_key_of(node)
+        except Exception:
+            key = None
+        if key is not None:
+            pred.op_class, pred.fp = key
+            pred.bucket = _planned_bucket(node)
+            ent, kind = store.match(pred.op_class, pred.fp, pred.bucket)
+            if ent is not None:
+                ew = ent.get("ewma") or {}
+                pred.matched = kind
+                pred.obs = int(ent.get("obs", 0))
+                pred.predicted_self_wall_ns = float(
+                    ew.get("self_wall_ns", 0.0))
+                pred.predicted_transfer_bytes = float(
+                    ew.get("bytes_h2d", 0.0)) + float(
+                    ew.get("bytes_d2h", 0.0))
+                pred.predicted_syncs = float(ew.get("host_syncs", 0.0))
+                conf = min(1.0, pred.obs / float(_FULL_CONFIDENCE_OBS))
+                pred.confidence = conf if kind == "exact" else conf * 0.5
+        nodes.append(pred)
+        for i, c in enumerate(node.children):
+            if isinstance(c, TpuExec):
+                walk(c, f"{path}.{i}")
+
+    walk(root, "0")
+    return QueryPrediction(nodes)
+
+
+def render_cost_tree(root, pred: QueryPrediction,
+                     diag=None, store_path: str = "") -> str:
+    """The ``explain("cost")`` text: the plan tree annotated with each
+    node's prediction, a predicted-cost ranking, and — when the last
+    collect's recorder matches this plan — the predicted-vs-actual
+    comparison per operator."""
+    from spark_rapids_tpu.diagnostics.report import _fmt_bytes
+    from spark_rapids_tpu.exec.base import TpuExec
+
+    by_path = pred.by_path()
+    # actuals only where the RECORDED operator at a path is the same
+    # operator the current tree has there: a re-plan since the recorded
+    # run (breaker trip, advisory change) renumbers paths, and pairing
+    # a node with a different operator's measured wall would corrupt
+    # the predicted-vs-actual comparison this mode exists for
+    names_by_path = {n.path: n.node_name for n in pred.nodes}
+    actual: Dict[str, int] = {}
+    if diag is not None:
+        with diag._lock:
+            for e in diag.events:
+                if e.get("ev") == "operator" and names_by_path.get(
+                        e.get("path", "")) == e.get("name"):
+                    actual[e.get("path", "")] = int(
+                        e.get("self_wall_ns", 0))
+        if not actual:
+            # sinks already dropped the in-memory events; recompute the
+            # exclusive (self) wall from the surviving per-op stats the
+            # same way recorder.finish does — inclusive wall minus the
+            # DIRECT children's (a parent's inclusive wall would be
+            # systematically inflated next to the predicted SELF wall)
+            stats = [st for st in diag.operator_stats() if st.path]
+            child_wall: Dict[str, int] = {}
+            for st in stats:
+                dot = st.path.rfind(".")
+                if dot > 0:
+                    parent = st.path[:dot]
+                    child_wall[parent] = child_wall.get(parent, 0) \
+                        + st.wall_ns
+            for st in stats:
+                if names_by_path.get(st.path) == st.name:
+                    actual[st.path] = max(
+                        st.wall_ns - child_wall.get(st.path, 0), 0)
+    lines = []
+
+    def annotate(node, path, indent):
+        p = by_path.get(path)
+        s = "  " * indent + node.describe()
+        if p is None:
+            lines.append(s)
+        elif p.matched == "miss":
+            lines.append(s + "  [cost: no calibration"
+                         + (f" ({p.op_class})" if p.op_class
+                            else " (unfingerprintable)") + "]")
+        else:
+            parts = [f"wall≈{p.predicted_self_wall_ns / 1e6:.2f}ms",
+                     f"xfer≈{_fmt_bytes(p.predicted_transfer_bytes)}",
+                     f"syncs≈{p.predicted_syncs:.1f}",
+                     f"conf={p.confidence:.2f}",
+                     f"obs={p.obs}"]
+            if p.matched == "nearest":
+                parts.append("bucket=nearest")
+            elif p.bucket is not None:
+                parts.append(f"bucket={p.bucket}")
+            if path in actual:
+                parts.append(f"actual={actual[path] / 1e6:.2f}ms")
+            lines.append(s + "  [cost: " + ", ".join(parts) + "]")
+        for i, c in enumerate(node.children):
+            if isinstance(c, TpuExec):
+                annotate(c, f"{path}.{i}", indent + 1)
+            elif hasattr(c, "pretty"):
+                lines.append(c.pretty(indent + 1))
+
+    annotate(root, "0", 0)
+    lines.append(
+        f"cost model: {pred.hits} matched / {pred.misses} unmatched | "
+        f"predicted wall {pred.predicted_wall_ns / 1e6:.2f}ms"
+        + (f" | store {store_path}" if store_path else ""))
+    ranking = pred.ranking()
+    if ranking:
+        lines.append("predicted top operators by self wall:")
+        for p in ranking:
+            line = (f"  {p.node_name:<30} "
+                    f"{p.predicted_self_wall_ns / 1e6:9.2f}ms  "
+                    f"(conf {p.confidence:.2f}, path {p.path})")
+            if p.path in actual:
+                line += f"  actual {actual[p.path] / 1e6:.2f}ms"
+            lines.append(line)
+    return "\n".join(lines)

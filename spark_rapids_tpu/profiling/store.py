@@ -1,0 +1,388 @@
+"""The persistent operator calibration store (ISSUE 8 tentpole).
+
+Reference analog: the spark-rapids-tools qualification/profiling suite
+mines Spark event logs into per-operator cost estimates (SURVEY §5.1);
+here the diagnostics layer (PR 3) already attributes ``self_wall_ns``,
+host syncs, and H2D/D2H bytes to every operator span, so this module
+closes the loop: observations fold into a persistent JSON store keyed by
+``(operator-class, expr-fingerprint, shape-bucket)`` — the same
+``resilience.breaker.plan_key`` identity the circuit breaker and the
+plan-time tagging compute, plus the AOT row-bucket ladder — and the
+plan-time cost model (``profiling/model.py``) reads them back before the
+next execution.
+
+Store file: ``<spark.rapids.tpu.profile.dir>/calibration.json``.  Writes
+are **merge-on-write**: ``save()`` re-reads the file under a module
+lock, applies only the observations recorded since load, and atomically
+replaces it (tmp + ``os.replace``) — two sequential processes
+accumulate instead of clobbering, and a killed writer never leaves a
+torn file.  Per-metric values are observation-counted decaying EWMAs
+(``spark.rapids.tpu.profile.ewmaAlpha``), so the store tracks drift
+without unbounded history.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+STORE_VERSION = 1
+STORE_FILENAME = "calibration.json"
+
+# pinned copy of columnar.column.DEFAULT_ROW_BUCKETS (the ladder the
+# runtime batches actually pad to — compilecache.aot.bucket_of uses the
+# same module default, NOT the conf ladder, for the same reason); kept
+# here as a pure-python constant so offline tools never import jax.
+# tests/test_profiling.py asserts the two stay equal.
+DEFAULT_ROW_BUCKETS = (1024, 8192, 65536, 262144, 1048576, 4194304)
+
+# per-metric decaying EWMAs kept per entry; sourced from the operator
+# event's own fields (wall/self_wall/rows/batches) and its attributed
+# counter deltas (syncs / transfer bytes / scan transfer wall)
+EWMA_KEYS = ("self_wall_ns", "wall_ns", "rows", "batches", "host_syncs",
+             "bytes_h2d", "bytes_d2h", "scan_transfer_ns")
+
+# monotone outcome tallies (never decayed): how often this entry's spans
+# ended in a fallback, and the resilience counters they attributed
+OUTCOME_KEYS = ("fallback_obs", "runtime_fallbacks", "transient_retries",
+                "oom_restarts", "breaker_trips")
+
+_IO_LOCK = threading.Lock()
+
+# read-only store instances keyed by path, stamped by (mtime_ns, size,
+# alpha) — see CalibrationStore.load_cached.  Bounded: a long-lived
+# process touching many distinct profile dirs (per-tenant confs, a test
+# sweep of tmp dirs) must not retain one parsed store per dead path
+_READ_CACHE_MAX = 8
+_READ_CACHE: Dict[str, Tuple[Tuple, "CalibrationStore"]] = {}
+
+
+def bounded_cache_put(cache: Dict, key, value, max_items: int = 8) -> None:
+    """Insert-most-recent with FIFO eviction (caller holds its own
+    lock) — shared by the store read cache and the advisory cache."""
+    cache.pop(key, None)
+    while len(cache) >= max_items:
+        cache.pop(next(iter(cache)))
+    cache[key] = value
+
+
+def _cache_put(path: str, stamp, store: "CalibrationStore") -> None:
+    """Caller holds _IO_LOCK."""
+    bounded_cache_put(_READ_CACHE, path, (stamp, store),
+                      _READ_CACHE_MAX)
+
+
+def bucket_of(rows: int) -> int:
+    """Round a row count up the default bucket ladder (next pow2 beyond
+    it) — mirrors compilecache.aot.bucket_of without importing jax."""
+    n = max(int(rows), 1)
+    for b in DEFAULT_ROW_BUCKETS:
+        if n <= b:
+            return b
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def entry_key(op_class: str, fp: str, bucket: int) -> str:
+    return f"{op_class}|{fp}|{int(bucket)}"
+
+
+class Observation:
+    """One operator span's contribution: identity + metric values +
+    outcome flags, decoupled from where it came from (a live recorder or
+    a replayed event log — both route through
+    :meth:`from_operator_event`)."""
+
+    __slots__ = ("op_class", "fp", "bucket", "values", "fallback",
+                 "outcomes", "path")
+
+    def __init__(self, op_class: str, fp: str, bucket: int,
+                 values: Dict[str, float], fallback: bool = False,
+                 outcomes: Optional[Dict[str, int]] = None,
+                 path: str = ""):
+        self.op_class = op_class
+        self.fp = fp
+        self.bucket = int(bucket)
+        self.values = values
+        self.fallback = bool(fallback)
+        self.outcomes = dict(outcomes or {})
+        self.path = path
+
+    @property
+    def key(self) -> str:
+        return entry_key(self.op_class, self.fp, self.bucket)
+
+    @classmethod
+    def from_operator_event(cls, e: Dict[str, Any]) -> Optional["Observation"]:
+        """Build from one diagnostics ``operator`` event (live dict or a
+        parsed JSONL line); None when the span carries no calibration
+        identity (no plan twin / pre-ISSUE-8 log) or recorded no work."""
+        op_class = e.get("op_class")
+        fp = e.get("fp")
+        if not op_class or not fp:
+            return None
+        wall = int(e.get("wall_ns") or 0)
+        batches = int(e.get("batches") or 0)
+        fallback = bool(e.get("fallback"))
+        if wall <= 0 and batches <= 0 and not fallback:
+            return None   # the operator never ran (planned but unpulled)
+        rows = int(e.get("rows") or 0)
+        counters = e.get("counters") or {}
+        values = {
+            "self_wall_ns": float(e.get("self_wall_ns", wall)),
+            "wall_ns": float(wall),
+            "rows": float(rows),
+            "batches": float(batches),
+            "host_syncs": float(counters.get("host_syncs", 0)),
+            "bytes_h2d": float(counters.get("bytes_h2d", 0)),
+            "bytes_d2h": float(counters.get("bytes_d2h", 0)),
+            "scan_transfer_ns": float(counters.get("scan_transfer_ns", 0)),
+        }
+        outcomes = {
+            "fallback_obs": 1 if fallback else 0,
+            "runtime_fallbacks": int(counters.get("runtime_fallbacks", 0)),
+            "transient_retries": int(counters.get("transient_retries", 0)),
+            "oom_restarts": int(counters.get("oom_restarts", 0)),
+            "breaker_trips": int(counters.get("breaker_trips", 0)),
+        }
+        return cls(op_class, fp, bucket_of(rows), values,
+                   fallback=fallback, outcomes=outcomes,
+                   path=str(e.get("path", "")))
+
+
+def _new_entry(op_class: str, fp: str, bucket: int) -> Dict[str, Any]:
+    return {"op": op_class, "fp": fp, "bucket": int(bucket), "obs": 0,
+            "ewma": {}, "outcomes": {k: 0 for k in OUTCOME_KEYS},
+            "last_at": 0.0}
+
+
+def _apply(entries: Dict[str, Dict], obs: Observation,
+           alpha: float) -> None:
+    ent = entries.get(obs.key)
+    if ent is None:
+        ent = entries[obs.key] = _new_entry(obs.op_class, obs.fp,
+                                            obs.bucket)
+    ent["obs"] = int(ent.get("obs", 0)) + 1
+    ent["last_at"] = time.time()
+    ew = ent.setdefault("ewma", {})
+    for k in EWMA_KEYS:
+        v = float(obs.values.get(k, 0.0))
+        old = ew.get(k)
+        ew[k] = v if old is None else alpha * v + (1.0 - alpha) * old
+    out = ent.setdefault("outcomes", {})
+    for k in OUTCOME_KEYS:
+        out[k] = int(out.get(k, 0)) + int(obs.outcomes.get(k, 0))
+
+
+class CalibrationStore:
+    """In-memory view + pending observations over one store file."""
+
+    def __init__(self, directory: str, alpha: float = 0.25):
+        self.directory = directory
+        self.path = os.path.join(directory, STORE_FILENAME)
+        # clamp: a zero/negative alpha would freeze the first observation
+        # forever; >1 would oscillate
+        self.alpha = min(max(float(alpha), 1e-3), 1.0)
+        self.entries: Dict[str, Dict] = {}
+        self._pending: List[Observation] = []
+        self._by_opfp: Dict[Tuple[str, str], List[str]] = {}
+
+    # -- load/save ------------------------------------------------------
+    @classmethod
+    def load(cls, directory: str, alpha: float = 0.25) -> "CalibrationStore":
+        st = cls(directory, alpha)
+        st.entries = st._read_disk()
+        st._reindex()
+        return st
+
+    @classmethod
+    def load_cached(cls, directory: str,
+                    alpha: float = 0.25) -> "CalibrationStore":
+        """READ-ONLY load, cached by the file's (mtime_ns, size) stamp —
+        the per-collect prediction path must not re-parse the whole
+        store when nothing changed.  Callers must not observe()/save()
+        on the returned instance (it is shared); writers use load()."""
+        path = os.path.join(directory, STORE_FILENAME)
+        # same clamp as __init__: the stamp must match what save()
+        # refreshes the cache with (self.alpha), or an out-of-range
+        # conf value would defeat the cache forever
+        alpha = min(max(float(alpha), 1e-3), 1.0)
+        try:
+            st = os.stat(path)
+            stamp = (st.st_mtime_ns, st.st_size, alpha)
+        except OSError:
+            stamp = (0, -1, alpha)
+        with _IO_LOCK:
+            hit = _READ_CACHE.get(path)
+            if hit is not None and hit[0] == stamp:
+                return hit[1]
+        store = cls.load(directory, alpha)
+        with _IO_LOCK:
+            _cache_put(path, stamp, store)
+        return store
+
+    def _read_disk(self) -> Dict[str, Dict]:
+        try:
+            with open(self.path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            return {}
+        if not isinstance(payload, dict) \
+                or payload.get("version") != STORE_VERSION:
+            return {}   # incompatible/corrupt store: start fresh
+        ents = payload.get("entries")
+        return dict(ents) if isinstance(ents, dict) else {}
+
+    def _reindex(self) -> None:
+        self._by_opfp = {}
+        for key, ent in self.entries.items():
+            self._by_opfp.setdefault(
+                (ent.get("op", ""), ent.get("fp", "")), []).append(key)
+
+    # -- observation ----------------------------------------------------
+    def observe(self, obs: Optional[Observation]) -> None:
+        if obs is None:
+            return
+        self._pending.append(obs)
+        _apply(self.entries, obs, self.alpha)
+        self._by_opfp.setdefault((obs.op_class, obs.fp), [])
+        if obs.key not in self._by_opfp[(obs.op_class, obs.fp)]:
+            self._by_opfp[(obs.op_class, obs.fp)].append(obs.key)
+
+    def observe_many(self, obs_iter: Iterable[Optional[Observation]]) -> int:
+        n = 0
+        for obs in obs_iter:
+            if obs is not None:
+                self.observe(obs)
+                n += 1
+        return n
+
+    def save(self) -> str:
+        """Merge-on-write: re-read the file, apply only THIS store's
+        pending observations on top of whatever is there now, replace
+        atomically.  Sequential writers accumulate; the in-memory view
+        becomes the merged state.  When the read cache's stamp still
+        matches the file, its entries serve as the merge base (deep
+        copy — the cached instance is shared read-only) instead of
+        re-parsing the file, so the steady per-query online loop pays
+        one serialize, not parse+serialize."""
+        import copy
+
+        with _IO_LOCK:
+            disk = None
+            try:
+                st = os.stat(self.path)
+                hit = _READ_CACHE.get(self.path)
+                # never use SELF as the merge base: observe() already
+                # applied the pending observations to self.entries, so
+                # re-applying them onto that state would double-count
+                # (a long-lived writer's second save would corrupt the
+                # store); fall through to the fresh disk read instead
+                if hit is not None and hit[1] is not self \
+                        and hit[0] == (st.st_mtime_ns, st.st_size,
+                                       float(self.alpha)):
+                    # copy-on-write merge base: only the entries this
+                    # save's pending observations touch are deep-copied
+                    # (_apply mutates per-entry dicts in place, and the
+                    # cached instance is shared read-only); untouched
+                    # entries stay shared, so the per-query cost scales
+                    # with the query's operators, not the store size
+                    disk = dict(hit[1].entries)
+                    for p in self._pending:
+                        if p.key in disk:
+                            disk[p.key] = copy.deepcopy(disk[p.key])
+            except OSError:
+                pass
+            if disk is None:
+                disk = self._read_disk()
+            for obs in self._pending:
+                _apply(disk, obs, self.alpha)
+            self._pending = []
+            self.entries = disk
+            self._reindex()
+            payload = {
+                "version": STORE_VERSION,
+                "alpha": self.alpha,
+                "updated_at": time.time(),
+                "total_obs": sum(int(e.get("obs", 0))
+                                 for e in disk.values()),
+                "entries": disk,
+            }
+            os.makedirs(self.directory, exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, indent=1, sort_keys=True)
+            # refresh the read cache with the merged state we already
+            # hold: the next load_cached (the next collect's prediction
+            # pass) hits instead of re-parsing the file this save just
+            # invalidated.  Stamp from the TMP file BEFORE the rename
+            # (rename preserves mtime/size): if another process replaces
+            # the store after ours lands, its file carries a different
+            # stamp and load_cached correctly misses — stat-ing after
+            # the replace could capture the OTHER writer's stamp over
+            # our (then stale) entries
+            try:
+                st = os.stat(tmp)
+                stamp = (st.st_mtime_ns, st.st_size, float(self.alpha))
+            except OSError:
+                stamp = None
+            os.replace(tmp, self.path)
+            if stamp is not None:
+                _cache_put(self.path, stamp, self)
+        return self.path
+
+    # -- lookup (the cost model's matcher) ------------------------------
+    def match(self, op_class: str, fp: str,
+              bucket: Optional[int]) -> Tuple[Optional[Dict], str]:
+        """``(entry, kind)``: ``("exact")`` when the predicted shape
+        bucket has its own entry, ``("nearest")`` when only other buckets
+        of the same (operator, fingerprint) exist — pow2-nearest wins —
+        and ``(None, "miss")`` when the store has never seen the pair."""
+        keys = self._by_opfp.get((op_class, fp))
+        if not keys:
+            return None, "miss"
+        if bucket is not None:
+            ent = self.entries.get(entry_key(op_class, fp, bucket))
+            if ent is not None:
+                return ent, "exact"
+        cands = [self.entries[k] for k in keys if k in self.entries]
+        if not cands:
+            return None, "miss"
+        if bucket is None:
+            # no plan-static shape: the most-observed bucket is the best
+            # prior for what the runtime will actually see
+            return max(cands, key=lambda e: int(e.get("obs", 0))), \
+                "nearest"
+        target = math.log2(max(int(bucket), 1))
+        return min(cands,
+                   key=lambda e: abs(
+                       math.log2(max(int(e.get("bucket", 1)), 1))
+                       - target)), "nearest"
+
+    # -- aggregation (the advisor's view) -------------------------------
+    def by_op_class(self) -> Dict[str, Dict[str, float]]:
+        """Per-operator-class rollup across fingerprints and buckets,
+        observation-weighted for the EWMA means."""
+        agg: Dict[str, Dict[str, float]] = {}
+        for ent in self.entries.values():
+            op = ent.get("op", "?")
+            n = int(ent.get("obs", 0))
+            a = agg.setdefault(op, {"obs": 0.0, "entries": 0.0,
+                                    **{k: 0.0 for k in EWMA_KEYS},
+                                    **{k: 0.0 for k in OUTCOME_KEYS}})
+            a["obs"] += n
+            a["entries"] += 1
+            for k in EWMA_KEYS:
+                a[k] += float((ent.get("ewma") or {}).get(k, 0.0)) * n
+            for k in OUTCOME_KEYS:
+                a[k] += int((ent.get("outcomes") or {}).get(k, 0))
+        for a in agg.values():
+            n = a["obs"] or 1.0
+            for k in EWMA_KEYS:
+                a[k] /= n        # obs-weighted mean of the entry EWMAs
+        return agg

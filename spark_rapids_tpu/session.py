@@ -584,8 +584,19 @@ class DataFrame:
         # the breaker generation ticks on every planner-visible breaker
         # transition (trip / probe / close), so a plan cached before a
         # stage tripped is re-planned — and re-tagged to the oracle —
-        # instead of re-failing on the TPU every collect
-        cache_key = (get_breaker().generation,) + tuple(
+        # instead of re-failing on the TPU every collect.  Same rule for
+        # the profiling advisory (ISSUE 8): editing/regenerating the
+        # advisory file must re-tag cached plans, so its (path, mtime,
+        # size) stamp is part of the key — gated on the conf so the
+        # disabled path makes zero profiling-module calls
+        advisory_key = None
+        from spark_rapids_tpu.config import PROFILE_ADVISOR_ENABLED
+
+        if conf.get(PROFILE_ADVISOR_ENABLED):
+            from spark_rapids_tpu.profiling.advisor import advisory_state
+
+            advisory_key = advisory_state(conf)
+        cache_key = (get_breaker().generation, advisory_key) + tuple(
             sorted((k, str(v)) for k, v in conf.settings.items()))
         cached = getattr(self, "_plan_cache", None)
         if cached is not None and cached[0] == cache_key:
@@ -637,10 +648,34 @@ class DataFrame:
             # counter attribution — flushed atomically to the configured
             # sinks on exit and kept on the DataFrame for
             # explain("analyze")
-            from spark_rapids_tpu.config import ambient_conf
+            from spark_rapids_tpu.config import PROFILE_DIR, ambient_conf
             from spark_rapids_tpu.diagnostics import query_scope
 
-            scope = query_scope(self.session.conf, root)
+            # Profiling (ISSUE 8): with a calibration-store dir set, the
+            # finished recorder's operator spans fold into the store and
+            # the predicted-vs-actual record lands in the event log —
+            # wired as the scope's finish hook so it runs after
+            # finish() but before the sinks flush.  Unset (default):
+            # one conf read, zero profiling-module calls (pinned by
+            # tests/test_profiling.py).
+            prof_dir = self.session.conf.get(PROFILE_DIR)
+            on_finish = None
+            # the prediction is threaded through this box, NOT stashed
+            # on the cached (shared) plan root: a losing concurrent
+            # collect of the same DataFrame must not clobber the
+            # recorded query's prediction
+            cost_box = {"pred": None}
+            if prof_dir:
+                _conf = self.session.conf
+
+                def on_finish(diag, _conf=_conf, _box=cost_box):
+                    from spark_rapids_tpu.profiling import record_query
+
+                    record_query(diag, _conf,
+                                 prediction=_box["pred"])
+
+            scope = query_scope(self.session.conf, root,
+                                on_finish=on_finish)
             try:
                 # thread-local conf pin: concurrent collects each read
                 # THEIR OWN session conf through config.get_conf() on
@@ -661,6 +696,20 @@ class DataFrame:
                     from spark_rapids_tpu.compilecache import maybe_submit_aot
 
                     maybe_submit_aot(root, self.session.conf)
+                    # Plan-time cost model (ISSUE 8): predict each
+                    # operator's wall/transfer from the calibration
+                    # store BEFORE execution (cost_model_* counters land
+                    # inside the recorder window and attribute to the
+                    # query); the prediction is compared against the
+                    # recorded actuals by the finish hook above
+                    if prof_dir:
+                        from spark_rapids_tpu.profiling import (
+                            annotate_plan,
+                        )
+
+                        cost_box["pred"] = annotate_plan(
+                            root, self.session.conf,
+                            attributed=scope.diag is not None)
                     # Admission control: the thread driving this query's
                     # iterator chain holds a TpuSemaphore permit while it
                     # touches the device (reference:
@@ -812,9 +861,19 @@ class DataFrame:
         and fallback status from the LAST collect() (requires
         spark.rapids.tpu.diagnostics.enabled for the counter columns;
         falls back to metrics-only otherwise) — the diagnostics analog of
-        Spark's AQE ``explain`` with runtime statistics."""
+        Spark's AQE ``explain`` with runtime statistics.
+
+        ``mode="cost"``: annotate the plan with the profiling cost
+        model's PRE-execution predictions — per-operator wall / transfer
+        bytes / confidence from the calibration store
+        (spark.rapids.tpu.profile.dir), plus predicted-vs-actual when
+        the last collect was diagnosed (docs/profiling.md)."""
         from spark_rapids_tpu.exec.base import TpuExec
 
+        if mode == "cost":
+            from spark_rapids_tpu.profiling import explain_cost
+
+            return explain_cost(self)
         root, meta = self._planned()
         if mode == "analyze":
             if not isinstance(root, TpuExec):

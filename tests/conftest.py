@@ -38,6 +38,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "stress: concurrent-query stress harness "
         "(tools/run_stress.py runs the big sweeps standalone)")
+    config.addinivalue_line(
+        "markers", "profiling: calibration-store / cost-model / advisor "
+        "feedback-loop tests (ISSUE 8; unmarked slow, so they run in "
+        "tier-1)")
 
 
 @pytest.hookimpl(tryfirst=True, hookwrapper=True)

@@ -77,8 +77,11 @@ GOLDEN_SCHEMA = {
     "io_fault": ["kind", "path", "fmt", "detail"],
     "scan_prefetch": ["depth", "batches", "overlapped_bytes", "stall_ns"],
     "op_batch": ["path", "batch", "rows", "dur_ns"],
-    "operator": ["path", "name", "describe", "wall_ns", "self_wall_ns",
-                 "batches", "rows", "counters", "metrics", "fallback"],
+    "operator": ["path", "name", "describe", "op_class", "fp", "wall_ns",
+                 "self_wall_ns", "batches", "rows", "counters", "metrics",
+                 "fallback"],
+    "cost_model": ["hits", "misses", "predicted_wall_ns",
+                   "actual_wall_ns", "matched_actual_wall_ns"],
     "query_end": ["wall_ns", "status", "counters"],
 }
 
@@ -273,6 +276,127 @@ def test_disabled_path_does_no_diagnostics_work(tmp_path):
         if any(b in fname for b in banned)]
     assert not offenders, (
         f"diagnostics work on the disabled path: {offenders}")
+
+
+# ---------------------------------------------------------------------------
+# concurrent collects: non-interleaved, per-query-pid traces (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+def _blocking_df(s, started, release):
+    """A query whose execution parks inside a python UDF until released
+    — deterministic overlap for the concurrent-trace pin (the udf
+    compiler is disabled on these sessions so nothing calls the UDF at
+    plan time)."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.expr.udf import udf
+    from spark_rapids_tpu.session import col
+
+    df = s.create_dataframe(
+        {"a": list(range(8))},
+        T.StructType([T.StructField("a", T.LONG, False)]))
+
+    def block(x):
+        started.set()
+        release.wait(30)
+        return x
+
+    return df.select(udf(block, T.LONG, "block")(col("a")).alias("r"))
+
+
+def _tree_paths_and_names(events):
+    paths, names = set(), set()
+    for e in events:
+        if e["ev"] == "operator" and e["path"]:
+            paths.add(e["path"])
+            names.add(e["name"])
+    return paths, names
+
+
+def test_chrome_trace_concurrent_collects_non_interleaved(tmp_path):
+    """ISSUE 8 satellite, extending the golden-trace test: two
+    OVERLAPPING collects must produce non-interleaved, per-query-pid
+    span trees that Perfetto-validate.  The losing (unrecorded) query's
+    exec tree is ownership-stamped, so its spans never register into
+    the active recorder's log as ``+N`` runtime operators; each query's
+    trace carries its own stable pid."""
+    import threading
+
+    no_compiler = {"spark.rapids.sql.udfCompiler.enabled": False}
+    s_a = _session(tmp_path / "a", no_compiler)
+    s_b = _session(tmp_path / "b", no_compiler)
+
+    def overlap_round(rec_session, other_df):
+        """Collect a blocking query on ``rec_session`` (it wins the
+        recorder slot), run ``other_df`` to completion WHILE the
+        recorder is held, then release.  Returns the recorded df."""
+        started, release = threading.Event(), threading.Event()
+        df_rec = _blocking_df(rec_session, started, release)
+        out, errs = [], []
+
+        def run():
+            try:
+                out.append(df_rec.collect())
+            except BaseException as e:   # surface, don't hang the test
+                errs.append(e)
+                release.set()
+
+        t = threading.Thread(target=run)
+        t.start()
+        try:
+            assert started.wait(30), "blocking query never started"
+            rows = other_df.collect()       # overlapping, loses the slot
+            assert sorted(rows) == [(0, 170), (1, 56)]
+        finally:
+            release.set()
+            t.join(30)
+        assert not errs, errs
+        assert len(out) == 1 and len(out[0]) == 8
+        assert other_df._last_diag is None, (
+            "the losing concurrent collect must run unrecorded")
+        return df_rec
+
+    # round 1: A records while B's join/agg/sort query overlaps;
+    # round 2: roles swapped — both queries end up with a trace
+    df_a = overlap_round(s_a, _build_query(s_b))
+    df_b = overlap_round(s_b, _build_query(s_a))
+
+    traces = []
+    for df, own_names in ((df_a, {"TpuProjectExec",
+                                  "TpuLocalTableScanExec"}),
+                          (df_b, {"TpuProjectExec",
+                                  "TpuLocalTableScanExec"})):
+        diag = df._last_diag
+        assert diag is not None and diag.trace_path
+        with open(diag.event_log_path) as f:
+            events = [json.loads(line) for line in f]
+        paths, names = _tree_paths_and_names(events)
+        # non-interleaved: no lazily-registered runtime (+N) operators
+        # from the concurrent query, and only this query's own plan
+        assert not any(p.startswith("+") for p in paths), paths
+        assert names == own_names, names
+        with open(diag.trace_path) as f:
+            traces.append(json.load(f))
+
+    # per-query pids, stable and distinct
+    pids = [{e["pid"] for e in tr["traceEvents"]} for tr in traces]
+    assert all(len(p) == 1 for p in pids)
+    assert pids[0] != pids[1]
+    # the MERGED timeline Perfetto-validates: matched B/E per (pid, tid)
+    merged = traces[0]["traceEvents"] + traces[1]["traceEvents"]
+    stacks = {}
+    for e in merged:
+        assert e["ph"] in ("M", "B", "E", "X", "i")
+        key = (e["pid"], e["tid"])
+        if e["ph"] == "B":
+            stacks.setdefault(key, []).append(e["name"])
+        elif e["ph"] == "E":
+            assert stacks.get(key), f"E without B on {key}"
+            stacks[key].pop()
+        elif e["ph"] == "X":
+            assert e["dur"] >= 0
+    assert not any(v for v in stacks.values()), stacks
+    assert any(e["ph"] == "M" and e["name"] == "process_name"
+               for e in merged)
 
 
 # ---------------------------------------------------------------------------

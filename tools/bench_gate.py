@@ -23,7 +23,11 @@ non-zero when the new run regressed past the tolerance:
 The payload's per-plan-signature ``slo`` section is informational, not
 gated: it includes warm-up/compile collects whose latency depends on
 cache state (tail-latency gating belongs to ``--concurrency``, where
-every observed query runs warm).
+every observed query runs warm).  Likewise the cost-model
+prediction-error column (ISSUE 8 satellite): per matched query the
+report shows ``costPredictedWall_s`` vs the measured wall, baseline →
+new, so calibration drift is visible across rounds — informational
+only, never a gate (prediction quality depends on store history).
 
 ``bench.py --gate BASELINE.json`` runs this gate in-process against the
 payload it just emitted, so a bench sweep IS the regression check.
@@ -157,6 +161,62 @@ def improvements(base: Dict, new: Dict) -> List[str]:
     return out
 
 
+def _pred_error_pct(q: Dict):
+    """Cost-model prediction error for one bench query record as
+    ``(signed percent, denominator_kind, denominator_value)``:
+    predicted wall vs the MATCHED operators' measured self wall (the
+    apples-to-apples twin the profiling hook records), falling back to
+    the full ``tpu_s`` only for records predating the field (field
+    ABSENT — a recorded 0.0 means the matched operators measured no
+    self wall and yields no row rather than a silently different
+    denominator).  ``(None, None, None)`` when the query ran without a
+    calibration store."""
+    pred = float(q.get("costPredictedWall_s") or 0.0)
+    if pred <= 0.0:
+        return None, None, None
+    if "costMatchedActualWall_s" in q:
+        actual, kind = float(q["costMatchedActualWall_s"] or 0.0), \
+            "matched-actual"
+    else:
+        actual, kind = float(q.get("tpu_s") or 0.0), "tpu_s"
+    if actual <= 0.0:
+        return None, None, None
+    return (pred - actual) * 100.0 / actual, kind, actual
+
+
+def prediction_report(base: Dict, new: Dict) -> List[str]:
+    """Informational (NON-gating, ISSUE 8 satellite): the cost model's
+    per-query prediction error, new run vs baseline, so calibration
+    drift is visible across bench rounds.  Prediction quality depends on
+    store history and machine state — it reports, never gates."""
+    bq = (base.get("queries") or {})
+    nq = (new.get("queries") or {})
+    rows = []
+    for name in sorted(nq):
+        ne, nkind, measured = _pred_error_pct(nq[name])
+        if ne is None:
+            continue
+        be, bkind, _ = _pred_error_pct(bq.get(name) or {})
+        if be is not None and bkind != nkind:
+            # percentages against different denominators (baseline
+            # predates the matched-actual field) are not comparable —
+            # say so instead of printing a spurious drift
+            base_part = f"n/a ({bkind} baseline, not comparable) -> "
+        elif be is not None:
+            base_part = f"{be:+.0f}% -> "
+        else:
+            base_part = "n/a -> "
+        hits = int(nq[name].get("costModelHits") or 0)
+        misses = int(nq[name].get("costModelMisses") or 0)
+        rows.append(
+            f"prediction error {name}: " + base_part
+            + f"{ne:+.0f}% (predicted "
+            f"{float(nq[name].get('costPredictedWall_s') or 0):.3f}s vs "
+            f"{nkind} {measured:.3f}s, "
+            f"{hits} hits / {misses} misses)")
+    return rows
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("baseline")
@@ -171,12 +231,15 @@ def main(argv=None) -> int:
     if args.json:
         print(json.dumps({"pass": not regressions,
                           "regressions": regressions,
-                          "improvements": improvements(base, new)}))
+                          "improvements": improvements(base, new),
+                          "prediction": prediction_report(base, new)}))
     else:
         for r in regressions:
             print(f"REGRESSION: {r}", file=sys.stderr)
         for i in improvements(base, new):
             print(f"note: {i}")
+        for p in prediction_report(base, new):
+            print(f"note: {p}")
         print("bench gate: "
               + ("PASS" if not regressions
                  else f"FAIL ({len(regressions)} regression(s))"))

@@ -211,6 +211,57 @@ def check() -> list:
             problems.append(
                 f"sampler gauge '{gauge}' is not documented in "
                 f"docs/observability.md")
+
+    # profile-driven cost model (ISSUE 8): confs + counters + the
+    # cost_model event + the advisory/telemetry vocabulary must be
+    # documented in docs/profiling.md (and confs in configs.md)
+    prof_md = read("profiling.md")
+    prof_confs = [k for k in _REGISTRY
+                  if k.startswith("spark.rapids.tpu.profile.")]
+    if not prof_confs:
+        problems.append("no spark.rapids.tpu.profile.* confs registered")
+    for key in sorted(prof_confs):
+        if f"`{key}`" not in prof_md:
+            problems.append(
+                f"conf '{key}' is not documented in docs/profiling.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+    for key in ("cost_model_hits", "cost_model_misses",
+                "cost_model_predicted_wall_ns",
+                "cost_model_matched_actual_wall_ns",
+                "advisor_plan_fallbacks"):
+        if key not in PC.COUNTERS:
+            problems.append(f"profiling counter '{key}' is not "
+                            f"registered in perfcounters.COUNTERS")
+        if f"`{key}`" not in prof_md:
+            problems.append(
+                f"profiling counter '{key}' is not documented in "
+                f"docs/profiling.md")
+    if "cost_model" not in EVENT_SCHEMA:
+        problems.append("diagnostics event type 'cost_model' is not "
+                        "registered in EVENT_SCHEMA")
+    for field in ("op_class", "fp"):
+        if field not in EVENT_SCHEMA.get("operator", []):
+            problems.append(
+                f"operator event field '{field}' (the calibration "
+                f"identity) is missing from EVENT_SCHEMA")
+    for gauge in ("cost_model_predicted_wall_ms",
+                  "cost_model_matched_actual_wall_ms",
+                  "cost_model_hit_rate", "cost_model_prediction_error"):
+        if f"`{gauge}`" not in prof_md:
+            problems.append(
+                f"profiling telemetry gauge '{gauge}' is not "
+                f"documented in docs/profiling.md")
+    # the advisory file vocabulary the plan-time consult depends on
+    for word in ("`route`", "`device`", "`native`", "`cpu`",
+                 "`fallback-heavy`", "`sync-bound`", "`transport-bound`",
+                 "advisory.json", "calibration.json"):
+        if word not in prof_md:
+            problems.append(
+                f"advisory/store vocabulary {word} is not documented "
+                f"in docs/profiling.md")
     return problems
 
 

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Replay diagnostics event logs into the operator calibration store.
+
+The offline half of the profiling feedback loop (ISSUE 8): point it at
+``query-*.jsonl`` files or directories of them (a bench corpus, the
+``spark.rapids.tpu.diagnostics.eventLogDir`` of a production run) and
+every operator span with a calibration identity folds into
+``<store>/calibration.json`` — byte-identically to what the online
+``query_end`` hook would have accumulated, so a store seeded offline
+drives the same plan-time predictions.
+
+Usage:
+    python tools/profile_ingest.py LOG_OR_DIR [LOG_OR_DIR ...] --store DIR
+    python tools/profile_ingest.py diag_logs --store profile_store --json
+
+Truncated/partial trailing lines (query killed mid-write) are skipped
+with a counted warning, never raised.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Ingest spark_rapids_tpu diagnostics event logs "
+                    "into the operator calibration store.")
+    ap.add_argument("logs", nargs="+",
+                    help="JSONL event logs or directories of query-*.jsonl")
+    ap.add_argument("--store", required=True,
+                    help="calibration store directory "
+                         "(spark.rapids.tpu.profile.dir)")
+    ap.add_argument("--alpha", type=float, default=0.25,
+                    help="EWMA decay factor (default 0.25, matches "
+                         "spark.rapids.tpu.profile.ewmaAlpha)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable JSON stats")
+    args = ap.parse_args(argv)
+
+    from spark_rapids_tpu.profiling.ingest import ingest_logs
+
+    stats = ingest_logs(args.logs, args.store, alpha=args.alpha)
+    if args.json:
+        print(json.dumps(stats))
+    else:
+        print(f"ingested {stats['observations']} operator observations "
+              f"from {stats['queries']} queries into {args.store} "
+              f"({stats['entries']} store entries)")
+        if stats["parse_errors"]:
+            print(f"WARNING: skipped {stats['parse_errors']} "
+                  f"malformed/truncated lines", file=sys.stderr)
+        if stats["incomplete_queries"]:
+            print(f"WARNING: {stats['incomplete_queries']} queries had "
+                  f"events_dropped > 0 (aggregates incomplete)",
+                  file=sys.stderr)
+    if stats["queries"] == 0:
+        print("no event logs found", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

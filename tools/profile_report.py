@@ -42,6 +42,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     from spark_rapids_tpu.diagnostics.report import (
+        data_quality_warnings,
         diff_profiles,
         load_logs,
         render_diff,
@@ -55,12 +56,28 @@ def main(argv=None) -> int:
     if not profiles:
         print("no event logs found", file=sys.stderr)
         return 2
-
     if args.json:
+        # counted warnings, not raises (ISSUE 8 satellite): a query
+        # killed mid-write leaves torn trailing lines; its parseable
+        # prefix still reports, flagged incomplete.  Text mode embeds
+        # the same warnings in the report header, so the stderr copy is
+        # json-mode-only
+        warnings = data_quality_warnings(profiles)
+        for w in warnings:
+            print(w, file=sys.stderr)
         payload = {
             "queries": [{"query_id": qp.query_id, "path": qp.path,
                          "wall_ns": qp.wall_ns, "status": qp.status,
+                         "events_dropped": qp.events_dropped,
+                         "parse_errors": qp.parse_errors,
+                         "incomplete": qp.incomplete,
                          "totals": qp.totals} for qp in profiles],
+            "data_quality": {
+                "warnings": warnings,
+                "parse_errors": sum(qp.parse_errors for qp in profiles),
+                "incomplete_queries": sum(1 for qp in profiles
+                                          if qp.incomplete),
+            },
             "totals": totals_summary(profiles),
             "resilience": resilience_summary(profiles),
             "top_by_wall": top_operators(profiles, "wall_ns", args.top),

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Qualification / advisor CLI — which operators benefit from the TPU?
+
+The spark-rapids-tools qualification analog (SURVEY §5.1) over this
+repo's own profile data: reads a calibration store (and/or ingests
+event logs on the fly), rolls it up per operator CLASS, and reports
+which classes are **fallback-heavy** (runtime CPU fallbacks dominate —
+device placement is wasted work), **sync-bound** (host round-trips per
+batch above threshold), or **transport-bound** (scan-transfer wall
+dominates).  With ``--advisory-out`` it writes the machine-readable
+advisory file that ``overrides/meta.py`` consults at plan time behind
+``spark.rapids.tpu.profile.advisor.enabled=true`` — only fallback-heavy
+classes get re-routed (device → native); sync/transport flags are
+tuning advice.
+
+Usage:
+    python tools/qualify.py --store profile_store
+    python tools/qualify.py diag_logs --store /tmp/fresh_store \\
+        --advisory-out profile_store/advisory.json
+    python tools/qualify.py --store profile_store --json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def render(advisory: dict) -> str:
+    ops = advisory["operators"]
+    out = [f"== qualification report: {len(ops)} operator class"
+           f"{'' if len(ops) == 1 else 'es'} =="]
+    if not ops:
+        out.append("(empty store — run queries with "
+                   "spark.rapids.tpu.profile.dir set, or ingest event "
+                   "logs with tools/profile_ingest.py)")
+    rerouted = {op: e for op, e in ops.items()
+                if e["route"] != "device"}
+    if rerouted:
+        out.append("routing recommendations (advisor file consumers "
+                   "re-route these at plan time):")
+        for op, e in sorted(rerouted.items()):
+            out.append(f"  {op:<28} -> {e['route']}  "
+                       f"({'; '.join(e['reasons'])})")
+    else:
+        out.append("routing: all observed operator classes keep their "
+                   "device placement")
+    out.append("")
+    out.append(f"{'operator':<28} {'obs':>5} {'route':>7} "
+               f"{'fb%':>6} {'sync/b':>7} {'xport%':>7} "
+               f"{'wall(ms)':>9}  flags")
+    for op, e in sorted(ops.items(),
+                        key=lambda kv: -kv[1]["stats"]["obs"]):
+        st = e["stats"]
+        out.append(
+            f"{op:<28} {st['obs']:>5} {e['route']:>7} "
+            f"{st['fallback_ratio'] * 100:>5.0f}% "
+            f"{st['syncs_per_batch']:>7.2f} "
+            f"{st['transport_share'] * 100:>6.0f}% "
+            f"{st['mean_self_wall_ms']:>9.2f}  "
+            + (",".join(e["flags"]) or "-"))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Qualification/advisor report over the operator "
+                    "calibration store.")
+    ap.add_argument("logs", nargs="*",
+                    help="optional event logs/dirs to ingest into "
+                         "--store before reporting")
+    ap.add_argument("--store", required=True,
+                    help="calibration store directory")
+    ap.add_argument("--advisory-out", metavar="FILE",
+                    help="write the machine-readable advisory JSON here "
+                         "(what spark.rapids.tpu.profile.advisor.file "
+                         "points at)")
+    ap.add_argument("--min-obs", type=int, default=None,
+                    help="observations before a class is classified "
+                         "(default 2)")
+    ap.add_argument("--fallback-ratio", type=float, default=None,
+                    help="fallback share that flips routing to native "
+                         "(default 0.5)")
+    ap.add_argument("--syncs-per-batch", type=float, default=None,
+                    help="sync-bound flag threshold (default 4.0)")
+    ap.add_argument("--transport-share", type=float, default=None,
+                    help="transport-bound flag threshold (default 0.5)")
+    ap.add_argument("--alpha", type=float, default=0.25,
+                    help="EWMA decay for --logs ingestion")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the advisory JSON to stdout")
+    args = ap.parse_args(argv)
+
+    from spark_rapids_tpu.profiling import advisor
+    from spark_rapids_tpu.profiling.store import CalibrationStore
+
+    if args.logs:
+        from spark_rapids_tpu.profiling.ingest import ingest_logs
+
+        # return_store: the ingest already holds the merged state —
+        # re-parsing the file it just wrote would be a redundant
+        # O(store) load
+        stats, store = ingest_logs(args.logs, args.store,
+                                   alpha=args.alpha, return_store=True)
+        if stats["parse_errors"]:
+            print(f"WARNING: skipped {stats['parse_errors']} "
+                  f"malformed/truncated lines", file=sys.stderr)
+    else:
+        store = CalibrationStore.load(args.store, alpha=args.alpha)
+    kw = {}
+    if args.min_obs is not None:
+        kw["min_obs"] = args.min_obs
+    if args.fallback_ratio is not None:
+        kw["fallback_ratio"] = args.fallback_ratio
+    if args.syncs_per_batch is not None:
+        kw["syncs_per_batch"] = args.syncs_per_batch
+    if args.transport_share is not None:
+        kw["transport_share"] = args.transport_share
+    advisory = advisor.classify(store, **kw)
+    if args.advisory_out:
+        advisor.write_advisory(advisory, args.advisory_out)
+        print(f"advisory written: {args.advisory_out}", file=sys.stderr)
+    if args.json:
+        print(json.dumps(advisory))
+    else:
+        print(render(advisory))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
