@@ -1,0 +1,42 @@
+"""tpulint — the project-invariant static analyzer (ISSUE 9).
+
+Eight PRs accumulated load-bearing concurrency and accounting
+invariants — lock-guarded ``perfcounters.bump()``, PROPAGATE-classified
+cancellation that must never be swallowed, ``sync_event``-accounted host
+syncs, the semaphore-before-spill lock order, the registered
+conf/counter/event vocabularies — all enforced only at runtime, so a
+regression surfaced as a flaky stress failure instead of a CI error.
+This package turns them into machine-checked gates: one ``ast.parse``
+per file, every rule's visitors multiplexed over that single tree walk,
+structured findings (file:line + rule id + fix hint), a
+``# tpulint: disable=<rule>`` pragma for justified exceptions, and a
+JSON baseline for grandfathered findings.
+
+Two tiers of rules:
+
+* Tier A — project-invariant lints (:mod:`rules_invariants`):
+  counter-write discipline, cancellation-swallow detection, unaccounted
+  host syncs, conf-vocabulary resolution, thread-unsafe module state,
+  unlocked read-modify-writes; plus the conf/counter/event doc-drift
+  checks folded in from ``tools/check_counters.py``
+  (:mod:`rules_docs`).
+* Tier B — a lockset-based race/deadlock detector
+  (:mod:`rules_lockset`): per-class dominant-lock inference with
+  mixed-guard write detection, and the inter-lock acquisition-order
+  graph with cycle detection (the static twin of the runtime guard in
+  ``memory/semaphore.py``).
+
+Entry points: :func:`run_paths` (importable API, used by the tier-1
+gate in ``tests/test_lint.py``) and ``tools/lint.py`` (CLI with
+``--baseline`` / ``--json`` / ``--fail-on-new``).
+"""
+from __future__ import annotations
+
+from spark_rapids_tpu.analysis.core import (
+    Baseline,
+    Finding,
+    run_paths,
+    to_json,
+)
+
+__all__ = ["Finding", "Baseline", "run_paths", "to_json"]

@@ -1,0 +1,115 @@
+"""CLI entry for tpulint (invoked via ``tools/lint.py``).
+
+Exit-code contract (pinned by tests/test_lint.py):
+
+* 0 — no findings beyond the (empty-or-justified) baseline
+* 1 — at least one non-baselined finding (``--fail-on-new`` makes the
+  intent explicit; it is also the default behavior)
+* 2 — bad invocation / unreadable baseline
+
+``--json`` emits deterministic JSON (sorted findings, sorted keys, no
+timestamps): two runs over an unchanged tree are byte-identical.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from spark_rapids_tpu.analysis.core import (
+    Baseline,
+    default_rules,
+    run_paths,
+    to_json,
+)
+
+DEFAULT_BASELINE = "tools/lint_baseline.json"
+
+
+def main(argv: Optional[List[str]] = None,
+         repo_root: Optional[str] = None) -> int:
+    root = os.path.abspath(repo_root or os.getcwd())
+    ap = argparse.ArgumentParser(
+        prog="lint.py",
+        description="tpulint: AST invariant linter + lockset "
+                    "race/deadlock detector")
+    ap.add_argument("paths", nargs="*",
+                    default=["spark_rapids_tpu", "tools"],
+                    help="files/directories to analyze "
+                         "(default: spark_rapids_tpu tools)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline JSON of grandfathered findings "
+                         f"(default: {DEFAULT_BASELINE} when present)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as deterministic JSON")
+    ap.add_argument("--fail-on-new", action="store_true",
+                    help="exit 1 on findings not in the baseline "
+                         "(explicit form of the default)")
+    ap.add_argument("--write-baseline", metavar="PATH",
+                    help="write the current NEW findings as a baseline "
+                         "skeleton (justifications must be filled in)")
+    ap.add_argument("--no-docs-rule", action="store_true",
+                    help="skip the repo-level doc-drift rule (fixture "
+                         "trees have no docs/)")
+    args = ap.parse_args(argv)
+
+    # user-supplied relative paths resolve against the CALLER's cwd;
+    # only the built-in defaults anchor at the repo root
+    defaults = ap.get_default("paths")
+    paths = [os.path.join(root, p) if args.paths is defaults
+             else os.path.abspath(p)
+             for p in args.paths]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"lint.py: no such path: {p}", file=sys.stderr)
+            return 2
+
+    baseline_path = args.baseline
+    if baseline_path is None:
+        cand = os.path.join(root, DEFAULT_BASELINE)
+        baseline_path = cand if os.path.exists(cand) else None
+    baseline = Baseline()
+    if baseline_path is not None:
+        try:
+            baseline = Baseline.load(baseline_path)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"lint.py: cannot load baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    findings = run_paths(
+        paths, root,
+        rules=default_rules(include_docs=not args.no_docs_rule))
+    new, stale = baseline.split(findings)
+    # staleness is only meaningful for files this run actually looked
+    # at — a scoped run must not report out-of-scope entries as stale
+    scope_rels = [os.path.relpath(p, root).replace(os.sep, "/")
+                  for p in paths]
+    stale = [e for e in stale
+             if any(e.get("file", "") == r
+                    or e.get("file", "").startswith(r.rstrip("/") + "/")
+                    for r in scope_rels)]
+
+    if args.write_baseline:
+        with open(args.write_baseline, "w", encoding="utf-8") as f:
+            f.write(Baseline.render_entries(new))
+        print(f"wrote {len(new)} baseline entries to "
+              f"{args.write_baseline} — fill in the justifications",
+              file=sys.stderr)
+
+    if args.json:
+        sys.stdout.write(to_json(new))
+    else:
+        for f in new:
+            print(f.render())
+        n_base = len(findings) - len(new)
+        summary = (f"tpulint: {len(new)} finding(s)"
+                   + (f" ({n_base} baselined)" if n_base else ""))
+        print(summary if new or n_base else "tpulint: clean")
+    for e in stale:
+        print(f"lint.py: stale baseline entry (no longer fires): "
+              f"{e['rule']} in {e['file']}: {e['message']}",
+              file=sys.stderr)
+
+    return 1 if new else 0

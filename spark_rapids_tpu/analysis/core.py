@@ -1,0 +1,517 @@
+"""Analyzer engine — one parse per file, rule visitors multiplexed.
+
+The engine owns everything rule-agnostic: file discovery, parsing
+(exactly once per file — rules never re-parse), the shared tree walk
+with scope/lock-context bookkeeping, ``# tpulint: disable=<rule>``
+pragma suppression, the JSON baseline for grandfathered findings, and
+deterministic ordering/serialization of findings (two runs over the
+same tree produce byte-identical JSON — pinned by the tier-1 gate).
+
+Rule protocol (see :mod:`rules_invariants` / :mod:`rules_lockset`):
+
+* ``node_types`` — AST classes the rule wants; the engine's single walk
+  dispatches each matching node to ``visit(node, walk)``.
+* ``prescan(ctx)`` — optional first pass over every file (used by the
+  conf-vocabulary rule to collect declarations before judging reads).
+* ``begin_file(ctx)`` / ``end_file(walk)`` — per-file aggregation.
+* ``end_run(engine)`` — cross-file analyses (the lock-order graph).
+
+Findings are reported through the walker/engine so suppression and
+identity stay uniform: a finding's baseline identity is
+``(rule, file, context, message)`` — deliberately line-free, so a
+grandfathered finding survives unrelated edits above it.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+PRAGMA_PREFIX = "tpulint:"
+
+# threading constructors whose result is a mutual-exclusion object; a
+# `with` over one of these is a critical section the lockset rules track
+LOCK_CTORS = ("Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One structured finding: file:line + rule id + message + fix hint.
+
+    ``context`` is the enclosing ``Class.method`` / function qualname
+    (empty at module level) and is part of the baseline identity so the
+    match survives line drift."""
+
+    file: str          # repo-relative posix path
+    line: int
+    col: int
+    rule: str
+    message: str
+    hint: str = ""
+    context: str = ""
+
+    @property
+    def identity(self) -> Tuple[str, str, str, str]:
+        return (self.rule, self.file, self.context, self.message)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"file": self.file, "line": self.line, "col": self.col,
+                "rule": self.rule, "context": self.context,
+                "message": self.message, "hint": self.hint}
+
+    def render(self) -> str:
+        ctx = f" [{self.context}]" if self.context else ""
+        hint = f"\n    hint: {self.hint}" if self.hint else ""
+        return (f"{self.file}:{self.line}:{self.col}: {self.rule}: "
+                f"{self.message}{ctx}{hint}")
+
+
+def to_json(findings: Sequence[Finding]) -> str:
+    """Deterministic serialization: sorted findings, sorted keys, no
+    timestamps — byte-identical across runs over an unchanged tree."""
+    return json.dumps([f.to_dict() for f in sorted(findings)],
+                      indent=2, sort_keys=True) + "\n"
+
+
+class Baseline:
+    """Grandfathered findings.  Every entry MUST carry a non-empty
+    ``justification`` — the shipped baseline is empty-or-justified by
+    construction, and the loader enforces it."""
+
+    def __init__(self, entries: Optional[List[Dict[str, str]]] = None):
+        self.entries = entries or []
+        self._keys: Set[Tuple[str, str, str, str]] = set()
+        for i, e in enumerate(self.entries):
+            if not str(e.get("justification", "")).strip():
+                raise ValueError(
+                    f"baseline entry #{i} ({e.get('rule')} in "
+                    f"{e.get('file')}) has no justification — every "
+                    f"grandfathered finding must say why it is benign")
+            self._keys.add((e["rule"], e["file"], e.get("context", ""),
+                            e["message"]))
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        return cls(data.get("entries", []))
+
+    def matches(self, f: Finding) -> bool:
+        return f.identity in self._keys
+
+    def split(self, findings: Sequence[Finding]
+              ) -> Tuple[List[Finding], List[Dict[str, str]]]:
+        """(new findings not in the baseline, stale unmatched entries)."""
+        new = [f for f in findings if not self.matches(f)]
+        seen = {f.identity for f in findings}
+        stale = [e for e in self.entries
+                 if (e["rule"], e["file"], e.get("context", ""),
+                     e["message"]) not in seen]
+        return new, stale
+
+    @staticmethod
+    def render_entries(findings: Sequence[Finding],
+                       justification: str = "FIXME: justify") -> str:
+        """``--write-baseline`` payload for the given findings."""
+        return json.dumps(
+            {"entries": [{"rule": f.rule, "file": f.file,
+                          "context": f.context, "message": f.message,
+                          "justification": justification}
+                         for f in sorted(findings)]},
+            indent=2, sort_keys=True) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# pragma parsing
+# ---------------------------------------------------------------------------
+
+def parse_pragmas(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """``# tpulint: disable=rule1,rule2`` comments.
+
+    Returns (line -> suppressed rule set, file-wide suppressed set from
+    ``# tpulint: disable-file=...``).  Comment tokens only — a pragma
+    inside a string literal does not suppress anything."""
+    per_line: Dict[int, Set[str]] = {}
+    whole_file: Set[str] = set()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            text = tok.string.lstrip("#").strip()
+            if not text.startswith(PRAGMA_PREFIX):
+                continue
+            body = text[len(PRAGMA_PREFIX):].strip()
+            for directive, sink in (("disable-file=", "file"),
+                                    ("disable=", "line")):
+                if body.startswith(directive):
+                    # everything after the first whitespace is a free-
+                    # form justification: `# tpulint: disable=r (why)`
+                    spec = body[len(directive):].split(None, 1)[0]
+                    rules = {r.strip() for r in spec.split(",")
+                             if r.strip()}
+                    if sink == "file":
+                        whole_file |= rules
+                    else:
+                        per_line.setdefault(tok.start[0], set()).update(
+                            rules)
+    except tokenize.TokenError:
+        pass
+    return per_line, whole_file
+
+
+# ---------------------------------------------------------------------------
+# per-file context + shared prepass facts
+# ---------------------------------------------------------------------------
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else "")
+    return name in LOCK_CTORS
+
+
+class FileCtx:
+    """Everything the rules may ask about one file: the single parsed
+    tree, pragma maps, and the lock-declaration prepass facts."""
+
+    def __init__(self, path: str, rel: str, source: str, tree: ast.Module):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.tree = tree
+        self.line_pragmas, self.file_pragmas = parse_pragmas(source)
+        # module-level lock names: _lock = threading.Lock()
+        self.module_locks: Set[str] = set()
+        for st in tree.body:
+            if (isinstance(st, ast.Assign) and _is_lock_ctor(st.value)):
+                for t in st.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_locks.add(t.id)
+            elif (isinstance(st, ast.AnnAssign) and st.value is not None
+                  and _is_lock_ctor(st.value)
+                  and isinstance(st.target, ast.Name)):
+                self.module_locks.add(st.target.id)
+        # per-class self-lock attrs: self._lock = threading.Lock()
+        self.class_locks: Dict[str, Set[str]] = {}
+        for st in ast.walk(tree):
+            if not isinstance(st, ast.ClassDef):
+                continue
+            attrs: Set[str] = set()
+            for sub in ast.walk(st):
+                if (isinstance(sub, ast.Assign)
+                        and _is_lock_ctor(sub.value)):
+                    for t in sub.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            attrs.add(t.attr)
+            if attrs:
+                self.class_locks[st.name] = attrs
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """Pragma on the finding's line, or anywhere in the contiguous
+        comment block directly above it (multi-line justifications)."""
+        if rule in self.file_pragmas or "all" in self.file_pragmas:
+            return True
+
+        def hit(ln: int) -> bool:
+            rules = self.line_pragmas.get(ln)
+            return bool(rules and (rule in rules or "all" in rules))
+
+        if hit(line):
+            return True
+        lines = self.source.splitlines()
+        ln = line - 1
+        while ln >= 1 and ln <= len(lines):
+            stripped = lines[ln - 1].strip()
+            if not stripped.startswith("#"):
+                break
+            if hit(ln):
+                return True
+            ln -= 1
+        return False
+
+
+# ---------------------------------------------------------------------------
+# the multiplexed walker
+# ---------------------------------------------------------------------------
+
+class Walk:
+    """One traversal of one file's tree, shared by every rule.
+
+    Maintains the scope stack (class/function nesting), the active
+    lock-context stack (resolved identities of ``with`` locks currently
+    held lexically), and whether the walk is inside a
+    ``with sync_event():`` region."""
+
+    def __init__(self, engine: "Engine", ctx: FileCtx,
+                 dispatch: Dict[type, List[object]]):
+        self.engine = engine
+        self.ctx = ctx
+        self._dispatch = dispatch
+        self.class_stack: List[str] = []
+        self.func_stack: List[str] = []
+        self.lock_stack: List[str] = []      # resolved MUTEX identities
+        # acquisition-order stack: the mutexes PLUS non-mutex ordered
+        # resources (the device semaphore via `with sem.scope():`).
+        # Separate from lock_stack on purpose — holding a semaphore
+        # permit orders lock acquisition but guards no attribute state.
+        self.acquire_stack: List[str] = []
+        self.sync_depth = 0                  # nested sync_event withs
+
+    # -- state queries ---------------------------------------------------
+    @property
+    def current_class(self) -> str:
+        return self.class_stack[-1] if self.class_stack else ""
+
+    def qualname(self) -> str:
+        parts = self.class_stack + self.func_stack
+        return ".".join(parts)
+
+    def held_locks(self) -> Tuple[str, ...]:
+        """Mutexes held lexically (guard semantics)."""
+        return tuple(self.lock_stack)
+
+    def held_acquires(self) -> Tuple[str, ...]:
+        """Ordered resources held lexically: mutexes + the device
+        semaphore (ordering semantics, for the lock-order rule)."""
+        return tuple(self.acquire_stack)
+
+    def in_sync_event(self) -> bool:
+        return self.sync_depth > 0
+
+    def lock_identity(self, expr: ast.AST) -> Optional[str]:
+        """Resolve a ``with`` context expression to a lock identity, or
+        None when it is not a known lock.  Identities:
+        ``<rel>::<name>`` for module-level locks, ``<rel>::<Class>.
+        <attr>`` for self-locks."""
+        if isinstance(expr, ast.Name):
+            if expr.id in self.ctx.module_locks:
+                return f"{self.ctx.rel}::{expr.id}"
+            return None
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)):
+            if expr.value.id == "self" and self.current_class:
+                if expr.attr in self.ctx.class_locks.get(
+                        self.current_class, ()):
+                    return (f"{self.ctx.rel}::"
+                            f"{self.current_class}.{expr.attr}")
+            return None
+        return None
+
+    # -- reporting -------------------------------------------------------
+    def report(self, rule: str, node: ast.AST, message: str,
+               hint: str = "", context: Optional[str] = None) -> None:
+        self.engine.report(self.ctx, rule,
+                           getattr(node, "lineno", 1),
+                           getattr(node, "col_offset", 0),
+                           message, hint,
+                           self.qualname() if context is None else context)
+
+    # -- traversal -------------------------------------------------------
+    def run(self) -> None:
+        self._visit(self.ctx.tree)
+
+    def _visit(self, node: ast.AST) -> None:
+        rules = self._dispatch.get(type(node))
+        if rules:
+            for r in rules:
+                r.visit(node, self)
+        if isinstance(node, ast.ClassDef):
+            self.class_stack.append(node.name)
+            self._generic(node)
+            self.class_stack.pop()
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.func_stack.append(node.name)
+            self._generic(node)
+            self.func_stack.pop()
+        elif isinstance(node, ast.With):
+            pushed_locks = 0
+            pushed_acq = 0
+            pushed_sync = 0
+            for item in node.items:
+                ident = self.lock_identity(item.context_expr)
+                if ident is not None:
+                    self.lock_stack.append(ident)
+                    pushed_locks += 1
+                    self.acquire_stack.append(ident)
+                    pushed_acq += 1
+                elif _is_semaphore_acquire(item.context_expr):
+                    # `with sem.scope():` — orders later acquisitions
+                    # but guards nothing (acquire_stack only)
+                    self.acquire_stack.append(SEMAPHORE_LOCK)
+                    pushed_acq += 1
+                elif _is_sync_event(item.context_expr):
+                    self.sync_depth += 1
+                    pushed_sync += 1
+            self._generic(node)
+            for _ in range(pushed_locks):
+                self.lock_stack.pop()
+            for _ in range(pushed_acq):
+                self.acquire_stack.pop()
+            self.sync_depth -= pushed_sync
+        else:
+            self._generic(node)
+
+    def _generic(self, node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+
+
+# the device-semaphore pseudo-lock: `sem.scope()` / `acquire_if_
+# necessary()` acquire it without a lexical `with <mutex>`; the
+# lock-order rule needs it as a graph node (semaphore BEFORE spill)
+SEMAPHORE_LOCK = "<device-semaphore>"
+SEMAPHORE_CALLS = frozenset(("acquire_if_necessary", "scope"))
+
+
+def _is_semaphore_acquire(expr: ast.AST) -> bool:
+    return (isinstance(expr, ast.Call)
+            and (expr.func.attr if isinstance(expr.func, ast.Attribute)
+                 else expr.func.id if isinstance(expr.func, ast.Name)
+                 else "") in SEMAPHORE_CALLS)
+
+
+def _is_sync_event(expr: ast.AST) -> bool:
+    """``with sync_event():`` / ``with PC.sync_event():`` — the
+    accounted-host-sync region perfcounters exposes."""
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    name = (expr.id if isinstance(expr, ast.Name)
+            else expr.attr if isinstance(expr, ast.Attribute) else "")
+    return name == "sync_event"
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+class Engine:
+    def __init__(self, repo_root: str, rules: Sequence[object]):
+        self.repo_root = os.path.abspath(repo_root)
+        self.rules = list(rules)
+        self.findings: List[Finding] = []
+        self._ctxs: List[FileCtx] = []
+
+    def report(self, ctx: Optional[FileCtx], rule: str, line: int,
+               col: int, message: str, hint: str = "",
+               context: str = "") -> None:
+        if ctx is not None and ctx.suppressed(rule, line):
+            return
+        rel = ctx.rel if ctx is not None else "<repo>"
+        self.findings.append(Finding(rel, line, col, rule, message, hint,
+                                     context))
+
+    def ctx_for(self, rel: str) -> Optional[FileCtx]:
+        for c in self._ctxs:
+            if c.rel == rel:
+                return c
+        return None
+
+    def run(self, paths: Sequence[str]) -> List[Finding]:
+        files = sorted(_collect_files(paths))
+        for path in files:
+            rel = os.path.relpath(path, self.repo_root).replace(os.sep,
+                                                                "/")
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    source = f.read()
+                tree = ast.parse(source, filename=path)
+            except (OSError, SyntaxError, ValueError) as e:
+                self.findings.append(Finding(
+                    rel, 1, 0, "parse-error",
+                    f"could not parse: {type(e).__name__}: {e}",
+                    "fix the syntax error; nothing else was checked"))
+                continue
+            self._ctxs.append(FileCtx(path, rel, source, tree))
+        # phase 0: run-level setup (e.g. repo-wide vocabulary, so a
+        # SCOPED run still judges against the full declaration set)
+        for rule in self.rules:
+            begin_run = getattr(rule, "begin_run", None)
+            if begin_run is not None:
+                begin_run(self)
+        # phase 1: prescan (vocabulary collection etc.)
+        for rule in self.rules:
+            prescan = getattr(rule, "prescan", None)
+            if prescan is not None:
+                for ctx in self._ctxs:
+                    prescan(ctx)
+        # phase 2: the single multiplexed walk per file
+        dispatch: Dict[type, List[object]] = {}
+        for rule in self.rules:
+            for nt in getattr(rule, "node_types", ()):
+                dispatch.setdefault(nt, []).append(rule)
+        for ctx in self._ctxs:
+            for rule in self.rules:
+                begin = getattr(rule, "begin_file", None)
+                if begin is not None:
+                    begin(ctx)
+            walk = Walk(self, ctx, dispatch)
+            walk.run()
+            for rule in self.rules:
+                end = getattr(rule, "end_file", None)
+                if end is not None:
+                    end(walk)
+        # phase 3: cross-file analyses
+        for rule in self.rules:
+            end_run = getattr(rule, "end_run", None)
+            if end_run is not None:
+                end_run(self)
+        self.findings.sort()
+        return self.findings
+
+
+def _collect_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p) and p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__"
+                                     and not d.startswith("."))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def default_rules(include_docs: bool = True) -> List[object]:
+    from spark_rapids_tpu.analysis import rules_invariants as RI
+    from spark_rapids_tpu.analysis import rules_lockset as RL
+
+    rules: List[object] = [
+        RI.CounterWriteRule(),
+        RI.CancelSwallowRule(),
+        RI.UnaccountedSyncRule(),
+        RI.ConfVocabularyRule(),
+        RI.ModuleStateRule(),
+        RI.UnlockedRmwRule(),
+        RL.LockMixedGuardRule(),
+        RL.LockOrderRule(),
+    ]
+    if include_docs:
+        from spark_rapids_tpu.analysis import rules_docs as RD
+
+        rules.append(RD.DocDriftRule())
+    return rules
+
+
+def run_paths(paths: Sequence[str], repo_root: str,
+              rules: Optional[Sequence[object]] = None,
+              include_docs: bool = False) -> List[Finding]:
+    """Analyze ``paths`` (files or directories); returns sorted
+    findings.  ``include_docs`` adds the repo-level doc-drift rule —
+    only meaningful when analyzing the real repo (it imports the conf
+    registry and reads docs/)."""
+    engine = Engine(repo_root,
+                    default_rules(include_docs) if rules is None
+                    else rules)
+    return engine.run(paths)

@@ -1,0 +1,278 @@
+"""Doc-drift rule — the conf/counter/event vocabulary checks folded in
+from ``tools/check_counters.py`` (which remains as a thin CLI shim so
+existing invocations and the pytest mirrors keep working).
+
+Unlike the AST rules this one introspects the RUNTIME registries
+(``perfcounters.COUNTERS``, the typed conf ``_REGISTRY``, the
+diagnostics ``EVENT_SCHEMA``) and cross-checks the docs tree, so it
+only runs against the real repo (``tools/lint.py`` default; fixture
+runs exclude it).  Message strings are kept byte-compatible with the
+old checker — tests assert on them.
+"""
+from __future__ import annotations
+
+import os
+from typing import List
+
+from spark_rapids_tpu.analysis.core import Engine, Finding
+
+
+def doc_drift_problems(repo_root: str) -> List[str]:
+    """Every drift problem as a human-readable string (the legacy
+    ``check_counters.check()`` contract)."""
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu.config import _REGISTRY
+    from spark_rapids_tpu.diagnostics.recorder import EVENT_SCHEMA
+
+    problems = []
+
+    def read(name):
+        path = os.path.join(repo_root, "docs", name)
+        try:
+            with open(path) as f:
+                return f.read()
+        except OSError:
+            problems.append(f"missing docs file: docs/{name}")
+            return ""
+
+    diag_md = read("diagnostics.md")
+    configs_md = read("configs.md")
+
+    for key in sorted(PC.COUNTERS):
+        # backtick-delimited: a bare substring test is vacuous for
+        # counter names that are ordinary words ("compiles")
+        if f"`{key}`" not in diag_md:
+            problems.append(
+                f"perf counter '{key}' is not documented (backticked) in "
+                f"docs/diagnostics.md")
+    if hasattr(PC, "ALIASES"):
+        problems.append(
+            "perfcounters.ALIASES still exists — the one-release "
+            "camelCase compat window closed in ISSUE 7")
+
+    diag_confs = [k for k in _REGISTRY
+                  if k.startswith("spark.rapids.tpu.diagnostics.")]
+    if not diag_confs:
+        problems.append("no spark.rapids.tpu.diagnostics.* confs "
+                        "registered")
+    for key in sorted(diag_confs):
+        if key not in diag_md:
+            problems.append(
+                f"conf '{key}' is not documented in docs/diagnostics.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+
+    for ev in sorted(EVENT_SCHEMA):
+        if f"`{ev}`" not in diag_md:
+            problems.append(
+                f"event type '{ev}' is not documented in "
+                f"docs/diagnostics.md")
+
+    # query lifecycle (ISSUE 4): confs + counters must be documented in
+    # docs/concurrency.md (and confs in the regenerated configs.md)
+    conc_md = read("concurrency.md")
+    life_confs = [k for k in _REGISTRY
+                  if k == "spark.rapids.tpu.concurrentQueries"
+                  or k.startswith(("spark.rapids.tpu.admission.",
+                                   "spark.rapids.tpu.query.",
+                                   "spark.rapids.tpu.semaphore."))]
+    if not life_confs:
+        problems.append("no query-lifecycle confs registered")
+    for key in sorted(life_confs):
+        if f"`{key}`" not in conc_md:
+            problems.append(
+                f"conf '{key}' is not documented in docs/concurrency.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+    for key in ("queries_admitted", "queries_rejected",
+                "queries_cancelled", "deadline_trips",
+                "admission_wait_ns"):
+        if key not in PC.COUNTERS:
+            problems.append(f"lifecycle counter '{key}' is not "
+                            f"registered in perfcounters.COUNTERS")
+        if f"`{key}`" not in conc_md:
+            problems.append(
+                f"lifecycle counter '{key}' is not documented in "
+                f"docs/concurrency.md")
+
+    # I/O fault domain (ISSUE 5): tolerance confs + counters must be
+    # documented in docs/io_resilience.md (and confs in configs.md)
+    io_md = read("io_resilience.md")
+    io_confs = [k for k in _REGISTRY
+                if k.startswith(("spark.sql.files.ignore",
+                                 "spark.rapids.tpu.files."))]
+    if not io_confs:
+        problems.append("no I/O fault-tolerance confs registered")
+    for key in sorted(io_confs):
+        if f"`{key}`" not in io_md:
+            problems.append(
+                f"conf '{key}' is not documented in "
+                f"docs/io_resilience.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+    for key in ("files_skipped_corrupt", "files_skipped_missing",
+                "file_decoder_fallbacks"):
+        if key not in PC.COUNTERS:
+            problems.append(f"I/O counter '{key}' is not registered in "
+                            f"perfcounters.COUNTERS")
+        if f"`{key}`" not in io_md:
+            problems.append(
+                f"I/O counter '{key}' is not documented in "
+                f"docs/io_resilience.md")
+    if "io_fault" not in EVENT_SCHEMA:
+        problems.append("diagnostics event type 'io_fault' is not "
+                        "registered in EVENT_SCHEMA")
+
+    # transport-aware scan pipeline (ISSUE 6): confs + counters must be
+    # documented in docs/scan_pipeline.md (and confs in configs.md)
+    scan_md = read("scan_pipeline.md")
+    scan_confs = [k for k in _REGISTRY
+                  if k.startswith(("spark.rapids.tpu.scan.",
+                                   "spark.rapids.sql.format.parquet."
+                                   "transfer."))]
+    if not scan_confs:
+        problems.append("no scan-pipeline confs registered")
+    for key in sorted(scan_confs):
+        if f"`{key}`" not in scan_md:
+            problems.append(
+                f"conf '{key}' is not documented in "
+                f"docs/scan_pipeline.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+    for key in ("bytes_h2d_logical", "scan_transfer_ns",
+                "pages_device_decompressed", "chunk_decode_fallbacks",
+                "bytes_h2d_overlapped", "prefetch_stall_ns",
+                "hot_cache_hits", "hot_cache_misses",
+                "hot_cache_evictions"):
+        if key not in PC.COUNTERS:
+            problems.append(f"scan counter '{key}' is not registered "
+                            f"in perfcounters.COUNTERS")
+        if f"`{key}`" not in scan_md:
+            problems.append(
+                f"scan counter '{key}' is not documented in "
+                f"docs/scan_pipeline.md")
+    if "scan_prefetch" not in EVENT_SCHEMA:
+        problems.append("diagnostics event type 'scan_prefetch' is not "
+                        "registered in EVENT_SCHEMA")
+
+    # telemetry tier (ISSUE 7): confs + counters + the sampler's gauge
+    # vocabulary must be documented in docs/observability.md (and confs
+    # in the regenerated configs.md)
+    obs_md = read("observability.md")
+    tel_confs = [k for k in _REGISTRY
+                 if k.startswith("spark.rapids.tpu.telemetry.")]
+    if not tel_confs:
+        problems.append("no spark.rapids.tpu.telemetry.* confs "
+                        "registered")
+    for key in sorted(tel_confs):
+        if f"`{key}`" not in obs_md:
+            problems.append(
+                f"conf '{key}' is not documented in "
+                f"docs/observability.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+    for key in ("slo_violations", "postmortem_dumps"):
+        if key not in PC.COUNTERS:
+            problems.append(f"telemetry counter '{key}' is not "
+                            f"registered in perfcounters.COUNTERS")
+        if f"`{key}`" not in obs_md:
+            problems.append(
+                f"telemetry counter '{key}' is not documented in "
+                f"docs/observability.md")
+    for gauge in ("admission_running", "admission_queued",
+                  "active_queries", "hbm_pool_bytes", "hbm_used_bytes",
+                  "hbm_occupancy", "hot_cache_hit_rate",
+                  "compile_cache_hit_rate", "compile_registry_programs",
+                  "query_latency_p95_ms"):
+        if f"`{gauge}`" not in obs_md:
+            problems.append(
+                f"sampler gauge '{gauge}' is not documented in "
+                f"docs/observability.md")
+
+    # profile-driven cost model (ISSUE 8): confs + counters + the
+    # cost_model event + the advisory/telemetry vocabulary must be
+    # documented in docs/profiling.md (and confs in configs.md)
+    prof_md = read("profiling.md")
+    prof_confs = [k for k in _REGISTRY
+                  if k.startswith("spark.rapids.tpu.profile.")]
+    if not prof_confs:
+        problems.append("no spark.rapids.tpu.profile.* confs registered")
+    for key in sorted(prof_confs):
+        if f"`{key}`" not in prof_md:
+            problems.append(
+                f"conf '{key}' is not documented in docs/profiling.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+    for key in ("cost_model_hits", "cost_model_misses",
+                "cost_model_predicted_wall_ns",
+                "cost_model_matched_actual_wall_ns",
+                "advisor_plan_fallbacks"):
+        if key not in PC.COUNTERS:
+            problems.append(f"profiling counter '{key}' is not "
+                            f"registered in perfcounters.COUNTERS")
+        if f"`{key}`" not in prof_md:
+            problems.append(
+                f"profiling counter '{key}' is not documented in "
+                f"docs/profiling.md")
+    if "cost_model" not in EVENT_SCHEMA:
+        problems.append("diagnostics event type 'cost_model' is not "
+                        "registered in EVENT_SCHEMA")
+    for field in ("op_class", "fp"):
+        if field not in EVENT_SCHEMA.get("operator", []):
+            problems.append(
+                f"operator event field '{field}' (the calibration "
+                f"identity) is missing from EVENT_SCHEMA")
+    for gauge in ("cost_model_predicted_wall_ms",
+                  "cost_model_matched_actual_wall_ms",
+                  "cost_model_hit_rate", "cost_model_prediction_error"):
+        if f"`{gauge}`" not in prof_md:
+            problems.append(
+                f"profiling telemetry gauge '{gauge}' is not "
+                f"documented in docs/profiling.md")
+    # the advisory file vocabulary the plan-time consult depends on
+    for word in ("`route`", "`device`", "`native`", "`cpu`",
+                 "`fallback-heavy`", "`sync-bound`", "`transport-bound`",
+                 "advisory.json", "calibration.json"):
+        if word not in prof_md:
+            problems.append(
+                f"advisory/store vocabulary {word} is not documented "
+                f"in docs/profiling.md")
+    return problems
+
+
+def _docs_file_of(problem: str) -> str:
+    """Best-effort anchor: the docs file the message names, else the
+    shim (registry-side problems)."""
+    for tok in problem.split():
+        tok = tok.rstrip(".,;:)")
+        if tok.startswith("docs/") and tok.endswith(".md"):
+            return tok
+    return "tools/check_counters.py"
+
+
+class DocDriftRule:
+    """Repo-level rule: runs once per analysis, not per file."""
+
+    id = "doc-drift"
+    node_types = ()
+    HINT = ("update the named docs file (and re-run python "
+            "docs/gen_docs.py for configs.md) so the registered "
+            "vocabulary and the documentation stay in sync")
+
+    def end_run(self, engine: Engine) -> None:
+        for problem in doc_drift_problems(engine.repo_root):
+            engine.findings.append(Finding(
+                _docs_file_of(problem), 1, 0, self.id, problem,
+                self.HINT, "doc-drift"))

@@ -1,0 +1,444 @@
+"""Tier A — project-invariant lints.
+
+Each rule encodes an invariant a prior PR introduced and until now only
+enforced at runtime (see docs/static_analysis.md for the catalogue and
+the PR that owns each invariant):
+
+* ``counter-write``    — every ``perfcounters.COUNTERS`` mutation goes
+  through ``bump()``/``bump_unattributed()`` (or holds the counter
+  lock inside perfcounters.py itself).  PR 1 made unguarded increments
+  a lost-update bug; this makes them a CI error.
+* ``cancel-swallow``   — a broad ``except Exception`` / bare ``except``
+  in the cancellation-observing packages must re-raise or classify:
+  ``QueryCancelled`` / ``QueryDeadlineExceeded`` are PROPAGATE-class
+  (PR 4) and a handler that absorbs them turns a cancelled query into
+  a wrong answer.
+* ``unaccounted-sync`` — ``jax.device_get`` / ``.block_until_ready()``
+  on exec/scan/shuffle hot paths must run inside ``sync_event`` (or
+  ``sync_get``) so ``host_syncs`` counts LOGICAL round trips (PR 3).
+* ``conf-vocabulary``  — every literal ``spark.*`` key at a conf
+  get/set site must be declared via the typed ``conf(...)`` builder
+  (the AST-resolved half of the old grep in check_counters.py).
+* ``module-state``     — module-level mutable containers / singletons
+  mutated from two or more functions need a module lock.
+* ``unlocked-rmw``     — ``self.x += n`` outside any lock in a class
+  that guards other state with a lock is a non-atomic
+  read-modify-write (three bytecodes; CPython switches threads
+  between them — the exact bug class perfcounters.bump() documents).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from spark_rapids_tpu.analysis.core import FileCtx, Walk, _collect_files
+
+# dict/set/list mutator method names that change the container in place
+MUTATORS = frozenset((
+    "append", "appendleft", "add", "update", "insert", "extend",
+    "remove", "discard", "pop", "popitem", "popleft", "clear",
+    "setdefault", "move_to_end", "sort", "reverse",
+))
+
+CONTAINER_CTORS = frozenset((
+    "dict", "list", "set", "deque", "OrderedDict", "defaultdict",
+    "Counter",
+))
+
+
+def _in_scoped_dirs(rel: str, segments: Tuple[str, ...]) -> bool:
+    parts = rel.split("/")
+    return any(seg in parts for seg in segments)
+
+
+def _trailing_name(expr: ast.AST) -> str:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return ""
+
+
+def _is_container_ctor(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return _trailing_name(node.func) in CONTAINER_CTORS
+    return False
+
+
+# ---------------------------------------------------------------------------
+# counter-write
+# ---------------------------------------------------------------------------
+
+class CounterWriteRule:
+    """Any mutation of ``COUNTERS`` outside ``perfcounters.py``'s
+    lock-guarded helpers loses updates under concurrency."""
+
+    id = "counter-write"
+    node_types = (ast.Assign, ast.AugAssign, ast.Delete, ast.Call)
+    HINT = ("route the increment through perfcounters.bump() / "
+            "bump_unattributed(); direct writes race and skip "
+            "diagnostics attribution")
+
+    @staticmethod
+    def _is_counters(expr: ast.AST) -> bool:
+        return _trailing_name(expr) == "COUNTERS"
+
+    def _targets(self, node: ast.AST) -> List[ast.AST]:
+        if isinstance(node, ast.Assign):
+            return list(node.targets)
+        if isinstance(node, ast.AugAssign):
+            return [node.target]
+        if isinstance(node, ast.Delete):
+            return list(node.targets)
+        return []
+
+    def visit(self, node: ast.AST, walk: Walk) -> None:
+        ctx = walk.ctx
+        in_perfcounters = ctx.rel.endswith("perfcounters.py")
+        hits: List[Tuple[ast.AST, str]] = []
+        for t in self._targets(node):
+            if isinstance(t, ast.Subscript) and self._is_counters(t.value):
+                hits.append((node, "COUNTERS[...] write"))
+            elif (self._is_counters(t) and walk.func_stack):
+                hits.append((node, "COUNTERS rebound"))
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (isinstance(fn, ast.Attribute)
+                    and self._is_counters(fn.value)
+                    and fn.attr in MUTATORS):
+                hits.append((node, f"COUNTERS.{fn.attr}() call"))
+        for hit_node, what in hits:
+            if in_perfcounters:
+                # inside the owning module a write is legal only under
+                # the counter lock (bump / reset / _CountingJit)
+                if any(lk.endswith("::_LOCK") for lk in walk.held_locks()):
+                    continue
+            walk.report(self.id, hit_node,
+                        f"{what} bypasses bump() — perfcounters.COUNTERS "
+                        f"may only be mutated under the counter lock",
+                        self.HINT)
+
+
+# ---------------------------------------------------------------------------
+# cancel-swallow
+# ---------------------------------------------------------------------------
+
+class CancelSwallowRule:
+    """Broad excepts in the cancellation-observing packages must
+    re-raise or classify; otherwise a tripped CancelToken's
+    ``QueryCancelled`` dies in the handler and the query keeps running
+    (or returns partial data)."""
+
+    id = "cancel-swallow"
+    node_types = (ast.Try,)
+    SCOPED = ("exec", "lifecycle", "resilience", "io", "shuffle")
+    # a handler that consults the failure taxonomy is explicitly
+    # classifying; resilience/classify.py routes PROPAGATE back out
+    CLASSIFIERS = frozenset((
+        "classify_failure", "exception_chain", "is_device_oom",
+        "to_scan_fault", "handle_scan_error",
+    ))
+    # only types that actually CATCH a raised QueryCancelled count as
+    # interception: QueryCancelled itself or a superclass.  The
+    # subclass QueryDeadlineExceeded and the sibling QueryRejected
+    # intercept nothing — a QueryCancelled sails past those clauses
+    # into the broad handler.
+    CANCEL_TYPES = frozenset((
+        "QueryCancelled", "BaseException", "Exception", "RuntimeError",
+    ))
+    HINT = ("re-raise PROPAGATE failures: classify via "
+            "resilience.classify.classify_failure (or catch "
+            "QueryCancelled first) so a tripped CancelToken unwinds")
+
+    def _broad(self, h: ast.ExceptHandler) -> bool:
+        if h.type is None:
+            return True
+        types = (h.type.elts if isinstance(h.type, ast.Tuple)
+                 else [h.type])
+        return any(_trailing_name(t) in ("Exception", "BaseException")
+                   for t in types)
+
+    def _names_cancel(self, h: ast.ExceptHandler) -> bool:
+        if h.type is None:
+            return False
+        types = (h.type.elts if isinstance(h.type, ast.Tuple)
+                 else [h.type])
+        return any(_trailing_name(t) in self.CANCEL_TYPES for t in types)
+
+    def _handler_ok(self, h: ast.ExceptHandler) -> bool:
+        for sub in ast.walk(h):
+            if isinstance(sub, ast.Raise):
+                return True
+            if (isinstance(sub, ast.Call)
+                    and _trailing_name(sub.func) in self.CLASSIFIERS):
+                return True
+        return False
+
+    def visit(self, node: ast.Try, walk: Walk) -> None:
+        if not _in_scoped_dirs(walk.ctx.rel, self.SCOPED):
+            return
+        # cancel_handled tracks EARLIER clauses only: a handler must not
+        # exempt itself by naming BaseException (a swallowing
+        # `except BaseException:` behind a narrow clause is exactly the
+        # bug this rule exists for)
+        cancel_handled = False
+        for h in node.handlers:
+            if self._broad(h) and not cancel_handled \
+                    and not self._handler_ok(h):
+                what = ("bare except:" if h.type is None
+                        else f"except {ast.unparse(h.type)}")
+                walk.report(self.id, h,
+                            f"{what} can swallow QueryCancelled/"
+                            f"QueryDeadlineExceeded without re-raise or "
+                            f"classification", self.HINT)
+            if self._names_cancel(h):
+                cancel_handled = True
+
+
+# ---------------------------------------------------------------------------
+# unaccounted-sync
+# ---------------------------------------------------------------------------
+
+class UnaccountedSyncRule:
+    """Device->host materializations on hot paths must be routed
+    through ``sync_event`` so ``host_syncs`` counts LOGICAL round trips
+    (a pytree fetch is ONE trip, not one per leaf — perfcounters
+    docstring).  ``np.asarray``-on-device cannot be resolved statically
+    (host arrays share the spelling) and is deliberately out of scope."""
+
+    id = "unaccounted-sync"
+    node_types = (ast.Call,)
+    SCOPED = ("exec", "io", "shuffle")
+    HINT = ("wrap in `with sync_event():` or use perfcounters.sync_get "
+            "for a pytree — one logical host round trip, exact "
+            "host_syncs accounting")
+
+    def visit(self, node: ast.Call, walk: Walk) -> None:
+        if not _in_scoped_dirs(walk.ctx.rel, self.SCOPED):
+            return
+        name = _trailing_name(node.func)
+        if name not in ("device_get", "block_until_ready"):
+            return
+        if walk.in_sync_event():
+            return
+        if "sync_get" in walk.func_stack:
+            return
+        walk.report(self.id, node,
+                    f"{name}() outside sync_event: each materialized "
+                    f"leaf counts a separate host_syncs round trip",
+                    self.HINT)
+
+
+# ---------------------------------------------------------------------------
+# conf-vocabulary
+# ---------------------------------------------------------------------------
+
+class ConfVocabularyRule:
+    """Every literal ``spark.*`` key read/written at a conf-get site
+    must be a key the typed registry declares via ``conf("...")`` —
+    typos silently fall back to defaults otherwise."""
+
+    id = "conf-vocabulary"
+    node_types = (ast.Call, ast.Subscript)
+    # per-op kill switches and similar families are registered with
+    # dynamically-built keys; literal members of the family are legal
+    DYNAMIC_PREFIXES = (
+        "spark.rapids.sql.expression.",
+        "spark.rapids.sql.exec.",
+    )
+    HINT = ("declare the key with the conf(\"...\") builder in the "
+            "owning module (or fix the typo) — unregistered keys "
+            "silently read their hardcoded fallback")
+
+    def __init__(self):
+        self.vocab: Set[str] = set()
+
+    # -- phase 0: repo-wide declarations --------------------------------
+    def begin_run(self, engine) -> None:
+        """A SCOPED run (`tools/lint.py some/dir`) must still know every
+        key the repo declares, or correct reads of out-of-scope
+        declarations become false positives.  Declarations are simple
+        string literals, so a regex sweep (no extra AST parses) over the
+        source tree is exact enough."""
+        import re
+
+        pat = re.compile(r"""conf\(\s*['"]([^'"]+)['"]""")
+        for sub in ("spark_rapids_tpu", "tools"):
+            root = os.path.join(engine.repo_root, sub)
+            if not os.path.isdir(root):
+                continue
+            for path in _collect_files([root]):
+                try:
+                    with open(path, "r", encoding="utf-8") as f:
+                        self.vocab.update(pat.findall(f.read()))
+                except OSError:
+                    continue
+
+    # -- phase 1: collect declarations (covers fixture trees whose
+    # repo_root has no spark_rapids_tpu/) -------------------------------
+    def prescan(self, ctx: FileCtx) -> None:
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and _trailing_name(node.func) == "conf"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                self.vocab.add(node.args[0].value)
+
+    # -- phase 2: judge get/set sites -----------------------------------
+    def _check_key(self, key: str) -> bool:
+        if key in self.vocab:
+            return True
+        return any(key.startswith(p) for p in self.DYNAMIC_PREFIXES)
+
+    def _report(self, walk: Walk, node: ast.AST, key: str,
+                site: str) -> None:
+        walk.report(self.id, node,
+                    f"conf key '{key}' at a {site} site is not declared "
+                    f"in the typed registry", self.HINT)
+
+    def visit(self, node: ast.AST, walk: Walk) -> None:
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (isinstance(fn, ast.Attribute)
+                    and fn.attr in ("get", "set", "unset")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                key = node.args[0].value
+                if (key.startswith(("spark.rapids.", "spark.sql."))
+                        and not self._check_key(key)):
+                    self._report(walk, node, key, f".{fn.attr}()")
+        elif isinstance(node, ast.Subscript):
+            sl = node.slice
+            if (isinstance(sl, ast.Constant) and isinstance(sl.value, str)
+                    and sl.value.startswith(("spark.rapids.",
+                                             "spark.sql."))
+                    and not self._check_key(sl.value)):
+                self._report(walk, node, sl.value, "subscript")
+
+
+# ---------------------------------------------------------------------------
+# module-state
+# ---------------------------------------------------------------------------
+
+class ModuleStateRule:
+    """Module-level mutable containers (and ``global``-rebound
+    singletons) mutated from two or more functions without a module
+    lock in scope: the classic unguarded-shared-state race."""
+
+    id = "module-state"
+    node_types = (ast.Assign, ast.AugAssign, ast.Delete, ast.Call,
+                  ast.Global)
+    HINT = ("guard every mutation with a module-level threading.Lock "
+            "(`with _lock:`) — or make the state per-instance")
+
+    def begin_file(self, ctx: FileCtx) -> None:
+        self._containers: Set[str] = set()
+        self._module_names: Set[str] = set()
+        for st in ctx.tree.body:
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(st, ast.Assign):
+                targets, value = st.targets, st.value
+            elif isinstance(st, ast.AnnAssign) and st.value is not None:
+                targets, value = [st.target], st.value
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    self._module_names.add(t.id)
+                    if _is_container_ctor(value):
+                        self._containers.add(t.id)
+        # name -> list of (func_qualname, guarded, node)
+        self._sites: Dict[str, List[Tuple[str, bool, ast.AST]]] = {}
+        self._globals_in_func: Dict[str, Set[str]] = {}
+
+    def _record(self, walk: Walk, name: str, node: ast.AST) -> None:
+        if not walk.func_stack:
+            return                       # module-level init is fine
+        self._sites.setdefault(name, []).append(
+            (walk.qualname(), bool(walk.held_locks()), node))
+
+    def visit(self, node: ast.AST, walk: Walk) -> None:
+        if isinstance(node, ast.Global):
+            if walk.func_stack:
+                self._globals_in_func.setdefault(
+                    walk.qualname(), set()).update(node.names)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+            targets = (node.targets if isinstance(node, (ast.Assign,
+                                                         ast.Delete))
+                       else [node.target])
+            for t in targets:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id in self._containers):
+                    self._record(walk, t.value.id, node)
+                elif (isinstance(t, ast.Name)
+                        and t.id in self._module_names
+                        and t.id in self._globals_in_func.get(
+                            walk.qualname(), ())):
+                    self._record(walk, t.id, node)
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if (isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in self._containers
+                    and fn.attr in MUTATORS):
+                self._record(walk, fn.value.id, node)
+
+    def end_file(self, walk: Walk) -> None:
+        for name in sorted(self._sites):
+            sites = self._sites[name]
+            funcs = {q for q, _, _ in sites}
+            if len(funcs) < 2:
+                continue
+            unguarded = [(q, n) for q, g, n in sites if not g]
+            if not unguarded:
+                continue
+            unguarded.sort(key=lambda s: (s[1].lineno, s[1].col_offset))
+            q, node = unguarded[0]
+            walk.engine.report(
+                walk.ctx, self.id, node.lineno, node.col_offset,
+                f"module-level mutable state '{name}' is mutated from "
+                f"{len(funcs)} functions with at least one write "
+                f"outside any module lock", self.HINT, q)
+
+
+# ---------------------------------------------------------------------------
+# unlocked-rmw
+# ---------------------------------------------------------------------------
+
+class UnlockedRmwRule:
+    """``self.x += n`` in a lock-guarded class, outside the lock:
+    load/add/store is three bytecodes and concurrent increments lose
+    updates (the exact race perfcounters.bump() exists to prevent)."""
+
+    id = "unlocked-rmw"
+    node_types = (ast.AugAssign,)
+    HINT = ("perform the increment inside `with self._lock:` (or the "
+            "class's guarding lock); a method suffixed `_locked` "
+            "documents caller-holds-lock and is exempt")
+
+    def visit(self, node: ast.AugAssign, walk: Walk) -> None:
+        cls = walk.current_class
+        if not cls or cls not in walk.ctx.class_locks:
+            return
+        t = node.target
+        if not (isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name) and t.value.id == "self"):
+            return
+        if not walk.func_stack:
+            return
+        if any(f == "__init__" or f.endswith("_locked")
+               for f in walk.func_stack):
+            return
+        if walk.held_locks():
+            return
+        walk.report(self.id, node,
+                    f"read-modify-write of self.{t.attr} outside any "
+                    f"lock in lock-guarded class {cls} — concurrent "
+                    f"increments lose updates", self.HINT)

@@ -1,0 +1,377 @@
+"""Tier B — lockset-based race/deadlock detection.
+
+* ``lock-mixed-guard`` — per class, collect which ``with self._lock``
+  blocks guard each attribute's writes; an attribute written both
+  under and outside its dominant lock is a data race (the unguarded
+  write can interleave with a guarded reader/writer).  ``__init__``
+  writes are excluded (construction is single-threaded) and methods
+  suffixed ``_locked`` are treated as guarded by contract (the
+  caller-holds-lock convention memory/spill.py uses).
+
+* ``lock-order`` — build the inter-lock acquisition-order graph from
+  (a) lexical ``with A: ... with B:`` nesting and (b) calls made while
+  holding a lock to functions whose (transitive) bodies acquire other
+  locks, then flag cycles.  This statically pins the ordering the
+  runtime guard in ``memory/semaphore.py`` only checks dynamically —
+  semaphore BEFORE spill, always.  Device-semaphore acquisition is
+  recognized non-lexically too: ``acquire_if_necessary(...)`` /
+  ``.scope()`` calls map to the ``<device-semaphore>`` pseudo-lock, so
+  acquiring the semaphore while lexically holding any other lock
+  contributes an edge.
+
+Known limits (documented in docs/static_analysis.md): lock identities
+resolve within a file (module locks) or class (``self`` locks); calls
+through untyped objects (``obj.method()`` where ``obj`` is a local)
+are not resolved; ``with sem.scope():`` regions DO contribute outgoing
+semaphore->X edges (the walker tracks the pseudo-lock on a separate
+acquisition stack), but a permit held between bare ``acquire``/
+``release`` CALLS is not a lexical region and contributes none.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from spark_rapids_tpu.analysis.core import (
+    SEMAPHORE_CALLS,
+    SEMAPHORE_LOCK,
+    Engine,
+    FileCtx,
+    Walk,
+    _is_semaphore_acquire,
+)
+from spark_rapids_tpu.analysis.rules_invariants import (
+    MUTATORS,
+    _trailing_name,
+)
+
+
+# ---------------------------------------------------------------------------
+# lock-mixed-guard
+# ---------------------------------------------------------------------------
+
+class LockMixedGuardRule:
+    id = "lock-mixed-guard"
+    node_types = (ast.Assign, ast.AugAssign, ast.Delete, ast.Call)
+    HINT = ("take the class's lock around the unguarded write (or move "
+            "it into a `*_locked` method whose callers hold the lock)")
+
+    def begin_file(self, ctx: FileCtx) -> None:
+        # (class, attr) -> list of (guarded, lock_or_None, qual, node)
+        self._writes: Dict[Tuple[str, str],
+                           List[Tuple[bool, Optional[str], str,
+                                      ast.AST]]] = {}
+
+    def _self_attr(self, t: ast.AST) -> Optional[str]:
+        """self.X or self.X[...] target -> attr name."""
+        if isinstance(t, ast.Subscript):
+            t = t.value
+        if (isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name) and t.value.id == "self"):
+            return t.attr
+        return None
+
+    def _record(self, walk: Walk, attr: str, node: ast.AST) -> None:
+        cls = walk.current_class
+        if not cls or not walk.func_stack:
+            return
+        locks = walk.ctx.class_locks.get(cls)
+        if not locks or attr in locks:
+            return
+        in_init = any(f == "__init__" for f in walk.func_stack)
+        if in_init:
+            return
+        by_contract = any(f.endswith("_locked") for f in walk.func_stack)
+        held = walk.held_locks()
+        guarded = bool(held) or by_contract
+        lock = held[-1] if held else ("<caller-held>" if by_contract
+                                      else None)
+        self._writes.setdefault((cls, attr), []).append(
+            (guarded, lock, walk.qualname(), node))
+
+    def visit(self, node: ast.AST, walk: Walk) -> None:
+        if isinstance(node, (ast.Assign, ast.Delete)):
+            for t in node.targets:
+                attr = self._self_attr(t)
+                if attr is not None:
+                    self._record(walk, attr, node)
+        elif isinstance(node, ast.AugAssign):
+            attr = self._self_attr(node.target)
+            if attr is not None:
+                self._record(walk, attr, node)
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if (isinstance(fn, ast.Attribute) and fn.attr in MUTATORS
+                    and isinstance(fn.value, ast.Attribute)
+                    and isinstance(fn.value.value, ast.Name)
+                    and fn.value.value.id == "self"):
+                self._record(walk, fn.value.attr, node)
+
+    def end_file(self, walk: Walk) -> None:
+        for (cls, attr) in sorted(self._writes):
+            sites = self._writes[(cls, attr)]
+            guarded = [s for s in sites if s[0]]
+            unguarded = [s for s in sites if not s[0]]
+            if not guarded or not unguarded:
+                continue
+            # dominant lock: the most common guarding lock identity
+            counts: Dict[str, int] = {}
+            for _, lock, _, _ in guarded:
+                if lock is not None:
+                    counts[lock] = counts.get(lock, 0) + 1
+            dominant = (sorted(counts, key=lambda k: (-counts[k], k))[0]
+                        if counts else "<caller-held>")
+            short = dominant.split("::")[-1]
+            for _, _, qual, node in sorted(
+                    unguarded, key=lambda s: (s[3].lineno,
+                                              s[3].col_offset)):
+                walk.engine.report(
+                    walk.ctx, self.id, node.lineno, node.col_offset,
+                    f"attribute '{attr}' of {cls} is written under its "
+                    f"dominant lock {short} at {len(guarded)} site(s) "
+                    f"but UNGUARDED here", self.HINT, qual)
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+class LockOrderRule:
+    id = "lock-order"
+    node_types = (ast.With, ast.Call, ast.Import, ast.ImportFrom)
+    HINT = ("pick one global acquisition order (the runtime's is "
+            "semaphore -> spill -> leaf locks) and re-nest the "
+            "inverted site to match it")
+
+    def __init__(self):
+        # func key "rel::Qual.name" -> set of lock ids acquired lexically
+        self._acquires: Dict[str, Set[str]] = {}
+        # func key -> list of unresolved callee descriptors
+        self._calls: Dict[str, List[Tuple]] = {}
+        # observed ordered pairs: (A, B) -> (rel, line) first/min site
+        self._edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        # per-file import alias -> module rel path ("a/b.py")
+        self._aliases: Dict[str, Dict[str, str]] = {}
+
+    # -- helpers ---------------------------------------------------------
+    def _func_key(self, walk: Walk) -> Optional[str]:
+        if not walk.func_stack:
+            return None
+        return f"{walk.ctx.rel}::{walk.qualname()}"
+
+    def _edge(self, a: str, b: str, rel: str, line: int) -> None:
+        if a == b:
+            return
+        site = self._edges.get((a, b))
+        if site is None or (rel, line) < site:
+            self._edges[(a, b)] = (rel, line)
+
+    def _with_locks(self, node: ast.With, walk: Walk) -> List[str]:
+        out = []
+        for item in node.items:
+            ident = walk.lock_identity(item.context_expr)
+            if ident is None and _is_semaphore_acquire(
+                    item.context_expr):
+                ident = SEMAPHORE_LOCK
+            if ident is not None:
+                out.append(ident)
+        return out
+
+    # -- visits ----------------------------------------------------------
+    def visit(self, node: ast.AST, walk: Walk) -> None:
+        rel = walk.ctx.rel
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            amap = self._aliases.setdefault(rel, {})
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    amap[a.asname or a.name.split(".")[0]] = \
+                        a.name.replace(".", "/") + ".py"
+            else:
+                mod = (node.module or "").replace(".", "/")
+                for a in node.names:
+                    amap[a.asname or a.name] = f"{mod}/{a.name}.py"
+            return
+        key = self._func_key(walk)
+        if isinstance(node, ast.With):
+            new_locks = self._with_locks(node, walk)
+            held = list(walk.held_acquires())
+            for b in new_locks:
+                for a in held:
+                    self._edge(a, b, rel, node.lineno)
+                held.append(b)
+                if key is not None:
+                    self._acquires.setdefault(key, set()).add(b)
+            return
+        if isinstance(node, ast.Call):
+            name = _trailing_name(node.func)
+            held = walk.held_acquires()
+            if name in SEMAPHORE_CALLS and held:
+                for a in held:
+                    self._edge(a, SEMAPHORE_LOCK, rel, node.lineno)
+            if key is None:
+                return
+            fn = node.func
+            desc: Optional[Tuple] = None
+            if isinstance(fn, ast.Name):
+                desc = ("mod", rel, fn.id)
+            elif isinstance(fn, ast.Attribute):
+                if (isinstance(fn.value, ast.Name)
+                        and fn.value.id == "self" and walk.current_class):
+                    desc = ("self", rel, walk.current_class, fn.attr)
+                elif isinstance(fn.value, ast.Name):
+                    desc = ("alias", rel, fn.value.id, fn.attr)
+            if desc is not None:
+                self._calls.setdefault(key, []).append(
+                    (desc, tuple(held), node.lineno))
+
+    # -- cross-file resolution + cycle detection -------------------------
+    def _resolve(self, desc: Tuple,
+                 funcs: Set[str]) -> Optional[str]:
+        kind = desc[0]
+        if kind == "mod":
+            k = f"{desc[1]}::{desc[2]}"
+            return k if k in funcs else None
+        if kind == "self":
+            k = f"{desc[1]}::{desc[2]}.{desc[3]}"
+            return k if k in funcs else None
+        if kind == "alias":
+            rel, alias, attr = desc[1], desc[2], desc[3]
+            target = self._aliases.get(rel, {}).get(alias)
+            if target is None:
+                return None
+            for cand in funcs:
+                frel, qual = cand.split("::", 1)
+                if frel.endswith(target) and qual.split(".")[-1] == attr \
+                        and "." not in qual:
+                    return cand
+            return None
+        return None
+
+    def end_run(self, engine: Engine) -> None:
+        funcs = set(self._acquires) | set(self._calls)
+        # resolve call descriptors once, then propagate acquire sets to
+        # a fixpoint over the call graph (bounded by lock-set growth)
+        call_graph: Dict[str, List[Tuple[str, Tuple[str, ...], int,
+                                         str]]] = {}
+        for caller, calls in self._calls.items():
+            rel = caller.split("::", 1)[0]
+            for desc, held, line in calls:
+                callee = self._resolve(desc, funcs)
+                if callee is not None:
+                    call_graph.setdefault(caller, []).append(
+                        (callee, held, line, rel))
+        trans: Dict[str, Set[str]] = {
+            k: set(v) for k, v in self._acquires.items()}
+        changed = True
+        while changed:
+            changed = False
+            for caller, edges in call_graph.items():
+                acc = trans.setdefault(caller, set())
+                for callee, _, _, _ in edges:
+                    extra = trans.get(callee, ())
+                    for lk in extra:
+                        if lk not in acc:
+                            acc.add(lk)
+                            changed = True
+        # held-across-call edges
+        for caller, edges in call_graph.items():
+            for callee, held, line, rel in edges:
+                for b in trans.get(callee, ()):
+                    for a in held:
+                        self._edge(a, b, rel, line)
+        # cycle detection over the order graph
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in self._edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        for cycle in _find_cycles(graph):
+            sites = []
+            for i, a in enumerate(cycle):
+                b = cycle[(i + 1) % len(cycle)]
+                rel, line = self._edges.get((a, b), ("?", 0))
+                sites.append(f"{_short(a)}->{_short(b)} at {rel}:{line}")
+            first_rel, first_line = self._edges.get(
+                (cycle[0], cycle[1 % len(cycle)]), ("<repo>", 1))
+            ctx = engine.ctx_for(first_rel)
+            engine.report(
+                ctx, self.id, first_line, 0,
+                "lock acquisition-order cycle (deadlock under "
+                "concurrency): " + "; ".join(sites), self.HINT,
+                "lock-order-graph")
+
+
+def _short(lock: str) -> str:
+    return lock.split("::")[-1] if "::" in lock else lock
+
+
+def _find_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Strongly connected components of size >= 2 (plus 2-cycles),
+    each canonicalized to start at its smallest lock id — deterministic
+    across runs."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan (the repo tree nests deep enough that a
+        # recursive walk could hit the interpreter limit)
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) >= 2:
+                    sccs.append(comp)
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    out = []
+    for comp in sccs:
+        start = min(comp)
+        # order the cycle deterministically: smallest id first, then
+        # follow edges greedily (smallest next) within the component
+        comp_set = set(comp)
+        ordered = [start]
+        cur = start
+        while True:
+            nxts = sorted(n for n in graph.get(cur, ())
+                          if n in comp_set and n not in ordered)
+            if not nxts:
+                break
+            cur = nxts[0]
+            ordered.append(cur)
+        out.append(ordered)
+    out.sort()
+    return out

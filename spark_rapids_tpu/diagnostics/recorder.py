@@ -230,7 +230,7 @@ class QueryDiagnostics:
                 st.batches += 1
                 st.rows += rows
                 if self.level >= DEBUG:
-                    self._append_event({
+                    self._append_event_locked({
                         "ev": "op_batch", "ts_ns": t0_ns, "op": path,
                         "path": path, "batch": batch_idx, "rows": rows,
                         "dur_ns": dur})
@@ -254,8 +254,10 @@ class QueryDiagnostics:
         for key, n in deltas:
             c[key] = c.get(key, 0) + n
 
-    def _append_event(self, e) -> None:
-        """Caller holds self._lock.  The in-memory list is bounded (a
+    def _append_event_locked(self, e) -> None:
+        """Caller holds self._lock (the ``_locked`` suffix is the
+        caller-holds-lock contract tpulint's lockset rules recognize).
+        The in-memory list is bounded (a
         launch-per-row pathological query must not hold GBs of event
         dicts until flush); overflow counts into ``events_dropped`` on
         query_end instead of growing without limit."""
@@ -272,7 +274,7 @@ class QueryDiagnostics:
         e.update(fields)
         with self._lock:
             if not self.closed:
-                self._append_event(e)
+                self._append_event_locked(e)
 
     # -- instrumentation entry points ----------------------------------
     def launch(self, dur_ns: int, compiled: int) -> None:
@@ -289,11 +291,11 @@ class QueryDiagnostics:
             self._attr_many(path, deltas)
             if self.level >= MODERATE:
                 ts = self._now()
-                self._append_event({
+                self._append_event_locked({
                     "ev": "launch", "ts_ns": ts - dur_ns, "op": path,
                     "dur_ns": dur_ns, "compiled": int(compiled)})
                 if compiled:
-                    self._append_event({
+                    self._append_event_locked({
                         "ev": "compile", "ts_ns": ts - dur_ns, "op": path,
                         "mode": "inline", "dur_ns": dur_ns, "label": ""})
 
@@ -308,7 +310,7 @@ class QueryDiagnostics:
                 return
             self._attr_many(path, deltas)
             if counted_sync and self.level >= MODERATE:
-                self._append_event({
+                self._append_event_locked({
                     "ev": "sync", "ts_ns": self._now(), "op": path,
                     "kind": "scalar", "dur_ns": 0, "bytes": int(nbytes)})
 
@@ -324,7 +326,7 @@ class QueryDiagnostics:
              "dur_ns": dur_ns, "bytes": 0}
         with self._lock:
             if not self.closed:
-                self._append_event(e)
+                self._append_event_locked(e)
 
     def cache_event(self, hit: bool, label: str) -> None:
         """Compile-registry hit/miss (counter attributed via bump)."""

@@ -263,6 +263,8 @@ def annotate_scan_error(exc: BaseException, path: str,
     if notes is None:
         try:
             exc.__notes__ = [note]
+        # tpulint: disable=cancel-swallow (guards a setattr on the
+        # exception object; nothing cancellable runs in the try)
         except Exception:
             pass
     elif not any(f"file={path}" in n for n in notes):
@@ -270,6 +272,7 @@ def annotate_scan_error(exc: BaseException, path: str,
     if getattr(exc, "srt_file", None) is None:
         try:
             exc.srt_file = path
+        # tpulint: disable=cancel-swallow (setattr guard, as above)
         except Exception:
             pass
     return exc

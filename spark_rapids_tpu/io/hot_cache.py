@@ -130,6 +130,8 @@ class HotTableCache:
             for h in v.handles:
                 try:
                     h.close()
+                # tpulint: disable=cancel-swallow (best-effort close of
+                # evicted spill handles on the non-cancellable put path)
                 except Exception:
                     pass
         return True
@@ -146,6 +148,8 @@ class HotTableCache:
                 n += 1
                 try:
                     h.close()
+                # tpulint: disable=cancel-swallow (best-effort close at
+                # clear/session shutdown; must not abort the sweep)
                 except Exception:
                     pass
         return n

@@ -94,6 +94,8 @@ def _filters_to_arrow(pushed) -> Optional[list]:
             l, r = f.children
             if isinstance(l, E.AttributeReference) and isinstance(r, E.Literal):
                 out.append((l.colname, op, r.value))
+        # tpulint: disable=cancel-swallow (pure expression translation;
+        # an untranslatable predicate is re-checked by the filter above)
         except Exception:
             continue
     return out or None

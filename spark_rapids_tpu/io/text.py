@@ -474,7 +474,14 @@ def _read_csv_spark(path: str, schema: T.StructType, options: dict):
             fast = _read_csv_fast(path, schema, options)
         except RuntimeError:
             raise       # FAILFAST surfaced by the fast path
-        except Exception:
+        except Exception as e:
+            from spark_rapids_tpu.resilience import classify as _CL
+
+            if _CL.classify_failure(e) == _CL.PROPAGATE:
+                # QueryCancelled / deadline / ANSI errors are the
+                # query's correct observable behavior — retrying the
+                # strict loop would swallow a cancellation (ISSUE 9)
+                raise
             fast = None  # any fast-path surprise: the strict loop decides
         if fast is not None:
             return fast
@@ -675,7 +682,13 @@ def _read_json_spark(path: str, schema: T.StructType, options: dict):
     if str(options.get("tpuFastParse", "true")).lower() != "false":
         try:
             fast = _read_json_fast(path, schema, options)
-        except Exception:
+        except Exception as e:
+            from spark_rapids_tpu.resilience import classify as _CL
+
+            if _CL.classify_failure(e) == _CL.PROPAGATE:
+                # a tripped CancelToken (or ANSI-mode error) must
+                # unwind, not silently degrade to the strict loop
+                raise
             fast = None
         if fast is not None:
             return fast

@@ -126,6 +126,8 @@ class query_lifecycle:
                                 "query_rejected",
                                 query_id=ctx.query_id,
                                 detail=str(e)[:300])
+                        # tpulint: disable=cancel-swallow (telemetry
+                        # isolation; QueryRejected re-raised below)
                         except Exception:
                             pass
                 raise
@@ -170,6 +172,9 @@ def _cleanup_query(ctx: QueryContext) -> None:
         fn = ctx.cleanup_hooks.pop()
         try:
             fn()
+        # tpulint: disable=cancel-swallow (cleanup-hook contract: hooks
+        # are idempotent + best-effort; the query's own exception — incl.
+        # a tripped token's — is re-raised by the main unwind path)
         except Exception:
             pass
     # 1. residual semaphore permit: the collect-level scope released one
@@ -249,6 +254,8 @@ def reset_leaked_state() -> None:
         for sid in mgr.active_shuffles():
             try:
                 mgr.unregister_shuffle(sid)
+            # tpulint: disable=cancel-swallow (leaked-state recovery in
+            # tests; no query is running when this sweeps)
             except Exception:
                 pass
     from spark_rapids_tpu.io import writer as _writer

@@ -81,6 +81,9 @@ def _run() -> None:
                     if hub is not None:
                         try:
                             hub.deadline_tripped(ctx)
+                        # tpulint: disable=cancel-swallow (telemetry
+                        # isolation: a flight-recorder failure must not
+                        # break the watchdog loop)
                         except Exception:
                             pass
         with _COND:

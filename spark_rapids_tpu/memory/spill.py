@@ -93,7 +93,7 @@ class SpillableColumnarBatch:
     def get_batch(self) -> ColumnarBatch:
         """Materialize on device (unspilling if needed) and bump LRU."""
         with self._framework._lock:
-            self._framework._touch(self)
+            self._framework._touch_locked(self)
             if self.state == STATE_DEVICE:
                 return self._batch
         # needs unspill: make room first (outside our own pin)
@@ -119,7 +119,7 @@ class SpillableColumnarBatch:
             if self.closed:
                 return
             self.closed = True
-            self._framework._unregister(self)
+            self._framework._unregister_locked(self)
             self._batch = None
             self._host = None
             if self._disk_path and os.path.exists(self._disk_path):
@@ -255,7 +255,7 @@ class SpillFramework:
     # -- registration ----------------------------------------------------
     def _register(self, h: SpillableColumnarBatch) -> None:
         with self._lock:
-            self._touch(h)
+            self._touch_locked(h)
             self._handles.append(h)
             self._device_used += h.device_bytes
             if self.debug:
@@ -316,13 +316,13 @@ class SpillFramework:
                 pass
         return len(victims)
 
-    def _unregister(self, h: SpillableColumnarBatch) -> None:
+    def _unregister_locked(self, h: SpillableColumnarBatch) -> None:
         if h.state == STATE_DEVICE:
             self._device_used -= h.device_bytes
         if h in self._handles:
             self._handles.remove(h)
 
-    def _touch(self, h: SpillableColumnarBatch) -> None:
+    def _touch_locked(self, h: SpillableColumnarBatch) -> None:
         self._tick += 1
         h.lru_tick = self._tick
 

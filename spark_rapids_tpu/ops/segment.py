@@ -11,6 +11,7 @@ reduction in one pass.  Null/NaN semantics follow Spark SQL:
 """
 from __future__ import annotations
 
+import threading
 from typing import Tuple
 
 import jax
@@ -68,7 +69,14 @@ class SegBounds:
         return self.csum_diff(validity.astype(jnp.int64))
 
 
-_AMBIENT_BOUNDS = []
+_BOUNDS_TLS = threading.local()
+
+
+def _bounds_stack() -> list:
+    st = getattr(_BOUNDS_TLS, "stack", None)
+    if st is None:
+        st = _BOUNDS_TLS.stack = []
+    return st
 
 
 class bounds_scope:
@@ -76,25 +84,29 @@ class bounds_scope:
     segment primitive called with ``num_segments == bounds.num`` takes the
     boundary form instead of a full-width scatter.  Installed by the
     aggregate's bounded program builder around its evaluation so the ~40
-    SEG call sites need no signature change; tracing is synchronous, so a
-    plain stack with try/finally scoping is race-free."""
+    SEG call sites need no signature change.  The ambient stack is
+    PER-THREAD: tracing is synchronous on its own thread, but concurrent
+    collects and the AOT compile pool trace on different threads at the
+    same time, and one query's bounds must never leak into another's
+    trace (found by tpulint's module-state rule, ISSUE 9)."""
 
     def __init__(self, b: "SegBounds"):
         self.b = b
 
     def __enter__(self):
-        _AMBIENT_BOUNDS.append(self.b)
+        _bounds_stack().append(self.b)
         return self.b
 
     def __exit__(self, *a):
-        _AMBIENT_BOUNDS.pop()
+        _bounds_stack().pop()
 
 
 def _active_bounds(num_segments: int, bounds):
     if bounds is not None:
         return bounds
-    if _AMBIENT_BOUNDS and _AMBIENT_BOUNDS[-1].num == num_segments:
-        return _AMBIENT_BOUNDS[-1]
+    st = _bounds_stack()
+    if st and st[-1].num == num_segments:
+        return st[-1]
     return None
 
 

@@ -86,6 +86,8 @@ class CircuitBreakerRegistry:
             if hub is not None:
                 try:
                     hub.breaker_opened(key, reason)
+                # tpulint: disable=cancel-swallow (telemetry isolation:
+                # a hub failure must never break the breaker)
                 except Exception:
                     pass
         return tripped
@@ -174,6 +176,8 @@ def expr_fingerprint(exprs) -> str:
     for e in exprs or []:
         try:
             parts.append(e.sql_string())
+        # tpulint: disable=cancel-swallow (pure stringification; the
+        # class-name fallback keeps the fingerprint total)
         except Exception:
             parts.append(type(e).__name__)
     h = hashlib.sha1(";".join(parts).encode("utf-8", "replace"))
@@ -189,6 +193,8 @@ def plan_key(plan) -> Key:
 
     try:
         exprs = _exprs_of(plan)
+    # tpulint: disable=cancel-swallow (pure plan-tree introspection at
+    # key-build time; no blocking layer runs under it)
     except Exception:
         exprs = []
     return (type(plan).__name__, expr_fingerprint(exprs))

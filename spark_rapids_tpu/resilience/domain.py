@@ -270,5 +270,7 @@ def _close_quietly(it) -> None:
     if it is not None:
         try:
             it.close()
+        # tpulint: disable=cancel-swallow (generator close on the unwind
+        # path; the original exception is already propagating)
         except Exception:
             pass

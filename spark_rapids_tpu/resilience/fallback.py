@@ -185,5 +185,7 @@ def execute_fallback(op, ansi: bool) -> Iterator[object]:
 def has_fallback(op) -> bool:
     try:
         return build_cpu_subplan(op) is not None
+    # tpulint: disable=cancel-swallow (plan-construction probe — builds
+    # no batches and observes no token; False just means no CPU twin)
     except Exception:
         return False

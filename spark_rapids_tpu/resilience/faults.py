@@ -209,11 +209,11 @@ def _poison_batch(batch, seed: int):
     return ColumnarBatch(cols, batch.num_rows, batch.schema)
 
 
-def parse_inject_conf(spec: str) -> int:
-    """Arm faults from the ``spark.rapids.tpu.resilience.testInject`` conf:
-    ``kind:Operator[:count[:at_batch[:seed]]]`` with ``;`` separating
-    multiple faults.  Returns how many were armed."""
-    n = 0
+def _parse_spec(spec: str) -> list:
+    """PURE parse of a testInject spec — validates and returns
+    ``[(operator, kind, count, at_batch, seed), ...]`` without touching
+    any module state, so callers can mutate atomically afterwards."""
+    out = []
     for part in (spec or "").split(";"):
         part = part.strip()
         if not part or part.upper() == "NONE":
@@ -224,12 +224,24 @@ def parse_inject_conf(spec: str) -> int:
                 f"bad testInject spec {part!r}: expected "
                 f"'kind:Operator[:count[:atBatch[:seed]]]'")
         kind, operator = bits[0], bits[1]
+        if kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} (use one of {KINDS})")
         count = int(bits[2]) if len(bits) > 2 else 1
         at_batch = int(bits[3]) if len(bits) > 3 else 0
         seed = int(bits[4]) if len(bits) > 4 else 0
+        out.append((operator, kind, count, at_batch, seed))
+    return out
+
+
+def parse_inject_conf(spec: str) -> int:
+    """Arm faults from the ``spark.rapids.tpu.resilience.testInject`` conf:
+    ``kind:Operator[:count[:at_batch[:seed]]]`` with ``;`` separating
+    multiple faults.  Returns how many were armed."""
+    parsed = _parse_spec(spec)
+    for operator, kind, count, at_batch, seed in parsed:
         inject_fault(operator, kind, count, at_batch, seed)
-        n += 1
-    return n
+    return len(parsed)
 
 
 def arm_conf_spec(spec: str) -> int:
@@ -237,13 +249,26 @@ def arm_conf_spec(spec: str) -> int:
     (re-arming on every collect would turn a 'fails once' spec into
     fails-every-query).  Changing the spec first de-arms whatever the
     previous spec left behind — a fault whose operator never ran must not
-    linger and fire under the NEW spec's queries."""
+    linger and fire under the NEW spec's queries.
+
+    Parse happens BEFORE any state mutation and the
+    check/de-arm/arm/claim sequence is one critical section: a bad spec
+    leaves the previous arming fully intact, racing same-spec collects
+    arm once, and racing different-spec collects each install a
+    consistent (spec, faults) pair — never an interleaved mix."""
     global _CONF_SPEC
     norm = (spec or "").strip()
-    if norm == _CONF_SPEC:
-        return 0
-    if _CONF_SPEC and _CONF_SPEC.upper() != "NONE":
-        clear_faults()
-    n = parse_inject_conf(norm)
-    _CONF_SPEC = norm
-    return n
+    parsed = _parse_spec(norm)      # raises on a bad spec: no mutation
+    with _LOCK:
+        if norm == _CONF_SPEC:
+            return 0
+        if _CONF_SPEC and _CONF_SPEC.upper() != "NONE":
+            # de-arm the previous spec's leftovers (clear_faults
+            # inlined — it takes _LOCK and we already hold it)
+            _FAULTS.clear()
+            _FIRED.clear()
+        for operator, kind, count, at_batch, seed in parsed:
+            _FAULTS.append(_Fault(operator, kind, int(count),
+                                  int(at_batch), int(seed)))
+        _CONF_SPEC = norm
+    return len(parsed)

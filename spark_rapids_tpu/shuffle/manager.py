@@ -177,8 +177,11 @@ class TpuShuffleManager:
         try:
             for f in futures:
                 n = f.result()
-                self.bytes_written += n
-                self.blocks_written += 1
+                # under _lock: concurrent queries share this singleton
+                # manager, and += is a non-atomic read-modify-write
+                with self._lock:
+                    self.bytes_written += n
+                    self.blocks_written += 1
         except BaseException:
             for f in futures:
                 f.cancel()

@@ -104,8 +104,12 @@ def serialize_batch(batch: ColumnarBatch, codec: Optional[str] = None) -> bytes:
         offset += _align8(len(raw))
         return off, len(raw)
 
-    # one host sync for the whole batch
-    host_cols = jax.device_get(
+    # one LOGICAL host sync for the whole batch: sync_get routes the
+    # pytree fetch through sync_event, so host_syncs counts this as a
+    # single round trip instead of one per materialized leaf
+    from spark_rapids_tpu.perfcounters import sync_get
+
+    host_cols = sync_get(
         [(c.validity, c.data, c.chars, c.lengths, c.elem_valid)
          for c in batch.columns])
     for c, (validity, data, chars, lengths, elem_valid) in zip(

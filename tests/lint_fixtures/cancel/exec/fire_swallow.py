@@ -1,0 +1,5 @@
+def pull_batch(it):
+    try:
+        return next(it)
+    except Exception:
+        return None

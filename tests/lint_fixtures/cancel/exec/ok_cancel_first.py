@@ -1,0 +1,11 @@
+class QueryCancelled(RuntimeError):
+    pass
+
+
+def pull_batch(it):
+    try:
+        return next(it)
+    except QueryCancelled:
+        raise
+    except Exception:
+        return None

@@ -1,0 +1,11 @@
+def classify_failure(e):
+    return "propagate"
+
+
+def pull_batch(it):
+    try:
+        return next(it)
+    except Exception as e:
+        if classify_failure(e) == "propagate":
+            raise
+        return None

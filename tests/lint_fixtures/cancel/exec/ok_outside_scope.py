@@ -1,0 +1,7 @@
+# this file's TWIN outside exec//io/ would not fire at all; inside the
+# scope, a narrow except never fires
+def pull_batch(it):
+    try:
+        return next(it)
+    except StopIteration:
+        return None

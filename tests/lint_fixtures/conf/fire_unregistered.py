@@ -1,0 +1,3 @@
+def read_conf(settings):
+    # typo'd key: never declared via the conf() builder
+    return settings.get("spark.rapids.tpu.scan.prefetchDepht")

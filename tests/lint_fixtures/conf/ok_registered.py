@@ -1,0 +1,22 @@
+def conf(key):
+    class _B:
+        def doc(self, d):
+            return self
+
+        def integer_conf(self, v):
+            return self
+
+    return _B()
+
+
+PREFETCH = conf("spark.rapids.tpu.scan.prefetch.depth").doc(
+    "fixture").integer_conf(2)
+
+
+def read_conf(settings):
+    return settings.get("spark.rapids.tpu.scan.prefetch.depth")
+
+
+def read_dynamic(settings):
+    # per-op kill-switch family is registered dynamically
+    return settings.get("spark.rapids.sql.exec.TpuSortExec")

@@ -1,0 +1,10 @@
+COUNTERS = {"programs_launched": 0}
+
+
+def bad_direct_write():
+    # three bytecodes; a racing thread loses the update
+    COUNTERS["programs_launched"] += 1
+
+
+def bad_update_call():
+    COUNTERS.update(programs_launched=2)

@@ -1,0 +1,6 @@
+def bump(key, n=1):
+    pass
+
+
+def good_write():
+    bump("programs_launched")

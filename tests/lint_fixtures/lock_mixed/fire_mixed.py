@@ -1,0 +1,15 @@
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bytes = 0
+
+    def add(self, n):
+        with self._lock:
+            self._bytes = self._bytes + n
+
+    def reset(self):
+        # UNGUARDED write to an attribute the lock dominates
+        self._bytes = 0

@@ -1,0 +1,19 @@
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bytes = 0
+
+    def add(self, n):
+        with self._lock:
+            self._bytes = self._bytes + n
+
+    def reset(self):
+        with self._lock:
+            self._bytes = 0
+
+    def _drain_locked(self):
+        # caller-holds-lock contract: treated as guarded
+        self._bytes = 0

@@ -1,0 +1,20 @@
+import threading
+
+SEMAPHORE = threading.Lock()   # stands in for the device semaphore
+SPILL = threading.Lock()       # stands in for the spill framework lock
+
+
+def run_query():
+    # the documented order: semaphore BEFORE spill
+    with SEMAPHORE:
+        with SPILL:
+            pass
+
+
+def bad_spill_path():
+    # INVERTED: acquiring the semaphore while holding the spill lock
+    # (the deadlock memory/semaphore.py's runtime guard catches only
+    # when the interleaving actually happens)
+    with SPILL:
+        with SEMAPHORE:
+            pass

@@ -1,0 +1,18 @@
+import threading
+
+SPILL = threading.Lock()
+
+
+def run_query(sem):
+    # forward order: device semaphore (via scope()) before spill
+    with sem.scope():
+        with SPILL:
+            pass
+
+
+def bad_spill_path(sem):
+    # INVERTED: acquiring the semaphore (non-lexical call form) while
+    # holding the spill lock — the deadlock the runtime guard in
+    # memory/semaphore.py catches only when the interleaving happens
+    with SPILL:
+        sem.acquire_if_necessary()

@@ -1,0 +1,21 @@
+import threading
+
+SEMAPHORE = threading.Lock()
+SPILL = threading.Lock()
+
+
+def run_query():
+    with SEMAPHORE:
+        with SPILL:
+            pass
+
+
+def _acquire_semaphore():
+    with SEMAPHORE:
+        pass
+
+
+def bad_spill_path():
+    # the inversion hides one call deep: still a cycle
+    with SPILL:
+        _acquire_semaphore()

@@ -1,0 +1,17 @@
+import threading
+
+SEMAPHORE = threading.Lock()
+SPILL = threading.Lock()
+
+
+def run_query():
+    with SEMAPHORE:
+        with SPILL:
+            pass
+
+
+def other_path():
+    # same order everywhere: acyclic
+    with SEMAPHORE:
+        with SPILL:
+            pass

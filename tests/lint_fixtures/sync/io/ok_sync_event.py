@@ -1,0 +1,14 @@
+import jax
+
+
+class sync_event:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        pass
+
+
+def fetch(tree):
+    with sync_event():
+        return jax.device_get(tree)

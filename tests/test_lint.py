@@ -1,0 +1,420 @@
+"""tpulint (ISSUE 9): fixture corpus, pragma/baseline mechanics, JSON
+determinism, the tier-1 repo gate, the CLI exit-code contract, and
+regression pins for the real in-repo findings the new rules surfaced
+(and this PR fixed).
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu.analysis import Baseline, run_paths, to_json
+from spark_rapids_tpu.analysis.core import default_rules
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+BASELINE = os.path.join(REPO, "tools", "lint_baseline.json")
+
+
+def _lint_fixtures():
+    return run_paths([FIXTURES], FIXTURES,
+                     rules=default_rules(include_docs=False))
+
+
+def _rules_by_file(findings):
+    out = {}
+    for f in findings:
+        out.setdefault(os.path.basename(f.file), set()).add(f.rule)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# golden fixture corpus: one firing + one non-firing case per rule
+# ---------------------------------------------------------------------------
+
+# file basename -> (rule, must_fire)
+_MATRIX = [
+    ("fire_direct.py", "counter-write", True),
+    ("ok_bump.py", "counter-write", False),
+    ("fire_swallow.py", "cancel-swallow", True),
+    ("fire_bare.py", "cancel-swallow", True),
+    ("fire_narrow_then_broad.py", "cancel-swallow", True),
+    ("fire_rejected_then_broad.py", "cancel-swallow", True),
+    ("ok_reraise.py", "cancel-swallow", False),
+    ("ok_classified.py", "cancel-swallow", False),
+    ("ok_cancel_first.py", "cancel-swallow", False),
+    ("ok_pragma.py", "cancel-swallow", False),
+    ("ok_outside_scope.py", "cancel-swallow", False),
+    ("fire_devget.py", "unaccounted-sync", True),
+    ("ok_sync_event.py", "unaccounted-sync", False),
+    ("fire_unregistered.py", "conf-vocabulary", True),
+    ("ok_registered.py", "conf-vocabulary", False),
+    ("fire_unlocked.py", "module-state", True),
+    ("ok_locked.py", "module-state", False),
+    ("ok_single_writer.py", "module-state", False),
+    ("fire_mixed.py", "lock-mixed-guard", True),
+    ("ok_guarded.py", "lock-mixed-guard", False),
+    ("fire_inverted.py", "lock-order", True),
+    ("fire_transitive.py", "lock-order", True),
+    ("fire_sem_call_inverted.py", "lock-order", True),
+    ("ok_consistent.py", "lock-order", False),
+    ("fire_rmw.py", "unlocked-rmw", True),
+    ("ok_rmw.py", "unlocked-rmw", False),
+]
+
+
+@pytest.fixture(scope="module")
+def fixture_rules():
+    return _rules_by_file(_lint_fixtures())
+
+
+@pytest.mark.parametrize("fname,rule,fires", _MATRIX,
+                         ids=[f"{r}-{f}" for f, r, _ in _MATRIX])
+def test_fixture_matrix(fixture_rules, fname, rule, fires):
+    fired = rule in fixture_rules.get(fname, set())
+    assert fired == fires, (
+        f"{fname}: expected {rule} {'to fire' if fires else 'NOT to fire'}"
+        f"; got rules {sorted(fixture_rules.get(fname, set()))}")
+
+
+def test_pragma_suppresses_identical_code(fixture_rules):
+    """fire_swallow.py and ok_pragma.py are the same handler; only the
+    # tpulint: disable= pragma separates them."""
+    assert "cancel-swallow" in fixture_rules["fire_swallow.py"]
+    assert "cancel-swallow" not in fixture_rules.get("ok_pragma.py",
+                                                     set())
+
+
+def test_lock_order_cycle_names_both_directions():
+    findings = [f for f in _lint_fixtures()
+                if f.rule == "lock-order"
+                and "fire_inverted" in f.file]
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "SEMAPHORE->SPILL" in msg and "SPILL->SEMAPHORE" in msg
+
+
+def test_sync_rule_flags_both_forms():
+    """device_get AND block_until_ready each count."""
+    findings = [f for f in _lint_fixtures()
+                if f.rule == "unaccounted-sync"
+                and "fire_devget" in f.file]
+    assert len(findings) == 2
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics
+# ---------------------------------------------------------------------------
+
+def test_baseline_matches_and_staleness():
+    findings = [f for f in _lint_fixtures()
+                if os.path.basename(f.file) == "fire_direct.py"]
+    assert findings
+    entries = [{"rule": f.rule, "file": f.file, "context": f.context,
+                "message": f.message, "justification": "fixture"}
+               for f in findings]
+    b = Baseline(entries)
+    new, stale = b.split(findings)
+    assert new == [] and stale == []
+    # dropping one entry makes exactly that finding "new"
+    b2 = Baseline(entries[1:])
+    new2, _ = b2.split(findings)
+    assert len(new2) == 1 and new2[0].identity == findings[0].identity
+    # an entry that no longer fires is reported stale
+    ghost = dict(entries[0])
+    ghost["message"] = "no longer exists"
+    _, stale3 = Baseline(entries + [ghost]).split(findings)
+    assert stale3 == [ghost]
+
+
+def test_baseline_requires_justification():
+    with pytest.raises(ValueError, match="justification"):
+        Baseline([{"rule": "x", "file": "y", "message": "z",
+                   "justification": "  "}])
+
+
+def test_shipped_baseline_every_entry_justified():
+    with open(BASELINE) as f:
+        data = json.load(f)
+    for e in data.get("entries", []):
+        assert str(e.get("justification", "")).strip(), e
+    Baseline.load(BASELINE)   # loader enforces the same invariant
+
+
+# ---------------------------------------------------------------------------
+# determinism + the tier-1 repo gate
+# ---------------------------------------------------------------------------
+
+def test_json_determinism_over_repo():
+    """Two runs over the repo produce byte-identical JSON findings."""
+    paths = [os.path.join(REPO, "spark_rapids_tpu"),
+             os.path.join(REPO, "tools")]
+    a = to_json(run_paths(paths, REPO,
+                          rules=default_rules(include_docs=False)))
+    b = to_json(run_paths(paths, REPO,
+                          rules=default_rules(include_docs=False)))
+    assert a == b
+    json.loads(a)             # well-formed
+
+
+def test_repo_lint_gate():
+    """The tier-1 gate: zero non-baselined findings over
+    spark_rapids_tpu/ + tools/ (all rules incl. doc-drift), bounded
+    runtime."""
+    t0 = time.monotonic()
+    findings = run_paths(
+        [os.path.join(REPO, "spark_rapids_tpu"),
+         os.path.join(REPO, "tools")],
+        REPO, rules=default_rules(include_docs=True))
+    elapsed = time.monotonic() - t0
+    new, stale = Baseline.load(BASELINE).split(findings)
+    assert new == [], "non-baselined findings:\n" + "\n".join(
+        f.render() for f in new)
+    assert stale == [], f"stale baseline entries: {stale}"
+    assert elapsed < 30.0, f"full-repo analysis took {elapsed:.1f}s"
+
+
+def test_scoped_run_knows_repo_vocabulary():
+    """A scoped run (`lint.py tools`) must judge conf reads against the
+    WHOLE repo's declarations — keys declared in config.py are not
+    false positives just because config.py was out of scope."""
+    findings = run_paths([os.path.join(REPO, "tools")], REPO,
+                         rules=default_rules(include_docs=False))
+    assert [f for f in findings if f.rule == "conf-vocabulary"] == []
+
+
+def test_analysis_package_self_clean():
+    """Lint-rule self-application: analysis/ runs clean under its own
+    rules (no pragmas, no baseline)."""
+    findings = run_paths(
+        [os.path.join(REPO, "spark_rapids_tpu", "analysis")],
+        REPO, rules=default_rules(include_docs=False))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# CLI exit-code contract (bench.py-independent)
+# ---------------------------------------------------------------------------
+
+def _cli(args, cwd=REPO):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py")] + args,
+        cwd=cwd, capture_output=True, text=True, env=env, timeout=120)
+
+
+def test_cli_clean_repo_exits_zero():
+    r = _cli(["--fail-on-new"])
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_new_finding_exits_one(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("COUNTERS = {}\n\n\ndef f():\n"
+                   "    COUNTERS['x'] = 1\n")
+    empty = tmp_path / "baseline.json"
+    empty.write_text('{"entries": []}\n')
+    r = _cli(["--fail-on-new", "--no-docs-rule",
+              "--baseline", str(empty), str(bad)])
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "counter-write" in r.stdout
+    # --json output is parseable and names the same finding
+    r2 = _cli(["--json", "--no-docs-rule", "--baseline", str(empty),
+               str(bad)])
+    assert r2.returncode == 1
+    payload = json.loads(r2.stdout)
+    assert payload and payload[0]["rule"] == "counter-write"
+
+
+# ---------------------------------------------------------------------------
+# regression pins for the real findings ISSUE 9 fixed
+# ---------------------------------------------------------------------------
+
+def test_serialize_batch_is_one_logical_sync():
+    """shuffle/serializer.py: the whole-batch fetch counts ONE
+    host_syncs round trip (it used to count one per column leaf)."""
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.shuffle.serializer import serialize_batch
+
+    schema = T.StructType([T.StructField("i", T.INT),
+                           T.StructField("d", T.DOUBLE),
+                           T.StructField("s", T.STRING)])
+    b = ColumnarBatch.from_pydict(
+        {"i": [1, 2, None], "d": [0.5, None, 1.5],
+         "s": ["a", None, "bc"]}, schema)
+    snap = PC.snapshot()
+    serialize_batch(b, codec="none")
+    assert PC.since(snap)["host_syncs"] == 1
+
+
+@pytest.mark.parametrize("which", ["csv", "json"])
+def test_text_fast_path_propagates_cancellation(monkeypatch, tmp_path,
+                                                which):
+    """io/text.py: a PROPAGATE-class failure (tripped CancelToken)
+    escaping the fast parse path must unwind, not silently degrade to
+    the strict loop."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.io import text as TX
+    from spark_rapids_tpu.lifecycle.context import QueryCancelled
+
+    schema = T.StructType([T.StructField("a", T.INT)])
+    if which == "csv":
+        p = tmp_path / "t.csv"
+        p.write_text("1\n2\n")
+        entry, fast = TX._read_csv_spark, "_read_csv_fast"
+    else:
+        p = tmp_path / "t.json"
+        p.write_text('{"a": 1}\n')
+        entry, fast = TX._read_json_spark, "_read_json_fast"
+
+    def boom(*a, **k):
+        raise QueryCancelled("q1: cancelled mid-scan")
+
+    monkeypatch.setattr(TX, fast, boom)
+    with pytest.raises(QueryCancelled):
+        entry(str(p), schema, {})
+
+    # a non-PROPAGATE surprise still degrades to the strict loop
+    def surprise(*a, **k):
+        raise ValueError("fast-path surprise")
+
+    monkeypatch.setattr(TX, fast, surprise)
+    cols, n = entry(str(p), schema, {})
+    assert n >= 1
+
+
+def test_shuffle_manager_counters_survive_concurrency():
+    """shuffle/manager.py: bytes_written/blocks_written increments are
+    locked — N racing writers lose no updates."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+
+    schema = T.StructType([T.StructField("i", T.INT)])
+    mgr = TpuShuffleManager(TpuConf())
+    assert mgr.mode == "MULTITHREADED"
+    n_threads, maps_per_thread, parts = 8, 4, 3
+    batch = ColumnarBatch.from_pydict({"i": list(range(16))}, schema)
+    sids = [mgr.register_shuffle() for _ in range(n_threads)]
+    barrier = threading.Barrier(n_threads)
+    errs = []
+
+    def writer(tid):
+        try:
+            barrier.wait()
+            for m in range(maps_per_thread):
+                mgr.write_map_output(sids[tid], m, [batch] * parts)
+        except Exception as e:          # surfaced via errs
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    try:
+        assert errs == []
+        assert mgr.blocks_written == n_threads * maps_per_thread * parts
+        assert mgr.bytes_written > 0
+    finally:
+        for sid in sids:
+            mgr.unregister_shuffle(sid)
+
+
+def test_bounds_scope_is_thread_local():
+    """ops/segment.py: one query's ambient SegBounds must not leak into
+    a concurrently tracing query's trace (the stack is per-thread)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.ops.segment import (
+        SegBounds,
+        _active_bounds,
+        bounds_scope,
+    )
+
+    seg_ids = jnp.array([0, 0, 1, 2], dtype=jnp.int32)
+    a_in = threading.Event()
+    b_in = threading.Event()
+    results = {}
+
+    def thread_a():
+        ba = SegBounds(seg_ids, 3)
+        with bounds_scope(ba):
+            a_in.set()
+            b_in.wait(5)
+            results["a"] = _active_bounds(3, None) is ba
+
+    def thread_b():
+        a_in.wait(5)
+        bb = SegBounds(seg_ids, 3)
+        with bounds_scope(bb):
+            results["b"] = _active_bounds(3, None) is bb
+            b_in.set()
+
+    ta = threading.Thread(target=thread_a)
+    tb = threading.Thread(target=thread_b)
+    ta.start()
+    tb.start()
+    ta.join(10)
+    tb.join(10)
+    assert results == {"a": True, "b": True}
+    # outside any scope on THIS thread: no ambient bounds
+    assert _active_bounds(3, None) is None
+
+
+def test_arm_conf_spec_races_arm_once():
+    """resilience/faults.py: concurrent collects racing the same NEW
+    testInject spec arm it exactly once."""
+    from spark_rapids_tpu.resilience import faults as F
+
+    F.clear_faults()
+    try:
+        spec = "transient:TpuSortExec:1"
+        n_threads = 8
+        barrier = threading.Barrier(n_threads)
+        armed = []
+
+        def arm():
+            barrier.wait()
+            armed.append(F.arm_conf_spec(spec))
+
+        threads = [threading.Thread(target=arm)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(armed) == 1, armed
+        assert len(F.active_faults()) == 1
+    finally:
+        F.clear_faults()
+
+
+def test_arm_conf_spec_bad_spec_mutates_nothing():
+    """A spec that fails to parse leaves the previous arming fully
+    intact (no partially-armed faults, spec un-claimed), and a
+    corrected retry arms cleanly."""
+    from spark_rapids_tpu.resilience import faults as F
+
+    F.clear_faults()
+    try:
+        assert F.arm_conf_spec("transient:TpuSortExec:1") == 1
+        with pytest.raises(ValueError):
+            F.arm_conf_spec("transient:TpuFilterExec:1;badpart")
+        # previous spec still armed, exactly as before the bad call
+        assert [(op, k) for op, k, _ in F.active_faults()] == [
+            ("TpuSortExec", "transient")]
+        # a corrected spec replaces it atomically
+        assert F.arm_conf_spec("oom:TpuFilterExec:1") == 1
+        assert [(op, k) for op, k, _ in F.active_faults()] == [
+            ("TpuFilterExec", "oom")]
+    finally:
+        F.clear_faults()

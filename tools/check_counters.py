@@ -1,27 +1,13 @@
 #!/usr/bin/env python
-"""Drift check: every perf counter and every diagnostics conf must be
-documented (ISSUE 3 satellite).
+"""Drift check: every perf counter / conf / event must be documented.
 
-Checks, failing the suite (tests/test_diagnostics.py calls
-:func:`check`) and this CLI (exit 1) on drift:
-
-* every canonical ``perfcounters.COUNTERS`` key appears in
-  ``docs/diagnostics.md``;
-* every ``spark.rapids.tpu.diagnostics.*`` conf key is registered in the
-  typed registry AND appears in ``docs/diagnostics.md`` AND in the
-  generated ``docs/configs.md`` (i.e. gen_docs.py was re-run);
-* every event type in ``diagnostics.recorder.EVENT_SCHEMA`` appears in
-  ``docs/diagnostics.md``;
-* every query-lifecycle conf (``spark.rapids.tpu.concurrentQueries``,
-  ``spark.rapids.tpu.admission.*``, ``spark.rapids.tpu.query.*``,
-  ``spark.rapids.tpu.semaphore.*``) appears in ``docs/concurrency.md``
-  and the generated ``docs/configs.md``, and the lifecycle counters are
-  documented in both;
-* every I/O fault-tolerance conf (``spark.sql.files.ignore*``,
-  ``spark.rapids.tpu.files.*``) appears in ``docs/io_resilience.md``
-  and the generated ``docs/configs.md``, the I/O counters
-  (``files_skipped_*``, ``file_decoder_fallbacks``) are documented
-  there, and the ``io_fault`` event type is registered.
+Since ISSUE 9 the actual checks live in the tpulint framework as the
+``doc-drift`` rule (:mod:`spark_rapids_tpu.analysis.rules_docs`), so
+``tools/lint.py`` and the tier-1 lint gate run them too.  This file
+remains as a thin shim: the CLI entrypoint (exit 1 on drift) and the
+``check()`` function (returns problem strings) keep their historical
+contracts — tests/test_diagnostics.py, test_telemetry.py and
+test_profiling.py call ``check()`` directly.
 """
 from __future__ import annotations
 
@@ -33,236 +19,9 @@ sys.path.insert(0, REPO)
 
 
 def check() -> list:
-    from spark_rapids_tpu import perfcounters as PC
-    from spark_rapids_tpu.config import _REGISTRY
-    from spark_rapids_tpu.diagnostics.recorder import EVENT_SCHEMA
+    from spark_rapids_tpu.analysis.rules_docs import doc_drift_problems
 
-    problems = []
-
-    def read(name):
-        path = os.path.join(REPO, "docs", name)
-        try:
-            with open(path) as f:
-                return f.read()
-        except OSError:
-            problems.append(f"missing docs file: docs/{name}")
-            return ""
-
-    diag_md = read("diagnostics.md")
-    configs_md = read("configs.md")
-
-    for key in sorted(PC.COUNTERS):
-        # backtick-delimited: a bare substring test is vacuous for
-        # counter names that are ordinary words ("compiles")
-        if f"`{key}`" not in diag_md:
-            problems.append(
-                f"perf counter '{key}' is not documented (backticked) in "
-                f"docs/diagnostics.md")
-    if hasattr(PC, "ALIASES"):
-        problems.append(
-            "perfcounters.ALIASES still exists — the one-release "
-            "camelCase compat window closed in ISSUE 7")
-
-    diag_confs = [k for k in _REGISTRY
-                  if k.startswith("spark.rapids.tpu.diagnostics.")]
-    if not diag_confs:
-        problems.append("no spark.rapids.tpu.diagnostics.* confs "
-                        "registered")
-    for key in sorted(diag_confs):
-        if key not in diag_md:
-            problems.append(
-                f"conf '{key}' is not documented in docs/diagnostics.md")
-        if f"`{key}`" not in configs_md:
-            problems.append(
-                f"conf '{key}' missing from docs/configs.md — re-run "
-                f"python docs/gen_docs.py")
-
-    for ev in sorted(EVENT_SCHEMA):
-        if f"`{ev}`" not in diag_md:
-            problems.append(
-                f"event type '{ev}' is not documented in "
-                f"docs/diagnostics.md")
-
-    # query lifecycle (ISSUE 4): confs + counters must be documented in
-    # docs/concurrency.md (and confs in the regenerated configs.md)
-    conc_md = read("concurrency.md")
-    life_confs = [k for k in _REGISTRY
-                  if k == "spark.rapids.tpu.concurrentQueries"
-                  or k.startswith(("spark.rapids.tpu.admission.",
-                                   "spark.rapids.tpu.query.",
-                                   "spark.rapids.tpu.semaphore."))]
-    if not life_confs:
-        problems.append("no query-lifecycle confs registered")
-    for key in sorted(life_confs):
-        if f"`{key}`" not in conc_md:
-            problems.append(
-                f"conf '{key}' is not documented in docs/concurrency.md")
-        if f"`{key}`" not in configs_md:
-            problems.append(
-                f"conf '{key}' missing from docs/configs.md — re-run "
-                f"python docs/gen_docs.py")
-    for key in ("queries_admitted", "queries_rejected",
-                "queries_cancelled", "deadline_trips",
-                "admission_wait_ns"):
-        if key not in PC.COUNTERS:
-            problems.append(f"lifecycle counter '{key}' is not "
-                            f"registered in perfcounters.COUNTERS")
-        if f"`{key}`" not in conc_md:
-            problems.append(
-                f"lifecycle counter '{key}' is not documented in "
-                f"docs/concurrency.md")
-
-    # I/O fault domain (ISSUE 5): tolerance confs + counters must be
-    # documented in docs/io_resilience.md (and confs in configs.md)
-    io_md = read("io_resilience.md")
-    io_confs = [k for k in _REGISTRY
-                if k.startswith(("spark.sql.files.ignore",
-                                 "spark.rapids.tpu.files."))]
-    if not io_confs:
-        problems.append("no I/O fault-tolerance confs registered")
-    for key in sorted(io_confs):
-        if f"`{key}`" not in io_md:
-            problems.append(
-                f"conf '{key}' is not documented in "
-                f"docs/io_resilience.md")
-        if f"`{key}`" not in configs_md:
-            problems.append(
-                f"conf '{key}' missing from docs/configs.md — re-run "
-                f"python docs/gen_docs.py")
-    for key in ("files_skipped_corrupt", "files_skipped_missing",
-                "file_decoder_fallbacks"):
-        if key not in PC.COUNTERS:
-            problems.append(f"I/O counter '{key}' is not registered in "
-                            f"perfcounters.COUNTERS")
-        if f"`{key}`" not in io_md:
-            problems.append(
-                f"I/O counter '{key}' is not documented in "
-                f"docs/io_resilience.md")
-    if "io_fault" not in EVENT_SCHEMA:
-        problems.append("diagnostics event type 'io_fault' is not "
-                        "registered in EVENT_SCHEMA")
-
-    # transport-aware scan pipeline (ISSUE 6): confs + counters must be
-    # documented in docs/scan_pipeline.md (and confs in configs.md)
-    scan_md = read("scan_pipeline.md")
-    scan_confs = [k for k in _REGISTRY
-                  if k.startswith(("spark.rapids.tpu.scan.",
-                                   "spark.rapids.sql.format.parquet."
-                                   "transfer."))]
-    if not scan_confs:
-        problems.append("no scan-pipeline confs registered")
-    for key in sorted(scan_confs):
-        if f"`{key}`" not in scan_md:
-            problems.append(
-                f"conf '{key}' is not documented in "
-                f"docs/scan_pipeline.md")
-        if f"`{key}`" not in configs_md:
-            problems.append(
-                f"conf '{key}' missing from docs/configs.md — re-run "
-                f"python docs/gen_docs.py")
-    for key in ("bytes_h2d_logical", "scan_transfer_ns",
-                "pages_device_decompressed", "chunk_decode_fallbacks",
-                "bytes_h2d_overlapped", "prefetch_stall_ns",
-                "hot_cache_hits", "hot_cache_misses",
-                "hot_cache_evictions"):
-        if key not in PC.COUNTERS:
-            problems.append(f"scan counter '{key}' is not registered "
-                            f"in perfcounters.COUNTERS")
-        if f"`{key}`" not in scan_md:
-            problems.append(
-                f"scan counter '{key}' is not documented in "
-                f"docs/scan_pipeline.md")
-    if "scan_prefetch" not in EVENT_SCHEMA:
-        problems.append("diagnostics event type 'scan_prefetch' is not "
-                        "registered in EVENT_SCHEMA")
-
-    # telemetry tier (ISSUE 7): confs + counters + the sampler's gauge
-    # vocabulary must be documented in docs/observability.md (and confs
-    # in the regenerated configs.md)
-    obs_md = read("observability.md")
-    tel_confs = [k for k in _REGISTRY
-                 if k.startswith("spark.rapids.tpu.telemetry.")]
-    if not tel_confs:
-        problems.append("no spark.rapids.tpu.telemetry.* confs "
-                        "registered")
-    for key in sorted(tel_confs):
-        if f"`{key}`" not in obs_md:
-            problems.append(
-                f"conf '{key}' is not documented in "
-                f"docs/observability.md")
-        if f"`{key}`" not in configs_md:
-            problems.append(
-                f"conf '{key}' missing from docs/configs.md — re-run "
-                f"python docs/gen_docs.py")
-    for key in ("slo_violations", "postmortem_dumps"):
-        if key not in PC.COUNTERS:
-            problems.append(f"telemetry counter '{key}' is not "
-                            f"registered in perfcounters.COUNTERS")
-        if f"`{key}`" not in obs_md:
-            problems.append(
-                f"telemetry counter '{key}' is not documented in "
-                f"docs/observability.md")
-    for gauge in ("admission_running", "admission_queued",
-                  "active_queries", "hbm_pool_bytes", "hbm_used_bytes",
-                  "hbm_occupancy", "hot_cache_hit_rate",
-                  "compile_cache_hit_rate", "compile_registry_programs",
-                  "query_latency_p95_ms"):
-        if f"`{gauge}`" not in obs_md:
-            problems.append(
-                f"sampler gauge '{gauge}' is not documented in "
-                f"docs/observability.md")
-
-    # profile-driven cost model (ISSUE 8): confs + counters + the
-    # cost_model event + the advisory/telemetry vocabulary must be
-    # documented in docs/profiling.md (and confs in configs.md)
-    prof_md = read("profiling.md")
-    prof_confs = [k for k in _REGISTRY
-                  if k.startswith("spark.rapids.tpu.profile.")]
-    if not prof_confs:
-        problems.append("no spark.rapids.tpu.profile.* confs registered")
-    for key in sorted(prof_confs):
-        if f"`{key}`" not in prof_md:
-            problems.append(
-                f"conf '{key}' is not documented in docs/profiling.md")
-        if f"`{key}`" not in configs_md:
-            problems.append(
-                f"conf '{key}' missing from docs/configs.md — re-run "
-                f"python docs/gen_docs.py")
-    for key in ("cost_model_hits", "cost_model_misses",
-                "cost_model_predicted_wall_ns",
-                "cost_model_matched_actual_wall_ns",
-                "advisor_plan_fallbacks"):
-        if key not in PC.COUNTERS:
-            problems.append(f"profiling counter '{key}' is not "
-                            f"registered in perfcounters.COUNTERS")
-        if f"`{key}`" not in prof_md:
-            problems.append(
-                f"profiling counter '{key}' is not documented in "
-                f"docs/profiling.md")
-    if "cost_model" not in EVENT_SCHEMA:
-        problems.append("diagnostics event type 'cost_model' is not "
-                        "registered in EVENT_SCHEMA")
-    for field in ("op_class", "fp"):
-        if field not in EVENT_SCHEMA.get("operator", []):
-            problems.append(
-                f"operator event field '{field}' (the calibration "
-                f"identity) is missing from EVENT_SCHEMA")
-    for gauge in ("cost_model_predicted_wall_ms",
-                  "cost_model_matched_actual_wall_ms",
-                  "cost_model_hit_rate", "cost_model_prediction_error"):
-        if f"`{gauge}`" not in prof_md:
-            problems.append(
-                f"profiling telemetry gauge '{gauge}' is not "
-                f"documented in docs/profiling.md")
-    # the advisory file vocabulary the plan-time consult depends on
-    for word in ("`route`", "`device`", "`native`", "`cpu`",
-                 "`fallback-heavy`", "`sync-bound`", "`transport-bound`",
-                 "advisory.json", "calibration.json"):
-        if word not in prof_md:
-            problems.append(
-                f"advisory/store vocabulary {word} is not documented "
-                f"in docs/profiling.md")
-    return problems
+    return doc_drift_problems(REPO)
 
 
 def main() -> int:

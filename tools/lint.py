@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""tpulint CLI — AST invariant linter + lockset race/deadlock detector.
+
+Thin launcher for :mod:`spark_rapids_tpu.analysis.cli`; see
+docs/static_analysis.md for the rule catalogue, the
+``# tpulint: disable=<rule>`` pragma, and the baseline workflow.
+
+    python tools/lint.py                       # whole repo, exit 1 on
+                                               # non-baselined findings
+    python tools/lint.py --json                # machine-readable
+    python tools/lint.py --fail-on-new         # explicit gate form
+    python tools/lint.py --baseline b.json x/  # scoped run
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from spark_rapids_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main(repo_root=REPO))
