@@ -249,6 +249,41 @@ def doc_drift_problems(repo_root: str) -> List[str]:
             problems.append(
                 f"advisory/store vocabulary {word} is not documented "
                 f"in docs/profiling.md")
+
+    # out-of-core exchange + ICI shuffle (ISSUE 10): confs + counters +
+    # the ici_shuffle event must be documented in docs/out_of_core.md
+    # (and confs in the regenerated configs.md)
+    ooc_md = read("out_of_core.md")
+    ooc_confs = [k for k in _REGISTRY
+                 if k.startswith(("spark.rapids.tpu.exchange.",
+                                  "spark.rapids.tpu.ici."))]
+    if not ooc_confs:
+        problems.append("no spark.rapids.tpu.exchange.* / "
+                        "spark.rapids.tpu.ici.* confs registered")
+    for key in sorted(ooc_confs):
+        if f"`{key}`" not in ooc_md:
+            problems.append(
+                f"conf '{key}' is not documented in "
+                f"docs/out_of_core.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+    for key in ("exchange_partitions_planned", "exchange_partition_ns",
+                "exchange_spill_ns", "exchange_host_blocks",
+                "exchange_host_block_bytes", "partitions_coalesced",
+                "ici_epochs", "ici_rows_exchanged", "ici_bytes_moved",
+                "ici_shuffle_ns"):
+        if key not in PC.COUNTERS:
+            problems.append(f"out-of-core counter '{key}' is not "
+                            f"registered in perfcounters.COUNTERS")
+        if f"`{key}`" not in ooc_md:
+            problems.append(
+                f"out-of-core counter '{key}' is not documented in "
+                f"docs/out_of_core.md")
+    if "ici_shuffle" not in EVENT_SCHEMA:
+        problems.append("diagnostics event type 'ici_shuffle' is not "
+                        "registered in EVENT_SCHEMA")
     return problems
 
 

@@ -636,6 +636,82 @@ MESH_EPOCH_BYTES = conf("spark.rapids.tpu.mesh.epochTargetBytes").doc(
     "per-device memory stays bounded by (epoch shard + accumulator/build "
     "state).").integer_conf(1 << 28)
 
+# --- out-of-core partitioned exchange (ISSUE 10) ---------------------------
+
+EXCHANGE_SIZED_PARTITIONS = conf(
+    "spark.rapids.tpu.exchange.sizedPartitions.enabled").doc(
+    "Size-aware exchange partitioning: at plan time, estimate each "
+    "shuffle exchange's input bytes from the AOT shape predictor "
+    "(aot_output_rows/aot_output_caps — refined by the profiling cost "
+    "model's calibrated per-operator output-bytes prediction when a "
+    "store exists) and GROW the partition count so one partition's "
+    "working set fits exchange.targetPartitionFraction of the HBM pool. "
+    "Only ever raises the planned count (datasets far larger than HBM "
+    "stream partition-by-partition instead of materializing whole); "
+    "small inputs keep their planned counts.  Sized exchanges are "
+    "exempt from the single-device partition collapse."
+).boolean_conf(True)
+
+EXCHANGE_TARGET_PARTITION_FRACTION = conf(
+    "spark.rapids.tpu.exchange.targetPartitionFraction").doc(
+    "Fraction of the HBM pool one exchange partition's working set "
+    "should fit when sizedPartitions chooses a partition count "
+    "(partitions = ceil(estimated bytes / (pool * fraction)))."
+).double_conf(0.125)
+
+EXCHANGE_MAX_PARTITIONS = conf(
+    "spark.rapids.tpu.exchange.maxPartitions").doc(
+    "Upper bound on the partition count sizedPartitions may choose "
+    "(each partition costs a read-side program launch; on a "
+    "compile-tunnel platform launches are hundreds of ms)."
+).integer_conf(256)
+
+EXCHANGE_SPILL_ENABLED = conf(
+    "spark.rapids.tpu.exchange.spill.enabled").doc(
+    "Stream shuffle exchange partitions through spill-backed partition "
+    "queues (shuffle/partition_queues.py): map-side slices register "
+    "with the SpillFramework up to exchange.deviceResidentBytes, and "
+    "slices beyond the budget cross the host boundary as CRC-framed "
+    "serializer blocks — device residency stays bounded instead of "
+    "materializing the whole exchange input.  false: the legacy "
+    "shuffle-manager path (serialize every slice host-side)."
+).boolean_conf(True)
+
+EXCHANGE_DEVICE_RESIDENT_BYTES = conf(
+    "spark.rapids.tpu.exchange.deviceResidentBytes").doc(
+    "Device bytes the spill-backed exchange queues may keep resident "
+    "as SpillFramework handles before further slices serialize to "
+    "CRC-framed host blocks.  0 (default) derives the budget from the "
+    "pool: pool_bytes * exchange.targetPartitionFraction * 2."
+).bytes_conf(0)
+
+EXCHANGE_COALESCE_SMALL_BYTES = conf(
+    "spark.rapids.tpu.exchange.coalesceSmallPartitionBytes").doc(
+    "AQE shuffle-read coalescing threshold (SURVEY §2.4): adjacent "
+    "reduce partitions below this byte size merge into one read window "
+    "in TpuAdaptiveShuffleReaderExec (counted by partitions_coalesced); "
+    "partitions at or above it emit alone.  The batch-size goal still "
+    "caps each window.").bytes_conf(4 << 20)
+
+# --- ICI multi-chip shuffle (ISSUE 10) -------------------------------------
+
+ICI_HOST_BOUNDARY_CODEC = conf(
+    "spark.rapids.tpu.ici.hostBoundaryCodec").doc(
+    "Codec for CRC-framed blocks crossing the ICI/exchange host "
+    "boundary (spill-backed partition queues, ici_host_frame).  Unset "
+    "defers to spark.rapids.shuffle.compression.codec."
+).string_conf(None)
+
+ICI_CROSS_SLICE_HOSTS = conf(
+    "spark.rapids.tpu.ici.crossSliceHosts").doc(
+    "When > 0, the generic mesh repartition routes through a two-level "
+    "(host x ici) mesh (parallel/crossslice.py): phase 1 moves rows to "
+    "their destination's local device index over intra-slice ICI, "
+    "phase 2 delivers each row across the host (DCN-analog) axis "
+    "exactly once.  The device count must be divisible by this host "
+    "count.  0 (default): the flat single-axis all-to-all."
+).integer_conf(0)
+
 SHUFFLE_MT_WRITER_THREADS = conf(
     "spark.rapids.shuffle.multiThreaded.writer.threads").integer_conf(20)
 SHUFFLE_MT_READER_THREADS = conf(

@@ -50,6 +50,7 @@ EVENT_SCHEMA: Dict[str, List[str]] = {
     "lifecycle": ["kind", "detail", "dur_ns"],
     "io_fault": ["kind", "path", "fmt", "detail"],
     "scan_prefetch": ["depth", "batches", "overlapped_bytes", "stall_ns"],
+    "ici_shuffle": ["stage", "n_dev", "rows", "bytes", "dur_ns"],
     "op_batch": ["path", "batch", "rows", "dur_ns"],
     "operator": ["path", "name", "describe", "op_class", "fp", "wall_ns",
                  "self_wall_ns", "batches", "rows", "counters", "metrics",
@@ -370,6 +371,14 @@ class QueryDiagnostics:
                     batches=int(batches),
                     overlapped_bytes=int(overlapped_bytes),
                     stall_ns=int(stall_ns))
+
+    def ici_shuffle(self, stage: str, n_dev: int, rows: int,
+                    bytes_: int, dur_ns: int) -> None:
+        """One ICI collective-exchange epoch (ISSUE 10): which mesh
+        stage ran it, how many devices participated, and the rows/bytes
+        exchanged device-to-device (zero host traffic on this path)."""
+        self._event(MODERATE, "ici_shuffle", stage=stage, n_dev=int(n_dev),
+                    rows=int(rows), bytes=int(bytes_), dur_ns=int(dur_ns))
 
     # -- finalization --------------------------------------------------
     def finish(self, root=None, status: str = "ok") -> None:

@@ -1,0 +1,210 @@
+"""Size-aware exchange partitioning (ISSUE 10) — the planner half of
+out-of-core execution.
+
+Reference analog: AQE's coalesce/split of shuffle partitions from map
+output statistics (SURVEY §2.4) — except here the FIRST estimate is
+plan-static, before a single batch runs: the AOT shape predictor
+(``aot_output_rows`` / ``aot_output_caps``, compilecache/aot.py) already
+walks row counts and capacities through the plan, and the PR 8
+calibration store carries a measured ``rows`` EWMA per (operator,
+expression-fingerprint, shape-bucket) that refines the static guess when
+profile data exists.
+
+The rule: one exchange partition's working set should fit
+``spark.rapids.tpu.exchange.targetPartitionFraction`` of the HBM pool, so
+
+    partitions = clamp(ceil(estimated_bytes / (pool * fraction)),
+                       planned, exchange.maxPartitions)
+
+Only ever GROWS the planned count — a dataset far larger than HBM then
+streams through the spill-backed exchange partition-by-partition with
+each partition's reduce side fitting comfortably on device, while small
+inputs keep their planned (often already coalesced) counts.  Sized
+exchanges are marked ``_ooc_sized`` so the single-device partition
+collapse leaves them alone: with one chip the partitions ARE the
+out-of-core schedule, not parallelism.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from spark_rapids_tpu import perfcounters as PC
+from spark_rapids_tpu import types as T
+
+
+def row_width_bytes(schema: T.StructType) -> int:
+    """Approximate device bytes one row of this schema occupies:
+    storage dtype + 1 validity byte per field; variable-width fields
+    (strings/arrays) count their smallest width bucket as a floor — an
+    underestimate only makes partitions somewhat larger than the
+    target, never incorrect."""
+    import numpy as np
+
+    total = 0
+    for f in schema.fields:
+        dt = f.dataType
+        total += 1  # validity
+        if isinstance(dt, T.StringType):
+            total += 8 + 4          # min chars bucket + lengths(int32)
+        elif isinstance(dt, T.ArrayType):
+            try:
+                total += 8 * np.dtype(
+                    T.storage_dtype(dt.elementType)).itemsize + 4
+            except TypeError:
+                total += 68
+        elif isinstance(dt, (T.MapType, T.StructType)):
+            total += 16             # children estimated flat elsewhere
+        elif isinstance(dt, T.DecimalType) and dt.is_128:
+            total += 16
+        else:
+            try:
+                total += np.dtype(T.storage_dtype(dt)).itemsize
+            except TypeError:
+                total += 8
+    return max(total, 1)
+
+
+def _static_rows(child) -> Optional[int]:
+    """Plan-static row estimate: exact when ``aot_output_rows`` is
+    derivable (scans and the narrow operators above them)."""
+    from spark_rapids_tpu.lifecycle import QueryCancelled
+
+    try:
+        fn = getattr(child, "aot_output_rows", None)
+        rows = fn() if fn is not None else None
+        if rows:
+            return int(sum(rows))
+    except QueryCancelled:
+        raise
+    except Exception:
+        pass
+    return None
+
+
+def _static_caps(child) -> Optional[int]:
+    """Capacity upper bound (aggregates propagate CAPACITY even when
+    group counts are data-dependent)."""
+    from spark_rapids_tpu.lifecycle import QueryCancelled
+
+    try:
+        fn = getattr(child, "aot_output_caps", None)
+        caps = fn() if fn is not None else None
+        if caps:
+            return int(sum(caps))
+    except QueryCancelled:
+        raise
+    except Exception:
+        pass
+    return None
+
+
+def _calibrated_rows(child, conf) -> Optional[int]:
+    """PR 8 refinement: the calibration store's measured ``rows`` EWMA
+    for this operator's (class, expr-fp, bucket) identity, when a store
+    exists.  Swallows every failure except cancellation — profiling
+    must never fail a plan."""
+    from spark_rapids_tpu.lifecycle import QueryCancelled
+
+    try:
+        from spark_rapids_tpu.config import PROFILE_DIR, PROFILE_EWMA_ALPHA
+
+        prof_dir = conf.get(PROFILE_DIR)
+        if not prof_dir:
+            return None
+        from spark_rapids_tpu.profiling.store import CalibrationStore
+        from spark_rapids_tpu.resilience.domain import _breaker_key_of
+
+        key = _breaker_key_of(child)
+        if key is None:
+            return None
+        op_class, fp = key
+        store = CalibrationStore.load_cached(
+            prof_dir, alpha=float(conf.get(PROFILE_EWMA_ALPHA)))
+        from spark_rapids_tpu.profiling.model import _planned_bucket
+
+        ent, _kind = store.match(op_class, fp, _planned_bucket(child))
+        if ent is None:
+            return None
+        rows = float((ent.get("ewma") or {}).get("rows", 0.0))
+        return int(rows) if rows > 0 else None
+    except QueryCancelled:
+        raise
+    except Exception:
+        return None
+
+
+def estimate_input_bytes(child, conf) -> Optional[int]:
+    """Estimated bytes the exchange will move: exact static rows win
+    (scan-derived counts are the truth), then the calibrated rows EWMA,
+    then the capacity upper bound; None when nothing is derivable."""
+    rows = _static_rows(child)
+    if rows is None:
+        rows = _calibrated_rows(child, conf)
+    if rows is None:
+        rows = _static_caps(child)
+    if rows is None:
+        return None
+    return rows * row_width_bytes(child.output)
+
+
+def target_partition_bytes(conf) -> int:
+    """The per-partition working-set budget: pool * fraction."""
+    from spark_rapids_tpu.config import EXCHANGE_TARGET_PARTITION_FRACTION
+    from spark_rapids_tpu.memory.device_manager import get_device_manager
+
+    pool = get_device_manager().pool_bytes
+    frac = conf.get(EXCHANGE_TARGET_PARTITION_FRACTION)
+    return max(int(pool * frac), 1 << 16)
+
+
+def choose_partition_count(exchange, conf) -> Optional[int]:
+    """The sized partition count for one exchange, or None when the
+    planned count should stand (no estimate, or the estimate already
+    fits).  Never shrinks the planned count."""
+    from spark_rapids_tpu.config import EXCHANGE_MAX_PARTITIONS
+
+    est = estimate_input_bytes(exchange.children[0], conf)
+    if est is None:
+        return None
+    target = target_partition_bytes(conf)
+    want = max(int(math.ceil(est / float(target))), 1)
+    want = min(want, conf.get(EXCHANGE_MAX_PARTITIONS))
+    cur = exchange.num_partitions
+    if want <= cur:
+        return None
+    exchange._ooc_est_bytes = est
+    return want
+
+
+def size_exchange_partitions(node, conf):
+    """Plan rewrite (TpuTransitionOverrides): grow hash/round-robin
+    exchange partition counts so per-partition working sets fit the
+    pool-fraction target.  Returns the (mutated-in-place) node."""
+    from spark_rapids_tpu.config import EXCHANGE_SIZED_PARTITIONS
+    from spark_rapids_tpu.exec.base import TpuExec
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.plan.nodes import (
+        HashPartitioning,
+        RoundRobinPartitioning,
+    )
+
+    if not conf.get(EXCHANGE_SIZED_PARTITIONS):
+        return node
+    node.children = [size_exchange_partitions(c, conf)
+                     if isinstance(c, TpuExec) else c
+                     for c in node.children]
+    if not (isinstance(node, TpuShuffleExchangeExec)
+            and isinstance(node.partitioning,
+                           (HashPartitioning, RoundRobinPartitioning))):
+        return node
+    want = choose_partition_count(node, conf)
+    if want is None:
+        return node
+    prev = node.num_partitions
+    node.partitioning.num_partitions = want
+    node._ooc_sized = True
+    node.sized_decision = (f"sized {prev}->{want} partitions "
+                           f"(est {node._ooc_est_bytes}B)")
+    PC.bump("exchange_partitions_planned")
+    return node

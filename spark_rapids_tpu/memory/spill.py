@@ -171,7 +171,9 @@ class SpillableColumnarBatch:
         self._batch = ColumnarBatch(cols, self.num_rows, self.schema)
         self._host = None
         self.state = STATE_DEVICE
-        self._framework._device_used += self.device_bytes
+        fw = self._framework
+        fw._device_used += self.device_bytes
+        fw._device_used_peak = max(fw._device_used_peak, fw._device_used)
 
     def host_bytes(self) -> int:
         if self._host is None:
@@ -245,6 +247,7 @@ class SpillFramework:
         self._lock = threading.RLock()
         self._handles: List[SpillableColumnarBatch] = []
         self._device_used = 0
+        self._device_used_peak = 0
         self._tick = 0
         # metrics (GpuTaskMetrics analog)
         self.spill_to_host_count = 0
@@ -254,10 +257,18 @@ class SpillFramework:
 
     # -- registration ----------------------------------------------------
     def _register(self, h: SpillableColumnarBatch) -> None:
+        # make room BEFORE admitting the new batch (ISSUE 10): residency
+        # then never exceeds the pool bound while the budget is meetable
+        # (a single batch larger than the whole pool still admits — the
+        # caller's retry block owns that case), which is what the
+        # out-of-core pins assert via device_used_peak
+        self.ensure_room(h.device_bytes, exclude=h)
         with self._lock:
             self._touch_locked(h)
             self._handles.append(h)
             self._device_used += h.device_bytes
+            self._device_used_peak = max(self._device_used_peak,
+                                         self._device_used)
             if self.debug:
                 # handle-leak tracking (the cuDF refcount-debug analog,
                 # SURVEY.md §5.2): remember where each live handle came
@@ -265,8 +276,6 @@ class SpillFramework:
                 import traceback
 
                 h._alloc_stack = "".join(traceback.format_stack(limit=8))
-        # over-budget after admitting the new batch: shed others
-        self.ensure_room(0, exclude=h)
 
     def leak_report(self, include_persistent: bool = False) -> List[str]:
         """Live (unclosed) handles with their allocation sites.
@@ -335,6 +344,14 @@ class SpillFramework:
     def device_used(self) -> int:
         return self._device_used
 
+    @property
+    def device_used_peak(self) -> int:
+        """High-water mark of tracked device residency — the number the
+        out-of-core pins compare against pool_bytes (register makes room
+        BEFORE admitting, so the peak only exceeds the pool when a
+        single batch is larger than the whole pool)."""
+        return self._device_used_peak
+
     def ensure_room(self, nbytes: int,
                     exclude: Optional[SpillableColumnarBatch] = None) -> bool:
         """Spill LRU device handles until `nbytes` more fit in the pool.
@@ -400,6 +417,7 @@ class SpillFramework:
             "spillToHostBytes": self.spill_to_host_bytes,
             "spillToDiskBytes": self.spill_to_disk_bytes,
             "deviceUsedBytes": self._device_used,
+            "deviceUsedPeakBytes": self._device_used_peak,
         }
 
 

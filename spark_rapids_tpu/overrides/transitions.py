@@ -165,7 +165,16 @@ def _record(name: str, installed: bool, reason=None) -> None:
 class TpuTransitionOverrides:
     @staticmethod
     def apply(root: TpuExec, conf: TpuConf) -> TpuExec:
+        from spark_rapids_tpu.exec.partition_sizing import (
+            size_exchange_partitions,
+        )
+
         _stage_log_reset()
+        # size-aware partition counts FIRST (ISSUE 10): exchanges whose
+        # estimated input exceeds the per-partition pool budget grow
+        # their counts and become exempt from the single-device collapse
+        # (out-of-core schedule, not parallelism)
+        root = size_exchange_partitions(root, conf)
         root = TpuTransitionOverrides._coalesce_single_device_shuffle(
             root, conf)
         root = TpuTransitionOverrides._insert_coalesce(root, conf)
@@ -522,12 +531,14 @@ class TpuTransitionOverrides:
         if reason is not None:
             _record("TpuIciRepartitionExec", False, reason)
             return node
+        from spark_rapids_tpu.config import ICI_CROSS_SLICE_HOSTS
         from spark_rapids_tpu.parallel.mesh import make_mesh
 
         _record("TpuIciRepartitionExec", True)
         return TpuIciRepartitionExec(
             node, make_mesh(conf.get(MESH_DEVICES) or None),
-            epoch_bytes=conf.get(MESH_EPOCH_BYTES))
+            epoch_bytes=conf.get(MESH_EPOCH_BYTES),
+            cross_hosts=conf.get(ICI_CROSS_SLICE_HOSTS))
 
     @staticmethod
     def _coalesce_single_device_shuffle(node: TpuExec,
@@ -554,13 +565,19 @@ class TpuTransitionOverrides:
             return node
         if isinstance(node, TpuShuffleExchangeExec) and isinstance(
                 node.partitioning,
-                (HashPartitioning, RoundRobinPartitioning)):
+                (HashPartitioning, RoundRobinPartitioning)) \
+                and not getattr(node, "_ooc_sized", False):
+            # sized exchanges keep their partitions: on one chip they
+            # are the out-of-core schedule, not elidable parallelism
             node.partitioning = SinglePartitioning()
         return node
 
     @staticmethod
     def _insert_coalesce(node: TpuExec, conf: TpuConf) -> TpuExec:
-        from spark_rapids_tpu.config import ADAPTIVE_ENABLED
+        from spark_rapids_tpu.config import (
+            ADAPTIVE_ENABLED,
+            EXCHANGE_COALESCE_SMALL_BYTES,
+        )
         from spark_rapids_tpu.exec.exchange import (
             TpuAdaptiveShuffleReaderExec,
         )
@@ -578,7 +595,9 @@ class TpuTransitionOverrides:
                     # (GpuCustomShuffleReaderExec analog)
                     _record("TpuAdaptiveShuffleReaderExec", True)
                     new_children.append(TpuAdaptiveShuffleReaderExec(
-                        c, conf.get(BATCH_SIZE_BYTES)))
+                        c, conf.get(BATCH_SIZE_BYTES),
+                        small_bytes=conf.get(
+                            EXCHANGE_COALESCE_SMALL_BYTES)))
                 else:
                     _record("TpuAdaptiveShuffleReaderExec", False,
                             f"{ADAPTIVE_ENABLED.key} is false")

@@ -1,0 +1,44 @@
+"""shard_map version compatibility (ISSUE 10).
+
+The mesh/ICI layers were written against the jax>=0.8 surface
+(``jax.shard_map`` with the ``check_vma`` kwarg).  Older jax ships the
+same primitive at ``jax.experimental.shard_map.shard_map`` with the
+kwarg spelled ``check_rep`` — on such builds every mesh stage died at
+trace time with ``unexpected keyword argument 'check_vma'``, which is
+exactly what held the whole MULTICHIP suite red.  This shim resolves
+the import once and translates the kwarg, so call sites keep the
+modern spelling.
+"""
+from __future__ import annotations
+
+import inspect
+
+try:  # jax>=0.8
+    from jax import shard_map as _shard_map  # type: ignore
+except ImportError:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:
+    _PARAMS = set(inspect.signature(_shard_map).parameters)
+except (TypeError, ValueError):  # pragma: no cover - exotic wrappers
+    _PARAMS = {"check_vma"}
+
+if "check_vma" in _PARAMS:
+    _CHECK_KW = "check_vma"
+elif "check_rep" in _PARAMS:
+    _CHECK_KW = "check_rep"
+else:  # pragma: no cover - future jax dropped the knob entirely
+    _CHECK_KW = None
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=None,
+              **kwargs):
+    """Drop-in ``shard_map`` accepting the modern ``check_vma`` name on
+    every jax this repo runs against."""
+    if check_vma is not None and _CHECK_KW is not None:
+        kwargs[_CHECK_KW] = check_vma
+    if f is None:  # decorator usage
+        return lambda fn: _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                     out_specs=out_specs, **kwargs)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)

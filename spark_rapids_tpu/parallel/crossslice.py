@@ -40,10 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax>=0.8
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from spark_rapids_tpu.parallel.compat import shard_map
 
 
 def make_mesh2(n_host: int, n_ici: int,
@@ -58,6 +55,41 @@ def make_mesh2(n_host: int, n_ici: int,
             f"have {len(devs)}")
     return Mesh(np.array(devs[:need]).reshape(n_host, n_ici),
                 ("host", "ici"))
+
+
+def cross_slice_all_to_all_columns(cols, row_valid, pid,
+                                   n_host: int, n_ici: int,
+                                   host_axis: str = "host",
+                                   ici_axis: str = "ici"):
+    """Whole-batch hierarchical routing (ISSUE 10): generalizes
+    :func:`cross_slice_repartition`'s (keys, values) pair to ANY list of
+    ``DeviceColumn`` (flat / string / array layouts — everything
+    ``ici_all_to_all_columns`` carries).  Row i moves to global
+    partition ``pid[i] in [0, n_host*n_ici)``, living on device
+    ``(pid // n_ici, pid %% n_ici)``:
+
+      phase 1 (ICI):  all-to-all over the inner axis to the
+                      destination's LOCAL device index, the destination
+                      host id riding along as one extra int32 column;
+      phase 2 (DCN):  all-to-all over the host axis delivers each row
+                      to its destination slice — each row crosses the
+                      slice-to-slice fabric exactly once.
+
+    Returns (received columns, received-row mask).  Must run inside a
+    shard_map over a 2-level (host x ici) mesh."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    from spark_rapids_tpu.parallel.mesh import ici_all_to_all_columns
+
+    tgt_dev = (pid % n_ici).astype(jnp.int32)
+    tgt_host = (pid // n_ici).astype(jnp.int32)
+    carry = DeviceColumn(T.INT, row_valid, data=tgt_host)
+    r1, ok1 = ici_all_to_all_columns(list(cols) + [carry], row_valid,
+                                     tgt_dev, n_ici, ici_axis)
+    r2, ok2 = ici_all_to_all_columns(
+        list(r1[:-1]), ok1, r1[-1].data.astype(jnp.int32), n_host,
+        host_axis)
+    return r2, ok2
 
 
 def cross_slice_repartition(mesh: Mesh):

@@ -34,10 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax>=0.8
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from spark_rapids_tpu.parallel.compat import shard_map
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:

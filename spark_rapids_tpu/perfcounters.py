@@ -105,6 +105,24 @@ COUNTERS: Dict[str, int] = {
     "cost_model_predicted_wall_ns": 0,
     "cost_model_matched_actual_wall_ns": 0,
     "advisor_plan_fallbacks": 0,
+    # out-of-core partitioned exchange (ISSUE 10): plan-time partition
+    # sizing, wall inside partition-id/slice programs vs wall inside the
+    # spill-backed queue (serialize/track/materialize), host-boundary
+    # CRC blocks the queues produced, and AQE shuffle-read coalescing
+    "exchange_partitions_planned": 0,
+    "exchange_partition_ns": 0,
+    "exchange_spill_ns": 0,
+    "exchange_host_blocks": 0,
+    "exchange_host_block_bytes": 0,
+    "partitions_coalesced": 0,
+    # ICI multi-chip shuffle (ISSUE 10): per-query collective-exchange
+    # accounting — epochs through the mesh all-to-all stages, rows/bytes
+    # exchanged device-to-device (never through the host), and the wall
+    # inside the collective programs
+    "ici_epochs": 0,
+    "ici_rows_exchanged": 0,
+    "ici_bytes_moved": 0,
+    "ici_shuffle_ns": 0,
 }
 
 

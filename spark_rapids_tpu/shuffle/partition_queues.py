@@ -1,0 +1,206 @@
+"""Spill-backed exchange partition queues (ISSUE 10).
+
+Reference analog: RapidsShuffleInternalManagerBase's block store plus
+SpillableColumnarBatch (SURVEY.md §2.3/§2.7) — but organized the way the
+out-of-core exchange consumes them: one queue per reduce partition,
+appended map-side slice by slice, drained partition by partition.
+
+Residency discipline: slices up to a conf'd device budget stay resident
+as :class:`SpillFramework` handles (the pool's LRU sheds them down-tier
+under pressure, so device residency is bounded by the HBM pool no matter
+how large the exchange input is); slices beyond the budget cross the
+host boundary immediately as CRC-framed serializer blocks
+(``shuffle/serializer.py`` — a flipped bit anywhere surfaces as a
+deterministic :class:`ShuffleCorruption` instead of silent wrong rows).
+Every append/read observes the current query's CancelToken, so a tripped
+deadline unwinds a wide exchange instead of finishing it.
+
+Wall inside the queue (serialize / track / materialize) lands in the
+``exchange_spill_ns`` counter; host-boundary blocks count into
+``exchange_host_blocks`` / ``exchange_host_block_bytes`` — bench.py
+decomposes exchange walls from these.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu import perfcounters as PC
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+
+
+class SpillBackedPartitionQueues:
+    """Per-partition queues of exchange output slices with bounded
+    device residency (the spill-backed exchange's block store)."""
+
+    def __init__(self, n_parts: int, schema: T.StructType,
+                 device_budget: int, codec: Optional[str] = None):
+        from spark_rapids_tpu.memory.spill import get_spill_framework
+
+        self.n_parts = n_parts
+        self.schema = schema
+        self.device_budget = max(int(device_budget), 0)
+        self.codec = codec
+        self._fw = get_spill_framework()
+        # per-partition entries: ("dev", handle) | ("host", crc_blob)
+        self._queues: Dict[int, List[Tuple[str, object]]] = {
+            p: [] for p in range(n_parts)}
+        self._device_bytes = 0
+        self.host_blocks = 0
+        self.host_block_bytes = 0
+
+    @property
+    def device_bytes(self) -> int:
+        """Device bytes currently queued as resident handles (the
+        queue's own budget accounting; the SpillFramework pool bound is
+        the second, global, limit)."""
+        return self._device_bytes
+
+    def append(self, pid: int, batch: ColumnarBatch) -> None:
+        """Queue one map-side slice for reduce partition ``pid``."""
+        from spark_rapids_tpu.lifecycle.context import check_cancel
+
+        check_cancel()
+        if batch is None or batch.num_rows == 0:
+            return
+        t0 = time.perf_counter_ns()
+        nb = batch.nbytes()
+        if self._device_bytes + nb <= self.device_budget:
+            handle = self._fw.track(batch)
+            self._queues[pid].append(("dev", handle))
+            self._device_bytes += nb
+        else:
+            # host boundary: CRC-framed serializer block (ShuffleCorruption
+            # on bit rot — never silent wrong rows); ONE framing site for
+            # the ICI/exchange host boundary (exec/ici.ici_host_frame)
+            from spark_rapids_tpu.exec.ici import ici_host_frame
+
+            blob = ici_host_frame(batch, codec=self.codec)
+            self._queues[pid].append(("host", blob))
+            self.host_blocks += 1
+            self.host_block_bytes += len(blob)
+            PC.bump("exchange_host_blocks")
+            PC.bump("exchange_host_block_bytes", len(blob))
+        PC.bump("exchange_spill_ns", time.perf_counter_ns() - t0)
+
+    def read(self, pid: int) -> Optional[ColumnarBatch]:
+        """Drain reduce partition ``pid`` into one device batch (the
+        chunked ``read_chunks`` is the exchange's streaming path; this
+        concat form serves callers that want the whole partition)."""
+        chunks = list(self.read_chunks(pid))
+        if not chunks:
+            return None
+        return (chunks[0] if len(chunks) == 1
+                else ColumnarBatch.concat(chunks))
+
+    def read_chunks(self, pid: int, target_bytes: int = 0):
+        """Drain reduce partition ``pid`` as a stream of device batches,
+        each ~``target_bytes`` (0: one chunk per queued entry group of
+        unbounded size — callers pass the session batch-size goal).  The
+        out-of-core invariant lives here: one CHUNK at a time pins /
+        materializes / releases, so the drain's device working set is
+        one chunk — never the whole partition (a partition far larger
+        than the pool would otherwise re-materialize whole and bust the
+        residency bound as a single unspillable batch)."""
+        from spark_rapids_tpu.lifecycle.context import check_cancel
+        from spark_rapids_tpu.shuffle.serializer import deserialize_concat
+
+        check_cancel()
+        entries = self._queues.get(pid) or []
+        if not entries:
+            return
+        self._queues[pid] = []
+        group: List[Tuple[str, object]] = []
+        group_bytes = 0
+
+        def _entry_bytes(kind, x):
+            return x.device_bytes if kind == "dev" else len(x)
+
+        def _drain_group():
+            t0 = time.perf_counter_ns()
+            handles = [h for kind, h in group if kind == "dev"]
+            try:
+                for h in handles:
+                    h.pin()
+                parts: List[ColumnarBatch] = []
+                host_blobs = []
+                for kind, x in group:
+                    if kind == "dev":
+                        parts.append(x.get_batch())
+                    else:
+                        host_blobs.append(x)
+                if host_blobs:
+                    # CRC-verified host-boundary decode
+                    # (ShuffleCorruption on mismatch), concat-friendly
+                    # across the group's blobs at once
+                    parts.append(deserialize_concat(
+                        host_blobs, self.schema, codec=self.codec))
+                out = (parts[0] if len(parts) == 1
+                       else ColumnarBatch.concat(parts))
+            finally:
+                for h in handles:
+                    h.unpin()
+            for h in handles:
+                self._device_bytes -= h.device_bytes
+                h.close()
+            PC.bump("exchange_spill_ns", time.perf_counter_ns() - t0)
+            return out
+
+        for kind, x in entries:
+            nb = _entry_bytes(kind, x)
+            if group and target_bytes and group_bytes + nb > target_bytes:
+                yield _drain_group()
+                check_cancel()
+                group, group_bytes = [], 0
+            group.append((kind, x))
+            group_bytes += nb
+        if group:
+            yield _drain_group()
+
+    def close(self) -> None:
+        """Release every remaining entry (the error-unwind path; a clean
+        drain already released everything in read())."""
+        from spark_rapids_tpu.lifecycle import QueryCancelled
+
+        for pid, entries in self._queues.items():
+            for kind, x in entries:
+                if kind == "dev":
+                    try:
+                        x.close()
+                    except QueryCancelled:
+                        raise
+                    except Exception:
+                        pass
+            self._queues[pid] = []
+        self._device_bytes = 0
+
+
+def queue_device_budget(conf) -> int:
+    """Resolve the queues' device budget: the conf when set, else a
+    pool-derived default (2x one target partition's working set, so the
+    next partition's slices can stage while the current one computes)."""
+    from spark_rapids_tpu.config import (
+        EXCHANGE_DEVICE_RESIDENT_BYTES,
+        EXCHANGE_TARGET_PARTITION_FRACTION,
+    )
+    from spark_rapids_tpu.memory.device_manager import get_device_manager
+
+    fixed = conf.get(EXCHANGE_DEVICE_RESIDENT_BYTES)
+    if fixed:
+        return int(fixed)
+    pool = get_device_manager().pool_bytes
+    frac = conf.get(EXCHANGE_TARGET_PARTITION_FRACTION)
+    return max(int(pool * frac * 2), 1 << 20)
+
+
+def host_boundary_codec(conf) -> Optional[str]:
+    """Codec for the CRC-framed host-boundary blocks: the ici override
+    when set, else the shuffle codec."""
+    from spark_rapids_tpu.config import (
+        ICI_HOST_BOUNDARY_CODEC,
+        SHUFFLE_COMPRESSION_CODEC,
+    )
+
+    return conf.get(ICI_HOST_BOUNDARY_CODEC) \
+        or conf.get(SHUFFLE_COMPRESSION_CODEC)

@@ -10,6 +10,7 @@ needs_mesh = pytest.mark.skipif(
 
 
 @needs_mesh
+@pytest.mark.slow  # compiles 2-level SPMD programs — minutes on CPU XLA
 @pytest.mark.parametrize("shape", [(2, 4), (4, 2)])
 def test_cross_slice_repartition_matches_reference(shape):
     from spark_rapids_tpu.parallel.crossslice import dryrun_cross_slice

@@ -76,6 +76,7 @@ GOLDEN_SCHEMA = {
     "lifecycle": ["kind", "detail", "dur_ns"],
     "io_fault": ["kind", "path", "fmt", "detail"],
     "scan_prefetch": ["depth", "batches", "overlapped_bytes", "stall_ns"],
+    "ici_shuffle": ["stage", "n_dev", "rows", "bytes", "dur_ns"],
     "op_batch": ["path", "batch", "rows", "dur_ns"],
     "operator": ["path", "name", "describe", "op_class", "fp", "wall_ns",
                  "self_wall_ns", "batches", "rows", "counters", "metrics",

@@ -9,8 +9,18 @@ import pytest
 needs_mesh = pytest.mark.skipif(
     len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
 
+# Mesh-COLLECTIVE tests compile multi-device SPMD programs — minutes of
+# XLA CPU compile apiece, ~27min for the suite — which the tier-1
+# 'not slow' budget cannot absorb now that they PASS (at seed the whole
+# suite failed fast on the jax shard_map kwarg drift parallel/compat.py
+# shims away).  Plan/install-level tests stay in tier-1; the collectives
+# run green via `pytest tests/test_multichip.py` (ISSUE 10 run) and the
+# driver's MULTICHIP_* artifact (__graft_entry__.dryrun_multichip).
+mesh_collective = pytest.mark.slow
+
 
 @needs_mesh
+@mesh_collective
 def test_distributed_global_agg_matches_local():
     from spark_rapids_tpu.parallel.mesh import distributed_agg_step, make_mesh
 
@@ -33,6 +43,7 @@ def test_distributed_global_agg_matches_local():
 
 
 @needs_mesh
+@mesh_collective
 def test_ici_shuffle_agg_matches_local():
     from spark_rapids_tpu.parallel.mesh import (
         distributed_shuffle_agg_step,
@@ -61,6 +72,7 @@ def test_ici_shuffle_agg_matches_local():
 
 
 @needs_mesh
+@mesh_collective
 def test_broadcast_build_side():
     from spark_rapids_tpu.parallel.mesh import broadcast_build_side, make_mesh
 
@@ -74,6 +86,7 @@ def test_broadcast_build_side():
 
 
 @needs_mesh
+@mesh_collective
 def test_dryrun_entrypoints():
     import __graft_entry__ as g
 
@@ -83,6 +96,7 @@ def test_dryrun_entrypoints():
     g.dryrun_multichip(8)
 
 
+@mesh_collective
 def test_dryrun_standalone_like_driver():
     """Run `python __graft_entry__.py` in a fresh interpreter with NONE of
     conftest's platform forcing — exactly how the driver invokes it.  Round 1
@@ -110,6 +124,7 @@ _ICI_CONF = {
 
 
 @needs_mesh
+@mesh_collective
 def test_ici_plan_grouped_agg_matches_oracle():
     """A real DataFrame query executes through TpuOverrides + the exec layer
     as ONE shard_map collective program on the mesh, and matches the oracle."""
@@ -133,6 +148,7 @@ def test_ici_plan_grouped_agg_matches_oracle():
 
 
 @needs_mesh
+@mesh_collective
 def test_ici_plan_global_agg_matches_oracle():
     import sys
     sys.path.insert(0, "tests")
@@ -169,6 +185,7 @@ def test_ici_plan_is_installed():
 
 
 @needs_mesh
+@mesh_collective
 def test_ici_plan_empty_input():
     from spark_rapids_tpu import types as T
     from spark_rapids_tpu.session import TpuSession, sum_
@@ -183,6 +200,7 @@ def test_ici_plan_empty_input():
 
 @needs_mesh
 @pytest.mark.parametrize("how", ["inner", "left", "left_semi", "left_anti"])
+@mesh_collective
 def test_ici_plan_shuffled_join_matches_oracle(how):
     """A shuffled equi-join DataFrame query executes as the two-step SPMD
     collective program (all-to-all both sides over ICI, local sorted-probe
@@ -230,6 +248,7 @@ def test_ici_join_plan_is_installed():
 
 
 @needs_mesh
+@mesh_collective
 def test_ici_epoch_streamed_agg():
     """Input far above one epoch's bytes streams through the accumulator
     (multi-epoch path: partial -> a2a -> merge-into-acc per epoch)."""
@@ -253,6 +272,7 @@ def test_ici_epoch_streamed_agg():
 
 
 @needs_mesh
+@mesh_collective
 def test_ici_epoch_streamed_global_agg():
     import sys
     sys.path.insert(0, "tests")
@@ -273,6 +293,7 @@ def test_ici_epoch_streamed_global_agg():
 
 
 @needs_mesh
+@mesh_collective
 def test_ici_distributed_sort():
     """Global order_by runs as the range-exchange mesh sort and emits the
     exact oracle order."""
@@ -293,6 +314,7 @@ def test_ici_distributed_sort():
 
 
 @needs_mesh
+@mesh_collective
 def test_ici_distributed_sort_desc_nulls():
     import sys
     sys.path.insert(0, "tests")
@@ -310,6 +332,7 @@ def test_ici_distributed_sort_desc_nulls():
 
 
 @needs_mesh
+@mesh_collective
 def test_ici_distributed_sort_multi_epoch():
     """Sort input spanning several epochs still emits globally ordered."""
     import sys
@@ -352,6 +375,7 @@ def test_ici_sort_installed():
 
 @needs_mesh
 @pytest.mark.parametrize("n_dev", [2, 3, 5, 8])
+@mesh_collective
 def test_ici_device_count_sweep(n_dev):
     """Non-power-of-2 meshes: quota/padding math must hold for every
     device count (VERDICT r2 weak #9)."""
@@ -375,6 +399,7 @@ def test_ici_device_count_sweep(n_dev):
 
 @needs_mesh
 @pytest.mark.parametrize("n_dev", [3, 5])
+@mesh_collective
 def test_ici_sort_device_count_sweep(n_dev):
     import sys
     sys.path.insert(0, "tests")
@@ -396,6 +421,7 @@ def test_ici_sort_device_count_sweep(n_dev):
 
 @needs_mesh
 @pytest.mark.parametrize("how", ["right", "full"])
+@mesh_collective
 def test_ici_right_full_joins_on_mesh(how):
     """RIGHT (mirror-swapped) and FULL (matched-build tail) mesh joins run
     through the ICI exec and match the oracle (VERDICT r3 Next #3)."""
@@ -430,6 +456,7 @@ def test_ici_right_full_joins_on_mesh(how):
 
 
 @needs_mesh
+@mesh_collective
 def test_ici_full_join_multi_epoch_tail():
     """FULL OUTER across several probe epochs: the matched-build mask ORs
     across epochs so the tail emits exactly the never-matched build rows."""
@@ -454,6 +481,7 @@ def test_ici_full_join_multi_epoch_tail():
 
 
 @needs_mesh
+@mesh_collective
 def test_ici_conditional_inner_join_on_mesh():
     """INNER equi-join with a RESIDUAL condition: the condition filters
     the gathered pairs inside the mesh materialization program (a
@@ -495,6 +523,7 @@ def test_ici_conditional_inner_join_on_mesh():
 
 
 @needs_mesh
+@mesh_collective
 def test_ici_join_probe_epochs():
     """Probe side spanning several epochs: per-device memory = build side
     + one epoch; every epoch's matches stream out."""
@@ -575,6 +604,7 @@ def test_ici_window_installed():
 
 @needs_mesh
 @pytest.mark.parametrize("n_dev", [2, 3, 5, 8])
+@mesh_collective
 def test_ici_window_matches_oracle(n_dev):
     """Partitioned window distributes over the mesh (hash all-to-all on
     PARTITION BY + per-device single-chip window) and matches the oracle
@@ -607,6 +637,7 @@ def test_ici_window_matches_oracle(n_dev):
 
 
 @needs_mesh
+@mesh_collective
 def test_ici_window_multi_epoch():
     """Window input spanning several epochs folds into the device-resident
     accumulator before the one window program."""
@@ -636,6 +667,7 @@ def test_ici_window_multi_epoch():
 
 
 @needs_mesh
+@mesh_collective
 def test_ici_window_null_partition_keys():
     """Null PARTITION BY keys form one partition and hash to one device."""
     import sys
@@ -684,6 +716,7 @@ def test_ici_window_kill_switch():
 
 
 @needs_mesh
+@mesh_collective
 def test_ici_repartition_installed_and_matches():
     """df.repartition(k) lowers to the generic mesh all-to-all and the
     downstream aggregate still matches the oracle."""
@@ -741,3 +774,204 @@ def test_ici_repartition_nested_schema_keeps_host_path():
 
     assert not find(root), "nested schema must keep the host exchange"
     assert sorted(q.collect()) == [(1, [1, 2]), (1, [3]), (2, None)]
+
+
+# -- ISSUE 10: real ICI shuffle — null round-trip, counters/event, -----------
+# -- zero-host-bytes pin, cross-slice wiring ---------------------------------
+
+
+@needs_mesh
+@mesh_collective
+def test_ici_all_to_all_columns_null_validity_round_trip():
+    """Satellite: whole-batch ICI all-to-all on the CPU-simulated mesh —
+    values, string payloads, AND per-column null validity survive the
+    routing; invalid rows drop; every valid row lands on exactly the
+    device its hash names."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    from spark_rapids_tpu.parallel.compat import shard_map
+    from spark_rapids_tpu.parallel.mesh import (
+        _local_hash_partition_ids,
+        ici_all_to_all_columns,
+        make_mesh,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_dev = 8
+    mesh = make_mesh(n_dev)
+    n = 64 * n_dev
+    rng = np.random.default_rng(3)
+    keys = jnp.asarray(rng.integers(0, 1 << 40, n), jnp.int64)
+    vals = jnp.asarray(rng.integers(-1000, 1000, n), jnp.int64)
+    v_ok = jnp.asarray(rng.random(n) < 0.7)        # nullable payload
+    rows_ok = jnp.asarray(rng.random(n) < 0.9)     # live rows
+    chars = jnp.asarray(rng.integers(97, 123, (n, 8)), jnp.uint8)
+    lens = jnp.asarray(rng.integers(1, 9, n), jnp.int32)
+
+    def step(kd, vd, vo, ch, ln, ro):
+        cols = [DeviceColumn(T.LONG, ro, data=kd),
+                DeviceColumn(T.LONG, vo & ro, data=vd),
+                DeviceColumn(T.STRING, ro, chars=ch, lengths=ln)]
+        tgt = _local_hash_partition_ids(kd, ro, n_dev)
+        rcols, rok = ici_all_to_all_columns(cols, ro, tgt, n_dev, "dp")
+        return (rcols[0].data, rcols[1].data, rcols[1].validity,
+                rcols[2].chars, rcols[2].lengths, rok)
+
+    fn = jax.jit(shard_map(
+        step, mesh=mesh, in_specs=(P("dp"),) * 6,
+        out_specs=(P("dp"),) * 6, check_vma=False))
+    spec = NamedSharding(mesh, P("dp"))
+    args = [jax.device_put(x, spec)
+            for x in (keys, vals, v_ok, chars, lens, rows_ok)]
+    rk, rv, rvok, rch, rln, rok = [np.asarray(x) for x in fn(*args)]
+
+    pid = np.asarray(jnp.where(
+        rows_ok, _local_hash_partition_ids(keys, rows_ok, n_dev), -1))
+    per_dev_cap = rk.shape[0] // n_dev
+    seen = 0
+    for d in range(n_dev):
+        sl = slice(d * per_dev_cap, (d + 1) * per_dev_cap)
+        m = rok[sl]
+        got = sorted(
+            (int(k), int(v) if ok else None,
+             bytes(c[:int(w)]).decode())
+            for k, v, ok, c, w in zip(rk[sl][m], rv[sl][m], rvok[sl][m],
+                                      rch[sl][m], rln[sl][m]))
+        want_mask = pid == d
+        want = sorted(
+            (int(k), int(v) if ok else None,
+             bytes(np.asarray(c)[:int(w)]).decode())
+            for k, v, ok, c, w in zip(
+                np.asarray(keys)[want_mask], np.asarray(vals)[want_mask],
+                np.asarray(v_ok)[want_mask],
+                np.asarray(chars)[want_mask],
+                np.asarray(lens)[want_mask]))
+        assert got == want, f"device {d}: {len(got)} vs {len(want)} rows"
+        seen += len(got)
+    assert seen == int(np.asarray(rows_ok).sum())
+
+
+@needs_mesh
+@mesh_collective
+def test_ici_all_to_all_zero_host_bytes():
+    """Acceptance pin: the all-device ICI shuffle path moves ZERO bytes
+    through the host — no D2H materializations, no H2D upload sites —
+    once inputs are device-resident (bytes_d2h / bytes_h2d deltas)."""
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    from spark_rapids_tpu.parallel.compat import shard_map
+    from spark_rapids_tpu.parallel.mesh import (
+        _local_hash_partition_ids,
+        ici_all_to_all_columns,
+        make_mesh,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_dev = 8
+    mesh = make_mesh(n_dev)
+    n = 32 * n_dev
+    rng = np.random.default_rng(9)
+    keys = jnp.asarray(rng.integers(0, 1 << 30, n), jnp.int64)
+    vals = jnp.asarray(rng.integers(-50, 50, n), jnp.int64)
+    ok = jnp.ones(n, jnp.bool_)
+
+    def step(kd, vd, ro):
+        cols = [DeviceColumn(T.LONG, ro, data=kd),
+                DeviceColumn(T.LONG, ro, data=vd)]
+        tgt = _local_hash_partition_ids(kd, ro, n_dev)
+        rcols, rok = ici_all_to_all_columns(cols, ro, tgt, n_dev, "dp")
+        return rcols[0].data, rcols[1].data, rok
+
+    fn = jax.jit(shard_map(step, mesh=mesh, in_specs=(P("dp"),) * 3,
+                           out_specs=(P("dp"),) * 3, check_vma=False))
+    spec = NamedSharding(mesh, P("dp"))
+    args = [jax.device_put(x, spec) for x in (keys, vals, ok)]
+    jax.block_until_ready(fn(*args))   # compile outside the window
+    snap = PC.snapshot()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    d = PC.since(snap)
+    assert d["bytes_d2h"] == 0, d
+    assert d["bytes_h2d"] == 0, d
+    assert d["host_syncs"] == 0, d
+
+
+@needs_mesh
+@mesh_collective
+def test_ici_counters_and_diagnostics_event(tmp_path):
+    """A mesh-stage query accounts its collective epochs into the
+    ici_* counters and emits the ici_shuffle diagnostics event."""
+    import json
+    import sys
+    sys.path.insert(0, "tests")
+    from data_gen import IntegerGen, gen_df
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu.session import TpuSession, sum_
+
+    conf = dict(_ICI_CONF)
+    conf["spark.rapids.tpu.diagnostics.enabled"] = True
+    conf["spark.rapids.tpu.diagnostics.eventLogDir"] = str(tmp_path)
+    s = TpuSession(conf)
+    df = gen_df(s, [IntegerGen(min_val=0, max_val=20), IntegerGen()],
+                ["k", "v"], length=400)
+    snap = PC.snapshot()
+    rows = df.group_by("k").agg(sum_("v", "sv")).collect()
+    assert rows
+    d = PC.since(snap)
+    assert d["ici_epochs"] >= 1, d
+    assert d["ici_rows_exchanged"] > 0, d
+    assert d["ici_shuffle_ns"] > 0, d
+    logs = sorted(tmp_path.glob("query-*.jsonl"))
+    assert logs
+    events = [json.loads(line) for line in
+              logs[-1].read_text().splitlines()]
+    ici = [e for e in events if e["ev"] == "ici_shuffle"]
+    assert ici, [e["ev"] for e in events]
+    assert ici[0]["n_dev"] == 8
+    assert ici[0]["rows"] > 0
+
+
+@needs_mesh
+@mesh_collective
+def test_ici_repartition_cross_slice_hosts():
+    """spark.rapids.tpu.ici.crossSliceHosts routes the generic mesh
+    repartition through the two-level (host x ici) mesh and still
+    matches the oracle."""
+    import sys
+    sys.path.insert(0, "tests")
+    from asserts import assert_tpu_and_cpu_are_equal_collect
+    from data_gen import IntegerGen, gen_df
+    from spark_rapids_tpu.exec.ici import TpuIciRepartitionExec
+    from spark_rapids_tpu.session import TpuSession, sum_
+
+    conf = dict(_ICI_CONF)
+    conf["spark.rapids.tpu.ici.crossSliceHosts"] = 2
+
+    s = TpuSession(dict(conf))
+    df = gen_df(s, [IntegerGen(min_val=0, max_val=9), IntegerGen()],
+                ["k", "v"], length=200)
+    root, _ = df.repartition(4, "k")._planned()
+
+    found = []
+
+    def find(n):
+        if isinstance(n, TpuIciRepartitionExec):
+            found.append(n)
+        for c in n.children:
+            if hasattr(c, "children"):
+                find(c)
+
+    find(root)
+    assert found, root.pretty()
+    assert found[0].cross_hosts == 2
+    assert "cross_slice=2x4" in found[0].describe()
+
+    def build(s):
+        df = gen_df(s, [IntegerGen(min_val=0, max_val=9),
+                        IntegerGen(min_val=-100, max_val=100)],
+                    ["k", "v"], length=300)
+        return (df.repartition(4, "k").group_by("k")
+                .agg(sum_("v", "sv")))
+
+    assert_tpu_and_cpu_are_equal_collect(build, conf=conf)

@@ -20,6 +20,13 @@ from asserts import assert_tpu_and_cpu_are_equal_collect  # noqa: E402
 needs_mesh = pytest.mark.skipif(
     len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
 
+# every test here EXECUTES the mesh join (multi-capacity SPMD compiles,
+# minutes on CPU XLA) — outside the tier-1 'not slow' budget for the
+# same reason as test_multichip's collective tests (ISSUE 10): at seed
+# they failed fast on the jax shard_map kwarg drift, with the
+# parallel/compat.py shim they pass but pay full compile cost
+pytestmark = pytest.mark.slow
+
 _CONF = {
     "spark.rapids.sql.enabled": True,
     "spark.rapids.shuffle.mode": "ICI",

@@ -45,6 +45,7 @@ DEFAULT_COMPILE_TOLERANCE = 0.5
 SCAN_TRANSFER_SLACK_S = 0.05
 COMPILE_SLACK_S = 0.5
 P95_SLACK_MS = 5.0
+RUNG3_OOC_SLACK_S = 2.0
 
 
 def load(path: str) -> Dict:
@@ -142,6 +143,27 @@ def gate(base: Dict, new: Dict, tolerance: float = DEFAULT_TOLERANCE,
                 f"{nc:.3f}s ({_pct(bc, nc)}, tolerance "
                 f"{compile_tolerance * 100:.0f}% + "
                 f"{COMPILE_SLACK_S:.1f}s)")
+
+    # gating rung3_ooc wall column (ISSUE 10): the pinned out-of-core
+    # rung must neither vanish (caught by the missing-queries check
+    # above, since it appears in skipped_on_time_budget otherwise) nor
+    # creep past tolerance — the spill/exchange machinery is exactly
+    # where perf PRs regress silently
+    b3, n3 = bq.get("rung3_ooc"), nq.get("rung3_ooc")
+    if b3 and n3:
+        bw = float(b3.get("tpu_s") or 0.0)
+        nw = float(n3.get("tpu_s") or 0.0)
+        if bw and nw > bw * (1.0 + tolerance) + RUNG3_OOC_SLACK_S:
+            regressions.append(
+                f"rung3_ooc: out-of-core wall regressed: {bw:.3f}s -> "
+                f"{nw:.3f}s ({_pct(bw, nw)}, tolerance "
+                f"{tolerance * 100:.0f}% + {RUNG3_OOC_SLACK_S:.1f}s)")
+        if b3.get("spillToHostCount") and not n3.get("spillToHostCount"):
+            # zero spills at 10x the pool means the rung silently
+            # stopped exercising the out-of-core path
+            regressions.append(
+                "rung3_ooc: spill traffic collapsed to 0 — the rung no "
+                "longer exercises the out-of-core machinery")
 
     # NOTE: the payload's per-plan-signature "slo" section is
     # deliberately NOT gated here — it includes warm-up/compile collects
