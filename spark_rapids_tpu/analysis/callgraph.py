@@ -1,0 +1,863 @@
+"""Interprocedural call graph rooted at the jit boundary.
+
+tracelint's foundation: every ``jax.jit`` / ``tpu_jit`` / ``pallas_call``
+/ ``shard_map`` / ``cached_jit_program`` site roots a **traced region** —
+the referenced function plus everything reachable from it through calls
+the resolver can bind (module-local names through nested scopes,
+``self``-methods through the class table and its base chain, imported
+names through the per-file alias map — the same resolution vocabulary
+``rules_lockset`` uses, extended with nested-``def`` scoping and
+lambda-default following for the ``lambda _fn=fn: tpu_jit(_fn)`` idiom).
+
+On top of the region the builder runs a **shallow taint** analysis:
+every parameter of a root function is a traced value; taint propagates
+through arithmetic/comparison operators, subscripts, attribute loads,
+``jnp.``/``jax.``/``lax.``/``pl.`` calls, and tuple packing/unpacking —
+and deliberately NOT through constructor calls, comprehensions, or
+user-function returns.  That asymmetry is the point: the trace rules
+that consume the taint (``rules_trace``) must never storm false
+positives, so the taint under-approximates and the region rules
+(conf reads, side effects) carry the recall.  Limits are documented in
+docs/static_analysis.md.
+
+One parse per file still holds: the builder only reads ``ctx.tree``
+objects the engine already parsed.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from spark_rapids_tpu.analysis.core import FileCtx
+
+# wrappers whose FIRST function-valued argument becomes a trace root
+JIT_WRAPPERS = frozenset(("jit", "tpu_jit", "pallas_call", "shard_map"))
+# registry entry point: cached_jit_program(key_parts, builder) traces arg 1
+BUILDER_WRAPPERS = {"cached_jit_program": 1}
+# jax.lax higher-order combinators: function-valued args join the caller's
+# region (they only ever run under an enclosing trace)
+HOF_FN_ARGS = {
+    "fori_loop": (2,), "while_loop": (0, 1), "scan": (0,),
+    "cond": (1, 2), "switch": (1, 2, 3, 4, 5), "map": (0,),
+    "vmap": (0,), "custom_vjp": (0,), "checkpoint": (0,), "remat": (0,),
+}
+# attribute-chain roots whose calls return traced values for taint
+ARRAY_NAMESPACES = frozenset(("jnp", "jax", "lax", "pl", "plgpu"))
+# attribute loads that yield STATIC metadata even on a traced value:
+# tracer shape/dtype are Python values (branching on them is legal and
+# resolves at trace time), and the columnar containers' schema fields
+# (is_string/width/capacity/dtype) are host metadata by construction
+STATIC_ATTRS = frozenset((
+    "shape", "ndim", "dtype", "size", "nbytes", "capacity", "width",
+    "is_string", "is_array", "is_struct", "is_string_array",
+    "is_dec128", "is_128", "fields", "names", "aval", "weak_type",
+))
+
+
+def _root_name(expr: ast.AST) -> str:
+    """Leftmost Name of an attribute/call chain (``jnp.ops.x`` -> jnp)."""
+    while isinstance(expr, (ast.Attribute, ast.Subscript, ast.Call)):
+        expr = (expr.value if isinstance(expr, (ast.Attribute,
+                                                ast.Subscript))
+                else expr.func)
+    return expr.id if isinstance(expr, ast.Name) else ""
+
+
+def _trailing(expr: ast.AST) -> str:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return ""
+
+
+class FuncInfo:
+    __slots__ = ("key", "rel", "qual", "node", "params", "ctx",
+                 "owner_class", "scope", "defaulted", "call_bindings")
+
+    def __init__(self, key: str, rel: str, qual: str, node: ast.AST,
+                 params: List[str], ctx: FileCtx,
+                 owner_class: str, scope: Tuple[str, ...],
+                 defaulted: Optional[Set[str]] = None):
+        self.key = key
+        self.rel = rel
+        self.qual = qual
+        self.node = node
+        self.params = params        # ordered positional-or-kw names
+        self.ctx = ctx
+        self.owner_class = owner_class   # innermost enclosing class, ""
+        self.scope = scope               # qual path segments
+        self.defaulted = defaulted or set()  # params carrying a default
+        # local name -> (callee desc, tuple index|None) for bindings of
+        # the `fn, aux = self._stage_fn(...)` form — lets a jit site on
+        # `fn` follow the callee's `return fn, aux` to the nested def
+        self.call_bindings: Dict[str, Tuple[Tuple, Optional[int]]] = {}
+
+    def receiver_params(self) -> int:
+        """1 when calls through ``self``/``cls`` skip the first param."""
+        return 1 if self.params[:1] in (["self"], ["cls"]) else 0
+
+
+class RootSite:
+    """Where a traced region is rooted: the jit/pallas/builder call."""
+
+    __slots__ = ("rel", "line", "kind", "owner_class", "scope")
+
+    def __init__(self, rel: str, line: int, kind: str,
+                 owner_class: str, scope: Tuple[str, ...]):
+        self.rel = rel
+        self.line = line
+        self.kind = kind
+        self.owner_class = owner_class
+        self.scope = scope
+
+
+class _CallRec:
+    __slots__ = ("desc", "node", "args", "keywords")
+
+    def __init__(self, desc, node: ast.Call):
+        self.desc = desc
+        self.node = node
+        self.args = node.args
+        self.keywords = node.keywords
+
+
+class CallGraph:
+    """Repo-wide function table + call edges + traced-region state."""
+
+    def __init__(self):
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.calls: Dict[str, List[_CallRec]] = {}
+        # per-file import alias maps:
+        #   alias -> ("mod", "a/b")      plain `import a.b as alias`
+        #   alias -> ("from", "a/b", "name")  `from a.b import name`
+        self.aliases: Dict[str, Dict[str, Tuple]] = {}
+        # (rel, ClassName) -> list of base descriptors (raw AST exprs)
+        self.class_bases: Dict[Tuple[str, str], List[ast.AST]] = {}
+        self.jit_sites: List[Tuple[FileCtx, ast.Call, str, ast.AST,
+                                   Tuple[str, ...], str]] = []
+        self._site_seen: Set[Tuple[str, int, int]] = set()
+        # results of finalize()
+        self.traced: Dict[str, RootSite] = {}
+        self.tainted_params: Dict[str, Set[str]] = {}
+        self.resolved_calls: Dict[str, List[Tuple[str, _CallRec]]] = {}
+        self._finalized = False
+
+    # ------------------------------------------------------------------
+    # per-file scan
+    # ------------------------------------------------------------------
+    def scan_file(self, ctx: FileCtx) -> None:
+        amap = self.aliases.setdefault(ctx.rel, {})
+        scanner = _FileScanner(self, ctx, amap)
+        scanner.visit_body(ctx.tree.body, scope=(), owner_class="")
+
+    def _add_func(self, ctx: FileCtx, node, scope: Tuple[str, ...],
+                  owner_class: str) -> FuncInfo:
+        if isinstance(node, ast.Lambda):
+            name = f"<lambda:{node.lineno}>"
+            args = node.args
+        else:
+            name = node.name
+            args = node.args
+        qual = ".".join(scope + (name,))
+        params = ([a.arg for a in args.posonlyargs]
+                  + [a.arg for a in args.args]
+                  + [a.arg for a in args.kwonlyargs])
+        if args.vararg is not None:
+            params.append(args.vararg.arg)
+        if args.kwarg is not None:
+            params.append(args.kwarg.arg)
+        positional = ([a.arg for a in args.posonlyargs]
+                      + [a.arg for a in args.args])
+        defaulted = set(positional[len(positional)
+                                   - len(args.defaults):]
+                        if args.defaults else ())
+        defaulted |= {a.arg for a, d in zip(args.kwonlyargs,
+                                            args.kw_defaults)
+                      if d is not None}
+        key = f"{ctx.rel}::{qual}"
+        info = FuncInfo(key, ctx.rel, qual, node, params, ctx,
+                        owner_class, scope + (name,), defaulted)
+        self.funcs[key] = info
+        return info
+
+    # ------------------------------------------------------------------
+    # resolution
+    # ------------------------------------------------------------------
+    def _lookup_scoped(self, rel: str, scope: Tuple[str, ...],
+                       name: str) -> Optional[str]:
+        """Innermost-out lookup of a function ``name`` visible at
+        ``scope`` in file ``rel`` (nested defs included)."""
+        for i in range(len(scope), -1, -1):
+            qual = ".".join(scope[:i] + (name,))
+            key = f"{rel}::{qual}"
+            if key in self.funcs:
+                return key
+        return None
+
+    def _class_chain(self, rel: str, cls: str,
+                     _seen=None) -> List[Tuple[str, str]]:
+        """(rel, class) plus base classes, depth-first, repo-resolved."""
+        _seen = _seen if _seen is not None else set()
+        if (rel, cls) in _seen:
+            return []
+        _seen.add((rel, cls))
+        out = [(rel, cls)]
+        for base in self.class_bases.get((rel, cls), ()):  # raw exprs
+            bname = _trailing(base)
+            if not bname:
+                continue
+            if (rel, bname) in self.class_bases:
+                out.extend(self._class_chain(rel, bname, _seen))
+                continue
+            # imported base: follow the from-import alias
+            tgt = self.aliases.get(rel, {}).get(bname)
+            if tgt is not None and tgt[0] == "from":
+                brel = tgt[1] + ".py"
+                for (frel, fcls) in self.class_bases:
+                    if frel.endswith(brel) and fcls == tgt[2]:
+                        out.extend(self._class_chain(frel, fcls, _seen))
+                        break
+        return out
+
+    def _lookup_method(self, rel: str, cls: str,
+                       attr: str) -> Optional[str]:
+        for (crel, cname) in self._class_chain(rel, cls):
+            key = f"{crel}::{cname}.{attr}"
+            if key in self.funcs:
+                return key
+        return None
+
+    def resolve(self, desc) -> Optional[str]:
+        """Bind a call/function-reference descriptor to a function key."""
+        kind = desc[0]
+        if kind == "name":
+            _, rel, scope, name = desc
+            key = self._lookup_scoped(rel, scope, name)
+            if key is not None:
+                return key
+            tgt = self.aliases.get(rel, {}).get(name)
+            if tgt is not None and tgt[0] == "from":
+                frel, fname = tgt[1] + ".py", tgt[2]
+                for k in self.funcs:
+                    krel, qual = k.split("::", 1)
+                    if krel.endswith(frel) and qual == fname:
+                        return k
+            return None
+        if kind == "self":
+            _, rel, cls, attr = desc
+            return self._lookup_method(rel, cls, attr)
+        if kind == "alias":
+            _, rel, alias, attr = desc
+            tgt = self.aliases.get(rel, {}).get(alias)
+            if tgt is None or tgt[0] != "mod":
+                return None
+            frel = tgt[1] + ".py"
+            for k in self.funcs:
+                krel, qual = k.split("::", 1)
+                if krel.endswith(frel) and qual == attr:
+                    return k
+            return None
+        if kind == "objattr":
+            # method reference through an untyped object: resolve in the
+            # current class chain first, else a same-file unique match
+            _, rel, cls, attr = desc
+            if cls:
+                key = self._lookup_method(rel, cls, attr)
+                if key is not None:
+                    return key
+            hits = [f"{rel}::{cname}.{attr}"
+                    for (crel, cname) in self.class_bases
+                    if crel == rel
+                    and f"{rel}::{cname}.{attr}" in self.funcs]
+            if len(set(hits)) == 1:
+                return hits[0]
+            return None
+        return None
+
+    # ------------------------------------------------------------------
+    # finalize: traced regions + taint fixpoint
+    # ------------------------------------------------------------------
+    def finalize(self) -> None:
+        if self._finalized:
+            return
+        self._finalized = True
+        for caller, recs in self.calls.items():
+            lst = []
+            for rec in recs:
+                callee = self.resolve(rec.desc)
+                if callee is not None and callee != caller:
+                    lst.append((callee, rec))
+            if lst:
+                self.resolved_calls[caller] = lst
+
+        work: List[str] = []
+        for (ctx, call, kind, fn_expr, scope, owner) in self.jit_sites:
+            keys = []
+            key = self._resolve_fn_expr(ctx, fn_expr, scope, owner)
+            if key is not None:
+                keys.append(key)
+            else:
+                keys.extend(self._param_fed_roots(ctx, fn_expr, scope))
+            static = _partial_bound(fn_expr)
+            for key in keys:
+                site = RootSite(ctx.rel, call.lineno, kind, owner, scope)
+                if key not in self.traced:
+                    self.traced[key] = site
+                info = self.funcs[key]
+                # defaulted params of a ROOT function are closure
+                # constants (the `def fn(cols, n, _b=groups_cap)`
+                # idiom): jax traces only arguments actually passed,
+                # and the jit-boundary call is invisible to the
+                # resolver — taint reaching a defaulted param through a
+                # resolved INTERIOR call still applies.  Same for
+                # params bound by a `partial(kernel, bw=bw)` wrapper.
+                seed = set(info.params) - info.defaulted
+                if static is not None:
+                    names, npos = static
+                    seed -= names | set(
+                        info.params[info.receiver_params():][:npos])
+                grew = self._taint_params(key, seed)
+                if key not in work or grew:
+                    work.append(key)
+
+        # BFS/fixpoint: propagate region membership + param taint
+        while work:
+            key = work.pop()
+            info = self.funcs.get(key)
+            if info is None:
+                continue
+            root = self.traced[key]
+            local = self.local_taint(key)
+            for callee, rec in self.resolved_calls.get(key, ()):
+                cinfo = self.funcs.get(callee)
+                if cinfo is None:
+                    continue
+                newly = callee not in self.traced
+                if newly:
+                    self.traced[callee] = root
+                tainted = set()
+                # calls through self/cls skip the receiver param, so
+                # positional args align one slot later
+                off = (cinfo.receiver_params()
+                       if rec.desc[0] in ("self", "objattr") else 0)
+                for i, arg in enumerate(rec.args):
+                    if i + off < len(cinfo.params) and self.expr_tainted(
+                            arg, local):
+                        tainted.add(cinfo.params[i + off])
+                for kw in rec.keywords:
+                    if kw.arg and kw.arg in cinfo.params \
+                            and self.expr_tainted(kw.value, local):
+                        tainted.add(kw.arg)
+                grew = self._taint_params(callee, tainted)
+                if newly or grew:
+                    work.append(callee)
+            # HOF fn-args join the region with fully-tainted params
+            for hof_key in self._hof_fn_refs(info):
+                if hof_key in self.funcs:
+                    newly = hof_key not in self.traced
+                    if newly:
+                        self.traced[hof_key] = root
+                    grew = self._taint_params(
+                        hof_key, set(self.funcs[hof_key].params))
+                    if newly or grew:
+                        work.append(hof_key)
+
+    def _param_fed_roots(self, ctx: FileCtx, fn_expr: ast.AST,
+                         scope: Tuple[str, ...]) -> List[str]:
+        """A jit site over a PARAM of its enclosing function (the
+        ``_cached_jit(self, attr, kind, builder)`` shape): resolve the
+        actual builder expressions at every resolved caller."""
+        if not (isinstance(fn_expr, ast.Name) and scope):
+            return []
+        enc_key = f"{ctx.rel}::" + ".".join(scope)
+        enc = self.funcs.get(enc_key)
+        if enc is None or fn_expr.id not in enc.params:
+            return []
+        pos = enc.params.index(fn_expr.id)
+        out = []
+        for caller in sorted(self.resolved_calls):
+            for callee, rec in self.resolved_calls[caller]:
+                if callee != enc_key:
+                    continue
+                cinfo = self.funcs.get(caller)
+                if cinfo is None:
+                    continue
+                # the caller's call is itself a registered jit site
+                # (`tpu_jit(...)` resolved into the tpu_jit WRAPPER's
+                # own `jax.jit(fn)`): the lexical site already rooted
+                # it, with better partial/lambda context
+                if (cinfo.rel, rec.node.lineno,
+                        rec.node.col_offset) in self._site_seen:
+                    continue
+                arg = None
+                apos = pos - (enc.receiver_params()
+                              if rec.desc[0] in ("self", "objattr")
+                              else 0)
+                if 0 <= apos < len(rec.args):
+                    arg = rec.args[apos]
+                else:
+                    for kw in rec.keywords:
+                        if kw.arg == fn_expr.id:
+                            arg = kw.value
+                if arg is not None:
+                    key = self._resolve_fn_expr(cinfo.ctx, arg,
+                                                cinfo.scope,
+                                                cinfo.owner_class)
+                    if key is not None:
+                        out.append(key)
+        return out
+
+    def _taint_params(self, key: str, params: Set[str]) -> bool:
+        # `self`/`cls` are never traced arrays — a method's receiver is
+        # plan-node state, and tainting it would mark every attribute
+        # read (self.mode, self.grouping) as a traced value
+        cur = self.tainted_params.setdefault(key, set())
+        before = len(cur)
+        cur |= params - {"self", "cls"}
+        return len(cur) > before
+
+    def _resolve_fn_expr(self, ctx: FileCtx, expr: ast.AST,
+                         scope: Tuple[str, ...],
+                         owner: str, _depth: int = 0) -> Optional[str]:
+        """Bind the function-valued argument of a jit site to a key."""
+        if _depth > 8:
+            return None
+        if isinstance(expr, ast.Lambda):
+            return f"{ctx.rel}::" + ".".join(
+                scope + (f"<lambda:{expr.lineno}>",))
+        if isinstance(expr, ast.Call):
+            name = _trailing(expr.func)
+            # tpu_jit(pl.pallas_call(kernel, ...)) — unwrap one level;
+            # partial(kernel, bw=bw) binds closure constants only
+            if name in JIT_WRAPPERS and expr.args:
+                return self._resolve_fn_expr(ctx, expr.args[0], scope,
+                                             owner, _depth + 1)
+            if name == "partial" and expr.args:
+                return self._resolve_fn_expr(ctx, expr.args[0], scope,
+                                             owner, _depth + 1)
+            # kernel RETURNED by a resolvable callee:
+            # `tpu_jit(self._chain_fn(...))` — follow `return fn`
+            desc = self._fn_desc(ctx, expr.func, scope, owner)
+            callee = self.resolve(desc) if desc is not None else None
+            if callee is not None:
+                return self._returned_fn_key(callee, None, _depth + 1)
+            return None
+        desc = self._fn_desc(ctx, expr, scope, owner)
+        key = self.resolve(desc) if desc is not None else None
+        if key is not None:
+            return key
+        # name bound from a resolvable call in an enclosing function:
+        # `fn, aux = self._stage_fn(...); ... tpu_jit(fn)` — follow the
+        # callee's `return fn, aux` through the tuple index
+        if isinstance(expr, ast.Name):
+            for i in range(len(scope), 0, -1):
+                enc = self.funcs.get(
+                    f"{ctx.rel}::" + ".".join(scope[:i]))
+                if enc is None:
+                    continue
+                bound = enc.call_bindings.get(expr.id)
+                if bound is None:
+                    continue
+                callee = self.resolve(bound[0])
+                if callee is not None:
+                    return self._returned_fn_key(callee, bound[1],
+                                                 _depth + 1)
+        return None
+
+    def _returned_fn_key(self, callee: str, index: Optional[int],
+                         _depth: int) -> Optional[str]:
+        """The function key ``callee`` returns (element ``index`` of a
+        returned tuple, or the bare return value)."""
+        info = self.funcs.get(callee)
+        if info is None:
+            return None
+        if isinstance(info.node, ast.Lambda):
+            values = [info.node.body]
+        else:
+            values = [st.value
+                      for st in _own_statements(info.node.body)
+                      if isinstance(st, ast.Return)
+                      and st.value is not None]
+        for v in values:
+            if index is not None:
+                if not (isinstance(v, (ast.Tuple, ast.List))
+                        and index < len(v.elts)):
+                    continue
+                v = v.elts[index]
+            key = self._resolve_fn_expr(info.ctx, v, info.scope,
+                                        info.owner_class, _depth)
+            if key is not None and key != callee:
+                return key
+        return None
+
+    def _fn_desc(self, ctx: FileCtx, expr: ast.AST,
+                 scope: Tuple[str, ...], owner: str):
+        if isinstance(expr, ast.Name):
+            return ("name", ctx.rel, scope, expr.id)
+        if isinstance(expr, ast.Attribute):
+            v = expr.value
+            if isinstance(v, ast.Name):
+                if v.id == "self" and owner:
+                    return ("self", ctx.rel, owner, expr.attr)
+                if v.id in self.aliases.get(ctx.rel, {}):
+                    return ("alias", ctx.rel, v.id, expr.attr)
+                return ("objattr", ctx.rel, owner, expr.attr)
+            # chained value (self.detached_for_trace()._fn, clone._fn...)
+            return ("objattr", ctx.rel, owner, expr.attr)
+        return None
+
+    def _hof_fn_refs(self, info: FuncInfo) -> List[str]:
+        """Function keys referenced as fn-args of jax.lax HOF calls in
+        ``info``'s body."""
+        out = []
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            idxs = HOF_FN_ARGS.get(_trailing(node.func))
+            if idxs is None:
+                continue
+            if _root_name(node.func) not in ARRAY_NAMESPACES \
+                    and _trailing(node.func) not in ("vmap",):
+                continue
+            for i in idxs:
+                if i < len(node.args):
+                    # info.scope (not [:-1]): the fn arg is an
+                    # expression INSIDE the function, so its own nested
+                    # defs/lambdas are visible — the common
+                    # `def body(...): ...; lax.fori_loop(0, n, body, x)`
+                    # idiom
+                    key = self._resolve_fn_expr(
+                        info.ctx, node.args[i], info.scope,
+                        info.owner_class)
+                    if key is not None:
+                        out.append(key)
+        return out
+
+    # ------------------------------------------------------------------
+    # shallow taint
+    # ------------------------------------------------------------------
+    def local_taint(self, key: str) -> Set[str]:
+        """Names holding traced values inside function ``key``, given
+        its tainted parameters — the shallow-propagation fixpoint."""
+        info = self.funcs.get(key)
+        if info is None:
+            return set()
+        tainted = set(self.tainted_params.get(key, ()))
+        body = getattr(info.node, "body", [])
+        if isinstance(info.node, ast.Lambda):
+            return tainted
+        stmts = list(_own_statements(body))
+        changed = True
+        while changed:
+            changed = False
+            for st in stmts:
+                targets: List[ast.AST] = []
+                value = None
+                if isinstance(st, ast.Assign):
+                    targets, value = st.targets, st.value
+                elif isinstance(st, ast.AnnAssign) and st.value is not None:
+                    targets, value = [st.target], st.value
+                elif isinstance(st, ast.AugAssign):
+                    targets, value = [st.target], st.value
+                elif isinstance(st, ast.For):
+                    targets, value = [st.target], st.iter
+                elif isinstance(st, ast.NamedExpr):
+                    targets, value = [st.target], st.value
+                if value is None or not self.expr_tainted(value, tainted):
+                    continue
+                for t in targets:
+                    for n in _target_names(t):
+                        if n not in tainted:
+                            tainted.add(n)
+                            changed = True
+        return tainted
+
+    def expr_tainted(self, expr: ast.AST, tainted: Set[str]) -> bool:
+        """Shallow: does ``expr`` propagate a traced value?  (See module
+        docstring for the deliberate under-approximation.)"""
+        if isinstance(expr, ast.Name):
+            return expr.id in tainted
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in STATIC_ATTRS:
+                return False
+            return self.expr_tainted(expr.value, tainted)
+        if isinstance(expr, (ast.Subscript, ast.Starred)):
+            return self.expr_tainted(expr.value, tainted)
+        if isinstance(expr, ast.BinOp):
+            return (self.expr_tainted(expr.left, tainted)
+                    or self.expr_tainted(expr.right, tainted))
+        if isinstance(expr, ast.UnaryOp):
+            return self.expr_tainted(expr.operand, tainted)
+        if isinstance(expr, ast.BoolOp):
+            return any(self.expr_tainted(v, tainted) for v in expr.values)
+        if isinstance(expr, ast.Compare):
+            # `x is None` / `x is not None` is an IDENTITY check — the
+            # standard optional-traced-arg pattern resolves at trace
+            # time from the call signature, not from the value
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in expr.ops):
+                return False
+            return (self.expr_tainted(expr.left, tainted)
+                    or any(self.expr_tainted(c, tainted)
+                           for c in expr.comparators))
+        if isinstance(expr, ast.IfExp):
+            return (self.expr_tainted(expr.body, tainted)
+                    or self.expr_tainted(expr.orelse, tainted))
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self.expr_tainted(e, tainted) for e in expr.elts)
+        if isinstance(expr, ast.Call):
+            if _root_name(expr.func) in ARRAY_NAMESPACES:
+                return (any(self.expr_tainted(a, tainted)
+                            for a in expr.args)
+                        or any(self.expr_tainted(kw.value, tainted)
+                               for kw in expr.keywords)
+                        # jnp methods ON a tainted chain (x.at[...].set)
+                        or self.expr_tainted(expr.func, tainted))
+            # method call on a tainted object keeps the taint
+            # (col.data.astype(...), x.reshape(...))
+            if isinstance(expr.func, ast.Attribute) \
+                    and self.expr_tainted(expr.func.value, tainted):
+                return True
+            return False
+        return False
+
+
+def _partial_bound(expr: ast.AST) -> Optional[Tuple[Set[str], int]]:
+    """(keyword names, positional count) a ``partial(...)`` wrapper on
+    the jit site's fn expression binds — those params are closure
+    constants, not traced values.  None when no partial is involved."""
+    while isinstance(expr, ast.Call) \
+            and _trailing(expr.func) in JIT_WRAPPERS and expr.args:
+        expr = expr.args[0]
+    if isinstance(expr, ast.Call) and _trailing(expr.func) == "partial":
+        return ({kw.arg for kw in expr.keywords if kw.arg},
+                max(len(expr.args) - 1, 0))
+    return None
+
+
+def _target_names(t: ast.AST):
+    if isinstance(t, ast.Name):
+        yield t.id
+    elif isinstance(t, ast.Starred):
+        yield from _target_names(t.value)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _target_names(e)
+
+
+def _shallow_exprs(stmt: ast.AST):
+    """Expression nodes belonging to ONE statement: stops at nested
+    statements (they get their own ``_visit``) and at lambda boundaries
+    (a registered lambda's body is scanned by ``_scan_calls``)."""
+    stack = [c for c in ast.iter_child_nodes(stmt)
+             if not isinstance(c, ast.stmt)]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, ast.Lambda):
+            continue
+        stack.extend(c for c in ast.iter_child_nodes(n)
+                     if not isinstance(c, ast.stmt))
+
+
+def _own_statements(body):
+    """Every statement of a function body EXCLUDING nested function /
+    class bodies (those are separate call-graph nodes)."""
+    stack = list(body)
+    while stack:
+        st = stack.pop()
+        yield st
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            continue
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.stmt):
+                stack.append(child)
+            elif isinstance(child, ast.NamedExpr):
+                yield child
+
+
+def own_body_nodes(node: ast.AST):
+    """Every AST node lexically inside a function, EXCLUDING nested
+    function/class/lambda bodies — the traversal the trace rules use so
+    one finding never double-reports from both a helper and its
+    enclosing builder."""
+    for st in (node.body if isinstance(node.body, list) else [node.body]):
+        stack = [st]
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+
+class _FileScanner:
+    """Recursive one-pass scan of one file: functions (nested included),
+    classes + bases, import aliases, call records, jit sites."""
+
+    def __init__(self, graph: CallGraph, ctx: FileCtx, amap: Dict):
+        self.graph = graph
+        self.ctx = ctx
+        self.amap = amap
+
+    def visit_body(self, body, scope: Tuple[str, ...],
+                   owner_class: str) -> None:
+        for node in body:
+            self._visit(node, scope, owner_class)
+
+    def _visit(self, node: ast.AST, scope: Tuple[str, ...],
+               owner_class: str) -> None:
+        g, ctx = self.graph, self.ctx
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                self.amap[a.asname or a.name.split(".")[0]] = (
+                    "mod", a.name.replace(".", "/"))
+            return
+        if isinstance(node, ast.ImportFrom):
+            mod = (node.module or "").replace(".", "/")
+            for a in node.names:
+                self.amap[a.asname or a.name] = ("from", mod, a.name)
+            return
+        if isinstance(node, ast.ClassDef):
+            g.class_bases[(ctx.rel, node.name)] = list(node.bases)
+            self.visit_body(node.body, scope + (node.name,), node.name)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info = g._add_func(ctx, node, scope, owner_class)
+            self._maybe_decorator_site(node, info)
+            self._scan_calls(info)
+            self.visit_body(node.body, info.scope, owner_class)
+            return
+        # any other statement: register lambdas / jit sites among its
+        # OWN expressions, then recurse into nested statements — a def
+        # inside an `if`/`try`/`with` body is still a call-graph node
+        # (the `_GATHER_JITS` memo-miss pattern builds kernels there)
+        for sub in _shallow_exprs(node):
+            if isinstance(sub, ast.Lambda):
+                info = g._add_func(ctx, sub, scope, owner_class)
+                self._scan_calls(info)
+            elif isinstance(sub, ast.Call):
+                self._maybe_jit_site(sub, scope, owner_class)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self._visit(child, scope, owner_class)
+
+    def _scan_calls(self, info: FuncInfo) -> None:
+        g, ctx = self.graph, self.ctx
+        node = info.node
+        # follow `lambda _fn=fn: ...` defaults: a Name default aliases
+        # the enclosing binding, so rewrite param -> target at jit sites
+        defaults_map = {}
+        if isinstance(node, ast.Lambda):
+            args = node.args.args
+            dflts = node.args.defaults
+            for a, d in zip(args[len(args) - len(dflts):], dflts):
+                if isinstance(d, ast.Name):
+                    defaults_map[a.arg] = d.id
+        body_iter = (own_body_nodes(node)
+                     if not isinstance(node, ast.Lambda)
+                     else ast.walk(node.body))
+        for sub in body_iter:
+            if isinstance(sub, ast.Assign) \
+                    and isinstance(sub.value, ast.Call):
+                bdesc = g._fn_desc(ctx, sub.value.func, info.scope[:-1],
+                                   info.owner_class)
+                if bdesc is not None:
+                    if bdesc[0] == "name":
+                        bdesc = ("name", ctx.rel, info.scope, bdesc[3])
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            info.call_bindings[t.id] = (bdesc, None)
+                        elif isinstance(t, (ast.Tuple, ast.List)):
+                            for ti, te in enumerate(t.elts):
+                                if isinstance(te, ast.Name):
+                                    info.call_bindings[te.id] = (bdesc,
+                                                                 ti)
+            if not isinstance(sub, ast.Call):
+                continue
+            self._maybe_jit_site(sub, info.scope, info.owner_class,
+                                 defaults_map)
+            desc = g._fn_desc(ctx, sub.func, info.scope[:-1],
+                              info.owner_class)
+            if desc is not None:
+                if desc[0] == "name":
+                    # call resolution sees names visible INSIDE the
+                    # function (its own nested defs included)
+                    desc = ("name", ctx.rel, info.scope, desc[3])
+                g.calls.setdefault(info.key, []).append(
+                    _CallRec(desc, sub))
+
+    def _maybe_decorator_site(self, node, info: FuncInfo) -> None:
+        """``@tpu_jit`` / ``@jax.jit`` / ``@partial(jax.jit, ...)``
+        decorators root a traced region at the decorated function."""
+        for dec in node.decorator_list:
+            target = dec
+            if isinstance(dec, ast.Call):
+                name = _trailing(dec.func)
+                if name == "partial" and dec.args:
+                    target = dec.args[0]
+                else:
+                    target = dec.func
+            if _trailing(target) in JIT_WRAPPERS:
+                fn_ref = ast.copy_location(
+                    ast.Name(id=(node.name
+                                 if not isinstance(node, ast.Lambda)
+                                 else ""), ctx=ast.Load()), node)
+                # only .lineno is read off the site node downstream, so
+                # the decorator expression itself serves as the site
+                self.graph.jit_sites.append(
+                    (self.ctx, dec, _trailing(target), fn_ref,
+                     info.scope[:-1], info.owner_class))
+                return
+
+    def _maybe_jit_site(self, call: ast.Call, scope: Tuple[str, ...],
+                        owner_class: str, defaults_map=None) -> None:
+        name = _trailing(call.func)
+        fn_expr = None
+        kind = name
+        if name in JIT_WRAPPERS and call.args:
+            fn_expr = call.args[0]
+        elif name in BUILDER_WRAPPERS:
+            idx = BUILDER_WRAPPERS[name]
+            if idx < len(call.args):
+                fn_expr = call.args[idx]
+        if fn_expr is None:
+            return
+        # the tree is visited both by the enclosing def's call scan and
+        # by the statement walk — first registration wins (it is the one
+        # with lambda-default context)
+        site_key = (self.ctx.rel, call.lineno, call.col_offset)
+        if site_key in self.graph._site_seen:
+            return
+        self.graph._site_seen.add(site_key)
+        if defaults_map and isinstance(fn_expr, ast.Name) \
+                and fn_expr.id in defaults_map:
+            # lambda-default alias: resolve the outer binding instead,
+            # in the scope ENCLOSING the lambda
+            fn_expr = ast.copy_location(
+                ast.Name(id=defaults_map[fn_expr.id], ctx=ast.Load()),
+                fn_expr)
+            scope = scope[:-1]
+        self.graph.jit_sites.append(
+            (self.ctx, call, kind, fn_expr, scope, owner_class))
+
+
+class CallGraphRule:
+    """Pseudo-rule that builds the shared CallGraph during prescan and
+    finalizes it before the trace rules' ``end_run`` — register it
+    FIRST in the rule list; it reports nothing itself."""
+
+    id = "_callgraph"
+    node_types = ()
+
+    def __init__(self):
+        self.graph = CallGraph()
+
+    def prescan(self, ctx: FileCtx) -> None:
+        self.graph.scan_file(ctx)
+
+    def end_run(self, engine) -> None:
+        self.graph.finalize()

@@ -5,23 +5,29 @@ Exit-code contract (pinned by tests/test_lint.py):
 * 0 — no findings beyond the (empty-or-justified) baseline
 * 1 — at least one non-baselined finding (``--fail-on-new`` makes the
   intent explicit; it is also the default behavior)
-* 2 — bad invocation / unreadable baseline
+* 2 — bad invocation / unreadable baseline / unknown ``--rules`` id
 
-``--json`` emits deterministic JSON (sorted findings, sorted keys, no
-timestamps): two runs over an unchanged tree are byte-identical.
+``--json`` and ``--sarif`` emit deterministic output (sorted findings,
+sorted keys, no timestamps): two runs over an unchanged tree are
+byte-identical.  ``--rules a,b`` scopes a run to the named rules;
+``--prune-baseline`` rewrites the baseline dropping entries that no
+longer fire; the stale-entry count prints on every run.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 from typing import List, Optional
 
 from spark_rapids_tpu.analysis.core import (
     Baseline,
+    all_rule_ids,
     default_rules,
     run_paths,
     to_json,
+    to_sarif,
 )
 
 DEFAULT_BASELINE = "tools/lint_baseline.json"
@@ -43,12 +49,21 @@ def main(argv: Optional[List[str]] = None,
                          f"(default: {DEFAULT_BASELINE} when present)")
     ap.add_argument("--json", action="store_true",
                     help="emit findings as deterministic JSON")
+    ap.add_argument("--sarif", metavar="OUT",
+                    help="write NEW findings (both tiers) as "
+                         "deterministic SARIF 2.1.0 to OUT")
+    ap.add_argument("--rules", metavar="A,B",
+                    help="scope the run to the named rule ids "
+                         "(comma-separated)")
     ap.add_argument("--fail-on-new", action="store_true",
                     help="exit 1 on findings not in the baseline "
                          "(explicit form of the default)")
     ap.add_argument("--write-baseline", metavar="PATH",
                     help="write the current NEW findings as a baseline "
                          "skeleton (justifications must be filled in)")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="rewrite the baseline file dropping entries "
+                         "that no longer fire")
     ap.add_argument("--no-docs-rule", action="store_true",
                     help="skip the repo-level doc-drift rule (fixture "
                          "trees have no docs/)")
@@ -78,18 +93,32 @@ def main(argv: Optional[List[str]] = None,
                   file=sys.stderr)
             return 2
 
+    only = None
+    if args.rules:
+        only = {r.strip() for r in args.rules.split(",") if r.strip()}
+        known = set(all_rule_ids(include_docs=True))
+        unknown = only - known
+        if unknown:
+            print(f"lint.py: unknown rule id(s): "
+                  f"{', '.join(sorted(unknown))} (known: "
+                  f"{', '.join(sorted(known))})", file=sys.stderr)
+            return 2
+
     findings = run_paths(
         paths, root,
-        rules=default_rules(include_docs=not args.no_docs_rule))
+        rules=default_rules(include_docs=not args.no_docs_rule,
+                            only=only))
     new, stale = baseline.split(findings)
-    # staleness is only meaningful for files this run actually looked
-    # at — a scoped run must not report out-of-scope entries as stale
+    # staleness is only meaningful for files (and, under --rules,
+    # rules) this run actually looked at — a scoped run must not
+    # report out-of-scope entries as stale
     scope_rels = [os.path.relpath(p, root).replace(os.sep, "/")
                   for p in paths]
     stale = [e for e in stale
              if any(e.get("file", "") == r
                     or e.get("file", "").startswith(r.rstrip("/") + "/")
-                    for r in scope_rels)]
+                    for r in scope_rels)
+             and (only is None or e.get("rule") in only)]
 
     if args.write_baseline:
         with open(args.write_baseline, "w", encoding="utf-8") as f:
@@ -97,6 +126,35 @@ def main(argv: Optional[List[str]] = None,
         print(f"wrote {len(new)} baseline entries to "
               f"{args.write_baseline} — fill in the justifications",
               file=sys.stderr)
+
+    if args.prune_baseline:
+        if baseline_path is None:
+            print("lint.py: --prune-baseline needs a baseline file",
+                  file=sys.stderr)
+            return 2
+        stale_keys = {(e["rule"], e["file"], e.get("context", ""),
+                       e["message"]) for e in stale}
+        kept = [e for e in baseline.entries
+                if (e["rule"], e["file"], e.get("context", ""),
+                    e["message"]) not in stale_keys]
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            f.write(json.dumps(
+                {"entries": sorted(
+                    kept, key=lambda e: (e["rule"], e["file"],
+                                         e.get("context", ""),
+                                         e["message"]))},
+                indent=2, sort_keys=True) + "\n")
+        print(f"lint.py: pruned {len(stale)} stale baseline "
+              f"entr{'y' if len(stale) == 1 else 'ies'} from "
+              f"{baseline_path}", file=sys.stderr)
+        # the pruned entries are gone from the file — the always-on
+        # stale count below must describe the post-prune state
+        stale = []
+
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as f:
+            f.write(to_sarif(new, all_rule_ids(
+                include_docs=not args.no_docs_rule)))
 
     if args.json:
         sys.stdout.write(to_json(new))
@@ -107,6 +165,10 @@ def main(argv: Optional[List[str]] = None,
         summary = (f"tpulint: {len(new)} finding(s)"
                    + (f" ({n_base} baselined)" if n_base else ""))
         print(summary if new or n_base else "tpulint: clean")
+    # the stale count prints on EVERY run so a shrinking baseline is
+    # visible without --prune-baseline
+    print(f"lint.py: {len(stale)} stale baseline "
+          f"entr{'y' if len(stale) == 1 else 'ies'}", file=sys.stderr)
     for e in stale:
         print(f"lint.py: stale baseline entry (no longer fires): "
               f"{e['rule']} in {e['file']}: {e['message']}",

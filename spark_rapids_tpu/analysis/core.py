@@ -77,6 +77,45 @@ def to_json(findings: Sequence[Finding]) -> str:
                       indent=2, sort_keys=True) + "\n"
 
 
+def to_sarif(findings: Sequence[Finding],
+             rule_ids: Sequence[str]) -> str:
+    """SARIF 2.1.0 for CI/editor annotations.  Deterministic like
+    ``to_json``: sorted results and rule metadata, no timestamps or
+    absolute paths — byte-identical across runs over an unchanged
+    tree."""
+    results = []
+    for f in sorted(findings):
+        msg = f.message + (f" [{f.context}]" if f.context else "")
+        if f.hint:
+            msg += f"\nhint: {f.hint}"
+        results.append({
+            "ruleId": f.rule,
+            "level": "warning",
+            "message": {"text": msg},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.file},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": f.col + 1},
+                },
+            }],
+        })
+    sarif = {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpulint",
+                "informationUri":
+                    "docs/static_analysis.md",
+                "rules": [{"id": rid} for rid in sorted(set(rule_ids))],
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(sarif, indent=2, sort_keys=True) + "\n"
+
+
 class Baseline:
     """Grandfathered findings.  Every entry MUST carry a non-empty
     ``justification`` — the shipped baseline is empty-or-justified by
@@ -483,9 +522,16 @@ def _collect_files(paths: Sequence[str]) -> Iterable[str]:
                         yield os.path.join(dirpath, fn)
 
 
-def default_rules(include_docs: bool = True) -> List[object]:
+def default_rules(include_docs: bool = True,
+                  only: Optional[Set[str]] = None) -> List[object]:
+    """The full rule set: tiers A/B (invariants + lockset), the
+    tracelint tier C (trace-safety over jitted regions), and the
+    repo-level doc-drift rule.  ``only`` scopes to the named rule ids
+    (the shared call-graph builder rides along whenever any tier-C rule
+    is requested)."""
     from spark_rapids_tpu.analysis import rules_invariants as RI
     from spark_rapids_tpu.analysis import rules_lockset as RL
+    from spark_rapids_tpu.analysis import rules_trace as RT
 
     rules: List[object] = [
         RI.CounterWriteRule(),
@@ -497,11 +543,35 @@ def default_rules(include_docs: bool = True) -> List[object]:
         RL.LockMixedGuardRule(),
         RL.LockOrderRule(),
     ]
+    rules.extend(RT.trace_rules())
     if include_docs:
         from spark_rapids_tpu.analysis import rules_docs as RD
 
         rules.append(RD.DocDriftRule())
+    if only is not None:
+        keep = [r for r in rules if getattr(r, "id", "") in only]
+        # tier-C rules consume the shared builder — keep it FIRST
+        if any(getattr(r, "id", "") in TRACE_RULE_IDS for r in keep):
+            builder = next(r for r in rules if r.id == "_callgraph")
+            if builder not in keep:
+                keep.insert(0, builder)
+        rules = keep
     return rules
+
+
+# tier-C rule ids (the tracelint tier) — used by --rules scoping and
+# the doc-drift vocabulary check
+TRACE_RULE_IDS = frozenset((
+    "trace-conf-read", "trace-side-effect", "trace-host-sync",
+    "trace-branch", "trace-closure-state", "trace-split-sync",
+    "retrace-key",
+))
+
+
+def all_rule_ids(include_docs: bool = True) -> List[str]:
+    """Every user-facing rule id in the default set, sorted."""
+    return sorted(getattr(r, "id") for r in default_rules(include_docs)
+                  if not getattr(r, "id", "").startswith("_"))
 
 
 def run_paths(paths: Sequence[str], repo_root: str,

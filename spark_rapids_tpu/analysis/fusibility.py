@@ -1,0 +1,261 @@
+"""Fusion-safety classification — tracelint's answer to "which kernels
+can be inlined into a larger traced region?"
+
+The ROADMAP's whole-plan-fusion item needs a static answer per exec:
+collapsing a pipeline-able subtree into ONE jitted program means every
+member kernel's body runs under a shared trace, so anything that is
+merely *suspicious* standalone (a trace-time side effect rescued by a
+per-exec aux store, a host sync that happens to sit at a program
+boundary) becomes *wrong* when inlined.  This module replays the
+tracelint region rules over every traced kernel — pragma suppression
+deliberately IGNORED, because a justified standalone exception is still
+a fusion blocker — and rolls the verdicts up:
+
+* ``fusable`` — the kernel body is pure traced compute; inline freely.
+* ``fusable-with-rewrite(<reason>)`` — inlinable after a mechanical
+  rewrite (hoist the conf read to build time, move the side effect to
+  the dispatch wrapper, make the trace-time aux travel with the fused
+  executable).
+* ``unfusable(<reason>)`` — Python control flow or host syncs on traced
+  values (the trace would freeze or concretize), or no jitted kernel at
+  all (host-side batch plumbing).
+
+The manifest is keyed by the ``plan_key`` operator-class identity —
+``resilience.breaker.plan_key(plan)[0]``, the same ``op_class`` the
+PR 8 calibration store and ``tools/qualify.py`` use — so the fusion
+planner and the qualification report can join it directly.  A second
+section keys by exec CLASS for the execs that exist only at runtime
+(fused stages, ICI shuffles, transitions).  Output is deterministic:
+two runs over an unchanged tree are byte-identical (pinned by
+``tests/test_lint.py``).
+"""
+from __future__ import annotations
+
+import ast
+import json
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.analysis.callgraph import CallGraphRule, _trailing
+from spark_rapids_tpu.analysis.core import Engine
+
+MANIFEST_VERSION = 1
+
+# shared sentinel entry for execs with no jitted kernel anywhere in
+# their class chain
+_HOST_ONLY = {
+    "classification":
+        "unfusable(no-jitted-kernel: host-side batch plumbing)",
+    "kernels": {},
+}
+
+# classification severity order: worst wins in roll-ups
+_SEVERITY = {"fusable": 0, "fusable-with-rewrite": 1, "unfusable": 2}
+
+# per-rule fusion verdicts: (class, reason) — reasons are stable text,
+# part of the byte-identical manifest
+_RULE_VERDICTS = {
+    "trace-host-sync": ("unfusable", "host sync on a traced value"),
+    "trace-branch": ("unfusable",
+                     "Python control flow on a traced value"),
+    "trace-conf-read": ("fusable-with-rewrite",
+                        "conf read must hoist to build time"),
+    "trace-side-effect": ("fusable-with-rewrite",
+                          "side effect must hoist to the call site"),
+    "trace-closure-state": ("fusable-with-rewrite",
+                            "trace-time aux must travel with the fused "
+                            "executable"),
+}
+
+
+class _Capture:
+    """Reporter shim: collects raw rule verdicts, no pragma/baseline
+    filtering — a justified standalone exception still blocks fusion."""
+
+    def __init__(self):
+        self.by_fn: Dict[str, List[str]] = {}
+
+    def report(self, ctx, rule, line, col, message, hint="",
+               context="") -> None:
+        key = f"{ctx.rel}::{context}"
+        self.by_fn.setdefault(key, []).append(rule)
+
+
+def _region_rules(cg: CallGraphRule):
+    from spark_rapids_tpu.analysis import rules_trace as RT
+
+    return [RT.TraceConfReadRule(cg), RT.TraceSideEffectRule(cg),
+            RT.TraceHostSyncRule(cg), RT.TraceBranchRule(cg),
+            RT.TraceClosureStateRule(cg)]
+
+
+def _convert_map(engine: Engine) -> Dict[str, List[str]]:
+    """plan-class name -> exec-class names, parsed statically from the
+    ``isinstance(plan, PN.X)`` branches of ``overrides._convert_node``
+    (and the module body around it) so the mapping cannot drift from
+    the code that does the converting."""
+    out: Dict[str, List[str]] = {}
+    for ctx in engine._ctxs:
+        if not ctx.rel.endswith("overrides/overrides.py"):
+            continue
+        fn = None
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef) \
+                    and node.name == "_convert_node":
+                fn = node
+                break
+        if fn is None:
+            continue
+        for st in ast.walk(fn):
+            if not isinstance(st, ast.If):
+                continue
+            plans = _isinstance_plan_classes(st.test)
+            if not plans:
+                continue
+            execs = sorted({
+                _trailing(c.func) for c in ast.walk(st)
+                if isinstance(c, ast.Call)
+                and _is_exec_ctor(_trailing(c.func))})
+            for p in plans:
+                if execs:
+                    cur = out.setdefault(p, [])
+                    cur.extend(e for e in execs if e not in cur)
+    return out
+
+
+def _isinstance_plan_classes(test: ast.AST) -> List[str]:
+    for c in ast.walk(test):
+        if isinstance(c, ast.Call) and _trailing(c.func) == "isinstance" \
+                and len(c.args) == 2:
+            second = c.args[1]
+            names = (second.elts if isinstance(second, ast.Tuple)
+                     else [second])
+            return [_trailing(n) for n in names if _trailing(n)]
+    return []
+
+
+def _is_exec_ctor(name: str) -> bool:
+    return name.startswith("Tpu") and name.endswith("Exec")
+
+
+def _worst(classes: List[str]) -> str:
+    if not classes:
+        return "unfusable(no-jitted-kernel: host-side batch plumbing)"
+    return max(sorted(classes),
+               key=lambda c: _SEVERITY[c.split("(", 1)[0]])
+
+
+def build_manifest(repo_root: str,
+                   paths: Optional[List[str]] = None) -> dict:
+    """The fusion-safety manifest for the repo at ``repo_root``."""
+    import os
+
+    cg = CallGraphRule()
+    # only the callgraph pseudo-rule runs in the engine (prescan builds
+    # the graph, its end_run finalizes it); the region rules run below
+    # through the raw capture — pragma/baseline filtering deliberately
+    # bypassed, and no wasted pragma-filtered engine pass
+    engine = Engine(repo_root, [cg])
+    scan = paths or [os.path.join(repo_root, "spark_rapids_tpu")]
+    engine.run(scan)
+    g = cg.graph
+
+    cap = _Capture()
+    rules = _region_rules(cg)
+    for key in sorted(g.traced):
+        info = g.funcs.get(key)
+        if info is None:
+            continue
+        for rule in rules:
+            rule.check(cap, info, g.traced[key], g)
+
+    # kernel verdicts, grouped by the ROOT site's owning exec class
+    kernels_by_class: Dict[str, Dict[str, dict]] = {}
+    for key in sorted(g.traced):
+        info = g.funcs.get(key)
+        if info is None:
+            continue
+        root = g.traced[key]
+        owner = root.owner_class or info.owner_class
+        if not owner:
+            continue
+        fired = sorted(set(cap.by_fn.get(f"{info.rel}::{info.qual}",
+                                         ())))
+        pairs = [(r, _RULE_VERDICTS[r]) for r in fired
+                 if r in _RULE_VERDICTS]
+        if pairs:
+            cls = _worst(sorted(f"{c}({reason})"
+                                for _, (c, reason) in pairs))
+            reasons = sorted(f"{reason} [{r}]"
+                             for r, (_, reason) in pairs)
+        else:
+            cls, reasons = "fusable", []
+        kernels_by_class.setdefault(owner, {})[info.qual] = {
+            "classification": cls,
+            "reasons": reasons,
+            "root": f"{root.rel}:{root.kind}",
+        }
+
+    exec_entries: Dict[str, dict] = {}
+    for cls_name in sorted(kernels_by_class):
+        kernels = kernels_by_class[cls_name]
+        exec_entries[cls_name] = {
+            "classification": _worst(
+                [k["classification"] for k in kernels.values()]),
+            "kernels": dict(sorted(kernels.items())),
+        }
+
+    # subclass execs inherit their base's kernels (TpuProjectExec runs
+    # TpuStageExec's stage program; the join execs share _BaseTpuJoin's)
+    base_names: Dict[str, List[str]] = {}
+    for (rel, cls), bases in g.class_bases.items():
+        base_names.setdefault(cls, []).extend(
+            _trailing(b) for b in bases if _trailing(b))
+
+    def entry_for(cls_name: str, _seen=None) -> dict:
+        _seen = _seen if _seen is not None else set()
+        if cls_name in _seen:
+            return _HOST_ONLY
+        _seen.add(cls_name)
+        if cls_name in exec_entries:
+            return exec_entries[cls_name]
+        for base in base_names.get(cls_name, ()):
+            e = entry_for(base, _seen)
+            if e is not _HOST_ONLY:
+                return e
+        return _HOST_ONLY
+
+    convert = _convert_map(engine)
+    try:
+        from spark_rapids_tpu.overrides.overrides import EXECS
+
+        plan_classes = sorted(c.__name__ for c in EXECS)
+    except Exception:
+        plan_classes = sorted(convert)
+
+    operators: Dict[str, dict] = {}
+    for op in plan_classes:
+        execs = convert.get(op, [])
+        mapped = {e: entry_for(e) for e in execs}
+        if mapped:
+            cls = _worst([m["classification"] for m in mapped.values()])
+        else:
+            cls = ("unfusable(no-device-exec: converts outside the "
+                   "traced kernel set)")
+        operators[op] = {
+            "classification": cls,
+            "execs": {e: m["classification"]
+                      for e, m in sorted(mapped.items())},
+        }
+
+    return {
+        "version": MANIFEST_VERSION,
+        "identity": ("op_class — resilience.breaker.plan_key(plan)[0], "
+                     "the calibration-store operator class"),
+        "operators": operators,
+        "execs": exec_entries,
+    }
+
+
+def manifest_json(manifest: dict) -> str:
+    """Deterministic serialization: sorted keys, no timestamps."""
+    return json.dumps(manifest, indent=2, sort_keys=True) + "\n"

@@ -284,6 +284,24 @@ def doc_drift_problems(repo_root: str) -> List[str]:
     if "ici_shuffle" not in EVENT_SCHEMA:
         problems.append("diagnostics event type 'ici_shuffle' is not "
                         "registered in EVENT_SCHEMA")
+
+    # tracelint (ISSUE 11): every lint rule id and the fusibility
+    # manifest vocabulary must be documented in docs/static_analysis.md
+    from spark_rapids_tpu.analysis.core import all_rule_ids
+
+    sa_md = read("static_analysis.md")
+    for rid in all_rule_ids(include_docs=True):
+        if f"`{rid}`" not in sa_md:
+            problems.append(
+                f"lint rule '{rid}' is not documented in "
+                f"docs/static_analysis.md")
+    for word in ("`fusable`", "`fusable-with-rewrite`", "`unfusable`",
+                 "`op_class`", "fusibility.py", "`--sarif`",
+                 "`--prune-baseline`", "`--rules`"):
+        if word not in sa_md:
+            problems.append(
+                f"tracelint/fusibility vocabulary {word} is not "
+                f"documented in docs/static_analysis.md")
     return problems
 
 

@@ -1,0 +1,670 @@
+"""Tier C — trace-safety rules over every jitted region (tracelint).
+
+Consumes the interprocedural :mod:`callgraph`: every function reachable
+from a ``jax.jit`` / ``tpu_jit`` / ``pallas_call`` / ``shard_map`` /
+``cached_jit_program`` site is a **traced region**, and its parameters
+carry a shallow traced-value taint.  The rules encode the bug classes
+the jit boundary actually produced in this repo:
+
+* ``trace-conf-read``     — ``get_conf()`` (or an ambient-conf helper)
+  inside traced code: the value BAKES into the trace at compile time,
+  and a session changing the setting keeps executing the stale program
+  unless the key happens to be in the program fingerprint.  Hoist the
+  read to build time and make it part of the cache key (``conf_fp``).
+* ``trace-side-effect``   — counter bumps, diagnostics/telemetry
+  recording, lock acquisition, or wall-clock reads inside traced code:
+  they run ONCE at trace time (so counts/timings lie) and never again
+  on cache hits.
+* ``trace-host-sync``     — ``float()``/``int()``/``bool()`` /
+  ``.item()``/``.tolist()``/``np.asarray`` on a traced value, or
+  ``device_get``/``block_until_ready`` anywhere in traced code: a
+  concretization error at trace time on TPU, a hidden device round
+  trip when the same helper runs eagerly.
+* ``trace-branch``        — Python ``if``/``while`` on a traced value:
+  the branch freezes at trace time (or raises
+  ``TracerBoolConversionError``); use ``jnp.where``/``lax.cond``.
+* ``trace-closure-state`` — traced code reading (by subscript) or
+  mutating a mutable container captured from an enclosing scope: the
+  state is baked at trace time and silently stale on every cache hit
+  (the ``offset_holder``/``msgs_store`` pattern — legal only with a
+  justifying pragma, because the aux must travel WITH the executable).
+* ``retrace-key``         — unstable Python values feeding a program
+  cache key (``fingerprint``/``cached_program``/``cached_jit_program``
+  key parts): f-strings, ``id()``/``hash()``/``repr()``, wall-clock /
+  random / pid reads, and set displays (repr order is PYTHONHASHSEED-
+  dependent, so a persisted AOT key misses across processes).
+
+Taint limits (shallow, deliberately under-approximating — see
+docs/static_analysis.md): constructor calls, comprehensions, and
+non-``jnp``/``jax`` user-function returns do NOT propagate taint, so
+``trace-host-sync``/``trace-branch`` trade recall for a near-zero
+false-positive rate; the region rules (conf/side-effect) need no taint
+and carry the recall.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from spark_rapids_tpu.analysis.callgraph import (
+    ARRAY_NAMESPACES,
+    CallGraph,
+    CallGraphRule,
+    FuncInfo,
+    RootSite,
+    _root_name,
+    _target_names,
+    _trailing,
+    own_body_nodes,
+)
+from spark_rapids_tpu.analysis.core import Engine
+from spark_rapids_tpu.analysis.rules_invariants import MUTATORS
+
+CONF_READERS = frozenset(("get_conf", "ambient_conf", "current_conf"))
+COUNTER_CALLS = frozenset(("bump", "bump_unattributed", "count_h2d"))
+DIAG_CALLS = frozenset(("record_event", "cache_event", "add_event",
+                        "observe", "record", "record_many", "launch",
+                        "d2h"))
+CLOCK_CALLS = frozenset(("perf_counter", "perf_counter_ns", "monotonic",
+                         "monotonic_ns", "process_time", "time_ns"))
+LOCK_CALLS = frozenset(("acquire", "release"))
+SYNC_CALLS = frozenset(("device_get", "block_until_ready"))
+UNSTABLE_KEY_CALLS = frozenset((
+    "id", "hash", "repr", "time", "time_ns", "monotonic", "monotonic_ns",
+    "perf_counter", "perf_counter_ns", "uuid1", "uuid4", "getpid",
+    "get_ident", "random", "randint", "randrange", "token_hex",
+    "getrandbits",
+))
+
+
+def _provenance(root: RootSite) -> str:
+    """Line-free root description — part of the finding message, so it
+    must survive unrelated edits (baseline identity)."""
+    where = root.owner_class or root.rel
+    return f"traced via {root.kind} in {where}"
+
+
+class _TraceRegionRule:
+    """Base: iterate every traced function once, deterministically."""
+
+    node_types = ()
+
+    def __init__(self, cg: CallGraphRule):
+        self._cg = cg
+
+    def end_run(self, engine: Engine) -> None:
+        g = self._cg.graph
+        g.finalize()
+        for key in sorted(g.traced):
+            info = g.funcs.get(key)
+            if info is None:
+                continue
+            self.check(engine, info, g.traced[key], g)
+
+    def check(self, engine: Engine, info: FuncInfo, root: RootSite,
+              g: CallGraph) -> None:
+        raise NotImplementedError
+
+
+class TraceConfReadRule(_TraceRegionRule):
+    """Conf reads bake at trace time — the stale-ambient-conf class."""
+
+    id = "trace-conf-read"
+    HINT = ("read the conf at BUILD time (outside the traced function), "
+            "pass the value in as a closure constant, and include it in "
+            "the program key (conf_fp already fingerprints the ambient "
+            "settings)")
+
+    def check(self, engine, info, root, g):
+        for node in own_body_nodes(info.node):
+            if isinstance(node, ast.Call) \
+                    and _trailing(node.func) in CONF_READERS:
+                engine.report(
+                    info.ctx, self.id, node.lineno, node.col_offset,
+                    f"conf read ({_trailing(node.func)}) inside traced "
+                    f"code ({_provenance(root)}) bakes the setting into "
+                    f"the compiled program", self.HINT, info.qual)
+
+
+class TraceSideEffectRule(_TraceRegionRule):
+    """Side effects inside a trace run once at trace time, then never
+    again on cache hits — counters lie, locks guard nothing."""
+
+    id = "trace-side-effect"
+    HINT = ("hoist the side effect out of the traced function (wrap the "
+            "CALL site, not the trace); counters/telemetry belong in "
+            "the dispatch wrapper, locks around the jit call")
+
+    def _lock_ident(self, info: FuncInfo, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+        if isinstance(expr, ast.Name) \
+                and expr.id in info.ctx.module_locks:
+            return expr.id
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and info.owner_class
+                and expr.attr in info.ctx.class_locks.get(
+                    info.owner_class, ())):
+            return expr.attr
+        return None
+
+    def check(self, engine, info, root, g):
+        for node in own_body_nodes(info.node):
+            what = None
+            if isinstance(node, ast.Call):
+                name = _trailing(node.func)
+                if name in COUNTER_CALLS:
+                    what = f"counter write {name}()"
+                elif name in DIAG_CALLS:
+                    what = f"diagnostics/telemetry call {name}()"
+                elif name in CLOCK_CALLS:
+                    what = f"wall-clock read {name}()"
+                elif name in LOCK_CALLS:
+                    what = f"lock {name}()"
+                elif name == "print":
+                    what = "print()"
+                elif (isinstance(node.func, ast.Attribute)
+                        and _trailing(node.func.value) == "COUNTERS"
+                        and name in MUTATORS):
+                    what = f"COUNTERS.{name}()"
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Subscript) \
+                            and _trailing(t.value) == "COUNTERS":
+                        what = "COUNTERS[...] write"
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    lk = self._lock_ident(info, item.context_expr)
+                    if lk is not None:
+                        what = f"lock acquisition `with {lk}:`"
+            if what is not None:
+                engine.report(
+                    info.ctx, self.id, node.lineno, node.col_offset,
+                    f"{what} inside traced code ({_provenance(root)}) "
+                    f"runs at trace time only — never on cache hits",
+                    self.HINT, info.qual)
+
+
+class TraceHostSyncRule(_TraceRegionRule):
+    """Implicit host syncs on traced values: trace-time concretization
+    errors on TPU, hidden device round trips on eager twins."""
+
+    id = "trace-host-sync"
+    HINT = ("keep the value on device (jnp ops) or return it and "
+            "materialize OUTSIDE the traced function under "
+            "`with sync_event():`")
+
+    def check(self, engine, info, root, g):
+        local = g.local_taint(info.key)
+        for node in own_body_nodes(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            what = None
+            fn = node.func
+            name = _trailing(fn)
+            if name in SYNC_CALLS:
+                what = f"{name}()"
+            elif (isinstance(fn, ast.Name)
+                    and fn.id in ("float", "int", "bool") and node.args
+                    and g.expr_tainted(node.args[0], local)):
+                what = f"{fn.id}() on a traced value"
+            elif (name in ("item", "tolist")
+                    and isinstance(fn, ast.Attribute)
+                    and g.expr_tainted(fn.value, local)):
+                what = f".{name}() on a traced value"
+            elif (name in ("asarray", "array")
+                    and _root_name(fn) in ("np", "numpy") and node.args
+                    and g.expr_tainted(node.args[0], local)):
+                what = f"np.{name}() on a traced value"
+            if what is not None:
+                engine.report(
+                    info.ctx, self.id, node.lineno, node.col_offset,
+                    f"implicit host sync: {what} inside traced code "
+                    f"({_provenance(root)})", self.HINT, info.qual)
+
+
+class TraceBranchRule(_TraceRegionRule):
+    """Python control flow on traced values freezes at trace time."""
+
+    id = "trace-branch"
+    HINT = ("replace with jnp.where / jax.lax.cond / a masked "
+            "computation — Python control flow evaluates ONCE at trace "
+            "time, not per element or per call")
+
+    def check(self, engine, info, root, g):
+        local = g.local_taint(info.key)
+        for node in own_body_nodes(info.node):
+            if isinstance(node, (ast.If, ast.While)) \
+                    and g.expr_tainted(node.test, local):
+                kw = "if" if isinstance(node, ast.If) else "while"
+                engine.report(
+                    info.ctx, self.id, node.lineno, node.col_offset,
+                    f"Python `{kw}` on a traced value inside traced "
+                    f"code ({_provenance(root)})", self.HINT, info.qual)
+
+
+class TraceClosureStateRule(_TraceRegionRule):
+    """Mutable enclosing-scope state read/written from traced code is
+    baked at trace time and stale on every cache hit."""
+
+    id = "trace-closure-state"
+    HINT = ("pass the value as a traced argument (or a static key part) "
+            "instead of closing over mutable state; a deliberate "
+            "trace-time aux store (the msgs_store pattern) needs a "
+            "justifying pragma and must travel WITH the executable")
+
+    def _bindings(self, info: FuncInfo) -> Set[str]:
+        node = info.node
+        if isinstance(node, ast.Lambda):
+            return set(info.params)
+        bound = set(info.params)
+        for sub in own_body_nodes(node):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                                ast.For, ast.NamedExpr)):
+                targets = (sub.targets if isinstance(sub, ast.Assign)
+                           else [sub.target])
+                for t in targets:
+                    for n in _target_names(t):
+                        bound.add(n)
+            elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(sub.name)
+            elif isinstance(sub, ast.withitem) and sub.optional_vars:
+                for n in _target_names(sub.optional_vars):
+                    bound.add(n)
+            elif isinstance(sub, (ast.Import, ast.ImportFrom)):
+                for a in sub.names:
+                    bound.add(a.asname or a.name.split(".")[0])
+            elif isinstance(sub, ast.comprehension):
+                for n in _target_names(sub.target):
+                    bound.add(n)
+        return bound
+
+    def _enclosing_bindings(self, info: FuncInfo,
+                            g: CallGraph) -> Set[str]:
+        """Names bound in lexically enclosing FUNCTIONS (not module
+        scope — module-level state is rules_invariants' domain)."""
+        out: Set[str] = set()
+        scope = info.scope[:-1]
+        while scope:
+            key = f"{info.rel}::" + ".".join(scope)
+            enc = g.funcs.get(key)
+            if enc is not None:
+                out |= self._bindings(enc)
+            scope = scope[:-1]
+        return out
+
+    def check(self, engine, info, root, g):
+        bound = self._bindings(info)
+        closure = self._enclosing_bindings(info, g) - bound
+        if not closure:
+            return
+
+        def is_closure_name(expr) -> Optional[str]:
+            return (expr.id if isinstance(expr, ast.Name)
+                    and expr.id in closure else None)
+
+        for node in own_body_nodes(info.node):
+            what = None
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if (isinstance(fn, ast.Attribute)
+                        and fn.attr in MUTATORS):
+                    n = is_closure_name(fn.value)
+                    if n:
+                        what = f"mutates closure container '{n}' " \
+                               f"(.{fn.attr}())"
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        n = is_closure_name(t.value)
+                        if n:
+                            what = f"writes closure container '{n}[...]'"
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load):
+                n = is_closure_name(node.value)
+                if n:
+                    what = (f"reads closure container '{n}[...]' — the "
+                            f"value bakes at trace time")
+            if what is not None:
+                engine.report(
+                    info.ctx, self.id, node.lineno, node.col_offset,
+                    f"{what} inside traced code ({_provenance(root)})",
+                    self.HINT, info.qual)
+
+
+# ---------------------------------------------------------------------------
+# trace-split-sync — N round trips where one sync_get suffices
+# ---------------------------------------------------------------------------
+
+def _chain_repr(expr: ast.AST) -> str:
+    """``self._jit`` / ``cache`` as a stable string, "" if not a plain
+    name/attribute chain."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if not isinstance(expr, ast.Name):
+        return ""
+    parts.append(expr.id)
+    return ".".join(reversed(parts))
+
+
+def _contains_jit_call(expr: ast.AST) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call) and (
+                _trailing(n.func) in ("jit", "tpu_jit",
+                                      "cached_jit_program")):
+            return True
+        if isinstance(n, ast.Attribute) and n.attr == "jitted":
+            return True
+    return False
+
+
+class TraceSplitSyncRule:
+    """Materializing the components of ONE jitted program result as
+    separate ``int()``/``float()``/``bool()``/``.item()`` calls outside
+    ``sync_event`` is N device round trips where one ``sync_get`` is a
+    single logical sync — the per-column-host-syncs bug class (PR 9's
+    serializer fix) recurring at the jit boundary.  Fires on two or
+    more split materializations of one result, or any materialization
+    of a per-element loop over a result."""
+
+    id = "trace-split-sync"
+    node_types = (ast.Assign, ast.Call, ast.For)
+    HINT = ("fetch the whole result in ONE logical round trip: "
+            "`host = sync_get((count,) + tuple(flags))` — then branch "
+            "on the host values")
+    MATERIALIZERS = frozenset(("int", "float", "bool"))
+    METHOD_MATS = frozenset(("item", "tolist"))
+
+    def begin_file(self, ctx) -> None:
+        # flat per-file maps: closure reads (`run` over `_build`'s
+        # `jitted`) resolve naturally; rebinding overwrites
+        self._containers: Set[str] = set()
+        self._callables: Set[str] = set()
+        self._groups: Dict[str, Tuple[int, int]] = {}
+        self._loop_names: Set[str] = set()
+        # group id -> [(node, loop_derived, qual)]
+        self._mats: Dict[Tuple[int, int], List] = {}
+
+    def _bind(self, targets: List[ast.AST], value: ast.AST,
+              node: ast.AST) -> None:
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        tuple_targets = [t for t in targets
+                         if isinstance(t, (ast.Tuple, ast.List))]
+        # container store: self._jit[key] = (tpu_jit(fn), msgs)
+        for t in targets:
+            if isinstance(t, ast.Subscript) and _contains_jit_call(value):
+                rep = _chain_repr(t.value)
+                if rep:
+                    self._containers.add(rep)
+        is_jit = _contains_jit_call(value)
+        from_container = (isinstance(value, ast.Subscript)
+                          and _chain_repr(value.value)
+                          in self._containers)
+        is_result = (isinstance(value, ast.Call)
+                     and isinstance(value.func, ast.Name)
+                     and value.func.id in self._callables)
+        gid = (node.lineno, node.col_offset)
+        for name in names:
+            self._clear(name)
+            if is_jit or from_container:
+                self._callables.add(name)
+            elif is_result:
+                self._groups[name] = gid
+        for tt in tuple_targets:
+            elts = [e for e in tt.elts]
+            vals = (value.elts if isinstance(value, (ast.Tuple, ast.List))
+                    and len(value.elts) == len(elts) else None)
+            for i, e in enumerate(elts):
+                if not isinstance(e, ast.Name):
+                    continue
+                self._clear(e.id)
+                ev = vals[i] if vals is not None else None
+                if ev is not None and _contains_jit_call(ev):
+                    self._callables.add(e.id)
+                elif vals is None and (is_jit or from_container) \
+                        and i == 0:
+                    # `jitted, aux = self._jit[key]` — the callable is
+                    # the first element by the store-site convention
+                    self._callables.add(e.id)
+                elif vals is None and is_result:
+                    self._groups[e.id] = gid
+
+    def _clear(self, name: str) -> None:
+        self._callables.discard(name)
+        self._groups.pop(name, None)
+        self._loop_names.discard(name)
+
+    def visit(self, node: ast.AST, walk) -> None:
+        if isinstance(node, ast.Assign):
+            self._bind(list(node.targets), node.value, node)
+            return
+        if isinstance(node, ast.For):
+            src = None
+            for n in ast.walk(node.iter):
+                if isinstance(n, ast.Name) and n.id in self._groups:
+                    src = self._groups[n.id]
+                    break
+            if src is not None:
+                for t in ([node.target]
+                          if isinstance(node.target, ast.Name)
+                          else getattr(node.target, "elts", [])):
+                    if isinstance(t, ast.Name):
+                        self._groups[t.id] = src
+                        self._loop_names.add(t.id)
+            return
+        # Call: a materialization of a grouped name?
+        fn = node.func
+        name = _trailing(fn)
+        arg = None
+        if isinstance(fn, ast.Name) and name in self.MATERIALIZERS \
+                and node.args and isinstance(node.args[0], ast.Name):
+            arg = node.args[0].id
+        elif name in self.METHOD_MATS and isinstance(fn, ast.Attribute) \
+                and isinstance(fn.value, ast.Name):
+            arg = fn.value.id
+        if arg is None or arg not in self._groups:
+            return
+        if walk.in_sync_event():
+            return               # one accounted logical region
+        self._mats.setdefault(self._groups[arg], []).append(
+            (node, arg in self._loop_names, walk.qualname()))
+
+    def end_file(self, walk) -> None:
+        for gid in sorted(self._mats):
+            mats = self._mats[gid]
+            loops = [m for m in mats if m[1]]
+            if len(mats) < 2 and not loops:
+                continue
+            node, in_loop, qual = mats[0]
+            what = ("per-element loop materialization"
+                    if loops else
+                    f"{len(mats)} split host materializations")
+            walk.engine.report(
+                walk.ctx, self.id, node.lineno, node.col_offset,
+                f"{what} of one jitted program result outside "
+                f"sync_event — each is a device round trip per batch",
+                self.HINT, qual)
+
+
+# ---------------------------------------------------------------------------
+# retrace-key — interprocedural backward slice from key-part sinks
+# ---------------------------------------------------------------------------
+
+class RetraceKeyRule:
+    """Unstable Python values feeding a program cache key: every
+    spurious difference is a retrace (minutes of XLA work), every
+    cross-process instability defeats the persistent AOT cache, and an
+    ``id()`` can be REUSED after GC — aliasing two different programs
+    to one key is silent wrong-answer territory.
+
+    Key material is sliced BACKWARD from the sinks through the call
+    graph (bounded depth): a local name follows its assignment, a
+    param follows every resolved caller's argument, and a call follows
+    the callee's return expressions — so an unstable value laundered
+    through a helper (``_agg_tag`` returning ``("id", id(agg))``) is
+    still caught at its construction site."""
+
+    id = "retrace-key"
+    node_types = ()
+    KEY_SINKS = {"fingerprint": None,        # every arg is key material
+                 "cached_program": 0, "cached_jit_program": 0}
+    HINT = ("feed the key stable, order-independent values: sorted "
+            "tuples of primitives; never f-strings of objects, "
+            "id()/hash()/repr(), clocks, randomness, or raw set reprs "
+            "(set order is PYTHONHASHSEED-dependent across processes)")
+    MAX_HOPS = 4
+
+    def __init__(self, cg: CallGraphRule):
+        self._cg = cg
+
+    def end_run(self, engine: Engine) -> None:
+        g = self._cg.graph
+        g.finalize()
+        self._reported: Set[Tuple[str, int, int]] = set()
+        for key in sorted(g.funcs):
+            info = g.funcs[key]
+            body = (own_body_nodes(info.node)
+                    if not isinstance(info.node, ast.Lambda)
+                    else ast.walk(info.node.body))
+            for node in body:
+                if not isinstance(node, ast.Call):
+                    continue
+                idx = self.KEY_SINKS.get(_trailing(node.func), -1)
+                if idx == -1:
+                    continue
+                exprs = (list(node.args) if idx is None else
+                         [node.args[idx]] if idx < len(node.args)
+                         else [])
+                for e in exprs:
+                    self._slice(engine, g, info, e, self.MAX_HOPS)
+
+    def _slice(self, engine: Engine, g: CallGraph, info: FuncInfo,
+               expr: ast.AST, hops: int) -> None:
+        """Scan ``expr`` (evaluated inside ``info``) for unstable
+        constructs, following names/params/calls up to ``hops``."""
+        stack = [expr]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.Call):
+                cname = _trailing(n.func)
+                if cname == "sorted":
+                    continue     # sorted(...) stabilizes its subtree
+                if cname in UNSTABLE_KEY_CALLS and not (
+                        cname == "repr" and n.args
+                        and isinstance(n.args[0], ast.Constant)):
+                    self._report(engine, info, n,
+                                 f"unstable call {cname}() in program "
+                                 f"key parts")
+                elif cname in ("set", "frozenset"):
+                    self._report(engine, info, n,
+                                 f"{cname}() in program key parts: repr "
+                                 f"order is hash-dependent")
+                elif hops > 0:
+                    # follow into the callee's returns: a helper that
+                    # RETURNS key material is part of the key
+                    desc = g._fn_desc(info.ctx, n.func, info.scope[:-1],
+                                      info.owner_class)
+                    if desc is not None and desc[0] == "name":
+                        # names resolve against the function's OWN
+                        # scope (nested defs included), like call recs
+                        desc = ("name", info.rel, info.scope, desc[3])
+                    callee = (g.resolve(desc) if desc is not None
+                              else None)
+                    if callee is not None:
+                        self._slice_returns(engine, g, callee, hops - 1)
+            elif isinstance(n, ast.JoinedStr):
+                if any(isinstance(v, ast.FormattedValue)
+                       for v in n.values):
+                    self._report(engine, info, n,
+                                 "f-string in program key parts bakes "
+                                 "object reprs into the fingerprint")
+                continue         # don't descend into formatted values
+            elif isinstance(n, (ast.Set, ast.SetComp)):
+                self._report(engine, info, n,
+                             "set display in program key parts: repr "
+                             "order is hash-dependent")
+            elif isinstance(n, ast.Name) and hops > 0:
+                self._slice_name(engine, g, info, n.id, hops - 1)
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _slice_name(self, engine: Engine, g: CallGraph, info: FuncInfo,
+                    name: str, hops: int) -> None:
+        """A name in key material: follow its local assignment, or —
+        when it is a parameter — every resolved caller's argument."""
+        node = info.node
+        if not isinstance(node, ast.Lambda):
+            for st in own_body_nodes(node):
+                if isinstance(st, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == name
+                        for t in st.targets):
+                    self._slice(engine, g, info, st.value, hops)
+        if name not in info.params:
+            return
+        pos = info.params.index(name)
+        for caller in sorted(g.resolved_calls):
+            for callee, rec in g.resolved_calls[caller]:
+                if callee != info.key:
+                    continue
+                cinfo = g.funcs.get(caller)
+                if cinfo is None:
+                    continue
+                apos = pos - (info.receiver_params()
+                              if rec.desc[0] in ("self", "objattr")
+                              else 0)
+                if 0 <= apos < len(rec.args):
+                    self._slice(engine, g, cinfo, rec.args[apos], hops)
+                else:
+                    for kw in rec.keywords:
+                        if kw.arg == name:
+                            self._slice(engine, g, cinfo, kw.value,
+                                        hops)
+
+    def _slice_returns(self, engine: Engine, g: CallGraph, callee: str,
+                       hops: int) -> None:
+        info = g.funcs.get(callee)
+        if info is None:
+            return
+        if isinstance(info.node, ast.Lambda):
+            self._slice(engine, g, info, info.node.body, hops)
+            return
+        for st in own_body_nodes(info.node):
+            if isinstance(st, ast.Return) and st.value is not None:
+                self._slice(engine, g, info, st.value, hops)
+
+    def _report(self, engine: Engine, info: FuncInfo, node: ast.AST,
+                msg: str) -> None:
+        # the sink implementations are the canonicalization boundary:
+        # fingerprint()'s own repr-of-vetted-parts is the digest
+        # MECHANISM, not key material
+        if info.qual.split(".")[-1] in self.KEY_SINKS:
+            return
+        # one finding per construction site even when the value feeds
+        # several sinks (helper return + direct use)
+        site = (info.rel, node.lineno, node.col_offset)
+        if site in self._reported:
+            return
+        self._reported.add(site)
+        engine.report(info.ctx, self.id, node.lineno, node.col_offset,
+                      msg, self.HINT, info.qual)
+
+
+def trace_rules() -> List[object]:
+    """The tracelint tier: shared call-graph builder + its consumers +
+    the per-file split-sync rule.  The builder must stay FIRST."""
+    cg = CallGraphRule()
+    return [cg,
+            TraceConfReadRule(cg),
+            TraceSideEffectRule(cg),
+            TraceHostSyncRule(cg),
+            TraceBranchRule(cg),
+            TraceClosureStateRule(cg),
+            TraceSplitSyncRule(),
+            RetraceKeyRule(cg)]

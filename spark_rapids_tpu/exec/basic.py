@@ -18,7 +18,7 @@ from __future__ import annotations
 from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
 import jax
-from spark_rapids_tpu.perfcounters import tpu_jit
+from spark_rapids_tpu.perfcounters import sync_get, tpu_jit
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
@@ -187,10 +187,15 @@ class TpuStageExec(TpuExec):
             # force the EAGER path — under jit the closure value would be
             # baked at trace time, but jitted stages never contain them
             ctx = EvalContext(batch, ansi=ansi,
+                              # tpulint: disable=trace-closure-state
+                              # (eager-only read, per the comment above)
                               row_offset=offset_holder[0])
             for op in ops:
                 batch = op.apply(ctx, batch)
+            # tpulint: disable=trace-closure-state (deliberate trace-time
+            # aux: the store travels WITH the executable as entry.aux)
             msgs_store.clear()
+            # tpulint: disable=trace-closure-state (same aux store)
             msgs_store.extend(m for _, m in ctx.error_flags)
             flags = tuple(jnp.any(f) for f, _ in ctx.error_flags)
             return batch.columns, jnp.asarray(batch.num_rows), flags
@@ -237,10 +242,15 @@ class TpuStageExec(TpuExec):
         def run(batch: ColumnarBatch) -> ColumnarBatch:
             cols, count, flags = jitted(
                 tuple(batch.columns), jnp.int32(batch.num_rows))
-            for f, m in zip(flags, list(msgs_store)):
-                if bool(f):
+            # row count + every ANSI error flag in ONE logical round
+            # trip — a per-flag bool() was a device sync per flag per
+            # batch (tracelint: trace-split-sync)
+            host = sync_get((count,) + tuple(flags))
+            for f, m in zip(host[1:], list(msgs_store)):
+                if f:
                     raise SparkArithmeticException(m)
-            return ColumnarBatch(list(cols), int(count), self._out_schema)
+            return ColumnarBatch(list(cols), int(host[0]),
+                                 self._out_schema)
 
         return run
 

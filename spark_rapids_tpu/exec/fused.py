@@ -25,6 +25,8 @@ machinery) — correctness is identical either way.
 """
 from __future__ import annotations
 
+import itertools
+import threading
 from typing import Iterator, List, Optional
 
 import jax
@@ -58,6 +60,14 @@ def _mask_col(c: DeviceColumn, keep) -> DeviceColumn:
     return DeviceColumn(c.dtype, c.validity & keep, data=c.data,
                         chars=c.chars, lengths=c.lengths,
                         elem_valid=c.elem_valid)
+
+
+# process-unique tags for unfingerprintable agg variants (never reused,
+# unlike id(), which the allocator recycles after GC); the lock makes
+# the lazy pin-on-object init atomic — two concurrent collects sharing
+# one agg must agree on the tag or the loser retraces forever
+_PRIVATE_TAGS = itertools.count()
+_PRIVATE_TAG_LOCK = threading.Lock()
 
 
 class TpuJoinAggFusedExec(TpuExec):
@@ -97,10 +107,22 @@ class TpuJoinAggFusedExec(TpuExec):
 
     def _agg_tag(self, agg):
         """Stable registry identity for the agg variant a key closes over
-        (self.agg or its PARTIAL/FINAL twins) — replaces id(agg), which
-        never matches across exec instances."""
+        (self.agg or its PARTIAL/FINAL twins).  An unfingerprintable agg
+        gets a process-unique tag PINNED on the object: an ``id()`` here
+        could be reused after GC, silently aliasing two different aggs
+        to one registry program — and the private marker also forces the
+        key out of the shared registry (see ``_cached``)."""
         fpp = agg._program_fp()
-        return fpp if fpp is not None else ("id", id(agg))
+        if fpp is not None:
+            return fpp
+        tag = getattr(agg, "_joinagg_private_tag", None)
+        if tag is None:
+            with _PRIVATE_TAG_LOCK:
+                tag = getattr(agg, "_joinagg_private_tag", None)
+                if tag is None:
+                    tag = ("private", next(_PRIVATE_TAGS))
+                    agg._joinagg_private_tag = tag
+        return tag
 
     def _cached(self, key, builder):
         if key not in self._jit_cache:
@@ -109,8 +131,16 @@ class TpuJoinAggFusedExec(TpuExec):
             )
 
             scope = self._registry_scope()
+            # a private (unfingerprintable-agg) tag must not enter the
+            # process-wide registry: the tag is meaningless in another
+            # process (persisted AOT) and would pin a never-shareable
+            # program in the shared LRU
+            private = isinstance(key, tuple) and any(
+                isinstance(p, tuple) and p[:1] == ("private",)
+                for p in key)
             self._jit_cache[key] = cached_jit_program(
-                None if scope is None else scope + (key,), builder,
+                None if scope is None or private else scope + (key,),
+                builder,
                 label=f"joinagg:{key if isinstance(key, str) else key[0]}")
         return self._jit_cache[key]
 

@@ -18,7 +18,7 @@ from __future__ import annotations
 from typing import Iterator, List, Optional
 
 import jax
-from spark_rapids_tpu.perfcounters import tpu_jit
+from spark_rapids_tpu.perfcounters import sync_get, tpu_jit
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
@@ -163,7 +163,10 @@ class TpuExpandExec(TpuExec):
             b = ColumnarBatch(list(cols), num_rows, batch.schema)
             ctx = EvalContext(b, ansi=self.ansi)
             out = tuple(e.eval_tpu(ctx) for e in self.projections[proj_idx])
+            # tpulint: disable=trace-closure-state (deliberate trace-time
+            # aux: the msgs list is cached WITH the jit in self._jit)
             msgs.clear()
+            # tpulint: disable=trace-closure-state (same aux store)
             msgs.extend(m for _, m in ctx.error_flags)
             return out, tuple(jnp.any(f) for f, _ in ctx.error_flags)
 
@@ -177,8 +180,11 @@ class TpuExpandExec(TpuExec):
                              jnp.int32(batch.num_rows))
         from spark_rapids_tpu.expr.base import SparkArithmeticException
 
-        for f, m in zip(flags, list(msgs)):
-            if bool(f):
+        # all error flags in ONE logical round trip — a per-flag bool()
+        # was a device sync per flag per batch (trace-split-sync)
+        host_flags = sync_get(tuple(flags)) if flags else ()
+        for f, m in zip(host_flags, list(msgs)):
+            if f:
                 raise SparkArithmeticException(m)
         return ColumnarBatch(list(cols), batch.num_rows, self._output)
 
@@ -267,7 +273,10 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
                 pred = self.condition.eval_tpu(ctx)
                 ok = pred.data & pred.validity & pair_ok
                 flags = tuple(jnp.any(f) for f, _ in ctx.error_flags)
+                # tpulint: disable=trace-closure-state (deliberate
+                # trace-time aux: cached WITH the jit in self._jits)
                 flag_msgs.clear()
+                # tpulint: disable=trace-closure-state (same aux store)
                 flag_msgs.extend(m for _, m in ctx.error_flags)
             else:
                 ok = pair_ok
@@ -289,8 +298,12 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
             jnp.int64(nl), jnp.int64(nright))
         from spark_rapids_tpu.expr.base import SparkArithmeticException
 
-        for f, m in zip(flags, list(flag_msgs)):
-            if bool(f):
+        # all condition error flags in ONE logical round trip — a
+        # per-flag bool() was a device sync per flag per chunk
+        # (trace-split-sync)
+        host_flags = sync_get(tuple(flags)) if flags else ()
+        for f, m in zip(host_flags, list(flag_msgs)):
+            if f:
                 raise SparkArithmeticException(m)
         if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
             def compact_fn(cols, flags, num_rows):

@@ -137,7 +137,13 @@ def _dispatch(src: T.DataType, dst: T.DataType):
 # -- numeric ---------------------------------------------------------------
 
 def _int_to_int(ctx, c, src, dst, ansi):
-    if ansi:
+    narrowing = (_I_MIN[type(dst)] > _I_MIN[type(src)]
+                 or _I_MAX[type(dst)] < _I_MAX[type(src)])
+    if ansi and narrowing:
+        # narrowing only: a widening cast cannot overflow — and its
+        # bound constants may not be representable in the SOURCE dtype
+        # (2^63-1 wraps to -1 as an int32 operand, flagging every
+        # non-negative row)
         mn, mx = _I_MIN[type(dst)], _I_MAX[type(dst)]
         bad = (c.data < mn) | (c.data > mx)
         ctx.add_error(bad & c.validity, f"cast overflow to {dst} (ANSI)")

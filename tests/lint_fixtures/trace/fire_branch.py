@@ -1,0 +1,17 @@
+"""trace-branch FIRING: Python `if`/`while` on a traced value freezes
+at trace time."""
+import jax.numpy as jnp
+
+from demo.perfcounters import tpu_jit
+
+
+def kernel(x, n):
+    if jnp.max(x) > 0:
+        x = x - jnp.max(x)
+    while n > 0:
+        x = x * 2
+        n = n - 1
+    return x
+
+
+JITTED = tpu_jit(kernel)

@@ -1,0 +1,18 @@
+"""trace-closure-state FIRING: traced code reading/mutating a mutable
+container captured from an enclosing scope bakes/loses state on cache
+hits."""
+import jax.numpy as jnp
+
+from demo.perfcounters import tpu_jit
+
+
+def build():
+    offsets = [0]
+    msgs = []
+
+    def kernel(x):
+        base = offsets[0]
+        msgs.append("traced")
+        return x + base
+
+    return tpu_jit(kernel)

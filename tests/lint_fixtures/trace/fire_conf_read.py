@@ -1,0 +1,14 @@
+"""trace-conf-read FIRING: get_conf() inside a traced kernel bakes the
+setting into the compiled program."""
+import jax.numpy as jnp
+
+from demo.config import get_conf
+from demo.perfcounters import tpu_jit
+
+
+def kernel(x):
+    limit = get_conf().get("demo.lint.clipLimit")
+    return jnp.clip(x, 0, limit)
+
+
+JITTED = tpu_jit(kernel)

@@ -1,0 +1,22 @@
+"""trace-branch + trace-host-sync FIRING inside an HOF body DEFINED
+INSIDE the traced kernel — the common `def body(...); lax.fori_loop(0,
+n, body, x)` idiom.  Regression: `_hof_fn_refs` used to resolve fn args
+against the kernel's ENCLOSING scope, so a nested body (or lambda)
+never joined the region and its defects were invisible."""
+import jax.numpy as jnp
+from jax import lax
+
+from demo.perfcounters import tpu_jit
+
+
+def kernel(x, n):
+    def body(i, acc):
+        if jnp.max(acc) > 0:          # trace-branch on a traced value
+            acc = acc - jnp.max(acc)
+        scale = float(jnp.sum(acc))   # trace-host-sync concretization
+        return acc * scale
+
+    return lax.fori_loop(0, n, body, x)
+
+
+JITTED = tpu_jit(kernel)

@@ -1,0 +1,14 @@
+"""trace-host-sync FIRING: float()/.item() on a traced value inside
+traced code concretizes at trace time."""
+import jax.numpy as jnp
+
+from demo.perfcounters import tpu_jit
+
+
+def kernel(x):
+    scale = float(jnp.max(x))
+    first = x[0].item()
+    return x * scale + first
+
+
+JITTED = tpu_jit(kernel)

@@ -1,0 +1,13 @@
+"""retrace-key FIRING: unstable values in program key parts — directly
+(id(), f-string, set) and laundered through a helper's return (the
+interprocedural slice)."""
+from demo.registry import cached_jit_program
+
+
+def tag_of(obj):
+    return ("id", id(obj))       # reused after GC; unstable across runs
+
+
+def build(obj, names, fn):
+    key = ("stage", tag_of(obj), f"cap={obj}", frozenset(names))
+    return cached_jit_program(key, fn)

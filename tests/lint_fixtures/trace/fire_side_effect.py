@@ -1,0 +1,13 @@
+"""trace-side-effect FIRING: a counter bump inside traced code runs at
+trace time only — never again on cache hits."""
+import jax.numpy as jnp
+
+from demo.perfcounters import bump, tpu_jit
+
+
+def kernel(x):
+    bump("kernel_calls")
+    return x + jnp.float32(1.0)
+
+
+JITTED = tpu_jit(kernel)

@@ -1,0 +1,21 @@
+"""trace-split-sync FIRING: the components of one jitted result are
+materialized as separate host round trips (incl. per-element loops)."""
+import jax.numpy as jnp
+
+from demo.perfcounters import tpu_jit
+
+
+def kernel(x):
+    return x, jnp.sum(x), tuple(jnp.any(x > i) for i in range(3))
+
+
+JITTED = tpu_jit(kernel)
+
+
+def run(x):
+    cols, count, flags = JITTED(x)
+    n = int(count)
+    for f in flags:
+        if bool(f):
+            raise ValueError("flagged")
+    return cols, n

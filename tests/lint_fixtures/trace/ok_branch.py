@@ -1,0 +1,19 @@
+"""trace-branch NON-FIRING: device-side select, identity checks on
+optional traced args, static-shape branches, and defaulted closure
+constants are all trace-safe."""
+import jax.numpy as jnp
+
+from demo.perfcounters import tpu_jit
+
+
+def kernel(x, mask=None, _depth=3):
+    if mask is not None:          # identity check: trace-time dispatch
+        x = jnp.where(mask, x, 0)
+    if x.shape[0] > 4:            # static metadata branch
+        x = x[:4]
+    if _depth > 1:                # defaulted param: closure constant
+        x = x * 2
+    return jnp.where(x > 0, x, -x)
+
+
+JITTED = tpu_jit(kernel)

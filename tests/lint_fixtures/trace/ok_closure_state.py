@@ -1,0 +1,12 @@
+"""trace-closure-state NON-FIRING: scalar closure CONSTANTS are fine
+(they key the program); only mutable-container reads/writes bake."""
+import jax.numpy as jnp
+
+from demo.perfcounters import tpu_jit
+
+
+def build(base):
+    def kernel(x):
+        return x + base          # immutable closure constant
+
+    return tpu_jit(kernel)

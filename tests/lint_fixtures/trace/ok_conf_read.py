@@ -1,0 +1,15 @@
+"""trace-conf-read NON-FIRING: the conf is read at BUILD time and the
+value closes over the kernel as a constant."""
+import jax.numpy as jnp
+
+from demo.config import get_conf
+from demo.perfcounters import tpu_jit
+
+
+def build():
+    limit = get_conf().get("demo.lint.clipLimit")
+
+    def kernel(x, _limit=limit):
+        return jnp.clip(x, 0, _limit)
+
+    return tpu_jit(kernel)

@@ -1,0 +1,14 @@
+"""trace-host-sync NON-FIRING: everything stays on device; host
+conversions apply only to static metadata (shape) and the kernel's
+closure constants, never to traced values."""
+import jax.numpy as jnp
+
+from demo.perfcounters import tpu_jit
+
+
+def kernel(x):
+    rows = float(x.shape[0])     # static metadata, not a traced value
+    return x * jnp.max(x) / jnp.float32(rows)
+
+
+JITTED = tpu_jit(kernel)

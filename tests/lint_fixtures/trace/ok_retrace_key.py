@@ -1,0 +1,12 @@
+"""retrace-key NON-FIRING: sorted tuples of primitives are stable key
+material (sorted() stabilizes its whole subtree)."""
+from demo.registry import cached_jit_program
+
+
+def fp_of(names, caps):
+    return tuple(sorted(str(n) for n in names)) + tuple(caps)
+
+
+def build(names, caps, fn):
+    key = ("stage", fp_of(names, caps), 1024, True)
+    return cached_jit_program(key, fn)

@@ -1,0 +1,17 @@
+"""trace-side-effect NON-FIRING: the counter bump wraps the CALL site,
+outside the traced function."""
+import jax.numpy as jnp
+
+from demo.perfcounters import bump, tpu_jit
+
+
+def kernel(x):
+    return x + jnp.float32(1.0)
+
+
+JITTED = tpu_jit(kernel)
+
+
+def dispatch(x):
+    bump("kernel_calls")
+    return JITTED(x)

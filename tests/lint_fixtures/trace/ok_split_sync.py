@@ -1,0 +1,26 @@
+"""trace-split-sync NON-FIRING: a single scalar materialization is
+irreducible, and a batched fetch under sync_event is ONE logical
+round trip."""
+import jax.numpy as jnp
+
+from demo.perfcounters import sync_event, tpu_jit
+
+
+def kernel(x):
+    return x, jnp.sum(x), tuple(jnp.any(x > i) for i in range(3))
+
+
+JITTED = tpu_jit(kernel)
+
+
+def run_single(x):
+    cols, count, flags = JITTED(x)
+    return cols, int(count)      # one irreducible scalar sync
+
+
+def run_batched(x):
+    cols, count, flags = JITTED(x)
+    with sync_event():
+        n = int(count)
+        hot = [bool(f) for f in flags]
+    return cols, n, hot

@@ -1,0 +1,15 @@
+"""trace-branch PRAGMA-SUPPRESSED."""
+import jax.numpy as jnp
+
+from demo.perfcounters import tpu_jit
+
+
+def kernel(x):
+    # tpulint: disable=trace-branch (fixture: value is constant-folded
+    # before tracing in every caller)
+    if jnp.max(x) > 0:
+        x = x - jnp.max(x)
+    return x
+
+
+JITTED = tpu_jit(kernel)

@@ -1,0 +1,18 @@
+"""trace-closure-state PRAGMA-SUPPRESSED: the deliberate trace-time
+aux-store pattern, justified because the store travels WITH the
+executable."""
+import jax.numpy as jnp
+
+from demo.perfcounters import tpu_jit
+
+
+def build():
+    msgs = []
+
+    def kernel(x):
+        # tpulint: disable=trace-closure-state (fixture: msgs is cached
+        # WITH the jit, the msgs_store pattern)
+        msgs.append("traced")
+        return x * 2
+
+    return tpu_jit(kernel), msgs

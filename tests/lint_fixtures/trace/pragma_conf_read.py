@@ -1,0 +1,16 @@
+"""trace-conf-read PRAGMA-SUPPRESSED: same shape as the firing case,
+silenced by a justified pragma."""
+import jax.numpy as jnp
+
+from demo.config import get_conf
+from demo.perfcounters import tpu_jit
+
+
+def kernel(x):
+    # tpulint: disable=trace-conf-read (fixture: the key is part of the
+    # program fingerprint, so the bake is deliberate)
+    limit = get_conf().get("demo.lint.clipLimit")
+    return jnp.clip(x, 0, limit)
+
+
+JITTED = tpu_jit(kernel)

@@ -1,0 +1,14 @@
+"""trace-host-sync PRAGMA-SUPPRESSED."""
+import jax.numpy as jnp
+
+from demo.perfcounters import tpu_jit
+
+
+def kernel(x):
+    # tpulint: disable=trace-host-sync (fixture: this kernel only ever
+    # runs eagerly on the CPU twin)
+    scale = float(jnp.max(x))
+    return x * scale
+
+
+JITTED = tpu_jit(kernel)

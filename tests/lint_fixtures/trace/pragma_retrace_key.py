@@ -1,0 +1,9 @@
+"""retrace-key PRAGMA-SUPPRESSED."""
+from demo.registry import cached_jit_program
+
+
+def build(obj, fn):
+    # tpulint: disable=retrace-key (fixture: process-local cache only,
+    # never persisted, and obj is pinned for the process lifetime)
+    key = ("stage", id(obj))
+    return cached_jit_program(key, fn)

@@ -1,0 +1,14 @@
+"""trace-side-effect PRAGMA-SUPPRESSED."""
+import jax.numpy as jnp
+
+from demo.perfcounters import bump, tpu_jit
+
+
+def kernel(x):
+    # tpulint: disable=trace-side-effect (fixture: trace-time-only
+    # bump is the point of this probe counter)
+    bump("kernel_traces")
+    return x + jnp.float32(1.0)
+
+
+JITTED = tpu_jit(kernel)

@@ -1,0 +1,20 @@
+"""trace-split-sync PRAGMA-SUPPRESSED."""
+import jax.numpy as jnp
+
+from demo.perfcounters import tpu_jit
+
+
+def kernel(x):
+    return jnp.sum(x), jnp.max(x)
+
+
+JITTED = tpu_jit(kernel)
+
+
+def run(x):
+    total, peak = JITTED(x)
+    # tpulint: disable=trace-split-sync (fixture: the two scalars are
+    # consumed by independent shutdown paths, never together)
+    a = int(total)
+    b = float(peak)
+    return a, b

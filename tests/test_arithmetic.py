@@ -115,3 +115,19 @@ def test_ansi_overflow_raises():
     df = s.create_dataframe({"a": [2**62, 2**62]}, schema)
     with pytest.raises(SparkArithmeticException):
         df.select((col("a") + col("a")).alias("r")).collect()
+
+
+def test_ansi_widening_cast_never_overflows():
+    """ISSUE 11 regression: an ANSI int->long WIDENING cast flagged
+    every non-negative row — the long max bound (2^63-1) wrapped to -1
+    as an int32 operand.  A literal int added to a long column is the
+    canonical trigger."""
+    from spark_rapids_tpu.session import TpuSession, lit
+    from spark_rapids_tpu import types as T
+
+    s = TpuSession({"spark.rapids.sql.enabled": True,
+                    "spark.sql.ansi.enabled": True})
+    schema = T.StructType([T.StructField("a", T.LONG)])
+    df = s.create_dataframe({"a": [1, 2, 3]}, schema)
+    out = df.select((col("a") + lit(1)).alias("r")).collect()
+    assert [r[0] for r in out] == [2, 3, 4]

@@ -64,6 +64,33 @@ _MATRIX = [
     ("ok_consistent.py", "lock-order", False),
     ("fire_rmw.py", "unlocked-rmw", True),
     ("ok_rmw.py", "unlocked-rmw", False),
+    # tracelint tier (ISSUE 11): firing + non-firing + pragma per rule
+    ("fire_conf_read.py", "trace-conf-read", True),
+    ("ok_conf_read.py", "trace-conf-read", False),
+    ("pragma_conf_read.py", "trace-conf-read", False),
+    ("fire_side_effect.py", "trace-side-effect", True),
+    ("ok_side_effect.py", "trace-side-effect", False),
+    ("pragma_side_effect.py", "trace-side-effect", False),
+    ("fire_host_sync.py", "trace-host-sync", True),
+    ("ok_host_sync.py", "trace-host-sync", False),
+    ("pragma_host_sync.py", "trace-host-sync", False),
+    ("fire_branch.py", "trace-branch", True),
+    ("ok_branch.py", "trace-branch", False),
+    ("pragma_branch.py", "trace-branch", False),
+    # HOF body DEFINED INSIDE the kernel joins the region (regression:
+    # _hof_fn_refs resolved fn args against the enclosing scope, so
+    # nested bodies were invisible to every trace rule)
+    ("fire_hof_nested.py", "trace-branch", True),
+    ("fire_hof_nested.py", "trace-host-sync", True),
+    ("fire_closure_state.py", "trace-closure-state", True),
+    ("ok_closure_state.py", "trace-closure-state", False),
+    ("pragma_closure_state.py", "trace-closure-state", False),
+    ("fire_split_sync.py", "trace-split-sync", True),
+    ("ok_split_sync.py", "trace-split-sync", False),
+    ("pragma_split_sync.py", "trace-split-sync", False),
+    ("fire_retrace_key.py", "retrace-key", True),
+    ("ok_retrace_key.py", "retrace-key", False),
+    ("pragma_retrace_key.py", "retrace-key", False),
 ]
 
 
@@ -175,7 +202,8 @@ def test_repo_lint_gate():
     assert new == [], "non-baselined findings:\n" + "\n".join(
         f.render() for f in new)
     assert stale == [], f"stale baseline entries: {stale}"
-    assert elapsed < 30.0, f"full-repo analysis took {elapsed:.1f}s"
+    # BOTH tiers (invariants/lockset + tracelint) under one wall bound
+    assert elapsed < 45.0, f"full-repo analysis took {elapsed:.1f}s"
 
 
 def test_scoped_run_knows_repo_vocabulary():
@@ -228,6 +256,126 @@ def test_cli_new_finding_exits_one(tmp_path):
     assert r2.returncode == 1
     payload = json.loads(r2.stdout)
     assert payload and payload[0]["rule"] == "counter-write"
+
+
+# ---------------------------------------------------------------------------
+# tracelint (ISSUE 11): fusibility manifest, SARIF, CLI satellites
+# ---------------------------------------------------------------------------
+
+def test_fusibility_manifest_covers_every_registered_exec():
+    """Every EXECS plan class has a classification; none is unknown."""
+    from spark_rapids_tpu.analysis.fusibility import build_manifest
+    from spark_rapids_tpu.overrides.overrides import EXECS
+
+    m = build_manifest(REPO)
+    ops = m["operators"]
+    for cls in EXECS:
+        assert cls.__name__ in ops, f"{cls.__name__} missing"
+    for op, e in ops.items():
+        c = e["classification"]
+        assert c.split("(", 1)[0] in ("fusable", "fusable-with-rewrite",
+                                      "unfusable"), (op, c)
+        assert "unknown" not in c, (op, c)
+    # the hot fusion targets classify as expected (pins the taint +
+    # resolution machinery end-to-end)
+    assert ops["HashAggregate"]["classification"] == "fusable"
+    assert ops["Project"]["classification"].startswith(
+        "fusable-with-rewrite")
+    assert "TpuStageExec" in m["execs"]
+
+
+def test_fusibility_manifest_byte_identical():
+    from spark_rapids_tpu.analysis.fusibility import (
+        build_manifest,
+        manifest_json,
+    )
+
+    a = manifest_json(build_manifest(REPO))
+    b = manifest_json(build_manifest(REPO))
+    assert a == b
+    json.loads(a)
+
+
+def test_sarif_deterministic_and_well_formed(tmp_path):
+    """--sarif: byte-identical across runs, valid SARIF 2.1.0 shape,
+    findings carry rule + location."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax.numpy as jnp\n\n\n"
+                   "def kernel(x):\n"
+                   "    if jnp.max(x) > 0:\n"
+                   "        x = x - 1\n"
+                   "    return x\n\n\n"
+                   "J = tpu_jit(kernel)\n")
+    empty = tmp_path / "baseline.json"
+    empty.write_text('{"entries": []}\n')
+    s1, s2 = tmp_path / "a.sarif", tmp_path / "b.sarif"
+    for out in (s1, s2):
+        r = _cli(["--no-docs-rule", "--baseline", str(empty),
+                  "--sarif", str(out), str(bad)])
+        assert r.returncode == 1
+    assert s1.read_bytes() == s2.read_bytes()
+    payload = json.loads(s1.read_text())
+    assert payload["version"] == "2.1.0"
+    results = payload["runs"][0]["results"]
+    assert results and results[0]["ruleId"] == "trace-branch"
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("bad.py")
+    assert loc["region"]["startLine"] == 5
+    rule_ids = {r["id"] for r in
+                payload["runs"][0]["tool"]["driver"]["rules"]}
+    assert "trace-branch" in rule_ids and "lock-order" in rule_ids
+
+
+def test_cli_rules_scoping(tmp_path):
+    """--rules scopes the run; unknown ids exit 2."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("COUNTERS = {}\n\n\ndef f():\n"
+                   "    COUNTERS['x'] = 1\n")
+    empty = tmp_path / "baseline.json"
+    empty.write_text('{"entries": []}\n')
+    # counter-write fires when in scope...
+    r = _cli(["--no-docs-rule", "--rules", "counter-write",
+              "--baseline", str(empty), str(bad)])
+    assert r.returncode == 1 and "counter-write" in r.stdout
+    # ...and is silent when scoped to an unrelated rule
+    r2 = _cli(["--no-docs-rule", "--rules", "trace-branch",
+               "--baseline", str(empty), str(bad)])
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    r3 = _cli(["--no-docs-rule", "--rules", "no-such-rule", str(bad)])
+    assert r3.returncode == 2
+    assert "unknown rule id" in r3.stderr
+
+
+def test_cli_stale_count_and_prune(tmp_path):
+    """The stale-entry count prints on every run; --prune-baseline
+    drops entries that no longer fire and keeps the rest."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("COUNTERS = {}\n\n\ndef f():\n"
+                   "    COUNTERS['x'] = 1\n")
+    # repo_root must match the CLI's (tools/lint.py anchors at REPO) so
+    # the baseline identity's file field lines up
+    findings = run_paths([str(bad)], REPO,
+                         rules=default_rules(include_docs=False))
+    assert findings
+    live = {"rule": findings[0].rule, "file": findings[0].file,
+            "context": findings[0].context,
+            "message": findings[0].message, "justification": "fixture"}
+    ghost = dict(live, message="no longer fires")
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps({"entries": [live, ghost]}) + "\n")
+    r = _cli(["--no-docs-rule", "--baseline", str(base), str(bad)],
+             cwd=str(tmp_path))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "1 stale baseline entry" in r.stderr
+    r2 = _cli(["--no-docs-rule", "--baseline", str(base),
+               "--prune-baseline", str(bad)], cwd=str(tmp_path))
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    kept = json.loads(base.read_text())["entries"]
+    assert len(kept) == 1 and kept[0]["message"] == live["message"]
+    # a clean run reports zero stale
+    r3 = _cli(["--no-docs-rule", "--baseline", str(base), str(bad)],
+              cwd=str(tmp_path))
+    assert "0 stale baseline entries" in r3.stderr
 
 
 # ---------------------------------------------------------------------------
@@ -396,6 +544,108 @@ def test_arm_conf_spec_races_arm_once():
         assert len(F.active_faults()) == 1
     finally:
         F.clear_faults()
+
+
+def test_stage_ansi_flags_are_one_logical_sync():
+    """exec/basic.py: an ANSI stage's row count + every error flag
+    materialize as ONE logical round trip (a per-flag bool() used to be
+    one device sync per flag per batch)."""
+    import numpy as np
+
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.column import HostColumn
+    from spark_rapids_tpu.exec.basic import (
+        TpuLocalTableScanExec,
+        TpuProjectExec,
+    )
+    from spark_rapids_tpu.expr.base import Alias, col, lit
+
+    schema = T.StructType([T.StructField("v", T.LONG, False)])
+    host = [HostColumn.from_numpy(np.arange(6, dtype=np.int64), T.LONG)]
+    scan = TpuLocalTableScanExec(host, schema)
+    e = Alias((col("v") + lit(1)).resolve(schema), "v1")
+    e.resolve(schema)
+    proj = TpuProjectExec([e], scan, True)   # ANSI: overflow flag
+    snap = PC.snapshot()
+    outs = list(proj.execute_columnar())
+    assert [b.num_rows for b in outs] == [6]
+    assert PC.since(snap)["host_syncs"] == 1
+
+
+def test_expand_ansi_flags_are_one_logical_sync():
+    """exec/generate.py TpuExpandExec: all of one projection's error
+    flags fetch as ONE logical sync."""
+    import numpy as np
+
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.column import HostColumn
+    from spark_rapids_tpu.exec.basic import TpuLocalTableScanExec
+    from spark_rapids_tpu.exec.generate import TpuExpandExec
+    from spark_rapids_tpu.expr.base import Alias, col, lit
+
+    schema = T.StructType([T.StructField("v", T.LONG, False)])
+    out_schema = T.StructType([T.StructField("a", T.LONG, True),
+                               T.StructField("b", T.LONG, True)])
+    host = [HostColumn.from_numpy(np.arange(5, dtype=np.int64), T.LONG)]
+    scan = TpuLocalTableScanExec(host, schema)
+    exprs = []
+    for name, add in (("a", 2), ("b", 3)):
+        e = Alias((col("v") + lit(add)).resolve(schema), name)
+        e.resolve(schema)
+        exprs.append(e)
+    # TWO ANSI-flagged projections: the old per-flag bool() cost two
+    # round trips here, the batched fetch costs one
+    expand = TpuExpandExec([exprs], scan, out_schema, ansi=True)
+    snap = PC.snapshot()
+    outs = list(expand.execute_columnar())
+    assert [b.num_rows for b in outs] == [5]
+    assert PC.since(snap)["host_syncs"] == 1
+
+
+def test_fused_agg_tag_never_uses_raw_id(monkeypatch):
+    """exec/fused.py: an unfingerprintable agg variant gets a
+    process-unique tag PINNED on the object (a raw id() can be reused
+    after GC, aliasing two different aggs to one registry program), and
+    a private tag forces the program out of the shared registry."""
+    import types as pytypes
+
+    from spark_rapids_tpu.exec import fused as FU
+
+    class FakeAgg:
+        def _program_fp(self):
+            return None
+
+    exec_ = object.__new__(FU.TpuJoinAggFusedExec)
+    a, b = FakeAgg(), FakeAgg()
+    ta, tb = exec_._agg_tag(a), exec_._agg_tag(b)
+    assert ta != tb                       # distinct objects: distinct
+    assert exec_._agg_tag(a) == ta        # stable per object
+    assert ta[:1] == ("private",)
+    # fingerprintable aggs keep their shared identity
+    good = pytypes.SimpleNamespace(_program_fp=lambda: ("fp", 1))
+    assert exec_._agg_tag(good) == ("fp", 1)
+
+    # a private tag in the key must force key_parts=None (instance-
+    # private jit) — never a process-wide registry entry
+    captured = {}
+
+    def fake_cached_jit_program(key_parts, builder, label=""):
+        captured["key_parts"] = key_parts
+        return object()
+
+    import spark_rapids_tpu.compilecache.registry as REG
+
+    monkeypatch.setattr(REG, "cached_jit_program",
+                        fake_cached_jit_program)
+    exec_._jit_cache = {}
+    exec_._reg_scope = ("joinagg", "scope")
+    exec_._cached(("uniq_agg", ta, None), lambda: None)
+    assert captured["key_parts"] is None
+    exec_._cached(("uniq_agg", ("fp", 1), None), lambda: None)
+    assert captured["key_parts"] == ("joinagg", "scope",
+                                     ("uniq_agg", ("fp", 1), None))
 
 
 def test_arm_conf_spec_bad_spec_mutates_nothing():

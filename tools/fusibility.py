@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Fusion-safety manifest CLI — which exec kernels can be inlined into
+a larger traced region?
+
+Classifies every registered exec's kernel functions as ``fusable`` /
+``fusable-with-rewrite(<reason>)`` / ``unfusable(<reason>)`` from the
+tracelint call graph (see docs/static_analysis.md), keyed by the same
+``plan_key`` operator-class identity the calibration store and
+``tools/qualify.py`` use.  Output is deterministic: two runs over an
+unchanged tree are byte-identical (pinned by tests/test_lint.py).
+
+    python tools/fusibility.py                   # manifest to stdout
+    python tools/fusibility.py --out fus.json    # write to a file
+    python tools/fusibility.py --summary         # one line per operator
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from spark_rapids_tpu.analysis.fusibility import (  # noqa: E402
+    build_manifest,
+    manifest_json,
+)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fusibility.py",
+        description="tracelint fusion-safety manifest")
+    ap.add_argument("--out", metavar="PATH",
+                    help="write the manifest JSON to PATH "
+                         "(default: stdout)")
+    ap.add_argument("--summary", action="store_true",
+                    help="print a one-line-per-operator summary "
+                         "instead of JSON")
+    args = ap.parse_args(argv)
+
+    manifest = build_manifest(REPO)
+    if args.summary:
+        for op, e in sorted(manifest["operators"].items()):
+            print(f"{op:<30} {e['classification']}")
+        counts = {}
+        for e in manifest["operators"].values():
+            kind = e["classification"].split("(", 1)[0]
+            counts[kind] = counts.get(kind, 0) + 1
+        print("--")
+        for kind in sorted(counts):
+            print(f"{kind:<30} {counts[kind]}")
+        return 0
+    payload = manifest_json(manifest)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(payload)
+        print(f"wrote {args.out} ({len(manifest['operators'])} "
+              f"operators, {len(manifest['execs'])} exec classes)",
+              file=sys.stderr)
+    else:
+        sys.stdout.write(payload)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -62,7 +62,9 @@ def render(advisory: dict) -> str:
             f"{st['syncs_per_batch']:>7.2f} "
             f"{st['transport_share'] * 100:>6.0f}% "
             f"{st['mean_self_wall_ms']:>9.2f}  "
-            + (",".join(e["flags"]) or "-"))
+            + (",".join(e["flags"]
+                        + ([f"fus:{e['fusibility'].split('(', 1)[0]}"]
+                           if "fusibility" in e else [])) or "-"))
     return "\n".join(out)
 
 
@@ -93,6 +95,10 @@ def main(argv=None) -> int:
                     help="EWMA decay for --logs ingestion")
     ap.add_argument("--json", action="store_true",
                     help="emit the advisory JSON to stdout")
+    ap.add_argument("--fusibility", metavar="FILE",
+                    help="tools/fusibility.py manifest to join: each "
+                         "operator class gains its fusion-safety "
+                         "classification (shared op_class identity)")
     args = ap.parse_args(argv)
 
     from spark_rapids_tpu.profiling import advisor
@@ -121,6 +127,14 @@ def main(argv=None) -> int:
     if args.transport_share is not None:
         kw["transport_share"] = args.transport_share
     advisory = advisor.classify(store, **kw)
+    if args.fusibility:
+        with open(args.fusibility, encoding="utf-8") as f:
+            manifest = json.load(f)
+        fus_ops = manifest.get("operators", {})
+        for op, e in advisory["operators"].items():
+            fe = fus_ops.get(op)
+            if fe is not None:
+                e["fusibility"] = fe["classification"]
     if args.advisory_out:
         advisor.write_advisory(advisory, args.advisory_out)
         print(f"advisory written: {args.advisory_out}", file=sys.stderr)
