@@ -564,6 +564,44 @@ def run_concurrency(n_workers: int, rounds: int = 3,
     return out
 
 
+def measure_progress_overhead(rows: int = 100_000,
+                              repeats: int = 5) -> dict:
+    """``progressOverhead`` (ISSUE 12 satellite): the wall cost of the
+    per-batch live-progress instrumentation on a hot in-memory
+    aggregate — the same query timed ``repeats``x with
+    ``spark.rapids.tpu.progress.enabled`` off then on (both sessions
+    share the warm compile cache; each warms once untimed).  Recorded
+    in the payload so tools/bench_gate.py can watch the enabled-path
+    tax across rounds; the disabled path's zero-call contract is pinned
+    separately by tests/test_progress.py."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.session import TpuSession, sum_
+
+    ss = make_store_sales(rows)
+
+    def q(s):
+        sales = _df(s, {k: ss[k] for k in ("date_sk", "store_sk",
+                                           "ext_sales")},
+                    [T.INT, T.INT, T.LONG])
+        return sales.group_by("store_sk").agg(sum_("ext_sales", "s"))
+
+    timings = {}
+    for key, enabled in (("disabled_s", False), ("enabled_s", True)):
+        s = TpuSession({
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.tpu.progress.enabled": enabled,
+        })
+        df = q(s)
+        t, _ = _time_repeats(df.collect, repeats)   # warms untimed
+        timings[key] = round(t, 6)
+    base = timings["disabled_s"]
+    timings["overhead_pct"] = round(
+        (timings["enabled_s"] - base) * 100.0 / base, 2) if base else 0.0
+    timings["rows"] = rows
+    timings["repeats"] = repeats
+    return timings
+
+
 def main():
     # BENCH_PLATFORM=cpu runs the suite on the XLA CPU backend (fast
     # correctness smoke; the container sitecustomize pre-imports jax on the
@@ -663,6 +701,8 @@ def main():
         {} if cache_env is None
         else {"spark.rapids.tpu.compileCache.dir": cache_env}))
     queries = {}
+    # progressOverhead (ISSUE 12): filled right before the final emit
+    progress_box = {}
 
     emitted = {"done": False, "rc": 0}
 
@@ -729,6 +769,7 @@ def main():
             "scan_inclusive_geomean": round(geo_scan, 3),
             "slo": slo,
             "telemetry": tel,
+            "progressOverhead": dict(progress_box) or None,
             "hbm_roofline_gbps": V5E_HBM_GBPS,
             "note": ("vs_baseline = geomean TPU speedup over "
                      "hand-vectorized numpy (bincount/searchsorted/"
@@ -1286,6 +1327,23 @@ def main():
             return emitted["rc"]
         except Exception as ex:   # additive: never lose rung 1-2
             progress(f"q6_parquet failed: {ex!r}")
+
+    # progressOverhead (ISSUE 12 satellite): a small hot-aggregate A/B
+    # right before the final emit — additive, never loses rung 1-2
+    if os.environ.get("BENCH_PROGRESS_OVERHEAD", "1") != "0" \
+            and not over_budget():
+        try:
+            progress_box.update(measure_progress_overhead())
+            progress(
+                f"progressOverhead: disabled "
+                f"{progress_box['disabled_s']:.4f}s -> enabled "
+                f"{progress_box['enabled_s']:.4f}s "
+                f"({progress_box['overhead_pct']:+.1f}%)")
+        except TimeoutError:
+            abort("progress_overhead")
+            return emitted["rc"]
+        except Exception as ex:
+            progress(f"progressOverhead failed: {ex!r}")
 
     emit()
     return emitted["rc"]

@@ -285,6 +285,50 @@ def doc_drift_problems(repo_root: str) -> List[str]:
         problems.append("diagnostics event type 'ici_shuffle' is not "
                         "registered in EVENT_SCHEMA")
 
+    # live progress (ISSUE 12): confs + counters + the query_stall /
+    # progress event vocabulary + the sampler's aggregate gauges + the
+    # history-server tooling must be documented in docs/progress.md
+    # (and confs in the regenerated configs.md)
+    prog_md = read("progress.md")
+    prog_confs = [k for k in _REGISTRY
+                  if k.startswith("spark.rapids.tpu.progress.")]
+    if not prog_confs:
+        problems.append("no spark.rapids.tpu.progress.* confs "
+                        "registered")
+    for key in sorted(prog_confs):
+        if f"`{key}`" not in prog_md:
+            problems.append(
+                f"conf '{key}' is not documented in docs/progress.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+    for key in ("stalls_detected", "progress_snapshots"):
+        if key not in PC.COUNTERS:
+            problems.append(f"progress counter '{key}' is not "
+                            f"registered in perfcounters.COUNTERS")
+        if f"`{key}`" not in prog_md:
+            problems.append(
+                f"progress counter '{key}' is not documented in "
+                f"docs/progress.md")
+    for ev in ("query_stall", "progress"):
+        if ev not in EVENT_SCHEMA:
+            problems.append(f"diagnostics event type '{ev}' is not "
+                            f"registered in EVENT_SCHEMA")
+    for gauge in ("progress_queries_running", "progress_min_pct",
+                  "progress_median_pct", "progress_stalled"):
+        if f"`{gauge}`" not in prog_md:
+            problems.append(
+                f"progress sampler gauge '{gauge}' is not documented "
+                f"in docs/progress.md")
+    for word in ("history.py", "`/progress`", "`aot_compile`",
+                 "`scan_prefetch`", "`shuffle_write`", "`--stalls`",
+                 "progressOverhead"):
+        if word not in prog_md:
+            problems.append(
+                f"progress surface vocabulary {word} is not "
+                f"documented in docs/progress.md")
+
     # tracelint (ISSUE 11): every lint rule id and the fusibility
     # manifest vocabulary must be documented in docs/static_analysis.md
     from spark_rapids_tpu.analysis.core import all_rule_ids

@@ -223,7 +223,8 @@ def _get_pool() -> _DaemonPool:
 
 def _compile_job(entry: ProgramEntry,
                  args_factory: Callable[[], Optional[tuple]],
-                 label: str, conf=None, token=None) -> None:
+                 label: str, conf=None, token=None,
+                 owner_qid: Optional[str] = None) -> None:
     """Warm one program via the AOT API: ``jitted.lower(*abstract).
     compile()`` on the RAW jitted (bypassing the launch/compile perf
     counters — a background warm-up is not an engine launch).  Operands
@@ -280,6 +281,13 @@ def _compile_job(entry: ProgramEntry,
                 # would double-count every warmed program (the runtime's
                 # first dispatch still pays the cache-deserialize there)
                 PC.bump("aot_compile_wall_ns", dt)
+                # live progress (ISSUE 12): the pool thread's wall
+                # shows up under the SUBMITTING query, not nowhere
+                from spark_rapids_tpu.progress import context as _PROG
+
+                if _PROG.TRACKER is not None:
+                    _PROG.TRACKER.add_background(
+                        owner_qid, "aot_compile", dt)
     except Exception:
         # a failed warm-up must never hurt the query: the runtime path
         # compiles inline exactly as it would have without AOT
@@ -365,10 +373,12 @@ def submit_plan(root, wait: bool = False) -> AotSubmission:
     except Exception:
         return sub
     from spark_rapids_tpu.config import get_conf
-    from spark_rapids_tpu.lifecycle.context import current_token
+    from spark_rapids_tpu.lifecycle.context import current, current_token
 
     conf = get_conf()   # pinned for every background trace of this plan
     token = current_token()   # the submitting query's cancel token
+    ctx = current()           # ...and its id, for progress attribution
+    owner_qid = ctx.query_id if ctx is not None else None
     pool = _get_pool()
     seen_keys = set()
     for node in _post_order(root):
@@ -414,7 +424,7 @@ def submit_plan(root, wait: bool = False) -> AotSubmission:
             entry.ready_event.clear()
             try:
                 fut = pool.submit(_compile_job, entry, prog.args_factory,
-                                  prog.label, conf, token)
+                                  prog.label, conf, token, owner_qid)
             except Exception:
                 # a failed submit (e.g. executor shutting down) must not
                 # leave a queued entry nobody will ever mark ready —

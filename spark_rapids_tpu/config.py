@@ -887,6 +887,38 @@ PROFILE_ADVISOR_FILE = conf("spark.rapids.tpu.profile.advisor.file").doc(
     "set, else no advisory."
 ).string_conf(None)
 
+# --- progress (progress/ — live per-operator progress, ETA, stalls) --------
+
+PROGRESS_ENABLED = conf("spark.rapids.tpu.progress.enabled").doc(
+    "Live query introspection: every lifecycle-managed collect() "
+    "registers with the process-global progress tracker — per-operator "
+    "batches/rows/bytes produced so far, percent-complete and ETA "
+    "joined from the profiling cost model's predictions, and causal "
+    "attribution of background work (AOT compiles, scan prefetch "
+    "uploads, shuffle-write serialization) to the owning query.  "
+    "Surfaced via session.progress(), live df.explain('analyze'), the "
+    "/progress JSON route on the telemetry HTTP endpoint, and the "
+    "sampler's progress_* gauges.  Disabled (default): every "
+    "instrumentation site costs one ambient attribute check — zero "
+    "calls into progress modules (docs/progress.md)."
+).boolean_conf(False)
+
+PROGRESS_STALL_MS = conf("spark.rapids.tpu.progress.stallMs").doc(
+    "Heartbeat stall detector (requires progress.enabled): when NO "
+    "operator of a live query advances — no batch pull completes and "
+    "no background work is attributed — for this many ms, the "
+    "watchdog's stall scan bumps stalls_detected, emits a query_stall "
+    "diagnostics event naming the stuck operator (the innermost "
+    "in-flight batch pull), and dumps a flight-recorder post-mortem "
+    "embedding the live progress snapshot.  Re-arms after each "
+    "advance, so a later wedge of the same query reports again.  "
+    "0 disables stall detection.").long_conf(0)
+
+PROGRESS_MAX_FINISHED = conf("spark.rapids.tpu.progress.maxFinished").doc(
+    "Recently finished query snapshots the tracker retains for the "
+    "/progress surface (oldest evicted first); live queries are always "
+    "reported regardless.").integer_conf(32)
+
 MEM_DEBUG = conf("spark.rapids.memory.gpu.debug").doc(
     "Log arena allocations.").boolean_conf(False)
 

@@ -51,6 +51,8 @@ EVENT_SCHEMA: Dict[str, List[str]] = {
     "io_fault": ["kind", "path", "fmt", "detail"],
     "scan_prefetch": ["depth", "batches", "overlapped_bytes", "stall_ns"],
     "ici_shuffle": ["stage", "n_dev", "rows", "bytes", "dur_ns"],
+    "query_stall": ["query_id", "path", "name", "stalled_ms", "detail"],
+    "progress": ["query_id", "pct", "eta_ns", "stalls", "background"],
     "op_batch": ["path", "batch", "rows", "dur_ns"],
     "operator": ["path", "name", "describe", "op_class", "fp", "wall_ns",
                  "self_wall_ns", "batches", "rows", "counters", "metrics",
@@ -359,6 +361,25 @@ class QueryDiagnostics:
         ``rejected``."""
         self._event(ESSENTIAL, "lifecycle", kind=kind,
                     detail=str(detail)[:500], dur_ns=int(dur_ns))
+
+    def query_stall(self, query_id: str, path: str, name: str,
+                    stalled_ms: float, detail: str = "") -> None:
+        """The watchdog's stall scan found no operator advance for
+        progress.stallMs (ISSUE 12): names the stuck operator — the
+        innermost in-flight batch pull — not just thread stacks."""
+        self._event(ESSENTIAL, "query_stall", query_id=query_id,
+                    path=path, name=name,
+                    stalled_ms=round(float(stalled_ms), 1),
+                    detail=str(detail)[:500])
+
+    def progress_summary(self, query_id: str, pct, eta_ns, stalls: int,
+                         background: Dict[str, Dict[str, int]]) -> None:
+        """The query's final live-progress record (ISSUE 12): overall
+        percent at finish, last ETA, stall episodes, and the background
+        wall (AOT/prefetch/shuffle pools) attributed to this query."""
+        self._event(ESSENTIAL, "progress", query_id=query_id, pct=pct,
+                    eta_ns=eta_ns, stalls=int(stalls),
+                    background=background)
 
     def scan_prefetch(self, depth: int, batches: int,
                       overlapped_bytes: int, stall_ns: int) -> None:

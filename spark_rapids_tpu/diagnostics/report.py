@@ -167,6 +167,52 @@ def resilience_summary(profiles: List[QueryProfile]) -> Dict[str, Any]:
     return {"counters": counts, "events": by_kind}
 
 
+def stalls_summary(profiles: List[QueryProfile]) -> Dict[str, Any]:
+    """Aggregate ``query_stall`` events (ISSUE 12): which operators
+    queries wedge in, how often, and for how long — the offline
+    companion of the live stall detector.  Fed by
+    ``tools/profile_report.py --stalls``."""
+    by_op: Dict[str, Dict[str, float]] = {}
+    events: List[Dict[str, Any]] = []
+    queries = set()
+    for qp in profiles:
+        for e in qp.events:
+            if e.get("ev") != "query_stall":
+                continue
+            name = e.get("name") or "(no in-flight operator)"
+            a = by_op.setdefault(name, {"stalls": 0.0, "stalled_ms": 0.0})
+            a["stalls"] += 1
+            a["stalled_ms"] += float(e.get("stalled_ms", 0) or 0)
+            queries.add(qp.query_id or qp.path)
+            events.append({"query": qp.query_id,
+                           "op": name,
+                           "path": e.get("path", ""),
+                           "stalled_ms": float(e.get("stalled_ms", 0)
+                                               or 0),
+                           "detail": e.get("detail", "")})
+    return {"total_stalls": len(events),
+            "queries_with_stalls": len(queries),
+            "by_operator": dict(sorted(
+                by_op.items(), key=lambda kv: -kv[1]["stalled_ms"])),
+            "events": events}
+
+
+def render_stalls(summary: Dict[str, Any]) -> str:
+    out = [f"== stalls: {summary['total_stalls']} query_stall event"
+           f"{'' if summary['total_stalls'] == 1 else 's'} across "
+           f"{summary['queries_with_stalls']} quer"
+           f"{'y' if summary['queries_with_stalls'] == 1 else 'ies'} =="]
+    for name, a in summary["by_operator"].items():
+        out.append(f"  {name:<34} {int(a['stalls']):3d} stall"
+                   f"{'' if a['stalls'] == 1 else 's'}  "
+                   f"{a['stalled_ms']:9.1f}ms stalled")
+    for e in summary["events"]:
+        out.append(f"    {e['query']}: {e['stalled_ms']:.0f}ms in "
+                   f"{e['op']}" + (f" at {e['path']}" if e["path"]
+                                   else ""))
+    return "\n".join(out)
+
+
 def diff_profiles(base: List[QueryProfile],
                   new: List[QueryProfile]) -> List[Dict[str, Any]]:
     """Per-query regression diff: match queries by plan signature (falls

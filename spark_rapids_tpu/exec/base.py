@@ -90,6 +90,51 @@ def _traced(fn):
     return wrapper
 
 
+def _progress(fn):
+    """Live-progress wrapper (ISSUE 12): when the process progress
+    tracker is active AND this node's registration stamp matches the
+    pulling thread's query, every completed batch pull advances the
+    owning operator's live counts (batches/rows/bytes) and maintains
+    the in-flight pull stack the stall detector reads.  Disabled path:
+    one ambient attribute check per batch, nothing else (the
+    diagnostics overhead contract, pinned by tests/test_progress.py)."""
+    import functools
+
+    from spark_rapids_tpu.progress import context as _PROG
+
+    @functools.wraps(fn)
+    def wrapper(self, *a, **kw):
+        it = fn(self, *a, **kw)
+        try:
+            while True:
+                trk = _PROG.TRACKER
+                h = trk.begin_pull(self) if trk is not None else None
+                if h is None:
+                    try:
+                        b = next(it)
+                    except StopIteration:
+                        return
+                    yield b
+                    continue
+                try:
+                    b = next(it)
+                except StopIteration:
+                    trk.end_pull(h, None, 0, finished=True)
+                    return
+                except BaseException:
+                    # the pull died (cancel trip, operator failure):
+                    # close the in-flight stack entry without counting
+                    # an advance, then let the unwind proceed
+                    trk.end_pull(h, None, 0, finished=False)
+                    raise
+                trk.end_pull(h, b.num_rows, b.nbytes(), finished=False)
+                yield b
+        finally:
+            it.close()
+
+    return wrapper
+
+
 def _cancel_guard(fn):
     """Outermost-of-all wrapper: ONE ambient contextvar check per batch
     pull against the current query's CancelToken (lifecycle/context.py).
@@ -400,12 +445,16 @@ class TpuExec:
         # the whole iteration, trace annotations included.  diagnostics
         # outside that: the span covers retries/fallbacks, and resilience
         # events fired by the fault domain attribute to this operator.
+        # progress between the cancel guard and diagnostics: its pull
+        # span covers the whole recorded batch (retries included), and
+        # a tripped token raises BEFORE begin_pull so the in-flight
+        # stack never holds a pull that was never started.
         # cancel guard outermost of all: a tripped CancelToken stops the
         # pull BEFORE any more work starts, and its raise must not be
         # wrapped in a diagnostics span it would never close
         if "execute_columnar" in cls.__dict__:
-            cls.execute_columnar = _cancel_guard(_diag(_fault_domain(
-                _traced(cls.execute_columnar))))
+            cls.execute_columnar = _cancel_guard(_progress(_diag(
+                _fault_domain(_traced(cls.execute_columnar)))))
 
     def collect_metrics(self, into=None) -> Dict[str, int]:
         into = into if into is not None else {}

@@ -549,12 +549,30 @@ class TpuFileSourceScanExec(TpuExec):
             return
         from spark_rapids_tpu.diagnostics import context as DIAG_CTX
         from spark_rapids_tpu.lifecycle import check_cancel
+        from spark_rapids_tpu.lifecycle.context import current as _cur
+        from spark_rapids_tpu.progress import context as PROG_CTX
 
         stats = {"batches": 0, "overlapped_bytes": 0, "stall_ns": 0}
         ring: collections.deque = collections.deque()
         pool = cf.ThreadPoolExecutor(
             1, thread_name_prefix="srt-scan-prefetch")
         jobs_it = iter(jobs)
+        # progress attribution (ISSUE 12): the owning query id is
+        # captured HERE on the query thread — the staging thread has no
+        # query contextvar of its own, and its decode+upload wall must
+        # show up under this query, not nowhere
+        _ctx = _cur()
+        owner_qid = _ctx.query_id if _ctx is not None else None
+
+        def run_job(job):
+            if PROG_CTX.TRACKER is None or owner_qid is None:
+                return job()
+            t0 = time.perf_counter_ns()
+            out = job()
+            PROG_CTX.TRACKER.add_background(
+                owner_qid, "scan_prefetch",
+                time.perf_counter_ns() - t0)
+            return out
 
         def fill():
             while len(ring) < depth:
@@ -562,7 +580,7 @@ class TpuFileSourceScanExec(TpuExec):
                     job = next(jobs_it)
                 except StopIteration:
                     return
-                ring.append(pool.submit(job))
+                ring.append(pool.submit(run_job, job))
 
         try:
             fill()

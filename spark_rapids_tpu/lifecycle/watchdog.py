@@ -86,5 +86,15 @@ def _run() -> None:
                         # break the watchdog loop)
                         except Exception:
                             pass
+        # Progress stall scan (ISSUE 12): one ambient attribute read
+        # per period; with a live tracker installed, flag every query
+        # whose progress.stallMs elapsed with no operator advance —
+        # query_stall event + stalls_detected + a post-mortem naming
+        # the stuck operator.  scan_stalls never raises.
+        from spark_rapids_tpu.progress import context as _PROG
+
+        trk = _PROG.TRACKER
+        if trk is not None:
+            trk.scan_stalls(time.monotonic_ns())
         with _COND:
             _COND.wait(max(period, 0.005))

@@ -115,6 +115,11 @@ COUNTERS: Dict[str, int] = {
     "exchange_host_blocks": 0,
     "exchange_host_block_bytes": 0,
     "partitions_coalesced": 0,
+    # live progress tracking (ISSUE 12, progress/): watchdog-detected
+    # query stalls (no operator advanced for progress.stallMs) and live
+    # snapshots served (session.progress() + the /progress endpoint)
+    "stalls_detected": 0,
+    "progress_snapshots": 0,
     # ICI multi-chip shuffle (ISSUE 10): per-query collective-exchange
     # accounting — epochs through the mesh all-to-all stages, rows/bytes
     # exchanged device-to-device (never through the host), and the wall

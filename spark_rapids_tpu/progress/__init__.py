@@ -1,0 +1,132 @@
+"""Live query introspection (ISSUE 12): per-operator progress + ETA,
+causal attribution of background work, and heartbeat stall detection —
+what makes an in-flight query (and an 8-way stress run) legible while
+it is happening instead of only after it finishes or the watchdog
+kills it.
+
+Reference analog: Spark's UI + history server show live per-stage task
+progress for the reference plugin, and scheduler-layer work (Theseus,
+arXiv:2508.05029; Presto+GPU, arXiv:2606.24647) consumes exactly these
+live per-operator signals.  This package is the substrate:
+
+  context.py — the ambient TRACKER slot (ONE attribute read on every
+               hot path; None = disabled = zero progress calls)
+  tracker.py — ProgressTracker / QueryProgress / OpProgress: live
+               counts, cost-model joins (pct/ETA), background
+               attribution, the watchdog stall scan, snapshots
+
+Surfaces: ``TpuSession.progress()`` / ``spark_rapids_tpu.progress.
+snapshot()``, live ``df.explain("analyze")`` for an in-flight query,
+``GET /progress`` on the telemetry HTTP endpoint, per-tick aggregate
+gauges in the telemetry sampler, and ``tools/history.py`` — the query
+history server over the rotating diagnostics event logs.
+
+Overhead contract: with ``spark.rapids.tpu.progress.enabled=false``
+(the default) a collect makes ZERO calls into this package — every
+call site gates on the conf or the ambient ``context.TRACKER``
+attribute before importing anything here (tests/test_progress.py pins
+it with cProfile, the diagnostics/telemetry/profiling methodology).
+
+This ``__init__`` is deliberately lazy (the diagnostics pattern): the
+hot paths import only ``progress.context`` — so this module must not
+pull ``tracker`` in at import time; it loads on the first ENABLED
+query.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.progress import context as CTX
+
+_TRACKER_LOCK = threading.Lock()
+
+
+def ensure_tracker(max_finished: int = 32):
+    """Idempotent process-global install (called by the first enabled
+    collect): later queries reuse the tracker for the process's life —
+    multi-query by design, unlike the one-recorder diagnostics slot.
+    The finished-ring retention honors the LATEST conf (a later
+    session's ``progress.maxFinished`` resizes, not silently
+    ignores)."""
+    with _TRACKER_LOCK:
+        if CTX.TRACKER is None:
+            from spark_rapids_tpu.progress.tracker import ProgressTracker
+
+            CTX.TRACKER = ProgressTracker(max_finished=max_finished)
+        else:
+            CTX.TRACKER.set_max_finished(max_finished)
+        return CTX.TRACKER
+
+
+def get_tracker():
+    return CTX.TRACKER
+
+
+def shutdown() -> None:
+    """Clear the tracker slot (tests / process teardown); the next
+    enabled collect rebuilds."""
+    with _TRACKER_LOCK:
+        CTX.TRACKER = None
+
+
+def snapshot(include_finished: bool = True) -> List[Dict]:
+    """The live multi-query snapshot ('' when progress is off) — what
+    ``session.progress()`` and the /progress endpoint serve."""
+    trk = CTX.TRACKER
+    return trk.snapshot(include_finished) if trk is not None else []
+
+
+def snapshot_for(query_id: str) -> Optional[Dict]:
+    trk = CTX.TRACKER
+    return trk.snapshot_for(query_id) if trk is not None else None
+
+
+def _fmt_pct(p: Optional[float]) -> str:
+    return "   ?%" if p is None else f"{p * 100:4.0f}%"
+
+
+def render_snapshot(snap: Dict) -> str:
+    """One query's snapshot as the live operator table — the text
+    ``df.explain("analyze")`` shows for an in-flight query."""
+    eta = snap.get("eta_ms")
+    lines = [
+        f"query {snap['query_id']}"
+        + (f" (diagnostics {snap['diag_qid']})" if snap.get("diag_qid")
+           else "")
+        + f"  status={snap['status']}"
+        + f"  elapsed={snap['elapsed_ms']:.0f}ms"
+        + f"  pct={_fmt_pct(snap.get('pct')).strip()}"
+        + (f"  eta≈{eta:.0f}ms" if eta is not None else "  eta=?")
+        + (f"  STALLED (no advance for "
+           f"{snap['last_advance_ms_ago']:.0f}ms)"
+           if snap.get("stalled") else ""),
+    ]
+    stuck = snap.get("stuck_op")
+    if stuck is not None:
+        lines.append(f"  in flight: {stuck['name']} @ {stuck['path']}")
+    lines.append("  path     op                              pct  "
+                 "batches       rows   wall_ms  last_advance")
+    for op in snap.get("operators", []):
+        last = op.get("last_advance_ms_ago")
+        lines.append(
+            f"  {op['path']:<8} {op['name']:<30} "
+            f"{_fmt_pct(op.get('pct'))}  "
+            f"{op['batches']:>7} {op['rows']:>10} "
+            f"{op['wall_ms']:>9.1f}  "
+            + ("never" if last is None else f"{last:.0f}ms ago")
+            + ("  <- in flight" if op.get("in_flight") else ""))
+    bg = snap.get("background") or {}
+    if bg:
+        lines.append("  background (attributed to this query):")
+        for kind in sorted(bg):
+            b = bg[kind]
+            lines.append(f"    {kind:<18} {b['events']:>5} events  "
+                         f"{b['wall_ns'] / 1e6:>9.1f}ms")
+    return "\n".join(lines)
+
+
+__all__ = [
+    "ensure_tracker", "get_tracker", "render_snapshot", "shutdown",
+    "snapshot", "snapshot_for",
+]

@@ -1,0 +1,30 @@
+"""Progress context — the ONLY module the hot paths import.
+
+One piece of ambient state: ``TRACKER`` — the process-wide
+:class:`~spark_rapids_tpu.progress.tracker.ProgressTracker` (or None).
+Like ``diagnostics.context.RECORDER`` it is deliberately a plain module
+attribute, not a contextvar: background pool threads (AOT compile,
+scan prefetch, shuffle writers) attribute their wall to the owning
+query through it, and a contextvar would silently lose their deltas.
+Unlike the diagnostics recorder the tracker is MULTI-query: it holds
+one live :class:`QueryProgress` per in-flight lifecycle query, which is
+what makes an 8-way stress run legible while it is happening.
+
+Disabled-path contract (the ISSUE 3 pattern): every instrumentation
+site performs exactly ONE ambient check — ``if CTX.TRACKER is None``
+(an attribute read, not a call) — before doing any other Python work.
+tests/test_progress.py pins it with cProfile: a collect with
+``spark.rapids.tpu.progress.enabled=false`` makes ZERO calls into any
+``progress/`` module.
+
+Written only by ``progress.ensure_tracker`` / ``progress.shutdown``
+under ``_TRACKER_LOCK``; read lock-free from hot paths.
+"""
+from __future__ import annotations
+
+TRACKER = None
+
+
+def active():
+    """The active tracker or None (one ambient check)."""
+    return TRACKER

@@ -1,0 +1,514 @@
+"""ProgressTracker — the process-global live view of in-flight queries.
+
+One :class:`QueryProgress` exists per lifecycle-managed ``collect()``
+whose conf enables ``spark.rapids.tpu.progress.enabled``; the exec
+layer's batch-pull wrapper (``exec/base._progress``) advances the
+owning operator's row/batch/byte counts on every pull, background pools
+(AOT compile, scan prefetch, shuffle writers) attribute their wall to
+the owning query by id, and the watchdog's stall scan runs here.
+
+Percent-complete and ETA come from joining the live counts against the
+PR 8 cost model at registration time:
+
+* per operator — rows produced / plan-predicted rows
+  (``aot_output_rows``) when the plan can predict the output, else
+  accumulated pull wall / calibrated predicted self wall
+  (``profiling.model.QueryPrediction``), else unknown; a finished
+  operator is 1.0 and an unfinished one is capped at 0.99, so progress
+  is MONOTONE (counts only grow and the caps only release on finish).
+* per query — predicted-wall-weighted mean of the known operator
+  percentages; ETA is the predicted remaining wall
+  ``sum(predicted_self_wall * (1 - pct))`` when predictions exist,
+  else an elapsed-time extrapolation once the query is >5% complete.
+
+Ownership discipline (the cross-attribution contract pinned by
+tests/test_progress.py): an operator advance counts ONLY when the
+exec node's registration stamp (``_prog_qid``) matches the pulling
+thread's ambient ``lifecycle`` QueryContext — a concurrent collect of
+a shared cached exec tree, or a stamp left behind by a finished query,
+attributes nowhere rather than to the wrong query.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from spark_rapids_tpu.lifecycle.context import CURRENT as _QCTX
+
+# unfinished operators cap below 1.0: only StopIteration proves done
+_PCT_CAP = 0.99
+
+
+class OpProgress:
+    """Per-operator live accumulation."""
+
+    __slots__ = ("path", "name", "describe", "batches", "rows", "bytes",
+                 "wall_ns", "predicted_rows", "predicted_wall_ns",
+                 "started_ns", "last_advance_ns", "finished")
+
+    def __init__(self, path: str, name: str, describe: str):
+        self.path = path
+        self.name = name
+        self.describe = describe
+        self.batches = 0
+        self.rows = 0
+        self.bytes = 0
+        self.wall_ns = 0
+        self.predicted_rows: Optional[int] = None
+        self.predicted_wall_ns = 0.0
+        self.started_ns: Optional[int] = None
+        self.last_advance_ns: Optional[int] = None
+        self.finished = False
+
+    def pct(self) -> Optional[float]:
+        if self.finished:
+            return 1.0
+        if self.predicted_rows:
+            return min(self.rows / self.predicted_rows, _PCT_CAP)
+        if self.predicted_wall_ns > 0:
+            return min(self.wall_ns / self.predicted_wall_ns, _PCT_CAP)
+        return None
+
+
+class QueryProgress:
+    """Everything tracked for one live query."""
+
+    __slots__ = ("query_id", "diag_qid", "started_ns", "stall_ms",
+                 "ops", "op_order", "pull_stack", "background",
+                 "last_activity_ns", "stall_flagged", "stalls",
+                 "status", "finished", "finished_ns",
+                 "predicted_total_wall_ns", "stamp_lost")
+
+    def __init__(self, query_id: str, stall_ms: float,
+                 diag_qid: Optional[str]):
+        self.query_id = query_id
+        self.diag_qid = diag_qid
+        self.started_ns = time.monotonic_ns()
+        self.stall_ms = float(stall_ms)
+        self.ops: Dict[str, OpProgress] = {}
+        self.op_order: List[str] = []
+        # innermost in-flight pull last: the operator actually doing
+        # the work when the query wedges (the exec chain is driven by
+        # ONE thread, so a plain stack is exact)
+        self.pull_stack: List[str] = []
+        # kind -> {"wall_ns": int, "events": int} for background pools
+        self.background: Dict[str, Dict[str, int]] = {}
+        self.last_activity_ns: Optional[int] = None
+        self.stall_flagged = False
+        self.stalls = 0
+        self.status = "running"
+        self.finished = False
+        self.finished_ns: Optional[int] = None
+        self.predicted_total_wall_ns = 0.0
+        # a LATER register() of the same cached plan root overwrote
+        # this query's ownership stamps: its pulls now attribute
+        # nowhere (by design), so the stall detector must not misread
+        # the frozen activity clock as a wedge
+        self.stamp_lost = False
+
+    # caller holds the tracker lock for everything below -----------------
+    def pct_locked(self) -> Optional[float]:
+        num = den = 0.0
+        uniform: List[float] = []
+        for st in self.ops.values():
+            p = st.pct()
+            if p is None:
+                continue
+            if st.predicted_wall_ns > 0:
+                num += st.predicted_wall_ns * p
+                den += st.predicted_wall_ns
+            uniform.append(p)
+        if den > 0:
+            return num / den
+        if uniform:
+            return sum(uniform) / len(uniform)
+        return None
+
+    def eta_ns_locked(self, now_ns: int) -> Optional[int]:
+        if self.finished:
+            return 0
+        rem = 0.0
+        have_pred = False
+        for st in self.ops.values():
+            if st.predicted_wall_ns > 0:
+                have_pred = True
+                rem += st.predicted_wall_ns * (1.0 - (st.pct() or 0.0))
+        if have_pred:
+            return int(rem)
+        pct = self.pct_locked()
+        if pct is not None and pct > 0.05:
+            elapsed = now_ns - self.started_ns
+            return int(elapsed * (1.0 - pct) / pct)
+        return None
+
+    def stuck_op_locked(self) -> Optional[OpProgress]:
+        if self.pull_stack:
+            return self.ops.get(self.pull_stack[-1])
+        return None
+
+
+class ProgressTracker:
+    """The process-global registry of live (and recently finished)
+    query progress states.  All mutation happens under one lock; the
+    per-batch enabled-path cost is two short lock acquisitions per pull
+    (begin/end), the same order of cost as the diagnostics recorder's
+    span bookkeeping."""
+
+    def __init__(self, max_finished: int = 32):
+        self._lock = threading.Lock()
+        self._queries: Dict[str, QueryProgress] = {}
+        self._finished: deque = deque(maxlen=max(int(max_finished), 1))
+
+    def set_max_finished(self, max_finished: int) -> None:
+        """Resize the finished ring to the latest conf (keeps the
+        newest entries when shrinking)."""
+        n = max(int(max_finished), 1)
+        with self._lock:
+            if self._finished.maxlen != n:
+                self._finished = deque(self._finished, maxlen=n)
+
+    # -- registration ----------------------------------------------------
+    def register(self, qctx, root, stall_ms: float = 0.0,
+                 prediction=None, diag_qid: Optional[str] = None) -> None:
+        """Walk the planned exec tree: stamp every TpuExec with this
+        query's ownership (``_prog_qid``/``_prog_path``), create its
+        live stat bucket, and join the PR 8 prediction (per-operator
+        predicted self wall) plus the plan-side row estimate
+        (``aot_output_rows``) for percent/ETA rendering."""
+        from spark_rapids_tpu.exec.base import TpuExec
+
+        qp = QueryProgress(qctx.query_id, stall_ms, diag_qid)
+        pred_by_path = prediction.by_path() if prediction is not None else {}
+        prior_qids = set()
+
+        def walk(node, path):
+            prior = getattr(node, "_prog_qid", None)
+            if prior is not None and prior != qp.query_id:
+                prior_qids.add(prior)
+            node._prog_qid = qp.query_id
+            node._prog_path = path
+            st = OpProgress(path, node.node_name, node.describe())
+            try:
+                rows = node.aot_output_rows()
+                if rows:
+                    st.predicted_rows = int(sum(rows))
+            except Exception:
+                st.predicted_rows = None
+            p = pred_by_path.get(path)
+            if p is not None and p.matched != "miss":
+                st.predicted_wall_ns = float(p.predicted_self_wall_ns)
+                qp.predicted_total_wall_ns += st.predicted_wall_ns
+            qp.ops[path] = st
+            qp.op_order.append(path)
+            for i, c in enumerate(node.children):
+                if isinstance(c, TpuExec):
+                    walk(c, f"{path}.{i}")
+
+        walk(root, "0")
+        with self._lock:
+            # a concurrent collect of the SAME cached plan root: the
+            # earlier query's stamps are gone, so its activity clock
+            # freezes — exempt it from stall detection (a false
+            # "wedged" alarm for a query making normal progress)
+            for prior in prior_qids:
+                live = self._queries.get(prior)
+                if live is not None and not live.finished:
+                    live.stamp_lost = True
+            self._queries[qp.query_id] = qp
+
+    def mark_untracked(self, query_id: str) -> None:
+        """The query left the tracked execution path but is still
+        running (whole-query CPU-oracle fallback): its batch pulls stop
+        and the activity clock freezes BY DESIGN, so exempt it from
+        stall detection instead of flagging a query that is actively
+        completing on the CPU."""
+        with self._lock:
+            qp = self._queries.get(query_id)
+            if qp is not None:
+                qp.stamp_lost = True
+
+    def finish_query(self, query_id: str, status: str = "ok") -> None:
+        """Move a query to the finished ring and emit the ``progress``
+        diagnostics summary event into its own recorder (still open:
+        this runs inside the query's diagnostics scope)."""
+        now = time.monotonic_ns()
+        with self._lock:
+            qp = self._queries.pop(query_id, None)
+            if qp is None:
+                return
+            qp.finished = True
+            qp.finished_ns = now
+            qp.status = status
+            for st in qp.ops.values():
+                if status == "ok":
+                    st.finished = True
+            self._finished.append(qp)
+            snap = self._snapshot_one_locked(qp, now)
+        self._emit_progress_event(qp, snap)
+
+    def _emit_progress_event(self, qp: QueryProgress, snap: Dict) -> None:
+        try:
+            from spark_rapids_tpu.diagnostics import context as _DIAG
+
+            rec = _DIAG.RECORDER
+            if rec is not None and qp.diag_qid is not None \
+                    and rec.query_id == qp.diag_qid:
+                rec.progress_summary(
+                    query_id=qp.query_id,
+                    pct=snap.get("pct"),
+                    eta_ns=snap.get("eta_ns"),
+                    stalls=qp.stalls,
+                    background={k: dict(v)
+                                for k, v in qp.background.items()})
+        except Exception:
+            # progress must never fail (or re-order) a finishing query
+            pass
+
+    # -- the hot path (exec/base._progress) ------------------------------
+    def begin_pull(self, op):
+        """Start one batch pull; returns an opaque handle or None when
+        the pull must run untracked (no ambient query, or the node's
+        stamp belongs to a different query than the pulling thread's —
+        the cross-attribution guard)."""
+        ctx = _QCTX.get()
+        if ctx is None:
+            return None
+        qid = getattr(op, "_prog_qid", None)
+        if qid != ctx.query_id:
+            return None
+        path = getattr(op, "_prog_path", None)
+        t0 = time.monotonic_ns()
+        with self._lock:
+            qp = self._queries.get(qid)
+            if qp is None:
+                return None
+            st = qp.ops.get(path)
+            if st is None:
+                return None
+            if st.started_ns is None:
+                st.started_ns = t0
+            qp.pull_stack.append(path)
+            return (qp, st, t0)
+
+    def end_pull(self, handle, rows: Optional[int], nbytes: int,
+                 finished: bool) -> None:
+        qp, st, t0 = handle
+        now = time.monotonic_ns()
+        with self._lock:
+            if qp.pull_stack and qp.pull_stack[-1] == st.path:
+                qp.pull_stack.pop()
+            elif st.path in qp.pull_stack:
+                qp.pull_stack.remove(st.path)
+            st.wall_ns += now - t0
+            if finished:
+                st.finished = True
+            elif rows is not None:
+                st.batches += 1
+                st.rows += rows
+                st.bytes += nbytes
+            st.last_advance_ns = now
+            qp.last_activity_ns = now
+            # an advance ends the current stall episode; the detector
+            # re-arms and a LATER wedge reports as a fresh stall
+            qp.stall_flagged = False
+
+    # -- background attribution ------------------------------------------
+    def add_background(self, query_id: Optional[str], kind: str,
+                       wall_ns: int, n: int = 1) -> None:
+        """Attribute ``wall_ns`` of pool-thread work (AOT compile, scan
+        prefetch upload, shuffle-write serialization) to the owning
+        query — its wall shows up under that query, not nowhere.  A
+        job whose owner already finished attributes to the finished
+        snapshot if still retained, else drops silently."""
+        if not query_id:
+            return
+        now = time.monotonic_ns()
+        with self._lock:
+            qp = self._queries.get(query_id)
+            if qp is None:
+                qp = next((f for f in reversed(self._finished)
+                           if f.query_id == query_id), None)
+            if qp is None:
+                return
+            b = qp.background.setdefault(kind, {"wall_ns": 0, "events": 0})
+            b["wall_ns"] += int(wall_ns)
+            b["events"] += int(n)
+            if not qp.finished:
+                qp.last_activity_ns = now
+                qp.stall_flagged = False
+
+    # -- stall detection (lifecycle/watchdog.py) -------------------------
+    def scan_stalls(self, now_ns: int) -> List[Dict[str, Any]]:
+        """One watchdog-period scan: flag every live query whose
+        configured ``progress.stallMs`` elapsed with NO operator
+        advance (and no background attribution), bump
+        ``stalls_detected``, emit the ``query_stall`` diagnostics event
+        naming the stuck operator, and trigger a flight-recorder
+        post-mortem embedding the live progress snapshot.  Never
+        raises: a broken emission path must not kill the watchdog."""
+        stalled = []
+        with self._lock:
+            for qp in self._queries.values():
+                if qp.finished or qp.stall_ms <= 0 or qp.stall_flagged \
+                        or qp.stamp_lost:
+                    continue
+                last = qp.last_activity_ns or qp.started_ns
+                stalled_ms = (now_ns - last) / 1e6
+                if stalled_ms < qp.stall_ms:
+                    continue
+                qp.stall_flagged = True
+                qp.stalls += 1
+                stuck = qp.stuck_op_locked()
+                stalled.append({
+                    "query_id": qp.query_id,
+                    "diag_qid": qp.diag_qid,
+                    "stalled_ms": stalled_ms,
+                    "path": stuck.path if stuck is not None else "",
+                    "name": stuck.name if stuck is not None else "",
+                })
+        for s in stalled:
+            self._report_stall(s)
+        return stalled
+
+    def _report_stall(self, s: Dict[str, Any]) -> None:
+        try:
+            from spark_rapids_tpu import perfcounters as PC
+
+            PC.bump("stalls_detected")
+            detail = (f"no operator advanced for {s['stalled_ms']:.0f}ms "
+                      f"(spark.rapids.tpu.progress.stallMs); stuck in "
+                      f"{s['name'] or '(no in-flight operator)'}"
+                      + (f" at {s['path']}" if s["path"] else ""))
+            from spark_rapids_tpu.diagnostics import context as _DIAG
+
+            rec = _DIAG.RECORDER
+            if rec is not None and s["diag_qid"] is not None \
+                    and rec.query_id == s["diag_qid"]:
+                rec.query_stall(s["query_id"], s["path"], s["name"],
+                                s["stalled_ms"], detail)
+            from spark_rapids_tpu.telemetry import context as _TEL
+
+            hub = _TEL.HUB
+            if hub is not None:
+                hub.record_event("query_stall", query_id=s["query_id"],
+                                 op=s["name"], path=s["path"],
+                                 stalled_ms=round(s["stalled_ms"], 1))
+                hub.postmortem("query_stall", query_id=s["query_id"],
+                               detail=detail, claim_query=False)
+        except Exception:
+            # stall REPORTING is best-effort; the watchdog loop (and
+            # the query itself) must survive any telemetry failure
+            pass
+
+    # -- snapshots --------------------------------------------------------
+    def _snapshot_one_locked(self, qp: QueryProgress,
+                             now_ns: int) -> Dict[str, Any]:
+        end = qp.finished_ns if qp.finished else now_ns
+        last = qp.last_activity_ns or qp.started_ns
+        stuck = qp.stuck_op_locked()
+        eta_ns = qp.eta_ns_locked(now_ns)
+        ops = []
+        for path in qp.op_order:
+            st = qp.ops[path]
+            ops.append({
+                "path": st.path, "name": st.name,
+                "describe": st.describe,
+                "batches": st.batches, "rows": st.rows,
+                "bytes": st.bytes,
+                "wall_ms": round(st.wall_ns / 1e6, 3),
+                "pct": st.pct(),
+                "predicted_rows": st.predicted_rows,
+                "predicted_wall_ms": round(
+                    st.predicted_wall_ns / 1e6, 3),
+                "finished": st.finished,
+                "in_flight": path in qp.pull_stack,
+                "last_advance_ms_ago": (
+                    None if st.last_advance_ns is None
+                    else round((end - st.last_advance_ns) / 1e6, 1)),
+            })
+        return {
+            "query_id": qp.query_id,
+            "diag_qid": qp.diag_qid,
+            "status": qp.status,
+            "elapsed_ms": round((end - qp.started_ns) / 1e6, 3),
+            "pct": qp.pct_locked(),
+            "eta_ns": eta_ns,
+            "eta_ms": None if eta_ns is None else round(eta_ns / 1e6, 1),
+            "predicted_wall_ms": round(
+                qp.predicted_total_wall_ns / 1e6, 3),
+            "stalls": qp.stalls,
+            "stalled": qp.stall_flagged,
+            "stamp_lost": qp.stamp_lost,
+            "last_advance_ms_ago": round((now_ns - last) / 1e6, 1),
+            "stuck_op": (None if stuck is None else
+                         {"path": stuck.path, "name": stuck.name}),
+            "operators": ops,
+            "background": {k: dict(v) for k, v in qp.background.items()},
+        }
+
+    def snapshot(self, include_finished: bool = True) -> List[Dict]:
+        """The live view: one dict per in-flight query (plus recently
+        finished ones), newest last.  Counted by ``progress_snapshots``
+        — the surface the /progress endpoint and ``session.progress()``
+        serve."""
+        from spark_rapids_tpu import perfcounters as PC
+
+        PC.bump("progress_snapshots")
+        now = time.monotonic_ns()
+        with self._lock:
+            # newest last by REGISTRATION TIME — unpadded "q<n>" ids
+            # sort lexicographically (q10 < q2), not chronologically
+            pairs = [(qp.started_ns, self._snapshot_one_locked(qp, now))
+                     for qp in self._queries.values()]
+            if include_finished:
+                pairs.extend((qp.started_ns,
+                              self._snapshot_one_locked(qp, now))
+                             for qp in self._finished)
+        pairs.sort(key=lambda p: p[0])
+        return [snap for _, snap in pairs]
+
+    def snapshot_for(self, query_id: str) -> Optional[Dict]:
+        """One query's snapshot (live or recently finished) — what the
+        flight-recorder bundle embeds."""
+        now = time.monotonic_ns()
+        with self._lock:
+            qp = self._queries.get(query_id)
+            if qp is None:
+                qp = next((f for f in reversed(self._finished)
+                           if f.query_id == query_id), None)
+            if qp is None:
+                return None
+            return self._snapshot_one_locked(qp, now)
+
+    def aggregate_stats(self) -> Dict[str, float]:
+        """Peek-only per-tick aggregates for the telemetry sampler:
+        queries running, min/median percent-complete, stalled count."""
+        with self._lock:
+            pcts = []
+            stalled = 0
+            n = 0
+            for qp in self._queries.values():
+                if qp.finished:
+                    continue
+                n += 1
+                if qp.stall_flagged:
+                    stalled += 1
+                p = qp.pct_locked()
+                if p is not None:
+                    pcts.append(p)
+        pcts.sort()
+        return {
+            "progress_queries_running": float(n),
+            "progress_min_pct": pcts[0] if pcts else 0.0,
+            "progress_median_pct": (pcts[len(pcts) // 2]
+                                    if pcts else 0.0),
+            "progress_stalled": float(stalled),
+        }
+
+    def clear(self) -> None:
+        """Test hook: drop every live and finished state."""
+        with self._lock:
+            self._queries.clear()
+            self._finished.clear()

@@ -122,6 +122,18 @@ class TpuSession:
         self.conf = self.conf.set(key, value)
         return self
 
+    def progress(self, include_finished: bool = True) -> List[dict]:
+        """Live multi-query progress snapshot (ISSUE 12): one dict per
+        in-flight (and recently finished) lifecycle-managed query on
+        this PROCESS — per-operator batches/rows/bytes, percent/ETA
+        from the cost-model join, attributed background work, and stall
+        state.  Empty when spark.rapids.tpu.progress.enabled never
+        enabled a query.  The same payload the telemetry endpoint's
+        /progress route serves (docs/progress.md)."""
+        from spark_rapids_tpu.progress import snapshot
+
+        return snapshot(include_finished)
+
     # -- data sources ---------------------------------------------------
     def create_dataframe(self, data, schema: T.StructType) -> "DataFrame":
         if isinstance(data, dict):
@@ -674,6 +686,22 @@ class DataFrame:
                     record_query(diag, _conf,
                                  prediction=_box["pred"])
 
+            # Progress (ISSUE 12): lifecycle-managed queries register
+            # with the process-global live tracker.  Disabled (default):
+            # one conf read, zero progress-module calls (pinned by
+            # tests/test_progress.py).
+            prog_trk = None
+            if qctx is not None:
+                from spark_rapids_tpu.config import (
+                    PROGRESS_ENABLED,
+                    PROGRESS_MAX_FINISHED,
+                )
+
+                if self.session.conf.get(PROGRESS_ENABLED):
+                    from spark_rapids_tpu.progress import ensure_tracker
+
+                    prog_trk = ensure_tracker(int(
+                        self.session.conf.get(PROGRESS_MAX_FINISHED)))
             scope = query_scope(self.session.conf, root,
                                 on_finish=on_finish)
             try:
@@ -687,15 +715,6 @@ class DataFrame:
                         scope.diag.lifecycle(
                             "admitted", qctx.query_id,
                             qctx.admission_wait_ns)
-                    # Plan-time AOT pipeline (compilecache/aot.py): enumerate
-                    # the stage programs this exec tree will need and compile
-                    # them on the background pool NOW, so the first operator's
-                    # first batch overlaps the compiles of everything
-                    # downstream.  Idempotent per planned tree; a warm-up
-                    # failure never reaches the query.
-                    from spark_rapids_tpu.compilecache import maybe_submit_aot
-
-                    maybe_submit_aot(root, self.session.conf)
                     # Plan-time cost model (ISSUE 8): predict each
                     # operator's wall/transfer from the calibration
                     # store BEFORE execution (cost_model_* counters land
@@ -710,63 +729,137 @@ class DataFrame:
                         cost_box["pred"] = annotate_plan(
                             root, self.session.conf,
                             attributed=scope.diag is not None)
-                    # Admission control: the thread driving this query's
-                    # iterator chain holds a TpuSemaphore permit while it
-                    # touches the device (reference:
-                    # GpuSemaphore.acquireIfNecessary at first batch).
-                    from spark_rapids_tpu.memory import (
-                        get_semaphore,
-                        get_spill_framework,
-                    )
-                    from spark_rapids_tpu.memory.retry import (
-                        force_retry_oom,
-                        force_split_and_retry_oom,
-                    )
-                    from spark_rapids_tpu.config import (
-                        TEST_RETRY_OOM_INJECTION_MODE,
-                    )
-
-                    get_spill_framework(self.session.conf)
-                    inject = self.session.conf.get(TEST_RETRY_OOM_INJECTION_MODE)
-                    if inject and inject != "NONE":
-                        kind, _, n = inject.partition(":")
-                        if kind.upper() == "RETRY":
-                            force_retry_oom(int(n or 1))
-                        elif kind.upper() == "SPLIT":
-                            force_split_and_retry_oom(int(n or 1))
-                    # chaos injection (the force_retry_oom API generalized to
-                    # compile/transient/poison faults at named operators);
-                    # armed once per distinct spec, process-global like the
-                    # fault list
-                    from spark_rapids_tpu.config import RESILIENCE_TEST_INJECT
-                    from spark_rapids_tpu.resilience.faults import arm_conf_spec
-
-                    arm_conf_spec(self.session.conf.get(RESILIENCE_TEST_INJECT))
-                    from spark_rapids_tpu.config import (
-                        SEMAPHORE_ACQUIRE_TIMEOUT_MS,
-                    )
-
-                    sem_timeout_ms = int(self.session.conf.get(
-                        SEMAPHORE_ACQUIRE_TIMEOUT_MS))
-                    sem = get_semaphore(self.session.conf.concurrent_tpu_tasks)
-                    try:
-                        with sem.scope(
-                                timeout=(sem_timeout_ms / 1000.0
-                                         if sem_timeout_ms > 0 else None)):
-                            host = TpuColumnarToRowExec(root).collect_host()
-                    except Exception as e:
-                        from spark_rapids_tpu.lifecycle.context import (
-                            QueryCancelled,
-                            QueryDeadlineExceeded,
+                    # Progress registration AFTER the cost model ran:
+                    # the prediction joins per-operator predicted walls
+                    # into percent-complete / ETA; without a store the
+                    # tracker falls back to plan row estimates
+                    if prog_trk is not None:
+                        from spark_rapids_tpu.config import (
+                            PROGRESS_STALL_MS,
                         )
 
-                        if isinstance(e, QueryCancelled) \
-                                and scope.diag is not None:
-                            scope.diag.lifecycle(
-                                "deadline_trip"
-                                if isinstance(e, QueryDeadlineExceeded)
-                                else "cancelled", str(e))
-                        host = self._query_fallback(e)
+                        prog_trk.register(
+                            qctx, root,
+                            stall_ms=float(self.session.conf.get(
+                                PROGRESS_STALL_MS)),
+                            prediction=cost_box["pred"],
+                            diag_qid=(scope.diag.query_id
+                                      if scope.diag is not None
+                                      else None))
+                        # live explain("analyze") key: while this
+                        # collect is in flight, analyze renders the
+                        # LIVE snapshot instead of the last post-hoc
+                        # recorder
+                        self._live_progress_qid = qctx.query_id
+                    # progress finish must cover EVERYTHING after
+                    # registration: a raise below (bad injection spec,
+                    # semaphore conf parse) would otherwise leave a
+                    # ghost "running" query in the tracker forever
+                    _prog_status = "error"
+                    try:
+                        # Plan-time AOT pipeline (compilecache/aot.py):
+                        # enumerate the stage programs this exec tree
+                        # will need and compile them on the background
+                        # pool NOW, so the first operator's first batch
+                        # overlaps the compiles of everything
+                        # downstream.  Idempotent per planned tree; a
+                        # warm-up failure never reaches the query.
+                        # AFTER progress registration: a compile
+                        # finishing before register() would drop its
+                        # background attribution on the floor.
+                        from spark_rapids_tpu.compilecache import (
+                            maybe_submit_aot,
+                        )
+
+                        maybe_submit_aot(root, self.session.conf)
+                        # Admission control: the thread driving this
+                        # query's iterator chain holds a TpuSemaphore
+                        # permit while it touches the device (reference:
+                        # GpuSemaphore.acquireIfNecessary at first
+                        # batch).
+                        from spark_rapids_tpu.memory import (
+                            get_semaphore,
+                            get_spill_framework,
+                        )
+                        from spark_rapids_tpu.memory.retry import (
+                            force_retry_oom,
+                            force_split_and_retry_oom,
+                        )
+                        from spark_rapids_tpu.config import (
+                            TEST_RETRY_OOM_INJECTION_MODE,
+                        )
+
+                        get_spill_framework(self.session.conf)
+                        inject = self.session.conf.get(
+                            TEST_RETRY_OOM_INJECTION_MODE)
+                        if inject and inject != "NONE":
+                            kind, _, n = inject.partition(":")
+                            if kind.upper() == "RETRY":
+                                force_retry_oom(int(n or 1))
+                            elif kind.upper() == "SPLIT":
+                                force_split_and_retry_oom(int(n or 1))
+                        # chaos injection (the force_retry_oom API
+                        # generalized to compile/transient/poison faults
+                        # at named operators); armed once per distinct
+                        # spec, process-global like the fault list
+                        from spark_rapids_tpu.config import (
+                            RESILIENCE_TEST_INJECT,
+                        )
+                        from spark_rapids_tpu.resilience.faults import (
+                            arm_conf_spec,
+                        )
+
+                        arm_conf_spec(self.session.conf.get(
+                            RESILIENCE_TEST_INJECT))
+                        from spark_rapids_tpu.config import (
+                            SEMAPHORE_ACQUIRE_TIMEOUT_MS,
+                        )
+
+                        sem_timeout_ms = int(self.session.conf.get(
+                            SEMAPHORE_ACQUIRE_TIMEOUT_MS))
+                        sem = get_semaphore(
+                            self.session.conf.concurrent_tpu_tasks)
+                        try:
+                            with sem.scope(
+                                    timeout=(sem_timeout_ms / 1000.0
+                                             if sem_timeout_ms > 0
+                                             else None)):
+                                host = TpuColumnarToRowExec(
+                                    root).collect_host()
+                        except Exception as e:
+                            from spark_rapids_tpu.lifecycle.context import (
+                                QueryCancelled,
+                                QueryDeadlineExceeded,
+                            )
+
+                            if isinstance(e, QueryCancelled) \
+                                    and scope.diag is not None:
+                                scope.diag.lifecycle(
+                                    "deadline_trip"
+                                    if isinstance(e, QueryDeadlineExceeded)
+                                    else "cancelled", str(e))
+                            # the whole-query CPU re-run makes no batch
+                            # pulls: exempt it from stall detection so
+                            # the frozen clock is not read as a wedge
+                            if prog_trk is not None:
+                                prog_trk.mark_untracked(qctx.query_id)
+                            host = self._query_fallback(e)
+                        _prog_status = "ok"
+                    except BaseException as _pe:
+                        _prog_status = type(_pe).__name__
+                        raise
+                    finally:
+                        # progress finish INSIDE the diagnostics scope:
+                        # the summary event must land before query_end.
+                        # Compare-and-clear the live-explain key: a
+                        # concurrent collect of the same DataFrame may
+                        # have overwritten it with ITS query id
+                        if prog_trk is not None:
+                            if getattr(self, "_live_progress_qid",
+                                       None) == qctx.query_id:
+                                self._live_progress_qid = None
+                            prog_trk.finish_query(qctx.query_id,
+                                                  _prog_status)
             finally:
                 # None when this collect ran unrecorded; assigned on the
                 # FAILURE path too — explain("analyze") must not report a
@@ -874,6 +967,24 @@ class DataFrame:
             from spark_rapids_tpu.profiling import explain_cost
 
             return explain_cost(self)
+        if mode == "analyze":
+            # Live introspection (ISSUE 12): while a collect of this
+            # DataFrame is in flight, analyze renders the LIVE progress
+            # snapshot (operator table, pct/ETA, background work)
+            # instead of the last finished recorder — checked BEFORE
+            # _planned() so an explain from another thread never
+            # touches plan state mid-collect
+            qid = getattr(self, "_live_progress_qid", None)
+            if qid is not None:
+                from spark_rapids_tpu.progress import (
+                    render_snapshot,
+                    snapshot_for,
+                )
+
+                snap = snapshot_for(qid)
+                if snap is not None and snap["status"] == "running":
+                    return ("live progress (query in flight — see "
+                            "docs/progress.md):\n" + render_snapshot(snap))
         root, meta = self._planned()
         if mode == "analyze":
             if not isinstance(root, TpuExec):

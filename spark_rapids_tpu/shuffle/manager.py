@@ -18,6 +18,7 @@ import itertools
 import os
 import tempfile
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from spark_rapids_tpu import types as T
@@ -150,11 +151,20 @@ class TpuShuffleManager:
     def write_map_output(self, shuffle_id: int, map_id: int,
                          slices: List[ColumnarBatch]) -> None:
         """Write one map task's partition slices (pid = index)."""
-        from spark_rapids_tpu.lifecycle.context import current_token
+        from spark_rapids_tpu.lifecycle.context import (
+            current,
+            current_token,
+        )
+        from spark_rapids_tpu.progress import context as PROG_CTX
 
         token = current_token()   # captured HERE: pool threads have no
         if token is not None:     # query contextvar of their own
             token.check()
+        # progress attribution (ISSUE 12): like the token, the owning
+        # query id is captured on the submitting thread so pool-side
+        # serialization wall lands under the right query
+        ctx = current()
+        owner_qid = ctx.query_id if ctx is not None else None
         if self.mode == "CACHE_ONLY":
             for pid, b in enumerate(slices):
                 if b is not None and b.num_rows > 0:
@@ -168,8 +178,16 @@ class TpuShuffleManager:
             # serialization jobs bail instead of burning the pool
             if token is not None:
                 token.check()
+            if PROG_CTX.TRACKER is None or owner_qid is None:
+                blob = serialize_batch(batch, codec=self.codec)
+                self.store.put((shuffle_id, map_id, pid), blob)
+                return len(blob)
+            t0 = time.perf_counter_ns()
             blob = serialize_batch(batch, codec=self.codec)
             self.store.put((shuffle_id, map_id, pid), blob)
+            PROG_CTX.TRACKER.add_background(
+                owner_qid, "shuffle_write",
+                time.perf_counter_ns() - t0)
             return len(blob)
 
         futures = [pool.submit(job, pid, b) for pid, b in enumerate(slices)

@@ -182,23 +182,32 @@ class TelemetryHub:
     def postmortem(self, reason: str, query_id: str = "",
                    detail: str = "",
                    offender_ident: Optional[int] = None,
-                   force: bool = False) -> Optional[Dict[str, Any]]:
+                   force: bool = False,
+                   claim_query: bool = True) -> Optional[Dict[str, Any]]:
         """Build (and optionally persist) one post-mortem bundle.
         Deduped per query (a deadline trip dumps from the watchdog; the
         same query's collect unwinding must not dump again) and
-        rate-limited per reason against failure storms."""
+        rate-limited per reason against failure storms.
+
+        ``claim_query=False`` (the stall detector, ISSUE 12): the dump
+        neither consumes nor honors the per-query dedup slot — a stall
+        bundle must not suppress the later deadline-trip bundle for the
+        same query (nor be suppressed by it), and a re-armed second
+        stall episode may dump again; the per-reason rate limit is the
+        storm guard on this path."""
         if not self.flight_enabled:
             return None
         now = time.monotonic()
         with self._dump_lock:
             if not force:
-                if query_id and query_id in self._dumped_qids:
+                if claim_query and query_id \
+                        and query_id in self._dumped_qids:
                     return None
                 last = self._last_dump_ts.get(reason, 0.0)
                 if now - last < _DUMP_MIN_INTERVAL_S:
                     return None
             self._last_dump_ts[reason] = now
-            if query_id:
+            if query_id and claim_query:
                 self._dumped_qids[query_id] = now
                 while len(self._dumped_qids) > 256:
                     self._dumped_qids.popitem(last=False)

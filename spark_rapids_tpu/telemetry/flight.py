@@ -86,6 +86,22 @@ def _active_query_table() -> List[Dict[str, Any]]:
     return rows
 
 
+def _progress_snapshot(query_id: str) -> Optional[Dict[str, Any]]:
+    """The offender's live progress snapshot (ISSUE 12): the operator
+    table with last-advance timestamps, so a deadline-trip dump says
+    *where* the query was stuck, not just which threads existed.  None
+    when progress tracking is off (the default) or the query is
+    unknown; never raises (a dump must not fail on its garnish)."""
+    if not query_id:
+        return None
+    try:
+        from spark_rapids_tpu.progress import snapshot_for
+
+        return snapshot_for(query_id)
+    except Exception:
+        return None
+
+
 def build_bundle(recorder: FlightRecorder, reason: str,
                  query_id: str = "", detail: str = "",
                  offender_ident: Optional[int] = None) -> Dict[str, Any]:
@@ -102,6 +118,7 @@ def build_bundle(recorder: FlightRecorder, reason: str,
         "counters": PC.snapshot(),
         "active_queries": _active_query_table(),
         "thread_stacks": _thread_stacks(offender_ident),
+        "progress": _progress_snapshot(query_id),
         "ring": recorder.snapshot(),
     }
 

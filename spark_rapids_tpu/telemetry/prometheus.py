@@ -75,23 +75,45 @@ class _Handler(BaseHTTPRequestHandler):
     hub = None                      # set per server class below
 
     def do_GET(self):               # noqa: N802 (http.server API)
-        if self.path.rstrip("/") not in ("", "/metrics"):
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path == "/progress":
+            # live per-query progress JSON (ISSUE 12) next to the
+            # scrape: the same payload session.progress() returns —
+            # what an operator (or the multi-tenant scheduler tier)
+            # polls to see an 8-way stress run while it is happening
+            import json
+
+            from spark_rapids_tpu.progress import snapshot
+
+            try:
+                body = json.dumps(snapshot()).encode()
+            except Exception as e:
+                self._fail(e)
+                return
+            self._ok(body, "application/json; charset=utf-8")
+            return
+        if path not in ("", "/metrics"):
             self.send_response(404)
             self.end_headers()
             return
         try:
             body = render_prometheus(self.hub).encode()
         except Exception as e:      # a scrape must never crash the server
-            self.send_response(500)
-            self.end_headers()
-            self.wfile.write(str(e).encode())
+            self._fail(e)
             return
+        self._ok(body, "text/plain; version=0.0.4; charset=utf-8")
+
+    def _ok(self, body: bytes, ctype: str) -> None:
         self.send_response(200)
-        self.send_header("Content-Type",
-                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _fail(self, e: Exception) -> None:
+        self.send_response(500)
+        self.end_headers()
+        self.wfile.write(str(e).encode())
 
     def log_message(self, *a):      # no stderr chatter per scrape
         pass

@@ -43,6 +43,7 @@ SAMPLED_COUNTERS = (
     "host_syncs", "programs_launched", "compiles",
     "transient_retries", "runtime_fallbacks", "breaker_trips",
     "slo_violations", "postmortem_dumps",
+    "stalls_detected", "progress_snapshots",
 )
 
 
@@ -94,6 +95,15 @@ def collect_gauges() -> Dict[str, float]:
                                      c.get("hot_cache_misses", 0))
     g["compile_cache_hit_rate"] = _ratio(c.get("compile_cache_hits", 0),
                                          c.get("compile_cache_misses", 0))
+    # live progress aggregates (ISSUE 12): per-tick queries running,
+    # min/median percent-complete, stalled count — peek-only like every
+    # other gauge (aggregate_stats never bumps counters), absent when
+    # no enabled query ever installed the tracker
+    from spark_rapids_tpu.progress import context as _PROG
+
+    trk = _PROG.TRACKER
+    if trk is not None:
+        g.update(trk.aggregate_stats())
     return g
 
 

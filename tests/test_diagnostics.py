@@ -77,6 +77,8 @@ GOLDEN_SCHEMA = {
     "io_fault": ["kind", "path", "fmt", "detail"],
     "scan_prefetch": ["depth", "batches", "overlapped_bytes", "stall_ns"],
     "ici_shuffle": ["stage", "n_dev", "rows", "bytes", "dur_ns"],
+    "query_stall": ["query_id", "path", "name", "stalled_ms", "detail"],
+    "progress": ["query_id", "pct", "eta_ns", "stalls", "background"],
     "op_batch": ["path", "batch", "rows", "dur_ns"],
     "operator": ["path", "name", "describe", "op_class", "fp", "wall_ns",
                  "self_wall_ns", "batches", "rows", "counters", "metrics",

@@ -1,0 +1,870 @@
+"""ISSUE 12: live query introspection.
+
+Pins the tentpole deliverables — the per-operator live progress tracker
+(batches/rows/bytes, monotone percent-complete, cost-model ETA), causal
+attribution of background work to the owning query, the watchdog stall
+detector (``query_stall`` event + ``stalls_detected`` + a post-mortem
+naming the stuck operator), and the three surfaces
+(``session.progress()`` / live ``explain("analyze")``, the telemetry
+``/progress`` route + sampler gauges, the ``tools/history.py`` history
+server) — plus the contracts:
+
+* disabled path (the default): a collect makes ZERO calls into any
+  ``progress/`` module (cProfile, the diagnostics/telemetry/profiling
+  methodology);
+* concurrent-collect isolation: two queries' snapshots never
+  cross-attribute operators (exact per-query counts, even for a SHARED
+  cached plan root);
+* the ETA feedback loop: on a profiled query, mid-query ETA at >=50%
+  progress is within a pinned factor of the actual remaining wall.
+"""
+import cProfile
+import json
+import os
+import pstats
+import sys
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu import perfcounters as PC
+from spark_rapids_tpu import progress as progress_mod
+from spark_rapids_tpu import telemetry
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.progress import context as PROG_CTX
+from spark_rapids_tpu.session import TpuSession, col, sum_
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+# mid-query ETA bound (>=50% progress, paced batches, profiled store):
+# generous for CI noise, same spirit as test_profiling.PIN_FACTOR
+ETA_PIN_FACTOR = 5.0
+
+
+@pytest.fixture(autouse=True)
+def _fresh_progress():
+    """The tracker is process-global: start and leave every test with
+    the slot EMPTY so the disabled-path pin and cross-test counts are
+    deterministic."""
+    progress_mod.shutdown()
+    yield
+    progress_mod.shutdown()
+
+
+def _mk_session(extra=None):
+    conf = {"spark.rapids.sql.enabled": True,
+            "spark.rapids.tpu.progress.enabled": True}
+    conf.update(extra or {})
+    return TpuSession(conf)
+
+
+def _agg_df(s, n=256):
+    return s.create_dataframe(
+        {"a": list(range(n)), "k": [i % 4 for i in range(n)]},
+        T.StructType([T.StructField("a", T.LONG, True),
+                      T.StructField("k", T.LONG, True)]))
+
+
+def _agg_query(s, n=256):
+    return _agg_df(s, n).group_by("k").agg(sum_("a", "s"))
+
+
+def _paced_query(s, n_parts=8, n=64, sleep_s=0.04):
+    """A multi-batch paced plan: union of ``n_parts`` frames under a
+    vectorized python UDF that sleeps per BATCH — execution long enough
+    to observe mid-flight, with a deterministic per-batch cadence.
+    Needs ``spark.rapids.sql.udfCompiler.enabled=false`` (a traced UDF
+    would hoist the sleep to plan time and project a pure
+    expression)."""
+    from spark_rapids_tpu.expr.udf import UserDefinedExpression
+
+    def pace(a):
+        time.sleep(sleep_s)
+        return a * 2
+
+    df = s.create_dataframe(
+        {"a": list(range(n))},
+        T.StructType([T.StructField("a", T.LONG, True)]))
+    u = df
+    for _ in range(n_parts - 1):
+        u = u.union(df)
+    e = UserDefinedExpression(pace, [col("a").resolve(u.schema)],
+                              T.LONG, "pace", vectorized=True)
+    return u.select(e.alias("r"))
+
+
+# ---------------------------------------------------------------------------
+# disabled path: the zero-call contract
+# ---------------------------------------------------------------------------
+
+def test_disabled_path_makes_zero_progress_calls():
+    """With ``spark.rapids.tpu.progress.enabled=false`` (the default)
+    a lifecycle-managed collect costs one conf read + one ambient
+    attribute check per batch — ZERO calls into ``progress/``
+    modules."""
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    assert PROG_CTX.TRACKER is None
+    q = _agg_query(s)
+    q.collect()                 # warm compile caches outside the profile
+
+    prof = cProfile.Profile()
+    prof.enable()
+    q.collect()
+    prof.disable()
+    banned = os.path.join("spark_rapids_tpu", "progress")
+    offenders = [
+        (fname, func)
+        for (fname, _lineno, func) in pstats.Stats(prof).stats
+        if banned in fname]
+    assert not offenders, (
+        f"progress work on the disabled path: {offenders}")
+    assert s.progress() == []
+
+
+def test_enabled_path_cost_is_per_pull_not_per_row():
+    """The enabled-path bound (the cProfile methodology, inverted):
+    per batch pull the tracker does ONE begin + ONE end; total calls
+    into ``progress/`` modules stay below a small constant per pull —
+    independent of row count."""
+    s = _mk_session()
+    q = _agg_query(s, 2048)                  # 8x the rows of the sibling
+    q.collect()                              # warm + register once
+    prof = cProfile.Profile()
+    prof.enable()
+    q.collect()
+    prof.disable()
+    banned = os.path.join("spark_rapids_tpu", "progress")
+    stats = pstats.Stats(prof).stats
+    calls = sum(stat[0] for key, stat in stats.items()
+                if banned in key[0])
+    snap = s.progress()[-1]
+    pulls = sum(op["batches"] + 1 for op in snap["operators"])
+    # begin+end per pull, plus registration/finish/summary constants
+    assert calls <= 8 * pulls + 40, (
+        f"{calls} progress-module calls for {pulls} pulls")
+
+
+# ---------------------------------------------------------------------------
+# enabled path: per-operator tracking + surfaces
+# ---------------------------------------------------------------------------
+
+def test_enabled_collect_tracks_per_operator_progress(tmp_path):
+    s = _mk_session({"spark.rapids.tpu.diagnostics.enabled": True,
+                     "spark.rapids.tpu.diagnostics.eventLogDir":
+                         str(tmp_path / "logs")})
+    q = _agg_query(s)
+    snap_ctr = PC.COUNTERS["progress_snapshots"]
+    assert sorted(q.collect()) == [(0, 8064), (1, 8128), (2, 8192),
+                                   (3, 8256)]
+    snaps = s.progress()
+    assert PC.COUNTERS["progress_snapshots"] == snap_ctr + 1
+    assert len(snaps) == 1
+    snap = snaps[0]
+    assert snap["status"] == "ok" and snap["pct"] == 1.0
+    by_name = {op["name"]: op for op in snap["operators"]}
+    agg = by_name["TpuHashAggregateExec"]
+    scan = by_name["TpuLocalTableScanExec"]
+    assert agg["rows"] == 4 and agg["finished"]
+    assert scan["rows"] == 256 and scan["batches"] >= 1
+    assert scan["bytes"] > 0 and scan["wall_ms"] >= 0.0
+    # the per-query summary event landed in the diagnostics log,
+    # before the trailing query_end
+    with open(q._last_diag.event_log_path) as f:
+        events = [json.loads(line) for line in f]
+    assert events[-1]["ev"] == "query_end"
+    prog_ev = [e for e in events if e["ev"] == "progress"]
+    assert len(prog_ev) == 1 and prog_ev[0]["pct"] == 1.0
+    # render path (what live explain("analyze") prints)
+    text = progress_mod.render_snapshot(snap)
+    assert "TpuHashAggregateExec" in text and "100%" in text
+
+
+def test_background_aot_compile_attributes_to_owning_query():
+    """The AOT pool thread's compile wall shows up under the SUBMITTING
+    query — not nowhere (a fresh expression fingerprint forces at least
+    one background warm-up compile)."""
+    s = _mk_session()
+    df = _agg_df(s, 128)
+    # a never-seen-before aggregate shape => cold AOT entry
+    q = df.filter(col("a") > 17).group_by("k").agg(
+        sum_("a", "s_bg_attr"))
+    q.collect()
+    snap = s.progress()[-1]
+    bg = snap["background"]
+    if "aot_compile" in bg:     # compile may be registry-warm already
+        assert bg["aot_compile"]["events"] >= 1
+        assert bg["aot_compile"]["wall_ns"] > 0
+
+
+def test_progress_is_monotone_per_operator():
+    """Sampled mid-flight, every operator's batches/rows/pct only ever
+    grow (the caps release only on finish)."""
+    s = _mk_session({"spark.rapids.sql.udfCompiler.enabled": False})
+    df = _paced_query(s, n_parts=6, sleep_s=0.03)
+    seen = []
+    stop = threading.Event()
+
+    def sample():
+        while not stop.is_set():
+            trk = PROG_CTX.TRACKER
+            if trk is not None:
+                for sn in trk.snapshot(include_finished=False):
+                    seen.append(sn)
+            time.sleep(0.004)
+
+    t = threading.Thread(target=sample)
+    t.start()
+    df.collect()
+    stop.set()
+    t.join()
+    assert len(seen) >= 3, "sampler observed too little of the query"
+    last = {}
+    for sn in seen:
+        for op in sn["operators"]:
+            prev = last.get(op["path"])
+            if prev is not None:
+                assert op["batches"] >= prev["batches"]
+                assert op["rows"] >= prev["rows"]
+                if prev["pct"] is not None and op["pct"] is not None:
+                    assert op["pct"] >= prev["pct"] - 1e-9
+            last[op["path"]] = op
+
+
+def test_live_explain_analyze_renders_in_flight_snapshot():
+    s = _mk_session({"spark.rapids.sql.udfCompiler.enabled": False})
+    df = _paced_query(s, n_parts=6, sleep_s=0.03)
+    got = {}
+    started = threading.Event()
+
+    def run():
+        started.set()
+        got["rows"] = len(df.collect())
+
+    t = threading.Thread(target=run)
+    t.start()
+    started.wait(5)
+    live = None
+    for _ in range(200):        # poll until the collect registers
+        if getattr(df, "_live_progress_qid", None) is not None:
+            live = df.explain("analyze")
+            break
+        time.sleep(0.005)
+    t.join()
+    assert live is not None, "collect finished before a live explain"
+    assert "live progress" in live and "TpuProjectExec" in live
+    # after the collect, analyze falls back to the post-hoc recorder
+    # path (no live marker)
+    assert "live progress" not in df.explain("analyze")
+    assert got["rows"] == 6 * 64
+
+
+# ---------------------------------------------------------------------------
+# concurrent-collect isolation
+# ---------------------------------------------------------------------------
+
+def test_concurrent_collects_never_cross_attribute():
+    """Two different queries in flight at once: each snapshot holds
+    exactly its own plan's operators with exactly its own row counts —
+    zero cross-query leaks."""
+    s1 = _mk_session()
+    s2 = _mk_session()
+    q_agg = _agg_query(s1, 256)              # 256-row scan, 4 groups
+    q_sort = _agg_df(s2, 192).order_by(
+        "a", ascending=False).limit(7)       # 192-row scan
+    barrier = threading.Barrier(2)
+    errs = []
+
+    def run(q, want_rows):
+        try:
+            barrier.wait(10)
+            for _ in range(3):
+                assert len(q.collect()) == want_rows
+        except Exception as e:               # noqa: BLE001 — reported
+            errs.append(e)
+
+    t1 = threading.Thread(target=run, args=(q_agg, 4))
+    t2 = threading.Thread(target=run, args=(q_sort, 7))
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+    assert not errs, errs
+    snaps = s1.progress()
+    assert len(snaps) == 6                   # 3 collects per query
+    for snap in snaps:
+        names = {op["name"] for op in snap["operators"]}
+        rows_of = {op["name"]: op["rows"] for op in snap["operators"]}
+        if "TpuHashAggregateExec" in names:
+            assert "TpuTopNExec" not in names
+            assert rows_of["TpuLocalTableScanExec"] == 256
+            assert rows_of["TpuHashAggregateExec"] == 4
+        else:
+            assert "TpuTopNExec" in names
+            assert rows_of["TpuLocalTableScanExec"] == 192
+        # background work never lands on the wrong query either: every
+        # attributed kind belongs to the background vocabulary
+        assert set(snap["background"]) <= {"aot_compile",
+                                           "scan_prefetch",
+                                           "shuffle_write"}
+
+
+def test_shared_plan_root_concurrent_collect_no_double_count():
+    """Two threads collect the SAME DataFrame (one cached plan root):
+    the ownership stamp makes the losing thread's pulls attribute
+    NOWHERE, so no snapshot ever reports more rows than the plan
+    produces."""
+    s = _mk_session()
+    q = _agg_query(s, 256)
+    q.collect()                              # plan + caches warm
+    barrier = threading.Barrier(2)
+    errs = []
+
+    def run():
+        try:
+            barrier.wait(10)
+            assert len(q.collect()) == 4
+        except Exception as e:               # noqa: BLE001 — reported
+            errs.append(e)
+
+    threads = [threading.Thread(target=run) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    for snap in s.progress():
+        for op in snap["operators"]:
+            if op["name"] == "TpuLocalTableScanExec":
+                assert op["rows"] <= 256, (
+                    f"cross-attributed rows: {op}")
+            if op["name"] == "TpuHashAggregateExec":
+                assert op["rows"] <= 4
+
+
+def test_failure_before_execution_still_finishes_query():
+    """Review pin: a raise AFTER registration but BEFORE execution (a
+    malformed injection spec) must not leave a ghost 'running' query in
+    the tracker or a stale live-explain key."""
+    s = _mk_session({"spark.rapids.sql.test.injectRetryOOM":
+                     "RETRY:notanumber"})
+    q = _agg_query(s)
+    with pytest.raises(ValueError):
+        q.collect()
+    assert s.progress(include_finished=False) == []
+    snaps = s.progress()
+    assert len(snaps) == 1 and snaps[0]["status"] == "ValueError"
+    assert getattr(q, "_live_progress_qid", None) is None
+    assert "live progress" not in q.explain("analyze")
+
+
+def test_stamp_lost_query_exempt_from_stall_detection():
+    """Review pin: when a later register() of the SAME cached plan root
+    overwrites a live query's ownership stamps, that query's frozen
+    activity clock must not read as a wedge — it is exempted from stall
+    detection (and says so in its snapshot)."""
+    from spark_rapids_tpu.progress.tracker import ProgressTracker
+
+    class _Ctx:
+        def __init__(self, qid):
+            self.query_id = qid
+
+    class _Node:
+        node_name = "FakeExec"
+        children = ()
+
+        def describe(self):
+            return "FakeExec"
+
+        def aot_output_rows(self):
+            return None
+
+    trk = ProgressTracker()
+    shared = _Node()
+    trk.register(_Ctx("q-old"), shared, stall_ms=1.0)
+    trk.register(_Ctx("q-new"), shared, stall_ms=1.0)
+    stalled = trk.scan_stalls(time.monotonic_ns() + 50_000_000)
+    # only the query that OWNS the stamps can stall; the overwritten
+    # one is exempt, not falsely flagged
+    assert [s["query_id"] for s in stalled] == ["q-new"]
+    assert trk.snapshot_for("q-old")["stamp_lost"] is True
+    assert trk.snapshot_for("q-new")["stamp_lost"] is False
+
+
+# ---------------------------------------------------------------------------
+# the stall detector
+# ---------------------------------------------------------------------------
+
+def test_stall_detector_names_stuck_operator(tmp_path):
+    """Acceptance pin: a blocking-UDF query trips ``query_stall``
+    within stallMs + the watchdog period; the diagnostics event and the
+    post-mortem bundle name the stuck operator (the UDF's project), and
+    the bundle embeds the live progress snapshot."""
+    telemetry.shutdown()
+    release = threading.Event()
+
+    def block(a):
+        if a is None:
+            return None
+        release.wait(15.0)
+        return a
+
+    from spark_rapids_tpu.expr.udf import udf
+
+    s = _mk_session({
+        "spark.rapids.sql.udfCompiler.enabled": False,
+        "spark.rapids.tpu.progress.stallMs": "150",
+        "spark.rapids.tpu.query.watchdogPeriodMs": "40",
+        "spark.rapids.tpu.diagnostics.enabled": True,
+        "spark.rapids.tpu.diagnostics.eventLogDir":
+            str(tmp_path / "logs"),
+    })
+    hub = telemetry.get_hub()
+    assert hub is not None
+    hub.reset_dump_limits()
+    df = s.create_dataframe(
+        {"a": list(range(32))},
+        T.StructType([T.StructField("a", T.LONG, True)]))
+    q = df.select(udf(block, T.LONG, "block")(col("a")).alias("r"))
+    stall_ctr = PC.COUNTERS["stalls_detected"]
+    got = {}
+
+    def run():
+        got["rows"] = len(q.collect())
+
+    t = threading.Thread(target=run)
+    t.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while PC.COUNTERS["stalls_detected"] == stall_ctr \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert PC.COUNTERS["stalls_detected"] > stall_ctr, (
+            "no stall detected within 10s (stallMs=150, period=40)")
+        live = s.progress(include_finished=False)
+        assert live and live[0]["stalled"]
+        assert live[0]["stuck_op"]["name"] == "TpuProjectExec"
+        # the dump happens after the counter bump on the watchdog
+        # thread: poll briefly for the bundle
+        pm = None
+        while pm is None and time.monotonic() < deadline:
+            pm = telemetry.last_postmortem()
+            if pm is None:
+                time.sleep(0.02)
+        assert pm is not None and pm["reason"] == "query_stall"
+        # the bundle embeds the progress snapshot naming the operator
+        assert pm["progress"]["stuck_op"]["name"] == "TpuProjectExec"
+        assert pm["progress"]["operators"], "no operator table in dump"
+    finally:
+        release.set()
+        t.join(30)
+    assert got.get("rows") == 32
+    # the query_stall diagnostics event landed in this query's log,
+    # naming the operator
+    events = [json.loads(line)
+              for line in open(q._last_diag.event_log_path)]
+    stalls = [e for e in events if e["ev"] == "query_stall"]
+    assert stalls and stalls[0]["name"] == "TpuProjectExec"
+    assert stalls[0]["stalled_ms"] >= 150
+    # ... and the final progress summary records the episode count
+    prog = [e for e in events if e["ev"] == "progress"]
+    assert prog and prog[0]["stalls"] >= 1
+    telemetry.shutdown()
+
+
+def test_deadline_trip_postmortem_embeds_stuck_operator():
+    """Acceptance pin: a deadline-tripped query's post-mortem (dumped
+    by the watchdog while the thread is still blocked) embeds the live
+    progress snapshot — the bundle says WHERE the query was stuck, not
+    just which threads existed."""
+    from spark_rapids_tpu.expr.udf import udf
+    from spark_rapids_tpu.lifecycle import QueryDeadlineExceeded
+
+    telemetry.shutdown()
+    release = threading.Event()
+
+    def block(a):
+        if a is None:
+            return None
+        release.wait(15.0)
+        return a
+
+    s = _mk_session({
+        "spark.rapids.sql.udfCompiler.enabled": False,
+        "spark.rapids.tpu.telemetry.samplePeriodMs": "0",
+        "spark.rapids.tpu.query.timeoutMs": "250",
+        "spark.rapids.tpu.query.watchdogPeriodMs": "30",
+    })
+    hub = telemetry.get_hub()
+    assert hub is not None
+    hub.reset_dump_limits()
+    df = s.create_dataframe(
+        {"a": list(range(16))},
+        T.StructType([T.StructField("a", T.LONG, True)]))
+    q = df.select(udf(block, T.LONG, "block")(col("a")).alias("r"))
+    outcome = {}
+
+    def run():
+        try:
+            q.collect()
+            outcome["exc"] = None
+        except BaseException as e:           # noqa: BLE001 — inspected
+            outcome["exc"] = e
+
+    t = threading.Thread(target=run)
+    t.start()
+    try:
+        pm = None
+        deadline = time.monotonic() + 10.0
+        while pm is None and time.monotonic() < deadline:
+            pm = next((p for p in list(hub.postmortems)
+                       if p["reason"] == "deadline_trip"), None)
+            if pm is None:
+                time.sleep(0.02)
+        assert pm is not None, "no deadline_trip post-mortem within 10s"
+        # dumped while the UDF still blocks: the embedded snapshot
+        # names the in-flight operator
+        assert pm["progress"] is not None
+        assert pm["progress"]["stuck_op"]["name"] == "TpuProjectExec"
+        assert pm["progress"]["operators"]
+    finally:
+        release.set()
+        t.join(30)
+    assert isinstance(outcome.get("exc"), QueryDeadlineExceeded)
+    telemetry.shutdown()
+
+
+def test_stall_dump_does_not_suppress_deadline_dump():
+    """Review pin: a stall post-mortem (claim_query=False) neither
+    consumes nor honors the per-query dedup slot — the later
+    deadline-trip bundle for the SAME query still dumps, and a second
+    stall episode may dump again after the rate-limit window."""
+    telemetry.shutdown()
+    s = _mk_session({"spark.rapids.tpu.telemetry.samplePeriodMs": "0"})
+    hub = telemetry.get_hub()
+    assert hub is not None
+    hub.reset_dump_limits()
+    try:
+        stall = hub.postmortem("query_stall", query_id="q-dup",
+                               detail="stall", claim_query=False)
+        assert stall is not None
+        deadline = hub.postmortem("deadline_trip", query_id="q-dup",
+                                  detail="deadline")
+        assert deadline is not None, (
+            "stall dump consumed the query's dedup slot")
+        # ...and the usual dedupe still holds for claiming reasons
+        assert hub.postmortem("collect_error", query_id="q-dup") is None
+    finally:
+        telemetry.shutdown()
+
+
+def test_query_fallback_marks_query_untracked():
+    """Review pin: the whole-query CPU-oracle fallback path exempts the
+    query from stall detection (its pulls stop by design)."""
+    from spark_rapids_tpu.progress.tracker import ProgressTracker
+
+    class _Ctx:
+        query_id = "q-fb"
+
+    class _Node:
+        node_name = "FakeExec"
+        children = ()
+
+        def describe(self):
+            return "FakeExec"
+
+        def aot_output_rows(self):
+            return None
+
+    trk = ProgressTracker()
+    trk.register(_Ctx(), _Node(), stall_ms=1.0)
+    trk.mark_untracked("q-fb")
+    assert trk.scan_stalls(time.monotonic_ns() + 50_000_000) == []
+    assert trk.snapshot_for("q-fb")["stamp_lost"] is True
+
+
+def test_max_finished_honors_latest_conf():
+    """Review pin: a later session's progress.maxFinished resizes the
+    finished ring instead of being silently ignored."""
+    trk = progress_mod.ensure_tracker(4)
+    assert trk._finished.maxlen == 4
+    assert progress_mod.ensure_tracker(2) is trk
+    assert trk._finished.maxlen == 2
+
+
+def test_snapshot_order_is_registration_time_not_lexicographic():
+    """Review pin: 'newest last' must survive q9 -> q10 (unpadded ids
+    sort lexicographically; the tracker sorts by registration time)."""
+    from spark_rapids_tpu.progress.tracker import ProgressTracker
+
+    class _Node:
+        node_name = "FakeExec"
+        children = ()
+
+        def describe(self):
+            return "FakeExec"
+
+        def aot_output_rows(self):
+            return None
+
+    class _Ctx:
+        def __init__(self, qid):
+            self.query_id = qid
+
+    trk = ProgressTracker()
+    for qid in ("q9", "q10", "q11"):       # registration order
+        trk.register(_Ctx(qid), _Node())
+    assert [s["query_id"] for s in trk.snapshot()] == \
+        ["q9", "q10", "q11"]
+
+
+def test_stall_detector_rearms_after_advance():
+    """An advance clears the stall flag; a later wedge of the same
+    query reports as a FRESH stall (stalls == 2)."""
+    from spark_rapids_tpu.progress.tracker import ProgressTracker
+
+    class _Ctx:
+        query_id = "q-rearm"
+
+    class _Node:
+        node_name = "FakeExec"
+        children = ()
+
+        def describe(self):
+            return "FakeExec"
+
+        def aot_output_rows(self):
+            return None
+
+    trk = ProgressTracker()
+    trk.register(_Ctx(), _Node(), stall_ms=1.0)
+    now = time.monotonic_ns()
+    assert len(trk.scan_stalls(now + 10_000_000)) == 1
+    # flagged: the same wedge must not re-report
+    assert trk.scan_stalls(now + 20_000_000) == []
+    # an advance re-arms...
+    trk.add_background("q-rearm", "aot_compile", 1000)
+    # ...so a LATER wedge reports again
+    later = time.monotonic_ns() + 50_000_000
+    assert len(trk.scan_stalls(later)) == 1
+    assert trk.snapshot_for("q-rearm")["stalls"] == 2
+
+
+# ---------------------------------------------------------------------------
+# telemetry surfaces: /progress route + sampler gauges
+# ---------------------------------------------------------------------------
+
+def test_http_progress_route_serves_snapshots():
+    import urllib.request
+
+    telemetry.shutdown()
+    s = _mk_session({"spark.rapids.tpu.telemetry.samplePeriodMs": "0"})
+    hub = telemetry.get_hub()
+    assert hub is not None
+    _agg_query(s).collect()
+    from spark_rapids_tpu.telemetry.prometheus import start_http
+
+    srv, port = start_http(hub, 0)           # ephemeral port
+    assert srv is not None
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/progress", timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith(
+                "application/json")
+            rows = json.loads(resp.read())
+        assert rows and rows[-1]["status"] == "ok"
+        assert any(op["name"] == "TpuHashAggregateExec"
+                   for op in rows[-1]["operators"])
+        # the scrape route still serves next to it
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            assert b"srt_" in resp.read()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        telemetry.shutdown()
+
+
+def test_sampler_tick_carries_progress_gauges():
+    telemetry.shutdown()
+    s = _mk_session({"spark.rapids.tpu.telemetry.samplePeriodMs": "0"})
+    hub = telemetry.get_hub()
+    assert hub is not None
+    try:
+        _agg_query(s).collect()
+        row = hub.sampler.tick()
+        assert row["progress_queries_running"] == 0.0   # none in flight
+        assert "progress_min_pct" in row
+        assert "progress_median_pct" in row
+        assert row["progress_stalled"] == 0.0
+        assert "stalls_detected" in row
+        assert "progress_snapshots" in row
+    finally:
+        telemetry.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the ETA feedback loop (the PR 8 store as the predictor)
+# ---------------------------------------------------------------------------
+
+def test_eta_within_pinned_factor_on_profiled_query(tmp_path):
+    """Profile a paced multi-batch query into a fresh store, re-run it
+    with progress on: every mid-query ETA sampled at 50-85% progress is
+    within ETA_PIN_FACTOR of the actual remaining wall."""
+    prof_dir = str(tmp_path / "store")
+    conf = {"spark.rapids.sql.udfCompiler.enabled": False,
+            # the store only populates from RECORDED operator spans
+            "spark.rapids.tpu.diagnostics.enabled": True,
+            "spark.rapids.tpu.profile.dir": prof_dir}
+    s_feed = TpuSession({"spark.rapids.sql.enabled": True, **conf})
+    _paced_query(s_feed).collect()           # populates the store
+    _paced_query(s_feed).collect()           # EWMA settles
+
+    s = _mk_session(conf)
+    df = _paced_query(s)
+    samples = []
+    stop = threading.Event()
+
+    def sample():
+        while not stop.is_set():
+            trk = PROG_CTX.TRACKER
+            if trk is not None:
+                for sn in trk.snapshot(include_finished=False):
+                    samples.append((time.perf_counter(), sn["pct"],
+                                    sn["eta_ms"]))
+            time.sleep(0.005)
+
+    t = threading.Thread(target=sample)
+    t.start()
+    df.collect()
+    t_end = time.perf_counter()
+    stop.set()
+    t.join()
+    mid = [(ts, pct, eta) for ts, pct, eta in samples
+           if pct is not None and eta is not None
+           and 0.5 <= pct <= 0.85]
+    assert mid, (f"no mid-query sample at 50-85% progress "
+                 f"({len(samples)} samples total)")
+    for ts, pct, eta in mid:
+        actual_rem_ms = (t_end - ts) * 1000.0
+        assert actual_rem_ms > 0
+        assert (actual_rem_ms / ETA_PIN_FACTOR <= eta
+                <= actual_rem_ms * ETA_PIN_FACTOR), (
+            f"ETA {eta:.0f}ms at pct={pct:.2f} outside "
+            f"{ETA_PIN_FACTOR}x of actual remaining "
+            f"{actual_rem_ms:.0f}ms")
+    # with the store matched, the snapshot carries a predicted wall
+    assert s.progress()[-1]["predicted_wall_ms"] > 0
+
+
+# ---------------------------------------------------------------------------
+# the history server
+# ---------------------------------------------------------------------------
+
+def test_history_server_index_and_query_pages(tmp_path):
+    import urllib.request
+
+    log_dir = str(tmp_path / "logs")
+    s = _mk_session({
+        "spark.rapids.tpu.diagnostics.enabled": True,
+        "spark.rapids.tpu.diagnostics.eventLogDir": log_dir,
+    })
+    q = _agg_query(s)
+    q.collect()
+    q2 = _agg_df(s, 64).order_by("a").limit(3)
+    q2.collect()
+
+    import history
+
+    rows = history.index_rows(history.load_profiles([log_dir]),
+                              slo_target_ms=0.0)
+    assert len(rows) == 2
+    assert {r["slo"] for r in rows} == {"ok"}
+    # a tight SLO target flags both finished queries
+    rows_slo = history.index_rows(history.load_profiles([log_dir]),
+                                  slo_target_ms=0.0001)
+    assert {r["slo"] for r in rows_slo} == {"violated"}
+
+    srv, port = history.start_server([log_dir], 0, slo_target_ms=0.0)
+    try:
+        api = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/queries", timeout=10).read())
+        assert len(api) == 2
+        qid = api[0]["query_id"]
+        detail = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/query/{qid}",
+            timeout=10).read())
+        # operator table ranked by self wall, descending
+        walls = [op["self_wall_ms"] for op in detail["operators"]]
+        assert walls == sorted(walls, reverse=True)
+        assert detail["plan"]
+        html_page = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/query/{qid}",
+            timeout=10).read().decode()
+        assert "operators (by self wall)" in html_page
+        index_page = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=10).read().decode()
+        assert "query history" in index_page
+        assert urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/query/nope",
+            timeout=10).status  # noqa: B018 — just reach the 404
+    except urllib.error.HTTPError as e:
+        assert e.code == 404    # the /api/query/nope probe
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_profile_report_stalls_section(tmp_path, capsys):
+    """``tools/profile_report.py --stalls`` aggregates query_stall
+    events per stuck operator."""
+    log_dir = str(tmp_path / "logs")
+    os.makedirs(log_dir)
+    lines = [
+        {"ev": "query_start", "ts_ns": 0, "op": "",
+         "query_id": "111-1-0001", "started_at": 1.0,
+         "metrics_level": "MODERATE",
+         "plan": [{"path": "0", "name": "TpuProjectExec",
+                   "describe": "TpuProject"}]},
+        {"ev": "query_stall", "ts_ns": 100, "op": "",
+         "query_id": "q1", "path": "0", "name": "TpuProjectExec",
+         "stalled_ms": 250.0, "detail": "stuck"},
+        {"ev": "query_stall", "ts_ns": 300, "op": "",
+         "query_id": "q1", "path": "0", "name": "TpuProjectExec",
+         "stalled_ms": 400.0, "detail": "stuck again"},
+        {"ev": "query_end", "ts_ns": 500, "op": "", "wall_ns": 500,
+         "status": "ok", "counters": {}},
+    ]
+    with open(os.path.join(log_dir, "query-111-1-0001.jsonl"),
+              "w") as f:
+        f.write("\n".join(json.dumps(e) for e in lines) + "\n")
+
+    import profile_report
+
+    rc = profile_report.main([log_dir, "--stalls", "--json"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    st = payload["stalls"]
+    assert st["total_stalls"] == 2
+    assert st["queries_with_stalls"] == 1
+    assert st["by_operator"]["TpuProjectExec"]["stalls"] == 2
+    assert st["by_operator"]["TpuProjectExec"]["stalled_ms"] == 650.0
+
+    rc = profile_report.main([log_dir, "--stalls"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "== stalls: 2 query_stall events" in out
+    assert "TpuProjectExec" in out
+
+
+# ---------------------------------------------------------------------------
+# docs / vocabulary drift (the check_counters mirror)
+# ---------------------------------------------------------------------------
+
+def test_progress_vocabulary_documented():
+    import check_counters
+
+    assert check_counters.check() == []

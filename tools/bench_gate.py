@@ -46,6 +46,9 @@ SCAN_TRANSFER_SLACK_S = 0.05
 COMPILE_SLACK_S = 0.5
 P95_SLACK_MS = 5.0
 RUNG3_OOC_SLACK_S = 2.0
+# progressOverhead (ISSUE 12): absolute percentage-point slack — the
+# A/B times sub-second collects, so small relative drift is noise
+PROGRESS_OVERHEAD_SLACK_PP = 10.0
 
 
 def load(path: str) -> Dict:
@@ -164,6 +167,22 @@ def gate(base: Dict, new: Dict, tolerance: float = DEFAULT_TOLERANCE,
             regressions.append(
                 "rung3_ooc: spill traffic collapsed to 0 — the rung no "
                 "longer exercises the out-of-core machinery")
+
+    # progressOverhead (ISSUE 12 satellite): the live-progress
+    # enabled-path tax must not creep across rounds.  Gated only when
+    # BOTH payloads measured it (a pre-progress baseline has no
+    # comparable number), with absolute percentage-point slack.
+    bo = base.get("progressOverhead") or {}
+    no = new.get("progressOverhead") or {}
+    if "overhead_pct" in bo and "overhead_pct" in no:
+        bp_ = float(bo["overhead_pct"])
+        np2 = float(no["overhead_pct"])
+        if np2 > bp_ + PROGRESS_OVERHEAD_SLACK_PP:
+            regressions.append(
+                f"progressOverhead regressed: {bp_:+.1f}% -> "
+                f"{np2:+.1f}% (slack "
+                f"{PROGRESS_OVERHEAD_SLACK_PP:.0f}pp) — the per-batch "
+                f"progress instrumentation got more expensive")
 
     # NOTE: the payload's per-plan-signature "slo" section is
     # deliberately NOT gated here — it includes warm-up/compile collects

@@ -1,0 +1,376 @@
+#!/usr/bin/env python
+"""Query history server (ISSUE 12): finished queries stay inspectable
+across processes.
+
+The live half of the introspection layer (``session.progress()``, the
+telemetry ``/progress`` route) dies with the process; this serves the
+ROTATING DIAGNOSTICS EVENT LOGS — one ``query-<id>.jsonl`` per query
+under ``spark.rapids.tpu.diagnostics.eventLogDir`` — as a browsable
+index, the Spark history-server analog over our event-log format:
+
+* index — one row per query, newest first: status, wall, SLO status
+  (deadline trip / cancelled / over ``--slo-target-ms`` / ok), cost
+  predicted-vs-actual, stall episodes;
+* per-query page — the plan tree, the operator table ranked by SELF
+  wall (with batches/rows/host-sync/launch counters), the
+  predicted-vs-actual cost record, lifecycle + ``query_stall`` +
+  ``progress`` events.
+
+Every request re-reads the directory, so a server left running tracks
+the live rotation; queries evicted by ``eventLog.maxFiles`` drop off
+the index (that bound is the retention policy).  Localhost by design,
+like the telemetry scrape endpoint: fleet exposure belongs to a real
+sidecar.
+
+Usage:
+    python tools/history.py [LOG_DIR ...] [--port 8098]
+    python tools/history.py diag_logs --once            # text index
+    python tools/history.py diag_logs --once --json     # machine form
+"""
+from __future__ import annotations
+
+import argparse
+import html
+import json
+import os
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DEFAULT_SLO_TARGET_MS = 0.0     # 0 = no latency SLO judged
+
+
+# ---------------------------------------------------------------------------
+# index construction (pure functions over parsed logs; tests import these)
+# ---------------------------------------------------------------------------
+
+def slo_status(qp, slo_target_ms: float) -> str:
+    """One word per query: ``deadline`` / ``cancelled`` beat a latency
+    judgment (the query never got to finish), then ``violated`` when a
+    target is set and the wall exceeds it, else ``ok`` (or ``error``
+    for a non-ok non-cancel status)."""
+    for e in qp.events:
+        if e.get("ev") == "lifecycle":
+            if e.get("kind") == "deadline_trip":
+                return "deadline"
+            if e.get("kind") == "cancelled":
+                return "cancelled"
+    if qp.status and qp.status != "ok":
+        return "error"
+    if slo_target_ms > 0 and qp.wall_ns / 1e6 > slo_target_ms:
+        return "violated"
+    return "ok"
+
+
+def _cost_record(qp) -> Optional[Dict[str, Any]]:
+    for e in qp.events:
+        if e.get("ev") == "cost_model":
+            return {
+                "hits": e.get("hits", 0),
+                "misses": e.get("misses", 0),
+                "predicted_wall_ms": round(
+                    e.get("predicted_wall_ns", 0) / 1e6, 3),
+                "matched_actual_wall_ms": round(
+                    e.get("matched_actual_wall_ns", 0) / 1e6, 3),
+            }
+    return None
+
+
+def _progress_record(qp) -> Optional[Dict[str, Any]]:
+    for e in qp.events:
+        if e.get("ev") == "progress":
+            return {"pct": e.get("pct"), "eta_ns": e.get("eta_ns"),
+                    "stalls": e.get("stalls", 0),
+                    "background": e.get("background") or {}}
+    return None
+
+
+def index_rows(profiles, slo_target_ms: float) -> List[Dict[str, Any]]:
+    """One summary dict per query, newest first (the /api/queries
+    payload and the index table's rows)."""
+    rows = []
+    for qp in profiles:
+        stalls = [e for e in qp.events if e.get("ev") == "query_stall"]
+        prog = _progress_record(qp)
+        rows.append({
+            "query_id": qp.query_id,
+            "started_at": qp.started_at,
+            "status": qp.status or "?",
+            "slo": slo_status(qp, slo_target_ms),
+            "wall_ms": round(qp.wall_ns / 1e6, 3),
+            "operators": len(qp.operators),
+            "stalls": (prog["stalls"] if prog is not None
+                       else len(stalls)),
+            "cost": _cost_record(qp),
+            "incomplete": qp.incomplete,
+            "log": qp.path,
+        })
+    rows.sort(key=lambda r: -r["started_at"])
+    return rows
+
+
+def query_detail(qp, slo_target_ms: float) -> Dict[str, Any]:
+    """The /api/query/<id> payload: plan, operators ranked by self
+    wall, the cost + progress records, lifecycle/stall events."""
+    ops = sorted(qp.operators,
+                 key=lambda op: -op.get("self_wall_ns",
+                                        op.get("wall_ns", 0)))
+    return {
+        "query_id": qp.query_id,
+        "started_at": qp.started_at,
+        "status": qp.status or "?",
+        "slo": slo_status(qp, slo_target_ms),
+        "wall_ms": round(qp.wall_ns / 1e6, 3),
+        "plan": qp.plan,
+        "operators": [{
+            "path": op.get("path", ""),
+            "name": op.get("name", "?"),
+            "describe": op.get("describe", ""),
+            "self_wall_ms": round(
+                op.get("self_wall_ns", op.get("wall_ns", 0)) / 1e6, 3),
+            "wall_ms": round(op.get("wall_ns", 0) / 1e6, 3),
+            "batches": op.get("batches", 0),
+            "rows": op.get("rows", 0),
+            "counters": op.get("counters") or {},
+        } for op in ops],
+        "cost": _cost_record(qp),
+        "progress": _progress_record(qp),
+        "stall_events": [e for e in qp.events
+                         if e.get("ev") == "query_stall"],
+        "lifecycle": [e for e in qp.events
+                      if e.get("ev") == "lifecycle"],
+        "totals": qp.totals,
+        "incomplete": qp.incomplete,
+        "log": qp.path,
+    }
+
+
+def load_profiles(log_dirs: List[str]):
+    from spark_rapids_tpu.diagnostics.report import load_logs
+
+    return load_logs(log_dirs)
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+_STYLE = """<style>
+body { font-family: monospace; margin: 1.5em; }
+table { border-collapse: collapse; }
+th, td { border: 1px solid #999; padding: 2px 8px; text-align: left; }
+th { background: #eee; }
+.slo-ok { color: #070; } .slo-violated, .slo-deadline, .slo-error,
+.slo-cancelled { color: #b00; font-weight: bold; }
+pre { background: #f6f6f6; padding: 0.5em; }
+</style>"""
+
+
+def _esc(v) -> str:
+    return html.escape(str(v))
+
+
+def render_index_html(rows: List[Dict[str, Any]]) -> str:
+    body = [f"<html><head><title>query history</title>{_STYLE}</head>",
+            "<body><h2>query history "
+            f"({len(rows)} queries)</h2><table>",
+            "<tr><th>query</th><th>status</th><th>SLO</th>"
+            "<th>wall_ms</th><th>ops</th><th>stalls</th>"
+            "<th>predicted_ms</th><th>matched_actual_ms</th></tr>"]
+    for r in rows:
+        cost = r["cost"] or {}
+        flag = " (incomplete)" if r["incomplete"] else ""
+        body.append(
+            f"<tr><td><a href='/query/{_esc(r['query_id'])}'>"
+            f"{_esc(r['query_id'])}</a>{flag}</td>"
+            f"<td>{_esc(r['status'])}</td>"
+            f"<td class='slo-{_esc(r['slo'])}'>{_esc(r['slo'])}</td>"
+            f"<td>{r['wall_ms']:.1f}</td><td>{r['operators']}</td>"
+            f"<td>{r['stalls']}</td>"
+            f"<td>{cost.get('predicted_wall_ms', '')}</td>"
+            f"<td>{cost.get('matched_actual_wall_ms', '')}</td></tr>")
+    body.append("</table></body></html>")
+    return "\n".join(body)
+
+
+def render_query_html(d: Dict[str, Any]) -> str:
+    body = [f"<html><head><title>{_esc(d['query_id'])}</title>{_STYLE}"
+            "</head><body>",
+            f"<h2>query {_esc(d['query_id'])}</h2>",
+            f"<p>status={_esc(d['status'])} "
+            f"<span class='slo-{_esc(d['slo'])}'>SLO={_esc(d['slo'])}"
+            f"</span> wall={d['wall_ms']:.1f}ms</p>",
+            "<h3>plan</h3><pre>"]
+    for n in d["plan"]:
+        depth = n.get("path", "").count(".")
+        body.append(_esc("  " * depth + n.get("describe",
+                                              n.get("name", "?"))))
+    body.append("</pre><h3>operators (by self wall)</h3><table>")
+    body.append("<tr><th>path</th><th>operator</th><th>self_wall_ms"
+                "</th><th>wall_ms</th><th>batches</th><th>rows</th>"
+                "<th>counters</th></tr>")
+    for op in d["operators"]:
+        counters = ", ".join(f"{k}={v}" for k, v in
+                             sorted(op["counters"].items())[:6])
+        body.append(
+            f"<tr><td>{_esc(op['path'])}</td><td>{_esc(op['name'])}</td>"
+            f"<td>{op['self_wall_ms']:.1f}</td>"
+            f"<td>{op['wall_ms']:.1f}</td><td>{op['batches']}</td>"
+            f"<td>{op['rows']}</td><td>{_esc(counters)}</td></tr>")
+    body.append("</table>")
+    if d["cost"] is not None:
+        c = d["cost"]
+        body.append(
+            f"<h3>cost model</h3><p>predicted "
+            f"{c['predicted_wall_ms']:.1f}ms vs matched actual "
+            f"{c['matched_actual_wall_ms']:.1f}ms "
+            f"({c['hits']} hits / {c['misses']} misses)</p>")
+    if d["progress"] is not None:
+        p = d["progress"]
+        body.append(
+            f"<h3>progress</h3><p>final pct={p['pct']} "
+            f"stalls={p['stalls']} background="
+            f"{_esc(json.dumps(p['background']))}</p>")
+    if d["stall_events"]:
+        body.append("<h3>stalls</h3><pre>")
+        for e in d["stall_events"]:
+            body.append(_esc(f"{e.get('stalled_ms', 0):>8}ms stuck in "
+                             f"{e.get('name', '?')} at "
+                             f"{e.get('path', '?')}: "
+                             f"{e.get('detail', '')}"))
+        body.append("</pre>")
+    body.append("<p><a href='/'>back to index</a></p></body></html>")
+    return "\n".join(body)
+
+
+def render_index_text(rows: List[Dict[str, Any]]) -> str:
+    lines = [f"query history ({len(rows)} queries)",
+             f"{'query':<28} {'status':<10} {'slo':<10} "
+             f"{'wall_ms':>10} {'ops':>4} {'stalls':>6} "
+             f"{'pred_ms':>9}"]
+    for r in rows:
+        cost = r["cost"] or {}
+        pred = cost.get("predicted_wall_ms")
+        lines.append(
+            f"{r['query_id']:<28} {r['status']:<10} {r['slo']:<10} "
+            f"{r['wall_ms']:>10.1f} {r['operators']:>4} "
+            f"{r['stalls']:>6} "
+            + (f"{pred:>9.1f}" if pred is not None else f"{'-':>9}"))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# the server
+# ---------------------------------------------------------------------------
+
+class _Handler(BaseHTTPRequestHandler):
+    log_dirs: List[str] = []
+    slo_target_ms: float = 0.0
+
+    def do_GET(self):               # noqa: N802 (http.server API)
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            profiles = load_profiles(self.log_dirs)
+            if path == "/":
+                self._ok(render_index_html(index_rows(
+                    profiles, self.slo_target_ms)).encode(),
+                    "text/html; charset=utf-8")
+            elif path == "/api/queries":
+                self._ok(json.dumps(index_rows(
+                    profiles, self.slo_target_ms)).encode(),
+                    "application/json; charset=utf-8")
+            elif path.startswith(("/query/", "/api/query/")):
+                qid = path.rsplit("/", 1)[1]
+                qp = next((p for p in profiles if p.query_id == qid),
+                          None)
+                if qp is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                d = query_detail(qp, self.slo_target_ms)
+                if path.startswith("/api/"):
+                    self._ok(json.dumps(d).encode(),
+                             "application/json; charset=utf-8")
+                else:
+                    self._ok(render_query_html(d).encode(),
+                             "text/html; charset=utf-8")
+            else:
+                self.send_response(404)
+                self.end_headers()
+        except Exception as e:      # a request must never kill the server
+            self.send_response(500)
+            self.end_headers()
+            self.wfile.write(str(e).encode())
+
+    def _ok(self, body: bytes, ctype: str) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):      # no stderr chatter per request
+        pass
+
+
+def start_server(log_dirs: List[str], port: int,
+                 slo_target_ms: float = 0.0):
+    """Bind on 127.0.0.1 (port 0 = ephemeral, used by tests); returns
+    (server, bound_port)."""
+    handler = type("_BoundHandler", (_Handler,),
+                   {"log_dirs": list(log_dirs),
+                    "slo_target_ms": float(slo_target_ms)})
+    srv = ThreadingHTTPServer(("127.0.0.1", int(port)), handler)
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever,
+                         name="srt-history-http", daemon=True)
+    t.start()
+    return srv, srv.server_address[1]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Serve diagnostics event logs as a browsable query "
+                    "history index.")
+    ap.add_argument("logs", nargs="*", default=["diag_logs"],
+                    help="event-log directories or query-*.jsonl files "
+                         "(default: diag_logs)")
+    ap.add_argument("--port", type=int, default=8098,
+                    help="listen port on 127.0.0.1 (default 8098; "
+                         "0 = ephemeral)")
+    ap.add_argument("--slo-target-ms", type=float,
+                    default=DEFAULT_SLO_TARGET_MS,
+                    help="judge finished queries against this latency "
+                         "target (0 = no SLO judgment)")
+    ap.add_argument("--once", action="store_true",
+                    help="print the index and exit instead of serving")
+    ap.add_argument("--json", action="store_true",
+                    help="with --once: machine-readable JSON")
+    args = ap.parse_args(argv)
+    logs = args.logs or ["diag_logs"]
+
+    if args.once:
+        rows = index_rows(load_profiles(logs), args.slo_target_ms)
+        if not rows:
+            print("no event logs found", file=sys.stderr)
+            return 2
+        print(json.dumps(rows) if args.json
+              else render_index_text(rows))
+        return 0
+
+    srv, port = start_server(logs, args.port, args.slo_target_ms)
+    print(f"query history server on http://127.0.0.1:{port}/ "
+          f"(serving {', '.join(logs)}; Ctrl-C stops)")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        srv.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -39,6 +39,10 @@ def main(argv=None) -> int:
                          "diff of LOGS vs BASELINE")
     ap.add_argument("--json", action="store_true",
                     help="emit machine-readable JSON instead of text")
+    ap.add_argument("--stalls", action="store_true",
+                    help="aggregate query_stall events (ISSUE 12): "
+                         "which operators queries wedge in, how often, "
+                         "for how long")
     args = ap.parse_args(argv)
 
     from spark_rapids_tpu.diagnostics.report import (
@@ -47,7 +51,9 @@ def main(argv=None) -> int:
         load_logs,
         render_diff,
         render_report,
+        render_stalls,
         resilience_summary,
+        stalls_summary,
         top_operators,
         totals_summary,
     )
@@ -86,6 +92,8 @@ def main(argv=None) -> int:
             "top_by_bytes_d2h": top_operators(profiles, "bytes_d2h",
                                               args.top),
         }
+        if args.stalls:
+            payload["stalls"] = stalls_summary(profiles)
         if args.diff:
             payload["diff"] = diff_profiles(load_logs([args.diff]),
                                             profiles)
@@ -93,6 +101,9 @@ def main(argv=None) -> int:
         return 0
 
     print(render_report(profiles, top_n=args.top))
+    if args.stalls:
+        print()
+        print(render_stalls(stalls_summary(profiles)))
     if args.diff:
         print()
         print(render_diff(load_logs([args.diff]), profiles))

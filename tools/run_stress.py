@@ -111,8 +111,23 @@ def _dump_telemetry(path: str) -> dict:
                  default=0)
     peak_hbm = max((r.get("hbm_used_bytes", 0) for r in timeline),
                    default=0)
+    # per-tick aggregate progress columns (ISSUE 12): with progress
+    # enabled the sampler rows carry progress_queries_running /
+    # progress_min_pct / progress_median_pct / progress_stalled — roll
+    # the run's peaks into the summary so a stress sweep's legibility
+    # shows up in one line, not only in the dumped timeline
+    prog_ticks = [r for r in timeline if "progress_queries_running" in r]
+    progress = {
+        "ticks_with_progress": len(prog_ticks),
+        "peak_queries_running": max(
+            (r["progress_queries_running"] for r in prog_ticks),
+            default=0.0),
+        "stalled_tick_count": sum(
+            1 for r in prog_ticks if r.get("progress_stalled", 0) > 0),
+    }
     return {"path": path or None, "ticks": len(timeline),
             "peak_queue_depth": peak_q, "peak_hbm_bytes": peak_hbm,
+            "progress": progress,
             "p95_ms": (slo.get("", {}) or {}).get("p95_ms", 0.0)}
 
 
@@ -157,6 +172,10 @@ def run_stress(n_threads: int = 8, rounds: int = 3, seed: int = 7,
         # fast sampler ticks so even a seconds-long stress run records a
         # usable telemetry timeline (ISSUE 7)
         "spark.rapids.tpu.telemetry.samplePeriodMs": "50",
+        # live progress (ISSUE 12): every worker query registers with
+        # the tracker, so the sampler's timeline rows carry the per-tick
+        # aggregate progress columns and /progress answers mid-run
+        "spark.rapids.tpu.progress.enabled": True,
     }
     # rebuild the hub with the fast-tick conf (the oracle sessions above
     # already built one at the default period)
