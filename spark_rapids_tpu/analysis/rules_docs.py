@@ -329,6 +329,50 @@ def doc_drift_problems(repo_root: str) -> List[str]:
                 f"progress surface vocabulary {word} is not "
                 f"documented in docs/progress.md")
 
+    # overload governor (ISSUE 13): confs + counters + the sampler
+    # gauges + the governor event + the stress/chaos driver vocabulary
+    # must be documented in docs/overload.md (and confs in configs.md)
+    ovl_md = read("overload.md")
+    gov_confs = [k for k in _REGISTRY
+                 if k.startswith("spark.rapids.tpu.governor.")]
+    if not gov_confs:
+        problems.append("no spark.rapids.tpu.governor.* confs "
+                        "registered")
+    for key in sorted(gov_confs):
+        if f"`{key}`" not in ovl_md:
+            problems.append(
+                f"conf '{key}' is not documented in docs/overload.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+    for key in ("governor_transitions", "queries_shed",
+                "preempt_pauses", "degraded_batches",
+                "oom_retry_preempts", "oom_retry_splits"):
+        if key not in PC.COUNTERS:
+            problems.append(f"governor counter '{key}' is not "
+                            f"registered in perfcounters.COUNTERS")
+        if f"`{key}`" not in ovl_md:
+            problems.append(
+                f"governor counter '{key}' is not documented in "
+                f"docs/overload.md")
+    if "governor" not in EVENT_SCHEMA:
+        problems.append("diagnostics event type 'governor' is not "
+                        "registered in EVENT_SCHEMA")
+    for gauge in ("governor_state", "governor_pressure"):
+        if f"`{gauge}`" not in ovl_md:
+            problems.append(
+                f"governor sampler gauge '{gauge}' is not documented "
+                f"in docs/overload.md")
+    for word in ("`--overload`", "`--pressure`", "`retry_after_ms`",
+                 "`queue_depth`", "`pressure_state`", "`governor_red`",
+                 "`QueryRejected`", "run_stress.py", "run_chaos.py",
+                 "bench_gate.py"):
+        if word not in ovl_md:
+            problems.append(
+                f"governor surface vocabulary {word} is not "
+                f"documented in docs/overload.md")
+
     # tracelint (ISSUE 11): every lint rule id and the fusibility
     # manifest vocabulary must be documented in docs/static_analysis.md
     from spark_rapids_tpu.analysis.core import all_rule_ids

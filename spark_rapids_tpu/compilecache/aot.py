@@ -206,9 +206,32 @@ class _DaemonPool:
         self._q.put((fn, args))
         return None
 
+    def quiesce(self, timeout_s: float) -> bool:
+        """Bounded wait for the queue to drain (all submitted jobs
+        finished).  Daemon workers dying MID-COMPILE at interpreter
+        exit can abort the whole process inside XLA's C++ teardown, so
+        batch drivers that submit speculative compiles near their exit
+        (the overload stress harness, ISSUE 13) drain here first.
+        True when the pool went idle within the timeout."""
+        import time as _time
+
+        deadline = _time.monotonic() + max(timeout_s, 0.0)
+        while _time.monotonic() < deadline:
+            if self._q.unfinished_tasks == 0:
+                return True
+            _time.sleep(0.05)
+        return self._q.unfinished_tasks == 0
+
 
 _POOL: Optional[_DaemonPool] = None
 _POOL_LOCK = threading.Lock()
+
+
+def quiesce_aot(timeout_s: float = 30.0) -> bool:
+    """Drain the background AOT pool if one exists (bounded); see
+    :meth:`_DaemonPool.quiesce`."""
+    pool = _POOL
+    return pool.quiesce(timeout_s) if pool is not None else True
 
 
 def _get_pool() -> _DaemonPool:
@@ -454,6 +477,16 @@ def maybe_submit_aot(root, conf) -> Optional[AotSubmission]:
 
     try:
         if not conf.get(COMPILE_AOT_ENABLED):
+            return None
+        # overload governor (ISSUE 13): under YELLOW/RED, background
+        # compiles DEFER — the pool threads' trace work and executable
+        # memory are speculation pressure can reclaim.  Nothing is
+        # stamped on the root, so a later collect under GREEN submits
+        # normally.
+        from spark_rapids_tpu.governor import context as _GOV
+
+        gov = _GOV.GOVERNOR
+        if gov is not None and gov.pause_background():
             return None
         existing = getattr(root, "_aot_submission", None)
         if existing is not None:

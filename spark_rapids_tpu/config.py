@@ -222,6 +222,88 @@ SEMAPHORE_ACQUIRE_TIMEOUT_MS = conf(
     "with backoff, by which time the convoy may have drained).  "
     "0 waits indefinitely.").long_conf(0)
 
+# --- overload governor (graceful degradation under sustained pressure) -----
+
+GOVERNOR_ENABLED = conf("spark.rapids.tpu.governor.enabled").doc(
+    "Enable the process-global overload governor (governor/): an "
+    "EWMA-smoothed GREEN/YELLOW/RED pressure state machine fused from "
+    "HBM-pool occupancy, admission queue depth, the active-query "
+    "table, the rolling p95, and cost-model predicted walls.  YELLOW "
+    "shrinks batch-size goals and exchange partition budgets, pauses "
+    "scan-prefetch run-ahead, and defers background AOT compiles; RED "
+    "adds deadline-aware load shedding at admission, hot-table-cache "
+    "eviction, and cooperative pause-and-spill preemption of the "
+    "newest-admitted running query.  Disabled (the default): one "
+    "ambient check per site, zero governor calls.").boolean_conf(False)
+
+GOVERNOR_UPDATE_PERIOD_MS = conf(
+    "spark.rapids.tpu.governor.updatePeriodMs").doc(
+    "Minimum interval between pressure recomputations.  The governor "
+    "has no thread of its own: every consult site (admission, batch "
+    "pulls, the telemetry sampler) triggers an update at most this "
+    "often — a consult inside the window reads the cached state."
+).double_conf(50.0)
+
+GOVERNOR_EWMA_ALPHA = conf("spark.rapids.tpu.governor.ewmaAlpha").doc(
+    "EWMA smoothing weight for the fused pressure signal (higher = "
+    "reacts faster, flaps easier).  Smoothing plus the separate "
+    "up/down thresholds is what keeps an oscillating signal from "
+    "flapping the state machine.").double_conf(0.4)
+
+GOVERNOR_YELLOW_UP = conf(
+    "spark.rapids.tpu.governor.yellowUpThreshold").doc(
+    "Smoothed pressure at (or above) which GREEN enters YELLOW."
+).double_conf(0.65)
+
+GOVERNOR_YELLOW_DOWN = conf(
+    "spark.rapids.tpu.governor.yellowDownThreshold").doc(
+    "Smoothed pressure at (or below) which YELLOW re-enters GREEN.  "
+    "Must sit below yellowUpThreshold — the gap is the hysteresis band "
+    "that prevents flapping.").double_conf(0.45)
+
+GOVERNOR_RED_UP = conf("spark.rapids.tpu.governor.redUpThreshold").doc(
+    "Smoothed pressure at (or above) which the governor enters RED."
+).double_conf(0.85)
+
+GOVERNOR_RED_DOWN = conf("spark.rapids.tpu.governor.redDownThreshold").doc(
+    "Smoothed pressure at (or below) which RED de-escalates (to YELLOW, "
+    "or straight to GREEN when also at or below yellowDownThreshold)."
+).double_conf(0.60)
+
+GOVERNOR_DEGRADE_FRACTION = conf(
+    "spark.rapids.tpu.governor.degradeBatchFraction").doc(
+    "Under YELLOW/RED, batch-size goals (coalesce targets, exchange "
+    "drain chunks) and exchange partition budgets shrink to this "
+    "fraction of their configured value — smaller working sets per "
+    "step trade throughput for bounded residency.").double_conf(0.5)
+
+GOVERNOR_MAX_PAUSE_MS = conf("spark.rapids.tpu.governor.maxPauseMs").doc(
+    "Upper bound on one cooperative pause-and-spill preemption: the "
+    "preempted query spills its unpinned device batches at its next "
+    "batch-pull boundary and waits until pressure leaves RED or this "
+    "many ms pass, then resumes — it is never cancelled."
+).long_conf(2000)
+
+GOVERNOR_SHED_MIN_RETRY_MS = conf(
+    "spark.rapids.tpu.governor.shedMinRetryMs").doc(
+    "Floor for the retry_after_ms hint carried by a shed "
+    "QueryRejected — clients backing off sooner than this would "
+    "re-arrive before any pressure could drain.").long_conf(100)
+
+GOVERNOR_HOT_CACHE_EVICT_FRACTION = conf(
+    "spark.rapids.tpu.governor.hotCacheEvictFraction").doc(
+    "Fraction of hot-table-cache bytes evicted (LRU-first) on each "
+    "entry into RED — cached convenience data is the first ballast "
+    "overboard.").double_conf(0.5)
+
+GOVERNOR_BACKLOG_TARGET_MS = conf(
+    "spark.rapids.tpu.governor.backlogTargetMs").doc(
+    "Normalization for the cost-model backlog signal: the summed "
+    "PR 8 predicted walls of admitted queries, divided by the "
+    "admission limit, reads as pressure 1.0 at this many ms.  0 "
+    "disables the predicted-wall component (the memory/queue/latency "
+    "signals still drive the state machine).").long_conf(0)
+
 # --- resilience (stage-level fault domains) --------------------------------
 
 RESILIENCE_ENABLED = conf("spark.rapids.tpu.resilience.enabled").doc(

@@ -51,6 +51,7 @@ EVENT_SCHEMA: Dict[str, List[str]] = {
     "io_fault": ["kind", "path", "fmt", "detail"],
     "scan_prefetch": ["depth", "batches", "overlapped_bytes", "stall_ns"],
     "ici_shuffle": ["stage", "n_dev", "rows", "bytes", "dur_ns"],
+    "governor": ["action", "state", "prev", "pressure", "detail"],
     "query_stall": ["query_id", "path", "name", "stalled_ms", "detail"],
     "progress": ["query_id", "pct", "eta_ns", "stalls", "background"],
     "op_batch": ["path", "batch", "rows", "dur_ns"],
@@ -361,6 +362,16 @@ class QueryDiagnostics:
         ``rejected``."""
         self._event(ESSENTIAL, "lifecycle", kind=kind,
                     detail=str(detail)[:500], dur_ns=int(dur_ns))
+
+    def governor(self, action: str, state: str, prev: str = "",
+                 pressure: float = 0.0, detail: str = "") -> None:
+        """An overload-governor event (ISSUE 13): ``transition`` (the
+        pressure state machine moved; ``prev`` names the old state) or
+        ``preempt_pause`` (this query took a cooperative pause-and-
+        spill at a batch-pull boundary)."""
+        self._event(ESSENTIAL, "governor", action=action, state=state,
+                    prev=prev, pressure=float(pressure),
+                    detail=str(detail)[:500])
 
     def query_stall(self, query_id: str, path: str, name: str,
                     stalled_ms: float, detail: str = "") -> None:

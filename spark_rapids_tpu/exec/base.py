@@ -135,6 +135,37 @@ def _progress(fn):
     return wrapper
 
 
+def _governor_checkpoint(fn):
+    """Overload-governor hook (ISSUE 13): with an active governor,
+    every batch pull runs one rate-limited pressure update and — when
+    THIS query is the armed preemption target — the cooperative
+    pause-and-spill (the pool drains at a batch boundary; the query
+    resumes, never cancelled).  Disabled path: one ambient attribute
+    check per batch, ZERO governor-module calls (the cProfile pin in
+    tests/test_governor.py)."""
+    import functools
+
+    from spark_rapids_tpu.governor import context as _GOV
+
+    @functools.wraps(fn)
+    def wrapper(self, *a, **kw):
+        it = fn(self, *a, **kw)
+        try:
+            while True:
+                gov = _GOV.GOVERNOR
+                if gov is not None:
+                    gov.batch_pull_checkpoint()
+                try:
+                    b = next(it)
+                except StopIteration:
+                    return
+                yield b
+        finally:
+            it.close()
+
+    return wrapper
+
+
 def _cancel_guard(fn):
     """Outermost-of-all wrapper: ONE ambient contextvar check per batch
     pull against the current query's CancelToken (lifecycle/context.py).
@@ -445,16 +476,22 @@ class TpuExec:
         # the whole iteration, trace annotations included.  diagnostics
         # outside that: the span covers retries/fallbacks, and resilience
         # events fired by the fault domain attribute to this operator.
-        # progress between the cancel guard and diagnostics: its pull
-        # span covers the whole recorded batch (retries included), and
-        # a tripped token raises BEFORE begin_pull so the in-flight
+        # progress between the governor checkpoint and diagnostics: its
+        # pull span covers the whole recorded batch (retries included),
+        # and a tripped token raises BEFORE begin_pull so the in-flight
         # stack never holds a pull that was never started.
+        # governor checkpoint between the cancel guard and progress: a
+        # pause-and-spill preemption happens OUTSIDE the progress pull
+        # span (a paused query is degrading gracefully, not stalled mid
+        # -operator), and AFTER the cancel check (a tripped token
+        # raises instead of pausing).
         # cancel guard outermost of all: a tripped CancelToken stops the
         # pull BEFORE any more work starts, and its raise must not be
         # wrapped in a diagnostics span it would never close
         if "execute_columnar" in cls.__dict__:
-            cls.execute_columnar = _cancel_guard(_progress(_diag(
-                _fault_domain(_traced(cls.execute_columnar)))))
+            cls.execute_columnar = _cancel_guard(_governor_checkpoint(
+                _progress(_diag(_fault_domain(
+                    _traced(cls.execute_columnar))))))
 
     def collect_metrics(self, into=None) -> Dict[str, int]:
         into = into if into is not None else {}

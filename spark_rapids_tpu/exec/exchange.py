@@ -306,6 +306,13 @@ class TpuShuffleExchangeExec(TpuExec):
             self.num_partitions, self.output, queue_device_budget(c),
             codec=host_boundary_codec(c))
         goal = int(c.get(BATCH_SIZE_BYTES))
+        # overload governor (ISSUE 13): under YELLOW/RED the drain
+        # chunks shrink so each reduce step pins a smaller working set
+        from spark_rapids_tpu.governor import context as _GOV
+
+        _gov = _GOV.GOVERNOR
+        if _gov is not None:
+            goal = _gov.degraded_goal(goal)
         try:
             with self.metric("shuffleWriteTime").timed():
                 for b in self.children[0].execute_columnar():

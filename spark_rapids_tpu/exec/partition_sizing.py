@@ -149,13 +149,21 @@ def estimate_input_bytes(child, conf) -> Optional[int]:
 
 
 def target_partition_bytes(conf) -> int:
-    """The per-partition working-set budget: pool * fraction."""
+    """The per-partition working-set budget: pool * fraction.  Under
+    governor YELLOW/RED pressure (ISSUE 13) the budget shrinks by
+    ``governor.degradeBatchFraction`` — more, smaller partitions keep
+    each reduce step's residency bounded while the pool is contended."""
     from spark_rapids_tpu.config import EXCHANGE_TARGET_PARTITION_FRACTION
+    from spark_rapids_tpu.governor import context as _GOV
     from spark_rapids_tpu.memory.device_manager import get_device_manager
 
     pool = get_device_manager().pool_bytes
     frac = conf.get(EXCHANGE_TARGET_PARTITION_FRACTION)
-    return max(int(pool * frac), 1 << 16)
+    target = max(int(pool * frac), 1 << 16)
+    gov = _GOV.GOVERNOR
+    if gov is not None:
+        target = gov.degraded_partition_target(target)
+    return target
 
 
 def choose_partition_count(exchange, conf) -> Optional[int]:

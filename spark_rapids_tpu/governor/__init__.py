@@ -1,0 +1,82 @@
+"""Overload governor (ISSUE 13): graceful degradation under sustained
+memory and queue pressure — the robustness prerequisite of the
+always-on serving tier (ROADMAP north star).
+
+Before this package, a saturated device pool plus a deep admission
+queue produced hard ``deviceOom`` retry storms, deadline cascades, and
+blunt queue-full ``QueryRejected``s.  The governor fuses the signals
+the repo already produces — HBM-pool occupancy (memory/spill.py),
+admission queue depth (lifecycle/admission.py), the watchdog
+active-query table, the telemetry rolling p95, and PR 8 cost-model
+predicted walls — into an EWMA-smoothed GREEN/YELLOW/RED state machine
+with separate up/down hysteresis thresholds, and each state drives
+concrete degradation:
+
+* YELLOW — shrink batch-size goals (coalesce targets, exchange drain
+  chunks) and exchange partition budgets to ``degradeBatchFraction``,
+  stop scan-prefetch run-ahead, defer background AOT compiles.
+* RED — additionally: deadline-aware load shedding at admission (a
+  structured ``QueryRejected`` carrying ``queue_depth`` /
+  ``retry_after_ms`` / ``pressure_state``), LRU eviction of the
+  hot-table cache, and cooperative pause-and-spill preemption of the
+  newest-admitted running query at its next batch-pull boundary — the
+  pool drains without cancelling anyone.
+
+  context.py — the ambient slot (ONE attribute read on hot paths)
+  core.py    — OverloadGovernor: signal fusion, hysteresis, actions
+
+Observability: ``governor_transitions`` / ``queries_shed`` /
+``preempt_pauses`` / ``degraded_batches`` counters, ``governor_state``
+/ ``governor_pressure`` sampler gauges, the ``governor`` diagnostics
+event, flight-ring ``governor`` events, and a post-mortem bundle on
+every entry into RED.  Chaos/stress drivers: ``tools/run_chaos.py
+--pressure`` and ``tools/run_stress.py --overload``
+(docs/overload.md).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from spark_rapids_tpu.governor import context as CTX
+from spark_rapids_tpu.governor.core import (
+    GREEN,
+    RED,
+    YELLOW,
+    OverloadGovernor,
+)
+
+_LOCK = threading.Lock()
+
+
+def ensure_governor(conf) -> Optional["OverloadGovernor"]:
+    """Idempotent process-global start (called by TpuSession.__init__):
+    the FIRST enabling conf builds the governor; later sessions reuse
+    it.  Returns None when the conf leaves the governor disabled (the
+    default) — the ambient slot stays None and every instrumented site
+    skips on one attribute read."""
+    from spark_rapids_tpu.config import GOVERNOR_ENABLED
+
+    if not conf.get(GOVERNOR_ENABLED):
+        return None
+    with _LOCK:
+        if CTX.GOVERNOR is None:
+            CTX.GOVERNOR = OverloadGovernor(conf)
+        return CTX.GOVERNOR
+
+
+def get_governor() -> Optional["OverloadGovernor"]:
+    return CTX.GOVERNOR
+
+
+def shutdown_governor() -> None:
+    """Clear the ambient slot (tests / process teardown); the next
+    enabling TpuSession rebuilds."""
+    with _LOCK:
+        CTX.GOVERNOR = None
+
+
+__all__ = [
+    "GREEN", "YELLOW", "RED", "OverloadGovernor",
+    "ensure_governor", "get_governor", "shutdown_governor",
+]

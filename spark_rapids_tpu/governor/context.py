@@ -1,0 +1,30 @@
+"""Governor context — the ONLY governor module instrumented sites read.
+
+``GOVERNOR`` is the process-wide active :class:`~spark_rapids_tpu.
+governor.core.OverloadGovernor` (or None).  Like ``telemetry.context.
+HUB`` and ``diagnostics.context.RECORDER`` it is a plain module
+attribute, not a contextvar: overload is a property of the *process*
+(one HBM pool, one admission queue), and degradation decisions must be
+visible from engine-owned helper threads (the telemetry sampler, the
+scan prefetch ring, the AOT pool) that a contextvar would silently
+drop.
+
+Disabled-path contract (mirrors the diagnostics/telemetry/progress
+contracts, pinned by tests/test_governor.py): every instrumented site
+performs exactly ONE ambient check — ``if CTX.GOVERNOR is None: skip``
+— before doing any other governor work, so the
+``spark.rapids.tpu.governor.enabled=false`` path costs an attribute
+read and ZERO calls into governor modules (cProfile-pinned).
+"""
+from __future__ import annotations
+
+# the active OverloadGovernor; None = governor off (the default).  Read
+# lock-free from instrumented sites; written only by
+# governor.ensure_governor / governor.shutdown_governor under the
+# module lock in governor/__init__.py.
+GOVERNOR = None
+
+
+def active():
+    """The active governor or None (one ambient check)."""
+    return GOVERNOR

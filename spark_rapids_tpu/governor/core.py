@@ -1,0 +1,445 @@
+"""OverloadGovernor — the EWMA-smoothed pressure state machine and the
+degradation actions each state drives.
+
+Reference analog: the resource-governance layer Theseus
+(arXiv:2508.05029) argues accelerated SQL platforms win or lose on, and
+the load-shedding discipline a serving-shaped deployment needs
+("Accelerating Presto with GPUs", arXiv:2606.24647): a saturated device
+pool plus a deep admission queue must produce *controlled degradation*
+— smaller working sets, paused speculation, deadline-aware shedding,
+cooperative preemption — never hard-OOM retry storms or deadline
+cascades.
+
+Signals (all peek-only — a governor consult can never CREATE a spill
+framework, admission controller, or telemetry hub):
+
+* HBM-pool occupancy: ``SpillFramework.device_used`` / ``pool_bytes``.
+* Admission queue depth: ``peek_admission()`` queued / maxQueueDepth.
+* Rolling p95 vs the armed SLO target (telemetry hub, when present).
+* Cost-model backlog: summed PR 8 predicted walls of admitted queries
+  vs ``governor.backlogTargetMs`` (0 disables the component).
+* The watchdog active-query table feeds preemption targeting (newest
+  admitted = least sunk cost) and the transition detail.
+
+The fused raw pressure is the MAX of the components (overload is a
+max-bottleneck phenomenon: a full queue with an empty pool is still
+overload), EWMA-smoothed under ``governor.ewmaAlpha``.  The state
+machine uses separate up/down thresholds (yellowUp > yellowDown,
+redUp > redDown) so an oscillating signal inside the hysteresis band
+produces no transitions — pinned by tests/test_governor.py.
+
+Locking discipline: all mutable state is guarded by ``self._lock``;
+raw-signal reads and every outward call (spill, evict, post-mortem,
+flight events) happen OUTSIDE the lock, so the only inter-lock edge is
+<caller's lock> -> governor lock and the lock-order detector sees no
+cycle.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+GREEN = "GREEN"
+YELLOW = "YELLOW"
+RED = "RED"
+
+_STATE_LEVEL = {GREEN: 0, YELLOW: 1, RED: 2}
+
+
+class OverloadGovernor:
+    """Process-global pressure state machine + degradation ladder."""
+
+    def __init__(self, conf):
+        from spark_rapids_tpu.config import (
+            GOVERNOR_BACKLOG_TARGET_MS,
+            GOVERNOR_DEGRADE_FRACTION,
+            GOVERNOR_EWMA_ALPHA,
+            GOVERNOR_HOT_CACHE_EVICT_FRACTION,
+            GOVERNOR_MAX_PAUSE_MS,
+            GOVERNOR_RED_DOWN,
+            GOVERNOR_RED_UP,
+            GOVERNOR_SHED_MIN_RETRY_MS,
+            GOVERNOR_UPDATE_PERIOD_MS,
+            GOVERNOR_YELLOW_DOWN,
+            GOVERNOR_YELLOW_UP,
+            TELEMETRY_SLO_TARGET_P95_MS,
+        )
+
+        self._lock = threading.Lock()
+        self._period_ns = int(
+            max(float(conf.get(GOVERNOR_UPDATE_PERIOD_MS)), 1.0) * 1e6)
+        self._alpha = min(max(float(conf.get(GOVERNOR_EWMA_ALPHA)), 0.01),
+                          1.0)
+        self._yellow_up = float(conf.get(GOVERNOR_YELLOW_UP))
+        self._yellow_down = float(conf.get(GOVERNOR_YELLOW_DOWN))
+        self._red_up = float(conf.get(GOVERNOR_RED_UP))
+        self._red_down = float(conf.get(GOVERNOR_RED_DOWN))
+        self._degrade_fraction = min(max(
+            float(conf.get(GOVERNOR_DEGRADE_FRACTION)), 0.05), 1.0)
+        self._max_pause_ms = int(conf.get(GOVERNOR_MAX_PAUSE_MS))
+        self._shed_min_retry_ms = int(conf.get(GOVERNOR_SHED_MIN_RETRY_MS))
+        self._evict_fraction = min(max(
+            float(conf.get(GOVERNOR_HOT_CACHE_EVICT_FRACTION)), 0.0), 1.0)
+        self._backlog_target_ms = int(conf.get(GOVERNOR_BACKLOG_TARGET_MS))
+        self._slo_target_ms = float(conf.get(TELEMETRY_SLO_TARGET_P95_MS))
+        # mutable state (all under self._lock)
+        self._state = GREEN
+        self._ewma = 0.0
+        self._raw = 0.0
+        self._next_update_ns = 0
+        self._transitions = 0
+        self._preempt_qid: Optional[str] = None
+        self._pausing_qid: Optional[str] = None
+        self._predicted_ns: Dict[str, int] = {}
+        self._wall_ewma_ms = 0.0
+        # test hook: a callable returning the raw pressure, bypassing
+        # the live signal peeks (unit tests drive the state machine
+        # with synthetic oscillations)
+        self._signal_override = None
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @property
+    def pressure(self) -> float:
+        return self._ewma
+
+    @property
+    def transitions(self) -> int:
+        return self._transitions
+
+    def gauges(self) -> Dict[str, float]:
+        """Telemetry-sampler gauges (one update first, so the sampled
+        state is at most updatePeriodMs stale)."""
+        self.maybe_update()
+        with self._lock:
+            return {"governor_state": float(_STATE_LEVEL[self._state]),
+                    "governor_pressure": round(self._ewma, 4)}
+
+    # -- test hook -------------------------------------------------------
+    def set_signal_override(self, fn) -> None:
+        """Replace the live signal peeks with ``fn() -> float`` (None
+        restores); also resets the update throttle so a test can step
+        the machine deterministically."""
+        with self._lock:
+            self._signal_override = fn
+            self._next_update_ns = 0
+
+    # -- signal fusion ---------------------------------------------------
+    def _raw_pressure(self) -> Tuple[float, Dict[str, float]]:
+        """The fused raw pressure and its components.  Peek-only and
+        LOCK-FREE: called before taking self._lock (the component reads
+        take other modules' locks)."""
+        override = self._signal_override
+        if override is not None:
+            v = float(override())
+            return v, {"override": v}
+        comp: Dict[str, float] = {}
+        from spark_rapids_tpu.memory.spill import peek_spill_framework
+
+        fw = peek_spill_framework()
+        if fw is not None and fw.pool_bytes:
+            comp["memory"] = fw.device_used / float(fw.pool_bytes)
+        from spark_rapids_tpu.lifecycle.admission import peek_admission
+
+        limit = 1
+        ctl = peek_admission()
+        if ctl is not None:
+            st = ctl.stats()
+            limit = max(int(st["limit"]), 1)
+            comp["queue"] = st["queued"] / float(max(st["max_queue"], 1))
+        from spark_rapids_tpu.telemetry import context as TEL
+
+        hub = TEL.HUB
+        if hub is not None and self._slo_target_ms > 0:
+            comp["latency"] = hub.slo.p95_ms() / self._slo_target_ms
+        if self._backlog_target_ms > 0:
+            with self._lock:
+                pred_ns = sum(self._predicted_ns.values())
+            comp["backlog"] = (pred_ns / 1e6) / (
+                self._backlog_target_ms * float(limit))
+        return (max(comp.values()) if comp else 0.0), comp
+
+    # -- the update step -------------------------------------------------
+    def maybe_update(self, now_ns: Optional[int] = None) -> str:
+        """Recompute pressure and step the state machine, at most once
+        per updatePeriodMs; returns the (possibly unchanged) state.
+        Safe from any thread and from inside other modules' locks."""
+        now = now_ns if now_ns is not None else time.monotonic_ns()
+        if now < self._next_update_ns:          # cheap unlocked fast path
+            return self._state
+        raw, comp = self._raw_pressure()
+        prev = new = None
+        with self._lock:
+            if now < self._next_update_ns:      # another thread updated
+                return self._state
+            self._next_update_ns = now + self._period_ns
+            self._raw = raw
+            self._ewma = (self._alpha * raw
+                          + (1.0 - self._alpha) * self._ewma)
+            prev = self._state
+            new = self._next_state_locked(self._ewma)
+            if new != prev:
+                self._state = new
+                self._transitions += 1
+                if _STATE_LEVEL[new] < _STATE_LEVEL[RED]:
+                    # leaving RED lifts any still-armed preemption
+                    self._preempt_qid = None
+            ewma = self._ewma
+        if new != prev:
+            self._on_transition(prev, new, ewma, comp)
+        return new
+
+    def _next_state_locked(self, ewma: float) -> str:
+        s = self._state
+        if s == GREEN:
+            if ewma >= self._red_up:
+                return RED
+            if ewma >= self._yellow_up:
+                return YELLOW
+        elif s == YELLOW:
+            if ewma >= self._red_up:
+                return RED
+            if ewma <= self._yellow_down:
+                return GREEN
+        else:  # RED
+            if ewma <= self._red_down:
+                return GREEN if ewma <= self._yellow_down else YELLOW
+        return s
+
+    def _on_transition(self, prev: str, new: str, ewma: float,
+                       comp: Dict[str, float]) -> None:
+        """Everything a state change drives — runs OUTSIDE the governor
+        lock (post-mortems, eviction, and events call other modules)."""
+        from spark_rapids_tpu import perfcounters as PC
+
+        PC.bump("governor_transitions")
+        detail = ", ".join(f"{k}={v:.2f}" for k, v in sorted(comp.items()))
+        from spark_rapids_tpu.diagnostics import context as DIAG
+
+        rec = DIAG.RECORDER
+        if rec is not None:
+            rec.governor("transition", new, prev=prev,
+                         pressure=round(ewma, 4), detail=detail)
+        from spark_rapids_tpu.telemetry import context as TEL
+
+        hub = TEL.HUB
+        if hub is not None:
+            hub.record_event("governor", state=new, prev=prev,
+                             pressure=round(ewma, 4), detail=detail)
+        if new == RED:
+            self._enter_red(ewma, detail, hub)
+
+    def _enter_red(self, ewma: float, detail: str, hub) -> None:
+        """RED-entry actions: flight-recorder post-mortem, hot-table
+        -cache eviction, and arming pause-and-spill preemption."""
+        if hub is not None:
+            try:
+                hub.postmortem(
+                    "governor_red",
+                    detail=f"pressure {ewma:.3f} ({detail})")
+            # tpulint: disable=cancel-swallow (telemetry isolation: a
+            # post-mortem failure must not break the pressure update)
+            except Exception:
+                pass
+        from spark_rapids_tpu.io.hot_cache import peek_hot_cache
+
+        hc = peek_hot_cache()
+        if hc is not None and self._evict_fraction > 0:
+            try:
+                keep = int(hc.stats()["bytes"]
+                           * (1.0 - self._evict_fraction))
+                hc.evict_to_bytes(keep)
+            # tpulint: disable=cancel-swallow (best-effort ballast drop;
+            # eviction failure must not break the pressure update)
+            except Exception:
+                pass
+        self.request_preempt()
+
+    # -- degradation: batch goals / budgets (YELLOW and up) --------------
+    def degraded_goal(self, goal_bytes: int) -> int:
+        """The (possibly shrunk) batch-size goal for the current
+        pressure state; counts one ``degraded_batches`` per shrink.
+        The 64KiB floor never RAISES a goal already configured below
+        it — degradation shrinks or leaves alone, only."""
+        if self.maybe_update() == GREEN:
+            return goal_bytes
+        from spark_rapids_tpu import perfcounters as PC
+
+        PC.bump("degraded_batches")
+        return min(goal_bytes,
+                   max(int(goal_bytes * self._degrade_fraction), 1 << 16))
+
+    def degraded_partition_target(self, target_bytes: int) -> int:
+        """The (possibly shrunk) exchange partition budget — plan-time
+        twin of :meth:`degraded_goal` (no per-batch counter)."""
+        if self.maybe_update() == GREEN:
+            return target_bytes
+        return min(target_bytes,
+                   max(int(target_bytes * self._degrade_fraction), 1 << 16))
+
+    def pause_background(self) -> bool:
+        """True when speculative background work (scan prefetch
+        run-ahead, AOT compile submission) should pause: any non-GREEN
+        state — speculation spends exactly the memory and device time
+        pressure needs back."""
+        return self.maybe_update() != GREEN
+
+    # -- RED: deadline-aware admission shedding --------------------------
+    def shed_admission(self, ctx, running: int, limit: int,
+                       queued: int) -> Optional[int]:
+        """Consulted by the admission gate for a query about to queue:
+        returns the ``retry_after_ms`` hint when the query should be
+        shed (RED, carries a deadline, and predicted wall + predicted
+        queue wait cannot meet it), else None (queue normally).  Never
+        sheds deadline-less queries — they can afford to wait."""
+        if self.maybe_update() != RED:
+            return None
+        if ctx.deadline_ns is None:
+            return None
+        remaining_ms = (ctx.deadline_ns - time.monotonic_ns()) / 1e6
+        wall_ms, wait_ms = self._predict_ms(queued, limit)
+        if wall_ms <= 0.0:
+            # no latency history yet: shed only the already-hopeless
+            wall_ms = 0.0
+        if wait_ms + wall_ms <= remaining_ms:
+            return None
+        return self.retry_after_ms(queued, limit)
+
+    def retry_after_ms(self, queued: int, limit: int) -> int:
+        """The client-backoff hint: the predicted time for the current
+        queue to drain one slot, floored at shedMinRetryMs."""
+        _wall, wait_ms = self._predict_ms(queued, limit)
+        return int(max(wait_ms, float(self._shed_min_retry_ms)))
+
+    def _predict_ms(self, queued: int, limit: int) -> Tuple[float, float]:
+        """(predicted wall of one query, predicted queue wait) in ms:
+        the rolling p95 when the telemetry hub has one, else the
+        governor's own wall EWMA."""
+        wall_ms = 0.0
+        from spark_rapids_tpu.telemetry import context as TEL
+
+        hub = TEL.HUB
+        if hub is not None:
+            wall_ms = hub.slo.p95_ms()
+        if wall_ms <= 0.0:
+            wall_ms = self._wall_ewma_ms
+        wait_ms = queued * wall_ms / float(max(limit, 1))
+        return wall_ms, wait_ms
+
+    # -- lifecycle feed --------------------------------------------------
+    def note_query_end(self, query_id: str, wall_ns: int) -> None:
+        """query_lifecycle exit hook: feeds the wall EWMA the shed
+        predictor falls back on, and clears the query's predicted-wall
+        backlog entry.  An armed preemption TARGET that finished on its
+        own re-arms the slot against the next-newest query — a stale
+        dead-query id must not disable pause-and-spill for the rest of
+        a RED episode."""
+        ms = wall_ns / 1e6
+        rearm = False
+        with self._lock:
+            self._predicted_ns.pop(query_id, None)
+            self._wall_ewma_ms = (0.3 * ms + 0.7 * self._wall_ewma_ms
+                                  if self._wall_ewma_ms else ms)
+            if self._preempt_qid == query_id:
+                self._preempt_qid = None
+                rearm = self._state == RED
+        if rearm:
+            # the finished query already left the watchdog registry, so
+            # this targets the next-newest running query (if any)
+            self.request_preempt()
+
+    def note_predicted_wall(self, query_id: str, wall_ns: int) -> None:
+        """Cost-model hook (ISSUE 8 join): an admitted query's predicted
+        wall joins the backlog signal until its query_lifecycle exits."""
+        with self._lock:
+            self._predicted_ns[query_id] = int(wall_ns)
+
+    # -- RED: cooperative pause-and-spill preemption ---------------------
+    def request_preempt(self, exclude_qid: Optional[str] = None) -> bool:
+        """Arm a pause-and-spill of the newest-admitted running query
+        (largest admission_seq = least sunk cost), excluding
+        ``exclude_qid`` (an OOM-retrying query must not preempt
+        itself).  The target pauses at its next batch-pull boundary —
+        it is never cancelled.  False when no eligible target exists."""
+        from spark_rapids_tpu.lifecycle import watchdog as _wd
+
+        cands = [c for c in _wd.active_queries()
+                 if c.query_id != exclude_qid and not c.token.cancelled]
+        if not cands:
+            return False
+        target = max(cands, key=lambda c: c.admission_seq)
+        with self._lock:
+            if self._pausing_qid == target.query_id:
+                return True          # already pausing
+            self._preempt_qid = target.query_id
+        return True
+
+    def preempt_for_oom(self, exclude_qid: Optional[str] = None) -> bool:
+        """memory/retry.py's RED path: arm a preemption pass (and spill
+        whatever is already unpinned) INSTEAD of immediately halving
+        the batch — the pool drains from someone else's working set
+        before this query shrinks its own."""
+        armed = self.request_preempt(exclude_qid=exclude_qid)
+        from spark_rapids_tpu.memory.spill import peek_spill_framework
+
+        fw = peek_spill_framework()
+        if fw is not None:
+            fw.spill_device_pressure()
+        return armed
+
+    def batch_pull_checkpoint(self) -> None:
+        """The exec/base per-pull hook: one rate-limited pressure
+        update, plus — when THIS query is the armed preemption target —
+        the cooperative pause-and-spill."""
+        now = time.monotonic_ns()
+        if now >= self._next_update_ns:
+            self.maybe_update(now)
+        if self._preempt_qid is None:           # one unlocked read
+            return
+        from spark_rapids_tpu.lifecycle.context import current
+
+        ctx = current()
+        if ctx is None or ctx.query_id != self._preempt_qid:
+            return
+        self._pause_and_spill(ctx)
+
+    def _pause_and_spill(self, ctx) -> None:
+        """The pause itself: claim the armed target (compare-and-clear
+        under the lock so concurrent pulls of the same query pause
+        once), spill the pool, then wait — cancellably — until pressure
+        leaves RED or maxPauseMs passes, and resume."""
+        with self._lock:
+            if self._preempt_qid != ctx.query_id:
+                return                            # lost the claim
+            self._preempt_qid = None
+            self._pausing_qid = ctx.query_id
+        try:
+            from spark_rapids_tpu import perfcounters as PC
+
+            PC.bump("preempt_pauses")
+            from spark_rapids_tpu.memory.spill import peek_spill_framework
+
+            fw = peek_spill_framework()
+            spilled = fw.spill_device_pressure() if fw is not None else 0
+            from spark_rapids_tpu.diagnostics import context as DIAG
+
+            rec = DIAG.RECORDER
+            if rec is not None:
+                rec.governor(
+                    "preempt_pause", self._state,
+                    pressure=round(self._ewma, 4),
+                    detail=f"{ctx.query_id} paused, {spilled}B spilled")
+            deadline = time.monotonic() + self._max_pause_ms / 1000.0
+            while time.monotonic() < deadline:
+                # a tripped CancelToken raises from here — the pause is
+                # a blocking site like any other (PROPAGATE class)
+                ctx.token.sleep_or_raise(0.02)
+                if self.maybe_update() != RED:
+                    break
+        finally:
+            with self._lock:
+                self._pausing_qid = None

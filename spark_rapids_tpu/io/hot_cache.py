@@ -137,6 +137,31 @@ class HotTableCache:
         return True
 
     # -- maintenance ----------------------------------------------------
+    def evict_to_bytes(self, target_bytes: int) -> int:
+        """Evict LRU entries until total cached bytes <= target (the
+        governor's RED-entry ballast drop, ISSUE 13); returns how many
+        entries were evicted.  Handle closes happen outside the lock,
+        like :meth:`put`'s eviction path."""
+        from spark_rapids_tpu import perfcounters as PC
+
+        target = max(int(target_bytes), 0)
+        with self._lock:
+            victims = []
+            while self._bytes > target and self._entries:
+                _k, v = self._entries.popitem(last=False)
+                self._bytes -= v.nbytes
+                victims.append(v)
+                PC.bump("hot_cache_evictions")
+        for v in victims:
+            for h in v.handles:
+                try:
+                    h.close()
+                # tpulint: disable=cancel-swallow (best-effort close of
+                # evicted spill handles on the pressure-eviction path)
+                except Exception:
+                    pass
+        return len(victims)
+
     def clear(self) -> int:
         with self._lock:
             victims = list(self._entries.values())

@@ -574,7 +574,16 @@ class TpuFileSourceScanExec(TpuExec):
                 time.perf_counter_ns() - t0)
             return out
 
+        from spark_rapids_tpu.governor import context as _GOV
+
         def fill():
+            # overload governor (ISSUE 13): under YELLOW/RED the ring
+            # stops running ahead — speculative uploads spend exactly
+            # the HBM pressure needs back; in-flight jobs still drain
+            # and remaining jobs run inline on the consumer thread
+            gov = _GOV.GOVERNOR
+            if gov is not None and gov.pause_background():
+                return
             while len(ring) < depth:
                 try:
                     job = next(jobs_it)
@@ -584,25 +593,36 @@ class TpuFileSourceScanExec(TpuExec):
 
         try:
             fill()
-            while ring:
-                fut = ring.popleft()
-                fill()
-                overlapped = fut.done()
-                if not overlapped:
-                    t0 = time.perf_counter_ns()
-                    while True:
-                        check_cancel()
-                        try:
-                            items = fut.result(timeout=0.05)
-                            break
-                        except cf.TimeoutError:
-                            continue
-                    stall = time.perf_counter_ns() - t0
-                    PC.bump("prefetch_stall_ns", stall)
-                    self.metric("prefetchStallTime").add(stall)
-                    stats["stall_ns"] += stall
+            while True:
+                if ring:
+                    fut = ring.popleft()
+                    fill()
+                    overlapped = fut.done()
+                    if not overlapped:
+                        t0 = time.perf_counter_ns()
+                        while True:
+                            check_cancel()
+                            try:
+                                items = fut.result(timeout=0.05)
+                                break
+                            except cf.TimeoutError:
+                                continue
+                        stall = time.perf_counter_ns() - t0
+                        PC.bump("prefetch_stall_ns", stall)
+                        self.metric("prefetchStallTime").add(stall)
+                        stats["stall_ns"] += stall
+                    else:
+                        items = fut.result()
                 else:
-                    items = fut.result()
+                    # ring empty: either the governor paused run-ahead
+                    # or every job is consumed — run the next inline
+                    try:
+                        job = next(jobs_it)
+                    except StopIteration:
+                        break
+                    check_cancel()
+                    overlapped = False
+                    items = run_job(job)
                 for b, p in items:
                     stats["batches"] += 1
                     if overlapped:

@@ -151,10 +151,19 @@ class query_lifecycle:
         finally:
             if self._ctl is not None:
                 self._ctl.release()
+            wall_ns = time.monotonic_ns() - ctx.started_ns
+            # overload governor (ISSUE 13): feed the wall EWMA the shed
+            # predictor falls back on, and clear this query's
+            # predicted-wall backlog entry (one ambient check)
+            from spark_rapids_tpu.governor import context as _GOV
+
+            gov = _GOV.GOVERNOR
+            if gov is not None:
+                gov.note_query_end(ctx.query_id, wall_ns)
             _tls.last = {
                 "query_id": ctx.query_id,
                 "admission_wait_ns": ctx.admission_wait_ns,
-                "wall_ns": time.monotonic_ns() - ctx.started_ns,
+                "wall_ns": wall_ns,
                 "status": ("ok" if exc_type is None else
                            getattr(exc_type, "__name__", "error")),
             }

@@ -51,19 +51,46 @@ class AdmissionController:
         token's exception if the query is cancelled while queued."""
         from spark_rapids_tpu import perfcounters as PC
 
+        from spark_rapids_tpu.governor import context as _GOV
+
         t0 = time.perf_counter_ns()
         with self._cond:
             if self._running < self.limit and not self._waiters:
                 self._running += 1
                 PC.bump("queries_admitted")
                 return 0
-            if len(self._waiters) >= self.max_queue:
+            gov = _GOV.GOVERNOR
+            depth = len(self._waiters)
+            if depth >= self.max_queue:
                 PC.bump("queries_rejected")
                 raise QueryRejected(
-                    f"admission queue full ({len(self._waiters)} queued, "
+                    f"admission queue full ({depth} queued, "
                     f"{self._running}/{self.limit} running; "
                     f"spark.rapids.tpu.admission.maxQueueDepth="
-                    f"{self.max_queue})")
+                    f"{self.max_queue})",
+                    queue_depth=depth,
+                    retry_after_ms=(gov.retry_after_ms(depth, self.limit)
+                                    if gov is not None else None),
+                    pressure_state=(gov.state if gov is not None else ""))
+            if gov is not None:
+                # overload governor (ISSUE 13): under RED, a query whose
+                # deadline cannot survive predicted wall + predicted
+                # queue wait is shed HERE — before it pins a queue slot
+                # it can only convert into a deadline cascade
+                retry_ms = gov.shed_admission(
+                    ctx, self._running, self.limit, depth)
+                if retry_ms is not None:
+                    PC.bump("queries_shed")
+                    PC.bump("queries_rejected")
+                    raise QueryRejected(
+                        f"{ctx.query_id}: shed under {gov.state} pressure "
+                        f"({depth} queued, {self._running}/{self.limit} "
+                        f"running): predicted wall + queue wait cannot "
+                        f"meet the query deadline; retry after "
+                        f"{retry_ms}ms",
+                        queue_depth=depth,
+                        retry_after_ms=retry_ms,
+                        pressure_state=gov.state)
             ticket = object()
             self._waiters.append(ticket)
             deadline = (None if timeout_ms <= 0
@@ -74,9 +101,17 @@ class AdmissionController:
                     ctx.token.check()
                     if deadline is not None and time.monotonic() >= deadline:
                         PC.bump("queries_rejected")
+                        gov = _GOV.GOVERNOR
+                        qd = len(self._waiters)
                         raise QueryRejected(
                             f"{ctx.query_id}: admission wait exceeded "
-                            f"queueTimeoutMs={timeout_ms}")
+                            f"queueTimeoutMs={timeout_ms}",
+                            queue_depth=qd,
+                            retry_after_ms=(
+                                gov.retry_after_ms(qd, self.limit)
+                                if gov is not None else None),
+                            pressure_state=(gov.state if gov is not None
+                                            else ""))
                     self._cond.wait(_POLL_S)
                 self._waiters.popleft()
                 self._running += 1

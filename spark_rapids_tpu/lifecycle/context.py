@@ -36,9 +36,31 @@ class QueryDeadlineExceeded(QueryCancelled):
 
 
 class QueryRejected(RuntimeError):
-    """Admission fast-reject: the wait queue was full (or the queue wait
-    timed out).  Raised before any planning/device work happened, so the
-    caller can shed load or retry later."""
+    """Admission fast-reject: the wait queue was full, the queue wait
+    timed out, or the overload governor shed the query (ISSUE 13).
+    Raised before any planning/device work happened, so the caller can
+    shed load or retry later.
+
+    Structured backoff fields (ISSUE 13 satellite — populated by
+    ``lifecycle/admission.py`` on the queue-full, queue-timeout, and
+    governor-shed paths so callers can implement client-side backoff
+    without parsing the message):
+
+    * ``queue_depth``    — admission queue depth at rejection time.
+    * ``retry_after_ms`` — the computed backoff hint (predicted time
+      for the queue to drain a slot; None when no governor/latency
+      history could compute one).
+    * ``pressure_state`` — the governor state at rejection ("GREEN" /
+      "YELLOW" / "RED", or "" when the governor is disabled).
+    """
+
+    def __init__(self, msg: str, queue_depth: Optional[int] = None,
+                 retry_after_ms: Optional[int] = None,
+                 pressure_state: str = ""):
+        super().__init__(msg)
+        self.queue_depth = queue_depth
+        self.retry_after_ms = retry_after_ms
+        self.pressure_state = pressure_state
 
 
 class CancelToken:

@@ -23,6 +23,14 @@ from spark_rapids_tpu.memory.spill import SpillableColumnarBatch
 X = TypeVar("X")
 
 
+def _bump(key: str) -> None:
+    """Deferred-import perfcounters bump (house style: this module
+    defers framework imports to call time)."""
+    from spark_rapids_tpu import perfcounters as PC
+
+    PC.bump(key)
+
+
 class TpuRetryOOM(RuntimeError):
     """Roll back and retry the block (after the framework spills)."""
 
@@ -71,6 +79,27 @@ def _is_device_oom(exc: BaseException) -> bool:
     return is_device_oom(exc)
 
 
+def _preempt_instead_of_split() -> bool:
+    """Overload-governor consult (ISSUE 13 satellite): under RED, an
+    OOM that would split the batch first requests a pause-and-spill
+    preemption pass — the pool drains from the NEWEST-admitted query's
+    working set before this query halves its own batch (halving under
+    transient co-tenant pressure permanently degrades this query's
+    launch efficiency for someone else's spike).  Tried at most once
+    per batch (the caller's flag); the counters ``oom_retry_preempts``
+    / ``oom_retry_splits`` distinguish the two outcomes."""
+    from spark_rapids_tpu.governor import context as _GOV
+
+    gov = _GOV.GOVERNOR
+    if gov is None or gov.maybe_update() != "RED":
+        return False
+    from spark_rapids_tpu.lifecycle.context import current
+
+    ctx = current()
+    return gov.preempt_for_oom(
+        exclude_qid=ctx.query_id if ctx is not None else None)
+
+
 def split_in_half_by_rows(
         spillable: SpillableColumnarBatch) -> List[SpillableColumnarBatch]:
     """Reference analog: splitSpillableInHalfByRows."""
@@ -114,6 +143,7 @@ def with_retry(
             check_cancel()
             item = queue.pop(0)
             attempts = 0
+            preempted = False
             while True:
                 attempts += 1
                 try:
@@ -132,17 +162,36 @@ def with_retry(
                         raise
                     fw.spill_device_pressure()
                 except TpuSplitAndRetryOOM:
+                    # governor RED (ISSUE 13): one preemption pass
+                    # before halving — retry at FULL size once the
+                    # newest-admitted query's working set spills
+                    if not preempted and attempts < max_attempts \
+                            and _preempt_instead_of_split():
+                        preempted = True
+                        _bump("oom_retry_preempts")
+                        continue
                     if not split or item.num_rows < max(min_split_rows, 2):
                         item.close()
                         raise
+                    _bump("oom_retry_splits")
                     queue = split_in_half_by_rows(item) + queue
                     break
                 except Exception as e:  # XLA RESOURCE_EXHAUSTED
                     if not _is_device_oom(e):
                         item.close()
                         raise
+                    # preempt check BEFORE the spill: preempt_for_oom
+                    # runs its own spill pass, so the preempt path must
+                    # not pay two back-to-back handle-list sweeps in
+                    # the middle of a pressure storm
+                    if not preempted and attempts < max_attempts \
+                            and _preempt_instead_of_split():
+                        preempted = True
+                        _bump("oom_retry_preempts")
+                        continue
                     fw.spill_device_pressure()
                     if split and item.num_rows >= max(min_split_rows, 2):
+                        _bump("oom_retry_splits")
                         queue = split_in_half_by_rows(item) + queue
                         break
                     if attempts >= max_attempts:

@@ -191,7 +191,14 @@ def _xxh_fmix(h):
 
 def _xxh_int(value_i32, seed_u64):
     h = seed_u64 + _P5 + jnp.uint64(4)
-    u = value_i32.astype(jnp.uint32).astype(jnp.uint64)  # i & 0xFFFFFFFF
+    # Spark's XXH64.hashInt: i & 0xFFFFFFFFL — the 32-bit pattern zero-
+    # extended.  An astype chain (int8/int16 -> int32 -> uint32 ->
+    # uint64) is NOT safe here: XLA's algebraic simplifier folds the
+    # converts into one signed int8->uint64 convert under jit, sign-
+    # extending negative bytes/shorts into the high 32 bits (eager and
+    # jit disagreed; seed xxhash64 byte/short failures).  The explicit
+    # mask survives any convert folding.
+    u = value_i32.astype(jnp.int64).astype(jnp.uint64) & _u64(0xFFFFFFFF)
     h = h ^ (u * _u64(_P1))
     h = _rotl64(h, 23) * _u64(_P2) + _P3
     return _xxh_fmix(h)

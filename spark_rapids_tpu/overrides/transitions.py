@@ -586,6 +586,15 @@ class TpuTransitionOverrides:
             TpuTransitionOverrides._insert_coalesce(c, conf)
             if isinstance(c, TpuExec) else c
             for c in node.children]
+        # overload governor (ISSUE 13): plan-time batch-size goals
+        # shrink under YELLOW/RED so newly planned queries start with
+        # smaller working sets (one ambient check when disabled)
+        from spark_rapids_tpu.governor import context as _GOV
+
+        _gov = _GOV.GOVERNOR
+        goal_bytes = conf.get(BATCH_SIZE_BYTES)
+        if _gov is not None:
+            goal_bytes = _gov.degraded_goal(goal_bytes)
         new_children = []
         for c in node.children:
             if isinstance(c, TpuShuffleExchangeExec):
@@ -595,13 +604,13 @@ class TpuTransitionOverrides:
                     # (GpuCustomShuffleReaderExec analog)
                     _record("TpuAdaptiveShuffleReaderExec", True)
                     new_children.append(TpuAdaptiveShuffleReaderExec(
-                        c, conf.get(BATCH_SIZE_BYTES),
+                        c, goal_bytes,
                         small_bytes=conf.get(
                             EXCHANGE_COALESCE_SMALL_BYTES)))
                 else:
                     _record("TpuAdaptiveShuffleReaderExec", False,
                             f"{ADAPTIVE_ENABLED.key} is false")
-                    goal = CoalesceGoal(conf.get(BATCH_SIZE_BYTES))
+                    goal = CoalesceGoal(goal_bytes)
                     new_children.append(TpuCoalesceBatchesExec(goal, c))
             else:
                 new_children.append(c)

@@ -120,6 +120,18 @@ COUNTERS: Dict[str, int] = {
     # snapshots served (session.progress() + the /progress endpoint)
     "stalls_detected": 0,
     "progress_snapshots": 0,
+    # overload governor (ISSUE 13, governor/): pressure state machine
+    # transitions, deadline-aware queries shed at admission under RED,
+    # cooperative pause-and-spill preemptions taken at batch-pull
+    # boundaries, batch-size-goal shrinks applied under YELLOW/RED, and
+    # the OOM-retry outcome split — a RED preemption pass taken instead
+    # of halving vs a batch actually split
+    "governor_transitions": 0,
+    "queries_shed": 0,
+    "preempt_pauses": 0,
+    "degraded_batches": 0,
+    "oom_retry_preempts": 0,
+    "oom_retry_splits": 0,
     # ICI multi-chip shuffle (ISSUE 10): per-query collective-exchange
     # accounting — epochs through the mesh all-to-all stages, rows/bytes
     # exchanged device-to-device (never through the host), and the wall

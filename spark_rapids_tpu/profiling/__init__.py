@@ -71,6 +71,18 @@ def annotate_plan(root, conf, attributed: bool = True):
                  pred.predicted_wall_ns)
         if pred.misses:
             bump("cost_model_misses", pred.misses)
+        # overload governor (ISSUE 13): an admitted query's predicted
+        # wall joins the governor's backlog signal until its lifecycle
+        # exits (one ambient check; cleared by note_query_end)
+        if pred.hits and pred.predicted_wall_ns:
+            from spark_rapids_tpu.governor import context as _GOV
+            from spark_rapids_tpu.lifecycle.context import current
+
+            gov = _GOV.GOVERNOR
+            ctx = current()
+            if gov is not None and ctx is not None:
+                gov.note_predicted_wall(ctx.query_id,
+                                        pred.predicted_wall_ns)
         return pred
     except Exception as e:
         print(f"spark_rapids_tpu.profiling: plan annotation failed: {e}",

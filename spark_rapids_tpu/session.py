@@ -113,6 +113,13 @@ class TpuSession:
         from spark_rapids_tpu.telemetry import maybe_configure
 
         maybe_configure(self.conf)
+        # Overload governor (ISSUE 13): the first session whose conf
+        # enables spark.rapids.tpu.governor.enabled installs the
+        # process-global pressure state machine; disabled (the default)
+        # this is one conf read and the ambient slot stays None.
+        from spark_rapids_tpu.governor import ensure_governor
+
+        ensure_governor(self.conf)
 
     @staticmethod
     def builder() -> "TpuSessionBuilder":

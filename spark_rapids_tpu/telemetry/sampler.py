@@ -44,6 +44,8 @@ SAMPLED_COUNTERS = (
     "transient_retries", "runtime_fallbacks", "breaker_trips",
     "slo_violations", "postmortem_dumps",
     "stalls_detected", "progress_snapshots",
+    "governor_transitions", "queries_shed", "preempt_pauses",
+    "degraded_batches",
 )
 
 
@@ -104,6 +106,14 @@ def collect_gauges() -> Dict[str, float]:
     trk = _PROG.TRACKER
     if trk is not None:
         g.update(trk.aggregate_stats())
+    # overload governor (ISSUE 13): per-tick pressure state/level — the
+    # gauges call runs one rate-limited pressure update, so a process
+    # whose queries are all blocked still de-escalates on sampler ticks
+    from spark_rapids_tpu.governor import context as _GOV
+
+    gov = _GOV.GOVERNOR
+    if gov is not None:
+        g.update(gov.gauges())
     return g
 
 

@@ -67,6 +67,15 @@ def _resilience_isolation():
     yield
     clear_faults()
     reset_breaker()
+    # ISSUE 13: the overload governor is process-global too — a test
+    # that enabled it must not leave degradation armed for later tests
+    # (one ambient check; default sessions never create one)
+    from spark_rapids_tpu.governor import context as _GOV
+
+    if _GOV.GOVERNOR is not None:
+        from spark_rapids_tpu.governor import shutdown_governor
+
+        shutdown_governor()
 
 
 @pytest.fixture(autouse=True)

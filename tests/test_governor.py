@@ -1,0 +1,555 @@
+"""Overload-governor tests (ISSUE 13).
+
+State-machine unit tests (threshold crossings, the hysteresis no-flap
+pin under an oscillating signal), pause-and-spill preemption
+correctness vs oracle, the deadline-aware shed path's structured
+``QueryRejected`` + ``retry_after_ms`` sanity, the RED OOM
+preempt-before-split satellite, degradation-ladder hooks (batch goals,
+partition budgets, AOT deferral), and the house-style cProfile
+zero-call disabled-path pin.
+"""
+import cProfile
+import os
+import pstats
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu import perfcounters as PC
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.governor import (
+    context as GOV_CTX,
+    ensure_governor,
+    shutdown_governor,
+)
+from spark_rapids_tpu.governor.core import OverloadGovernor
+from spark_rapids_tpu.lifecycle import (
+    QueryRejected,
+    reset_admission,
+)
+from spark_rapids_tpu.session import TpuSession, col, sum_
+
+_GOV_CONF = {
+    "spark.rapids.sql.enabled": True,
+    "spark.rapids.tpu.governor.enabled": True,
+    "spark.rapids.tpu.governor.updatePeriodMs": "1",
+    # alpha 1.0: session-level tests want the machine to track the
+    # synthetic override immediately; the smoothing-specific unit tests
+    # build their own governors with explicit alphas
+    "spark.rapids.tpu.governor.ewmaAlpha": "1.0",
+}
+
+
+def _mk_gov(**extra) -> OverloadGovernor:
+    conf = dict(_GOV_CONF)
+    conf.update({k: str(v) for k, v in extra.items()})
+    return OverloadGovernor(TpuConf(conf))
+
+
+def _step(gov, value, n=1):
+    """Feed ``value`` through ``n`` update steps (the override reset
+    also resets the update throttle, so each step recomputes)."""
+    for _ in range(n):
+        gov.set_signal_override(lambda: value)
+        gov.maybe_update()
+
+
+def _df(s, n=64):
+    return s.create_dataframe(
+        {"a": list(range(n)), "k": [i % 4 for i in range(n)]},
+        T.StructType([T.StructField("a", T.LONG),
+                      T.StructField("k", T.LONG)]))
+
+
+def _agg(s, n=64):
+    return _df(s, n).group_by("k").agg(sum_("a", "s"))
+
+
+# ---------------------------------------------------------------------------
+# state machine
+# ---------------------------------------------------------------------------
+
+def test_threshold_crossings():
+    """GREEN -> YELLOW -> RED on the up thresholds; RED -> YELLOW ->
+    GREEN on the (lower) down thresholds."""
+    gov = _mk_gov(**{"spark.rapids.tpu.governor.ewmaAlpha": "1.0"})
+    assert gov.state == "GREEN"
+    _step(gov, 0.5)
+    assert gov.state == "GREEN"          # below yellowUp (0.65)
+    _step(gov, 0.7)
+    assert gov.state == "YELLOW"         # crossed yellowUp
+    _step(gov, 0.5)
+    assert gov.state == "YELLOW"         # above yellowDown (0.45): holds
+    _step(gov, 0.9)
+    assert gov.state == "RED"            # crossed redUp (0.85)
+    _step(gov, 0.7)
+    assert gov.state == "RED"            # above redDown (0.60): holds
+    _step(gov, 0.5)
+    assert gov.state == "YELLOW"         # <= redDown, > yellowDown
+    _step(gov, 0.3)
+    assert gov.state == "GREEN"          # <= yellowDown
+    assert gov.transitions == 4
+
+
+def test_green_jumps_straight_to_red():
+    gov = _mk_gov(**{"spark.rapids.tpu.governor.ewmaAlpha": "1.0"})
+    _step(gov, 0.95)
+    assert gov.state == "RED"
+    _step(gov, 0.1)
+    assert gov.state == "GREEN"          # <= both down thresholds
+
+
+def test_hysteresis_no_flap_under_oscillation():
+    """The acceptance pin: a signal oscillating AROUND the YELLOW
+    threshold (0.55 <-> 0.75 across yellowUp=0.65, staying above
+    yellowDown=0.45) produces at most 2 transitions over the whole
+    window — the up/down gap plus EWMA smoothing absorb the
+    oscillation instead of flapping GREEN<->YELLOW every step."""
+    gov = _mk_gov(**{"spark.rapids.tpu.governor.ewmaAlpha": "0.4"})
+    for i in range(100):
+        _step(gov, 0.75 if i % 2 == 0 else 0.55)
+    assert gov.state == "YELLOW"
+    assert gov.transitions <= 2, (
+        f"{gov.transitions} transitions under an oscillating signal — "
+        f"the hysteresis band is not absorbing it")
+
+
+def test_ewma_smooths_single_spike():
+    """One outlier sample must not trip the machine (alpha < 1)."""
+    gov = _mk_gov(**{"spark.rapids.tpu.governor.ewmaAlpha": "0.3"})
+    _step(gov, 0.2, n=5)
+    _step(gov, 1.0)                      # a single spike
+    assert gov.state == "GREEN"
+    assert gov.transitions == 0
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder (YELLOW)
+# ---------------------------------------------------------------------------
+
+def test_degraded_goal_and_partition_target():
+    gov = _mk_gov(**{"spark.rapids.tpu.governor.ewmaAlpha": "1.0"})
+    goal = 1 << 30
+    assert gov.degraded_goal(goal) == goal            # GREEN: unchanged
+    snap = PC.snapshot()
+    _step(gov, 0.7)                                   # YELLOW
+    assert gov.degraded_goal(goal) == goal // 2
+    assert gov.degraded_partition_target(goal) == goal // 2
+    assert PC.since(snap)["degraded_batches"] == 1    # goal counts, not
+    assert gov.pause_background()                     # the plan target
+
+
+def test_yellow_defers_background_aot():
+    """maybe_submit_aot returns None (defers, stamps nothing) while the
+    installed governor reports pressure."""
+    s = TpuSession(dict(_GOV_CONF))
+    gov = GOV_CTX.GOVERNOR
+    assert gov is not None
+    _step(gov, 0.7, n=3)
+    assert gov.state == "YELLOW"
+    from spark_rapids_tpu.compilecache import maybe_submit_aot
+
+    root, _meta = _agg(s, 32)._planned()
+    assert maybe_submit_aot(root, s.conf) is None
+    assert getattr(root, "_aot_submission", None) is None
+    _step(gov, 0.1, n=5)
+    assert gov.state == "GREEN"
+    assert maybe_submit_aot(root, s.conf) is not None
+
+
+# ---------------------------------------------------------------------------
+# RED: shed path
+# ---------------------------------------------------------------------------
+
+def test_shed_structured_retry_after_sanity():
+    """Under RED, a deadline-carrying query whose predicted wall +
+    queue wait cannot meet the deadline is shed at admission with a
+    structured QueryRejected; retry_after_ms respects the configured
+    floor and the queue-drain estimate."""
+    reset_admission()
+    from spark_rapids_tpu import telemetry
+
+    telemetry.shutdown()                 # the wall-EWMA fallback path:
+    conf = dict(_GOV_CONF)               # no hub p95 to override it
+    conf.update({
+        "spark.rapids.tpu.telemetry.enabled": False,
+        "spark.rapids.tpu.concurrentQueries": "1",
+        "spark.rapids.tpu.admission.maxQueueDepth": "8",
+        "spark.rapids.tpu.query.timeoutMs": "2000",
+        "spark.rapids.tpu.governor.shedMinRetryMs": "123",
+    })
+    s = TpuSession(conf)
+    gov = GOV_CTX.GOVERNOR
+    _step(gov, 0.95, n=5)
+    assert gov.state == "RED"
+    # latency history says one query takes far longer than the deadline
+    gov.note_query_end("warm", int(60e9))
+
+    hold, release = threading.Event(), threading.Event()
+
+    def blocker():
+        from spark_rapids_tpu.expr.udf import udf
+
+        s2 = TpuSession(conf)
+
+        def slow(x):
+            hold.set()
+            release.wait(10)
+            return x
+
+        try:
+            _df(s2, 8).select(
+                udf(slow, T.LONG, "slow")(col("a")).alias("b")).collect()
+        except Exception:
+            pass
+
+    t = threading.Thread(target=blocker)
+    t.start()
+    assert hold.wait(10)
+    snap = PC.snapshot()
+    try:
+        with pytest.raises(QueryRejected) as ei:
+            _agg(s, 16).collect()
+    finally:
+        release.set()
+        t.join(20)
+    e = ei.value
+    assert e.pressure_state == "RED"
+    assert isinstance(e.queue_depth, int)
+    assert e.retry_after_ms is not None
+    # sanity: at least the configured floor, and no more than the
+    # worst-case drain estimate of a short queue against a 60s wall
+    assert 123 <= e.retry_after_ms <= 600_000
+    d = PC.since(snap)
+    assert d["queries_shed"] == 1
+    assert d["queries_rejected"] == 1
+    reset_admission()
+
+
+def test_queue_full_rejection_carries_structured_fields():
+    """The EXISTING queue-full path (ISSUE 4) now populates the backoff
+    fields too."""
+    reset_admission()
+    conf = dict(_GOV_CONF)
+    conf.update({"spark.rapids.tpu.concurrentQueries": "1",
+                 "spark.rapids.tpu.admission.maxQueueDepth": "0"})
+    s = TpuSession(conf)
+    gov = GOV_CTX.GOVERNOR
+    _step(gov, 0.7, n=3)                 # YELLOW: not shedding, but the
+    hold, release = threading.Event(), threading.Event()   # state rides
+
+    def blocker():
+        from spark_rapids_tpu.expr.udf import udf
+
+        s2 = TpuSession(conf)
+
+        def slow(x):
+            hold.set()
+            release.wait(10)
+            return x
+
+        try:
+            _df(s2, 8).select(udf(slow, T.LONG, "slow")(
+                col("a")).alias("b")).collect()
+        except Exception:
+            pass
+
+    t = threading.Thread(target=blocker)
+    t.start()
+    assert hold.wait(10)
+    try:
+        with pytest.raises(QueryRejected) as ei:
+            _agg(s, 16).collect()
+    finally:
+        release.set()
+        t.join(20)
+    e = ei.value
+    assert e.queue_depth == 0            # maxQueueDepth=0: no waiters
+    assert e.pressure_state == "YELLOW"
+    assert e.retry_after_ms is not None  # governor computed a hint
+    reset_admission()
+
+
+# ---------------------------------------------------------------------------
+# RED: pause-and-spill preemption
+# ---------------------------------------------------------------------------
+
+def test_pause_and_spill_correct_vs_oracle():
+    """The armed preemption target pauses at its next batch-pull
+    boundary (preempt_pauses bumps, the pool spills), resumes when
+    pressure leaves RED, and still answers CORRECTLY — preemption never
+    cancels, never corrupts."""
+    oracle = sorted(_agg(
+        TpuSession({"spark.rapids.sql.enabled": False}), 64).collect())
+    conf = dict(_GOV_CONF)
+    conf["spark.rapids.tpu.governor.maxPauseMs"] = "400"
+    s = TpuSession(conf)
+    gov = GOV_CTX.GOVERNOR
+    box = {"v": 0.95}
+    gov.set_signal_override(lambda: box["v"])
+    _step(gov, 0.95, n=5)
+    assert gov.state == "RED"
+    gov.set_signal_override(lambda: box["v"])
+
+    hold, release = threading.Event(), threading.Event()
+    result = {}
+
+    def victim():
+        from spark_rapids_tpu.expr.udf import udf
+
+        sv = TpuSession(conf)
+
+        def gate(x):
+            hold.set()
+            release.wait(10)
+            return x
+
+        df = _df(sv, 64).select(
+            udf(gate, T.LONG, "gate")(col("a")).alias("a"),
+            col("k")).group_by("k").agg(sum_("a", "s"))
+        result["rows"] = sorted(df.collect())
+
+    t = threading.Thread(target=victim)
+    t.start()
+    assert hold.wait(10)
+    # arm the preemption NOW, while the victim is mid-collect: its next
+    # batch-pull boundary takes the pause
+    assert gov.request_preempt()
+    snap = PC.snapshot()
+    release.set()
+    # drop the pressure shortly after so the pause exits via the state
+    # (not only the maxPauseMs backstop)
+    time.sleep(0.1)
+    box["v"] = 0.1
+    t.join(30)
+    d = PC.since(snap)
+    assert d["preempt_pauses"] >= 1, "the target never paused"
+    assert result["rows"] == oracle
+    assert gov._preempt_qid is None
+
+
+def test_oom_red_preempt_before_split():
+    """memory/retry.py satellite: under RED, a SplitAndRetryOOM first
+    requests a preemption pass of the newest-admitted OTHER query and
+    retries at FULL size; only a repeat OOM splits.  The two outcomes
+    are distinguishable by counter."""
+    from spark_rapids_tpu.lifecycle import watchdog as _wd
+    from spark_rapids_tpu.lifecycle.context import CURRENT, QueryContext
+    from spark_rapids_tpu.memory import spill as spill_mod
+    from spark_rapids_tpu.memory.retry import (
+        force_split_and_retry_oom,
+        with_retry,
+    )
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+
+    ensure_governor(TpuConf(_GOV_CONF))
+    gov = GOV_CTX.GOVERNOR
+    _step(gov, 0.95, n=5)
+    assert gov.state == "RED"
+    gov.set_signal_override(lambda: 0.95)
+
+    spill_mod.reset_spill_framework()
+    fw = spill_mod.get_spill_framework(TpuConf(
+        {"spark.rapids.tpu.test.deviceMemoryBytes": str(1 << 30)}))
+    me = QueryContext()
+    victim = QueryContext()              # newer admission_seq than me
+    _wd.register(victim)
+    tok = CURRENT.set(me)
+    try:
+        batch = ColumnarBatch.from_pydict(
+            {"a": list(range(100))},
+            T.StructType([T.StructField("a", T.LONG)]))
+        snap = PC.snapshot()
+        force_split_and_retry_oom(1)
+        out = list(with_retry(fw.track(batch), lambda b: b.num_rows))
+        d = PC.since(snap)
+        # ONE preemption pass, retried at full size — no split
+        assert out == [100]
+        assert d["oom_retry_preempts"] == 1
+        assert d["oom_retry_splits"] == 0
+        assert gov._preempt_qid == victim.query_id
+
+        # a second, repeated OOM on the same item DOES split (the pass
+        # is tried at most once per batch)
+        batch2 = ColumnarBatch.from_pydict(
+            {"a": list(range(100))},
+            T.StructType([T.StructField("a", T.LONG)]))
+        snap = PC.snapshot()
+        force_split_and_retry_oom(2)
+        out = list(with_retry(fw.track(batch2), lambda b: b.num_rows))
+        d = PC.since(snap)
+        assert out == [50, 50]
+        assert d["oom_retry_preempts"] == 1
+        assert d["oom_retry_splits"] == 1
+    finally:
+        CURRENT.reset(tok)
+        _wd.unregister(victim)
+        force_split_and_retry_oom(0)
+        spill_mod.reset_spill_framework()
+
+
+# ---------------------------------------------------------------------------
+# RED entry: post-mortem + hot-cache eviction
+# ---------------------------------------------------------------------------
+
+def test_red_entry_postmortem_and_eviction():
+    from spark_rapids_tpu import telemetry
+    from spark_rapids_tpu.io.hot_cache import get_hot_cache
+
+    telemetry.shutdown()
+    s = TpuSession(dict(_GOV_CONF))
+    hub = telemetry.get_hub()
+    assert hub is not None
+    hub.reset_dump_limits()
+    gov = GOV_CTX.GOVERNOR
+    # a fake hot-cache occupancy via stats-only entries is intrusive;
+    # instead check the eviction API directly plus the bundle on entry
+    hc = get_hot_cache()
+    before = len(hub.postmortems)
+    snap = PC.snapshot()
+    _step(gov, 0.95, n=5)
+    assert gov.state == "RED"
+    assert len(hub.postmortems) == before + 1
+    assert hub.postmortems[-1]["reason"] == "governor_red"
+    assert PC.since(snap)["governor_transitions"] >= 1
+    assert hc.evict_to_bytes(0) == 0     # empty cache: no-op
+    # flight ring recorded the transition events
+    kinds = [e["ev"] for e in hub.flight.snapshot()]
+    assert "governor" in kinds
+
+
+def test_hot_cache_evict_to_bytes():
+    """The governor's RED ballast drop: LRU entries close until the
+    byte bound holds (counted as hot_cache_evictions)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    import tempfile
+
+    from spark_rapids_tpu.io.hot_cache import clear_hot_cache
+
+    clear_hot_cache()
+    with tempfile.TemporaryDirectory() as td:
+        paths = []
+        for i in range(2):
+            tbl = pa.table({"v": np.arange(2000, dtype=np.int64) + i})
+            p = os.path.join(td, f"t{i}.parquet")
+            pq.write_table(tbl, p)
+            paths.append(p)
+        conf = {"spark.rapids.sql.enabled": True,
+                "spark.rapids.tpu.scan.hotTableCache.enabled": True}
+        s = TpuSession(conf)
+        for p in paths:                  # two distinct cache entries
+            s.read.parquet(p).collect()
+        from spark_rapids_tpu.io.hot_cache import get_hot_cache
+
+        hc = get_hot_cache()
+        st = hc.stats()
+        assert st["entries"] == 2 and st["bytes"] > 0
+        snap = PC.snapshot()
+        evicted = hc.evict_to_bytes(st["bytes"] // 2)
+        assert evicted >= 1
+        assert hc.stats()["bytes"] <= st["bytes"] // 2
+        assert PC.since(snap)["hot_cache_evictions"] == evicted
+        clear_hot_cache()
+
+
+# ---------------------------------------------------------------------------
+# disabled path
+# ---------------------------------------------------------------------------
+
+def test_disabled_path_makes_zero_governor_calls():
+    """With ``spark.rapids.tpu.governor.enabled=false`` (the default) a
+    collect costs one ambient attribute check per site — ZERO calls
+    into ``governor/`` modules (the diagnostics/telemetry/progress
+    overhead contract, applied here)."""
+    shutdown_governor()
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    assert GOV_CTX.GOVERNOR is None
+    q = _agg(s)
+    q.collect()                 # warm compile caches outside the profile
+
+    prof = cProfile.Profile()
+    prof.enable()
+    q.collect()
+    prof.disable()
+    banned = os.path.join("spark_rapids_tpu", "governor")
+    offenders = [
+        (fname, func)
+        for (fname, _lineno, func) in pstats.Stats(prof).stats
+        if banned in fname]
+    assert not offenders, (
+        f"governor work on the disabled path: {offenders}")
+
+
+# ---------------------------------------------------------------------------
+# observability wiring
+# ---------------------------------------------------------------------------
+
+def test_sampler_gauges_and_diagnostics_event():
+    from spark_rapids_tpu import telemetry
+    from spark_rapids_tpu.telemetry.sampler import collect_gauges
+
+    telemetry.shutdown()
+    conf = dict(_GOV_CONF)
+    conf["spark.rapids.tpu.diagnostics.enabled"] = True
+    s = TpuSession(conf)
+    gov = GOV_CTX.GOVERNOR
+    _step(gov, 0.7, n=3)
+    g = collect_gauges()
+    assert g["governor_state"] == 1.0          # YELLOW
+    assert 0.0 < g["governor_pressure"] <= 1.0
+    # the governor diagnostics event fires inside a recorded query
+    from spark_rapids_tpu.diagnostics import query_scope
+
+    root, _meta = _agg(s, 32)._planned()
+    gov.set_signal_override(lambda: 0.1)
+    scope = query_scope(s.conf, root)
+    with scope:
+        gov.maybe_update()                     # YELLOW -> GREEN inside
+    events = [e for e in scope.diag.events if e["ev"] == "governor"]
+    assert events and events[-1]["state"] == "GREEN"
+    assert events[-1]["prev"] == "YELLOW"
+    assert events[-1]["action"] == "transition"
+
+
+def test_bench_gate_overload_columns():
+    """tools/bench_gate.py gates the --overload stress payload: shed
+    rate and recovery time regress past tolerance -> FAIL; hard
+    failures -> FAIL; within slack -> PASS."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, "tools"))
+    from bench_gate import gate
+
+    base = {"mode": "overload", "shed_rate": 0.10, "recovery_s": 0.5,
+            "failures": []}
+    ok = {"mode": "overload", "shed_rate": 0.12, "recovery_s": 0.6,
+          "failures": []}
+    assert gate(base, ok) == []
+    bad_shed = dict(ok, shed_rate=0.40)
+    assert any("shed rate" in r for r in gate(base, bad_shed))
+    bad_rec = dict(ok, recovery_s=5.0)
+    assert any("recovery time" in r for r in gate(base, bad_rec))
+    never_green = dict(ok, recovery_s=None)
+    assert any("never returned to GREEN" in r
+               for r in gate(base, never_green))
+    hard_fail = dict(ok, failures=["worker 3: unexpected RuntimeError"])
+    assert any("hard failure" in r for r in gate(base, hard_fail))
+    # type mismatch fails loudly, never passes vacuously
+    assert gate(base, {"value": 1.0}) != []
+
+
+def test_doc_drift_gate_covers_governor():
+    """check_counters/doc-drift knows the governor confs, counters,
+    gauges, and event (the pytest mirror of the tier-1 lint gate)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, "tools"))
+    import check_counters
+
+    assert check_counters.check() == []

@@ -30,6 +30,36 @@ def test_stress_sweep(timeout_ms):
     assert s["ok"] + s["cancelled"] == 24
 
 
+def test_overload_replay():
+    """``run_stress.py --overload`` engine (ISSUE 13), tier-1 size: a
+    mixed replay at 3x admission capacity with the overload governor
+    on, chaos faults + injected OOM armed, and the device pool shrunk
+    to 1/4 mid-run.  Every query must either complete correctly vs the
+    CPU oracle or be rejected with a STRUCTURED QueryRejected (the
+    engine fails unstructured ones); zero hard OOM failures, bounded
+    shed rate, empty leak report, and pressure back to GREEN within
+    the recovery window once the load drops.  The CLI runs the bigger
+    16-way soak."""
+    from run_stress import run_overload
+
+    s = run_overload(n_threads=6, rounds=2, limit=2, max_queue=6,
+                     seed=20260803, deadline_ms=1500, quiet=True)
+    assert not s["failures"], s["failures"]
+    assert not s["leaks"], s["leaks"]
+    assert s["queries"] == 12
+    assert s["ok"] >= s["queries"] // 2
+    assert s["shed_rate"] <= 0.5
+    assert s["pool_shrink"]["applied"]
+    # the shrink survived the per-collect framework rebuilds: the last
+    # live framework still carried the 1/4 pool
+    assert s["pool_shrink"]["pool_at_end"] == \
+        s["pool_shrink"]["pool_after"]
+    # the recovery pin: run_overload already fails the run when GREEN
+    # is not reached; assert the measured wall is bounded too
+    assert s["recovery_s"] is not None and s["recovery_s"] <= 10.0
+    assert s["governor"]["final_state"] == "GREEN"
+
+
 def test_hot_cache_trace_replay():
     """``run_stress.py --hot-cache`` engine (ISSUE 6): 8 workers replay
     the same parquet table concurrently — every warm replay must be a

@@ -18,7 +18,13 @@ non-zero when the new run regressed past the tolerance:
   ``--compile-tolerance`` (+0.5s slack) — compiles are cache-state
   dependent, so the gate is loose by design;
 * for ``--concurrency`` payloads: ``latency_ms.p95`` must not grow more
-  than ``--tolerance`` (+5ms slack).
+  than ``--tolerance`` (+5ms slack);
+* for ``run_stress.py --overload`` payloads (ISSUE 13): ``shed_rate``
+  must not grow more than ``--tolerance`` (+0.05 absolute slack),
+  ``recovery_s`` (time back to GREEN after the load drops) must not
+  grow more than ``--tolerance`` (+1s slack), and a new run with
+  failures — or one that stopped shedding/recovering entirely where
+  the baseline measured both — fails the gate.
 
 The payload's per-plan-signature ``slo`` section is informational, not
 gated: it includes warm-up/compile collects whose latency depends on
@@ -46,6 +52,8 @@ SCAN_TRANSFER_SLACK_S = 0.05
 COMPILE_SLACK_S = 0.5
 P95_SLACK_MS = 5.0
 RUNG3_OOC_SLACK_S = 2.0
+SHED_RATE_SLACK = 0.05
+RECOVERY_SLACK_S = 1.0
 # progressOverhead (ISSUE 12): absolute percentage-point slack — the
 # A/B times sub-second collects, so small relative drift is noise
 PROGRESS_OVERHEAD_SLACK_PP = 10.0
@@ -67,6 +75,44 @@ def gate(base: Dict, new: Dict, tolerance: float = DEFAULT_TOLERANCE,
          ) -> List[str]:
     """Regression messages (empty list = the new run passes)."""
     regressions: List[str] = []
+
+    # run_stress --overload payloads (ISSUE 13): the shed-rate and
+    # recovery-time gates.  Type mismatch fails loudly like the
+    # concurrency rule below.
+    base_ovl = base.get("mode") == "overload"
+    new_ovl = new.get("mode") == "overload"
+    if base_ovl != new_ovl:
+        return [f"payload type mismatch: baseline is "
+                f"{'overload' if base_ovl else 'non-overload'}, new run "
+                f"is {'overload' if new_ovl else 'non-overload'} — "
+                f"nothing comparable"]
+    if base_ovl:
+        if new.get("failures"):
+            regressions.append(
+                f"overload run has {len(new['failures'])} hard "
+                f"failure(s) — the zero-hard-failure pin broke: "
+                f"{new['failures'][0]}")
+        bs = float(base.get("shed_rate") or 0.0)
+        ns = float(new.get("shed_rate") or 0.0)
+        if ns > bs * (1.0 + tolerance) + SHED_RATE_SLACK:
+            regressions.append(
+                f"overload shed rate regressed: {bs:.3f} -> {ns:.3f} "
+                f"(tolerance {tolerance * 100:.0f}% + "
+                f"{SHED_RATE_SLACK:.2f})")
+        br = base.get("recovery_s")
+        nr = new.get("recovery_s")
+        if br is not None and nr is None:
+            regressions.append(
+                "overload recovery collapsed: the new run never "
+                f"returned to GREEN (baseline recovered in {br:.2f}s)")
+        elif br is not None and nr is not None \
+                and float(nr) > float(br) * (1.0 + tolerance) \
+                + RECOVERY_SLACK_S:
+            regressions.append(
+                f"overload recovery time regressed: {float(br):.2f}s "
+                f"-> {float(nr):.2f}s (tolerance "
+                f"{tolerance * 100:.0f}% + {RECOVERY_SLACK_S:.1f}s)")
+        return regressions
 
     # --concurrency payloads: the p95 gate.  Comparing a concurrency
     # payload against a single-stream one checks nothing — that must

@@ -4,6 +4,18 @@ over the representative join+agg+sort+expr query and print a summary.
 
     python tools/run_chaos.py [--seed 7] [--shape broadcast|shuffled|all]
     python tools/run_chaos.py --corrupt-inputs [--seed 7]
+    python tools/run_chaos.py --pressure [--seed 7]
+
+``--pressure`` (ISSUE 13) sweeps sustained OVERLOAD instead of
+operator faults: the ``tools/run_stress.py --overload`` engine (a
+mixed-tenant replay at 4x admission capacity with the overload
+governor on and the device pool shrunk to 1/4 mid-run) runs WITH the
+chaos fault matrix armed — transient faults, injected RetryOOM, and
+injected SplitAndRetryOOM land on queries already degrading under
+pressure.  The pin: zero hard OOM / unexplained failures (every query
+completes correctly vs oracle or sheds with a structured
+QueryRejected), bounded shed rate, and pressure back to GREEN within
+the recovery window once the load drops.
 
 ``--corrupt-inputs`` (ISSUE 5) sweeps REAL on-disk input damage instead
 of injected operator faults: for each mutation (truncate / bit-flip /
@@ -178,6 +190,29 @@ def run_corrupt_inputs(seed: int) -> bool:
     return ok
 
 
+def run_pressure(seed: int) -> bool:
+    """The --pressure sweep: chaos faults x sustained overload (the
+    run_stress --overload engine with its chaos arm ON)."""
+    import json
+
+    from run_stress import run_overload
+
+    print("\n== pressure sweep (overload governor, 4x capacity, "
+          "pool shrunk to 1/4 mid-run, chaos armed) ==")
+    s = run_overload(n_threads=16, rounds=3, seed=seed, chaos=True,
+                     quiet=True)
+    print(json.dumps({k: s[k] for k in (
+        "queries", "ok", "shed", "shed_rate", "deadline_trips",
+        "recovery_s", "governor", "pool_shrink")}, indent=2))
+    for f in s["failures"]:
+        print(f"FAILURE: {f}")
+    for leak in s["leaks"]:
+        print(f"LEAK: {leak.splitlines()[0]}")
+    ok = not s["failures"] and not s["leaks"]
+    print("pressure sweep:", "OK" if ok else "FAILED")
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seed", type=int, default=7)
@@ -186,8 +221,13 @@ def main():
     ap.add_argument("--corrupt-inputs", action="store_true",
                     help="sweep real on-disk input damage against the "
                          "ignoreCorruptFiles/ignoreMissingFiles matrix")
+    ap.add_argument("--pressure", action="store_true",
+                    help="sweep sustained overload (governor on, 4x "
+                         "capacity, pool shrink) with chaos faults armed")
     args = ap.parse_args()
 
+    if args.pressure:
+        return 0 if run_pressure(args.seed) else 1
     if args.corrupt_inputs:
         return 0 if run_corrupt_inputs(args.seed) else 1
 

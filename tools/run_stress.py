@@ -274,6 +274,280 @@ def run_stress(n_threads: int = 8, rounds: int = 3, seed: int = 7,
     return summary
 
 
+def run_overload(n_threads: int = 16, rounds: int = 3, limit: int = 4,
+                 max_queue: int = 12, seed: int = 7,
+                 deadline_ms: int = 1500, shrink_pool: bool = True,
+                 chaos: bool = True, quiet: bool = False,
+                 telemetry_out: str = "",
+                 recovery_timeout_s: float = 10.0) -> dict:
+    """``--overload`` mode (ISSUE 13): a mixed-tenant replay at
+    ``n_threads / limit``x admission capacity (default 4x) with the
+    overload governor ON, chaos faults + injected OOM armed, a tight
+    deadline on a third of the tenants, and the device pool SHRUNK to
+    1/4 mid-run.  The acceptance pin:
+
+    * every query either completes CORRECTLY vs the CPU oracle or is
+      rejected/shed with a *structured* QueryRejected (queue_depth /
+      retry_after_ms / pressure_state populated) — zero hard OOM or
+      unexplained failures, zero leaks;
+    * the shed+rejection rate stays bounded (the governor degrades,
+      it does not collapse);
+    * after the load drops, pressure returns to GREEN within
+      ``recovery_timeout_s`` (the recovery wall is recorded and gated
+      by tools/bench_gate.py across rounds).
+    """
+    import random
+
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu.governor import (
+        context as GOV_CTX,
+        shutdown_governor,
+    )
+    from spark_rapids_tpu.lifecycle import (
+        QueryCancelled,
+        QueryDeadlineExceeded,
+        QueryRejected,
+        leak_report_all,
+        reset_admission,
+    )
+    from spark_rapids_tpu.resilience import (
+        clear_faults,
+        inject_fault,
+        reset_breaker,
+    )
+    from spark_rapids_tpu.session import TpuSession
+
+    rng = random.Random(seed)
+    shapes = _shapes()
+    oracle = {}
+    for i, q in enumerate(shapes):
+        so = TpuSession({"spark.rapids.sql.enabled": False})
+        oracle[i] = sorted(q(so).collect())
+
+    clear_faults()
+    reset_breaker()
+    shutdown_governor()
+    reset_admission()
+    if chaos:
+        inject_fault("TpuHashAggregateExec", "transient",
+                     count=n_threads // 2)
+        inject_fault("TpuSortExec", "transient", count=2)
+
+    base_conf = {
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.tpu.governor.enabled": True,
+        "spark.rapids.tpu.governor.updatePeriodMs": "10",
+        "spark.rapids.tpu.concurrentQueries": str(limit),
+        "spark.rapids.tpu.admission.maxQueueDepth": str(max_queue),
+        "spark.rapids.tpu.resilience.backoffBaseMs": "0",
+        "spark.rapids.sql.concurrentGpuTasks": "2",
+        "spark.rapids.tpu.telemetry.samplePeriodMs": "50",
+    }
+    from spark_rapids_tpu import telemetry
+
+    telemetry.shutdown()
+
+    outcomes, failures, shed_hints = [], [], []
+    lock = threading.Lock()
+
+    def worker(wid: int):
+        conf = dict(base_conf)
+        if wid % 3 == 0:
+            conf["spark.rapids.sql.test.injectRetryOOM"] = "RETRY:1"
+        elif wid % 3 == 1 and chaos:
+            conf["spark.rapids.sql.test.injectRetryOOM"] = "SPLIT:1"
+        if wid % 3 == 2:
+            # the deadline-carrying tenants: the governor's RED shed
+            # path protects exactly these from queue-wait cascades
+            conf["spark.rapids.tpu.query.timeoutMs"] = str(deadline_ms)
+        s = TpuSession(conf)
+        for r in range(rounds):
+            qi = (wid + r) % len(shapes)
+            try:
+                rows = sorted(shapes[qi](s).collect())
+                with lock:
+                    if rows != oracle[qi]:
+                        failures.append(
+                            f"worker {wid} round {r} shape {qi}: "
+                            f"result diverged from oracle")
+                    else:
+                        outcomes.append("ok")
+            except QueryRejected as e:
+                with lock:
+                    # structured-rejection contract (ISSUE 13
+                    # satellite): every rejection carries backoff
+                    # fields a client can act on
+                    if not isinstance(e.queue_depth, int) \
+                            or not isinstance(e.pressure_state, str) \
+                            or not e.pressure_state:
+                        failures.append(
+                            f"worker {wid} round {r}: UNSTRUCTURED "
+                            f"QueryRejected (queue_depth="
+                            f"{e.queue_depth!r}, retry_after_ms="
+                            f"{e.retry_after_ms!r}, pressure_state="
+                            f"{e.pressure_state!r})")
+                    else:
+                        outcomes.append("shed")
+                        if e.retry_after_ms is not None:
+                            shed_hints.append(int(e.retry_after_ms))
+                # honor the backoff hint (bounded) — the replay models
+                # a well-behaved client
+                time.sleep(min((e.retry_after_ms or 0) / 1000.0, 0.25))
+            except QueryDeadlineExceeded:
+                with lock:
+                    outcomes.append("deadline")
+            except QueryCancelled:
+                with lock:
+                    outcomes.append("cancelled")
+            except Exception as e:   # noqa: BLE001 — report, don't die
+                with lock:
+                    failures.append(
+                        f"worker {wid} round {r} shape {qi}: unexpected "
+                        f"{type(e).__name__}: {e}")
+
+    # mid-run chaos: shrink the device pool to 1/4 once the replay is
+    # in full flight — residency discipline must hold at the new bound.
+    # The spill framework (and the device manager it reads its pool
+    # from) are REBUILT by every collect that passes a conf, so
+    # mutating the live framework alone would be clobbered within
+    # milliseconds; the env-level deviceMemoryBytes override is the
+    # one shrink every rebuild re-reads.
+    _POOL_ENV = "SRT_SPARK_RAPIDS_TPU_TEST_DEVICEMEMORYBYTES"
+    shrink = {"applied": False, "pool_before": 0, "pool_after": 0}
+
+    def pool_shrinker():
+        time.sleep(0.4)
+        from spark_rapids_tpu.memory.spill import peek_spill_framework
+
+        fw = peek_spill_framework()
+        if fw is not None and shrink_pool:
+            shrink["pool_before"] = fw.pool_bytes
+            new_pool = max(fw.pool_bytes // 4, 1 << 20)
+            os.environ[_POOL_ENV] = str(new_pool)
+            fw.pool_bytes = new_pool      # immediate effect, too
+            shrink["pool_after"] = new_pool
+            shrink["applied"] = True
+
+    snap = PC.snapshot()
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    ts = threading.Thread(target=pool_shrinker)
+    try:
+        for t in threads:
+            t.start()
+        ts.start()
+        for t in threads:
+            t.join(300)
+        ts.join(10)
+        # evidence the shrink SURVIVED the per-collect framework
+        # rebuilds: whatever framework is live after the replay must
+        # still carry the shrunken pool (pinned by the tier-1 twin)
+        from spark_rapids_tpu.memory.spill import peek_spill_framework
+
+        fw_end = peek_spill_framework()
+        shrink["pool_at_end"] = fw_end.pool_bytes if fw_end else 0
+    finally:
+        # the shrink must not outlive the run (later tests/sessions
+        # would silently inherit a 1/4-size pool): drop the override
+        # and the shrunken singletons it shaped
+        if os.environ.pop(_POOL_ENV, None) is not None:
+            from spark_rapids_tpu.memory.device_manager import (
+                reset_device_manager,
+            )
+
+            reset_device_manager()
+    wall_s = time.monotonic() - t0
+
+    # recovery pin: with the load gone, pressure must return to GREEN
+    gov = GOV_CTX.GOVERNOR
+    recovery_s = None
+    if gov is not None:
+        r0 = time.monotonic()
+        while time.monotonic() - r0 < recovery_timeout_s:
+            if gov.maybe_update() == "GREEN":
+                recovery_s = round(time.monotonic() - r0, 3)
+                break
+            time.sleep(0.05)
+        if recovery_s is None:
+            failures.append(
+                f"governor did not return to GREEN within "
+                f"{recovery_timeout_s}s after load dropped "
+                f"(state={gov.state}, pressure={gov.pressure:.3f})")
+    else:
+        failures.append("governor was never installed")
+
+    clear_faults()
+    reset_breaker()
+    # drain the background AOT pool before the process can exit: the
+    # governor DEFERS speculative compiles under pressure, so the last
+    # GREEN collects bunch their submissions right at the end of the
+    # replay — daemon compile workers dying mid-XLA at interpreter
+    # teardown abort the whole process (exit 134/139)
+    from spark_rapids_tpu.compilecache.aot import quiesce_aot
+
+    quiesced = quiesce_aot(60.0)
+    leaks = leak_report_all()
+    d = PC.since(snap)
+    final_state = gov.state if gov is not None else "?"
+    shutdown_governor()
+    reset_admission()
+
+    total = len(outcomes) + 0
+    shed = outcomes.count("shed")
+    shed_rate = round(shed / total, 3) if total else 1.0
+    # bounded-shed pin: controlled degradation, not collapse — at least
+    # half the replay must complete, and at 4x capacity the shed share
+    # must stay a minority
+    if total and shed_rate > 0.5:
+        failures.append(f"shed rate {shed_rate} exceeds the 0.5 bound "
+                        f"({shed}/{total})")
+    if outcomes.count("ok") < total // 2:
+        failures.append(
+            f"only {outcomes.count('ok')}/{total} queries completed — "
+            f"degradation collapsed into unavailability")
+
+    summary = {
+        "mode": "overload",
+        "threads": n_threads, "rounds": rounds, "limit": limit,
+        "max_queue": max_queue,
+        "queries": total,
+        "ok": outcomes.count("ok"),
+        "shed": shed,
+        "shed_rate": shed_rate,
+        "deadline_trips": outcomes.count("deadline"),
+        "cancelled": outcomes.count("cancelled"),
+        "recovery_s": recovery_s,
+        "aot_quiesced": quiesced,
+        "pool_shrink": shrink,
+        "failures": failures,
+        "leaks": leaks,
+        "wall_s": round(wall_s, 2),
+        "governor": {
+            "final_state": final_state,
+            "transitions": d["governor_transitions"],
+            "preempt_pauses": d["preempt_pauses"],
+            "degraded_batches": d["degraded_batches"],
+            "oom_retry_preempts": d["oom_retry_preempts"],
+            "oom_retry_splits": d["oom_retry_splits"],
+        },
+        "shed_retry_after_ms": {
+            "min": min(shed_hints, default=0),
+            "max": max(shed_hints, default=0),
+        },
+        "counters": {k: d[k] for k in (
+            "queries_admitted", "queries_rejected", "queries_shed",
+            "queries_cancelled", "deadline_trips", "transient_retries",
+            "oom_restarts", "runtime_fallbacks")},
+        "telemetry": _dump_telemetry(telemetry_out),
+    }
+    if not quiet:
+        import json
+
+        print(json.dumps(summary, indent=2))
+    return summary
+
+
 def run_hot_cache(n_threads: int = 8, rounds: int = 3,
                   rows: int = 60_000, quiet: bool = False,
                   telemetry_out: str = "") -> dict:
@@ -391,7 +665,9 @@ def run_hot_cache(n_threads: int = 8, rounds: int = 3,
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--threads", type=int, default=None,
+                    help="worker threads (default 8; 16 for --overload "
+                         "so the replay runs at 4x admission capacity)")
     ap.add_argument("--rounds", type=int, default=3)
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--cancels", type=int, default=4)
@@ -399,20 +675,45 @@ def main() -> int:
     ap.add_argument("--hot-cache", action="store_true",
                     help="repeated-query hot-table-cache trace instead "
                          "of the mixed chaos sweep")
+    ap.add_argument("--overload", action="store_true",
+                    help="ISSUE 13: 4x-capacity mixed replay with the "
+                         "overload governor on, chaos faults armed, and "
+                         "the device pool shrunk to 1/4 mid-run — pins "
+                         "zero hard failures, bounded shed rate, and "
+                         "bounded recovery to GREEN")
+    ap.add_argument("--limit", type=int, default=4,
+                    help="admission capacity for --overload (threads/"
+                         "limit = the overcommit factor)")
+    ap.add_argument("--deadline-ms", type=int, default=1500,
+                    help="deadline carried by every third tenant in "
+                         "--overload (the shed candidates)")
     ap.add_argument("--telemetry-out", default="STRESS_TELEMETRY.json",
                     help="write the telemetry timeline (queue depth, "
                          "HBM occupancy, rolling p95 per sampler tick) "
                          "+ SLO summary to this JSON file; '' disables")
     args = ap.parse_args()
+    n_threads = args.threads or (16 if args.overload else 8)
+    if args.overload:
+        s = run_overload(n_threads,
+                         args.rounds, limit=args.limit, seed=args.seed,
+                         deadline_ms=args.deadline_ms,
+                         telemetry_out=args.telemetry_out)
+        ok = not s["failures"] and not s["leaks"]
+        print(("PASS" if ok else "FAIL")
+              + f": {s['ok']} ok / {s['shed']} shed / "
+              f"{s['deadline_trips']} deadline of {s['queries']} at "
+              f"{s['threads']}/{s['limit']}x capacity; recovery "
+              f"{s['recovery_s']}s")
+        return 0 if ok else 1
     if args.hot_cache:
-        s = run_hot_cache(args.threads, args.rounds,
+        s = run_hot_cache(n_threads, args.rounds,
                           telemetry_out=args.telemetry_out)
         ok = not s["failures"] and not s["leaks"]
         print(("PASS" if ok else "FAIL")
               + f": {s['hot_cache_hits']} cached replays, "
               f"{s['bytes_h2d']} H2D bytes in {s['wall_s']}s")
         return 0 if ok else 1
-    s = run_stress(args.threads, args.rounds, args.seed, args.cancels,
+    s = run_stress(n_threads, args.rounds, args.seed, args.cancels,
                    args.timeout_ms, telemetry_out=args.telemetry_out)
     ok = not s["failures"] and not s["leaks"]
     print(("PASS" if ok else "FAIL")
