@@ -816,7 +816,7 @@ def main():
         emitted["rc"] = run_gate(payload)
 
     _ALL = ["qa_join_agg", "qb_left_join", "qc_window", "rung3",
-            "rung3_ooc", "q6_parquet"]
+            "rung3_ooc", "rung4_dist", "q6_parquet"]
 
     def mark_skipped(names):
         # only queries that did NOT finish (ISSUE 10 satellite): a
@@ -1208,6 +1208,140 @@ def main():
             return emitted["rc"]
         except Exception as ex:   # additive: never lose rung 1-3
             progress(f"rung3_ooc failed: {ex!r}")
+    # ---- rung4_dist (ISSUE 14): the 2-process distributed join rung —
+    # the same hash-join + aggregation shape routed over worker
+    # PROCESSES at ~100x a shrunken per-worker block store, with one
+    # SIGKILL injected mid-shuffle (BENCH_DIST_KILL=0 disables).  The
+    # deliverables are the wall, partitionsReplayed / workerLost, and a
+    # loud wrong-answer/unrecovered-loss failure for bench_gate. -----------
+    def run_rung4_dist():
+        import numpy as np
+
+        from spark_rapids_tpu import distributed as DIST
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.config import TpuConf
+        from spark_rapids_tpu.distributed import client as DIST_CLIENT
+        from spark_rapids_tpu.session import TpuSession, sum_
+
+        n_fact = int(os.environ.get("BENCH_DIST_ROWS", 200_000))
+        # default store budget targets ~100x: serialized (compressed)
+        # block traffic is ~2.5B/row/worker, so ~5B/row/100 per store
+        worker_mem = int(os.environ.get("BENCH_DIST_WORKER_MEM",
+                                        max((n_fact * 5) // 100, 4096)))
+        kill_armed = os.environ.get("BENCH_DIST_KILL", "1") != "0"
+        n_dim = 2000
+        rng = np.random.default_rng(29)
+        fk = rng.integers(0, n_dim, n_fact).astype(np.int32)
+        fv = rng.integers(-1000, 1000, n_fact)
+        dk = np.arange(n_dim, dtype=np.int32)
+        dg = (dk % 31).astype(np.int32)
+        data_bytes = float(fk.nbytes + fv.nbytes)
+
+        conf = {
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.tpu.distributed.enabled": True,
+            "spark.sql.autoBroadcastJoinThreshold": "-1",
+            "spark.sql.adaptive.enabled": False,
+            "spark.rapids.sql.batchSizeBytes": 256 << 10,
+            "spark.rapids.sql.reader.batchSizeRows":
+                max(n_fact // 16, 1),
+            "spark.rapids.tpu.distributed.heartbeatMs": 100,
+            "spark.rapids.tpu.distributed.workerLostMs": 600,
+            "spark.rapids.tpu.distributed.opTimeoutMs": 1000,
+            **_diag_conf(), **_profile_conf(),
+        }
+
+        def build(sess):
+            fact = _df(sess, {"k": fk, "v": fv}, [T.INT, T.LONG])
+            dim = _df(sess, {"k": dk, "g": dg}, [T.INT, T.INT])
+            return (fact.join(dim, on="k", how="inner")
+                    .group_by("g").agg(sum_("v", "sv")))
+
+        def cpu_dist():
+            sums = np.bincount(dg[fk], weights=fv.astype(np.float64),
+                               minlength=31)
+            return {int(i): int(sums[i]) for i in range(31) if sums[i]}
+
+        DIST.reset_coordinator()
+        coord = DIST.get_coordinator(TpuConf(conf))
+        procs = {w: DIST.spawn_local_worker(coord, w,
+                                            mem_bytes=worker_mem)
+                 for w in ("bench0", "bench1")}
+        try:
+            if not coord.wait_for_workers(2, timeout_s=60):
+                raise RuntimeError("rung4_dist: workers failed to join")
+            t_vec, want = _time_repeats(cpu_dist, repeats)
+            s = TpuSession(conf)
+            df_dist = build(s)
+            state = {"n": 0}
+
+            def hook(exch, pid, seq):
+                state["n"] += 1
+                if kill_armed and state["n"] == 5 \
+                        and procs["bench0"].poll() is None:
+                    procs["bench0"].kill()
+
+            from spark_rapids_tpu import perfcounters as PC
+
+            # warm separately from the kill: the fault must land inside
+            # the TIMED run so the recorded wall includes recovery
+            snap = PC.snapshot()
+            DIST_CLIENT.TEST_SHIP_HOOK = hook
+            try:
+                t0 = time.perf_counter()
+                rows = df_dist.collect()
+                t_tpu = time.perf_counter() - t0
+            finally:
+                DIST_CLIENT.TEST_SHIP_HOOK = None
+            d = PC.since(snap)
+            got = {int(r[0]): int(r[1]) for r in rows if r[1]}
+            assert got == want, "rung4_dist WRONG ANSWER vs CPU"
+            if kill_armed and not d["partitions_replayed"]:
+                raise AssertionError(
+                    "rung4_dist: kill armed but no partition was "
+                    "re-driven — the loss went unrecovered or the rung "
+                    "stopped exercising the distributed path")
+            queries["rung4_dist"] = dict(
+                tpu_s=t_tpu, cpu_vec_s=t_vec, cpu_oracle_s=0.0,
+                rows_per_s=n_fact / t_tpu,
+                eff_gbps=data_bytes / t_tpu / 1e9,
+                vs_vec=t_vec / t_tpu, vs_oracle=0.0,
+                eventLog=_event_log_of(df_dist),
+                dataBytes=data_bytes, workerMemBytes=float(worker_mem),
+                distRatio=d["dist_block_bytes"] / max(worker_mem, 1),
+                killArmed=bool(kill_armed),
+                workerLost=float(d["worker_lost"]),
+                partitionsReplayed=float(d["partitions_replayed"]),
+                distBlocksShipped=float(d["dist_blocks_shipped"]),
+                distBlockBytes=float(d["dist_block_bytes"]),
+                workersJoined=float(d["workers_joined"]))
+            stream()
+            progress(
+                f"rung4_dist: tpu {t_tpu:.2f}s over "
+                f"{data_bytes / 1e6:.0f}MB vs {worker_mem >> 10}KiB/"
+                f"worker stores "
+                f"(kill={'armed' if kill_armed else 'off'}, "
+                f"lost={d['worker_lost']:.0f}, "
+                f"replayed={d['partitions_replayed']:.0f})")
+        finally:
+            for p in procs.values():
+                try:
+                    p.kill()
+                    p.wait(timeout=10)
+                except Exception:
+                    pass
+            DIST.reset_coordinator()
+
+    if os.environ.get("BENCH_RUNG4_DIST", "1") != "0" \
+            and not over_budget():
+        try:
+            run_rung4_dist()
+        except TimeoutError:
+            abort("rung4_dist")
+            return emitted["rc"]
+        except Exception as ex:   # additive: never lose rungs 1-3
+            progress(f"rung4_dist failed: {ex!r}")
+
     # ---- q6 over real snappy parquet files through the device decode path
     # (VERDICT r4 Next #5: two rounds of decode work had no recorded perf
     # number).  Scan-inclusive by construction: every run re-reads, decodes

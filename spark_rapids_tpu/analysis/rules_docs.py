@@ -373,6 +373,53 @@ def doc_drift_problems(repo_root: str) -> List[str]:
                 f"governor surface vocabulary {word} is not "
                 f"documented in docs/overload.md")
 
+    # distributed cross-host tier (ISSUE 14): confs + counters + the
+    # sampler gauges + the distributed event + the chaos/bench surface
+    # vocabulary must be documented in docs/distributed.md (confs in
+    # configs.md, counters ALSO in diagnostics.md via the global check)
+    dist_md = read("distributed.md")
+    dist_confs = [k for k in _REGISTRY
+                  if k.startswith("spark.rapids.tpu.distributed.")]
+    if not dist_confs:
+        problems.append("no spark.rapids.tpu.distributed.* confs "
+                        "registered")
+    for key in sorted(dist_confs):
+        if f"`{key}`" not in dist_md:
+            problems.append(
+                f"conf '{key}' is not documented in "
+                f"docs/distributed.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+    for key in ("workers_joined", "worker_lost",
+                "worker_heartbeat_misses", "partitions_replayed",
+                "dist_blocks_shipped", "dist_block_bytes"):
+        if key not in PC.COUNTERS:
+            problems.append(f"distributed counter '{key}' is not "
+                            f"registered in perfcounters.COUNTERS")
+        if f"`{key}`" not in dist_md:
+            problems.append(
+                f"distributed counter '{key}' is not documented in "
+                f"docs/distributed.md")
+    if "distributed" not in EVENT_SCHEMA:
+        problems.append("diagnostics event type 'distributed' is not "
+                        "registered in EVENT_SCHEMA")
+    for gauge in ("dist_workers_live", "dist_workers_quarantined",
+                  "dist_replacement_backlog"):
+        if f"`{gauge}`" not in dist_md:
+            problems.append(
+                f"distributed sampler gauge '{gauge}' is not "
+                f"documented in docs/distributed.md")
+    for word in ("`--worker-kill`", "`WorkerLost`", "QUARANTINED",
+                 "`worker_lost`", "`partition_replayed`", "rung4_dist",
+                 "`TKD1`", "`TKU2`", "`ProtocolCorruption`",
+                 "run_chaos.py", "bench_gate", "lineage"):
+        if word not in dist_md:
+            problems.append(
+                f"distributed surface vocabulary {word} is not "
+                f"documented in docs/distributed.md")
+
     # tracelint (ISSUE 11): every lint rule id and the fusibility
     # manifest vocabulary must be documented in docs/static_analysis.md
     from spark_rapids_tpu.analysis.core import all_rule_ids

@@ -304,6 +304,70 @@ GOVERNOR_BACKLOG_TARGET_MS = conf(
     "disables the predicted-wall component (the memory/queue/latency "
     "signals still drive the state machine).").long_conf(0)
 
+# --- distributed cross-host execution tier (ISSUE 14) ----------------------
+
+DISTRIBUTED_ENABLED = conf("spark.rapids.tpu.distributed.enabled").doc(
+    "Route multi-partition exchanges through the cross-host worker "
+    "tier (distributed/): a coordinator places reduce partitions over "
+    "worker processes, blocks ship as CRC-framed TKU2 wire blocks, and "
+    "the producer-side spill-backed partition queues retain every "
+    "shipped block until the consuming stage commits — a worker lost "
+    "mid-shuffle (missed heartbeats or dead socket) is recovered by "
+    "re-placing its partitions on survivors and re-driving the "
+    "retained blocks.  Requires a coordinator with live workers; with "
+    "none joined, exchanges fall back to the in-process spill-backed "
+    "path.").boolean_conf(False)
+
+DISTRIBUTED_HEARTBEAT_MS = conf(
+    "spark.rapids.tpu.distributed.heartbeatMs").doc(
+    "Worker heartbeat period.  The coordinator's liveness monitor "
+    "scans at the same period and counts a worker late "
+    "(worker_heartbeat_misses) past two periods of silence."
+).long_conf(200)
+
+DISTRIBUTED_WORKER_LOST_MS = conf(
+    "spark.rapids.tpu.distributed.workerLostMs").doc(
+    "Heartbeat silence after which a worker is declared LOST: its "
+    "partitions re-place onto survivors, the re-drive plan is queued, "
+    "a per-worker circuit-breaker entry opens (flapping workers are "
+    "quarantined on rejoin until the breaker TTL re-probe), and a "
+    "flight-recorder post-mortem bundle captures the placement table "
+    "and re-drive plan.").long_conf(1200)
+
+DISTRIBUTED_OP_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.distributed.opTimeoutMs").doc(
+    "Socket timeout for one data-plane operation (put / fetch / "
+    "release) against a worker.  A timed-out op classifies TRANSIENT "
+    "and retries up to putRetries times before the worker is declared "
+    "lost.").long_conf(4000)
+
+DISTRIBUTED_PUT_RETRIES = conf(
+    "spark.rapids.tpu.distributed.putRetries").doc(
+    "Bounded transient retries (reconnect + resend) per data-plane "
+    "operation before the target worker is declared lost and the "
+    "block layer switches to re-placement + re-drive."
+).long_conf(2)
+
+DISTRIBUTED_REDRIVE_MAX = conf(
+    "spark.rapids.tpu.distributed.redriveMaxAttempts").doc(
+    "How many times one reduce partition may be re-placed + re-driven "
+    "(repeated worker losses) before WorkerLost escapes to the "
+    "operator fault domain — which falls back to the CPU oracle "
+    "without indicting the operator's breaker key.").long_conf(4)
+
+DISTRIBUTED_WORKER_MEM = conf(
+    "spark.rapids.tpu.distributed.workerMemoryBytes").doc(
+    "Default per-worker block-store memory budget handed to spawned "
+    "workers; blocks past it overflow to the worker's spill "
+    "directory (the netty shuffle-file analog).").bytes_conf(64 << 20)
+
+DISTRIBUTED_LOSS_BREAKER_THRESHOLD = conf(
+    "spark.rapids.tpu.distributed.lossBreakerThreshold").doc(
+    "Loss declarations that OPEN a worker's circuit-breaker entry.  "
+    "The default (1) quarantines a killed-and-rejoined worker "
+    "immediately: it heartbeats but receives no placements until the "
+    "resilience breaker TTL admits a re-probe.").long_conf(1)
+
 # --- resilience (stage-level fault domains) --------------------------------
 
 RESILIENCE_ENABLED = conf("spark.rapids.tpu.resilience.enabled").doc(

@@ -52,6 +52,8 @@ EVENT_SCHEMA: Dict[str, List[str]] = {
     "scan_prefetch": ["depth", "batches", "overlapped_bytes", "stall_ns"],
     "ici_shuffle": ["stage", "n_dev", "rows", "bytes", "dur_ns"],
     "governor": ["action", "state", "prev", "pressure", "detail"],
+    "distributed": ["kind", "worker_id", "detail", "n_workers",
+                    "n_partitions"],
     "query_stall": ["query_id", "path", "name", "stalled_ms", "detail"],
     "progress": ["query_id", "pct", "eta_ns", "stalls", "background"],
     "op_batch": ["path", "batch", "rows", "dur_ns"],
@@ -372,6 +374,20 @@ class QueryDiagnostics:
         self._event(ESSENTIAL, "governor", action=action, state=state,
                     prev=prev, pressure=float(pressure),
                     detail=str(detail)[:500])
+
+    def distributed(self, kind: str, worker_id: str, detail: str,
+                    n_workers: int, n_partitions: int) -> None:
+        """A cross-host tier event (ISSUE 14): ``worker_joined`` /
+        ``worker_quarantined`` / ``worker_probed`` / ``worker_left`` /
+        ``worker_lost`` (membership + liveness, with the live-worker
+        and placed-partition counts at the time) or
+        ``partition_replayed`` (one reduce partition re-driven from
+        the producer-side spilled queues after a loss)."""
+        self._event(ESSENTIAL, "distributed", kind=kind,
+                    worker_id=str(worker_id),
+                    detail=str(detail)[:500],
+                    n_workers=int(n_workers),
+                    n_partitions=int(n_partitions))
 
     def query_stall(self, query_id: str, path: str, name: str,
                     stalled_ms: float, detail: str = "") -> None:

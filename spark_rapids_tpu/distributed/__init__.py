@@ -1,0 +1,116 @@
+"""Cross-host fault-tolerant execution tier (ISSUE 14).
+
+The first true multi-process tier of the engine: worker processes own
+durable exchange partitions, a lightweight in-driver coordinator places
+them (``exec/partition_sizing.py`` estimates feed the weighting), blocks
+cross hosts as PR 4 CRC-framed ``TKU2`` blocks over the ``TKD1`` control
+protocol, and the spill-backed partition queues from
+``shuffle/partition_queues.py`` double as the producer-side LINEAGE
+buffer — every shipped block is retained until the consuming stage
+commits its partition, so a SIGKILLed worker is recovered by re-placing
+its partitions on survivors and re-driving the retained blocks.
+
+Modules:
+
+  protocol.py    — TKD1 control framing + the WorkerLost taxonomy
+  worker.py      — the worker process (store, heartbeats, data server)
+  coordinator.py — membership / liveness / placement / re-drive plan
+  client.py      — DistributedExchange (produce, consume, lineage retry)
+
+Robustness state machine (docs/distributed.md has the full picture):
+
+    JOINED --heartbeats--> ALIVE --workerLostMs silence / dead socket-->
+    LOST --(rejoin, breaker OPEN)--> QUARANTINED --TTL re-probe--> ALIVE
+
+The singleton accessors below mirror the shuffle-manager pattern:
+cleanup paths only ever *peek* (a leak sweep must never build a
+coordinator), and ``reset_coordinator`` tears the listener down for
+test isolation.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+from typing import List, Optional
+
+from spark_rapids_tpu.distributed.coordinator import Coordinator
+from spark_rapids_tpu.distributed.protocol import (  # noqa: F401
+    ProtocolCorruption,
+    WorkerLost,
+)
+
+_lock = threading.Lock()
+_coordinator: Optional[Coordinator] = None
+
+
+def get_coordinator(conf=None) -> Coordinator:
+    """The process coordinator, built on first use (the harness/test or
+    the first distributed exchange)."""
+    global _coordinator
+    with _lock:
+        if _coordinator is None:
+            _coordinator = Coordinator(conf)
+        return _coordinator
+
+
+def peek_coordinator() -> Optional[Coordinator]:
+    """The singleton if it exists — cleanup/leak paths must never
+    CREATE one."""
+    return _coordinator
+
+
+def reset_coordinator() -> None:
+    global _coordinator
+    with _lock:
+        c, _coordinator = _coordinator, None
+    if c is not None:
+        c.shutdown()
+
+
+def spawn_local_worker(coordinator: Coordinator, worker_id: str,
+                       mem_bytes: int = 64 << 20,
+                       heartbeat_ms: Optional[int] = None,
+                       spill_dir: Optional[str] = None,
+                       warm_compile_dir: Optional[str] = None,
+                       op_timeout_ms: Optional[int] = None,
+                       extra_env: Optional[dict] = None
+                       ) -> subprocess.Popen:
+    """Launch one worker PROCESS against the given coordinator (tests,
+    the chaos sweep, and bench all spawn through here).  The child runs
+    on the CPU backend regardless of the parent's platform — workers
+    hold serialized blocks, not device state."""
+    hb = heartbeat_ms if heartbeat_ms is not None \
+        else int(coordinator.heartbeat_s * 1000)
+    ot = op_timeout_ms if op_timeout_ms is not None \
+        else int(coordinator.op_timeout_s * 1000)
+    cmd = [sys.executable, "-m", "spark_rapids_tpu.distributed.worker",
+           "--coordinator", f"127.0.0.1:{coordinator.port}",
+           "--worker-id", worker_id,
+           "--mem-bytes", str(int(mem_bytes)),
+           "--heartbeat-ms", str(hb),
+           "--op-timeout-ms", str(ot)]
+    if spill_dir:
+        cmd += ["--spill-dir", spill_dir]
+    if warm_compile_dir:
+        cmd += ["--warm-compile-dir", warm_compile_dir]
+    env = dict(os.environ)
+    # unconditional: workers hold serialized blocks, not device state,
+    # and on a real TPU host an inherited JAX_PLATFORMS=tpu would have
+    # N worker processes contending with the driver for the one-client
+    # TPU runtime (extra_env can still override for tests)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra_env or {})
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(cmd, env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+def leak_report() -> List[str]:
+    """Remote-partition leak lines (lifecycle.leak_report_all hook)."""
+    c = peek_coordinator()
+    return c.leak_report() if c is not None else []

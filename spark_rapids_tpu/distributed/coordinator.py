@@ -1,0 +1,801 @@
+"""Coordinator — membership, heartbeat liveness, placement, loss
+recovery bookkeeping for the cross-host tier.
+
+Reference analog: the driver-side shuffle coordination the reference
+delegates to Spark's MapOutputTracker + the RapidsShuffleHeartbeat
+endpoint (SURVEY.md §2.7); Theseus (arXiv:2508.05029) centralizes
+exactly this: a lightweight control plane that PLACES data movement and
+survives executor churn.  The coordinator owns:
+
+  * **membership** — workers join (HELLO over the control listener) and
+    leave (GOODBYE / dead socket) between queries; every join warms from
+    the shared persistent stores on the worker side and bumps
+    ``workers_joined``.
+  * **liveness** — each worker heartbeats every
+    ``spark.rapids.tpu.distributed.heartbeatMs``; the monitor thread
+    counts late workers (``worker_heartbeat_misses``) and declares one
+    LOST past ``workerLostMs`` (or instantly on a dead socket reported
+    by the block layer).  A loss bumps ``worker_lost``, records a
+    per-worker circuit-breaker entry (key ``("DistributedWorker",
+    worker_id)``) so a flapping worker that rejoins is QUARANTINED until
+    the breaker TTL re-probe, emits the ``distributed`` diagnostics
+    event, and dumps a flight-recorder post-mortem bundle carrying the
+    placement table and the re-drive plan.
+  * **placement** — ``place()`` spreads one exchange's reduce partitions
+    over placeable workers, least-loaded first, weighted by each
+    worker's advertised memory (fed by ``exec/partition_sizing.py``
+    estimates on the exchange side).
+  * **re-drive bookkeeping** — a loss re-places the dead worker's
+    partitions on survivors and queues them for re-drive; the exchange
+    client claims the queue and re-pushes the retained producer-side
+    blocks (lineage retry), bumping ``partitions_replayed``.
+
+The coordinator never holds partition DATA — blocks flow producer ->
+worker -> consumer; losing the coordinator process loses the query but
+never corrupts one (every data block is CRC-framed end to end).
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from spark_rapids_tpu import perfcounters as PC
+from spark_rapids_tpu.distributed import protocol as P
+from spark_rapids_tpu.distributed.protocol import WorkerLost
+
+ALIVE = "ALIVE"
+QUARANTINED = "QUARANTINED"
+LOST = "LOST"
+LEFT = "LEFT"
+
+# the per-worker circuit-breaker key family: first element mirrors the
+# (operator-class, fingerprint) shape the breaker registry indexes by
+BREAKER_OP = "DistributedWorker"
+
+
+class WorkerInfo:
+    __slots__ = ("worker_id", "host", "data_port", "pid", "mem_bytes",
+                 "state", "last_hb", "joined_at", "control",
+                 "hb_missed", "probe_failed", "warmed_entries")
+
+    def __init__(self, worker_id: str, host: str, data_port: int,
+                 pid: int, mem_bytes: int, control: socket.socket,
+                 warmed_entries: int = 0):
+        self.worker_id = worker_id
+        self.host = host
+        self.data_port = data_port
+        self.pid = pid
+        self.mem_bytes = max(int(mem_bytes), 1)
+        self.state = ALIVE
+        self.last_hb = time.monotonic()
+        self.joined_at = time.monotonic()
+        self.control = control
+        self.hb_missed = False
+        self.probe_failed = False
+        self.warmed_entries = warmed_entries
+
+
+class Coordinator:
+    """One per process; built lazily by the first distributed exchange
+    (or explicitly by tests/harnesses via ``get_coordinator``)."""
+
+    def __init__(self, conf=None):
+        from spark_rapids_tpu.config import (
+            DISTRIBUTED_HEARTBEAT_MS,
+            DISTRIBUTED_LOSS_BREAKER_THRESHOLD,
+            DISTRIBUTED_OP_TIMEOUT_MS,
+            DISTRIBUTED_PUT_RETRIES,
+            DISTRIBUTED_WORKER_LOST_MS,
+            RESILIENCE_BREAKER_TTL_SEC,
+            get_conf,
+        )
+
+        c = conf if conf is not None else get_conf()
+        self.heartbeat_s = max(
+            int(c.get(DISTRIBUTED_HEARTBEAT_MS)), 10) / 1000.0
+        self.lost_s = max(int(c.get(DISTRIBUTED_WORKER_LOST_MS)),
+                          int(c.get(DISTRIBUTED_HEARTBEAT_MS))) / 1000.0
+        self.op_timeout_s = max(
+            int(c.get(DISTRIBUTED_OP_TIMEOUT_MS)), 100) / 1000.0
+        self.put_retries = int(c.get(DISTRIBUTED_PUT_RETRIES))
+        self.breaker_threshold = int(
+            c.get(DISTRIBUTED_LOSS_BREAKER_THRESHOLD))
+        self.breaker_ttl_s = float(c.get(RESILIENCE_BREAKER_TTL_SEC))
+
+        self._lock = threading.Lock()
+        self._workers: Dict[str, WorkerInfo] = {}
+        # wire ids: the identifier used in put/fetch/release headers is
+        # minted HERE, never reused for the coordinator's lifetime.
+        # Shuffle-manager ids are process-unique themselves (the
+        # module-level counter in shuffle/manager.py), so for manager
+        # callers this is defense in depth; it is load-bearing for
+        # DIRECT place() callers (tests, tools) whose raw exchange ids
+        # can repeat — a stale worker-store entry under a colliding
+        # (exch, pid) key would satisfy the consumer's completeness
+        # check with WRONG (CRC-valid) rows
+        import itertools as _it
+
+        self._wire_ids = _it.count(1)
+        self._wire_of: Dict[int, int] = {}
+        # (exch, pid) -> worker_id
+        self._placement: Dict[Tuple[int, int], str] = {}
+        # shipped-block bookkeeping for the leak gate: (exch, pid) ->
+        # blocks currently held remotely
+        self._holdings: Dict[Tuple[int, int], int] = {}
+        # pids a loss re-placed, awaiting producer re-drive
+        self._redrives: Dict[int, Set[int]] = {}
+        # data-plane connections (shared by put/fetch/release), one per
+        # worker, serialized by a per-worker lock
+        self._conns: Dict[str, socket.socket] = {}
+        self._conn_locks: Dict[str, threading.Lock] = {}
+        self._stop = threading.Event()
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(32)
+        self.port = self._listener.getsockname()[1]
+        self._threads: List[threading.Thread] = []
+        for target, name in ((self._accept_loop, "accept"),
+                             (self._monitor_loop, "monitor")):
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f"srt-dist-coord-{name}")
+            t.start()
+            self._threads.append(t)
+
+    # -- membership ------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._listener.accept()
+            except OSError:
+                if self._stop.is_set():
+                    return
+                # transient accept failure (EMFILE during a heavy
+                # shuffle, interrupted syscall): keep serving joins —
+                # a dead accept loop would silently disable elastic
+                # membership for the rest of the process
+                time.sleep(self.heartbeat_s)
+                continue
+            conn.settimeout(self.lost_s * 2 + 1.0)
+            t = threading.Thread(
+                target=self._control_conn, args=(conn, addr[0]),
+                daemon=True, name="srt-dist-coord-control")
+            t.start()
+
+    def _control_conn(self, conn: socket.socket, host: str) -> None:
+        """One worker's control connection: HELLO, then heartbeats until
+        EOF/error (= dead socket)."""
+        wid = None
+        try:
+            header, _ = P.recv_msg(conn)
+            if header.get("op") != "hello":
+                P.send_msg(conn, {"error": "expected hello"})
+                return
+            wid = str(header["worker_id"])
+            self._admit(wid, host, header, conn)
+            P.send_msg(conn, {"op": "welcome", "worker_id": wid})
+            while not self._stop.is_set():
+                msg, _ = P.recv_msg(conn)
+                op = msg.get("op")
+                if op == "heartbeat":
+                    self._heartbeat(wid)
+                elif op == "goodbye":
+                    self._leave(wid)
+                    return
+        except (OSError, ConnectionError, P.ProtocolCorruption):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if wid is not None and not self._stop.is_set():
+                # EOF without goodbye: dead socket — LOST, unless this
+                # connection was already superseded by a rejoin, the
+                # worker left cleanly, or the coordinator itself is
+                # shutting down (a teardown must not bleed stray loss
+                # declarations into whatever runs next)
+                with self._lock:
+                    w = self._workers.get(wid)
+                    stale = w is None or w.control is not conn \
+                        or w.state in (LOST, LEFT)
+                if not stale:
+                    self.declare_lost(wid, "control socket closed")
+
+    def _admit(self, wid: str, host: str, header: Dict,
+               conn: socket.socket) -> None:
+        from spark_rapids_tpu.resilience.breaker import get_breaker
+
+        info = WorkerInfo(wid, host, int(header["data_port"]),
+                          int(header.get("pid", 0)),
+                          int(header.get("mem_bytes", 1 << 20)), conn,
+                          int(header.get("warmed_entries", 0)))
+        # flapping-worker quarantine: a worker id whose loss history
+        # holds the breaker OPEN joins QUARANTINED (heartbeats, but is
+        # never placed) until the TTL re-probe admits it again
+        held = get_breaker().consult((BREAKER_OP, wid),
+                                     self.breaker_ttl_s)
+        if held is not None:
+            info.state = QUARANTINED
+        with self._lock:
+            old = self._workers.get(wid)
+            self._workers[wid] = info
+            self._conn_locks.setdefault(wid, threading.Lock())
+            # a rejoin supersedes the old connection; drop any stale
+            # data conn so the next op dials the new port
+            stale_conn = self._conns.pop(wid, None)
+        if old is not None and old.control is not conn:
+            try:
+                old.control.close()
+            except OSError:
+                pass
+        if stale_conn is not None:
+            try:
+                stale_conn.close()
+            except OSError:
+                pass
+        PC.bump("workers_joined")
+        self._diag_event("worker_joined" if info.state == ALIVE
+                         else "worker_quarantined", wid,
+                         f"mem={info.mem_bytes} state={info.state}")
+        self._flight_event("worker_joined", worker_id=wid,
+                           state=info.state)
+
+    def _heartbeat(self, wid: str) -> None:
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is not None:
+                w.last_hb = time.monotonic()
+                w.hb_missed = False
+                w.probe_failed = False
+                # a quarantined worker re-probes via consult() in
+                # placeable_workers(); heartbeats alone never un-lose a
+                # LOST worker (it must rejoin with a fresh HELLO)
+
+    def _leave(self, wid: str) -> None:
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is None:
+                return
+            w.state = LEFT
+            conn = self._conns.pop(wid, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._diag_event("worker_left", wid, "")
+        self._flight_event("worker_left", worker_id=wid)
+
+    # -- liveness --------------------------------------------------------
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_s):
+            now = time.monotonic()
+            late: List[str] = []
+            lost: List[str] = []
+            with self._lock:
+                for wid, w in self._workers.items():
+                    if w.state not in (ALIVE, QUARANTINED):
+                        continue
+                    age = now - w.last_hb
+                    if age > self.lost_s:
+                        lost.append(wid)
+                    elif age > self.heartbeat_s * 2 and not w.hb_missed:
+                        w.hb_missed = True
+                        late.append(wid)
+            for wid in late:
+                PC.bump("worker_heartbeat_misses")
+            for wid in lost:
+                # heartbeat silence alone is ambiguous on a BUSY driver:
+                # a long GIL hold (XLA compile) starves the reader
+                # threads, so frames sit unread while the worker is
+                # fine.  An active data-port probe disambiguates — a
+                # live worker answers, a SIGSTOPped one times out, a
+                # SIGKILLed one refuses — and a TIMED-OUT probe must
+                # fail twice in a row before declaring (one slow answer
+                # under load is not a death certificate; a refused
+                # connection is).
+                alive, refused = self._probe_alive(wid)
+                if alive:
+                    self._heartbeat(wid)
+                    continue
+                with self._lock:
+                    w = self._workers.get(wid)
+                    first_failure = w is not None and not w.probe_failed
+                    if w is not None:
+                        w.probe_failed = True
+                if first_failure and not refused:
+                    continue      # re-probe next scan before declaring
+                self.declare_lost(
+                    wid, f"no heartbeat for {self.lost_s * 1000:.0f}ms "
+                         f"and data-port probe failed")
+
+    def _probe_alive(self, wid: str) -> Tuple[bool, bool]:
+        """One ping against the worker's data listener (fresh
+        connection; the pooled conn may be mid-operation).  Returns
+        (alive, connection_refused) — refusal means the process is
+        gone and needs no second opinion."""
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is None or w.state in (LOST, LEFT):
+                return False, True
+            host, port = w.host, w.data_port
+        try:
+            s = P.connect(host, port, self.op_timeout_s)
+            try:
+                rep, _ = P.request(s, {"op": "ping"})
+                return bool(rep.get("ok")), False
+            finally:
+                s.close()
+        except ConnectionRefusedError:
+            return False, True
+        except (OSError, ConnectionError, RuntimeError,
+                P.ProtocolCorruption):
+            return False, False
+
+    def declare_lost(self, wid: str, reason: str) -> bool:
+        """Idempotent LOST declaration: quarantine the id, re-place its
+        partitions on survivors, queue them for re-drive, and emit the
+        post-mortem bundle.  True when this call performed the
+        declaration."""
+        from spark_rapids_tpu.resilience.breaker import get_breaker
+
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is None or w.state in (LOST, LEFT):
+                return False
+            w.state = LOST
+            control, conn = w.control, self._conns.pop(wid, None)
+            owned = [k for k, owner in self._placement.items()
+                     if owner == wid]
+        for s in (control, conn):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        # re-place + queue re-drives FIRST: once the LOST state is
+        # visible (state was flipped under the lock above) an observer
+        # acting on it must find the re-drive plan already queued — the
+        # breaker hook below can spend tens of ms building a post-mortem
+        # bundle, and recovery must not wait on observability
+        replaced = self._replace_owner(owned)
+        PC.bump("worker_lost")
+        get_breaker().record_failure((BREAKER_OP, wid),
+                                     self.breaker_threshold,
+                                     reason=f"worker lost: {reason}")
+        plan = [{"exch": e, "pid": p, "to": to}
+                for (e, p), to in sorted(replaced.items())]
+        self._diag_event("worker_lost", wid,
+                         f"{reason}; re-placing {len(plan)} partitions")
+        self._flight_event("worker_lost", worker_id=wid, reason=reason,
+                           replaced=len(plan))
+        self._postmortem(wid, reason, plan)
+        return True
+
+    def _replace_owner(
+            self, keys: List[Tuple[int, int]]
+    ) -> Dict[Tuple[int, int], str]:
+        """Re-place the given (exch, pid) keys on surviving placeable
+        workers and queue them for re-drive.  Keys with no survivor stay
+        mapped to the dead worker — the client's re-drive attempt will
+        raise WorkerLost and the fault domain falls back."""
+        survivors = self.placeable_workers()
+        out: Dict[Tuple[int, int], str] = {}
+        if not survivors:
+            with self._lock:
+                for e, p in keys:
+                    self._redrives.setdefault(e, set()).add(p)
+            return out
+        with self._lock:
+            # re-verify under the lock: a CONCURRENT loss may have
+            # flipped a snapshot survivor to LOST between the
+            # placeable scan above and here — assigning to it would
+            # strand these keys on a dead worker (its own declare_lost
+            # already snapshotted its owned keys and will not re-run)
+            live = [w for w in survivors if w.state == ALIVE]
+            if not live:
+                for e, p in keys:
+                    self._redrives.setdefault(e, set()).add(p)
+                return out
+            loads: Dict[str, float] = {w.worker_id: 0.0 for w in live}
+            for k, owner in self._placement.items():
+                if owner in loads:
+                    loads[owner] += self._holdings.get(k, 0)
+            by_id = {w.worker_id: w for w in live}
+            for e, p in sorted(keys):
+                wid = min(loads, key=lambda i: (loads[i] / by_id[i]
+                                                .mem_bytes, i))
+                self._placement[(e, p)] = wid
+                self._holdings.pop((e, p), None)
+                loads[wid] += 1
+                self._redrives.setdefault(e, set()).add(p)
+                out[(e, p)] = wid
+        return out
+
+    # -- placement -------------------------------------------------------
+    def placeable_workers(self) -> List[WorkerInfo]:
+        """ALIVE workers plus QUARANTINED ones whose breaker TTL expired
+        (the consult admits the re-probe, flipping them placeable)."""
+        from spark_rapids_tpu.resilience.breaker import get_breaker
+
+        out = []
+        with self._lock:
+            candidates = list(self._workers.values())
+        for w in candidates:
+            if w.state == ALIVE:
+                out.append(w)
+            elif w.state == QUARANTINED:
+                if get_breaker().consult((BREAKER_OP, w.worker_id),
+                                         self.breaker_ttl_s) is None:
+                    with self._lock:
+                        if w.state == QUARANTINED:
+                            w.state = ALIVE
+                            out.append(w)
+                    self._diag_event("worker_probed", w.worker_id,
+                                     "quarantine TTL expired")
+        return out
+
+    def live_worker_count(self) -> int:
+        with self._lock:
+            return sum(1 for w in self._workers.values()
+                       if w.state == ALIVE)
+
+    def worker_state(self, wid: str) -> Optional[str]:
+        with self._lock:
+            w = self._workers.get(wid)
+            return w.state if w is not None else None
+
+    def redrive_backlog(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._redrives.values())
+
+    def wait_for_workers(self, n: int, timeout_s: float = 15.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.live_worker_count() >= n:
+                return True
+            time.sleep(0.02)
+        return self.live_worker_count() >= n
+
+    def place(self, exch: int, n_parts: int,
+              est_bytes: Optional[int] = None) -> Dict[int, str]:
+        """Spread one exchange's reduce partitions over placeable
+        workers, least-loaded-by-capacity first (``est_bytes`` comes
+        from the partition-sizing estimate when the planner had one)."""
+        workers = self.placeable_workers()
+        if not workers:
+            raise WorkerLost("<none>", "no placeable workers")
+        per_pid = (est_bytes / n_parts) if est_bytes else 1.0
+        loads = {w.worker_id: 0.0 for w in workers}
+        by_id = {w.worker_id: w for w in workers}
+        out: Dict[int, str] = {}
+        with self._lock:
+            self._wire_of.setdefault(exch, next(self._wire_ids))
+            for pid in range(n_parts):
+                wid = min(loads, key=lambda i: (loads[i] / by_id[i]
+                                                .mem_bytes, i))
+                loads[wid] += per_pid
+                out[pid] = wid
+                self._placement[(exch, pid)] = wid
+        return out
+
+    def _wire(self, exch: int) -> int:
+        """The never-reused wire identifier for one exchange (falls
+        back to the raw id for ops against unplaced exchanges)."""
+        with self._lock:
+            return self._wire_of.get(exch, exch)
+
+    def owner_of(self, exch: int, pid: int) -> str:
+        with self._lock:
+            wid = self._placement.get((exch, pid))
+        if wid is None:
+            raise KeyError(f"partition ({exch}, {pid}) is not placed")
+        return wid
+
+    def placement_of(self, exch: int) -> Dict[int, str]:
+        with self._lock:
+            return {p: w for (e, p), w in self._placement.items()
+                    if e == exch}
+
+    def claim_redrives(self, exch: int) -> Set[int]:
+        """Atomically take (and clear) the exchange's pending re-drive
+        pids — the producer-side client re-pushes them from its spilled
+        partition queues."""
+        with self._lock:
+            return self._redrives.pop(exch, set())
+
+    def mark_redrive(self, exch: int, pid: int) -> None:
+        """Queue one partition for re-drive (the consumer found a
+        worker's copy incomplete — e.g. it restarted empty)."""
+        with self._lock:
+            self._redrives.setdefault(exch, set()).add(pid)
+
+    # -- data plane ------------------------------------------------------
+    def _data_conn_locked_args(self, wid: str):
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is None or w.state in (LOST, LEFT):
+                raise WorkerLost(wid, f"state={'?' if w is None else w.state}")
+            lock = self._conn_locks.setdefault(wid, threading.Lock())
+            return w, lock
+
+    def _request(self, wid: str, header: Dict, blobs=(),
+                 cancellable: bool = True) -> Tuple[Dict, List[bytes]]:
+        """One data-plane request to one worker, with bounded transient
+        retry (connection refused/reset/timeout may heal); exhausted
+        retries or a LOST/unknown worker raise :class:`WorkerLost` after
+        declaring the loss.  ``cancellable=False`` is the CLEANUP
+        contract: a release broadcast for a cancelled query must still
+        reach the workers (remote copies must never outlive the query),
+        so it does not observe the tripped CancelToken."""
+        from spark_rapids_tpu.lifecycle.context import check_cancel
+        from spark_rapids_tpu.resilience.classify import (
+            TRANSIENT,
+            classify_failure,
+        )
+
+        attempt = 0
+        while True:
+            if cancellable:
+                check_cancel()
+            w, lock = self._data_conn_locked_args(wid)
+            try:
+                with lock:
+                    conn = self._conns.get(wid)
+                    if conn is None:
+                        conn = P.connect(w.host, w.data_port,
+                                         self.op_timeout_s)
+                        with self._lock:
+                            self._conns[wid] = conn
+                    try:
+                        return P.request(conn, header, blobs)
+                    except (OSError, ConnectionError):
+                        # one reconnect-and-retry inside the same
+                        # attempt: the pooled conn may simply be stale
+                        with self._lock:
+                            if self._conns.get(wid) is conn:
+                                del self._conns[wid]
+                        try:
+                            conn.close()
+                        except OSError:
+                            pass
+                        conn = P.connect(w.host, w.data_port,
+                                         self.op_timeout_s)
+                        with self._lock:
+                            self._conns[wid] = conn
+                        return P.request(conn, header, blobs)
+            except (OSError, ConnectionError, socket.timeout,
+                    P.RemoteOpError, P.ProtocolCorruption) as e:
+                # ALWAYS evict the pooled conn: a corrupted frame in
+                # particular leaves the TCP stream mid-frame
+                # desynchronized — reusing it would fail every later op
+                # against this worker with bad-magic noise
+                with self._lock:
+                    if self._conns.get(wid) is not None:
+                        try:
+                            self._conns.pop(wid).close()
+                        except OSError:
+                            pass
+                attempt += 1
+                # RemoteOpError: the worker answered but could not
+                # serve (ENOSPC on its spill dir, a racing release) —
+                # treat like a dead socket: declare + re-place, never
+                # let it escape as DETERMINISTIC and indict the
+                # query's operator breaker.  ProtocolCorruption retries
+                # on a FRESH connection (frame desync heals with the
+                # socket; persistent corruption becomes a loss).
+                retryable = isinstance(e, P.ProtocolCorruption) \
+                    or (not isinstance(e, P.RemoteOpError)
+                        and classify_failure(e) == TRANSIENT)
+                if retryable and attempt <= self.put_retries:
+                    time.sleep(min(0.02 * attempt, 0.2))
+                    continue
+                self.declare_lost(wid, f"{type(e).__name__}: {e}")
+                raise WorkerLost(wid, f"{type(e).__name__}: {e}") from e
+
+    def _ensure_live_owner(self, exch: int, pid: int) -> str:
+        """The partition's owner, re-placed first if a concurrent loss
+        left it mapped to a dead worker (the dead worker's own
+        declare_lost snapshotted its keys BEFORE this one landed there,
+        so nobody else will heal it).  The re-placement queues the pid
+        for re-drive like any other loss."""
+        wid = self.owner_of(exch, pid)
+        with self._lock:
+            w = self._workers.get(wid)
+            dead = w is None or w.state in (LOST, LEFT)
+        if dead:
+            replaced = self._replace_owner([(exch, pid)])
+            wid = replaced.get((exch, pid))
+            if wid is None:
+                raise WorkerLost(
+                    "<none>", f"partition ({exch}, {pid}) owner dead "
+                              f"and no placeable survivors")
+        return wid
+
+    def put_block(self, exch: int, pid: int, seq: int,
+                  blob: bytes) -> str:
+        """Ship one block to the partition's current owner; returns the
+        owner id (raises WorkerLost when the owner died and retries
+        were exhausted — the caller re-drives after re-placement)."""
+        wid = self._ensure_live_owner(exch, pid)
+        self._request(wid, {"op": "put", "exch": self._wire(exch),
+                            "pid": pid, "seq": seq}, [blob])
+        with self._lock:
+            # distinct-block count, not send count: replays re-send
+            # sequences the worker's idempotent store deduplicates, and
+            # inflated holdings would skew re-placement load weighting
+            self._holdings[(exch, pid)] = max(
+                self._holdings.get((exch, pid), 0), seq + 1)
+        PC.bump("dist_blocks_shipped")
+        PC.bump("dist_block_bytes", len(blob))
+        return wid
+
+    def fetch_blocks(self, exch: int, pid: int, after_seq: int = -1,
+                     max_bytes: int = 0
+                     ) -> Tuple[List[int], List[bytes], int]:
+        """One PAGE of a partition from its owner (sequences above
+        ``after_seq``, ~``max_bytes`` per page) — a reduce partition
+        far larger than one wire frame streams out page by page
+        instead of materializing whole on the worker.  Returns (seqs,
+        blobs, the worker's total block count for the partition)."""
+        wid = self._ensure_live_owner(exch, pid)
+        rep, blobs = self._request(
+            wid, {"op": "fetch", "exch": self._wire(exch), "pid": pid,
+                  "after_seq": after_seq, "max_bytes": max_bytes})
+        return ([int(s) for s in rep.get("seqs", [])], blobs,
+                int(rep.get("n_total", len(blobs))))
+
+    def worker_stats(self, wid: str) -> Dict:
+        rep, _ = self._request(wid, {"op": "stats"})
+        return rep
+
+    def note_worker_ok(self, wid: str) -> None:
+        """A probed (previously quarantined) worker served successfully:
+        close its breaker entry so future joins are clean."""
+        from spark_rapids_tpu.resilience.breaker import get_breaker
+
+        get_breaker().record_success((BREAKER_OP, wid))
+
+    # -- release / leak accounting --------------------------------------
+    def release_exchange(self, exch: int) -> None:
+        """Drop one exchange everywhere: placement, holdings, pending
+        re-drives, and a best-effort release broadcast to every worker
+        that held any of its partitions (the query committed or died —
+        remote copies must not outlive it)."""
+        with self._lock:
+            owners = {w for (e, _), w in self._placement.items()
+                      if e == exch}
+            for k in [k for k in self._placement if k[0] == exch]:
+                del self._placement[k]
+                self._holdings.pop(k, None)
+            self._redrives.pop(exch, None)
+            wire = self._wire_of.pop(exch, exch)
+        for wid in sorted(owners):
+            try:
+                self._request(wid, {"op": "release", "exch": wire},
+                              cancellable=False)
+            except (WorkerLost, RuntimeError, OSError):
+                # a dead/slow worker cannot hold up query cleanup; its
+                # store dies with its process
+                pass
+
+    def release_all(self) -> None:
+        with self._lock:
+            exchanges = {e for (e, _) in self._placement}
+        for e in sorted(exchanges):
+            self.release_exchange(e)
+
+    def leak_report(self) -> List[str]:
+        """One line per exchange still placed remotely — wired into
+        ``lifecycle.leak_report_all`` so the conftest gate fails the
+        owning test on a leftover remote partition."""
+        with self._lock:
+            by_exch: Dict[int, int] = {}
+            for (e, _p), w in self._placement.items():
+                by_exch[e] = by_exch.get(e, 0) + 1
+            return [
+                f"LEAK: distributed exchange {e} still placed "
+                f"({n} partitions on remote workers)"
+                for e, n in sorted(by_exch.items())]
+
+    # -- observability ---------------------------------------------------
+    def _diag_event(self, kind: str, wid: str, detail: str) -> None:
+        from spark_rapids_tpu.diagnostics import context as _DIAG
+
+        rec = _DIAG.RECORDER
+        if rec is not None:
+            with self._lock:
+                n_workers = sum(1 for w in self._workers.values()
+                                if w.state == ALIVE)
+                n_parts = len(self._placement)
+            rec.distributed(kind, wid, detail, n_workers, n_parts)
+
+    def _flight_event(self, kind: str, **fields) -> None:
+        from spark_rapids_tpu.telemetry import context as TEL
+
+        hub = TEL.HUB
+        if hub is not None:
+            try:
+                hub.record_event(kind, **fields)
+            # tpulint: disable=cancel-swallow (telemetry isolation: a
+            # hub failure must never break membership handling)
+            except Exception:
+                pass
+
+    def _postmortem(self, wid: str, reason: str, plan: List[Dict]) -> None:
+        """The worker-loss flight-recorder bundle: placement table +
+        re-drive plan, so the first thing an operator opens says what
+        was where and what is being replayed."""
+        from spark_rapids_tpu.telemetry import context as TEL
+
+        hub = TEL.HUB
+        if hub is None:
+            return
+        with self._lock:
+            placement = [
+                {"exch": e, "pid": p, "worker": w,
+                 "blocks": self._holdings.get((e, p), 0)}
+                for (e, p), w in sorted(self._placement.items())]
+            members = [{"worker_id": w.worker_id, "state": w.state,
+                        "host": w.host, "data_port": w.data_port,
+                        "pid": w.pid}
+                       for w in self._workers.values()]
+        try:
+            hub.postmortem(
+                "worker_lost", detail=f"{wid}: {reason}", force=True,
+                extra={"worker_id": wid,
+                       "placement_table": placement,
+                       "redrive_plan": plan,
+                       "membership": members})
+        # tpulint: disable=cancel-swallow (telemetry isolation: a dump
+        # failure must never break loss recovery)
+        except Exception:
+            pass
+
+    def gauges(self) -> Dict[str, float]:
+        """Sampler hook (peek-only): live worker count + re-placement
+        backlog."""
+        with self._lock:
+            live = sum(1 for w in self._workers.values()
+                       if w.state == ALIVE)
+            quarantined = sum(1 for w in self._workers.values()
+                              if w.state == QUARANTINED)
+            backlog = sum(len(v) for v in self._redrives.values())
+        return {"dist_workers_live": float(live),
+                "dist_workers_quarantined": float(quarantined),
+                "dist_replacement_backlog": float(backlog)}
+
+    def describe(self) -> str:
+        with self._lock:
+            states = {w.worker_id: w.state
+                      for w in self._workers.values()}
+        return json.dumps({"port": self.port, "workers": states})
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            socks = list(self._conns.values()) + [
+                w.control for w in self._workers.values()
+                if w.control is not None]
+            self._conns.clear()
+            # membership ends with the coordinator: mark everyone LEFT
+            # so in-flight reader/monitor threads waking on the closed
+            # sockets below cannot declare stray losses (bumping
+            # counters and dumping bundles into whatever runs next)
+            for w in self._workers.values():
+                if w.state in (ALIVE, QUARANTINED):
+                    w.state = LEFT
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass

@@ -1,0 +1,396 @@
+"""Worker process — one durable exchange-buffer node of the cross-host
+tier.
+
+Reference analog: a RapidsShuffleServer executor holding its shuffle
+blocks for peer fetches (SURVEY.md §2.7), reduced to the role the
+coordinator places on it: own a set of reduce partitions, keep their
+CRC-framed (``TKU2``) blocks durably (bounded memory, overflowing to a
+spill directory — the netty shuffle-file analog), serve fetches, and
+heartbeat so the coordinator can tell a live worker from a dead one.
+
+A worker is deliberately almost stateless: everything it holds can be
+re-driven from the producer-side spilled partition queues (lineage
+retry), so SIGKILLing one loses no query.  Protocol (over the data
+listener; the control socket to the coordinator carries only HELLO +
+heartbeats):
+
+  put     {exch, pid, seq}+blob -> {ok}     store one partition block
+  fetch   {exch, pid} -> {seqs}+blobs       every block of one partition
+  release {exch} -> {ok}                    drop one exchange's blocks
+  stats   {} -> {blocks, bytes, ...}        introspection
+  ping    {} -> {ok}
+
+Run as a process:
+
+    python -m spark_rapids_tpu.distributed.worker \
+        --coordinator 127.0.0.1:<port> [--worker-id w0] \
+        [--mem-bytes 67108864] [--heartbeat-ms 200] \
+        [--spill-dir DIR] [--warm-compile-dir DIR]
+
+On join the worker warms what can be warmed from shared persistent
+stores: ``--warm-compile-dir`` points the process-wide persistent XLA
+compile cache (``spark.rapids.tpu.compile.cacheDir``) at the shared
+directory, so programs any peer already compiled load instead of
+recompiling (elastic membership without cold-compile storms).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import socket
+import sys
+import tempfile
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.distributed import protocol as P
+
+
+class PartitionStore:
+    """Blocks keyed (exchange, pid) -> ordered (seq, blob) entries, with
+    bounded memory residency; over-budget blocks land as files in the
+    spill dir (one file per block — blocks are already CRC-framed, so
+    disk rot surfaces at deserialize time as ShuffleCorruption)."""
+
+    def __init__(self, mem_bytes: int, spill_dir: Optional[str] = None):
+        self.mem_bytes = max(int(mem_bytes), 0)
+        self._spill_dir = spill_dir
+        self._made_spill_dir = spill_dir is None
+        self._lock = threading.Lock()
+        # (exch, pid) -> {seq: ("mem"|"disk", blob|path)} — keyed by
+        # sequence so the idempotent-put dedup is O(1), not a linear
+        # scan per block on a thousands-of-blocks partition
+        self._parts: Dict[Tuple[int, int],
+                          Dict[int, Tuple[str, object]]] = {}
+        self._mem_used = 0
+        self.blocks = 0
+        self.bytes = 0
+        self.spilled_blocks = 0
+
+    def _spill_path(self, exch: int, pid: int, seq: int) -> str:
+        if self._spill_dir is None:
+            # pid-stamped (not mkdtemp-random): a SIGKILLed worker —
+            # the central scenario of this tier — cannot clean up after
+            # itself, so the name must let reap_stale_spill_dirs()
+            # identify dead owners' leftovers later
+            self._spill_dir = os.path.join(
+                tempfile.gettempdir(), f"srt_dist_worker_{os.getpid()}")
+        os.makedirs(self._spill_dir, exist_ok=True)
+        return os.path.join(self._spill_dir,
+                            f"part_{exch}_{pid}_{seq}.blk")
+
+    def put(self, exch: int, pid: int, seq: int, blob: bytes) -> None:
+        with self._lock:
+            entries = self._parts.setdefault((exch, pid), {})
+            if seq in entries:
+                return   # idempotent re-drive: the block already landed
+            if self._mem_used + len(blob) <= self.mem_bytes:
+                entries[seq] = ("mem", blob)
+                self._mem_used += len(blob)
+            else:
+                path = self._spill_path(exch, pid, seq)
+                with open(path, "wb") as f:
+                    f.write(blob)
+                entries[seq] = ("disk", path)
+                self.spilled_blocks += 1
+            self.blocks += 1
+            self.bytes += len(blob)
+
+    def fetch(self, exch: int, pid: int, after_seq: int = -1,
+              max_bytes: int = 0) -> Tuple[List[int], List[bytes], int]:
+        """One PAGE of a partition's blocks: sequences above
+        ``after_seq``, up to ~``max_bytes`` (0 = everything; at least
+        one block always returns).  Paging keeps a huge reduce
+        partition out of any single wire frame and off this process's
+        heap — spilled blocks load lazily per page.  Returns (seqs,
+        blobs, total block count for the partition)."""
+        with self._lock:
+            part = self._parts.get((exch, pid), {})
+            n_total = len(part)
+            entries = sorted((s, kv) for s, kv in part.items()
+                             if s > after_seq)
+        seqs: List[int] = []
+        blobs: List[bytes] = []
+        total = 0
+        for seq, (kind, x) in entries:
+            if kind == "mem":
+                blob = x
+            else:
+                with open(x, "rb") as f:
+                    blob = f.read()
+            if blobs and max_bytes and total + len(blob) > max_bytes:
+                break
+            seqs.append(seq)
+            blobs.append(blob)
+            total += len(blob)
+        return seqs, blobs, n_total
+
+    def release(self, exch: int) -> int:
+        with self._lock:
+            victims = [k for k in self._parts if k[0] == exch]
+            dropped = 0
+            for k in victims:
+                for kind, x in self._parts.pop(k).values():
+                    dropped += 1
+                    self.blocks -= 1
+                    if kind == "mem":
+                        self._mem_used -= len(x)
+                        self.bytes -= len(x)
+                    else:
+                        try:
+                            self.bytes -= os.path.getsize(x)
+                            os.unlink(x)
+                        except OSError:
+                            pass
+            return dropped
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {"blocks": self.blocks, "bytes": self.bytes,
+                    "mem_used": self._mem_used,
+                    "mem_bytes": self.mem_bytes,
+                    "spilled_blocks": self.spilled_blocks,
+                    "partitions": len(self._parts)}
+
+    def close(self) -> None:
+        with self._lock:
+            self._parts.clear()
+            self._mem_used = 0
+        if self._made_spill_dir and self._spill_dir:
+            shutil.rmtree(self._spill_dir, ignore_errors=True)
+
+
+def reap_stale_spill_dirs() -> int:
+    """Remove ``srt_dist_worker_<pid>`` spill dirs whose owning process
+    is gone — SIGKILLed workers cannot clean up after themselves, so
+    every STARTING worker sweeps the graveyard (best-effort; foreign
+    dirs that refuse to die are left alone).  Returns dirs removed."""
+    reaped = 0
+    tmp = tempfile.gettempdir()
+    try:
+        names = os.listdir(tmp)
+    except OSError:
+        return 0
+    for name in names:
+        if not name.startswith("srt_dist_worker_"):
+            continue
+        pid_s = name[len("srt_dist_worker_"):]
+        if not pid_s.isdigit() or int(pid_s) == os.getpid():
+            continue
+        try:
+            os.kill(int(pid_s), 0)
+            continue              # owner still alive
+        except ProcessLookupError:
+            pass
+        except OSError:
+            continue              # e.g. EPERM: someone else's pid space
+        shutil.rmtree(os.path.join(tmp, name), ignore_errors=True)
+        reaped += 1
+    return reaped
+
+
+def _warm_caches(compile_dir: Optional[str]) -> int:
+    """Elastic-join cache warming: point the persistent XLA compile
+    cache at the shared store so this worker reuses every executable a
+    peer already built.  Returns how many cached entries were visible
+    at join (0 when warming is off/empty); never raises — a missing
+    store must not fail the join."""
+    if not compile_dir:
+        return 0
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", compile_dir)
+        return len([f for f in os.listdir(compile_dir)
+                    if not f.startswith(".")]) if os.path.isdir(
+                        compile_dir) else 0
+    except Exception:
+        return 0
+
+
+class WorkerServer:
+    """The in-process server object (the CLI main() instantiates one;
+    tests drive it directly for protocol-level coverage)."""
+
+    def __init__(self, coordinator: Tuple[str, int], worker_id: str,
+                 mem_bytes: int = 64 << 20, heartbeat_ms: int = 200,
+                 spill_dir: Optional[str] = None,
+                 warm_compile_dir: Optional[str] = None,
+                 op_timeout_ms: int = 4000):
+        self.coordinator = coordinator
+        self.worker_id = worker_id
+        self.heartbeat_s = max(heartbeat_ms, 10) / 1000.0
+        self.op_timeout_s = max(op_timeout_ms, 100) / 1000.0
+        if spill_dir is None:
+            reap_stale_spill_dirs()
+        self.store = PartitionStore(mem_bytes, spill_dir)
+        self.warmed_entries = _warm_caches(warm_compile_dir)
+        self.mem_bytes = mem_bytes
+        self._stop = threading.Event()
+        self._listener: Optional[socket.socket] = None
+        self._control: Optional[socket.socket] = None
+        self._threads: List[threading.Thread] = []
+        self.data_port: Optional[int] = None
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(32)
+        self.data_port = self._listener.getsockname()[1]
+        host, port = self.coordinator
+        self._control = P.connect(host, port, self.op_timeout_s)
+        P.send_msg(self._control, {
+            "op": "hello", "worker_id": self.worker_id,
+            "data_port": self.data_port, "pid": os.getpid(),
+            "mem_bytes": self.mem_bytes,
+            "warmed_entries": self.warmed_entries})
+        rep, _ = P.recv_msg(self._control)
+        if rep.get("op") != "welcome":
+            raise ConnectionError(f"unexpected join reply: {rep}")
+        for target, name in ((self._serve_loop, "accept"),
+                             (self._heartbeat_loop, "heartbeat")):
+            t = threading.Thread(
+                target=target, daemon=True,
+                name=f"srt-dist-worker-{self.worker_id}-{name}")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self, goodbye: bool = True) -> None:
+        self._stop.set()
+        if goodbye and self._control is not None:
+            try:
+                P.send_msg(self._control, {"op": "goodbye",
+                                           "worker_id": self.worker_id})
+            except OSError:
+                pass
+        for s in (self._control, self._listener):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self._control = self._listener = None
+        self.store.close()
+
+    def run_forever(self) -> None:
+        """Block until the control socket dies (coordinator gone or it
+        evicted us) or stop() is called — the CLI process's main loop."""
+        while not self._stop.wait(self.heartbeat_s):
+            if self._control is None:
+                break
+
+    # -- heartbeats ------------------------------------------------------
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_s):
+            c = self._control
+            if c is None:
+                return
+            try:
+                P.send_msg(c, {"op": "heartbeat",
+                               "worker_id": self.worker_id,
+                               **self.store.stats()})
+            except OSError:
+                # the coordinator hung up: a LOST declaration closed our
+                # socket, or the coordinator itself died — either way
+                # this worker's membership is over
+                self._stop.set()
+                self._control = None
+                return
+
+    # -- data plane ------------------------------------------------------
+    def _serve_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.settimeout(self.op_timeout_s * 4)
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True,
+                                 name=f"srt-dist-data-{self.worker_id}")
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    header, blobs = P.recv_msg(conn)
+                except (OSError, ConnectionError):
+                    return
+                try:
+                    reply, rblobs = self._handle(header, blobs)
+                except P.ProtocolCorruption as e:
+                    reply, rblobs = {"error": f"corrupt: {e}"}, []
+                except Exception as e:   # a bad op must not kill the conn
+                    reply, rblobs = {
+                        "error": f"{type(e).__name__}: {e}"}, []
+                try:
+                    P.send_msg(conn, reply, rblobs)
+                except OSError:
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, h: Dict, blobs: List[bytes]) -> Tuple[Dict, list]:
+        op = h.get("op")
+        if op == "put":
+            self.store.put(int(h["exch"]), int(h["pid"]), int(h["seq"]),
+                           blobs[0] if blobs else b"")
+            return {"ok": True}, []
+        if op == "fetch":
+            seqs, out, n_total = self.store.fetch(
+                int(h["exch"]), int(h["pid"]),
+                after_seq=int(h.get("after_seq", -1)),
+                max_bytes=int(h.get("max_bytes", 0)))
+            return {"ok": True, "seqs": seqs, "n_total": n_total}, out
+        if op == "release":
+            dropped = self.store.release(int(h["exch"]))
+            return {"ok": True, "dropped": dropped}, []
+        if op == "stats":
+            return {"ok": True, **self.store.stats()}, []
+        if op == "ping":
+            return {"ok": True, "worker_id": self.worker_id}, []
+        return {"error": f"unknown op {op!r}"}, []
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--coordinator", required=True,
+                    help="host:port of the coordinator's listener")
+    ap.add_argument("--worker-id",
+                    default=f"w-{os.getpid()}")
+    ap.add_argument("--mem-bytes", type=int, default=64 << 20)
+    ap.add_argument("--heartbeat-ms", type=int, default=200)
+    ap.add_argument("--op-timeout-ms", type=int, default=4000)
+    ap.add_argument("--spill-dir", default=None)
+    ap.add_argument("--warm-compile-dir", default=None)
+    args = ap.parse_args(argv)
+
+    srv = WorkerServer(
+        P.parse_endpoint(args.coordinator), args.worker_id,
+        mem_bytes=args.mem_bytes, heartbeat_ms=args.heartbeat_ms,
+        spill_dir=args.spill_dir, warm_compile_dir=args.warm_compile_dir,
+        op_timeout_ms=args.op_timeout_ms)
+    try:
+        srv.start()
+    except OSError as e:
+        print(f"worker {args.worker_id}: cannot join: {e}",
+              file=sys.stderr)
+        return 1
+    try:
+        srv.run_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -244,6 +244,7 @@ class TpuShuffleExchangeExec(TpuExec):
         and each reduce partition assembled by the concat-friendly
         reader."""
         from spark_rapids_tpu.config import (
+            DISTRIBUTED_ENABLED,
             EXCHANGE_SPILL_ENABLED,
             SHUFFLE_MODE,
             get_conf,
@@ -260,6 +261,18 @@ class TpuShuffleExchangeExec(TpuExec):
                 yield self._count_output(b)
             return
         c = self.conf if self.conf is not None else get_conf()
+        if c.get(DISTRIBUTED_ENABLED):
+            # cross-host tier (ISSUE 14): route reduce partitions over
+            # the worker processes when a coordinator with placeable
+            # workers exists; otherwise fall through to the in-process
+            # paths (elastic membership — zero workers is a valid state
+            # between queries, not an error)
+            from spark_rapids_tpu.distributed import peek_coordinator
+
+            coord = peek_coordinator()
+            if coord is not None and coord.placeable_workers():
+                yield from self._execute_distributed(c, coord)
+                return
         if c.get(EXCHANGE_SPILL_ENABLED) \
                 and str(c.get(SHUFFLE_MODE)).upper() != "CACHE_ONLY":
             yield from self._execute_spill_backed(c)
@@ -285,6 +298,94 @@ class TpuShuffleExchangeExec(TpuExec):
                     yield self._count_output(out)
         finally:
             mgr.unregister_shuffle(shuffle_id)
+
+    def _execute_distributed(self, c, coord) -> Iterator[ColumnarBatch]:
+        """Cross-host execution (ISSUE 14): partition slices are framed
+        once (TKU2), shipped to coordinator-placed worker processes,
+        AND retained in a producer-side spill-backed queue (device
+        budget 0 — every entry a wire block) until the consuming side
+        commits each partition.  A worker lost mid-shuffle is recovered
+        by re-placement + re-drive of the retained blocks; the shuffle
+        manager registration ties remote holdings to this query, so the
+        query-end cleanup sweep releases them even on a mid-batch
+        unwind."""
+        from spark_rapids_tpu.config import (
+            BATCH_SIZE_BYTES,
+            DISTRIBUTED_REDRIVE_MAX,
+            SPILL_DIR,
+        )
+        from spark_rapids_tpu.distributed.client import DistributedExchange
+        from spark_rapids_tpu.exec.partition_sizing import (
+            estimate_input_bytes,
+        )
+        from spark_rapids_tpu.lifecycle import QueryCancelled
+        from spark_rapids_tpu.lifecycle.context import check_cancel
+        from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
+        from spark_rapids_tpu.shuffle.partition_queues import (
+            SpillBackedPartitionQueues,
+            host_boundary_codec,
+        )
+
+        mgr = get_shuffle_manager(self.conf)
+        exch_id = mgr.register_shuffle()
+        # everything fallible — incl. placement inside
+        # DistributedExchange.__init__, which raises WorkerLost when the
+        # last placeable worker died since the execute_columnar check —
+        # sits inside the try so the finally always unregisters the
+        # shuffle id and closes whatever was built
+        queues = None
+        dist = None
+        try:
+            try:
+                est = estimate_input_bytes(self.children[0], c)
+            except QueryCancelled:
+                raise
+            except Exception:
+                est = None
+            # lineage buffer: device budget 0 (every entry a wire
+            # block), host residency bounded by the shuffle host-store
+            # limit with disk overflow — retaining a whole exchange
+            # until its partitions commit must not pin the driver's RAM
+            from spark_rapids_tpu.shuffle.manager import (
+                SHUFFLE_HOST_STORE_LIMIT,
+            )
+
+            queues = SpillBackedPartitionQueues(
+                self.num_partitions, self.output, device_budget=0,
+                codec=host_boundary_codec(c),
+                host_budget=int(c.get(SHUFFLE_HOST_STORE_LIMIT)),
+                spill_dir=c.get(SPILL_DIR))
+            dist = DistributedExchange(
+                coord, exch_id, self.num_partitions, self.output,
+                host_boundary_codec(c), queues, est_bytes=est,
+                redrive_max_attempts=int(c.get(DISTRIBUTED_REDRIVE_MAX)))
+            goal = int(c.get(BATCH_SIZE_BYTES))
+            from spark_rapids_tpu.governor import context as _GOV
+
+            _gov = _GOV.GOVERNOR
+            if _gov is not None:
+                goal = _gov.degraded_goal(goal)
+            with self.metric("shuffleWriteTime").timed():
+                for b in self.children[0].execute_columnar():
+                    for pid, sl in self.partition_slices(b):
+                        with self.metric("exchangeSpillTime").timed():
+                            dist.add_slice(pid, sl)
+            for pid in range(self.num_partitions):
+                check_cancel()
+                it = dist.read_partition_chunks(pid, target_bytes=goal)
+                while True:
+                    with self.metric("shuffleReadTime").timed():
+                        out = next(it, None)
+                    if out is None:
+                        break
+                    if out.num_rows > 0:
+                        yield self._count_output(out)
+        finally:
+            if dist is not None:
+                dist.close()
+            elif queues is not None:
+                queues.close()
+            mgr.unregister_shuffle(exch_id)
 
     def _execute_spill_backed(self, c) -> Iterator[ColumnarBatch]:
         """Stream partition slices through spill-backed queues: per

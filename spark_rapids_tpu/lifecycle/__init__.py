@@ -235,6 +235,12 @@ def leak_report_all() -> List[str]:
     if mgr is not None:
         for sid in mgr.active_shuffles():
             out.append(f"LEAK: shuffle {sid} still registered")
+    # 3b. partitions still PLACED on remote workers (ISSUE 14): a
+    #     distributed exchange that unwound without its release
+    #     broadcast leaves blocks pinned in another process's store
+    from spark_rapids_tpu import distributed as _dist
+
+    out.extend(_dist.leak_report())
     # 4. writer staging dirs never committed nor aborted (ISSUE 5): a
     #    leftover _temporary/<uuid> means a write unwound without its
     #    commit protocol running — visible-partial-output risk
@@ -270,6 +276,19 @@ def reset_leaked_state() -> None:
     from spark_rapids_tpu.io import writer as _writer
 
     _writer.reset_leaked_staging()
+    # remote placements an unregistered/leaked exchange left behind
+    # (ISSUE 14) — release everywhere so one leaky test cannot pin
+    # blocks in worker stores for the rest of the session
+    from spark_rapids_tpu import distributed as _dist
+
+    coord = _dist.peek_coordinator()
+    if coord is not None:
+        try:
+            coord.release_all()
+        # tpulint: disable=cancel-swallow (leaked-state recovery in
+        # tests; no query is running when this sweeps)
+        except Exception:
+            pass
 
 
 __all__ = [

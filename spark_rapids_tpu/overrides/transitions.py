@@ -563,12 +563,19 @@ class TpuTransitionOverrides:
             return node
         if len(jax.devices()) > 1:
             return node
+        from spark_rapids_tpu.config import DISTRIBUTED_ENABLED
+
         if isinstance(node, TpuShuffleExchangeExec) and isinstance(
                 node.partitioning,
                 (HashPartitioning, RoundRobinPartitioning)) \
-                and not getattr(node, "_ooc_sized", False):
+                and not getattr(node, "_ooc_sized", False) \
+                and not conf.get(DISTRIBUTED_ENABLED):
             # sized exchanges keep their partitions: on one chip they
-            # are the out-of-core schedule, not elidable parallelism
+            # are the out-of-core schedule, not elidable parallelism.
+            # Distributed exchanges (ISSUE 14) keep them too: reduce
+            # partitions are the unit of cross-host placement — with
+            # one local chip and N remote workers, collapsing would
+            # collapse the cluster to one worker
             node.partitioning = SinglePartitioning()
         return node
 

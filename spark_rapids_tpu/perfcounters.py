@@ -140,6 +140,19 @@ COUNTERS: Dict[str, int] = {
     "ici_rows_exchanged": 0,
     "ici_bytes_moved": 0,
     "ici_shuffle_ns": 0,
+    # distributed cross-host tier (ISSUE 14, distributed/): elastic
+    # membership (every worker join, incl. quarantined rejoins), LOST
+    # declarations (missed heartbeats past workerLostMs or a dead
+    # socket past the transient budget), monitor ticks that caught a
+    # late heartbeat, reduce partitions re-placed + re-driven from the
+    # producer-side spilled partition queues after a loss, and the
+    # block traffic shipped to workers
+    "workers_joined": 0,
+    "worker_lost": 0,
+    "worker_heartbeat_misses": 0,
+    "partitions_replayed": 0,
+    "dist_blocks_shipped": 0,
+    "dist_block_bytes": 0,
 }
 
 

@@ -25,6 +25,21 @@ Classes:
                       query (ANSI overflow, FAILFAST parse errors) plus
                       control-flow exceptions; the fault domain must
                       re-raise these unchanged.
+  * WORKER_LOST     — a distributed worker is gone for good (heartbeat
+                      silence, dead socket past the transient budget).
+                      Not a per-batch-backoff case and not an operator
+                      bug: the distributed tier answers with partition
+                      re-placement + re-drive from the producer-side
+                      spilled partition queues; if it still escapes, the
+                      fault domain falls back WITHOUT feeding the
+                      operator's circuit-breaker key (infrastructure
+                      churn must not banish a healthy stage to CPU).
+
+Framed-block I/O taxonomy (ISSUE 14): ``ConnectionError`` /
+``BrokenPipeError`` / ``socket.timeout`` anywhere in the chain classify
+TRANSIENT — a reconnect may heal them — while the typed
+:class:`WorkerLost` raised once the block layer's transient budget is
+exhausted classifies WORKER_LOST.
 """
 from __future__ import annotations
 
@@ -34,6 +49,7 @@ DEVICE_OOM = "deviceOom"
 TRANSIENT = "transient"
 DETERMINISTIC = "deterministic"
 PROPAGATE = "propagate"
+WORKER_LOST = "workerLost"
 
 # absl / XLA status codes (the string form jaxlib prefixes messages with)
 _OOM_CODES = ("RESOURCE_EXHAUSTED",)
@@ -62,7 +78,14 @@ _PROPAGATE_TYPE_NAMES = ("SparkArithmeticException",
 # CRC, disk-spill CRC): re-reading re-derives the same corruption, so
 # they classify DETERMINISTIC (the fallthrough default — listed here so
 # the contract is explicit and message contents can never reclassify)
-_DETERMINISTIC_TYPE_NAMES = ("ShuffleCorruption", "SpillCorruption")
+_DETERMINISTIC_TYPE_NAMES = ("ShuffleCorruption", "SpillCorruption",
+                             "ProtocolCorruption")
+
+# a distributed worker declared gone (distributed/protocol.py).  Matched
+# by name (import-cycle-free) and BEFORE the ConnectionError isinstance
+# check — WorkerLost subclasses ConnectionError, but retry/backoff is
+# exactly the wrong response once the loss is declared
+_WORKER_LOST_TYPE_NAMES = ("WorkerLost",)
 
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
 
@@ -136,6 +159,9 @@ def classify_failure(exc: BaseException) -> str:
     for link in exception_chain(exc):
         if type(link).__name__ in _PROPAGATE_TYPE_NAMES:
             return PROPAGATE
+    for link in exception_chain(exc):
+        if type(link).__name__ in _WORKER_LOST_TYPE_NAMES:
+            return WORKER_LOST
     for link in exception_chain(exc):
         if type(link).__name__ in _DETERMINISTIC_TYPE_NAMES:
             return DETERMINISTIC

@@ -224,8 +224,17 @@ def run_fault_domain(op, fn, args, kwargs) -> Iterator:
                 if kind in (CL.TRANSIENT, CL.DEVICE_OOM):
                     e._srt_retries_exhausted = True
                 # deterministic (or retry budget exhausted): breaker +
-                # runtime CPU fallback
+                # runtime CPU fallback.  WORKER_LOST (ISSUE 14) takes
+                # the same fallback path but NEVER indicts the
+                # operator's breaker key — the distributed tier already
+                # re-placed/re-drove what it could and quarantined the
+                # worker's own per-worker entry; losing infrastructure
+                # must not banish a healthy stage to CPU
+                if kind == CL.WORKER_LOST:
+                    _diag_event("worker_lost", name,
+                                f"{type(e).__name__}: {e}")
                 key = None if isinstance(e, ReplayMisalignment) \
+                    or kind == CL.WORKER_LOST \
                     else _breaker_key_of(op)
                 if key is not None and not getattr(
                         e, "_srt_breaker_recorded", False):

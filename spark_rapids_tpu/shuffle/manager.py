@@ -105,7 +105,12 @@ class TpuShuffleManager:
         self.store = _BlockStore(tpu_conf.get(SHUFFLE_HOST_STORE_LIMIT),
                                  tpu_conf.get(SPILL_DIR))
         self._device_store: Dict[Tuple[int, int, int], ColumnarBatch] = {}
-        self._next_shuffle = itertools.count()
+        # PROCESS-unique ids (ISSUE 14): a shuffle-conf change rebuilds
+        # the manager, and a restarted per-instance counter would hand a
+        # new query an id an in-flight query (or a remote worker store)
+        # still holds — the distributed tier keys cross-process state by
+        # these ids, so reuse would mix queries' partitions
+        self._next_shuffle = _shuffle_ids
         self._pool: Optional[cf.ThreadPoolExecutor] = None
         self._lock = threading.Lock()
         # lifecycle bookkeeping (ISSUE 4): live shuffle ids + the query
@@ -236,6 +241,16 @@ class TpuShuffleManager:
         self.store.remove_shuffle(shuffle_id)
         for k in [k for k in self._device_store if k[0] == shuffle_id]:
             del self._device_store[k]
+        # ISSUE 14: a distributed exchange registered under this id
+        # placed partitions on REMOTE workers — unregistering (directly,
+        # or via the query-end unregister_owned sweep) must release
+        # those too, or the leak outlives the query on another process.
+        # Peek only: cleanup must never build a coordinator.
+        from spark_rapids_tpu.distributed import peek_coordinator
+
+        coord = peek_coordinator()
+        if coord is not None:
+            coord.release_exchange(shuffle_id)
         with self._lock:
             self._owners.pop(shuffle_id, None)
 
@@ -243,6 +258,8 @@ class TpuShuffleManager:
 _lock = threading.Lock()
 _manager: Optional[TpuShuffleManager] = None
 _manager_key = None
+# shared by every manager generation — see TpuShuffleManager.__init__
+_shuffle_ids = itertools.count()
 
 
 def get_shuffle_manager(tpu_conf: Optional[TpuConf] = None) -> TpuShuffleManager:

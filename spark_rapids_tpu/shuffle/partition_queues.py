@@ -35,18 +35,30 @@ class SpillBackedPartitionQueues:
     device residency (the spill-backed exchange's block store)."""
 
     def __init__(self, n_parts: int, schema: T.StructType,
-                 device_budget: int, codec: Optional[str] = None):
+                 device_budget: int, codec: Optional[str] = None,
+                 host_budget: int = 0,
+                 spill_dir: Optional[str] = None):
         from spark_rapids_tpu.memory.spill import get_spill_framework
 
         self.n_parts = n_parts
         self.schema = schema
         self.device_budget = max(int(device_budget), 0)
+        # host-memory budget for retained CRC blobs (0 = unbounded):
+        # past it blobs land as files in the spill dir — the distributed
+        # lineage buffer (ISSUE 14) retains a whole exchange until its
+        # partitions commit, which must not pin the driver's RAM
+        self.host_budget = max(int(host_budget), 0)
+        self._spill_dir = spill_dir
+        self._made_spill_dir = False
         self.codec = codec
         self._fw = get_spill_framework()
-        # per-partition entries: ("dev", handle) | ("host", crc_blob)
+        # per-partition entries:
+        #   ("dev", handle) | ("host", crc_blob) | ("hostfile", path)
         self._queues: Dict[int, List[Tuple[str, object]]] = {
             p: [] for p in range(n_parts)}
         self._device_bytes = 0
+        self._host_mem_bytes = 0
+        self._next_file = 0
         self.host_blocks = 0
         self.host_block_bytes = 0
 
@@ -77,12 +89,90 @@ class SpillBackedPartitionQueues:
             from spark_rapids_tpu.exec.ici import ici_host_frame
 
             blob = ici_host_frame(batch, codec=self.codec)
-            self._queues[pid].append(("host", blob))
+            self._queues[pid].append(self._host_entry(blob))
             self.host_blocks += 1
             self.host_block_bytes += len(blob)
             PC.bump("exchange_host_blocks")
             PC.bump("exchange_host_block_bytes", len(blob))
         PC.bump("exchange_spill_ns", time.perf_counter_ns() - t0)
+
+    def _host_entry(self, blob: bytes) -> Tuple[str, object]:
+        """One host-tier entry: in memory up to ``host_budget``, past
+        it a file in the spill dir (blobs are already CRC-framed, so
+        disk rot surfaces at decode time as ShuffleCorruption)."""
+        if not self.host_budget \
+                or self._host_mem_bytes + len(blob) <= self.host_budget:
+            self._host_mem_bytes += len(blob)
+            return ("host", blob)
+        import os
+        import tempfile
+
+        if self._spill_dir is None:
+            self._spill_dir = tempfile.mkdtemp(prefix="srt_exch_lineage_")
+            self._made_spill_dir = True
+        os.makedirs(self._spill_dir, exist_ok=True)
+        path = os.path.join(self._spill_dir,
+                            f"lineage_{id(self):x}_{self._next_file}.blk")
+        self._next_file += 1
+        with open(path, "wb") as f:
+            f.write(blob)
+        return ("hostfile", path)
+
+    def _release_entry(self, kind: str, x) -> None:
+        """Drop one entry's backing resource (host accounting / spill
+        file / device handle)."""
+        if kind == "host":
+            self._host_mem_bytes -= len(x)
+        elif kind == "hostfile":
+            import os
+
+            try:
+                os.unlink(x)
+            except OSError:
+                pass
+        elif kind == "dev":
+            self._device_bytes -= x.device_bytes
+            x.close()
+
+    def append_framed(self, pid: int, blob: bytes) -> None:
+        """Queue one PRE-FRAMED host-boundary block (the distributed
+        tier frames each slice once — ``exec/ici.ici_host_frame`` — and
+        retains the same bytes here as its lineage copy).  Counted like
+        any other host-boundary block."""
+        from spark_rapids_tpu.lifecycle.context import check_cancel
+
+        check_cancel()
+        if not blob:
+            return
+        self._queues[pid].append(self._host_entry(blob))
+        self.host_blocks += 1
+        self.host_block_bytes += len(blob)
+        PC.bump("exchange_host_blocks")
+        PC.bump("exchange_host_block_bytes", len(blob))
+
+    def peek_blobs(self, pid: int) -> List[bytes]:
+        """The partition's retained host-boundary blocks WITHOUT
+        draining — the re-drive source after a worker loss (ISSUE 14;
+        spilled blobs read back from disk).  Only meaningful for queues
+        run at device budget 0 (every entry framed): device-resident
+        entries are not wire blocks and are skipped."""
+        out: List[bytes] = []
+        for kind, x in (self._queues.get(pid) or []):
+            if kind == "host":
+                out.append(x)
+            elif kind == "hostfile":
+                with open(x, "rb") as f:
+                    out.append(f.read())
+        return out
+
+    def release_partition(self, pid: int) -> None:
+        """Commit one partition: the consuming stage fully read it, so
+        the lineage copy (resident handles, retained blobs, spill
+        files) can go."""
+        entries = self._queues.get(pid) or []
+        self._queues[pid] = []
+        for kind, x in entries:
+            self._release_entry(kind, x)
 
     def read(self, pid: int) -> Optional[ColumnarBatch]:
         """Drain reduce partition ``pid`` into one device batch (the
@@ -115,7 +205,16 @@ class SpillBackedPartitionQueues:
         group_bytes = 0
 
         def _entry_bytes(kind, x):
-            return x.device_bytes if kind == "dev" else len(x)
+            if kind == "dev":
+                return x.device_bytes
+            if kind == "hostfile":
+                import os
+
+                try:
+                    return os.path.getsize(x)
+                except OSError:
+                    return 0
+            return len(x)
 
         def _drain_group():
             t0 = time.perf_counter_ns()
@@ -128,6 +227,9 @@ class SpillBackedPartitionQueues:
                 for kind, x in group:
                     if kind == "dev":
                         parts.append(x.get_batch())
+                    elif kind == "hostfile":
+                        with open(x, "rb") as f:
+                            host_blobs.append(f.read())
                     else:
                         host_blobs.append(x)
                 if host_blobs:
@@ -141,9 +243,8 @@ class SpillBackedPartitionQueues:
             finally:
                 for h in handles:
                     h.unpin()
-            for h in handles:
-                self._device_bytes -= h.device_bytes
-                h.close()
+            for kind, x in group:
+                self._release_entry(kind, x)
             PC.bump("exchange_spill_ns", time.perf_counter_ns() - t0)
             return out
 
@@ -165,15 +266,21 @@ class SpillBackedPartitionQueues:
 
         for pid, entries in self._queues.items():
             for kind, x in entries:
-                if kind == "dev":
-                    try:
-                        x.close()
-                    except QueryCancelled:
-                        raise
-                    except Exception:
-                        pass
+                try:
+                    self._release_entry(kind, x)
+                except QueryCancelled:
+                    raise
+                except Exception:
+                    pass
             self._queues[pid] = []
         self._device_bytes = 0
+        self._host_mem_bytes = 0
+        if self._made_spill_dir and self._spill_dir:
+            import shutil
+
+            shutil.rmtree(self._spill_dir, ignore_errors=True)
+            self._spill_dir = None
+            self._made_spill_dir = False
 
 
 def queue_device_budget(conf) -> int:

@@ -183,7 +183,9 @@ class TelemetryHub:
                    detail: str = "",
                    offender_ident: Optional[int] = None,
                    force: bool = False,
-                   claim_query: bool = True) -> Optional[Dict[str, Any]]:
+                   claim_query: bool = True,
+                   extra: Optional[Dict[str, Any]] = None
+                   ) -> Optional[Dict[str, Any]]:
         """Build (and optionally persist) one post-mortem bundle.
         Deduped per query (a deadline trip dumps from the watchdog; the
         same query's collect unwinding must not dump again) and
@@ -213,7 +215,8 @@ class TelemetryHub:
                     self._dumped_qids.popitem(last=False)
         bundle = build_bundle(self.flight, reason, query_id=query_id,
                               detail=detail,
-                              offender_ident=offender_ident)
+                              offender_ident=offender_ident,
+                              extra=extra)
         if self.dump_dir:
             bundle["path"] = write_bundle(bundle, self.dump_dir)
         self.postmortems.append(bundle)

@@ -104,11 +104,15 @@ def _progress_snapshot(query_id: str) -> Optional[Dict[str, Any]]:
 
 def build_bundle(recorder: FlightRecorder, reason: str,
                  query_id: str = "", detail: str = "",
-                 offender_ident: Optional[int] = None) -> Dict[str, Any]:
-    """Assemble one post-mortem bundle (pure data, JSON-serializable)."""
+                 offender_ident: Optional[int] = None,
+                 extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Assemble one post-mortem bundle (pure data, JSON-serializable).
+    ``extra`` merges caller-provided context at the top level — the
+    worker-loss bundle (ISSUE 14) carries the placement table and the
+    re-drive plan this way."""
     from spark_rapids_tpu import perfcounters as PC
 
-    return {
+    bundle = {
         "bundle": "spark_rapids_tpu_postmortem",
         "reason": reason,
         "query_id": query_id,
@@ -121,6 +125,10 @@ def build_bundle(recorder: FlightRecorder, reason: str,
         "progress": _progress_snapshot(query_id),
         "ring": recorder.snapshot(),
     }
+    if extra:
+        for k, v in extra.items():
+            bundle.setdefault(k, v)
+    return bundle
 
 
 def write_bundle(bundle: Dict[str, Any], dump_dir: str) -> Optional[str]:

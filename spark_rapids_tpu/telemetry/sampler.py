@@ -46,6 +46,8 @@ SAMPLED_COUNTERS = (
     "stalls_detected", "progress_snapshots",
     "governor_transitions", "queries_shed", "preempt_pauses",
     "degraded_batches",
+    "workers_joined", "worker_lost", "worker_heartbeat_misses",
+    "partitions_replayed",
 )
 
 
@@ -114,6 +116,14 @@ def collect_gauges() -> Dict[str, float]:
     gov = _GOV.GOVERNOR
     if gov is not None:
         g.update(gov.gauges())
+    # distributed cross-host tier (ISSUE 14): live worker count,
+    # quarantined count, and the re-placement backlog still awaiting
+    # producer re-drive — peek-only like every other gauge
+    from spark_rapids_tpu.distributed import peek_coordinator
+
+    coord = peek_coordinator()
+    if coord is not None:
+        g.update(coord.gauges())
     return g
 
 

@@ -84,9 +84,12 @@ def _leak_gate(request):
     shuffle registration fails the OWNING test instead of silently
     poisoning every later one.  ISSUE 5 extends the report to writer
     staging dirs: a leftover ``_temporary/<uuid>`` means a write unwound
-    without its commit protocol running.  The gate only *fails* a test
-    whose body passed (a failing test already reported its real error —
-    the leaked state is still cleaned so it cannot cascade)."""
+    without its commit protocol running.  ISSUE 14 extends it to REMOTE
+    partitions: an exchange still placed on distributed workers means a
+    query ended without its release broadcast — blocks pinned in another
+    process's store.  The gate only *fails* a test whose body passed (a
+    failing test already reported its real error — the leaked state is
+    still cleaned so it cannot cascade)."""
     yield
     from spark_rapids_tpu.lifecycle import (
         leak_report_all,
@@ -104,7 +107,8 @@ def _leak_gate(request):
     if rep is not None and rep.passed:
         pytest.fail(
             "resource leak after test (spillables / semaphore permits / "
-            "shuffle registrations / writer staging dirs):\n"
+            "shuffle registrations / writer staging dirs / remote "
+            "distributed partitions):\n"
             + "\n".join(leaks[:20]),
             pytrace=False)
 

@@ -78,6 +78,8 @@ GOLDEN_SCHEMA = {
     "scan_prefetch": ["depth", "batches", "overlapped_bytes", "stall_ns"],
     "ici_shuffle": ["stage", "n_dev", "rows", "bytes", "dur_ns"],
     "governor": ["action", "state", "prev", "pressure", "detail"],
+    "distributed": ["kind", "worker_id", "detail", "n_workers",
+                    "n_partitions"],
     "query_stall": ["query_id", "path", "name", "stalled_ms", "detail"],
     "progress": ["query_id", "pct", "eta_ns", "stalls", "background"],
     "op_batch": ["path", "batch", "rows", "dur_ns"],

@@ -60,6 +60,27 @@ def test_overload_replay():
     assert s["governor"]["final_state"] == "GREEN"
 
 
+def test_worker_kill_chaos_twin():
+    """``run_chaos.py --worker-kill`` engine (ISSUE 14), tier-1 size: a
+    2-round distributed-join replay over 2 worker processes with one
+    SIGKILL round armed.  Every round must match the CPU oracle (the
+    killed round recovers via re-placement + re-drive from the
+    producer-side spilled partition queues), the kill must end in a
+    LOST declaration, and the leak report must be empty.  The CLI runs
+    the bigger SIGKILL/SIGSTOP mix."""
+    from run_stress import run_worker_kill
+
+    s = run_worker_kill(n_workers=2, rounds=2, seed=20260804, kills=1,
+                        suspend=False, rows=30_000, quiet=True)
+    assert not s["failures"], s["failures"]
+    assert not s["leaks"], s["leaks"]
+    assert s["ok"] == s["rounds"] == 2
+    assert len(s["kills"]) == 1
+    assert s["worker_lost"] >= 1
+    assert s["partitions_replayed"] >= 1
+    assert s["blocks_shipped"] > 0
+
+
 def test_hot_cache_trace_replay():
     """``run_stress.py --hot-cache`` engine (ISSUE 6): 8 workers replay
     the same parquet table concurrently — every warm replay must be a

@@ -24,7 +24,12 @@ non-zero when the new run regressed past the tolerance:
   ``recovery_s`` (time back to GREEN after the load drops) must not
   grow more than ``--tolerance`` (+1s slack), and a new run with
   failures — or one that stopped shedding/recovering entirely where
-  the baseline measured both — fails the gate.
+  the baseline measured both — fails the gate;
+* ``rung4_dist`` (ISSUE 14): the 2-process distributed join rung's
+  wall must stay within ``--tolerance`` (+3s absolute slack for the
+  loss-detection window), and a kill-armed run must record both a
+  ``workerLost`` declaration and ``partitionsReplayed > 0`` — a wrong
+  answer or an unrecovered loss fails loudly.
 
 The payload's per-plan-signature ``slo`` section is informational, not
 gated: it includes warm-up/compile collects whose latency depends on
@@ -52,6 +57,10 @@ SCAN_TRANSFER_SLACK_S = 0.05
 COMPILE_SLACK_S = 0.5
 P95_SLACK_MS = 5.0
 RUNG3_OOC_SLACK_S = 2.0
+# rung4_dist absolute slack: the distributed rung's wall includes a
+# workerLostMs detection window + re-drive, both latency- not
+# throughput-bound, so small runs need absolute headroom
+RUNG4_DIST_SLACK_S = 3.0
 SHED_RATE_SLACK = 0.05
 RECOVERY_SLACK_S = 1.0
 # progressOverhead (ISSUE 12): absolute percentage-point slack — the
@@ -213,6 +222,37 @@ def gate(base: Dict, new: Dict, tolerance: float = DEFAULT_TOLERANCE,
             regressions.append(
                 "rung3_ooc: spill traffic collapsed to 0 — the rung no "
                 "longer exercises the out-of-core machinery")
+
+    # gating rung4_dist (ISSUE 14): the 2-process distributed join rung
+    # — wall within tolerance, and the fault-tolerance machinery must
+    # keep firing: a kill-armed run with zero re-driven partitions (or
+    # zero losses) means the loss went unrecovered or the rung silently
+    # stopped exercising the distributed path.  Wrong answers never
+    # reach the payload (the rung asserts vs the CPU reference and a
+    # failed rung lands in the missing-queries check above).
+    b4, n4 = bq.get("rung4_dist"), nq.get("rung4_dist")
+    if b4 and n4:
+        bw = float(b4.get("tpu_s") or 0.0)
+        nw = float(n4.get("tpu_s") or 0.0)
+        if bw and nw > bw * (1.0 + tolerance) + RUNG4_DIST_SLACK_S:
+            regressions.append(
+                f"rung4_dist: distributed wall regressed: {bw:.3f}s -> "
+                f"{nw:.3f}s ({_pct(bw, nw)}, tolerance "
+                f"{tolerance * 100:.0f}% + {RUNG4_DIST_SLACK_S:.1f}s)")
+        if n4.get("killArmed"):
+            if not n4.get("workerLost"):
+                regressions.append(
+                    "rung4_dist: kill armed but worker_lost == 0 — the "
+                    "injected loss was never declared")
+            if not n4.get("partitionsReplayed"):
+                regressions.append(
+                    "rung4_dist: kill armed but partitions_replayed == "
+                    "0 — the loss went unrecovered (no re-drive)")
+        if b4.get("distBlocksShipped") \
+                and not n4.get("distBlocksShipped"):
+            regressions.append(
+                "rung4_dist: block traffic collapsed to 0 — the rung "
+                "no longer exercises the distributed exchange")
 
     # progressOverhead (ISSUE 12 satellite): the live-progress
     # enabled-path tax must not creep across rounds.  Gated only when

@@ -5,6 +5,16 @@ over the representative join+agg+sort+expr query and print a summary.
     python tools/run_chaos.py [--seed 7] [--shape broadcast|shuffled|all]
     python tools/run_chaos.py --corrupt-inputs [--seed 7]
     python tools/run_chaos.py --pressure [--seed 7]
+    python tools/run_chaos.py --worker-kill [--seed 7]
+
+``--worker-kill`` (ISSUE 14) sweeps WORKER-PROCESS churn instead of
+operator faults: the ``tools/run_stress.py --worker-kill`` engine
+replays a distributed join over a pool of worker processes while
+random workers are SIGKILLed or SIGSTOPped mid-shuffle.  The pin: zero
+wrong answers and zero hard failures (every round matches the CPU
+oracle, recovered by re-placement + re-drive from the producer-side
+spilled partition queues), every kill ends in a LOST declaration, and
+the leak report is empty afterwards.
 
 ``--pressure`` (ISSUE 13) sweeps sustained OVERLOAD instead of
 operator faults: the ``tools/run_stress.py --overload`` engine (a
@@ -213,6 +223,31 @@ def run_pressure(seed: int) -> bool:
     return ok
 
 
+def run_worker_kill_sweep(seed: int, workers: int, rounds: int,
+                          kills: int) -> bool:
+    """The --worker-kill sweep: distributed-join replay under random
+    SIGKILL/SIGSTOP worker churn (run_stress.run_worker_kill)."""
+    import json
+
+    from run_stress import run_worker_kill
+
+    print(f"\n== worker-kill sweep ({workers} workers, {rounds} rounds, "
+          f"{kills} kill rounds, SIGKILL/SIGSTOP mix) ==")
+    s = run_worker_kill(n_workers=workers, rounds=rounds, seed=seed,
+                        kills=kills, quiet=False)
+    print(json.dumps({k: s[k] for k in (
+        "rounds", "ok", "kills", "worker_lost", "partitions_replayed",
+        "heartbeat_misses", "workers_joined", "blocks_shipped")},
+        indent=2, default=str))
+    for f in s["failures"]:
+        print(f"FAILURE: {f}")
+    for leak in s["leaks"]:
+        print(f"LEAK: {leak.splitlines()[0]}")
+    ok = not s["failures"] and not s["leaks"] and s["ok"] == s["rounds"]
+    print("worker-kill sweep:", "OK" if ok else "FAILED")
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seed", type=int, default=7)
@@ -224,8 +259,22 @@ def main():
     ap.add_argument("--pressure", action="store_true",
                     help="sweep sustained overload (governor on, 4x "
                          "capacity, pool shrink) with chaos faults armed")
+    ap.add_argument("--worker-kill", action="store_true",
+                    help="sweep distributed worker churn: SIGKILL/"
+                         "SIGSTOP random workers during a distributed "
+                         "replay, pinning zero wrong answers and zero "
+                         "hard failures")
+    ap.add_argument("--workers", type=int, default=3,
+                    help="worker processes for --worker-kill")
+    ap.add_argument("--rounds", type=int, default=4,
+                    help="replay rounds for --worker-kill")
+    ap.add_argument("--kills", type=int, default=2,
+                    help="kill-armed rounds for --worker-kill")
     args = ap.parse_args()
 
+    if args.worker_kill:
+        return 0 if run_worker_kill_sweep(args.seed, args.workers,
+                                          args.rounds, args.kills) else 1
     if args.pressure:
         return 0 if run_pressure(args.seed) else 1
     if args.corrupt_inputs:

@@ -663,6 +663,185 @@ def run_hot_cache(n_threads: int = 8, rounds: int = 3,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_worker_kill(n_workers: int = 3, rounds: int = 4, seed: int = 7,
+                    kills: int = 2, suspend: bool = True,
+                    rows: int = 60_000, worker_mem: int = 8 << 10,
+                    quiet: bool = False) -> dict:
+    """ISSUE 14: the --worker-kill chaos engine — a distributed join
+    replay over ``n_workers`` worker PROCESSES while random workers are
+    SIGKILLed (and, with ``suspend``, SIGSTOPped) mid-shuffle.  Pins:
+    zero wrong answers and zero hard failures (every round matches the
+    CPU oracle — recovered via re-drive from the producer-side spilled
+    partition queues, or served by the in-process fallback when no
+    worker survives), every armed kill produced a loss declaration, and
+    empty leak reports afterwards.  Stopped workers are SIGCONTed and
+    dead ones replaced between rounds (elastic membership under churn)."""
+    import random
+    import signal
+
+    import numpy as np
+
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu import distributed as D
+    from spark_rapids_tpu.distributed import client as DC
+    from spark_rapids_tpu.lifecycle import leak_report_all
+    from spark_rapids_tpu.session import TpuSession, sum_
+
+    conf = {
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.tpu.distributed.enabled": True,
+        "spark.sql.autoBroadcastJoinThreshold": "-1",
+        "spark.sql.adaptive.enabled": False,
+        "spark.rapids.sql.batchSizeBytes": 64 << 10,
+        "spark.rapids.sql.reader.batchSizeRows": 4000,
+        "spark.rapids.tpu.distributed.heartbeatMs": 100,
+        "spark.rapids.tpu.distributed.workerLostMs": 600,
+        "spark.rapids.tpu.distributed.opTimeoutMs": 800,
+    }
+    rng = random.Random(seed)
+    nrng = np.random.default_rng(seed)
+    n_dim = 500
+    fk = nrng.integers(0, n_dim, rows).tolist()
+    fv = nrng.integers(-100, 100, rows).tolist()
+    dk = list(range(n_dim))
+    dg = [i % 13 for i in range(n_dim)]
+    fact_schema = T.StructType([T.StructField("k", T.INT),
+                                T.StructField("v", T.LONG)])
+    dim_schema = T.StructType([T.StructField("k", T.INT),
+                               T.StructField("g", T.INT)])
+
+    def build(s):
+        fact = s.create_dataframe({"k": fk, "v": fv}, fact_schema)
+        dim = s.create_dataframe({"k": dk, "g": dg}, dim_schema)
+        return (fact.join(dim, on="k", how="inner")
+                .group_by("g").agg(sum_("v", "sv")))
+
+    oracle = sorted(build(
+        TpuSession({"spark.rapids.sql.enabled": False})).collect())
+
+    D.reset_coordinator()
+    coord = D.get_coordinator(TpuConf(conf))
+    procs = {}
+    next_wid = [0]
+
+    def spawn():
+        wid = f"ck{next_wid[0]}"
+        next_wid[0] += 1
+        procs[wid] = D.spawn_local_worker(coord, wid,
+                                          mem_bytes=worker_mem)
+        return wid
+
+    for _ in range(n_workers):
+        spawn()
+    coord.wait_for_workers(n_workers, timeout_s=30)
+
+    snap = PC.snapshot()
+    failures, kill_log, stopped = [], [], []
+    ok = 0
+    kill_rounds = sorted(rng.sample(range(rounds), min(kills, rounds)))
+    try:
+        for r in range(rounds):
+            armed = r in kill_rounds
+            action = None
+            if armed:
+                action = ("suspend" if suspend and rng.random() < 0.5
+                          else "kill")
+            state = {"n": 0, "at": rng.randrange(2, 12), "done": False}
+
+            def hook(exch, pid, seq):
+                state["n"] += 1
+                if not armed or state["done"] \
+                        or state["n"] < state["at"]:
+                    return
+                state["done"] = True
+                live = [w for w, p in procs.items()
+                        if p.poll() is None and w not in stopped]
+                if not live:
+                    return
+                victim = rng.choice(live)
+                if action == "suspend":
+                    os.kill(procs[victim].pid, signal.SIGSTOP)
+                    stopped.append(victim)
+                else:
+                    procs[victim].kill()
+                kill_log.append((r, action, victim))
+
+            DC.TEST_SHIP_HOOK = hook
+            rows_got = None
+            try:
+                rows_got = sorted(build(TpuSession(conf)).collect())
+            except Exception as e:    # noqa: BLE001 — report, don't die
+                # fall through: the churn recovery below must still run
+                # (a frozen victim left SIGSTOPped would cascade this
+                # one failure into every later round)
+                failures.append(f"round {r}: {type(e).__name__}: {e}")
+            finally:
+                DC.TEST_SHIP_HOOK = None
+            if rows_got is not None:
+                if rows_got != oracle:
+                    failures.append(f"round {r}: WRONG ANSWER "
+                                    f"({len(rows_got)} rows)")
+                else:
+                    ok += 1
+            # churn recovery between rounds: resume the stopped, bury
+            # the dead, restore the population with fresh ids
+            for wid in stopped:
+                try:
+                    os.kill(procs[wid].pid, signal.SIGCONT)
+                except OSError:
+                    pass
+            stopped.clear()
+            live = sum(1 for w, p in procs.items()
+                       if p.poll() is None
+                       and coord.worker_state(w) == "ALIVE")
+            for _ in range(n_workers - live):
+                spawn()
+            coord.wait_for_workers(n_workers, timeout_s=20)
+            if not quiet:
+                print(f"round {r}: "
+                      f"ok={rows_got is not None and rows_got == oracle} "
+                      f"action={action or '-'} live={live}")
+        # every armed kill must end in a LOST declaration (the monitor
+        # may still be inside its workerLostMs window for the last one)
+        deadline = time.time() + 10.0
+        for (_r, _a, wid) in kill_log:
+            while coord.worker_state(wid) not in ("LOST", None) \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+        d = PC.since(snap)
+        failures.extend(
+            f"round {r}: {a} of {w} produced no loss declaration"
+            for (r, a, w) in kill_log
+            if coord.worker_state(w) not in ("LOST", None))
+        leaks = leak_report_all()
+        return {
+            "mode": "worker_kill", "rounds": rounds, "ok": ok,
+            "workers": n_workers, "kills": kill_log,
+            "worker_lost": d["worker_lost"],
+            "partitions_replayed": d["partitions_replayed"],
+            "heartbeat_misses": d["worker_heartbeat_misses"],
+            "workers_joined": d["workers_joined"],
+            "blocks_shipped": d["dist_blocks_shipped"],
+            "failures": failures, "leaks": leaks,
+        }
+    finally:
+        DC.TEST_SHIP_HOOK = None
+        for wid in stopped:
+            try:
+                os.kill(procs[wid].pid, signal.SIGCONT)
+            except OSError:
+                pass
+        for p in procs.values():
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        D.reset_coordinator()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--threads", type=int, default=None,
@@ -681,6 +860,17 @@ def main() -> int:
                          "the device pool shrunk to 1/4 mid-run — pins "
                          "zero hard failures, bounded shed rate, and "
                          "bounded recovery to GREEN")
+    ap.add_argument("--worker-kill", action="store_true",
+                    help="ISSUE 14: distributed-join replay over worker "
+                         "processes with random SIGKILL/SIGSTOP chaos — "
+                         "pins zero wrong answers, zero hard failures, "
+                         "a loss declaration per kill, empty leaks "
+                         "(tools/run_chaos.py --worker-kill runs this "
+                         "same engine)")
+    ap.add_argument("--workers", type=int, default=3,
+                    help="worker processes for --worker-kill")
+    ap.add_argument("--kills", type=int, default=2,
+                    help="rounds of --worker-kill that arm a kill")
     ap.add_argument("--limit", type=int, default=4,
                     help="admission capacity for --overload (threads/"
                          "limit = the overcommit factor)")
@@ -693,6 +883,17 @@ def main() -> int:
                          "+ SLO summary to this JSON file; '' disables")
     args = ap.parse_args()
     n_threads = args.threads or (16 if args.overload else 8)
+    if args.worker_kill:
+        s = run_worker_kill(n_workers=args.workers, rounds=args.rounds,
+                            seed=args.seed, kills=args.kills)
+        ok = not s["failures"] and not s["leaks"]
+        print(("PASS" if ok else "FAIL")
+              + f": {s['ok']}/{s['rounds']} rounds correct under "
+              f"{len(s['kills'])} kills ({s['worker_lost']} losses, "
+              f"{s['partitions_replayed']} partitions replayed)")
+        for f in s["failures"]:
+            print(f"FAILURE: {f}")
+        return 0 if ok else 1
     if args.overload:
         s = run_overload(n_threads,
                          args.rounds, limit=args.limit, seed=args.seed,
