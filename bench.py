@@ -1301,6 +1301,34 @@ def main():
                     "rung4_dist: kill armed but no partition was "
                     "re-driven — the loss went unrecovered or the rung "
                     "stopped exercising the distributed path")
+            # cluster-observability overhead A/B (ISSUE 15): the same
+            # distributed query timed with trace propagation ON vs OFF
+            # (no kill — survivors serve both), min of 2 runs per mode;
+            # bench_gate pins the on/off delta <= 5%
+            def timed_dist_collect():
+                t0 = time.perf_counter()
+                r2 = build(TpuSession(conf)).collect()
+                dt = time.perf_counter() - t0
+                assert {int(x[0]): int(x[1]) for x in r2
+                        if x[1]} == want, "rung4_dist A/B WRONG ANSWER"
+                return dt
+
+            trace_on_s = trace_off_s = trace_overhead_pct = None
+            if os.environ.get("BENCH_DIST_TRACE_AB", "1") != "0":
+                prior_trace = coord.trace_enabled
+                try:
+                    coord.trace_enabled = True
+                    trace_on_s = min(timed_dist_collect()
+                                     for _ in range(2))
+                    coord.trace_enabled = False
+                    trace_off_s = min(timed_dist_collect()
+                                      for _ in range(2))
+                    if trace_off_s > 0:
+                        trace_overhead_pct = (
+                            (trace_on_s - trace_off_s)
+                            * 100.0 / trace_off_s)
+                finally:
+                    coord.trace_enabled = prior_trace
             queries["rung4_dist"] = dict(
                 tpu_s=t_tpu, cpu_vec_s=t_vec, cpu_oracle_s=0.0,
                 rows_per_s=n_fact / t_tpu,
@@ -1314,15 +1342,21 @@ def main():
                 partitionsReplayed=float(d["partitions_replayed"]),
                 distBlocksShipped=float(d["dist_blocks_shipped"]),
                 distBlockBytes=float(d["dist_block_bytes"]),
-                workersJoined=float(d["workers_joined"]))
+                workersJoined=float(d["workers_joined"]),
+                traceOnWall_s=trace_on_s, traceOffWall_s=trace_off_s,
+                traceOverheadPct=trace_overhead_pct)
             stream()
+            overhead_note = ("" if trace_overhead_pct is None else
+                             f", trace overhead "
+                             f"{trace_overhead_pct:+.1f}%")
             progress(
                 f"rung4_dist: tpu {t_tpu:.2f}s over "
                 f"{data_bytes / 1e6:.0f}MB vs {worker_mem >> 10}KiB/"
                 f"worker stores "
                 f"(kill={'armed' if kill_armed else 'off'}, "
                 f"lost={d['worker_lost']:.0f}, "
-                f"replayed={d['partitions_replayed']:.0f})")
+                f"replayed={d['partitions_replayed']:.0f}"
+                f"{overhead_note})")
         finally:
             for p in procs.values():
                 try:

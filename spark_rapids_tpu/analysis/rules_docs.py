@@ -420,6 +420,62 @@ def doc_drift_problems(repo_root: str) -> List[str]:
                 f"distributed surface vocabulary {word} is not "
                 f"documented in docs/distributed.md")
 
+    # cluster observability (ISSUE 15): the worker-local counter
+    # vocabulary, the federation gauges, the trace-id contract and the
+    # merged-bundle/trace surfaces must be documented in
+    # docs/cluster_observability.md (worker counters are NOT
+    # perfcounters.COUNTERS — they live in the worker process — so the
+    # global diagnostics.md check cannot see them)
+    from spark_rapids_tpu.distributed.worker import WORKER_COUNTER_KEYS
+
+    cluster_md = read("cluster_observability.md")
+    for key in WORKER_COUNTER_KEYS:
+        if f"`{key}`" not in cluster_md:
+            problems.append(
+                f"worker-local counter '{key}' is not documented in "
+                f"docs/cluster_observability.md")
+    for gauge in ("dist_blocks_unacked",):
+        if f"`{gauge}`" not in cluster_md:
+            problems.append(
+                f"cluster-observability gauge '{gauge}' is not "
+                f"documented in docs/cluster_observability.md")
+    for ev in ("worker_telemetry", "worker_span"):
+        if ev not in EVENT_SCHEMA:
+            problems.append(f"diagnostics event type '{ev}' is not "
+                            f"registered in EVENT_SCHEMA")
+        if f"`{ev}`" not in cluster_md:
+            problems.append(
+                f"cluster-observability event '{ev}' is not "
+                f"documented in docs/cluster_observability.md")
+    if "trace_id" not in EVENT_SCHEMA.get("query_start", []):
+        problems.append(
+            "query_start event is missing the 'trace_id' field (the "
+            "cluster trace contract)")
+    for key in ("dist_worker_dumps", "dist_worker_spans_merged"):
+        if key not in PC.COUNTERS:
+            problems.append(f"cluster-observability counter '{key}' is "
+                            f"not registered in perfcounters.COUNTERS")
+        if f"`{key}`" not in cluster_md:
+            problems.append(
+                f"cluster-observability counter '{key}' is not "
+                f"documented in docs/cluster_observability.md")
+    for word in ("trace id", "`trace`", "`span`", "`dump`",
+                 "clock offset", "heartbeat", "piggyback",
+                 "`worker=`", "history.py", "`/cluster`",
+                 "`--telemetry-out`", "`--workers`",
+                 "traceOverheadPct", "`redrive`", "Perfetto",
+                 "worker_diagnostics", "mint_trace_id"):
+        if word not in cluster_md:
+            problems.append(
+                f"cluster-observability vocabulary {word} is not "
+                f"documented in docs/cluster_observability.md")
+    for name, md in (("distributed.md", dist_md),
+                     ("observability.md", obs_md)):
+        if "cluster_observability.md" not in md:
+            problems.append(
+                f"docs/{name} does not cross-link "
+                f"docs/cluster_observability.md")
+
     # tracelint (ISSUE 11): every lint rule id and the fusibility
     # manifest vocabulary must be documented in docs/static_analysis.md
     from spark_rapids_tpu.analysis.core import all_rule_ids

@@ -368,6 +368,29 @@ DISTRIBUTED_LOSS_BREAKER_THRESHOLD = conf(
     "immediately: it heartbeats but receives no placements until the "
     "resilience breaker TTL admits a re-probe.").long_conf(1)
 
+DISTRIBUTED_TRACE_ENABLED = conf(
+    "spark.rapids.tpu.distributed.traceEnabled").doc(
+    "Cluster-wide trace propagation (ISSUE 15): stamp the query's "
+    "trace id (minted at lifecycle collect start) and the current "
+    "operator's span id on every TKD1 control frame, so worker-side "
+    "work (store puts/fetches, spill, re-drive serves) records into "
+    "the worker-local diagnostics ring attributed to the originating "
+    "query, heartbeats piggyback worker counter/ring deltas, and the "
+    "driver merges driver+worker spans into one Chrome trace.  Off, "
+    "frames carry no trace fields, so workers record no spans and no "
+    "merge runs (counters still federate over heartbeats) — the bench "
+    "rung4_dist A/B pins the on/off overhead <= 5%."
+).boolean_conf(True)
+
+DISTRIBUTED_TELEMETRY_RING = conf(
+    "spark.rapids.tpu.distributed.telemetryRingSize").doc(
+    "Capacity of the worker-local diagnostics ring (span events for "
+    "store puts/fetches/spill/re-drive) AND of the per-worker mirror "
+    "ring the coordinator folds heartbeat-shipped deltas into — the "
+    "mirror is what a SIGKILLed worker's post-mortem bundle contains "
+    "(its 'last-shipped' ring).  0 disables worker span recording "
+    "(counters still federate).").long_conf(512)
+
 # --- resilience (stage-level fault domains) --------------------------------
 
 RESILIENCE_ENABLED = conf("spark.rapids.tpu.resilience.enabled").doc(

@@ -115,11 +115,19 @@ class query_scope:
                 next_query_id,
             )
 
+            # adopt the lifecycle-minted cluster trace id (ISSUE 15) so
+            # the event-log header, the TKD1 frame stamps, and the
+            # worker-span merge below all share one key
+            from spark_rapids_tpu.lifecycle.context import current
+
+            ctx = current()
             diag = QueryDiagnostics(
                 next_query_id(),
                 metrics_level=self._conf.get(METRICS_LEVEL),
                 plan_text=self._plan_text,
-                max_events=int(self._conf.get(DIAGNOSTICS_MAX_EVENTS)))
+                max_events=int(self._conf.get(DIAGNOSTICS_MAX_EVENTS)),
+                trace_id=getattr(ctx, "trace_id", "") if ctx is not None
+                else "")
             diag.register_root(self._root)
             # install + baseline snapshot atomically under the counter
             # lock (counter writes attribute under the same lock), so no
@@ -153,8 +161,38 @@ class query_scope:
             except Exception as e:
                 print("spark_rapids_tpu.diagnostics: finish hook "
                       f"failed: {e}", file=sys.stderr)
+        self._merge_worker_spans()
         self._write_sinks()
         return False
+
+    def _merge_worker_spans(self) -> None:
+        """Fold worker-side spans for this query's trace id into the
+        finished log (ISSUE 15) so the event log and Chrome trace are
+        the MERGED cross-process record.  The coordinator is peeked via
+        sys.modules — the in-process path (distributed never imported
+        or never built) makes zero calls into distributed modules, the
+        cProfile pin in tests/test_cluster_observability.py holds this.
+        ALIVE workers are DUMPed live first so the merge does not stop
+        at the last heartbeat; failures never fail the query."""
+        dist_mod = sys.modules.get("spark_rapids_tpu.distributed")
+        coord = getattr(dist_mod, "_coordinator", None) \
+            if dist_mod is not None else None
+        if coord is None or not self.diag.trace_id \
+                or not getattr(coord, "trace_enabled", False):
+            return
+        if not self.diag.total.get("dist_blocks_shipped"):
+            return   # this query never touched the worker tier
+        try:
+            views = coord.collect_trace(self.diag.trace_id,
+                                        pull_live=True)
+            merged = self.diag.record_worker_spans(views)
+            if merged:
+                from spark_rapids_tpu import perfcounters as PC
+
+                PC.bump_unattributed("dist_worker_spans_merged", merged)
+        except Exception as e:   # observability must never fail a query
+            print("spark_rapids_tpu.diagnostics: worker-span merge "
+                  f"failed: {e}", file=sys.stderr)
 
     def _write_sinks(self) -> None:
         """Atomic per-query flush of the configured sinks; sink I/O

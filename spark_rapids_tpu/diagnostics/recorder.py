@@ -41,7 +41,8 @@ _LEVELS = {"ESSENTIAL": ESSENTIAL, "MODERATE": MODERATE, "DEBUG": DEBUG}
 # Every event also carries: ev, ts_ns, op (the attributed operator path,
 # "" when no operator context was active).
 EVENT_SCHEMA: Dict[str, List[str]] = {
-    "query_start": ["query_id", "started_at", "metrics_level", "plan"],
+    "query_start": ["query_id", "trace_id", "started_at",
+                    "metrics_level", "plan"],
     "launch": ["dur_ns", "compiled"],
     "compile": ["mode", "dur_ns", "label"],
     "sync": ["kind", "dur_ns", "bytes"],
@@ -54,6 +55,10 @@ EVENT_SCHEMA: Dict[str, List[str]] = {
     "governor": ["action", "state", "prev", "pressure", "detail"],
     "distributed": ["kind", "worker_id", "detail", "n_workers",
                     "n_partitions"],
+    "worker_telemetry": ["worker_id", "blocks", "bytes", "mem_used",
+                         "counters"],
+    "worker_span": ["worker_id", "kind", "trace", "span", "exch",
+                    "pid", "seq", "bytes", "dur_ns"],
     "query_stall": ["query_id", "path", "name", "stalled_ms", "detail"],
     "progress": ["query_id", "pct", "eta_ns", "stalls", "background"],
     "op_batch": ["path", "batch", "rows", "dur_ns"],
@@ -122,9 +127,14 @@ class QueryDiagnostics:
     for the duration of the query by ``diagnostics.query_scope``."""
 
     def __init__(self, query_id: str, metrics_level: str = "MODERATE",
-                 plan_text: str = "", max_events: int = 200_000):
+                 plan_text: str = "", max_events: int = 200_000,
+                 trace_id: str = ""):
         self._lock = threading.Lock()
         self.query_id = query_id
+        # the cluster-wide trace id (ISSUE 15): adopted from the
+        # lifecycle QueryContext by query_scope, stamped on every TKD1
+        # frame, and the key worker-side spans merge back under
+        self.trace_id = trace_id
         self.max_events = int(max_events)
         self.dropped_events = 0
         self.level = _LEVELS.get(str(metrics_level).upper(), MODERATE)
@@ -389,6 +399,70 @@ class QueryDiagnostics:
                     n_workers=int(n_workers),
                     n_partitions=int(n_partitions))
 
+    def worker_telemetry(self, worker_id: str, blocks: int, bytes_: int,
+                         mem_used: int, counters: Dict[str, int]) -> None:
+        """One federated heartbeat payload from a worker (ISSUE 15):
+        its store occupancy + cumulative worker-local counters at
+        receipt time — the per-query record of what the cluster's
+        workers were doing while this query ran."""
+        self._event(MODERATE, "worker_telemetry",
+                    worker_id=str(worker_id), blocks=int(blocks),
+                    bytes=int(bytes_), mem_used=int(mem_used),
+                    counters=dict(counters))
+
+    def record_worker_spans(self, views: List[Dict]) -> int:
+        """Merge worker-side span events (ISSUE 15) into this FINISHED
+        query's log: each view is one worker's federated telemetry
+        (``Coordinator.collect_trace`` shape — ring already filtered to
+        this query's trace id, plus the handshake clock offset).  Ring
+        timestamps are worker wall-clock; alignment onto the driver
+        timeline is ``(ts_wall + offset - started_at)`` clamped into
+        the query window.  Runs after ``finish()`` closed the window
+        (like ``record_cost_model``) and keeps query_end last.  Returns
+        the number of spans merged."""
+        events = []
+        for view in views:
+            wid = str(view.get("worker_id", "?"))
+            off = float(view.get("clock_offset_s") or 0.0)
+            for e in view.get("ring", ()):
+                ts_ns = int(((float(e.get("ts_wall", 0.0)) + off)
+                             - self.started_at) * 1e9)
+                events.append({
+                    "ev": "worker_span",
+                    "ts_ns": max(min(ts_ns, self.wall_ns), 0),
+                    "op": e.get("span", "") or "",
+                    "worker_id": wid,
+                    "kind": e.get("kind", "?"),
+                    "trace": e.get("trace", ""),
+                    "span": e.get("span", "") or "",
+                    "exch": int(e.get("exch", -1)),
+                    "pid": int(e.get("pid", -1)),
+                    "seq": int(e.get("seq", -1)),
+                    "bytes": int(e.get("bytes", 0)),
+                    "dur_ns": int(e.get("dur_ns", 0))})
+        if not events:
+            return 0
+        with self._lock:
+            # honor the in-memory bound like every other event: a
+            # many-worker merge must not blow past max_events just
+            # because it lands after finish() (overflow counts into
+            # events_dropped, same as _append_event_locked)
+            room = max(self.max_events - len(self.events), 0)
+            if len(events) > room:
+                self.dropped_events += len(events) - room
+                events = events[:room]
+            at = len(self.events)
+            if self.events and self.events[-1].get("ev") == "query_end":
+                at -= 1
+                # finish() already stamped events_dropped into the
+                # trailing query_end — keep the flushed log's count true
+                self.events[-1]["events_dropped"] = self.dropped_events
+            if not events:
+                return 0
+            self.events[at:at] = events
+            self.n_events = len(self.events)
+        return len(events)
+
     def query_stall(self, query_id: str, path: str, name: str,
                     stalled_ms: float, detail: str = "") -> None:
         """The watchdog's stall scan found no operator advance for
@@ -525,7 +599,8 @@ class QueryDiagnostics:
     def header(self) -> Dict[str, Any]:
         return {
             "ev": "query_start", "ts_ns": 0, "op": "",
-            "query_id": self.query_id, "started_at": self.started_at,
+            "query_id": self.query_id, "trace_id": self.trace_id,
+            "started_at": self.started_at,
             "metrics_level": self.metrics_level,
             "plan": [{"path": p, "name": self.ops[p].name,
                       "describe": self.ops[p].describe}

@@ -16,13 +16,15 @@ from typing import Any, Dict, List, Optional, Tuple
 class QueryProfile:
     """One parsed query log."""
 
-    __slots__ = ("path", "query_id", "started_at", "metrics_level",
+    __slots__ = ("path", "query_id", "trace_id", "started_at",
+                 "metrics_level",
                  "plan", "operators", "events", "totals", "wall_ns",
                  "status", "parse_errors", "events_dropped")
 
     def __init__(self):
         self.path = ""
         self.query_id = ""
+        self.trace_id = ""
         self.started_at = 0.0
         self.metrics_level = ""
         self.plan: List[Dict[str, Any]] = []
@@ -74,6 +76,7 @@ def load_query_log(path: str) -> QueryProfile:
             ev = e.get("ev")
             if ev == "query_start":
                 qp.query_id = e.get("query_id", "")
+                qp.trace_id = e.get("trace_id", "")
                 qp.started_at = e.get("started_at", 0.0)
                 qp.metrics_level = e.get("metrics_level", "")
                 qp.plan = e.get("plan", [])
@@ -103,7 +106,38 @@ def expand_log_paths(paths: List[str]) -> List[str]:
 
 
 def load_logs(paths: List[str]) -> List[QueryProfile]:
-    return [load_query_log(p) for p in expand_log_paths(paths)]
+    return attach_worker_spans(
+        [load_query_log(p) for p in expand_log_paths(paths)])
+
+
+def attach_worker_spans(
+        profiles: List[QueryProfile]) -> List[QueryProfile]:
+    """Multi-process event logs (ISSUE 15): a file with no
+    ``query_start`` whose events are worker spans (a worker-ring dump,
+    a chaos harness timeline) is not a query — its spans attach to the
+    loaded query whose trace id they carry, instead of surfacing as an
+    anonymous empty profile (the old behavior: dropped as unknown
+    operators).  Spans naming no loaded trace stay behind on the
+    anonymous profile so nothing is silently discarded."""
+    by_trace = {qp.trace_id: qp for qp in profiles
+                if qp.query_id and qp.trace_id}
+    out = []
+    for qp in profiles:
+        if qp.query_id or not qp.events:
+            out.append(qp)
+            continue
+        orphans = []
+        for e in qp.events:
+            owner = by_trace.get(e.get("trace")) \
+                if e.get("ev") == "worker_span" else None
+            if owner is not None:
+                owner.events.append(e)
+            else:
+                orphans.append(e)
+        if orphans or qp.parse_errors:
+            qp.events = orphans
+            out.append(qp)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -210,6 +244,73 @@ def render_stalls(summary: Dict[str, Any]) -> str:
         out.append(f"    {e['query']}: {e['stalled_ms']:.0f}ms in "
                    f"{e['op']}" + (f" at {e['path']}" if e["path"]
                                    else ""))
+    return "\n".join(out)
+
+
+def workers_summary(profiles: List[QueryProfile]) -> Dict[str, Any]:
+    """Aggregate cluster-observability events (ISSUE 15): worker spans
+    grouped by worker and by owning query (trace id), plus each
+    worker's last federated counter snapshot — the offline companion
+    of the live per-worker labeled series."""
+    by_worker: Dict[str, Dict[str, Any]] = {}
+    queries = set()
+    for qp in profiles:
+        for e in qp.events:
+            ev = e.get("ev")
+            if ev == "worker_span":
+                wid = e.get("worker_id", "?")
+                a = by_worker.setdefault(wid, {
+                    "spans": 0, "bytes": 0, "wall_ns": 0,
+                    "by_kind": {}, "queries": set(), "counters": {}})
+                a["spans"] += 1
+                a["bytes"] += int(e.get("bytes", 0) or 0)
+                a["wall_ns"] += int(e.get("dur_ns", 0) or 0)
+                kind = e.get("kind", "?")
+                a["by_kind"][kind] = a["by_kind"].get(kind, 0) + 1
+                a["queries"].add(qp.query_id or e.get("trace", "?"))
+                queries.add(qp.query_id or qp.path)
+            elif ev == "worker_telemetry":
+                wid = e.get("worker_id", "?")
+                a = by_worker.setdefault(wid, {
+                    "spans": 0, "bytes": 0, "wall_ns": 0,
+                    "by_kind": {}, "queries": set(), "counters": {}})
+                a["counters"] = e.get("counters") or {}
+                a["queries"].add(qp.query_id or qp.path)
+    workers = {}
+    for wid, a in sorted(by_worker.items()):
+        workers[wid] = {
+            "spans": a["spans"], "bytes": a["bytes"],
+            "wall_ns": a["wall_ns"],
+            "by_kind": dict(sorted(a["by_kind"].items())),
+            "queries": sorted(a["queries"]),
+            "counters": a["counters"]}
+    return {"workers": workers,
+            "total_spans": sum(a["spans"] for a in workers.values()),
+            "queries_with_workers": len(queries)}
+
+
+def render_workers(summary: Dict[str, Any]) -> str:
+    out = [f"== distributed workers: {len(summary['workers'])} worker"
+           f"{'' if len(summary['workers']) == 1 else 's'}, "
+           f"{summary['total_spans']} span"
+           f"{'' if summary['total_spans'] == 1 else 's'} across "
+           f"{summary['queries_with_workers']} quer"
+           f"{'y' if summary['queries_with_workers'] == 1 else 'ies'} =="]
+    for wid, a in summary["workers"].items():
+        kinds = ", ".join(f"{k}={v}" for k, v in a["by_kind"].items())
+        out.append(f"  {wid:<12} {a['spans']:5d} spans  "
+                   f"{_fmt_bytes(a['bytes']):>10}  "
+                   f"{a['wall_ns'] / 1e6:8.1f}ms  [{kinds}]  "
+                   f"({len(a['queries'])} quer"
+                   f"{'y' if len(a['queries']) == 1 else 'ies'})")
+        c = a["counters"]
+        if c:
+            out.append(
+                f"    counters: puts={c.get('store_puts', 0)} "
+                f"redrive={c.get('store_redrive_puts', 0)} "
+                f"fetches={c.get('store_fetches', 0)} "
+                f"served={_fmt_bytes(c.get('store_bytes_served', 0))} "
+                f"overflow={_fmt_bytes(c.get('store_overflow_bytes', 0))}")
     return "\n".join(out)
 
 
@@ -324,6 +425,13 @@ def render_report(profiles: List[QueryProfile], top_n: int = 10) -> str:
             out.append(f"  {kk}: x{v}")
     else:
         out.append("resilience: clean (no retries/fallbacks/trips)")
+
+    # distributed workers (ISSUE 15): merged worker spans grouped by
+    # trace id under their owning queries
+    ws = workers_summary(profiles)
+    if ws["workers"]:
+        out.append("")
+        out.append(render_workers(ws))
 
     def section(title, by, fmt):
         ranked = top_operators(profiles, by=by, n=top_n)

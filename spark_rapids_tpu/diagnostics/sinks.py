@@ -88,6 +88,16 @@ def trace_pid(query_id: str) -> int:
     return (zlib.crc32(query_id.encode("utf-8")) & 0x3FFFFFFF) or 1
 
 
+def worker_trace_pid(worker_id: str) -> int:
+    """Stable per-WORKER trace pid (ISSUE 15), disjoint from the query
+    pid space (high bit set): a merged cross-process trace renders the
+    driver and every worker as distinct Perfetto process groups."""
+    import zlib
+
+    return 0x40000000 | (zlib.crc32(
+        worker_id.encode("utf-8")) & 0x3FFFFFFF)
+
+
 def chrome_trace(diag: QueryDiagnostics) -> Dict[str, Any]:
     """Build the Chrome trace-event dict for one finished query."""
     pid = trace_pid(diag.query_id)
@@ -124,11 +134,38 @@ def chrome_trace(diag: QueryDiagnostics) -> Dict[str, Any]:
     # point/duration events nested on their operator's track
     with diag._lock:
         events = list(diag.events)
+    # worker processes (ISSUE 15): each worker that contributed merged
+    # `worker_span` events renders as its OWN process group, pid from
+    # worker_trace_pid, timestamps already clock-offset-aligned onto
+    # the driver timeline by record_worker_spans
+    worker_pids: Dict[str, int] = {}
+    for e in events:
+        if e.get("ev") != "worker_span":
+            continue
+        wid = e.get("worker_id", "?")
+        if wid not in worker_pids:
+            wpid = worker_trace_pid(wid)
+            worker_pids[wid] = wpid
+            emit({"ph": "M", "name": "process_name", "pid": wpid,
+                  "tid": 0, "ts": 0, "args": {"name": f"worker {wid}"}})
+            emit({"ph": "M", "name": "thread_name", "pid": wpid,
+                  "tid": 0, "ts": 0, "args": {"name": "store"}})
     for e in events:
         ev = e.get("ev")
         tid = tids.get(e.get("op") or "", tids.get("", 0))
         ts_us = e.get("ts_ns", 0) / 1e3
-        if ev == "launch":
+        if ev == "worker_span":
+            wpid = worker_pids[e.get("worker_id", "?")]
+            emit({"ph": "X", "name": f"worker:{e.get('kind', '?')}",
+                  "pid": wpid, "tid": 0, "ts": ts_us,
+                  "dur": e.get("dur_ns", 0) / 1e3,
+                  "args": {"trace": e.get("trace", ""),
+                           "span": e.get("span", ""),
+                           "exch": e.get("exch", -1),
+                           "pid": e.get("pid", -1),
+                           "seq": e.get("seq", -1),
+                           "bytes": e.get("bytes", 0)}})
+        elif ev == "launch":
             emit({"ph": "X", "name": "launch", "pid": pid, "tid": tid,
                   "ts": ts_us, "dur": e["dur_ns"] / 1e3,
                   "args": {"compiled": e["compiled"]}})
@@ -166,6 +203,7 @@ def chrome_trace(diag: QueryDiagnostics) -> Dict[str, Any]:
         del ev["_seq"]
     return {"traceEvents": trace, "displayTimeUnit": "ms",
             "otherData": {"query_id": diag.query_id,
+                          "trace_id": diag.trace_id,
                           "metrics_level": diag.metrics_level}}
 
 

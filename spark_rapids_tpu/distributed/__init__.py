@@ -75,6 +75,7 @@ def spawn_local_worker(coordinator: Coordinator, worker_id: str,
                        spill_dir: Optional[str] = None,
                        warm_compile_dir: Optional[str] = None,
                        op_timeout_ms: Optional[int] = None,
+                       telemetry_ring: Optional[int] = None,
                        extra_env: Optional[dict] = None
                        ) -> subprocess.Popen:
     """Launch one worker PROCESS against the given coordinator (tests,
@@ -85,12 +86,15 @@ def spawn_local_worker(coordinator: Coordinator, worker_id: str,
         else int(coordinator.heartbeat_s * 1000)
     ot = op_timeout_ms if op_timeout_ms is not None \
         else int(coordinator.op_timeout_s * 1000)
+    ring = telemetry_ring if telemetry_ring is not None \
+        else getattr(coordinator, "telemetry_ring", 512)
     cmd = [sys.executable, "-m", "spark_rapids_tpu.distributed.worker",
            "--coordinator", f"127.0.0.1:{coordinator.port}",
            "--worker-id", worker_id,
            "--mem-bytes", str(int(mem_bytes)),
            "--heartbeat-ms", str(hb),
-           "--op-timeout-ms", str(ot)]
+           "--op-timeout-ms", str(ot),
+           "--telemetry-ring", str(int(ring))]
     if spill_dir:
         cmd += ["--spill-dir", spill_dir]
     if warm_compile_dir:

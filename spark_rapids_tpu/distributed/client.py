@@ -129,8 +129,11 @@ class DistributedExchange:
             seq = 0
             while seq < len(blobs):
                 try:
+                    # redrive-flagged: the worker counts the replay
+                    # (store_redrive_puts) and records a `redrive_put`
+                    # span, so recovery traffic is visible cluster-wide
                     self.coord.put_block(self.exch_id, pid, seq,
-                                         blobs[seq])
+                                         blobs[seq], redrive=True)
                     seq += 1
                 except WorkerLost:
                     # the replacement died too: budget-check, fold ITS

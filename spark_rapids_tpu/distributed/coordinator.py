@@ -40,6 +40,7 @@ import json
 import socket
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Set, Tuple
 
 from spark_rapids_tpu import perfcounters as PC
@@ -59,11 +60,13 @@ BREAKER_OP = "DistributedWorker"
 class WorkerInfo:
     __slots__ = ("worker_id", "host", "data_port", "pid", "mem_bytes",
                  "state", "last_hb", "joined_at", "control",
-                 "hb_missed", "probe_failed", "warmed_entries")
+                 "hb_missed", "probe_failed", "warmed_entries",
+                 "counters", "store_stats", "mirror", "mirror_last_n",
+                 "clock_offset_s")
 
     def __init__(self, worker_id: str, host: str, data_port: int,
                  pid: int, mem_bytes: int, control: socket.socket,
-                 warmed_entries: int = 0):
+                 warmed_entries: int = 0, mirror_capacity: int = 512):
         self.worker_id = worker_id
         self.host = host
         self.data_port = data_port
@@ -76,6 +79,18 @@ class WorkerInfo:
         self.hb_missed = False
         self.probe_failed = False
         self.warmed_entries = warmed_entries
+        # federated telemetry (ISSUE 15): the worker's latest
+        # heartbeat-reported counter snapshot + store stats, the mirror
+        # of its diagnostics ring (what a SIGKILLed worker's post-mortem
+        # contains), the ring sequence already folded (heartbeat deltas
+        # and full `dump` pulls both dedup on it), and the
+        # handshake-estimated clock offset (driver wall - worker wall;
+        # min over samples, so one slow frame cannot skew it)
+        self.counters: Dict[str, int] = {}
+        self.store_stats: Dict[str, int] = {}
+        self.mirror: deque = deque(maxlen=max(int(mirror_capacity), 1))
+        self.mirror_last_n = 0
+        self.clock_offset_s: Optional[float] = None
 
 
 class Coordinator:
@@ -88,6 +103,8 @@ class Coordinator:
             DISTRIBUTED_LOSS_BREAKER_THRESHOLD,
             DISTRIBUTED_OP_TIMEOUT_MS,
             DISTRIBUTED_PUT_RETRIES,
+            DISTRIBUTED_TELEMETRY_RING,
+            DISTRIBUTED_TRACE_ENABLED,
             DISTRIBUTED_WORKER_LOST_MS,
             RESILIENCE_BREAKER_TTL_SEC,
             get_conf,
@@ -104,6 +121,8 @@ class Coordinator:
         self.breaker_threshold = int(
             c.get(DISTRIBUTED_LOSS_BREAKER_THRESHOLD))
         self.breaker_ttl_s = float(c.get(RESILIENCE_BREAKER_TTL_SEC))
+        self.trace_enabled = bool(c.get(DISTRIBUTED_TRACE_ENABLED))
+        self.telemetry_ring = int(c.get(DISTRIBUTED_TELEMETRY_RING))
 
         self._lock = threading.Lock()
         self._workers: Dict[str, WorkerInfo] = {}
@@ -127,6 +146,17 @@ class Coordinator:
         self._holdings: Dict[Tuple[int, int], int] = {}
         # pids a loss re-placed, awaiting producer re-drive
         self._redrives: Dict[int, Set[int]] = {}
+        # put-receipt reconciliation (ISSUE 15): blocks this coordinator
+        # shipped vs blocks workers REPORT having received (heartbeat
+        # counters: store_puts + store_put_dedups).  A rejoin resets a
+        # worker's counters, so the superseded incarnation's last report
+        # retires into _acked_retired.  gauges() surfaces the difference
+        # as `dist_blocks_unacked` — nonzero past heartbeat lag means
+        # frames the CRC can't flag because they never arrived at all
+        # (or a dead worker's unreported tail, exactly what re-drive
+        # re-ships).
+        self._shipped_blocks = 0
+        self._acked_retired = 0
         # data-plane connections (shared by put/fetch/release), one per
         # worker, serialized by a per-worker lock
         self._conns: Dict[str, socket.socket] = {}
@@ -182,7 +212,7 @@ class Coordinator:
                 msg, _ = P.recv_msg(conn)
                 op = msg.get("op")
                 if op == "heartbeat":
-                    self._heartbeat(wid)
+                    self._heartbeat(wid, msg)
                 elif op == "goodbye":
                     self._leave(wid)
                     return
@@ -213,7 +243,13 @@ class Coordinator:
         info = WorkerInfo(wid, host, int(header["data_port"]),
                           int(header.get("pid", 0)),
                           int(header.get("mem_bytes", 1 << 20)), conn,
-                          int(header.get("warmed_entries", 0)))
+                          int(header.get("warmed_entries", 0)),
+                          mirror_capacity=self.telemetry_ring)
+        if "t_wall" in header:
+            # clock-offset handshake: driver receipt wall minus worker
+            # send wall.  Overestimates by the one-way frame latency;
+            # heartbeats refine it (min over samples, see _fold below)
+            info.clock_offset_s = time.time() - float(header["t_wall"])
         # flapping-worker quarantine: a worker id whose loss history
         # holds the breaker OPEN joins QUARANTINED (heartbeats, but is
         # never placed) until the TTL re-probe admits it again
@@ -223,6 +259,13 @@ class Coordinator:
             info.state = QUARANTINED
         with self._lock:
             old = self._workers.get(wid)
+            if old is not None and old.counters:
+                # the superseded incarnation's put receipts retire into
+                # the running total — the rejoined process restarts its
+                # counters at zero
+                self._acked_retired += (
+                    int(old.counters.get("store_puts", 0))
+                    + int(old.counters.get("store_put_dedups", 0)))
             self._workers[wid] = info
             self._conn_locks.setdefault(wid, threading.Lock())
             # a rejoin supersedes the old connection; drop any stale
@@ -245,7 +288,8 @@ class Coordinator:
         self._flight_event("worker_joined", worker_id=wid,
                            state=info.state)
 
-    def _heartbeat(self, wid: str) -> None:
+    def _heartbeat(self, wid: str, msg: Optional[Dict] = None) -> None:
+        tel = None
         with self._lock:
             w = self._workers.get(wid)
             if w is not None:
@@ -255,6 +299,45 @@ class Coordinator:
                 # a quarantined worker re-probes via consult() in
                 # placeable_workers(); heartbeats alone never un-lose a
                 # LOST worker (it must rejoin with a fresh HELLO)
+                if msg is not None:
+                    tel = self._fold_telemetry_locked(w, msg)
+        if tel is not None:
+            # one ambient check: a recorded query sees the federation
+            # arrive as `worker_telemetry` diagnostics events
+            from spark_rapids_tpu.diagnostics import context as _DIAG
+
+            rec = _DIAG.RECORDER
+            if rec is not None:
+                rec.worker_telemetry(wid, tel["blocks"], tel["bytes"],
+                                     tel["mem_used"], tel["counters"])
+
+    def _fold_telemetry_locked(self, w: WorkerInfo,
+                               msg: Dict) -> Optional[Dict]:
+        """Fold one heartbeat/dump payload into the worker's federated
+        state (caller holds self._lock).  Returns the summary for the
+        diagnostics event, or None when the payload carried no
+        telemetry (an old-protocol worker)."""
+        counters = msg.get("counters")
+        if counters is None and "ring" not in msg:
+            return None
+        if isinstance(counters, dict):
+            w.counters = {k: int(v) for k, v in counters.items()}
+        w.store_stats = {k: int(msg[k]) for k in
+                         ("blocks", "bytes", "mem_used", "spilled_blocks",
+                          "partitions") if k in msg}
+        for e in msg.get("ring") or ():
+            n = int(e.get("n", 0))
+            if n > w.mirror_last_n:
+                w.mirror.append(e)
+                w.mirror_last_n = n
+        if "t_wall" in msg:
+            off = time.time() - float(msg["t_wall"])
+            if w.clock_offset_s is None or off < w.clock_offset_s:
+                w.clock_offset_s = off
+        return {"blocks": int(msg.get("blocks", 0)),
+                "bytes": int(msg.get("bytes", 0)),
+                "mem_used": int(msg.get("mem_used", 0)),
+                "counters": dict(w.counters)}
 
     def _leave(self, wid: str) -> None:
         with self._lock:
@@ -598,6 +681,28 @@ class Coordinator:
                 self.declare_lost(wid, f"{type(e).__name__}: {e}")
                 raise WorkerLost(wid, f"{type(e).__name__}: {e}") from e
 
+    def _trace_fields(self) -> Dict:
+        """The trace/span stamp for one outgoing data-plane header
+        (ISSUE 15): the active query's trace id (minted at lifecycle
+        collect start) and the diagnostics current-operator path.  Empty
+        when tracing is off or no lifecycle-managed query is active —
+        the worker then records counters but no attributed spans."""
+        if not self.trace_enabled:
+            return {}
+        from spark_rapids_tpu.lifecycle.context import current
+
+        ctx = current()
+        if ctx is None:
+            return {}
+        fields = {"trace": getattr(ctx, "trace_id", "") or ctx.query_id}
+        from spark_rapids_tpu.diagnostics import context as _DIAG
+
+        span = _DIAG.CURRENT_OP.get() if _DIAG.RECORDER is not None \
+            else None
+        if span:
+            fields["span"] = span
+        return fields
+
     def _ensure_live_owner(self, exch: int, pid: int) -> str:
         """The partition's owner, re-placed first if a concurrent loss
         left it mapped to a dead worker (the dead worker's own
@@ -618,19 +723,26 @@ class Coordinator:
         return wid
 
     def put_block(self, exch: int, pid: int, seq: int,
-                  blob: bytes) -> str:
+                  blob: bytes, redrive: bool = False) -> str:
         """Ship one block to the partition's current owner; returns the
         owner id (raises WorkerLost when the owner died and retries
-        were exhausted — the caller re-drives after re-placement)."""
+        were exhausted — the caller re-drives after re-placement).
+        ``redrive=True`` marks a lineage replay so the worker's
+        `store_redrive_puts` counter (and its `redrive_put` span kind)
+        makes recovery traffic countable on the worker side."""
         wid = self._ensure_live_owner(exch, pid)
-        self._request(wid, {"op": "put", "exch": self._wire(exch),
-                            "pid": pid, "seq": seq}, [blob])
+        header = {"op": "put", "exch": self._wire(exch),
+                  "pid": pid, "seq": seq, **self._trace_fields()}
+        if redrive:
+            header["redrive"] = 1
+        self._request(wid, header, [blob])
         with self._lock:
             # distinct-block count, not send count: replays re-send
             # sequences the worker's idempotent store deduplicates, and
             # inflated holdings would skew re-placement load weighting
             self._holdings[(exch, pid)] = max(
                 self._holdings.get((exch, pid), 0), seq + 1)
+            self._shipped_blocks += 1
         PC.bump("dist_blocks_shipped")
         PC.bump("dist_block_bytes", len(blob))
         return wid
@@ -646,13 +758,117 @@ class Coordinator:
         wid = self._ensure_live_owner(exch, pid)
         rep, blobs = self._request(
             wid, {"op": "fetch", "exch": self._wire(exch), "pid": pid,
-                  "after_seq": after_seq, "max_bytes": max_bytes})
+                  "after_seq": after_seq, "max_bytes": max_bytes,
+                  **self._trace_fields()})
         return ([int(s) for s in rep.get("seqs", [])], blobs,
                 int(rep.get("n_total", len(blobs))))
 
     def worker_stats(self, wid: str) -> Dict:
         rep, _ = self._request(wid, {"op": "stats"})
         return rep
+
+    # -- federated telemetry (ISSUE 15) ---------------------------------
+    def dump_worker(self, wid: str) -> Optional[Dict]:
+        """Pull one LIVE worker's full telemetry via the DUMP control
+        op and fold it into the mirror.  Runs on a FRESH connection
+        with no loss-declaration side effects (observability must never
+        kill membership — a slow dump is just a None).  Returns the
+        folded view (counters + full mirror ring + clock offset) or
+        None when the worker is gone/slow."""
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is None or w.state in (LOST, LEFT):
+                return None
+            host, port = w.host, w.data_port
+        try:
+            s = P.connect(host, port, self.op_timeout_s)
+            try:
+                rep, _ = P.request(s, {"op": "dump",
+                                       **self._trace_fields()})
+            finally:
+                s.close()
+        except (OSError, ConnectionError, RuntimeError,
+                P.ProtocolCorruption):
+            return None
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is None:
+                return None
+            self._fold_telemetry_locked(w, rep)
+            view = self._worker_view_locked(w)
+        PC.bump("dist_worker_dumps")
+        return view
+
+    def _worker_view_locked(self, w: WorkerInfo,
+                            trace_id: Optional[str] = None) -> Dict:
+        ring = [e for e in w.mirror
+                if not trace_id or e.get("trace") == trace_id]
+        return {"worker_id": w.worker_id, "state": w.state,
+                "pid": w.pid, "clock_offset_s": w.clock_offset_s,
+                "counters": dict(w.counters),
+                "store_stats": dict(w.store_stats),
+                "ring": ring}
+
+    def collect_trace(self, trace_id: Optional[str] = None,
+                      pull_live: bool = False) -> List[Dict]:
+        """Every worker's federated telemetry view, ring filtered to
+        ``trace_id`` when given.  ``pull_live`` first DUMPs each ALIVE
+        worker so the view includes spans newer than the last heartbeat
+        (the query-end merge uses this; LOST workers contribute their
+        last-shipped mirror — the whole point of the piggyback)."""
+        if pull_live:
+            with self._lock:
+                live = [w.worker_id for w in self._workers.values()
+                        if w.state == ALIVE]
+            for wid in live:
+                self.dump_worker(wid)
+        out = []
+        with self._lock:
+            for w in self._workers.values():
+                view = self._worker_view_locked(w, trace_id)
+                if view["ring"] or view["counters"]:
+                    out.append(view)
+        return out
+
+    def worker_telemetry(self) -> Dict[str, Dict]:
+        """Per-worker federated counter snapshots for the sampler fold
+        (peek-only: latest heartbeat-reported values, no network)."""
+        with self._lock:
+            return {w.worker_id: {"state": w.state,
+                                  "counters": dict(w.counters),
+                                  "store_stats": dict(w.store_stats),
+                                  "clock_offset_s": w.clock_offset_s}
+                    for w in self._workers.values() if w.counters}
+
+    def postmortem_worker(self, wid: str, detail: str = "") -> Optional[Dict]:
+        """On-demand merged post-mortem (the DUMP-op twin of the
+        worker-loss bundle): pull the worker's ring + counters and dump
+        a flight-recorder bundle naming it.  Returns the bundle or None
+        (telemetry off / worker gone with an empty mirror)."""
+        from spark_rapids_tpu.telemetry import context as TEL
+
+        hub = TEL.HUB
+        if hub is None:
+            return None
+        view = self.dump_worker(wid)
+        if view is None:
+            with self._lock:
+                w = self._workers.get(wid)
+                view = self._worker_view_locked(w) if w is not None \
+                    else None
+        if view is None:
+            return None
+        try:
+            return hub.postmortem(
+                "worker_dump", detail=detail or wid, force=True,
+                extra={"worker_id": wid, "worker_diagnostics": view,
+                       "trace_ids": sorted(
+                           {e.get("trace") for e in view["ring"]
+                            if e.get("trace")})})
+        # tpulint: disable=cancel-swallow (telemetry isolation: a dump
+        # failure must never break the caller)
+        except Exception:
+            return None
 
     def note_worker_ok(self, wid: str) -> None:
         """A probed (previously quarantined) worker served successfully:
@@ -677,7 +893,8 @@ class Coordinator:
             wire = self._wire_of.pop(exch, exch)
         for wid in sorted(owners):
             try:
-                self._request(wid, {"op": "release", "exch": wire},
+                self._request(wid, {"op": "release", "exch": wire,
+                                    **self._trace_fields()},
                               cancellable=False)
             except (WorkerLost, RuntimeError, OSError):
                 # a dead/slow worker cannot hold up query cleanup; its
@@ -728,9 +945,11 @@ class Coordinator:
                 pass
 
     def _postmortem(self, wid: str, reason: str, plan: List[Dict]) -> None:
-        """The worker-loss flight-recorder bundle: placement table +
-        re-drive plan, so the first thing an operator opens says what
-        was where and what is being replayed."""
+        """The worker-loss flight-recorder bundle: the driver's view
+        (placement table + re-drive plan + membership) MERGED with the
+        lost worker's last-shipped diagnostics ring + counter snapshot
+        (ISSUE 15) — a SIGKILLed process cannot answer a DUMP, so what
+        its heartbeats already piggybacked is the post-mortem."""
         from spark_rapids_tpu.telemetry import context as TEL
 
         hub = TEL.HUB
@@ -745,30 +964,48 @@ class Coordinator:
                         "host": w.host, "data_port": w.data_port,
                         "pid": w.pid}
                        for w in self._workers.values()]
+            lost = self._workers.get(wid)
+            diagnostics = self._worker_view_locked(lost) \
+                if lost is not None else None
+        trace_ids = sorted({e.get("trace")
+                            for e in (diagnostics or {}).get("ring", [])
+                            if e.get("trace")})
         try:
             hub.postmortem(
                 "worker_lost", detail=f"{wid}: {reason}", force=True,
                 extra={"worker_id": wid,
                        "placement_table": placement,
                        "redrive_plan": plan,
-                       "membership": members})
+                       "membership": members,
+                       "worker_diagnostics": diagnostics,
+                       "trace_ids": trace_ids})
         # tpulint: disable=cancel-swallow (telemetry isolation: a dump
         # failure must never break loss recovery)
         except Exception:
             pass
 
     def gauges(self) -> Dict[str, float]:
-        """Sampler hook (peek-only): live worker count + re-placement
-        backlog."""
+        """Sampler hook (peek-only): live worker count, re-placement
+        backlog, and the put-receipt drift (ISSUE 15)."""
         with self._lock:
             live = sum(1 for w in self._workers.values()
                        if w.state == ALIVE)
             quarantined = sum(1 for w in self._workers.values()
                               if w.state == QUARANTINED)
             backlog = sum(len(v) for v in self._redrives.values())
+            acked = self._acked_retired + sum(
+                int(w.counters.get("store_puts", 0))
+                + int(w.counters.get("store_put_dedups", 0))
+                for w in self._workers.values())
+            unacked = max(self._shipped_blocks - acked, 0)
         return {"dist_workers_live": float(live),
                 "dist_workers_quarantined": float(quarantined),
-                "dist_replacement_backlog": float(backlog)}
+                "dist_replacement_backlog": float(backlog),
+                # shipped-but-never-reported blocks: transiently nonzero
+                # within one heartbeat of shipping; persistently nonzero
+                # means silent frame loss (or a dead worker's unreported
+                # tail — cross-check worker_lost)
+                "dist_blocks_unacked": float(unacked)}
 
     def describe(self) -> str:
         with self._lock:

@@ -29,6 +29,21 @@ frames.  Failure taxonomy (consumed by ``resilience/classify.py``):
     classifies as the WORKER_LOST class, which triggers partition
     re-placement + re-drive rather than per-batch backoff.
 
+Trace propagation (ISSUE 15, docs/cluster_observability.md): every
+data-plane header MAY carry two optional fields the driver stamps when
+``spark.rapids.tpu.distributed.traceEnabled`` is on —
+
+  * ``trace`` — the originating query's cluster-wide trace id (minted
+    by ``lifecycle.context.mint_trace_id`` at collect start and echoed
+    in the query's diagnostics event-log header), and
+  * ``span``  — the driver-side operator path ("0.1") current when the
+    frame was sent (the diagnostics contextvar).
+
+Workers copy both into their local diagnostics ring, so worker-side
+work attributes to exactly one collect across processes; a header
+without them is valid (tracing off / non-query tooling) and records
+counters only.  ``redrive: 1`` on a put marks a lineage replay.
+
 This module is deliberately dependency-light (stdlib only) so worker
 processes can import it before paying for the full engine import.
 """

@@ -18,7 +18,19 @@ heartbeats):
   fetch   {exch, pid} -> {seqs}+blobs       every block of one partition
   release {exch} -> {ok}                    drop one exchange's blocks
   stats   {} -> {blocks, bytes, ...}        introspection
+  dump    {} -> {counters, ring, ...}       full telemetry pull (ISSUE 15)
   ping    {} -> {ok}
+
+Cluster observability (ISSUE 15, docs/cluster_observability.md): every
+data-plane op bumps WORKER-LOCAL counters (:data:`WORKER_COUNTER_KEYS` —
+plain dict, no engine import: worker processes must stay light) and,
+when the header carries ``trace``/``span`` fields (the driver stamps the
+query's trace id + current-operator span id on every frame), records a
+span event into a bounded worker-local diagnostics ring.  Heartbeats
+piggyback the cumulative counter snapshot + the ring entries recorded
+since the previous heartbeat + ``t_wall`` (the clock-offset handshake),
+so the coordinator's mirror holds a SIGKILLed worker's last-shipped
+telemetry; the ``dump`` op pulls the full live ring on demand.
 
 Run as a process:
 
@@ -42,9 +54,88 @@ import socket
 import sys
 import tempfile
 import threading
+import time
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from spark_rapids_tpu.distributed import protocol as P
+
+# the worker-local counter vocabulary (docs/cluster_observability.md —
+# the doc-drift rule pins every key documented).  Deliberately NOT
+# perfcounters.COUNTERS: these live in the WORKER process, which must
+# import nothing heavier than stdlib + protocol, and they cross to the
+# driver only as heartbeat-piggybacked snapshots the coordinator folds
+# into per-worker labeled registry series.
+WORKER_COUNTER_KEYS = (
+    "store_puts",            # blocks landed (idempotent dedups excluded)
+    "store_put_bytes",       # bytes landed
+    "store_put_dedups",      # idempotent re-sends dropped (seq existed)
+    "store_redrive_puts",    # puts flagged as lineage re-drives
+    "store_fetches",         # fetch pages served
+    "store_blocks_served",   # blocks returned across fetch pages
+    "store_bytes_served",    # bytes returned across fetch pages
+    "store_overflow_blocks",  # puts that overflowed memory to disk
+    "store_overflow_bytes",  # bytes written to the spill directory
+    "put_wall_ns",           # wall inside put handling
+    "fetch_wall_ns",         # wall inside fetch handling (page walls)
+)
+
+
+class WorkerTelemetry:
+    """Worker-local counters + bounded diagnostics span ring.
+
+    The ring holds one event per traced data-plane op:
+    ``{"n": ring-seq, "kind": put|redrive_put|spill|fetch|release,
+    "trace": query trace id, "span": driver operator path, "exch",
+    "pid", "seq": block seq (-1 when n/a), "bytes", "ts_wall":
+    time.time() at op start, "dur_ns"}``.  ``n`` is monotonic per
+    worker incarnation so heartbeat deltas and full ``dump`` pulls
+    deduplicate on the coordinator's mirror."""
+
+    def __init__(self, ring_capacity: int = 512):
+        self._lock = threading.Lock()
+        self.ring_capacity = max(int(ring_capacity), 0)
+        self.counters: Dict[str, int] = {k: 0 for k in WORKER_COUNTER_KEYS}
+        self._ring: deque = deque(maxlen=self.ring_capacity or 1)
+        self._seq = 0
+        self._last_shipped = 0     # ring seq already heartbeat-shipped
+
+    def bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0) + n
+
+    def span(self, kind: str, trace: str, span: str, exch: int,
+             pid: int, seq: int, nbytes: int, ts_wall: float,
+             dur_ns: int) -> None:
+        if self.ring_capacity <= 0:
+            return
+        with self._lock:
+            self._seq += 1
+            self._ring.append({
+                "n": self._seq, "kind": kind, "trace": trace,
+                "span": span, "exch": int(exch), "pid": int(pid),
+                "seq": int(seq), "bytes": int(nbytes),
+                "ts_wall": round(float(ts_wall), 6),
+                "dur_ns": int(dur_ns)})
+
+    def counters_snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.counters)
+
+    def ring_snapshot(self) -> List[Dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def drain_unshipped(self) -> List[Dict]:
+        """Ring entries recorded since the previous heartbeat (the
+        piggyback payload) — the mirror dedups on ``n`` anyway, so a
+        lost heartbeat only costs the window the ring itself rotated
+        out."""
+        with self._lock:
+            out = [e for e in self._ring if e["n"] > self._last_shipped]
+            if out:
+                self._last_shipped = out[-1]["n"]
+            return out
 
 
 class PartitionStore:
@@ -53,8 +144,10 @@ class PartitionStore:
     spill dir (one file per block — blocks are already CRC-framed, so
     disk rot surfaces at deserialize time as ShuffleCorruption)."""
 
-    def __init__(self, mem_bytes: int, spill_dir: Optional[str] = None):
+    def __init__(self, mem_bytes: int, spill_dir: Optional[str] = None,
+                 telemetry: Optional[WorkerTelemetry] = None):
         self.mem_bytes = max(int(mem_bytes), 0)
+        self.telemetry = telemetry
         self._spill_dir = spill_dir
         self._made_spill_dir = spill_dir is None
         self._lock = threading.Lock()
@@ -80,22 +173,37 @@ class PartitionStore:
         return os.path.join(self._spill_dir,
                             f"part_{exch}_{pid}_{seq}.blk")
 
-    def put(self, exch: int, pid: int, seq: int, blob: bytes) -> None:
+    def put(self, exch: int, pid: int, seq: int, blob: bytes) -> str:
+        """Store one block; returns where it landed — ``"mem"``,
+        ``"disk"`` (memory budget overflowed to the spill dir), or
+        ``"dup"`` (idempotent re-drive: the block already landed)."""
+        tel = self.telemetry
         with self._lock:
             entries = self._parts.setdefault((exch, pid), {})
             if seq in entries:
-                return   # idempotent re-drive: the block already landed
+                if tel is not None:
+                    tel.bump("store_put_dedups")
+                return "dup"
             if self._mem_used + len(blob) <= self.mem_bytes:
                 entries[seq] = ("mem", blob)
                 self._mem_used += len(blob)
+                kind = "mem"
             else:
                 path = self._spill_path(exch, pid, seq)
                 with open(path, "wb") as f:
                     f.write(blob)
                 entries[seq] = ("disk", path)
                 self.spilled_blocks += 1
+                kind = "disk"
             self.blocks += 1
             self.bytes += len(blob)
+        if tel is not None:
+            tel.bump("store_puts")
+            tel.bump("store_put_bytes", len(blob))
+            if kind == "disk":
+                tel.bump("store_overflow_blocks")
+                tel.bump("store_overflow_bytes", len(blob))
+        return kind
 
     def fetch(self, exch: int, pid: int, after_seq: int = -1,
               max_bytes: int = 0) -> Tuple[List[int], List[bytes], int]:
@@ -124,6 +232,11 @@ class PartitionStore:
             seqs.append(seq)
             blobs.append(blob)
             total += len(blob)
+        tel = self.telemetry
+        if tel is not None:
+            tel.bump("store_fetches")
+            tel.bump("store_blocks_served", len(seqs))
+            tel.bump("store_bytes_served", total)
         return seqs, blobs, n_total
 
     def release(self, exch: int) -> int:
@@ -217,14 +330,17 @@ class WorkerServer:
                  mem_bytes: int = 64 << 20, heartbeat_ms: int = 200,
                  spill_dir: Optional[str] = None,
                  warm_compile_dir: Optional[str] = None,
-                 op_timeout_ms: int = 4000):
+                 op_timeout_ms: int = 4000,
+                 telemetry_ring: int = 512):
         self.coordinator = coordinator
         self.worker_id = worker_id
         self.heartbeat_s = max(heartbeat_ms, 10) / 1000.0
         self.op_timeout_s = max(op_timeout_ms, 100) / 1000.0
         if spill_dir is None:
             reap_stale_spill_dirs()
-        self.store = PartitionStore(mem_bytes, spill_dir)
+        self.telemetry = WorkerTelemetry(telemetry_ring)
+        self.store = PartitionStore(mem_bytes, spill_dir,
+                                    telemetry=self.telemetry)
         self.warmed_entries = _warm_caches(warm_compile_dir)
         self.mem_bytes = mem_bytes
         self._stop = threading.Event()
@@ -246,7 +362,11 @@ class WorkerServer:
             "op": "hello", "worker_id": self.worker_id,
             "data_port": self.data_port, "pid": os.getpid(),
             "mem_bytes": self.mem_bytes,
-            "warmed_entries": self.warmed_entries})
+            "warmed_entries": self.warmed_entries,
+            # clock-offset handshake (ISSUE 15): the coordinator
+            # estimates offset = its receipt wall-clock minus this, so
+            # worker ring timestamps align onto the driver timeline
+            "t_wall": time.time()})
         rep, _ = P.recv_msg(self._control)
         if rep.get("op") != "welcome":
             raise ConnectionError(f"unexpected join reply: {rep}")
@@ -289,8 +409,16 @@ class WorkerServer:
             if c is None:
                 return
             try:
+                # telemetry piggyback (ISSUE 15): cumulative counter
+                # snapshot + ring entries since the last beat + t_wall —
+                # the coordinator's per-worker mirror is what survives
+                # this process being SIGKILLed
                 P.send_msg(c, {"op": "heartbeat",
                                "worker_id": self.worker_id,
+                               "counters":
+                                   self.telemetry.counters_snapshot(),
+                               "ring": self.telemetry.drain_unshipped(),
+                               "t_wall": time.time(),
                                **self.store.stats()})
             except OSError:
                 # the coordinator hung up: a LOST declaration closed our
@@ -339,21 +467,65 @@ class WorkerServer:
 
     def _handle(self, h: Dict, blobs: List[bytes]) -> Tuple[Dict, list]:
         op = h.get("op")
+        trace = str(h.get("trace", "") or "")
+        span = str(h.get("span", "") or "")
+        tel = self.telemetry
         if op == "put":
-            self.store.put(int(h["exch"]), int(h["pid"]), int(h["seq"]),
-                           blobs[0] if blobs else b"")
+            t_wall = time.time()
+            t0 = time.perf_counter_ns()
+            blob = blobs[0] if blobs else b""
+            redrive = bool(h.get("redrive"))
+            landed = self.store.put(int(h["exch"]), int(h["pid"]),
+                                    int(h["seq"]), blob)
+            dur = time.perf_counter_ns() - t0
+            tel.bump("put_wall_ns", dur)
+            if redrive and landed != "dup":
+                tel.bump("store_redrive_puts")
+            # untraced frames (tracing off, non-query tooling) record
+            # counters only — a span without a trace id could never be
+            # attributed and would just rotate attributed history out
+            # of the bounded ring
+            if trace and landed != "dup":
+                kind = ("redrive_put" if redrive
+                        else "spill" if landed == "disk" else "put")
+                tel.span(kind, trace, span, int(h["exch"]),
+                         int(h["pid"]), int(h["seq"]), len(blob),
+                         t_wall, dur)
             return {"ok": True}, []
         if op == "fetch":
+            t_wall = time.time()
+            t0 = time.perf_counter_ns()
             seqs, out, n_total = self.store.fetch(
                 int(h["exch"]), int(h["pid"]),
                 after_seq=int(h.get("after_seq", -1)),
                 max_bytes=int(h.get("max_bytes", 0)))
+            dur = time.perf_counter_ns() - t0
+            tel.bump("fetch_wall_ns", dur)
+            if trace and seqs:
+                tel.span("fetch", trace, span, int(h["exch"]),
+                         int(h["pid"]), seqs[-1],
+                         sum(len(b) for b in out), t_wall, dur)
             return {"ok": True, "seqs": seqs, "n_total": n_total}, out
         if op == "release":
+            t_wall = time.time()
+            t0 = time.perf_counter_ns()
             dropped = self.store.release(int(h["exch"]))
+            if trace and dropped:
+                tel.span("release", trace, span, int(h["exch"]), -1, -1,
+                         0, t_wall, time.perf_counter_ns() - t0)
             return {"ok": True, "dropped": dropped}, []
         if op == "stats":
             return {"ok": True, **self.store.stats()}, []
+        if op == "dump":
+            # the on-demand telemetry pull (ISSUE 15): full ring +
+            # counter snapshot + clock sample, same shape as the
+            # heartbeat piggyback so the coordinator mirror folds both
+            return {"ok": True, "worker_id": self.worker_id,
+                    "pid": os.getpid(),
+                    "counters": tel.counters_snapshot(),
+                    "ring": tel.ring_snapshot(),
+                    "t_wall": time.time(),
+                    **self.store.stats()}, []
         if op == "ping":
             return {"ok": True, "worker_id": self.worker_id}, []
         return {"error": f"unknown op {op!r}"}, []
@@ -370,13 +542,18 @@ def main(argv=None) -> int:
     ap.add_argument("--op-timeout-ms", type=int, default=4000)
     ap.add_argument("--spill-dir", default=None)
     ap.add_argument("--warm-compile-dir", default=None)
+    ap.add_argument("--telemetry-ring", type=int, default=512,
+                    help="worker-local diagnostics ring capacity "
+                         "(0 disables span recording; counters still "
+                         "federate over heartbeats)")
     args = ap.parse_args(argv)
 
     srv = WorkerServer(
         P.parse_endpoint(args.coordinator), args.worker_id,
         mem_bytes=args.mem_bytes, heartbeat_ms=args.heartbeat_ms,
         spill_dir=args.spill_dir, warm_compile_dir=args.warm_compile_dir,
-        op_timeout_ms=args.op_timeout_ms)
+        op_timeout_ms=args.op_timeout_ms,
+        telemetry_ring=args.telemetry_ring)
     try:
         srv.start()
     except OSError as e:

@@ -19,6 +19,7 @@ never CPU-fallbacked, never counted by the circuit breaker.
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from contextvars import ContextVar
@@ -113,16 +114,30 @@ class CancelToken:
 _QUERY_SEQ = itertools.count(1)
 
 
+def mint_trace_id(seq: int) -> str:
+    """The cluster-wide trace identifier minted at collect start
+    (ISSUE 15).  ``query_id`` ("q3") is readable but only unique within
+    one driver process; the trace id adds a wall-clock millisecond and
+    the driver pid so worker-local diagnostics rings — which outlive
+    queries and survive driver restarts on disk — attribute spans to
+    exactly one collect across every process that ever touched it.
+    Carried on every TKD1 control frame (``trace``/``span`` header
+    fields) and stamped into the diagnostics event log header."""
+    return f"{int(time.time() * 1000):x}-{os.getpid():x}-{seq:x}"
+
+
 class QueryContext:
     """Everything the lifecycle layer tracks for one collect()."""
 
-    __slots__ = ("query_id", "token", "admission_seq", "admission_wait_ns",
+    __slots__ = ("query_id", "trace_id", "token", "admission_seq",
+                 "admission_wait_ns",
                  "deadline_ns", "watchdog_period_s", "started_ns",
                  "owner_thread", "cleanup_hooks")
 
     def __init__(self, watchdog_period_s: float = 0.05):
         n = next(_QUERY_SEQ)
         self.query_id = f"q{n}"
+        self.trace_id = mint_trace_id(n)
         self.token = CancelToken()
         # admission order doubles as semaphore priority: a LOWER seq was
         # admitted earlier (already running, already holding memory) and

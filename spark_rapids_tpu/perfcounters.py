@@ -153,6 +153,12 @@ COUNTERS: Dict[str, int] = {
     "partitions_replayed": 0,
     "dist_blocks_shipped": 0,
     "dist_block_bytes": 0,
+    # cluster observability (ISSUE 15, docs/cluster_observability.md):
+    # on-demand DUMP pulls of a worker's telemetry (ring + counters)
+    # by the coordinator, and worker-side span events merged into
+    # driver query event logs by trace id at collect end
+    "dist_worker_dumps": 0,
+    "dist_worker_spans_merged": 0,
 }
 
 

@@ -77,6 +77,7 @@ def _active_query_table() -> List[Dict[str, Any]]:
     for ctx in _wd.active_queries():
         rows.append({
             "query_id": ctx.query_id,
+            "trace_id": getattr(ctx, "trace_id", ""),
             "age_ms": round((now - ctx.started_ns) / 1e6, 1),
             "deadline_set": ctx.deadline_ns is not None,
             "deadline_expired": ctx.deadline_expired(now),

@@ -46,6 +46,19 @@ def render_prometheus(hub) -> str:
             out.append(f"# HELP {name} {s.help}")
         out.append(f"# TYPE {name} {s.kind}")
         out.append(f"{name} {_fmt(s.value)}")
+    # labeled families (ISSUE 15): the per-worker federated series —
+    # one TYPE header per family, then one labeled sample per label set
+    by_family = {}
+    for s in hub.registry.labeled_items():
+        by_family.setdefault((s.name, s.kind), []).append(s)
+    for (fam, kind) in sorted(by_family):
+        name = _name(fam) + ("_total" if kind == "counter" else "")
+        out.append(f"# TYPE {name} {kind}")
+        for s in sorted(by_family[(fam, kind)],
+                        key=lambda s: s.labels or ()):
+            lbls = ",".join(f'{k}="{_esc_label(v)}"'
+                            for k, v in (s.labels or ()))
+            out.append(f"{name}{{{lbls}}} {_fmt(s.value)}")
     for h in sorted(hub.registry.hist_items(), key=lambda h: h.name):
         name = _name(h.name)
         if h.help:

@@ -38,16 +38,23 @@ DEFAULT_LATENCY_BUCKETS_MS: Tuple[float, ...] = (
 
 
 class Series:
-    """One gauge/counter time series with a bounded sample ring."""
+    """One gauge/counter time series with a bounded sample ring.
 
-    __slots__ = ("name", "kind", "help", "value", "ring")
+    ``labels`` (ISSUE 15): an optional sorted tuple of ``(key, value)``
+    pairs — per-worker federated series (``worker="w0"``) export as
+    Prometheus-labeled samples of one family instead of name-mangled
+    singletons, so a fleet dashboard can aggregate across workers."""
 
-    def __init__(self, name: str, kind: str, help_: str, retention: int):
+    __slots__ = ("name", "kind", "help", "value", "ring", "labels")
+
+    def __init__(self, name: str, kind: str, help_: str, retention: int,
+                 labels: Optional[Tuple[Tuple[str, str], ...]] = None):
         self.name = name
         self.kind = kind            # "gauge" | "counter"
         self.help = help_
         self.value: float = 0.0
         self.ring: deque = deque(maxlen=max(int(retention), 1))
+        self.labels = labels
 
     def record(self, value: float, ts: Optional[float] = None) -> None:
         self.value = float(value)
@@ -159,6 +166,10 @@ class MetricsRegistry:
         self.retention = max(int(retention), 1)
         self._lock = threading.Lock()
         self._series: Dict[str, Series] = {}
+        # labeled sub-series keyed (family name, sorted label tuple) —
+        # the per-worker federated metrics (ISSUE 15)
+        self._labeled: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                            Series] = {}
         self._hists: Dict[str, Histogram] = {}
 
     # -- series ----------------------------------------------------------
@@ -194,6 +205,36 @@ class MetricsRegistry:
                                                     self.retention)
                 s.record(v, ts)
 
+    def record_labeled(self, name: str, value: float,
+                       labels: Dict[str, str], kind: str = "gauge",
+                       ts: Optional[float] = None) -> None:
+        """Record one sample of a LABELED sub-series (get-or-create).
+        One family may hold many label sets; the exporter emits them as
+        ``srt_<name>{k="v",...}`` samples under one TYPE header."""
+        key = (name, tuple(sorted((str(k), str(v))
+                                  for k, v in labels.items())))
+        self.record_labeled_many(kind, {key: float(value)}, ts)
+
+    def record_labeled_many(self, kind: str,
+                            values: Dict[Tuple[str,
+                                               Tuple[Tuple[str, str],
+                                                     ...]], float],
+                            ts: Optional[float] = None) -> None:
+        """One lock acquisition for a whole sampler tick's worth of
+        labeled samples (keys are (family, sorted label tuple))."""
+        ts = ts if ts is not None else time.time()
+        with self._lock:
+            for key, v in values.items():
+                s = self._labeled.get(key)
+                if s is None:
+                    s = self._labeled[key] = Series(
+                        key[0], kind, "", self.retention, labels=key[1])
+                s.record(float(v), ts)
+
+    def labeled_items(self) -> List[Series]:
+        with self._lock:
+            return list(self._labeled.values())
+
     def histogram(self, name: str, help_: str = "",
                   buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS_MS,
                   label_name: str = "") -> Histogram:
@@ -213,10 +254,14 @@ class MetricsRegistry:
         """Current values of every series (no rings) + histogram stats —
         the JSONL sink's per-tick record shape."""
         with self._lock:
-            out = {"gauges": {}, "counters": {}, "histograms": {}}
+            out = {"gauges": {}, "counters": {}, "histograms": {},
+                   "labeled": {}}
             for s in self._series.values():
                 out["gauges" if s.kind == "gauge"
                     else "counters"][s.name] = s.value
+            for s in self._labeled.values():
+                lbl = ",".join(f'{k}="{v}"' for k, v in (s.labels or ()))
+                out["labeled"].setdefault(s.name, {})[lbl] = s.value
             for h in self._hists.values():
                 out["histograms"][h.name] = {
                     (lbl or ""): h.stats(lbl) for lbl in h.labels()}

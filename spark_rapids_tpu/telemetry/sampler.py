@@ -47,7 +47,8 @@ SAMPLED_COUNTERS = (
     "governor_transitions", "queries_shed", "preempt_pauses",
     "degraded_batches",
     "workers_joined", "worker_lost", "worker_heartbeat_misses",
-    "partitions_replayed",
+    "partitions_replayed", "dist_worker_dumps",
+    "dist_worker_spans_merged",
 )
 
 
@@ -127,6 +128,32 @@ def collect_gauges() -> Dict[str, float]:
     return g
 
 
+def collect_worker_series() -> Dict[str, Dict[str, float]]:
+    """Federated per-worker telemetry for one tick (ISSUE 15): the
+    heartbeat-reported worker-local counters and store occupancy, keyed
+    ``{worker_id: {series_name: value}}`` — peek-only (latest folded
+    snapshots; an idle tick does no network I/O).  Series names carry a
+    ``worker_`` prefix; the registry records them labeled
+    ``worker="<id>"`` so the Prometheus export and the history-server
+    cluster page see one family per metric across workers."""
+    from spark_rapids_tpu.distributed import peek_coordinator
+
+    coord = peek_coordinator()
+    if coord is None:
+        return {}
+    out: Dict[str, Dict[str, Dict[str, float]]] = {}
+    for wid, view in coord.worker_telemetry().items():
+        out[wid] = {
+            # cumulative worker-local counters -> counter kind
+            "counters": {f"worker_{k}": float(v)
+                         for k, v in view["counters"].items()},
+            # instantaneous store occupancy -> gauge kind
+            "gauges": {f"worker_store_{k}": float(v)
+                       for k, v in view.get("store_stats", {}).items()},
+        }
+    return out
+
+
 class Sampler:
     """Owns the daemon thread, the timeline ring, and the JSONL sink."""
 
@@ -184,12 +211,28 @@ class Sampler:
         reg = self._hub.registry
         reg.record_many("gauge", gauges, ts)
         reg.record_many("counter", counters, ts)
+        # per-worker federated series (ISSUE 15): worker-local counters
+        # piggybacked on heartbeats, recorded labeled worker="<id>"
+        workers = collect_worker_series()
+        if workers:
+            for kind, group in (("counter", "counters"),
+                                ("gauge", "gauges")):
+                reg.record_labeled_many(
+                    kind,
+                    {(name, (("worker", wid),)): v
+                     for wid, row in workers.items()
+                     for name, v in row[group].items()}, ts)
         p95 = self._hub.slo.p95_ms()
         reg.record("query_latency_p95_ms", p95, "gauge",
                    "rolling all-queries p95 collect latency", ts)
         row = {"ts": round(ts, 3), "p95_ms": round(p95, 3)}
         row.update({k: v for k, v in gauges.items()})
         row.update({k: int(v) for k, v in counters.items()})
+        if workers:
+            row["workers"] = {
+                wid: {k: int(v)
+                      for group in r.values() for k, v in group.items()}
+                for wid, r in workers.items()}
         self.timeline.append(row)
         self.ticks += 1
         self._write_jsonl(row)

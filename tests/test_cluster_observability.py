@@ -1,0 +1,686 @@
+"""Cluster-wide observability (ISSUE 15): trace propagation onto TKD1
+frames and worker diagnostics rings, worker telemetry federation over
+heartbeats (per-worker labeled Prometheus series, the
+`dist_blocks_unacked` drift gauge, `worker_telemetry` diagnostics
+events), merged cross-process post-mortems (heartbeat-mirrored rings in
+`worker_lost` bundles + the on-demand DUMP op), the merged Chrome trace
+with per-process pids and clock-offset alignment, and the offline
+surfaces (profile_report worker aggregation by trace id, the
+history-server cluster page) — plus the disabled-path cProfile pin:
+distributed observability off means zero new calls on the in-process
+path.
+"""
+import cProfile
+import json
+import os
+import pstats
+import re
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import perfcounters as PC
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.session import TpuSession, sum_
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+_DIST_CONF = {
+    "spark.rapids.sql.enabled": True,
+    "spark.rapids.tpu.distributed.enabled": True,
+    "spark.sql.autoBroadcastJoinThreshold": "-1",
+    "spark.sql.adaptive.enabled": False,
+    "spark.rapids.sql.batchSizeBytes": 64 << 10,
+    "spark.rapids.sql.reader.batchSizeRows": 4000,
+    "spark.rapids.tpu.distributed.heartbeatMs": 100,
+    "spark.rapids.tpu.distributed.workerLostMs": 600,
+    "spark.rapids.tpu.distributed.opTimeoutMs": 1000,
+}
+
+
+@pytest.fixture
+def coordinator():
+    from spark_rapids_tpu import distributed as D
+
+    D.reset_coordinator()
+    coord = D.get_coordinator(TpuConf(_DIST_CONF))
+    try:
+        yield coord
+    finally:
+        D.reset_coordinator()
+
+
+def _inproc_worker(coord, wid, mem_bytes=64 << 10, **kw):
+    from spark_rapids_tpu.distributed.worker import WorkerServer
+
+    w = WorkerServer(("127.0.0.1", coord.port), wid,
+                     mem_bytes=mem_bytes, heartbeat_ms=100, **kw)
+    w.start()
+    assert coord.wait_for_workers(1, timeout_s=20)
+    return w
+
+
+def _wait(pred, timeout_s=10.0, period=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(period)
+    return pred()
+
+
+def _join_query(n_fact=20_000, n_dim=200, seed=11):
+    rng = np.random.default_rng(seed)
+    fk = rng.integers(0, n_dim, n_fact).tolist()
+    fv = rng.integers(-100, 100, n_fact).tolist()
+    dk = list(range(n_dim))
+    dg = [i % 7 for i in range(n_dim)]
+    fact_schema = T.StructType([T.StructField("k", T.INT),
+                                T.StructField("v", T.LONG)])
+    dim_schema = T.StructType([T.StructField("k", T.INT),
+                               T.StructField("g", T.INT)])
+
+    def build(s):
+        fact = s.create_dataframe({"k": fk, "v": fv}, fact_schema)
+        dim = s.create_dataframe({"k": dk, "g": dg}, dim_schema)
+        return (fact.join(dim, on="k", how="inner")
+                .group_by("g").agg(sum_("v", "sv")))
+
+    return build
+
+
+def _query_context():
+    """Install a lifecycle QueryContext on the current thread (what a
+    real collect does) so coordinator ops pick up its trace id."""
+    from spark_rapids_tpu.lifecycle.context import CURRENT, QueryContext
+
+    ctx = QueryContext()
+    token = CURRENT.set(ctx)
+    return ctx, token
+
+
+# ---------------------------------------------------------------------------
+# trace-id contract
+# ---------------------------------------------------------------------------
+
+def test_trace_id_minted_per_query_and_unique():
+    from spark_rapids_tpu.lifecycle.context import QueryContext
+
+    a, b = QueryContext(), QueryContext()
+    assert a.trace_id and b.trace_id and a.trace_id != b.trace_id
+    # "<ms hex>-<pid hex>-<seq hex>": joinable across processes
+    assert re.fullmatch(r"[0-9a-f]+-[0-9a-f]+-[0-9a-f]+", a.trace_id)
+    assert a.trace_id.split("-")[1] == f"{os.getpid():x}"
+
+
+def test_frames_carry_trace_and_span_into_worker_ring(coordinator):
+    """Every traced put/fetch lands in the worker-local ring attributed
+    to the originating query's trace id; redrive-flagged puts count
+    worker-side (`store_redrive_puts`) and record `redrive_put` spans."""
+    from spark_rapids_tpu.lifecycle.context import CURRENT
+
+    w = _inproc_worker(coordinator, "tr0")
+    try:
+        coordinator.place(1, 1, est_bytes=256)
+        ctx, token = _query_context()
+        try:
+            coordinator.put_block(1, 0, 0, b"a" * 64)
+            coordinator.put_block(1, 0, 1, b"b" * 64, redrive=True)
+            coordinator.fetch_blocks(1, 0)
+        finally:
+            CURRENT.reset(token)
+        ring = w.telemetry.ring_snapshot()
+        assert [e["kind"] for e in ring] == ["put", "redrive_put",
+                                             "fetch"]
+        assert {e["trace"] for e in ring} == {ctx.trace_id}
+        c = w.telemetry.counters_snapshot()
+        assert c["store_puts"] == 2
+        assert c["store_redrive_puts"] == 1
+        assert c["store_fetches"] == 1
+        assert c["store_bytes_served"] == 128
+        assert c["put_wall_ns"] > 0 and c["fetch_wall_ns"] > 0
+        coordinator.release_exchange(1)
+    finally:
+        w.stop(goodbye=True)
+
+
+def test_trace_disabled_frames_carry_no_fields(coordinator):
+    from spark_rapids_tpu.lifecycle.context import CURRENT
+
+    w = _inproc_worker(coordinator, "tr1")
+    try:
+        coordinator.trace_enabled = False
+        coordinator.place(2, 1, est_bytes=64)
+        ctx, token = _query_context()
+        try:
+            coordinator.put_block(2, 0, 0, b"x" * 32)
+        finally:
+            CURRENT.reset(token)
+        # an untraced frame records NO span (a trace-less entry could
+        # never be attributed and would only rotate attributed history
+        # out of the bounded ring) — counters still bump
+        assert w.telemetry.ring_snapshot() == []
+        assert w.telemetry.counters_snapshot()["store_puts"] == 1
+        coordinator.release_exchange(2)
+    finally:
+        coordinator.trace_enabled = True
+        w.stop(goodbye=True)
+
+
+# ---------------------------------------------------------------------------
+# telemetry federation
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_piggyback_folds_counters_and_mirror(coordinator):
+    w = _inproc_worker(coordinator, "hb0")
+    try:
+        coordinator.place(3, 1, est_bytes=64)
+        ctx, token = _query_context()
+        try:
+            coordinator.put_block(3, 0, 0, b"z" * 48)
+        finally:
+            from spark_rapids_tpu.lifecycle.context import CURRENT
+
+            CURRENT.reset(token)
+        assert _wait(lambda: coordinator.worker_telemetry()
+                     .get("hb0", {}).get("counters", {})
+                     .get("store_puts", 0) == 1)
+        view = coordinator.worker_telemetry()["hb0"]
+        assert view["store_stats"]["blocks"] == 1
+        # handshake clock offset: same host, sub-second by construction
+        assert abs(view["clock_offset_s"]) < 1.0
+        # the mirror holds the span, deduped on ring seq across beats
+        assert _wait(lambda: any(
+            e["trace"] == ctx.trace_id
+            for v in coordinator.collect_trace() for e in v["ring"]))
+        views = coordinator.collect_trace(ctx.trace_id)
+        assert len(views) == 1 and len(views[0]["ring"]) == 1
+        coordinator.release_exchange(3)
+    finally:
+        w.stop(goodbye=True)
+
+
+def test_worker_telemetry_diagnostics_event(coordinator):
+    """The new `worker_telemetry` event: a federation arrival during a
+    recorded query lands in the event log, schema-complete."""
+    from spark_rapids_tpu.diagnostics import context as CTX
+    from spark_rapids_tpu.diagnostics.recorder import (
+        EVENT_SCHEMA,
+        QueryDiagnostics,
+    )
+
+    diag = QueryDiagnostics("qtel", metrics_level="MODERATE",
+                            trace_id="t-x")
+    CTX.RECORDER = diag
+    try:
+        coordinator._heartbeat("wtel", {
+            "op": "heartbeat", "worker_id": "wtel",
+            "counters": {"store_puts": 5}, "ring": [],
+            "t_wall": time.time(), "blocks": 2, "bytes": 128,
+            "mem_used": 64, "spilled_blocks": 0, "partitions": 1})
+    finally:
+        CTX.RECORDER = None
+    evs = [e for e in diag.events if e["ev"] == "worker_telemetry"]
+    # the worker is unknown to membership (no HELLO) -> no fold; a
+    # joined worker's beat must record
+    assert evs == []
+    w = _inproc_worker(coordinator, "wtel2")
+    try:
+        CTX.RECORDER = diag
+        try:
+            assert _wait(lambda: any(
+                e["ev"] == "worker_telemetry" for e in diag.events))
+        finally:
+            CTX.RECORDER = None
+        evs = [e for e in diag.events if e["ev"] == "worker_telemetry"]
+        for field in EVENT_SCHEMA["worker_telemetry"]:
+            assert field in evs[0], field
+        assert evs[0]["worker_id"] == "wtel2"
+        assert isinstance(evs[0]["counters"], dict)
+    finally:
+        w.stop(goodbye=True)
+
+
+def test_prometheus_labeled_worker_series_round_trip(coordinator):
+    """Per-worker labeled series: sampler tick -> registry ->
+    exposition text -> parsed back with worker labels intact, declared
+    under one TYPE header per family."""
+    from spark_rapids_tpu import telemetry
+
+    telemetry.shutdown()
+    s = TpuSession({"spark.rapids.sql.enabled": True,
+                    "spark.rapids.tpu.telemetry.samplePeriodMs": "0"})
+    hub = telemetry.get_hub()
+    assert hub is not None
+    w = _inproc_worker(coordinator, "prom0")
+    try:
+        coordinator.place(4, 1, est_bytes=64)
+        ctx, token = _query_context()
+        try:
+            coordinator.put_block(4, 0, 0, b"p" * 32)
+        finally:
+            from spark_rapids_tpu.lifecycle.context import CURRENT
+
+            CURRENT.reset(token)
+        assert _wait(lambda: coordinator.worker_telemetry()
+                     .get("prom0", {}).get("counters", {})
+                     .get("store_puts", 0) == 1)
+        hub.sampler.tick()
+        text = telemetry.export()
+        # labeled counter sample under a declared family
+        m = re.search(
+            r'^srt_worker_store_puts_total\{worker="prom0"\} (\d+)$',
+            text, re.M)
+        assert m is not None, text
+        assert int(m.group(1)) == 1
+        assert re.search(r"^# TYPE srt_worker_store_puts_total counter$",
+                         text, re.M)
+        # store occupancy federates as a labeled gauge
+        assert re.search(
+            r'^srt_worker_store_blocks\{worker="prom0"\} \d+$',
+            text, re.M)
+        assert re.search(r"^# TYPE srt_worker_store_blocks gauge$",
+                         text, re.M)
+        # the drift gauge samples with the other dist_* gauges
+        assert re.search(r"^srt_dist_blocks_unacked \d+", text, re.M)
+        # the timeline row carries the per-tick federated workers map
+        row = hub.sampler.timeline_snapshot()[-1]
+        assert row["workers"]["prom0"]["worker_store_puts"] == 1
+        # registry snapshot exposes the labeled families too
+        labeled = hub.registry.snapshot()["labeled"]
+        assert labeled["worker_store_puts"]['worker="prom0"'] == 1.0
+        coordinator.release_exchange(4)
+    finally:
+        w.stop(goodbye=True)
+        telemetry.shutdown()
+
+
+def test_dist_blocks_unacked_drift_gauge(coordinator):
+    """Healthy shipping reconciles to zero within a heartbeat; a
+    shipped-but-never-received frame (simulated) surfaces as drift; a
+    rejoin retires the old incarnation's receipts instead of
+    double-counting them."""
+    w = _inproc_worker(coordinator, "dr0")
+    try:
+        coordinator.place(5, 1, est_bytes=64)
+        for i in range(3):
+            coordinator.put_block(5, 0, i, b"d" * 16)
+        assert _wait(lambda: coordinator.gauges()
+                     ["dist_blocks_unacked"] == 0.0)
+        # a frame the worker never saw: shipped count moves, acks don't
+        with coordinator._lock:
+            coordinator._shipped_blocks += 2
+        assert coordinator.gauges()["dist_blocks_unacked"] == 2.0
+        with coordinator._lock:
+            coordinator._shipped_blocks -= 2
+        # rejoin under the same id: old receipts retire, gauge stays 0
+        w.stop(goodbye=True)
+        w2 = _inproc_worker(coordinator, "dr0")
+        try:
+            assert _wait(lambda: coordinator.gauges()
+                         ["dist_blocks_unacked"] == 0.0)
+            assert coordinator._acked_retired >= 3
+        finally:
+            w2.stop(goodbye=True)
+        coordinator.release_exchange(5)
+    finally:
+        if w._control is not None:
+            w.stop(goodbye=True)
+
+
+# ---------------------------------------------------------------------------
+# merged post-mortems (DUMP op + worker_lost bundles)
+# ---------------------------------------------------------------------------
+
+def test_dump_op_and_on_demand_postmortem(coordinator):
+    from spark_rapids_tpu import telemetry
+
+    telemetry.shutdown()
+    TpuSession({"spark.rapids.sql.enabled": True,
+                "spark.rapids.tpu.telemetry.samplePeriodMs": "0"})
+    hub = telemetry.get_hub()
+    assert hub is not None
+    hub.reset_dump_limits()
+    w = _inproc_worker(coordinator, "du0")
+    try:
+        coordinator.place(6, 1, est_bytes=64)
+        ctx, token = _query_context()
+        try:
+            coordinator.put_block(6, 0, 0, b"q" * 24)
+        finally:
+            from spark_rapids_tpu.lifecycle.context import CURRENT
+
+            CURRENT.reset(token)
+        snap = PC.snapshot()
+        view = coordinator.dump_worker("du0")
+        assert view["counters"]["store_puts"] == 1
+        assert any(e["trace"] == ctx.trace_id for e in view["ring"])
+        assert PC.since(snap)["dist_worker_dumps"] == 1
+        bundle = coordinator.postmortem_worker("du0", detail="drill")
+        assert bundle is not None
+        assert bundle["reason"] == "worker_dump"
+        assert bundle["worker_id"] == "du0"
+        assert bundle["worker_diagnostics"]["counters"]["store_puts"] == 1
+        assert ctx.trace_id in bundle["trace_ids"]
+        coordinator.release_exchange(6)
+    finally:
+        w.stop(goodbye=True)
+        telemetry.shutdown()
+
+
+def test_worker_lost_bundle_merges_last_shipped_ring(coordinator):
+    """THE merged-post-mortem pin: a dead-socket loss produces ONE
+    bundle holding the driver's placement/re-drive view AND the
+    worker's last-shipped diagnostics ring + counters, sharing the
+    query's trace id."""
+    from spark_rapids_tpu import telemetry
+
+    telemetry.shutdown()
+    TpuSession({"spark.rapids.sql.enabled": True,
+                "spark.rapids.tpu.telemetry.samplePeriodMs": "0"})
+    hub = telemetry.get_hub()
+    hub.reset_dump_limits()
+    w = _inproc_worker(coordinator, "pm0")
+    try:
+        coordinator.place(7, 2, est_bytes=128)
+        ctx, token = _query_context()
+        try:
+            coordinator.put_block(7, 0, 0, b"m" * 40)
+            coordinator.put_block(7, 1, 0, b"n" * 40)
+        finally:
+            from spark_rapids_tpu.lifecycle.context import CURRENT
+
+            CURRENT.reset(token)
+        # the ring must have been SHIPPED (heartbeat) before the kill —
+        # a SIGKILLed worker cannot answer a dump
+        assert _wait(lambda: any(
+            v["ring"] for v in coordinator.collect_trace()))
+        w.stop(goodbye=False)          # dead socket -> LOST
+        assert _wait(lambda: coordinator.worker_state("pm0") == "LOST")
+
+        def _bundle():
+            return [b for b in hub.postmortems
+                    if b["reason"] == "worker_lost"
+                    and b.get("worker_id") == "pm0"]
+
+        assert _wait(lambda: bool(_bundle()))
+        b = _bundle()[-1]
+        # driver's view (PR 14) ...
+        assert "placement_table" in b and "redrive_plan" in b
+        # ... merged with the worker's last-shipped diagnostics
+        wd = b["worker_diagnostics"]
+        assert wd["counters"]["store_puts"] == 2
+        assert any(e["trace"] == ctx.trace_id for e in wd["ring"])
+        assert wd["clock_offset_s"] is not None
+        assert b["trace_ids"] == [ctx.trace_id]
+        coordinator.release_exchange(7)
+    finally:
+        telemetry.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# merged event log + Chrome trace (end to end through a real query)
+# ---------------------------------------------------------------------------
+
+def test_distributed_query_merges_worker_spans(coordinator, tmp_path):
+    """End to end: a diagnostics-enabled distributed join writes ONE
+    event log + Chrome trace whose worker spans carry the query's
+    trace id and render as distinct per-process pids, clock-aligned
+    inside the query window."""
+    from spark_rapids_tpu.diagnostics.report import load_query_log
+
+    w = _inproc_worker(coordinator, "mw0", mem_bytes=8 << 10)
+    try:
+        log_dir = tmp_path / "logs"
+        trace_dir = tmp_path / "traces"
+        conf = dict(_DIST_CONF)
+        conf.update({
+            "spark.rapids.tpu.diagnostics.enabled": True,
+            "spark.rapids.tpu.diagnostics.eventLogDir": str(log_dir),
+            "spark.rapids.tpu.diagnostics.chromeTraceDir":
+                str(trace_dir),
+        })
+        build = _join_query()
+        oracle = sorted(build(TpuSession(
+            {"spark.rapids.sql.enabled": False})).collect())
+        snap = PC.snapshot()
+        rows = sorted(build(TpuSession(conf)).collect())
+        assert rows == oracle
+        d = PC.since(snap)
+        assert d["dist_blocks_shipped"] > 0
+        assert d["dist_worker_spans_merged"] > 0
+
+        logs = sorted(log_dir.glob("query-*.jsonl"))
+        assert logs
+        qp = load_query_log(str(logs[-1]))
+        assert qp.trace_id, "query_start must carry the trace id"
+        spans = [e for e in qp.events if e["ev"] == "worker_span"]
+        assert spans, "worker spans must merge into the driver log"
+        assert {e["trace"] for e in spans} == {qp.trace_id}
+        assert {e["worker_id"] for e in spans} == {"mw0"}
+        assert {e["kind"] for e in spans} >= {"put", "fetch"}
+        # clock-offset alignment: every span timestamp inside the window
+        assert all(0 <= e["ts_ns"] <= qp.wall_ns for e in spans)
+
+        traces = sorted(trace_dir.glob("query-*.trace.json"))
+        assert traces
+        with open(traces[-1]) as f:
+            trace = json.load(f)
+        assert trace["otherData"]["trace_id"] == qp.trace_id
+        pids = {ev["pid"] for ev in trace["traceEvents"]}
+        worker_events = [ev for ev in trace["traceEvents"]
+                         if ev.get("name", "").startswith("worker:")]
+        assert worker_events, "merged trace must hold worker spans"
+        worker_pids = {ev["pid"] for ev in worker_events}
+        assert worker_pids and not (worker_pids
+                                    & (pids - worker_pids)), \
+            "workers must render as distinct process groups"
+        names = {ev["args"]["name"] for ev in trace["traceEvents"]
+                 if ev.get("name") == "process_name"}
+        assert "worker mw0" in names
+    finally:
+        w.stop(goodbye=True)
+
+
+def test_worker_span_merge_honors_max_events():
+    """The query-end merge respects the in-memory event bound like
+    every other recording site: overflow drops (counted into the
+    flushed query_end's events_dropped) instead of blowing past
+    diagnostics.maxEvents after finish()."""
+    from spark_rapids_tpu.diagnostics.recorder import QueryDiagnostics
+
+    diag = QueryDiagnostics("qcap", max_events=5, trace_id="t")
+    diag.finish()
+    assert [e["ev"] for e in diag.events] == ["query_end"]
+    ring = [{"ts_wall": diag.started_at, "dur_ns": 1, "kind": "put",
+             "trace": "t", "span": "", "exch": 1, "pid": 0, "seq": i,
+             "bytes": 1} for i in range(10)]
+    merged = diag.record_worker_spans(
+        [{"worker_id": "w", "clock_offset_s": 0.0, "ring": ring}])
+    assert merged == 4                       # room under the cap
+    assert len(diag.events) == 5
+    assert diag.events[-1]["ev"] == "query_end"
+    assert diag.dropped_events == 6
+    assert diag.events[-1]["events_dropped"] == 6
+
+
+# ---------------------------------------------------------------------------
+# disabled-path pin (satellite): distributed observability off =>
+# zero new calls on the in-process path
+# ---------------------------------------------------------------------------
+
+def test_disabled_path_zero_distributed_calls(tmp_path):
+    from spark_rapids_tpu import distributed as D
+    from spark_rapids_tpu import telemetry
+
+    D.reset_coordinator()
+    telemetry.shutdown()
+    conf = {"spark.rapids.sql.enabled": True,
+            "spark.rapids.tpu.telemetry.samplePeriodMs": "0",
+            "spark.rapids.tpu.diagnostics.enabled": True,
+            "spark.rapids.tpu.diagnostics.eventLogDir":
+                str(tmp_path / "logs")}
+    s = TpuSession(conf)
+    df = s.create_dataframe(
+        {"a": list(range(512)), "k": [i % 4 for i in range(512)]},
+        T.StructType([T.StructField("a", T.LONG, True),
+                      T.StructField("k", T.LONG, True)]))
+    q = df.group_by("k").agg(sum_("a", "s"))
+    q.collect()                    # warm compiles outside the profile
+    prof = cProfile.Profile()
+    prof.enable()
+    q.collect()
+    prof.disable()
+    banned = os.path.join("spark_rapids_tpu", "distributed")
+    offenders = [
+        (fname, func)
+        for (fname, _lineno, func) in pstats.Stats(prof).stats
+        if banned in fname]
+    assert not offenders, (
+        f"distributed-module work on the in-process path: {offenders}")
+    telemetry.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# offline surfaces: profile_report aggregation + history cluster page
+# ---------------------------------------------------------------------------
+
+def _write_multiproc_logs(log_dir):
+    """One driver query log (trace T) + one LOOSE worker-span file
+    holding spans for T and for an unknown trace X."""
+    os.makedirs(log_dir, exist_ok=True)
+    trace = "abc-1-f"
+    qlog = [
+        {"ev": "query_start", "ts_ns": 0, "op": "", "query_id": "qA",
+         "trace_id": trace, "started_at": 100.0,
+         "metrics_level": "MODERATE",
+         "plan": [{"path": "0", "name": "Agg", "describe": "Agg"}]},
+        {"ev": "operator", "ts_ns": 50, "op": "0", "path": "0",
+         "name": "Agg", "describe": "Agg", "op_class": None, "fp": None,
+         "wall_ns": 50, "self_wall_ns": 50, "batches": 1, "rows": 10,
+         "counters": {}, "metrics": {}, "fallback": False},
+        {"ev": "worker_span", "ts_ns": 10, "op": "", "worker_id": "w0",
+         "kind": "put", "trace": trace, "span": "0", "exch": 1,
+         "pid": 0, "seq": 0, "bytes": 64, "dur_ns": 5},
+        {"ev": "worker_telemetry", "ts_ns": 20, "op": "",
+         "worker_id": "w0", "blocks": 1, "bytes": 64, "mem_used": 64,
+         "counters": {"store_puts": 3, "store_redrive_puts": 1,
+                      "store_fetches": 2, "store_bytes_served": 256,
+                      "store_overflow_bytes": 0}},
+        {"ev": "query_end", "ts_ns": 100, "op": "", "wall_ns": 100,
+         "status": "ok", "counters": {}},
+    ]
+    with open(os.path.join(log_dir, "query-qA.jsonl"), "w") as f:
+        f.write("\n".join(json.dumps(e) for e in qlog) + "\n")
+    loose = [
+        {"ev": "worker_span", "ts_ns": 30, "op": "", "worker_id": "w1",
+         "kind": "fetch", "trace": trace, "span": "", "exch": 1,
+         "pid": 0, "seq": 1, "bytes": 128, "dur_ns": 7},
+        {"ev": "worker_span", "ts_ns": 40, "op": "", "worker_id": "w9",
+         "kind": "put", "trace": "unknown-x", "span": "", "exch": 2,
+         "pid": 1, "seq": 0, "bytes": 32, "dur_ns": 3},
+    ]
+    with open(os.path.join(log_dir, "query-w1ring.jsonl"), "w") as f:
+        f.write("\n".join(json.dumps(e) for e in loose) + "\n")
+    return trace
+
+
+def test_report_attaches_loose_worker_spans_by_trace(tmp_path):
+    from spark_rapids_tpu.diagnostics.report import (
+        load_logs,
+        render_workers,
+        workers_summary,
+    )
+
+    _write_multiproc_logs(str(tmp_path))
+    profiles = load_logs([str(tmp_path)])
+    named = [qp for qp in profiles if qp.query_id]
+    assert len(named) == 1
+    qp = named[0]
+    # the loose w1 span attached to qA by trace id...
+    assert {e["worker_id"] for e in qp.events
+            if e["ev"] == "worker_span"} == {"w0", "w1"}
+    # ...and the unknown-trace orphan stayed behind, not discarded
+    anon = [p for p in profiles if not p.query_id]
+    assert len(anon) == 1
+    assert [e["worker_id"] for e in anon[0].events] == ["w9"]
+
+    ws = workers_summary(profiles)
+    assert set(ws["workers"]) == {"w0", "w1", "w9"}
+    assert ws["workers"]["w0"]["counters"]["store_puts"] == 3
+    assert ws["workers"]["w0"]["queries"] == ["qA"]
+    assert ws["workers"]["w1"]["by_kind"] == {"fetch": 1}
+    text = render_workers(ws)
+    assert "w0" in text and "redrive=1" in text
+
+
+def test_profile_report_cli_workers_json(tmp_path, capsys):
+    import profile_report
+
+    _write_multiproc_logs(str(tmp_path))
+    rc = profile_report.main([str(tmp_path), "--json", "--workers"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["workers"]["workers"]["w0"]["spans"] == 1
+    assert payload["workers"]["total_spans"] == 3
+
+
+def test_history_cluster_page(tmp_path):
+    import urllib.request
+
+    import history
+
+    _write_multiproc_logs(str(tmp_path))
+    rows = history.cluster_rows(history.load_profiles([str(tmp_path)]))
+    assert {r["worker_id"] for r in rows} == {"w0", "w1", "w9"}
+    w0 = next(r for r in rows if r["worker_id"] == "w0")
+    assert w0["store_puts"] == 3 and w0["store_redrive_puts"] == 1
+    srv, port = history.start_server([str(tmp_path)], 0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/cluster",
+                timeout=10) as resp:
+            assert resp.status == 200
+            api_rows = json.loads(resp.read().decode())
+        assert {r["worker_id"] for r in api_rows} == {"w0", "w1", "w9"}
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/cluster", timeout=10) as resp:
+            body = resp.read().decode()
+        assert "w0" in body and "cluster" in body
+        # query detail carries the trace id + merged worker spans
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/query/qA",
+                timeout=10) as resp:
+            detail = json.loads(resp.read().decode())
+        assert detail["trace_id"] == "abc-1-f"
+        assert len(detail["worker_spans"]) == 2
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# bench gate: the rung4_dist observability-overhead column
+# ---------------------------------------------------------------------------
+
+def test_bench_gate_trace_overhead_pin():
+    from bench_gate import gate
+
+    def payload(overhead):
+        return {"value": 1.0, "queries": {"rung4_dist": {
+            "tpu_s": 5.0, "killArmed": True, "workerLost": 1.0,
+            "partitionsReplayed": 2.0, "distBlocksShipped": 10.0,
+            "traceOnWall_s": 5.0 * (1 + overhead / 100.0),
+            "traceOffWall_s": 5.0, "traceOverheadPct": overhead}}}
+
+    assert gate(payload(3.0), payload(3.0)) == []
+    regs = gate(payload(3.0), payload(12.0))
+    assert any("observability overhead" in r for r in regs), regs
+    # records predating the column (None) stay ungated
+    old = payload(0.0)
+    old["queries"]["rung4_dist"]["traceOverheadPct"] = None
+    assert gate(old, old) == []

@@ -67,7 +67,8 @@ def _run_and_load(tmp_path, extra=None):
 # The golden copy: a schema drift (renamed field, dropped event type) must
 # fail HERE, not just in the generated docs.
 GOLDEN_SCHEMA = {
-    "query_start": ["query_id", "started_at", "metrics_level", "plan"],
+    "query_start": ["query_id", "trace_id", "started_at",
+                    "metrics_level", "plan"],
     "launch": ["dur_ns", "compiled"],
     "compile": ["mode", "dur_ns", "label"],
     "sync": ["kind", "dur_ns", "bytes"],
@@ -80,6 +81,10 @@ GOLDEN_SCHEMA = {
     "governor": ["action", "state", "prev", "pressure", "detail"],
     "distributed": ["kind", "worker_id", "detail", "n_workers",
                     "n_partitions"],
+    "worker_telemetry": ["worker_id", "blocks", "bytes", "mem_used",
+                         "counters"],
+    "worker_span": ["worker_id", "kind", "trace", "span", "exch",
+                    "pid", "seq", "bytes", "dur_ns"],
     "query_stall": ["query_id", "path", "name", "stalled_ms", "detail"],
     "progress": ["query_id", "pct", "eta_ns", "stalls", "background"],
     "op_batch": ["path", "batch", "rows", "dur_ns"],

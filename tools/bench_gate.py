@@ -61,6 +61,12 @@ RUNG3_OOC_SLACK_S = 2.0
 # workerLostMs detection window + re-drive, both latency- not
 # throughput-bound, so small runs need absolute headroom
 RUNG4_DIST_SLACK_S = 3.0
+# cluster-observability overhead pin (ISSUE 15): the rung4_dist
+# trace-on vs trace-off A/B (min of 2 runs per mode) must stay within
+# this many percent — trace propagation, heartbeat piggyback, and the
+# query-end worker-span merge are per-BLOCK / per-BEAT, never per-row,
+# so growth here means instrumentation leaked onto a hot path
+TRACE_OVERHEAD_MAX_PCT = 5.0
 SHED_RATE_SLACK = 0.05
 RECOVERY_SLACK_S = 1.0
 # progressOverhead (ISSUE 12): absolute percentage-point slack — the
@@ -253,6 +259,17 @@ def gate(base: Dict, new: Dict, tolerance: float = DEFAULT_TOLERANCE,
             regressions.append(
                 "rung4_dist: block traffic collapsed to 0 — the rung "
                 "no longer exercises the distributed exchange")
+        # observability-overhead column (ISSUE 15): absolute pin, not
+        # baseline-relative — the A/B is self-contained per run
+        op = n4.get("traceOverheadPct")
+        if op is not None and float(op) > TRACE_OVERHEAD_MAX_PCT:
+            regressions.append(
+                f"rung4_dist: cluster-observability overhead "
+                f"{float(op):+.1f}% exceeds the "
+                f"{TRACE_OVERHEAD_MAX_PCT:.0f}% pin (trace-on "
+                f"{float(n4.get('traceOnWall_s') or 0):.3f}s vs "
+                f"trace-off "
+                f"{float(n4.get('traceOffWall_s') or 0):.3f}s)")
 
     # progressOverhead (ISSUE 12 satellite): the live-progress
     # enabled-path tax must not creep across rounds.  Gated only when

@@ -121,6 +121,7 @@ def query_detail(qp, slo_target_ms: float) -> Dict[str, Any]:
                                         op.get("wall_ns", 0)))
     return {
         "query_id": qp.query_id,
+        "trace_id": qp.trace_id,
         "started_at": qp.started_at,
         "status": qp.status or "?",
         "slo": slo_status(qp, slo_target_ms),
@@ -143,6 +144,8 @@ def query_detail(qp, slo_target_ms: float) -> Dict[str, Any]:
                          if e.get("ev") == "query_stall"],
         "lifecycle": [e for e in qp.events
                       if e.get("ev") == "lifecycle"],
+        "worker_spans": [e for e in qp.events
+                         if e.get("ev") == "worker_span"],
         "totals": qp.totals,
         "incomplete": qp.incomplete,
         "log": qp.path,
@@ -153,6 +156,34 @@ def load_profiles(log_dirs: List[str]):
     from spark_rapids_tpu.diagnostics.report import load_logs
 
     return load_logs(log_dirs)
+
+
+def cluster_rows(profiles) -> List[Dict[str, Any]]:
+    """One row per WORKER (ISSUE 15): the cluster page over the merged
+    event logs — spans served, bytes moved, recovery traffic, the last
+    federated counter snapshot, and which queries each worker touched
+    (worker spans merge under their owning query by trace id, so this
+    is a pure function of the same logs the index serves)."""
+    from spark_rapids_tpu.diagnostics.report import workers_summary
+
+    ws = workers_summary(profiles)
+    rows = []
+    for wid, a in ws["workers"].items():
+        c = a["counters"]
+        rows.append({
+            "worker_id": wid,
+            "spans": a["spans"],
+            "bytes": a["bytes"],
+            "wall_ms": round(a["wall_ns"] / 1e6, 3),
+            "by_kind": a["by_kind"],
+            "queries": a["queries"],
+            "store_puts": c.get("store_puts", 0),
+            "store_redrive_puts": c.get("store_redrive_puts", 0),
+            "store_fetches": c.get("store_fetches", 0),
+            "store_bytes_served": c.get("store_bytes_served", 0),
+            "store_overflow_bytes": c.get("store_overflow_bytes", 0),
+        })
+    return rows
 
 
 # ---------------------------------------------------------------------------
@@ -193,7 +224,8 @@ def render_index_html(rows: List[Dict[str, Any]]) -> str:
             f"<td>{r['stalls']}</td>"
             f"<td>{cost.get('predicted_wall_ms', '')}</td>"
             f"<td>{cost.get('matched_actual_wall_ms', '')}</td></tr>")
-    body.append("</table></body></html>")
+    body.append("</table><p><a href='/cluster'>cluster (per-worker "
+                "view)</a></p></body></html>")
     return "\n".join(body)
 
 
@@ -247,6 +279,29 @@ def render_query_html(d: Dict[str, Any]) -> str:
     return "\n".join(body)
 
 
+def render_cluster_html(rows: List[Dict[str, Any]]) -> str:
+    body = [f"<html><head><title>cluster</title>{_STYLE}</head>",
+            f"<body><h2>cluster — {len(rows)} worker"
+            f"{'' if len(rows) == 1 else 's'}</h2><table>",
+            "<tr><th>worker</th><th>spans</th><th>bytes</th>"
+            "<th>wall_ms</th><th>puts</th><th>redrive</th>"
+            "<th>fetches</th><th>served_bytes</th>"
+            "<th>overflow_bytes</th><th>queries</th></tr>"]
+    for r in rows:
+        body.append(
+            f"<tr><td>{_esc(r['worker_id'])}</td><td>{r['spans']}</td>"
+            f"<td>{r['bytes']}</td><td>{r['wall_ms']:.1f}</td>"
+            f"<td>{r['store_puts']}</td>"
+            f"<td>{r['store_redrive_puts']}</td>"
+            f"<td>{r['store_fetches']}</td>"
+            f"<td>{r['store_bytes_served']}</td>"
+            f"<td>{r['store_overflow_bytes']}</td>"
+            f"<td>{len(r['queries'])}</td></tr>")
+    body.append("</table><p><a href='/'>back to index</a></p>"
+                "</body></html>")
+    return "\n".join(body)
+
+
 def render_index_text(rows: List[Dict[str, Any]]) -> str:
     lines = [f"query history ({len(rows)} queries)",
              f"{'query':<28} {'status':<10} {'slo':<10} "
@@ -283,6 +338,13 @@ class _Handler(BaseHTTPRequestHandler):
                 self._ok(json.dumps(index_rows(
                     profiles, self.slo_target_ms)).encode(),
                     "application/json; charset=utf-8")
+            elif path == "/cluster":
+                self._ok(render_cluster_html(
+                    cluster_rows(profiles)).encode(),
+                    "text/html; charset=utf-8")
+            elif path == "/api/cluster":
+                self._ok(json.dumps(cluster_rows(profiles)).encode(),
+                         "application/json; charset=utf-8")
             elif path.startswith(("/query/", "/api/query/")):
                 qid = path.rsplit("/", 1)[1]
                 qp = next((p for p in profiles if p.query_id == qid),

@@ -43,6 +43,13 @@ def main(argv=None) -> int:
                     help="aggregate query_stall events (ISSUE 12): "
                          "which operators queries wedge in, how often, "
                          "for how long")
+    ap.add_argument("--workers", action="store_true",
+                    help="aggregate cluster-observability events "
+                         "(ISSUE 15): worker spans grouped by trace id "
+                         "under their owning queries, per-worker "
+                         "federated counters (multi-process logs — "
+                         "loose worker-span files attach to loaded "
+                         "queries by trace id)")
     args = ap.parse_args(argv)
 
     from spark_rapids_tpu.diagnostics.report import (
@@ -52,10 +59,12 @@ def main(argv=None) -> int:
         render_diff,
         render_report,
         render_stalls,
+        render_workers,
         resilience_summary,
         stalls_summary,
         top_operators,
         totals_summary,
+        workers_summary,
     )
 
     profiles = load_logs(args.logs)
@@ -94,6 +103,8 @@ def main(argv=None) -> int:
         }
         if args.stalls:
             payload["stalls"] = stalls_summary(profiles)
+        if args.workers:
+            payload["workers"] = workers_summary(profiles)
         if args.diff:
             payload["diff"] = diff_profiles(load_logs([args.diff]),
                                             profiles)
@@ -104,6 +115,9 @@ def main(argv=None) -> int:
     if args.stalls:
         print()
         print(render_stalls(stalls_summary(profiles)))
+    if args.workers:
+        print()
+        print(render_workers(workers_summary(profiles)))
     if args.diff:
         print()
         print(render_diff(load_logs([args.diff]), profiles))

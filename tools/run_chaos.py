@@ -224,9 +224,14 @@ def run_pressure(seed: int) -> bool:
 
 
 def run_worker_kill_sweep(seed: int, workers: int, rounds: int,
-                          kills: int) -> bool:
+                          kills: int, telemetry_out: str = "") -> bool:
     """The --worker-kill sweep: distributed-join replay under random
-    SIGKILL/SIGSTOP worker churn (run_stress.run_worker_kill)."""
+    SIGKILL/SIGSTOP worker churn (run_stress.run_worker_kill).  With
+    ``--telemetry-out`` the federated per-worker timeline (sampler
+    rows carrying the per-tick ``workers`` map + the labeled series
+    snapshot) lands in the JSON, and the sweep asserts every kill's
+    merged post-mortem NAMES the killed worker and carries its
+    last-shipped diagnostics ring (ISSUE 15)."""
     import json
 
     from run_stress import run_worker_kill
@@ -234,16 +239,25 @@ def run_worker_kill_sweep(seed: int, workers: int, rounds: int,
     print(f"\n== worker-kill sweep ({workers} workers, {rounds} rounds, "
           f"{kills} kill rounds, SIGKILL/SIGSTOP mix) ==")
     s = run_worker_kill(n_workers=workers, rounds=rounds, seed=seed,
-                        kills=kills, quiet=False)
+                        kills=kills, quiet=False,
+                        telemetry_out=telemetry_out)
     print(json.dumps({k: s[k] for k in (
         "rounds", "ok", "kills", "worker_lost", "partitions_replayed",
-        "heartbeat_misses", "workers_joined", "blocks_shipped")},
+        "heartbeat_misses", "workers_joined", "blocks_shipped",
+        "blocks_unacked", "merged_postmortems")},
         indent=2, default=str))
+    if telemetry_out:
+        print(f"federated per-worker timeline: {telemetry_out} "
+              f"({s['telemetry'].get('ticks', 0)} ticks, "
+              f"{len(s['worker_series'])} labeled series families)")
     for f in s["failures"]:
         print(f"FAILURE: {f}")
     for leak in s["leaks"]:
         print(f"LEAK: {leak.splitlines()[0]}")
     ok = not s["failures"] and not s["leaks"] and s["ok"] == s["rounds"]
+    if s["kills"] and not s["merged_postmortems"]:
+        print("FAILURE: no merged post-mortem named a killed worker")
+        ok = False
     print("worker-kill sweep:", "OK" if ok else "FAILED")
     return ok
 
@@ -270,11 +284,17 @@ def main():
                     help="replay rounds for --worker-kill")
     ap.add_argument("--kills", type=int, default=2,
                     help="kill-armed rounds for --worker-kill")
+    ap.add_argument("--telemetry-out", default="",
+                    help="with --worker-kill: write the federated "
+                         "per-worker telemetry timeline (sampler ticks "
+                         "with per-worker counter maps) to this JSON "
+                         "file")
     args = ap.parse_args()
 
     if args.worker_kill:
-        return 0 if run_worker_kill_sweep(args.seed, args.workers,
-                                          args.rounds, args.kills) else 1
+        return 0 if run_worker_kill_sweep(
+            args.seed, args.workers, args.rounds, args.kills,
+            telemetry_out=args.telemetry_out) else 1
     if args.pressure:
         return 0 if run_pressure(args.seed) else 1
     if args.corrupt_inputs:

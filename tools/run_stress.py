@@ -666,7 +666,8 @@ def run_hot_cache(n_threads: int = 8, rounds: int = 3,
 def run_worker_kill(n_workers: int = 3, rounds: int = 4, seed: int = 7,
                     kills: int = 2, suspend: bool = True,
                     rows: int = 60_000, worker_mem: int = 8 << 10,
-                    quiet: bool = False) -> dict:
+                    quiet: bool = False,
+                    telemetry_out: str = "") -> dict:
     """ISSUE 14: the --worker-kill chaos engine — a distributed join
     replay over ``n_workers`` worker PROCESSES while random workers are
     SIGKILLed (and, with ``suspend``, SIGSTOPped) mid-shuffle.  Pins:
@@ -816,6 +817,35 @@ def run_worker_kill(n_workers: int = 3, rounds: int = 4, seed: int = 7,
             for (r, a, w) in kill_log
             if coord.worker_state(w) not in ("LOST", None))
         leaks = leak_report_all()
+        # merged post-mortems (ISSUE 15): every kill's worker_lost
+        # bundle must NAME the killed worker and carry its last-shipped
+        # federated diagnostics (mirror ring + counter snapshot) — the
+        # driver-only bundle of PR 14 no longer passes
+        from spark_rapids_tpu import telemetry as _tel
+
+        hub = _tel.get_hub()
+        merged_postmortems = 0
+        if hub is not None and hub.flight_enabled:
+            bundles = {b.get("worker_id"): b for b in hub.postmortems
+                       if b.get("reason") == "worker_lost"}
+            for (r, a, wid) in kill_log:
+                b = bundles.get(wid)
+                if b is None:
+                    failures.append(
+                        f"round {r}: no worker_lost post-mortem names "
+                        f"killed worker {wid}")
+                    continue
+                merged_postmortems += 1
+                if not isinstance(b.get("worker_diagnostics"), dict):
+                    failures.append(
+                        f"round {r}: post-mortem for {wid} is not "
+                        f"merged (no worker_diagnostics payload)")
+        # the federated per-worker timeline (sampler rows carry a
+        # per-tick `workers` map) + labeled series snapshot
+        telemetry_summary = _dump_telemetry(telemetry_out)
+        worker_series = {}
+        if hub is not None:
+            worker_series = hub.registry.snapshot().get("labeled", {})
         return {
             "mode": "worker_kill", "rounds": rounds, "ok": ok,
             "workers": n_workers, "kills": kill_log,
@@ -824,6 +854,10 @@ def run_worker_kill(n_workers: int = 3, rounds: int = 4, seed: int = 7,
             "heartbeat_misses": d["worker_heartbeat_misses"],
             "workers_joined": d["workers_joined"],
             "blocks_shipped": d["dist_blocks_shipped"],
+            "blocks_unacked": coord.gauges()["dist_blocks_unacked"],
+            "merged_postmortems": merged_postmortems,
+            "worker_series": worker_series,
+            "telemetry": telemetry_summary,
             "failures": failures, "leaks": leaks,
         }
     finally:
@@ -885,12 +919,14 @@ def main() -> int:
     n_threads = args.threads or (16 if args.overload else 8)
     if args.worker_kill:
         s = run_worker_kill(n_workers=args.workers, rounds=args.rounds,
-                            seed=args.seed, kills=args.kills)
+                            seed=args.seed, kills=args.kills,
+                            telemetry_out=args.telemetry_out)
         ok = not s["failures"] and not s["leaks"]
         print(("PASS" if ok else "FAIL")
               + f": {s['ok']}/{s['rounds']} rounds correct under "
               f"{len(s['kills'])} kills ({s['worker_lost']} losses, "
-              f"{s['partitions_replayed']} partitions replayed)")
+              f"{s['partitions_replayed']} partitions replayed, "
+              f"{s['merged_postmortems']} merged post-mortems)")
         for f in s["failures"]:
             print(f"FAILURE: {f}")
         return 0 if ok else 1
