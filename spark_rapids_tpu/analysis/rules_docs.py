@@ -476,6 +476,54 @@ def doc_drift_problems(repo_root: str) -> List[str]:
                 f"docs/{name} does not cross-link "
                 f"docs/cluster_observability.md")
 
+    # crash-consistent recovery (ISSUE 16): confs + counters + the
+    # recovery event + the journal/checkpoint/lease surface vocabulary
+    # must be documented in docs/recovery.md (confs in configs.md,
+    # counters ALSO in diagnostics.md via the global check)
+    rec_md = read("recovery.md")
+    rec_confs = [k for k in _REGISTRY
+                 if k.startswith("spark.rapids.tpu.recovery.")]
+    if not rec_confs:
+        problems.append("no spark.rapids.tpu.recovery.* confs "
+                        "registered")
+    for key in sorted(rec_confs):
+        if f"`{key}`" not in rec_md:
+            problems.append(
+                f"conf '{key}' is not documented in docs/recovery.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+    for key in ("journal_records_written", "stages_recovered",
+                "queries_resumed", "journal_recovery_discards",
+                "recovery_leases_expired"):
+        if key not in PC.COUNTERS:
+            problems.append(f"recovery counter '{key}' is not "
+                            f"registered in perfcounters.COUNTERS")
+        if f"`{key}`" not in rec_md:
+            problems.append(
+                f"recovery counter '{key}' is not documented in "
+                f"docs/recovery.md")
+    if "recovery" not in EVENT_SCHEMA:
+        problems.append("diagnostics event type 'recovery' is not "
+                        "registered in EVENT_SCHEMA")
+    for word in ("`TKJ1`", "`journal.wal`", "`journal.replay`",
+                 "`coordinator.endpoint`", "MANIFEST.json",
+                 "`completed`", "`resumable`", "`abandoned`",
+                 "`--driver-kill`", "re-HELLO", "lease",
+                 "`stage_committed`", "`stage_recovered`",
+                 "`driver_crash`", "run_chaos.py", "rung5_recovery",
+                 "journalOverheadPct"):
+        if word not in rec_md:
+            problems.append(
+                f"recovery surface vocabulary {word} is not "
+                f"documented in docs/recovery.md")
+    for name, md in (("distributed.md", dist_md),
+                     ("concurrency.md", conc_md)):
+        if "recovery.md" not in md:
+            problems.append(
+                f"docs/{name} does not cross-link docs/recovery.md")
+
     # tracelint (ISSUE 11): every lint rule id and the fusibility
     # manifest vocabulary must be documented in docs/static_analysis.md
     from spark_rapids_tpu.analysis.core import all_rule_ids

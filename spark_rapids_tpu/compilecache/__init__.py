@@ -25,8 +25,80 @@ The cross-process half rides JAX's on-disk compilation cache
 (``jax_compilation_cache_dir``), pointed at ``spark.rapids.tpu.compile.
 cacheDir`` by the session (see session._apply_compile_cache) — a fresh
 process re-running the same plan deserializes executables instead of
-compiling.
+compiling.  Every path that enables the on-disk cache must first call
+:func:`ensure_atomic_cache_put` (crash-consistent entry publication —
+see its docstring for why torn entries segfault).
 """
+import os
+import time
+
+_ATOMIC_PUT_APPLIED = False
+
+
+def ensure_atomic_cache_put() -> None:
+    """Make jax's persistent compile-cache writes crash-consistent.
+
+    Stock ``jax._src.lru_cache.LRUCache.put`` writes the serialized
+    executable to its FINAL path with one plain ``write_bytes`` — no
+    tmp+rename.  Two real failure modes follow: a process killed
+    mid-write (a crashed driver; the --driver-kill harness lands
+    SIGKILLs exactly there) leaves a truncated entry at the final
+    path, and a concurrent reader — the AOT background pool in this
+    process, or a worker process sharing the directory — can read a
+    half-written file.  Either way ``deserialize_executable`` on torn
+    bytes SEGFAULTS the reader, possibly a completely different
+    process days later.  Re-bind ``put`` to stage the bytes beside the
+    final path and publish with ``os.replace``, so an entry is either
+    absent or complete — the same discipline as the recovery journal's
+    checkpoint commit (docs/recovery.md).  Idempotent; a jax without
+    this cache layout is left untouched.
+    """
+    global _ATOMIC_PUT_APPLIED
+    if _ATOMIC_PUT_APPLIED:
+        return
+    try:
+        from jax._src import lru_cache as _lru
+
+        _lru.LRUCache  # noqa: B018 — layout probe
+    except Exception:
+        return
+
+    def _atomic_put(self, key, val):
+        if not key:
+            raise ValueError("key cannot be empty")
+        if self.eviction_enabled and len(val) > self.max_size:
+            return
+        cache_path = self.path / f"{key}{_lru._CACHE_SUFFIX}"
+        atime_path = self.path / f"{key}{_lru._ATIME_SUFFIX}"
+        if self.eviction_enabled:
+            self.lock.acquire(timeout=self.lock_timeout_secs)
+        try:
+            if cache_path.exists():
+                return
+            self._evict_if_needed(additional_size=len(val))
+            tmp = cache_path.with_name(
+                cache_path.name + f".tmp.{os.getpid()}")
+            try:
+                tmp.write_bytes(val)
+                os.replace(tmp, cache_path)
+            except OSError:
+                # a broken disk degrades caching, never the query
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                return
+            try:
+                atime_path.write_bytes(
+                    time.time_ns().to_bytes(8, "little"))
+            except OSError:
+                pass
+        finally:
+            if self.eviction_enabled:
+                self.lock.release()
+
+    _lru.LRUCache.put = _atomic_put
+    _ATOMIC_PUT_APPLIED = True
 from spark_rapids_tpu.compilecache.keys import (  # noqa: F401
     conf_fp,
     exprs_fp,

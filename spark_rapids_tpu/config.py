@@ -391,6 +391,53 @@ DISTRIBUTED_TELEMETRY_RING = conf(
     "(its 'last-shipped' ring).  0 disables worker span recording "
     "(counters still federate).").long_conf(512)
 
+# --- crash-consistent driver recovery (ISSUE 16) ---------------------------
+
+RECOVERY_ENABLED = conf("spark.rapids.tpu.recovery.enabled").doc(
+    "Crash-consistent driver recovery (docs/recovery.md): every "
+    "collect() appends admission / stage-checkpoint / end records to a "
+    "durable CRC-framed query journal (lifecycle/journal.py), "
+    "materialized exchange outputs commit at stage boundaries (local: "
+    "atomic tmp+rename checkpoint files keyed by plan-stage "
+    "fingerprint; distributed: worker-held partitions pinned by a "
+    "journal-recorded lease), and a restarted driver replays the "
+    "journal to classify prior queries as completed / resumable / "
+    "abandoned and to skip committed stages on re-execution "
+    "(stages_recovered).  Off, the journal module is never imported — "
+    "the hot path makes zero recovery calls.").boolean_conf(False)
+
+RECOVERY_DIR = conf("spark.rapids.tpu.recovery.dir").doc(
+    "Root directory for the query journal, stage checkpoints, and the "
+    "coordinator endpoint file workers re-attach through.  Must be "
+    "stable across driver restarts (recovery identity lives here).  "
+    "Unset: <tmpdir>/srt_recovery.").string_conf(None)
+
+RECOVERY_FSYNC = conf("spark.rapids.tpu.recovery.fsyncOnAppend").doc(
+    "Journal durability: fsync the journal after every appended "
+    "record (the spark.rapids.tpu.files.fsyncOnCommit discipline "
+    "applied to the WAL).  Off by default — single-write atomic "
+    "appends already keep the journal prefix-consistent; fsync adds a "
+    "per-record syscall and protects against machine (not process) "
+    "crashes.").boolean_conf(False)
+
+RECOVERY_LEASE_TTL_MS = conf("spark.rapids.tpu.recovery.leaseTtlMs").doc(
+    "How long a journal-recorded stage checkpoint (a distributed "
+    "lease pinning worker-held partitions, or a local checkpoint "
+    "directory) stays adoptable after the committing driver's death.  "
+    "A reborn driver retires anything older (recovery_leases_expired) "
+    "and re-executes from scratch — orphaned worker partitions must "
+    "not pin memory forever.").long_conf(120_000)
+
+RECOVERY_WORKER_REATTACH_MS = conf(
+    "spark.rapids.tpu.recovery.workerReattachMs").doc(
+    "How long a worker that lost its driver (heartbeat socket died) "
+    "keeps its store alive and retries re-attaching through the "
+    "recovery-dir endpoint file before giving up and exiting.  The "
+    "re-HELLO enumerates held (exchange, partition, seq-range) "
+    "inventory so the reborn coordinator can rebuild placement.  "
+    "0 keeps the pre-recovery behavior: a dead control socket ends "
+    "the worker.").long_conf(30_000)
+
 # --- resilience (stage-level fault domains) --------------------------------
 
 RESILIENCE_ENABLED = conf("spark.rapids.tpu.resilience.enabled").doc(

@@ -57,6 +57,7 @@ EVENT_SCHEMA: Dict[str, List[str]] = {
                     "n_partitions"],
     "worker_telemetry": ["worker_id", "blocks", "bytes", "mem_used",
                          "counters"],
+    "recovery": ["kind", "fp", "detail", "n"],
     "worker_span": ["worker_id", "kind", "trace", "span", "exch",
                     "pid", "seq", "bytes", "dur_ns"],
     "query_stall": ["query_id", "path", "name", "stalled_ms", "detail"],
@@ -398,6 +399,19 @@ class QueryDiagnostics:
                     detail=str(detail)[:500],
                     n_workers=int(n_workers),
                     n_partitions=int(n_partitions))
+
+    def recovery(self, kind: str, fp: str, detail: str,
+                 n: int = 0) -> None:
+        """A crash-recovery event (ISSUE 16, docs/recovery.md):
+        ``stage_committed`` (one exchange's materialized output became
+        durable — local checkpoint renamed or distributed lease
+        journaled), ``stage_recovered`` (a committed stage served
+        instead of re-executing; ``n`` counts partitions),
+        ``checkpoint_discarded`` (a damaged/expired artifact degraded
+        to full re-execution), or ``query_resumed`` (this query
+        adopted at least one prior-incarnation stage)."""
+        self._event(ESSENTIAL, "recovery", kind=kind, fp=str(fp),
+                    detail=str(detail)[:500], n=int(n))
 
     def worker_telemetry(self, worker_id: str, blocks: int, bytes_: int,
                          mem_used: int, counters: Dict[str, int]) -> None:

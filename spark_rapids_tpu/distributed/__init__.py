@@ -76,12 +76,17 @@ def spawn_local_worker(coordinator: Coordinator, worker_id: str,
                        warm_compile_dir: Optional[str] = None,
                        op_timeout_ms: Optional[int] = None,
                        telemetry_ring: Optional[int] = None,
-                       extra_env: Optional[dict] = None
+                       extra_env: Optional[dict] = None,
+                       reattach_ms: Optional[int] = None,
+                       endpoint_file: Optional[str] = None
                        ) -> subprocess.Popen:
     """Launch one worker PROCESS against the given coordinator (tests,
     the chaos sweep, and bench all spawn through here).  The child runs
     on the CPU backend regardless of the parent's platform — workers
-    hold serialized blocks, not device state."""
+    hold serialized blocks, not device state.  ``reattach_ms`` +
+    ``endpoint_file`` arm crash recovery (ISSUE 16): the worker
+    survives THIS driver's death and re-dials whatever endpoint the
+    successor publishes."""
     hb = heartbeat_ms if heartbeat_ms is not None \
         else int(coordinator.heartbeat_s * 1000)
     ot = op_timeout_ms if op_timeout_ms is not None \
@@ -99,6 +104,10 @@ def spawn_local_worker(coordinator: Coordinator, worker_id: str,
         cmd += ["--spill-dir", spill_dir]
     if warm_compile_dir:
         cmd += ["--warm-compile-dir", warm_compile_dir]
+    if reattach_ms:
+        cmd += ["--reattach-ms", str(int(reattach_ms))]
+    if endpoint_file:
+        cmd += ["--endpoint-file", endpoint_file]
     env = dict(os.environ)
     # unconditional: workers hold serialized blocks, not device state,
     # and on a real TPU host an inherited JAX_PLATFORMS=tpu would have

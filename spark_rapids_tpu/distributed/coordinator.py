@@ -62,7 +62,7 @@ class WorkerInfo:
                  "state", "last_hb", "joined_at", "control",
                  "hb_missed", "probe_failed", "warmed_entries",
                  "counters", "store_stats", "mirror", "mirror_last_n",
-                 "clock_offset_s")
+                 "clock_offset_s", "held")
 
     def __init__(self, worker_id: str, host: str, data_port: int,
                  pid: int, mem_bytes: int, control: socket.socket,
@@ -91,6 +91,11 @@ class WorkerInfo:
         self.mirror: deque = deque(maxlen=max(int(mirror_capacity), 1))
         self.mirror_last_n = 0
         self.clock_offset_s: Optional[float] = None
+        # crash recovery (ISSUE 16): the (wire_exch, pid, n_blocks,
+        # max_seq) inventory a re-attaching worker enumerated in its
+        # HELLO — what a reborn coordinator rebuilds the placement map
+        # from when adopting a journaled stage lease
+        self.held: List[Tuple[int, int, int, int]] = []
 
 
 class Coordinator:
@@ -168,6 +173,23 @@ class Coordinator:
         self._listener.bind(("127.0.0.1", 0))
         self._listener.listen(32)
         self.port = self._listener.getsockname()[1]
+        # crash recovery (ISSUE 16): publish this incarnation's control
+        # endpoint under the recovery root so workers that outlived a
+        # dead driver re-dial the successor (atomic tmp+rename; workers
+        # poll the file during their bounded re-attach window)
+        from spark_rapids_tpu.config import RECOVERY_ENABLED
+
+        if bool(c.get(RECOVERY_ENABLED)):
+            from spark_rapids_tpu.lifecycle import journal as _journal
+
+            try:
+                _journal.write_endpoint(_journal.resolve_root(c),
+                                        "127.0.0.1", self.port)
+            # tpulint: disable=cancel-swallow (durability isolation: an
+            # unwritable endpoint file degrades re-attach, never the
+            # coordinator itself)
+            except Exception:
+                pass
         self._threads: List[threading.Thread] = []
         for target, name in ((self._accept_loop, "accept"),
                              (self._monitor_loop, "monitor")):
@@ -250,6 +272,19 @@ class Coordinator:
             # send wall.  Overestimates by the one-way frame latency;
             # heartbeats refine it (min over samples, see _fold below)
             info.clock_offset_s = time.time() - float(header["t_wall"])
+        inventory = header.get("held") or []
+        if inventory:
+            # recovery re-HELLO (ISSUE 16): the worker outlived a dead
+            # driver and is re-attaching with its held partitions.  Its
+            # prior incarnation's control socket died WITH the driver,
+            # so any ("DistributedWorker", id) breaker entry that loss
+            # left behind is about the crash, not about this worker —
+            # clear it outright; quarantining the one process that
+            # still holds the checkpointed blocks would turn a
+            # resumable query into a full re-execution
+            info.held = [(int(e), int(p), int(n), int(mx))
+                         for e, p, n, mx in inventory]
+            get_breaker().clear_key((BREAKER_OP, wid))
         # flapping-worker quarantine: a worker id whose loss history
         # holds the breaker OPEN joins QUARANTINED (heartbeats, but is
         # never placed) until the TTL re-probe admits it again
@@ -258,6 +293,19 @@ class Coordinator:
         if held is not None:
             info.state = QUARANTINED
         with self._lock:
+            if info.held:
+                # cross-incarnation wire-id safety: this coordinator's
+                # counter restarted at 1, but the re-attached worker's
+                # store still keys blocks by the DEAD incarnation's wire
+                # ids — minting a colliding id would let stale
+                # (CRC-valid!) blocks satisfy a new exchange's
+                # completeness check with wrong rows.  Reseed past the
+                # inventory's max before any place() can run.
+                import itertools as _it
+
+                nxt = next(self._wire_ids)
+                top = max(e for e, _p, _n, _mx in info.held) + 1
+                self._wire_ids = _it.count(max(nxt, top))
             old = self._workers.get(wid)
             if old is not None and old.counters:
                 # the superseded incarnation's put receipts retire into
@@ -584,6 +632,77 @@ class Coordinator:
         with self._lock:
             return {p: w for (e, p), w in self._placement.items()
                     if e == exch}
+
+    def wire_of(self, exch: int) -> int:
+        """Public wire-id accessor (ISSUE 16): the identifier a stage
+        lease journals — the one that survives a driver restart,
+        because worker stores key blocks under it."""
+        return self._wire(exch)
+
+    def worker_inventory(self) -> Dict[str, List[Tuple[int, int, int,
+                                                       int]]]:
+        """Every live worker's re-HELLO-enumerated holdings:
+        worker_id -> [(wire_exch, pid, n_blocks, max_seq), ...].  Empty
+        lists for workers that joined fresh — the lease-adoption check
+        in lifecycle/journal.py matches journaled block counts against
+        this."""
+        with self._lock:
+            return {wid: list(w.held)
+                    for wid, w in self._workers.items()
+                    if w.state == ALIVE}
+
+    def adopt_exchange(self, wire: int, placement: Dict[int, str],
+                       counts: Optional[Dict[int, int]] = None) -> None:
+        """Rebuild one journaled exchange's placement from re-attached
+        workers' inventories (ISSUE 16).  The exchange registers under
+        its ORIGINAL wire id (that is the key the worker stores hold),
+        holdings are restored so the leak gate and gauges track the
+        adopted blocks, and the wire-id counter reseeds past it so a
+        fresh place() can never mint a colliding id."""
+        import itertools as _it
+
+        with self._lock:
+            self._wire_of[wire] = wire
+            for pid, wid in placement.items():
+                self._placement[(wire, pid)] = wid
+                if counts:
+                    self._holdings[(wire, pid)] = int(
+                        counts.get(pid, 0))
+            nxt = next(self._wire_ids)
+            self._wire_ids = _it.count(max(nxt, wire + 1))
+        self._diag_event("exchange_adopted", "-",
+                         f"wire={wire} n_parts={len(placement)}")
+
+    def release_orphan_holdings(self, keep: Set[int]) -> int:
+        """Release every re-HELLO-held wire id that is neither in
+        ``keep`` (still-adoptable journaled leases) nor currently placed
+        (an adoption mid-serve) — blocks a dead incarnation shipped but
+        never lease-committed must not outlive its journal (ISSUE 16:
+        the zero-stranded-partitions pin).  Returns wires released."""
+        with self._lock:
+            placed = set(self._wire_of.values())
+            victims: Dict[str, Set[int]] = {}
+            for wid, w in self._workers.items():
+                if w.state != ALIVE or not w.held:
+                    continue
+                drop = {e for (e, _p, _n, _mx) in w.held
+                        if e not in keep and e not in placed}
+                if drop:
+                    victims[wid] = drop
+                    w.held = [h for h in w.held if h[0] not in drop]
+        n = 0
+        for wid, wires in sorted(victims.items()):
+            for wire in sorted(wires):
+                try:
+                    self._request(wid, {"op": "release", "exch": wire},
+                                  cancellable=False)
+                    n += 1
+                except (WorkerLost, RuntimeError, OSError):
+                    # a dead/slow worker's store dies with its process
+                    pass
+            self._diag_event("orphans_released", wid,
+                             f"wires={sorted(wires)}")
+        return n
 
     def claim_redrives(self, exch: int) -> Set[int]:
         """Atomically take (and clear) the exchange's pending re-drive

@@ -258,6 +258,14 @@ class PartitionStore:
                             pass
             return dropped
 
+    def inventory(self) -> List[Tuple[int, int, int, int]]:
+        """Every held partition as (exch, pid, n_blocks, max_seq) —
+        what a recovery re-HELLO enumerates so a reborn coordinator can
+        rebuild its placement map from surviving workers (ISSUE 16)."""
+        with self._lock:
+            return [(e, p, len(d), max(d) if d else -1)
+                    for (e, p), d in sorted(self._parts.items())]
+
     def stats(self) -> Dict:
         with self._lock:
             return {"blocks": self.blocks, "bytes": self.bytes,
@@ -314,6 +322,11 @@ def _warm_caches(compile_dir: Optional[str]) -> int:
     try:
         import jax
 
+        from spark_rapids_tpu.compilecache import ensure_atomic_cache_put
+
+        # N workers + the driver write this SHARED directory; stock
+        # jax publishes entries non-atomically (see the helper)
+        ensure_atomic_cache_put()
         jax.config.update("jax_compilation_cache_dir", compile_dir)
         return len([f for f in os.listdir(compile_dir)
                     if not f.startswith(".")]) if os.path.isdir(
@@ -326,16 +339,27 @@ class WorkerServer:
     """The in-process server object (the CLI main() instantiates one;
     tests drive it directly for protocol-level coverage)."""
 
-    def __init__(self, coordinator: Tuple[str, int], worker_id: str,
+    def __init__(self, coordinator: Optional[Tuple[str, int]],
+                 worker_id: str,
                  mem_bytes: int = 64 << 20, heartbeat_ms: int = 200,
                  spill_dir: Optional[str] = None,
                  warm_compile_dir: Optional[str] = None,
                  op_timeout_ms: int = 4000,
-                 telemetry_ring: int = 512):
+                 telemetry_ring: int = 512,
+                 reattach_ms: int = 0,
+                 endpoint_file: Optional[str] = None):
         self.coordinator = coordinator
         self.worker_id = worker_id
         self.heartbeat_s = max(heartbeat_ms, 10) / 1000.0
         self.op_timeout_s = max(op_timeout_ms, 100) / 1000.0
+        # crash recovery (ISSUE 16): with a re-attach window the worker
+        # OUTLIVES a dead driver — heartbeat loss enters a bounded
+        # re-dial loop against the endpoint file the successor
+        # coordinator publishes, re-HELLOing with the held-partition
+        # inventory.  0 (default) keeps the pre-recovery behavior:
+        # membership ends when the control socket dies.
+        self.reattach_ms = max(int(reattach_ms), 0)
+        self.endpoint_file = endpoint_file
         if spill_dir is None:
             reap_stale_spill_dirs()
         self.telemetry = WorkerTelemetry(telemetry_ring)
@@ -344,32 +368,76 @@ class WorkerServer:
         self.warmed_entries = _warm_caches(warm_compile_dir)
         self.mem_bytes = mem_bytes
         self._stop = threading.Event()
+        self._reattaching = threading.Event()
         self._listener: Optional[socket.socket] = None
         self._control: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
         self.data_port: Optional[int] = None
 
     # -- lifecycle -------------------------------------------------------
+    def _resolve_endpoint(self) -> Optional[Tuple[str, int]]:
+        """The coordinator endpoint to dial: the endpoint file (re-read
+        every attempt — a reborn coordinator publishes a NEW port) when
+        configured, else the fixed --coordinator address."""
+        if self.endpoint_file:
+            try:
+                with open(self.endpoint_file) as f:
+                    host, port = f.read().strip().rsplit(":", 1)
+                return host, int(port)
+            except (OSError, ValueError):
+                pass
+        return self.coordinator
+
+    def _join(self, endpoint: Tuple[str, int],
+              reattach: bool) -> socket.socket:
+        """Dial + HELLO + welcome on one control socket.  A recovery
+        re-HELLO (``reattach``) enumerates the held-partition inventory
+        so the coordinator can rebuild placement for journaled stage
+        leases."""
+        c = P.connect(endpoint[0], endpoint[1], self.op_timeout_s)
+        try:
+            P.send_msg(c, {
+                "op": "hello", "worker_id": self.worker_id,
+                "data_port": self.data_port, "pid": os.getpid(),
+                "mem_bytes": self.mem_bytes,
+                "warmed_entries": self.warmed_entries,
+                "reattach": bool(reattach),
+                "held": (self.store.inventory() if reattach else []),
+                # clock-offset handshake (ISSUE 15): the coordinator
+                # estimates offset = its receipt wall-clock minus this,
+                # so worker ring timestamps align onto the driver
+                # timeline
+                "t_wall": time.time()})
+            rep, _ = P.recv_msg(c)
+            if rep.get("op") != "welcome":
+                raise ConnectionError(f"unexpected join reply: {rep}")
+        except BaseException:
+            try:
+                c.close()
+            except OSError:
+                pass
+            raise
+        return c
+
     def start(self) -> None:
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind(("127.0.0.1", 0))
         self._listener.listen(32)
         self.data_port = self._listener.getsockname()[1]
-        host, port = self.coordinator
-        self._control = P.connect(host, port, self.op_timeout_s)
-        P.send_msg(self._control, {
-            "op": "hello", "worker_id": self.worker_id,
-            "data_port": self.data_port, "pid": os.getpid(),
-            "mem_bytes": self.mem_bytes,
-            "warmed_entries": self.warmed_entries,
-            # clock-offset handshake (ISSUE 15): the coordinator
-            # estimates offset = its receipt wall-clock minus this, so
-            # worker ring timestamps align onto the driver timeline
-            "t_wall": time.time()})
-        rep, _ = P.recv_msg(self._control)
-        if rep.get("op") != "welcome":
-            raise ConnectionError(f"unexpected join reply: {rep}")
+        endpoint = self._resolve_endpoint()
+        if endpoint is None and self.endpoint_file:
+            # endpoint-file mode may race the coordinator's startup:
+            # wait briefly for the file to appear
+            deadline = time.monotonic() + self.op_timeout_s * 4
+            while endpoint is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+                endpoint = self._resolve_endpoint()
+        if endpoint is None:
+            raise ConnectionError("no coordinator endpoint (neither "
+                                  "--coordinator nor a readable "
+                                  "endpoint file)")
+        self._control = self._join(endpoint, reattach=False)
         for target, name in ((self._serve_loop, "accept"),
                              (self._heartbeat_loop, "heartbeat")):
             t = threading.Thread(
@@ -397,9 +465,12 @@ class WorkerServer:
 
     def run_forever(self) -> None:
         """Block until the control socket dies (coordinator gone or it
-        evicted us) or stop() is called — the CLI process's main loop."""
+        evicted us) or stop() is called — the CLI process's main loop.
+        A re-attach in progress (ISSUE 16) is NOT a dead control: the
+        process must stay up through the bounded re-dial window, or the
+        held partitions die with it."""
         while not self._stop.wait(self.heartbeat_s):
-            if self._control is None:
+            if self._control is None and not self._reattaching.is_set():
                 break
 
     # -- heartbeats ------------------------------------------------------
@@ -421,12 +492,51 @@ class WorkerServer:
                                "t_wall": time.time(),
                                **self.store.stats()})
             except OSError:
-                # the coordinator hung up: a LOST declaration closed our
-                # socket, or the coordinator itself died — either way
-                # this worker's membership is over
+                # the coordinator hung up: a LOST declaration closed
+                # our socket, or the coordinator itself died.  With a
+                # re-attach window (ISSUE 16) the DRIVER dying is
+                # survivable — keep the held partitions and re-dial the
+                # successor; only an exhausted window ends membership
+                if self._try_reattach():
+                    continue
                 self._stop.set()
                 self._control = None
                 return
+
+    def _try_reattach(self) -> bool:
+        """Bounded re-attach loop (ISSUE 16): re-resolve the endpoint
+        (the successor coordinator publishes a NEW port in the endpoint
+        file), re-HELLO with the held-partition inventory, and resume
+        heartbeating on success.  False when the window is 0 (recovery
+        off), stop() raced, or the deadline exhausted — the caller then
+        falls back to the pre-recovery death path."""
+        if self.reattach_ms <= 0 or self._stop.is_set():
+            return False
+        self._reattaching.set()
+        try:
+            old, self._control = self._control, None
+            if old is not None:
+                try:
+                    old.close()
+                except OSError:
+                    pass
+            deadline = time.monotonic() + self.reattach_ms / 1000.0
+            while not self._stop.is_set() \
+                    and time.monotonic() < deadline:
+                endpoint = self._resolve_endpoint()
+                if endpoint is not None:
+                    try:
+                        self._control = self._join(endpoint,
+                                                   reattach=True)
+                        return True
+                    except (OSError, ConnectionError,
+                            P.ProtocolCorruption):
+                        pass
+                if self._stop.wait(min(self.heartbeat_s, 0.2)):
+                    return False
+            return False
+        finally:
+            self._reattaching.clear()
 
     # -- data plane ------------------------------------------------------
     def _serve_loop(self) -> None:
@@ -533,8 +643,19 @@ class WorkerServer:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--coordinator", required=True,
-                    help="host:port of the coordinator's listener")
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of the coordinator's listener "
+                         "(or use --endpoint-file)")
+    ap.add_argument("--endpoint-file", default=None,
+                    help="path of the coordinator.endpoint file under "
+                         "the recovery root — re-read on every "
+                         "(re-)attach so a reborn coordinator's new "
+                         "port is found (ISSUE 16)")
+    ap.add_argument("--reattach-ms", type=int, default=0,
+                    help="on heartbeat loss, re-dial the coordinator "
+                         "for up to this many ms instead of exiting "
+                         "(0: exit immediately — pre-recovery "
+                         "behavior)")
     ap.add_argument("--worker-id",
                     default=f"w-{os.getpid()}")
     ap.add_argument("--mem-bytes", type=int, default=64 << 20)
@@ -547,13 +668,18 @@ def main(argv=None) -> int:
                          "(0 disables span recording; counters still "
                          "federate over heartbeats)")
     args = ap.parse_args(argv)
+    if not args.coordinator and not args.endpoint_file:
+        ap.error("one of --coordinator / --endpoint-file is required")
 
     srv = WorkerServer(
-        P.parse_endpoint(args.coordinator), args.worker_id,
+        (P.parse_endpoint(args.coordinator)
+         if args.coordinator else None), args.worker_id,
         mem_bytes=args.mem_bytes, heartbeat_ms=args.heartbeat_ms,
         spill_dir=args.spill_dir, warm_compile_dir=args.warm_compile_dir,
         op_timeout_ms=args.op_timeout_ms,
-        telemetry_ring=args.telemetry_ring)
+        telemetry_ring=args.telemetry_ring,
+        reattach_ms=args.reattach_ms,
+        endpoint_file=args.endpoint_file)
     try:
         srv.start()
     except OSError as e:

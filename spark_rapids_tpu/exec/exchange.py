@@ -261,6 +261,23 @@ class TpuShuffleExchangeExec(TpuExec):
                 yield self._count_output(b)
             return
         c = self.conf if self.conf is not None else get_conf()
+        # Crash-consistent recovery (ISSUE 16, docs/recovery.md): with
+        # recovery on, this stage boundary is a durable checkpoint —
+        # serve a prior incarnation's committed output instead of
+        # re-executing the child, and commit this incarnation's output
+        # once the write phase lands.  Off (default): one conf read,
+        # zero journal-module calls (cProfile-pinned by
+        # tests/test_recovery.py).
+        ckpt = None
+        from spark_rapids_tpu.config import RECOVERY_ENABLED
+
+        if bool(c.get(RECOVERY_ENABLED)):
+            ckpt = self._recovery_ckpt(c)
+            if ckpt is not None:
+                served = self._serve_recovered(c, *ckpt)
+                if served is not None:
+                    yield from served
+                    return
         if c.get(DISTRIBUTED_ENABLED):
             # cross-host tier (ISSUE 14): route reduce partitions over
             # the worker processes when a coordinator with placeable
@@ -271,11 +288,11 @@ class TpuShuffleExchangeExec(TpuExec):
 
             coord = peek_coordinator()
             if coord is not None and coord.placeable_workers():
-                yield from self._execute_distributed(c, coord)
+                yield from self._execute_distributed(c, coord, ckpt)
                 return
         if c.get(EXCHANGE_SPILL_ENABLED) \
                 and str(c.get(SHUFFLE_MODE)).upper() != "CACHE_ONLY":
-            yield from self._execute_spill_backed(c)
+            yield from self._execute_spill_backed(c, ckpt)
             return
         mgr = get_shuffle_manager(self.conf)
         shuffle_id = mgr.register_shuffle()
@@ -299,7 +316,124 @@ class TpuShuffleExchangeExec(TpuExec):
         finally:
             mgr.unregister_shuffle(shuffle_id)
 
-    def _execute_distributed(self, c, coord) -> Iterator[ColumnarBatch]:
+    # -- crash-consistent recovery (ISSUE 16) ---------------------------
+    def _recovery_ckpt(self, c):
+        """(journal, plan-stage fingerprint) for this exchange, or None
+        when recovery cannot apply: unsafe partitioning exprs (no
+        stable fingerprint) or a journal root that cannot open.  The
+        fingerprint extends the compile-registry scope with the CHILD
+        SUBTREE's plan identity — two exchanges with identical
+        partitioning + output schema but different children must never
+        trade checkpoints."""
+        from spark_rapids_tpu.lifecycle import journal as _jn
+
+        scope = self._registry_scope("ckpt")
+        if scope is None:
+            return None
+        from spark_rapids_tpu.compilecache.keys import fingerprint
+
+        fp = fingerprint(scope, _jn.plan_tree_fp(self.children[0]))
+        try:
+            return _jn.get_journal(c), fp
+        # tpulint: disable=cancel-swallow (durability isolation: an
+        # unopenable journal disables recovery for this query, never
+        # fails it)
+        except Exception:
+            return None
+
+    def _serve_recovered(self, c, jn, fp):
+        """A generator over a prior incarnation's committed output for
+        this stage, or None (no adoptable checkpoint — execute
+        normally).  Local checkpoints are fully CRC-validated before
+        the first yield; lease serves stream from the re-attached
+        workers (a worker dying mid-serve raises WorkerLost into the
+        fault domain like any distributed read)."""
+        from spark_rapids_tpu.shuffle.partition_queues import (
+            host_boundary_codec,
+        )
+
+        hit = jn.lookup_stage(fp)
+        if hit is None:
+            return None
+        from spark_rapids_tpu.lifecycle.context import current
+
+        ctx = current()
+        qid = ctx.query_id if ctx is not None else "-"
+        codec = host_boundary_codec(c)
+        if hit[0] == "local":
+            return self._gen_recovered_local(jn, fp, qid, codec, hit[1])
+        _, wire, _placement, counts = hit
+        return self._gen_recovered_lease(c, jn, fp, qid, codec, wire,
+                                         counts)
+
+    def _gen_recovered_local(self, jn, fp, qid, codec, parts):
+        from spark_rapids_tpu.shuffle.serializer import deserialize_concat
+
+        for pid in range(self.num_partitions):
+            blobs = parts.get(pid) or []
+            if not blobs:
+                continue
+            with self.metric("shuffleReadTime").timed():
+                out = deserialize_concat(blobs, self.output, codec=codec)
+            if out.num_rows > 0:
+                yield self._count_output(out)
+        jn.mark_recovered(fp, qid, len(parts))
+
+    def _gen_recovered_lease(self, c, jn, fp, qid, codec, wire, counts):
+        from spark_rapids_tpu.config import BATCH_SIZE_BYTES
+        from spark_rapids_tpu.distributed import (
+            ProtocolCorruption,
+            peek_coordinator,
+        )
+        from spark_rapids_tpu.lifecycle.context import check_cancel
+        from spark_rapids_tpu.shuffle.serializer import deserialize_concat
+
+        coord = peek_coordinator()
+        goal = int(c.get(BATCH_SIZE_BYTES))
+        try:
+            for pid in sorted(counts):
+                check_cancel()
+                expected = counts[pid]
+                next_seq = 0
+                while next_seq < expected:
+                    with self.metric("shuffleReadTime").timed():
+                        seqs, blobs, _n = coord.fetch_blocks(
+                            wire, pid, after_seq=next_seq - 1,
+                            max_bytes=goal)
+                    if not seqs:
+                        raise ProtocolCorruption(
+                            f"recovered stage {fp}: worker returned no "
+                            f"blocks for pid {pid} at seq "
+                            f"{next_seq}/{expected}")
+                    next_seq = seqs[-1] + 1
+                    out = deserialize_concat(blobs, self.output,
+                                             codec=codec)
+                    if out.num_rows > 0:
+                        yield self._count_output(out)
+        finally:
+            # adopted placements must not outlive the serve — release
+            # on success AND on unwind (a failed serve re-executes; the
+            # workers' copies are no longer adoptable either way)
+            coord.release_exchange(wire)
+        jn.mark_recovered(fp, qid, len(counts))
+
+    def _commit_stage(self, ckpt, commit_fn) -> None:
+        """Run one checkpoint commit, isolating durability failures
+        from the query (a stage that cannot commit simply is not
+        recoverable)."""
+        from spark_rapids_tpu.lifecycle import QueryCancelled
+
+        try:
+            commit_fn()
+        except QueryCancelled:
+            raise
+        # tpulint: disable=cancel-swallow (durability isolation: a
+        # failed checkpoint commit must never fail the query)
+        except Exception:
+            pass
+
+    def _execute_distributed(self, c, coord,
+                             ckpt=None) -> Iterator[ColumnarBatch]:
         """Cross-host execution (ISSUE 14): partition slices are framed
         once (TKU2), shipped to coordinator-placed worker processes,
         AND retained in a producer-side spill-backed queue (device
@@ -370,6 +504,21 @@ class TpuShuffleExchangeExec(TpuExec):
                     for pid, sl in self.partition_slices(b):
                         with self.metric("exchangeSpillTime").timed():
                             dist.add_slice(pid, sl)
+            if ckpt is not None:
+                # stage boundary reached: the worker-held partitions
+                # ARE the checkpoint — journal a lease pinning them
+                # past driver death (ISSUE 16).  The read phase below
+                # does not release worker copies (only dist.close()
+                # does), so a driver killed ANY time after this record
+                # finds the full inventory on re-attach
+                jn, fp = ckpt
+                from spark_rapids_tpu.lifecycle.context import current
+
+                _ctx = current()
+                self._commit_stage(ckpt, lambda: jn.commit_lease(
+                    fp, _ctx.query_id if _ctx is not None else "-",
+                    coord.wire_of(exch_id), coord.placement_of(exch_id),
+                    dist.block_counts()))
             for pid in range(self.num_partitions):
                 check_cancel()
                 it = dist.read_partition_chunks(pid, target_bytes=goal)
@@ -387,7 +536,8 @@ class TpuShuffleExchangeExec(TpuExec):
                 queues.close()
             mgr.unregister_shuffle(exch_id)
 
-    def _execute_spill_backed(self, c) -> Iterator[ColumnarBatch]:
+    def _execute_spill_backed(self, c,
+                              ckpt=None) -> Iterator[ColumnarBatch]:
         """Stream partition slices through spill-backed queues: per
         input batch ONE partition program, each slice registered (or
         CRC-framed to host past the device budget) before the next
@@ -420,6 +570,21 @@ class TpuShuffleExchangeExec(TpuExec):
                     for pid, sl in self.partition_slices(b):
                         with self.metric("exchangeSpillTime").timed():
                             queues.append(pid, sl)
+            if ckpt is not None:
+                # stage boundary reached: snapshot every partition as
+                # framed blobs and commit durably (atomic tmp+rename +
+                # journal record) BEFORE the read phase drains the
+                # queues — a driver killed past this point resumes by
+                # serving the checkpoint instead of re-executing the
+                # child (ISSUE 16)
+                jn, fp = ckpt
+                from spark_rapids_tpu.lifecycle.context import current
+
+                _ctx = current()
+                self._commit_stage(ckpt, lambda: jn.commit_local_stage(
+                    fp, _ctx.query_id if _ctx is not None else "-",
+                    {pid: queues.snapshot_framed(pid)
+                     for pid in range(self.num_partitions)}))
             for pid in range(self.num_partitions):
                 it = queues.read_chunks(pid, target_bytes=goal)
                 while True:

@@ -75,6 +75,7 @@ class query_lifecycle:
         self._ctx: Optional[QueryContext] = None
         self._ctl: Optional[AdmissionController] = None
         self._cv_token = None
+        self._journaled = False
 
     def __enter__(self) -> Optional[QueryContext]:
         from spark_rapids_tpu.config import (
@@ -134,6 +135,23 @@ class query_lifecycle:
             self._ctl = ctl
         self._cv_token = CURRENT.set(ctx)
         self._ctx = ctx
+        # crash-consistent recovery (ISSUE 16): journal the admission so
+        # a dead driver's successor can classify this query.  One
+        # ambient conf check — with recovery off the journal module is
+        # never imported (cProfile-pinned)
+        from spark_rapids_tpu.config import RECOVERY_ENABLED
+
+        if bool(conf.get(RECOVERY_ENABLED)):
+            from spark_rapids_tpu.lifecycle import journal as _journal
+
+            try:
+                _journal.journal_admit(ctx, conf)
+                self._journaled = True
+            # tpulint: disable=cancel-swallow (durability isolation: a
+            # journal that cannot append voids the recovery guarantee
+            # for this query but must not fail its admission)
+            except Exception:
+                pass
         return ctx
 
     def __exit__(self, exc_type, exc, tb):
@@ -148,6 +166,19 @@ class query_lifecycle:
             if exc is not None and isinstance(exc, QueryCancelled):
                 PC.bump("queries_cancelled")
             _cleanup_query(ctx)
+            if self._journaled:
+                from spark_rapids_tpu.lifecycle import journal as _journal
+
+                status = ("ok" if exc_type is None else
+                          "cancelled" if isinstance(exc, QueryCancelled)
+                          else getattr(exc_type, "__name__", "error"))
+                try:
+                    _journal.journal_end(ctx, status)
+                # tpulint: disable=cancel-swallow (durability isolation:
+                # the end record is a GC optimization — replay treats a
+                # missing one as a crash, which is the safe default)
+                except Exception:
+                    pass
         finally:
             if self._ctl is not None:
                 self._ctl.release()
@@ -247,6 +278,12 @@ def leak_report_all() -> List[str]:
     from spark_rapids_tpu.io import writer as _writer
 
     out.extend(_writer.staging_leak_report())
+    # 5. recovery journal hygiene (ISSUE 16): a journaled query that
+    #    never ended, or a checkpoint dir left on disk, means a real
+    #    run would mis-classify at the next restart — fail the test
+    from spark_rapids_tpu.lifecycle import journal as _journal
+
+    out.extend(_journal.journal_leak_report())
     return out
 
 
@@ -289,6 +326,12 @@ def reset_leaked_state() -> None:
         # tests; no query is running when this sweeps)
         except Exception:
             pass
+    # journal + checkpoint artifacts (ISSUE 16): purge every recovery
+    # root this process touched so one leaky test's WAL cannot seed a
+    # bogus "resumable" classification in the next test's replay
+    from spark_rapids_tpu.lifecycle import journal as _journal
+
+    _journal.reset_journal(purge=True)
 
 
 __all__ = [

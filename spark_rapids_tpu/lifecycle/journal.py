@@ -1,0 +1,817 @@
+"""Durable query journal + stage checkpoints — crash-consistent driver
+recovery (ISSUE 16, docs/recovery.md).
+
+Reference analog: Spark's driver survives executor loss but not its own
+death; Theseus (arXiv:2508.05029) makes materialized stage outputs the
+recovery unit so a restarted control plane resumes from the last
+committed data movement instead of re-running the world.  This module is
+the driver-side durability tier:
+
+  * **query journal** — a write-ahead log of CRC-framed records (the
+    ``TKU2``/``TKD1`` framing discipline: magic + crc32 + length-prefixed
+    payload, one ``os.write`` per record so the file is always
+    prefix-consistent).  Records: query admission (trace id + conf
+    snapshot), plan identity (``compilecache/keys.py`` fingerprints),
+    stage-boundary checkpoint commits, stage serves, and query end.
+    ``spark.rapids.tpu.recovery.fsyncOnAppend`` mirrors the
+    ``files.fsyncOnCommit`` durability knob.
+  * **stage checkpoints** — one exchange's materialized output made
+    durable at its stage boundary.  Local: the partition queues' framed
+    blobs land as length-prefixed part files committed by an atomic
+    tmp+rename of the whole checkpoint directory, manifest CRCs pinning
+    every byte.  Distributed: the worker-held partitions are the
+    checkpoint; the journal records a LEASE (wire exchange id, placement,
+    per-partition block counts, expiry) pinning them past driver death.
+  * **recovery replay** — a reborn driver (the next ``QueryJournal``
+    opened on the same ``recovery.dir``) rotates the prior incarnation's
+    WAL, replays it damage-tolerantly (a truncated tail, a flipped bit,
+    or a newer schema version each degrade to clean full re-execution —
+    ``journal_recovery_discards``), classifies every journaled query as
+    completed / resumable / abandoned, retires checkpoints past
+    ``recovery.leaseTtlMs`` (``recovery_leases_expired``), and carries
+    still-adoptable checkpoints forward into the new WAL.  Exchanges
+    whose plan-stage fingerprint matches an adoptable checkpoint serve
+    the committed output instead of re-executing their child
+    (``stages_recovered`` / ``queries_resumed``).
+
+Disabled path: with ``spark.rapids.tpu.recovery.enabled`` off nothing
+imports this module on the hot path — one ambient conf check per site,
+zero journal calls (cProfile-pinned by tests/test_recovery.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import struct
+import tempfile
+import threading
+import time
+import zlib
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from spark_rapids_tpu import perfcounters as PC
+
+MAGIC = b"TKJ1"
+SCHEMA_VERSION = 1
+
+WAL_NAME = "journal.wal"
+REPLAY_NAME = "journal.replay"
+ENDPOINT_NAME = "coordinator.endpoint"
+CHECKPOINT_DIR = "checkpoints"
+
+LOCAL = "local"
+LEASE = "lease"
+
+COMPLETED = "completed"
+RESUMABLE = "resumable"
+ABANDONED = "abandoned"
+
+# test hook: called as hook(kind, n_records_this_incarnation) after every
+# WAL append — the driver-kill harness SIGKILLs itself here to land
+# kills exactly at admit/commit boundaries
+TEST_RECORD_HOOK: Optional[Callable[[str, int], None]] = None
+
+_lock = threading.Lock()
+_journal: "Optional[QueryJournal]" = None
+# every recovery root a journal touched in this process — the conftest
+# leak gate sweeps these (leftover checkpoint dirs / un-ended journaled
+# queries fail the owning test)
+_ACTIVE_ROOTS: Set[str] = set()
+
+
+# ---------------------------------------------------------------------------
+# record framing (TKU2 discipline: magic + crc + length-prefixed payload)
+# ---------------------------------------------------------------------------
+
+def frame_record(rec: Dict) -> bytes:
+    """One journal frame: ``MAGIC + u32 crc32(payload) + u32 len +
+    payload`` (payload = compact JSON).  Written with a single
+    ``os.write`` on an O_APPEND fd, so a crash mid-append leaves at
+    worst one torn TAIL frame — which replay discards."""
+    payload = json.dumps(rec, sort_keys=True,
+                         separators=(",", ":")).encode("utf-8")
+    return (MAGIC + struct.pack("<I", zlib.crc32(payload) & 0xFFFFFFFF)
+            + struct.pack("<I", len(payload)) + payload)
+
+
+def parse_frames(data: bytes) -> Tuple[List[Dict], bool]:
+    """Replay one journal file's bytes.  Returns (records, damaged):
+    parsing stops at the first bad magic, CRC mismatch, torn tail, or
+    record from a NEWER schema version — everything before the damage
+    is the trusted prefix, everything after is discarded (the WAL
+    contract: appends are atomic, so damage can only be a tail or rot,
+    and either way the clean degrade is full re-execution)."""
+    out: List[Dict] = []
+    off = 0
+    n = len(data)
+    while off < n:
+        if n - off < 12 or data[off:off + 4] != MAGIC:
+            return out, True
+        crc, ln = struct.unpack_from("<II", data, off + 4)
+        if n - off - 12 < ln:
+            return out, True          # torn tail record
+        payload = data[off + 12:off + 12 + ln]
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            return out, True          # bit rot
+        try:
+            rec = json.loads(payload.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            return out, True
+        if not isinstance(rec, dict) \
+                or int(rec.get("v", 0)) > SCHEMA_VERSION:
+            # a journal written by a newer engine: nothing from here on
+            # is interpretable — degrade to full re-execution
+            return out, True
+        out.append(rec)
+        off += 12 + ln
+    return out, False
+
+
+def _read_journal_file(path: str) -> Tuple[List[Dict], bool]:
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return [], True
+    if not data:
+        return [], False
+    return parse_frames(data)
+
+
+# ---------------------------------------------------------------------------
+# roots / endpoint file
+# ---------------------------------------------------------------------------
+
+def resolve_root(conf) -> str:
+    from spark_rapids_tpu.config import RECOVERY_DIR
+
+    root = conf.get(RECOVERY_DIR)
+    if not root:
+        root = os.path.join(tempfile.gettempdir(), "srt_recovery")
+    return root
+
+
+def write_endpoint(root: str, host: str, port: int) -> str:
+    """Publish the coordinator's control endpoint under the recovery
+    root (atomic tmp+rename) so workers that outlived a dead driver can
+    re-attach to its successor."""
+    os.makedirs(root, exist_ok=True)
+    path = os.path.join(root, ENDPOINT_NAME)
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(f"{host}:{port}\n")
+    os.replace(tmp, path)
+    return path
+
+
+def read_endpoint(root: str) -> Optional[Tuple[str, int]]:
+    try:
+        with open(os.path.join(root, ENDPOINT_NAME)) as f:
+            host, port = f.read().strip().rsplit(":", 1)
+        return host, int(port)
+    except (OSError, ValueError):
+        return None
+
+
+def plan_tree_fp(node) -> tuple:
+    """Plan-identity fingerprint parts for one exec subtree: (class,
+    describe) per node in preorder.  ``describe()`` prints expressions,
+    partitioning, and scan paths, so two different child plans that
+    happen to share an exchange's output schema + partitioning key
+    apart — a checkpoint must never serve another subtree's rows."""
+    from spark_rapids_tpu.lifecycle import QueryCancelled
+
+    parts = []
+
+    def walk(n):
+        try:
+            d = n.describe()
+        except QueryCancelled:
+            raise
+        except Exception:
+            d = ""
+        parts.append((type(n).__name__, d))
+        for c in getattr(n, "children", []) or []:
+            walk(c)
+
+    walk(node)
+    return tuple(parts)
+
+
+# ---------------------------------------------------------------------------
+# local checkpoint store (atomic tmp+rename, manifest-CRC-pinned)
+# ---------------------------------------------------------------------------
+
+def _ckpt_root(root: str) -> str:
+    return os.path.join(root, CHECKPOINT_DIR)
+
+
+def _ckpt_dir(root: str, fp: str) -> str:
+    return os.path.join(_ckpt_root(root), fp)
+
+
+def _write_local_checkpoint(root: str, fp: str, qid: str,
+                            parts: Dict[int, List[bytes]],
+                            fsync: bool) -> Dict:
+    """Write one stage's partitions as length-prefixed framed blobs +
+    a manifest, then atomically rename the whole directory into place.
+    Returns the manifest dict (raises on I/O failure — the caller
+    treats a failed commit as 'stage not checkpointed', never as a
+    query error)."""
+    base = _ckpt_root(root)
+    os.makedirs(base, exist_ok=True)
+    tmp = os.path.join(base, f".tmp.{fp}.{os.getpid()}")
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+    manifest: Dict = {"v": SCHEMA_VERSION, "fp": fp, "q": qid,
+                      "ts": time.time(), "parts": {}}
+    try:
+        for pid, blobs in parts.items():
+            path = os.path.join(tmp, f"part_{pid}.bin")
+            buf = b"".join(struct.pack("<I", len(b)) + b for b in blobs)
+            with open(path, "wb") as f:
+                f.write(buf)
+                if fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            manifest["parts"][str(pid)] = {
+                "n": len(blobs), "bytes": len(buf),
+                "crc": zlib.crc32(buf) & 0xFFFFFFFF}
+        mpath = os.path.join(tmp, "MANIFEST.json")
+        with open(mpath, "w") as f:
+            json.dump(manifest, f, sort_keys=True)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        final = _ckpt_dir(root, fp)
+        shutil.rmtree(final, ignore_errors=True)
+        os.rename(tmp, final)
+        if fsync:
+            dfd = os.open(base, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return manifest
+
+
+def load_local_stage(root: str, fp: str
+                     ) -> Optional[Dict[int, List[bytes]]]:
+    """Read back one committed local checkpoint, verifying every part
+    file against the manifest CRC.  None on ANY damage (missing file,
+    size/CRC mismatch, unreadable manifest) — the caller counts a
+    discard and re-executes."""
+    d = _ckpt_dir(root, fp)
+    try:
+        with open(os.path.join(d, "MANIFEST.json")) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if int(manifest.get("v", 0)) > SCHEMA_VERSION \
+            or manifest.get("fp") != fp:
+        return None
+    out: Dict[int, List[bytes]] = {}
+    for pid_s, meta in (manifest.get("parts") or {}).items():
+        try:
+            with open(os.path.join(d, f"part_{pid_s}.bin"), "rb") as f:
+                buf = f.read()
+        except OSError:
+            return None
+        if len(buf) != int(meta.get("bytes", -1)) \
+                or (zlib.crc32(buf) & 0xFFFFFFFF) != int(meta.get("crc", -1)):
+            return None
+        blobs: List[bytes] = []
+        off = 0
+        while off < len(buf):
+            if len(buf) - off < 4:
+                return None
+            (ln,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            if len(buf) - off < ln:
+                return None
+            blobs.append(buf[off:off + ln])
+            off += ln
+        if len(blobs) != int(meta.get("n", -1)):
+            return None
+        out[int(pid_s)] = blobs
+    return out
+
+
+# ---------------------------------------------------------------------------
+# recovery state (the replay product)
+# ---------------------------------------------------------------------------
+
+class RecoveryState:
+    """What one rotation's replay produced: the prior incarnation's
+    query classification and the still-adoptable stage checkpoints."""
+
+    def __init__(self):
+        self.classification: Dict[str, str] = {}
+        # fp -> the adoptable ckpt record ({"ckind": local|lease, ...})
+        self.pending: Dict[str, Dict] = {}
+        self.replayed_records = 0
+        self.discards = 0
+        self.expired = 0
+        # queries of THIS incarnation that adopted >= 1 stage
+        self._resumed_qids: Set[str] = set()
+
+
+def _build_recovery(root: str, records: List[Dict],
+                    damaged_files: int, lease_ttl_s: float
+                    ) -> RecoveryState:
+    st = RecoveryState()
+    st.replayed_records = len(records)
+    st.discards += damaged_files
+    queries: Dict[str, Dict] = {}
+    ckpts: Dict[str, Dict] = {}
+    served: Set[str] = set()
+    for r in records:
+        kind = r.get("kind")
+        q = str(r.get("q", ""))
+        if kind == "admit":
+            queries.setdefault(q, {"ended": None, "ckpts": set()})
+        elif kind == "end":
+            queries.setdefault(q, {"ended": None, "ckpts": set()})
+            queries[q]["ended"] = str(r.get("status", "ok"))
+        elif kind == "ckpt":
+            fp = str(r.get("fp", ""))
+            if fp:
+                ckpts[fp] = r
+                queries.setdefault(
+                    q, {"ended": None, "ckpts": set()})["ckpts"].add(fp)
+        elif kind == "served":
+            served.add(str(r.get("fp", "")))
+    now = time.time()
+    for fp, rec in ckpts.items():
+        q = str(rec.get("q", ""))
+        owner = queries.get(q)
+        if fp in served or (owner is not None
+                            and owner["ended"] is not None):
+            continue            # superseded: the query finished cleanly
+        expires = float(rec.get("expires", 0.0) or 0.0)
+        if expires and now > expires:
+            st.expired += 1
+            PC.bump("recovery_leases_expired")
+            if rec.get("ckind") == LOCAL:
+                shutil.rmtree(_ckpt_dir(root, fp), ignore_errors=True)
+            continue
+        if rec.get("ckind") == LOCAL:
+            # validate eagerly: a damaged checkpoint must degrade HERE,
+            # not mid-query
+            if load_local_stage(root, fp) is None:
+                st.discards += 1
+                PC.bump("journal_recovery_discards")
+                shutil.rmtree(_ckpt_dir(root, fp), ignore_errors=True)
+                continue
+        st.pending[fp] = rec
+    # classify every journaled query
+    for q, info in queries.items():
+        if not q:
+            continue
+        if info["ended"] is not None:
+            st.classification[q] = COMPLETED
+        elif any(fp in st.pending for fp in info["ckpts"]):
+            st.classification[q] = RESUMABLE
+        else:
+            st.classification[q] = ABANDONED
+    if damaged_files:
+        PC.bump("journal_recovery_discards", damaged_files)
+    # orphan sweep: checkpoint dirs with no adoptable record (a crash
+    # between the dir rename and its journal append, or a serve whose
+    # delete failed) are unreachable — purge them
+    base = _ckpt_root(root)
+    try:
+        names = os.listdir(base)
+    except OSError:
+        names = []
+    for name in names:
+        if name in st.pending:
+            continue
+        victim = os.path.join(base, name)
+        if not name.startswith(".tmp."):
+            st.discards += 1
+            PC.bump("journal_recovery_discards")
+        shutil.rmtree(victim, ignore_errors=True)
+    return st
+
+
+# ---------------------------------------------------------------------------
+# the journal
+# ---------------------------------------------------------------------------
+
+class QueryJournal:
+    """One incarnation's WAL over one recovery root.  Construction IS
+    recovery: any prior WAL rotates to ``journal.replay``, replays into
+    a :class:`RecoveryState`, still-adoptable checkpoints are carried
+    forward into the fresh WAL, and the replay file is deleted — the
+    new WAL is always the single source of truth."""
+
+    def __init__(self, root: str, fsync: bool = False,
+                 lease_ttl_ms: int = 120_000):
+        self.root = root
+        self.fsync = bool(fsync)
+        self.lease_ttl_s = max(float(lease_ttl_ms), 0.0) / 1000.0
+        self._lock = threading.Lock()
+        self._n_records = 0
+        # this incarnation's live bookkeeping for end-of-query GC and
+        # the leak gate: qid -> [(ckind, fp)], and un-ended admits
+        self._committed: Dict[str, List[Tuple[str, str]]] = {}
+        self._active_qids: Set[str] = set()
+        os.makedirs(root, exist_ok=True)
+        with _lock:
+            _ACTIVE_ROOTS.add(root)
+
+        wal = os.path.join(root, WAL_NAME)
+        replay = os.path.join(root, REPLAY_NAME)
+        records: List[Dict] = []
+        damaged = 0
+        # a leftover journal.replay means the PREVIOUS recovery crashed
+        # mid-rotation: fold it first (it is older than the wal)
+        for path in (replay, wal):
+            if os.path.exists(path):
+                recs, bad = _read_journal_file(path)
+                records.extend(recs)
+                damaged += 1 if bad else 0
+        if os.path.exists(wal):
+            os.replace(wal, replay)
+        self.recovery = _build_recovery(root, records, damaged,
+                                        self.lease_ttl_s)
+        self._fd = os.open(wal, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                           0o644)
+        # carry still-adoptable checkpoints forward so a crash of THIS
+        # incarnation before serving them keeps them recoverable
+        for rec in self.recovery.pending.values():
+            self._append(dict(rec))
+        try:
+            os.unlink(replay)
+        except OSError:
+            pass
+
+    # -- append ----------------------------------------------------------
+    def _append(self, rec: Dict) -> None:
+        rec.setdefault("v", SCHEMA_VERSION)
+        rec.setdefault("ts", time.time())
+        frame = frame_record(rec)
+        with self._lock:
+            if self._fd is None:
+                return
+            os.write(self._fd, frame)
+            if self.fsync:
+                os.fsync(self._fd)
+            self._n_records += 1
+            n = self._n_records
+        PC.bump("journal_records_written")
+        hook = TEST_RECORD_HOOK
+        if hook is not None:
+            hook(str(rec.get("kind", "")), n)
+
+    # -- query lifecycle records ----------------------------------------
+    def admit(self, qid: str, trace_id: str, conf) -> None:
+        settings = dict(getattr(conf, "settings", {}) or {})
+        from spark_rapids_tpu.compilecache.keys import fingerprint
+
+        self._active_qids.add(qid)
+        self._append({
+            "kind": "admit", "q": qid, "trace": trace_id,
+            "conf_fp": fingerprint(tuple(sorted(
+                (str(k), str(v)) for k, v in settings.items()))),
+            "conf": {str(k): str(v) for k, v in settings.items()}})
+
+    def plan(self, qid: str, root_exec) -> None:
+        from spark_rapids_tpu.compilecache.keys import fingerprint
+
+        self._append({"kind": "plan", "q": qid,
+                      "plan_fp": fingerprint(plan_tree_fp(root_exec))})
+
+    def end(self, qid: str, status: str) -> None:
+        self._append({"kind": "end", "q": qid, "status": status})
+        self._active_qids.discard(qid)
+        # the query finished cleanly: its checkpoints are garbage (a
+        # restart would classify it completed and never adopt them)
+        for ckind, fp in self._committed.pop(qid, []):
+            if ckind == LOCAL:
+                shutil.rmtree(_ckpt_dir(self.root, fp),
+                              ignore_errors=True)
+        try:
+            self._reconcile_worker_holdings()
+        # tpulint: disable=cancel-swallow (durability isolation: a
+        # failed orphan sweep must never fail query teardown)
+        except Exception:
+            pass
+
+    def _reconcile_worker_holdings(self) -> None:
+        """Release worker-held partitions a dead incarnation shipped but
+        never lease-committed (or whose lease was retired) — orphans no
+        replay will ever adopt.  Runs at query end, the first driver-side
+        point where re-attaching workers have certainly enumerated their
+        holdings; still-pending leases stay pinned."""
+        from spark_rapids_tpu.distributed import peek_coordinator
+
+        coord = peek_coordinator()
+        if coord is None:
+            return
+        keep = {int(rec.get("wire", -1))
+                for rec in self.recovery.pending.values()
+                if rec.get("ckind") == LEASE}
+        n = coord.release_orphan_holdings(keep)
+        if n:
+            self._diag("orphans_released", "-", f"wires={n}", n)
+
+    # -- stage checkpoints ----------------------------------------------
+    def commit_local_stage(self, fp: str, qid: str,
+                           parts: Dict[int, List[bytes]]) -> bool:
+        """Commit one local stage: part files + manifest land under a
+        tmp dir, the dir renames into place atomically, THEN the
+        journal records the commit — a crash anywhere leaves either a
+        fully-adoptable checkpoint or an orphan the next replay
+        purges."""
+        try:
+            _write_local_checkpoint(self.root, fp, qid, parts,
+                                    self.fsync)
+        except OSError:
+            return False
+        self._committed.setdefault(qid, []).append((LOCAL, fp))
+        n_blobs = {str(p): len(b) for p, b in parts.items()}
+        self._append({"kind": "ckpt", "ckind": LOCAL, "q": qid,
+                      "fp": fp, "parts": n_blobs,
+                      "expires": time.time() + self.lease_ttl_s})
+        self._diag("stage_committed", fp,
+                   f"local n_parts={len(parts)}", len(parts))
+        return True
+
+    def commit_lease(self, fp: str, qid: str, wire: int,
+                     placement: Dict[int, str],
+                     counts: Dict[int, int]) -> None:
+        """Commit one distributed stage: the worker-held partitions ARE
+        the checkpoint; this lease record pins them past driver death
+        (workers re-attach and re-enumerate them) until it expires."""
+        self._committed.setdefault(qid, []).append((LEASE, fp))
+        self._append({"kind": "ckpt", "ckind": LEASE, "q": qid,
+                      "fp": fp, "wire": int(wire),
+                      "placement": {str(p): w
+                                    for p, w in placement.items()},
+                      "counts": {str(p): int(n)
+                                 for p, n in counts.items()},
+                      "expires": time.time() + self.lease_ttl_s})
+        self._diag("stage_committed", fp,
+                   f"lease wire={wire} n_parts={len(counts)}",
+                   len(counts))
+
+    # -- recovery lookup / serve ----------------------------------------
+    def lookup_stage(self, fp: str):
+        """An adoptable prior-incarnation checkpoint for this plan-stage
+        fingerprint, or None.  Returns ``("local", {pid: [blobs]})`` or
+        ``("lease", wire, {pid: wid}, {pid: n_blocks})`` — for a lease
+        the coordinator's worker inventory must fully cover the
+        recorded block counts (workers re-HELLOed what they hold), and
+        adoption registers the placement under the original wire id."""
+        rec = self.recovery.pending.get(fp)
+        if rec is None:
+            return None
+        if rec.get("ckind") == LOCAL:
+            parts = load_local_stage(self.root, fp)
+            if parts is None:
+                self.discard_stage(fp, "checkpoint damaged")
+                return None
+            return (LOCAL, parts)
+        # lease: match recorded counts against live worker inventory
+        from spark_rapids_tpu.distributed import peek_coordinator
+
+        coord = peek_coordinator()
+        if coord is None:
+            return None
+        wire = int(rec.get("wire", -1))
+        counts = {int(p): int(n)
+                  for p, n in (rec.get("counts") or {}).items()}
+        inv = coord.worker_inventory()
+        placement: Dict[int, str] = {}
+        for pid, need in counts.items():
+            owner = None
+            for wid, held in inv.items():
+                for exch, hpid, n, _mx in held:
+                    if exch == wire and hpid == pid and n >= need:
+                        owner = wid
+                        break
+                if owner is not None:
+                    break
+            if owner is None:
+                return None     # not (yet) covered — workers may still
+                                # be re-attaching; the lease stays pending
+            placement[pid] = owner
+        coord.adopt_exchange(wire, placement, counts)
+        return (LEASE, wire, placement, counts)
+
+    def mark_recovered(self, fp: str, qid: str, n_parts: int) -> None:
+        """One stage was served from its checkpoint instead of
+        re-executing."""
+        rec = self.recovery.pending.pop(fp, None)
+        self._append({"kind": "served", "fp": fp, "q": qid})
+        if rec is not None and rec.get("ckind") == LOCAL:
+            shutil.rmtree(_ckpt_dir(self.root, fp), ignore_errors=True)
+        PC.bump("stages_recovered")
+        if qid not in self.recovery._resumed_qids:
+            self.recovery._resumed_qids.add(qid)
+            PC.bump("queries_resumed")
+            self._diag("query_resumed", fp, f"query={qid}", 1)
+        self._diag("stage_recovered", fp, f"query={qid}", n_parts)
+
+    def discard_stage(self, fp: str, reason: str) -> None:
+        rec = self.recovery.pending.pop(fp, None)
+        if rec is not None:
+            self._append({"kind": "served", "fp": fp, "q": "-"})
+            if rec.get("ckind") == LOCAL:
+                shutil.rmtree(_ckpt_dir(self.root, fp),
+                              ignore_errors=True)
+        PC.bump("journal_recovery_discards")
+        self._diag("checkpoint_discarded", fp, reason, 0)
+
+    def retire_expired(self) -> int:
+        """Drop pending checkpoints past their expiry (callable from
+        tooling/long-lived services; replay already retires anything
+        expired at rotation time).  Returns how many retired."""
+        now = time.time()
+        victims = [fp for fp, rec in self.recovery.pending.items()
+                   if float(rec.get("expires", 0) or 0) and
+                   now > float(rec.get("expires", 0))]
+        for fp in victims:
+            rec = self.recovery.pending.pop(fp)
+            self._append({"kind": "served", "fp": fp, "q": "-"})
+            if rec.get("ckind") == LOCAL:
+                shutil.rmtree(_ckpt_dir(self.root, fp),
+                              ignore_errors=True)
+            PC.bump("recovery_leases_expired")
+            self._diag("checkpoint_discarded", fp, "lease expired", 0)
+        return len(victims)
+
+    # -- observability / hygiene ----------------------------------------
+    def _diag(self, kind: str, fp: str, detail: str, n: int) -> None:
+        from spark_rapids_tpu.diagnostics import context as _DIAG
+
+        rec = _DIAG.RECORDER
+        if rec is not None:
+            rec.recovery(kind, fp, detail, n)
+
+    def startup_postmortem(self) -> Optional[Dict]:
+        """The crashed-incarnation post-mortem (telemetry satellite):
+        when replay found un-completed queries, bundle the
+        classification + the journal tail into a flight-recorder dump
+        so the crash is investigable from the reborn process.  None
+        when telemetry is off or nothing crashed."""
+        crashed = {q: c for q, c in self.recovery.classification.items()
+                   if c != COMPLETED}
+        if not crashed:
+            return None
+        from spark_rapids_tpu.telemetry import context as TEL
+
+        hub = TEL.HUB
+        if hub is None:
+            return None
+        try:
+            return hub.postmortem(
+                "driver_crash", detail=f"{len(crashed)} queries "
+                f"un-completed at restart", force=True,
+                extra={"classification": self.recovery.classification,
+                       "pending_stages": sorted(self.recovery.pending),
+                       "replayed_records":
+                           self.recovery.replayed_records,
+                       "journal_discards": self.recovery.discards})
+        # tpulint: disable=cancel-swallow (telemetry isolation: a dump
+        # failure must never break recovery)
+        except Exception:
+            return None
+
+    def leak_lines(self) -> List[str]:
+        out = []
+        for qid in sorted(self._active_qids):
+            out.append(f"LEAK: recovery journal query {qid} admitted "
+                       f"but never ended")
+        for fp in sorted(self.recovery.pending):
+            out.append(f"LEAK: recovery checkpoint {fp} still pending "
+                       f"(never served nor retired)")
+        base = _ckpt_root(self.root)
+        try:
+            names = sorted(os.listdir(base))
+        except OSError:
+            names = []
+        live = {fp for lst in self._committed.values() for _, fp in lst}
+        live |= set(self.recovery.pending)
+        for name in names:
+            if name not in live:
+                out.append(f"LEAK: recovery checkpoint dir {name} "
+                           f"left on disk")
+        return out
+
+    def close(self, purge: bool = False) -> None:
+        with self._lock:
+            fd, self._fd = self._fd, None
+        if fd is not None:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        if purge:
+            for name in (WAL_NAME, REPLAY_NAME, ENDPOINT_NAME):
+                try:
+                    os.unlink(os.path.join(self.root, name))
+                except OSError:
+                    pass
+            shutil.rmtree(_ckpt_root(self.root), ignore_errors=True)
+            with _lock:
+                _ACTIVE_ROOTS.discard(self.root)
+
+
+# ---------------------------------------------------------------------------
+# module singleton + lifecycle hooks
+# ---------------------------------------------------------------------------
+
+def get_journal(conf) -> QueryJournal:
+    """The process journal, opened (= recovered) on first use.  A conf
+    pointing at a DIFFERENT root swaps the singleton (tests; a real
+    driver has one root for its lifetime)."""
+    global _journal
+    from spark_rapids_tpu.config import (
+        RECOVERY_FSYNC,
+        RECOVERY_LEASE_TTL_MS,
+    )
+
+    root = resolve_root(conf)
+    with _lock:
+        if _journal is not None and _journal.root == root:
+            return _journal
+        old, _journal = _journal, None
+    if old is not None:
+        old.close()
+    j = QueryJournal(root, fsync=bool(conf.get(RECOVERY_FSYNC)),
+                     lease_ttl_ms=int(conf.get(RECOVERY_LEASE_TTL_MS)))
+    j.startup_postmortem()
+    with _lock:
+        _journal = j
+    return j
+
+
+def peek_journal() -> Optional[QueryJournal]:
+    return _journal
+
+
+def reset_journal(purge: bool = False) -> None:
+    """Close (and optionally purge) the journal singleton.  With
+    ``purge`` every active root this process touched is swept —
+    the leaked-state recovery path, so one leaky test cannot poison
+    the next."""
+    global _journal
+    with _lock:
+        j, _journal = _journal, None
+    if j is not None:
+        j.close(purge=purge)
+    if purge:
+        with _lock:
+            roots = list(_ACTIVE_ROOTS)
+        for root in roots:
+            for name in (WAL_NAME, REPLAY_NAME, ENDPOINT_NAME):
+                try:
+                    os.unlink(os.path.join(root, name))
+                except OSError:
+                    pass
+            shutil.rmtree(_ckpt_root(root), ignore_errors=True)
+            with _lock:
+                _ACTIVE_ROOTS.discard(root)
+
+
+def journal_admit(ctx, conf) -> None:
+    """lifecycle.__enter__ hook (one ambient conf check guards the
+    call site — this function only runs with recovery enabled)."""
+    get_journal(conf).admit(ctx.query_id,
+                            getattr(ctx, "trace_id", "") or "", conf)
+
+
+def journal_plan(ctx, root_exec, conf) -> None:
+    j = peek_journal()
+    if j is None:
+        j = get_journal(conf)
+    j.plan(ctx.query_id, root_exec)
+
+
+def journal_end(ctx, status: str) -> None:
+    j = peek_journal()
+    if j is not None:
+        j.end(ctx.query_id, status)
+
+
+def recovery_report() -> Dict[str, str]:
+    """The prior incarnation's query classification (completed /
+    resumable / abandoned) — what the driver-kill harness pins: every
+    journaled query gets exactly one class."""
+    j = peek_journal()
+    return dict(j.recovery.classification) if j is not None else {}
+
+
+def journal_leak_report() -> List[str]:
+    """lifecycle.leak_report_all hook: leftover checkpoint dirs or
+    never-ended journaled queries fail the owning test.  Peek-only —
+    reports nothing unless this process opened a journal."""
+    j = _journal
+    return j.leak_lines() if j is not None else []

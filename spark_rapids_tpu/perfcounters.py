@@ -159,6 +159,18 @@ COUNTERS: Dict[str, int] = {
     # driver query event logs by trace id at collect end
     "dist_worker_dumps": 0,
     "dist_worker_spans_merged": 0,
+    # crash-consistent driver recovery (ISSUE 16, docs/recovery.md):
+    # journal WAL appends, exchange stages served from a prior
+    # incarnation's committed checkpoint instead of re-executing,
+    # queries that recovered at least one stage, damaged/unreadable
+    # journal or checkpoint artifacts discarded during replay (each a
+    # clean degrade to full re-execution), and checkpoint leases
+    # retired past recovery.leaseTtlMs
+    "journal_records_written": 0,
+    "stages_recovered": 0,
+    "queries_resumed": 0,
+    "journal_recovery_discards": 0,
+    "recovery_leases_expired": 0,
 }
 
 

@@ -105,6 +105,20 @@ class CircuitBreakerRegistry:
             elif e.state == CLOSED and e.failures:
                 e.failures -= 1
 
+    def clear_key(self, key: Key) -> bool:
+        """Drop one entry outright regardless of state (ISSUE 16: a
+        worker re-attaching after a driver restart must not inherit its
+        prior incarnation's quarantine — the recovery-path re-HELLO
+        closes the stale ``("DistributedWorker", id)`` entry).  Bumps
+        the generation like any other planner-visible change.  True
+        when an entry existed."""
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is None:
+                return False
+            self.generation += 1
+            return True
+
     # -- consulting (called from plan-time tagging) ---------------------
     def consult(self, key: Key, ttl_sec: float) -> Optional[str]:
         """Why this stage must stay on CPU, or None (run on TPU).  An OPEN

@@ -97,6 +97,9 @@ def _apply_compile_cache(conf: "TpuConf") -> None:
     try:
         import jax
 
+        from spark_rapids_tpu.compilecache import ensure_atomic_cache_put
+
+        ensure_atomic_cache_put()
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:
@@ -655,6 +658,24 @@ class DataFrame:
 
         CURRENT_INPUT_FILE[0] = ""   # InputFileName: "" outside file scans
         root, _meta = self._planned()
+        # Crash-consistent recovery (ISSUE 16): journal the planned
+        # tree's identity so a reborn driver replanning the same query
+        # can prove checkpoint fingerprints refer to the same plan.
+        # Disabled (default): one conf read, zero journal-module calls
+        # (pinned by tests/test_recovery.py).
+        if qctx is not None:
+            from spark_rapids_tpu.config import RECOVERY_ENABLED
+
+            if bool(self.session.conf.get(RECOVERY_ENABLED)):
+                from spark_rapids_tpu.lifecycle import journal as _jn
+
+                try:
+                    _jn.journal_plan(qctx, root, self.session.conf)
+                # tpulint: disable=cancel-swallow (durability isolation:
+                # the plan record is advisory; losing it weakens the
+                # post-mortem, never the query)
+                except Exception:
+                    pass
         if isinstance(root, TpuExec):
             from spark_rapids_tpu.config import PROFILE_ENABLED
             from spark_rapids_tpu.exec.base import enable_operator_tracing

@@ -165,6 +165,32 @@ class SpillBackedPartitionQueues:
                     out.append(f.read())
         return out
 
+    def snapshot_framed(self, pid: int) -> List[bytes]:
+        """EVERY queued entry of one partition as CRC-framed
+        host-boundary blocks WITHOUT draining — the stage-checkpoint
+        source (ISSUE 16).  Unlike :meth:`peek_blobs` this covers
+        device-resident entries too: each handle pins, serializes
+        through the one framing site, and unpins with the entry still
+        queued (the checkpoint is a copy; the read phase drains the
+        queue as usual afterwards)."""
+        from spark_rapids_tpu.exec.ici import ici_host_frame
+
+        out: List[bytes] = []
+        for kind, x in (self._queues.get(pid) or []):
+            if kind == "host":
+                out.append(x)
+            elif kind == "hostfile":
+                with open(x, "rb") as f:
+                    out.append(f.read())
+            else:
+                x.pin()
+                try:
+                    out.append(ici_host_frame(x.get_batch(),
+                                              codec=self.codec))
+                finally:
+                    x.unpin()
+        return out
+
     def release_partition(self, pid: int) -> None:
         """Commit one partition: the consuming stage fully read it, so
         the lineage copy (resident handles, retained blobs, spill

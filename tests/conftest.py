@@ -87,9 +87,12 @@ def _leak_gate(request):
     without its commit protocol running.  ISSUE 14 extends it to REMOTE
     partitions: an exchange still placed on distributed workers means a
     query ended without its release broadcast — blocks pinned in another
-    process's store.  The gate only *fails* a test whose body passed (a
-    failing test already reported its real error — the leaked state is
-    still cleaned so it cannot cascade)."""
+    process's store.  ISSUE 16 extends it to RECOVERY artifacts: a
+    journaled query left un-ended, an unserved pending checkpoint, or a
+    leftover ``checkpoints/<fp>`` dir on disk means a test drove the
+    journal without closing its query lifecycle.  The gate only *fails*
+    a test whose body passed (a failing test already reported its real
+    error — the leaked state is still cleaned so it cannot cascade)."""
     yield
     from spark_rapids_tpu.lifecycle import (
         leak_report_all,
@@ -108,7 +111,8 @@ def _leak_gate(request):
         pytest.fail(
             "resource leak after test (spillables / semaphore permits / "
             "shuffle registrations / writer staging dirs / remote "
-            "distributed partitions):\n"
+            "distributed partitions / recovery journal + checkpoint "
+            "files):\n"
             + "\n".join(leaks[:20]),
             pytrace=False)
 

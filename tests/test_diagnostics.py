@@ -81,6 +81,7 @@ GOLDEN_SCHEMA = {
     "governor": ["action", "state", "prev", "pressure", "detail"],
     "distributed": ["kind", "worker_id", "detail", "n_workers",
                     "n_partitions"],
+    "recovery": ["kind", "fp", "detail", "n"],
     "worker_telemetry": ["worker_id", "blocks", "bytes", "mem_used",
                          "counters"],
     "worker_span": ["worker_id", "kind", "trace", "span", "exch",

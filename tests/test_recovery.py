@@ -1,0 +1,428 @@
+"""Crash-consistent driver recovery (ISSUE 16): the TKJ1 write-ahead
+query journal (atomic CRC-framed appends, rotation replay), journal
+damage degrading to clean full re-execution (truncated tail, bit rot,
+newer schema version — never a crash, never a wrong answer),
+stage-boundary local checkpoints (commit → crash → restart → the
+committed stage SERVED, not re-executed), recovery classification
+(completed / resumable / abandoned) for every journaled query, lease
+expiry, the re-attach breaker-clear regression pin, and the
+disabled-path pin (recovery off ⇒ zero journal-module calls on a
+collect, cProfile-verified).
+"""
+import cProfile
+import os
+import socket
+import time
+
+import pytest
+
+from spark_rapids_tpu import perfcounters as PC
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.lifecycle import journal as JM
+from spark_rapids_tpu.session import TpuSession, sum_
+
+
+@pytest.fixture
+def rec_root(tmp_path):
+    """A private recovery root, swept (journal singleton closed, WAL +
+    checkpoint dirs purged) after the test so the conftest leak gate
+    sees a clean slate."""
+    root = str(tmp_path / "recovery")
+    try:
+        yield root
+    finally:
+        JM.TEST_RECORD_HOOK = None
+        JM.reset_journal(purge=True)
+
+
+def _delta(before, key):
+    return PC.snapshot().get(key, 0) - before.get(key, 0)
+
+
+# ---------------------------------------------------------------------------
+# TKJ1 framing
+# ---------------------------------------------------------------------------
+
+def test_frame_roundtrip():
+    recs = [{"kind": "admit", "q": "qa", "v": 1},
+            {"kind": "ckpt", "q": "qa", "fp": "f" * 16, "v": 1,
+             "parts": {"0": 3}},
+            {"kind": "end", "q": "qa", "status": "ok", "v": 1}]
+    data = b"".join(JM.frame_record(r) for r in recs)
+    out, damaged = JM.parse_frames(data)
+    assert not damaged
+    assert out == recs
+
+
+def test_parse_truncated_tail_keeps_trusted_prefix():
+    recs = [{"kind": "admit", "q": "qa", "v": 1},
+            {"kind": "end", "q": "qa", "status": "ok", "v": 1}]
+    data = b"".join(JM.frame_record(r) for r in recs)
+    out, damaged = JM.parse_frames(data[:-3])
+    assert damaged
+    assert out == recs[:1]
+
+
+def test_parse_bitflip_stops_at_damage():
+    recs = [{"kind": "admit", "q": "qa", "v": 1},
+            {"kind": "end", "q": "qa", "status": "ok", "v": 1}]
+    data = bytearray(b"".join(JM.frame_record(r) for r in recs))
+    data[-2] ^= 0xFF            # rot inside the SECOND record's payload
+    out, damaged = JM.parse_frames(bytes(data))
+    assert damaged
+    assert out == recs[:1]
+
+
+def test_parse_newer_schema_version_stops():
+    ok = {"kind": "admit", "q": "qa", "v": JM.SCHEMA_VERSION}
+    newer = {"kind": "end", "q": "qa", "status": "ok",
+             "v": JM.SCHEMA_VERSION + 1}
+    data = JM.frame_record(ok) + JM.frame_record(newer)
+    out, damaged = JM.parse_frames(data)
+    assert damaged
+    assert out == [ok]
+
+
+# ---------------------------------------------------------------------------
+# journal files: damage degrades to clean full re-execution
+# ---------------------------------------------------------------------------
+
+def _seed_journal(root, with_ckpt=True):
+    j = JM.QueryJournal(root)
+    j.admit("qa", "trace-a", TpuConf({}))
+    if with_ckpt:
+        assert j.commit_local_stage("a" * 16, "qa", {0: [b"payload-0"]})
+    j.close()
+    return os.path.join(root, "journal.wal")
+
+
+def test_truncated_wal_degrades_to_reexecution(rec_root):
+    wal = _seed_journal(rec_root)
+    before = PC.snapshot()
+    with open(wal, "r+b") as f:
+        f.truncate(os.path.getsize(wal) - 5)    # torn ckpt tail record
+    j2 = JM.QueryJournal(rec_root)
+    # the torn record was the checkpoint commit: qa degrades to full
+    # re-execution (abandoned), the now-orphaned checkpoint dir is
+    # purged, and every discard is counted — no crash, nothing pending
+    assert j2.recovery.classification == {"qa": "abandoned"}
+    assert not j2.recovery.pending
+    assert not os.listdir(os.path.join(rec_root, "checkpoints"))
+    assert _delta(before, "journal_recovery_discards") >= 1
+    assert j2.leak_lines() == []
+    j2.close(purge=True)
+
+
+def test_bitflipped_wal_degrades_to_reexecution(rec_root):
+    wal = _seed_journal(rec_root, with_ckpt=False)
+    j = JM.QueryJournal(rec_root)
+    j.admit("qa", "trace-a", TpuConf({}))
+    j.end("qa", "ok")
+    j.close()
+    before = PC.snapshot()
+    with open(wal, "r+b") as f:
+        f.seek(os.path.getsize(wal) - 2)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))       # rot inside the end record
+    j2 = JM.QueryJournal(rec_root)
+    # the completion record rotted away: the trusted prefix still admits
+    # qa, so it re-executes (abandoned) rather than crashing or serving
+    assert j2.recovery.classification["qa"] == "abandoned"
+    assert _delta(before, "journal_recovery_discards") >= 1
+    j2.close(purge=True)
+
+
+def test_newer_schema_wal_degrades_to_reexecution(rec_root):
+    wal = _seed_journal(rec_root, with_ckpt=False)
+    with open(wal, "ab") as f:
+        f.write(JM.frame_record({"kind": "end", "q": "qa", "status": "ok",
+                                 "v": JM.SCHEMA_VERSION + 1}))
+    before = PC.snapshot()
+    j2 = JM.QueryJournal(rec_root)
+    assert j2.recovery.classification["qa"] == "abandoned"
+    assert _delta(before, "journal_recovery_discards") >= 1
+    j2.close(purge=True)
+
+
+def test_classification_and_carry_forward(rec_root):
+    j = JM.QueryJournal(rec_root)
+    j.admit("q_done", "t1", TpuConf({}))
+    j.end("q_done", "ok")
+    j.admit("q_resume", "t2", TpuConf({}))
+    assert j.commit_local_stage("b" * 16, "q_resume", {0: [b"x"],
+                                                      1: [b"yy"]})
+    j.admit("q_lost", "t3", TpuConf({}))
+    j.close()
+
+    j2 = JM.QueryJournal(rec_root)
+    assert j2.recovery.classification == {
+        "q_done": "completed", "q_resume": "resumable",
+        "q_lost": "abandoned"}
+    # the committed stage is adoptable, with its exact blobs
+    got = j2.lookup_stage("b" * 16)
+    assert got is not None and got[0] == "local"
+    assert got[1] == {0: [b"x"], 1: [b"yy"]}
+    j2.mark_recovered("b" * 16, "q_new", n_parts=2)
+    assert not j2.recovery.pending
+    j2.close()
+
+    # a SECOND restart must not re-adopt the served stage (the `served`
+    # record supersedes the carried-forward checkpoint record)
+    j3 = JM.QueryJournal(rec_root)
+    assert j3.lookup_stage("b" * 16) is None
+    assert not j3.recovery.pending
+    j3.close(purge=True)
+
+
+def test_lease_expiry_retires_checkpoint(rec_root):
+    j = JM.QueryJournal(rec_root, lease_ttl_ms=1)
+    j.admit("qa", "t", TpuConf({}))
+    j.commit_lease("c" * 16, "qa", wire=7, placement={0: "w0"},
+                   counts={0: 3})
+    j.close()
+    time.sleep(0.05)
+    before = PC.snapshot()
+    j2 = JM.QueryJournal(rec_root)
+    # past recovery.leaseTtlMs the worker-held blocks may be gone —
+    # never adopt, degrade to re-execution, count the expiry
+    assert j2.recovery.expired >= 1
+    assert not j2.recovery.pending
+    assert j2.recovery.classification["qa"] == "abandoned"
+    assert _delta(before, "recovery_leases_expired") >= 1
+    j2.close(purge=True)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: commit → crash → restart → committed stages SERVED
+# ---------------------------------------------------------------------------
+
+class _Crash(BaseException):
+    """Simulated driver death.  BaseException on purpose: the commit
+    protocol's durability isolation (``except Exception``) must not
+    swallow it, mirroring how a real SIGKILL is unswallowable."""
+
+
+def _rec_conf(root):
+    return {
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.tpu.recovery.enabled": True,
+        "spark.rapids.tpu.recovery.dir": root,
+        # keep real multi-partition exchanges on the single test device
+        "spark.rapids.tpu.shuffle.singleDeviceCoalesce": False,
+        "spark.sql.shuffle.partitions": 4,
+        "spark.sql.autoBroadcastJoinThreshold": "-1",
+        "spark.sql.adaptive.enabled": False,
+    }
+
+
+def _rec_query(s):
+    fact = s.create_dataframe(
+        {"k": [i % 50 for i in range(2000)],
+         "v": [(i * 7) % 23 - 11 for i in range(2000)]},
+        T.StructType([T.StructField("k", T.INT),
+                      T.StructField("v", T.LONG)]))
+    dim = s.create_dataframe(
+        {"k": list(range(50)), "g": [i % 7 for i in range(50)]},
+        T.StructType([T.StructField("k", T.INT),
+                      T.StructField("g", T.INT)]))
+    return (fact.join(dim, on="k", how="inner")
+            .group_by("g").agg(sum_("v", "sv")))
+
+
+def test_crash_after_commit_resumes_without_reexecution(rec_root):
+    oracle = sorted(_rec_query(
+        TpuSession({"spark.rapids.sql.enabled": False})).collect())
+
+    # incarnation 1: die right AFTER the second durable stage commit
+    # (the record is on disk when the "kill" lands) and before the end
+    # record — journal_end is stubbed out because in-process unwinding
+    # still runs the lifecycle __exit__ a real SIGKILL would not
+    state = {"ckpts": 0}
+
+    def _hook(kind, n):
+        if kind == "ckpt":
+            state["ckpts"] += 1
+            if state["ckpts"] >= 2:
+                raise _Crash()
+
+    orig_end = JM.journal_end
+    JM.TEST_RECORD_HOOK = _hook
+    JM.journal_end = lambda *a, **k: None
+    try:
+        with pytest.raises(_Crash):
+            _rec_query(TpuSession(_rec_conf(rec_root))).collect()
+    finally:
+        JM.TEST_RECORD_HOOK = None
+        JM.journal_end = orig_end
+
+    # "restart": drop the singleton; the next query's journal open
+    # rotates + replays the crashed incarnation's WAL
+    JM.reset_journal()
+    before = PC.snapshot()
+
+    from spark_rapids_tpu.exec import exchange as EX
+
+    executed = {"n": 0}
+    orig_spill = EX.TpuShuffleExchangeExec._execute_spill_backed
+
+    def _counting(self, c, ckpt):
+        executed["n"] += 1
+        return orig_spill(self, c, ckpt)
+
+    EX.TpuShuffleExchangeExec._execute_spill_backed = _counting
+    try:
+        rows = sorted(_rec_query(TpuSession(_rec_conf(rec_root)))
+                      .collect())
+    finally:
+        EX.TpuShuffleExchangeExec._execute_spill_backed = orig_spill
+
+    assert rows == oracle
+    # the crashed query was classified resumable, both committed stages
+    # were SERVED from their checkpoints — zero exchange re-executions
+    assert "resumable" in JM.recovery_report().values()
+    assert _delta(before, "stages_recovered") == 2
+    assert _delta(before, "queries_resumed") == 1
+    assert executed["n"] == 0
+    # end-of-query GC: nothing pending, no checkpoint dirs left behind
+    j = JM.peek_journal()
+    assert j is not None and j.leak_lines() == []
+
+
+def test_crash_before_any_commit_reexecutes_cleanly(rec_root):
+    oracle = sorted(_rec_query(
+        TpuSession({"spark.rapids.sql.enabled": False})).collect())
+
+    def _hook(kind, n):
+        if kind == "plan":
+            raise _Crash()
+
+    orig_end = JM.journal_end
+    JM.TEST_RECORD_HOOK = _hook
+    JM.journal_end = lambda *a, **k: None
+    try:
+        with pytest.raises(_Crash):
+            _rec_query(TpuSession(_rec_conf(rec_root))).collect()
+    finally:
+        JM.TEST_RECORD_HOOK = None
+        JM.journal_end = orig_end
+
+    JM.reset_journal()
+    before = PC.snapshot()
+    rows = sorted(_rec_query(TpuSession(_rec_conf(rec_root))).collect())
+    assert rows == oracle
+    assert "abandoned" in JM.recovery_report().values()
+    assert _delta(before, "stages_recovered") == 0
+    j = JM.peek_journal()
+    assert j is not None and j.leak_lines() == []
+
+
+# ---------------------------------------------------------------------------
+# re-attach must clear the dead incarnation's breaker entry
+# ---------------------------------------------------------------------------
+
+def test_reattach_clears_stale_breaker_entry():
+    """Regression pin: a worker re-attaching after a driver restart used
+    to be quarantined by the ("DistributedWorker", id) breaker entry its
+    PRIOR incarnation's loss left behind — turning every resumable query
+    into a full re-execution.  A recovery re-HELLO (held inventory
+    present) clears the stale entry; a plain rejoin still quarantines."""
+    from spark_rapids_tpu import distributed as D
+    from spark_rapids_tpu.distributed.coordinator import (
+        ALIVE,
+        BREAKER_OP,
+        QUARANTINED,
+    )
+    from spark_rapids_tpu.resilience.breaker import get_breaker
+
+    D.reset_coordinator()
+    coord = D.get_coordinator(TpuConf({
+        "spark.rapids.tpu.distributed.enabled": True,
+        "spark.rapids.tpu.distributed.heartbeatMs": 100,
+        "spark.rapids.tpu.distributed.workerLostMs": 500,
+        "spark.rapids.tpu.distributed.opTimeoutMs": 1000}))
+    socks = []
+
+    def _hello(wid, held):
+        a, b = socket.socketpair()
+        socks.extend((a, b))
+        coord._admit(wid, "127.0.0.1",
+                     {"data_port": 1, "pid": 0, "mem_bytes": 1 << 20,
+                      "held": held}, a)
+
+    try:
+        for wid in ("w_stale", "w_flappy"):
+            get_breaker().record_failure((BREAKER_OP, wid), 1,
+                                         reason="worker lost: crash")
+        # plain rejoin (no held inventory): the quarantine still bites
+        _hello("w_flappy", [])
+        assert coord._workers["w_flappy"].state == QUARANTINED
+        # recovery re-HELLO: stale entry cleared, worker placeable again
+        _hello("w_stale", [[9, 0, 3, 2]])
+        assert coord._workers["w_stale"].state == ALIVE
+        assert get_breaker().consult((BREAKER_OP, "w_stale"), 3600) \
+            is None
+        # cross-incarnation wire-id safety rode along: the id counter
+        # reseeded past the held inventory's max, so a new exchange can
+        # never collide with the dead incarnation's stored blocks
+        assert next(coord._wire_ids) > 9
+    finally:
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        D.reset_coordinator()
+
+
+# ---------------------------------------------------------------------------
+# disabled path: recovery off ⇒ ZERO journal-module calls
+# ---------------------------------------------------------------------------
+
+def test_recovery_off_makes_zero_journal_calls():
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    q = _rec_query(s)
+    prof = cProfile.Profile()
+    prof.enable()
+    rows = q.collect()
+    prof.disable()
+    assert len(rows) == 7
+    jfile = os.path.join("lifecycle", "journal.py")
+    offenders = sorted({
+        f"{e.code.co_filename}:{e.code.co_name}"
+        for e in prof.getstats()
+        if hasattr(e.code, "co_filename")
+        and e.code.co_filename.endswith(jfile)})
+    assert not offenders, (
+        "recovery disabled but the collect entered the journal module: "
+        + ", ".join(offenders))
+    assert JM.peek_journal() is None
+
+
+# ---------------------------------------------------------------------------
+# persistent compile cache: crash-consistent entry publication
+# ---------------------------------------------------------------------------
+
+def test_persistent_compile_cache_put_is_atomic(tmp_path):
+    """Stock jax LRUCache.put writes the serialized executable to its
+    FINAL path with one plain write_bytes: a SIGKILL mid-write (the
+    --driver-kill harness lands kills exactly there) or a concurrent
+    reader (AOT pool thread, worker process sharing the directory)
+    sees a truncated entry and deserialize_executable SEGFAULTS.
+    ensure_atomic_cache_put re-binds put to tmp + os.replace — pin
+    that every cache-enabling path gets the hardened publication."""
+    from spark_rapids_tpu.compilecache import ensure_atomic_cache_put
+
+    ensure_atomic_cache_put()
+    _lru = pytest.importorskip("jax._src.lru_cache")
+    # the patch is bound (session + worker both route through it)
+    assert _lru.LRUCache.put.__name__ == "_atomic_put"
+    c = _lru.LRUCache(str(tmp_path), max_size=-1)
+    c.put("k1", b"executable-bytes")
+    assert c.get("k1") == b"executable-bytes"
+    # publication staged nothing at the final path: no tmp debris
+    assert not [p for p in os.listdir(tmp_path) if ".tmp." in p]
+    # stock duplicate-put semantics preserved (first write wins)
+    c.put("k1", b"other")
+    assert c.get("k1") == b"executable-bytes"

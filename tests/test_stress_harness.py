@@ -81,6 +81,29 @@ def test_worker_kill_chaos_twin():
     assert s["blocks_shipped"] > 0
 
 
+def test_driver_kill_twin():
+    """``run_chaos.py --driver-kill`` engine (ISSUE 16), tier-1 size —
+    the acceptance pin: a 2-worker distributed join is SIGKILLed at the
+    DRIVER right after its first durable stage commit; the restarted
+    driver reconstructs membership from the surviving workers' re-HELLO
+    inventories, classifies the crashed query resumable, serves the
+    committed stage from its journaled lease (``stages_recovered >= 1``
+    — NOT re-executed), matches the CPU oracle, and strands zero worker
+    partitions.  The CLI runs the full mid-plan/mid-shuffle/mid-commit
+    sweep."""
+    from run_stress import run_driver_kill
+
+    s = run_driver_kill(n_workers=2, seed=20260806, rows=20_000,
+                        kill_points=("ckpt:1",), quiet=True)
+    assert not s["failures"], s["failures"]
+    assert s["rounds_run"] == 1
+    r = s["results"][0]
+    assert r["counters"]["stages_recovered"] >= 1
+    assert r["counters"]["queries_resumed"] >= 1
+    assert "resumable" in r["recovery"].values()
+    assert r["stranded_blocks"] == 0
+
+
 def test_hot_cache_trace_replay():
     """``run_stress.py --hot-cache`` engine (ISSUE 6): 8 workers replay
     the same parquet table concurrently — every warm replay must be a

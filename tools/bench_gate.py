@@ -29,7 +29,12 @@ non-zero when the new run regressed past the tolerance:
   wall must stay within ``--tolerance`` (+3s absolute slack for the
   loss-detection window), and a kill-armed run must record both a
   ``workerLost`` declaration and ``partitionsReplayed > 0`` — a wrong
-  answer or an unrecovered loss fails loudly.
+  answer or an unrecovered loss fails loudly;
+* ``rung5_recovery`` (ISSUE 16): the journal-on vs journal-off
+  hot-path A/B must stay within ``JOURNAL_OVERHEAD_MAX_PCT`` (2%,
+  absolute — self-contained per run), and the kill-at-50% resume must
+  record ``stagesRecovered > 0`` (a committed stage served, not
+  re-executed); the resume-vs-cold walls are informational.
 
 The payload's per-plan-signature ``slo`` section is informational, not
 gated: it includes warm-up/compile collects whose latency depends on
@@ -69,6 +74,12 @@ RUNG4_DIST_SLACK_S = 3.0
 TRACE_OVERHEAD_MAX_PCT = 5.0
 SHED_RATE_SLACK = 0.05
 RECOVERY_SLACK_S = 1.0
+# crash-consistent recovery pin (ISSUE 16): the rung5_recovery
+# journal-on vs journal-off hot-path A/B (min of repeats per mode) must
+# stay within this many percent — journal appends are per-QUERY and
+# per-STAGE-COMMIT, never per-row or per-batch, so growth here means
+# durability work leaked onto the hot path
+JOURNAL_OVERHEAD_MAX_PCT = 2.0
 # progressOverhead (ISSUE 12): absolute percentage-point slack — the
 # A/B times sub-second collects, so small relative drift is noise
 PROGRESS_OVERHEAD_SLACK_PP = 10.0
@@ -270,6 +281,33 @@ def gate(base: Dict, new: Dict, tolerance: float = DEFAULT_TOLERANCE,
                 f"{float(n4.get('traceOnWall_s') or 0):.3f}s vs "
                 f"trace-off "
                 f"{float(n4.get('traceOffWall_s') or 0):.3f}s)")
+
+    # gating rung5_recovery (ISSUE 16): the crash-consistent recovery
+    # rung — the journal-on hot-path overhead is an ABSOLUTE pin
+    # (the A/B is self-contained per run), and a run whose resume
+    # stopped adopting committed stages means recovery silently
+    # degraded to full re-execution.  The resume-vs-cold walls are
+    # informational (resume includes the un-committed tail's work).
+    b5, n5 = bq.get("rung5_recovery"), nq.get("rung5_recovery")
+    if n5:
+        op5 = n5.get("journalOverheadPct")
+        if op5 is not None and float(op5) > JOURNAL_OVERHEAD_MAX_PCT:
+            regressions.append(
+                f"rung5_recovery: journal-on hot-path overhead "
+                f"{float(op5):+.2f}% exceeds the "
+                f"{JOURNAL_OVERHEAD_MAX_PCT:.0f}% pin (on "
+                f"{float(n5.get('journalOnWall_s') or 0):.3f}s vs off "
+                f"{float(n5.get('journalOffWall_s') or 0):.3f}s) — "
+                f"journaling leaked onto the per-row/per-batch path")
+        if not n5.get("stagesRecovered"):
+            regressions.append(
+                "rung5_recovery: stages_recovered == 0 — the resumed "
+                "run re-executed its committed stage")
+        if b5 and b5.get("journalRecordsWritten") \
+                and not n5.get("journalRecordsWritten"):
+            regressions.append(
+                "rung5_recovery: journal_records_written collapsed to "
+                "0 — the rung no longer exercises the journal")
 
     # progressOverhead (ISSUE 12 satellite): the live-progress
     # enabled-path tax must not creep across rounds.  Gated only when

@@ -262,6 +262,35 @@ def run_worker_kill_sweep(seed: int, workers: int, rounds: int,
     return ok
 
 
+def run_driver_kill_sweep(seed: int, workers: int, rows: int,
+                          kill_points: str = "") -> bool:
+    """The --driver-kill sweep (ISSUE 16): SIGKILL the DRIVER process
+    mid-query — mid-plan, mid-shuffle, and right after a durable stage
+    commit — restart it against the surviving worker pool, and pin
+    crash-consistent recovery: oracle-equal resumed results, a recovery
+    classification (completed/resumable/abandoned) for every journaled
+    query, committed stages SERVED from their checkpoint lease instead
+    of re-executed (``stages_recovered >= 1`` on the ckpt round), zero
+    stranded worker partitions, and empty leak reports in every
+    incarnation (run_stress.run_driver_kill)."""
+    import json
+
+    from run_stress import run_driver_kill
+
+    kps = [k.strip() for k in kill_points.split(",") if k.strip()] or None
+    print(f"\n== driver-kill sweep ({workers} workers, kill points "
+          f"{kps or ['plan:1', 'ship:6', 'ckpt:1']}) ==")
+    s = run_driver_kill(n_workers=workers, seed=seed, rows=rows,
+                        kill_points=kps, quiet=False)
+    print(json.dumps({k: s[k] for k in (
+        "kill_points", "rounds_run", "results")}, indent=2, default=str))
+    for f in s["failures"]:
+        print(f"FAILURE: {f}")
+    ok = not s["failures"] and s["rounds_run"] == len(s["kill_points"])
+    print("driver-kill sweep:", "OK" if ok else "FAILED")
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seed", type=int, default=7)
@@ -278,8 +307,21 @@ def main():
                          "SIGSTOP random workers during a distributed "
                          "replay, pinning zero wrong answers and zero "
                          "hard failures")
+    ap.add_argument("--driver-kill", action="store_true",
+                    help="sweep driver-process SIGKILLs (mid-plan, "
+                         "mid-shuffle, post-commit) with restart + "
+                         "crash-consistent recovery pins: oracle-equal "
+                         "resume, committed stages not re-executed, "
+                         "zero stranded worker partitions")
     ap.add_argument("--workers", type=int, default=3,
-                    help="worker processes for --worker-kill")
+                    help="worker processes for --worker-kill / "
+                         "--driver-kill (min 2 for --driver-kill)")
+    ap.add_argument("--rows", type=int, default=60_000,
+                    help="fact-table rows for --driver-kill")
+    ap.add_argument("--kill-points", default="",
+                    help="comma-separated --driver-kill points "
+                         "(admit:N/plan:N/ship:N/ckpt:N); default "
+                         "plan:1,ship:6,ckpt:1")
     ap.add_argument("--rounds", type=int, default=4,
                     help="replay rounds for --worker-kill")
     ap.add_argument("--kills", type=int, default=2,
@@ -291,6 +333,10 @@ def main():
                          "file")
     args = ap.parse_args()
 
+    if args.driver_kill:
+        return 0 if run_driver_kill_sweep(
+            args.seed, max(args.workers, 2), args.rows,
+            kill_points=args.kill_points) else 1
     if args.worker_kill:
         return 0 if run_worker_kill_sweep(
             args.seed, args.workers, args.rounds, args.kills,

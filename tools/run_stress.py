@@ -876,6 +876,306 @@ def run_worker_kill(n_workers: int = 3, rounds: int = 4, seed: int = 7,
         D.reset_coordinator()
 
 
+def _driver_kill_query(s, rows: int, seed: int):
+    """The deterministic distributed join+agg both driver incarnations
+    (and the parent's CPU oracle) build — same data from the seed."""
+    import numpy as np
+
+    from spark_rapids_tpu import types as T
+
+    nrng = np.random.default_rng(seed)
+    n_dim = 500
+    fact = s.create_dataframe(
+        {"k": nrng.integers(0, n_dim, rows).tolist(),
+         "v": nrng.integers(-100, 100, rows).tolist()},
+        T.StructType([T.StructField("k", T.INT),
+                      T.StructField("v", T.LONG)]))
+    dim = s.create_dataframe(
+        {"k": list(range(n_dim)), "g": [i % 13 for i in range(n_dim)]},
+        T.StructType([T.StructField("k", T.INT),
+                      T.StructField("g", T.INT)]))
+    from spark_rapids_tpu.session import sum_
+
+    return (fact.join(dim, on="k", how="inner")
+            .group_by("g").agg(sum_("v", "sv")))
+
+
+def _driver_kill_conf(recovery_dir: str) -> dict:
+    return {
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.tpu.distributed.enabled": True,
+        "spark.rapids.tpu.recovery.enabled": True,
+        "spark.rapids.tpu.recovery.dir": recovery_dir,
+        # SIGKILLed incarnations must not share the persistent XLA
+        # executable cache: jax's lru_cache.put writes the final path
+        # directly (no tmp+rename), so a kill landing mid-write leaves
+        # a truncated executable that segfaults a LATER process's
+        # deserialize.  The journal WAL is the only shared durable
+        # state this harness is allowed to tear mid-write.
+        "spark.rapids.tpu.compile.cacheDir": "0",
+        "spark.sql.autoBroadcastJoinThreshold": "-1",
+        "spark.sql.adaptive.enabled": False,
+        "spark.rapids.sql.batchSizeBytes": 64 << 10,
+        "spark.rapids.sql.reader.batchSizeRows": 4000,
+        "spark.rapids.tpu.distributed.heartbeatMs": 100,
+        "spark.rapids.tpu.distributed.workerLostMs": 600,
+        "spark.rapids.tpu.distributed.opTimeoutMs": 1000,
+    }
+
+
+def driver_kill_child(args) -> int:
+    """One driver INCARNATION of the --driver-kill engine (spawned by
+    run_driver_kill as a subprocess): build the coordinator (publishing
+    the endpoint file workers (re-)attach to), wait for the worker
+    pool, arm the requested SIGKILL point, and run the replay query.
+    A non-killed incarnation writes its result JSON (rows, recovery
+    classification, counters, stranded worker blocks, leaks)
+    atomically for the parent's pins."""
+    import json
+    import signal
+
+    from spark_rapids_tpu import distributed as D
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.lifecycle import journal as JM
+    from spark_rapids_tpu.lifecycle import leak_report_all
+    from spark_rapids_tpu.session import TpuSession
+
+    conf = _driver_kill_conf(args.recovery_dir)
+    coord = D.get_coordinator(TpuConf(conf))
+    if not coord.wait_for_workers(args.workers, timeout_s=60):
+        print("driver-kill child: workers never attached",
+              file=sys.stderr)
+        return 3
+
+    kind_at, _, n_s = (args.kill_at or "none").partition(":")
+    n_at = int(n_s) if n_s else 1
+    if kind_at == "ship":
+        # mid-shuffle: SIGKILL after the n_at-th shipped block
+        from spark_rapids_tpu.distributed import client as DC
+
+        state = {"n": 0}
+
+        def _ship_hook(exch, pid, seq):
+            state["n"] += 1
+            if state["n"] >= n_at:
+                os.kill(os.getpid(), signal.SIGKILL)
+
+        DC.TEST_SHIP_HOOK = _ship_hook
+    elif kind_at not in ("", "none"):
+        # journal-record kill points: admit (before planning), plan
+        # (before execution), ckpt (right after the n_at-th durable
+        # stage commit — the record IS on disk when the kill lands)
+        state = {"n": 0}
+
+        def _rec_hook(kind, n):
+            if kind != kind_at:
+                return
+            state["n"] += 1
+            if state["n"] >= n_at:
+                os.kill(os.getpid(), signal.SIGKILL)
+
+        JM.TEST_RECORD_HOOK = _rec_hook
+
+    s = TpuSession(conf)
+    t0 = time.monotonic()
+    rows = sorted(_driver_kill_query(s, args.rows, args.seed).collect())
+    wall = time.monotonic() - t0
+    d = PC.snapshot()
+    stranded = 0
+    for wid in sorted(coord.worker_inventory()):
+        try:
+            stranded += int(coord.worker_stats(wid).get("blocks", 0))
+        except Exception:   # noqa: BLE001 — a slow worker is not a leak
+            pass
+    out = {
+        "rows": [[int(x) for x in r] for r in rows],
+        "wall_s": round(wall, 3),
+        "counters": {k: d[k] for k in (
+            "journal_records_written", "stages_recovered",
+            "queries_resumed", "journal_recovery_discards",
+            "recovery_leases_expired", "workers_joined",
+            "dist_blocks_shipped", "partitions_replayed")},
+        "recovery": JM.recovery_report(),
+        "stranded_blocks": stranded,
+        "leaks": leak_report_all(),
+    }
+    if args.result_out:
+        tmp = args.result_out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+        os.replace(tmp, args.result_out)
+    else:
+        print(json.dumps(out))
+    return 0
+
+
+def run_driver_kill(n_workers: int = 2, seed: int = 7,
+                    rows: int = 60_000, kill_points=None,
+                    quiet: bool = False) -> dict:
+    """ISSUE 16: the --driver-kill chaos engine — SIGKILL the DRIVER
+    process mid-query (mid-plan, mid-shuffle, mid-commit), restart it,
+    and pin crash-consistent recovery.  The worker pool is owned by
+    THIS parent process and outlives both driver incarnations (armed
+    with ``--reattach-ms`` + the recovery root's endpoint file); per
+    kill point the parent runs incarnation 1 (killed), then
+    incarnation 2 (clean), and asserts:
+
+      * incarnation 2's rows equal the in-process CPU oracle,
+      * every journaled query has a recovery classification and the
+        crashed one is NOT 'completed',
+      * zero worker-held blocks survive the resumed query (orphaned
+        holdings reconciled, adopted leases released after serving),
+      * a kill landing after a committed stage ('ckpt:N') resumes with
+        ``stages_recovered >= 1`` and the crashed query classified
+        'resumable' (the committed stage is served, not re-executed),
+      * both incarnations' leak reports are empty.
+    """
+    import json
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    from spark_rapids_tpu.session import TpuSession
+
+    kill_points = list(kill_points or ("plan:1", "ship:6", "ckpt:1"))
+    root = tempfile.mkdtemp(prefix="srt_driver_kill_")
+    endpoint = os.path.join(root, "coordinator.endpoint")
+
+    oracle = sorted(_driver_kill_query(
+        TpuSession({"spark.rapids.sql.enabled": False}),
+        rows, seed).collect())
+    oracle_json = [[int(x) for x in r] for r in oracle]
+
+    repo_root = os.path.dirname(_HERE)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    def spawn_worker(wid: str) -> subprocess.Popen:
+        cmd = [sys.executable, "-m",
+               "spark_rapids_tpu.distributed.worker",
+               "--worker-id", wid, "--mem-bytes", str(32 << 20),
+               "--heartbeat-ms", "100", "--op-timeout-ms", "1000",
+               "--endpoint-file", endpoint,
+               "--reattach-ms", "120000"]
+        return subprocess.Popen(cmd, env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+
+    def spawn_driver(tag: str, kill_at: str,
+                     result_out: str = "") -> subprocess.Popen:
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--driver-kill-child", "--recovery-dir", root,
+               "--kill-at", kill_at, "--workers", str(n_workers),
+               "--rows", str(rows), "--seed", str(seed)]
+        if result_out:
+            cmd += ["--result-out", result_out]
+        log = open(os.path.join(root, f"driver_{tag}.log"), "wb")
+        return subprocess.Popen(cmd, env=env, stdout=log, stderr=log)
+
+    def log_tail(tag: str) -> str:
+        try:
+            with open(os.path.join(root, f"driver_{tag}.log"), "rb") as f:
+                return f.read()[-800:].decode("utf-8", "replace")
+        except OSError:
+            return "<no log>"
+
+    failures, results, workers = [], [], []
+    try:
+        for i, kp in enumerate(kill_points):
+            p1 = spawn_driver(f"{i}a", kp)
+            if i == 0:
+                # the first incarnation's coordinator publishes the
+                # endpoint file; only then can the worker pool dial it
+                deadline = time.monotonic() + 90
+                while not os.path.exists(endpoint) \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.05)
+                if not os.path.exists(endpoint):
+                    failures.append("no coordinator endpoint appeared")
+                    p1.kill()
+                    break
+                workers.extend(spawn_worker(f"dk{w}")
+                               for w in range(n_workers))
+            rc1 = p1.wait(timeout=300)
+            if rc1 != -signal.SIGKILL:
+                failures.append(
+                    f"round {i} ({kp}): incarnation 1 exited rc={rc1}, "
+                    f"expected SIGKILL death [{log_tail(f'{i}a')}]")
+                continue
+            res_path = os.path.join(root, f"result_{i}.json")
+            p2 = spawn_driver(f"{i}b", "none", result_out=res_path)
+            rc2 = p2.wait(timeout=300)
+            if rc2 != 0:
+                failures.append(
+                    f"round {i} ({kp}): incarnation 2 exited rc={rc2} "
+                    f"[{log_tail(f'{i}b')}]")
+                continue
+            with open(res_path) as f:
+                res = json.load(f)
+            results.append({"kill": kp,
+                            "counters": res["counters"],
+                            "recovery": res["recovery"],
+                            "stranded_blocks": res["stranded_blocks"],
+                            "wall_s": res["wall_s"]})
+            if res["rows"] != oracle_json:
+                failures.append(f"round {i} ({kp}): WRONG ANSWER "
+                                f"({len(res['rows'])} rows)")
+            if res["stranded_blocks"]:
+                failures.append(
+                    f"round {i} ({kp}): {res['stranded_blocks']} worker "
+                    f"blocks stranded after the resumed query")
+            if res["leaks"]:
+                failures.append(f"round {i} ({kp}): leaks: "
+                                f"{res['leaks'][:3]}")
+            classes = res["recovery"]
+            bad = {q: c for q, c in classes.items()
+                   if c not in ("completed", "resumable", "abandoned")}
+            if bad:
+                failures.append(f"round {i} ({kp}): unclassified "
+                                f"journaled queries: {bad}")
+            crashed = [c for c in classes.values() if c != "completed"]
+            if not crashed:
+                failures.append(
+                    f"round {i} ({kp}): the killed incarnation's query "
+                    f"was classified completed: {classes}")
+            if kp.startswith("ckpt"):
+                # the acceptance pin: a committed stage is SERVED on
+                # restart, never re-executed
+                if res["counters"].get("stages_recovered", 0) < 1:
+                    failures.append(
+                        f"round {i} ({kp}): stages_recovered="
+                        f"{res['counters'].get('stages_recovered')} "
+                        f"(committed stage was re-executed)")
+                if "resumable" not in crashed:
+                    failures.append(
+                        f"round {i} ({kp}): crashed query not "
+                        f"classified resumable: {classes}")
+            if not quiet:
+                print(f"round {i} ({kp}): ok "
+                      f"stages_recovered="
+                      f"{res['counters'].get('stages_recovered')} "
+                      f"recovery={classes}")
+    finally:
+        for p in workers:
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:   # noqa: BLE001
+                pass
+        shutil.rmtree(root, ignore_errors=True)
+    summary = {
+        "mode": "driver_kill", "workers": n_workers,
+        "kill_points": kill_points, "rounds_run": len(results),
+        "results": results, "failures": failures,
+    }
+    if not quiet:
+        print(json.dumps(summary, indent=2))
+    return summary
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--threads", type=int, default=None,
@@ -902,9 +1202,32 @@ def main() -> int:
                          "(tools/run_chaos.py --worker-kill runs this "
                          "same engine)")
     ap.add_argument("--workers", type=int, default=3,
-                    help="worker processes for --worker-kill")
+                    help="worker processes for --worker-kill / "
+                         "--driver-kill")
     ap.add_argument("--kills", type=int, default=2,
                     help="rounds of --worker-kill that arm a kill")
+    ap.add_argument("--driver-kill", action="store_true",
+                    help="ISSUE 16: SIGKILL the DRIVER mid-query "
+                         "(mid-plan, mid-shuffle, mid-commit), restart "
+                         "it against the surviving worker pool, and pin "
+                         "oracle-equal resume, recovery classification "
+                         "for every journaled query, committed stages "
+                         "served not re-executed, zero stranded worker "
+                         "partitions, empty leaks (tools/run_chaos.py "
+                         "--driver-kill runs this same engine)")
+    ap.add_argument("--rows", type=int, default=60_000,
+                    help="fact-table rows for --driver-kill")
+    ap.add_argument("--kill-points", default="plan:1,ship:6,ckpt:1",
+                    help="comma-separated --driver-kill SIGKILL points: "
+                         "admit:N / plan:N (Nth journal record), ship:N "
+                         "(Nth shipped shuffle block), ckpt:N (right "
+                         "after the Nth durable stage commit)")
+    # internal: one driver incarnation of --driver-kill (subprocess)
+    ap.add_argument("--driver-kill-child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--recovery-dir", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--kill-at", default="none", help=argparse.SUPPRESS)
+    ap.add_argument("--result-out", default="", help=argparse.SUPPRESS)
     ap.add_argument("--limit", type=int, default=4,
                     help="admission capacity for --overload (threads/"
                          "limit = the overcommit factor)")
@@ -917,6 +1240,26 @@ def main() -> int:
                          "+ SLO summary to this JSON file; '' disables")
     args = ap.parse_args()
     n_threads = args.threads or (16 if args.overload else 8)
+    if args.driver_kill_child:
+        return driver_kill_child(args)
+    if args.driver_kill:
+        kps = [k.strip() for k in args.kill_points.split(",") if k.strip()]
+        s = run_driver_kill(n_workers=max(args.workers, 2),
+                            seed=args.seed, rows=args.rows,
+                            kill_points=kps)
+        ok = not s["failures"] and s["rounds_run"] == len(s["kill_points"])
+        recovered = sum(r["counters"].get("stages_recovered", 0)
+                        for r in s["results"])
+        resumed = sum(r["counters"].get("queries_resumed", 0)
+                      for r in s["results"])
+        print(("PASS" if ok else "FAIL")
+              + f": {s['rounds_run']}/{len(s['kill_points'])} driver-kill "
+              f"rounds oracle-equal ({recovered} stages served from "
+              f"checkpoint, {resumed} queries resumed, 0 stranded "
+              f"partitions)")
+        for f in s["failures"]:
+            print(f"FAILURE: {f}")
+        return 0 if ok else 1
     if args.worker_kill:
         s = run_worker_kill(n_workers=args.workers, rounds=args.rounds,
                             seed=args.seed, kills=args.kills,
