@@ -541,6 +541,51 @@ def doc_drift_problems(repo_root: str) -> List[str]:
             problems.append(
                 f"tracelint/fusibility vocabulary {word} is not "
                 f"documented in docs/static_analysis.md")
+
+    # whole-plan fusion (ISSUE 17): confs + counters + the runtime
+    # dispatch / fusion / bench-gate surface vocabulary must be
+    # documented in docs/whole_plan_fusion.md (confs in configs.md,
+    # counters ALSO in diagnostics.md via the global check), and the
+    # docs the pass's machinery rides on must cross-link it
+    fus_md = read("whole_plan_fusion.md")
+    fus_confs = [k for k in _REGISTRY
+                 if k.startswith("spark.rapids.tpu.fusion.")]
+    if not fus_confs:
+        problems.append("no spark.rapids.tpu.fusion.* confs registered")
+    for key in sorted(fus_confs):
+        if f"`{key}`" not in fus_md:
+            problems.append(
+                f"conf '{key}' is not documented in "
+                f"docs/whole_plan_fusion.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+    for key in ("subtrees_fused", "collect_shrinks_elided"):
+        if key not in PC.COUNTERS:
+            problems.append(f"fusion counter '{key}' is not "
+                            f"registered in perfcounters.COUNTERS")
+        if f"`{key}`" not in fus_md:
+            problems.append(
+                f"fusion counter '{key}' is not documented in "
+                f"docs/whole_plan_fusion.md")
+    for word in ("`CONCERNS`", "`fusion_segment()`", "`PipelineSegment`",
+                 "`MANIFEST_ELIGIBLE`", "`tools/fusibility_manifest.json`",
+                 "`fusable-with-rewrite`", "trace-time aux", "`--check`",
+                 "`predicted_intermediate_bytes`",
+                 "`nProgramsLaunched`", "`nHostSyncs`",
+                 "splits at the predicted boundary", "TpuFusedPipeline["):
+        if word not in fus_md:
+            problems.append(
+                f"whole-plan-fusion surface vocabulary {word} is not "
+                f"documented in docs/whole_plan_fusion.md")
+    for name, md in (("out_of_core.md", read("out_of_core.md")),
+                     ("static_analysis.md", sa_md),
+                     ("profiling.md", read("profiling.md"))):
+        if "whole_plan_fusion.md" not in md:
+            problems.append(
+                f"docs/{name} does not cross-link "
+                f"docs/whole_plan_fusion.md")
     return problems
 
 

@@ -114,13 +114,29 @@ class ColumnarBatch:
         cols = _shrink_cols(out_cap, tuple(self.columns))
         return ColumnarBatch(list(cols), self.num_rows, self.schema)
 
-    def to_host_columns(self) -> List[HostColumn]:
+    def to_host_columns(
+            self, max_shrink_waste_bytes: int = 0) -> List[HostColumn]:
         # one device_get for the whole batch: per-array np.asarray would pay
         # a device round trip PER BUFFER (tunnel latency dominates small
         # transfers); shrink first so padding never crosses the link
         import jax
 
-        shrunk = self.shrink_to_fit()
+        shrunk = self
+        out_cap = round_up_bucket(max(self.num_rows, 1), DEFAULT_ROW_BUCKETS)
+        if out_cap < self.capacity:
+            # shrink elision (docs/whole_plan_fusion.md): the shrink is a
+            # whole extra program launch; when the padding it would strip
+            # is under the caller's waste budget, transferring the padded
+            # buffers is cheaper than compiling + launching the compactor
+            # (to_host(n) truncates rows on host either way)
+            waste = self.nbytes() * (self.capacity - out_cap) \
+                // self.capacity
+            if waste <= max_shrink_waste_bytes:
+                from spark_rapids_tpu import perfcounters as PC
+
+                PC.bump("collect_shrinks_elided")
+            else:
+                shrunk = self.shrink_to_fit()
         # DeviceColumn is a pytree, so one device_get fetches every buffer
         # of every column (incl. struct children) in one logical round trip
         from spark_rapids_tpu.perfcounters import sync_get

@@ -816,6 +816,39 @@ WINDOW_CHAIN_FUSION = conf(
     "compose with it via device-scalar row counts — no host sync between "
     "operators).").boolean_conf(True)
 
+FUSION_ENABLED = conf("spark.rapids.tpu.fusion.enabled").doc(
+    "Whole-plan subtree fusion (ISSUE 17): compile each maximal "
+    "pipeline-able chain of narrow operators (project/filter stages, "
+    "expand) into ONE jitted XLA program routed through the compile "
+    "cache registry — a 3-operator chain then costs one launch and zero "
+    "intermediate host round trips instead of three launches with "
+    "per-edge materialization.  Eligibility is the fusibility "
+    "manifest's fusable set intersected with the cost model's predicted "
+    "intermediate sizes (see fusion.maxIntermediateFraction)."
+).boolean_conf(True)
+
+FUSION_MAX_INTERMEDIATE_FRACTION = conf(
+    "spark.rapids.tpu.fusion.maxIntermediateFraction").doc(
+    "Fusion boundary rule: a pipeline chain fuses through an operator "
+    "edge only while the cost-model-predicted intermediate at that edge "
+    "(static AOT rows, else the calibration store's measured rows EWMA, "
+    "else the capacity bound — exec/partition_sizing.py) stays within "
+    "this fraction of the HBM pool.  A predicted-oversized intermediate "
+    "splits the chain at that edge so the fused program's working set "
+    "cannot blow the pool.").double_conf(0.5)
+
+FUSION_COLLECT_SHRINK_MAX_WASTE = conf(
+    "spark.rapids.tpu.fusion.collectShrinkMaxWasteBytes").doc(
+    "Collect-boundary shrink elision: to_host_columns normally launches "
+    "one slice program to shrink a padded batch to its tight capacity "
+    "bucket before the device->host copy.  When the padding that would "
+    "be transferred anyway is at most this many bytes, the shrink "
+    "launch is elided (per-column to_host truncation already drops the "
+    "padding rows on host) — one program and its host round trip saved "
+    "per collect, and one fewer (in-capacity, out-capacity) shrink "
+    "shape to compile (minutes per shape on a tunnel-relayed chip).  "
+    "0 disables the elision.").bytes_conf(8 << 20)
+
 MESH_DEVICES = conf("spark.rapids.tpu.mesh.devices").doc(
     "Number of mesh devices for ICI stages (0 = all visible devices).  "
     "Non-power-of-2 counts are supported; capacities pad to multiples of "

@@ -67,203 +67,6 @@ def enable_operator_tracing(root: "TpuExec", on: bool = True) -> None:
             enable_operator_tracing(c, on)
 
 
-def _traced(fn):
-    import functools
-
-    @functools.wraps(fn)
-    def wrapper(self, *a, **kw):
-        if not getattr(self, "_trace_on", False):
-            yield from fn(self, *a, **kw)
-            return
-        import jax.profiler
-
-        it = fn(self, *a, **kw)
-        name = self.node_name
-        while True:
-            with jax.profiler.TraceAnnotation(name):
-                try:
-                    b = next(it)
-                except StopIteration:
-                    return
-            yield b
-
-    return wrapper
-
-
-def _progress(fn):
-    """Live-progress wrapper (ISSUE 12): when the process progress
-    tracker is active AND this node's registration stamp matches the
-    pulling thread's query, every completed batch pull advances the
-    owning operator's live counts (batches/rows/bytes) and maintains
-    the in-flight pull stack the stall detector reads.  Disabled path:
-    one ambient attribute check per batch, nothing else (the
-    diagnostics overhead contract, pinned by tests/test_progress.py)."""
-    import functools
-
-    from spark_rapids_tpu.progress import context as _PROG
-
-    @functools.wraps(fn)
-    def wrapper(self, *a, **kw):
-        it = fn(self, *a, **kw)
-        try:
-            while True:
-                trk = _PROG.TRACKER
-                h = trk.begin_pull(self) if trk is not None else None
-                if h is None:
-                    try:
-                        b = next(it)
-                    except StopIteration:
-                        return
-                    yield b
-                    continue
-                try:
-                    b = next(it)
-                except StopIteration:
-                    trk.end_pull(h, None, 0, finished=True)
-                    return
-                except BaseException:
-                    # the pull died (cancel trip, operator failure):
-                    # close the in-flight stack entry without counting
-                    # an advance, then let the unwind proceed
-                    trk.end_pull(h, None, 0, finished=False)
-                    raise
-                trk.end_pull(h, b.num_rows, b.nbytes(), finished=False)
-                yield b
-        finally:
-            it.close()
-
-    return wrapper
-
-
-def _governor_checkpoint(fn):
-    """Overload-governor hook (ISSUE 13): with an active governor,
-    every batch pull runs one rate-limited pressure update and — when
-    THIS query is the armed preemption target — the cooperative
-    pause-and-spill (the pool drains at a batch boundary; the query
-    resumes, never cancelled).  Disabled path: one ambient attribute
-    check per batch, ZERO governor-module calls (the cProfile pin in
-    tests/test_governor.py)."""
-    import functools
-
-    from spark_rapids_tpu.governor import context as _GOV
-
-    @functools.wraps(fn)
-    def wrapper(self, *a, **kw):
-        it = fn(self, *a, **kw)
-        try:
-            while True:
-                gov = _GOV.GOVERNOR
-                if gov is not None:
-                    gov.batch_pull_checkpoint()
-                try:
-                    b = next(it)
-                except StopIteration:
-                    return
-                yield b
-        finally:
-            it.close()
-
-    return wrapper
-
-
-def _cancel_guard(fn):
-    """Outermost-of-all wrapper: ONE ambient contextvar check per batch
-    pull against the current query's CancelToken (lifecycle/context.py).
-    A tripped token raises QueryCancelled / QueryDeadlineExceeded from
-    the pull site, which every enclosing fault domain classifies
-    PROPAGATE — the unwind reaches collect() without a retry, a CPU
-    fallback, or a breaker count (ISSUE 4).  Outside a lifecycle-managed
-    query the check is a None test and nothing else."""
-    import functools
-
-    from spark_rapids_tpu.lifecycle.context import CURRENT as _QCTX
-
-    @functools.wraps(fn)
-    def wrapper(self, *a, **kw):
-        it = fn(self, *a, **kw)
-        try:
-            while True:
-                ctx = _QCTX.get()
-                if ctx is not None:
-                    ctx.token.check()
-                try:
-                    b = next(it)
-                except StopIteration:
-                    return
-                yield b
-        finally:
-            it.close()
-
-    return wrapper
-
-
-def _fault_domain(fn):
-    """Wrap an operator's batch iterator in the stage-level fault domain
-    (resilience/domain.py): failure classification, bounded transient /
-    OOM restarts, runtime CPU fallback, circuit-breaker recording, and the
-    chaos-injection hooks.  The reference's RmmRapidsRetryIterator analog,
-    generalized past OOM."""
-    import functools
-
-    @functools.wraps(fn)
-    def wrapper(self, *a, **kw):
-        from spark_rapids_tpu.resilience.domain import run_fault_domain
-
-        yield from run_fault_domain(self, fn, a, kw)
-
-    return wrapper
-
-
-def _diag(fn):
-    """Outermost wrapper: when a QueryDiagnostics recorder is active,
-    every batch pull runs with the contextvar-scoped "current operator"
-    set to this exec's plan-node path, so launches / host syncs /
-    compiles / resilience events fired anywhere below (fault domain and
-    retries included) attribute here, and the pull itself is recorded as
-    a span.  Disabled path: one ambient check per batch, nothing else
-    (ISSUE 3's overhead contract)."""
-    import functools
-
-    from spark_rapids_tpu.diagnostics import context as _CTX
-
-    @functools.wraps(fn)
-    def wrapper(self, *a, **kw):
-        it = fn(self, *a, **kw)
-        try:
-            while True:
-                rec = _CTX.RECORDER
-                if rec is None:
-                    try:
-                        b = next(it)
-                    except StopIteration:
-                        return
-                    yield b
-                    continue
-                span = rec.begin_op(self)
-                if span is None:   # another query's recorder owns the slot
-                    try:
-                        b = next(it)
-                    except StopIteration:
-                        return
-                    yield b
-                    continue
-                path, token, t0 = span
-                rows = None
-                try:
-                    try:
-                        b = next(it)
-                    except StopIteration:
-                        return
-                    rows = b.num_rows
-                finally:
-                    rec.end_op(path, token, t0, rows)
-                yield b
-        finally:
-            it.close()
-
-    return wrapper
-
-
 class _SchemaOnlyExec:
     """Stand-in child inside a detached trace clone (detached_for_trace):
     registry-shared stage functions only ever read ``.output`` from their
@@ -463,6 +266,14 @@ class TpuExec:
         entry is exactly the one the first batch looks up."""
         return []
 
+    def fusion_segment(self):
+        """This operator's traceable pipeline slice for whole-plan
+        fusion (exec/fusion.PipelineSegment), or None when it cannot be
+        inlined into a larger traced region.  Only implemented by execs
+        the fusibility manifest classifies fusable / fusable-with-
+        rewrite (the pass checks both)."""
+        return None
+
     def _count_output(self, b: ColumnarBatch) -> ColumnarBatch:
         self.metrics["numOutputRows"] += b.num_rows
         self.metrics["numOutputBatches"] += 1
@@ -470,28 +281,16 @@ class TpuExec:
 
     def __init_subclass__(cls, **kw):
         super().__init_subclass__(**kw)
-        # wrap execute_columnar with per-operator trace annotations
-        # (NvtxRange analog); zero overhead unless profiling is enabled.
-        # fault domain outside the trace: it must see failures escaping
-        # the whole iteration, trace annotations included.  diagnostics
-        # outside that: the span covers retries/fallbacks, and resilience
-        # events fired by the fault domain attribute to this operator.
-        # progress between the governor checkpoint and diagnostics: its
-        # pull span covers the whole recorded batch (retries included),
-        # and a tripped token raises BEFORE begin_pull so the in-flight
-        # stack never holds a pull that was never started.
-        # governor checkpoint between the cancel guard and progress: a
-        # pause-and-spill preemption happens OUTSIDE the progress pull
-        # span (a paused query is degrading gracefully, not stalled mid
-        # -operator), and AFTER the cancel check (a tripped token
-        # raises instead of pausing).
-        # cancel guard outermost of all: a tripped CancelToken stops the
-        # pull BEFORE any more work starts, and its raise must not be
-        # wrapped in a diagnostics span it would never close
+        # install the unified operator runtime (exec/runtime.py): ONE
+        # batch loop dispatching every registered per-batch concern —
+        # cancel, governor, progress, diagnostics, fault domain, trace —
+        # in the order the runtime's CONCERNS registry pins (ISSUE 17;
+        # previously a six-deep wrapper stack built here)
         if "execute_columnar" in cls.__dict__:
-            cls.execute_columnar = _cancel_guard(_governor_checkpoint(
-                _progress(_diag(_fault_domain(
-                    _traced(cls.execute_columnar))))))
+            from spark_rapids_tpu.exec.runtime import make_operator_runtime
+
+            cls.execute_columnar = make_operator_runtime(
+                cls.execute_columnar)
 
     def collect_metrics(self, into=None) -> Dict[str, int]:
         into = into if into is not None else {}

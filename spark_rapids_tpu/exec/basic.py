@@ -254,6 +254,29 @@ class TpuStageExec(TpuExec):
 
         return run
 
+    def fusion_segment(self):
+        """Whole-plan fusion slice (exec/fusion.py): the stage's traced
+        chain inlines into a larger fused program.  The ANSI message
+        store ``_stage_fn`` fills at trace time travels with the fused
+        executable as registry aux — the manifest's fusable-with-rewrite
+        rewrite for Filter/Project.  Host-kernel stages must run
+        eagerly, so they refuse."""
+        if self._has_host_kernels():
+            return None
+        from spark_rapids_tpu.compilecache.keys import stage_ops_fp
+        from spark_rapids_tpu.exec.fusion import PipelineSegment
+
+        ops_fp = stage_ops_fp(self.ops)
+        return PipelineSegment(
+            name=self.describe(),
+            fp=None if ops_fp is None else (
+                "stage", ops_fp, bool(self.ansi)),
+            make=self._stage_fn,
+            out_schema=self._out_schema,
+            count_map=None if self._aot_filters_rows()
+            else (lambda n: n),
+            programs_unfused=1)
+
     # -- plan-time AOT enumeration (compilecache/aot.py) -----------------
     def _aot_filters_rows(self) -> bool:
         return any(getattr(op, "condition", None) is not None

@@ -133,6 +133,47 @@ class TpuGenerateExec(TpuExec):
         return ColumnarBatch(list(cols), total, self._output)
 
 
+def _stack_sel(arrs, p, i):
+    """Select across P stacked per-projection arrays: ``out[j] =
+    arrs[p[j]][i[j], ...]``.  Trailing dims pad to the common max
+    (string char widths differ per projection)."""
+    tails = {a.shape[1:] for a in arrs}
+    if len(tails) > 1:
+        rank = len(arrs[0].shape) - 1
+        maxs = tuple(max(a.shape[1 + d] for a in arrs)
+                     for d in range(rank))
+        arrs = [jnp.pad(a, [(0, 0)] + [(0, m - s) for m, s
+                                       in zip(maxs, a.shape[1:])])
+                for a in arrs]
+    if len(arrs) == 1:
+        return arrs[0][i]
+    return jnp.stack(arrs)[p, i]
+
+
+def _select_variant(vcols, p, i, row_valid):
+    """One output DeviceColumn from P per-projection variants: row j
+    takes projection p[j]'s row i[j] — the device-side concatenation of
+    expand's projected batches (recursing into struct children)."""
+    c0 = vcols[0]
+    validity = _stack_sel([v.validity for v in vcols], p, i) & row_valid
+    if c0.is_struct:
+        kids = tuple(
+            _select_variant([v.children[k] for v in vcols], p, i,
+                            row_valid)
+            for k in range(len(c0.children)))
+        return DeviceColumn(c0.dtype, validity, children=kids)
+
+    def pick(attr):
+        vals = [getattr(v, attr) for v in vcols]
+        if any(x is None for x in vals):
+            return None
+        return _stack_sel(vals, p, i)
+
+    return DeviceColumn(c0.dtype, validity, data=pick("data"),
+                        chars=pick("chars"), lengths=pick("lengths"),
+                        elem_valid=pick("elem_valid"))
+
+
 class TpuExpandExec(TpuExec):
     def __init__(self, projections: List[List[Expression]], child: TpuExec,
                  output_schema: T.StructType, ansi: bool = False):
@@ -148,6 +189,65 @@ class TpuExpandExec(TpuExec):
 
     def describe(self):
         return f"TpuExpand [{len(self.projections)} projections]"
+
+    def fusion_segment(self):
+        """Whole-plan fusion slice (exec/fusion.py): ALL projections in
+        one traced program, device-concatenated — output row j takes
+        projection ``j // n``'s input row ``j % n``, so P launches and
+        P batches per input become one launch and one batch.  The ANSI
+        message aux travels with the fused executable as registry aux
+        (the manifest's fusable-with-rewrite rewrite for Expand)."""
+        from spark_rapids_tpu.compilecache.keys import exprs_fp, schema_fp
+        from spark_rapids_tpu.exec.fusion import PipelineSegment
+
+        projections = self.projections
+        ansi = self.ansi
+        out_schema = self._output
+        P = len(projections)
+        efp = exprs_fp([e for proj in projections for e in proj])
+
+        def make(in_schema):
+            msgs: List[str] = []
+
+            def fn(cols, num_rows):
+                b = ColumnarBatch(list(cols), num_rows, in_schema)
+                cap = b.capacity
+                out_cap = round_up_bucket(max(P * cap, 1),
+                                          DEFAULT_ROW_BUCKETS)
+                variants, flags, acc = [], [], []
+                for proj in projections:
+                    ctx = EvalContext(b, ansi=ansi)
+                    variants.append([e.eval_tpu(ctx) for e in proj])
+                    flags.extend(jnp.any(f) for f, _ in ctx.error_flags)
+                    acc.extend(m for _, m in ctx.error_flags)
+                # tpulint: disable=trace-closure-state (deliberate
+                # trace-time aux: travels WITH the fused executable)
+                msgs.clear()
+                # tpulint: disable=trace-closure-state (same aux store)
+                msgs.extend(acc)
+                n = num_rows.astype(jnp.int64)
+                nsafe = jnp.maximum(n, 1)
+                j = jnp.arange(out_cap, dtype=jnp.int64)
+                p = jnp.clip(j // nsafe, 0, P - 1).astype(jnp.int32)
+                i = jnp.clip(j % nsafe, 0, cap - 1).astype(jnp.int32)
+                row_valid = j < (P * n)
+                out_cols = [
+                    _select_variant([v[k] for v in variants], p, i,
+                                    row_valid)
+                    for k in range(len(out_schema.fields))]
+                return (tuple(out_cols), (P * n).astype(jnp.int32),
+                        tuple(flags))
+
+            return fn, msgs
+
+        return PipelineSegment(
+            name=self.describe(),
+            fp=None if efp is None else (
+                "expand", efp, P, schema_fp(out_schema), bool(ansi)),
+            make=make,
+            out_schema=out_schema,
+            count_map=lambda n: P * n,
+            programs_unfused=P)
 
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
         for batch in self.children[0].execute_columnar():
@@ -218,6 +318,23 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
             self._jits[key] = tpu_jit(fn)
         return self._jits[key]
 
+    def _match_key_parts(self, lb, rbatch, key):
+        """Registry key for the match program, or None (private entry)
+        when the condition is unfingerprintable."""
+        from spark_rapids_tpu.compilecache.keys import (
+            conf_fp,
+            exprs_fp,
+            schema_fp,
+        )
+
+        cfp = exprs_fp([self.condition]
+                       if self.condition is not None else [])
+        if cfp is None:
+            return None
+        return ("bnlj", cfp, self.join_type.value, bool(self.ansi),
+                schema_fp(lb.schema), schema_fp(rbatch.schema), key,
+                conf_fp())
+
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
         right_batches = list(self.children[1].execute_columnar())
         if right_batches:
@@ -255,6 +372,10 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
                 return None
         out_cap = round_up_bucket(max(nl * max(nright, 1), 1),
                                   DEFAULT_ROW_BUCKETS)
+        # locals only: a registry-shared closure over ``self``/``lb``
+        # would pin the exec subtree and the left batch's device buffers
+        # for as long as the entry lives
+        condition, ansi, l_cap = self.condition, self.ansi, lb.capacity
 
         def match_fn(lcols, rcols, n_l, n_r):
             """(matched pairs flags + per-left any-match) on the expansion."""
@@ -268,30 +389,43 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
             pb = ColumnarBatch(list(lo) + list(ro),
                                (n_l * n_r).astype(jnp.int32), pair_schema)
             flags = ()
-            if self.condition is not None:
-                ctx = EvalContext(pb, ansi=self.ansi)
-                pred = self.condition.eval_tpu(ctx)
+            if condition is not None:
+                ctx = EvalContext(pb, ansi=ansi)
+                pred = condition.eval_tpu(ctx)
                 ok = pred.data & pred.validity & pair_ok
                 flags = tuple(jnp.any(f) for f, _ in ctx.error_flags)
                 # tpulint: disable=trace-closure-state (deliberate
-                # trace-time aux: cached WITH the jit in self._jits)
+                # trace-time aux: travels WITH the executable as the
+                # registry entry's aux)
                 flag_msgs.clear()
                 # tpulint: disable=trace-closure-state (same aux store)
                 flag_msgs.extend(m for _, m in ctx.error_flags)
             else:
                 ok = pair_ok
             li_safe = jnp.where(pair_ok, li, 0).astype(jnp.int32)
-            li_safe = jnp.clip(li_safe, 0, lb.capacity - 1)
+            li_safe = jnp.clip(li_safe, 0, l_cap - 1)
             any_match = jax.ops.segment_max(
                 jnp.where(ok, 1, 0), li_safe,
-                num_segments=lb.capacity) > 0
+                num_segments=l_cap) > 0
             return tuple(lo), tuple(ro), ok, any_match, flags
 
         key = ("match", out_cap, lb.capacity)
         if key not in self._jits:
-            # msgs list is captured by the traced fn and cached WITH the jit
-            # so cache hits still know the flag messages
-            self._jits[key] = (tpu_jit(match_fn), flag_msgs_store)
+            # the match program routes through the compile-cache registry
+            # with the trace-time flag-message aux traveling WITH the
+            # executable (entry.aux) — the manifest's fusable-with-
+            # rewrite rewrite for BroadcastNestedLoopJoin; an
+            # unfingerprintable condition keys None (instance-private
+            # entry, correct just not shared)
+            from spark_rapids_tpu.compilecache.registry import (
+                cached_program,
+            )
+
+            entry = cached_program(
+                self._match_key_parts(lb, rbatch, key),
+                lambda: (tpu_jit(match_fn), flag_msgs_store),
+                label=f"bnlj:{self.describe()[:44]}")
+            self._jits[key] = (entry.jitted, entry.aux)
         mf, flag_msgs = self._jits[key]
         lo, ro, ok, any_match, flags = mf(
             tuple(lb.columns), tuple(rbatch.columns),

@@ -79,7 +79,12 @@ class TpuColumnarToRowExec(TpuExec):
             schema = self.output
             return [HostColumn.from_pylist([], f.dataType)
                     for f in schema.fields]
-        per_batch = [b.to_host_columns() for b in batches]
+        from spark_rapids_tpu.config import (
+            FUSION_COLLECT_SHRINK_MAX_WASTE, get_conf)
+
+        waste_cap = get_conf().get(FUSION_COLLECT_SHRINK_MAX_WASTE)
+        per_batch = [b.to_host_columns(max_shrink_waste_bytes=waste_cap)
+                     for b in batches]
         out = [_concat_host([pb[ci] for pb in per_batch])
                for ci in range(len(per_batch[0]))]
         return out

@@ -130,6 +130,10 @@ def _stage_rules():
                   C.ADAPTIVE_ENABLED.key,
                   "stats-driven shuffle-read partition coalescing "
                   "(GpuCustomShuffleReaderExec analog)"),
+        StageRule("TpuFusedPipelineExec", C.FUSION_ENABLED.key,
+                  "maximal pipeline-able operator chains (stage/expand) "
+                  "compiled as ONE jitted program, split at predicted-"
+                  "oversized HBM boundaries (manifest ∩ cost model)"),
     ]}
 
 
@@ -186,6 +190,13 @@ class TpuTransitionOverrides:
         # the stage ops absorbed as the aggregate's pre_ops
         root = TpuTransitionOverrides._fuse_join_agg(root, conf)
         root = TpuTransitionOverrides._fuse_window_chain(root, conf)
+        # whole-plan pipeline fusion (ISSUE 17) after the specialized
+        # join-agg / window-chain fusions so they keep first claim on
+        # their patterns; remaining stage/expand chains compile into one
+        # program each, split at predicted-oversized HBM boundaries
+        from spark_rapids_tpu.exec.fusion import fuse_pipelines
+
+        root = fuse_pipelines(root, conf)
         root = TpuTransitionOverrides._rewrite_ici_agg(root, conf)
         root = TpuTransitionOverrides._rewrite_ici_join(root, conf)
         root = TpuTransitionOverrides._rewrite_ici_sort(root, conf)

@@ -115,6 +115,12 @@ COUNTERS: Dict[str, int] = {
     "exchange_host_blocks": 0,
     "exchange_host_block_bytes": 0,
     "partitions_coalesced": 0,
+    # whole-plan fusion (ISSUE 17, exec/fusion.py): pipeline-able
+    # subtrees compiled as ONE jitted program at plan time, and collect
+    # -boundary shrink programs elided because the padded transfer waste
+    # stayed under fusion.collectShrinkMaxWasteBytes
+    "subtrees_fused": 0,
+    "collect_shrinks_elided": 0,
     # live progress tracking (ISSUE 12, progress/): watchdog-detected
     # query stalls (no operator advanced for progress.stallMs) and live
     # snapshots served (session.progress() + the /progress endpoint)

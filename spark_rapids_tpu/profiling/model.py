@@ -79,6 +79,21 @@ def _planned_bucket(node) -> Optional[int]:
     return None
 
 
+def predicted_intermediate_bytes(node, conf) -> Optional[int]:
+    """Predicted bytes of the intermediate batch ``node``'s output
+    materializes — the cost-model input to the whole-plan fusion
+    boundary rule (exec/fusion.py): a chain fuses through an edge only
+    while this stays within the HBM budget.  Delegates to the same
+    estimate ladder the out-of-core exchange sizing uses (static AOT
+    rows, then the calibration store's measured rows EWMA, then the
+    capacity bound — exec/partition_sizing.estimate_input_bytes), so a
+    store-profiled operator moves the fusion boundary exactly where the
+    partition sizing would move an exchange."""
+    from spark_rapids_tpu.exec.partition_sizing import estimate_input_bytes
+
+    return estimate_input_bytes(node, conf)
+
+
 def predict_tree(root, store: CalibrationStore) -> QueryPrediction:
     """Walk the planned exec tree (paths follow the diagnostics
     ``register_root`` convention, so predictions line up with recorded

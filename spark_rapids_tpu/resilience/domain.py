@@ -136,7 +136,9 @@ def run_fault_domain(op, fn, args, kwargs) -> Iterator:
                 yield faults.maybe_poison(name, idx, b)
                 idx += 1
         finally:
-            it.close()
+            # the raw batch iterator need not be a generator (a source
+            # exec may return a plain iterator with no close())
+            _close_quietly(it)
 
     breaker = get_breaker()
     yielded = 0                 # batches already delivered downstream

@@ -1,0 +1,241 @@
+"""Whole-plan subtree fusion (ISSUE 17): the manifest ∩ cost-model
+eligible set, the fused-pipeline plan shape + explain surface, the
+HBM-budget boundary rule (store-profiled, feedback-loop style), and
+the disable conf.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from data_gen import IntegerGen, LongGen, gen_df  # noqa: E402
+
+from spark_rapids_tpu import perfcounters as PC  # noqa: E402
+from spark_rapids_tpu.session import TpuSession, col, lit  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MANIFEST = os.path.join(REPO, "tools", "fusibility_manifest.json")
+
+
+def _session(extra=None):
+    conf = {"spark.rapids.sql.enabled": True}
+    conf.update(extra or {})
+    return TpuSession(conf)
+
+
+def _plan_names(df):
+    root, _ = df._planned()
+    out = []
+
+    def walk(n):
+        out.append(type(n).__name__)
+        for c in getattr(n, "children", []):
+            walk(c)
+
+    walk(root)
+    return out
+
+
+def _fused_nodes(df):
+    from spark_rapids_tpu.exec.fusion import TpuFusedPipelineExec
+
+    root, _ = df._planned()
+    out = []
+
+    def walk(n):
+        if isinstance(n, TpuFusedPipelineExec):
+            out.append(n)
+        for c in getattr(n, "children", []):
+            walk(c)
+
+    walk(root)
+    return out
+
+
+def _decisions(df):
+    _, meta = df._planned()
+    return [(n, ok, reason) for n, ok, reason in meta.stage_decisions]
+
+
+def _expand_query(s, length=200):
+    df = gen_df(s, [IntegerGen(min_val=0, max_val=3, nullable=False),
+                    LongGen(min_val=-100, max_val=100, nullable=False)],
+                ["k", "v"], length=length)
+    return df.expand([[col("k"), col("v")],
+                      [(col("k") * lit(0)).alias("k"), col("v")]]) \
+             .select((col("v") + lit(1)).alias("v1"), col("k"))
+
+
+# ---------------------------------------------------------------------------
+# satellite: the pass's eligible set IS the committed manifest
+# ---------------------------------------------------------------------------
+
+def test_eligible_set_matches_committed_manifest():
+    """MANIFEST_ELIGIBLE must equal the committed manifest's
+    fusable/fusable-with-rewrite exec classes EXACTLY — a reclassified
+    exec cannot keep fusing (or stay excluded) silently.  The committed
+    file itself is drift-gated against a regeneration in test_lint.py,
+    so transitively the pass eligibility tracks the analysis."""
+    from spark_rapids_tpu.exec.fusion import MANIFEST_ELIGIBLE
+
+    with open(MANIFEST) as f:
+        m = json.load(f)
+    fusable = {name for name, e in m["execs"].items()
+               if e["classification"].split("(", 1)[0]
+               in ("fusable", "fusable-with-rewrite")}
+    assert MANIFEST_ELIGIBLE == fusable, (
+        sorted(MANIFEST_ELIGIBLE - fusable), sorted(fusable - MANIFEST_ELIGIBLE))
+
+
+def test_manifest_rewrites_are_the_aux_rule():
+    """The 4 fusable-with-rewrite operators all carry the implemented
+    rewrite's reason: trace-time aux (ANSI message stores) travels with
+    the fused executable through the registry entry."""
+    with open(MANIFEST) as f:
+        m = json.load(f)
+    rewrites = {op for op, e in m["operators"].items()
+                if e["classification"].startswith("fusable-with-rewrite")}
+    assert rewrites == {"BroadcastNestedLoopJoin", "Expand", "Filter",
+                        "Project"}
+    for op in rewrites:
+        assert "trace-time aux must travel with the fused executable" \
+            in m["operators"][op]["classification"], op
+
+
+def test_every_segment_provider_is_manifest_eligible():
+    """Any exec overriding fusion_segment must be manifest-eligible —
+    otherwise it defines a segment the pass can never use."""
+    from spark_rapids_tpu.exec import basic, fusion, generate  # noqa: F401
+    from spark_rapids_tpu.exec.base import TpuExec
+    from spark_rapids_tpu.exec.fusion import manifest_eligible
+
+    def subclasses(c):
+        for s in c.__subclasses__():
+            yield s
+            yield from subclasses(s)
+
+    providers = [c for c in subclasses(TpuExec)
+                 if "fusion_segment" in c.__dict__]
+    assert providers, "no fusion_segment providers found"
+    for c in providers:
+        assert any(b.__name__ in fusion.MANIFEST_ELIGIBLE
+                   for b in c.__mro__), c.__name__
+
+
+# ---------------------------------------------------------------------------
+# plan shape + explain surface + correctness
+# ---------------------------------------------------------------------------
+
+def test_fused_pipeline_plan_shape_and_explain():
+    s = _session()
+    q = _expand_query(s)
+    fused = _fused_nodes(q)
+    assert len(fused) == 1
+    node = fused[0]
+    # constituent attribution: expand + the project stage, in pipeline
+    # order, visible in describe() and therefore explain() and the
+    # diagnostics operator span
+    assert len(node.constituents) == 2
+    assert "TpuExpand" in node.constituents[0]
+    d = node.describe()
+    assert d.startswith("TpuFusedPipeline[") and " -> " in d
+    assert "TpuFusedPipeline[" in q.explain()
+    assert ("TpuFusedPipelineExec", True, None) in _decisions(q)
+
+
+def test_fused_results_match_unfused():
+    base = _session({"spark.rapids.tpu.fusion.enabled": False})
+    fused = _session()
+    qb, qf = _expand_query(base), _expand_query(fused)
+    assert not _fused_nodes(qb)
+    assert _fused_nodes(qf)
+    assert sorted(qb.collect()) == sorted(qf.collect())
+
+
+def test_fusion_saves_launches():
+    """The acceptance direction: the fused expand chain launches
+    strictly fewer programs than the unfused plan, steady-state."""
+
+    def steady(q):
+        for _ in range(3):
+            q.collect()
+        PC.reset()
+        q.collect()
+        c = PC.snapshot()
+        return c["programs_launched"], c["host_syncs"]
+
+    off = steady(_expand_query(
+        _session({"spark.rapids.tpu.fusion.enabled": False})))
+    on = steady(_expand_query(_session()))
+    assert on[0] < off[0], (on, off)
+    assert on[1] <= off[1], (on, off)
+
+
+def test_disable_conf_records_reason():
+    s = _session({"spark.rapids.tpu.fusion.enabled": False})
+    q = _expand_query(s)
+    assert "TpuFusedPipelineExec" not in _plan_names(q)
+    reasons = [r for n, ok, r in _decisions(q)
+               if n == "TpuFusedPipelineExec" and not ok]
+    assert reasons and "spark.rapids.tpu.fusion.enabled" in reasons[0]
+
+
+# ---------------------------------------------------------------------------
+# satellite: the store-profiled HBM boundary (feedback-loop style)
+# ---------------------------------------------------------------------------
+
+def _boundary_query(s, length=8192):
+    """filter (data-dependent rows -> the calibrated-EWMA rung of the
+    estimate ladder) under an expand: the fusible chain is
+    [Expand, Stage(filter)] and the edge between them is costed from
+    the store's measured rows."""
+    df = gen_df(s, [LongGen(min_val=1, max_val=100, nullable=False),
+                    LongGen(min_val=1, max_val=100, nullable=False)],
+                ["k", "v"], length=length)
+    f = df.filter(col("v") > lit(0))      # keeps every row: EWMA ~length
+    return f.expand([[col("k"), col("v")],
+                     [(col("k") * lit(0)).alias("k"), col("v")]])
+
+
+def test_boundary_splits_at_predicted_oversize_and_fuses_with_budget(
+        tmp_path):
+    prof_dir = str(tmp_path / "prof")
+    # record UNFUSED so the store holds per-constituent operator rows
+    # (the profiling hook rides the diagnostics recorder)
+    rec = _session({
+        "spark.rapids.tpu.profile.dir": prof_dir,
+        "spark.rapids.tpu.diagnostics.enabled": True,
+        "spark.rapids.tpu.diagnostics.eventLogDir": str(tmp_path / "logs"),
+        "spark.rapids.tpu.fusion.enabled": False})
+    q = _boundary_query(rec)
+    q.collect()
+    q.collect()
+    assert os.path.exists(os.path.join(prof_dir, "calibration.json"))
+
+    # ~8192 rows * 18B/row ≈ 147KB predicted intermediate above the
+    # filter stage; a vanishing maxIntermediateFraction clamps the
+    # budget to its 64KiB floor -> the chain must SPLIT at exactly
+    # that edge: expand fuses alone, the stage stays a plain exec
+    small = _session({
+        "spark.rapids.tpu.profile.dir": prof_dir,
+        "spark.rapids.tpu.fusion.maxIntermediateFraction": 1e-12})
+    qs = _boundary_query(small)
+    fused = _fused_nodes(qs)
+    assert len(fused) == 1 and len(fused[0].constituents) == 1
+    assert "TpuExpand" in fused[0].constituents[0]
+    assert "TpuFilterExec" in _plan_names(qs)   # the stage stays unfused
+    reasons = [r for n, ok, r in _decisions(qs)
+               if n == "TpuFusedPipelineExec" and not ok]
+    assert reasons and "exceeds fusion budget" in reasons[0] \
+        and "split at the predicted boundary" in reasons[0]
+
+    # same plan, same store, default budget (half of a multi-GB pool):
+    # the predicted intermediate fits and the chain fuses through
+    big = _session({"spark.rapids.tpu.profile.dir": prof_dir})
+    qb = _boundary_query(big)
+    fused = _fused_nodes(qb)
+    assert len(fused) == 1 and len(fused[0].constituents) == 2
+    assert "TpuFilterExec" not in _plan_names(qb)
+    # both shapes compute the same answer
+    assert sorted(qs.collect()) == sorted(qb.collect())

@@ -296,6 +296,42 @@ def test_fusibility_manifest_byte_identical():
     json.loads(a)
 
 
+def test_fusibility_manifest_drift_gate():
+    """ISSUE 17: the committed tools/fusibility_manifest.json must stay
+    byte-identical to a fresh regeneration — the whole-plan fusion pass
+    derives its eligible set from it, so a stale manifest silently
+    changes what fuses.  Regenerate with
+    ``python tools/fusibility.py --out tools/fusibility_manifest.json``."""
+    from spark_rapids_tpu.analysis.fusibility import (
+        build_manifest,
+        manifest_json,
+    )
+
+    committed = os.path.join(REPO, "tools", "fusibility_manifest.json")
+    with open(committed, "r", encoding="utf-8") as f:
+        on_disk = f.read()
+    assert on_disk == manifest_json(build_manifest(REPO)), (
+        "tools/fusibility_manifest.json is stale — regenerate with "
+        "python tools/fusibility.py --out tools/fusibility_manifest.json")
+
+
+def test_fusibility_cli_check_flag(tmp_path):
+    """--check: exit 0 against the committed manifest, exit 1 on drift."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    tool = os.path.join(REPO, "tools", "fusibility.py")
+    r = subprocess.run([sys.executable, tool, "--check"], cwd=REPO,
+                       capture_output=True, text=True, env=env,
+                       timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    stale = tmp_path / "stale.json"
+    stale.write_text("{}\n")
+    r = subprocess.run([sys.executable, tool, "--check", str(stale)],
+                       cwd=REPO, capture_output=True, text=True, env=env,
+                       timeout=120)
+    assert r.returncode == 1
+    assert "stale" in r.stderr
+
+
 def test_sarif_deterministic_and_well_formed(tmp_path):
     """--sarif: byte-identical across runs, valid SARIF 2.1.0 shape,
     findings carry rule + location."""

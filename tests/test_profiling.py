@@ -562,6 +562,37 @@ def test_bench_gate_prediction_column_is_informational():
         "q": {"tpu_s": 1.0}}}) == []
 
 
+def test_bench_gate_programs_and_syncs_strict_pin():
+    """ISSUE 17: per matched query, nProgramsLaunched / nHostSyncs at
+    or below baseline pass; ANY growth is a regression (no tolerance);
+    payloads predating the fields gate nothing."""
+    bench_gate = _tool("bench_gate")
+
+    def payload(programs, syncs, **extra):
+        q = {"tpu_s": 1.0}
+        if programs is not None:
+            q["nProgramsLaunched"] = programs
+        if syncs is not None:
+            q["nHostSyncs"] = syncs
+        q.update(extra)
+        return {"metric": "m", "value": 1.0,
+                "scan_inclusive_geomean": 1.0, "queries": {"qa_hot": q}}
+
+    # equal and improved both pass
+    assert bench_gate.gate(payload(3, 2), payload(3, 2)) == []
+    assert bench_gate.gate(payload(3, 2), payload(1, 0)) == []
+    # +1 program is a regression even though every tolerance-based
+    # rule would wave it through
+    regs = bench_gate.gate(payload(3, 2), payload(4, 2))
+    assert len(regs) == 1 and "programs launched" in regs[0] \
+        and "qa_hot" in regs[0]
+    regs = bench_gate.gate(payload(3, 2), payload(3, 3))
+    assert len(regs) == 1 and "host syncs" in regs[0]
+    # baseline predates the counters: nothing to gate
+    assert bench_gate.gate(payload(None, None), payload(9, 9)) == []
+    assert bench_gate.gate(payload(3, 2), payload(None, None)) == []
+
+
 def test_check_counters_covers_profiling():
     check_counters = _tool("check_counters")
 

@@ -46,7 +46,8 @@ def test_registry_lists_all_stage_execs():
     assert names == {
         "TpuIciShuffleAggExec", "TpuIciShuffleJoinExec", "TpuIciSortExec",
         "TpuIciWindowExec", "TpuIciRepartitionExec", "TpuJoinAggFusedExec",
-        "TpuWindowChainFusedExec", "TpuAdaptiveShuffleReaderExec"}
+        "TpuWindowChainFusedExec", "TpuAdaptiveShuffleReaderExec",
+        "TpuFusedPipelineExec"}
     for r in stage_rules().values():
         assert r.conf_key and r.desc
 

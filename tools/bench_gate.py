@@ -17,6 +17,10 @@ non-zero when the new run regressed past the tolerance:
 * per matched query: ``compileWall_s`` must not grow more than
   ``--compile-tolerance`` (+0.5s slack) — compiles are cache-state
   dependent, so the gate is loose by design;
+* per matched query (ISSUE 17): ``nProgramsLaunched`` and
+  ``nHostSyncs`` must stay at or below baseline — strict, no
+  tolerance: discrete per-collect counts, so any growth means a fused
+  subtree split back apart or a blocking sync crept into the hot loop;
 * for ``--concurrency`` payloads: ``latency_ms.p95`` must not grow more
   than ``--tolerance`` (+5ms slack);
 * for ``run_stress.py --overload`` payloads (ISSUE 13): ``shed_rate``
@@ -218,6 +222,23 @@ def gate(base: Dict, new: Dict, tolerance: float = DEFAULT_TOLERANCE,
                 f"{nc:.3f}s ({_pct(bc, nc)}, tolerance "
                 f"{compile_tolerance * 100:.0f}% + "
                 f"{COMPILE_SLACK_S:.1f}s)")
+        # whole-plan fusion pin (ISSUE 17): per matched query the
+        # steady-state program-launch and host-sync counts must stay at
+        # or below baseline — STRICT, no tolerance: these are discrete
+        # per-collect counts (launches and blocking syncs), so any
+        # growth means a fused subtree split back apart or a sync
+        # sneaked into the hot loop.  Gated only when the baseline
+        # recorded the field (older payloads predate the counters).
+        for fld, what in (("nProgramsLaunched", "programs launched"),
+                          ("nHostSyncs", "host syncs")):
+            if b.get(fld) is None or n.get(fld) is None:
+                continue
+            bv, nv = float(b[fld]), float(n[fld])
+            if nv > bv:
+                regressions.append(
+                    f"{name}: {what} per collect regressed: "
+                    f"{bv:g} -> {nv:g} — fusion/sync-elision lost "
+                    f"ground (strict pin, no tolerance)")
 
     # gating rung3_ooc wall column (ISSUE 10): the pinned out-of-core
     # rung must neither vanish (caught by the missing-queries check

@@ -12,6 +12,9 @@ unchanged tree are byte-identical (pinned by tests/test_lint.py).
     python tools/fusibility.py                   # manifest to stdout
     python tools/fusibility.py --out fus.json    # write to a file
     python tools/fusibility.py --summary         # one line per operator
+    python tools/fusibility.py --check           # drift gate: exit 1 when
+                                                 # the committed manifest
+                                                 # is stale
 """
 from __future__ import annotations
 
@@ -39,9 +42,36 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--summary", action="store_true",
                     help="print a one-line-per-operator summary "
                          "instead of JSON")
+    ap.add_argument("--check", nargs="?", metavar="PATH",
+                    const=os.path.join(REPO, "tools",
+                                       "fusibility_manifest.json"),
+                    default=None,
+                    help="drift gate: regenerate the manifest and "
+                         "byte-compare against PATH (default: the "
+                         "committed tools/fusibility_manifest.json); "
+                         "exit 1 on any difference")
     args = ap.parse_args(argv)
 
     manifest = build_manifest(REPO)
+    if args.check is not None:
+        payload = manifest_json(manifest)
+        try:
+            with open(args.check, "r", encoding="utf-8") as f:
+                committed = f.read()
+        except OSError as e:
+            print(f"fusibility drift gate: cannot read {args.check}: "
+                  f"{e}", file=sys.stderr)
+            return 1
+        if committed != payload:
+            print(f"fusibility drift gate: {args.check} is stale — "
+                  f"regenerate with:\n"
+                  f"  python tools/fusibility.py --out {args.check}",
+                  file=sys.stderr)
+            return 1
+        print(f"fusibility drift gate: {args.check} is current "
+              f"({len(manifest['operators'])} operators)",
+              file=sys.stderr)
+        return 0
     if args.summary:
         for op, e in sorted(manifest["operators"].items()):
             print(f"{op:<30} {e['classification']}")
