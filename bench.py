@@ -428,6 +428,19 @@ def _time_repeats(fn, repeats, counters=False):
         "nExchangeHostBlocks": d["exchange_host_blocks"] / repeats,
         "nPartitionsCoalesced": d["partitions_coalesced"] / repeats,
     }
+    # resource bill (ISSUE 18 satellite): the last settled bill's
+    # device footprint columns.  With accounting disabled (the bench
+    # default) last_bill() is None and the columns are absent — the
+    # accountingOverhead A/B below owns the enabled-cost story.
+    from spark_rapids_tpu import accounting as _acct
+
+    lb = _acct.last_bill()
+    if lb is not None:
+        sp = lb.get("spill") or {}
+        per_run["devicePeakBytes"] = lb.get("device_peak_bytes", 0)
+        per_run["deviceByteSeconds"] = lb.get("device_byte_seconds", 0.0)
+        per_run["spilledBytes"] = (sp.get("host_bytes", 0)
+                                   + sp.get("disk_bytes", 0))
     return dt, out, per_run
 
 
@@ -602,6 +615,60 @@ def measure_progress_overhead(rows: int = 100_000,
     return timings
 
 
+def measure_accounting_overhead(rows: int = 100_000,
+                                repeats: int = 5) -> dict:
+    """``accountingOverhead`` (ISSUE 18 satellite): the wall cost of the
+    per-handle bill charging on a hot in-memory aggregate — the same
+    query timed ``repeats``x with ``spark.rapids.tpu.accounting.enabled``
+    off then on, MIN of repeats per arm (the charge tax is a fixed
+    per-handle cost; min discards scheduler noise that an average would
+    smear into the 2% gate).  tools/bench_gate.py pins overhead_pct; the
+    disabled path's zero-call contract is pinned separately by
+    tests/test_accounting.py with cProfile."""
+    from spark_rapids_tpu import accounting as _acct
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.session import TpuSession, sum_
+
+    ss = make_store_sales(rows)
+
+    def q(s):
+        sales = _df(s, {k: ss[k] for k in ("date_sk", "store_sk",
+                                           "ext_sales")},
+                    [T.INT, T.INT, T.LONG])
+        return sales.group_by("store_sk").agg(sum_("ext_sales", "s"))
+
+    timings = {}
+    # disabled arm FIRST: maybe_configure installs the process-global
+    # ledger registry, so the enabled session must come second (and be
+    # shut down after) to keep the rest of the suite accounting-free
+    for key, enabled in (("disabled_s", False), ("enabled_s", True)):
+        s = TpuSession({
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.tpu.accounting.enabled": enabled,
+        })
+        df = q(s)
+        from spark_rapids_tpu import perfcounters as PC
+
+        for _ in range(3):   # warm until no fresh compile (untimed)
+            pre = PC.COUNTERS["compiles"]
+            df.collect()
+            if PC.COUNTERS["compiles"] == pre:
+                break
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            df.collect()
+            best = min(best, time.perf_counter() - t0)
+        timings[key] = round(best, 6)
+    _acct.shutdown()
+    base = timings["disabled_s"]
+    timings["overhead_pct"] = round(
+        (timings["enabled_s"] - base) * 100.0 / base, 2) if base else 0.0
+    timings["rows"] = rows
+    timings["repeats"] = repeats
+    return timings
+
+
 def main():
     # BENCH_PLATFORM=cpu runs the suite on the XLA CPU backend (fast
     # correctness smoke; the container sitecustomize pre-imports jax on the
@@ -703,6 +770,8 @@ def main():
     queries = {}
     # progressOverhead (ISSUE 12): filled right before the final emit
     progress_box = {}
+    # accountingOverhead (ISSUE 18): same slot pattern
+    accounting_box = {}
 
     emitted = {"done": False, "rc": 0}
 
@@ -770,6 +839,7 @@ def main():
             "slo": slo,
             "telemetry": tel,
             "progressOverhead": dict(progress_box) or None,
+            "accountingOverhead": dict(accounting_box) or None,
             "hbm_roofline_gbps": V5E_HBM_GBPS,
             "note": ("vs_baseline = geomean TPU speedup over "
                      "hand-vectorized numpy (bincount/searchsorted/"
@@ -1661,6 +1731,23 @@ def main():
             return emitted["rc"]
         except Exception as ex:
             progress(f"progressOverhead failed: {ex!r}")
+
+    # accountingOverhead (ISSUE 18 satellite): the bill-charging tax on
+    # the same hot aggregate, min-of-repeats A/B — additive as above
+    if os.environ.get("BENCH_ACCOUNTING_OVERHEAD", "1") != "0" \
+            and not over_budget():
+        try:
+            accounting_box.update(measure_accounting_overhead())
+            progress(
+                f"accountingOverhead: disabled "
+                f"{accounting_box['disabled_s']:.4f}s -> enabled "
+                f"{accounting_box['enabled_s']:.4f}s "
+                f"({accounting_box['overhead_pct']:+.1f}%)")
+        except TimeoutError:
+            abort("accounting_overhead")
+            return emitted["rc"]
+        except Exception as ex:
+            progress(f"accountingOverhead failed: {ex!r}")
 
     emit()
     return emitted["rc"]

@@ -1,0 +1,297 @@
+"""Per-query resource accounting + the live regression sentinel
+(ISSUE 18).
+
+Reference analog: the reference plugin meters per-task GPU time and
+semaphore wait (GpuTaskMetrics, SURVEY §5.5) but never aggregates a
+query-attributable resource record; Theseus (arXiv:2508.05029) argues
+accelerated SQL platforms win or lose at the resource-scheduling layer,
+and a scheduler needs exactly this substrate: "which query is holding
+the HBM" (the bill) and "did this plan signature just get slower" (the
+sentinel).  The ROADMAP's multi-tenant serving tier (per-tenant quotas,
+tenant-aware shed/preempt) and adaptive execution (observed-vs-predicted
+feedback) both sit on it.
+
+Layout:
+  context.py  — the ambient LEDGERS slot + the PARTITION drain stamp
+  ledger.py   — LedgerRegistry / Bill (charged by memory/spill.py)
+  sentinel.py — per-signature baseline comparison + the delta table
+
+Wiring:
+  * ``TpuSession.__init__`` calls :func:`maybe_configure` — the first
+    session with ``spark.rapids.tpu.accounting.enabled=true`` installs
+    the process ledger registry.
+  * ``memory/spill.py`` charge sites bill every HBM registration /
+    spill / release (one ambient ``context.LEDGERS`` check each —
+    disabled: ZERO accounting calls, cProfile-pinned).
+  * ``diagnostics.query_scope``'s finish hook calls
+    :func:`record_bill` — the bill joins the recorder's counter deltas,
+    progress background wall, and federated worker bytes; lands as a
+    ``resource_bill`` event + telemetry gauges; the sentinel runs.
+  * ``lifecycle._cleanup_query`` settles the bill after the query's
+    leftover handles were swept; a nonzero residual feeds the conftest
+    leak gate.
+
+Every entry point swallows its own failures — accounting must never
+fail a query.
+"""
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Any, Dict, Optional
+
+from spark_rapids_tpu.accounting import context as CTX
+from spark_rapids_tpu.accounting.ledger import UNOWNED, LedgerRegistry
+
+_LOCK = threading.Lock()
+
+# the counter-delta slice joined into the resource_bill event (the
+# dimensions the ISSUE names: transfer volume, launches, syncs, compile
+# wall, plus the acct_* mirror keys the invariant test reconciles)
+BILL_COUNTER_KEYS = (
+    "bytes_h2d", "bytes_d2h", "programs_launched", "host_syncs",
+    "compile_wall_ns", "aot_compile_wall_ns", "launch_wall_ns",
+    "compile_cache_hits", "compile_cache_misses",
+    "acct_device_bytes_charged", "acct_device_bytes_released",
+    "acct_spill_bytes_host", "acct_spill_bytes_disk",
+    "acct_bytes_restored",
+)
+
+# partitions listed in the resource_bill event, largest traffic first
+# (a 4096-partition exchange must not bloat every event)
+MAX_EVENT_PARTITIONS = 8
+
+
+def maybe_configure(conf) -> Optional[LedgerRegistry]:
+    """Idempotent process-global start (TpuSession.__init__): the FIRST
+    enabling conf installs the ledger registry; later sessions reuse
+    it.  None when the conf disables accounting."""
+    from spark_rapids_tpu.config import (
+        ACCOUNTING_ENABLED,
+        ACCOUNTING_RETAINED_BILLS,
+    )
+
+    if not conf.get(ACCOUNTING_ENABLED):
+        return None
+    with _LOCK:
+        if CTX.LEDGERS is None:
+            CTX.LEDGERS = LedgerRegistry(
+                int(conf.get(ACCOUNTING_RETAINED_BILLS)))
+        return CTX.LEDGERS
+
+
+def get_registry() -> Optional[LedgerRegistry]:
+    return CTX.LEDGERS
+
+
+def shutdown() -> None:
+    """Clear the ledger slot (tests / process teardown); the next
+    enabling TpuSession rebuilds."""
+    with _LOCK:
+        CTX.LEDGERS = None
+
+
+def last_bill() -> Optional[Dict[str, Any]]:
+    """The most recently settled bill (bench.py's per-run columns)."""
+    reg = CTX.LEDGERS
+    return reg.last_settled() if reg is not None else None
+
+
+def _empty_bill(owner: str) -> Dict[str, Any]:
+    from spark_rapids_tpu.accounting.ledger import Bill
+
+    return Bill(owner).snapshot()
+
+
+def plan_signature_of(diag) -> str:
+    """The recorder's plan signature — ``path:name`` joined in plan
+    order, the same identity ``QueryProfile.plan_signature`` derives
+    from the event-log header (so offline tooling matches sentinel
+    baselines to history pages)."""
+    return "|".join(f"{p}:{diag.ops[p].name}"
+                    for p in diag._op_order if p != "")
+
+
+def record_bill(diag, conf) -> None:
+    """query_scope finish hook (after ``finish()`` closed the window,
+    before the sinks flush): join the query's ledger with the
+    recorder's counter deltas + progress background wall + federated
+    worker bytes, emit the ``resource_bill`` event and telemetry
+    gauges, then run the regression sentinel."""
+    try:
+        reg = CTX.LEDGERS
+        if reg is None:
+            return
+        from spark_rapids_tpu.lifecycle.context import current
+
+        ctx = current()
+        qid = ctx.query_id if ctx is not None else None
+        bill = reg.snapshot(qid) or _empty_bill(qid or UNOWNED)
+        sig = plan_signature_of(diag)
+        with diag._lock:
+            events = list(diag.events)
+        background_wall = 0
+        worker_bytes: Dict[str, int] = {}
+        for e in events:
+            ev = e.get("ev")
+            if ev == "progress":
+                background_wall = sum(
+                    int(d.get("wall_ns", 0))
+                    for d in (e.get("background") or {}).values())
+            elif ev == "worker_telemetry":
+                # last payload per worker wins — store occupancy is a
+                # level, not a delta
+                worker_bytes[str(e.get("worker_id", "?"))] = \
+                    int(e.get("bytes", 0))
+        if not worker_bytes:
+            worker_bytes = _federated_worker_bytes()
+        counters = {k: int(diag.total.get(k, 0))
+                    for k in BILL_COUNTER_KEYS}
+        parts = sorted(
+            bill.get("partitions", {}).items(),
+            key=lambda kv: kv[1].get("spill_bytes", 0)
+            + kv[1].get("restore_bytes", 0),
+            reverse=True)[:MAX_EVENT_PARTITIONS]
+        diag.record_resource_bill(
+            query_id=qid or diag.query_id, signature=sig,
+            wall_ns=diag.wall_ns,
+            device_peak_bytes=bill["device_peak_bytes"],
+            device_byte_seconds=bill["device_byte_seconds"],
+            device_bytes_charged=bill["device_bytes_charged"],
+            device_bytes_released=bill["device_bytes_released"],
+            residual_bytes=bill["residual_bytes"],
+            persistent_bytes=bill["persistent_bytes"],
+            spill=dict(bill["spill"]),
+            partitions={str(p): dict(d) for p, d in parts},
+            background_wall_ns=background_wall,
+            worker_bytes=worker_bytes,
+            counters=counters)
+        _record_gauges(bill)
+        _run_sentinel(diag, conf, qid or diag.query_id, sig, bill)
+    except Exception as e:   # accounting must never fail a query
+        print(f"spark_rapids_tpu.accounting: bill recording failed: {e}",
+              file=sys.stderr)
+
+
+def _record_gauges(bill: Dict[str, Any]) -> None:
+    """Per-query bill gauges on the always-on registry (ISSUE 7
+    surface): HBM pressure per query is visible beside latency/SLOs."""
+    from spark_rapids_tpu.telemetry import context as TEL
+
+    hub = TEL.HUB
+    if hub is None:
+        return
+    reg = hub.registry
+    spill = bill.get("spill") or {}
+    reg.record("bill_device_peak_bytes",
+               float(bill["device_peak_bytes"]))
+    reg.record("bill_device_byte_seconds",
+               float(bill["device_byte_seconds"]))
+    reg.record("bill_spilled_bytes",
+               float(spill.get("host_bytes", 0)
+                     + spill.get("disk_bytes", 0)))
+
+
+def _federated_worker_bytes() -> Dict[str, int]:
+    """Live federated store bytes when the query recorded no
+    worker_telemetry events (heartbeats landed between queries).  The
+    coordinator is peeked via sys.modules — the in-process path makes
+    zero calls into distributed modules (same rule as the worker-span
+    merge)."""
+    dist_mod = sys.modules.get("spark_rapids_tpu.distributed")
+    coord = getattr(dist_mod, "_coordinator", None) \
+        if dist_mod is not None else None
+    if coord is None:
+        return {}
+    try:
+        return coord.federated_store_bytes()
+    # tpulint: disable=cancel-swallow (observability isolation: a dead
+    # coordinator must not fail bill recording)
+    except Exception:
+        return {}
+
+
+def _run_sentinel(diag, conf, qid: str, sig: str,
+                  bill: Dict[str, Any]) -> None:
+    """Compare this query against its signature baseline; flag at most
+    ONE regression (counter + flight event + diagnostics event + a
+    post-mortem bundle carrying the bill, the violated baseline, and
+    the per-operator delta table), and fold clean ok-status
+    observations into the store."""
+    from spark_rapids_tpu.config import (
+        ACCOUNTING_SENTINEL_ENABLED,
+        ACCOUNTING_SENTINEL_MIN_SAMPLES,
+        ACCOUNTING_SENTINEL_MIN_WALL_EXCESS_MS,
+        ACCOUNTING_SENTINEL_WALL_RATIO,
+        ACCOUNTING_SENTINEL_Z,
+        PROFILE_DIR,
+        PROFILE_EWMA_ALPHA,
+    )
+
+    if not conf.get(ACCOUNTING_SENTINEL_ENABLED) or not sig:
+        return
+    prof_dir = conf.get(PROFILE_DIR)
+    if not prof_dir:
+        return   # baselines live in the calibration store (docs)
+    from spark_rapids_tpu.accounting.sentinel import (
+        evaluate,
+        op_self_walls,
+        regressed_operator,
+        signature_observation,
+    )
+    from spark_rapids_tpu.profiling.store import CalibrationStore
+
+    alpha = float(conf.get(PROFILE_EWMA_ALPHA))
+    store = CalibrationStore.load_cached(prof_dir, alpha=alpha)
+    baseline = store.signature(sig)
+    obs = signature_observation(diag, bill)
+    ops_obs = op_self_walls(diag)
+    finding = evaluate(
+        baseline, obs,
+        min_samples=int(conf.get(ACCOUNTING_SENTINEL_MIN_SAMPLES)),
+        wall_ratio=float(conf.get(ACCOUNTING_SENTINEL_WALL_RATIO)),
+        z_threshold=float(conf.get(ACCOUNTING_SENTINEL_Z)),
+        min_wall_excess_ns=float(conf.get(
+            ACCOUNTING_SENTINEL_MIN_WALL_EXCESS_MS)) * 1e6)
+    if finding is not None:
+        from spark_rapids_tpu import perfcounters as PC
+
+        # UNATTRIBUTED: the hook runs after its own recorder closed; a
+        # plain bump would land in a concurrent query's window
+        PC.bump_unattributed("perf_regressions_flagged")
+        op_path, op_name, table = regressed_operator(baseline, ops_obs)
+        detail = (f"{finding['dimension']}: observed "
+                  f"{finding['observed']:.0f} vs baseline "
+                  f"{finding['baseline']:.0f} "
+                  f"(ratio {finding['ratio']:.2f}, z {finding['z']:.1f});"
+                  f" worst operator {op_path}:{op_name}")
+        diag.record_regression(
+            query_id=qid, signature=sig,
+            dimension=finding["dimension"],
+            observed=finding["observed"], baseline=finding["baseline"],
+            ratio=finding["ratio"], z=finding["z"],
+            op_path=op_path, op_name=op_name, detail=detail)
+        from spark_rapids_tpu.telemetry import context as TEL
+
+        hub = TEL.HUB
+        if hub is not None:
+            hub.record_event("regression", query_id=qid, signature=sig,
+                             dimension=finding["dimension"],
+                             ratio=finding["ratio"])
+            hub.postmortem(
+                "perf_regression", query_id=qid, detail=detail,
+                extra={"bill": bill, "baseline": baseline,
+                       "op_deltas": table[:12]})
+        return
+    if diag.status != "ok":
+        return   # truncated queries must not poison the baselines
+    wstore = CalibrationStore(prof_dir, alpha=alpha)
+    wstore.observe_signature(sig, obs, ops_obs)
+    wstore.save()
+
+
+__all__ = [
+    "BILL_COUNTER_KEYS", "LedgerRegistry", "UNOWNED", "get_registry",
+    "last_bill", "maybe_configure", "plan_signature_of", "record_bill",
+    "shutdown",
+]

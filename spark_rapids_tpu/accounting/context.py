@@ -1,0 +1,26 @@
+"""The ambient accounting slots (ISSUE 18).
+
+Hot paths import ONLY this module: the charge sites in memory/spill.py
+and the partition stamps in shuffle/partition_queues.py read one module
+attribute (``LEDGERS``) per event — with accounting disabled the slot is
+None and they make ZERO calls into the accounting package
+(tests/test_accounting.py pins it with cProfile, the same methodology as
+the diagnostics / telemetry / progress disabled-path pins).
+
+``PARTITION`` is the draining-partition stamp (ISSUE 18 satellite): the
+spill-backed exchange sets it around per-partition appends and drains so
+spill/restore traffic a partition DRIVES is attributable to that
+partition in the owning query's bill, localizing out-of-core pressure.
+"""
+from __future__ import annotations
+
+import contextvars
+from typing import Optional
+
+# the process LedgerRegistry while accounting is enabled, else None —
+# the one ambient check every charge site makes
+LEDGERS = None  # type: Optional["object"]
+
+# reduce-partition id currently driving spill/restore traffic (-1: none)
+PARTITION: contextvars.ContextVar[int] = contextvars.ContextVar(
+    "srt_acct_partition", default=-1)

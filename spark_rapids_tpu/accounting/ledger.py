@@ -1,0 +1,259 @@
+"""Per-query resource bills (ISSUE 18 tentpole).
+
+Every HBM registration / spill / release in ``memory/spill.py`` charges
+the owning query's ledger: device bytes charged/released, the per-query
+device high-water mark, device-byte-seconds (the integral of tracked
+device residency over time — the number a per-tenant quota would
+meter), and spill traffic per tier (device->host, host->disk, and
+restore traffic back up).  ``accounting.record_bill`` joins the ledger
+with the diagnostics recorder's per-query counter deltas at collect end;
+``settle`` retires the bill at lifecycle exit after query cleanup closed
+the query's leftover handles.
+
+Invariant discipline (the PR 3 attribution pin, applied to bytes): every
+charge site bumps a global ``acct_*`` perf counter AND the owning bill
+by the same amount, so the sum of per-bill values across live + settled
+bills equals the global counter ``since()`` deltas exactly
+(tests/test_accounting.py pins it).  Charges with no lifecycle context
+land in the ``(unowned)`` bucket so the sums still balance.
+
+Lock discipline: charge sites call in under the spill framework's lock;
+``_lock`` here is a LEAF (nothing is called while holding it except
+dict/arithmetic), and the paired perf-counter bumps happen outside it
+(order: fw._lock -> ledger._lock, fw._lock -> PC._LOCK — no cycles).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+from spark_rapids_tpu import perfcounters as PC
+from spark_rapids_tpu.accounting import context as CTX
+
+UNOWNED = "(unowned)"
+
+# tier name -> the paired global counter (the exact-sum invariant's
+# other half; keys must exist in perfcounters.COUNTERS)
+_TIER_COUNTER = {
+    "host": "acct_spill_bytes_host",
+    "disk": "acct_spill_bytes_disk",
+    "restore": "acct_bytes_restored",
+}
+
+
+class Bill:
+    """One query's accumulating resource bill."""
+
+    __slots__ = ("owner", "charged", "released", "now", "peak",
+                 "persistent_now", "byte_seconds", "spill", "partitions",
+                 "started_t_ns", "last_t_ns", "settled", "residual")
+
+    def __init__(self, owner: str):
+        self.owner = owner
+        self.charged = 0          # device bytes ever charged
+        self.released = 0         # device bytes ever released
+        self.now = 0              # device bytes currently held
+        self.peak = 0             # per-query device high-water mark
+        self.persistent_now = 0   # the df.cache() share of `now`
+        self.byte_seconds = 0.0   # integral of `now` over wall time
+        self.spill: Dict[str, int] = {
+            "host_bytes": 0, "host_count": 0,
+            "disk_bytes": 0, "disk_count": 0,
+            "restore_bytes": 0, "restore_count": 0,
+        }
+        # pid -> {"spill_bytes", "restore_bytes"} — the draining
+        # partition that DROVE the traffic (ISSUE 18 satellite)
+        self.partitions: Dict[int, Dict[str, int]] = {}
+        self.started_t_ns = time.monotonic_ns()
+        self.last_t_ns = self.started_t_ns
+        self.settled = False
+        self.residual = 0
+
+    def _integrate_locked(self) -> None:
+        t = time.monotonic_ns()
+        if self.now > 0 and t > self.last_t_ns:
+            self.byte_seconds += self.now * (t - self.last_t_ns) / 1e9
+        self.last_t_ns = t
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "owner": self.owner,
+            "device_bytes_charged": self.charged,
+            "device_bytes_released": self.released,
+            "device_bytes_now": self.now,
+            "device_peak_bytes": self.peak,
+            "persistent_bytes": self.persistent_now,
+            "residual_bytes": self.now - self.persistent_now,
+            "device_byte_seconds": round(self.byte_seconds, 6),
+            "spill": dict(self.spill),
+            "partitions": {p: dict(d)
+                           for p, d in self.partitions.items()},
+        }
+
+
+class LedgerRegistry:
+    """The process-global bill table: live bills keyed by lifecycle
+    query id, plus a bounded ring of settled bills."""
+
+    def __init__(self, retained_bills: int = 64):
+        self._lock = threading.Lock()
+        self._bills: Dict[str, Bill] = {}
+        self._finished: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self._retained = max(int(retained_bills), 1)
+        # settled bills whose residual was nonzero (charged bytes never
+        # released, persistent excluded) — the conftest leak gate reads
+        # and clears these, mirroring the spillable leak gate
+        self._residuals: Dict[str, int] = {}
+
+    # -- charge API (memory/spill.py charge sites) ----------------------
+    def _bill_locked(self, qid: Optional[str]) -> Bill:
+        key = qid if qid is not None else UNOWNED
+        b = self._bills.get(key)
+        if b is None:
+            # a settled query's stragglers must not resurrect a live
+            # bill — fold them into the unowned bucket so the global
+            # sums still balance
+            if key in self._finished:
+                b = self._bills.get(UNOWNED)
+                if b is None:
+                    b = self._bills[UNOWNED] = Bill(UNOWNED)
+                return b
+            b = self._bills[key] = Bill(key)
+        return b
+
+    def charge_device(self, qid: Optional[str], nbytes: int,
+                      persistent: bool = False) -> None:
+        n = int(nbytes)
+        with self._lock:
+            b = self._bill_locked(qid)
+            b._integrate_locked()
+            b.charged += n
+            b.now += n
+            if b.now > b.peak:
+                b.peak = b.now
+            if persistent:
+                b.persistent_now += n
+        PC.bump("acct_device_bytes_charged", n)
+
+    def release_device(self, qid: Optional[str], nbytes: int,
+                       persistent: bool = False) -> None:
+        n = int(nbytes)
+        with self._lock:
+            key = qid if qid is not None else UNOWNED
+            fin = self._finished.get(key) \
+                if key not in self._bills else None
+            if fin is not None:
+                # late release for an already-settled bill (a persistent
+                # cache handle closed after its query): keep the settled
+                # record — and the residual gate — truthful
+                fin["device_bytes_released"] += n
+                fin["device_bytes_now"] -= n
+                if persistent:
+                    fin["persistent_bytes"] -= n
+                fin["residual_bytes"] = fin["device_bytes_now"] \
+                    - fin["persistent_bytes"]
+                if key in self._residuals:
+                    if fin["residual_bytes"]:
+                        self._residuals[key] = fin["residual_bytes"]
+                    else:
+                        del self._residuals[key]
+            else:
+                b = self._bill_locked(qid)
+                b._integrate_locked()
+                b.released += n
+                b.now -= n
+                if persistent:
+                    b.persistent_now -= n
+        PC.bump("acct_device_bytes_released", n)
+
+    def charge_spill(self, qid: Optional[str], tier: str,
+                     nbytes: int) -> None:
+        """One spill/restore movement: ``tier`` is ``host``
+        (device->host), ``disk`` (host->disk), or ``restore``
+        (back up-tier).  Tagged with the draining partition id when the
+        exchange drain set the ``PARTITION`` stamp."""
+        n = int(nbytes)
+        pid = CTX.PARTITION.get()
+        with self._lock:
+            b = self._bill_locked(qid)
+            b.spill[f"{tier}_bytes"] += n
+            b.spill[f"{tier}_count"] += 1
+            if pid >= 0:
+                part = b.partitions.get(pid)
+                if part is None:
+                    part = b.partitions[pid] = {"spill_bytes": 0,
+                                                "restore_bytes": 0}
+                part["restore_bytes" if tier == "restore"
+                     else "spill_bytes"] += n
+        PC.bump(_TIER_COUNTER[tier], n)
+
+    # -- read/lifecycle surfaces ----------------------------------------
+    def snapshot(self, qid: Optional[str]) -> Optional[Dict[str, Any]]:
+        """The query's live bill as a dict (byte-seconds integrated up
+        to now), or its settled record, or None."""
+        key = qid if qid is not None else UNOWNED
+        with self._lock:
+            b = self._bills.get(key)
+            if b is not None:
+                b._integrate_locked()
+                return b.snapshot()
+            fin = self._finished.get(key)
+            return dict(fin) if fin is not None else None
+
+    def snapshot_all(self) -> List[Dict[str, Any]]:
+        """Every live AND settled bill (the invariant-sum surface)."""
+        with self._lock:
+            out = []
+            for b in self._bills.values():
+                b._integrate_locked()
+                out.append(b.snapshot())
+            out.extend(dict(f) for f in self._finished.values())
+            return out
+
+    def settle(self, qid: str) -> Optional[Dict[str, Any]]:
+        """Retire the query's bill at lifecycle exit (after
+        ``close_owned_by`` swept its leftover handles).  A nonzero
+        residual — charged device bytes never released, persistent
+        df.cache() handles excluded — is recorded for the leak gate."""
+        with self._lock:
+            b = self._bills.pop(qid, None)
+            if b is None:
+                return None
+            b._integrate_locked()
+            b.settled = True
+            b.residual = b.now - b.persistent_now
+            snap = b.snapshot()
+            snap["settled"] = True
+            self._finished[qid] = snap
+            while len(self._finished) > self._retained:
+                old_qid, old = self._finished.popitem(last=False)
+                # an evicted bill keeps its residual visible: bounded
+                # retention must not silently forgive a leak
+                if old["residual_bytes"]:
+                    self._residuals.setdefault(old_qid,
+                                               old["residual_bytes"])
+            if b.residual:
+                self._residuals[qid] = b.residual
+        PC.bump_unattributed("bills_settled")
+        return snap
+
+    def last_settled(self) -> Optional[Dict[str, Any]]:
+        """The most recently settled bill (bench.py's per-run columns)."""
+        with self._lock:
+            if not self._finished:
+                return None
+            return dict(next(reversed(self._finished.values())))
+
+    # -- leak gate (lifecycle.leak_report_all / conftest) ---------------
+    def leak_report(self) -> List[str]:
+        with self._lock:
+            return [f"LEAK: resource bill {qid} residual {res}B "
+                    "(charged device bytes never released; persistent "
+                    "handles excluded)"
+                    for qid, res in self._residuals.items()]
+
+    def reset_residuals(self) -> None:
+        with self._lock:
+            self._residuals.clear()

@@ -1,0 +1,148 @@
+"""The live regression sentinel (ISSUE 18 tentpole, part 2).
+
+Production serving traffic is dominated by REPEATED plan signatures
+(Presto+GPU, arXiv:2606.24647), so per-signature baselines make
+slowdowns machine-detectable: at each collect exit the sentinel compares
+the query's bill + wall against the calibration store's per-plan-
+signature EWMAs (wall, host syncs, spill bytes, compile-cache hit rate)
+and flags excursions past the conf'd ratio/z thresholds — a live fleet
+notices its own slowdowns without a human running
+``profile_report --diff``.
+
+Discipline against false positives and baseline poisoning:
+
+* a dimension flags only when BOTH the ratio gate and an absolute
+  excess floor trip (wall additionally requires the z-score gate, with
+  the deviation EWMA floored at 5% of the mean so a near-constant
+  baseline cannot make trivial jitter look like many sigmas);
+* at most ONE regression is flagged per query — the worst dimension;
+* a FLAGGED observation is NOT folded into the baseline (folding the
+  regression would teach the store the slowdown is normal), and only
+  ``status == "ok"`` queries fold at all (same rule as the PR 8
+  operator calibration).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+# absolute excess floors: below these a ratio excursion is noise, not a
+# regression (a 2-sync query tripling to 6 syncs is not an incident)
+SYNC_EXCESS_FLOOR = 16
+SPILL_EXCESS_FLOOR = 1 << 20          # 1 MiB
+CACHE_HIT_DROP_FLOOR = 0.5            # absolute hit-rate drop
+# the deviation-EWMA floor as a fraction of the mean (the z denominator
+# can never collapse below 5% of the baseline wall)
+WALL_STD_FLOOR_FRAC = 0.05
+
+# the EWMA'd per-signature dimensions (stored under the calibration
+# store's "signatures" section)
+SIGNATURE_KEYS = ("wall_ns", "host_syncs", "spill_bytes",
+                  "cache_hit_rate")
+
+
+def signature_observation(diag, bill: Dict[str, Any]) -> Dict[str, Any]:
+    """One query's sentinel-dimension observation, harvested from the
+    finished recorder's global-delta window and its resource bill."""
+    total = diag.total or {}
+    hits = int(total.get("compile_cache_hits", 0))
+    misses = int(total.get("compile_cache_misses", 0))
+    spill = bill.get("spill") or {}
+    return {
+        "wall_ns": float(diag.wall_ns),
+        "host_syncs": float(total.get("host_syncs", 0)),
+        "spill_bytes": float(spill.get("host_bytes", 0)
+                             + spill.get("disk_bytes", 0)),
+        "cache_hit_rate": (hits / (hits + misses)
+                           if (hits + misses) else 1.0),
+    }
+
+
+def op_self_walls(diag) -> Dict[str, int]:
+    """Per-operator self-wall observation keyed ``path:name`` — the
+    delta table a flagged regression's post-mortem names the regressed
+    operator from."""
+    out: Dict[str, int] = {}
+    child_wall: Dict[str, int] = {}
+    for path, st in diag.ops.items():
+        dot = path.rfind(".")
+        if dot > 0:
+            parent = path[:dot]
+            child_wall[parent] = child_wall.get(parent, 0) + st.wall_ns
+    for path, st in diag.ops.items():
+        if path == "":
+            continue
+        out[f"{path}:{st.name}"] = max(
+            st.wall_ns - child_wall.get(path, 0), 0)
+    return out
+
+
+def evaluate(baseline: Optional[Dict[str, Any]],
+             obs: Dict[str, Any],
+             min_samples: int,
+             wall_ratio: float,
+             z_threshold: float,
+             min_wall_excess_ns: float) -> Optional[Dict[str, Any]]:
+    """Compare one observation against its signature baseline; the
+    worst offending dimension as a finding dict, or None.  Pure
+    function — tests drive the thresholds directly."""
+    if baseline is None or int(baseline.get("n", 0)) < int(min_samples):
+        return None
+    ew = baseline.get("ewma") or {}
+    findings: List[Tuple[float, Dict[str, Any]]] = []
+
+    mean = float(ew.get("wall_ns", 0.0))
+    w = float(obs.get("wall_ns", 0.0))
+    if mean > 0 and w > mean * wall_ratio \
+            and (w - mean) >= float(min_wall_excess_ns):
+        std = max(float(baseline.get("wall_dev_ns", 0.0)),
+                  mean * WALL_STD_FLOOR_FRAC, 1.0)
+        z = (w - mean) / std
+        if z >= z_threshold:
+            findings.append((w / mean, {
+                "dimension": "wall_ns", "observed": w,
+                "baseline": mean, "ratio": w / mean, "z": z}))
+
+    for dim, floor in (("host_syncs", SYNC_EXCESS_FLOOR),
+                       ("spill_bytes", SPILL_EXCESS_FLOOR)):
+        mean = float(ew.get(dim, 0.0))
+        v = float(obs.get(dim, 0.0))
+        if v > mean * wall_ratio and (v - mean) >= floor:
+            ratio = v / mean if mean > 0 else float("inf")
+            findings.append((min(ratio, 1e9), {
+                "dimension": dim, "observed": v, "baseline": mean,
+                "ratio": round(min(ratio, 1e9), 3), "z": 0.0}))
+
+    mean = float(ew.get("cache_hit_rate", 1.0))
+    v = float(obs.get("cache_hit_rate", 1.0))
+    if (mean - v) >= CACHE_HIT_DROP_FLOOR:
+        findings.append((1.0 + (mean - v), {
+            "dimension": "cache_hit_rate", "observed": v,
+            "baseline": mean, "ratio": round(mean - v, 3), "z": 0.0}))
+
+    if not findings:
+        return None
+    findings.sort(key=lambda f: f[0], reverse=True)
+    return findings[0][1]
+
+
+def regressed_operator(baseline: Optional[Dict[str, Any]],
+                       ops_obs: Dict[str, int]
+                       ) -> Tuple[str, str, List[Dict[str, Any]]]:
+    """(op_path, op_name, per-operator delta table) — the operator whose
+    self-wall grew most over its baseline EWMA, the post-mortem's
+    primary suspect.  With no baseline ops the largest observed
+    self-wall stands in."""
+    base_ops = (baseline or {}).get("ops") or {}
+    table: List[Dict[str, Any]] = []
+    for key, wall in ops_obs.items():
+        base = float(base_ops.get(key, 0.0))
+        path, _, name = key.partition(":")
+        table.append({"path": path, "name": name,
+                      "self_wall_ns": int(wall),
+                      "baseline_self_wall_ns": int(base),
+                      "delta_ns": int(wall - base)})
+    table.sort(key=lambda r: r["delta_ns"], reverse=True)
+    if not table:
+        return "", "", table
+    top = table[0]
+    return top["path"], top["name"], table

@@ -586,6 +586,67 @@ def doc_drift_problems(repo_root: str) -> List[str]:
             problems.append(
                 f"docs/{name} does not cross-link "
                 f"docs/whole_plan_fusion.md")
+
+    # per-query resource accounting + regression sentinel (ISSUE 18):
+    # confs + counters + the bill gauges + the resource_bill/regression
+    # events + the bill/sentinel surface vocabulary must be documented
+    # in docs/accounting.md (confs in configs.md, counters ALSO in
+    # diagnostics.md via the global check), and the observability docs
+    # the layer rides on must cross-link it
+    acct_md = read("accounting.md")
+    acct_confs = [k for k in _REGISTRY
+                  if k.startswith("spark.rapids.tpu.accounting.")]
+    if not acct_confs:
+        problems.append("no spark.rapids.tpu.accounting.* confs "
+                        "registered")
+    for key in sorted(acct_confs):
+        if f"`{key}`" not in acct_md:
+            problems.append(
+                f"conf '{key}' is not documented in docs/accounting.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+    for key in ("acct_device_bytes_charged", "acct_device_bytes_released",
+                "acct_spill_bytes_host", "acct_spill_bytes_disk",
+                "acct_bytes_restored", "bills_settled",
+                "perf_regressions_flagged"):
+        if key not in PC.COUNTERS:
+            problems.append(f"accounting counter '{key}' is not "
+                            f"registered in perfcounters.COUNTERS")
+        if f"`{key}`" not in acct_md:
+            problems.append(
+                f"accounting counter '{key}' is not documented in "
+                f"docs/accounting.md")
+    for ev in ("resource_bill", "regression"):
+        if ev not in EVENT_SCHEMA:
+            problems.append(f"diagnostics event type '{ev}' is not "
+                            f"registered in EVENT_SCHEMA")
+        if f"`{ev}`" not in acct_md:
+            problems.append(
+                f"accounting event '{ev}' is not documented in "
+                f"docs/accounting.md")
+    for gauge in ("bill_device_peak_bytes", "bill_device_byte_seconds",
+                  "bill_spilled_bytes"):
+        if f"`{gauge}`" not in acct_md:
+            problems.append(
+                f"accounting bill gauge '{gauge}' is not documented "
+                f"in docs/accounting.md")
+    for word in ("device-byte-seconds", "`(unowned)`", "`--bills`",
+                 "`residual_bytes`", "`perf_regression`",
+                 "`devicePeakBytes`", "`deviceByteSeconds`",
+                 "`spilledBytes`", "accountingOverhead", "bench_gate",
+                 "history.py", "`df.cache()`", "plan-signature"):
+        if word not in acct_md:
+            problems.append(
+                f"accounting surface vocabulary {word} is not "
+                f"documented in docs/accounting.md")
+    for name, md in (("observability.md", obs_md),
+                     ("profiling.md", read("profiling.md")),
+                     ("overload.md", ovl_md)):
+        if "accounting.md" not in md:
+            problems.append(
+                f"docs/{name} does not cross-link docs/accounting.md")
     return problems
 
 

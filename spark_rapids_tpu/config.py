@@ -1168,6 +1168,71 @@ PROGRESS_MAX_FINISHED = conf("spark.rapids.tpu.progress.maxFinished").doc(
     "/progress surface (oldest evicted first); live queries are always "
     "reported regardless.").integer_conf(32)
 
+# --- accounting (accounting/ — per-query resource bills + sentinel) --------
+
+ACCOUNTING_ENABLED = conf("spark.rapids.tpu.accounting.enabled").doc(
+    "Per-query resource bills: every HBM registration/spill/release in "
+    "the spill framework charges the owning query's ledger (device "
+    "bytes charged/released, per-query peak, device-byte-seconds, "
+    "spill traffic per tier with the draining exchange partition "
+    "stamped), joined at collect end with the query's counter deltas "
+    "(H2D/D2H bytes, launches, syncs, compile wall), progress "
+    "background wall, and federated worker store bytes — emitted as a "
+    "resource_bill diagnostics event plus bill_* telemetry gauges, and "
+    "settled at lifecycle exit (a nonzero residual is a leak the test "
+    "gate fails on).  Disabled (default): every charge site costs one "
+    "ambient attribute check — zero calls into accounting modules "
+    "(docs/accounting.md)."
+).boolean_conf(False)
+
+ACCOUNTING_RETAINED_BILLS = conf(
+    "spark.rapids.tpu.accounting.retainedBills").doc(
+    "Settled bills the ledger registry retains (oldest evicted first) "
+    "for tools/history.py pages and bench.py columns.  An evicted "
+    "bill's nonzero residual stays visible to the leak gate."
+).integer_conf(64)
+
+ACCOUNTING_SENTINEL_ENABLED = conf(
+    "spark.rapids.tpu.accounting.sentinel.enabled").doc(
+    "With accounting.enabled AND profile.dir set, compare each "
+    "finished query's bill + wall against the calibration store's "
+    "per-plan-signature EWMAs (wall, host syncs, spill bytes, "
+    "compile-cache hit rate) at collect exit.  An excursion past the "
+    "ratio/z thresholds bumps perf_regressions_flagged, emits a "
+    "regression diagnostics event + flight-ring event, and dumps a "
+    "post-mortem bundle carrying the offending bill, the violated "
+    "baseline, and the per-operator self-wall delta table naming the "
+    "regressed operator.  Flagged observations are NOT folded into "
+    "the baseline; only clean status=ok queries calibrate."
+).boolean_conf(True)
+
+ACCOUNTING_SENTINEL_MIN_SAMPLES = conf(
+    "spark.rapids.tpu.accounting.sentinel.minSamples").doc(
+    "Observations a plan signature's baseline needs before the "
+    "sentinel evaluates it — younger baselines only accumulate."
+).integer_conf(3)
+
+ACCOUNTING_SENTINEL_WALL_RATIO = conf(
+    "spark.rapids.tpu.accounting.sentinel.wallRatio").doc(
+    "Multiplicative excursion gate: a dimension must exceed its "
+    "baseline EWMA by this factor to flag (wall additionally requires "
+    "the z gate; syncs/spill additionally require absolute excess "
+    "floors so tiny baselines cannot alarm on noise)."
+).double_conf(2.0)
+
+ACCOUNTING_SENTINEL_Z = conf("spark.rapids.tpu.accounting.sentinel.z").doc(
+    "Z-score gate for the wall dimension: (observed - baseline) / "
+    "deviation-EWMA must reach this many sigmas (deviation floored at "
+    "5% of the baseline mean so near-constant history cannot make "
+    "jitter look significant)."
+).double_conf(4.0)
+
+ACCOUNTING_SENTINEL_MIN_WALL_EXCESS_MS = conf(
+    "spark.rapids.tpu.accounting.sentinel.minWallExcessMs").doc(
+    "Absolute wall excess floor in ms: below this a ratio/z excursion "
+    "on a sub-millisecond baseline is noise, not a regression."
+).double_conf(5.0)
+
 MEM_DEBUG = conf("spark.rapids.memory.gpu.debug").doc(
     "Log arena allocations.").boolean_conf(False)
 

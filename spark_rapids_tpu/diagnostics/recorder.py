@@ -68,6 +68,15 @@ EVENT_SCHEMA: Dict[str, List[str]] = {
                  "fallback"],
     "cost_model": ["hits", "misses", "predicted_wall_ns",
                    "actual_wall_ns", "matched_actual_wall_ns"],
+    "resource_bill": ["query_id", "signature", "wall_ns",
+                      "device_peak_bytes", "device_byte_seconds",
+                      "device_bytes_charged", "device_bytes_released",
+                      "residual_bytes", "persistent_bytes", "spill",
+                      "partitions", "background_wall_ns", "worker_bytes",
+                      "counters"],
+    "regression": ["query_id", "signature", "dimension", "observed",
+                   "baseline", "ratio", "z", "op_path", "op_name",
+                   "detail"],
     "query_end": ["wall_ns", "status", "counters"],
 }
 
@@ -609,6 +618,33 @@ class QueryDiagnostics:
             else:
                 self.events.append(e)
             self.n_events = len(self.events)
+
+    def _append_post_finish(self, e: Dict[str, Any]) -> None:
+        """Insert a finish-hook event BEFORE the trailing query_end
+        (same pattern as record_cost_model: the hooks run after
+        ``finish()`` closed the window, before the sinks flush)."""
+        with self._lock:
+            if self.events and self.events[-1].get("ev") == "query_end":
+                self.events.insert(len(self.events) - 1, e)
+            else:
+                self.events.append(e)
+            self.n_events = len(self.events)
+
+    def record_resource_bill(self, **fields: Any) -> None:
+        """The per-query resource bill (ISSUE 18): the ledger joined
+        with the window's counter deltas, progress background wall, and
+        federated worker bytes — appended by the accounting finish
+        hook."""
+        self._append_post_finish(
+            {"ev": "resource_bill", "ts_ns": self.wall_ns, "op": "",
+             **fields})
+
+    def record_regression(self, **fields: Any) -> None:
+        """A sentinel-flagged excursion past this plan signature's
+        baseline (ISSUE 18) — at most one per query, worst dimension."""
+        self._append_post_finish(
+            {"ev": "regression", "ts_ns": self.wall_ns, "op": "",
+             **fields})
 
     def header(self) -> Dict[str, Any]:
         return {

@@ -314,6 +314,85 @@ def render_workers(summary: Dict[str, Any]) -> str:
     return "\n".join(out)
 
 
+def bills_summary(profiles: List[QueryProfile]) -> Dict[str, Any]:
+    """Aggregate ``resource_bill`` + ``regression`` events (ISSUE 18):
+    queries ranked by device-byte-seconds (the per-tenant quota number)
+    and spill traffic, with any sentinel verdicts attached — fed by
+    ``tools/profile_report.py --bills``."""
+    bills: List[Dict[str, Any]] = []
+    regressions: List[Dict[str, Any]] = []
+    for qp in profiles:
+        reg = None
+        for e in qp.events:
+            if e.get("ev") == "regression":
+                reg = e
+                regressions.append({
+                    "query": e.get("query_id") or qp.query_id,
+                    "dimension": e.get("dimension", ""),
+                    "ratio": float(e.get("ratio", 0) or 0),
+                    "op": f"{e.get('op_path', '')}:{e.get('op_name', '')}",
+                    "detail": e.get("detail", "")})
+        for e in qp.events:
+            if e.get("ev") != "resource_bill":
+                continue
+            sp = e.get("spill") or {}
+            bills.append({
+                "query": e.get("query_id") or qp.query_id,
+                "signature": e.get("signature", ""),
+                "wall_ns": int(e.get("wall_ns", 0) or 0),
+                "device_peak_bytes":
+                    int(e.get("device_peak_bytes", 0) or 0),
+                "device_byte_seconds":
+                    float(e.get("device_byte_seconds", 0) or 0),
+                "spilled_bytes": int(sp.get("host_bytes", 0) or 0)
+                + int(sp.get("disk_bytes", 0) or 0),
+                "restored_bytes": int(sp.get("restore_bytes", 0) or 0),
+                "residual_bytes": int(e.get("residual_bytes", 0) or 0),
+                "partitions": e.get("partitions") or {},
+                "regression": (reg.get("dimension") if reg is not None
+                               else None)})
+    bills.sort(key=lambda b: b["device_byte_seconds"], reverse=True)
+    return {"bills": bills,
+            "queries_with_bills": len(bills),
+            "total_device_byte_seconds": round(
+                sum(b["device_byte_seconds"] for b in bills), 6),
+            "total_spilled_bytes":
+                sum(b["spilled_bytes"] for b in bills),
+            "regressions": regressions}
+
+
+def render_bills(summary: Dict[str, Any]) -> str:
+    n = summary["queries_with_bills"]
+    out = [f"== resource bills: {n} quer{'y' if n == 1 else 'ies'}, "
+           f"{summary['total_device_byte_seconds']:.1f} device-byte-"
+           f"seconds, {_fmt_bytes(summary['total_spilled_bytes'])} "
+           f"spilled =="]
+    for b in summary["bills"]:
+        flag = f"  REGRESSED[{b['regression']}]" if b["regression"] \
+            else ""
+        out.append(
+            f"  {b['query']:<24} {b['device_byte_seconds']:12.1f} B*s  "
+            f"peak {_fmt_bytes(b['device_peak_bytes']):>10}  "
+            f"spilled {_fmt_bytes(b['spilled_bytes']):>10}  "
+            f"wall {b['wall_ns'] / 1e6:8.1f}ms{flag}")
+        if b["partitions"]:
+            hot = sorted(
+                b["partitions"].items(),
+                key=lambda kv: kv[1].get("spill_bytes", 0)
+                + kv[1].get("restore_bytes", 0), reverse=True)[:4]
+            parts = ", ".join(
+                f"p{pid}={_fmt_bytes(d.get('spill_bytes', 0) + d.get('restore_bytes', 0))}"
+                for pid, d in hot)
+            out.append(f"    hot partitions: {parts}")
+        if b["residual_bytes"]:
+            out.append(f"    RESIDUAL {_fmt_bytes(b['residual_bytes'])}"
+                       f" charged but never released")
+    for r in summary["regressions"]:
+        out.append(f"  regression: {r['query']} {r['dimension']} "
+                   f"x{r['ratio']:.2f} worst op {r['op']}")
+    return "\n".join(out)
+
+
 def diff_profiles(base: List[QueryProfile],
                   new: List[QueryProfile]) -> List[Dict[str, Any]]:
     """Per-query regression diff: match queries by plan signature (falls

@@ -959,6 +959,14 @@ class Coordinator:
                                   "clock_offset_s": w.clock_offset_s}
                     for w in self._workers.values() if w.counters}
 
+    def federated_store_bytes(self) -> Dict[str, int]:
+        """Last-heartbeat store bytes per worker (peek-only) — the
+        resource bill's worker-side bytes when a query's window caught
+        no worker_telemetry events (ISSUE 18)."""
+        with self._lock:
+            return {w.worker_id: int(w.store_stats.get("bytes", 0))
+                    for w in self._workers.values() if w.store_stats}
+
     def postmortem_worker(self, wid: str, detail: str = "") -> Optional[Dict]:
         """On-demand merged post-mortem (the DUMP-op twin of the
         worker-loss bundle): pull the worker's ring + counters and dump

@@ -238,6 +238,14 @@ def _cleanup_query(ctx: QueryContext) -> None:
     mgr = _shuffle.peek_shuffle_manager()
     if mgr is not None:
         mgr.unregister_owned(ctx.query_id)
+    # 4. settle the query's resource bill (ISSUE 18) — AFTER
+    #    close_owned_by swept leftover handles, so their releases land
+    #    on the bill and a nonzero residual means truly-unreleased
+    #    charged bytes (persistent df.cache handles excluded)
+    from spark_rapids_tpu.accounting import context as _acct
+
+    if _acct.LEDGERS is not None:
+        _acct.LEDGERS.settle(ctx.query_id)
 
 
 # ---------------------------------------------------------------------------
@@ -284,6 +292,13 @@ def leak_report_all() -> List[str]:
     from spark_rapids_tpu.lifecycle import journal as _journal
 
     out.extend(_journal.journal_leak_report())
+    # 6. resource-bill residuals (ISSUE 18): a settled bill whose
+    #    charged device bytes were never released (persistent handles
+    #    excluded) is the accounting-side view of a handle leak
+    from spark_rapids_tpu.accounting import context as _acct
+
+    if _acct.LEDGERS is not None:
+        out.extend(_acct.LEDGERS.leak_report())
     return out
 
 
@@ -326,6 +341,10 @@ def reset_leaked_state() -> None:
         # tests; no query is running when this sweeps)
         except Exception:
             pass
+    from spark_rapids_tpu.accounting import context as _acct
+
+    if _acct.LEDGERS is not None:
+        _acct.LEDGERS.reset_residuals()
     # journal + checkpoint artifacts (ISSUE 16): purge every recovery
     # root this process touched so one leaky test's WAL cannot seed a
     # bogus "resumable" classification in the next test's replay

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from spark_rapids_tpu import types as T
+from spark_rapids_tpu.accounting import context as _ACCT
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar.column import DeviceColumn
 from spark_rapids_tpu.config import (
@@ -174,6 +175,13 @@ class SpillableColumnarBatch:
         fw = self._framework
         fw._device_used += self.device_bytes
         fw._device_used_peak = max(fw._device_used_peak, fw._device_used)
+        if _ACCT.LEDGERS is not None:
+            # restore re-charges device residency AND bills the up-tier
+            # traffic (ISSUE 18)
+            _ACCT.LEDGERS.charge_device(self.owner_qid, self.device_bytes,
+                                        self.persistent)
+            _ACCT.LEDGERS.charge_spill(self.owner_qid, "restore",
+                                       self.device_bytes)
 
     def host_bytes(self) -> int:
         if self._host is None:
@@ -269,6 +277,9 @@ class SpillFramework:
             self._device_used += h.device_bytes
             self._device_used_peak = max(self._device_used_peak,
                                          self._device_used)
+            if _ACCT.LEDGERS is not None:
+                _ACCT.LEDGERS.charge_device(h.owner_qid, h.device_bytes,
+                                            h.persistent)
             if self.debug:
                 # handle-leak tracking (the cuDF refcount-debug analog,
                 # SURVEY.md §5.2): remember where each live handle came
@@ -328,6 +339,9 @@ class SpillFramework:
     def _unregister_locked(self, h: SpillableColumnarBatch) -> None:
         if h.state == STATE_DEVICE:
             self._device_used -= h.device_bytes
+            if _ACCT.LEDGERS is not None:
+                _ACCT.LEDGERS.release_device(h.owner_qid, h.device_bytes,
+                                             h.persistent)
         if h in self._handles:
             self._handles.remove(h)
 
@@ -374,6 +388,13 @@ class SpillFramework:
                 self._device_used -= freed
                 self.spill_to_host_count += 1
                 self.spill_to_host_bytes += freed
+                if _ACCT.LEDGERS is not None:
+                    # the bill releases device residency AND records the
+                    # down-tier traffic against the handle's OWNER (who
+                    # held the memory), not whoever triggered pressure
+                    _ACCT.LEDGERS.release_device(v.owner_qid, freed,
+                                                 v.persistent)
+                    _ACCT.LEDGERS.charge_spill(v.owner_qid, "host", freed)
                 if self.debug:
                     print(f"[spill] device->host {freed >> 10}KiB "
                           f"rows={v.num_rows} used={self._device_used >> 20}MiB")
@@ -394,6 +415,8 @@ class SpillFramework:
             host_used -= n
             self.spill_to_disk_count += 1
             self.spill_to_disk_bytes += n
+            if _ACCT.LEDGERS is not None:
+                _ACCT.LEDGERS.charge_spill(h.owner_qid, "disk", n)
 
     def spill_device_pressure(self) -> int:
         """Spill everything unpinned (the RetryOOM 'roll back' release)."""
@@ -407,6 +430,10 @@ class SpillFramework:
                 self.spill_to_host_count += 1
                 self.spill_to_host_bytes += freed
                 spilled += freed
+                if _ACCT.LEDGERS is not None:
+                    _ACCT.LEDGERS.release_device(h.owner_qid, freed,
+                                                 h.persistent)
+                    _ACCT.LEDGERS.charge_spill(h.owner_qid, "host", freed)
             self._host_pressure_locked()
         return spilled
 

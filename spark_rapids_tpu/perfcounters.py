@@ -177,6 +177,19 @@ COUNTERS: Dict[str, int] = {
     "queries_resumed": 0,
     "journal_recovery_discards": 0,
     "recovery_leases_expired": 0,
+    # per-query resource accounting (ISSUE 18, accounting/): the global
+    # halves of the bill exact-sum invariant — every spill-framework
+    # charge site bumps the acct_* counter AND the owning query's bill
+    # by the same amount, so summing bills reconciles against these
+    # since() deltas exactly — plus bills retired at lifecycle exit and
+    # regressions the sentinel flagged against signature baselines
+    "acct_device_bytes_charged": 0,
+    "acct_device_bytes_released": 0,
+    "acct_spill_bytes_host": 0,
+    "acct_spill_bytes_disk": 0,
+    "acct_bytes_restored": 0,
+    "bills_settled": 0,
+    "perf_regressions_flagged": 0,
 }
 
 

@@ -50,6 +50,13 @@ EWMA_KEYS = ("self_wall_ns", "wall_ns", "rows", "batches", "host_syncs",
 OUTCOME_KEYS = ("fallback_obs", "runtime_fallbacks", "transient_retries",
                 "oom_restarts", "breaker_trips")
 
+# per-plan-signature EWMA dimensions (ISSUE 18): the regression
+# sentinel's baselines, stored under the payload's "signatures" section
+# beside the per-operator "entries" (old stores read back with an empty
+# section; old readers ignore the new key — no version bump needed)
+SIGNATURE_EWMA_KEYS = ("wall_ns", "host_syncs", "spill_bytes",
+                       "cache_hit_rate")
+
 _IO_LOCK = threading.Lock()
 
 # read-only store instances keyed by path, stamped by (mtime_ns, size,
@@ -179,6 +186,39 @@ def _apply(entries: Dict[str, Dict], obs: Observation,
         out[k] = int(out.get(k, 0)) + int(obs.outcomes.get(k, 0))
 
 
+def _new_sig_entry() -> Dict[str, Any]:
+    return {"n": 0, "ewma": {}, "wall_dev_ns": 0.0, "ops": {},
+            "last_at": 0.0}
+
+
+def _apply_signature(sigs: Dict[str, Dict], sig: str,
+                     values: Dict[str, float], ops: Dict[str, float],
+                     alpha: float) -> None:
+    """Fold one per-query sentinel observation (ISSUE 18) into a
+    signature's EWMAs.  The wall deviation EWMA tracks |obs - mean|
+    against the PRE-update mean — the sentinel's z denominator."""
+    ent = sigs.get(sig)
+    if ent is None:
+        ent = sigs[sig] = _new_sig_entry()
+    ent["n"] = int(ent.get("n", 0)) + 1
+    ent["last_at"] = time.time()
+    ew = ent.setdefault("ewma", {})
+    prev_mean = ew.get("wall_ns")
+    if prev_mean is not None:
+        dev = abs(float(values.get("wall_ns", 0.0)) - float(prev_mean))
+        old_dev = float(ent.get("wall_dev_ns", 0.0))
+        ent["wall_dev_ns"] = alpha * dev + (1.0 - alpha) * old_dev
+    for k in SIGNATURE_EWMA_KEYS:
+        v = float(values.get(k, 0.0))
+        old = ew.get(k)
+        ew[k] = v if old is None else alpha * v + (1.0 - alpha) * old
+    ops_ew = ent.setdefault("ops", {})
+    for key, wall in ops.items():
+        old = ops_ew.get(key)
+        ops_ew[key] = float(wall) if old is None \
+            else alpha * float(wall) + (1.0 - alpha) * float(old)
+
+
 class CalibrationStore:
     """In-memory view + pending observations over one store file."""
 
@@ -189,14 +229,17 @@ class CalibrationStore:
         # forever; >1 would oscillate
         self.alpha = min(max(float(alpha), 1e-3), 1.0)
         self.entries: Dict[str, Dict] = {}
+        # per-plan-signature sentinel baselines (ISSUE 18)
+        self.signatures: Dict[str, Dict] = {}
         self._pending: List[Observation] = []
+        self._pending_sigs: List[Tuple[str, Dict, Dict]] = []
         self._by_opfp: Dict[Tuple[str, str], List[str]] = {}
 
     # -- load/save ------------------------------------------------------
     @classmethod
     def load(cls, directory: str, alpha: float = 0.25) -> "CalibrationStore":
         st = cls(directory, alpha)
-        st.entries = st._read_disk()
+        st.entries, st.signatures = st._read_disk()
         st._reindex()
         return st
 
@@ -226,17 +269,21 @@ class CalibrationStore:
             _cache_put(path, stamp, store)
         return store
 
-    def _read_disk(self) -> Dict[str, Dict]:
+    def _read_disk(self) -> Tuple[Dict[str, Dict], Dict[str, Dict]]:
+        """(entries, signatures) — pre-ISSUE-18 stores read back with an
+        empty signatures section."""
         try:
             with open(self.path) as f:
                 payload = json.load(f)
         except (OSError, ValueError):
-            return {}
+            return {}, {}
         if not isinstance(payload, dict) \
                 or payload.get("version") != STORE_VERSION:
-            return {}   # incompatible/corrupt store: start fresh
+            return {}, {}   # incompatible/corrupt store: start fresh
         ents = payload.get("entries")
-        return dict(ents) if isinstance(ents, dict) else {}
+        sigs = payload.get("signatures")
+        return (dict(ents) if isinstance(ents, dict) else {},
+                dict(sigs) if isinstance(sigs, dict) else {})
 
     def _reindex(self) -> None:
         self._by_opfp = {}
@@ -262,6 +309,22 @@ class CalibrationStore:
                 n += 1
         return n
 
+    def observe_signature(self, sig: str, values: Dict[str, float],
+                          ops: Optional[Dict[str, float]] = None) -> None:
+        """Fold one per-query sentinel observation (ISSUE 18) into the
+        signature's baseline EWMAs; merged on save() like operator
+        observations."""
+        if not sig:
+            return
+        ops = dict(ops or {})
+        self._pending_sigs.append((sig, dict(values), ops))
+        _apply_signature(self.signatures, sig, values, ops, self.alpha)
+
+    def signature(self, sig: str) -> Optional[Dict]:
+        """The signature's baseline entry, or None when the store has
+        never folded the plan shape."""
+        return self.signatures.get(sig)
+
     def save(self) -> str:
         """Merge-on-write: re-read the file, apply only THIS store's
         pending observations on top of whatever is there now, replace
@@ -275,6 +338,7 @@ class CalibrationStore:
 
         with _IO_LOCK:
             disk = None
+            sdisk = None
             try:
                 st = os.stat(self.path)
                 hit = _READ_CACHE.get(self.path)
@@ -296,14 +360,22 @@ class CalibrationStore:
                     for p in self._pending:
                         if p.key in disk:
                             disk[p.key] = copy.deepcopy(disk[p.key])
+                    sdisk = dict(hit[1].signatures)
+                    for sig, _v, _o in self._pending_sigs:
+                        if sig in sdisk:
+                            sdisk[sig] = copy.deepcopy(sdisk[sig])
             except OSError:
                 pass
             if disk is None:
-                disk = self._read_disk()
+                disk, sdisk = self._read_disk()
             for obs in self._pending:
                 _apply(disk, obs, self.alpha)
+            for sig, values, ops in self._pending_sigs:
+                _apply_signature(sdisk, sig, values, ops, self.alpha)
             self._pending = []
+            self._pending_sigs = []
             self.entries = disk
+            self.signatures = sdisk
             self._reindex()
             payload = {
                 "version": STORE_VERSION,
@@ -312,6 +384,7 @@ class CalibrationStore:
                 "total_obs": sum(int(e.get("obs", 0))
                                  for e in disk.values()),
                 "entries": disk,
+                "signatures": sdisk,
             }
             os.makedirs(self.directory, exist_ok=True)
             tmp = self.path + ".tmp"

@@ -19,6 +19,7 @@ import os
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 from spark_rapids_tpu import types as T
+from spark_rapids_tpu.accounting import context as _ACCT_CTX
 from spark_rapids_tpu.columnar.column import HostColumn
 from spark_rapids_tpu.telemetry import context as _TEL_CTX
 from spark_rapids_tpu.config import SHUFFLE_PARTITIONS, TpuConf
@@ -123,6 +124,13 @@ class TpuSession:
         from spark_rapids_tpu.governor import ensure_governor
 
         ensure_governor(self.conf)
+        # Resource accounting (ISSUE 18): the first session enabling
+        # spark.rapids.tpu.accounting.enabled installs the process-global
+        # ledger registry; disabled (the default) the ambient slot stays
+        # None and every spill-framework charge site is one attr check.
+        from spark_rapids_tpu.accounting import maybe_configure as acct_configure
+
+        acct_configure(self.conf)
 
     @staticmethod
     def builder() -> "TpuSessionBuilder":
@@ -705,14 +713,29 @@ class DataFrame:
             # collect of the same DataFrame must not clobber the
             # recorded query's prediction
             cost_box = {"pred": None}
-            if prof_dir:
+            # Accounting (ISSUE 18): with the ledger registry installed
+            # and a lifecycle context to own the bill, the finish hook
+            # also joins + records the query's resource bill and runs
+            # the regression sentinel.  Disabled: one ambient attr read.
+            acct_on = _ACCT_CTX.LEDGERS is not None and qctx is not None
+            if prof_dir or acct_on:
                 _conf = self.session.conf
 
-                def on_finish(diag, _conf=_conf, _box=cost_box):
-                    from spark_rapids_tpu.profiling import record_query
+                def on_finish(diag, _conf=_conf, _box=cost_box,
+                              _prof=bool(prof_dir), _acct=acct_on):
+                    if _prof:
+                        from spark_rapids_tpu.profiling import record_query
 
-                    record_query(diag, _conf,
-                                 prediction=_box["pred"])
+                        record_query(diag, _conf,
+                                     prediction=_box["pred"])
+                    if _acct:
+                        from spark_rapids_tpu.accounting import record_bill
+
+                        # AFTER record_query: a freshly folded operator
+                        # calibration must not shift THIS query's
+                        # sentinel baseline mid-flight (signatures merge
+                        # on the same store but are read once here)
+                        record_bill(diag, _conf)
 
             # Progress (ISSUE 12): lifecycle-managed queries register
             # with the process-global live tracker.  Disabled (default):

@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Tuple
 
 from spark_rapids_tpu import perfcounters as PC
 from spark_rapids_tpu import types as T
+from spark_rapids_tpu.accounting import context as _ACCT
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 
 
@@ -79,7 +80,16 @@ class SpillBackedPartitionQueues:
         t0 = time.perf_counter_ns()
         nb = batch.nbytes()
         if self._device_bytes + nb <= self.device_budget:
-            handle = self._fw.track(batch)
+            if _ACCT.LEDGERS is not None:
+                # stamp the reduce partition driving this admission so
+                # LRU spills it triggers bill against pid (ISSUE 18)
+                tok = _ACCT.PARTITION.set(pid)
+                try:
+                    handle = self._fw.track(batch)
+                finally:
+                    _ACCT.PARTITION.reset(tok)
+            else:
+                handle = self._fw.track(batch)
             self._queues[pid].append(("dev", handle))
             self._device_bytes += nb
         else:
@@ -244,6 +254,11 @@ class SpillBackedPartitionQueues:
 
         def _drain_group():
             t0 = time.perf_counter_ns()
+            # stamp the DRAINING partition: restores its materialization
+            # pulls up-tier — and spills that restoring displaces — bill
+            # against pid, localizing out-of-core pressure (ISSUE 18)
+            _tok = _ACCT.PARTITION.set(pid) \
+                if _ACCT.LEDGERS is not None else None
             handles = [h for kind, h in group if kind == "dev"]
             try:
                 for h in handles:
@@ -269,6 +284,8 @@ class SpillBackedPartitionQueues:
             finally:
                 for h in handles:
                     h.unpin()
+                if _tok is not None:
+                    _ACCT.PARTITION.reset(_tok)
             for kind, x in group:
                 self._release_entry(kind, x)
             PC.bump("exchange_spill_ns", time.perf_counter_ns() - t0)

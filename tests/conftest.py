@@ -76,6 +76,15 @@ def _resilience_isolation():
         from spark_rapids_tpu.governor import shutdown_governor
 
         shutdown_governor()
+    # ISSUE 18: the ledger registry is process-global — a test that
+    # enabled accounting must not leave every later test paying the
+    # charge tax (and piling settled bills into the retained ring)
+    from spark_rapids_tpu.accounting import context as _ACCT
+
+    if _ACCT.LEDGERS is not None:
+        from spark_rapids_tpu.accounting import shutdown as _acct_shutdown
+
+        _acct_shutdown()
 
 
 @pytest.fixture(autouse=True)
@@ -90,9 +99,14 @@ def _leak_gate(request):
     process's store.  ISSUE 16 extends it to RECOVERY artifacts: a
     journaled query left un-ended, an unserved pending checkpoint, or a
     leftover ``checkpoints/<fp>`` dir on disk means a test drove the
-    journal without closing its query lifecycle.  The gate only *fails*
-    a test whose body passed (a failing test already reported its real
-    error — the leaked state is still cleaned so it cannot cascade)."""
+    journal without closing its query lifecycle.  ISSUE 18 extends it to
+    RESOURCE BILLS: a settled bill with a nonzero residual — device
+    bytes charged to the query but never released, persistent df.cache
+    handles excluded — is the accounting-side view of a handle leak and
+    fails the owning test even after the handle itself was swept.  The
+    gate only *fails* a test whose body passed (a failing test already
+    reported its real error — the leaked state is still cleaned so it
+    cannot cascade)."""
     yield
     from spark_rapids_tpu.lifecycle import (
         leak_report_all,
@@ -112,7 +126,7 @@ def _leak_gate(request):
             "resource leak after test (spillables / semaphore permits / "
             "shuffle registrations / writer staging dirs / remote "
             "distributed partitions / recovery journal + checkpoint "
-            "files):\n"
+            "files / nonzero residual resource bills):\n"
             + "\n".join(leaks[:20]),
             pytrace=False)
 

@@ -1,0 +1,515 @@
+"""ISSUE 18: per-query resource accounting + the regression sentinel.
+
+Pins the tentpole contracts — the golden ``resource_bill`` event schema,
+the exact-sum invariant (per-query bills reconcile to the global
+``acct_*`` counter deltas, concurrent collects isolated), the exchange
+drain's partition attribution, the settled-bill residual leak report —
+plus the sentinel end-to-end: an injected slowdown on a store-profiled
+signature flags exactly one regression naming the regressed operator
+(with a post-mortem carrying the bill and the violated baseline), and
+unperturbed replays flag nothing.  The disabled path makes ZERO calls
+into accounting modules (cProfile-pinned, the diagnostics overhead
+methodology).
+"""
+import cProfile
+import json
+import os
+import pstats
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu import perfcounters as PC
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import TpuConf
+
+ACCT_KEYS = ("acct_device_bytes_charged", "acct_device_bytes_released",
+             "acct_spill_bytes_host", "acct_spill_bytes_disk",
+             "acct_bytes_restored")
+
+
+def _session(tmp_path, extra=None, accounting=True):
+    from spark_rapids_tpu.session import TpuSession
+
+    conf = {
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.tpu.accounting.enabled": accounting,
+        "spark.rapids.tpu.diagnostics.enabled": True,
+        "spark.rapids.tpu.diagnostics.eventLogDir": str(tmp_path / "logs"),
+    }
+    conf.update(extra or {})
+    return TpuSession(conf)
+
+
+def _build_query(s):
+    from spark_rapids_tpu.session import col, lit, sum_
+
+    sales = s.create_dataframe(
+        {"k": [1, 2, 1, 3, 2, 1, 4, 4],
+         "v": [10, 20, 30, 40, 50, 60, 7, 9]},
+        T.StructType([T.StructField("k", T.INT, False),
+                      T.StructField("v", T.LONG, False)]))
+    dim = s.create_dataframe(
+        {"k": [1, 2, 3, 4], "grp": [0, 0, 1, 1]},
+        T.StructType([T.StructField("k", T.INT, False),
+                      T.StructField("grp", T.INT, False)]))
+    return (sales.filter(col("v") > lit(5))
+            .join(dim, on="k")
+            .group_by("grp").agg(sum_("v", "sv"))
+            .order_by("grp"))
+
+
+def _events_of(df):
+    with open(df._last_diag.event_log_path) as f:
+        return [json.loads(line) for line in f]
+
+
+# ---------------------------------------------------------------------------
+# the resource_bill event: golden schema + report surface
+# ---------------------------------------------------------------------------
+
+def test_resource_bill_event_golden_schema(tmp_path):
+    from spark_rapids_tpu.accounting import BILL_COUNTER_KEYS
+    from spark_rapids_tpu.diagnostics.recorder import EVENT_SCHEMA
+
+    s = _session(tmp_path)
+    df = _build_query(s)
+    rows = df.collect()
+    assert sorted(rows) == [(0, 170), (1, 56)]
+    events = _events_of(df)
+    bills = [e for e in events if e["ev"] == "resource_bill"]
+    assert len(bills) == 1
+    bill = bills[0]
+    for field in EVENT_SCHEMA["resource_bill"]:
+        assert field in bill, f"resource_bill missing {field}"
+    # one bill per query, emitted before the trailing query_end
+    assert events[-1]["ev"] == "query_end"
+    assert events.index(bill) < len(events) - 1
+    # the query's tracked device bytes all came back: balanced bill
+    assert bill["device_bytes_charged"] > 0
+    assert bill["device_bytes_charged"] == bill["device_bytes_released"]
+    assert bill["residual_bytes"] == 0
+    assert bill["device_peak_bytes"] > 0
+    assert bill["device_byte_seconds"] >= 0
+    # plan signature: the SLO/--diff identity, path:Name joined
+    assert all(":" in seg for seg in bill["signature"].split("|"))
+    assert "TpuSortExec" in bill["signature"]
+    assert set(bill["counters"]) == set(BILL_COUNTER_KEYS)
+    spill = bill["spill"]
+    for k in ("host_bytes", "host_count", "disk_bytes", "disk_count",
+              "restore_bytes", "restore_count"):
+        assert k in spill
+
+    # the offline surface reads the same event back
+    from spark_rapids_tpu.diagnostics.report import (
+        bills_summary,
+        load_logs,
+        render_bills,
+    )
+
+    summary = bills_summary(load_logs([str(tmp_path / "logs")]))
+    assert summary["queries_with_bills"] == 1
+    row = summary["bills"][0]
+    assert row["device_peak_bytes"] == bill["device_peak_bytes"]
+    assert row["regression"] is None
+    assert "resource bills" in render_bills(summary)
+
+
+# ---------------------------------------------------------------------------
+# the exact-sum invariant
+# ---------------------------------------------------------------------------
+
+def test_bills_reconcile_to_global_counter_deltas(tmp_path):
+    from spark_rapids_tpu.accounting import get_registry
+
+    snap = PC.snapshot()
+    s = _session(tmp_path)
+    for _ in range(2):
+        _build_query(s).collect()
+    reg = get_registry()
+    assert reg is not None
+    all_bills = reg.snapshot_all()
+    settled = [b for b in all_bills if b.get("settled")]
+    assert len(settled) == 2
+    d = PC.since(snap)
+    assert sum(b["device_bytes_charged"] for b in all_bills) \
+        == d["acct_device_bytes_charged"] > 0
+    assert sum(b["device_bytes_released"] for b in all_bills) \
+        == d["acct_device_bytes_released"]
+    assert sum(b["spill"]["host_bytes"] for b in all_bills) \
+        == d["acct_spill_bytes_host"]
+    assert sum(b["spill"]["disk_bytes"] for b in all_bills) \
+        == d["acct_spill_bytes_disk"]
+    assert sum(b["spill"]["restore_bytes"] for b in all_bills) \
+        == d["acct_bytes_restored"]
+    assert d["bills_settled"] == 2
+    for b in settled:
+        assert b["residual_bytes"] == 0
+
+
+def test_concurrent_collects_have_isolated_bills(tmp_path):
+    from spark_rapids_tpu.accounting import get_registry
+
+    snap = PC.snapshot()
+    s = _session(tmp_path)
+    start = threading.Barrier(2)
+    errors = []
+
+    def run():
+        try:
+            start.wait(timeout=10)
+            for _ in range(3):
+                rows = _build_query(s).collect()
+                assert sorted(rows) == [(0, 170), (1, 56)]
+        except Exception as e:  # surfaces in the main thread's assert
+            errors.append(e)
+
+    threads = [threading.Thread(target=run) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    reg = get_registry()
+    all_bills = reg.snapshot_all()
+    settled = [b for b in all_bills if b.get("settled")]
+    assert len(settled) == 6
+    # isolation: every bill balanced on its own — a cross-attributed
+    # release would leave one bill negative and another leaking
+    for b in settled:
+        assert b["device_bytes_charged"] > 0
+        assert b["device_bytes_charged"] == b["device_bytes_released"]
+        assert b["residual_bytes"] == 0
+    d = PC.since(snap)
+    assert sum(b["device_bytes_charged"] for b in all_bills) \
+        == d["acct_device_bytes_charged"]
+    assert sum(b["device_bytes_released"] for b in all_bills) \
+        == d["acct_device_bytes_released"]
+
+
+# ---------------------------------------------------------------------------
+# exchange drain partition attribution (ISSUE 18 satellite)
+# ---------------------------------------------------------------------------
+
+def test_exchange_drain_attributes_spill_to_partition(tmp_path):
+    """A tiny-pool queue run: LRU spills triggered by a partition's
+    admissions and the restores its drain pulls back bill against THAT
+    partition id."""
+    from spark_rapids_tpu.accounting import maybe_configure, shutdown
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.memory.device_manager import reset_device_manager
+    from spark_rapids_tpu.memory.spill import (
+        get_spill_framework,
+        reset_spill_framework,
+    )
+    from spark_rapids_tpu.shuffle.partition_queues import (
+        SpillBackedPartitionQueues,
+    )
+
+    shutdown()
+    reg = maybe_configure(TpuConf(
+        {"spark.rapids.tpu.accounting.enabled": True}))
+    reset_spill_framework()
+    try:
+        reset_device_manager()
+    except Exception:
+        pass
+    get_spill_framework(TpuConf({
+        "spark.rapids.tpu.test.deviceMemoryBytes": 48 << 10,
+        "spark.rapids.memory.spillDir": str(tmp_path),
+    }))
+
+    def batch(start):
+        n = 1000
+        return ColumnarBatch.from_pydict(
+            {"a": list(range(start, start + n)),
+             "s": [f"row{i}" for i in range(n)]},
+            T.StructType([T.StructField("a", T.LONG),
+                          T.StructField("s", T.STRING)]))
+
+    q = SpillBackedPartitionQueues(3, batch(0).schema,
+                                   device_budget=1 << 30, codec="none")
+    # ~22KiB per batch against a 48KiB pool: partition 2's admissions
+    # must LRU-spill partition 0/1 residents
+    for pid in range(3):
+        q.append(pid, batch(pid * 1000))
+        q.append(pid, batch(pid * 1000 + 500))
+    for pid in range(3):
+        out = q.read(pid)
+        assert out.num_rows == 2000
+        assert out.to_pydict()["a"][0] == pid * 1000
+    q.close()
+
+    bill = reg.snapshot(None)   # no lifecycle context: unowned bucket
+    assert bill is not None
+    assert bill["spill"]["host_bytes"] > 0
+    assert bill["spill"]["restore_bytes"] > 0
+    parts = bill["partitions"]
+    assert parts, "no partition attribution recorded"
+    assert set(parts) <= {0, 1, 2}
+    assert sum(p["spill_bytes"] for p in parts.values()) \
+        == bill["spill"]["host_bytes"]
+    assert sum(p["restore_bytes"] for p in parts.values()) \
+        == bill["spill"]["restore_bytes"]
+    # the drain restores partitions spilled under OTHER partitions'
+    # admissions — more than one pid must carry traffic
+    assert len(parts) >= 2
+
+
+# ---------------------------------------------------------------------------
+# residual bills: the leak-gate surface
+# ---------------------------------------------------------------------------
+
+def test_settled_residual_bill_reports_as_leak():
+    from spark_rapids_tpu.accounting.ledger import LedgerRegistry
+
+    reg = LedgerRegistry()
+    reg.charge_device("qL", 4096)
+    reg.release_device("qL", 1024)
+    snap = reg.settle("qL")
+    assert snap["residual_bytes"] == 3072
+    report = reg.leak_report()
+    assert len(report) == 1
+    assert "LEAK: resource bill qL residual 3072B" in report[0]
+    # a late release (handle swept after settle) repairs the record AND
+    # the leak entry — bounded retention must stay truthful
+    reg.release_device("qL", 3072)
+    assert reg.leak_report() == []
+    assert reg.snapshot("qL")["residual_bytes"] == 0
+    reg.reset_residuals()
+    assert reg.leak_report() == []
+
+
+def test_persistent_handles_excluded_from_residual():
+    from spark_rapids_tpu.accounting.ledger import LedgerRegistry
+
+    reg = LedgerRegistry()
+    reg.charge_device("qC", 8192, persistent=True)   # df.cache()
+    reg.charge_device("qC", 1000)
+    reg.release_device("qC", 1000)
+    snap = reg.settle("qC")
+    assert snap["persistent_bytes"] == 8192
+    assert snap["residual_bytes"] == 0
+    assert reg.leak_report() == []
+
+
+# ---------------------------------------------------------------------------
+# disabled path: zero accounting calls
+# ---------------------------------------------------------------------------
+
+def test_disabled_path_does_no_accounting_work(tmp_path):
+    """With accounting disabled every charge site costs one ambient
+    ``LEDGERS is None`` check: profiling a track/spill/collect-heavy
+    workload shows ZERO calls into the accounting package."""
+    from spark_rapids_tpu.accounting import context as _ACCT
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.memory.spill import SpillFramework
+
+    assert _ACCT.LEDGERS is None
+    s = _session(tmp_path, accounting=False)
+    df = _build_query(s)
+    df.collect()          # warm compile caches outside the profile
+    b = ColumnarBatch.from_pydict(
+        {"a": list(range(1000))},
+        T.StructType([T.StructField("a", T.LONG)]))
+    fw = SpillFramework(pool_bytes=16 << 10, host_limit=1 << 30,
+                        spill_dir=str(tmp_path / "spill"))
+
+    prof = cProfile.Profile()
+    prof.enable()
+    for _ in range(30):
+        h = fw.track(b)            # charge + LRU-evict sites
+        h.get_batch()              # restore site
+        h.close()                  # release site
+    df.collect()
+    prof.disable()
+    banned = (os.path.join("accounting", "ledger.py"),
+              os.path.join("accounting", "__init__.py"),
+              os.path.join("accounting", "sentinel.py"))
+    offenders = [
+        (fname, func)
+        for (fname, _lineno, func) in pstats.Stats(prof).stats
+        if any(bad in fname for bad in banned)]
+    assert not offenders, (
+        f"accounting work on the disabled path: {offenders}")
+
+
+# ---------------------------------------------------------------------------
+# the sentinel: evaluate() thresholds (pure unit)
+# ---------------------------------------------------------------------------
+
+def _baseline(wall=100e6, syncs=10.0, spill=0.0, hit=1.0, n=5,
+              dev=0.0):
+    return {"n": n, "wall_dev_ns": dev,
+            "ewma": {"wall_ns": wall, "host_syncs": syncs,
+                     "spill_bytes": spill, "cache_hit_rate": hit},
+            "ops": {}}
+
+
+def _evaluate(baseline, obs, **kw):
+    from spark_rapids_tpu.accounting.sentinel import evaluate
+
+    args = dict(min_samples=3, wall_ratio=2.0, z_threshold=4.0,
+                min_wall_excess_ns=5e6)
+    args.update(kw)
+    return evaluate(baseline, obs, **args)
+
+
+def _obs(wall=100e6, syncs=10.0, spill=0.0, hit=1.0):
+    return {"wall_ns": wall, "host_syncs": syncs, "spill_bytes": spill,
+            "cache_hit_rate": hit}
+
+
+def test_evaluate_min_samples_and_clean_pass():
+    assert _evaluate(None, _obs(wall=1e12)) is None
+    assert _evaluate(_baseline(n=2), _obs(wall=1e12)) is None
+    assert _evaluate(_baseline(), _obs()) is None
+
+
+def test_evaluate_wall_needs_ratio_and_z_and_excess():
+    # 3x the baseline with a tiny deviation EWMA: flags (std floored)
+    f = _evaluate(_baseline(), _obs(wall=300e6))
+    assert f is not None and f["dimension"] == "wall_ns"
+    assert f["ratio"] == pytest.approx(3.0)
+    # over ratio but under the absolute excess floor: noise, no flag
+    assert _evaluate(_baseline(wall=1e6), _obs(wall=3e6)) is None
+    # over ratio but a noisy baseline kills the z gate
+    assert _evaluate(_baseline(dev=200e6), _obs(wall=210e6)) is None
+    # under the ratio gate entirely
+    assert _evaluate(_baseline(), _obs(wall=150e6)) is None
+
+
+def test_evaluate_sync_and_spill_floors():
+    f = _evaluate(_baseline(syncs=20.0), _obs(syncs=60.0))
+    assert f is not None and f["dimension"] == "host_syncs"
+    # tripled but only +4 syncs: under SYNC_EXCESS_FLOOR
+    assert _evaluate(_baseline(syncs=2.0), _obs(syncs=6.0)) is None
+    f = _evaluate(_baseline(spill=0.0), _obs(spill=4 << 20))
+    assert f is not None and f["dimension"] == "spill_bytes"
+    assert _evaluate(_baseline(spill=0.0), _obs(spill=1024)) is None
+
+
+def test_evaluate_cache_drop_and_worst_dimension_wins():
+    f = _evaluate(_baseline(hit=0.95), _obs(hit=0.2))
+    assert f is not None and f["dimension"] == "cache_hit_rate"
+    assert _evaluate(_baseline(hit=0.95), _obs(hit=0.7)) is None
+    # wall 10x vs syncs 3x: the worse excursion is reported
+    f = _evaluate(_baseline(), _obs(wall=1000e6, syncs=30.0))
+    assert f is not None and f["dimension"] == "wall_ns"
+
+
+def test_regressed_operator_names_largest_delta():
+    from spark_rapids_tpu.accounting.sentinel import regressed_operator
+
+    base = {"ops": {"0:Sort": 10e6, "0.0:Agg": 20e6}}
+    path, name, table = regressed_operator(
+        base, {"0:Sort": int(12e6), "0.0:Agg": int(900e6)})
+    assert (path, name) == ("0.0", "Agg")
+    assert table[0]["delta_ns"] == int(900e6 - 20e6)
+    assert regressed_operator(None, {}) == ("", "", [])
+
+
+# ---------------------------------------------------------------------------
+# store: signature baseline roundtrip + merge
+# ---------------------------------------------------------------------------
+
+def test_store_signature_roundtrip_and_merge(tmp_path):
+    from spark_rapids_tpu.profiling.store import CalibrationStore
+
+    d = str(tmp_path / "store")
+    st = CalibrationStore(d, alpha=0.5)
+    st.observe_signature("0:A|0.0:B", _obs(wall=100e6),
+                         {"0:A": 60e6, "0.0:B": 40e6})
+    st.observe_signature("0:A|0.0:B", _obs(wall=200e6),
+                         {"0:A": 120e6, "0.0:B": 80e6})
+    st.save()
+
+    rt = CalibrationStore.load(d, alpha=0.5)
+    ent = rt.signature("0:A|0.0:B")
+    assert ent is not None and ent["n"] == 2
+    assert ent["ewma"]["wall_ns"] == pytest.approx(150e6)
+    # deviation EWMA tracked |obs - pre-update mean| = 100e6 at alpha .5
+    assert ent["wall_dev_ns"] == pytest.approx(50e6)
+    assert ent["ops"]["0:A"] == pytest.approx(90e6)
+    assert rt.signature("0:missing") is None
+
+    # a second writer merges on save instead of clobbering
+    w2 = CalibrationStore(d, alpha=0.5)
+    w2.observe_signature("1:C", _obs(wall=5e6), {"1:C": 5e6})
+    w2.save()
+    rt2 = CalibrationStore.load(d, alpha=0.5)
+    assert rt2.signature("0:A|0.0:B")["n"] == 2
+    assert rt2.signature("1:C")["n"] == 1
+
+
+# ---------------------------------------------------------------------------
+# sentinel end-to-end: injected slowdown flags, clean replays do not
+# ---------------------------------------------------------------------------
+
+@pytest.mark.profiling
+def test_sentinel_flags_injected_slowdown_and_bounds_false_positives(
+        tmp_path):
+    import shutil
+
+    from spark_rapids_tpu import telemetry
+    from spark_rapids_tpu.exec.runtime import make_operator_runtime
+    from spark_rapids_tpu.exec.sort import TpuSortExec
+
+    s = _session(tmp_path, extra={
+        "spark.rapids.tpu.profile.dir": str(tmp_path / "store"),
+        "spark.rapids.tpu.accounting.sentinel.minSamples": 3,
+        # jitter guard: only the injected sleep can clear this floor
+        "spark.rapids.tpu.accounting.sentinel.minWallExcessMs": 250.0,
+    })
+    # the session's first collect pays the compile wall; fold-free
+    # baselines need steady runs, so warm up and drop the store
+    for _ in range(2):
+        _build_query(s).collect()
+    shutil.rmtree(tmp_path / "store", ignore_errors=True)
+    snap = PC.snapshot()
+    for _ in range(4):
+        rows = _build_query(s).collect()
+        assert sorted(rows) == [(0, 170), (1, 56)]
+    assert PC.since(snap)["perf_regressions_flagged"] == 0
+
+    # inject the slowdown INSIDE the operator runtime wrapper so the
+    # recorder attributes the extra wall to the aggregate's own span
+    raw = TpuSortExec.execute_columnar.__wrapped__
+
+    def slow(self):
+        time.sleep(0.8)
+        yield from raw(self)
+
+    orig = TpuSortExec.execute_columnar
+    TpuSortExec.execute_columnar = make_operator_runtime(slow)
+    try:
+        df = _build_query(s)
+        rows = df.collect()
+    finally:
+        TpuSortExec.execute_columnar = orig
+    assert sorted(rows) == [(0, 170), (1, 56)]
+
+    assert PC.since(snap)["perf_regressions_flagged"] == 1
+    regs = [e for e in _events_of(df) if e["ev"] == "regression"]
+    assert len(regs) == 1
+    reg = regs[0]
+    assert reg["dimension"] == "wall_ns"
+    assert reg["ratio"] > 2.0
+    assert reg["op_name"] == "TpuSortExec"
+    assert "TpuSortExec" in reg["detail"]
+
+    pm = telemetry.last_postmortem()
+    assert pm is not None and pm["reason"] == "perf_regression"
+    assert pm["bill"]["device_peak_bytes"] >= 0
+    assert pm["baseline"]["n"] >= 3
+    assert pm["op_deltas"][0]["name"] == "TpuSortExec"
+
+    # false-positive bound: 10 unperturbed replays flag nothing (the
+    # flagged observation was NOT folded into the baseline)
+    for _ in range(10):
+        df = _build_query(s)
+        rows = df.collect()
+        assert sorted(rows) == [(0, 170), (1, 56)]
+        assert not [e for e in _events_of(df) if e["ev"] == "regression"]
+    assert PC.since(snap)["perf_regressions_flagged"] == 1

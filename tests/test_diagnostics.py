@@ -94,6 +94,15 @@ GOLDEN_SCHEMA = {
                  "fallback"],
     "cost_model": ["hits", "misses", "predicted_wall_ns",
                    "actual_wall_ns", "matched_actual_wall_ns"],
+    "resource_bill": ["query_id", "signature", "wall_ns",
+                      "device_peak_bytes", "device_byte_seconds",
+                      "device_bytes_charged", "device_bytes_released",
+                      "residual_bytes", "persistent_bytes", "spill",
+                      "partitions", "background_wall_ns", "worker_bytes",
+                      "counters"],
+    "regression": ["query_id", "signature", "dimension", "observed",
+                   "baseline", "ratio", "z", "op_path", "op_name",
+                   "detail"],
     "query_end": ["wall_ns", "status", "counters"],
 }
 

@@ -87,6 +87,11 @@ JOURNAL_OVERHEAD_MAX_PCT = 2.0
 # progressOverhead (ISSUE 12): absolute percentage-point slack — the
 # A/B times sub-second collects, so small relative drift is noise
 PROGRESS_OVERHEAD_SLACK_PP = 10.0
+# resource-accounting pin (ISSUE 18): the accounting-on vs -off hot
+# aggregate A/B (min of repeats per mode) must stay within this many
+# percent — bill charges are per-HANDLE (register/spill/release), never
+# per-row, so growth here means ledger work leaked onto a hot path
+ACCT_OVERHEAD_MAX_PCT = 2.0
 
 
 def load(path: str) -> Dict:
@@ -345,6 +350,20 @@ def gate(base: Dict, new: Dict, tolerance: float = DEFAULT_TOLERANCE,
                 f"{np2:+.1f}% (slack "
                 f"{PROGRESS_OVERHEAD_SLACK_PP:.0f}pp) — the per-batch "
                 f"progress instrumentation got more expensive")
+
+    # accountingOverhead (ISSUE 18 satellite): self-contained absolute
+    # pin like the journal one — the enabled-path bill-charging tax on
+    # the new payload must stay under the cap whenever it was measured
+    # (no baseline needed; min-of-repeats already discarded noise)
+    ao = (new.get("accountingOverhead") or {}).get("overhead_pct")
+    if ao is not None and float(ao) > ACCT_OVERHEAD_MAX_PCT:
+        regressions.append(
+            f"accountingOverhead {float(ao):+.1f}% exceeds the "
+            f"{ACCT_OVERHEAD_MAX_PCT:.0f}% pin (accounting-on "
+            f"{(new.get('accountingOverhead') or {}).get('enabled_s')}s "
+            f"vs off "
+            f"{(new.get('accountingOverhead') or {}).get('disabled_s')}s)"
+            f" — per-handle bill charging leaked onto a hot path")
 
     # NOTE: the payload's per-plan-signature "slo" section is
     # deliberately NOT gated here — it includes warm-up/compile collects

@@ -89,6 +89,43 @@ def _progress_record(qp) -> Optional[Dict[str, Any]]:
     return None
 
 
+def _bill_record(qp) -> Optional[Dict[str, Any]]:
+    """The query's resource_bill event (ISSUE 18), compacted for the
+    index/detail payloads."""
+    for e in qp.events:
+        if e.get("ev") == "resource_bill":
+            sp = e.get("spill") or {}
+            return {
+                "device_peak_bytes":
+                    int(e.get("device_peak_bytes", 0) or 0),
+                "device_byte_seconds":
+                    float(e.get("device_byte_seconds", 0) or 0),
+                "spilled_bytes": int(sp.get("host_bytes", 0) or 0)
+                + int(sp.get("disk_bytes", 0) or 0),
+                "restored_bytes": int(sp.get("restore_bytes", 0) or 0),
+                "residual_bytes": int(e.get("residual_bytes", 0) or 0),
+                "partitions": e.get("partitions") or {},
+                "worker_bytes": e.get("worker_bytes") or {},
+            }
+    return None
+
+
+def _sentinel_record(qp) -> Optional[Dict[str, Any]]:
+    """The sentinel's verdict (ISSUE 18): the regression event when one
+    was flagged, else None (= no excursion against the baseline)."""
+    for e in qp.events:
+        if e.get("ev") == "regression":
+            return {
+                "dimension": e.get("dimension", ""),
+                "observed": e.get("observed", 0),
+                "baseline": e.get("baseline", 0),
+                "ratio": e.get("ratio", 0),
+                "op": f"{e.get('op_path', '')}:{e.get('op_name', '')}",
+                "detail": e.get("detail", ""),
+            }
+    return None
+
+
 def index_rows(profiles, slo_target_ms: float) -> List[Dict[str, Any]]:
     """One summary dict per query, newest first (the /api/queries
     payload and the index table's rows)."""
@@ -106,6 +143,8 @@ def index_rows(profiles, slo_target_ms: float) -> List[Dict[str, Any]]:
             "stalls": (prog["stalls"] if prog is not None
                        else len(stalls)),
             "cost": _cost_record(qp),
+            "bill": _bill_record(qp),
+            "regression": _sentinel_record(qp),
             "incomplete": qp.incomplete,
             "log": qp.path,
         })
@@ -140,6 +179,8 @@ def query_detail(qp, slo_target_ms: float) -> Dict[str, Any]:
         } for op in ops],
         "cost": _cost_record(qp),
         "progress": _progress_record(qp),
+        "bill": _bill_record(qp),
+        "regression": _sentinel_record(qp),
         "stall_events": [e for e in qp.events
                          if e.get("ev") == "query_stall"],
         "lifecycle": [e for e in qp.events
@@ -211,10 +252,17 @@ def render_index_html(rows: List[Dict[str, Any]]) -> str:
             f"({len(rows)} queries)</h2><table>",
             "<tr><th>query</th><th>status</th><th>SLO</th>"
             "<th>wall_ms</th><th>ops</th><th>stalls</th>"
-            "<th>predicted_ms</th><th>matched_actual_ms</th></tr>"]
+            "<th>predicted_ms</th><th>matched_actual_ms</th>"
+            "<th>device_B*s</th><th>spilled</th><th>sentinel</th></tr>"]
     for r in rows:
         cost = r["cost"] or {}
+        bill = r.get("bill") or {}
+        reg = r.get("regression")
         flag = " (incomplete)" if r["incomplete"] else ""
+        sentinel = (f"<span class='slo-error'>"
+                    f"REGRESSED[{_esc(reg['dimension'])}]</span>"
+                    if reg else
+                    ("ok" if bill else ""))
         body.append(
             f"<tr><td><a href='/query/{_esc(r['query_id'])}'>"
             f"{_esc(r['query_id'])}</a>{flag}</td>"
@@ -223,7 +271,10 @@ def render_index_html(rows: List[Dict[str, Any]]) -> str:
             f"<td>{r['wall_ms']:.1f}</td><td>{r['operators']}</td>"
             f"<td>{r['stalls']}</td>"
             f"<td>{cost.get('predicted_wall_ms', '')}</td>"
-            f"<td>{cost.get('matched_actual_wall_ms', '')}</td></tr>")
+            f"<td>{cost.get('matched_actual_wall_ms', '')}</td>"
+            f"<td>{bill.get('device_byte_seconds', '')}</td>"
+            f"<td>{bill.get('spilled_bytes', '')}</td>"
+            f"<td>{sentinel}</td></tr>")
     body.append("</table><p><a href='/cluster'>cluster (per-worker "
                 "view)</a></p></body></html>")
     return "\n".join(body)
@@ -267,6 +318,27 @@ def render_query_html(d: Dict[str, Any]) -> str:
             f"<h3>progress</h3><p>final pct={p['pct']} "
             f"stalls={p['stalls']} background="
             f"{_esc(json.dumps(p['background']))}</p>")
+    if d.get("bill") is not None:
+        b = d["bill"]
+        body.append(
+            f"<h3>resource bill</h3><p>device peak "
+            f"{b['device_peak_bytes']}B, "
+            f"{b['device_byte_seconds']:.1f} device-byte-seconds, "
+            f"spilled {b['spilled_bytes']}B / restored "
+            f"{b['restored_bytes']}B, residual {b['residual_bytes']}B"
+            "</p>")
+        if b["partitions"]:
+            body.append(f"<p>hot partitions: "
+                        f"{_esc(json.dumps(b['partitions']))}</p>")
+        if b["worker_bytes"]:
+            body.append(f"<p>worker store bytes: "
+                        f"{_esc(json.dumps(b['worker_bytes']))}</p>")
+    if d.get("regression") is not None:
+        rr = d["regression"]
+        body.append(
+            f"<h3>sentinel</h3><p class='slo-error'>REGRESSED "
+            f"{_esc(rr['dimension'])} x{rr['ratio']} — worst op "
+            f"{_esc(rr['op'])}: {_esc(rr['detail'])}</p>")
     if d["stall_events"]:
         body.append("<h3>stalls</h3><pre>")
         for e in d["stall_events"]:

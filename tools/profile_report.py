@@ -50,12 +50,19 @@ def main(argv=None) -> int:
                          "federated counters (multi-process logs — "
                          "loose worker-span files attach to loaded "
                          "queries by trace id)")
+    ap.add_argument("--bills", action="store_true",
+                    help="aggregate resource_bill events (ISSUE 18): "
+                         "queries ranked by device-byte-seconds and "
+                         "spill traffic, hot exchange partitions, and "
+                         "any sentinel regression verdicts")
     args = ap.parse_args(argv)
 
     from spark_rapids_tpu.diagnostics.report import (
+        bills_summary,
         data_quality_warnings,
         diff_profiles,
         load_logs,
+        render_bills,
         render_diff,
         render_report,
         render_stalls,
@@ -105,6 +112,8 @@ def main(argv=None) -> int:
             payload["stalls"] = stalls_summary(profiles)
         if args.workers:
             payload["workers"] = workers_summary(profiles)
+        if args.bills:
+            payload["bills"] = bills_summary(profiles)
         if args.diff:
             payload["diff"] = diff_profiles(load_logs([args.diff]),
                                             profiles)
@@ -118,6 +127,9 @@ def main(argv=None) -> int:
     if args.workers:
         print()
         print(render_workers(workers_summary(profiles)))
+    if args.bills:
+        print()
+        print(render_bills(bills_summary(profiles)))
     if args.diff:
         print()
         print(render_diff(load_logs([args.diff]), profiles))
