@@ -577,6 +577,187 @@ def run_concurrency(n_workers: int, rounds: int = 3,
     return out
 
 
+def run_serving(n_workers: int, rounds: int = 4,
+                rows: int = 200_000) -> dict:
+    """``bench.py --serving N`` (ISSUE 19 satellite): the mixed-tenant
+    serving benchmark — 2 'light' workers and ``N - 2`` 'heavy' workers
+    drive the rung-2-shaped mini query through isolated tenant
+    sessions, fair-share admission, tenant quotas, and the
+    result-fragment cache.  Emits one JSON line whose shed-rate /
+    per-tenant-p95 / cross-tenant-leak columns tools/bench_gate.py
+    pins: leaks and warm-repeat recompiles are STRICT zeros, p95 and
+    shed rate are baseline-relative like the --concurrency gate.
+
+    Per-tenant p95 comes from the walls of the TIMED phase only (every
+    query there runs warm and unique), not the SLO histograms — those
+    include the warm phase's compile walls, which depend on cache
+    state, exactly what the bench gate must not flag."""
+    import threading
+
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.governor import shutdown_governor
+    from spark_rapids_tpu.lifecycle import (
+        QueryRejected,
+        last_query_stats,
+        leak_report_all,
+        reset_admission,
+    )
+    from spark_rapids_tpu.serving import peek_serving, shutdown_serving
+    from spark_rapids_tpu.session import TpuSession, sum_
+
+    n_workers = max(n_workers, 3)
+    ss = make_store_sales(rows)
+    dd = make_date_dim()
+
+    shutdown_governor()
+    shutdown_serving()
+    reset_admission()
+    conf = {
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.tpu.serving.enabled": True,
+        # equal weights: the fairness the gate pins must come from the
+        # usage accounts, not from tilting the scale
+        "spark.rapids.tpu.serving.weights": "light:1,heavy:1",
+        "spark.rapids.tpu.serving.quotas": "heavy:2",
+        "spark.rapids.tpu.governor.enabled": True,
+        "spark.rapids.tpu.governor.updatePeriodMs": "10",
+        "spark.rapids.tpu.concurrentQueries": str(
+            int(os.environ.get("BENCH_CONCURRENT_QUERIES", 3))),
+        "spark.rapids.tpu.admission.maxQueueDepth": "64",
+        "spark.rapids.tpu.resilience.backoffBaseMs": "0",
+    }
+    TpuSession(conf)                   # installs the tier + scheduler
+    tier = peek_serving()
+
+    def q(s, n_limit):
+        sales = _df(s, {k: ss[k] for k in ("date_sk", "store_sk",
+                                           "ext_sales")},
+                    [T.INT, T.INT, T.LONG])
+        dates = _df(s, dd, [T.INT, T.INT, T.INT, T.INT])
+        return sales.join(dates, on="date_sk", how="inner") \
+            .group_by("store_sk").agg(sum_("ext_sales", "s")) \
+            .limit(n_limit)
+
+    # warm phase: one canonical collect per tenant compiles the shape
+    # and seeds a result fragment for the warm-repeat pin below
+    for tenant in ("light", "heavy"):
+        sess = tier.session(tenant)
+        sess.collect(q(sess.spark, 10))
+
+    walls = {"light": [], "heavy": []}
+    sheds = {"light": 0, "heavy": 0}
+    submitted = {"light": 0, "heavy": 0}
+    lock = threading.Lock()
+    snap = PC.snapshot()
+    t0 = time.perf_counter()
+
+    def worker(idx: int, tenant: str):
+        sess = tier.session(tenant)
+        for it in range(rounds):
+            # a unique limit literal per iteration -> a unique result
+            # key -> real execution (no cache short-circuit in the
+            # timed phase)
+            n = 11 + idx * 1000 + it
+            try:
+                sess.collect(q(sess.spark, n))
+            except QueryRejected as e:
+                with lock:
+                    sheds[tenant] += 1
+                    submitted[tenant] += 1
+                time.sleep(min((e.retry_after_ms or 0) / 1000.0, 0.25))
+                continue
+            st = last_query_stats() or {}
+            with lock:
+                submitted[tenant] += 1
+                walls[tenant].append(st.get("wall_ns", 0))
+
+    threads = [threading.Thread(
+        target=worker, args=(i, "light" if i < 2 else "heavy"))
+        for i in range(n_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+    d = PC.since(snap)
+
+    # warm-repeat pin: the canonical query repeats from the result
+    # cache — zero fresh compiles, one hit per tenant
+    snap_warm = PC.snapshot()
+    for tenant in ("light", "heavy"):
+        sess = tier.session(tenant)
+        sess.collect(q(sess.spark, 10))
+    d_warm = PC.since(snap_warm)
+
+    # cross-tenant leak probes: each count here is a hard isolation
+    # break (the gate pins the column at 0)
+    cross_tenant_leaks = 0
+    light, heavy = tier.session("light"), tier.session("heavy")
+    light.create_temp_view("bench_probe", q(light.spark, 10))
+    try:
+        heavy.view("bench_probe")
+        cross_tenant_leaks += 1        # saw another tenant's view
+    except KeyError:
+        pass
+    light.set_conf("spark.rapids.tpu.telemetry.slo.targetP95Ms", "1234")
+    if heavy.get_conf(
+            "spark.rapids.tpu.telemetry.slo.targetP95Ms") == "1234":
+        cross_tenant_leaks += 1        # saw another tenant's conf
+    # an identical plan under the other tenant must MISS the cache
+    # (limit=9 appears nowhere else: the timed phase starts at 11, the
+    # warm phase used 10 — neither tenant can hit its OWN fragment)
+    snap_x = PC.snapshot()
+    heavy.collect(q(heavy.spark, 9))   # heavy caches limit=9
+    light.collect(q(light.spark, 9))   # light's twin must miss
+    if PC.since(snap_x)["result_cache_hits"] > 0:
+        cross_tenant_leaks += 1        # shared a result fragment
+
+    tier.close_session("light")
+    tier.close_session("heavy")
+    leaks = list(leak_report_all())
+    shutdown_serving()
+    shutdown_governor()
+    reset_admission()
+    from spark_rapids_tpu.compilecache.aot import quiesce_aot
+
+    quiesce_aot(60.0)
+
+    def pct(xs, p):
+        xs = sorted(xs) or [0]
+        return round(xs[min(int(len(xs) * p), len(xs) - 1)] / 1e6, 3)
+
+    n_queries = sum(len(v) for v in walls.values())
+    n_submitted = sum(submitted.values())
+    out = {
+        "metric": "serving", "unit": "ms",
+        "workers": n_workers, "rounds": rounds, "rows": rows,
+        "wall_s": round(wall_s, 3),
+        "queries": n_queries,
+        "qps": round(n_queries / wall_s, 2) if wall_s else 0.0,
+        "tenants": {t: {
+            "queries": len(walls[t]),
+            "latency_ms": {"p50": pct(walls[t], 0.5),
+                           "p95": pct(walls[t], 0.95)},
+            "sheds": sheds[t],
+        } for t in ("light", "heavy")},
+        "shed_rate": round(sum(sheds.values()) / n_submitted, 4)
+        if n_submitted else 0.0,
+        "cross_tenant_leaks": cross_tenant_leaks + len(leaks),
+        "leaks": leaks[:10],
+        "warm_repeat": {
+            "result_cache_hits": d_warm["result_cache_hits"],
+            "compiles": d_warm["compiles"],
+        },
+        "counters": {k: d[k] for k in (
+            "queries_admitted", "queries_rejected", "fair_share_admissions",
+            "tenant_sheds", "tenant_preempts", "result_cache_hits",
+            "result_cache_misses")},
+    }
+    print(json.dumps(out))
+    return out
+
+
 def measure_progress_overhead(rows: int = 100_000,
                               repeats: int = 5) -> dict:
     """``progressOverhead`` (ISSUE 12 satellite): the wall cost of the
@@ -727,6 +908,17 @@ def main():
         out = run_concurrency(
             n_workers,
             rounds=int(os.environ.get("BENCH_CONC_ROUNDS", 3)),
+            rows=int(os.environ.get("BENCH_CONC_ROWS", 200_000)))
+        return run_gate(out)
+    # --serving N (ISSUE 19 satellite): the mixed-tenant serving
+    # benchmark — shed-rate / per-tenant-p95 / cross-tenant-leak
+    # columns, gated like the concurrency payload
+    if "--serving" in sys.argv:
+        idx = sys.argv.index("--serving")
+        n_workers = int(sys.argv[idx + 1]) if idx + 1 < len(sys.argv) else 6
+        out = run_serving(
+            n_workers,
+            rounds=int(os.environ.get("BENCH_CONC_ROUNDS", 4)),
             rows=int(os.environ.get("BENCH_CONC_ROWS", 200_000)))
         return run_gate(out)
     n = int(os.environ.get("BENCH_ROWS", 20_000_000))

@@ -127,14 +127,33 @@ class LedgerRegistry:
                       persistent: bool = False) -> None:
         n = int(nbytes)
         with self._lock:
-            b = self._bill_locked(qid)
-            b._integrate_locked()
-            b.charged += n
-            b.now += n
-            if b.now > b.peak:
-                b.peak = b.now
-            if persistent:
-                b.persistent_now += n
+            key = qid if qid is not None else UNOWNED
+            fin = self._finished.get(key) \
+                if key not in self._bills else None
+            if fin is not None:
+                # late charge against an already-settled bill (ISSUE 19:
+                # a serving result fragment is inserted after its
+                # producing query's lifecycle exited — the owner still
+                # pays): mirror of the late-release path below
+                fin["device_bytes_charged"] += n
+                fin["device_bytes_now"] += n
+                if persistent:
+                    fin["persistent_bytes"] += n
+                fin["residual_bytes"] = fin["device_bytes_now"] \
+                    - fin["persistent_bytes"]
+                if fin["residual_bytes"]:
+                    self._residuals[key] = fin["residual_bytes"]
+                elif key in self._residuals:
+                    del self._residuals[key]
+            else:
+                b = self._bill_locked(qid)
+                b._integrate_locked()
+                b.charged += n
+                b.now += n
+                if b.now > b.peak:
+                    b.peak = b.now
+                if persistent:
+                    b.persistent_now += n
         PC.bump("acct_device_bytes_charged", n)
 
     def release_device(self, qid: Optional[str], nbytes: int,

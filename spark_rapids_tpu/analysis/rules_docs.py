@@ -647,6 +647,59 @@ def doc_drift_problems(repo_root: str) -> List[str]:
         if "accounting.md" not in md:
             problems.append(
                 f"docs/{name} does not cross-link docs/accounting.md")
+
+    # multi-tenant serving tier (ISSUE 19): confs + counters + the
+    # sampler gauges + the session/fair-share/result-cache/warm-start
+    # surface vocabulary must be documented in docs/serving.md (confs
+    # in configs.md, counters ALSO in diagnostics.md via the global
+    # check), and the docs the tier composes over must cross-link it
+    srv_md = read("serving.md")
+    srv_confs = [k for k in _REGISTRY
+                 if k.startswith("spark.rapids.tpu.serving.")]
+    if not srv_confs:
+        problems.append("no spark.rapids.tpu.serving.* confs registered")
+    for key in sorted(srv_confs):
+        if f"`{key}`" not in srv_md:
+            problems.append(
+                f"conf '{key}' is not documented in docs/serving.md")
+        if f"`{key}`" not in configs_md:
+            problems.append(
+                f"conf '{key}' missing from docs/configs.md — re-run "
+                f"python docs/gen_docs.py")
+    for key in ("serving_sessions_opened", "serving_sessions_closed",
+                "fair_share_admissions", "result_cache_hits",
+                "result_cache_misses", "result_cache_evictions",
+                "tenant_sheds", "tenant_preempts"):
+        if key not in PC.COUNTERS:
+            problems.append(f"serving counter '{key}' is not "
+                            f"registered in perfcounters.COUNTERS")
+        if f"`{key}`" not in srv_md:
+            problems.append(
+                f"serving counter '{key}' is not documented in "
+                f"docs/serving.md")
+    for gauge in ("serving_tenants_active", "serving_queue_depth",
+                  "serving_running", "result_cache_entries",
+                  "result_cache_bytes"):
+        if f"`{gauge}`" not in srv_md:
+            problems.append(
+                f"serving sampler gauge '{gauge}' is not documented "
+                f"in docs/serving.md")
+    for word in ("fair-share", "`retry_after_ms`", "`QueryRejected`",
+                 "`tenant=<name>`", "`drop_tenant`", "warm_cache.py",
+                 "`--serve`", "`--serving`", "`--trace`",
+                 "work-conserving", "half-life",
+                 "`result_plan_key`", "`shutdown_serving()`",
+                 "starved", "bench_gate", "`close()`"):
+        if word not in srv_md:
+            problems.append(
+                f"serving surface vocabulary {word} is not documented "
+                f"in docs/serving.md")
+    for name, md in (("concurrency.md", conc_md),
+                     ("overload.md", ovl_md),
+                     ("observability.md", obs_md)):
+        if "serving.md" not in md:
+            problems.append(
+                f"docs/{name} does not cross-link docs/serving.md")
     return problems
 
 

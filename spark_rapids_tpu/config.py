@@ -304,6 +304,63 @@ GOVERNOR_BACKLOG_TARGET_MS = conf(
     "disables the predicted-wall component (the memory/queue/latency "
     "signals still drive the state machine).").long_conf(0)
 
+# --- multi-tenant serving tier (ISSUE 19) ----------------------------------
+
+SERVING_ENABLED = conf("spark.rapids.tpu.serving.enabled").doc(
+    "Enable the multi-tenant serving tier (serving/): named tenant "
+    "sessions with hard-isolated conf / temp views / cache handles / "
+    "result fragments, a weighted fair-share scheduler replacing the "
+    "FIFO admission order, tenant-aware governor shed/preempt "
+    "decisions, and a per-tenant result-fragment cache.  Disabled (the "
+    "default): one ambient check per site, zero serving-module calls."
+).boolean_conf(False)
+
+SERVING_TENANT = conf("spark.rapids.tpu.serving.tenant").doc(
+    "Tenant identity of queries run under this conf.  Serving sessions "
+    "set it automatically; it rides the QueryContext so admission "
+    "fair-share, per-tenant SLO series, and governor shed/preempt "
+    "decisions all attribute the query to its tenant.  Empty = "
+    "untenanted (weight 1, no quota).").string_conf("")
+
+SERVING_WEIGHTS = conf("spark.rapids.tpu.serving.weights").doc(
+    "Per-tenant fair-share weights as 'tenantA:4,tenantB:1'.  The "
+    "scheduler admits the eligible waiter with the lowest "
+    "usage/weight — a tenant with weight 4 earns 4x the admission "
+    "throughput of a weight-1 tenant under contention.  Unlisted "
+    "tenants get weight 1.").string_conf("")
+
+SERVING_QUOTAS = conf("spark.rapids.tpu.serving.quotas").doc(
+    "Per-tenant concurrent-running quotas as 'tenantA:2,tenantB:1'.  A "
+    "tenant at its quota is ineligible for the next admission slot "
+    "while any under-quota tenant waits (work-conserving: with only "
+    "over-quota waiters the slot is still granted).  Under RED "
+    "pressure the governor sheds over-quota tenants' queries first.  "
+    "Unlisted tenants are unbounded.").string_conf("")
+
+SERVING_USAGE_HALFLIFE_S = conf(
+    "spark.rapids.tpu.serving.usageHalflifeS").doc(
+    "Half-life of the per-tenant fair-share usage EWMA: charged usage "
+    "(admissions + query wall seconds) decays by half every this many "
+    "seconds, so an idle tenant's past consumption fades and it "
+    "re-approaches its full share instead of being punished forever."
+).double_conf(30.0)
+
+SERVING_RESULT_CACHE_ENABLED = conf(
+    "spark.rapids.tpu.serving.resultCache.enabled").doc(
+    "Cache collected result rows per (plan signature, conf "
+    "fingerprint, tenant) inside serving sessions — a repeated "
+    "dashboard query returns without planning, compiling, or touching "
+    "the device.  Entries are charged to the owning query's resource "
+    "bill, scoped to (and dropped with) the owning tenant session, "
+    "and evicted by the governor's RED ladder.").boolean_conf(True)
+
+SERVING_RESULT_CACHE_MAX_BYTES = conf(
+    "spark.rapids.tpu.serving.resultCache.maxBytes").doc(
+    "LRU bound on estimated host bytes held by the serving "
+    "result-fragment cache across all tenants; inserting past it "
+    "evicts least-recently-used fragments first."
+).long_conf(64 * 1024 * 1024)
+
 # --- distributed cross-host execution tier (ISSUE 14) ----------------------
 
 DISTRIBUTED_ENABLED = conf("spark.rapids.tpu.distributed.enabled").doc(

@@ -36,6 +36,7 @@ cycle.
 """
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from typing import Dict, Optional, Tuple
@@ -257,6 +258,20 @@ class OverloadGovernor:
             # eviction failure must not break the pressure update)
             except Exception:
                 pass
+        # serving result-fragment cache (ISSUE 19): same RED ladder,
+        # same fraction.  sys.modules peek, not an import — a process
+        # that never enabled serving must make zero serving-module calls
+        srv = sys.modules.get("spark_rapids_tpu.serving.context")
+        rc = getattr(srv, "RESULT_CACHE", None) if srv is not None else None
+        if rc is not None and self._evict_fraction > 0:
+            try:
+                keep = int(rc.stats()["bytes"]
+                           * (1.0 - self._evict_fraction))
+                rc.evict_to_bytes(keep)
+            # tpulint: disable=cancel-swallow (best-effort ballast drop;
+            # eviction failure must not break the pressure update)
+            except Exception:
+                pass
         self.request_preempt()
 
     # -- degradation: batch goals / budgets (YELLOW and up) --------------
@@ -290,14 +305,36 @@ class OverloadGovernor:
 
     # -- RED: deadline-aware admission shedding --------------------------
     def shed_admission(self, ctx, running: int, limit: int,
-                       queued: int) -> Optional[int]:
+                       queued: int,
+                       running_by: Optional[dict] = None) -> Optional[int]:
         """Consulted by the admission gate for a query about to queue:
         returns the ``retry_after_ms`` hint when the query should be
         shed (RED, carries a deadline, and predicted wall + predicted
         queue wait cannot meet it), else None (queue normally).  Never
-        sheds deadline-less queries — they can afford to wait."""
+        sheds deadline-less queries — they can afford to wait.
+
+        ISSUE 19: with the serving tier's fair-share scheduler
+        installed the decision is tenant-aware FIRST — the most-starved
+        tenant's queries are never shed (not even by the deadline
+        predictor), and a tenant at/over its running quota sheds
+        immediately, deadline or not (``running_by`` is the admission
+        gate's per-tenant running snapshot)."""
         if self.maybe_update() != RED:
             return None
+        from spark_rapids_tpu.lifecycle import admission as _adm
+
+        sched = _adm.SCHEDULER
+        tenant = getattr(ctx, "tenant", "") or ""
+        if sched is not None and tenant:
+            by = running_by or {}
+            decision = sched.shed_decision(tenant, by, by.keys())
+            if decision == "never":
+                return None
+            if decision == "shed":
+                from spark_rapids_tpu import perfcounters as PC
+
+                PC.bump("tenant_sheds")
+                return self.retry_after_ms(queued, limit)
         if ctx.deadline_ns is None:
             return None
         remaining_ms = (ctx.deadline_ns - time.monotonic_ns()) / 1e6
@@ -371,11 +408,27 @@ class OverloadGovernor:
                  if c.query_id != exclude_qid and not c.token.cancelled]
         if not cands:
             return False
-        target = max(cands, key=lambda c: c.admission_seq)
+        from spark_rapids_tpu.lifecycle import admission as _adm
+
+        sched = _adm.SCHEDULER
+        if sched is not None:
+            # tenant-aware (ISSUE 19): pause the MOST OVER-SHARE
+            # tenant's query (highest normalized usage; admission order
+            # breaks ties toward the newest) — the fair-share twin of
+            # "shed the over-quota tenant first"
+            target = max(cands, key=lambda c: (
+                sched.normalized_usage(getattr(c, "tenant", "") or ""),
+                c.admission_seq))
+        else:
+            target = max(cands, key=lambda c: c.admission_seq)
         with self._lock:
             if self._pausing_qid == target.query_id:
                 return True          # already pausing
             self._preempt_qid = target.query_id
+        if sched is not None:
+            from spark_rapids_tpu import perfcounters as PC
+
+            PC.bump("tenant_preempts")
         return True
 
     def preempt_for_oom(self, exclude_qid: Optional[str] = None) -> bool:

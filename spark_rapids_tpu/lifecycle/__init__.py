@@ -91,6 +91,11 @@ class query_lifecycle:
             return None
         period_s = max(float(conf.get(QUERY_WATCHDOG_PERIOD_MS)), 1.0) / 1000.0
         ctx = QueryContext(watchdog_period_s=period_s)
+        # multi-tenant serving (ISSUE 19): stamp the owning tenant from
+        # the session conf — a plain conf read, no serving-module call
+        from spark_rapids_tpu.config import SERVING_TENANT
+
+        ctx.tenant = str(conf.get(SERVING_TENANT) or "")
         # deadline armed and watchdog registered BEFORE the admission
         # wait: a query stuck in the queue must be deadline-trippable and
         # visible to active_queries() cancel tooling (the acquire loop
@@ -181,8 +186,15 @@ class query_lifecycle:
                     pass
         finally:
             if self._ctl is not None:
-                self._ctl.release()
+                self._ctl.release(ctx.tenant)
             wall_ns = time.monotonic_ns() - ctx.started_ns
+            # fair-share usage feedback (ISSUE 19): charge the tenant's
+            # consumed wall so long-running queries weigh against its
+            # share (one module-attribute check; None when serving off)
+            from spark_rapids_tpu.lifecycle import admission as _adm
+
+            if _adm.SCHEDULER is not None:
+                _adm.SCHEDULER.note_query_end(ctx.tenant, wall_ns)
             # overload governor (ISSUE 13): feed the wall EWMA the shed
             # predictor falls back on, and clear this query's
             # predicted-wall backlog entry (one ambient check)
@@ -299,6 +311,16 @@ def leak_report_all() -> List[str]:
 
     if _acct.LEDGERS is not None:
         out.extend(_acct.LEDGERS.leak_report())
+    # 7. serving-tier hygiene (ISSUE 19): unclosed tenant sessions and
+    #    result-cache fragments that outlived their session — a
+    #    sys.modules peek, so a process that never enabled serving
+    #    makes zero serving-module calls (the cProfile-pinned
+    #    disabled-path contract)
+    import sys as _sys
+
+    srv = _sys.modules.get("spark_rapids_tpu.serving")
+    if srv is not None:
+        out.extend(srv.leak_report())
     return out
 
 
@@ -351,6 +373,19 @@ def reset_leaked_state() -> None:
     from spark_rapids_tpu.lifecycle import journal as _journal
 
     _journal.reset_journal(purge=True)
+    # serving tier (ISSUE 19): tear down leaked tenant sessions so one
+    # test's unclosed session cannot hold cached batches, temp views,
+    # or result fragments across the rest of the run
+    import sys as _sys
+
+    srv = _sys.modules.get("spark_rapids_tpu.serving")
+    if srv is not None and srv.peek_serving() is not None:
+        try:
+            srv.shutdown_serving()
+        # tpulint: disable=cancel-swallow (leaked-state recovery in
+        # tests; no query is running when this sweeps)
+        except Exception:
+            pass
 
 
 __all__ = [

@@ -1,4 +1,4 @@
-"""Admission control — a FIFO gate on concurrently-running queries.
+"""Admission control — a gate on concurrently-running queries.
 
 Reference analog: GpuSemaphore bounds how many *tasks* touch the device
 (SURVEY.md §2.3); Theseus (arXiv:2508.05029) argues accelerator query
@@ -6,9 +6,19 @@ engines must additionally bound how many *queries* hold planning state
 and device memory at once, because N queries each spilling the others'
 working set livelocks the pool.  ``spark.rapids.tpu.concurrentQueries``
 admits at most L queries; up to ``admission.maxQueueDepth`` more wait in
-FIFO order, and anything beyond that fast-rejects with
+the queue, and anything beyond that fast-rejects with
 :class:`QueryRejected` — shedding load at the door beats collapsing the
 whole process.
+
+Ordering is FIFO by default.  When the multi-tenant serving tier
+(ISSUE 19) is active it installs a weighted fair-share policy into the
+module-level :data:`SCHEDULER` slot, and the next free slot goes to the
+eligible waiter whose tenant has the lowest normalized usage
+(usage/weight) instead of the queue head — one module-attribute check
+per wait iteration, zero cost while serving is off.  Usage is charged
+only on ADMISSION (and query wall at lifecycle exit), never for time
+spent waiting, so a rejected or timed-out query costs its tenant's
+fair share nothing.
 
 Waiters poll in short slices so a tripped CancelToken (user cancel or
 watchdog deadline) aborts the wait within ~50ms.
@@ -18,7 +28,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 from spark_rapids_tpu.lifecycle.context import (
     QueryContext,
@@ -27,6 +37,22 @@ from spark_rapids_tpu.lifecycle.context import (
 
 _POLL_S = 0.05
 
+# ISSUE 19: the fair-share policy slot.  None (the default) keeps plain
+# FIFO ordering; serving.ensure_serving installs a FairShareScheduler
+# and shutdown_serving clears it.  Read as ONE module attribute on the
+# admission paths — the disabled-path contract.
+SCHEDULER = None
+
+
+class _Ticket:
+    """One queued waiter: its tenant (fair-share key) and FIFO arrival
+    order (the tie-break)."""
+
+    __slots__ = ("tenant",)
+
+    def __init__(self, tenant: str):
+        self.tenant = tenant
+
 
 class AdmissionController:
     def __init__(self, limit: int, max_queue: int):
@@ -34,18 +60,46 @@ class AdmissionController:
         self.max_queue = max(0, int(max_queue))
         self._cond = threading.Condition()
         self._running = 0
-        self._waiters: "deque" = deque()   # ticket objects, FIFO
+        self._waiters: "deque[_Ticket]" = deque()   # FIFO arrival order
+        self._running_by: Dict[str, int] = {}       # tenant -> running
 
     # -- introspection ---------------------------------------------------
     def stats(self) -> dict:
+        """Depth/running plus the per-tenant breakdown the telemetry
+        sampler and serving tier read (ISSUE 19 satellite)."""
         with self._cond:
+            queued_by: Dict[str, int] = {}
+            for t in self._waiters:
+                queued_by[t.tenant] = queued_by.get(t.tenant, 0) + 1
+            tenants = {
+                name: {"running": self._running_by.get(name, 0),
+                       "queued": queued_by.get(name, 0)}
+                for name in set(self._running_by) | set(queued_by)
+            }
             return {"running": self._running, "queued": len(self._waiters),
-                    "limit": self.limit, "max_queue": self.max_queue}
+                    "limit": self.limit, "max_queue": self.max_queue,
+                    "tenants": tenants}
+
+    # -- internals (caller holds self._cond) -----------------------------
+    def _admit_locked(self, tenant: str, sched) -> None:
+        self._running += 1
+        self._running_by[tenant] = self._running_by.get(tenant, 0) + 1
+        if sched is not None:
+            sched.on_admit(tenant)
+
+    def _next_locked(self, sched) -> Optional[_Ticket]:
+        """The waiter the next free slot belongs to: queue head under
+        FIFO, the fair-share pick when a scheduler is installed."""
+        if not self._waiters:
+            return None
+        if sched is None:
+            return self._waiters[0]
+        return sched.select(self._waiters, self._running_by)
 
     # -- the gate --------------------------------------------------------
     def acquire(self, ctx: QueryContext,
                 timeout_ms: int = 0) -> int:
-        """Admit ``ctx`` (FIFO), returning the queue-wait in ns.  Raises
+        """Admit ``ctx``, returning the queue-wait in ns.  Raises
         :class:`QueryRejected` immediately when the wait queue is full,
         or after ``timeout_ms`` (0 = wait indefinitely); raises the
         token's exception if the query is cancelled while queued."""
@@ -53,11 +107,17 @@ class AdmissionController:
 
         from spark_rapids_tpu.governor import context as _GOV
 
+        tenant = getattr(ctx, "tenant", "") or ""
         t0 = time.perf_counter_ns()
         with self._cond:
-            if self._running < self.limit and not self._waiters:
-                self._running += 1
+            sched = SCHEDULER
+            if (self._running < self.limit and not self._waiters
+                    and (sched is None
+                         or sched.admissible(tenant, self._running_by))):
+                self._admit_locked(tenant, sched)
                 PC.bump("queries_admitted")
+                if sched is not None:
+                    PC.bump("fair_share_admissions")
                 return 0
             gov = _GOV.GOVERNOR
             depth = len(self._waiters)
@@ -76,9 +136,13 @@ class AdmissionController:
                 # overload governor (ISSUE 13): under RED, a query whose
                 # deadline cannot survive predicted wall + predicted
                 # queue wait is shed HERE — before it pins a queue slot
-                # it can only convert into a deadline cascade
+                # it can only convert into a deadline cascade.  ISSUE 19
+                # makes the decision tenant-aware (the per-tenant running
+                # counts ride along; a copy, so the governor never
+                # touches controller state)
                 retry_ms = gov.shed_admission(
-                    ctx, self._running, self.limit, depth)
+                    ctx, self._running, self.limit, depth,
+                    running_by=dict(self._running_by))
                 if retry_ms is not None:
                     PC.bump("queries_shed")
                     PC.bump("queries_rejected")
@@ -91,13 +155,13 @@ class AdmissionController:
                         queue_depth=depth,
                         retry_after_ms=retry_ms,
                         pressure_state=gov.state)
-            ticket = object()
+            ticket = _Ticket(tenant)
             self._waiters.append(ticket)
             deadline = (None if timeout_ms <= 0
                         else time.monotonic() + timeout_ms / 1000.0)
             try:
                 while not (self._running < self.limit
-                           and self._waiters[0] is ticket):
+                           and self._next_locked(SCHEDULER) is ticket):
                     ctx.token.check()
                     if deadline is not None and time.monotonic() >= deadline:
                         PC.bump("queries_rejected")
@@ -113,8 +177,11 @@ class AdmissionController:
                             pressure_state=(gov.state if gov is not None
                                             else ""))
                     self._cond.wait(_POLL_S)
-                self._waiters.popleft()
-                self._running += 1
+                self._waiters.remove(ticket)
+                sched = SCHEDULER
+                self._admit_locked(tenant, sched)
+                if sched is not None:
+                    PC.bump("fair_share_admissions")
             except BaseException:
                 try:
                     self._waiters.remove(ticket)
@@ -122,7 +189,7 @@ class AdmissionController:
                     pass
                 self._cond.notify_all()
                 raise
-            # the head moved: the next waiter (or a free slot) may now
+            # the pick moved: the next waiter (or a free slot) may now
             # be eligible
             self._cond.notify_all()
         wait_ns = time.perf_counter_ns() - t0
@@ -130,9 +197,14 @@ class AdmissionController:
         PC.bump("admission_wait_ns", wait_ns)
         return wait_ns
 
-    def release(self) -> None:
+    def release(self, tenant: str = "") -> None:
         with self._cond:
             self._running = max(0, self._running - 1)
+            n = self._running_by.get(tenant, 0) - 1
+            if n > 0:
+                self._running_by[tenant] = n
+            else:
+                self._running_by.pop(tenant, None)
             self._cond.notify_all()
 
 

@@ -132,7 +132,7 @@ class QueryContext:
     __slots__ = ("query_id", "trace_id", "token", "admission_seq",
                  "admission_wait_ns",
                  "deadline_ns", "watchdog_period_s", "started_ns",
-                 "owner_thread", "cleanup_hooks")
+                 "owner_thread", "cleanup_hooks", "tenant")
 
     def __init__(self, watchdog_period_s: float = 0.05):
         n = next(_QUERY_SEQ)
@@ -149,6 +149,11 @@ class QueryContext:
         self.watchdog_period_s = watchdog_period_s
         self.started_ns = time.monotonic_ns()
         self.owner_thread = threading.get_ident()
+        # multi-tenant serving (ISSUE 19): the owning tenant, from
+        # spark.rapids.tpu.serving.tenant at lifecycle entry.  "" =
+        # untenanted; fair-share admission, per-tenant SLO series, and
+        # tenant-aware governor shed/preempt all key on it
+        self.tenant = ""
         # idempotent callables run by lifecycle._cleanup_query when the
         # query's exec tree unwinds (success, error, or cancel trip) —
         # e.g. the writer's staging-dir abort (ISSUE 5): a killed

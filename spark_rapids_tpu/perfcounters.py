@@ -190,6 +190,19 @@ COUNTERS: Dict[str, int] = {
     "acct_bytes_restored": 0,
     "bills_settled": 0,
     "perf_regressions_flagged": 0,
+    # multi-tenant serving tier (ISSUE 19, serving/): fair-share
+    # admissions granted by the weighted scheduler (vs plain FIFO),
+    # result-fragment cache traffic, tenant-aware governor actions
+    # (sheds targeting an over-quota tenant, preemptions targeting the
+    # most over-share runner), and serving-session lifecycle
+    "fair_share_admissions": 0,
+    "serving_sessions_opened": 0,
+    "serving_sessions_closed": 0,
+    "result_cache_hits": 0,
+    "result_cache_misses": 0,
+    "result_cache_evictions": 0,
+    "tenant_sheds": 0,
+    "tenant_preempts": 0,
 }
 
 

@@ -1,0 +1,344 @@
+"""Multi-tenant serving tier (ISSUE 19 tentpole).
+
+Composes the primitives the repo already has — admission + deadlines
+(lifecycle/), SLO histograms (telemetry/), the overload governor
+(governor/), resource bills (accounting/) — into a long-running
+SERVICE: named tenant sessions with hard isolation, weighted fair-share
+scheduling over the admission queue, tenant-aware shed/preempt, and a
+per-tenant result-fragment cache.  "Accelerating Presto with GPUs"
+(arXiv:2606.24647) is exactly this serving shape; Theseus
+(arXiv:2508.05029) argues the scheduler layer is where accelerated SQL
+platforms win or lose.
+
+  * context.py      — the ambient TIER / RESULT_CACHE slots (one
+                      module-attribute read per instrumented site).
+  * fair_share.py   — FairShareScheduler: decaying per-tenant usage
+                      accounts, weights, quotas, and the selection /
+                      shed / preempt policies.
+  * result_cache.py — ResultFragmentCache: plan-signature-keyed
+                      collected rows, per-tenant scoped, bill-charged,
+                      on the governor's RED eviction ladder.
+
+Isolation contract (the pinned zero-cross-tenant-leak test): a tenant
+session OWNS its conf (its own TpuSession/TpuConf — a set_conf never
+leaks), its temp views (a plain per-session registry), its df.cache()
+handles (tracked; unpersisted at close), and its result fragments
+(tenant-stamped; dropped at close).  Cross-tenant visibility of any of
+those is a bug, and an unclosed session or an orphaned fragment fails
+the owning test through the conftest leak gate.
+
+Disabled path: ``spark.rapids.tpu.serving.enabled`` defaults false;
+nothing imports this package and no serving-module call is made
+(cProfile-pinned).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.serving import context as _ctx
+from spark_rapids_tpu.serving.fair_share import (
+    FairShareScheduler,
+    parse_tenant_map,
+)
+from spark_rapids_tpu.serving.result_cache import ResultFragmentCache
+
+_LOCK = threading.Lock()
+
+
+class ServingSession:
+    """One named tenant's isolated handle on the engine.
+
+    Wraps a private ``TpuSession`` whose conf carries
+    ``spark.rapids.tpu.serving.tenant=<name>`` — every collect's
+    QueryContext, fair-share charge, SLO series, and governor decision
+    attributes to this tenant.  Never shares conf, temp views, cache
+    handles, or result fragments with any other session."""
+
+    def __init__(self, tier: "ServingTier", tenant: str,
+                 conf_overrides: Optional[dict] = None):
+        from spark_rapids_tpu.session import TpuSession
+
+        self.tenant = tenant
+        self.closed = False
+        self._tier = tier
+        settings = dict(tier.base_settings)
+        settings.update(conf_overrides or {})
+        settings["spark.rapids.tpu.serving.tenant"] = tenant
+        self._spark = TpuSession(settings)
+        self._views: Dict[str, object] = {}
+        self._cached: List[object] = []
+
+    # -- the wrapped engine ----------------------------------------------
+    @property
+    def spark(self):
+        """The underlying TpuSession (createDataFrame / read / conf)."""
+        self._check_open()
+        return self._spark
+
+    def _check_open(self) -> None:
+        if self.closed:
+            raise RuntimeError(
+                f"serving session '{self.tenant}' is closed")
+
+    def set_conf(self, key: str, value) -> "ServingSession":
+        """Session-scoped conf — lands on this tenant's private
+        TpuSession only."""
+        self._check_open()
+        self._spark.set_conf(key, value)
+        return self
+
+    def get_conf(self, key: str) -> Optional[str]:
+        self._check_open()
+        return self._spark.conf.settings.get(key)
+
+    # -- temp views (per-session registry; no cross-tenant lookup) -------
+    def create_temp_view(self, name: str, df) -> None:
+        self._check_open()
+        self._views[name] = df
+
+    def view(self, name: str):
+        self._check_open()
+        try:
+            return self._views[name]
+        except KeyError:
+            raise KeyError(
+                f"temp view '{name}' not found in serving session "
+                f"'{self.tenant}' (views are session-scoped; another "
+                f"tenant's views are never visible)") from None
+
+    def temp_views(self) -> List[str]:
+        self._check_open()
+        return sorted(self._views)
+
+    def drop_temp_view(self, name: str) -> bool:
+        self._check_open()
+        return self._views.pop(name, None) is not None
+
+    # -- tracked df.cache() handles --------------------------------------
+    def cache(self, df):
+        """``df.cache()`` tracked by this session so close() releases
+        the device batches even if the caller forgot unpersist()."""
+        self._check_open()
+        cached = df.cache()
+        self._cached.append(cached)
+        return cached
+
+    # -- the serving collect (result-fragment cache) ---------------------
+    def _result_key(self, df) -> Optional[str]:
+        """fingerprint(value-level plan identity, session conf,
+        tenant), or None when the plan refuses a stable key — shaky
+        ground is never cached (the hot-cache scan_key discipline).
+        ``result_plan_key`` (not the telemetry plan *signature*, which
+        is node names only) so two queries differing in a literal or
+        in their leaf data never share a fragment."""
+        from spark_rapids_tpu.compilecache.keys import fingerprint
+        from spark_rapids_tpu.serving.result_cache import result_plan_key
+
+        try:
+            root, _meta = df._planned()
+        # tpulint: disable=cancel-swallow (planning probe: an unplannable
+        # frame falls through to the normal collect path, which raises
+        # the real error with full context)
+        except Exception:
+            return None
+        parts = result_plan_key(root)
+        if parts is None:
+            return None
+        conf_items = tuple(sorted(
+            (str(k), str(v)) for k, v in df.session.conf.settings.items()))
+        return fingerprint("serving-result", parts, conf_items, self.tenant)
+
+    def collect(self, df) -> List[tuple]:
+        """``df.collect()`` through the result-fragment cache: a repeat
+        of a cached (plan, conf) returns the stored rows — no admission
+        slot, no compile, no device work — and a miss stores the rows
+        charged to the producing query's bill."""
+        self._check_open()
+        rc = _ctx.RESULT_CACHE
+        key = self._result_key(df) if rc is not None else None
+        if key is not None:
+            rows = rc.get(key, self.tenant)
+            if rows is not None:
+                return list(rows)
+        out = df.collect()
+        if key is not None:
+            from spark_rapids_tpu.lifecycle import last_query_stats
+
+            stats = last_query_stats()
+            owner = stats.get("query_id") if stats else None
+            rc.put(key, self.tenant, out, owner)
+        return out
+
+    # -- teardown --------------------------------------------------------
+    def close(self) -> None:
+        """Release everything the session owns: cached device batches,
+        temp views, and this tenant's result fragments.  Idempotent."""
+        if self.closed:
+            return
+        self.closed = True
+        while self._cached:
+            df = self._cached.pop()
+            try:
+                df.unpersist()
+            # tpulint: disable=cancel-swallow (session teardown: a
+            # handle already closed by query cleanup is not an error)
+            except Exception:
+                pass
+        self._views.clear()
+        rc = _ctx.RESULT_CACHE
+        if rc is not None:
+            rc.drop_tenant(self.tenant)
+        from spark_rapids_tpu import perfcounters as PC
+
+        PC.bump("serving_sessions_closed")
+
+
+class ServingTier:
+    """The process-wide serving tier: the session registry, the
+    fair-share scheduler, and the result-fragment cache."""
+
+    def __init__(self, conf):
+        from spark_rapids_tpu.config import (
+            SERVING_QUOTAS,
+            SERVING_USAGE_HALFLIFE_S,
+            SERVING_WEIGHTS,
+        )
+
+        self.base_settings = dict(conf.settings)
+        self.scheduler = FairShareScheduler(
+            weights=parse_tenant_map(str(conf.get(SERVING_WEIGHTS) or "")),
+            quotas=parse_tenant_map(str(conf.get(SERVING_QUOTAS) or "")),
+            halflife_s=float(conf.get(SERVING_USAGE_HALFLIFE_S)))
+        self._lock = threading.Lock()
+        self._sessions: Dict[str, ServingSession] = {}
+
+    # -- sessions --------------------------------------------------------
+    def session(self, tenant: str,
+                conf_overrides: Optional[dict] = None) -> ServingSession:
+        """The tenant's open session, created on first use (named
+        sessions: one live session per tenant name)."""
+        if not tenant:
+            raise ValueError("serving sessions require a tenant name")
+        from spark_rapids_tpu import perfcounters as PC
+
+        with self._lock:
+            s = self._sessions.get(tenant)
+            if s is not None and not s.closed:
+                return s
+            s = ServingSession(self, tenant, conf_overrides)
+            self._sessions[tenant] = s
+        PC.bump("serving_sessions_opened")
+        return s
+
+    def close_session(self, tenant: str) -> None:
+        with self._lock:
+            s = self._sessions.pop(tenant, None)
+        if s is not None:
+            s.close()
+
+    def tenants(self) -> List[str]:
+        """Tenants with an OPEN session."""
+        with self._lock:
+            return sorted(t for t, s in self._sessions.items()
+                          if not s.closed)
+
+    # -- leak gate surface -----------------------------------------------
+    def leak_report(self) -> List[str]:
+        out: List[str] = []
+        with self._lock:
+            open_tenants = {t for t, s in self._sessions.items()
+                            if not s.closed}
+            for t in sorted(open_tenants):
+                out.append(
+                    f"LEAK: serving session '{t}' left open (its conf, "
+                    "temp views, cache handles, and result fragments "
+                    "are still live)")
+        rc = _ctx.RESULT_CACHE
+        if rc is not None:
+            for t in rc.tenants():
+                if t not in open_tenants:
+                    out.append(
+                        f"LEAK: result-cache fragments for tenant "
+                        f"'{t}' outlive its session (close() must "
+                        "drop them)")
+        return out
+
+    def shutdown(self) -> None:
+        with self._lock:
+            sessions = list(self._sessions.values())
+            self._sessions.clear()
+        for s in sessions:
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# the ambient singleton (governor/__init__.py pattern)
+# ---------------------------------------------------------------------------
+
+def ensure_serving(conf) -> Optional[ServingTier]:
+    """Build (idempotently) the serving tier when
+    ``spark.rapids.tpu.serving.enabled`` is set; None when disabled.
+    Installs the fair-share scheduler into the admission module and the
+    result-fragment cache into its ambient slot."""
+    from spark_rapids_tpu.config import (
+        SERVING_ENABLED,
+        SERVING_RESULT_CACHE_ENABLED,
+        SERVING_RESULT_CACHE_MAX_BYTES,
+    )
+
+    if not bool(conf.get(SERVING_ENABLED)):
+        return None
+    with _LOCK:
+        if _ctx.TIER is None:
+            tier = ServingTier(conf)
+            from spark_rapids_tpu.lifecycle import admission as _adm
+
+            _adm.SCHEDULER = tier.scheduler
+            if bool(conf.get(SERVING_RESULT_CACHE_ENABLED)):
+                _ctx.RESULT_CACHE = ResultFragmentCache(
+                    int(conf.get(SERVING_RESULT_CACHE_MAX_BYTES)))
+            _ctx.TIER = tier
+        return _ctx.TIER
+
+
+def peek_serving() -> Optional[ServingTier]:
+    """The tier if it exists — never creates one (sampler/governor
+    discipline)."""
+    return _ctx.TIER
+
+
+def peek_result_cache() -> Optional[ResultFragmentCache]:
+    return _ctx.RESULT_CACHE
+
+
+def shutdown_serving() -> None:
+    """Tear the tier down: close every session, uninstall the
+    fair-share scheduler (admission reverts to FIFO), drop the result
+    cache."""
+    with _LOCK:
+        tier = _ctx.TIER
+        rc = _ctx.RESULT_CACHE
+        _ctx.TIER = None
+        _ctx.RESULT_CACHE = None
+        from spark_rapids_tpu.lifecycle import admission as _adm
+
+        _adm.SCHEDULER = None
+    if tier is not None:
+        tier.shutdown()
+    if rc is not None:
+        rc.clear()
+
+
+def leak_report() -> List[str]:
+    """Serving-side leak report for ``lifecycle.leak_report_all`` (one
+    ambient check; empty while serving is off)."""
+    tier = _ctx.TIER
+    return tier.leak_report() if tier is not None else []
+
+
+__all__ = [
+    "FairShareScheduler", "ResultFragmentCache", "ServingSession",
+    "ServingTier", "ensure_serving", "leak_report", "parse_tenant_map",
+    "peek_result_cache", "peek_serving", "shutdown_serving",
+]

@@ -1,0 +1,17 @@
+"""The ambient serving-tier slots (ISSUE 19).
+
+Split from ``serving/__init__.py`` so hot paths can do ONE module
+attribute read (``_SRV.TIER is None`` / ``_SRV.RESULT_CACHE is None``)
+without importing any serving machinery — the governor/context.py
+pattern.  Default sessions never create a tier, so the disabled path
+makes zero serving-module calls (cProfile-pinned)."""
+from __future__ import annotations
+
+# The live ServingTier, or None while serving is disabled/shut down.
+# Mutated only by serving.ensure_serving / serving.shutdown_serving
+# under serving._LOCK.
+TIER = None
+
+# The live ResultFragmentCache — a separate slot so the governor's RED
+# eviction ladder peeks it without walking the tier.
+RESULT_CACHE = None

@@ -1,0 +1,179 @@
+"""Weighted fair-share admission policy (ISSUE 19 tentpole).
+
+Replaces the FIFO admission order: when the serving tier is active it
+installs a :class:`FairShareScheduler` into
+``lifecycle/admission.SCHEDULER``, and the next free slot goes to the
+eligible waiter whose tenant has the LOWEST normalized usage
+(decayed usage units / weight) — classic stride scheduling over a
+decaying usage account, the shape Theseus (arXiv:2508.05029) argues
+decides whether an accelerated SQL platform serves or collapses.
+
+Usage accounting:
+
+* ``on_admit`` charges 1.0 unit at ADMISSION — never while waiting, so
+  a rejected or timed-out query costs its tenant's share nothing (the
+  ISSUE 19 retry_after_ms satellite's other half, pinned by test).
+* ``note_query_end`` charges the query's wall seconds at lifecycle
+  exit, so a tenant of few-but-heavy queries weighs the same as one of
+  many-but-light queries.
+* Both decay with half-life ``spark.rapids.tpu.serving.usageHalflifeS``
+  so an idle tenant's history fades and it re-approaches its full
+  share instead of being punished forever.
+
+Quotas bound CONCURRENCY, not throughput: a tenant at its quota is
+ineligible while any under-quota tenant waits, but the policy is
+work-conserving — with only over-quota waiters the slot is still
+granted (an idle device serves nobody).
+
+Starvation-proofing falls out of the math: a light tenant's normalized
+usage is always below a flooding tenant's, so its occasional queries
+win every selection they enter — a heavy tenant at 10x submit rate
+cannot push the light tenant's p95 past its SLO (the pinned
+starved-tenant test).
+
+Lock discipline: ``select``/``admissible``/``on_admit`` are called
+while the admission controller holds its condition — ``_lock`` here is
+a LEAF (dict/arithmetic only; order: admission._cond -> _lock).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterable, Mapping, Optional
+
+
+def parse_tenant_map(spec: str) -> Dict[str, float]:
+    """Parse ``'tenantA:4,tenantB:1'`` (whitespace tolerated).  A bad
+    entry raises ValueError at tier construction — a serving-conf typo
+    must fail loudly, not silently grant default shares."""
+    out: Dict[str, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, val = part.rpartition(":")
+        name = name.strip()
+        if not sep or not name:
+            raise ValueError(
+                f"bad tenant map entry {part!r} (want 'tenant:number')")
+        try:
+            out[name] = float(val)
+        except ValueError:
+            raise ValueError(
+                f"bad tenant map entry {part!r} (want 'tenant:number')")
+    return out
+
+
+class FairShareScheduler:
+    """Per-tenant decaying usage accounts + the selection policy."""
+
+    def __init__(self, weights: Optional[Mapping[str, float]] = None,
+                 quotas: Optional[Mapping[str, float]] = None,
+                 halflife_s: float = 30.0):
+        self._lock = threading.Lock()
+        self._weights = {k: float(v) for k, v in (weights or {}).items()}
+        self._quotas = {k: int(v) for k, v in (quotas or {}).items()}
+        self._halflife_s = max(float(halflife_s), 1e-3)
+        # tenant -> [decayed usage units, monotonic seconds of last touch]
+        self._usage: Dict[str, list] = {}
+
+    # -- static config ---------------------------------------------------
+    def weight(self, tenant: str) -> float:
+        return max(self._weights.get(tenant, 1.0), 1e-9)
+
+    def quota(self, tenant: str) -> int:
+        """Max concurrent running queries; 0 = unbounded."""
+        return self._quotas.get(tenant, 0)
+
+    # -- usage accounts --------------------------------------------------
+    def _decayed_locked(self, tenant: str, now_s: float) -> float:
+        row = self._usage.get(tenant)
+        if row is None:
+            return 0.0
+        if now_s > row[1]:
+            row[0] *= 0.5 ** ((now_s - row[1]) / self._halflife_s)
+            row[1] = now_s
+        return row[0]
+
+    def charge(self, tenant: str, units: float) -> None:
+        now = time.monotonic()
+        with self._lock:
+            val = self._decayed_locked(tenant, now)
+            self._usage[tenant] = [val + float(units), now]
+
+    def on_admit(self, tenant: str) -> None:
+        """Charged at ADMISSION only — a query that waited and was
+        rejected (queue timeout, shed) never reaches here, so its wait
+        costs the tenant nothing."""
+        self.charge(tenant, 1.0)
+
+    def note_query_end(self, tenant: str, wall_ns: int) -> None:
+        self.charge(tenant, wall_ns / 1e9)
+
+    def normalized_usage(self, tenant: str) -> float:
+        """The fair-share rank: decayed usage / weight (lower = more
+        entitled to the next slot)."""
+        now = time.monotonic()
+        with self._lock:
+            return self._decayed_locked(tenant, now) / self.weight(tenant)
+
+    def usage_snapshot(self) -> Dict[str, float]:
+        """tenant -> normalized usage (sampler / stress-harness
+        surface)."""
+        now = time.monotonic()
+        with self._lock:
+            return {t: self._decayed_locked(t, now) / self.weight(t)
+                    for t in list(self._usage)}
+
+    # -- admission policy (caller holds admission._cond) -----------------
+    def admissible(self, tenant: str, running_by: Mapping[str, int]) -> bool:
+        q = self.quota(tenant)
+        return q <= 0 or int(running_by.get(tenant, 0)) < q
+
+    def select(self, waiters: Iterable, running_by: Mapping[str, int]):
+        """The fair-share pick among queued tickets (objects carrying
+        ``.tenant``): under-quota tenants outrank over-quota ones, then
+        lowest normalized usage, then FIFO arrival — deterministic and
+        O(#waiters)."""
+        now = time.monotonic()
+        best = None
+        best_key = None
+        with self._lock:
+            for idx, ticket in enumerate(waiters):
+                t = ticket.tenant
+                u = self._decayed_locked(t, now) / self.weight(t)
+                key = (0 if self.admissible(t, running_by) else 1, u, idx)
+                if best_key is None or key < best_key:
+                    best, best_key = ticket, key
+        return best
+
+    # -- governor policy (tenant-aware shed / preempt) -------------------
+    def most_starved(self, tenants: Iterable[str]) -> Optional[str]:
+        """Among ``tenants`` (names with live demand), the one with the
+        lowest normalized usage — the governor never sheds its
+        queries."""
+        now = time.monotonic()
+        with self._lock:
+            return min(
+                tenants,
+                key=lambda t: (self._decayed_locked(t, now)
+                               / self.weight(t), t),
+                default=None)
+
+    def shed_decision(self, tenant: str,
+                      running_by: Mapping[str, int],
+                      demand: Iterable[str]) -> str:
+        """Under RED: ``"never"`` for the most-starved tenant with
+        demand (its queries pass through to the deadline predictor
+        untouched is NOT enough — they are exempt from shedding
+        entirely), ``"shed"`` for a tenant at/over its running quota
+        (the over-quota tenant pays first), ``"maybe"`` otherwise (the
+        deadline-aware predictor decides)."""
+        names = set(demand)
+        names.add(tenant)
+        if self.most_starved(names) == tenant:
+            return "never"
+        q = self.quota(tenant)
+        if q > 0 and int(running_by.get(tenant, 0)) >= q:
+            return "shed"
+        return "maybe"

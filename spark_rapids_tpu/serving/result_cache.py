@@ -1,0 +1,279 @@
+"""Per-tenant result-fragment cache (ISSUE 19 tentpole).
+
+A plan-signature -> collected-rows cache for repeated dashboard
+queries — the ``io/hot_cache.py`` fingerprint-keyed pattern one level
+up: where the hot-table cache short-circuits the SCAN, this cache
+short-circuits the whole collect (no planning, no compile, no device
+work; "Accelerating Presto with GPUs", arXiv:2606.24647, finds serving
+workloads dominated by exactly these repeats).
+
+Keying and isolation: fragments are keyed by
+``fingerprint(result_plan_key(root), session-conf items, tenant)``
+(``compilecache/keys.py``).  ``result_plan_key`` is a VALUE-level plan
+identity — per-node ``describe()`` strings (expressions and literals
+printed), content digests for in-memory leaf data, file paths +
+pushdown for file scans — because the telemetry plan *signature*
+(node names only) would collide two queries that differ only in a
+literal or in their data.  Plans carrying expressions the
+compile-cache fingerprints call unsafe (UDFs, rand, clocks) are never
+cached.  Entries additionally stamp the owning tenant — a lookup
+under a different tenant MISSES even on a key collision, so
+cross-tenant visibility of cached rows is structurally impossible
+(the pinned zero-leak test).
+
+Accounting: every fragment is charged to the PRODUCING query's
+resource bill (ISSUE 18) as persistent bytes — like df.cache()
+handles, intentionally retained beyond the query, excluded from the
+residual leak gate — and released on eviction (the ledger's
+late-charge/late-release paths keep settled bills truthful).
+
+Eviction: LRU over ``serving.resultCache.maxBytes`` at insert;
+``evict_to_bytes`` joins the governor's RED ladder next to the
+hot-table cache (cached convenience data is the first ballast
+overboard); ``drop_tenant`` at session close releases everything the
+tenant owned.
+"""
+from __future__ import annotations
+
+import hashlib
+import sys
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu import perfcounters as PC
+
+
+def _host_columns_digest(cols) -> str:
+    """Content digest of in-memory leaf data (HostColumn buffers) —
+    two create_dataframe leaves with the same schema but different
+    values must never share a result fragment."""
+    import numpy as np
+
+    h = hashlib.sha1()
+    for c in cols:
+        h.update(str(c.dtype).encode("utf-8", "replace"))
+        for buf in (c.validity, c.data, c.chars, c.lengths,
+                    c.elem_valid):
+            if buf is not None:
+                h.update(np.ascontiguousarray(buf).tobytes())
+        if c.children:
+            h.update(_host_columns_digest(c.children).encode())
+    return h.hexdigest()
+
+
+def _node_has_unsafe_expr(node) -> bool:
+    """Best-effort sweep for expressions whose value is not a function
+    of the plan text (UDF callables, rand/uuid, clock captures) — the
+    compile-cache ``_expr_unsafe`` verdict applied to every
+    expression-looking attribute the node carries.  Caching such a
+    plan's ROWS would freeze nondeterminism even harder than sharing
+    its executable would."""
+    from spark_rapids_tpu.compilecache.keys import _expr_unsafe
+
+    try:
+        attrs = vars(node).values()
+    except TypeError:
+        return False
+
+    def scan(v) -> bool:
+        if callable(getattr(v, "sql_string", None)):
+            return _expr_unsafe(v)
+        if isinstance(v, (list, tuple)):
+            return any(scan(x) for x in v)
+        return False
+
+    return any(scan(v) for v in attrs)
+
+
+def result_plan_key(root) -> Optional[tuple]:
+    """Value-level identity of a planned exec tree, or None when the
+    plan refuses one (the hot-cache scan_key discipline: shaky ground
+    is never cached).  Per node: the ``describe()`` string — literals,
+    expressions, join keys, and sort orders all print — plus a content
+    digest for in-memory leaf data and paths + pushdown for file
+    scans.  ``df.cache()`` nodes key on their NAME (describe() says
+    hit/cold — execution state, not identity; the child subtree below
+    them supplies the identity)."""
+    from spark_rapids_tpu.exec.base import TpuExec
+
+    parts: List[tuple] = []
+
+    def walk(node, path: str) -> None:
+        name = type(node).__name__
+        if _node_has_unsafe_expr(node):
+            raise ValueError(f"unsafe expression under {name}")
+        desc = name if name == "TpuInMemoryTableScanExec" \
+            else node.describe()
+        parts.append((path, name, desc))
+        hc = getattr(node, "host_columns", None)
+        if hc is not None:
+            parts.append((path, "data", _host_columns_digest(hc)))
+        plan = getattr(node, "plan", None)
+        if plan is not None and hasattr(plan, "paths"):
+            parts.append((path, "files", tuple(plan.paths),
+                          repr(getattr(plan, "pushed_filters", None)),
+                          repr(getattr(plan, "options", None))))
+        for i, c in enumerate(getattr(node, "children", ())):
+            if isinstance(c, TpuExec):
+                walk(c, f"{path}.{i}")
+
+    try:
+        walk(root, "0")
+    # tpulint: disable=cancel-swallow (identity probe: a plan that
+    # refuses a stable key falls through to the normal uncached
+    # collect, which raises any real error with full context)
+    except Exception:
+        return None
+    return tuple(parts)
+
+
+def estimate_rows_bytes(rows: List[tuple]) -> int:
+    """Cheap host-side size estimate: sample up to 64 rows' shallow +
+    element sizes and scale.  An estimate is enough — the bound and the
+    bills need proportionality, not byte exactness."""
+    n = len(rows)
+    if n == 0:
+        return 64
+    sample = rows[:64]
+    per = 0
+    for r in sample:
+        per += sys.getsizeof(r)
+        try:
+            per += sum(sys.getsizeof(v) for v in r)
+        except TypeError:
+            pass
+    return max(64, int(per / len(sample) * n))
+
+
+class _Fragment:
+    __slots__ = ("rows", "tenant", "owner_qid", "nbytes")
+
+    def __init__(self, rows, tenant, owner_qid, nbytes):
+        self.rows = rows
+        self.tenant = tenant
+        self.owner_qid = owner_qid
+        self.nbytes = int(nbytes)
+
+
+class ResultFragmentCache:
+    """LRU host-rows cache; ``_lock`` is a leaf (order:
+    _lock -> PC._LOCK / ledger._lock via the release helper)."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _Fragment]" = OrderedDict()
+        self._bytes = 0
+
+    # -- internals -------------------------------------------------------
+    @staticmethod
+    def _release_bill(frag: _Fragment) -> None:
+        """Return the fragment's bytes to the owner's bill (late
+        release on settled bills is supported)."""
+        from spark_rapids_tpu.accounting import context as _acct
+
+        if _acct.LEDGERS is not None:
+            _acct.LEDGERS.release_device(
+                frag.owner_qid, frag.nbytes, persistent=True)
+
+    def _pop_lru_locked(self) -> Optional[_Fragment]:
+        if not self._entries:
+            return None
+        _key, frag = self._entries.popitem(last=False)
+        self._bytes -= frag.nbytes
+        return frag
+
+    # -- the cache -------------------------------------------------------
+    def get(self, key: str, tenant: str) -> Optional[List[tuple]]:
+        """The cached rows, or None.  The tenant stamp must match —
+        a cross-tenant lookup is a MISS by construction."""
+        with self._lock:
+            frag = self._entries.get(key)
+            if frag is None or frag.tenant != tenant:
+                frag = None
+            else:
+                self._entries.move_to_end(key)
+        if frag is None:
+            PC.bump("result_cache_misses")
+            return None
+        PC.bump("result_cache_hits")
+        return frag.rows
+
+    def put(self, key: str, tenant: str, rows: List[tuple],
+            owner_qid: Optional[str]) -> None:
+        nbytes = estimate_rows_bytes(rows)
+        if nbytes > self.max_bytes:
+            return                       # would evict everything else
+        evicted: List[_Fragment] = []
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+                evicted.append(old)
+            frag = _Fragment(list(rows), tenant, owner_qid, nbytes)
+            self._entries[key] = frag
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes:
+                victim = self._pop_lru_locked()
+                if victim is None:
+                    break
+                evicted.append(victim)
+        # bills + counters outside the lock
+        from spark_rapids_tpu.accounting import context as _acct
+
+        if _acct.LEDGERS is not None:
+            _acct.LEDGERS.charge_device(owner_qid, nbytes, persistent=True)
+        for frag in evicted:
+            PC.bump("result_cache_evictions")
+            self._release_bill(frag)
+
+    def evict_to_bytes(self, target: int) -> int:
+        """LRU-evict until at most ``target`` bytes remain (the
+        governor's RED ladder); returns bytes evicted."""
+        evicted: List[_Fragment] = []
+        with self._lock:
+            while self._bytes > max(0, int(target)):
+                victim = self._pop_lru_locked()
+                if victim is None:
+                    break
+                evicted.append(victim)
+        for frag in evicted:
+            PC.bump("result_cache_evictions")
+            self._release_bill(frag)
+        return sum(f.nbytes for f in evicted)
+
+    def drop_tenant(self, tenant: str) -> int:
+        """Release every fragment the tenant owns (session close);
+        returns the count dropped."""
+        dropped: List[_Fragment] = []
+        with self._lock:
+            for key in [k for k, f in self._entries.items()
+                        if f.tenant == tenant]:
+                frag = self._entries.pop(key)
+                self._bytes -= frag.nbytes
+                dropped.append(frag)
+        for frag in dropped:
+            self._release_bill(frag)
+        return len(dropped)
+
+    def clear(self) -> None:
+        with self._lock:
+            dropped = list(self._entries.values())
+            self._entries.clear()
+            self._bytes = 0
+        for frag in dropped:
+            self._release_bill(frag)
+
+    # -- introspection ---------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            by_tenant: Dict[str, int] = {}
+            for f in self._entries.values():
+                by_tenant[f.tenant] = by_tenant.get(f.tenant, 0) + 1
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "by_tenant": by_tenant}
+
+    def tenants(self) -> List[str]:
+        with self._lock:
+            return sorted({f.tenant for f in self._entries.values()})

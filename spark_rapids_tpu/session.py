@@ -131,6 +131,17 @@ class TpuSession:
         from spark_rapids_tpu.accounting import maybe_configure as acct_configure
 
         acct_configure(self.conf)
+        # Multi-tenant serving tier (ISSUE 19): the first session whose
+        # conf enables spark.rapids.tpu.serving.enabled builds the tier
+        # (fair-share scheduler installed into admission, the result-
+        # fragment cache into its ambient slot).  Disabled (the
+        # default): one conf read, the serving package never imports.
+        from spark_rapids_tpu.config import SERVING_ENABLED
+
+        if bool(self.conf.get(SERVING_ENABLED)):
+            from spark_rapids_tpu.serving import ensure_serving
+
+            ensure_serving(self.conf)
 
     @staticmethod
     def builder() -> "TpuSessionBuilder":

@@ -117,7 +117,8 @@ class TelemetryHub:
             status = type(e).__name__
             self._finish(df, qid, wall, status,
                          float(df.session.conf.get(
-                             TELEMETRY_SLO_TARGET_P95_MS)))
+                             TELEMETRY_SLO_TARGET_P95_MS)),
+                         tenant=getattr(qctx, "tenant", ""))
             # QueryRejected never lands here: admission raises inside
             # query_lifecycle.__enter__, before this wrapper runs — the
             # lifecycle layer records the query_rejected flight event
@@ -133,11 +134,12 @@ class TelemetryHub:
             raise
         wall = time.perf_counter_ns() - t0
         self._finish(df, qid, wall, "ok",
-                     float(df.session.conf.get(TELEMETRY_SLO_TARGET_P95_MS)))
+                     float(df.session.conf.get(TELEMETRY_SLO_TARGET_P95_MS)),
+                     tenant=getattr(qctx, "tenant", ""))
         return rows
 
     def _finish(self, df, qid: str, wall_ns: int, status: str,
-                target_p95_ms: float) -> None:
+                target_p95_ms: float, tenant: str = "") -> None:
         sig = ""
         cached = getattr(df, "_plan_cache", None)
         if cached is not None:
@@ -146,7 +148,10 @@ class TelemetryHub:
             root = cached[1]
             if isinstance(root, TpuExec):
                 sig = plan_signature(root)
-        violated = self.slo.observe(sig, wall_ns, status, target_p95_ms)
+        # per-tenant SLO sub-series (ISSUE 19): the serving tier's
+        # starved-tenant pin reads hub.slo.p95_ms(tenant_label(t))
+        violated = self.slo.observe(sig, wall_ns, status, target_p95_ms,
+                                    tenant=tenant)
         if violated:
             from spark_rapids_tpu import perfcounters as PC
 

@@ -49,6 +49,10 @@ SAMPLED_COUNTERS = (
     "workers_joined", "worker_lost", "worker_heartbeat_misses",
     "partitions_replayed", "dist_worker_dumps",
     "dist_worker_spans_merged",
+    "fair_share_admissions", "serving_sessions_opened",
+    "serving_sessions_closed", "result_cache_hits",
+    "result_cache_misses", "result_cache_evictions",
+    "tenant_sheds", "tenant_preempts",
 )
 
 
@@ -68,6 +72,12 @@ def collect_gauges() -> Dict[str, float]:
         g["admission_running"] = st["running"]
         g["admission_queued"] = st["queued"]
         g["admission_limit"] = st["limit"]
+        # serving tier (ISSUE 19): tenants with work in flight right now
+        tenants = st.get("tenants") or {}
+        if tenants:
+            g["serving_tenants_active"] = sum(
+                1 for t in tenants.values()
+                if t["running"] + t["queued"] > 0)
     from spark_rapids_tpu.lifecycle import watchdog as _wd
 
     g["active_queries"] = len(_wd.active_queries())
@@ -125,7 +135,36 @@ def collect_gauges() -> Dict[str, float]:
     coord = peek_coordinator()
     if coord is not None:
         g.update(coord.gauges())
+    # serving tier (ISSUE 19): result-fragment-cache occupancy —
+    # sys.modules peek so a process that never enabled serving makes
+    # zero serving-module calls (the cProfile-pinned disabled path)
+    import sys as _sys
+
+    srv = _sys.modules.get("spark_rapids_tpu.serving.context")
+    rc = getattr(srv, "RESULT_CACHE", None)
+    if rc is not None:
+        st = rc.stats()
+        g["result_cache_entries"] = st["entries"]
+        g["result_cache_bytes"] = st["bytes"]
     return g
+
+
+def collect_tenant_series() -> Dict[str, Dict[str, float]]:
+    """Per-tenant admission occupancy for one tick (ISSUE 19), keyed
+    ``{tenant: {series_name: value}}`` — peek-only.  The registry
+    records them labeled ``tenant="<name>"`` (the ISSUE 15 per-worker
+    pattern), so dashboards see one ``serving_queue_depth`` family
+    across tenants instead of N ad-hoc gauge names."""
+    from spark_rapids_tpu.lifecycle.admission import peek_admission
+
+    ctl = peek_admission()
+    if ctl is None:
+        return {}
+    out: Dict[str, Dict[str, float]] = {}
+    for t, row in (ctl.stats().get("tenants") or {}).items():
+        out[t] = {"serving_queue_depth": float(row["queued"]),
+                  "serving_running": float(row["running"])}
+    return out
 
 
 def collect_worker_series() -> Dict[str, Dict[str, float]]:
@@ -222,6 +261,15 @@ class Sampler:
                     {(name, (("worker", wid),)): v
                      for wid, row in workers.items()
                      for name, v in row[group].items()}, ts)
+        # per-tenant serving series (ISSUE 19): admission queue depth
+        # and running counts, recorded labeled tenant="<name>"
+        tenants = collect_tenant_series()
+        if tenants:
+            reg.record_labeled_many(
+                "gauge",
+                {(name, (("tenant", t),)): v
+                 for t, row in tenants.items()
+                 for name, v in row.items()}, ts)
         p95 = self._hub.slo.p95_ms()
         reg.record("query_latency_p95_ms", p95, "gauge",
                    "rolling all-queries p95 collect latency", ts)

@@ -29,6 +29,13 @@ from spark_rapids_tpu.telemetry.registry import (
 LATENCY_HIST = "query_latency_ms"
 
 
+def tenant_label(tenant: str) -> str:
+    """The per-tenant SLO sub-series label (ISSUE 19).  Plan signatures
+    are ``path:Name|...`` strings and never contain ``=``, so the two
+    label families share one histogram without collisions."""
+    return f"tenant={tenant}"
+
+
 class SloTracker:
     def __init__(self, registry: MetricsRegistry):
         self._registry = registry
@@ -40,8 +47,13 @@ class SloTracker:
         self._status: Dict[str, Dict[str, int]] = {}
 
     def observe(self, plan_sig: str, wall_ns: int, status: str,
-                target_p95_ms: float = 0.0) -> bool:
-        """Record one query; True when it violated the armed target."""
+                target_p95_ms: float = 0.0, tenant: str = "") -> bool:
+        """Record one query; True when it violated the armed target.
+        ``tenant`` (ISSUE 19) lands the wall into a per-tenant
+        sub-series too (label ``tenant=<name>`` — disjoint from plan
+        signatures, which never contain '='), so a serving deployment
+        reads each tenant's p95 from the same histogram the starved
+        -tenant pin asserts against."""
         ms = wall_ns / 1e6
         key = "ok" if status == "ok" else "error"
         with self._lock:
@@ -51,6 +63,11 @@ class SloTracker:
                 self._hist.observe(ms, plan_sig)
                 self._status.setdefault(
                     plan_sig, {"ok": 0, "error": 0})[key] += 1
+            if tenant:
+                lbl = tenant_label(tenant)
+                self._hist.observe(ms, lbl)
+                self._status.setdefault(
+                    lbl, {"ok": 0, "error": 0})[key] += 1
         return bool(target_p95_ms and ms > target_p95_ms)
 
     def summary(self) -> Dict[str, Dict[str, float]]:

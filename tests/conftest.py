@@ -85,6 +85,16 @@ def _resilience_isolation():
         from spark_rapids_tpu.accounting import shutdown as _acct_shutdown
 
         _acct_shutdown()
+    # ISSUE 19: the serving tier is process-global — a test that opened
+    # tenant sessions must not leave the fair-share scheduler installed
+    # (later tests' admissions would be charged to stale usage accounts)
+    # or result fragments resident
+    from spark_rapids_tpu.serving import context as _SRV
+
+    if _SRV.TIER is not None or _SRV.RESULT_CACHE is not None:
+        from spark_rapids_tpu.serving import shutdown_serving
+
+        shutdown_serving()
 
 
 @pytest.fixture(autouse=True)
@@ -103,7 +113,10 @@ def _leak_gate(request):
     RESOURCE BILLS: a settled bill with a nonzero residual — device
     bytes charged to the query but never released, persistent df.cache
     handles excluded — is the accounting-side view of a handle leak and
-    fails the owning test even after the handle itself was swept.  The
+    fails the owning test even after the handle itself was swept.
+    ISSUE 19 extends it to SERVING state: an unclosed tenant session or
+    a result-cache fragment that outlived its session is a cross-tenant
+    leak risk and fails the owning test.  The
     gate only *fails* a test whose body passed (a failing test already
     reported its real error — the leaked state is still cleaned so it
     cannot cascade)."""
@@ -126,7 +139,8 @@ def _leak_gate(request):
             "resource leak after test (spillables / semaphore permits / "
             "shuffle registrations / writer staging dirs / remote "
             "distributed partitions / recovery journal + checkpoint "
-            "files / nonzero residual resource bills):\n"
+            "files / nonzero residual resource bills / open serving "
+            "sessions + orphaned result fragments):\n"
             + "\n".join(leaks[:20]),
             pytrace=False)
 

@@ -64,7 +64,7 @@ def test_admission_fifo_and_reject_unit():
     assert got == ["c2"]
     ctl.release()
     assert ctl.stats() == {"running": 0, "queued": 0,
-                           "limit": 1, "max_queue": 1}
+                           "limit": 1, "max_queue": 1, "tenants": {}}
 
 
 def test_admission_queue_timeout_rejects():

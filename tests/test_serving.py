@@ -1,0 +1,653 @@
+"""Multi-tenant serving-tier tests (ISSUE 19).
+
+Pins the tentpole contracts — fair-share selection math (weights,
+quotas, decaying usage, FIFO tie-break), the rejected-wait-costs-
+nothing satellite, tenant-aware governor shed/preempt, hard session
+isolation (conf / temp views / cached results / result fragments, with
+the conftest leak gate extended to serving state), the value-level
+result-cache keying, per-tenant SLO series + sampler gauges, the
+starved-tenant pin (a flooding tenant at 10x submit rate cannot push
+the light tenant's p95 past its SLO), the bench-gate serving columns,
+and the house-style cProfile zero-call disabled-path pin.
+"""
+import cProfile
+import os
+import pstats
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu import perfcounters as PC
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.lifecycle import (
+    QueryRejected,
+    leak_report_all,
+    reset_admission,
+)
+from spark_rapids_tpu.lifecycle import admission as _adm
+from spark_rapids_tpu.serving import (
+    peek_result_cache,
+    peek_serving,
+    shutdown_serving,
+)
+from spark_rapids_tpu.serving.fair_share import (
+    FairShareScheduler,
+    parse_tenant_map,
+)
+from spark_rapids_tpu.serving.result_cache import (
+    ResultFragmentCache,
+    estimate_rows_bytes,
+    result_plan_key,
+)
+from spark_rapids_tpu.session import TpuSession, col, sum_
+
+_SERVE_CONF = {
+    "spark.rapids.sql.enabled": True,
+    "spark.rapids.tpu.serving.enabled": True,
+}
+
+
+def _tier(extra=None):
+    """A fresh serving tier (any previous tier torn down first)."""
+    shutdown_serving()
+    reset_admission()
+    conf = dict(_SERVE_CONF)
+    conf.update(extra or {})
+    TpuSession(conf)
+    tier = peek_serving()
+    assert tier is not None
+    return tier
+
+
+def _df(s, n=64, base=0):
+    return s.create_dataframe(
+        {"a": list(range(base, base + n)), "k": [i % 4 for i in range(n)]},
+        T.StructType([T.StructField("a", T.LONG),
+                      T.StructField("k", T.LONG)]))
+
+
+def _agg(s, n=64, base=0):
+    return _df(s, n, base).group_by("k").agg(sum_("a", "s")) \
+        .order_by("k")
+
+
+class _Ticket:
+    def __init__(self, tenant):
+        self.tenant = tenant
+
+
+# ---------------------------------------------------------------------------
+# fair-share scheduler: pure units
+# ---------------------------------------------------------------------------
+
+def test_parse_tenant_map():
+    assert parse_tenant_map("a:4, b : 1.5,") == {"a": 4.0, "b": 1.5}
+    assert parse_tenant_map("") == {}
+    # a serving-conf typo must fail loudly, not grant default shares
+    with pytest.raises(ValueError):
+        parse_tenant_map("a:b")
+    with pytest.raises(ValueError):
+        parse_tenant_map(":3")
+
+
+def test_selection_lowest_normalized_usage_wins():
+    """The next slot goes to the waiter whose tenant has the lowest
+    usage/weight; equal accounts fall back to FIFO arrival."""
+    sched = FairShareScheduler(weights={"a": 4.0, "b": 1.0},
+                               halflife_s=3600.0)
+    ta, tb = _Ticket("a"), _Ticket("b")
+    # no usage anywhere: FIFO (first ticket wins)
+    assert sched.select([tb, ta], {}) is tb
+    # same raw usage, but a's weight is 4x: a is 4x more entitled
+    sched.charge("a", 4.0)
+    sched.charge("b", 4.0)
+    assert sched.normalized_usage("a") == pytest.approx(1.0)
+    assert sched.normalized_usage("b") == pytest.approx(4.0)
+    assert sched.select([tb, ta], {}) is ta
+
+
+def test_quota_gates_selection_but_stays_work_conserving():
+    """A tenant at its running quota is ineligible while an under-quota
+    tenant waits — but with ONLY over-quota waiters the slot is still
+    granted (an idle device serves nobody)."""
+    sched = FairShareScheduler(quotas={"a": 1}, halflife_s=3600.0)
+    ta, tb = _Ticket("a"), _Ticket("b")
+    # a is at quota and first in line with lower usage — b still wins
+    sched.charge("b", 10.0)
+    assert sched.select([ta, tb], {"a": 1}) is tb
+    # work-conserving: only the over-quota tenant waits -> it runs
+    assert sched.select([ta], {"a": 1}) is ta
+    # below quota a competes normally (zero usage beats b's 10)
+    assert sched.select([ta, tb], {}) is ta
+
+
+def test_usage_decays_with_halflife():
+    sched = FairShareScheduler(halflife_s=0.01)
+    sched.charge("a", 8.0)
+    time.sleep(0.06)                     # ~6 half-lives
+    assert sched.normalized_usage("a") < 1.0
+
+
+def test_shed_decision_policy():
+    """Under RED: never shed the most-starved tenant; shed an at-quota
+    tenant immediately; everyone else falls to the deadline
+    predictor."""
+    sched = FairShareScheduler(quotas={"heavy": 2}, halflife_s=3600.0)
+    sched.charge("heavy", 50.0)
+    assert sched.shed_decision("light", {"heavy": 2}, ["heavy"]) \
+        == "never"
+    assert sched.shed_decision("heavy", {"heavy": 2}, ["light"]) \
+        == "shed"
+    assert sched.shed_decision("heavy", {"heavy": 1}, ["light"]) \
+        == "maybe"
+
+
+# ---------------------------------------------------------------------------
+# admission integration: the rejected-wait-costs-nothing satellite
+# ---------------------------------------------------------------------------
+
+def test_rejected_query_costs_its_tenant_nothing():
+    """Usage is charged at ADMISSION only: a query rejected at the door
+    (queue full) or after a queue timeout never touches its tenant's
+    fair-share account."""
+    from spark_rapids_tpu.lifecycle.admission import AdmissionController
+    from spark_rapids_tpu.lifecycle.context import QueryContext
+
+    sched = FairShareScheduler(halflife_s=3600.0)
+    old = _adm.SCHEDULER
+    _adm.SCHEDULER = sched
+    try:
+        ctl = AdmissionController(limit=1, max_queue=0)
+        heavy_ctx = QueryContext()
+        heavy_ctx.tenant = "heavy"
+        ctl.acquire(heavy_ctx)
+        assert sched.normalized_usage("heavy") == pytest.approx(1.0)
+
+        light_ctx = QueryContext()
+        light_ctx.tenant = "light"
+        with pytest.raises(QueryRejected):
+            ctl.acquire(light_ctx)       # queue full, fast reject
+        assert sched.normalized_usage("light") == 0.0
+
+        # the timeout path must not charge either
+        ctl2 = AdmissionController(limit=1, max_queue=4)
+        heavy2 = QueryContext()
+        heavy2.tenant = "heavy"
+        ctl2.acquire(heavy2)
+        light2 = QueryContext()
+        light2.tenant = "light"
+        with pytest.raises(QueryRejected):
+            ctl2.acquire(light2, timeout_ms=60)
+        assert sched.normalized_usage("light") == 0.0
+    finally:
+        _adm.SCHEDULER = old
+
+
+def test_admission_uses_fair_share_order():
+    """With the scheduler installed, a freed slot goes to the
+    most-entitled waiter, not the queue head."""
+    from spark_rapids_tpu.lifecycle.admission import AdmissionController
+    from spark_rapids_tpu.lifecycle.context import QueryContext
+
+    sched = FairShareScheduler(halflife_s=3600.0)
+    sched.charge("heavy", 100.0)
+    old = _adm.SCHEDULER
+    _adm.SCHEDULER = sched
+    try:
+        ctl = AdmissionController(limit=1, max_queue=8)
+        holder = QueryContext()
+        holder.tenant = "heavy"
+        ctl.acquire(holder)
+
+        order = []
+        lock = threading.Lock()
+
+        def waiter(tenant):
+            ctx = QueryContext()
+            ctx.tenant = tenant
+            ctl.acquire(ctx)
+            with lock:
+                order.append(tenant)
+            ctl.release(tenant)
+
+        th = threading.Thread(target=waiter, args=("heavy",))
+        th.start()
+        time.sleep(0.15)                 # heavy queues first (FIFO head)
+        tl = threading.Thread(target=waiter, args=("light",))
+        tl.start()
+        time.sleep(0.15)
+        ctl.release("heavy")             # free the slot
+        tl.join(10)
+        th.join(10)
+        # light arrived second but its tenant is 100 units more
+        # entitled — it must run first
+        assert order == ["light", "heavy"]
+    finally:
+        _adm.SCHEDULER = old
+
+
+# ---------------------------------------------------------------------------
+# governor: tenant-aware preemption
+# ---------------------------------------------------------------------------
+
+def test_preempt_targets_most_over_share_tenant():
+    """Under RED the pause-and-spill target is the MOST OVER-SHARE
+    running query, not simply the newest-admitted one."""
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.governor import (
+        context as GOV_CTX,
+        ensure_governor,
+        shutdown_governor,
+    )
+    from spark_rapids_tpu.lifecycle import watchdog as _wd
+    from spark_rapids_tpu.lifecycle.context import QueryContext
+
+    ensure_governor(TpuConf({
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.tpu.governor.enabled": True,
+        "spark.rapids.tpu.governor.updatePeriodMs": "1",
+    }))
+    gov = GOV_CTX.GOVERNOR
+    sched = FairShareScheduler(halflife_s=3600.0)
+    sched.charge("hog", 100.0)
+    old = _adm.SCHEDULER
+    _adm.SCHEDULER = sched
+    hog_ctx, light_ctx = QueryContext(), QueryContext()
+    hog_ctx.tenant = "hog"
+    light_ctx.tenant = "light"           # NEWER admission_seq than hog
+    _wd.register(hog_ctx)
+    _wd.register(light_ctx)
+    try:
+        snap = PC.snapshot()
+        assert gov.request_preempt()
+        # plain newest-first would pick light_ctx; fair-share picks hog
+        assert gov._preempt_qid == hog_ctx.query_id
+        assert PC.since(snap)["tenant_preempts"] == 1
+    finally:
+        _wd.unregister(hog_ctx)
+        _wd.unregister(light_ctx)
+        _adm.SCHEDULER = old
+        shutdown_governor()
+
+
+# ---------------------------------------------------------------------------
+# sessions: hard isolation + the leak-gate extension
+# ---------------------------------------------------------------------------
+
+def test_session_isolation_conf_views_and_fragments():
+    tier = _tier()
+    light = tier.session("light")
+    heavy = tier.session("heavy")
+
+    # conf: session-scoped, never visible across tenants
+    light.set_conf("spark.rapids.tpu.telemetry.slo.targetP95Ms", "1234")
+    assert light.get_conf(
+        "spark.rapids.tpu.telemetry.slo.targetP95Ms") == "1234"
+    assert heavy.get_conf(
+        "spark.rapids.tpu.telemetry.slo.targetP95Ms") != "1234"
+
+    # temp views: per-session registry, cross-tenant lookup fails
+    light.create_temp_view("t", _agg(light.spark))
+    assert light.temp_views() == ["t"]
+    with pytest.raises(KeyError, match="session-scoped"):
+        heavy.view("t")
+
+    # result fragments: a same-tenant repeat is a HIT with zero fresh
+    # compiles; the other tenant's identical plan is a MISS
+    rows1 = light.collect(_agg(light.spark, base=7))
+    snap = PC.snapshot()
+    rows2 = light.collect(_agg(light.spark, base=7))
+    d = PC.since(snap)
+    assert rows2 == rows1
+    assert d["result_cache_hits"] == 1
+    assert d["compiles"] == 0
+    snap = PC.snapshot()
+    heavy.collect(_agg(heavy.spark, base=7))
+    d = PC.since(snap)
+    assert d["result_cache_hits"] == 0
+    assert d["result_cache_misses"] >= 1
+
+    tier.close_session("light")
+    tier.close_session("heavy")
+    assert leak_report_all() == []
+    shutdown_serving()
+
+
+def test_leak_gate_sees_open_sessions_and_orphan_fragments():
+    """The conftest leak-gate extension: an unclosed tenant session or
+    a fragment outliving its session lands in leak_report_all."""
+    tier = _tier()
+    tier.session("forgetful")
+    leaks = leak_report_all()
+    assert any("forgetful" in ln and "left open" in ln for ln in leaks)
+
+    tier.close_session("forgetful")
+    rc = peek_result_cache()
+    rc.put("orphan-key", "ghost", [(1,)], None)
+    leaks = leak_report_all()
+    assert any("ghost" in ln and "outlive" in ln for ln in leaks)
+    shutdown_serving()
+    assert leak_report_all() == []
+
+
+def test_closed_session_rejects_use_and_close_is_idempotent():
+    tier = _tier()
+    s = tier.session("t")
+    s.close()
+    s.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        s.collect(None)
+    # a fresh session under the same name replaces the closed one
+    s2 = tier.session("t")
+    assert s2 is not s and not s2.closed
+    shutdown_serving()
+
+
+# ---------------------------------------------------------------------------
+# result cache: value-level keys, LRU, RED ladder, bills
+# ---------------------------------------------------------------------------
+
+def test_result_key_is_value_level():
+    """Two plans that differ only in a literal or only in their leaf
+    DATA must never share a fragment (the telemetry plan signature —
+    node names only — would collide both)."""
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    k_lim2 = result_plan_key(_agg(s).limit(2)._planned()[0])
+    k_lim3 = result_plan_key(_agg(s).limit(3)._planned()[0])
+    assert k_lim2 is not None and k_lim2 != k_lim3
+    k_data1 = result_plan_key(_agg(s, base=0)._planned()[0])
+    k_data2 = result_plan_key(_agg(s, base=1)._planned()[0])
+    assert k_data1 is not None and k_data1 != k_data2
+    # identical plan + data -> identical key
+    assert k_data1 == result_plan_key(_agg(s, base=0)._planned()[0])
+
+
+def test_result_key_refuses_unsafe_expressions():
+    """A plan carrying a nondeterministic expression never gets a
+    result key — caching its rows would freeze nondeterminism.  (UDFs
+    are traced into deterministic expressions at plan time, so the
+    surviving unsafe classes are rand/uuid/clock-captures.)"""
+    from spark_rapids_tpu.expr.misc import Rand
+
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    df = _df(s).select(Rand().alias("r"))
+    assert result_plan_key(df._planned()[0]) is None
+
+
+def test_result_cache_lru_and_red_ladder():
+    rows = [(i, "x" * 50) for i in range(100)]
+    per = estimate_rows_bytes(rows)
+    rc = ResultFragmentCache(max_bytes=int(per * 2.5))
+    snap = PC.snapshot()
+    rc.put("k1", "a", rows, None)
+    rc.put("k2", "a", rows, None)
+    rc.put("k3", "b", rows, None)        # k1 is LRU -> evicted
+    assert rc.get("k1", "a") is None
+    assert rc.get("k3", "b") == rows
+    assert PC.since(snap)["result_cache_evictions"] == 1
+    # the governor's RED ladder: evict down to a byte target
+    freed = rc.evict_to_bytes(per)
+    assert freed > 0 and rc.stats()["bytes"] <= per
+    # drop_tenant releases exactly that tenant's fragments
+    rc.put("k4", "a", rows, None)
+    rc.put("k5", "b", rows, None)
+    rc.drop_tenant("a")
+    assert rc.tenants() == ["b"]
+    rc.clear()
+    assert rc.stats() == {"entries": 0, "bytes": 0, "by_tenant": {}}
+
+
+def test_oversized_fragment_never_caches():
+    rc = ResultFragmentCache(max_bytes=64)
+    rc.put("big", "a", [(i, "y" * 100) for i in range(100)], None)
+    assert rc.stats()["entries"] == 0
+
+
+def test_fragment_charged_to_owner_bill_and_released_on_evict():
+    """Fragments are persistent bytes on the PRODUCING query's bill
+    (ISSUE 18), released on eviction — counter deltas prove both
+    directions."""
+    from spark_rapids_tpu import accounting as _acct
+    from spark_rapids_tpu.config import TpuConf
+
+    _acct.maybe_configure(TpuConf(
+        {"spark.rapids.tpu.accounting.enabled": True}))
+    try:
+        rows = [(1, 2), (3, 4)]
+        rc = ResultFragmentCache(max_bytes=1 << 20)
+        snap = PC.snapshot()
+        rc.put("k", "a", rows, "q_owner")
+        d = PC.since(snap)
+        assert d["acct_device_bytes_charged"] == estimate_rows_bytes(rows)
+        snap = PC.snapshot()
+        rc.clear()
+        d = PC.since(snap)
+        assert d["acct_device_bytes_released"] == estimate_rows_bytes(rows)
+    finally:
+        _acct.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# telemetry: per-tenant SLO series + sampler gauges
+# ---------------------------------------------------------------------------
+
+def test_per_tenant_slo_series():
+    from spark_rapids_tpu import telemetry
+    from spark_rapids_tpu.telemetry.slo import tenant_label
+
+    telemetry.shutdown()
+    tier = _tier({"spark.rapids.tpu.telemetry.samplePeriodMs": "50"})
+    light = tier.session("light")
+    light.collect(_agg(light.spark, base=3))
+    hub = telemetry.get_hub()
+    summary = hub.slo.summary()
+    assert tenant_label("light") in summary
+    assert summary[tenant_label("light")]["count"] == 1
+    assert hub.slo.p95_ms(tenant_label("light")) > 0.0
+    tier.close_session("light")
+    shutdown_serving()
+
+
+def test_sampler_serving_gauges():
+    """serving_tenants_active + the per-tenant labeled queue-depth
+    series + the result-cache occupancy gauges."""
+    from spark_rapids_tpu.lifecycle.admission import get_admission
+    from spark_rapids_tpu.lifecycle.context import QueryContext
+    from spark_rapids_tpu.telemetry.sampler import (
+        collect_gauges,
+        collect_tenant_series,
+    )
+
+    tier = _tier()
+    reset_admission()
+    ctl = get_admission(2, 8)
+    ctx = QueryContext()
+    ctx.tenant = "light"
+    ctl.acquire(ctx)
+    try:
+        g = collect_gauges()
+        assert g.get("serving_tenants_active") == 1
+        series = collect_tenant_series()
+        assert series["light"]["serving_running"] == 1
+        assert series["light"]["serving_queue_depth"] == 0
+    finally:
+        ctl.release("light")
+    peek_result_cache().put("k", "light", [(1,)], None)
+    g = collect_gauges()
+    assert g.get("result_cache_entries") == 1
+    assert g.get("result_cache_bytes", 0) > 0
+    shutdown_serving()
+    reset_admission()
+
+
+# ---------------------------------------------------------------------------
+# the starved-tenant pin
+# ---------------------------------------------------------------------------
+
+def test_starved_tenant_holds_slo_under_flood():
+    """A heavy tenant flooding at >=10x the light tenant's submit rate
+    cannot push the light tenant past its SLO: light is never shed and
+    every light query admits + completes promptly (fair-share puts it
+    at the queue front; the quota caps heavy's slot share)."""
+    tier = _tier({
+        "spark.rapids.tpu.serving.weights": "light:1,heavy:1",
+        "spark.rapids.tpu.serving.quotas": "heavy:1",
+        "spark.rapids.tpu.concurrentQueries": "2",
+        "spark.rapids.tpu.admission.maxQueueDepth": "32",
+    })
+    light = tier.session("light")
+    heavy = tier.session("heavy")
+    # warm both shapes' compiles outside the timed window
+    light.collect(_agg(light.spark, base=500))
+    heavy.collect(_agg(heavy.spark, base=501))
+
+    t_end = time.monotonic() + 2.0
+    counts = {"light": 0, "heavy": 0, "light_shed": 0}
+    walls = []
+    lock = threading.Lock()
+
+    def flood(idx):
+        it = 0
+        while time.monotonic() < t_end:
+            it += 1
+            try:
+                heavy.collect(_agg(heavy.spark, base=1000 + idx * 10000 + it))
+            except QueryRejected:
+                continue
+            with lock:
+                counts["heavy"] += 1
+
+    def trickle():
+        it = 0
+        while time.monotonic() < t_end:
+            it += 1
+            t0 = time.perf_counter()
+            try:
+                light.collect(_agg(light.spark, base=900000 + it))
+            except QueryRejected:
+                with lock:
+                    counts["light_shed"] += 1
+                continue
+            with lock:
+                counts["light"] += 1
+                walls.append(time.perf_counter() - t0)
+            time.sleep(0.15)
+
+    threads = [threading.Thread(target=flood, args=(i,)) for i in range(4)]
+    threads.append(threading.Thread(target=trickle))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+
+    assert counts["light"] >= 3
+    assert counts["heavy"] >= 10 * counts["light_shed"] + counts["light"]
+    # the pins: light is NEVER shed, and its p95 stays under an SLO a
+    # warm sub-second query only misses if fair-share stopped
+    # protecting it from the flood
+    assert counts["light_shed"] == 0
+    walls.sort()
+    p95 = walls[min(int(len(walls) * 0.95), len(walls) - 1)]
+    assert p95 < 5.0, f"light p95 {p95:.2f}s under flood"
+    tier.close_session("light")
+    tier.close_session("heavy")
+    shutdown_serving()
+    reset_admission()
+
+
+# ---------------------------------------------------------------------------
+# disabled path: zero serving calls
+# ---------------------------------------------------------------------------
+
+def test_disabled_path_makes_zero_serving_calls():
+    """With serving off (the default) every instrumented site costs one
+    ambient module-attribute check: profiling an admission-heavy
+    workload shows ZERO calls into the serving package."""
+    from spark_rapids_tpu.serving import context as _SRV
+
+    shutdown_serving()
+    reset_admission()
+    assert _SRV.TIER is None and _SRV.RESULT_CACHE is None
+    assert _adm.SCHEDULER is None
+    s = TpuSession({"spark.rapids.sql.enabled": True,
+                    "spark.rapids.tpu.concurrentQueries": "2"})
+    df = _agg(s)
+    df.collect()                         # warm compiles outside profile
+
+    prof = cProfile.Profile()
+    prof.enable()
+    for _ in range(5):
+        df.collect()
+    prof.disable()
+    banned = (os.path.join("serving", "__init__.py"),
+              os.path.join("serving", "context.py"),
+              os.path.join("serving", "fair_share.py"),
+              os.path.join("serving", "result_cache.py"))
+    offenders = [
+        (fname, func)
+        for (fname, _lineno, func) in pstats.Stats(prof).stats
+        if any(bad in fname for bad in banned)]
+    assert not offenders, (
+        f"serving work on the disabled path: {offenders}")
+
+
+# ---------------------------------------------------------------------------
+# bench gate: the serving columns
+# ---------------------------------------------------------------------------
+
+def test_bench_gate_serving_columns():
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, "tools"))
+    from bench_gate import gate
+
+    base = {
+        "metric": "serving", "shed_rate": 0.0, "cross_tenant_leaks": 0,
+        "warm_repeat": {"result_cache_hits": 2, "compiles": 0},
+        "tenants": {
+            "light": {"latency_ms": {"p50": 10.0, "p95": 20.0}},
+            "heavy": {"latency_ms": {"p50": 15.0, "p95": 30.0}},
+        },
+    }
+    assert gate(base, base) == []
+    # STRICT zeros: one leaked fragment or one warm recompile fails at
+    # any tolerance
+    import copy
+
+    leaky = copy.deepcopy(base)
+    leaky["cross_tenant_leaks"] = 1
+    assert any("cross_tenant_leaks" in r for r in gate(base, leaky))
+    recompiled = copy.deepcopy(base)
+    recompiled["warm_repeat"] = {"result_cache_hits": 0, "compiles": 2}
+    msgs = gate(base, recompiled)
+    assert any("recompiled" in r for r in msgs)
+    assert any("hit the result cache 0 times" in r for r in msgs)
+    # baseline-relative: shed rate and per-tenant p95
+    shedding = copy.deepcopy(base)
+    shedding["shed_rate"] = 0.4
+    assert any("shed rate" in r for r in gate(base, shedding))
+    slow = copy.deepcopy(base)
+    slow["tenants"]["light"]["latency_ms"]["p95"] = 500.0
+    assert any("tenant 'light' p95" in r for r in gate(base, slow))
+    # a vanished tenant is a coverage regression; a type mismatch
+    # fails loudly, never passes vacuously
+    lost = copy.deepcopy(base)
+    del lost["tenants"]["heavy"]
+    assert any("missing" in r for r in gate(base, lost))
+    assert gate(base, {"value": 1.0}) != []
+
+
+# ---------------------------------------------------------------------------
+# docs: drift gate covers the serving surface
+# ---------------------------------------------------------------------------
+
+def test_doc_drift_gate_covers_serving():
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, "tools"))
+    import check_counters
+
+    assert check_counters.check() == []

@@ -23,6 +23,13 @@ non-zero when the new run regressed past the tolerance:
   subtree split back apart or a blocking sync crept into the hot loop;
 * for ``--concurrency`` payloads: ``latency_ms.p95`` must not grow more
   than ``--tolerance`` (+5ms slack);
+* for ``--serving`` payloads (ISSUE 19): ``cross_tenant_leaks`` must be
+  0 and the warm-repeat must hit the result cache with zero recompiles
+  — STRICT, no tolerance (isolation and cache correctness are not
+  latency); per-tenant ``latency_ms.p95`` follows the concurrency rule
+  (+5ms slack), ``shed_rate`` the overload rule (+0.05 absolute slack),
+  and a tenant the baseline measured that vanished from the new run is
+  a coverage regression;
 * for ``run_stress.py --overload`` payloads (ISSUE 13): ``shed_rate``
   must not grow more than ``--tolerance`` (+0.05 absolute slack),
   ``recovery_s`` (time back to GREEN after the load drops) must not
@@ -174,6 +181,64 @@ def gate(base: Dict, new: Dict, tolerance: float = DEFAULT_TOLERANCE,
                 f"concurrency p95 latency regressed: {bp:.1f}ms -> "
                 f"{np_:.1f}ms ({_pct(bp, np_)}, tolerance "
                 f"{tolerance * 100:.0f}% + {P95_SLACK_MS:.0f}ms)")
+        return regressions
+
+    # --serving payloads (ISSUE 19): the mixed-tenant serving gate.
+    # Isolation and cache-correctness columns are STRICT zeros on the
+    # NEW run (no baseline math — one leaked fragment is a bug at any
+    # tolerance); shed rate and per-tenant p95 are baseline-relative.
+    base_srv = base.get("metric") == "serving"
+    new_srv = new.get("metric") == "serving"
+    if base_srv != new_srv:
+        return [f"payload type mismatch: baseline is "
+                f"{'serving' if base_srv else 'non-serving'}, new run "
+                f"is {'serving' if new_srv else 'non-serving'} — "
+                f"nothing comparable"]
+    if base_srv:
+        ctl = int(new.get("cross_tenant_leaks") or 0)
+        if ctl:
+            first = (new.get("leaks") or ["isolation probe tripped"])[0]
+            regressions.append(
+                f"serving cross_tenant_leaks == {ctl} (pin is 0) — "
+                f"tenant isolation broke: {first}")
+        wr = new.get("warm_repeat") or {}
+        if int(wr.get("compiles") or 0):
+            regressions.append(
+                f"serving warm repeats recompiled "
+                f"({wr['compiles']} fresh compiles; pin is 0) — the "
+                f"result cache stopped short-circuiting warm queries")
+        if not int(wr.get("result_cache_hits") or 0):
+            regressions.append(
+                "serving warm repeats hit the result cache 0 times — "
+                "warm-start replay no longer serves from cache")
+        bs = float(base.get("shed_rate") or 0.0)
+        ns = float(new.get("shed_rate") or 0.0)
+        if ns > bs * (1.0 + tolerance) + SHED_RATE_SLACK:
+            regressions.append(
+                f"serving shed rate regressed: {bs:.3f} -> {ns:.3f} "
+                f"(tolerance {tolerance * 100:.0f}% + "
+                f"{SHED_RATE_SLACK:.2f})")
+        bt = base.get("tenants") or {}
+        nt = new.get("tenants") or {}
+        missing_t = sorted(set(bt) - set(nt))
+        if missing_t:
+            regressions.append(
+                "tenants in baseline but missing from new serving run: "
+                + ", ".join(missing_t))
+        for t in sorted(set(bt) & set(nt)):
+            bp = float((bt[t].get("latency_ms") or {}).get("p95", 0.0))
+            np_ = float((nt[t].get("latency_ms") or {}).get("p95", 0.0))
+            if bp and np_ == 0.0:
+                regressions.append(
+                    f"serving tenant '{t}' p95 collapsed to 0 (was "
+                    f"{bp:.1f}ms): the tenant completed no measurable "
+                    f"queries")
+            elif bp and np_ > bp * (1.0 + tolerance) + P95_SLACK_MS:
+                regressions.append(
+                    f"serving tenant '{t}' p95 latency regressed: "
+                    f"{bp:.1f}ms -> {np_:.1f}ms ({_pct(bp, np_)}, "
+                    f"tolerance {tolerance * 100:.0f}% + "
+                    f"{P95_SLACK_MS:.0f}ms)")
         return regressions
 
     # a partial new run (budget kill / SIGTERM mid-suite) has missing or
@@ -413,6 +478,10 @@ def prediction_report(base: Dict, new: Dict) -> List[str]:
     store history and machine state — it reports, never gates."""
     bq = (base.get("queries") or {})
     nq = (new.get("queries") or {})
+    # concurrency/serving payloads carry "queries" as an int COUNT, not
+    # the per-query dict — no prediction rows to report there
+    if not isinstance(bq, dict) or not isinstance(nq, dict):
+        return []
     rows = []
     for name in sorted(nq):
         ne, nkind, measured = _pred_error_pct(nq[name])

@@ -663,6 +663,255 @@ def run_hot_cache(n_threads: int = 8, rounds: int = 3,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_serve(n_threads: int = 10, duration_s: float = 6.0,
+              seed: int = 7, limit: int = 3, max_queue: int = 24,
+              slo_ms: float = 5000.0, quiet: bool = False,
+              telemetry_out: str = "") -> dict:
+    """``--serve`` mode (ISSUE 19): a sustained mixed-tenant replay
+    through the serving tier — 2 'light' threads submitting slowly and
+    ``n_threads - 2`` 'heavy' threads flooding continuously (well past
+    10x the light submit rate) against fair-share admission, tenant
+    quotas, and the result-fragment cache.  The acceptance pins:
+
+    * zero unstructured failures — every rejection is a structured
+      QueryRejected whose retry_after_ms the clients honor;
+    * the starved-tenant pin: the light tenant is never shed (the
+      fair-share scheduler protects the most-starved tenant) and its
+      executed-query p95 stays under ``slo_ms`` despite the flood;
+    * warm-started repeats: after the load, every tenant's warm
+      queries return from the result cache — zero compiles;
+    * zero cross-tenant leaks: temp views, session conf, and result
+      fragments are invisible across tenants, and closing the
+      sessions leaves an empty process leak report.
+    """
+    import json
+
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu.governor import shutdown_governor
+    from spark_rapids_tpu.lifecycle import (
+        QueryCancelled,
+        QueryDeadlineExceeded,
+        QueryRejected,
+        leak_report_all,
+        reset_admission,
+    )
+    from spark_rapids_tpu.serving import peek_serving, shutdown_serving
+    from spark_rapids_tpu.session import TpuSession
+
+    shapes = _shapes()
+    oracle = {}
+    for i, q in enumerate(shapes):
+        so = TpuSession({"spark.rapids.sql.enabled": False})
+        oracle[i] = sorted(q(so).collect())
+
+    shutdown_governor()
+    shutdown_serving()
+    reset_admission()
+    base_conf = {
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.tpu.serving.enabled": True,
+        # equal weights: fairness must come from usage accounting, not
+        # from tilting the scale toward the light tenant
+        "spark.rapids.tpu.serving.weights": "light:1,heavy:1",
+        # the heavy tenant may hold at most 2 of the 3 slots — under
+        # RED the governor sheds its over-quota submissions first
+        "spark.rapids.tpu.serving.quotas": f"heavy:{max(limit - 1, 1)}",
+        "spark.rapids.tpu.governor.enabled": True,
+        "spark.rapids.tpu.governor.updatePeriodMs": "10",
+        "spark.rapids.tpu.concurrentQueries": str(limit),
+        "spark.rapids.tpu.admission.maxQueueDepth": str(max_queue),
+        "spark.rapids.tpu.resilience.backoffBaseMs": "0",
+        "spark.rapids.tpu.telemetry.samplePeriodMs": "50",
+    }
+    from spark_rapids_tpu import telemetry
+
+    telemetry.shutdown()
+    TpuSession(base_conf)          # installs the tier + scheduler
+    tier = peek_serving()
+    failures: list = []
+    if tier is None:
+        return {"mode": "serve", "failures": ["serving tier was never "
+                                              "installed"], "leaks": []}
+
+    # -- warm phase: canonical shapes populate compiles + fragments ----
+    for tenant in ("light", "heavy"):
+        sess = tier.session(tenant)
+        for qi, q in enumerate(shapes):
+            rows = sorted(sess.collect(q(sess.spark)))
+            if rows != oracle[qi]:
+                failures.append(f"warm {tenant} shape {qi}: diverged")
+
+    # -- sustained load: unique per-iteration queries (distinct limit
+    #    literal -> distinct result key -> real execution, no cache
+    #    short-circuit), heavy flooding, light trickling ---------------
+    stats = {t: {"submitted": 0, "ok": 0, "shed": 0, "cancelled": 0}
+             for t in ("light", "heavy")}
+    lock = threading.Lock()
+    t_end = time.monotonic() + duration_s
+
+    def worker(idx: int, tenant: str, pause_s: float):
+        sess = tier.session(tenant)
+        it = 0
+        while time.monotonic() < t_end:
+            qi = (idx + it) % len(shapes)
+            n = 1 + idx * 100_000 + it     # never repeats across run
+            df = shapes[qi](sess.spark).limit(n)
+            with lock:
+                stats[tenant]["submitted"] += 1
+            try:
+                rows = sess.collect(df)
+                with lock:
+                    stats[tenant]["ok"] += 1
+                    # limit(n) of the shaped result: every row must
+                    # come from the oracle set, n >= |oracle| is exact
+                    if any(tuple(r) not in set(oracle[qi])
+                           for r in rows) \
+                            or len(rows) != min(n, len(oracle[qi])):
+                        failures.append(
+                            f"{tenant} worker {idx} it {it}: rows "
+                            f"diverged from oracle subset")
+            except QueryRejected as e:
+                with lock:
+                    if not isinstance(e.queue_depth, int) \
+                            or not isinstance(e.pressure_state, str) \
+                            or not e.pressure_state:
+                        failures.append(
+                            f"{tenant} worker {idx} it {it}: "
+                            f"UNSTRUCTURED QueryRejected")
+                    else:
+                        stats[tenant]["shed"] += 1
+                # the advisory-backoff contract: honor the hint
+                time.sleep(min((e.retry_after_ms or 0) / 1000.0, 0.25))
+            except (QueryCancelled, QueryDeadlineExceeded):
+                with lock:
+                    stats[tenant]["cancelled"] += 1
+            except Exception as e:   # noqa: BLE001 — report, don't die
+                with lock:
+                    failures.append(
+                        f"{tenant} worker {idx} it {it}: unexpected "
+                        f"{type(e).__name__}: {e}")
+            it += 1
+            if pause_s:
+                time.sleep(pause_s)
+
+    plan = [("light", 0.1)] * 2 + [("heavy", 0.0)] * (n_threads - 2)
+    snap_load = PC.snapshot()
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=worker, args=(i, t, p))
+               for i, (t, p) in enumerate(plan)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    wall_s = time.monotonic() - t0
+    d_load = PC.since(snap_load)
+
+    # -- warm-repeat pin: the canonical shapes must return from the
+    #    result cache with ZERO compiles ------------------------------
+    snap_pin = PC.snapshot()
+    for tenant in ("light", "heavy"):
+        sess = tier.session(tenant)
+        for qi, q in enumerate(shapes):
+            rows = sorted(sess.collect(q(sess.spark)))
+            if rows != oracle[qi]:
+                failures.append(f"repeat {tenant} shape {qi}: diverged")
+    d_pin = PC.since(snap_pin)
+    want_hits = 2 * len(shapes)
+    if d_pin["result_cache_hits"] != want_hits:
+        failures.append(
+            f"warm repeats hit the result cache "
+            f"{d_pin['result_cache_hits']}/{want_hits} times")
+    if d_pin["compiles"] != 0:
+        failures.append(
+            f"warm repeats recompiled {d_pin['compiles']} programs "
+            f"(expected 0 — cached fragments skip execution entirely)")
+
+    # -- cross-tenant isolation probes ---------------------------------
+    light, heavy = tier.session("light"), tier.session("heavy")
+    light.create_temp_view("serve_probe_view", None)
+    try:
+        heavy.view("serve_probe_view")
+        failures.append("temp view leaked across tenants")
+    except KeyError:
+        pass
+    light.drop_temp_view("serve_probe_view")
+    light.set_conf("spark.rapids.tpu.telemetry.slo.targetP95Ms", "1234")
+    if heavy.get_conf(
+            "spark.rapids.tpu.telemetry.slo.targetP95Ms") is not None:
+        failures.append("session conf leaked across tenants")
+    # an identical plan cached by ONE tenant must MISS for the other
+    probe = shapes[0](light.spark).limit(2)
+    light.collect(probe)                       # miss -> insert
+    snap_x = PC.snapshot()
+    light.collect(shapes[0](light.spark).limit(2))
+    d_x = PC.since(snap_x)
+    if d_x["result_cache_hits"] != 1:
+        failures.append("same-tenant repeat did not hit the cache")
+    snap_x = PC.snapshot()
+    heavy.collect(shapes[0](heavy.spark).limit(2))
+    d_x = PC.since(snap_x)
+    if d_x["result_cache_hits"] != 0:
+        failures.append(
+            "CROSS-TENANT LEAK: another tenant's fragment served")
+
+    # -- the starved-tenant pin ----------------------------------------
+    from spark_rapids_tpu.telemetry.slo import tenant_label
+
+    hub = telemetry.get_hub()
+    light_p95 = hub.slo.p95_ms(tenant_label("light")) if hub else 0.0
+    heavy_p95 = hub.slo.p95_ms(tenant_label("heavy")) if hub else 0.0
+    if stats["light"]["shed"]:
+        failures.append(
+            f"the starved light tenant was shed "
+            f"{stats['light']['shed']} times (fair-share shed policy "
+            f"must protect the most-starved tenant)")
+    if stats["light"]["ok"] == 0:
+        failures.append("the light tenant completed zero queries")
+    elif light_p95 > slo_ms:
+        failures.append(
+            f"light-tenant p95 {light_p95:.1f}ms exceeds the "
+            f"{slo_ms}ms SLO target under heavy-tenant flood")
+
+    # -- teardown: everything the tenants own must release -------------
+    tier.close_session("light")
+    tier.close_session("heavy")
+    from spark_rapids_tpu.compilecache.aot import quiesce_aot
+
+    quiesced = quiesce_aot(60.0)
+    leaks = leak_report_all()
+    shutdown_serving()
+    shutdown_governor()
+    reset_admission()
+
+    rate = {t: round(stats[t]["submitted"] / max(wall_s, 1e-9), 2)
+            for t in stats}
+    summary = {
+        "mode": "serve",
+        "threads": n_threads, "duration_s": duration_s, "limit": limit,
+        "tenants": stats,
+        "submit_rate_qps": rate,
+        "rate_ratio": round(rate["heavy"] / max(rate["light"], 1e-9), 1),
+        "p95_ms": {"light": round(light_p95, 2),
+                   "heavy": round(heavy_p95, 2)},
+        "warm_repeat": {"result_cache_hits": d_pin["result_cache_hits"],
+                        "compiles": d_pin["compiles"]},
+        "aot_quiesced": quiesced,
+        "failures": failures,
+        "leaks": leaks,
+        "wall_s": round(wall_s, 2),
+        "counters": {k: d_load[k] for k in (
+            "queries_admitted", "fair_share_admissions",
+            "queries_rejected", "queries_shed", "tenant_sheds",
+            "tenant_preempts", "result_cache_hits",
+            "result_cache_misses", "result_cache_evictions",
+            "serving_sessions_opened", "serving_sessions_closed")},
+        "telemetry": _dump_telemetry(telemetry_out),
+    }
+    if not quiet:
+        print(json.dumps(summary, indent=2))
+    return summary
+
+
 def run_worker_kill(n_workers: int = 3, rounds: int = 4, seed: int = 7,
                     kills: int = 2, suspend: bool = True,
                     rows: int = 60_000, worker_mem: int = 8 << 10,
@@ -1194,6 +1443,20 @@ def main() -> int:
                          "the device pool shrunk to 1/4 mid-run — pins "
                          "zero hard failures, bounded shed rate, and "
                          "bounded recovery to GREEN")
+    ap.add_argument("--serve", action="store_true",
+                    help="ISSUE 19: sustained mixed-tenant serving "
+                         "replay through fair-share admission — a "
+                         "heavy tenant floods while a light tenant "
+                         "trickles; pins zero unstructured failures, "
+                         "the starved tenant never shed and within "
+                         "its SLO, warm repeats served from the "
+                         "result cache with zero compiles, and zero "
+                         "cross-tenant leaks")
+    ap.add_argument("--duration-s", type=float, default=6.0,
+                    help="sustained-load window for --serve")
+    ap.add_argument("--slo-ms", type=float, default=5000.0,
+                    help="light-tenant p95 target for the --serve "
+                         "starved-tenant pin")
     ap.add_argument("--worker-kill", action="store_true",
                     help="ISSUE 14: distributed-join replay over worker "
                          "processes with random SIGKILL/SIGSTOP chaos — "
@@ -1270,6 +1533,26 @@ def main() -> int:
               f"{len(s['kills'])} kills ({s['worker_lost']} losses, "
               f"{s['partitions_replayed']} partitions replayed, "
               f"{s['merged_postmortems']} merged post-mortems)")
+        for f in s["failures"]:
+            print(f"FAILURE: {f}")
+        return 0 if ok else 1
+    if args.serve:
+        s = run_serve(max(n_threads, 10), duration_s=args.duration_s,
+                      seed=args.seed, limit=args.limit,
+                      slo_ms=args.slo_ms,
+                      telemetry_out=args.telemetry_out)
+        ok = not s["failures"] and not s["leaks"]
+        t = s.get("tenants", {})
+        print(("PASS" if ok else "FAIL")
+              + f": light {t.get('light', {}).get('ok', 0)} ok / "
+              f"{t.get('light', {}).get('shed', 0)} shed "
+              f"(p95 {s.get('p95_ms', {}).get('light')}ms), heavy "
+              f"{t.get('heavy', {}).get('ok', 0)} ok / "
+              f"{t.get('heavy', {}).get('shed', 0)} shed at "
+              f"{s.get('rate_ratio')}x submit rate; warm repeats "
+              f"{s.get('warm_repeat', {}).get('result_cache_hits')} "
+              f"cache hits, "
+              f"{s.get('warm_repeat', {}).get('compiles')} compiles")
         for f in s["failures"]:
             print(f"FAILURE: {f}")
         return 0 if ok else 1

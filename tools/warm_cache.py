@@ -12,9 +12,22 @@ host beyond the dummy warm-up batches.
     python tools/warm_cache.py                       # bench suite, 20M rows
     python tools/warm_cache.py --queries q6,qa --rows 1000000
     python tools/warm_cache.py --cache-dir /nfs/xla-cache --json
+    python tools/warm_cache.py --trace serve_trace.jsonl
 
 Match --rows to the rows the real run will use: programs are keyed per
 shape bucket, so warming 1M-row buckets does not help a 20M-row run.
+
+``--trace`` (ISSUE 19) warm-starts a SERVING replica from a recorded
+trace instead of the fixed bench suite: a JSONL file whose lines are
+
+    {"op": "scan", "format": "parquet", "paths": ["/data/t.parquet"]}
+    {"op": "query", "name": "qa", "rows": 1000000}
+
+``scan`` entries execute once through a hot-table-cache session so the
+device-resident table cache is primed; ``query`` entries AOT-compile
+that bench query at the recorded row count.  A replica warmed this way
+serves its first repeated queries with zero cold compiles and zero
+H2D bytes for the traced tables.
 """
 from __future__ import annotations
 
@@ -59,10 +72,41 @@ def _build_queries(names, rows, cache_dir=None):
     return out
 
 
+def _warm_scans(scan_entries, cache_dir):
+    """Execute each recorded scan once through a hot-table-cache
+    session so the device-resident table cache is primed for the
+    serving replica's replays (ISSUE 19)."""
+    from spark_rapids_tpu.io.hot_cache import get_hot_cache
+    from spark_rapids_tpu.session import TpuSession
+
+    conf = {"spark.rapids.sql.enabled": True,
+            "spark.rapids.tpu.scan.hotTableCache.enabled": True}
+    if cache_dir:
+        conf["spark.rapids.tpu.compile.cacheDir"] = cache_dir
+    s = TpuSession(conf)
+    warmed = 0
+    for e in scan_entries:
+        fmt = e.get("format", "parquet")
+        df = getattr(s.read, fmt)(*e["paths"])
+        cols = e.get("columns")
+        if cols:
+            df = df.select(*cols)
+        df.collect()
+        warmed += 1
+    cache = get_hot_cache()
+    st = cache.stats() if cache is not None else {"entries": 0,
+                                                  "bytes": 0}
+    return {"scans": warmed, "hotCacheEntries": st["entries"],
+            "hotCacheBytes": st["bytes"]}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--queries", default="q6,qa,qb,qc",
                     help="comma list from {q6,qa,qb,qc}")
+    ap.add_argument("--trace", default=None,
+                    help="warm from a recorded JSONL trace (scan + "
+                         "query entries) instead of --queries/--rows")
     ap.add_argument("--rows", type=int,
                     default=int(os.environ.get("BENCH_ROWS", 20_000_000)),
                     help="row count the real run will use (shape buckets "
@@ -85,8 +129,28 @@ def main(argv=None) -> int:
     from spark_rapids_tpu.compilecache import submit_plan
     from spark_rapids_tpu.exec.base import TpuExec
 
-    names = [q.strip() for q in args.queries.split(",") if q.strip()]
-    queries = _build_queries(names, args.rows, args.cache_dir)
+    scan_report = None
+    if args.trace:
+        with open(args.trace) as f:
+            entries = [json.loads(ln) for ln in f if ln.strip()]
+        scans = [e for e in entries if e.get("op") == "scan"]
+        if scans:
+            scan_report = _warm_scans(scans, args.cache_dir)
+            if not args.json:
+                print(f"[warm_cache] trace: {scan_report['scans']} scans "
+                      f"primed ({scan_report['hotCacheBytes']} cached "
+                      f"bytes)", file=sys.stderr, flush=True)
+        queries = {}
+        for e in entries:
+            if e.get("op") != "query":
+                continue
+            rows = int(e.get("rows", args.rows))
+            for n, df in _build_queries([e["name"]], rows,
+                                        args.cache_dir).items():
+                queries[f"{n}@{rows}"] = df
+    else:
+        names = [q.strip() for q in args.queries.split(",") if q.strip()]
+        queries = _build_queries(names, args.rows, args.cache_dir)
     report = {}
     snap_all = PC.snapshot()
     for name, df in queries.items():
@@ -110,6 +174,11 @@ def main(argv=None) -> int:
             print(f"[warm_cache] {name}: {sub.summary()} "
                   f"({report[name]['compileWall_s']}s compiling)",
                   file=sys.stderr, flush=True)
+    # drain the background pool before exit (daemon compile workers
+    # dying mid-XLA at interpreter teardown abort the process)
+    from spark_rapids_tpu.compilecache.aot import quiesce_aot
+
+    quiesce_aot(60.0)
     total = PC.since(snap_all)
     payload = {
         "rows": args.rows,
@@ -117,6 +186,8 @@ def main(argv=None) -> int:
         "totalAotCompiles": total["aot_compiles"],
         "totalCompileWall_s": round(total["aot_compile_wall_ns"] / 1e9, 3),
     }
+    if scan_report is not None:
+        payload["scanWarm"] = scan_report
     if args.json:
         print(json.dumps(payload), flush=True)
     else:
