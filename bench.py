@@ -1591,6 +1591,38 @@ def main():
                             * 100.0 / trace_off_s)
                 finally:
                     coord.trace_enabled = prior_trace
+            # hedged-fetch overhead A/B (ISSUE 20): the same healthy
+            # distributed query with hedging ON vs OFF — 3 INTERLEAVED
+            # rounds per mode (on/off alternating cancels slow drift;
+            # min-of-3 approximates each mode's true floor, the 2% pin
+            # is tighter than min-of-2 run noise at small sizes).  On a
+            # healthy cluster the soft deadline races must all be won
+            # by the remote fetch — bench_gate pins the on/off delta
+            # <= 2% AND hedgesWon == 0 (a hedge that fires with no
+            # straggler means the deadline estimate is broken; hedge-
+            # off rounds cannot hedge, so the counter delta across the
+            # whole block attributes to the hedge-on rounds)
+            hedge_on_s = hedge_off_s = hedge_overhead_pct = None
+            hedges_won_healthy = None
+            if os.environ.get("BENCH_DIST_HEDGE_AB", "1") != "0":
+                prior_hedge = coord.hedge_enabled
+                try:
+                    snap_h = PC.snapshot()
+                    hedge_walls = {True: [], False: []}
+                    for _ in range(3):
+                        for mode in (True, False):
+                            coord.hedge_enabled = mode
+                            hedge_walls[mode].append(
+                                timed_dist_collect())
+                    hedges_won_healthy = PC.since(snap_h)["hedges_won"]
+                    hedge_on_s = min(hedge_walls[True])
+                    hedge_off_s = min(hedge_walls[False])
+                    if hedge_off_s > 0:
+                        hedge_overhead_pct = (
+                            (hedge_on_s - hedge_off_s)
+                            * 100.0 / hedge_off_s)
+                finally:
+                    coord.hedge_enabled = prior_hedge
             queries["rung4_dist"] = dict(
                 tpu_s=t_tpu, cpu_vec_s=t_vec, cpu_oracle_s=0.0,
                 rows_per_s=n_fact / t_tpu,
@@ -1606,11 +1638,19 @@ def main():
                 distBlockBytes=float(d["dist_block_bytes"]),
                 workersJoined=float(d["workers_joined"]),
                 traceOnWall_s=trace_on_s, traceOffWall_s=trace_off_s,
-                traceOverheadPct=trace_overhead_pct)
+                traceOverheadPct=trace_overhead_pct,
+                hedgeOnWall_s=hedge_on_s, hedgeOffWall_s=hedge_off_s,
+                hedgeOverheadPct=hedge_overhead_pct,
+                hedgesWon=(None if hedges_won_healthy is None
+                           else float(hedges_won_healthy)))
             stream()
             overhead_note = ("" if trace_overhead_pct is None else
                              f", trace overhead "
                              f"{trace_overhead_pct:+.1f}%")
+            if hedge_overhead_pct is not None:
+                overhead_note += (f", hedge overhead "
+                                  f"{hedge_overhead_pct:+.1f}% "
+                                  f"(won={hedges_won_healthy})")
             progress(
                 f"rung4_dist: tpu {t_tpu:.2f}s over "
                 f"{data_bytes / 1e6:.0f}MB vs {worker_mem >> 10}KiB/"

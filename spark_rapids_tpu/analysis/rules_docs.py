@@ -700,6 +700,43 @@ def doc_drift_problems(repo_root: str) -> List[str]:
         if "serving.md" not in md:
             problems.append(
                 f"docs/{name} does not cross-link docs/serving.md")
+
+    # gray-failure resilience (ISSUE 20): the hedging/DEGRADED counters
+    # + sampler gauges + the soft-deadline/netchaos surface vocabulary
+    # must be documented in docs/distributed.md (confs are covered by
+    # the ISSUE 14 prefix loop above, counters ALSO in diagnostics.md
+    # via the global check), and the failure taxonomy in
+    # docs/resilience.md must carry the workerDegraded class
+    for key in ("fetch_hedges", "hedges_won", "workers_degraded",
+                "speculative_redrives"):
+        if key not in PC.COUNTERS:
+            problems.append(f"gray-failure counter '{key}' is not "
+                            f"registered in perfcounters.COUNTERS")
+        if f"`{key}`" not in dist_md:
+            problems.append(
+                f"gray-failure counter '{key}' is not documented in "
+                f"docs/distributed.md")
+    for gauge in ("dist_workers_degraded", "dist_fleet_lat_p95_ms"):
+        if f"`{gauge}`" not in dist_md:
+            problems.append(
+                f"gray-failure sampler gauge '{gauge}' is not "
+                f"documented in docs/distributed.md")
+    for word in ("DEGRADED", "soft deadline", "hedge", "`--net`",
+                 "netchaos", "`worker_degraded`", "`worker_promoted`",
+                 "`WorkerDegraded`", "`ProtocolDesync`",
+                 "first-complete-wins", "p95", "fleet median",
+                 "test_gray_failure"):
+        if word not in dist_md:
+            problems.append(
+                f"gray-failure surface vocabulary {word} is not "
+                f"documented in docs/distributed.md")
+    res_md = read("resilience.md")
+    for word in ("`workerDegraded`", "`WorkerDegraded`", "`--net`",
+                 "netchaos"):
+        if word not in res_md:
+            problems.append(
+                f"gray-failure taxonomy vocabulary {word} is not "
+                f"documented in docs/resilience.md")
     return problems
 
 

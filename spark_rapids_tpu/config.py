@@ -448,6 +448,58 @@ DISTRIBUTED_TELEMETRY_RING = conf(
     "(its 'last-shipped' ring).  0 disables worker span recording "
     "(counters still federate).").long_conf(512)
 
+# --- gray-failure resilience (ISSUE 20) ------------------------------------
+
+DISTRIBUTED_HEDGE_ENABLED = conf(
+    "spark.rapids.tpu.distributed.hedgeEnabled").doc(
+    "Hedged fetches for the distributed exchange read path "
+    "(docs/distributed.md): a paged TKD1 fetch that blows its per-"
+    "worker soft deadline (softDeadlineFactor x the worker's p95 "
+    "latency EWMA, floored at softDeadlineMinMs) races a hedge "
+    "against the producer-side lineage buffer — partition_queues "
+    "retains every framed slice until commit, so the hedge source is "
+    "free — first-complete-wins, remote duplicates discarded by the "
+    "store's per-seq idempotence.  Counters: fetch_hedges launches, "
+    "hedges_won lineage wins.  The bench rung4_dist healthy-path A/B "
+    "pins the on/off overhead <= 2% with hedges_won == 0."
+).boolean_conf(True)
+
+DISTRIBUTED_SOFT_DEADLINE_FACTOR = conf(
+    "spark.rapids.tpu.distributed.softDeadlineFactor").doc(
+    "Multiplier over a worker's p95-biased latency EWMA that sets its "
+    "per-op soft deadline.  An op past the soft deadline is a 'miss' "
+    "(counts toward DEGRADED demotion and, on the fetch path, "
+    "launches a hedge); the hard stop stays opTimeoutMs."
+).double_conf(3.0)
+
+DISTRIBUTED_SOFT_DEADLINE_MIN_MS = conf(
+    "spark.rapids.tpu.distributed.softDeadlineMinMs").doc(
+    "Floor for the per-worker soft deadline, so an idle fleet with "
+    "microsecond EWMAs does not hedge every op on scheduler jitter."
+).long_conf(50)
+
+DISTRIBUTED_SLOW_FACTOR = conf(
+    "spark.rapids.tpu.distributed.slowFactor").doc(
+    "A worker whose latency EWMA sits persistently past slowFactor x "
+    "the fleet median (or that misses degradeAfterMisses consecutive "
+    "soft deadlines) is declared DEGRADED: demoted in capacity-"
+    "weighted placement, its pending partitions speculatively re-"
+    "driven onto healthy survivors over the lineage contract — "
+    "WITHOUT declaring it LOST or opening the quarantine breaker (a "
+    "slow worker is not a dead one).").double_conf(4.0)
+
+DISTRIBUTED_DEGRADE_AFTER_MISSES = conf(
+    "spark.rapids.tpu.distributed.degradeAfterMisses").doc(
+    "Consecutive soft-deadline misses on one worker's data-plane ops "
+    "before the coordinator declares it DEGRADED.").long_conf(3)
+
+DISTRIBUTED_PROMOTE_AFTER_OKS = conf(
+    "spark.rapids.tpu.distributed.promoteAfterOks").doc(
+    "Consecutive within-deadline observations (served ops or monitor "
+    "pings) a DEGRADED worker must bank, with its EWMA back under "
+    "slowFactor x the fleet median, before promotion to ALIVE — "
+    "sustained recovery, not one lucky op.").long_conf(3)
+
 # --- crash-consistent driver recovery (ISSUE 16) ---------------------------
 
 RECOVERY_ENABLED = conf("spark.rapids.tpu.recovery.enabled").doc(

@@ -26,6 +26,18 @@ Contract (the fault-tolerance core of the cross-host tier):
 re-driven (repeated losses), after which :class:`WorkerLost` escapes to
 the operator fault domain — classified WORKER_LOST, which falls back to
 the CPU oracle without indicting the operator's breaker key.
+
+Hedged fetches (ISSUE 20, docs/distributed.md): because the producer
+retains every framed slice until commit, the lineage queue IS a free
+replica of every un-committed partition.  A paged fetch that blows the
+owner's soft deadline (``Coordinator.soft_deadline_s`` — softDeadline
+Factor x the worker's p95 latency EWMA) therefore hedges against
+``queues.peek_blobs`` instead of waiting out the straggler:
+first-complete-wins, the remote's eventual reply is discarded, and any
+duplicate a re-drive later ships is dropped by the worker store's
+per-seq idempotence.  ``fetch_hedges`` counts launches, ``hedges_won``
+counts lineage wins; on a healthy fleet both stay 0 (pinned by the
+bench rung4_dist A/B at <= 2% overhead).
 """
 from __future__ import annotations
 
@@ -195,9 +207,7 @@ class DistributedExchange:
         next_seq = 0
         while next_seq < expected:
             check_cancel()
-            seqs, blobs, _n = self.coord.fetch_blocks(
-                self.exch_id, pid, after_seq=next_seq - 1,
-                max_bytes=FETCH_PAGE_BYTES)
+            seqs, blobs, _n = self._fetch_page(pid, next_seq)
             if not seqs:
                 raise WorkerLost(
                     str(self.placement.get(pid, "?")),
@@ -222,6 +232,65 @@ class DistributedExchange:
         # the consuming stage committed this partition: lineage copy
         # released (a later loss can no longer need it)
         self.queues.release_partition(pid)
+
+    def _fetch_page(self, pid: int, next_seq: int):
+        """One page of the partition (sequences above ``next_seq - 1``)
+        from its owning worker, HEDGED (ISSUE 20): the remote fetch
+        runs on a side thread racing the owner's soft deadline; blowing
+        it launches a hedge against the producer-side lineage buffer —
+        which retains every framed slice until commit, so it can serve
+        the whole remainder locally.  First-complete-wins: a hedge win
+        abandons the straggler's in-flight reply (its wall still feeds
+        the worker's latency EWMA when it lands) and counts the miss
+        toward the owner's DEGRADED demotion."""
+        def remote():
+            return self.coord.fetch_blocks(
+                self.exch_id, pid, after_seq=next_seq - 1,
+                max_bytes=FETCH_PAGE_BYTES)
+
+        deadline = None
+        owner = None
+        if getattr(self.coord, "hedge_enabled", False):
+            try:
+                owner = self.coord.owner_of(self.exch_id, pid)
+                deadline = self.coord.soft_deadline_s(owner)
+            except KeyError:
+                pass
+        if deadline is None:
+            return remote()
+        box: Dict[str, object] = {}
+        done = threading.Event()
+
+        def run():
+            try:
+                box["out"] = remote()
+            except BaseException as e:
+                box["err"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=run, daemon=True,
+                             name="srt-dist-hedge-fetch")
+        t.start()
+        if not done.wait(deadline):
+            PC.bump("fetch_hedges")
+            self.coord.note_soft_deadline_miss(owner)
+            blobs = self.queues.peek_blobs(pid)
+            if len(blobs) > next_seq:
+                # the lineage copy holds the remainder (it always does
+                # before commit): serve it and discard whatever the
+                # straggler eventually answers — byte-identical by
+                # construction, these ARE the shipped frames
+                PC.bump("hedges_won")
+                return (list(range(next_seq, len(blobs))),
+                        blobs[next_seq:], len(blobs))
+            # lineage already committed/empty (cannot happen before the
+            # final release, but never hang on it): take the remote
+            done.wait()
+        err = box.get("err")
+        if err is not None:
+            raise err
+        return box["out"]
 
     def _ensure_remote_complete(self, pid: int, expected: int) -> None:
         """Re-drive until the owner's store holds the full partition

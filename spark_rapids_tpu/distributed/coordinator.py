@@ -30,6 +30,18 @@ survives executor churn.  The coordinator owns:
     client claims the queue and re-pushes the retained producer-side
     blocks (lineage retry), bumping ``partitions_replayed``.
 
+  * **gray failure** (ISSUE 20, docs/distributed.md) — the full state
+    machine is ALIVE <-> DEGRADED -> LOST: every data-plane op walls
+    into a per-worker p95-biased latency EWMA (refined by heartbeat-
+    federated worker service times); a worker past ``slowFactor``x the
+    fleet median, or stacking consecutive soft-deadline misses, is
+    DEGRADED — demoted in capacity-weighted placement, its pending
+    partitions speculatively re-driven onto healthy survivors
+    (``speculative_redrives``), quarantine breaker untouched — and
+    promoted back after ``promoteAfterOks`` within-deadline
+    observations.  ``soft_deadline_s()`` is what the client's hedged
+    fetch path races against.
+
 The coordinator never holds partition DATA — blocks flow producer ->
 worker -> consumer; losing the coordinator process loses the query but
 never corrupts one (every data block is CRC-framed end to end).
@@ -45,16 +57,37 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from spark_rapids_tpu import perfcounters as PC
 from spark_rapids_tpu.distributed import protocol as P
-from spark_rapids_tpu.distributed.protocol import WorkerLost
+from spark_rapids_tpu.distributed.protocol import WorkerDegraded, WorkerLost
 
 ALIVE = "ALIVE"
 QUARANTINED = "QUARANTINED"
 LOST = "LOST"
 LEFT = "LEFT"
+# gray failure (ISSUE 20): slow, not dead — demoted in placement, its
+# pending partitions speculated onto healthy survivors, promotable back
+# to ALIVE on sustained recovery.  ALIVE <-> DEGRADED -> LOST.
+DEGRADED = "DEGRADED"
 
 # the per-worker circuit-breaker key family: first element mirrors the
 # (operator-class, fingerprint) shape the breaker registry indexes by
 BREAKER_OP = "DistributedWorker"
+
+
+def _full_jitter_sleep(attempt: int, base_s: float = 0.02,
+                       cap_s: float = 0.2, sleep=time.sleep,
+                       rand=None) -> float:
+    """Full-jitter backoff for the distributed retry path (ISSUE 20
+    audit): sleep uniform(0, min(base * 2^(attempt-1), cap)) — a
+    coordinated fleet retrying a hiccuping worker must not re-arrive in
+    lockstep the way the old fixed ``0.02 * attempt`` schedule did.
+    Returns the slept duration so the regression test can pin the
+    distribution without patching time."""
+    import random as _random
+
+    cap = min(base_s * (2 ** max(attempt - 1, 0)), cap_s)
+    delay = (rand if rand is not None else _random.random)() * cap
+    sleep(delay)
+    return delay
 
 
 class WorkerInfo:
@@ -62,7 +95,9 @@ class WorkerInfo:
                  "state", "last_hb", "joined_at", "control",
                  "hb_missed", "probe_failed", "warmed_entries",
                  "counters", "store_stats", "mirror", "mirror_last_n",
-                 "clock_offset_s", "held")
+                 "clock_offset_s", "held", "lat_ewma_s", "lat_samples",
+                 "miss_streak", "ok_streak", "slow_ticks",
+                 "degraded_since")
 
     def __init__(self, worker_id: str, host: str, data_port: int,
                  pid: int, mem_bytes: int, control: socket.socket,
@@ -96,6 +131,18 @@ class WorkerInfo:
         # HELLO — what a reborn coordinator rebuilds the placement map
         # from when adopting a journaled stage lease
         self.held: List[Tuple[int, int, int, int]] = []
+        # gray-failure bookkeeping (ISSUE 20): a p95-biased latency
+        # EWMA over this worker's data-plane op walls (driver-observed,
+        # refined by the heartbeat-federated worker-side service time),
+        # consecutive soft-deadline miss / within-deadline streaks,
+        # monitor ticks spent past slowFactor x the fleet median, and
+        # when the worker entered DEGRADED (None while healthy)
+        self.lat_ewma_s: Optional[float] = None
+        self.lat_samples = 0
+        self.miss_streak = 0
+        self.ok_streak = 0
+        self.slow_ticks = 0
+        self.degraded_since: Optional[float] = None
 
 
 class Coordinator:
@@ -104,10 +151,16 @@ class Coordinator:
 
     def __init__(self, conf=None):
         from spark_rapids_tpu.config import (
+            DISTRIBUTED_DEGRADE_AFTER_MISSES,
             DISTRIBUTED_HEARTBEAT_MS,
+            DISTRIBUTED_HEDGE_ENABLED,
             DISTRIBUTED_LOSS_BREAKER_THRESHOLD,
             DISTRIBUTED_OP_TIMEOUT_MS,
+            DISTRIBUTED_PROMOTE_AFTER_OKS,
             DISTRIBUTED_PUT_RETRIES,
+            DISTRIBUTED_SLOW_FACTOR,
+            DISTRIBUTED_SOFT_DEADLINE_FACTOR,
+            DISTRIBUTED_SOFT_DEADLINE_MIN_MS,
             DISTRIBUTED_TELEMETRY_RING,
             DISTRIBUTED_TRACE_ENABLED,
             DISTRIBUTED_WORKER_LOST_MS,
@@ -128,6 +181,18 @@ class Coordinator:
         self.breaker_ttl_s = float(c.get(RESILIENCE_BREAKER_TTL_SEC))
         self.trace_enabled = bool(c.get(DISTRIBUTED_TRACE_ENABLED))
         self.telemetry_ring = int(c.get(DISTRIBUTED_TELEMETRY_RING))
+        # gray-failure resilience (ISSUE 20)
+        self.hedge_enabled = bool(c.get(DISTRIBUTED_HEDGE_ENABLED))
+        self.soft_factor = max(
+            float(c.get(DISTRIBUTED_SOFT_DEADLINE_FACTOR)), 1.0)
+        self.soft_min_s = max(
+            int(c.get(DISTRIBUTED_SOFT_DEADLINE_MIN_MS)), 1) / 1000.0
+        self.slow_factor = max(
+            float(c.get(DISTRIBUTED_SLOW_FACTOR)), 1.0)
+        self.degrade_after = max(
+            int(c.get(DISTRIBUTED_DEGRADE_AFTER_MISSES)), 1)
+        self.promote_after = max(
+            int(c.get(DISTRIBUTED_PROMOTE_AFTER_OKS)), 1)
 
         self._lock = threading.Lock()
         self._workers: Dict[str, WorkerInfo] = {}
@@ -151,6 +216,12 @@ class Coordinator:
         self._holdings: Dict[Tuple[int, int], int] = {}
         # pids a loss re-placed, awaiting producer re-drive
         self._redrives: Dict[int, Set[int]] = {}
+        # gray failure (ISSUE 20): workers speculation moved an
+        # exchange's partitions AWAY from.  Unlike a LOST worker, a
+        # DEGRADED one still runs — release_exchange must broadcast to
+        # these former owners too, or their store copies outlive the
+        # query
+        self._former_owners: Dict[int, Set[str]] = {}
         # put-receipt reconciliation (ISSUE 15): blocks this coordinator
         # shipped vs blocks workers REPORT having received (heartbeat
         # counters: store_puts + store_put_dedups).  A rejoin resets a
@@ -369,7 +440,27 @@ class Coordinator:
         if counters is None and "ring" not in msg:
             return None
         if isinstance(counters, dict):
-            w.counters = {k: int(v) for k, v in counters.items()}
+            new = {k: int(v) for k, v in counters.items()}
+            # federated latency refinement (ISSUE 20): the heartbeat-
+            # piggybacked service-time counters contribute one mean-
+            # per-op sample per fold to the worker's p95 EWMA — a
+            # thrashing spill disk shows up here even when the driver
+            # sent it no ops this interval.  Deltas against the prior
+            # snapshot; a rejoin resets worker counters, which the
+            # negative-delta guard skips.
+            d_wall = (new.get("put_wall_ns", 0)
+                      + new.get("fetch_wall_ns", 0)
+                      - int(w.counters.get("put_wall_ns", 0))
+                      - int(w.counters.get("fetch_wall_ns", 0)))
+            d_ops = (new.get("store_puts", 0)
+                     + new.get("store_put_dedups", 0)
+                     + new.get("store_fetches", 0)
+                     - int(w.counters.get("store_puts", 0))
+                     - int(w.counters.get("store_put_dedups", 0))
+                     - int(w.counters.get("store_fetches", 0)))
+            if d_ops > 0 and d_wall >= 0:
+                self._note_sample_locked(w, (d_wall / d_ops) / 1e9)
+            w.counters = new
         w.store_stats = {k: int(msg[k]) for k in
                          ("blocks", "bytes", "mem_used", "spilled_blocks",
                           "partitions") if k in msg}
@@ -408,9 +499,10 @@ class Coordinator:
             now = time.monotonic()
             late: List[str] = []
             lost: List[str] = []
+            degraded: List[str] = []
             with self._lock:
                 for wid, w in self._workers.items():
-                    if w.state not in (ALIVE, QUARANTINED):
+                    if w.state not in (ALIVE, QUARANTINED, DEGRADED):
                         continue
                     age = now - w.last_hb
                     if age > self.lost_s:
@@ -418,8 +510,20 @@ class Coordinator:
                     elif age > self.heartbeat_s * 2 and not w.hb_missed:
                         w.hb_missed = True
                         late.append(wid)
+                    if w.state == DEGRADED and wid not in lost:
+                        degraded.append(wid)
             for wid in late:
                 PC.bump("worker_heartbeat_misses")
+            self._scan_stragglers()
+            for wid in degraded:
+                # a DEGRADED worker may carry no traffic (speculation
+                # moved its partitions), so promotion cannot wait for
+                # served ops — a timed data-port ping per scan keeps its
+                # latency EWMA fed and banks the recovery streak
+                t0 = time.monotonic()
+                alive, _refused = self._probe_alive(wid)
+                if alive:
+                    self.note_op_latency(wid, time.monotonic() - t0)
             for wid in lost:
                 # heartbeat silence alone is ambiguous on a BUSY driver:
                 # a long GIL hold (XLA compile) starves the reader
@@ -508,6 +612,210 @@ class Coordinator:
         self._postmortem(wid, reason, plan)
         return True
 
+    # -- gray failure (ISSUE 20) ----------------------------------------
+    def _note_sample_locked(self, w: WorkerInfo, wall_s: float) -> None:
+        """Fold one op wall into the worker's p95-biased latency EWMA
+        (caller holds self._lock): overshoots pull the estimate up fast,
+        undershoots bleed off slowly, so the estimate rides near the
+        tail of the distribution rather than its mean."""
+        if w.lat_ewma_s is None:
+            w.lat_ewma_s = wall_s
+        else:
+            a = 0.5 if wall_s > w.lat_ewma_s else 0.05
+            w.lat_ewma_s += a * (wall_s - w.lat_ewma_s)
+        w.lat_samples += 1
+
+    def soft_deadline_s(self, wid: str) -> Optional[float]:
+        """The worker's current per-op soft deadline:
+        max(softDeadlineMinMs, softDeadlineFactor x its p95 latency
+        EWMA); the floor alone before any samples.  None when hedging
+        is off — the caller then never hedges or counts misses."""
+        if not self.hedge_enabled:
+            return None
+        with self._lock:
+            w = self._workers.get(wid)
+            ewma = None if w is None else w.lat_ewma_s
+        if ewma is None:
+            return self.soft_min_s
+        return max(self.soft_min_s, self.soft_factor * ewma)
+
+    def note_op_latency(self, wid: str, wall_s: float) -> None:
+        """One completed data-plane op wall against one worker: feed
+        the EWMA, judge it against the soft deadline derived from the
+        PRIOR estimate (an op must not raise its own bar), and step the
+        degrade/promote streaks."""
+        degrade_evidence = None
+        promote = False
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is None or w.state in (LOST, LEFT):
+                return
+            prior = w.lat_ewma_s
+            self._note_sample_locked(w, wall_s)
+            if prior is None:
+                return
+            deadline = max(self.soft_min_s, self.soft_factor * prior)
+            if wall_s > deadline:
+                w.miss_streak += 1
+                w.ok_streak = 0
+                if w.state == ALIVE \
+                        and w.miss_streak >= self.degrade_after:
+                    degrade_evidence = (
+                        f"{w.miss_streak} consecutive soft-deadline "
+                        f"misses (last {wall_s * 1e3:.1f}ms > "
+                        f"{deadline * 1e3:.1f}ms)")
+            else:
+                w.ok_streak += 1
+                w.miss_streak = 0
+                promote = (w.state == DEGRADED
+                           and w.ok_streak >= self.promote_after
+                           and self._recovered_locked(w))
+        if degrade_evidence is not None:
+            self.declare_degraded(wid, degrade_evidence)
+        elif promote:
+            self._promote(wid)
+
+    def note_soft_deadline_miss(self, wid: str) -> None:
+        """A caller (the hedged fetch path) watched an op blow its soft
+        deadline while still in flight — count the miss now; the op's
+        eventual wall will feed the EWMA when it lands."""
+        evidence = None
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is None or w.state in (LOST, LEFT):
+                return
+            w.miss_streak += 1
+            w.ok_streak = 0
+            if w.state == ALIVE and w.miss_streak >= self.degrade_after:
+                evidence = (f"{w.miss_streak} consecutive soft-deadline "
+                            f"misses (hedged fetches)")
+        if evidence is not None:
+            self.declare_degraded(wid, evidence)
+
+    def _recovered_locked(self, w: WorkerInfo) -> bool:
+        """Caller holds self._lock: is this worker's EWMA back under
+        slowFactor x the healthy fleet's median?  Vacuously true with
+        no healthy peers to compare against."""
+        peers = [x.lat_ewma_s for x in self._workers.values()
+                 if x.state == ALIVE and x.lat_ewma_s is not None]
+        if not peers or w.lat_ewma_s is None:
+            return True
+        med = sorted(peers)[len(peers) // 2]
+        return med <= 0 or w.lat_ewma_s <= self.slow_factor * med
+
+    def _scan_stragglers(self) -> None:
+        """One monitor tick of the fleet-median rule: an ALIVE worker
+        whose EWMA sits past slowFactor x the fleet median for
+        degradeAfterMisses consecutive scans is DEGRADED — the
+        persistent-outlier complement to the per-op miss streak."""
+        victims: List[Tuple[str, float, float]] = []
+        with self._lock:
+            sam = [w.lat_ewma_s for w in self._workers.values()
+                   if w.state in (ALIVE, DEGRADED)
+                   and w.lat_ewma_s is not None and w.lat_samples >= 3]
+            if len(sam) >= 2:
+                med = sorted(sam)[len(sam) // 2]
+                for wid, w in self._workers.items():
+                    if w.state != ALIVE or w.lat_ewma_s is None \
+                            or w.lat_samples < 3:
+                        continue
+                    if med > 0 and w.lat_ewma_s > self.slow_factor * med:
+                        w.slow_ticks += 1
+                        if w.slow_ticks >= self.degrade_after:
+                            victims.append((wid, w.lat_ewma_s, med))
+                    else:
+                        w.slow_ticks = 0
+        for wid, ewma, med in victims:
+            self.declare_degraded(
+                wid, f"latency EWMA {ewma * 1e3:.1f}ms persistently > "
+                     f"slowFactor({self.slow_factor:g}) x fleet median "
+                     f"{med * 1e3:.1f}ms")
+
+    def declare_degraded(self, wid: str, evidence: str) -> bool:
+        """Demote one ALIVE worker to DEGRADED: speculate its pending
+        partitions onto healthy survivors (lineage contract, same as
+        loss recovery) WITHOUT declaring it LOST and WITHOUT the
+        quarantine breaker — a slow worker is not a dead one.  It keeps
+        heartbeating, keeps serving what it still owns, takes demoted
+        placement weight, and promotes back on sustained recovery.
+        True when this call performed the demotion."""
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is None or w.state != ALIVE:
+                return False
+            w.state = DEGRADED
+            w.degraded_since = time.monotonic()
+            w.ok_streak = 0
+            w.slow_ticks = 0
+            owned = [k for k, owner in self._placement.items()
+                     if owner == wid]
+            healthy = any(x.state == ALIVE
+                          for x in self._workers.values())
+        PC.bump("workers_degraded")
+        replaced: Dict[Tuple[int, int], str] = {}
+        if owned and healthy:
+            # speculation re-uses the loss re-placement machinery (the
+            # client re-drives from its retained producer-side queues;
+            # the worker store's per-seq idempotence discards any
+            # duplicate the in-flight originals already landed) — but
+            # only when a healthy survivor exists; with none, the
+            # partitions stay where they are (slow beats stranded)
+            replaced = self._replace_owner(owned)
+            if replaced:
+                with self._lock:
+                    for (e, _p) in replaced:
+                        self._former_owners.setdefault(e, set()).add(wid)
+                PC.bump("speculative_redrives", len(replaced))
+        plan = [{"exch": e, "pid": p, "to": to}
+                for (e, p), to in sorted(replaced.items())]
+        self._diag_event(
+            "worker_degraded", wid,
+            f"{evidence}; speculating {len(plan)} pending partitions")
+        self._flight_event("worker_degraded", worker_id=wid,
+                           evidence=evidence, speculated=len(plan))
+        self._postmortem(wid, evidence, plan, kind="worker_degraded")
+        return True
+
+    def _promote(self, wid: str) -> None:
+        """DEGRADED -> ALIVE on sustained recovery (the note_op_latency
+        streaks banked promoteAfterOks within-deadline observations and
+        the EWMA is back under the fleet bar)."""
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is None or w.state != DEGRADED:
+                return
+            w.state = ALIVE
+            since = w.degraded_since
+            w.degraded_since = None
+            w.miss_streak = 0
+            w.slow_ticks = 0
+        dur = (time.monotonic() - since) if since is not None else 0.0
+        self._diag_event("worker_promoted", wid,
+                         f"recovered after {dur * 1e3:.0f}ms degraded")
+        self._flight_event("worker_promoted", worker_id=wid,
+                           degraded_s=round(dur, 3))
+
+    def fleet_pressure(self) -> float:
+        """Fleet tail-latency pressure in [0, 1] for the governor
+        (peek-only): the DEGRADED fraction of the fleet, or — when at
+        least two workers carry latency estimates — how far the worst
+        EWMA sits past slowFactor x the median, whichever is worse."""
+        with self._lock:
+            states = [w.state for w in self._workers.values()
+                      if w.state in (ALIVE, DEGRADED)]
+            sam = [w.lat_ewma_s for w in self._workers.values()
+                   if w.state in (ALIVE, DEGRADED)
+                   and w.lat_ewma_s is not None and w.lat_samples >= 3]
+        if not states:
+            return 0.0
+        p = states.count(DEGRADED) / len(states)
+        if len(sam) >= 2:
+            med = sorted(sam)[len(sam) // 2]
+            if med > 0:
+                ratio = max(sam) / med
+                p = max(p, (ratio - self.slow_factor) / self.slow_factor)
+        return max(0.0, min(p, 1.0))
+
     def _replace_owner(
             self, keys: List[Tuple[int, int]]
     ) -> Dict[Tuple[int, int], str]:
@@ -530,6 +838,10 @@ class Coordinator:
             # already snapshotted its owned keys and will not re-run)
             live = [w for w in survivors if w.state == ALIVE]
             if not live:
+                # last resort: a DEGRADED survivor is slow, not dead —
+                # landing the keys on it beats stranding them
+                live = [w for w in survivors if w.state == DEGRADED]
+            if not live:
                 for e, p in keys:
                     self._redrives.setdefault(e, set()).add(p)
                 return out
@@ -550,7 +862,9 @@ class Coordinator:
 
     # -- placement -------------------------------------------------------
     def placeable_workers(self) -> List[WorkerInfo]:
-        """ALIVE workers plus QUARANTINED ones whose breaker TTL expired
+        """ALIVE workers, DEGRADED ones (demoted — place() divides
+        their capacity weight by slowFactor; a slow worker still beats
+        no worker), plus QUARANTINED ones whose breaker TTL expired
         (the consult admits the re-probe, flipping them placeable)."""
         from spark_rapids_tpu.resilience.breaker import get_breaker
 
@@ -558,7 +872,7 @@ class Coordinator:
         with self._lock:
             candidates = list(self._workers.values())
         for w in candidates:
-            if w.state == ALIVE:
+            if w.state in (ALIVE, DEGRADED):
                 out.append(w)
             elif w.state == QUARANTINED:
                 if get_breaker().consult((BREAKER_OP, w.worker_id),
@@ -603,13 +917,20 @@ class Coordinator:
             raise WorkerLost("<none>", "no placeable workers")
         per_pid = (est_bytes / n_parts) if est_bytes else 1.0
         loads = {w.worker_id: 0.0 for w in workers}
-        by_id = {w.worker_id: w for w in workers}
+        # capacity-weighted with DEGRADED demotion (ISSUE 20): a
+        # straggler's advertised memory counts at 1/slowFactor, so it
+        # receives proportionally fewer partitions while demoted but is
+        # never starved outright
+        cap = {w.worker_id: (w.mem_bytes / self.slow_factor
+                             if w.state == DEGRADED else
+                             float(w.mem_bytes))
+               for w in workers}
         out: Dict[int, str] = {}
         with self._lock:
             self._wire_of.setdefault(exch, next(self._wire_ids))
             for pid in range(n_parts):
-                wid = min(loads, key=lambda i: (loads[i] / by_id[i]
-                                                .mem_bytes, i))
+                wid = min(loads,
+                          key=lambda i: (loads[i] / cap[i], i))
                 loads[wid] += per_pid
                 out[pid] = wid
                 self._placement[(exch, pid)] = wid
@@ -746,6 +1067,7 @@ class Coordinator:
             if cancellable:
                 check_cancel()
             w, lock = self._data_conn_locked_args(wid)
+            t0 = time.monotonic()
             try:
                 with lock:
                     conn = self._conns.get(wid)
@@ -755,7 +1077,7 @@ class Coordinator:
                         with self._lock:
                             self._conns[wid] = conn
                     try:
-                        return P.request(conn, header, blobs)
+                        out = P.request(conn, header, blobs)
                     except (OSError, ConnectionError):
                         # one reconnect-and-retry inside the same
                         # attempt: the pooled conn may simply be stale
@@ -770,7 +1092,12 @@ class Coordinator:
                                          self.op_timeout_s)
                         with self._lock:
                             self._conns[wid] = conn
-                        return P.request(conn, header, blobs)
+                        out = P.request(conn, header, blobs)
+                # per-op latency feed (ISSUE 20): every served data-
+                # plane op walls into the worker's p95 EWMA and steps
+                # the degrade/promote streaks
+                self.note_op_latency(wid, time.monotonic() - t0)
+                return out
             except (OSError, ConnectionError, socket.timeout,
                     P.RemoteOpError, P.ProtocolCorruption) as e:
                 # ALWAYS evict the pooled conn: a corrupted frame in
@@ -795,8 +1122,34 @@ class Coordinator:
                     or (not isinstance(e, P.RemoteOpError)
                         and classify_failure(e) == TRANSIENT)
                 if retryable and attempt <= self.put_retries:
-                    time.sleep(min(0.02 * attempt, 0.2))
+                    _full_jitter_sleep(attempt)
                     continue
+                with self._lock:
+                    ww = self._workers.get(wid)
+                    is_degraded = ww is not None \
+                        and ww.state == DEGRADED
+                if is_degraded:
+                    # a DEGRADED worker that cannot serve this op is
+                    # still heartbeating — speculate whatever it still
+                    # owns (demoted placement may have landed keys on
+                    # it after the demotion) and surface the typed
+                    # degradation (the caller re-drives) without a loss
+                    # declaration or the quarantine breaker
+                    with self._lock:
+                        owned = [k for k, o in self._placement.items()
+                                 if o == wid]
+                        healthy = any(x.state == ALIVE for x in
+                                      self._workers.values())
+                    if owned and healthy:
+                        moved = self._replace_owner(owned)
+                        if moved:
+                            with self._lock:
+                                for (e2, _p2) in moved:
+                                    self._former_owners.setdefault(
+                                        e2, set()).add(wid)
+                            PC.bump("speculative_redrives", len(moved))
+                    raise WorkerDegraded(
+                        wid, f"{type(e).__name__}: {e}") from e
                 self.declare_lost(wid, f"{type(e).__name__}: {e}")
                 raise WorkerLost(wid, f"{type(e).__name__}: {e}") from e
 
@@ -938,7 +1291,7 @@ class Coordinator:
         if pull_live:
             with self._lock:
                 live = [w.worker_id for w in self._workers.values()
-                        if w.state == ALIVE]
+                        if w.state in (ALIVE, DEGRADED)]
             for wid in live:
                 self.dump_worker(wid)
         out = []
@@ -956,7 +1309,9 @@ class Coordinator:
             return {w.worker_id: {"state": w.state,
                                   "counters": dict(w.counters),
                                   "store_stats": dict(w.store_stats),
-                                  "clock_offset_s": w.clock_offset_s}
+                                  "clock_offset_s": w.clock_offset_s,
+                                  "lat_ewma_ms": (w.lat_ewma_s or 0.0)
+                                  * 1000.0}
                     for w in self._workers.values() if w.counters}
 
     def federated_store_bytes(self) -> Dict[str, int]:
@@ -1013,6 +1368,10 @@ class Coordinator:
         with self._lock:
             owners = {w for (e, _), w in self._placement.items()
                       if e == exch}
+            # speculation moved partitions off still-running DEGRADED
+            # workers — their store copies need the release broadcast
+            # too (a LOST former owner just fails the request quietly)
+            owners |= self._former_owners.pop(exch, set())
             for k in [k for k in self._placement if k[0] == exch]:
                 del self._placement[k]
                 self._holdings.pop(k, None)
@@ -1071,12 +1430,15 @@ class Coordinator:
             except Exception:
                 pass
 
-    def _postmortem(self, wid: str, reason: str, plan: List[Dict]) -> None:
+    def _postmortem(self, wid: str, reason: str, plan: List[Dict],
+                    kind: str = "worker_lost") -> None:
         """The worker-loss flight-recorder bundle: the driver's view
         (placement table + re-drive plan + membership) MERGED with the
         lost worker's last-shipped diagnostics ring + counter snapshot
         (ISSUE 15) — a SIGKILLed process cannot answer a DUMP, so what
-        its heartbeats already piggybacked is the post-mortem."""
+        its heartbeats already piggybacked is the post-mortem.  ISSUE
+        20 reuses the bundle with ``kind="worker_degraded"``: same
+        evidence shape, the worker merely stays a member."""
         from spark_rapids_tpu.telemetry import context as TEL
 
         hub = TEL.HUB
@@ -1099,7 +1461,7 @@ class Coordinator:
                             if e.get("trace")})
         try:
             hub.postmortem(
-                "worker_lost", detail=f"{wid}: {reason}", force=True,
+                kind, detail=f"{wid}: {reason}", force=True,
                 extra={"worker_id": wid,
                        "placement_table": placement,
                        "redrive_plan": plan,
@@ -1119,14 +1481,25 @@ class Coordinator:
                        if w.state == ALIVE)
             quarantined = sum(1 for w in self._workers.values()
                               if w.state == QUARANTINED)
+            degraded = sum(1 for w in self._workers.values()
+                           if w.state == DEGRADED)
             backlog = sum(len(v) for v in self._redrives.values())
             acked = self._acked_retired + sum(
                 int(w.counters.get("store_puts", 0))
                 + int(w.counters.get("store_put_dedups", 0))
                 for w in self._workers.values())
             unacked = max(self._shipped_blocks - acked, 0)
+            lat = [w.lat_ewma_s for w in self._workers.values()
+                   if w.state in (ALIVE, DEGRADED)
+                   and w.lat_ewma_s is not None]
         return {"dist_workers_live": float(live),
                 "dist_workers_quarantined": float(quarantined),
+                # gray failure (ISSUE 20): current straggler count and
+                # the fleet's worst per-worker p95 latency EWMA — the
+                # tail the governor's fleet pressure component watches
+                "dist_workers_degraded": float(degraded),
+                "dist_fleet_lat_p95_ms": (max(lat) * 1000.0
+                                          if lat else 0.0),
                 "dist_replacement_backlog": float(backlog),
                 # shipped-but-never-reported blocks: transiently nonzero
                 # within one heartbeat of shipping; persistently nonzero
@@ -1156,7 +1529,7 @@ class Coordinator:
             # sockets below cannot declare stray losses (bumping
             # counters and dumping bundles into whatever runs next)
             for w in self._workers.values():
-                if w.state in (ALIVE, QUARANTINED):
+                if w.state in (ALIVE, QUARANTINED, DEGRADED):
                     w.state = LEFT
         for s in socks:
             try:

@@ -49,6 +49,7 @@ processes can import it before paying for the full engine import.
 """
 from __future__ import annotations
 
+import itertools
 import json
 import socket
 import struct
@@ -64,10 +65,26 @@ _U32 = struct.Struct("<I")
 # guards against a corrupted length word allocating gigabytes
 MAX_FRAME_BYTES = 1 << 30
 
+# process-unique request correlation ids (``rid``): uniqueness is all
+# the desync check needs, and a global counter avoids per-socket state
+# (socket.socket carries __slots__; GIL makes next() atomic)
+_RID = itertools.count(1)
+
 
 class ProtocolCorruption(RuntimeError):
     """Bad magic / length / CRC on a control frame — deterministic (the
     same bytes re-derive the same corruption)."""
+
+
+class ProtocolDesync(ConnectionError):
+    """The reply frame read off the socket answers a DIFFERENT request
+    than the one just sent (its echoed ``rid`` mismatches).  A network
+    that duplicates or reorders frames (netchaos ``dup_frame`` /
+    ``reorder``, a misbehaving middlebox) leaves a stale reply in the
+    stream; every frame after it would be off-by-one forever, so the
+    only safe move is to abandon the connection.  A ``ConnectionError``
+    subclass: TRANSIENT, and the caller's retry dials a fresh pooled
+    connection whose request/reply cursor starts clean."""
 
 
 class RemoteOpError(RuntimeError):
@@ -87,6 +104,24 @@ class WorkerLost(ConnectionError):
     def __init__(self, worker_id: str, detail: str = ""):
         super().__init__(
             f"worker {worker_id} lost" + (f": {detail}" if detail else ""))
+        self.worker_id = worker_id
+
+
+class WorkerDegraded(WorkerLost):
+    """A worker is *slow*, not dead (ISSUE 20, gray failure): its ops
+    keep blowing the soft deadline or its latency EWMA sits past
+    slowFactor x the fleet median, and an op against it exhausted the
+    transient budget.  Classified as the WORKER_DEGRADED class — never
+    DETERMINISTIC, never the quarantine breaker: the caller re-drives
+    the affected partitions onto the healthy survivors the coordinator
+    already speculated them to, and the worker stays a member
+    (DEGRADED, promotable back on sustained recovery)."""
+
+    def __init__(self, worker_id: str, detail: str = ""):
+        ConnectionError.__init__(
+            self,
+            f"worker {worker_id} degraded"
+            + (f": {detail}" if detail else ""))
         self.worker_id = worker_id
 
 
@@ -158,9 +193,23 @@ def request(sock: socket.socket, header: Dict,
             blobs: Sequence[bytes] = ()) -> Tuple[Dict, List[bytes]]:
     """Send one message and read one reply; a reply carrying ``error``
     raises :class:`RemoteOpError` (the remote failed the op, the
-    transport itself is fine)."""
+    transport itself is fine).
+
+    Every request carries a process-unique correlation id (``rid``)
+    that the worker echoes into its reply; a mismatch means the stream
+    holds a duplicated or reordered frame and raises
+    :class:`ProtocolDesync` BEFORE the error field is consulted (a
+    stale error reply must not be attributed to this op)."""
+    rid = next(_RID)
+    header = dict(header)
+    header["rid"] = rid
     send_msg(sock, header, blobs)
     rep, rblobs = recv_msg(sock)
+    got = rep.get("rid")
+    if got != rid:
+        raise ProtocolDesync(
+            f"reply rid {got!r} answers a different request than "
+            f"{rid} — duplicated/reordered frame in the stream")
     if rep.get("error"):
         raise RemoteOpError(f"remote error: {rep['error']}")
     return rep, rblobs

@@ -565,6 +565,11 @@ class WorkerServer:
                 except Exception as e:   # a bad op must not kill the conn
                     reply, rblobs = {
                         "error": f"{type(e).__name__}: {e}"}, []
+                # echo the request's correlation id so the client can
+                # detect duplicated/reordered reply frames (ISSUE 20,
+                # protocol.ProtocolDesync)
+                if "rid" in header:
+                    reply.setdefault("rid", header["rid"])
                 try:
                     P.send_msg(conn, reply, rblobs)
                 except OSError:

@@ -20,6 +20,10 @@ framework, admission controller, or telemetry hub):
   vs ``governor.backlogTargetMs`` (0 disables the component).
 * The watchdog active-query table feeds preemption targeting (newest
   admitted = least sunk cost) and the transition detail.
+* Fleet tail latency (ISSUE 20): ``Coordinator.fleet_pressure()`` —
+  the DEGRADED fraction of the worker fleet, or how far the worst
+  per-worker latency EWMA sits past ``slowFactor`` x the median; a
+  gray worker stretches every exchange drain, so admission feels it.
 
 The fused raw pressure is the MAX of the components (overload is a
 max-bottleneck phenomenon: a full queue with an empty pool is still
@@ -162,6 +166,17 @@ class OverloadGovernor:
                 pred_ns = sum(self._predicted_ns.values())
             comp["backlog"] = (pred_ns / 1e6) / (
                 self._backlog_target_ms * float(limit))
+        from spark_rapids_tpu import distributed as _D
+
+        coord = _D.peek_coordinator()
+        if coord is not None:
+            # fleet tail latency (ISSUE 20): degraded workers and a
+            # worst-vs-median latency EWMA outlier are overload the
+            # driver-side signals cannot see — a gray worker stretches
+            # every exchange drain, so admission should feel it
+            fleet = coord.fleet_pressure()
+            if fleet > 0.0:
+                comp["fleet"] = fleet
         return (max(comp.values()) if comp else 0.0), comp
 
     # -- the update step -------------------------------------------------

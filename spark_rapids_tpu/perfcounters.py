@@ -159,6 +159,16 @@ COUNTERS: Dict[str, int] = {
     "partitions_replayed": 0,
     "dist_blocks_shipped": 0,
     "dist_block_bytes": 0,
+    # gray-failure resilience (ISSUE 20, docs/distributed.md): hedged
+    # page fetches launched after a soft-deadline miss, hedges the
+    # producer-side lineage buffer won (first-complete-wins against
+    # the slow remote), DEGRADED declarations (straggler demotion, not
+    # loss), and pending partitions speculatively re-driven off a
+    # DEGRADED worker onto healthy survivors
+    "fetch_hedges": 0,
+    "hedges_won": 0,
+    "workers_degraded": 0,
+    "speculative_redrives": 0,
     # cluster observability (ISSUE 15, docs/cluster_observability.md):
     # on-demand DUMP pulls of a worker's telemetry (ring + counters)
     # by the coordinator, and worker-side span events merged into

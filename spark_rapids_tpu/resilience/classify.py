@@ -34,6 +34,15 @@ Classes:
                       fault domain falls back WITHOUT feeding the
                       operator's circuit-breaker key (infrastructure
                       churn must not banish a healthy stage to CPU).
+  * WORKER_DEGRADED — a distributed worker is SLOW, not dead (gray
+                      failure, ISSUE 20): persistent soft-deadline
+                      misses or a latency EWMA past slowFactor x the
+                      fleet median.  Same re-drive answer as
+                      WORKER_LOST (WorkerDegraded subclasses
+                      WorkerLost) but the worker stays a member —
+                      DEGRADED, demoted in placement, promotable back
+                      — and the quarantine breaker stays closed.
+                      Never DETERMINISTIC.
 
 Framed-block I/O taxonomy (ISSUE 14): ``ConnectionError`` /
 ``BrokenPipeError`` / ``socket.timeout`` anywhere in the chain classify
@@ -50,6 +59,7 @@ TRANSIENT = "transient"
 DETERMINISTIC = "deterministic"
 PROPAGATE = "propagate"
 WORKER_LOST = "workerLost"
+WORKER_DEGRADED = "workerDegraded"
 
 # absl / XLA status codes (the string form jaxlib prefixes messages with)
 _OOM_CODES = ("RESOURCE_EXHAUSTED",)
@@ -86,6 +96,13 @@ _DETERMINISTIC_TYPE_NAMES = ("ShuffleCorruption", "SpillCorruption",
 # check — WorkerLost subclasses ConnectionError, but retry/backoff is
 # exactly the wrong response once the loss is declared
 _WORKER_LOST_TYPE_NAMES = ("WorkerLost",)
+
+# a distributed worker declared SLOW, not dead (ISSUE 20 gray failure):
+# the op exhausted its budget against a DEGRADED straggler.  Matched by
+# name BEFORE the WorkerLost check (WorkerDegraded subclasses WorkerLost
+# so existing re-drive paths handle it) and never DETERMINISTIC — a
+# straggler is infrastructure weather, never an operator bug
+_WORKER_DEGRADED_TYPE_NAMES = ("WorkerDegraded",)
 
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
 
@@ -159,6 +176,9 @@ def classify_failure(exc: BaseException) -> str:
     for link in exception_chain(exc):
         if type(link).__name__ in _PROPAGATE_TYPE_NAMES:
             return PROPAGATE
+    for link in exception_chain(exc):
+        if type(link).__name__ in _WORKER_DEGRADED_TYPE_NAMES:
+            return WORKER_DEGRADED
     for link in exception_chain(exc):
         if type(link).__name__ in _WORKER_LOST_TYPE_NAMES:
             return WORKER_LOST

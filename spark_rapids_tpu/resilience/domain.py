@@ -232,11 +232,16 @@ def run_fault_domain(op, fn, args, kwargs) -> Iterator:
                 # re-placed/re-drove what it could and quarantined the
                 # worker's own per-worker entry; losing infrastructure
                 # must not banish a healthy stage to CPU
-                if kind == CL.WORKER_LOST:
-                    _diag_event("worker_lost", name,
-                                f"{type(e).__name__}: {e}")
+                # WORKER_DEGRADED (ISSUE 20) is the same stance, one
+                # notch softer: the straggler stays a member, so there
+                # is even less reason to indict the operator
+                if kind in (CL.WORKER_LOST, CL.WORKER_DEGRADED):
+                    _diag_event(
+                        "worker_lost" if kind == CL.WORKER_LOST
+                        else "worker_degraded", name,
+                        f"{type(e).__name__}: {e}")
                 key = None if isinstance(e, ReplayMisalignment) \
-                    or kind == CL.WORKER_LOST \
+                    or kind in (CL.WORKER_LOST, CL.WORKER_DEGRADED) \
                     else _breaker_key_of(op)
                 if key is not None and not getattr(
                         e, "_srt_breaker_recorded", False):

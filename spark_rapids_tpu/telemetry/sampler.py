@@ -49,6 +49,8 @@ SAMPLED_COUNTERS = (
     "workers_joined", "worker_lost", "worker_heartbeat_misses",
     "partitions_replayed", "dist_worker_dumps",
     "dist_worker_spans_merged",
+    "fetch_hedges", "hedges_won", "workers_degraded",
+    "speculative_redrives",
     "fair_share_admissions", "serving_sessions_opened",
     "serving_sessions_closed", "result_cache_hits",
     "result_cache_misses", "result_cache_evictions",
@@ -190,6 +192,11 @@ def collect_worker_series() -> Dict[str, Dict[str, float]]:
             "gauges": {f"worker_store_{k}": float(v)
                        for k, v in view.get("store_stats", {}).items()},
         }
+        # gray failure (ISSUE 20): the coordinator's p95-biased per-op
+        # latency EWMA for this worker — the evidence a DEGRADED
+        # demotion cites, as a per-worker gauge family
+        out[wid]["gauges"]["worker_lat_ewma_ms"] = float(
+            view.get("lat_ewma_ms", 0.0))
     return out
 
 

@@ -1,0 +1,754 @@
+"""Gray-failure resilience (ISSUE 20): hedged fetches, straggler
+speculation, the ALIVE <-> DEGRADED -> LOST state machine, the typed
+WorkerDegraded classification, full-jitter retry backoff, the TKD1
+request/reply correlation (ProtocolDesync), the worker store's
+idempotence under duplicated/reordered/replayed frames, the netchaos
+injection engine, and the pinned straggler acceptance run — one worker
+delayed ~90x on its bulk replies while its heartbeats stay healthy
+must cost hedges and a DEGRADED demotion, never a loss declaration or
+a wrong answer.
+"""
+import os
+import signal
+import socket
+import struct
+import threading
+import time
+import types as pytypes
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import perfcounters as PC
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.session import TpuSession, sum_
+
+_GRAY_CONF = {
+    "spark.rapids.sql.enabled": True,
+    "spark.rapids.tpu.distributed.enabled": True,
+    "spark.sql.autoBroadcastJoinThreshold": "-1",
+    "spark.sql.adaptive.enabled": False,
+    "spark.rapids.sql.batchSizeBytes": 64 << 10,
+    "spark.rapids.sql.reader.batchSizeRows": 4000,
+    "spark.rapids.tpu.distributed.heartbeatMs": 100,
+    # generous loss window: the whole point is that gray is NOT dead
+    "spark.rapids.tpu.distributed.workerLostMs": 3000,
+    "spark.rapids.tpu.distributed.opTimeoutMs": 1200,
+    "spark.rapids.tpu.distributed.hedgeEnabled": True,
+    "spark.rapids.tpu.distributed.softDeadlineMinMs": 40,
+    "spark.rapids.tpu.distributed.softDeadlineFactor": 3.0,
+    "spark.rapids.tpu.distributed.slowFactor": 3.0,
+    "spark.rapids.tpu.distributed.degradeAfterMisses": 2,
+    "spark.rapids.tpu.distributed.promoteAfterOks": 2,
+}
+
+
+@pytest.fixture
+def coordinator():
+    from spark_rapids_tpu import distributed as D
+
+    D.reset_coordinator()
+    coord = D.get_coordinator(TpuConf(_GRAY_CONF))
+    coord.procs = []
+    try:
+        yield coord
+    finally:
+        for p in coord.procs:
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        D.reset_coordinator()
+
+
+def _spawn(coord, wid, mem_bytes=64 << 10, **kw):
+    from spark_rapids_tpu.distributed import spawn_local_worker
+
+    p = spawn_local_worker(coord, wid, mem_bytes=mem_bytes, **kw)
+    coord.procs.append(p)
+    return p
+
+
+def _wait(pred, timeout_s=10.0, period=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(period)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# classification: WorkerDegraded is typed, never DETERMINISTIC
+# ---------------------------------------------------------------------------
+
+def test_worker_degraded_classifies_degraded_never_deterministic():
+    """The typed WorkerDegraded classifies as the WORKER_DEGRADED
+    class — bare or chain-wrapped — and NEVER as DETERMINISTIC (a slow
+    worker must not indict the query's operator or trip the quarantine
+    breaker)."""
+    from spark_rapids_tpu.distributed.protocol import (
+        WorkerDegraded,
+        WorkerLost,
+    )
+    from spark_rapids_tpu.resilience.classify import (
+        DETERMINISTIC,
+        WORKER_DEGRADED,
+        classify_failure,
+    )
+
+    e = WorkerDegraded("w0", "3 consecutive soft-deadline misses")
+    assert classify_failure(e) == WORKER_DEGRADED
+    assert classify_failure(e) != DETERMINISTIC
+    # subclassing WorkerLost is the re-drive contract: every existing
+    # `except WorkerLost` recovery path handles a degradation too
+    assert isinstance(e, WorkerLost)
+    assert isinstance(e, ConnectionError)
+    try:
+        try:
+            raise e
+        except WorkerDegraded as inner:
+            raise RuntimeError("fetch failed") from inner
+    except RuntimeError as wrapped:
+        assert classify_failure(wrapped) == WORKER_DEGRADED
+
+
+def test_protocol_desync_is_transient():
+    """ProtocolDesync (a duplicated/reordered reply frame) is a
+    ConnectionError — TRANSIENT, healed by retrying on a fresh pooled
+    connection, never DETERMINISTIC."""
+    from spark_rapids_tpu.distributed.protocol import ProtocolDesync
+    from spark_rapids_tpu.resilience.classify import (
+        TRANSIENT,
+        classify_failure,
+    )
+
+    e = ProtocolDesync("reply rid 3 answers a different request than 4")
+    assert isinstance(e, ConnectionError)
+    assert classify_failure(e) == TRANSIENT
+
+
+def test_request_rid_mismatch_raises_desync():
+    """protocol.request stamps every request with a correlation id the
+    server must echo; a reply carrying a stale rid (the wire shape a
+    duplicated frame leaves behind) raises ProtocolDesync, and the
+    check fires BEFORE the error field (a stale error reply must not
+    be attributed to this op)."""
+    from spark_rapids_tpu.distributed import protocol as P
+
+    a, b = socket.socketpair()
+    try:
+        a.settimeout(5.0)
+        b.settimeout(5.0)
+
+        def server(reply_of):
+            h, _ = P.recv_msg(b)
+            P.send_msg(b, reply_of(h))
+
+        # echoing server: request succeeds
+        t = threading.Thread(
+            target=server, args=(lambda h: {"ok": True, "rid": h["rid"]},))
+        t.start()
+        rep, _ = P.request(a, {"op": "ping"})
+        t.join()
+        assert rep["ok"] is True
+
+        # stale-rid server (a duplicated earlier reply): desync, even
+        # though the stale frame also carries an error field
+        t = threading.Thread(
+            target=server,
+            args=(lambda h: {"error": "boom", "rid": h["rid"] - 1},))
+        t.start()
+        with pytest.raises(P.ProtocolDesync):
+            P.request(a, {"op": "ping"})
+        t.join()
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# full-jitter backoff (satellite: no fixed sleeps on the retry path)
+# ---------------------------------------------------------------------------
+
+def test_full_jitter_backoff_bounds_and_jitter():
+    """The distributed retry path sleeps full-jitter: uniform over
+    (0, min(base * 2^(attempt-1), cap)) — bounded, capped, and actually
+    jittered (a fixed-sleep retry loop synchronizes every client into
+    retry storms against an already-slow worker)."""
+    import random
+
+    from spark_rapids_tpu.distributed.coordinator import (
+        _full_jitter_sleep,
+    )
+
+    slept = []
+    rng = random.Random(7)
+    for attempt in range(1, 12):
+        s = _full_jitter_sleep(attempt, base_s=0.02, cap_s=0.2,
+                               sleep=slept.append, rand=rng.random)
+        assert s == slept[-1]
+        assert 0.0 <= s <= min(0.02 * 2 ** (attempt - 1), 0.2)
+    # capped: no sleep ever exceeds cap_s even at attempt 11 (2^10 x)
+    assert max(slept) <= 0.2
+    # jittered: draws differ (a fixed-sleep implementation would
+    # produce identical values at identical attempts)
+    again = [_full_jitter_sleep(5, base_s=0.02, cap_s=0.2,
+                                sleep=lambda _s: None,
+                                rand=rng.random) for _ in range(16)]
+    assert len(set(again)) > 1
+
+
+# ---------------------------------------------------------------------------
+# the DEGRADED state machine (unit: fabricated membership, no sockets)
+# ---------------------------------------------------------------------------
+
+def _fake_worker(coord, wid, mem=64 << 10):
+    from spark_rapids_tpu.distributed.coordinator import WorkerInfo
+
+    w = WorkerInfo(wid, "127.0.0.1", 1, pid=0, mem_bytes=mem,
+                   control=None)
+    with coord._lock:
+        coord._workers[wid] = w
+    return w
+
+
+def test_degrade_on_miss_streak_and_promote_on_recovery(coordinator):
+    """note_op_latency drives the full state machine: consecutive
+    soft-deadline misses demote ALIVE -> DEGRADED (bumping
+    workers_degraded and leaving a worker_degraded diagnostics event);
+    sustained within-deadline ops WITH the EWMA back under slowFactor x
+    the fleet median promote DEGRADED -> ALIVE."""
+    coord = coordinator
+    _fake_worker(coord, "g0")
+    _fake_worker(coord, "g1")
+    # healthy traffic: both workers near 2ms, estimates converge
+    for _ in range(8):
+        coord.note_op_latency("g0", 0.002)
+        coord.note_op_latency("g1", 0.002)
+    assert coord.worker_state("g0") == "ALIVE"
+    d0 = PC.snapshot()["workers_degraded"]
+    # two ESCALATING ops past the soft deadline demote (the p95-biased
+    # EWMA chases a single slow op up fast — only a worker that keeps
+    # outrunning its own rising bar banks a miss STREAK)
+    coord.note_op_latency("g0", 0.5)
+    coord.note_op_latency("g0", 5.0)
+    assert coord.worker_state("g0") == "DEGRADED"
+    assert PC.snapshot()["workers_degraded"] == d0 + 1
+    assert coord.gauges()["dist_workers_degraded"] == 1
+    assert coord.fleet_pressure() > 0.0
+    # an op can't raise its own bar: the judgment used the PRIOR
+    # estimate, so the estimate itself now rides near the 0.5s tail
+    dl = coord.soft_deadline_s("g0")
+    assert dl is not None and dl > 0.1
+    # fast again — but promotion needs BOTH the ok streak and the EWMA
+    # back under slowFactor x the healthy median, so it takes the slow
+    # 5%-per-sample bleed-down, not promoteAfterOks samples
+    n = 0
+    while coord.worker_state("g0") == "DEGRADED" and n < 500:
+        coord.note_op_latency("g0", 0.002)
+        coord.note_op_latency("g1", 0.002)
+        n += 1
+    assert coord.worker_state("g0") == "ALIVE"
+    assert n > coord.promote_after  # the EWMA gate actually gated
+    assert coord.fleet_pressure() == 0.0
+
+
+def test_degraded_demoted_in_placement_but_never_starved(coordinator):
+    """place() divides a DEGRADED worker's capacity weight by
+    slowFactor: it receives ~1/slowFactor of a healthy peer's
+    partitions while demoted — but stays placeable (slow beats
+    stranded)."""
+    coord = coordinator
+    _fake_worker(coord, "p0")
+    _fake_worker(coord, "p1")
+    for _ in range(8):
+        coord.note_op_latency("p0", 0.002)
+        coord.note_op_latency("p1", 0.002)
+    coord.note_op_latency("p0", 0.5)
+    coord.note_op_latency("p0", 5.0)
+    assert coord.worker_state("p0") == "DEGRADED"
+    placement = coord.place(900, 16)
+    on_slow = sum(1 for w in placement.values() if w == "p0")
+    assert 1 <= on_slow <= 6  # demoted (16/2=8 if healthy), not starved
+    coord.release_exchange(900)
+
+
+def test_degradation_speculates_pending_partitions(coordinator):
+    """declare_degraded re-places what the victim still owns onto
+    healthy survivors and queues the re-drives (the lineage contract)
+    WITHOUT a loss declaration — and release_exchange still reaches
+    the former owner, which (unlike a LOST worker) is alive and would
+    otherwise hold its copies forever."""
+    coord = coordinator
+    _fake_worker(coord, "s0")
+    _fake_worker(coord, "s1")
+    placement = coord.place(901, 4)
+    owned = [p for p, w in placement.items() if w == "s0"]
+    assert owned  # both placeable, load-balanced
+    d0 = PC.snapshot()
+    lost0 = d0["worker_lost"]
+    assert coord.declare_degraded("s0", "test evidence")
+    d = PC.since(d0)
+    assert d["workers_degraded"] == 1
+    assert d["speculative_redrives"] == len(owned)
+    assert PC.snapshot()["worker_lost"] == lost0  # NOT a loss
+    assert coord.worker_state("s0") == "DEGRADED"
+    for p in owned:
+        assert coord.owner_of(901, p) == "s1"
+    # the former owner is remembered for the release broadcast
+    assert "s0" in coord._former_owners.get(901, set())
+    coord.release_exchange(901)
+    assert 901 not in coord._former_owners
+
+
+def test_degraded_worker_can_still_be_declared_lost(coordinator):
+    """DEGRADED -> LOST stays reachable: a straggler that finally dies
+    (heartbeat silence, refused probe) is declared lost like any other
+    worker — DEGRADED is a detour on the way down, not a shield."""
+    coord = coordinator
+    _fake_worker(coord, "d0")
+    _fake_worker(coord, "d1")
+    assert coord.declare_degraded("d0", "slow")
+    assert coord.worker_state("d0") == "DEGRADED"
+    assert coord.declare_lost("d0", "then it died")
+    assert coord.worker_state("d0") == "LOST"
+
+
+def test_soft_deadline_floor_and_hedging_off(coordinator):
+    """Before any samples the soft deadline is the configured floor;
+    with hedging disabled it is None (callers never hedge or count
+    misses)."""
+    coord = coordinator
+    _fake_worker(coord, "f0")
+    assert coord.soft_deadline_s("f0") == pytest.approx(0.040)
+    coord.note_op_latency("f0", 0.1)
+    coord.note_op_latency("f0", 0.1)
+    assert coord.soft_deadline_s("f0") == pytest.approx(0.3)
+    coord.hedge_enabled = False
+    try:
+        assert coord.soft_deadline_s("f0") is None
+    finally:
+        coord.hedge_enabled = True
+
+
+# ---------------------------------------------------------------------------
+# hedged fetch (unit: fake coordinator, real _fetch_page)
+# ---------------------------------------------------------------------------
+
+def test_hedged_fetch_serves_remainder_from_lineage():
+    """A paged fetch that blows its soft deadline launches the hedge:
+    the lineage buffer (which retains every framed slice until commit)
+    serves the WHOLE remainder, first-complete-wins, and the straggler
+    worker is charged a soft-deadline miss.  The abandoned remote
+    reply is discarded — byte-identical by construction."""
+    from spark_rapids_tpu.distributed.client import DistributedExchange
+
+    blobs = [b"blk%d" % i for i in range(6)]
+    release = threading.Event()
+    misses = []
+
+    class FakeCoord:
+        hedge_enabled = True
+
+        def owner_of(self, exch, pid):
+            return "slowpoke"
+
+        def soft_deadline_s(self, wid):
+            return 0.05
+
+        def note_soft_deadline_miss(self, wid):
+            misses.append(wid)
+
+        def fetch_blocks(self, exch, pid, after_seq=-1, max_bytes=0):
+            release.wait(10.0)  # a straggler: far past the deadline
+            return ([after_seq + 1], [blobs[after_seq + 1]],
+                    len(blobs))
+
+    class FakeQueues:
+        def peek_blobs(self, pid):
+            return list(blobs)
+
+    dist = pytypes.SimpleNamespace(coord=FakeCoord(), queues=FakeQueues(),
+                                   exch_id=1)
+    snap = PC.snapshot()
+    try:
+        seqs, got, n = DistributedExchange._fetch_page(dist, 0, 2)
+    finally:
+        release.set()
+    d = PC.since(snap)
+    assert seqs == [2, 3, 4, 5]
+    assert got == blobs[2:]
+    assert n == len(blobs)
+    assert misses == ["slowpoke"]
+    assert d["fetch_hedges"] == 1
+    assert d["hedges_won"] == 1
+
+
+def test_fast_fetch_never_hedges():
+    """A fetch inside its soft deadline takes the remote reply with no
+    hedge, no miss, and no counter noise."""
+    from spark_rapids_tpu.distributed.client import DistributedExchange
+
+    class FakeCoord:
+        hedge_enabled = True
+
+        def owner_of(self, exch, pid):
+            return "quick"
+
+        def soft_deadline_s(self, wid):
+            return 5.0
+
+        def note_soft_deadline_miss(self, wid):
+            raise AssertionError("miss counted on a fast fetch")
+
+        def fetch_blocks(self, exch, pid, after_seq=-1, max_bytes=0):
+            return ([0], [b"x"], 1)
+
+    dist = pytypes.SimpleNamespace(coord=FakeCoord(), queues=None,
+                                   exch_id=1)
+    snap = PC.snapshot()
+    seqs, got, n = DistributedExchange._fetch_page(dist, 0, 0)
+    d = PC.since(snap)
+    assert (seqs, got, n) == ([0], [b"x"], 1)
+    assert d["fetch_hedges"] == 0
+    assert d["hedges_won"] == 0
+
+
+# ---------------------------------------------------------------------------
+# store idempotence under duplicated / reordered / replayed frames
+# ---------------------------------------------------------------------------
+
+def test_store_idempotent_under_duplicate_reorder_replay(tmp_path):
+    """Property pin (satellite): a seeded storm of duplicated,
+    reordered, and wholesale-replayed put frames against the worker
+    PartitionStore lands each sequence EXACTLY once — every repeat
+    answers "dup", the drain is byte-identical and in order, and the
+    store's put accounting counts distinct blocks only (no
+    double-count in the dist_blocks_shipped/holdings reconciliation)."""
+    import random
+
+    from spark_rapids_tpu.distributed.worker import PartitionStore
+
+    rng = random.Random(20260807)
+    store = PartitionStore(mem_bytes=1 << 10, spill_dir=str(tmp_path))
+    blobs = [bytes([i]) * (50 + 17 * i) for i in range(24)]
+
+    puts = [(s, blobs[s]) for s in range(len(blobs))]
+    storm = []
+    for _ in range(3):            # replay the whole exchange 3x
+        burst = list(puts)
+        rng.shuffle(burst)        # reordered
+        for entry in burst:
+            storm.append(entry)
+            if rng.random() < 0.3:
+                storm.append(entry)   # duplicated back-to-back
+    landed = {"first": 0, "dup": 0}
+    seen = set()
+    for s, blob in storm:
+        where = store.put(7, 0, s, blob)
+        if s in seen:
+            assert where == "dup", (s, where)
+            landed["dup"] += 1
+        else:
+            assert where in ("mem", "disk"), (s, where)
+            seen.add(s)
+            landed["first"] += 1
+    assert landed["first"] == len(blobs)
+    assert landed["dup"] == len(storm) - len(blobs)
+    seqs, got, n_total = store.fetch(7, 0)
+    assert n_total == len(blobs)
+    assert seqs == list(range(len(blobs)))
+    assert got == blobs          # byte-identical, ordered, exactly once
+
+
+# ---------------------------------------------------------------------------
+# netchaos: the injection engine itself
+# ---------------------------------------------------------------------------
+
+def _frames(n, size=40):
+    from spark_rapids_tpu.distributed.protocol import encode_msg
+
+    return [encode_msg({"i": i, "pad": "x" * size}) for i in range(n)]
+
+
+def test_split_frames_respects_tkd1_boundaries():
+    from spark_rapids_tpu.distributed.netchaos import _split_frames
+
+    fs = _frames(3)
+    blob = b"".join(fs)
+    # whole frames + a partial tail stay split exactly on boundaries
+    got, rest = _split_frames(blob + fs[0][:7])
+    assert got == fs
+    assert rest == fs[0][:7]
+    # a non-TKD1 prefix passes through as one pseudo-frame (the proxy
+    # must never wedge on bytes it doesn't understand)
+    got, rest = _split_frames(b"garbage-prefix" + blob)
+    assert got == [b"garbage-prefix" + blob]
+    assert rest == b""
+
+
+def test_injections_are_seed_deterministic():
+    """Two injections spawned from the same spec/connection index
+    transform the same byte stream identically — a sweep failure
+    replays."""
+    from spark_rapids_tpu.distributed.netchaos import (
+        ChaosSpec,
+        _split_frames,
+    )
+
+    fs = _frames(12)
+    data = b"".join(fs)
+    for kind, params in (("dup_frame", {"p": 0.5}),
+                         ("reorder", {"p": 0.5})):
+        spec = ChaosSpec(13, {"w2c": (kind, params)})
+        outs = []
+        for _ in range(2):
+            inj = spec.spawn(4)["w2c"]
+            outs.append(inj.feed(data, {}) + inj.flush())
+        assert outs[0] == outs[1]
+        # the output is made of whole input frames (possibly repeated
+        # or swapped), never torn ones
+        rebuilt, rest = _split_frames(outs[0])
+        assert rest == b""
+        assert set(rebuilt) <= set(fs)
+        if kind == "reorder":
+            assert sorted(rebuilt, key=fs.index) == fs  # a permutation
+        else:
+            assert [f for f in rebuilt if rebuilt.count(f) == 1] \
+                or len(rebuilt) >= len(fs)
+
+
+def test_injection_drop_after_and_reset_and_min_bytes():
+    from spark_rapids_tpu.distributed.netchaos import (
+        ChaosSpec,
+        _ResetSignal,
+    )
+
+    fs = _frames(4, size=100)
+    data = b"".join(fs)
+    # drop_after forwards exactly N bytes then swallows the rest
+    inj = ChaosSpec(1, {"w2c": ("drop_after",
+                                {"after_bytes": 100})}).spawn(0)["w2c"]
+    assert inj.feed(data, {}) == data[:100]
+    assert inj.feed(b"more", {}) == b""
+    # reset raises the RST signal once past the threshold
+    inj = ChaosSpec(1, {"c2w": ("reset",
+                                {"after_bytes": 10})}).spawn(0)["c2w"]
+    with pytest.raises(_ResetSignal):
+        inj.feed(data, {})
+    # delay with min_bytes: small frames pass undelayed (assert via
+    # wall clock — 4 small frames under a 0.2s/frame delay must return
+    # immediately)
+    inj = ChaosSpec(1, {"w2c": ("delay",
+                                {"delay_s": 0.2,
+                                 "min_bytes": 1 << 20})}).spawn(0)["w2c"]
+    t0 = time.monotonic()
+    assert inj.feed(data, {}) == data
+    assert time.monotonic() - t0 < 0.15
+    # half_open: the trigger stalls the shared connection state
+    inj = ChaosSpec(1, {"c2w": ("half_open",
+                                {"after_bytes": 10})}).spawn(0)["c2w"]
+    state = {}
+    inj.feed(data, state)
+    assert state.get("stalled") is True
+
+
+# ---------------------------------------------------------------------------
+# hard timeout: a SIGSTOPped worker mid-reply must never hang an op
+# ---------------------------------------------------------------------------
+
+def test_sigstopped_worker_never_hangs_an_op(coordinator):
+    """Satellite pin: every blocking TKD1 client read carries the
+    opTimeoutMs socket timeout, so an op against a worker SIGSTOPped
+    mid-conversation fails structurally (TRANSIENT timeout -> bounded
+    retries -> typed loss/degradation) in bounded time instead of
+    hanging the collect forever."""
+    coord = coordinator
+    _spawn(coord, "z0")
+    assert coord.wait_for_workers(1, timeout_s=30)
+    pid = coord.procs[0].pid
+    assert coord.worker_stats("z0").get("ok")  # conversational first
+    os.kill(pid, signal.SIGSTOP)
+    # os.kill returns once the signal is QUEUED; the worker can still
+    # win a sub-millisecond loopback roundtrip before the kernel stops
+    # it — wait for the process to actually reach the stopped state
+    assert _wait(lambda: open(f"/proc/{pid}/stat").read()
+                 .rsplit(")", 1)[1].split()[0] == "T",
+                 timeout_s=10.0, period=0.01), "worker never stopped"
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):   # WorkerLost/Degraded
+            coord.worker_stats("z0")
+        wall = time.monotonic() - t0
+        # opTimeout(1.2s) x (put_retries+1) attempts x 2 (one in-attempt
+        # reconnect each) + jitter: generously bounded, NOT unbounded
+        bound = coord.op_timeout_s * 2 * (coord.put_retries + 2) + 2.0
+        assert wall < bound, f"hung {wall:.1f}s (bound {bound:.1f}s)"
+    finally:
+        os.kill(pid, signal.SIGCONT)
+
+
+# ---------------------------------------------------------------------------
+# the pinned straggler acceptance run (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+def test_straggler_join_hedges_degrades_and_promotes(coordinator):
+    """THE acceptance pin: a 2-worker distributed join with ONE worker's
+    bulk replies delayed ~90x (netchaos per-frame delay; tiny acks and
+    all heartbeats healthy).  The query must stay oracle-equal at
+    bounded cost (<= ~3x the healthy wall), hedged fetches must fire
+    and win from the lineage buffer, the straggler must be demoted
+    DEGRADED — speculating its pending partitions onto the healthy
+    survivor — with a worker_degraded post-mortem naming it, the loss
+    path and quarantine breaker must stay untouched, and once the
+    weather lifts the worker must earn promotion back to ALIVE.  Leak
+    reports stay empty."""
+    from spark_rapids_tpu import telemetry as _tel
+    from spark_rapids_tpu.distributed import netchaos
+    from spark_rapids_tpu.lifecycle import leak_report_all
+    from spark_rapids_tpu.resilience.breaker import get_breaker
+
+    coord = coordinator
+    _spawn(coord, "st0", mem_bytes=8 << 10)
+    _spawn(coord, "st1", mem_bytes=8 << 10)
+    assert coord.wait_for_workers(2, timeout_s=30)
+
+    rng = np.random.default_rng(3)
+    rows, n_dim = 12_000, 300
+    fk = rng.integers(0, n_dim, rows).tolist()
+    fv = rng.integers(-100, 100, rows).tolist()
+    dk = list(range(n_dim))
+    dg = [i % 7 for i in range(n_dim)]
+    fact_schema = T.StructType([T.StructField("k", T.INT),
+                                T.StructField("v", T.LONG)])
+    dim_schema = T.StructType([T.StructField("k", T.INT),
+                               T.StructField("g", T.INT)])
+
+    def build(s):
+        fact = s.create_dataframe({"k": fk, "v": fv}, fact_schema)
+        dim = s.create_dataframe({"k": dk, "g": dg}, dim_schema)
+        return (fact.join(dim, on="k", how="inner")
+                .group_by("g").agg(sum_("v", "sv")))
+
+    oracle = sorted(build(
+        TpuSession({"spark.rapids.sql.enabled": False})).collect())
+
+    # healthy baseline (also warms compile caches so the wall ratio
+    # compares execution, not compilation)
+    sorted(build(TpuSession(_GRAY_CONF)).collect())
+    t0 = time.monotonic()
+    healthy = sorted(build(TpuSession(_GRAY_CONF)).collect())
+    healthy_wall = time.monotonic() - t0
+    assert healthy == oracle
+
+    with coord._lock:
+        direct = (coord._workers["st0"].host,
+                  coord._workers["st0"].data_port)
+    proxy = netchaos.interpose(coord, "st0")
+    try:
+        # min_bytes splits the victim's reply population: put acks and
+        # one-blob completeness probes (<2KB here) pass fast — keeping
+        # its latency EWMA (and thus the adaptive soft deadline) honest
+        # — while every multi-blob bulk page (>2.5KB) crawls at ~90x,
+        # so the page fetches blow their deadlines and hedge
+        proxy.set_spec(netchaos.ChaosSpec(11, {
+            "w2c": ("delay", {"delay_s": 0.18, "min_bytes": 2000})}))
+        snap = PC.snapshot()
+        t0 = time.monotonic()
+        got = sorted(build(TpuSession(_GRAY_CONF)).collect())
+        gray_wall = time.monotonic() - t0
+        d = PC.since(snap)
+
+        assert got == oracle                       # zero wrong answers
+        assert d["fetch_hedges"] > 0, d            # hedges launched
+        assert d["hedges_won"] > 0, d              # lineage served
+        assert d["workers_degraded"] >= 1, d       # demoted...
+        assert d["speculative_redrives"] > 0, d    # ...and speculated
+        assert d["worker_lost"] == 0, d            # NEVER a loss
+        assert d["breaker_trips"] == 0, d
+        assert coord.worker_state("st0") == "DEGRADED"
+        assert coord.worker_state("st1") == "ALIVE"
+        # the breaker holds no entry for the straggler (degradation
+        # must not quarantine)
+        assert not any("st0" in str(k)
+                       for k, _s, _f in get_breaker().snapshot())
+        # bounded cost: hedges keep the straggler off the critical
+        # path (3x + fixed slack for the demotion machinery itself)
+        assert gray_wall <= 3.0 * healthy_wall + 2.0, \
+            f"gray {gray_wall:.2f}s vs healthy {healthy_wall:.2f}s"
+        # the post-mortem names the worker and carries the evidence
+        hub = _tel.get_hub()
+        if hub is not None and hub.flight_enabled:
+            named = [b for b in hub.postmortems
+                     if b.get("reason") == "worker_degraded"
+                     and b.get("worker_id") == "st0"]
+            assert named, "no worker_degraded post-mortem names st0"
+
+        # lift the weather — spec cleared AND direct wiring restored
+        # (the promote gate compares st0's probe latency against st1's
+        # DIRECT latency; leaving the extra proxy hop in place would
+        # hold the EWMA at the bar forever): monitor probes refill the
+        # EWMA and the worker earns promotion back (ALIVE <-> DEGRADED,
+        # both ways)
+        proxy.clear()
+        with coord._lock:
+            w = coord._workers["st0"]
+            w.host, w.data_port = direct
+            stale = coord._conns.pop("st0", None)
+        if stale is not None:
+            stale.close()
+        # ... with a trickle of real traffic keeping the EWMA honest:
+        # the promote gate compares st0 against st1's POOLED-connection
+        # op latencies, so recovery must be measured the same way
+        # (fresh-connect monitor probes alone carry a constant handicap
+        # that can hold the estimate at the bar)
+        def _recovering():
+            try:
+                coord.worker_stats("st0")
+            except ConnectionError:
+                pass
+            return coord.worker_state("st0") == "ALIVE"
+
+        assert _wait(_recovering, timeout_s=25.0, period=0.05), \
+            coord.worker_state("st0")
+    finally:
+        proxy.close()
+    assert leak_report_all() == []
+
+
+# ---------------------------------------------------------------------------
+# bench gate: the rung4_dist hedging-overhead columns
+# ---------------------------------------------------------------------------
+
+def test_bench_gate_hedge_overhead_and_won_pins():
+    """The healthy hedging A/B is gated absolutely: on/off delta past
+    HEDGE_OVERHEAD_MAX_PCT fails (deadline bookkeeping leaked onto the
+    fetch path), and ANY hedge won on a healthy cluster fails (the
+    soft-deadline estimate fired against workers that are fine).
+    Records predating the columns (None) stay ungated."""
+    import sys as _sys
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                     os.pardir, "tools"))
+    from bench_gate import gate
+
+    def payload(overhead, won=0.0):
+        return {"value": 1.0, "queries": {"rung4_dist": {
+            "tpu_s": 5.0, "killArmed": True, "workerLost": 1.0,
+            "partitionsReplayed": 2.0, "distBlocksShipped": 10.0,
+            "hedgeOnWall_s": 5.0 * (1 + overhead / 100.0),
+            "hedgeOffWall_s": 5.0, "hedgeOverheadPct": overhead,
+            "hedgesWon": won}}}
+
+    assert gate(payload(1.0), payload(1.5)) == []
+    regs = gate(payload(1.0), payload(7.0))
+    assert any("hedged-fetch overhead" in r for r in regs), regs
+    regs = gate(payload(1.0), payload(1.0, won=2.0))
+    assert any("healthy cluster" in r for r in regs), regs
+    # records predating the columns (None) stay ungated
+    old = payload(0.0)
+    old["queries"]["rung4_dist"]["hedgeOverheadPct"] = None
+    old["queries"]["rung4_dist"]["hedgesWon"] = None
+    assert gate(old, old) == []

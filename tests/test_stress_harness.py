@@ -104,6 +104,31 @@ def test_driver_kill_twin():
     assert r["stranded_blocks"] == 0
 
 
+def test_net_chaos_twin():
+    """``run_chaos.py --net`` engine (ISSUE 20), tier-1 size: a
+    2-worker distributed join with one worker's data plane interposed
+    through the netchaos proxy, sweeping a straggler cell (per-frame
+    delay on bulk replies, hedging on) and a duplicated-frame cell.
+    Every cell must match the CPU oracle with zero unstructured
+    failures, the delay cell must launch at least one hedged fetch and
+    demote the victim to DEGRADED (leaving a worker_degraded
+    post-mortem naming it), and the leak report must be empty.  The
+    CLI runs the full kinds x hedging-on/off matrix."""
+    from run_stress import run_net_chaos
+
+    s = run_net_chaos(n_workers=2, seed=20260807,
+                      kinds=("delay", "dup_frame"), hedging=(True,),
+                      rows=8_000, quiet=True, recover_s=4.0)
+    assert not s["failures"], s["failures"]
+    assert not s["leaks"], s["leaks"]
+    assert all(c["match"] for c in s["cells"]), s["cells"]
+    delay = next(c for c in s["cells"] if c["kind"] == "delay")
+    assert delay["fetch_hedges"] >= 1, s["cells"]
+    assert delay["workers_degraded"] >= 1, s["cells"]
+    assert delay["victim_state"] != "LOST"
+    assert s["postmortems_named"] >= 1
+
+
 def test_hot_cache_trace_replay():
     """``run_stress.py --hot-cache`` engine (ISSUE 6): 8 workers replay
     the same parquet table concurrently — every warm replay must be a

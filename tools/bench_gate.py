@@ -40,7 +40,10 @@ non-zero when the new run regressed past the tolerance:
   wall must stay within ``--tolerance`` (+3s absolute slack for the
   loss-detection window), and a kill-armed run must record both a
   ``workerLost`` declaration and ``partitionsReplayed > 0`` — a wrong
-  answer or an unrecovered loss fails loudly;
+  answer or an unrecovered loss fails loudly; the hedging-on vs -off
+  healthy A/B (ISSUE 20) must stay within ``HEDGE_OVERHEAD_MAX_PCT``
+  (2%, absolute) with ``hedgesWon == 0`` (a hedge that WINS on a
+  healthy cluster means the soft-deadline estimate is mis-calibrated);
 * ``rung5_recovery`` (ISSUE 16): the journal-on vs journal-off
   hot-path A/B must stay within ``JOURNAL_OVERHEAD_MAX_PCT`` (2%,
   absolute — self-contained per run), and the kill-at-50% resume must
@@ -91,6 +94,15 @@ RECOVERY_SLACK_S = 1.0
 # per-STAGE-COMMIT, never per-row or per-batch, so growth here means
 # durability work leaked onto the hot path
 JOURNAL_OVERHEAD_MAX_PCT = 2.0
+# gray-failure pin (ISSUE 20): the rung4_dist hedging-on vs hedging-off
+# healthy A/B (min of 2 runs per mode) must stay within this many
+# percent — the hedging machinery is a per-PAGE deadline computation
+# plus an armed-but-idle timer, never per-row work, so growth here
+# means deadline bookkeeping leaked onto the fetch hot path.  A healthy
+# cluster must also win every race remotely: hedgesWon > 0 with no
+# straggler means the soft-deadline estimate is mis-calibrated and
+# hedges burn lineage-buffer reads for nothing
+HEDGE_OVERHEAD_MAX_PCT = 2.0
 # progressOverhead (ISSUE 12): absolute percentage-point slack — the
 # A/B times sub-second collects, so small relative drift is noise
 PROGRESS_OVERHEAD_SLACK_PP = 10.0
@@ -372,6 +384,27 @@ def gate(base: Dict, new: Dict, tolerance: float = DEFAULT_TOLERANCE,
                 f"{float(n4.get('traceOnWall_s') or 0):.3f}s vs "
                 f"trace-off "
                 f"{float(n4.get('traceOffWall_s') or 0):.3f}s)")
+        # hedged-fetch overhead column (ISSUE 20): absolute pin — the
+        # hedging-on/off A/B runs on a healthy (post-recovery) cluster,
+        # so overhead past the pin means deadline bookkeeping leaked
+        # onto the fetch path, and any hedge WON healthy means the
+        # p95-EWMA soft deadline fires against workers that are fine
+        hp = n4.get("hedgeOverheadPct")
+        if hp is not None and float(hp) > HEDGE_OVERHEAD_MAX_PCT:
+            regressions.append(
+                f"rung4_dist: hedged-fetch overhead "
+                f"{float(hp):+.1f}% exceeds the "
+                f"{HEDGE_OVERHEAD_MAX_PCT:.0f}% pin (hedge-on "
+                f"{float(n4.get('hedgeOnWall_s') or 0):.3f}s vs "
+                f"hedge-off "
+                f"{float(n4.get('hedgeOffWall_s') or 0):.3f}s)")
+        hw = n4.get("hedgesWon")
+        if hw is not None and float(hw) > 0:
+            regressions.append(
+                f"rung4_dist: {float(hw):.0f} hedge(s) WON on a "
+                f"healthy cluster — the soft-deadline estimate is "
+                f"mis-calibrated (hedges should only win against a "
+                f"real straggler)")
 
     # gating rung5_recovery (ISSUE 16): the crash-consistent recovery
     # rung — the journal-on hot-path overhead is an ABSOLUTE pin

@@ -6,6 +6,19 @@ over the representative join+agg+sort+expr query and print a summary.
     python tools/run_chaos.py --corrupt-inputs [--seed 7]
     python tools/run_chaos.py --pressure [--seed 7]
     python tools/run_chaos.py --worker-kill [--seed 7]
+    python tools/run_chaos.py --net [--seed 7]
+
+``--net`` (ISSUE 20) sweeps NETWORK gray failure instead of process
+death: the ``tools/run_stress.py --net`` engine interposes ONE
+worker's data plane through the in-process netchaos TCP proxy —
+injecting per-frame delay, bandwidth throttle, silent drop-after-N,
+half-open stalls, duplicated/reordered frames, and mid-stream RSTs —
+while its control-plane heartbeats stay healthy (the failure shape
+SIGKILL chaos cannot produce), crossed with hedging on/off.  The pin:
+zero wrong answers, zero unstructured failures, every degradation
+leaves a ``worker_degraded`` post-mortem NAMING the victim, slow kinds
+(delay/throttle) end in DEGRADED — never LOST — and the leak report is
+empty afterwards.
 
 ``--worker-kill`` (ISSUE 14) sweeps WORKER-PROCESS churn instead of
 operator faults: the ``tools/run_stress.py --worker-kill`` engine
@@ -262,6 +275,33 @@ def run_worker_kill_sweep(seed: int, workers: int, rounds: int,
     return ok
 
 
+def run_net_chaos_sweep(seed: int, workers: int) -> bool:
+    """The --net sweep (ISSUE 20): one worker's data plane through the
+    netchaos proxy, injection kinds x hedging on/off
+    (run_stress.run_net_chaos)."""
+    import json
+
+    from run_stress import run_net_chaos
+
+    print(f"\n== net-chaos sweep ({workers} workers, one victim "
+          f"proxied, kinds x hedging on/off) ==")
+    s = run_net_chaos(n_workers=workers, seed=seed, quiet=False)
+    print(json.dumps({k: s[k] for k in (
+        "kinds", "hedging", "hedges", "hedges_won", "degraded_cells",
+        "postmortems_named")}, indent=2))
+    for f in s["failures"]:
+        print(f"FAILURE: {f}")
+    for leak in s["leaks"]:
+        print(f"LEAK: {leak.splitlines()[0]}")
+    ok = not s["failures"] and not s["leaks"] \
+        and all(c["match"] for c in s["cells"])
+    if s["degraded_cells"] and not s["postmortems_named"]:
+        print("FAILURE: no worker_degraded post-mortem named the victim")
+        ok = False
+    print("net-chaos sweep:", "OK" if ok else "FAILED")
+    return ok
+
+
 def run_driver_kill_sweep(seed: int, workers: int, rows: int,
                           kill_points: str = "") -> bool:
     """The --driver-kill sweep (ISSUE 16): SIGKILL the DRIVER process
@@ -307,6 +347,14 @@ def main():
                          "SIGSTOP random workers during a distributed "
                          "replay, pinning zero wrong answers and zero "
                          "hard failures")
+    ap.add_argument("--net", action="store_true",
+                    help="sweep network gray failure: one worker's "
+                         "data plane through the netchaos proxy "
+                         "(delay/throttle/drop/half-open/dup/reorder/"
+                         "reset x hedging on/off) with healthy "
+                         "heartbeats, pinning zero wrong answers, "
+                         "structured degradation only, and named "
+                         "worker_degraded post-mortems")
     ap.add_argument("--driver-kill", action="store_true",
                     help="sweep driver-process SIGKILLs (mid-plan, "
                          "mid-shuffle, post-commit) with restart + "
@@ -333,6 +381,8 @@ def main():
                          "file")
     args = ap.parse_args()
 
+    if args.net:
+        return 0 if run_net_chaos_sweep(args.seed, args.workers) else 1
     if args.driver_kill:
         return 0 if run_driver_kill_sweep(
             args.seed, max(args.workers, 2), args.rows,

@@ -1125,6 +1125,204 @@ def run_worker_kill(n_workers: int = 3, rounds: int = 4, seed: int = 7,
         D.reset_coordinator()
 
 
+# gray-failure sweep (ISSUE 20): kinds whose victim stays *slow but
+# alive* — these must end in DEGRADED (or recovered ALIVE), never LOST.
+# Destructive kinds (drop_after / half_open / reset) may legitimately
+# escalate to a loss declaration once the transient budget exhausts.
+SLOW_NET_KINDS = ("delay", "throttle")
+NET_KINDS = ("delay", "throttle", "drop_after", "half_open",
+             "dup_frame", "reorder", "reset")
+
+
+def _net_injection(kind: str):
+    """(direction, params) for one --net sweep cell.  Slow kinds ride
+    the worker->client reply path (a straggler answers, late);
+    dup_frame rides client->worker so the store's per-seq idempotence
+    is what dedups the replayed put; the rest pick the direction that
+    makes the gray shape nastiest."""
+    return {
+        # min_bytes lets tiny put-acks pass so the straggler's EWMA
+        # stays honest until its bulk fetch replies blow the deadline
+        "delay":      ("w2c", {"delay_s": 0.18, "min_bytes": 1024}),
+        "throttle":   ("w2c", {"bytes_per_s": 96 << 10}),
+        "drop_after": ("w2c", {"after_bytes": 6000}),
+        "half_open":  ("c2w", {"after_bytes": 6000}),
+        "dup_frame":  ("c2w", {"p": 0.5}),
+        "reorder":    ("w2c", {"p": 0.25}),
+        "reset":      ("w2c", {"after_bytes": 8000}),
+    }[kind]
+
+
+def run_net_chaos(n_workers: int = 3, seed: int = 7,
+                  kinds=NET_KINDS, hedging=(True, False),
+                  rows: int = 24_000, worker_mem: int = 8 << 10,
+                  quiet: bool = False, recover_s: float = 12.0) -> dict:
+    """ISSUE 20: the --net chaos engine — a distributed join replay
+    with ONE worker's data plane interposed through the netchaos TCP
+    proxy, sweeping injection kinds x hedging on/off.  Heartbeats ride
+    the worker's own control connection and bypass the proxy: a gray
+    data plane under a healthy control plane, the failure shape
+    SIGKILL chaos cannot produce.  Pins: zero wrong answers (every
+    cell matches the CPU oracle — hedged from the producer-side
+    lineage, speculated to survivors, or absorbed by transient
+    retries), zero unstructured failures, every cell that degraded the
+    victim left a worker_degraded post-mortem NAMING it, slow kinds
+    (delay/throttle) never end in LOST, and empty leak reports."""
+    import numpy as np
+
+    from spark_rapids_tpu import perfcounters as PC
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu import distributed as D
+    from spark_rapids_tpu.distributed import netchaos
+    from spark_rapids_tpu.lifecycle import leak_report_all
+    from spark_rapids_tpu.session import TpuSession, sum_
+
+    nrng = np.random.default_rng(seed)
+    n_dim = 400
+    fk = nrng.integers(0, n_dim, rows).tolist()
+    fv = nrng.integers(-100, 100, rows).tolist()
+    dk = list(range(n_dim))
+    dg = [i % 11 for i in range(n_dim)]
+    fact_schema = T.StructType([T.StructField("k", T.INT),
+                                T.StructField("v", T.LONG)])
+    dim_schema = T.StructType([T.StructField("k", T.INT),
+                               T.StructField("g", T.INT)])
+
+    def build(s):
+        fact = s.create_dataframe({"k": fk, "v": fv}, fact_schema)
+        dim = s.create_dataframe({"k": dk, "g": dg}, dim_schema)
+        return (fact.join(dim, on="k", how="inner")
+                .group_by("g").agg(sum_("v", "sv")))
+
+    oracle = sorted(build(
+        TpuSession({"spark.rapids.sql.enabled": False})).collect())
+
+    cells, failures = [], []
+    postmortems_named = 0
+    for hedge in hedging:
+        conf = {
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.tpu.distributed.enabled": True,
+            "spark.sql.autoBroadcastJoinThreshold": "-1",
+            "spark.sql.adaptive.enabled": False,
+            "spark.rapids.sql.batchSizeBytes": 64 << 10,
+            "spark.rapids.sql.reader.batchSizeRows": 4000,
+            "spark.rapids.tpu.distributed.heartbeatMs": 100,
+            # generous loss window: gray is not dead, and the control
+            # plane stays healthy throughout
+            "spark.rapids.tpu.distributed.workerLostMs": 3000,
+            "spark.rapids.tpu.distributed.opTimeoutMs": 1200,
+            "spark.rapids.tpu.distributed.hedgeEnabled": hedge,
+            "spark.rapids.tpu.distributed.softDeadlineMinMs": 40,
+            "spark.rapids.tpu.distributed.softDeadlineFactor": 3.0,
+            "spark.rapids.tpu.distributed.slowFactor": 3.0,
+            "spark.rapids.tpu.distributed.degradeAfterMisses": 2,
+            "spark.rapids.tpu.distributed.promoteAfterOks": 2,
+        }
+        D.reset_coordinator()
+        coord = D.get_coordinator(TpuConf(conf))
+        procs = {}
+        for k in range(n_workers):
+            wid = f"nw{k}"
+            procs[wid] = D.spawn_local_worker(coord, wid,
+                                              mem_bytes=worker_mem)
+        coord.wait_for_workers(n_workers, timeout_s=30)
+        victim = "nw0"
+        proxy = netchaos.interpose(coord, victim)
+        try:
+            for i, kind in enumerate(kinds):
+                direction, params = _net_injection(kind)
+                proxy.set_spec(netchaos.ChaosSpec(
+                    seed * 1000 + i, {direction: (kind, params)}))
+                snap = PC.snapshot()
+                t0 = time.monotonic()
+                label = f"{kind}/hedge={'on' if hedge else 'off'}"
+                rows_got = None
+                try:
+                    rows_got = sorted(build(TpuSession(conf)).collect())
+                except Exception as e:   # noqa: BLE001 — report matrix
+                    failures.append(
+                        f"{label}: {type(e).__name__}: {e}")
+                wall = time.monotonic() - t0
+                proxy.clear()
+                d = PC.since(snap)
+                if rows_got is not None and rows_got != oracle:
+                    failures.append(f"{label}: WRONG ANSWER "
+                                    f"({len(rows_got)} rows)")
+                state = coord.worker_state(victim)
+                if kind in SLOW_NET_KINDS and state == "LOST":
+                    failures.append(
+                        f"{label}: slow-but-alive victim declared "
+                        f"LOST (gray failure escalated to a loss)")
+                # every degradation must leave a post-mortem NAMING
+                # the victim (checked per cell: the bundle ring is
+                # bounded and later cells would rotate it out)
+                named = _count_degraded_postmortems(victim)
+                if d["workers_degraded"] and not named:
+                    failures.append(
+                        f"{label}: victim degraded but no "
+                        f"worker_degraded post-mortem names it")
+                postmortems_named = max(postmortems_named, named)
+                # let the victim earn promotion back before the next
+                # cell (probe pings refill its EWMA once the weather
+                # lifts); a destructive kind may have lost it for good
+                deadline = time.monotonic() + recover_s
+                while coord.worker_state(victim) == "DEGRADED" \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.1)
+                cells.append({
+                    "kind": kind, "hedge": hedge, "wall_s": round(wall, 3),
+                    "match": rows_got == oracle,
+                    "victim_state": state,
+                    "recovered": coord.worker_state(victim) == "ALIVE",
+                    "fetch_hedges": d["fetch_hedges"],
+                    "hedges_won": d["hedges_won"],
+                    "workers_degraded": d["workers_degraded"],
+                    "speculative_redrives": d["speculative_redrives"],
+                })
+                if not quiet:
+                    c = cells[-1]
+                    print(f"{label:22s} match={c['match']} "
+                          f"state={c['victim_state']} "
+                          f"hedges={c['fetch_hedges']}/{c['hedges_won']} "
+                          f"degraded={c['workers_degraded']} "
+                          f"redrives={c['speculative_redrives']} "
+                          f"wall={c['wall_s']}s")
+        finally:
+            proxy.close()
+            for p in procs.values():
+                try:
+                    p.kill()
+                    p.wait(timeout=10)
+                except Exception:
+                    pass
+            D.reset_coordinator()
+    leaks = leak_report_all()
+    return {
+        "mode": "net_chaos", "workers": n_workers, "cells": cells,
+        "kinds": list(kinds), "hedging": list(hedging),
+        "postmortems_named": postmortems_named,
+        "hedges": sum(c["fetch_hedges"] for c in cells),
+        "hedges_won": sum(c["hedges_won"] for c in cells),
+        "degraded_cells": sum(1 for c in cells if c["workers_degraded"]),
+        "failures": failures, "leaks": leaks,
+    }
+
+
+def _count_degraded_postmortems(victim: str) -> int:
+    """worker_degraded flight bundles naming ``victim`` currently in
+    the (bounded) post-mortem ring."""
+    from spark_rapids_tpu import telemetry as _tel
+
+    hub = _tel.get_hub()
+    if hub is None or not hub.flight_enabled:
+        return 0
+    return sum(1 for b in hub.postmortems
+               if b.get("reason") == "worker_degraded"
+               and b.get("worker_id") == victim)
+
+
 def _driver_kill_query(s, rows: int, seed: int):
     """The deterministic distributed join+agg both driver incarnations
     (and the parent's CPU oracle) build — same data from the seed."""
@@ -1464,9 +1662,20 @@ def main() -> int:
                          "a loss declaration per kill, empty leaks "
                          "(tools/run_chaos.py --worker-kill runs this "
                          "same engine)")
+    ap.add_argument("--net", action="store_true",
+                    help="ISSUE 20: gray-failure sweep — one worker's "
+                         "data plane interposed through the netchaos "
+                         "TCP proxy (delay/throttle/drop/half-open/"
+                         "dup/reorder/reset x hedging on/off) while "
+                         "heartbeats stay healthy; pins zero wrong "
+                         "answers, zero unstructured failures, "
+                         "worker_degraded post-mortems naming the "
+                         "victim, slow kinds never LOST, empty leaks "
+                         "(tools/run_chaos.py --net runs this same "
+                         "engine)")
     ap.add_argument("--workers", type=int, default=3,
                     help="worker processes for --worker-kill / "
-                         "--driver-kill")
+                         "--driver-kill / --net")
     ap.add_argument("--kills", type=int, default=2,
                     help="rounds of --worker-kill that arm a kill")
     ap.add_argument("--driver-kill", action="store_true",
@@ -1520,6 +1729,18 @@ def main() -> int:
               f"rounds oracle-equal ({recovered} stages served from "
               f"checkpoint, {resumed} queries resumed, 0 stranded "
               f"partitions)")
+        for f in s["failures"]:
+            print(f"FAILURE: {f}")
+        return 0 if ok else 1
+    if args.net:
+        s = run_net_chaos(n_workers=args.workers, seed=args.seed)
+        ok = not s["failures"] and not s["leaks"]
+        print(("PASS" if ok else "FAIL")
+              + f": {sum(1 for c in s['cells'] if c['match'])}/"
+              f"{len(s['cells'])} net-chaos cells oracle-equal "
+              f"({s['hedges']} hedges, {s['hedges_won']} won, "
+              f"{s['degraded_cells']} cells degraded the victim, "
+              f"{s['postmortems_named']} post-mortems named it)")
         for f in s["failures"]:
             print(f"FAILURE: {f}")
         return 0 if ok else 1
